@@ -1,0 +1,15 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax is imported,
+so multi-chip sharding tests (dp/tp/pp/sp/ep over jax.sharding.Mesh) run
+without TPU hardware. Bench (bench.py) runs outside pytest on the real chip.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

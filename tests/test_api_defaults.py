@@ -1,0 +1,147 @@
+"""Defaulting semantics — parity with reference
+pkg/apis/tensorflow/v1/defaults_test.go:83,122 (case normalization,
+port/replica defaulting) and the per-framework equivalents."""
+import pytest
+
+from tf_operator_tpu.api import common, job as jobapi
+from tf_operator_tpu.api import mxnet as mxapi
+from tf_operator_tpu.api import pytorch as ptapi
+from tf_operator_tpu.api import tensorflow as tfapi
+from tf_operator_tpu.api import tpujob as tpuapi
+from tf_operator_tpu.api import xgboost as xgbapi
+from tf_operator_tpu.k8s import objects
+
+from tests import testutil
+
+
+def test_tfjob_replica_type_case_normalization():
+    job = tfapi.TFJob(
+        replica_specs={
+            "ps": common.ReplicaSpec(template=testutil.tf_template()),
+            "WORKER": common.ReplicaSpec(template=testutil.tf_template()),
+            "chief": common.ReplicaSpec(template=testutil.tf_template()),
+        }
+    )
+    tfapi.set_defaults(job)
+    assert set(job.replica_specs) == {"PS", "Worker", "Chief"}
+
+
+def test_tfjob_default_port_injected():
+    job = testutil.new_tfjob(worker=1)
+    tfapi.set_defaults(job)
+    c = objects.find_container(
+        job.replica_specs["Worker"].template, tfapi.DEFAULT_CONTAINER_NAME
+    )
+    assert objects.find_port(c, tfapi.DEFAULT_PORT_NAME) == tfapi.DEFAULT_PORT
+
+
+def test_tfjob_existing_port_preserved():
+    job = tfapi.TFJob(
+        replica_specs={
+            "Worker": common.ReplicaSpec(
+                template=testutil.tf_template(ports=True)
+            )
+        }
+    )
+    job.replica_specs["Worker"].template["spec"]["containers"][0]["ports"][0][
+        "containerPort"
+    ] = 3333
+    tfapi.set_defaults(job)
+    c = objects.find_container(
+        job.replica_specs["Worker"].template, tfapi.DEFAULT_CONTAINER_NAME
+    )
+    assert objects.find_port(c, tfapi.DEFAULT_PORT_NAME) == 3333
+    assert len(c["ports"]) == 1
+
+
+def test_tfjob_default_replicas_and_policies():
+    job = tfapi.TFJob(
+        replica_specs={"Worker": common.ReplicaSpec(template=testutil.tf_template())}
+    )
+    tfapi.set_defaults(job)
+    spec = job.replica_specs["Worker"]
+    assert spec.replicas == 1
+    assert spec.restart_policy == common.RESTART_POLICY_NEVER
+    assert job.run_policy.clean_pod_policy == common.CLEAN_POD_POLICY_RUNNING
+    assert job.success_policy == tfapi.SUCCESS_POLICY_DEFAULT
+
+
+def test_pytorch_default_restart_policy_is_on_failure():
+    job = ptapi.PyTorchJob(
+        replica_specs={
+            "Master": common.ReplicaSpec(
+                template={
+                    "spec": {
+                        "containers": [
+                            {"name": "pytorch", "image": testutil.TEST_IMAGE}
+                        ]
+                    }
+                }
+            )
+        }
+    )
+    ptapi.set_defaults(job)
+    assert (
+        job.replica_specs["Master"].restart_policy
+        == common.RESTART_POLICY_ON_FAILURE
+    )
+    c = objects.find_container(job.replica_specs["Master"].template, "pytorch")
+    assert objects.find_port(c, ptapi.DEFAULT_PORT_NAME) == ptapi.DEFAULT_PORT
+
+
+@pytest.mark.parametrize(
+    "api,container,port",
+    [
+        (mxapi, "mxnet", 9091),
+        (xgbapi, "xgboost", 9999),
+    ],
+)
+def test_other_framework_default_ports(api, container, port):
+    job = api.MXJob() if api is mxapi else api.XGBoostJob()
+    rt = "Worker" if api is mxapi else "Master"
+    job.replica_specs = {
+        rt: common.ReplicaSpec(
+            template={
+                "spec": {"containers": [{"name": container, "image": "img"}]}
+            }
+        )
+    }
+    api.set_defaults(job)
+    c = objects.find_container(job.replica_specs[rt].template, container)
+    assert objects.find_port(c, api.DEFAULT_PORT_NAME) == port
+
+
+def test_tpujob_topology_math():
+    assert tpuapi.slice_hosts("v4-32") == 8
+    assert tpuapi.chips_per_host("v4-32") == 4
+    assert tpuapi.slice_hosts("v5e-8") == 1
+    assert tpuapi.slice_hosts("v5p-128") == 32
+    assert tpuapi.slice_hosts("v4-8") == 2
+
+
+def test_tpujob_defaults_derive_replicas_and_gang():
+    job = testutil.new_tpujob(accelerator_type="v4-32")
+    tpuapi.set_defaults(job)
+    worker = job.replica_specs["Worker"]
+    assert worker.replicas == 8
+    assert worker.restart_policy == common.RESTART_POLICY_EXIT_CODE
+    assert job.run_policy.scheduling_policy.min_available == 8
+    c = objects.find_container(worker.template, tpuapi.DEFAULT_CONTAINER_NAME)
+    assert c["resources"]["requests"][tpuapi.TPU_RESOURCE] == "4"
+    assert c["resources"]["limits"][tpuapi.TPU_RESOURCE] == "4"
+    assert objects.find_port(c, tpuapi.DEFAULT_PORT_NAME) == tpuapi.DEFAULT_PORT
+
+
+def test_tpujob_multislice_replicas():
+    job = testutil.new_tpujob(accelerator_type="v4-16", num_slices=2)
+    tpuapi.set_defaults(job)
+    assert job.replica_specs["Worker"].replicas == 8  # 4 hosts x 2 slices
+
+
+def test_job_roundtrip_serialization():
+    job = testutil.new_tfjob(worker=2, ps=1)
+    tfapi.set_defaults(job)
+    d = job.to_dict()
+    job2 = tfapi.TFJob.from_dict(d)
+    assert job2.to_dict() == d
+    assert job2.replica_specs["Worker"].replicas == 2

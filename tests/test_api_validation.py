@@ -1,0 +1,118 @@
+"""Validation semantics — parity with reference
+pkg/apis/tensorflow/validation/validation_test.go:26 and per-framework
+equivalents (nil specs, missing framework container, master-count rules)."""
+import pytest
+
+from tf_operator_tpu.api import common, job as jobapi
+from tf_operator_tpu.api import pytorch as ptapi
+from tf_operator_tpu.api import tensorflow as tfapi
+from tf_operator_tpu.api import tpujob as tpuapi
+from tf_operator_tpu.api import xgboost as xgbapi
+
+from tests import testutil
+
+
+def test_nil_replica_specs_invalid():
+    job = tfapi.TFJob()
+    job.replica_specs = None
+    with pytest.raises(jobapi.ValidationError):
+        tfapi.validate(job)
+
+
+def test_empty_containers_invalid():
+    job = tfapi.TFJob(
+        replica_specs={"Worker": common.ReplicaSpec(template={"spec": {"containers": []}})}
+    )
+    with pytest.raises(jobapi.ValidationError, match="containers definition"):
+        tfapi.validate(job)
+
+
+def test_missing_image_invalid():
+    job = tfapi.TFJob(
+        replica_specs={
+            "Worker": common.ReplicaSpec(
+                template={"spec": {"containers": [{"name": "tensorflow"}]}}
+            )
+        }
+    )
+    with pytest.raises(jobapi.ValidationError, match="Image is undefined"):
+        tfapi.validate(job)
+
+
+def test_no_tensorflow_container_invalid():
+    job = tfapi.TFJob(
+        replica_specs={
+            "Worker": common.ReplicaSpec(
+                template={"spec": {"containers": [{"name": "other", "image": "i"}]}}
+            )
+        }
+    )
+    with pytest.raises(jobapi.ValidationError, match="no container named tensorflow"):
+        tfapi.validate(job)
+
+
+def test_two_chiefs_invalid():
+    job = testutil.new_tfjob(chief=1, master=1, worker=1)
+    with pytest.raises(jobapi.ValidationError, match="more than 1 chief"):
+        tfapi.validate(job)
+
+
+def test_valid_tfjob_passes():
+    job = testutil.new_tfjob(worker=2, ps=1, chief=1)
+    tfapi.validate(job)
+
+
+def test_pytorch_requires_master():
+    job = ptapi.PyTorchJob(
+        replica_specs={
+            "Worker": common.ReplicaSpec(
+                template={"spec": {"containers": [{"name": "pytorch", "image": "i"}]}}
+            )
+        }
+    )
+    with pytest.raises(jobapi.ValidationError, match="Master ReplicaSpec must be present"):
+        ptapi.validate(job)
+
+
+def test_pytorch_single_master_only():
+    job = ptapi.PyTorchJob(
+        replica_specs={
+            "Master": common.ReplicaSpec(
+                replicas=2,
+                template={"spec": {"containers": [{"name": "pytorch", "image": "i"}]}},
+            )
+        }
+    )
+    with pytest.raises(jobapi.ValidationError, match="only 1 master"):
+        ptapi.validate(job)
+
+
+def test_pytorch_invalid_replica_type():
+    job = ptapi.PyTorchJob(
+        replica_specs={
+            "PS": common.ReplicaSpec(
+                template={"spec": {"containers": [{"name": "pytorch", "image": "i"}]}}
+            )
+        }
+    )
+    with pytest.raises(jobapi.ValidationError, match="unknown replica type"):
+        ptapi.validate(job)
+
+
+def test_tpujob_bad_accelerator_type():
+    job = testutil.new_tpujob(accelerator_type="h100-8")
+    with pytest.raises(jobapi.ValidationError, match="bad acceleratorType"):
+        tpuapi.validate(job)
+
+
+def test_tpujob_replica_mismatch():
+    job = testutil.new_tpujob(accelerator_type="v4-32")
+    job.replica_specs["Worker"].replicas = 3
+    with pytest.raises(jobapi.ValidationError, match="must equal"):
+        tpuapi.validate(job)
+
+
+def test_tpujob_valid_after_defaults():
+    job = testutil.new_tpujob(accelerator_type="v4-32")
+    tpuapi.set_defaults(job)
+    tpuapi.validate(job)

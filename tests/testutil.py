@@ -1,0 +1,96 @@
+"""Fixture builders — the analogue of the reference's
+pkg/common/util/v1/testutil ({tfjob,pod,service}.go builders)."""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api import common, tensorflow as tfapi, pytorch as ptapi
+from tf_operator_tpu.api import tpujob as tpuapi
+from tf_operator_tpu.k8s import objects
+
+TEST_IMAGE = "test-image:latest"
+
+
+def tf_template(image: str = TEST_IMAGE, ports: bool = False) -> Dict[str, Any]:
+    c: Dict[str, Any] = {"name": tfapi.DEFAULT_CONTAINER_NAME, "image": image}
+    if ports:
+        c["ports"] = [
+            {"name": tfapi.DEFAULT_PORT_NAME, "containerPort": tfapi.DEFAULT_PORT}
+        ]
+    return {"spec": {"containers": [c]}}
+
+
+def new_tfjob(
+    name: str = "test-tfjob",
+    namespace: str = "default",
+    worker: int = 0,
+    ps: int = 0,
+    chief: int = 0,
+    master: int = 0,
+    evaluator: int = 0,
+    **kwargs,
+) -> tfapi.TFJob:
+    """Build a TFJob with the given replica counts (reference
+    testutil/tfjob.go:27-113 builder family)."""
+    specs: Dict[str, common.ReplicaSpec] = {}
+    for rtype, n in (
+        (tfapi.REPLICA_WORKER, worker),
+        (tfapi.REPLICA_PS, ps),
+        (tfapi.REPLICA_CHIEF, chief),
+        (tfapi.REPLICA_MASTER, master),
+        (tfapi.REPLICA_EVALUATOR, evaluator),
+    ):
+        if n > 0:
+            specs[rtype] = common.ReplicaSpec(replicas=n, template=tf_template())
+    job = tfapi.TFJob(
+        metadata=objects.make_meta(name, namespace) | {"uid": objects.new_uid()},
+        replica_specs=specs,
+        **kwargs,
+    )
+    return job
+
+
+def new_tpujob(
+    name: str = "test-tpujob",
+    accelerator_type: str = "v4-32",
+    num_slices: int = 1,
+    namespace: str = "default",
+) -> tpuapi.TPUJob:
+    return tpuapi.TPUJob(
+        metadata=objects.make_meta(name, namespace) | {"uid": objects.new_uid()},
+        accelerator_type=accelerator_type,
+        num_slices=num_slices,
+        replica_specs={
+            tpuapi.REPLICA_WORKER: common.ReplicaSpec(
+                template={
+                    "spec": {
+                        "containers": [
+                            {"name": tpuapi.DEFAULT_CONTAINER_NAME, "image": TEST_IMAGE}
+                        ]
+                    }
+                }
+            )
+        },
+    )
+
+
+def set_pod_statuses(
+    pods: List[Dict[str, Any]],
+    phase: str,
+    count: int,
+    start: int = 0,
+    exit_code: Optional[int] = None,
+    container_name: str = tfapi.DEFAULT_CONTAINER_NAME,
+) -> None:
+    """Set `count` pods (from `start`) to `phase`, optionally with a
+    terminated exit code (reference testutil/pod.go:57-97)."""
+    for pod in pods[start : start + count]:
+        pod["status"]["phase"] = phase
+        if exit_code is not None:
+            pod["status"]["containerStatuses"] = [
+                {
+                    "name": container_name,
+                    "state": {"terminated": {"exitCode": exit_code}},
+                }
+            ]

@@ -1,0 +1,25 @@
+"""tf_operator_tpu — a TPU-native distributed-training operator + runtime.
+
+A brand-new framework with the capabilities of Kubeflow's tf-operator
+(reference: ryantd/tf-operator): CRD-style job specs (TFJob, PyTorchJob,
+MXJob, XGBoostJob, and the new TPUJob), a generic reconciliation engine
+(pods + headless services + cluster-discovery env injection + restart /
+success / clean-pod policies + status conditions), gang scheduling, metrics,
+and a Python client SDK — plus what the reference delegates to in-container
+frameworks: a TPU-native compute runtime (JAX/XLA/pallas) with SPMD
+parallelism (dp/tp/pp/sp/ep) over `jax.sharding.Mesh`, models, and kernels.
+
+Layer map (mirrors SURVEY.md §1 of the reference analysis):
+  k8s/          L0/L1 — cluster-state abstraction: objects, fake cluster,
+                informer-style event fanout, real-API client shim
+  api/          L2   — job types, defaulting, validation
+  engine/       L3   — generic job-controller engine (kubeflow/common equiv.)
+  controllers/  L4   — per-framework reconcilers + env injection
+  cli/          L5   — operator entrypoint (flags, health, metrics, election)
+  manifests/    L6   — CRDs + deployment yaml (repo root)
+  sdk/          L7   — user-facing job client
+  runtime/, models/, ops/, parallel/ — the TPU compute stack (new; the
+                reference leaves this to the containers it schedules)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from tf_operator_tpu.api import common
+
+__all__ = ["common"]

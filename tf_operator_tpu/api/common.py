@@ -1,0 +1,305 @@
+"""Common job API types — the equivalent of kubeflow/common pkg/apis/common/v1.
+
+The reference consumes these from the external module github.com/kubeflow/common
+v0.3.4 (interface reconstructed in SURVEY.md §2.9 from call sites and the CRD
+openAPIV3 schemas in reference manifests/base/kubeflow.org_tfjobs.yaml).
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Restart / clean-pod policies (reference swagger.json definitions;
+# RestartPolicy incl. the operator-implemented ExitCode — design doc
+# reference docs/design/tf_job_design_doc.md:84)
+# ---------------------------------------------------------------------------
+RESTART_POLICY_ALWAYS = "Always"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+RESTART_POLICY_EXIT_CODE = "ExitCode"
+RESTART_POLICIES = (
+    RESTART_POLICY_ALWAYS,
+    RESTART_POLICY_ON_FAILURE,
+    RESTART_POLICY_NEVER,
+    RESTART_POLICY_EXIT_CODE,
+)
+
+CLEAN_POD_POLICY_ALL = "All"
+CLEAN_POD_POLICY_RUNNING = "Running"
+CLEAN_POD_POLICY_NONE = "None"
+
+# Job condition types (reference swagger.json JobConditionType)
+JOB_CREATED = "Created"
+JOB_RUNNING = "Running"
+JOB_RESTARTING = "Restarting"
+JOB_SUCCEEDED = "Succeeded"
+JOB_FAILED = "Failed"
+
+
+def is_retryable_exit_code(exit_code: int) -> bool:
+    """Exit codes >=128 (signal deaths: SIGKILL, SIGSEGV, preemption class)
+    are retryable; 1-127 are permanent user errors. Same convention as
+    kubeflow/common util/train.IsRetryableExitCode (reference design doc
+    docs/design/tf_job_design_doc.md:84)."""
+    return exit_code >= 128
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (reference CRD schema schedulingPolicy block,
+    manifests/base/kubeflow.org_tfjobs.yaml:62-82)."""
+
+    min_available: Optional[int] = None
+    queue: Optional[str] = None
+    min_resources: Optional[Dict[str, str]] = None
+    priority_class: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.min_available is not None:
+            d["minAvailable"] = self.min_available
+        if self.queue is not None:
+            d["queue"] = self.queue
+        if self.min_resources is not None:
+            d["minResources"] = self.min_resources
+        if self.priority_class is not None:
+            d["priorityClass"] = self.priority_class
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["SchedulingPolicy"]:
+        if d is None:
+            return None
+        return cls(
+            min_available=d.get("minAvailable"),
+            queue=d.get("queue"),
+            min_resources=d.get("minResources"),
+            priority_class=d.get("priorityClass"),
+        )
+
+
+@dataclass
+class RunPolicy:
+    """Policies for the job as a whole (reference swagger.json RunPolicy)."""
+
+    clean_pod_policy: Optional[str] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.clean_pod_policy is not None:
+            d["cleanPodPolicy"] = self.clean_pod_policy
+        if self.ttl_seconds_after_finished is not None:
+            d["ttlSecondsAfterFinished"] = self.ttl_seconds_after_finished
+        if self.active_deadline_seconds is not None:
+            d["activeDeadlineSeconds"] = self.active_deadline_seconds
+        if self.backoff_limit is not None:
+            d["backoffLimit"] = self.backoff_limit
+        if self.scheduling_policy is not None:
+            d["schedulingPolicy"] = self.scheduling_policy.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RunPolicy":
+        d = d or {}
+        return cls(
+            clean_pod_policy=d.get("cleanPodPolicy"),
+            ttl_seconds_after_finished=d.get("ttlSecondsAfterFinished"),
+            active_deadline_seconds=d.get("activeDeadlineSeconds"),
+            backoff_limit=d.get("backoffLimit"),
+            scheduling_policy=SchedulingPolicy.from_dict(d.get("schedulingPolicy")),
+        )
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica group: count + pod template + restart policy
+    (reference swagger.json ReplicaSpec)."""
+
+    replicas: Optional[int] = None
+    template: Dict[str, Any] = field(default_factory=dict)  # podTemplateSpec dict
+    restart_policy: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"template": self.template}
+        if self.replicas is not None:
+            d["replicas"] = self.replicas
+        if self.restart_policy is not None:
+            d["restartPolicy"] = self.restart_policy
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaSpec":
+        return cls(
+            replicas=d.get("replicas"),
+            template=copy.deepcopy(d.get("template", {})),
+            restart_policy=d.get("restartPolicy"),
+        )
+
+
+@dataclass
+class JobCondition:
+    type: str = ""
+    status: str = "True"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_update_time: str = ""
+    last_transition_time: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastUpdateTime": self.last_update_time,
+            "lastTransitionTime": self.last_transition_time,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "JobCondition":
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", "True"),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_update_time=d.get("lastUpdateTime", ""),
+            last_transition_time=d.get("lastTransitionTime", ""),
+        )
+
+
+@dataclass
+class ReplicaStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"active": self.active, "succeeded": self.succeeded, "failed": self.failed}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaStatus":
+        return cls(
+            active=d.get("active", 0),
+            succeeded=d.get("succeeded", 0),
+            failed=d.get("failed", 0),
+        )
+
+
+@dataclass
+class JobStatus:
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    last_reconcile_time: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "conditions": [c.to_dict() for c in self.conditions],
+            "replicaStatuses": {k: v.to_dict() for k, v in self.replica_statuses.items()},
+        }
+        if self.start_time is not None:
+            d["startTime"] = self.start_time
+        if self.completion_time is not None:
+            d["completionTime"] = self.completion_time
+        if self.last_reconcile_time is not None:
+            d["lastReconcileTime"] = self.last_reconcile_time
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "JobStatus":
+        d = d or {}
+        return cls(
+            conditions=[JobCondition.from_dict(c) for c in d.get("conditions", []) or []],
+            replica_statuses={
+                k: ReplicaStatus.from_dict(v)
+                for k, v in (d.get("replicaStatuses", {}) or {}).items()
+            },
+            start_time=d.get("startTime"),
+            completion_time=d.get("completionTime"),
+            last_reconcile_time=d.get("lastReconcileTime"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Condition helpers — the equivalent of kubeflow/common pkg/util
+# UpdateJobConditions (used throughout reference status.go)
+# ---------------------------------------------------------------------------
+
+
+def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:
+    for c in status.conditions:
+        if c.type == cond_type:
+            return c
+    return None
+
+
+def has_condition(status: JobStatus, cond_type: str) -> bool:
+    c = get_condition(status, cond_type)
+    return c is not None and c.status == "True"
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, JOB_SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, JOB_FAILED)
+
+
+def is_finished(status: JobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)
+
+
+def is_running(status: JobStatus) -> bool:
+    return has_condition(status, JOB_RUNNING)
+
+
+def update_job_conditions(
+    status: JobStatus, cond_type: str, reason: str, message: str, now: str
+) -> None:
+    """Append/refresh a condition; terminal or state-changing conditions clear
+    the mutually-exclusive ones (Running vs Restarting vs terminal), matching
+    kubeflow/common's filterOutCondition behavior observed in reference
+    status transitions (status.go:120-211)."""
+    new_cond = JobCondition(
+        type=cond_type,
+        status="True",
+        reason=reason,
+        message=message,
+        last_update_time=now,
+        last_transition_time=now,
+    )
+    existing = get_condition(status, cond_type)
+    if existing is not None:
+        if existing.reason == reason and existing.message == message:
+            existing.last_update_time = now
+            return
+        existing.reason = reason
+        existing.message = message
+        existing.last_update_time = now
+        existing.last_transition_time = now
+    else:
+        status.conditions.append(new_cond)
+
+    # mutual exclusion: Running <-> Restarting; terminal conditions demote both
+    def _demote(t: str) -> None:
+        c = get_condition(status, t)
+        if c is not None and c.status == "True" and c.type != cond_type:
+            c.status = "False"
+            c.last_update_time = now
+            c.last_transition_time = now
+
+    if cond_type == JOB_RUNNING:
+        _demote(JOB_RESTARTING)
+    elif cond_type == JOB_RESTARTING:
+        _demote(JOB_RUNNING)
+    elif cond_type in (JOB_SUCCEEDED, JOB_FAILED):
+        _demote(JOB_RUNNING)
+        _demote(JOB_RESTARTING)

@@ -1,0 +1,216 @@
+"""Generic Job CR model + shared defaulting machinery.
+
+Framework API modules (tensorflow.py, pytorch.py, mxnet.py, xgboost.py,
+tpujob.py) specialize this with their replica types, container names, default
+ports, and validation rules — mirroring the per-framework pkg/apis/*/v1
+packages of the reference.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.k8s import objects
+
+
+class ValidationError(Exception):
+    """Raised when a job spec fails validation (reference
+    pkg/apis/tensorflow/validation/validation.go:27)."""
+
+
+@dataclass
+class Job:
+    """A training job CR. `replica_specs` maps ReplicaType -> ReplicaSpec.
+
+    Serialized form matches the reference CRD shape:
+      {apiVersion, kind, metadata, spec: {<kind>ReplicaSpecs, runPolicy, ...},
+       status: {...}}
+    """
+
+    kind: str = "Job"
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    replica_specs: Dict[str, common.ReplicaSpec] = field(default_factory=dict)
+    run_policy: common.RunPolicy = field(default_factory=common.RunPolicy)
+    status: common.JobStatus = field(default_factory=common.JobStatus)
+    api_version: str = objects.API_VERSION
+
+    # ---- identity helpers -------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.get("namespace", "default")
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.get("uid", "")
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    # ---- spec serialization ----------------------------------------------
+    def replica_specs_key(self) -> str:
+        """Key under .spec holding the replica map, e.g. 'tfReplicaSpecs'."""
+        return "replicaSpecs"
+
+    def extra_spec_to_dict(self) -> Dict[str, Any]:
+        """Framework-specific extra spec fields (successPolicy, jobMode, ...)."""
+        return {}
+
+    def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
+        pass
+
+    def to_dict(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {
+            self.replica_specs_key(): {
+                rt: rs.to_dict() for rt, rs in self.replica_specs.items()
+            },
+        }
+        run_policy = self.run_policy.to_dict()
+        if run_policy:
+            spec["runPolicy"] = run_policy
+        spec.update(self.extra_spec_to_dict())
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": copy.deepcopy(self.metadata),
+            "spec": spec,
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Job":
+        job = cls()
+        job.api_version = d.get("apiVersion", objects.API_VERSION)
+        if d.get("kind"):
+            job.kind = d["kind"]
+        job.metadata = copy.deepcopy(d.get("metadata", {}) or {})
+        spec = d.get("spec", {}) or {}
+        replicas = spec.get(job.replica_specs_key())
+        if replicas is None:
+            job.replica_specs = None  # preserved so validation can reject it
+        else:
+            job.replica_specs = {
+                rt: common.ReplicaSpec.from_dict(rs) for rt, rs in replicas.items()
+            }
+        job.run_policy = common.RunPolicy.from_dict(spec.get("runPolicy"))
+        job.extra_spec_from_dict(spec)
+        job.status = common.JobStatus.from_dict(d.get("status"))
+        return job
+
+
+# ---------------------------------------------------------------------------
+# Shared defaulting helpers (reference pkg/apis/tensorflow/v1/defaults.go:38-91,
+# replicated per framework in the reference)
+# ---------------------------------------------------------------------------
+
+
+def set_type_names_to_camel_case(job: Job, canonical_types: List[str]) -> None:
+    """Normalize replica-type keys to canonical case ('ps'->'PS',
+    'WORKER'->'Worker') — reference defaults.go:72-91."""
+    if not job.replica_specs:
+        return
+    for canon in canonical_types:
+        for existing in list(job.replica_specs.keys()):
+            if existing.lower() == canon.lower() and existing != canon:
+                job.replica_specs[canon] = job.replica_specs.pop(existing)
+                break
+
+
+def set_default_replicas(
+    spec: common.ReplicaSpec, default_restart_policy: str
+) -> None:
+    """replicas -> 1, restartPolicy -> framework default
+    (reference defaults.go:62-69)."""
+    if spec.replicas is None:
+        spec.replicas = 1
+    if not spec.restart_policy:
+        spec.restart_policy = default_restart_policy
+
+
+def set_default_port(
+    template: Dict[str, Any], container_name: str, port_name: str, port: int
+) -> None:
+    """Inject the default RPC port into the framework container if the named
+    port is absent. Falls back to container index 0 when no container carries
+    the framework name — same as reference defaults.go:38-60."""
+    containers = template.setdefault("spec", {}).setdefault("containers", [])
+    if not containers:
+        return
+    target = objects.find_container(template, container_name) or containers[0]
+    for p in target.get("ports", []) or []:
+        if p.get("name") == port_name:
+            return
+    target.setdefault("ports", []).append(
+        {"name": port_name, "containerPort": port}
+    )
+
+
+def apply_common_defaults(
+    job: Job,
+    canonical_types: List[str],
+    container_name: str,
+    port_name: str,
+    port: int,
+    default_restart_policy: str,
+) -> None:
+    if job.run_policy.clean_pod_policy is None:
+        job.run_policy.clean_pod_policy = common.CLEAN_POD_POLICY_RUNNING
+    set_type_names_to_camel_case(job, canonical_types)
+    for spec in (job.replica_specs or {}).values():
+        set_default_replicas(spec, default_restart_policy)
+        set_default_port(spec.template, container_name, port_name, port)
+
+
+def validate_replica_specs(
+    job: Job,
+    container_name: str,
+    valid_types: Optional[List[str]] = None,
+    masterish_types: Optional[List[str]] = None,
+    kind: str = "Job",
+) -> None:
+    """Shared validation (reference validation.go:27-66): specs non-nil,
+    containers present, image set, >=1 container with the framework name,
+    <=1 chief/master replica."""
+    specs = job.replica_specs
+    if specs is None or not isinstance(specs, dict):
+        raise ValidationError(f"{kind}Spec is not valid")
+    found_masterish = 0
+    for rtype, rspec in specs.items():
+        if valid_types is not None and rtype not in valid_types:
+            raise ValidationError(
+                f"{kind}Spec is not valid: unknown replica type {rtype!r}"
+            )
+        containers = (
+            (rspec.template or {}).get("spec", {}).get("containers", []) or []
+            if rspec is not None
+            else []
+        )
+        if rspec is None or not containers:
+            raise ValidationError(
+                f"{kind}Spec is not valid: containers definition expected in {rtype}"
+            )
+        if masterish_types and rtype in masterish_types:
+            found_masterish += 1
+        num_named = 0
+        for c in containers:
+            if not c.get("image"):
+                raise ValidationError(
+                    f"{kind}Spec is not valid: Image is undefined in the container of {rtype}"
+                )
+            if c.get("name") == container_name:
+                num_named += 1
+        if num_named == 0:
+            raise ValidationError(
+                f"{kind}Spec is not valid: There is no container named "
+                f"{container_name} in {rtype}"
+            )
+    if found_masterish > 1:
+        raise ValidationError(
+            f"{kind}Spec is not valid: more than 1 chief/master found"
+        )

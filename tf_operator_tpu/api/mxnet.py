@@ -1,0 +1,81 @@
+"""MXJob API types, defaults, validation.
+
+Reference parity: pkg/apis/mxnet/v1/{mxjob_types.go,defaults.go,constants.go}
++ pkg/apis/mxnet/validation/validation.go.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from tf_operator_tpu.api import common, job as jobapi
+
+KIND = "MXJob"
+PLURAL = "mxjobs"
+
+# Replica types (reference mxjob_types.go:58-77)
+REPLICA_SCHEDULER = "Scheduler"
+REPLICA_SERVER = "Server"
+REPLICA_WORKER = "Worker"
+REPLICA_TUNER_TRACKER = "TunerTracker"
+REPLICA_TUNER_SERVER = "TunerServer"
+REPLICA_TUNER = "Tuner"
+REPLICA_TYPES = [
+    REPLICA_SCHEDULER,
+    REPLICA_SERVER,
+    REPLICA_WORKER,
+    REPLICA_TUNER_TRACKER,
+    REPLICA_TUNER_SERVER,
+    REPLICA_TUNER,
+]
+
+# Job modes (reference mxjob_types.go:46-56)
+MODE_TRAIN = "MXTrain"
+MODE_TUNE = "MXTune"
+
+# Reference constants.go:8-14
+DEFAULT_PORT_NAME = "mxjob-port"
+DEFAULT_CONTAINER_NAME = "mxnet"
+DEFAULT_PORT = 9091
+DEFAULT_RESTART_POLICY = common.RESTART_POLICY_NEVER
+
+
+def is_scheduler(rtype: str) -> bool:
+    return rtype == REPLICA_SCHEDULER
+
+
+@dataclass
+class MXJob(jobapi.Job):
+    kind: str = KIND
+    job_mode: str = MODE_TRAIN
+
+    def replica_specs_key(self) -> str:
+        return "mxReplicaSpecs"
+
+    def extra_spec_to_dict(self) -> Dict[str, Any]:
+        return {"jobMode": self.job_mode}
+
+    def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
+        self.job_mode = spec.get("jobMode", MODE_TRAIN)
+
+
+def set_defaults(job: MXJob) -> None:
+    jobapi.apply_common_defaults(
+        job,
+        REPLICA_TYPES,
+        DEFAULT_CONTAINER_NAME,
+        DEFAULT_PORT_NAME,
+        DEFAULT_PORT,
+        DEFAULT_RESTART_POLICY,
+    )
+
+
+def validate(job: MXJob) -> None:
+    """Reference ValidateV1MXJobSpec: <=1 Scheduler
+    (pkg/apis/mxnet/validation/validation.go)."""
+    jobapi.validate_replica_specs(
+        job,
+        DEFAULT_CONTAINER_NAME,
+        masterish_types=[REPLICA_SCHEDULER],
+        kind=KIND,
+    )

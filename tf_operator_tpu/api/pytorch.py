@@ -1,0 +1,62 @@
+"""PyTorchJob API types, defaults, validation.
+
+Reference parity: pkg/apis/pytorch/v1/{pytorchjob_types.go,defaults.go,
+constants.go} + pkg/apis/pytorch/validation/validation.go.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+from tf_operator_tpu.api import common, job as jobapi
+
+KIND = "PyTorchJob"
+PLURAL = "pytorchjobs"
+
+REPLICA_MASTER = "Master"
+REPLICA_WORKER = "Worker"
+REPLICA_TYPES = [REPLICA_MASTER, REPLICA_WORKER]
+
+# Reference constants.go:24-30
+DEFAULT_PORT_NAME = "pytorchjob-port"
+DEFAULT_CONTAINER_NAME = "pytorch"
+DEFAULT_PORT = 23456
+DEFAULT_RESTART_POLICY = common.RESTART_POLICY_ON_FAILURE
+
+
+@dataclass
+class PyTorchJob(jobapi.Job):
+    kind: str = KIND
+
+    def replica_specs_key(self) -> str:
+        return "pytorchReplicaSpecs"
+
+
+def set_defaults(job: PyTorchJob) -> None:
+    """Reference pkg/apis/pytorch/v1/defaults.go:36-58."""
+    jobapi.apply_common_defaults(
+        job,
+        REPLICA_TYPES,
+        DEFAULT_CONTAINER_NAME,
+        DEFAULT_PORT_NAME,
+        DEFAULT_PORT,
+        DEFAULT_RESTART_POLICY,
+    )
+
+
+def validate(job: PyTorchJob) -> None:
+    """Reference ValidateV1PyTorchJobSpec: valid replica types only, exactly
+    one Master replica required (pkg/apis/pytorch/validation/validation.go)."""
+    jobapi.validate_replica_specs(
+        job, DEFAULT_CONTAINER_NAME, valid_types=REPLICA_TYPES, kind=KIND
+    )
+    specs = job.replica_specs or {}
+    master = specs.get(REPLICA_MASTER)
+    if master is None:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: Master ReplicaSpec must be present"
+        )
+    if master.replicas is not None and master.replicas != 1:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: There must be only 1 master replica"
+        )

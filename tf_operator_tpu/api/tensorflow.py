@@ -1,0 +1,116 @@
+"""TFJob API types, defaults, validation, helpers.
+
+Reference parity: pkg/apis/tensorflow/v1/{types.go,defaults.go,constants.go,
+common.go,util.go} + pkg/apis/tensorflow/validation/validation.go.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from tf_operator_tpu.api import common, job as jobapi
+
+KIND = "TFJob"
+PLURAL = "tfjobs"
+
+# Replica types (reference types.go:75-94)
+REPLICA_PS = "PS"
+REPLICA_WORKER = "Worker"
+REPLICA_CHIEF = "Chief"
+REPLICA_MASTER = "Master"
+REPLICA_EVALUATOR = "Evaluator"
+REPLICA_TYPES = [
+    REPLICA_PS,
+    REPLICA_WORKER,
+    REPLICA_CHIEF,
+    REPLICA_MASTER,
+    REPLICA_EVALUATOR,
+]
+
+# Defaults (reference constants.go:24-34)
+DEFAULT_PORT_NAME = "tfjob-port"
+DEFAULT_CONTAINER_NAME = "tensorflow"
+DEFAULT_PORT = 2222
+DEFAULT_RESTART_POLICY = common.RESTART_POLICY_NEVER
+
+# Success policies (reference common.go:21-22)
+SUCCESS_POLICY_DEFAULT = ""  # worker-0 defines success
+SUCCESS_POLICY_ALL_WORKERS = "AllWorkers"
+
+
+def is_chief_or_master(rtype: str) -> bool:
+    """Reference util.go:22."""
+    return rtype in (REPLICA_CHIEF, REPLICA_MASTER)
+
+
+def is_worker(rtype: str) -> bool:
+    return rtype == REPLICA_WORKER
+
+
+def is_evaluator(rtype: str) -> bool:
+    return rtype == REPLICA_EVALUATOR
+
+
+@dataclass
+class TFJob(jobapi.Job):
+    kind: str = KIND
+    success_policy: Optional[str] = None  # reference types.go:56-61
+    enable_dynamic_worker: bool = False  # reference types.go:62-69
+
+    def replica_specs_key(self) -> str:
+        return "tfReplicaSpecs"
+
+    def extra_spec_to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.success_policy is not None:
+            d["successPolicy"] = self.success_policy
+        if self.enable_dynamic_worker:
+            d["enableDynamicWorker"] = True
+        return d
+
+    def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
+        self.success_policy = spec.get("successPolicy")
+        self.enable_dynamic_worker = bool(spec.get("enableDynamicWorker", False))
+
+
+def set_defaults(tfjob: TFJob) -> None:
+    """Reference SetDefaults_TFJob (defaults.go:94-115)."""
+    if tfjob.success_policy is None:
+        tfjob.success_policy = SUCCESS_POLICY_DEFAULT
+    jobapi.apply_common_defaults(
+        tfjob,
+        REPLICA_TYPES,
+        DEFAULT_CONTAINER_NAME,
+        DEFAULT_PORT_NAME,
+        DEFAULT_PORT,
+        DEFAULT_RESTART_POLICY,
+    )
+
+
+def validate(tfjob: TFJob) -> None:
+    """Reference ValidateV1TFJobSpec (validation.go:27-66)."""
+    jobapi.validate_replica_specs(
+        tfjob,
+        DEFAULT_CONTAINER_NAME,
+        masterish_types=[REPLICA_CHIEF, REPLICA_MASTER],
+        kind=KIND,
+    )
+
+
+def get_port(tfjob: TFJob) -> int:
+    """Look up the tfjob-port on the tensorflow container; default 2222
+    (reference util.go:29-42)."""
+    from tf_operator_tpu.k8s import objects
+
+    for rspec in (tfjob.replica_specs or {}).values():
+        c = objects.find_container(rspec.template, DEFAULT_CONTAINER_NAME)
+        if c is not None:
+            port = objects.find_port(c, DEFAULT_PORT_NAME)
+            if port:
+                return port
+    return DEFAULT_PORT
+
+
+def contains_chief_or_master(tfjob: TFJob) -> bool:
+    """Reference util.go:45-52."""
+    return any(is_chief_or_master(rt) for rt in (tfjob.replica_specs or {}))

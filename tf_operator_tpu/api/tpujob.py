@@ -1,0 +1,181 @@
+"""TPUJob — the TPU-native job kind (new; no reference counterpart).
+
+The reference schedules per-pod GPU workers (nvidia.com/gpu + NCCL,
+reference examples/v1/distribution_strategy/keras-API/multi_worker_tfjob.yaml).
+A TPU slice is different: it is allocated whole, one pod per TPU VM *host*,
+`google.com/tpu` chips per host, collectives over ICI — so the job unit is
+the slice, replica count is derived from the accelerator topology, and
+scheduling must be gang-atomic (SURVEY.md §2.10, §7.4 item 1).
+
+Spec shape:
+  spec:
+    acceleratorType: "v4-32"          # generation-chips
+    topology: "2x2x4"                 # optional chip topology override
+    numSlices: 1                      # multislice (DCN-connected) jobs
+    tpuReplicaSpecs:
+      Worker: {replicas: <derived>, template: {...}}
+    runPolicy: {...}
+"""
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from tf_operator_tpu.api import common, job as jobapi
+
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+
+REPLICA_WORKER = "Worker"
+REPLICA_TYPES = [REPLICA_WORKER]
+
+DEFAULT_PORT_NAME = "tpujob-port"
+DEFAULT_CONTAINER_NAME = "tpu"
+DEFAULT_PORT = 8471  # TPU runtime gRPC port on each TPU VM host
+COORDINATOR_PORT_NAME = "coordinator-port"
+DEFAULT_COORDINATOR_PORT = 8476  # jax.distributed coordinator
+DEFAULT_RESTART_POLICY = common.RESTART_POLICY_EXIT_CODE
+
+TPU_RESOURCE = "google.com/tpu"
+
+# chips per TPU VM host, by generation
+CHIPS_PER_HOST = {
+    "v2": 4,
+    "v3": 4,
+    "v4": 4,
+    "v5p": 4,
+    "v5e": 8,
+    "v5litepod": 8,
+    "v6e": 8,
+}
+
+_ACCEL_RE = re.compile(r"^(v\d+(?:p|e|litepod)?)-(\d+)$")
+
+
+def parse_accelerator_type(accelerator_type: str) -> Tuple[str, int]:
+    """'v4-32' -> ('v4', 32 chips). Raises ValidationError on bad input."""
+    m = _ACCEL_RE.match(accelerator_type or "")
+    if not m:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: bad acceleratorType {accelerator_type!r} "
+            f"(want e.g. 'v4-32')"
+        )
+    gen, chips = m.group(1), int(m.group(2))
+    if gen not in CHIPS_PER_HOST:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: unknown TPU generation {gen!r}"
+        )
+    if chips <= 0:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: chip count must be positive"
+        )
+    return gen, chips
+
+
+def slice_hosts(accelerator_type: str) -> int:
+    """Number of TPU VM hosts (= pods) in one slice of `accelerator_type`."""
+    gen, chips = parse_accelerator_type(accelerator_type)
+    per_host = CHIPS_PER_HOST[gen]
+    return max(1, math.ceil(chips / per_host))
+
+
+def chips_per_host(accelerator_type: str) -> int:
+    gen, chips = parse_accelerator_type(accelerator_type)
+    return min(chips, CHIPS_PER_HOST[gen])
+
+
+@dataclass
+class TPUJob(jobapi.Job):
+    kind: str = KIND
+    accelerator_type: str = ""
+    topology: Optional[str] = None  # e.g. "2x2x4"
+    num_slices: int = 1  # multislice over DCN
+
+    def replica_specs_key(self) -> str:
+        return "tpuReplicaSpecs"
+
+    def extra_spec_to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"acceleratorType": self.accelerator_type}
+        if self.topology:
+            d["topology"] = self.topology
+        if self.num_slices != 1:
+            d["numSlices"] = self.num_slices
+        return d
+
+    def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
+        self.accelerator_type = spec.get("acceleratorType", "")
+        self.topology = spec.get("topology")
+        self.num_slices = int(spec.get("numSlices", 1))
+
+
+def set_defaults(job: TPUJob) -> None:
+    """Replicas derive from the slice topology (hosts x numSlices); TPU chips
+    are injected as container resources; restart policy defaults to ExitCode
+    so preemption (retryable) restarts the slice while user errors fail it."""
+    if job.run_policy.clean_pod_policy is None:
+        job.run_policy.clean_pod_policy = common.CLEAN_POD_POLICY_RUNNING
+    jobapi.set_type_names_to_camel_case(job, REPLICA_TYPES)
+    specs = job.replica_specs or {}
+    worker = specs.get(REPLICA_WORKER)
+    if worker is None:
+        return
+    try:
+        hosts = slice_hosts(job.accelerator_type)
+        per_host = chips_per_host(job.accelerator_type)
+    except jobapi.ValidationError:
+        hosts, per_host = None, None
+    if worker.replicas is None and hosts is not None:
+        worker.replicas = hosts * max(1, job.num_slices)
+    if not worker.restart_policy:
+        worker.restart_policy = DEFAULT_RESTART_POLICY
+    jobapi.set_default_port(
+        worker.template, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME, DEFAULT_PORT
+    )
+    jobapi.set_default_port(
+        worker.template,
+        DEFAULT_CONTAINER_NAME,
+        COORDINATOR_PORT_NAME,
+        DEFAULT_COORDINATOR_PORT,
+    )
+    # inject google.com/tpu resource requests/limits on the tpu container
+    if per_host is not None:
+        from tf_operator_tpu.k8s import objects
+
+        containers = worker.template.get("spec", {}).get("containers", [])
+        target = objects.find_container(worker.template, DEFAULT_CONTAINER_NAME)
+        if target is None and containers:
+            target = containers[0]
+        if target is not None:
+            res = target.setdefault("resources", {})
+            for kind in ("requests", "limits"):
+                res.setdefault(kind, {}).setdefault(TPU_RESOURCE, str(per_host))
+    # gang scheduling is mandatory for a slice: minAvailable = all replicas
+    sp = job.run_policy.scheduling_policy or common.SchedulingPolicy()
+    if sp.min_available is None and worker.replicas is not None:
+        sp.min_available = worker.replicas
+    job.run_policy.scheduling_policy = sp
+
+
+def validate(job: TPUJob) -> None:
+    jobapi.validate_replica_specs(
+        job, DEFAULT_CONTAINER_NAME, valid_types=REPLICA_TYPES, kind=KIND
+    )
+    gen_chips = parse_accelerator_type(job.accelerator_type)  # raises if bad
+    del gen_chips
+    if job.num_slices < 1:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: numSlices must be >= 1"
+        )
+    worker = (job.replica_specs or {}).get(REPLICA_WORKER)
+    if worker is None:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: Worker ReplicaSpec must be present"
+        )
+    expected = slice_hosts(job.accelerator_type) * max(1, job.num_slices)
+    if worker.replicas is not None and worker.replicas != expected:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: replicas ({worker.replicas}) must equal "
+            f"hosts-per-slice x numSlices ({expected}) for {job.accelerator_type}"
+        )

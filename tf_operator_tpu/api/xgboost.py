@@ -1,0 +1,58 @@
+"""XGBoostJob API types, defaults, validation.
+
+Reference parity: pkg/apis/xgboost/v1 + pkg/apis/xgboost/validation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tf_operator_tpu.api import common, job as jobapi
+
+KIND = "XGBoostJob"
+PLURAL = "xgboostjobs"
+
+REPLICA_MASTER = "Master"
+REPLICA_WORKER = "Worker"
+REPLICA_TYPES = [REPLICA_MASTER, REPLICA_WORKER]
+
+# Reference constants.go:22-28
+DEFAULT_PORT_NAME = "xgboostjob-port"
+DEFAULT_CONTAINER_NAME = "xgboost"
+DEFAULT_PORT = 9999
+DEFAULT_RESTART_POLICY = common.RESTART_POLICY_NEVER
+
+
+@dataclass
+class XGBoostJob(jobapi.Job):
+    kind: str = KIND
+
+    def replica_specs_key(self) -> str:
+        return "xgbReplicaSpecs"
+
+
+def set_defaults(job: XGBoostJob) -> None:
+    jobapi.apply_common_defaults(
+        job,
+        REPLICA_TYPES,
+        DEFAULT_CONTAINER_NAME,
+        DEFAULT_PORT_NAME,
+        DEFAULT_PORT,
+        DEFAULT_RESTART_POLICY,
+    )
+
+
+def validate(job: XGBoostJob) -> None:
+    """Reference ValidateV1XGBoostJobSpec: valid types, exactly one Master."""
+    jobapi.validate_replica_specs(
+        job, DEFAULT_CONTAINER_NAME, valid_types=REPLICA_TYPES, kind=KIND
+    )
+    specs = job.replica_specs or {}
+    master = specs.get(REPLICA_MASTER)
+    if master is None:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: Master ReplicaSpec must be present"
+        )
+    if master.replicas is not None and master.replicas != 1:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: There must be only 1 master replica"
+        )

@@ -1,0 +1,4 @@
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+__all__ = ["objects", "FakeCluster"]

@@ -1,0 +1,190 @@
+"""FakeCluster — an in-memory Kubernetes-state double.
+
+Serves two roles:
+  1. The test substrate: the reference tests controllers by injecting fixture
+     pods/services straight into informer indexers (reference
+     pkg/controller.v1/tensorflow/job_test.go:40-64, testutil/pod.go:57-97);
+     FakeCluster is the Python equivalent.
+  2. The ClusterClient interface the engine is written against; the real
+     apiserver-backed client (k8s/client.py) implements the same surface, so
+     the engine is oblivious to which one it runs on.
+
+Event subscription gives informer-style add/update/delete notifications used
+by expectation accounting (reference pkg/common/util/reconciler.go:38-157).
+"""
+from __future__ import annotations
+
+import copy
+import fnmatch
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.k8s import objects
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class NotFoundError(ApiError):
+    def __init__(self, message: str = "not found"):
+        super().__init__(404, message)
+
+
+class ConflictError(ApiError):
+    def __init__(self, message: str = "conflict"):
+        super().__init__(409, message)
+
+
+EventHandler = Callable[[str, Dict[str, Any]], None]  # (event_type, obj)
+
+
+class FakeCluster:
+    """In-memory object store: pods, services, podgroups, and job CRs
+    (stored unstructured, keyed by kind)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # kind -> {namespace/name -> obj}
+        self._store: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._handlers: Dict[str, List[EventHandler]] = {}
+        self._rv = 0  # resourceVersion counter
+        self.events: List[Dict[str, Any]] = []  # recorded k8s Events
+
+    # ------------------------------------------------------------------ util
+    def _bump(self, obj: Dict[str, Any]) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+
+    def _kind_store(self, kind: str) -> Dict[str, Dict[str, Any]]:
+        return self._store.setdefault(kind, {})
+
+    def subscribe(self, kind: str, handler: EventHandler) -> None:
+        self._handlers.setdefault(kind, []).append(handler)
+
+    def _notify(self, kind: str, event_type: str, obj: Dict[str, Any]) -> None:
+        for h in self._handlers.get(kind, []):
+            h(event_type, copy.deepcopy(obj))
+
+    # ------------------------------------------------------------- generic
+    def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            key = objects.key_of(obj)
+            store = self._kind_store(kind)
+            if key in store:
+                raise ConflictError(f"{kind} {key} already exists")
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("uid", objects.new_uid())
+            meta.setdefault("creationTimestamp", objects.now_iso())
+            self._bump(obj)
+            store[key] = obj
+        self._notify(kind, "ADDED", obj)
+        return copy.deepcopy(obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        with self._lock:
+            store = self._kind_store(kind)
+            key = f"{namespace}/{name}"
+            if key not in store:
+                raise NotFoundError(f"{kind} {key}")
+            return copy.deepcopy(store[key])
+
+    def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            key = objects.key_of(obj)
+            store = self._kind_store(kind)
+            if key not in store:
+                raise NotFoundError(f"{kind} {key}")
+            obj = copy.deepcopy(obj)
+            self._bump(obj)
+            store[key] = obj
+        self._notify(kind, "MODIFIED", obj)
+        return copy.deepcopy(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            store = self._kind_store(kind)
+            key = f"{namespace}/{name}"
+            if key not in store:
+                raise NotFoundError(f"{kind} {key}")
+            obj = store.pop(key)
+        self._notify(kind, "DELETED", obj)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for obj in self._kind_store(kind).values():
+                if namespace is not None and objects.namespace_of(obj) != namespace:
+                    continue
+                if selector and not objects.selector_matches(
+                    selector, objects.labels_of(obj)
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    # ------------------------------------------------------------- typed sugar
+    def create_pod(self, pod: Dict[str, Any]) -> Dict[str, Any]:
+        return self.create("Pod", pod)
+
+    def get_pod(self, namespace: str, name: str) -> Dict[str, Any]:
+        return self.get("Pod", namespace, name)
+
+    def update_pod(self, pod: Dict[str, Any]) -> Dict[str, Any]:
+        return self.update("Pod", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.delete("Pod", namespace, name)
+
+    def list_pods(self, namespace=None, selector=None) -> List[Dict[str, Any]]:
+        return self.list("Pod", namespace, selector)
+
+    def create_service(self, svc: Dict[str, Any]) -> Dict[str, Any]:
+        return self.create("Service", svc)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self.delete("Service", namespace, name)
+
+    def list_services(self, namespace=None, selector=None) -> List[Dict[str, Any]]:
+        return self.list("Service", namespace, selector)
+
+    # ------------------------------------------------------------- events
+    def record_event(
+        self,
+        obj: Dict[str, Any],
+        event_type: str,
+        reason: str,
+        message: str,
+    ) -> None:
+        """k8s Event recorder analogue (reference uses record.EventRecorder
+        for every lifecycle edge — SURVEY.md §5.5)."""
+        self.events.append(
+            {
+                "type": event_type,
+                "reason": reason,
+                "message": message,
+                "involvedObject": {
+                    "kind": obj.get("kind", ""),
+                    "name": objects.name_of(obj),
+                    "namespace": objects.namespace_of(obj),
+                },
+                "timestamp": objects.now_iso(),
+            }
+        )
+
+    def events_for(self, name: str, event_type: Optional[str] = None) -> List[Dict[str, Any]]:
+        return [
+            e
+            for e in self.events
+            if e["involvedObject"]["name"] == name
+            and (event_type is None or e["type"] == event_type)
+        ]

@@ -1,0 +1,219 @@
+"""Kubernetes-shaped object helpers.
+
+Pods and Services are represented as plain nested dicts in standard k8s JSON
+shape (the reference manipulates typed Go structs; its legacy informer path
+works on Unstructured — see reference pkg/common/util/v1/unstructured/
+informer.go:26 — and dicts are the Python-idiomatic unstructured form).
+This module holds constructors and accessors so the rest of the codebase
+never hand-assembles raw dicts.
+"""
+from __future__ import annotations
+
+import copy
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+# Pod phases (k8s core/v1)
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+POD_UNKNOWN = "Unknown"
+
+# Label keys — same contract as the reference (kubeflow/common
+# JobRoleLabel/ReplicaTypeLabel; see reference tfjob_controller.go:762-767).
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_REPLICA_TYPE = "replica-type"
+LABEL_REPLICA_INDEX = "replica-index"
+LABEL_JOB_ROLE = "job-role"
+
+GROUP_NAME = "kubeflow.org"
+API_VERSION = GROUP_NAME + "/v1"
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+def make_meta(
+    name: str,
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    meta: Dict[str, Any] = {"name": name, "namespace": namespace}
+    if labels:
+        meta["labels"] = dict(labels)
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    return meta
+
+
+def owner_reference(owner: Dict[str, Any], controller: bool = True) -> Dict[str, Any]:
+    """Build an ownerReference to `owner` (a k8s-shaped dict with apiVersion,
+    kind, metadata.name/.uid). Mirrors GenOwnerReference usage
+    (reference pod.go:183)."""
+    meta = owner.get("metadata", {})
+    return {
+        "apiVersion": owner.get("apiVersion", API_VERSION),
+        "kind": owner.get("kind", ""),
+        "name": meta.get("name", ""),
+        "uid": meta.get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+
+
+def get_controller_of(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def name_of(obj: Dict[str, Any]) -> str:
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace_of(obj: Dict[str, Any]) -> str:
+    return obj.get("metadata", {}).get("namespace", "default")
+
+
+def uid_of(obj: Dict[str, Any]) -> str:
+    return obj.get("metadata", {}).get("uid", "")
+
+
+def labels_of(obj: Dict[str, Any]) -> Dict[str, str]:
+    return obj.get("metadata", {}).get("labels", {}) or {}
+
+
+def key_of(obj: Dict[str, Any]) -> str:
+    """namespace/name key (client-go cache.MetaNamespaceKeyFunc analogue)."""
+    return f"{namespace_of(obj)}/{name_of(obj)}"
+
+
+def pod_phase(pod: Dict[str, Any]) -> str:
+    return pod.get("status", {}).get("phase", POD_PENDING)
+
+
+def is_pod_active(pod: Dict[str, Any]) -> bool:
+    return pod_phase(pod) in (POD_PENDING, POD_RUNNING)
+
+
+def pod_deleted(pod: Dict[str, Any]) -> bool:
+    return bool(pod.get("metadata", {}).get("deletionTimestamp"))
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    template: Optional[Dict[str, Any]] = None,
+    phase: str = POD_PENDING,
+) -> Dict[str, Any]:
+    """Construct a pod dict, optionally from a podTemplateSpec dict
+    ({metadata: ..., spec: ...})."""
+    template = copy.deepcopy(template) if template else {}
+    meta = template.get("metadata", {})
+    merged_labels = dict(meta.get("labels", {}) or {})
+    if labels:
+        merged_labels.update(labels)
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": merged_labels,
+            "annotations": dict(meta.get("annotations", {}) or {}),
+        },
+        "spec": template.get("spec", {}),
+        "status": {"phase": phase},
+    }
+    return pod
+
+
+def make_service(
+    name: str,
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    selector: Optional[Dict[str, str]] = None,
+    port: int = 0,
+    port_name: str = "",
+) -> Dict[str, Any]:
+    """A headless Service giving the replica a stable DNS name
+    (reference: engine ReconcileServices; clusterIP None)."""
+    svc: Dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": dict(labels or {}),
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": dict(selector or labels or {}),
+            "ports": [],
+        },
+    }
+    if port:
+        svc["spec"]["ports"].append({"name": port_name or "port", "port": port})
+    return svc
+
+
+def containers_of(pod_or_template: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return pod_or_template.get("spec", {}).get("containers", []) or []
+
+
+def find_container(pod_or_template: Dict[str, Any], name: str) -> Optional[Dict[str, Any]]:
+    for c in containers_of(pod_or_template):
+        if c.get("name") == name:
+            return c
+    return None
+
+
+def find_port(container: Dict[str, Any], port_name: str) -> Optional[int]:
+    for p in container.get("ports", []) or []:
+        if p.get("name") == port_name:
+            return p.get("containerPort")
+    return None
+
+
+def set_env(container: Dict[str, Any], name: str, value: str) -> None:
+    """Idempotently set an env var on a container dict."""
+    env = container.setdefault("env", [])
+    for e in env:
+        if e.get("name") == name:
+            e["value"] = value
+            return
+    env.append({"name": name, "value": value})
+
+
+def get_env(container: Dict[str, Any], name: str) -> Optional[str]:
+    for e in container.get("env", []) or []:
+        if e.get("name") == name:
+            return e.get("value")
+    return None
+
+
+def selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def container_exit_code(pod: Dict[str, Any], container_name: str) -> int:
+    """Read the terminated exit code of `container_name` from containerStatuses.
+    Returns the 0xbeef sentinel when unavailable — same magic the reference
+    uses (reference pod.go:129-138)."""
+    for st in pod.get("status", {}).get("containerStatuses", []) or []:
+        if st.get("name") == container_name:
+            term = (st.get("state") or {}).get("terminated")
+            if term is not None and "exitCode" in term:
+                return int(term["exitCode"])
+    return 0xBEEF
