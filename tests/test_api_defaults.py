@@ -112,20 +112,24 @@ def test_other_framework_default_ports(api, container, port):
 
 
 def test_tpujob_topology_math():
-    assert tpuapi.slice_hosts("v4-32") == 8
+    # v2-v5p suffixes are TensorCores (2/chip): v4-32 = 16 chips = 4 hosts
+    assert tpuapi.slice_hosts("v4-32") == 4
     assert tpuapi.chips_per_host("v4-32") == 4
+    # v5e/v6e suffixes are chips directly
     assert tpuapi.slice_hosts("v5e-8") == 1
-    assert tpuapi.slice_hosts("v5p-128") == 32
-    assert tpuapi.slice_hosts("v4-8") == 2
+    assert tpuapi.slice_hosts("v5e-16") == 2
+    assert tpuapi.slice_hosts("v5p-128") == 16
+    assert tpuapi.slice_hosts("v4-8") == 1
+    assert tpuapi.parse_topology("2x2x4") == 16
 
 
 def test_tpujob_defaults_derive_replicas_and_gang():
     job = testutil.new_tpujob(accelerator_type="v4-32")
     tpuapi.set_defaults(job)
     worker = job.replica_specs["Worker"]
-    assert worker.replicas == 8
+    assert worker.replicas == 4
     assert worker.restart_policy == common.RESTART_POLICY_EXIT_CODE
-    assert job.run_policy.scheduling_policy.min_available == 8
+    assert job.run_policy.scheduling_policy.min_available == 4
     c = objects.find_container(worker.template, tpuapi.DEFAULT_CONTAINER_NAME)
     assert c["resources"]["requests"][tpuapi.TPU_RESOURCE] == "4"
     assert c["resources"]["limits"][tpuapi.TPU_RESOURCE] == "4"
@@ -135,7 +139,18 @@ def test_tpujob_defaults_derive_replicas_and_gang():
 def test_tpujob_multislice_replicas():
     job = testutil.new_tpujob(accelerator_type="v4-16", num_slices=2)
     tpuapi.set_defaults(job)
-    assert job.replica_specs["Worker"].replicas == 8  # 4 hosts x 2 slices
+    assert job.replica_specs["Worker"].replicas == 4  # 2 hosts x 2 slices
+
+
+def test_tpujob_topology_mismatch_rejected():
+    job = testutil.new_tpujob(accelerator_type="v4-32")
+    job.topology = "2x2x2"  # 8 chips, but v4-32 is 16
+    tpuapi.set_defaults(job)
+    import pytest as _pytest
+    from tf_operator_tpu.api import job as jobapi
+
+    with _pytest.raises(jobapi.ValidationError, match="does not match"):
+        tpuapi.validate(job)
 
 
 def test_job_roundtrip_serialization():

@@ -1,0 +1,211 @@
+"""Cluster-discovery env injection tests — parity with reference
+pod_test.go TestClusterSpec:230, tensorflow_test.go:23 (sparse), and the
+pytorch/mxnet/xgboost SetPodEnv suites; plus the new TPU/JAX wiring."""
+import json
+
+import pytest
+
+from tf_operator_tpu.api import common, mxnet as mxapi, pytorch as ptapi
+from tf_operator_tpu.api import tensorflow as tfapi, tpujob as tpuapi
+from tf_operator_tpu.api import xgboost as xgbapi
+from tf_operator_tpu.controllers.mxnet import MXNetAdapter
+from tf_operator_tpu.controllers.pytorch import PyTorchAdapter
+from tf_operator_tpu.controllers.tensorflow import (
+    TFAdapter,
+    gen_cluster_spec,
+    gen_tf_config,
+    sparse_cluster_spec,
+)
+from tf_operator_tpu.controllers.tpu import TPUAdapter
+from tf_operator_tpu.controllers.xgboost import XGBoostAdapter
+from tf_operator_tpu.k8s import objects
+
+from tests import testutil
+
+
+def env_of(template, container_name):
+    c = objects.find_container(template, container_name)
+    return {e["name"]: e["value"] for e in c.get("env", [])}
+
+
+def test_tf_config_content():
+    job = testutil.new_tfjob(name="mnist", worker=2, ps=1)
+    tfapi.set_defaults(job)
+    cfg = json.loads(gen_tf_config(job, "Worker", 1))
+    assert cfg["task"] == {"type": "worker", "index": 1}
+    assert cfg["environment"] == "cloud"
+    assert cfg["cluster"]["worker"] == [
+        "mnist-worker-0.default.svc:2222",
+        "mnist-worker-1.default.svc:2222",
+    ]
+    assert cfg["cluster"]["ps"] == ["mnist-ps-0.default.svc:2222"]
+
+
+def test_tf_config_custom_cluster_domain(monkeypatch):
+    monkeypatch.setenv("CUSTOM_CLUSTER_DOMAIN", "cluster.local")
+    job = testutil.new_tfjob(name="mnist", worker=1, ps=1)
+    tfapi.set_defaults(job)
+    cfg = json.loads(gen_tf_config(job, "Worker", 0))
+    assert cfg["cluster"]["worker"] == ["mnist-worker-0.default.svc.cluster.local:2222"]
+
+
+def test_sparse_cluster_spec():
+    """reference tensorflow_test.go:23 conversion semantics."""
+    cluster = {
+        "worker": ["w0:2222", "w1:2222", "w2:2222"],
+        "ps": ["p0:2222", "p1:2222"],
+    }
+    s = sparse_cluster_spec(cluster, "worker", 1)
+    assert s["ps"] == ["p0:2222", "p1:2222"]
+    assert s["worker"] == {1: "w1:2222"}
+    s = sparse_cluster_spec(cluster, "ps", 1)
+    assert s["ps"] == ["p1:2222"]
+    assert s["worker"] == {}
+
+
+def test_tf_dynamic_worker_sparse_config():
+    job = testutil.new_tfjob(name="mnist", worker=3, ps=1)
+    job.enable_dynamic_worker = True
+    tfapi.set_defaults(job)
+    cfg = json.loads(gen_tf_config(job, "Worker", 2))
+    assert "sparseCluster" in cfg
+    assert list(cfg["sparseCluster"]["worker"].keys()) == ["2"]
+    assert len(cfg["sparseCluster"]["ps"]) == 1
+
+
+def test_tf_no_config_for_local_job():
+    """Single-replica jobs get no TF_CONFIG (reference tfjob_controller.go:547)."""
+    job = testutil.new_tfjob(worker=1)
+    tfapi.set_defaults(job)
+    template = job.replica_specs["Worker"].template
+    TFAdapter().set_cluster_spec(job, template, "Worker", 0)
+    assert "TF_CONFIG" not in env_of(template, "tensorflow")
+
+
+def _pt_job(master=1, worker=2):
+    specs = {}
+    template = {
+        "spec": {"containers": [{"name": "pytorch", "image": testutil.TEST_IMAGE}]}
+    }
+    import copy
+
+    if master:
+        specs[ptapi.REPLICA_MASTER] = common.ReplicaSpec(
+            replicas=master, template=copy.deepcopy(template)
+        )
+    if worker:
+        specs[ptapi.REPLICA_WORKER] = common.ReplicaSpec(
+            replicas=worker, template=copy.deepcopy(template)
+        )
+    job = ptapi.PyTorchJob(
+        metadata=objects.make_meta("torch", "default"), replica_specs=specs
+    )
+    ptapi.set_defaults(job)
+    return job
+
+
+def test_pytorch_env_master():
+    job = _pt_job()
+    template = job.replica_specs["Master"].template
+    PyTorchAdapter().set_cluster_spec(job, template, "Master", 0)
+    env = env_of(template, "pytorch")
+    assert env["MASTER_ADDR"] == "localhost"
+    assert env["MASTER_PORT"] == "23456"
+    assert env["WORLD_SIZE"] == "3"
+    assert env["RANK"] == "0"
+    assert env["PYTHONUNBUFFERED"] == "0"
+
+
+def test_pytorch_env_worker_rank_offset():
+    """reference pytorch.go:32-39: worker rank = index + 1."""
+    job = _pt_job()
+    template = job.replica_specs["Worker"].template
+    PyTorchAdapter().set_cluster_spec(job, template, "Worker", 1)
+    env = env_of(template, "pytorch")
+    assert env["MASTER_ADDR"] == "torch-master-0"
+    assert env["RANK"] == "2"
+
+
+def test_mxnet_env():
+    specs = {}
+    import copy
+
+    template = {
+        "spec": {"containers": [{"name": "mxnet", "image": testutil.TEST_IMAGE}]}
+    }
+    for rt, n in (("Scheduler", 1), ("Server", 2), ("Worker", 2)):
+        specs[rt] = common.ReplicaSpec(replicas=n, template=copy.deepcopy(template))
+    job = mxapi.MXJob(metadata=objects.make_meta("mx", "default"), replica_specs=specs)
+    mxapi.set_defaults(job)
+    template = job.replica_specs["Worker"].template
+    MXNetAdapter().set_cluster_spec(job, template, "Worker", 1)
+    env = env_of(template, "mxnet")
+    assert env["DMLC_PS_ROOT_URI"] == "mx-scheduler-0"
+    assert env["DMLC_PS_ROOT_PORT"] == "9091"
+    assert env["DMLC_NUM_SERVER"] == "2"
+    assert env["DMLC_NUM_WORKER"] == "2"
+    assert env["DMLC_ROLE"] == "worker"
+    assert env["DMLC_USE_KUBERNETES"] == "1"
+    assert env["DMLC_WORKER_ID"] == "1"  # BytePS
+    cfg = json.loads(env["MX_CONFIG"])
+    assert cfg["task"] == {"type": "worker", "index": 1}
+    assert cfg["cluster"]["scheduler"] == [{"url": "mx-scheduler-0", "port": 9091}]
+
+
+def test_xgboost_env():
+    import copy
+
+    template = {
+        "spec": {"containers": [{"name": "xgboost", "image": testutil.TEST_IMAGE}]}
+    }
+    job = xgbapi.XGBoostJob(
+        metadata=objects.make_meta("xgb", "default"),
+        replica_specs={
+            "Master": common.ReplicaSpec(replicas=1, template=copy.deepcopy(template)),
+            "Worker": common.ReplicaSpec(replicas=2, template=copy.deepcopy(template)),
+        },
+    )
+    xgbapi.set_defaults(job)
+    template = job.replica_specs["Worker"].template
+    XGBoostAdapter().set_cluster_spec(job, template, "Worker", 0)
+    env = env_of(template, "xgboost")
+    assert env["MASTER_ADDR"] == "xgb-master-0"
+    assert env["MASTER_PORT"] == "9999"
+    assert env["WORLD_SIZE"] == "3"
+    assert env["RANK"] == "1"  # worker-0 offset by 1 master
+    assert env["WORKER_PORT"] == "9999"
+    assert env["WORKER_ADDRS"] == "xgb-worker-0,xgb-worker-1"
+
+
+def test_tpu_env_single_slice():
+    job = testutil.new_tpujob(name="bert", accelerator_type="v4-32")
+    tpuapi.set_defaults(job)  # 16 chips = 4 hosts
+    template = job.replica_specs["Worker"].template
+    TPUAdapter().set_cluster_spec(job, template, "Worker", 3)
+    env = env_of(template, "tpu")
+    assert env["COORDINATOR_ADDRESS"] == "bert-worker-0.default.svc:8476"
+    assert env["NUM_PROCESSES"] == "4"
+    assert env["PROCESS_ID"] == "3"
+    assert env["TPU_WORKER_ID"] == "3"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v4-32"
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+    assert "MEGASCALE_NUM_SLICES" not in env
+
+
+def test_tpu_env_multislice():
+    job = testutil.new_tpujob(name="t5", accelerator_type="v4-16", num_slices=2)
+    tpuapi.set_defaults(job)  # 2 hosts/slice x 2 = 4 replicas
+    template = job.replica_specs["Worker"].template
+    # replica index 3 = slice 1, host 1
+    TPUAdapter().set_cluster_spec(job, template, "Worker", 3)
+    env = env_of(template, "tpu")
+    assert env["TPU_SLICE_ID"] == "1"
+    assert env["PROCESS_ID"] == "1"
+    assert env["NUM_PROCESSES"] == "2"
+    assert env["COORDINATOR_ADDRESS"] == "t5-worker-2.default.svc:8476"
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "t5-worker-0.default.svc:8476"
+    hostnames = env["TPU_WORKER_HOSTNAMES"].split(",")
+    assert hostnames[0] == "t5-worker-2.default.svc"
+    assert len(hostnames) == 2

@@ -1,0 +1,170 @@
+"""Full reconcile loops for the non-TF frameworks + TPU slice semantics."""
+import copy
+
+import pytest
+
+from tf_operator_tpu.api import common, mxnet as mxapi, pytorch as ptapi
+from tf_operator_tpu.api import tpujob as tpuapi, xgboost as xgbapi
+from tf_operator_tpu.controllers import make_engine
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+from tests import testutil
+from tests.test_engine import reconcile, run_pods, set_phase
+
+
+def _template(container):
+    return {
+        "spec": {"containers": [{"name": container, "image": testutil.TEST_IMAGE}]}
+    }
+
+
+def make_pt_job(name="torch", master=1, worker=2):
+    specs = {}
+    if master:
+        specs["Master"] = common.ReplicaSpec(
+            replicas=master, template=copy.deepcopy(_template("pytorch"))
+        )
+    if worker:
+        specs["Worker"] = common.ReplicaSpec(
+            replicas=worker, template=copy.deepcopy(_template("pytorch"))
+        )
+    return ptapi.PyTorchJob(
+        metadata=objects.make_meta(name) | {"uid": objects.new_uid()},
+        replica_specs=specs,
+    )
+
+
+def test_pytorch_full_lifecycle():
+    cluster = FakeCluster()
+    engine = make_engine("PyTorchJob", cluster)
+    job = make_pt_job()
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 3
+    assert len(cluster.list_services()) == 3
+
+    master = run_pods(cluster, rtype="Master")[0]
+    env = {
+        e["name"]: e["value"]
+        for e in master["spec"]["containers"][0].get("env", [])
+    }
+    assert env["MASTER_ADDR"] == "localhost"
+    assert env["WORLD_SIZE"] == "3"
+
+    for p in cluster.list_pods():
+        set_phase(cluster, p, objects.POD_RUNNING, container="pytorch")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_running(job.status)
+
+    # master completes -> job succeeds even with workers running
+    set_phase(cluster, master, objects.POD_SUCCEEDED, exit_code=0, container="pytorch")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+
+
+def test_xgboost_master_failure_fails_job():
+    cluster = FakeCluster()
+    engine = make_engine("XGBoostJob", cluster)
+    job = xgbapi.XGBoostJob(
+        metadata=objects.make_meta("xgb") | {"uid": objects.new_uid()},
+        replica_specs={
+            "Master": common.ReplicaSpec(
+                replicas=1, template=copy.deepcopy(_template("xgboost"))
+            ),
+            "Worker": common.ReplicaSpec(
+                replicas=1, template=copy.deepcopy(_template("xgboost"))
+            ),
+        },
+    )
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    master = run_pods(cluster, rtype="Master")[0]
+    set_phase(cluster, master, objects.POD_FAILED, exit_code=1, container="xgboost")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_failed(job.status)
+
+
+def test_mxnet_scheduler_completion_succeeds_job():
+    cluster = FakeCluster()
+    engine = make_engine("MXJob", cluster)
+    job = mxapi.MXJob(
+        metadata=objects.make_meta("mx") | {"uid": objects.new_uid()},
+        replica_specs={
+            "Scheduler": common.ReplicaSpec(
+                replicas=1, template=copy.deepcopy(_template("mxnet"))
+            ),
+            "Server": common.ReplicaSpec(
+                replicas=1, template=copy.deepcopy(_template("mxnet"))
+            ),
+            "Worker": common.ReplicaSpec(
+                replicas=2, template=copy.deepcopy(_template("mxnet"))
+            ),
+        },
+    )
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 4
+    sched = run_pods(cluster, rtype="Scheduler")[0]
+    set_phase(cluster, sched, objects.POD_SUCCEEDED, exit_code=0, container="mxnet")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+
+
+def test_tpujob_full_lifecycle_with_gang():
+    from tf_operator_tpu.engine.controller import EngineConfig
+
+    cluster = FakeCluster()
+    engine = make_engine(
+        "TPUJob", cluster, config=EngineConfig(enable_gang_scheduling=True)
+    )
+    job = testutil.new_tpujob(name="bert", accelerator_type="v4-32")
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    pods = cluster.list_pods()
+    assert len(pods) == 4  # v4-32 = 16 chips = 4 hosts
+    pg = cluster.get("PodGroup", "default", "bert")
+    assert pg["spec"]["minMember"] == 4  # gang-atomic slice
+
+    for p in pods:
+        set_phase(cluster, p, objects.POD_RUNNING, container="tpu")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_running(job.status)
+
+    for p in cluster.list_pods():
+        set_phase(cluster, p, objects.POD_SUCCEEDED, exit_code=0, container="tpu")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+
+
+def test_tpujob_preemption_restarts_whole_slice():
+    """One host preempted (SIGKILL=137, retryable) -> ALL 8 host pods torn
+    down for atomic recreation; job is Restarting, not Failed."""
+    cluster = FakeCluster()
+    engine = make_engine("TPUJob", cluster)
+    job = testutil.new_tpujob(name="bert", accelerator_type="v4-32")
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    for p in pods:
+        set_phase(cluster, p, objects.POD_RUNNING, container="tpu")
+    set_phase(cluster, pods[3], objects.POD_FAILED, exit_code=137, container="tpu")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.has_condition(job.status, common.JOB_RESTARTING)
+    assert not common.is_failed(job.status)
+    assert len(cluster.list_pods()) == 0  # whole slice torn down
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 4  # recreated atomically
+
+
+def test_tpujob_user_error_fails_job():
+    cluster = FakeCluster()
+    engine = make_engine("TPUJob", cluster)
+    job = testutil.new_tpujob(name="bert", accelerator_type="v4-8")
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    set_phase(cluster, pods[0], objects.POD_FAILED, exit_code=1, container="tpu")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_failed(job.status)
+    assert not common.has_condition(job.status, common.JOB_RESTARTING)

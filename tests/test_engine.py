@@ -1,0 +1,410 @@
+"""Engine reconcile tests — parity with reference pkg/controller.v1/tensorflow
+{controller_test.go TestNormalPath:68, pod_test.go, job_test.go} run against
+FakeCluster instead of injected informer indexers."""
+import pytest
+
+from tf_operator_tpu.api import common, tensorflow as tfapi
+from tf_operator_tpu.controllers import make_engine
+from tf_operator_tpu.engine.controller import EngineConfig
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+from tests import testutil
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def setup_engine(kind="TFJob", config=None, clock=None):
+    cluster = FakeCluster()
+    engine = make_engine(kind, cluster, config=config, clock=clock or Clock())
+    return cluster, engine
+
+
+def submit(cluster, engine, job):
+    cluster.create(job.kind, job.to_dict())
+    return job
+
+
+def reconcile(cluster, engine, job):
+    # re-fetch like a real controller would
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, job.namespace, job.name)
+    )
+    result = engine.reconcile(fresh)
+    return fresh, result
+
+
+def run_pods(cluster, selector=None, rtype=None):
+    pods = cluster.list_pods(selector=selector)
+    if rtype:
+        pods = [
+            p
+            for p in pods
+            if objects.labels_of(p).get(objects.LABEL_REPLICA_TYPE) == rtype.lower()
+        ]
+    return sorted(pods, key=lambda p: objects.name_of(p))
+
+
+def set_phase(cluster, pod, phase, exit_code=None, container="tensorflow"):
+    pod = cluster.get_pod(objects.namespace_of(pod), objects.name_of(pod))
+    pod["status"]["phase"] = phase
+    if exit_code is not None:
+        pod["status"]["containerStatuses"] = [
+            {"name": container, "state": {"terminated": {"exitCode": exit_code}}}
+        ]
+    cluster.update_pod(pod)
+
+
+# ---------------------------------------------------------------------------
+# normal path (reference TestNormalPath controller_test.go:68)
+# ---------------------------------------------------------------------------
+
+
+def test_creates_pods_and_services():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=4, ps=2))
+    job, _ = reconcile(cluster, engine, job)
+
+    pods = cluster.list_pods()
+    svcs = cluster.list_services()
+    assert len(pods) == 6
+    assert len(svcs) == 6
+    names = sorted(objects.name_of(p) for p in pods)
+    assert names == sorted(
+        [f"test-tfjob-worker-{i}" for i in range(4)]
+        + [f"test-tfjob-ps-{i}" for i in range(2)]
+    )
+    # conditions: Created; no Running yet (pods pending)
+    assert common.has_condition(job.status, common.JOB_CREATED)
+    assert not common.is_finished(job.status)
+
+
+def test_pod_labels_and_owner_refs():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    job, _ = reconcile(cluster, engine, job)
+    pod = cluster.list_pods()[0]
+    labels = objects.labels_of(pod)
+    assert labels[objects.LABEL_GROUP_NAME] == "kubeflow.org"
+    assert labels[objects.LABEL_JOB_NAME] == "test-tfjob"
+    assert labels[objects.LABEL_REPLICA_TYPE] == "worker"
+    assert labels[objects.LABEL_REPLICA_INDEX] == "0"
+    assert labels[objects.LABEL_JOB_ROLE] == "master"  # worker-0, no chief
+    ref = objects.get_controller_of(pod)
+    assert ref["kind"] == "TFJob" and ref["name"] == "test-tfjob"
+
+
+def test_running_condition_when_pods_run():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    for p in cluster.list_pods():
+        set_phase(cluster, p, objects.POD_RUNNING)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_running(job.status)
+    assert job.status.replica_statuses["Worker"].active == 2
+
+
+def test_worker0_success_rule():
+    """Default success policy: worker-0 Succeeded completes the job
+    (reference pod_test.go:687, status.go:150-181)."""
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    set_phase(cluster, pods[0], objects.POD_SUCCEEDED, exit_code=0)  # worker-0
+    set_phase(cluster, pods[1], objects.POD_RUNNING)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+    assert job.status.completion_time is not None
+
+
+def test_all_workers_success_policy():
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=2)
+    job.success_policy = tfapi.SUCCESS_POLICY_ALL_WORKERS
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    set_phase(cluster, pods[0], objects.POD_SUCCEEDED, exit_code=0)
+    set_phase(cluster, pods[1], objects.POD_RUNNING)
+    job, _ = reconcile(cluster, engine, job)
+    assert not common.is_succeeded(job.status)  # worker-1 still running
+    set_phase(cluster, pods[1], objects.POD_SUCCEEDED, exit_code=0)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+
+
+def test_chief_success_rule():
+    """With a chief, only chief completion matters (reference status.go:120-150)."""
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2, chief=1))
+    job, _ = reconcile(cluster, engine, job)
+    chief = run_pods(cluster, rtype="Chief")[0]
+    workers = run_pods(cluster, rtype="Worker")
+    set_phase(cluster, workers[0], objects.POD_RUNNING)
+    set_phase(cluster, workers[1], objects.POD_RUNNING)
+    set_phase(cluster, chief, objects.POD_SUCCEEDED, exit_code=0)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+
+
+def test_failed_pod_fails_job():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    set_phase(cluster, pods[1], objects.POD_FAILED, exit_code=1)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_failed(job.status)
+
+
+# ---------------------------------------------------------------------------
+# exit-code restart (reference pod_test.go:442)
+# ---------------------------------------------------------------------------
+
+
+def test_exit_code_restart_retryable():
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=2)
+    for spec in job.replica_specs.values():
+        spec.restart_policy = common.RESTART_POLICY_EXIT_CODE
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    set_phase(cluster, pods[1], objects.POD_FAILED, exit_code=130)  # retryable
+    job, _ = reconcile(cluster, engine, job)
+    # pod deleted for recreation; Restarting condition set; not failed
+    assert common.has_condition(job.status, common.JOB_RESTARTING)
+    assert not common.is_failed(job.status)
+    assert len(cluster.list_pods()) == 1
+    # next reconcile recreates it
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 2
+
+
+def test_exit_code_restart_permanent():
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=2)
+    for spec in job.replica_specs.values():
+        spec.restart_policy = common.RESTART_POLICY_EXIT_CODE
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    set_phase(cluster, pods[1], objects.POD_FAILED, exit_code=1)  # permanent
+    job, _ = reconcile(cluster, engine, job)
+    assert not common.has_condition(job.status, common.JOB_RESTARTING)
+    assert common.is_failed(job.status)
+    assert len(cluster.list_pods()) == 2  # not deleted mid-flight
+
+
+def test_exit_code_pod_restart_policy_forced_never():
+    """reference setRestartPolicy pod.go:321-328."""
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=1)
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_EXIT_CODE
+    submit(cluster, engine, job)
+    reconcile(cluster, engine, job)
+    pod = cluster.list_pods()[0]
+    assert pod["spec"]["restartPolicy"] == "Never"
+
+
+# ---------------------------------------------------------------------------
+# dynamic scale (reference pod_test.go:530 scale down, :614 scale up)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_down_deletes_out_of_range():
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=3)
+    job.enable_dynamic_worker = True
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 3
+    # scale down to 1
+    stored = cluster.get("TFJob", job.namespace, job.name)
+    stored["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = 1
+    cluster.update("TFJob", stored)
+    job, _ = reconcile(cluster, engine, job)
+    pods = cluster.list_pods()
+    assert len(pods) == 1
+    assert objects.labels_of(pods[0])[objects.LABEL_REPLICA_INDEX] == "0"
+
+
+def test_scale_up_creates_missing():
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=1)
+    job.enable_dynamic_worker = True
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    stored = cluster.get("TFJob", job.namespace, job.name)
+    stored["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = 3
+    cluster.update("TFJob", stored)
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 3
+
+
+# ---------------------------------------------------------------------------
+# run policy (reference job_test.go TestDeletePodsAndServices:191,
+# TestActiveDeadlineSeconds:549, TestBackoffForOnFailure:691)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "policy,remaining_pods",
+    [
+        (common.CLEAN_POD_POLICY_ALL, 0),
+        (common.CLEAN_POD_POLICY_RUNNING, 1),  # only the running one deleted
+        (common.CLEAN_POD_POLICY_NONE, 2),
+    ],
+)
+def test_clean_pod_policy(policy, remaining_pods):
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=2)
+    job.run_policy.clean_pod_policy = policy
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    set_phase(cluster, pods[0], objects.POD_SUCCEEDED, exit_code=0)  # worker-0
+    set_phase(cluster, pods[1], objects.POD_RUNNING)
+    job, _ = reconcile(cluster, engine, job)  # job succeeds
+    assert common.is_succeeded(job.status)
+    job, _ = reconcile(cluster, engine, job)  # terminal pass cleans pods
+    assert len(cluster.list_pods()) == remaining_pods
+
+
+def test_active_deadline_fails_job():
+    clock = Clock()
+    cluster, engine = setup_engine(clock=clock)
+    job = testutil.new_tfjob(worker=1)
+    job.run_policy.active_deadline_seconds = 60
+    submit(cluster, engine, job)
+    job, result = reconcile(cluster, engine, job)
+    assert result.requeue_after is not None and result.requeue_after <= 60
+    clock.advance(61)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_failed(job.status)
+    cond = common.get_condition(job.status, common.JOB_FAILED)
+    assert "deadline" in cond.message.lower()
+    # pods force-cleaned
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 0
+
+
+def test_backoff_limit_on_failure():
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=1)
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_ON_FAILURE
+    job.run_policy.backoff_limit = 2
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    pod = cluster.list_pods()[0]
+    pod["status"]["phase"] = objects.POD_RUNNING
+    pod["status"]["containerStatuses"] = [
+        {"name": "tensorflow", "restartCount": 3}
+    ]
+    cluster.update_pod(pod)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_failed(job.status)
+    cond = common.get_condition(job.status, common.JOB_FAILED)
+    assert "backoff" in cond.message.lower()
+
+
+def test_ttl_deletes_job():
+    clock = Clock()
+    cluster, engine = setup_engine(clock=clock)
+    job = testutil.new_tfjob(worker=1)
+    job.run_policy.ttl_seconds_after_finished = 100
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    set_phase(cluster, cluster.list_pods()[0], objects.POD_SUCCEEDED, exit_code=0)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+    job, result = reconcile(cluster, engine, job)  # terminal pass: requeue for TTL
+    assert result.requeue_after is not None and 0 < result.requeue_after <= 100
+    clock.advance(101)
+    job, _ = reconcile(cluster, engine, job)
+    with pytest.raises(Exception):
+        cluster.get("TFJob", "default", "test-tfjob")
+
+
+def test_invalid_job_gets_failed_condition_no_pods():
+    """reference e2e invalid_tfjob_tests.py: invalid spec -> Failed, no pods."""
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=1)
+    job.replica_specs["Worker"].template["spec"]["containers"][0].pop("image")
+    submit(cluster, engine, job)
+    job, result = reconcile(cluster, engine, job)
+    assert result.error is not None
+    stored = cluster.get("TFJob", "default", "test-tfjob")
+    conds = stored["status"]["conditions"]
+    assert any(c["type"] == "Failed" and c["status"] == "True" for c in conds)
+    assert len(cluster.list_pods()) == 0
+
+
+# ---------------------------------------------------------------------------
+# expectations (reference pod_test.go:109,168)
+# ---------------------------------------------------------------------------
+
+
+def test_expectations_prevent_double_creation():
+    from tf_operator_tpu.engine.expectations import gen_expectation_pods_key
+
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    # simulate pending expectation (issued create not yet observed)
+    engine.expectations.expect_creations(
+        gen_expectation_pods_key(job.key, "Worker"), 1
+    )
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 0  # gated
+    engine.expectations.creation_observed(
+        gen_expectation_pods_key(job.key, "Worker")
+    )
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 1
+
+
+def test_status_written_to_cluster():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    reconcile(cluster, engine, job)
+    stored = cluster.get("TFJob", "default", "test-tfjob")
+    assert stored["status"]["conditions"]
+    assert stored["status"]["replicaStatuses"]["Worker"] is not None
+
+
+# ---------------------------------------------------------------------------
+# gang scheduling
+# ---------------------------------------------------------------------------
+
+
+def test_gang_scheduling_podgroup_and_annotations():
+    cluster, engine = setup_engine(
+        config=EngineConfig(enable_gang_scheduling=True)
+    )
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    pg = cluster.get("PodGroup", "default", "test-tfjob")
+    assert pg["spec"]["minMember"] == 2
+    pod = cluster.list_pods()[0]
+    ann = pod["metadata"]["annotations"]
+    assert ann["scheduling.k8s.io/group-name"] == "test-tfjob"
+    assert ann["volcano.sh/task-spec"] == "worker"
+    assert pod["spec"]["schedulerName"] == "volcano"
+    # terminal: podgroup removed
+    for p in cluster.list_pods():
+        set_phase(cluster, p, objects.POD_SUCCEEDED, exit_code=0)
+    job, _ = reconcile(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    with pytest.raises(Exception):
+        cluster.get("PodGroup", "default", "test-tfjob")

@@ -278,9 +278,14 @@ def update_job_conditions(
     )
     existing = get_condition(status, cond_type)
     if existing is not None:
-        if existing.reason == reason and existing.message == message:
+        if (
+            existing.reason == reason
+            and existing.message == message
+            and existing.status == "True"
+        ):
             existing.last_update_time = now
             return
+        existing.status = "True"  # re-promote a previously demoted condition
         existing.reason = reason
         existing.message = message
         existing.last_update_time = now

@@ -66,9 +66,12 @@ class Job:
         pass
 
     def to_dict(self) -> Dict[str, Any]:
+        # replica_specs may be None (spec missing its replica map); serialize
+        # as {} so status write-backs of invalid jobs don't crash — the None
+        # sentinel is preserved in memory for validate() to reject.
         spec: Dict[str, Any] = {
             self.replica_specs_key(): {
-                rt: rs.to_dict() for rt, rs in self.replica_specs.items()
+                rt: rs.to_dict() for rt, rs in (self.replica_specs or {}).items()
             },
         }
         run_policy = self.run_policy.to_dict()
@@ -116,6 +119,8 @@ def set_type_names_to_camel_case(job: Job, canonical_types: List[str]) -> None:
     if not job.replica_specs:
         return
     for canon in canonical_types:
+        if canon in job.replica_specs:
+            continue  # never overwrite an existing canonical entry
         for existing in list(job.replica_specs.keys()):
             if existing.lower() == canon.lower() and existing != canon:
                 job.replica_specs[canon] = job.replica_specs.pop(existing)
@@ -139,10 +144,10 @@ def set_default_port(
     """Inject the default RPC port into the framework container if the named
     port is absent. Falls back to container index 0 when no container carries
     the framework name — same as reference defaults.go:38-60."""
-    containers = template.setdefault("spec", {}).setdefault("containers", [])
-    if not containers:
+    template.setdefault("spec", {}).setdefault("containers", [])
+    target = objects.default_container(template, container_name)
+    if target is None:
         return
-    target = objects.find_container(template, container_name) or containers[0]
     for p in target.get("ports", []) or []:
         if p.get("name") == port_name:
             return

@@ -51,27 +51,54 @@ CHIPS_PER_HOST = {
     "v6e": 8,
 }
 
+# For v2-v5p GCP numbers acceleratorType in TensorCores (2 per chip):
+# 'v4-32' = 32 cores = 16 chips = 4 hosts. v5e/v6e count chips directly.
+CORES_PER_CHIP = {
+    "v2": 2,
+    "v3": 2,
+    "v4": 2,
+    "v5p": 2,
+    "v5e": 1,
+    "v5litepod": 1,
+    "v6e": 1,
+}
+
 _ACCEL_RE = re.compile(r"^(v\d+(?:p|e|litepod)?)-(\d+)$")
 
 
 def parse_accelerator_type(accelerator_type: str) -> Tuple[str, int]:
-    """'v4-32' -> ('v4', 32 chips). Raises ValidationError on bad input."""
+    """'v4-32' -> ('v4', 16 chips): the numeric suffix is TensorCores for
+    v2-v5p and chips for v5e/v6e. Raises ValidationError on bad input."""
     m = _ACCEL_RE.match(accelerator_type or "")
     if not m:
         raise jobapi.ValidationError(
             f"{KIND}Spec is not valid: bad acceleratorType {accelerator_type!r} "
             f"(want e.g. 'v4-32')"
         )
-    gen, chips = m.group(1), int(m.group(2))
+    gen, count = m.group(1), int(m.group(2))
     if gen not in CHIPS_PER_HOST:
         raise jobapi.ValidationError(
             f"{KIND}Spec is not valid: unknown TPU generation {gen!r}"
         )
-    if chips <= 0:
+    if count <= 0:
         raise jobapi.ValidationError(
             f"{KIND}Spec is not valid: chip count must be positive"
         )
+    chips = max(1, count // CORES_PER_CHIP[gen])
     return gen, chips
+
+
+def parse_topology(topology: str) -> int:
+    """'2x2x4' -> 16 chips. Raises ValidationError on bad input."""
+    try:
+        dims = [int(d) for d in topology.lower().split("x")]
+    except ValueError:
+        dims = []
+    if not dims or any(d <= 0 for d in dims):
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: bad topology {topology!r} (want e.g. '2x2x4')"
+        )
+    return math.prod(dims)
 
 
 def slice_hosts(accelerator_type: str) -> int:
@@ -143,10 +170,7 @@ def set_defaults(job: TPUJob) -> None:
     if per_host is not None:
         from tf_operator_tpu.k8s import objects
 
-        containers = worker.template.get("spec", {}).get("containers", [])
-        target = objects.find_container(worker.template, DEFAULT_CONTAINER_NAME)
-        if target is None and containers:
-            target = containers[0]
+        target = objects.default_container(worker.template, DEFAULT_CONTAINER_NAME)
         if target is not None:
             res = target.setdefault("resources", {})
             for kind in ("requests", "limits"):
@@ -162,8 +186,13 @@ def validate(job: TPUJob) -> None:
     jobapi.validate_replica_specs(
         job, DEFAULT_CONTAINER_NAME, valid_types=REPLICA_TYPES, kind=KIND
     )
-    gen_chips = parse_accelerator_type(job.accelerator_type)  # raises if bad
-    del gen_chips
+    gen, chips = parse_accelerator_type(job.accelerator_type)  # raises if bad
+    if job.topology is not None and parse_topology(job.topology) != chips:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: topology {job.topology!r} "
+            f"({parse_topology(job.topology)} chips) does not match "
+            f"acceleratorType {job.accelerator_type!r} ({chips} chips)"
+        )
     if job.num_slices < 1:
         raise jobapi.ValidationError(
             f"{KIND}Spec is not valid: numSlices must be >= 1"

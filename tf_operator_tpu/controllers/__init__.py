@@ -1,0 +1,3 @@
+from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS, make_engine
+
+__all__ = ["SUPPORTED_ADAPTERS", "make_engine"]

@@ -1,0 +1,157 @@
+"""MXJob controller adapter — MX_CONFIG + DMLC_* env, scheduler rendezvous.
+
+Reference parity: pkg/controller.v1/mxnet/{mxnet.go,mxjob_controller.go}.
+Env (mxnet.go:55-120): MX_CONFIG JSON {cluster:{rt:[{url,port}]}, labels,
+task}, DMLC_PS_ROOT_URI/PORT from scheduler-0, DMLC_NUM_SERVER/WORKER,
+DMLC_ROLE, DMLC_USE_KUBERNETES, BytePS DMLC_WORKER_ID; tvm auto-tuning
+'tuner-server-key' annotation passthrough (mxnet.go:16-19).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api import mxnet as mxapi
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
+from tf_operator_tpu.engine.controller import (
+    JobEngine,
+    REASON_FAILED,
+    REASON_RESTARTING,
+    REASON_RUNNING,
+    REASON_SUCCEEDED,
+)
+from tf_operator_tpu.k8s import objects
+
+TUNER_SERVER_KEY = "tuner-server-key"
+ENV_MX_CONFIG = "MX_CONFIG"
+
+
+def get_port(job: mxapi.MXJob, rtype: str) -> int:
+    spec = (job.replica_specs or {}).get(rtype)
+    if spec is not None:
+        c = objects.find_container(spec.template, mxapi.DEFAULT_CONTAINER_NAME)
+        if c is not None:
+            p = objects.find_port(c, mxapi.DEFAULT_PORT_NAME)
+            if p:
+                return p
+    return mxapi.DEFAULT_PORT
+
+
+def gen_cluster_spec(job: mxapi.MXJob) -> Dict[str, List[Dict[str, Any]]]:
+    """{rt: [{url, port}...]} — url is the bare service name (same-namespace
+    DNS), reference genClusterSpec (mxnet.go:122-175)."""
+    cluster: Dict[str, List[Dict[str, Any]]] = {}
+    for rtype, spec in (job.replica_specs or {}).items():
+        rt = rtype.lower()
+        port = get_port(job, rtype)
+        cluster[rt] = [
+            {"url": JobEngine.gen_general_name(job.name, rtype, i), "port": port}
+            for i in range(spec.replicas or 0)
+        ]
+    return cluster
+
+
+def gen_labels_spec(job: mxapi.MXJob) -> Dict[str, str]:
+    return {
+        rtype.lower(): (spec.template.get("metadata", {}).get("annotations", {}) or {}).get(
+            TUNER_SERVER_KEY, ""
+        )
+        for rtype, spec in (job.replica_specs or {}).items()
+    }
+
+
+class MXNetAdapter(FrameworkAdapter):
+    KIND = mxapi.KIND
+    PLURAL = mxapi.PLURAL
+    REPLICA_TYPES = mxapi.REPLICA_TYPES
+    CONTAINER_NAME = mxapi.DEFAULT_CONTAINER_NAME
+    PORT_NAME = mxapi.DEFAULT_PORT_NAME
+    DEFAULT_PORT = mxapi.DEFAULT_PORT
+
+    def from_dict(self, d: Dict[str, Any]) -> mxapi.MXJob:
+        return mxapi.MXJob.from_dict(d)
+
+    def set_defaults(self, job: mxapi.MXJob) -> None:
+        mxapi.set_defaults(job)
+
+    def validate(self, job: mxapi.MXJob) -> None:
+        mxapi.validate(job)
+
+    def set_cluster_spec(
+        self, job: mxapi.MXJob, pod_template: Dict[str, Any], rtype: str, index: int
+    ) -> None:
+        rt = rtype.lower()
+        cluster = gen_cluster_spec(job)
+        mx_config = {
+            "cluster": cluster,
+            "labels": gen_labels_spec(job),
+            "task": {"type": rt, "index": index},
+        }
+        scheduler = (cluster.get("scheduler") or [{"url": "", "port": 0}])[0]
+        env = {
+            ENV_MX_CONFIG: json.dumps(mx_config),
+            "DMLC_PS_ROOT_PORT": str(scheduler["port"]),
+            "DMLC_PS_ROOT_URI": scheduler["url"],
+            "DMLC_NUM_SERVER": str(len(cluster.get("server", []))),
+            "DMLC_NUM_WORKER": str(len(cluster.get("worker", []))),
+            "DMLC_ROLE": rt,
+            "DMLC_USE_KUBERNETES": "1",
+        }
+        for c in pod_template.get("spec", {}).get("containers", []) or []:
+            for k, v in env.items():
+                objects.set_env(c, k, v)
+            if rt == mxapi.REPLICA_WORKER.lower():
+                objects.set_env(c, "DMLC_WORKER_ID", str(index))  # BytePS
+
+    def is_master_role(
+        self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
+    ) -> bool:
+        return mxapi.is_scheduler(rtype)
+
+    def update_job_status(self, engine, job, ctx: StatusContext) -> None:
+        """reference mxjob_controller.go:328-412: Running while any replica
+        runs; Succeeded when any replica type fully completes; ExitCode
+        failures restart, others fail."""
+        status = ctx.status
+        for rtype in sorted(ctx.replicas):
+            spec = ctx.replicas[rtype]
+            expected, running, succeeded, failed = ctx.counts(rtype)
+            if running > 0:
+                common.update_job_conditions(
+                    status, common.JOB_RUNNING, REASON_RUNNING,
+                    f"MXJob {job.name} is running.", ctx.now,
+                )
+            if expected == 0:
+                msg = f"MXJob {job.name} is successfully completed."
+                ctx.record_event("Normal", REASON_SUCCEEDED, msg)
+                if status.completion_time is None:
+                    status.completion_time = ctx.now
+                common.update_job_conditions(
+                    status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
+                )
+                metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+            if failed > 0:
+                if spec.restart_policy == common.RESTART_POLICY_EXIT_CODE:
+                    msg = (
+                        f"MXJob {job.name} is restarting because {failed} "
+                        f"{rtype} replica(s) failed."
+                    )
+                    ctx.record_event("Warning", REASON_RESTARTING, msg)
+                    common.update_job_conditions(
+                        status, common.JOB_RESTARTING, REASON_RESTARTING, msg, ctx.now
+                    )
+                    metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
+                else:
+                    msg = (
+                        f"MXJob {job.name} is failed because {failed} "
+                        f"{rtype} replica(s) failed."
+                    )
+                    ctx.record_event("Normal", REASON_FAILED, msg)
+                    if status.completion_time is None:
+                        status.completion_time = ctx.now
+                    common.update_job_conditions(
+                        status, common.JOB_FAILED, REASON_FAILED, msg, ctx.now
+                    )
+                    metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})

@@ -1,0 +1,88 @@
+"""PyTorchJob controller adapter — MASTER_ADDR/RANK env + master-gated status.
+
+Reference parity: pkg/controller.v1/pytorch/{pytorch.go,pytorchjob_controller.go}.
+Env injection SetPodEnv (pytorch.go:13-68): MASTER_ADDR is the master-0
+service name ('localhost' on the master itself), RANK is worker index+1,
+WORLD_SIZE is the replica sum — applied to ALL containers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api import pytorch as ptapi
+from tf_operator_tpu.api.job import ValidationError
+from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
+from tf_operator_tpu.engine.controller import JobEngine
+from tf_operator_tpu.controllers.shared_status import master_based_update_job_status
+from tf_operator_tpu.k8s import objects
+
+
+def total_replicas(job: ptapi.PyTorchJob) -> int:
+    return sum(s.replicas or 0 for s in (job.replica_specs or {}).values())
+
+
+def master_port(job: ptapi.PyTorchJob) -> int:
+    spec = (job.replica_specs or {}).get(ptapi.REPLICA_MASTER)
+    if spec is not None:
+        c = objects.find_container(spec.template, ptapi.DEFAULT_CONTAINER_NAME)
+        if c is not None:
+            p = objects.find_port(c, ptapi.DEFAULT_PORT_NAME)
+            if p:
+                return p
+    return ptapi.DEFAULT_PORT
+
+
+class PyTorchAdapter(FrameworkAdapter):
+    KIND = ptapi.KIND
+    PLURAL = ptapi.PLURAL
+    REPLICA_TYPES = ptapi.REPLICA_TYPES
+    CONTAINER_NAME = ptapi.DEFAULT_CONTAINER_NAME
+    PORT_NAME = ptapi.DEFAULT_PORT_NAME
+    DEFAULT_PORT = ptapi.DEFAULT_PORT
+
+    def from_dict(self, d: Dict[str, Any]) -> ptapi.PyTorchJob:
+        return ptapi.PyTorchJob.from_dict(d)
+
+    def set_defaults(self, job: ptapi.PyTorchJob) -> None:
+        ptapi.set_defaults(job)
+
+    def validate(self, job: ptapi.PyTorchJob) -> None:
+        ptapi.validate(job)
+
+    def set_cluster_spec(
+        self, job: ptapi.PyTorchJob, pod_template: Dict[str, Any], rtype: str, index: int
+    ) -> None:
+        rank = index
+        addr = JobEngine.gen_general_name(job.name, ptapi.REPLICA_MASTER, 0)
+        if rtype == ptapi.REPLICA_MASTER:
+            if rank != 0:
+                raise ValidationError(
+                    "invalid config: There should be only a single master with index=0"
+                )
+            addr = "localhost"
+        else:
+            rank = rank + 1  # master offset (reference pytorch.go:32-39)
+        env = {
+            "MASTER_PORT": str(master_port(job)),
+            "MASTER_ADDR": addr,
+            "WORLD_SIZE": str(total_replicas(job)),
+            "RANK": str(rank),
+            "PYTHONUNBUFFERED": "0",
+        }
+        for c in pod_template.get("spec", {}).get("containers", []) or []:
+            for k, v in env.items():
+                objects.set_env(c, k, v)
+
+    def is_master_role(
+        self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
+    ) -> bool:
+        return rtype == ptapi.REPLICA_MASTER
+
+    def replica_order(self, replicas):
+        return [rt for rt in (ptapi.REPLICA_MASTER, ptapi.REPLICA_WORKER) if rt in replicas]
+
+    def update_job_status(self, engine, job, ctx: StatusContext) -> None:
+        master_based_update_job_status(
+            self.KIND, job, ctx, master_type=ptapi.REPLICA_MASTER
+        )

@@ -1,0 +1,58 @@
+"""Controller registry — Kind -> adapter, the '--enable-scheme' surface
+(reference register_controller.go:36-76: SupportedSchemeReconciler +
+EnabledSchemes)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from tf_operator_tpu.engine.adapter import FrameworkAdapter
+from tf_operator_tpu.engine.controller import EngineConfig, JobEngine
+from tf_operator_tpu.controllers.tensorflow import TFAdapter
+from tf_operator_tpu.controllers.pytorch import PyTorchAdapter
+from tf_operator_tpu.controllers.mxnet import MXNetAdapter
+from tf_operator_tpu.controllers.xgboost import XGBoostAdapter
+from tf_operator_tpu.controllers.tpu import TPUAdapter
+
+SUPPORTED_ADAPTERS: Dict[str, Type[FrameworkAdapter]] = {
+    TFAdapter.KIND: TFAdapter,
+    PyTorchAdapter.KIND: PyTorchAdapter,
+    MXNetAdapter.KIND: MXNetAdapter,
+    XGBoostAdapter.KIND: XGBoostAdapter,
+    TPUAdapter.KIND: TPUAdapter,
+}
+
+
+class EnabledSchemes:
+    """Validating multi-value flag type (reference register_controller.go:51-76)."""
+
+    def __init__(self, kinds: Optional[List[str]] = None) -> None:
+        self.kinds: List[str] = []
+        for k in kinds or []:
+            self.set(k)
+
+    def set(self, kind: str) -> None:
+        match = next(
+            (k for k in SUPPORTED_ADAPTERS if k.lower() == kind.lower()), None
+        )
+        if match is None:
+            raise ValueError(
+                f"kind {kind!r} is not supported; supported: "
+                f"{sorted(SUPPORTED_ADAPTERS)}"
+            )
+        if match not in self.kinds:
+            self.kinds.append(match)
+
+    def fill_all(self) -> None:
+        self.kinds = list(SUPPORTED_ADAPTERS)
+
+    def empty(self) -> bool:
+        return not self.kinds
+
+
+def make_engine(
+    kind: str, cluster, config: Optional[EngineConfig] = None, **kwargs
+) -> JobEngine:
+    adapter_cls = SUPPORTED_ADAPTERS.get(kind)
+    if adapter_cls is None:
+        raise ValueError(f"unsupported job kind {kind!r}")
+    return JobEngine(cluster, adapter_cls(), config=config, **kwargs)

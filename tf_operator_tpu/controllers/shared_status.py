@@ -1,0 +1,78 @@
+"""Master-gated status rules shared by PyTorchJob and XGBoostJob
+(reference pytorchjob_controller.go UpdateJobStatus and the near-identical
+xgboostjob_controller.go version): Running while the master runs, Succeeded
+when the master completes, ExitCode failures become Restarting, other
+failures Fail the job; a live job keeps a Running condition.
+"""
+from __future__ import annotations
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.adapter import StatusContext
+from tf_operator_tpu.engine.controller import (
+    REASON_FAILED,
+    REASON_RESTARTING,
+    REASON_RUNNING,
+    REASON_SUCCEEDED,
+)
+
+
+def master_based_update_job_status(
+    kind: str, job, ctx: StatusContext, master_type: str = "Master"
+) -> None:
+    status = ctx.status
+    for rtype in [master_type] + [rt for rt in ctx.replicas if rt != master_type]:
+        if rtype not in ctx.replicas:
+            continue
+        spec = ctx.replicas[rtype]
+        expected, running, succeeded, failed = ctx.counts(rtype)
+
+        if rtype == master_type:
+            if running > 0:
+                common.update_job_conditions(
+                    status, common.JOB_RUNNING, REASON_RUNNING,
+                    f"{kind} {job.name} is running.", ctx.now,
+                )
+            if expected == 0:
+                msg = f"{kind} {job.name} is successfully completed."
+                ctx.record_event("Normal", REASON_SUCCEEDED, msg)
+                if status.completion_time is None:
+                    status.completion_time = ctx.now
+                common.update_job_conditions(
+                    status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
+                )
+                metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+                return
+
+        if failed > 0:
+            if spec.restart_policy == common.RESTART_POLICY_EXIT_CODE:
+                msg = (
+                    f"{kind} {job.name} is restarting because {failed} "
+                    f"{rtype} replica(s) failed."
+                )
+                ctx.record_event("Warning", REASON_RESTARTING, msg)
+                common.update_job_conditions(
+                    status, common.JOB_RESTARTING, REASON_RESTARTING, msg, ctx.now
+                )
+                metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
+            else:
+                msg = (
+                    f"{kind} {job.name} is failed because {failed} "
+                    f"{rtype} replica(s) failed."
+                )
+                ctx.record_event("Normal", REASON_FAILED, msg)
+                if status.completion_time is None:
+                    status.completion_time = ctx.now
+                common.update_job_conditions(
+                    status, common.JOB_FAILED, REASON_FAILED, msg, ctx.now
+                )
+                metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})
+                return
+    # still alive: keep a Running condition (reference pytorchjob_controller.go tail)
+    if not common.is_finished(status) and not common.has_condition(
+        status, common.JOB_RESTARTING
+    ):
+        common.update_job_conditions(
+            status, common.JOB_RUNNING, REASON_RUNNING,
+            f"{kind} {job.name} is running.", ctx.now,
+        )

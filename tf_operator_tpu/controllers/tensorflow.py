@@ -1,0 +1,227 @@
+"""TFJob controller adapter — TF_CONFIG injection + TF status rules.
+
+Reference parity: pkg/controller.v1/tensorflow/{tensorflow.go,status.go,
+tfjob_controller.go}. The env-injection seam is SetClusterSpec
+(tfjob_controller.go:540-573); cluster-spec DNS form and sparse variant are
+tensorflow.go:97-173; status ordering and chief-vs-worker0 success rules are
+status.go:64-220.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api import tensorflow as tfapi
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
+from tf_operator_tpu.engine.controller import (
+    JobEngine,
+    REASON_FAILED,
+    REASON_RUNNING,
+    REASON_SUCCEEDED,
+)
+from tf_operator_tpu.k8s import objects
+
+ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
+ENV_TF_CONFIG = "TF_CONFIG"
+
+# status iteration order (reference status.go:95-101)
+STATUS_ORDER = [
+    tfapi.REPLICA_CHIEF,
+    tfapi.REPLICA_EVALUATOR,
+    tfapi.REPLICA_MASTER,
+    tfapi.REPLICA_PS,
+    tfapi.REPLICA_WORKER,
+]
+
+
+def replica_dns_name(
+    job_name: str, namespace: str, rtype: str, index: int, port: int
+) -> str:
+    """{job}-{rt}-{i}.{ns}.svc[.{domain}]:{port} (reference tensorflow.go:153-166)."""
+    host = f"{JobEngine.gen_general_name(job_name, rtype, index)}.{namespace}.svc"
+    domain = os.environ.get(ENV_CUSTOM_CLUSTER_DOMAIN, "")
+    if domain:
+        host += "." + domain
+    return f"{host}:{port}"
+
+
+def gen_cluster_spec(tfjob: tfapi.TFJob) -> Dict[str, List[str]]:
+    """reference genClusterSpec (tensorflow.go:142-173)."""
+    cluster: Dict[str, List[str]] = {}
+    port = tfapi.get_port(tfjob)
+    for rtype, spec in (tfjob.replica_specs or {}).items():
+        rt = rtype.lower()
+        cluster[rt] = [
+            replica_dns_name(tfjob.name, tfjob.namespace, rtype, i, port)
+            for i in range(spec.replicas or 0)
+        ]
+    return cluster
+
+
+def sparse_cluster_spec(
+    cluster: Dict[str, List[str]], rtype: str, index: int
+) -> Dict[str, Any]:
+    """Sparse variant for EnableDynamicWorker: a worker sees only itself plus
+    all PS; a PS sees only itself (reference
+    convertClusterSpecToSparseClusterSpec, tensorflow.go:64-83)."""
+    rt = rtype.lower()
+    sparse: Dict[str, Any] = {"worker": {}, "ps": []}
+    if rt == "ps":
+        sparse["ps"] = [cluster[rt][index]]
+    elif rt == "worker":
+        sparse["ps"] = cluster.get("ps", [])
+        sparse["worker"] = {index: cluster[rt][index]}
+    return sparse
+
+
+def gen_tf_config(tfjob: tfapi.TFJob, rtype: str, index: int) -> str:
+    """reference genTFConfigJSONStr (tensorflow.go:97-139)."""
+    cluster = gen_cluster_spec(tfjob)
+    task = {"type": rtype.lower(), "index": index}
+    if tfjob.enable_dynamic_worker:
+        payload: Dict[str, Any] = {
+            "sparseCluster": sparse_cluster_spec(cluster, rtype, index),
+            "task": task,
+        }
+    else:
+        payload = {"cluster": cluster, "task": task, "environment": "cloud"}
+    return json.dumps(payload)
+
+
+def is_distributed(tfjob: tfapi.TFJob) -> bool:
+    """>1 total replicas (reference pod.go:298-319)."""
+    total = 0
+    for spec in (tfjob.replica_specs or {}).values():
+        total += spec.replicas if spec.replicas is not None else 1
+    return total != 1
+
+
+class TFAdapter(FrameworkAdapter):
+    KIND = tfapi.KIND
+    PLURAL = tfapi.PLURAL
+    REPLICA_TYPES = tfapi.REPLICA_TYPES
+    CONTAINER_NAME = tfapi.DEFAULT_CONTAINER_NAME
+    PORT_NAME = tfapi.DEFAULT_PORT_NAME
+    DEFAULT_PORT = tfapi.DEFAULT_PORT
+
+    def from_dict(self, d: Dict[str, Any]) -> tfapi.TFJob:
+        return tfapi.TFJob.from_dict(d)
+
+    def set_defaults(self, job: tfapi.TFJob) -> None:
+        tfapi.set_defaults(job)
+
+    def validate(self, job: tfapi.TFJob) -> None:
+        tfapi.validate(job)
+
+    def set_cluster_spec(
+        self, job: tfapi.TFJob, pod_template: Dict[str, Any], rtype: str, index: int
+    ) -> None:
+        if not is_distributed(job):
+            return  # no TF_CONFIG for local jobs (reference tfjob_controller.go:547)
+        tf_config = gen_tf_config(job, rtype, index)
+        c = objects.find_container(pod_template, self.CONTAINER_NAME)
+        if c is not None:
+            objects.set_env(c, ENV_TF_CONFIG, tf_config)
+
+    def is_master_role(
+        self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
+    ) -> bool:
+        """Chief/Master if present; else worker-0
+        (reference tfjob_controller.go:586-593)."""
+        if any(tfapi.is_chief_or_master(rt) for rt in replicas):
+            return tfapi.is_chief_or_master(rtype)
+        return rtype == tfapi.REPLICA_WORKER and index == 0
+
+    def replica_order(self, replicas: Dict[str, common.ReplicaSpec]) -> List[str]:
+        return [rt for rt in STATUS_ORDER if rt in replicas] + [
+            rt for rt in replicas if rt not in STATUS_ORDER
+        ]
+
+    # ------------------------------------------------------------- status
+    def _is_worker0_completed(self, ctx: StatusContext) -> bool:
+        """worker-0 pod Succeeded with exit code 0
+        (reference tfjob_controller.go:597-617)."""
+        if tfapi.REPLICA_WORKER not in ctx.replicas:
+            return True
+        workers = JobEngine.filter_for_replica_type(ctx.pods, tfapi.REPLICA_WORKER)
+        for pod in workers:
+            if objects.labels_of(pod).get(objects.LABEL_REPLICA_INDEX) != "0":
+                continue
+            exit_code = objects.container_exit_code(pod, self.CONTAINER_NAME)
+            return (
+                objects.pod_phase(pod) == objects.POD_SUCCEEDED and exit_code in (0, 0xBEEF)
+            )
+        return False
+
+    def update_job_status(self, engine: JobEngine, job: tfapi.TFJob, ctx: StatusContext) -> None:
+        """reference UpdateJobStatus (status.go:64-220): chief presence decides
+        the success source; worker-0 completion is the chief-less fallback;
+        Restarting precedence over Failed."""
+        status = ctx.status
+        worker0_completed = self._is_worker0_completed(ctx)
+        has_chief = tfapi.contains_chief_or_master(job)
+
+        for rtype in self.replica_order(ctx.replicas):
+            expected, running, succeeded, failed = ctx.counts(rtype)
+
+            if has_chief:
+                if tfapi.is_chief_or_master(rtype):
+                    if running > 0:
+                        common.update_job_conditions(
+                            status, common.JOB_RUNNING, REASON_RUNNING,
+                            f"TFJob {job.namespace}/{job.name} is running.", ctx.now,
+                        )
+                    if expected == 0:
+                        msg = f"TFJob {job.namespace}/{job.name} successfully completed."
+                        ctx.record_event("Normal", REASON_SUCCEEDED, msg)
+                        if status.completion_time is None:
+                            status.completion_time = ctx.now
+                        common.update_job_conditions(
+                            status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
+                        )
+                        metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+            else:
+                if rtype == tfapi.REPLICA_WORKER:
+                    # success: all workers done, or worker-0 done under the
+                    # default success policy (reference status.go:150-181)
+                    all_workers_done = expected == 0
+                    if all_workers_done or (
+                        worker0_completed
+                        and job.success_policy != tfapi.SUCCESS_POLICY_ALL_WORKERS
+                    ):
+                        msg = f"TFJob {job.namespace}/{job.name} successfully completed."
+                        ctx.record_event("Normal", REASON_SUCCEEDED, msg)
+                        if status.completion_time is None:
+                            status.completion_time = ctx.now
+                        common.update_job_conditions(
+                            status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
+                        )
+                        metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+                    elif running > 0:
+                        common.update_job_conditions(
+                            status, common.JOB_RUNNING, REASON_RUNNING,
+                            f"TFJob {job.namespace}/{job.name} is running.", ctx.now,
+                        )
+
+            if failed > 0:
+                restarting = any(
+                    c.type == common.JOB_RESTARTING and c.status == "True"
+                    for c in status.conditions
+                )
+                if restarting:
+                    metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})
+                else:
+                    msg = (
+                        f"TFJob {job.namespace}/{job.name} has failed because "
+                        f"{failed} {rtype} replica(s) failed."
+                    )
+                    ctx.record_event("Normal", REASON_FAILED, msg)
+                    if status.completion_time is None:
+                        status.completion_time = ctx.now
+                    common.update_job_conditions(
+                        status, common.JOB_FAILED, REASON_FAILED, msg, ctx.now
+                    )
+                    metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})

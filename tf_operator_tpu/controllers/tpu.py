@@ -1,0 +1,154 @@
+"""TPUJob controller adapter — JAX/TPU distributed env + slice semantics.
+
+The TPU-native analogue of the reference's TF_CONFIG seam
+(tfjob_controller.go:540-573): instead of a gRPC peer list, a TPU slice
+needs (a) the jax.distributed coordinator rendezvous, (b) per-host identity
+(TPU_WORKER_ID), (c) the slice hostname roster (TPU_WORKER_HOSTNAMES), and
+(d) multislice (DCN) wiring via MEGASCALE_* when numSlices > 1. Collectives
+then ride ICI within the slice and DCN across slices — no per-peer service
+mesh required (SURVEY.md §5.8).
+
+Slice differences vs the reference's per-pod model:
+  - gang scheduling is mandatory (minAvailable = all hosts, set in defaults)
+  - restart is whole-slice-atomic (WHOLE_SLICE_RESTART -> engine tears down
+    every host pod on a retryable failure)
+  - success requires ALL hosts to complete (SPMD: every host runs the same
+    program and exits together)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api import tpujob as tpuapi
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
+from tf_operator_tpu.engine.controller import (
+    JobEngine,
+    REASON_FAILED,
+    REASON_RUNNING,
+    REASON_SUCCEEDED,
+)
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.controllers.tensorflow import replica_dns_name
+
+
+class TPUAdapter(FrameworkAdapter):
+    KIND = tpuapi.KIND
+    PLURAL = tpuapi.PLURAL
+    REPLICA_TYPES = tpuapi.REPLICA_TYPES
+    CONTAINER_NAME = tpuapi.DEFAULT_CONTAINER_NAME
+    PORT_NAME = tpuapi.DEFAULT_PORT_NAME
+    DEFAULT_PORT = tpuapi.DEFAULT_PORT
+    WHOLE_SLICE_RESTART = True
+
+    def from_dict(self, d: Dict[str, Any]) -> tpuapi.TPUJob:
+        return tpuapi.TPUJob.from_dict(d)
+
+    def set_defaults(self, job: tpuapi.TPUJob) -> None:
+        tpuapi.set_defaults(job)
+
+    def validate(self, job: tpuapi.TPUJob) -> None:
+        tpuapi.validate(job)
+
+    def set_cluster_spec(
+        self, job: tpuapi.TPUJob, pod_template: Dict[str, Any], rtype: str, index: int
+    ) -> None:
+        hosts_per_slice = tpuapi.slice_hosts(job.accelerator_type)
+        num_slices = max(1, job.num_slices)
+        slice_id, host_in_slice = divmod(index, hosts_per_slice)
+        total_hosts = hosts_per_slice * num_slices
+
+        def host_dns(i: int) -> str:
+            return replica_dns_name(
+                job.name, job.namespace, rtype, i, 0
+            ).rsplit(":", 1)[0]
+
+        # roster of hosts within THIS replica's slice
+        slice_base = slice_id * hosts_per_slice
+        slice_hostnames = ",".join(
+            host_dns(slice_base + i) for i in range(hosts_per_slice)
+        )
+        coordinator = (
+            f"{host_dns(slice_base)}:{tpuapi.DEFAULT_COORDINATOR_PORT}"
+        )
+        env = {
+            # jax.distributed.initialize() rendezvous (per slice)
+            "COORDINATOR_ADDRESS": coordinator,
+            "NUM_PROCESSES": str(hosts_per_slice),
+            "PROCESS_ID": str(host_in_slice),
+            # libtpu host identity/roster
+            "TPU_WORKER_ID": str(host_in_slice),
+            "TPU_WORKER_HOSTNAMES": slice_hostnames,
+            "TPU_ACCELERATOR_TYPE": job.accelerator_type,
+            # runtime mesh construction hints
+            "TPU_SLICE_ID": str(slice_id),
+            "TPU_NUM_SLICES": str(num_slices),
+            "TPU_HOSTS_PER_SLICE": str(hosts_per_slice),
+            "TPU_TOTAL_HOSTS": str(total_hosts),
+        }
+        if job.topology:
+            env["TPU_TOPOLOGY"] = job.topology
+        if num_slices > 1:
+            # multislice-over-DCN wiring (MEGASCALE convention)
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                f"{host_dns(0)}:{tpuapi.DEFAULT_COORDINATOR_PORT}"
+            )
+            env["MEGASCALE_NUM_SLICES"] = str(num_slices)
+            env["MEGASCALE_SLICE_ID"] = str(slice_id)
+        c = objects.find_container(pod_template, self.CONTAINER_NAME)
+        targets = (
+            [c]
+            if c is not None
+            else pod_template.get("spec", {}).get("containers", []) or []
+        )
+        for container in targets:
+            for k, v in env.items():
+                objects.set_env(container, k, v)
+
+    def is_master_role(
+        self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
+    ) -> bool:
+        return rtype == tpuapi.REPLICA_WORKER and index == 0  # coordinator host
+
+    def update_job_status(self, engine: JobEngine, job, ctx: StatusContext) -> None:
+        """All-hosts semantics: Running while any host runs; Succeeded only
+        when every host completed; a non-retryable failure (engine didn't
+        convert it to Restarting) fails the job."""
+        status = ctx.status
+        rtype = tpuapi.REPLICA_WORKER
+        if rtype not in ctx.replicas:
+            return
+        expected, running, succeeded, failed = ctx.counts(rtype)
+        if running > 0:
+            common.update_job_conditions(
+                status, common.JOB_RUNNING, REASON_RUNNING,
+                f"TPUJob {job.namespace}/{job.name} is running "
+                f"({running} hosts active).", ctx.now,
+            )
+        if expected == 0:
+            msg = f"TPUJob {job.namespace}/{job.name} successfully completed."
+            ctx.record_event("Normal", REASON_SUCCEEDED, msg)
+            if status.completion_time is None:
+                status.completion_time = ctx.now
+            common.update_job_conditions(
+                status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
+            )
+            metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+        elif failed > 0:
+            restarting = any(
+                c.type == common.JOB_RESTARTING and c.status == "True"
+                for c in status.conditions
+            )
+            if not restarting:
+                msg = (
+                    f"TPUJob {job.namespace}/{job.name} has failed because "
+                    f"{failed} {rtype} host(s) failed permanently."
+                )
+                ctx.record_event("Normal", REASON_FAILED, msg)
+                if status.completion_time is None:
+                    status.completion_time = ctx.now
+                common.update_job_conditions(
+                    status, common.JOB_FAILED, REASON_FAILED, msg, ctx.now
+                )
+                metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})

@@ -1,0 +1,89 @@
+"""XGBoostJob controller adapter — Rabit/LightGBM env + master-gated status.
+
+Reference parity: pkg/controller.v1/xgboost/{xgboost.go,xgboostjob_controller.go}.
+Env (xgboost.go:18-100): MASTER_ADDR/PORT, WORLD_SIZE, RANK (worker rank
+offset by master count), PYTHONUNBUFFERED; LightGBM WORKER_PORT/WORKER_ADDRS
+when distributed.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api import xgboost as xgbapi
+from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
+from tf_operator_tpu.engine.controller import JobEngine
+from tf_operator_tpu.controllers.shared_status import master_based_update_job_status
+from tf_operator_tpu.k8s import objects
+
+
+def get_port(job: xgbapi.XGBoostJob, rtype: str) -> int:
+    spec = (job.replica_specs or {}).get(rtype)
+    if spec is not None:
+        c = objects.find_container(spec.template, xgbapi.DEFAULT_CONTAINER_NAME)
+        if c is not None:
+            p = objects.find_port(c, xgbapi.DEFAULT_PORT_NAME)
+            if p:
+                return p
+    return xgbapi.DEFAULT_PORT
+
+
+def total_replicas(job: xgbapi.XGBoostJob) -> int:
+    return sum(s.replicas or 0 for s in (job.replica_specs or {}).values())
+
+
+class XGBoostAdapter(FrameworkAdapter):
+    KIND = xgbapi.KIND
+    PLURAL = xgbapi.PLURAL
+    REPLICA_TYPES = xgbapi.REPLICA_TYPES
+    CONTAINER_NAME = xgbapi.DEFAULT_CONTAINER_NAME
+    PORT_NAME = xgbapi.DEFAULT_PORT_NAME
+    DEFAULT_PORT = xgbapi.DEFAULT_PORT
+
+    def from_dict(self, d: Dict[str, Any]) -> xgbapi.XGBoostJob:
+        return xgbapi.XGBoostJob.from_dict(d)
+
+    def set_defaults(self, job: xgbapi.XGBoostJob) -> None:
+        xgbapi.set_defaults(job)
+
+    def validate(self, job: xgbapi.XGBoostJob) -> None:
+        xgbapi.validate(job)
+
+    def set_cluster_spec(
+        self, job: xgbapi.XGBoostJob, pod_template: Dict[str, Any], rtype: str, index: int
+    ) -> None:
+        rank = index
+        specs = job.replica_specs or {}
+        if rtype == xgbapi.REPLICA_WORKER:
+            master = specs.get(xgbapi.REPLICA_MASTER)
+            rank += (master.replicas or 0) if master else 0
+        total = total_replicas(job)
+        env = {
+            "MASTER_PORT": str(get_port(job, xgbapi.REPLICA_MASTER)),
+            "MASTER_ADDR": JobEngine.gen_general_name(
+                job.name, xgbapi.REPLICA_MASTER, 0
+            ),
+            "WORLD_SIZE": str(total),
+            "RANK": str(rank),
+            "PYTHONUNBUFFERED": "0",
+        }
+        if total > 1:
+            worker_port = get_port(job, xgbapi.REPLICA_WORKER)
+            env["WORKER_PORT"] = str(worker_port)
+            env["WORKER_ADDRS"] = ",".join(
+                JobEngine.gen_general_name(job.name, xgbapi.REPLICA_WORKER, i)
+                for i in range(total - 1)
+            )
+        for c in pod_template.get("spec", {}).get("containers", []) or []:
+            for k, v in env.items():
+                objects.set_env(c, k, v)
+
+    def is_master_role(
+        self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
+    ) -> bool:
+        return rtype == xgbapi.REPLICA_MASTER
+
+    def update_job_status(self, engine, job, ctx: StatusContext) -> None:
+        master_based_update_job_status(
+            self.KIND, job, ctx, master_type=xgbapi.REPLICA_MASTER
+        )

@@ -1,0 +1,4 @@
+from tf_operator_tpu.engine.controller import EngineConfig, JobEngine, ReconcileResult
+from tf_operator_tpu.engine.expectations import ControllerExpectations
+
+__all__ = ["EngineConfig", "JobEngine", "ReconcileResult", "ControllerExpectations"]

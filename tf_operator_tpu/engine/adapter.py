@@ -1,0 +1,86 @@
+"""FrameworkAdapter — the per-framework callback set the engine drives.
+
+This is the Python shape of the reference's ControllerInterface
+(kubeflow/common; overridden methods at reference tfjob_controller.go:
+SetClusterSpec :540, IsMasterRole :586, UpdateJobStatus :351, plus the
+api-level defaults/validation). One adapter per job kind; registered in
+controllers/registry.py (reference register_controller.go:36-49).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api.job import Job
+
+
+class FrameworkAdapter:
+    KIND: str = "Job"
+    PLURAL: str = "jobs"
+    REPLICA_TYPES: List[str] = []
+    CONTAINER_NAME: str = ""
+    PORT_NAME: str = ""
+    DEFAULT_PORT: int = 0
+
+    # ---- api-level hooks --------------------------------------------------
+    def from_dict(self, d: Dict[str, Any]) -> Job:
+        raise NotImplementedError
+
+    def set_defaults(self, job: Job) -> None:
+        raise NotImplementedError
+
+    def validate(self, job: Job) -> None:
+        raise NotImplementedError
+
+    # ---- reconcile-time hooks --------------------------------------------
+    def set_cluster_spec(
+        self, job: Job, pod_template: Dict[str, Any], rtype: str, index: int
+    ) -> None:
+        """Inject cluster-discovery env (TF_CONFIG / MASTER_ADDR / DMLC_* /
+        JAX coordinator) into the pod template. The reference's seam is
+        SetClusterSpec (tfjob_controller.go:540-573)."""
+        raise NotImplementedError
+
+    def is_master_role(
+        self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
+    ) -> bool:
+        """Whether this replica gets the job-role=master label
+        (reference tfjob_controller.go:586-593)."""
+        return False
+
+    def replica_order(self, replicas: Dict[str, common.ReplicaSpec]) -> List[str]:
+        """Deterministic replica-type iteration order for status updates
+        (reference status.go:95-101 orders Chief,Evaluator,Master,PS,Worker)."""
+        return sorted(replicas.keys())
+
+    def update_job_status(self, engine, job: Job, ctx: "StatusContext") -> None:
+        """Framework success/running/failed condition rules, applied after
+        per-replica pod reconciliation. Default: master-style semantics
+        shared by PyTorch/XGBoost (success when the master-role replica
+        type completes)."""
+        raise NotImplementedError
+
+
+class StatusContext:
+    """What update_job_status gets to look at: the declared replicas and the
+    freshly-counted pod states, plus an event recorder."""
+
+    def __init__(
+        self,
+        replicas: Dict[str, common.ReplicaSpec],
+        status: common.JobStatus,
+        pods: List[Dict[str, Any]],
+        now: str,
+        record_event,
+    ) -> None:
+        self.replicas = replicas
+        self.status = status
+        self.pods = pods
+        self.now = now
+        self.record_event = record_event
+
+    def counts(self, rtype: str):
+        rs = self.status.replica_statuses.get(rtype, common.ReplicaStatus())
+        spec = self.replicas[rtype]
+        expected = (spec.replicas or 0) - rs.succeeded
+        return expected, rs.active, rs.succeeded, rs.failed

@@ -1,0 +1,95 @@
+"""Pod/Service control — create/delete with controller ownerReferences.
+
+Equivalent of kubeflow/common pkg/controller.v1/control
+(RealPodControl/RealServiceControl, reference tfjob_controller.go:94-95) and
+its FakePodControl test double (reference §4.2 tests count create/delete
+calls instead of hitting an apiserver).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.k8s import objects
+
+
+class PodControl:
+    """Creates/deletes pods against a ClusterClient (FakeCluster or real)."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def create_pod_with_controller_ref(
+        self,
+        namespace: str,
+        pod_template: Dict[str, Any],
+        owner: Dict[str, Any],
+        controller_ref: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_template.get("metadata", {}).get("name", ""),
+                "namespace": namespace,
+                "labels": dict(pod_template.get("metadata", {}).get("labels", {}) or {}),
+                "annotations": dict(
+                    pod_template.get("metadata", {}).get("annotations", {}) or {}
+                ),
+                "ownerReferences": [copy.deepcopy(controller_ref)],
+            },
+            "spec": copy.deepcopy(pod_template.get("spec", {})),
+            "status": {"phase": objects.POD_PENDING},
+        }
+        return self.cluster.create_pod(pod)
+
+    def delete_pod(self, namespace: str, name: str, owner: Dict[str, Any]) -> None:
+        self.cluster.delete_pod(namespace, name)
+
+
+class ServiceControl:
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def create_service_with_controller_ref(
+        self,
+        namespace: str,
+        service: Dict[str, Any],
+        owner: Dict[str, Any],
+        controller_ref: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        service = copy.deepcopy(service)
+        service.setdefault("metadata", {})["ownerReferences"] = [
+            copy.deepcopy(controller_ref)
+        ]
+        service["metadata"].setdefault("namespace", namespace)
+        return self.cluster.create_service(service)
+
+    def delete_service(self, namespace: str, name: str, owner: Dict[str, Any]) -> None:
+        self.cluster.delete_service(namespace, name)
+
+
+class FakePodControl(PodControl):
+    """Counts create/delete calls; optionally injects errors
+    (reference tests' FakePodControl)."""
+
+    def __init__(self, cluster=None) -> None:
+        super().__init__(cluster)
+        self.templates: List[Dict[str, Any]] = []
+        self.deleted: List[str] = []
+        self.create_error: Optional[Exception] = None
+
+    def create_pod_with_controller_ref(self, namespace, pod_template, owner, controller_ref):
+        if self.create_error is not None:
+            raise self.create_error
+        self.templates.append(copy.deepcopy(pod_template))
+        if self.cluster is not None:
+            return super().create_pod_with_controller_ref(
+                namespace, pod_template, owner, controller_ref
+            )
+        return pod_template
+
+    def delete_pod(self, namespace, name, owner):
+        self.deleted.append(f"{namespace}/{name}")
+        if self.cluster is not None:
+            super().delete_pod(namespace, name, owner)

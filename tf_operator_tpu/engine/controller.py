@@ -1,0 +1,735 @@
+"""JobEngine — the generic job-controller engine.
+
+The equivalent of kubeflow/common's JobController.ReconcileJobs (the
+top-level state machine invoked by every framework reconciler in the
+reference: tfjob_controller.go:152, pytorchjob_controller.go:162,
+mxjob_controller.go:177, xgboostjob_controller.go:168). Responsibilities,
+in reconcile order:
+
+  1. expectation gate (skip sync while issued creates/deletes unobserved)
+  2. defaults + validation (invalid spec -> Failed condition, no pods)
+  3. terminal-state handling: CleanPodPolicy teardown, TTLSecondsAfterFinished
+  4. BackoffLimit / ActiveDeadlineSeconds -> job Failed
+  5. gang PodGroup sync (volcano-style)
+  6. per replica type: ReconcilePods (index slices, exit-code restart) +
+     ReconcileServices (headless DNS identity)
+  7. framework UpdateJobStatus + status write-back if changed
+
+Deliberate fix vs the reference: ActiveDeadlineSeconds and TTL use
+ReconcileResult.requeue_after instead of WorkQueue.AddAfter, which is a
+silent no-op in the reference's new stack (FakeWorkQueue,
+reference fake_workqueue.go:27, tfjob_controller.go:379 — SURVEY.md §7.4.6).
+"""
+from __future__ import annotations
+
+import calendar
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api.job import Job, ValidationError
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
+from tf_operator_tpu.engine.control import PodControl, ServiceControl
+from tf_operator_tpu.engine.expectations import (
+    ControllerExpectations,
+    gen_expectation_pods_key,
+    gen_expectation_services_key,
+)
+from tf_operator_tpu.k8s import objects
+
+# Gang-scheduling annotations (reference pod.go:223-237 / tfjob_controller.go:799-813)
+GANG_GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
+GANG_TASK_SPEC_ANNOTATION = "volcano.sh/task-spec"
+DEFAULT_GANG_SCHEDULER = "volcano"
+
+# Event reasons (reference event vocabulary)
+REASON_SUCCEEDED = "JobSucceeded"
+REASON_FAILED = "JobFailed"
+REASON_RUNNING = "JobRunning"
+REASON_CREATED = "JobCreated"
+REASON_RESTARTING = "JobRestarting"
+REASON_EXITED_WITH_CODE = "ExitedWithCode"
+REASON_POD_TEMPLATE_RESTART_POLICY = "SettedPodTemplateRestartPolicy"
+REASON_FAILED_VALIDATION = "FailedValidation"
+
+
+def iso_from_epoch(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def epoch_from_iso(s: str) -> float:
+    return calendar.timegm(time.strptime(s, "%Y-%m-%dT%H:%M:%SZ"))
+
+
+@dataclass
+class EngineConfig:
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = DEFAULT_GANG_SCHEDULER
+
+
+@dataclass
+class ReconcileResult:
+    requeue_after: Optional[float] = None  # seconds
+    error: Optional[str] = None
+
+
+class JobEngine:
+    """One engine per job kind; shared reconcile machinery, framework
+    behavior via the adapter."""
+
+    def __init__(
+        self,
+        cluster,
+        adapter: FrameworkAdapter,
+        config: Optional[EngineConfig] = None,
+        clock=time.time,
+        pod_control: Optional[PodControl] = None,
+        service_control: Optional[ServiceControl] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.adapter = adapter
+        self.config = config or EngineConfig()
+        self.clock = clock
+        self.expectations = ControllerExpectations(clock=clock)
+        self.pod_control = pod_control or PodControl(cluster)
+        self.service_control = service_control or ServiceControl(cluster)
+        # informer-style hooks: observe creations/deletions for expectations
+        # (reference pkg/common/util/reconciler.go:38-157)
+        cluster.subscribe("Pod", self._on_pod_event)
+        cluster.subscribe("Service", self._on_service_event)
+
+    # ------------------------------------------------------------ identity
+    def gen_labels(self, job_name: str) -> Dict[str, str]:
+        """kubeflow/common GenLabels (used at reference tfjob_controller.go:259)."""
+        return {
+            objects.LABEL_GROUP_NAME: objects.GROUP_NAME,
+            objects.LABEL_JOB_NAME: job_name.replace("/", "-"),
+        }
+
+    @staticmethod
+    def gen_general_name(job_name: str, rtype: str, index: int) -> str:
+        """{job}-{rt}-{index} naming contract (kubeflow/common GenGeneralName,
+        used at reference tensorflow.go:158; asserted by the reference e2e
+        suite pod_names_validation_tests.py)."""
+        return f"{job_name}-{rtype.lower()}-{index}"
+
+    # ------------------------------------------------------- informer hooks
+    def _expectation_key_for(self, obj: Dict[str, Any], kind: str) -> Optional[str]:
+        labels = objects.labels_of(obj)
+        job_name = labels.get(objects.LABEL_JOB_NAME)
+        rtype = labels.get(objects.LABEL_REPLICA_TYPE)
+        if not job_name or not rtype:
+            return None
+        job_key = f"{objects.namespace_of(obj)}/{job_name}"
+        if kind == "Pod":
+            return gen_expectation_pods_key(job_key, rtype)
+        return gen_expectation_services_key(job_key, rtype)
+
+    def _on_pod_event(self, event_type: str, pod: Dict[str, Any]) -> None:
+        key = self._expectation_key_for(pod, "Pod")
+        if key is None:
+            return
+        if event_type == "ADDED":
+            self.expectations.creation_observed(key)
+        elif event_type == "DELETED":
+            self.expectations.deletion_observed(key)
+
+    def _on_service_event(self, event_type: str, svc: Dict[str, Any]) -> None:
+        key = self._expectation_key_for(svc, "Service")
+        if key is None:
+            return
+        if event_type == "ADDED":
+            self.expectations.creation_observed(key)
+        elif event_type == "DELETED":
+            self.expectations.deletion_observed(key)
+
+    def satisfied_expectations(self, job: Job) -> bool:
+        """OR over replica types (reference reconciler.go:23-35)."""
+        if not job.replica_specs:
+            return True
+        for rtype in job.replica_specs:
+            if self.expectations.satisfied_expectations(
+                gen_expectation_pods_key(job.key, rtype)
+            ) and self.expectations.satisfied_expectations(
+                gen_expectation_services_key(job.key, rtype)
+            ):
+                return True
+        return False
+
+    # ----------------------------------------------------------- list/adopt
+    def get_pods_for_job(self, job: Job) -> List[Dict[str, Any]]:
+        """List by GenLabels selector, then adopt orphans / skip pods owned
+        by someone else (ControllerRefManager-style,
+        reference tfjob_controller.go:251-290)."""
+        selector = self.gen_labels(job.name)
+        pods = self.cluster.list_pods(namespace=job.namespace, selector=selector)
+        claimed = []
+        for pod in pods:
+            ref = objects.get_controller_of(pod)
+            if ref is None:
+                # adopt: set our controller ref
+                pod["metadata"].setdefault("ownerReferences", []).append(
+                    objects.owner_reference(
+                        {"apiVersion": job.api_version, "kind": job.kind,
+                         "metadata": job.metadata}
+                    )
+                )
+                pod = self.cluster.update_pod(pod)
+                claimed.append(pod)
+            elif ref.get("uid") == job.uid or ref.get("name") == job.name:
+                claimed.append(pod)
+        return claimed
+
+    def get_services_for_job(self, job: Job) -> List[Dict[str, Any]]:
+        selector = self.gen_labels(job.name)
+        svcs = self.cluster.list_services(namespace=job.namespace, selector=selector)
+        return [
+            s
+            for s in svcs
+            if (objects.get_controller_of(s) or {}).get("name", job.name) == job.name
+        ]
+
+    @staticmethod
+    def filter_for_replica_type(
+        items: List[Dict[str, Any]], rtype: str
+    ) -> List[Dict[str, Any]]:
+        """kubeflow/common FilterPodsForReplicaType (reference pod.go:87)."""
+        rt = rtype.lower()
+        return [
+            it
+            for it in items
+            if objects.labels_of(it).get(objects.LABEL_REPLICA_TYPE) == rt
+        ]
+
+    @staticmethod
+    def get_slices(
+        items: List[Dict[str, Any]], replicas: int
+    ) -> List[List[Dict[str, Any]]]:
+        """Index-bucketed slices sized max(replicas, highest index + 1) so the
+        caller can create missing indices and delete out-of-range ones
+        (kubeflow/common GetPodSlices contract, reference pod.go:98-127)."""
+        size = replicas
+        parsed = []
+        for it in items:
+            try:
+                idx = int(objects.labels_of(it).get(objects.LABEL_REPLICA_INDEX, ""))
+            except ValueError:
+                continue
+            parsed.append((idx, it))
+            size = max(size, idx + 1)
+        slices: List[List[Dict[str, Any]]] = [[] for _ in range(size)]
+        for idx, it in parsed:
+            if idx >= 0:
+                slices[idx].append(it)
+        return slices
+
+    # ------------------------------------------------------------ reconcile
+    def reconcile(self, job: Job) -> ReconcileResult:
+        """Full ReconcileJobs state machine. Mutates job.status and writes it
+        back to the cluster if changed."""
+        now_iso = iso_from_epoch(self.clock())
+        status = job.status
+        old_status = copy.deepcopy(status)
+
+        # Created condition on first contact (reference onOwnerCreateFunc /
+        # addTFJob set Created; job.go:59-138)
+        if not status.conditions:
+            common.update_job_conditions(
+                status, common.JOB_CREATED, REASON_CREATED,
+                f"{self.adapter.KIND} {job.name} is created.", now_iso,
+            )
+            self.cluster.record_event(
+                job.to_dict(), "Normal", REASON_CREATED,
+                f"{self.adapter.KIND} {job.name} is created.",
+            )
+            metrics.JOBS_CREATED.inc({"job_namespace": job.namespace})
+
+        # validation: invalid spec -> Failed condition, no pods (reference
+        # e2e invalid_tfjob_tests.py; legacy job.go:40-56 writes Failed)
+        try:
+            self.adapter.set_defaults(job)
+            self.adapter.validate(job)
+        except ValidationError as e:
+            common.update_job_conditions(
+                status, common.JOB_FAILED, REASON_FAILED_VALIDATION, str(e), now_iso
+            )
+            self.cluster.record_event(
+                job.to_dict(), "Warning", REASON_FAILED_VALIDATION, str(e)
+            )
+            self._write_status(job, old_status)
+            return ReconcileResult(error=str(e))
+
+        # expectation gate (reference tfjob_controller.go:139-146)
+        if not self.satisfied_expectations(job):
+            return ReconcileResult()
+
+        pods = self.get_pods_for_job(job)
+        services = self.get_services_for_job(job)
+        replicas = job.replica_specs
+
+        # ----- terminal state: clean pods, TTL (reference ReconcileJobs head)
+        if common.is_finished(status):
+            self._delete_pods_and_services(job, pods)
+            if self.config.enable_gang_scheduling:
+                self._delete_pod_group(job)
+            res = self._cleanup_job_ttl(job)
+            self._write_status(job, old_status)
+            return res
+
+        # ----- BackoffLimit / ActiveDeadlineSeconds -> Failed
+        failure_message = None
+        if self._past_backoff_limit(job, pods):
+            failure_message = (
+                f"{self.adapter.KIND} {job.name} has failed because it has "
+                f"reached the specified backoff limit"
+            )
+        elif self._past_active_deadline(job):
+            failure_message = (
+                f"{self.adapter.KIND} {job.name} has failed because it was "
+                f"active longer than specified deadline"
+            )
+        if failure_message is not None:
+            if status.completion_time is None:
+                status.completion_time = now_iso
+            self._delete_pods_and_services(job, pods, force_all=True)
+            if self.config.enable_gang_scheduling:
+                self._delete_pod_group(job)
+            self.cluster.record_event(
+                job.to_dict(), "Normal", REASON_FAILED, failure_message
+            )
+            common.update_job_conditions(
+                status, common.JOB_FAILED, REASON_FAILED, failure_message, now_iso
+            )
+            metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})
+            self._write_status(job, old_status)
+            return ReconcileResult()
+
+        # ----- gang PodGroup sync
+        if self.config.enable_gang_scheduling:
+            self._sync_pod_group(job)
+
+        # ----- per replica type: pods + services
+        for rtype, spec in replicas.items():
+            self.reconcile_pods(job, status, pods, rtype, spec, replicas, now_iso)
+            self.reconcile_services(job, services, rtype, spec)
+
+        # ----- framework status rules
+        if status.start_time is None:
+            status.start_time = now_iso
+        ctx = StatusContext(
+            replicas, status,
+            self.get_pods_for_job(job), now_iso,
+            lambda etype, reason, msg: self.cluster.record_event(
+                job.to_dict(), etype, reason, msg
+            ),
+        )
+        self.adapter.update_job_status(self, job, ctx)
+        status.last_reconcile_time = now_iso
+
+        self._write_status(job, old_status)
+
+        # requeue for ActiveDeadlineSeconds (RequeueAfter fix, SURVEY §7.4.6)
+        requeue = None
+        ads = job.run_policy.active_deadline_seconds
+        if ads is not None and status.start_time is not None:
+            remaining = epoch_from_iso(status.start_time) + ads - self.clock()
+            requeue = max(0.0, remaining)
+        return ReconcileResult(requeue_after=requeue)
+
+    # ------------------------------------------------------------- pods
+    def reconcile_pods(
+        self,
+        job: Job,
+        status: common.JobStatus,
+        pods: List[Dict[str, Any]],
+        rtype: str,
+        spec: common.ReplicaSpec,
+        replicas: Dict[str, common.ReplicaSpec],
+        now_iso: str,
+    ) -> None:
+        """Per-replica-type pod reconciliation: create missing indices, delete
+        out-of-range (dynamic scale down), exit-code restart handling, replica
+        status counting (reference tfjob_controller.go:644-740)."""
+        typed = self.filter_for_replica_type(pods, rtype)
+        num_replicas = spec.replicas or 0
+        # initializeReplicaStatuses (reference status.go:244-249)
+        status.replica_statuses[rtype] = common.ReplicaStatus()
+        restarted_this_pass = False
+
+        slices = self.get_slices(typed, num_replicas)
+        for index, pod_slice in enumerate(slices):
+            if len(pod_slice) > 1:
+                continue  # too many pods for index; wait for deletion to settle
+            if len(pod_slice) == 0:
+                master_role = self.adapter.is_master_role(replicas, rtype, index)
+                self._create_new_pod(job, rtype, index, spec, master_role, replicas)
+                continue
+            pod = pod_slice[0]
+            if index < 0 or index >= num_replicas:
+                # out-of-range: scale down (reference tfjob_controller.go:698-703)
+                key = gen_expectation_pods_key(job.key, rtype)
+                self.expectations.raise_expectations(key, 0, 1)
+                try:
+                    self.pod_control.delete_pod(
+                        job.namespace, objects.name_of(pod), job.to_dict()
+                    )
+                except Exception:
+                    self.expectations.lower_expectations(key, 0, 1)
+                    raise
+                continue
+
+            exit_code = objects.container_exit_code(pod, self.adapter.CONTAINER_NAME)
+            if exit_code != 0xBEEF and objects.pod_phase(pod) == objects.POD_FAILED:
+                self.cluster.record_event(
+                    job.to_dict(), "Normal", REASON_EXITED_WITH_CODE,
+                    f"Pod: {objects.namespace_of(pod)}.{objects.name_of(pod)} "
+                    f"exited with code {exit_code}",
+                )
+            if (
+                spec.restart_policy == common.RESTART_POLICY_EXIT_CODE
+                and objects.pod_phase(pod) == objects.POD_FAILED
+                and common.is_retryable_exit_code(exit_code)
+            ):
+                # delete-for-recreate + Restarting condition
+                # (reference tfjob_controller.go:705-736)
+                key = gen_expectation_pods_key(job.key, rtype)
+                self.expectations.raise_expectations(key, 0, 1)
+                try:
+                    self.pod_control.delete_pod(
+                        job.namespace, objects.name_of(pod), job.to_dict()
+                    )
+                except Exception:
+                    self.expectations.lower_expectations(key, 0, 1)
+                    raise
+                msg = (
+                    f"{self.adapter.KIND} {job.name} is restarting because "
+                    f"{rtype} replica(s) failed."
+                )
+                self.cluster.record_event(
+                    job.to_dict(), "Warning", REASON_RESTARTING, msg
+                )
+                common.update_job_conditions(
+                    status, common.JOB_RESTARTING, REASON_RESTARTING, msg, now_iso
+                )
+                metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
+                restarted_this_pass = True
+                continue
+
+            # updateJobReplicaStatuses (reference status.go:253-262)
+            phase = objects.pod_phase(pod)
+            rs = status.replica_statuses[rtype]
+            if phase == objects.POD_RUNNING:
+                rs.active += 1
+            elif phase == objects.POD_SUCCEEDED:
+                rs.succeeded += 1
+            elif phase == objects.POD_FAILED:
+                rs.failed += 1
+
+        # Whole-slice gang restart: a TPU slice is unusable partially, so a
+        # retryable failure tears down ALL replicas of the type for atomic
+        # recreation (SURVEY.md §5.3/§7.4.1 — no reference counterpart; the
+        # reference restarts pods individually).
+        if restarted_this_pass and getattr(self.adapter, "WHOLE_SLICE_RESTART", False):
+            key = gen_expectation_pods_key(job.key, rtype)
+            for pod_slice in self.get_slices(
+                self.filter_for_replica_type(self.get_pods_for_job(job), rtype),
+                num_replicas,
+            ):
+                for pod in pod_slice:
+                    self.expectations.raise_expectations(key, 0, 1)
+                    try:
+                        self.pod_control.delete_pod(
+                            job.namespace, objects.name_of(pod), job.to_dict()
+                        )
+                    except Exception:
+                        self.expectations.lower_expectations(key, 0, 1)
+            # counts no longer reflect reality; reset for this pass
+            status.replica_statuses[rtype] = common.ReplicaStatus()
+
+    def _create_new_pod(
+        self,
+        job: Job,
+        rtype: str,
+        index: int,
+        spec: common.ReplicaSpec,
+        master_role: bool,
+        replicas: Dict[str, common.ReplicaSpec],
+    ) -> None:
+        """reference createNewPod (tfjob_controller.go:744-834)."""
+        rt = rtype.lower()
+        key = gen_expectation_pods_key(job.key, rtype)
+        self.expectations.raise_expectations(key, 1, 0)
+
+        labels = self.gen_labels(job.name)
+        labels[objects.LABEL_REPLICA_TYPE] = rt
+        labels[objects.LABEL_REPLICA_INDEX] = str(index)
+        if master_role:
+            labels[objects.LABEL_JOB_ROLE] = "master"
+
+        template = copy.deepcopy(spec.template)
+        meta = template.setdefault("metadata", {})
+        meta["name"] = self.gen_general_name(job.name, rtype, index)
+        meta.setdefault("labels", {}).update(labels)
+
+        self.adapter.set_cluster_spec(job, template, rtype, index)
+
+        # pod-template restart policy is overridden by the replica-level one;
+        # warn like the reference (tfjob_controller.go:788-794)
+        if template.get("spec", {}).get("restartPolicy"):
+            self.cluster.record_event(
+                job.to_dict(), "Warning", REASON_POD_TEMPLATE_RESTART_POLICY,
+                "Restart policy in pod template will be overwritten by restart "
+                "policy in replica spec",
+            )
+        # ExitCode is operator-implemented: pod itself must not be restarted
+        # by kubelet (reference setRestartPolicy, pod.go:321-328)
+        if spec.restart_policy == common.RESTART_POLICY_EXIT_CODE:
+            template.setdefault("spec", {})["restartPolicy"] = common.RESTART_POLICY_NEVER
+        else:
+            template.setdefault("spec", {})["restartPolicy"] = spec.restart_policy
+
+        if self.config.enable_gang_scheduling:
+            user_scheduler = template.get("spec", {}).get("schedulerName")
+            if not user_scheduler:
+                template["spec"]["schedulerName"] = self.config.gang_scheduler_name
+            elif user_scheduler != self.config.gang_scheduler_name:
+                self.cluster.record_event(
+                    job.to_dict(), "Warning", "PodTemplateSchedulerName",
+                    "Another scheduler is specified when gang-scheduling is "
+                    "enabled and it will not be overwritten",
+                )
+            annotations = meta.setdefault("annotations", {})
+            annotations[GANG_GROUP_NAME_ANNOTATION] = job.name
+            annotations[GANG_TASK_SPEC_ANNOTATION] = rt
+
+        controller_ref = objects.owner_reference(
+            {"apiVersion": job.api_version, "kind": job.kind, "metadata": job.metadata}
+        )
+        try:
+            self.pod_control.create_pod_with_controller_ref(
+                job.namespace, template, job.to_dict(), controller_ref
+            )
+        except Exception:
+            # creation failed: the informer won't observe it — lower the
+            # expectation (reference tfjob_controller.go:824-832)
+            self.expectations.creation_observed(key)
+            raise
+
+    # ------------------------------------------------------------- services
+    def reconcile_services(
+        self,
+        job: Job,
+        services: List[Dict[str, Any]],
+        rtype: str,
+        spec: common.ReplicaSpec,
+    ) -> None:
+        """One headless Service per replica index — the stable DNS identity
+        peers dial ({job}-{rt}-{i}.{ns}.svc, reference tensorflow.go:153-166;
+        engine ReconcileServices)."""
+        typed = self.filter_for_replica_type(services, rtype)
+        num_replicas = spec.replicas or 0
+        slices = self.get_slices(typed, num_replicas)
+        for index, svc_slice in enumerate(slices):
+            if len(svc_slice) > 1:
+                continue
+            if len(svc_slice) == 0:
+                self._create_new_service(job, rtype, index, spec)
+            else:
+                svc = svc_slice[0]
+                if index >= num_replicas:
+                    key = gen_expectation_services_key(job.key, rtype)
+                    self.expectations.raise_expectations(key, 0, 1)
+                    try:
+                        self.service_control.delete_service(
+                            job.namespace, objects.name_of(svc), job.to_dict()
+                        )
+                    except Exception:
+                        self.expectations.lower_expectations(key, 0, 1)
+                        raise
+
+    def _create_new_service(
+        self, job: Job, rtype: str, index: int, spec: common.ReplicaSpec
+    ) -> None:
+        rt = rtype.lower()
+        key = gen_expectation_services_key(job.key, rtype)
+        self.expectations.raise_expectations(key, 1, 0)
+
+        labels = self.gen_labels(job.name)
+        labels[objects.LABEL_REPLICA_TYPE] = rt
+        labels[objects.LABEL_REPLICA_INDEX] = str(index)
+
+        port = self._replica_port(spec)
+        svc = objects.make_service(
+            name=self.gen_general_name(job.name, rtype, index),
+            namespace=job.namespace,
+            labels=labels,
+            selector=labels,
+            port=port,
+            port_name=self.adapter.PORT_NAME,
+        )
+        controller_ref = objects.owner_reference(
+            {"apiVersion": job.api_version, "kind": job.kind, "metadata": job.metadata}
+        )
+        try:
+            self.service_control.create_service_with_controller_ref(
+                job.namespace, svc, job.to_dict(), controller_ref
+            )
+        except Exception:
+            self.expectations.creation_observed(key)
+            raise
+
+    def _replica_port(self, spec: common.ReplicaSpec) -> int:
+        """Port from the framework container's named port (reference
+        util.go:29-42 / engine GetPortFromJob)."""
+        c = objects.find_container(spec.template, self.adapter.CONTAINER_NAME)
+        if c is not None:
+            p = objects.find_port(c, self.adapter.PORT_NAME)
+            if p:
+                return p
+        return self.adapter.DEFAULT_PORT
+
+    # ----------------------------------------------------------- run policy
+    def _delete_pods_and_services(
+        self, job: Job, pods: List[Dict[str, Any]], force_all: bool = False
+    ) -> None:
+        """kubeflow/common DeletePodsAndServices: CleanPodPolicy None keeps
+        everything; Running deletes only still-running pods; All deletes all.
+        Service shares the pod's name."""
+        if not pods:
+            return
+        policy = job.run_policy.clean_pod_policy or common.CLEAN_POD_POLICY_RUNNING
+        if not force_all and policy == common.CLEAN_POD_POLICY_NONE:
+            return
+        for pod in pods:
+            if (
+                not force_all
+                and policy == common.CLEAN_POD_POLICY_RUNNING
+                and objects.pod_phase(pod) != objects.POD_RUNNING
+            ):
+                continue
+            name = objects.name_of(pod)
+            try:
+                self.pod_control.delete_pod(job.namespace, name, job.to_dict())
+            except Exception:
+                pass
+            try:
+                self.service_control.delete_service(job.namespace, name, job.to_dict())
+            except Exception:
+                pass
+
+    def _cleanup_job_ttl(self, job: Job) -> ReconcileResult:
+        """TTLSecondsAfterFinished: delete the job CR once expired, else
+        requeue for the remainder."""
+        ttl = job.run_policy.ttl_seconds_after_finished
+        if ttl is None:
+            return ReconcileResult()
+        finish = job.status.completion_time
+        if finish is None:
+            return ReconcileResult()
+        expire_at = epoch_from_iso(finish) + ttl
+        remaining = expire_at - self.clock()
+        if remaining <= 0:
+            try:
+                self.cluster.delete(self.adapter.KIND, job.namespace, job.name)
+                metrics.JOBS_DELETED.inc({"job_namespace": job.namespace})
+            except Exception:
+                pass
+            return ReconcileResult()
+        return ReconcileResult(requeue_after=remaining)
+
+    def _past_active_deadline(self, job: Job) -> bool:
+        ads = job.run_policy.active_deadline_seconds
+        if ads is None or job.status.start_time is None:
+            return False
+        return self.clock() - epoch_from_iso(job.status.start_time) >= ads
+
+    def _past_backoff_limit(self, job: Job, pods: List[Dict[str, Any]]) -> bool:
+        """kubeflow/common PastBackoffLimit: sum kubelet restart counts of
+        running pods for OnFailure/Always replica types."""
+        limit = job.run_policy.backoff_limit
+        if limit is None:
+            return False
+        total = 0
+        for rtype, spec in (job.replica_specs or {}).items():
+            if spec.restart_policy not in (
+                common.RESTART_POLICY_ON_FAILURE,
+                common.RESTART_POLICY_ALWAYS,
+            ):
+                continue
+            for pod in self.filter_for_replica_type(pods, rtype):
+                if objects.pod_phase(pod) != objects.POD_RUNNING:
+                    continue
+                for cs in pod.get("status", {}).get("containerStatuses", []) or []:
+                    total += int(cs.get("restartCount", 0))
+        if limit == 0:
+            return total > 0
+        return total >= limit
+
+    # ------------------------------------------------------------ podgroups
+    def _sync_pod_group(self, job: Job) -> None:
+        """volcano-style PodGroup: minMember from schedulingPolicy.minAvailable
+        or total replicas (reference: PodGroup lifecycle in kubeflow/common
+        ReconcileJobs; CRD knobs manifests/base/kubeflow.org_tfjobs.yaml)."""
+        total = sum(s.replicas or 0 for s in (job.replica_specs or {}).values())
+        sp = job.run_policy.scheduling_policy
+        min_member = total
+        queue = None
+        priority_class = None
+        min_resources = None
+        if sp is not None:
+            if sp.min_available is not None:
+                min_member = sp.min_available
+            queue = sp.queue
+            priority_class = sp.priority_class
+            min_resources = sp.min_resources
+        pg = {
+            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "kind": "PodGroup",
+            "metadata": {
+                "name": job.name,
+                "namespace": job.namespace,
+                "ownerReferences": [
+                    objects.owner_reference(
+                        {"apiVersion": job.api_version, "kind": job.kind,
+                         "metadata": job.metadata}
+                    )
+                ],
+            },
+            "spec": {"minMember": min_member},
+        }
+        if queue:
+            pg["spec"]["queue"] = queue
+        if priority_class:
+            pg["spec"]["priorityClassName"] = priority_class
+        if min_resources:
+            pg["spec"]["minResources"] = min_resources
+        try:
+            existing = self.cluster.get("PodGroup", job.namespace, job.name)
+            if existing.get("spec") != pg["spec"]:
+                existing["spec"] = pg["spec"]
+                self.cluster.update("PodGroup", existing)
+        except Exception:
+            self.cluster.create("PodGroup", pg)
+
+    def _delete_pod_group(self, job: Job) -> None:
+        try:
+            self.cluster.delete("PodGroup", job.namespace, job.name)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ status io
+    def _write_status(self, job: Job, old_status: common.JobStatus) -> None:
+        """Status().Update only on diff (reference tfjob_controller.go:510-537)."""
+        if job.status.to_dict() == old_status.to_dict():
+            return
+        try:
+            current = self.cluster.get(self.adapter.KIND, job.namespace, job.name)
+        except Exception:
+            return
+        current["status"] = job.status.to_dict()
+        # also persist defaulted spec? The reference defaults in-memory only;
+        # we match that: only status is written back.
+        self.cluster.update(self.adapter.KIND, current)

@@ -1,0 +1,89 @@
+"""Controller expectations — double-creation protection under informer lag.
+
+The equivalent of kubeflow/common pkg/controller.v1/expectation
+(ControllerExpectations; usage at reference pod.go:176-180,
+reconciler.go:23-35). A controller records how many creations/deletions it
+has issued but not yet observed; while expectations are unsatisfied the sync
+is skipped, so slow watch events can't cause duplicate pods (SURVEY.md §7.4
+hard part 2).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+EXPECTATION_TTL_SECONDS = 5 * 60  # same 5-minute expiry as client-go
+
+
+def gen_expectation_pods_key(job_key: str, replica_type: str) -> str:
+    return f"{job_key}/{replica_type.lower()}/pods"
+
+
+def gen_expectation_services_key(job_key: str, replica_type: str) -> str:
+    return f"{job_key}/{replica_type.lower()}/services"
+
+
+@dataclass
+class _Expectation:
+    add: int = 0
+    delete: int = 0
+    timestamp: float = field(default_factory=time.time)
+
+    def fulfilled(self) -> bool:
+        return self.add <= 0 and self.delete <= 0
+
+    def expired(self, now: float) -> bool:
+        return now - self.timestamp > EXPECTATION_TTL_SECONDS
+
+
+class ControllerExpectations:
+    def __init__(self, clock=time.time) -> None:
+        self._lock = threading.Lock()
+        self._store: Dict[str, _Expectation] = {}
+        self._clock = clock
+
+    def set_expectations(self, key: str, add: int, delete: int) -> None:
+        with self._lock:
+            self._store[key] = _Expectation(add=add, delete=delete, timestamp=self._clock())
+
+    def expect_creations(self, key: str, adds: int) -> None:
+        self.set_expectations(key, adds, 0)
+
+    def expect_deletions(self, key: str, dels: int) -> None:
+        self.set_expectations(key, 0, dels)
+
+    def raise_expectations(self, key: str, add: int, delete: int) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None:
+                self._store[key] = _Expectation(add=add, delete=delete, timestamp=self._clock())
+            else:
+                exp.add += add
+                exp.delete += delete
+
+    def lower_expectations(self, key: str, add: int, delete: int) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is not None:
+                exp.add -= add
+                exp.delete -= delete
+
+    def creation_observed(self, key: str) -> None:
+        self.lower_expectations(key, 1, 0)
+
+    def deletion_observed(self, key: str) -> None:
+        self.lower_expectations(key, 0, 1)
+
+    def satisfied_expectations(self, key: str) -> bool:
+        """True if fulfilled, expired, or never set (first sync must proceed)."""
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None:
+                return True
+            return exp.fulfilled() or exp.expired(self._clock())
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)

@@ -1,0 +1,105 @@
+"""Prometheus metrics — job lifecycle counters labeled by namespace.
+
+Reference parity (SURVEY.md §5.5): tf_operator_jobs_created_total
+(job.go:30-37), _deleted_total (controller.go:70-77), _successful_total /
+_failed_total (status.go:48-62), _restarted_total (pod.go:57-65),
+tf_operator_is_leader gauge (server.go:64-69). Exposition is the Prometheus
+text format, served by the CLI's metrics endpoint.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_REGISTRY: List["Metric"] = []
+_LOCK = threading.Lock()
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Metric:
+    TYPE = "counter"
+
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name = name
+        self.help = help_text
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        with _LOCK:
+            _REGISTRY.append(self)
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def _render_labels(self, key) -> str:
+        if not key:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in key)
+        return "{" + inner + "}"
+
+    def expose(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.TYPE}",
+        ]
+        values = self._values or {(): 0.0}
+        for key, v in sorted(values.items()):
+            lines.append(f"{self.name}{self._render_labels(key)} {v:g}")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self._values.clear()
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, amount: float = 1.0) -> None:
+        with _LOCK:
+            k = _label_key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with _LOCK:
+            self._values[_label_key(labels)] = value
+
+
+def expose_all() -> str:
+    with _LOCK:
+        return "\n".join(m.expose() for m in _REGISTRY) + "\n"
+
+
+def reset_all() -> None:
+    with _LOCK:
+        for m in _REGISTRY:
+            m.reset()
+
+
+PREFIX = "tpu_operator"
+
+JOBS_CREATED = Counter(
+    f"{PREFIX}_jobs_created_total", "Counts number of jobs created"
+)
+JOBS_DELETED = Counter(
+    f"{PREFIX}_jobs_deleted_total", "Counts number of jobs deleted"
+)
+JOBS_SUCCEEDED = Counter(
+    f"{PREFIX}_jobs_successful_total", "Counts number of jobs completed successfully"
+)
+JOBS_FAILED = Counter(
+    f"{PREFIX}_jobs_failed_total", "Counts number of jobs failed"
+)
+JOBS_RESTARTED = Counter(
+    f"{PREFIX}_jobs_restarted_total", "Counts number of jobs restarted"
+)
+IS_LEADER = Gauge(
+    f"{PREFIX}_is_leader", "1 when this operator instance holds leadership"
+)
+RECONCILE_LATENCY = Counter(
+    f"{PREFIX}_reconcile_seconds_total", "Cumulative reconcile latency in seconds"
+)

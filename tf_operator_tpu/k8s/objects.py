@@ -179,6 +179,19 @@ def find_container(pod_or_template: Dict[str, Any], name: str) -> Optional[Dict[
     return None
 
 
+def default_container(
+    pod_or_template: Dict[str, Any], name: str
+) -> Optional[Dict[str, Any]]:
+    """The framework container by name, falling back to container index 0 —
+    the single targeting rule shared by port defaulting and resource
+    injection (reference defaults.go:38-60 uses the same fallback)."""
+    c = find_container(pod_or_template, name)
+    if c is not None:
+        return c
+    containers = containers_of(pod_or_template)
+    return containers[0] if containers else None
+
+
 def find_port(container: Dict[str, Any], port_name: str) -> Optional[int]:
     for p in container.get("ports", []) or []:
         if p.get("name") == port_name:
