@@ -1,15 +1,23 @@
-"""Test config: force an 8-device virtual CPU mesh BEFORE jax is imported,
-so multi-chip sharding tests (dp/tp/pp/sp/ep over jax.sharding.Mesh) run
-without TPU hardware. Bench (bench.py) runs outside pytest on the real chip.
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding
+tests (dp/tp/pp/sp/ep over jax.sharding.Mesh) run without TPU hardware.
+Bench (bench.py) runs outside pytest on the real chip.
+
+Note: the session's sitecustomize pre-imports jax with the TPU platform
+pinned, so env vars alone are too late — we update jax.config before any
+backend is instantiated (backends are lazy until the first devices() call).
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (may already be in sys.modules via sitecustomize)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

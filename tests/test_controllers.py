@@ -138,8 +138,8 @@ def test_tpujob_full_lifecycle_with_gang():
 
 
 def test_tpujob_preemption_restarts_whole_slice():
-    """One host preempted (SIGKILL=137, retryable) -> ALL 8 host pods torn
-    down for atomic recreation; job is Restarting, not Failed."""
+    """One host preempted (SIGKILL=137, retryable) -> ALL host pods (4 for
+    v4-32) torn down for atomic recreation; job is Restarting, not Failed."""
     cluster = FakeCluster()
     engine = make_engine("TPUJob", cluster)
     job = testutil.new_tpujob(name="bert", accelerator_type="v4-32")
@@ -168,3 +168,60 @@ def test_tpujob_user_error_fails_job():
     job, _ = reconcile(cluster, engine, job)
     assert common.is_failed(job.status)
     assert not common.has_condition(job.status, common.JOB_RESTARTING)
+
+
+def test_pytorch_permanent_exit_code_fails_not_wedges():
+    """Permanent exit code (1) under ExitCode policy must FAIL the job, not
+    loop in Restarting (a reference wedge we deliberately fix)."""
+    cluster = FakeCluster()
+    engine = make_engine("PyTorchJob", cluster)
+    job = make_pt_job()
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_EXIT_CODE
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    worker = run_pods(cluster, rtype="Worker")[0]
+    set_phase(cluster, worker, objects.POD_FAILED, exit_code=1, container="pytorch")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_failed(job.status)
+    assert not common.has_condition(job.status, common.JOB_RESTARTING)
+
+
+def test_pytorch_retryable_exit_code_restarts():
+    cluster = FakeCluster()
+    engine = make_engine("PyTorchJob", cluster)
+    job = make_pt_job()
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_EXIT_CODE
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    worker = run_pods(cluster, rtype="Worker")[0]
+    set_phase(cluster, worker, objects.POD_FAILED, exit_code=137, container="pytorch")
+    job, _ = reconcile(cluster, engine, job)
+    assert common.has_condition(job.status, common.JOB_RESTARTING)
+    assert not common.is_failed(job.status)
+
+
+def test_recreated_job_does_not_adopt_old_incarnation_pods():
+    """Same name, new UID: stale Failed pods from the deleted incarnation
+    must not be claimed (strict UID claim)."""
+    cluster = FakeCluster()
+    engine = make_engine("TFJob", cluster)
+    job = testutil.new_tfjob(worker=1)
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    # old incarnation dies; its pod lingers, Failed
+    pod = cluster.list_pods()[0]
+    set_phase(cluster, pod, objects.POD_FAILED, exit_code=1)
+    cluster.delete("TFJob", "default", "test-tfjob")
+    # recreate with a fresh UID: stale pod is NOT adopted; its name collides,
+    # so this sync errors for requeue instead of counting the stale failure
+    job2 = testutil.new_tfjob(worker=1)
+    cluster.create(job2.kind, job2.to_dict())
+    job2, result = reconcile(cluster, engine, job2)
+    assert not common.is_failed(job2.status)
+    assert result.error is not None and "exists" in result.error
+    # once the stale pod finishes terminating, the new incarnation proceeds
+    cluster.delete_pod("default", "test-tfjob-worker-0")
+    job2, result = reconcile(cluster, engine, job2)
+    assert result.error is None
+    assert len(cluster.list_pods()) == 1
+    assert not common.is_failed(job2.status)

@@ -30,13 +30,12 @@ ENV_MX_CONFIG = "MX_CONFIG"
 
 def get_port(job: mxapi.MXJob, rtype: str) -> int:
     spec = (job.replica_specs or {}).get(rtype)
-    if spec is not None:
-        c = objects.find_container(spec.template, mxapi.DEFAULT_CONTAINER_NAME)
-        if c is not None:
-            p = objects.find_port(c, mxapi.DEFAULT_PORT_NAME)
-            if p:
-                return p
-    return mxapi.DEFAULT_PORT
+    if spec is None:
+        return mxapi.DEFAULT_PORT
+    return objects.replica_port(
+        spec.template, mxapi.DEFAULT_CONTAINER_NAME,
+        mxapi.DEFAULT_PORT_NAME, mxapi.DEFAULT_PORT,
+    )
 
 
 def gen_cluster_spec(job: mxapi.MXJob) -> Dict[str, List[Dict[str, Any]]]:
@@ -133,16 +132,14 @@ class MXNetAdapter(FrameworkAdapter):
                 )
                 metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
             if failed > 0:
-                if spec.restart_policy == common.RESTART_POLICY_EXIT_CODE:
-                    msg = (
-                        f"MXJob {job.name} is restarting because {failed} "
-                        f"{rtype} replica(s) failed."
-                    )
-                    ctx.record_event("Warning", REASON_RESTARTING, msg)
-                    common.update_job_conditions(
-                        status, common.JOB_RESTARTING, REASON_RESTARTING, msg, ctx.now
-                    )
-                    metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
+                # see shared_status.py: permanent ExitCode failures must fail
+                # the job; only engine-initiated restarts (this sync) stay
+                # Restarting
+                if (
+                    spec.restart_policy == common.RESTART_POLICY_EXIT_CODE
+                    and rtype in ctx.restarted_types
+                ):
+                    pass
                 else:
                     msg = (
                         f"MXJob {job.name} is failed because {failed} "

@@ -24,13 +24,12 @@ def total_replicas(job: ptapi.PyTorchJob) -> int:
 
 def master_port(job: ptapi.PyTorchJob) -> int:
     spec = (job.replica_specs or {}).get(ptapi.REPLICA_MASTER)
-    if spec is not None:
-        c = objects.find_container(spec.template, ptapi.DEFAULT_CONTAINER_NAME)
-        if c is not None:
-            p = objects.find_port(c, ptapi.DEFAULT_PORT_NAME)
-            if p:
-                return p
-    return ptapi.DEFAULT_PORT
+    if spec is None:
+        return ptapi.DEFAULT_PORT
+    return objects.replica_port(
+        spec.template, ptapi.DEFAULT_CONTAINER_NAME,
+        ptapi.DEFAULT_PORT_NAME, ptapi.DEFAULT_PORT,
+    )
 
 
 class PyTorchAdapter(FrameworkAdapter):

@@ -45,16 +45,18 @@ def master_based_update_job_status(
                 return
 
         if failed > 0:
-            if spec.restart_policy == common.RESTART_POLICY_EXIT_CODE:
-                msg = (
-                    f"{kind} {job.name} is restarting because {failed} "
-                    f"{rtype} replica(s) failed."
-                )
-                ctx.record_event("Warning", REASON_RESTARTING, msg)
-                common.update_job_conditions(
-                    status, common.JOB_RESTARTING, REASON_RESTARTING, msg, ctx.now
-                )
-                metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
+            # The engine only deletes-for-restart on RETRYABLE exit codes; a
+            # failed pod still present under ExitCode policy means a permanent
+            # (1-127) code, which must FAIL the job, not wedge it in
+            # Restarting. ctx.restarted_types is the per-sync engine signal —
+            # checking the lingering Restarting *condition* would conflate an
+            # old restart with a new permanent failure (the reference's wedge,
+            # pytorchjob_controller.go:359; deliberate fix).
+            if (
+                spec.restart_policy == common.RESTART_POLICY_EXIT_CODE
+                and rtype in ctx.restarted_types
+            ):
+                pass  # engine already recorded the restart + condition
             else:
                 msg = (
                     f"{kind} {job.name} is failed because {failed} "

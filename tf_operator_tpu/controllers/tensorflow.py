@@ -207,11 +207,10 @@ class TFAdapter(FrameworkAdapter):
                         )
 
             if failed > 0:
-                restarting = any(
-                    c.type == common.JOB_RESTARTING and c.status == "True"
-                    for c in status.conditions
-                )
-                if restarting:
+                # per-sync engine restart signal, not the lingering condition
+                # (deliberate fix of the reference's status.go:186-196 wedge
+                # when a retryable and a permanent failure co-occur)
+                if rtype in ctx.restarted_types:
                     metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})
                 else:
                     msg = (

@@ -136,11 +136,7 @@ class TPUAdapter(FrameworkAdapter):
             )
             metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
         elif failed > 0:
-            restarting = any(
-                c.type == common.JOB_RESTARTING and c.status == "True"
-                for c in status.conditions
-            )
-            if not restarting:
+            if rtype not in ctx.restarted_types:
                 msg = (
                     f"TPUJob {job.namespace}/{job.name} has failed because "
                     f"{failed} {rtype} host(s) failed permanently."

@@ -19,13 +19,12 @@ from tf_operator_tpu.k8s import objects
 
 def get_port(job: xgbapi.XGBoostJob, rtype: str) -> int:
     spec = (job.replica_specs or {}).get(rtype)
-    if spec is not None:
-        c = objects.find_container(spec.template, xgbapi.DEFAULT_CONTAINER_NAME)
-        if c is not None:
-            p = objects.find_port(c, xgbapi.DEFAULT_PORT_NAME)
-            if p:
-                return p
-    return xgbapi.DEFAULT_PORT
+    if spec is None:
+        return xgbapi.DEFAULT_PORT
+    return objects.replica_port(
+        spec.template, xgbapi.DEFAULT_CONTAINER_NAME,
+        xgbapi.DEFAULT_PORT_NAME, xgbapi.DEFAULT_PORT,
+    )
 
 
 def total_replicas(job: xgbapi.XGBoostJob) -> int:

@@ -72,12 +72,18 @@ class StatusContext:
         pods: List[Dict[str, Any]],
         now: str,
         record_event,
+        restarted_types: Optional[set] = None,
     ) -> None:
         self.replicas = replicas
         self.status = status
         self.pods = pods
         self.now = now
         self.record_event = record_event
+        # replica types the ENGINE deleted-for-restart in THIS sync; the
+        # authoritative "is restarting" signal (the Restarting *condition*
+        # lingers across syncs and conflates old restarts with new permanent
+        # failures — the reference's wedge, status.go:186-196)
+        self.restarted_types = restarted_types or set()
 
     def counts(self, rtype: str):
         rs = self.status.replica_statuses.get(rtype, common.ReplicaStatus())

@@ -147,17 +147,19 @@ class JobEngine:
             self.expectations.deletion_observed(key)
 
     def satisfied_expectations(self, job: Job) -> bool:
-        """OR over replica types (reference reconciler.go:23-35)."""
+        """AND over replica types. (The reference ORs — reconciler.go:23-35 —
+        which defeats the double-creation guard whenever one replica type's
+        expectations are trivially satisfied; deliberate fix.)"""
         if not job.replica_specs:
             return True
         for rtype in job.replica_specs:
-            if self.expectations.satisfied_expectations(
+            if not self.expectations.satisfied_expectations(
                 gen_expectation_pods_key(job.key, rtype)
-            ) and self.expectations.satisfied_expectations(
+            ) or not self.expectations.satisfied_expectations(
                 gen_expectation_services_key(job.key, rtype)
             ):
-                return True
-        return False
+                return False
+        return True
 
     # ----------------------------------------------------------- list/adopt
     def get_pods_for_job(self, job: Job) -> List[Dict[str, Any]]:
@@ -179,7 +181,11 @@ class JobEngine:
                 )
                 pod = self.cluster.update_pod(pod)
                 claimed.append(pod)
-            elif ref.get("uid") == job.uid or ref.get("name") == job.name:
+            elif ref.get("uid") == job.uid:
+                # strict UID claim: a recreated job (same name, new UID) must
+                # NOT adopt the old incarnation's terminating pods
+                # (reference ControllerRefManager + UID recheck,
+                # tfjob_controller.go:277-287)
                 claimed.append(pod)
         return claimed
 
@@ -311,10 +317,21 @@ class JobEngine:
         if self.config.enable_gang_scheduling:
             self._sync_pod_group(job)
 
-        # ----- per replica type: pods + services
-        for rtype, spec in replicas.items():
-            self.reconcile_pods(job, status, pods, rtype, spec, replicas, now_iso)
-            self.reconcile_services(job, services, rtype, spec)
+        # ----- per replica type: pods + services. API errors (e.g. 409 on a
+        # name held by a dying pod of an older incarnation) abort this sync
+        # with an error result — controller-runtime style requeue-on-error —
+        # rather than crashing the loop.
+        restarted_types: set = set()
+        try:
+            for rtype, spec in replicas.items():
+                self.reconcile_pods(
+                    job, status, pods, rtype, spec, replicas, now_iso,
+                    restarted_types,
+                )
+                self.reconcile_services(job, services, rtype, spec)
+        except Exception as e:  # noqa: BLE001 — any API failure requeues
+            self._write_status(job, old_status)
+            return ReconcileResult(error=str(e), requeue_after=1.0)
 
         # ----- framework status rules
         if status.start_time is None:
@@ -325,6 +342,7 @@ class JobEngine:
             lambda etype, reason, msg: self.cluster.record_event(
                 job.to_dict(), etype, reason, msg
             ),
+            restarted_types=restarted_types,
         )
         self.adapter.update_job_status(self, job, ctx)
         status.last_reconcile_time = now_iso
@@ -349,10 +367,13 @@ class JobEngine:
         spec: common.ReplicaSpec,
         replicas: Dict[str, common.ReplicaSpec],
         now_iso: str,
+        restarted_types: Optional[set] = None,
     ) -> None:
         """Per-replica-type pod reconciliation: create missing indices, delete
         out-of-range (dynamic scale down), exit-code restart handling, replica
-        status counting (reference tfjob_controller.go:644-740)."""
+        status counting (reference tfjob_controller.go:644-740). Types whose
+        pods were deleted-for-restart this sync are added to
+        `restarted_types` for the status rules."""
         typed = self.filter_for_replica_type(pods, rtype)
         num_replicas = spec.replicas or 0
         # initializeReplicaStatuses (reference status.go:244-249)
@@ -416,6 +437,8 @@ class JobEngine:
                 )
                 metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
                 restarted_this_pass = True
+                if restarted_types is not None:
+                    restarted_types.add(rtype)
                 continue
 
             # updateJobReplicaStatuses (reference status.go:253-262)
@@ -582,14 +605,12 @@ class JobEngine:
             raise
 
     def _replica_port(self, spec: common.ReplicaSpec) -> int:
-        """Port from the framework container's named port (reference
-        util.go:29-42 / engine GetPortFromJob)."""
-        c = objects.find_container(spec.template, self.adapter.CONTAINER_NAME)
-        if c is not None:
-            p = objects.find_port(c, self.adapter.PORT_NAME)
-            if p:
-                return p
-        return self.adapter.DEFAULT_PORT
+        return objects.replica_port(
+            spec.template,
+            self.adapter.CONTAINER_NAME,
+            self.adapter.PORT_NAME,
+            self.adapter.DEFAULT_PORT,
+        )
 
     # ----------------------------------------------------------- run policy
     def _delete_pods_and_services(

@@ -199,6 +199,19 @@ def find_port(container: Dict[str, Any], port_name: str) -> Optional[int]:
     return None
 
 
+def replica_port(
+    template: Dict[str, Any], container_name: str, port_name: str, default: int
+) -> int:
+    """Port of the named port on the framework container, else `default`
+    (reference GetPortFromTFJob util.go:29-42 and per-framework copies)."""
+    c = find_container(template, container_name)
+    if c is not None:
+        p = find_port(c, port_name)
+        if p:
+            return p
+    return default
+
+
 def set_env(container: Dict[str, Any], name: str, value: str) -> None:
     """Idempotently set an env var on a container dict."""
     env = container.setdefault("env", [])

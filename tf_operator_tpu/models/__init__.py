@@ -1,0 +1,3 @@
+from tf_operator_tpu.models import resnet, mnist
+
+__all__ = ["resnet", "mnist"]

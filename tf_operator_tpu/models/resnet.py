@@ -1,0 +1,101 @@
+"""ResNet family (flax) — the benchmark ladder's config #3/#4 workhorse
+(BASELINE.md: ResNet-50 images/sec/chip is the headline metric; the
+reference's example is examples/v1/dist-mnist + distribution_strategy
+ResNet variants, which run inside containers the operator schedules).
+
+TPU-first choices: NHWC layout (XLA's native conv layout on TPU), bf16
+compute with f32 params/batch-stats, no data-dependent control flow, large
+fused convs that tile onto the MXU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(self.norm, dtype=self.dtype)
+
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), self.strides, name="conv2")(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        # zero-init final BN scale: residual branch starts as identity
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = norm(name="bn_proj")(residual)
+
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+        )
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype, name="conv_init",
+        )(x)
+        x = norm(dtype=self.dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    features=self.width * 2**i,
+                    strides=strides,
+                    dtype=self.dtype,
+                    norm=norm,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2])  # basic-block depths reused as bottlenecks for simplicity at this size
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3])
+
+
+def flops_per_image(image_size: int = 224) -> float:
+    """Approximate fwd FLOPs for ResNet-50 at the given resolution (4.1
+    GFLOPs at 224); train step ~= 3x fwd."""
+    return 4.1e9 * (image_size / 224.0) ** 2

@@ -1,0 +1,214 @@
+"""Transformer family — BERT-style encoders and decoder LMs (benchmark
+ladder configs #4 BERT-large and #5 T5-3B, BASELINE.md).
+
+TPU-first: bf16 compute/f32 params, static shapes, einsum-shaped matmuls
+that tile onto the MXU, Megatron-style tensor parallelism expressed as
+sharding rules over param paths (parallel/tp.py) with XLA inserting the
+tp collectives; attention is pluggable so ops/flash_attention.py (pallas)
+or ops/ring_attention.py (sequence parallel) can replace the reference
+einsum path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32128
+    d_model: int = 1024
+    n_heads: int = 16
+    n_layers: int = 24
+    d_ff: int = 4096
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    causal: bool = False  # False: encoder (BERT); True: decoder LM
+    tie_embeddings: bool = True
+    # attention impl: None -> reference einsum; or a callable
+    # (q, k, v, causal) -> out supplied by ops/
+    attention_fn: Optional[Callable] = None
+    remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
+    # MoE: replace the MLP with a mixture of experts every `moe_every` blocks
+    n_experts: int = 0
+    moe_every: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_large(**kw) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=30522, d_model=1024, n_heads=16, n_layers=24,
+        d_ff=4096, max_len=512, causal=False, **kw,
+    )
+
+
+def t5_3b_decoder(**kw) -> TransformerConfig:
+    """Decoder-LM stand-in at T5-3B scale (config #5)."""
+    return TransformerConfig(
+        vocab_size=32128, d_model=2048, n_heads=32, n_layers=48,
+        d_ff=8192, max_len=512, causal=True, **kw,
+    )
+
+
+def tiny(**kw) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_len=64, **kw,
+    )
+
+
+def dot_product_attention(q, k, v, causal: bool) -> jax.Array:
+    """Reference attention path: [B, S, H, D] einsums. Replaced by the
+    pallas flash kernel on TPU (ops/flash_attention.py)."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class MultiHeadAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = functools.partial(
+            nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
+        )
+        # fused qkv: one big MXU matmul, [B,S,E] -> [B,S,3,H,D]
+        qkv = dense(features=(3, cfg.n_heads, cfg.head_dim), name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = cfg.attention_fn or dot_product_attention
+        out = attn(q, k, v, cfg.causal)
+        return dense(
+            features=cfg.d_model, axis=(-2, -1), name="out"
+        )(out)
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="wi")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, use_bias=False, name="wo")(h)
+
+
+class MoeMlp(nn.Module):
+    """Mixture-of-experts MLP: top-1 switch routing, experts sharded over the
+    'ep' mesh axis (parallel/tp.py rules). Dense einsum dispatch keeps shapes
+    static for XLA (capacity = tokens; no dropping) — idiomatic for moderate
+    expert counts on TPU."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        n_e = cfg.n_experts
+        router = nn.Dense(n_e, dtype=jnp.float32, use_bias=False, name="router")
+        logits = router(x.astype(jnp.float32))  # [B,S,E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [B,S]
+        gate = jnp.max(probs, axis=-1)  # [B,S]
+        onehot = jax.nn.one_hot(expert_idx, n_e, dtype=cfg.dtype)  # [B,S,E]
+
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (n_e, d, cfg.d_ff), jnp.float32
+        ).astype(cfg.dtype)
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (n_e, cfg.d_ff, d), jnp.float32
+        ).astype(cfg.dtype)
+        # dense dispatch: every token through its expert via masked einsum
+        h = jnp.einsum("bsd,edf->bsef", x, wi)
+        h = nn.gelu(h)
+        out = jnp.einsum("bsef,efd->bsed", h, wo)
+        out = jnp.einsum("bsed,bse->bsd", out, onehot)
+        # auxiliary load-balancing loss (Switch Transformer)
+        density = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))  # [E]
+        router_mean = jnp.mean(probs, axis=(0, 1))  # [E]
+        aux = n_e * jnp.sum(density * router_mean)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return out * gate[..., None].astype(cfg.dtype)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = functools.partial(nn.LayerNorm, dtype=cfg.dtype, use_bias=False)
+        x = x + MultiHeadAttention(cfg, name="attn")(ln(name="ln1")(x))
+        mlp = MoeMlp(cfg, name="moe") if self.use_moe else Mlp(cfg, name="mlp")
+        return x + mlp(ln(name="ln2")(x))
+
+
+class Transformer(nn.Module):
+    """Encoder (BERT-style, causal=False) or decoder LM (causal=True); token
+    logits out — MLM/CLM heads share this body."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            dtype=cfg.dtype, name="embed",
+        )
+        pos_embed = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.d_model),
+            jnp.float32,
+        )
+        x = embed(tokens) + pos_embed[None, : tokens.shape[1]].astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        for i in range(cfg.n_layers):
+            use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
+            x = block(cfg, use_moe=use_moe, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, use_bias=False, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head"
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token loss for causal LMs; masked positions = all (simple CLM)."""
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    onehot = jax.nn.one_hot(targets, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+
+def params_flops_per_token(cfg: TransformerConfig) -> float:
+    """~6 * params FLOPs/token for a train step (fwd+bwd)."""
+    p = (
+        cfg.vocab_size * cfg.d_model
+        + cfg.n_layers
+        * (4 * cfg.d_model * cfg.d_model + 2 * cfg.d_model * cfg.d_ff)
+    )
+    return 6.0 * p

@@ -1,0 +1,8 @@
+from tf_operator_tpu.parallel.mesh import (
+    MeshRules,
+    make_mesh,
+    named_sharding,
+    DEFAULT_RULES,
+)
+
+__all__ = ["MeshRules", "make_mesh", "named_sharding", "DEFAULT_RULES"]

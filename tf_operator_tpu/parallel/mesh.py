@@ -1,0 +1,121 @@
+"""Device-mesh construction + logical sharding rules.
+
+The TPU-native replacement for the reference's topology bookkeeping: where
+the reference renders a TF_CONFIG peer list and lets gRPC sort it out
+(reference tensorflow.go:85-139), a TPU job builds a `jax.sharding.Mesh`
+over the slice and annotates arrays with logical axes; XLA inserts the
+collectives, which ride ICI within a slice and DCN across slices.
+
+Axes (any may be size 1 and is then effectively disabled):
+  dp    — data parallel (batch split; gradient psum)
+  fsdp  — fully-sharded data parallel (batch split + param/optimizer shard)
+  tp    — tensor parallel (embed/heads/mlp split; activation collectives)
+  pp    — pipeline parallel (layer stages; ppermute microbatch handoff)
+  ep    — expert parallel (MoE experts split; all_to_all dispatch)
+`sp` (sequence/context parallel for ring attention) reuses the `tp` axis on
+the mesh — sequence shards live where attention heads live, so ring
+ppermutes stay intra-slice (see ops/ring_attention.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "tp")
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over `devices` (default: all). Missing axes get size 1;
+    at most one axis may be -1 (inferred). Axis order puts tp innermost so
+    tensor-parallel collectives map to the fastest ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {})
+    sizes = {name: axes.get(name, 1) for name in AXIS_ORDER}
+    infer = [k for k, v in sizes.items() if v == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if infer:
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[infer[0]] = n // known
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(
+            f"mesh axes {sizes} require {total} devices, have {n}"
+        )
+    shape = tuple(sizes[name] for name in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+@dataclass(frozen=True)
+class MeshRules:
+    """Logical-axis -> mesh-axis mapping (flax 'logical axis rules' idea,
+    kept framework-free). Model code annotates arrays with logical names;
+    the trainer resolves them against the active mesh."""
+
+    rules: Tuple[Tuple[str, Union[str, Tuple[str, ...], None]], ...] = ()
+
+    def mesh_axes(self, logical: Optional[str]):
+        if logical is None:
+            return None
+        for name, target in self.rules:
+            if name == logical:
+                return target
+        return None
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> P:
+        return P(*(self.mesh_axes(a) for a in logical_axes))
+
+    def with_rule(self, name: str, target) -> "MeshRules":
+        kept = tuple((n, t) for n, t in self.rules if n != name)
+        return MeshRules(rules=kept + ((name, target),))
+
+
+DEFAULT_RULES = MeshRules(
+    rules=(
+        ("batch", ("dp", "fsdp")),  # batch split over all data axes
+        ("embed", "tp"),
+        ("heads", "tp"),
+        ("kv", None),
+        ("mlp", "tp"),
+        ("vocab", "tp"),
+        ("seq", None),         # activations: sequence unsharded by default
+        ("seq_sp", "tp"),      # ring-attention sequence sharding rides tp
+        ("expert", "ep"),
+        ("stage", "pp"),
+        ("params_fsdp", "fsdp"),
+    )
+)
+
+
+def named_sharding(
+    mesh: Mesh, logical_axes: Sequence[Optional[str]], rules: MeshRules = DEFAULT_RULES
+) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def batch_sharding(mesh: Mesh, rules: MeshRules = DEFAULT_RULES) -> NamedSharding:
+    """Inputs: batch dim split over (dp, fsdp), rest replicated."""
+    return NamedSharding(mesh, P(rules.mesh_axes("batch")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_mesh_axes(n_devices: int, prefer_tp: int = 1) -> Dict[str, int]:
+    """A reasonable default mesh for n devices: tp as requested (clamped to
+    a divisor), rest data parallel."""
+    tp = math.gcd(prefer_tp, n_devices) if prefer_tp > 1 else 1
+    return {"tp": tp, "dp": n_devices // tp}

@@ -1,0 +1,111 @@
+"""Tensor-parallel (Megatron-style) + expert + fsdp param placement for the
+transformer family.
+
+Column-parallel qkv/wi (shard the output features over tp), row-parallel
+out/wo (shard the input features over tp) — XLA then inserts exactly one
+all-reduce per attention/MLP block over the tp axis of the mesh (ICI).
+Experts shard over ep; everything else optionally overlays fsdp on its
+largest free dim. No reference counterpart: the reference operator never
+touches tensors (SURVEY.md §2.10 TP row: absent).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+def _overlay_fsdp(spec_list, shape, fsdp: int, min_size: int):
+    if fsdp <= 1:
+        return spec_list
+    size = 1
+    for d in shape:
+        size *= d
+    if size < min_size:
+        return spec_list
+    dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
+    for d in dims:
+        if spec_list[d] is None and shape[d] % fsdp == 0:
+            spec_list[d] = "fsdp"
+            break
+    return spec_list
+
+
+def transformer_param_sharding(
+    params: Any, mesh: Mesh, min_fsdp_size: int = 2**14
+) -> Any:
+    """Pytree of NamedSharding matching `params` (from models/transformer.py)."""
+    tp = mesh.shape.get("tp", 1)
+    ep = mesh.shape.get("ep", 1)
+    fsdp = mesh.shape.get("fsdp", 1)
+
+    def place(path, x) -> NamedSharding:
+        name = _path_str(path)
+        shape = getattr(x, "shape", ())
+        spec = [None] * len(shape)
+
+        def ok(dim, axis_size):
+            return dim < len(shape) and shape[dim] % axis_size == 0
+
+        if tp > 1:
+            if name.endswith("qkv/kernel") and ok(2, tp):
+                spec[2] = "tp"  # [E, 3, H, D]: shard heads
+            elif "attn/out/kernel" in name and ok(0, tp):
+                spec[0] = "tp"  # [H, D, E]: row-parallel
+            elif name.endswith("mlp/wi/kernel") and ok(1, tp):
+                spec[1] = "tp"  # [E, F]: column-parallel
+            elif name.endswith("mlp/wo/kernel") and ok(0, tp):
+                spec[0] = "tp"  # [F, E]: row-parallel
+            elif name.endswith("embed/embedding") and ok(0, tp):
+                spec[0] = "tp"  # vocab-parallel embedding
+            elif name.endswith("lm_head/kernel") and ok(1, tp):
+                spec[1] = "tp"
+            elif name.endswith("moe/wi") and ok(2, tp):
+                spec[2] = "tp"  # [X, D, F]
+            elif name.endswith("moe/wo") and ok(1, tp):
+                spec[1] = "tp"  # [X, F, D]
+        if ep > 1 and ("moe/wi" in name or "moe/wo" in name) and ok(0, ep):
+            spec[0] = "ep"  # experts over ep
+        spec = _overlay_fsdp(spec, shape, fsdp, min_fsdp_size)
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def state_sharding(state, mesh: Mesh, param_fn=transformer_param_sharding):
+    """Sharding for a TrainState: params + mirrored opt_state, scalars
+    replicated."""
+    params_sh = param_fn(state.params, mesh)
+
+    # optax states mirror the param tree where shapes match (momenta etc.);
+    # shard those like their params, replicate scalars/counts
+    flat_params = jax.tree.leaves_with_path(state.params)
+    by_shape = {}
+    for path, leaf in flat_params:
+        by_shape.setdefault(getattr(leaf, "shape", ()), []).append(path)
+    params_sh_flat = {tuple(p): s for p, s in jax.tree.leaves_with_path(params_sh)}
+
+    def place_opt(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if not shape:
+            return NamedSharding(mesh, P())
+        cands = by_shape.get(shape)
+        if cands:
+            return params_sh_flat[tuple(cands[0])]
+        return NamedSharding(mesh, P())
+
+    opt_sh = jax.tree_util.tree_map_with_path(place_opt, state.opt_state)
+    bs_sh = jax.tree.map(lambda x: NamedSharding(mesh, P()), state.batch_stats)
+    return state.replace(
+        step=NamedSharding(mesh, P()),
+        params=params_sh,
+        opt_state=opt_sh,
+        batch_stats=bs_sh,
+    )

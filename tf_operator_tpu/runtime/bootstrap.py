@@ -1,0 +1,95 @@
+"""In-container runtime bootstrap — the consumer side of the env contract.
+
+The TPUJob controller injects COORDINATOR_ADDRESS / NUM_PROCESSES /
+PROCESS_ID / TPU_* / MEGASCALE_* (controllers/tpu.py set_cluster_spec —
+the TPU analogue of the TF_CONFIG the reference's containers read, SURVEY.md
+§3.4). This module reads them back, initializes jax.distributed for
+multi-host slices, and builds the device mesh. The e2e suite asserts this
+round-trip the way the reference's estimator_runconfig_tests.py asserts
+TF_CONFIG -> RunConfig.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from tf_operator_tpu.parallel.mesh import make_mesh
+
+
+@dataclass
+class SliceInfo:
+    """Parsed topology env for this host."""
+
+    coordinator_address: Optional[str] = None
+    megascale_coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    worker_id: int = 0
+    worker_hostnames: tuple = ()
+    accelerator_type: str = ""
+    slice_id: int = 0
+    num_slices: int = 1
+    hosts_per_slice: int = 1
+    total_hosts: int = 1
+    topology: str = ""
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1 or self.num_slices > 1
+
+
+def slice_info_from_env(env: Optional[Dict[str, str]] = None) -> SliceInfo:
+    e = env if env is not None else os.environ
+    hostnames = tuple(h for h in e.get("TPU_WORKER_HOSTNAMES", "").split(",") if h)
+    return SliceInfo(
+        coordinator_address=e.get("COORDINATOR_ADDRESS") or None,
+        megascale_coordinator_address=e.get("MEGASCALE_COORDINATOR_ADDRESS") or None,
+        num_processes=int(e.get("NUM_PROCESSES", "1")),
+        process_id=int(e.get("PROCESS_ID", "0")),
+        worker_id=int(e.get("TPU_WORKER_ID", "0")),
+        worker_hostnames=hostnames,
+        accelerator_type=e.get("TPU_ACCELERATOR_TYPE", ""),
+        slice_id=int(e.get("TPU_SLICE_ID", "0")),
+        num_slices=int(e.get("TPU_NUM_SLICES", e.get("MEGASCALE_NUM_SLICES", "1"))),
+        hosts_per_slice=int(e.get("TPU_HOSTS_PER_SLICE", "1")),
+        total_hosts=int(e.get("TPU_TOTAL_HOSTS", "1")),
+        topology=e.get("TPU_TOPOLOGY", ""),
+    )
+
+
+_initialized = False
+
+
+def initialize(env: Optional[Dict[str, str]] = None) -> SliceInfo:
+    """Initialize jax.distributed from the injected env (idempotent).
+    Single-process jobs skip distributed init entirely."""
+    global _initialized
+    info = slice_info_from_env(env)
+    if info.is_distributed and not _initialized:
+        import jax
+
+        if info.num_slices > 1:
+            # multislice: jax.distributed is GLOBAL across all slices —
+            # one coordinator (slice 0, host 0 = MEGASCALE address), global
+            # process count/id; the MEGASCALE_* env separately tells libtpu
+            # the slice topology for ICI-vs-DCN routing
+            coordinator = info.megascale_coordinator_address
+            num_processes = info.total_hosts
+            process_id = info.slice_id * info.hosts_per_slice + info.process_id
+        else:
+            coordinator = info.coordinator_address
+            num_processes = info.num_processes
+            process_id = info.process_id
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+    return info
+
+
+def default_mesh(axes: Optional[Dict[str, int]] = None):
+    """Mesh over all (global) devices; call after initialize()."""
+    return make_mesh(axes=axes)

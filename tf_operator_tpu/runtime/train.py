@@ -1,0 +1,208 @@
+"""Training runtime — sharded train/eval steps + checkpointing.
+
+The compute path the reference leaves to in-container TF (SURVEY.md §3.4
+'in-pod training bootstrap'), built TPU-first: one jitted SPMD train step
+over a `jax.sharding.Mesh`; params replicated across dp and sharded over
+fsdp; batches sharded over (dp, fsdp); XLA inserts the gradient psum over
+ICI. Checkpoint/resume uses orbax (the operator recreates pods with stable
+identity so the runtime can restore — SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core as flax_core
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.parallel.mesh import DEFAULT_RULES, MeshRules
+
+
+class TrainState(struct.PyTreeNode):
+    """Minimal train state: params + opt state + optional batch stats."""
+
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    batch_stats: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads, new_batch_stats=None):
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=(
+                new_batch_stats if new_batch_stats is not None else self.batch_stats
+            ),
+        )
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    sample_input: jax.Array,
+    tx: optax.GradientTransformation,
+) -> TrainState:
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", flax_core.FrozenDict())
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        batch_stats=batch_stats,
+        tx=tx,
+    )
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    onehot = jax.nn.one_hot(labels, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+
+def fsdp_param_sharding(params, mesh: Mesh, min_size: int = 2**14):
+    """Shard each large param along its largest fsdp-divisible dim; small
+    params replicate. The standard fsdp placement — params live sharded in
+    HBM, XLA all-gathers just-in-time per layer."""
+    fsdp = mesh.shape.get("fsdp", 1)
+
+    def place(x):
+        if fsdp > 1 and hasattr(x, "shape") and x.size >= min_size:
+            dims = sorted(
+                range(x.ndim), key=lambda d: x.shape[d], reverse=True
+            )
+            for d in dims:
+                if x.shape[d] % fsdp == 0:
+                    spec = [None] * x.ndim
+                    spec[d] = "fsdp"
+                    return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(place, params)
+
+
+def make_train_step(
+    model,
+    loss_fn: Callable = cross_entropy_loss,
+    has_batch_stats: bool = True,
+    rules: MeshRules = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+):
+    """Build the jitted SPMD train step: (state, images, labels) ->
+    (state, metrics). Everything inside is traced once; no python branching
+    on data."""
+
+    def step(state: TrainState, images: jax.Array, labels: jax.Array):
+        if mesh is not None:
+            batch_spec = P(rules.mesh_axes("batch"))
+            images = jax.lax.with_sharding_constraint(
+                images, NamedSharding(mesh, batch_spec)
+            )
+
+        def compute_loss(params):
+            variables = {"params": params}
+            if has_batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                logits, updates = model.apply(
+                    variables, images, train=True, mutable=["batch_stats"]
+                )
+                return loss_fn(logits, labels), (logits, updates["batch_stats"])
+            logits = model.apply(variables, images, train=True)
+            return loss_fn(logits, labels), (logits, None)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return new_state, {"loss": loss, "accuracy": accuracy}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_eval_step(model, has_batch_stats: bool = True):
+    def step(state: TrainState, images: jax.Array, labels: jax.Array):
+        variables = {"params": state.params}
+        if has_batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, images, train=False)
+        return {
+            "loss": cross_entropy_loss(logits, labels),
+            "accuracy": jnp.mean(jnp.argmax(logits, -1) == labels),
+        }
+
+    return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing (orbax) — SURVEY.md §5.4: resume = pod recreation with stable
+# identity + framework-side restore; this is the framework side.
+# ---------------------------------------------------------------------------
+
+
+class Checkpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: TrainState) -> None:
+        payload = {
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "batch_stats": state.batch_stats,
+        }
+        self.mngr.save(step, args=self._ocp.args.StandardSave(payload))
+        self.mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.mngr.latest_step()
+
+    def restore(self, state: TrainState, step: Optional[int] = None) -> TrainState:
+        step = step if step is not None else self.mngr.latest_step()
+        if step is None:
+            return state
+        payload = {
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "batch_stats": state.batch_stats,
+        }
+        restored = self.mngr.restore(
+            step, args=self._ocp.args.StandardRestore(payload)
+        )
+        return state.replace(**restored)
+
+
+@dataclass
+class StepTimer:
+    """Steps/sec + images/sec bookkeeping for bench + progress logs."""
+
+    batch_size: int
+    warmup: int = 2
+    _t0: float = 0.0
+    _steps: int = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+        self._steps = 0
+
+    def tick(self) -> None:
+        self._steps += 1
+
+    def images_per_sec(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._steps * self.batch_size / dt if dt > 0 else 0.0
