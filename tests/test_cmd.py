@@ -1,0 +1,193 @@
+"""Operator process layer: flags, manager dispatch, leader election,
+health/metrics endpoints (reference SURVEY.md §2.4)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.cmd.health import HealthServer
+from tf_operator_tpu.cmd.leader import LeaderElector
+from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.options import ServerOptions, parse_args
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+from tests import testutil
+
+
+# ---------------------------------------------------------------- options
+
+
+def test_parse_args_defaults_match_reference():
+    o = parse_args([])
+    assert o.threadiness == 1
+    assert o.resync_period == 12 * 3600.0
+    assert o.qps == 5.0 and o.burst == 10
+    assert not o.enable_gang_scheduling
+    assert o.gang_scheduler_name == "volcano"
+    assert o.metrics_bind_address == ":8080"
+    assert o.health_probe_bind_address == ":8081"
+    # empty --enable-scheme means all kinds
+    assert set(o.all_kinds) == {"TFJob", "PyTorchJob", "MXJob", "XGBoostJob", "TPUJob"}
+
+
+def test_parse_args_enable_scheme_case_insensitive_and_validating():
+    o = parse_args(["--enable-scheme", "tfjob", "--enable-scheme", "PyTorchJob"])
+    assert o.all_kinds == ["TFJob", "PyTorchJob"]
+    with pytest.raises(ValueError):
+        parse_args(["--enable-scheme", "CaffeJob"])
+
+
+# ---------------------------------------------------------------- manager
+
+
+def manager_for(kinds=("TFJob",), **opt_kwargs):
+    cluster = FakeCluster()
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(list(kinds)), resync_period=0, **opt_kwargs
+    )
+    mgr = OperatorManager(cluster, opts)
+    mgr.factory.start_all()
+    return cluster, mgr
+
+
+def test_manager_reconciles_job_end_to_end():
+    cluster, mgr = manager_for()
+    job = testutil.new_tfjob(worker=2)
+    cluster.create(job.kind, job.to_dict())
+    mgr.process_until_idle()
+    pods = cluster.list_pods()
+    assert len(pods) == 2
+    # pod running -> event routed via ownerRef -> status becomes Running
+    for p in pods:
+        p["status"]["phase"] = objects.POD_RUNNING
+        cluster.update_pod(p)
+    mgr.process_until_idle()
+    stored = cluster.get("TFJob", "default", job.name)
+    conds = [c["type"] for c in stored["status"]["conditions"]]
+    assert "Running" in conds
+
+
+def test_manager_threaded_workers_drive_job():
+    cluster, mgr = manager_for(threadiness=2)
+    mgr.start()
+    assert mgr.ready
+    job = testutil.new_tfjob(worker=1)
+    cluster.create(job.kind, job.to_dict())
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not cluster.list_pods():
+        time.sleep(0.01)
+    assert len(cluster.list_pods()) == 1
+    mgr.stop()
+
+
+def test_manager_namespace_scoping():
+    cluster, mgr = manager_for(namespace="team-a")
+    job = testutil.new_tfjob(worker=1, namespace="team-b")
+    cluster.create(job.kind, job.to_dict())
+    mgr.process_until_idle()
+    assert cluster.list_pods() == []
+
+
+def test_manager_counts_job_metrics():
+    metrics.JOBS_CREATED.reset()
+    metrics.JOBS_DELETED.reset()
+    cluster, mgr = manager_for()
+    job = testutil.new_tfjob(worker=1)
+    cluster.create(job.kind, job.to_dict())
+    mgr.process_until_idle()
+    assert metrics.JOBS_CREATED.get({"job_namespace": "default"}) == 1
+    cluster.delete(job.kind, "default", job.name)
+    assert metrics.JOBS_DELETED.get({"job_namespace": "default"}) == 1
+
+
+def test_manager_dependent_event_requeues_owner_only_for_known_kind():
+    cluster, mgr = manager_for()
+    # a pod owned by an unknown kind must not crash routing
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "stray",
+            "namespace": "default",
+            "ownerReferences": [
+                {"kind": "ReplicaSet", "name": "rs", "controller": True}
+            ],
+        },
+        "status": {"phase": "Running"},
+    }
+    cluster.create_pod(pod)
+    mgr.process_until_idle()
+
+
+# ---------------------------------------------------------------- leader
+
+
+def test_leader_election_single_holder_and_failover():
+    cluster = FakeCluster()
+    a_started, b_started = [], []
+    a = LeaderElector(
+        cluster, "a", lease_duration=0.3, renew_deadline=0.05, retry_period=0.02,
+        on_started_leading=lambda: a_started.append(1),
+    )
+    b = LeaderElector(
+        cluster, "b", lease_duration=0.3, renew_deadline=0.05, retry_period=0.02,
+        on_started_leading=lambda: b_started.append(1),
+    )
+    a.start()
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not a.is_leader:
+        time.sleep(0.01)
+    assert a.is_leader and a_started
+    b.start()
+    time.sleep(0.15)
+    assert not b.is_leader  # lease held by a
+    a.stop()  # releases
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not b.is_leader:
+        time.sleep(0.01)
+    assert b.is_leader and b_started
+    b.stop()
+
+
+def test_leader_election_sets_gauge():
+    cluster = FakeCluster()
+    e = LeaderElector(cluster, "x", lease_duration=0.3, renew_deadline=0.05,
+                      retry_period=0.02)
+    e.start()
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not e.is_leader:
+        time.sleep(0.01)
+    assert metrics.IS_LEADER.get() == 1
+    e.stop()
+    assert metrics.IS_LEADER.get() == 0
+
+
+# ---------------------------------------------------------------- health
+
+
+def test_health_server_endpoints():
+    ready = {"v": False}
+    srv = HealthServer(healthz=lambda: True, readyz=lambda: ready["v"])
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(base + path) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, ""
+
+    assert get("/healthz")[0] == 200
+    assert get("/readyz")[0] == 500
+    ready["v"] = True
+    assert get("/readyz")[0] == 200
+    status, body = get("/metrics")
+    assert status == 200
+    assert "tpu_operator_jobs_created_total" in body
+    assert get("/nope")[0] == 404
+    srv.stop()

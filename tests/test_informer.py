@@ -1,0 +1,141 @@
+"""Workqueue + informer semantics (client-go contract the reference's
+correctness rests on: one worker per key, dedup, rate-limited requeue,
+real AddAfter — SURVEY.md §2.5/§2.9)."""
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.k8s.fake import FakeCluster
+from tf_operator_tpu.k8s.informer import (
+    ItemExponentialFailureRateLimiter,
+    Lister,
+    RateLimitingQueue,
+    ResourceEventHandler,
+    SharedIndexInformer,
+    SharedInformerFactory,
+)
+
+
+def make_obj(name, ns="default", labels=None):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+    }
+
+
+# ---------------------------------------------------------------- queue
+
+
+def test_queue_dedups_pending_items():
+    q = RateLimitingQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+
+
+def test_queue_readds_item_dirtied_while_processing():
+    q = RateLimitingQueue()
+    q.add("a")
+    item = q.get()
+    assert item == "a"
+    q.add("a")  # dirtied mid-processing
+    assert len(q) == 0  # not delivered to a second worker
+    q.done("a")
+    assert q.get(timeout=0.5) == "a"  # re-delivered exactly once
+    q.done("a")
+    assert q.get(timeout=0) is None
+
+
+def test_queue_add_after_fires():
+    q = RateLimitingQueue()
+    q.add_after("x", 0.05)
+    assert q.get(timeout=0) is None
+    assert q.get(timeout=1.0) == "x"
+
+
+def test_queue_rate_limiter_backoff_and_forget():
+    rl = ItemExponentialFailureRateLimiter(base_delay=0.01, max_delay=1.0)
+    assert rl.when("k") == pytest.approx(0.01)
+    assert rl.when("k") == pytest.approx(0.02)
+    assert rl.when("k") == pytest.approx(0.04)
+    assert rl.num_requeues("k") == 3
+    rl.forget("k")
+    assert rl.when("k") == pytest.approx(0.01)
+
+
+def test_queue_shutdown_unblocks_getters():
+    q = RateLimitingQueue()
+    got = []
+
+    def worker():
+        got.append(q.get())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    q.shut_down()
+    t.join(timeout=1)
+    assert got == [None]
+
+
+# ---------------------------------------------------------------- informer
+
+
+def test_informer_initial_sync_and_events():
+    cluster = FakeCluster()
+    cluster.create("TFJob", make_obj("pre"))
+    inf = SharedIndexInformer(cluster, "TFJob")
+    seen = []
+    inf.add_event_handler(
+        ResourceEventHandler(
+            add_func=lambda o: seen.append(("add", o["metadata"]["name"])),
+            update_func=lambda old, new: seen.append(("upd", new["metadata"]["name"])),
+            delete_func=lambda o: seen.append(("del", o["metadata"]["name"])),
+        )
+    )
+    inf.start()
+    assert inf.has_synced()
+    assert ("add", "pre") in seen
+
+    cluster.create("TFJob", make_obj("live"))
+    obj = cluster.get("TFJob", "default", "live")
+    cluster.update("TFJob", obj)
+    cluster.delete("TFJob", "default", "live")
+    assert ("add", "live") in seen
+    assert ("upd", "live") in seen
+    assert ("del", "live") in seen
+
+
+def test_lister_reads_cache_with_selector():
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "TFJob")
+    inf.start()
+    cluster.create("TFJob", make_obj("a", labels={"team": "x"}))
+    cluster.create("TFJob", make_obj("b", labels={"team": "y"}))
+    lister = Lister(inf)
+    assert lister.get("default", "a")["metadata"]["name"] == "a"
+    assert [o["metadata"]["name"] for o in lister.list(selector={"team": "y"})] == ["b"]
+
+
+def test_informer_resync_redelivers_updates():
+    cluster = FakeCluster()
+    cluster.create("TFJob", make_obj("a"))
+    inf = SharedIndexInformer(cluster, "TFJob")
+    updates = []
+    inf.add_event_handler(
+        ResourceEventHandler(update_func=lambda o, n: updates.append(n["metadata"]["name"]))
+    )
+    inf.start()
+    inf.resync_once()
+    assert updates == ["a"]
+
+
+def test_factory_shares_informers():
+    cluster = FakeCluster()
+    f = SharedInformerFactory(cluster)
+    assert f.for_kind("TFJob") is f.for_kind("TFJob")
+    f.start_all()
+    assert f.wait_for_cache_sync(timeout=1)

@@ -1,0 +1,2 @@
+"""Operator process layer: CLI flags, manager, leader election, probes
+(reference cmd/training-operator.v1 + cmd/tf-operator.v1 — SURVEY.md §2.4)."""

@@ -1,0 +1,79 @@
+"""Health probes + metrics endpoints.
+
+Reference parity: /healthz and /readyz on the probe address (reference
+cmd/training-operator.v1/main.go:110-117, probed by the Deployment at
+manifests/base/deployment.yaml:35-45) and the Prometheus exposition on the
+metrics address (main.go:63, legacy --monitoring-port options.go:75-77).
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from tf_operator_tpu.engine import metrics
+
+Check = Callable[[], bool]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    checks: Dict[str, Check] = {}
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    def do_GET(self):  # noqa: N802 (stdlib API name)
+        path = self.path.split("?")[0]
+        if path == "/metrics":
+            body = metrics.expose_all().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        check = self.checks.get(path)
+        if check is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        ok = False
+        try:
+            ok = check()
+        except Exception:
+            ok = False
+        self.send_response(200 if ok else 500)
+        self.send_header("Content-Type", "text/plain")
+        self.end_headers()
+        self.wfile.write(b"ok" if ok else b"unhealthy")
+
+
+class HealthServer:
+    """Serves /healthz, /readyz, and /metrics on one listener. Bind with
+    port 0 to get an ephemeral port (tests read .port after start)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        healthz: Optional[Check] = None,
+        readyz: Optional[Check] = None,
+    ) -> None:
+        handler = type("Handler", (_Handler,), {})
+        handler.checks = {
+            "/healthz": healthz or (lambda: True),
+            "/readyz": readyz or (lambda: True),
+        }
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

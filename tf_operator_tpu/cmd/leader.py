@@ -1,0 +1,140 @@
+"""Leader election over a Lease record in the cluster store.
+
+The reference's legacy stack runs Endpoints-lock leader election with lease
+15s / renew 5s / retry 3s and flips a `tf_operator_is_leader` gauge
+(reference cmd/tf-operator.v1/app/server.go:54-59,64-69,147-193). This is
+the same state machine over a coordination.k8s.io/Lease-shaped object
+(Endpoints locks are deprecated upstream; Lease is the modern lock), with
+the timings configurable so tests run in milliseconds.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from tf_operator_tpu.engine.metrics import IS_LEADER
+from tf_operator_tpu.k8s.fake import ApiError
+
+LEASE_KIND = "Lease"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        cluster,
+        identity: str,
+        lock_name: str = "tpu-operator",
+        namespace: str = "default",
+        lease_duration: float = 15.0,
+        renew_deadline: float = 5.0,
+        retry_period: float = 3.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> None:
+        if renew_deadline >= lease_duration:
+            raise ValueError("renew_deadline must be < lease_duration")
+        self.cluster = cluster
+        self.identity = identity
+        self.lock_name = lock_name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lock ops
+    def _get_lease(self) -> Optional[Dict[str, Any]]:
+        try:
+            return self.cluster.get(LEASE_KIND, self.namespace, self.lock_name)
+        except ApiError:
+            return None
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        lease = self._get_lease()
+        record = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": self.lease_duration,
+            "renewTime": now,
+        }
+        if lease is None:
+            try:
+                self.cluster.create(
+                    LEASE_KIND,
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": LEASE_KIND,
+                        "metadata": {"name": self.lock_name, "namespace": self.namespace},
+                        "spec": record,
+                    },
+                )
+                return True
+            except ApiError:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        expired = now > spec.get("renewTime", 0) + spec.get(
+            "leaseDurationSeconds", self.lease_duration
+        )
+        if holder != self.identity and not expired:
+            return False
+        lease["spec"] = record
+        try:
+            self.cluster.update(LEASE_KIND, lease)
+            return True
+        except ApiError:
+            return False
+
+    def release(self) -> None:
+        """Voluntarily give up the lease so a standby can take over without
+        waiting out the lease duration."""
+        lease = self._get_lease()
+        if lease and lease.get("spec", {}).get("holderIdentity") == self.identity:
+            lease["spec"]["renewTime"] = 0
+            try:
+                self.cluster.update(LEASE_KIND, lease)
+            except ApiError:
+                pass
+
+    # ------------------------------------------------------------- run loop
+    def run(self) -> None:
+        """Blocking acquire -> renew loop; returns when stopped or when
+        leadership is lost (reference semantics: OnStoppedLeading exits the
+        process, server.go:186-190)."""
+        # acquire
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                break
+            self._stop.wait(self.retry_period)
+        if self._stop.is_set():
+            return
+        self.is_leader = True
+        IS_LEADER.set(1)
+        if self.on_started_leading:
+            self.on_started_leading()
+        # renew
+        while not self._stop.wait(self.renew_deadline):
+            if not self._try_acquire_or_renew():
+                break
+        self.is_leader = False
+        IS_LEADER.set(0)
+        if self.on_stopped_leading:
+            self.on_stopped_leading()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self, release: bool = True) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if release and self.is_leader:
+            self.is_leader = False
+            IS_LEADER.set(0)
+            self.release()

@@ -1,0 +1,100 @@
+"""Operator entrypoint: `python -m tf_operator_tpu.cmd.main [flags]`.
+
+Startup order mirrors the reference (legacy server.go:72-196 + new-stack
+main.go:58-124): parse flags -> print version -> configure logging ->
+build cluster client -> health/metrics servers -> (leader election ->)
+manager start -> block until signal.
+
+The cluster backend is pluggable: with --kubeconfig pointing at a real
+cluster a kubernetes-client-backed ClusterClient would be used; without
+one (dev, tests, single-node) the in-memory FakeCluster serves as a fully
+functional standalone state store.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from tf_operator_tpu import version
+from tf_operator_tpu.cmd.health import HealthServer
+from tf_operator_tpu.cmd.leader import LeaderElector
+from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.options import ServerOptions, parse_args
+from tf_operator_tpu.k8s.fake import FakeCluster
+from tf_operator_tpu.utils import logging as ulog
+
+# reference pkg/common/constants.go:4-5
+NAMESPACE_ENV = "KUBEFLOW_NAMESPACE"
+
+
+def build_cluster(options: ServerOptions):
+    # Real-apiserver client would be selected here by --kubeconfig; the
+    # in-memory store is the standalone backend.
+    return FakeCluster()
+
+
+def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorManager:
+    ulog.configure(json_format=options.json_log_format)
+    log = ulog.logger_with({"component": "main"})
+    log.info(version.version_string())
+
+    if not options.namespace:
+        options.namespace = os.environ.get(NAMESPACE_ENV, "")
+
+    cluster = cluster if cluster is not None else build_cluster(options)
+    manager = OperatorManager(cluster, options)
+
+    health_host, _, health_port = options.health_probe_bind_address.rpartition(":")
+    probe = HealthServer(
+        host=health_host or "0.0.0.0",
+        port=int(health_port),
+        healthz=lambda: manager.healthy,
+        readyz=lambda: manager.ready,
+    )
+    probe.start()
+    log.info("health probes on :%d", probe.port)
+
+    stop_event = threading.Event()
+
+    def start_manager():
+        manager.start()
+        log.info("manager started: kinds=%s", list(manager.controllers))
+
+    if options.leader_elect:
+        elector = LeaderElector(
+            cluster,
+            identity=f"{os.uname().nodename}-{os.getpid()}",
+            lock_name=options.leader_election_id,
+            namespace=options.namespace or "default",
+            on_started_leading=start_manager,
+            on_stopped_leading=stop_event.set,
+        )
+        elector.start()
+    else:
+        start_manager()
+
+    if block:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop_event.set())
+        stop_event.wait()
+        manager.stop()
+        probe.stop()
+    else:
+        manager._probe = probe  # keep a handle for the caller to stop
+    return manager
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    options = parse_args(argv)
+    if options.print_version:
+        print(version.version_string())
+        return 0
+    run(options)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+"""OperatorManager — the controller-runtime Manager equivalent.
+
+Wires, per enabled job kind: a SharedIndexInformer, a RateLimitingQueue, a
+JobEngine, and `threadiness` worker threads popping keys and reconciling
+(the reference's two stacks merged: controller-runtime manager dispatch
+cmd/training-operator.v1/main.go:78-120 + the legacy workqueue worker loop
+pkg/controller.v1/tensorflow/controller.go:193-286).
+
+Pod/Service events are resolved to their controlling job via ownerReference
+and enqueued on the owning kind's queue (reference AddPod/UpdatePod/
+DeletePod informer handlers, controller.go:158-177); expectation
+observation itself happens inside the engine's cluster subscription.
+
+ReconcileResult.requeue_after lands on queue.add_after — the real
+ActiveDeadlineSeconds path the reference's new stack silently dropped
+(FakeWorkQueue, SURVEY.md §7.4.6).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import make_engine
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.controller import EngineConfig
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import ApiError, NotFoundError
+from tf_operator_tpu.k8s.informer import (
+    Lister,
+    RateLimitingQueue,
+    ResourceEventHandler,
+    SharedIndexInformer,
+    SharedInformerFactory,
+)
+from tf_operator_tpu.utils.logging import logger_for_key
+
+MAX_RECONCILE_RETRIES = 15
+
+
+class _KindController:
+    """Queue + informer + engine + workers for one job kind."""
+
+    def __init__(self, manager: "OperatorManager", kind: str) -> None:
+        self.manager = manager
+        self.kind = kind
+        self.engine = make_engine(
+            kind,
+            manager.cluster,
+            config=EngineConfig(
+                enable_gang_scheduling=manager.options.enable_gang_scheduling,
+                gang_scheduler_name=manager.options.gang_scheduler_name,
+            ),
+        )
+        self.queue = RateLimitingQueue()
+        self.informer = manager.factory.for_kind(kind)
+        self.lister = Lister(self.informer)
+        self.informer.add_event_handler(
+            ResourceEventHandler(
+                add_func=self._on_add,
+                update_func=self._on_update,
+                delete_func=self._on_delete,
+            )
+        )
+        self.workers: List[threading.Thread] = []
+
+    # ------------------------------------------------------------- handlers
+    def _in_scope(self, obj) -> bool:
+        ns = self.manager.options.namespace
+        return not ns or objects.namespace_of(obj) == ns
+
+    # job-created/-deleted counters are incremented by the engine (the
+    # reference increments on the Created condition / DeleteJob path, not in
+    # the informer handlers: job.go:30-37, controller.go:70-77)
+    def _on_add(self, obj) -> None:
+        if self._in_scope(obj):
+            self.enqueue(objects.key_of(obj))
+
+    def _on_update(self, old, new) -> None:
+        if self._in_scope(new):
+            self.enqueue(objects.key_of(new))
+
+    def _on_delete(self, obj) -> None:
+        if self._in_scope(obj):
+            metrics.JOBS_DELETED.inc({"job_namespace": objects.namespace_of(obj)})
+            self.enqueue(objects.key_of(obj))
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    # ------------------------------------------------------------- work loop
+    def _sync(self, key: str) -> None:
+        namespace, _, name = key.partition("/")
+        log = logger_for_key(self.kind, key)
+        t0 = time.monotonic()
+        try:
+            raw = self.manager.cluster.get(self.kind, namespace, name)
+        except NotFoundError:
+            self.queue.forget(key)
+            return  # deleted; nothing to reconcile
+        job = self.engine.adapter.from_dict(raw)
+        result = self.engine.reconcile(job)
+        metrics.RECONCILE_LATENCY.inc(
+            {"kind": self.kind}, amount=time.monotonic() - t0
+        )
+        if result.error:
+            if self.queue.num_requeues(key) < MAX_RECONCILE_RETRIES:
+                log.warning("reconcile error, requeueing: %s", result.error)
+                self.queue.add_rate_limited(key)
+            else:
+                log.error("reconcile retries exhausted: %s", result.error)
+                self.queue.forget(key)
+            return
+        self.queue.forget(key)
+        if result.requeue_after is not None:
+            self.queue.add_after(key, result.requeue_after)
+
+    def run_worker(self) -> None:
+        while True:
+            key = self.queue.get()
+            if key is None:
+                return
+            try:
+                self._sync(key)
+            except Exception as e:  # noqa: BLE001 — workers must not die
+                logger_for_key(self.kind, key).error("sync panic: %s", e)
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+
+    def start_workers(self, n: int) -> None:
+        for i in range(n):
+            t = threading.Thread(
+                target=self.run_worker, name=f"{self.kind}-worker-{i}", daemon=True
+            )
+            t.start()
+            self.workers.append(t)
+
+
+class OperatorManager:
+    def __init__(self, cluster, options: Optional[ServerOptions] = None) -> None:
+        self.cluster = cluster
+        self.options = options or ServerOptions()
+        self.factory = SharedInformerFactory(
+            cluster, resync_period=self.options.resync_period
+        )
+        self.controllers: Dict[str, _KindController] = {}
+        for kind in self.options.all_kinds:
+            self.controllers[kind] = _KindController(self, kind)
+        # dependent informers: one Pod + one Service informer shared by all
+        for dep_kind in ("Pod", "Service"):
+            inf = self.factory.for_kind(dep_kind)
+            inf.add_event_handler(
+                ResourceEventHandler(
+                    add_func=self._on_dependent,
+                    update_func=lambda old, new: self._on_dependent(new),
+                    delete_func=self._on_dependent,
+                )
+            )
+        self._started = False
+
+    # ------------------------------------------------------------- dependents
+    def _on_dependent(self, obj) -> None:
+        """Route a Pod/Service event to its controlling job's queue."""
+        ref = objects.get_controller_of(obj)
+        if not ref:
+            return
+        ctl = self.controllers.get(ref.get("kind", ""))
+        if ctl is None:
+            return
+        key = f"{objects.namespace_of(obj)}/{ref.get('name', '')}"
+        ctl.enqueue(key)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Start informers, wait for cache sync, start workers (reference
+        Run: WaitForCacheSync -> N x wait.Until(runWorker),
+        controller.go:193-218)."""
+        self.factory.start_all()
+        if not self.factory.wait_for_cache_sync():
+            raise RuntimeError("informer caches failed to sync")
+        for ctl in self.controllers.values():
+            ctl.start_workers(self.options.threadiness)
+        self._started = True
+
+    def stop(self) -> None:
+        for ctl in self.controllers.values():
+            ctl.queue.shut_down()
+        self.factory.stop_all()
+        for ctl in self.controllers.values():
+            for t in ctl.workers:
+                t.join(timeout=2)
+        self._started = False
+
+    @property
+    def healthy(self) -> bool:
+        return True
+
+    @property
+    def ready(self) -> bool:
+        return self._started and all(
+            i.has_synced() for i in self.factory._informers.values()
+        )
+
+    # ------------------------------------------------------------- test mode
+    def process_until_idle(self, timeout: float = 10.0) -> None:
+        """Deterministically drain all queues without worker threads —
+        the test-mode dispatch (timers from add_after still apply)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            busy = False
+            for ctl in self.controllers.values():
+                key = ctl.queue.get(timeout=0)
+                if key is None:
+                    continue
+                busy = True
+                try:
+                    ctl._sync(key)
+                finally:
+                    ctl.queue.done(key)
+            if not busy:
+                if all(len(c.queue) == 0 for c in self.controllers.values()):
+                    return
+                time.sleep(0.002)
+        raise TimeoutError("queues did not drain")

@@ -1,0 +1,93 @@
+"""Operator CLI flags.
+
+Union of the reference's two flag sets (legacy
+cmd/tf-operator.v1/app/options/options.go:53-83 and new-stack
+cmd/training-operator.v1/main.go:63-69), normalized: the legacy
+`--resyc-period` typo is fixed, and gang scheduling / scheme gating are
+shared across all kinds.
+"""
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS, EnabledSchemes
+
+
+@dataclass
+class ServerOptions:
+    namespace: str = ""  # "" = all namespaces (reference options.go:57-62)
+    threadiness: int = 1
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = "volcano"
+    resync_period: float = 12 * 3600.0
+    qps: float = 5.0
+    burst: int = 10
+    json_log_format: bool = True
+    metrics_bind_address: str = ":8080"
+    health_probe_bind_address: str = ":8081"
+    leader_elect: bool = False
+    leader_election_id: str = "tpu-operator-lock"
+    enabled_schemes: EnabledSchemes = field(default_factory=EnabledSchemes)
+    kubeconfig: str = ""
+    print_version: bool = False
+
+    @property
+    def all_kinds(self) -> List[str]:
+        if self.enabled_schemes.empty():
+            self.enabled_schemes.fill_all()
+        return list(self.enabled_schemes.kinds)
+
+
+def _addr(spec: str) -> tuple:
+    host, _, port = spec.rpartition(":")
+    return (host or "0.0.0.0", int(port))
+
+
+def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
+    p = argparse.ArgumentParser(prog="tpu-operator")
+    p.add_argument("--namespace", default="", help="namespace to scope to; empty = all")
+    p.add_argument("--threadiness", type=int, default=1)
+    p.add_argument("--enable-gang-scheduling", action="store_true")
+    p.add_argument("--gang-scheduler-name", default="volcano")
+    p.add_argument("--resync-period", type=float, default=12 * 3600.0)
+    p.add_argument("--qps", type=float, default=5.0)
+    p.add_argument("--burst", type=int, default=10)
+    p.add_argument("--json-log-format", action="store_true", default=True)
+    p.add_argument("--metrics-bind-address", default=":8080")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--leader-election-id", default="tpu-operator-lock")
+    p.add_argument(
+        "--enable-scheme",
+        action="append",
+        default=[],
+        metavar="KIND",
+        help=f"enable a job kind (repeatable); default all: {sorted(SUPPORTED_ADAPTERS)}",
+    )
+    p.add_argument("--kubeconfig", default="")
+    p.add_argument("--version", action="store_true", dest="print_version")
+    a = p.parse_args(argv)
+
+    schemes = EnabledSchemes()
+    for kind in a.enable_scheme:
+        schemes.set(kind)  # raises ValueError on unknown kind
+
+    return ServerOptions(
+        namespace=a.namespace,
+        threadiness=a.threadiness,
+        enable_gang_scheduling=a.enable_gang_scheduling,
+        gang_scheduler_name=a.gang_scheduler_name,
+        resync_period=a.resync_period,
+        qps=a.qps,
+        burst=a.burst,
+        json_log_format=a.json_log_format,
+        metrics_bind_address=a.metrics_bind_address,
+        health_probe_bind_address=a.health_probe_bind_address,
+        leader_elect=a.leader_elect,
+        leader_election_id=a.leader_election_id,
+        enabled_schemes=schemes,
+        kubeconfig=a.kubeconfig,
+        print_version=a.print_version,
+    )

@@ -654,8 +654,9 @@ class JobEngine:
         remaining = expire_at - self.clock()
         if remaining <= 0:
             try:
+                # jobs_deleted_total is counted by the manager's informer
+                # delete handler so user deletes and TTL deletes tally once
                 self.cluster.delete(self.adapter.KIND, job.namespace, job.name)
-                metrics.JOBS_DELETED.inc({"job_namespace": job.namespace})
             except Exception:
                 pass
             return ReconcileResult()

@@ -197,11 +197,37 @@ class Transformer(nn.Module):
 
 
 def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Next-token loss for causal LMs; masked positions = all (simple CLM)."""
-    logits = logits[:, :-1]
-    targets = tokens[:, 1:]
-    onehot = jax.nn.one_hot(targets, logits.shape[-1])
-    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+    """Next-token loss for causal LMs; masked positions = all (simple CLM).
+    Integer-label CE — no [B, S, vocab] one-hot temporary in the hot path."""
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]
+    ).mean()
+
+
+# Switch Transformer aux-loss weight (paper default 1e-2)
+MOE_AUX_WEIGHT = 0.01
+
+
+def apply_with_aux(model, params, tokens, train: bool = True):
+    """Forward pass that collects sown MoE load-balancing losses.
+    Returns (logits, total_aux) — total_aux is 0 for dense models."""
+    logits, mut = model.apply(
+        {"params": params}, tokens, train=train, mutable=["intermediates"]
+    )
+    aux = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(mut.get("intermediates", {})):
+        aux = aux + jnp.sum(leaf)
+    return logits, aux
+
+
+def lm_train_loss(model, params, tokens) -> jax.Array:
+    """CLM loss + weighted MoE load-balancing aux — the loss train steps
+    should differentiate (plain lm_loss would silently drop the router
+    balancing term for MoE configs)."""
+    logits, aux = apply_with_aux(model, params, tokens, train=True)
+    return lm_loss(logits, tokens) + MOE_AUX_WEIGHT * aux
 
 
 def params_flops_per_token(cfg: TransformerConfig) -> float:

@@ -114,6 +114,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def pick_fsdp_dim(
+    shape: Sequence[int],
+    fsdp: int,
+    min_size: int = 2**14,
+    taken: Sequence[int] = (),
+) -> Optional[int]:
+    """The single fsdp placement rule: for a param of `shape`, return the
+    largest fsdp-divisible dim not already sharded (`taken`), or None for
+    params below `min_size` (those replicate). Shared by the generic fsdp
+    placement (runtime/train.py) and the transformer tp/ep overlay
+    (parallel/tp.py) so the heuristic cannot diverge."""
+    if fsdp <= 1 or not shape or math.prod(shape) < min_size:
+        return None
+    for d in sorted(range(len(shape)), key=lambda d: shape[d], reverse=True):
+        if d not in taken and shape[d] % fsdp == 0:
+            return d
+    return None
+
+
 def local_mesh_axes(n_devices: int, prefer_tp: int = 1) -> Dict[str, int]:
     """A reasonable default mesh for n devices: tp as requested (clamped to
     a divisor), rest data parallel."""

@@ -23,18 +23,12 @@ def _path_str(path) -> str:
 
 
 def _overlay_fsdp(spec_list, shape, fsdp: int, min_size: int):
-    if fsdp <= 1:
-        return spec_list
-    size = 1
-    for d in shape:
-        size *= d
-    if size < min_size:
-        return spec_list
-    dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
-    for d in dims:
-        if spec_list[d] is None and shape[d] % fsdp == 0:
-            spec_list[d] = "fsdp"
-            break
+    from tf_operator_tpu.parallel.mesh import pick_fsdp_dim
+
+    taken = tuple(d for d, s in enumerate(spec_list) if s is not None)
+    d = pick_fsdp_dim(shape, fsdp, min_size, taken=taken)
+    if d is not None:
+        spec_list[d] = "fsdp"
     return spec_list
 
 

@@ -64,26 +64,25 @@ def create_train_state(
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    onehot = jax.nn.one_hot(labels, logits.shape[-1])
-    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+    # integer-label CE: no [B, ..., vocab] one-hot temporary in the hot path
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
 def fsdp_param_sharding(params, mesh: Mesh, min_size: int = 2**14):
     """Shard each large param along its largest fsdp-divisible dim; small
     params replicate. The standard fsdp placement — params live sharded in
     HBM, XLA all-gathers just-in-time per layer."""
+    from tf_operator_tpu.parallel.mesh import pick_fsdp_dim
+
     fsdp = mesh.shape.get("fsdp", 1)
 
     def place(x):
-        if fsdp > 1 and hasattr(x, "shape") and x.size >= min_size:
-            dims = sorted(
-                range(x.ndim), key=lambda d: x.shape[d], reverse=True
-            )
-            for d in dims:
-                if x.shape[d] % fsdp == 0:
-                    spec = [None] * x.ndim
-                    spec[d] = "fsdp"
-                    return NamedSharding(mesh, P(*spec))
+        shape = getattr(x, "shape", ())
+        d = pick_fsdp_dim(shape, fsdp, min_size)
+        if d is not None:
+            spec = [None] * len(shape)
+            spec[d] = "fsdp"
+            return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P())
 
     return jax.tree.map(place, params)

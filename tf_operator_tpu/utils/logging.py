@@ -1,0 +1,69 @@
+"""Structured per-job contextual loggers.
+
+Reference kubeflow/common pkg/util LoggerForJob / LoggerForReplica /
+LoggerForPod / LoggerForKey (used at every reconcile step, e.g. reference
+status.go:76). JSON output honors the legacy `--json-log-format` flag
+(options.go:69-70).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, Dict, Optional
+
+_root = logging.getLogger("tpu_operator")
+_configured = False
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry: Dict[str, Any] = {
+            "level": record.levelname.lower(),
+            "msg": record.getMessage(),
+            "time": self.formatTime(record, "%Y-%m-%dT%H:%M:%SZ"),
+            "logger": record.name,
+        }
+        entry.update(getattr(record, "ctx", {}) or {})
+        return json.dumps(entry)
+
+
+def configure(json_format: bool = True, level: int = logging.INFO) -> None:
+    global _configured
+    handler = logging.StreamHandler(sys.stderr)
+    if json_format:
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+    _root.handlers[:] = [handler]
+    _root.setLevel(level)
+    _configured = True
+
+
+class ContextLogger(logging.LoggerAdapter):
+    def process(self, msg, kwargs):
+        kwargs.setdefault("extra", {})["ctx"] = self.extra
+        return msg, kwargs
+
+
+def logger_with(ctx: Dict[str, Any]) -> ContextLogger:
+    return ContextLogger(_root, ctx)
+
+
+def logger_for_job(job) -> ContextLogger:
+    return logger_with(
+        {"job": f"{job.namespace}.{job.name}", "kind": getattr(job, "kind", "")}
+    )
+
+
+def logger_for_replica(job, rtype: str, index: Optional[int] = None) -> ContextLogger:
+    ctx = {"job": f"{job.namespace}.{job.name}", "replica-type": rtype}
+    if index is not None:
+        ctx["replica-index"] = index
+    return logger_with(ctx)
+
+
+def logger_for_key(kind: str, key: str) -> ContextLogger:
+    return logger_with({"kind": kind, "key": key})

@@ -1,0 +1,30 @@
+"""Version info printed at operator startup (reference
+pkg/version/version.go:21-24: Version + GitSHA)."""
+from __future__ import annotations
+
+import subprocess
+
+VERSION = "0.1.0"
+_git_sha_cache: str | None = None
+
+
+def git_sha() -> str:
+    global _git_sha_cache
+    if _git_sha_cache is None:
+        try:
+            _git_sha_cache = (
+                subprocess.run(
+                    ["git", "rev-parse", "--short", "HEAD"],
+                    capture_output=True,
+                    text=True,
+                    timeout=5,
+                ).stdout.strip()
+                or "unknown"
+            )
+        except Exception:
+            _git_sha_cache = "unknown"
+    return _git_sha_cache
+
+
+def version_string() -> str:
+    return f"tpu-operator {VERSION} (git {git_sha()})"
