@@ -166,6 +166,27 @@ def test_leader_election_sets_gauge():
     assert metrics.IS_LEADER.get() == 0
 
 
+def test_update_conflict_prevents_split_brain():
+    """Two electors racing on the same expired lease: the CAS (resourceVersion
+    precondition in FakeCluster.update) lets exactly one win."""
+    from tf_operator_tpu.k8s.fake import ConflictError
+
+    cluster = FakeCluster()
+    cluster.create("Lease", {"kind": "Lease",
+                             "metadata": {"name": "l", "namespace": "default"},
+                             "spec": {"holderIdentity": "old", "renewTime": 0,
+                                      "leaseDurationSeconds": 0.1}})
+    # both read the same stale copy
+    a_copy = cluster.get("Lease", "default", "l")
+    b_copy = cluster.get("Lease", "default", "l")
+    a_copy["spec"]["holderIdentity"] = "a"
+    cluster.update("Lease", a_copy)
+    b_copy["spec"]["holderIdentity"] = "b"
+    with pytest.raises(ConflictError):
+        cluster.update("Lease", b_copy)
+    assert cluster.get("Lease", "default", "l")["spec"]["holderIdentity"] == "a"
+
+
 # ---------------------------------------------------------------- health
 
 

@@ -1,0 +1,330 @@
+"""E2E scenario suites — the reference's 8 Python e2e modules (SURVEY.md
+§4.4) run hermetically: real threaded manager + FakeKubelet running real
+HTTP test-servers per pod, driven through the SDK JobClient.
+
+simple_tfjob_tests.py:26        -> test_simple_tfjob_completes
+distributed_training_tests.py   -> test_distributed_training
+estimator_runconfig_tests.py:26 -> test_runconfig_per_replica
+shutdown_policy_tests.py:25     -> test_shutdown_policy_{chief,worker0}
+cleanpod_policy_tests.py        -> test_cleanpod_{all,running,none}
+replica_restart_policy_tests.py -> test_restart_policy_*
+pod_names_validation_tests.py   -> test_pod_names
+invalid_tfjob_tests.py          -> test_invalid_tfjob
+sdk test_e2e.py                 -> test_sdk_round_trip (in test_sdk.py)
+"""
+import json
+import time
+
+import pytest
+
+from tf_operator_tpu.api import common, tensorflow as tfapi
+from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.e2e.kubelet import FakeKubelet
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import FakeCluster, NotFoundError
+from tf_operator_tpu.sdk.client import TFJobClient
+
+from tests import testutil
+
+
+@pytest.fixture()
+def harness():
+    cluster = FakeCluster()
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]), resync_period=0, threadiness=2
+    )
+    mgr = OperatorManager(cluster, opts)
+    mgr.start()
+    kubelet = FakeKubelet(cluster)
+    client = TFJobClient(cluster)
+    yield cluster, mgr, kubelet, client
+    kubelet.stop_all()
+    mgr.stop()
+
+
+def wait_for(pred, what, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"timeout waiting for {what}")
+
+
+def wait_pods_running(kubelet, client, job_name, n, timeout=10.0):
+    wait_for(
+        lambda: len(client.get_pod_names(job_name)) == n, f"{n} pods", timeout
+    )
+    for name in sorted(client.get_pod_names(job_name)):
+        kubelet.wait_running("default", name, timeout)
+
+
+# ---------------------------------------------------------------- simple
+
+
+def test_simple_tfjob_completes(harness):
+    cluster, mgr, kubelet, client = harness
+    job = testutil.new_tfjob("simple", worker=1)
+    client.create(job)
+    client.wait_for_condition("simple", ["Running"])
+    wait_pods_running(kubelet, client, "simple", 1)
+    kubelet.terminate_replica("default", "simple-worker-0", 0)
+    assert client.wait_for_job("simple")["status"]["conditions"][-1]["type"] == "Succeeded"
+    assert client.is_job_succeeded("simple")
+    # no pod/service creation-failure events (reference tf_job_client.py:363-400)
+    assert cluster.events_for("simple", "Warning") == []
+
+
+# ---------------------------------------------------------------- distributed
+
+
+def test_distributed_training(harness):
+    cluster, mgr, kubelet, client = harness
+    job = testutil.new_tfjob("dist", worker=4, ps=2)
+    client.create(job)
+    client.wait_for_condition("dist", ["Running"])
+    wait_pods_running(kubelet, client, "dist", 6)
+    # all workers complete; worker-0 rule marks the job Succeeded
+    for i in range(4):
+        kubelet.terminate_replica("default", f"dist-worker-{i}", 0)
+    assert client.wait_for_job("dist", timeout=15)
+    assert client.is_job_succeeded("dist")
+    # CleanPodPolicy default Running: the still-running PS pods are removed
+    wait_for(
+        lambda: client.get_pod_names("dist", replica_type="ps") == set(),
+        "PS cleanup",
+    )
+
+
+# ---------------------------------------------------------------- runconfig
+
+
+def test_runconfig_per_replica(harness):
+    """The injected cluster spec, seen from inside each replica, matches the
+    expected topology (reference estimator_runconfig_tests.py:26-100)."""
+    cluster, mgr, kubelet, client = harness
+    job = testutil.new_tfjob("rc", worker=2, ps=1, chief=1)
+    client.create(job)
+    wait_pods_running(kubelet, client, "rc", 4)
+
+    expected_cluster = {
+        "chief": ["rc-chief-0.default.svc:2222"],
+        "ps": ["rc-ps-0.default.svc:2222"],
+        "worker": ["rc-worker-0.default.svc:2222", "rc-worker-1.default.svc:2222"],
+    }
+    for rtype, index, is_chief in (
+        ("chief", 0, True),
+        ("ps", 0, False),
+        ("worker", 0, False),
+        ("worker", 1, False),
+    ):
+        rc = kubelet.http_get("default", f"rc-{rtype}-{index}", "/runconfig")
+        assert rc["cluster_spec"] == expected_cluster, rc
+        assert rc["task_type"] == rtype and rc["task_id"] == index
+        assert rc["is_chief"] == is_chief
+        assert rc["environment"] == "cloud"
+        assert rc["num_ps_replicas"] == 1 and rc["num_worker_replicas"] == 2
+
+
+# ---------------------------------------------------------------- shutdown
+
+
+def test_shutdown_policy_chief_is_chief(harness):
+    """Chief completion defines success even while workers run
+    (reference shutdown_policy_tests.py master_is_chief)."""
+    cluster, mgr, kubelet, client = harness
+    job = testutil.new_tfjob("sd-chief", worker=2, chief=1)
+    client.create(job)
+    wait_pods_running(kubelet, client, "sd-chief", 3)
+    kubelet.terminate_replica("default", "sd-chief-chief-0", 0)
+    client.wait_for_job("sd-chief")
+    assert client.is_job_succeeded("sd-chief")
+
+
+def test_shutdown_policy_worker0_is_chief(harness):
+    """No chief: worker-0 completion defines success (worker0_is_chief)."""
+    cluster, mgr, kubelet, client = harness
+    job = testutil.new_tfjob("sd-w0", worker=3)
+    client.create(job)
+    wait_pods_running(kubelet, client, "sd-w0", 3)
+    kubelet.terminate_replica("default", "sd-w0-worker-0", 0)
+    client.wait_for_job("sd-w0")
+    assert client.is_job_succeeded("sd-w0")
+
+
+# ---------------------------------------------------------------- cleanpod
+
+
+def _complete_all_workers(kubelet, client, name, n):
+    for i in range(n):
+        kubelet.terminate_replica("default", f"{name}-worker-{i}", 0)
+
+
+def _cleanpod_job(name, policy):
+    job = testutil.new_tfjob(name, worker=1, ps=1)
+    job.run_policy.clean_pod_policy = policy
+    return job
+
+
+def test_cleanpod_policy_all(harness):
+    cluster, mgr, kubelet, client = harness
+    client.create(_cleanpod_job("cp-all", common.CLEAN_POD_POLICY_ALL))
+    wait_pods_running(kubelet, client, "cp-all", 2)
+    _complete_all_workers(kubelet, client, "cp-all", 1)
+    client.wait_for_job("cp-all")
+    wait_for(lambda: client.get_pod_names("cp-all") == set(), "all pods removed")
+
+
+def test_cleanpod_policy_running(harness):
+    cluster, mgr, kubelet, client = harness
+    client.create(_cleanpod_job("cp-run", common.CLEAN_POD_POLICY_RUNNING))
+    wait_pods_running(kubelet, client, "cp-run", 2)
+    _complete_all_workers(kubelet, client, "cp-run", 1)
+    client.wait_for_job("cp-run")
+    # running PS deleted; the succeeded worker pod is kept
+    wait_for(
+        lambda: client.get_pod_names("cp-run", replica_type="ps") == set(),
+        "running PS removed",
+    )
+    assert client.get_pod_names("cp-run", replica_type="worker") == {"cp-run-worker-0"}
+
+
+def test_cleanpod_policy_none(harness):
+    cluster, mgr, kubelet, client = harness
+    client.create(_cleanpod_job("cp-none", common.CLEAN_POD_POLICY_NONE))
+    wait_pods_running(kubelet, client, "cp-none", 2)
+    _complete_all_workers(kubelet, client, "cp-none", 1)
+    client.wait_for_job("cp-none")
+    time.sleep(0.2)
+    assert client.get_pod_names("cp-none") == {"cp-none-worker-0", "cp-none-ps-0"}
+
+
+# ---------------------------------------------------------------- restart
+
+
+def _job_with_restart_policy(name, policy):
+    job = testutil.new_tfjob(name, worker=1)
+    job.replica_specs[tfapi.REPLICA_WORKER].restart_policy = policy
+    return job
+
+
+def test_restart_policy_exitcode_retryable(harness):
+    """Exit 130 (>=128, retryable) under ExitCode: the operator deletes the
+    pod for recreation and the job keeps going (reference
+    replica_restart_policy_tests.py:28; pod_test.go:442)."""
+    cluster, mgr, kubelet, client = harness
+    client.create(_job_with_restart_policy("rp-retry", common.RESTART_POLICY_EXIT_CODE))
+    wait_pods_running(kubelet, client, "rp-retry", 1)
+    first_uid = cluster.get_pod("default", "rp-retry-worker-0")["metadata"]["uid"]
+    kubelet.terminate_replica("default", "rp-retry-worker-0", 130)
+    # pod recreated with a fresh uid
+    wait_for(
+        lambda: _pod_uid(cluster, "rp-retry-worker-0") not in (None, first_uid),
+        "pod recreated",
+    )
+    kubelet.wait_running("default", "rp-retry-worker-0")
+    conds = {c["type"] for c in client.get("rp-retry")["status"]["conditions"]}
+    assert "Restarting" in conds
+    # and it can still succeed afterwards
+    kubelet.terminate_replica("default", "rp-retry-worker-0", 0)
+    client.wait_for_job("rp-retry")
+    assert client.is_job_succeeded("rp-retry")
+
+
+def test_restart_policy_exitcode_permanent(harness):
+    """Exit 1 (1-127, permanent) under ExitCode fails the job."""
+    cluster, mgr, kubelet, client = harness
+    client.create(_job_with_restart_policy("rp-perm", common.RESTART_POLICY_EXIT_CODE))
+    wait_pods_running(kubelet, client, "rp-perm", 1)
+    kubelet.terminate_replica("default", "rp-perm-worker-0", 1)
+    client.wait_for_job("rp-perm")
+    assert client.get_job_status("rp-perm") == "Failed"
+
+
+def test_restart_policy_onfailure_kubelet_restarts(harness):
+    """OnFailure is delegated to the kubelet: same pod, restartCount++."""
+    cluster, mgr, kubelet, client = harness
+    client.create(_job_with_restart_policy("rp-onf", common.RESTART_POLICY_ON_FAILURE))
+    wait_pods_running(kubelet, client, "rp-onf", 1)
+    uid = cluster.get_pod("default", "rp-onf-worker-0")["metadata"]["uid"]
+    kubelet.terminate_replica("default", "rp-onf-worker-0", 7)
+    wait_for(
+        lambda: (
+            cluster.get_pod("default", "rp-onf-worker-0")["status"]
+            .get("containerStatuses", [{}])[0]
+            .get("restartCount", 0)
+            == 1
+        ),
+        "kubelet restart",
+    )
+    assert cluster.get_pod("default", "rp-onf-worker-0")["metadata"]["uid"] == uid
+    kubelet.wait_running("default", "rp-onf-worker-0")
+    kubelet.terminate_replica("default", "rp-onf-worker-0", 0)
+    client.wait_for_job("rp-onf")
+    assert client.is_job_succeeded("rp-onf")
+
+
+def test_restart_policy_never_fails_job(harness):
+    cluster, mgr, kubelet, client = harness
+    client.create(_job_with_restart_policy("rp-never", common.RESTART_POLICY_NEVER))
+    wait_pods_running(kubelet, client, "rp-never", 1)
+    kubelet.terminate_replica("default", "rp-never-worker-0", 3)
+    client.wait_for_job("rp-never")
+    assert client.get_job_status("rp-never") == "Failed"
+
+
+def _pod_uid(cluster, name):
+    try:
+        return cluster.get_pod("default", name)["metadata"]["uid"]
+    except NotFoundError:
+        return None
+
+
+# ---------------------------------------------------------------- naming
+
+
+def test_pod_names(harness):
+    """{job}-{replica-type}-{index} naming contract (reference
+    pod_names_validation_tests.py)."""
+    cluster, mgr, kubelet, client = harness
+    client.create(testutil.new_tfjob("names", worker=2, ps=1))
+    wait_for(lambda: len(client.get_pod_names("names")) == 3, "pods")
+    assert client.get_pod_names("names") == {
+        "names-worker-0",
+        "names-worker-1",
+        "names-ps-0",
+    }
+    assert client.get_pod_names("names", replica_type="worker", replica_index=1) == {
+        "names-worker-1"
+    }
+    svc_names = {objects.name_of(s) for s in cluster.list_services()}
+    assert svc_names == {"names-worker-0", "names-worker-1", "names-ps-0"}
+
+
+# ---------------------------------------------------------------- invalid
+
+
+def test_invalid_tfjob(harness):
+    """Invalid spec -> Failed condition, no pods created (reference
+    invalid_tfjob_tests.py)."""
+    cluster, mgr, kubelet, client = harness
+    bad = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": "bad", "namespace": "default"},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": 1,
+                    # no container named "tensorflow" -> validation error
+                    "template": {"spec": {"containers": [{"name": "main", "image": "x"}]}},
+                }
+            }
+        },
+    }
+    client.create(bad)
+    client.wait_for_job("bad")
+    assert client.get_job_status("bad") == "Failed"
+    assert client.get_pod_names("bad") == set()

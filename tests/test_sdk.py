@@ -1,0 +1,101 @@
+"""SDK JobClient tests (reference sdk/python/kubeflow/tfjob — SURVEY.md
+§2.6; round-trip scenario mirrors sdk/python/test/test_e2e.py)."""
+import time
+
+import pytest
+
+from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.e2e.kubelet import FakeKubelet
+from tf_operator_tpu.k8s.fake import FakeCluster, NotFoundError
+from tf_operator_tpu.sdk.client import JobClient, TFJobClient, TimeoutError_
+
+from tests import testutil
+
+
+@pytest.fixture()
+def client():
+    return TFJobClient(FakeCluster())
+
+
+def test_create_get_delete_round_trip(client):
+    job = testutil.new_tfjob("t1", worker=1)
+    created = client.create(job)
+    assert created["metadata"]["name"] == "t1"
+    fetched = client.get("t1")
+    assert fetched["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 1
+    assert [j["metadata"]["name"] for j in client.get()] == ["t1"]
+    client.delete("t1")
+    with pytest.raises(NotFoundError):
+        client.get("t1")
+
+
+def test_patch_deep_merges(client):
+    client.create(testutil.new_tfjob("t2", worker=2))
+    client.patch("t2", {"spec": {"tfReplicaSpecs": {"Worker": {"replicas": 4}}}})
+    job = client.get("t2")
+    assert job["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 4
+    # untouched fields survive the merge
+    assert job["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"]["containers"]
+
+
+def test_job_status_helpers(client):
+    client.create(testutil.new_tfjob("t3", worker=1))
+    assert client.get_job_status("t3") == ""
+    client.patch(
+        "t3",
+        {
+            "status": {
+                "conditions": [
+                    {"type": "Created", "status": "True"},
+                    {"type": "Running", "status": "True"},
+                ]
+            }
+        },
+    )
+    assert client.get_job_status("t3") == "Running"
+    assert client.is_job_running("t3")
+    assert not client.is_job_succeeded("t3")
+
+
+def test_wait_for_condition_timeout(client):
+    client.create(testutil.new_tfjob("t4", worker=1))
+    with pytest.raises(TimeoutError_):
+        client.wait_for_condition("t4", ["Succeeded"], timeout=0.1)
+
+
+def test_get_logs_requires_pods(client):
+    client.create(testutil.new_tfjob("t5", worker=1))
+    with pytest.raises(RuntimeError):
+        client.get_logs("t5")
+
+
+def test_sdk_round_trip_e2e():
+    """create -> wait Running -> get_logs -> delete -> wait deletion
+    (reference sdk/python/test/test_e2e.py)."""
+    cluster = FakeCluster()
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]), resync_period=0, threadiness=1
+    )
+    mgr = OperatorManager(cluster, opts)
+    mgr.start()
+    kubelet = FakeKubelet(cluster)
+    client = TFJobClient(cluster)
+    try:
+        client.create(testutil.new_tfjob("sdk-e2e", worker=1))
+        client.wait_for_condition("sdk-e2e", ["Running"])
+        kubelet.wait_running("default", "sdk-e2e-worker-0")
+        logs = client.get_logs("sdk-e2e")
+        assert "sdk-e2e-worker-0" in logs
+        assert "test-server listening" in logs["sdk-e2e-worker-0"]
+        # master filter: single-worker TF jobs label worker-0 as master
+        assert client.get_pod_names("sdk-e2e", master=True) == {"sdk-e2e-worker-0"}
+        kubelet.terminate_replica("default", "sdk-e2e-worker-0", 0)
+        client.wait_for_job("sdk-e2e")
+        assert client.is_job_succeeded("sdk-e2e")
+        client.delete("sdk-e2e")
+        client.wait_for_deletion("sdk-e2e")
+    finally:
+        kubelet.stop_all()
+        mgr.stop()

@@ -46,6 +46,7 @@ class LeaderElector:
         self.is_leader = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._release_on_stop = True
 
     # ------------------------------------------------------------- lock ops
     def _get_lease(self) -> Optional[Dict[str, Any]]:
@@ -121,8 +122,13 @@ class LeaderElector:
         while not self._stop.wait(self.renew_deadline):
             if not self._try_acquire_or_renew():
                 break
+        was_stopped = self._stop.is_set()
         self.is_leader = False
         IS_LEADER.set(0)
+        if was_stopped and self._release_on_stop:
+            # voluntary shutdown: release so a standby fails over immediately
+            # instead of waiting out lease_duration
+            self.release()
         if self.on_stopped_leading:
             self.on_stopped_leading()
 
@@ -131,10 +137,7 @@ class LeaderElector:
         self._thread.start()
 
     def stop(self, release: bool = True) -> None:
+        self._release_on_stop = release
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
-        if release and self.is_leader:
-            self.is_leader = False
-            IS_LEADER.set(0)
-            self.release()

@@ -22,7 +22,7 @@ from tf_operator_tpu import version
 from tf_operator_tpu.cmd.health import HealthServer
 from tf_operator_tpu.cmd.leader import LeaderElector
 from tf_operator_tpu.cmd.manager import OperatorManager
-from tf_operator_tpu.cmd.options import ServerOptions, parse_args
+from tf_operator_tpu.cmd.options import ServerOptions, parse_args, split_bind_address
 from tf_operator_tpu.k8s.fake import FakeCluster
 from tf_operator_tpu.utils import logging as ulog
 
@@ -47,15 +47,22 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
     cluster = cluster if cluster is not None else build_cluster(options)
     manager = OperatorManager(cluster, options)
 
-    health_host, _, health_port = options.health_probe_bind_address.rpartition(":")
+    health_host, health_port = split_bind_address(options.health_probe_bind_address)
     probe = HealthServer(
-        host=health_host or "0.0.0.0",
-        port=int(health_port),
+        host=health_host,
+        port=health_port,
         healthz=lambda: manager.healthy,
         readyz=lambda: manager.ready,
     )
     probe.start()
     log.info("health probes on :%d", probe.port)
+
+    # separate metrics listener (reference --metrics-bind-address :8080,
+    # main.go:63; the probe port also serves /metrics for convenience)
+    metrics_host, metrics_port = split_bind_address(options.metrics_bind_address)
+    metrics_srv = HealthServer(host=metrics_host, port=metrics_port)
+    metrics_srv.start()
+    log.info("metrics on :%d", metrics_srv.port)
 
     stop_event = threading.Event()
 
@@ -82,8 +89,11 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         stop_event.wait()
         manager.stop()
         probe.stop()
+        metrics_srv.stop()
     else:
-        manager._probe = probe  # keep a handle for the caller to stop
+        # keep handles for the caller to stop
+        manager._probe = probe
+        manager._metrics_srv = metrics_srv
     return manager
 
 

@@ -40,7 +40,9 @@ class ServerOptions:
         return list(self.enabled_schemes.kinds)
 
 
-def _addr(spec: str) -> tuple:
+def split_bind_address(spec: str) -> tuple:
+    """':8080' -> ('0.0.0.0', 8080); the single parsing rule for every
+    bind-address flag (used by cmd/main.py for probe + metrics listeners)."""
     host, _, port = spec.rpartition(":")
     return (host or "0.0.0.0", int(port))
 
@@ -54,7 +56,12 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
     p.add_argument("--resync-period", type=float, default=12 * 3600.0)
     p.add_argument("--qps", type=float, default=5.0)
     p.add_argument("--burst", type=int, default=10)
-    p.add_argument("--json-log-format", action="store_true", default=True)
+    p.add_argument(
+        "--json-log-format",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="JSON logs (default); --no-json-log-format for plain text",
+    )
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
     p.add_argument("--leader-elect", action="store_true")

@@ -1,0 +1,2 @@
+"""E2E test harness: in-process kubelet simulator + test-server + runner
+(reference py/kubeflow/tf_operator + test/test-server — SURVEY.md §2.7/§4.4)."""

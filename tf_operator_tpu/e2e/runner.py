@@ -1,0 +1,88 @@
+"""Retrying test runner with junit emission (reference
+py/kubeflow/tf_operator/test_runner.py:22-66: run_test retries up to 10
+times on infra flakes and writes junit XML for CI artifact collection)."""
+from __future__ import annotations
+
+import time
+import traceback
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass
+class TestCase:
+    name: str
+    time_s: float = 0.0
+    failure: Optional[str] = None
+
+    @property
+    def passed(self) -> bool:
+        return self.failure is None
+
+
+@dataclass
+class TestSuiteResult:
+    name: str
+    cases: List[TestCase] = field(default_factory=list)
+
+    @property
+    def failures(self) -> int:
+        return sum(1 for c in self.cases if not c.passed)
+
+    def to_junit_xml(self) -> str:
+        suite = ET.Element(
+            "testsuite",
+            name=self.name,
+            tests=str(len(self.cases)),
+            failures=str(self.failures),
+            time=f"{sum(c.time_s for c in self.cases):.3f}",
+        )
+        for c in self.cases:
+            tc = ET.SubElement(
+                suite, "testcase", name=c.name, time=f"{c.time_s:.3f}"
+            )
+            if c.failure is not None:
+                f = ET.SubElement(tc, "failure", message="test failed")
+                f.text = c.failure
+        return ET.tostring(suite, encoding="unicode")
+
+
+def run_test(
+    fn: Callable[[], None],
+    name: Optional[str] = None,
+    retries: int = 3,
+    retry_delay: float = 0.1,
+) -> TestCase:
+    """Run `fn`, retrying on failure (infra-flake tolerance; the reference
+    retries ×10 with backoff)."""
+    case = TestCase(name=name or fn.__name__)
+    t0 = time.perf_counter()
+    last: Optional[str] = None
+    for attempt in range(retries):
+        try:
+            fn()
+            last = None
+            break
+        except Exception:
+            last = traceback.format_exc()
+            if attempt < retries - 1:
+                time.sleep(retry_delay * (attempt + 1))
+    case.time_s = time.perf_counter() - t0
+    case.failure = last
+    return case
+
+
+def run_suite(
+    tests: List[Callable[[], None]],
+    suite_name: str,
+    junit_path: Optional[str] = None,
+    retries: int = 3,
+) -> TestSuiteResult:
+    result = TestSuiteResult(name=suite_name)
+    for fn in tests:
+        result.cases.append(run_test(fn, retries=retries))
+    if junit_path:
+        with open(junit_path, "w") as f:
+            f.write(result.to_junit_xml())
+    return result

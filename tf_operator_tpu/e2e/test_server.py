@@ -1,0 +1,127 @@
+"""The in-pod test server — a real HTTP app standing in for training code.
+
+Mirrors the reference's Flask test-server (test/test-server/test_app.py):
+  /tfconfig   — echo the raw TF_CONFIG env the operator injected
+  /runconfig  — parsed cluster/task fields (the reference returns
+                tf.estimator.RunConfig's view: master, task_type, task_id,
+                cluster_spec, is_chief, num_ps/worker_replicas)
+  /env        — the full injected env (covers the PyTorch/MXNet/XGBoost/TPU
+                contracts the reference asserts per-framework)
+  /exit?exitCode=N — remote-controlled termination, the fault-injection
+                seam the e2e restart-policy suites drive
+                (reference tf_job_client.terminate_replica :301)
+  /healthz    — liveness
+
+This is what lets e2e suites assert distributed semantics with no real
+training (SURVEY.md §4.4 'the crucial trick').
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+def parse_runconfig(env: Dict[str, str]) -> Dict[str, object]:
+    """The fields estimator_runconfig_tests.py asserts (reference :26-100),
+    derived from TF_CONFIG exactly as tf.estimator.RunConfig would."""
+    raw = env.get("TF_CONFIG", "")
+    if not raw:
+        return {}
+    cfg = json.loads(raw)
+    cluster = cfg.get("cluster", {})
+    task = cfg.get("task", {})
+    ttype, tid = task.get("type", ""), int(task.get("index", 0))
+    chief_type = "chief" if "chief" in cluster else "master"
+    is_chief = ttype == chief_type or (
+        chief_type not in cluster and ttype == "worker" and tid == 0
+    )
+    addr = (cluster.get(ttype) or [None] * (tid + 1))[tid] if ttype in cluster else None
+    return {
+        "master": f"grpc://{addr}" if addr and ttype != "evaluator" else "",
+        "task_type": ttype,
+        "task_id": tid,
+        "cluster_spec": cluster,
+        "is_chief": is_chief,
+        "num_ps_replicas": len(cluster.get("ps", [])),
+        "num_worker_replicas": len(cluster.get("worker", [])),
+        "environment": cfg.get("environment", ""),
+    }
+
+
+class TestServer:
+    """One instance per simulated container; `on_exit(code)` is provided by
+    the kubelet simulator and marks the container terminated."""
+
+    def __init__(
+        self,
+        env: Dict[str, str],
+        on_exit: Optional[Callable[[int], None]] = None,
+        log: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.env = dict(env)
+        self.on_exit = on_exit or (lambda code: None)
+        self.log = log or (lambda line: None)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                url = urlparse(self.path)
+                if url.path == "/tfconfig":
+                    self._send(200, {"TF_CONFIG": outer.env.get("TF_CONFIG", "")})
+                elif url.path == "/runconfig":
+                    self._send(200, parse_runconfig(outer.env))
+                elif url.path == "/env":
+                    self._send(200, outer.env)
+                elif url.path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif url.path == "/exit":
+                    code = int(parse_qs(url.query).get("exitCode", ["0"])[0])
+                    outer.log(f"exit requested with code {code}")
+                    self._send(200, {"exiting": code})
+                    # terminate asynchronously so the response flushes first
+                    threading.Thread(
+                        target=outer.terminate, args=(code,), daemon=True
+                    ).start()
+                else:
+                    self._send(404, {"error": "not found"})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread: Optional[threading.Thread] = None
+        self._terminated = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self.log(f"test-server listening on 127.0.0.1:{self.port}")
+
+    def terminate(self, code: int) -> None:
+        if self._terminated.is_set():
+            return
+        self._terminated.set()
+        self._server.shutdown()
+        self._server.server_close()
+        self.log(f"terminated with exit code {code}")
+        self.on_exit(code)
+
+    def stop(self) -> None:
+        if not self._terminated.is_set():
+            self._terminated.set()
+            self._server.shutdown()
+            self._server.server_close()

@@ -53,6 +53,7 @@ class FakeCluster:
         self._handlers: Dict[str, List[EventHandler]] = {}
         self._rv = 0  # resourceVersion counter
         self.events: List[Dict[str, Any]] = []  # recorded k8s Events
+        self._pod_logs: Dict[str, List[str]] = {}  # namespace/name -> lines
 
     # ------------------------------------------------------------------ util
     def _bump(self, obj: Dict[str, Any]) -> None:
@@ -99,6 +100,14 @@ class FakeCluster:
             store = self._kind_store(kind)
             if key not in store:
                 raise NotFoundError(f"{kind} {key}")
+            # optimistic concurrency: a stale resourceVersion is a conflict
+            # (real apiserver semantics; leader election's CAS depends on it)
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            stored_rv = store[key].get("metadata", {}).get("resourceVersion")
+            if sent_rv is not None and stored_rv is not None and sent_rv != stored_rv:
+                raise ConflictError(
+                    f"{kind} {key}: resourceVersion {sent_rv} != {stored_rv}"
+                )
             obj = copy.deepcopy(obj)
             self._bump(obj)
             store[key] = obj
@@ -156,6 +165,17 @@ class FakeCluster:
 
     def list_services(self, namespace=None, selector=None) -> List[Dict[str, Any]]:
         return self.list("Service", namespace, selector)
+
+    # ------------------------------------------------------------- pod logs
+    def append_pod_log(self, namespace: str, name: str, line: str) -> None:
+        """Container stdout capture (written by the kubelet simulator; read
+        by JobClient.get_logs the way the reference reads the pod log API)."""
+        with self._lock:
+            self._pod_logs.setdefault(f"{namespace}/{name}", []).append(line)
+
+    def read_pod_log(self, namespace: str, name: str) -> str:
+        with self._lock:
+            return "\n".join(self._pod_logs.get(f"{namespace}/{name}", []))
 
     # ------------------------------------------------------------- events
     def record_event(

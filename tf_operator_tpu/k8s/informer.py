@@ -126,7 +126,10 @@ class RateLimitingQueue:
                 return
             self._seq += 1
             heapq.heappush(self._heap, (time.monotonic() + delay, self._seq, item))
-            if self._timer_thread is None or not self._timer_thread.is_alive():
+            # the timer thread clears _timer_thread under this lock before it
+            # exits, so `is None` is a race-free liveness check (an is_alive()
+            # check would miss a thread that decided to exit but hasn't died)
+            if self._timer_thread is None:
                 self._timer_thread = threading.Thread(
                     target=self._timer_loop, daemon=True
                 )
@@ -136,10 +139,9 @@ class RateLimitingQueue:
     def _timer_loop(self) -> None:
         while True:
             with self._cond:
-                if self._shutdown:
+                if self._shutdown or not self._heap:
+                    self._timer_thread = None
                     return
-                if not self._heap:
-                    return  # thread exits; restarted on next add_after
                 fire_at, _, item = self._heap[0]
                 now = time.monotonic()
                 if fire_at <= now:

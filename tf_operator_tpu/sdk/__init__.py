@@ -1,0 +1,5 @@
+"""User-facing client SDK (reference sdk/python/kubeflow/tfjob — SURVEY.md
+§2.6)."""
+from tf_operator_tpu.sdk.client import JobClient, TFJobClient, TPUJobClient
+
+__all__ = ["JobClient", "TFJobClient", "TPUJobClient"]
