@@ -1,0 +1,102 @@
+"""Tests for the e2e infra itself: retrying runner + junit (reference
+test_runner.py:22-66), TestServer lifecycle edges, and leader stop()
+consistency under a wedged run loop."""
+import threading
+import time
+import xml.etree.ElementTree as ET
+
+from tf_operator_tpu.e2e.runner import run_suite, run_test
+from tf_operator_tpu.e2e.test_server import TestServer
+
+
+def test_run_test_retries_until_pass():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("infra flake")
+
+    case = run_test(flaky, retries=5, retry_delay=0)
+    assert case.passed
+    assert len(attempts) == 3
+
+
+def test_run_test_exhausts_retries():
+    def always_fails():
+        raise RuntimeError("broken for real")
+
+    case = run_test(always_fails, retries=2, retry_delay=0)
+    assert not case.passed
+    assert "broken for real" in case.failure
+
+
+def test_run_suite_junit_xml(tmp_path):
+    def ok():
+        pass
+
+    def bad():
+        raise ValueError("nope")
+
+    junit = tmp_path / "junit.xml"
+    result = run_suite([ok, bad], "suite1", junit_path=str(junit),
+                       retries=1)
+    assert result.failures == 1
+    root = ET.fromstring(junit.read_text())
+    assert root.tag == "testsuite"
+    assert root.get("tests") == "2"
+    assert root.get("failures") == "1"
+    names = [tc.get("name") for tc in root.findall("testcase")]
+    assert names == ["ok", "bad"]
+    failures = root.findall("testcase/failure")
+    assert len(failures) == 1 and "nope" in failures[0].text
+
+
+def test_test_server_stop_before_start_returns():
+    """Regression: shutdown() on a never-started socketserver blocks forever."""
+    server = TestServer({})
+    done = threading.Event()
+
+    def stopper():
+        server.stop()
+        done.set()
+
+    t = threading.Thread(target=stopper, daemon=True)
+    t.start()
+    assert done.wait(timeout=2), "TestServer.stop() deadlocked on unstarted server"
+
+
+def test_test_server_terminate_before_start_reports_exit():
+    codes = []
+    server = TestServer({}, on_exit=codes.append)
+    server.terminate(7)
+    assert codes == [7]
+
+
+def test_leader_stop_forces_non_leader_when_run_wedged():
+    """If the run thread is stuck inside a renew call (stalled network I/O
+    against a real apiserver), stop() must still leave a consistent
+    non-leader state and release the lease."""
+    from tf_operator_tpu.cmd.leader import LeaderElector
+    from tf_operator_tpu.k8s.fake import FakeCluster
+
+    cluster = FakeCluster()
+    elector = LeaderElector(
+        cluster, "me", lease_duration=0.5, renew_deadline=0.1,
+        retry_period=0.05,
+    )
+    elector.start()
+    deadline = time.monotonic() + 5
+    while not elector.is_leader and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert elector.is_leader
+    # wedge the renew path as a stalled network call would
+    wedge = threading.Event()
+    orig = elector._try_acquire_or_renew
+    elector._try_acquire_or_renew = lambda: wedge.wait(timeout=30) or orig()
+    time.sleep(0.15)  # let the run loop enter the wedged renew
+    elector.stop(join_timeout=0.3)
+    assert not elector.is_leader, "stop() left stale leadership"
+    lease = cluster.get("Lease", "default", "tpu-operator")
+    assert lease["spec"]["renewTime"] == 0, "lease not released"
+    wedge.set()

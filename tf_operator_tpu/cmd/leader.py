@@ -136,8 +136,16 @@ class LeaderElector:
         self._thread = threading.Thread(target=self.run, daemon=True)
         self._thread.start()
 
-    def stop(self, release: bool = True) -> None:
+    def stop(self, release: bool = True, join_timeout: float = 5.0) -> None:
         self._release_on_stop = release
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=join_timeout)
+            if self._thread.is_alive() and self.is_leader:
+                # run() is wedged (stalled lock update / blocking callback):
+                # force a consistent non-leader state anyway so callers and
+                # standbys don't wait out the full lease_duration
+                self.is_leader = False
+                IS_LEADER.set(0)
+                if release:
+                    self.release()

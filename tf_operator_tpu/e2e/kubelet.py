@@ -19,7 +19,12 @@ from typing import Dict, Optional
 
 from tf_operator_tpu.e2e.test_server import TestServer
 from tf_operator_tpu.k8s import objects
-from tf_operator_tpu.k8s.fake import ApiError, FakeCluster, NotFoundError
+from tf_operator_tpu.k8s.fake import (
+    ApiError,
+    ConflictError,
+    FakeCluster,
+    NotFoundError,
+)
 
 PORT_ANNOTATION = "tpu-operator.e2e/port"
 
@@ -72,16 +77,15 @@ class FakeKubelet:
         def on_exit(code: int) -> None:
             self._container_exited(key, code)
 
-        server = TestServer(env, on_exit=on_exit, log=log)
         with self._lock:
             if key in self._running:  # duplicate ADDED
-                server.stop()
                 return
+            server = TestServer(env, on_exit=on_exit, log=log)
             self._running[key] = _RunningPod(server, c.get("name", ""))
         server.start()
         log(f"container {c.get('name')} image {c.get('image')} started")
-        try:
-            pod = self.cluster.get_pod(namespace, name)
+
+        def mark_running(pod) -> None:
             pod["status"]["phase"] = objects.POD_RUNNING
             pod["status"]["podIP"] = "127.0.0.1"
             pod["metadata"].setdefault("annotations", {})[PORT_ANNOTATION] = str(
@@ -94,9 +98,25 @@ class FakeKubelet:
                     "restartCount": 0,
                 }
             ]
-            self.cluster.update_pod(pod)
-        except (NotFoundError, ApiError):
+
+        if not self._write_pod_status(namespace, name, mark_running):
             self._stop_pod(key)
+
+    def _write_pod_status(self, namespace: str, name: str, mutate) -> bool:
+        """Re-get + retry on write conflicts, like the real kubelet's status
+        manager — other writers (controller adoption, tests) race on pods."""
+        for _ in range(5):
+            try:
+                pod = self.cluster.get_pod(namespace, name)
+                mutate(pod)
+                self.cluster.update_pod(pod)
+                return True
+            except ConflictError:
+                time.sleep(0.01)
+                continue
+            except (NotFoundError, ApiError):
+                return False
+        return False
 
     def _container_exited(self, key: str, code: int) -> None:
         namespace, _, name = key.partition("/")
@@ -117,17 +137,18 @@ class FakeKubelet:
             self.cluster.append_pod_log(
                 namespace, name, f"restarting container (count {running.restart_count})"
             )
-            pod["status"]["containerStatuses"] = [
-                {
-                    "name": running.container_name,
-                    "state": {"running": {}},
-                    "lastState": {"terminated": {"exitCode": code}},
-                    "restartCount": running.restart_count,
-                }
-            ]
-            try:
-                self.cluster.update_pod(pod)
-            except ApiError:
+
+            def mark_restarting(pod) -> None:
+                pod["status"]["containerStatuses"] = [
+                    {
+                        "name": running.container_name,
+                        "state": {"running": {}},
+                        "lastState": {"terminated": {"exitCode": code}},
+                        "restartCount": running.restart_count,
+                    }
+                ]
+
+            if not self._write_pod_status(namespace, name, mark_restarting):
                 return
             # spin the replacement server with the same env
             env = running.server.env
@@ -140,29 +161,28 @@ class FakeKubelet:
                 self._running[key] = _RunningPod(server, running.container_name)
                 self._running[key].restart_count = running.restart_count
             server.start()
-            try:
-                pod = self.cluster.get_pod(namespace, name)
+
+            def set_port(pod) -> None:
                 pod["metadata"].setdefault("annotations", {})[PORT_ANNOTATION] = str(
                     server.port
                 )
-                self.cluster.update_pod(pod)
-            except (NotFoundError, ApiError):
-                pass
+
+            self._write_pod_status(namespace, name, set_port)
             return
-        pod["status"]["phase"] = (
-            objects.POD_SUCCEEDED if code == 0 else objects.POD_FAILED
-        )
-        pod["status"]["containerStatuses"] = [
-            {
-                "name": running.container_name,
-                "state": {"terminated": {"exitCode": code}},
-                "restartCount": running.restart_count,
-            }
-        ]
-        try:
-            self.cluster.update_pod(pod)
-        except ApiError:
-            pass
+
+        def mark_terminal(pod) -> None:
+            pod["status"]["phase"] = (
+                objects.POD_SUCCEEDED if code == 0 else objects.POD_FAILED
+            )
+            pod["status"]["containerStatuses"] = [
+                {
+                    "name": running.container_name,
+                    "state": {"terminated": {"exitCode": code}},
+                    "restartCount": running.restart_count,
+                }
+            ]
+
+        self._write_pod_status(namespace, name, mark_terminal)
 
     def _stop_pod(self, key: str) -> None:
         with self._lock:

@@ -55,6 +55,8 @@ class TestServer:
     """One instance per simulated container; `on_exit(code)` is provided by
     the kubelet simulator and marks the container terminated."""
 
+    __test__ = False  # not a pytest class despite the name
+
     def __init__(
         self,
         env: Dict[str, str],
@@ -111,17 +113,22 @@ class TestServer:
         self._thread.start()
         self.log(f"test-server listening on 127.0.0.1:{self.port}")
 
+    def _shutdown(self) -> None:
+        # BaseServer.shutdown() blocks on an event only serve_forever() sets;
+        # calling it on a never-started server deadlocks forever.
+        if self._thread is not None:
+            self._server.shutdown()
+        self._server.server_close()
+
     def terminate(self, code: int) -> None:
         if self._terminated.is_set():
             return
         self._terminated.set()
-        self._server.shutdown()
-        self._server.server_close()
+        self._shutdown()
         self.log(f"terminated with exit code {code}")
         self.on_exit(code)
 
     def stop(self) -> None:
         if not self._terminated.is_set():
             self._terminated.set()
-            self._server.shutdown()
-            self._server.server_close()
+            self._shutdown()

@@ -1,0 +1,10 @@
+"""TPU kernels (pallas) for the hot ops of the model families.
+
+The reference operator contains no tensor code at all (SURVEY.md §0: the
+math lives in user containers). In the TPU-native framework the compute
+path is first-class, so the attention inner loop — the dominant
+non-matmul cost of ladder configs #4/#5 (BASELINE.md) — gets a fused
+pallas kernel (flash_attention) plus a sequence-parallel ring variant
+(ring_attention) for long context over the ICI mesh.
+"""
+from tf_operator_tpu.ops.flash_attention import flash_attention  # noqa: F401
