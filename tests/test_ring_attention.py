@@ -1,0 +1,80 @@
+"""Ring attention (sequence/context parallel) vs full attention on the
+8-device CPU mesh. The reference has no counterpart (SURVEY.md §5.7)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from tf_operator_tpu.parallel.compat import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.models.transformer import dot_product_attention
+from tf_operator_tpu.ops.ring_attention import (
+    make_ring_attention_fn,
+    ring_attention,
+)
+from tf_operator_tpu.parallel.mesh import make_mesh
+
+
+def _qkv(key, b, s, h, d, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sp", [4, 8])
+def test_ring_matches_full(causal, sp):
+    mesh = make_mesh({"tp": sp, "dp": 8 // sp})
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, 64, 2, 16)
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, causal=causal, axis_name="tp"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    got = jax.jit(fn)(q, k, v)
+    want = dot_product_attention(q, k, v, causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_grads_match_full():
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    q, k, v = _qkv(jax.random.PRNGKey(1), 2, 32, 2, 8)
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    ring = shard_map(
+        functools.partial(ring_attention, causal=True, axis_name="tp"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    cot = jax.random.normal(jax.random.PRNGKey(2), q.shape)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v) * cot)
+
+    g_ring = jax.jit(jax.grad(functools.partial(loss, ring),
+                              argnums=(0, 1, 2)))(q, k, v)
+    g_full = jax.grad(
+        lambda q, k, v: jnp.sum(dot_product_attention(q, k, v, True) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_ring_inside_transformer():
+    """make_ring_attention_fn plugs into TransformerConfig.attention_fn and
+    agrees with the einsum path under jit over the mesh."""
+    from tf_operator_tpu.models import transformer as tfm
+
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    cfg_ref = tfm.tiny(causal=True, dtype=jnp.float32)
+    cfg_ring = tfm.tiny(causal=True, dtype=jnp.float32,
+                        attention_fn=make_ring_attention_fn(mesh))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 64), 0, 255)
+    params = tfm.Transformer(cfg_ref).init(jax.random.PRNGKey(4), tokens)
+    out_ref = tfm.Transformer(cfg_ref).apply(params, tokens)
+    out_ring = jax.jit(
+        lambda p, t: tfm.Transformer(cfg_ring).apply(p, t)
+    )(params, tokens)
+    np.testing.assert_allclose(out_ref, out_ring, atol=1e-4, rtol=1e-4)

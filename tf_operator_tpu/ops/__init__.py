@@ -8,3 +8,7 @@ pallas kernel (flash_attention) plus a sequence-parallel ring variant
 (ring_attention) for long context over the ICI mesh.
 """
 from tf_operator_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from tf_operator_tpu.ops.ring_attention import (  # noqa: F401
+    make_ring_attention_fn,
+    ring_attention,
+)

@@ -304,6 +304,8 @@ def flash_attention(q, k, v, causal: bool = False, *,
     blk_q = min(blk_q, s)
     blk_k = min(blk_k, s)
     if s % blk_q or s % blk_k:
+        # e.g. s=200 with 128 blocks; s <= blk is fine (a block equal to the
+        # full array dim satisfies Mosaic tiling — verified on hardware)
         from tf_operator_tpu.models.transformer import dot_product_attention
         return dot_product_attention(q, k, v, causal)
     if interpret is None:

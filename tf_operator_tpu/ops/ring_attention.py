@@ -1,0 +1,104 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context scaling the reference lacks entirely (SURVEY.md §5.7: no ring
+attention / context parallel anywhere; the reference only provides the
+topology substrate). Here the sequence dim is sharded over a mesh axis
+(default: the `tp` axis, rule `seq_sp` in parallel/mesh.py): each device
+holds S/n of Q, K, V and, over n ring steps, computes blockwise attention
+against the KV shard currently resident, merging partial results with the
+flash-style (m, l) running softmax while `jax.lax.ppermute` rotates the KV
+shards one hop around the ring — ICI traffic only, KV never materializes
+globally, and per-device attention memory stays O((S/n)^2).
+
+Each step is wrapped in jax.checkpoint so backward recomputes the block
+scores instead of saving n score matrices.
+
+Causal masking uses global positions derived from the device's ring index,
+so blocks strictly above the diagonal contribute exactly zero.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+@functools.partial(jax.checkpoint, static_argnums=(5,))
+def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool):
+    """One ring step: blockwise attention q @ (k, v) with global-position
+    causal mask, merged into the running (o, m, l) accumulator."""
+    q, k, v = qkv
+    q_pos, k_pos = pos
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk] global
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)                          # [B,H,Sq]
+    m_new = jnp.maximum(carry_m, m_blk)
+    # exp(NEG_INF - m) underflows to 0 unless m is itself NEG_INF (a fully
+    # masked row so far); guard so masked entries never contribute exp(0)=1
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[..., None]))
+    corr = jnp.exp(jnp.clip(carry_m - m_new, max=0.0))
+    l_new = carry_l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    o_new = carry_o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, causal: bool = False, *,
+                   axis_name: str = "tp") -> jax.Array:
+    """Attention over sequence shards. Call inside shard_map with q, k, v
+    [B, S_local, H, D] sharded on dim 1 over `axis_name`. Differentiable
+    (ppermute transposes to the reverse rotation under autodiff)."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    iota = jnp.arange(s_local, dtype=jnp.int32)
+    q_pos = my * s_local + iota
+
+    o = jnp.zeros((b, s_local, h, d), jnp.float32)
+    m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    kv = (k, v)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        src = jax.lax.rem(my - step + n, n)  # ring origin of resident KV
+        k_pos = src * s_local + iota
+        o, m, l = _merge_block(o, m, l, (q, kv[0], kv[1]),
+                               (q_pos, k_pos), causal)
+        if step < n - 1:
+            kv = jax.lax.ppermute(kv, axis_name, perm)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh: Mesh, axis_name: str = "tp",
+                           batch_axes=("dp", "fsdp")):
+    """An attention_fn for models/transformer.TransformerConfig: shard_maps
+    [B, S, H, D] inputs with S over `axis_name` and runs ring_attention.
+    Nesting inside the outer jit is fine; XLA overlaps the ppermute hops
+    with the per-step block compute."""
+    from tf_operator_tpu.parallel.compat import shard_map
+
+    spec = P(batch_axes, axis_name, None, None)
+
+    def attention_fn(q, k, v, causal: bool) -> jax.Array:
+        inner = functools.partial(ring_attention, causal=causal,
+                                  axis_name=axis_name)
+        return shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )(q, k, v)
+
+    return attention_fn

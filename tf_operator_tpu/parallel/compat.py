@@ -1,0 +1,22 @@
+"""Version shims for jax APIs that moved between releases."""
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: top-level
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep: bool = True):
+    """shard_map with the old `check_rep` name; newer jax calls it
+    `check_vma` (varying-manual-axes checking)."""
+    kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    if "check_rep" in _PARAMS:
+        kw["check_rep"] = check_rep
+    elif "check_vma" in _PARAMS:
+        kw["check_vma"] = check_rep
+    return _shard_map(f, **kw)

@@ -1,0 +1,101 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over the `pp`
+mesh axis.
+
+Absent from the reference (SURVEY.md §2.10: PP row "NO"). TPU-first
+design: stage parameters are stacked on a leading dim and sharded over
+`pp`, every device runs the same scanned schedule (SPMD — no per-stage
+programs), and activations hop one ICI neighbor per tick via
+`jax.lax.ppermute`. A microbatch enters stage 0 each tick; after the
+pipeline fills, all stages compute concurrently; outputs drain from the
+last stage. Total ticks = n_micro + n_stages - 1, bubble fraction
+(n_stages-1)/(n_micro+n_stages-1).
+
+Autodiff runs through scan + ppermute, which yields the reverse schedule
+(activation hops transpose to backward hops) without a hand-written
+backward pipeline.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(params_list) -> Any:
+    """[per-stage pytrees] -> one pytree with a leading stage dim, ready to
+    shard with PartitionSpec('pp', ...)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_list
+    )
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], params, x,
+          *, axis_name: str = "pp") -> jax.Array:
+    """Run the pipeline. Call inside shard_map:
+      params — this device's stage slice, leading dim 1 (from a stacked
+               [n_stages, ...] pytree sharded over `axis_name`)
+      x      — microbatched input [n_micro, mb, ...], same on every stage
+    Returns [n_micro, mb, ...] outputs (replicated via a masked psum)."""
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+    n_micro = x.shape[0]
+    ticks = n_micro + n_stages - 1
+    # activations hop stage i -> i+1; stage 0 has no upstream sender
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        buf, out = carry
+        feed = x[jnp.clip(t, 0, n_micro - 1)]
+        inp = jnp.where(stage == 0, feed, buf)
+        y = stage_fn(my_params, inp)
+        buf_next = jax.lax.ppermute(y, axis_name, perm)
+        m = t - (n_stages - 1)  # microbatch draining at the last stage
+        valid = jnp.logical_and(stage == n_stages - 1,
+                                jnp.logical_and(m >= 0, m < n_micro))
+        upd = jnp.where(valid, y, out[jnp.clip(m, 0, n_micro - 1)])
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, upd, jnp.clip(m, 0, n_micro - 1), axis=0)
+        return (buf_next, out), None
+
+    buf0 = jnp.zeros_like(x[0])
+    out0 = jnp.zeros(x.shape[:2] + _out_shape_tail(stage_fn, my_params, x),
+                     x.dtype)
+    (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+    # only the last stage holds real outputs; replicate with a masked psum
+    mask = (stage == n_stages - 1).astype(out.dtype)
+    return jax.lax.psum(out * mask, axis_name)
+
+
+def _out_shape_tail(stage_fn, params, x):
+    """Trailing dims of one stage's output (stages must be shape-preserving
+    across hops: each stage's output feeds the next stage's input)."""
+    shape = jax.eval_shape(stage_fn, params, jax.ShapeDtypeStruct(
+        x.shape[1:], x.dtype)).shape
+    return shape[1:]
+
+
+def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
+                     axis_name: str = "pp"):
+    """jit-able f(stacked_params, batch) running the pipeline over `mesh`.
+    `stacked_params` leaves are [n_stages, ...]; batch [B, ...] is split
+    into n_micro microbatches."""
+    from tf_operator_tpu.parallel.compat import shard_map
+
+    def run(params, batch):
+        b = batch.shape[0]
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+        x = batch.reshape((n_micro, b // n_micro) + batch.shape[1:])
+        inner = functools.partial(gpipe, stage_fn, axis_name=axis_name)
+        out = shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(axis_name), P()), out_specs=P(),
+            check_rep=False,
+        )(params, x)
+        return out.reshape((b,) + out.shape[2:])
+
+    return run
