@@ -1,0 +1,44 @@
+# Developer entrypoints, kubebuilder-style (reference Makefile:39-86:
+# manifests / generate / test / build / deploy).
+IMG ?= kubeflow/tpu-training-operator:latest
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra -pthread
+NATIVE_DIR := native
+NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
+NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
+
+.PHONY: all manifests verify-manifests test bench native clean docker-build deploy undeploy
+
+all: native manifests
+
+# Regenerate CRDs from the Python API types (reference `make manifests`).
+manifests:
+	python hack/gen_crds.py
+
+verify-manifests:
+	python hack/gen_crds.py --check
+
+# Native runtime core (workqueue/expectations) as a shared library.
+native: $(NATIVE_LIB)
+
+$(NATIVE_LIB): $(NATIVE_SRCS) $(wildcard $(NATIVE_DIR)/*.h)
+	mkdir -p $(dir $@)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(NATIVE_SRCS)
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+docker-build:
+	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .
+
+deploy:
+	kubectl apply -k manifests/overlays/standalone
+
+undeploy:
+	kubectl delete -k manifests/overlays/standalone
+
+clean:
+	rm -f $(NATIVE_LIB)

@@ -1,0 +1,100 @@
+"""Packaging-layer tests: generated CRDs are in sync with the API types and
+the kustomize base is internally consistent (reference tier-1 analogue of
+`make manifests` + config validation; SURVEY §2.8)."""
+import os
+import subprocess
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE = os.path.join(REPO, "manifests", "base")
+
+
+def _load(path):
+    with open(path) as f:
+        return list(yaml.safe_load_all(f))
+
+
+def test_crds_in_sync_with_api_types():
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "gen_crds.py"), "--check"],
+        capture_output=True,
+        text=True,
+    )
+    assert rc.returncode == 0, rc.stderr
+
+
+def test_crds_cover_all_kinds_and_replica_types():
+    from tf_operator_tpu.api import mxnet, pytorch, tensorflow, tpujob, xgboost
+
+    expect = {
+        "TFJob": ("tfReplicaSpecs", tensorflow.REPLICA_TYPES),
+        "PyTorchJob": ("pytorchReplicaSpecs", pytorch.REPLICA_TYPES),
+        "MXJob": ("mxReplicaSpecs", mxnet.REPLICA_TYPES),
+        "XGBoostJob": ("xgbReplicaSpecs", xgboost.REPLICA_TYPES),
+        "TPUJob": ("tpuReplicaSpecs", tpujob.REPLICA_TYPES),
+    }
+    seen = {}
+    crd_dir = os.path.join(BASE, "crds")
+    for fname in os.listdir(crd_dir):
+        (doc,) = _load(os.path.join(crd_dir, fname))
+        kind = doc["spec"]["names"]["kind"]
+        ver = doc["spec"]["versions"][0]
+        assert ver["subresources"] == {"status": {}}
+        props = ver["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+        key, rtypes = expect[kind]
+        assert key in props, f"{kind}: missing {key}"
+        assert sorted(props[key]["properties"]) == sorted(rtypes)
+        assert "runPolicy" in props
+        sched = props["runPolicy"]["properties"]["schedulingPolicy"]["properties"]
+        assert {"minAvailable", "queue", "minResources", "priorityClass"} <= set(sched)
+        seen[kind] = True
+    assert sorted(seen) == sorted(expect)
+
+
+def test_tpujob_crd_has_tpu_fields():
+    (doc,) = _load(os.path.join(BASE, "crds", "kubeflow.org_tpujobs.yaml"))
+    spec = doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"][
+        "spec"
+    ]
+    assert spec["required"] == ["acceleratorType"]
+    assert {"acceleratorType", "topology", "numSlices"} <= set(spec["properties"])
+
+
+def test_kustomize_base_resources_exist():
+    (kust,) = _load(os.path.join(BASE, "kustomization.yaml"))
+    for res in kust["resources"]:
+        assert os.path.exists(os.path.join(BASE, res)), res
+
+
+def test_rbac_covers_all_crds_and_podgroups():
+    docs = _load(os.path.join(BASE, "cluster-role.yaml"))
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    kubeflow_rule = next(
+        r for r in role["rules"] if "kubeflow.org" in r["apiGroups"]
+    )
+    for plural in ("tfjobs", "pytorchjobs", "mxjobs", "xgboostjobs", "tpujobs"):
+        assert plural in kubeflow_rule["resources"]
+        assert f"{plural}/status" in kubeflow_rule["resources"]
+    volcano = next(
+        r for r in role["rules"] if "scheduling.volcano.sh" in r["apiGroups"]
+    )
+    assert "podgroups" in volcano["resources"]
+
+
+def test_deployment_probes_and_entrypoint():
+    (dep,) = _load(os.path.join(BASE, "deployment.yaml"))
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][-1] == "tf_operator_tpu.cmd.main"
+    assert c["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert c["readinessProbe"]["httpGet"]["path"] == "/readyz"
+
+
+def test_overlays_reference_base():
+    for overlay in ("standalone", "kubeflow"):
+        (kust,) = _load(
+            os.path.join(REPO, "manifests", "overlays", overlay, "kustomization.yaml")
+        )
+        assert any("base" in r for r in kust["resources"])
+        assert kust["namespace"]

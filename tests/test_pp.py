@@ -80,3 +80,28 @@ def test_gpipe_uneven_batch_raises():
     x = jax.random.normal(jax.random.PRNGKey(5), (8, D))
     with pytest.raises(ValueError, match="not divisible"):
         run(stacked, x)
+
+
+def test_gpipe_stage_count_mismatch_raises():
+    """4 stacked stages on a pp=2 mesh must fail loudly, not silently run
+    only stages [0, 2]."""
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    stacked = stack_stage_params(_make_params(jax.random.PRNGKey(6)))
+    run = make_pipeline_fn(mesh, _stage_fn, n_micro=4)
+    x = jax.random.normal(jax.random.PRNGKey(7), (8, D))
+    with pytest.raises(ValueError, match="must match"):
+        run(stacked, x)
+
+
+def test_gpipe_bf16_batch_f32_params():
+    """Dtype-promoting stages (bf16 batch through f32 params) must carry the
+    promoted dtype instead of crashing in dynamic_update_slice."""
+    mesh = make_mesh({"pp": N_STAGES, "dp": 8 // N_STAGES})
+    stages = _make_params(jax.random.PRNGKey(8))
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(9), (8, D), jnp.bfloat16)
+    run = make_pipeline_fn(mesh, _stage_fn, n_micro=4)
+    got = jax.jit(run)(stacked, x)
+    assert got.dtype == jnp.float32
+    want = _sequential(stages, x)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)

@@ -41,6 +41,13 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], params, x,
     Returns [n_micro, mb, ...] outputs (replicated via a masked psum)."""
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
+    for leaf in jax.tree_util.tree_leaves(params):
+        if leaf.shape[0] != 1:
+            raise ValueError(
+                f"gpipe: per-device params carry {leaf.shape[0]} stages; the "
+                f"stacked stage dim must equal the {axis_name!r} axis size "
+                f"({n_stages})"
+            )
     my_params = jax.tree_util.tree_map(lambda p: p[0], params)
     n_micro = x.shape[0]
     ticks = n_micro + n_stages - 1
@@ -61,21 +68,30 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], params, x,
             out, upd, jnp.clip(m, 0, n_micro - 1), axis=0)
         return (buf_next, out), None
 
-    buf0 = jnp.zeros_like(x[0])
-    out0 = jnp.zeros(x.shape[:2] + _out_shape_tail(stage_fn, my_params, x),
-                     x.dtype)
+    y_struct = _stage_out_struct(stage_fn, my_params, x)
+    buf0 = jnp.zeros(y_struct.shape, y_struct.dtype)
+    out0 = jnp.zeros((n_micro,) + y_struct.shape, y_struct.dtype)
     (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(ticks))
     # only the last stage holds real outputs; replicate with a masked psum
     mask = (stage == n_stages - 1).astype(out.dtype)
     return jax.lax.psum(out * mask, axis_name)
 
 
-def _out_shape_tail(stage_fn, params, x):
-    """Trailing dims of one stage's output (stages must be shape-preserving
-    across hops: each stage's output feeds the next stage's input)."""
-    shape = jax.eval_shape(stage_fn, params, jax.ShapeDtypeStruct(
-        x.shape[1:], x.dtype)).shape
-    return shape[1:]
+def _stage_out_struct(stage_fn, params, x):
+    """Shape+dtype of one stage's output on the steady-state carry. Stages
+    must be shape-preserving across hops; the carry dtype is the fixed point
+    of input-dtype promotion (a bf16 batch through f32 params carries f32)."""
+    y = jax.eval_shape(stage_fn, params,
+                       jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+    carry_dtype = jnp.promote_types(x.dtype, y.dtype)
+    y = jax.eval_shape(stage_fn, params,
+                       jax.ShapeDtypeStruct(x.shape[1:], carry_dtype))
+    if y.shape != x.shape[1:]:
+        raise ValueError(
+            f"gpipe: stage output shape {y.shape} != input {x.shape[1:]}; "
+            f"stages must be shape-preserving"
+        )
+    return jax.ShapeDtypeStruct(y.shape, jnp.promote_types(carry_dtype, y.dtype))
 
 
 def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
@@ -89,6 +105,14 @@ def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
         b = batch.shape[0]
         if b % n_micro:
             raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+        pp = mesh.shape[axis_name]
+        for leaf in jax.tree_util.tree_leaves(params):
+            if leaf.shape[0] != pp:
+                raise ValueError(
+                    f"make_pipeline_fn: stacked params have {leaf.shape[0]} "
+                    f"stages but mesh axis {axis_name!r} has {pp} devices; "
+                    f"they must match (one stage per pipeline device)"
+                )
         x = batch.reshape((n_micro, b // n_micro) + batch.shape[1:])
         inner = functools.partial(gpipe, stage_fn, axis_name=axis_name)
         out = shard_map(
