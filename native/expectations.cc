@@ -1,0 +1,92 @@
+// Native ControllerExpectations — double-creation protection counters.
+//
+// Mirrors the Python ControllerExpectations (engine/expectations.py) and
+// kubeflow/common's expectation package semantics: per-key (add, delete)
+// counters with a TTL; satisfied when fulfilled, expired, or never set.
+
+#include "tpuoperator.h"
+
+#include <chrono>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Expectation {
+  long long add = 0;
+  long long del = 0;
+  Clock::time_point stamp;
+};
+
+struct Expectations {
+  std::mutex mu;
+  std::unordered_map<std::string, Expectation> store;
+  double ttl_ms;
+  explicit Expectations(double ttl) : ttl_ms(ttl) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+void* exp_new(double ttl_ms) { return new Expectations(ttl_ms); }
+
+void exp_free(void* h) { delete static_cast<Expectations*>(h); }
+
+void exp_set(void* h, const char* key, long long add, long long del) {
+  auto* e = static_cast<Expectations*>(h);
+  std::lock_guard<std::mutex> lk(e->mu);
+  e->store[key] = {add, del, Clock::now()};
+}
+
+void exp_raise(void* h, const char* key, long long add, long long del) {
+  auto* e = static_cast<Expectations*>(h);
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->store.find(key);
+  if (it == e->store.end()) {
+    e->store[key] = {add, del, Clock::now()};
+  } else {
+    it->second.add += add;
+    it->second.del += del;
+  }
+}
+
+void exp_lower(void* h, const char* key, long long add, long long del) {
+  auto* e = static_cast<Expectations*>(h);
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->store.find(key);
+  if (it != e->store.end()) {
+    it->second.add -= add;
+    it->second.del -= del;
+  }
+}
+
+// 1 = satisfied (fulfilled, expired, or never set), 0 = must wait.
+int exp_satisfied(void* h, const char* key) {
+  auto* e = static_cast<Expectations*>(h);
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->store.find(key);
+  if (it == e->store.end()) return 1;
+  const Expectation& exp = it->second;
+  if (exp.add <= 0 && exp.del <= 0) return 1;
+  auto age =
+      std::chrono::duration<double, std::milli>(Clock::now() - exp.stamp);
+  return age.count() > e->ttl_ms ? 1 : 0;
+}
+
+void exp_delete(void* h, const char* key) {
+  auto* e = static_cast<Expectations*>(h);
+  std::lock_guard<std::mutex> lk(e->mu);
+  e->store.erase(key);
+}
+
+int exp_count(void* h) {
+  auto* e = static_cast<Expectations*>(h);
+  std::lock_guard<std::mutex> lk(e->mu);
+  return static_cast<int>(e->store.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,37 @@
+// C ABI for the native operator runtime (loaded via ctypes from
+// tf_operator_tpu/native/__init__.py).
+#ifndef TPUOPERATOR_H_
+#define TPUOPERATOR_H_
+
+#include <cstdint>
+
+extern "C" {
+
+// ---- work queue (workqueue.cc) ----
+void* wq_new(double base_delay_ms, double max_delay_ms);
+void wq_free(void* h);
+void wq_add(void* h, const char* key);
+void wq_add_after(void* h, const char* key, double delay_ms);
+double wq_add_rate_limited(void* h, const char* key);
+int wq_get(void* h, double timeout_ms, char* buf, int buflen);
+void wq_done(void* h, const char* key);
+void wq_forget(void* h, const char* key);
+int wq_num_requeues(void* h, const char* key);
+int wq_len(void* h);
+int wq_pending_delayed(void* h);
+int wq_empty(void* h);
+void wq_shutdown(void* h);
+
+// ---- expectations (expectations.cc) ----
+void* exp_new(double ttl_ms);
+void exp_free(void* h);
+void exp_set(void* h, const char* key, long long add, long long del);
+void exp_raise(void* h, const char* key, long long add, long long del);
+void exp_lower(void* h, const char* key, long long add, long long del);
+int exp_satisfied(void* h, const char* key);
+void exp_delete(void* h, const char* key);
+int exp_count(void* h);
+
+}  // extern "C"
+
+#endif  // TPUOPERATOR_H_

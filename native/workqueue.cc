@@ -1,0 +1,218 @@
+// Native rate-limiting work queue — the operator's hot dispatch path.
+//
+// Same contract as client-go's workqueue (and the Python fallback in
+// tf_operator_tpu/k8s/informer.py): dedup on add, at-most-one worker per
+// item, dirty re-queue on done(), delayed adds via a min-heap serviced by
+// the getters themselves (no timer thread), per-item exponential backoff.
+//
+// Exposed through a flat C ABI for ctypes (see native/tpuoperator.h).
+
+#include "tpuoperator.h"
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Delayed {
+  Clock::time_point fire_at;
+  uint64_t seq;
+  std::string key;
+  bool operator>(const Delayed& o) const {
+    return fire_at != o.fire_at ? fire_at > o.fire_at : seq > o.seq;
+  }
+};
+
+struct WorkQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::string> queue;
+  std::unordered_set<std::string> dirty;
+  std::unordered_set<std::string> processing;
+  std::priority_queue<Delayed, std::vector<Delayed>, std::greater<Delayed>>
+      heap;
+  std::unordered_map<std::string, int> failures;
+  uint64_t seq = 0;
+  bool shutdown = false;
+  double base_delay_ms;
+  double max_delay_ms;
+
+  WorkQueue(double base_ms, double max_ms)
+      : base_delay_ms(base_ms), max_delay_ms(max_ms) {}
+
+  // caller holds mu
+  void add_locked(const std::string& key) {
+    if (shutdown || dirty.count(key)) return;
+    dirty.insert(key);
+    if (processing.count(key)) return;  // re-queued by done()
+    queue.push_back(key);
+    cv.notify_one();
+  }
+
+  // caller holds mu; move due delayed items onto the live queue
+  void drain_due_locked(Clock::time_point now) {
+    while (!heap.empty() && heap.top().fire_at <= now) {
+      std::string key = heap.top().key;
+      heap.pop();
+      add_locked(key);
+    }
+  }
+};
+
+int copy_out(const std::string& s, char* buf, int buflen) {
+  if (buf == nullptr || buflen <= 0) return -2;
+  if (s.size() > static_cast<size_t>(buflen) - 1) return -2;  // would truncate
+  int n = static_cast<int>(s.size());
+  std::memcpy(buf, s.data(), n);
+  buf[n] = '\0';
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wq_new(double base_delay_ms, double max_delay_ms) {
+  return new WorkQueue(base_delay_ms, max_delay_ms);
+}
+
+void wq_free(void* h) { delete static_cast<WorkQueue*>(h); }
+
+void wq_add(void* h, const char* key) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->add_locked(key);
+}
+
+void wq_add_after(void* h, const char* key, double delay_ms) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  if (q->shutdown) return;
+  if (delay_ms <= 0) {
+    q->add_locked(key);
+    return;
+  }
+  q->heap.push({Clock::now() + std::chrono::microseconds(
+                    static_cast<int64_t>(delay_ms * 1000)),
+                ++q->seq, key});
+  q->cv.notify_all();  // wake a getter so it re-computes its wait deadline
+}
+
+double wq_add_rate_limited(void* h, const char* key) {
+  auto* q = static_cast<WorkQueue*>(h);
+  double delay_ms;
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    int n = q->failures[key]++;
+    delay_ms = q->base_delay_ms;
+    for (int i = 0; i < n && delay_ms < q->max_delay_ms; i++) delay_ms *= 2;
+    delay_ms = std::min(delay_ms, q->max_delay_ms);
+  }
+  wq_add_after(h, key, delay_ms);
+  return delay_ms;
+}
+
+// Blocks up to timeout_ms (-1 = forever). Returns key length written into
+// buf, or -1 on timeout/shutdown-empty.
+int wq_get(void* h, double timeout_ms, char* buf, int buflen) {
+  auto* q = static_cast<WorkQueue*>(h);
+  auto deadline = timeout_ms < 0
+                      ? Clock::time_point::max()
+                      : Clock::now() + std::chrono::microseconds(
+                                           static_cast<int64_t>(timeout_ms * 1000));
+  std::unique_lock<std::mutex> lk(q->mu);
+  for (;;) {
+    q->drain_due_locked(Clock::now());
+    if (!q->queue.empty()) {
+      // copy out BEFORE mutating state: an oversized key returns -2 with the
+      // queue untouched, so the caller can raise instead of wedging the item
+      // half-processed
+      int n = copy_out(q->queue.front(), buf, buflen);
+      if (n < 0) return n;
+      std::string key = q->queue.front();
+      q->queue.pop_front();
+      q->dirty.erase(key);
+      q->processing.insert(key);
+      return n;
+    }
+    if (q->shutdown) return -1;
+    auto wake = deadline;
+    if (!q->heap.empty()) wake = std::min(wake, q->heap.top().fire_at);
+    if (wake == Clock::time_point::max()) {
+      q->cv.wait(lk);
+    } else {
+      if (q->cv.wait_until(lk, wake) == std::cv_status::timeout &&
+          Clock::now() >= deadline && deadline != Clock::time_point::max()) {
+        // one last drain so a just-due delayed item isn't missed
+        q->drain_due_locked(Clock::now());
+        if (q->queue.empty()) return -1;
+      }
+    }
+  }
+}
+
+void wq_done(void* h, const char* key) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->processing.erase(key);
+  if (q->dirty.count(key) &&
+      std::find(q->queue.begin(), q->queue.end(), key) == q->queue.end()) {
+    q->queue.push_back(key);
+    q->cv.notify_one();
+  }
+}
+
+void wq_forget(void* h, const char* key) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->failures.erase(key);
+}
+
+int wq_num_requeues(void* h, const char* key) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  auto it = q->failures.find(key);
+  return it == q->failures.end() ? 0 : it->second;
+}
+
+int wq_len(void* h) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  // count due-but-undrained items too so len() can't transiently read 0
+  // while a delayed item is already due
+  q->drain_due_locked(Clock::now());
+  return static_cast<int>(q->queue.size());
+}
+
+int wq_pending_delayed(void* h) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int>(q->heap.size());
+}
+
+int wq_empty(void* h) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->drain_due_locked(Clock::now());
+  return q->queue.empty() && q->processing.empty() ? 1 : 0;
+}
+
+void wq_shutdown(void* h) {
+  auto* q = static_cast<WorkQueue*>(h);
+  std::lock_guard<std::mutex> lk(q->mu);
+  q->shutdown = true;
+  q->cv.notify_all();
+}
+
+}  // extern "C"

@@ -29,7 +29,6 @@ from tf_operator_tpu.k8s import objects
 from tf_operator_tpu.k8s.fake import ApiError, NotFoundError
 from tf_operator_tpu.k8s.informer import (
     Lister,
-    RateLimitingQueue,
     ResourceEventHandler,
     SharedIndexInformer,
     SharedInformerFactory,
@@ -53,7 +52,10 @@ class _KindController:
                 gang_scheduler_name=manager.options.gang_scheduler_name,
             ),
         )
-        self.queue = RateLimitingQueue()
+        # C++ work queue (native/workqueue.cc) when built, Python otherwise
+        from tf_operator_tpu.native import make_queue
+
+        self.queue = make_queue()
         self.informer = manager.factory.for_kind(kind)
         self.lister = Lister(self.informer)
         self.informer.add_event_handler(

@@ -93,7 +93,15 @@ class JobEngine:
         self.adapter = adapter
         self.config = config or EngineConfig()
         self.clock = clock
-        self.expectations = ControllerExpectations(clock=clock)
+        if clock is time.time:
+            # hot path: C++ expectations (native/expectations.cc) when built;
+            # a test-injected clock forces the Python implementation since the
+            # native library keeps its own monotonic timestamps
+            from tf_operator_tpu.native import make_expectations
+
+            self.expectations = make_expectations()
+        else:
+            self.expectations = ControllerExpectations(clock=clock)
         self.pod_control = pod_control or PodControl(cluster)
         self.service_control = service_control or ServiceControl(cluster)
         # informer-style hooks: observe creations/deletions for expectations

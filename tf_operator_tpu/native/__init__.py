@@ -1,0 +1,224 @@
+"""ctypes bindings for the native (C++) operator runtime core.
+
+The reference's only native component is its Go operator binary; here the
+operator's hot paths — the work queue the reconcile dispatch spins on and
+the expectations counters consulted on every sync — are C++
+(native/workqueue.cc, native/expectations.cc), built by `make native` into
+libtpuoperator.so next to this file.
+
+`make_queue()` / `make_expectations()` return the native implementation
+when the library is present (and TPU_OPERATOR_NATIVE != 0), else the pure
+Python fallback, behind identical interfaces — callers never branch.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_LIB_NAME = "libtpuoperator.so"
+_MAX_KEY = 4096
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("TPU_OPERATOR_NATIVE", "1") == "0":
+        return None
+    path = os.path.join(os.path.dirname(__file__), _LIB_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.wq_new.restype = ctypes.c_void_p
+    lib.wq_new.argtypes = [ctypes.c_double, ctypes.c_double]
+    lib.wq_free.argtypes = [ctypes.c_void_p]
+    lib.wq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_add_after.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+    lib.wq_add_rate_limited.restype = ctypes.c_double
+    lib.wq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_get.restype = ctypes.c_int
+    lib.wq_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_double,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.wq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_num_requeues.restype = ctypes.c_int
+    lib.wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.wq_len.restype = ctypes.c_int
+    lib.wq_len.argtypes = [ctypes.c_void_p]
+    lib.wq_pending_delayed.restype = ctypes.c_int
+    lib.wq_pending_delayed.argtypes = [ctypes.c_void_p]
+    lib.wq_empty.restype = ctypes.c_int
+    lib.wq_empty.argtypes = [ctypes.c_void_p]
+    lib.wq_shutdown.argtypes = [ctypes.c_void_p]
+    lib.exp_new.restype = ctypes.c_void_p
+    lib.exp_new.argtypes = [ctypes.c_double]
+    lib.exp_free.argtypes = [ctypes.c_void_p]
+    for fn in ("exp_set", "exp_raise", "exp_lower"):
+        getattr(lib, fn).argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_longlong,
+            ctypes.c_longlong,
+        ]
+    lib.exp_satisfied.restype = ctypes.c_int
+    lib.exp_satisfied.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.exp_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.exp_count.restype = ctypes.c_int
+    lib.exp_count.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_loaded = False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_loaded
+    with _lib_lock:
+        if not _lib_loaded:
+            _lib = _load()
+            _lib_loaded = True
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+class NativeRateLimitingQueue:
+    """Same contract as k8s.informer.RateLimitingQueue, backed by C++.
+
+    Keys must be str (the operator only ever queues namespace/name keys)
+    and shorter than 4 KiB; oversized keys raise ValueError."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError(f"{_LIB_NAME} not built (run `make native`)")
+        self._h = self._lib.wq_new(base_delay * 1000.0, max_delay * 1000.0)
+        self._shutting_down = False
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.wq_free(h)
+
+    def add(self, item: str) -> None:
+        self._lib.wq_add(self._h, item.encode())
+
+    def add_after(self, item: str, delay: float) -> None:
+        self._lib.wq_add_after(self._h, item.encode(), delay * 1000.0)
+
+    def add_rate_limited(self, item: str) -> None:
+        self._lib.wq_add_rate_limited(self._h, item.encode())
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        timeout_ms = -1.0 if timeout is None else timeout * 1000.0
+        # each blocking getter needs its own buffer (get() may run on many
+        # worker threads concurrently)
+        buf = ctypes.create_string_buffer(_MAX_KEY)
+        n = self._lib.wq_get(self._h, timeout_ms, buf, _MAX_KEY)
+        if n == -2:
+            raise ValueError(f"queued key exceeds {_MAX_KEY - 1} bytes")
+        if n < 0:
+            return None
+        return buf.raw[:n].decode()
+
+    def done(self, item: str) -> None:
+        self._lib.wq_done(self._h, item.encode())
+
+    def forget(self, item: str) -> None:
+        self._lib.wq_forget(self._h, item.encode())
+
+    def num_requeues(self, item: str) -> int:
+        return self._lib.wq_num_requeues(self._h, item.encode())
+
+    def __len__(self) -> int:
+        return self._lib.wq_len(self._h)
+
+    def pending_delayed(self) -> int:
+        return self._lib.wq_pending_delayed(self._h)
+
+    def empty(self) -> bool:
+        return bool(self._lib.wq_empty(self._h))
+
+    @property
+    def shutting_down(self) -> bool:
+        return self._shutting_down
+
+    def shut_down(self) -> None:
+        self._shutting_down = True
+        self._lib.wq_shutdown(self._h)
+
+
+class NativeControllerExpectations:
+    """Same contract as engine.expectations.ControllerExpectations."""
+
+    def __init__(self, ttl_seconds: float = 300.0):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError(f"{_LIB_NAME} not built (run `make native`)")
+        self._h = self._lib.exp_new(ttl_seconds * 1000.0)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.exp_free(h)
+
+    def set_expectations(self, key: str, add: int, delete: int) -> None:
+        self._lib.exp_set(self._h, key.encode(), add, delete)
+
+    def expect_creations(self, key: str, adds: int) -> None:
+        self.set_expectations(key, adds, 0)
+
+    def expect_deletions(self, key: str, dels: int) -> None:
+        self.set_expectations(key, 0, dels)
+
+    def raise_expectations(self, key: str, add: int, delete: int) -> None:
+        self._lib.exp_raise(self._h, key.encode(), add, delete)
+
+    def lower_expectations(self, key: str, add: int, delete: int) -> None:
+        self._lib.exp_lower(self._h, key.encode(), add, delete)
+
+    def creation_observed(self, key: str) -> None:
+        self._lib.exp_lower(self._h, key.encode(), 1, 0)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lib.exp_lower(self._h, key.encode(), 0, 1)
+
+    def satisfied_expectations(self, key: str) -> bool:
+        return bool(self._lib.exp_satisfied(self._h, key.encode()))
+
+    def delete_expectations(self, key: str) -> None:
+        self._lib.exp_delete(self._h, key.encode())
+
+
+def make_queue(base_delay: float = 0.005, max_delay: float = 1000.0):
+    """Native queue when built, else the Python RateLimitingQueue — with the
+    same backoff tuning either way."""
+    if native_available():
+        return NativeRateLimitingQueue(base_delay=base_delay, max_delay=max_delay)
+    from tf_operator_tpu.k8s.informer import (
+        ItemExponentialFailureRateLimiter,
+        RateLimitingQueue,
+    )
+
+    return RateLimitingQueue(
+        ItemExponentialFailureRateLimiter(base_delay=base_delay, max_delay=max_delay)
+    )
+
+
+def make_expectations():
+    """Native expectations when built, else the Python fallback."""
+    if native_available():
+        return NativeControllerExpectations()
+    from tf_operator_tpu.engine.expectations import ControllerExpectations
+
+    return ControllerExpectations()
