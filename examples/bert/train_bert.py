@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Config #4: BERT-large pretraining (masked-LM objective) on a TPU slice.
+
+dp×fsdp mesh: batch sharded over both axes, params sharded over fsdp
+(HBM capacity), flash-attention pallas kernel on the MXU hot path
+(ops/flash_attention.py). The reference runs the equivalent via
+TPUStrategy inside a TF container (SURVEY §2.10 row 'TPU-native
+equivalents'); here the framework owns the math end to end.
+"""
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.transformer import Transformer, bert_large, tiny
+from tf_operator_tpu.parallel.mesh import make_mesh, local_mesh_axes
+from tf_operator_tpu.parallel.tp import state_sharding
+from tf_operator_tpu.runtime import bootstrap
+from tf_operator_tpu.runtime.loop import PreemptionGuard, run_training
+from tf_operator_tpu.runtime.profiler import Profiler
+from tf_operator_tpu.runtime.train import Checkpointer, TrainState
+
+
+def mlm_batches(batch: int, seq_len: int, vocab: int, seed: int):
+    """Synthetic masked-LM batches: (tokens, labels); label -100 = unmasked."""
+    key = jax.random.PRNGKey(seed)
+    while True:
+        key, k1, k2 = jax.random.split(key, 3)
+        tokens = jax.random.randint(k1, (batch, seq_len), 0, vocab)
+        mask = jax.random.bernoulli(k2, 0.15, (batch, seq_len))
+        labels = jnp.where(mask, tokens, -100)
+        yield (jnp.where(mask, 103, tokens), labels)  # 103 = [MASK]
+
+
+def make_mlm_step(model, tx, mesh):
+    def step(state: TrainState, tokens, labels):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens, train=True)
+            valid = labels >= 0
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), jnp.maximum(labels, 0)
+            )
+            return (ce * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10000)
+    ap.add_argument("--per-host-batch", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--smoke", action="store_true", help="tiny model, CPU ok")
+    args = ap.parse_args(argv)
+
+    info = bootstrap.initialize()
+    cfg = tiny() if args.smoke else bert_large(remat=True)
+    seq_len = min(args.seq_len, cfg.max_len)
+    mesh = make_mesh(axes=local_mesh_axes(jax.device_count()))
+    print(f"host {info.process_id}/{info.num_processes}, mesh {dict(mesh.shape)}")
+
+    model = Transformer(cfg)
+    tx = optax.adamw(1e-4, weight_decay=0.01)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((args.per_host_batch, seq_len), jnp.int32)
+    params = model.init(rng, sample, train=False)["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), batch_stats={}, tx=tx,
+    )
+    # shard params/opt-state over the mesh (tp + fsdp overlay)
+    state = jax.device_put(state, state_sharding(state, mesh))
+
+    res = run_training(
+        state,
+        make_mlm_step(model, tx, mesh),
+        mlm_batches(args.per_host_batch, seq_len, cfg.vocab_size,
+                    seed=info.process_id),
+        num_steps=args.steps,
+        checkpointer=Checkpointer(args.ckpt_dir) if args.ckpt_dir else None,
+        profiler=Profiler(batch_size=args.per_host_batch * jax.process_count()),
+        guard=PreemptionGuard(),
+        metrics_sink=print,
+    )
+    print(f"done: steps={res.steps_run} loss={res.last_metrics.get('loss')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Config #2: distributed MNIST under the PS+Worker topology.
+
+Reads the operator-injected TF_CONFIG (the same contract the reference's
+dist_mnist.py consumes, reference examples/v1/dist-mnist/dist_mnist.py) and
+reports its role. PS replicas idle-serve (TF parameter-server semantics
+live in TF containers); workers run data-parallel training over their local
+devices — demonstrating that the env contract carries everything a
+framework needs to self-assemble.
+"""
+import argparse
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.mnist import MnistMLP
+from tf_operator_tpu.runtime.loop import run_training
+from tf_operator_tpu.runtime.train import create_train_state, make_train_step
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    tf_config = json.loads(os.environ.get("TF_CONFIG", "{}"))
+    task = tf_config.get("task", {})
+    role, index = task.get("type", "worker"), task.get("index", 0)
+    cluster = tf_config.get("cluster", {})
+    print(f"role={role} index={index} cluster_keys={sorted(cluster)}")
+
+    if role == "ps":
+        # a TF parameter server would block serving variables here; the
+        # JAX-native path has no PS — exit cleanly so the job can succeed
+        # under the worker-0 success rule
+        print("ps replica: parameter serving is framework-internal; idling")
+        return 0
+
+    model = MnistMLP()
+    sample = jnp.zeros((args.batch_size, 28, 28, 1))
+    state = create_train_state(
+        jax.random.PRNGKey(index), model, sample, optax.sgd(0.01)
+    )
+
+    def batches():
+        key = jax.random.PRNGKey(1000 + index)  # per-worker data shard
+        while True:
+            key, k1, k2 = jax.random.split(key, 3)
+            yield (
+                jax.random.normal(k1, (args.batch_size, 28, 28, 1)),
+                jax.random.randint(k2, (args.batch_size,), 0, 10),
+            )
+
+    res = run_training(
+        state, make_train_step(model), batches(),
+        num_steps=args.steps, metrics_sink=print,
+    )
+    print(f"worker {index} done: steps={res.steps_run}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Config #5: T5-3B-scale decoder LM on a multi-host slice with gang
+preemption recovery.
+
+The full parallelism stack: dp×fsdp×tp mesh, tensor-parallel attention/MLP
+sharding (parallel/tp.py), remat blocks, causal LM loss; checkpoint to the
+PVC every save interval AND on SIGTERM, so a preempted slice resumes from
+at most one step back after the operator's whole-gang restart.
+"""
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    lm_train_loss,
+    t5_3b_decoder,
+    tiny,
+)
+from tf_operator_tpu.parallel.mesh import make_mesh, local_mesh_axes
+from tf_operator_tpu.parallel.tp import state_sharding
+from tf_operator_tpu.runtime import bootstrap
+from tf_operator_tpu.runtime.loop import PreemptionGuard, run_training
+from tf_operator_tpu.runtime.profiler import Profiler
+from tf_operator_tpu.runtime.train import Checkpointer, TrainState
+
+
+def lm_batches(batch: int, seq_len: int, vocab: int, seed: int):
+    key = jax.random.PRNGKey(seed)
+    while True:
+        key, k = jax.random.split(key)
+        yield (jax.random.randint(k, (batch, seq_len), 0, vocab),)
+
+
+def make_lm_step(model):
+    def step(state: TrainState, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_train_loss(model, p, tokens)
+        )(state.params)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100_000)
+    ap.add_argument("--per-host-batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--save-interval", type=int, default=500)
+    ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
+    ap.add_argument("--smoke", action="store_true", help="tiny model, CPU ok")
+    args = ap.parse_args(argv)
+
+    info = bootstrap.initialize()
+    cfg = tiny(causal=True) if args.smoke else t5_3b_decoder(remat=True)
+    seq_len = min(args.seq_len, cfg.max_len)
+    mesh = make_mesh(axes=local_mesh_axes(jax.device_count(), prefer_tp=args.tp))
+    print(f"host {info.process_id}/{info.num_processes} slice "
+          f"{info.slice_id}/{info.num_slices}, mesh {dict(mesh.shape)}")
+
+    model = Transformer(cfg)
+    tx = optax.adafactor(1e-3)
+    sample = jnp.zeros((args.per_host_batch, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), sample, train=False)["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), batch_stats={}, tx=tx,
+    )
+    state = jax.device_put(state, state_sharding(state, mesh))
+
+    res = run_training(
+        state,
+        make_lm_step(model),
+        lm_batches(args.per_host_batch, seq_len, cfg.vocab_size,
+                   seed=info.process_id),
+        num_steps=args.steps,
+        checkpointer=Checkpointer(args.ckpt_dir) if args.ckpt_dir else None,
+        save_interval_steps=args.save_interval,
+        profiler=Profiler(batch_size=args.per_host_batch * jax.process_count()),
+        guard=PreemptionGuard(),
+        metrics_sink=print,
+    )
+    status = "preempted (checkpointed)" if res.preempted else "complete"
+    print(f"{status}: steps={res.steps_run} resumed_from={res.resumed_from}")
+    return 0 if not res.preempted else 143  # 143 = retryable, gang restarts
+
+
+if __name__ == "__main__":
+    sys.exit(main())

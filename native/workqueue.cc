@@ -166,8 +166,9 @@ void wq_done(void* h, const char* key) {
   auto* q = static_cast<WorkQueue*>(h);
   std::lock_guard<std::mutex> lk(q->mu);
   q->processing.erase(key);
-  if (q->dirty.count(key) &&
-      std::find(q->queue.begin(), q->queue.end(), key) == q->queue.end()) {
+  // invariant: a key dirty while processing is never also in the queue
+  // (add_locked skips the push when processing), so no membership scan
+  if (q->dirty.count(key)) {
     q->queue.push_back(key);
     q->cv.notify_one();
   }

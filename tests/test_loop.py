@@ -1,0 +1,205 @@
+"""Training-loop runner: checkpoint/resume, preemption save, profiler hooks.
+
+The operator side of resume (pod recreation with stable identity) is tested
+in test_e2e.py; this covers the framework side the reference leaves to
+user containers (SURVEY §5.4) — restore-from-latest, interval saves, and
+SIGTERM-latched final saves.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from tf_operator_tpu.runtime.loop import PreemptionGuard, run_training
+from tf_operator_tpu.runtime.profiler import Profiler, StepProfile
+from tf_operator_tpu.runtime.train import Checkpointer, create_train_state
+
+
+class _TinyModel:
+    """Minimal flax-like model for loop tests (linear classifier)."""
+
+    def init(self, rng, x, train=False):
+        return {"params": {"w": jnp.zeros((x.shape[-1], 4)), "b": jnp.zeros(4)}}
+
+    def apply(self, variables, x, train=False):
+        p = variables["params"]
+        return x @ p["w"] + p["b"]
+
+
+def _make_state():
+    model = _TinyModel()
+    x = jnp.ones((2, 8))
+    return create_train_state(jax.random.PRNGKey(0), model, x, optax.sgd(0.1))
+
+
+def _train_step(state, x, y):
+    def loss_fn(params):
+        logits = x @ params["w"] + params["b"]
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    return state.apply_gradients(grads), {"loss": loss}
+
+
+def _batches(n=10_000):
+    k = jax.random.PRNGKey(1)
+    x = jax.random.normal(k, (2, 8))
+    y = jnp.array([0, 1])
+    for _ in range(n):
+        yield (x, y)
+
+
+def test_loop_runs_to_num_steps():
+    res = run_training(_make_state(), _train_step, _batches(), num_steps=7)
+    assert res.steps_run == 7
+    assert int(res.state.step) == 7
+    assert not res.preempted
+    assert res.resumed_from is None
+    assert "loss" in res.last_metrics
+
+
+def test_checkpoint_resume_continues_where_left_off(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    res1 = run_training(
+        _make_state(),
+        _train_step,
+        _batches(),
+        num_steps=5,
+        checkpointer=Checkpointer(ckpt_dir),
+        save_interval_steps=2,
+    )
+    assert int(res1.state.step) == 5
+    # "pod recreated": fresh state object, same checkpoint dir
+    res2 = run_training(
+        _make_state(),
+        _train_step,
+        _batches(),
+        num_steps=8,
+        checkpointer=Checkpointer(ckpt_dir),
+        save_interval_steps=2,
+    )
+    assert res2.resumed_from == 5
+    assert res2.steps_run == 3  # only the remaining steps
+    assert int(res2.state.step) == 8
+
+
+def test_resume_params_match_uninterrupted_run(tmp_path):
+    full = run_training(_make_state(), _train_step, _batches(), num_steps=6)
+    ckpt_dir = str(tmp_path / "ckpt")
+    run_training(
+        _make_state(), _train_step, _batches(), num_steps=3,
+        checkpointer=Checkpointer(ckpt_dir),
+    )
+    resumed = run_training(
+        _make_state(), _train_step, _batches(), num_steps=6,
+        checkpointer=Checkpointer(ckpt_dir),
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(full.state.params),
+        jax.tree_util.tree_leaves(resumed.state.params),
+    ):
+        assert jnp.allclose(a, b, atol=1e-6), "resume must not fork training"
+
+
+def test_preemption_triggers_final_save(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    guard = PreemptionGuard(install=False)
+
+    sink_calls = []
+
+    def preempting_batches():
+        for i, b in enumerate(_batches()):
+            if i == 3:
+                guard.trigger()  # SIGTERM mid-training
+            yield b
+
+    res = run_training(
+        _make_state(),
+        _train_step,
+        preempting_batches(),
+        num_steps=100,
+        checkpointer=Checkpointer(ckpt_dir),
+        save_interval_steps=50,  # interval save would never fire
+        guard=guard,
+        metrics_sink=sink_calls.append,
+    )
+    assert res.preempted
+    assert res.steps_run == 4  # steps 0-3 ran; flag checked at loop top
+    # the preemption save captured progress even though interval didn't
+    assert Checkpointer(ckpt_dir).latest_step() == 4
+
+
+def test_no_resave_when_resume_finds_run_complete(tmp_path):
+    """A recreated pod whose run already finished must not re-save the
+    final step (orbax raises StepAlreadyExistsError on duplicate saves)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    run_training(
+        _make_state(), _train_step, _batches(), num_steps=3,
+        checkpointer=Checkpointer(ckpt_dir),
+    )
+    res = run_training(
+        _make_state(), _train_step, _batches(), num_steps=3,
+        checkpointer=Checkpointer(ckpt_dir),
+    )
+    assert res.steps_run == 0
+    assert res.resumed_from == 3
+
+
+def test_loop_emits_metrics_lines():
+    lines = []
+    run_training(
+        _make_state(),
+        _train_step,
+        _batches(),
+        num_steps=6,
+        log_interval_steps=2,
+        profiler=Profiler(batch_size=2),
+        metrics_sink=lines.append,
+    )
+    assert len(lines) == 3
+    payload = json.loads(lines[-1])
+    assert payload["step"] == 6
+    assert payload["steps_per_sec"] > 0
+    assert payload["examples_per_sec"] > 0
+    assert "loss" in payload
+
+
+def test_step_profile_stats():
+    p = StepProfile(window=10)
+    for _ in range(5):
+        p.tick()
+    assert p.steps_recorded == 4
+    assert p.steps_per_sec() > 0
+    assert p.percentile(50) >= 0
+    assert p.percentile(99) >= p.percentile(50)
+    p.reset()
+    assert p.steps_recorded == 0
+    assert p.steps_per_sec() == 0.0
+
+
+def test_profiler_trace_window_writes_trace(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    prof = Profiler(trace_dir=trace_dir)
+    with prof.trace_window():
+        with prof.step(0):
+            jnp.square(jnp.arange(16.0)).block_until_ready()
+    import os
+
+    found = []
+    for root, _, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, "profiler must write a device trace"
+
+
+def test_guard_signal_latch_and_uninstall():
+    import signal as sig
+
+    guard = PreemptionGuard(install=True)
+    try:
+        assert not guard.preempted
+        sig.raise_signal(sig.SIGTERM)
+        assert guard.preempted
+    finally:
+        guard.uninstall()

@@ -91,5 +91,6 @@ def initialize(env: Optional[Dict[str, str]] = None) -> SliceInfo:
 
 
 def default_mesh(axes: Optional[Dict[str, int]] = None):
-    """Mesh over all (global) devices; call after initialize()."""
-    return make_mesh(axes=axes)
+    """Mesh over all (global) devices; call after initialize(). Without
+    `axes`, everything lands on the dp axis."""
+    return make_mesh(axes=axes if axes is not None else {"dp": -1})

@@ -1,0 +1,144 @@
+"""Training loop runner — checkpoint/resume + preemption-aware save + profiler.
+
+The reference's "resume" is pod recreation with stable identity; the
+framework inside the container is responsible for restoring its own state
+(SURVEY.md §5.4). This module is that framework side, TPU-first:
+
+  - resume-from-latest on start (the recreated pod finds its checkpoint);
+  - periodic async-friendly saves every `save_interval_steps`;
+  - preemption-aware save: SIGTERM (TPU maintenance/preemption sends it
+    ahead of the kill) triggers one final checkpoint, so a whole-slice
+    gang restart (controllers/tpu.py exit-code policy) loses at most the
+    in-flight step, not the save interval;
+  - profiler hooks (runtime/profiler.py) + metrics lines on stdout.
+
+The loop itself stays jit-friendly: the python loop only feeds batches and
+reads back metrics; the step is one compiled SPMD program.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from tf_operator_tpu.runtime.profiler import Profiler
+from tf_operator_tpu.runtime.train import Checkpointer, TrainState
+from tf_operator_tpu.utils.logging import get_logger
+
+log = get_logger("runtime.loop")
+
+
+class PreemptionGuard:
+    """Latches SIGTERM/SIGINT so the loop can checkpoint before dying.
+
+    TPU preemption/maintenance deletes the pod; kubelet delivers SIGTERM
+    and waits terminationGracePeriodSeconds — enough for one save. The
+    guard only latches a flag; the loop decides when to act (never save
+    mid-step)."""
+
+    def __init__(self, install: bool = True) -> None:
+        self._preempted = threading.Event()
+        self._prev_handlers: Dict[int, Any] = {}
+        if install and threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+
+    def _on_signal(self, signum, frame) -> None:
+        log.warning("received signal %s: will checkpoint and stop", signum)
+        self._preempted.set()
+
+    def trigger(self) -> None:
+        """Test hook / manual preemption injection."""
+        self._preempted.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted.is_set()
+
+    def uninstall(self) -> None:
+        for sig, handler in self._prev_handlers.items():
+            signal.signal(sig, handler)
+        self._prev_handlers.clear()
+
+
+@dataclass
+class LoopResult:
+    state: Any
+    steps_run: int
+    preempted: bool
+    resumed_from: Optional[int]
+    last_metrics: Dict[str, float]
+
+
+def run_training(
+    state: TrainState,
+    train_step: Callable,
+    batches: Iterable,
+    num_steps: int,
+    checkpointer: Optional[Checkpointer] = None,
+    save_interval_steps: int = 100,
+    profiler: Optional[Profiler] = None,
+    guard: Optional[PreemptionGuard] = None,
+    log_interval_steps: int = 50,
+    metrics_sink: Optional[Callable[[str], None]] = None,
+) -> LoopResult:
+    """Run up to `num_steps` total steps (counting restored progress).
+
+    `batches` yields (inputs, labels) tuples; `train_step(state, *batch)`
+    returns (state, metrics). Resume: if `checkpointer` has a saved step,
+    restore and continue from there — the recreated pod converges to the
+    same loop position (reference semantics: identical pod name/DNS, state
+    from the framework's own checkpoint)."""
+    resumed_from = None
+    if checkpointer is not None:
+        latest = checkpointer.latest_step()
+        if latest is not None:
+            state = checkpointer.restore(state)
+            resumed_from = latest
+            log.info("resumed from checkpoint step %d", latest)
+
+    profiler = profiler or Profiler()
+    guard = guard or PreemptionGuard(install=False)
+    step = int(state.step)
+    steps_run = 0
+    last_metrics: Dict[str, float] = {}
+    it = iter(batches)
+
+    while step < num_steps:
+        if guard.preempted:
+            break
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        with profiler.step(step):
+            state, metrics = train_step(state, *batch)
+        step += 1
+        steps_run += 1
+        last_metrics = {k: float(v) for k, v in metrics.items()}
+
+        if checkpointer is not None and step % save_interval_steps == 0:
+            checkpointer.save(step, state)
+        if step % log_interval_steps == 0:
+            line = profiler.metrics_line(step, extra=last_metrics)
+            (metrics_sink or (lambda s: log.info("%s", s)))(line)
+
+    preempted = guard.preempted
+    if (
+        checkpointer is not None
+        and steps_run > 0
+        and (preempted or step % save_interval_steps)
+    ):
+        # final save: on preemption ALWAYS; on clean exit only if the last
+        # interval save didn't already capture this step. steps_run == 0
+        # (e.g. a recreated pod that restored an already-complete run) has
+        # nothing new to save — re-saving an existing step would raise
+        checkpointer.save(step, state)
+    return LoopResult(
+        state=state,
+        steps_run=steps_run,
+        preempted=preempted,
+        resumed_from=resumed_from,
+        last_metrics=last_metrics,
+    )

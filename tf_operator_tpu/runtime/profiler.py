@@ -1,0 +1,150 @@
+"""Profiling/tracing subsystem — XLA/JAX profiler hooks + per-step metrics.
+
+The reference has no in-operator tracing (SURVEY.md §5.1: observability is
+metrics + logs + events; cAdvisor for container stats). On TPU the profiler
+is first-class: `jax.profiler` captures device traces (MXU utilization,
+HBM transfers, ICI collectives) viewable in TensorBoard/XProf, and the
+per-step wall-clock stream is the operator's throughput signal.
+
+Pieces:
+  - `StepProfile`: ring-buffer of per-step wall times -> steps/sec, p50/p99.
+  - `annotate_step(n)`: StepTraceAnnotation so device traces align to steps.
+  - `Profiler`: programmatic trace capture (start/stop or N-step window),
+    plus a metrics-line emitter the runner ships to stdout for scraping
+    (the analogue of the reference's prometheus counters, SURVEY.md §5.5).
+"""
+from __future__ import annotations
+
+import json
+import math
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+import jax
+
+
+def annotate_step(step: int):
+    """Context manager marking one train step in the device trace
+    (jax.profiler.StepTraceAnnotation)."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+@dataclass
+class StepProfile:
+    """Per-step wall-time stats over a sliding window."""
+
+    window: int = 200
+    _times: List[float] = field(default_factory=list)
+    _last: Optional[float] = None
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+            if len(self._times) > self.window:
+                self._times.pop(0)
+        self._last = now
+
+    def reset(self) -> None:
+        self._times.clear()
+        self._last = None
+
+    @property
+    def steps_recorded(self) -> int:
+        return len(self._times)
+
+    def steps_per_sec(self) -> float:
+        if not self._times:
+            return 0.0
+        return len(self._times) / sum(self._times)
+
+    def percentile(self, q: float) -> float:
+        """q-th percentile step time in seconds (q in [0, 100])."""
+        if not self._times:
+            return 0.0
+        xs = sorted(self._times)
+        idx = min(len(xs) - 1, max(0, math.ceil(q / 100.0 * len(xs)) - 1))
+        return xs[idx]
+
+    def summary(self, batch_size: Optional[int] = None) -> Dict[str, float]:
+        s: Dict[str, float] = {
+            "steps_per_sec": self.steps_per_sec(),
+            "step_time_p50_ms": self.percentile(50) * 1e3,
+            "step_time_p99_ms": self.percentile(99) * 1e3,
+        }
+        if batch_size is not None:
+            s["examples_per_sec"] = self.steps_per_sec() * batch_size
+        return s
+
+
+class Profiler:
+    """Programmatic jax.profiler capture + metrics emission.
+
+    `trace_dir` enables device-trace capture; without it the profiler still
+    tracks step stats (zero-overhead in the hot loop beyond a perf_counter
+    read per step)."""
+
+    def __init__(
+        self,
+        trace_dir: Optional[str] = None,
+        batch_size: Optional[int] = None,
+        window: int = 200,
+    ) -> None:
+        self.trace_dir = trace_dir
+        self.batch_size = batch_size
+        self.steps = StepProfile(window=window)
+        self._tracing = False
+
+    # ------------------------------------------------------------- tracing
+    def start_trace(self) -> None:
+        if self.trace_dir and not self._tracing:
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+
+    def stop_trace(self) -> None:
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    @contextmanager
+    def trace_window(self) -> Iterator[None]:
+        """Capture a device trace for the enclosed steps."""
+        self.start_trace()
+        try:
+            yield
+        finally:
+            self.stop_trace()
+
+    @contextmanager
+    def step(self, n: int) -> Iterator[None]:
+        """Wrap one train step: trace annotation + wall-time tick."""
+        with annotate_step(n):
+            yield
+        self.steps.tick()
+
+    # ------------------------------------------------------------- metrics
+    def metrics_line(self, step: int, extra: Optional[Dict] = None) -> str:
+        """One JSON line of progress metrics (shipped to stdout; the
+        in-container analogue of the operator's prometheus counters)."""
+        payload = {"step": step, **self.steps.summary(self.batch_size)}
+        if extra:
+            payload.update(
+                {
+                    k: (float(v) if hasattr(v, "item") else v)
+                    for k, v in extra.items()
+                }
+            )
+        return json.dumps(payload)
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Per-device HBM usage {device: bytes_in_use} where the backend exposes
+    it (TPU/GPU; CPU returns {})."""
+    out: Dict[str, int] = {}
+    for d in jax.local_devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats and "bytes_in_use" in stats:
+            out[str(d)] = int(stats["bytes_in_use"])
+    return out

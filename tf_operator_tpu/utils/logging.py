@@ -67,3 +67,7 @@ def logger_for_replica(job, rtype: str, index: Optional[int] = None) -> ContextL
 
 def logger_for_key(kind: str, key: str) -> ContextLogger:
     return logger_with({"kind": kind, "key": key})
+
+
+def get_logger(component: str) -> ContextLogger:
+    return logger_with({"component": component})
