@@ -34,7 +34,7 @@ def mlm_batches(batch: int, seq_len: int, vocab: int, seed: int):
         yield (jnp.where(mask, 103, tokens), labels)  # 103 = [MASK]
 
 
-def make_mlm_step(model, tx, mesh):
+def make_mlm_step(model):
     def step(state: TrainState, tokens, labels):
         def loss_fn(params):
             logits = model.apply({"params": params}, tokens, train=True)
@@ -79,7 +79,7 @@ def main(argv=None):
 
     res = run_training(
         state,
-        make_mlm_step(model, tx, mesh),
+        make_mlm_step(model),
         mlm_batches(args.per_host_batch, seq_len, cfg.vocab_size,
                     seed=info.process_id),
         num_steps=args.steps,

@@ -1,0 +1,295 @@
+// Native prefetching record loader — the host-side input pipeline.
+//
+// The reference has no data path at all (training data is the user
+// container's problem); on TPU the host input pipeline must keep the MXU
+// fed across the PCIe/HBM boundary, so this framework ships one: a fixed-
+// size-record binary format read by pread worker threads into a bounded
+// ring of batch buffers, consumed zero-copy-into-numpy via ctypes
+// (tf_operator_tpu/data/loader.py).
+//
+// File format (written by tf_operator_tpu.data.write_records):
+//   8 bytes  magic "TPUREC01"
+//   u64      record_size (bytes, little-endian)
+//   u64      n_records
+//   then n_records * record_size bytes of payload.
+//
+// Sharding: records are assigned round-robin to (shard_id of n_shards),
+// the multi-host split (one shard per TPU VM host). Shuffle: per-epoch
+// mt19937 permutation seeded by seed+epoch, identical on every host so
+// shards stay disjoint.
+
+#include "tpuoperator.h"
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <numeric>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#ifdef _WIN32
+#error "POSIX only"
+#endif
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'T', 'P', 'U', 'R', 'E', 'C', '0', '1'};
+
+struct RecordFile {
+  int fd = -1;
+  uint64_t record_size = 0;
+  uint64_t n_records = 0;
+  off_t payload_off = 0;
+};
+
+struct Batch {
+  std::vector<uint8_t> data;
+  bool filled = false;
+};
+
+struct Loader {
+  std::vector<RecordFile> files;
+  std::vector<std::pair<uint32_t, uint64_t>> index;  // (file, record) mine only
+  uint64_t record_size = 0;
+  int batch_size = 0;
+  uint64_t seed = 0;
+  int shard_id = 0;
+  int n_shards = 1;
+  bool shuffle = true;
+  bool loop_forever = true;
+
+  // ring of batch buffers
+  std::vector<Batch> ring;
+  size_t head = 0, tail = 0, count = 0;  // filled-batch FIFO over ring slots
+  std::mutex mu;
+  std::condition_variable cv_producer, cv_consumer;
+  bool stop = false;       // hard stop: error or destruction
+  bool exhausted = false;  // soft stop: non-looping data ran out
+  std::string error;
+
+  std::vector<std::thread> workers;
+  // producer cursor state (guarded by mu)
+  std::vector<uint64_t> order;
+  uint64_t cursor = 0;
+  uint64_t epoch = 0;
+  std::atomic<uint64_t> batches_produced{0};
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_producer.notify_all();
+    cv_consumer.notify_all();
+    for (auto& t : workers) t.join();
+    for (auto& f : files)
+      if (f.fd >= 0) close(f.fd);
+  }
+
+  void reshuffle_locked() {
+    order.resize(index.size());
+    std::iota(order.begin(), order.end(), 0);
+    if (shuffle) {
+      std::mt19937_64 rng(seed + epoch);
+      std::shuffle(order.begin(), order.end(), rng);
+    }
+    cursor = 0;
+  }
+
+  // claim the next batch worth of record ids; returns false at end-of-data
+  bool claim_locked(std::vector<uint64_t>& ids) {
+    // dl_new rejects 0 < index < batch_size, but keep the guard local too:
+    // a shard smaller than one batch can never produce (no within-batch
+    // repeats), looping or not
+    if (index.size() < static_cast<size_t>(batch_size)) return false;
+    if (cursor + batch_size > order.size()) {  // drop remainder
+      if (!loop_forever) return false;
+      epoch++;
+      reshuffle_locked();
+    }
+    ids.assign(order.begin() + cursor, order.begin() + cursor + batch_size);
+    cursor += batch_size;
+    return true;
+  }
+
+  void worker() {
+    std::vector<uint64_t> ids;
+    for (;;) {
+      size_t slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_producer.wait(lk,
+                         [&] { return stop || exhausted || count < ring.size(); });
+        if (stop || exhausted) return;
+        if (!claim_locked(ids)) {
+          // soft drain: peers may still be filling reserved slots — the
+          // consumer keeps reading until count hits 0, losing nothing
+          exhausted = true;
+          cv_producer.notify_all();
+          cv_consumer.notify_all();
+          return;
+        }
+        slot = tail;
+        tail = (tail + 1) % ring.size();
+        count++;  // reserve slot; consumer waits on `filled`
+      }
+      Batch& b = ring[slot];
+      uint8_t* dst = b.data.data();
+      for (int i = 0; i < batch_size; i++) {
+        const auto& [fi, rec] = index[ids[i]];
+        const RecordFile& f = files[fi];
+        off_t off = f.payload_off + static_cast<off_t>(rec * record_size);
+        size_t want = record_size;
+        uint8_t* p = dst + i * record_size;
+        while (want > 0) {
+          ssize_t n = pread(f.fd, p, want, off);
+          if (n <= 0) {
+            std::lock_guard<std::mutex> lk(mu);
+            error = "pread failed";
+            stop = true;
+            cv_consumer.notify_all();
+            return;
+          }
+          want -= n;
+          p += n;
+          off += n;
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        b.filled = true;
+        batches_produced++;
+      }
+      cv_consumer.notify_all();
+    }
+  }
+};
+
+bool open_file(const char* path, RecordFile& out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return false;
+  char magic[8];
+  uint64_t hdr[2];
+  if (pread(fd, magic, 8, 0) != 8 || memcmp(magic, kMagic, 8) != 0 ||
+      pread(fd, hdr, 16, 8) != 16) {
+    close(fd);
+    return false;
+  }
+  out.fd = fd;
+  out.record_size = hdr[0];
+  out.n_records = hdr[1];
+  out.payload_off = 24;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: '\n'-separated record files. Returns nullptr on any open/header
+// failure or record-size mismatch between files.
+void* dl_new(const char* paths, int batch_size, int prefetch_depth,
+             int n_threads, int shard_id, int n_shards, uint64_t seed,
+             int shuffle, int loop_forever) {
+  if (batch_size <= 0 || prefetch_depth <= 0 || n_threads <= 0 ||
+      n_shards <= 0 || shard_id < 0 || shard_id >= n_shards)
+    return nullptr;
+  auto loader = std::make_unique<Loader>();
+  std::string all(paths), item;
+  size_t start = 0;
+  while (start <= all.size()) {
+    size_t nl = all.find('\n', start);
+    item = all.substr(start, nl == std::string::npos ? nl : nl - start);
+    start = nl == std::string::npos ? all.size() + 1 : nl + 1;
+    if (item.empty()) continue;
+    RecordFile f;
+    if (!open_file(item.c_str(), f)) return nullptr;
+    if (loader->record_size == 0) loader->record_size = f.record_size;
+    if (f.record_size != loader->record_size) {
+      close(f.fd);
+      return nullptr;
+    }
+    loader->files.push_back(f);
+  }
+  if (loader->files.empty() || loader->record_size == 0) return nullptr;
+
+  uint64_t global = 0;
+  for (uint32_t fi = 0; fi < loader->files.size(); fi++)
+    for (uint64_t r = 0; r < loader->files[fi].n_records; r++, global++)
+      if (global % n_shards == static_cast<uint64_t>(shard_id))
+        loader->index.push_back({fi, r});
+
+  loader->batch_size = batch_size;
+  loader->seed = seed;
+  loader->shard_id = shard_id;
+  loader->n_shards = n_shards;
+  loader->shuffle = shuffle != 0;
+  loader->loop_forever = loop_forever != 0;
+  loader->ring.resize(prefetch_depth);
+  for (auto& b : loader->ring)
+    b.data.resize(static_cast<size_t>(batch_size) * loader->record_size);
+  loader->reshuffle_locked();
+  if (loader->index.size() < static_cast<size_t>(batch_size) &&
+      !loader->index.empty())
+    return nullptr;  // can never produce a full batch (even looping:
+                     // a batch never repeats a record within itself)
+  for (int i = 0; i < n_threads; i++)
+    loader->workers.emplace_back(&Loader::worker, loader.get());
+  return loader.release();
+}
+
+void dl_free(void* h) { delete static_cast<Loader*>(h); }
+
+uint64_t dl_record_size(void* h) {
+  return static_cast<Loader*>(h)->record_size;
+}
+
+uint64_t dl_num_records(void* h) {
+  return static_cast<Loader*>(h)->index.size();
+}
+
+uint64_t dl_batches_produced(void* h) {
+  return static_cast<Loader*>(h)->batches_produced.load();
+}
+
+// Copy the next ready batch into out (batch_size * record_size bytes).
+// Returns 1 on success, 0 on end-of-data/stopped, -1 on io error.
+int dl_next(void* h, uint8_t* out, uint64_t out_len) {
+  auto* l = static_cast<Loader*>(h);
+  if (out_len < static_cast<uint64_t>(l->batch_size) * l->record_size)
+    return -1;
+  size_t slot;
+  {
+    std::unique_lock<std::mutex> lk(l->mu);
+    // a reserved slot (count>0, not yet filled) is always eventually filled
+    // by its worker, so on soft exhaustion we only give up once count==0 —
+    // no in-flight tail batch is ever dropped
+    l->cv_consumer.wait(lk, [&] {
+      return (l->count > 0 && l->ring[l->head].filled) || l->stop ||
+             (l->exhausted && l->count == 0);
+    });
+    if (!(l->count > 0 && l->ring[l->head].filled))
+      return l->error.empty() ? 0 : -1;
+    slot = l->head;
+  }
+  std::memcpy(out, l->ring[slot].data.data(),
+              static_cast<size_t>(l->batch_size) * l->record_size);
+  {
+    std::lock_guard<std::mutex> lk(l->mu);
+    l->ring[slot].filled = false;
+    l->head = (l->head + 1) % l->ring.size();
+    l->count--;
+  }
+  l->cv_producer.notify_one();
+  return 1;
+}
+
+}  // extern "C"

@@ -32,6 +32,16 @@ int exp_satisfied(void* h, const char* key);
 void exp_delete(void* h, const char* key);
 int exp_count(void* h);
 
+// ---- data loader (dataloader.cc) ----
+void* dl_new(const char* paths, int batch_size, int prefetch_depth,
+             int n_threads, int shard_id, int n_shards, uint64_t seed,
+             int shuffle, int loop_forever);
+void dl_free(void* h);
+uint64_t dl_record_size(void* h);
+uint64_t dl_num_records(void* h);
+uint64_t dl_batches_produced(void* h);
+int dl_next(void* h, uint8_t* out, uint64_t out_len);
+
 }  // extern "C"
 
 #endif  // TPUOPERATOR_H_

@@ -1,0 +1,164 @@
+"""Record format + loader: native (C++) and Python paths must agree on
+sharding, shuffling determinism, batch contents, and end-of-data."""
+import numpy as np
+import pytest
+
+from tf_operator_tpu import native
+from tf_operator_tpu.data import FieldSpec, RecordLoader, read_header, write_records
+
+FIELDS = [
+    FieldSpec("image", (4, 4, 1), "uint8"),
+    FieldSpec("label", (), "int32"),
+]
+
+
+def _write(tmp_path, n=32, name="a.rec", label_base=0):
+    images = np.arange(n * 16, dtype=np.uint8).reshape(n, 4, 4, 1)
+    labels = (np.arange(n, dtype=np.int32) + label_base)
+    path = str(tmp_path / name)
+    write_records(path, FIELDS, {"image": images, "label": labels})
+    return path, images, labels
+
+
+def _loaders(**base):
+    params = [pytest.param({"force_python": True}, id="python")]
+    if native.native_available():
+        params.append(pytest.param({}, id="native"))
+    return params
+
+
+def test_header_roundtrip(tmp_path):
+    path, _, _ = _write(tmp_path, n=5)
+    rsize, n = read_header(path)
+    assert rsize == 16 + 4
+    assert n == 5
+
+
+def test_write_rejects_bad_shapes(tmp_path):
+    with pytest.raises(ValueError, match="shape"):
+        write_records(
+            str(tmp_path / "bad.rec"),
+            FIELDS,
+            {"image": np.zeros((2, 3, 3, 1), np.uint8),
+             "label": np.zeros(2, np.int32)},
+        )
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_batches_cover_all_records_without_shuffle(tmp_path, kw):
+    path, images, labels = _write(tmp_path)
+    dl = RecordLoader([path], FIELDS, batch_size=8, shuffle=False, loop=False, **kw)
+    seen_labels = []
+    for batch in dl:
+        assert batch["image"].shape == (8, 4, 4, 1)
+        assert batch["label"].dtype == np.int32
+        seen_labels.extend(batch["label"].tolist())
+    assert sorted(seen_labels) == list(range(32))
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_record_integrity(tmp_path, kw):
+    path, images, labels = _write(tmp_path)
+    dl = RecordLoader([path], FIELDS, batch_size=4, shuffle=False, loop=False, **kw)
+    batch = next(iter(dl))
+    for j in range(4):
+        lbl = int(batch["label"][j])
+        np.testing.assert_array_equal(batch["image"][j], images[lbl])
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_sharding_disjoint_and_complete(tmp_path, kw):
+    path, _, _ = _write(tmp_path)
+    seen = []
+    for shard in range(2):
+        dl = RecordLoader(
+            [path], FIELDS, batch_size=4, shuffle=False, loop=False,
+            shard_id=shard, n_shards=2, **kw,
+        )
+        assert dl.num_records() == 16
+        seen.append({int(x) for b in dl for x in b["label"]})
+    assert seen[0] & seen[1] == set()
+    assert seen[0] | seen[1] == set(range(32))
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_multi_file(tmp_path, kw):
+    p1, _, _ = _write(tmp_path, n=8, name="a.rec")
+    p2, _, _ = _write(tmp_path, n=8, name="b.rec", label_base=100)
+    dl = RecordLoader([p1, p2], FIELDS, batch_size=4, shuffle=False, loop=False, **kw)
+    labels = sorted(int(x) for b in dl for x in b["label"])
+    assert labels == list(range(8)) + list(range(100, 108))
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_shuffle_changes_order_not_content(tmp_path, kw):
+    path, _, _ = _write(tmp_path)
+    dl = RecordLoader([path], FIELDS, batch_size=32, shuffle=True, seed=7,
+                      loop=False, **kw)
+    labels = [int(x) for b in dl for x in b["label"]]
+    assert sorted(labels) == list(range(32))
+    assert labels != list(range(32)), "seeded shuffle must permute"
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_loop_reshuffles_across_epochs(tmp_path, kw):
+    path, _, _ = _write(tmp_path, n=16)
+    dl = RecordLoader([path], FIELDS, batch_size=16, shuffle=True, seed=3,
+                      loop=True, **kw)
+    it = iter(dl)
+    e1 = [int(x) for x in next(it)["label"]]
+    e2 = [int(x) for x in next(it)["label"]]
+    assert sorted(e1) == sorted(e2) == list(range(16))
+    assert e1 != e2, "epochs must reshuffle"
+
+
+def test_native_python_same_unshuffled_stream(tmp_path):
+    if not native.native_available():
+        pytest.skip("native not built")
+    path, _, _ = _write(tmp_path)
+    a = RecordLoader([path], FIELDS, batch_size=8, shuffle=False, loop=False)
+    b = RecordLoader([path], FIELDS, batch_size=8, shuffle=False, loop=False,
+                     force_python=True)
+    assert a.using_native and not b.using_native
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(ba["image"], bb["image"])
+        np.testing.assert_array_equal(ba["label"], bb["label"])
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_reiterating_nonlooping_loader_restarts(tmp_path, kw):
+    path, _, _ = _write(tmp_path, n=16)
+    dl = RecordLoader([path], FIELDS, batch_size=8, shuffle=False, loop=False, **kw)
+    first = [int(x) for b in dl for x in b["label"]]
+    second = [int(x) for b in dl for x in b["label"]]
+    assert first == second == list(range(16))
+
+
+def test_shard_smaller_than_batch_rejected_native(tmp_path):
+    if not native.native_available():
+        pytest.skip("native not built")
+    path, _, _ = _write(tmp_path, n=4)
+    # shard 0 of 4 holds 1 record < batch_size 2: must fail loudly (looping
+    # too — a batch never repeats a record within itself)
+    with pytest.raises(ValueError, match="rejected"):
+        RecordLoader([path], FIELDS, batch_size=2, n_shards=4, loop=True)
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_no_tail_batches_dropped_many_threads(tmp_path, kw):
+    """End-of-data with several workers must not lose in-flight batches."""
+    path, _, _ = _write(tmp_path, n=32)
+    for _ in range(5):  # race is nondeterministic; hammer it
+        dl = RecordLoader(
+            [path], FIELDS, batch_size=4, shuffle=False, loop=False,
+            n_threads=4, prefetch_depth=2, **kw,
+        )
+        got = sorted(int(x) for b in dl for x in b["label"])
+        assert got == list(range(32))
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "junk.rec"
+    p.write_bytes(b"NOTAREC0" + b"\0" * 16)
+    with pytest.raises(ValueError, match="TPUREC01"):
+        RecordLoader([str(p)], FIELDS, batch_size=2)

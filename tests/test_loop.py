@@ -131,6 +131,27 @@ def test_preemption_triggers_final_save(tmp_path):
     assert Checkpointer(ckpt_dir).latest_step() == 4
 
 
+def test_preemption_on_interval_boundary_no_double_save(tmp_path):
+    """SIGTERM landing on a step the interval save just wrote must not
+    save that step twice (orbax raises on duplicates)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    guard = PreemptionGuard(install=False)
+
+    def batches():
+        for i, b in enumerate(_batches()):
+            if i == 1:  # SIGTERM during step 2 == save_interval_steps
+                guard.trigger()
+            yield b
+
+    res = run_training(
+        _make_state(), _train_step, batches(), num_steps=100,
+        checkpointer=Checkpointer(ckpt_dir), save_interval_steps=2,
+        guard=guard,
+    )
+    assert res.preempted
+    assert Checkpointer(ckpt_dir).latest_step() == 2
+
+
 def test_no_resave_when_resume_finds_run_complete(tmp_path):
     """A recreated pod whose run already finished must not re-save the
     final step (orbax raises StepAlreadyExistsError on duplicate saves)."""

@@ -1,0 +1,16 @@
+"""Host input pipeline: fixed-record binary files + prefetching loaders.
+
+The reference ships no data path (user containers own it); a TPU framework
+must, because the host pipeline feeds the MXU. `write_records` produces the
+TPUREC01 format; `RecordLoader` streams batches from it — C++ prefetch
+threads (native/dataloader.cc) when the native library is built, a pure
+Python reader otherwise, same iterator contract either way.
+"""
+from tf_operator_tpu.data.loader import (
+    FieldSpec,
+    RecordLoader,
+    read_header,
+    write_records,
+)
+
+__all__ = ["FieldSpec", "RecordLoader", "read_header", "write_records"]

@@ -1,0 +1,252 @@
+"""TPUREC01 record files + the batch loader over them.
+
+Format (native/dataloader.cc reads the same layout):
+  8B magic 'TPUREC01' | u64 record_size | u64 n_records | payload.
+
+A record is the concatenation of fixed-size fields (FieldSpec); a batch of
+N records viewed field-wise gives arrays [N, *field.shape] with zero
+parsing — one memcpy from the prefetch ring into numpy, then device_put.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"TPUREC01"
+HEADER = struct.Struct("<8sQQ")
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str  # numpy dtype string, e.g. 'uint8', 'int32', 'bfloat16'-free
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, initial=1)) * np.dtype(self.dtype).itemsize
+
+
+def record_size(fields: Sequence[FieldSpec]) -> int:
+    return sum(f.nbytes for f in fields)
+
+
+def write_records(path: str, fields: Sequence[FieldSpec], columns: Dict[str, np.ndarray]) -> int:
+    """Write one record file. `columns[name]` is [N, *shape] for each field;
+    all N equal. Returns N."""
+    ns = {f.name: len(columns[f.name]) for f in fields}
+    n = next(iter(ns.values()))
+    if any(v != n for v in ns.values()):
+        raise ValueError(f"unequal column lengths: {ns}")
+    rsize = record_size(fields)
+    with open(path, "wb") as out:
+        out.write(HEADER.pack(MAGIC, rsize, n))
+        for i in range(n):
+            for f in fields:
+                # NB: ascontiguousarray promotes 0-d to 1-d; asarray doesn't
+                arr = np.asarray(columns[f.name][i], dtype=f.dtype, order="C")
+                if arr.shape != tuple(f.shape):
+                    raise ValueError(
+                        f"{f.name}[{i}]: shape {arr.shape} != spec {f.shape}"
+                    )
+                out.write(arr.tobytes())
+    return n
+
+
+def read_header(path: str) -> Tuple[int, int]:
+    """-> (record_size, n_records); raises on bad magic."""
+    with open(path, "rb") as f:
+        magic, rsize, n = HEADER.unpack(f.read(HEADER.size))
+    if magic != MAGIC:
+        raise ValueError(f"{path}: not a TPUREC01 file")
+    return rsize, n
+
+
+def _split_batch(
+    buf: np.ndarray, batch_size: int, fields: Sequence[FieldSpec]
+) -> Dict[str, np.ndarray]:
+    """View a [batch_size * record_size] byte buffer field-wise (zero copy)."""
+    rec = buf.reshape(batch_size, -1)
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for f in fields:
+        chunk = rec[:, off : off + f.nbytes]
+        out[f.name] = chunk.view(f.dtype).reshape((batch_size,) + tuple(f.shape))
+        off += f.nbytes
+    return out
+
+
+class RecordLoader:
+    """Iterate batches from record files.
+
+    Yields {field: np.ndarray [B, *shape]}. Drop-remainder; per-epoch
+    shuffle (seeded, identical across hosts so shards stay disjoint);
+    `shard_id`/`n_shards` give each TPU VM host a disjoint record subset
+    (wire from bootstrap.SliceInfo process_id/num_processes).
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        fields: Sequence[FieldSpec],
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        shard_id: int = 0,
+        n_shards: int = 1,
+        loop: bool = True,
+        prefetch_depth: int = 4,
+        n_threads: int = 2,
+        force_python: bool = False,
+    ) -> None:
+        if not paths:
+            raise ValueError("no record files")
+        self.paths = [os.fspath(p) for p in paths]
+        self.fields = list(fields)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.shard_id = shard_id
+        self.n_shards = n_shards
+        self.loop = loop
+        self.prefetch_depth = prefetch_depth
+        self.n_threads = n_threads
+
+        rsize = record_size(self.fields)
+        for p in self.paths:
+            got, _ = read_header(p)
+            if got != rsize:
+                raise ValueError(
+                    f"{p}: record_size {got} != field spec total {rsize}"
+                )
+        self._rsize = rsize
+
+        self._native = None
+        if not force_python:
+            from tf_operator_tpu import native as native_mod
+
+            lib = native_mod.get_lib()
+            if lib is not None and hasattr(lib, "dl_new"):
+                self._lib = lib
+                self._configure_native()
+
+    def _configure_native(self) -> None:
+        lib = self._lib
+        lib.dl_new.restype = ctypes.c_void_p
+        lib.dl_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.dl_free.argtypes = [ctypes.c_void_p]
+        lib.dl_next.restype = ctypes.c_int
+        lib.dl_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+        ]
+        for fn in ("dl_record_size", "dl_num_records", "dl_batches_produced"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        h = lib.dl_new(
+            "\n".join(self.paths).encode(),
+            self.batch_size,
+            self.prefetch_depth,
+            self.n_threads,
+            self.shard_id,
+            self.n_shards,
+            self.seed,
+            1 if self.shuffle else 0,
+            1 if self.loop else 0,
+        )
+        if not h:
+            raise ValueError("native loader rejected the record files")
+        self._native = h
+
+    def __del__(self):
+        h, self._native = getattr(self, "_native", None), None
+        if h:
+            self._lib.dl_free(h)
+
+    @property
+    def using_native(self) -> bool:
+        return self._native is not None
+
+    def num_records(self) -> int:
+        if self._native:
+            return int(self._lib.dl_num_records(self._native))
+        total = sum(read_header(p)[1] for p in self.paths)
+        return total // self.n_shards + (
+            1 if total % self.n_shards > self.shard_id else 0
+        )
+
+    # ------------------------------------------------------------- iteration
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self._native or getattr(self, "_consumed", False):
+            if getattr(self, "_consumed", False):
+                # the C++ ring latches end-of-data; re-iterating a
+                # non-looping loader restarts it so native matches the
+                # Python fallback's fresh-epoch-per-__iter__ contract
+                if self._native:
+                    self._lib.dl_free(self._native)
+                    self._native = None
+                self._consumed = False
+                self._configure_native()
+            return self._iter_native()
+        return self._iter_python()
+
+    def _iter_native(self):
+        nbytes = self.batch_size * self._rsize
+        while True:
+            buf = np.empty(nbytes, np.uint8)
+            rc = self._lib.dl_next(
+                self._native,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                nbytes,
+            )
+            if rc == 0:
+                self._consumed = True
+                return
+            if rc < 0:
+                raise IOError("native loader read error")
+            yield _split_batch(buf, self.batch_size, self.fields)
+
+    def _iter_python(self):
+        # same record indexing/shuffle semantics as the native path
+        index: List[Tuple[int, int]] = []
+        counts = [read_header(p)[1] for p in self.paths]
+        g = 0
+        for fi, n in enumerate(counts):
+            for r in range(n):
+                if g % self.n_shards == self.shard_id:
+                    index.append((fi, r))
+                g += 1
+        handles = [open(p, "rb") for p in self.paths]
+        try:
+            epoch = 0
+            while True:
+                order = np.arange(len(index))
+                if self.shuffle:
+                    np.random.default_rng(self.seed + epoch).shuffle(order)
+                for s in range(0, len(order) - self.batch_size + 1, self.batch_size):
+                    buf = np.empty(self.batch_size * self._rsize, np.uint8)
+                    for j, oi in enumerate(order[s : s + self.batch_size]):
+                        fi, r = index[oi]
+                        handles[fi].seek(HEADER.size + r * self._rsize)
+                        chunk = handles[fi].read(self._rsize)
+                        buf[j * self._rsize : (j + 1) * self._rsize] = np.frombuffer(
+                            chunk, np.uint8
+                        )
+                    yield _split_batch(buf, self.batch_size, self.fields)
+                if not self.loop:
+                    return
+                epoch += 1
+        finally:
+            for h in handles:
+                h.close()

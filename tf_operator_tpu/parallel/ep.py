@@ -1,0 +1,163 @@
+"""Expert parallelism — switch routing + all-to-all dispatch over the `ep`
+mesh axis.
+
+Absent from the reference (SURVEY.md §2.10: EP row "NO"). Two dispatch
+strategies exist in this framework:
+
+  - models/transformer.py MoeMlp: dense masked-einsum dispatch, experts
+    sharded over ep by GSPMD (parallel/tp.py). Zero comm code; best when
+    E is small and capacity ~= tokens.
+  - this module: explicit capacity-bounded all-to-all dispatch under
+    shard_map — each device routes its tokens to the devices owning their
+    experts (one ICI all_to_all), applies its local expert FFNs, and routes
+    results back (second all_to_all). Traffic is 2 x capacity x d per
+    device instead of the dense path's full [B,S,E] expansion; this is the
+    scalable route for large E (Switch Transformer / GShard pattern).
+
+All shapes static (capacity fixed up front); overflow tokens are dropped
+(standard switch behavior) and their outputs are zero, so the residual
+stream carries them unchanged.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.parallel.compat import shard_map
+
+
+def switch_route(
+    router_logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 routing with per-expert capacity.
+
+    router_logits: [T, E] (float32 for a stable softmax).
+    Returns (dispatch [T, E, C] one-hot, gate [T], aux_loss scalar).
+    Token t goes to slot `pos` of its expert's bucket, where pos is its
+    order among same-expert tokens; pos >= capacity -> dropped.
+    """
+    t, n_e = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.max(probs, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert_idx, n_e, dtype=jnp.int32)  # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E]; -1 where not routed
+    in_cap = (pos >= 0) & (pos < capacity)
+    dispatch = jax.nn.one_hot(
+        jnp.where(in_cap, pos, capacity), capacity + 1, dtype=router_logits.dtype
+    )[..., :capacity] * in_cap[..., None].astype(router_logits.dtype)
+    # aux load-balancing loss (Switch Transformer eq. 4)
+    density = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    router_mean = jnp.mean(probs, axis=0)
+    aux = n_e * jnp.sum(density * router_mean)
+    gate = gate * in_cap.any(-1).astype(gate.dtype)  # dropped tokens: zero out
+    return dispatch, gate, aux
+
+
+def _local_moe(
+    x: jax.Array,
+    router_logits: jax.Array,
+    wi: jax.Array,
+    wo: jax.Array,
+    *,
+    n_experts: int,
+    capacity: int,
+    axis_name: str,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-device body under shard_map.
+
+    x [T, d] local tokens; router_logits [T, E]; wi [E_local, d, f],
+    wo [E_local, f, d] local expert weights (E_local = E / ep).
+    """
+    ep = jax.lax.psum(1, axis_name)
+    e_local = n_experts // ep
+    dispatch, gate, aux = switch_route(router_logits.astype(jnp.float32), capacity)
+    dispatch = dispatch.astype(x.dtype)
+
+    # bucket local tokens by destination expert: [E, C, d]
+    buckets = jnp.einsum("tec,td->ecd", dispatch, x)
+    # all_to_all #1: send bucket block e to the device owning expert e.
+    # [E, C, d] -> [ep, E_local, C, d] -> exchange leading dim -> on each
+    # device: [ep(source), E_local(mine), C, d]
+    buckets = buckets.reshape(ep, e_local, capacity, -1)
+    buckets = jax.lax.all_to_all(buckets, axis_name, 0, 0, tiled=False)
+
+    # local expert FFN over all sources at once: [ep, E_local, C, d]
+    h = jnp.einsum("secd,edf->secf", buckets, wi)
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("secf,efd->secd", h, wo)
+
+    # all_to_all #2: route results back to the token-owning devices
+    out = jax.lax.all_to_all(out, axis_name, 0, 0, tiled=False)
+    out = out.reshape(n_experts, capacity, -1)  # [E, C, d]
+    # un-bucket into token order, apply gate
+    y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None].astype(x.dtype)
+    # aux is identical math on every device only if tokens were global; they
+    # aren't — average across devices for the global load-balance signal
+    aux = jax.lax.pmean(aux, axis_name)
+    return y, aux
+
+
+def make_switch_moe(
+    mesh: Mesh,
+    n_experts: int,
+    capacity_factor: float = 1.25,
+    axis_name: str = "ep",
+):
+    """Build f(x, router_logits, wi, wo) -> (y, aux) running all-to-all EP
+    over `mesh`.
+
+    Global shapes: x [B, S, d] (batch sharded over ep), router_logits
+    [B, S, E], wi [E, d, f] / wo [E, f, d] (experts sharded over ep).
+    Capacity per (device, expert) = ceil(local_tokens / E * factor).
+    """
+    ep = mesh.shape.get(axis_name, 1)
+    if n_experts % ep:
+        raise ValueError(f"n_experts {n_experts} not divisible by ep {ep}")
+
+    def run(x, router_logits, wi, wo):
+        b, s, d = x.shape
+        if (b * s) % ep:
+            raise ValueError(f"tokens {b * s} not divisible by ep {ep}")
+        local_tokens = b * s // ep
+        capacity = max(1, int(local_tokens / n_experts * capacity_factor))
+
+        inner = functools.partial(
+            _local_moe,
+            n_experts=n_experts,
+            capacity=capacity,
+            axis_name=axis_name,
+        )
+        # flatten tokens; shard them over ep; experts already over ep
+        xf = x.reshape(b * s, d)
+        lf = router_logits.reshape(b * s, n_experts)
+        y, aux = shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name)),
+            out_specs=(P(axis_name), P()),
+            check_rep=False,
+        )(xf, lf, wi, wo)
+        return y.reshape(b, s, d), aux
+
+    return run
+
+
+def dense_reference_moe(x, router_logits, wi, wo, capacity: int):
+    """Single-device reference with identical routing/capacity semantics —
+    the correctness oracle for tests."""
+    b, s, d = x.shape
+    xf = x.reshape(b * s, d)
+    dispatch, gate, aux = switch_route(
+        router_logits.reshape(b * s, -1).astype(jnp.float32), capacity
+    )
+    dispatch = dispatch.astype(x.dtype)
+    buckets = jnp.einsum("tec,td->ecd", dispatch, xf)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", buckets, wi))
+    out = jnp.einsum("ecf,efd->ecd", h, wo)
+    y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None].astype(x.dtype)
+    return y.reshape(b, s, d), aux

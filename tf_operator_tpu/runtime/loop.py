@@ -102,6 +102,7 @@ def run_training(
     guard = guard or PreemptionGuard(install=False)
     step = int(state.step)
     steps_run = 0
+    last_saved_step = resumed_from if resumed_from is not None else -1
     last_metrics: Dict[str, float] = {}
     it = iter(batches)
 
@@ -120,20 +121,16 @@ def run_training(
 
         if checkpointer is not None and step % save_interval_steps == 0:
             checkpointer.save(step, state)
+            last_saved_step = step
         if step % log_interval_steps == 0:
             line = profiler.metrics_line(step, extra=last_metrics)
             (metrics_sink or (lambda s: log.info("%s", s)))(line)
 
     preempted = guard.preempted
-    if (
-        checkpointer is not None
-        and steps_run > 0
-        and (preempted or step % save_interval_steps)
-    ):
-        # final save: on preemption ALWAYS; on clean exit only if the last
-        # interval save didn't already capture this step. steps_run == 0
-        # (e.g. a recreated pod that restored an already-complete run) has
-        # nothing new to save — re-saving an existing step would raise
+    if checkpointer is not None and steps_run > 0 and step != last_saved_step:
+        # final save unless this exact step is already on disk (interval
+        # save this iteration, or a recreated pod that restored an
+        # already-complete run) — orbax raises on duplicate steps
         checkpointer.save(step, state)
     return LoopResult(
         state=state,

@@ -186,22 +186,4 @@ class Checkpointer:
         return state.replace(**restored)
 
 
-@dataclass
-class StepTimer:
-    """Steps/sec + images/sec bookkeeping for bench + progress logs."""
-
-    batch_size: int
-    warmup: int = 2
-    _t0: float = 0.0
-    _steps: int = 0
-
-    def start(self) -> None:
-        self._t0 = time.perf_counter()
-        self._steps = 0
-
-    def tick(self) -> None:
-        self._steps += 1
-
-    def images_per_sec(self) -> float:
-        dt = time.perf_counter() - self._t0
-        return self._steps * self.batch_size / dt if dt > 0 else 0.0
+# Step throughput bookkeeping lives in runtime/profiler.py (StepProfile).
