@@ -134,14 +134,24 @@ def test_reiterating_nonlooping_loader_restarts(tmp_path, kw):
     assert first == second == list(range(16))
 
 
-def test_shard_smaller_than_batch_rejected_native(tmp_path):
-    if not native.native_available():
-        pytest.skip("native not built")
+@pytest.mark.parametrize("kw", _loaders())
+def test_shard_smaller_than_batch_rejected(tmp_path, kw):
     path, _, _ = _write(tmp_path, n=4)
     # shard 0 of 4 holds 1 record < batch_size 2: must fail loudly (looping
     # too — a batch never repeats a record within itself)
-    with pytest.raises(ValueError, match="rejected"):
-        RecordLoader([path], FIELDS, batch_size=2, n_shards=4, loop=True)
+    with pytest.raises(ValueError, match="never produce"):
+        RecordLoader([path], FIELDS, batch_size=2, n_shards=4, loop=True, **kw)
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_abandoned_iterator_then_reiterate_restarts(tmp_path, kw):
+    """Partial consumption then a fresh __iter__ restarts from the top on
+    BOTH paths (native must not resume its C++ cursor mid-stream)."""
+    path, _, _ = _write(tmp_path, n=16)
+    dl = RecordLoader([path], FIELDS, batch_size=4, shuffle=False, loop=False, **kw)
+    first = [int(x) for x in next(iter(dl))["label"]]
+    again = [int(x) for x in next(iter(dl))["label"]]
+    assert first == again == [0, 1, 2, 3]
 
 
 @pytest.mark.parametrize("kw", _loaders())

@@ -67,7 +67,9 @@ def test_all_to_all_matches_per_shard_dense_with_drops():
 
     t = x.shape[0] * x.shape[1]
     local = t // EP
-    cap = max(1, int(local / E * factor))
+    import math
+
+    cap = max(1, math.ceil(local / E * factor))
     xf = x.reshape(t, D)
     lf = logits.reshape(t, E)
     outs = []

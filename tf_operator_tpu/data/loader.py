@@ -127,7 +127,18 @@ class RecordLoader:
                 )
         self._rsize = rsize
 
+        # a shard smaller than one batch can never produce a full batch
+        # (records never repeat within a batch) — fail loudly on both paths,
+        # matching dl_new's native-side rejection
+        n_mine = self._shard_count()
+        if 0 < n_mine < batch_size:
+            raise ValueError(
+                f"shard {shard_id}/{n_shards} holds {n_mine} records "
+                f"< batch_size {batch_size}: can never produce a batch"
+            )
+
         self._native = None
+        self._native_started = False
         if not force_python:
             from tf_operator_tpu import native as native_mod
 
@@ -178,30 +189,35 @@ class RecordLoader:
     def using_native(self) -> bool:
         return self._native is not None
 
-    def num_records(self) -> int:
-        if self._native:
-            return int(self._lib.dl_num_records(self._native))
+    def _shard_count(self) -> int:
         total = sum(read_header(p)[1] for p in self.paths)
         return total // self.n_shards + (
             1 if total % self.n_shards > self.shard_id else 0
         )
 
+    def num_records(self) -> int:
+        if self._native:
+            return int(self._lib.dl_num_records(self._native))
+        return self._shard_count()
+
     # ------------------------------------------------------------- iteration
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        if self._native or getattr(self, "_consumed", False):
-            if getattr(self, "_consumed", False):
-                # the C++ ring latches end-of-data; re-iterating a
-                # non-looping loader restarts it so native matches the
-                # Python fallback's fresh-epoch-per-__iter__ contract
-                if self._native:
-                    self._lib.dl_free(self._native)
-                    self._native = None
-                self._consumed = False
-                self._configure_native()
-            return self._iter_native()
-        return self._iter_python()
+        if self._native is None and not self._native_started:
+            return self._iter_python()
+        # every __iter__ is a fresh stream from the start — the Python
+        # fallback's generator contract. The C++ handle advances (and
+        # latches end-of-data) as it is consumed, so once touched it must
+        # be rebuilt, even after partial consumption.
+        if self._native_started:
+            if self._native:
+                self._lib.dl_free(self._native)
+                self._native = None
+            self._configure_native()
+            self._native_started = False
+        return self._iter_native()
 
     def _iter_native(self):
+        self._native_started = True
         nbytes = self.batch_size * self._rsize
         while True:
             buf = np.empty(nbytes, np.uint8)
@@ -211,7 +227,6 @@ class RecordLoader:
                 nbytes,
             )
             if rc == 0:
-                self._consumed = True
                 return
             if rc < 0:
                 raise IOError("native loader read error")

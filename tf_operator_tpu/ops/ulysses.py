@@ -1,0 +1,66 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence exchange.
+
+The second long-context strategy (SURVEY.md §5.7 has neither; ring
+attention in ops/ring_attention.py is the first). DeepSpeed-Ulysses
+pattern, TPU-first: with the sequence sharded over a mesh axis, two ICI
+all_to_alls re-partition [B, S/n, H, D] -> [B, S, H/n, D], so each device
+computes *exact* attention over the full sequence for its head subset —
+no blockwise softmax merging, O(S^2 / n) score memory per device, and the
+collective volume is 2 x activation size (vs ring's n KV hops).
+
+Trade-offs vs ring: Ulysses needs H % n == 0 and materializes full-length
+scores per local head (fine up to moderate S); ring keeps O((S/n)^2)
+memory and wins at extreme lengths. Both share the attention_fn interface
+(models/transformer.TransformerConfig.attention_fn) so models switch by
+config.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ulysses_attention(q, k, v, causal: bool = False, *,
+                      axis_name: str = "tp") -> jax.Array:
+    """Call inside shard_map with q, k, v [B, S_local, H, D], sequence
+    sharded over `axis_name`. Requires H divisible by the axis size."""
+    n = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by axis {axis_name!r}={n}")
+    from tf_operator_tpu.models.transformer import dot_product_attention
+
+    # all_to_all #1: scatter heads, gather sequence -> [B, S, H/n, D]
+    def fwd(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    # after the exchange each device holds the FULL sequence for its head
+    # subset, so the exact reference attention applies unchanged (single
+    # shared kernel — numerics can't drift from the dense path)
+    out = dot_product_attention(fwd(q), fwd(k), fwd(v), causal)
+    # all_to_all #2: scatter sequence, gather heads -> [B, S/n, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = "tp",
+                              batch_axes=("dp", "fsdp")):
+    """attention_fn for TransformerConfig — same interface as
+    make_ring_attention_fn, so configs pick ring vs ulysses freely."""
+    from tf_operator_tpu.parallel.compat import shard_map
+
+    spec = P(batch_axes, axis_name, None, None)
+
+    def attention_fn(q, k, v, causal: bool) -> jax.Array:
+        inner = functools.partial(ulysses_attention, causal=causal,
+                                  axis_name=axis_name)
+        return shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )(q, k, v)
+
+    return attention_fn

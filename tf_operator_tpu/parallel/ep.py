@@ -21,6 +21,7 @@ stream carries them unchanged.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Tuple
 
 import jax
@@ -124,7 +125,7 @@ def make_switch_moe(
         if (b * s) % ep:
             raise ValueError(f"tokens {b * s} not divisible by ep {ep}")
         local_tokens = b * s // ep
-        capacity = max(1, int(local_tokens / n_experts * capacity_factor))
+        capacity = max(1, math.ceil(local_tokens / n_experts * capacity_factor))
 
         inner = functools.partial(
             _local_moe,
