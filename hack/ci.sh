@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Local CI pipeline — the runnable form of test/workflows/e2e-workflow.yaml
+# (the reference drives the same stages through Argo+Prow: build -> lint ->
+# unit -> e2e -> sdk, SURVEY §3.5). Every stage must pass.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+stage() { echo; echo "=== $1 ==="; }
+
+stage "build: native runtime core"
+make native
+
+stage "lint: python compile check"
+python -m compileall -q tf_operator_tpu hack examples tests
+
+stage "manifests: generated CRDs in sync"
+python hack/gen_crds.py --check
+
+stage "unit + controller + numerics"
+python -m pytest tests/ -q -x --ignore=tests/test_e2e.py \
+    --ignore=tests/test_examples.py --ignore=tests/test_sdk.py
+
+stage "e2e scenarios"
+python -m pytest tests/test_e2e.py -q -x
+
+stage "examples smoke (sdk + ladder)"
+python -m pytest tests/test_examples.py tests/test_sdk.py -q -x
+
+stage "graft entry: single-chip compile + 8-device dryrun"
+XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+fn, args = g.entry()
+jax.jit(fn)(*args)
+print("graft entry ok")
+EOF
+
+echo
+echo "CI PASSED"

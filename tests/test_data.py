@@ -144,6 +144,27 @@ def test_shard_smaller_than_batch_rejected(tmp_path, kw):
 
 
 @pytest.mark.parametrize("kw", _loaders())
+def test_empty_shard_rejected(tmp_path, kw):
+    path, _, _ = _write(tmp_path, n=3)
+    # shard 3 of 4 holds 0 records: loud error, not an infinite busy-loop
+    with pytest.raises(ValueError, match="never produce"):
+        RecordLoader([path], FIELDS, batch_size=1, shard_id=3, n_shards=4,
+                     loop=True, **kw)
+
+
+@pytest.mark.parametrize("kw", _loaders())
+def test_two_concurrent_iterators_are_independent(tmp_path, kw):
+    path, _, _ = _write(tmp_path, n=16)
+    dl = RecordLoader([path], FIELDS, batch_size=4, shuffle=False, loop=False, **kw)
+    it1, it2 = iter(dl), iter(dl)
+    a1 = [int(x) for x in next(it1)["label"]]
+    a2 = [int(x) for x in next(it2)["label"]]
+    b1 = [int(x) for x in next(it1)["label"]]
+    assert a1 == a2 == [0, 1, 2, 3]
+    assert b1 == [4, 5, 6, 7]
+
+
+@pytest.mark.parametrize("kw", _loaders())
 def test_abandoned_iterator_then_reiterate_restarts(tmp_path, kw):
     """Partial consumption then a fresh __iter__ restarts from the top on
     BOTH paths (native must not resume its C++ cursor mid-stream)."""

@@ -1,0 +1,156 @@
+"""SDK watch helper: streamed status transitions (reference tf_job_watch.py
+surface, SURVEY §2.6) against the live operator on the fake cluster."""
+import threading
+
+import pytest
+
+from tf_operator_tpu.sdk.client import TFJobClient
+from tf_operator_tpu.sdk.watch import job_state, watch_job
+
+
+def _job_dict(name="w1"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "tfReplicaSpecs": {
+                "Worker": {
+                    "replicas": 1,
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {"name": "tensorflow", "image": "x"}
+                            ]
+                        }
+                    },
+                }
+            }
+        },
+    }
+
+
+def test_job_state_reads_latest_true_condition():
+    job = {"status": {"conditions": [
+        {"type": "Created", "status": "True"},
+        {"type": "Running", "status": "False"},
+        {"type": "Succeeded", "status": "True"},
+    ]}}
+    assert job_state(job) == "Succeeded"
+    assert job_state({}) == ""
+
+
+def test_watch_yields_current_then_transitions():
+    from tf_operator_tpu.k8s.fake import FakeCluster
+
+    cluster = FakeCluster()
+    client = TFJobClient(cluster)
+    client.create(_job_dict())
+
+    seen = []
+
+    def consume():
+        for ev, job in watch_job(cluster, "TFJob", "w1", timeout=5):
+            seen.append((ev, job_state(job)))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    # drive status transitions like the controller would (fresh read each
+    # time: updates bump resourceVersion)
+    for cond in ("Created", "Running", "Succeeded"):
+        j = cluster.get("TFJob", "default", "w1")
+        j.setdefault("status", {}).setdefault("conditions", []).append(
+            {"type": cond, "status": "True"}
+        )
+        cluster.update("TFJob", j)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert seen[0] == ("ADDED", "")
+    assert seen[-1][1] == "Succeeded"  # stopped at terminal
+
+
+def test_watch_stops_on_delete():
+    from tf_operator_tpu.k8s.fake import FakeCluster
+
+    cluster = FakeCluster()
+    client = TFJobClient(cluster)
+    client.create(_job_dict("gone"))
+    events = []
+
+    def consume():
+        for ev, _ in client.watch("gone", timeout=5):
+            events.append(ev)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    client.delete("gone")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert events == ["ADDED", "DELETED"]
+
+
+def test_watch_timeout_raises():
+    from tf_operator_tpu.k8s.fake import FakeCluster
+
+    cluster = FakeCluster()
+    TFJobClient(cluster).create(_job_dict("idle"))
+    with pytest.raises(TimeoutError):
+        for _ in watch_job(cluster, "TFJob", "idle", timeout=0.1):
+            pass
+
+
+def test_watch_unsubscribes_handler():
+    from tf_operator_tpu.k8s.fake import FakeCluster
+
+    cluster = FakeCluster()
+    client = TFJobClient(cluster)
+    client.create(_job_dict("u1"))
+    before = sum(len(v) for v in cluster._handlers.values())
+    try:
+        for _ in watch_job(cluster, "TFJob", "u1", timeout=0.05):
+            pass
+    except TimeoutError:
+        pass
+    after = sum(len(v) for v in cluster._handlers.values())
+    assert after == before
+
+
+def test_watch_end_to_end_with_operator():
+    """Full loop: the live operator + fake kubelet drive the job while a
+    concurrent watch streams its states through to Succeeded."""
+    from tf_operator_tpu.cmd.manager import OperatorManager
+    from tf_operator_tpu.cmd.options import ServerOptions
+    from tf_operator_tpu.controllers.registry import EnabledSchemes
+    from tf_operator_tpu.e2e.kubelet import FakeKubelet
+    from tf_operator_tpu.k8s.fake import FakeCluster
+
+    cluster = FakeCluster()
+    mgr = OperatorManager(
+        cluster,
+        ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]), threadiness=2),
+    )
+    mgr.start()
+    kubelet = FakeKubelet(cluster)
+    client = TFJobClient(cluster)
+    try:
+        states = []
+        done = threading.Event()
+
+        def consume():
+            for _, job in client.watch("full", timeout=10):
+                states.append(job_state(job))
+            done.set()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        client.create(_job_dict("full"))
+        client.wait_for_condition("full", ["Running"])
+        kubelet.wait_running("default", "full-worker-0", 10)
+        kubelet.terminate_replica("default", "full-worker-0", 0)
+        assert done.wait(timeout=10)
+        t.join(timeout=2)
+        assert states[-1] == "Succeeded"
+        assert "Running" in states
+    finally:
+        kubelet.stop_all()
+        mgr.stop()

@@ -67,6 +67,32 @@ def read_header(path: str) -> Tuple[int, int]:
     return rsize, n
 
 
+_BOUND = set()
+
+
+def _bind_lib(lib):
+    """Declare the dl_* ctypes signatures once per CDLL."""
+    if id(lib) not in _BOUND:
+        lib.dl_new.restype = ctypes.c_void_p
+        lib.dl_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.dl_free.argtypes = [ctypes.c_void_p]
+        lib.dl_next.restype = ctypes.c_int
+        lib.dl_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+        ]
+        for fn in ("dl_record_size", "dl_num_records", "dl_batches_produced"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        _BOUND.add(id(lib))
+    return lib
+
+
 def _split_batch(
     buf: np.ndarray, batch_size: int, fields: Sequence[FieldSpec]
 ) -> Dict[str, np.ndarray]:
@@ -131,41 +157,26 @@ class RecordLoader:
         # (records never repeat within a batch) — fail loudly on both paths,
         # matching dl_new's native-side rejection
         n_mine = self._shard_count()
-        if 0 < n_mine < batch_size:
+        if n_mine < batch_size:
             raise ValueError(
                 f"shard {shard_id}/{n_shards} holds {n_mine} records "
                 f"< batch_size {batch_size}: can never produce a batch"
             )
 
-        self._native = None
-        self._native_started = False
+        self._lib = None
         if not force_python:
             from tf_operator_tpu import native as native_mod
 
             lib = native_mod.get_lib()
             if lib is not None and hasattr(lib, "dl_new"):
-                self._lib = lib
-                self._configure_native()
+                self._lib = _bind_lib(lib)
+                # probe: validate the files through dl_new once, loudly
+                self._lib.dl_free(self._new_handle())
 
-    def _configure_native(self) -> None:
-        lib = self._lib
-        lib.dl_new.restype = ctypes.c_void_p
-        lib.dl_new.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
-            ctypes.c_int,
-        ]
-        lib.dl_free.argtypes = [ctypes.c_void_p]
-        lib.dl_next.restype = ctypes.c_int
-        lib.dl_next.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_uint8),
-            ctypes.c_uint64,
-        ]
-        for fn in ("dl_record_size", "dl_num_records", "dl_batches_produced"):
-            getattr(lib, fn).restype = ctypes.c_uint64
-            getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        h = lib.dl_new(
+    def _new_handle(self):
+        """A fresh C++ loader (own prefetch threads + cursor). Each iterator
+        owns one — independent streams, no shared state, no use-after-free."""
+        h = self._lib.dl_new(
             "\n".join(self.paths).encode(),
             self.batch_size,
             self.prefetch_depth,
@@ -178,16 +189,11 @@ class RecordLoader:
         )
         if not h:
             raise ValueError("native loader rejected the record files")
-        self._native = h
-
-    def __del__(self):
-        h, self._native = getattr(self, "_native", None), None
-        if h:
-            self._lib.dl_free(h)
+        return h
 
     @property
     def using_native(self) -> bool:
-        return self._native is not None
+        return self._lib is not None
 
     def _shard_count(self) -> int:
         total = sum(read_header(p)[1] for p in self.paths)
@@ -196,41 +202,33 @@ class RecordLoader:
         )
 
     def num_records(self) -> int:
-        if self._native:
-            return int(self._lib.dl_num_records(self._native))
         return self._shard_count()
 
     # ------------------------------------------------------------- iteration
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        if self._native is None and not self._native_started:
+        """Every __iter__ is an independent fresh stream from the start,
+        on both paths (native: a dedicated C++ loader per iterator)."""
+        if self._lib is None:
             return self._iter_python()
-        # every __iter__ is a fresh stream from the start — the Python
-        # fallback's generator contract. The C++ handle advances (and
-        # latches end-of-data) as it is consumed, so once touched it must
-        # be rebuilt, even after partial consumption.
-        if self._native_started:
-            if self._native:
-                self._lib.dl_free(self._native)
-                self._native = None
-            self._configure_native()
-            self._native_started = False
-        return self._iter_native()
+        return self._iter_native(self._new_handle())
 
-    def _iter_native(self):
-        self._native_started = True
+    def _iter_native(self, handle):
         nbytes = self.batch_size * self._rsize
-        while True:
-            buf = np.empty(nbytes, np.uint8)
-            rc = self._lib.dl_next(
-                self._native,
-                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                nbytes,
-            )
-            if rc == 0:
-                return
-            if rc < 0:
-                raise IOError("native loader read error")
-            yield _split_batch(buf, self.batch_size, self.fields)
+        try:
+            while True:
+                buf = np.empty(nbytes, np.uint8)
+                rc = self._lib.dl_next(
+                    handle,
+                    buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    nbytes,
+                )
+                if rc == 0:
+                    return
+                if rc < 0:
+                    raise IOError("native loader read error")
+                yield _split_batch(buf, self.batch_size, self.fields)
+        finally:
+            self._lib.dl_free(handle)
 
     def _iter_python(self):
         # same record indexing/shuffle semantics as the native path

@@ -203,12 +203,23 @@ class FakeKubelet:
 
     def http_get(self, namespace: str, name: str, path: str) -> Dict:
         """GET a path on a pod's test-server — the analogue of the
-        reference's apiserver-proxy request (tf_job_client.py:251-298)."""
-        port = self.pod_port(namespace, name)
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}{path}", timeout=5
-        ) as r:
-            return json.loads(r.read().decode())
+        reference's apiserver-proxy request (tf_job_client.py:251-298).
+        Retries briefly: across a container restart the pod can look
+        Running with a stale port annotation while the new server is
+        still binding (the reference's send_request retries the same
+        way)."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            port = self.pod_port(namespace, name)
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as r:
+                    return json.loads(r.read().decode())
+            except (ConnectionError, urllib.error.URLError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
 
     def terminate_replica(
         self, namespace: str, name: str, exit_code: int = 0

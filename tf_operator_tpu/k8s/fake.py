@@ -64,10 +64,23 @@ class FakeCluster:
         return self._store.setdefault(kind, {})
 
     def subscribe(self, kind: str, handler: EventHandler) -> None:
-        self._handlers.setdefault(kind, []).append(handler)
+        with self._lock:
+            self._handlers.setdefault(kind, []).append(handler)
+
+    def unsubscribe(self, kind: str, handler: EventHandler) -> None:
+        with self._lock:
+            try:
+                self._handlers.get(kind, []).remove(handler)
+            except ValueError:
+                pass
 
     def _notify(self, kind: str, event_type: str, obj: Dict[str, Any]) -> None:
-        for h in self._handlers.get(kind, []):
+        # snapshot under the lock: a concurrent unsubscribe must not make
+        # the iteration skip an unrelated handler (list.remove shifts
+        # indices under a live for-loop)
+        with self._lock:
+            handlers = list(self._handlers.get(kind, []))
+        for h in handlers:
             h(event_type, copy.deepcopy(obj))
 
     # ------------------------------------------------------------- generic

@@ -190,6 +190,27 @@ class JobClient:
             pod: self.cluster.read_pod_log(namespace, pod) for pod in sorted(names)
         }
 
+    # ------------------------------------------------------------- watch
+    def watch(
+        self,
+        name: str,
+        namespace: str = "default",
+        timeout: Optional[float] = 600,
+        stop_at_terminal: bool = True,
+    ):
+        """Stream (event_type, job) transitions (sdk/watch.py; the
+        reference's tf_job_watch.py surface)."""
+        from tf_operator_tpu.sdk.watch import watch_job
+
+        return watch_job(
+            self.cluster,
+            self.kind,
+            name,
+            namespace,
+            timeout=timeout,
+            stop_at_terminal=stop_at_terminal,
+        )
+
 
 class TFJobClient(JobClient):
     KIND = "TFJob"

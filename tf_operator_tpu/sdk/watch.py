@@ -1,0 +1,88 @@
+"""Job watch helper — stream status transitions as they happen.
+
+The reference SDK ships tf_job_watch.py (a kubernetes.watch wrapper that
+prints NAME/STATE/TIME rows, SURVEY §2.6); this is the same surface over
+the cluster's event stream: subscribe to the job kind, yield
+(event_type, job_dict) whenever the watched job changes, with an optional
+terminal-state stop.
+"""
+from __future__ import annotations
+
+import queue
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+TERMINAL = ("Succeeded", "Failed")
+
+
+def job_state(job: Dict[str, Any]) -> str:
+    """Latest True condition type, '' if none (reference watch prints the
+    last condition as the job state)."""
+    conds = ((job.get("status") or {}).get("conditions")) or []
+    for c in reversed(conds):
+        if c.get("status", "True") == "True":
+            return c.get("type", "")
+    return ""
+
+
+def watch_job(
+    cluster,
+    kind: str,
+    name: str,
+    namespace: str = "default",
+    timeout: Optional[float] = 600,
+    stop_at_terminal: bool = True,
+) -> Iterator[Tuple[str, Dict[str, Any]]]:
+    """Yield (event_type, job) for every change to the named job.
+
+    event_type is ADDED/MODIFIED/DELETED (cluster event stream). The
+    current object, if it exists, is yielded first as 'ADDED' so callers
+    always see the present state. Stops on DELETED, on a terminal
+    condition (when stop_at_terminal), or after `timeout` seconds without
+    events (TimeoutError).
+
+    Subscription happens NOW (this is a plain function returning a
+    generator), so events between this call and the first next() are
+    queued, not lost.
+    """
+    q: "queue.Queue[Tuple[str, Dict[str, Any]]]" = queue.Queue()
+
+    def handler(event_type: str, obj: Dict[str, Any]) -> None:
+        meta = obj.get("metadata", {})
+        if meta.get("name") == name and meta.get("namespace", "default") == namespace:
+            q.put((event_type, obj))
+
+    cluster.subscribe(kind, handler)
+    return _watch_events(
+        cluster, kind, name, namespace, timeout, stop_at_terminal, q, handler
+    )
+
+
+def _watch_events(
+    cluster, kind, name, namespace, timeout, stop_at_terminal, q, handler
+) -> Iterator[Tuple[str, Dict[str, Any]]]:
+    try:
+        try:
+            current = cluster.get(kind, namespace, name)
+            yield ("ADDED", current)
+            if stop_at_terminal and job_state(current) in TERMINAL:
+                return
+        except Exception:  # noqa: BLE001 — not created yet; watch for it
+            pass
+        while True:
+            try:
+                event_type, obj = q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no events for {namespace}/{name} within {timeout}s"
+                ) from None
+            yield (event_type, obj)
+            if event_type == "DELETED":
+                return
+            if stop_at_terminal and job_state(obj) in TERMINAL:
+                return
+    finally:
+        # FakeCluster keeps handlers for its lifetime; real impls expose
+        # unsubscribe — use it when present
+        unsub = getattr(cluster, "unsubscribe", None)
+        if unsub is not None:
+            unsub(kind, handler)
