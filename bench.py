@@ -16,11 +16,30 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
-import optax
 
-from tf_operator_tpu.models.resnet import ResNet50
-from tf_operator_tpu.runtime.train import create_train_state, make_train_step
+
+def _ensure_backend() -> None:
+    """A dead TPU transport (tunnel down, remote_compile unreachable) must
+    degrade to a CPU measurement, not crash the bench."""
+    try:
+        jax.devices()
+    except RuntimeError as e:
+        print(f"# TPU backend unavailable ({e}); falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+
+
+_ensure_backend()
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from tf_operator_tpu.models.resnet import ResNet50  # noqa: E402
+from tf_operator_tpu.runtime.train import (  # noqa: E402
+    create_train_state,
+    make_train_step,
+)
 
 # Cloud TPU reference ResNet-50 training throughput anchors (images/sec/chip).
 # v2/v3 from the public Cloud TPU ResNet-50 reference (~3.3k/4.0k img/s per

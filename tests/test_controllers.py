@@ -202,8 +202,9 @@ def test_pytorch_retryable_exit_code_restarts():
 
 def test_recreated_job_does_not_adopt_old_incarnation_pods():
     """Same name, new UID: stale Failed pods from the deleted incarnation
-    must not be claimed (strict UID claim)."""
-    cluster = FakeCluster()
+    must not be claimed (strict UID claim). gc=False simulates the GC-lag
+    window where the stale pod still exists."""
+    cluster = FakeCluster(gc=False)
     engine = make_engine("TFJob", cluster)
     job = testutil.new_tfjob(worker=1)
     cluster.create(job.kind, job.to_dict())

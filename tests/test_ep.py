@@ -106,6 +106,30 @@ def test_gradients_flow_through_all_to_all():
     np.testing.assert_allclose(g_wo, r_wo, atol=1e-4, rtol=1e-4)
 
 
+def test_transformer_moe_alltoall_matches_dense_dispatch():
+    """Model-level EP: a MoE Transformer with moe_dispatch_fn (all-to-all)
+    must reproduce the dense-dispatch MoeMlp when capacity is ample."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import Transformer, tiny
+
+    mesh = make_mesh({"ep": 4, "dp": 2})
+    cfg_dense = tiny(n_experts=4, moe_every=1, dtype=jnp.float32)
+    cfg_a2a = tiny(
+        n_experts=4, moe_every=1, dtype=jnp.float32,
+        moe_dispatch_fn=make_switch_moe(mesh, n_experts=4,
+                                        capacity_factor=4.0),
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (4, 16), 0, 256)
+    m_dense, m_a2a = Transformer(cfg_dense), Transformer(cfg_a2a)
+    params = m_dense.init(jax.random.PRNGKey(7), tokens, train=False)["params"]
+    want = m_dense.apply({"params": params}, tokens, train=False)
+    got = jax.jit(
+        lambda p, t: m_a2a.apply({"params": p}, t, train=False)
+    )(params, tokens)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
 def test_validation_errors():
     mesh = make_mesh({"ep": EP, "dp": 8 // EP})
     with pytest.raises(ValueError, match="not divisible"):

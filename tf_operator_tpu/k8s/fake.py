@@ -44,9 +44,16 @@ EventHandler = Callable[[str, Dict[str, Any]], None]  # (event_type, obj)
 
 class FakeCluster:
     """In-memory object store: pods, services, podgroups, and job CRs
-    (stored unstructured, keyed by kind)."""
+    (stored unstructured, keyed by kind).
 
-    def __init__(self) -> None:
+    `gc=True` (default) emulates the k8s garbage collector synchronously:
+    deleting an owner reaps its dependents, and a dependent created for an
+    already-dead owner is reaped on arrival. Pass gc=False to simulate GC
+    lag windows (e.g. the stale-incarnation adoption races the controller
+    must survive on its own)."""
+
+    def __init__(self, gc: bool = True) -> None:
+        self.gc = gc
         self._lock = threading.RLock()
         # kind -> {namespace/name -> obj}
         self._store: Dict[str, Dict[str, Dict[str, Any]]] = {}
@@ -97,7 +104,36 @@ class FakeCluster:
             self._bump(obj)
             store[key] = obj
         self._notify(kind, "ADDED", obj)
+        # GC also covers the create-after-owner-delete race: a dependent
+        # born to a dead owner (reconcile in flight while the CR was
+        # deleted) is reaped immediately, as the k8s garbage collector
+        # would on its next observation
+        owner_uid = next(
+            (
+                ref.get("uid")
+                for ref in obj["metadata"].get("ownerReferences", []) or []
+                if ref.get("controller")
+            ),
+            None,
+        )
+        if self.gc and owner_uid is not None and not self._uid_alive(owner_uid):
+            try:
+                self.delete(
+                    kind,
+                    obj["metadata"].get("namespace", "default"),
+                    obj["metadata"]["name"],
+                )
+            except NotFoundError:
+                pass
         return copy.deepcopy(obj)
+
+    def _uid_alive(self, uid: str) -> bool:
+        with self._lock:
+            return any(
+                o["metadata"].get("uid") == uid
+                for store in self._store.values()
+                for o in store.values()
+            )
 
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
         with self._lock:
@@ -135,6 +171,31 @@ class FakeCluster:
                 raise NotFoundError(f"{kind} {key}")
             obj = store.pop(key)
         self._notify(kind, "DELETED", obj)
+        self._collect_garbage(namespace, obj.get("metadata", {}).get("uid"))
+
+    def _collect_garbage(self, namespace: str, owner_uid: Optional[str]) -> None:
+        """Owner-based cascading deletion — the role the k8s garbage
+        collector plays for the reference (pods/services carry a
+        controller ownerReference; deleting the job CR reaps them).
+        Without this, a job deleted mid-reconcile strands its pods."""
+        if not owner_uid or not self.gc:
+            return
+        with self._lock:
+            dependents = [
+                (kind, o["metadata"].get("namespace", "default"),
+                 o["metadata"]["name"])
+                for kind, store in self._store.items()
+                for o in store.values()
+                if any(
+                    ref.get("uid") == owner_uid
+                    for ref in o["metadata"].get("ownerReferences", []) or []
+                )
+            ]
+        for dep_kind, dep_ns, dep_name in dependents:
+            try:
+                self.delete(dep_kind, dep_ns, dep_name)
+            except NotFoundError:
+                pass  # lost a race with another deleter — already gone
 
     def list(
         self,

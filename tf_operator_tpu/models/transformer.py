@@ -37,6 +37,10 @@ class TransformerConfig:
     # MoE: replace the MLP with a mixture of experts every `moe_every` blocks
     n_experts: int = 0
     moe_every: int = 2
+    # None -> dense masked-einsum dispatch; or parallel/ep.make_switch_moe
+    # for explicit all-to-all expert parallelism:
+    # (x, router_logits, wi, wo) -> (y, aux_loss)
+    moe_dispatch_fn: Optional[Callable] = None
 
     @property
     def head_dim(self) -> int:
@@ -110,9 +114,14 @@ class Mlp(nn.Module):
 
 class MoeMlp(nn.Module):
     """Mixture-of-experts MLP: top-1 switch routing, experts sharded over the
-    'ep' mesh axis (parallel/tp.py rules). Dense einsum dispatch keeps shapes
-    static for XLA (capacity = tokens; no dropping) — idiomatic for moderate
-    expert counts on TPU."""
+    'ep' mesh axis (parallel/tp.py rules).
+
+    Dispatch strategy: dense masked-einsum by default (capacity = tokens,
+    no dropping; static shapes, GSPMD handles the expert sharding —
+    idiomatic for moderate expert counts on TPU), or, when
+    cfg.moe_dispatch_fn is set (parallel/ep.make_switch_moe), explicit
+    all-to-all expert parallelism — two ICI collectives instead of the
+    [B,S,E] expansion, the scalable route for large E."""
 
     cfg: TransformerConfig
 
@@ -123,10 +132,6 @@ class MoeMlp(nn.Module):
         n_e = cfg.n_experts
         router = nn.Dense(n_e, dtype=jnp.float32, use_bias=False, name="router")
         logits = router(x.astype(jnp.float32))  # [B,S,E]
-        probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)  # [B,S]
-        gate = jnp.max(probs, axis=-1)  # [B,S]
-        onehot = jax.nn.one_hot(expert_idx, n_e, dtype=cfg.dtype)  # [B,S,E]
 
         wi = self.param(
             "wi", nn.initializers.lecun_normal(), (n_e, d, cfg.d_ff), jnp.float32
@@ -134,6 +139,16 @@ class MoeMlp(nn.Module):
         wo = self.param(
             "wo", nn.initializers.lecun_normal(), (n_e, cfg.d_ff, d), jnp.float32
         ).astype(cfg.dtype)
+
+        if cfg.moe_dispatch_fn is not None:
+            out, aux = cfg.moe_dispatch_fn(x, logits, wi, wo)
+            self.sow("intermediates", "moe_aux_loss", aux)
+            return out
+
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # [B,S]
+        gate = jnp.max(probs, axis=-1)  # [B,S]
+        onehot = jax.nn.one_hot(expert_idx, n_e, dtype=cfg.dtype)  # [B,S,E]
         # dense dispatch: every token through its expert via masked einsum
         h = jnp.einsum("bsd,edf->bsef", x, wi)
         h = nn.gelu(h)
