@@ -220,8 +220,11 @@ def test_recreated_job_does_not_adopt_old_incarnation_pods():
     job2, result = reconcile(cluster, engine, job2)
     assert not common.is_failed(job2.status)
     assert result.error is not None and "exists" in result.error
-    # once the stale pod finishes terminating, the new incarnation proceeds
+    # once the stale pod AND service finish terminating (in reality the
+    # garbage collector reaps both via their ownerReferences — services now
+    # carry one too), the new incarnation proceeds
     cluster.delete_pod("default", "test-tfjob-worker-0")
+    cluster.delete_service("default", "test-tfjob-worker-0")
     job2, result = reconcile(cluster, engine, job2)
     assert result.error is None
     assert len(cluster.list_pods()) == 1

@@ -1,0 +1,522 @@
+"""ClusterClient (real-apiserver backend) tests.
+
+Two tiers, mirroring what envtest gives the reference
+(pkg/controller.v1/tensorflow/suite_test.go:50-76):
+
+1. Scripted `StubTransport` — asserts the exact REST wire behavior
+   (paths, verbs, label selectors, status-subresource split) and that real
+   apiserver responses (409 stale RV, 404, watch MODIFIED/DELETED/BOOKMARK,
+   410 Gone relist) surface with FakeCluster-identical semantics.
+2. `ApiServerTransport` façade over FakeCluster — full REST round-trips
+   including watch streaming (test_e2e.py additionally runs the whole
+   manager e2e suite over this backend).
+"""
+import base64
+import json
+import queue
+import textwrap
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.e2e.apiserver import ApiServerTransport
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.client import (
+    ClusterClient,
+    load_kubeconfig,
+    resource_path,
+    selector_to_query,
+)
+from tf_operator_tpu.k8s.fake import (
+    ApiError,
+    ConflictError,
+    FakeCluster,
+    NotFoundError,
+)
+
+
+# ------------------------------------------------------------ scripted stub
+class StubTransport:
+    """Records every request; replies from a scripted queue or a handler."""
+
+    def __init__(self):
+        self.calls = []
+        self.replies = []
+        self.handler = None
+        self.streams = []  # scripted watch streams: list of list-of-events
+
+    def expect(self, status, body):
+        self.replies.append((status, body))
+
+    def request(self, method, path, query=None, body=None):
+        self.calls.append((method, path, query, body))
+        if self.handler:
+            return self.handler(method, path, query, body)
+        return self.replies.pop(0)
+
+    def stream(self, path, query=None, cancel=None):
+        self.calls.append(("WATCH", path, query, None))
+        cancelled = threading.Event()
+        if cancel is not None:
+            cancel.append(cancelled.set)  # registered eagerly, like HttpTransport
+        if not self.streams:
+            def _quiet():
+                while not cancelled.is_set():  # quiet watch: nothing to say
+                    time.sleep(0.05)
+                return
+                yield  # pragma: no cover — makes this a generator
+
+            return _quiet()
+        events = self.streams.pop(0)
+        if isinstance(events, ApiError):
+            raise events
+        return iter(events)
+
+
+def make_client(namespace=""):
+    t = StubTransport()
+    return ClusterClient(t, namespace=namespace), t
+
+
+def test_resource_paths():
+    assert resource_path("Pod", "ns1", "p0") == "/api/v1/namespaces/ns1/pods/p0"
+    assert resource_path("Pod", None) == "/api/v1/pods"
+    assert (
+        resource_path("TFJob", "ns1", "j", "status")
+        == "/apis/kubeflow.org/v1/namespaces/ns1/tfjobs/j/status"
+    )
+    assert (
+        resource_path("PodGroup", "ns1", "pg")
+        == "/apis/scheduling.volcano.sh/v1beta1/namespaces/ns1/podgroups/pg"
+    )
+    assert (
+        resource_path("Lease", "kube-system", "lock")
+        == "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases/lock"
+    )
+    with pytest.raises(ApiError):
+        resource_path("Widget", "ns1")
+
+
+def test_selector_query_is_sorted_and_joined():
+    assert selector_to_query({"b": "2", "a": "1"}) == "a=1,b=2"
+    assert selector_to_query(None) is None
+
+
+def test_create_posts_to_namespace_collection():
+    c, t = make_client()
+    pod = objects.make_pod("p0", namespace="ns1")
+    t.expect(201, {**pod, "metadata": {**pod["metadata"], "uid": "u1"}})
+    out = c.create_pod(pod)
+    method, path, _, body = t.calls[0]
+    assert (method, path) == ("POST", "/api/v1/namespaces/ns1/pods")
+    assert body["metadata"]["name"] == "p0"
+    assert out["metadata"]["uid"] == "u1"
+
+
+def test_conflict_on_create_maps_to_conflict_error():
+    c, t = make_client()
+    t.expect(409, {"kind": "Status", "message": "already exists", "code": 409})
+    with pytest.raises(ConflictError):
+        c.create_pod(objects.make_pod("p0"))
+
+
+def test_get_404_maps_to_not_found():
+    c, t = make_client()
+    t.expect(404, {"kind": "Status", "message": "not found", "code": 404})
+    with pytest.raises(NotFoundError):
+        c.get_pod("default", "ghost")
+
+
+def test_update_stale_rv_maps_to_conflict():
+    c, t = make_client()
+    t.expect(409, {"kind": "Status", "message": "rv conflict", "code": 409})
+    pod = objects.make_pod("p0")
+    pod["metadata"]["resourceVersion"] = "5"
+    with pytest.raises(ConflictError):
+        c.update_pod(pod)
+    method, path, _, _ = t.calls[0]
+    assert (method, path) == ("PUT", "/api/v1/namespaces/default/pods/p0")
+
+
+def test_job_update_splits_status_subresource():
+    """One FakeCluster-style update = main PUT + /status PUT carrying the RV
+    the main PUT returned (apiserver drops status on main-resource writes)."""
+    c, t = make_client()
+    job = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": "j", "namespace": "ns1", "resourceVersion": "3"},
+        "spec": {"x": 1},
+        "status": {"conditions": [{"type": "Running"}]},
+    }
+    main_reply = {**job, "metadata": {**job["metadata"], "resourceVersion": "4"}}
+    status_reply = {**job, "metadata": {**job["metadata"], "resourceVersion": "5"}}
+    t.expect(200, main_reply)
+    t.expect(200, status_reply)
+    out = c.update("TFJob", job)
+    (m1, p1, _, b1), (m2, p2, _, b2) = t.calls
+    assert (m1, p1) == ("PUT", "/apis/kubeflow.org/v1/namespaces/ns1/tfjobs/j")
+    assert (m2, p2) == (
+        "PUT",
+        "/apis/kubeflow.org/v1/namespaces/ns1/tfjobs/j/status",
+    )
+    assert b2["metadata"]["resourceVersion"] == "4"  # RV from the main PUT
+    assert out["metadata"]["resourceVersion"] == "5"
+
+
+def test_update_without_status_is_single_put():
+    c, t = make_client()
+    job = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": "j", "namespace": "ns1"},
+        "spec": {},
+    }
+    t.expect(200, job)
+    c.update("TFJob", job)
+    assert len(t.calls) == 1
+
+
+def test_list_sends_label_selector_and_restores_kind():
+    c, t = make_client()
+    t.expect(
+        200,
+        {
+            "kind": "PodList",
+            "metadata": {"resourceVersion": "9"},
+            "items": [{"metadata": {"name": "p0", "namespace": "d"}}],
+        },
+    )
+    out = c.list_pods(namespace="d", selector={"job-name": "j", "a": "b"})
+    _, path, query, _ = t.calls[0]
+    assert path == "/api/v1/namespaces/d/pods"
+    assert query == {"labelSelector": "a=b,job-name=j"}
+    assert out[0]["kind"] == "Pod"
+
+
+def test_list_all_namespaces_when_unscoped():
+    c, t = make_client(namespace="")
+    t.expect(200, {"items": []})
+    c.list("Service")
+    assert t.calls[0][1] == "/api/v1/services"
+
+
+def test_list_uses_client_namespace_scope():
+    c, t = make_client(namespace="kubeflow")
+    t.expect(200, {"items": []})
+    c.list("Service")
+    assert t.calls[0][1] == "/api/v1/namespaces/kubeflow/services"
+
+
+def test_delete_404_maps_to_not_found():
+    c, t = make_client()
+    t.expect(404, {"message": "gone", "code": 404})
+    with pytest.raises(NotFoundError):
+        c.delete_pod("d", "p0")
+
+
+def test_read_pod_log():
+    c, t = make_client()
+    t.expect(200, "line1\nline2")
+    assert c.read_pod_log("d", "p0") == "line1\nline2"
+    assert t.calls[0][1] == "/api/v1/namespaces/d/pods/p0/log"
+
+
+def test_record_event_posts_v1_event_and_swallows_errors():
+    c, t = make_client()
+    t.expect(201, {})
+    job = {"kind": "TFJob", "metadata": {"name": "j", "namespace": "d", "uid": "u"}}
+    c.record_event(job, "Warning", "Reason", "msg")
+    method, path, _, body = t.calls[0]
+    assert (method, path) == ("POST", "/api/v1/namespaces/d/events")
+    assert body["involvedObject"] == {
+        "kind": "TFJob",
+        "name": "j",
+        "namespace": "d",
+        "uid": "u",
+    }
+    assert body["type"] == "Warning" and body["reason"] == "Reason"
+    # a failing event write must not raise (observability never fails reconcile)
+    t.expect(500, {"message": "boom"})
+    c.record_event(job, "Normal", "R", "m")
+
+
+# --------------------------------------------------------------- watch loop
+def _wait_until(pred, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise TimeoutError(what)
+
+
+def test_watch_dispatches_and_handles_bookmark_and_gone():
+    t = StubTransport()
+    pod1 = {"kind": "Pod", "metadata": {"name": "a", "namespace": "d", "resourceVersion": "2"}}
+    pod2 = {"kind": "Pod", "metadata": {"name": "a", "namespace": "d", "resourceVersion": "3"}}
+
+    lists = queue.Queue()
+    lists.put({"metadata": {"resourceVersion": "1"}, "items": []})
+    lists.put({"metadata": {"resourceVersion": "7"}, "items": []})
+
+    def handler(method, path, query, body):
+        assert path == "/api/v1/pods"
+        return 200, lists.get(timeout=5)
+
+    t.handler = handler
+    # stream 1: ADDED, BOOKMARK(rv=5), MODIFIED, then ERROR 410 -> relist
+    t.streams.append(
+        [
+            {"type": "ADDED", "object": pod1},
+            {"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": "5"}}},
+            {"type": "MODIFIED", "object": pod2},
+            {"type": "ERROR", "object": {"kind": "Status", "code": 410}},
+        ]
+    )
+    # stream 2 (after relist): a late DELETED for the same pod — the relist
+    # diff already reported it gone, so this replay must be suppressed
+    t.streams.append(
+        [{"type": "DELETED", "object": {**pod2, "metadata": {**pod2["metadata"], "resourceVersion": "8"}}}]
+    )
+
+    c = ClusterClient(t)
+    got = []
+    c.subscribe("Pod", lambda et, obj: got.append((et, obj["metadata"]["resourceVersion"])))
+    # the relist (rv 7, no items) diff-reports the DELETED itself
+    _wait_until(lambda: len(got) == 3, what="3 watch events")
+    assert got == [("ADDED", "2"), ("MODIFIED", "3"), ("DELETED", "7")]
+    # the relist happened (two list calls) and the second watch resumed from
+    # the fresh list RV
+    watch_calls = [q for (m, p, q, b) in t.calls if m == "WATCH"]
+    assert watch_calls[0]["resourceVersion"] == "1"
+    assert watch_calls[1]["resourceVersion"] == "7"
+    c.close()
+
+
+def test_watch_410_gap_repaired_by_relist_diff():
+    """Events lost while the watch was expired MUST still reach subscribers:
+    the relist diffs against delivered state (client-go Reflector replace
+    semantics) — a relist that only re-pins the rv would hide the gap
+    forever, breaking FakeCluster's lossless-subscribe contract."""
+    t = StubTransport()
+    pod_a1 = {"kind": "Pod", "metadata": {"name": "a", "namespace": "d", "resourceVersion": "2"}}
+    pod_a2 = {"kind": "Pod", "metadata": {"name": "a", "namespace": "d", "resourceVersion": "6"}}
+    pod_b = {"kind": "Pod", "metadata": {"name": "b", "namespace": "d", "resourceVersion": "5"}}
+    pod_c = {"kind": "Pod", "metadata": {"name": "c", "namespace": "d", "resourceVersion": "3"}}
+
+    lists = queue.Queue()
+    # seed list: pod c exists before subscribe (must NOT be dispatched)
+    lists.put({"metadata": {"resourceVersion": "1"}, "items": [dict(pod_c)]})
+    # relist after the 410 gap: a modified, b created, c deleted
+    lists.put({"metadata": {"resourceVersion": "7"}, "items": [dict(pod_a2), dict(pod_b)]})
+    t.handler = lambda m, p, q, b: (200, lists.get(timeout=5))
+    # stream 1: ADDED a, then the watch dies with 410
+    t.streams.append(
+        [
+            {"type": "ADDED", "object": pod_a1},
+            {"type": "ERROR", "object": {"kind": "Status", "code": 410}},
+        ]
+    )
+
+    c = ClusterClient(t)
+    got = []
+    c.subscribe("Pod", lambda et, obj: got.append((et, obj["metadata"]["name"])))
+    _wait_until(lambda: len(got) >= 4, what="gap-repair events")
+    assert got[0] == ("ADDED", "a")
+    # diff events, order-insensitive between kinds of change
+    repair = set(got[1:4])
+    assert repair == {("MODIFIED", "a"), ("ADDED", "b"), ("DELETED", "c")}
+    c.close()
+
+
+def test_close_unblocks_quiet_watch_thread():
+    """close() must abort a stream blocked with nothing to deliver — the
+    cancel hook — instead of leaking the thread and its connection."""
+    t = StubTransport()
+    t.handler = lambda *a: (200, {"metadata": {"resourceVersion": "1"}, "items": []})
+    c = ClusterClient(t)
+    c.subscribe("Pod", lambda et, obj: None)
+    loop = c._watches["Pod"]
+    c.close()
+    loop._thread.join(timeout=3.0)
+    assert not loop._thread.is_alive(), "watch thread must exit on close()"
+
+
+def test_unsubscribe_stops_loop_when_last_handler_removed():
+    t = StubTransport()
+    t.handler = lambda *a: (200, {"metadata": {"resourceVersion": "1"}, "items": []})
+    c = ClusterClient(t)
+    h = lambda et, obj: None  # noqa: E731
+    c.subscribe("Pod", h)
+    assert "Pod" in c._watches
+    c.unsubscribe("Pod", h)
+    assert "Pod" not in c._watches
+
+
+# ------------------------------------------------------------- kubeconfig
+def test_load_kubeconfig_token_and_inline_certs(tmp_path):
+    ca = base64.b64encode(b"CA PEM").decode()
+    cfg_file = tmp_path / "kubeconfig"
+    cfg_file.write_text(
+        textwrap.dedent(
+            f"""
+            apiVersion: v1
+            kind: Config
+            current-context: ctx
+            contexts:
+            - name: ctx
+              context: {{cluster: c1, user: u1}}
+            clusters:
+            - name: c1
+              cluster:
+                server: https://10.0.0.1:6443
+                certificate-authority-data: {ca}
+            users:
+            - name: u1
+              user:
+                token: sekrit-token
+            """
+        )
+    )
+    kc = load_kubeconfig(str(cfg_file))
+    assert kc.server == "https://10.0.0.1:6443"
+    assert kc.token == "sekrit-token"
+    with open(kc.ca_cert_file, "rb") as fh:
+        assert fh.read() == b"CA PEM"
+
+
+def test_load_kubeconfig_missing_context_raises(tmp_path):
+    cfg_file = tmp_path / "kc"
+    cfg_file.write_text("apiVersion: v1\ncurrent-context: nope\ncontexts: []\n")
+    with pytest.raises(ValueError, match="context"):
+        load_kubeconfig(str(cfg_file))
+
+
+# ----------------------------------------------------- façade integration
+@pytest.fixture()
+def rest_cluster():
+    fake = FakeCluster()
+    transport = ApiServerTransport(fake)
+    client = ClusterClient(transport)
+    yield fake, client
+    client.close()
+    transport.close()
+
+
+def test_facade_crud_round_trip(rest_cluster):
+    fake, c = rest_cluster
+    pod = objects.make_pod("p0", namespace="d", labels={"job-name": "j"})
+    created = c.create_pod(pod)
+    assert created["metadata"]["uid"]
+    assert c.get_pod("d", "p0")["metadata"]["name"] == "p0"
+    assert [objects.name_of(p) for p in c.list_pods(selector={"job-name": "j"})] == ["p0"]
+    assert c.list_pods(selector={"job-name": "other"}) == []
+    c.delete_pod("d", "p0")
+    with pytest.raises(NotFoundError):
+        c.get_pod("d", "p0")
+
+
+def test_facade_duplicate_create_conflicts(rest_cluster):
+    _, c = rest_cluster
+    c.create_pod(objects.make_pod("p0"))
+    with pytest.raises(ConflictError):
+        c.create_pod(objects.make_pod("p0"))
+
+
+def test_facade_stale_rv_update_conflicts(rest_cluster):
+    _, c = rest_cluster
+    created = c.create_pod(objects.make_pod("p0"))
+    c.update_pod(created)  # bumps RV server-side
+    with pytest.raises(ConflictError):
+        c.update_pod(created)  # stale RV
+
+
+def test_facade_status_subresource_is_isolated(rest_cluster):
+    """Main PUT keeps stored status; /status PUT keeps stored spec."""
+    _, c = rest_cluster
+    job = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": "j", "namespace": "d"},
+        "spec": {"v": 1},
+    }
+    created = c.create("TFJob", job)
+    # write a status through the split-update path
+    created["status"] = {"conditions": [{"type": "Created"}]}
+    updated = c.update("TFJob", created)
+    assert updated["status"]["conditions"][0]["type"] == "Created"
+    # a spec-only writer that carries NO status must not clobber it
+    fresh = c.get("TFJob", "d", "j")
+    fresh["spec"]["v"] = 2
+    fresh.pop("status")
+    after = c.update("TFJob", fresh)
+    final = c.get("TFJob", "d", "j")
+    assert final["spec"]["v"] == 2
+    assert final["status"]["conditions"][0]["type"] == "Created", (
+        "main-resource PUT must not wipe the status subresource"
+    )
+    assert after["metadata"]["resourceVersion"]
+
+
+def test_facade_watch_delivers_post_subscribe_events(rest_cluster):
+    fake, c = rest_cluster
+    pre = objects.make_pod("pre", namespace="d")
+    fake.create_pod(pre)  # before subscribe: must NOT be delivered
+    got = []
+    c.subscribe("Pod", lambda et, obj: got.append((et, objects.name_of(obj))))
+    time.sleep(0.05)
+    post = objects.make_pod("post", namespace="d")
+    c.create_pod(post)
+    live = c.get_pod("d", "post")
+    c.update_pod(live)
+    c.delete_pod("d", "post")
+    _wait_until(lambda: len(got) >= 3, what="watch events")
+    assert got[:3] == [("ADDED", "post"), ("MODIFIED", "post"), ("DELETED", "post")]
+
+
+def test_facade_watch_survives_410_expiry(rest_cluster):
+    fake, c = rest_cluster
+    transport = c.transport
+    got = []
+    c.subscribe("Pod", lambda et, obj: got.append((et, objects.name_of(obj))))
+    c.create_pod(objects.make_pod("a", namespace="d"))
+    _wait_until(lambda: ("ADDED", "a") in got, what="first event")
+    transport.expire_watches()  # kills the live watch with 410 Gone
+    time.sleep(0.1)
+    c.create_pod(objects.make_pod("b", namespace="d"))
+    _wait_until(lambda: ("ADDED", "b") in got, what="event after relist")
+
+
+def test_facade_generate_name(rest_cluster):
+    _, c = rest_cluster
+    ev = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {"generateName": "j.", "namespace": "d"},
+        "type": "Normal",
+        "involvedObject": {"name": "j"},
+    }
+    out = c.create("Event", ev)
+    assert out["metadata"]["name"].startswith("j.")
+    assert len(out["metadata"]["name"]) > len("j.")
+
+
+def test_facade_record_event_and_events_for(rest_cluster):
+    _, c = rest_cluster
+    job = {"kind": "TFJob", "metadata": {"name": "j", "namespace": "d", "uid": "u"}}
+    c.record_event(job, "Warning", "Unhealthy", "bad")
+    c.record_event(job, "Normal", "Created", "ok")
+    warnings = c.events_for("j", "Warning")
+    assert len(warnings) == 1 and warnings[0]["reason"] == "Unhealthy"
+    assert len(c.events_for("j")) == 2
+    assert c.events_for("other") == []
+
+
+def test_facade_pod_log_passthrough(rest_cluster):
+    fake, c = rest_cluster
+    fake.create_pod(objects.make_pod("p0", namespace="d"))
+    fake.append_pod_log("d", "p0", "hello")
+    fake.append_pod_log("d", "p0", "world")
+    assert c.read_pod_log("d", "p0") == "hello\nworld"

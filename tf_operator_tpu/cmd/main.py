@@ -31,8 +31,20 @@ NAMESPACE_ENV = "KUBEFLOW_NAMESPACE"
 
 
 def build_cluster(options: ServerOptions):
-    # Real-apiserver client would be selected here by --kubeconfig; the
-    # in-memory store is the standalone backend.
+    """Select the cluster backend (reference server.go:198-229 clientset
+    construction): --kubeconfig / $KUBECONFIG / in-cluster service account
+    selects the real-apiserver ClusterClient; otherwise the in-memory
+    FakeCluster serves as a fully functional standalone state store."""
+    if (
+        options.kubeconfig
+        or os.environ.get("KUBECONFIG")
+        or os.environ.get("KUBERNETES_SERVICE_HOST")
+    ):
+        from tf_operator_tpu.k8s.client import ClusterClient
+
+        return ClusterClient.from_kubeconfig(
+            options.kubeconfig, namespace=options.namespace
+        )
     return FakeCluster()
 
 

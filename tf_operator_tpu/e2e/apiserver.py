@@ -1,0 +1,272 @@
+"""In-process apiserver façade: real REST semantics over FakeCluster.
+
+`ApiServerTransport` implements the `HttpTransport` protocol
+(`request`/`stream`) by translating Kubernetes REST calls — paths, label
+selectors, status subresources, generateName, watch streams with
+resourceVersion replay, 410 Gone expiry — onto a backing FakeCluster.
+
+This is the repo's envtest tier (reference
+pkg/controller.v1/tensorflow/suite_test.go:50-76 boots etcd+kube-apiserver):
+no real apiserver binary exists in this environment, so the achievable
+equivalent is the REST *behavior* replayed in process.  Driving the manager
+through `ClusterClient(ApiServerTransport(fake))` exercises every REST code
+path (serialization, routing, subresource split, watch reconnect) that the
+live-cluster client uses, while FakeKubelet keeps simulating node behavior
+against the same backing store — the position a real kubelet occupies
+relative to a real apiserver.
+"""
+from __future__ import annotations
+
+import re
+import threading
+import uuid
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.client import KIND_REGISTRY
+from tf_operator_tpu.k8s.fake import ApiError, ConflictError, FakeCluster, NotFoundError
+
+_PLURAL_TO_KIND = {info.plural: kind for kind, info in KIND_REGISTRY.items()}
+
+# /api/v1/... or /apis/{group}/{version}/... ; optional namespace segment;
+# plural; optional name; optional subresource
+_PATH_RE = re.compile(
+    r"^/(?:api/(?P<core_version>v1)|apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"(?:/namespaces/(?P<namespace>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+)(?:/(?P<sub>[^/]+))?)?$"
+)
+
+
+def _parse_path(path: str) -> Tuple[str, Optional[str], Optional[str], Optional[str]]:
+    m = _PATH_RE.match(path)
+    if not m:
+        raise ApiError(404, f"no route for {path}")
+    plural = m.group("plural")
+    kind = _PLURAL_TO_KIND.get(plural)
+    if kind is None:
+        raise ApiError(404, f"unknown resource {plural}")
+    return kind, m.group("namespace"), m.group("name"), m.group("sub")
+
+
+def _parse_selector(query: Optional[Dict[str, str]]) -> Optional[Dict[str, str]]:
+    sel = (query or {}).get("labelSelector")
+    if not sel:
+        return None
+    out = {}
+    for clause in sel.split(","):
+        k, _, v = clause.partition("=")
+        out[k] = v
+    return out
+
+
+def _status_payload(code: int, message: str) -> Dict[str, Any]:
+    reasons = {
+        404: "NotFound",
+        409: "Conflict",
+        400: "BadRequest",
+        410: "Gone",
+        422: "Invalid",
+    }
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": message,
+        "reason": reasons.get(code, "InternalError"),
+        "code": code,
+    }
+
+
+class ApiServerTransport:
+    """The `HttpTransport` protocol served from a FakeCluster."""
+
+    def __init__(self, fake: FakeCluster) -> None:
+        self.fake = fake
+        self._lock = threading.Condition()
+        # per-kind ordered event logs: List[(seq, etype, obj)]
+        self._logs: Dict[str, List[Tuple[int, str, Dict[str, Any]]]] = {}
+        self._seq = 0
+        self._min_rv = 0  # watches below this rv get 410 Gone (expiry sim)
+        self._closed = False
+        for kind in KIND_REGISTRY:
+            fake.subscribe(kind, self._make_recorder(kind))
+
+    # keep at most this many events per kind; older entries are pruned and the
+    # 410 horizon advances so a slow watcher relists (the client's relist
+    # diffs against its delivered state, so pruning never loses updates)
+    MAX_LOG = 4096
+
+    def _make_recorder(self, kind: str):
+        def record(etype: str, obj: Dict[str, Any]) -> None:
+            with self._lock:
+                self._seq += 1
+                try:
+                    rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
+                except (TypeError, ValueError):
+                    rv = 0
+                seq = max(self._seq, rv)
+                self._seq = seq
+                if etype == "DELETED":
+                    # real apiserver stamps deletes with a fresh rv; the fake
+                    # pops the object carrying its last stored rv — restamp so
+                    # watch replay ordering stays monotone
+                    obj.setdefault("metadata", {})["resourceVersion"] = str(seq)
+                log = self._logs.setdefault(kind, [])
+                log.append((seq, etype, obj))
+                if len(log) > self.MAX_LOG:
+                    drop = len(log) - self.MAX_LOG
+                    self._min_rv = max(self._min_rv, log[drop - 1][0])
+                    del log[:drop]
+                self._lock.notify_all()
+
+        return record
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    def expire_watches(self) -> None:
+        """Simulate watch-cache expiry: active and future watches pinned at
+        the current horizon get 410 Gone and must relist."""
+        with self._lock:
+            self._seq += 1
+            self._min_rv = self._seq
+            self._lock.notify_all()
+
+    # ------------------------------------------------------------- request
+    def request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[int, Any]:
+        try:
+            kind, ns, name, sub = _parse_path(path)
+            if method == "GET" and name and sub == "log" and kind == "Pod":
+                return 200, self.fake.read_pod_log(ns, name)
+            if method == "GET" and name:
+                return 200, self.fake.get(kind, ns, name)
+            if method == "GET":
+                # snapshot the horizon BEFORE listing: an rv claimed after the
+                # list could cover a concurrent create whose object the list
+                # missed, and a watcher pinning that rv would never see it
+                # (duplicate delivery is safe; loss is not)
+                with self._lock:
+                    rv = str(self._seq)
+                items = self.fake.list(
+                    kind, namespace=ns, selector=_parse_selector(query)
+                )
+                return 200, {
+                    "kind": f"{kind}List",
+                    "apiVersion": "v1",
+                    "metadata": {"resourceVersion": rv},
+                    "items": items,
+                }
+            if method == "POST":
+                obj = dict(body or {})
+                meta = dict(obj.get("metadata") or {})
+                if not meta.get("name") and meta.get("generateName"):
+                    meta["name"] = meta["generateName"] + uuid.uuid4().hex[:6]
+                if ns:
+                    meta["namespace"] = ns
+                obj["metadata"] = meta
+                if not meta.get("name"):
+                    return 422, _status_payload(422, "name or generateName required")
+                return 201, self.fake.create(kind, obj)
+            if method == "PUT" and name:
+                return 200, self._put(kind, ns, name, sub, body or {})
+            if method == "DELETE" and name:
+                self.fake.delete(kind, ns, name)
+                return 200, _status_payload_success()
+            return 405, _status_payload(400, f"method {method} not allowed")
+        except NotFoundError as e:
+            return 404, _status_payload(404, str(e))
+        except ConflictError as e:
+            return 409, _status_payload(409, str(e))
+        except ApiError as e:
+            return e.code, _status_payload(e.code, str(e))
+
+    def _put(
+        self, kind: str, ns: str, name: str, sub: Optional[str], body: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        info = KIND_REGISTRY[kind]
+        if not info.has_status:
+            return self.fake.update(kind, body)
+        # status-subresource kinds: a main-resource PUT keeps the stored
+        # status; a /status PUT keeps the stored spec (apiserver semantics
+        # the live client must navigate — ClusterClient.update does both)
+        stored = self.fake.get(kind, ns, name)
+        merged = dict(body)
+        if sub == "status":
+            merged = dict(stored)
+            merged["status"] = body.get("status", {})
+            # conflict check against the rv the client sent
+            merged["metadata"] = dict(stored["metadata"])
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+            if sent_rv is not None:
+                merged["metadata"]["resourceVersion"] = sent_rv
+        elif sub is None:
+            merged["status"] = stored.get("status", {})
+        else:
+            raise ApiError(404, f"unknown subresource {sub}")
+        return self.fake.update(kind, merged)
+
+    # ------------------------------------------------------------- stream
+    def stream(
+        self,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        cancel: Optional[list] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        if (query or {}).get("watch") != "true":
+            raise ApiError(400, "stream requires watch=true")
+        kind, _ns, _name, _sub = _parse_path(path)
+        try:
+            start = int((query or {}).get("resourceVersion", "0"))
+        except ValueError:
+            start = 0
+        # cancel hook registered EAGERLY (before the generator body runs):
+        # the consumer snapshots `cancel` before first next()
+        cancelled = threading.Event()
+        if cancel is not None:
+            def _cancel() -> None:
+                cancelled.set()
+                with self._lock:
+                    self._lock.notify_all()
+
+            cancel.append(_cancel)
+
+        def _events() -> Iterator[Dict[str, Any]]:
+            cursor = start
+            while True:
+                with self._lock:
+                    if self._closed or cancelled.is_set():
+                        return
+                    if cursor < self._min_rv:
+                        yield {
+                            "type": "ERROR",
+                            "object": _status_payload(
+                                410, "too old resource version"
+                            ),
+                        }
+                        return
+                    pending = [
+                        (seq, etype, obj)
+                        for seq, etype, obj in self._logs.get(kind, [])
+                        if seq > cursor
+                    ]
+                    if not pending:
+                        self._lock.wait(timeout=0.5)
+                        continue
+                for seq, etype, obj in pending:
+                    yield {"type": etype, "object": obj}
+                    cursor = max(cursor, seq)
+
+        return _events()
+
+
+def _status_payload_success() -> Dict[str, Any]:
+    return {"kind": "Status", "apiVersion": "v1", "status": "Success"}

@@ -170,41 +170,44 @@ class JobEngine:
         return True
 
     # ----------------------------------------------------------- list/adopt
-    def get_pods_for_job(self, job: Job) -> List[Dict[str, Any]]:
-        """List by GenLabels selector, then adopt orphans / skip pods owned
-        by someone else (ControllerRefManager-style,
-        reference tfjob_controller.go:251-290)."""
-        selector = self.gen_labels(job.name)
-        pods = self.cluster.list_pods(namespace=job.namespace, selector=selector)
+    def _claim_controllees(
+        self, job: Job, kind: str, items: List[Dict[str, Any]]
+    ) -> List[Dict[str, Any]]:
+        """ControllerRefManager adopt/claim, shared by the pod and service
+        paths (reference tfjob_controller.go:251-331): orphans get the
+        controllerRef WRITTEN BACK (so the garbage collector reaps them with
+        the job); already-owned objects are claimed strictly by UID — a
+        recreated job (same name, new UID) must NOT adopt the old
+        incarnation's terminating objects (reference UID recheck,
+        tfjob_controller.go:277-287)."""
         claimed = []
-        for pod in pods:
-            ref = objects.get_controller_of(pod)
+        for item in items:
+            ref = objects.get_controller_of(item)
             if ref is None:
-                # adopt: set our controller ref
-                pod["metadata"].setdefault("ownerReferences", []).append(
+                item["metadata"].setdefault("ownerReferences", []).append(
                     objects.owner_reference(
                         {"apiVersion": job.api_version, "kind": job.kind,
                          "metadata": job.metadata}
                     )
                 )
-                pod = self.cluster.update_pod(pod)
-                claimed.append(pod)
+                claimed.append(self.cluster.update(kind, item))
             elif ref.get("uid") == job.uid:
-                # strict UID claim: a recreated job (same name, new UID) must
-                # NOT adopt the old incarnation's terminating pods
-                # (reference ControllerRefManager + UID recheck,
-                # tfjob_controller.go:277-287)
-                claimed.append(pod)
+                claimed.append(item)
         return claimed
 
+    def get_pods_for_job(self, job: Job) -> List[Dict[str, Any]]:
+        """List by GenLabels selector, then adopt/claim
+        (reference tfjob_controller.go:251-290)."""
+        selector = self.gen_labels(job.name)
+        pods = self.cluster.list_pods(namespace=job.namespace, selector=selector)
+        return self._claim_controllees(job, "Pod", pods)
+
     def get_services_for_job(self, job: Job) -> List[Dict[str, Any]]:
+        """Service twin of get_pods_for_job (reference
+        ServiceControllerRefManager, tfjob_controller.go:295-331)."""
         selector = self.gen_labels(job.name)
         svcs = self.cluster.list_services(namespace=job.namespace, selector=selector)
-        return [
-            s
-            for s in svcs
-            if (objects.get_controller_of(s) or {}).get("name", job.name) == job.name
-        ]
+        return self._claim_controllees(job, "Service", svcs)
 
     @staticmethod
     def filter_for_replica_type(
@@ -384,8 +387,13 @@ class JobEngine:
         `restarted_types` for the status rules."""
         typed = self.filter_for_replica_type(pods, rtype)
         num_replicas = spec.replicas or 0
-        # initializeReplicaStatuses (reference status.go:244-249)
-        status.replica_statuses[rtype] = common.ReplicaStatus()
+        # initializeReplicaStatuses (reference status.go:244-249) — the
+        # persisted ExitCode restart counter survives the per-sync reset so
+        # BackoffLimit can count delete-for-recreate restarts
+        prev = status.replica_statuses.get(rtype)
+        status.replica_statuses[rtype] = common.ReplicaStatus(
+            restarts=prev.restarts if prev else 0
+        )
         restarted_this_pass = False
 
         slices = self.get_slices(typed, num_replicas)
@@ -444,6 +452,7 @@ class JobEngine:
                     status, common.JOB_RESTARTING, REASON_RESTARTING, msg, now_iso
                 )
                 metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
+                status.replica_statuses[rtype].restarts += 1
                 restarted_this_pass = True
                 if restarted_types is not None:
                     restarted_types.add(rtype)
@@ -477,8 +486,11 @@ class JobEngine:
                         )
                     except Exception:
                         self.expectations.lower_expectations(key, 0, 1)
-            # counts no longer reflect reality; reset for this pass
-            status.replica_statuses[rtype] = common.ReplicaStatus()
+            # counts no longer reflect reality; reset for this pass (the
+            # restart counter is history, not a count of live pods — keep it)
+            status.replica_statuses[rtype] = common.ReplicaStatus(
+                restarts=status.replica_statuses[rtype].restarts
+            )
 
     def _create_new_pod(
         self,
@@ -677,13 +689,22 @@ class JobEngine:
         return self.clock() - epoch_from_iso(job.status.start_time) >= ads
 
     def _past_backoff_limit(self, job: Job, pods: List[Dict[str, Any]]) -> bool:
-        """kubeflow/common PastBackoffLimit: sum kubelet restart counts of
-        running pods for OnFailure/Always replica types."""
+        """kubeflow/common PastBackoffLimit, extended: kubelet restart counts
+        of running pods for OnFailure/Always types, PLUS the persisted
+        operator restart counter for ExitCode types.  The reference counts
+        only the former, so ExitCode delete-for-recreate restarts (fresh pod,
+        restartCount=0) loop forever — the default failure mode for TPUJob,
+        whose replicas default to ExitCode (api/tpujob.py)."""
         limit = job.run_policy.backoff_limit
         if limit is None:
             return False
         total = 0
         for rtype, spec in (job.replica_specs or {}).items():
+            if spec.restart_policy == common.RESTART_POLICY_EXIT_CODE:
+                rs = job.status.replica_statuses.get(rtype)
+                if rs is not None:
+                    total += rs.restarts
+                continue
             if spec.restart_policy not in (
                 common.RESTART_POLICY_ON_FAILURE,
                 common.RESTART_POLICY_ALWAYS,
