@@ -14,9 +14,11 @@
 //   then n_records * record_size bytes of payload.
 //
 // Sharding: records are assigned round-robin to (shard_id of n_shards),
-// the multi-host split (one shard per TPU VM host). Shuffle: per-epoch
-// mt19937 permutation seeded by seed+epoch, identical on every host so
-// shards stay disjoint.
+// the multi-host split (one shard per TPU VM host) — disjointness comes
+// from this assignment alone.  Shuffle: per-epoch mt19937 permutation of
+// the host's own shard, seeded by seed+epoch (std::shuffle's permutation
+// is implementation-defined, so the order differs from the numpy fallback
+// for the same seed; only within-shard order is affected).
 
 #include "tpuoperator.h"
 

@@ -135,11 +135,17 @@ int wq_get(void* h, double timeout_ms, char* buf, int buflen) {
   for (;;) {
     q->drain_due_locked(Clock::now());
     if (!q->queue.empty()) {
-      // copy out BEFORE mutating state: an oversized key returns -2 with the
-      // queue untouched, so the caller can raise instead of wedging the item
-      // half-processed
+      // copy out BEFORE taking ownership; an oversized key is popped AND
+      // DROPPED — left at the head it would be re-hit by every subsequent
+      // get, permanently wedging the worker pool on one bad key
       int n = copy_out(q->queue.front(), buf, buflen);
-      if (n < 0) return n;
+      if (n < 0) {
+        std::string bad = q->queue.front();
+        q->queue.pop_front();
+        q->dirty.erase(bad);
+        q->failures.erase(bad);
+        return n;
+      }
       std::string key = q->queue.front();
       q->queue.pop_front();
       q->dirty.erase(key);

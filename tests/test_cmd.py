@@ -212,3 +212,18 @@ def test_health_server_endpoints():
     assert "tpu_operator_jobs_created_total" in body
     assert get("/nope")[0] == 404
     srv.stop()
+
+
+def test_packaging_console_entrypoint():
+    """pyproject.toml ships the operator as an installable console script
+    (reference publishes kubeflow-tfjob, sdk/python/setup.py:15)."""
+    import tomllib
+
+    with open("pyproject.toml", "rb") as fh:
+        meta = tomllib.load(fh)
+    assert meta["project"]["name"] == "tf-operator-tpu"
+    assert meta["project"]["scripts"]["tpu-operator"] == "tf_operator_tpu.cmd.main:main"
+    # the referenced callable exists and is the real entrypoint
+    from tf_operator_tpu.cmd.main import main
+
+    assert callable(main)

@@ -215,6 +215,59 @@ def test_native_queue_oversized_key_raises():
 
 
 @needs_native
+def test_native_queue_oversized_key_dropped_not_wedged():
+    """The bad key must be popped and dropped — left at the head it would
+    re-raise on every subsequent get, permanently wedging the worker pool
+    (ADVICE r1)."""
+    q = native.NativeRateLimitingQueue()
+    q.add("x" * 5000)
+    q.add("good-key")
+    with pytest.raises(ValueError):
+        q.get(timeout=1)
+    assert q.get(timeout=1) == "good-key"
+    q.done("good-key")
+    assert len(q) == 0
+
+
+@needs_native
+def test_native_queue_close_with_blocked_getter_is_safe():
+    """A getter still blocked in the native call when the queue is finalized
+    must not touch freed memory: close() shuts down (waking it) and the last
+    in-flight call frees the handle (ADVICE r1, medium)."""
+    import threading
+
+    q = native.NativeRateLimitingQueue()
+    results = []
+
+    def getter():
+        results.append(q.get(timeout=30))
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.2)  # getter is blocked inside wq_get
+    q._hd.close()  # what __del__ does, while the call is in flight
+    t.join(timeout=5)
+    assert not t.is_alive(), "blocked getter must be woken by close()"
+    assert results == [None]
+    assert q._hd.h is None, "handle freed exactly once, by the last exiter"
+    # post-close calls are refused, not crashes
+    q.add("late")
+    assert q.get(timeout=0.01) is None
+    assert len(q) == 0
+
+
+@needs_native
+def test_native_expectations_close_refuses_late_calls():
+    e = native.NativeControllerExpectations()
+    e.expect_creations("k", 2)
+    assert not e.satisfied_expectations("k")
+    e._hd.close()
+    # closed: benign defaults, no UAF
+    e.creation_observed("k")
+    assert e.satisfied_expectations("k") is True
+
+
+@needs_native
 def test_native_queue_shutting_down_property():
     q = native.NativeRateLimitingQueue()
     assert not q.shutting_down

@@ -110,10 +110,14 @@ def _split_batch(
 class RecordLoader:
     """Iterate batches from record files.
 
-    Yields {field: np.ndarray [B, *shape]}. Drop-remainder; per-epoch
-    shuffle (seeded, identical across hosts so shards stay disjoint);
-    `shard_id`/`n_shards` give each TPU VM host a disjoint record subset
-    (wire from bootstrap.SliceInfo process_id/num_processes).
+    Yields {field: np.ndarray [B, *shape]}. Drop-remainder; seeded per-epoch
+    shuffle.  Shard DISJOINTNESS comes from the round-robin record->shard
+    assignment (record i belongs to shard i % n_shards), NOT from the
+    shuffle: the native path (std::shuffle, implementation-defined
+    permutation) and the numpy fallback produce different orders for the
+    same seed, and each host only ever permutes its own shard.
+    `shard_id`/`n_shards` give each TPU VM host its subset (wire from
+    bootstrap.SliceInfo process_id/num_processes).
     """
 
     def __init__(

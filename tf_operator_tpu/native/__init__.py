@@ -92,39 +92,120 @@ def native_available() -> bool:
     return get_lib() is not None
 
 
+class _NativeHandle:
+    """Lifetime guard for a C++ handle shared with worker threads.
+
+    The hazard (ADVICE r1, medium): __del__ freeing the handle while a
+    worker is still blocked inside a native call (OperatorManager.stop()
+    joins workers with a timeout, so stragglers outlive the Python object)
+    is a use-after-free of the C++ mutex/condvar.  Every native call runs
+    inside enter()/exit() which refcounts in-flight calls; close() first
+    shuts the native object down (waking blocked getters), then frees only
+    when no call is in flight — otherwise the LAST exiting call frees.  A
+    call arriving after close() is refused by enter() and the wrapper
+    returns its benign default instead of touching freed memory."""
+
+    def __init__(self, lib, handle, free_name: str, shutdown_name: Optional[str]):
+        self.lib = lib
+        self.h = handle
+        self._free = getattr(lib, free_name)
+        self._shutdown = getattr(lib, shutdown_name) if shutdown_name else None
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._closed = False
+
+    def enter(self) -> bool:
+        with self._lock:
+            if self._closed:
+                return False
+            self._inflight += 1
+            return True
+
+    def exit(self) -> None:
+        free_now = None
+        with self._lock:
+            self._inflight -= 1
+            if self._closed and self._inflight == 0 and self.h is not None:
+                free_now, self.h = self.h, None
+        if free_now is not None:
+            self._free(free_now)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            h = self.h
+        if h is not None and self._shutdown is not None:
+            self._shutdown(h)  # wakes any getter blocked in the native call
+        free_now = None
+        with self._lock:
+            if self._inflight == 0 and self.h is not None:
+                free_now, self.h = self.h, None
+        if free_now is not None:
+            self._free(free_now)
+        # else: a call is still in flight; its exit() frees the handle
+
+
 class NativeRateLimitingQueue:
     """Same contract as k8s.informer.RateLimitingQueue, backed by C++.
 
     Keys must be str (the operator only ever queues namespace/name keys)
-    and shorter than 4 KiB; oversized keys raise ValueError."""
+    and shorter than 4 KiB; oversized keys raise ValueError (the native
+    queue drops the bad key rather than leaving it at the head)."""
 
     def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
         self._lib = get_lib()
         if self._lib is None:
             raise RuntimeError(f"{_LIB_NAME} not built (run `make native`)")
-        self._h = self._lib.wq_new(base_delay * 1000.0, max_delay * 1000.0)
+        self._hd = _NativeHandle(
+            self._lib,
+            self._lib.wq_new(base_delay * 1000.0, max_delay * 1000.0),
+            "wq_free",
+            "wq_shutdown",
+        )
         self._shutting_down = False
 
     def __del__(self):
-        h, self._h = getattr(self, "_h", None), None
-        if h and getattr(self, "_lib", None) is not None:
-            self._lib.wq_free(h)
+        hd = getattr(self, "_hd", None)
+        if hd is not None:
+            hd.close()
 
     def add(self, item: str) -> None:
-        self._lib.wq_add(self._h, item.encode())
+        if not self._hd.enter():
+            return
+        try:
+            self._lib.wq_add(self._hd.h, item.encode())
+        finally:
+            self._hd.exit()
 
     def add_after(self, item: str, delay: float) -> None:
-        self._lib.wq_add_after(self._h, item.encode(), delay * 1000.0)
+        if not self._hd.enter():
+            return
+        try:
+            self._lib.wq_add_after(self._hd.h, item.encode(), delay * 1000.0)
+        finally:
+            self._hd.exit()
 
     def add_rate_limited(self, item: str) -> None:
-        self._lib.wq_add_rate_limited(self._h, item.encode())
+        if not self._hd.enter():
+            return
+        try:
+            self._lib.wq_add_rate_limited(self._hd.h, item.encode())
+        finally:
+            self._hd.exit()
 
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
-        timeout_ms = -1.0 if timeout is None else timeout * 1000.0
-        # each blocking getter needs its own buffer (get() may run on many
-        # worker threads concurrently)
-        buf = ctypes.create_string_buffer(_MAX_KEY)
-        n = self._lib.wq_get(self._h, timeout_ms, buf, _MAX_KEY)
+        if not self._hd.enter():
+            return None  # closed queue behaves like a shut-down one
+        try:
+            timeout_ms = -1.0 if timeout is None else timeout * 1000.0
+            # each blocking getter needs its own buffer (get() may run on
+            # many worker threads concurrently)
+            buf = ctypes.create_string_buffer(_MAX_KEY)
+            n = self._lib.wq_get(self._hd.h, timeout_ms, buf, _MAX_KEY)
+        finally:
+            self._hd.exit()
         if n == -2:
             raise ValueError(f"queued key exceeds {_MAX_KEY - 1} bytes")
         if n < 0:
@@ -132,22 +213,52 @@ class NativeRateLimitingQueue:
         return buf.raw[:n].decode()
 
     def done(self, item: str) -> None:
-        self._lib.wq_done(self._h, item.encode())
+        if not self._hd.enter():
+            return
+        try:
+            self._lib.wq_done(self._hd.h, item.encode())
+        finally:
+            self._hd.exit()
 
     def forget(self, item: str) -> None:
-        self._lib.wq_forget(self._h, item.encode())
+        if not self._hd.enter():
+            return
+        try:
+            self._lib.wq_forget(self._hd.h, item.encode())
+        finally:
+            self._hd.exit()
 
     def num_requeues(self, item: str) -> int:
-        return self._lib.wq_num_requeues(self._h, item.encode())
+        if not self._hd.enter():
+            return 0
+        try:
+            return self._lib.wq_num_requeues(self._hd.h, item.encode())
+        finally:
+            self._hd.exit()
 
     def __len__(self) -> int:
-        return self._lib.wq_len(self._h)
+        if not self._hd.enter():
+            return 0
+        try:
+            return self._lib.wq_len(self._hd.h)
+        finally:
+            self._hd.exit()
 
     def pending_delayed(self) -> int:
-        return self._lib.wq_pending_delayed(self._h)
+        if not self._hd.enter():
+            return 0
+        try:
+            return self._lib.wq_pending_delayed(self._hd.h)
+        finally:
+            self._hd.exit()
 
     def empty(self) -> bool:
-        return bool(self._lib.wq_empty(self._h))
+        if not self._hd.enter():
+            return True
+        try:
+            return bool(self._lib.wq_empty(self._hd.h))
+        finally:
+            self._hd.exit()
 
     @property
     def shutting_down(self) -> bool:
@@ -155,7 +266,12 @@ class NativeRateLimitingQueue:
 
     def shut_down(self) -> None:
         self._shutting_down = True
-        self._lib.wq_shutdown(self._h)
+        if not self._hd.enter():
+            return
+        try:
+            self._lib.wq_shutdown(self._hd.h)
+        finally:
+            self._hd.exit()
 
 
 class NativeControllerExpectations:
@@ -165,15 +281,25 @@ class NativeControllerExpectations:
         self._lib = get_lib()
         if self._lib is None:
             raise RuntimeError(f"{_LIB_NAME} not built (run `make native`)")
-        self._h = self._lib.exp_new(ttl_seconds * 1000.0)
+        self._hd = _NativeHandle(
+            self._lib, self._lib.exp_new(ttl_seconds * 1000.0), "exp_free", None
+        )
 
     def __del__(self):
-        h, self._h = getattr(self, "_h", None), None
-        if h and getattr(self, "_lib", None) is not None:
-            self._lib.exp_free(h)
+        hd = getattr(self, "_hd", None)
+        if hd is not None:
+            hd.close()
+
+    def _call(self, fn_name: str, key: str, *args):
+        if not self._hd.enter():
+            return None
+        try:
+            return getattr(self._lib, fn_name)(self._hd.h, key.encode(), *args)
+        finally:
+            self._hd.exit()
 
     def set_expectations(self, key: str, add: int, delete: int) -> None:
-        self._lib.exp_set(self._h, key.encode(), add, delete)
+        self._call("exp_set", key, add, delete)
 
     def expect_creations(self, key: str, adds: int) -> None:
         self.set_expectations(key, adds, 0)
@@ -182,22 +308,25 @@ class NativeControllerExpectations:
         self.set_expectations(key, 0, dels)
 
     def raise_expectations(self, key: str, add: int, delete: int) -> None:
-        self._lib.exp_raise(self._h, key.encode(), add, delete)
+        self._call("exp_raise", key, add, delete)
 
     def lower_expectations(self, key: str, add: int, delete: int) -> None:
-        self._lib.exp_lower(self._h, key.encode(), add, delete)
+        self._call("exp_lower", key, add, delete)
 
     def creation_observed(self, key: str) -> None:
-        self._lib.exp_lower(self._h, key.encode(), 1, 0)
+        self._call("exp_lower", key, 1, 0)
 
     def deletion_observed(self, key: str) -> None:
-        self._lib.exp_lower(self._h, key.encode(), 0, 1)
+        self._call("exp_lower", key, 0, 1)
 
     def satisfied_expectations(self, key: str) -> bool:
-        return bool(self._lib.exp_satisfied(self._h, key.encode()))
+        # closed (interpreter teardown): report satisfied so a late reconcile
+        # is not wedged behind expectations that can no longer resolve
+        result = self._call("exp_satisfied", key)
+        return True if result is None else bool(result)
 
     def delete_expectations(self, key: str) -> None:
-        self._lib.exp_delete(self._h, key.encode())
+        self._call("exp_delete", key)
 
 
 def make_queue(base_delay: float = 0.005, max_delay: float = 1000.0):

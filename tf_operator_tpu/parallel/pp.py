@@ -41,12 +41,13 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], params, x,
     Returns [n_micro, mb, ...] outputs (replicated via a masked psum)."""
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
-    for leaf in jax.tree_util.tree_leaves(params):
-        if leaf.shape[0] != 1:
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if leaf.ndim == 0 or leaf.shape[0] != 1:
+            stages = "a scalar (no stage dim)" if leaf.ndim == 0 else leaf.shape[0]
             raise ValueError(
-                f"gpipe: per-device params carry {leaf.shape[0]} stages; the "
-                f"stacked stage dim must equal the {axis_name!r} axis size "
-                f"({n_stages})"
+                f"gpipe: per-device param {jax.tree_util.keystr(path)} carries "
+                f"{stages}; the stacked stage dim must equal the "
+                f"{axis_name!r} axis size ({n_stages})"
             )
     my_params = jax.tree_util.tree_map(lambda p: p[0], params)
     n_micro = x.shape[0]
@@ -106,12 +107,14 @@ def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
         if b % n_micro:
             raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
         pp = mesh.shape[axis_name]
-        for leaf in jax.tree_util.tree_leaves(params):
-            if leaf.shape[0] != pp:
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+            if leaf.ndim == 0 or leaf.shape[0] != pp:
+                stages = "a scalar (no stage dim)" if leaf.ndim == 0 else leaf.shape[0]
                 raise ValueError(
-                    f"make_pipeline_fn: stacked params have {leaf.shape[0]} "
-                    f"stages but mesh axis {axis_name!r} has {pp} devices; "
-                    f"they must match (one stage per pipeline device)"
+                    f"make_pipeline_fn: stacked param "
+                    f"{jax.tree_util.keystr(path)} has {stages} stages but "
+                    f"mesh axis {axis_name!r} has {pp} devices; they must "
+                    f"match (one stage per pipeline device)"
                 )
         x = batch.reshape((n_micro, b // n_micro) + batch.shape[1:])
         inner = functools.partial(gpipe, stage_fn, axis_name=axis_name)
