@@ -1,0 +1,124 @@
+"""Model-level pipeline parallelism: transformer blocks through the gpipe
+schedule (models/pipeline.py) vs the unsharded sequential reference —
+logits, loss, and grads, on pp x tp x dp meshes (VERDICT r1 item 5)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import pipeline as pl
+from tf_operator_tpu.models.transformer import TransformerConfig, lm_loss
+from tf_operator_tpu.parallel.mesh import make_mesh
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_len=16, dtype=jnp.float32, causal=True, tie_embeddings=True,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _data(cfg, batch=8, seed=1):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (batch, cfg.max_len), 0, cfg.vocab_size
+    )
+
+
+@pytest.mark.parametrize(
+    "axes,n_stages,n_micro",
+    [
+        ({"pp": 2, "tp": 2, "dp": 2}, 2, 4),
+        ({"pp": 4, "dp": 2}, 4, 2),
+        ({"pp": 2, "fsdp": 2, "tp": 2}, 2, 2),
+    ],
+)
+def test_pipelined_logits_match_sequential(axes, n_stages, n_micro):
+    cfg = _cfg()
+    mesh = make_mesh(axes)
+    params = pl.init_params(jax.random.PRNGKey(0), cfg, n_stages)
+    params = jax.device_put(params, pl.param_shardings(params, mesh))
+    tokens = _data(cfg)
+    apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro)
+    got = jax.jit(apply_fn)(params, tokens)
+    want = pl.sequential_apply(cfg, params, tokens)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_pipelined_grads_match_sequential():
+    cfg = _cfg()
+    mesh = make_mesh({"pp": 2, "tp": 2, "dp": 2})
+    params = pl.init_params(jax.random.PRNGKey(2), cfg, n_stages=2)
+    sharded = jax.device_put(params, pl.param_shardings(params, mesh))
+    tokens = _data(cfg, seed=3)
+    apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro=4)
+
+    g_pp = jax.jit(jax.grad(
+        lambda p: pl.pipeline_lm_loss(apply_fn, p, tokens)
+    ))(sharded)
+    g_seq = jax.grad(
+        lambda p: lm_loss(pl.sequential_apply(cfg, p, tokens), tokens)
+    )(params)
+    flat_pp = jax.tree_util.tree_leaves_with_path(g_pp)
+    flat_seq = jax.tree_util.tree_leaves_with_path(g_seq)
+    assert [p for p, _ in flat_pp] == [p for p, _ in flat_seq]
+    for (path, got), (_, want) in zip(flat_pp, flat_seq):
+        np.testing.assert_allclose(
+            jax.device_get(got), jax.device_get(want), atol=2e-4, rtol=2e-3,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pipelined_train_step_descends():
+    """A few optimizer steps through the pipelined loss must reduce it —
+    end-to-end trainability, not just one-shot parity."""
+    import optax
+
+    cfg = _cfg(n_layers=2)
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    params = pl.init_params(jax.random.PRNGKey(4), cfg, n_stages=2)
+    params = jax.device_put(params, pl.param_shardings(params, mesh))
+    tokens = _data(cfg, seed=5)
+    apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro=2)
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: pl.pipeline_lm_loss(apply_fn, p, tokens)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_init_validates_divisibility():
+    with pytest.raises(ValueError, match="not divisible"):
+        pl.init_params(jax.random.PRNGKey(0), _cfg(n_layers=3), n_stages=2)
+
+
+def test_apply_validates_batch():
+    cfg = _cfg(n_layers=2)
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    params = pl.init_params(jax.random.PRNGKey(0), cfg, n_stages=2)
+    apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        apply_fn(params, _data(cfg, batch=8))
+
+
+def test_apply_validates_stage_count():
+    cfg = _cfg(n_layers=4)
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    params = pl.init_params(jax.random.PRNGKey(0), cfg, n_stages=4)
+    apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro=2)
+    with pytest.raises(ValueError, match="stage leaves carry"):
+        apply_fn(params, _data(cfg))

@@ -1,0 +1,221 @@
+"""Pipelined transformer LM — model-level pipeline parallelism.
+
+Threads the transformer-block stack (models/transformer.py semantics)
+through the SPMD GPipe schedule (parallel/pp.gpipe) with Megatron-style
+tensor parallelism *inside* each pipeline stage: blocks are pure functions
+over an explicit param pytree whose leaves carry a leading [n_stages,
+blocks_per_stage, ...] stacking, sharded P('pp', None, ...) with head/ffn
+dims over 'tp'.  Inside shard_map each device holds one stage slice and a
+1/tp slice of every block's heads and ffn; the two row-parallel matmuls
+per block finish with a single lax.psum over 'tp' — the hand-placed
+equivalent of what GSPMD inserts for the non-pipelined path
+(parallel/tp.py), necessary here because gpipe runs in manual
+(shard_map) mode where XLA cannot insert collectives for us.
+
+The embedding and LM head run *outside* the pipeline under plain GSPMD
+jit (they are not shape-preserving, so they cannot be pipeline stages).
+Batch is split over ('dp','fsdp') in both regions.
+
+No reference counterpart: the reference operator never touches tensors
+(SURVEY.md §2.10, PP row "NO"); this is the TPU-first capability the
+rebuild adds on top of the reference's topology bookkeeping.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.models.transformer import (
+    TransformerConfig,
+    dot_product_attention,
+    lm_loss,
+)
+from tf_operator_tpu.parallel.pp import gpipe
+
+
+# ---------------------------------------------------------------- params
+def init_params(rng: jax.Array, cfg: TransformerConfig, n_stages: int) -> Dict:
+    """Param pytree for the pipelined LM.  Stage leaves are stacked
+    [n_stages, blocks_per_stage, ...]; embed/head leaves are flat.  All
+    params f32 (cast to cfg.dtype at use)."""
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by n_stages {n_stages}"
+        )
+    if not cfg.tie_embeddings:
+        raise ValueError("pipelined LM supports tied embeddings only")
+    lps = cfg.n_layers // n_stages
+    e, h, d, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    k_embed, k_pos, k_qkv, k_out, k_wi, k_wo = jax.random.split(rng, 6)
+
+    def init(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+    return {
+        "embed": {
+            "embedding": jax.random.normal(k_embed, (cfg.vocab_size, e)) * 0.02,
+            "pos": jax.random.normal(k_pos, (cfg.max_len, e)) * 0.02,
+        },
+        "stages": {
+            "ln1": jnp.ones((n_stages, lps, e), jnp.float32),
+            "qkv": init(k_qkv, (n_stages, lps, e, 3, h, d), e),
+            "out": init(k_out, (n_stages, lps, h, d, e), h * d),
+            "ln2": jnp.ones((n_stages, lps, e), jnp.float32),
+            "wi": init(k_wi, (n_stages, lps, e, f), e),
+            "wo": init(k_wo, (n_stages, lps, f, e), f),
+        },
+        "ln_f": jnp.ones((e,), jnp.float32),
+    }
+
+
+def stage_param_specs() -> Dict:
+    """PartitionSpec pytree for params['stages']: stage dim over 'pp',
+    head/ffn dims over 'tp' (column-parallel qkv/wi, row-parallel out/wo)."""
+    return {
+        "ln1": P("pp", None, None),
+        "qkv": P("pp", None, None, None, "tp", None),
+        "out": P("pp", None, "tp", None, None),
+        "ln2": P("pp", None, None),
+        "wi": P("pp", None, None, "tp"),
+        "wo": P("pp", None, "tp", None),
+    }
+
+
+def param_shardings(params: Dict, mesh: Mesh) -> Dict:
+    """NamedSharding pytree for the whole param tree (GSPMD placement of
+    the jit inputs; the pipeline's shard_map re-interprets the stage leaves
+    with the same specs)."""
+    stage_specs = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        stage_param_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    rep = NamedSharding(mesh, P())
+    return {
+        "embed": jax.tree.map(lambda _: rep, params["embed"]),
+        "stages": stage_specs,
+        "ln_f": rep,
+    }
+
+
+# ---------------------------------------------------------------- compute
+def _layernorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+    return y.astype(x.dtype)
+
+
+def _block(p: Dict, x: jax.Array, *, causal: bool,
+           tp_axis: Optional[str]) -> jax.Array:
+    """One transformer block on (possibly tp-local) param shards.
+    x: [b, s, e] replicated over tp; qkv/out hold h/tp local heads and
+    wi/wo f/tp local ffn columns; each residual branch ends in a psum."""
+    dtype = x.dtype
+    h = _layernorm(x, p["ln1"])
+    qkv = jnp.einsum("bse,ethd->tbshd", h, p["qkv"].astype(dtype))
+    a = dot_product_attention(qkv[0], qkv[1], qkv[2], causal)
+    o = jnp.einsum("bshd,hde->bse", a, p["out"].astype(dtype))
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
+    h = _layernorm(x, p["ln2"])
+    h = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, p["wi"].astype(dtype)))
+    o = jnp.einsum("bsf,fe->bse", h, p["wo"].astype(dtype))
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    return x + o
+
+
+def _stage_fn(p: Dict, x: jax.Array, *, causal: bool,
+              tp_axis: Optional[str]) -> jax.Array:
+    """One pipeline stage = blocks_per_stage blocks applied in order.
+    Leaves of p are [blocks_per_stage, ...] (stage dim already stripped
+    by gpipe)."""
+    n_blocks = p["ln1"].shape[0]
+    for i in range(n_blocks):
+        x = _block(jax.tree.map(lambda a: a[i], p), x,
+                   causal=causal, tp_axis=tp_axis)
+    return x
+
+
+def _embed(p: Dict, tokens: jax.Array, dtype) -> jax.Array:
+    x = jnp.take(p["embedding"], tokens, axis=0)
+    return (x + p["pos"][None, : tokens.shape[1]]).astype(dtype)
+
+
+def _head(params: Dict, x: jax.Array) -> jax.Array:
+    x = _layernorm(x, params["ln_f"]).astype(jnp.float32)
+    return jnp.einsum("bse,ve->bsv", x, params["embed"]["embedding"])
+
+
+def make_pipelined_apply(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
+    """f(params, tokens) -> logits running the block stack through the
+    gpipe schedule over mesh axis 'pp', with tp collectives inside stages
+    and batch over ('dp','fsdp').  Differentiable end to end (gpipe's
+    scan+ppermute transposes to the reverse schedule)."""
+    from tf_operator_tpu.parallel.compat import shard_map
+
+    pp = mesh.shape.get("pp", 1)
+    tp = mesh.shape.get("tp", 1)
+    tp_axis = "tp" if tp > 1 else None
+    if cfg.n_heads % tp or cfg.d_ff % tp:
+        raise ValueError(
+            f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide tp {tp}"
+        )
+    batch_axes = ("dp", "fsdp")
+    dp_total = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    stage_fn = functools.partial(_stage_fn, causal=cfg.causal, tp_axis=tp_axis)
+    inner = functools.partial(gpipe, stage_fn, axis_name="pp")
+    x_spec = P(None, batch_axes, None, None)  # [n_micro, mb, s, e]
+
+    def apply(params: Dict, tokens: jax.Array) -> jax.Array:
+        b = tokens.shape[0]
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+        mb = b // n_micro
+        if mb % dp_total:
+            raise ValueError(
+                f"microbatch {mb} not divisible by dp*fsdp {dp_total}"
+            )
+        for leaf in jax.tree.leaves(params["stages"]):
+            if leaf.shape[0] != pp:
+                raise ValueError(
+                    f"stage leaves carry {leaf.shape[0]} stages but mesh "
+                    f"axis 'pp' has {pp} devices"
+                )
+        x = _embed(params["embed"], tokens, cfg.dtype)
+        x = x.reshape((n_micro, mb) + x.shape[1:])
+        x = shard_map(
+            inner, mesh=mesh,
+            in_specs=(stage_param_specs(), x_spec), out_specs=x_spec,
+            check_rep=False,
+        )(params["stages"], x)
+        x = x.reshape((b,) + x.shape[2:])
+        return _head(params, x)
+
+    return apply
+
+
+def sequential_apply(cfg: TransformerConfig, params: Dict,
+                     tokens: jax.Array) -> jax.Array:
+    """Unsharded reference: the same params applied block-by-block on one
+    device — the numeric witness for the pipelined path."""
+    x = _embed(params["embed"], tokens, cfg.dtype)
+    stages = params["stages"]
+    n_stages = stages["ln1"].shape[0]
+    for s in range(n_stages):
+        x = _stage_fn(jax.tree.map(lambda a: a[s], stages), x,
+                      causal=cfg.causal, tp_axis=None)
+    return _head(params, x)
+
+
+def pipeline_lm_loss(apply_fn, params, tokens) -> jax.Array:
+    return lm_loss(apply_fn(params, tokens), tokens)
