@@ -120,5 +120,12 @@ def test_apply_validates_stage_count():
     mesh = make_mesh({"pp": 2, "dp": 4})
     params = pl.init_params(jax.random.PRNGKey(0), cfg, n_stages=4)
     apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro=2)
-    with pytest.raises(ValueError, match="stage leaves carry"):
+    with pytest.raises(ValueError, match="must match"):
         apply_fn(params, _data(cfg))
+
+
+def test_init_rejects_unsupported_config():
+    with pytest.raises(ValueError, match="does not support"):
+        pl.init_params(jax.random.PRNGKey(0), _cfg(n_experts=4), n_stages=2)
+    with pytest.raises(ValueError, match="does not support"):
+        pl.init_params(jax.random.PRNGKey(0), _cfg(remat=True), n_stages=2)

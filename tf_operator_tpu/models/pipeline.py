@@ -22,10 +22,9 @@ rebuild adds on top of the reference's topology bookkeeping.
 """
 from __future__ import annotations
 
-import dataclasses
 import functools
 import math
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +35,7 @@ from tf_operator_tpu.models.transformer import (
     dot_product_attention,
     lm_loss,
 )
-from tf_operator_tpu.parallel.pp import gpipe
+from tf_operator_tpu.parallel.pp import make_pipeline_fn
 
 
 # ---------------------------------------------------------------- params
@@ -48,8 +47,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig, n_stages: int) -> Dict:
         raise ValueError(
             f"n_layers {cfg.n_layers} not divisible by n_stages {n_stages}"
         )
-    if not cfg.tie_embeddings:
-        raise ValueError("pipelined LM supports tied embeddings only")
+    _check_supported(cfg)
     lps = cfg.n_layers // n_stages
     e, h, d, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
     k_embed, k_pos, k_qkv, k_out, k_wi, k_wo = jax.random.split(rng, 6)
@@ -72,6 +70,28 @@ def init_params(rng: jax.Array, cfg: TransformerConfig, n_stages: int) -> Dict:
         },
         "ln_f": jnp.ones((e,), jnp.float32),
     }
+
+
+def _check_supported(cfg: TransformerConfig) -> None:
+    """Reject config fields the pipelined model would silently drop —
+    building a dense einsum-attention model regardless would let the
+    numeric witness pass while training a different model than asked."""
+    if not cfg.tie_embeddings:
+        raise ValueError("pipelined LM supports tied embeddings only")
+    unsupported = {
+        "n_experts": cfg.n_experts,
+        "attention_fn": cfg.attention_fn,
+        "moe_dispatch_fn": cfg.moe_dispatch_fn,
+        "remat": cfg.remat,
+    }
+    set_fields = [k for k, v in unsupported.items() if v]
+    if set_fields:
+        raise ValueError(
+            f"pipelined LM does not support config fields {set_fields}; "
+            f"use the non-pipelined Transformer (models/transformer.py) "
+            f"for MoE/custom-attention/remat, or combine pp with ep/sp at "
+            f"the mesh level in a future revision"
+        )
 
 
 def stage_param_specs() -> Dict:
@@ -161,44 +181,22 @@ def make_pipelined_apply(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
     gpipe schedule over mesh axis 'pp', with tp collectives inside stages
     and batch over ('dp','fsdp').  Differentiable end to end (gpipe's
     scan+ppermute transposes to the reverse schedule)."""
-    from tf_operator_tpu.parallel.compat import shard_map
-
-    pp = mesh.shape.get("pp", 1)
+    _check_supported(cfg)
     tp = mesh.shape.get("tp", 1)
     tp_axis = "tp" if tp > 1 else None
     if cfg.n_heads % tp or cfg.d_ff % tp:
         raise ValueError(
-            f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide tp {tp}"
+            f"tp {tp} must divide n_heads {cfg.n_heads} and d_ff {cfg.d_ff}"
         )
-    batch_axes = ("dp", "fsdp")
-    dp_total = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
     stage_fn = functools.partial(_stage_fn, causal=cfg.causal, tp_axis=tp_axis)
-    inner = functools.partial(gpipe, stage_fn, axis_name="pp")
-    x_spec = P(None, batch_axes, None, None)  # [n_micro, mb, s, e]
+    run = make_pipeline_fn(
+        mesh, stage_fn, n_micro, axis_name="pp",
+        param_specs=stage_param_specs(), batch_axes=("dp", "fsdp"),
+    )
 
     def apply(params: Dict, tokens: jax.Array) -> jax.Array:
-        b = tokens.shape[0]
-        if b % n_micro:
-            raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
-        mb = b // n_micro
-        if mb % dp_total:
-            raise ValueError(
-                f"microbatch {mb} not divisible by dp*fsdp {dp_total}"
-            )
-        for leaf in jax.tree.leaves(params["stages"]):
-            if leaf.shape[0] != pp:
-                raise ValueError(
-                    f"stage leaves carry {leaf.shape[0]} stages but mesh "
-                    f"axis 'pp' has {pp} devices"
-                )
         x = _embed(params["embed"], tokens, cfg.dtype)
-        x = x.reshape((n_micro, mb) + x.shape[1:])
-        x = shard_map(
-            inner, mesh=mesh,
-            in_specs=(stage_param_specs(), x_spec), out_specs=x_spec,
-            check_rep=False,
-        )(params["stages"], x)
-        x = x.reshape((b,) + x.shape[2:])
+        x = run(params["stages"], x)
         return _head(params, x)
 
     return apply

@@ -17,6 +17,7 @@ backward pipeline.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Callable
 
 import jax
@@ -96,16 +97,42 @@ def _stage_out_struct(stage_fn, params, x):
 
 
 def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
-                     axis_name: str = "pp"):
+                     axis_name: str = "pp", param_specs=None,
+                     batch_axes=None):
     """jit-able f(stacked_params, batch) running the pipeline over `mesh`.
     `stacked_params` leaves are [n_stages, ...]; batch [B, ...] is split
-    into n_micro microbatches."""
+    into n_micro microbatches.
+
+    param_specs: optional PartitionSpec pytree for the stacked params
+    (prefix-pytrees allowed, as shard_map accepts) when stage params are
+    sharded beyond the leading `axis_name` dim — e.g. tensor-parallel
+    head/ffn dims whose collectives stage_fn places itself.  Default:
+    everything sharded only over `axis_name`.
+    batch_axes: optional mesh axis (or tuple) to shard the microbatch dim
+    over (data parallelism inside the pipeline).  Default: replicated."""
     from tf_operator_tpu.parallel.compat import shard_map
+
+    if param_specs is None:
+        param_specs = P(axis_name)
+    x_spec = P(None, batch_axes) if batch_axes is not None else P()
+    dp_total = (
+        math.prod(
+            mesh.shape[a]
+            for a in (batch_axes if isinstance(batch_axes, tuple) else (batch_axes,))
+        )
+        if batch_axes is not None
+        else 1
+    )
 
     def run(params, batch):
         b = batch.shape[0]
         if b % n_micro:
             raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+        if (b // n_micro) % dp_total:
+            raise ValueError(
+                f"microbatch {b // n_micro} not divisible by the batch mesh "
+                f"axes {batch_axes} (total {dp_total})"
+            )
         pp = mesh.shape[axis_name]
         for path, leaf in jax.tree_util.tree_leaves_with_path(params):
             if leaf.ndim == 0 or leaf.shape[0] != pp:
@@ -120,7 +147,7 @@ def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
         inner = functools.partial(gpipe, stage_fn, axis_name=axis_name)
         out = shard_map(
             inner, mesh=mesh,
-            in_specs=(P(axis_name), P()), out_specs=P(),
+            in_specs=(param_specs, x_spec), out_specs=x_spec,
             check_rep=False,
         )(params, x)
         return out.reshape((b,) + out.shape[2:])
