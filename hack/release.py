@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Release / deploy CLI — reference py/kubeflow/tf_operator/{release,deploy}.py.
+
+  python hack/release.py release --registry gcr.io/me [--push] [--run]
+  python hack/release.py render  --overlay standalone [--image reg/op:tag]
+  python hack/release.py cluster --project p --zone z --name c \
+      --tpu-pool v5e-16=4x4 [--run]
+  python hack/release.py teardown --project p --zone z --name c [--run]
+
+Everything is a dry-run printing the command plan unless --run is given.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tf_operator_tpu.deploy import cluster as cl  # noqa: E402
+from tf_operator_tpu.deploy import release as rel  # noqa: E402
+from tf_operator_tpu.deploy.render import render_overlay, to_yaml_stream  # noqa: E402
+from tf_operator_tpu.deploy.runner import CommandRunner  # noqa: E402
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("release")
+    pr.add_argument("--registry", required=True)
+    pr.add_argument("--version", default="0.1.0")
+    pr.add_argument("--push", action="store_true")
+    pr.add_argument("--run", action="store_true")
+
+    pv = sub.add_parser("render")
+    pv.add_argument("--overlay", default="standalone",
+                    choices=("standalone", "kubeflow"))
+    pv.add_argument("--image", default=None)
+
+    pc = sub.add_parser("cluster")
+    pc.add_argument("--project", required=True)
+    pc.add_argument("--zone", required=True)
+    pc.add_argument("--name", required=True)
+    pc.add_argument("--tpu-pool", action="append", default=[],
+                    help="acceleratorType[=topology], e.g. v5e-16=4x4")
+    pc.add_argument("--run", action="store_true")
+
+    pt = sub.add_parser("teardown")
+    pt.add_argument("--project", required=True)
+    pt.add_argument("--zone", required=True)
+    pt.add_argument("--name", required=True)
+    pt.add_argument("--run", action="store_true")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "render":
+        print(to_yaml_stream(render_overlay(REPO_ROOT, args.overlay,
+                                            image=args.image)))
+        return 0
+
+    runner = CommandRunner(dry_run=not getattr(args, "run", False), echo=True)
+    if args.cmd == "release":
+        cfg = rel.ReleaseConfig(repo_root=REPO_ROOT, registry=args.registry,
+                                version=args.version)
+        artifacts = rel.release(runner, cfg, push=args.push)
+        print(json.dumps(artifacts, indent=2))
+    elif args.cmd in ("cluster", "teardown"):
+        pools = {}
+        for spec in getattr(args, "tpu_pool", []) or []:
+            acc, _, topo = spec.partition("=")
+            pools[acc] = topo
+        ccfg = cl.ClusterConfig(project=args.project, zone=args.zone,
+                                name=args.name, tpu_pools=pools)
+        if args.cmd == "cluster":
+            cl.setup_cluster(runner, ccfg)
+        else:
+            cl.teardown_cluster(runner, ccfg)
+    if runner.dry_run:
+        print("# dry run — re-run with --run to execute", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

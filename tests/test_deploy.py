@@ -1,0 +1,232 @@
+"""Deploy/release tooling: kustomize renderer correctness, release command
+plans, cluster plans, operator apply via the ClusterClient surface
+(VERDICT r1 missing item 4; reference deploy.py/release.py)."""
+import io
+import json
+import os
+import tarfile
+
+import pytest
+import yaml
+
+from tf_operator_tpu.deploy import cluster as cl
+from tf_operator_tpu.deploy import release as rel
+from tf_operator_tpu.deploy.render import (
+    render_kustomization,
+    render_overlay,
+    to_yaml_stream,
+)
+from tf_operator_tpu.deploy.runner import CommandRunner
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ---------------------------------------------------------------- renderer
+def test_render_base_contains_all_resources():
+    docs = render_kustomization(os.path.join(REPO, "manifests", "base"))
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds.count("CustomResourceDefinition") == 5
+    for kind in ("Deployment", "Service", "ServiceAccount", "ClusterRole",
+                 "ClusterRoleBinding"):
+        assert kind in kinds, kinds
+
+
+def test_render_standalone_overlay_namespaces():
+    docs = render_overlay(REPO, "standalone")
+    ns_doc = [d for d in docs if d["kind"] == "Namespace"]
+    assert ns_doc and ns_doc[0]["metadata"]["name"] == "tpu-operator-system"
+    dep = [d for d in docs if d["kind"] == "Deployment"][0]
+    assert dep["metadata"]["namespace"] == "tpu-operator-system"
+    # cluster-scoped objects must NOT get a namespace
+    for d in docs:
+        if d["kind"] in ("CustomResourceDefinition", "ClusterRole",
+                         "Namespace", "ClusterRoleBinding"):
+            assert "namespace" not in d.get("metadata", {}), d["kind"]
+
+
+def test_render_kubeflow_overlay_common_labels():
+    docs = render_overlay(REPO, "kubeflow")
+    dep = [d for d in docs if d["kind"] == "Deployment"][0]
+    labels = dep["metadata"]["labels"]
+    assert labels["app.kubernetes.io/name"] == "tpu-training-operator"
+    # kustomize semantics: selectors and pod template get the labels too
+    assert dep["spec"]["selector"]["matchLabels"][
+        "app.kubernetes.io/name"] == "tpu-training-operator"
+    assert dep["spec"]["template"]["metadata"]["labels"][
+        "app.kubernetes.io/name"] == "tpu-training-operator"
+
+
+def test_render_rewrites_binding_subject_namespace():
+    """kustomize semantics: the ClusterRoleBinding's ServiceAccount subject
+    must follow the overlay namespace, else the operator's SA has no RBAC."""
+    for overlay, ns in (("standalone", "tpu-operator-system"),
+                        ("kubeflow", "kubeflow")):
+        docs = render_overlay(REPO, overlay)
+        crb = [d for d in docs if d["kind"] == "ClusterRoleBinding"][0]
+        subj = [s for s in crb["subjects"] if s["kind"] == "ServiceAccount"][0]
+        assert subj["namespace"] == ns, overlay
+        sa = [d for d in docs if d["kind"] == "ServiceAccount"][0]
+        assert sa["metadata"]["namespace"] == ns, overlay
+
+
+def test_cluster_client_paths_for_deploy_kinds():
+    """Every kind the overlays render must be routable by the real
+    ClusterClient, with cluster-scoped kinds not namespaced."""
+    from tf_operator_tpu.k8s.client import resource_path
+
+    docs = render_overlay(REPO, "standalone")
+    for d in docs:
+        path = resource_path(d["kind"], "tpu-operator-system",
+                             d["metadata"]["name"])
+        if d["kind"] in ("Namespace", "CustomResourceDefinition",
+                         "ClusterRole", "ClusterRoleBinding"):
+            assert "/namespaces/tpu-operator-system/" not in path, (
+                d["kind"], path)
+        else:
+            assert "/namespaces/tpu-operator-system/" in path, (d["kind"], path)
+    assert resource_path("Deployment", "ns1", "op") == \
+        "/apis/apps/v1/namespaces/ns1/deployments/op"
+    assert resource_path("Namespace", "ignored", "x") == "/api/v1/namespaces/x"
+
+
+def test_render_image_override():
+    docs = render_overlay(REPO, "standalone", image="gcr.io/me/op:v1.2.3-gabc")
+    dep = [d for d in docs if d["kind"] == "Deployment"][0]
+    img = dep["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert img == "gcr.io/me/op:v1.2.3-gabc"
+
+
+def test_render_yaml_stream_round_trips():
+    docs = render_overlay(REPO, "standalone")
+    stream = to_yaml_stream(docs)
+    parsed = [d for d in yaml.safe_load_all(stream) if d]
+    assert len(parsed) == len(docs)
+
+
+def test_render_rejects_unsupported_keys(tmp_path):
+    (tmp_path / "kustomization.yaml").write_text(
+        "resources: []\npatchesStrategicMerge: [p.yaml]\n"
+    )
+    with pytest.raises(ValueError, match="unsupported kustomization keys"):
+        render_kustomization(str(tmp_path))
+
+
+# ---------------------------------------------------------------- release
+def test_release_dry_run_writes_nothing(tmp_path):
+    cfg = rel.ReleaseConfig(repo_root=REPO, registry="gcr.io/me",
+                            artifacts_dir=os.path.relpath(tmp_path, REPO))
+    artifacts = rel.release(CommandRunner(dry_run=True), cfg, push=True)
+    assert os.listdir(tmp_path) == []  # dry run must not touch dist/
+    assert "(not written: dry run)" in artifacts["build_info"]
+
+
+def test_release_dry_run_plan_and_artifacts(tmp_path):
+    cfg = rel.ReleaseConfig(repo_root=REPO, registry="gcr.io/me",
+                            version="0.2.0",
+                            artifacts_dir=os.path.relpath(tmp_path, REPO))
+    runner = CommandRunner(dry_run=True)
+    artifacts = rel.release(runner, cfg, push=True, write_artifacts=True)
+    plan = runner.plan()
+    assert any(c.startswith("git -C") for c in plan)
+    assert any("docker build" in c and "gcr.io/me/tpu-training-operator:v0.2.0-g"
+               in c for c in plan)
+    assert sum("docker push" in c for c in plan) == 2  # tag + latest
+    assert any("pip wheel" in c for c in plan)
+
+    info = json.load(open(artifacts["build_info"]))
+    assert info["version"] == "0.2.0"
+    assert info["image"].startswith("gcr.io/me/tpu-training-operator:v0.2.0-g")
+
+    with tarfile.open(artifacts["manifest_bundle"]) as tar:
+        names = tar.getnames()
+        assert "manifests/standalone.yaml" in names
+        assert "manifests/kubeflow.yaml" in names
+        data = tar.extractfile("manifests/standalone.yaml").read().decode()
+        assert info["image"] in data  # bundle pinned to the released image
+
+
+def test_image_tag_format():
+    assert rel.image_tag("1.0.0", "abc123") == "v1.0.0-gabc123"
+    assert rel.image_tag("v1.0.0", "abc123") == "v1.0.0-gabc123"
+
+
+# ---------------------------------------------------------------- cluster
+def test_setup_cluster_plan_tpu_pools():
+    runner = CommandRunner(dry_run=True)
+    cfg = cl.ClusterConfig(project="p", zone="us-central2-b", name="c",
+                           tpu_pools={"v4-32": "2x2x4", "v5e-16": ""})
+    cl.setup_cluster(runner, cfg)
+    plan = runner.plan()
+    assert any("clusters create c" in c for c in plan)
+    v4 = [c for c in plan if "tpu-v432" in c][0]
+    assert "--machine-type ct4p-hightpu-4t" in v4
+    assert "--tpu-topology 2x2x4" in v4
+    v5e = [c for c in plan if "tpu-v5e16" in c][0]
+    assert "--machine-type ct5lp-hightpu-4t" in v5e
+    assert "--tpu-topology" not in v5e
+    assert any("get-credentials" in c for c in plan)
+
+
+def test_setup_cluster_unknown_generation():
+    with pytest.raises(ValueError, match="unknown TPU generation"):
+        cl.tpu_nodepool_args("v99-8")
+
+
+def test_teardown_plan():
+    runner = CommandRunner(dry_run=True)
+    cl.teardown_cluster(runner, cl.ClusterConfig("p", "z", "c"))
+    assert any("clusters delete c" in c for c in runner.plan())
+
+
+# ---------------------------------------------------------------- operator
+def test_deploy_operator_into_fake_cluster_and_wait():
+    cluster = FakeCluster()
+    applied = cl.deploy_operator_client(cluster, REPO, "standalone")
+    assert any(a.startswith("Namespace/") and a.endswith("/tpu-operator-system")
+               for a in applied)
+    dep = cluster.get("Deployment", "tpu-operator-system",
+                      "tpu-training-operator")
+    assert dep["spec"]["replicas"] == 1
+
+    # idempotent re-apply (create -> update path)
+    applied2 = cl.deploy_operator_client(cluster, REPO, "standalone")
+    assert applied2 == applied
+
+    # not ready until status says so
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def sleep(s):
+        t[0] += s
+
+    assert not cl.wait_operator_ready(cluster, timeout_s=5.0, clock=clock,
+                                      sleep=sleep)
+    dep = cluster.get("Deployment", "tpu-operator-system",
+                      "tpu-training-operator")
+    dep["status"] = {"readyReplicas": 1}
+    cluster.update("Deployment", dep)
+    assert cl.wait_operator_ready(cluster, timeout_s=5.0, clock=clock,
+                                  sleep=sleep)
+
+
+def test_deploy_operator_kubectl_plan():
+    runner = CommandRunner(dry_run=True)
+    cl.deploy_operator_kubectl(runner, REPO, "standalone",
+                               image="gcr.io/me/op:v9")
+    assert runner.plan() == ["kubectl apply -f -"]
+
+
+def test_release_cli_render(capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "hack_release", os.path.join(REPO, "hack", "release.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["render", "--overlay", "standalone"]) == 0
+    out = capsys.readouterr().out
+    assert "kind: Deployment" in out and "tpu-operator-system" in out

@@ -1,0 +1,6 @@
+"""Deploy/release tooling — the equivalent of the reference's
+py/kubeflow/tf_operator/{deploy,release}.py (cluster setup, operator
+deploy, image build+push, release artifacts), rebuilt for GKE TPU
+slices.  All shell-outs go through runner.CommandRunner so every plan is
+dry-runnable and unit-testable without gcloud/docker/kubectl installed.
+"""

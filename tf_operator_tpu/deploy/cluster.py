@@ -1,0 +1,144 @@
+"""Cluster setup + operator deployment for GKE TPU slices.
+
+Reference parity: py/kubeflow/tf_operator/deploy.py (setup_cluster :103,
+teardown :260) — rebuilt for the TPU path: instead of GPU node pools, the
+plan creates TPU slice node pools (one per accelerator type), since a
+TPU multi-host slice maps to a dedicated GKE node pool whose nodes are
+the slice's TPU VM hosts.  Operator install goes through the in-repo
+kustomize renderer + the ClusterClient (k8s/client.py) when a kubeconfig
+is given, or a kubectl plan otherwise."""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.deploy.render import render_overlay, to_yaml_stream
+from tf_operator_tpu.deploy.runner import CommandRunner
+
+# acceleratorType prefix -> GKE machine type for the TPU VM hosts
+TPU_MACHINE_TYPES = {
+    "v4": "ct4p-hightpu-4t",
+    "v5e": "ct5lp-hightpu-4t",
+    "v5p": "ct5p-hightpu-4t",
+    "v6e": "ct6e-standard-4t",
+}
+
+
+def tpu_nodepool_args(accelerator_type: str, topology: str = "") -> List[str]:
+    """gcloud flags for one TPU slice node pool (e.g. v5p-128)."""
+    gen = accelerator_type.split("-")[0]
+    machine = TPU_MACHINE_TYPES.get(gen)
+    if machine is None:
+        raise ValueError(
+            f"unknown TPU generation {gen!r} in acceleratorType "
+            f"{accelerator_type!r} (known: {sorted(TPU_MACHINE_TYPES)})"
+        )
+    args = ["--machine-type", machine]
+    if topology:
+        args += ["--tpu-topology", topology]
+    # slices are all-or-nothing: no autoscaling mid-slice
+    args += ["--num-nodes", "1", "--placement-type", "COMPACT"]
+    return args
+
+
+@dataclass
+class ClusterConfig:
+    project: str
+    zone: str
+    name: str
+    # acceleratorType -> topology ('' = let GKE derive)
+    tpu_pools: Dict[str, str] = field(default_factory=dict)
+    release_channel: str = "regular"
+
+
+def setup_cluster(runner: CommandRunner, cfg: ClusterConfig) -> None:
+    """Create the GKE cluster + one TPU node pool per accelerator type
+    (reference setup_cluster creates a GPU cluster + installs drivers —
+    TPU pools need no driver daemonset)."""
+    runner.run([
+        "gcloud", "container", "clusters", "create", cfg.name,
+        "--project", cfg.project, "--zone", cfg.zone,
+        "--release-channel", cfg.release_channel,
+        "--num-nodes", "1",
+    ])
+    for acc, topo in cfg.tpu_pools.items():
+        runner.run([
+            "gcloud", "container", "node-pools", "create",
+            f"tpu-{acc.replace('-', '')}",
+            "--cluster", cfg.name,
+            "--project", cfg.project, "--zone", cfg.zone,
+            *tpu_nodepool_args(acc, topo),
+        ])
+    runner.run([
+        "gcloud", "container", "clusters", "get-credentials", cfg.name,
+        "--project", cfg.project, "--zone", cfg.zone,
+    ])
+
+
+def teardown_cluster(runner: CommandRunner, cfg: ClusterConfig) -> None:
+    runner.run([
+        "gcloud", "container", "clusters", "delete", cfg.name,
+        "--project", cfg.project, "--zone", cfg.zone, "--quiet",
+    ])
+
+
+# ---------------------------------------------------------------- operator
+def deploy_operator_kubectl(runner: CommandRunner, repo_root: str,
+                            overlay: str = "standalone",
+                            image: Optional[str] = None) -> None:
+    """Apply the rendered overlay through kubectl (no client needed)."""
+    stream = to_yaml_stream(render_overlay(repo_root, overlay, image=image))
+    runner.run(["kubectl", "apply", "-f", "-"], input_text=stream)
+
+
+def deploy_operator_client(cluster, repo_root: str,
+                           overlay: str = "standalone",
+                           image: Optional[str] = None) -> List[str]:
+    """Apply the rendered overlay through a ClusterClient/FakeCluster
+    (k8s/client.py surface): create-or-update by (kind, ns, name).
+    Returns the applied object keys."""
+    from tf_operator_tpu.k8s import objects
+    from tf_operator_tpu.k8s.fake import NotFoundError
+
+    applied = []
+    for doc in render_overlay(repo_root, overlay, image=image):
+        kind = doc.get("kind", "")
+        # cluster-scoped objects live under the store's default-namespace
+        # key (objects.namespace_of), so look them up the same way
+        ns, name = objects.namespace_of(doc), objects.name_of(doc)
+        try:
+            existing = cluster.get(kind, ns, name)
+        except NotFoundError:
+            existing = None
+        if existing is None:
+            cluster.create(kind, doc)
+        else:
+            doc.setdefault("metadata", {})["resourceVersion"] = (
+                existing.get("metadata", {}).get("resourceVersion")
+            )
+            cluster.update(kind, doc)
+        applied.append(f"{kind}/{ns or '-'}/{name}")
+    return applied
+
+
+def wait_operator_ready(cluster, namespace: str = "tpu-operator-system",
+                        name: str = "tpu-training-operator",
+                        timeout_s: float = 300.0,
+                        poll_s: float = 2.0,
+                        clock=time.monotonic,
+                        sleep=time.sleep) -> bool:
+    """Poll the operator Deployment until readyReplicas >= 1 (reference
+    deploy.py waits on the tf-job-operator deployment the same way)."""
+    from tf_operator_tpu.k8s.fake import NotFoundError
+
+    deadline = clock() + timeout_s
+    while clock() < deadline:
+        try:
+            dep = cluster.get("Deployment", namespace, name)
+        except NotFoundError:
+            dep = None
+        if dep and (dep.get("status", {}).get("readyReplicas") or 0) >= 1:
+            return True
+        sleep(poll_s)
+    return False

@@ -1,0 +1,145 @@
+"""Release pipeline — image build/push, SDK wheel, release artifacts.
+
+Reference parity: py/kubeflow/tf_operator/release.py (build_operator_image
+:122, _push_image :223, write_build_info :278, build_and_push_artifacts
+:239) rebuilt with a testable command plan and TPU-era defaults (one
+python operator image instead of a Go binary + ECR mirror fan-out)."""
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from tf_operator_tpu.deploy.render import render_overlay, to_yaml_stream
+from tf_operator_tpu.deploy.runner import CommandRunner
+
+DEFAULT_IMAGE_NAME = "tpu-training-operator"
+
+
+def git_sha(runner: CommandRunner, repo_root: str, short: bool = True) -> str:
+    argv = ["git", "-C", repo_root, "rev-parse"]
+    if short:
+        argv.append("--short=12")
+    argv.append("HEAD")
+    out = runner.run(argv).strip()
+    return out or "dryrunsha"
+
+
+def image_tag(version: str, sha: str) -> str:
+    """vX.Y.Z-gSHA — reference tags images v{date}-{sha} (release.py:152);
+    version+sha keeps tags unique AND sortable by release."""
+    return f"v{version.lstrip('v')}-g{sha}"
+
+
+@dataclass
+class ReleaseConfig:
+    repo_root: str
+    registry: str  # e.g. gcr.io/my-project
+    version: str = "0.1.0"
+    image_name: str = DEFAULT_IMAGE_NAME
+    dockerfile: str = "build/images/tpu-training-operator/Dockerfile"
+    artifacts_dir: str = "dist"
+
+    def image(self, sha: str) -> str:
+        return f"{self.registry}/{self.image_name}:{image_tag(self.version, sha)}"
+
+    def latest_image(self) -> str:
+        return f"{self.registry}/{self.image_name}:latest"
+
+
+def build_operator_image(runner: CommandRunner, cfg: ReleaseConfig,
+                         sha: str) -> str:
+    image = cfg.image(sha)
+    runner.run([
+        "docker", "build",
+        "-t", image, "-t", cfg.latest_image(),
+        "-f", os.path.join(cfg.repo_root, cfg.dockerfile),
+        cfg.repo_root,
+    ])
+    return image
+
+
+def push_image(runner: CommandRunner, cfg: ReleaseConfig, image: str) -> None:
+    runner.run(["docker", "push", image])
+    runner.run(["docker", "push", cfg.latest_image()])
+
+
+def build_sdk_wheel(runner: CommandRunner, cfg: ReleaseConfig) -> str:
+    """Build the installable package (pyproject.toml; reference publishes
+    kubeflow-tfjob via sdk/python/setup.py:15)."""
+    out_dir = os.path.join(cfg.repo_root, cfg.artifacts_dir)
+    runner.run([
+        "python", "-m", "pip", "wheel", "--no-deps",
+        "-w", out_dir, cfg.repo_root,
+    ])
+    return out_dir
+
+
+def write_build_info(cfg: ReleaseConfig, image: str, sha: str,
+                     now: Optional[float] = None) -> str:
+    """build_info.yaml equivalent (reference release.py:278-297): what was
+    built, from which commit, when — consumed by CI to promote releases."""
+    info = {
+        "image": image,
+        "commit": sha,
+        "version": cfg.version,
+        "timestamp": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now if now is not None else time.time())
+        ),
+    }
+    out_dir = os.path.join(cfg.repo_root, cfg.artifacts_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "build_info.json")
+    with open(path, "w") as f:
+        json.dump(info, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def write_manifest_bundle(cfg: ReleaseConfig, image: str) -> str:
+    """Render both overlays against the released image and tar them up —
+    the install artifact a release ships alongside the image."""
+    out_dir = os.path.join(cfg.repo_root, cfg.artifacts_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    bundle = os.path.join(out_dir, "manifests.tar.gz")
+    with tarfile.open(bundle, "w:gz") as tar:
+        for overlay in ("standalone", "kubeflow"):
+            docs = render_overlay(cfg.repo_root, overlay, image=image)
+            payload = to_yaml_stream(docs).encode()
+            ti = tarfile.TarInfo(name=f"manifests/{overlay}.yaml")
+            ti.size = len(payload)
+            ti.mtime = 0
+            tar.addfile(ti, io.BytesIO(payload))
+    return bundle
+
+
+def release(runner: CommandRunner, cfg: ReleaseConfig, push: bool = False,
+            write_artifacts: Optional[bool] = None) -> Dict[str, str]:
+    """Full pipeline: image -> (push) -> wheel -> build info -> manifest
+    bundle.  Returns the artifact map.
+
+    write_artifacts defaults to `not runner.dry_run`: a dry run only
+    prints the command plan and must not touch dist/ (it could clobber a
+    previous real release's artifacts with a dryrunsha build info)."""
+    if write_artifacts is None:
+        write_artifacts = not runner.dry_run
+    sha = git_sha(runner, cfg.repo_root)
+    image = build_operator_image(runner, cfg, sha)
+    if push:
+        push_image(runner, cfg, image)
+    artifacts = {
+        "image": image,
+        "sdk_wheel_dir": build_sdk_wheel(runner, cfg),
+    }
+    out_dir = os.path.join(cfg.repo_root, cfg.artifacts_dir)
+    if write_artifacts:
+        artifacts["build_info"] = write_build_info(cfg, image, sha)
+        artifacts["manifest_bundle"] = write_manifest_bundle(cfg, image)
+    else:
+        artifacts["build_info"] = os.path.join(out_dir, "build_info.json") + " (not written: dry run)"
+        artifacts["manifest_bundle"] = os.path.join(out_dir, "manifests.tar.gz") + " (not written: dry run)"
+    return artifacts

@@ -1,0 +1,136 @@
+"""Minimal kustomize renderer — resolves this repo's manifest overlays to
+one YAML stream without the kustomize binary.
+
+Supports the subset our manifests use (and validates it's only that
+subset): `resources` (files or directories containing kustomization.yaml),
+`namespace`, `commonLabels`, and `images` name/newName/newTag overrides.
+The reference relies on `kubectl kustomize` (README.md:24); shipping the
+renderer keeps deploy tooling and tests hermetic."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import yaml
+
+SUPPORTED_KEYS = {
+    "apiVersion", "kind", "resources", "namespace", "commonLabels", "images",
+}
+
+# cluster-scoped kinds never get a namespace stamped on them
+CLUSTER_SCOPED = {
+    "Namespace", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleBinding", "PriorityClass", "StorageClass",
+}
+
+
+def _load_yaml_docs(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def render_kustomization(path: str) -> List[Dict[str, Any]]:
+    """Render the kustomization at `path` (a directory) to manifest dicts."""
+    kfile = os.path.join(path, "kustomization.yaml")
+    with open(kfile) as f:
+        kust = yaml.safe_load(f) or {}
+    unknown = set(kust) - SUPPORTED_KEYS
+    if unknown:
+        raise ValueError(
+            f"{kfile}: unsupported kustomization keys {sorted(unknown)} "
+            f"(renderer supports {sorted(SUPPORTED_KEYS)})"
+        )
+    docs: List[Dict[str, Any]] = []
+    for res in kust.get("resources", []) or []:
+        rpath = os.path.normpath(os.path.join(path, res))
+        if os.path.isdir(rpath):
+            docs.extend(render_kustomization(rpath))
+        else:
+            docs.extend(_load_yaml_docs(rpath))
+    ns = kust.get("namespace")
+    if ns:
+        for d in docs:
+            if d.get("kind") not in CLUSTER_SCOPED:
+                d.setdefault("metadata", {})["namespace"] = ns
+            # kustomize also rewrites ServiceAccount subjects in role
+            # bindings — without this the deployed operator's SA lives in
+            # the overlay namespace while the binding points elsewhere,
+            # and every operator API call 403s
+            if d.get("kind") in ("RoleBinding", "ClusterRoleBinding"):
+                for subj in d.get("subjects", []) or []:
+                    if subj.get("kind") == "ServiceAccount":
+                        subj["namespace"] = ns
+    labels = kust.get("commonLabels") or {}
+    if labels:
+        for d in docs:
+            md = d.setdefault("metadata", {})
+            md["labels"] = {**(md.get("labels") or {}), **labels}
+            _label_selectors_and_templates(d, labels)
+    for img in kust.get("images", []) or []:
+        _override_image(docs, img)
+    return docs
+
+
+def _label_selectors_and_templates(doc: Dict[str, Any], labels: Dict[str, str]):
+    """kustomize semantics: commonLabels also land on pod templates and
+    selectors of workload kinds."""
+    spec = doc.get("spec")
+    if not isinstance(spec, dict):
+        return
+    sel = spec.get("selector")
+    if isinstance(sel, dict) and ("matchLabels" in sel or doc.get("kind") in
+                                  ("Deployment", "StatefulSet", "DaemonSet")):
+        sel["matchLabels"] = {**(sel.get("matchLabels") or {}), **labels}
+    elif isinstance(sel, dict) and doc.get("kind") == "Service":
+        spec["selector"] = {**sel, **labels}
+    tpl = spec.get("template")
+    if isinstance(tpl, dict):
+        md = tpl.setdefault("metadata", {})
+        md["labels"] = {**(md.get("labels") or {}), **labels}
+
+
+def _override_image(docs: List[Dict[str, Any]], img: Dict[str, str]) -> None:
+    name = img.get("name", "")
+    new_name = img.get("newName", name)
+    new_tag = img.get("newTag")
+
+    def visit(obj: Any) -> None:
+        if isinstance(obj, dict):
+            image = obj.get("image")
+            if isinstance(image, str) and image.split(":")[0] == name:
+                tag = new_tag or (image.split(":", 1) + ["latest"])[1]
+                obj["image"] = f"{new_name}:{tag}"
+            for v in obj.values():
+                visit(v)
+        elif isinstance(obj, list):
+            for v in obj:
+                visit(v)
+
+    visit(docs)
+
+
+def to_yaml_stream(docs: Iterable[Dict[str, Any]]) -> str:
+    return "---\n".join(
+        yaml.safe_dump(d, sort_keys=False, default_flow_style=False)
+        for d in docs
+    )
+
+
+def render_overlay(
+    repo_root: str,
+    overlay: str = "standalone",
+    image: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Render manifests/overlays/{overlay}; optionally retarget the operator
+    image (`registry/name:tag`)."""
+    docs = render_kustomization(
+        os.path.join(repo_root, "manifests", "overlays", overlay)
+    )
+    if image:
+        new_name, _, new_tag = image.partition(":")
+        _override_image(docs, {
+            "name": "kubeflow/tpu-training-operator",
+            "newName": new_name,
+            "newTag": new_tag or "latest",
+        })
+    return docs

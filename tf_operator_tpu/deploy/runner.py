@@ -1,0 +1,48 @@
+"""Injectable command execution for deploy/release tooling.
+
+Every external command (gcloud, docker, kubectl, git) flows through
+CommandRunner, so tests and --dry-run see the exact plan that real runs
+execute (reference deploy.py/release.py shell out ad hoc via util.run,
+which makes their plans untestable without a cluster)."""
+from __future__ import annotations
+
+import subprocess
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+
+class CommandError(RuntimeError):
+    def __init__(self, argv: Sequence[str], rc: int, output: str):
+        super().__init__(f"command {list(argv)} failed with rc={rc}: {output[-500:]}")
+        self.argv = list(argv)
+        self.rc = rc
+        self.output = output
+
+
+@dataclass
+class CommandRunner:
+    """dry_run=True records commands and returns canned output; real mode
+    shells out and raises CommandError on failure."""
+
+    dry_run: bool = True
+    log: List[List[str]] = field(default_factory=list)
+    echo: bool = False
+
+    def run(self, argv: Sequence[str], *, input_text: Optional[str] = None,
+            timeout: Optional[float] = None) -> str:
+        self.log.append(list(argv))
+        if self.echo:
+            print("+ " + " ".join(argv))
+        if self.dry_run:
+            return ""
+        r = subprocess.run(
+            list(argv), input=input_text, capture_output=True, text=True,
+            timeout=timeout,
+        )
+        out = (r.stdout or "") + (r.stderr or "")
+        if r.returncode != 0:
+            raise CommandError(argv, r.returncode, out)
+        return r.stdout or ""
+
+    def plan(self) -> List[str]:
+        return [" ".join(argv) for argv in self.log]

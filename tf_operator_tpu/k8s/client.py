@@ -48,6 +48,7 @@ class KindInfo:
     version: str
     plural: str
     has_status: bool = False  # status subresource enabled
+    cluster_scoped: bool = False  # no /namespaces/{ns}/ path segment
 
     @property
     def api_prefix(self) -> str:
@@ -67,6 +68,21 @@ KIND_REGISTRY: Dict[str, KindInfo] = {
     "Event": KindInfo("", "v1", "events"),
     "PodGroup": KindInfo("scheduling.volcano.sh", "v1beta1", "podgroups"),
     "Lease": KindInfo("coordination.k8s.io", "v1", "leases"),
+    # kinds the deploy tooling applies (tf_operator_tpu/deploy/cluster.py)
+    "Namespace": KindInfo("", "v1", "namespaces", cluster_scoped=True),
+    "ServiceAccount": KindInfo("", "v1", "serviceaccounts"),
+    "Deployment": KindInfo("apps", "v1", "deployments", has_status=True),
+    "CustomResourceDefinition": KindInfo(
+        "apiextensions.k8s.io", "v1", "customresourcedefinitions",
+        cluster_scoped=True,
+    ),
+    "ClusterRole": KindInfo(
+        "rbac.authorization.k8s.io", "v1", "clusterroles", cluster_scoped=True
+    ),
+    "ClusterRoleBinding": KindInfo(
+        "rbac.authorization.k8s.io", "v1", "clusterrolebindings",
+        cluster_scoped=True,
+    ),
     **{
         kind: KindInfo(objects.GROUP_NAME, "v1", kind.lower() + "s", has_status=True)
         for kind in _JOB_KINDS
@@ -87,7 +103,7 @@ def resource_path(
 ) -> str:
     info = kind_info(kind)
     path = info.api_prefix
-    if namespace:
+    if namespace and not info.cluster_scoped:
         path += f"/namespaces/{namespace}"
     path += f"/{info.plural}"
     if name:

@@ -114,15 +114,22 @@ def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
 
     if param_specs is None:
         param_specs = P(axis_name)
-    x_spec = P(None, batch_axes) if batch_axes is not None else P()
-    dp_total = (
-        math.prod(
-            mesh.shape[a]
-            for a in (batch_axes if isinstance(batch_axes, tuple) else (batch_axes,))
-        )
-        if batch_axes is not None
-        else 1
-    )
+    if batch_axes is not None:
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        batch_axes = tuple(batch_axes)
+        missing = [a for a in batch_axes if a not in mesh.shape]
+        if missing:
+            raise ValueError(
+                f"batch_axes {missing} not in mesh axes "
+                f"{tuple(mesh.shape)} (batch_axes must name mesh axes to "
+                f"shard the microbatch dim over)"
+            )
+        x_spec = P(None, batch_axes)
+        dp_total = math.prod(mesh.shape[a] for a in batch_axes)
+    else:
+        x_spec = P()
+        dp_total = 1
 
     def run(params, batch):
         b = batch.shape[0]
