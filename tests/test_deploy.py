@@ -216,7 +216,20 @@ def test_deploy_operator_kubectl_plan():
     runner = CommandRunner(dry_run=True)
     cl.deploy_operator_kubectl(runner, REPO, "standalone",
                                image="gcr.io/me/op:v9")
-    assert runner.plan() == ["kubectl apply -f -"]
+    plan = runner.plan()
+    assert len(plan) == 1 and plan[0].startswith("kubectl apply -f -")
+    # the plan records the manifest stream actually being applied
+    assert "<<stdin (" in plan[0]
+    assert "gcr.io/me/op:v9" in runner.stdins[0]
+
+
+def test_image_ref_split_ports_and_digests():
+    from tf_operator_tpu.deploy.render import _split_image_ref
+
+    assert _split_image_ref("kubeflow/op:latest") == ("kubeflow/op", "latest")
+    assert _split_image_ref("localhost:5000/op") == ("localhost:5000/op", None)
+    assert _split_image_ref("localhost:5000/op:v1") == ("localhost:5000/op", "v1")
+    assert _split_image_ref("repo/op@sha256:abc") == ("repo/op", None)
 
 
 def test_release_cli_render(capsys):

@@ -72,3 +72,17 @@ def test_flash_inside_transformer():
     # tiny cfg runs bf16: the flash kernel scores in f32 while the einsum
     # path scores in bf16, so agreement is bounded by bf16 resolution.
     np.testing.assert_allclose(out_ref, out_flash, atol=1e-1, rtol=5e-2)
+
+
+def test_snap_block_keeps_kernel_engaged():
+    """Preferred blocks that don't divide S snap down to a 128-multiple
+    divisor instead of bailing to the einsum fallback."""
+    from tf_operator_tpu.ops.flash_attention import _snap_block
+
+    assert _snap_block(1024, 2048) == 1024
+    assert _snap_block(1024, 1536) == 768   # largest 128-mult divisor
+    assert _snap_block(512, 2560) == 512
+    assert _snap_block(1024, 2560) == 640
+    assert _snap_block(512, 64) == 64       # s <= blk: whole-dim block
+    assert _snap_block(512, 200) == 200     # ditto (full dim is Mosaic-legal)
+    assert _snap_block(512, 600) is None    # no aligned divisor -> fallback

@@ -89,6 +89,17 @@ def _label_selectors_and_templates(doc: Dict[str, Any], labels: Dict[str, str]):
         md["labels"] = {**(md.get("labels") or {}), **labels}
 
 
+def _split_image_ref(ref: str):
+    """'name[:tag][@digest]' -> (name, tag) — the ':' only splits a tag if
+    it follows the last '/', so registry ports (localhost:5000/op) and
+    digests (op@sha256:...) match by name like real kustomize."""
+    base = ref.split("@", 1)[0]
+    slash, colon = base.rfind("/"), base.rfind(":")
+    if colon > slash:
+        return base[:colon], base[colon + 1:]
+    return base, None
+
+
 def _override_image(docs: List[Dict[str, Any]], img: Dict[str, str]) -> None:
     name = img.get("name", "")
     new_name = img.get("newName", name)
@@ -97,8 +108,8 @@ def _override_image(docs: List[Dict[str, Any]], img: Dict[str, str]) -> None:
     def visit(obj: Any) -> None:
         if isinstance(obj, dict):
             image = obj.get("image")
-            if isinstance(image, str) and image.split(":")[0] == name:
-                tag = new_tag or (image.split(":", 1) + ["latest"])[1]
+            if isinstance(image, str) and _split_image_ref(image)[0] == name:
+                tag = new_tag or _split_image_ref(image)[1] or "latest"
                 obj["image"] = f"{new_name}:{tag}"
             for v in obj.values():
                 visit(v)

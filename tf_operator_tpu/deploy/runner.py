@@ -26,13 +26,22 @@ class CommandRunner:
 
     dry_run: bool = True
     log: List[List[str]] = field(default_factory=list)
+    stdins: List[Optional[str]] = field(default_factory=list)
     echo: bool = False
 
     def run(self, argv: Sequence[str], *, input_text: Optional[str] = None,
             timeout: Optional[float] = None) -> str:
         self.log.append(list(argv))
+        # keep the stdin payload so a dry-run plan shows WHAT would be
+        # applied (e.g. the manifest stream behind `kubectl apply -f -`),
+        # not just the command line
+        self.stdins.append(input_text)
         if self.echo:
             print("+ " + " ".join(argv))
+            if input_text:
+                head = input_text[:400]
+                print(f"  <<stdin ({len(input_text)} bytes)>> {head}"
+                      + ("..." if len(input_text) > 400 else ""))
         if self.dry_run:
             return ""
         r = subprocess.run(
@@ -45,4 +54,8 @@ class CommandRunner:
         return r.stdout or ""
 
     def plan(self) -> List[str]:
-        return [" ".join(argv) for argv in self.log]
+        return [
+            " ".join(argv)
+            + (f" <<stdin ({len(stdin)} bytes)>>" if stdin else "")
+            for argv, stdin in zip(self.log, self.stdins)
+        ]
