@@ -1,0 +1,136 @@
+"""tpu-jobs user CLI (sdk/cli.py): submit/get/list/wait/pods/logs/delete
+against a FakeCluster with a real engine reconcile in between."""
+import json
+
+import pytest
+import yaml
+
+from tf_operator_tpu.controllers.registry import make_engine
+from tf_operator_tpu.k8s.fake import FakeCluster, NotFoundError
+from tf_operator_tpu.sdk.cli import Cli, make_parser, resolve_kind, run
+
+TFJOB = {
+    "apiVersion": "kubeflow.org/v1",
+    "kind": "TFJob",
+    "metadata": {"name": "mnist", "namespace": "default"},
+    "spec": {
+        "tfReplicaSpecs": {
+            "Worker": {
+                "replicas": 2,
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {"name": "tensorflow", "image": "train:v1"}
+                        ]
+                    }
+                },
+            }
+        }
+    },
+}
+
+
+def _cli_and_cluster():
+    return Cli(FakeCluster())
+
+
+def _invoke(cli, argv):
+    return run(make_parser().parse_args(argv), cli)
+
+
+def test_resolve_kind_accepts_kind_and_plural():
+    assert resolve_kind("tfjob") == "TFJob"
+    assert resolve_kind("TFJobs") == "TFJob"
+    assert resolve_kind("tpujobs") == "TPUJob"
+    with pytest.raises(SystemExit):
+        resolve_kind("nope")
+
+
+def test_submit_get_list_delete(tmp_path, capsys):
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    assert _invoke(cli, ["submit", str(path)]) == 0
+    assert "tfjob.kubeflow.org/mnist created" in capsys.readouterr().out
+
+    assert _invoke(cli, ["get", "tfjob", "mnist", "-o", "json"]) == 0
+    job = json.loads(capsys.readouterr().out)
+    assert job["metadata"]["name"] == "mnist"
+
+    assert _invoke(cli, ["list", "tfjob"]) == 0
+    out = capsys.readouterr().out
+    assert "mnist" in out and "NAME" in out
+
+    assert _invoke(cli, ["delete", "tfjob", "mnist"]) == 0
+    with pytest.raises(NotFoundError):
+        cli.cluster.get("TFJob", "default", "mnist")
+
+
+def test_pods_and_logs_after_reconcile(tmp_path, capsys):
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    _invoke(cli, ["submit", str(path)])
+    capsys.readouterr()
+
+    engine = make_engine("TFJob", cli.cluster)
+    from tf_operator_tpu.api import tensorflow as tfapi
+
+    job = tfapi.TFJob.from_dict(cli.cluster.get("TFJob", "default", "mnist"))
+    engine.reconcile(job)
+
+    assert _invoke(cli, ["pods", "tfjob", "mnist"]) == 0
+    out = capsys.readouterr().out.splitlines()
+    assert out == ["mnist-worker-0", "mnist-worker-1"]
+
+    cli.cluster.append_pod_log("default", "mnist-worker-0", "step 1 loss 2.3")
+    assert _invoke(cli, ["logs", "tfjob", "mnist", "--replica-type",
+                         "Worker", "--index", "0"]) == 0
+    out = capsys.readouterr().out
+    assert "==> mnist-worker-0 <==" in out and "step 1 loss 2.3" in out
+
+
+def test_wait_returns_by_terminal_state(tmp_path, capsys):
+    from tf_operator_tpu.api import common
+
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    _invoke(cli, ["submit", str(path)])
+
+    job = cli.cluster.get("TFJob", "default", "mnist")
+    job.setdefault("status", {})["conditions"] = [
+        {"type": common.JOB_SUCCEEDED, "status": "True"}
+    ]
+    cli.cluster.update("TFJob", job)
+    assert _invoke(cli, ["wait", "tfjob", "mnist", "--timeout", "5"]) == 0
+    assert "Succeeded" in capsys.readouterr().out
+
+    # a failed job exits 2, a timeout exits 1
+    job = cli.cluster.get("TFJob", "default", "mnist")
+    job["status"]["conditions"] = [
+        {"type": common.JOB_FAILED, "status": "True"}
+    ]
+    cli.cluster.update("TFJob", job)
+    assert _invoke(cli, ["wait", "tfjob", "mnist", "--timeout", "5"]) == 2
+
+
+def test_submit_from_stdin(monkeypatch, capsys):
+    import io
+
+    cli = _cli_and_cluster()
+    monkeypatch.setattr("sys.stdin", io.StringIO(yaml.safe_dump(TFJOB)))
+    assert _invoke(cli, ["submit", "-"]) == 0
+    assert "created" in capsys.readouterr().out
+    assert cli.cluster.get("TFJob", "default", "mnist")
+
+
+def test_global_flags_after_verb():
+    """kubectl-style flag placement: -n/--kubeconfig parse after the verb."""
+    args = make_parser().parse_args(["get", "tfjob", "mnist", "-n", "prod"])
+    assert args.namespace == "prod"
+    args = make_parser().parse_args(["-n", "pre", "get", "tfjob", "m"])
+    assert args.namespace == "pre"
+    args = make_parser().parse_args(
+        ["submit", "job.yaml", "--kubeconfig", "/tmp/kc"])
+    assert args.kubeconfig == "/tmp/kc"

@@ -1,0 +1,231 @@
+"""`tpu-jobs` — kubectl-style user CLI over the SDK JobClient.
+
+The reference's user surface is the generated SDK plus raw kubectl
+(`sdk/python/kubeflow/tfjob/api/tf_job_client.py`); this collapses the
+common verbs into one command:
+
+  tpu-jobs submit job.yaml                 # create from YAML
+  tpu-jobs get tfjob mnist [-n ns] [-o json|wide]
+  tpu-jobs list tpujob [-n ns]
+  tpu-jobs wait tfjob mnist --timeout 600  # block until terminal
+  tpu-jobs logs tfjob mnist [--replica-type Worker] [--index 0]
+  tpu-jobs pods tfjob mnist
+  tpu-jobs delete tfjob mnist
+
+Backend selection matches the operator (`cmd/main.py:build_cluster`):
+--kubeconfig / $KUBECONFIG / in-cluster env picks the real apiserver
+ClusterClient; otherwise commands run against the in-memory FakeCluster
+(only useful for tests, which inject their own cluster via make_cli).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS
+from tf_operator_tpu.sdk.client import JobClient, TimeoutError_
+from tf_operator_tpu.sdk.watch import job_state
+
+_KINDS = {}  # kind-lowercase / plural -> canonical Kind
+
+
+def _kind_table():
+    if not _KINDS:
+        for kind, adapter_cls in SUPPORTED_ADAPTERS.items():
+            _KINDS[kind.lower()] = kind
+            _KINDS[adapter_cls.PLURAL.lower()] = kind
+    return _KINDS
+
+
+def resolve_kind(token: str) -> str:
+    table = _kind_table()
+    kind = table.get(token.lower())
+    if kind is None:
+        raise SystemExit(
+            f"unknown kind {token!r} (choose from "
+            f"{sorted(set(table.values()))})"
+        )
+    return kind
+
+
+def _condition_summary(job: Dict[str, Any]) -> str:
+    # single source of truth for "latest True condition" (sdk/watch.py)
+    return job_state(job) or "Pending"
+
+
+def _print_job_row(job: Dict[str, Any], header: bool = False) -> None:
+    if header:
+        print(f"{'NAME':<32}{'KIND':<14}{'STATE':<12}CREATED")
+    md = job.get("metadata", {})
+    print(
+        f"{md.get('name', ''):<32}{job.get('kind', ''):<14}"
+        f"{_condition_summary(job):<12}{md.get('creationTimestamp', '')}"
+    )
+
+
+class Cli:
+    """Verb dispatcher bound to a cluster backend (injectable for tests)."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+
+    def client(self, kind: str) -> JobClient:
+        return JobClient(self.cluster, kind=kind)
+
+    # ----------------------------------------------------------- verbs
+    def submit(self, path: str, namespace: str) -> int:
+        with (sys.stdin if path == "-" else open(path)) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        for doc in docs:
+            kind = resolve_kind(doc.get("kind", ""))
+            created = self.client(kind).create(doc, namespace=namespace)
+            md = created.get("metadata", {})
+            print(f"{kind.lower()}.kubeflow.org/{md.get('name')} created")
+        return 0
+
+    def get(self, kind: str, name: str, namespace: str, output: str) -> int:
+        job = self.client(kind).get(name, namespace=namespace)
+        if output == "json":
+            print(json.dumps(job, indent=2, sort_keys=True))
+        elif output == "yaml":
+            print(yaml.safe_dump(job, sort_keys=False))
+        else:
+            _print_job_row(job, header=True)
+        return 0
+
+    def list(self, kind: str, namespace: Optional[str]) -> int:
+        jobs = self.client(kind).get(namespace=namespace)
+        if not jobs:
+            print("No resources found.")
+            return 0
+        for i, job in enumerate(jobs):
+            _print_job_row(job, header=(i == 0))
+        return 0
+
+    def wait(self, kind: str, name: str, namespace: str,
+             timeout: float) -> int:
+        try:
+            # 2s polling: the 0.02s SDK default is tuned for the in-memory
+            # FakeCluster; against a real apiserver it would be ~50 GETs/s
+            job = self.client(kind).wait_for_job(
+                name, namespace=namespace, timeout=timeout,
+                polling_interval=2.0,
+            )
+        except TimeoutError_ as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        state = _condition_summary(job)
+        print(f"{name}: {state}")
+        return 0 if state == "Succeeded" else 2
+
+    def pods(self, kind: str, name: str, namespace: str,
+             replica_type: Optional[str], index: Optional[int]) -> int:
+        names = self.client(kind).get_pod_names(
+            name, namespace=namespace, replica_type=replica_type,
+            replica_index=index,
+        )
+        for n in sorted(names):
+            print(n)
+        return 0
+
+    def logs(self, kind: str, name: str, namespace: str,
+             replica_type: Optional[str], index: Optional[int]) -> int:
+        out = self.client(kind).get_logs(
+            name, namespace=namespace, replica_type=replica_type,
+            replica_index=index,
+        )
+        for pod, text in sorted(out.items()):
+            print(f"==> {pod} <==")
+            if text:
+                print(text)
+        return 0
+
+    def delete(self, kind: str, name: str, namespace: str) -> int:
+        self.client(kind).delete(name, namespace=namespace)
+        print(f"{kind.lower()}.kubeflow.org/{name} deleted")
+        return 0
+
+
+def _build_cluster(kubeconfig: Optional[str]):
+    from tf_operator_tpu.cmd.main import build_cluster
+    from tf_operator_tpu.cmd.options import ServerOptions
+
+    options = ServerOptions()
+    options.kubeconfig = kubeconfig or ""
+    return build_cluster(options)
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-jobs", description=__doc__)
+    # global flags work BOTH before and after the verb (kubectl style):
+    # real defaults live on the top-level parser; the per-verb copies
+    # default to SUPPRESS so they only override when actually given
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("-n", "--namespace", default="default")
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--kubeconfig", default=argparse.SUPPRESS)
+    common.add_argument("-n", "--namespace", default=argparse.SUPPRESS)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    ps = sub.add_parser("submit", parents=[common])
+    ps.add_argument("file", help="job YAML ('-' for stdin)")
+
+    for verb in ("get", "wait", "pods", "logs", "delete"):
+        pv = sub.add_parser(verb, parents=[common])
+        pv.add_argument("kind")
+        pv.add_argument("name")
+        if verb == "get":
+            pv.add_argument("-o", "--output", default="wide",
+                            choices=("wide", "json", "yaml"))
+        if verb == "wait":
+            pv.add_argument("--timeout", type=float, default=600.0)
+        if verb in ("pods", "logs"):
+            pv.add_argument("--replica-type", default=None)
+            pv.add_argument("--index", type=int, default=None)
+
+    pl = sub.add_parser("list", parents=[common])
+    pl.add_argument("kind")
+    return p
+
+
+def run(args: argparse.Namespace, cli: Cli) -> int:
+    ns = args.namespace
+    if args.verb == "submit":
+        return cli.submit(args.file, ns)
+    kind = resolve_kind(args.kind)
+    if args.verb == "get":
+        return cli.get(kind, args.name, ns, args.output)
+    if args.verb == "list":
+        return cli.list(kind, ns)
+    if args.verb == "wait":
+        return cli.wait(kind, args.name, ns, args.timeout)
+    if args.verb == "pods":
+        return cli.pods(kind, args.name, ns, args.replica_type, args.index)
+    if args.verb == "logs":
+        return cli.logs(kind, args.name, ns, args.replica_type, args.index)
+    if args.verb == "delete":
+        return cli.delete(kind, args.name, ns)
+    raise SystemExit(f"unknown verb {args.verb}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    from tf_operator_tpu.k8s.fake import ApiError
+
+    try:
+        return run(args, Cli(_build_cluster(args.kubeconfig)))
+    except ApiError as e:  # NotFound/Conflict/...: clean message, no trace
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except (FileNotFoundError, RuntimeError, ValueError,
+            yaml.YAMLError) as e:  # bad kubeconfig / malformed job YAML
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
