@@ -1,0 +1,153 @@
+"""Local executor (runtime/local.py): real subprocesses driven by the real
+operator stack — the hermetic analogue of the reference's cluster e2e tier
+(SURVEY.md §4.4), with actual OS processes instead of containers."""
+import sys
+import textwrap
+
+import pytest
+
+from tf_operator_tpu.runtime.local import localize_env_value, run_local
+
+
+def _job(kind, replica_key, rtypes, container, script, *, extra_spec=None,
+         restart_policy=None, name="local"):
+    specs = {}
+    for rtype, n in rtypes.items():
+        rspec = {
+            "replicas": n,
+            "template": {"spec": {"containers": [{
+                "name": container,
+                "image": "local",
+                "command": ["python", "-c", textwrap.dedent(script)],
+            }]}},
+        }
+        if restart_policy:
+            rspec["restartPolicy"] = restart_policy
+        specs[rtype] = rspec
+    spec = {replica_key: specs}
+    spec.update(extra_spec or {})
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": kind,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def test_localize_env_value():
+    assert localize_env_value("j-worker-0.ns.svc:2222") == "127.0.0.1:2222"
+    assert localize_env_value(
+        "j-ps-0.ns.svc.cluster.local:1234") == "127.0.0.1:1234"
+    cfg = '{"worker": ["a-worker-0.default.svc:2222", "a-worker-1.default.svc:2222"]}'
+    assert localize_env_value(cfg) == \
+        '{"worker": ["127.0.0.1:2222", "127.0.0.1:2222"]}'
+    assert localize_env_value("plain-value") == "plain-value"
+
+
+def test_tfjob_runs_to_succeeded_with_env_contract():
+    """2 workers actually execute, see a well-formed TF_CONFIG, and the job
+    goes Succeeded; logs carry each replica's own task index."""
+    script = """
+        import json, os
+        cfg = json.loads(os.environ["TF_CONFIG"])
+        assert cfg["task"]["type"] == "worker"
+        assert len(cfg["cluster"]["worker"]) == 2
+        assert cfg["cluster"]["worker"][0].startswith("127.0.0.1:")
+        print("task-index", cfg["task"]["index"])
+    """
+    result = run_local(
+        _job("TFJob", "tfReplicaSpecs", {"Worker": 2}, "tensorflow", script),
+        timeout=90,
+    )
+    assert result["state"] == "Succeeded", result["logs"]
+    combined = "\n".join(result["logs"].values())
+    assert "task-index 0" in combined and "task-index 1" in combined
+
+
+def test_tpujob_env_and_failure_path():
+    """A TPUJob host that exits 1 permanently fails the job (ExitCode
+    policy: 1 is non-retryable); env carries the TPU slice contract."""
+    script = """
+        import os, sys
+        assert os.environ["TPU_WORKER_ID"] == "0"
+        assert os.environ["COORDINATOR_ADDRESS"].startswith("127.0.0.1:")
+        print("slice env ok"); sys.exit(1)
+    """
+    job = _job("TPUJob", "tpuReplicaSpecs", {"Worker": 1}, "tpu", script,
+               extra_spec={"acceleratorType": "v5e-4"})
+    result = run_local(job, timeout=90)
+    assert result["state"] == "Failed", result["logs"]
+    assert "slice env ok" in "\n".join(result["logs"].values())
+
+
+def test_onfailure_restarts_until_success(tmp_path):
+    """restartPolicy OnFailure: first run exits 1, the kubelet restarts the
+    container in place, second run succeeds -> job Succeeded."""
+    marker = tmp_path / "ran-once"
+    script = f"""
+        import os, sys
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            print("first attempt fails"); sys.exit(1)
+        print("second attempt succeeds")
+    """
+    job = _job("PyTorchJob", "pytorchReplicaSpecs", {"Master": 1}, "pytorch",
+               script, restart_policy="OnFailure")
+    result = run_local(job, timeout=90)
+    assert result["state"] == "Succeeded", result["logs"]
+    combined = "\n".join(result["logs"].values())
+    assert "first attempt fails" in combined
+    assert "restarting container (count 1)" in combined
+    assert "second attempt succeeds" in combined
+
+
+def test_missing_command_fails_cleanly():
+    job = _job("TFJob", "tfReplicaSpecs", {"Worker": 1}, "tensorflow", "")
+    job["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0].pop("command")
+    result = run_local(job, timeout=60)
+    assert result["state"] == "Failed", result["logs"]
+    assert "no command" in "\n".join(result["logs"].values())
+
+
+def test_cli_run_local(tmp_path, capsys):
+    import yaml
+
+    from tf_operator_tpu.sdk.cli import main
+
+    job = _job("TFJob", "tfReplicaSpecs", {"Worker": 1}, "tensorflow",
+               "print('hello from local pod')")
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(job))
+    rc = main(["run-local", str(path), "--timeout", "90"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "tfjob/local: Succeeded" in out
+    assert "hello from local pod" in out
+
+
+def test_run_local_ignores_stale_kubeconfig(tmp_path, capsys, monkeypatch):
+    """run-local must not construct a cluster backend: a stale KUBECONFIG
+    cannot break the offline dev loop."""
+    import yaml
+
+    from tf_operator_tpu.sdk.cli import main
+
+    monkeypatch.setenv("KUBECONFIG", "/nonexistent/kubeconfig")
+    job = _job("TFJob", "tfReplicaSpecs", {"Worker": 1}, "tensorflow",
+               "print('offline ok')")
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(job))
+    rc = main(["run-local", str(path), "--timeout", "90"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "offline ok" in out
+
+
+def test_run_local_timeout_is_reported():
+    job = _job("TFJob", "tfReplicaSpecs", {"Worker": 1}, "tensorflow",
+               "import time; time.sleep(60)")
+    result = run_local(job, timeout=3.0)
+    assert result["state"] == "Timeout"
+    assert result["timed_out"] is True

@@ -18,13 +18,8 @@ import urllib.request
 from typing import Dict, Optional
 
 from tf_operator_tpu.e2e.test_server import TestServer
-from tf_operator_tpu.k8s import objects
-from tf_operator_tpu.k8s.fake import (
-    ApiError,
-    ConflictError,
-    FakeCluster,
-    NotFoundError,
-)
+from tf_operator_tpu.k8s import kubelet_util, objects
+from tf_operator_tpu.k8s.fake import FakeCluster, NotFoundError
 
 PORT_ANNOTATION = "tpu-operator.e2e/port"
 
@@ -86,37 +81,16 @@ class FakeKubelet:
         log(f"container {c.get('name')} image {c.get('image')} started")
 
         def mark_running(pod) -> None:
-            pod["status"]["phase"] = objects.POD_RUNNING
-            pod["status"]["podIP"] = "127.0.0.1"
+            kubelet_util.mark_running(pod, c.get("name", ""), 0)
             pod["metadata"].setdefault("annotations", {})[PORT_ANNOTATION] = str(
                 server.port
             )
-            pod["status"]["containerStatuses"] = [
-                {
-                    "name": c.get("name", ""),
-                    "state": {"running": {}},
-                    "restartCount": 0,
-                }
-            ]
 
         if not self._write_pod_status(namespace, name, mark_running):
             self._stop_pod(key)
 
     def _write_pod_status(self, namespace: str, name: str, mutate) -> bool:
-        """Re-get + retry on write conflicts, like the real kubelet's status
-        manager — other writers (controller adoption, tests) race on pods."""
-        for _ in range(5):
-            try:
-                pod = self.cluster.get_pod(namespace, name)
-                mutate(pod)
-                self.cluster.update_pod(pod)
-                return True
-            except ConflictError:
-                time.sleep(0.01)
-                continue
-            except (NotFoundError, ApiError):
-                return False
-        return False
+        return kubelet_util.write_pod_status(self.cluster, namespace, name, mutate)
 
     def _container_exited(self, key: str, code: int) -> None:
         namespace, _, name = key.partition("/")
@@ -129,8 +103,7 @@ class FakeKubelet:
         except NotFoundError:
             return
         policy = pod.get("spec", {}).get("restartPolicy", "Always")
-        restart = policy == "Always" or (policy == "OnFailure" and code != 0)
-        if restart:
+        if kubelet_util.should_restart(policy, code):
             # kubelet-style in-place container restart: pod object survives,
             # restartCount increments, phase returns to Running
             running.restart_count += 1
@@ -139,14 +112,8 @@ class FakeKubelet:
             )
 
             def mark_restarting(pod) -> None:
-                pod["status"]["containerStatuses"] = [
-                    {
-                        "name": running.container_name,
-                        "state": {"running": {}},
-                        "lastState": {"terminated": {"exitCode": code}},
-                        "restartCount": running.restart_count,
-                    }
-                ]
+                kubelet_util.mark_restarting(
+                    pod, running.container_name, running.restart_count, code)
 
             if not self._write_pod_status(namespace, name, mark_restarting):
                 return
@@ -170,19 +137,10 @@ class FakeKubelet:
             self._write_pod_status(namespace, name, set_port)
             return
 
-        def mark_terminal(pod) -> None:
-            pod["status"]["phase"] = (
-                objects.POD_SUCCEEDED if code == 0 else objects.POD_FAILED
-            )
-            pod["status"]["containerStatuses"] = [
-                {
-                    "name": running.container_name,
-                    "state": {"terminated": {"exitCode": code}},
-                    "restartCount": running.restart_count,
-                }
-            ]
-
-        self._write_pod_status(namespace, name, mark_terminal)
+        self._write_pod_status(
+            namespace, name,
+            lambda pod: kubelet_util.mark_terminal(
+                pod, running.container_name, code, running.restart_count))
 
     def _stop_pod(self, key: str) -> None:
         with self._lock:

@@ -251,6 +251,17 @@ class FakeCluster:
         with self._lock:
             return "\n".join(self._pod_logs.get(f"{namespace}/{name}", []))
 
+    def all_pod_logs(self, namespace: Optional[str] = None) -> Dict[str, str]:
+        """Snapshot of every pod's log (incl. pods already reaped by
+        CleanPodPolicy — logs outlive the pod object, like a real log
+        store). Locked: kubelet threads may be appending concurrently."""
+        with self._lock:
+            return {
+                key.partition("/")[2]: "\n".join(lines)
+                for key, lines in self._pod_logs.items()
+                if namespace is None or key.startswith(namespace + "/")
+            }
+
     # ------------------------------------------------------------- events
     def record_event(
         self,

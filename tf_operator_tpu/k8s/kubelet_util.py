@@ -1,0 +1,77 @@
+"""Shared kubelet-semantics helpers for the two pod materializers —
+e2e/kubelet.py (in-process test servers) and runtime/local.py (real
+subprocesses). Both must agree on restart-policy decisions, pod status
+shapes, and the conflict-retrying status write; keeping those here means
+a semantics fix cannot silently apply to only one simulator."""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import ApiError, ConflictError, NotFoundError
+
+
+def should_restart(policy: str, exit_code: int) -> bool:
+    """Kubelet restart decision: Always restarts; OnFailure restarts on
+    non-zero; Never/ExitCode go terminal (the operator owns ExitCode —
+    reference pod.go:321-328 forces Never on the pod)."""
+    return policy == "Always" or (policy == "OnFailure" and exit_code != 0)
+
+
+def write_pod_status(cluster, namespace: str, name: str,
+                     mutate: Callable, retries: int = 5) -> bool:
+    """Re-get + retry on write conflicts, like the real kubelet's status
+    manager — other writers (controller adoption, tests) race on pods."""
+    for _ in range(retries):
+        try:
+            pod = cluster.get_pod(namespace, name)
+            mutate(pod)
+            cluster.update_pod(pod)
+            return True
+        except ConflictError:
+            time.sleep(0.01)
+            continue
+        except (NotFoundError, ApiError):
+            return False
+    return False
+
+
+def running_status(container_name: str, restart_count: int,
+                   last_exit_code: Optional[int] = None) -> Dict:
+    status = {
+        "name": container_name,
+        "state": {"running": {}},
+        "restartCount": restart_count,
+    }
+    if last_exit_code is not None:
+        status["lastState"] = {"terminated": {"exitCode": last_exit_code}}
+    return status
+
+
+def mark_running(pod, container_name: str, restart_count: int,
+                 pod_ip: str = "127.0.0.1") -> None:
+    pod["status"]["phase"] = objects.POD_RUNNING
+    pod["status"]["podIP"] = pod_ip
+    pod["status"]["containerStatuses"] = [
+        running_status(container_name, restart_count)
+    ]
+
+
+def mark_restarting(pod, container_name: str, restart_count: int,
+                    exit_code: int) -> None:
+    pod["status"]["containerStatuses"] = [
+        running_status(container_name, restart_count, last_exit_code=exit_code)
+    ]
+
+
+def mark_terminal(pod, container_name: str, exit_code: int,
+                  restart_count: int) -> None:
+    pod["status"]["phase"] = (
+        objects.POD_SUCCEEDED if exit_code == 0 else objects.POD_FAILED
+    )
+    pod["status"]["containerStatuses"] = [{
+        "name": container_name,
+        "state": {"terminated": {"exitCode": exit_code}},
+        "restartCount": restart_count,
+    }]

@@ -1,0 +1,274 @@
+"""Local executor — run a job CR's replicas as REAL local subprocesses.
+
+`tpu-jobs run-local job.yaml` (or `run_local(job_dict)`) stands up the
+whole stack in one process — FakeCluster state store, OperatorManager
+reconciling, and a SubprocessKubelet that materializes every created Pod
+as an actual subprocess running the container's command with the
+operator-injected env (TF_CONFIG, MASTER_*, TPU_*, ... —
+docs/env_contract.md) — then waits for the job to reach a terminal
+condition and returns its logs.
+
+This is the dev-loop analogue of the reference's real-cluster e2e tier
+(SURVEY.md §4.4): where the reference needs a live cluster + kubelet to
+observe a replica's actual runtime config, run-local gives the same
+observation from plain `python -c` / training scripts on the developer
+machine. Cluster-internal DNS names (`{job}-{rt}-{i}.{ns}.svc`) are
+rewritten to 127.0.0.1 in injected env values, so single-binder
+rendezvous schemes (a jax.distributed coordinator on one port) work
+locally; schemes where every replica binds the same port on its own
+host (TF gRPC servers) need real pods.
+
+Restart-policy decisions, status shapes, and the conflict-retrying
+status write are shared with the in-process test-server kubelet
+(e2e/kubelet.py) via k8s/kubelet_util.py.
+"""
+from __future__ import annotations
+
+import os
+import re
+import shlex
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.k8s import kubelet_util, objects
+from tf_operator_tpu.k8s.fake import FakeCluster, NotFoundError
+
+# any cluster-internal service DNS form, with or without :port
+_SVC_DNS = re.compile(r"[a-z0-9]([a-z0-9-]*[a-z0-9])?"
+                      r"(-[0-9]+)?\.[a-z0-9-]+\.svc(\.[a-z0-9.-]*[a-z0-9])?")
+
+
+def localize_env_value(value: str) -> str:
+    """Rewrite `{name}.{ns}.svc[.domain]` hostnames to 127.0.0.1 (ports
+    kept) so local processes can reach a locally-bound coordinator."""
+    return _SVC_DNS.sub("127.0.0.1", value)
+
+
+class _Proc:
+    def __init__(self, popen: subprocess.Popen, container_name: str) -> None:
+        self.popen = popen
+        self.container_name = container_name
+        self.restart_count = 0
+        self.deleted = False
+
+
+def _reap(popen: subprocess.Popen) -> None:
+    """Kill + wait + close the pipe so no zombie survives."""
+    popen.kill()
+    try:
+        popen.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        pass
+    if popen.stdout is not None:
+        popen.stdout.close()
+
+
+class SubprocessKubelet:
+    """Watches Pods on a cluster; runs each pod's first container command
+    as a local subprocess, captures its output as the pod log, and drives
+    pod phase/containerStatuses exactly like a kubelet."""
+
+    def __init__(self, cluster: FakeCluster,
+                 extra_env: Optional[Dict[str, str]] = None) -> None:
+        self.cluster = cluster
+        self.extra_env = dict(extra_env or {})
+        self._lock = threading.Lock()
+        self._running: Dict[str, _Proc] = {}
+        self._shutdown = False
+        cluster.subscribe("Pod", self._on_pod_event)
+
+    # ------------------------------------------------------------- events
+    def _on_pod_event(self, event_type: str, pod) -> None:
+        key = objects.key_of(pod)
+        if event_type == "ADDED":
+            threading.Thread(
+                target=self._start_pod, args=(key,), daemon=True
+            ).start()
+        elif event_type == "DELETED":
+            self._stop_pod(key)
+
+    # ---------------------------------------------------------- lifecycle
+    def _argv_env(self, pod) -> Optional[tuple]:
+        containers = pod.get("spec", {}).get("containers", [])
+        if not containers:
+            return None
+        c = containers[0]
+        argv = list(c.get("command") or []) + list(c.get("args") or [])
+        if not argv:
+            return None
+        if argv[0] in ("python", "python3"):
+            argv[0] = sys.executable  # the venv running the operator
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        for e in c.get("env", []) or []:
+            env[e["name"]] = localize_env_value(str(e.get("value", "")))
+        return c.get("name", ""), argv, env
+
+    def _start_pod(self, key: str) -> None:
+        namespace, _, name = key.partition("/")
+        try:
+            pod = self.cluster.get_pod(namespace, name)
+        except NotFoundError:
+            return
+        spec = self._argv_env(pod)
+        if spec is None:
+            self.cluster.append_pod_log(
+                namespace, name, "run-local: container has no command; "
+                "local pods must specify command/args")
+            self._mark_terminal(key, "", 127, restart_count=0)
+            return
+        container_name, argv, env = spec
+        self._spawn(key, container_name, argv, env, restart_count=0)
+
+    def _spawn(self, key: str, container_name: str, argv: List[str],
+               env: Dict[str, str], restart_count: int) -> None:
+        namespace, _, name = key.partition("/")
+        with self._lock:
+            if self._shutdown:
+                return
+        try:
+            popen = subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+        except OSError as e:
+            self.cluster.append_pod_log(namespace, name, f"spawn failed: {e}")
+            self._mark_terminal(key, container_name, 127, restart_count)
+            return
+        proc = _Proc(popen, container_name)
+        proc.restart_count = restart_count
+        with self._lock:
+            # losing a registration race (duplicate ADDED) or a shutdown
+            # that began mid-spawn: reap the redundant child, no zombies
+            if self._shutdown or key in self._running:
+                reap = True
+            else:
+                self._running[key] = proc
+                reap = False
+        if reap:
+            _reap(popen)
+            return
+        self.cluster.append_pod_log(
+            namespace, name,
+            f"container {container_name} started: {shlex.join(argv)}")
+        self._write_status(
+            namespace, name,
+            lambda pod: kubelet_util.mark_running(
+                pod, container_name, restart_count))
+        threading.Thread(
+            target=self._pump, args=(key, proc, argv, env), daemon=True
+        ).start()
+
+    def _pump(self, key: str, proc: _Proc, argv: List[str],
+              env: Dict[str, str]) -> None:
+        namespace, _, name = key.partition("/")
+        for line in proc.popen.stdout:  # drains until EOF (process exit)
+            self.cluster.append_pod_log(namespace, name, line.rstrip("\n"))
+        code = proc.popen.wait()
+        proc.popen.stdout.close()
+        with self._lock:
+            current = self._running.get(key)
+            if current is not proc:
+                return  # superseded
+            self._running.pop(key, None)
+            if proc.deleted or self._shutdown:
+                return  # torn down; do not respawn or write status
+        try:
+            pod = self.cluster.get_pod(namespace, name)
+        except NotFoundError:
+            return
+        policy = pod.get("spec", {}).get("restartPolicy", "Always")
+        if kubelet_util.should_restart(policy, code):
+            # kubelet-style in-place restart: same pod object, count++
+            count = proc.restart_count + 1
+            self.cluster.append_pod_log(
+                namespace, name, f"restarting container (count {count})")
+            ok = self._write_status(
+                namespace, name,
+                lambda pod: kubelet_util.mark_restarting(
+                    pod, proc.container_name, count, code))
+            if ok:
+                self._spawn(key, proc.container_name, argv, env, count)
+            return
+        self._mark_terminal(key, proc.container_name, code,
+                            proc.restart_count)
+
+    def _mark_terminal(self, key: str, container_name: str, code: int,
+                       restart_count: int) -> None:
+        namespace, _, name = key.partition("/")
+        self._write_status(
+            namespace, name,
+            lambda pod: kubelet_util.mark_terminal(
+                pod, container_name, code, restart_count))
+
+    def _write_status(self, namespace: str, name: str, mutate) -> bool:
+        return kubelet_util.write_pod_status(
+            self.cluster, namespace, name, mutate)
+
+    def _stop_pod(self, key: str) -> None:
+        with self._lock:
+            proc = self._running.pop(key, None)
+            if proc is not None:
+                proc.deleted = True
+        if proc is not None:
+            proc.popen.terminate()
+            try:
+                proc.popen.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.popen.kill()
+
+    def stop_all(self) -> None:
+        # the flag (checked under the same lock _pump/_spawn hold) closes
+        # the restart race: a crash-looping pod mid-respawn during
+        # shutdown must not leave an orphan process behind
+        with self._lock:
+            self._shutdown = True
+            keys = list(self._running)
+        for key in keys:
+            self._stop_pod(key)
+
+
+# ------------------------------------------------------------------ driver
+def run_local(job: Dict[str, Any], timeout: float = 300.0,
+              poll: float = 0.2,
+              extra_env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Run one job CR end to end locally. Returns {"job": final_cr,
+    "state": str, "timed_out": bool, "logs": {pod_name: text}} — state is
+    "Timeout" when the deadline fired before a terminal condition (the
+    last observed phase is still in the returned job's conditions)."""
+    from tf_operator_tpu.api import common
+    from tf_operator_tpu.cmd.manager import OperatorManager
+    from tf_operator_tpu.cmd.options import ServerOptions
+    from tf_operator_tpu.sdk.watch import job_state
+
+    kind = job.get("kind", "")
+    namespace = job.get("metadata", {}).get("namespace", "default")
+    name = job.get("metadata", {}).get("name", "")
+    cluster = FakeCluster()
+    kubelet = SubprocessKubelet(cluster, extra_env=extra_env)
+    manager = OperatorManager(cluster, ServerOptions())
+    manager.start()
+    try:
+        cluster.create(kind, job)
+        deadline = time.monotonic() + timeout
+        timed_out = True
+        while time.monotonic() < deadline:
+            cr = cluster.get(kind, namespace, name)
+            if job_state(cr) in (common.JOB_SUCCEEDED, common.JOB_FAILED):
+                timed_out = False
+                break
+            time.sleep(poll)
+        cr = cluster.get(kind, namespace, name)
+        state = "Timeout" if timed_out else job_state(cr)
+        return {
+            "job": cr,
+            "state": state,
+            "timed_out": timed_out,
+            "logs": cluster.all_pod_logs(namespace),
+        }
+    finally:
+        kubelet.stop_all()
+        manager.stop()

@@ -5,6 +5,7 @@ The reference's user surface is the generated SDK plus raw kubectl
 common verbs into one command:
 
   tpu-jobs submit job.yaml                 # create from YAML
+  tpu-jobs run-local job.yaml              # run replicas as LOCAL processes
   tpu-jobs get tfjob mnist [-n ns] [-o json|wide]
   tpu-jobs list tpujob [-n ns]
   tpu-jobs wait tfjob mnist --timeout 600  # block until terminal
@@ -150,6 +151,28 @@ class Cli:
         return 0
 
 
+def run_local_file(path: str, timeout: float) -> int:
+    """Run a job YAML's replicas as local subprocesses end to end
+    (runtime/local.py) — the dev-loop analogue of a real-cluster e2e."""
+    from tf_operator_tpu.runtime.local import run_local
+
+    with (sys.stdin if path == "-" else open(path)) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    rc = 0
+    for doc in docs:
+        resolve_kind(doc.get("kind", ""))  # fail fast on unknown kinds
+        result = run_local(doc, timeout=timeout)
+        name = doc.get("metadata", {}).get("name", "")
+        print(f"{doc['kind'].lower()}/{name}: {result['state']}")
+        for pod, text in sorted(result["logs"].items()):
+            print(f"==> {pod} <==")
+            if text:
+                print(text)
+        if result["state"] != "Succeeded":
+            rc = 2
+    return rc
+
+
 def _build_cluster(kubeconfig: Optional[str]):
     from tf_operator_tpu.cmd.main import build_cluster
     from tf_operator_tpu.cmd.options import ServerOptions
@@ -174,6 +197,10 @@ def make_parser() -> argparse.ArgumentParser:
     ps = sub.add_parser("submit", parents=[common])
     ps.add_argument("file", help="job YAML ('-' for stdin)")
 
+    pr = sub.add_parser("run-local", parents=[common])
+    pr.add_argument("file", help="job YAML ('-' for stdin)")
+    pr.add_argument("--timeout", type=float, default=300.0)
+
     for verb in ("get", "wait", "pods", "logs", "delete"):
         pv = sub.add_parser(verb, parents=[common])
         pv.add_argument("kind")
@@ -196,6 +223,8 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
     ns = args.namespace
     if args.verb == "submit":
         return cli.submit(args.file, ns)
+    if args.verb == "run-local":
+        return run_local_file(args.file, args.timeout)
     kind = resolve_kind(args.kind)
     if args.verb == "get":
         return cli.get(kind, args.name, ns, args.output)
@@ -217,6 +246,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from tf_operator_tpu.k8s.fake import ApiError
 
     try:
+        if args.verb == "run-local":
+            # fully local: never touch (or require) a cluster backend —
+            # a stale $KUBECONFIG must not break an offline dev loop
+            return run_local_file(args.file, args.timeout)
         return run(args, Cli(_build_cluster(args.kubeconfig)))
     except ApiError as e:  # NotFound/Conflict/...: clean message, no trace
         print(f"error: {e}", file=sys.stderr)
