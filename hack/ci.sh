@@ -16,6 +16,10 @@ python -m compileall -q tf_operator_tpu hack examples tests
 stage "manifests: generated CRDs in sync"
 python hack/gen_crds.py --check
 
+stage "manifests: overlays render (hermetic kustomize)"
+python hack/release.py render --overlay standalone > /dev/null
+python hack/release.py render --overlay kubeflow > /dev/null
+
 stage "unit + controller + numerics"
 python -m pytest tests/ -q -x --ignore=tests/test_e2e.py \
     --ignore=tests/test_examples.py --ignore=tests/test_sdk.py
