@@ -115,3 +115,24 @@ def test_t5_smoke_with_resume(tmp_path):
                f"--ckpt-dir={ckpt}")
     assert rc2.returncode == 0, rc2.stderr[-2000:]
     assert "resumed_from=2" in rc2.stdout
+
+
+def test_mnist_ladder_config_through_run_local(tmp_path):
+    """Ladder config #1 end to end through the WHOLE stack: job CR ->
+    operator reconcile -> pod -> real subprocess -> actual training to
+    Succeeded. The YAML's container path is remapped to the repo checkout
+    the way the operator image maps /examples."""
+    from tf_operator_tpu.runtime.local import run_local
+
+    doc = yaml.safe_load(open(os.path.join(EX, "mnist", "mnist_single.yaml")))
+    c = doc["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0]
+    c["command"] = [
+        "python", os.path.join(EX, "mnist", "train_mnist.py")]
+    c["args"] = ["--steps=20", "--batch-size=16", "--log-interval=10",
+                 f"--ckpt-dir={tmp_path}"]
+    result = run_local(doc, timeout=240,
+                       extra_env={"PYTHONPATH": REPO})
+    combined = "\n".join(result["logs"].values())
+    assert result["state"] == "Succeeded", combined[-2000:]
+    assert "loss" in combined

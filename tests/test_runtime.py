@@ -133,3 +133,39 @@ def test_bootstrap_local_is_not_distributed():
 def test_flops_estimate():
     assert flops_per_image(224) == pytest.approx(4.1e9)
     assert flops_per_image(112) == pytest.approx(4.1e9 / 4)
+
+
+def test_multislice_global_rendezvous():
+    """Global process math for multislice (slice_id * hosts + host): the
+    env controllers/tpu.py injects for a 2-slice v4-32 job must rendezvous
+    every host at the MEGASCALE coordinator with a unique global id."""
+    seen = []
+    for slice_id in (0, 1):
+        for host in (0, 3):
+            env = {
+                "COORDINATOR_ADDRESS": f"j-worker-{slice_id * 4}.ns.svc:8476",
+                "MEGASCALE_COORDINATOR_ADDRESS": "j-worker-0.ns.svc:8476",
+                "NUM_PROCESSES": "4",
+                "PROCESS_ID": str(host),
+                "TPU_SLICE_ID": str(slice_id),
+                "TPU_NUM_SLICES": "2",
+                "TPU_HOSTS_PER_SLICE": "4",
+                "TPU_TOTAL_HOSTS": "8",
+            }
+            info = bootstrap.slice_info_from_env(env)
+            coord, n, pid = bootstrap.global_rendezvous(info)
+            assert coord == "j-worker-0.ns.svc:8476"  # one global coordinator
+            assert n == 8
+            seen.append(pid)
+    assert seen == [0, 3, 4, 7]  # unique, slice-major
+
+
+def test_single_slice_rendezvous_uses_slice_coordinator():
+    env = {
+        "COORDINATOR_ADDRESS": "j-worker-0.ns.svc:8476",
+        "NUM_PROCESSES": "4",
+        "PROCESS_ID": "2",
+    }
+    coord, n, pid = bootstrap.global_rendezvous(
+        bootstrap.slice_info_from_env(env))
+    assert (coord, n, pid) == ("j-worker-0.ns.svc:8476", 4, 2)

@@ -61,6 +61,23 @@ def slice_info_from_env(env: Optional[Dict[str, str]] = None) -> SliceInfo:
 _initialized = False
 
 
+def global_rendezvous(info: SliceInfo):
+    """(coordinator, num_processes, process_id) for jax.distributed.
+
+    Multislice: jax.distributed is GLOBAL across all slices — one
+    coordinator (slice 0, host 0 = the MEGASCALE address), global process
+    count/id; the MEGASCALE_* env separately tells libtpu the slice
+    topology for ICI-vs-DCN routing. Pure so the off-by-one-critical math
+    (SURVEY.md §7.4.5) is unit-testable without jax.distributed."""
+    if info.num_slices > 1:
+        return (
+            info.megascale_coordinator_address,
+            info.total_hosts,
+            info.slice_id * info.hosts_per_slice + info.process_id,
+        )
+    return info.coordinator_address, info.num_processes, info.process_id
+
+
 def initialize(env: Optional[Dict[str, str]] = None) -> SliceInfo:
     """Initialize jax.distributed from the injected env (idempotent).
     Single-process jobs skip distributed init entirely."""
@@ -69,18 +86,7 @@ def initialize(env: Optional[Dict[str, str]] = None) -> SliceInfo:
     if info.is_distributed and not _initialized:
         import jax
 
-        if info.num_slices > 1:
-            # multislice: jax.distributed is GLOBAL across all slices —
-            # one coordinator (slice 0, host 0 = MEGASCALE address), global
-            # process count/id; the MEGASCALE_* env separately tells libtpu
-            # the slice topology for ICI-vs-DCN routing
-            coordinator = info.megascale_coordinator_address
-            num_processes = info.total_hosts
-            process_id = info.slice_id * info.hosts_per_slice + info.process_id
-        else:
-            coordinator = info.coordinator_address
-            num_processes = info.num_processes
-            process_id = info.process_id
+        coordinator, num_processes, process_id = global_rendezvous(info)
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
