@@ -238,6 +238,23 @@ def bench_transformer(gen: str, n_chips: int):
     }
 
 
+
+def _parity(f_out, f_grads, r_out, r_grads):
+    """(fwd_rel, grad_max_rel, ok) between two (loss, grads) pairs."""
+    import jax
+
+    f_out = float(jax.device_get(f_out))
+    r_out = float(jax.device_get(r_out))
+    fwd_rel = abs(f_out - r_out) / max(1.0, abs(r_out))
+    grad_rel = 0.0
+    for fg, rg in zip(f_grads, r_grads):
+        fg = jax.device_get(fg).astype("float32")
+        rg = jax.device_get(rg).astype("float32")
+        denom = float(abs(rg).max()) or 1.0
+        grad_rel = max(grad_rel, float(abs(fg - rg).max()) / denom)
+    return fwd_rel, grad_rel, fwd_rel < 5e-3 and grad_rel < 5e-2
+
+
 def bench_flash_attention(gen: str):
     """Compiled (non-interpret) pallas flash attention: parity vs the einsum
     reference fwd+bwd at S=2048, causal and non-causal, plus speedup.
@@ -273,17 +290,9 @@ def bench_flash_attention(gen: str):
 
         f_out, f_grads = flash_vg(q, k, v)
         r_out, r_grads = ref_vg(q, k, v)
-        f_out = float(jax.device_get(f_out))
-        r_out = float(jax.device_get(r_out))
         # bf16 inputs, f32 accumulation: sums over B*S*H*D=8.4M outputs —
         # compare relatively
-        fwd_rel = abs(f_out - r_out) / max(1.0, abs(r_out))
-        grad_rel = 0.0
-        for fg, rg in zip(f_grads, r_grads):
-            fg = jax.device_get(fg).astype("float32")
-            rg = jax.device_get(rg).astype("float32")
-            denom = float(abs(rg).max()) or 1.0
-            grad_rel = max(grad_rel, float(abs(fg - rg).max()) / denom)
+        fwd_rel, grad_rel, ok = _parity(f_out, f_grads, r_out, r_grads)
 
         def timed(fn, n=10):
             fn(q, k, v)  # warm
@@ -295,7 +304,6 @@ def bench_flash_attention(gen: str):
 
         t_flash = timed(flash_vg)
         t_ref = timed(ref_vg)
-        ok = fwd_rel < 5e-3 and grad_rel < 5e-2
         results[tag] = {
             "parity_ok": ok,
             "fwd_rel_err": round(fwd_rel, 6),
@@ -305,6 +313,36 @@ def bench_flash_attention(gen: str):
             "speedup": round(t_ref / t_flash, 2),
         }
     results["shape"] = f"b{b} s{s} h{h} d{d} bf16 fwd+bwd"
+
+    # ring-flash (ops/ring_flash.py) compiled on a 1-device mesh (ring of
+    # one): validates the carry-kernel + SMEM-offset Mosaic lowering on
+    # hardware even though multi-chip rings need a real slice
+    try:
+        from tf_operator_tpu.ops.ring_flash import make_ring_flash_attention_fn
+        from tf_operator_tpu.parallel.mesh import make_mesh
+
+        mesh1 = make_mesh({}, devices=jax.devices()[:1])
+        rf = make_ring_flash_attention_fn(mesh1, "tp", interpret=False)
+
+        def loss_rf(q, k, v):
+            return rf(q, k, v, True).astype(jnp.float32).sum()
+
+        rf_vg = jax.jit(jax.value_and_grad(loss_rf, argnums=(0, 1, 2)))
+        def loss_ref_c(q, k, v):
+            return dot_product_attention(q, k, v, True).astype(
+                jnp.float32).sum()
+
+        ref_vg_c = jax.jit(jax.value_and_grad(loss_ref_c, argnums=(0, 1, 2)))
+        f_out, f_grads = rf_vg(q, k, v)
+        r_out, r_grads = ref_vg_c(q, k, v)
+        fwd_rel, grad_rel, ok = _parity(f_out, f_grads, r_out, r_grads)
+        results["ring_flash_1dev"] = {
+            "parity_ok": ok,
+            "fwd_rel_err": round(fwd_rel, 6),
+            "grad_max_rel_err": round(grad_rel, 6),
+        }
+    except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+        results["ring_flash_1dev"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     return results
 
 

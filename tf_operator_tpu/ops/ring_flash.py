@@ -1,0 +1,418 @@
+"""Ring flash attention — the pallas kernel fused into the ring step.
+
+ops/ring_attention.py materializes a [B, H, S/n, S/n] score block per
+ring step (O((S/n)^2) memory); here each step instead runs a
+carry-passing variant of the flash kernel (ops/flash_attention.py): the
+running online-softmax state (m, l, unnormalized acc) lives in HBM
+between steps, each kernel invocation streams the resident KV shard
+through VMEM exactly like the single-chip kernel, and `ppermute`
+rotates KV shards around the ring. Per-device attention memory drops to
+O(S/n * blk), so the sequence per device is bounded by weights+activations,
+not by the score block.
+
+Causality is handled with GLOBAL positions: the q/k shard offsets
+(my_index * S_local, src_index * S_local) ride into the kernel as [1,1]
+scalars, the mask compares global ids, and fully-masked KV tiles are
+skipped with pl.when. Fully-masked rows keep l == 0 and are normalized
+to zero output with lse = +inf, so the backward's exp(s - lse)
+vanishes for them (the einsum ring guards the same corner,
+ring_attention.py:47).
+
+Backward is the standard ring recomputation: dq accumulates locally
+per step; dk/dv contributions are computed for the RESIDENT shard and
+rotate along with it — (k, v, dk, dv) make one full loop and arrive
+home after n hops.
+
+No reference counterpart (SURVEY.md §5.7: the reference has no
+long-context support at all).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tf_operator_tpu.ops.flash_attention import (
+    NEG_INF,
+    _compiler_params,
+    _dot,
+    _snap_block,
+    _use_interpret,
+)
+
+POS_INF = 1e30
+
+
+def _global_mask(q_off, k_off, q_start, k_start, blk_q: int, blk_k: int):
+    """[blk_q, blk_k] bool — global q id >= global k id."""
+    q_ids = q_off + q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0)
+    k_ids = k_off + k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 1)
+    return q_ids >= k_ids
+
+
+# ---------------------------------------------------------------- forward
+def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
+                      acc_in, m_out, l_out, acc_out, m_scr, l_scr, acc_scr,
+                      *, causal: bool, scale: float, n_kv: int):
+    blk_q, d = q_ref.shape[1], q_ref.shape[2]
+    blk_k = k_ref.shape[1]
+    j, t = pl.program_id(1), pl.program_id(2)
+    q_start, k_start = j * blk_q, t * blk_k
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = m_in[0]
+        l_scr[:] = l_in[0]
+        acc_scr[:] = acc_in[0]
+
+    if causal:
+        # skip KV tiles whose FIRST global key id is past the last query id
+        live = k_off + k_start <= q_off + q_start + blk_q - 1
+    else:
+        live = t >= 0
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        s = _dot(q, k_ref[0], ((1,), (1,))) * scale  # [blk_q, blk_k] f32
+        if causal:
+            s = jnp.where(
+                _global_mask(q_off, k_off, q_start, k_start, blk_q, blk_k),
+                s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        # rows with nothing visible yet keep m == NEG_INF; exp(s - m) would
+        # be exp(0) = 1 for their masked entries — guard like the einsum
+        # ring (ring_attention.py:47)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[:, None]))
+        corr = jnp.exp(jnp.clip(m_prev - m_new, max=0.0))
+        l_scr[:, 0] = l_prev * corr + jnp.sum(p, axis=1)
+        m_scr[:, 0] = m_new
+        pv = _dot(p.astype(v_ref.dtype), v_ref[0], ((1,), (0,)))
+        acc_scr[:] = acc_scr[:] * corr[:, None] + pv
+
+    @pl.when(t == n_kv - 1)
+    def _finish():
+        m_out[0] = m_scr[:]
+        l_out[0] = l_scr[:]
+        acc_out[0] = acc_scr[:]
+
+
+def _carry_fwd_call(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
+                    blk_q: int, blk_k: int, interpret: bool):
+    """One ring step. q,k,v [BH,S,D]; m,l [BH,S,1] f32; acc [BH,S,D] f32;
+    q_off/k_off [1,1] int32. Returns updated (m, l, acc)."""
+    bh, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    n_kv = s // blk_k
+    grid = (bh, s // blk_q, n_kv)
+    # offsets ride in SMEM: scalars steering control flow/masks belong
+    # there, not in a (1,1) VMEM tile
+    off = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_tile = pl.BlockSpec((1, blk_q, d), lambda i, j, t: (i, j, 0))
+    kv_tile = pl.BlockSpec((1, blk_k, d), lambda i, j, t: (i, t, 0))
+    vec_tile = pl.BlockSpec((1, blk_q, 1), lambda i, j, t: (i, j, 0))
+    return pl.pallas_call(
+        functools.partial(_carry_fwd_kernel, causal=causal, scale=scale,
+                          n_kv=n_kv),
+        grid=grid,
+        in_specs=[off, off, q_tile, kv_tile, kv_tile, vec_tile, vec_tile,
+                  q_tile],
+        out_specs=[vec_tile, vec_tile, q_tile],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=_compiler_params(interpret),
+    )(q_off, k_off, q, k, v, m, l, acc)
+
+
+# --------------------------------------------------------------- backward
+def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dq_ref, dq_scr, *, causal: bool,
+                    scale: float, n_kv: int):
+    blk_q, d = q_ref.shape[1], q_ref.shape[2]
+    blk_k = k_ref.shape[1]
+    j, t = pl.program_id(1), pl.program_id(2)
+    q_start, k_start = j * blk_q, t * blk_k
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+
+    @pl.when(t == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        live = k_off + k_start <= q_off + q_start + blk_q - 1
+    else:
+        live = t >= 0
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        k_tile = k_ref[0]
+        s = _dot(q, k_tile, ((1,), (1,))) * scale
+        if causal:
+            s = jnp.where(
+                _global_mask(q_off, k_off, q_start, k_start, blk_q, blk_k),
+                s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        dp = _dot(do, v_ref[0], ((1,), (1,)))
+        ds = (p * (dp - delta_ref[0, :, 0][:, None])).astype(k_tile.dtype)
+        dq_scr[:] = dq_scr[:] + scale * _dot(ds, k_tile, ((1,), (0,)))
+
+    @pl.when(t == n_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:]
+
+
+def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                     causal: bool, scale: float, n_q: int):
+    blk_k, d = k_ref.shape[1], k_ref.shape[2]
+    blk_q = q_ref.shape[1]
+    t, j = pl.program_id(1), pl.program_id(2)  # t: kv tile, j: streamed q
+    q_start, k_start = j * blk_q, t * blk_k
+    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        live = q_off + q_start + blk_q - 1 >= k_off + k_start
+    else:
+        live = j >= 0
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        k_tile = k_ref[0]
+        s = _dot(q, k_tile, ((1,), (1,))) * scale
+        if causal:
+            s = jnp.where(
+                _global_mask(q_off, k_off, q_start, k_start, blk_q, blk_k),
+                s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        dv_scr[:] = dv_scr[:] + _dot(p.astype(do.dtype), do, ((0,), (0,)))
+        dp = _dot(do, v_ref[0], ((1,), (1,)))
+        ds = (p * (dp - delta_ref[0, :, 0][:, None])).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + scale * _dot(ds, q, ((0,), (0,)))
+
+    @pl.when(j == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+def _bwd_step_call(q, k, v, do, lse, delta, q_off, k_off, *, causal: bool,
+                   blk_q: int, blk_k: int, interpret: bool):
+    """One backward ring step: (dq_contrib, dk_contrib, dv_contrib) of the
+    local q/do against the resident k/v, all f32 [BH,S,D]."""
+    bh, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    n_kv, n_q = s // blk_k, s // blk_q
+    off = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_tile = pl.BlockSpec((1, blk_q, d), lambda i, j, t: (i, j, 0))
+    q_vec = pl.BlockSpec((1, blk_q, 1), lambda i, j, t: (i, j, 0))
+    kv_tile = pl.BlockSpec((1, blk_k, d), lambda i, j, t: (i, t, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_ring_kernel, causal=causal, scale=scale,
+                          n_kv=n_kv),
+        grid=(bh, n_q, n_kv),
+        in_specs=[off, off, q_tile, kv_tile, kv_tile, q_tile, q_vec, q_vec],
+        out_specs=q_tile,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret,
+        compiler_params=_compiler_params(interpret),
+    )(q_off, k_off, q, k, v, do, lse, delta)
+
+    q_stream = pl.BlockSpec((1, blk_q, d), lambda i, t, j: (i, j, 0))
+    qv_stream = pl.BlockSpec((1, blk_q, 1), lambda i, t, j: (i, j, 0))
+    kv_fixed = pl.BlockSpec((1, blk_k, d), lambda i, t, j: (i, t, 0))
+    off2 = pl.BlockSpec(memory_space=pltpu.SMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_ring_kernel, causal=causal, scale=scale,
+                          n_q=n_q),
+        grid=(bh, n_kv, n_q),
+        in_specs=[off2, off2, q_stream, kv_fixed, kv_fixed, q_stream,
+                  qv_stream, qv_stream],
+        out_specs=[kv_fixed, kv_fixed],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=_compiler_params(interpret),
+    )(q_off, k_off, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------- ring
+def _offsets(idx, s_local):
+    return (idx * s_local).astype(jnp.int32).reshape(1, 1)
+
+
+def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
+    """q,k,v [BH, S_l, D] (inside shard_map). Returns (out, lse)."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    bh, s_l, d = q.shape
+    m = jnp.full((bh, s_l, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, s_l, 1), jnp.float32)
+    acc = jnp.zeros((bh, s_l, d), jnp.float32)
+    q_off = _offsets(my, s_l)
+    kv = (k, v)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        src = jax.lax.rem(my - step + n, n)
+
+        def live_step(carry, kv=kv, src=src):
+            m, l, acc = carry
+            return _carry_fwd_call(
+                q, kv[0], kv[1], m, l, acc, q_off, _offsets(src, s_l),
+                causal=causal, blk_q=blk_q, blk_k=blk_k,
+                interpret=interpret)
+
+        if causal and step > 0:
+            # a resident shard entirely in the future (src > my) has every
+            # tile masked — skip the kernel so the (m, l, acc) carry does
+            # not round-trip HBM for zero work (~half the causal hops)
+            m, l, acc = jax.lax.cond(
+                src <= my, live_step, lambda c: c, (m, l, acc))
+        else:
+            m, l, acc = live_step((m, l, acc))
+        if step < n - 1:
+            kv = jax.lax.ppermute(kv, axis_name, perm)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)
+    # fully-masked rows: zero output, +inf lse so backward's exp vanishes
+    lse = jnp.where(l == 0.0, POS_INF, m + jnp.log(l_safe))
+    return out, lse  # lse [BH, S_l, 1] — the shape the bwd kernels read
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
+    out, _ = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
+                            interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
+    out, lse = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, res, do):
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    bh, s_l, d = q.shape
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)[:, :, None]
+    lse3 = lse  # already [BH, S_l, 1]
+    q_off = _offsets(my, s_l)
+    dq = jnp.zeros((bh, s_l, d), jnp.float32)
+    # (k, v, dk, dv) rotate together: after n hops every shard has
+    # collected contributions from every q shard and is home again
+    kvg = (k, v, jnp.zeros((bh, s_l, d), jnp.float32),
+           jnp.zeros((bh, s_l, d), jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        src = jax.lax.rem(my - step + n, n)
+        k_res, v_res, dk_res, dv_res = kvg
+
+        def live_step(carry, k_res=k_res, v_res=v_res, src=src):
+            dq, dk_res, dv_res = carry
+            dq_c, dk_c, dv_c = _bwd_step_call(
+                q, k_res, v_res, do, lse3, delta, q_off,
+                _offsets(src, s_l), causal=causal, blk_q=blk_q,
+                blk_k=blk_k, interpret=interpret)
+            return dq + dq_c, dk_res + dk_c, dv_res + dv_c
+
+        if causal and step > 0:
+            # mirror the forward: dead hops (src > my) contribute nothing
+            dq, dk_res, dv_res = jax.lax.cond(
+                src <= my, live_step, lambda c: c, (dq, dk_res, dv_res))
+        else:
+            dq, dk_res, dv_res = live_step((dq, dk_res, dv_res))
+        kvg = jax.lax.ppermute(
+            (k_res, v_res, dk_res, dv_res), axis_name, perm)
+    _, _, dk, dv = kvg
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, causal: bool = False, *,
+                         axis_name: str = "tp", blk_q: int = 512,
+                         blk_k: int = 512,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Sequence-parallel flash attention. Call inside shard_map with
+    q, k, v [B, S_local, H, D] sharded on dim 1 over `axis_name`.
+    Falls back to the einsum ring when S_local has no 128-aligned block."""
+    b, s_l, h, d = q.shape
+    # _snap_block returns s_l itself when s_l <= blk even if unaligned —
+    # a block equal to the full array dim is Mosaic-legal (the documented
+    # "divisible by (8, 128) or equal to the full dim" rule, same contract
+    # the single-chip kernel relies on)
+    bq, bk = _snap_block(blk_q, s_l), _snap_block(blk_k, s_l)
+    if bq is None or bk is None:
+        from tf_operator_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal, axis_name=axis_name)
+    if interpret is None:
+        interpret = _use_interpret()
+
+    def to_bh(x):  # [B,S,H,D] -> [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_l, d)
+
+    out = _ring_flash(to_bh(q), to_bh(k), to_bh(v), causal, axis_name,
+                      bq, bk, bool(interpret))
+    return out.reshape(b, h, s_l, d).transpose(0, 2, 1, 3)
+
+
+def make_ring_flash_attention_fn(mesh: Mesh, axis_name: str = "tp",
+                                 batch_axes=("dp", "fsdp"),
+                                 interpret: Optional[bool] = None):
+    """An attention_fn for models/transformer.TransformerConfig — drop-in
+    for make_ring_attention_fn with the fused per-step kernel."""
+    from tf_operator_tpu.parallel.compat import shard_map
+
+    spec = P(batch_axes, axis_name, None, None)
+
+    def attention_fn(q, k, v, causal: bool) -> jax.Array:
+        inner = functools.partial(
+            ring_flash_attention, causal=causal, axis_name=axis_name,
+            interpret=interpret)
+        return shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )(q, k, v)
+
+    return attention_fn
