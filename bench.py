@@ -346,6 +346,96 @@ def bench_flash_attention(gen: str):
     return results
 
 
+def bench_startup_latency(runs: int = 5):
+    """Operator-path startup latency (the second half of the BASELINE.md
+    metric): time from job-CR creation until (a) the pod object exists,
+    (b) the job carries a Running condition, and (c) the training process
+    emits its first line — measured over the real engine + a subprocess
+    kubelet (runtime/local.py), so the number covers reconcile, env
+    injection, and spawn, not TPU compile time."""
+    import statistics
+
+    from tf_operator_tpu.api import common
+    from tf_operator_tpu.cmd.manager import OperatorManager
+    from tf_operator_tpu.cmd.options import ServerOptions
+    from tf_operator_tpu.k8s.fake import FakeCluster, NotFoundError
+    from tf_operator_tpu.runtime.local import SubprocessKubelet
+    from tf_operator_tpu.sdk.watch import job_state
+
+    def job_doc(i: int):
+        return {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "TFJob",
+            "metadata": {"name": f"lat-{i}", "namespace": "default"},
+            "spec": {"tfReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "tensorflow",
+                    "image": "bench",
+                    "command": ["python", "-c",
+                                "print('first-step', flush=True)"],
+                }]}},
+            }}},
+        }
+
+    pod_s, running_s, first_step_s, failed = [], [], [], 0
+    for i in range(runs):
+        cluster = FakeCluster()
+        kubelet = SubprocessKubelet(cluster)
+        manager = OperatorManager(cluster, ServerOptions())
+        manager.start()
+        # event-driven pod timestamp: polling granularity must not
+        # quantize a single-digit-ms metric
+        stamps = {}
+        cluster.subscribe(
+            "Pod",
+            lambda etype, pod: stamps.setdefault("pod", time.perf_counter())
+            if etype == "ADDED" else None,
+        )
+        try:
+            t0 = time.perf_counter()
+            cluster.create("TFJob", job_doc(i))
+            t_running = t_step = None
+            deadline = t0 + 30.0
+            # fine poll (0.2 ms) for the two states without event hooks
+            while time.perf_counter() < deadline:
+                now = time.perf_counter()
+                state = job_state(cluster.get("TFJob", "default", f"lat-{i}"))
+                if t_running is None and state in (common.JOB_RUNNING,
+                                                   common.JOB_SUCCEEDED):
+                    t_running = now - t0
+                if state == common.JOB_FAILED:
+                    failed += 1  # spawn failure etc. — abort, don't stall
+                    break
+                if t_step is None and "first-step" in cluster.read_pod_log(
+                        "default", f"lat-{i}-worker-0"):
+                    t_step = now - t0
+                if t_running is not None and t_step is not None:
+                    break
+                time.sleep(0.0002)
+        finally:
+            kubelet.stop_all()
+            manager.stop()
+        if "pod" in stamps:
+            pod_s.append(stamps["pod"] - t0)
+        if t_running:
+            running_s.append(t_running)
+        if t_step:
+            first_step_s.append(t_step)
+
+    def med(xs):
+        return round(statistics.median(xs), 4) if xs else None
+
+    return {
+        "runs": runs,
+        "failed_runs": failed,
+        "create_to_pod_s": med(pod_s),
+        "create_to_running_s": med(running_s),
+        "create_to_first_step_s": med(first_step_s),
+    }
+
+
 # ---------------------------------------------------------------- main
 def main() -> int:
     tpu_ok, probe_detail = probe_tpu()
@@ -376,6 +466,11 @@ def main() -> int:
             extra["flash_attention"] = bench_flash_attention(gen)
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    try:
+        extra["startup_latency"] = bench_startup_latency()
+    except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+        extra["startup_latency"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     baseline = REFERENCE_IMG_PER_SEC_PER_CHIP[gen]
     result = {
