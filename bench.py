@@ -358,7 +358,7 @@ def bench_startup_latency(runs: int = 5):
     from tf_operator_tpu.api import common
     from tf_operator_tpu.cmd.manager import OperatorManager
     from tf_operator_tpu.cmd.options import ServerOptions
-    from tf_operator_tpu.k8s.fake import FakeCluster, NotFoundError
+    from tf_operator_tpu.k8s.fake import FakeCluster
     from tf_operator_tpu.runtime.local import SubprocessKubelet
     from tf_operator_tpu.sdk.watch import job_state
 
@@ -406,14 +406,15 @@ def bench_startup_latency(runs: int = 5):
                                                    common.JOB_SUCCEEDED):
                     t_running = now - t0
                 if state == common.JOB_FAILED:
-                    failed += 1  # spawn failure etc. — abort, don't stall
-                    break
+                    break  # spawn failure etc. — counted below, don't stall
                 if t_step is None and "first-step" in cluster.read_pod_log(
                         "default", f"lat-{i}-worker-0"):
                     t_step = now - t0
                 if t_running is not None and t_step is not None:
                     break
                 time.sleep(0.0002)
+            if t_running is None or t_step is None:
+                failed += 1  # JOB_FAILED or deadline expiry (stall) alike
         finally:
             kubelet.stop_all()
             manager.stop()

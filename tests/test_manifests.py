@@ -98,3 +98,14 @@ def test_overlays_reference_base():
         )
         assert any("base" in r for r in kust["resources"])
         assert kust["namespace"]
+
+
+def test_apidoc_in_sync():
+    """docs/api.md must match the CRD schemas (hack/gen_apidoc.py --check),
+    like the CRD-drift check above."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "gen_apidoc.py"),
+         "--check"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
