@@ -413,17 +413,19 @@ def bench_startup_latency(runs: int = 5):
                 if t_running is not None and t_step is not None:
                     break
                 time.sleep(0.0002)
-            if t_running is None or t_step is None:
-                failed += 1  # JOB_FAILED or deadline expiry (stall) alike
         finally:
             kubelet.stop_all()
             manager.stop()
+        if t_running is None or t_step is None:
+            # JOB_FAILED or deadline expiry (stall): count it and drop the
+            # run's partial timestamps so the medians only describe
+            # successful runs
+            failed += 1
+            continue
         if "pod" in stamps:
             pod_s.append(stamps["pod"] - t0)
-        if t_running:
-            running_s.append(t_running)
-        if t_step:
-            first_step_s.append(t_step)
+        running_s.append(t_running)
+        first_step_s.append(t_step)
 
     def med(xs):
         return round(statistics.median(xs), 4) if xs else None

@@ -94,10 +94,26 @@ def _check_supported(cfg: TransformerConfig) -> None:
         )
 
 
-def stage_param_specs() -> Dict:
+# per stage-leaf: the dim (in STACKED [pp, L, ...] coordinates) that fsdp
+# shards — the model dim E everywhere; ln scales are too small to bother
+_FSDP_DIMS = {"qkv": 2, "out": 4, "wi": 2, "wo": 3, "ln1": None, "ln2": None}
+
+
+def stage_param_specs(fsdp: bool = False) -> Dict:
     """PartitionSpec pytree for params['stages']: stage dim over 'pp',
-    head/ffn dims over 'tp' (column-parallel qkv/wi, row-parallel out/wo)."""
-    return {
+    head/ffn dims over 'tp' (column-parallel qkv/wi, row-parallel out/wo),
+    and optionally the model dim over 'fsdp' (gathered per stage —
+    _gather_stage)."""
+
+    def with_fsdp(name: str, spec: P) -> P:
+        d = _FSDP_DIMS.get(name)
+        if not fsdp or d is None:
+            return spec
+        parts = list(spec) + [None] * (d + 1 - len(spec))
+        parts[d] = "fsdp"
+        return P(*parts)
+
+    base = {
         "ln1": P("pp", None, None),
         "qkv": P("pp", None, None, None, "tp", None),
         "out": P("pp", None, "tp", None, None),
@@ -105,15 +121,35 @@ def stage_param_specs() -> Dict:
         "wi": P("pp", None, None, "tp"),
         "wo": P("pp", None, "tp", None),
     }
+    return {k: with_fsdp(k, v) for k, v in base.items()}
 
 
-def param_shardings(params: Dict, mesh: Mesh) -> Dict:
+def _gather_stage(params: Dict) -> Dict:
+    """Manual FSDP inside shard_map: all-gather each fsdp-sharded leaf
+    back to full size before the stage computes (dims shift by -1: gpipe
+    already stripped the leading pp dim). Autodiff transposes the gather
+    to a reduce-scatter of the grads — the textbook FSDP backward."""
+    out = {}
+    for name, leaf in params.items():
+        d = _FSDP_DIMS.get(name)
+        if d is None:
+            out[name] = leaf
+        else:
+            out[name] = jax.lax.all_gather(
+                leaf, "fsdp", axis=d - 1, tiled=True)
+    return out
+
+
+def param_shardings(params: Dict, mesh: Mesh,
+                    fsdp: Optional[bool] = None) -> Dict:
     """NamedSharding pytree for the whole param tree (GSPMD placement of
     the jit inputs; the pipeline's shard_map re-interprets the stage leaves
-    with the same specs)."""
+    with the same specs). fsdp defaults to mesh['fsdp'] > 1."""
+    if fsdp is None:
+        fsdp = mesh.shape.get("fsdp", 1) > 1
     stage_specs = jax.tree.map(
         lambda s: NamedSharding(mesh, s),
-        stage_param_specs(),
+        stage_param_specs(fsdp=fsdp),
         is_leaf=lambda x: isinstance(x, P),
     )
     rep = NamedSharding(mesh, P())
@@ -183,15 +219,26 @@ def make_pipelined_apply(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
     scan+ppermute transposes to the reverse schedule)."""
     _check_supported(cfg)
     tp = mesh.shape.get("tp", 1)
+    fsdp = mesh.shape.get("fsdp", 1) > 1
     tp_axis = "tp" if tp > 1 else None
     if cfg.n_heads % tp or cfg.d_ff % tp:
         raise ValueError(
             f"tp {tp} must divide n_heads {cfg.n_heads} and d_ff {cfg.d_ff}"
         )
-    stage_fn = functools.partial(_stage_fn, causal=cfg.causal, tp_axis=tp_axis)
+    if fsdp and cfg.d_model % mesh.shape["fsdp"]:
+        raise ValueError(
+            f"fsdp {mesh.shape['fsdp']} must divide d_model {cfg.d_model}"
+        )
+    base_stage = functools.partial(_stage_fn, causal=cfg.causal,
+                                   tp_axis=tp_axis)
+    if fsdp:
+        def stage_fn(p, x):
+            return base_stage(_gather_stage(p), x)
+    else:
+        stage_fn = base_stage
     run = make_pipeline_fn(
         mesh, stage_fn, n_micro, axis_name="pp",
-        param_specs=stage_param_specs(), batch_axes=("dp", "fsdp"),
+        param_specs=stage_param_specs(fsdp=fsdp), batch_axes=("dp", "fsdp"),
     )
 
     def apply(params: Dict, tokens: jax.Array) -> jax.Array:
