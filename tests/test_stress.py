@@ -192,3 +192,57 @@ def test_rapid_status_updates_single_writer(stress_env):
         f"converged to {final}",
     )
     assert auditor.violations == []
+
+
+def test_chaos_random_kills_converge(stress_env):
+    """Chaos fault injection (the reference's explicit TODO,
+    test_runner.py:43): random retryable kills across jobs with BOTH
+    restart models — OnFailure (kubelet restarts in place) and ExitCode
+    (operator delete-for-recreate) — must converge back to full healthy
+    replica sets with no duplicate-index violations and no job Failed."""
+    import random
+
+    from tf_operator_tpu.k8s.fake import NotFoundError
+
+    cluster, mgr, kubelet, client, auditor = stress_env
+    rnd = random.Random(42)
+    n_jobs, n_workers = 4, 3
+    for i in range(n_jobs):
+        job = testutil.new_tfjob(f"chaos-{i}", worker=n_workers)
+        policy = "OnFailure" if i % 2 == 0 else "ExitCode"
+        for spec in job.replica_specs.values():
+            spec.restart_policy = policy
+        client.create(job)
+
+    def all_running():
+        for i in range(n_jobs):
+            names = client.get_pod_names(f"chaos-{i}")
+            if len(names) != n_workers:
+                return False
+            for name in names:
+                try:
+                    pod = cluster.get_pod("default", name)
+                except NotFoundError:
+                    return False  # deleted-for-recreate mid-poll
+                if pod["status"].get("phase") != "Running":
+                    return False
+        return True
+
+    _wait(all_running, "all chaos pods running")
+
+    # 137 (SIGKILL class) is retryable under both policies
+    for _ in range(3 * n_jobs):
+        name = f"chaos-{rnd.randrange(n_jobs)}-worker-{rnd.randrange(n_workers)}"
+        try:
+            kubelet.terminate_replica("default", name, exit_code=137)
+        except Exception:  # noqa: BLE001 — pod mid-restart IS the chaos
+            pass
+        time.sleep(0.05)
+
+    _wait(all_running, "jobs recovered from chaos", timeout=60.0)
+    assert auditor.violations == []
+    for i in range(n_jobs):
+        status = client.get(f"chaos-{i}").get("status", {})
+        conds = [c["type"] for c in status.get("conditions", [])
+                 if c.get("status") == "True"]
+        assert "Failed" not in conds, (i, conds)
