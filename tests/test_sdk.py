@@ -99,3 +99,50 @@ def test_sdk_round_trip_e2e():
     finally:
         kubelet.stop_all()
         mgr.stop()
+
+
+def test_stream_logs_follows_until_terminal():
+    """stream_logs yields lines incrementally across pods and stops after
+    the job goes terminal with the tail drained (reference get_logs
+    follow mode)."""
+    import threading
+    import time as _time
+
+    from tf_operator_tpu.api import common
+    from tf_operator_tpu.api import tensorflow as tfapi
+    from tf_operator_tpu.controllers.registry import make_engine
+
+    cluster = FakeCluster()
+    client = TFJobClient(cluster)
+    client.create(testutil.new_tfjob("streamy", worker=2))
+    engine = make_engine("TFJob", cluster)
+    job = tfapi.TFJob.from_dict(cluster.get("TFJob", "default", "streamy"))
+    engine.reconcile(job)
+
+    cluster.append_pod_log("default", "streamy-worker-0", "w0 line1")
+
+    def writer():
+        _time.sleep(0.15)
+        cluster.append_pod_log("default", "streamy-worker-1", "w1 line1")
+        cluster.append_pod_log("default", "streamy-worker-0", "w0 line2")
+        _time.sleep(0.1)
+        # the tail line must land BEFORE the terminal flip: stream_logs
+        # guarantees one final drain after seeing the terminal condition,
+        # not delivery of lines appended after it
+        cluster.append_pod_log("default", "streamy-worker-1", "w1 final")
+        cr = cluster.get("TFJob", "default", "streamy")
+        cr.setdefault("status", {})["conditions"] = [
+            {"type": common.JOB_SUCCEEDED, "status": "True"}
+        ]
+        cluster.update("TFJob", cr)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    got = list(client.stream_logs("streamy", poll=0.05))
+    t.join()
+    assert ("streamy-worker-0", "w0 line1") in got
+    assert ("streamy-worker-0", "w0 line2") in got
+    assert ("streamy-worker-1", "w1 line1") in got
+    assert ("streamy-worker-1", "w1 final") in got  # terminal tail drained
+    # incremental: no duplicates
+    assert len(got) == len(set(got))

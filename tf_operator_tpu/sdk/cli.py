@@ -134,8 +134,19 @@ class Cli:
         return 0
 
     def logs(self, kind: str, name: str, namespace: str,
-             replica_type: Optional[str], index: Optional[int]) -> int:
-        out = self.client(kind).get_logs(
+             replica_type: Optional[str], index: Optional[int],
+             follow: bool = False) -> int:
+        client = self.client(kind)
+        if follow:
+            # kubectl-logs -f style: stream merged lines, pod-prefixed,
+            # until the job reaches a terminal condition; flush per line
+            # so `logs -f | tee` follows in real time
+            for pod, line in client.stream_logs(
+                    name, namespace=namespace, replica_type=replica_type,
+                    replica_index=index):
+                print(f"[{pod}] {line}", flush=True)
+            return 0
+        out = client.get_logs(
             name, namespace=namespace, replica_type=replica_type,
             replica_index=index,
         )
@@ -213,6 +224,8 @@ def make_parser() -> argparse.ArgumentParser:
         if verb in ("pods", "logs"):
             pv.add_argument("--replica-type", default=None)
             pv.add_argument("--index", type=int, default=None)
+        if verb == "logs":
+            pv.add_argument("-f", "--follow", action="store_true")
 
     pl = sub.add_parser("list", parents=[common])
     pl.add_argument("kind")
@@ -235,7 +248,8 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
     if args.verb == "pods":
         return cli.pods(kind, args.name, ns, args.replica_type, args.index)
     if args.verb == "logs":
-        return cli.logs(kind, args.name, ns, args.replica_type, args.index)
+        return cli.logs(kind, args.name, ns, args.replica_type, args.index,
+                        follow=args.follow)
     if args.verb == "delete":
         return cli.delete(kind, args.name, ns)
     raise SystemExit(f"unknown verb {args.verb}")
@@ -258,6 +272,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             yaml.YAMLError) as e:  # bad kubeconfig / malformed job YAML
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except KeyboardInterrupt:  # Ctrl-C out of `logs -f` / `wait`: clean exit
+        print(file=sys.stderr)
+        return 130
 
 
 if __name__ == "__main__":
