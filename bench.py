@@ -188,54 +188,68 @@ def bench_transformer(gen: str, n_chips: int):
     on_cpu = gen == "cpu"
     if on_cpu:
         cfg = tfm.tiny(max_len=128)
-        batch, steps, warmup = 4, 3, 1
+        batches, steps, warmup = (4,), 3, 1
     else:
         cfg = tfm.bert_large()
-        batch, steps, warmup = 8, 10, 3
-    batch *= n_chips
+        batches, steps, warmup = (8, 16), 10, 3
     mesh = make_mesh({"dp": n_chips})
-
     model = tfm.Transformer(cfg)
-    rng = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(rng, (batch, cfg.max_len), 0, cfg.vocab_size)
-    tokens = jax.device_put(tokens, batch_sharding(mesh))
-    params = model.init(rng, tokens, train=False)["params"]
-    tx = optax.sgd(1e-2)
-    opt_state = tx.init(params)
-
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: tfm.lm_train_loss(model, p, tokens)
-        )(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    step = jax.jit(train_step, donate_argnums=(0, 1))
-    for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    float(jax.device_get(loss))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec_per_chip = steps * batch * cfg.max_len / dt / n_chips
     flops_per_token = tfm.params_flops_per_token(cfg)
     peak = PEAK_FLOPS_PER_CHIP.get(gen)
-    return {
-        "config": "bert_large" if not on_cpu else "tiny",
-        "batch": batch,
-        "seq_len": cfg.max_len,
-        "steps": steps,
-        "tokens_per_sec_per_chip": round(tokens_per_sec_per_chip, 1),
-        "flops_per_token": flops_per_token,
-        "mfu": (
-            round(tokens_per_sec_per_chip * flops_per_token / peak, 4)
-            if peak
-            else None
-        ),
-    }
+
+    def run_one(batch):
+        rng = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(
+            rng, (batch, cfg.max_len), 0, cfg.vocab_size)
+        tokens = jax.device_put(tokens, batch_sharding(mesh))
+        params = model.init(rng, tokens, train=False)["params"]
+        tx = optax.sgd(1e-2)
+        opt_state = tx.init(params)
+
+        def train_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: tfm.lm_train_loss(model, p, tokens)
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(jax.device_get(loss))
+        dt = time.perf_counter() - t0
+        return steps * batch * cfg.max_len / dt / n_chips
+
+    # sweep per-chip batch sizes and keep the best (larger batches lift
+    # MFU until HBM runs out — only an OOM ends the sweep benignly; any
+    # other failure propagates like it did pre-sweep)
+    best, best_tps = None, 0.0
+    for b in batches:
+        try:
+            tps = run_one(b * n_chips)
+        except Exception as e:  # noqa: BLE001 — classify below
+            if best is not None and "RESOURCE_EXHAUSTED" in str(e).upper():
+                best["sweep_stopped"] = f"b{b * n_chips}: {type(e).__name__}"
+                break
+            raise
+        if best is None or tps > best_tps:
+            best_tps = tps
+            best = {
+                "config": "bert_large" if not on_cpu else "tiny",
+                "batch": b * n_chips,
+                "seq_len": cfg.max_len,
+                "steps": steps,
+                "tokens_per_sec_per_chip": round(tps, 1),
+                "flops_per_token": flops_per_token,
+                "mfu": (
+                    round(tps * flops_per_token / peak, 4) if peak else None
+                ),
+            }
+    return best
 
 
 

@@ -47,26 +47,31 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
 
+def _config(base: dict, kw: dict) -> TransformerConfig:
+    base.update(kw)  # factory defaults are overridable, never conflicting
+    return TransformerConfig(**base)
+
+
 def bert_large(**kw) -> TransformerConfig:
-    return TransformerConfig(
+    return _config(dict(
         vocab_size=30522, d_model=1024, n_heads=16, n_layers=24,
-        d_ff=4096, max_len=512, causal=False, **kw,
-    )
+        d_ff=4096, max_len=512, causal=False,
+    ), kw)
 
 
 def t5_3b_decoder(**kw) -> TransformerConfig:
     """Decoder-LM stand-in at T5-3B scale (config #5)."""
-    return TransformerConfig(
+    return _config(dict(
         vocab_size=32128, d_model=2048, n_heads=32, n_layers=48,
-        d_ff=8192, max_len=512, causal=True, **kw,
-    )
+        d_ff=8192, max_len=512, causal=True,
+    ), kw)
 
 
 def tiny(**kw) -> TransformerConfig:
-    return TransformerConfig(
+    return _config(dict(
         vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
-        max_len=64, **kw,
-    )
+        max_len=64,
+    ), kw)
 
 
 def dot_product_attention(q, k, v, causal: bool) -> jax.Array:
