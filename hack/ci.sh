@@ -20,6 +20,7 @@ python hack/gen_apidoc.py --check
 stage "manifests: overlays render (hermetic kustomize)"
 python hack/release.py render --overlay standalone > /dev/null
 python hack/release.py render --overlay kubeflow > /dev/null
+python hack/release.py render --overlay webhook > /dev/null
 
 stage "unit + controller + numerics"
 python -m pytest tests/ -q -x --ignore=tests/test_e2e.py \

@@ -38,7 +38,7 @@ def main(argv=None) -> int:
 
     pv = sub.add_parser("render")
     pv.add_argument("--overlay", default="standalone",
-                    choices=("standalone", "kubeflow"))
+                    choices=("standalone", "kubeflow", "webhook"))
     pv.add_argument("--image", default=None)
 
     pc = sub.add_parser("cluster")

@@ -134,3 +134,32 @@ def test_global_flags_after_verb():
     args = make_parser().parse_args(
         ["submit", "job.yaml", "--kubeconfig", "/tmp/kc"])
     assert args.kubeconfig == "/tmp/kc"
+
+
+def test_suspend_resume_verbs(tmp_path, capsys):
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    assert _invoke(cli, ["submit", str(path)]) == 0
+    engine = make_engine("TFJob", cli.cluster)
+
+    def sync():
+        from tf_operator_tpu.api import tensorflow as tfapi
+
+        engine.reconcile(tfapi.TFJob.from_dict(
+            cli.cluster.get("TFJob", "default", "mnist")))
+
+    sync()
+    assert len(cli.cluster.list_pods()) == 2
+
+    assert _invoke(cli, ["suspend", "tfjob", "mnist"]) == 0
+    assert "suspended" in capsys.readouterr().out
+    sync()
+    assert cli.cluster.list_pods() == []
+    job = cli.cluster.get("TFJob", "default", "mnist")
+    assert job["spec"]["runPolicy"]["suspend"] is True
+
+    assert _invoke(cli, ["resume", "tfjob", "mnist"]) == 0
+    assert "resumed" in capsys.readouterr().out
+    sync()
+    assert len(cli.cluster.list_pods()) == 2

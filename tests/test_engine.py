@@ -539,3 +539,92 @@ def test_stale_incarnation_service_not_claimed():
     # the stale service keeps its original owner untouched
     svc = cluster.list_services()[0]
     assert objects.get_controller_of(svc)["uid"] == "old-incarnation-uid"
+
+
+# ---------------------------------------------------------------------------
+# suspend / resume (modern training-operator semantics — no reference
+# counterpart; the snapshot predates RunPolicy.suspend)
+# ---------------------------------------------------------------------------
+
+
+def _set_suspend(cluster, job, value):
+    doc = cluster.get(job.kind, job.namespace, job.name)
+    doc.setdefault("spec", {}).setdefault("runPolicy", {})["suspend"] = value
+    cluster.update(job.kind, doc)
+
+
+def test_suspend_tears_down_and_resume_recreates():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    for p in cluster.list_pods():
+        set_phase(cluster, p, objects.POD_RUNNING)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_running(job.status)
+    assert len(cluster.list_pods()) == 2 and len(cluster.list_services()) == 2
+
+    _set_suspend(cluster, job, True)
+    job, _ = reconcile(cluster, engine, job)
+    assert cluster.list_pods() == [] and cluster.list_services() == []
+    assert common.is_suspended(job.status)
+    assert not common.is_running(job.status)  # demoted, not dropped
+    assert common.get_condition(job.status, common.JOB_RUNNING).status == "False"
+    assert job.status.start_time is None
+    assert job.status.replica_statuses["Worker"].active == 0
+    assert [e for e in cluster.events_for(job.name)
+            if e["reason"] == "JobSuspended"]
+
+    # idempotent: a second suspended reconcile emits no duplicate event
+    job, _ = reconcile(cluster, engine, job)
+    assert len([e for e in cluster.events_for(job.name)
+                if e["reason"] == "JobSuspended"]) == 1
+
+    _set_suspend(cluster, job, False)
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 2 and len(cluster.list_services()) == 2
+    cond = common.get_condition(job.status, common.JOB_SUSPENDED)
+    assert cond.status == "False" and cond.reason == "JobResumed"
+    assert job.status.start_time is not None
+    assert [e for e in cluster.events_for(job.name)
+            if e["reason"] == "JobResumed"]
+
+
+def test_suspend_resets_active_deadline_clock():
+    """batch/v1 Job semantics: suspension stops the ActiveDeadlineSeconds
+    clock; the deadline restarts from resume time."""
+    clock = Clock()
+    cluster, engine = setup_engine(clock=clock)
+    job = testutil.new_tfjob(worker=1)
+    job.run_policy.active_deadline_seconds = 100
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+
+    clock.advance(90)
+    _set_suspend(cluster, job, True)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_suspended(job.status)
+
+    clock.advance(50)  # 140s since creation — past the original deadline
+    _set_suspend(cluster, job, False)
+    job, _ = reconcile(cluster, engine, job)
+    assert not common.is_failed(job.status)  # clock restarted at resume
+    assert job.status.start_time is not None
+
+    clock.advance(101)  # now past the post-resume deadline
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_failed(job.status)
+
+
+def test_suspend_preserves_exit_code_restart_counter():
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=1)
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_EXIT_CODE
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    set_phase(cluster, cluster.list_pods()[0], objects.POD_FAILED, exit_code=137)
+    job, _ = reconcile(cluster, engine, job)  # delete-for-recreate: restarts=1
+    assert job.status.replica_statuses["Worker"].restarts == 1
+
+    _set_suspend(cluster, job, True)
+    job, _ = reconcile(cluster, engine, job)
+    assert job.status.replica_statuses["Worker"].restarts == 1

@@ -98,6 +98,11 @@ def test_overlays_reference_base():
         )
         assert any("base" in r for r in kust["resources"])
         assert kust["namespace"]
+    # webhook stacks on standalone (which carries the namespace + base)
+    (kust,) = _load(
+        os.path.join(REPO, "manifests", "overlays", "webhook", "kustomization.yaml")
+    )
+    assert any("standalone" in r for r in kust["resources"])
 
 
 def test_apidoc_in_sync():

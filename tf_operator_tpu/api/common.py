@@ -30,12 +30,15 @@ CLEAN_POD_POLICY_ALL = "All"
 CLEAN_POD_POLICY_RUNNING = "Running"
 CLEAN_POD_POLICY_NONE = "None"
 
-# Job condition types (reference swagger.json JobConditionType)
+# Job condition types (reference swagger.json JobConditionType; Suspended
+# follows the modern training-operator / batch.v1 Job suspend semantics —
+# the reference snapshot predates it)
 JOB_CREATED = "Created"
 JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
+JOB_SUSPENDED = "Suspended"
 
 
 def is_retryable_exit_code(exit_code: int) -> bool:
@@ -89,6 +92,10 @@ class RunPolicy:
     active_deadline_seconds: Optional[int] = None
     backoff_limit: Optional[int] = None
     scheduling_policy: Optional[SchedulingPolicy] = None
+    # suspend=true tears the job's pods down and halts reconciliation until
+    # resumed (modern training-operator semantics, absent in the reference
+    # snapshot); the ActiveDeadlineSeconds clock resets on resume.
+    suspend: Optional[bool] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -102,6 +109,8 @@ class RunPolicy:
             d["backoffLimit"] = self.backoff_limit
         if self.scheduling_policy is not None:
             d["schedulingPolicy"] = self.scheduling_policy.to_dict()
+        if self.suspend is not None:
+            d["suspend"] = self.suspend
         return d
 
     @classmethod
@@ -113,6 +122,7 @@ class RunPolicy:
             active_deadline_seconds=d.get("activeDeadlineSeconds"),
             backoff_limit=d.get("backoffLimit"),
             scheduling_policy=SchedulingPolicy.from_dict(d.get("schedulingPolicy")),
+            suspend=d.get("suspend"),
         )
 
 
@@ -272,6 +282,32 @@ def is_running(status: JobStatus) -> bool:
     return has_condition(status, JOB_RUNNING)
 
 
+def is_suspended(status: JobStatus) -> bool:
+    return has_condition(status, JOB_SUSPENDED)
+
+
+def demote_condition(
+    status: JobStatus,
+    cond_type: str,
+    now: str,
+    reason: Optional[str] = None,
+    message: Optional[str] = None,
+) -> None:
+    """Flip a True condition to False (optionally restating reason/message),
+    bumping both timestamps — the single implementation behind condition
+    mutual exclusion and explicit demotions like suspend -> resume."""
+    cond = get_condition(status, cond_type)
+    if cond is None or cond.status != "True":
+        return
+    cond.status = "False"
+    if reason is not None:
+        cond.reason = reason
+    if message is not None:
+        cond.message = message
+    cond.last_update_time = now
+    cond.last_transition_time = now
+
+
 def update_job_conditions(
     status: JobStatus, cond_type: str, reason: str, message: str, now: str
 ) -> None:
@@ -306,16 +342,18 @@ def update_job_conditions(
 
     # mutual exclusion: Running <-> Restarting; terminal conditions demote both
     def _demote(t: str) -> None:
-        c = get_condition(status, t)
-        if c is not None and c.status == "True" and c.type != cond_type:
-            c.status = "False"
-            c.last_update_time = now
-            c.last_transition_time = now
+        if t != cond_type:
+            demote_condition(status, t, now)
 
     if cond_type == JOB_RUNNING:
         _demote(JOB_RESTARTING)
+        _demote(JOB_SUSPENDED)
     elif cond_type == JOB_RESTARTING:
         _demote(JOB_RUNNING)
+    elif cond_type == JOB_SUSPENDED:
+        _demote(JOB_RUNNING)
+        _demote(JOB_RESTARTING)
     elif cond_type in (JOB_SUCCEEDED, JOB_FAILED):
         _demote(JOB_RUNNING)
         _demote(JOB_RESTARTING)
+        _demote(JOB_SUSPENDED)

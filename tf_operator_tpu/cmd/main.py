@@ -76,6 +76,20 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
     metrics_srv.start()
     log.info("metrics on :%d", metrics_srv.port)
 
+    webhook_srv = None
+    if options.webhook_bind_address:
+        from tf_operator_tpu.cmd.webhook import WebhookServer
+
+        wh_host, wh_port = split_bind_address(options.webhook_bind_address)
+        webhook_srv = WebhookServer(
+            host=wh_host,
+            port=wh_port,
+            cert_file=options.webhook_cert_file or None,
+            key_file=options.webhook_key_file or None,
+        )
+        webhook_srv.start()
+        log.info("admission webhooks on :%d", webhook_srv.port)
+
     stop_event = threading.Event()
 
     def start_manager():
@@ -102,10 +116,13 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         manager.stop()
         probe.stop()
         metrics_srv.stop()
+        if webhook_srv is not None:
+            webhook_srv.stop()
     else:
         # keep handles for the caller to stop
         manager._probe = probe
         manager._metrics_srv = metrics_srv
+        manager._webhook_srv = webhook_srv
     return manager
 
 

@@ -32,6 +32,10 @@ class ServerOptions:
     enabled_schemes: EnabledSchemes = field(default_factory=EnabledSchemes)
     kubeconfig: str = ""
     print_version: bool = False
+    # admission webhooks (cmd/webhook.py); empty address = disabled
+    webhook_bind_address: str = ""
+    webhook_cert_file: str = ""
+    webhook_key_file: str = ""
 
     @property
     def all_kinds(self) -> List[str]:
@@ -74,6 +78,14 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         help=f"enable a job kind (repeatable); default all: {sorted(SUPPORTED_ADAPTERS)}",
     )
     p.add_argument("--kubeconfig", default="")
+    p.add_argument(
+        "--webhook-bind-address",
+        default="",
+        help="serve admission webhooks (/validate, /mutate) here, "
+        "e.g. ':9443'; empty disables",
+    )
+    p.add_argument("--webhook-cert-file", default="")
+    p.add_argument("--webhook-key-file", default="")
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -97,4 +109,7 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         enabled_schemes=schemes,
         kubeconfig=a.kubeconfig,
         print_version=a.print_version,
+        webhook_bind_address=a.webhook_bind_address,
+        webhook_cert_file=a.webhook_cert_file,
+        webhook_key_file=a.webhook_key_file,
     )

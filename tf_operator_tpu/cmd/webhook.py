@@ -1,0 +1,193 @@
+"""Admission webhooks — validating + defaulting, over the api layer.
+
+The reference snapshot validates only inside the controller (a bad spec is
+admitted, then reconciled into a Failed condition — reference
+pkg/apis/tensorflow/validation/validation.go:27 called from
+tfjob_controller.go:129).  The modern training-operator moved validation
+into admission webhooks so bad specs are rejected at `kubectl apply` time;
+this module provides that upgrade for all five kinds, reusing the exact
+same `adapter.set_defaults`/`adapter.validate` code paths the engine runs,
+so webhook and controller can never disagree.
+
+Endpoints (AdmissionReview v1, admission.k8s.io):
+  POST /validate  -> allowed / denied(message)   [ValidatingWebhookConfiguration]
+  POST /mutate    -> JSONPatch applying API defaults  [MutatingWebhookConfiguration]
+
+TLS: the apiserver requires https; pass cert_file/key_file (e.g. mounted
+from a cert-manager Certificate).  Tests and local runs may serve plain
+HTTP by omitting them.
+"""
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from tf_operator_tpu.api.job import ValidationError
+from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS
+
+
+def review_response(
+    review: Dict[str, Any],
+    allowed: bool,
+    message: str = "",
+    patch: Optional[list] = None,
+) -> Dict[str, Any]:
+    """Build the AdmissionReview reply: echo apiVersion/kind/request.uid,
+    carry allowed (+ status message on deny, + base64 JSONPatch on mutate)."""
+    resp: Dict[str, Any] = {
+        "uid": (review.get("request") or {}).get("uid", ""),
+        "allowed": allowed,
+    }
+    if message:
+        resp["status"] = {"message": message}
+    if patch is not None:
+        resp["patchType"] = "JSONPatch"
+        resp["patch"] = base64.b64encode(json.dumps(patch).encode()).decode()
+    return {
+        "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+        "kind": review.get("kind", "AdmissionReview"),
+        "response": resp,
+    }
+
+
+def _adapter_for(review: Dict[str, Any]):
+    req = review.get("request") or {}
+    kind = ((req.get("kind") or {}).get("kind")) or (
+        (req.get("object") or {}).get("kind")
+    )
+    if not kind:
+        return None, None
+    adapter_cls = next(
+        (a for k, a in SUPPORTED_ADAPTERS.items() if k.lower() == kind.lower()),
+        None,
+    )
+    return kind, (adapter_cls() if adapter_cls else None)
+
+
+def validate_review(review: Dict[str, Any]) -> Dict[str, Any]:
+    """Run the kind's set_defaults+validate against request.object.
+    DELETE (no object) and unknown kinds are allowed through — the webhook
+    configuration scopes which kinds reach us; failing open on them would
+    otherwise brick unrelated applies under failurePolicy: Fail."""
+    req = review.get("request") or {}
+    obj = req.get("object")
+    if obj is None:
+        return review_response(review, True)
+    kind, adapter = _adapter_for(review)
+    if adapter is None:
+        return review_response(
+            review, True, message=f"kind {kind!r} not handled; allowed"
+        )
+    try:
+        job = adapter.from_dict(copy.deepcopy(obj))
+        adapter.set_defaults(job)
+        adapter.validate(job)
+    except ValidationError as e:
+        return review_response(review, False, message=str(e))
+    except Exception as e:  # malformed metadata/spec shapes
+        return review_response(
+            review, False, message=f"malformed {kind}: {type(e).__name__}: {e}"
+        )
+    return review_response(review, True)
+
+
+def mutate_review(review: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply API defaults (port injection, replica counts, restart policies,
+    replica-type case normalization) as a JSONPatch, so stored objects are
+    fully defaulted instead of defaulted in-memory per reconcile like the
+    reference (defaults.go:94 applied at tfjob_controller.go:149)."""
+    req = review.get("request") or {}
+    obj = req.get("object")
+    if obj is None:
+        return review_response(review, True)
+    kind, adapter = _adapter_for(review)
+    if adapter is None:
+        return review_response(review, True)
+    try:
+        job = adapter.from_dict(copy.deepcopy(obj))
+        adapter.set_defaults(job)
+        defaulted = job.to_dict()
+    except Exception as e:  # defaulting must never block admission
+        return review_response(
+            review, True, message=f"defaulting skipped: {type(e).__name__}: {e}"
+        )
+    patch = []
+    if defaulted.get("spec") != obj.get("spec"):
+        patch.append(
+            {"op": "replace" if "spec" in obj else "add",
+             "path": "/spec", "value": defaulted.get("spec")}
+        )
+    return review_response(review, True, patch=patch if patch else None)
+
+
+ROUTES = {"/validate": validate_review, "/mutate": mutate_review}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    def do_POST(self):  # noqa: N802 (stdlib API name)
+        handler = ROUTES.get(self.path.split("?")[0])
+        if handler is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            review = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(review, dict):
+                raise ValueError("request body is not an AdmissionReview object")
+            body = json.dumps(handler(review)).encode()
+        except Exception as e:  # noqa: BLE001 — any malformed body -> 400,
+            # never an unanswered connection (failurePolicy: Fail would turn
+            # a handler crash into an opaque cluster-wide apply error)
+            self.send_response(400)
+            self.send_header("Content-Type", "text/plain")
+            self.end_headers()
+            self.wfile.write(str(e).encode())
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class WebhookServer:
+    """Serves /validate and /mutate; https when cert/key are given.
+    Bind port 0 for an ephemeral port (tests read .port after start)."""
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        cert_file: Optional[str] = None,
+        key_file: Optional[str] = None,
+    ) -> None:
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        if cert_file and key_file:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_file, key_file)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

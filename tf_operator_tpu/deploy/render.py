@@ -3,7 +3,8 @@ one YAML stream without the kustomize binary.
 
 Supports the subset our manifests use (and validates it's only that
 subset): `resources` (files or directories containing kustomization.yaml),
-`namespace`, `commonLabels`, and `images` name/newName/newTag overrides.
+`namespace`, `commonLabels`, `images` name/newName/newTag overrides, and
+`patches` (strategic-merge patch files with a kind/name target).
 The reference relies on `kubectl kustomize` (README.md:24); shipping the
 renderer keeps deploy tooling and tests hermetic."""
 from __future__ import annotations
@@ -15,12 +16,15 @@ import yaml
 
 SUPPORTED_KEYS = {
     "apiVersion", "kind", "resources", "namespace", "commonLabels", "images",
+    "patches",
 }
 
 # cluster-scoped kinds never get a namespace stamped on them
 CLUSTER_SCOPED = {
     "Namespace", "CustomResourceDefinition", "ClusterRole",
     "ClusterRoleBinding", "PriorityClass", "StorageClass",
+    "ValidatingWebhookConfiguration", "MutatingWebhookConfiguration",
+    "ClusterIssuer",
 }
 
 
@@ -68,7 +72,59 @@ def render_kustomization(path: str) -> List[Dict[str, Any]]:
             _label_selectors_and_templates(d, labels)
     for img in kust.get("images", []) or []:
         _override_image(docs, img)
+    for patch in kust.get("patches", []) or []:
+        _apply_patch(docs, patch, path)
     return docs
+
+
+def _strategic_merge(base: Any, patch: Any) -> Any:
+    """Strategic-merge subset: dicts merge per key; lists whose elements all
+    carry a `name` merge by it (k8s patchMergeKey for containers/ports/
+    volumes/env); other lists replace."""
+    if isinstance(base, dict) and isinstance(patch, dict):
+        out = dict(base)
+        for k, v in patch.items():
+            out[k] = _strategic_merge(out[k], v) if k in out else v
+        return out
+    if isinstance(base, list) and isinstance(patch, list):
+        if base and patch and all(
+            isinstance(x, dict) and "name" in x for x in base + patch
+        ):
+            merged = {x["name"]: x for x in base}
+            order = [x["name"] for x in base]
+            for p in patch:
+                n = p["name"]
+                if n in merged:
+                    merged[n] = _strategic_merge(merged[n], p)
+                else:
+                    order.append(n)
+                    merged[n] = p
+            return [merged[n] for n in order]
+        return patch
+    return patch
+
+
+def _apply_patch(
+    docs: List[Dict[str, Any]], patch: Dict[str, Any], base_dir: str
+) -> None:
+    """kustomize `patches` entry: strategic-merge the patch file onto every
+    doc matching the kind/name target (the subset our overlays use)."""
+    ppath = os.path.normpath(os.path.join(base_dir, patch["path"]))
+    patch_docs = _load_yaml_docs(ppath)
+    target = patch.get("target") or {}
+    matched = False
+    for pdoc in patch_docs:
+        t_kind = target.get("kind") or pdoc.get("kind")
+        t_name = target.get("name") or pdoc.get("metadata", {}).get("name")
+        for i, d in enumerate(docs):
+            if d.get("kind") != t_kind:
+                continue
+            if t_name and d.get("metadata", {}).get("name") != t_name:
+                continue
+            docs[i] = _strategic_merge(d, pdoc)
+            matched = True
+    if not matched:
+        raise ValueError(f"{ppath}: patch target matched no resource")
 
 
 def _label_selectors_and_templates(doc: Dict[str, Any], labels: Dict[str, str]):

@@ -54,6 +54,8 @@ REASON_RESTARTING = "JobRestarting"
 REASON_EXITED_WITH_CODE = "ExitedWithCode"
 REASON_POD_TEMPLATE_RESTART_POLICY = "SettedPodTemplateRestartPolicy"
 REASON_FAILED_VALIDATION = "FailedValidation"
+REASON_SUSPENDED = "JobSuspended"
+REASON_RESUMED = "JobResumed"
 
 
 def iso_from_epoch(ts: float) -> str:
@@ -295,6 +297,42 @@ class JobEngine:
             res = self._cleanup_job_ttl(job)
             self._write_status(job, old_status)
             return res
+
+        # ----- suspend/resume (modern training-operator semantics; no
+        # reference counterpart — the snapshot predates RunPolicy.suspend).
+        # Suspend tears down every pod/service and PodGroup, stamps the
+        # Suspended condition, and resets StartTime so the
+        # ActiveDeadlineSeconds clock restarts on resume (batch/v1 Job
+        # suspend behavior).
+        if job.run_policy.suspend:
+            self._delete_pods_and_services(job, pods, force_all=True)
+            if self.config.enable_gang_scheduling:
+                self._delete_pod_group(job)
+            # counts describe live pods only; the ExitCode restart counter is
+            # history and survives suspension
+            for rtype in replicas:
+                prev = status.replica_statuses.get(rtype)
+                status.replica_statuses[rtype] = common.ReplicaStatus(
+                    restarts=prev.restarts if prev else 0
+                )
+            if not common.is_suspended(status):
+                msg = f"{self.adapter.KIND} {job.name} is suspended."
+                self.cluster.record_event(
+                    job.to_dict(), "Normal", REASON_SUSPENDED, msg
+                )
+                common.update_job_conditions(
+                    status, common.JOB_SUSPENDED, REASON_SUSPENDED, msg, now_iso
+                )
+            status.start_time = None
+            self._write_status(job, old_status)
+            return ReconcileResult()
+        if common.is_suspended(status):
+            msg = f"{self.adapter.KIND} {job.name} is resumed."
+            self.cluster.record_event(job.to_dict(), "Normal", REASON_RESUMED, msg)
+            common.demote_condition(
+                status, common.JOB_SUSPENDED, now_iso,
+                reason=REASON_RESUMED, message=msg,
+            )
 
         # ----- BackoffLimit / ActiveDeadlineSeconds -> Failed
         failure_message = None

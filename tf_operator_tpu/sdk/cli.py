@@ -11,6 +11,8 @@ common verbs into one command:
   tpu-jobs wait tfjob mnist --timeout 600  # block until terminal
   tpu-jobs logs tfjob mnist [--replica-type Worker] [--index 0]
   tpu-jobs pods tfjob mnist
+  tpu-jobs suspend tfjob mnist             # tear pods down, keep the CR
+  tpu-jobs resume tfjob mnist
   tpu-jobs delete tfjob mnist
 
 Backend selection matches the operator (`cmd/main.py:build_cluster`):
@@ -161,6 +163,16 @@ class Cli:
         print(f"{kind.lower()}.kubeflow.org/{name} deleted")
         return 0
 
+    def suspend(self, kind: str, name: str, namespace: str) -> int:
+        self.client(kind).suspend(name, namespace=namespace)
+        print(f"{kind.lower()}.kubeflow.org/{name} suspended")
+        return 0
+
+    def resume(self, kind: str, name: str, namespace: str) -> int:
+        self.client(kind).resume(name, namespace=namespace)
+        print(f"{kind.lower()}.kubeflow.org/{name} resumed")
+        return 0
+
 
 def run_local_file(path: str, timeout: float) -> int:
     """Run a job YAML's replicas as local subprocesses end to end
@@ -212,7 +224,8 @@ def make_parser() -> argparse.ArgumentParser:
     pr.add_argument("file", help="job YAML ('-' for stdin)")
     pr.add_argument("--timeout", type=float, default=300.0)
 
-    for verb in ("get", "wait", "pods", "logs", "delete"):
+    for verb in ("get", "wait", "pods", "logs", "delete", "suspend",
+                 "resume"):
         pv = sub.add_parser(verb, parents=[common])
         pv.add_argument("kind")
         pv.add_argument("name")
@@ -252,6 +265,10 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
                         follow=args.follow)
     if args.verb == "delete":
         return cli.delete(kind, args.name, ns)
+    if args.verb == "suspend":
+        return cli.suspend(kind, args.name, ns)
+    if args.verb == "resume":
+        return cli.resume(kind, args.name, ns)
     raise SystemExit(f"unknown verb {args.verb}")
 
 

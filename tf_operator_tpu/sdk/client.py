@@ -67,6 +67,17 @@ class JobClient:
     def delete(self, name: str, namespace: str = "default") -> None:
         self.cluster.delete(self.kind, namespace, name)
 
+    def suspend(self, name: str, namespace: str = "default") -> Dict[str, Any]:
+        """Set runPolicy.suspend=true: the operator tears the job's pods
+        down and halts reconciliation until resume() (engine suspend
+        semantics; no reference counterpart)."""
+        return self.patch(name, {"spec": {"runPolicy": {"suspend": True}}},
+                          namespace)
+
+    def resume(self, name: str, namespace: str = "default") -> Dict[str, Any]:
+        return self.patch(name, {"spec": {"runPolicy": {"suspend": False}}},
+                          namespace)
+
     # ------------------------------------------------------------- waits
     def get_job_status(self, name: str, namespace: str = "default") -> str:
         """Type of the last transition-ordered True condition
