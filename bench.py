@@ -26,6 +26,7 @@ timed — a Mosaic lowering error or a perf regression fails loudly in the
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -127,52 +128,62 @@ def bench_resnet(gen: str, n_chips: int):
     from tf_operator_tpu.runtime.train import create_train_state, make_train_step
 
     on_cpu = gen == "cpu"
-    batch = 32 if on_cpu else 256
+    batches = (32,) if on_cpu else (256, 512)
     image = 64 if on_cpu else 224
     steps = 5 if on_cpu else 30
     warmup = 2 if on_cpu else 5
-
-    # data-parallel over every local chip so throughput/n_chips is honest
-    # (an unsharded step would run on chip 0 only while dividing by all)
-    batch *= n_chips
     mesh = make_mesh({"dp": n_chips})
-
     model = ResNet50(num_classes=1000)
-    rng = jax.random.PRNGKey(0)
-    images = jax.random.normal(rng, (batch, image, image, 3), jnp.bfloat16)
-    labels = jax.random.randint(rng, (batch,), 0, 1000)
-    images = jax.device_put(images, batch_sharding(mesh))
-    labels = jax.device_put(labels, batch_sharding(mesh))
-
-    tx = optax.sgd(0.1, momentum=0.9)
-    state = create_train_state(rng, model, images, tx)
-    step = make_train_step(model, has_batch_stats=True, mesh=mesh)
-
-    # NOTE: sync via device_get of the scalar loss, NOT block_until_ready —
-    # on relayed/remote device transports block_until_ready can return before
-    # execution completes; fetching a value is the only reliable barrier.
-    for _ in range(warmup):
-        state, metrics = step(state, images, labels)
-    float(jax.device_get(metrics["loss"]))
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, images, labels)
-    float(jax.device_get(metrics["loss"]))
-    dt = time.perf_counter() - t0
-
-    img_per_sec_per_chip = steps * batch / dt / n_chips
     flops_per_image = resnet50_train_flops_per_image(image)
-    achieved = img_per_sec_per_chip * flops_per_image
     peak = PEAK_FLOPS_PER_CHIP.get(gen)
-    return {
-        "batch": batch,
-        "image_px": image,
-        "steps": steps,
-        "img_per_sec_per_chip": round(img_per_sec_per_chip, 2),
-        "train_flops_per_image": flops_per_image,
-        "mfu": round(achieved / peak, 4) if peak else None,
-    }
+
+    def run_one(batch):
+        rng = jax.random.PRNGKey(0)
+        images = jax.random.normal(rng, (batch, image, image, 3), jnp.bfloat16)
+        labels = jax.random.randint(rng, (batch,), 0, 1000)
+        images = jax.device_put(images, batch_sharding(mesh))
+        labels = jax.device_put(labels, batch_sharding(mesh))
+        tx = optax.sgd(0.1, momentum=0.9)
+        state = create_train_state(rng, model, images, tx)
+        step = make_train_step(model, has_batch_stats=True, mesh=mesh)
+        # NOTE: sync via device_get of the scalar loss, NOT
+        # block_until_ready — on relayed/remote device transports
+        # block_until_ready can return before execution completes; fetching
+        # a value is the only reliable barrier.
+        for _ in range(warmup):
+            state, metrics = step(state, images, labels)
+        float(jax.device_get(metrics["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, images, labels)
+        float(jax.device_get(metrics["loss"]))
+        dt = time.perf_counter() - t0
+        return steps * batch / dt / n_chips
+
+    # sweep per-chip batch sizes, data-parallel over every local chip so
+    # throughput/n_chips is honest (an unsharded step would run on chip 0
+    # only while dividing by all); only an OOM ends the sweep benignly
+    best, best_ips = None, 0.0
+    for b in batches:
+        try:
+            ips = run_one(b * n_chips)
+        except Exception as e:  # noqa: BLE001 — classify below
+            if best is not None and "RESOURCE_EXHAUSTED" in str(e).upper():
+                best.setdefault("sweep_stopped", []).append(
+                    f"b{b * n_chips}: {type(e).__name__}")
+                break
+            raise
+        if best is None or ips > best_ips:
+            best_ips = ips
+            best = {
+                "batch": b * n_chips,
+                "image_px": image,
+                "steps": steps,
+                "img_per_sec_per_chip": round(ips, 2),
+                "train_flops_per_image": flops_per_image,
+                "mfu": round(ips * flops_per_image / peak, 4) if peak else None,
+            }
+    return best
 
 
 def bench_transformer(gen: str, n_chips: int):
@@ -187,17 +198,30 @@ def bench_transformer(gen: str, n_chips: int):
 
     on_cpu = gen == "cpu"
     if on_cpu:
-        cfg = tfm.tiny(max_len=128)
+        base_cfg = tfm.tiny(max_len=128)
         batches, steps, warmup = (4,), 3, 1
+        variants = {"einsum": (None, None)}
     else:
-        cfg = tfm.bert_large()
-        batches, steps, warmup = (8, 16), 10, 3
+        base_cfg = tfm.bert_large()
+        batches, steps, warmup = (8, 16, 32), 10, 3
+        # sweep arms: (attention_fn, loss_fn) — the pallas flash kernel
+        # usually beats the einsum path, and the blocked large-vocab CE
+        # (ops/blocked_ce.py) removes the [B,S,V] f32 logits so larger
+        # batches fit; the numbers decide
+        from tf_operator_tpu.ops.blocked_ce import lm_blocked_loss
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        variants = {
+            "einsum": (None, None),
+            "flash": (flash_attention, None),
+            "flash+blocked_ce": (flash_attention, lm_blocked_loss),
+        }
     mesh = make_mesh({"dp": n_chips})
-    model = tfm.Transformer(cfg)
-    flops_per_token = tfm.params_flops_per_token(cfg)
+    flops_per_token = tfm.params_flops_per_token(base_cfg)
     peak = PEAK_FLOPS_PER_CHIP.get(gen)
 
-    def run_one(batch):
+    def run_one(batch, cfg, loss_impl):
+        model = tfm.Transformer(cfg)
         rng = jax.random.PRNGKey(0)
         tokens = jax.random.randint(
             rng, (batch, cfg.max_len), 0, cfg.vocab_size)
@@ -205,10 +229,11 @@ def bench_transformer(gen: str, n_chips: int):
         params = model.init(rng, tokens, train=False)["params"]
         tx = optax.sgd(1e-2)
         opt_state = tx.init(params)
+        loss_of = loss_impl or tfm.lm_train_loss
 
         def train_step(params, opt_state, tokens):
             loss, grads = jax.value_and_grad(
-                lambda p: tfm.lm_train_loss(model, p, tokens)
+                lambda p: loss_of(model, p, tokens)
             )(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
@@ -224,31 +249,46 @@ def bench_transformer(gen: str, n_chips: int):
         dt = time.perf_counter() - t0
         return steps * batch * cfg.max_len / dt / n_chips
 
-    # sweep per-chip batch sizes and keep the best (larger batches lift
-    # MFU until HBM runs out — only an OOM ends the sweep benignly; any
-    # other failure propagates like it did pre-sweep)
+    # sweep per-chip batch sizes x attention impls and keep the best
+    # (larger batches lift MFU until HBM runs out — only an OOM ends a
+    # sweep arm benignly; any other failure propagates like it did
+    # pre-sweep, except the optional flash arm which must not kill the
+    # einsum headline)
     best, best_tps = None, 0.0
-    for b in batches:
-        try:
-            tps = run_one(b * n_chips)
-        except Exception as e:  # noqa: BLE001 — classify below
-            if best is not None and "RESOURCE_EXHAUSTED" in str(e).upper():
-                best["sweep_stopped"] = f"b{b * n_chips}: {type(e).__name__}"
-                break
-            raise
-        if best is None or tps > best_tps:
-            best_tps = tps
-            best = {
-                "config": "bert_large" if not on_cpu else "tiny",
-                "batch": b * n_chips,
-                "seq_len": cfg.max_len,
-                "steps": steps,
-                "tokens_per_sec_per_chip": round(tps, 1),
-                "flops_per_token": flops_per_token,
-                "mfu": (
-                    round(tps * flops_per_token / peak, 4) if peak else None
-                ),
-            }
+    for arm, (attn_fn, loss_impl) in variants.items():
+        cfg = dataclasses.replace(base_cfg, attention_fn=attn_fn)
+        for b in batches:
+            try:
+                tps = run_one(b * n_chips, cfg, loss_impl)
+            except Exception as e:  # noqa: BLE001 — classify below
+                oom = "RESOURCE_EXHAUSTED" in str(e).upper()
+                if best is not None and oom:
+                    best.setdefault("sweep_stopped", []).append(
+                        f"{arm} b{b * n_chips}: {type(e).__name__}")
+                    break
+                if arm != "einsum":
+                    # a Mosaic/lowering failure in an optional arm is
+                    # surfaced, not fatal
+                    best.setdefault("sweep_stopped", []).append(
+                        f"{arm} b{b * n_chips}: "
+                        f"{type(e).__name__}: {e}"[:200])
+                    break
+                raise
+            if best is None or tps > best_tps:
+                best_tps = tps
+                best = {
+                    "config": "bert_large" if not on_cpu else "tiny",
+                    "arm": arm,
+                    "batch": b * n_chips,
+                    "seq_len": cfg.max_len,
+                    "steps": steps,
+                    "tokens_per_sec_per_chip": round(tps, 1),
+                    "flops_per_token": flops_per_token,
+                    "mfu": (
+                        round(tps * flops_per_token / peak, 4)
+                        if peak else None
+                    ),
+                }
     return best
 
 
@@ -464,6 +504,13 @@ def main() -> int:
               file=sys.stderr)
 
     import jax
+
+    if not tpu_ok:
+        # the session sitecustomize pins jax_platforms via jax.config at
+        # interpreter start; jax.config overrides the JAX_PLATFORMS env
+        # var, so the CPU fallback must update the config explicitly or
+        # jax.devices() below will still dial the TPU pool and hang
+        jax.config.update("jax_platforms", "cpu")
 
     dev = jax.devices()[0]
     gen = detect_generation(dev)

@@ -224,3 +224,27 @@ def test_guard_signal_latch_and_uninstall():
         assert guard.preempted
     finally:
         guard.uninstall()
+
+
+def test_async_checkpointing_resume_and_durability(tmp_path):
+    """async_save=True: interval saves overlap compute (no per-save wait),
+    the loop drains in-flight writes before returning, and a second run
+    resumes exactly where the first stopped."""
+    ckpt_dir = str(tmp_path / "async-ckpt")
+    res = run_training(
+        _make_state(), _train_step, _batches(), num_steps=6,
+        checkpointer=Checkpointer(ckpt_dir, async_save=True),
+        save_interval_steps=2,
+    )
+    assert res.steps_run == 6
+    # everything durable on return, including the step-6 interval save
+    assert Checkpointer(ckpt_dir).latest_step() == 6
+
+    res2 = run_training(
+        _make_state(), _train_step, _batches(), num_steps=9,
+        checkpointer=Checkpointer(ckpt_dir, async_save=True),
+        save_interval_steps=100,
+    )
+    assert res2.resumed_from == 6
+    assert res2.steps_run == 3
+    assert Checkpointer(ckpt_dir).latest_step() == 9

@@ -187,7 +187,7 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False):
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model,
@@ -207,6 +207,10 @@ class Transformer(nn.Module):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
             x = block(cfg, use_moe=use_moe, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, use_bias=False, name="ln_f")(x)
+        if return_hidden:
+            # pre-projection hidden states: lets ops/blocked_ce.py fuse the
+            # lm-head matmul into the loss without a [B,S,V] materialization
+            return x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
@@ -240,6 +244,20 @@ def apply_with_aux(model, params, tokens, train: bool = True):
     for leaf in jax.tree_util.tree_leaves(mut.get("intermediates", {})):
         aux = aux + jnp.sum(leaf)
     return logits, aux
+
+
+def apply_body(model, params, tokens, train: bool = True):
+    """Body-only forward (no logits projection): returns ([B,S,D] hidden
+    states, MoE aux loss). Pair with ops/blocked_ce.py to compute the LM
+    loss without materializing [B,S,V] logits."""
+    hidden, mut = model.apply(
+        {"params": params}, tokens, train=train, return_hidden=True,
+        mutable=["intermediates"],
+    )
+    aux = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(mut.get("intermediates", {})):
+        aux = aux + jnp.sum(leaf)
+    return hidden, aux
 
 
 def lm_train_loss(model, params, tokens) -> jax.Array:

@@ -1,0 +1,188 @@
+"""Blocked large-vocab cross-entropy — the lm-head analogue of flash
+attention.
+
+A decoder LM's loss normally materializes [B, S, V] float32 logits
+(BERT-large b16 s512 v30k -> ~1 GB; T5-3B v32k the same per batch) just to
+reduce them to one scalar.  This op fuses the lm-head matmul into the loss:
+the vocab dimension is processed in chunks inside a `lax.scan` with an
+online logsumexp (the same running-max trick flash attention uses over
+keys), so peak memory is [B*S, chunk] instead of [B*S, V].
+
+Backward recomputes each chunk's logits and writes the softmax-weighted
+gradients chunk by chunk (custom_vjp) — FLOPs 2x forward-matmul per pass,
+memory O(chunk), exactly the remat trade that suits HBM-bound TPU runs.
+
+No reference counterpart (the reference operator contains no model code —
+SURVEY.md §5.7); comparable public technique: chunked/fused linear-CE
+losses used by large-vocab LM trainers.
+
+Layout notes (TPU): `x` is [N, D] activations (N = B*S tokens), `w` is
+[D, V] head weights (tied embeddings pass `embed.T`).  Chunks of 8-16k
+keep each partial matmul MXU-shaped ([N, D] @ [D, chunk]).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_chunk(v: int, chunk: Optional[int]) -> int:
+    """Any chunk works — the tail chunk is padded and masked — so real
+    vocab sizes (30522, 50257, ...) with no aligned divisor still stream
+    in small tiles instead of degenerating to one full-vocab chunk."""
+    if chunk is not None:
+        if chunk <= 0:
+            raise ValueError(f"chunk must be positive, got {chunk}")
+        return min(chunk, v)
+    return min(8192, (v + 127) // 128 * 128)
+
+
+def _pad_chunks(w: jax.Array, chunk: int) -> Tuple[jax.Array, int]:
+    """Zero-pad [D, V] to a chunk multiple and return the [n_chunks, D,
+    chunk] scan view; padded columns are masked to -inf in the kernel."""
+    d, v = w.shape
+    n_chunks = -(-v // chunk)
+    pad = n_chunks * chunk - v
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+    return w.reshape(d, n_chunks, chunk).transpose(1, 0, 2), n_chunks
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _blocked_ce(x, w, labels, chunk):
+    loss, _ = _forward(x, w, labels, chunk)
+    return loss
+
+
+def _forward(x, w, labels, chunk) -> Tuple[jax.Array, Tuple]:
+    """Returns (mean_loss, residuals). Online logsumexp over vocab chunks:
+    carry (m, s) with m = running max, s = sum(exp(logit - m))."""
+    n, d = x.shape
+    v = w.shape[1]
+    # scan streams one chunk's weights through the MXU at a time; the
+    # padded tail columns are masked out of max/sum below
+    w_c, _ = _pad_chunks(w, chunk)
+    x32 = x.astype(jnp.float32)
+    cols = jnp.arange(chunk)
+
+    def body(carry, wc):
+        m, s, label_logit, idx = carry
+        logits = x32 @ wc.astype(jnp.float32)  # [N, chunk]
+        valid = (idx * chunk + cols) < v
+        logits = jnp.where(valid[None, :], logits, -jnp.inf)
+        m_new = jnp.maximum(m, logits.max(axis=1))
+        s = s * jnp.exp(m - m_new) + jnp.where(
+            valid[None, :], jnp.exp(logits - m_new[:, None]), 0.0
+        ).sum(axis=1)
+        # pick out the label's logit if it falls in this chunk
+        local = labels - idx * chunk
+        in_chunk = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1
+        )[:, 0]
+        label_logit = jnp.where(in_chunk, picked, label_logit)
+        return (m_new, s, label_logit, idx + 1), None
+
+    init = (
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((), jnp.int32),
+    )
+    (m, s, label_logit, _), _ = jax.lax.scan(body, init, w_c)
+    lse = m + jnp.log(s)
+    loss = (lse - label_logit).mean()
+    return loss, (x, w, labels, lse)
+
+
+def _blocked_ce_fwd(x, w, labels, chunk):
+    loss, res = _forward(x, w, labels, chunk)
+    return loss, res
+
+
+def _blocked_ce_bwd(chunk, res, g):
+    """d loss / d logits = (softmax - onehot(label)) / N; recompute each
+    chunk's logits, accumulate dx, and emit dw chunk by chunk."""
+    x, w, labels, lse = res
+    n, d = x.shape
+    v = w.shape[1]
+    w_c, n_chunks = _pad_chunks(w, chunk)
+    x32 = x.astype(jnp.float32)
+    scale = g / n
+    cols = jnp.arange(chunk)
+
+    def body(carry, wc_idx):
+        dx_acc, idx = carry
+        wc = wc_idx
+        logits = x32 @ wc.astype(jnp.float32)
+        valid = (idx * chunk + cols) < v
+        # softmax over the full vocab; padded columns contribute nothing
+        p = jnp.where(valid[None, :], jnp.exp(logits - lse[:, None]), 0.0)
+        local = labels - idx * chunk
+        in_chunk = (local >= 0) & (local < chunk)
+        onehot = (
+            jax.nn.one_hot(jnp.clip(local, 0, chunk - 1), chunk,
+                           dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        dlogits = (p - onehot) * scale  # [N, chunk]
+        dx_acc = dx_acc + dlogits @ wc.astype(jnp.float32).T
+        dwc = x32.T @ dlogits  # [D, chunk]
+        return (dx_acc, idx + 1), dwc
+
+    (dx, _), dw_c = jax.lax.scan(
+        body, (jnp.zeros((n, d), jnp.float32), jnp.zeros((), jnp.int32)), w_c
+    )
+    dw = dw_c.transpose(1, 0, 2).reshape(d, n_chunks * chunk)[:, :v]
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+_blocked_ce.defvjp(_blocked_ce_fwd, _blocked_ce_bwd)
+
+
+def blocked_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    labels: jax.Array,
+    chunk: Optional[int] = None,
+) -> jax.Array:
+    """Mean CE of `softmax(x @ w)` against integer `labels`, without ever
+    materializing the [N, V] logits.
+
+    x: [N, D] final-layer activations (flatten [B, S, D] first)
+    w: [D, V] lm-head weights (pass `embedding.T` for tied embeddings)
+    labels: [N] int targets
+    chunk: vocab tile width (default 8192, 128-aligned; the tail chunk is
+        zero-padded and masked, so any real vocab size — 30522, 50257 —
+        streams in tiles instead of one full-width pass)
+    """
+    if x.ndim != 2 or w.ndim != 2 or labels.ndim != 1:
+        raise ValueError(
+            f"expected x[N,D], w[D,V], labels[N]; got {x.shape}, {w.shape}, "
+            f"{labels.shape}"
+        )
+    return _blocked_ce(x, w, labels, _pick_chunk(w.shape[1], chunk))
+
+
+def lm_blocked_loss(model, params, tokens, chunk: Optional[int] = None):
+    """Drop-in for models.transformer.lm_train_loss on tied-embedding
+    Transformers: runs the body WITHOUT the logits projection, then the
+    blocked CE against the embedding matrix. Falls back assertion-free only
+    for cfg.tie_embeddings models (the lm_head case can pass its kernel
+    directly to blocked_cross_entropy)."""
+    from tf_operator_tpu.models import transformer as tfm
+
+    cfg = model.cfg
+    if not cfg.tie_embeddings:
+        raise ValueError("lm_blocked_loss requires tie_embeddings=True")
+    hidden, aux = tfm.apply_body(model, params, tokens, train=True)
+    x = hidden[:, :-1].reshape(-1, cfg.d_model)
+    labels = tokens[:, 1:].reshape(-1)
+    embed = params["embed"]["embedding"]  # [V, D]
+    loss = blocked_cross_entropy(
+        x.astype(jnp.float32), embed.astype(jnp.float32).T, labels, chunk
+    )
+    return loss + tfm.MOE_AUX_WEIGHT * aux

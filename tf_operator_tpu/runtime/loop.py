@@ -130,8 +130,13 @@ def run_training(
     if checkpointer is not None and steps_run > 0 and step != last_saved_step:
         # final save unless this exact step is already on disk (interval
         # save this iteration, or a recreated pod that restored an
-        # already-complete run) — orbax raises on duplicate steps
-        checkpointer.save(step, state)
+        # already-complete run) — orbax raises on duplicate steps.
+        # wait=True: the exit/preemption save must be durable before the
+        # process dies, even in async mode
+        checkpointer.save(step, state, wait=True)
+    elif checkpointer is not None:
+        # async interval saves may still be in flight; drain before return
+        checkpointer.wait_until_finished()
     return LoopResult(
         state=state,
         steps_run=steps_run,

@@ -92,10 +92,37 @@ def make_train_step(
     has_batch_stats: bool = True,
     rules: MeshRules = DEFAULT_RULES,
     mesh: Optional[Mesh] = None,
+    accum_steps: int = 1,
 ):
     """Build the jitted SPMD train step: (state, images, labels) ->
     (state, metrics). Everything inside is traced once; no python branching
-    on data."""
+    on data.
+
+    `accum_steps > 1` enables gradient accumulation: the batch is split
+    into that many micro-batches, a `lax.scan` runs fwd+bwd per micro-batch
+    summing gradients, and ONE optimizer update applies the mean — the
+    standard HBM <-> batch-size trade (activation memory scales with the
+    micro-batch, not the global batch). Equal-sized micro-batches make the
+    mean-of-means equal the full-batch mean loss/grad, so for BN-free
+    models the update is numerically the full-batch update."""
+
+    def forward_backward(params, batch_stats, x, y):
+        def compute_loss(p):
+            variables = {"params": p}
+            if has_batch_stats:
+                variables["batch_stats"] = batch_stats
+                logits, updates = model.apply(
+                    variables, x, train=True, mutable=["batch_stats"]
+                )
+                return loss_fn(logits, y), (logits, updates["batch_stats"])
+            logits = model.apply(variables, x, train=True)
+            return loss_fn(logits, y), (logits, None)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(params)
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == y)
+        return loss, accuracy, new_stats, grads
 
     def step(state: TrainState, images: jax.Array, labels: jax.Array):
         if mesh is not None:
@@ -104,23 +131,51 @@ def make_train_step(
                 images, NamedSharding(mesh, batch_spec)
             )
 
-        def compute_loss(params):
-            variables = {"params": params}
-            if has_batch_stats:
-                variables["batch_stats"] = state.batch_stats
-                logits, updates = model.apply(
-                    variables, images, train=True, mutable=["batch_stats"]
-                )
-                return loss_fn(logits, labels), (logits, updates["batch_stats"])
-            logits = model.apply(variables, images, train=True)
-            return loss_fn(logits, labels), (logits, None)
+        if accum_steps == 1:
+            loss, accuracy, new_stats, grads = forward_backward(
+                state.params, state.batch_stats, images, labels
+            )
+            new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
+            return new_state, {"loss": loss, "accuracy": accuracy}
 
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
-        new_state = state.apply_gradients(grads, new_batch_stats=new_stats)
-        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
-        return new_state, {"loss": loss, "accuracy": accuracy}
+        b = images.shape[0]
+        if b % accum_steps != 0:
+            raise ValueError(
+                f"batch size {b} not divisible by accum_steps {accum_steps}"
+            )
+        micro = b // accum_steps
+        mi = images.reshape(accum_steps, micro, *images.shape[1:])
+        ml = labels.reshape(accum_steps, micro, *labels.shape[1:])
+
+        def body(carry, xs):
+            grads_acc, loss_acc, acc_acc, bs = carry
+            x, y = xs
+            loss, accuracy, new_stats, grads = forward_backward(
+                state.params, bs, x, y
+            )
+            carry = (
+                jax.tree.map(jnp.add, grads_acc, grads),
+                loss_acc + loss,
+                acc_acc + accuracy,
+                new_stats if has_batch_stats else bs,
+            )
+            return carry, None
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        (grads_sum, loss_sum, acc_sum, new_stats), _ = jax.lax.scan(
+            body,
+            (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+             state.batch_stats),
+            (mi, ml),
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, grads_sum)
+        new_state = state.apply_gradients(
+            grads, new_batch_stats=new_stats if has_batch_stats else None
+        )
+        return new_state, {
+            "loss": loss_sum / accum_steps,
+            "accuracy": acc_sum / accum_steps,
+        }
 
     return jax.jit(step, donate_argnums=(0,))
 
@@ -146,16 +201,29 @@ def make_eval_step(model, has_batch_stats: bool = True):
 
 
 class Checkpointer:
-    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+    """Orbax-backed checkpoint manager.
+
+    `async_save=True` overlaps the checkpoint write with training compute
+    (orbax snapshots device arrays to host, then persists on a background
+    thread) — the TPU-idiomatic mode: a multi-GB save costs one
+    device-to-host copy instead of a full write stall.  Interval saves in
+    the training loop then don't block the step; `wait_until_finished()`
+    makes the last save durable before the process exits (preemption
+    path)."""
+
+    def __init__(
+        self, directory: str, max_to_keep: int = 3, async_save: bool = False
+    ) -> None:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self.async_save = async_save
         self.mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
 
-    def save(self, step: int, state: TrainState) -> None:
+    def save(self, step: int, state: TrainState, wait: bool = False) -> None:
         payload = {
             "step": state.step,
             "params": state.params,
@@ -163,6 +231,11 @@ class Checkpointer:
             "batch_stats": state.batch_stats,
         }
         self.mngr.save(step, args=self._ocp.args.StandardSave(payload))
+        if wait or not self.async_save:
+            self.mngr.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
+        """Block until every in-flight async save is durable on disk."""
         self.mngr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
