@@ -163,14 +163,13 @@ def bench_resnet(gen: str, n_chips: int):
     # sweep per-chip batch sizes, data-parallel over every local chip so
     # throughput/n_chips is honest (an unsharded step would run on chip 0
     # only while dividing by all); only an OOM ends the sweep benignly
-    best, best_ips = None, 0.0
+    best, best_ips, stops = None, 0.0, []
     for b in batches:
         try:
             ips = run_one(b * n_chips)
         except Exception as e:  # noqa: BLE001 — classify below
             if best is not None and "RESOURCE_EXHAUSTED" in str(e).upper():
-                best.setdefault("sweep_stopped", []).append(
-                    f"b{b * n_chips}: {type(e).__name__}")
+                stops.append(f"b{b * n_chips}: {type(e).__name__}")
                 break
             raise
         if best is None or ips > best_ips:
@@ -183,6 +182,8 @@ def bench_resnet(gen: str, n_chips: int):
                 "train_flops_per_image": flops_per_image,
                 "mfu": round(ips * flops_per_image / peak, 4) if peak else None,
             }
+    if best is not None and stops:
+        best["sweep_stopped"] = stops
     return best
 
 
@@ -254,7 +255,7 @@ def bench_transformer(gen: str, n_chips: int):
     # sweep arm benignly; any other failure propagates like it did
     # pre-sweep, except the optional flash arm which must not kill the
     # einsum headline)
-    best, best_tps = None, 0.0
+    best, best_tps, stops = None, 0.0, []
     for arm, (attn_fn, loss_impl) in variants.items():
         cfg = dataclasses.replace(base_cfg, attention_fn=attn_fn)
         for b in batches:
@@ -263,13 +264,12 @@ def bench_transformer(gen: str, n_chips: int):
             except Exception as e:  # noqa: BLE001 — classify below
                 oom = "RESOURCE_EXHAUSTED" in str(e).upper()
                 if best is not None and oom:
-                    best.setdefault("sweep_stopped", []).append(
-                        f"{arm} b{b * n_chips}: {type(e).__name__}")
+                    stops.append(f"{arm} b{b * n_chips}: {type(e).__name__}")
                     break
                 if arm != "einsum":
                     # a Mosaic/lowering failure in an optional arm is
                     # surfaced, not fatal
-                    best.setdefault("sweep_stopped", []).append(
+                    stops.append(
                         f"{arm} b{b * n_chips}: "
                         f"{type(e).__name__}: {e}"[:200])
                     break
@@ -289,6 +289,8 @@ def bench_transformer(gen: str, n_chips: int):
                         if peak else None
                     ),
                 }
+    if best is not None and stops:
+        best["sweep_stopped"] = stops
     return best
 
 
@@ -398,6 +400,70 @@ def bench_flash_attention(gen: str):
     except Exception as e:  # noqa: BLE001 — surfaced, not fatal
         results["ring_flash_1dev"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     return results
+
+
+def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4):
+    """Operator throughput at the reference's design scale target of O(100)
+    concurrent jobs per cluster with a single controller (reference design
+    doc tf_job_design_doc.md:24; SURVEY.md §6).  Creates n_jobs TFJobs
+    against the engine + a stub kubelet that marks pods Running, and times
+    until every job carries a Running condition."""
+    from tf_operator_tpu.cmd.manager import OperatorManager
+    from tf_operator_tpu.cmd.options import ServerOptions
+    from tf_operator_tpu.k8s.fake import FakeCluster
+    from tf_operator_tpu.k8s.kubelet_util import write_pod_status
+    from tf_operator_tpu.k8s.objects import name_of, namespace_of
+    from tf_operator_tpu.sdk.watch import job_state
+
+    cluster = FakeCluster()
+
+    def instant_kubelet(etype, pod):
+        if etype != "ADDED":
+            return
+        # conflict-retrying status write shared with the real simulators
+        # (k8s/kubelet_util.py) — a swallowed conflict would leave the pod
+        # Pending forever and fail the whole bench at the deadline
+        write_pod_status(
+            cluster, namespace_of(pod), name_of(pod),
+            lambda p: p.setdefault("status", {}).update(phase="Running"),
+        )
+
+    cluster.subscribe("Pod", instant_kubelet)
+    manager = OperatorManager(cluster, ServerOptions(threadiness=threadiness))
+    manager.start()
+    try:
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            cluster.create("TFJob", {
+                "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+                "metadata": {"name": f"scale-{i}", "namespace": "default"},
+                "spec": {"tfReplicaSpecs": {"Worker": {
+                    "replicas": 2,
+                    "template": {"spec": {"containers": [
+                        {"name": "tensorflow", "image": "bench"}]}},
+                }}},
+            })
+        deadline = t0 + 120.0
+        running = 0
+        while time.perf_counter() < deadline:
+            running = sum(
+                1 for j in cluster.list("TFJob", namespace="default")
+                if job_state(j) == "Running"
+            )
+            if running == n_jobs:
+                break
+            time.sleep(0.01)
+        dt = time.perf_counter() - t0
+    finally:
+        manager.stop()
+    return {
+        "jobs": n_jobs,
+        "pods": 2 * n_jobs,
+        "threadiness": threadiness,
+        "all_running": running == n_jobs,
+        "create_to_all_running_s": round(dt, 3),
+        "jobs_per_sec": round(n_jobs / dt, 1) if dt > 0 else None,
+    }
 
 
 def bench_startup_latency(runs: int = 5):
@@ -535,6 +601,11 @@ def main() -> int:
         extra["startup_latency"] = bench_startup_latency()
     except Exception as e:  # noqa: BLE001 — surfaced, not fatal
         extra["startup_latency"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    try:
+        extra["operator_scale"] = bench_operator_scale()
+    except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+        extra["operator_scale"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     baseline = REFERENCE_IMG_PER_SEC_PER_CHIP[gen]
     result = {

@@ -35,10 +35,19 @@ def lm_batches(batch: int, seq_len: int, vocab: int, seed: int):
         yield (jax.random.randint(k, (batch, seq_len), 0, vocab),)
 
 
-def make_lm_step(model):
+def make_lm_step(model, blocked_ce: bool = False):
+    if blocked_ce:
+        # fuse the 32k-vocab lm-head into the loss (ops/blocked_ce.py):
+        # no [B,S,V] f32 logits materialization
+        from tf_operator_tpu.ops.blocked_ce import lm_blocked_loss
+
+        loss_of = lm_blocked_loss
+    else:
+        loss_of = lm_train_loss
+
     def step(state: TrainState, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: lm_train_loss(model, p, tokens)
+            lambda p: loss_of(model, p, tokens)
         )(state.params)
         return state.apply_gradients(grads), {"loss": loss}
 
@@ -53,11 +62,18 @@ def main(argv=None):
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--save-interval", type=int, default=500)
     ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
+    ap.add_argument("--blocked-ce", action="store_true",
+                    help="fused large-vocab CE (no [B,S,V] logits)")
     ap.add_argument("--smoke", action="store_true", help="tiny model, CPU ok")
     args = ap.parse_args(argv)
 
     info = bootstrap.initialize()
-    cfg = tiny(causal=True) if args.smoke else t5_3b_decoder(remat=True)
+    if args.smoke:
+        cfg = tiny(causal=True)
+    else:
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        cfg = t5_3b_decoder(remat=True, attention_fn=flash_attention)
     seq_len = min(args.seq_len, cfg.max_len)
     mesh = make_mesh(axes=local_mesh_axes(jax.device_count(), prefer_tp=args.tp))
     print(f"host {info.process_id}/{info.num_processes} slice "
@@ -75,11 +91,14 @@ def main(argv=None):
 
     res = run_training(
         state,
-        make_lm_step(model),
+        make_lm_step(model, blocked_ce=args.blocked_ce),
         lm_batches(args.per_host_batch, seq_len, cfg.vocab_size,
                    seed=info.process_id),
         num_steps=args.steps,
-        checkpointer=Checkpointer(args.ckpt_dir) if args.ckpt_dir else None,
+        checkpointer=(
+            Checkpointer(args.ckpt_dir, async_save=True)
+            if args.ckpt_dir else None
+        ),
         save_interval_steps=args.save_interval,
         profiler=Profiler(batch_size=args.per_host_batch * jax.process_count()),
         guard=PreemptionGuard(),

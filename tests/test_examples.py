@@ -136,3 +136,9 @@ def test_mnist_ladder_config_through_run_local(tmp_path):
     combined = "\n".join(result["logs"].values())
     assert result["state"] == "Succeeded", combined[-2000:]
     assert "loss" in combined
+
+
+def test_t5_smoke_blocked_ce():
+    rc = _run("t5/train_t5.py", "--smoke", "--steps=2", "--per-host-batch=2",
+              "--blocked-ce")
+    assert rc.returncode == 0, rc.stderr[-2000:]

@@ -246,3 +246,47 @@ def test_chaos_random_kills_converge(stress_env):
         conds = [c["type"] for c in status.get("conditions", [])
                  if c.get("status") == "True"]
         assert "Failed" not in conds, (i, conds)
+
+
+def test_suspend_resume_churn_under_load(stress_env):
+    """Concurrent suspend/resume flapping across jobs must quiesce to the
+    right end state (suspended jobs: zero pods; resumed: full sets) with
+    no duplicate-index violations."""
+    cluster, mgr, kubelet, client, auditor = stress_env
+    n_jobs, n_workers = 4, 3
+    for i in range(n_jobs):
+        client.create(testutil.new_tfjob(f"flap-{i}", worker=n_workers))
+    _wait(
+        lambda: all(
+            len(client.get_pod_names(f"flap-{i}")) == n_workers
+            for i in range(n_jobs)
+        ),
+        "all pods created",
+    )
+
+    def flapper(i):
+        for _ in range(3):
+            client.suspend(f"flap-{i}")
+            time.sleep(0.02)
+            client.resume(f"flap-{i}")
+            time.sleep(0.02)
+        if i % 2 == 0:  # end suspended
+            client.suspend(f"flap-{i}")
+
+    threads = [threading.Thread(target=flapper, args=(i,)) for i in range(n_jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    def converged():
+        for i in range(n_jobs):
+            want = 0 if i % 2 == 0 else n_workers
+            if len(client.get_pod_names(f"flap-{i}")) != want:
+                return False
+        return True
+
+    _wait(converged, "suspend/resume converged")
+    assert auditor.violations == []
+    for i in range(0, n_jobs, 2):
+        assert client.get_job_status(f"flap-{i}") == "Suspended"

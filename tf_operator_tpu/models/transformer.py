@@ -234,30 +234,31 @@ def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
 MOE_AUX_WEIGHT = 0.01
 
 
-def apply_with_aux(model, params, tokens, train: bool = True):
-    """Forward pass that collects sown MoE load-balancing losses.
-    Returns (logits, total_aux) — total_aux is 0 for dense models."""
-    logits, mut = model.apply(
-        {"params": params}, tokens, train=train, mutable=["intermediates"]
+def _apply_collecting_aux(model, params, tokens, train, return_hidden):
+    """One forward pass collecting sown MoE load-balancing losses — the
+    single implementation behind both the logits and body-only paths, so
+    aux-collection semantics cannot diverge between them."""
+    out, mut = model.apply(
+        {"params": params}, tokens, train=train,
+        return_hidden=return_hidden, mutable=["intermediates"],
     )
     aux = jnp.zeros((), jnp.float32)
     for leaf in jax.tree_util.tree_leaves(mut.get("intermediates", {})):
         aux = aux + jnp.sum(leaf)
-    return logits, aux
+    return out, aux
+
+
+def apply_with_aux(model, params, tokens, train: bool = True):
+    """Forward pass that collects sown MoE load-balancing losses.
+    Returns (logits, total_aux) — total_aux is 0 for dense models."""
+    return _apply_collecting_aux(model, params, tokens, train, False)
 
 
 def apply_body(model, params, tokens, train: bool = True):
     """Body-only forward (no logits projection): returns ([B,S,D] hidden
     states, MoE aux loss). Pair with ops/blocked_ce.py to compute the LM
     loss without materializing [B,S,V] logits."""
-    hidden, mut = model.apply(
-        {"params": params}, tokens, train=train, return_hidden=True,
-        mutable=["intermediates"],
-    )
-    aux = jnp.zeros((), jnp.float32)
-    for leaf in jax.tree_util.tree_leaves(mut.get("intermediates", {})):
-        aux = aux + jnp.sum(leaf)
-    return hidden, aux
+    return _apply_collecting_aux(model, params, tokens, train, True)
 
 
 def lm_train_loss(model, params, tokens) -> jax.Array:
