@@ -163,3 +163,30 @@ def test_suspend_resume_verbs(tmp_path, capsys):
     assert "resumed" in capsys.readouterr().out
     sync()
     assert len(cli.cluster.list_pods()) == 2
+
+
+def test_describe_shows_conditions_replicas_events(tmp_path, capsys):
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    assert _invoke(cli, ["submit", str(path)]) == 0
+    engine = make_engine("TFJob", cli.cluster)
+    from tf_operator_tpu.api import tensorflow as tfapi
+
+    engine.reconcile(tfapi.TFJob.from_dict(
+        cli.cluster.get("TFJob", "default", "mnist")))
+    for pod in cli.cluster.list_pods():
+        pod["status"]["phase"] = "Running"
+        cli.cluster.update_pod(pod)
+    engine.reconcile(tfapi.TFJob.from_dict(
+        cli.cluster.get("TFJob", "default", "mnist")))
+    capsys.readouterr()
+
+    assert _invoke(cli, ["describe", "tfjob", "mnist"]) == 0
+    out = capsys.readouterr().out
+    assert "Name:      mnist" in out
+    assert "State:     Running" in out
+    assert "Worker: active=2" in out
+    assert "Running" in out and "Created" in out  # conditions table
+    assert "mnist-worker-0" in out and "mnist-worker-1" in out
+    assert "JobCreated" in out  # event vocabulary

@@ -227,3 +227,43 @@ def test_packaging_console_entrypoint():
     from tf_operator_tpu.cmd.main import main
 
     assert callable(main)
+
+
+def test_crd_preflight_real_client_blocks_without_crds():
+    """reference server.go:232-251: against a real apiserver the operator
+    refuses to start until the CRDs are installed; FakeCluster (schemaless)
+    skips the check."""
+    from tf_operator_tpu.cmd.main import crd_preflight, run
+    from tf_operator_tpu.e2e.apiserver import ApiServerTransport
+    from tf_operator_tpu.k8s.client import ClusterClient
+
+    backing = FakeCluster()
+    client = ClusterClient(ApiServerTransport(backing))
+    opts = ServerOptions(
+        metrics_bind_address="127.0.0.1:0",
+        health_probe_bind_address="127.0.0.1:0",
+    )
+    with pytest.raises(SystemExit, match="CRDs not installed"):
+        run(opts, cluster=client, block=False)
+
+    missing = crd_preflight(client, opts.all_kinds)
+    assert "tfjobs.kubeflow.org" in missing and len(missing) == 5
+
+    # install the CRDs (as deploy/cluster.py would) -> preflight passes
+    for kind in ("tfjobs", "pytorchjobs", "mxjobs", "xgboostjobs", "tpujobs"):
+        # natural cluster-scoped form (no namespace field): the store keys
+        # it under "" via objects.CLUSTER_SCOPED_KINDS
+        backing.create("CustomResourceDefinition", {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": f"{kind}.kubeflow.org"},
+        })
+    assert crd_preflight(client, opts.all_kinds) == []
+    mgr = run(opts, cluster=client, block=False)
+    try:
+        assert mgr.ready is not None
+    finally:
+        mgr.stop()
+        mgr._probe.stop()
+        mgr._metrics_srv.stop()
+    client.close()

@@ -520,3 +520,35 @@ def test_facade_pod_log_passthrough(rest_cluster):
     fake.append_pod_log("d", "p0", "hello")
     fake.append_pod_log("d", "p0", "world")
     assert c.read_pod_log("d", "p0") == "hello\nworld"
+
+
+def test_facade_cluster_scoped_round_trip(rest_cluster):
+    """A CRD POSTed through the facade must be found by the namespace-less
+    GET (cluster-scoped kinds key under the empty namespace)."""
+    fake, c = rest_cluster
+    c.create("CustomResourceDefinition", {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tfjobs.kubeflow.org"},
+    })
+    got = c.get("CustomResourceDefinition", "", "tfjobs.kubeflow.org")
+    assert got["metadata"]["name"] == "tfjobs.kubeflow.org"
+    c.delete("CustomResourceDefinition", "", "tfjobs.kubeflow.org")
+    with pytest.raises(NotFoundError):
+        c.get("CustomResourceDefinition", "", "tfjobs.kubeflow.org")
+
+
+def test_events_for_namespace_scoping(rest_cluster):
+    """Same-named jobs in different namespaces must not leak each other's
+    events into `describe` (namespace-aware filter on both backends)."""
+    fake, c = rest_cluster
+    for ns in ("team-a", "team-b"):
+        job = {"kind": "TFJob",
+               "metadata": {"name": "mnist", "namespace": ns}}
+        fake.record_event(job, "Normal", "JobCreated", f"created in {ns}")
+        c.record_event(job, "Normal", "JobCreated", f"created in {ns}")
+    a = fake.events_for("mnist", namespace="team-a")
+    assert len(a) == 1 and "team-a" in a[0]["message"]
+    a = c.events_for("mnist", namespace="team-a")
+    assert len(a) == 1 and "team-a" in a[0]["message"]
+    assert len(c.events_for("mnist")) == 2

@@ -48,6 +48,33 @@ def build_cluster(options: ServerOptions):
     return FakeCluster()
 
 
+def crd_preflight(cluster, kinds, log=None) -> list:
+    """Verify each enabled kind's CRD is installed before starting the
+    controllers (reference server.go:124,232-251 — the legacy operator
+    refuses to run against a cluster without its CRDs, which otherwise
+    surfaces as an endless stream of list/watch errors). Returns the list
+    of missing CRD names. A non-404 API error (e.g. 403 from an RBAC
+    policy without the apiextensions read the base ClusterRole grants)
+    skips the check with a warning instead of crashing a correctly
+    installed operator."""
+    from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS
+    from tf_operator_tpu.k8s import objects
+    from tf_operator_tpu.k8s.fake import ApiError, NotFoundError
+
+    missing = []
+    for kind in kinds:
+        name = f"{SUPPORTED_ADAPTERS[kind].PLURAL}.{objects.GROUP_NAME}"
+        try:
+            cluster.get("CustomResourceDefinition", "", name)
+        except NotFoundError:
+            missing.append(name)
+        except ApiError as e:
+            if log is not None:
+                log.warning("CRD preflight skipped (cannot read CRDs): %s", e)
+            return []
+    return missing
+
+
 def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorManager:
     ulog.configure(json_format=options.json_log_format)
     log = ulog.logger_with({"component": "main"})
@@ -57,6 +84,19 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         options.namespace = os.environ.get(NAMESPACE_ENV, "")
 
     cluster = cluster if cluster is not None else build_cluster(options)
+
+    # CRD preflight against a real apiserver only — the in-memory
+    # FakeCluster is schemaless and needs no installed CRDs
+    from tf_operator_tpu.k8s.client import ClusterClient
+
+    if isinstance(cluster, ClusterClient):
+        missing = crd_preflight(cluster, options.all_kinds, log=log)
+        if missing:
+            raise SystemExit(
+                f"CRDs not installed: {', '.join(sorted(missing))} — apply "
+                "manifests/overlays/standalone (kubectl apply -k) first"
+            )
+
     manager = OperatorManager(cluster, options)
 
     health_host, health_port = split_bind_address(options.health_probe_bind_address)

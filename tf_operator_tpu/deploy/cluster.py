@@ -104,8 +104,8 @@ def deploy_operator_client(cluster, repo_root: str,
     applied = []
     for doc in render_overlay(repo_root, overlay, image=image):
         kind = doc.get("kind", "")
-        # cluster-scoped objects live under the store's default-namespace
-        # key (objects.namespace_of), so look them up the same way
+        # cluster-scoped objects key under the empty namespace
+        # (objects.CLUSTER_SCOPED_KINDS via namespace_of)
         ns, name = objects.namespace_of(doc), objects.name_of(doc)
         try:
             existing = cluster.get(kind, ns, name)

@@ -19,13 +19,9 @@ SUPPORTED_KEYS = {
     "patches",
 }
 
-# cluster-scoped kinds never get a namespace stamped on them
-CLUSTER_SCOPED = {
-    "Namespace", "CustomResourceDefinition", "ClusterRole",
-    "ClusterRoleBinding", "PriorityClass", "StorageClass",
-    "ValidatingWebhookConfiguration", "MutatingWebhookConfiguration",
-    "ClusterIssuer",
-}
+# cluster-scoped kinds never get a namespace stamped on them (shared
+# scoping table: k8s/objects.py)
+from tf_operator_tpu.k8s.objects import CLUSTER_SCOPED_KINDS as CLUSTER_SCOPED
 
 
 def _load_yaml_docs(path: str) -> List[Dict[str, Any]]:

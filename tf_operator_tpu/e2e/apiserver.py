@@ -145,6 +145,8 @@ class ApiServerTransport:
     ) -> Tuple[int, Any]:
         try:
             kind, ns, name, sub = _parse_path(path)
+            # cluster-scoped keying is normalized in the store itself
+            # (objects.CLUSTER_SCOPED_KINDS) — no transport-side mapping
             if method == "GET" and name and sub == "log" and kind == "Pod":
                 return 200, self.fake.read_pod_log(ns, name)
             if method == "GET" and name:

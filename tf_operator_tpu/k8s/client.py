@@ -90,6 +90,14 @@ KIND_REGISTRY: Dict[str, KindInfo] = {
 }
 
 
+# the registry's scoping flags must agree with the store's shared table
+# (k8s/objects.py) — divergence would key an object one way in FakeCluster
+# and another in REST paths
+assert {k for k, i in KIND_REGISTRY.items() if i.cluster_scoped} == (
+    objects.CLUSTER_SCOPED_KINDS & set(KIND_REGISTRY)
+), "KIND_REGISTRY cluster_scoped flags diverge from objects.CLUSTER_SCOPED_KINDS"
+
+
 def kind_info(kind: str) -> KindInfo:
     try:
         return KIND_REGISTRY[kind]
@@ -700,13 +708,21 @@ class ClusterClient:
             pass
 
     def events_for(
-        self, name: str, event_type: Optional[str] = None
+        self,
+        name: str,
+        event_type: Optional[str] = None,
+        namespace: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
+        # a namespace argument also scopes the LIST itself, so a
+        # namespace-restricted RBAC principal can still read its events
         out = []
-        for e in self.list("Event", namespace=self.namespace or None):
-            if (e.get("involvedObject") or {}).get("name") != name:
+        for e in self.list("Event", namespace=namespace or self.namespace or None):
+            obj = e.get("involvedObject") or {}
+            if obj.get("name") != name:
                 continue
             if event_type is not None and e.get("type") != event_type:
+                continue
+            if namespace is not None and obj.get("namespace") != namespace:
                 continue
             out.append(e)
         return out

@@ -138,7 +138,7 @@ class FakeCluster:
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
         with self._lock:
             store = self._kind_store(kind)
-            key = f"{namespace}/{name}"
+            key = f"{objects.normalize_namespace(kind, namespace)}/{name}"
             if key not in store:
                 raise NotFoundError(f"{kind} {key}")
             return copy.deepcopy(store[key])
@@ -166,7 +166,7 @@ class FakeCluster:
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             store = self._kind_store(kind)
-            key = f"{namespace}/{name}"
+            key = f"{objects.normalize_namespace(kind, namespace)}/{name}"
             if key not in store:
                 raise NotFoundError(f"{kind} {key}")
             obj = store.pop(key)
@@ -204,6 +204,7 @@ class FakeCluster:
         selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
         with self._lock:
+            namespace = objects.normalize_namespace(kind, namespace)
             out = []
             for obj in self._kind_store(kind).values():
                 if namespace is not None and objects.namespace_of(obj) != namespace:
@@ -286,10 +287,17 @@ class FakeCluster:
             }
         )
 
-    def events_for(self, name: str, event_type: Optional[str] = None) -> List[Dict[str, Any]]:
+    def events_for(
+        self,
+        name: str,
+        event_type: Optional[str] = None,
+        namespace: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
         return [
             e
             for e in self.events
             if e["involvedObject"]["name"] == name
             and (event_type is None or e["type"] == event_type)
+            and (namespace is None
+                 or e["involvedObject"].get("namespace") == namespace)
         ]

@@ -81,8 +81,28 @@ def name_of(obj: Dict[str, Any]) -> str:
     return obj.get("metadata", {}).get("name", "")
 
 
+# Cluster-scoped kinds key under the empty namespace everywhere (store,
+# transport, renderer) — the single source of truth for scoping, so an
+# object seeded directly into FakeCluster and one POSTed through the REST
+# facade agree on their key.
+CLUSTER_SCOPED_KINDS = {
+    "Namespace", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleBinding", "PriorityClass", "StorageClass",
+    "ValidatingWebhookConfiguration", "MutatingWebhookConfiguration",
+    "ClusterIssuer",
+}
+
+
 def namespace_of(obj: Dict[str, Any]) -> str:
+    if obj.get("kind") in CLUSTER_SCOPED_KINDS:
+        return ""
     return obj.get("metadata", {}).get("namespace", "default")
+
+
+def normalize_namespace(kind: str, namespace: Optional[str]) -> Optional[str]:
+    """Caller-supplied namespace for a kind: cluster-scoped kinds always
+    resolve to the empty namespace regardless of what was passed."""
+    return "" if kind in CLUSTER_SCOPED_KINDS else namespace
 
 
 def uid_of(obj: Dict[str, Any]) -> str:

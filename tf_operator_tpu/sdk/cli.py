@@ -7,6 +7,7 @@ common verbs into one command:
   tpu-jobs submit job.yaml                 # create from YAML
   tpu-jobs run-local job.yaml              # run replicas as LOCAL processes
   tpu-jobs get tfjob mnist [-n ns] [-o json|wide]
+  tpu-jobs describe tfjob mnist            # conditions, replicas, events
   tpu-jobs list tpujob [-n ns]
   tpu-jobs wait tfjob mnist --timeout 600  # block until terminal
   tpu-jobs logs tfjob mnist [--replica-type Worker] [--index 0]
@@ -163,6 +164,52 @@ class Cli:
         print(f"{kind.lower()}.kubeflow.org/{name} deleted")
         return 0
 
+    def describe(self, kind: str, name: str, namespace: str) -> int:
+        """kubectl-describe-shaped view: spec summary, replica statuses,
+        conditions, pods, and events for one job."""
+        client = self.client(kind)
+        job = client.get(name, namespace=namespace)
+        md = job.get("metadata", {})
+        status = job.get("status", {})
+        print(f"Name:      {md.get('name', '')}")
+        print(f"Namespace: {md.get('namespace', '')}")
+        print(f"Kind:      {job.get('kind', '')}")
+        print(f"Created:   {md.get('creationTimestamp', '')}")
+        print(f"State:     {_condition_summary(job)}")
+        rs = status.get("replicaStatuses", {}) or {}
+        if rs:
+            print("Replica Statuses:")
+            for rtype in sorted(rs):
+                counts = rs[rtype]
+                line = (f"  {rtype}: active={counts.get('active', 0)} "
+                        f"succeeded={counts.get('succeeded', 0)} "
+                        f"failed={counts.get('failed', 0)}")
+                if counts.get("restarts"):
+                    line += f" restarts={counts['restarts']}"
+                print(line)
+        conds = status.get("conditions", []) or []
+        if conds:
+            print("Conditions:")
+            print(f"  {'TYPE':<12}{'STATUS':<8}{'REASON':<24}LAST TRANSITION")
+            for c in conds:
+                print(f"  {c.get('type', ''):<12}{c.get('status', ''):<8}"
+                      f"{c.get('reason', ''):<24}"
+                      f"{c.get('lastTransitionTime', '')}")
+        pods = sorted(client.get_pod_names(name, namespace=namespace))
+        if pods:
+            print("Pods:")
+            for p in pods:
+                print(f"  {p}")
+        events = self.cluster.events_for(
+            md.get("name", name), namespace=namespace
+        )
+        if events:
+            print("Events:")
+            for e in events:
+                print(f"  {e.get('type', ''):<8}{e.get('reason', ''):<28}"
+                      f"{e.get('message', '')}")
+        return 0
+
     def suspend(self, kind: str, name: str, namespace: str) -> int:
         self.client(kind).suspend(name, namespace=namespace)
         print(f"{kind.lower()}.kubeflow.org/{name} suspended")
@@ -224,8 +271,8 @@ def make_parser() -> argparse.ArgumentParser:
     pr.add_argument("file", help="job YAML ('-' for stdin)")
     pr.add_argument("--timeout", type=float, default=300.0)
 
-    for verb in ("get", "wait", "pods", "logs", "delete", "suspend",
-                 "resume"):
+    for verb in ("get", "describe", "wait", "pods", "logs", "delete",
+                 "suspend", "resume"):
         pv = sub.add_parser(verb, parents=[common])
         pv.add_argument("kind")
         pv.add_argument("name")
@@ -254,6 +301,8 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
     kind = resolve_kind(args.kind)
     if args.verb == "get":
         return cli.get(kind, args.name, ns, args.output)
+    if args.verb == "describe":
+        return cli.describe(kind, args.name, ns)
     if args.verb == "list":
         return cli.list(kind, ns)
     if args.verb == "wait":
