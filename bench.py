@@ -370,6 +370,43 @@ def bench_flash_attention(gen: str):
         }
     results["shape"] = f"b{b} s{s} h{h} d{d} bf16 fwd+bwd"
 
+    # long-context point (S=8192, causal): the regime where the einsum
+    # path's O(S^2) score materialization starts to hurt (BASELINE.md)
+    try:
+        s_long = 8192
+        ql = jax.random.normal(kq, (1, s_long, h, d), jnp.bfloat16)
+        kl = jax.random.normal(kk, (1, s_long, h, d), jnp.bfloat16)
+        vl = jax.random.normal(kv, (1, s_long, h, d), jnp.bfloat16)
+
+        def loss_flash_l(q, k, v):
+            return flash_attention(q, k, v, causal=True,
+                                   interpret=False).astype(jnp.float32).sum()
+
+        def loss_ref_l(q, k, v):
+            return dot_product_attention(q, k, v, True).astype(
+                jnp.float32).sum()
+
+        fl = jax.jit(jax.value_and_grad(loss_flash_l, argnums=(0, 1, 2)))
+        rl = jax.jit(jax.value_and_grad(loss_ref_l, argnums=(0, 1, 2)))
+
+        def timed_l(fn, n=5):
+            fn(ql, kl, vl)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out, _ = fn(ql, kl, vl)
+            float(jax.device_get(out))
+            return (time.perf_counter() - t0) / n
+
+        t_flash = timed_l(fl)
+        t_ref = timed_l(rl)
+        results["causal_s8192"] = {
+            "flash_ms": round(t_flash * 1e3, 2),
+            "einsum_ms": round(t_ref * 1e3, 2),
+            "speedup": round(t_ref / t_flash, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+        results["causal_s8192"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # ring-flash (ops/ring_flash.py) compiled on a 1-device mesh (ring of
     # one): validates the carry-kernel + SMEM-offset Mosaic lowering on
     # hardware even though multi-chip rings need a real slice
@@ -464,6 +501,64 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4):
         "create_to_all_running_s": round(dt, 3),
         "jobs_per_sec": round(n_jobs / dt, 1) if dt > 0 else None,
     }
+
+
+def bench_data_loader(n_records: int = 20000, batch: int = 256):
+    """Host input-pipeline throughput: the C++ prefetching record loader
+    (native/dataloader.cc) vs the numpy fallback on one ResNet-shaped
+    shard — records/sec feeding the host, independent of the TPU."""
+    import tempfile
+
+    import numpy as np
+
+    from tf_operator_tpu.data.loader import (
+        FieldSpec, RecordLoader, write_records,
+    )
+
+    fields = [
+        FieldSpec("image", (64, 64, 3), np.uint8),
+        FieldSpec("label", (), np.int32),
+    ]
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench.rec")
+        write_records(path, fields, {
+            "image": np.zeros((n_records, 64, 64, 3), np.uint8),
+            "label": np.zeros((n_records,), np.int32),
+        })
+        for mode, force_python in (("native", False), ("python", True)):
+            loader = RecordLoader(
+                [path], fields, batch, shuffle=True, loop=True,
+                force_python=force_python,
+            )
+            if mode == "native" and not loader.using_native:
+                out[mode] = {"error": "native loader unavailable"}
+                continue
+            it = iter(loader)
+            try:
+                next(it)  # warm the prefetch pipeline
+                n_batches = 50
+                t0 = time.perf_counter()
+                for _ in range(n_batches):
+                    next(it)
+                dt = time.perf_counter() - t0
+            finally:
+                # deterministic cleanup: the generator's finally block frees
+                # the native handle/fds before the TemporaryDirectory goes
+                it.close()
+            out[mode] = {
+                "records_per_sec": round(n_batches * batch / dt),
+                "mb_per_sec": round(
+                    n_batches * batch * (64 * 64 * 3 + 4) / dt / 2**20, 1
+                ),
+            }
+    if "records_per_sec" in out.get("native", {}) and \
+            "records_per_sec" in out.get("python", {}):
+        out["native_speedup"] = round(
+            out["native"]["records_per_sec"]
+            / out["python"]["records_per_sec"], 2,
+        )
+    return out
 
 
 def bench_startup_latency(runs: int = 5):
@@ -606,6 +701,11 @@ def main() -> int:
         extra["operator_scale"] = bench_operator_scale()
     except Exception as e:  # noqa: BLE001 — surfaced, not fatal
         extra["operator_scale"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    try:
+        extra["data_loader"] = bench_data_loader()
+    except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+        extra["data_loader"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     baseline = REFERENCE_IMG_PER_SEC_PER_CHIP[gen]
     result = {

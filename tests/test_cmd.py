@@ -267,3 +267,31 @@ def test_crd_preflight_real_client_blocks_without_crds():
         mgr._probe.stop()
         mgr._metrics_srv.stop()
     client.close()
+
+
+def test_reconcile_duration_histogram_observed_and_exposed():
+    metrics.RECONCILE_DURATION.reset()
+    cluster = FakeCluster()
+    mgr = OperatorManager(cluster, ServerOptions())
+    mgr.start()
+    try:
+        cluster.create("TFJob", testutil.new_tfjob("histo").to_dict())
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and metrics.RECONCILE_DURATION.count({"kind": "TFJob"}) == 0):
+            time.sleep(0.02)
+    finally:
+        mgr.stop()
+    assert metrics.RECONCILE_DURATION.count({"kind": "TFJob"}) >= 1
+    text = metrics.expose_all()
+    assert 'tpu_operator_reconcile_duration_seconds_bucket{kind="TFJob",le="+Inf"}' in text
+    assert "tpu_operator_reconcile_duration_seconds_sum" in text
+    assert "tpu_operator_reconcile_duration_seconds_count" in text
+    # buckets are cumulative: le=+Inf >= le=10
+    import re
+
+    buckets = dict(re.findall(
+        r'reconcile_duration_seconds_bucket\{kind="TFJob",le="([^"]+)"\} (\d+)',
+        text,
+    ))
+    assert int(buckets["+Inf"]) >= int(buckets["10"])

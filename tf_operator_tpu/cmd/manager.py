@@ -103,8 +103,8 @@ class _KindController:
             return  # deleted; nothing to reconcile
         job = self.engine.adapter.from_dict(raw)
         result = self.engine.reconcile(job)
-        metrics.RECONCILE_LATENCY.inc(
-            {"kind": self.kind}, amount=time.monotonic() - t0
+        metrics.RECONCILE_DURATION.observe(
+            time.monotonic() - t0, {"kind": self.kind}
         )
         if result.error:
             if self.queue.num_requeues(key) < MAX_RECONCILE_RETRIES:
