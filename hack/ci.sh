@@ -10,6 +10,9 @@ stage() { echo; echo "=== $1 ==="; }
 stage "build: native runtime core"
 make native
 
+stage "native: tsan concurrency stress (the -race the reference never runs)"
+bash hack/native_tsan.sh
+
 stage "lint: python compile check"
 python -m compileall -q tf_operator_tpu hack examples tests
 

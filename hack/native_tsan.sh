@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# ThreadSanitizer run of the native runtime's concurrency stress driver
+# (native/stress_main.cc).  SURVEY.md §5.2: the reference's `make test`
+# never passes -race; this is the C++ analogue, run as a CI stage.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out=$(mktemp -d)
+trap 'rm -rf "$out"' EXIT
+
+g++ -std=c++17 -O1 -g -fsanitize=thread -fno-omit-frame-pointer \
+    -Inative \
+    native/workqueue.cc native/expectations.cc native/stress_main.cc \
+    -o "$out/native_stress" -lpthread
+
+# halt_on_error: any data race fails CI loudly; the outer timeout bounds
+# any unforeseen hang (TSan slows scheduling 5-20x)
+TSAN_OPTIONS="halt_on_error=1" timeout 120 "$out/native_stress"
+echo "native tsan stress: PASS"

@@ -1,0 +1,103 @@
+// Concurrency stress driver for the native runtime, built to run under
+// ThreadSanitizer (hack/native_tsan.sh).  SURVEY.md §5.2: the reference's
+// `make test` never passes -race; this harness races the C++ workqueue and
+// expectations the way the live manager does (N producers enqueueing /
+// rate-limiting / forgetting keys while M consumers drain, plus a
+// shutdown-while-blocked exit) and exits nonzero on any detected race or
+// invariant breach.
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpuoperator.h"
+
+namespace {
+
+constexpr int kProducers = 4;
+constexpr int kConsumers = 4;
+constexpr int kKeys = 32;
+constexpr int kOpsPerProducer = 2000;
+
+std::atomic<long long> processed{0};
+std::atomic<bool> failed{false};
+std::atomic<bool> shutting_down{false};
+
+void producer(void* wq, void* exp, int id) {
+  for (int i = 0; i < kOpsPerProducer; ++i) {
+    std::string key = "job-" + std::to_string((id * 31 + i) % kKeys);
+    switch (i % 5) {
+      case 0: wq_add(wq, key.c_str()); break;
+      case 1: wq_add_rate_limited(wq, key.c_str()); break;
+      case 2: wq_add_after(wq, key.c_str(), 0.1); break;
+      case 3: wq_forget(wq, key.c_str()); break;
+      default: wq_add(wq, key.c_str()); break;
+    }
+    exp_raise(exp, key.c_str(), 1, 0);
+    exp_lower(exp, key.c_str(), 1, 0);
+    (void)exp_satisfied(exp, key.c_str());
+    if (i % 64 == 0) exp_delete(exp, key.c_str());
+  }
+}
+
+void consumer(void* wq) {
+  char buf[256];
+  while (true) {
+    int n = wq_get(wq, 50.0, buf, sizeof(buf));
+    if (n < 0) {
+      // idle timeout is NOT exit: a rate-limited item may still be in the
+      // delay heap (backoff cap == this timeout); only shutdown ends us
+      if (shutting_down.load()) return;
+      continue;
+    }
+    bool ok = static_cast<size_t>(n) == std::strlen(buf);
+    if (!ok) {
+      std::fprintf(stderr, "length/content mismatch: %d vs %zu\n", n,
+                   std::strlen(buf));
+      failed = true;
+    }
+    processed.fetch_add(1);
+    wq_done(wq, buf);  // always: a key stuck in `processing` wedges drain
+    if (!ok) return;
+  }
+}
+
+}  // namespace
+
+int main() {
+  void* wq = wq_new(1.0, 50.0);
+  void* exp = exp_new(30000.0);
+
+  std::vector<std::thread> threads;
+  threads.reserve(kProducers + kConsumers);
+  for (int c = 0; c < kConsumers; ++c) threads.emplace_back(consumer, wq);
+  for (int p = 0; p < kProducers; ++p)
+    threads.emplace_back(producer, wq, exp, p);
+  for (int p = 0; p < kProducers; ++p) threads[kConsumers + p].join();
+
+  // drain with a deadline (dedup keeps `processed` well below the op
+  // count, and a detected failure must reach the report, not hang), then
+  // shut down while consumers may be blocked in wq_get — the exact
+  // teardown path OperatorManager.stop() exercises
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(10);
+  while (!wq_empty(wq) && !failed.load() &&
+         std::chrono::steady_clock::now() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  shutting_down = true;
+  wq_shutdown(wq);
+  for (int c = 0; c < kConsumers; ++c) threads[c].join();
+
+  wq_free(wq);
+  exp_free(exp);
+
+  if (failed.load() || processed.load() == 0) {
+    std::fprintf(stderr, "stress failed: processed=%lld\n", processed.load());
+    return 1;
+  }
+  std::printf("native stress ok: processed=%lld keys=%d threads=%d\n",
+              processed.load(), kKeys, kProducers + kConsumers);
+  return 0;
+}

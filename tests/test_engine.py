@@ -628,3 +628,89 @@ def test_suspend_preserves_exit_code_restart_counter():
     _set_suspend(cluster, job, True)
     job, _ = reconcile(cluster, engine, job)
     assert job.status.replica_statuses["Worker"].restarts == 1
+
+
+# ---------------------------------------------------------------------------
+# normal-path matrix (reference TestNormalPath, controller_test.go:68: a
+# table over per-type pod phases -> expected replica statuses + condition)
+# ---------------------------------------------------------------------------
+
+R, P, S, F = "Running", "Pending", "Succeeded", "Failed"
+
+NORMAL_PATH_TABLE = [
+    # (worker phases, ps phases, chief phases, success_policy,
+    #  expected {type: (active, succeeded, failed)}, expected condition)
+    (["Pending", "Pending"], [], [], "",
+     {"Worker": (0, 0, 0)}, common.JOB_CREATED),
+    ([R, R], [], [], "",
+     {"Worker": (2, 0, 0)}, common.JOB_RUNNING),
+    ([R, P], [R], [], "",
+     {"Worker": (1, 0, 0), "PS": (1, 0, 0)}, common.JOB_RUNNING),
+    # worker-0 success completes the job under the default policy
+    ([S, R], [R], [], "",
+     {"Worker": (1, 1, 0), "PS": (1, 0, 0)}, common.JOB_SUCCEEDED),
+    # non-0 worker success does NOT complete it
+    ([R, S], [], [], "",
+     {"Worker": (1, 1, 0)}, common.JOB_RUNNING),
+    # AllWorkers: partial success keeps running, full success completes
+    ([S, R], [], [], "AllWorkers",
+     {"Worker": (1, 1, 0)}, common.JOB_RUNNING),
+    ([S, S], [], [], "AllWorkers",
+     {"Worker": (0, 2, 0)}, common.JOB_SUCCEEDED),
+    # any failure (restartPolicy Never) fails the job
+    ([R, F], [], [], "",
+     {"Worker": (1, 0, 1)}, common.JOB_FAILED),
+    ([R, R], [F], [], "",
+     {"Worker": (2, 0, 0), "PS": (0, 0, 1)}, common.JOB_FAILED),
+    # chief presence: workers succeeding doesn't finish while chief runs
+    ([S, S], [], [R], "",
+     {"Worker": (0, 2, 0), "Chief": (1, 0, 0)}, common.JOB_RUNNING),
+    ([R, R], [], [S], "",
+     {"Worker": (2, 0, 0), "Chief": (0, 1, 0)}, common.JOB_SUCCEEDED),
+    ([R, R], [], [F], "",
+     {"Worker": (2, 0, 0), "Chief": (0, 0, 1)}, common.JOB_FAILED),
+    # mixed terminals in one pass: PS failure wins over worker-0 success
+    # (first terminal sticks — the job must not be Failed AND Succeeded)
+    ([S, R], [F], [], "",
+     {"Worker": (1, 1, 0), "PS": (0, 0, 1)}, common.JOB_FAILED),
+]
+
+
+@pytest.mark.parametrize(
+    "workers,ps,chief,success_policy,expected,condition", NORMAL_PATH_TABLE
+)
+def test_normal_path_matrix(workers, ps, chief, success_policy,
+                            expected, condition):
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(
+        worker=len(workers), ps=len(ps), chief=len(chief),
+    )
+    if success_policy:
+        job.success_policy = success_policy
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+
+    for rtype, phases in (("worker", workers), ("ps", ps), ("chief", chief)):
+        for i, phase in enumerate(phases):
+            if phase == "Pending":
+                continue  # pods are created Pending
+            pod = cluster.get_pod("default", f"test-tfjob-{rtype}-{i}")
+            set_phase(cluster, pod, phase,
+                      exit_code=0 if phase == S else (1 if phase == F else None))
+    job, _ = reconcile(cluster, engine, job)
+
+    for rtype, (active, succeeded, failed) in expected.items():
+        rs = job.status.replica_statuses[rtype]
+        assert (rs.active, rs.succeeded, rs.failed) == (
+            active, succeeded, failed
+        ), f"{rtype}: {(rs.active, rs.succeeded, rs.failed)}"
+    assert common.has_condition(job.status, condition), (
+        condition, [c.to_dict() for c in job.status.conditions]
+    )
+    # terminal exclusivity: a finished job is never also Running, and never
+    # carries both terminal conditions
+    if condition in (common.JOB_SUCCEEDED, common.JOB_FAILED):
+        assert not common.is_running(job.status)
+        other = (common.JOB_FAILED if condition == common.JOB_SUCCEEDED
+                 else common.JOB_SUCCEEDED)
+        assert not common.has_condition(job.status, other)

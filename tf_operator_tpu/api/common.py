@@ -315,6 +315,19 @@ def update_job_conditions(
     the mutually-exclusive ones (Running vs Restarting vs terminal), matching
     kubeflow/common's filterOutCondition behavior observed in reference
     status transitions (status.go:120-211)."""
+    # terminal conditions are sticky: once Succeeded/Failed is True, a later
+    # replica-type pass in the same status update must not re-promote
+    # Running/Restarting/Suspended (e.g. PS failed -> Failed, then the
+    # worker loop sees running workers — the job is still Failed), and must
+    # not stack the OTHER terminal on top (PS failed + worker-0 succeeded
+    # is a Failed job, not both) — first terminal wins.
+    if is_finished(status):
+        if cond_type in (JOB_RUNNING, JOB_RESTARTING, JOB_SUSPENDED):
+            return
+        if cond_type == JOB_SUCCEEDED and is_failed(status):
+            return
+        if cond_type == JOB_FAILED and is_succeeded(status):
+            return
     new_cond = JobCondition(
         type=cond_type,
         status="True",

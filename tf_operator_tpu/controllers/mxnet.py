@@ -115,6 +115,8 @@ class MXNetAdapter(FrameworkAdapter):
         failures restart, others fail."""
         status = ctx.status
         for rtype in sorted(ctx.replicas):
+            if common.is_finished(status):
+                break  # first terminal condition wins (events/metrics too)
             spec = ctx.replicas[rtype]
             expected, running, succeeded, failed = ctx.counts(rtype)
             if running > 0:

@@ -24,6 +24,8 @@ def master_based_update_job_status(
     for rtype in [master_type] + [rt for rt in ctx.replicas if rt != master_type]:
         if rtype not in ctx.replicas:
             continue
+        if common.is_finished(status):
+            break  # first terminal condition wins (events/metrics too)
         spec = ctx.replicas[rtype]
         expected, running, succeeded, failed = ctx.counts(rtype)
 

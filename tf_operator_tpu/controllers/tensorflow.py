@@ -165,6 +165,10 @@ class TFAdapter(FrameworkAdapter):
         has_chief = tfapi.contains_chief_or_master(job)
 
         for rtype in self.replica_order(ctx.replicas):
+            if common.is_finished(status):
+                # first terminal condition wins — later types must not fire
+                # success/failure events or metrics on a finished job
+                break
             expected, running, succeeded, failed = ctx.counts(rtype)
 
             if has_chief:
