@@ -675,6 +675,37 @@ NORMAL_PATH_TABLE = [
      {"Worker": (1, 1, 0), "PS": (0, 0, 1)}, common.JOB_FAILED),
 ]
 
+EVALUATOR_TABLE = [
+    # evaluator is observational: worker-0 success completes the job even
+    # while the evaluator runs (reference ordering Chief->Evaluator->...;
+    # status.go:95-101), but an evaluator FAILURE fails the job
+    ([S, R], [R], {"Worker": (1, 1, 0), "Evaluator": (1, 0, 0)},
+     common.JOB_SUCCEEDED),
+    ([R, R], [F], {"Worker": (2, 0, 0), "Evaluator": (0, 0, 1)},
+     common.JOB_FAILED),
+]
+
+
+@pytest.mark.parametrize("workers,evaluator,expected,condition",
+                         EVALUATOR_TABLE)
+def test_evaluator_matrix(workers, evaluator, expected, condition):
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(
+        worker=len(workers), evaluator=len(evaluator)))
+    job, _ = reconcile(cluster, engine, job)
+    for rtype, phases in (("worker", workers), ("evaluator", evaluator)):
+        for i, phase in enumerate(phases):
+            if phase == "Pending":
+                continue
+            pod = cluster.get_pod("default", f"test-tfjob-{rtype}-{i}")
+            set_phase(cluster, pod, phase,
+                      exit_code=0 if phase == S else (1 if phase == F else None))
+    job, _ = reconcile(cluster, engine, job)
+    for rtype, (active, succeeded, failed) in expected.items():
+        rs = job.status.replica_statuses[rtype]
+        assert (rs.active, rs.succeeded, rs.failed) == (active, succeeded, failed)
+    assert common.has_condition(job.status, condition)
+
 
 @pytest.mark.parametrize(
     "workers,ps,chief,success_policy,expected,condition", NORMAL_PATH_TABLE
@@ -714,3 +745,52 @@ def test_normal_path_matrix(workers, ps, chief, success_policy,
         other = (common.JOB_FAILED if condition == common.JOB_SUCCEEDED
                  else common.JOB_SUCCEEDED)
         assert not common.has_condition(job.status, other)
+
+
+# ---------------------------------------------------------------------------
+# adoption preconditions (reference RecheckDeletionTimestamp,
+# tfjob_controller.go:277-287 + client-go ControllerRefManager)
+# ---------------------------------------------------------------------------
+
+
+def _orphan_pod(cluster, job, index=0, terminating=False):
+    from tf_operator_tpu.k8s import objects as k8sobj
+
+    pod = k8sobj.make_pod(
+        f"{job.name}-worker-{index}",
+        labels={
+            k8sobj.LABEL_GROUP_NAME: k8sobj.GROUP_NAME,
+            k8sobj.LABEL_JOB_NAME: job.name,
+            k8sobj.LABEL_REPLICA_TYPE: "worker",
+            k8sobj.LABEL_REPLICA_INDEX: str(index),
+        },
+    )
+    if terminating:
+        pod["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    cluster.create_pod(pod)
+    return pod
+
+
+def test_terminating_orphan_not_adopted():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    _orphan_pod(cluster, job, terminating=True)
+    pods = engine.get_pods_for_job(
+        engine.adapter.from_dict(cluster.get(job.kind, "default", job.name))
+    )
+    assert pods == []  # not claimed; no ownerReference written
+    stored = cluster.get_pod("default", f"{job.name}-worker-0")
+    assert not stored["metadata"].get("ownerReferences")
+
+
+def test_deleting_job_does_not_adopt_orphans():
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    doc = cluster.get(job.kind, "default", job.name)
+    doc["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    cluster.update(job.kind, doc)
+    _orphan_pod(cluster, job)
+    fresh = engine.adapter.from_dict(cluster.get(job.kind, "default", job.name))
+    assert engine.get_pods_for_job(fresh) == []
+    stored = cluster.get_pod("default", f"{job.name}-worker-0")
+    assert not stored["metadata"].get("ownerReferences")

@@ -182,10 +182,23 @@ class JobEngine:
         recreated job (same name, new UID) must NOT adopt the old
         incarnation's terminating objects (reference UID recheck,
         tfjob_controller.go:277-287)."""
+        can_adopt: Optional[bool] = None  # lazily computed, once per call
         claimed = []
         for item in items:
             ref = objects.get_controller_of(item)
             if ref is None:
+                # never adopt a terminating orphan (client-go
+                # ControllerRefManager AdoptPod precondition)
+                if objects.pod_deleted(item):
+                    continue
+                # ... and never adopt while the job itself is being deleted;
+                # the uncached recheck costs one API read, so it only runs
+                # when there actually is an orphan to adopt (the reference
+                # wraps it in sync.Once the same way)
+                if can_adopt is None:
+                    can_adopt = self._can_adopt(job)
+                if not can_adopt:
+                    continue
                 item["metadata"].setdefault("ownerReferences", []).append(
                     objects.owner_reference(
                         {"apiVersion": job.api_version, "kind": job.kind,
@@ -196,6 +209,21 @@ class JobEngine:
             elif ref.get("uid") == job.uid:
                 claimed.append(item)
         return claimed
+
+    def _can_adopt(self, job: Job) -> bool:
+        """reference RecheckDeletionTimestamp (tfjob_controller.go:278): a
+        fresh uncached read must confirm the job is the same incarnation
+        (UID) and not being deleted before any adoption happens. A missing
+        job means no adoption; any other read error propagates so the sync
+        aborts and retries instead of silently skipping adoption."""
+        from tf_operator_tpu.k8s.fake import NotFoundError
+
+        try:
+            current = self.cluster.get(job.kind, job.namespace, job.name)
+        except NotFoundError:
+            return False
+        meta = current.get("metadata", {})
+        return meta.get("uid") == job.uid and not meta.get("deletionTimestamp")
 
     def get_pods_for_job(self, job: Job) -> List[Dict[str, Any]]:
         """List by GenLabels selector, then adopt/claim

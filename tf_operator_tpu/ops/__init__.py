@@ -7,8 +7,16 @@ non-matmul cost of ladder configs #4/#5 (BASELINE.md) — gets a fused
 pallas kernel (flash_attention) plus a sequence-parallel ring variant
 (ring_attention) for long context over the ICI mesh.
 """
+from tf_operator_tpu.ops.blocked_ce import (  # noqa: F401
+    blocked_cross_entropy,
+    lm_blocked_loss,
+)
 from tf_operator_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from tf_operator_tpu.ops.ring_attention import (  # noqa: F401
     make_ring_attention_fn,
     ring_attention,
 )
+from tf_operator_tpu.ops.ring_flash import (  # noqa: F401
+    make_ring_flash_attention_fn,
+)
+from tf_operator_tpu.ops.ulysses import make_ulysses_attention_fn  # noqa: F401
