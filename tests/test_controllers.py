@@ -229,3 +229,123 @@ def test_recreated_job_does_not_adopt_old_incarnation_pods():
     assert result.error is None
     assert len(cluster.list_pods()) == 1
     assert not common.is_failed(job2.status)
+
+
+# ---------------------------------------------------------------------------
+# elastic PyTorchJob (modern training-operator semantics; no reference
+# counterpart — torchrun rendezvous instead of static MASTER_*/RANK)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_ptjob(name="elastic", workers=2, **policy):
+    return ptapi.PyTorchJob(
+        metadata=objects.make_meta(name) | {"uid": objects.new_uid()},
+        replica_specs={
+            "Worker": common.ReplicaSpec(
+                replicas=workers, template=copy.deepcopy(_template("pytorch"))
+            )
+        },
+        elastic_policy=ptapi.ElasticPolicy(**policy),
+    )
+
+
+def test_elastic_pytorch_env_and_lifecycle():
+    cluster = FakeCluster()
+    engine = make_engine("PyTorchJob", cluster)
+    job = _elastic_ptjob(workers=2, min_replicas=1, max_replicas=4,
+                         n_proc_per_node=8, max_restarts=3)
+    cluster.create(job.kind, job.to_dict())
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", "elastic"))
+    engine.reconcile(fresh)
+
+    pods = cluster.list_pods()
+    assert len(pods) == 2  # no Master pod: rendezvous replaces it
+    env = {e["name"]: e["value"]
+           for e in pods[0]["spec"]["containers"][0]["env"]}
+    assert env["PET_RDZV_BACKEND"] == "c10d"
+    assert env["PET_RDZV_ENDPOINT"] == "elastic-worker-0:29400"
+    assert env["PET_RDZV_ID"] == "elastic"
+    assert env["PET_NNODES"] == "1:4"
+    assert env["PET_NPROC_PER_NODE"] == "8"
+    assert env["PET_MAX_RESTARTS"] == "3"
+    assert "MASTER_ADDR" not in env and "RANK" not in env
+    # worker-0 carries the master role label (rendezvous host)
+    w0 = cluster.get_pod("default", "elastic-worker-0")
+    assert objects.labels_of(w0).get(objects.LABEL_JOB_ROLE) == "master"
+
+    # any worker completing cleanly completes the job
+    for p in cluster.list_pods():
+        p["status"]["phase"] = objects.POD_RUNNING
+        cluster.update_pod(p)
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", "elastic"))
+    engine.reconcile(fresh)
+    assert common.is_running(fresh.status)
+    w0 = cluster.get_pod("default", "elastic-worker-0")
+    w0["status"]["phase"] = objects.POD_SUCCEEDED
+    cluster.update_pod(w0)
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", "elastic"))
+    engine.reconcile(fresh)
+    assert common.is_succeeded(fresh.status)
+
+
+def test_elastic_pytorch_scale_within_bounds():
+    cluster = FakeCluster()
+    engine = make_engine("PyTorchJob", cluster)
+    job = _elastic_ptjob(workers=2, min_replicas=1, max_replicas=4)
+    cluster.create(job.kind, job.to_dict())
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", "elastic"))
+    engine.reconcile(fresh)
+    assert len(cluster.list_pods()) == 2
+    # scale up within bounds: index-slice diffing adds workers, env stable
+    doc = cluster.get(job.kind, "default", "elastic")
+    doc["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] = 4
+    cluster.update(job.kind, doc)
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", "elastic"))
+    engine.reconcile(fresh)
+    assert len(cluster.list_pods()) == 4
+    env = {e["name"]: e["value"] for e in cluster.get_pod(
+        "default", "elastic-worker-3")["spec"]["containers"][0]["env"]}
+    assert env["PET_RDZV_ENDPOINT"] == "elastic-worker-0:29400"
+
+
+def test_elastic_pytorch_validation():
+    from tf_operator_tpu.api import pytorch as ptapi
+
+    # min > max rejected
+    job = _elastic_ptjob(min_replicas=4, max_replicas=2)
+    with pytest.raises(Exception, match="minReplicas"):
+        ptapi.set_defaults(job) or ptapi.validate(job)
+    # replicas outside bounds rejected
+    job = _elastic_ptjob(workers=8, min_replicas=1, max_replicas=4)
+    ptapi.set_defaults(job)
+    with pytest.raises(Exception, match="maxReplicas"):
+        ptapi.validate(job)
+    # maxReplicas is mandatory (PET_NNODES must not drift with replicas)
+    job = _elastic_ptjob(min_replicas=1)
+    ptapi.set_defaults(job)
+    with pytest.raises(Exception, match="maxReplicas is required"):
+        ptapi.validate(job)
+    # a static Master and a rendezvous are mutually exclusive
+    job = _elastic_ptjob(min_replicas=1, max_replicas=4)
+    job.replica_specs["Master"] = common.ReplicaSpec(
+        replicas=1, template=copy.deepcopy(_template("pytorch"))
+    )
+    ptapi.set_defaults(job)
+    with pytest.raises(Exception, match="mutually exclusive"):
+        ptapi.validate(job)
+    # minReplicas defaults to 1 (constant — never derived from replicas)
+    job = _elastic_ptjob(workers=2, max_replicas=4)
+    ptapi.set_defaults(job)
+    ptapi.validate(job)
+    assert job.elastic_policy.min_replicas == 1
+    # non-elastic without master still rejected
+    job = _elastic_ptjob()
+    job.elastic_policy = None
+    ptapi.set_defaults(job)
+    with pytest.raises(Exception, match="Master"):
+        ptapi.validate(job)

@@ -6,7 +6,7 @@ constants.go} + pkg/apis/pytorch/validation/validation.go.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from tf_operator_tpu.api import common, job as jobapi
 
@@ -23,17 +23,88 @@ DEFAULT_CONTAINER_NAME = "pytorch"
 DEFAULT_PORT = 23456
 DEFAULT_RESTART_POLICY = common.RESTART_POLICY_ON_FAILURE
 
+# torch elastic rendezvous defaults (modern training-operator
+# PyTorchJob.spec.elasticPolicy; absent in the reference snapshot)
+DEFAULT_RDZV_BACKEND = "c10d"
+DEFAULT_RDZV_PORT = 29400
+
+
+@dataclass
+class ElasticPolicy:
+    """Torchrun/torch-elastic knobs. When present, the worker count may
+    float between min and max (edit replicas; the engine's index-slice
+    diffing scales pods) and the operator injects PET_* rendezvous env
+    instead of static MASTER_*/RANK/WORLD_SIZE — torchrun negotiates
+    membership itself."""
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    rdzv_backend: str = DEFAULT_RDZV_BACKEND
+    rdzv_port: int = DEFAULT_RDZV_PORT
+    rdzv_host: Optional[str] = None
+    rdzv_id: Optional[str] = None
+    n_proc_per_node: Optional[int] = None
+    max_restarts: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.min_replicas is not None:
+            d["minReplicas"] = self.min_replicas
+        if self.max_replicas is not None:
+            d["maxReplicas"] = self.max_replicas
+        if self.rdzv_backend != DEFAULT_RDZV_BACKEND:
+            d["rdzvBackend"] = self.rdzv_backend
+        if self.rdzv_port != DEFAULT_RDZV_PORT:
+            d["rdzvPort"] = self.rdzv_port
+        if self.rdzv_host is not None:
+            d["rdzvHost"] = self.rdzv_host
+        if self.rdzv_id is not None:
+            d["rdzvId"] = self.rdzv_id
+        if self.n_proc_per_node is not None:
+            d["nProcPerNode"] = self.n_proc_per_node
+        if self.max_restarts is not None:
+            d["maxRestarts"] = self.max_restarts
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["ElasticPolicy"]:
+        if d is None:
+            return None
+        return cls(
+            min_replicas=d.get("minReplicas"),
+            max_replicas=d.get("maxReplicas"),
+            rdzv_backend=d.get("rdzvBackend", DEFAULT_RDZV_BACKEND),
+            rdzv_port=d.get("rdzvPort", DEFAULT_RDZV_PORT),
+            rdzv_host=d.get("rdzvHost"),
+            rdzv_id=d.get("rdzvId"),
+            n_proc_per_node=d.get("nProcPerNode"),
+            max_restarts=d.get("maxRestarts"),
+        )
+
 
 @dataclass
 class PyTorchJob(jobapi.Job):
     kind: str = KIND
+    elastic_policy: Optional[ElasticPolicy] = None
 
     def replica_specs_key(self) -> str:
         return "pytorchReplicaSpecs"
 
+    def extra_spec_to_dict(self) -> Dict[str, Any]:
+        if self.elastic_policy is None:
+            return {}
+        # {} still round-trips presence (all-default policy)
+        return {"elasticPolicy": self.elastic_policy.to_dict()}
+
+    def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
+        self.elastic_policy = ElasticPolicy.from_dict(spec.get("elasticPolicy"))
+
 
 def set_defaults(job: PyTorchJob) -> None:
-    """Reference pkg/apis/pytorch/v1/defaults.go:36-58."""
+    """Reference pkg/apis/pytorch/v1/defaults.go:36-58 (+ elastic bound
+    defaulting: minReplicas -> 1, a CONSTANT — deriving bounds from the
+    current replica count would bake different PET_NNODES into pods
+    created before and after a scale edit)."""
     jobapi.apply_common_defaults(
         job,
         REPLICA_TYPES,
@@ -42,16 +113,61 @@ def set_defaults(job: PyTorchJob) -> None:
         DEFAULT_PORT,
         DEFAULT_RESTART_POLICY,
     )
+    if job.elastic_policy is not None and job.elastic_policy.min_replicas is None:
+        job.elastic_policy.min_replicas = 1
 
 
 def validate(job: PyTorchJob) -> None:
     """Reference ValidateV1PyTorchJobSpec: valid replica types only, exactly
-    one Master replica required (pkg/apis/pytorch/validation/validation.go)."""
+    one Master replica required (pkg/apis/pytorch/validation/validation.go).
+    With an elasticPolicy (modern semantics) the Master is optional —
+    torchrun's rendezvous replaces the static master — and the Worker count
+    must sit within [minReplicas, maxReplicas]."""
     jobapi.validate_replica_specs(
         job, DEFAULT_CONTAINER_NAME, valid_types=REPLICA_TYPES, kind=KIND
     )
     specs = job.replica_specs or {}
     master = specs.get(REPLICA_MASTER)
+    if job.elastic_policy is not None:
+        ep = job.elastic_policy
+        if master is not None:
+            # a static Master and a floating rendezvous are incoherent: the
+            # master pod would join (and overflow) the torchrun group
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: elasticPolicy and a Master "
+                f"ReplicaSpec are mutually exclusive (torchrun's rendezvous "
+                f"replaces the static master)"
+            )
+        if ep.max_replicas is None:
+            # the bound is baked into every pod's PET_NNODES; without an
+            # explicit value it would drift with the replica count
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: elasticPolicy.maxReplicas is "
+                f"required"
+            )
+        if ep.min_replicas is not None and ep.min_replicas > ep.max_replicas:
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: elasticPolicy.minReplicas "
+                f"{ep.min_replicas} > maxReplicas {ep.max_replicas}"
+            )
+        worker = specs.get(REPLICA_WORKER)
+        if worker is None:
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: elastic jobs need a Worker "
+                f"ReplicaSpec"
+            )
+        n = worker.replicas if worker.replicas is not None else 1
+        if ep.min_replicas is not None and n < ep.min_replicas:
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: Worker replicas {n} < "
+                f"elasticPolicy.minReplicas {ep.min_replicas}"
+            )
+        if n > ep.max_replicas:
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: Worker replicas {n} > "
+                f"elasticPolicy.maxReplicas {ep.max_replicas}"
+            )
+        return
     if master is None:
         raise jobapi.ValidationError(
             f"{KIND}Spec is not valid: Master ReplicaSpec must be present"

@@ -52,36 +52,100 @@ class PyTorchAdapter(FrameworkAdapter):
     def set_cluster_spec(
         self, job: ptapi.PyTorchJob, pod_template: Dict[str, Any], rtype: str, index: int
     ) -> None:
-        rank = index
-        addr = JobEngine.gen_general_name(job.name, ptapi.REPLICA_MASTER, 0)
-        if rtype == ptapi.REPLICA_MASTER:
-            if rank != 0:
-                raise ValidationError(
-                    "invalid config: There should be only a single master with index=0"
-                )
-            addr = "localhost"
+        if job.elastic_policy is not None:
+            env = self._elastic_env(job)
         else:
-            rank = rank + 1  # master offset (reference pytorch.go:32-39)
-        env = {
-            "MASTER_PORT": str(master_port(job)),
-            "MASTER_ADDR": addr,
-            "WORLD_SIZE": str(total_replicas(job)),
-            "RANK": str(rank),
-            "PYTHONUNBUFFERED": "0",
-        }
+            rank = index
+            addr = JobEngine.gen_general_name(job.name, ptapi.REPLICA_MASTER, 0)
+            if rtype == ptapi.REPLICA_MASTER:
+                if rank != 0:
+                    raise ValidationError(
+                        "invalid config: There should be only a single master with index=0"
+                    )
+                addr = "localhost"
+            else:
+                rank = rank + 1  # master offset (reference pytorch.go:32-39)
+            env = {
+                "MASTER_PORT": str(master_port(job)),
+                "MASTER_ADDR": addr,
+                "WORLD_SIZE": str(total_replicas(job)),
+                "RANK": str(rank),
+                "PYTHONUNBUFFERED": "0",
+            }
         for c in pod_template.get("spec", {}).get("containers", []) or []:
             for k, v in env.items():
                 objects.set_env(c, k, v)
 
+    @staticmethod
+    def _elastic_env(job: ptapi.PyTorchJob) -> Dict[str, str]:
+        """torchrun/torch-elastic rendezvous env (PET_* — the variables
+        torchrun's launcher reads) instead of static MASTER_*/RANK: the
+        rendezvous endpoint is worker-0's stable DNS name (or an explicit
+        rdzvHost, e.g. an external etcd), and membership floats between
+        min and max as replicas are edited — no env rewrite needed on
+        scale, which is the point: the sparse-config analogue of TFJob's
+        EnableDynamicWorker (modern training-operator semantics; the
+        reference snapshot has no elastic mode)."""
+        ep = job.elastic_policy
+        host = ep.rdzv_host or JobEngine.gen_general_name(
+            job.name, ptapi.REPLICA_WORKER, 0
+        )
+        # bounds come ONLY from the policy (min defaulted to 1 in
+        # set_defaults, max required by validation) so pods created before
+        # and after a replica edit always agree on PET_NNODES
+        env = {
+            "PET_RDZV_BACKEND": ep.rdzv_backend,
+            "PET_RDZV_ENDPOINT": f"{host}:{ep.rdzv_port}",
+            "PET_RDZV_ID": ep.rdzv_id or job.name,
+            "PET_NNODES": f"{ep.min_replicas}:{ep.max_replicas}",
+            "PYTHONUNBUFFERED": "0",
+        }
+        if ep.n_proc_per_node is not None:
+            env["PET_NPROC_PER_NODE"] = str(ep.n_proc_per_node)
+        if ep.max_restarts is not None:
+            env["PET_MAX_RESTARTS"] = str(ep.max_restarts)
+        return env
+
     def is_master_role(
         self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
     ) -> bool:
-        return rtype == ptapi.REPLICA_MASTER
+        if ptapi.REPLICA_MASTER in replicas:
+            return rtype == ptapi.REPLICA_MASTER
+        # elastic worker-only jobs: worker-0 carries the master role label
+        # (it also hosts the c10d rendezvous endpoint)
+        return rtype == ptapi.REPLICA_WORKER and index == 0
 
     def replica_order(self, replicas):
         return [rt for rt in (ptapi.REPLICA_MASTER, ptapi.REPLICA_WORKER) if rt in replicas]
 
     def update_job_status(self, engine, job, ctx: StatusContext) -> None:
+        if (
+            job.elastic_policy is not None
+            and ptapi.REPLICA_MASTER not in ctx.replicas
+        ):
+            self._elastic_update_job_status(job, ctx)
+            return
         master_based_update_job_status(
             self.KIND, job, ctx, master_type=ptapi.REPLICA_MASTER
         )
+
+    def _elastic_update_job_status(self, job, ctx: StatusContext) -> None:
+        """Worker-only elastic jobs (torchrun rendezvous, no Master): any
+        worker completing cleanly completes the job — elastic agents exit
+        together when training finishes, and stragglers are torn down by
+        CleanPodPolicy (modern training-operator elastic semantics)."""
+        from tf_operator_tpu.controllers.shared_status import (
+            handle_replica_failure,
+            keep_running_tail,
+            mark_succeeded,
+        )
+
+        rtype = ptapi.REPLICA_WORKER
+        spec = ctx.replicas[rtype]
+        _, _, succeeded, failed = ctx.counts(rtype)
+        if succeeded > 0:
+            mark_succeeded(self.KIND, job, ctx)
+            return
+        if handle_replica_failure(self.KIND, job, ctx, rtype, spec, failed):
+            return
+        keep_running_tail(self.KIND, job, ctx)

@@ -17,6 +17,65 @@ from tf_operator_tpu.engine.controller import (
 )
 
 
+def mark_succeeded(kind: str, job, ctx: StatusContext) -> None:
+    """Record the Succeeded condition + event + metric (shared by the
+    master-gated and elastic success rules)."""
+    status = ctx.status
+    msg = f"{kind} {job.name} is successfully completed."
+    ctx.record_event("Normal", REASON_SUCCEEDED, msg)
+    if status.completion_time is None:
+        status.completion_time = ctx.now
+    common.update_job_conditions(
+        status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
+    )
+    metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+
+
+def handle_replica_failure(
+    kind: str, job, ctx: StatusContext, rtype: str, spec, failed: int
+) -> bool:
+    """Fail the job on a permanent replica failure; returns True when the
+    job was failed (callers stop their loop).
+
+    The engine only deletes-for-restart on RETRYABLE exit codes; a failed
+    pod still present under ExitCode policy means a permanent (1-127)
+    code, which must FAIL the job, not wedge it in Restarting.
+    ctx.restarted_types is the per-sync engine signal — checking the
+    lingering Restarting *condition* would conflate an old restart with a
+    new permanent failure (the reference's wedge,
+    pytorchjob_controller.go:359; deliberate fix)."""
+    if failed <= 0:
+        return False
+    if (
+        spec.restart_policy == common.RESTART_POLICY_EXIT_CODE
+        and rtype in ctx.restarted_types
+    ):
+        return False  # engine already recorded the restart + condition
+    status = ctx.status
+    msg = f"{kind} {job.name} is failed because {failed} {rtype} replica(s) failed."
+    ctx.record_event("Normal", REASON_FAILED, msg)
+    if status.completion_time is None:
+        status.completion_time = ctx.now
+    common.update_job_conditions(
+        status, common.JOB_FAILED, REASON_FAILED, msg, ctx.now
+    )
+    metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})
+    return True
+
+
+def keep_running_tail(kind: str, job, ctx: StatusContext) -> None:
+    """A live job keeps a Running condition (reference
+    pytorchjob_controller.go tail)."""
+    status = ctx.status
+    if not common.is_finished(status) and not common.has_condition(
+        status, common.JOB_RESTARTING
+    ):
+        common.update_job_conditions(
+            status, common.JOB_RUNNING, REASON_RUNNING,
+            f"{kind} {job.name} is running.", ctx.now,
+        )
+
+
 def master_based_update_job_status(
     kind: str, job, ctx: StatusContext, master_type: str = "Master"
 ) -> None:
@@ -36,47 +95,9 @@ def master_based_update_job_status(
                     f"{kind} {job.name} is running.", ctx.now,
                 )
             if expected == 0:
-                msg = f"{kind} {job.name} is successfully completed."
-                ctx.record_event("Normal", REASON_SUCCEEDED, msg)
-                if status.completion_time is None:
-                    status.completion_time = ctx.now
-                common.update_job_conditions(
-                    status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
-                )
-                metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+                mark_succeeded(kind, job, ctx)
                 return
 
-        if failed > 0:
-            # The engine only deletes-for-restart on RETRYABLE exit codes; a
-            # failed pod still present under ExitCode policy means a permanent
-            # (1-127) code, which must FAIL the job, not wedge it in
-            # Restarting. ctx.restarted_types is the per-sync engine signal —
-            # checking the lingering Restarting *condition* would conflate an
-            # old restart with a new permanent failure (the reference's wedge,
-            # pytorchjob_controller.go:359; deliberate fix).
-            if (
-                spec.restart_policy == common.RESTART_POLICY_EXIT_CODE
-                and rtype in ctx.restarted_types
-            ):
-                pass  # engine already recorded the restart + condition
-            else:
-                msg = (
-                    f"{kind} {job.name} is failed because {failed} "
-                    f"{rtype} replica(s) failed."
-                )
-                ctx.record_event("Normal", REASON_FAILED, msg)
-                if status.completion_time is None:
-                    status.completion_time = ctx.now
-                common.update_job_conditions(
-                    status, common.JOB_FAILED, REASON_FAILED, msg, ctx.now
-                )
-                metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})
-                return
-    # still alive: keep a Running condition (reference pytorchjob_controller.go tail)
-    if not common.is_finished(status) and not common.has_condition(
-        status, common.JOB_RESTARTING
-    ):
-        common.update_job_conditions(
-            status, common.JOB_RUNNING, REASON_RUNNING,
-            f"{kind} {job.name} is running.", ctx.now,
-        )
+        if handle_replica_failure(kind, job, ctx, rtype, spec, failed):
+            return
+    keep_running_tail(kind, job, ctx)
