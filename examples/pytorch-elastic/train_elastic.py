@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Elastic-worker training entry: reads the torchrun rendezvous contract
+the operator injects (PET_* — docs/env_contract.md) and launches the real
+`torchrun` when available, else demonstrates the env round-trip.
+
+In production the container command would simply be
+
+    torchrun --nnodes=$PET_NNODES --nproc-per-node=$PET_NPROC_PER_NODE \
+             --rdzv-backend=$PET_RDZV_BACKEND --rdzv-endpoint=$PET_RDZV_ENDPOINT \
+             --rdzv-id=$PET_RDZV_ID train.py
+
+torchrun reads exactly these variables from the environment, so the
+operator-injected values need no flag plumbing at all — this script just
+makes the contract visible and testable without torch installed.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+
+def main() -> int:
+    contract = {
+        k: os.environ.get(k, "")
+        for k in (
+            "PET_RDZV_BACKEND",
+            "PET_RDZV_ENDPOINT",
+            "PET_RDZV_ID",
+            "PET_NNODES",
+            "PET_NPROC_PER_NODE",
+            "PET_MAX_RESTARTS",
+        )
+    }
+    missing = [k for k in ("PET_RDZV_ENDPOINT", "PET_NNODES") if not contract[k]]
+    if missing:
+        print(f"not an elastic pod: missing {missing}", file=sys.stderr)
+        return 1
+    for k, v in contract.items():
+        if v:
+            print(f"{k}={v}", flush=True)
+
+    if shutil.which("torchrun") and os.environ.get("RUN_TORCH", "") == "1":
+        return subprocess.call(
+            ["torchrun", "--no-python", "python", "-c", "print('trained')"]
+        )
+    print("elastic contract ok", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,11 +17,13 @@ _ADAPTERS = None
 def _adapters():
     global _ADAPTERS
     if _ADAPTERS is None:
-        from tf_operator_tpu.api import tensorflow, tpujob
+        from tf_operator_tpu.api import pytorch, tensorflow, tpujob
 
         _ADAPTERS = {
             "TFJob": (tensorflow.TFJob, tensorflow.set_defaults, tensorflow.validate),
             "TPUJob": (tpujob.TPUJob, tpujob.set_defaults, tpujob.validate),
+            "PyTorchJob": (pytorch.PyTorchJob, pytorch.set_defaults,
+                           pytorch.validate),
         }
     return _ADAPTERS
 
@@ -142,3 +144,22 @@ def test_t5_smoke_blocked_ce():
     rc = _run("t5/train_t5.py", "--smoke", "--steps=2", "--per-host-batch=2",
               "--blocked-ce")
     assert rc.returncode == 0, rc.stderr[-2000:]
+
+
+def test_elastic_pytorch_example_through_run_local():
+    """Elastic example end to end: operator injects PET_* rendezvous env,
+    the training script validates the torchrun contract, job Succeeds."""
+    from tf_operator_tpu.runtime.local import run_local
+
+    doc = yaml.safe_load(
+        open(os.path.join(EX, "pytorch-elastic", "elastic.yaml")))
+    c = doc["spec"]["pytorchReplicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0]
+    c["command"] = [
+        "python", os.path.join(EX, "pytorch-elastic", "train_elastic.py")]
+    result = run_local(doc, timeout=120)
+    combined = "\n".join(result["logs"].values())
+    assert result["state"] == "Succeeded", combined[-2000:]
+    assert "PET_RDZV_ENDPOINT=elastic-demo-worker-0:29400" in combined
+    assert "PET_NNODES=1:8" in combined
+    assert "elastic contract ok" in combined
