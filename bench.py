@@ -328,9 +328,8 @@ def bench_flash_attention(gen: str):
     k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
     v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
 
-    results = {}
-    for causal in (False, True):
-        tag = "causal" if causal else "full"
+    def make_pair(causal):
+        """(flash, einsum) jitted fwd+bwd closures for one mask mode."""
 
         def loss_flash(q, k, v):
             return flash_attention(q, k, v, causal=causal,
@@ -341,32 +340,43 @@ def bench_flash_attention(gen: str):
                 jnp.float32
             ).sum()
 
-        flash_vg = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))
-        ref_vg = jax.jit(jax.value_and_grad(loss_ref, argnums=(0, 1, 2)))
+        return (
+            jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2))),
+            jax.jit(jax.value_and_grad(loss_ref, argnums=(0, 1, 2))),
+        )
 
+    def timed(fn, args, n=10):
+        out, _ = fn(*args)  # warm — and BARRIER before starting the clock
+        float(jax.device_get(out))  # (value fetch: see bench_resnet NOTE)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out, _ = fn(*args)
+        float(jax.device_get(out))
+        return (time.perf_counter() - t0) / n
+
+    def speed(flash_vg, ref_vg, args, n=10):
+        t_flash = timed(flash_vg, args, n)
+        t_ref = timed(ref_vg, args, n)
+        return {
+            "flash_ms": round(t_flash * 1e3, 2),
+            "einsum_ms": round(t_ref * 1e3, 2),
+            "speedup": round(t_ref / t_flash, 2),
+        }
+
+    results = {}
+    for causal in (False, True):
+        tag = "causal" if causal else "full"
+        flash_vg, ref_vg = make_pair(causal)
         f_out, f_grads = flash_vg(q, k, v)
         r_out, r_grads = ref_vg(q, k, v)
         # bf16 inputs, f32 accumulation: sums over B*S*H*D=8.4M outputs —
         # compare relatively
         fwd_rel, grad_rel, ok = _parity(f_out, f_grads, r_out, r_grads)
-
-        def timed(fn, n=10):
-            fn(q, k, v)  # warm
-            t0 = time.perf_counter()
-            for _ in range(n):
-                out, _ = fn(q, k, v)
-            float(jax.device_get(out))
-            return (time.perf_counter() - t0) / n
-
-        t_flash = timed(flash_vg)
-        t_ref = timed(ref_vg)
         results[tag] = {
             "parity_ok": ok,
             "fwd_rel_err": round(fwd_rel, 6),
             "grad_max_rel_err": round(grad_rel, 6),
-            "flash_ms": round(t_flash * 1e3, 2),
-            "einsum_ms": round(t_ref * 1e3, 2),
-            "speedup": round(t_ref / t_flash, 2),
+            **speed(flash_vg, ref_vg, (q, k, v)),
         }
     results["shape"] = f"b{b} s{s} h{h} d{d} bf16 fwd+bwd"
 
@@ -374,36 +384,12 @@ def bench_flash_attention(gen: str):
     # path's O(S^2) score materialization starts to hurt (BASELINE.md)
     try:
         s_long = 8192
-        ql = jax.random.normal(kq, (1, s_long, h, d), jnp.bfloat16)
-        kl = jax.random.normal(kk, (1, s_long, h, d), jnp.bfloat16)
-        vl = jax.random.normal(kv, (1, s_long, h, d), jnp.bfloat16)
-
-        def loss_flash_l(q, k, v):
-            return flash_attention(q, k, v, causal=True,
-                                   interpret=False).astype(jnp.float32).sum()
-
-        def loss_ref_l(q, k, v):
-            return dot_product_attention(q, k, v, True).astype(
-                jnp.float32).sum()
-
-        fl = jax.jit(jax.value_and_grad(loss_flash_l, argnums=(0, 1, 2)))
-        rl = jax.jit(jax.value_and_grad(loss_ref_l, argnums=(0, 1, 2)))
-
-        def timed_l(fn, n=5):
-            fn(ql, kl, vl)
-            t0 = time.perf_counter()
-            for _ in range(n):
-                out, _ = fn(ql, kl, vl)
-            float(jax.device_get(out))
-            return (time.perf_counter() - t0) / n
-
-        t_flash = timed_l(fl)
-        t_ref = timed_l(rl)
-        results["causal_s8192"] = {
-            "flash_ms": round(t_flash * 1e3, 2),
-            "einsum_ms": round(t_ref * 1e3, 2),
-            "speedup": round(t_ref / t_flash, 2),
-        }
+        long_args = tuple(
+            jax.random.normal(key, (1, s_long, h, d), jnp.bfloat16)
+            for key in (kq, kk, kv)
+        )
+        flash_vg, ref_vg = make_pair(True)
+        results["causal_s8192"] = speed(flash_vg, ref_vg, long_args, n=5)
     except Exception as e:  # noqa: BLE001 — surfaced, not fatal
         results["causal_s8192"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 

@@ -794,3 +794,25 @@ def test_deleting_job_does_not_adopt_orphans():
     assert engine.get_pods_for_job(fresh) == []
     stored = cluster.get_pod("default", f"{job.name}-worker-0")
     assert not stored["metadata"].get("ownerReferences")
+
+
+def test_suspend_deletes_and_resume_recreates_podgroup():
+    """Suspension must release the gang reservation (a suspended job
+    holding PodGroup quota would block other queued jobs)."""
+    cluster, engine = setup_engine(
+        config=EngineConfig(enable_gang_scheduling=True))
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    assert cluster.get("PodGroup", "default", job.name)["spec"][
+        "minMember"] == 2
+
+    _set_suspend(cluster, job, True)
+    job, _ = reconcile(cluster, engine, job)
+    assert cluster.list_pods() == []
+    with pytest.raises(Exception):
+        cluster.get("PodGroup", "default", job.name)
+
+    _set_suspend(cluster, job, False)
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_pods()) == 2
+    assert cluster.get("PodGroup", "default", job.name)
