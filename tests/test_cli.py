@@ -190,3 +190,47 @@ def test_describe_shows_conditions_replicas_events(tmp_path, capsys):
     assert "Running" in out and "Created" in out  # conditions table
     assert "mnist-worker-0" in out and "mnist-worker-1" in out
     assert "JobCreated" in out  # event vocabulary
+
+
+def test_scale_verb_drives_replica_count(tmp_path, capsys):
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    assert _invoke(cli, ["submit", str(path)]) == 0
+    engine = make_engine("TFJob", cli.cluster)
+    from tf_operator_tpu.api import tensorflow as tfapi
+
+    def sync():
+        engine.reconcile(tfapi.TFJob.from_dict(
+            cli.cluster.get("TFJob", "default", "mnist")))
+
+    sync()
+    assert len(cli.cluster.list_pods()) == 2
+    assert _invoke(cli, ["scale", "tfjob", "mnist", "--replicas", "4"]) == 0
+    assert "scaled (Worker=4)" in capsys.readouterr().out
+    sync()
+    assert len(cli.cluster.list_pods()) == 4
+    # unknown replica type is a clean error
+    assert _invoke(cli, ["scale", "tfjob", "mnist", "--replicas", "1",
+                         "--replica-type", "PS"]) == 1
+    assert "no PS replicas" in capsys.readouterr().err
+
+
+def test_scale_rejects_out_of_bounds_elastic(capsys):
+    cli = _cli_and_cluster()
+    cli.cluster.create("PyTorchJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "PyTorchJob",
+        "metadata": {"name": "el", "namespace": "default"},
+        "spec": {
+            "elasticPolicy": {"minReplicas": 1, "maxReplicas": 4},
+            "pytorchReplicaSpecs": {"Worker": {
+                "replicas": 2,
+                "template": {"spec": {"containers": [
+                    {"name": "pytorch", "image": "x"}]}}}}},
+    })
+    # overshoot would terminally fail the job at validation — reject here
+    assert _invoke(cli, ["scale", "pytorchjob", "el", "--replicas", "6"]) == 1
+    assert "outside elasticPolicy bounds" in capsys.readouterr().err
+    assert _invoke(cli, ["scale", "pytorchjob", "el", "--replicas", "4"]) == 0
+    doc = cli.cluster.get("PyTorchJob", "default", "el")
+    assert doc["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] == 4

@@ -816,3 +816,20 @@ def test_suspend_deletes_and_resume_recreates_podgroup():
     job, _ = reconcile(cluster, engine, job)
     assert len(cluster.list_pods()) == 2
     assert cluster.get("PodGroup", "default", job.name)
+
+
+def test_replica_status_selector_for_scale_subresource():
+    """The /scale subresource's labelSelectorPath reads
+    .status.replicaStatuses.<type>.selector — the engine must write a
+    selector that actually matches the type's pods."""
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    sel = job.status.replica_statuses["Worker"].selector
+    assert sel
+    selector = dict(kv.split("=", 1) for kv in sel.split(","))
+    assert cluster.list_pods(selector=selector) != []
+    assert len(cluster.list_pods(selector=selector)) == 2
+    # persisted through the status write-back
+    stored = cluster.get("TFJob", "default", job.name)
+    assert stored["status"]["replicaStatuses"]["Worker"]["selector"] == sel

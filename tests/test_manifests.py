@@ -41,7 +41,16 @@ def test_crds_cover_all_kinds_and_replica_types():
         (doc,) = _load(os.path.join(crd_dir, fname))
         kind = doc["spec"]["names"]["kind"]
         ver = doc["spec"]["versions"][0]
-        assert ver["subresources"] == {"status": {}}
+        assert ver["subresources"]["status"] == {}
+        if kind == "PyTorchJob":
+            # HPA-facing scale subresource targets the Worker count
+            assert ver["subresources"]["scale"] == {
+                "specReplicasPath": ".spec.pytorchReplicaSpecs.Worker.replicas",
+                "statusReplicasPath": ".status.replicaStatuses.Worker.active",
+                "labelSelectorPath": ".status.replicaStatuses.Worker.selector",
+            }
+        else:
+            assert "scale" not in ver["subresources"]
         props = ver["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
         key, rtypes = expect[kind]
         assert key in props, f"{kind}: missing {key}"

@@ -195,11 +195,17 @@ class ReplicaStatus:
     # Persisting the counter in status is what lets _past_backoff_limit see
     # restarts that happened in prior reconciles.
     restarts: int = 0
+    # label-selector string for this type's pods — the /scale subresource's
+    # labelSelectorPath points here so the HPA can find the pods behind the
+    # count (upstream training-operator does the same)
+    selector: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"active": self.active, "succeeded": self.succeeded, "failed": self.failed}
         if self.restarts:
             d["restarts"] = self.restarts
+        if self.selector:
+            d["selector"] = self.selector
         return d
 
     @classmethod
@@ -209,6 +215,7 @@ class ReplicaStatus:
             succeeded=d.get("succeeded", 0),
             failed=d.get("failed", 0),
             restarts=d.get("restarts", 0),
+            selector=d.get("selector"),
         )
 
 

@@ -119,6 +119,13 @@ class JobEngine:
             objects.LABEL_JOB_NAME: job_name.replace("/", "-"),
         }
 
+    def _replica_selector(self, job: Job, rtype: str) -> str:
+        """Label-selector string matching one replica type's pods (k8s
+        `k=v,k=v` form; ordering fixed for stable status diffs)."""
+        labels = self.gen_labels(job.name)
+        labels[objects.LABEL_REPLICA_TYPE] = rtype.lower()
+        return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
     @staticmethod
     def gen_general_name(job_name: str, rtype: str, index: int) -> str:
         """{job}-{rt}-{index} naming contract (kubeflow/common GenGeneralName,
@@ -455,10 +462,12 @@ class JobEngine:
         num_replicas = spec.replicas or 0
         # initializeReplicaStatuses (reference status.go:244-249) — the
         # persisted ExitCode restart counter survives the per-sync reset so
-        # BackoffLimit can count delete-for-recreate restarts
+        # BackoffLimit can count delete-for-recreate restarts; the selector
+        # feeds the /scale subresource's labelSelectorPath (HPA)
         prev = status.replica_statuses.get(rtype)
         status.replica_statuses[rtype] = common.ReplicaStatus(
-            restarts=prev.restarts if prev else 0
+            restarts=prev.restarts if prev else 0,
+            selector=self._replica_selector(job, rtype),
         )
         restarted_this_pass = False
 

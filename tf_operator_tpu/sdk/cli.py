@@ -14,6 +14,7 @@ common verbs into one command:
   tpu-jobs pods tfjob mnist
   tpu-jobs suspend tfjob mnist             # tear pods down, keep the CR
   tpu-jobs resume tfjob mnist
+  tpu-jobs scale pytorchjob elastic --replicas 6 [--replica-type Worker]
   tpu-jobs delete tfjob mnist
 
 Backend selection matches the operator (`cmd/main.py:build_cluster`):
@@ -210,6 +211,19 @@ class Cli:
                       f"{e.get('message', '')}")
         return 0
 
+    def scale(self, kind: str, name: str, namespace: str, replicas: int,
+              replica_type: str) -> int:
+        try:
+            self.client(kind).scale(name, replicas,
+                                    replica_type=replica_type,
+                                    namespace=namespace)
+        except ValueError as e:  # unknown replica type: clean message
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"{kind.lower()}.kubeflow.org/{name} scaled "
+              f"({replica_type}={replicas})")
+        return 0
+
     def suspend(self, kind: str, name: str, namespace: str) -> int:
         self.client(kind).suspend(name, namespace=namespace)
         print(f"{kind.lower()}.kubeflow.org/{name} suspended")
@@ -272,7 +286,7 @@ def make_parser() -> argparse.ArgumentParser:
     pr.add_argument("--timeout", type=float, default=300.0)
 
     for verb in ("get", "describe", "wait", "pods", "logs", "delete",
-                 "suspend", "resume"):
+                 "suspend", "resume", "scale"):
         pv = sub.add_parser(verb, parents=[common])
         pv.add_argument("kind")
         pv.add_argument("name")
@@ -286,6 +300,9 @@ def make_parser() -> argparse.ArgumentParser:
             pv.add_argument("--index", type=int, default=None)
         if verb == "logs":
             pv.add_argument("-f", "--follow", action="store_true")
+        if verb == "scale":
+            pv.add_argument("--replicas", type=int, required=True)
+            pv.add_argument("--replica-type", default="Worker")
 
     pl = sub.add_parser("list", parents=[common])
     pl.add_argument("kind")
@@ -314,6 +331,9 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
                         follow=args.follow)
     if args.verb == "delete":
         return cli.delete(kind, args.name, ns)
+    if args.verb == "scale":
+        return cli.scale(kind, args.name, ns, args.replicas,
+                         args.replica_type)
     if args.verb == "suspend":
         return cli.suspend(kind, args.name, ns)
     if args.verb == "resume":

@@ -67,6 +67,43 @@ class JobClient:
     def delete(self, name: str, namespace: str = "default") -> None:
         self.cluster.delete(self.kind, namespace, name)
 
+    def scale(
+        self,
+        name: str,
+        replicas: int,
+        replica_type: str = "Worker",
+        namespace: str = "default",
+    ) -> Dict[str, Any]:
+        """Set one replica type's count (the engine's index-slice diffing
+        creates/deletes pods to match — kubectl scale analogue; for elastic
+        PyTorch jobs this is the knob the HPA drives via /scale)."""
+        from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS
+
+        current = self.cluster.get(self.kind, namespace, name)
+        # the authoritative replica-specs key comes from the kind's API
+        # class, not from sniffing spec keys
+        job = SUPPORTED_ADAPTERS[self.kind]().from_dict(current)
+        key = job.replica_specs_key()
+        if replica_type not in (job.replica_specs or {}):
+            raise ValueError(
+                f"{self.kind} {name} has no {replica_type} replicas to scale"
+            )
+        ep = getattr(job, "elastic_policy", None)
+        if ep is not None:
+            # an out-of-bounds count would fail spec validation and
+            # terminally fail the job — reject it here with a clear message
+            lo = ep.min_replicas if ep.min_replicas is not None else 1
+            hi = ep.max_replicas
+            if replicas < lo or (hi is not None and replicas > hi):
+                raise ValueError(
+                    f"replicas {replicas} outside elasticPolicy bounds "
+                    f"[{lo}, {hi}]"
+                )
+        return self.patch(
+            name, {"spec": {key: {replica_type: {"replicas": replicas}}}},
+            namespace,
+        )
+
     def suspend(self, name: str, namespace: str = "default") -> Dict[str, Any]:
         """Set runPolicy.suspend=true: the operator tears the job's pods
         down and halts reconciliation until resume() (engine suspend
