@@ -41,6 +41,11 @@ def _yamls():
 def test_example_yaml_valid(path):
     doc = yaml.safe_load(open(path))
     kind = doc["kind"]
+    if kind not in _adapters():
+        # non-job manifests (e.g. the HPA example) have nothing to
+        # validate; a job manifest with a typo'd apiVersion still runs
+        # through its adapter (and fails loudly) because kinds key this
+        pytest.skip(f"no job adapter for kind {kind!r}")
     cls, set_defaults, validate = _adapters()[kind]
     job = cls.from_dict(doc)
     set_defaults(job)
