@@ -200,22 +200,25 @@ def bench_transformer(gen: str, n_chips: int):
     on_cpu = gen == "cpu"
     if on_cpu:
         base_cfg = tfm.tiny(max_len=128)
-        batches, steps, warmup = (4,), 3, 1
-        variants = {"einsum": (None, None)}
+        steps, warmup = 3, 1
+        variants = {"einsum": (None, None, (4,))}
     else:
         base_cfg = tfm.bert_large()
-        batches, steps, warmup = (8, 16, 32), 10, 3
-        # sweep arms: (attention_fn, loss_fn) — the pallas flash kernel
-        # usually beats the einsum path, and the blocked large-vocab CE
-        # (ops/blocked_ce.py) removes the [B,S,V] f32 logits so larger
-        # batches fit; the numbers decide
+        steps, warmup = 10, 3
+        # sweep arms: (attention_fn, loss_fn, per-chip batches) — the
+        # pallas flash kernel usually beats the einsum path, and the
+        # blocked large-vocab CE (ops/blocked_ce.py) removes the [B,S,V]
+        # f32 logits so larger batches fit; per-arm batch lists bound the
+        # total compile count (each BERT-large compile costs minutes on a
+        # tunnelled chip) while still probing big batches where they can
+        # plausibly fit
         from tf_operator_tpu.ops.blocked_ce import lm_blocked_loss
         from tf_operator_tpu.ops.flash_attention import flash_attention
 
         variants = {
-            "einsum": (None, None),
-            "flash": (flash_attention, None),
-            "flash+blocked_ce": (flash_attention, lm_blocked_loss),
+            "einsum": (None, None, (8, 16)),
+            "flash": (flash_attention, None, (8, 16)),
+            "flash+blocked_ce": (flash_attention, lm_blocked_loss, (16, 32)),
         }
     mesh = make_mesh({"dp": n_chips})
     flops_per_token = tfm.params_flops_per_token(base_cfg)
@@ -256,7 +259,7 @@ def bench_transformer(gen: str, n_chips: int):
     # pre-sweep, except the optional flash arm which must not kill the
     # einsum headline)
     best, best_tps, stops = None, 0.0, []
-    for arm, (attn_fn, loss_impl) in variants.items():
+    for arm, (attn_fn, loss_impl, batches) in variants.items():
         cfg = dataclasses.replace(base_cfg, attention_fn=attn_fn)
         for b in batches:
             try:
