@@ -230,3 +230,51 @@ def test_patch_target_must_match(tmp_path):
 
     with pytest.raises(ValueError, match="matched no resource"):
         render_kustomization(str(tmp_path))
+
+
+@pytest.mark.skipif(
+    __import__("shutil").which("openssl") is None,
+    reason="needs the openssl binary for the self-signed pair",
+)
+def test_webhook_serves_https_with_cert(tmp_path):
+    """The apiserver only dials webhooks over TLS; cover the cert-file
+    path (production mode) with a self-signed pair."""
+    import ssl
+    import subprocess
+
+    cert = tmp_path / "tls.crt"
+    key = tmp_path / "tls.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    srv = WebhookServer(host="127.0.0.1", port=0,
+                        cert_file=str(cert), key_file=str(key))
+    srv.start()
+    try:
+        ctx = ssl.create_default_context(cafile=str(cert))
+        ctx.check_hostname = False  # CN=localhost vs 127.0.0.1
+        conn = http.client.HTTPSConnection(
+            "127.0.0.1", srv.port, timeout=5, context=ctx)
+        conn.request("POST", "/validate", json.dumps(review_for(tfjob_doc())),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["response"]["allowed"] is True
+        # plain HTTP against the TLS listener must fail with a
+        # connection/protocol error — not succeed, and not because the
+        # server died (proven alive by the request above and below)
+        plain = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        with pytest.raises((ConnectionError, http.client.HTTPException,
+                            OSError)):
+            plain.request("POST", "/validate", "{}")
+            plain.getresponse()
+        conn2 = http.client.HTTPSConnection(
+            "127.0.0.1", srv.port, timeout=5, context=ctx)
+        conn2.request("POST", "/validate",
+                      json.dumps(review_for(tfjob_doc())))
+        assert conn2.getresponse().status == 200  # still serving after that
+    finally:
+        srv.stop()
