@@ -116,3 +116,21 @@ def test_tpujob_valid_after_defaults():
     job = testutil.new_tpujob(accelerator_type="v4-32")
     tpuapi.set_defaults(job)
     tpuapi.validate(job)
+
+
+def test_negative_replicas_rejected():
+    """CRD schema says minimum: 0; in-process validation must agree (a
+    negative count would read as 'delete every pod' to the engine)."""
+    doc = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "x"},
+        "spec": {"tfReplicaSpecs": {"Worker": {
+            "replicas": -2,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "i"}]}},
+        }}},
+    }
+    job = tfapi.TFJob.from_dict(doc)
+    tfapi.set_defaults(job)
+    with pytest.raises(jobapi.ValidationError, match=">= 0"):
+        tfapi.validate(job)

@@ -191,6 +191,14 @@ def validate_replica_specs(
             raise ValidationError(
                 f"{kind}Spec is not valid: unknown replica type {rtype!r}"
             )
+        if rspec is not None and rspec.replicas is not None and rspec.replicas < 0:
+            # the CRD schema enforces minimum: 0 at admission; mirror it
+            # here so in-process/webhook paths agree (a negative count
+            # would otherwise read as "delete every pod" to the engine)
+            raise ValidationError(
+                f"{kind}Spec is not valid: {rtype} replicas must be >= 0, "
+                f"got {rspec.replicas}"
+            )
         containers = (
             (rspec.template or {}).get("spec", {}).get("containers", []) or []
             if rspec is not None
