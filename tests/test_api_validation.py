@@ -134,3 +134,60 @@ def test_negative_replicas_rejected():
     tfapi.set_defaults(job)
     with pytest.raises(jobapi.ValidationError, match=">= 0"):
         tfapi.validate(job)
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda s: s["tfReplicaSpecs"]["Worker"].update(restartPolicy="Sometimes"),
+     "unknown restartPolicy"),
+    (lambda s: s.update(runPolicy={"cleanPodPolicy": "Sometimes"}),
+     "unknown cleanPodPolicy"),
+    (lambda s: s.update(runPolicy={"activeDeadlineSeconds": -5}),
+     "activeDeadlineSeconds"),
+    (lambda s: s.update(runPolicy={"backoffLimit": -1}), "backoffLimit"),
+    (lambda s: s.update(runPolicy={"ttlSecondsAfterFinished": -10}),
+     "ttlSecondsAfterFinished"),
+])
+def test_run_policy_schema_constraints_mirrored(mutate, match):
+    """The CRD schema's enums/minimums must hold in-process too, so the
+    webhook and schemaless backends (FakeCluster, run-local) agree with
+    admission-time validation."""
+    doc = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "x"},
+        "spec": {"tfReplicaSpecs": {"Worker": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "i"}]}},
+        }}},
+    }
+    mutate(doc["spec"])
+    job = tfapi.TFJob.from_dict(doc)
+    tfapi.set_defaults(job)
+    with pytest.raises(jobapi.ValidationError, match=match):
+        tfapi.validate(job)
+
+
+def test_non_numeric_run_policy_values_rejected_cleanly():
+    """A non-numeric RunPolicy value must be a ValidationError (Failed
+    condition), not a TypeError crashing the reconcile loop."""
+    doc = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "x"},
+        "spec": {
+            "runPolicy": {"ttlSecondsAfterFinished": "ten"},
+            "tfReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "tensorflow", "image": "i"}]}},
+            }},
+        },
+    }
+    job = tfapi.TFJob.from_dict(doc)
+    tfapi.set_defaults(job)
+    with pytest.raises(jobapi.ValidationError, match="must be a number"):
+        tfapi.validate(job)
+    doc["spec"]["runPolicy"] = {}
+    doc["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = "two"
+    job = tfapi.TFJob.from_dict(doc)
+    with pytest.raises(jobapi.ValidationError, match="must be an integer"):
+        tfapi.validate(job)

@@ -29,6 +29,11 @@ RESTART_POLICIES = (
 CLEAN_POD_POLICY_ALL = "All"
 CLEAN_POD_POLICY_RUNNING = "Running"
 CLEAN_POD_POLICY_NONE = "None"
+CLEAN_POD_POLICIES = (
+    CLEAN_POD_POLICY_ALL,
+    CLEAN_POD_POLICY_RUNNING,
+    CLEAN_POD_POLICY_NONE,
+)
 
 # Job condition types (reference swagger.json JobConditionType; Suspended
 # follows the modern training-operator / batch.v1 Job suspend semantics —

@@ -172,6 +172,46 @@ def apply_common_defaults(
         set_default_port(spec.template, container_name, port_name, port)
 
 
+def validate_run_policy(job: Job, kind: str = "Job") -> None:
+    """Mirror the CRD schema's RunPolicy constraints (enums + minimums) so
+    in-process and webhook validation agree with admission-time schema
+    checks even when the CRDs aren't enforcing (FakeCluster, run-local).
+
+    Deliberate ratchet: this also runs at reconcile time, so a CR admitted
+    with a negative value before the schema minimums existed fails loudly
+    on the next sync instead of acting on the nonsense value (negative ADS/
+    backoffLimit already failed jobs instantly; negative TTL would delete
+    the CR the moment it finished)."""
+    rp = job.run_policy
+    if (
+        rp.clean_pod_policy is not None
+        and rp.clean_pod_policy not in common.CLEAN_POD_POLICIES
+    ):
+        raise ValidationError(
+            f"{kind}Spec is not valid: unknown cleanPodPolicy "
+            f"{rp.clean_pod_policy!r}"
+        )
+    for field_name, value in (
+        ("ttlSecondsAfterFinished", rp.ttl_seconds_after_finished),
+        ("activeDeadlineSeconds", rp.active_deadline_seconds),
+        ("backoffLimit", rp.backoff_limit),
+    ):
+        if value is None:
+            continue
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            # a TypeError from `value < 0` would crash the reconcile loop
+            # instead of failing the job cleanly
+            raise ValidationError(
+                f"{kind}Spec is not valid: {field_name} must be a number, "
+                f"got {value!r}"
+            )
+        if value < 0:
+            raise ValidationError(
+                f"{kind}Spec is not valid: {field_name} must be >= 0, "
+                f"got {value}"
+            )
+
+
 def validate_replica_specs(
     job: Job,
     container_name: str,
@@ -185,19 +225,36 @@ def validate_replica_specs(
     specs = job.replica_specs
     if specs is None or not isinstance(specs, dict):
         raise ValidationError(f"{kind}Spec is not valid")
+    validate_run_policy(job, kind)
     found_masterish = 0
     for rtype, rspec in specs.items():
         if valid_types is not None and rtype not in valid_types:
             raise ValidationError(
                 f"{kind}Spec is not valid: unknown replica type {rtype!r}"
             )
-        if rspec is not None and rspec.replicas is not None and rspec.replicas < 0:
-            # the CRD schema enforces minimum: 0 at admission; mirror it
-            # here so in-process/webhook paths agree (a negative count
-            # would otherwise read as "delete every pod" to the engine)
+        if rspec is not None and rspec.replicas is not None:
+            r = rspec.replicas
+            if isinstance(r, bool) or not isinstance(r, int):
+                raise ValidationError(
+                    f"{kind}Spec is not valid: {rtype} replicas must be an "
+                    f"integer, got {r!r}"
+                )
+            if r < 0:
+                # the CRD schema enforces minimum: 0 at admission; mirror
+                # it here so in-process/webhook paths agree (a negative
+                # count would read as "delete every pod" to the engine)
+                raise ValidationError(
+                    f"{kind}Spec is not valid: {rtype} replicas must be "
+                    f">= 0, got {r}"
+                )
+        if (
+            rspec is not None
+            and rspec.restart_policy is not None
+            and rspec.restart_policy not in common.RESTART_POLICIES
+        ):
             raise ValidationError(
-                f"{kind}Spec is not valid: {rtype} replicas must be >= 0, "
-                f"got {rspec.replicas}"
+                f"{kind}Spec is not valid: unknown restartPolicy "
+                f"{rspec.restart_policy!r} for {rtype}"
             )
         containers = (
             (rspec.template or {}).get("spec", {}).get("containers", []) or []
