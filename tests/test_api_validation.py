@@ -184,10 +184,34 @@ def test_non_numeric_run_policy_values_rejected_cleanly():
     }
     job = tfapi.TFJob.from_dict(doc)
     tfapi.set_defaults(job)
-    with pytest.raises(jobapi.ValidationError, match="must be a number"):
+    with pytest.raises(jobapi.ValidationError, match="must be an integer"):
         tfapi.validate(job)
     doc["spec"]["runPolicy"] = {}
     doc["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = "two"
     job = tfapi.TFJob.from_dict(doc)
     with pytest.raises(jobapi.ValidationError, match="must be an integer"):
         tfapi.validate(job)
+
+
+@pytest.mark.parametrize("ma,match", [
+    (-3, ">= 0"),
+    (99, "exceeds total replicas"),
+    ("three", "must be an integer"),
+])
+def test_min_available_constraints(ma, match):
+    """minAvailable > total can never gang-schedule (silent Pending hang);
+    negatives and non-ints are schema violations."""
+    job = testutil.new_tfjob(worker=2)
+    job.run_policy.scheduling_policy = common.SchedulingPolicy(
+        min_available=ma)
+    tfapi.set_defaults(job)
+    with pytest.raises(jobapi.ValidationError, match=match):
+        tfapi.validate(job)
+
+
+def test_min_available_valid_passes():
+    job = testutil.new_tfjob(worker=2, ps=1)
+    job.run_policy.scheduling_policy = common.SchedulingPolicy(
+        min_available=3)
+    tfapi.set_defaults(job)
+    tfapi.validate(job)

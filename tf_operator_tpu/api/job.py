@@ -172,6 +172,23 @@ def apply_common_defaults(
         set_default_port(spec.template, container_name, port_name, port)
 
 
+def _require_nonneg_int(kind: str, field_name: str, value) -> None:
+    """Shared numeric-field guard: None passes; anything except a
+    non-negative int (the CRD schemas say type: integer, minimum: 0) is a
+    ValidationError — never a TypeError crashing the reconcile loop."""
+    if value is None:
+        return
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValidationError(
+            f"{kind}Spec is not valid: {field_name} must be an integer, "
+            f"got {value!r}"
+        )
+    if value < 0:
+        raise ValidationError(
+            f"{kind}Spec is not valid: {field_name} must be >= 0, got {value}"
+        )
+
+
 def validate_run_policy(job: Job, kind: str = "Job") -> None:
     """Mirror the CRD schema's RunPolicy constraints (enums + minimums) so
     in-process and webhook validation agree with admission-time schema
@@ -196,19 +213,22 @@ def validate_run_policy(job: Job, kind: str = "Job") -> None:
         ("activeDeadlineSeconds", rp.active_deadline_seconds),
         ("backoffLimit", rp.backoff_limit),
     ):
-        if value is None:
-            continue
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            # a TypeError from `value < 0` would crash the reconcile loop
-            # instead of failing the job cleanly
+        _require_nonneg_int(kind, field_name, value)
+    sp = rp.scheduling_policy
+    if sp is not None and sp.min_available is not None:
+        ma = sp.min_available
+        _require_nonneg_int(kind, "schedulingPolicy.minAvailable", ma)
+        total = sum(
+            s.replicas
+            for s in (job.replica_specs or {}).values()
+            if s is not None and isinstance(s.replicas, int)
+        )
+        if ma > total:
+            # a PodGroup with minMember > member count can never schedule:
+            # the job would hang Pending forever with no signal
             raise ValidationError(
-                f"{kind}Spec is not valid: {field_name} must be a number, "
-                f"got {value!r}"
-            )
-        if value < 0:
-            raise ValidationError(
-                f"{kind}Spec is not valid: {field_name} must be >= 0, "
-                f"got {value}"
+                f"{kind}Spec is not valid: schedulingPolicy.minAvailable "
+                f"{ma} exceeds total replicas {total}"
             )
 
 
@@ -225,28 +245,17 @@ def validate_replica_specs(
     specs = job.replica_specs
     if specs is None or not isinstance(specs, dict):
         raise ValidationError(f"{kind}Spec is not valid")
-    validate_run_policy(job, kind)
     found_masterish = 0
     for rtype, rspec in specs.items():
         if valid_types is not None and rtype not in valid_types:
             raise ValidationError(
                 f"{kind}Spec is not valid: unknown replica type {rtype!r}"
             )
-        if rspec is not None and rspec.replicas is not None:
-            r = rspec.replicas
-            if isinstance(r, bool) or not isinstance(r, int):
-                raise ValidationError(
-                    f"{kind}Spec is not valid: {rtype} replicas must be an "
-                    f"integer, got {r!r}"
-                )
-            if r < 0:
-                # the CRD schema enforces minimum: 0 at admission; mirror
-                # it here so in-process/webhook paths agree (a negative
-                # count would read as "delete every pod" to the engine)
-                raise ValidationError(
-                    f"{kind}Spec is not valid: {rtype} replicas must be "
-                    f">= 0, got {r}"
-                )
+        if rspec is not None:
+            # the CRD schema enforces type/minimum at admission; mirror it
+            # here so in-process/webhook paths agree (a negative count
+            # would read as "delete every pod" to the engine)
+            _require_nonneg_int(kind, f"{rtype} replicas", rspec.replicas)
         if (
             rspec is not None
             and rspec.restart_policy is not None
@@ -284,3 +293,6 @@ def validate_replica_specs(
         raise ValidationError(
             f"{kind}Spec is not valid: more than 1 chief/master found"
         )
+    # after the per-spec checks so minAvailable-vs-total sums validated
+    # replica counts (a bad replicas value gets its clearer error first)
+    validate_run_policy(job, kind)
