@@ -215,3 +215,20 @@ def test_min_available_valid_passes():
         min_available=3)
     tfapi.set_defaults(job)
     tfapi.validate(job)
+
+
+def test_tpujob_malformed_num_slices_is_validation_error():
+    """A malformed numSlices must surface as a ValidationError (Failed
+    condition / webhook denial), not a ValueError crash-looping the
+    reconcile worker at from_dict time."""
+    doc = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TPUJob",
+        "metadata": {"name": "t"},
+        "spec": {"acceleratorType": "v4-32", "numSlices": "two",
+                 "tpuReplicaSpecs": {"Worker": {"template": {"spec": {
+                     "containers": [{"name": "tpu", "image": "i"}]}}}}},
+    }
+    job = tpuapi.TPUJob.from_dict(doc)  # must not raise
+    tpuapi.set_defaults(job)            # must not raise either
+    with pytest.raises(jobapi.ValidationError, match="numSlices must be"):
+        tpuapi.validate(job)

@@ -349,3 +349,21 @@ def test_elastic_pytorch_validation():
     ptapi.set_defaults(job)
     with pytest.raises(Exception, match="Master"):
         ptapi.validate(job)
+
+
+def test_malformed_num_slices_fails_job_not_worker():
+    """End-to-end engine check for the lenient-parse contract: the job gets
+    a Failed condition; the reconcile worker must not crash in from_dict."""
+    cluster = FakeCluster()
+    engine = make_engine("TPUJob", cluster)
+    cluster.create("TPUJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "TPUJob",
+        "metadata": {"name": "bad", "namespace": "default"},
+        "spec": {"acceleratorType": "v4-32", "numSlices": "two",
+                 "tpuReplicaSpecs": {"Worker": {"template": {"spec": {
+                     "containers": [{"name": "tpu", "image": "i"}]}}}}},
+    })
+    job = engine.adapter.from_dict(cluster.get("TPUJob", "default", "bad"))
+    engine.reconcile(job)
+    assert common.is_failed(job.status)
+    assert cluster.list_pods() == []

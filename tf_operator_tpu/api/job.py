@@ -172,13 +172,19 @@ def apply_common_defaults(
         set_default_port(spec.template, container_name, port_name, port)
 
 
+def is_int(value) -> bool:
+    """True for a real integer (bools are ints in Python but not in CRD
+    schemas) — the single integer predicate for validation and defaulting."""
+    return isinstance(value, int) and not isinstance(value, bool)
+
+
 def _require_nonneg_int(kind: str, field_name: str, value) -> None:
     """Shared numeric-field guard: None passes; anything except a
     non-negative int (the CRD schemas say type: integer, minimum: 0) is a
     ValidationError — never a TypeError crashing the reconcile loop."""
     if value is None:
         return
-    if isinstance(value, bool) or not isinstance(value, int):
+    if not is_int(value):
         raise ValidationError(
             f"{kind}Spec is not valid: {field_name} must be an integer, "
             f"got {value!r}"
@@ -218,18 +224,19 @@ def validate_run_policy(job: Job, kind: str = "Job") -> None:
     if sp is not None and sp.min_available is not None:
         ma = sp.min_available
         _require_nonneg_int(kind, "schedulingPolicy.minAvailable", ma)
-        total = sum(
-            s.replicas
-            for s in (job.replica_specs or {}).values()
-            if s is not None and isinstance(s.replicas, int)
-        )
-        if ma > total:
-            # a PodGroup with minMember > member count can never schedule:
-            # the job would hang Pending forever with no signal
-            raise ValidationError(
-                f"{kind}Spec is not valid: schedulingPolicy.minAvailable "
-                f"{ma} exceeds total replicas {total}"
-            )
+        specs = [s for s in (job.replica_specs or {}).values() if s is not None]
+        # only cross-check when every count is known — an underivable
+        # replicas (e.g. bad acceleratorType left it None) must surface its
+        # OWN error, not a misleading 'exceeds total replicas 0'
+        if all(is_int(s.replicas) for s in specs):
+            total = sum(s.replicas for s in specs)
+            if ma > total:
+                # a PodGroup with minMember > member count can never
+                # schedule: the job would hang Pending forever, silently
+                raise ValidationError(
+                    f"{kind}Spec is not valid: schedulingPolicy.minAvailable "
+                    f"{ma} exceeds total replicas {total}"
+                )
 
 
 def validate_replica_specs(

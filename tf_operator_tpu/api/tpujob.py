@@ -134,7 +134,10 @@ class TPUJob(jobapi.Job):
     def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
         self.accelerator_type = spec.get("acceleratorType", "")
         self.topology = spec.get("topology")
-        self.num_slices = int(spec.get("numSlices", 1))
+        # lenient parse: from_dict runs before validation (engine _sync,
+        # webhook), so a malformed value must surface as a ValidationError
+        # there, not a ValueError crash-looping the reconcile worker
+        self.num_slices = spec.get("numSlices", 1)
 
 
 def set_defaults(job: TPUJob) -> None:
@@ -154,7 +157,10 @@ def set_defaults(job: TPUJob) -> None:
     except jobapi.ValidationError:
         hosts, per_host = None, None
     if worker.replicas is None and hosts is not None:
-        worker.replicas = hosts * max(1, job.num_slices)
+        # a malformed numSlices is rejected by validate(); defaults must
+        # not crash on it meanwhile
+        ns = job.num_slices if jobapi.is_int(job.num_slices) else 1
+        worker.replicas = hosts * max(1, ns)
     if not worker.restart_policy:
         worker.restart_policy = DEFAULT_RESTART_POLICY
     jobapi.set_default_port(
@@ -192,6 +198,11 @@ def validate(job: TPUJob) -> None:
             f"{KIND}Spec is not valid: topology {job.topology!r} "
             f"({parse_topology(job.topology)} chips) does not match "
             f"acceleratorType {job.accelerator_type!r} ({chips} chips)"
+        )
+    if not jobapi.is_int(job.num_slices):
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: numSlices must be an integer, "
+            f"got {job.num_slices!r}"
         )
     if job.num_slices < 1:
         raise jobapi.ValidationError(
