@@ -234,3 +234,9 @@ def test_scale_rejects_out_of_bounds_elastic(capsys):
     assert _invoke(cli, ["scale", "pytorchjob", "el", "--replicas", "4"]) == 0
     doc = cli.cluster.get("PyTorchJob", "default", "el")
     assert doc["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] == 4
+
+
+def test_version_verb(capsys):
+    cli = _cli_and_cluster()
+    assert _invoke(cli, ["version"]) == 0
+    assert "tpu-operator" in capsys.readouterr().out

@@ -16,6 +16,7 @@ common verbs into one command:
   tpu-jobs resume tfjob mnist
   tpu-jobs scale pytorchjob elastic --replicas 6 [--replica-type Worker]
   tpu-jobs delete tfjob mnist
+  tpu-jobs version
 
 Backend selection matches the operator (`cmd/main.py:build_cluster`):
 --kubeconfig / $KUBECONFIG / in-cluster env picks the real apiserver
@@ -306,11 +307,17 @@ def make_parser() -> argparse.ArgumentParser:
 
     pl = sub.add_parser("list", parents=[common])
     pl.add_argument("kind")
+    sub.add_parser("version", parents=[common])
     return p
 
 
 def run(args: argparse.Namespace, cli: Cli) -> int:
     ns = args.namespace
+    if args.verb == "version":
+        from tf_operator_tpu import version
+
+        print(version.version_string())
+        return 0
     if args.verb == "submit":
         return cli.submit(args.file, ns)
     if args.verb == "run-local":
@@ -350,6 +357,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # fully local: never touch (or require) a cluster backend —
             # a stale $KUBECONFIG must not break an offline dev loop
             return run_local_file(args.file, args.timeout)
+        if args.verb == "version":
+            # same rule: version must print even with a broken kubeconfig
+            return run(args, Cli(None))
         return run(args, Cli(_build_cluster(args.kubeconfig)))
     except ApiError as e:  # NotFound/Conflict/...: clean message, no trace
         print(f"error: {e}", file=sys.stderr)

@@ -2,6 +2,7 @@
 pkg/version/version.go:21-24: Version + GitSHA)."""
 from __future__ import annotations
 
+import os
 import subprocess
 
 VERSION = "0.1.0"
@@ -12,9 +13,13 @@ def git_sha() -> str:
     global _git_sha_cache
     if _git_sha_cache is None:
         try:
+            # resolve against the PACKAGE's checkout, not the caller's CWD
+            # — an installed `tpu-jobs version` run inside some unrelated
+            # repo must not present that repo's HEAD as the operator build
             _git_sha_cache = (
                 subprocess.run(
-                    ["git", "rev-parse", "--short", "HEAD"],
+                    ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+                     "rev-parse", "--short", "HEAD"],
                     capture_output=True,
                     text=True,
                     timeout=5,
