@@ -833,3 +833,30 @@ def test_replica_status_selector_for_scale_subresource():
     # persisted through the status write-back
     stored = cluster.get("TFJob", "default", job.name)
     assert stored["status"]["replicaStatuses"]["Worker"]["selector"] == sel
+
+
+def test_terminating_orphan_service_not_adopted():
+    """Services share _claim_controllees with pods — the terminating-orphan
+    guard must hold there too."""
+    from tf_operator_tpu.k8s import objects as k8sobj
+
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    svc = k8sobj.make_service(
+        name=f"{job.name}-worker-0",
+        namespace="default",
+        labels={
+            k8sobj.LABEL_GROUP_NAME: k8sobj.GROUP_NAME,
+            k8sobj.LABEL_JOB_NAME: job.name,
+            k8sobj.LABEL_REPLICA_TYPE: "worker",
+            k8sobj.LABEL_REPLICA_INDEX: "0",
+        },
+        selector={}, port=2222, port_name="tfjob-port",
+    )
+    svc["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    cluster.create_service(svc)
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", job.name))
+    assert engine.get_services_for_job(fresh) == []
+    stored = cluster.get("Service", "default", f"{job.name}-worker-0")
+    assert not stored["metadata"].get("ownerReferences")
