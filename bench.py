@@ -68,6 +68,22 @@ def resnet50_train_flops_per_image(image_px: int) -> float:
     return 3.0 * RESNET50_FWD_FLOPS_224 * (image_px / 224.0) ** 2
 
 
+def _timed_train_steps(step, params, opt_state, tokens, warmup, steps):
+    """Shared LM timing harness: warm (and sync via value fetch — the only
+    reliable barrier on relayed transports), then time `steps` iterations.
+    Returns (dt_seconds, loss_after_warmup)."""
+    import jax
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    loss0 = float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(jax.device_get(loss))
+    return time.perf_counter() - t0, loss0
+
+
 # ---------------------------------------------------------------- backend
 PROBE_SRC = (
     "import jax; d = jax.devices()[0]; "
@@ -243,14 +259,7 @@ def bench_transformer(gen: str, n_chips: int):
             return optax.apply_updates(params, updates), opt_state, loss
 
         step = jax.jit(train_step, donate_argnums=(0, 1))
-        for _ in range(warmup):
-            params, opt_state, loss = step(params, opt_state, tokens)
-        float(jax.device_get(loss))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt_state, loss = step(params, opt_state, tokens)
-        float(jax.device_get(loss))
-        dt = time.perf_counter() - t0
+        dt, _ = _timed_train_steps(step, params, opt_state, tokens, warmup, steps)
         return steps * batch * cfg.max_len / dt / n_chips
 
     # sweep per-chip batch sizes x attention impls and keep the best
@@ -296,6 +305,61 @@ def bench_transformer(gen: str, n_chips: int):
         best["sweep_stopped"] = stops
     return best
 
+
+
+def bench_t5_3b(gen: str, cfg=None):
+    """Ladder config #5 at single-chip scale (opt-in via BENCH_T5=1: a
+    48-layer compile costs minutes, and the round-end bench must never
+    risk its headline on it).  T5-3B-class decoder fits ONE chip only
+    because of the framework's memory levers together: bf16 params (~5GB),
+    adafactor (factored state), remat blocks, pallas flash attention, and
+    the blocked CE (no [B,S,V] f32 logits).  `cfg` override: tests run the
+    same path on a tiny decoder."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tf_operator_tpu.models import transformer as tfm
+    from tf_operator_tpu.ops.blocked_ce import lm_blocked_loss
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    if cfg is None:
+        cfg = tfm.t5_3b_decoder(remat=True, attention_fn=flash_attention)
+    model = tfm.Transformer(cfg)
+    rng = jax.random.PRNGKey(0)
+    batch, steps, warmup = 1, 5, 2
+    tokens = jax.random.randint(rng, (batch, cfg.max_len), 0, cfg.vocab_size)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        model.init(rng, tokens, train=False)["params"],
+    )
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tx = optax.adafactor(1e-3)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_blocked_loss(model, p, tokens)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    dt, loss0 = _timed_train_steps(
+        step, params, opt_state, tokens, warmup, steps
+    )
+    tps = steps * batch * cfg.max_len / dt
+    flops_per_token = tfm.params_flops_per_token(cfg)
+    peak = PEAK_FLOPS_PER_CHIP.get(gen)
+    return {
+        "params_b": round(n_params / 1e9, 2),
+        "batch": batch,
+        "seq_len": cfg.max_len,
+        "steps": steps,
+        "loss_after_warmup": round(loss0, 3),
+        "tokens_per_sec_per_chip": round(tps, 1),
+        "mfu": round(tps * flops_per_token / peak, 4) if peak else None,
+    }
 
 
 def _parity(f_out, f_grads, r_out, r_grads):
@@ -680,6 +744,11 @@ def main() -> int:
             extra["flash_attention"] = bench_flash_attention(gen)
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        if os.environ.get("BENCH_T5") == "1":
+            try:
+                extra["t5_3b"] = bench_t5_3b(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["t5_3b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     try:
         extra["startup_latency"] = bench_startup_latency()
