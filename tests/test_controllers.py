@@ -367,3 +367,111 @@ def test_malformed_num_slices_fails_job_not_worker():
     engine.reconcile(job)
     assert common.is_failed(job.status)
     assert cluster.list_pods() == []
+
+
+def test_elastic_pytorch_mixed_outcome_fails_not_succeeds():
+    """ADVICE r2 (medium): one worker exits 0 while another fails
+    permanently in the same sync (straggler crash / scale-down race).
+    Failures must be evaluated BEFORE success — terminal conditions are
+    sticky, so a premature Succeeded would make Failed unrecordable."""
+    cluster = FakeCluster()
+    engine = make_engine("PyTorchJob", cluster)
+    job = _elastic_ptjob(workers=2, min_replicas=1, max_replicas=4)
+    cluster.create(job.kind, job.to_dict())
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", "elastic"))
+    engine.reconcile(fresh)
+    pods = sorted(cluster.list_pods(), key=lambda p: objects.name_of(p))
+    set_phase(cluster, pods[0], objects.POD_SUCCEEDED, exit_code=0,
+              container="pytorch")
+    set_phase(cluster, pods[1], objects.POD_FAILED, exit_code=1,
+              container="pytorch")
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, "default", "elastic"))
+    engine.reconcile(fresh)
+    assert common.is_failed(fresh.status)
+    assert not common.is_succeeded(fresh.status)
+
+
+def test_tpujob_partial_slice_teardown_is_loud():
+    """A failed delete during whole-slice restart must not pass silently:
+    the rest of the slice is still torn down, a Warning event names the
+    stuck pod, and the sync returns an error so it requeues (VERDICT r2
+    weak #3)."""
+    from tf_operator_tpu.engine.control import PodControl
+
+    class StickyPod(PodControl):
+        def __init__(self, cluster):
+            super().__init__(cluster)
+            self.fail_name = None
+
+        def delete_pod(self, namespace, name, owner):
+            if name == self.fail_name:
+                raise RuntimeError(f"injected delete failure for {name}")
+            super().delete_pod(namespace, name, owner)
+
+    cluster = FakeCluster()
+    control = StickyPod(cluster)
+    engine = make_engine("TPUJob", cluster, pod_control=control)
+    job = testutil.new_tpujob(name="bert", accelerator_type="v4-32")
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    pods = run_pods(cluster)
+    for p in pods:
+        set_phase(cluster, p, objects.POD_RUNNING, container="tpu")
+    set_phase(cluster, pods[3], objects.POD_FAILED, exit_code=137,
+              container="tpu")
+
+    control.fail_name = objects.name_of(pods[1])
+    job, result = reconcile(cluster, engine, job)
+    assert result.error and "slice teardown is partial" in result.error
+    assert result.requeue_after is not None  # retried, not dropped
+    warnings = [e for e in cluster.events_for("bert")
+                if e["reason"] == "PartialSliceTeardown"]
+    assert len(warnings) == 1
+    assert objects.name_of(pods[1]) in warnings[0]["message"]
+    # one stuck pod survives; everything else was still torn down
+    assert [objects.name_of(p) for p in cluster.list_pods()] == [
+        objects.name_of(pods[1])
+    ]
+
+    # failure clears -> the stale pod is deleted on sight (restart-generation
+    # stamp behind the restart counter), NOT absorbed into the new slice
+    control.fail_name = None
+    job, result = reconcile(cluster, engine, job)
+    assert result.error is None
+    live = {objects.name_of(p) for p in cluster.list_pods()}
+    assert objects.name_of(pods[1]) not in live  # old incarnation gone
+    # next sync completes the slice; every pod is the new incarnation
+    job, result = reconcile(cluster, engine, job)
+    recreated = run_pods(cluster)
+    assert len(recreated) == 4
+    assert all(
+        objects.labels_of(p)["restart-generation"] == "1" for p in recreated
+    )
+
+
+def test_unlabeled_pods_survive_restart_counter():
+    """Pre-upgrade pods carry no restart-generation label; with a persisted
+    restart counter > 0 they must count as the CURRENT incarnation — a
+    healthy running slice is never torn down just for missing the stamp."""
+    cluster = FakeCluster()
+    engine = make_engine("TPUJob", cluster)
+    job = testutil.new_tpujob(name="bert", accelerator_type="v4-8")
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    # simulate pre-upgrade state: strip the stamp, persist restarts=1
+    for p in run_pods(cluster):
+        p = cluster.get_pod("default", objects.name_of(p))
+        del p["metadata"]["labels"]["restart-generation"]
+        p["status"]["phase"] = objects.POD_RUNNING
+        cluster.update_pod(p)
+    doc = cluster.get(job.kind, "default", "bert")
+    doc.setdefault("status", {}).setdefault("replicaStatuses", {}).setdefault(
+        "Worker", {})["restarts"] = 1
+    cluster.update(job.kind, doc)
+
+    job, result = reconcile(cluster, engine, job)
+    assert result.error is None
+    assert len(cluster.list_pods()) == 1  # nothing deleted
+    assert common.is_running(job.status)

@@ -860,3 +860,55 @@ def test_terminating_orphan_service_not_adopted():
     assert engine.get_services_for_job(fresh) == []
     stored = cluster.get("Service", "default", f"{job.name}-worker-0")
     assert not stored["metadata"].get("ownerReferences")
+
+
+def test_suspend_preserves_scale_selector():
+    """ADVICE r2: /scale's labelSelectorPath reads the replica-status
+    selector while suspended — the suspend reset must keep it."""
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    selector = job.status.replica_statuses["Worker"].selector
+    assert selector  # set by normal reconcile
+
+    _set_suspend(cluster, job, True)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_suspended(job.status)
+    assert job.status.replica_statuses["Worker"].selector == selector
+
+
+def test_suspend_cleans_leftover_service():
+    """ADVICE r2: a service orphaned by a partially-failed earlier delete
+    (pod gone, service left) must still be cleaned while the job stays
+    suspended — the empty pod list must not short-circuit teardown."""
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    assert len(cluster.list_services()) == 2
+    # simulate the partial failure: pods removed, services left behind
+    for p in cluster.list_pods():
+        cluster.delete_pod(objects.namespace_of(p), objects.name_of(p))
+    assert cluster.list_pods() == [] and len(cluster.list_services()) == 2
+
+    _set_suspend(cluster, job, True)
+    job, _ = reconcile(cluster, engine, job)
+    assert cluster.list_services() == []
+
+
+def test_finished_job_cleans_orphan_service():
+    """The terminal-state cleanup must also retry a service orphaned by a
+    swallowed earlier delete error (pod gone, service left) — not only the
+    force_all paths."""
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=2))
+    job, _ = reconcile(cluster, engine, job)
+    for p in cluster.list_pods():
+        set_phase(cluster, p, objects.POD_SUCCEEDED, exit_code=0)
+    job, _ = reconcile(cluster, engine, job)
+    assert common.is_succeeded(job.status)
+    # simulate the partial failure: pods removed, one service left behind
+    for p in cluster.list_pods():
+        cluster.delete_pod(objects.namespace_of(p), objects.name_of(p))
+    assert len(cluster.list_services()) == 2
+    job, _ = reconcile(cluster, engine, job)
+    assert cluster.list_services() == []

@@ -146,3 +146,24 @@ def test_stream_logs_follows_until_terminal():
     assert ("streamy-worker-1", "w1 final") in got  # terminal tail drained
     # incremental: no duplicates
     assert len(got) == len(set(got))
+
+
+def test_scale_rejects_negative_replicas():
+    """ADVICE r2: a negative count (CLI typo) must be rejected client-side;
+    patched through where CRD schema isn't enforcing it would terminally
+    fail a healthy job at the next sync's validation."""
+    import pytest
+
+    from tf_operator_tpu.k8s.fake import FakeCluster
+    from tf_operator_tpu.sdk.client import JobClient
+    from tests import testutil
+
+    cluster = FakeCluster()
+    job = testutil.new_tfjob(worker=2)
+    cluster.create(job.kind, job.to_dict())
+    client = JobClient(cluster, kind="TFJob")
+    with pytest.raises(ValueError, match="replicas must be >= 0"):
+        client.scale(job.name, -1)
+    # the job spec is untouched
+    doc = cluster.get("TFJob", "default", job.name)
+    assert doc["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 2

@@ -130,10 +130,15 @@ class PyTorchAdapter(FrameworkAdapter):
         )
 
     def _elastic_update_job_status(self, job, ctx: StatusContext) -> None:
-        """Worker-only elastic jobs (torchrun rendezvous, no Master): any
+        """Worker-only elastic jobs (torchrun rendezvous, no Master): a
         worker completing cleanly completes the job — elastic agents exit
         together when training finishes, and stragglers are torn down by
-        CleanPodPolicy (modern training-operator elastic semantics)."""
+        CleanPodPolicy (modern training-operator elastic semantics).
+
+        Failures are evaluated FIRST: in a mixed outcome (one agent exits 0
+        while others fail permanently — straggler crash, scale-down race)
+        the job must record Failed, and terminal conditions are sticky, so
+        marking Succeeded here would make Failed unrecordable forever."""
         from tf_operator_tpu.controllers.shared_status import (
             handle_replica_failure,
             keep_running_tail,
@@ -143,9 +148,9 @@ class PyTorchAdapter(FrameworkAdapter):
         rtype = ptapi.REPLICA_WORKER
         spec = ctx.replicas[rtype]
         _, _, succeeded, failed = ctx.counts(rtype)
+        if handle_replica_failure(self.KIND, job, ctx, rtype, spec, failed):
+            return
         if succeeded > 0:
             mark_succeeded(self.KIND, job, ctx)
-            return
-        if handle_replica_failure(self.KIND, job, ctx, rtype, spec, failed):
             return
         keep_running_tail(self.KIND, job, ctx)

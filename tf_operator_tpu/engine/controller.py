@@ -39,6 +39,7 @@ from tf_operator_tpu.engine.expectations import (
     gen_expectation_services_key,
 )
 from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import NotFoundError
 
 # Gang-scheduling annotations (reference pod.go:223-237 / tfjob_controller.go:799-813)
 GANG_GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
@@ -56,6 +57,13 @@ REASON_POD_TEMPLATE_RESTART_POLICY = "SettedPodTemplateRestartPolicy"
 REASON_FAILED_VALIDATION = "FailedValidation"
 REASON_SUSPENDED = "JobSuspended"
 REASON_RESUMED = "JobResumed"
+REASON_PARTIAL_SLICE_TEARDOWN = "PartialSliceTeardown"
+
+
+class PartialSliceTeardown(RuntimeError):
+    """Whole-slice restart could not delete every pod of the slice; the
+    sync-level catch turns this into requeue-with-error so teardown retries
+    instead of silently leaving a partially-restarted slice."""
 
 
 def iso_from_epoch(ts: float) -> str:
@@ -223,8 +231,6 @@ class JobEngine:
         (UID) and not being deleted before any adoption happens. A missing
         job means no adoption; any other read error propagates so the sync
         aborts and retries instead of silently skipping adoption."""
-        from tf_operator_tpu.k8s.fake import NotFoundError
-
         try:
             current = self.cluster.get(job.kind, job.namespace, job.name)
         except NotFoundError:
@@ -326,7 +332,7 @@ class JobEngine:
 
         # ----- terminal state: clean pods, TTL (reference ReconcileJobs head)
         if common.is_finished(status):
-            self._delete_pods_and_services(job, pods)
+            self._delete_pods_and_services(job, pods, services)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
             res = self._cleanup_job_ttl(job)
@@ -340,15 +346,17 @@ class JobEngine:
         # ActiveDeadlineSeconds clock restarts on resume (batch/v1 Job
         # suspend behavior).
         if job.run_policy.suspend:
-            self._delete_pods_and_services(job, pods, force_all=True)
+            self._delete_pods_and_services(job, pods, services, force_all=True)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
             # counts describe live pods only; the ExitCode restart counter is
-            # history and survives suspension
+            # history and survives suspension, and the selector must too —
+            # /scale's labelSelectorPath reads it while suspended
             for rtype in replicas:
                 prev = status.replica_statuses.get(rtype)
                 status.replica_statuses[rtype] = common.ReplicaStatus(
-                    restarts=prev.restarts if prev else 0
+                    restarts=prev.restarts if prev else 0,
+                    selector=self._replica_selector(job, rtype),
                 )
             if not common.is_suspended(status):
                 msg = f"{self.adapter.KIND} {job.name} is suspended."
@@ -384,7 +392,7 @@ class JobEngine:
         if failure_message is not None:
             if status.completion_time is None:
                 status.completion_time = now_iso
-            self._delete_pods_and_services(job, pods, force_all=True)
+            self._delete_pods_and_services(job, pods, services, force_all=True)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
             self.cluster.record_event(
@@ -482,15 +490,21 @@ class JobEngine:
             pod = pod_slice[0]
             if index < 0 or index >= num_replicas:
                 # out-of-range: scale down (reference tfjob_controller.go:698-703)
-                key = gen_expectation_pods_key(job.key, rtype)
-                self.expectations.raise_expectations(key, 0, 1)
-                try:
-                    self.pod_control.delete_pod(
-                        job.namespace, objects.name_of(pod), job.to_dict()
-                    )
-                except Exception:
-                    self.expectations.lower_expectations(key, 0, 1)
-                    raise
+                self._delete_pod_with_expectations(job, rtype, pod)
+                continue
+
+            gen = objects.pod_restart_generation(pod)
+            if (
+                getattr(self.adapter, "WHOLE_SLICE_RESTART", False)
+                and gen is not None
+                and gen < status.replica_statuses[rtype].restarts
+            ):
+                # stale incarnation: an earlier whole-slice teardown was
+                # interrupted (PartialSliceTeardown) — finish it instead of
+                # absorbing a pre-restart pod into the recreated slice
+                self._delete_pod_with_expectations(job, rtype, pod)
+                if restarted_types is not None:
+                    restarted_types.add(rtype)
                 continue
 
             exit_code = objects.container_exit_code(pod, self.adapter.CONTAINER_NAME)
@@ -507,15 +521,7 @@ class JobEngine:
             ):
                 # delete-for-recreate + Restarting condition
                 # (reference tfjob_controller.go:705-736)
-                key = gen_expectation_pods_key(job.key, rtype)
-                self.expectations.raise_expectations(key, 0, 1)
-                try:
-                    self.pod_control.delete_pod(
-                        job.namespace, objects.name_of(pod), job.to_dict()
-                    )
-                except Exception:
-                    self.expectations.lower_expectations(key, 0, 1)
-                    raise
+                self._delete_pod_with_expectations(job, rtype, pod)
                 msg = (
                     f"{self.adapter.KIND} {job.name} is restarting because "
                     f"{rtype} replica(s) failed."
@@ -548,24 +554,58 @@ class JobEngine:
         # recreation (SURVEY.md §5.3/§7.4.1 — no reference counterpart; the
         # reference restarts pods individually).
         if restarted_this_pass and getattr(self.adapter, "WHOLE_SLICE_RESTART", False):
-            key = gen_expectation_pods_key(job.key, rtype)
+            failed_deletes: List[str] = []
             for pod_slice in self.get_slices(
                 self.filter_for_replica_type(self.get_pods_for_job(job), rtype),
                 num_replicas,
             ):
                 for pod in pod_slice:
-                    self.expectations.raise_expectations(key, 0, 1)
                     try:
-                        self.pod_control.delete_pod(
-                            job.namespace, objects.name_of(pod), job.to_dict()
-                        )
+                        self._delete_pod_with_expectations(job, rtype, pod)
                     except Exception:
-                        self.expectations.lower_expectations(key, 0, 1)
+                        # keep deleting the rest of the slice — one stuck pod
+                        # must not leave the others running — then surface the
+                        # partial teardown loudly below
+                        failed_deletes.append(objects.name_of(pod))
             # counts no longer reflect reality; reset for this pass (the
-            # restart counter is history, not a count of live pods — keep it)
+            # restart counter is history, not a count of live pods — keep it;
+            # the selector feeds /scale's labelSelectorPath — keep it too)
             status.replica_statuses[rtype] = common.ReplicaStatus(
-                restarts=status.replica_statuses[rtype].restarts
+                restarts=status.replica_statuses[rtype].restarts,
+                selector=self._replica_selector(job, rtype),
             )
+            if failed_deletes:
+                # A partially-torn-down slice is exactly the state whole-slice
+                # restart exists to prevent: event + raise so the sync-level
+                # catch requeues-with-error and retries the teardown.
+                msg = (
+                    f"{self.adapter.KIND} {job.name} whole-slice restart "
+                    f"could not delete {rtype} pod(s) "
+                    f"{', '.join(failed_deletes)}; slice teardown is partial"
+                )
+                self.cluster.record_event(
+                    job.to_dict(), "Warning", REASON_PARTIAL_SLICE_TEARDOWN, msg
+                )
+                raise PartialSliceTeardown(msg)
+
+    def _delete_pod_with_expectations(self, job: Job, rtype: str, pod) -> None:
+        """Expectation-guarded pod delete, shared by scale-down, exit-code
+        restart, stale-incarnation cleanup, and whole-slice teardown.
+        NotFound counts as success — the pod is already gone (deleted
+        earlier this sync, or the list came from a lagging cache) — but the
+        deletion will never surface as an informer event, so the
+        expectation is settled here."""
+        key = gen_expectation_pods_key(job.key, rtype)
+        self.expectations.raise_expectations(key, 0, 1)
+        try:
+            self.pod_control.delete_pod(
+                job.namespace, objects.name_of(pod), job.to_dict()
+            )
+        except NotFoundError:
+            self.expectations.lower_expectations(key, 0, 1)
+        except Exception:
+            self.expectations.lower_expectations(key, 0, 1)
+            raise
 
     def _create_new_pod(
         self,
@@ -586,6 +626,14 @@ class JobEngine:
         labels[objects.LABEL_REPLICA_INDEX] = str(index)
         if master_role:
             labels[objects.LABEL_JOB_ROLE] = "master"
+        if getattr(self.adapter, "WHOLE_SLICE_RESTART", False):
+            # incarnation stamp: lets later syncs finish an interrupted
+            # whole-slice teardown (a stale-generation pod is deleted on
+            # sight instead of being absorbed into the recreated slice)
+            rs = job.status.replica_statuses.get(rtype)
+            labels[objects.LABEL_RESTART_GENERATION] = str(
+                rs.restarts if rs else 0
+            )
 
         template = copy.deepcopy(spec.template)
         meta = template.setdefault("metadata", {})
@@ -709,13 +757,18 @@ class JobEngine:
 
     # ----------------------------------------------------------- run policy
     def _delete_pods_and_services(
-        self, job: Job, pods: List[Dict[str, Any]], force_all: bool = False
+        self,
+        job: Job,
+        pods: List[Dict[str, Any]],
+        services: Optional[List[Dict[str, Any]]] = None,
+        force_all: bool = False,
     ) -> None:
         """kubeflow/common DeletePodsAndServices: CleanPodPolicy None keeps
         everything; Running deletes only still-running pods; All deletes all.
-        Service shares the pod's name."""
-        if not pods:
-            return
+        Service shares the pod's name.  The listed services drive deletion
+        too: a service left behind by a swallowed earlier delete error must
+        not outlive its (already gone) pod — with force_all every listed
+        service goes; otherwise only pod-less orphans."""
         policy = job.run_policy.clean_pod_policy or common.CLEAN_POD_POLICY_RUNNING
         if not force_all and policy == common.CLEAN_POD_POLICY_NONE:
             return
@@ -733,6 +786,20 @@ class JobEngine:
                 pass
             try:
                 self.service_control.delete_service(job.namespace, name, job.to_dict())
+            except Exception:
+                pass
+        # orphan services: a pod-less service (earlier swallowed delete
+        # error) is always cleaned; services whose pod exists were already
+        # handled alongside the pod above (or deliberately kept by policy)
+        pod_names = {objects.name_of(p) for p in pods}
+        for svc in services or []:
+            name = objects.name_of(svc)
+            if name in pod_names:
+                continue
+            try:
+                self.service_control.delete_service(
+                    job.namespace, name, job.to_dict()
+                )
             except Exception:
                 pass
 

@@ -43,13 +43,15 @@ class Metric:
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} {self.TYPE}",
         ]
-        values = self._values or {(): 0.0}
+        with _LOCK:  # snapshot; inc/set mutate _values in place under _LOCK
+            values = dict(self._values) or {(): 0.0}
         for key, v in sorted(values.items()):
             lines.append(f"{self.name}{self._render_labels(key)} {v:g}")
         return "\n".join(lines)
 
     def reset(self) -> None:
-        self._values.clear()
+        with _LOCK:
+            self._values.clear()
 
 
 class Counter(Metric):
@@ -99,15 +101,18 @@ class Histogram(Metric):
             self._obs[k][1] = total + value
 
     def count(self, labels: Optional[Dict[str, str]] = None) -> int:
-        obs = self._obs.get(_label_key(labels))
-        return obs[0][-1] if obs else 0
+        with _LOCK:
+            obs = self._obs.get(_label_key(labels))
+            return obs[0][-1] if obs else 0
 
     def expose(self) -> str:
         lines = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} {self.TYPE}",
         ]
-        for key, (counts, total) in sorted(self._obs.items()):
+        with _LOCK:  # snapshot: observe() mutates the counts lists in place
+            snapshot = {k: (list(v[0]), v[1]) for k, v in self._obs.items()}
+        for key, (counts, total) in sorted(snapshot.items()):
             base = dict(key)
             for i, le in enumerate(self.buckets):
                 lk = self._render_labels(
@@ -124,19 +129,25 @@ class Histogram(Metric):
         return "\n".join(lines)
 
     def reset(self) -> None:
-        self._obs.clear()
-        self._values.clear()
+        with _LOCK:
+            self._obs.clear()
+            self._values.clear()
 
 
 def expose_all() -> str:
+    # each expose() snapshots under _LOCK itself (non-reentrant lock — the
+    # registry list is copied here so a concurrent Metric() init can't race
+    # the iteration)
     with _LOCK:
-        return "\n".join(m.expose() for m in _REGISTRY) + "\n"
+        registry = list(_REGISTRY)
+    return "\n".join(m.expose() for m in registry) + "\n"
 
 
 def reset_all() -> None:
     with _LOCK:
-        for m in _REGISTRY:
-            m.reset()
+        registry = list(_REGISTRY)
+    for m in registry:
+        m.reset()
 
 
 PREFIX = "tpu_operator"

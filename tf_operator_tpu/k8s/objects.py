@@ -28,6 +28,11 @@ LABEL_JOB_NAME = "job-name"
 LABEL_REPLICA_TYPE = "replica-type"
 LABEL_REPLICA_INDEX = "replica-index"
 LABEL_JOB_ROLE = "job-role"
+# slice incarnation stamp for whole-slice-restart types: the replica-status
+# restart counter at pod creation; a pod whose stamp is behind the counter
+# belongs to a torn-down incarnation (no reference counterpart — the
+# reference restarts pods individually)
+LABEL_RESTART_GENERATION = "restart-generation"
 
 GROUP_NAME = "kubeflow.org"
 API_VERSION = GROUP_NAME + "/v1"
@@ -120,6 +125,20 @@ def key_of(obj: Dict[str, Any]) -> str:
 
 def pod_phase(pod: Dict[str, Any]) -> str:
     return pod.get("status", {}).get("phase", POD_PENDING)
+
+
+def pod_restart_generation(pod: Dict[str, Any]) -> "int | None":
+    """The whole-slice incarnation the pod was created for.  None when the
+    label is absent or malformed: a pre-upgrade (or hand-made) pod counts
+    as the CURRENT incarnation — a healthy running slice must never be
+    torn down just for missing the stamp."""
+    val = labels_of(pod).get(LABEL_RESTART_GENERATION)
+    if val is None:
+        return None
+    try:
+        return int(val)
+    except ValueError:
+        return None
 
 
 def is_pod_active(pod: Dict[str, Any]) -> bool:

@@ -79,6 +79,12 @@ class JobClient:
         PyTorch jobs this is the knob the HPA drives via /scale)."""
         from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS
 
+        if replicas < 0:
+            # without this pre-check a negative count (CLI typo) is patched
+            # through wherever CRD schema isn't enforcing (FakeCluster,
+            # run-local) and the next sync writes a sticky terminal Failed
+            # validation condition on a previously healthy job
+            raise ValueError(f"replicas must be >= 0, got {replicas}")
         current = self.cluster.get(self.kind, namespace, name)
         # the authoritative replica-specs key comes from the kind's API
         # class, not from sniffing spec keys
