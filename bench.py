@@ -130,6 +130,43 @@ def probe_tpu(attempts: "int | None" = None, timeout_s: "float | None" = None):
     return False, detail
 
 
+# ---------------------------------------------------------------- TPU cache
+# Last-good TPU artifact (VERDICT r2 item 1a): a busy device pool must not
+# erase real-chip evidence.  Every successful TPU run persists its full
+# result here (git-tracked); a degraded (CPU) run merges it back into the
+# output with explicit provenance so the round artifact always carries the
+# newest TPU numbers that exist, clearly labeled live vs cached.
+CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST_GOOD.json"
+)
+
+
+def save_tpu_cache(result) -> None:
+    try:
+        payload = {
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "result": result,
+        }
+        with open(CACHE_PATH, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        print(f"# could not persist TPU last-good cache: {e}", file=sys.stderr)
+
+
+def load_tpu_cache():
+    """The cached payload, or None when absent/corrupt/not-a-TPU-result."""
+    try:
+        with open(CACHE_PATH) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    result = payload.get("result", {})
+    if result.get("platform") == "cpu" or not payload.get("measured_at"):
+        return None
+    return payload
+
+
 def detect_generation(dev) -> str:
     kind = getattr(dev, "device_kind", "").lower()
     if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
@@ -320,9 +357,9 @@ def bench_transformer(gen: str, n_chips: int):
 
 
 def bench_t5_3b(gen: str, cfg=None):
-    """Ladder config #5 at single-chip scale (opt-in via BENCH_T5=1: a
-    48-layer compile costs minutes, and the round-end bench must never
-    risk its headline on it).  T5-3B-class decoder fits ONE chip only
+    """Ladder config #5 at single-chip scale (default-on when a chip is
+    present, opt-out via BENCH_T5=0: a 48-layer compile costs minutes but
+    only 5 steps run).  T5-3B-class decoder fits ONE chip only
     because of the framework's memory levers together: bf16 params (~5GB),
     adafactor (factored state), remat blocks, pallas flash attention, and
     the blocked CE (no [B,S,V] f32 logits).  `cfg` override: tests run the
@@ -501,6 +538,46 @@ def bench_flash_attention(gen: str):
         }
     except Exception as e:  # noqa: BLE001 — surfaced, not fatal
         results["ring_flash_1dev"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    return results
+
+
+def bench_flash_parity_interpret():
+    """Degraded-mode flash arm (VERDICT r2 item 1c): with no chip, the
+    pallas kernel still executes in interpret mode so fwd+bwd parity lands
+    in the artifact.  Small shapes — interpret mode runs the grid serially
+    in Python; this is a correctness witness, not a timing."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import dot_product_attention
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = 1, 256, 2, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
+
+    results = {"mode": "interpret", "shape": f"b{b} s{s} h{h} d{d} bf16 fwd+bwd"}
+    for causal in (False, True):
+        tag = "causal" if causal else "full"
+
+        def loss_flash(q, k, v, _c=causal):
+            return flash_attention(
+                q, k, v, causal=_c, blk_q=128, blk_k=128, interpret=True
+            ).astype(jnp.float32).sum()
+
+        def loss_ref(q, k, v, _c=causal):
+            return dot_product_attention(q, k, v, _c).astype(jnp.float32).sum()
+
+        f_out, f_grads = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        r_out, r_grads = jax.value_and_grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        fwd_rel, grad_rel, ok = _parity(f_out, f_grads, r_out, r_grads)
+        results[tag] = {
+            "parity_ok": ok,
+            "fwd_rel_err": round(fwd_rel, 6),
+            "grad_max_rel_err": round(grad_rel, 6),
+        }
     return results
 
 
@@ -756,11 +833,20 @@ def main() -> int:
             extra["flash_attention"] = bench_flash_attention(gen)
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-        if os.environ.get("BENCH_T5") == "1":
+        # default-ON with a chip (VERDICT r2 item 1c): 5 steps + one big
+        # compile; opt out with BENCH_T5=0
+        if os.environ.get("BENCH_T5", "1") == "1":
             try:
                 extra["t5_3b"] = bench_t5_3b(gen)
             except Exception as e:  # noqa: BLE001 — surfaced, not fatal
                 extra["t5_3b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        # no chip: the pallas kernel still runs (interpret mode) so the
+        # flash arm's correctness witness lands in the artifact
+        try:
+            extra["flash_attention"] = bench_flash_parity_interpret()
+        except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+            extra["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     try:
         extra["startup_latency"] = bench_startup_latency()
@@ -795,6 +881,22 @@ def main() -> int:
     }
     if degraded_reason:
         result["degraded_reason"] = degraded_reason
+    if tpu_ok and dev.platform != "cpu":
+        result["source"] = "live"
+        save_tpu_cache(result)
+    else:
+        cached = load_tpu_cache()
+        if cached is not None:
+            # newest real-chip evidence, clearly labeled: the headline stays
+            # the honest live (CPU) measurement, the cached TPU sections ride
+            # along with provenance
+            result["tpu_last_good"] = {
+                **cached["result"],
+                # provenance LAST so it can't be clobbered by the stored
+                # result (which carries source=live from its own run)
+                "source": "cached",
+                "measured_at": cached["measured_at"],
+            }
     print(json.dumps(result))
     return 0
 

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Opportunistic TPU chip grabber: probe the shared device pool and, when a
+# chip frees up, run the full bench so BENCH_TPU_LAST_GOOD.json catches a
+# real-TPU artifact even if the pool is busy again at round end (the cache
+# is merged into later bench output with "source: cached" provenance).
+# Run under tmux/nohup for a whole session:
+#   hack/tpu_grab.sh [interval_s] [probe_timeout_s]
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-600}"
+PROBE_TIMEOUT="${2:-120}"
+while true; do
+  if timeout "$PROBE_TIMEOUT" python -c \
+      'import jax,sys; sys.exit(0 if jax.devices()[0].platform != "cpu" else 1)' \
+      >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) probe OK - running bench"
+    BENCH_PROBE_TIMEOUT_S="$PROBE_TIMEOUT" python bench.py \
+      > /tmp/bench_grab_last.json 2>/tmp/bench_grab_last.err
+    if grep -q '"source": "live"' /tmp/bench_grab_last.json 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) live TPU bench captured -> BENCH_TPU_LAST_GOOD.json"
+      exit 0
+    fi
+    echo "$(date -u +%FT%TZ) bench ran but not live-TPU; retrying"
+  else
+    echo "$(date -u +%FT%TZ) pool busy"
+  fi
+  sleep "$INTERVAL"
+done
