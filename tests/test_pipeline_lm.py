@@ -125,7 +125,9 @@ def test_apply_validates_stage_count():
 
 
 def test_init_rejects_unsupported_config():
-    with pytest.raises(ValueError, match="does not support"):
+    # n_experts is supported since r3, but only with moe_every=1 (stacked
+    # stage leaves must be shape-uniform across blocks)
+    with pytest.raises(ValueError, match="moe_every=1"):
         pl.init_params(jax.random.PRNGKey(0), _cfg(n_experts=4), n_stages=2)
     with pytest.raises(ValueError, match="does not support"):
         pl.init_params(jax.random.PRNGKey(0), _cfg(remat=True), n_stages=2)
@@ -153,3 +155,84 @@ def test_pipelined_fsdp_grads_match_sequential():
             jax.device_get(got), jax.device_get(want), atol=2e-4, rtol=2e-3,
             err_msg=jax.tree_util.keystr(path),
         )
+
+
+# ---------------------------------------------------------------------------
+# MoE inside the pipeline (VERDICT r2 item 4): switch FFN per block, experts
+# + tokens sharded over 'ep', all-to-all dispatch INSIDE gpipe stages
+# ---------------------------------------------------------------------------
+
+
+def _moe_cfg(**kw):
+    return _cfg(n_experts=2, moe_every=1, **kw)
+
+
+@pytest.mark.parametrize(
+    "axes,n_stages,n_micro",
+    [
+        ({"pp": 2, "ep": 2, "dp": 2}, 2, 2),
+        ({"pp": 2, "ep": 2, "fsdp": 2}, 2, 2),
+        ({"pp": 2, "ep": 2, "tp": 2}, 2, 2),
+    ],
+)
+def test_pipelined_moe_matches_sequential(axes, n_stages, n_micro):
+    """Logits + CE + aux parity vs the dense-dispatch sequential reference
+    with no-drop capacity (factor = n_experts): the all-to-all exchange
+    must be a pure re-layout of the same expert math."""
+    cfg = _moe_cfg()
+    factor = float(cfg.n_experts)  # capacity == local tokens: nothing drops
+    mesh = make_mesh(axes)
+    params = pl.init_params(jax.random.PRNGKey(0), cfg, n_stages)
+    assert "router" in params["stages"]
+    sharded = jax.device_put(params, pl.param_shardings(params, mesh))
+    tokens = _data(cfg)
+    apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro,
+                                       capacity_factor=factor)
+    got, aux = jax.jit(apply_fn)(sharded, tokens)
+    want, aux_seq = pl.sequential_apply(cfg, params, tokens,
+                                        capacity_factor=factor)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), atol=2e-4, rtol=2e-4
+    )
+    # aux: per-(shard, microbatch) statistics averaged vs the global batch
+    # statistic — a deliberate approximation, loose bound (parallel/ep.py)
+    assert abs(float(aux) - float(aux_seq)) / max(1e-9, float(aux_seq)) < 0.2
+    assert np.isfinite(float(aux))
+
+
+def test_pipelined_moe_grads_flow_to_router():
+    """The aux term must backprop through the gpipe accumulator: router
+    grads are nonzero and total-loss grads stay close to sequential."""
+    import optax
+
+    cfg = _moe_cfg()
+    factor = float(cfg.n_experts)
+    mesh = make_mesh({"pp": 2, "ep": 2, "dp": 2})
+    params = pl.init_params(jax.random.PRNGKey(2), cfg, n_stages=2)
+    sharded = jax.device_put(params, pl.param_shardings(params, mesh))
+    tokens = _data(cfg, seed=3)
+    apply_fn = pl.make_pipelined_apply(cfg, mesh, n_micro=2,
+                                       capacity_factor=factor)
+    w = 1e-2
+
+    g_pp = jax.jit(jax.grad(
+        lambda p: pl.pipeline_lm_loss_with_aux(apply_fn, p, tokens, w)[0]
+    ))(sharded)
+    assert float(optax.global_norm(g_pp["stages"]["router"])) > 0
+
+    def seq_loss(p):
+        logits, aux = pl.sequential_apply(cfg, p, tokens,
+                                          capacity_factor=factor)
+        return lm_loss(logits, tokens) + w * aux
+
+    g_seq = jax.grad(seq_loss)(params)
+    gn_pp = float(optax.global_norm(g_pp))
+    gn_seq = float(optax.global_norm(g_seq))
+    assert abs(gn_pp - gn_seq) / gn_seq < 2e-2, (gn_pp, gn_seq)
+
+
+def test_pipelined_moe_requires_moe_every_1():
+    cfg = _cfg(n_experts=2, moe_every=2)
+    mesh = make_mesh({"pp": 2, "dp": 4})
+    with pytest.raises(ValueError, match="moe_every=1"):
+        pl.make_pipelined_apply(cfg, mesh, n_micro=2)

@@ -40,7 +40,10 @@ def test_mesh_bad_sizes():
 
 def test_rules_spec():
     spec = DEFAULT_RULES.spec(("batch", "embed", None))
-    assert spec == jax.sharding.PartitionSpec(("dp", "fsdp"), "tp", None)
+    # batch rides dcn too: multislice dp-over-DCN (size-1 dcn is a no-op)
+    assert spec == jax.sharding.PartitionSpec(
+        ("dcn", "dp", "fsdp"), "tp", None
+    )
 
 
 def test_train_step_mlp_loss_decreases():
@@ -225,3 +228,33 @@ def test_grad_accumulation_rejects_indivisible_batch():
 
     with _pytest.raises(ValueError, match="divisible"):
         step(state, x, y)
+
+
+def test_multislice_mesh_dcn_outermost():
+    """numSlices=2: one dcn row per slice, contiguous (slice-major) device
+    blocks so only dcn-mapped traffic (batch/grads) crosses slices."""
+    env = {
+        "COORDINATOR_ADDRESS": "j-worker-0.ns.svc:8476",
+        "NUM_PROCESSES": "2", "PROCESS_ID": "0",
+        "MEGASCALE_COORDINATOR_ADDRESS": "j-worker-0.ns.svc:8476",
+        "MEGASCALE_NUM_SLICES": "2", "TPU_NUM_SLICES": "2",
+        "TPU_SLICE_ID": "0", "TPU_HOSTS_PER_SLICE": "2",
+        "TPU_TOTAL_HOSTS": "4",
+    }
+    info = bootstrap.slice_info_from_env(env)
+    assert info.num_slices == 2
+    devices = jax.devices()[:8]
+    mesh = bootstrap.multislice_mesh(info, {"fsdp": 2, "dp": -1},
+                                     devices=devices)
+    assert dict(mesh.shape)["dcn"] == 2
+    assert dict(mesh.shape)["fsdp"] == 2 and dict(mesh.shape)["dp"] == 2
+    # slice-major: dcn row s holds the s-th contiguous device block
+    row0 = [d.id for d in mesh.devices[0].flatten()]
+    row1 = [d.id for d in mesh.devices[1].flatten()]
+    assert row0 == [d.id for d in devices[:4]]
+    assert row1 == [d.id for d in devices[4:]]
+    # conflicting explicit dcn is rejected
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="numSlices"):
+        bootstrap.multislice_mesh(info, {"dcn": 4, "dp": -1},
+                                  devices=devices)

@@ -16,6 +16,14 @@ The embedding and LM head run *outside* the pipeline under plain GSPMD
 jit (they are not shape-preserving, so they cannot be pipeline stages).
 Batch is split over ('dp','fsdp') in both regions.
 
+MoE inside the pipeline (cfg.n_experts > 0): every block's FFN becomes a
+top-1 switch layer with experts sharded over the 'ep' mesh axis and the
+all-to-all dispatch of parallel/ep._local_moe running INSIDE each stage —
+the batch is additionally split over 'ep' so tokens are exchanged
+expert-major exactly as in the GSPMD path.  The per-block load-balance
+aux rides the gpipe aux accumulator (parallel/pp.gpipe has_aux) and is
+returned next to the logits.
+
 No reference counterpart: the reference operator never touches tensors
 (SURVEY.md §2.10, PP row "NO"); this is the TPU-first capability the
 rebuild adds on top of the reference's topology bookkeeping.
@@ -50,10 +58,25 @@ def init_params(rng: jax.Array, cfg: TransformerConfig, n_stages: int) -> Dict:
     _check_supported(cfg)
     lps = cfg.n_layers // n_stages
     e, h, d, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
-    k_embed, k_pos, k_qkv, k_out, k_wi, k_wo = jax.random.split(rng, 6)
+    k_embed, k_pos, k_qkv, k_out, k_wi, k_wo, k_router = jax.random.split(rng, 7)
 
     def init(key, shape, fan_in):
         return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+    if cfg.n_experts:
+        # switch FFN: every block carries a router + per-expert wi/wo
+        # (stacked leaves must be shape-uniform across blocks, hence the
+        # every-block restriction in _check_supported)
+        ffn = {
+            "router": init(k_router, (n_stages, lps, e, cfg.n_experts), e),
+            "wi": init(k_wi, (n_stages, lps, cfg.n_experts, e, f), e),
+            "wo": init(k_wo, (n_stages, lps, cfg.n_experts, f, e), f),
+        }
+    else:
+        ffn = {
+            "wi": init(k_wi, (n_stages, lps, e, f), e),
+            "wo": init(k_wo, (n_stages, lps, f, e), f),
+        }
 
     return {
         "embed": {
@@ -65,8 +88,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig, n_stages: int) -> Dict:
             "qkv": init(k_qkv, (n_stages, lps, e, 3, h, d), e),
             "out": init(k_out, (n_stages, lps, h, d, e), h * d),
             "ln2": jnp.ones((n_stages, lps, e), jnp.float32),
-            "wi": init(k_wi, (n_stages, lps, e, f), e),
-            "wo": init(k_wo, (n_stages, lps, f, e), f),
+            **ffn,
         },
         "ln_f": jnp.ones((e,), jnp.float32),
     }
@@ -78,8 +100,14 @@ def _check_supported(cfg: TransformerConfig) -> None:
     numeric witness pass while training a different model than asked."""
     if not cfg.tie_embeddings:
         raise ValueError("pipelined LM supports tied embeddings only")
+    if cfg.n_experts and cfg.moe_every != 1:
+        # stacked stage leaves must be shape-uniform across blocks, so the
+        # pipelined MoE puts a switch FFN in EVERY block
+        raise ValueError(
+            f"pipelined MoE requires moe_every=1 (every block MoE); got "
+            f"moe_every={cfg.moe_every}"
+        )
     unsupported = {
-        "n_experts": cfg.n_experts,
         "attention_fn": cfg.attention_fn,
         "moe_dispatch_fn": cfg.moe_dispatch_fn,
         "remat": cfg.remat,
@@ -89,24 +117,36 @@ def _check_supported(cfg: TransformerConfig) -> None:
         raise ValueError(
             f"pipelined LM does not support config fields {set_fields}; "
             f"use the non-pipelined Transformer (models/transformer.py) "
-            f"for MoE/custom-attention/remat, or combine pp with ep/sp at "
-            f"the mesh level in a future revision"
+            f"for custom-attention/remat (MoE: set n_experts + moe_every=1; "
+            f"the pipeline places the ep all-to-all itself)"
         )
 
 
 # per stage-leaf: the dim (in STACKED [pp, L, ...] coordinates) that fsdp
-# shards — the model dim E everywhere; ln scales are too small to bother
-_FSDP_DIMS = {"qkv": 2, "out": 4, "wi": 2, "wo": 3, "ln1": None, "ln2": None}
+# shards — the model dim E everywhere; ln scales are too small to bother.
+# Dense and MoE FFN leaves share names but differ in rank, hence two tables.
+_FSDP_DIMS_DENSE = {
+    "qkv": 2, "out": 4, "wi": 2, "wo": 3, "ln1": None, "ln2": None,
+}
+_FSDP_DIMS_MOE = {
+    "qkv": 2, "out": 4, "wi": 3, "wo": 4, "ln1": None, "ln2": None,
+    "router": None,  # [pp, L, e, E] — small; replicated like the ln scales
+}
 
 
-def stage_param_specs(fsdp: bool = False) -> Dict:
+def _fsdp_dims(moe: bool) -> Dict:
+    return _FSDP_DIMS_MOE if moe else _FSDP_DIMS_DENSE
+
+
+def stage_param_specs(fsdp: bool = False, moe: bool = False) -> Dict:
     """PartitionSpec pytree for params['stages']: stage dim over 'pp',
     head/ffn dims over 'tp' (column-parallel qkv/wi, row-parallel out/wo),
-    and optionally the model dim over 'fsdp' (gathered per stage —
-    _gather_stage)."""
+    experts over 'ep' for the MoE FFN, and optionally the model dim over
+    'fsdp' (gathered per stage — _gather_stage)."""
+    dims = _fsdp_dims(moe)
 
     def with_fsdp(name: str, spec: P) -> P:
-        d = _FSDP_DIMS.get(name)
+        d = dims.get(name)
         if not fsdp or d is None:
             return spec
         parts = list(spec) + [None] * (d + 1 - len(spec))
@@ -118,20 +158,32 @@ def stage_param_specs(fsdp: bool = False) -> Dict:
         "qkv": P("pp", None, None, None, "tp", None),
         "out": P("pp", None, "tp", None, None),
         "ln2": P("pp", None, None),
-        "wi": P("pp", None, None, "tp"),
-        "wo": P("pp", None, "tp", None),
     }
+    if moe:
+        base.update({
+            # experts sharded over ep; the switch FFN is not tp-sharded
+            # (tp stays on attention), so expert dims beyond E are fsdp-only
+            "router": P("pp", None, None, None),
+            "wi": P("pp", None, "ep", None, None),
+            "wo": P("pp", None, "ep", None, None),
+        })
+    else:
+        base.update({
+            "wi": P("pp", None, None, "tp"),
+            "wo": P("pp", None, "tp", None),
+        })
     return {k: with_fsdp(k, v) for k, v in base.items()}
 
 
-def _gather_stage(params: Dict) -> Dict:
+def _gather_stage(params: Dict, moe: bool = False) -> Dict:
     """Manual FSDP inside shard_map: all-gather each fsdp-sharded leaf
     back to full size before the stage computes (dims shift by -1: gpipe
     already stripped the leading pp dim). Autodiff transposes the gather
     to a reduce-scatter of the grads — the textbook FSDP backward."""
+    dims = _fsdp_dims(moe)
     out = {}
     for name, leaf in params.items():
-        d = _FSDP_DIMS.get(name)
+        d = dims.get(name)
         if d is None:
             out[name] = leaf
         else:
@@ -147,9 +199,10 @@ def param_shardings(params: Dict, mesh: Mesh,
     with the same specs). fsdp defaults to mesh['fsdp'] > 1."""
     if fsdp is None:
         fsdp = mesh.shape.get("fsdp", 1) > 1
+    moe = "router" in params["stages"]
     stage_specs = jax.tree.map(
         lambda s: NamedSharding(mesh, s),
-        stage_param_specs(fsdp=fsdp),
+        stage_param_specs(fsdp=fsdp, moe=moe),
         is_leaf=lambda x: isinstance(x, P),
     )
     rep = NamedSharding(mesh, P())
@@ -169,11 +222,40 @@ def _layernorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return y.astype(x.dtype)
 
 
+def _moe_ffn(p: Dict, h: jax.Array, *, ep_axis: Optional[str],
+             capacity_factor: float):
+    """Switch FFN on the (possibly ep-local) token shard h [b, s, e].
+    Under shard_map (ep_axis set) tokens ride parallel/ep._local_moe's
+    all-to-all dispatch; unsharded (sequential reference) the dense
+    masked-einsum oracle computes identical routing/capacity semantics."""
+    from tf_operator_tpu.parallel import ep as ep_mod
+
+    b, s, e = h.shape
+    n_experts = p["router"].shape[-1]
+    logits = jnp.einsum(
+        "bse,ef->bsf", h.astype(jnp.float32), p["router"]
+    )  # router math in f32 for a stable softmax
+    # capacity from LOCAL tokens (static shape): every device must agree
+    capacity = max(1, math.ceil(b * s / n_experts * capacity_factor))
+    wi = p["wi"].astype(h.dtype)
+    wo = p["wo"].astype(h.dtype)
+    if ep_axis is not None:
+        y, aux = ep_mod._local_moe(
+            h.reshape(b * s, e), logits.reshape(b * s, n_experts),
+            wi, wo, n_experts=n_experts, capacity=capacity,
+            axis_name=ep_axis,
+        )
+        return y.reshape(b, s, e), aux
+    return ep_mod.dense_reference_moe(h, logits, wi, wo, capacity)
+
+
 def _block(p: Dict, x: jax.Array, *, causal: bool,
-           tp_axis: Optional[str]) -> jax.Array:
+           tp_axis: Optional[str], ep_axis: Optional[str] = None,
+           capacity_factor: float = 1.25):
     """One transformer block on (possibly tp-local) param shards.
     x: [b, s, e] replicated over tp; qkv/out hold h/tp local heads and
-    wi/wo f/tp local ffn columns; each residual branch ends in a psum."""
+    wi/wo f/tp local ffn columns; each residual branch ends in a psum.
+    Returns (x, aux) — aux is the MoE load-balance scalar (0 for dense)."""
     dtype = x.dtype
     h = _layernorm(x, p["ln1"])
     qkv = jnp.einsum("bse,ethd->tbshd", h, p["qkv"].astype(dtype))
@@ -183,22 +265,34 @@ def _block(p: Dict, x: jax.Array, *, causal: bool,
         o = jax.lax.psum(o, tp_axis)
     x = x + o
     h = _layernorm(x, p["ln2"])
+    if "router" in p:
+        o, aux = _moe_ffn(p, h, ep_axis=ep_axis,
+                          capacity_factor=capacity_factor)
+        # experts are ep-sharded, not tp-sharded: o is already the full
+        # sum; with tp>1 every tp member computed it identically
+        return x + o, aux
     h = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, p["wi"].astype(dtype)))
     o = jnp.einsum("bsf,fe->bse", h, p["wo"].astype(dtype))
     if tp_axis is not None:
         o = jax.lax.psum(o, tp_axis)
-    return x + o
+    return x + o, jnp.float32(0)
 
 
 def _stage_fn(p: Dict, x: jax.Array, *, causal: bool,
-              tp_axis: Optional[str]) -> jax.Array:
+              tp_axis: Optional[str], ep_axis: Optional[str] = None,
+              capacity_factor: float = 1.25, with_aux: bool = False):
     """One pipeline stage = blocks_per_stage blocks applied in order.
     Leaves of p are [blocks_per_stage, ...] (stage dim already stripped
-    by gpipe)."""
+    by gpipe).  with_aux: return (x, aux summed over the stage's blocks)."""
     n_blocks = p["ln1"].shape[0]
+    aux_sum = jnp.float32(0)
     for i in range(n_blocks):
-        x = _block(jax.tree.map(lambda a: a[i], p), x,
-                   causal=causal, tp_axis=tp_axis)
+        x, aux = _block(jax.tree.map(lambda a: a[i], p), x,
+                        causal=causal, tp_axis=tp_axis, ep_axis=ep_axis,
+                        capacity_factor=capacity_factor)
+        aux_sum = aux_sum + aux
+    if with_aux:
+        return x, aux_sum
     return x
 
 
@@ -212,37 +306,71 @@ def _head(params: Dict, x: jax.Array) -> jax.Array:
     return jnp.einsum("bse,ve->bsv", x, params["embed"]["embedding"])
 
 
-def make_pipelined_apply(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
+def make_pipelined_apply(cfg: TransformerConfig, mesh: Mesh, n_micro: int,
+                         capacity_factor: Optional[float] = None):
     """f(params, tokens) -> logits running the block stack through the
     gpipe schedule over mesh axis 'pp', with tp collectives inside stages
     and batch over ('dp','fsdp').  Differentiable end to end (gpipe's
-    scan+ppermute transposes to the reverse schedule)."""
+    scan+ppermute transposes to the reverse schedule).
+
+    MoE configs (cfg.n_experts > 0): batch additionally splits over 'ep',
+    experts shard over 'ep', each stage runs the all-to-all dispatch, and
+    f returns (logits, aux) — aux is the load-balance loss summed over
+    blocks, averaged over microbatches (comparable to the sequential
+    reference's per-batch sum over blocks)."""
     _check_supported(cfg)
+    moe = cfg.n_experts > 0
+    if moe and capacity_factor is None:
+        # capacity derives from LOCAL token counts, so the witness pair
+        # (pipelined vs sequential_apply) must be handed the same factor
+        # explicitly — a silent default would let the two sides disagree
+        # on drop behavior
+        raise ValueError(
+            "MoE pipeline requires an explicit capacity_factor (pass the "
+            "same value to sequential_apply when comparing)"
+        )
+    if capacity_factor is None:
+        capacity_factor = 1.25
     tp = mesh.shape.get("tp", 1)
+    ep = mesh.shape.get("ep", 1)
     fsdp = mesh.shape.get("fsdp", 1) > 1
     tp_axis = "tp" if tp > 1 else None
-    if cfg.n_heads % tp or cfg.d_ff % tp:
+    ep_axis = "ep" if (moe and ep > 1) else None
+    if cfg.n_heads % tp:
+        raise ValueError(f"tp {tp} must divide n_heads {cfg.n_heads}")
+    if not moe and cfg.d_ff % tp:
+        raise ValueError(f"tp {tp} must divide d_ff {cfg.d_ff}")
+    if moe and cfg.n_experts % ep:
         raise ValueError(
-            f"tp {tp} must divide n_heads {cfg.n_heads} and d_ff {cfg.d_ff}"
+            f"ep {ep} must divide n_experts {cfg.n_experts}"
         )
     if fsdp and cfg.d_model % mesh.shape["fsdp"]:
         raise ValueError(
             f"fsdp {mesh.shape['fsdp']} must divide d_model {cfg.d_model}"
         )
-    base_stage = functools.partial(_stage_fn, causal=cfg.causal,
-                                   tp_axis=tp_axis)
+    base_stage = functools.partial(
+        _stage_fn, causal=cfg.causal, tp_axis=tp_axis, ep_axis=ep_axis,
+        capacity_factor=capacity_factor, with_aux=moe,
+    )
     if fsdp:
         def stage_fn(p, x):
-            return base_stage(_gather_stage(p), x)
+            return base_stage(_gather_stage(p, moe=moe), x)
     else:
         stage_fn = base_stage
+    batch_axes = ("dp", "fsdp", "ep") if ep_axis else ("dp", "fsdp")
     run = make_pipeline_fn(
         mesh, stage_fn, n_micro, axis_name="pp",
-        param_specs=stage_param_specs(fsdp=fsdp), batch_axes=("dp", "fsdp"),
+        param_specs=stage_param_specs(fsdp=fsdp, moe=moe),
+        batch_axes=batch_axes, has_aux=moe,
     )
 
-    def apply(params: Dict, tokens: jax.Array) -> jax.Array:
+    def apply(params: Dict, tokens: jax.Array):
         x = _embed(params["embed"], tokens, cfg.dtype)
+        if moe:
+            x, aux = run(params["stages"], x)
+            # gpipe aux = sum over stages × microbatches; per-batch scale
+            # (the transformer.py convention: sum over blocks) = / n_micro
+            return _head(params, x), aux / n_micro
         x = run(params["stages"], x)
         return _head(params, x)
 
@@ -250,17 +378,45 @@ def make_pipelined_apply(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
 
 
 def sequential_apply(cfg: TransformerConfig, params: Dict,
-                     tokens: jax.Array) -> jax.Array:
+                     tokens: jax.Array,
+                     capacity_factor: Optional[float] = None):
     """Unsharded reference: the same params applied block-by-block on one
-    device — the numeric witness for the pipelined path."""
+    device — the numeric witness for the pipelined path.  MoE configs
+    return (logits, aux) like the pipelined apply and require the same
+    explicit capacity_factor the pipelined side was built with."""
+    if "router" in params["stages"] and capacity_factor is None:
+        raise ValueError(
+            "MoE reference requires the capacity_factor the pipelined "
+            "apply was built with"
+        )
+    if capacity_factor is None:
+        capacity_factor = 1.25
     x = _embed(params["embed"], tokens, cfg.dtype)
     stages = params["stages"]
+    moe = "router" in stages
     n_stages = stages["ln1"].shape[0]
+    aux_sum = jnp.float32(0)
     for s in range(n_stages):
-        x = _stage_fn(jax.tree.map(lambda a: a[s], stages), x,
-                      causal=cfg.causal, tp_axis=None)
+        out = _stage_fn(jax.tree.map(lambda a: a[s], stages), x,
+                        causal=cfg.causal, tp_axis=None,
+                        capacity_factor=capacity_factor, with_aux=moe)
+        if moe:
+            x, aux = out
+            aux_sum = aux_sum + aux
+        else:
+            x = out
+    if moe:
+        return _head(params, x), aux_sum
     return _head(params, x)
 
 
 def pipeline_lm_loss(apply_fn, params, tokens) -> jax.Array:
     return lm_loss(apply_fn(params, tokens), tokens)
+
+
+def pipeline_lm_loss_with_aux(apply_fn, params, tokens, aux_weight: float):
+    """(total, ce) for MoE pipelines: CE + weighted load-balance aux —
+    the same split the GSPMD train step uses (transformer.lm_loss_with_aux)."""
+    logits, aux = apply_fn(params, tokens)
+    ce = lm_loss(logits, tokens)
+    return ce + aux_weight * aux, ce

@@ -84,7 +84,7 @@ def ring_attention(q, k, v, causal: bool = False, *,
 
 
 def make_ring_attention_fn(mesh: Mesh, axis_name: str = "tp",
-                           batch_axes=("dp", "fsdp")):
+                           batch_axes=("dcn", "dp", "fsdp")):
     """An attention_fn for models/transformer.TransformerConfig: shard_maps
     [B, S, H, D] inputs with S over `axis_name` and runs ring_attention.
     Nesting inside the outer jit is fine; XLA overlaps the ppermute hops

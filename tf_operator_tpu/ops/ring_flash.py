@@ -398,7 +398,7 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
 
 
 def make_ring_flash_attention_fn(mesh: Mesh, axis_name: str = "tp",
-                                 batch_axes=("dp", "fsdp"),
+                                 batch_axes=("dcn", "dp", "fsdp"),
                                  interpret: Optional[bool] = None):
     """An attention_fn for models/transformer.TransformerConfig — drop-in
     for make_ring_attention_fn with the fused per-step kernel."""

@@ -61,7 +61,7 @@ def ulysses_attention(q, k, v, causal: bool = False, *,
 
 
 def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = "tp",
-                              batch_axes=("dp", "fsdp"),
+                              batch_axes=("dcn", "dp", "fsdp"),
                               use_flash: bool = False, interpret=None):
     """attention_fn for TransformerConfig — same interface as
     make_ring_attention_fn, so configs pick ring vs ulysses freely."""

@@ -7,6 +7,9 @@ over the slice and annotates arrays with logical axes; XLA inserts the
 collectives, which ride ICI within a slice and DCN across slices.
 
 Axes (any may be size 1 and is then effectively disabled):
+  dcn   — cross-slice data parallel (multislice: one mesh entry per slice;
+          collectives over it ride the data-center network, every other
+          axis stays inside a slice on ICI)
   dp    — data parallel (batch split; gradient psum)
   fsdp  — fully-sharded data parallel (batch split + param/optimizer shard)
   tp    — tensor parallel (embed/heads/mlp split; activation collectives)
@@ -15,6 +18,13 @@ Axes (any may be size 1 and is then effectively disabled):
 `sp` (sequence/context parallel for ring attention) reuses the `tp` axis on
 the mesh — sequence shards live where attention heads live, so ring
 ppermutes stay intra-slice (see ops/ring_attention.py).
+
+dcn is OUTERMOST: jax orders devices by global process id, and the
+operator's rendezvous math assigns ids slice-major (slice_id *
+hosts_per_slice + host — runtime/bootstrap.py global_rendezvous), so a
+contiguous reshape puts each slice's devices in one dcn row and only the
+batch/gradient dp traffic crosses slices (the scaling-book recipe:
+dp-over-dcn, everything else over ICI).
 """
 from __future__ import annotations
 
@@ -26,7 +36,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "tp")
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "tp")
 
 
 def make_mesh(
@@ -84,7 +94,7 @@ class MeshRules:
 
 DEFAULT_RULES = MeshRules(
     rules=(
-        ("batch", ("dp", "fsdp")),  # batch split over all data axes
+        ("batch", ("dcn", "dp", "fsdp")),  # batch split over all data axes
         ("embed", "tp"),
         ("heads", "tp"),
         ("kv", None),

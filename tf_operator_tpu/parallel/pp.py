@@ -34,12 +34,21 @@ def stack_stage_params(params_list) -> Any:
 
 
 def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], params, x,
-          *, axis_name: str = "pp") -> jax.Array:
+          *, axis_name: str = "pp", has_aux: bool = False,
+          aux_mean_axes: tuple = ()):
     """Run the pipeline. Call inside shard_map:
       params — this device's stage slice, leading dim 1 (from a stacked
                [n_stages, ...] pytree sharded over `axis_name`)
       x      — microbatched input [n_micro, mb, ...], same on every stage
-    Returns [n_micro, mb, ...] outputs (replicated via a masked psum)."""
+    Returns [n_micro, mb, ...] outputs (replicated via a masked psum).
+
+    has_aux: stage_fn returns (y, aux_scalar) — e.g. an MoE load-balance
+    loss.  Each stage accumulates aux only on its VALID ticks (the
+    fill/drain ticks compute on garbage and must not contribute), the
+    per-stage sums are psummed over `axis_name` (total over stages ×
+    microbatches), then pmeaned over `aux_mean_axes` (token-splitting
+    axes: each member saw different tokens, the global scalar is their
+    mean).  Returns (outputs, aux_total)."""
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
     for path, leaf in jax.tree_util.tree_leaves_with_path(params):
@@ -56,38 +65,58 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], params, x,
     # activations hop stage i -> i+1; stage 0 has no upstream sender
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
+    def run_stage(inp):
+        if has_aux:
+            return stage_fn(my_params, inp)
+        return stage_fn(my_params, inp), jnp.float32(0)
+
     def tick(carry, t):
-        buf, out = carry
+        buf, out, aux_acc = carry
         feed = x[jnp.clip(t, 0, n_micro - 1)]
         inp = jnp.where(stage == 0, feed, buf)
-        y = stage_fn(my_params, inp)
+        y, aux = run_stage(inp)
         buf_next = jax.lax.ppermute(y, axis_name, perm)
+        # this stage computes microbatch t - stage; outside [0, n_micro)
+        # it's chewing fill/drain garbage and the aux must be masked
+        m_mine = t - stage
+        aux_valid = jnp.logical_and(m_mine >= 0, m_mine < n_micro)
+        aux_acc = aux_acc + jnp.where(
+            aux_valid, aux.astype(jnp.float32), 0.0
+        )
         m = t - (n_stages - 1)  # microbatch draining at the last stage
         valid = jnp.logical_and(stage == n_stages - 1,
                                 jnp.logical_and(m >= 0, m < n_micro))
         upd = jnp.where(valid, y, out[jnp.clip(m, 0, n_micro - 1)])
         out = jax.lax.dynamic_update_index_in_dim(
             out, upd, jnp.clip(m, 0, n_micro - 1), axis=0)
-        return (buf_next, out), None
+        return (buf_next, out, aux_acc), None
 
-    y_struct = _stage_out_struct(stage_fn, my_params, x)
+    y_struct = _stage_out_struct_aux(run_stage, x)
     buf0 = jnp.zeros(y_struct.shape, y_struct.dtype)
     out0 = jnp.zeros((n_micro,) + y_struct.shape, y_struct.dtype)
-    (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+    (_, out, aux_acc), _ = jax.lax.scan(
+        tick, (buf0, out0, jnp.float32(0)), jnp.arange(ticks)
+    )
     # only the last stage holds real outputs; replicate with a masked psum
     mask = (stage == n_stages - 1).astype(out.dtype)
-    return jax.lax.psum(out * mask, axis_name)
+    out = jax.lax.psum(out * mask, axis_name)
+    if not has_aux:
+        return out
+    aux_total = jax.lax.psum(aux_acc, axis_name)
+    for ax in aux_mean_axes:
+        aux_total = jax.lax.pmean(aux_total, ax)
+    return out, aux_total
 
 
-def _stage_out_struct(stage_fn, params, x):
+def _stage_out_struct_aux(run_stage, x):
     """Shape+dtype of one stage's output on the steady-state carry. Stages
     must be shape-preserving across hops; the carry dtype is the fixed point
-    of input-dtype promotion (a bf16 batch through f32 params carries f32)."""
-    y = jax.eval_shape(stage_fn, params,
-                       jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+    of input-dtype promotion (a bf16 batch through f32 params carries f32).
+    run_stage: inp -> (y, aux)."""
+    y, _ = jax.eval_shape(run_stage, jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
     carry_dtype = jnp.promote_types(x.dtype, y.dtype)
-    y = jax.eval_shape(stage_fn, params,
-                       jax.ShapeDtypeStruct(x.shape[1:], carry_dtype))
+    y, _ = jax.eval_shape(run_stage,
+                          jax.ShapeDtypeStruct(x.shape[1:], carry_dtype))
     if y.shape != x.shape[1:]:
         raise ValueError(
             f"gpipe: stage output shape {y.shape} != input {x.shape[1:]}; "
@@ -98,7 +127,7 @@ def _stage_out_struct(stage_fn, params, x):
 
 def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
                      axis_name: str = "pp", param_specs=None,
-                     batch_axes=None):
+                     batch_axes=None, has_aux: bool = False):
     """jit-able f(stacked_params, batch) running the pipeline over `mesh`.
     `stacked_params` leaves are [n_stages, ...]; batch [B, ...] is split
     into n_micro microbatches.
@@ -109,7 +138,10 @@ def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
     head/ffn dims whose collectives stage_fn places itself.  Default:
     everything sharded only over `axis_name`.
     batch_axes: optional mesh axis (or tuple) to shard the microbatch dim
-    over (data parallelism inside the pipeline).  Default: replicated."""
+    over (data parallelism inside the pipeline).  Default: replicated.
+    has_aux: stage_fn returns (y, aux_scalar); f returns (out, aux_total)
+    with aux summed over stages × microbatches and pmeaned over the
+    token-splitting axes (see gpipe)."""
     from tf_operator_tpu.parallel.compat import shard_map
 
     if param_specs is None:
@@ -151,12 +183,23 @@ def make_pipeline_fn(mesh: Mesh, stage_fn, n_micro: int,
                     f"match (one stage per pipeline device)"
                 )
         x = batch.reshape((n_micro, b // n_micro) + batch.shape[1:])
-        inner = functools.partial(gpipe, stage_fn, axis_name=axis_name)
+        # the aux scalar differs across members that saw different tokens
+        # (the batch axes); pmean over every non-pp axis is the global mean
+        # (size-1 and replicated axes are no-ops)
+        aux_mean_axes = tuple(a for a in mesh.axis_names if a != axis_name)
+        inner = functools.partial(
+            gpipe, stage_fn, axis_name=axis_name,
+            has_aux=has_aux, aux_mean_axes=aux_mean_axes,
+        )
+        out_specs = (x_spec, P()) if has_aux else x_spec
         out = shard_map(
             inner, mesh=mesh,
-            in_specs=(param_specs, x_spec), out_specs=x_spec,
+            in_specs=(param_specs, x_spec), out_specs=out_specs,
             check_rep=False,
         )(params, x)
+        if has_aux:
+            out, aux = out
+            return out.reshape((b,) + out.shape[2:]), aux
         return out.reshape((b,) + out.shape[2:])
 
     return run

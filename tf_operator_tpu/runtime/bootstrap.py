@@ -100,3 +100,25 @@ def default_mesh(axes: Optional[Dict[str, int]] = None):
     """Mesh over all (global) devices; call after initialize(). Without
     `axes`, everything lands on the dp axis."""
     return make_mesh(axes=axes if axes is not None else {"dp": -1})
+
+
+def multislice_mesh(
+    info: SliceInfo,
+    ici_axes: Optional[Dict[str, int]] = None,
+    devices=None,
+):
+    """dcn×ici mesh for a (possibly) multislice job: one dcn row per slice,
+    `ici_axes` (tp/fsdp/dp/ep/pp) laid out inside each slice.  Correct
+    because jax orders devices by global process id and global_rendezvous
+    assigns ids slice-major, so the contiguous dcn-outermost reshape in
+    make_mesh puts each slice's chips in one dcn row — cross-slice traffic
+    is whatever the caller maps to dcn (batch/gradients by DEFAULT_RULES),
+    everything else stays on ICI.  Single-slice jobs get dcn=1 and this
+    degenerates to default_mesh."""
+    axes = dict(ici_axes if ici_axes is not None else {"dp": -1})
+    if "dcn" in axes and axes["dcn"] not in (1, info.num_slices):
+        raise ValueError(
+            f"dcn axis {axes['dcn']} conflicts with numSlices {info.num_slices}"
+        )
+    axes["dcn"] = info.num_slices
+    return make_mesh(axes=axes, devices=devices)
