@@ -295,3 +295,21 @@ def test_reconcile_duration_histogram_observed_and_exposed():
         text,
     ))
     assert int(buckets["+Inf"]) >= int(buckets["10"])
+
+
+def test_histogram_percentiles():
+    from tf_operator_tpu.engine.metrics import Histogram
+
+    h = Histogram("test_pctl_seconds", "t", buckets=(0.01, 0.1, 1.0))
+    labels = {"kind": "TFJob"}
+    assert h.percentiles([0.5], labels) == {0.5: None}  # empty
+    for _ in range(90):
+        h.observe(0.005, labels)   # -> 0.01 bucket
+    for _ in range(9):
+        h.observe(0.05, labels)    # -> 0.1 bucket
+    h.observe(5.0, labels)         # beyond last finite bucket
+    ps = h.percentiles([0.5, 0.9, 0.99, 1.0], labels)
+    assert ps[0.5] == 0.01
+    assert ps[0.9] == 0.01
+    assert ps[0.99] == 0.1
+    assert ps[1.0] is None  # falls in +Inf: no finite upper bound

@@ -105,6 +105,30 @@ class Histogram(Metric):
             obs = self._obs.get(_label_key(labels))
             return obs[0][-1] if obs else 0
 
+    def percentiles(
+        self, qs, labels: Optional[Dict[str, str]] = None
+    ) -> Dict[float, Optional[float]]:
+        """Approximate quantiles from the cumulative le-buckets: the upper
+        bound of the first bucket whose count reaches the target rank
+        (None when the quantile falls beyond the last finite bucket —
+        prometheus histogram_quantile semantics, conservative upper
+        bound)."""
+        with _LOCK:
+            obs = self._obs.get(_label_key(labels))
+            counts = list(obs[0]) if obs else None
+        if not counts or counts[-1] == 0:
+            return {q: None for q in qs}
+        total = counts[-1]
+        out: Dict[float, Optional[float]] = {}
+        for q in qs:
+            rank = q * total
+            out[q] = next(
+                (le for i, le in enumerate(self.buckets)
+                 if counts[i] >= rank),
+                None,
+            )
+        return out
+
     def expose(self) -> str:
         lines = [
             f"# HELP {self.name} {self.help}",
