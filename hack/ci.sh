@@ -19,6 +19,7 @@ python -m compileall -q tf_operator_tpu hack examples tests
 stage "manifests: generated CRDs in sync"
 python hack/gen_crds.py --check
 python hack/gen_apidoc.py --check
+python hack/gen_openapi.py --check
 
 stage "manifests: overlays render (hermetic kustomize)"
 python hack/release.py render --overlay standalone > /dev/null

@@ -167,3 +167,52 @@ def test_scale_rejects_negative_replicas():
     # the job spec is untouched
     doc = cluster.get("TFJob", "default", job.name)
     assert doc["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 2
+
+
+def test_create_validates_against_published_schema():
+    """The SDK validates bodies against the generated openapi.json before
+    submit (reference parity: generated OpenAPI models in its SDK)."""
+    import pytest
+
+    from tf_operator_tpu.k8s.fake import FakeCluster
+    from tf_operator_tpu.sdk.client import JobClient
+    from tf_operator_tpu.sdk.schema import SchemaError, schema_for
+
+    assert schema_for("TFJob") is not None
+    assert schema_for("NoSuchKind") is None
+
+    cluster = FakeCluster()
+    client = JobClient(cluster, kind="TFJob")
+    bad = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "typo"},
+        "spec": {"tfReplicaSpecs": {"Worker": {
+            "replicas": -1,                      # violates minimum: 0
+            "restartPolicy": "Sometimes",        # not in the enum
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "x"}]}},
+        }}},
+    }
+    with pytest.raises(SchemaError) as e:
+        client.create(bad)
+    msg = str(e.value)
+    assert "restartPolicy" in msg and "replicas" in msg
+    assert cluster.list("TFJob", namespace="default") == []  # nothing stored
+
+    # validate=False defers to server-side validation
+    client.create(bad, validate=False)
+    assert len(cluster.list("TFJob", namespace="default")) == 1
+
+    # a good body passes
+    good = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "ok"},
+        "spec": {"tfReplicaSpecs": {"Worker": {
+            "replicas": 2,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "x"}]}},
+        }}},
+    }
+    client.create(good)
+    assert any(j["metadata"]["name"] == "ok"
+               for j in cluster.list("TFJob", namespace="default"))

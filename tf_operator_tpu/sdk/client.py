@@ -46,9 +46,20 @@ class JobClient:
         self.kind = kind or self.KIND
 
     # ------------------------------------------------------------- CRUD
-    def create(self, job, namespace: str = "default") -> Dict[str, Any]:
+    def create(
+        self, job, namespace: str = "default", validate: bool = True
+    ) -> Dict[str, Any]:
+        """Create the job CR.  The body is validated client-side against
+        the published OpenAPI schema first (sdk/schema.py) so shape errors
+        fail here with a pointed message instead of becoming a terminal
+        Failed-validation condition on the stored job; validate=False
+        skips it (e.g. to exercise server-side validation)."""
         body = job.to_dict() if hasattr(job, "to_dict") else copy.deepcopy(job)
         body.setdefault("metadata", {}).setdefault("namespace", namespace)
+        if validate:
+            from tf_operator_tpu.sdk.schema import validate_body
+
+            validate_body(self.kind, body)
         return self.cluster.create(self.kind, body)
 
     def get(

@@ -1,0 +1,62 @@
+"""Client-side schema validation against the published OpenAPI artifact.
+
+The reference ships generated OpenAPI models with its SDK
+(sdk/python/kubeflow/tfjob/models/, setup.py:15) so clients catch shape
+errors before the apiserver does.  The TPU-native equivalent: the
+generated `openapi.json` (hack/gen_openapi.py, packaged next to this
+module) is applied to job bodies with jsonschema BEFORE submit — a typo'd
+field or a wrong enum fails in the client with a pointed message instead
+of a terminal Failed-validation condition on the stored job.
+
+Unknown x-kubernetes-* keywords in the CRD schemas are inert under
+jsonschema (treated as annotations), which matches apiserver semantics.
+"""
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Any, Dict, List, Optional
+
+_ARTIFACT = os.path.join(os.path.dirname(__file__), "openapi.json")
+
+
+class SchemaError(ValueError):
+    """Job body does not conform to the published schema."""
+
+
+@lru_cache(maxsize=1)
+def _schemas() -> Dict[str, Any]:
+    with open(_ARTIFACT) as f:
+        return json.load(f)["components"]["schemas"]
+
+
+def schema_for(kind: str) -> Optional[Dict[str, Any]]:
+    """The OpenAPI component schema for a kind (None when unknown)."""
+    for name, schema in _schemas().items():
+        if name.rsplit(".", 1)[-1] == kind:
+            return schema
+    return None
+
+
+def validate_body(kind: str, body: Dict[str, Any]) -> None:
+    """Raise SchemaError listing every violation (path + message) the
+    published schema finds in `body`.  Unknown kinds pass — the artifact
+    validates shapes, it does not gate which kinds a cluster serves."""
+    schema = schema_for(kind)
+    if schema is None:
+        return
+    try:
+        import jsonschema
+    except ImportError:  # pragma: no cover — declared in pyproject deps;
+        return  # only reachable on hand-rolled environments
+    validator = jsonschema.Draft202012Validator(schema)
+    errors: List[str] = []
+    for err in sorted(validator.iter_errors(body), key=lambda e: list(e.path)):
+        where = ".".join(str(p) for p in err.path) or "<root>"
+        errors.append(f"{where}: {err.message}")
+    if errors:
+        raise SchemaError(
+            f"{kind} body fails the published schema "
+            f"({len(errors)} error(s)):\n  " + "\n  ".join(errors[:10])
+        )
