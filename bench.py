@@ -193,7 +193,9 @@ def bench_resnet(gen: str, n_chips: int):
     from tf_operator_tpu.runtime.train import create_train_state, make_train_step
 
     on_cpu = gen == "cpu"
-    batches = (32,) if on_cpu else (256, 512)
+    # b1024 probes the MFU headroom past the r2 point; the sweep ends
+    # benignly at the first RESOURCE_EXHAUSTED (BASELINE.md roofline)
+    batches = (32,) if on_cpu else (256, 512, 1024)
     image = 64 if on_cpu else 224
     steps = 5 if on_cpu else 30
     warmup = 2 if on_cpu else 5

@@ -2,42 +2,72 @@
 # Local CI pipeline — the runnable form of test/workflows/e2e-workflow.yaml
 # (the reference drives the same stages through Argo+Prow: build -> lint ->
 # unit -> e2e -> sdk, SURVEY §3.5). Every stage must pass.
+#
+# CI_STAGES selects stage groups (the Prow-style presubmit matrix,
+# reference prow_config.yaml:6-57 — .github/workflows/ci.yaml fans these
+# out as parallel jobs):
+#   native  — build + TSAN concurrency stress
+#   static  — lint, generated-artifact drift, overlay rendering
+#   unit    — build + unit/controller/numerics tests
+#   e2e     — build + e2e scenarios + examples/sdk smoke
+#   dryrun  — graft entry compile + 8-device multichip dryrun
+#   bench   — build + operator-bench smoke (tiny sizes; correctness of the
+#             bench harness itself, not a perf measurement)
+# Default: all groups, sequentially.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+RUN="${CI_STAGES:-all}"
+want() { [[ "$RUN" == "all" || " $RUN " == *" $1 "* ]]; }
 stage() { echo; echo "=== $1 ==="; }
 
-stage "build: native runtime core"
-make native
+if want native || want unit || want e2e || want bench; then
+  stage "build: native runtime core"
+  make native
+fi
 
-stage "native: tsan concurrency stress (the -race the reference never runs)"
-bash hack/native_tsan.sh
+if want native; then
+  stage "native: tsan concurrency stress (the -race the reference never runs)"
+  bash hack/native_tsan.sh
+fi
 
-stage "lint: python compile check"
-python -m compileall -q tf_operator_tpu hack examples tests
+if want static; then
+  stage "lint: python compile check"
+  python -m compileall -q tf_operator_tpu hack examples tests
 
-stage "manifests: generated CRDs in sync"
-python hack/gen_crds.py --check
-python hack/gen_apidoc.py --check
-python hack/gen_openapi.py --check
+  stage "manifests: generated CRDs in sync"
+  python hack/gen_crds.py --check
+  python hack/gen_apidoc.py --check
+  python hack/gen_openapi.py --check
 
-stage "manifests: overlays render (hermetic kustomize)"
-python hack/release.py render --overlay standalone > /dev/null
-python hack/release.py render --overlay kubeflow > /dev/null
-python hack/release.py render --overlay webhook > /dev/null
+  stage "manifests: overlays render (hermetic kustomize)"
+  python hack/release.py render --overlay standalone > /dev/null
+  python hack/release.py render --overlay kubeflow > /dev/null
+  python hack/release.py render --overlay webhook > /dev/null
+  python hack/release.py render --overlay kind-e2e > /dev/null
+fi
 
-stage "unit + controller + numerics"
-python -m pytest tests/ -q -x --ignore=tests/test_e2e.py \
-    --ignore=tests/test_examples.py --ignore=tests/test_sdk.py
+if want unit; then
+  stage "unit + controller + numerics"
+  python -m pytest tests/ -q -x --ignore=tests/test_e2e.py \
+      --ignore=tests/test_examples.py --ignore=tests/test_sdk.py \
+      --ignore=tests/test_torch_e2e.py --ignore=tests/test_jax_dist_e2e.py
+fi
 
-stage "e2e scenarios"
-python -m pytest tests/test_e2e.py -q -x
+if want e2e; then
+  stage "e2e scenarios"
+  python -m pytest tests/test_e2e.py -q -x
 
-stage "examples smoke (sdk + ladder)"
-python -m pytest tests/test_examples.py tests/test_sdk.py -q -x
+  stage "real-consumer env contract (torch gloo + jax.distributed)"
+  python -m pytest tests/test_torch_e2e.py tests/test_jax_dist_e2e.py -q
 
-stage "graft entry: single-chip compile + 8-device dryrun"
-XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'EOF'
+  stage "examples smoke (sdk + ladder)"
+  python -m pytest tests/test_examples.py tests/test_sdk.py -q -x
+fi
+
+if want dryrun; then
+  stage "graft entry: single-chip compile + 8-device dryrun"
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")
 import __graft_entry__ as g
@@ -46,6 +76,26 @@ fn, args = g.entry()
 jax.jit(fn)(*args)
 print("graft entry ok")
 EOF
+fi
+
+if want bench; then
+  stage "bench smoke: operator benches at tiny sizes (both backends)"
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import bench
+for be in ("fake", "rest"):
+    r = bench.bench_operator_scale(n_jobs=10, backend=be)
+    assert r["all_running"], r
+    s = bench.bench_startup_latency(runs=1, backend=be)
+    assert s["failed_runs"] == 0, s
+    print(f"bench smoke [{be}] ok:",
+          r["jobs_per_sec"], "jobs/s,", s["create_to_first_step_s"], "s to step")
+d = bench.bench_data_loader(n_records=2000, batch=128)
+assert "records_per_sec" in d.get("python", {}), d
+print("loader smoke ok:", d["python"]["records_per_sec"], "rec/s (python)")
+EOF
+fi
 
 echo
 echo "CI PASSED"

@@ -38,7 +38,7 @@ def main(argv=None) -> int:
 
     pv = sub.add_parser("render")
     pv.add_argument("--overlay", default="standalone",
-                    choices=("standalone", "kubeflow", "webhook"))
+                    choices=("standalone", "kubeflow", "webhook", "kind-e2e"))
     pv.add_argument("--image", default=None)
 
     pc = sub.add_parser("cluster")

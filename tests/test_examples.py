@@ -165,6 +165,9 @@ def test_elastic_pytorch_example_through_run_local():
     result = run_local(doc, timeout=120)
     combined = "\n".join(result["logs"].values())
     assert result["state"] == "Succeeded", combined[-2000:]
-    assert "PET_RDZV_ENDPOINT=elastic-demo-worker-0:29400" in combined
+    # the local executor localizes the job's own bare service name so the
+    # rendezvous endpoint is actually reachable (runtime/local.py); the
+    # cluster form of the endpoint is asserted in tests/test_controllers.py
+    assert "PET_RDZV_ENDPOINT=127.0.0.1:29400" in combined
     assert "PET_NNODES=1:8" in combined
     assert "elastic contract ok" in combined

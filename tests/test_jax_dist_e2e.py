@@ -1,0 +1,86 @@
+"""Real-consumer verification of the TPUJob env contract with jax.distributed.
+
+The torch-side twin (tests/test_torch_e2e.py) proves MASTER_ADDR/RANK
+against real torch; this proves COORDINATOR_ADDRESS / NUM_PROCESSES /
+PROCESS_ID — the env the TPU controller injects and
+runtime/bootstrap.initialize consumes — against REAL
+`jax.distributed.initialize`: a 2-host TPUJob under the local executor
+where each host process (CPU backend) joins the coordinator from the
+injected env via bootstrap.initialize, then runs a cross-process
+allgather.  A wrong process id, count, or coordinator address fails the
+rendezvous or the gathered roster (SURVEY.md §7.4.5 — the off-by-one
+class the reference dedicates estimator_runconfig_tests.py to).
+"""
+import sys
+import textwrap
+
+import pytest
+
+from tf_operator_tpu.runtime.local import run_local
+
+CONSUMER = textwrap.dedent(
+    """
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from tf_operator_tpu.runtime import bootstrap
+
+    info = bootstrap.initialize()  # reads the operator-injected env
+    assert info.num_processes == 2 and info.hosts_per_slice == 2, info
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_index() == info.process_id, (
+        jax.process_index(), info.process_id)
+
+    from jax.experimental import multihost_utils
+
+    roster = multihost_utils.process_allgather(jax.process_index())
+    assert sorted(roster.tolist()) == [0, 1], roster
+    mesh = bootstrap.multislice_mesh(info, {"dp": -1})
+    assert dict(mesh.shape)["dp"] == jax.device_count()
+    print(f"process {info.process_id}/{info.num_processes} "
+          f"roster={sorted(roster.tolist())} OK", flush=True)
+    """
+)
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_jax_distributed_rendezvous_over_injected_env():
+    port = _free_port()
+    result = run_local({
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TPUJob",
+        "metadata": {"name": "jaxdist", "namespace": "default"},
+        "spec": {
+            "acceleratorType": "v4-16",  # 8 chips = 2 hosts = 2 processes
+            "tpuReplicaSpecs": {"Worker": {
+                "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "tpu",
+                    "image": "local",
+                    "command": [sys.executable, "-u", "-c", CONSUMER],
+                    # free coordinator port: the controller honors the
+                    # declared container port (controllers/tpu.py), and a
+                    # fixed default would flake on TIME_WAIT leftovers
+                    "ports": [{"name": "coordinator-port",
+                               "containerPort": port}],
+                }]}},
+            }},
+        },
+    }, timeout=180.0)
+    logs = "\n".join(
+        f"--- {k}\n{v}" for k, v in sorted(result["logs"].items())
+    )
+    assert result["state"] == "Succeeded", f"{result['state']}\n{logs[-3000:]}"
+    assert "process 0/2 roster=[0, 1] OK" in logs, logs[-3000:]
+    assert "process 1/2 roster=[0, 1] OK" in logs, logs[-3000:]

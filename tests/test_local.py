@@ -151,3 +151,21 @@ def test_run_local_timeout_is_reported():
     result = run_local(job, timeout=3.0)
     assert result["state"] == "Timeout"
     assert result["timed_out"] is True
+
+
+def test_localize_bare_service_names_with_job_name():
+    """PyTorch's MASTER_ADDR / torchrun's PET_RDZV_ENDPOINT carry the BARE
+    headless-service name; with the pod's job name the local executor
+    rewrites those too (and comma rosters element-wise), leaving foreign
+    hosts alone."""
+    assert localize_env_value("torchrc-master-0", "torchrc") == "127.0.0.1"
+    assert localize_env_value(
+        "el-worker-0:29400", "el") == "127.0.0.1:29400"
+    assert localize_env_value(
+        "lgb-worker-0:9091,lgb-worker-1:9091", "lgb"
+    ) == "127.0.0.1:9091,127.0.0.1:9091"
+    # not this job's services: untouched
+    assert localize_env_value("other-master-0", "torchrc") == "other-master-0"
+    assert localize_env_value("plain-value", "torchrc") == "plain-value"
+    # without a job name the bare form stays (DNS .svc form still rewrites)
+    assert localize_env_value("torchrc-master-0") == "torchrc-master-0"

@@ -197,6 +197,21 @@ def test_create_validates_against_published_schema():
         client.create(bad)
     msg = str(e.value)
     assert "restartPolicy" in msg and "replicas" in msg
+
+    # typo'd field NAME: the published schema closes declared objects
+    # (additionalProperties:false), so what the apiserver would silently
+    # prune fails loudly here
+    typo = {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "typo2"},
+        "spec": {"tfReplicaSpecs": {"Worker": {
+            "replicass": 2,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "x"}]}},
+        }}},
+    }
+    with pytest.raises(SchemaError, match="replicass"):
+        client.create(typo)
     assert cluster.list("TFJob", namespace="default") == []  # nothing stored
 
     # validate=False defers to server-side validation

@@ -69,9 +69,17 @@ class TPUAdapter(FrameworkAdapter):
         slice_hostnames = ",".join(
             host_dns(slice_base + i) for i in range(hosts_per_slice)
         )
-        coordinator = (
-            f"{host_dns(slice_base)}:{tpuapi.DEFAULT_COORDINATOR_PORT}"
+        # honor a declared coordinator container port (set_defaults injects
+        # the default; users may override — same contract as PyTorch's
+        # master_port honoring the declared pytorchjob-port)
+        spec = (job.replica_specs or {}).get(rtype)
+        coord_port = objects.replica_port(
+            spec.template if spec else pod_template,
+            tpuapi.DEFAULT_CONTAINER_NAME,
+            tpuapi.COORDINATOR_PORT_NAME,
+            tpuapi.DEFAULT_COORDINATOR_PORT,
         )
+        coordinator = f"{host_dns(slice_base)}:{coord_port}"
         env = {
             # jax.distributed.initialize() rendezvous (per slice)
             "COORDINATOR_ADDRESS": coordinator,
@@ -91,9 +99,7 @@ class TPUAdapter(FrameworkAdapter):
             env["TPU_TOPOLOGY"] = job.topology
         if num_slices > 1:
             # multislice-over-DCN wiring (MEGASCALE convention)
-            env["MEGASCALE_COORDINATOR_ADDRESS"] = (
-                f"{host_dns(0)}:{tpuapi.DEFAULT_COORDINATOR_PORT}"
-            )
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = f"{host_dns(0)}:{coord_port}"
             env["MEGASCALE_NUM_SLICES"] = str(num_slices)
             env["MEGASCALE_SLICE_ID"] = str(slice_id)
         c = objects.find_container(pod_template, self.CONTAINER_NAME)

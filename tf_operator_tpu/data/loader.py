@@ -93,6 +93,34 @@ def _bind_lib(lib):
     return lib
 
 
+def host_sharded_loader(
+    paths: Sequence[str],
+    fields: Sequence["FieldSpec"],
+    batch_size: int,
+    info=None,
+    **kwargs,
+) -> "RecordLoader":
+    """RecordLoader wired to THIS host's disjoint input shard from the
+    operator-injected env — the tf.data auto-shard / torch
+    DistributedSampler analogue for multi-host slices.
+
+    shard_id is the GLOBAL host id (slice-major, the same math
+    jax.distributed ranks use — runtime/bootstrap.global_rendezvous) and
+    n_shards the global host count, so every host of every slice reads a
+    disjoint subset and dp-over-dcn data parallelism sees the full
+    dataset exactly once per epoch.  Pass `info` explicitly in tests;
+    default reads os.environ (bootstrap.slice_info_from_env)."""
+    from tf_operator_tpu.runtime import bootstrap
+
+    if info is None:
+        info = bootstrap.slice_info_from_env()
+    _, n_shards, shard_id = bootstrap.global_rendezvous(info)
+    return RecordLoader(
+        paths, fields, batch_size,
+        shard_id=shard_id, n_shards=max(1, n_shards), **kwargs,
+    )
+
+
 def _split_batch(
     buf: np.ndarray, batch_size: int, fields: Sequence[FieldSpec]
 ) -> Dict[str, np.ndarray]:
@@ -116,8 +144,9 @@ class RecordLoader:
     shuffle: the native path (std::shuffle, implementation-defined
     permutation) and the numpy fallback produce different orders for the
     same seed, and each host only ever permutes its own shard.
-    `shard_id`/`n_shards` give each TPU VM host its subset (wire from
-    bootstrap.SliceInfo process_id/num_processes).
+    `shard_id`/`n_shards` give each TPU VM host its subset —
+    `host_sharded_loader` wires them from the operator-injected env
+    (global slice-major host id / total hosts, incl. multislice).
     """
 
     def __init__(

@@ -41,10 +41,27 @@ _SVC_DNS = re.compile(r"[a-z0-9]([a-z0-9-]*[a-z0-9])?"
                       r"(-[0-9]+)?\.[a-z0-9-]+\.svc(\.[a-z0-9.-]*[a-z0-9])?")
 
 
-def localize_env_value(value: str) -> str:
-    """Rewrite `{name}.{ns}.svc[.domain]` hostnames to 127.0.0.1 (ports
-    kept) so local processes can reach a locally-bound coordinator."""
-    return _SVC_DNS.sub("127.0.0.1", value)
+def localize_env_value(value: str, job_name: str = "") -> str:
+    """Rewrite cluster-internal hostnames to 127.0.0.1 (ports kept) so
+    local processes can reach a locally-bound coordinator: the
+    `{name}.{ns}.svc[.domain]` DNS form, and — given the pod's job name —
+    the BARE headless-service names `{job}-{rtype}-{i}` that PyTorch's
+    MASTER_ADDR and torchrun's PET_RDZV_ENDPOINT carry (reference
+    pytorch.go:32-39 uses the plain service name).  The bare form is
+    matched from the job name, not the live service list, so it cannot
+    race service creation order; comma-separated rosters (LightGBM
+    WORKER_ADDRS, TPU_WORKER_HOSTNAMES) localize element-wise."""
+    value = _SVC_DNS.sub("127.0.0.1", value)
+    if job_name:
+        bare = re.compile(
+            rf"^{re.escape(job_name)}-[a-z0-9]+-[0-9]+$"
+        )
+        parts = []
+        for part in value.split(","):
+            host, sep, port = part.partition(":")
+            parts.append("127.0.0.1" + sep + port if bare.match(host) else part)
+        value = ",".join(parts)
+    return value
 
 
 class _Proc:
@@ -103,8 +120,11 @@ class SubprocessKubelet:
             argv[0] = sys.executable  # the venv running the operator
         env = dict(os.environ)
         env.update(self.extra_env)
+        job_name = objects.labels_of(pod).get(objects.LABEL_JOB_NAME, "")
         for e in c.get("env", []) or []:
-            env[e["name"]] = localize_env_value(str(e.get("value", "")))
+            env[e["name"]] = localize_env_value(
+                str(e.get("value", "")), job_name
+            )
         return c.get("name", ""), argv, env
 
     def _start_pod(self, key: str) -> None:
