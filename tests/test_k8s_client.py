@@ -444,7 +444,9 @@ def test_facade_status_subresource_is_isolated(rest_cluster):
     }
     created = c.create("TFJob", job)
     # write a status through the split-update path
-    created["status"] = {"conditions": [{"type": "Created"}]}
+    # schema-complete condition: the facade validates writes against the
+    # CRD schema (type+status are required on conditions)
+    created["status"] = {"conditions": [{"type": "Created", "status": "True"}]}
     updated = c.update("TFJob", created)
     assert updated["status"]["conditions"][0]["type"] == "Created"
     # a spec-only writer that carries NO status must not clobber it
@@ -552,3 +554,74 @@ def test_events_for_namespace_scoping(rest_cluster):
     a = c.events_for("mnist", namespace="team-a")
     assert len(a) == 1 and "team-a" in a[0]["message"]
     assert len(c.events_for("mnist")) == 2
+
+
+def test_apiserver_enforces_crd_schema_on_write():
+    """The facade rejects schema-invalid CR writes with 422 Invalid like a
+    real apiserver validating against the CRD's structural schema —
+    'runs unmodified on a real apiserver' must include the rejections."""
+    from tf_operator_tpu.e2e.apiserver import ApiServerTransport
+    from tf_operator_tpu.k8s.client import ClusterClient
+    from tf_operator_tpu.k8s.fake import ApiError, FakeCluster
+
+    backing = FakeCluster()
+    transport = ApiServerTransport(backing)
+    cluster = ClusterClient(transport)
+    try:
+        bad = {
+            "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+            "metadata": {"name": "schema-bad", "namespace": "default"},
+            "spec": {"tfReplicaSpecs": {"Worker": {
+                "replicas": -2,                 # minimum: 0
+                "restartPolicy": "Sometimes",   # not in enum
+                "template": {"spec": {"containers": [
+                    {"name": "tensorflow", "image": "x"}]}},
+            }}},
+        }
+        with pytest.raises(ApiError) as e:
+            cluster.create("TFJob", bad)
+        assert e.value.code == 422
+        assert "restartPolicy" in str(e.value)
+        assert backing.list("TFJob", namespace="default") == []
+
+        # a valid body stores; an invalid main-resource UPDATE also 422s
+        bad["spec"]["tfReplicaSpecs"]["Worker"].update(
+            replicas=2, restartPolicy="Never")
+        stored = cluster.create("TFJob", bad)
+        doc = cluster.get("TFJob", "default", "schema-bad")
+        doc["spec"]["tfReplicaSpecs"]["Worker"]["restartPolicy"] = "Nope"
+        with pytest.raises(ApiError) as e:
+            cluster.update("TFJob", doc)
+        assert e.value.code == 422
+        kept = backing.get("TFJob", "default", "schema-bad")
+        assert kept["spec"]["tfReplicaSpecs"]["Worker"]["restartPolicy"] == "Never"
+        del stored
+
+        # POST clears client-sent status (apiserver create semantics for
+        # status-subresource kinds) instead of validating or storing it
+        with_status = {
+            "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+            "metadata": {"name": "round-trip", "namespace": "default"},
+            "spec": {"tfReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "tensorflow", "image": "x"}]}},
+            }}},
+            "status": {"conditions": [{"type": "Created"}]},  # incomplete
+        }
+        cluster.create("TFJob", with_status)
+        assert "status" not in (
+            backing.get("TFJob", "default", "round-trip").get("status") or {}
+        ) or backing.get("TFJob", "default", "round-trip")["status"] == {}
+
+        # a /status write with a schema-invalid condition 422s — the
+        # stored status stays valid by induction, so main-resource
+        # writers are never blamed for status they didn't author
+        doc = cluster.get("TFJob", "default", "round-trip")
+        doc["status"] = {"conditions": [{"type": "Created"}]}  # no 'status'
+        with pytest.raises(ApiError) as e:
+            cluster.update("TFJob", doc)
+        assert e.value.code == 422
+    finally:
+        cluster.close()
+        transport.close()
