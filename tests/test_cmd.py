@@ -313,3 +313,34 @@ def test_histogram_percentiles():
     assert ps[0.9] == 0.01
     assert ps[0.99] == 0.1
     assert ps[1.0] is None  # falls in +Inf: no finite upper bound
+
+
+def test_exhausted_retries_hold_at_max_backoff_not_forgotten():
+    """client-go semantics: an erroring key past the retry window keeps
+    being retried at a flat cadence — forgetting it would wedge the job
+    (e.g. a partial slice teardown) until the 12h resync."""
+    from unittest import mock
+
+    from tf_operator_tpu.cmd import manager as mgr_mod
+    from tf_operator_tpu.k8s.fake import FakeCluster
+
+    cluster = FakeCluster()
+    cluster.create("TFJob", testutil.new_tfjob("stuck", worker=1).to_dict())
+    m = OperatorManager(cluster, ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"])))
+    ctl = m.controllers["TFJob"]
+
+    calls = []
+    with mock.patch.object(ctl.engine, "reconcile") as rec, \
+            mock.patch.object(ctl.queue, "num_requeues",
+                              return_value=mgr_mod.MAX_RECONCILE_RETRIES), \
+            mock.patch.object(ctl.queue, "forget") as forget, \
+            mock.patch.object(
+                ctl.queue, "add_after",
+                side_effect=lambda k, d: calls.append((k, d))):
+        from tf_operator_tpu.engine.controller import ReconcileResult
+
+        rec.return_value = ReconcileResult(error="injected")
+        ctl._sync("default/stuck")
+    assert calls == [("default/stuck", mgr_mod.EXHAUSTED_RETRY_PERIOD)]
+    forget.assert_not_called()

@@ -36,6 +36,10 @@ from tf_operator_tpu.k8s.informer import (
 from tf_operator_tpu.utils.logging import logger_for_key
 
 MAX_RECONCILE_RETRIES = 15
+# past the rate-limiter's window the key is retried at a flat cadence —
+# client-go's capped-backoff semantics (workqueue maxDelay ~1000s), chosen
+# smaller so a recovered outage resumes within minutes
+EXHAUSTED_RETRY_PERIOD = 120.0
 
 
 class _KindController:
@@ -111,8 +115,16 @@ class _KindController:
                 log.warning("reconcile error, requeueing: %s", result.error)
                 self.queue.add_rate_limited(key)
             else:
-                log.error("reconcile retries exhausted: %s", result.error)
-                self.queue.forget(key)
+                # client-go never abandons an erroring key — it caps the
+                # backoff.  Forgetting here would wedge the job until the
+                # (12h) resync or the next object event; a long apiserver
+                # outage or a stuck finalizer must not orphan teardowns
+                # (e.g. PartialSliceTeardown retries).
+                log.error(
+                    "reconcile retries exhausted, holding at max backoff: %s",
+                    result.error,
+                )
+                self.queue.add_after(key, EXHAUSTED_RETRY_PERIOD)
             return
         self.queue.forget(key)
         if result.requeue_after is not None:
