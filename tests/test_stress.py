@@ -294,3 +294,91 @@ def test_suspend_resume_churn_under_load(stress_env):
 
     _wait(converged, "suspend/resume converged")
     assert auditor.violations == []
+
+
+def test_slice_preemption_chaos_with_failing_deletes():
+    """Whole-slice restarts under randomly failing pod deletes: interrupted
+    teardowns must surface PartialSliceTeardown events, retry (capped
+    backoff — never forgotten), and once the API heals every slice must
+    converge to a SINGLE incarnation (uniform restart-generation) with no
+    pre-restart stragglers absorbed."""
+    import zlib
+
+    from tf_operator_tpu.k8s.fake import ApiError
+
+    class FlakyDeletes(FakeCluster):
+        failing = True
+
+        def delete_pod(self, namespace, name):
+            # worker-0 deletes happen only in the whole-slice teardown loop
+            # (worker-1 is the preempted pod, deleted per-pod first), so
+            # failing them guarantees at least one interrupted teardown per
+            # job; other pods flake by a NAME-derived coin so outcomes are
+            # schedule-independent (a shared seeded rng consumed from 4
+            # worker threads would not be reproducible)
+            flaky = zlib.crc32(name.encode()) % 5 < 2
+            if self.failing and (name.endswith("worker-0") or flaky):
+                raise ApiError(500, f"injected delete failure for {name}")
+            super().delete_pod(namespace, name)
+
+    cluster = FlakyDeletes()
+    auditor = PodInvariantAuditor(cluster)
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TPUJob"]), threadiness=4
+    )
+    mgr = OperatorManager(cluster, opts)
+    mgr.start()
+    kubelet = FakeKubelet(cluster)
+    try:
+        n_jobs, hosts = 3, 2  # v4-16 = 8 chips = 2 host pods per slice
+        for i in range(n_jobs):
+            cluster.create("TPUJob", {
+                "apiVersion": "kubeflow.org/v1", "kind": "TPUJob",
+                "metadata": {"name": f"chaos-{i}", "namespace": "default"},
+                "spec": {"acceleratorType": "v4-16",
+                         "tpuReplicaSpecs": {"Worker": {"template": {"spec": {
+                             "containers": [{"name": "tpu", "image": "x"}]}}}}},
+            })
+        for i in range(n_jobs):
+            for h in range(hosts):
+                kubelet.wait_running("default", f"chaos-{i}-worker-{h}", 20)
+
+        # preempt one host per slice (retryable 137) while deletes flake
+        for i in range(n_jobs):
+            kubelet.terminate_replica("default", f"chaos-{i}-worker-1", 137)
+        # heal the API only AFTER a teardown has verifiably been
+        # interrupted — a fixed sleep would race slow CI machines
+        _wait(
+            lambda: any(e["reason"] == "PartialSliceTeardown"
+                        for e in cluster.events),
+            "an interrupted teardown surfaced",
+            timeout=30.0,
+        )
+        cluster.failing = False  # API heals; capped-backoff retries finish
+
+        def converged():
+            for i in range(n_jobs):
+                pods = [p for p in cluster.list_pods()
+                        if p["metadata"]["labels"].get("job-name")
+                        == f"chaos-{i}"]
+                if len(pods) != hosts:
+                    return False
+                gens = {p["metadata"]["labels"].get("restart-generation")
+                        for p in pods}
+                if len(gens) != 1 or gens == {"0"}:
+                    return False  # mixed incarnation, or never restarted
+                if not all(p["status"].get("phase") == "Running"
+                           for p in pods):
+                    return False
+            return True
+
+        _wait(converged, "slices rebuilt at a single new incarnation",
+              timeout=60.0)
+        assert auditor.violations == []
+        # loudness was established pre-heal by the _wait above; it must
+        # still be visible in the recorded events
+        assert any(e["reason"] == "PartialSliceTeardown"
+                   for e in cluster.events)
+    finally:
+        kubelet.stop_all()
+        mgr.stop()
