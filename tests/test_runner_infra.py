@@ -100,3 +100,42 @@ def test_leader_stop_forces_non_leader_when_run_wedged():
     lease = cluster.get("Lease", "default", "tpu-operator")
     assert lease["spec"]["renewTime"] == 0, "lease not released"
     wedge.set()
+
+
+def test_three_ci_definitions_share_one_stage_list():
+    """hack/ci.sh (CI_STAGES groups), the GitHub Actions matrix, and the
+    Argo workflow DAG must agree on the stage-group list — the reference
+    keeps Prow/Argo/scripts in sync by hand; here drift is a test
+    failure."""
+    import os
+    import re
+
+    import yaml
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    ci_sh = open(os.path.join(repo, "hack", "ci.sh")).read()
+    # groups = every name tested via `want <name>` (dedup, order-stable)
+    groups = sorted(set(re.findall(r"\bwant (\w+)", ci_sh)))
+    assert groups, "no CI_STAGES groups found in hack/ci.sh"
+
+    gha = yaml.safe_load(
+        open(os.path.join(repo, ".github", "workflows", "ci.yaml")))
+    matrix = gha["jobs"]["ci"]["strategy"]["matrix"]
+    gha_stages = sorted(e["stage"] for e in matrix["include"])
+    assert gha_stages == groups, (gha_stages, groups)
+    # every matrix leg delegates to the shared script
+    steps = gha["jobs"]["ci"]["steps"]
+    assert any("CI_STAGES=${{ matrix.stage }} bash hack/ci.sh"
+               in (s.get("run") or "") for s in steps)
+
+    argo = yaml.safe_load(
+        open(os.path.join(repo, "test", "workflows", "e2e-workflow.yaml")))
+    tmpl = next(t for t in argo["spec"]["templates"] if t["name"] == "e2e")
+    cmds = [t["arguments"]["parameters"][0]["value"]
+            for t in tmpl["dag"]["tasks"]]
+    matches = {c: re.match(r"CI_STAGES=(\w+) bash hack/ci\.sh", c)
+               for c in cmds}
+    drifted = [c for c, m in matches.items() if m is None]
+    assert not drifted, f"Argo tasks not delegating to hack/ci.sh: {drifted}"
+    argo_stages = sorted(m.group(1) for m in matches.values())
+    assert argo_stages == groups, (argo_stages, groups)

@@ -310,15 +310,18 @@ def test_slice_preemption_chaos_with_failing_deletes():
         failing = True
 
         def delete_pod(self, namespace, name):
-            # worker-0 deletes happen only in the whole-slice teardown loop
-            # (worker-1 is the preempted pod, deleted per-pod first), so
-            # failing them guarantees at least one interrupted teardown per
-            # job; other pods flake by a NAME-derived coin so outcomes are
+            # the teardown loop only runs after the preempted worker-1's
+            # per-pod delete succeeds, so worker-1 must NEVER flake (or a
+            # job might not reach the teardown at all) while worker-0 —
+            # deleted only by the teardown loop — ALWAYS fails while chaos
+            # is on: every job verifiably hits an interrupted teardown.
+            # Any other pod flakes by a NAME-derived coin so outcomes are
             # schedule-independent (a shared seeded rng consumed from 4
-            # worker threads would not be reproducible)
-            flaky = zlib.crc32(name.encode()) % 5 < 2
-            if self.failing and (name.endswith("worker-0") or flaky):
-                raise ApiError(500, f"injected delete failure for {name}")
+            # worker threads would not be reproducible).
+            if self.failing and not name.endswith("worker-1"):
+                flaky = zlib.crc32(name.encode()) % 5 < 2
+                if name.endswith("worker-0") or flaky:
+                    raise ApiError(500, f"injected delete failure for {name}")
             super().delete_pod(namespace, name)
 
     cluster = FlakyDeletes()
