@@ -36,12 +36,51 @@ def synthetic_imagenet(batch: int, image_size: int, seed: int):
         yield (x, y)
 
 
+def record_pipeline(data_dir: str, batch: int, image_size: int, info):
+    """Disjoint per-host shard of on-disk records through the prefetching
+    loader (`host_sharded_loader` wires shard_id/n_shards from the
+    operator-injected slice topology — the tf.data auto-shard analogue;
+    native C++ reader when built)."""
+    import glob
+    import os
+
+    import numpy as np
+
+    from tf_operator_tpu.data.loader import FieldSpec, host_sharded_loader
+
+    fields = [
+        FieldSpec("image", (image_size, image_size, 3), np.uint8),
+        FieldSpec("label", (), np.int32),
+    ]
+    paths = sorted(glob.glob(os.path.join(data_dir, "*.rec")))
+    if not paths:
+        raise SystemExit(f"no .rec files under {data_dir}")
+    # loader built EAGERLY: a wrong path or an undersized shard must fail
+    # at startup, not at the first batch when peer hosts are already
+    # blocked in the gradient all-reduce
+    loader = host_sharded_loader(paths, fields, batch, info=info,
+                                 shuffle=True, loop=True)
+    print(f"data: records x{loader.num_records()} "
+          f"(shard {loader.shard_id}/{loader.n_shards}, "
+          f"native={loader.using_native})")
+
+    def batches():
+        for rec in loader:
+            x = jnp.asarray(rec["image"], jnp.bfloat16) / 127.5 - 1.0
+            yield (x, jnp.asarray(rec["label"]))
+
+    return batches()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=5000)
     ap.add_argument("--per-host-batch", type=int, default=256)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--data-dir", default="",
+                    help=".rec shards (data/loader.py format); each host "
+                         "reads its disjoint subset. Default: synthetic.")
     args = ap.parse_args(argv)
 
     info = bootstrap.initialize()
@@ -57,11 +96,17 @@ def main(argv=None):
         optax.sgd(0.1 * jax.process_count(), momentum=0.9),
     )
     step_fn = make_train_step(model, mesh=mesh)
+    if args.data_dir:
+        data = record_pipeline(args.data_dir, args.per_host_batch,
+                               args.image_size, info)
+    else:
+        print("data: synthetic")
+        data = synthetic_imagenet(args.per_host_batch, args.image_size,
+                                  seed=info.process_id)
     res = run_training(
         state,
         step_fn,
-        synthetic_imagenet(args.per_host_batch, args.image_size,
-                           seed=info.process_id),
+        data,
         num_steps=args.steps,
         checkpointer=Checkpointer(args.ckpt_dir) if args.ckpt_dir else None,
         profiler=Profiler(batch_size=args.per_host_batch * jax.process_count()),

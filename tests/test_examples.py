@@ -106,6 +106,32 @@ def test_resnet_smoke():
     assert "done: steps=2" in rc.stdout
 
 
+def test_resnet_smoke_record_pipeline(tmp_path):
+    """--data-dir path: on-disk records through host_sharded_loader (the
+    per-host auto-shard) feed the same training loop."""
+    import numpy as np
+
+    from tf_operator_tpu.data.loader import FieldSpec, write_records
+
+    fields = [FieldSpec("image", (32, 32, 3), np.uint8),
+              FieldSpec("label", (), np.int32)]
+    write_records(str(tmp_path / "train-0.rec"), fields, {
+        "image": np.zeros((64, 32, 32, 3), np.uint8),
+        "label": np.zeros((64,), np.int32),
+    })
+    rc = _run(
+        "resnet50/train_resnet.py",
+        "--steps=2", "--per-host-batch=4", "--image-size=32",
+        f"--data-dir={tmp_path}",
+    )
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "done: steps=2" in rc.stdout
+    # the record path was actually taken (a silent fall-back to the
+    # synthetic pipeline would keep 'done' green)
+    assert "data: records x64 (shard 0/1" in rc.stdout, rc.stdout[-500:]
+    assert "data: synthetic" not in rc.stdout
+
+
 def test_bert_smoke():
     rc = _run("bert/train_bert.py", "--smoke", "--steps=2", "--per-host-batch=2")
     assert rc.returncode == 0, rc.stderr[-2000:]
