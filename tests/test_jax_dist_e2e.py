@@ -84,3 +84,82 @@ def test_jax_distributed_rendezvous_over_injected_env():
     assert result["state"] == "Succeeded", f"{result['state']}\n{logs[-3000:]}"
     assert "process 0/2 roster=[0, 1] OK" in logs, logs[-3000:]
     assert "process 1/2 roster=[0, 1] OK" in logs, logs[-3000:]
+
+
+CKPT_CONSUMER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.mnist import MnistMLP
+    from tf_operator_tpu.runtime import bootstrap
+    from tf_operator_tpu.runtime.train import (
+        Checkpointer, create_train_state, make_train_step,
+    )
+
+    info = bootstrap.initialize()
+    mesh = bootstrap.multislice_mesh(info, {"dp": -1})
+    ckpt_dir = os.environ["CKPT_DIR"]
+
+    model = MnistMLP(hidden=16)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (8, 28, 28))
+    y = jnp.arange(8) % 10
+    state = create_train_state(rng, model, x, optax.sgd(1e-2))
+    step = make_train_step(model, has_batch_stats=False, mesh=mesh)
+    state, _ = step(state, x, y)
+    state, _ = step(state, x, y)
+
+    # every process participates in the distributed save (orbax barriers
+    # over jax.distributed) and in the restore
+    ck = Checkpointer(ckpt_dir)
+    ck.save(int(state.step), state, wait=True)
+
+    restored = Checkpointer(ckpt_dir)
+    assert restored.latest_step() == 2, restored.latest_step()
+    fresh = create_train_state(rng, model, x, optax.sgd(1e-2))
+    loaded = restored.restore(fresh)
+    assert int(loaded.step) == 2
+    for a, b in zip(jax.tree.leaves(loaded.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    print(f"process {info.process_id}: ckpt step=2 roundtrip OK", flush=True)
+    """
+)
+
+
+def test_distributed_checkpoint_roundtrip(tmp_path):
+    """SURVEY §5.4 with a REAL multi-process witness: 2 jax.distributed
+    processes (rendezvoused from the operator-injected env) save one orbax
+    checkpoint cooperatively and both restore it bit-exact — the
+    preemption-resume contract a single-process test cannot prove."""
+    port = _free_port()
+    result = run_local({
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TPUJob",
+        "metadata": {"name": "jaxckpt", "namespace": "default"},
+        "spec": {
+            "acceleratorType": "v4-16",
+            "tpuReplicaSpecs": {"Worker": {
+                "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "tpu",
+                    "image": "local",
+                    "command": [sys.executable, "-u", "-c", CKPT_CONSUMER],
+                    "ports": [{"name": "coordinator-port",
+                               "containerPort": port}],
+                }]}},
+            }},
+        },
+    }, timeout=240.0, extra_env={"CKPT_DIR": str(tmp_path / "ckpt")})
+    logs = "\n".join(
+        f"--- {k}\n{v}" for k, v in sorted(result["logs"].items())
+    )
+    assert result["state"] == "Succeeded", f"{result['state']}\n{logs[-3000:]}"
+    assert "process 0: ckpt step=2 roundtrip OK" in logs, logs[-3000:]
+    assert "process 1: ckpt step=2 roundtrip OK" in logs, logs[-3000:]
