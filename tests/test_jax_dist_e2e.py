@@ -55,12 +55,15 @@ def _free_port():
     return port
 
 
-def test_jax_distributed_rendezvous_over_injected_env():
-    port = _free_port()
+def _run_two_host_tpujob(name, consumer, timeout, extra_env=None):
+    """2-host TPUJob (v4-16) under the local executor running `consumer`
+    per host, on a kernel-assigned free coordinator port — the controller
+    honors the declared container port (controllers/tpu.py), and a fixed
+    default would flake on TIME_WAIT leftovers.  Returns (result, logs)."""
     result = run_local({
         "apiVersion": "kubeflow.org/v1",
         "kind": "TPUJob",
-        "metadata": {"name": "jaxdist", "namespace": "default"},
+        "metadata": {"name": name, "namespace": "default"},
         "spec": {
             "acceleratorType": "v4-16",  # 8 chips = 2 hosts = 2 processes
             "tpuReplicaSpecs": {"Worker": {
@@ -68,19 +71,21 @@ def test_jax_distributed_rendezvous_over_injected_env():
                 "template": {"spec": {"containers": [{
                     "name": "tpu",
                     "image": "local",
-                    "command": [sys.executable, "-u", "-c", CONSUMER],
-                    # free coordinator port: the controller honors the
-                    # declared container port (controllers/tpu.py), and a
-                    # fixed default would flake on TIME_WAIT leftovers
+                    "command": [sys.executable, "-u", "-c", consumer],
                     "ports": [{"name": "coordinator-port",
-                               "containerPort": port}],
+                               "containerPort": _free_port()}],
                 }]}},
             }},
         },
-    }, timeout=180.0)
+    }, timeout=timeout, extra_env=extra_env)
     logs = "\n".join(
         f"--- {k}\n{v}" for k, v in sorted(result["logs"].items())
     )
+    return result, logs
+
+
+def test_jax_distributed_rendezvous_over_injected_env():
+    result, logs = _run_two_host_tpujob("jaxdist", CONSUMER, timeout=180.0)
     assert result["state"] == "Succeeded", f"{result['state']}\n{logs[-3000:]}"
     assert "process 0/2 roster=[0, 1] OK" in logs, logs[-3000:]
     assert "process 1/2 roster=[0, 1] OK" in logs, logs[-3000:]
@@ -88,7 +93,7 @@ def test_jax_distributed_rendezvous_over_injected_env():
 
 CKPT_CONSUMER = textwrap.dedent(
     """
-    import os, sys
+    import os
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -138,27 +143,9 @@ def test_distributed_checkpoint_roundtrip(tmp_path):
     processes (rendezvoused from the operator-injected env) save one orbax
     checkpoint cooperatively and both restore it bit-exact — the
     preemption-resume contract a single-process test cannot prove."""
-    port = _free_port()
-    result = run_local({
-        "apiVersion": "kubeflow.org/v1",
-        "kind": "TPUJob",
-        "metadata": {"name": "jaxckpt", "namespace": "default"},
-        "spec": {
-            "acceleratorType": "v4-16",
-            "tpuReplicaSpecs": {"Worker": {
-                "restartPolicy": "Never",
-                "template": {"spec": {"containers": [{
-                    "name": "tpu",
-                    "image": "local",
-                    "command": [sys.executable, "-u", "-c", CKPT_CONSUMER],
-                    "ports": [{"name": "coordinator-port",
-                               "containerPort": port}],
-                }]}},
-            }},
-        },
-    }, timeout=240.0, extra_env={"CKPT_DIR": str(tmp_path / "ckpt")})
-    logs = "\n".join(
-        f"--- {k}\n{v}" for k, v in sorted(result["logs"].items())
+    result, logs = _run_two_host_tpujob(
+        "jaxckpt", CKPT_CONSUMER, timeout=240.0,
+        extra_env={"CKPT_DIR": str(tmp_path / "ckpt")},
     )
     assert result["state"] == "Succeeded", f"{result['state']}\n{logs[-3000:]}"
     assert "process 0: ckpt step=2 roundtrip OK" in logs, logs[-3000:]
