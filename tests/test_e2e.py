@@ -348,3 +348,65 @@ def test_invalid_tfjob(harness):
     client.wait_for_job("bad")
     assert client.get_job_status("bad") == "Failed"
     assert client.get_pod_names("bad") == set()
+
+
+def test_operator_restart_resumes_reconciliation():
+    """Operator crash/upgrade resilience: all state lives in the cluster
+    (reference design: CRs in etcd, stateless controller), so a NEW
+    manager instance must adopt the previous incarnation's pods untouched
+    (same UIDs — no teardown, no duplicates) and process changes that
+    happened while no operator was running."""
+    cluster = FakeCluster()
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]), resync_period=0,
+        threadiness=2,
+    )
+    mgr = OperatorManager(cluster, opts)
+    mgr.start()
+    kubelet = FakeKubelet(cluster)
+    client = TFJobClient(cluster)
+    try:
+        client.create(testutil.new_tfjob("survivor", worker=2))
+        client.wait_for_condition("survivor", ["Running"], timeout=10)
+        for i in range(2):
+            kubelet.wait_running("default", f"survivor-worker-{i}")
+        uids_before = {
+            objects.name_of(p): objects.uid_of(p)
+            for p in cluster.list_pods(selector={"job-name": "survivor"})
+        }
+
+        mgr.stop()  # operator goes away; cluster state stays
+
+        # while no operator runs: the user scales up (the supported path —
+        # scale() resolves the kind's replica-specs key itself)
+        client.scale("survivor", 3)
+
+        mgr2 = OperatorManager(cluster, opts)
+        mgr2.start()
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                pods = cluster.list_pods(selector={"job-name": "survivor"})
+                if len(pods) == 3:
+                    break
+                time.sleep(0.05)
+            pods = cluster.list_pods(selector={"job-name": "survivor"})
+            assert len(pods) == 3, [objects.name_of(p) for p in pods]
+            kubelet.wait_running("default", "survivor-worker-2")
+            # the old incarnation's pods were ADOPTED, not recreated
+            uids_after = {
+                objects.name_of(p): objects.uid_of(p) for p in pods
+            }
+            for name, uid in uids_before.items():
+                assert uids_after[name] == uid, f"{name} was recreated"
+            # indexes unique
+            idx = sorted(
+                p["metadata"]["labels"]["replica-index"] for p in pods
+            )
+            assert idx == ["0", "1", "2"]
+            assert client.is_job_running("survivor")
+        finally:
+            mgr2.stop()
+    finally:
+        kubelet.stop_all()
+        mgr.stop()
