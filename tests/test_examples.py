@@ -17,13 +17,18 @@ _ADAPTERS = None
 def _adapters():
     global _ADAPTERS
     if _ADAPTERS is None:
-        from tf_operator_tpu.api import pytorch, tensorflow, tpujob
+        from tf_operator_tpu.api import (
+            mxnet, pytorch, tensorflow, tpujob, xgboost,
+        )
 
         _ADAPTERS = {
             "TFJob": (tensorflow.TFJob, tensorflow.set_defaults, tensorflow.validate),
             "TPUJob": (tpujob.TPUJob, tpujob.set_defaults, tpujob.validate),
             "PyTorchJob": (pytorch.PyTorchJob, pytorch.set_defaults,
                            pytorch.validate),
+            "MXJob": (mxnet.MXJob, mxnet.set_defaults, mxnet.validate),
+            "XGBoostJob": (xgboost.XGBoostJob, xgboost.set_defaults,
+                           xgboost.validate),
         }
     return _ADAPTERS
 
@@ -197,3 +202,51 @@ def test_elastic_pytorch_example_through_run_local():
     assert "PET_RDZV_ENDPOINT=127.0.0.1:29400" in combined
     assert "PET_NNODES=1:8" in combined
     assert "elastic contract ok" in combined
+
+
+def _localize_example_command(container):
+    """Remap /examples/... script paths in the container command to this
+    checkout (the operator image's mapping), PRESERVING every other
+    element — the yaml's own flags must be what the test exercises."""
+    container["command"] = [
+        os.path.join(REPO, el.lstrip("/")) if el.startswith("/examples/")
+        else el
+        for el in container.get("command", [])
+    ]
+
+
+def test_mxnet_example_through_run_local():
+    """MXJob example end to end: operator injects MX_CONFIG + DMLC_*, every
+    replica validates the kvstore contract, job Succeeds on scheduler
+    completion (MXNet semantics)."""
+    from tf_operator_tpu.runtime.local import run_local
+
+    doc = yaml.safe_load(open(os.path.join(EX, "mxnet", "mxjob_dist.yaml")))
+    for rs in doc["spec"]["mxReplicaSpecs"].values():
+        c = rs["template"]["spec"]["containers"][0]
+        _localize_example_command(c)
+    result = run_local(doc, timeout=120, extra_env={"PYTHONPATH": REPO})
+    combined = "\n".join(result["logs"].values())
+    assert result["state"] == "Succeeded", combined[-2000:]
+    assert "DMLC_ROLE=scheduler" in combined
+    assert "DMLC_ROLE=server" in combined
+    assert "DMLC_ROLE=worker" in combined
+    assert combined.count("mx contract ok") == 4  # 1+1+2 replicas
+
+
+def test_xgboost_example_through_run_local():
+    """XGBoostJob example end to end: operator injects MASTER_*/RANK
+    (rabit contract), every replica validates it, master completion
+    succeeds the job."""
+    from tf_operator_tpu.runtime.local import run_local
+
+    doc = yaml.safe_load(
+        open(os.path.join(EX, "xgboost", "xgboostjob_dist.yaml")))
+    for rs in doc["spec"]["xgbReplicaSpecs"].values():
+        _localize_example_command(rs["template"]["spec"]["containers"][0])
+    result = run_local(doc, timeout=120, extra_env={"PYTHONPATH": REPO})
+    combined = "\n".join(result["logs"].values())
+    assert result["state"] == "Succeeded", combined[-2000:]
+    assert "xgb contract ok: rank=0/3" in combined
+    assert "xgb contract ok: rank=1/3" in combined
+    assert "xgb contract ok: rank=2/3" in combined
