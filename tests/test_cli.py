@@ -240,3 +240,32 @@ def test_version_verb(capsys):
     cli = _cli_and_cluster()
     assert _invoke(cli, ["version"]) == 0
     assert "tpu-operator" in capsys.readouterr().out
+
+
+def test_apply_creates_then_configures(tmp_path, capsys):
+    """kubectl-apply idempotency: first apply creates, a second apply with
+    a changed replica count deep-merge patches the stored job."""
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    import copy as _copy
+
+    assert _invoke(cli, ["apply", str(path)]) == 0
+    assert "created" in capsys.readouterr().out
+    # round-trip manifest: server-managed metadata (resourceVersion, uid)
+    # in the applied doc is ignored, not merged into a conflict
+    doc = _copy.deepcopy(cli.cluster.get("TFJob", "default", "mnist"))
+    doc["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = 5
+    doc.pop("status", None)
+    path.write_text(yaml.safe_dump(doc))
+    assert _invoke(cli, ["apply", str(path)]) == 0
+    assert "configured" in capsys.readouterr().out
+    stored = cli.cluster.get("TFJob", "default", "mnist")
+    assert stored["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 5
+    # schema still enforced on the apply path
+    bad = _copy.deepcopy(TFJOB)
+    bad["spec"]["tfReplicaSpecs"]["Worker"]["restartPolicy"] = "Sometimes"
+    path.write_text(yaml.safe_dump(bad))
+    assert _invoke(cli, ["apply", str(path)]) == 1
+    err = capsys.readouterr().err
+    assert "restartPolicy" in err

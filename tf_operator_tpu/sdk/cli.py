@@ -5,6 +5,7 @@ The reference's user surface is the generated SDK plus raw kubectl
 common verbs into one command:
 
   tpu-jobs submit job.yaml                 # create from YAML
+  tpu-jobs apply job.yaml                  # create-or-update (deep merge)
   tpu-jobs run-local job.yaml              # run replicas as LOCAL processes
   tpu-jobs get tfjob mnist [-n ns] [-o json|wide]
   tpu-jobs describe tfjob mnist            # conditions, replicas, events
@@ -83,14 +84,31 @@ class Cli:
         return JobClient(self.cluster, kind=kind)
 
     # ----------------------------------------------------------- verbs
-    def submit(self, path: str, namespace: str) -> int:
+    def submit(self, path: str, namespace: str, apply: bool = False) -> int:
+        """Create each doc in the file; with apply=True an existing job is
+        deep-merge patched instead (kubectl-apply idempotency —
+        JobClient.apply owns the semantics)."""
+        from tf_operator_tpu.k8s.fake import ApiError
+
         with (sys.stdin if path == "-" else open(path)) as f:
             docs = [d for d in yaml.safe_load_all(f) if d]
         for doc in docs:
             kind = resolve_kind(doc.get("kind", ""))
-            created = self.client(kind).create(doc, namespace=namespace)
-            md = created.get("metadata", {})
-            print(f"{kind.lower()}.kubeflow.org/{md.get('name')} created")
+            client = self.client(kind)
+            name = doc.get("metadata", {}).get("name", "")
+            try:
+                if apply:
+                    created, action = client.apply(doc, namespace=namespace)
+                else:
+                    created = client.create(doc, namespace=namespace)
+                    action = "created"
+                name = created.get("metadata", {}).get("name", name)
+            except (ValueError, ApiError) as e:
+                # schema violation / conflict / apiserver rejection:
+                # clean message, no traceback
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            print(f"{kind.lower()}.kubeflow.org/{name} {action}")
         return 0
 
     def get(self, kind: str, name: str, namespace: str, output: str) -> int:
@@ -282,6 +300,10 @@ def make_parser() -> argparse.ArgumentParser:
     ps = sub.add_parser("submit", parents=[common])
     ps.add_argument("file", help="job YAML ('-' for stdin)")
 
+    pa = sub.add_parser("apply", parents=[common])
+    pa.add_argument("file", help="job YAML ('-' for stdin); creates or "
+                                 "deep-merge updates (kubectl apply style)")
+
     pr = sub.add_parser("run-local", parents=[common])
     pr.add_argument("file", help="job YAML ('-' for stdin)")
     pr.add_argument("--timeout", type=float, default=300.0)
@@ -320,6 +342,8 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
         return 0
     if args.verb == "submit":
         return cli.submit(args.file, ns)
+    if args.verb == "apply":
+        return cli.submit(args.file, ns, apply=True)
     if args.verb == "run-local":
         return run_local_file(args.file, args.timeout)
     kind = resolve_kind(args.kind)

@@ -75,6 +75,33 @@ class JobClient:
         current = self.cluster.get(self.kind, namespace, name)
         return self.cluster.update(self.kind, _deep_merge(current, patch))
 
+    def apply(
+        self, doc, namespace: str = "default"
+    ) -> "tuple[Dict[str, Any], str]":
+        """Create-or-update (kubectl apply style): validate the desired doc
+        against the published schema, then deep-merge it onto an existing
+        job, or create it.  Server-managed metadata in the desired doc
+        (resourceVersion/uid/generation/creationTimestamp — present in any
+        `get -o yaml` round-trip) is ignored rather than merged, so a
+        saved-and-edited manifest applies cleanly.  Returns (object,
+        "created"|"configured")."""
+        from tf_operator_tpu.sdk.schema import validate_body
+
+        body = doc.to_dict() if hasattr(doc, "to_dict") else copy.deepcopy(doc)
+        meta = body.setdefault("metadata", {})
+        for managed in ("resourceVersion", "uid", "generation",
+                        "creationTimestamp"):
+            meta.pop(managed, None)
+        validate_body(self.kind, body)
+        name = meta.get("name", "")
+        try:
+            # patch re-fetches and raises NotFoundError for missing jobs
+            return self.patch(name, body, namespace), "configured"
+        except NotFoundError:
+            # already validated above
+            return self.create(body, namespace=namespace,
+                               validate=False), "created"
+
     def delete(self, name: str, namespace: str = "default") -> None:
         self.cluster.delete(self.kind, namespace, name)
 
