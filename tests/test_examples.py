@@ -222,6 +222,10 @@ def test_mxnet_example_through_run_local():
     from tf_operator_tpu.runtime.local import run_local
 
     doc = yaml.safe_load(open(os.path.join(EX, "mxnet", "mxjob_dist.yaml")))
+    # keep all pods + logs: with the default CleanPodPolicy the scheduler
+    # finishing first would tear down workers before their contract lines
+    # flush (a log race, not a correctness signal)
+    doc["spec"]["runPolicy"] = {"cleanPodPolicy": "None"}
     for rs in doc["spec"]["mxReplicaSpecs"].values():
         c = rs["template"]["spec"]["containers"][0]
         _localize_example_command(c)
@@ -242,6 +246,8 @@ def test_xgboost_example_through_run_local():
 
     doc = yaml.safe_load(
         open(os.path.join(EX, "xgboost", "xgboostjob_dist.yaml")))
+    # see the mxnet test: master completion must not race worker logs away
+    doc["spec"]["runPolicy"] = {"cleanPodPolicy": "None"}
     for rs in doc["spec"]["xgbReplicaSpecs"].values():
         _localize_example_command(rs["template"]["spec"]["containers"][0])
     result = run_local(doc, timeout=120, extra_env={"PYTHONPATH": REPO})
