@@ -204,6 +204,44 @@ def test_elastic_pytorch_example_through_run_local():
     assert "elastic contract ok" in combined
 
 
+def _run_job_until(doc, pred, timeout=120):
+    """Drive a job CR through the live manager + subprocess kubelet and
+    wait until pred(combined_logs, state) — unlike run_local's snapshot at
+    the terminal condition, this can ALSO wait for output of replicas that
+    are still draining when the first one completes (cleanPodPolicy None
+    keeps them alive)."""
+    import time as _time
+
+    from tf_operator_tpu.cmd.manager import OperatorManager
+    from tf_operator_tpu.cmd.options import ServerOptions
+    from tf_operator_tpu.k8s.fake import FakeCluster
+    from tf_operator_tpu.runtime.local import SubprocessKubelet
+    from tf_operator_tpu.sdk.watch import job_state
+
+    kind = doc["kind"]
+    name = doc["metadata"]["name"]
+    cluster = FakeCluster()
+    kubelet = SubprocessKubelet(cluster, extra_env={"PYTHONPATH": REPO})
+    mgr = OperatorManager(cluster, ServerOptions())
+    mgr.start()
+    try:
+        cluster.create(kind, doc)
+        deadline = _time.monotonic() + timeout
+        combined = state = None
+        while _time.monotonic() < deadline:
+            combined = "\n".join(
+                cluster.all_pod_logs("default").values())
+            state = job_state(cluster.get(kind, "default", name))
+            if pred(combined, state):
+                return combined, state
+            _time.sleep(0.05)
+        raise TimeoutError(
+            f"pred never satisfied; state={state}\n{(combined or '')[-2000:]}")
+    finally:
+        kubelet.stop_all()
+        mgr.stop()
+
+
 def _localize_example_command(container):
     """Remap /examples/... script paths in the container command to this
     checkout (the operator image's mapping), PRESERVING every other
@@ -224,18 +262,20 @@ def test_mxnet_example_through_run_local():
     doc = yaml.safe_load(open(os.path.join(EX, "mxnet", "mxjob_dist.yaml")))
     # keep all pods + logs: with the default CleanPodPolicy the scheduler
     # finishing first would tear down workers before their contract lines
-    # flush (a log race, not a correctness signal)
+    # flush; cleanPodPolicy None + waiting for ALL lines (not a snapshot
+    # at Succeeded) removes the race entirely
     doc["spec"]["runPolicy"] = {"cleanPodPolicy": "None"}
     for rs in doc["spec"]["mxReplicaSpecs"].values():
         c = rs["template"]["spec"]["containers"][0]
         _localize_example_command(c)
-    result = run_local(doc, timeout=120, extra_env={"PYTHONPATH": REPO})
-    combined = "\n".join(result["logs"].values())
-    assert result["state"] == "Succeeded", combined[-2000:]
+    combined, state = _run_job_until(
+        doc,
+        lambda logs, st: st == "Succeeded"
+        and logs.count("mx contract ok") == 4,  # 1+1+2 replicas
+    )
     assert "DMLC_ROLE=scheduler" in combined
     assert "DMLC_ROLE=server" in combined
     assert "DMLC_ROLE=worker" in combined
-    assert combined.count("mx contract ok") == 4  # 1+1+2 replicas
 
 
 def test_xgboost_example_through_run_local():
@@ -250,9 +290,10 @@ def test_xgboost_example_through_run_local():
     doc["spec"]["runPolicy"] = {"cleanPodPolicy": "None"}
     for rs in doc["spec"]["xgbReplicaSpecs"].values():
         _localize_example_command(rs["template"]["spec"]["containers"][0])
-    result = run_local(doc, timeout=120, extra_env={"PYTHONPATH": REPO})
-    combined = "\n".join(result["logs"].values())
-    assert result["state"] == "Succeeded", combined[-2000:]
-    assert "xgb contract ok: rank=0/3" in combined
-    assert "xgb contract ok: rank=1/3" in combined
-    assert "xgb contract ok: rank=2/3" in combined
+    combined, state = _run_job_until(
+        doc,
+        lambda logs, st: st == "Succeeded" and all(
+            f"xgb contract ok: rank={r}/3" in logs for r in (0, 1, 2)
+        ),
+    )
+    assert state == "Succeeded"
