@@ -81,6 +81,9 @@ def main(argv=None):
     ap.add_argument("--data-dir", default="",
                     help=".rec shards (data/loader.py format); each host "
                          "reads its disjoint subset. Default: synthetic.")
+    ap.add_argument("--trace-dir", default="",
+                    help="capture an XProf device trace of steps 10-30 "
+                         "(runtime/profiler.py bounded window)")
     args = ap.parse_args(argv)
 
     info = bootstrap.initialize()
@@ -109,7 +112,8 @@ def main(argv=None):
         data,
         num_steps=args.steps,
         checkpointer=Checkpointer(args.ckpt_dir) if args.ckpt_dir else None,
-        profiler=Profiler(batch_size=args.per_host_batch * jax.process_count()),
+        profiler=Profiler(trace_dir=args.trace_dir or None,
+                          batch_size=args.per_host_batch * jax.process_count()),
         guard=PreemptionGuard(),
         metrics_sink=print,
     )

@@ -248,3 +248,81 @@ def test_async_checkpointing_resume_and_durability(tmp_path):
     assert res2.resumed_from == 6
     assert res2.steps_run == 3
     assert Checkpointer(ckpt_dir).latest_step() == 9
+
+
+def test_bounded_trace_window_captures_and_flushes(tmp_path):
+    """A trace_dir on the Profiler makes run_training capture a bounded
+    XProf window (start past compile, stop after N steps, flush on exit)
+    without any caller-side trace plumbing."""
+    import optax
+
+    from tf_operator_tpu.models.mnist import MnistMLP
+    from tf_operator_tpu.runtime.loop import run_training
+    from tf_operator_tpu.runtime.profiler import Profiler
+    from tf_operator_tpu.runtime.train import create_train_state, make_train_step
+
+    model = MnistMLP(hidden=8)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4, 28, 28))
+    y = jnp.arange(4) % 10
+
+    def batches():
+        while True:
+            yield (x, y)
+
+    state = create_train_state(rng, model, x, optax.sgd(1e-2))
+    prof = Profiler(trace_dir=str(tmp_path), trace_start_step=1,
+                    trace_num_steps=2)
+    res = run_training(
+        state, make_train_step(model, has_batch_stats=False), batches(),
+        num_steps=5, profiler=prof,
+    )
+    assert res.steps_run == 5
+    assert not prof._tracing  # stopped inside the loop, flushed
+    traced = list(tmp_path.rglob("*"))
+    assert any(p.is_file() for p in traced), "no trace artifacts written"
+
+
+def test_trace_window_starts_on_resumed_step_counter(tmp_path):
+    """A checkpoint-resumed run whose first step is already past
+    trace_start_step still captures exactly one window (>= start + one-shot
+    latch), and a mid-window exception flushes via the loop's finally."""
+    import optax
+
+    from tf_operator_tpu.models.mnist import MnistMLP
+    from tf_operator_tpu.runtime.loop import run_training
+    from tf_operator_tpu.runtime.profiler import Profiler
+    from tf_operator_tpu.runtime.train import create_train_state, make_train_step
+
+    model = MnistMLP(hidden=8)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4, 28, 28))
+    y = jnp.arange(4) % 10
+    state = create_train_state(rng, model, x, optax.sgd(1e-2))
+    state = state.replace(step=jnp.asarray(100))  # "resumed" past start=10
+
+    prof = Profiler(trace_dir=str(tmp_path / "a"), trace_start_step=10,
+                    trace_num_steps=2)
+
+    def batches():
+        while True:
+            yield (x, y)
+
+    run_training(state, make_train_step(model, has_batch_stats=False),
+                 batches(), num_steps=105, profiler=prof)
+    assert prof._trace_done and not prof._tracing
+    assert any(p.is_file() for p in (tmp_path / "a").rglob("*"))
+
+    # mid-window exception: the finally flush stops the global profiler
+    prof2 = Profiler(trace_dir=str(tmp_path / "b"), trace_start_step=0,
+                     trace_num_steps=50)
+    state2 = create_train_state(rng, model, x, optax.sgd(1e-2))
+
+    def exploding():
+        yield (x, y)
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        run_training(state2, make_train_step(model, has_batch_stats=False),
+                     exploding(), num_steps=10, profiler=prof2)
+    assert not prof2._tracing  # flushed; a later start_trace would work

@@ -106,26 +106,32 @@ def run_training(
     last_metrics: Dict[str, float] = {}
     it = iter(batches)
 
-    while step < num_steps:
-        if guard.preempted:
-            break
-        try:
-            batch = next(it)
-        except StopIteration:
-            break
-        with profiler.step(step):
-            state, metrics = train_step(state, *batch)
-        step += 1
-        steps_run += 1
-        last_metrics = {k: float(v) for k, v in metrics.items()}
+    try:
+        while step < num_steps:
+            if guard.preempted:
+                break
+            profiler.maybe_trace(step)
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            with profiler.step(step):
+                state, metrics = train_step(state, *batch)
+            step += 1
+            steps_run += 1
+            last_metrics = {k: float(v) for k, v in metrics.items()}
 
-        if checkpointer is not None and step % save_interval_steps == 0:
-            checkpointer.save(step, state)
-            last_saved_step = step
-        if step % log_interval_steps == 0:
-            line = profiler.metrics_line(step, extra=last_metrics)
-            (metrics_sink or (lambda s: log.info("%s", s)))(line)
-
+            if checkpointer is not None and step % save_interval_steps == 0:
+                checkpointer.save(step, state)
+                last_saved_step = step
+            if step % log_interval_steps == 0:
+                line = profiler.metrics_line(step, extra=last_metrics)
+                (metrics_sink or (lambda s: log.info("%s", s)))(line)
+    finally:
+        # flush an unfinished trace window even when a step raises mid-
+        # window: the jax profiler is process-global, and leaving it
+        # started loses the capture AND breaks any later start_trace()
+        profiler.stop_trace()
     preempted = guard.preempted
     if checkpointer is not None and steps_run > 0 and step != last_saved_step:
         # final save unless this exact step is already on disk (interval

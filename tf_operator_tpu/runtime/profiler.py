@@ -91,11 +91,17 @@ class Profiler:
         trace_dir: Optional[str] = None,
         batch_size: Optional[int] = None,
         window: int = 200,
+        trace_start_step: int = 10,
+        trace_num_steps: int = 20,
     ) -> None:
         self.trace_dir = trace_dir
         self.batch_size = batch_size
         self.steps = StepProfile(window=window)
+        self.trace_start_step = trace_start_step
+        self.trace_num_steps = trace_num_steps
         self._tracing = False
+        self._trace_started_at: Optional[int] = None
+        self._trace_done = False
 
     # ------------------------------------------------------------- tracing
     def start_trace(self) -> None:
@@ -116,6 +122,24 @@ class Profiler:
             yield
         finally:
             self.stop_trace()
+
+    def maybe_trace(self, step: int) -> None:
+        """Bounded-window capture driven by the training loop: with a
+        trace_dir set, start once the step counter passes trace_start_step
+        (>= — a checkpoint-resumed run whose first step is already past
+        the threshold still gets its window) and stop after
+        trace_num_steps, exactly once per process.  No-op otherwise; the
+        loop's final stop_trace() flushes an unfinished window on early
+        exit/preemption."""
+        if not self.trace_dir or self._trace_done:
+            return
+        if not self._tracing:
+            if step >= self.trace_start_step:
+                self.start_trace()
+                self._trace_started_at = step
+        elif step >= self._trace_started_at + self.trace_num_steps:
+            self.stop_trace()
+            self._trace_done = True
 
     @contextmanager
     def step(self, n: int) -> Iterator[None]:
