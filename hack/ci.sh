@@ -39,6 +39,7 @@ if want static; then
   python hack/gen_crds.py --check
   python hack/gen_apidoc.py --check
   python hack/gen_openapi.py --check
+  python hack/gen_models.py --check
 
   stage "manifests: overlays render (hermetic kustomize)"
   python hack/release.py render --overlay standalone > /dev/null

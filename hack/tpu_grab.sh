@@ -4,17 +4,25 @@
 # real-TPU artifact even if the pool is busy again at round end (the cache
 # is merged into later bench output with "source: cached" provenance).
 # Run under tmux/nohup for a whole session:
-#   hack/tpu_grab.sh [interval_s] [probe_timeout_s]
+#   hack/tpu_grab.sh [interval_s] [probe_timeout_s] [bench_timeout_s]
+#
+# The bench runs with BENCH_SKIP_PROBE=1: this loop's probe is the only
+# pre-claim, so the bench's own jax init is the next (single) pool claim —
+# the pool has been observed to wedge a claim that follows a rapid
+# claim/release cycle, so fewer claims is strictly safer.  A hard `timeout`
+# around the bench keeps a wedged claim from blocking the loop forever.
 set -u
 cd "$(dirname "$0")/.."
-INTERVAL="${1:-600}"
+INTERVAL="${1:-300}"
 PROBE_TIMEOUT="${2:-120}"
+BENCH_TIMEOUT="${3:-5400}"
 while true; do
   if timeout "$PROBE_TIMEOUT" python -c \
       'import jax,sys; sys.exit(0 if jax.devices()[0].platform != "cpu" else 1)' \
       >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) probe OK - running bench"
-    BENCH_PROBE_TIMEOUT_S="$PROBE_TIMEOUT" python bench.py \
+    sleep 5   # let the probe's claim fully release before the bench claims
+    BENCH_SKIP_PROBE=1 timeout "$BENCH_TIMEOUT" python bench.py \
       > /tmp/bench_grab_last.json 2>/tmp/bench_grab_last.err
     if grep -q '"source": "live"' /tmp/bench_grab_last.json 2>/dev/null; then
       echo "$(date -u +%FT%TZ) live TPU bench captured -> BENCH_TPU_LAST_GOOD.json"

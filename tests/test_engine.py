@@ -410,6 +410,48 @@ def test_gang_scheduling_podgroup_and_annotations():
         cluster.get("PodGroup", "default", "test-tfjob")
 
 
+def test_gang_scheduling_coscheduling_backend():
+    """--gang-scheduler-name scheduler-plugins renders the
+    scheduling.x-k8s.io/v1alpha1 PodGroup and joins members by the
+    coscheduling pod LABEL, not volcano's annotations (modern
+    training-operator's second gang backend; the reference snapshot is
+    volcano-only)."""
+    cluster, engine = setup_engine(
+        config=EngineConfig(enable_gang_scheduling=True,
+                            gang_scheduler_name="scheduler-plugins")
+    )
+    job = testutil.new_tfjob(worker=2)
+    job.run_policy.scheduling_policy = common.SchedulingPolicy(
+        min_available=2, schedule_timeout_seconds=120, queue="q1"
+    )
+    submit(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    pg = cluster.get("CoschedulingPodGroup", "default", "test-tfjob")
+    assert pg["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+    assert pg["spec"]["minMember"] == 2
+    assert pg["spec"]["scheduleTimeoutSeconds"] == 120
+    # queue is volcano-only: dropped from the spec, surfaced as a warning
+    assert "queue" not in pg["spec"]
+    assert any(e["reason"] == "GangSchedulingPolicy"
+               for e in cluster.events_for("test-tfjob"))
+    # no volcano PodGroup was created
+    with pytest.raises(Exception):
+        cluster.get("PodGroup", "default", "test-tfjob")
+    pod = cluster.list_pods()[0]
+    assert (pod["metadata"]["labels"]["scheduling.x-k8s.io/pod-group"]
+            == "test-tfjob")
+    assert "volcano.sh/task-spec" not in pod["metadata"].get(
+        "annotations", {})
+    assert pod["spec"]["schedulerName"] == "scheduler-plugins"
+    # terminal: the coscheduling podgroup is removed too
+    for p in cluster.list_pods():
+        set_phase(cluster, p, objects.POD_SUCCEEDED, exit_code=0)
+    job, _ = reconcile(cluster, engine, job)
+    job, _ = reconcile(cluster, engine, job)
+    with pytest.raises(Exception):
+        cluster.get("CoschedulingPodGroup", "default", "test-tfjob")
+
+
 # ---------------------------------------------------------------------------
 # BackoffLimit for ExitCode delete-for-recreate restarts (reference gap the
 # rebuild closes: kubeflow/common PastBackoffLimit counts only kubelet
@@ -912,3 +954,38 @@ def test_finished_job_cleans_orphan_service():
     assert len(cluster.list_services()) == 2
     job, _ = reconcile(cluster, engine, job)
     assert cluster.list_services() == []
+
+
+def test_gang_backend_knob_warnings_are_symmetric():
+    """Neither backend drops a scheduling knob silently: volcano warns on
+    scheduleTimeoutSeconds, coscheduling warns on queue/priorityClass —
+    including knobs added AFTER the PodGroup was first synced (the
+    warning latches on the ignored values, not the rendered-spec diff)."""
+    cluster, engine = setup_engine(
+        config=EngineConfig(enable_gang_scheduling=True)
+    )
+    job = testutil.new_tfjob(worker=1)
+    submit(cluster, engine, job)
+    reconcile(cluster, engine, job)  # PodGroup synced, no foreign knobs
+
+    def warnings():
+        return [e for e in cluster.events_for("test-tfjob")
+                if e["reason"] == "GangSchedulingPolicy"]
+
+    assert not warnings()
+    # foreign knob added to the ALREADY-SYNCED job: rendered volcano spec
+    # is unchanged, the warning must still fire
+    stored = cluster.get("TFJob", "default", "test-tfjob")
+    stored["spec"]["runPolicy"] = {
+        "schedulingPolicy": {"scheduleTimeoutSeconds": 60}}
+    cluster.update("TFJob", stored)
+    job = engine.adapter.from_dict(
+        cluster.get("TFJob", "default", "test-tfjob"))
+    engine.reconcile(job)
+    assert warnings() and "scheduleTimeoutSeconds" in warnings()[0]["message"]
+    pg = cluster.get("PodGroup", "default", "test-tfjob")
+    assert "scheduleTimeoutSeconds" not in pg["spec"]
+    # steady state: the same ignored value does not re-warn every sync
+    engine.reconcile(engine.adapter.from_dict(
+        cluster.get("TFJob", "default", "test-tfjob")))
+    assert len(warnings()) == 1

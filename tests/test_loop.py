@@ -326,3 +326,15 @@ def test_trace_window_starts_on_resumed_step_counter(tmp_path):
         run_training(state2, make_train_step(model, has_batch_stats=False),
                      exploding(), num_steps=10, profiler=prof2)
     assert not prof2._tracing  # flushed; a later start_trace would work
+
+
+def test_maybe_trace_tolerates_externally_opened_window(tmp_path):
+    """The documented external pattern — trace_window() around a run whose
+    loop also calls maybe_trace(step) — must bound the window, not crash
+    on None arithmetic (regression: _trace_started_at was never set when
+    the window was opened externally)."""
+    prof = Profiler(trace_dir=str(tmp_path / "t"), trace_num_steps=2)
+    with prof.trace_window():
+        for step in range(5):
+            prof.maybe_trace(step)  # adopts step 0 as origin, stops at 2
+    assert prof._trace_done and not prof._tracing

@@ -63,6 +63,9 @@ class SchedulingPolicy:
     queue: Optional[str] = None
     min_resources: Optional[Dict[str, str]] = None
     priority_class: Optional[str] = None
+    # consumed by the scheduler-plugins (coscheduling) gang backend; the
+    # volcano PodGroup API has no such field and ignores it
+    schedule_timeout_seconds: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -74,6 +77,8 @@ class SchedulingPolicy:
             d["minResources"] = self.min_resources
         if self.priority_class is not None:
             d["priorityClass"] = self.priority_class
+        if self.schedule_timeout_seconds is not None:
+            d["scheduleTimeoutSeconds"] = self.schedule_timeout_seconds
         return d
 
     @classmethod
@@ -85,6 +90,7 @@ class SchedulingPolicy:
             queue=d.get("queue"),
             min_resources=d.get("minResources"),
             priority_class=d.get("priorityClass"),
+            schedule_timeout_seconds=d.get("scheduleTimeoutSeconds"),
         )
 
 

@@ -221,6 +221,9 @@ def validate_run_policy(job: Job, kind: str = "Job") -> None:
     ):
         _require_nonneg_int(kind, field_name, value)
     sp = rp.scheduling_policy
+    if sp is not None:
+        _require_nonneg_int(kind, "schedulingPolicy.scheduleTimeoutSeconds",
+                            sp.schedule_timeout_seconds)
     if sp is not None and sp.min_available is not None:
         ma = sp.min_available
         _require_nonneg_int(kind, "schedulingPolicy.minAvailable", ma)

@@ -26,7 +26,11 @@ from tf_operator_tpu.k8s import objects
 from tf_operator_tpu.k8s.client import KIND_REGISTRY
 from tf_operator_tpu.k8s.fake import ApiError, ConflictError, FakeCluster, NotFoundError
 
-_PLURAL_TO_KIND = {info.plural: kind for kind, info in KIND_REGISTRY.items()}
+# (group, plural) — plural alone is ambiguous: volcano and
+# scheduler-plugins both serve `podgroups` in different API groups
+_GROUP_PLURAL_TO_KIND = {
+    (info.group, info.plural): kind for kind, info in KIND_REGISTRY.items()
+}
 
 # /api/v1/... or /apis/{group}/{version}/... ; optional namespace segment;
 # plural; optional name; optional subresource
@@ -43,9 +47,10 @@ def _parse_path(path: str) -> Tuple[str, Optional[str], Optional[str], Optional[
     if not m:
         raise ApiError(404, f"no route for {path}")
     plural = m.group("plural")
-    kind = _PLURAL_TO_KIND.get(plural)
+    group = "" if m.group("core_version") else m.group("group")
+    kind = _GROUP_PLURAL_TO_KIND.get((group, plural))
     if kind is None:
-        raise ApiError(404, f"unknown resource {plural}")
+        raise ApiError(404, f"unknown resource {plural} in group {group!r}")
     return kind, m.group("namespace"), m.group("name"), m.group("sub")
 
 

@@ -45,6 +45,17 @@ from tf_operator_tpu.k8s.fake import NotFoundError
 GANG_GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
 GANG_TASK_SPEC_ANNOTATION = "volcano.sh/task-spec"
 DEFAULT_GANG_SCHEDULER = "volcano"
+# Second gang backend: kube-scheduler coscheduling plugin
+# (scheduler-plugins).  Members join the gang via a pod LABEL naming the
+# PodGroup rather than volcano's annotations, and the PodGroup lives in
+# the scheduling.x-k8s.io/v1alpha1 API.  The reference snapshot is
+# volcano-only; the modern training-operator supports both, selected by
+# --gang-scheduler-name.
+COSCHEDULING_POD_GROUP_LABEL = "scheduling.x-k8s.io/pod-group"
+COSCHEDULING_SCHEDULER_NAMES = frozenset({"scheduler-plugins", "coscheduling"})
+# PodGroup annotation latching which schedulingPolicy knobs the selected
+# gang backend could not express (the once-per-change warning keys on it)
+IGNORED_KNOBS_ANNOTATION = "kubeflow.org/ignored-scheduling-knobs"
 
 # Event reasons (reference event vocabulary)
 REASON_SUCCEEDED = "JobSucceeded"
@@ -667,9 +678,14 @@ class JobEngine:
                     "Another scheduler is specified when gang-scheduling is "
                     "enabled and it will not be overwritten",
                 )
-            annotations = meta.setdefault("annotations", {})
-            annotations[GANG_GROUP_NAME_ANNOTATION] = job.name
-            annotations[GANG_TASK_SPEC_ANNOTATION] = rt
+            if self._gang_coscheduling():
+                # coscheduling joins members to the gang by label
+                meta.setdefault("labels", {})[
+                    COSCHEDULING_POD_GROUP_LABEL] = job.name
+            else:
+                annotations = meta.setdefault("annotations", {})
+                annotations[GANG_GROUP_NAME_ANNOTATION] = job.name
+                annotations[GANG_TASK_SPEC_ANNOTATION] = rt
 
         controller_ref = objects.owner_reference(
             {"apiVersion": job.api_version, "kind": job.kind, "metadata": job.metadata}
@@ -862,24 +878,61 @@ class JobEngine:
         return total >= limit
 
     # ------------------------------------------------------------ podgroups
+    def _gang_coscheduling(self) -> bool:
+        """True when the configured gang scheduler is the kube-scheduler
+        coscheduling plugin (scheduler-plugins) rather than volcano."""
+        return (self.config.gang_scheduler_name or "").lower() in (
+            COSCHEDULING_SCHEDULER_NAMES
+        )
+
     def _sync_pod_group(self, job: Job) -> None:
-        """volcano-style PodGroup: minMember from schedulingPolicy.minAvailable
+        """Gang PodGroup sync: minMember from schedulingPolicy.minAvailable
         or total replicas (reference: PodGroup lifecycle in kubeflow/common
-        ReconcileJobs; CRD knobs manifests/base/kubeflow.org_tfjobs.yaml)."""
+        ReconcileJobs; CRD knobs manifests/base/kubeflow.org_tfjobs.yaml).
+        The group object is rendered for whichever backend
+        --gang-scheduler-name selects: volcano
+        (scheduling.volcano.sh/v1beta1: queue/priorityClassName/minResources)
+        or scheduler-plugins coscheduling (scheduling.x-k8s.io/v1alpha1:
+        minResources/scheduleTimeoutSeconds; queue and priorityClass are
+        volcano concepts with no coscheduling counterpart)."""
         total = sum(s.replicas or 0 for s in (job.replica_specs or {}).values())
         sp = job.run_policy.scheduling_policy
         min_member = total
-        queue = None
-        priority_class = None
-        min_resources = None
+        if sp is not None and sp.min_available is not None:
+            min_member = sp.min_available
+        coscheduling = self._gang_coscheduling()
+        pg_kind = "CoschedulingPodGroup" if coscheduling else "PodGroup"
+        spec: Dict[str, Any] = {"minMember": min_member}
         if sp is not None:
-            if sp.min_available is not None:
-                min_member = sp.min_available
-            queue = sp.queue
-            priority_class = sp.priority_class
-            min_resources = sp.min_resources
+            if sp.min_resources:
+                spec["minResources"] = sp.min_resources
+            if coscheduling:
+                if sp.schedule_timeout_seconds is not None:
+                    spec["scheduleTimeoutSeconds"] = sp.schedule_timeout_seconds
+            else:
+                if sp.queue:
+                    spec["queue"] = sp.queue
+                if sp.priority_class:
+                    spec["priorityClassName"] = sp.priority_class
+        # knobs the selected backend cannot express — warned symmetrically
+        # so no knob is ever dropped silently.  The warned values are
+        # latched in a PodGroup annotation (not gated on the rendered-spec
+        # diff: a foreign knob added to an already-synced job leaves the
+        # rendered spec identical), so the event fires once per change and
+        # survives controller restarts.
+        ignored = {}
+        if sp is not None:
+            if coscheduling:
+                if sp.queue:
+                    ignored["queue"] = sp.queue
+                if sp.priority_class:
+                    ignored["priorityClass"] = sp.priority_class
+            elif sp.schedule_timeout_seconds is not None:
+                ignored["scheduleTimeoutSeconds"] = sp.schedule_timeout_seconds
+        note = ",".join(f"{k}={v}" for k, v in sorted(ignored.items()))
         pg = {
-            "apiVersion": "scheduling.volcano.sh/v1beta1",
+            "apiVersion": ("scheduling.x-k8s.io/v1alpha1" if coscheduling
+                           else "scheduling.volcano.sh/v1beta1"),
             "kind": "PodGroup",
             "metadata": {
                 "name": job.name,
@@ -891,27 +944,43 @@ class JobEngine:
                     )
                 ],
             },
-            "spec": {"minMember": min_member},
+            "spec": spec,
         }
-        if queue:
-            pg["spec"]["queue"] = queue
-        if priority_class:
-            pg["spec"]["priorityClassName"] = priority_class
-        if min_resources:
-            pg["spec"]["minResources"] = min_resources
+        if note:
+            pg["metadata"]["annotations"] = {IGNORED_KNOBS_ANNOTATION: note}
         try:
-            existing = self.cluster.get("PodGroup", job.namespace, job.name)
-            if existing.get("spec") != pg["spec"]:
+            existing = self.cluster.get(pg_kind, job.namespace, job.name)
+            prev_note = (existing.get("metadata", {}).get("annotations", {})
+                         .get(IGNORED_KNOBS_ANNOTATION, ""))
+            if existing.get("spec") != pg["spec"] or prev_note != note:
                 existing["spec"] = pg["spec"]
-                self.cluster.update("PodGroup", existing)
-        except Exception:
-            self.cluster.create("PodGroup", pg)
+                ann = existing.setdefault("metadata", {}).setdefault(
+                    "annotations", {})
+                if note:
+                    ann[IGNORED_KNOBS_ANNOTATION] = note
+                else:
+                    ann.pop(IGNORED_KNOBS_ANNOTATION, None)
+                self.cluster.update(pg_kind, existing)
+        except NotFoundError:
+            prev_note = ""
+            self.cluster.create(pg_kind, pg)
+        if note and note != prev_note:
+            backend = ("the scheduler-plugins coscheduling backend"
+                       if coscheduling else "the volcano backend")
+            self.cluster.record_event(
+                job.to_dict(), "Warning", "GangSchedulingPolicy",
+                f"schedulingPolicy {{{note}}} cannot be expressed by "
+                f"{backend} and is ignored",
+            )
 
     def _delete_pod_group(self, job: Job) -> None:
-        try:
-            self.cluster.delete("PodGroup", job.namespace, job.name)
-        except Exception:
-            pass
+        # both backends' groups are tried: a --gang-scheduler-name flip
+        # mid-job must not orphan the previous backend's PodGroup
+        for pg_kind in ("PodGroup", "CoschedulingPodGroup"):
+            try:
+                self.cluster.delete(pg_kind, job.namespace, job.name)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ status io
     def _write_status(self, job: Job, old_status: common.JobStatus) -> None:

@@ -67,6 +67,12 @@ KIND_REGISTRY: Dict[str, KindInfo] = {
     "Service": KindInfo("", "v1", "services"),
     "Event": KindInfo("", "v1", "events"),
     "PodGroup": KindInfo("scheduling.volcano.sh", "v1beta1", "podgroups"),
+    # scheduler-plugins coscheduling gang backend: same k8s kind name
+    # (PodGroup) in a different API group — registered under a distinct
+    # registry key because routing here is by kind string
+    "CoschedulingPodGroup": KindInfo(
+        "scheduling.x-k8s.io", "v1alpha1", "podgroups"
+    ),
     "Lease": KindInfo("coordination.k8s.io", "v1", "leases"),
     # kinds the deploy tooling applies (tf_operator_tpu/deploy/cluster.py)
     "Namespace": KindInfo("", "v1", "namespaces", cluster_scoped=True),

@@ -187,7 +187,8 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, return_hidden: bool = False):
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
+                 positions=None):
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model,
@@ -199,7 +200,17 @@ class Transformer(nn.Module):
             (cfg.max_len, cfg.d_model),
             jnp.float32,
         )
-        x = embed(tokens) + pos_embed[None, : tokens.shape[1]].astype(cfg.dtype)
+        if positions is None:
+            pos = pos_embed[None, : tokens.shape[1]]
+        else:
+            # explicit global position ids ([S] or [B, S]) — the seam for
+            # permuted token layouts (ops/zigzag.py: the token stream is
+            # reordered once outside the step; the absolute position
+            # embedding must follow its token)
+            pos = pos_embed[positions]
+            if pos.ndim == 2:
+                pos = pos[None]
+        x = embed(tokens) + pos.astype(cfg.dtype)
         block = Block
         if cfg.remat:
             block = nn.remat(Block)

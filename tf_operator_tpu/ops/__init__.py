@@ -18,5 +18,7 @@ from tf_operator_tpu.ops.ring_attention import (  # noqa: F401
 )
 from tf_operator_tpu.ops.ring_flash import (  # noqa: F401
     make_ring_flash_attention_fn,
+    ring_flash_attention,
 )
 from tf_operator_tpu.ops.ulysses import make_ulysses_attention_fn  # noqa: F401
+from tf_operator_tpu.ops import zigzag  # noqa: F401

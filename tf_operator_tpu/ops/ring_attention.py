@@ -55,16 +55,33 @@ def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool):
     return o_new, m_new, l_new
 
 
+def _positions(idx, n, s_local, layout: str):
+    """[s_local] global position ids ring member `idx` holds."""
+    if layout == "zigzag":
+        from tf_operator_tpu.ops.zigzag import device_positions
+
+        return device_positions(idx, n, s_local)
+    return idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+
+
 def ring_attention(q, k, v, causal: bool = False, *,
-                   axis_name: str = "tp") -> jax.Array:
+                   axis_name: str = "tp",
+                   layout: str = "contiguous") -> jax.Array:
     """Attention over sequence shards. Call inside shard_map with q, k, v
     [B, S_local, H, D] sharded on dim 1 over `axis_name`. Differentiable
-    (ppermute transposes to the reverse rotation under autodiff)."""
+    (ppermute transposes to the reverse rotation under autodiff).
+    layout="zigzag" expects shards in zigzag storage order
+    (ops/zigzag.py) and masks by the matching global positions — the
+    balanced layout causal ring_flash exploits; here it only changes the
+    mask math (the einsum block is dense either way)."""
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
-    iota = jnp.arange(s_local, dtype=jnp.int32)
-    q_pos = my * s_local + iota
+    if layout == "zigzag" and s_local % 2:
+        raise ValueError(
+            f"layout='zigzag' needs an even per-member sequence, got "
+            f"S_local={s_local}")
+    q_pos = _positions(my, n, s_local, layout)
 
     o = jnp.zeros((b, s_local, h, d), jnp.float32)
     m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
@@ -73,7 +90,7 @@ def ring_attention(q, k, v, causal: bool = False, *,
     perm = [(i, (i + 1) % n) for i in range(n)]
     for step in range(n):
         src = jax.lax.rem(my - step + n, n)  # ring origin of resident KV
-        k_pos = src * s_local + iota
+        k_pos = _positions(src, n, s_local, layout)
         o, m, l = _merge_block(o, m, l, (q, kv[0], kv[1]),
                                (q_pos, k_pos), causal)
         if step < n - 1:
@@ -84,7 +101,8 @@ def ring_attention(q, k, v, causal: bool = False, *,
 
 
 def make_ring_attention_fn(mesh: Mesh, axis_name: str = "tp",
-                           batch_axes=("dcn", "dp", "fsdp")):
+                           batch_axes=("dcn", "dp", "fsdp"),
+                           layout: str = "contiguous"):
     """An attention_fn for models/transformer.TransformerConfig: shard_maps
     [B, S, H, D] inputs with S over `axis_name` and runs ring_attention.
     Nesting inside the outer jit is fine; XLA overlaps the ppermute hops
@@ -95,7 +113,7 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = "tp",
 
     def attention_fn(q, k, v, causal: bool) -> jax.Array:
         inner = functools.partial(ring_attention, causal=causal,
-                                  axis_name=axis_name)
+                                  axis_name=axis_name, layout=layout)
         return shard_map(
             inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False,

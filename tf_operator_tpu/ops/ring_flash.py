@@ -48,24 +48,35 @@ from tf_operator_tpu.ops.flash_attention import (
 POS_INF = 1e30
 
 
-def _global_mask(q_off, k_off, q_start, k_start, blk_q: int, blk_k: int):
-    """[blk_q, blk_k] bool — global q id >= global k id."""
-    q_ids = q_off + q_start + jax.lax.broadcasted_iota(
-        jnp.int32, (blk_q, blk_k), 0)
-    k_ids = k_off + k_start + jax.lax.broadcasted_iota(
-        jnp.int32, (blk_q, blk_k), 1)
+def _global_mask(q_g, k_g, blk_q: int, blk_k: int):
+    """[blk_q, blk_k] bool — global q id >= global k id, given the tile's
+    global start ids."""
+    q_ids = q_g + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    k_ids = k_g + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
     return q_ids >= k_ids
+
+
+def _tile_global_start(off_ref, start, s_half: int):
+    """Global id of a tile's first row under the two-chunk layout:
+    off_ref is [2, 1] SMEM — global start of the shard's first and second
+    half-chunk.  Contiguous shards set off[1] = off[0] + s_half, which
+    makes this exact even for tiles straddling the halves; zigzag shards
+    have discontiguous halves, so callers guarantee tiles divide
+    s_half."""
+    return jnp.where(start < s_half, off_ref[0, 0] + start,
+                     off_ref[1, 0] + start - s_half)
 
 
 # ---------------------------------------------------------------- forward
 def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
                       acc_in, m_out, l_out, acc_out, m_scr, l_scr, acc_scr,
-                      *, causal: bool, scale: float, n_kv: int):
+                      *, causal: bool, scale: float, n_kv: int, s_half: int):
     blk_q, d = q_ref.shape[1], q_ref.shape[2]
     blk_k = k_ref.shape[1]
     j, t = pl.program_id(1), pl.program_id(2)
     q_start, k_start = j * blk_q, t * blk_k
-    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+    q_g = _tile_global_start(qo_ref, q_start, s_half)
+    k_g = _tile_global_start(ko_ref, k_start, s_half)
 
     @pl.when(t == 0)
     def _init():
@@ -75,7 +86,7 @@ def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
 
     if causal:
         # skip KV tiles whose FIRST global key id is past the last query id
-        live = k_off + k_start <= q_off + q_start + blk_q - 1
+        live = k_g <= q_g + blk_q - 1
     else:
         live = t >= 0
 
@@ -84,9 +95,7 @@ def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
         q = q_ref[0]
         s = _dot(q, k_ref[0], ((1,), (1,))) * scale  # [blk_q, blk_k] f32
         if causal:
-            s = jnp.where(
-                _global_mask(q_off, k_off, q_start, k_start, blk_q, blk_k),
-                s, NEG_INF)
+            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k), s, NEG_INF)
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -110,7 +119,8 @@ def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
 def _carry_fwd_call(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
                     blk_q: int, blk_k: int, interpret: bool):
     """One ring step. q,k,v [BH,S,D]; m,l [BH,S,1] f32; acc [BH,S,D] f32;
-    q_off/k_off [1,1] int32. Returns updated (m, l, acc)."""
+    q_off/k_off [2,1] int32 (per-half-chunk global starts). Returns
+    updated (m, l, acc)."""
     bh, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_kv = s // blk_k
@@ -123,7 +133,7 @@ def _carry_fwd_call(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
     vec_tile = pl.BlockSpec((1, blk_q, 1), lambda i, j, t: (i, j, 0))
     return pl.pallas_call(
         functools.partial(_carry_fwd_kernel, causal=causal, scale=scale,
-                          n_kv=n_kv),
+                          n_kv=n_kv, s_half=s // 2),
         grid=grid,
         in_specs=[off, off, q_tile, kv_tile, kv_tile, vec_tile, vec_tile,
                   q_tile],
@@ -146,19 +156,20 @@ def _carry_fwd_call(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
 # --------------------------------------------------------------- backward
 def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dq_ref, dq_scr, *, causal: bool,
-                    scale: float, n_kv: int):
+                    scale: float, n_kv: int, s_half: int):
     blk_q, d = q_ref.shape[1], q_ref.shape[2]
     blk_k = k_ref.shape[1]
     j, t = pl.program_id(1), pl.program_id(2)
     q_start, k_start = j * blk_q, t * blk_k
-    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+    q_g = _tile_global_start(qo_ref, q_start, s_half)
+    k_g = _tile_global_start(ko_ref, k_start, s_half)
 
     @pl.when(t == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     if causal:
-        live = k_off + k_start <= q_off + q_start + blk_q - 1
+        live = k_g <= q_g + blk_q - 1
     else:
         live = t >= 0
 
@@ -169,9 +180,7 @@ def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k_tile = k_ref[0]
         s = _dot(q, k_tile, ((1,), (1,))) * scale
         if causal:
-            s = jnp.where(
-                _global_mask(q_off, k_off, q_start, k_start, blk_q, blk_k),
-                s, NEG_INF)
+            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k), s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         dp = _dot(do, v_ref[0], ((1,), (1,)))
         ds = (p * (dp - delta_ref[0, :, 0][:, None])).astype(k_tile.dtype)
@@ -184,12 +193,13 @@ def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                      delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                     causal: bool, scale: float, n_q: int):
+                     causal: bool, scale: float, n_q: int, s_half: int):
     blk_k, d = k_ref.shape[1], k_ref.shape[2]
     blk_q = q_ref.shape[1]
     t, j = pl.program_id(1), pl.program_id(2)  # t: kv tile, j: streamed q
     q_start, k_start = j * blk_q, t * blk_k
-    q_off, k_off = qo_ref[0, 0], ko_ref[0, 0]
+    q_g = _tile_global_start(qo_ref, q_start, s_half)
+    k_g = _tile_global_start(ko_ref, k_start, s_half)
 
     @pl.when(j == 0)
     def _init():
@@ -197,7 +207,7 @@ def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     if causal:
-        live = q_off + q_start + blk_q - 1 >= k_off + k_start
+        live = q_g + blk_q - 1 >= k_g
     else:
         live = j >= 0
 
@@ -208,9 +218,7 @@ def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k_tile = k_ref[0]
         s = _dot(q, k_tile, ((1,), (1,))) * scale
         if causal:
-            s = jnp.where(
-                _global_mask(q_off, k_off, q_start, k_start, blk_q, blk_k),
-                s, NEG_INF)
+            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k), s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         dv_scr[:] = dv_scr[:] + _dot(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot(do, v_ref[0], ((1,), (1,)))
@@ -236,7 +244,7 @@ def _bwd_step_call(q, k, v, do, lse, delta, q_off, k_off, *, causal: bool,
     kv_tile = pl.BlockSpec((1, blk_k, d), lambda i, j, t: (i, t, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_ring_kernel, causal=causal, scale=scale,
-                          n_kv=n_kv),
+                          n_kv=n_kv, s_half=s // 2),
         grid=(bh, n_q, n_kv),
         in_specs=[off, off, q_tile, kv_tile, kv_tile, q_tile, q_vec, q_vec],
         out_specs=q_tile,
@@ -252,7 +260,7 @@ def _bwd_step_call(q, k, v, do, lse, delta, q_off, k_off, *, causal: bool,
     off2 = pl.BlockSpec(memory_space=pltpu.SMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_ring_kernel, causal=causal, scale=scale,
-                          n_q=n_q),
+                          n_q=n_q, s_half=s // 2),
         grid=(bh, n_kv, n_q),
         in_specs=[off2, off2, q_stream, kv_fixed, kv_fixed, q_stream,
                   qv_stream, qv_stream],
@@ -272,11 +280,24 @@ def _bwd_step_call(q, k, v, do, lse, delta, q_off, k_off, *, causal: bool,
 
 
 # ------------------------------------------------------------------- ring
-def _offsets(idx, s_local):
-    return (idx * s_local).astype(jnp.int32).reshape(1, 1)
+def _offsets(idx, n, s_local, layout: str):
+    """[2, 1] int32 — global start ids of ring member `idx`'s two
+    half-chunks.  Contiguous shards are expressed as two adjacent halves
+    (off[1] = off[0] + s_half), which _tile_global_start folds back into
+    plain `offset + position` math; zigzag gives the member chunks
+    (idx, 2n-1-idx) of the 2n global chunks (ops/zigzag.py)."""
+    half = s_local // 2
+    if layout == "zigzag":
+        first = idx * half
+        second = (2 * n - 1 - idx) * half
+    else:
+        first = idx * s_local
+        second = first + half
+    return jnp.stack([first, second]).astype(jnp.int32).reshape(2, 1)
 
 
-def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
+def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
+                   layout):
     """q,k,v [BH, S_l, D] (inside shard_map). Returns (out, lse)."""
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -284,7 +305,7 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
     m = jnp.full((bh, s_l, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((bh, s_l, 1), jnp.float32)
     acc = jnp.zeros((bh, s_l, d), jnp.float32)
-    q_off = _offsets(my, s_l)
+    q_off = _offsets(my, n, s_l, layout)
     kv = (k, v)
     perm = [(i, (i + 1) % n) for i in range(n)]
     for step in range(n):
@@ -293,14 +314,20 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
         def live_step(carry, kv=kv, src=src):
             m, l, acc = carry
             return _carry_fwd_call(
-                q, kv[0], kv[1], m, l, acc, q_off, _offsets(src, s_l),
+                q, kv[0], kv[1], m, l, acc, q_off,
+                _offsets(src, n, s_l, layout),
                 causal=causal, blk_q=blk_q, blk_k=blk_k,
                 interpret=interpret)
 
-        if causal and step > 0:
+        if causal and step > 0 and layout != "zigzag":
             # a resident shard entirely in the future (src > my) has every
             # tile masked — skip the kernel so the (m, l, acc) carry does
-            # not round-trip HBM for zero work (~half the causal hops)
+            # not round-trip HBM for zero work (~half the causal hops).
+            # Under zigzag every hop carries live work BY DESIGN (each
+            # member's late chunk attends every other member's early
+            # chunk) — the balancing that makes per-step wall time equal
+            # the mean instead of the max; the dead HALF-chunks are
+            # skipped tile-by-tile inside the kernel instead.
             m, l, acc = jax.lax.cond(
                 src <= my, live_step, lambda c: c, (m, l, acc))
         else:
@@ -314,20 +341,23 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
     return out, lse  # lse [BH, S_l, 1] — the shape the bwd kernels read
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
+                layout):
     out, _ = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
-                            interpret)
+                            interpret, layout)
     return out
 
 
-def _ring_flash_fwd(q, k, v, causal, axis_name, blk_q, blk_k, interpret):
+def _ring_flash_fwd(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
+                    layout):
     out, lse = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
-                              interpret)
+                              interpret, layout)
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, res, do):
+def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
+                    res, do):
     q, k, v, out, lse = res
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -335,7 +365,7 @@ def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, res, do):
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)[:, :, None]
     lse3 = lse  # already [BH, S_l, 1]
-    q_off = _offsets(my, s_l)
+    q_off = _offsets(my, n, s_l, layout)
     dq = jnp.zeros((bh, s_l, d), jnp.float32)
     # (k, v, dk, dv) rotate together: after n hops every shard has
     # collected contributions from every q shard and is home again
@@ -350,11 +380,11 @@ def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, res, do):
             dq, dk_res, dv_res = carry
             dq_c, dk_c, dv_c = _bwd_step_call(
                 q, k_res, v_res, do, lse3, delta, q_off,
-                _offsets(src, s_l), causal=causal, blk_q=blk_q,
+                _offsets(src, n, s_l, layout), causal=causal, blk_q=blk_q,
                 blk_k=blk_k, interpret=interpret)
             return dq + dq_c, dk_res + dk_c, dv_res + dv_c
 
-        if causal and step > 0:
+        if causal and step > 0 and layout != "zigzag":
             # mirror the forward: dead hops (src > my) contribute nothing
             dq, dk_res, dv_res = jax.lax.cond(
                 src <= my, live_step, lambda c: c, (dq, dk_res, dv_res))
@@ -372,20 +402,37 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 def ring_flash_attention(q, k, v, causal: bool = False, *,
                          axis_name: str = "tp", blk_q: int = 512,
                          blk_k: int = 512,
-                         interpret: Optional[bool] = None) -> jax.Array:
+                         interpret: Optional[bool] = None,
+                         layout: str = "contiguous") -> jax.Array:
     """Sequence-parallel flash attention. Call inside shard_map with
     q, k, v [B, S_local, H, D] sharded on dim 1 over `axis_name`.
-    Falls back to the einsum ring when S_local has no 128-aligned block."""
+    Falls back to the einsum ring when S_local has no 128-aligned block.
+
+    layout="zigzag" expects shards in zigzag storage order (ops/zigzag.py:
+    device i holds global chunks i and 2n-1-i): causal tile-skipping then
+    drops ~half the work on EVERY device uniformly instead of idling the
+    early ring members — ~2x causal wall-clock at large ring sizes."""
     b, s_l, h, d = q.shape
     # _snap_block returns s_l itself when s_l <= blk even if unaligned —
     # a block equal to the full array dim is Mosaic-legal (the documented
     # "divisible by (8, 128) or equal to the full dim" rule, same contract
-    # the single-chip kernel relies on)
-    bq, bk = _snap_block(blk_q, s_l), _snap_block(blk_k, s_l)
+    # the single-chip kernel relies on).  Zigzag shards are two
+    # discontiguous half-chunks, so tiles must divide the HALF (a tile
+    # straddling the halves would need two global offsets at once).
+    if layout == "zigzag" and s_l % 2:
+        # the einsum fallback can't represent an odd-length zigzag shard
+        # either (2 equal half-chunks per member) — fail with the real
+        # constraint instead of a shape error deep in the ring
+        raise ValueError(
+            f"layout='zigzag' needs an even per-member sequence, got "
+            f"S_local={s_l}")
+    snap_s = s_l // 2 if layout == "zigzag" else s_l
+    bq, bk = _snap_block(blk_q, snap_s), _snap_block(blk_k, snap_s)
     if bq is None or bk is None:
         from tf_operator_tpu.ops.ring_attention import ring_attention
 
-        return ring_attention(q, k, v, causal, axis_name=axis_name)
+        return ring_attention(q, k, v, causal, axis_name=axis_name,
+                              layout=layout)
     if interpret is None:
         interpret = _use_interpret()
 
@@ -393,15 +440,18 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_l, d)
 
     out = _ring_flash(to_bh(q), to_bh(k), to_bh(v), causal, axis_name,
-                      bq, bk, bool(interpret))
+                      bq, bk, bool(interpret), layout)
     return out.reshape(b, h, s_l, d).transpose(0, 2, 1, 3)
 
 
 def make_ring_flash_attention_fn(mesh: Mesh, axis_name: str = "tp",
                                  batch_axes=("dcn", "dp", "fsdp"),
-                                 interpret: Optional[bool] = None):
+                                 interpret: Optional[bool] = None,
+                                 layout: str = "contiguous"):
     """An attention_fn for models/transformer.TransformerConfig — drop-in
-    for make_ring_attention_fn with the fused per-step kernel."""
+    for make_ring_attention_fn with the fused per-step kernel.  With
+    layout="zigzag" the token stream must be permuted into zigzag storage
+    order once outside the step (ops/zigzag.to_storage)."""
     from tf_operator_tpu.parallel.compat import shard_map
 
     spec = P(batch_axes, axis_name, None, None)
@@ -409,7 +459,7 @@ def make_ring_flash_attention_fn(mesh: Mesh, axis_name: str = "tp",
     def attention_fn(q, k, v, causal: bool) -> jax.Array:
         inner = functools.partial(
             ring_flash_attention, causal=causal, axis_name=axis_name,
-            interpret=interpret)
+            interpret=interpret, layout=layout)
         return shard_map(
             inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False,

@@ -1,0 +1,82 @@
+"""Zigzag (load-balanced) sequence layout for causal ring attention.
+
+With contiguous sequence shards, causal ring attention is imbalanced:
+at ring step t only devices `my >= t` hold unmasked work, and each step
+is synchronized by the `ppermute` rotation, so wall time is set by the
+busiest device — ~n full block-passes even though half the score matrix
+is masked.  The zigzag layout (used by public ring-attention
+implementations for exactly this reason; sometimes called "striped" in
+its finer-grained form) splits the sequence into 2n chunks and gives
+device i chunks (i, 2n-1-i) — one early chunk and one late chunk.  Every
+(device, step) pair then carries ~the same two live quarter-blocks of
+causal work, the per-step maximum equals the mean, and the causal ring
+runs in ~n/2 block-passes: a ~2x wall-clock win that grows with ring
+size.
+
+Positions are no longer `offset + iota` per shard, so the layout ships
+as (a) per-device global-position math for the einsum ring and the
+two-offset pallas ring (ops/ring_attention.py, ops/ring_flash.py), and
+(b) host-side permutations mapping logical token order <-> zigzag
+storage order.  The permutation is applied ONCE to the token stream
+outside the step function — attention is the only position-dependent op
+inside the transformer, so the rest of the network runs obliviously on
+permuted rows.  Two things must ride the permutation with the tokens:
+absolute position ids (pass `positions=storage_perm(n, S)` to
+models/transformer.Transformer so each token keeps its logical
+embedding) and labels — and any next-token SHIFT must be taken in
+LOGICAL order first ("next" in storage order is a different token), i.e.
+shift-then-permute, never permute-then-shift.
+
+No reference counterpart (SURVEY.md §5.7: the reference has no
+long-context support at all).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def chunk_ids(n: int):
+    """Per-device (early, late) chunk ids: device i of n holds chunks
+    (i, 2n-1-i) of the 2n equal chunks."""
+    return [(i, 2 * n - 1 - i) for i in range(n)]
+
+
+def device_positions(idx, n: int, s_local: int):
+    """[s_local] global (logical) position ids held by ring member `idx`
+    (traced or static) under the zigzag layout."""
+    c = s_local // 2
+    i = jnp.arange(c, dtype=jnp.int32)
+    return jnp.concatenate([idx * c + i, (2 * n - 1 - idx) * c + i])
+
+
+def storage_perm(n: int, s: int) -> np.ndarray:
+    """perm such that `x[perm]` reorders a logical-order [S, ...] array
+    into zigzag storage order: contiguous equal sharding of the result
+    over n devices gives device i chunks (i, 2n-1-i)."""
+    if s % (2 * n):
+        raise ValueError(f"sequence {s} not divisible by 2*n = {2 * n}")
+    c = s // (2 * n)
+    order = []
+    for i, (a, b) in enumerate(chunk_ids(n)):
+        order.extend(range(a * c, (a + 1) * c))
+        order.extend(range(b * c, (b + 1) * c))
+    return np.asarray(order, dtype=np.int32)
+
+
+def inverse_perm(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size, dtype=perm.dtype)
+    return inv
+
+
+def to_storage(x, n: int, axis: int = 1):
+    """Gather a logical-order array into zigzag storage order along
+    `axis` (host-level; do this once per batch, not per layer)."""
+    return jnp.take(x, jnp.asarray(storage_perm(n, x.shape[axis])), axis=axis)
+
+
+def from_storage(x, n: int, axis: int = 1):
+    """Inverse of `to_storage`."""
+    perm = storage_perm(n, x.shape[axis])
+    return jnp.take(x, jnp.asarray(inverse_perm(perm)), axis=axis)

@@ -137,6 +137,12 @@ class Profiler:
             if step >= self.trace_start_step:
                 self.start_trace()
                 self._trace_started_at = step
+        elif self._trace_started_at is None:
+            # the window was opened externally (trace_window()/start_trace()
+            # around the whole run) — adopt the current step as its origin
+            # so the bounded stop below still applies instead of crashing
+            # on None arithmetic
+            self._trace_started_at = step
         elif step >= self._trace_started_at + self.trace_num_steps:
             self.stop_trace()
             self._trace_done = True

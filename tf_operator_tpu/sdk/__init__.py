@@ -1,5 +1,7 @@
 """User-facing client SDK (reference sdk/python/kubeflow/tfjob — SURVEY.md
-§2.6)."""
+§2.6).  `models` carries the typed, OpenAPI-generated model classes (the
+analogue of the reference's sdk/python/kubeflow/tfjob/models/)."""
+from tf_operator_tpu.sdk import models
 from tf_operator_tpu.sdk.client import JobClient, TFJobClient, TPUJobClient
 
-__all__ = ["JobClient", "TFJobClient", "TPUJobClient"]
+__all__ = ["JobClient", "TFJobClient", "TPUJobClient", "models"]
