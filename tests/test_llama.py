@@ -1,0 +1,260 @@
+"""LLaMA-family model (models/llama.py): RoPE properties, GQA vs a dense
+reference, flash/ring attention drop-in parity, and tp/fsdp/dp sharded
+train-step parity against the unsharded run."""
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from tf_operator_tpu.models import llama
+from tf_operator_tpu.models.transformer import lm_loss
+from tf_operator_tpu.parallel.mesh import make_mesh
+from tf_operator_tpu.parallel.tp import state_sharding
+from tf_operator_tpu.runtime.train import create_train_state
+
+
+def _f32(**kw):
+    kw.setdefault("dtype", jnp.float32)
+    return llama.tiny(**kw)
+
+
+def _tokens(cfg, batch=2, seed=0):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (batch, cfg.max_len), 0, cfg.vocab_size
+    )
+
+
+# ------------------------------------------------------------------ rotary
+def test_rope_preserves_norm():
+    angles = llama.rope_table(16, 8, 10000.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 8))
+    rx = llama.apply_rope(x, angles)
+    assert rx.shape == x.shape
+    assert jnp.allclose(
+        jnp.linalg.norm(rx, axis=-1), jnp.linalg.norm(x, axis=-1), atol=1e-5
+    )
+
+
+def test_rope_scores_depend_on_relative_position_only():
+    """<R(p)q, R(p+d)k> must equal <R(p')q, R(p'+d)k> for any base p, p'."""
+    head_dim, delta = 8, 3
+    table = llama.rope_table(64, head_dim, 10000.0)
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, head_dim))
+    k = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, head_dim))
+
+    def score(base):
+        rq = llama.apply_rope(q, table[base: base + 1])
+        rk = llama.apply_rope(k, table[base + delta: base + delta + 1])
+        return float(jnp.sum(rq * rk))
+
+    assert abs(score(0) - score(17)) < 1e-4
+    assert abs(score(5) - score(40)) < 1e-4
+
+
+def test_rope_position_zero_is_identity():
+    table = llama.rope_table(4, 8, 10000.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 8))
+    assert jnp.allclose(llama.apply_rope(x, table[:1]), x, atol=1e-6)
+
+
+def test_explicit_positions_match_default():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    toks = _tokens(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    base = model.apply({"params": params}, toks)
+    pos = jnp.arange(cfg.max_len)
+    explicit = model.apply({"params": params}, toks, positions=pos)
+    assert jnp.allclose(base, explicit, atol=1e-6)
+
+
+# -------------------------------------------------------------------- gqa
+def _dense_gqa_reference(q, k, v):
+    """Per-head causal attention with each kv head explicitly indexed by
+    its query group — independent math to check the broadcast path."""
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    group = h // kv
+    outs = []
+    for head in range(h):
+        qi = q[:, :, head].astype(jnp.float32)
+        ki = k[:, :, head // group].astype(jnp.float32)
+        vi = v[:, :, head // group].astype(jnp.float32)
+        scores = qi @ ki.transpose(0, 2, 1) / jnp.sqrt(d)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, -1e30)
+        outs.append(jax.nn.softmax(scores, axis=-1) @ vi)
+    return jnp.stack(outs, axis=2)
+
+
+def test_gqa_broadcast_matches_dense_reference():
+    b, s, h, kv, d = 2, 8, 4, 2, 6
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+    ref = _dense_gqa_reference(q, k, v)
+    from tf_operator_tpu.models.transformer import dot_product_attention
+
+    got = dot_product_attention(
+        q, jnp.repeat(k, h // kv, axis=2), jnp.repeat(v, h // kv, axis=2), True
+    )
+    assert jnp.allclose(got, ref, atol=1e-5), float(jnp.abs(got - ref).max())
+
+
+def test_mha_config_is_gqa_with_group_one():
+    """n_kv_heads == n_heads must behave as plain MHA (group size 1 path)."""
+    cfg = _f32(n_kv_heads=4)
+    assert cfg.q_per_kv == 1
+    model = llama.Llama(cfg)
+    toks = _tokens(cfg)
+    logits = model.init_with_output(
+        jax.random.PRNGKey(0), toks, train=False
+    )[0]
+    assert logits.shape == (*toks.shape, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_param_shapes_and_flops():
+    cfg = llama.tiny()
+    model = llama.Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), _tokens(cfg), train=False
+    )["params"]
+    blk = params["block0"]
+    assert blk["attn"]["wq"]["kernel"].shape == (64, 4, 16)
+    assert blk["attn"]["wkv"]["kernel"].shape == (64, 2, 2, 16)
+    assert blk["attn"]["out"]["kernel"].shape == (4, 16, 64)
+    assert blk["mlp"]["wi"]["kernel"].shape == (64, 2, 128)
+    assert blk["mlp"]["wo"]["kernel"].shape == (128, 64)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    # flops accounting covers matmul params (excludes rmsnorm scales);
+    # untied lm_head doubles the embed term relative to the tied count
+    approx = llama.params_flops_per_token(cfg) / 6.0
+    approx += cfg.vocab_size * cfg.d_model  # lm_head (untied default)
+    assert abs(n_params - approx) / n_params < 0.01
+
+
+def test_factory_configs_validate():
+    assert llama.llama_7b().q_per_kv == 1
+    assert llama.llama3_8b().q_per_kv == 4
+    with pytest.raises(ValueError):
+        llama.tiny(n_kv_heads=3)
+    with pytest.raises(ValueError):
+        llama.tiny(d_model=65)
+
+
+# ------------------------------------------------------------ attention fns
+def test_flash_attention_drop_in_parity():
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    cfg = _f32(max_len=256)
+    toks = _tokens(cfg)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    ref = model.apply({"params": params}, toks)
+    flash_model = llama.Llama(
+        llama.tiny(dtype=jnp.float32, max_len=256, attention_fn=flash_attention)
+    )
+    got = flash_model.apply({"params": params}, toks)
+    assert jnp.allclose(got, ref, atol=2e-3), float(jnp.abs(got - ref).max())
+
+
+def test_ring_attention_drop_in_parity():
+    """Ring attention over tp=2 (sequence parallel) on the sharded model
+    must match the single-device einsum run."""
+    devices = jax.devices()[:2]
+    mesh = make_mesh({"tp": 2}, devices=devices)
+    from tf_operator_tpu.ops.ring_attention import make_ring_attention_fn
+
+    cfg = _f32()
+    toks = _tokens(cfg)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    ref = model.apply({"params": params}, toks)
+    ring_model = llama.Llama(
+        _f32(attention_fn=make_ring_attention_fn(mesh, axis_name="tp"))
+    )
+    with mesh:
+        got = jax.jit(
+            lambda p, t: ring_model.apply({"params": p}, t)
+        )(params, toks)
+    assert jnp.allclose(got, ref, atol=2e-3), float(jnp.abs(got - ref).max())
+
+
+# --------------------------------------------------------------- sharding
+def test_tp_fsdp_dp_train_step_parity():
+    """One adam step over a tp=2 x fsdp=2 x dp=2 mesh must match the
+    unsharded single-device step (loss + grad global norm)."""
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest forces an 8-device CPU mesh"
+    mesh = make_mesh({"tp": 2, "fsdp": 2, "dp": 2}, devices=devices[:8])
+    mesh1 = make_mesh({}, devices=devices[:1])
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    toks = _tokens(cfg, batch=8)
+
+    def one_step(m):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rng = jax.random.PRNGKey(0)
+        state = create_train_state(rng, model, toks, optax.adam(1e-3))
+        st_sh = state_sharding(state, m)
+        state = jax.device_put(state, st_sh)
+        batch_sh = NamedSharding(m, P(("dp", "fsdp"), None))
+        t = jax.device_put(toks, batch_sh)
+
+        def train_step(state, t):
+            def loss_fn(p):
+                return lm_loss(model.apply({"params": p}, t), t)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads), loss, optax.global_norm(grads)
+
+        step = jax.jit(
+            train_step, in_shardings=(st_sh, batch_sh), donate_argnums=(0,)
+        )
+        state, loss, gnorm = step(state, t)
+        return float(loss), float(gnorm)
+
+    loss, gnorm = one_step(mesh)
+    loss1, gnorm1 = one_step(mesh1)
+    assert abs(loss - loss1) / abs(loss1) < 1e-4, (loss, loss1)
+    assert abs(gnorm - gnorm1) / abs(gnorm1) < 1e-3, (gnorm, gnorm1)
+
+
+def test_tp_shards_llama_params():
+    mesh = make_mesh({"tp": 2, "fsdp": 2, "dp": 2}, devices=jax.devices()[:8])
+    cfg = llama.tiny(d_ff=256)
+    model = llama.Llama(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), _tokens(cfg), train=False
+    )["params"]
+    from tf_operator_tpu.parallel.tp import transformer_param_sharding
+
+    sh = transformer_param_sharding(params, mesh, min_fsdp_size=0)
+    blk = sh["block0"]
+    assert "tp" in blk["attn"]["wq"]["kernel"].spec
+    assert blk["attn"]["wq"]["kernel"].spec[1] == "tp"
+    assert blk["attn"]["wkv"]["kernel"].spec[2] == "tp"
+    assert blk["attn"]["out"]["kernel"].spec[0] == "tp"
+    assert blk["mlp"]["wi"]["kernel"].spec[2] == "tp"
+    assert blk["mlp"]["wo"]["kernel"].spec[0] == "tp"
+
+
+# ------------------------------------------------------------- blocked CE
+def test_blocked_ce_hidden_seam():
+    """return_hidden + tied embedding + blocked CE == full-logits loss."""
+    from tf_operator_tpu.ops.blocked_ce import blocked_cross_entropy
+
+    cfg = _f32(tie_embeddings=True)
+    model = llama.Llama(cfg)
+    toks = _tokens(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    full = lm_loss(model.apply({"params": params}, toks), toks)
+    hidden = model.apply({"params": params}, toks, return_hidden=True)
+    w = params["embed"]["embedding"].T.astype(jnp.float32)
+    x = hidden[:, :-1].reshape(-1, cfg.d_model).astype(jnp.float32)
+    labels = toks[:, 1:].reshape(-1)
+    blocked = blocked_cross_entropy(x, w, labels, chunk=128)
+    assert abs(float(full) - float(blocked)) < 1e-5

@@ -1,0 +1,248 @@
+"""LLaMA-class decoder LM — RoPE + GQA + SwiGLU + RMSNorm.
+
+Beyond-reference [+]: the reference's ladder tops out at BERT-large and
+T5-3B (SURVEY.md §6; reference examples only ship estimator/Keras-era
+models); this adds the modern decoder family so the framework covers the
+architectures users actually train today, wired to the same TPU seams as
+models/transformer.py:
+
+- attention is pluggable through the (q, k, v, causal) contract, so the
+  pallas flash kernel (ops/flash_attention.py), ring sequence parallelism
+  (ops/ring_attention.py), and Ulysses all drop in; RoPE is applied BEFORE
+  the attention_fn, so every backend sees post-rotary q/k and needs no
+  position awareness of its own.
+- rotary embeddings take explicit `positions` ids — the seam the zigzag
+  causal ring layout (ops/zigzag.py) uses to permute tokens while keeping
+  each token's rotation tied to its global position.
+- GQA shares one K/V head across `n_heads // n_kv_heads` query heads; the
+  kv heads are broadcast to full head count just before the attention
+  contraction (inside the jit — XLA commonly fuses the broadcast into the
+  first score matmul, and the projection/grad savings, which is where GQA
+  helps a *training* step, are realized regardless).
+- bf16 compute / f32 params, static shapes, fused [2, F] SwiGLU gate+up
+  matmul and fused [2, KV, D] K/V projection (fewer, larger MXU calls).
+- `return_hidden` exposes the pre-logits hidden states so
+  ops/blocked_ce.py can fuse the lm-head matmul into the loss without a
+  [B, S, V] materialization at large vocab.
+
+Sharding: parallel/tp.py places wq/wkv column-parallel over tp, attention
+out and SwiGLU wo row-parallel, embedding vocab-parallel — one tp
+all-reduce per block, same rule table as the transformer family.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    n_layers: int = 32
+    d_ff: int = 11008
+    max_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    # None -> reference einsum; or ops/flash_attention.flash_attention /
+    # ops/ring_attention.make_ring_attention_fn(...) — called with
+    # post-RoPE (q, k, v, causal=True)
+    attention_fn: Optional[Callable] = None
+    remat: bool = False  # jax.checkpoint each block
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model {self.d_model} not divisible by n_heads {self.n_heads}"
+            )
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads {self.n_heads} not divisible by "
+                f"n_kv_heads {self.n_kv_heads}"
+            )
+        if self.head_dim % 2:
+            raise ValueError(f"head_dim {self.head_dim} must be even for RoPE")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+def _config(base: dict, kw: dict) -> LlamaConfig:
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def llama_7b(**kw) -> LlamaConfig:
+    """7B-class: MHA-era layout (n_kv_heads == n_heads)."""
+    return _config(dict(
+        vocab_size=32000, d_model=4096, n_heads=32, n_kv_heads=32,
+        n_layers=32, d_ff=11008, max_len=2048,
+    ), kw)
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    """8B-class: GQA 4:1, larger vocab, theta=500k long-context base."""
+    return _config(dict(
+        vocab_size=128256, d_model=4096, n_heads=32, n_kv_heads=8,
+        n_layers=32, d_ff=14336, max_len=8192, rope_theta=500000.0,
+    ), kw)
+
+
+def tiny(**kw) -> LlamaConfig:
+    return _config(dict(
+        vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2,
+        n_layers=2, d_ff=128, max_len=64,
+    ), kw)
+
+
+# ------------------------------------------------------------------ rotary
+def rope_table(max_len: int, head_dim: int, theta: float) -> jax.Array:
+    """[max_len, head_dim/2] rotation angles: pos / theta^(2i/d)."""
+    inv_freq = theta ** (
+        -jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    )
+    return jnp.arange(max_len, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate [B, S, H, D] by per-position angles [S, D/2] or [B, S, D/2].
+
+    Pairs (x[2i], x[2i+1]) via the split-halves convention (rotate_half):
+    elementwise VPU work that XLA fuses into the adjacent projection.
+    Rotation happens in f32 (small-angle differences vanish in bf16) and
+    returns in the input dtype for the MXU contraction that follows.
+    """
+    cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    if angles.ndim == 2:  # [S, D/2] -> broadcast over batch
+        cos, sin = cos[None], sin[None]
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    out = jnp.concatenate((x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1)
+    return out.astype(x.dtype)
+
+
+# ------------------------------------------------------------------ modules
+class GqaAttention(nn.Module):
+    """Grouped-query attention with rotary embeddings."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, angles):
+        cfg = self.cfg
+        dense = functools.partial(
+            nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
+        )
+        q = dense(features=(cfg.n_heads, cfg.head_dim), name="wq")(x)
+        # fused K/V: one [E, 2*KV*D] MXU matmul -> [B, S, 2, KV, D]
+        kv = dense(features=(2, cfg.n_kv_heads, cfg.head_dim), name="wkv")(x)
+        k, v = kv[:, :, 0], kv[:, :, 1]
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+        if cfg.q_per_kv > 1:
+            # share each kv head across the query group; XLA fuses the
+            # broadcast into the score contraction
+            k = jnp.repeat(k, cfg.q_per_kv, axis=2)
+            v = jnp.repeat(v, cfg.q_per_kv, axis=2)
+        attn = cfg.attention_fn or _einsum_attention
+        out = attn(q, k, v, True)
+        return dense(
+            features=cfg.d_model, axis=(-2, -1), name="out"
+        )(out)
+
+
+def _einsum_attention(q, k, v, causal: bool) -> jax.Array:
+    from tf_operator_tpu.models.transformer import dot_product_attention
+
+    return dot_product_attention(q, k, v, causal)
+
+
+class SwiGlu(nn.Module):
+    """silu(x W_gate) * (x W_up) -> W_down, gate+up fused as [2, F]."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.DenseGeneral(
+            features=(2, cfg.d_ff), dtype=cfg.dtype, use_bias=False, name="wi"
+        )(x)
+        h = nn.silu(h[..., 0, :]) * h[..., 1, :]
+        return nn.Dense(
+            cfg.d_model, dtype=cfg.dtype, use_bias=False, name="wo"
+        )(h)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, angles):
+        cfg = self.cfg
+        norm = functools.partial(
+            nn.RMSNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype
+        )
+        x = x + GqaAttention(cfg, name="attn")(norm(name="ln1")(x), angles)
+        return x + SwiGlu(cfg, name="mlp")(norm(name="ln2")(x))
+
+
+class Llama(nn.Module):
+    """Causal decoder LM; same call contract as models/transformer.py
+    Transformer (tokens -> f32 logits; `return_hidden` for blocked CE;
+    `positions` for permuted token layouts)."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
+                 positions=None):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
+        )
+        table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta)
+        if positions is None:
+            angles = table[: tokens.shape[1]]  # [S, D/2]
+        else:
+            angles = table[positions]  # [S, D/2] or [B, S, D/2]
+        x = embed(tokens)
+        block = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"block{i}")(x, angles)
+        x = nn.RMSNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, dtype=jnp.float32, use_bias=False,
+                name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+def params_flops_per_token(cfg: LlamaConfig) -> float:
+    """~6 * matmul-params FLOPs/token for a train step (fwd+bwd)."""
+    attn = (cfg.n_heads + 2 * cfg.n_kv_heads + cfg.n_heads) * (
+        cfg.d_model * cfg.head_dim
+    )
+    mlp = 3 * cfg.d_model * cfg.d_ff
+    p = cfg.vocab_size * cfg.d_model + cfg.n_layers * (attn + mlp)
+    return 6.0 * p

@@ -58,7 +58,7 @@ def storage_perm(n: int, s: int) -> np.ndarray:
         raise ValueError(f"sequence {s} not divisible by 2*n = {2 * n}")
     c = s // (2 * n)
     order = []
-    for i, (a, b) in enumerate(chunk_ids(n)):
+    for a, b in chunk_ids(n):
         order.extend(range(a * c, (a + 1) * c))
         order.extend(range(b * c, (b + 1) * c))
     return np.asarray(order, dtype=np.int32)

@@ -51,8 +51,15 @@ def transformer_param_sharding(
         if tp > 1:
             if name.endswith("qkv/kernel") and ok(2, tp):
                 spec[2] = "tp"  # [E, 3, H, D]: shard heads
+            elif name.endswith("attn/wq/kernel") and ok(1, tp):
+                spec[1] = "tp"  # llama [E, H, D]: shard query heads
+            elif name.endswith("attn/wkv/kernel") and ok(2, tp):
+                spec[2] = "tp"  # llama [E, 2, KV, D]: shard kv heads
             elif "attn/out/kernel" in name and ok(0, tp):
                 spec[0] = "tp"  # [H, D, E]: row-parallel
+            elif (name.endswith("mlp/wi/kernel") and len(shape) == 3
+                    and ok(2, tp)):
+                spec[2] = "tp"  # llama swiglu [E, 2, F]: column-parallel
             elif name.endswith("mlp/wi/kernel") and ok(1, tp):
                 spec[1] = "tp"  # [E, F]: column-parallel
             elif name.endswith("mlp/wo/kernel") and ok(0, tp):

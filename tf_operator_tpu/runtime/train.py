@@ -93,10 +93,20 @@ def make_train_step(
     rules: MeshRules = DEFAULT_RULES,
     mesh: Optional[Mesh] = None,
     accum_steps: int = 1,
+    state_shardings: Optional[Any] = None,
 ):
     """Build the jitted SPMD train step: (state, images, labels) ->
     (state, metrics). Everything inside is traced once; no python branching
     on data.
+
+    `state_shardings` (a TrainState of NamedShardings, e.g. from
+    parallel/tp.state_sharding) pins the OUTPUT state sharding. Without it
+    XLA's propagation is free to emit the updated params under a different
+    sharding than the input state (observed: tp moved / fsdp added on a
+    multi-axis mesh), which silently reshards every step — and, if the
+    caller jits a wrapper with explicit `in_shardings`, fails the second
+    step outright because the donated output no longer matches. Requires
+    `mesh` (metrics scalars are pinned replicated on it).
 
     `accum_steps > 1` enables gradient accumulation: the batch is split
     into that many micro-batches, a `lax.scan` runs fwd+bwd per micro-batch
@@ -177,7 +187,13 @@ def make_train_step(
             "accuracy": acc_sum / accum_steps,
         }
 
-    return jax.jit(step, donate_argnums=(0,))
+    kw = {}
+    if state_shardings is not None:
+        if mesh is None:
+            raise ValueError("state_shardings requires mesh")
+        # prefix pytree: one replicated sharding covers the metrics dict
+        kw["out_shardings"] = (state_shardings, NamedSharding(mesh, P()))
+    return jax.jit(step, donate_argnums=(0,), **kw)
 
 
 def make_eval_step(model, has_batch_stats: bool = True):
