@@ -366,27 +366,19 @@ def bench_transformer(gen: str, n_chips: int):
 
 
 
-def bench_t5_3b(gen: str, cfg=None):
-    """Ladder config #5 at single-chip scale (default-on when a chip is
-    present, opt-out via BENCH_T5=0: a 48-layer compile costs minutes but
-    only 5 steps run).  T5-3B-class decoder fits ONE chip only
-    because of the framework's memory levers together: bf16 params (~5GB),
-    adafactor (factored state), remat blocks, pallas flash attention, and
-    the blocked CE (no [B,S,V] f32 logits).  `cfg` override: tests run the
-    same path on a tiny decoder."""
+def _bench_big_lm(gen: str, model, cfg, flops_per_token: float, batch: int):
+    """Shared harness for the single-chip big-LM arms (t5_3b, llama): the
+    memory-lever stack is identical — bf16 params, adafactor (factored
+    state), remat blocks, blocked CE over the tied embedding — only the
+    model family differs."""
     import jax
     import jax.numpy as jnp
     import optax
 
-    from tf_operator_tpu.models import transformer as tfm
     from tf_operator_tpu.ops.blocked_ce import lm_blocked_loss
-    from tf_operator_tpu.ops.flash_attention import flash_attention
 
-    if cfg is None:
-        cfg = tfm.t5_3b_decoder(remat=True, attention_fn=flash_attention)
-    model = tfm.Transformer(cfg)
     rng = jax.random.PRNGKey(0)
-    batch, steps, warmup = 1, 5, 2
+    steps, warmup = 5, 2
     tokens = jax.random.randint(rng, (batch, cfg.max_len), 0, cfg.vocab_size)
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16),
@@ -408,7 +400,6 @@ def bench_t5_3b(gen: str, cfg=None):
         step, params, opt_state, tokens, warmup, steps
     )
     tps = steps * batch * cfg.max_len / dt
-    flops_per_token = tfm.params_flops_per_token(cfg)
     peak = PEAK_FLOPS_PER_CHIP.get(gen)
     return {
         "params_b": round(n_params / 1e9, 2),
@@ -419,6 +410,48 @@ def bench_t5_3b(gen: str, cfg=None):
         "tokens_per_sec_per_chip": round(tps, 1),
         "mfu": round(tps * flops_per_token / peak, 4) if peak else None,
     }
+
+
+def bench_t5_3b(gen: str, cfg=None):
+    """Ladder config #5 at single-chip scale (default-on when a chip is
+    present, opt-out via BENCH_T5=0: a 48-layer compile costs minutes but
+    only 5 steps run).  T5-3B-class decoder fits ONE chip only
+    because of the framework's memory levers together: bf16 params (~5GB),
+    adafactor (factored state), remat blocks, pallas flash attention, and
+    the blocked CE (no [B,S,V] f32 logits).  `cfg` override: tests run the
+    same path on a tiny decoder."""
+    from tf_operator_tpu.models import transformer as tfm
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    if cfg is None:
+        cfg = tfm.t5_3b_decoder(remat=True, attention_fn=flash_attention)
+    return _bench_big_lm(
+        gen, tfm.Transformer(cfg), cfg, tfm.params_flops_per_token(cfg),
+        batch=1,
+    )
+
+
+def bench_llama(gen: str, cfg=None):
+    """LLaMA-family arm (models/llama.py): 1B-class GQA decoder, flash
+    attention post-RoPE, tied embedding + blocked CE, adafactor, remat —
+    tokens/sec/chip + MFU for the modern-decoder path (default-on with a
+    chip, opt-out via BENCH_LLAMA=0). `cfg` override: tests run the same
+    path on a tiny config."""
+    from tf_operator_tpu.models import llama as llm
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    if cfg is None:
+        # ~0.8B params: 4:1 GQA, SwiGLU 2048->5632, S=2048
+        cfg = llm.LlamaConfig(
+            vocab_size=32000, d_model=2048, n_heads=16, n_kv_heads=4,
+            n_layers=16, d_ff=5632, max_len=2048, tie_embeddings=True,
+            remat=True, attention_fn=flash_attention,
+        )
+    r = _bench_big_lm(
+        gen, llm.Llama(cfg), cfg, llm.params_flops_per_token(cfg), batch=4,
+    )
+    r["gqa"] = f"{cfg.n_heads}q:{cfg.n_kv_heads}kv"
+    return r
 
 
 def _parity(f_out, f_grads, r_out, r_grads):
@@ -918,15 +951,25 @@ def main() -> int:
     n_chips = max(1, len(jax.devices()))
     extra = {"probe": probe_detail}
 
+    def progress(arm: str) -> None:
+        # per-arm heartbeat on stderr: a multi-arm run on a tunnelled chip
+        # takes tens of minutes and is otherwise indistinguishable from a
+        # wedged device claim to anyone tailing the log
+        print(f"# {time.strftime('%H:%M:%S')} bench arm: {arm}",
+              file=sys.stderr, flush=True)
+
+    progress("resnet")
     resnet = bench_resnet(gen, n_chips)
     extra["resnet"] = resnet
 
+    progress("transformer")
     try:
         extra["transformer"] = bench_transformer(gen, n_chips)
     except Exception as e:  # noqa: BLE001 — secondary bench must not kill headline
         extra["transformer"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     if gen != "cpu":
+        progress("flash_attention")
         try:
             extra["flash_attention"] = bench_flash_attention(gen)
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
@@ -934,13 +977,21 @@ def main() -> int:
         # default-ON with a chip (VERDICT r2 item 1c): 5 steps + one big
         # compile; opt out with BENCH_T5=0
         if os.environ.get("BENCH_T5", "1") == "1":
+            progress("t5_3b")
             try:
                 extra["t5_3b"] = bench_t5_3b(gen)
             except Exception as e:  # noqa: BLE001 — surfaced, not fatal
                 extra["t5_3b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        if os.environ.get("BENCH_LLAMA", "1") == "1":
+            progress("llama")
+            try:
+                extra["llama"] = bench_llama(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["llama"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     else:
         # no chip: the pallas kernel still runs (interpret mode) so the
         # flash arm's correctness witness lands in the artifact
+        progress("flash_parity_interpret")
         try:
             extra["flash_attention"] = bench_flash_parity_interpret()
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
@@ -951,6 +1002,7 @@ def main() -> int:
     # the measured path — VERDICT r2 item 6)
     for name, fn in (("startup_latency", bench_startup_latency),
                      ("operator_scale", bench_operator_scale)):
+        progress(name)
         rows = {}
         for be in ("fake", "rest"):
             try:
@@ -959,6 +1011,7 @@ def main() -> int:
                 rows[be] = {"error": f"{type(e).__name__}: {e}"[:300]}
         extra[name] = rows
 
+    progress("data_loader")
     try:
         extra["data_loader"] = bench_data_loader()
     except Exception as e:  # noqa: BLE001 — surfaced, not fatal

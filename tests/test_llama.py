@@ -258,3 +258,16 @@ def test_blocked_ce_hidden_seam():
     labels = toks[:, 1:].reshape(-1)
     blocked = blocked_cross_entropy(x, w, labels, chunk=128)
     assert abs(float(full) - float(blocked)) < 1e-5
+
+
+def test_bench_llama_path_runs_on_tiny_config():
+    """bench.bench_llama's stack (bf16 params + adafactor + remat + GQA +
+    blocked CE over the tied embedding) must execute end to end; the real
+    run only swaps in the 1B-class config."""
+    import bench  # repo root is on sys.path via tests/conftest.py
+
+    cfg = llama.tiny(tie_embeddings=True, remat=True)
+    r = bench.bench_llama("cpu", cfg=cfg)
+    assert r["tokens_per_sec_per_chip"] > 0
+    assert r["loss_after_warmup"] > 0
+    assert r["gqa"] == "4q:2kv"

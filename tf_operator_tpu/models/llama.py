@@ -121,8 +121,10 @@ def rope_table(max_len: int, head_dim: int, theta: float) -> jax.Array:
 def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     """Rotate [B, S, H, D] by per-position angles [S, D/2] or [B, S, D/2].
 
-    Pairs (x[2i], x[2i+1]) via the split-halves convention (rotate_half):
-    elementwise VPU work that XLA fuses into the adjacent projection.
+    Split-halves (rotate_half) convention: x[i] pairs with x[i + D/2] —
+    NOT the interleaved (x[2i], x[2i+1]) layout original-LLaMA checkpoints
+    use; porting such weights requires a one-time head-dim permutation.
+    Elementwise VPU work that XLA fuses into the adjacent projection.
     Rotation happens in f32 (small-angle differences vanish in bf16) and
     returns in the input dtype for the MXU contraction that follows.
     """
