@@ -144,12 +144,31 @@ def probe_tpu(attempts: "int | None" = None, timeout_s: "float | None" = None):
 # result here (git-tracked); a degraded (CPU) run merges it back into the
 # output with explicit provenance so the round artifact always carries the
 # newest TPU numbers that exist, clearly labeled live vs cached.
-CACHE_PATH = os.path.join(
+CACHE_PATH = os.environ.get("BENCH_CACHE_PATH") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST_GOOD.json"
 )
 
 
 def save_tpu_cache(result) -> None:
+    # A chip can die part-way through a run (tunnel drop): arms after the
+    # death record {"error": ...} while the headline stays live. Never let
+    # such a run erase a prior GOOD measurement of the same arm — keep the
+    # prior section, marked stale, so the cache only ever improves.
+    prior = load_tpu_cache()
+    if prior is not None:
+        pex = prior["result"].get("extra", {})
+        ex = result.setdefault("extra", {})
+        for k, prior_v in pex.items():
+            if not isinstance(prior_v, dict) or "error" in prior_v:
+                continue
+            v = ex.get(k)
+            errored = isinstance(v, dict) and "error" in v
+            if k not in ex or errored:
+                # arm skipped this run (opt-out env) or died with the chip:
+                # carry the prior good section forward, labeled with the
+                # time it was truly measured (an existing stale_from wins
+                # so the label cannot drift across repeated carries)
+                ex[k] = {"stale_from": prior["measured_at"], **prior_v}
     try:
         payload = {
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -169,8 +188,9 @@ def load_tpu_cache():
             payload = json.load(f)
     except (OSError, ValueError):
         return None
-    result = payload.get("result", {})
-    if result.get("platform") == "cpu" or not payload.get("measured_at"):
+    result = payload.get("result")
+    if (not isinstance(result, dict) or result.get("platform") == "cpu"
+            or not payload.get("measured_at")):
         return None
     return payload
 
@@ -927,15 +947,36 @@ def bench_startup_latency(runs: int = 5, backend: str = "fake"):
     }
 
 
+def _reexec_cpu(reason: str) -> int:
+    """Salvage path for a chip lost MID-run (tunnel drop / pool preemption
+    killed the claim after init): the in-process PJRT backend cannot be
+    re-platformed, so re-run the whole bench in a CPU child — its output
+    (with the cached last-good TPU sections merged under provenance)
+    becomes ours, instead of the round artifact being nothing at all."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_SKIP_PROBE": "",
+        "BENCH_DEGRADED_REASON": reason[:300],
+    }
+    print(f"# TPU lost mid-bench, re-running on CPU: {reason[:300]}",
+          file=sys.stderr, flush=True)
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env
+    ).returncode
+
+
 # ---------------------------------------------------------------- main
 def main() -> int:
     tpu_ok, probe_detail = probe_tpu()
     degraded_reason = None
     if not tpu_ok:
-        degraded_reason = probe_detail
+        # a mid-run fallback (see _reexec_cpu) carries the real cause;
+        # otherwise the probe's detail is the story
+        degraded_reason = os.environ.get("BENCH_DEGRADED_REASON") or probe_detail
         os.environ["JAX_PLATFORMS"] = "cpu"
-        print(f"# TPU unavailable, measuring CPU (degraded): {probe_detail}",
-              file=sys.stderr)
+        print(f"# TPU unavailable, measuring CPU (degraded): "
+              f"{degraded_reason}", file=sys.stderr)
 
     import jax
 
@@ -959,7 +1000,12 @@ def main() -> int:
               file=sys.stderr, flush=True)
 
     progress("resnet")
-    resnet = bench_resnet(gen, n_chips)
+    try:
+        resnet = bench_resnet(gen, n_chips)
+    except Exception as e:  # noqa: BLE001 — classify: dead chip vs real bug
+        if tpu_ok and dev.platform != "cpu":
+            return _reexec_cpu(f"{type(e).__name__}: {e}")
+        raise
     extra["resnet"] = resnet
 
     progress("transformer")

@@ -1,0 +1,141 @@
+"""Bench harness infrastructure (bench.py): last-good TPU cache semantics,
+mid-run chip-loss fallback, probe gating. The measurement arms themselves
+are covered by their tiny-config path tests (test_llama, test_blocked_ce)."""
+import json
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def cache(tmp_path, monkeypatch):
+    path = tmp_path / "BENCH_TPU_LAST_GOOD.json"
+    monkeypatch.setattr(bench, "CACHE_PATH", str(path))
+    return path
+
+
+def _tpu_result(**extra_arms):
+    return {
+        "platform": "tpu",
+        "value": 2500.0,
+        "extra": {"resnet": {"img_per_sec_per_chip": 2500.0}, **extra_arms},
+    }
+
+
+def test_cache_round_trip(cache):
+    bench.save_tpu_cache(_tpu_result())
+    payload = bench.load_tpu_cache()
+    assert payload["result"]["platform"] == "tpu"
+    assert payload["measured_at"]
+
+
+def test_cache_rejects_cpu_results(cache):
+    cache.write_text(json.dumps(
+        {"measured_at": "t", "result": {"platform": "cpu"}}
+    ))
+    assert bench.load_tpu_cache() is None
+
+
+def test_cache_rejects_corrupt_file(cache):
+    cache.write_text("{not json")
+    assert bench.load_tpu_cache() is None
+    assert bench.load_tpu_cache() is None  # absent file too
+
+
+def test_halfdead_run_keeps_prior_good_arm(cache):
+    """A run whose chip died after the headline must not erase a prior
+    good measurement of a later arm: the prior section survives with
+    stale provenance, so the cache only ever improves."""
+    bench.save_tpu_cache(_tpu_result(
+        t5_3b={"tokens_per_sec_per_chip": 9000.0}
+    ))
+    first = bench.load_tpu_cache()
+    bench.save_tpu_cache(_tpu_result(
+        t5_3b={"error": "UNAVAILABLE: remote_compile: Connection refused"}
+    ))
+    merged = bench.load_tpu_cache()["result"]["extra"]["t5_3b"]
+    assert merged["tokens_per_sec_per_chip"] == 9000.0
+    assert merged["stale_from"] == first["measured_at"]
+    assert "error" not in merged
+
+
+def test_fresh_good_arm_overwrites_prior(cache):
+    bench.save_tpu_cache(_tpu_result(
+        t5_3b={"tokens_per_sec_per_chip": 9000.0}
+    ))
+    bench.save_tpu_cache(_tpu_result(
+        t5_3b={"tokens_per_sec_per_chip": 9500.0}
+    ))
+    merged = bench.load_tpu_cache()["result"]["extra"]["t5_3b"]
+    assert merged["tokens_per_sec_per_chip"] == 9500.0
+    assert "stale_from" not in merged
+
+
+def test_reexec_cpu_env(monkeypatch):
+    """The mid-run fallback must hand the child a CPU platform, clear the
+    probe skip, and carry the real failure cause."""
+    seen = {}
+
+    def fake_run(argv, env=None):
+        seen["argv"], seen["env"] = argv, env
+
+        class R:
+            returncode = 0
+
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    rc = bench._reexec_cpu("JaxRuntimeError: UNAVAILABLE: tunnel down")
+    assert rc == 0
+    assert seen["env"]["JAX_PLATFORMS"] == "cpu"
+    assert seen["env"]["BENCH_SKIP_PROBE"] == ""
+    assert "tunnel down" in seen["env"]["BENCH_DEGRADED_REASON"]
+    assert seen["argv"][1].endswith("bench.py")
+
+
+def test_probe_respects_cpu_pin(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    ok, detail = bench.probe_tpu()
+    assert not ok and "JAX_PLATFORMS" in detail
+
+
+def test_probe_skip_trusts_caller(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("BENCH_SKIP_PROBE", "1")
+    ok, detail = bench.probe_tpu()
+    assert ok and "skipped" in detail
+
+
+def test_skipped_arm_carried_forward(cache):
+    """An arm absent from the new run (opt-out env) must not be erased:
+    the prior good section rides forward with stale provenance."""
+    bench.save_tpu_cache(_tpu_result(
+        t5_3b={"tokens_per_sec_per_chip": 9000.0}
+    ))
+    first = bench.load_tpu_cache()
+    bench.save_tpu_cache(_tpu_result())  # no t5_3b arm at all
+    merged = bench.load_tpu_cache()["result"]["extra"]["t5_3b"]
+    assert merged["tokens_per_sec_per_chip"] == 9000.0
+    assert merged["stale_from"] == first["measured_at"]
+
+
+def test_stale_from_does_not_drift(cache):
+    """Repeated carries must keep pointing at the ORIGINAL measurement
+    time, not advance to each intermediate cache write."""
+    bench.save_tpu_cache(_tpu_result(
+        t5_3b={"tokens_per_sec_per_chip": 9000.0}
+    ))
+    origin = bench.load_tpu_cache()["measured_at"]
+    for _ in range(3):
+        bench.save_tpu_cache(_tpu_result(t5_3b={"error": "chip died"}))
+    merged = bench.load_tpu_cache()["result"]["extra"]["t5_3b"]
+    assert merged["stale_from"] == origin
+
+
+def test_cache_rejects_resultless_payload(cache):
+    cache.write_text(json.dumps({"measured_at": "t"}))
+    assert bench.load_tpu_cache() is None
+    # and saving over it must not crash
+    bench.save_tpu_cache(_tpu_result())
+    assert bench.load_tpu_cache()["result"]["platform"] == "tpu"
