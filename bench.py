@@ -153,11 +153,15 @@ def save_tpu_cache(result) -> None:
     # A chip can die part-way through a run (tunnel drop): arms after the
     # death record {"error": ...} while the headline stays live. Never let
     # such a run erase a prior GOOD measurement of the same arm — keep the
-    # prior section, marked stale, so the cache only ever improves.
+    # prior section, marked stale, so the cache only ever improves. The
+    # merge happens on a COPY: the caller's live artifact keeps its fresh
+    # error strings (a real regression must stay visible in the round
+    # output), only the cache payload carries the good sections forward.
+    result = {**result, "extra": dict(result.get("extra", {}))}
     prior = load_tpu_cache()
     if prior is not None:
         pex = prior["result"].get("extra", {})
-        ex = result.setdefault("extra", {})
+        ex = result["extra"]
         for k, prior_v in pex.items():
             if not isinstance(prior_v, dict) or "error" in prior_v:
                 continue
@@ -167,8 +171,13 @@ def save_tpu_cache(result) -> None:
                 # arm skipped this run (opt-out env) or died with the chip:
                 # carry the prior good section forward, labeled with the
                 # time it was truly measured (an existing stale_from wins
-                # so the label cannot drift across repeated carries)
-                ex[k] = {"stale_from": prior["measured_at"], **prior_v}
+                # so the label cannot drift across repeated carries); a
+                # fresh error string rides along so it is never laundered
+                # away by the carry
+                carried = {"stale_from": prior["measured_at"], **prior_v}
+                if errored:
+                    carried["last_error"] = v["error"]
+                ex[k] = carried
     try:
         payload = {
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

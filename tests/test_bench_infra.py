@@ -40,7 +40,8 @@ def test_cache_rejects_cpu_results(cache):
 def test_cache_rejects_corrupt_file(cache):
     cache.write_text("{not json")
     assert bench.load_tpu_cache() is None
-    assert bench.load_tpu_cache() is None  # absent file too
+    cache.unlink()
+    assert bench.load_tpu_cache() is None  # absent file
 
 
 def test_halfdead_run_keeps_prior_good_arm(cache):
@@ -58,6 +59,7 @@ def test_halfdead_run_keeps_prior_good_arm(cache):
     assert merged["tokens_per_sec_per_chip"] == 9000.0
     assert merged["stale_from"] == first["measured_at"]
     assert "error" not in merged
+    assert "remote_compile" in merged["last_error"]
 
 
 def test_fresh_good_arm_overwrites_prior(cache):
@@ -139,3 +141,18 @@ def test_cache_rejects_resultless_payload(cache):
     # and saving over it must not crash
     bench.save_tpu_cache(_tpu_result())
     assert bench.load_tpu_cache()["result"]["platform"] == "tpu"
+
+
+def test_save_does_not_mutate_live_result(cache):
+    """The cache merge must not rewrite the caller's artifact: a fresh arm
+    error stays visible in the printed live output even when the cache
+    carries the prior good section forward."""
+    bench.save_tpu_cache(_tpu_result(
+        t5_3b={"tokens_per_sec_per_chip": 9000.0}
+    ))
+    live = _tpu_result(t5_3b={"error": "real regression"})
+    bench.save_tpu_cache(live)
+    assert live["extra"]["t5_3b"] == {"error": "real regression"}
+    cached = bench.load_tpu_cache()["result"]["extra"]["t5_3b"]
+    assert cached["tokens_per_sec_per_chip"] == 9000.0
+    assert cached["last_error"] == "real regression"

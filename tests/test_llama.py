@@ -271,3 +271,96 @@ def test_bench_llama_path_runs_on_tiny_config():
     assert r["tokens_per_sec_per_chip"] > 0
     assert r["loss_after_warmup"] > 0
     assert r["gqa"] == "4q:2kv"
+
+
+# ---------------------------------------------------- GQA-native flash
+def _flash_gqa_case(causal, s=256, b=2, h=4, kv=2, d=8, seed=0):
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+
+    def flash_loss(q, k, v):
+        out = flash_attention(q, k, v, causal, blk_q=128, blk_k=128)
+        return jnp.sum(out * out), out
+
+    def ref_loss(q, k, v):
+        from tf_operator_tpu.models.transformer import dot_product_attention
+
+        g = h // kv
+        out = dot_product_attention(
+            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), causal
+        )
+        return jnp.sum(out * out), out
+
+    (_, out_f), gf = jax.value_and_grad(flash_loss, argnums=(0, 1, 2),
+                                        has_aux=True)(q, k, v)
+    (_, out_r), gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2),
+                                        has_aux=True)(q, k, v)
+    return out_f, gf, out_r, gr
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_kernel_matches_reference(causal):
+    """The GQA-native kernel (kv heads indexed via BlockSpec maps, dk/dv
+    accumulated over the query group) must match the repeat+dense path
+    forward AND backward — including the kv-shaped [B,S,KV,D] grads."""
+    out_f, gf, out_r, gr = _flash_gqa_case(causal)
+    assert out_f.shape == out_r.shape
+    assert jnp.allclose(out_f, out_r, atol=2e-5), float(
+        jnp.abs(out_f - out_r).max()
+    )
+    for a, b_, name in zip(gf, gr, "qkv"):
+        assert a.shape == b_.shape, name
+        assert jnp.allclose(a, b_, atol=5e-5), (
+            name, float(jnp.abs(a - b_).max())
+        )
+
+
+def test_flash_gqa_kv_grad_shapes():
+    """dk/dv must come back in the compact [B,S,KV,D] shape (not the
+    broadcast H shape) so the wkv projection grad math stays compact."""
+    out_f, gf, _, _ = _flash_gqa_case(True, kv=1)  # MQA extreme
+    assert gf[0].shape == (2, 256, 4, 8)
+    assert gf[1].shape == (2, 256, 1, 8)
+    assert gf[2].shape == (2, 256, 1, 8)
+
+
+def test_flash_gqa_rejects_indivisible_heads():
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((1, 128, 4, 8))
+    kv = jnp.zeros((1, 128, 3, 8))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, kv, kv, True)
+
+
+def test_llama_flash_skips_repeat_and_matches_einsum():
+    """End to end: the GQA llama with flash attention (no kv broadcast)
+    must match the einsum path (which broadcasts)."""
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    assert flash_attention.supports_gqa
+    cfg = _f32(max_len=256)
+    assert cfg.q_per_kv == 2
+    toks = _tokens(cfg)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    ref = model.apply({"params": params}, toks)
+    flash_model = llama.Llama(
+        _f32(max_len=256, attention_fn=flash_attention)
+    )
+    got = flash_model.apply({"params": params}, toks)
+    assert jnp.allclose(got, ref, atol=2e-3), float(jnp.abs(got - ref).max())
+
+
+def test_flash_gqa_rejects_mismatched_kv_shapes():
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((1, 128, 4, 8))
+    k = jnp.zeros((1, 128, 2, 8))
+    v = jnp.zeros((1, 128, 4, 8))  # half-migrated caller: broadcast v
+    with pytest.raises(ValueError, match="must match"):
+        flash_attention(q, k, v, True)

@@ -156,12 +156,14 @@ class GqaAttention(nn.Module):
         k, v = kv[:, :, 0], kv[:, :, 1]
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
-        if cfg.q_per_kv > 1:
-            # share each kv head across the query group; XLA fuses the
-            # broadcast into the score contraction
+        attn = cfg.attention_fn or _einsum_attention
+        if cfg.q_per_kv > 1 and not getattr(attn, "supports_gqa", False):
+            # backend wants equal head counts: share each kv head across
+            # its query group by broadcast (XLA fuses it into the score
+            # contraction). GQA-native backends (pallas flash) instead
+            # index the shared head inside the kernel — no repeat.
             k = jnp.repeat(k, cfg.q_per_kv, axis=2)
             v = jnp.repeat(v, cfg.q_per_kv, axis=2)
-        attn = cfg.attention_fn or _einsum_attention
         out = attn(q, k, v, True)
         return dense(
             features=cfg.d_model, axis=(-2, -1), name="out"

@@ -122,9 +122,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, :, 0] = m_scr[:, 0] + jnp.log(l_safe)
 
 
+def _kv_index(i, heads: int, group: int):
+    """Map a query program's bh index [B*H] to its kv row [B*KV]: with
+    grouped-query attention each kv head serves `group` consecutive query
+    heads; identity when group == 1. Plain integer arithmetic on the
+    program id — legal in BlockSpec index maps, so the kernel reads the
+    SHARED kv head directly from HBM instead of a [B,S,H,D] repeat."""
+    kvh = heads // group
+    return (i // heads) * kvh + (i % heads) // group
+
+
 def _fwd_call(q, k, v, causal: bool, blk_q: int, blk_k: int,
-              interpret: bool):
-    """q,k,v: [BH, S, D] -> (out [BH,S,D], lse [BH,S])."""
+              interpret: bool, heads: int = 1, group: int = 1):
+    """q: [BH, S, D]; k,v: [B*KV, S, D] (KV = heads/group) ->
+    (out [BH,S,D], lse [BH,S])."""
     bh, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
     n_kv = s // blk_k
@@ -135,8 +146,14 @@ def _fwd_call(q, k, v, causal: bool, blk_q: int, blk_k: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, blk_q, d), lambda i, j, t: (i, j, 0)),
-            pl.BlockSpec((1, blk_k, d), lambda i, j, t: (i, t, 0)),
-            pl.BlockSpec((1, blk_k, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec(
+                (1, blk_k, d),
+                lambda i, j, t: (_kv_index(i, heads, group), t, 0),
+            ),
+            pl.BlockSpec(
+                (1, blk_k, d),
+                lambda i, j, t: (_kv_index(i, heads, group), t, 0),
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, blk_q, d), lambda i, j, t: (i, j, 0)),
@@ -199,11 +216,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                scale: float, n_q: int):
+                scale: float, n_q: int, group: int = 1):
+    """Grid (B*KV, n_kv, group*n_q): each program owns ONE kv tile of ONE
+    kv head; the streamed dim walks every (query head of the group) x
+    (q tile) pair, so a grouped kv head's gradient accumulates over all
+    `group` query heads it serves with no cross-program accumulation."""
     blk_k, d = k_ref.shape[1], k_ref.shape[2]
     blk_q = q_ref.shape[1]
     t, j = pl.program_id(1), pl.program_id(2)  # t: kv tile, j: streamed q
-    q_start, k_start = j * blk_q, t * blk_k
+    q_start, k_start = (j % n_q) * blk_q, t * blk_k
 
     @pl.when(j == 0)
     def _init():
@@ -228,15 +249,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - delta_ref[0, :, 0][:, None])).astype(q.dtype)
         dk_scr[:] = dk_scr[:] + scale * _dot(ds, q, ((0,), (0,)))
 
-    @pl.when(j == n_q - 1)
+    @pl.when(j == group * n_q - 1)
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
-              interpret: bool):
+              interpret: bool, heads: int = 1, group: int = 1):
     bh, s, d = q.shape
+    bkv = k.shape[0]
     scale = 1.0 / (d ** 0.5)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)  # [BH, S]
@@ -246,10 +268,13 @@ def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
     lse = lse[:, :, None]
     delta = delta[:, :, None]
     n_kv, n_q = s // blk_k, s // blk_q
+    kvh = heads // group
 
     q_tile = pl.BlockSpec((1, blk_q, d), lambda i, j, t: (i, j, 0))
     q_vec = pl.BlockSpec((1, blk_q, 1), lambda i, j, t: (i, j, 0))
-    kv_tile = pl.BlockSpec((1, blk_k, d), lambda i, j, t: (i, t, 0))
+    kv_tile = pl.BlockSpec(
+        (1, blk_k, d), lambda i, j, t: (_kv_index(i, heads, group), t, 0)
+    )
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, n_kv=n_kv),
         grid=(bh, n_q, n_kv),
@@ -261,19 +286,29 @@ def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
         compiler_params=_compiler_params(interpret),
     )(q, k, v, do, lse, delta)
 
-    # kv tiles are the parallel dim here; q streams innermost
-    q_stream = pl.BlockSpec((1, blk_q, d), lambda i, t, j: (i, j, 0))
-    qv_stream = pl.BlockSpec((1, blk_q, 1), lambda i, t, j: (i, j, 0))
+    # kv tiles are the parallel dim here; the streamed innermost dim walks
+    # (query head of the group) x (q tile), so dk/dv accumulate over every
+    # query head a grouped kv head serves (grid row i: kv row in [B*KV])
+    def _q_row(i, j):
+        return (i // kvh) * heads + (i % kvh) * group + j // n_q
+
+    q_stream = pl.BlockSpec(
+        (1, blk_q, d), lambda i, t, j: (_q_row(i, j), j % n_q, 0)
+    )
+    qv_stream = pl.BlockSpec(
+        (1, blk_q, 1), lambda i, t, j: (_q_row(i, j), j % n_q, 0)
+    )
     kv_fixed = pl.BlockSpec((1, blk_k, d), lambda i, t, j: (i, t, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q),
-        grid=(bh, n_kv, n_q),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q,
+                          group=group),
+        grid=(bkv, n_kv, group * n_q),
         in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, qv_stream,
                   qv_stream],
         out_specs=[kv_fixed, kv_fixed],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bkv, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bkv, s, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, d), jnp.float32),
@@ -288,20 +323,22 @@ def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
 # ------------------------------------------------------------ public API
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, blk_q, blk_k, interpret):
-    out, _ = _fwd_call(q, k, v, causal, blk_q, blk_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, blk_q, blk_k, interpret, heads, group):
+    out, _ = _fwd_call(q, k, v, causal, blk_q, blk_k, interpret, heads, group)
     return out
 
 
-def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
-    out, lse = _fwd_call(q, k, v, causal, blk_q, blk_k, interpret)
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, heads, group):
+    out, lse = _fwd_call(q, k, v, causal, blk_q, blk_k, interpret, heads,
+                         group)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, blk_q, blk_k, interpret, res, do):
+def _flash_bwd(causal, blk_q, blk_k, interpret, heads, group, res, do):
     q, k, v, out, lse = res
-    return _bwd_call(q, k, v, out, lse, do, causal, blk_q, blk_k, interpret)
+    return _bwd_call(q, k, v, out, lse, do, causal, blk_q, blk_k, interpret,
+                     heads, group)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -326,20 +363,42 @@ def flash_attention(q, k, v, causal: bool = False, *,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused attention for [B, S, H, D] inputs (transformer layout,
     models/transformer.py MultiHeadAttention). Differentiable; falls back
-    to the einsum reference path when S doesn't tile evenly."""
+    to the einsum reference path when S doesn't tile evenly.
+
+    Grouped-query attention is native: k/v may carry FEWER heads than q
+    ([B, S, KV, D] with H % KV == 0, models/llama.py GqaAttention) — the
+    kernels index the shared kv head per query group via the BlockSpec
+    index map (no [B,S,H,D] materialized repeat; dk/dv accumulate over
+    the group inside the kv-owned backward program)."""
     b, s, h, d = q.shape
+    kv_heads = k.shape[2]
+    if h % kv_heads:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kv_heads}")
+    if v.shape != k.shape:
+        # a half-migrated caller (compact k, broadcast v) would otherwise
+        # read v rows through the wrong index map — loudly reject instead
+        raise ValueError(f"k {k.shape} and v {v.shape} shapes must match")
+    group = h // kv_heads
     blk_q = _snap_block(blk_q, s)
     blk_k = _snap_block(blk_k, s)
     if blk_q is None or blk_k is None:
         # no 128-aligned divisor of S (e.g. s=200): unfused reference path
         from tf_operator_tpu.models.transformer import dot_product_attention
+        if group > 1:
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         return dot_product_attention(q, k, v, causal)
     if interpret is None:
         interpret = _use_interpret()
 
-    def to_bh(x):  # [B,S,H,D] -> [B*H, S, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    def to_bh(x):  # [B,S,Hx,D] -> [B*Hx, S, D]
+        hx = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hx, s, d)
 
     out = _flash(to_bh(q), to_bh(k), to_bh(v), causal, blk_q, blk_k,
-                 bool(interpret))
+                 bool(interpret), h, group)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+# models/llama.py GqaAttention checks this to skip its kv-head broadcast
+flash_attention.supports_gqa = True
