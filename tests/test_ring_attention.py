@@ -107,3 +107,106 @@ def test_zigzag_einsum_ring_matches_oracle():
     want = dot_product_attention(q, k, v, True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+# ------------------------------------------------------------------ GQA
+def _gqa_ref(q, k, v, causal):
+    g = q.shape[2] // k.shape[2]
+    return dot_product_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), causal
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_ring_gqa_matches_repeat_reference(causal, layout):
+    """Compact-kv ring (the grouped einsums ppermute KV-head shards only)
+    must match broadcast attention, both sequence layouts."""
+    from tf_operator_tpu.ops.zigzag import storage_perm
+
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    b, s, h, kv, d = 2, 64, 4, 2, 16
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+    want = _gqa_ref(q, k, v, causal)
+    if layout == "zigzag":
+        perm = storage_perm(4, s)
+        qs, ks_, vs = q[:, perm], k[:, perm], v[:, perm]
+    else:
+        qs, ks_, vs = q, k, v
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, causal=causal, axis_name="tp",
+                          layout=layout),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    got = jax.jit(fn)(qs, ks_, vs)
+    if layout == "zigzag":
+        inv = np.argsort(perm)
+        got = got[:, inv]
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa_grads_match_repeat_reference():
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    b, s, h, kv, d = 2, 32, 4, 2, 8
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    ring = shard_map(
+        functools.partial(ring_attention, causal=True, axis_name="tp"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    gr = jax.grad(lambda *a: jnp.sum(jax.jit(ring)(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda *a: jnp.sum(_gqa_ref(*a, True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gr, gw, "qkv"):
+        assert a.shape == b_.shape, name
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-5,
+                                   err_msg=name)
+
+
+def test_ring_gqa_rejects_bad_heads():
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    q = jnp.zeros((1, 32, 4, 8))
+    k = jnp.zeros((1, 32, 3, 8))
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, causal=True, axis_name="tp"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(fn)(q, k, k)
+
+
+def test_llama_ring_gqa_drop_in():
+    """GQA llama + ring attention_fn: supports_gqa means no kv broadcast;
+    output must still match the single-device einsum model."""
+    from tf_operator_tpu.models import llama
+
+    mesh = make_mesh({"tp": 2, "dp": 4})
+    ring_fn = make_ring_attention_fn(mesh, axis_name="tp")
+    assert ring_fn.supports_gqa
+    cfg = llama.tiny(dtype=jnp.float32)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(0), (4, cfg.max_len), 0, cfg.vocab_size
+    )
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    want = model.apply({"params": params}, toks)
+    ring_model = llama.Llama(
+        llama.tiny(dtype=jnp.float32, attention_fn=ring_fn)
+    )
+    with mesh:
+        got = jax.jit(
+            lambda p, t: ring_model.apply({"params": p}, t)
+        )(params, toks)
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)

@@ -47,6 +47,20 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def check_gqa_shapes(q, k, v) -> int:
+    """Validate [B,S,H,D] q against [B,S,KV,D] k/v; returns the group size
+    H // KV (1 == plain MHA). Shared by every GQA-capable attention
+    backend so the contract (and its error text) cannot drift."""
+    h, kv_heads = q.shape[2], k.shape[2]
+    if h % kv_heads:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kv_heads}")
+    if v.shape != k.shape:
+        # a half-migrated caller (compact k, broadcast v) would otherwise
+        # read v rows through the wrong index map — loudly reject instead
+        raise ValueError(f"k {k.shape} and v {v.shape} shapes must match")
+    return h // kv_heads
+
+
 def _compiler_params(interpret: bool):
     """bh and tile dims are parallel (disjoint outputs); the streamed
     contraction dim is sequential (scratch carries state across it)."""
@@ -371,14 +385,7 @@ def flash_attention(q, k, v, causal: bool = False, *,
     index map (no [B,S,H,D] materialized repeat; dk/dv accumulate over
     the group inside the kv-owned backward program)."""
     b, s, h, d = q.shape
-    kv_heads = k.shape[2]
-    if h % kv_heads:
-        raise ValueError(f"q heads {h} not divisible by kv heads {kv_heads}")
-    if v.shape != k.shape:
-        # a half-migrated caller (compact k, broadcast v) would otherwise
-        # read v rows through the wrong index map — loudly reject instead
-        raise ValueError(f"k {k.shape} and v {v.shape} shapes must match")
-    group = h // kv_heads
+    group = check_gqa_shapes(q, k, v)
     blk_q = _snap_block(blk_q, s)
     blk_k = _snap_block(blk_k, s)
     if blk_q is None or blk_k is None:

@@ -30,13 +30,27 @@ NEG_INF = -1e30
 @functools.partial(jax.checkpoint, static_argnums=(5,))
 def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool):
     """One ring step: blockwise attention q @ (k, v) with global-position
-    causal mask, merged into the running (o, m, l) accumulator."""
+    causal mask, merged into the running (o, m, l) accumulator.
+
+    k/v may carry fewer heads than q (grouped-query attention): the score
+    and PV einsums then contract with q reshaped [B,Sq,KV,G,D], so the
+    compact kv shard — the thing the ring ppermutes — is used directly,
+    never repeated to H heads."""
     q, k, v = qkv
     q_pos, k_pos = pos
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
+    b, sq, h, d = q.shape
+    kv_heads = k.shape[2]
+    group = h // kv_heads
+    scale = 1.0 / (d ** 0.5)
+    if group == 1:
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+    else:
+        qg = q.reshape(b, sq, kv_heads, group, d)
+        s = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+        ).reshape(b, h, sq, -1) * scale
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk] global
         s = jnp.where(mask[None, None], s, NEG_INF)
@@ -47,10 +61,17 @@ def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool):
     p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new[..., None]))
     corr = jnp.exp(jnp.clip(carry_m - m_new, max=0.0))
     l_new = carry_l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum(
-        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
+    if group == 1:
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        pg = p.reshape(b, kv_heads, group, sq, -1)
+        pv = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", pg.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, sq, h, d)
     o_new = carry_o * corr.transpose(0, 2, 1)[..., None] + pv
     return o_new, m_new, l_new
 
@@ -67,16 +88,21 @@ def _positions(idx, n, s_local, layout: str):
 def ring_attention(q, k, v, causal: bool = False, *,
                    axis_name: str = "tp",
                    layout: str = "contiguous") -> jax.Array:
-    """Attention over sequence shards. Call inside shard_map with q, k, v
-    [B, S_local, H, D] sharded on dim 1 over `axis_name`. Differentiable
-    (ppermute transposes to the reverse rotation under autodiff).
+    """Attention over sequence shards. Call inside shard_map with q
+    [B, S_local, H, D] and k, v [B, S_local, KV, D] (KV == H, or fewer
+    heads for GQA with H % KV == 0) sharded on dim 1 over `axis_name`.
+    Differentiable (ppermute transposes to the reverse rotation under
+    autodiff).
     layout="zigzag" expects shards in zigzag storage order
     (ops/zigzag.py) and masks by the matching global positions — the
     balanced layout causal ring_flash exploits; here it only changes the
     mask math (the einsum block is dense either way)."""
+    from tf_operator_tpu.ops.flash_attention import check_gqa_shapes
+
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
+    check_gqa_shapes(q, k, v)
     if layout == "zigzag" and s_local % 2:
         raise ValueError(
             f"layout='zigzag' needs an even per-member sequence, got "
@@ -119,4 +145,8 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = "tp",
             check_rep=False,
         )(q, k, v)
 
+    # compact-kv (GQA) inputs are supported natively: the grouped einsums
+    # in _merge_block contract against the unrepeated kv shard, so the
+    # ring's ppermute moves group x fewer bytes over ICI per hop
+    attention_fn.supports_gqa = True
     return attention_fn

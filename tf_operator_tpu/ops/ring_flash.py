@@ -43,6 +43,7 @@ from tf_operator_tpu.ops.flash_attention import (
     _dot,
     _snap_block,
     _use_interpret,
+    check_gqa_shapes,
 )
 
 POS_INF = 1e30
@@ -296,9 +297,28 @@ def _offsets(idx, n, s_local, layout: str):
     return jnp.stack([first, second]).astype(jnp.int32).reshape(2, 1)
 
 
+def _expand_kv(x, group: int):
+    """[B*KV, S, D] -> [B*H, S, D]: row b*KV + kvh expands to the `group`
+    consecutive rows b*H + kvh*group + r — exactly the head-major order
+    to_bh produces, so a plain axis-0 repeat is the correct inverse of
+    GQA head sharing. Identity when group == 1 (python-static)."""
+    return jnp.repeat(x, group, axis=0) if group > 1 else x
+
+
+def _fold_dkv(g, group: int):
+    """[B*H, S, D] grads -> compact [B*KV, S, D]: sum each kv head's
+    `group` query-head contributions (adjoint of _expand_kv)."""
+    if group == 1:
+        return g
+    bh, s, d = g.shape
+    return g.reshape(bh // group, group, s, d).sum(axis=1)
+
+
 def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
-                   layout):
-    """q,k,v [BH, S_l, D] (inside shard_map). Returns (out, lse)."""
+                   layout, group=1):
+    """q [BH, S_l, D]; k,v [B*KV, S_l, D] (inside shard_map). The ring
+    ppermutes the COMPACT kv shard (group x fewer ICI bytes per hop);
+    each step expands it locally for the kernel. Returns (out, lse)."""
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     bh, s_l, d = q.shape
@@ -314,7 +334,8 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
         def live_step(carry, kv=kv, src=src):
             m, l, acc = carry
             return _carry_fwd_call(
-                q, kv[0], kv[1], m, l, acc, q_off,
+                q, _expand_kv(kv[0], group), _expand_kv(kv[1], group),
+                m, l, acc, q_off,
                 _offsets(src, n, s_l, layout),
                 causal=causal, blk_q=blk_q, blk_k=blk_k,
                 interpret=interpret)
@@ -341,23 +362,23 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
     return out, lse  # lse [BH, S_l, 1] — the shape the bwd kernels read
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_flash(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
-                layout):
+                layout, group):
     out, _ = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
-                            interpret, layout)
+                            interpret, layout, group)
     return out
 
 
 def _ring_flash_fwd(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
-                    layout):
+                    layout, group):
     out, lse = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
-                              interpret, layout)
+                              interpret, layout, group)
     return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
-                    res, do):
+                    group, res, do):
     q, k, v, out, lse = res
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -367,10 +388,12 @@ def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
     lse3 = lse  # already [BH, S_l, 1]
     q_off = _offsets(my, n, s_l, layout)
     dq = jnp.zeros((bh, s_l, d), jnp.float32)
-    # (k, v, dk, dv) rotate together: after n hops every shard has
-    # collected contributions from every q shard and is home again
-    kvg = (k, v, jnp.zeros((bh, s_l, d), jnp.float32),
-           jnp.zeros((bh, s_l, d), jnp.float32))
+    # (k, v, dk, dv) rotate together — all COMPACT [B*KV, S_l, D]: after n
+    # hops every shard has collected contributions from every q shard and
+    # is home again; each hop's dk/dv contribution is folded back to the
+    # kv heads before riding the ring
+    kvg = (k, v, jnp.zeros(k.shape, jnp.float32),
+           jnp.zeros(v.shape, jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
     for step in range(n):
         src = jax.lax.rem(my - step + n, n)
@@ -379,10 +402,12 @@ def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
         def live_step(carry, k_res=k_res, v_res=v_res, src=src):
             dq, dk_res, dv_res = carry
             dq_c, dk_c, dv_c = _bwd_step_call(
-                q, k_res, v_res, do, lse3, delta, q_off,
+                q, _expand_kv(k_res, group), _expand_kv(v_res, group),
+                do, lse3, delta, q_off,
                 _offsets(src, n, s_l, layout), causal=causal, blk_q=blk_q,
                 blk_k=blk_k, interpret=interpret)
-            return dq + dq_c, dk_res + dk_c, dv_res + dv_c
+            return (dq + dq_c, dk_res + _fold_dkv(dk_c, group),
+                    dv_res + _fold_dkv(dv_c, group))
 
         if causal and step > 0 and layout != "zigzag":
             # mirror the forward: dead hops (src > my) contribute nothing
@@ -411,8 +436,14 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
     layout="zigzag" expects shards in zigzag storage order (ops/zigzag.py:
     device i holds global chunks i and 2n-1-i): causal tile-skipping then
     drops ~half the work on EVERY device uniformly instead of idling the
-    early ring members — ~2x causal wall-clock at large ring sizes."""
+    early ring members — ~2x causal wall-clock at large ring sizes.
+
+    k/v may carry fewer heads than q (GQA, H % KV == 0): the ring then
+    rotates the COMPACT kv shard (group x fewer ICI bytes per hop) and
+    expands it locally per step for the kernel; dk/dv fold back to the
+    compact [B, S_local, KV, D] shape before riding the ring."""
     b, s_l, h, d = q.shape
+    group = check_gqa_shapes(q, k, v)
     # _snap_block returns s_l itself when s_l <= blk even if unaligned —
     # a block equal to the full array dim is Mosaic-legal (the documented
     # "divisible by (8, 128) or equal to the full dim" rule, same contract
@@ -436,11 +467,12 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
     if interpret is None:
         interpret = _use_interpret()
 
-    def to_bh(x):  # [B,S,H,D] -> [B*H, S, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s_l, d)
+    def to_bh(x):  # [B,S,Hx,D] -> [B*Hx, S, D]
+        hx = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hx, s_l, d)
 
     out = _ring_flash(to_bh(q), to_bh(k), to_bh(v), causal, axis_name,
-                      bq, bk, bool(interpret), layout)
+                      bq, bk, bool(interpret), layout, group)
     return out.reshape(b, h, s_l, d).transpose(0, 2, 1, 3)
 
 
@@ -465,4 +497,6 @@ def make_ring_flash_attention_fn(mesh: Mesh, axis_name: str = "tp",
             check_rep=False,
         )(q, k, v)
 
+    # compact-kv (GQA) inputs rotate unexpanded around the ring
+    attention_fn.supports_gqa = True
     return attention_fn
