@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""[+] LLaMA-class GQA decoder pretraining on a TPU slice.
+
+Beyond the reference ladder (BASELINE.md tops out at T5): the modern
+decoder recipe on the same runtime seams as train_t5.py — dp×fsdp×tp
+mesh, GQA-native flash attention (compact kv heads, models/llama.py),
+optional sequence-parallel ring for long context (--ring: the compact
+kv shard is what ppermutes, ops/ring_flash.py), blocked large-vocab CE
+over the tied embedding, adafactor + remat, checkpoint on interval AND
+on SIGTERM for gang preemption recovery.
+"""
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tf_operator_tpu.models.llama import Llama, llama3_8b, tiny
+from tf_operator_tpu.models.transformer import lm_loss
+from tf_operator_tpu.ops.blocked_ce import lm_blocked_loss
+from tf_operator_tpu.parallel.mesh import make_mesh, local_mesh_axes
+from tf_operator_tpu.parallel.tp import state_sharding
+from tf_operator_tpu.runtime import bootstrap
+from tf_operator_tpu.runtime.loop import PreemptionGuard, run_training
+from tf_operator_tpu.runtime.profiler import Profiler
+from tf_operator_tpu.runtime.train import Checkpointer, TrainState
+
+
+def lm_batches(batch: int, seq_len: int, vocab: int, seed: int):
+    key = jax.random.PRNGKey(seed)
+    while True:
+        key, k = jax.random.split(key)
+        yield (jax.random.randint(k, (batch, seq_len), 0, vocab),)
+
+
+def make_lm_step(model):
+    # tied embedding -> the blocked CE fuses the 128k-vocab lm-head into
+    # the loss; no [B,S,V] f32 logits ever materializes
+    loss_of = lm_blocked_loss if model.cfg.tie_embeddings else (
+        lambda m, p, t: lm_loss(m.apply({"params": p}, t), t)
+    )
+
+    def step(state: TrainState, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_of(model, p, tokens)
+        )(state.params)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200_000)
+    ap.add_argument("--per-host-batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=8192)
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--save-interval", type=int, default=500)
+    ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
+    ap.add_argument("--ring", action="store_true",
+                    help="sequence-parallel ring attention over tp "
+                         "(compact GQA kv shards on the ring)")
+    ap.add_argument("--smoke", action="store_true", help="tiny model, CPU ok")
+    args = ap.parse_args(argv)
+
+    info = bootstrap.initialize()
+    mesh = make_mesh(axes=local_mesh_axes(jax.device_count(),
+                                          prefer_tp=args.tp))
+    print(f"host {info.process_id}/{info.num_processes} slice "
+          f"{info.slice_id}/{info.num_slices}, mesh {dict(mesh.shape)}")
+
+    if args.ring:
+        from tf_operator_tpu.ops.ring_flash import make_ring_flash_attention_fn
+
+        attention_fn = make_ring_flash_attention_fn(mesh, "tp")
+    else:
+        from tf_operator_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = flash_attention
+    if args.smoke:
+        cfg = tiny(tie_embeddings=True, attention_fn=attention_fn)
+    else:
+        cfg = llama3_8b(tie_embeddings=True, remat=True,
+                        attention_fn=attention_fn)
+    seq_len = min(args.seq_len, cfg.max_len)
+
+    model = Llama(cfg)
+    tx = optax.adafactor(1e-3)
+    sample = jnp.zeros((args.per_host_batch, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), sample, train=False)["params"]
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), batch_stats={}, tx=tx,
+    )
+    state = jax.device_put(state, state_sharding(state, mesh))
+
+    res = run_training(
+        state,
+        make_lm_step(model),
+        lm_batches(args.per_host_batch, seq_len, cfg.vocab_size,
+                   seed=info.process_id),
+        num_steps=args.steps,
+        checkpointer=(
+            Checkpointer(args.ckpt_dir, async_save=True)
+            if args.ckpt_dir else None
+        ),
+        save_interval_steps=args.save_interval,
+        profiler=Profiler(batch_size=args.per_host_batch * jax.process_count()),
+        guard=PreemptionGuard(),
+        metrics_sink=print,
+    )
+    status = "preempted (checkpointed)" if res.preempted else "complete"
+    print(f"{status}: steps={res.steps_run} resumed_from={res.resumed_from}")
+    return 0 if not res.preempted else 143  # 143 = retryable, gang restarts
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -297,3 +297,30 @@ def test_xgboost_example_through_run_local():
         ),
     )
     assert state == "Succeeded"
+
+
+def test_llama_smoke_with_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    rc = _run("llama/train_llama.py", "--smoke", "--steps=2",
+              "--per-host-batch=2", f"--ckpt-dir={ckpt}")
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    rc2 = _run("llama/train_llama.py", "--smoke", "--steps=3",
+               "--per-host-batch=2", f"--ckpt-dir={ckpt}")
+    assert rc2.returncode == 0, rc2.stderr[-2000:]
+    assert "resumed_from=2" in rc2.stdout
+
+
+def test_llama_smoke_ring_sequence_parallel():
+    """--ring on a 2-virtual-device mesh: the GQA kv shards ride a real
+    tp=2 ring (compact on the wire) through the example's own path."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=2"))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(EX, "llama/train_llama.py"),
+         "--smoke", "--steps=2", "--per-host-batch=2", "--ring", "--tp=2"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "'tp': 2" in rc.stdout
+    assert "complete: steps=2" in rc.stdout
