@@ -97,3 +97,60 @@ def test_ulysses_with_flash_kernel_matches_oracle():
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-4)
+
+
+# ------------------------------------------------------------------ GQA
+def _gqa_qkv(key, b, s, h, kv, d):
+    ks = jax.random.split(key, 3)
+    return (jax.random.normal(ks[0], (b, s, h, d)),
+            jax.random.normal(ks[1], (b, s, kv, d)),
+            jax.random.normal(ks[2], (b, s, kv, d)))
+
+
+def _gqa_ref(q, k, v, causal):
+    g = q.shape[2] // k.shape[2]
+    return dot_product_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), causal
+    )
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+@pytest.mark.parametrize("kv", [2, 4])
+def test_ulysses_gqa_matches_reference(use_flash, kv):
+    """Compact kv exchanges over the axis when KV % n == 0 (kv=2 on tp=2);
+    both the einsum and flash local paths must match the broadcast
+    oracle."""
+    mesh = make_mesh({"tp": 2, "dp": 4})
+    fn = make_ulysses_attention_fn(mesh, use_flash=use_flash,
+                                   interpret=use_flash or None)
+    assert fn.supports_gqa
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(3), 4, 64, 4, kv, 16)
+    got = jax.jit(lambda q, k, v: fn(q, k, v, True))(q, k, v)
+    want = _gqa_ref(q, k, v, True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gqa_broadcast_fallback():
+    """KV=2 on a tp=4 axis: kv heads don't split, so the pre-exchange
+    broadcast path must kick in and still match."""
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    fn = make_ulysses_attention_fn(mesh)
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(4), 4, 64, 4, 2, 16)
+    got = jax.jit(lambda q, k, v: fn(q, k, v, True))(q, k, v)
+    want = _gqa_ref(q, k, v, True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gqa_grads_match_reference():
+    mesh = make_mesh({"tp": 2, "dp": 4})
+    fn = make_ulysses_attention_fn(mesh)
+    q, k, v = _gqa_qkv(jax.random.PRNGKey(5), 4, 32, 4, 2, 8)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v, True) ** 2)
+
+    gf = jax.grad(loss(fn), argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(loss(_gqa_ref), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gw, "qkv"):
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5, err_msg=name)

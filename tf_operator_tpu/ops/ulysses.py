@@ -33,13 +33,28 @@ def ulysses_attention(q, k, v, causal: bool = False, *,
     the pallas flash kernel (ops/flash_attention.py) — since Ulysses
     computes EXACT attention per local head subset, the kernel drops in
     unchanged: O(S^2/n) score memory becomes O(S·blk/n) and the MXU path
-    gets the kernel's measured 1.45–2.2x over einsum."""
+    gets the kernel's measured 1.45–2.2x over einsum.
+
+    Grouped-query attention: k/v may carry KV < H heads. When KV % n == 0
+    the compact kv rides the all_to_alls (group x fewer ICI bytes for the
+    kv exchange) — a contiguous head split keeps each query head on the
+    same device as its shared kv head, so the local attention is plain
+    GQA at the same group ratio. When n does not divide KV, kv is
+    broadcast to H heads before the exchange (correct, just unsaving)."""
+    from tf_operator_tpu.ops.flash_attention import check_gqa_shapes
+
     n = jax.lax.psum(1, axis_name)
     h = q.shape[2]
+    group = check_gqa_shapes(q, k, v)
     if h % n:
         raise ValueError(f"heads {h} not divisible by axis {axis_name!r}={n}")
+    if group > 1 and k.shape[2] % n:
+        # kv heads don't split over the axis: fall back to broadcast
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+        group = 1
 
-    # all_to_all #1: scatter heads, gather sequence -> [B, S, H/n, D]
+    # all_to_all #1: scatter heads, gather sequence -> [B, S, Hx/n, D]
     def fwd(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True)
@@ -49,12 +64,17 @@ def ulysses_attention(q, k, v, causal: bool = False, *,
     if use_flash:
         from tf_operator_tpu.ops.flash_attention import flash_attention
 
+        # the pallas kernel is GQA-native: compact local kv goes straight in
         out = flash_attention(fwd(q), fwd(k), fwd(v), causal,
                               interpret=interpret)
     else:
         from tf_operator_tpu.models.transformer import dot_product_attention
 
-        out = dot_product_attention(fwd(q), fwd(k), fwd(v), causal)
+        kl, vl = fwd(k), fwd(v)
+        if group > 1:
+            kl = jnp.repeat(kl, group, axis=2)
+            vl = jnp.repeat(vl, group, axis=2)
+        out = dot_product_attention(fwd(q), kl, vl, causal)
     # all_to_all #2: scatter sequence, gather heads -> [B, S/n, H, D]
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
@@ -78,4 +98,7 @@ def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = "tp",
             check_rep=False,
         )(q, k, v)
 
+    # compact-kv (GQA) inputs exchange unexpanded when the axis size
+    # divides KV (KV % n == 0); otherwise kv broadcasts pre-exchange
+    attention_fn.supports_gqa = True
     return attention_fn
