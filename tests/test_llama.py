@@ -364,3 +364,102 @@ def test_flash_gqa_rejects_mismatched_kv_shapes():
     v = jnp.zeros((1, 128, 4, 8))  # half-migrated caller: broadcast v
     with pytest.raises(ValueError, match="must match"):
         flash_attention(q, k, v, True)
+
+
+# ----------------------------------------------------------- generation
+def _naive_greedy(model, params, prompt, n):
+    """Oracle: re-run the FULL forward over the growing sequence each
+    step and take argmax of the last position."""
+    toks = prompt
+    out = []
+    for _ in range(n):
+        logits = model.apply({"params": params}, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        out.append(nxt)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    return jnp.stack(out, axis=1)
+
+
+def test_greedy_generate_matches_full_forward_oracle():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :8]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    want = _naive_greedy(model, params, prompt, 6)
+    got = llama.generate(model, params, prompt, 6)
+    assert got.shape == (2, 6)
+    assert jnp.array_equal(got, want), (got, want)
+
+
+def test_prefill_logits_match_full_forward():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :10]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    full = model.apply({"params": params}, prompt)
+    cache = llama.init_cache(cfg, 2)
+    dec, new_cache = model.apply(
+        {"params": params}, prompt, cache=cache, cache_pos=0)
+    assert jnp.allclose(dec, full, atol=1e-4), float(jnp.abs(dec - full).max())
+    assert len(new_cache) == cfg.n_layers
+
+
+def test_cache_is_compact_kv():
+    cfg = llama.tiny()  # 4 q heads, 2 kv heads
+    cache = llama.init_cache(cfg, batch=3, cache_len=32)
+    k, v = cache[0]
+    assert k.shape == (3, 32, 2, 16)
+    assert v.shape == (3, 32, 2, 16)
+
+
+def test_generate_single_token():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=1)[:, :4]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    got = llama.generate(model, params, prompt, 1)
+    want = _naive_greedy(model, params, prompt, 1)
+    assert jnp.array_equal(got, want)
+
+
+def test_generate_sampling_runs_and_respects_cache_bound():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :4]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    out = llama.generate(model, params, prompt, 5,
+                         rng=jax.random.PRNGKey(7), temperature=0.8)
+    assert out.shape == (2, 5)
+    assert bool((out >= 0).all()) and bool((out < cfg.vocab_size).all())
+    with pytest.raises(ValueError, match="exceeds cache"):
+        llama.generate(model, params, prompt, cfg.max_len)
+    with pytest.raises(ValueError, match="needs an rng"):
+        llama.generate(model, params, prompt, 2, temperature=1.0)
+
+
+def test_generate_zero_tokens_and_bad_cache_len():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :4]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    out = llama.generate(model, params, prompt, 0)
+    assert out.shape == (2, 0)
+    with pytest.raises(ValueError, match=">= 0"):
+        llama.generate(model, params, prompt, -1)
+    # cache longer than the RoPE table must be rejected, not silently
+    # decoded with clamped rotations
+    with pytest.raises(ValueError, match="max_len"):
+        llama.init_cache(cfg, 2, cache_len=cfg.max_len * 2)
+
+
+def test_generate_reuses_compiled_fns():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :4]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    llama.generate(model, params, prompt, 2)
+    fns = llama._DECODE_FNS[(model, 0.0)]
+    llama.generate(model, params, prompt, 2)
+    assert llama._DECODE_FNS[(model, 0.0)] is fns
+    # an equal-config model instance shares the cache entry
+    assert (llama.Llama(cfg), 0.0) in llama._DECODE_FNS

@@ -138,14 +138,46 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
+# ------------------------------------------------------------------ decode
+def _cached_attention(q, k_cache, v_cache, q_pos, cache_len: int):
+    """Decode-mode attention: q [B,L,H,D] (the L new positions, already
+    rotated) against the full compact cache [B,C,KV,D]. Static shapes —
+    the cache is always its full allocated length and masking does the
+    bookkeeping (k slot j is visible iff j <= the query's global
+    position and j has been written). Grouped einsums contract against
+    the compact cache directly: the GQA memory win IS the cache."""
+    b, l, h, d = q.shape
+    kv_heads = k_cache.shape[2]
+    group = h // kv_heads
+    qg = q.reshape(b, l, kv_heads, group, d)
+    s = jnp.einsum(
+        "blhgd,bchd->bhglc", qg, k_cache, preferred_element_type=jnp.float32
+    ) / (d ** 0.5)
+    k_pos = jnp.arange(cache_len, dtype=jnp.int32)
+    mask = k_pos[None, :] <= q_pos[:, None]                   # [L, C]
+    s = jnp.where(mask[None, None, None], s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhglc,bchd->blhgd", p.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, l, h, d).astype(q.dtype)
+
+
 # ------------------------------------------------------------------ modules
 class GqaAttention(nn.Module):
-    """Grouped-query attention with rotary embeddings."""
+    """Grouped-query attention with rotary embeddings.
+
+    Training path: full-sequence causal attention via cfg.attention_fn
+    (flash / ring / ulysses — GQA-native backends get compact kv).
+    Decode path (cache=(k,v) [B,C,KV,D], pos [B or scalar]): the step's
+    k/v are written into the cache at `pos` and attention runs against
+    the whole cache with a position mask — returns (out, new_cache)."""
 
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, angles):
+    def __call__(self, x, angles, cache=None, pos=None):
         cfg = self.cfg
         dense = functools.partial(
             nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
@@ -156,6 +188,18 @@ class GqaAttention(nn.Module):
         k, v = kv[:, :, 0], kv[:, :, 1]
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
+        if cache is not None:
+            k_cache, v_cache = cache
+            l = x.shape[1]
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+            q_pos = pos + jnp.arange(l, dtype=jnp.int32)
+            out = _cached_attention(q, k_cache, v_cache, q_pos,
+                                    k_cache.shape[1])
+            proj = dense(features=cfg.d_model, axis=(-2, -1), name="out")
+            return proj(out), (k_cache, v_cache)
         attn = cfg.attention_fn or _einsum_attention
         if cfg.q_per_kv > 1 and not getattr(attn, "supports_gqa", False):
             # backend wants equal head counts: share each kv head across
@@ -197,12 +241,17 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, angles):
+    def __call__(self, x, angles, cache=None, pos=None):
         cfg = self.cfg
         norm = functools.partial(
             nn.RMSNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype
         )
-        x = x + GqaAttention(cfg, name="attn")(norm(name="ln1")(x), angles)
+        attn = GqaAttention(cfg, name="attn")
+        if cache is not None:
+            a, cache = attn(norm(name="ln1")(x), angles, cache, pos)
+            x = x + a
+            return x + SwiGlu(cfg, name="mlp")(norm(name="ln2")(x)), cache
+        x = x + attn(norm(name="ln1")(x), angles)
         return x + SwiGlu(cfg, name="mlp")(norm(name="ln2")(x))
 
 
@@ -215,23 +264,35 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
-                 positions=None):
+                 positions=None, cache=None, cache_pos=None):
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
         )
         table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta)
-        if positions is None:
+        decode = cache is not None
+        if decode:
+            # cache: per-layer (k, v) tuples (init_cache); cache_pos is the
+            # global position of tokens[:, 0] — rotation follows it
+            angles = jax.lax.dynamic_slice_in_dim(
+                table, cache_pos, tokens.shape[1])
+        elif positions is None:
             angles = table[: tokens.shape[1]]  # [S, D/2]
         else:
             angles = table[positions]  # [S, D/2] or [B, S, D/2]
         x = embed(tokens)
-        block = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
+        block = nn.remat(LlamaBlock) if (cfg.remat and not decode) else LlamaBlock
+        new_cache = []
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"block{i}")(x, angles)
+            blk = block(cfg, name=f"block{i}")
+            if decode:
+                x, layer_cache = blk(x, angles, cache[i], cache_pos)
+                new_cache.append(layer_cache)
+            else:
+                x = blk(x, angles)
         x = nn.RMSNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
-            return x
+            return (x, new_cache) if decode else x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
@@ -239,7 +300,110 @@ class Llama(nn.Module):
                 cfg.vocab_size, dtype=jnp.float32, use_bias=False,
                 name="lm_head",
             )(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return (logits, new_cache) if decode else logits
+
+
+# ---------------------------------------------------------------- generate
+def init_cache(cfg: LlamaConfig, batch: int, cache_len: Optional[int] = None,
+               dtype=None):
+    """Per-layer (k, v) caches [B, C, KV, D] — COMPACT kv heads: for 4:1
+    GQA the cache is 4x smaller than an MHA cache, which is the point of
+    GQA at inference (HBM capacity bounds batch x context).
+    C is capped at cfg.max_len: the RoPE table has max_len rows, so a
+    longer cache would silently decode with clamped (repeated) rotations."""
+    c = cache_len or cfg.max_len
+    if c > cfg.max_len:
+        raise ValueError(
+            f"cache_len {c} exceeds cfg.max_len {cfg.max_len} (the RoPE "
+            f"table bound — raise max_len/rope_theta for longer contexts)")
+    dt = dtype or cfg.dtype
+    shape = (batch, c, cfg.n_kv_heads, cfg.head_dim)
+    return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+            for _ in range(cfg.n_layers)]
+
+
+# jitted prefill/decode, keyed by (model, temperature) — flax modules hash
+# by their (frozen) config, so repeated generate() calls and equal-config
+# model instances share one compile instead of retracing per call
+_DECODE_FNS: dict = {}
+
+
+def _decode_fns(model, temperature: float):
+    key = (model, float(temperature))
+    if key not in _DECODE_FNS:
+        @jax.jit
+        def prefill(params, cache, prompt):
+            logits, cache = model.apply(
+                {"params": params}, prompt, cache=cache, cache_pos=0)
+            return logits[:, -1], cache
+
+        @functools.partial(jax.jit, static_argnums=(5,))
+        def decode(params, cache, first, pos0, rng, length):
+            def step(carry, _):
+                cache, tok, pos, k = carry
+                logits, cache = model.apply(
+                    {"params": params}, tok[:, None], cache=cache,
+                    cache_pos=pos)
+                k, sub = jax.random.split(k)
+                nxt = _select_token(logits[:, 0], temperature, sub)
+                return (cache, nxt, pos + 1, k), nxt
+
+            _, rest = jax.lax.scan(
+                step, (cache, first, pos0, rng), None, length=length)
+            return rest
+
+        _DECODE_FNS[key] = (prefill, decode)
+    return _DECODE_FNS[key]
+
+
+def generate(model, params, prompt, max_new_tokens: int,
+             rng=None, temperature: float = 0.0,
+             cache_len: Optional[int] = None):
+    """Autoregressive decoding: one prefill pass over the prompt (all
+    positions in one MXU-friendly call), then `max_new_tokens` single-
+    token steps through a `lax.scan` — static shapes; prefill and the
+    decode scan each compile once per (model, temperature, length) and
+    are reused across calls. temperature 0 -> greedy argmax; else
+    softmax sampling at that temperature. Returns [B, max_new_tokens].
+
+    The KV cache is allocated once at full length and positions beyond
+    the current step are masked — the standard TPU decode layout (no
+    dynamic shapes anywhere under jit)."""
+    cfg = model.cfg
+    b, prompt_len = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    total = prompt_len + max_new_tokens
+    if total > (cache_len or cfg.max_len):
+        raise ValueError(
+            f"prompt {prompt_len} + new {max_new_tokens} exceeds cache "
+            f"length {cache_len or cfg.max_len}")
+    cache = init_cache(cfg, b, cache_len)
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    k_first, k_rest = jax.random.split(rng)  # single-use key discipline
+
+    prefill, decode = _decode_fns(model, temperature)
+    last_logits, cache = prefill(params, cache, prompt)
+    first = _select_token(last_logits, temperature, k_first)
+    if max_new_tokens == 1:
+        return first[:, None]
+    rest = decode(params, cache, first, jnp.int32(prompt_len), k_rest,
+                  max_new_tokens - 1)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def _select_token(logits, temperature: float, key):
+    """[B, V] logits -> [B] token ids (greedy at temperature 0)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1
+    ).astype(jnp.int32)
 
 
 def params_flops_per_token(cfg: LlamaConfig) -> float:
