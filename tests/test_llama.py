@@ -458,8 +458,92 @@ def test_generate_reuses_compiled_fns():
     prompt = _tokens(cfg, batch=2)[:, :4]
     params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
     llama.generate(model, params, prompt, 2)
-    fns = llama._DECODE_FNS[(model, 0.0)]
+    fns = llama._decode_fns(model, 0.0)
+    before = llama._decode_fns.cache_info().hits
     llama.generate(model, params, prompt, 2)
-    assert llama._DECODE_FNS[(model, 0.0)] is fns
+    assert llama._decode_fns.cache_info().hits > before
     # an equal-config model instance shares the cache entry
-    assert (llama.Llama(cfg), 0.0) in llama._DECODE_FNS
+    assert llama._decode_fns(llama.Llama(cfg), 0.0) is fns
+
+
+# ------------------------------------------------------------------ MoE
+def test_moe_llama_trains_and_collects_aux():
+    from tf_operator_tpu.models.transformer import apply_with_aux
+
+    cfg = _f32(n_experts=4, moe_every=2)
+    model = llama.Llama(cfg)
+    toks = _tokens(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    # experts only in every 2nd block; swiglu experts pack gate+up
+    assert "moe" in params["block1"] and "mlp" in params["block0"]
+    assert params["block1"]["moe"]["wi"].shape == (4, 64, 256)
+    assert params["block1"]["moe"]["wo"].shape == (4, 128, 64)
+    logits, aux = apply_with_aux(model, params, toks)
+    assert jnp.isfinite(logits).all()
+    assert float(aux) > 0.0  # load-balance loss collected via sow
+
+
+def test_moe_llama_ep_dispatch_matches_dense_reference():
+    """All-to-all SwiGLU experts over an ep mesh == the dense masked
+    dispatch (capacity = tokens so nothing drops)."""
+    from tf_operator_tpu.models.transformer import apply_with_aux
+    from tf_operator_tpu.parallel.ep import make_switch_moe
+
+    mesh = make_mesh({"ep": 2, "dp": 4}, devices=jax.devices()[:8])
+    n_e = 4
+    dense_cfg = _f32(n_experts=n_e, moe_every=2)
+    dispatch = make_switch_moe(mesh, n_e, capacity_factor=float(n_e),
+                               activation="swiglu")
+    ep_cfg = _f32(n_experts=n_e, moe_every=2, moe_dispatch_fn=dispatch)
+    toks = _tokens(cfg=dense_cfg, batch=4)
+    dense_model = llama.Llama(dense_cfg)
+    params = dense_model.init(
+        jax.random.PRNGKey(0), toks, train=False)["params"]
+    want, aux_d = apply_with_aux(dense_model, params, toks)
+    with mesh:
+        got, aux_e = jax.jit(
+            lambda p, t: apply_with_aux(llama.Llama(ep_cfg), p, t)
+        )(params, toks)
+    assert jnp.allclose(got, want, atol=2e-3), float(jnp.abs(got - want).max())
+    # aux is a pmean of per-shard stats — looser (see __graft_entry__)
+    assert abs(float(aux_e) - float(aux_d)) / abs(float(aux_d)) < 0.3
+
+
+def test_moe_llama_decode_matches_full_forward():
+    """Generation with MoE blocks: cached decode logits == full forward."""
+    cfg = _f32(n_experts=4, moe_every=2)
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :8]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    full = model.apply({"params": params}, prompt)
+    cache = llama.init_cache(cfg, 2)
+    dec, _ = model.apply({"params": params}, prompt, cache=cache, cache_pos=0)
+    assert jnp.allclose(dec, full, atol=1e-4), float(jnp.abs(dec - full).max())
+
+
+def test_mixtral_factory():
+    cfg = llama.mixtral_8x7b()
+    assert cfg.n_experts == 8 and cfg.moe_every == 1
+    assert cfg.q_per_kv == 4
+
+
+def test_moe_llama_decode_with_ep_dispatch_falls_back_dense():
+    """A model built with the all-to-all dispatch must still decode: the
+    cache path forces dense routing (single-token steps can't satisfy
+    the dispatch's token divisibility and don't need its collectives)."""
+    from tf_operator_tpu.parallel.ep import make_switch_moe
+
+    mesh = make_mesh({"ep": 2, "dp": 4}, devices=jax.devices()[:8])
+    dispatch = make_switch_moe(mesh, 4, capacity_factor=4.0,
+                               activation="swiglu")
+    cfg = _f32(n_experts=4, moe_every=2, moe_dispatch_fn=dispatch)
+    model = llama.Llama(cfg)
+    # init takes the training path: its sample must satisfy the dispatch's
+    # token divisibility (4 % ep == 0); decode afterwards may use ANY
+    # prompt length (5 here) because the cache path routes densely
+    init_toks = _tokens(cfg, batch=1)[:, :4]
+    params = model.init(
+        jax.random.PRNGKey(0), init_toks, train=False)["params"]
+    prompt = _tokens(cfg, batch=1)[:, :5]
+    out = llama.generate(model, params, prompt, 3)
+    assert out.shape == (1, 3)

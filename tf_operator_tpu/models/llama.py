@@ -58,6 +58,14 @@ class LlamaConfig:
     # post-RoPE (q, k, v, causal=True)
     attention_fn: Optional[Callable] = None
     remat: bool = False  # jax.checkpoint each block
+    # Mixtral-style sparse FFN: replace the SwiGLU MLP with switch-routed
+    # SwiGLU experts every `moe_every` blocks (0 experts = dense)
+    n_experts: int = 0
+    moe_every: int = 2
+    # None -> dense masked-einsum dispatch; or
+    # parallel/ep.make_switch_moe(..., activation="swiglu") for explicit
+    # all-to-all expert parallelism: (x, logits, wi, wo) -> (y, aux)
+    moe_dispatch_fn: Optional[Callable] = None
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
@@ -99,6 +107,16 @@ def llama3_8b(**kw) -> LlamaConfig:
     return _config(dict(
         vocab_size=128256, d_model=4096, n_heads=32, n_kv_heads=8,
         n_layers=32, d_ff=14336, max_len=8192, rope_theta=500000.0,
+    ), kw)
+
+
+def mixtral_8x7b(**kw) -> LlamaConfig:
+    """Mixtral-class sparse config: 8 SwiGLU experts in EVERY block,
+    top-1 switch routing (active params per token ~ the dense 7B)."""
+    return _config(dict(
+        vocab_size=32000, d_model=4096, n_heads=32, n_kv_heads=8,
+        n_layers=32, d_ff=14336, max_len=8192, rope_theta=1000000.0,
+        n_experts=8, moe_every=1,
     ), kw)
 
 
@@ -170,9 +188,11 @@ class GqaAttention(nn.Module):
 
     Training path: full-sequence causal attention via cfg.attention_fn
     (flash / ring / ulysses — GQA-native backends get compact kv).
-    Decode path (cache=(k,v) [B,C,KV,D], pos [B or scalar]): the step's
-    k/v are written into the cache at `pos` and attention runs against
-    the whole cache with a position mask — returns (out, new_cache)."""
+    Decode path (cache=(k,v) [B,C,KV,D], pos a scalar — every sequence
+    in the batch decodes at the same position; ragged continuation is
+    not supported): the step's k/v are written into the cache at `pos`
+    and attention runs against the whole cache with a position mask —
+    returns (out, new_cache)."""
 
     cfg: LlamaConfig
 
@@ -237,8 +257,53 @@ class SwiGlu(nn.Module):
         )(h)
 
 
+class MoeSwiGlu(nn.Module):
+    """Mixtral-style sparse FFN: top-1 switch routing over SwiGLU experts.
+
+    Dense masked-einsum dispatch by default (capacity = tokens, nothing
+    drops; GSPMD shards experts via the `moe/*` rules in parallel/tp.py),
+    or explicit all-to-all expert parallelism when cfg.moe_dispatch_fn is
+    set (parallel/ep.make_switch_moe(..., activation='swiglu')). Shares
+    the transformer family's param naming (router / moe/wi / moe/wo) so
+    the ep+tp sharding rules apply unchanged."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, force_dense: bool = False):
+        cfg = self.cfg
+        n_e = cfg.n_experts
+        d = cfg.d_model
+        router = nn.Dense(n_e, dtype=jnp.float32, use_bias=False, name="router")
+        logits = router(x.astype(jnp.float32))  # [B,S,E]
+        # gate+up packed on the last dim: [X, D, 2F] — one MXU matmul/expert
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (n_e, d, 2 * cfg.d_ff),
+            jnp.float32,
+        ).astype(cfg.dtype)
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (n_e, cfg.d_ff, d),
+            jnp.float32,
+        ).astype(cfg.dtype)
+
+        # force_dense: decode steps are a handful of tokens — the all-to-all
+        # dispatch's token-divisibility can't hold and its collectives buy
+        # nothing, so the cache path routes densely (identical top-1 math
+        # when nothing overflows, which a single token never does)
+        if cfg.moe_dispatch_fn is not None and not force_dense:
+            out, aux = cfg.moe_dispatch_fn(x, logits, wi, wo)
+        else:
+            from tf_operator_tpu.parallel.ep import dense_switch_dispatch
+
+            out, aux = dense_switch_dispatch(
+                x, logits, wi, wo, activation="swiglu", dtype=cfg.dtype)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return out
+
+
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, angles, cache=None, pos=None):
@@ -247,12 +312,16 @@ class LlamaBlock(nn.Module):
             nn.RMSNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype
         )
         attn = GqaAttention(cfg, name="attn")
+        mlp = (MoeSwiGlu(cfg, name="moe") if self.use_moe
+               else SwiGlu(cfg, name="mlp"))
         if cache is not None:
             a, cache = attn(norm(name="ln1")(x), angles, cache, pos)
             x = x + a
-            return x + SwiGlu(cfg, name="mlp")(norm(name="ln2")(x)), cache
+            h = norm(name="ln2")(x)
+            y = mlp(h, force_dense=True) if self.use_moe else mlp(h)
+            return x + y, cache
         x = x + attn(norm(name="ln1")(x), angles)
-        return x + SwiGlu(cfg, name="mlp")(norm(name="ln2")(x))
+        return x + mlp(norm(name="ln2")(x))
 
 
 class Llama(nn.Module):
@@ -284,7 +353,9 @@ class Llama(nn.Module):
         block = nn.remat(LlamaBlock) if (cfg.remat and not decode) else LlamaBlock
         new_cache = []
         for i in range(cfg.n_layers):
-            blk = block(cfg, name=f"block{i}")
+            use_moe = (cfg.n_experts > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            blk = block(cfg, use_moe=use_moe, name=f"block{i}")
             if decode:
                 x, layer_cache = blk(x, angles, cache[i], cache_pos)
                 new_cache.append(layer_cache)
@@ -325,36 +396,34 @@ def init_cache(cfg: LlamaConfig, batch: int, cache_len: Optional[int] = None,
 
 # jitted prefill/decode, keyed by (model, temperature) — flax modules hash
 # by their (frozen) config, so repeated generate() calls and equal-config
-# model instances share one compile instead of retracing per call
-_DECODE_FNS: dict = {}
-
-
+# model instances share one compile instead of retracing per call. The
+# cache is BOUNDED: each entry pins jitted closures (and through the
+# model, any moe_dispatch_fn mesh) alive — per-request temperatures in a
+# serving loop must not grow it forever.
+@functools.lru_cache(maxsize=8)
 def _decode_fns(model, temperature: float):
-    key = (model, float(temperature))
-    if key not in _DECODE_FNS:
-        @jax.jit
-        def prefill(params, cache, prompt):
+    @jax.jit
+    def prefill(params, cache, prompt):
+        logits, cache = model.apply(
+            {"params": params}, prompt, cache=cache, cache_pos=0)
+        return logits[:, -1], cache
+
+    @functools.partial(jax.jit, static_argnums=(5,))
+    def decode(params, cache, first, pos0, rng, length):
+        def step(carry, _):
+            cache, tok, pos, k = carry
             logits, cache = model.apply(
-                {"params": params}, prompt, cache=cache, cache_pos=0)
-            return logits[:, -1], cache
+                {"params": params}, tok[:, None], cache=cache,
+                cache_pos=pos)
+            k, sub = jax.random.split(k)
+            nxt = _select_token(logits[:, 0], temperature, sub)
+            return (cache, nxt, pos + 1, k), nxt
 
-        @functools.partial(jax.jit, static_argnums=(5,))
-        def decode(params, cache, first, pos0, rng, length):
-            def step(carry, _):
-                cache, tok, pos, k = carry
-                logits, cache = model.apply(
-                    {"params": params}, tok[:, None], cache=cache,
-                    cache_pos=pos)
-                k, sub = jax.random.split(k)
-                nxt = _select_token(logits[:, 0], temperature, sub)
-                return (cache, nxt, pos + 1, k), nxt
+        _, rest = jax.lax.scan(
+            step, (cache, first, pos0, rng), None, length=length)
+        return rest
 
-            _, rest = jax.lax.scan(
-                step, (cache, first, pos0, rng), None, length=length)
-            return rest
-
-        _DECODE_FNS[key] = (prefill, decode)
-    return _DECODE_FNS[key]
+    return prefill, decode
 
 
 def generate(model, params, prompt, max_new_tokens: int,
@@ -377,10 +446,15 @@ def generate(model, params, prompt, max_new_tokens: int,
     if max_new_tokens == 0:
         return jnp.zeros((b, 0), jnp.int32)
     total = prompt_len + max_new_tokens
-    if total > (cache_len or cfg.max_len):
+    if cache_len is None:
+        # size the cache to the request, bucketed to 128-multiples so
+        # nearby request sizes share one compile — decoding a short
+        # generation must not attend over all cfg.max_len slots
+        cache_len = min(cfg.max_len, (total + 127) // 128 * 128)
+    if total > cache_len:
         raise ValueError(
             f"prompt {prompt_len} + new {max_new_tokens} exceeds cache "
-            f"length {cache_len or cfg.max_len}")
+            f"length {cache_len}")
     cache = init_cache(cfg, b, cache_len)
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")

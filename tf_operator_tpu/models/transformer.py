@@ -147,24 +147,13 @@ class MoeMlp(nn.Module):
 
         if cfg.moe_dispatch_fn is not None:
             out, aux = cfg.moe_dispatch_fn(x, logits, wi, wo)
-            self.sow("intermediates", "moe_aux_loss", aux)
-            return out
+        else:
+            from tf_operator_tpu.parallel.ep import dense_switch_dispatch
 
-        probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)  # [B,S]
-        gate = jnp.max(probs, axis=-1)  # [B,S]
-        onehot = jax.nn.one_hot(expert_idx, n_e, dtype=cfg.dtype)  # [B,S,E]
-        # dense dispatch: every token through its expert via masked einsum
-        h = jnp.einsum("bsd,edf->bsef", x, wi)
-        h = nn.gelu(h)
-        out = jnp.einsum("bsef,efd->bsed", h, wo)
-        out = jnp.einsum("bsed,bse->bsd", out, onehot)
-        # auxiliary load-balancing loss (Switch Transformer)
-        density = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))  # [E]
-        router_mean = jnp.mean(probs, axis=(0, 1))  # [E]
-        aux = n_e * jnp.sum(density * router_mean)
+            out, aux = dense_switch_dispatch(
+                x, logits, wi, wo, activation="gelu", dtype=cfg.dtype)
         self.sow("intermediates", "moe_aux_loss", aux)
-        return out * gate[..., None].astype(cfg.dtype)
+        return out
 
 
 class Block(nn.Module):

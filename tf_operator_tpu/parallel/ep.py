@@ -59,6 +59,16 @@ def switch_route(
     return dispatch, gate, aux
 
 
+def _expert_ffn(h: jax.Array, act: str) -> jax.Array:
+    """Post-wi nonlinearity. 'gelu': plain. 'swiglu': wi packed the gate
+    and up halves on the last dim ([..., 2f] -> silu(gate) * up) — the
+    LLaMA/Mixtral expert FFN."""
+    if act == "swiglu":
+        gate, up = jnp.split(h, 2, axis=-1)
+        return jax.nn.silu(gate) * up
+    return jax.nn.gelu(h)
+
+
 def _local_moe(
     x: jax.Array,
     router_logits: jax.Array,
@@ -68,11 +78,13 @@ def _local_moe(
     n_experts: int,
     capacity: int,
     axis_name: str,
+    activation: str = "gelu",
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-device body under shard_map.
 
     x [T, d] local tokens; router_logits [T, E]; wi [E_local, d, f],
-    wo [E_local, f, d] local expert weights (E_local = E / ep).
+    wo [E_local, f, d] local expert weights (E_local = E / ep); for
+    activation='swiglu' wi is [E_local, d, 2f] (gate+up packed).
     """
     ep = jax.lax.psum(1, axis_name)
     e_local = n_experts // ep
@@ -89,7 +101,7 @@ def _local_moe(
 
     # local expert FFN over all sources at once: [ep, E_local, C, d]
     h = jnp.einsum("secd,edf->secf", buckets, wi)
-    h = jax.nn.gelu(h)
+    h = _expert_ffn(h, activation)
     out = jnp.einsum("secf,efd->secd", h, wo)
 
     # all_to_all #2: route results back to the token-owning devices
@@ -108,13 +120,16 @@ def make_switch_moe(
     n_experts: int,
     capacity_factor: float = 1.25,
     axis_name: str = "ep",
+    activation: str = "gelu",
 ):
     """Build f(x, router_logits, wi, wo) -> (y, aux) running all-to-all EP
     over `mesh`.
 
     Global shapes: x [B, S, d] (batch sharded over ep), router_logits
-    [B, S, E], wi [E, d, f] / wo [E, f, d] (experts sharded over ep).
-    Capacity per (device, expert) = ceil(local_tokens / E * factor).
+    [B, S, E], wi [E, d, f] / wo [E, f, d] (experts sharded over ep);
+    activation='swiglu' expects wi [E, d, 2f] (gate+up packed — the
+    LLaMA/Mixtral expert FFN). Capacity per (device, expert) =
+    ceil(local_tokens / E * factor).
     """
     ep = mesh.shape.get(axis_name, 1)
     if n_experts % ep:
@@ -132,6 +147,7 @@ def make_switch_moe(
             n_experts=n_experts,
             capacity=capacity,
             axis_name=axis_name,
+            activation=activation,
         )
         # flatten tokens; shard them over ep; experts already over ep
         xf = x.reshape(b * s, d)
@@ -148,7 +164,30 @@ def make_switch_moe(
     return run
 
 
-def dense_reference_moe(x, router_logits, wi, wo, capacity: int):
+def dense_switch_dispatch(x, router_logits, wi, wo, activation: str = "gelu",
+                          dtype=None):
+    """Dense masked-einsum top-1 dispatch — the zero-comm MoE path both
+    model families share (transformer.MoeMlp, llama.MoeSwiGlu): every
+    token through its argmax expert via one-hot einsums (capacity =
+    tokens, nothing drops), Switch aux loss included. GSPMD shards the
+    expert dim; best at moderate E. Returns (y [B,S,D], aux)."""
+    dt = dtype or x.dtype
+    probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E] f32
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert_idx, wi.shape[0], dtype=dt)
+    h = _expert_ffn(jnp.einsum("bsd,edf->bsef", x, wi), activation)
+    out = jnp.einsum("bsef,efd->bsed", h, wo)
+    out = jnp.einsum("bsed,bse->bsd", out, onehot)
+    # auxiliary load-balancing loss (Switch Transformer eq. 4)
+    density = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))
+    router_mean = jnp.mean(probs, axis=(0, 1))
+    aux = wi.shape[0] * jnp.sum(density * router_mean)
+    return out * gate[..., None].astype(dt), aux
+
+
+def dense_reference_moe(x, router_logits, wi, wo, capacity: int,
+                        activation: str = "gelu"):
     """Single-device reference with identical routing/capacity semantics —
     the correctness oracle for tests."""
     b, s, d = x.shape
@@ -158,7 +197,7 @@ def dense_reference_moe(x, router_logits, wi, wo, capacity: int):
     )
     dispatch = dispatch.astype(x.dtype)
     buckets = jnp.einsum("tec,td->ecd", dispatch, xf)
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", buckets, wi))
+    h = _expert_ffn(jnp.einsum("ecd,edf->ecf", buckets, wi), activation)
     out = jnp.einsum("ecf,efd->ecd", h, wo)
     y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None].astype(x.dtype)
     return y.reshape(b, s, d), aux
