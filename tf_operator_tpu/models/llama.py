@@ -58,6 +58,11 @@ class LlamaConfig:
     # post-RoPE (q, k, v, causal=True)
     attention_fn: Optional[Callable] = None
     remat: bool = False  # jax.checkpoint each block
+    # Mistral-style sliding-window attention (causal band: each query
+    # sees itself + window-1 previous positions); None = full causal.
+    # Passed as window= to the attention backend — flash and the einsum
+    # reference support it; ring/ulysses raise (unsupported there).
+    sliding_window: Optional[int] = None
     # Mixtral-style sparse FFN: replace the SwiGLU MLP with switch-routed
     # SwiGLU experts every `moe_every` blocks (0 experts = dense)
     n_experts: int = 0
@@ -79,6 +84,10 @@ class LlamaConfig:
             )
         if self.head_dim % 2:
             raise ValueError(f"head_dim {self.head_dim} must be even for RoPE")
+        if self.n_experts > 0 and self.moe_every < 1:
+            raise ValueError(
+                f"moe_every must be >= 1 when n_experts > 0, got "
+                f"{self.moe_every}")
 
     @property
     def head_dim(self) -> int:
@@ -107,6 +116,15 @@ def llama3_8b(**kw) -> LlamaConfig:
     return _config(dict(
         vocab_size=128256, d_model=4096, n_heads=32, n_kv_heads=8,
         n_layers=32, d_ff=14336, max_len=8192, rope_theta=500000.0,
+    ), kw)
+
+
+def mistral_7b(**kw) -> LlamaConfig:
+    """Mistral-class: 4:1 GQA + 4096-token sliding-window attention."""
+    return _config(dict(
+        vocab_size=32000, d_model=4096, n_heads=32, n_kv_heads=8,
+        n_layers=32, d_ff=14336, max_len=8192, rope_theta=1000000.0,
+        sliding_window=4096,
     ), kw)
 
 
@@ -157,13 +175,20 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
 
 
 # ------------------------------------------------------------------ decode
-def _cached_attention(q, k_cache, v_cache, q_pos, cache_len: int):
+def _cached_attention(q, k_cache, v_cache, q_pos, cache_len: int,
+                      window=None):
     """Decode-mode attention: q [B,L,H,D] (the L new positions, already
-    rotated) against the full compact cache [B,C,KV,D]. Static shapes —
-    the cache is always its full allocated length and masking does the
-    bookkeeping (k slot j is visible iff j <= the query's global
-    position and j has been written). Grouped einsums contract against
-    the compact cache directly: the GQA memory win IS the cache."""
+    rotated) against the compact cache [B,C,KV,D]. Static shapes — the
+    cache is its full allocated length and masking does the bookkeeping.
+    Grouped einsums contract against the compact cache directly: the GQA
+    memory win IS the cache.
+
+    The cache is a RING BUFFER: global position p lives in slot p % C,
+    so a sliding-window model sizes C to the window, not the context
+    (O(window) decode memory/FLOPs — the Mistral cache layout). Slot
+    j's last-written global position is q_pos - ((q_pos - j) mod C);
+    that one formula also covers the linear case (C >= every position):
+    unwritten slots resolve to negative positions and mask out."""
     b, l, h, d = q.shape
     kv_heads = k_cache.shape[2]
     group = h // kv_heads
@@ -171,8 +196,13 @@ def _cached_attention(q, k_cache, v_cache, q_pos, cache_len: int):
     s = jnp.einsum(
         "blhgd,bchd->bhglc", qg, k_cache, preferred_element_type=jnp.float32
     ) / (d ** 0.5)
-    k_pos = jnp.arange(cache_len, dtype=jnp.int32)
-    mask = k_pos[None, :] <= q_pos[:, None]                   # [L, C]
+    slot = jnp.arange(cache_len, dtype=jnp.int32)
+    k_global = q_pos[:, None] - jnp.mod(
+        q_pos[:, None] - slot[None, :], cache_len)            # [L, C]
+    mask = k_global >= 0  # written (and causal: k_global <= q_pos always)
+    if window is not None:
+        # sliding band: slots older than window-1 steps are invisible
+        mask &= k_global > q_pos[:, None] - window
     s = jnp.where(mask[None, None, None], s, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
@@ -211,33 +241,54 @@ class GqaAttention(nn.Module):
         if cache is not None:
             k_cache, v_cache = cache
             l = x.shape[1]
+            # ring-buffer write: global position p -> slot p % C. Callers
+            # guarantee a multi-position write never wraps (generate
+            # enforces prompt_len <= C), so one contiguous slice suffices.
+            slot = jnp.mod(pos, k_cache.shape[1])
             k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+                k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+                v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
             q_pos = pos + jnp.arange(l, dtype=jnp.int32)
             out = _cached_attention(q, k_cache, v_cache, q_pos,
-                                    k_cache.shape[1])
+                                    k_cache.shape[1],
+                                    window=cfg.sliding_window)
             proj = dense(features=cfg.d_model, axis=(-2, -1), name="out")
             return proj(out), (k_cache, v_cache)
         attn = cfg.attention_fn or _einsum_attention
-        if cfg.q_per_kv > 1 and not getattr(attn, "supports_gqa", False):
+        if cfg.q_per_kv > 1 and not _supports_gqa(attn):
             # backend wants equal head counts: share each kv head across
             # its query group by broadcast (XLA fuses it into the score
             # contraction). GQA-native backends (pallas flash) instead
             # index the shared head inside the kernel — no repeat.
             k = jnp.repeat(k, cfg.q_per_kv, axis=2)
             v = jnp.repeat(v, cfg.q_per_kv, axis=2)
-        out = attn(q, k, v, True)
+        kw = {}
+        if cfg.sliding_window is not None:
+            # backends without sliding-window support fail loudly here
+            # (TypeError) rather than silently attending the full context
+            kw["window"] = cfg.sliding_window
+        out = attn(q, k, v, True, **kw)
         return dense(
             features=cfg.d_model, axis=(-2, -1), name="out"
         )(out)
 
 
-def _einsum_attention(q, k, v, causal: bool) -> jax.Array:
+def _einsum_attention(q, k, v, causal: bool, **kw) -> jax.Array:
     from tf_operator_tpu.models.transformer import dot_product_attention
 
-    return dot_product_attention(q, k, v, causal)
+    return dot_product_attention(q, k, v, causal, **kw)
+
+
+def _supports_gqa(attn) -> bool:
+    """Does the backend consume compact [B,S,KV,D] kv natively? Looks
+    through functools.partial layers (a partial of flash_attention with
+    custom block sizes must not silently fall back to broadcast)."""
+    while attn is not None:
+        if getattr(attn, "supports_gqa", False):
+            return True
+        attn = getattr(attn, "func", None)
+    return False
 
 
 class SwiGlu(nn.Module):
@@ -270,7 +321,7 @@ class MoeSwiGlu(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, force_dense: bool = False):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         n_e = cfg.n_experts
         d = cfg.d_model
@@ -286,11 +337,22 @@ class MoeSwiGlu(nn.Module):
             jnp.float32,
         ).astype(cfg.dtype)
 
-        # force_dense: decode steps are a handful of tokens — the all-to-all
-        # dispatch's token-divisibility can't hold and its collectives buy
-        # nothing, so the cache path routes densely (identical top-1 math
-        # when nothing overflows, which a single token never does)
-        if cfg.moe_dispatch_fn is not None and not force_dense:
+        if decode:
+            # decode steps carry a handful of tokens: GATHER each token's
+            # argmax expert and run only it — sparse inference reads one
+            # expert's weights per token instead of all E (the all-to-all
+            # dispatch is useless here anyway: its token-divisibility
+            # cannot hold for L=1 and its collectives buy nothing)
+            probs = jax.nn.softmax(logits, axis=-1)
+            e_idx = jnp.argmax(probs, axis=-1)               # [B,L]
+            gate = jnp.max(probs, axis=-1)                   # [B,L]
+            h = jnp.einsum("bld,bldf->blf", x, wi[e_idx])
+            g, up = jnp.split(h, 2, axis=-1)
+            out = jnp.einsum("blf,blfd->bld", nn.silu(g) * up, wo[e_idx])
+            self.sow("intermediates", "moe_aux_loss",
+                     jnp.zeros((), jnp.float32))
+            return out * gate[..., None].astype(cfg.dtype)
+        if cfg.moe_dispatch_fn is not None:
             out, aux = cfg.moe_dispatch_fn(x, logits, wi, wo)
         else:
             from tf_operator_tpu.parallel.ep import dense_switch_dispatch
@@ -318,7 +380,7 @@ class LlamaBlock(nn.Module):
             a, cache = attn(norm(name="ln1")(x), angles, cache, pos)
             x = x + a
             h = norm(name="ln2")(x)
-            y = mlp(h, force_dense=True) if self.use_moe else mlp(h)
+            y = mlp(h, decode=True) if self.use_moe else mlp(h)
             return x + y, cache
         x = x + attn(norm(name="ln1")(x), angles)
         return x + mlp(norm(name="ln2")(x))
@@ -400,8 +462,14 @@ def init_cache(cfg: LlamaConfig, batch: int, cache_len: Optional[int] = None,
 # cache is BOUNDED: each entry pins jitted closures (and through the
 # model, any moe_dispatch_fn mesh) alive — per-request temperatures in a
 # serving loop must not grow it forever.
+def _decode_fns(model, temperature):
+    # coerce BEFORE the cache key: a jnp/np scalar temperature must not
+    # crash on hashing or fragment the 8-slot cache vs the equal float
+    return _decode_fns_cached(model, float(temperature))
+
+
 @functools.lru_cache(maxsize=8)
-def _decode_fns(model, temperature: float):
+def _decode_fns_cached(model, temperature: float):
     @jax.jit
     def prefill(params, cache, prompt):
         logits, cache = model.apply(
@@ -446,15 +514,40 @@ def generate(model, params, prompt, max_new_tokens: int,
     if max_new_tokens == 0:
         return jnp.zeros((b, 0), jnp.int32)
     total = prompt_len + max_new_tokens
+    if total > cfg.max_len:
+        raise ValueError(
+            f"prompt {prompt_len} + new {max_new_tokens} exceeds RoPE "
+            f"table length max_len={cfg.max_len}")
+
+    def bucket(n):  # 128-multiples so nearby request sizes share a compile
+        return min(cfg.max_len, (n + 127) // 128 * 128)
+
     if cache_len is None:
-        # size the cache to the request, bucketed to 128-multiples so
-        # nearby request sizes share one compile — decoding a short
-        # generation must not attend over all cfg.max_len slots
-        cache_len = min(cfg.max_len, (total + 127) // 128 * 128)
-    if total > cache_len:
+        cache_len = bucket(total)
+        if cfg.sliding_window is not None:
+            # ring buffer: positions beyond the window are invisible, so
+            # the cache only needs window slots (plus room for the whole
+            # prompt, whose prefill write must not wrap) — O(window)
+            # decode memory instead of O(context)
+            cache_len = min(cache_len,
+                            max(bucket(cfg.sliding_window),
+                                bucket(prompt_len)))
+    if cfg.sliding_window is None and total > cache_len:
         raise ValueError(
             f"prompt {prompt_len} + new {max_new_tokens} exceeds cache "
             f"length {cache_len}")
+    if prompt_len > cache_len:
+        raise ValueError(
+            f"prompt {prompt_len} exceeds cache length {cache_len} "
+            f"(the prefill write must not wrap the ring)")
+    if (cfg.sliding_window is not None
+            and cache_len < min(cfg.sliding_window, total)):
+        # a ring smaller than the visible window silently loses positions
+        # the model should still attend — reject, never approximate
+        raise ValueError(
+            f"cache_len {cache_len} < sliding window "
+            f"{min(cfg.sliding_window, total)} — visible positions would "
+            f"be overwritten")
     cache = init_cache(cfg, b, cache_len)
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")

@@ -74,15 +74,24 @@ def tiny(**kw) -> TransformerConfig:
     ), kw)
 
 
-def dot_product_attention(q, k, v, causal: bool) -> jax.Array:
+def dot_product_attention(q, k, v, causal: bool, *,
+                          window: "Optional[int]" = None) -> jax.Array:
     """Reference attention path: [B, S, H, D] einsums. Replaced by the
-    pallas flash kernel on TPU (ops/flash_attention.py)."""
+    pallas flash kernel on TPU (ops/flash_attention.py). `window`
+    (causal only): sliding-window band — each query sees itself plus the
+    window-1 previous positions."""
     depth = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
     if causal:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+        q_ids = jnp.arange(s_q)[:, None]
+        k_ids = jnp.arange(s_k)[None, :]
+        mask = q_ids >= k_ids
+        if window is not None:
+            mask &= k_ids > q_ids - window
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    elif window is not None:
+        raise ValueError("window requires causal=True")
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 

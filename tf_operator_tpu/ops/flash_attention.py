@@ -74,11 +74,30 @@ def _compiler_params(interpret: bool):
         return None
 
 
-def _causal_mask(q_start, k_start, blk_q: int, blk_k: int):
-    """[blk_q, blk_k] bool: global q index >= global k index."""
+def _causal_mask(q_start, k_start, blk_q: int, blk_k: int,
+                 window: "Optional[int]" = None):
+    """[blk_q, blk_k] bool: global q index >= global k index; with a
+    sliding window W, additionally k index > q index - W (each query
+    sees itself plus the W-1 previous positions — Mistral convention)."""
     q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
     k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-    return q_ids >= k_ids
+    mask = q_ids >= k_ids
+    if window is not None:
+        mask &= k_ids > q_ids - window
+    return mask
+
+
+def _tile_live(q_start, k_start, blk_q: int, blk_k: int, causal: bool,
+               window: "Optional[int]"):
+    """Whether tile (q_start.., k_start..) can contain ANY unmasked pair:
+    causality kills tiles fully past the diagonal, a sliding window kills
+    tiles fully before the band. The starts derive from program ids, so
+    this is a traced predicate fed to pl.when — skipped tiles cost only
+    grid overhead, giving O(S·W) work at long context."""
+    live = (k_start <= q_start + blk_q - 1) if causal else (k_start >= 0)
+    if window is not None:
+        live &= k_start + blk_k - 1 > q_start - window
+    return live
 
 
 def _dot(a, b, dims, out=jnp.float32):
@@ -91,7 +110,8 @@ def _dot(a, b, dims, out=jnp.float32):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, causal: bool, scale: float, n_kv: int):
+                *, causal: bool, scale: float, n_kv: int,
+                window: "Optional[int]" = None):
     blk_q, d = q_ref.shape[1], q_ref.shape[2]
     blk_k = k_ref.shape[1]
     j, t = pl.program_id(1), pl.program_id(2)
@@ -103,16 +123,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: tiles strictly past the diagonal contribute nothing
-    live = (k_start <= q_start + blk_q - 1) if causal else (t >= 0)
+    # causal: tiles strictly past the diagonal contribute nothing;
+    # sliding window: neither do tiles entirely before the band
+    live = _tile_live(q_start, k_start, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
         q = q_ref[0]  # native dtype: bf16 operands run the MXU at full rate
         s = _dot(q, k_ref[0], ((1,), (1,))) * scale  # [blk_q, blk_k] f32
         if causal:
-            s = jnp.where(_causal_mask(q_start, k_start, blk_q, blk_k),
-                          s, NEG_INF)
+            s = jnp.where(
+                _causal_mask(q_start, k_start, blk_q, blk_k, window),
+                s, NEG_INF)
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -147,7 +169,8 @@ def _kv_index(i, heads: int, group: int):
 
 
 def _fwd_call(q, k, v, causal: bool, blk_q: int, blk_k: int,
-              interpret: bool, heads: int = 1, group: int = 1):
+              interpret: bool, heads: int = 1, group: int = 1,
+              window=None):
     """q: [BH, S, D]; k,v: [B*KV, S, D] (KV = heads/group) ->
     (out [BH,S,D], lse [BH,S])."""
     bh, s, d = q.shape
@@ -156,7 +179,7 @@ def _fwd_call(q, k, v, causal: bool, blk_q: int, blk_k: int,
     grid = (bh, s // blk_q, n_kv)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                          n_kv=n_kv),
+                          n_kv=n_kv, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, blk_q, d), lambda i, j, t: (i, j, 0)),
@@ -192,7 +215,8 @@ def _fwd_call(q, k, v, causal: bool, blk_q: int, blk_k: int,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, causal: bool, scale: float, n_kv: int):
+               dq_scr, *, causal: bool, scale: float, n_kv: int,
+               window: "Optional[int]" = None):
     blk_q, d = q_ref.shape[1], q_ref.shape[2]
     blk_k = k_ref.shape[1]
     j, t = pl.program_id(1), pl.program_id(2)
@@ -202,7 +226,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = (k_start <= q_start + blk_q - 1) if causal else (t >= 0)
+    live = _tile_live(q_start, k_start, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -211,8 +235,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k_tile = k_ref[0]
         s = _dot(q, k_tile, ((1,), (1,))) * scale
         if causal:
-            s = jnp.where(_causal_mask(q_start, k_start, blk_q, blk_k),
-                          s, NEG_INF)
+            s = jnp.where(
+                _causal_mask(q_start, k_start, blk_q, blk_k, window),
+                s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])         # [blk_q, blk_k]
         dp = _dot(do, v_ref[0], ((1,), (1,)))              # dO · V^T
         ds = (p * (dp - delta_ref[0, :, 0][:, None])).astype(k_tile.dtype)
@@ -230,7 +255,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                scale: float, n_q: int, group: int = 1):
+                scale: float, n_q: int, group: int = 1,
+                window: "Optional[int]" = None):
     """Grid (B*KV, n_kv, group*n_q): each program owns ONE kv tile of ONE
     kv head; the streamed dim walks every (query head of the group) x
     (q tile) pair, so a grouped kv head's gradient accumulates over all
@@ -245,8 +271,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    # causal: q tiles entirely above the diagonal see nothing of this kv tile
-    live = (q_start + blk_q - 1 >= k_start) if causal else (j >= 0)
+    # causal: q tiles entirely above the diagonal see nothing of this kv
+    # tile; window: neither do q tiles whose whole band lies after it —
+    # the same _tile_live predicate, with q/k in the dkv grid's roles
+    live = _tile_live(q_start, k_start, blk_q, blk_k, causal, window) \
+        if causal else (j >= 0)
 
     @pl.when(live)
     def _step():
@@ -255,8 +284,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_tile = k_ref[0]
         s = _dot(q, k_tile, ((1,), (1,))) * scale
         if causal:
-            s = jnp.where(_causal_mask(q_start, k_start, blk_q, blk_k),
-                          s, NEG_INF)
+            s = jnp.where(
+                _causal_mask(q_start, k_start, blk_q, blk_k, window),
+                s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])         # [blk_q, blk_k]
         dv_scr[:] = dv_scr[:] + _dot(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot(do, v_ref[0], ((1,), (1,)))
@@ -270,7 +300,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
-              interpret: bool, heads: int = 1, group: int = 1):
+              interpret: bool, heads: int = 1, group: int = 1,
+              window=None):
     bh, s, d = q.shape
     bkv = k.shape[0]
     scale = 1.0 / (d ** 0.5)
@@ -290,7 +321,8 @@ def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
         (1, blk_k, d), lambda i, j, t: (_kv_index(i, heads, group), t, 0)
     )
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale, n_kv=n_kv),
+        functools.partial(_dq_kernel, causal=causal, scale=scale, n_kv=n_kv,
+                          window=window),
         grid=(bh, n_q, n_kv),
         in_specs=[q_tile, kv_tile, kv_tile, q_tile, q_vec, q_vec],
         out_specs=q_tile,
@@ -315,7 +347,7 @@ def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
     kv_fixed = pl.BlockSpec((1, blk_k, d), lambda i, t, j: (i, t, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale, n_q=n_q,
-                          group=group),
+                          group=group, window=window),
         grid=(bkv, n_kv, group * n_q),
         in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, qv_stream,
                   qv_stream],
@@ -337,22 +369,25 @@ def _bwd_call(q, k, v, out, lse, do, causal: bool, blk_q: int, blk_k: int,
 # ------------------------------------------------------------ public API
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, blk_q, blk_k, interpret, heads, group):
-    out, _ = _fwd_call(q, k, v, causal, blk_q, blk_k, interpret, heads, group)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, blk_q, blk_k, interpret, heads, group, window):
+    out, _ = _fwd_call(q, k, v, causal, blk_q, blk_k, interpret, heads, group,
+                       window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, heads, group):
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, heads, group,
+               window):
     out, lse = _fwd_call(q, k, v, causal, blk_q, blk_k, interpret, heads,
-                         group)
+                         group, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, blk_q, blk_k, interpret, heads, group, res, do):
+def _flash_bwd(causal, blk_q, blk_k, interpret, heads, group, window, res,
+               do):
     q, k, v, out, lse = res
     return _bwd_call(q, k, v, out, lse, do, causal, blk_q, blk_k, interpret,
-                     heads, group)
+                     heads, group, window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -374,7 +409,8 @@ def _snap_block(blk: int, s: int) -> Optional[int]:
 
 def flash_attention(q, k, v, causal: bool = False, *,
                     blk_q: int = 512, blk_k: int = 1024,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    window: Optional[int] = None) -> jax.Array:
     """Fused attention for [B, S, H, D] inputs (transformer layout,
     models/transformer.py MultiHeadAttention). Differentiable; falls back
     to the einsum reference path when S doesn't tile evenly.
@@ -383,9 +419,19 @@ def flash_attention(q, k, v, causal: bool = False, *,
     ([B, S, KV, D] with H % KV == 0, models/llama.py GqaAttention) — the
     kernels index the shared kv head per query group via the BlockSpec
     index map (no [B,S,H,D] materialized repeat; dk/dv accumulate over
-    the group inside the kv-owned backward program)."""
+    the group inside the kv-owned backward program).
+
+    `window` (requires causal): Mistral-style sliding-window attention —
+    each query sees itself plus the window-1 previous positions. Tiles
+    entirely outside the band are skipped in forward AND both backward
+    kernels, so compute scales O(S·window) instead of O(S²/2)."""
     b, s, h, d = q.shape
     group = check_gqa_shapes(q, k, v)
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     blk_q = _snap_block(blk_q, s)
     blk_k = _snap_block(blk_k, s)
     if blk_q is None or blk_k is None:
@@ -394,7 +440,7 @@ def flash_attention(q, k, v, causal: bool = False, *,
         if group > 1:
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)
-        return dot_product_attention(q, k, v, causal)
+        return dot_product_attention(q, k, v, causal, window=window)
     if interpret is None:
         interpret = _use_interpret()
 
@@ -403,7 +449,7 @@ def flash_attention(q, k, v, causal: bool = False, *,
         return x.transpose(0, 2, 1, 3).reshape(b * hx, s, d)
 
     out = _flash(to_bh(q), to_bh(k), to_bh(v), causal, blk_q, blk_k,
-                 bool(interpret), h, group)
+                 bool(interpret), h, group, window)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
