@@ -460,6 +460,21 @@ def bench_t5_3b(gen: str, cfg=None):
     )
 
 
+def _llama_1b_cfg(**kw):
+    """The ~0.8B 4:1-GQA config BOTH llama arms share — train and decode
+    must measure the same model or their numbers aren't comparable."""
+    from tf_operator_tpu.models import llama as llm
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    base = dict(
+        vocab_size=32000, d_model=2048, n_heads=16, n_kv_heads=4,
+        n_layers=16, d_ff=5632, max_len=2048, tie_embeddings=True,
+        attention_fn=flash_attention,
+    )
+    base.update(kw)
+    return llm.LlamaConfig(**base)
+
+
 def bench_llama(gen: str, cfg=None):
     """LLaMA-family arm (models/llama.py): 1B-class GQA decoder, flash
     attention post-RoPE, tied embedding + blocked CE, adafactor, remat —
@@ -467,20 +482,63 @@ def bench_llama(gen: str, cfg=None):
     chip, opt-out via BENCH_LLAMA=0). `cfg` override: tests run the same
     path on a tiny config."""
     from tf_operator_tpu.models import llama as llm
-    from tf_operator_tpu.ops.flash_attention import flash_attention
 
     if cfg is None:
-        # ~0.8B params: 4:1 GQA, SwiGLU 2048->5632, S=2048
-        cfg = llm.LlamaConfig(
-            vocab_size=32000, d_model=2048, n_heads=16, n_kv_heads=4,
-            n_layers=16, d_ff=5632, max_len=2048, tie_embeddings=True,
-            remat=True, attention_fn=flash_attention,
-        )
+        cfg = _llama_1b_cfg(remat=True)
     r = _bench_big_lm(
         gen, llm.Llama(cfg), cfg, llm.params_flops_per_token(cfg), batch=4,
     )
     r["gqa"] = f"{cfg.n_heads}q:{cfg.n_kv_heads}kv"
     return r
+
+
+def bench_llama_decode(gen: str, cfg=None, max_new: int = 128):
+    """Autoregressive inference arm: prefill + greedy ring-cache decode on
+    the 1B-class GQA llama (models/llama.generate). Reports prefill and
+    per-token decode throughput — the compact GQA KV cache is the memory
+    lever that sets decode batch headroom (default-on with a chip,
+    opt-out BENCH_DECODE=0). `cfg` override: tests run a tiny config."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama as llm
+
+    if cfg is None:
+        cfg = _llama_1b_cfg()
+    model = llm.Llama(cfg)
+    rng = jax.random.PRNGKey(0)
+    batch = 4
+    max_new = max(2, min(max_new, cfg.max_len // 2))
+    prompt_len = min(256, cfg.max_len - max_new)
+    prompt = jax.random.randint(rng, (batch, prompt_len), 0, cfg.vocab_size)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        model.init(rng, prompt, train=False)["params"],
+    )
+    # warmup compiles prefill + BOTH decode scan lengths (static shapes —
+    # the timed calls must reuse these exact lengths)
+    jax.block_until_ready(llm.generate(model, params, prompt, 1))
+    jax.block_until_ready(llm.generate(model, params, prompt, max_new))
+    t0 = time.perf_counter()
+    jax.block_until_ready(llm.generate(model, params, prompt, 1))
+    t_prefill = time.perf_counter() - t0  # prefill + ONE decode token
+    t0 = time.perf_counter()
+    jax.block_until_ready(llm.generate(model, params, prompt, max_new))
+    t_total = time.perf_counter() - t0
+    # subtracting isolates the extra max_new-1 scan steps: a pure decode
+    # rate with no prefill share (t_prefill carries the prefill + first
+    # token for both runs)
+    decode_tps = batch * (max_new - 1) / max(1e-9, t_total - t_prefill)
+    return {
+        "params_b": round(sum(
+            x.size for x in jax.tree.leaves(params)) / 1e9, 2),
+        "gqa": f"{cfg.n_heads}q:{cfg.n_kv_heads}kv",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": max_new,
+        "prefill_tokens_per_sec": round(batch * prompt_len / t_prefill, 1),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+    }
 
 
 def _parity(f_out, f_grads, r_out, r_grads):
@@ -1043,6 +1101,13 @@ def main() -> int:
                 extra["llama"] = bench_llama(gen)
             except Exception as e:  # noqa: BLE001 — surfaced, not fatal
                 extra["llama"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        if os.environ.get("BENCH_DECODE", "1") == "1":
+            progress("llama_decode")
+            try:
+                extra["llama_decode"] = bench_llama_decode(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["llama_decode"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
     else:
         # no chip: the pallas kernel still runs (interpret mode) so the
         # flash arm's correctness witness lands in the artifact

@@ -156,3 +156,17 @@ def test_save_does_not_mutate_live_result(cache):
     cached = bench.load_tpu_cache()["result"]["extra"]["t5_3b"]
     assert cached["tokens_per_sec_per_chip"] == 9000.0
     assert cached["last_error"] == "real regression"
+
+
+def test_bench_llama_decode_path_runs_on_tiny_config():
+    """The decode arm's full path (prefill + ring-cache greedy scan +
+    throughput accounting) must execute end to end on a tiny config."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama
+
+    cfg = llama.tiny(dtype=jnp.float32, tie_embeddings=True)
+    r = bench.bench_llama_decode("cpu", cfg=cfg, max_new=8)
+    assert r["decode_tokens_per_sec"] > 0
+    assert r["new_tokens"] == 8
+    assert r["gqa"] == "4q:2kv"

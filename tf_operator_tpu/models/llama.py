@@ -337,12 +337,16 @@ class MoeSwiGlu(nn.Module):
             jnp.float32,
         ).astype(cfg.dtype)
 
-        if decode:
-            # decode steps carry a handful of tokens: GATHER each token's
-            # argmax expert and run only it — sparse inference reads one
-            # expert's weights per token instead of all E (the all-to-all
-            # dispatch is useless here anyway: its token-divisibility
-            # cannot hold for L=1 and its collectives buy nothing)
+        if decode and x.shape[1] == 1:
+            # single-token decode steps: GATHER the token's argmax expert
+            # and run only it — sparse inference reads one expert's
+            # weights per step instead of all E. ONLY for L == 1: the
+            # gather materializes per-token weight copies [B, L, D, 2F],
+            # which at prefill lengths would dwarf the dense dispatch's
+            # activations (the prefill below routes densely instead; the
+            # all-to-all dispatch stays a training-path tool — its token
+            # divisibility cannot hold here and its collectives buy
+            # nothing at decode)
             probs = jax.nn.softmax(logits, axis=-1)
             e_idx = jnp.argmax(probs, axis=-1)               # [B,L]
             gate = jnp.max(probs, axis=-1)                   # [B,L]
@@ -352,7 +356,7 @@ class MoeSwiGlu(nn.Module):
             self.sow("intermediates", "moe_aux_loss",
                      jnp.zeros((), jnp.float32))
             return out * gate[..., None].astype(cfg.dtype)
-        if cfg.moe_dispatch_fn is not None:
+        if cfg.moe_dispatch_fn is not None and not decode:
             out, aux = cfg.moe_dispatch_fn(x, logits, wi, wo)
         else:
             from tf_operator_tpu.parallel.ep import dense_switch_dispatch
