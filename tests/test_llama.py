@@ -758,3 +758,24 @@ def test_moe_decode_gathers_single_expert():
     dec, _ = model.apply({"params": params}, toks, cache=cache, cache_pos=0)
     # gathered per-token expert == dense masked dispatch, to fp tolerance
     assert jnp.allclose(dec, full, atol=1e-4), float(jnp.abs(dec - full).max())
+
+
+def test_moe_single_token_gather_matches_full_forward():
+    """The L==1 gathered-expert decode branch must be NUMERICALLY right:
+    prefill a prompt, take one cached single-token step, and compare its
+    logits against the full forward over prompt+token (which routes all
+    tokens through the dense dispatch)."""
+    cfg = _f32(n_experts=4, moe_every=1)
+    model = llama.Llama(cfg)
+    toks = _tokens(cfg)[:, :9]
+    prompt, last = toks[:, :8], toks[:, 8:9]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    cache = llama.init_cache(cfg, 2)
+    _, cache = model.apply(
+        {"params": params}, prompt, cache=cache, cache_pos=0)
+    step_logits, _ = model.apply(
+        {"params": params}, last, cache=cache, cache_pos=8)
+    full = model.apply({"params": params}, toks)
+    assert jnp.allclose(step_logits[:, 0], full[:, 8], atol=1e-4), float(
+        jnp.abs(step_logits[:, 0] - full[:, 8]).max()
+    )

@@ -1,0 +1,270 @@
+"""Pipelined LLaMA LM — the modern-decoder family through the gpipe
+schedule (counterpart of models/pipeline.py for models/llama.py).
+
+Same architecture as models/pipeline.py: blocks are pure functions over
+an explicit param pytree with [n_stages, blocks_per_stage, ...] stacked
+stage leaves running under parallel/pp.gpipe (shard_map, manual
+collectives), with Megatron-style tensor parallelism INSIDE each stage —
+wq/wkv column-parallel over 'tp' (whole query/kv heads per shard, so GQA
+grouping survives: tp must divide n_kv_heads), attention out and SwiGLU
+wo row-parallel ending in one lax.psum each. RoPE needs no parameters:
+each block slices the closed-over angle table by its sequence length
+(microbatches split the BATCH dim; every microbatch carries full
+sequences starting at position 0). Sliding-window attention passes
+through to the banded einsum reference (models/transformer.py).
+
+Embedding (tied) and the RMS head run outside the pipeline under GSPMD,
+exactly as in pipeline.py. No reference counterpart (SURVEY.md §2.10 PP
+row "NO").
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.models.llama import LlamaConfig, apply_rope, rope_table
+from tf_operator_tpu.models.transformer import dot_product_attention, lm_loss
+from tf_operator_tpu.parallel.pp import make_pipeline_fn
+
+
+# ---------------------------------------------------------------- params
+def init_params(rng: jax.Array, cfg: LlamaConfig, n_stages: int) -> Dict:
+    """Param pytree: stage leaves stacked [n_stages, blocks_per_stage, ...];
+    embed/ln_f flat. All f32 (cast to cfg.dtype at use)."""
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by n_stages {n_stages}"
+        )
+    _check_supported(cfg)
+    lps = cfg.n_layers // n_stages
+    e, h, kv, d, f = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                      cfg.head_dim, cfg.d_ff)
+    k_embed, k_wq, k_wkv, k_out, k_wi, k_wo = jax.random.split(rng, 6)
+
+    def init(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+    return {
+        "embed": {
+            "embedding": jax.random.normal(k_embed, (cfg.vocab_size, e)) * 0.02,
+        },
+        "stages": {
+            "rms1": jnp.ones((n_stages, lps, e), jnp.float32),
+            "wq": init(k_wq, (n_stages, lps, e, h, d), e),
+            "wkv": init(k_wkv, (n_stages, lps, e, 2, kv, d), e),
+            "out": init(k_out, (n_stages, lps, h, d, e), h * d),
+            "rms2": jnp.ones((n_stages, lps, e), jnp.float32),
+            # SwiGLU gate+up as [E, 2, F]: the tp shard slices F, keeping a
+            # full (gate, up) pair per shard so the elementwise silu*up
+            # needs no collective
+            "wi": init(k_wi, (n_stages, lps, e, 2, f), e),
+            "wo": init(k_wo, (n_stages, lps, f, e), f),
+        },
+        "ln_f": jnp.ones((e,), jnp.float32),
+    }
+
+
+def _check_supported(cfg: LlamaConfig) -> None:
+    """Reject config fields the pipelined model would silently drop."""
+    if not cfg.tie_embeddings:
+        raise ValueError("pipelined llama supports tied embeddings only")
+    unsupported = {
+        "attention_fn": cfg.attention_fn,
+        "moe_dispatch_fn": cfg.moe_dispatch_fn,
+        "remat": cfg.remat,
+        "n_experts": cfg.n_experts,
+    }
+    set_fields = [k for k, v in unsupported.items() if v]
+    if set_fields:
+        raise ValueError(
+            f"pipelined llama does not support config fields {set_fields}; "
+            f"use the non-pipelined Llama (models/llama.py) for "
+            f"custom-attention/remat/MoE"
+        )
+
+
+# per stage-leaf: the STACKED-coordinates dim fsdp shards (model dim E).
+_FSDP_DIMS = {
+    "rms1": None, "wq": 2, "wkv": 2, "out": 4, "rms2": None,
+    "wi": 2, "wo": 3,
+}
+
+
+def stage_param_specs(fsdp: bool = False) -> Dict:
+    """PartitionSpec pytree for params['stages']: stage dim over 'pp',
+    query/kv heads and ffn columns over 'tp', optionally E over 'fsdp'."""
+    def with_fsdp(name: str, spec: P) -> P:
+        d = _FSDP_DIMS.get(name)
+        if not fsdp or d is None:
+            return spec
+        parts = list(spec) + [None] * (d + 1 - len(spec))
+        parts[d] = "fsdp"
+        return P(*parts)
+
+    base = {
+        "rms1": P("pp", None, None),
+        "wq": P("pp", None, None, "tp", None),
+        "wkv": P("pp", None, None, None, "tp", None),
+        "out": P("pp", None, "tp", None, None),
+        "rms2": P("pp", None, None),
+        "wi": P("pp", None, None, None, "tp"),
+        "wo": P("pp", None, "tp", None),
+    }
+    return {k: with_fsdp(k, v) for k, v in base.items()}
+
+
+def _gather_stage(params: Dict) -> Dict:
+    """Manual FSDP inside shard_map: all-gather fsdp-sharded leaves before
+    the stage computes (dims shift by -1: gpipe stripped the pp dim);
+    autodiff transposes to reduce-scatter of the grads."""
+    out = {}
+    for name, leaf in params.items():
+        d = _FSDP_DIMS.get(name)
+        out[name] = leaf if d is None else jax.lax.all_gather(
+            leaf, "fsdp", axis=d - 1, tiled=True)
+    return out
+
+
+def param_shardings(params: Dict, mesh: Mesh,
+                    fsdp: Optional[bool] = None) -> Dict:
+    if fsdp is None:
+        fsdp = mesh.shape.get("fsdp", 1) > 1
+    stage_specs = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        stage_param_specs(fsdp=fsdp),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    rep = NamedSharding(mesh, P())
+    return {
+        "embed": jax.tree.map(lambda _: rep, params["embed"]),
+        "stages": stage_specs,
+        "ln_f": rep,
+    }
+
+
+# ---------------------------------------------------------------- compute
+def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(
+        jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def _block(p: Dict, x: jax.Array, *, angles_table: jax.Array,
+           group: int, tp_axis: Optional[str],
+           window: Optional[int], eps: float) -> jax.Array:
+    """One llama block on (possibly tp-local) param shards. x: [b, s, e]
+    replicated over tp; wq/wkv hold whole LOCAL heads (h/tp query, kv/tp
+    kv — grouping alignment is preserved because the contiguous head
+    split assigns each query head's shared kv head to the same shard);
+    wi/wo hold f/tp SwiGLU columns. Each residual ends in a psum."""
+    dtype = x.dtype
+    s_len = x.shape[1]
+    angles = angles_table[:s_len]
+    h = _rmsnorm(x, p["rms1"], eps)
+    q = jnp.einsum("bse,ehd->bshd", h, p["wq"].astype(dtype))
+    kvp = jnp.einsum("bse,eckd->cbskd", h, p["wkv"].astype(dtype))
+    k, v = kvp[0], kvp[1]
+    q = apply_rope(q, angles)
+    k = apply_rope(k, angles)
+    if group > 1:
+        # local kv heads are tiny post-shard; broadcast for the reference
+        # attention (the GSPMD path's kernels index compactly instead)
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    a = dot_product_attention(q, k, v, True, window=window)
+    o = jnp.einsum("bshd,hde->bse", a, p["out"].astype(dtype))
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
+    h = _rmsnorm(x, p["rms2"], eps)
+    hh = jnp.einsum("bse,ecf->bscf", h, p["wi"].astype(dtype))
+    hh = jax.nn.silu(hh[:, :, 0]) * hh[:, :, 1]
+    o = jnp.einsum("bsf,fe->bse", hh, p["wo"].astype(dtype))
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    return x + o
+
+
+def _stage_fn(p: Dict, x: jax.Array, *, angles_table, group, tp_axis,
+              window, eps) -> jax.Array:
+    n_blocks = p["rms1"].shape[0]
+    for i in range(n_blocks):
+        x = _block(jax.tree.map(lambda a: a[i], p), x,
+                   angles_table=angles_table, group=group, tp_axis=tp_axis,
+                   window=window, eps=eps)
+    return x
+
+
+def _head(params: Dict, x: jax.Array, eps: float) -> jax.Array:
+    x = _rmsnorm(x, params["ln_f"], eps).astype(jnp.float32)
+    return jnp.einsum("bse,ve->bsv", x, params["embed"]["embedding"])
+
+
+def make_pipelined_apply(cfg: LlamaConfig, mesh: Mesh, n_micro: int):
+    """f(params, tokens) -> logits: llama blocks through gpipe over 'pp'
+    with tp collectives inside stages and batch over ('dp','fsdp')."""
+    _check_supported(cfg)
+    tp = mesh.shape.get("tp", 1)
+    fsdp = mesh.shape.get("fsdp", 1) > 1
+    tp_axis = "tp" if tp > 1 else None
+    if cfg.n_heads % tp:
+        raise ValueError(f"tp {tp} must divide n_heads {cfg.n_heads}")
+    if cfg.n_kv_heads % tp:
+        # each shard must own whole kv heads with their whole query group
+        raise ValueError(f"tp {tp} must divide n_kv_heads {cfg.n_kv_heads}")
+    if cfg.d_ff % tp:
+        raise ValueError(f"tp {tp} must divide d_ff {cfg.d_ff}")
+    if fsdp and cfg.d_model % mesh.shape["fsdp"]:
+        raise ValueError(
+            f"fsdp {mesh.shape['fsdp']} must divide d_model {cfg.d_model}"
+        )
+    angles_table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta)
+    base_stage = functools.partial(
+        _stage_fn, angles_table=angles_table, group=cfg.q_per_kv,
+        tp_axis=tp_axis, window=cfg.sliding_window, eps=cfg.norm_eps,
+    )
+    if fsdp:
+        def stage_fn(p, x):
+            return base_stage(_gather_stage(p), x)
+    else:
+        stage_fn = base_stage
+    run = make_pipeline_fn(
+        mesh, stage_fn, n_micro, axis_name="pp",
+        param_specs=stage_param_specs(fsdp=fsdp),
+        batch_axes=("dp", "fsdp"),
+    )
+
+    def apply(params: Dict, tokens: jax.Array):
+        x = jnp.take(
+            params["embed"]["embedding"], tokens, axis=0
+        ).astype(cfg.dtype)
+        x = run(params["stages"], x)
+        return _head(params, x, cfg.norm_eps)
+
+    return apply
+
+
+def sequential_apply(cfg: LlamaConfig, params: Dict,
+                     tokens: jax.Array) -> jax.Array:
+    """Unsharded block-by-block reference — the numeric witness."""
+    angles_table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta)
+    x = jnp.take(
+        params["embed"]["embedding"], tokens, axis=0
+    ).astype(cfg.dtype)
+    stages = params["stages"]
+    for s in range(stages["rms1"].shape[0]):
+        x = _stage_fn(jax.tree.map(lambda a: a[s], stages), x,
+                      angles_table=angles_table, group=cfg.q_per_kv,
+                      tp_axis=None, window=cfg.sliding_window,
+                      eps=cfg.norm_eps)
+    return _head(params, x, cfg.norm_eps)
+
+
+def pipeline_lm_loss(apply_fn, params, tokens) -> jax.Array:
+    return lm_loss(apply_fn(params, tokens), tokens)
