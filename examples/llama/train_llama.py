@@ -28,10 +28,26 @@ from tf_operator_tpu.runtime.train import Checkpointer, TrainState
 
 
 def lm_batches(batch: int, seq_len: int, vocab: int, seed: int):
+    print("data: synthetic")
     key = jax.random.PRNGKey(seed)
     while True:
         key, k = jax.random.split(key)
         yield (jax.random.randint(k, (batch, seq_len), 0, vocab),)
+
+
+def token_record_pipeline(data_dir: str, batch: int, seq_len: int, info):
+    """Disjoint per-host shard of pre-tokenized on-disk records — each
+    record one [seq_len] int32 token row (write shards with
+    data/loader.write_records; shard/prefetch scaffold shared with the
+    other examples via data/loader.host_record_batches)."""
+    import numpy as np
+
+    from tf_operator_tpu.data.loader import FieldSpec, host_record_batches
+
+    return host_record_batches(
+        data_dir, [FieldSpec("tokens", (seq_len,), np.int32)], batch, info,
+        lambda rec: (jnp.asarray(rec["tokens"]),),
+    )
 
 
 def make_lm_step(model):
@@ -55,6 +71,11 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=200_000)
     ap.add_argument("--per-host-batch", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=8192)
+    ap.add_argument("--data-dir", default="",
+                    help="dir of pre-tokenized .rec shards ([seq-len] "
+                         "int32 rows, data/loader.write_records); each "
+                         "host reads its disjoint subset. "
+                         "Default: synthetic tokens.")
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--save-interval", type=int, default=500)
     ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
@@ -95,11 +116,16 @@ def main(argv=None):
     )
     state = jax.device_put(state, state_sharding(state, mesh))
 
+    if args.data_dir:
+        batches = token_record_pipeline(
+            args.data_dir, args.per_host_batch, seq_len, info)
+    else:
+        batches = lm_batches(args.per_host_batch, seq_len, cfg.vocab_size,
+                             seed=info.process_id)
     res = run_training(
         state,
         make_lm_step(model),
-        lm_batches(args.per_host_batch, seq_len, cfg.vocab_size,
-                   seed=info.process_id),
+        batches,
         num_steps=args.steps,
         checkpointer=(
             Checkpointer(args.ckpt_dir, async_save=True)

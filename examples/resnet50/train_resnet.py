@@ -37,39 +37,23 @@ def synthetic_imagenet(batch: int, image_size: int, seed: int):
 
 
 def record_pipeline(data_dir: str, batch: int, image_size: int, info):
-    """Disjoint per-host shard of on-disk records through the prefetching
-    loader (`host_sharded_loader` wires shard_id/n_shards from the
-    operator-injected slice topology — the tf.data auto-shard analogue;
-    native C++ reader when built)."""
-    import glob
-    import os
-
+    """Disjoint per-host shard of on-disk records (the tf.data auto-shard
+    analogue; shard/prefetch scaffold shared with the other examples via
+    data/loader.host_record_batches, native C++ reader when built)."""
     import numpy as np
 
-    from tf_operator_tpu.data.loader import FieldSpec, host_sharded_loader
+    from tf_operator_tpu.data.loader import FieldSpec, host_record_batches
 
-    fields = [
-        FieldSpec("image", (image_size, image_size, 3), np.uint8),
-        FieldSpec("label", (), np.int32),
-    ]
-    paths = sorted(glob.glob(os.path.join(data_dir, "*.rec")))
-    if not paths:
-        raise SystemExit(f"no .rec files under {data_dir}")
-    # loader built EAGERLY: a wrong path or an undersized shard must fail
-    # at startup, not at the first batch when peer hosts are already
-    # blocked in the gradient all-reduce
-    loader = host_sharded_loader(paths, fields, batch, info=info,
-                                 shuffle=True, loop=True)
-    print(f"data: records x{loader.num_records()} "
-          f"(shard {loader.shard_id}/{loader.n_shards}, "
-          f"native={loader.using_native})")
+    def to_batch(rec):
+        x = jnp.asarray(rec["image"], jnp.bfloat16) / 127.5 - 1.0
+        return (x, jnp.asarray(rec["label"]))
 
-    def batches():
-        for rec in loader:
-            x = jnp.asarray(rec["image"], jnp.bfloat16) / 127.5 - 1.0
-            yield (x, jnp.asarray(rec["label"]))
-
-    return batches()
+    return host_record_batches(
+        data_dir,
+        [FieldSpec("image", (image_size, image_size, 3), np.uint8),
+         FieldSpec("label", (), np.int32)],
+        batch, info, to_batch,
+    )
 
 
 def main(argv=None):

@@ -121,6 +121,32 @@ def host_sharded_loader(
     )
 
 
+def host_record_batches(data_dir: str, fields: Sequence["FieldSpec"],
+                        batch_size: int, info, map_fn):
+    """The examples' on-disk input scaffold in one place: glob the .rec
+    shards under `data_dir` (loudly failing on an empty dir), build the
+    host-sharded loader EAGERLY — a wrong path or undersized shard must
+    fail at startup, not at the first batch when peer hosts are already
+    blocked in the gradient all-reduce — print the shard line the smoke
+    tests assert on, and yield map_fn(record) batches forever."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(data_dir, "*.rec")))
+    if not paths:
+        raise SystemExit(f"no .rec files under {data_dir}")
+    loader = host_sharded_loader(paths, fields, batch_size, info=info,
+                                 shuffle=True, loop=True)
+    print(f"data: records x{loader.num_records()} "
+          f"(shard {loader.shard_id}/{loader.n_shards}, "
+          f"native={loader.using_native})")
+
+    def batches():
+        for rec in loader:
+            yield map_fn(rec)
+
+    return batches()
+
+
 def _split_batch(
     buf: np.ndarray, batch_size: int, fields: Sequence[FieldSpec]
 ) -> Dict[str, np.ndarray]:
