@@ -1,0 +1,144 @@
+"""HF checkpoint import (models/convert.py): a randomly initialized
+`transformers.LlamaForCausalLM` and the converted flax model must produce
+the same logits — true cross-framework parity, catching any convention
+mismatch (RoPE pairing, GQA grouping, transposes) that shape checks
+alone would miss."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tf_operator_tpu.models import llama
+from tf_operator_tpu.models.convert import config_from_hf, import_hf_llama
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _tiny_hf_pair(tie=False, kv_heads=2):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=kv_heads, max_position_embeddings=64,
+        rms_norm_eps=1e-5, rope_theta=10000.0, attention_bias=False,
+        mlp_bias=False, tie_word_embeddings=tie,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    # config derived from the HF config, NOT hand-built: norm_eps and
+    # rope_theta mismatches skew logits ~1% and pass every shape check
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    return hf, cfg
+
+
+@pytest.mark.parametrize("kv_heads", [2, 4])
+def test_hf_llama_logits_parity(kv_heads):
+    hf, cfg = _tiny_hf_pair(kv_heads=kv_heads)
+    params = import_hf_llama(hf.state_dict(), cfg)
+    tokens = np.random.default_rng(0).integers(0, 256, (2, 16))
+    with torch.no_grad():
+        want = hf(torch.as_tensor(tokens)).logits.numpy()
+    got = llama.Llama(cfg).apply(
+        {"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_hf_llama_generate_after_import():
+    """Converted weights drive generate(): greedy tokens must equal HF's
+    own greedy decoding."""
+    hf, cfg = _tiny_hf_pair()
+    params = import_hf_llama(hf.state_dict(), cfg)
+    prompt = np.random.default_rng(1).integers(0, 256, (1, 8))
+    with torch.no_grad():
+        want = hf.generate(
+            torch.as_tensor(prompt), max_new_tokens=6, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[:, 8:]
+    got = llama.generate(
+        llama.Llama(cfg), params, jnp.asarray(prompt), 6)
+    assert np.array_equal(np.asarray(got), want), (got, want)
+
+
+def test_import_validates_shapes_and_keys():
+    hf, cfg = _tiny_hf_pair()
+    sd = hf.state_dict()
+    with pytest.raises(ValueError, match="shape"):
+        import_hf_llama(sd, llama.LlamaConfig(
+            vocab_size=256, d_model=64, n_heads=4, n_kv_heads=2,
+            n_layers=2, d_ff=256, max_len=64, dtype=jnp.float32))
+    sd2 = dict(sd)
+    del sd2["model.norm.weight"]
+    with pytest.raises(KeyError, match="model.norm.weight"):
+        import_hf_llama(sd2, cfg)
+    sd3 = dict(sd)
+    sd3["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(64)
+    with pytest.raises(ValueError, match="unconsumed"):
+        import_hf_llama(sd3, cfg)
+
+
+def test_config_from_hf_defaults_and_overrides():
+    """The derived config must track transformers' DEFAULTS (rms_norm_eps
+    1e-6, not our 1e-5) — the silent-drift trap — and accept overrides."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=48)
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.norm_eps == 1e-6
+    assert cfg.n_kv_heads == 2 and cfg.n_layers == 3 and cfg.max_len == 48
+    assert config_from_hf(hf_cfg, dtype=jnp.float32).dtype == jnp.float32
+    assert config_from_hf(hf_cfg.to_dict()).d_ff == 64  # dict form too
+
+
+def test_default_eps_configs_reach_logit_parity():
+    """End to end with transformers' DEFAULT eps (the case a hand-built
+    config got wrong): derived config must reach tight parity."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, attention_bias=False, mlp_bias=False)
+    torch.manual_seed(7)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = import_hf_llama(hf.state_dict(), cfg)
+    toks = np.random.default_rng(3).integers(0, 128, (2, 10))
+    with torch.no_grad():
+        want = hf(torch.as_tensor(toks)).logits.numpy()
+    got = llama.Llama(cfg).apply({"params": params}, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_tied_embedding_import_and_parity():
+    """The tied path — the one examples/llama's training configs use:
+    lm_head must be absorbed (aliased to the embedding) and parity hold."""
+    hf, cfg = _tiny_hf_pair(tie=True)
+    assert cfg.tie_embeddings
+    params = import_hf_llama(hf.state_dict(), cfg)
+    assert "lm_head" not in params
+    tokens = np.random.default_rng(2).integers(0, 256, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.as_tensor(tokens)).logits.numpy()
+    got = llama.Llama(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+    # a tied cfg with a DIFFERENT lm_head in the dict must be rejected
+    sd = dict(hf.state_dict())
+    sd["lm_head.weight"] = torch.randn(256, 64)
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        import_hf_llama(sd, cfg)
+
+
+def test_config_from_hf_rejects_unsupported():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "original_max_position_embeddings": 8192,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(hf_cfg)
+    hf_cfg2 = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        hidden_act="gelu")
+    with pytest.raises(ValueError, match="hidden_act"):
+        config_from_hf(hf_cfg2)

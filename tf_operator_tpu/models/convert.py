@@ -1,0 +1,151 @@
+"""Checkpoint import — Hugging Face LLaMA-format weights -> models/llama.py.
+
+The "switch and bring your weights" half of the migration story
+(docs/migration.md): a `LlamaForCausalLM` state dict (torch tensors or
+numpy arrays, any source — safetensors, torch.load, sharded index)
+converts offline into the flax param pytree models/llama.py consumes.
+
+Convention notes (the silent-wrongness traps this module exists to
+avoid):
+- RoPE pairing: transformers' LLaMA stores q/k already permuted for the
+  split-halves (rotate_half) convention — the SAME convention
+  models/llama.apply_rope implements — so q/k need no head-dim
+  permutation here. (Original Meta checkpoints use interleaved pairs and
+  would need one; convert them to HF format first.)
+- torch nn.Linear stores [out_features, in_features]; flax DenseGeneral
+  kernels are [in, ...out...] — every projection transposes.
+- GQA: HF k/v carry the compact KV head count and repeat-interleave to
+  query heads, matching models/llama.py's grouping (head // group).
+
+Verified end to end by tests/test_convert.py: a randomly initialized
+`transformers.LlamaForCausalLM` and the converted flax model produce
+the same logits to float tolerance.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from tf_operator_tpu.models.llama import LlamaConfig
+
+
+def _np(x) -> np.ndarray:
+    """torch tensor / np array -> float32 numpy (params live f32; the
+    model casts to cfg.dtype at use)."""
+    if hasattr(x, "detach"):  # torch tensor without importing torch
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
+    """Derive the matching LlamaConfig from a `transformers.LlamaConfig`
+    (object or its to_dict()). Hand-building the config invites silent
+    numeric drift — e.g. transformers defaults rms_norm_eps to 1e-6 while
+    LlamaConfig defaults norm_eps to 1e-5, a mismatch that skews logits
+    by ~1% and is invisible to every shape check."""
+    d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
+    # refuse what models/llama.py cannot reproduce — importing anyway
+    # would pass every shape check and silently produce wrong logits,
+    # the exact trap this helper exists to close
+    if d.get("rope_scaling") is not None:
+        raise ValueError(
+            f"rope_scaling={d['rope_scaling']!r} is not supported "
+            f"(models/llama.rope_table implements plain RoPE only); "
+            f"Llama-3.1-style scaled-rope checkpoints would decode with "
+            f"silently wrong rotations")
+    act = d.get("hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(
+            f"hidden_act={act!r} is not supported (the SwiGLU MLP is "
+            f"silu-gated)")
+    base = dict(
+        vocab_size=d["vocab_size"],
+        d_model=d["hidden_size"],
+        n_heads=d["num_attention_heads"],
+        n_kv_heads=d.get("num_key_value_heads") or d["num_attention_heads"],
+        n_layers=d["num_hidden_layers"],
+        d_ff=d["intermediate_size"],
+        max_len=d["max_position_embeddings"],
+        rope_theta=float(d.get("rope_theta", 10000.0)),
+        norm_eps=float(d.get("rms_norm_eps", 1e-6)),
+        tie_embeddings=bool(d.get("tie_word_embeddings", False)),
+        sliding_window=d.get("sliding_window"),
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+def import_hf_llama(state_dict: Mapping[str, Any],
+                    cfg: LlamaConfig) -> Dict:
+    """HF `LlamaForCausalLM.state_dict()` -> params for
+    `models.llama.Llama(cfg)`. Shapes are validated against cfg; missing
+    or extra keys raise with the offending name."""
+    e, h, kv, d = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    sd = dict(state_dict)
+
+    def take(name: str, shape) -> np.ndarray:
+        if name not in sd:
+            raise KeyError(f"checkpoint is missing {name!r}")
+        x = _np(sd.pop(name))
+        if tuple(x.shape) != tuple(shape):
+            raise ValueError(
+                f"{name}: checkpoint shape {tuple(x.shape)} != expected "
+                f"{tuple(shape)} for this LlamaConfig")
+        return x
+
+    params: Dict[str, Any] = {
+        "embed": {
+            "embedding": take("model.embed_tokens.weight",
+                              (cfg.vocab_size, e)),
+        },
+        "ln_f": {"scale": take("model.norm.weight", (e,))},
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        wq = take(p + "self_attn.q_proj.weight", (h * d, e))
+        wk = take(p + "self_attn.k_proj.weight", (kv * d, e))
+        wv = take(p + "self_attn.v_proj.weight", (kv * d, e))
+        wo = take(p + "self_attn.o_proj.weight", (e, h * d))
+        gate = take(p + "mlp.gate_proj.weight", (cfg.d_ff, e))
+        up = take(p + "mlp.up_proj.weight", (cfg.d_ff, e))
+        down = take(p + "mlp.down_proj.weight", (e, cfg.d_ff))
+        params[f"block{i}"] = {
+            "ln1": {"scale": take(p + "input_layernorm.weight", (e,))},
+            "ln2": {"scale": take(
+                p + "post_attention_layernorm.weight", (e,))},
+            "attn": {
+                # [out, in] -> [in, heads, head_dim]
+                "wq": {"kernel": wq.T.reshape(e, h, d)},
+                # fused [E, 2, KV, D]: k then v, the wkv slot order
+                "wkv": {"kernel": np.stack(
+                    [wk.T.reshape(e, kv, d), wv.T.reshape(e, kv, d)],
+                    axis=1)},
+                # o_proj [E, H*D] -> [heads, head_dim, E]
+                "out": {"kernel": wo.T.reshape(h, d, e)},
+            },
+            "mlp": {
+                # SwiGLU gate+up packed [E, 2, F]
+                "wi": {"kernel": np.stack([gate.T, up.T], axis=1)},
+                "wo": {"kernel": down.T},
+            },
+        }
+    if cfg.tie_embeddings:
+        # tied checkpoints either omit lm_head or alias it to the embedding
+        lm_w = sd.pop("lm_head.weight", None)
+        if lm_w is not None and not np.array_equal(
+                _np(lm_w), params["embed"]["embedding"]):
+            raise ValueError(
+                "cfg.tie_embeddings=True but the checkpoint's lm_head "
+                "differs from its embedding — convert with an untied cfg")
+    else:
+        params["lm_head"] = {
+            "kernel": take("lm_head.weight", (cfg.vocab_size, e)).T,
+        }
+    # rotary tables are derived, not stored; buffers like
+    # model.rotary_emb.inv_freq may ride along in older dumps
+    leftover = [k for k in sd if "rotary" not in k and "inv_freq" not in k]
+    if leftover:
+        raise ValueError(
+            f"unconsumed checkpoint keys (wrong config?): {leftover[:5]}")
+    return params
