@@ -3,6 +3,7 @@
 the same logits — true cross-framework parity, catching any convention
 mismatch (RoPE pairing, GQA grouping, transposes) that shape checks
 alone would miss."""
+import jax
 import numpy as np
 import pytest
 
@@ -142,3 +143,44 @@ def test_config_from_hf_rejects_unsupported():
         hidden_act="gelu")
     with pytest.raises(ValueError, match="hidden_act"):
         config_from_hf(hf_cfg2)
+
+
+def test_export_roundtrip_and_hf_accepts():
+    """export -> import is the identity, and transformers itself loads
+    the exported dict and reproduces our logits."""
+    from tf_operator_tpu.models.convert import export_hf_llama
+
+    hf, cfg = _tiny_hf_pair()
+    params = import_hf_llama(hf.state_dict(), cfg)
+    sd = export_hf_llama(params, cfg)
+    back = import_hf_llama(sd, cfg)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params),
+            jax.tree_util.tree_leaves_with_path(back)):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # a model trained HERE (perturb the imported params) must deploy on HF
+    bumped = jax.tree.map(lambda x: np.asarray(x) * 1.01, params)
+    hf2 = transformers.LlamaForCausalLM(hf.config).eval()
+    missing, unexpected = hf2.load_state_dict(
+        {k: torch.as_tensor(v) for k, v in
+         export_hf_llama(bumped, cfg).items()})
+    assert not missing and not unexpected
+    tokens = np.random.default_rng(5).integers(0, 256, (2, 12))
+    with torch.no_grad():
+        want = hf2(torch.as_tensor(tokens)).logits.numpy()
+    got = llama.Llama(cfg).apply(
+        {"params": jax.tree.map(jnp.asarray, bumped)},
+        jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_export_rejects_moe():
+    from tf_operator_tpu.models.convert import export_hf_llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_len=16, n_experts=4, moe_every=1, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="MoE"):
+        export_hf_llama({}, cfg)

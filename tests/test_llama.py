@@ -779,3 +779,73 @@ def test_moe_single_token_gather_matches_full_forward():
     assert jnp.allclose(step_logits[:, 0], full[:, 8], atol=1e-4), float(
         jnp.abs(step_logits[:, 0] - full[:, 8]).max()
     )
+
+
+# ------------------------------------------------------------- sampling
+def test_top_k_one_equals_greedy():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (3, 50))
+    greedy = jnp.argmax(logits, axis=-1)
+    for seed in range(5):
+        got = llama._select_token(logits, 1.0, jax.random.PRNGKey(seed),
+                                  top_k=1)
+        assert jnp.array_equal(got, greedy)
+
+
+def test_top_k_samples_stay_in_top_k():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (2, 50))
+    allowed = set(jnp.argsort(logits, axis=-1)[0, -5:].tolist())
+    for seed in range(30):
+        got = llama._select_token(logits, 1.0, jax.random.PRNGKey(seed),
+                                  top_k=5)
+        assert int(got[0]) in allowed
+
+
+def test_top_p_tiny_equals_greedy():
+    logits = jax.random.normal(jax.random.PRNGKey(2), (2, 50)) * 3
+    greedy = jnp.argmax(logits, axis=-1)
+    for seed in range(5):
+        got = llama._select_token(logits, 1.0, jax.random.PRNGKey(seed),
+                                  top_p=1e-6)
+        assert jnp.array_equal(got, greedy)
+
+
+def test_top_p_samples_stay_in_nucleus():
+    logits = jnp.log(jnp.asarray(
+        [[0.5, 0.3, 0.1, 0.05, 0.05]]))  # nucleus(0.75) = {0, 1}
+    for seed in range(40):
+        got = llama._select_token(logits, 1.0, jax.random.PRNGKey(seed),
+                                  top_p=0.75)
+        assert int(got[0]) in (0, 1)
+
+
+def test_generate_with_sampling_knobs():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :4]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    out = llama.generate(model, params, prompt, 4,
+                         rng=jax.random.PRNGKey(3), temperature=0.9,
+                         top_k=10, top_p=0.9)
+    assert out.shape == (2, 4)
+    assert bool((out >= 0).all()) and bool((out < cfg.vocab_size).all())
+
+
+def test_sampling_knobs_bind_every_decode_step():
+    """top_k=1 sampling == greedy for EVERY generated token (a regression
+    here means the scan body dropped the knobs and only token 1 was
+    truncated)."""
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :6]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    greedy = llama.generate(model, params, prompt, 8)
+    sampled = llama.generate(model, params, prompt, 8,
+                             rng=jax.random.PRNGKey(11), temperature=1.5,
+                             top_k=1)
+    assert jnp.array_equal(sampled, greedy)
+    with pytest.raises(ValueError, match="top_k"):
+        llama.generate(model, params, prompt, 2, rng=jax.random.PRNGKey(0),
+                       temperature=1.0, top_k=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="top_p"):
+        llama.generate(model, params, prompt, 2, rng=jax.random.PRNGKey(0),
+                       temperature=1.0, top_p=1.5)

@@ -149,3 +149,43 @@ def import_hf_llama(state_dict: Mapping[str, Any],
         raise ValueError(
             f"unconsumed checkpoint keys (wrong config?): {leftover[:5]}")
     return params
+
+
+def export_hf_llama(params: Mapping[str, Any],
+                    cfg: LlamaConfig) -> Dict[str, np.ndarray]:
+    """The inverse: flax params -> an HF `LlamaForCausalLM` state dict
+    (numpy f32), so models trained or LoRA-merged here deploy on any
+    HF-compatible stack. Exact inverse of import_hf_llama
+    (tests/test_convert.py proves the roundtrip and that transformers
+    itself accepts and reproduces the exported weights)."""
+    if cfg.n_experts:
+        raise ValueError(
+            "export of MoE configs is not supported (HF LlamaForCausalLM "
+            "has no expert weights; a Mixtral exporter would target a "
+            "different architecture)")
+    e, h, kv, d = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(params["embed"]["embedding"]),
+        "model.norm.weight": _np(params["ln_f"]["scale"]),
+    }
+    for i in range(cfg.n_layers):
+        blk = params[f"block{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _np(blk["ln1"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = _np(blk["ln2"]["scale"])
+        wkv = _np(blk["attn"]["wkv"]["kernel"])  # [E, 2, KV, D]
+        sd[p + "self_attn.q_proj.weight"] = (
+            _np(blk["attn"]["wq"]["kernel"]).reshape(e, h * d).T)
+        sd[p + "self_attn.k_proj.weight"] = wkv[:, 0].reshape(e, kv * d).T
+        sd[p + "self_attn.v_proj.weight"] = wkv[:, 1].reshape(e, kv * d).T
+        sd[p + "self_attn.o_proj.weight"] = (
+            _np(blk["attn"]["out"]["kernel"]).reshape(h * d, e).T)
+        wi = _np(blk["mlp"]["wi"]["kernel"])  # [E, 2, F]
+        sd[p + "mlp.gate_proj.weight"] = wi[:, 0].T
+        sd[p + "mlp.up_proj.weight"] = wi[:, 1].T
+        sd[p + "mlp.down_proj.weight"] = _np(blk["mlp"]["wo"]["kernel"]).T
+    if cfg.tie_embeddings:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    else:
+        sd["lm_head.weight"] = _np(params["lm_head"]["kernel"]).T
+    return sd

@@ -460,20 +460,23 @@ def init_cache(cfg: LlamaConfig, batch: int, cache_len: Optional[int] = None,
             for _ in range(cfg.n_layers)]
 
 
-# jitted prefill/decode, keyed by (model, temperature) — flax modules hash
+# jitted prefill/decode, keyed by (model, temperature, top_k, top_p) —
+# flax modules hash
 # by their (frozen) config, so repeated generate() calls and equal-config
 # model instances share one compile instead of retracing per call. The
 # cache is BOUNDED: each entry pins jitted closures (and through the
 # model, any moe_dispatch_fn mesh) alive — per-request temperatures in a
 # serving loop must not grow it forever.
-def _decode_fns(model, temperature):
+def _decode_fns(model, temperature, top_k: int = 0, top_p: float = 0.0):
     # coerce BEFORE the cache key: a jnp/np scalar temperature must not
     # crash on hashing or fragment the 8-slot cache vs the equal float
-    return _decode_fns_cached(model, float(temperature))
+    return _decode_fns_cached(model, float(temperature), int(top_k),
+                              float(top_p))
 
 
 @functools.lru_cache(maxsize=8)
-def _decode_fns_cached(model, temperature: float):
+def _decode_fns_cached(model, temperature: float, top_k: int = 0,
+                       top_p: float = 0.0):
     @jax.jit
     def prefill(params, cache, prompt):
         logits, cache = model.apply(
@@ -488,7 +491,8 @@ def _decode_fns_cached(model, temperature: float):
                 {"params": params}, tok[:, None], cache=cache,
                 cache_pos=pos)
             k, sub = jax.random.split(k)
-            nxt = _select_token(logits[:, 0], temperature, sub)
+            nxt = _select_token(logits[:, 0], temperature, sub,
+                                top_k, top_p)
             return (cache, nxt, pos + 1, k), nxt
 
         _, rest = jax.lax.scan(
@@ -500,13 +504,16 @@ def _decode_fns_cached(model, temperature: float):
 
 def generate(model, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 0.0,
              cache_len: Optional[int] = None):
     """Autoregressive decoding: one prefill pass over the prompt (all
     positions in one MXU-friendly call), then `max_new_tokens` single-
     token steps through a `lax.scan` — static shapes; prefill and the
-    decode scan each compile once per (model, temperature, length) and
-    are reused across calls. temperature 0 -> greedy argmax; else
-    softmax sampling at that temperature. Returns [B, max_new_tokens].
+    decode scan each compile once per (model, temperature, top_k, top_p,
+    length) and are reused across calls. temperature 0 -> greedy argmax;
+    else softmax sampling at that temperature, optionally truncated by
+    top_k (keep the k highest logits) and/or top_p (nucleus). Returns
+    [B, max_new_tokens].
 
     The KV cache is allocated once at full length and positions beyond
     the current step are masked — the standard TPU decode layout (no
@@ -515,6 +522,11 @@ def generate(model, params, prompt, max_new_tokens: int,
     b, prompt_len = prompt.shape
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if top_k < 0 or top_k > cfg.vocab_size:
+        raise ValueError(
+            f"top_k must be in [0, vocab_size={cfg.vocab_size}], got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     if max_new_tokens == 0:
         return jnp.zeros((b, 0), jnp.int32)
     total = prompt_len + max_new_tokens
@@ -558,9 +570,9 @@ def generate(model, params, prompt, max_new_tokens: int,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     k_first, k_rest = jax.random.split(rng)  # single-use key discipline
 
-    prefill, decode = _decode_fns(model, temperature)
+    prefill, decode = _decode_fns(model, temperature, top_k, top_p)
     last_logits, cache = prefill(params, cache, prompt)
-    first = _select_token(last_logits, temperature, k_first)
+    first = _select_token(last_logits, temperature, k_first, top_k, top_p)
     if max_new_tokens == 1:
         return first[:, None]
     rest = decode(params, cache, first, jnp.int32(prompt_len), k_rest,
@@ -568,13 +580,31 @@ def generate(model, params, prompt, max_new_tokens: int,
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 
-def _select_token(logits, temperature: float, key):
-    """[B, V] logits -> [B] token ids (greedy at temperature 0)."""
+def _select_token(logits, temperature: float, key, top_k: int = 0,
+                  top_p: float = 0.0):
+    """[B, V] logits -> [B] token ids. temperature 0 -> greedy argmax;
+    else softmax sampling, optionally truncated: top_k keeps the k
+    highest logits, top_p (nucleus) keeps the smallest set of tokens
+    whose probability mass reaches p — both static-shape (mask, never
+    gather), so the decode scan stays one compiled program."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits / temperature, axis=-1
-    ).astype(jnp.int32)
+    logits = logits / temperature
+    neg = jnp.finfo(logits.dtype).min
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the mass BEFORE them is < p (the first token
+        # is always kept); the cutoff logit is the smallest kept one
+        keep = jnp.roll(cum, 1, axis=-1).at[:, 0].set(0.0) < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def params_flops_per_token(cfg: LlamaConfig) -> float:
