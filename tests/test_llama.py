@@ -3,6 +3,7 @@ reference, flash/ring attention drop-in parity, and tp/fsdp/dp sharded
 train-step parity against the unsharded run."""
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 import pytest
 
@@ -849,3 +850,39 @@ def test_sampling_knobs_bind_every_decode_step():
     with pytest.raises(ValueError, match="top_p"):
         llama.generate(model, params, prompt, 2, rng=jax.random.PRNGKey(0),
                        temperature=1.0, top_p=1.5)
+
+
+def test_eos_masks_rest_of_generation():
+    """Once a sequence emits eos_id, every later slot is eos_id; a
+    sequence that never emits it decodes normally (same-batch mix)."""
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=2)[:, :6]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    plain = llama.generate(model, params, prompt, 10)
+    # pick row 0's 3rd greedy token as the "eos": rows diverge after it
+    eos = int(plain[0, 2])
+    out = llama.generate(model, params, prompt, 10, eos_id=eos)
+    got = np.asarray(out) if hasattr(out, "shape") else out
+    for b in range(2):
+        row = list(map(int, got[b]))
+        if eos in row:
+            i = row.index(eos)
+            assert all(t == eos for t in row[i:]), row
+            # tokens BEFORE the first eos match the unmasked decode
+            assert row[:i] == list(map(int, plain[b][:i]))
+        else:
+            assert row == list(map(int, plain[b]))
+    # row 0 must actually have stopped at position 2
+    assert int(got[0, 2]) == eos and int(got[0, 9]) == eos
+    with pytest.raises(ValueError, match="eos_id"):
+        llama.generate(model, params, prompt, 2, eos_id=cfg.vocab_size)
+
+
+def test_negative_eos_rejected_before_allocation():
+    cfg = _f32()
+    model = llama.Llama(cfg)
+    prompt = _tokens(cfg, batch=1)[:, :4]
+    params = model.init(jax.random.PRNGKey(0), prompt, train=False)["params"]
+    with pytest.raises(ValueError, match="eos_id"):
+        llama.generate(model, params, prompt, 2, eos_id=-2)

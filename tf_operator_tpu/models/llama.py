@@ -460,23 +460,24 @@ def init_cache(cfg: LlamaConfig, batch: int, cache_len: Optional[int] = None,
             for _ in range(cfg.n_layers)]
 
 
-# jitted prefill/decode, keyed by (model, temperature, top_k, top_p) —
-# flax modules hash
+# jitted prefill/decode, keyed by (model, temperature, top_k, top_p,
+# eos_id) — flax modules hash
 # by their (frozen) config, so repeated generate() calls and equal-config
 # model instances share one compile instead of retracing per call. The
 # cache is BOUNDED: each entry pins jitted closures (and through the
 # model, any moe_dispatch_fn mesh) alive — per-request temperatures in a
 # serving loop must not grow it forever.
-def _decode_fns(model, temperature, top_k: int = 0, top_p: float = 0.0):
+def _decode_fns(model, temperature, top_k: int = 0, top_p: float = 0.0,
+                eos_id: int = -1):
     # coerce BEFORE the cache key: a jnp/np scalar temperature must not
     # crash on hashing or fragment the 8-slot cache vs the equal float
     return _decode_fns_cached(model, float(temperature), int(top_k),
-                              float(top_p))
+                              float(top_p), int(eos_id))
 
 
 @functools.lru_cache(maxsize=8)
 def _decode_fns_cached(model, temperature: float, top_k: int = 0,
-                       top_p: float = 0.0):
+                       top_p: float = 0.0, eos_id: int = -1):
     @jax.jit
     def prefill(params, cache, prompt):
         logits, cache = model.apply(
@@ -486,17 +487,24 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
     @functools.partial(jax.jit, static_argnums=(5,))
     def decode(params, cache, first, pos0, rng, length):
         def step(carry, _):
-            cache, tok, pos, k = carry
+            cache, tok, pos, k, done = carry
             logits, cache = model.apply(
                 {"params": params}, tok[:, None], cache=cache,
                 cache_pos=pos)
             k, sub = jax.random.split(k)
             nxt = _select_token(logits[:, 0], temperature, sub,
                                 top_k, top_p)
-            return (cache, nxt, pos + 1, k), nxt
+            if eos_id >= 0:
+                # sequences that already emitted EOS keep emitting it —
+                # static shapes, the mask does the early-stopping
+                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                done = done | (nxt == eos_id)
+            return (cache, nxt, pos + 1, k, done), nxt
 
+        done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros(
+            first.shape, bool)
         _, rest = jax.lax.scan(
-            step, (cache, first, pos0, rng), None, length=length)
+            step, (cache, first, pos0, rng, done0), None, length=length)
         return rest
 
     return prefill, decode
@@ -505,15 +513,18 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
 def generate(model, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 0.0,
+             eos_id: Optional[int] = None,
              cache_len: Optional[int] = None):
     """Autoregressive decoding: one prefill pass over the prompt (all
     positions in one MXU-friendly call), then `max_new_tokens` single-
     token steps through a `lax.scan` — static shapes; prefill and the
     decode scan each compile once per (model, temperature, top_k, top_p,
-    length) and are reused across calls. temperature 0 -> greedy argmax;
+    eos_id, length) and are reused across calls. temperature 0 -> greedy argmax;
     else softmax sampling at that temperature, optionally truncated by
-    top_k (keep the k highest logits) and/or top_p (nucleus). Returns
-    [B, max_new_tokens].
+    top_k (keep the k highest logits) and/or top_p (nucleus). With
+    eos_id set, a sequence that emits it keeps emitting it for the rest
+    of the scan (static shapes — masking, not early exit, stops it).
+    Returns [B, max_new_tokens].
 
     The KV cache is allocated once at full length and positions beyond
     the current step are masked — the standard TPU decode layout (no
@@ -527,6 +538,10 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"top_k must be in [0, vocab_size={cfg.vocab_size}], got {top_k}")
     if not 0.0 <= top_p <= 1.0:
         raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    eos = -1 if eos_id is None else int(eos_id)
+    if eos_id is not None and not 0 <= eos < cfg.vocab_size:
+        raise ValueError(
+            f"eos_id {eos_id} out of range for vocab_size {cfg.vocab_size}")
     if max_new_tokens == 0:
         return jnp.zeros((b, 0), jnp.int32)
     total = prompt_len + max_new_tokens
@@ -570,7 +585,7 @@ def generate(model, params, prompt, max_new_tokens: int,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     k_first, k_rest = jax.random.split(rng)  # single-use key discipline
 
-    prefill, decode = _decode_fns(model, temperature, top_k, top_p)
+    prefill, decode = _decode_fns(model, temperature, top_k, top_p, eos)
     last_logits, cache = prefill(params, cache, prompt)
     first = _select_token(last_logits, temperature, k_first, top_k, top_p)
     if max_new_tokens == 1:
