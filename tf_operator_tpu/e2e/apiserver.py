@@ -159,6 +159,12 @@ class ApiServerTransport:
         self._seq = 0
         self._min_rv = 0  # watches below this rv get 410 Gone (expiry sim)
         self._closed = False
+        # compile CRD schemas NOW, like a real apiserver does at CRD
+        # registration — lazily compiling them inside the first create
+        # charges the whole jsonschema import (~4s cold) to that request's
+        # latency and skews the p99 of any bench that starts timing at
+        # transport construction
+        _crd_validators()
         for kind in KIND_REGISTRY:
             fake.subscribe(kind, self._make_recorder(kind))
 

@@ -77,7 +77,8 @@ class Histogram(Metric):
 
     TYPE = "histogram"
     DEFAULT_BUCKETS = (
-        0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+        0.001, 0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25, 0.35, 0.5,
+        0.75, 1.0, 2.5, 5.0, 10.0,
     )
 
     def __init__(self, name: str, help_text: str, buckets=None) -> None:

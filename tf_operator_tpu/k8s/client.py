@@ -22,7 +22,6 @@ pkg/controller.v1/tensorflow/suite_test.go:50-76).
 from __future__ import annotations
 
 import base64
-import copy
 import json
 import os
 import ssl
@@ -419,7 +418,7 @@ class _WatchLoop:
         for h in handlers:
             # per-handler copy, matching FakeCluster._notify: a handler that
             # mutates its view must not corrupt another's (or the stream's)
-            h(event_type, copy.deepcopy(obj))
+            h(event_type, objects.fast_deepcopy(obj))
 
     def _list(self) -> Tuple[str, List[Dict[str, Any]]]:
         status, body = self.client.transport.request(

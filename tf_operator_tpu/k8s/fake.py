@@ -14,7 +14,6 @@ by expectation accounting (reference pkg/common/util/reconciler.go:38-157).
 """
 from __future__ import annotations
 
-import copy
 import fnmatch
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -88,7 +87,7 @@ class FakeCluster:
         with self._lock:
             handlers = list(self._handlers.get(kind, []))
         for h in handlers:
-            h(event_type, copy.deepcopy(obj))
+            h(event_type, objects.fast_deepcopy(obj))
 
     # ------------------------------------------------------------- generic
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
@@ -97,7 +96,7 @@ class FakeCluster:
             store = self._kind_store(kind)
             if key in store:
                 raise ConflictError(f"{kind} {key} already exists")
-            obj = copy.deepcopy(obj)
+            obj = objects.fast_deepcopy(obj)
             meta = obj.setdefault("metadata", {})
             meta.setdefault("uid", objects.new_uid())
             meta.setdefault("creationTimestamp", objects.now_iso())
@@ -125,7 +124,7 @@ class FakeCluster:
                 )
             except NotFoundError:
                 pass
-        return copy.deepcopy(obj)
+        return objects.fast_deepcopy(obj)
 
     def _uid_alive(self, uid: str) -> bool:
         with self._lock:
@@ -141,7 +140,7 @@ class FakeCluster:
             key = f"{objects.normalize_namespace(kind, namespace)}/{name}"
             if key not in store:
                 raise NotFoundError(f"{kind} {key}")
-            return copy.deepcopy(store[key])
+            return objects.fast_deepcopy(store[key])
 
     def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
@@ -157,11 +156,11 @@ class FakeCluster:
                 raise ConflictError(
                     f"{kind} {key}: resourceVersion {sent_rv} != {stored_rv}"
                 )
-            obj = copy.deepcopy(obj)
+            obj = objects.fast_deepcopy(obj)
             self._bump(obj)
             store[key] = obj
         self._notify(kind, "MODIFIED", obj)
-        return copy.deepcopy(obj)
+        return objects.fast_deepcopy(obj)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
@@ -213,7 +212,7 @@ class FakeCluster:
                     selector, objects.labels_of(obj)
                 ):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(objects.fast_deepcopy(obj))
             return out
 
     # ------------------------------------------------------------- typed sugar
