@@ -158,6 +158,65 @@ def test_save_does_not_mutate_live_result(cache):
     assert cached["last_error"] == "real regression"
 
 
+def test_micro_sections_tagged_and_never_downgrade_full(cache, monkeypatch):
+    """A BENCH_MICRO save tags every good section; a later micro run must
+    not replace a full-fidelity section (the cache only ever improves),
+    while an arm the full cache lacks is still adopted from micro."""
+    bench.save_tpu_cache(_tpu_result(
+        flash_attention={"causal": {"speedup": 1.8}}
+    ))
+    full = bench.load_tpu_cache()
+    monkeypatch.setenv("BENCH_MICRO", "1")
+    micro = _tpu_result(llama={"tokens_per_sec_per_chip": 5000.0})
+    micro["micro"] = True
+    micro["value"] = 1000.0
+    bench.save_tpu_cache(micro)
+    merged = bench.load_tpu_cache()["result"]
+    # full-fidelity resnet + flash survive, labeled stale; headline follows
+    assert merged["extra"]["resnet"]["stale_from"] == full["measured_at"]
+    assert "micro" not in merged["extra"]["resnet"]
+    assert merged["value"] == 2500.0
+    assert merged["extra"]["flash_attention"]["causal"]["speedup"] == 1.8
+    # the arm only micro measured is adopted, visibly micro-fidelity
+    assert merged["extra"]["llama"]["tokens_per_sec_per_chip"] == 5000.0
+    assert merged["extra"]["llama"]["micro"] is True
+
+
+def test_full_run_replaces_micro_sections(cache, monkeypatch):
+    """The reverse direction: a full-fidelity run overwrites micro
+    sections outright."""
+    monkeypatch.setenv("BENCH_MICRO", "1")
+    micro = _tpu_result(llama={"tokens_per_sec_per_chip": 5000.0})
+    micro["micro"] = True
+    bench.save_tpu_cache(micro)
+    monkeypatch.delenv("BENCH_MICRO")
+    bench.save_tpu_cache(_tpu_result(
+        llama={"tokens_per_sec_per_chip": 5200.0}
+    ))
+    merged = bench.load_tpu_cache()["result"]
+    assert merged["extra"]["llama"] == {"tokens_per_sec_per_chip": 5200.0}
+    assert "micro" not in merged["extra"]["resnet"]
+
+
+def test_cache_write_is_atomic(cache, monkeypatch):
+    """The grabber can SIGTERM the bench mid-save: the write must go via a
+    temp file + rename so a kill can never leave truncated JSON behind."""
+    bench.save_tpu_cache(_tpu_result())
+    good = cache.read_text()
+
+    real_replace = bench.os.replace
+
+    def boom(src, dst):
+        raise OSError("killed mid-rename")
+
+    monkeypatch.setattr(bench.os, "replace", boom)
+    bench.save_tpu_cache(_tpu_result(t5_3b={"tokens_per_sec_per_chip": 1.0}))
+    # the visible cache file is bit-identical to the last good save
+    assert cache.read_text() == good
+    monkeypatch.setattr(bench.os, "replace", real_replace)
+    assert bench.load_tpu_cache()["result"]["platform"] == "tpu"
+
+
 def test_bench_llama_decode_path_runs_on_tiny_config():
     """The decode arm's full path (prefill + ring-cache greedy scan +
     throughput accounting) must execute end to end on a tiny config."""
