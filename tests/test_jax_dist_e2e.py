@@ -18,6 +18,8 @@ import pytest
 
 from tf_operator_tpu.runtime.local import run_local
 
+from tests import testutil
+
 CONSUMER = textwrap.dedent(
     """
     import os
@@ -45,14 +47,6 @@ CONSUMER = textwrap.dedent(
 )
 
 
-def _free_port():
-    import socket
-
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _run_two_host_tpujob(name, consumer, timeout, extra_env=None):
@@ -73,7 +67,7 @@ def _run_two_host_tpujob(name, consumer, timeout, extra_env=None):
                     "image": "local",
                     "command": [sys.executable, "-u", "-c", consumer],
                     "ports": [{"name": "coordinator-port",
-                               "containerPort": _free_port()}],
+                               "containerPort": testutil.free_port()}],
                 }]}},
             }},
         },

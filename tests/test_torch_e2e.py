@@ -22,6 +22,8 @@ pytest.importorskip("torch")
 
 from tf_operator_tpu.runtime.local import run_local  # noqa: E402
 
+from tests import testutil  # noqa: E402
+
 CONSUMER = textwrap.dedent(
     """
     import datetime, os, torch, torch.distributed as dist
@@ -42,17 +44,6 @@ CONSUMER = textwrap.dedent(
 )
 
 
-def _free_port():
-    """A kernel-assigned free port: the operator honors the declared
-    container port (controllers/pytorch.master_port), and a fixed default
-    would flake on TIME_WAIT leftovers from earlier local runs."""
-    import socket
-
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _replica(n, port):
@@ -69,7 +60,7 @@ def _replica(n, port):
 
 
 def test_torch_gloo_rendezvous_over_injected_env():
-    port = _free_port()
+    port = testutil.free_port()
     result = run_local({
         "apiVersion": "kubeflow.org/v1",
         "kind": "PyTorchJob",

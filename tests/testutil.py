@@ -12,6 +12,19 @@ from tf_operator_tpu.k8s import objects
 TEST_IMAGE = "test-image:latest"
 
 
+def free_port() -> int:
+    """A kernel-assigned free port (shared by every test that launches a
+    real listener).  The operator honors declared container ports, and a
+    fixed default would flake on TIME_WAIT leftovers from earlier runs."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def tf_template(image: str = TEST_IMAGE, ports: bool = False) -> Dict[str, Any]:
     c: Dict[str, Any] = {"name": tfapi.DEFAULT_CONTAINER_NAME, "image": image}
     if ports:
