@@ -134,7 +134,80 @@ def test_validation_errors():
     mesh = make_mesh({"ep": EP, "dp": 8 // EP})
     with pytest.raises(ValueError, match="not divisible"):
         make_switch_moe(mesh, n_experts=6)  # 6 % 4 != 0
-    moe = make_switch_moe(mesh, n_experts=E)
-    x = jnp.zeros((1, 6, D))  # 6 tokens, not divisible by ep=4
-    with pytest.raises(ValueError, match="tokens"):
-        moe(x, jnp.zeros((1, 6, E)), jnp.zeros((E, D, F)), jnp.zeros((E, F, D)))
+    # ragged token counts are NOT an error: they pad up to the ep axis
+    # (see the ragged tests below)
+
+
+# ---------------------------------------------------------------- ragged
+def test_ragged_tokens_padded_to_ep_axis():
+    """Token counts not divisible by ep (the prefill shape) are padded
+    internally: with generous capacity the output matches the dense
+    oracle on the REAL tokens, and padding contributes nothing."""
+    mesh = make_mesh({"ep": EP, "dp": 8 // EP})
+    wi, wo, _ = _params(jax.random.PRNGKey(0))
+    # b*s = 3*7 = 21, not divisible by ep=4
+    x, logits = _inputs(jax.random.PRNGKey(1), b=3, s=7)
+    moe = make_switch_moe(mesh, n_experts=E, capacity_factor=float(E))
+    got, aux = jax.jit(moe)(x, logits, wi, wo)
+    want, _ = dense_reference_moe(x, logits, wi, wo, capacity=21)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    assert got.shape == x.shape
+    assert float(aux) > 0
+
+
+def test_ragged_aux_excludes_padding():
+    """The load-balance statistics must be computed over real tokens
+    only: the same tokens with and without forced padding give the same
+    aux loss."""
+    mesh = make_mesh({"ep": EP, "dp": 8 // EP})
+    wi, wo, _ = _params(jax.random.PRNGKey(2))
+    x, logits = _inputs(jax.random.PRNGKey(3), b=4, s=16)  # divisible
+    moe = make_switch_moe(mesh, n_experts=E, capacity_factor=float(E))
+    _, aux_even = jax.jit(moe)(x, logits, wi, wo)
+    # same data minus one token: 63 pads to 64 internally; a naive mean
+    # over the padded rows would dilute the densities, a masked mean
+    # changes aux only by the one missing token's contribution
+    x_r = x.reshape(1, 64, -1)[:, :63]
+    l_r = logits.reshape(1, 64, -1)[:, :63]
+    _, aux_ragged = jax.jit(moe)(x_r, l_r, wi, wo)
+    assert abs(float(aux_ragged) - float(aux_even)) < 0.1
+
+
+def test_switch_route_valid_mask_semantics():
+    """Padding rows: no capacity consumed, no dispatch, zero gate,
+    excluded from aux."""
+    logits = jnp.array(
+        [[9.0, 0.0], [9.0, 0.0], [9.0, 0.0], [9.0, 0.0]], jnp.float32)
+    valid = jnp.array([True, False, True, True])
+    dispatch, gate, aux = switch_route(logits, capacity=2, valid=valid)
+    # rows 0, 2 take expert-0 slots 0, 1; row 3 overflows; row 1 (pad)
+    # consumed nothing
+    assert dispatch[0, 0, 0] == 1 and dispatch[2, 0, 1] == 1
+    assert dispatch[1].sum() == 0 and gate[1] == 0
+    assert dispatch[3].sum() == 0 and gate[3] == 0  # overflow drop
+    # aux densities over the 3 real rows: all routed to expert 0
+    assert float(aux) > 0
+
+
+def test_moe_prefill_generation_under_ep_mesh():
+    """Expert-sharded PREFILL (VERDICT r3 weak #6): a mixtral-style tiny
+    llama generates under an ep mesh with the all-to-all dispatch doing
+    the prefill (batch x prompt_len ragged vs ep), and the tokens match
+    the dense-dispatch model exactly."""
+    from tf_operator_tpu.models import llama
+
+    mesh = make_mesh({"ep": 4, "dp": 2})
+    moe_fn = make_switch_moe(mesh, n_experts=4, capacity_factor=4.0,
+                             activation="swiglu")
+    base = dict(dtype=jnp.float32, n_experts=4, moe_every=1, max_len=32)
+    cfg_dense = llama.tiny(**base)
+    cfg_ep = llama.tiny(**base, moe_dispatch_fn=moe_fn)
+    # prompt 3 x 5 = 15 tokens — not divisible by ep=4
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (3, 5), 0, 256)
+    model = llama.Llama(cfg_dense)
+    params = model.init(jax.random.PRNGKey(1), prompt, train=False)["params"]
+    want = llama.generate(model, params, prompt, max_new_tokens=6)
+    with mesh:
+        got = llama.generate(
+            llama.Llama(cfg_ep), params, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -210,3 +210,139 @@ def test_llama_ring_gqa_drop_in():
             lambda p, t: ring_model.apply({"params": p}, t)
         )(params, toks)
     np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)
+
+
+# ---------------------------------------------------------------- window
+@pytest.mark.parametrize("window", [1, 10, 64])
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_ring_sliding_window_matches_reference(window, layout):
+    """Mistral-style sliding band under the ring, both layouts: output
+    matches the dense windowed reference (window spanning shard
+    boundaries is the interesting case — W=10 crosses the 16-token
+    shards; W=64 covers everything; W=1 is the degenerate self-only
+    band)."""
+    from tf_operator_tpu.ops.zigzag import from_storage, to_storage
+
+    n = 4
+    mesh = make_mesh({"tp": n, "dp": 2})
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    b, s, h, kv, d = 2, 64, 4, 2, 16
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+    g = h // kv
+    want = dot_product_attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), True,
+        window=window)
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, causal=True, axis_name="tp",
+                          layout=layout, window=window),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    if layout == "zigzag":
+        got = from_storage(jax.jit(fn)(
+            to_storage(q, n), to_storage(k, n), to_storage(v, n)), n)
+    else:
+        got = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_sliding_window_grads_match_reference():
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    b, s, h, kv, d, w = 2, 32, 4, 2, 8, 6
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv, d))
+    v = jax.random.normal(ks[2], (b, s, kv, d))
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    ring = shard_map(
+        functools.partial(ring_attention, causal=True, axis_name="tp",
+                          window=w),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    g = h // kv
+    gr = jax.grad(lambda *a: jnp.sum(jax.jit(ring)(*a) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(
+        lambda q, k, v: jnp.sum(dot_product_attention(
+            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2), True,
+            window=w) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gr, gw, "qkv"):
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-5,
+                                   err_msg=name)
+
+
+def test_ring_window_requires_causal():
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    q = jnp.zeros((1, 32, 2, 8))
+    spec = P(("dp", "fsdp"), "tp", None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, causal=False, axis_name="tp",
+                          window=4),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    with pytest.raises(ValueError, match="causal"):
+        jax.jit(fn)(q, q, q)
+
+
+def test_live_ring_steps_truncate_band():
+    """The static liveness math: a narrow band keeps only the first
+    ~ceil(W/S_local)+1 contiguous steps (and both step-range ends under
+    zigzag, whose members hold one early + one late chunk); no window
+    keeps every step."""
+    from tf_operator_tpu.ops.zigzag import live_ring_steps
+
+    # n=8, s_local=16: W=10 reaches <= 1 shard back; step t goes live
+    # once the band reaches distance t*s - (s-1), i.e. W >= (t-1)*s + 2
+    assert live_ring_steps(8, 16, "contiguous", 10) == [0, 1]
+    assert live_ring_steps(8, 16, "contiguous", 17) == [0, 1]
+    assert live_ring_steps(8, 16, "contiguous", 18) == [0, 1, 2]
+    assert live_ring_steps(8, 16, "contiguous", None) == list(range(8))
+    assert live_ring_steps(8, 16, "contiguous", 1) == [0]  # self-only band
+    # zigzag: early-early pairs live at small t, late-late pairs at n-t
+    zz = live_ring_steps(8, 16, "zigzag", 10)
+    assert 0 in zz and zz[-1] == 7 and 4 not in zz
+    # a huge window keeps everything
+    assert live_ring_steps(8, 16, "zigzag", 1000) == list(range(8))
+
+
+def test_ring_window_skips_dead_hops():
+    """The narrow-band ring must not ppermute past the last live step:
+    count ppermutes in the jaxpr (2 live steps -> 1 rotation, vs n-1=3
+    for the full causal ring)."""
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    q = jnp.zeros((2, 64, 2, 16))
+    spec = P(("dp", "fsdp"), "tp", None, None)
+
+    def count_ppermutes(window):
+        fn = shard_map(
+            functools.partial(ring_attention, causal=True, axis_name="tp",
+                              window=window),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
+        jaxpr = jax.make_jaxpr(fn)(q, q, q)
+
+        def walk(jx):
+            total = 0
+            for eqn in jx.eqns:
+                if eqn.primitive.name == "ppermute":
+                    total += 1
+                for param in eqn.params.values():
+                    if hasattr(param, "jaxpr"):
+                        total += walk(param.jaxpr)
+                    elif hasattr(param, "eqns"):
+                        total += walk(param)
+            return total
+
+        return walk(jaxpr.jaxpr)
+
+    # each rotation ppermutes the (k, v) pair -> 2 primitive eqns per hop
+    assert count_ppermutes(8) == 2    # live steps [0, 1] -> one rotation
+    assert count_ppermutes(None) == 6  # full causal ring -> n-1 rotations

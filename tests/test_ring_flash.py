@@ -281,3 +281,77 @@ def test_gqa_zigzag_matches_oracle():
     want = _gqa_oracle(q, k, v, True)
     np.testing.assert_allclose(
         np.asarray(got[:, inv]), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------- window
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+@pytest.mark.parametrize("window", [100, 250])
+def test_window_matches_oracle(layout, window):
+    """Sliding band through the pallas ring, both layouts: band tiles are
+    masked in-kernel, out-of-band ring steps are skipped statically; the
+    dense windowed reference is the oracle.  W=100 crosses the 128-token
+    shards; W=250 spans several."""
+    from tf_operator_tpu.ops.zigzag import from_storage, to_storage
+
+    n = 4
+    mesh = make_mesh({"tp": n, "dp": 2})
+    fn = make_ring_flash_attention_fn(mesh, "tp", interpret=True,
+                                      layout=layout)
+    q, k, v = _qkv(seed=3)
+    want = dot_product_attention(q, k, v, True, window=window)
+    if layout == "zigzag":
+        got = from_storage(jax.jit(
+            lambda q, k, v: fn(to_storage(q, n), to_storage(k, n),
+                               to_storage(v, n), True, window=window)
+        )(q, k, v), n)
+    else:
+        got = jax.jit(
+            lambda q, k, v: fn(q, k, v, True, window=window))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_window_grads_match_oracle():
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    fn = make_ring_flash_attention_fn(mesh, "tp", interpret=True)
+    q, k, v = _qkv(seed=4)
+    w = 150
+
+    def loss(f):
+        return lambda q, k, v: (
+            f(q, k, v, True, window=w).astype(jnp.float32) ** 2).sum()
+
+    g_got = jax.jit(jax.grad(loss(fn), argnums=(0, 1, 2)))(q, k, v)
+    g_want = jax.grad(
+        loss(lambda q, k, v, c, window: dot_product_attention(
+            q, k, v, c, window=window)), argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name}")
+
+
+def test_window_gqa_composes_through_ring():
+    """Compact GQA kv + sliding band + ring together (the Mistral-style
+    long-context combination VERDICT r3 weak #5 named)."""
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    fn = make_ring_flash_attention_fn(mesh, "tp", interpret=True)
+    rng = jax.random.PRNGKey(9)
+    kq, kk, kv_ = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, 4, D))
+    k = jax.random.normal(kk, (B, S, 2, D))
+    v = jax.random.normal(kv_, (B, S, 2, D))
+    got = jax.jit(lambda *a: fn(*a, True, window=100))(q, k, v)
+    want = dot_product_attention(
+        q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2), True,
+        window=100)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_window_requires_causal_in_ring_flash():
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    fn = make_ring_flash_attention_fn(mesh, "tp", interpret=True)
+    q, k, v = _qkv(seed=5)
+    with pytest.raises(ValueError, match="causal"):
+        jax.jit(lambda *a: fn(*a, False, window=64))(q, k, v)

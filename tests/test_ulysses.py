@@ -154,3 +154,17 @@ def test_ulysses_gqa_grads_match_reference():
     for a, b, name in zip(gf, gw, "qkv"):
         assert a.shape == b.shape, name
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_ulysses_sliding_window_matches_reference():
+    """The Mistral band drops through Ulysses' post-exchange local
+    attention (positions are global after the all-to-all)."""
+    mesh = make_mesh({"tp": 4, "dp": 2})
+    fn = make_ulysses_attention_fn(mesh, "tp")
+    rng = jax.random.PRNGKey(11)
+    q, k, v = (jax.random.normal(kk, (2, 64, 4, 16)) for kk in
+               jax.random.split(rng, 3))
+    got = jax.jit(lambda *a: fn(*a, True, window=10))(q, k, v)
+    want = dot_product_attention(q, k, v, True, window=10)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)

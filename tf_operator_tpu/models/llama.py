@@ -60,8 +60,10 @@ class LlamaConfig:
     remat: bool = False  # jax.checkpoint each block
     # Mistral-style sliding-window attention (causal band: each query
     # sees itself + window-1 previous positions); None = full causal.
-    # Passed as window= to the attention backend — flash and the einsum
-    # reference support it; ring/ulysses raise (unsupported there).
+    # Passed as window= to the attention backend — every backend supports
+    # it: flash + the einsum reference mask the band, both rings skip
+    # out-of-band KV shards as they rotate (ops/zigzag.live_ring_steps),
+    # and ulysses hands it to its post-exchange local attention.
     sliding_window: Optional[int] = None
     # Mixtral-style sparse FFN: replace the SwiGLU MLP with switch-routed
     # SwiGLU experts every `moe_every` blocks (0 experts = dense)
@@ -343,10 +345,9 @@ class MoeSwiGlu(nn.Module):
             # weights per step instead of all E. ONLY for L == 1: the
             # gather materializes per-token weight copies [B, L, D, 2F],
             # which at prefill lengths would dwarf the dense dispatch's
-            # activations (the prefill below routes densely instead; the
-            # all-to-all dispatch stays a training-path tool — its token
-            # divisibility cannot hold here and its collectives buy
-            # nothing at decode)
+            # activations (prefill goes through the dispatch fn below —
+            # expert-sharded all-to-all with ragged padding — or dense
+            # routing; the per-step collectives buy nothing at L == 1)
             probs = jax.nn.softmax(logits, axis=-1)
             e_idx = jnp.argmax(probs, axis=-1)               # [B,L]
             gate = jnp.max(probs, axis=-1)                   # [B,L]
@@ -356,7 +357,11 @@ class MoeSwiGlu(nn.Module):
             self.sow("intermediates", "moe_aux_loss",
                      jnp.zeros((), jnp.float32))
             return out * gate[..., None].astype(cfg.dtype)
-        if cfg.moe_dispatch_fn is not None and not decode:
+        if cfg.moe_dispatch_fn is not None:
+            # training forwards AND multi-token prefill: the all-to-all
+            # dispatch pads ragged token counts up to the ep axis
+            # (parallel/ep.make_switch_moe), so expert-sharded prefill
+            # needs no shape cooperation from batch x prompt_len
             out, aux = cfg.moe_dispatch_fn(x, logits, wi, wo)
         else:
             from tf_operator_tpu.parallel.ep import dense_switch_dispatch

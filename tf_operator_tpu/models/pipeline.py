@@ -242,7 +242,8 @@ def _moe_ffn(p: Dict, h: jax.Array, *, ep_axis: Optional[str],
     if ep_axis is not None:
         y, aux = ep_mod._local_moe(
             h.reshape(b * s, e), logits.reshape(b * s, n_experts),
-            wi, wo, n_experts=n_experts, capacity=capacity,
+            wi, wo, jnp.ones((b * s,), bool),  # stage tokens: none padded
+            n_experts=n_experts, capacity=capacity,
             axis_name=ep_axis,
         )
         return y.reshape(b, s, e), aux

@@ -80,6 +80,8 @@ def dot_product_attention(q, k, v, causal: bool, *,
     pallas flash kernel on TPU (ops/flash_attention.py). `window`
     (causal only): sliding-window band — each query sees itself plus the
     window-1 previous positions."""
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     depth = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
     if causal:

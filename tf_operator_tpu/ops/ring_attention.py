@@ -27,10 +27,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-@functools.partial(jax.checkpoint, static_argnums=(5,))
-def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool):
+@functools.partial(jax.checkpoint, static_argnums=(5, 6))
+def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool,
+                 window=None):
     """One ring step: blockwise attention q @ (k, v) with global-position
-    causal mask, merged into the running (o, m, l) accumulator.
+    causal (and optional sliding-window band) mask, merged into the
+    running (o, m, l) accumulator.
 
     k/v may carry fewer heads than q (grouped-query attention): the score
     and PV einsums then contract with q reshaped [B,Sq,KV,G,D], so the
@@ -53,6 +55,10 @@ def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool):
         ).reshape(b, h, sq, -1) * scale
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk] global
+        if window is not None:
+            # sliding band (models/transformer.dot_product_attention
+            # convention): each query sees itself + window-1 previous
+            mask &= k_pos[None, :] > q_pos[:, None] - window
         s = jnp.where(mask[None, None], s, NEG_INF)
     m_blk = jnp.max(s, axis=-1)                          # [B,H,Sq]
     m_new = jnp.maximum(carry_m, m_blk)
@@ -76,6 +82,26 @@ def _merge_block(carry_o, carry_m, carry_l, qkv, pos, causal: bool):
     return o_new, m_new, l_new
 
 
+def rotate_shards(x, axis_name: str, n: int, hop: int):
+    """Rotate resident shards `hop` positions around the ring in ONE
+    ppermute (multi-hop jumps are how dead window steps are skipped)."""
+    return jax.lax.ppermute(
+        x, axis_name, [(i, (i + hop) % n) for i in range(n)])
+
+
+def ring_schedule(n: int, s_local: int, layout: str, window, causal):
+    """[(step, hop)] over the live ring steps — `hop` is the rotation to
+    apply BEFORE computing that step (0 for the first).  Shared by the
+    einsum and pallas rings so the jump bookkeeping lives in one place."""
+    from tf_operator_tpu.ops.zigzag import live_ring_steps
+
+    out, prev = [], 0
+    for t in live_ring_steps(n, s_local, layout, window, causal):
+        out.append((t, t - prev))
+        prev = t
+    return out
+
+
 def _positions(idx, n, s_local, layout: str):
     """[s_local] global position ids ring member `idx` holds."""
     if layout == "zigzag":
@@ -87,7 +113,8 @@ def _positions(idx, n, s_local, layout: str):
 
 def ring_attention(q, k, v, causal: bool = False, *,
                    axis_name: str = "tp",
-                   layout: str = "contiguous") -> jax.Array:
+                   layout: str = "contiguous",
+                   window=None) -> jax.Array:
     """Attention over sequence shards. Call inside shard_map with q
     [B, S_local, H, D] and k, v [B, S_local, KV, D] (KV == H, or fewer
     heads for GQA with H % KV == 0) sharded on dim 1 over `axis_name`.
@@ -96,9 +123,20 @@ def ring_attention(q, k, v, causal: bool = False, *,
     layout="zigzag" expects shards in zigzag storage order
     (ops/zigzag.py) and masks by the matching global positions — the
     balanced layout causal ring_flash exploits; here it only changes the
-    mask math (the einsum block is dense either way)."""
+    mask math (the einsum block is dense either way).
+    window (causal only): Mistral-style sliding band — each query sees
+    itself + window-1 previous positions.  Ring steps whose resident KV
+    lies wholly outside every band are SKIPPED, with one multi-hop
+    ppermute jumping the rotation between live steps: with W << S the
+    causal ring runs in ~ceil(W / S_local) + 1 block-passes instead of n
+    (ops/zigzag.live_ring_steps)."""
     from tf_operator_tpu.ops.flash_attention import check_gqa_shapes
 
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -113,14 +151,13 @@ def ring_attention(q, k, v, causal: bool = False, *,
     m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, s_local), jnp.float32)
     kv = (k, v)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    for step in range(n):
+    for step, hop in ring_schedule(n, s_local, layout, window, causal):
+        if hop:
+            kv = rotate_shards(kv, axis_name, n, hop)
         src = jax.lax.rem(my - step + n, n)  # ring origin of resident KV
         k_pos = _positions(src, n, s_local, layout)
         o, m, l = _merge_block(o, m, l, (q, kv[0], kv[1]),
-                               (q_pos, k_pos), causal)
-        if step < n - 1:
-            kv = jax.lax.ppermute(kv, axis_name, perm)
+                               (q_pos, k_pos), causal, window)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = o / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -137,9 +174,10 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = "tp",
 
     spec = P(batch_axes, axis_name, None, None)
 
-    def attention_fn(q, k, v, causal: bool) -> jax.Array:
+    def attention_fn(q, k, v, causal: bool, window=None) -> jax.Array:
         inner = functools.partial(ring_attention, causal=causal,
-                                  axis_name=axis_name, layout=layout)
+                                  axis_name=axis_name, layout=layout,
+                                  window=window)
         return shard_map(
             inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False,

@@ -39,22 +39,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tf_operator_tpu.ops.flash_attention import (
     NEG_INF,
+    _causal_mask,
     _compiler_params,
     _dot,
     _snap_block,
+    _tile_live,
     _use_interpret,
     check_gqa_shapes,
 )
 
 POS_INF = 1e30
 
-
-def _global_mask(q_g, k_g, blk_q: int, blk_k: int):
-    """[blk_q, blk_k] bool — global q id >= global k id, given the tile's
-    global start ids."""
-    q_ids = q_g + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-    k_ids = k_g + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-    return q_ids >= k_ids
+# the single-chip kernel's mask/liveness helpers are pure id arithmetic,
+# so they apply unchanged with GLOBAL tile-start ids (the only thing the
+# ring changes about masking)
+_global_mask = _causal_mask
 
 
 def _tile_global_start(off_ref, start, s_half: int):
@@ -71,7 +70,8 @@ def _tile_global_start(off_ref, start, s_half: int):
 # ---------------------------------------------------------------- forward
 def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
                       acc_in, m_out, l_out, acc_out, m_scr, l_scr, acc_scr,
-                      *, causal: bool, scale: float, n_kv: int, s_half: int):
+                      *, causal: bool, scale: float, n_kv: int, s_half: int,
+                      window=None):
     blk_q, d = q_ref.shape[1], q_ref.shape[2]
     blk_k = k_ref.shape[1]
     j, t = pl.program_id(1), pl.program_id(2)
@@ -85,18 +85,16 @@ def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
         l_scr[:] = l_in[0]
         acc_scr[:] = acc_in[0]
 
-    if causal:
-        # skip KV tiles whose FIRST global key id is past the last query id
-        live = k_g <= q_g + blk_q - 1
-    else:
-        live = t >= 0
+    # skip KV tiles wholly past the diagonal or before the sliding band
+    live = _tile_live(q_g, k_g, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
         q = q_ref[0]
         s = _dot(q, k_ref[0], ((1,), (1,))) * scale  # [blk_q, blk_k] f32
         if causal:
-            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k), s, NEG_INF)
+            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k, window),
+                          s, NEG_INF)
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -118,7 +116,7 @@ def _carry_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in, l_in,
 
 
 def _carry_fwd_call(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
-                    blk_q: int, blk_k: int, interpret: bool):
+                    blk_q: int, blk_k: int, interpret: bool, window=None):
     """One ring step. q,k,v [BH,S,D]; m,l [BH,S,1] f32; acc [BH,S,D] f32;
     q_off/k_off [2,1] int32 (per-half-chunk global starts). Returns
     updated (m, l, acc)."""
@@ -134,7 +132,7 @@ def _carry_fwd_call(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
     vec_tile = pl.BlockSpec((1, blk_q, 1), lambda i, j, t: (i, j, 0))
     return pl.pallas_call(
         functools.partial(_carry_fwd_kernel, causal=causal, scale=scale,
-                          n_kv=n_kv, s_half=s // 2),
+                          n_kv=n_kv, s_half=s // 2, window=window),
         grid=grid,
         in_specs=[off, off, q_tile, kv_tile, kv_tile, vec_tile, vec_tile,
                   q_tile],
@@ -157,7 +155,7 @@ def _carry_fwd_call(q, k, v, m, l, acc, q_off, k_off, *, causal: bool,
 # --------------------------------------------------------------- backward
 def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dq_ref, dq_scr, *, causal: bool,
-                    scale: float, n_kv: int, s_half: int):
+                    scale: float, n_kv: int, s_half: int, window=None):
     blk_q, d = q_ref.shape[1], q_ref.shape[2]
     blk_k = k_ref.shape[1]
     j, t = pl.program_id(1), pl.program_id(2)
@@ -169,10 +167,7 @@ def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    if causal:
-        live = k_g <= q_g + blk_q - 1
-    else:
-        live = t >= 0
+    live = _tile_live(q_g, k_g, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -181,7 +176,8 @@ def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k_tile = k_ref[0]
         s = _dot(q, k_tile, ((1,), (1,))) * scale
         if causal:
-            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k), s, NEG_INF)
+            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         dp = _dot(do, v_ref[0], ((1,), (1,)))
         ds = (p * (dp - delta_ref[0, :, 0][:, None])).astype(k_tile.dtype)
@@ -194,7 +190,8 @@ def _dq_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                      delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                     causal: bool, scale: float, n_q: int, s_half: int):
+                     causal: bool, scale: float, n_q: int, s_half: int,
+                     window=None):
     blk_k, d = k_ref.shape[1], k_ref.shape[2]
     blk_q = q_ref.shape[1]
     t, j = pl.program_id(1), pl.program_id(2)  # t: kv tile, j: streamed q
@@ -207,10 +204,7 @@ def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    if causal:
-        live = q_g + blk_q - 1 >= k_g
-    else:
-        live = j >= 0
+    live = _tile_live(q_g, k_g, blk_q, blk_k, causal, window)
 
     @pl.when(live)
     def _step():
@@ -219,7 +213,8 @@ def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k_tile = k_ref[0]
         s = _dot(q, k_tile, ((1,), (1,))) * scale
         if causal:
-            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k), s, NEG_INF)
+            s = jnp.where(_global_mask(q_g, k_g, blk_q, blk_k, window),
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         dv_scr[:] = dv_scr[:] + _dot(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot(do, v_ref[0], ((1,), (1,)))
@@ -233,7 +228,7 @@ def _dkv_ring_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _bwd_step_call(q, k, v, do, lse, delta, q_off, k_off, *, causal: bool,
-                   blk_q: int, blk_k: int, interpret: bool):
+                   blk_q: int, blk_k: int, interpret: bool, window=None):
     """One backward ring step: (dq_contrib, dk_contrib, dv_contrib) of the
     local q/do against the resident k/v, all f32 [BH,S,D]."""
     bh, s, d = q.shape
@@ -245,7 +240,7 @@ def _bwd_step_call(q, k, v, do, lse, delta, q_off, k_off, *, causal: bool,
     kv_tile = pl.BlockSpec((1, blk_k, d), lambda i, j, t: (i, t, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_ring_kernel, causal=causal, scale=scale,
-                          n_kv=n_kv, s_half=s // 2),
+                          n_kv=n_kv, s_half=s // 2, window=window),
         grid=(bh, n_q, n_kv),
         in_specs=[off, off, q_tile, kv_tile, kv_tile, q_tile, q_vec, q_vec],
         out_specs=q_tile,
@@ -261,7 +256,7 @@ def _bwd_step_call(q, k, v, do, lse, delta, q_off, k_off, *, causal: bool,
     off2 = pl.BlockSpec(memory_space=pltpu.SMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_ring_kernel, causal=causal, scale=scale,
-                          n_q=n_q, s_half=s // 2),
+                          n_q=n_q, s_half=s // 2, window=window),
         grid=(bh, n_kv, n_q),
         in_specs=[off2, off2, q_stream, kv_fixed, kv_fixed, q_stream,
                   qv_stream, qv_stream],
@@ -315,10 +310,20 @@ def _fold_dkv(g, group: int):
 
 
 def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
-                   layout, group=1):
+                   layout, group=1, window=None):
     """q [BH, S_l, D]; k,v [B*KV, S_l, D] (inside shard_map). The ring
     ppermutes the COMPACT kv shard (group x fewer ICI bytes per hop);
-    each step expands it locally for the kernel. Returns (out, lse)."""
+    each step expands it locally for the kernel. Returns (out, lse).
+
+    With a sliding window, ring steps whose resident shard lies wholly
+    outside every band are skipped statically and the rotation jumps
+    between live steps in one multi-hop ppermute
+    (ring_attention.ring_schedule): W << S runs the causal ring in
+    ~ceil(W / S_local) + 1 block-passes instead of n."""
+    from tf_operator_tpu.ops.ring_attention import (
+        ring_schedule, rotate_shards,
+    )
+
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     bh, s_l, d = q.shape
@@ -327,8 +332,9 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
     acc = jnp.zeros((bh, s_l, d), jnp.float32)
     q_off = _offsets(my, n, s_l, layout)
     kv = (k, v)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    for step in range(n):
+    for step, hop in ring_schedule(n, s_l, layout, window, causal):
+        if hop:
+            kv = rotate_shards(kv, axis_name, n, hop)
         src = jax.lax.rem(my - step + n, n)
 
         def live_step(carry, kv=kv, src=src):
@@ -338,7 +344,7 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
                 m, l, acc, q_off,
                 _offsets(src, n, s_l, layout),
                 causal=causal, blk_q=blk_q, blk_k=blk_k,
-                interpret=interpret)
+                interpret=interpret, window=window)
 
         if causal and step > 0 and layout != "zigzag":
             # a resident shard entirely in the future (src > my) has every
@@ -353,8 +359,6 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
                 src <= my, live_step, lambda c: c, (m, l, acc))
         else:
             m, l, acc = live_step((m, l, acc))
-        if step < n - 1:
-            kv = jax.lax.ppermute(kv, axis_name, perm)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l_safe).astype(q.dtype)
     # fully-masked rows: zero output, +inf lse so backward's exp vanishes
@@ -362,23 +366,28 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
     return out, lse  # lse [BH, S_l, 1] — the shape the bwd kernels read
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _ring_flash(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
-                layout, group):
+                layout, group, window):
     out, _ = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
-                            interpret, layout, group)
+                            interpret, layout, group, window)
     return out
 
 
 def _ring_flash_fwd(q, k, v, causal, axis_name, blk_q, blk_k, interpret,
-                    layout, group):
+                    layout, group, window):
     out, lse = _ring_fwd_pass(q, k, v, causal, axis_name, blk_q, blk_k,
-                              interpret, layout, group)
+                              interpret, layout, group, window)
     return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
-                    group, res, do):
+                    group, window, res, do):
+    from tf_operator_tpu.ops.ring_attention import (
+        ring_schedule, rotate_shards,
+    )
+
     q, k, v, out, lse = res
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -388,14 +397,18 @@ def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
     lse3 = lse  # already [BH, S_l, 1]
     q_off = _offsets(my, n, s_l, layout)
     dq = jnp.zeros((bh, s_l, d), jnp.float32)
-    # (k, v, dk, dv) rotate together — all COMPACT [B*KV, S_l, D]: after n
-    # hops every shard has collected contributions from every q shard and
-    # is home again; each hop's dk/dv contribution is folded back to the
-    # kv heads before riding the ring
+    # (k, v, dk, dv) rotate together — all COMPACT [B*KV, S_l, D]: the
+    # rotation hops between live steps and then closes the loop (n hops
+    # total) so every shard has collected contributions from every live q
+    # shard and is home again; each hop's dk/dv contribution is folded
+    # back to the kv heads before riding the ring
     kvg = (k, v, jnp.zeros(k.shape, jnp.float32),
            jnp.zeros(v.shape, jnp.float32))
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    for step in range(n):
+    rotated = 0
+    for step, hop in ring_schedule(n, s_l, layout, window, causal):
+        if hop:
+            kvg = rotate_shards(kvg, axis_name, n, hop)
+            rotated = step
         src = jax.lax.rem(my - step + n, n)
         k_res, v_res, dk_res, dv_res = kvg
 
@@ -405,7 +418,7 @@ def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
                 q, _expand_kv(k_res, group), _expand_kv(v_res, group),
                 do, lse3, delta, q_off,
                 _offsets(src, n, s_l, layout), causal=causal, blk_q=blk_q,
-                blk_k=blk_k, interpret=interpret)
+                blk_k=blk_k, interpret=interpret, window=window)
             return (dq + dq_c, dk_res + _fold_dkv(dk_c, group),
                     dv_res + _fold_dkv(dv_c, group))
 
@@ -415,8 +428,11 @@ def _ring_flash_bwd(causal, axis_name, blk_q, blk_k, interpret, layout,
                 src <= my, live_step, lambda c: c, (dq, dk_res, dv_res))
         else:
             dq, dk_res, dv_res = live_step((dq, dk_res, dv_res))
-        kvg = jax.lax.ppermute(
-            (k_res, v_res, dk_res, dv_res), axis_name, perm)
+        kvg = (k_res, v_res, dk_res, dv_res)
+    if rotated % n:
+        # close the loop: dk/dv accumulated on whatever member is holding
+        # them must travel the remaining hops to arrive back home
+        kvg = rotate_shards(kvg, axis_name, n, n - rotated)
     _, _, dk, dv = kvg
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -428,7 +444,8 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
                          axis_name: str = "tp", blk_q: int = 512,
                          blk_k: int = 512,
                          interpret: Optional[bool] = None,
-                         layout: str = "contiguous") -> jax.Array:
+                         layout: str = "contiguous",
+                         window: Optional[int] = None) -> jax.Array:
     """Sequence-parallel flash attention. Call inside shard_map with
     q, k, v [B, S_local, H, D] sharded on dim 1 over `axis_name`.
     Falls back to the einsum ring when S_local has no 128-aligned block.
@@ -438,12 +455,24 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
     drops ~half the work on EVERY device uniformly instead of idling the
     early ring members — ~2x causal wall-clock at large ring sizes.
 
+    window (causal only): Mistral-style sliding band — each query sees
+    itself + window-1 previous positions.  Tiles outside the band are
+    skipped inside the kernel, and ring steps whose resident shard lies
+    wholly outside EVERY band are skipped statically with multi-hop
+    ppermute jumps (ops/zigzag.live_ring_steps): W << S runs the ring in
+    ~ceil(W / S_local) + 1 block-passes instead of n.
+
     k/v may carry fewer heads than q (GQA, H % KV == 0): the ring then
     rotates the COMPACT kv shard (group x fewer ICI bytes per hop) and
     expands it locally per step for the kernel; dk/dv fold back to the
     compact [B, S_local, KV, D] shape before riding the ring."""
     b, s_l, h, d = q.shape
     group = check_gqa_shapes(q, k, v)
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     # _snap_block returns s_l itself when s_l <= blk even if unaligned —
     # a block equal to the full array dim is Mosaic-legal (the documented
     # "divisible by (8, 128) or equal to the full dim" rule, same contract
@@ -463,7 +492,7 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
         from tf_operator_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, causal, axis_name=axis_name,
-                              layout=layout)
+                              layout=layout, window=window)
     if interpret is None:
         interpret = _use_interpret()
 
@@ -472,7 +501,7 @@ def ring_flash_attention(q, k, v, causal: bool = False, *,
         return x.transpose(0, 2, 1, 3).reshape(b * hx, s_l, d)
 
     out = _ring_flash(to_bh(q), to_bh(k), to_bh(v), causal, axis_name,
-                      bq, bk, bool(interpret), layout, group)
+                      bq, bk, bool(interpret), layout, group, window)
     return out.reshape(b, h, s_l, d).transpose(0, 2, 1, 3)
 
 
@@ -488,10 +517,10 @@ def make_ring_flash_attention_fn(mesh: Mesh, axis_name: str = "tp",
 
     spec = P(batch_axes, axis_name, None, None)
 
-    def attention_fn(q, k, v, causal: bool) -> jax.Array:
+    def attention_fn(q, k, v, causal: bool, window=None) -> jax.Array:
         inner = functools.partial(
             ring_flash_attention, causal=causal, axis_name=axis_name,
-            interpret=interpret, layout=layout)
+            interpret=interpret, layout=layout, window=window)
         return shard_map(
             inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False,

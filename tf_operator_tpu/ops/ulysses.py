@@ -25,7 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def ulysses_attention(q, k, v, causal: bool = False, *,
                       axis_name: str = "tp", use_flash: bool = False,
-                      interpret=None) -> jax.Array:
+                      interpret=None, window=None) -> jax.Array:
     """Call inside shard_map with q, k, v [B, S_local, H, D], sequence
     sharded over `axis_name`. Requires H divisible by the axis size.
 
@@ -60,13 +60,16 @@ def ulysses_attention(q, k, v, causal: bool = False, *,
                                   tiled=True)
 
     # after the exchange each device holds the FULL sequence for its head
-    # subset, so exact (non-blockwise) attention applies unchanged
+    # subset, so exact (non-blockwise) attention applies unchanged — a
+    # sliding window (Mistral band) drops straight through to the local
+    # backend, which already supports it (the window math needs global
+    # positions, and post-exchange every position IS global)
     if use_flash:
         from tf_operator_tpu.ops.flash_attention import flash_attention
 
         # the pallas kernel is GQA-native: compact local kv goes straight in
         out = flash_attention(fwd(q), fwd(k), fwd(v), causal,
-                              interpret=interpret)
+                              interpret=interpret, window=window)
     else:
         from tf_operator_tpu.models.transformer import dot_product_attention
 
@@ -74,7 +77,7 @@ def ulysses_attention(q, k, v, causal: bool = False, *,
         if group > 1:
             kl = jnp.repeat(kl, group, axis=2)
             vl = jnp.repeat(vl, group, axis=2)
-        out = dot_product_attention(fwd(q), kl, vl, causal)
+        out = dot_product_attention(fwd(q), kl, vl, causal, window=window)
     # all_to_all #2: scatter sequence, gather heads -> [B, S/n, H, D]
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
@@ -89,10 +92,10 @@ def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = "tp",
 
     spec = P(batch_axes, axis_name, None, None)
 
-    def attention_fn(q, k, v, causal: bool) -> jax.Array:
+    def attention_fn(q, k, v, causal: bool, window=None) -> jax.Array:
         inner = functools.partial(ulysses_attention, causal=causal,
                                   axis_name=axis_name, use_flash=use_flash,
-                                  interpret=interpret)
+                                  interpret=interpret, window=window)
         return shard_map(
             inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False,

@@ -70,6 +70,50 @@ def inverse_perm(perm: np.ndarray) -> np.ndarray:
     return inv
 
 
+def member_intervals(idx: int, n: int, s_local: int, layout: str):
+    """Closed global-position intervals [(lo, hi), ...] ring member `idx`
+    holds (static ints — liveness math, not traced)."""
+    if layout == "zigzag":
+        c = s_local // 2
+        return [(idx * c, (idx + 1) * c - 1),
+                ((2 * n - 1 - idx) * c, (2 * n - idx) * c - 1)]
+    return [(idx * s_local, (idx + 1) * s_local - 1)]
+
+
+def live_ring_steps(n: int, s_local: int, layout: str, window,
+                    causal: bool = True):
+    """The ring steps with ANY live (query, key) pair on ANY device under
+    a causal sliding-window band of `window` positions (None = every step
+    — plain causal keeps all n steps live: at step t every member
+    my >= t still attends src = my - t).
+
+    A causal band of width W only reaches keys in [q - W + 1, q], so a
+    resident KV shard whose positions all fall outside every query's band
+    contributes exactly zero — the whole ring step (its einsum/kernel AND
+    its ppermute hop) can be skipped statically.  Callers jump the ring
+    by multi-hop ppermutes between consecutive live steps, so with
+    W << S the causal ring runs in ~ceil(W / s_local) + 1 block-passes
+    instead of n (contiguous layout; zigzag's split chunks keep both ends
+    of the step range live, with the dead half-chunks skipped inside the
+    step).  SPMD note: liveness is a global any-device property, which is
+    what keeps the skip static and collective-safe."""
+    if not causal or window is None:
+        return list(range(n))
+    live = []
+    for t in range(n):
+        hit = False
+        for my in range(n):
+            src = (my - t) % n
+            for qa, qb in member_intervals(my, n, s_local, layout):
+                for ka, kb in member_intervals(src, n, s_local, layout):
+                    # band pairs: 0 <= q - k <= window-1 for some q, k
+                    if qb >= ka and qa - kb <= window - 1:
+                        hit = True
+        if hit:
+            live.append(t)
+    return live
+
+
 def to_storage(x, n: int, axis: int = 1):
     """Gather a logical-order array into zigzag storage order along
     `axis` (host-level; do this once per batch, not per layer)."""

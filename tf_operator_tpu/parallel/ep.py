@@ -32,11 +32,14 @@ from tf_operator_tpu.parallel.compat import shard_map
 
 
 def switch_route(
-    router_logits: jax.Array, capacity: int
+    router_logits: jax.Array, capacity: int, valid=None
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-1 routing with per-expert capacity.
 
     router_logits: [T, E] (float32 for a stable softmax).
+    valid: optional [T] bool — False rows are PADDING (ragged batches
+    rounded up to the ep axis): they consume no capacity, route nowhere,
+    gate to zero, and are excluded from the aux statistics.
     Returns (dispatch [T, E, C] one-hot, gate [T], aux_loss scalar).
     Token t goes to slot `pos` of its expert's bucket, where pos is its
     order among same-expert tokens; pos >= capacity -> dropped.
@@ -46,14 +49,22 @@ def switch_route(
     expert_idx = jnp.argmax(probs, axis=-1)  # [T]
     gate = jnp.max(probs, axis=-1)  # [T]
     onehot = jax.nn.one_hot(expert_idx, n_e, dtype=jnp.int32)  # [T, E]
+    if valid is not None:
+        onehot = onehot * valid[:, None].astype(onehot.dtype)
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E]; -1 where not routed
     in_cap = (pos >= 0) & (pos < capacity)
     dispatch = jax.nn.one_hot(
         jnp.where(in_cap, pos, capacity), capacity + 1, dtype=router_logits.dtype
     )[..., :capacity] * in_cap[..., None].astype(router_logits.dtype)
-    # aux load-balancing loss (Switch Transformer eq. 4)
-    density = jnp.mean(onehot.astype(jnp.float32), axis=0)
-    router_mean = jnp.mean(probs, axis=0)
+    # aux load-balancing loss (Switch Transformer eq. 4) over REAL tokens
+    if valid is None:
+        denom = jnp.float32(t)
+        probs_v = probs
+    else:
+        denom = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+        probs_v = probs * valid[:, None].astype(probs.dtype)
+    density = jnp.sum(onehot.astype(jnp.float32), axis=0) / denom
+    router_mean = jnp.sum(probs_v, axis=0) / denom
     aux = n_e * jnp.sum(density * router_mean)
     gate = gate * in_cap.any(-1).astype(gate.dtype)  # dropped tokens: zero out
     return dispatch, gate, aux
@@ -74,6 +85,7 @@ def _local_moe(
     router_logits: jax.Array,
     wi: jax.Array,
     wo: jax.Array,
+    valid: jax.Array,
     *,
     n_experts: int,
     capacity: int,
@@ -83,12 +95,14 @@ def _local_moe(
     """Per-device body under shard_map.
 
     x [T, d] local tokens; router_logits [T, E]; wi [E_local, d, f],
-    wo [E_local, f, d] local expert weights (E_local = E / ep); for
-    activation='swiglu' wi is [E_local, d, 2f] (gate+up packed).
+    wo [E_local, f, d] local expert weights (E_local = E / ep); valid [T]
+    bool marks real (non-padding) tokens; for activation='swiglu' wi is
+    [E_local, d, 2f] (gate+up packed).
     """
     ep = jax.lax.psum(1, axis_name)
     e_local = n_experts // ep
-    dispatch, gate, aux = switch_route(router_logits.astype(jnp.float32), capacity)
+    dispatch, gate, aux = switch_route(
+        router_logits.astype(jnp.float32), capacity, valid)
     dispatch = dispatch.astype(x.dtype)
 
     # bucket local tokens by destination expert: [E, C, d]
@@ -109,9 +123,13 @@ def _local_moe(
     out = out.reshape(n_experts, capacity, -1)  # [E, C, d]
     # un-bucket into token order, apply gate
     y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None].astype(x.dtype)
-    # aux is identical math on every device only if tokens were global; they
-    # aren't — average across devices for the global load-balance signal
-    aux = jax.lax.pmean(aux, axis_name)
+    # aux is identical math on every device only if tokens were global;
+    # they aren't — combine per-device values weighted by REAL token count
+    # so a device holding only ragged padding does not dilute the global
+    # load-balance signal (its local aux is 0 over 0 tokens)
+    n_valid = valid.sum().astype(jnp.float32)
+    aux = (jax.lax.psum(aux * n_valid, axis_name)
+           / jnp.maximum(jax.lax.psum(n_valid, axis_name), 1.0))
     return y, aux
 
 
@@ -130,6 +148,12 @@ def make_switch_moe(
     activation='swiglu' expects wi [E, d, 2f] (gate+up packed — the
     LLaMA/Mixtral expert FFN). Capacity per (device, expert) =
     ceil(local_tokens / E * factor).
+
+    Ragged token counts are handled by PADDING up to the ep axis (the
+    inference seam: a prefill's batch x prompt_len owes ep nothing):
+    padding rows ride the all-to-alls as zeros, consume no expert
+    capacity, are excluded from the aux statistics, and are stripped
+    from the output — so expert-parallel prefill works for any shape.
     """
     ep = mesh.shape.get(axis_name, 1)
     if n_experts % ep:
@@ -137,9 +161,9 @@ def make_switch_moe(
 
     def run(x, router_logits, wi, wo):
         b, s, d = x.shape
-        if (b * s) % ep:
-            raise ValueError(f"tokens {b * s} not divisible by ep {ep}")
-        local_tokens = b * s // ep
+        t = b * s
+        t_pad = -(-t // ep) * ep  # round up to the ep axis
+        local_tokens = t_pad // ep
         capacity = max(1, math.ceil(local_tokens / n_experts * capacity_factor))
 
         inner = functools.partial(
@@ -150,16 +174,22 @@ def make_switch_moe(
             activation=activation,
         )
         # flatten tokens; shard them over ep; experts already over ep
-        xf = x.reshape(b * s, d)
-        lf = router_logits.reshape(b * s, n_experts)
+        xf = x.reshape(t, d)
+        lf = router_logits.reshape(t, n_experts)
+        valid = jnp.ones((t,), bool)
+        if t_pad != t:
+            xf = jnp.pad(xf, ((0, t_pad - t), (0, 0)))
+            lf = jnp.pad(lf, ((0, t_pad - t), (0, 0)))
+            valid = jnp.pad(valid, (0, t_pad - t))
         y, aux = shard_map(
             inner,
             mesh=mesh,
-            in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name)),
+            in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name),
+                      P(axis_name)),
             out_specs=(P(axis_name), P()),
             check_rep=False,
-        )(xf, lf, wi, wo)
-        return y.reshape(b, s, d), aux
+        )(xf, lf, wi, wo, valid)
+        return y[:t].reshape(b, s, d), aux
 
     return run
 
