@@ -129,14 +129,16 @@ def test_tied_embedding_import_and_parity():
 
 
 def test_config_from_hf_rejects_unsupported():
+    # llama3 rope scaling is SUPPORTED now (mapped to RopeScaling; logits
+    # parity proven below) — only unknown scaling types refuse
     hf_cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=32, intermediate_size=64,
         num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
         rope_scaling={"rope_type": "llama3", "factor": 8.0,
                       "original_max_position_embeddings": 8192,
                       "low_freq_factor": 1.0, "high_freq_factor": 4.0})
-    with pytest.raises(ValueError, match="rope_scaling"):
-        config_from_hf(hf_cfg)
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.rope_scaling is not None and cfg.rope_scaling.factor == 8.0
     hf_cfg2 = transformers.LlamaConfig(
         vocab_size=128, hidden_size=32, intermediate_size=64,
         num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
@@ -184,3 +186,68 @@ def test_export_rejects_moe():
         d_ff=64, max_len=16, n_experts=4, moe_every=1, dtype=jnp.float32)
     with pytest.raises(ValueError, match="MoE"):
         export_hf_llama({}, cfg)
+
+
+# ------------------------------------------------------------ rope scaling
+def test_hf_llama31_rope_scaling_logits_parity():
+    """A llama-3.1-style checkpoint (rope_type='llama3' frequency
+    scaling): the imported model must match transformers' logits, which
+    exercises _scale_inv_freq against HF's _compute_llama3_parameters.
+    Positions beyond original_max_position_embeddings are included so
+    the factor-8 slowdown actually matters."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-5, rope_theta=10000.0, attention_bias=False,
+        mlp_bias=False, tie_word_embeddings=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 16,
+        },
+    )
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert cfg.rope_scaling is not None
+    assert cfg.rope_scaling.factor == 8.0
+    assert cfg.rope_scaling.original_max_len == 16
+    params = import_hf_llama(hf.state_dict(), cfg)
+    # 48 > original 16: the scaled band is exercised
+    tokens = np.random.default_rng(3).integers(0, 256, (2, 48))
+    with torch.no_grad():
+        want = hf(torch.as_tensor(tokens)).logits.numpy()
+    got = llama.Llama(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_config_from_hf_refuses_unknown_rope_type():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+    ).to_dict()
+    hf_cfg["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    with pytest.raises(ValueError, match="yarn"):
+        config_from_hf(hf_cfg)
+
+
+def test_rope_scaling_changes_low_freq_only():
+    """The llama3 recipe: high-frequency components rotate exactly as
+    unscaled RoPE; the lowest frequencies are slowed by `factor`."""
+    from tf_operator_tpu.models.llama import RopeScaling, rope_table
+
+    sc = RopeScaling(factor=8.0, low_freq_factor=1.0,
+                     high_freq_factor=4.0, original_max_len=64)
+    plain = rope_table(128, 64, 500000.0)
+    scaled = rope_table(128, 64, 500000.0, sc)
+    # dimension 0 is the highest frequency (wavelen 2*pi << 16): untouched
+    np.testing.assert_allclose(np.asarray(scaled[:, 0]),
+                               np.asarray(plain[:, 0]), rtol=1e-6)
+    # the last dimension's wavelength far exceeds original_max_len / 1:
+    # slowed by exactly factor
+    np.testing.assert_allclose(np.asarray(scaled[:, -1]),
+                               np.asarray(plain[:, -1]) / 8.0, rtol=1e-6)
+    # monotone in between: every scaled angle <= plain angle (pos > 0)
+    assert np.all(np.asarray(scaled[1:]) <= np.asarray(plain[1:]) + 1e-9)

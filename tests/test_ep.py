@@ -211,3 +211,131 @@ def test_moe_prefill_generation_under_ep_mesh():
         got = llama.generate(
             llama.Llama(cfg_ep), params, prompt, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ----------------------------------------------------------------- top-k
+def test_topk_route_renormalized_gates_and_priority():
+    from tf_operator_tpu.parallel.ep import topk_route
+
+    logits = jnp.array(
+        [[3.0, 2.0, -9.0], [3.0, 2.0, -9.0], [-9.0, 3.0, 2.0]], jnp.float32)
+    dispatch, combine, aux = topk_route(logits, capacity=2, k=2)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # token 0: experts 0,1 with gates p0/(p0+p1), p1/(p0+p1)
+    g0 = float(probs[0, 0] / (probs[0, 0] + probs[0, 1]))
+    np.testing.assert_allclose(float(combine[0, 0].sum()), g0, rtol=1e-6)
+    np.testing.assert_allclose(float(combine[0, 1].sum()), 1 - g0, rtol=1e-6)
+    # combine weights sum to 1 for tokens with both choices live; token 1
+    # loses its SECOND choice to capacity (expert 1 full) and keeps only
+    # its first-choice gate g0 — the drop sheds gate weight, not tokens
+    np.testing.assert_allclose(
+        np.asarray(combine.sum(axis=(1, 2))),
+        np.array([1.0, g0, 1.0]), rtol=1e-5)
+    # first-choice priority: expert 1 is claimed FIRST-choice by token 2
+    # and second-choice by tokens 0, 1 -> with capacity 2, token 2's
+    # first choice must survive; one of the second choices drops
+    d1 = np.asarray(dispatch[:, 1].sum(axis=-1))  # per-token use of e1
+    assert d1[2] == 1, "first-choice claim was shed before second choices"
+    assert d1.sum() == 2  # capacity bound respected
+    assert float(aux) > 0
+
+
+def test_dense_dispatch_top2_matches_manual_reference():
+    from tf_operator_tpu.parallel.ep import dense_switch_dispatch
+
+    wi, wo, _ = _params(jax.random.PRNGKey(4))
+    x, logits = _inputs(jax.random.PRNGKey(5), b=2, s=8)
+    got, aux = dense_switch_dispatch(x, logits, wi, wo, top_k=2)
+    # manual: run every expert densely, weight by renormalized top-2 gates
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, 2)
+    gates = top_p / top_p.sum(-1, keepdims=True)
+    h = jnp.einsum("bsd,edf->bsef", x, wi)
+    h = jax.nn.gelu(h)
+    full = jnp.einsum("bsef,efd->bsed", h, wo)
+    want = sum(
+        jnp.take_along_axis(
+            full, top_i[..., c, None, None], axis=2
+        )[:, :, 0] * gates[..., c, None]
+        for c in range(2)
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_all_to_all_top2_matches_dense_reference():
+    mesh = make_mesh({"ep": EP, "dp": 8 // EP})
+    wi, wo, _ = _params(jax.random.PRNGKey(6))
+    x, logits = _inputs(jax.random.PRNGKey(7))
+    moe = make_switch_moe(mesh, n_experts=E, capacity_factor=float(E),
+                          top_k=2)
+    got, aux = jax.jit(moe)(x, logits, wi, wo)
+    want, _ = dense_reference_moe(
+        x, logits, wi, wo, capacity=2 * x.shape[0] * x.shape[1], top_k=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_mixtral_top2_decode_matches_forward():
+    """True-Mixtral tiny llama (top-2, renormalized gates): the decode
+    gather path (k experts per step) must reproduce the dense forward
+    logits position by position."""
+    from tf_operator_tpu.models import llama
+
+    cfg = llama.tiny(dtype=jnp.float32, n_experts=4, moe_every=1,
+                     moe_top_k=2, max_len=32)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 12), 0, 256)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(1), toks, train=False)["params"]
+    full = model.apply({"params": params}, toks)  # [B, S, V]
+    cache = llama.init_cache(cfg, 2)
+    # prefill the first 4, then decode one token at a time
+    logits, cache = model.apply(
+        {"params": params}, toks[:, :4], cache=cache, cache_pos=0)
+    np.testing.assert_allclose(np.asarray(logits[:, -1]),
+                               np.asarray(full[:, 3]), atol=2e-4, rtol=2e-4)
+    for i in range(4, 12):
+        logits, cache = model.apply(
+            {"params": params}, toks[:, i:i + 1], cache=cache, cache_pos=i)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, i]),
+            atol=2e-4, rtol=2e-4, err_msg=f"pos {i}")
+
+
+def test_moe_top_k_dispatch_fn_mismatch_rejected():
+    """One generate() must never mix top-1 prefill with top-2 decode:
+    a dispatch fn built with a different top_k than the config refuses
+    at config construction."""
+    from tf_operator_tpu.models import llama
+
+    mesh = make_mesh({"ep": 4, "dp": 2})
+    fn1 = make_switch_moe(mesh, n_experts=4, top_k=1)
+    with pytest.raises(ValueError, match="top-1.*moe_top_k=2"):
+        llama.tiny(n_experts=4, moe_every=1, moe_top_k=2,
+                   moe_dispatch_fn=fn1)
+    # matching arity constructs fine
+    fn2 = make_switch_moe(mesh, n_experts=4, top_k=2)
+    llama.tiny(n_experts=4, moe_every=1, moe_top_k=2, moe_dispatch_fn=fn2)
+
+
+def test_mixtral_top2_prefill_under_ep_matches_dense():
+    """True-Mixtral (top-2) expert-sharded prefill: generation under the
+    ep mesh with a top-2 dispatch fn equals the dense top-2 model."""
+    from tf_operator_tpu.models import llama
+
+    mesh = make_mesh({"ep": 4, "dp": 2})
+    moe_fn = make_switch_moe(mesh, n_experts=4, capacity_factor=4.0,
+                             activation="swiglu", top_k=2)
+    base = dict(dtype=jnp.float32, n_experts=4, moe_every=1, moe_top_k=2,
+                max_len=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (3, 5), 0, 256)
+    model = llama.Llama(llama.tiny(**base))
+    params = model.init(jax.random.PRNGKey(5), prompt, train=False)["params"]
+    want = llama.generate(model, params, prompt, max_new_tokens=6)
+    with mesh:
+        got = llama.generate(
+            llama.Llama(llama.tiny(**base, moe_dispatch_fn=moe_fn)),
+            params, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -45,15 +45,29 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
     LlamaConfig defaults norm_eps to 1e-5, a mismatch that skews logits
     by ~1% and is invisible to every shape check."""
     d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
-    # refuse what models/llama.py cannot reproduce — importing anyway
-    # would pass every shape check and silently produce wrong logits,
-    # the exact trap this helper exists to close
-    if d.get("rope_scaling") is not None:
-        raise ValueError(
-            f"rope_scaling={d['rope_scaling']!r} is not supported "
-            f"(models/llama.rope_table implements plain RoPE only); "
-            f"Llama-3.1-style scaled-rope checkpoints would decode with "
-            f"silently wrong rotations")
+    # map what models/llama.py reproduces; refuse the rest — importing
+    # anyway would pass every shape check and silently produce wrong
+    # logits, the exact trap this helper exists to close
+    rope_scaling = None
+    rs = d.get("rope_scaling")
+    if rs is not None:
+        kind = rs.get("rope_type") or rs.get("type")
+        if kind != "llama3":
+            raise ValueError(
+                f"rope_scaling type {kind!r} is not supported "
+                f"(models/llama.rope_table implements plain RoPE and the "
+                f"llama3 frequency-dependent scaling); importing a "
+                f"{kind!r}-scaled checkpoint would decode with silently "
+                f"wrong rotations")
+        from tf_operator_tpu.models.llama import RopeScaling
+
+        rope_scaling = RopeScaling(
+            factor=float(rs["factor"]),
+            low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            original_max_len=int(
+                rs.get("original_max_position_embeddings", 8192)),
+        )
     act = d.get("hidden_act", "silu")
     if act not in ("silu", "swish"):
         raise ValueError(
@@ -68,6 +82,7 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         d_ff=d["intermediate_size"],
         max_len=d["max_position_embeddings"],
         rope_theta=float(d.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
         norm_eps=float(d.get("rms_norm_eps", 1e-6)),
         tie_embeddings=bool(d.get("tie_word_embeddings", False)),
         sliding_window=d.get("sliding_window"),

@@ -41,6 +41,21 @@ import jax.numpy as jnp
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1-style frequency-dependent RoPE scaling ("llama3" rope
+    type): high-frequency components keep their original rotation,
+    wavelengths past the original context are slowed by `factor`, and a
+    smooth band interpolates between the two — which is what lets an
+    8k-trained base extrapolate to 128k.  Frozen dataclass (not a dict)
+    so LlamaConfig stays hashable for the jitted-decode cache."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_len: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
     d_model: int = 4096
@@ -50,6 +65,8 @@ class LlamaConfig:
     d_ff: int = 11008
     max_len: int = 2048
     rope_theta: float = 10000.0
+    # None = plain RoPE; a RopeScaling = llama-3.1 context extension
+    rope_scaling: Optional[RopeScaling] = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
@@ -69,6 +86,9 @@ class LlamaConfig:
     # SwiGLU experts every `moe_every` blocks (0 experts = dense)
     n_experts: int = 0
     moe_every: int = 2
+    # experts per token: 1 = Switch (gate by raw argmax prob), 2 = true
+    # Mixtral (top-2, gates renormalized over the selected experts)
+    moe_top_k: int = 1
     # None -> dense masked-einsum dispatch; or
     # parallel/ep.make_switch_moe(..., activation="swiglu") for explicit
     # all-to-all expert parallelism: (x, logits, wi, wo) -> (y, aux)
@@ -90,6 +110,19 @@ class LlamaConfig:
             raise ValueError(
                 f"moe_every must be >= 1 when n_experts > 0, got "
                 f"{self.moe_every}")
+        if self.n_experts > 0 and not 1 <= self.moe_top_k <= self.n_experts:
+            raise ValueError(
+                f"moe_top_k {self.moe_top_k} out of range "
+                f"[1, {self.n_experts}]")
+        fn_k = getattr(self.moe_dispatch_fn, "top_k", None)
+        if fn_k is not None and fn_k != self.moe_top_k:
+            # the dispatch fn routes prefill/training; the decode gather
+            # routes single-token steps by moe_top_k — a mismatch would
+            # silently run one generate() under two different routings
+            raise ValueError(
+                f"moe_dispatch_fn routes top-{fn_k} but moe_top_k="
+                f"{self.moe_top_k}; pass top_k={self.moe_top_k} to "
+                f"make_switch_moe")
 
     @property
     def head_dim(self) -> int:
@@ -121,6 +154,18 @@ def llama3_8b(**kw) -> LlamaConfig:
     ), kw)
 
 
+def llama31_8b(**kw) -> LlamaConfig:
+    """Llama-3.1-class: the 3.0 layout extended to 128k context via
+    "llama3" rope scaling (factor 8 over the 8k-trained base)."""
+    return _config(dict(
+        vocab_size=128256, d_model=4096, n_heads=32, n_kv_heads=8,
+        n_layers=32, d_ff=14336, max_len=131072, rope_theta=500000.0,
+        rope_scaling=RopeScaling(factor=8.0, low_freq_factor=1.0,
+                                 high_freq_factor=4.0,
+                                 original_max_len=8192),
+    ), kw)
+
+
 def mistral_7b(**kw) -> LlamaConfig:
     """Mistral-class: 4:1 GQA + 4096-token sliding-window attention."""
     return _config(dict(
@@ -132,11 +177,12 @@ def mistral_7b(**kw) -> LlamaConfig:
 
 def mixtral_8x7b(**kw) -> LlamaConfig:
     """Mixtral-class sparse config: 8 SwiGLU experts in EVERY block,
-    top-1 switch routing (active params per token ~ the dense 7B)."""
+    top-2 routing with renormalized gates (the published Mixtral
+    recipe — ~13B active params per token)."""
     return _config(dict(
         vocab_size=32000, d_model=4096, n_heads=32, n_kv_heads=8,
         n_layers=32, d_ff=14336, max_len=8192, rope_theta=1000000.0,
-        n_experts=8, moe_every=1,
+        n_experts=8, moe_every=1, moe_top_k=2,
     ), kw)
 
 
@@ -148,11 +194,34 @@ def tiny(**kw) -> LlamaConfig:
 
 
 # ------------------------------------------------------------------ rotary
-def rope_table(max_len: int, head_dim: int, theta: float) -> jax.Array:
-    """[max_len, head_dim/2] rotation angles: pos / theta^(2i/d)."""
+def _scale_inv_freq(inv_freq: jax.Array, sc: RopeScaling) -> jax.Array:
+    """Llama-3.1 "llama3" rope scaling (matches the published recipe and
+    transformers' _compute_llama3_parameters): components whose wavelength
+    fits well inside the original context (wavelen < orig/high_freq_factor)
+    are untouched; wavelengths past the original context
+    (wavelen > orig/low_freq_factor) are slowed by `factor`; the band
+    between interpolates smoothly."""
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wavelen = sc.original_max_len / sc.low_freq_factor
+    high_wavelen = sc.original_max_len / sc.high_freq_factor
+    smooth = (sc.original_max_len / wavelen - sc.low_freq_factor) / (
+        sc.high_freq_factor - sc.low_freq_factor
+    )
+    smoothed = (1.0 - smooth) * inv_freq / sc.factor + smooth * inv_freq
+    scaled = jnp.where(wavelen > low_wavelen, inv_freq / sc.factor,
+                       jnp.where(wavelen < high_wavelen, inv_freq, smoothed))
+    return scaled
+
+
+def rope_table(max_len: int, head_dim: int, theta: float,
+               scaling: Optional[RopeScaling] = None) -> jax.Array:
+    """[max_len, head_dim/2] rotation angles: pos / theta^(2i/d), with
+    optional llama-3.1 frequency-dependent scaling."""
     inv_freq = theta ** (
         -jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
     )
+    if scaling is not None:
+        inv_freq = _scale_inv_freq(inv_freq, scaling)
     return jnp.arange(max_len, dtype=jnp.float32)[:, None] * inv_freq[None, :]
 
 
@@ -340,23 +409,29 @@ class MoeSwiGlu(nn.Module):
         ).astype(cfg.dtype)
 
         if decode and x.shape[1] == 1:
-            # single-token decode steps: GATHER the token's argmax expert
-            # and run only it — sparse inference reads one expert's
+            # single-token decode steps: GATHER the token's top-k experts
+            # and run only those — sparse inference reads k experts'
             # weights per step instead of all E. ONLY for L == 1: the
-            # gather materializes per-token weight copies [B, L, D, 2F],
+            # gather materializes per-token weight copies [B, L, K, D, 2F],
             # which at prefill lengths would dwarf the dense dispatch's
             # activations (prefill goes through the dispatch fn below —
             # expert-sharded all-to-all with ragged padding — or dense
             # routing; the per-step collectives buy nothing at L == 1)
+            kk = cfg.moe_top_k
             probs = jax.nn.softmax(logits, axis=-1)
-            e_idx = jnp.argmax(probs, axis=-1)               # [B,L]
-            gate = jnp.max(probs, axis=-1)                   # [B,L]
-            h = jnp.einsum("bld,bldf->blf", x, wi[e_idx])
+            top_p, top_i = jax.lax.top_k(probs, kk)          # [B,L,K]
+            if kk > 1:  # Mixtral: renormalize over the selected experts
+                gates = top_p / jnp.maximum(
+                    top_p.sum(-1, keepdims=True), 1e-9)
+            else:
+                gates = top_p
+            h = jnp.einsum("bld,blkdf->blkf", x, wi[top_i])
             g, up = jnp.split(h, 2, axis=-1)
-            out = jnp.einsum("blf,blfd->bld", nn.silu(g) * up, wo[e_idx])
+            out = jnp.einsum("blkf,blkfd->blkd", nn.silu(g) * up, wo[top_i])
+            out = jnp.einsum("blkd,blk->bld", out, gates.astype(cfg.dtype))
             self.sow("intermediates", "moe_aux_loss",
                      jnp.zeros((), jnp.float32))
-            return out * gate[..., None].astype(cfg.dtype)
+            return out
         if cfg.moe_dispatch_fn is not None:
             # training forwards AND multi-token prefill: the all-to-all
             # dispatch pads ragged token counts up to the ep axis
@@ -367,7 +442,8 @@ class MoeSwiGlu(nn.Module):
             from tf_operator_tpu.parallel.ep import dense_switch_dispatch
 
             out, aux = dense_switch_dispatch(
-                x, logits, wi, wo, activation="swiglu", dtype=cfg.dtype)
+                x, logits, wi, wo, activation="swiglu", dtype=cfg.dtype,
+                top_k=cfg.moe_top_k)
         self.sow("intermediates", "moe_aux_loss", aux)
         return out
 
@@ -409,7 +485,8 @@ class Llama(nn.Module):
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
         )
-        table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta)
+        table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta,
+                       cfg.rope_scaling)
         decode = cache is not None
         if decode:
             # cache: per-layer (k, v) tuples (init_cache); cache_pos is the

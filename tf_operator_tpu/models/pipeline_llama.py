@@ -224,7 +224,8 @@ def make_pipelined_apply(cfg: LlamaConfig, mesh: Mesh, n_micro: int):
         raise ValueError(
             f"fsdp {mesh.shape['fsdp']} must divide d_model {cfg.d_model}"
         )
-    angles_table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta)
+    angles_table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta,
+                             cfg.rope_scaling)
     base_stage = functools.partial(
         _stage_fn, angles_table=angles_table, group=cfg.q_per_kv,
         tp_axis=tp_axis, window=cfg.sliding_window, eps=cfg.norm_eps,
@@ -253,7 +254,8 @@ def make_pipelined_apply(cfg: LlamaConfig, mesh: Mesh, n_micro: int):
 def sequential_apply(cfg: LlamaConfig, params: Dict,
                      tokens: jax.Array) -> jax.Array:
     """Unsharded block-by-block reference — the numeric witness."""
-    angles_table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta)
+    angles_table = rope_table(cfg.max_len, cfg.head_dim, cfg.rope_theta,
+                             cfg.rope_scaling)
     x = jnp.take(
         params["embed"]["embedding"], tokens, axis=0
     ).astype(cfg.dtype)

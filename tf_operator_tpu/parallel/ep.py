@@ -31,42 +31,72 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tf_operator_tpu.parallel.compat import shard_map
 
 
-def switch_route(
-    router_logits: jax.Array, capacity: int, valid=None
+def topk_route(
+    router_logits: jax.Array, capacity: int, k: int = 1, valid=None
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Top-1 routing with per-expert capacity.
+    """Top-k routing with per-expert capacity (k=1: Switch; k=2: the
+    Mixtral pattern — gates renormalized over the selected experts).
 
     router_logits: [T, E] (float32 for a stable softmax).
     valid: optional [T] bool — False rows are PADDING (ragged batches
     rounded up to the ep axis): they consume no capacity, route nowhere,
     gate to zero, and are excluded from the aux statistics.
-    Returns (dispatch [T, E, C] one-hot, gate [T], aux_loss scalar).
-    Token t goes to slot `pos` of its expert's bucket, where pos is its
-    order among same-expert tokens; pos >= capacity -> dropped.
+    Returns (dispatch [T, E, C] 0/1, combine [T, E, C] gate weights,
+    aux_loss scalar).  Capacity is FIRST-CHOICE-PRIORITY: every token's
+    1st-choice claim is positioned before any 2nd-choice claim (GShard
+    semantics), so congestion sheds the weaker assignments first; an
+    over-capacity choice is dropped (its gate weight simply vanishes —
+    the residual stream carries the token unchanged for that expert).
     """
     t, n_e = router_logits.shape
     probs = jax.nn.softmax(router_logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    gate = jnp.max(probs, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert_idx, n_e, dtype=jnp.int32)  # [T, E]
+    top_p, top_i = jax.lax.top_k(probs, k)                  # [T, k]
+    # k=1 keeps the raw argmax prob (the Switch gate); k>1 renormalizes
+    # the gates over the selected experts (the Mixtral convention)
+    if k > 1:
+        gates = top_p / jnp.maximum(
+            top_p.sum(-1, keepdims=True), 1e-9)
+    else:
+        gates = top_p
+    onehots = jax.nn.one_hot(top_i, n_e, dtype=jnp.int32)   # [T, k, E]
     if valid is not None:
-        onehot = onehot * valid[:, None].astype(onehot.dtype)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E]; -1 where not routed
-    in_cap = (pos >= 0) & (pos < capacity)
-    dispatch = jax.nn.one_hot(
-        jnp.where(in_cap, pos, capacity), capacity + 1, dtype=router_logits.dtype
-    )[..., :capacity] * in_cap[..., None].astype(router_logits.dtype)
-    # aux load-balancing loss (Switch Transformer eq. 4) over REAL tokens
+        onehots = onehots * valid[:, None, None].astype(onehots.dtype)
+    dispatch = jnp.zeros((t, n_e, capacity), router_logits.dtype)
+    combine = jnp.zeros((t, n_e, capacity), router_logits.dtype)
+    claimed = jnp.zeros((n_e,), jnp.int32)  # slots taken by higher choices
+    for c in range(k):
+        oh = onehots[:, c]                                   # [T, E]
+        pos = jnp.cumsum(oh, axis=0) * oh - 1 + claimed[None, :]
+        in_cap = (pos >= claimed[None, :]) & (pos < capacity) & (oh > 0)
+        slot = jax.nn.one_hot(
+            jnp.where(in_cap, pos, capacity), capacity + 1,
+            dtype=router_logits.dtype,
+        )[..., :capacity] * in_cap[..., None].astype(router_logits.dtype)
+        dispatch = dispatch + slot
+        combine = combine + slot * gates[:, c, None, None]
+        claimed = claimed + oh.sum(axis=0)
+    # aux load-balancing loss (Switch eq. 4 / Mixtral generalization):
+    # density counts every top-k selection, normalized per choice
     if valid is None:
         denom = jnp.float32(t)
         probs_v = probs
     else:
         denom = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
         probs_v = probs * valid[:, None].astype(probs.dtype)
-    density = jnp.sum(onehot.astype(jnp.float32), axis=0) / denom
+    density = jnp.sum(onehots.astype(jnp.float32), axis=(0, 1)) / (denom * k)
     router_mean = jnp.sum(probs_v, axis=0) / denom
     aux = n_e * jnp.sum(density * router_mean)
-    gate = gate * in_cap.any(-1).astype(gate.dtype)  # dropped tokens: zero out
+    return dispatch, combine, aux
+
+
+def switch_route(
+    router_logits: jax.Array, capacity: int, valid=None
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 routing with per-expert capacity (the Switch pattern) —
+    kept as the (dispatch, per-token gate, aux) view of topk_route(k=1)
+    for callers that fold the gate themselves."""
+    dispatch, combine, aux = topk_route(router_logits, capacity, 1, valid)
+    gate = combine.sum(axis=(1, 2))  # one live slot per token -> its gate
     return dispatch, gate, aux
 
 
@@ -91,6 +121,7 @@ def _local_moe(
     capacity: int,
     axis_name: str,
     activation: str = "gelu",
+    top_k: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-device body under shard_map.
 
@@ -101,9 +132,10 @@ def _local_moe(
     """
     ep = jax.lax.psum(1, axis_name)
     e_local = n_experts // ep
-    dispatch, gate, aux = switch_route(
-        router_logits.astype(jnp.float32), capacity, valid)
+    dispatch, combine, aux = topk_route(
+        router_logits.astype(jnp.float32), capacity, top_k, valid)
     dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
 
     # bucket local tokens by destination expert: [E, C, d]
     buckets = jnp.einsum("tec,td->ecd", dispatch, x)
@@ -121,8 +153,9 @@ def _local_moe(
     # all_to_all #2: route results back to the token-owning devices
     out = jax.lax.all_to_all(out, axis_name, 0, 0, tiled=False)
     out = out.reshape(n_experts, capacity, -1)  # [E, C, d]
-    # un-bucket into token order, apply gate
-    y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None].astype(x.dtype)
+    # un-bucket into token order with the gate weights folded in (top-k:
+    # each token sums its k expert outputs by renormalized gates)
+    y = jnp.einsum("tec,ecd->td", combine, out)
     # aux is identical math on every device only if tokens were global;
     # they aren't — combine per-device values weighted by REAL token count
     # so a device holding only ragged padding does not dilute the global
@@ -139,6 +172,7 @@ def make_switch_moe(
     capacity_factor: float = 1.25,
     axis_name: str = "ep",
     activation: str = "gelu",
+    top_k: int = 1,
 ):
     """Build f(x, router_logits, wi, wo) -> (y, aux) running all-to-all EP
     over `mesh`.
@@ -158,13 +192,17 @@ def make_switch_moe(
     ep = mesh.shape.get(axis_name, 1)
     if n_experts % ep:
         raise ValueError(f"n_experts {n_experts} not divisible by ep {ep}")
+    if not 1 <= top_k <= n_experts:
+        raise ValueError(f"top_k {top_k} out of range [1, {n_experts}]")
 
     def run(x, router_logits, wi, wo):
         b, s, d = x.shape
         t = b * s
         t_pad = -(-t // ep) * ep  # round up to the ep axis
         local_tokens = t_pad // ep
-        capacity = max(1, math.ceil(local_tokens / n_experts * capacity_factor))
+        # top-k tokens claim k slots each — capacity scales with k
+        capacity = max(1, math.ceil(
+            local_tokens * top_k / n_experts * capacity_factor))
 
         inner = functools.partial(
             _local_moe,
@@ -172,6 +210,7 @@ def make_switch_moe(
             capacity=capacity,
             axis_name=axis_name,
             activation=activation,
+            top_k=top_k,
         )
         # flatten tokens; shard them over ep; experts already over ep
         xf = x.reshape(t, d)
@@ -191,43 +230,58 @@ def make_switch_moe(
         )(xf, lf, wi, wo, valid)
         return y[:t].reshape(b, s, d), aux
 
+    # introspectable routing arity: model code (llama.MoeSwiGlu) checks
+    # this against its own decode-path top_k so one generate() can never
+    # mix top-1 prefill with top-2 decode
+    run.top_k = top_k
     return run
 
 
 def dense_switch_dispatch(x, router_logits, wi, wo, activation: str = "gelu",
-                          dtype=None):
-    """Dense masked-einsum top-1 dispatch — the zero-comm MoE path both
+                          dtype=None, top_k: int = 1):
+    """Dense masked-einsum top-k dispatch — the zero-comm MoE path both
     model families share (transformer.MoeMlp, llama.MoeSwiGlu): every
-    token through its argmax expert via one-hot einsums (capacity =
-    tokens, nothing drops), Switch aux loss included. GSPMD shards the
-    expert dim; best at moderate E. Returns (y [B,S,D], aux)."""
+    token through its top-k experts via one-hot einsums (capacity =
+    tokens, nothing drops), Switch/Mixtral aux loss included.  top_k=1
+    gates by the raw argmax prob (Switch); top_k>1 renormalizes the
+    gates over the selected experts (Mixtral).  GSPMD shards the expert
+    dim; best at moderate E. Returns (y [B,S,D], aux)."""
     dt = dtype or x.dtype
+    n_e = wi.shape[0]
     probs = jax.nn.softmax(router_logits, axis=-1)          # [B,S,E] f32
-    expert_idx = jnp.argmax(probs, axis=-1)
-    gate = jnp.max(probs, axis=-1)
-    onehot = jax.nn.one_hot(expert_idx, wi.shape[0], dtype=dt)
+    top_p, top_i = jax.lax.top_k(probs, top_k)              # [B,S,k]
+    if top_k > 1:
+        gates = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+    else:
+        gates = top_p
+    onehots = jax.nn.one_hot(top_i, n_e, dtype=jnp.float32)  # [B,S,k,E]
+    # per-expert gate weights (0 for unselected): [B,S,E]
+    combine = jnp.einsum("bske,bsk->bse", onehots, gates).astype(dt)
     h = _expert_ffn(jnp.einsum("bsd,edf->bsef", x, wi), activation)
     out = jnp.einsum("bsef,efd->bsed", h, wo)
-    out = jnp.einsum("bsed,bse->bsd", out, onehot)
-    # auxiliary load-balancing loss (Switch Transformer eq. 4)
-    density = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))
+    out = jnp.einsum("bsed,bse->bsd", out, combine)
+    # auxiliary load-balancing loss (Switch eq. 4 / Mixtral): density
+    # counts every top-k selection, normalized per choice
+    density = jnp.sum(onehots, axis=(0, 1, 2)) / (
+        probs.shape[0] * probs.shape[1] * top_k)
     router_mean = jnp.mean(probs, axis=(0, 1))
-    aux = wi.shape[0] * jnp.sum(density * router_mean)
-    return out * gate[..., None].astype(dt), aux
+    aux = n_e * jnp.sum(density * router_mean)
+    return out, aux
 
 
 def dense_reference_moe(x, router_logits, wi, wo, capacity: int,
-                        activation: str = "gelu"):
+                        activation: str = "gelu", top_k: int = 1):
     """Single-device reference with identical routing/capacity semantics —
     the correctness oracle for tests."""
     b, s, d = x.shape
     xf = x.reshape(b * s, d)
-    dispatch, gate, aux = switch_route(
-        router_logits.reshape(b * s, -1).astype(jnp.float32), capacity
+    dispatch, combine, aux = topk_route(
+        router_logits.reshape(b * s, -1).astype(jnp.float32), capacity,
+        top_k,
     )
     dispatch = dispatch.astype(x.dtype)
     buckets = jnp.einsum("tec,td->ecd", dispatch, xf)
     h = _expert_ffn(jnp.einsum("ecd,edf->ecf", buckets, wi), activation)
     out = jnp.einsum("ecf,efd->ecd", h, wo)
-    y = jnp.einsum("tec,ecd->td", dispatch, out) * gate[:, None].astype(x.dtype)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out)
     return y.reshape(b, s, d), aux
