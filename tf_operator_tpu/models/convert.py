@@ -87,15 +87,23 @@ def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
         tie_embeddings=bool(d.get("tie_word_embeddings", False)),
         sliding_window=d.get("sliding_window"),
     )
+    if d.get("num_local_experts"):
+        # MixtralConfig: sparse FFN in every block, top-k routing
+        base.update(
+            n_experts=int(d["num_local_experts"]),
+            moe_top_k=int(d.get("num_experts_per_tok", 2)),
+            moe_every=1,
+        )
     base.update(overrides)
     return LlamaConfig(**base)
 
 
 def import_hf_llama(state_dict: Mapping[str, Any],
                     cfg: LlamaConfig) -> Dict:
-    """HF `LlamaForCausalLM.state_dict()` -> params for
-    `models.llama.Llama(cfg)`. Shapes are validated against cfg; missing
-    or extra keys raise with the offending name."""
+    """HF `LlamaForCausalLM.state_dict()` (or `MixtralForCausalLM` when
+    cfg.n_experts > 0) -> params for `models.llama.Llama(cfg)`. Shapes
+    are validated against cfg; missing or extra keys raise with the
+    offending name."""
     e, h, kv, d = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     sd = dict(state_dict)
 
@@ -122,10 +130,7 @@ def import_hf_llama(state_dict: Mapping[str, Any],
         wk = take(p + "self_attn.k_proj.weight", (kv * d, e))
         wv = take(p + "self_attn.v_proj.weight", (kv * d, e))
         wo = take(p + "self_attn.o_proj.weight", (e, h * d))
-        gate = take(p + "mlp.gate_proj.weight", (cfg.d_ff, e))
-        up = take(p + "mlp.up_proj.weight", (cfg.d_ff, e))
-        down = take(p + "mlp.down_proj.weight", (e, cfg.d_ff))
-        params[f"block{i}"] = {
+        block: Dict[str, Any] = {
             "ln1": {"scale": take(p + "input_layernorm.weight", (e,))},
             "ln2": {"scale": take(
                 p + "post_attention_layernorm.weight", (e,))},
@@ -139,12 +144,40 @@ def import_hf_llama(state_dict: Mapping[str, Any],
                 # o_proj [E, H*D] -> [heads, head_dim, E]
                 "out": {"kernel": wo.T.reshape(h, d, e)},
             },
-            "mlp": {
+        }
+        use_moe = (cfg.n_experts > 0
+                   and i % cfg.moe_every == cfg.moe_every - 1)
+        if use_moe:
+            # Mixtral sparse block: per-expert w1 (gate) / w3 (up) / w2
+            # (down) fold into the packed [X, D, 2F] wi and [X, F, D] wo
+            # that MoeSwiGlu reads (gate occupies the first F columns —
+            # _expert_ffn splits the last dim in that order)
+            mp = p + "block_sparse_moe."
+            router = take(mp + "gate.weight", (cfg.n_experts, e))
+            wi = np.empty((cfg.n_experts, e, 2 * cfg.d_ff), np.float32)
+            wo_e = np.empty((cfg.n_experts, cfg.d_ff, e), np.float32)
+            for j in range(cfg.n_experts):
+                xp = mp + f"experts.{j}."
+                wi[j, :, :cfg.d_ff] = take(
+                    xp + "w1.weight", (cfg.d_ff, e)).T
+                wi[j, :, cfg.d_ff:] = take(
+                    xp + "w3.weight", (cfg.d_ff, e)).T
+                wo_e[j] = take(xp + "w2.weight", (e, cfg.d_ff)).T
+            block["moe"] = {
+                "router": {"kernel": router.T},
+                "wi": wi,
+                "wo": wo_e,
+            }
+        else:
+            gate = take(p + "mlp.gate_proj.weight", (cfg.d_ff, e))
+            up = take(p + "mlp.up_proj.weight", (cfg.d_ff, e))
+            down = take(p + "mlp.down_proj.weight", (e, cfg.d_ff))
+            block["mlp"] = {
                 # SwiGLU gate+up packed [E, 2, F]
                 "wi": {"kernel": np.stack([gate.T, up.T], axis=1)},
                 "wo": {"kernel": down.T},
-            },
-        }
+            }
+        params[f"block{i}"] = block
     if cfg.tie_embeddings:
         # tied checkpoints either omit lm_head or alias it to the embedding
         lm_w = sd.pop("lm_head.weight", None)
@@ -168,16 +201,18 @@ def import_hf_llama(state_dict: Mapping[str, Any],
 
 def export_hf_llama(params: Mapping[str, Any],
                     cfg: LlamaConfig) -> Dict[str, np.ndarray]:
-    """The inverse: flax params -> an HF `LlamaForCausalLM` state dict
-    (numpy f32), so models trained or LoRA-merged here deploy on any
-    HF-compatible stack. Exact inverse of import_hf_llama
-    (tests/test_convert.py proves the roundtrip and that transformers
-    itself accepts and reproduces the exported weights)."""
-    if cfg.n_experts:
+    """The inverse: flax params -> an HF `LlamaForCausalLM` (or, when
+    cfg.n_experts > 0, `MixtralForCausalLM`) state dict (numpy f32), so
+    models trained or LoRA-merged here deploy on any HF-compatible
+    stack. Exact inverse of import_hf_llama (tests/test_convert.py
+    proves the roundtrip and that transformers itself accepts and
+    reproduces the exported weights)."""
+    if cfg.n_experts and cfg.moe_every != 1:
         raise ValueError(
-            "export of MoE configs is not supported (HF LlamaForCausalLM "
-            "has no expert weights; a Mixtral exporter would target a "
-            "different architecture)")
+            f"export of interleaved-MoE configs (moe_every="
+            f"{cfg.moe_every}) is not supported: MixtralForCausalLM has "
+            f"experts in EVERY layer; a mixed dense/sparse stack matches "
+            f"no HF architecture")
     e, h, kv, d = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": _np(params["embed"]["embedding"]),
@@ -195,10 +230,22 @@ def export_hf_llama(params: Mapping[str, Any],
         sd[p + "self_attn.v_proj.weight"] = wkv[:, 1].reshape(e, kv * d).T
         sd[p + "self_attn.o_proj.weight"] = (
             _np(blk["attn"]["out"]["kernel"]).reshape(h * d, e).T)
-        wi = _np(blk["mlp"]["wi"]["kernel"])  # [E, 2, F]
-        sd[p + "mlp.gate_proj.weight"] = wi[:, 0].T
-        sd[p + "mlp.up_proj.weight"] = wi[:, 1].T
-        sd[p + "mlp.down_proj.weight"] = _np(blk["mlp"]["wo"]["kernel"]).T
+        if "moe" in blk:
+            mp = p + "block_sparse_moe."
+            sd[mp + "gate.weight"] = _np(blk["moe"]["router"]["kernel"]).T
+            wi_e = _np(blk["moe"]["wi"])       # [X, E, 2F] gate||up
+            wo_e = _np(blk["moe"]["wo"])       # [X, F, E]
+            f = wi_e.shape[-1] // 2
+            for j in range(wi_e.shape[0]):
+                xp = mp + f"experts.{j}."
+                sd[xp + "w1.weight"] = wi_e[j, :, :f].T
+                sd[xp + "w3.weight"] = wi_e[j, :, f:].T
+                sd[xp + "w2.weight"] = wo_e[j].T
+        else:
+            wi = _np(blk["mlp"]["wi"]["kernel"])  # [E, 2, F]
+            sd[p + "mlp.gate_proj.weight"] = wi[:, 0].T
+            sd[p + "mlp.up_proj.weight"] = wi[:, 1].T
+            sd[p + "mlp.down_proj.weight"] = _np(blk["mlp"]["wo"]["kernel"]).T
     if cfg.tie_embeddings:
         sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
     else:
