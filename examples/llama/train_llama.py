@@ -16,7 +16,9 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from tf_operator_tpu.models.llama import Llama, llama3_8b, tiny
+from tf_operator_tpu.models.llama import (
+    Llama, llama3_8b, llama31_8b, mistral_7b, mixtral_8x7b, tiny,
+)
 from tf_operator_tpu.models.transformer import lm_loss
 from tf_operator_tpu.ops.blocked_ce import lm_blocked_loss
 from tf_operator_tpu.parallel.mesh import make_mesh, local_mesh_axes
@@ -79,15 +81,33 @@ def main(argv=None):
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--save-interval", type=int, default=500)
     ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel degree (mixtral: all-to-all "
+                         "dispatch over this axis)")
     ap.add_argument("--ring", action="store_true",
                     help="sequence-parallel ring attention over tp "
-                         "(compact GQA kv shards on the ring)")
+                         "(compact GQA kv shards on the ring; composes "
+                         "with mistral's sliding window — out-of-band "
+                         "ring hops are skipped statically)")
+    ap.add_argument("--model", default="llama3",
+                    choices=["llama3", "llama31", "mistral", "mixtral"],
+                    help="llama3 = 8B GQA; llama31 = +128k rope scaling; "
+                         "mistral = +4k sliding window; mixtral = 8x "
+                         "top-2 experts")
     ap.add_argument("--smoke", action="store_true", help="tiny model, CPU ok")
     args = ap.parse_args(argv)
 
     info = bootstrap.initialize()
-    mesh = make_mesh(axes=local_mesh_axes(jax.device_count(),
-                                          prefer_tp=args.tp))
+    if args.ep > 1 and args.model != "mixtral":
+        raise SystemExit(
+            f"--ep only applies to --model=mixtral (a dense {args.model} "
+            f"has nothing to shard over an expert axis)")
+    axes = local_mesh_axes(jax.device_count(), prefer_tp=args.tp)
+    if args.ep > 1:
+        if axes["dp"] % args.ep:
+            raise SystemExit(f"--ep {args.ep} must divide dp {axes['dp']}")
+        axes = {**axes, "ep": args.ep, "dp": axes["dp"] // args.ep}
+    mesh = make_mesh(axes=axes)
     print(f"host {info.process_id}/{info.num_processes} slice "
           f"{info.slice_id}/{info.num_slices}, mesh {dict(mesh.shape)}")
 
@@ -99,11 +119,34 @@ def main(argv=None):
         from tf_operator_tpu.ops.flash_attention import flash_attention
 
         attention_fn = flash_attention
+    presets = {"llama3": llama3_8b, "llama31": llama31_8b,
+               "mistral": mistral_7b, "mixtral": mixtral_8x7b}
+    extra = {}
+    if args.model == "mixtral":
+        n_experts = 4 if args.smoke else 8  # one source for the dispatch fn
+        if args.ep > 1:
+            from tf_operator_tpu.parallel.ep import make_switch_moe
+
+            # the same dispatch fn runs expert-sharded prefill at inference
+            extra["moe_dispatch_fn"] = make_switch_moe(
+                mesh, n_experts=n_experts, activation="swiglu", top_k=2)
     if args.smoke:
-        cfg = tiny(tie_embeddings=True, attention_fn=attention_fn)
+        if args.model == "mixtral":
+            extra.update(n_experts=n_experts, moe_every=1, moe_top_k=2)
+        if args.model == "mistral":
+            extra["sliding_window"] = 16
+        cfg = tiny(tie_embeddings=True, attention_fn=attention_fn, **extra)
     else:
-        cfg = llama3_8b(tie_embeddings=True, remat=True,
-                        attention_fn=attention_fn)
+        cfg = presets[args.model](tie_embeddings=True, remat=True,
+                                  attention_fn=attention_fn, **extra)
+        if args.seq_len > cfg.max_len:
+            # long-context runs (e.g. mistral at 32k over its 8k preset):
+            # extend the RoPE table instead of silently clamping — the
+            # whole point of a sliding-window/rope-scaled config is
+            # sequences past the preset default
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, max_len=args.seq_len)
     seq_len = min(args.seq_len, cfg.max_len)
 
     model = Llama(cfg)

@@ -347,3 +347,36 @@ def test_llama_smoke_token_record_pipeline(tmp_path):
     assert "data: records x32 (shard 0/1" in rc.stdout, rc.stdout[-500:]
     assert "data: synthetic" not in rc.stdout
     assert "complete: steps=2" in rc.stdout
+
+
+def test_llama_smoke_mistral_swa_ring():
+    """--model=mistral --ring: the sliding band crosses the tp=2 ring's
+    shard boundaries through the example's own path."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=2"))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(EX, "llama/train_llama.py"),
+         "--smoke", "--steps=2", "--per-host-batch=2",
+         "--model=mistral", "--ring", "--tp=2"],
+        capture_output=True, text=True, env=env, timeout=600, cwd=REPO,
+    )
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "complete: steps=2" in rc.stdout
+
+
+def test_llama_smoke_mixtral_expert_parallel():
+    """--model=mixtral --ep=2: top-2 all-to-all dispatch over a real ep
+    axis through the example's own path."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=2"))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(EX, "llama/train_llama.py"),
+         "--smoke", "--steps=2", "--per-host-batch=2",
+         "--model=mixtral", "--ep=2"],
+        capture_output=True, text=True, env=env, timeout=600, cwd=REPO,
+    )
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "'ep': 2" in rc.stdout
+    assert "complete: steps=2" in rc.stdout

@@ -168,3 +168,23 @@ def test_ulysses_sliding_window_matches_reference():
     want = dot_product_attention(q, k, v, True, window=10)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_flash_sliding_window_matches_reference():
+    """window + the pallas kernel + compact GQA kv through Ulysses
+    together (kv heads < q heads, so the GQA exchange path is on the
+    line, not just the window mask)."""
+    mesh = make_mesh({"tp": 2, "dp": 4})
+    fn = make_ulysses_attention_fn(mesh, "tp", use_flash=True,
+                                   interpret=True)
+    rng = jax.random.PRNGKey(12)
+    kq, kk, kv_ = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (4, 256, 4, 16))
+    k = jax.random.normal(kk, (4, 256, 2, 16))
+    v = jax.random.normal(kv_, (4, 256, 2, 16))
+    got = jax.jit(lambda *a: fn(*a, True, window=50))(q, k, v)
+    want = dot_product_attention(
+        q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2), True,
+        window=50)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
