@@ -530,6 +530,32 @@ def bench_llama(gen: str, cfg=None):
     return r
 
 
+def _mixtral_1b_cfg(**kw):
+    """~1B-total / ~0.4B-active 8-expert top-2 config for the MoE arm —
+    the true-Mixtral recipe (models/llama.py) on the same 1B-class base
+    as the dense llama arms (so the two stay comparable)."""
+    return _llama_1b_cfg(
+        n_layers=8, d_ff=2816, n_experts=8, moe_every=1, moe_top_k=2,
+        **kw)
+
+
+def bench_moe(gen: str, cfg=None):
+    """Sparse-decoder arm: 8-expert top-2 mixtral-class train step —
+    tokens/sec/chip + MFU over ACTIVE FLOPs (router + 2 experts/token;
+    llama.params_flops_per_token). Dense dispatch on one chip (the
+    all-to-all needs an ep mesh); default-on with a chip, opt-out via
+    BENCH_MOE=0. `cfg` override: tests/CPU smoke run a tiny config."""
+    from tf_operator_tpu.models import llama as llm
+
+    if cfg is None:
+        cfg = _mixtral_1b_cfg(remat=True)
+    r = _bench_big_lm(
+        gen, llm.Llama(cfg), cfg, llm.params_flops_per_token(cfg), batch=4,
+    )
+    r["experts"] = f"{cfg.n_experts}x top-{cfg.moe_top_k}"
+    return r
+
+
 def bench_llama_decode(gen: str, cfg=None, max_new: int = 128):
     """Autoregressive inference arm: prefill + greedy ring-cache decode on
     the 1B-class GQA llama (models/llama.generate). Reports prefill and
@@ -1203,6 +1229,13 @@ def main() -> int:
                 extra["llama_decode"] = {
                     "error": f"{type(e).__name__}: {e}"[:300]}
             checkpoint_cache(resnet)
+        if os.environ.get("BENCH_MOE", "1") == "1" and not _micro():
+            progress("moe")
+            try:
+                extra["moe"] = bench_moe(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["moe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
     else:
         # no chip: the pallas kernel still runs (interpret mode) so the
         # flash arm's correctness witness lands in the artifact
@@ -1230,6 +1263,13 @@ def main() -> int:
             extra["llama_decode"] = {"config": "tiny", "smoke": True, **row}
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["llama_decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        progress("moe_smoke")
+        try:
+            row = bench_moe(gen, cfg=llm.tiny(
+                tie_embeddings=True, n_experts=4, moe_every=1, moe_top_k=2))
+            extra["moe"] = {"config": "tiny", "smoke": True, **row}
+        except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+            extra["moe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     # both rows per operator bench: the in-memory store and the ClusterClient
     # + REST façade path (serialization, watch dispatch, conflict retries in

@@ -229,3 +229,30 @@ def test_bench_llama_decode_path_runs_on_tiny_config():
     assert r["decode_tokens_per_sec"] > 0
     assert r["new_tokens"] == 8
     assert r["gqa"] == "4q:2kv"
+
+
+def test_bench_moe_path_runs_on_tiny_config():
+    """The sparse arm's full path (top-2 dense dispatch + active-FLOPs
+    accounting) must execute end to end on a tiny config, and the MoE
+    branch of params_flops_per_token must count router + top-k experts
+    rather than every expert."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama
+
+    cfg = llama.tiny(dtype=jnp.float32, tie_embeddings=True,
+                     n_experts=4, moe_every=1, moe_top_k=2)
+    r = bench.bench_moe("cpu", cfg=cfg)
+    assert r["tokens_per_sec_per_chip"] > 0
+    assert r["experts"] == "4x top-2"
+    # active FLOPs: dense layers' mlp term replaced by top_k experts +
+    # router; top-1 must be strictly cheaper than top-2, and both lie
+    # between the dense formula's 1-expert and 4-expert extremes
+    f_top2 = llama.params_flops_per_token(cfg)
+    f_top1 = llama.params_flops_per_token(
+        llama.tiny(n_experts=4, moe_every=1, moe_top_k=1))
+    f_dense = llama.params_flops_per_token(llama.tiny())
+    assert f_top1 < f_top2
+    assert f_top2 < f_dense + 6.0 * cfg.n_layers * (
+        2 * 3 * cfg.d_model * cfg.d_ff)  # well under all-4-experts
+    assert f_top2 - f_top1 == 6.0 * cfg.n_layers * 3 * cfg.d_model * cfg.d_ff

@@ -705,10 +705,23 @@ def _select_token(logits, temperature: float, key, top_k: int = 0,
 
 
 def params_flops_per_token(cfg: LlamaConfig) -> float:
-    """~6 * matmul-params FLOPs/token for a train step (fwd+bwd)."""
+    """~6 * ACTIVE matmul-params FLOPs/token for a train step (fwd+bwd).
+    Sparse (MoE) layers count the router plus moe_top_k experts' FFNs —
+    the FLOPs a token actually executes, which is the quantity MFU is
+    defined over (total expert params only cost memory, not compute)."""
     attn = (cfg.n_heads + 2 * cfg.n_kv_heads + cfg.n_heads) * (
         cfg.d_model * cfg.head_dim
     )
-    mlp = 3 * cfg.d_model * cfg.d_ff
-    p = cfg.vocab_size * cfg.d_model + cfg.n_layers * (attn + mlp)
+    dense_mlp = 3 * cfg.d_model * cfg.d_ff
+    if cfg.n_experts:
+        n_moe = sum(
+            1 for i in range(cfg.n_layers)
+            if i % cfg.moe_every == cfg.moe_every - 1
+        )
+        moe_mlp = (cfg.moe_top_k * dense_mlp
+                   + cfg.d_model * cfg.n_experts)  # + router
+        mlp_total = (cfg.n_layers - n_moe) * dense_mlp + n_moe * moe_mlp
+    else:
+        mlp_total = cfg.n_layers * dense_mlp
+    p = cfg.vocab_size * cfg.d_model + cfg.n_layers * attn + mlp_total
     return 6.0 * p
