@@ -556,12 +556,16 @@ def bench_moe(gen: str, cfg=None):
     return r
 
 
-def bench_llama_decode(gen: str, cfg=None, max_new: int = 128):
+def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
+                       int8_weights: bool = False):
     """Autoregressive inference arm: prefill + greedy ring-cache decode on
     the 1B-class GQA llama (models/llama.generate). Reports prefill and
     per-token decode throughput — the compact GQA KV cache is the memory
     lever that sets decode batch headroom (default-on with a chip,
-    opt-out BENCH_DECODE=0). `cfg` override: tests run a tiny config."""
+    opt-out BENCH_DECODE=0). `cfg` override: tests run a tiny config.
+    int8_weights: weight-only quantized decode (models/quant.py) — each
+    scan step streams int8 weights from HBM, the bandwidth-bound
+    regime's ~2x lever."""
     import jax
     import jax.numpy as jnp
 
@@ -574,30 +578,46 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128):
     batch = 4
     if _micro():
         max_new = min(max_new, 16)
-    max_new = max(2, min(max_new, cfg.max_len // 2))
+    max_new = max(2, min(max_new, (cfg.max_len * 3) // 4))
     prompt_len = min(256, cfg.max_len - max_new)
     prompt = jax.random.randint(rng, (batch, prompt_len), 0, cfg.vocab_size)
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16),
         model.init(rng, prompt, train=False)["params"],
     )
+    gen_kw = {}
+    if int8_weights:
+        from tf_operator_tpu.models import quant
+
+        params = quant.quantize_params(params)
+        gen_kw["params_transform"] = quant.make_dequantizer(cfg.dtype)
+
+    def run(n):
+        return llm.generate(model, params, prompt, n, **gen_kw)
+
     # warmup compiles prefill + BOTH decode scan lengths (static shapes —
     # the timed calls must reuse these exact lengths)
-    jax.block_until_ready(llm.generate(model, params, prompt, 1))
-    jax.block_until_ready(llm.generate(model, params, prompt, max_new))
+    jax.block_until_ready(run(1))
+    jax.block_until_ready(run(max_new))
     t0 = time.perf_counter()
-    jax.block_until_ready(llm.generate(model, params, prompt, 1))
+    jax.block_until_ready(run(1))
     t_prefill = time.perf_counter() - t0  # prefill + ONE decode token
     t0 = time.perf_counter()
-    jax.block_until_ready(llm.generate(model, params, prompt, max_new))
+    jax.block_until_ready(run(max_new))
     t_total = time.perf_counter() - t0
     # subtracting isolates the extra max_new-1 scan steps: a pure decode
     # rate with no prefill share (t_prefill carries the prefill + first
     # token for both runs)
+    from tf_operator_tpu.models.quant import quantized_bytes
+
     decode_tps = batch * (max_new - 1) / max(1e-9, t_total - t_prefill)
-    return {
+    weight_gb = quantized_bytes(params) / 1e9  # generic nbytes sum
+    out = {
         "params_b": round(sum(
-            x.size for x in jax.tree.leaves(params)) / 1e9, 2),
+            x.size for x in jax.tree.leaves(params)
+            if x.dtype != jnp.float32 or not int8_weights) / 1e9, 2),
+        "weights": ("int8+scales" if int8_weights else "bf16"),
+        "weight_gb": round(weight_gb, 3),
         "gqa": f"{cfg.n_heads}q:{cfg.n_kv_heads}kv",
         "batch": batch,
         "prompt_len": prompt_len,
@@ -605,6 +625,20 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128):
         "prefill_tokens_per_sec": round(batch * prompt_len / t_prefill, 1),
         "decode_tokens_per_sec": round(decode_tps, 1),
     }
+    if cfg.sliding_window is not None:
+        # the Mistral ring-buffer cache: O(window) slots regardless of
+        # how long the generation runs — mirror of llama.generate's
+        # auto-sizing (min with the total-length bucket included, so
+        # short generations are not overstated)
+        def bucket(n):
+            return min(cfg.max_len, (n + 127) // 128 * 128)
+
+        out["window"] = cfg.sliding_window
+        out["cache_len"] = min(
+            bucket(prompt_len + max_new),
+            max(bucket(cfg.sliding_window), bucket(prompt_len)))
+        out["full_causal_cache_len"] = bucket(prompt_len + max_new)
+    return out
 
 
 def _parity(f_out, f_grads, r_out, r_grads):
@@ -1227,6 +1261,30 @@ def main() -> int:
                 extra["llama_decode"] = bench_llama_decode(gen)
             except Exception as e:  # noqa: BLE001 — surfaced, not fatal
                 extra["llama_decode"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
+        if os.environ.get("BENCH_DECODE", "1") == "1" and not _micro():
+            # windowed long generation: the ring-buffer cache stays at
+            # O(window) slots while the sequence runs past it — decode
+            # attention cost per step follows cache_len, not context
+            progress("llama_decode_swa")
+            try:
+                extra["llama_decode_swa"] = bench_llama_decode(
+                    gen, cfg=_llama_1b_cfg(sliding_window=512),
+                    max_new=1024)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["llama_decode_swa"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
+        if os.environ.get("BENCH_DECODE", "1") == "1" and not _micro():
+            # weight-only int8 decode: same model, half the weight bytes
+            # per scan step — the bandwidth-bound regime's ~2x lever
+            progress("llama_decode_int8")
+            try:
+                extra["llama_decode_int8"] = bench_llama_decode(
+                    gen, int8_weights=True)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["llama_decode_int8"] = {
                     "error": f"{type(e).__name__}: {e}"[:300]}
             checkpoint_cache(resnet)
         if os.environ.get("BENCH_MOE", "1") == "1" and not _micro():

@@ -1,0 +1,141 @@
+"""Weight-only int8 quantization (models/quant.py): per-channel error
+bounds, pytree transparency, and end-to-end quantized decode through
+llama.generate's params_transform seam."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import llama
+from tf_operator_tpu.models.quant import (
+    QTensor,
+    dequantize_params,
+    make_dequantizer,
+    quantize_params,
+    quantize_tensor,
+    quantized_bytes,
+)
+
+
+def _f32(**kw):
+    kw.setdefault("dtype", jnp.float32)
+    return llama.tiny(**kw)
+
+
+def _model_and_params(cfg, seed=0):
+    toks = jax.random.randint(
+        jax.random.PRNGKey(seed), (2, cfg.max_len), 0, cfg.vocab_size)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(seed), toks,
+                        train=False)["params"]
+    return model, params, toks
+
+
+def test_quantize_tensor_error_bound():
+    """Symmetric absmax int8: per-channel max error <= absmax/254 (half a
+    quantization step of that channel's own scale)."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * jnp.linspace(
+        0.1, 10.0, 32)[None, :]  # wildly different channel ranges
+    qt = quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == w.shape
+    err = np.abs(np.asarray(qt.dequantize(jnp.float32)) - np.asarray(w))
+    bound = np.abs(np.asarray(w)).max(axis=0) / 254.0 + 1e-7
+    assert (err.max(axis=0) <= bound * 2).all()  # round-to-nearest step
+    # per-channel scales: big channels don't inflate small channels' err
+    assert err[:, 0].max() < err[:, -1].max() / 10
+
+
+def test_quantized_tree_structure_and_bytes():
+    cfg = _f32()
+    _, params, _ = _model_and_params(cfg)
+    qparams = quantize_params(params)
+    # matmul weights became QTensors; norm scales stayed float
+    assert isinstance(qparams["block0"]["attn"]["wq"]["kernel"], QTensor)
+    assert isinstance(qparams["embed"]["embedding"], QTensor)
+    assert not isinstance(qparams["block0"]["ln1"]["scale"], QTensor)
+    # the tree is jit/device_put-transparent (registered pytree)
+    n_leaves = len(jax.tree_util.tree_leaves(qparams))
+    assert n_leaves > len(jax.tree_util.tree_leaves(params))  # q + scale
+    # ~4x fewer weight bytes than f32 (int8 payload + small f32 scales)
+    f32_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
+    assert quantized_bytes(qparams) < 0.3 * f32_bytes
+
+
+def test_dequantized_forward_close_to_full_precision():
+    cfg = _f32()
+    model, params, toks = _model_and_params(cfg)
+    want = model.apply({"params": params}, toks)
+    deq = dequantize_params(quantize_params(params), jnp.float32)
+    got = model.apply({"params": deq}, toks)
+    # int8 weight-only: logits track within a few percent relative
+    denom = np.abs(np.asarray(want)).max()
+    rel = np.abs(np.asarray(got) - np.asarray(want)).max() / denom
+    assert rel < 0.05, rel
+
+
+def test_quantized_generate_through_transform_seam():
+    """generate(qparams, params_transform=dequantizer): runs end to end,
+    and greedy tokens mostly agree with the full-precision decode (exact
+    agreement is not guaranteed at int8 — near-ties can flip)."""
+    cfg = _f32(tie_embeddings=True)
+    model, params, _ = _model_and_params(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (4, 8), 0,
+                                cfg.vocab_size)
+    want = llama.generate(model, params, prompt, max_new_tokens=12)
+    qparams = quantize_params(params)
+    got = llama.generate(model, qparams, prompt, max_new_tokens=12,
+                         params_transform=make_dequantizer(jnp.float32))
+    agree = float((np.asarray(got) == np.asarray(want)).mean())
+    assert agree > 0.5, (agree, got, want)
+
+
+def test_quantized_generate_moe_and_window():
+    """The seam composes with the rest of the family: a windowed
+    mixtral-style config decodes under quantized weights."""
+    cfg = _f32(tie_embeddings=True, n_experts=4, moe_every=1,
+               moe_top_k=2, sliding_window=16)
+    model, params, _ = _model_and_params(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 6), 0,
+                                cfg.vocab_size)
+    qparams = quantize_params(params)
+    got = llama.generate(model, qparams, prompt, max_new_tokens=8,
+                         params_transform=make_dequantizer(jnp.float32))
+    assert got.shape == (2, 8)
+    assert (np.asarray(got) >= 0).all()
+
+
+def test_dequantizer_identity_is_stable():
+    """One transform per dtype — a fresh closure per generate() call
+    would fragment the jitted-decode cache."""
+    assert make_dequantizer(jnp.float32) is make_dequantizer(jnp.float32)
+    assert make_dequantizer(jnp.bfloat16) is make_dequantizer(jnp.bfloat16)
+    assert make_dequantizer(jnp.float32) is not make_dequantizer(jnp.bfloat16)
+
+
+def test_scale_payloads_stay_small_and_router_unquantized():
+    """The contraction-axis table must hold for every leaf: scale
+    payloads a small fraction of the int8 payload (a scale spanning a
+    contraction axis would rival the weights themselves and erode the
+    bandwidth win), and the MoE router stays full precision."""
+    cfg = _f32(n_experts=4, moe_every=1, moe_top_k=2)
+    _, params, _ = _model_and_params(cfg)
+    qparams = quantize_params(params)
+    assert not isinstance(
+        qparams["block0"]["moe"]["router"]["kernel"], QTensor)
+
+    def check(tree, path=""):
+        if isinstance(tree, QTensor):
+            assert tree.scale.nbytes <= 0.26 * tree.q.nbytes + 64, (
+                path, tree.q.shape, tree.scale.shape)
+            return
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                check(v, f"{path}/{k}")
+
+    check(qparams)
+    # the attn out projection's scale is per-OUTPUT-channel [1, 1, E]
+    out_q = qparams["block0"]["attn"]["out"]["kernel"]
+    assert out_q.scale.shape == (1, 1, cfg.d_model), out_q.scale.shape
+    # per-expert scales on the moe mats: [X, 1, out]
+    wi_q = qparams["block0"]["moe"]["wi"]
+    assert wi_q.scale.shape == (cfg.n_experts, 1, 2 * cfg.d_ff)

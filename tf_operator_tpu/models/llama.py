@@ -550,20 +550,29 @@ def init_cache(cfg: LlamaConfig, batch: int, cache_len: Optional[int] = None,
 # model, any moe_dispatch_fn mesh) alive — per-request temperatures in a
 # serving loop must not grow it forever.
 def _decode_fns(model, temperature, top_k: int = 0, top_p: float = 0.0,
-                eos_id: int = -1):
+                eos_id: int = -1, params_transform=None):
     # coerce BEFORE the cache key: a jnp/np scalar temperature must not
     # crash on hashing or fragment the 8-slot cache vs the equal float
     return _decode_fns_cached(model, float(temperature), int(top_k),
-                              float(top_p), int(eos_id))
+                              float(top_p), int(eos_id), params_transform)
 
 
 @functools.lru_cache(maxsize=8)
 def _decode_fns_cached(model, temperature: float, top_k: int = 0,
-                       top_p: float = 0.0, eos_id: int = -1):
+                       top_p: float = 0.0, eos_id: int = -1,
+                       params_transform=None):
+    # params_transform maps the passed tree to apply()-ready params at
+    # TRACE time — the int8 weight-only seam (models/quant.py). It runs
+    # INSIDE the scan body below on purpose: hoisted before the scan,
+    # XLA would materialize the dequantized bf16 copy once in HBM and
+    # every decode step would stream THAT, forfeiting the int8
+    # bandwidth win that is the whole point.
+    xform = params_transform or (lambda p: p)
+
     @jax.jit
     def prefill(params, cache, prompt):
         logits, cache = model.apply(
-            {"params": params}, prompt, cache=cache, cache_pos=0)
+            {"params": xform(params)}, prompt, cache=cache, cache_pos=0)
         return logits[:, -1], cache
 
     @functools.partial(jax.jit, static_argnums=(5,))
@@ -571,7 +580,7 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
         def step(carry, _):
             cache, tok, pos, k, done = carry
             logits, cache = model.apply(
-                {"params": params}, tok[:, None], cache=cache,
+                {"params": xform(params)}, tok[:, None], cache=cache,
                 cache_pos=pos)
             k, sub = jax.random.split(k)
             nxt = _select_token(logits[:, 0], temperature, sub,
@@ -596,7 +605,8 @@ def generate(model, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 0.0,
              eos_id: Optional[int] = None,
-             cache_len: Optional[int] = None):
+             cache_len: Optional[int] = None,
+             params_transform=None):
     """Autoregressive decoding: one prefill pass over the prompt (all
     positions in one MXU-friendly call), then `max_new_tokens` single-
     token steps through a `lax.scan` — static shapes; prefill and the
@@ -607,6 +617,14 @@ def generate(model, params, prompt, max_new_tokens: int,
     eos_id set, a sequence that emits it keeps emitting it for the rest
     of the scan (static shapes — masking, not early exit, stops it).
     Returns [B, max_new_tokens].
+
+    params_transform (optional): maps `params` to apply()-ready params
+    inside the jitted prefill/decode — the weight-only int8 seam
+    (models/quant.quantize_params + make_dequantizer): pass the
+    quantized tree as `params` and the dequantizer here, and every
+    decode step streams int8 weights from HBM.  Use a STABLE function
+    (make_dequantizer caches one per dtype) — a fresh closure per call
+    would defeat the jitted-decode cache.
 
     The KV cache is allocated once at full length and positions beyond
     the current step are masked — the standard TPU decode layout (no
@@ -667,7 +685,8 @@ def generate(model, params, prompt, max_new_tokens: int,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     k_first, k_rest = jax.random.split(rng)  # single-use key discipline
 
-    prefill, decode = _decode_fns(model, temperature, top_k, top_p, eos)
+    prefill, decode = _decode_fns(model, temperature, top_k, top_p, eos,
+                                  params_transform)
     last_logits, cache = prefill(params, cache, prompt)
     first = _select_token(last_logits, temperature, k_first, top_k, top_p)
     if max_new_tokens == 1:

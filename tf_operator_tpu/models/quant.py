@@ -1,0 +1,148 @@
+"""Weight-only int8 quantization for inference — a TPU-first serving lever.
+
+Single-token decode is HBM-bandwidth-bound: every step streams the full
+weight set through the chip while the MXU sits mostly idle, so halving
+the weight bytes (bf16 -> int8) is worth up to ~2x decode throughput at
+small batch.  The recipe here is the standard weight-only scheme:
+
+  - per-OUTPUT-CHANNEL symmetric absmax scales (one f32 scale per output
+    column): `w ≈ q * scale`, q int8 in [-127, 127].  Output-channel
+    granularity keeps the quantization error per matmul column bounded by
+    that column's own dynamic range — the same choice llama.cpp Q8 /
+    AWQ-style weight-only kernels make.
+  - dequantization happens INSIDE the jitted step, fused by XLA into the
+    consumer matmul: the int8 tensor is what lives in (and streams from)
+    HBM; the bf16 view exists only tile-by-tile in registers/VMEM.  No
+    pallas needed — `convert_element_type` + multiply fuse with the dot.
+  - params stay a plain pytree: `QTensor(q, scale)` is a registered
+    pytree node, so the quantized tree flows through jit/device_put
+    unchanged, and `dequantize` maps it back to the model's dtype at
+    trace time.  Norm scales and other 1-D leaves stay unquantized
+    (they are tiny and precision-critical).
+
+No reference counterpart (the reference has no model/serving code at
+all, SURVEY.md §5.7); this pairs with models/llama.generate via its
+`params_transform` seam.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """int8 weights + per-output-channel f32 scales: w ≈ q * scale."""
+
+    q: Any      # int8, original shape
+    scale: Any  # f32, shape = (1, ..., out_dims...) broadcastable to q
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self, dtype=jnp.bfloat16):
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def quantize_tensor(w, axes=(0,)) -> QTensor:
+    """Symmetric absmax int8, reducing over `axes` (the contraction axes
+    of the consuming matmul); every remaining (output) channel gets its
+    own scale."""
+    wf = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=tuple(axes), keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale.astype(jnp.float32))
+
+
+# contraction axes by leaf tag: kernels are tagged '<module>.kernel'
+# (their parent module name is where the contraction layout lives; the
+# leaf key 'kernel' says nothing), raw params by their own key. The
+# llama family:
+#   wq [E, H, D] / wkv [E, 2, KV, D] / mlp wi [E, 2, F] / mlp wo [F, E]
+#     / lm_head [E, V]: flax DenseGeneral [in..., out...] with ONE input
+#     axis — contraction over axis 0, the default.
+#   attn `out` kernel [H, D, E]: contraction over (H, D).
+#   moe raw wi [X, D, 2F] / wo [X, F, D]: per-expert matrices,
+#     contraction over the middle axis -> per-expert per-output scales.
+#   embedding [V, E]: per-ROW (per-token) scales — the lookup reads one
+#     row at a time and each token keeps its own dynamic range; the tied
+#     attend() logits matmul shares them (measured fine at int8).
+_CONTRACT_AXES = {
+    "out.kernel": (0, 1),
+    "wi": (1,),
+    "wo": (1,),
+    "embedding": (1,),
+}
+# precision-critical, deliberately NOT quantized: the MoE router runs
+# its logits in f32 because near-tied experts flip under tiny error —
+# int8 would change routing for ~16KB of savings
+_SKIP = {"router.kernel"}
+
+
+def _quantize_leaf(tag: str, leaf) -> Any:
+    if tag in _SKIP or not (hasattr(leaf, "ndim") and leaf.ndim >= 2):
+        return leaf  # 1-D norm scales / biases stay full precision too
+    return quantize_tensor(leaf, axes=_CONTRACT_AXES.get(tag, (0,)))
+
+
+def quantize_params(params) -> Any:
+    """Walk a llama/transformer param tree and replace every matmul
+    weight with a QTensor (int8 + per-output-channel scales).  1-D
+    leaves (RMSNorm scales) and the MoE router stay as they are."""
+    def walk(tree, name=""):
+        if isinstance(tree, dict):
+            return {
+                k: walk(v, f"{name}.kernel" if k == "kernel" else k)
+                for k, v in tree.items()
+            }
+        return _quantize_leaf(name, tree)
+
+    return walk(params)
+
+
+def dequantize_params(qparams, dtype=jnp.bfloat16):
+    """The inverse map, usable INSIDE jit: QTensor leaves become dtype
+    arrays (XLA fuses the dequant into each consumer matmul); everything
+    else passes through."""
+    return jax.tree_util.tree_map(
+        lambda x: x.dequantize(dtype) if isinstance(x, QTensor) else x,
+        qparams,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
+
+
+# one transform per dtype: generate()'s jitted-decode cache keys on the
+# transform's identity, and a fresh closure per call would defeat it
+_DEQUANTIZERS = {}
+
+
+def make_dequantizer(dtype=jnp.bfloat16):
+    key = jnp.dtype(dtype).name
+    if key not in _DEQUANTIZERS:
+        def transform(qparams, _dtype=dtype):
+            return dequantize_params(qparams, _dtype)
+
+        _DEQUANTIZERS[key] = transform
+    return _DEQUANTIZERS[key]
+
+
+def quantized_bytes(qparams) -> int:
+    """Total HBM bytes of the quantized tree (int8 + scales) — the
+    number the decode-bandwidth win is proportional to."""
+    return sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(qparams)
+        if hasattr(x, "nbytes")
+    )
