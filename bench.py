@@ -627,17 +627,14 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
     }
     if cfg.sliding_window is not None:
         # the Mistral ring-buffer cache: O(window) slots regardless of
-        # how long the generation runs — mirror of llama.generate's
-        # auto-sizing (min with the total-length bucket included, so
-        # short generations are not overstated)
-        def bucket(n):
-            return min(cfg.max_len, (n + 127) // 128 * 128)
-
+        # how long the generation runs — the SAME sizing policy the
+        # timed generate() calls used (llama.auto_cache_len)
         out["window"] = cfg.sliding_window
-        out["cache_len"] = min(
-            bucket(prompt_len + max_new),
-            max(bucket(cfg.sliding_window), bucket(prompt_len)))
-        out["full_causal_cache_len"] = bucket(prompt_len + max_new)
+        out["cache_len"] = llm.auto_cache_len(
+            cfg, prompt_len, prompt_len + max_new)
+        out["full_causal_cache_len"] = llm.auto_cache_len(
+            dataclasses.replace(cfg, sliding_window=None),
+            prompt_len, prompt_len + max_new)
     return out
 
 

@@ -380,3 +380,28 @@ def test_llama_smoke_mixtral_expert_parallel():
     assert rc.returncode == 0, rc.stderr[-2000:]
     assert "'ep': 2" in rc.stdout
     assert "complete: steps=2" in rc.stdout
+
+
+def test_llama_text_to_training_via_tokenize_cli(tmp_path):
+    """The whole data front half: raw text -> tokenize CLI -> packed
+    .rec shards -> llama training loop.  The byte tokenizer's vocab is
+    exactly 256 (NUL doubles as EOS), so its ids fit the tiny model's
+    256-token embedding with no clamping."""
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(("the quick brown fox jumps over the lazy dog " * 60
+                       + "\n\n") * 4)
+    shards = tmp_path / "shards"
+    rc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.data.tokenize",
+         "--input", str(corpus), "--seq-len", "64",
+         "--out", str(shards), "--num-shards", "1"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO),
+        cwd=REPO,
+    )
+    assert rc.returncode == 0, rc.stderr
+    rc2 = _run("llama/train_llama.py", "--smoke", "--steps=2",
+               "--per-host-batch=2", f"--data-dir={shards}")
+    assert rc2.returncode == 0, rc2.stderr[-2000:]
+    assert "data: records" in rc2.stdout
+    assert "complete: steps=2" in rc2.stdout

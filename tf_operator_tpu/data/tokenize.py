@@ -1,0 +1,189 @@
+"""Text -> pre-tokenized .rec shards: the missing front half of the data
+pipeline (data/loader.py consumes fixed-length token rows; this produces
+them).
+
+`python -m tf_operator_tpu.data.tokenize --input corpus/*.txt \
+    --tokenizer byte --seq-len 2048 --out shards/ --num-shards 8`
+
+Documents are tokenized, joined by EOS, and PACKED into dense [seq_len]
+rows (no padding waste — the standard pretraining layout; an LM trained
+on packed rows sees document boundaries through the EOS tokens).  Rows
+round-robin across shards so every shard is statistically similar and
+`host_record_batches`' disjoint per-host assignment stays balanced.
+
+Tokenizers:
+  - `byte`: built-in byte-level fallback (vocab exactly 256; NUL doubles
+    as the EOS separator — it never occurs in text) — zero dependencies,
+    reversible, fits any model vocab >= 256, useful for smokes and
+    ablations (this environment has no network egress, so the default
+    must not need a download).
+  - a PATH to a local Hugging Face tokenizer directory — loaded with
+    `transformers.AutoTokenizer.from_pretrained(path,
+    local_files_only=True)`, so llama/mistral checkpoints imported with
+    models/convert.py train on text tokenized exactly as upstream.
+
+Reference parity: the reference ships no input tooling at all (its
+examples generate synthetic data inline, e.g. its dist-mnist estimator
+feeds); this is beyond-reference [+] like the rest of the data layer.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Iterable, Iterator, List
+
+import numpy as np
+
+from tf_operator_tpu.data.loader import FieldSpec, write_records
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: token i is byte i.  NUL (0)
+    doubles as EOS — it never occurs in text, so the vocab stays exactly
+    256 and fits every model vocab without clamping."""
+
+    vocab_size = 256
+    eos_id = 0
+
+    def encode(self, text: str) -> List[int]:
+        return [b or 32 for b in text.encode("utf-8")]  # NUL -> space
+
+    def decode(self, ids: Iterable[int]) -> str:
+        return bytes(i for i in ids if i > 0).decode("utf-8", "replace")
+
+
+class HFTokenizer:
+    """A local (no-download) Hugging Face tokenizer directory."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self.tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self.tok)
+        self.eos_id = self.tok.eos_token_id
+        if self.eos_id is None:
+            raise SystemExit(
+                f"tokenizer at {path} has no eos token — packing needs a "
+                f"document separator")
+
+    def encode(self, text: str) -> List[int]:
+        return self.tok.encode(text, add_special_tokens=False)
+
+
+def load_tokenizer(spec: str):
+    if spec == "byte":
+        return ByteTokenizer()
+    if os.path.isdir(spec):
+        return HFTokenizer(spec)
+    raise SystemExit(
+        f"--tokenizer must be 'byte' or a local tokenizer directory, "
+        f"got {spec!r} (no-egress environment: remote hub names cannot "
+        f"be downloaded)")
+
+
+def iter_documents(paths: List[str]) -> Iterator[str]:
+    """Yield one document per .jsonl line ('text' field) or per
+    blank-line-separated block of a .txt file."""
+    for p in paths:
+        if p.endswith(".jsonl"):
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)["text"]
+        else:
+            with open(p) as f:
+                block: List[str] = []
+                for line in f:
+                    if line.strip():
+                        block.append(line)
+                    elif block:
+                        yield "".join(block)
+                        block = []
+                if block:
+                    yield "".join(block)
+
+
+def pack_rows(docs: Iterator[str], tok, seq_len: int) -> Iterator[np.ndarray]:
+    """Greedy-pack `tokenized doc + EOS` streams into dense [seq_len]
+    rows; the trailing partial row is dropped (standard pretraining
+    packing — a padded tail would teach the model padding)."""
+    buf: List[int] = []
+    for doc in docs:
+        buf.extend(tok.encode(doc))
+        buf.append(tok.eos_id)
+        while len(buf) >= seq_len:
+            yield np.asarray(buf[:seq_len], np.int32)
+            del buf[:seq_len]
+
+
+def write_shards(rows: Iterator[np.ndarray], seq_len: int, out_dir: str,
+                 num_shards: int, chunk_rows: int = 4096) -> List[int]:
+    """Round-robin rows across `num_shards` logical shards, STREAMING:
+    each shard flushes a `tokens-{shard}-{part}.rec` file every
+    `chunk_rows` rows, so memory stays O(num_shards x chunk) no matter
+    how large the corpus is (a 50GB corpus must not need 200GB of
+    resident int32 rows).  Returns per-shard row counts."""
+    os.makedirs(out_dir, exist_ok=True)
+    fields = [FieldSpec("tokens", (seq_len,), np.int32)]
+    buckets: List[List[np.ndarray]] = [[] for _ in range(num_shards)]
+    counts = [0] * num_shards
+    parts = [0] * num_shards
+
+    def flush(s: int) -> None:
+        if not buckets[s]:
+            return
+        path = os.path.join(out_dir, f"tokens-{s:05d}-{parts[s]:04d}.rec")
+        write_records(path, fields, {"tokens": np.stack(buckets[s])})
+        counts[s] += len(buckets[s])
+        parts[s] += 1
+        buckets[s] = []
+
+    for i, row in enumerate(rows):
+        s = i % num_shards
+        buckets[s].append(row)
+        if len(buckets[s]) >= chunk_rows:
+            flush(s)
+    for s in range(num_shards):
+        flush(s)
+    return counts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--input", nargs="+", required=True,
+                    help=".txt (blank-line-separated docs) or .jsonl "
+                         "('text' field) files/globs")
+    ap.add_argument("--tokenizer", default="byte",
+                    help="'byte' or a local HF tokenizer directory")
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--out", required=True, help="output shard directory")
+    ap.add_argument("--num-shards", type=int, default=8,
+                    help="shard count (>= the host count that will read)")
+    args = ap.parse_args(argv)
+
+    paths: List[str] = []
+    for pattern in args.input:
+        hits = sorted(glob.glob(pattern))
+        if not hits:
+            raise SystemExit(f"--input pattern matched nothing: {pattern}")
+        paths.extend(hits)
+    tok = load_tokenizer(args.tokenizer)
+    rows = pack_rows(iter_documents(paths), tok, args.seq_len)
+    counts = write_shards(rows, args.seq_len, args.out, args.num_shards)
+    total = sum(counts)
+    if total == 0:
+        raise SystemExit(
+            f"no full [{args.seq_len}] rows produced — corpus smaller "
+            f"than one sequence?")
+    print(f"wrote {total} rows x {args.seq_len} tokens "
+          f"(vocab {tok.vocab_size}) across "
+          f"{sum(1 for c in counts if c)} shards in {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

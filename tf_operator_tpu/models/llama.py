@@ -601,6 +601,24 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
     return prefill, decode
 
 
+def auto_cache_len(cfg: LlamaConfig, prompt_len: int, total: int) -> int:
+    """generate()'s default KV-cache sizing, exposed so tools reporting
+    on the cache (bench.py) read the same policy the timed run
+    allocates.  128-multiples so nearby request sizes share a compile;
+    sliding-window models get a ring of O(window) slots (plus room for
+    the whole prompt, whose prefill write must not wrap) instead of
+    O(context)."""
+    def bucket(n):
+        return min(cfg.max_len, (n + 127) // 128 * 128)
+
+    cache_len = bucket(total)
+    if cfg.sliding_window is not None:
+        cache_len = min(cache_len,
+                        max(bucket(cfg.sliding_window),
+                            bucket(prompt_len)))
+    return cache_len
+
+
 def generate(model, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 0.0,
@@ -650,19 +668,8 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"prompt {prompt_len} + new {max_new_tokens} exceeds RoPE "
             f"table length max_len={cfg.max_len}")
 
-    def bucket(n):  # 128-multiples so nearby request sizes share a compile
-        return min(cfg.max_len, (n + 127) // 128 * 128)
-
     if cache_len is None:
-        cache_len = bucket(total)
-        if cfg.sliding_window is not None:
-            # ring buffer: positions beyond the window are invisible, so
-            # the cache only needs window slots (plus room for the whole
-            # prompt, whose prefill write must not wrap) — O(window)
-            # decode memory instead of O(context)
-            cache_len = min(cache_len,
-                            max(bucket(cfg.sliding_window),
-                                bucket(prompt_len)))
+        cache_len = auto_cache_len(cfg, prompt_len, total)
     if cfg.sliding_window is None and total > cache_len:
         raise ValueError(
             f"prompt {prompt_len} + new {max_new_tokens} exceeds cache "
