@@ -1,0 +1,189 @@
+"""Speculative decoding — draft-model speculation, target verification.
+
+Single-token decode leaves the MXU idle (one token's worth of FLOPs per
+full weight read); speculative decoding converts idle MXU into accepted
+tokens: a cheap DRAFT model proposes `k` tokens autoregressively, the
+TARGET model scores all k+1 positions in ONE forward (an MXU-friendly
+[B, k+1] matmul instead of k+1 weight-streaming steps), and the longest
+draft prefix that agrees with the target's own argmax is accepted plus
+one bonus token from the target.  Greedy output is EXACT: every emitted
+token equals what target-only greedy decoding would emit, regardless of
+draft quality — the draft only changes the speed.
+
+TPU-first mechanics (all static shapes under one jitted
+`lax.while_loop`):
+
+  - the position-masked ring cache (models/llama._cached_attention) gives
+    REJECTION ROLLBACK FOR FREE: verification writes all k+1 speculated
+    positions into the cache, and when only n < k are accepted the next
+    iteration simply resumes at pos + n + 1 — the stale future slots are
+    invisible to the visibility mask (their `k_global` resolves ahead of
+    every query) and are overwritten as decoding proceeds.  No gather,
+    no copy, no dynamic shapes.
+  - batches advance in LOCKSTEP at the minimum per-row acceptance: rows
+    that agreed further simply re-verify those tokens next round.  Greedy
+    exactness is preserved (each accepted token agrees with the target's
+    argmax under the identical prefix); only the speedup is diluted by
+    the slowest row — the standard batch-speculation tradeoff.
+  - per-iteration work: k single-token draft steps (`lax.scan`) + one
+    (k+1)-token target forward.  With acceptance rate a, expected tokens
+    per target forward is ~(1 - a^(k+1)) / (1 - a) + ... >= 1, vs exactly
+    1 for plain decode.
+
+Scope: greedy (temperature 0) only — sampling needs the stochastic
+acceptance rule; sliding-window targets must still allocate
+cache >= total (the multi-position verify write must not wrap the ring).
+No reference counterpart (the reference has no model/serving code,
+SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=8)
+def _spec_fns(target, draft, k: int,
+              target_transform=None, draft_transform=None):
+    """Jitted (prefill, spec_loop) for a (target, draft, k) pair.
+    Transforms are the weight-only-quantization seam
+    (models/quant.make_dequantizer), identical to llama.generate's."""
+    t_xform = target_transform or (lambda p: p)
+    d_xform = draft_transform or (lambda p: p)
+
+    @jax.jit
+    def prefill(t_params, d_params, t_cache, d_cache, prompt):
+        t_logits, t_cache = target.apply(
+            {"params": t_xform(t_params)}, prompt, cache=t_cache,
+            cache_pos=0)
+        _, d_cache = draft.apply(
+            {"params": d_xform(d_params)}, prompt, cache=d_cache,
+            cache_pos=0)
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        return first, t_cache, d_cache
+
+    @functools.partial(jax.jit, static_argnums=(6,))
+    def spec_loop(t_params, d_params, t_cache, d_cache, first, pos0,
+                  max_new: int):
+        b = first.shape[0]
+        # k+1 headroom: one verify round may write past max_new; the
+        # buffer is cropped on return
+        out = jnp.zeros((b, max_new + k + 1), jnp.int32)
+        out = out.at[:, 0].set(first)
+
+        def cond(state):
+            _, _, _, n_out, _, _, _ = state
+            return n_out < max_new
+
+        def body(state):
+            t_cache, d_cache, out, n_out, pos, last, n_fwd = state
+
+            # ---- draft k tokens, single-token steps.  The scan runs
+            # k+1 steps: the extra step's OUTPUT is discarded, but its
+            # cache write records d_k's K/V at pos+k — without it, a
+            # fully-accepted round leaves a zero hole at that slot that
+            # every later draft query silently attends (the position
+            # mask treats any slot <= q_pos as written), eroding
+            # acceptance on exactly the high-agreement path.  When the
+            # round is rejected early the extra write is stale and
+            # invisible like every other rolled-back slot.
+            def dstep(carry, _):
+                d_cache, tok, dpos = carry
+                logits, d_cache = draft.apply(
+                    {"params": d_xform(d_params)}, tok[:, None],
+                    cache=d_cache, cache_pos=dpos)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                return (d_cache, nxt, dpos + 1), nxt
+
+            (d_cache, _, _), drafts = jax.lax.scan(
+                dstep, (d_cache, last, pos), None, length=k + 1)
+            drafts = drafts.T[:, :k]  # [B, k]; step k+1 only wrote cache
+
+            # ---- one target forward over [last, d_1..d_k]
+            seq = jnp.concatenate([last[:, None], drafts], axis=1)
+            t_logits, t_cache = target.apply(
+                {"params": t_xform(t_params)}, seq, cache=t_cache,
+                cache_pos=pos)
+            tpred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+            # ---- longest agreeing prefix (per row), lockstep minimum
+            match = (drafts == tpred[:, :k]).astype(jnp.int32)
+            acc_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+            n_acc = jnp.min(acc_row)
+            # emitted tokens this round: drafts[:, :n_acc] then the
+            # target's own token at the first disagreement (the bonus)
+            bonus = jnp.take(tpred, n_acc, axis=1)  # [B]
+            idx = jnp.arange(k + 1)
+            cand = jnp.where(idx[None, :] < n_acc,
+                             jnp.pad(drafts, ((0, 0), (0, 1))),
+                             bonus[:, None])
+            out = jax.lax.dynamic_update_slice(out, cand, (0, n_out))
+            n_emit = n_acc + 1
+            return (t_cache, d_cache, out, n_out + n_emit,
+                    pos + n_emit, bonus, n_fwd + 1)
+
+        state = (t_cache, d_cache, out, jnp.int32(1), pos0, first,
+                 jnp.int32(0))
+        _, _, out, n_out, _, _, n_fwd = jax.lax.while_loop(
+            cond, body, state)
+        return out[:, :max_new], n_fwd
+
+    return prefill, spec_loop
+
+
+def speculative_generate(target, t_params, draft, d_params, prompt,
+                         max_new_tokens: int, k: int = 4,
+                         cache_len: Optional[int] = None,
+                         target_transform=None, draft_transform=None,
+                         return_stats: bool = False):
+    """Greedy speculative decoding: returns [B, max_new_tokens] tokens
+    IDENTICAL to `llama.generate(target, ...)`'s greedy output, produced
+    in ~(accepted+1)-token chunks per target forward.
+
+    target/draft: llama.Llama modules sharing a tokenizer (vocab ids
+    must mean the same thing); k: draft tokens per round.
+    return_stats: also return {"target_forwards": int} — the speedup
+    witness (plain decode needs max_new_tokens forwards)."""
+    from tf_operator_tpu.models.llama import init_cache
+
+    if target.cfg.vocab_size != draft.cfg.vocab_size:
+        raise ValueError(
+            f"target vocab {target.cfg.vocab_size} != draft vocab "
+            f"{draft.cfg.vocab_size} — speculation compares token ids")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    b, prompt_len = prompt.shape
+    # edge contract mirrors llama.generate: negative raises, zero
+    # returns empty BEFORE the length limits apply
+    if max_new_tokens < 0:
+        raise ValueError(
+            f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    total = prompt_len + max_new_tokens + k + 1  # verify-round headroom
+    for name, cfg in (("target", target.cfg), ("draft", draft.cfg)):
+        if total > cfg.max_len:
+            raise ValueError(
+                f"prompt {prompt_len} + new {max_new_tokens} (+{k + 1} "
+                f"speculation headroom) exceeds {name} max_len "
+                f"{cfg.max_len}")
+    c = cache_len or total
+    if c < total:
+        raise ValueError(
+            f"cache_len {c} < {total} — the multi-position verify write "
+            f"must not wrap the ring")
+    t_cache = init_cache(target.cfg, b, min(c, target.cfg.max_len))
+    d_cache = init_cache(draft.cfg, b, min(c, draft.cfg.max_len))
+
+    prefill, spec_loop = _spec_fns(target, draft, int(k),
+                                   target_transform, draft_transform)
+    first, t_cache, d_cache = prefill(t_params, d_params, t_cache,
+                                      d_cache, prompt)
+    out, n_fwd = spec_loop(t_params, d_params, t_cache, d_cache, first,
+                           jnp.int32(prompt_len), int(max_new_tokens))
+    if return_stats:
+        return out, {"target_forwards": int(n_fwd)}
+    return out
