@@ -113,3 +113,88 @@ def test_validation():
     with pytest.raises(ValueError, match="exceeds"):
         speculative_generate(target, t_params, draft2, d2, prompt,
                              max_new_tokens=64, k=4)
+
+
+# ---------------------------------------------------------------- sampling
+def test_residual_sample_recovers_target_distribution():
+    """The acceptance + residual rule is distribution-exact: simulate
+    the per-position procedure with synthetic p_draft/p_target over a
+    tiny vocab and check the empirical output distribution equals
+    p_target (Monte Carlo, 60k trials)."""
+    from tf_operator_tpu.models.speculative import residual_sample
+
+    key = jax.random.PRNGKey(0)
+    v = 8
+    kd, kt = jax.random.split(key)
+    p_d = jax.nn.softmax(jax.random.normal(kd, (v,)) * 1.5)
+    p_t = jax.nn.softmax(jax.random.normal(kt, (v,)) * 1.5)
+    n = 60_000
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    x = jax.random.categorical(ks[0], jnp.log(p_d), shape=(n,))
+    u = jax.random.uniform(ks[1], (n,))
+    accept = u * p_d[x] < p_t[x]
+    fixes = residual_sample(
+        ks[2], jnp.tile(p_t, (n, 1)), jnp.tile(p_d, (n, 1)))
+    emitted = jnp.where(accept, x, fixes)
+    emp = jnp.bincount(emitted, length=v) / n
+    np.testing.assert_allclose(np.asarray(emp), np.asarray(p_t),
+                               atol=0.01)
+
+
+def test_sampling_speculative_runs_and_is_seed_deterministic():
+    target, t_params = _init(_f32(n_layers=2, max_len=128), seed=0)
+    draft, d_params = _init(_f32(n_layers=1, max_len=128), seed=3)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0, 256)
+    a = speculative_generate(target, t_params, draft, d_params, prompt,
+                             max_new_tokens=12, k=3, temperature=0.8,
+                             rng=jax.random.PRNGKey(42))
+    b = speculative_generate(target, t_params, draft, d_params, prompt,
+                             max_new_tokens=12, k=3, temperature=0.8,
+                             rng=jax.random.PRNGKey(42))
+    c = speculative_generate(target, t_params, draft, d_params, prompt,
+                             max_new_tokens=12, k=3, temperature=0.8,
+                             rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert ((np.asarray(a) >= 0) & (np.asarray(a) < 256)).all()
+
+
+def test_sampling_needs_rng():
+    target, t_params = _init(_f32(max_len=64), seed=0)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="rng"):
+        speculative_generate(target, t_params, target, t_params, prompt,
+                             4, temperature=0.7)
+
+
+def test_sampling_first_token_marginal_matches_plain_generate():
+    """End-to-end distribution witness: over many seeds, the FIRST
+    sampled token's marginal from speculative sampling matches plain
+    generate's (both are draws from the target's temperature-T
+    prefill distribution)."""
+    target, t_params = _init(_f32(n_layers=1, max_len=64), seed=0)
+    draft, d_params = _init(_f32(n_layers=1, max_len=64), seed=8)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (1, 6), 0, 256)
+    n = 300
+    spec_first, plain_first = [], []
+    for s in range(n):
+        # INDEPENDENT keys per path: with a shared key both paths make
+        # the identical categorical draw and the test compares a
+        # sequence with itself (vacuous) — fold_in separates them
+        base = jax.random.PRNGKey(1000 + s)
+        got = speculative_generate(target, t_params, draft, d_params,
+                                   prompt, max_new_tokens=2, k=2,
+                                   temperature=1.0,
+                                   rng=jax.random.fold_in(base, 0))
+        spec_first.append(int(got[0, 0]))
+        want = llama.generate(target, t_params, prompt, max_new_tokens=2,
+                              temperature=1.0,
+                              rng=jax.random.fold_in(base, 1))
+        plain_first.append(int(want[0, 0]))
+    # same prefill distribution — compare the top-token frequency coarse
+    # statistic (full-vocab TV needs far more samples); independent
+    # 300-draw frequencies differ by ~0.04 sd, 0.15 is ~3.7 sd
+    top = max(set(plain_first), key=plain_first.count)
+    f_spec = spec_first.count(top) / n
+    f_plain = plain_first.count(top) / n
+    assert abs(f_spec - f_plain) < 0.15, (f_spec, f_plain)

@@ -30,11 +30,22 @@ TPU-first mechanics (all static shapes under one jitted
     per target forward is ~(1 - a^(k+1)) / (1 - a) + ... >= 1, vs exactly
     1 for plain decode.
 
-Scope: greedy (temperature 0) only — sampling needs the stochastic
-acceptance rule; sliding-window targets must still allocate
-cache >= total (the multi-position verify write must not wrap the ring).
-No reference counterpart (the reference has no model/serving code,
-SURVEY.md §5.7).
+Sampling (temperature > 0) uses the stochastic acceptance rule
+(speculative sampling): draft token x is accepted with probability
+min(1, p_target(x) / p_draft(x)); on rejection the emitted token is
+drawn from the RESIDUAL distribution norm(max(0, p_target - p_draft)).
+Each emitted token is an exact draw from the target's temperature-T
+distribution — provably, regardless of draft quality (the Monte-Carlo
+witness lives in tests/test_speculative.py).  Lockstep rollback keeps
+exactness: a row whose accepted tokens are discarded because another
+row rejected earlier simply re-runs the (exact) procedure with fresh
+randomness.  top_k/top_p truncation is not supported under speculation
+(the acceptance ratio must be computed over the same distributions the
+tokens were drawn from).
+
+Sliding-window targets must still allocate cache >= total (the
+multi-position verify write must not wrap the ring).  No reference
+counterpart (the reference has no model/serving code, SURVEY.md §5.7).
 """
 from __future__ import annotations
 
@@ -45,29 +56,49 @@ import jax
 import jax.numpy as jnp
 
 
+def residual_sample(key, t_probs, d_probs):
+    """One draw from norm(max(0, p_target - p_draft)) — the rejected-
+    position correction of speculative sampling.  Degenerate case
+    (distributions identical so the residual is empty — unreachable in
+    exact arithmetic since rejection then has probability 0, but float
+    round-off can produce it): fall back to the target distribution."""
+    res = jnp.maximum(t_probs - d_probs, 0.0)
+    mass = res.sum(axis=-1, keepdims=True)
+    res = jnp.where(mass > 0.0, res / jnp.maximum(mass, 1e-30), t_probs)
+    return jax.random.categorical(key, jnp.log(jnp.maximum(res, 1e-30)))
+
+
 @functools.lru_cache(maxsize=8)
-def _spec_fns(target, draft, k: int,
+def _spec_fns(target, draft, k: int, temperature: float,
               target_transform=None, draft_transform=None):
-    """Jitted (prefill, spec_loop) for a (target, draft, k) pair.
+    """Jitted (prefill, spec_loop) for a (target, draft, k, T) tuple.
     Transforms are the weight-only-quantization seam
     (models/quant.make_dequantizer), identical to llama.generate's."""
+    from tf_operator_tpu.models.llama import _select_token
+
     t_xform = target_transform or (lambda p: p)
     d_xform = draft_transform or (lambda p: p)
+    sampling = temperature > 0.0
+
+    def _first_token(logits, key):
+        # llama's own selection dispatch: keeps the greedy contract
+        # ("IDENTICAL to generate()") in lockstep by construction
+        return _select_token(logits, temperature, key).astype(jnp.int32)
 
     @jax.jit
-    def prefill(t_params, d_params, t_cache, d_cache, prompt):
+    def prefill(t_params, d_params, t_cache, d_cache, prompt, key):
         t_logits, t_cache = target.apply(
             {"params": t_xform(t_params)}, prompt, cache=t_cache,
             cache_pos=0)
         _, d_cache = draft.apply(
             {"params": d_xform(d_params)}, prompt, cache=d_cache,
             cache_pos=0)
-        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        first = _first_token(t_logits[:, -1], key)
         return first, t_cache, d_cache
 
-    @functools.partial(jax.jit, static_argnums=(6,))
+    @functools.partial(jax.jit, static_argnums=(7,))
     def spec_loop(t_params, d_params, t_cache, d_cache, first, pos0,
-                  max_new: int):
+                  rng, max_new: int):
         b = first.shape[0]
         # k+1 headroom: one verify round may write past max_new; the
         # buffer is cropped on return
@@ -75,11 +106,11 @@ def _spec_fns(target, draft, k: int,
         out = out.at[:, 0].set(first)
 
         def cond(state):
-            _, _, _, n_out, _, _, _ = state
-            return n_out < max_new
+            return state[3] < max_new
 
         def body(state):
-            t_cache, d_cache, out, n_out, pos, last, n_fwd = state
+            t_cache, d_cache, out, n_out, pos, last, key, n_fwd = state
+            key, k_draft, k_accept, k_fix = jax.random.split(key, 4)
 
             # ---- draft k tokens, single-token steps.  The scan runs
             # k+1 steps: the extra step's OUTPUT is discarded, but its
@@ -90,44 +121,81 @@ def _spec_fns(target, draft, k: int,
             # acceptance on exactly the high-agreement path.  When the
             # round is rejected early the extra write is stale and
             # invisible like every other rolled-back slot.
-            def dstep(carry, _):
+            def dstep(carry, step_key):
                 d_cache, tok, dpos = carry
                 logits, d_cache = draft.apply(
                     {"params": d_xform(d_params)}, tok[:, None],
                     cache=d_cache, cache_pos=dpos)
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-                return (d_cache, nxt, dpos + 1), nxt
+                lg = logits[:, 0]
+                nxt = _select_token(lg, temperature,
+                                    step_key).astype(jnp.int32)
+                # draft probs feed the acceptance ratio (sampling only;
+                # greedy compares argmaxes and never reads them)
+                probs = jax.nn.softmax(
+                    lg / (temperature if sampling else 1.0), axis=-1)
+                return (d_cache, nxt, dpos + 1), (nxt, probs)
 
-            (d_cache, _, _), drafts = jax.lax.scan(
-                dstep, (d_cache, last, pos), None, length=k + 1)
-            drafts = drafts.T[:, :k]  # [B, k]; step k+1 only wrote cache
+            (d_cache, _, _), (drafts, dprobs) = jax.lax.scan(
+                dstep, (d_cache, last, pos),
+                jax.random.split(k_draft, k + 1))
+            drafts = drafts.T[:, :k]      # [B, k]; step k+1 wrote cache
+            dprobs = dprobs.transpose(1, 0, 2)[:, :k]  # [B, k, V]
 
             # ---- one target forward over [last, d_1..d_k]
             seq = jnp.concatenate([last[:, None], drafts], axis=1)
             t_logits, t_cache = target.apply(
                 {"params": t_xform(t_params)}, seq, cache=t_cache,
                 cache_pos=pos)
-            tpred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
 
-            # ---- longest agreeing prefix (per row), lockstep minimum
-            match = (drafts == tpred[:, :k]).astype(jnp.int32)
-            acc_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
-            n_acc = jnp.min(acc_row)
-            # emitted tokens this round: drafts[:, :n_acc] then the
-            # target's own token at the first disagreement (the bonus)
-            bonus = jnp.take(tpred, n_acc, axis=1)  # [B]
+            if sampling:
+                tprobs = jax.nn.softmax(t_logits / temperature, axis=-1)
+                # accept x_i with prob min(1, p_t(x_i)/p_d(x_i))
+                p_t = jnp.take_along_axis(
+                    tprobs[:, :k], drafts[..., None], axis=2)[..., 0]
+                p_d = jnp.take_along_axis(
+                    dprobs, drafts[..., None], axis=2)[..., 0]
+                u = jax.random.uniform(k_accept, (b, k))
+                accept = (u * jnp.maximum(p_d, 1e-30) < p_t).astype(
+                    jnp.int32)
+                acc_row = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+                n_acc = jnp.min(acc_row)
+                # slot n_acc, per row: rejected there -> residual draw;
+                # accepted past it -> keep its own accepted draft token;
+                # everyone accepted all k -> bonus draw from p_t[k]
+                t_at = jnp.take(tprobs, n_acc, axis=1)       # [B, V]
+                d_at = jnp.take(
+                    jnp.pad(dprobs, ((0, 0), (0, 1), (0, 0))),
+                    n_acc, axis=1)                           # [B, V]
+                fix = residual_sample(k_fix, t_at, d_at).astype(jnp.int32)
+                bonus_all = jax.random.categorical(
+                    k_fix, jnp.log(jnp.maximum(t_at, 1e-30))).astype(
+                        jnp.int32)
+                slot = jnp.where(
+                    n_acc == k, bonus_all,
+                    jnp.where(acc_row == n_acc, fix,
+                              jnp.take(jnp.pad(drafts, ((0, 0), (0, 1))),
+                                       n_acc, axis=1)))
+            else:
+                tpred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+                match = (drafts == tpred[:, :k]).astype(jnp.int32)
+                acc_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                n_acc = jnp.min(acc_row)
+                # the target's own token at the first disagreement
+                slot = jnp.take(tpred, n_acc, axis=1)
+
             idx = jnp.arange(k + 1)
             cand = jnp.where(idx[None, :] < n_acc,
                              jnp.pad(drafts, ((0, 0), (0, 1))),
-                             bonus[:, None])
+                             slot[:, None])
             out = jax.lax.dynamic_update_slice(out, cand, (0, n_out))
             n_emit = n_acc + 1
+            # the round's last emitted token is cand[:, n_acc] == slot
             return (t_cache, d_cache, out, n_out + n_emit,
-                    pos + n_emit, bonus, n_fwd + 1)
+                    pos + n_emit, slot, key, n_fwd + 1)
 
-        state = (t_cache, d_cache, out, jnp.int32(1), pos0, first,
+        state = (t_cache, d_cache, out, jnp.int32(1), pos0, first, rng,
                  jnp.int32(0))
-        _, _, out, n_out, _, _, n_fwd = jax.lax.while_loop(
+        _, _, out, n_out, _, _, _, n_fwd = jax.lax.while_loop(
             cond, body, state)
         return out[:, :max_new], n_fwd
 
@@ -136,12 +204,16 @@ def _spec_fns(target, draft, k: int,
 
 def speculative_generate(target, t_params, draft, d_params, prompt,
                          max_new_tokens: int, k: int = 4,
+                         temperature: float = 0.0, rng=None,
                          cache_len: Optional[int] = None,
                          target_transform=None, draft_transform=None,
                          return_stats: bool = False):
-    """Greedy speculative decoding: returns [B, max_new_tokens] tokens
-    IDENTICAL to `llama.generate(target, ...)`'s greedy output, produced
-    in ~(accepted+1)-token chunks per target forward.
+    """Speculative decoding: [B, max_new_tokens] tokens produced in
+    ~(accepted+1)-token chunks per target forward.  temperature 0 =
+    greedy, IDENTICAL to `llama.generate(target, ...)`'s output;
+    temperature > 0 = speculative SAMPLING (needs `rng`): every token is
+    an exact draw from the target's temperature-T distribution via the
+    stochastic-acceptance + residual rule.
 
     target/draft: llama.Llama modules sharing a tokenizer (vocab ids
     must mean the same thing); k: draft tokens per round.
@@ -175,15 +247,21 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
         raise ValueError(
             f"cache_len {c} < {total} — the multi-position verify write "
             f"must not wrap the ring")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    k_first, k_loop = jax.random.split(rng)
     t_cache = init_cache(target.cfg, b, min(c, target.cfg.max_len))
     d_cache = init_cache(draft.cfg, b, min(c, draft.cfg.max_len))
 
     prefill, spec_loop = _spec_fns(target, draft, int(k),
+                                   float(temperature),
                                    target_transform, draft_transform)
     first, t_cache, d_cache = prefill(t_params, d_params, t_cache,
-                                      d_cache, prompt)
+                                      d_cache, prompt, k_first)
     out, n_fwd = spec_loop(t_params, d_params, t_cache, d_cache, first,
-                           jnp.int32(prompt_len), int(max_new_tokens))
+                           jnp.int32(prompt_len), k_loop,
+                           int(max_new_tokens))
     if return_stats:
         return out, {"target_forwards": int(n_fwd)}
     return out
