@@ -405,3 +405,30 @@ def test_llama_text_to_training_via_tokenize_cli(tmp_path):
     assert rc2.returncode == 0, rc2.stderr[-2000:]
     assert "data: records" in rc2.stdout
     assert "complete: steps=2" in rc2.stdout
+
+
+def test_generate_cli_smoke_modes(tmp_path):
+    """The inference CLI's feature matrix: plain, int8, speculative, and
+    int8+speculative-sampling all decode on the tiny smoke model."""
+    for extra in ((), ("--int8",), ("--draft-layers", "1"),
+                  ("--int8", "--draft-layers", "1",
+                   "--temperature", "0.8")):
+        rc = _run("llama/generate_llama.py", "--smoke",
+                  "--prompt", "hello world", "--max-new", "8", *extra)
+        assert rc.returncode == 0, (extra, rc.stderr[-2000:])
+        assert "tokens: [" in rc.stdout, (extra, rc.stdout)
+
+
+def test_train_then_generate_checkpoint_roundtrip(tmp_path):
+    """train_llama saves an orbax checkpoint; generate_llama restores it
+    and decodes — the train->serve seam end to end."""
+    ckpt = str(tmp_path / "ckpt")
+    rc = _run("llama/train_llama.py", "--smoke", "--steps=2",
+              "--per-host-batch=2", f"--ckpt-dir={ckpt}")
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    rc2 = _run("llama/generate_llama.py", "--smoke",
+               "--prompt", "abc", "--max-new", "6",
+               f"--ckpt-dir={ckpt}")
+    assert rc2.returncode == 0, rc2.stderr[-2000:]
+    assert "restored step" in rc2.stdout
+    assert "tokens: [" in rc2.stdout

@@ -257,6 +257,22 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self.mngr.latest_step()
 
+    def restore_params(self, params, step: Optional[int] = None):
+        """Restore ONLY the params subtree — the inference path
+        (examples/llama/generate_llama.py): a serving process has no
+        optimizer, and demanding a matching opt_state tree just to read
+        weights would tie checkpoint consumers to the trainer's
+        optimizer choice."""
+        step = step if step is not None else self.mngr.latest_step()
+        if step is None:
+            raise ValueError("no checkpoint to restore params from")
+        restored = self.mngr.restore(
+            step,
+            args=self._ocp.args.PyTreeRestore(
+                {"params": params}, partial_restore=True),
+        )
+        return restored["params"]
+
     def restore(self, state: TrainState, step: Optional[int] = None) -> TrainState:
         step = step if step is not None else self.mngr.latest_step()
         if step is None:
