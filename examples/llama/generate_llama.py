@@ -164,13 +164,10 @@ def main(argv=None) -> int:
         out = llama.generate(
             model, params, prompt, args.max_new, rng=rng,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, **gen_kw)
+            top_p=args.top_p, eos_id=tok.eos_id, **gen_kw)
 
     ids_out = [int(t) for t in out[0]]
-    if hasattr(tok, "decode"):
-        print(tok.decode(ids_out))
-    else:
-        print(tok.tok.decode(ids_out))
+    print(tok.decode(ids_out))
     print(f"tokens: {ids_out}")
     return 0
 

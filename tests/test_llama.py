@@ -958,3 +958,79 @@ def test_mistral_swa_under_ring_flash_zigzag_grads():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), atol=5e-3, rtol=5e-3,
             err_msg=jax.tree_util.keystr(path))
+
+
+# ------------------------------------------------------------ chunked prefill
+def test_chunked_prefill_matches_single_pass():
+    """Chunked prefill (ragged last chunk included) must produce the
+    exact tokens of the one-pass prefill."""
+    cfg = _f32(max_len=128)
+    toks = _tokens(cfg, batch=2)[:, :40]
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    want = llama.generate(model, params, toks, max_new_tokens=12)
+    got = llama.generate(model, params, toks, max_new_tokens=12,
+                         prefill_chunk=16)  # 16,16,8 segments
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_chunked_prefill_streams_long_prompt_through_window_ring():
+    """The headline case: a sliding-window model whose PROMPT exceeds the
+    ring cache. Chunked prefill streams it through O(window) slots; the
+    result must equal the same model prefilled with a big cache (the
+    window hides everything older either way)."""
+    cfg = _f32(sliding_window=16, max_len=256)
+    model = llama.Llama(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 100), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt,
+                        train=False)["params"]
+    want = llama.generate(model, params, prompt, max_new_tokens=10,
+                          cache_len=128)  # prompt fits: one-pass oracle
+    got = llama.generate(model, params, prompt, max_new_tokens=10,
+                         cache_len=32, prefill_chunk=16)  # prompt 100 > 32
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_chunked_prefill_validation():
+    cfg = _f32(max_len=128)
+    model = llama.Llama(cfg)
+    toks = jnp.zeros((1, 40), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    with pytest.raises(ValueError, match="divide"):
+        llama.generate(model, params, toks, 4, cache_len=128,
+                       prefill_chunk=48)
+    # a full-causal model cannot stream past its cache — chunking bounds
+    # activations, not visibility
+    with pytest.raises(ValueError, match="exceeds cache"):
+        llama.generate(model, params, toks, 4, cache_len=32,
+                       prefill_chunk=16)
+    # a WINDOWED model's over-long prompt without chunking refuses with
+    # the prefill_chunk hint (full-causal ones hit the total>cache check
+    # first, where streaming could not help anyway)
+    wcfg = _f32(sliding_window=16, max_len=128)
+    wmodel = llama.Llama(wcfg)
+    wparams = wmodel.init(jax.random.PRNGKey(0), toks,
+                          train=False)["params"]
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        llama.generate(wmodel, wparams, toks, 4, cache_len=32)
+
+
+def test_chunked_prefill_rejects_window_evicting_chunks():
+    """A segment write must not evict positions its own queries still
+    attend: window=24, cache=32, chunk=32 divides the cache but evicts
+    the whole ring before attention runs — reject, never approximate."""
+    cfg = _f32(sliding_window=24, max_len=256)
+    model = llama.Llama(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 64), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt,
+                        train=False)["params"]
+    with pytest.raises(ValueError, match="evict"):
+        llama.generate(model, params, prompt, 4, cache_len=32,
+                       prefill_chunk=32)
+    # at the safe bound (chunk <= cache - window) streaming stays exact
+    want = llama.generate(model, params, prompt, 4, cache_len=128)
+    got = llama.generate(model, params, prompt, 4, cache_len=32,
+                         prefill_chunk=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -72,6 +72,9 @@ class HFTokenizer:
     def encode(self, text: str) -> List[int]:
         return self.tok.encode(text, add_special_tokens=False)
 
+    def decode(self, ids: Iterable[int]) -> str:
+        return self.tok.decode(list(ids))
+
 
 def load_tokenizer(spec: str):
     if spec == "byte":

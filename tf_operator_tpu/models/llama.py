@@ -575,6 +575,16 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
             {"params": xform(params)}, prompt, cache=cache, cache_pos=0)
         return logits[:, -1], cache
 
+    @jax.jit
+    def chunk_fill(params, cache, segment, pos):
+        # chunked-prefill step: same as prefill but position-offset
+        # (traced pos -> one compile per segment SHAPE, reused across
+        # chunks and calls)
+        logits, cache = model.apply(
+            {"params": xform(params)}, segment, cache=cache,
+            cache_pos=pos)
+        return logits[:, -1], cache
+
     @functools.partial(jax.jit, static_argnums=(5,))
     def decode(params, cache, first, pos0, rng, length):
         def step(carry, _):
@@ -598,7 +608,7 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
             step, (cache, first, pos0, rng, done0), None, length=length)
         return rest
 
-    return prefill, decode
+    return prefill, decode, chunk_fill
 
 
 def auto_cache_len(cfg: LlamaConfig, prompt_len: int, total: int) -> int:
@@ -624,7 +634,8 @@ def generate(model, params, prompt, max_new_tokens: int,
              top_k: int = 0, top_p: float = 0.0,
              eos_id: Optional[int] = None,
              cache_len: Optional[int] = None,
-             params_transform=None):
+             params_transform=None,
+             prefill_chunk: Optional[int] = None):
     """Autoregressive decoding: one prefill pass over the prompt (all
     positions in one MXU-friendly call), then `max_new_tokens` single-
     token steps through a `lax.scan` — static shapes; prefill and the
@@ -643,6 +654,14 @@ def generate(model, params, prompt, max_new_tokens: int,
     decode step streams int8 weights from HBM.  Use a STABLE function
     (make_dequantizer caches one per dtype) — a fresh closure per call
     would defeat the jitted-decode cache.
+
+    prefill_chunk (optional): prefill the prompt in segments of this
+    size instead of one pass — bounds prefill attention activations to
+    O(chunk x cache) for very long prompts, and for SLIDING-WINDOW
+    models lifts the prompt-must-fit-the-ring restriction entirely: a
+    128k prompt prefills through an O(window) ring cache chunk by chunk
+    (old positions are overwritten exactly when they leave the band).
+    Must divide the cache length so no segment write wraps the ring.
 
     The KV cache is allocated once at full length and positions beyond
     the current step are masked — the standard TPU decode layout (no
@@ -674,10 +693,32 @@ def generate(model, params, prompt, max_new_tokens: int,
         raise ValueError(
             f"prompt {prompt_len} + new {max_new_tokens} exceeds cache "
             f"length {cache_len}")
-    if prompt_len > cache_len:
+    if prefill_chunk is not None:
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if cache_len % prefill_chunk:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must divide cache_len "
+                f"{cache_len} — a segment write must never wrap the ring")
+        if (cfg.sliding_window is not None and total > cache_len
+                and prefill_chunk > cache_len - cfg.sliding_window):
+            # a segment write evicts the ring's OLDEST prefill_chunk
+            # positions BEFORE the segment's attention runs; if any of
+            # them is still inside the first query's window, that query
+            # attends aliased (future) K/V in their slots — silent
+            # garbage, so reject, never approximate
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} > cache_len {cache_len} "
+                f"- sliding_window {cfg.sliding_window}: a segment's "
+                f"write would evict positions its own queries still "
+                f"attend (grow the cache or shrink the chunk)")
+    elif prompt_len > cache_len:
         raise ValueError(
             f"prompt {prompt_len} exceeds cache length {cache_len} "
-            f"(the prefill write must not wrap the ring)")
+            f"(a single-pass prefill write must not wrap the ring; pass "
+            f"prefill_chunk to stream a long prompt through a smaller "
+            f"cache)")
     if (cfg.sliding_window is not None
             and cache_len < min(cfg.sliding_window, total)):
         # a ring smaller than the visible window silently loses positions
@@ -686,15 +727,24 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"cache_len {cache_len} < sliding window "
             f"{min(cfg.sliding_window, total)} — visible positions would "
             f"be overwritten")
+    # (full-causal models cannot stream past their cache — the
+    # sliding_window-is-None total>cache_len check above already refuses;
+    # chunking bounds activations, not visibility)
     cache = init_cache(cfg, b, cache_len)
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     k_first, k_rest = jax.random.split(rng)  # single-use key discipline
 
-    prefill, decode = _decode_fns(model, temperature, top_k, top_p, eos,
-                                  params_transform)
-    last_logits, cache = prefill(params, cache, prompt)
+    prefill, decode, chunk_fill = _decode_fns(
+        model, temperature, top_k, top_p, eos, params_transform)
+    if prefill_chunk is not None:
+        for i in range(0, prompt_len, prefill_chunk):
+            last_logits, cache = chunk_fill(
+                params, cache, prompt[:, i:i + prefill_chunk],
+                jnp.int32(i))
+    else:
+        last_logits, cache = prefill(params, cache, prompt)
     first = _select_token(last_logits, temperature, k_first, top_k, top_p)
     if max_new_tokens == 1:
         return first[:, None]
