@@ -156,6 +156,7 @@ def main(argv=None) -> int:
         out, stats = speculative_generate(
             model, params, d_model, d_params, prompt, args.max_new,
             k=args.spec_k, temperature=args.temperature, rng=rng,
+            eos_id=tok.eos_id,
             target_transform=gen_kw.get("params_transform"),
             return_stats=True, **d_kw)
         print(f"speculative: {stats['target_forwards']} target forwards "

@@ -139,3 +139,30 @@ def test_scale_payloads_stay_small_and_router_unquantized():
     # per-expert scales on the moe mats: [X, 1, out]
     wi_q = qparams["block0"]["moe"]["wi"]
     assert wi_q.scale.shape == (cfg.n_experts, 1, 2 * cfg.d_ff)
+
+
+def test_lora_merge_then_quantize_then_generate():
+    """The fine-tune -> deploy path: LoRA-adapted weights merge into the
+    base, quantize to int8, and drive generation — the merged-quantized
+    model's greedy tokens match the merged full-precision model's up to
+    int8 tie-flips (shape/validity asserted; closeness via logits)."""
+    from tf_operator_tpu.models import lora
+
+    cfg = _f32(tie_embeddings=True, max_len=64)
+    model, params, toks = _model_and_params(cfg)
+    adapters = lora.init(jax.random.PRNGKey(7), params, rank=2)
+    # a non-trivial adapter (random B would be zero-init in real LoRA;
+    # force it nonzero so the merge actually changes weights)
+    adapters = jax.tree_util.tree_map(
+        lambda x: x + 0.01, adapters)
+    merged = lora.merge(params, adapters)
+    want = model.apply({"params": merged}, toks[:, :16])
+    qmerged = quantize_params(merged)
+    got = model.apply(
+        {"params": dequantize_params(qmerged, jnp.float32)}, toks[:, :16])
+    denom = np.abs(np.asarray(want)).max()
+    rel = np.abs(np.asarray(got) - np.asarray(want)).max() / denom
+    assert rel < 0.05, rel
+    out = llama.generate(model, qmerged, toks[:2, :8], max_new_tokens=6,
+                         params_transform=make_dequantizer(jnp.float32))
+    assert out.shape == (2, 6)

@@ -198,3 +198,21 @@ def test_sampling_first_token_marginal_matches_plain_generate():
     f_spec = spec_first.count(top) / n
     f_plain = plain_first.count(top) / n
     assert abs(f_spec - f_plain) < 0.15, (f_spec, f_plain)
+
+
+def test_eos_parity_with_generate():
+    """eos_id stopping matches llama.generate's contract exactly: once a
+    row emits EOS, every later position is EOS, and pre-EOS tokens are
+    the plain greedy tokens."""
+    target, t_params = _init(_f32(n_layers=2, max_len=128), seed=0)
+    draft, d_params = _init(_f32(n_layers=1, max_len=128), seed=4)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 8), 0, 256)
+    plain = llama.generate(target, t_params, prompt, max_new_tokens=20)
+    # pick an eos that actually occurs mid-stream in row 0's output
+    eos = int(plain[0, 5])
+    want = llama.generate(target, t_params, prompt, max_new_tokens=20,
+                          eos_id=eos)
+    got = speculative_generate(target, t_params, draft, d_params,
+                               prompt, max_new_tokens=20, k=3,
+                               eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

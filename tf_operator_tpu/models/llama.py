@@ -569,21 +569,29 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
     # bandwidth win that is the whole point.
     xform = params_transform or (lambda p: p)
 
-    @jax.jit
-    def prefill(params, cache, prompt):
-        logits, cache = model.apply(
-            {"params": xform(params)}, prompt, cache=cache, cache_pos=0)
-        return logits[:, -1], cache
-
-    @jax.jit
+    # the cache is DONATED: each fill rebinds it, and without donation
+    # XLA must copy the full per-layer (k, v) buffers per dispatch —
+    # O(cache/chunk) write amplification on the long-prompt streaming
+    # path
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def chunk_fill(params, cache, segment, pos):
-        # chunked-prefill step: same as prefill but position-offset
-        # (traced pos -> one compile per segment SHAPE, reused across
-        # chunks and calls)
+        # prefill step at an arbitrary position offset (traced pos ->
+        # one compile per segment SHAPE, reused across chunks and calls);
+        # pos 0 with the whole prompt IS the one-pass prefill
         logits, cache = model.apply(
             {"params": xform(params)}, segment, cache=cache,
             cache_pos=pos)
         return logits[:, -1], cache
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def chunk_write(params, cache, segment, pos):
+        # non-final chunks only feed the cache — skip the lm_head
+        # entirely (at 128k vocab the discarded logits would dominate
+        # per-chunk FLOPs and activation memory)
+        _, cache = model.apply(
+            {"params": xform(params)}, segment, cache=cache,
+            cache_pos=pos, return_hidden=True)
+        return cache
 
     @functools.partial(jax.jit, static_argnums=(5,))
     def decode(params, cache, first, pos0, rng, length):
@@ -608,7 +616,7 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
             step, (cache, first, pos0, rng, done0), None, length=length)
         return rest
 
-    return prefill, decode, chunk_fill
+    return decode, chunk_fill, chunk_write
 
 
 def auto_cache_len(cfg: LlamaConfig, prompt_len: int, total: int) -> int:
@@ -736,15 +744,22 @@ def generate(model, params, prompt, max_new_tokens: int,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     k_first, k_rest = jax.random.split(rng)  # single-use key discipline
 
-    prefill, decode, chunk_fill = _decode_fns(
+    decode, chunk_fill, chunk_write = _decode_fns(
         model, temperature, top_k, top_p, eos, params_transform)
     if prefill_chunk is not None:
-        for i in range(0, prompt_len, prefill_chunk):
-            last_logits, cache = chunk_fill(
+        starts = list(range(0, prompt_len, prefill_chunk))
+        for i in starts[:-1]:
+            # intermediate chunks only feed the cache (no lm_head)
+            cache = chunk_write(
                 params, cache, prompt[:, i:i + prefill_chunk],
                 jnp.int32(i))
+        last = starts[-1]
+        last_logits, cache = chunk_fill(
+            params, cache, prompt[:, last:last + prefill_chunk],
+            jnp.int32(last))
     else:
-        last_logits, cache = prefill(params, cache, prompt)
+        last_logits, cache = chunk_fill(params, cache, prompt,
+                                        jnp.int32(0))
     first = _select_token(last_logits, temperature, k_first, top_k, top_p)
     if max_new_tokens == 1:
         return first[:, None]

@@ -205,6 +205,7 @@ def _spec_fns(target, draft, k: int, temperature: float,
 def speculative_generate(target, t_params, draft, d_params, prompt,
                          max_new_tokens: int, k: int = 4,
                          temperature: float = 0.0, rng=None,
+                         eos_id: Optional[int] = None,
                          cache_len: Optional[int] = None,
                          target_transform=None, draft_transform=None,
                          return_stats: bool = False):
@@ -217,6 +218,9 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
 
     target/draft: llama.Llama modules sharing a tokenizer (vocab ids
     must mean the same thing); k: draft tokens per round.
+    eos_id: llama.generate's stopping contract — once a row emits it,
+    every later position is eos_id (applied as a post-mask: speculation
+    may compute past the stop, the OUTPUT is identical).
     return_stats: also return {"target_forwards": int} — the speedup
     witness (plain decode needs max_new_tokens forwards)."""
     from tf_operator_tpu.models.llama import init_cache
@@ -262,6 +266,20 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     out, n_fwd = spec_loop(t_params, d_params, t_cache, d_cache, first,
                            jnp.int32(prompt_len), k_loop,
                            int(max_new_tokens))
+    if eos_id is not None:
+        if not 0 <= int(eos_id) < target.cfg.vocab_size:
+            raise ValueError(
+                f"eos_id {eos_id} out of range for vocab_size "
+                f"{target.cfg.vocab_size}")
+        # generate()'s contract: once a row emits EOS it keeps emitting
+        # it.  A post-mask gives the identical output (the masked tail's
+        # compute is wasted, not wrong — greedy/sampling exactness up to
+        # the first EOS is unaffected)
+        seen = jnp.cumsum(
+            (out == int(eos_id)).astype(jnp.int32), axis=1) > 0
+        prev_seen = jnp.pad(seen, ((0, 0), (1, 0)))[:, :-1]
+        out = jnp.where(prev_seen | (out == int(eos_id)),
+                        jnp.int32(eos_id), out)
     if return_stats:
         return out, {"target_forwards": int(n_fwd)}
     return out
