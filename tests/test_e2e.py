@@ -319,6 +319,9 @@ def test_pod_names(harness):
     assert client.get_pod_names("names", replica_type="worker", replica_index=1) == {
         "names-worker-1"
     }
+    # services materialize in their own reconcile pass — wait like the
+    # pod check does, or a loaded box races the assertion
+    wait_for(lambda: len(cluster.list_services()) == 3, "services")
     svc_names = {objects.name_of(s) for s in cluster.list_services()}
     assert svc_names == {"names-worker-0", "names-worker-1", "names-ps-0"}
 

@@ -72,8 +72,8 @@ def _run(script, *args):
         text=True,
         env=env,
         # generous: ~100s standalone, but under full-suite CPU contention
-        # the compile-heavy smokes have been observed to exceed 300s
-        timeout=600,
+        # the compile-heavy smokes have been observed to exceed 600s
+        timeout=900,
         cwd=REPO,
     )
 
