@@ -95,6 +95,10 @@ def main(argv=None) -> int:
                     help="smoke: random draft with this many layers")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens per speculation round")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="prefill the prompt in segments of this size "
+                         "(long prompts; sliding-window models stream "
+                         "through an O(window) cache)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny random model, CPU ok")
     args = ap.parse_args(argv)
@@ -140,6 +144,10 @@ def main(argv=None) -> int:
                 "--top-k/--top-p are not supported under speculation "
                 "(the acceptance ratio must match the sampled "
                 "distributions)")
+        if args.prefill_chunk:
+            raise SystemExit(
+                "--prefill-chunk is not supported under speculation "
+                "(the verify forwards re-prefill as they go)")
         import dataclasses
 
         d_layers = args.draft_layers or max(1, cfg.n_layers // 4)
@@ -162,6 +170,10 @@ def main(argv=None) -> int:
         print(f"speculative: {stats['target_forwards']} target forwards "
               f"for {args.max_new} tokens (plain decode = {args.max_new})")
     else:
+        if args.prefill_chunk:
+            # forward verbatim (including invalid values: the library's
+            # own validation message beats a silent mask here)
+            gen_kw["prefill_chunk"] = args.prefill_chunk
         out = llama.generate(
             model, params, prompt, args.max_new, rng=rng,
             temperature=args.temperature, top_k=args.top_k,
