@@ -539,6 +539,45 @@ def _mixtral_1b_cfg(**kw):
         **kw)
 
 
+def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4):
+    """Speculative-decoding witness: greedy self-draft generation (the
+    acceptance machinery at its best case) — reports target forwards vs
+    the max_new a plain decode would need, and verifies the output
+    equals plain greedy decode (the exactness contract).  Forward count
+    is the honest metric on any platform; wall-clock gains additionally
+    need a cheaper draft model than the target."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama as llm
+    from tf_operator_tpu.models.speculative import speculative_generate
+
+    if cfg is None:
+        cfg = _llama_1b_cfg()
+    model = llm.Llama(cfg)
+    rng = jax.random.PRNGKey(0)
+    max_new = max(2, min(max_new, cfg.max_len // 2))
+    prompt = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    params = jax.tree.map(
+        lambda x: x.astype(cfg.dtype),  # honor the config (f32 smokes)
+        model.init(rng, prompt, train=False)["params"],
+    )
+    plain = llm.generate(model, params, prompt, max_new)
+    out, stats = speculative_generate(
+        model, params, model, params, prompt, max_new, k=k,
+        return_stats=True)
+    exact = bool((jnp.asarray(out) == jnp.asarray(plain)).all())
+    return {
+        "mode": "self-draft greedy",
+        "k": k,
+        "new_tokens": max_new,
+        "target_forwards": stats["target_forwards"],
+        "plain_decode_forwards": max_new,
+        "forward_reduction": round(max_new / stats["target_forwards"], 2),
+        "output_equals_plain_greedy": exact,
+    }
+
+
 def bench_moe(gen: str, cfg=None):
     """Sparse-decoder arm: 8-expert top-2 mixtral-class train step —
     tokens/sec/chip + MFU over ACTIVE FLOPs (router + 2 experts/token;
@@ -1185,6 +1224,8 @@ def main() -> int:
         # jax.devices() below will still dial the TPU pool and hang
         jax.config.update("jax_platforms", "cpu")
 
+    import jax.numpy as jnp  # the CPU smoke rows build tiny f32 configs
+
     dev = jax.devices()[0]
     gen = detect_generation(dev)
     n_chips = max(1, len(jax.devices()))
@@ -1291,6 +1332,14 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001 — surfaced, not fatal
                 extra["moe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
             checkpoint_cache(resnet)
+        if os.environ.get("BENCH_SPEC", "1") == "1" and not _micro():
+            progress("speculative")
+            try:
+                extra["speculative"] = bench_speculative(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["speculative"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
     else:
         # no chip: the pallas kernel still runs (interpret mode) so the
         # flash arm's correctness witness lands in the artifact
@@ -1325,6 +1374,14 @@ def main() -> int:
             extra["moe"] = {"config": "tiny", "smoke": True, **row}
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["moe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        progress("speculative_smoke")
+        try:
+            row = bench_speculative(
+                gen, cfg=llm.tiny(dtype=jnp.float32, max_len=128),
+                max_new=24, k=3)
+            extra["speculative"] = {"config": "tiny", "smoke": True, **row}
+        except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+            extra["speculative"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     # both rows per operator bench: the in-memory store and the ClusterClient
     # + REST façade path (serialization, watch dispatch, conflict retries in

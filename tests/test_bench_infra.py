@@ -256,3 +256,18 @@ def test_bench_moe_path_runs_on_tiny_config():
     assert f_top2 < f_dense + 6.0 * cfg.n_layers * (
         2 * 3 * cfg.d_model * cfg.d_ff)  # well under all-4-experts
     assert f_top2 - f_top1 == 6.0 * cfg.n_layers * 3 * cfg.d_model * cfg.d_ff
+
+
+def test_bench_speculative_path_runs_on_tiny_config():
+    """The speculative arm end to end on a tiny config: self-draft must
+    beat plain decode on forward count AND keep the exactness bit."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama
+
+    r = bench.bench_speculative(
+        "cpu", cfg=llama.tiny(dtype=jnp.float32, max_len=128),
+        max_new=24, k=3)
+    assert r["output_equals_plain_greedy"] is True
+    assert r["target_forwards"] < r["plain_decode_forwards"] == 24
+    assert r["forward_reduction"] > 1.0
