@@ -1,9 +1,12 @@
 #!/usr/bin/env python
 """Headline benchmark: ResNet-50 training images/sec/chip + MFU (BASELINE.md).
 
-Prints ONE JSON line:
+Prints the full result as one JSON line (also written to BENCH_FULL.json),
+then a compact summary as the FINAL line — headline scalars only, hard-capped
+under the driver's 2,000-char tail window (round 4's full line outgrew it and
+the artifact parsed as null):
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
-   "mfu": N, "platform": ..., "degraded": bool, "extra": {...}}
+   "mfu": N, "platform": ..., "degraded": bool, "arms": {...}}
 
 Backend policy (VERDICT r1 item 1): the TPU backend is probed in a
 subprocess WITH A TIMEOUT and retried with backoff — jax.devices() can hang
@@ -651,10 +654,18 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
 
     decode_tps = batch * (max_new - 1) / max(1e-9, t_total - t_prefill)
     weight_gb = quantized_bytes(params) / 1e9  # generic nbytes sum
+    # parameter count by leaf identity: a QTensor contributes its int8
+    # payload only (scales are bookkeeping, not parameters); every other
+    # leaf counts whatever its dtype is — an f32 norm scale must not
+    # vanish from the count just because int8 mode is on
+    from tf_operator_tpu.models.quant import QTensor
+
+    n_params = sum(
+        leaf.q.size if isinstance(leaf, QTensor) else leaf.size
+        for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)))
     out = {
-        "params_b": round(sum(
-            x.size for x in jax.tree.leaves(params)
-            if x.dtype != jnp.float32 or not int8_weights) / 1e9, 2),
+        "params_b": round(n_params / 1e9, 2),
         "weights": ("int8+scales" if int8_weights else "bf16"),
         "weight_gb": round(weight_gb, 3),
         "gqa": f"{cfg.n_heads}q:{cfg.n_kv_heads}kv",
@@ -1420,8 +1431,91 @@ def main() -> int:
                 "source": "cached",
                 "measured_at": cached["measured_at"],
             }
+    # Full result: one (possibly huge) JSON line for humans/tools, plus a
+    # file copy.  The LAST stdout line is a compact summary hard-capped
+    # under the driver's 2,000-char tail window — round 4's full line
+    # outgrew that window and the round's artifact came back parsed:null,
+    # so the final line must stay small no matter how many arms grow.
     print(json.dumps(result))
+    try:
+        with open("BENCH_FULL.json", "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError as e:
+        print(f"# could not write BENCH_FULL.json: {e}", file=sys.stderr)
+    print(json.dumps(_compact_summary(result)))
     return 0
+
+
+# ------------------------------------------------- compact final line
+# One headline scalar per arm, picked in priority order.  Anything not
+# matched reports "ok"/"err" — presence is still a witness.
+_HEADLINE_KEYS = (
+    "img_per_sec_per_chip", "tokens_per_sec_per_chip",
+    "decode_tokens_per_sec", "tokens_per_target_forward", "speedup",
+    "jobs_per_sec", "p50_ms", "batches_per_sec", "tflops_per_sec",
+)
+
+
+def _arm_headline(row):
+    if not isinstance(row, dict):
+        return "ok"
+    if "error" in row:
+        return "err"
+    for k in _HEADLINE_KEYS:
+        v = row.get(k)
+        if isinstance(v, (int, float)):
+            return round(v, 2)
+    # two-backend rows ({"fake": {...}, "rest": {...}}) summarize per backend
+    sub = {k: _arm_headline(v) for k, v in row.items() if isinstance(v, dict)}
+    return sub or "ok"
+
+
+def _compact_summary(result):
+    summary = {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "vs_baseline": result["vs_baseline"],
+        "mfu": result["mfu"],
+        "platform": result["platform"],
+        "n_chips": result["n_chips"],
+        "degraded": result["degraded"],
+        "full": "BENCH_FULL.json",
+    }
+    for k in ("micro", "source"):
+        if k in result:
+            summary[k] = result[k]
+    if "degraded_reason" in result:
+        summary["degraded_reason"] = result["degraded_reason"][:160]
+    tlg = result.get("tpu_last_good")
+    if isinstance(tlg, dict):
+        summary["tpu_last_good"] = {
+            "measured_at": tlg.get("measured_at"),
+            "platform": tlg.get("platform"),
+            "value": tlg.get("value"),
+            "mfu": tlg.get("mfu"),
+        }
+    arms = {k: _arm_headline(v)
+            for k, v in result.get("extra", {}).items() if k != "probe"}
+    summary["arms"] = arms
+    # hard cap: drop arm detail, then arms entirely, before ever exceeding
+    # the window (the driver reads only the last 2,000 chars of stdout)
+    def degrade(v):
+        # a two-backend dict arm must not read "ok" when its backends
+        # failed: all-err -> err, mixed -> partial
+        if isinstance(v, dict):
+            vals = [degrade(x) for x in v.values()]
+            if vals and all(x == "err" for x in vals):
+                return "err"
+            return "partial" if any(x == "err" for x in vals) else "ok"
+        return "err" if v == "err" else "ok"
+
+    if len(json.dumps(summary)) > 1900:
+        summary["arms"] = {k: degrade(v) for k, v in arms.items()}
+    if len(json.dumps(summary)) > 1900:
+        summary.pop("arms")
+        summary["arms_truncated"] = True
+    return summary
 
 
 if __name__ == "__main__":

@@ -997,9 +997,15 @@ def test_chunked_prefill_validation():
     model = llama.Llama(cfg)
     toks = jnp.zeros((1, 40), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), toks, train=False)["params"]
+    # a chunk >= the prompt is a single-segment prefill — identical to
+    # the unchunked path, even when the chunk exceeds max_len (the
+    # streaming-only sizing rules must not reject or mis-size it)
+    want = llama.generate(model, params, toks, 4)
+    got = llama.generate(model, params, toks, 4, prefill_chunk=600)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     with pytest.raises(ValueError, match="divide"):
         llama.generate(model, params, toks, 4, cache_len=128,
-                       prefill_chunk=48)
+                       prefill_chunk=24)  # streams (24 < 40), 128 % 24 != 0
     # a full-causal model cannot stream past its cache — chunking bounds
     # activations, not visibility
     with pytest.raises(ValueError, match="exceeds cache"):
@@ -1014,6 +1020,65 @@ def test_chunked_prefill_validation():
                           train=False)["params"]
     with pytest.raises(ValueError, match="prefill_chunk"):
         llama.generate(wmodel, wparams, toks, 4, cache_len=32)
+
+
+def test_auto_cache_len_chunked_prefill_gives_window_ring():
+    """With prefill_chunk set, a sliding-window model's DEFAULT cache is
+    O(window + chunk), not O(prompt) — the documented '128k prompt
+    through an O(window) ring' must materialize without the caller
+    passing cache_len (the inference CLI never does)."""
+    cfg = _f32(sliding_window=512, max_len=16384)
+    # no chunk: the one-pass prefill write must fit, cache grows with it
+    assert llama.auto_cache_len(cfg, 4096, 4160) == 4096
+    # chunked: window + one chunk's eviction band, chunk-aligned
+    c = llama.auto_cache_len(cfg, 4096, 4160, prefill_chunk=128)
+    assert c == 640
+    assert c % 128 == 0 and c - cfg.sliding_window >= 128
+    # a non-128-multiple chunk still divides the result (generate()
+    # requires chunk | cache) and keeps the eviction band
+    c = llama.auto_cache_len(cfg, 4096, 4160, prefill_chunk=96)
+    assert c % 96 == 0 and c >= cfg.sliding_window + 96
+    # full causal: chunking bounds activations, not visibility — the
+    # cache still holds the whole sequence, rounded to a chunk multiple
+    fc = _f32(max_len=16384)
+    c = llama.auto_cache_len(fc, 4096, 4160, prefill_chunk=96)
+    assert c >= 4160 and c % 96 == 0
+    # short prompt: the chunked default never exceeds the unchunked one
+    assert llama.auto_cache_len(cfg, 64, 128, prefill_chunk=64) == 128
+    # the chunk round-up must never cross the RoPE-table bound: with a
+    # chunk that does not divide max_len and total in the top bucket,
+    # the default falls back to the largest chunk multiple that fits
+    # (init_cache would refuse anything past max_len)
+    edge = _f32(max_len=512)
+    c = llama.auto_cache_len(edge, 500, 510, prefill_chunk=96)
+    assert c == 480 and c <= edge.max_len and c % 96 == 0
+    # ...and generate() then refuses the genuinely infeasible request
+    # with its own accurate message, not init_cache's
+    model = llama.Llama(edge)
+    toks = jnp.zeros((1, 500), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks[:, :8],
+                        train=False)["params"]
+    with pytest.raises(ValueError, match="exceeds cache"):
+        llama.generate(model, params, toks, 10, prefill_chunk=96)
+
+
+def test_generate_default_cache_streams_long_prompt():
+    """End to end through the DEFAULT sizing: windowed model, prompt
+    larger than the auto ring, no cache_len argument — generate() must
+    stream exactly (vs a big-cache oracle) rather than allocate
+    O(prompt)."""
+    cfg = _f32(sliding_window=16, max_len=512)
+    model = llama.Llama(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 300), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt,
+                        train=False)["params"]
+    assert llama.auto_cache_len(cfg, 300, 310, prefill_chunk=16) == 128
+    want = llama.generate(model, params, prompt, max_new_tokens=10,
+                          cache_len=384)
+    got = llama.generate(model, params, prompt, max_new_tokens=10,
+                         prefill_chunk=16)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_chunked_prefill_rejects_window_evicting_chunks():

@@ -619,21 +619,40 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
     return decode, chunk_fill, chunk_write
 
 
-def auto_cache_len(cfg: LlamaConfig, prompt_len: int, total: int) -> int:
+def auto_cache_len(cfg: LlamaConfig, prompt_len: int, total: int,
+                   prefill_chunk: Optional[int] = None) -> int:
     """generate()'s default KV-cache sizing, exposed so tools reporting
     on the cache (bench.py) read the same policy the timed run
     allocates.  128-multiples so nearby request sizes share a compile;
     sliding-window models get a ring of O(window) slots (plus room for
     the whole prompt, whose prefill write must not wrap) instead of
-    O(context)."""
+    O(context).  With prefill_chunk set, the prompt streams through the
+    ring chunk by chunk, so the ring needs only window + one chunk's
+    eviction band — NOT the whole prompt — and the result is rounded up
+    to a chunk multiple (generate() requires chunk | cache so no segment
+    write wraps)."""
     def bucket(n):
         return min(cfg.max_len, (n + 127) // 128 * 128)
 
     cache_len = bucket(total)
     if cfg.sliding_window is not None:
-        cache_len = min(cache_len,
-                        max(bucket(cfg.sliding_window),
-                            bucket(prompt_len)))
+        if prefill_chunk is None:
+            cache_len = min(cache_len,
+                            max(bucket(cfg.sliding_window),
+                                bucket(prompt_len)))
+        else:
+            cache_len = min(cache_len,
+                            bucket(cfg.sliding_window + prefill_chunk))
+    if prefill_chunk is not None:
+        cache_len = -(-cache_len // prefill_chunk) * prefill_chunk
+        if cache_len > cfg.max_len:
+            # rounding up crossed the RoPE-table bound (init_cache would
+            # refuse): take the largest chunk multiple that fits instead —
+            # if even that cannot hold the sequence, generate()'s own
+            # validation refuses with the accurate message (the request
+            # is infeasible at this chunk size, not mis-sized by us)
+            cache_len = max(prefill_chunk,
+                            cfg.max_len // prefill_chunk * prefill_chunk)
     return cache_len
 
 
@@ -695,8 +714,14 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"prompt {prompt_len} + new {max_new_tokens} exceeds RoPE "
             f"table length max_len={cfg.max_len}")
 
+    if prefill_chunk is not None and prefill_chunk >= prompt_len:
+        # one segment holds the whole prompt: identical math to the
+        # unchunked path, and sizing/divisibility rules written for
+        # genuine streaming (chunk | cache, chunk <= max_len) stop
+        # applying to a request that never streams
+        prefill_chunk = None
     if cache_len is None:
-        cache_len = auto_cache_len(cfg, prompt_len, total)
+        cache_len = auto_cache_len(cfg, prompt_len, total, prefill_chunk)
     if cfg.sliding_window is None and total > cache_len:
         raise ValueError(
             f"prompt {prompt_len} + new {max_new_tokens} exceeds cache "

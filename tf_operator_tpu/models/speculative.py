@@ -160,21 +160,19 @@ def _spec_fns(target, draft, k: int, temperature: float,
                 acc_row = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
                 n_acc = jnp.min(acc_row)
                 # slot n_acc, per row: rejected there -> residual draw;
-                # accepted past it -> keep its own accepted draft token;
-                # everyone accepted all k -> bonus draw from p_t[k]
+                # accepted past it -> keep its own accepted draft token.
+                # The all-k-accepted bonus needs no special case: then
+                # every acc_row == k == n_acc, and the padded d_at row is
+                # all zeros, so residual_sample's norm(max(p_t - 0, 0))
+                # IS an exact draw from the target distribution.
                 t_at = jnp.take(tprobs, n_acc, axis=1)       # [B, V]
                 d_at = jnp.take(
                     jnp.pad(dprobs, ((0, 0), (0, 1), (0, 0))),
                     n_acc, axis=1)                           # [B, V]
                 fix = residual_sample(k_fix, t_at, d_at).astype(jnp.int32)
-                bonus_all = jax.random.categorical(
-                    k_fix, jnp.log(jnp.maximum(t_at, 1e-30))).astype(
-                        jnp.int32)
-                slot = jnp.where(
-                    n_acc == k, bonus_all,
-                    jnp.where(acc_row == n_acc, fix,
-                              jnp.take(jnp.pad(drafts, ((0, 0), (0, 1))),
-                                       n_acc, axis=1)))
+                slot = jnp.where(acc_row == n_acc, fix,
+                                 jnp.take(jnp.pad(drafts, ((0, 0), (0, 1))),
+                                          n_acc, axis=1))
             else:
                 tpred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
                 match = (drafts == tpred[:, :k]).astype(jnp.int32)
