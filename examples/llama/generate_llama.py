@@ -144,10 +144,6 @@ def main(argv=None) -> int:
                 "--top-k/--top-p are not supported under speculation "
                 "(the acceptance ratio must match the sampled "
                 "distributions)")
-        if args.prefill_chunk:
-            raise SystemExit(
-                "--prefill-chunk is not supported under speculation "
-                "(the verify forwards re-prefill as they go)")
         import dataclasses
 
         d_layers = args.draft_layers or max(1, cfg.n_layers // 4)
@@ -161,6 +157,10 @@ def main(argv=None) -> int:
 
             d_params = quant.quantize_params(d_params)
             d_kw = {"draft_transform": quant.make_dequantizer(cfg.dtype)}
+        if args.prefill_chunk:
+            # long prompts stream into both rings segment by segment
+            # (the library validates chunk | cache etc. itself)
+            d_kw["prefill_chunk"] = args.prefill_chunk
         out, stats = speculative_generate(
             model, params, d_model, d_params, prompt, args.max_new,
             k=args.spec_k, temperature=args.temperature, rng=rng,

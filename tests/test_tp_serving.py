@@ -1,0 +1,186 @@
+"""Tensor-parallel serving: generate() under a tp mesh with the KV cache
+sharded over kv heads (parallel/tp.kv_cache_sharding) and params placed
+by the training rule table (parallel/tp.transformer_param_sharding) —
+tokens must be EXACTLY those of the single-device run, for bf16, int8
+(sharded QTensor leaves), sampling, sliding-window rings, and chunked
+prefill.  This is how a model that does not fit one chip serves at all;
+the reference has no serving path (SURVEY.md §5.7), so the contract here
+is sharding-invariance, witnessed the same way the training dryruns are.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import llama, quant
+from tf_operator_tpu.parallel.mesh import make_mesh
+from tf_operator_tpu.parallel.tp import (
+    kv_cache_sharding, transformer_param_sharding,
+)
+
+
+def _setup(batch=4, prompt_len=12, tie=False, **cfg_kw):
+    cfg_kw.setdefault("dtype", jnp.float32)
+    cfg_kw.setdefault("max_len", 64)
+    cfg = llama.tiny(tie_embeddings=tie, **cfg_kw)
+    model = llama.Llama(cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt,
+                        train=False)["params"]
+    return cfg, model, prompt, params
+
+
+def _tp_mesh(tp=2):
+    return make_mesh({"tp": tp, "dp": len(jax.devices()) // tp})
+
+
+def _place(params, cfg, mesh, batch):
+    sharded = jax.device_put(params, transformer_param_sharding(params, mesh))
+    return sharded, kv_cache_sharding(cfg, mesh, batch)
+
+
+# ------------------------------------------------------------- exactness
+def test_tp_generate_matches_single_device():
+    """Greedy decode under tp=2 x dp=4 (untied lm_head exercises the
+    column-parallel logits matmul) == single-device tokens."""
+    cfg, model, prompt, params = _setup()
+    want = llama.generate(model, params, prompt, 8)
+    mesh = _tp_mesh()
+    sp, csh = _place(params, cfg, mesh, prompt.shape[0])
+    got = llama.generate(model, sp, prompt, 8, cache_sharding=csh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_generate_tied_embeddings():
+    """Tied embeddings: the vocab-parallel table serves both the lookup
+    and the attend() logits matmul."""
+    cfg, model, prompt, params = _setup(tie=True)
+    want = llama.generate(model, params, prompt, 6)
+    mesh = _tp_mesh()
+    sp, csh = _place(params, cfg, mesh, prompt.shape[0])
+    got = llama.generate(model, sp, prompt, 6, cache_sharding=csh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_generate_sampling_matches():
+    """Sampling at temperature/top_k/top_p: same rng => same tokens
+    under sharding (the categorical draw sees numerically matching
+    logits; exact equality holds away from measure-zero ties)."""
+    cfg, model, prompt, params = _setup()
+    rng = jax.random.PRNGKey(7)
+    kw = dict(temperature=0.8, top_k=20, top_p=0.9, rng=rng)
+    want = llama.generate(model, params, prompt, 8, **kw)
+    mesh = _tp_mesh()
+    sp, csh = _place(params, cfg, mesh, prompt.shape[0])
+    got = llama.generate(model, sp, prompt, 8, cache_sharding=csh, **kw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_int8_generate_matches():
+    """Weight-only int8 under tp: QTensor leaves are placed by the same
+    rule table (payload sharded, broadcast scale dims replicated) and
+    the dequant-inside-the-scan seam runs sharded — tokens equal the
+    single-device int8 run."""
+    cfg, model, prompt, params = _setup()
+    qp = quant.quantize_params(params)
+    dq = quant.make_dequantizer(cfg.dtype)
+    want = llama.generate(model, qp, prompt, 8, params_transform=dq)
+    mesh = _tp_mesh()
+    sq, csh = _place(qp, cfg, mesh, prompt.shape[0])
+    got = llama.generate(model, sq, prompt, 8, cache_sharding=csh,
+                         params_transform=dq)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_sliding_window_ring_cache():
+    """The Mistral ring cache under tp: O(window) slots, kv-sharded,
+    generation running past the window — equal to the unsharded run."""
+    cfg, model, prompt, params = _setup(sliding_window=16, max_len=256,
+                                        prompt_len=20)
+    want = llama.generate(model, params, prompt, 24)
+    mesh = _tp_mesh()
+    sp, csh = _place(params, cfg, mesh, prompt.shape[0])
+    got = llama.generate(model, sp, prompt, 24, cache_sharding=csh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_chunked_prefill():
+    """Long-prompt streaming (chunked prefill through the ring) under
+    tp: the donated sharded cache flows through every chunk write."""
+    cfg, model, prompt, params = _setup(sliding_window=16, max_len=256,
+                                        prompt_len=50)
+    want = llama.generate(model, params, prompt, 8, cache_len=64)
+    mesh = _tp_mesh()
+    sp, csh = _place(params, cfg, mesh, prompt.shape[0])
+    got = llama.generate(model, sp, prompt, 8, cache_len=64,
+                         prefill_chunk=16, cache_sharding=csh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ------------------------------------------------------------- placement
+def test_params_actually_sharded():
+    """The exactness witnesses must not pass by silent replication: a
+    tp-sharded attention kernel's addressable shard holds half the
+    query heads, and the KV cache spec shards the kv-head dim."""
+    cfg, model, prompt, params = _setup()
+    mesh = _tp_mesh()
+    sp, csh = _place(params, cfg, mesh, prompt.shape[0])
+    wq = sp["block0"]["attn"]["wq"]["kernel"]  # [E, H, D]
+    shard = wq.addressable_shards[0].data
+    assert shard.shape[1] == cfg.n_heads // 2
+    assert csh.spec == jax.sharding.PartitionSpec("dp", None, "tp", None)
+
+
+def test_qtensor_sharding_scale_projection():
+    """QTensor placement: the int8 payload takes the param's rule; the
+    scale keeps the spec only on dims it carries (broadcast 1-dims
+    replicate).  Row-parallel attn out [H, D, E] shards dim 0 of q,
+    whose scale (1, 1, E) cannot follow."""
+    cfg, model, prompt, params = _setup()
+    qp = quant.quantize_params(params)
+    mesh = _tp_mesh()
+    sh = transformer_param_sharding(qp, mesh)
+    out = sh["block0"]["attn"]["out"]["kernel"]
+    assert isinstance(out, quant.QTensor)
+    assert out.q.spec[0] == "tp"
+    assert out.scale.spec == jax.sharding.PartitionSpec(None, None, None)
+    wq = sh["block0"]["attn"]["wq"]["kernel"]
+    assert wq.q.spec[1] == "tp"
+    assert wq.scale.spec[1] == "tp"  # (1, H, D) carries the head dim
+
+
+def test_kv_cache_sharding_falls_back_to_replication():
+    """kv heads not divisible by tp (8 kv heads, tp=8 here vs tiny's 2
+    kv heads) must replicate the head dim, not refuse or mis-shard; a
+    batch that does not divide the data axes replicates batch."""
+    cfg = llama.tiny(dtype=jnp.float32)
+    mesh = make_mesh({"tp": 8})
+    sh = kv_cache_sharding(cfg, mesh, 4)
+    assert sh.spec == jax.sharding.PartitionSpec(None, None, None, None)
+    mesh2 = make_mesh({"dp": 8})
+    sh2 = kv_cache_sharding(cfg, mesh2, 3)  # 3 % 8 != 0
+    assert sh2.spec == jax.sharding.PartitionSpec(None, None, None, None)
+    sh3 = kv_cache_sharding(cfg, mesh2, 8)
+    assert sh3.spec == jax.sharding.PartitionSpec(("dp",), None, None, None)
+
+
+def test_speculative_under_tp_mesh():
+    """Speculative decoding with BOTH models' params tp-sharded: greedy
+    output must stay token-identical to plain single-device decode (the
+    exactness contract is sharding-invariant)."""
+    from tf_operator_tpu.models.speculative import speculative_generate
+
+    cfg, model, prompt, params = _setup(max_len=128)
+    dcfg = llama.tiny(dtype=jnp.float32, max_len=128, n_layers=1,
+                      tie_embeddings=True)
+    draft = llama.Llama(dcfg)
+    dparams = draft.init(jax.random.PRNGKey(2), prompt,
+                         train=False)["params"]
+    want = llama.generate(model, params, prompt, 10)
+    mesh = _tp_mesh()
+    sp, _ = _place(params, cfg, mesh, prompt.shape[0])
+    sd = jax.device_put(dparams,
+                        transformer_param_sharding(dparams, mesh))
+    got = speculative_generate(model, sp, draft, sd, prompt, 10, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -298,7 +298,7 @@ class GqaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, angles, cache=None, pos=None):
+    def __call__(self, x, angles, cache=None, pos=None, wrap_write=False):
         cfg = self.cfg
         dense = functools.partial(
             nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
@@ -312,14 +312,28 @@ class GqaAttention(nn.Module):
         if cache is not None:
             k_cache, v_cache = cache
             l = x.shape[1]
-            # ring-buffer write: global position p -> slot p % C. Callers
-            # guarantee a multi-position write never wraps (generate
-            # enforces prompt_len <= C), so one contiguous slice suffices.
-            slot = jnp.mod(pos, k_cache.shape[1])
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
+            if wrap_write and l > 1:
+                # multi-position write at an arbitrary ring offset (the
+                # speculative verify: k+1 positions from wherever the
+                # last round stopped) — per-position scatter, allowed to
+                # wrap.  Small-L only: contiguous bulk writes (prefill)
+                # keep the cheaper slice path below.
+                idx = jnp.mod(pos + jnp.arange(l, dtype=jnp.int32),
+                              k_cache.shape[1])
+                k_cache = k_cache.at[:, idx].set(
+                    k.astype(k_cache.dtype), unique_indices=True)
+                v_cache = v_cache.at[:, idx].set(
+                    v.astype(v_cache.dtype), unique_indices=True)
+            else:
+                # ring-buffer write: global position p -> slot p % C.
+                # Callers guarantee this write never wraps (generate
+                # enforces prompt_len <= C / chunk | C), so one
+                # contiguous slice suffices.
+                slot = jnp.mod(pos, k_cache.shape[1])
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
             q_pos = pos + jnp.arange(l, dtype=jnp.int32)
             out = _cached_attention(q, k_cache, v_cache, q_pos,
                                     k_cache.shape[1],
@@ -453,7 +467,7 @@ class LlamaBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, angles, cache=None, pos=None):
+    def __call__(self, x, angles, cache=None, pos=None, wrap_write=False):
         cfg = self.cfg
         norm = functools.partial(
             nn.RMSNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype
@@ -462,7 +476,8 @@ class LlamaBlock(nn.Module):
         mlp = (MoeSwiGlu(cfg, name="moe") if self.use_moe
                else SwiGlu(cfg, name="mlp"))
         if cache is not None:
-            a, cache = attn(norm(name="ln1")(x), angles, cache, pos)
+            a, cache = attn(norm(name="ln1")(x), angles, cache, pos,
+                            wrap_write)
             x = x + a
             h = norm(name="ln2")(x)
             y = mlp(h, decode=True) if self.use_moe else mlp(h)
@@ -480,7 +495,8 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
-                 positions=None, cache=None, cache_pos=None):
+                 positions=None, cache=None, cache_pos=None,
+                 wrap_cache_write: bool = False):
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
@@ -505,7 +521,8 @@ class Llama(nn.Module):
                        and i % cfg.moe_every == cfg.moe_every - 1)
             blk = block(cfg, use_moe=use_moe, name=f"block{i}")
             if decode:
-                x, layer_cache = blk(x, angles, cache[i], cache_pos)
+                x, layer_cache = blk(x, angles, cache[i], cache_pos,
+                                     wrap_cache_write)
                 new_cache.append(layer_cache)
             else:
                 x = blk(x, angles)
@@ -619,6 +636,43 @@ def _decode_fns_cached(model, temperature: float, top_k: int = 0,
     return decode, chunk_fill, chunk_write
 
 
+def chunk_align_cache(cache_len: int, prefill_chunk: int,
+                      max_len: int) -> int:
+    """Round a cache length up to a prefill_chunk multiple (streaming
+    prefill requires chunk | cache so no segment write wraps), falling
+    back to the largest multiple under max_len when rounding would cross
+    the RoPE-table bound.  The single sizing rule shared by generate()'s
+    default (auto_cache_len) and speculative_generate's (_spec_cache_len)
+    so chunked runs size identically across both entry points."""
+    c = -(-cache_len // prefill_chunk) * prefill_chunk
+    if c > max_len:
+        c = max(prefill_chunk, max_len // prefill_chunk * prefill_chunk)
+    return c
+
+
+def check_prefill_chunk(prefill_chunk: int, cache_len: int, window,
+                        streams_past_cache: bool, who: str = "") -> None:
+    """Shared streaming-prefill validation (generate +
+    speculative_generate): the chunk must divide the cache, and when the
+    ring actually wraps it must not evict positions its own segment's
+    queries still attend — refuse, never approximate."""
+    if cache_len % prefill_chunk:
+        raise ValueError(
+            f"prefill_chunk {prefill_chunk} must divide {who}cache_len "
+            f"{cache_len} — a segment write must never wrap the ring")
+    if (window is not None and streams_past_cache
+            and prefill_chunk > cache_len - window):
+        # a segment write evicts the ring's OLDEST prefill_chunk
+        # positions BEFORE the segment's attention runs; if any of them
+        # is still inside the first query's window, that query attends
+        # aliased (future) K/V in their slots — silent garbage
+        raise ValueError(
+            f"prefill_chunk {prefill_chunk} > {who}cache_len {cache_len} "
+            f"- sliding_window {window}: a segment's write would evict "
+            f"positions its own queries still attend (grow the cache or "
+            f"shrink the chunk)")
+
+
 def auto_cache_len(cfg: LlamaConfig, prompt_len: int, total: int,
                    prefill_chunk: Optional[int] = None) -> int:
     """generate()'s default KV-cache sizing, exposed so tools reporting
@@ -644,15 +698,11 @@ def auto_cache_len(cfg: LlamaConfig, prompt_len: int, total: int,
             cache_len = min(cache_len,
                             bucket(cfg.sliding_window + prefill_chunk))
     if prefill_chunk is not None:
-        cache_len = -(-cache_len // prefill_chunk) * prefill_chunk
-        if cache_len > cfg.max_len:
-            # rounding up crossed the RoPE-table bound (init_cache would
-            # refuse): take the largest chunk multiple that fits instead —
-            # if even that cannot hold the sequence, generate()'s own
-            # validation refuses with the accurate message (the request
-            # is infeasible at this chunk size, not mis-sized by us)
-            cache_len = max(prefill_chunk,
-                            cfg.max_len // prefill_chunk * prefill_chunk)
+        # if even the aligned fallback cannot hold the sequence,
+        # generate()'s own validation refuses with the accurate message
+        # (the request is infeasible at this chunk size, not mis-sized)
+        cache_len = chunk_align_cache(cache_len, prefill_chunk,
+                                      cfg.max_len)
     return cache_len
 
 
@@ -662,7 +712,8 @@ def generate(model, params, prompt, max_new_tokens: int,
              eos_id: Optional[int] = None,
              cache_len: Optional[int] = None,
              params_transform=None,
-             prefill_chunk: Optional[int] = None):
+             prefill_chunk: Optional[int] = None,
+             cache_sharding=None):
     """Autoregressive decoding: one prefill pass over the prompt (all
     positions in one MXU-friendly call), then `max_new_tokens` single-
     token steps through a `lax.scan` — static shapes; prefill and the
@@ -681,6 +732,15 @@ def generate(model, params, prompt, max_new_tokens: int,
     decode step streams int8 weights from HBM.  Use a STABLE function
     (make_dequantizer caches one per dtype) — a fresh closure per call
     would defeat the jitted-decode cache.
+
+    cache_sharding (optional): a jax.sharding.Sharding (or matching
+    pytree) applied to the freshly allocated KV cache — the
+    tensor-parallel serving seam (parallel/tp.kv_cache_sharding): with
+    params placed by parallel/tp.transformer_param_sharding and the
+    cache's kv-head dim sharded over tp, the whole prefill+decode runs
+    as one GSPMD program with each chip holding only its own heads'
+    K/V and weights.  Composes with params_transform (sharded QTensor
+    leaves) and prefill_chunk.
 
     prefill_chunk (optional): prefill the prompt in segments of this
     size instead of one pass — bounds prefill attention activations to
@@ -730,22 +790,8 @@ def generate(model, params, prompt, max_new_tokens: int,
         if prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
-        if cache_len % prefill_chunk:
-            raise ValueError(
-                f"prefill_chunk {prefill_chunk} must divide cache_len "
-                f"{cache_len} — a segment write must never wrap the ring")
-        if (cfg.sliding_window is not None and total > cache_len
-                and prefill_chunk > cache_len - cfg.sliding_window):
-            # a segment write evicts the ring's OLDEST prefill_chunk
-            # positions BEFORE the segment's attention runs; if any of
-            # them is still inside the first query's window, that query
-            # attends aliased (future) K/V in their slots — silent
-            # garbage, so reject, never approximate
-            raise ValueError(
-                f"prefill_chunk {prefill_chunk} > cache_len {cache_len} "
-                f"- sliding_window {cfg.sliding_window}: a segment's "
-                f"write would evict positions its own queries still "
-                f"attend (grow the cache or shrink the chunk)")
+        check_prefill_chunk(prefill_chunk, cache_len, cfg.sliding_window,
+                            streams_past_cache=total > cache_len)
     elif prompt_len > cache_len:
         raise ValueError(
             f"prompt {prompt_len} exceeds cache length {cache_len} "
@@ -764,6 +810,8 @@ def generate(model, params, prompt, max_new_tokens: int,
     # sliding_window-is-None total>cache_len check above already refuses;
     # chunking bounds activations, not visibility)
     cache = init_cache(cfg, b, cache_len)
+    if cache_sharding is not None:
+        cache = jax.device_put(cache, cache_sharding)
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
     rng = rng if rng is not None else jax.random.PRNGKey(0)

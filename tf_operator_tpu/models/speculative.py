@@ -43,9 +43,14 @@ randomness.  top_k/top_p truncation is not supported under speculation
 (the acceptance ratio must be computed over the same distributions the
 tokens were drawn from).
 
-Sliding-window targets must still allocate cache >= total (the
-multi-position verify write must not wrap the ring).  No reference
-counterpart (the reference has no model/serving code, SURVEY.md §5.7).
+Sliding-window models keep their O(window) ring under speculation: a
+ring of cache_len >= window + k slots is enough — the wrapping verify
+write goes through a scatter (llama wrap_cache_write) and every aliased
+slot resolves outside the window mask (bound derivation in
+_spec_cache_len); long prompts stream in via prefill_chunk.  A
+full-causal model on either side still needs its whole sequence
+resident.  No reference counterpart (the reference has no model/serving
+code, SURVEY.md §5.7).
 """
 from __future__ import annotations
 
@@ -70,10 +75,14 @@ def residual_sample(key, t_probs, d_probs):
 
 @functools.lru_cache(maxsize=8)
 def _spec_fns(target, draft, k: int, temperature: float,
-              target_transform=None, draft_transform=None):
+              target_transform=None, draft_transform=None,
+              wrap_target: bool = False):
     """Jitted (prefill, spec_loop) for a (target, draft, k, T) tuple.
     Transforms are the weight-only-quantization seam
-    (models/quant.make_dequantizer), identical to llama.generate's."""
+    (models/quant.make_dequantizer), identical to llama.generate's.
+    wrap_target: the target cache is an O(window) ring smaller than the
+    sequence, so the k+1-position verify write may wrap the ring and
+    goes through the scatter path (llama.GqaAttention wrap_write)."""
     from tf_operator_tpu.models.llama import _select_token
 
     t_xform = target_transform or (lambda p: p)
@@ -145,7 +154,7 @@ def _spec_fns(target, draft, k: int, temperature: float,
             seq = jnp.concatenate([last[:, None], drafts], axis=1)
             t_logits, t_cache = target.apply(
                 {"params": t_xform(t_params)}, seq, cache=t_cache,
-                cache_pos=pos)
+                cache_pos=pos, wrap_cache_write=wrap_target)
 
             if sampling:
                 tprobs = jax.nn.softmax(t_logits / temperature, axis=-1)
@@ -200,12 +209,63 @@ def _spec_fns(target, draft, k: int, temperature: float,
     return prefill, spec_loop
 
 
+def _spec_cache_len(name: str, cfg, requested: Optional[int], total: int,
+                    k: int, prompt_len: int,
+                    prefill_chunk: Optional[int]) -> int:
+    """Per-model cache sizing + validation for speculative decoding.
+
+    Full-causal models need the whole sequence resident (the visibility
+    set only grows).  Sliding-window models may run an O(window) ring
+    SMALLER than the sequence: the k+1-position verify write then wraps,
+    and a freshly written slot for position p aliases, to a query at q,
+    as apparent position p - C — outside q's window iff C >= window + k
+    (worst case p = q + k).  The same bound keeps a rejected round's
+    stale slots invisible to every later query.  Refuse below the bound,
+    never approximate.  Default sizing and streaming-prefill checks are
+    llama's own (chunk_align_cache / check_prefill_chunk), so chunked
+    speculation sizes caches exactly like plain generate()."""
+    from tf_operator_tpu.models.llama import (
+        check_prefill_chunk, chunk_align_cache,
+    )
+
+    c = requested or total
+    c = min(c, cfg.max_len)
+    if requested is None and prefill_chunk is not None:
+        c = chunk_align_cache(c, prefill_chunk, cfg.max_len)
+    w = cfg.sliding_window
+    if w is None:
+        if c < total:
+            raise ValueError(
+                f"{name} cache_len {c} < {total} — a full-causal model "
+                f"cannot stream past its cache (every position stays "
+                f"visible)")
+    elif c < total and c < w + k:
+        raise ValueError(
+            f"{name} cache_len {c} < window {w} + k {k}: a verify "
+            f"round's k+1-position ring write would alias positions "
+            f"its own queries still attend (grow the cache or "
+            f"shrink k)")
+    if prefill_chunk is None:
+        if prompt_len > c:
+            raise ValueError(
+                f"prompt {prompt_len} exceeds {name} cache length {c} "
+                f"(the prefill write must not wrap the ring; pass "
+                f"prefill_chunk to stream a long prompt)")
+    else:
+        check_prefill_chunk(prefill_chunk, c, w,
+                            streams_past_cache=total > c,
+                            who=f"{name} ")
+    return c
+
+
 def speculative_generate(target, t_params, draft, d_params, prompt,
                          max_new_tokens: int, k: int = 4,
                          temperature: float = 0.0, rng=None,
                          eos_id: Optional[int] = None,
                          cache_len: Optional[int] = None,
+                         draft_cache_len: Optional[int] = None,
                          target_transform=None, draft_transform=None,
+                         prefill_chunk: Optional[int] = None,
                          return_stats: bool = False):
     """Speculative decoding: [B, max_new_tokens] tokens produced in
     ~(accepted+1)-token chunks per target forward.  temperature 0 =
@@ -219,9 +279,25 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     eos_id: llama.generate's stopping contract — once a row emits it,
     every later position is eos_id (applied as a post-mask: speculation
     may compute past the stop, the OUTPUT is identical).
+
+    cache_len / draft_cache_len: per-model KV cache slots (defaults:
+    whole sequence).  A sliding-window model may pass an O(window) ring
+    as small as window + k — long-context serving keeps the windowed
+    memory win under speculation; the wrapping verify write is handled
+    by a scatter (llama wrap_cache_write) and the window mask hides
+    every aliased slot (see _spec_cache_len for the bound).  A
+    full-causal model (either side) still requires its whole sequence.
+
+    prefill_chunk: stream the prompt into BOTH caches in segments (the
+    long-prompt path: a prompt longer than a windowed model's ring
+    prefills through it chunk by chunk, llama.generate's contract; the
+    chunk must divide both cache lengths).
+
     return_stats: also return {"target_forwards": int} — the speedup
     witness (plain decode needs max_new_tokens forwards)."""
-    from tf_operator_tpu.models.llama import init_cache
+    from tf_operator_tpu.models.llama import (
+        _decode_fns, _select_token, init_cache,
+    )
 
     if target.cfg.vocab_size != draft.cfg.vocab_size:
         raise ValueError(
@@ -244,23 +320,50 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
                 f"prompt {prompt_len} + new {max_new_tokens} (+{k + 1} "
                 f"speculation headroom) exceeds {name} max_len "
                 f"{cfg.max_len}")
-    c = cache_len or total
-    if c < total:
-        raise ValueError(
-            f"cache_len {c} < {total} — the multi-position verify write "
-            f"must not wrap the ring")
+    if prefill_chunk is not None:
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if prefill_chunk >= prompt_len:
+            # one segment holds the whole prompt: identical to unchunked
+            prefill_chunk = None
+    c_t = _spec_cache_len("target", target.cfg, cache_len, total, k,
+                          prompt_len, prefill_chunk)
+    c_d = _spec_cache_len("draft", draft.cfg, draft_cache_len, total, k,
+                          prompt_len, prefill_chunk)
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     k_first, k_loop = jax.random.split(rng)
-    t_cache = init_cache(target.cfg, b, min(c, target.cfg.max_len))
-    d_cache = init_cache(draft.cfg, b, min(c, draft.cfg.max_len))
+    t_cache = init_cache(target.cfg, b, c_t)
+    d_cache = init_cache(draft.cfg, b, c_d)
 
     prefill, spec_loop = _spec_fns(target, draft, int(k),
                                    float(temperature),
-                                   target_transform, draft_transform)
-    first, t_cache, d_cache = prefill(t_params, d_params, t_cache,
-                                      d_cache, prompt, k_first)
+                                   target_transform, draft_transform,
+                                   wrap_target=c_t < total)
+    if prefill_chunk is not None:
+        # stream the prompt through both rings segment by segment,
+        # reusing llama.generate's jitted chunk writers (shared compile
+        # cache — greedy key: chunk writes never select tokens)
+        _, t_fill, t_write = _decode_fns(target, 0.0, 0, 0.0, -1,
+                                         target_transform)
+        _, _, d_write = _decode_fns(draft, 0.0, 0, 0.0, -1,
+                                    draft_transform)
+        starts = list(range(0, prompt_len, prefill_chunk))
+        for i in starts[:-1]:
+            seg = prompt[:, i:i + prefill_chunk]
+            t_cache = t_write(t_params, t_cache, seg, jnp.int32(i))
+            d_cache = d_write(d_params, d_cache, seg, jnp.int32(i))
+        last = starts[-1]
+        seg = prompt[:, last:last + prefill_chunk]
+        last_logits, t_cache = t_fill(t_params, t_cache, seg,
+                                      jnp.int32(last))
+        d_cache = d_write(d_params, d_cache, seg, jnp.int32(last))
+        first = _select_token(last_logits, temperature, k_first)
+    else:
+        first, t_cache, d_cache = prefill(t_params, d_params, t_cache,
+                                          d_cache, prompt, k_first)
     out, n_fwd = spec_loop(t_params, d_params, t_cache, d_cache, first,
                            jnp.int32(prompt_len), k_loop,
                            int(max_new_tokens))

@@ -35,14 +35,22 @@ def _overlay_fsdp(spec_list, shape, fsdp: int, min_size: int):
 def transformer_param_sharding(
     params: Any, mesh: Mesh, min_fsdp_size: int = 2**14
 ) -> Any:
-    """Pytree of NamedSharding matching `params` (from models/transformer.py)."""
+    """Pytree of NamedSharding matching `params` (from models/transformer.py).
+
+    Weight-only-quantized trees (models/quant.QTensor leaves) place by the
+    SAME rule table: the int8 payload takes the rule for its param name,
+    and the per-output-channel scale inherits the payload's spec on every
+    dim it actually carries (broadcast size-1 dims replicate — a
+    row-parallel kernel's scale has no input dim to shard)."""
+    from tf_operator_tpu.models.quant import QTensor
+
     tp = mesh.shape.get("tp", 1)
     ep = mesh.shape.get("ep", 1)
     fsdp = mesh.shape.get("fsdp", 1)
 
-    def place(path, x) -> NamedSharding:
+    def place(path, x):
         name = _path_str(path)
-        shape = getattr(x, "shape", ())
+        shape = getattr(x, "shape", ())  # QTensor.shape is its q.shape
         spec = [None] * len(shape)
 
         def ok(dim, axis_size):
@@ -75,9 +83,41 @@ def transformer_param_sharding(
         if ep > 1 and ("moe/wi" in name or "moe/wo" in name) and ok(0, ep):
             spec[0] = "ep"  # experts over ep
         spec = _overlay_fsdp(spec, shape, fsdp, min_fsdp_size)
+        if isinstance(x, QTensor):
+            sspec = [
+                a if a is not None and x.scale.shape[d] % mesh.shape[a] == 0
+                else None
+                for d, a in enumerate(spec)
+            ]
+            return QTensor(q=NamedSharding(mesh, P(*spec)),
+                           scale=NamedSharding(mesh, P(*sspec)))
         return NamedSharding(mesh, P(*spec))
 
-    return jax.tree_util.tree_map_with_path(place, params)
+    return jax.tree_util.tree_map_with_path(
+        place, params, is_leaf=lambda x: isinstance(x, QTensor))
+
+
+def kv_cache_sharding(cfg, mesh: Mesh, batch: int) -> NamedSharding:
+    """Sharding for the decode KV cache (models/llama.init_cache leaves,
+    [B, C, KV, D]): kv heads over tp — each chip holds only its own
+    heads' K/V, the HBM stream that dominates long-context decode — and
+    batch over the data axes (dcn/dp/fsdp) when it divides.  Axes that
+    do not divide replicate rather than refuse: a 70B model with 8 kv
+    heads on a tp=16 mesh still serves, it just replicates the cache
+    within each 2-chip group.
+
+    The positions dim (C) is deliberately never sharded: every decode
+    step writes one slot at a dynamic position, and a sharded C would
+    turn each write into cross-chip traffic."""
+    tp = mesh.shape.get("tp", 1)
+    data_axes = tuple(a for a in ("dcn", "dp", "fsdp")
+                      if mesh.shape.get(a, 1) > 1)
+    n_data = 1
+    for a in data_axes:
+        n_data *= mesh.shape[a]
+    spec_b = data_axes if data_axes and batch % n_data == 0 else None
+    spec_kv = "tp" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
+    return NamedSharding(mesh, P(spec_b, None, spec_kv, None))
 
 
 def state_sharding(state, mesh: Mesh, param_fn=transformer_param_sharding):
