@@ -599,7 +599,8 @@ def bench_moe(gen: str, cfg=None):
 
 
 def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
-                       int8_weights: bool = False):
+                       int8_weights: bool = False,
+                       int8_kv: bool = False):
     """Autoregressive inference arm: prefill + greedy ring-cache decode on
     the 1B-class GQA llama (models/llama.generate). Reports prefill and
     per-token decode throughput — the compact GQA KV cache is the memory
@@ -607,7 +608,8 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
     opt-out BENCH_DECODE=0). `cfg` override: tests run a tiny config.
     int8_weights: weight-only quantized decode (models/quant.py) — each
     scan step streams int8 weights from HBM, the bandwidth-bound
-    regime's ~2x lever."""
+    regime's ~2x lever.  int8_kv: the int8 KV cache (the other HBM
+    stream, dominant at long context / large batch)."""
     import jax
     import jax.numpy as jnp
 
@@ -633,6 +635,8 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
 
         params = quant.quantize_params(params)
         gen_kw["params_transform"] = quant.make_dequantizer(cfg.dtype)
+    if int8_kv:
+        gen_kw["kv_quant"] = True
 
     def run(n):
         return llm.generate(model, params, prompt, n, **gen_kw)
@@ -664,10 +668,20 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
         leaf.q.size if isinstance(leaf, QTensor) else leaf.size
         for leaf in jax.tree.leaves(
             params, is_leaf=lambda x: isinstance(x, QTensor)))
+    # KV-cache HBM bytes under the same sizing the timed run used: int8
+    # stores head_dim bytes + one f32 scale per (position, head) slot
+    c_len = llm.auto_cache_len(cfg, prompt_len, prompt_len + max_new)
+    per_slot = (cfg.head_dim + 4 if int8_kv
+                else cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+    kv_gb = (2 * cfg.n_layers * batch * c_len * cfg.n_kv_heads
+             * per_slot / 1e9)
     out = {
         "params_b": round(n_params / 1e9, 2),
         "weights": ("int8+scales" if int8_weights else "bf16"),
         "weight_gb": round(weight_gb, 3),
+        "kv_cache": ("int8+scales" if int8_kv
+                     else jnp.dtype(cfg.dtype).name),
+        "kv_cache_gb": round(kv_gb, 4),
         "gqa": f"{cfg.n_heads}q:{cfg.n_kv_heads}kv",
         "batch": batch,
         "prompt_len": prompt_len,
@@ -680,8 +694,7 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
         # how long the generation runs — the SAME sizing policy the
         # timed generate() calls used (llama.auto_cache_len)
         out["window"] = cfg.sliding_window
-        out["cache_len"] = llm.auto_cache_len(
-            cfg, prompt_len, prompt_len + max_new)
+        out["cache_len"] = c_len
         out["full_causal_cache_len"] = llm.auto_cache_len(
             dataclasses.replace(cfg, sliding_window=None),
             prompt_len, prompt_len + max_new)
@@ -1336,6 +1349,18 @@ def main() -> int:
                 extra["llama_decode_int8"] = {
                     "error": f"{type(e).__name__}: {e}"[:300]}
             checkpoint_cache(resnet)
+        if os.environ.get("BENCH_DECODE", "1") == "1" and not _micro():
+            # int8 KV cache: halves the OTHER decode HBM stream — at
+            # long context/large batch the cache, not the weights, is
+            # what the step reads most of
+            progress("llama_decode_int8kv")
+            try:
+                extra["llama_decode_int8kv"] = bench_llama_decode(
+                    gen, int8_kv=True)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["llama_decode_int8kv"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
         if os.environ.get("BENCH_MOE", "1") == "1" and not _micro():
             progress("moe")
             try:
@@ -1378,6 +1403,15 @@ def main() -> int:
             extra["llama_decode"] = {"config": "tiny", "smoke": True, **row}
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["llama_decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        progress("llama_decode_int8kv_smoke")
+        try:
+            row = bench_llama_decode(gen, cfg=llm.tiny(), max_new=8,
+                                     int8_kv=True)
+            extra["llama_decode_int8kv"] = {
+                "config": "tiny", "smoke": True, **row}
+        except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+            extra["llama_decode_int8kv"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
         progress("moe_smoke")
         try:
             row = bench_moe(gen, cfg=llm.tiny(

@@ -10,6 +10,8 @@ with any combination of:
   --int8          weight-only int8 quantized decode (models/quant.py):
                   int8 weights stream from HBM each step — the ~2x
                   lever for bandwidth-bound decode
+  --int8-kv       int8 KV cache (llama.init_cache kv_quant): the other
+                  HBM stream halved; approximate within tested bounds
   --draft-*       exact speculative decoding (models/speculative.py):
                   greedy output is token-identical to plain decoding,
                   temperature sampling is distribution-exact
@@ -89,6 +91,9 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 quantized decode")
+    ap.add_argument("--int8-kv", action="store_true",
+                    help="int8 KV cache (halves the cache HBM stream; "
+                         "output approximate within tested bounds)")
     ap.add_argument("--draft-ckpt-dir", default="",
                     help="draft checkpoint -> speculative decoding")
     ap.add_argument("--draft-layers", type=int, default=0,
@@ -133,6 +138,9 @@ def main(argv=None) -> int:
         params = quant.quantize_params(params)
         gen_kw["params_transform"] = quant.make_dequantizer(cfg.dtype)
         print("weights: int8 + per-channel scales")
+    if args.int8_kv:
+        gen_kw["kv_quant"] = True
+        print("kv cache: int8 + per-head scales")
 
     rng = jax.random.PRNGKey(args.seed)
     speculative = bool(args.draft_ckpt_dir or args.draft_layers)
@@ -161,6 +169,8 @@ def main(argv=None) -> int:
             # long prompts stream into both rings segment by segment
             # (the library validates chunk | cache etc. itself)
             d_kw["prefill_chunk"] = args.prefill_chunk
+        if args.int8_kv:
+            d_kw["kv_quant"] = True
         out, stats = speculative_generate(
             model, params, d_model, d_params, prompt, args.max_new,
             k=args.spec_k, temperature=args.temperature, rng=rng,

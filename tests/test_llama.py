@@ -706,9 +706,9 @@ def test_windowed_default_cache_is_window_sized(monkeypatch):
     sizes = []
     real = llama.init_cache
 
-    def spy(cfg_, batch, cache_len=None, dtype=None):
+    def spy(cfg_, batch, cache_len=None, dtype=None, **kw):
         sizes.append(cache_len)
-        return real(cfg_, batch, cache_len, dtype)
+        return real(cfg_, batch, cache_len, dtype, **kw)
 
     monkeypatch.setattr(llama, "init_cache", spy)
     # total 6+130=136 buckets to 256; window sizing caps at

@@ -246,6 +246,37 @@ def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
 
 
 # ------------------------------------------------------------------ decode
+def _ring_write(buf, val, pos, wrap: bool):
+    """Write val [B, L, ...] into ring buffer buf [B, C, ...] at global
+    position pos (slot = pos % C).  wrap=True takes the per-position
+    scatter path (a multi-position write at an arbitrary offset — the
+    speculative verify — may cross the ring seam); otherwise one
+    contiguous dynamic_update_slice (callers guarantee no wrap:
+    prompt_len <= C / chunk | C)."""
+    c = buf.shape[1]
+    if wrap and val.shape[1] > 1:
+        idx = jnp.mod(pos + jnp.arange(val.shape[1], dtype=jnp.int32), c)
+        return buf.at[:, idx].set(val.astype(buf.dtype),
+                                  unique_indices=True)
+    slot = jnp.mod(pos, c)
+    return jax.lax.dynamic_update_slice(
+        buf, val.astype(buf.dtype), (0, slot) + (0,) * (buf.ndim - 2))
+
+
+def _cache_write(cache_buf, val, pos, wrap: bool):
+    """One K or V cache write; int8 caches (models/quant.QTensor leaves)
+    quantize at the write — per-(position, head) scales over head_dim —
+    so int8 is what lives in and streams from HBM."""
+    from tf_operator_tpu.models.quant import QTensor, quantize_tensor
+
+    if isinstance(cache_buf, QTensor):
+        qv = quantize_tensor(val, axes=(3,))  # [B,L,KV,D]: scale [B,L,KV,1]
+        return QTensor(
+            q=_ring_write(cache_buf.q, qv.q, pos, wrap),
+            scale=_ring_write(cache_buf.scale, qv.scale, pos, wrap))
+    return _ring_write(cache_buf, val, pos, wrap)
+
+
 def _cached_attention(q, k_cache, v_cache, q_pos, cache_len: int,
                       window=None):
     """Decode-mode attention: q [B,L,H,D] (the L new positions, already
@@ -259,7 +290,17 @@ def _cached_attention(q, k_cache, v_cache, q_pos, cache_len: int,
     (O(window) decode memory/FLOPs — the Mistral cache layout). Slot
     j's last-written global position is q_pos - ((q_pos - j) mod C);
     that one formula also covers the linear case (C >= every position):
-    unwritten slots resolve to negative positions and mask out."""
+    unwritten slots resolve to negative positions and mask out.
+
+    int8 caches (QTensor) dequantize AT THE READ: the convert + scale
+    multiply are elementwise producers of the score/value einsums and
+    fuse into them, so the int8 payload is what streams from HBM — the
+    bandwidth-bound decode step's other ~2x lever beside int8 weights."""
+    from tf_operator_tpu.models.quant import QTensor
+
+    if isinstance(k_cache, QTensor):
+        k_cache = k_cache.dequantize(q.dtype)
+        v_cache = v_cache.dequantize(q.dtype)
     b, l, h, d = q.shape
     kv_heads = k_cache.shape[2]
     group = h // kv_heads
@@ -312,28 +353,8 @@ class GqaAttention(nn.Module):
         if cache is not None:
             k_cache, v_cache = cache
             l = x.shape[1]
-            if wrap_write and l > 1:
-                # multi-position write at an arbitrary ring offset (the
-                # speculative verify: k+1 positions from wherever the
-                # last round stopped) — per-position scatter, allowed to
-                # wrap.  Small-L only: contiguous bulk writes (prefill)
-                # keep the cheaper slice path below.
-                idx = jnp.mod(pos + jnp.arange(l, dtype=jnp.int32),
-                              k_cache.shape[1])
-                k_cache = k_cache.at[:, idx].set(
-                    k.astype(k_cache.dtype), unique_indices=True)
-                v_cache = v_cache.at[:, idx].set(
-                    v.astype(v_cache.dtype), unique_indices=True)
-            else:
-                # ring-buffer write: global position p -> slot p % C.
-                # Callers guarantee this write never wraps (generate
-                # enforces prompt_len <= C / chunk | C), so one
-                # contiguous slice suffices.
-                slot = jnp.mod(pos, k_cache.shape[1])
-                k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0))
-                v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0))
+            k_cache = _cache_write(k_cache, k, pos, wrap_write)
+            v_cache = _cache_write(v_cache, v, pos, wrap_write)
             q_pos = pos + jnp.arange(l, dtype=jnp.int32)
             out = _cached_attention(q, k_cache, v_cache, q_pos,
                                     k_cache.shape[1],
@@ -542,19 +563,37 @@ class Llama(nn.Module):
 
 # ---------------------------------------------------------------- generate
 def init_cache(cfg: LlamaConfig, batch: int, cache_len: Optional[int] = None,
-               dtype=None):
+               dtype=None, kv_quant: bool = False):
     """Per-layer (k, v) caches [B, C, KV, D] — COMPACT kv heads: for 4:1
     GQA the cache is 4x smaller than an MHA cache, which is the point of
     GQA at inference (HBM capacity bounds batch x context).
     C is capped at cfg.max_len: the RoPE table has max_len rows, so a
-    longer cache would silently decode with clamped (repeated) rotations."""
+    longer cache would silently decode with clamped (repeated) rotations.
+
+    kv_quant: int8 cache — each leaf is a QTensor(int8 [B,C,KV,D],
+    f32 scale [B,C,KV,1]); K/V quantize at the write with
+    per-(position, head) scales and dequantize fused into the attention
+    read.  Halves the cache's HBM bytes, which at long context / large
+    batch is the decode step's dominant stream."""
     c = cache_len or cfg.max_len
     if c > cfg.max_len:
         raise ValueError(
             f"cache_len {c} exceeds cfg.max_len {cfg.max_len} (the RoPE "
             f"table bound — raise max_len/rope_theta for longer contexts)")
-    dt = dtype or cfg.dtype
     shape = (batch, c, cfg.n_kv_heads, cfg.head_dim)
+    if kv_quant:
+        if dtype is not None:
+            raise ValueError(
+                "kv_quant and dtype are mutually exclusive: the int8 "
+                "cache's layout is fixed (int8 payload + f32 scales)")
+        from tf_operator_tpu.models.quant import QTensor
+
+        def leaf():
+            return QTensor(q=jnp.zeros(shape, jnp.int8),
+                           scale=jnp.ones(shape[:3] + (1,), jnp.float32))
+
+        return [(leaf(), leaf()) for _ in range(cfg.n_layers)]
+    dt = dtype or cfg.dtype
     return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
             for _ in range(cfg.n_layers)]
 
@@ -713,7 +752,7 @@ def generate(model, params, prompt, max_new_tokens: int,
              cache_len: Optional[int] = None,
              params_transform=None,
              prefill_chunk: Optional[int] = None,
-             cache_sharding=None):
+             cache_sharding=None, kv_quant: bool = False):
     """Autoregressive decoding: one prefill pass over the prompt (all
     positions in one MXU-friendly call), then `max_new_tokens` single-
     token steps through a `lax.scan` — static shapes; prefill and the
@@ -741,6 +780,11 @@ def generate(model, params, prompt, max_new_tokens: int,
     as one GSPMD program with each chip holding only its own heads'
     K/V and weights.  Composes with params_transform (sharded QTensor
     leaves) and prefill_chunk.
+
+    kv_quant: int8 KV cache (init_cache kv_quant) — K/V quantize at the
+    cache write, dequant fuses into the attention read; halves the
+    cache's HBM stream.  Output is APPROXIMATE (per-head int8 error),
+    unlike every other option here; bounds in tests/test_kv_quant.py.
 
     prefill_chunk (optional): prefill the prompt in segments of this
     size instead of one pass — bounds prefill attention activations to
@@ -809,8 +853,11 @@ def generate(model, params, prompt, max_new_tokens: int,
     # (full-causal models cannot stream past their cache — the
     # sliding_window-is-None total>cache_len check above already refuses;
     # chunking bounds activations, not visibility)
-    cache = init_cache(cfg, b, cache_len)
+    cache = init_cache(cfg, b, cache_len, kv_quant=kv_quant)
     if cache_sharding is not None:
+        # a single NamedSharding broadcasts over every leaf; the int8
+        # cache's scale [B, C, KV, 1] takes the same spec (its sharded
+        # dims match, the trailing 1 is never sharded)
         cache = jax.device_put(cache, cache_sharding)
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")

@@ -266,6 +266,7 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
                          draft_cache_len: Optional[int] = None,
                          target_transform=None, draft_transform=None,
                          prefill_chunk: Optional[int] = None,
+                         kv_quant: bool = False,
                          return_stats: bool = False):
     """Speculative decoding: [B, max_new_tokens] tokens produced in
     ~(accepted+1)-token chunks per target forward.  temperature 0 =
@@ -292,6 +293,11 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     long-prompt path: a prompt longer than a windowed model's ring
     prefills through it chunk by chunk, llama.generate's contract; the
     chunk must divide both cache lengths).
+
+    kv_quant: int8 KV caches for BOTH models (llama.init_cache
+    kv_quant).  Greedy output stays token-identical to
+    generate(..., kv_quant=True) — the exactness contract is relative
+    to the target decoding over the same cache representation.
 
     return_stats: also return {"target_forwards": int} — the speedup
     witness (plain decode needs max_new_tokens forwards)."""
@@ -335,8 +341,8 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
         raise ValueError("sampling (temperature > 0) needs an rng")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     k_first, k_loop = jax.random.split(rng)
-    t_cache = init_cache(target.cfg, b, c_t)
-    d_cache = init_cache(draft.cfg, b, c_d)
+    t_cache = init_cache(target.cfg, b, c_t, kv_quant=kv_quant)
+    d_cache = init_cache(draft.cfg, b, c_d, kv_quant=kv_quant)
 
     prefill, spec_loop = _spec_fns(target, draft, int(k),
                                    float(temperature),
