@@ -701,6 +701,66 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
     return out
 
 
+def bench_serve_loop(gen: str, cfg=None, n_requests: int = 8,
+                     slots: int = 2, max_new: int = 32):
+    """Continuous-batching arm (models/serving.serve_loop): ragged
+    requests through a fixed set of decode lanes with slot admission,
+    vs serving the same requests one-by-one (batch-1 generate) — the
+    lane-sharing throughput win is the quantity (slots minus admission
+    overhead, diluted by prefill).  Exactness is pinned by
+    tests/test_serving.py; this row measures."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama as llm
+    from tf_operator_tpu.models.serving import serve_loop
+
+    if cfg is None:
+        cfg = _llama_1b_cfg()
+    model = llm.Llama(cfg)
+    key = jax.random.PRNGKey(0)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        model.init(key, toks, train=False)["params"])
+    lengths = [(17 * (i + 3)) % 48 + 8 for i in range(n_requests)]
+    prompts = []
+    for n in lengths:
+        key, k = jax.random.split(key)
+        prompts.append(jax.random.randint(k, (n,), 0, cfg.vocab_size))
+
+    # warm both paths' compiles out of the timing — the full request set
+    # (every distinct prompt length owns a prefill compile)
+    serve_loop(model, params, prompts, slots=slots,
+               max_new_tokens=max_new)
+    t0 = time.perf_counter()
+    res = serve_loop(model, params, prompts, slots=slots,
+                     max_new_tokens=max_new)
+    t_serve = time.perf_counter() - t0
+    n_tokens = sum(len(r.tokens) for r in res)
+    # sequential baseline: one request at a time, batch 1 (compiles per
+    # distinct prompt length are warm after the first loop — time the
+    # second)
+    for p in prompts:
+        jax.block_until_ready(llm.generate(model, params, p[None],
+                                           max_new))
+    t0 = time.perf_counter()
+    for p in prompts:
+        jax.block_until_ready(llm.generate(model, params, p[None],
+                                           max_new))
+    t_seq = time.perf_counter() - t0
+    return {
+        "requests": n_requests,
+        "slots": slots,
+        "prompt_lens": f"{min(lengths)}..{max(lengths)}",
+        "new_tokens_per_request": max_new,
+        "tokens_per_sec": round(n_tokens / t_serve, 1),
+        "sequential_tokens_per_sec": round(
+            n_requests * max_new / t_seq, 1),
+        "speedup_vs_sequential": round(t_seq / t_serve, 2),
+    }
+
+
 def _parity(f_out, f_grads, r_out, r_grads):
     """(fwd_rel, grad_max_rel, ok) between two (loss, grads) pairs."""
     import jax
@@ -1376,6 +1436,14 @@ def main() -> int:
                 extra["speculative"] = {
                     "error": f"{type(e).__name__}: {e}"[:300]}
             checkpoint_cache(resnet)
+        if os.environ.get("BENCH_SERVE", "1") == "1" and not _micro():
+            progress("serve_loop")
+            try:
+                extra["serve_loop"] = bench_serve_loop(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["serve_loop"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
     else:
         # no chip: the pallas kernel still runs (interpret mode) so the
         # flash arm's correctness witness lands in the artifact
@@ -1427,6 +1495,14 @@ def main() -> int:
             extra["speculative"] = {"config": "tiny", "smoke": True, **row}
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["speculative"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        progress("serve_loop_smoke")
+        try:
+            row = bench_serve_loop(
+                gen, cfg=llm.tiny(dtype=jnp.float32, max_len=128),
+                n_requests=4, slots=2, max_new=8)
+            extra["serve_loop"] = {"config": "tiny", "smoke": True, **row}
+        except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+            extra["serve_loop"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     # both rows per operator bench: the in-memory store and the ClusterClient
     # + REST façade path (serialization, watch dispatch, conflict retries in

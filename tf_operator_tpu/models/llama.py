@@ -252,8 +252,18 @@ def _ring_write(buf, val, pos, wrap: bool):
     scatter path (a multi-position write at an arbitrary offset — the
     speculative verify — may cross the ring seam); otherwise one
     contiguous dynamic_update_slice (callers guarantee no wrap:
-    prompt_len <= C / chunk | C)."""
+    prompt_len <= C / chunk | C).  A VECTOR pos [B] writes each row at
+    its own position (continuous batching: every slot decodes at its
+    own length; single-token steps only)."""
     c = buf.shape[1]
+    if getattr(pos, "ndim", 0) == 1:
+        if val.shape[1] != 1:
+            raise ValueError(
+                f"per-row positions support single-token writes only, "
+                f"got L={val.shape[1]}")
+        rows = jnp.arange(buf.shape[0])
+        return buf.at[rows, jnp.mod(pos, c)].set(
+            val[:, 0].astype(buf.dtype), unique_indices=True)
     if wrap and val.shape[1] > 1:
         idx = jnp.mod(pos + jnp.arange(val.shape[1], dtype=jnp.int32), c)
         return buf.at[:, idx].set(val.astype(buf.dtype),
@@ -309,13 +319,17 @@ def _cached_attention(q, k_cache, v_cache, q_pos, cache_len: int,
         "blhgd,bchd->bhglc", qg, k_cache, preferred_element_type=jnp.float32
     ) / (d ** 0.5)
     slot = jnp.arange(cache_len, dtype=jnp.int32)
-    k_global = q_pos[:, None] - jnp.mod(
-        q_pos[:, None] - slot[None, :], cache_len)            # [L, C]
+    # q_pos [L] (lockstep batch) or [B, L] (per-row positions —
+    # continuous batching, every slot at its own length)
+    k_global = q_pos[..., None] - jnp.mod(
+        q_pos[..., None] - slot, cache_len)          # [L, C] or [B, L, C]
     mask = k_global >= 0  # written (and causal: k_global <= q_pos always)
     if window is not None:
         # sliding band: slots older than window-1 steps are invisible
-        mask &= k_global > q_pos[:, None] - window
-    s = jnp.where(mask[None, None, None], s, jnp.finfo(jnp.float32).min)
+        mask &= k_global > q_pos[..., None] - window
+    mask = (mask[None, None, None] if q_pos.ndim == 1
+            else mask[:, None, None])                # -> [B?,1,1,L,C]
+    s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
         "bhglc,bchd->blhgd", p.astype(v_cache.dtype), v_cache,
@@ -355,7 +369,9 @@ class GqaAttention(nn.Module):
             l = x.shape[1]
             k_cache = _cache_write(k_cache, k, pos, wrap_write)
             v_cache = _cache_write(v_cache, v, pos, wrap_write)
-            q_pos = pos + jnp.arange(l, dtype=jnp.int32)
+            steps = jnp.arange(l, dtype=jnp.int32)
+            q_pos = (pos[:, None] + steps
+                     if getattr(pos, "ndim", 0) == 1 else pos + steps)
             out = _cached_attention(q, k_cache, v_cache, q_pos,
                                     k_cache.shape[1],
                                     window=cfg.sliding_window)
@@ -527,9 +543,14 @@ class Llama(nn.Module):
         decode = cache is not None
         if decode:
             # cache: per-layer (k, v) tuples (init_cache); cache_pos is the
-            # global position of tokens[:, 0] — rotation follows it
-            angles = jax.lax.dynamic_slice_in_dim(
-                table, cache_pos, tokens.shape[1])
+            # global position of tokens[:, 0] — rotation follows it.  A
+            # VECTOR cache_pos [B] gives each row its own position
+            # (continuous batching; single-token steps only)
+            if getattr(cache_pos, "ndim", 0) == 1:
+                angles = table[cache_pos][:, None, :]  # [B, 1, D/2]
+            else:
+                angles = jax.lax.dynamic_slice_in_dim(
+                    table, cache_pos, tokens.shape[1])
         elif positions is None:
             angles = table[: tokens.shape[1]]  # [S, D/2]
         else:
@@ -866,26 +887,32 @@ def generate(model, params, prompt, max_new_tokens: int,
 
     decode, chunk_fill, chunk_write = _decode_fns(
         model, temperature, top_k, top_p, eos, params_transform)
-    if prefill_chunk is not None:
-        starts = list(range(0, prompt_len, prefill_chunk))
-        for i in starts[:-1]:
-            # intermediate chunks only feed the cache (no lm_head)
-            cache = chunk_write(
-                params, cache, prompt[:, i:i + prefill_chunk],
-                jnp.int32(i))
-        last = starts[-1]
-        last_logits, cache = chunk_fill(
-            params, cache, prompt[:, last:last + prefill_chunk],
-            jnp.int32(last))
-    else:
-        last_logits, cache = chunk_fill(params, cache, prompt,
-                                        jnp.int32(0))
+    last_logits, cache = stream_prefill(chunk_fill, chunk_write, params,
+                                        cache, prompt, prefill_chunk)
     first = _select_token(last_logits, temperature, k_first, top_k, top_p)
     if max_new_tokens == 1:
         return first[:, None]
     rest = decode(params, cache, first, jnp.int32(prompt_len), k_rest,
                   max_new_tokens - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def stream_prefill(chunk_fill, chunk_write, params, cache, prompt,
+                   prefill_chunk: Optional[int]):
+    """The one streaming-prefill loop (generate + serving.serve_loop):
+    intermediate segments feed only the cache (chunk_write skips the
+    lm_head), the final segment returns its last-position logits.
+    prefill_chunk None = one-pass prefill.  Callers validate sizing
+    (check_prefill_chunk) before getting here."""
+    if prefill_chunk is None:
+        return chunk_fill(params, cache, prompt, jnp.int32(0))
+    starts = list(range(0, prompt.shape[1], prefill_chunk))
+    for i in starts[:-1]:
+        cache = chunk_write(params, cache,
+                            prompt[:, i:i + prefill_chunk], jnp.int32(i))
+    last = starts[-1]
+    return chunk_fill(params, cache, prompt[:, last:last + prefill_chunk],
+                      jnp.int32(last))
 
 
 def _select_token(logits, temperature: float, key, top_k: int = 0,

@@ -1,0 +1,275 @@
+"""Continuous batching — a slot-based serving loop (vLLM-class admission
+for TPU's static-shape world).
+
+Static shapes are non-negotiable under jit, so the loop holds a FIXED
+batch of `slots` decode lanes and changes which *request* occupies each
+lane: a row that emits EOS (or hits its token budget) frees its slot,
+and a queued request prefills into that slot while every other row keeps
+decoding — no global drain/refill barrier, which is where naive batched
+serving loses its throughput (one long request pins the whole batch).
+
+TPU-first mechanics:
+  - every slot decodes at ITS OWN position: one jitted single-token step
+    over [B, 1] tokens with a vector cache_pos [B] (per-row RoPE, ring
+    write, and visibility mask — models/llama.py grew the per-row path
+    for exactly this).  The step compiles ONCE and is reused for the
+    whole serve lifetime; admission never retraces it.
+  - prefill runs OFF the batch: a single-row cache is filled by
+    llama.generate's own jitted chunk writers (shared compile cache),
+    then inserted into the batch cache with one scatter per leaf.  Other
+    slots' decoding is not recomputed or re-traced by an admission.
+  - slot reuse needs NO cache scrubbing: the position mask derives a
+    slot's validity from the query position, and a fresh request at
+    position q overwrites ring slot q % C exactly when q first becomes
+    visible — the previous occupant's K/V can never leak (the same
+    argument that gives speculative rollback for free).
+  - frozen rows (free slots / finished requests) keep stepping with
+    their position pinned: the wasted lane work is the price of static
+    shapes, bounded by slots, and their repeated same-slot write is
+    harmless.
+
+Exactness: greedy outputs per request are token-identical to an
+isolated llama.generate call (tests/test_serving.py) — batching and
+admission order change throughput only.  Composes with kv_quant (int8
+caches insert through the same tree scatter) and sliding-window rings.
+
+No reference counterpart (the reference has no serving code at all,
+SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import deque
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models import llama as _llama
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """Per-request outcome: the emitted tokens (EOS included when hit)
+    and scheduling metadata for observability."""
+
+    tokens: List[int]
+    admitted_at_step: int
+    finished_at_step: int
+    slot: int
+
+
+@functools.lru_cache(maxsize=8)
+def _serve_fns(model, temperature: float, top_k: int, top_p: float,
+               params_transform=None):
+    """Jitted (step, insert_row) shared across serve_loop calls (lru by
+    model identity, like llama._decode_fns)."""
+    xform = params_transform or (lambda p: p)
+
+    @functools.partial(jax.jit, donate_argnums=(1,), static_argnums=(6,))
+    def step(params, cache, tok, pos, frozen, key, n_steps: int):
+        """A BLOCK of n_steps single-token decode steps for every slot,
+        each at its own position, as one on-device lax.scan — the host
+        syncs (EOS detection, admission) once per block instead of once
+        per token.  Frozen rows emit their token unchanged and do not
+        advance (their repeated same-slot cache write is harmless); a
+        row that hits EOS mid-block keeps computing to the block edge
+        and the host discards the overshoot."""
+        def body(carry, k):
+            cache, tok, pos = carry
+            logits, cache = model.apply(
+                {"params": xform(params)}, tok[:, None], cache=cache,
+                cache_pos=pos)
+            nxt = _llama._select_token(logits[:, 0], temperature, k,
+                                       top_k, top_p)
+            nxt = jnp.where(frozen, tok, nxt)
+            pos = jnp.where(frozen, pos, pos + 1)
+            return (cache, nxt, pos), nxt
+
+        (cache, tok, pos), toks = jax.lax.scan(
+            body, (cache, tok, pos), jax.random.split(key, n_steps))
+        return cache, tok, pos, toks  # toks [n_steps, B]
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def insert_row(cache, row_cache, slot):
+        """Scatter a prefilled single-row cache into batch lane `slot`
+        (QTensor leaves flatten to arrays, so one tree_map covers bf16
+        and int8 caches alike).  slot is traced — one compile serves
+        every lane."""
+        return jax.tree.map(lambda b, r: b.at[slot].set(r[0]),
+                            cache, row_cache)
+
+    return step, insert_row
+
+
+def serve_loop(model, params, requests: Sequence[Any], *,
+               slots: int = 4, max_new_tokens: int = 64,
+               eos_id: Optional[int] = None,
+               cache_len: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0, rng=None,
+               params_transform=None, prefill_chunk: Optional[int] = None,
+               kv_quant: bool = False,
+               steps_per_sync: int = 8) -> List[ServeResult]:
+    """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
+    with continuous admission; returns a ServeResult per request, in
+    request order.
+
+    cache_len: per-slot KV slots (default: a 128-bucket of the worst
+    case, prompt+new, via llama.auto_cache_len on the longest prompt;
+    sliding-window models get their O(window) ring).  Every option
+    mirrors llama.generate: sampling (temperature/top_k/top_p + rng),
+    params_transform (int8 weights), prefill_chunk (long prompts stream
+    into the single-row cache before insertion), kv_quant (int8 KV).
+
+    steps_per_sync: decode-block size — the device runs this many
+    single-token steps as one lax.scan between host syncs, so EOS
+    detection and admission happen once per block instead of once per
+    token (the dispatch+transfer amortization every serving loop needs;
+    worst-case cost is steps_per_sync-1 discarded lane-steps after an
+    EOS and the same bound on admission latency — tokens are unchanged).
+
+    Greedy outputs are token-identical to per-request llama.generate
+    calls; sampling draws its keys from the serve loop's own stream (the
+    procedure, not the key path, matches)."""
+    cfg = model.cfg
+    reqs = [jnp.asarray(r, jnp.int32).reshape(-1) for r in requests]
+    if not reqs:
+        return []
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if steps_per_sync < 1:
+        raise ValueError(
+            f"steps_per_sync must be >= 1, got {steps_per_sync}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng")
+    # generate()'s own range checks — an out-of-range eos_id can never
+    # match a token, which would silently disable early stopping
+    if top_k < 0 or top_k > cfg.vocab_size:
+        raise ValueError(
+            f"top_k must be in [0, vocab_size={cfg.vocab_size}], "
+            f"got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    if eos_id is not None and not 0 <= int(eos_id) < cfg.vocab_size:
+        raise ValueError(
+            f"eos_id {eos_id} out of range for vocab_size "
+            f"{cfg.vocab_size}")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    eos = -1 if eos_id is None else int(eos_id)
+    longest = max(r.shape[0] for r in reqs)
+    for i, r in enumerate(reqs):
+        if r.shape[0] < 1:
+            raise ValueError(f"request {i} is empty")
+        if r.shape[0] + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"request {i}: prompt {r.shape[0]} + new "
+                f"{max_new_tokens} exceeds max_len {cfg.max_len}")
+    if cache_len is None:
+        cache_len = _llama.auto_cache_len(
+            cfg, longest, longest + max_new_tokens, prefill_chunk)
+    # generate()'s visibility rules, per lane: a full-causal model must
+    # hold its longest request's whole sequence (the ring must never
+    # wrap); a windowed one needs at least the window resident
+    worst = longest + max_new_tokens
+    if cfg.sliding_window is None and worst > cache_len:
+        raise ValueError(
+            f"longest prompt {longest} + new {max_new_tokens} exceeds "
+            f"cache length {cache_len} — a full-causal model cannot "
+            f"stream past its cache")
+    if (cfg.sliding_window is not None
+            and cache_len < min(cfg.sliding_window, worst)):
+        raise ValueError(
+            f"cache_len {cache_len} < sliding window "
+            f"{min(cfg.sliding_window, worst)} — visible positions "
+            f"would be overwritten")
+
+    # jitted pieces: the batch step (compiled once), the row inserter,
+    # and llama.generate's own chunk writers for off-batch prefill
+    step, insert_row = _serve_fns(model, float(temperature), int(top_k),
+                                  float(top_p), params_transform)
+    _, chunk_fill, chunk_write = _llama._decode_fns(
+        model, 0.0, 0, 0.0, -1, params_transform)
+
+    def prefill_row(prompt):
+        """Fill a fresh single-row cache with `prompt`; returns (last
+        logits, row cache).  Long prompts stream via prefill_chunk —
+        llama.generate's validation rules apply (chunk | cache etc.)."""
+        p_len = prompt.shape[0]
+        chunk = prefill_chunk
+        if chunk is not None and chunk >= p_len:
+            chunk = None
+        if chunk is None and p_len > cache_len:
+            raise ValueError(
+                f"prompt {p_len} exceeds cache_len {cache_len}; pass "
+                f"prefill_chunk to stream it")
+        if chunk is not None:
+            _llama.check_prefill_chunk(
+                chunk, cache_len, cfg.sliding_window,
+                streams_past_cache=True)
+        row = _llama.init_cache(cfg, 1, cache_len, kv_quant=kv_quant)
+        return _llama.stream_prefill(chunk_fill, chunk_write, params,
+                                     row, prompt[None, :], chunk)
+
+    # slot state: cache/tok/pos live on device; occupancy bookkeeping
+    # (owner, frozen, emitted) lives on the host — the loop reads tokens
+    # back once per step anyway (it must, to detect EOS)
+    cache = _llama.init_cache(cfg, slots, cache_len, kv_quant=kv_quant)
+    tok = jnp.zeros((slots,), jnp.int32)
+    pos = jnp.zeros((slots,), jnp.int32)
+    frozen_py = [True] * slots
+    owner = [None] * slots          # request index occupying each lane
+    emitted: List[List[int]] = [[] for _ in range(slots)]
+    results: List[Optional[ServeResult]] = [None] * len(reqs)
+    admitted_step = [0] * slots
+    queue = deque(range(len(reqs)))
+    n_step = 0
+
+    def finish(s):
+        frozen_py[s] = True
+        results[owner[s]] = ServeResult(
+            tokens=emitted[s], admitted_at_step=admitted_step[s],
+            finished_at_step=n_step, slot=s)
+        owner[s] = None
+
+    while queue or any(o is not None for o in owner):
+        # ---- admission: every free lane takes the next queued request
+        for s in range(slots):
+            if owner[s] is not None or not queue:
+                continue
+            ridx = queue.popleft()
+            rng, k_first = jax.random.split(rng)
+            last_logits, row = prefill_row(reqs[ridx])
+            cache = insert_row(cache, row, jnp.int32(s))
+            first = int(_llama._select_token(
+                last_logits, temperature, k_first, top_k, top_p)[0])
+            owner[s] = ridx
+            admitted_step[s] = n_step
+            emitted[s] = [first]
+            tok = tok.at[s].set(first)
+            pos = pos.at[s].set(reqs[ridx].shape[0])
+            frozen_py[s] = False
+            if first == eos or max_new_tokens == 1:
+                finish(s)
+        if all(o is None for o in owner):
+            continue  # all lanes finished instantly; admit more
+        # ---- one decode BLOCK for every lane, each at its own position
+        rng, k_step = jax.random.split(rng)
+        cache, tok, pos, toks = step(params, cache, tok, pos,
+                                     jnp.asarray(frozen_py), k_step,
+                                     steps_per_sync)
+        block = jax.device_get(toks)  # [steps_per_sync, B]
+        for i in range(steps_per_sync):
+            n_step += 1
+            for s in range(slots):
+                if owner[s] is None or frozen_py[s]:
+                    continue
+                t = int(block[i, s])
+                emitted[s].append(t)
+                if t == eos or len(emitted[s]) >= max_new_tokens:
+                    finish(s)  # later in-block tokens are overshoot
+    return results  # type: ignore[return-value]
