@@ -542,13 +542,36 @@ def _mixtral_1b_cfg(**kw):
         **kw)
 
 
-def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4):
-    """Speculative-decoding witness: greedy self-draft generation (the
-    acceptance machinery at its best case) — reports target forwards vs
-    the max_new a plain decode would need, and verifies the output
-    equals plain greedy decode (the exactness contract).  Forward count
-    is the honest metric on any platform; wall-clock gains additionally
-    need a cheaper draft model than the target."""
+def _early_exit_draft_params(params, n_draft_layers: int):
+    """The draft is the TARGET'S OWN first n layers (shared embedding,
+    first blocks, final norm, lm_head) — early-exit / self-speculative
+    drafting: no second checkpoint needed, and the draft correlates with
+    the target by construction instead of by luck."""
+    out = {}
+    for name, sub in params.items():
+        if name.startswith("block"):
+            if int(name[len("block"):]) < n_draft_layers:
+                out[name] = sub
+        else:
+            out[name] = sub
+    return out
+
+
+def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4,
+                      ks=(2, 4, 8)):
+    """Speculative decoding, two sections:
+
+    self_draft_witness — draft == target, so acceptance is identically 1
+    and the forward count is the ARITHMETIC best case (~max_new/(k+1)).
+    A plumbing/exactness witness, NOT a performance measurement.
+
+    early_exit_draft — a REAL cheaper draft (the target's own first
+    quarter of layers, early-exit style) swept over k: measured
+    acceptance rate (< 1), tokens per target forward at that acceptance,
+    and WALL-CLOCK tokens/sec for speculative vs plain decode — on TPU
+    the wall-clock column is the performance claim; on CPU smoke rows it
+    mostly reflects dispatch overhead and the acceptance/forward columns
+    are the honest signal."""
     import jax
     import jax.numpy as jnp
 
@@ -565,20 +588,95 @@ def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4):
         lambda x: x.astype(cfg.dtype),  # honor the config (f32 smokes)
         model.init(rng, prompt, train=False)["params"],
     )
+
+    # plain decode: the baseline for exactness AND wall-clock
     plain = llm.generate(model, params, prompt, max_new)
+    jax.block_until_ready(plain)
+    t0 = time.perf_counter()
+    jax.block_until_ready(llm.generate(model, params, prompt, max_new))
+    t_plain = time.perf_counter() - t0
+    b = prompt.shape[0]
+
     out, stats = speculative_generate(
         model, params, model, params, prompt, max_new, k=k,
         return_stats=True)
     exact = bool((jnp.asarray(out) == jnp.asarray(plain)).all())
-    return {
-        "mode": "self-draft greedy",
-        "k": k,
-        "new_tokens": max_new,
-        "target_forwards": stats["target_forwards"],
-        "plain_decode_forwards": max_new,
-        "forward_reduction": round(max_new / stats["target_forwards"], 2),
-        "output_equals_plain_greedy": exact,
+    result = {
+        "plain_decode_tokens_per_sec": round(b * max_new / t_plain, 1),
+        "self_draft_witness": {
+            "note": "best-case plumbing witness (acceptance == 1 by "
+                    "construction); not a performance measurement",
+            "k": k,
+            "new_tokens": max_new,
+            "target_forwards": stats["target_forwards"],
+            # both paths get token 1 from the prefill; plain decode
+            # then needs one forward per remaining token
+            "plain_decode_forwards": max_new - 1,
+            "best_case_forward_reduction": round(
+                (max_new - 1) / stats["target_forwards"], 2),
+            "output_equals_plain_greedy": exact,
+        },
     }
+
+    def k_sweep(draft, d_params, **d_kw):
+        sweep = {}
+        for kk in ks:
+            # warm this k's compiles (draft scan + verify widths are
+            # k-specific), then time
+            o, st = speculative_generate(
+                model, params, draft, d_params, prompt, max_new, k=kk,
+                return_stats=True, **d_kw)
+            jax.block_until_ready(o)
+            t0 = time.perf_counter()
+            o2, st = speculative_generate(
+                model, params, draft, d_params, prompt, max_new, k=kk,
+                return_stats=True, **d_kw)
+            jax.block_until_ready(o2)
+            t_spec = time.perf_counter() - t0
+            n_fwd = st["target_forwards"]
+            # the FIRST token comes from the prefill (not counted in
+            # target_forwards), so verify rounds emit max_new - 1
+            # tokens, each round (accepted + 1): accepted draft tokens
+            # = (max_new - 1) - rounds; proposals = k * rounds
+            acc = max(0, max_new - 1 - n_fwd) / max(1, kk * n_fwd)
+            sweep[f"k{kk}"] = {
+                "acceptance_rate": round(acc, 3),
+                "target_forwards": n_fwd,
+                "tokens_per_target_forward": round(
+                    (max_new - 1) / n_fwd, 2),
+                "tokens_per_sec": round(b * max_new / t_spec, 1),
+                "speedup_vs_plain": round(t_plain / t_spec, 2),
+                "exact": bool(
+                    (jnp.asarray(o2) == jnp.asarray(plain)).all()),
+            }
+        return sweep
+
+    # (a) early-exit draft: the target's own first quarter of layers —
+    # cheap by depth; acceptance is whatever the truncation earns (low
+    # on random weights, high on trained checkpoints)
+    n_draft = max(1, cfg.n_layers // 4)
+    draft = llm.Llama(dataclasses.replace(cfg, n_layers=n_draft))
+    result["early_exit_draft"] = {
+        "draft_layers": n_draft,
+        "target_layers": cfg.n_layers,
+        "new_tokens": max_new,
+        "sweep": k_sweep(draft, _early_exit_draft_params(params, n_draft)),
+    }
+
+    # (b) int8 draft: the FULL target, weight-only quantized — cheap by
+    # bytes (the decode cost axis on TPU), and high-acceptance by
+    # construction because int8 logits track full precision; the
+    # realistic-acceptance arm without needing a trained checkpoint
+    from tf_operator_tpu.models import quant
+
+    q_draft = quant.quantize_params(params)
+    result["int8_draft"] = {
+        "draft": "full target, weight-only int8",
+        "new_tokens": max_new,
+        "sweep": k_sweep(model, q_draft,
+                         draft_transform=quant.make_dequantizer(cfg.dtype)),
+    }
+    return result
 
 
 def bench_moe(gen: str, cfg=None):
@@ -721,7 +819,7 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 8,
     key = jax.random.PRNGKey(0)
     toks = jnp.zeros((1, 8), jnp.int32)
     params = jax.tree.map(
-        lambda x: x.astype(jnp.bfloat16),
+        lambda x: x.astype(cfg.dtype),  # honor the config (f32 smokes)
         model.init(key, toks, train=False)["params"])
     lengths = [(17 * (i + 3)) % 48 + 8 for i in range(n_requests)]
     prompts = []
@@ -1561,7 +1659,8 @@ def main() -> int:
 # matched reports "ok"/"err" — presence is still a witness.
 _HEADLINE_KEYS = (
     "img_per_sec_per_chip", "tokens_per_sec_per_chip",
-    "decode_tokens_per_sec", "tokens_per_target_forward", "speedup",
+    "decode_tokens_per_sec", "plain_decode_tokens_per_sec",
+    "tokens_per_target_forward", "tokens_per_sec", "speedup",
     "jobs_per_sec", "p50_ms", "batches_per_sec", "tflops_per_sec",
 )
 

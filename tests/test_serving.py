@@ -202,3 +202,11 @@ def test_validation():
     with pytest.raises(ValueError, match="visible positions"):
         serve_loop(wmodel, wparams, _prompts(wcfg, [10]), cache_len=16,
                    max_new_tokens=40)  # ring smaller than the window
+    with pytest.raises(ValueError, match="prefill_chunk must be"):
+        serve_loop(model, params, p, prefill_chunk=0, max_new_tokens=4)
+    # a LATER request's infeasible prompt must fail before ANY request
+    # decodes, not mid-serve after request 0 completed
+    wcfg2, wmodel2, wparams2 = _setup(max_len=512, sliding_window=8)
+    with pytest.raises(ValueError, match="request 1: prompt 40"):
+        serve_loop(wmodel2, wparams2, _prompts(wcfg2, [10, 40]),
+                   cache_len=16, max_new_tokens=4, slots=1)

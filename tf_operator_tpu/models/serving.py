@@ -161,6 +161,9 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             f"{cfg.vocab_size}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     eos = -1 if eos_id is None else int(eos_id)
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(
+            f"prefill_chunk must be >= 1, got {prefill_chunk}")
     longest = max(r.shape[0] for r in reqs)
     for i, r in enumerate(reqs):
         if r.shape[0] < 1:
@@ -188,6 +191,27 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             f"{min(cfg.sliding_window, worst)} — visible positions "
             f"would be overwritten")
 
+    def _effective_chunk(p_len: int) -> Optional[int]:
+        # a chunk >= the prompt is a single-segment prefill (generate's
+        # normalization)
+        if prefill_chunk is not None and prefill_chunk < p_len:
+            return prefill_chunk
+        return None
+
+    # per-request prefill feasibility, validated BEFORE any compute —
+    # a bad request must not surface mid-serve after other requests
+    # already decoded
+    for i, r in enumerate(reqs):
+        chunk = _effective_chunk(r.shape[0])
+        if chunk is None and r.shape[0] > cache_len:
+            raise ValueError(
+                f"request {i}: prompt {r.shape[0]} exceeds cache_len "
+                f"{cache_len}; pass prefill_chunk to stream it")
+        if chunk is not None:
+            _llama.check_prefill_chunk(
+                chunk, cache_len, cfg.sliding_window,
+                streams_past_cache=True)
+
     # jitted pieces: the batch step (compiled once), the row inserter,
     # and llama.generate's own chunk writers for off-batch prefill
     step, insert_row = _serve_fns(model, float(temperature), int(top_k),
@@ -196,24 +220,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         model, 0.0, 0, 0.0, -1, params_transform)
 
     def prefill_row(prompt):
-        """Fill a fresh single-row cache with `prompt`; returns (last
-        logits, row cache).  Long prompts stream via prefill_chunk —
-        llama.generate's validation rules apply (chunk | cache etc.)."""
-        p_len = prompt.shape[0]
-        chunk = prefill_chunk
-        if chunk is not None and chunk >= p_len:
-            chunk = None
-        if chunk is None and p_len > cache_len:
-            raise ValueError(
-                f"prompt {p_len} exceeds cache_len {cache_len}; pass "
-                f"prefill_chunk to stream it")
-        if chunk is not None:
-            _llama.check_prefill_chunk(
-                chunk, cache_len, cfg.sliding_window,
-                streams_past_cache=True)
+        """Fill a fresh single-row cache with `prompt` (validated
+        above); returns (last logits, row cache)."""
         row = _llama.init_cache(cfg, 1, cache_len, kv_quant=kv_quant)
-        return _llama.stream_prefill(chunk_fill, chunk_write, params,
-                                     row, prompt[None, :], chunk)
+        return _llama.stream_prefill(
+            chunk_fill, chunk_write, params, row, prompt[None, :],
+            _effective_chunk(prompt.shape[0]))
 
     # slot state: cache/tok/pos live on device; occupancy bookkeeping
     # (owner, frozen, emitted) lives on the host — the loop reads tokens
