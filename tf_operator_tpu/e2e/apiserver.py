@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 import uuid
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -165,8 +166,63 @@ class ApiServerTransport:
         # latency and skews the p99 of any bench that starts timing at
         # transport construction
         _crd_validators()
+        # phase profile (None = off): phase -> [calls, total_seconds].
+        # Enabled by benches to MEASURE where the REST façade's overhead vs
+        # the bare store goes (VERDICT r4 weak #6 asked for this breakdown
+        # instead of the asserted "serialization + sockets" — in-process
+        # there are no sockets, so the candidates are path parse, jsonschema
+        # validation, the store op itself, and watch fan-out's deepcopies).
+        self.profile: Optional[Dict[str, List[float]]] = None
+        self._prof_lock = threading.Lock()
+        self._in_request = threading.local()
         for kind in KIND_REGISTRY:
             fake.subscribe(kind, self._make_recorder(kind))
+
+    # ------------------------------------------------------------- profile
+    def enable_profile(self) -> None:
+        self.profile = {}
+
+    def _prof_add(self, phase: str, dt: float) -> None:
+        with self._prof_lock:
+            slot = self.profile.setdefault(phase, [0, 0.0])
+            slot[0] += 1
+            slot[1] += dt
+
+    def profile_summary(self) -> Dict[str, Any]:
+        """{phase: {calls, total_ms, mean_us}} plus each phase's share of
+        the total request time ('other' = request minus accounted phases;
+        'watch_fanout' runs INSIDE 'store', so shares are reported against
+        request total with store_minus_fanout separated out)."""
+        with self._prof_lock:
+            snap = {k: (int(c), float(t)) for k, (c, t) in
+                    (self.profile or {}).items()}
+        total = snap.get("request", (0, 0.0))[1]
+        fanout = snap.get("watch_fanout", (0, 0.0))[1]
+        store = sum(t for k, (_, t) in snap.items() if k.startswith("store."))
+        # watch_fanout happens INSIDE store ops; watch_fanout_ext happens
+        # outside any request (direct backing-store writers) and is
+        # reported but excluded from the request-time decomposition
+        accounted = sum(t for k, (_, t) in snap.items()
+                        if k not in ("request", "watch_fanout",
+                                     "watch_fanout_ext"))
+        out: Dict[str, Any] = {}
+        for k, (calls, t) in sorted(snap.items()):
+            out[k] = {
+                "calls": calls,
+                "total_ms": round(t * 1e3, 1),
+                "mean_us": round(t / calls * 1e6, 1) if calls else 0.0,
+            }
+        if total > 0:
+            out["shares_pct"] = {
+                k: round(t / total * 100, 1) for k, (_, t) in snap.items()
+                if k not in ("request", "watch_fanout", "watch_fanout_ext")
+            }
+            out["shares_pct"]["store_minus_fanout"] = round(
+                max(store - fanout, 0.0) / total * 100, 1)
+            out["shares_pct"]["watch_fanout"] = round(fanout / total * 100, 1)
+            out["shares_pct"]["other"] = round(
+                max(total - accounted, 0.0) / total * 100, 1)
+        return out
 
     # keep at most this many events per kind; older entries are pruned and the
     # 410 horizon advances so a slow watcher relists (the client's relist
@@ -175,28 +231,44 @@ class ApiServerTransport:
 
     def _make_recorder(self, kind: str):
         def record(etype: str, obj: Dict[str, Any]) -> None:
-            with self._lock:
-                self._seq += 1
-                try:
-                    rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
-                except (TypeError, ValueError):
-                    rv = 0
-                seq = max(self._seq, rv)
-                self._seq = seq
-                if etype == "DELETED":
-                    # real apiserver stamps deletes with a fresh rv; the fake
-                    # pops the object carrying its last stored rv — restamp so
-                    # watch replay ordering stays monotone
-                    obj.setdefault("metadata", {})["resourceVersion"] = str(seq)
-                log = self._logs.setdefault(kind, [])
-                log.append((seq, etype, obj))
-                if len(log) > self.MAX_LOG:
-                    drop = len(log) - self.MAX_LOG
-                    self._min_rv = max(self._min_rv, log[drop - 1][0])
-                    del log[:drop]
-                self._lock.notify_all()
+            prof = self.profile  # snapshot: see request()
+            if prof is None:
+                return self._record_event(kind, etype, obj)
+            # fan-out triggered by a store write OUTSIDE any request (e.g.
+            # a kubelet writing straight to the backing store) is recorded
+            # under its own phase — folding it into watch_fanout would
+            # subtract never-inside-a-store time from store_minus_fanout
+            phase = ("watch_fanout" if getattr(self._in_request, "active", False)
+                     else "watch_fanout_ext")
+            t0 = time.perf_counter()
+            try:
+                self._record_event(kind, etype, obj)
+            finally:
+                self._prof_add(phase, time.perf_counter() - t0)
 
         return record
+
+    def _record_event(self, kind: str, etype: str, obj: Dict[str, Any]) -> None:
+        with self._lock:
+            self._seq += 1
+            try:
+                rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
+            except (TypeError, ValueError):
+                rv = 0
+            seq = max(self._seq, rv)
+            self._seq = seq
+            if etype == "DELETED":
+                # real apiserver stamps deletes with a fresh rv; the fake
+                # pops the object carrying its last stored rv — restamp so
+                # watch replay ordering stays monotone
+                obj.setdefault("metadata", {})["resourceVersion"] = str(seq)
+            log = self._logs.setdefault(kind, [])
+            log.append((seq, etype, obj))
+            if len(log) > self.MAX_LOG:
+                drop = len(log) - self.MAX_LOG
+                self._min_rv = max(self._min_rv, log[drop - 1][0])
+                del log[:drop]
+            self._lock.notify_all()
 
     def close(self) -> None:
         with self._lock:
@@ -219,14 +291,39 @@ class ApiServerTransport:
         query: Optional[Dict[str, str]] = None,
         body: Optional[Dict[str, Any]] = None,
     ) -> Tuple[int, Any]:
+        # snapshot ONCE: enable_profile() racing a request in flight must
+        # not let the finally see a profile the entry didn't (a t0 of 0.0
+        # would turn one sample into ~uptime and swamp every share)
+        prof = self.profile
+        if prof is None:
+            return self._request(method, path, query, body)
+        t0 = time.perf_counter()
+        self._in_request.active = True
         try:
-            kind, ns, name, sub = _parse_path(path)
+            return self._request(method, path, query, body, profiled=True)
+        finally:
+            self._in_request.active = False
+            self._prof_add("request", time.perf_counter() - t0)
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, Any]] = None,
+        profiled: bool = False,
+    ) -> Tuple[int, Any]:
+        try:
+            kind, ns, name, sub = self._timed(
+                "parse", profiled, _parse_path, path)
             # cluster-scoped keying is normalized in the store itself
             # (objects.CLUSTER_SCOPED_KINDS) — no transport-side mapping
             if method == "GET" and name and sub == "log" and kind == "Pod":
-                return 200, self.fake.read_pod_log(ns, name)
+                return 200, self._timed(
+                    "store.log", profiled, self.fake.read_pod_log, ns, name)
             if method == "GET" and name:
-                return 200, self.fake.get(kind, ns, name)
+                return 200, self._timed(
+                    "store.get", profiled, self.fake.get, kind, ns, name)
             if method == "GET":
                 # snapshot the horizon BEFORE listing: an rv claimed after the
                 # list could cover a concurrent create whose object the list
@@ -234,8 +331,11 @@ class ApiServerTransport:
                 # (duplicate delivery is safe; loss is not)
                 with self._lock:
                     rv = str(self._seq)
-                items = self.fake.list(
-                    kind, namespace=ns, selector=_parse_selector(query)
+                selector = self._timed(
+                    "parse", profiled, _parse_selector, query)
+                items = self._timed(
+                    "store.list", profiled, self.fake.list,
+                    kind, namespace=ns, selector=selector,
                 )
                 return 200, {
                     "kind": f"{kind}List",
@@ -257,12 +357,13 @@ class ApiServerTransport:
                     # apiserver create semantics for status-subresource
                     # kinds: client-sent .status is CLEARED, not validated
                     obj.pop("status", None)
-                _validate_crd_body(kind, obj)
-                return 201, self.fake.create(kind, obj)
+                self._timed("validate", profiled, _validate_crd_body, kind, obj)
+                return 201, self._timed(
+                    "store.create", profiled, self.fake.create, kind, obj)
             if method == "PUT" and name:
-                return 200, self._put(kind, ns, name, sub, body or {})
+                return 200, self._put(kind, ns, name, sub, body or {}, profiled)
             if method == "DELETE" and name:
-                self.fake.delete(kind, ns, name)
+                self._timed("store.delete", profiled, self.fake.delete, kind, ns, name)
                 return 200, _status_payload_success()
             return 405, _status_payload(400, f"method {method} not allowed")
         except NotFoundError as e:
@@ -272,16 +373,26 @@ class ApiServerTransport:
         except ApiError as e:
             return e.code, _status_payload(e.code, str(e))
 
+    def _timed(self, phase: str, profiled: bool, fn, *args, **kwargs):
+        if not profiled:
+            return fn(*args, **kwargs)
+        t = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._prof_add(phase, time.perf_counter() - t)
+
     def _put(
-        self, kind: str, ns: str, name: str, sub: Optional[str], body: Dict[str, Any]
+        self, kind: str, ns: str, name: str, sub: Optional[str],
+        body: Dict[str, Any], profiled: bool = False,
     ) -> Dict[str, Any]:
         info = KIND_REGISTRY[kind]
         if not info.has_status:
-            return self.fake.update(kind, body)
+            return self._timed("store.update", profiled, self.fake.update, kind, body)
         # status-subresource kinds: a main-resource PUT keeps the stored
         # status; a /status PUT keeps the stored spec (apiserver semantics
         # the live client must navigate — ClusterClient.update does both)
-        stored = self.fake.get(kind, ns, name)
+        stored = self._timed("store.get", profiled, self.fake.get, kind, ns, name)
         merged = dict(body)
         if sub == "status":
             merged = dict(stored)
@@ -299,8 +410,8 @@ class ApiServerTransport:
         # semantics): a /status write with an invalid condition 422s here;
         # by induction the stored status is always valid, so a main-
         # resource writer is never blamed for status it didn't author
-        _validate_crd_body(kind, merged)
-        return self.fake.update(kind, merged)
+        self._timed("validate", profiled, _validate_crd_body, kind, merged)
+        return self._timed("store.update", profiled, self.fake.update, kind, merged)
 
     # ------------------------------------------------------------- stream
     def stream(

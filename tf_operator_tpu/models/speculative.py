@@ -118,7 +118,8 @@ def _spec_fns(target, draft, k: int, temperature: float,
             return state[3] < max_new
 
         def body(state):
-            t_cache, d_cache, out, n_out, pos, last, key, n_fwd = state
+            (t_cache, d_cache, out, n_out, pos, last, key, n_fwd,
+             acc_total) = state
             key, k_draft, k_accept, k_fix = jax.random.split(key, 4)
 
             # ---- draft k tokens, single-token steps.  The scan runs
@@ -196,15 +197,19 @@ def _spec_fns(target, draft, k: int, temperature: float,
                              slot[:, None])
             out = jax.lax.dynamic_update_slice(out, cand, (0, n_out))
             n_emit = n_acc + 1
-            # the round's last emitted token is cand[:, n_acc] == slot
+            # the round's last emitted token is cand[:, n_acc] == slot.
+            # acc_total counts ACCEPTED draft tokens before any crop of
+            # the final round's overshoot — accepted/(k*rounds) is then an
+            # unbiased acceptance rate (emitted-token counts are clipped
+            # at max_new and would understate it, worse at larger k)
             return (t_cache, d_cache, out, n_out + n_emit,
-                    pos + n_emit, slot, key, n_fwd + 1)
+                    pos + n_emit, slot, key, n_fwd + 1, acc_total + n_acc)
 
         state = (t_cache, d_cache, out, jnp.int32(1), pos0, first, rng,
-                 jnp.int32(0))
-        _, _, out, n_out, _, _, _, n_fwd = jax.lax.while_loop(
+                 jnp.int32(0), jnp.int32(0))
+        _, _, out, n_out, _, _, _, n_fwd, acc_total = jax.lax.while_loop(
             cond, body, state)
-        return out[:, :max_new], n_fwd
+        return out[:, :max_new], n_fwd, acc_total
 
     return prefill, spec_loop
 
@@ -299,8 +304,11 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     generate(..., kv_quant=True) — the exactness contract is relative
     to the target decoding over the same cache representation.
 
-    return_stats: also return {"target_forwards": int} — the speedup
-    witness (plain decode needs max_new_tokens forwards)."""
+    return_stats: also return {"target_forwards": int,
+    "accepted_drafts": int} — forwards is the speedup witness (plain
+    decode needs max_new_tokens forwards); accepted_drafts counts
+    accepted proposals before the final round's overshoot crop, so
+    accepted/(k*rounds) is an unbiased acceptance rate."""
     from tf_operator_tpu.models.llama import (
         _decode_fns, _select_token, init_cache,
     )
@@ -370,9 +378,9 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     else:
         first, t_cache, d_cache = prefill(t_params, d_params, t_cache,
                                           d_cache, prompt, k_first)
-    out, n_fwd = spec_loop(t_params, d_params, t_cache, d_cache, first,
-                           jnp.int32(prompt_len), k_loop,
-                           int(max_new_tokens))
+    out, n_fwd, acc_total = spec_loop(t_params, d_params, t_cache, d_cache,
+                                      first, jnp.int32(prompt_len), k_loop,
+                                      int(max_new_tokens))
     if eos_id is not None:
         if not 0 <= int(eos_id) < target.cfg.vocab_size:
             raise ValueError(
@@ -388,5 +396,6 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
         out = jnp.where(prev_seen | (out == int(eos_id)),
                         jnp.int32(eos_id), out)
     if return_stats:
-        return out, {"target_forwards": int(n_fwd)}
+        return out, {"target_forwards": int(n_fwd),
+                     "accepted_drafts": int(acc_total)}
     return out
