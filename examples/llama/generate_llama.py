@@ -147,11 +147,6 @@ def main(argv=None) -> int:
     if speculative:
         from tf_operator_tpu.models.speculative import speculative_generate
 
-        if args.top_k or args.top_p:
-            raise SystemExit(
-                "--top-k/--top-p are not supported under speculation "
-                "(the acceptance ratio must match the sampled "
-                "distributions)")
         import dataclasses
 
         d_layers = args.draft_layers or max(1, cfg.n_layers // 4)
@@ -174,7 +169,7 @@ def main(argv=None) -> int:
         out, stats = speculative_generate(
             model, params, d_model, d_params, prompt, args.max_new,
             k=args.spec_k, temperature=args.temperature, rng=rng,
-            eos_id=tok.eos_id,
+            eos_id=tok.eos_id, top_k=args.top_k, top_p=args.top_p,
             target_transform=gen_kw.get("params_transform"),
             return_stats=True, **d_kw)
         print(f"speculative: {stats['target_forwards']} target forwards "

@@ -822,11 +822,7 @@ def generate(model, params, prompt, max_new_tokens: int,
     b, prompt_len = prompt.shape
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-    if top_k < 0 or top_k > cfg.vocab_size:
-        raise ValueError(
-            f"top_k must be in [0, vocab_size={cfg.vocab_size}], got {top_k}")
-    if not 0.0 <= top_p <= 1.0:
-        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    check_truncation(cfg.vocab_size, top_k, top_p)
     eos = -1 if eos_id is None else int(eos_id)
     if eos_id is not None and not 0 <= eos < cfg.vocab_size:
         raise ValueError(
@@ -915,30 +911,52 @@ def stream_prefill(chunk_fill, chunk_write, params, cache, prompt,
                       jnp.int32(last))
 
 
-def _select_token(logits, temperature: float, key, top_k: int = 0,
-                  top_p: float = 0.0):
-    """[B, V] logits -> [B] token ids. temperature 0 -> greedy argmax;
-    else softmax sampling, optionally truncated: top_k keeps the k
-    highest logits, top_p (nucleus) keeps the smallest set of tokens
-    whose probability mass reaches p — both static-shape (mask, never
-    gather), so the decode scan stays one compiled program."""
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _truncate_logits(logits, temperature: float, top_k: int = 0,
+                     top_p: float = 0.0):
+    """[..., V] logits -> temperature-scaled logits with truncated-out
+    tokens masked to -inf.  softmax of the result IS the sampling
+    distribution (the seam speculative decoding needs: acceptance ratios
+    and residuals must be computed over the exact distributions tokens
+    are drawn from).  top_k keeps the k highest logits, top_p (nucleus)
+    keeps the smallest set of tokens whose probability mass reaches p —
+    both static-shape (mask, never gather), so decode scans stay one
+    compiled program."""
     logits = logits / temperature
     neg = jnp.finfo(logits.dtype).min
     if top_k and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, neg, logits)
     if top_p and 0.0 < top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # keep tokens while the mass BEFORE them is < p (the first token
         # is always kept); the cutoff logit is the smallest kept one
-        keep = jnp.roll(cum, 1, axis=-1).at[:, 0].set(0.0) < top_p
+        keep = jnp.roll(cum, 1, axis=-1).at[..., 0].set(0.0) < top_p
         cutoff = jnp.min(
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
+def check_truncation(vocab_size: int, top_k: int, top_p: float) -> None:
+    """Shared top_k/top_p range validation for every sampling entry point
+    (generate, serve_loop, speculative_generate) — one place to change if
+    truncation semantics ever move."""
+    if top_k < 0 or top_k > vocab_size:
+        raise ValueError(
+            f"top_k must be in [0, vocab_size={vocab_size}], got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+
+
+def _select_token(logits, temperature: float, key, top_k: int = 0,
+                  top_p: float = 0.0):
+    """[B, V] logits -> [B] token ids. temperature 0 -> greedy argmax;
+    else softmax sampling over _truncate_logits' distribution."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _truncate_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 

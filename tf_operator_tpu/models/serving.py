@@ -149,12 +149,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         raise ValueError("sampling (temperature > 0) needs an rng")
     # generate()'s own range checks — an out-of-range eos_id can never
     # match a token, which would silently disable early stopping
-    if top_k < 0 or top_k > cfg.vocab_size:
-        raise ValueError(
-            f"top_k must be in [0, vocab_size={cfg.vocab_size}], "
-            f"got {top_k}")
-    if not 0.0 <= top_p <= 1.0:
-        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    _llama.check_truncation(cfg.vocab_size, top_k, top_p)
     if eos_id is not None and not 0 <= int(eos_id) < cfg.vocab_size:
         raise ValueError(
             f"eos_id {eos_id} out of range for vocab_size "

@@ -39,9 +39,11 @@ distribution — provably, regardless of draft quality (the Monte-Carlo
 witness lives in tests/test_speculative.py).  Lockstep rollback keeps
 exactness: a row whose accepted tokens are discarded because another
 row rejected earlier simply re-runs the (exact) procedure with fresh
-randomness.  top_k/top_p truncation is not supported under speculation
-(the acceptance ratio must be computed over the same distributions the
-tokens were drawn from).
+randomness.  top_k/top_p truncation composes: BOTH distributions are
+truncated and renormalized before proposal/acceptance/residual, so the
+acceptance ratio is computed over the same distributions the tokens
+were drawn from and every emitted token is an exact draw from the
+target's truncated distribution.
 
 Sliding-window models keep their O(window) ring under speculation: a
 ring of cache_len >= window + k slots is enough — the wrapping verify
@@ -76,14 +78,22 @@ def residual_sample(key, t_probs, d_probs):
 @functools.lru_cache(maxsize=8)
 def _spec_fns(target, draft, k: int, temperature: float,
               target_transform=None, draft_transform=None,
-              wrap_target: bool = False):
-    """Jitted (prefill, spec_loop) for a (target, draft, k, T) tuple.
-    Transforms are the weight-only-quantization seam
+              wrap_target: bool = False, top_k: int = 0,
+              top_p: float = 0.0):
+    """Jitted (prefill, spec_loop) for a (target, draft, k, T, top_k/p)
+    tuple.  Transforms are the weight-only-quantization seam
     (models/quant.make_dequantizer), identical to llama.generate's.
     wrap_target: the target cache is an O(window) ring smaller than the
     sequence, so the k+1-position verify write may wrap the ring and
-    goes through the scatter path (llama.GqaAttention wrap_write)."""
-    from tf_operator_tpu.models.llama import _select_token
+    goes through the scatter path (llama.GqaAttention wrap_write).
+    top_k/top_p truncate BOTH models' sampling distributions
+    (llama._truncate_logits); the acceptance ratio and residual are
+    computed over those truncated distributions, so every emitted token
+    is an exact draw from the target's truncated distribution — the
+    standard speculative-sampling proof applies unchanged to any
+    modified target distribution as long as p_draft is the actual
+    proposal distribution."""
+    from tf_operator_tpu.models.llama import _select_token, _truncate_logits
 
     t_xform = target_transform or (lambda p: p)
     d_xform = draft_transform or (lambda p: p)
@@ -92,7 +102,8 @@ def _spec_fns(target, draft, k: int, temperature: float,
     def _first_token(logits, key):
         # llama's own selection dispatch: keeps the greedy contract
         # ("IDENTICAL to generate()") in lockstep by construction
-        return _select_token(logits, temperature, key).astype(jnp.int32)
+        return _select_token(logits, temperature, key, top_k,
+                             top_p).astype(jnp.int32)
 
     @jax.jit
     def prefill(t_params, d_params, t_cache, d_cache, prompt, key):
@@ -137,12 +148,20 @@ def _spec_fns(target, draft, k: int, temperature: float,
                     {"params": d_xform(d_params)}, tok[:, None],
                     cache=d_cache, cache_pos=dpos)
                 lg = logits[:, 0]
-                nxt = _select_token(lg, temperature,
-                                    step_key).astype(jnp.int32)
-                # draft probs feed the acceptance ratio (sampling only;
-                # greedy compares argmaxes and never reads them)
-                probs = jax.nn.softmax(
-                    lg / (temperature if sampling else 1.0), axis=-1)
+                if sampling:
+                    # truncate FIRST, then sample and record softmax of
+                    # the same masked logits: probs must be the exact
+                    # distribution the proposal was drawn from or the
+                    # acceptance ratio loses the exactness proof
+                    ml = _truncate_logits(lg, temperature, top_k, top_p)
+                    nxt = jax.random.categorical(
+                        step_key, ml, axis=-1).astype(jnp.int32)
+                    probs = jax.nn.softmax(ml, axis=-1)
+                else:
+                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                    # greedy compares argmaxes and never reads probs;
+                    # kept for a uniform scan carry shape
+                    probs = jax.nn.softmax(lg, axis=-1)
                 return (d_cache, nxt, dpos + 1), (nxt, probs)
 
             (d_cache, _, _), (drafts, dprobs) = jax.lax.scan(
@@ -158,7 +177,9 @@ def _spec_fns(target, draft, k: int, temperature: float,
                 cache_pos=pos, wrap_cache_write=wrap_target)
 
             if sampling:
-                tprobs = jax.nn.softmax(t_logits / temperature, axis=-1)
+                tprobs = jax.nn.softmax(
+                    _truncate_logits(t_logits, temperature, top_k, top_p),
+                    axis=-1)
                 # accept x_i with prob min(1, p_t(x_i)/p_d(x_i))
                 p_t = jnp.take_along_axis(
                     tprobs[:, :k], drafts[..., None], axis=2)[..., 0]
@@ -272,6 +293,7 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
                          target_transform=None, draft_transform=None,
                          prefill_chunk: Optional[int] = None,
                          kv_quant: bool = False,
+                         top_k: int = 0, top_p: float = 0.0,
                          return_stats: bool = False):
     """Speculative decoding: [B, max_new_tokens] tokens produced in
     ~(accepted+1)-token chunks per target forward.  temperature 0 =
@@ -279,6 +301,14 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     temperature > 0 = speculative SAMPLING (needs `rng`): every token is
     an exact draw from the target's temperature-T distribution via the
     stochastic-acceptance + residual rule.
+
+    top_k / top_p: truncated sampling (llama.generate's knobs, same
+    semantics).  Both models' distributions are truncated and
+    renormalized BEFORE proposal/acceptance/residual, so every emitted
+    token is an exact draw from the target's truncated distribution —
+    the acceptance proof holds for any modified target distribution as
+    long as the ratio uses the actual proposal distribution.  Ignored
+    under greedy (temperature 0), exactly like generate().
 
     target/draft: llama.Llama modules sharing a tokenizer (vocab ids
     must mean the same thing); k: draft tokens per round.
@@ -310,7 +340,7 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     accepted proposals before the final round's overshoot crop, so
     accepted/(k*rounds) is an unbiased acceptance rate."""
     from tf_operator_tpu.models.llama import (
-        _decode_fns, _select_token, init_cache,
+        _decode_fns, _select_token, check_truncation, init_cache,
     )
 
     if target.cfg.vocab_size != draft.cfg.vocab_size:
@@ -319,6 +349,7 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
             f"{draft.cfg.vocab_size} — speculation compares token ids")
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    check_truncation(target.cfg.vocab_size, top_k, top_p)
     b, prompt_len = prompt.shape
     # edge contract mirrors llama.generate: negative raises, zero
     # returns empty BEFORE the length limits apply
@@ -355,7 +386,8 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     prefill, spec_loop = _spec_fns(target, draft, int(k),
                                    float(temperature),
                                    target_transform, draft_transform,
-                                   wrap_target=c_t < total)
+                                   wrap_target=c_t < total,
+                                   top_k=int(top_k), top_p=float(top_p))
     if prefill_chunk is not None:
         # stream the prompt through both rings segment by segment,
         # reusing llama.generate's jitted chunk writers (shared compile
@@ -374,7 +406,8 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
         last_logits, t_cache = t_fill(t_params, t_cache, seg,
                                       jnp.int32(last))
         d_cache = d_write(d_params, d_cache, seg, jnp.int32(last))
-        first = _select_token(last_logits, temperature, k_first)
+        first = _select_token(last_logits, temperature, k_first,
+                              int(top_k), float(top_p))
     else:
         first, t_cache, d_cache = prefill(t_params, d_params, t_cache,
                                           d_cache, prompt, k_first)
