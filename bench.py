@@ -308,7 +308,7 @@ def bench_resnet(gen: str, n_chips: int):
     # sweep per-chip batch sizes, data-parallel over every local chip so
     # throughput/n_chips is honest (an unsharded step would run on chip 0
     # only while dividing by all); only an OOM ends the sweep benignly
-    best, best_ips, stops = None, 0.0, []
+    best, best_ips, stops, sweep = None, 0.0, [], {}
     for b in batches:
         try:
             ips = run_one(b * n_chips)
@@ -317,6 +317,10 @@ def bench_resnet(gen: str, n_chips: int):
                 stops.append(f"b{b * n_chips}: {type(e).__name__}")
                 break
             raise
+        # record EVERY batch, not just the winner: the non-best points
+        # ARE the measured headroom bound (VERDICT r4 item 9 — b512/b1024
+        # results were discarded when b256 won, leaving the probe silent)
+        sweep[f"b{b * n_chips}"] = round(ips, 2)
         if best is None or ips > best_ips:
             best_ips = ips
             best = {
@@ -327,6 +331,8 @@ def bench_resnet(gen: str, n_chips: int):
                 "train_flops_per_image": flops_per_image,
                 "mfu": round(ips * flops_per_image / peak, 4) if peak else None,
             }
+    if best is not None and len(sweep) > 1:
+        best["batch_sweep_img_per_sec"] = sweep
     if best is not None and stops:
         best["sweep_stopped"] = stops
     return best
@@ -799,14 +805,20 @@ def bench_llama_decode(gen: str, cfg=None, max_new: int = 128,
     return out
 
 
-def bench_serve_loop(gen: str, cfg=None, n_requests: int = 8,
-                     slots: int = 2, max_new: int = 32):
+def bench_serve_loop(gen: str, cfg=None, n_requests: int = 16,
+                     slots: int = 4, max_new: int = 64,
+                     steps_per_sync: int = 32):
     """Continuous-batching arm (models/serving.serve_loop): ragged
     requests through a fixed set of decode lanes with slot admission,
     vs serving the same requests one-by-one (batch-1 generate) — the
     lane-sharing throughput win is the quantity (slots minus admission
     overhead, diluted by prefill).  Exactness is pinned by
-    tests/test_serving.py; this row measures."""
+    tests/test_serving.py; this row measures.  Sized as a sustained
+    serving workload: lane sharing amortizes over decode length, and a
+    large steps_per_sync keeps the device busy between host syncs —
+    through a relayed transport each sync is tens of ms, so the r4-sized
+    row (2 slots, 32 tokens, sync every 8) measured launch latency, not
+    the feature."""
     import jax
     import jax.numpy as jnp
 
@@ -830,10 +842,10 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 8,
     # warm both paths' compiles out of the timing — the full request set
     # (every distinct prompt length owns a prefill compile)
     serve_loop(model, params, prompts, slots=slots,
-               max_new_tokens=max_new)
+               max_new_tokens=max_new, steps_per_sync=steps_per_sync)
     t0 = time.perf_counter()
     res = serve_loop(model, params, prompts, slots=slots,
-                     max_new_tokens=max_new)
+                     max_new_tokens=max_new, steps_per_sync=steps_per_sync)
     t_serve = time.perf_counter() - t0
     n_tokens = sum(len(r.tokens) for r in res)
     # sequential baseline: one request at a time, batch 1 (compiles per
@@ -850,6 +862,7 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 8,
     return {
         "requests": n_requests,
         "slots": slots,
+        "steps_per_sync": steps_per_sync,
         "prompt_lens": f"{min(lengths)}..{max(lengths)}",
         "new_tokens_per_request": max_new,
         "tokens_per_sec": round(n_tokens / t_serve, 1),
@@ -1626,7 +1639,7 @@ def main() -> int:
         try:
             row = bench_serve_loop(
                 gen, cfg=llm.tiny(dtype=jnp.float32, max_len=128),
-                n_requests=4, slots=2, max_new=8)
+                n_requests=4, slots=2, max_new=8, steps_per_sync=4)
             extra["serve_loop"] = {"config": "tiny", "smoke": True, **row}
         except Exception as e:  # noqa: BLE001 — surfaced, not fatal
             extra["serve_loop"] = {"error": f"{type(e).__name__}: {e}"[:300]}

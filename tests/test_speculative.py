@@ -397,7 +397,12 @@ def test_self_draft_full_acceptance_under_truncation():
             target, t_params, target, t_params, prompt, 12, k=3,
             temperature=0.8, rng=jax.random.PRNGKey(3),
             return_stats=True, **kw)
-        assert st["accepted_drafts"] == 3 * st["target_forwards"], (kw, st)
+        # -1 slack: draft (k single-token forwards) and target (one k+1
+        # forward) take different XLA reduction paths, so p_t can land a
+        # float hair below p_d and reject despite identical weights;
+        # one-sided truncation would reject FAR more than one
+        assert st["accepted_drafts"] >= 3 * st["target_forwards"] - 1, (
+            kw, st)
 
 
 def test_topk_midstream_marginal_matches_plain_generate():

@@ -350,6 +350,11 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     check_truncation(target.cfg.vocab_size, top_k, top_p)
+    if temperature <= 0.0:
+        # greedy ignores truncation (generate()'s contract) — normalize
+        # so (T=0, top_k=50) and (T=0) share one _spec_fns cache entry
+        # instead of compiling a duplicate program pair
+        top_k, top_p = 0, 0.0
     b, prompt_len = prompt.shape
     # edge contract mirrors llama.generate: negative raises, zero
     # returns empty BEFORE the length limits apply
