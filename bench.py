@@ -640,11 +640,11 @@ def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4,
             jax.block_until_ready(o2)
             t_spec = time.perf_counter() - t0
             n_fwd = st["target_forwards"]
-            # accepted_drafts counts acceptances BEFORE the final round's
-            # overshoot is cropped at max_new, so accepted/(k*rounds) is
-            # unbiased (deriving accepted from emitted tokens would
-            # understate acceptance, worse at larger k)
-            acc = st["accepted_drafts"] / max(1, kk * n_fwd)
+            # accepted/proposed cover active rows only and count
+            # acceptances BEFORE the final round's overshoot crop, so
+            # the rate is unbiased (emitted-token derivations understate
+            # acceptance, worse at larger k)
+            acc = st["accepted_drafts"] / max(1, st["proposed_drafts"])
             sweep[f"k{kk}"] = {
                 "acceptance_rate": round(acc, 3),
                 "target_forwards": n_fwd,

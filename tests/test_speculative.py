@@ -88,9 +88,9 @@ def test_speculative_composes_with_int8_weights():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
-def test_batch_lockstep_exactness():
+def test_batch_per_row_exactness():
     """Batched rows with different acceptance patterns stay exact under
-    lockstep-minimum acceptance."""
+    PER-ROW advance (each row keeps its own accepted prefix)."""
     target, t_params = _init(_f32(n_layers=3, max_len=128), seed=0)
     draft, d_params = _init(_f32(n_layers=2, max_len=128), seed=0)
     # draft shares layer-0/1 style but different depth: mixed agreement
@@ -99,6 +99,40 @@ def test_batch_lockstep_exactness():
     got = speculative_generate(target, t_params, draft, d_params,
                                prompt, max_new_tokens=18, k=4)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_per_row_advance_is_isolated():
+    """The per-row property itself: under greedy, a row's speculative
+    trajectory is independent of its batch-mates — batched output equals
+    each row's ISOLATED run, batched rounds equal the MAX of the
+    isolated rounds (lockstep would need at least as many, re-running
+    every row at the batch-minimum acceptance), and once a row
+    finishes, proposals count only the still-active rows."""
+    target, t_params = _init(_f32(n_layers=3, max_len=128), seed=0)
+    draft, d_params = _init(_f32(n_layers=2, max_len=128), seed=0)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (4, 12), 0, 256)
+    max_new, k = 18, 4
+    rows = []
+    for i in range(prompt.shape[0]):
+        o, st = speculative_generate(
+            target, t_params, draft, d_params, prompt[i:i + 1],
+            max_new_tokens=max_new, k=k, return_stats=True)
+        rows.append((o, st["target_forwards"]))
+    got, st = speculative_generate(
+        target, t_params, draft, d_params, prompt,
+        max_new_tokens=max_new, k=k, return_stats=True)
+    for i, (o, _) in enumerate(rows):
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(o[0]))
+    per_row_rounds = [n for _, n in rows]
+    assert st["target_forwards"] == max(per_row_rounds), (
+        st, per_row_rounds)
+    # rows finished at different rounds for this seed (else the active
+    # accounting below is vacuous — tighten the seed if this ever fails)
+    assert len(set(per_row_rounds)) > 1, per_row_rounds
+    # proposals = k * (active rows summed over rounds), strictly fewer
+    # than k * B * rounds because finished rows stop proposing
+    expect_props = k * sum(per_row_rounds)
+    assert st["proposed_drafts"] == expect_props, (st, per_row_rounds)
 
 
 def test_validation():
@@ -397,18 +431,18 @@ def test_self_draft_full_acceptance_under_truncation():
             target, t_params, target, t_params, prompt, 12, k=3,
             temperature=0.8, rng=jax.random.PRNGKey(3),
             return_stats=True, **kw)
-        # -1 slack: draft (k single-token forwards) and target (one k+1
-        # forward) take different XLA reduction paths, so p_t can land a
-        # float hair below p_d and reject despite identical weights;
-        # one-sided truncation would reject FAR more than one
-        assert st["accepted_drafts"] >= 3 * st["target_forwards"] - 1, (
+        # slack of one per row: draft (k single-token forwards) and
+        # target (one k+1 forward) take different XLA reduction paths,
+        # so p_t can land a float hair below p_d and reject despite
+        # identical weights; one-sided truncation would reject FAR more
+        assert st["accepted_drafts"] >= st["proposed_drafts"] - 2, (
             kw, st)
 
 
 def test_topk_midstream_marginal_matches_plain_generate():
     """End-to-end truncated-sampling witness past the first token: a
     large batch of IDENTICAL prompts gives i.i.d. per-row draws (plain)
-    and lockstep-coupled but per-row-exact draws (speculative); the
+    and per-row-exact draws (speculative, per-row advance); the
     mid-stream empirical marginals must agree."""
     target, t_params = _init(_f32(n_layers=1, max_len=64), seed=0)
     draft, d_params = _init(_f32(n_layers=1, max_len=64), seed=8)

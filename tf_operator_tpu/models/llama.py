@@ -253,17 +253,28 @@ def _ring_write(buf, val, pos, wrap: bool):
     speculative verify — may cross the ring seam); otherwise one
     contiguous dynamic_update_slice (callers guarantee no wrap:
     prompt_len <= C / chunk | C).  A VECTOR pos [B] writes each row at
-    its own position (continuous batching: every slot decodes at its
-    own length; single-token steps only)."""
+    its own position (continuous batching / per-row speculation: every
+    row at its own length); the modulo is per (row, step), so the seam
+    is always handled and `wrap` is irrelevant on this path."""
     c = buf.shape[1]
     if getattr(pos, "ndim", 0) == 1:
-        if val.shape[1] != 1:
-            raise ValueError(
-                f"per-row positions support single-token writes only, "
-                f"got L={val.shape[1]}")
         rows = jnp.arange(buf.shape[0])
-        return buf.at[rows, jnp.mod(pos, c)].set(
-            val[:, 0].astype(buf.dtype), unique_indices=True)
+        l = val.shape[1]
+        if l == 1:
+            return buf.at[rows, jnp.mod(pos, c)].set(
+                val[:, 0].astype(buf.dtype), unique_indices=True)
+        if l > c:
+            # duplicate (row, slot) indices under unique_indices would
+            # be silent undefined behavior; the speculation cache bound
+            # (_spec_cache_len) guarantees k+1 <= C today, but enforce
+            # it HERE where the scatter happens — l and c are static
+            raise ValueError(
+                f"per-row write of L={l} positions into a C={c} ring "
+                f"would alias slots within a row")
+        slots = jnp.mod(
+            pos[:, None] + jnp.arange(l, dtype=jnp.int32), c)
+        return buf.at[rows[:, None], slots].set(
+            val.astype(buf.dtype), unique_indices=True)
     if wrap and val.shape[1] > 1:
         idx = jnp.mod(pos + jnp.arange(val.shape[1], dtype=jnp.int32), c)
         return buf.at[:, idx].set(val.astype(buf.dtype),
@@ -344,11 +355,12 @@ class GqaAttention(nn.Module):
 
     Training path: full-sequence causal attention via cfg.attention_fn
     (flash / ring / ulysses — GQA-native backends get compact kv).
-    Decode path (cache=(k,v) [B,C,KV,D], pos a scalar — every sequence
-    in the batch decodes at the same position; ragged continuation is
-    not supported): the step's k/v are written into the cache at `pos`
-    and attention runs against the whole cache with a position mask —
-    returns (out, new_cache)."""
+    Decode path (cache=(k,v) [B,C,KV,D]; pos a scalar for a batch
+    decoding in step, or a VECTOR [B] giving each row its own position —
+    continuous batching and per-row speculative verify both ride this):
+    the step's k/v are written into the cache at `pos` and attention
+    runs against the whole cache with a position mask — returns
+    (out, new_cache)."""
 
     cfg: LlamaConfig
 
@@ -545,9 +557,10 @@ class Llama(nn.Module):
             # cache: per-layer (k, v) tuples (init_cache); cache_pos is the
             # global position of tokens[:, 0] — rotation follows it.  A
             # VECTOR cache_pos [B] gives each row its own position
-            # (continuous batching; single-token steps only)
+            # (continuous batching / per-row speculative verify)
             if getattr(cache_pos, "ndim", 0) == 1:
-                angles = table[cache_pos][:, None, :]  # [B, 1, D/2]
+                steps = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+                angles = table[cache_pos[:, None] + steps]  # [B, L, D/2]
             else:
                 angles = jax.lax.dynamic_slice_in_dim(
                     table, cache_pos, tokens.shape[1])

@@ -20,11 +20,13 @@ TPU-first mechanics (all static shapes under one jitted
     invisible to the visibility mask (their `k_global` resolves ahead of
     every query) and are overwritten as decoding proceeds.  No gather,
     no copy, no dynamic shapes.
-  - batches advance in LOCKSTEP at the minimum per-row acceptance: rows
-    that agreed further simply re-verify those tokens next round.  Greedy
-    exactness is preserved (each accepted token agrees with the target's
-    argmax under the identical prefix); only the speedup is diluted by
-    the slowest row — the standard batch-speculation tradeoff.
+  - batches advance PER ROW: positions, cache writes, and output offsets
+    are [B] vectors, so each row keeps its own accepted prefix and a
+    batch is never diluted to its slowest row's acceptance.  Under
+    greedy, a row's trajectory is bit-identical to running it alone
+    (batched rounds == max of isolated per-row rounds — tested).  A
+    finished row freezes: its lanes keep computing (SPMD) but its
+    writes land on the out buffer's scratch column.
   - per-iteration work: k single-token draft steps (`lax.scan`) + one
     (k+1)-token target forward.  With acceptance rate a, expected tokens
     per target forward is ~(1 - a^(k+1)) / (1 - a) + ... >= 1, vs exactly
@@ -36,10 +38,8 @@ min(1, p_target(x) / p_draft(x)); on rejection the emitted token is
 drawn from the RESIDUAL distribution norm(max(0, p_target - p_draft)).
 Each emitted token is an exact draw from the target's temperature-T
 distribution — provably, regardless of draft quality (the Monte-Carlo
-witness lives in tests/test_speculative.py).  Lockstep rollback keeps
-exactness: a row whose accepted tokens are discarded because another
-row rejected earlier simply re-runs the (exact) procedure with fresh
-randomness.  top_k/top_p truncation composes: BOTH distributions are
+witness lives in tests/test_speculative.py).  top_k/top_p truncation
+composes: BOTH distributions are
 truncated and renormalized before proposal/acceptance/residual, so the
 acceptance ratio is computed over the same distributions the tokens
 were drawn from and every emitted token is an exact draw from the
@@ -101,7 +101,7 @@ def _spec_fns(target, draft, k: int, temperature: float,
 
     def _first_token(logits, key):
         # llama's own selection dispatch: keeps the greedy contract
-        # ("IDENTICAL to generate()") in lockstep by construction
+        # ("IDENTICAL to generate()") by construction
         return _select_token(logits, temperature, key, top_k,
                              top_p).astype(jnp.int32)
 
@@ -126,12 +126,18 @@ def _spec_fns(target, draft, k: int, temperature: float,
         out = out.at[:, 0].set(first)
 
         def cond(state):
-            return state[3] < max_new
+            return jnp.any(state[3] < max_new)
 
         def body(state):
             (t_cache, d_cache, out, n_out, pos, last, key, n_fwd,
-             acc_total) = state
+             acc_total, prop_total) = state
             key, k_draft, k_accept, k_fix = jax.random.split(key, 4)
+            # PER-ROW advance: each row keeps its own accepted prefix
+            # (no lockstep min — a batch is not diluted to its slowest
+            # row).  Rows that reached max_new are done: they keep
+            # computing (SPMD lanes can't exit) but their state freezes
+            # and their writes land on the out buffer's scratch slot.
+            done = n_out >= max_new                       # [B]
 
             # ---- draft k tokens, single-token steps.  The scan runs
             # k+1 steps: the extra step's OUTPUT is discarded, but its
@@ -188,49 +194,59 @@ def _spec_fns(target, draft, k: int, temperature: float,
                 u = jax.random.uniform(k_accept, (b, k))
                 accept = (u * jnp.maximum(p_d, 1e-30) < p_t).astype(
                     jnp.int32)
-                acc_row = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
-                n_acc = jnp.min(acc_row)
-                # slot n_acc, per row: rejected there -> residual draw;
-                # accepted past it -> keep its own accepted draft token.
+                n_acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # [B]
+                # slot n_acc, per row: rejected there -> residual draw.
                 # The all-k-accepted bonus needs no special case: then
-                # every acc_row == k == n_acc, and the padded d_at row is
-                # all zeros, so residual_sample's norm(max(p_t - 0, 0))
-                # IS an exact draw from the target distribution.
-                t_at = jnp.take(tprobs, n_acc, axis=1)       # [B, V]
-                d_at = jnp.take(
+                # the padded d_at row is all zeros, so residual_sample's
+                # norm(max(p_t - 0, 0)) IS an exact draw from the target
+                # distribution.
+                t_at = jnp.take_along_axis(
+                    tprobs, n_acc[:, None, None], axis=1)[:, 0]  # [B, V]
+                d_at = jnp.take_along_axis(
                     jnp.pad(dprobs, ((0, 0), (0, 1), (0, 0))),
-                    n_acc, axis=1)                           # [B, V]
-                fix = residual_sample(k_fix, t_at, d_at).astype(jnp.int32)
-                slot = jnp.where(acc_row == n_acc, fix,
-                                 jnp.take(jnp.pad(drafts, ((0, 0), (0, 1))),
-                                          n_acc, axis=1))
+                    n_acc[:, None, None], axis=1)[:, 0]          # [B, V]
+                slot = residual_sample(k_fix, t_at, d_at).astype(jnp.int32)
             else:
                 tpred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
                 match = (drafts == tpred[:, :k]).astype(jnp.int32)
-                acc_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
-                n_acc = jnp.min(acc_row)
+                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)   # [B]
                 # the target's own token at the first disagreement
-                slot = jnp.take(tpred, n_acc, axis=1)
+                slot = jnp.take_along_axis(tpred, n_acc[:, None],
+                                           axis=1)[:, 0]
 
-            idx = jnp.arange(k + 1)
-            cand = jnp.where(idx[None, :] < n_acc,
+            idx = jnp.arange(k + 1, dtype=jnp.int32)
+            cand = jnp.where(idx[None, :] < n_acc[:, None],
                              jnp.pad(drafts, ((0, 0), (0, 1))),
                              slot[:, None])
-            out = jax.lax.dynamic_update_slice(out, cand, (0, n_out))
-            n_emit = n_acc + 1
-            # the round's last emitted token is cand[:, n_acc] == slot.
-            # acc_total counts ACCEPTED draft tokens before any crop of
-            # the final round's overshoot — accepted/(k*rounds) is then an
-            # unbiased acceptance rate (emitted-token counts are clipped
-            # at max_new and would understate it, worse at larger k)
+            # per-row scatter at each row's own offset; done rows write
+            # the scratch slot (index max_new + k — the buffer's last
+            # column, never part of the cropped result).  Active rows
+            # write n_out..n_out+k <= max_new-1+k: in bounds, and any
+            # overshoot garbage past a row's final n_out is either
+            # overwritten by its own next round or sits past max_new
+            rows = jnp.arange(b, dtype=jnp.int32)
+            write_pos = jnp.where(done[:, None], jnp.int32(max_new + k),
+                                  n_out[:, None] + idx[None, :])
+            out = out.at[rows[:, None], write_pos].set(cand)
+            n_emit = jnp.where(done, 0, n_acc + 1)
+            # acc/prop totals count ACTIVE rows only, and acceptances
+            # before any crop of the final round's overshoot —
+            # accepted/proposed is then an unbiased acceptance rate
+            # (emitted-token counts are clipped at max_new and would
+            # understate it, worse at larger k)
+            active = (~done).astype(jnp.int32)
             return (t_cache, d_cache, out, n_out + n_emit,
-                    pos + n_emit, slot, key, n_fwd + 1, acc_total + n_acc)
+                    pos + n_emit, jnp.where(done, last, slot), key,
+                    n_fwd + 1,
+                    acc_total + jnp.sum(n_acc * active),
+                    prop_total + k * jnp.sum(active))
 
-        state = (t_cache, d_cache, out, jnp.int32(1), pos0, first, rng,
-                 jnp.int32(0), jnp.int32(0))
-        _, _, out, n_out, _, _, _, n_fwd, acc_total = jax.lax.while_loop(
-            cond, body, state)
-        return out[:, :max_new], n_fwd, acc_total
+        state = (t_cache, d_cache, out, jnp.full((b,), 1, jnp.int32),
+                 jnp.full((b,), 0, jnp.int32) + pos0, first, rng,
+                 jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        (_, _, out, n_out, _, _, _, n_fwd, acc_total,
+         prop_total) = jax.lax.while_loop(cond, body, state)
+        return out[:, :max_new], n_fwd, acc_total, prop_total
 
     return prefill, spec_loop
 
@@ -335,10 +351,11 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     to the target decoding over the same cache representation.
 
     return_stats: also return {"target_forwards": int,
-    "accepted_drafts": int} — forwards is the speedup witness (plain
-    decode needs max_new_tokens forwards); accepted_drafts counts
-    accepted proposals before the final round's overshoot crop, so
-    accepted/(k*rounds) is an unbiased acceptance rate."""
+    "accepted_drafts": int, "proposed_drafts": int} — forwards is the
+    speedup witness (plain decode needs max_new_tokens forwards);
+    accepted/proposed counts cover ACTIVE rows only and acceptances
+    before the final round's overshoot crop, so accepted/proposed is an
+    unbiased acceptance rate."""
     from tf_operator_tpu.models.llama import (
         _decode_fns, _select_token, check_truncation, init_cache,
     )
@@ -416,9 +433,9 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     else:
         first, t_cache, d_cache = prefill(t_params, d_params, t_cache,
                                           d_cache, prompt, k_first)
-    out, n_fwd, acc_total = spec_loop(t_params, d_params, t_cache, d_cache,
-                                      first, jnp.int32(prompt_len), k_loop,
-                                      int(max_new_tokens))
+    out, n_fwd, acc_total, prop_total = spec_loop(
+        t_params, d_params, t_cache, d_cache, first,
+        jnp.int32(prompt_len), k_loop, int(max_new_tokens))
     if eos_id is not None:
         if not 0 <= int(eos_id) < target.cfg.vocab_size:
             raise ValueError(
@@ -435,5 +452,6 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
                         jnp.int32(eos_id), out)
     if return_stats:
         return out, {"target_forwards": int(n_fwd),
-                     "accepted_drafts": int(acc_total)}
+                     "accepted_drafts": int(acc_total),
+                     "proposed_drafts": int(prop_total)}
     return out
