@@ -210,3 +210,123 @@ def test_validation():
     with pytest.raises(ValueError, match="request 1: prompt 40"):
         serve_loop(wmodel2, wparams2, _prompts(wcfg2, [10, 40]),
                    cache_len=16, max_new_tokens=4, slots=1)
+
+
+# ------------------------------------------------- speculative serving
+def _draft_setup(cfg, seed=9):
+    import dataclasses
+
+    d_cfg = dataclasses.replace(cfg, n_layers=1)
+    d_model = llama.Llama(d_cfg)
+    d_params = d_model.init(jax.random.PRNGKey(seed),
+                            jnp.zeros((1, 8), jnp.int32),
+                            train=False)["params"]
+    return d_model, d_params
+
+
+def test_spec_serve_greedy_exact_vs_isolated():
+    """Speculative continuous batching: per-lane draft+verify rounds
+    must leave every request's greedy tokens EXACTLY equal to isolated
+    generate — speculation and lane sharing change throughput only."""
+    cfg, model, params = _setup(max_len=256)
+    d_model, d_params = _draft_setup(cfg)
+    prompts = _prompts(cfg, [6, 11, 3, 9, 7, 5])
+    res = serve_loop(model, params, prompts, slots=2, max_new_tokens=12,
+                     draft=d_model, draft_params=d_params, spec_k=3,
+                     steps_per_sync=2)
+    for r, p in zip(res, prompts):
+        assert r.tokens == _oracle(model, params, p, 12), (
+            f"slot {r.slot} diverged under speculation")
+
+
+def test_spec_serve_eos_frees_slot():
+    """A lane that hits EOS mid-round finishes (overshoot discarded)
+    and its slot admits the next request; outputs still oracle-exact."""
+    cfg, model, params = _setup(max_len=256)
+    d_model, d_params = _draft_setup(cfg)
+    prompts = _prompts(cfg, [5, 8, 4, 6])
+    # pick an eos that actually occurs early for at least one request
+    base = [_oracle(model, params, p, 16) for p in prompts]
+    flat = [t for toks in base for t in toks]
+    eos = max(set(flat), key=flat.count)
+    res = serve_loop(model, params, prompts, slots=2, max_new_tokens=16,
+                     eos_id=eos, draft=d_model, draft_params=d_params,
+                     spec_k=2, steps_per_sync=3)
+    for r, p in zip(res, prompts):
+        assert r.tokens == _oracle(model, params, p, 16, eos_id=eos)
+
+
+def test_spec_serve_window_ring_and_int8():
+    """The flagship composition: sliding-window rings on BOTH models,
+    int8 weights + int8 KV caches, speculative rounds through shared
+    lanes — greedy still oracle-exact (over the same int8-KV
+    representation)."""
+    from tf_operator_tpu.models import quant
+
+    cfg, model, params = _setup(max_len=256, sliding_window=8,
+                                n_layers=2)
+    d_model, d_params = _draft_setup(cfg)
+    prompts = _prompts(cfg, [7, 12, 5])
+    q_params = quant.quantize_params(params)
+    q_draft = quant.quantize_params(d_params)
+    xform = quant.make_dequantizer(cfg.dtype)
+    kw = dict(slots=2, max_new_tokens=10, cache_len=16,
+              draft=d_model, spec_k=3, kv_quant=True,
+              params_transform=xform, draft_transform=xform)
+    res = serve_loop(model, q_params, prompts,
+                     draft_params=q_draft, **kw)
+    for r, p in zip(res, prompts):
+        want = llama.generate(model, q_params, p[None, :], 10,
+                              params_transform=xform, cache_len=16,
+                              kv_quant=True)
+        assert r.tokens == [int(t) for t in np.asarray(want[0])], (
+            f"slot {r.slot} diverged")
+
+
+def test_spec_serve_sampling_seed_deterministic():
+    cfg, model, params = _setup(max_len=256)
+    d_model, d_params = _draft_setup(cfg)
+    prompts = _prompts(cfg, [6, 9])
+    kw = dict(slots=2, max_new_tokens=8, temperature=0.8, top_p=0.9,
+              draft=d_model, draft_params=d_params, spec_k=2)
+    a = serve_loop(model, params, prompts, rng=jax.random.PRNGKey(4), **kw)
+    b = serve_loop(model, params, prompts, rng=jax.random.PRNGKey(4), **kw)
+    assert [r.tokens for r in a] == [r.tokens for r in b]
+    assert all(0 <= t < cfg.vocab_size for r in a for t in r.tokens)
+
+
+def test_spec_serve_validation():
+    cfg, model, params = _setup(max_len=128)
+    d_model, d_params = _draft_setup(cfg)
+    prompts = _prompts(cfg, [5])
+    with pytest.raises(ValueError, match="draft_params"):
+        serve_loop(model, params, prompts, draft=d_model)
+    with pytest.raises(ValueError, match="spec_k"):
+        serve_loop(model, params, prompts, draft=d_model,
+                   draft_params=d_params, spec_k=0)
+    # windowed ring below the window + spec_k bound is refused
+    w_cfg, w_model, w_params = _setup(max_len=256, sliding_window=8)
+    wd_model, wd_params = _draft_setup(w_cfg)
+    with pytest.raises(ValueError, match="window"):
+        serve_loop(w_model, w_params, _prompts(w_cfg, [30]),
+                   max_new_tokens=40, cache_len=9, draft=wd_model,
+                   draft_params=wd_params, spec_k=4, prefill_chunk=3)
+
+
+def test_spec_serve_default_cache_sizing_windowed():
+    """128-multiple window + speculation with cache_len=None: the
+    default sizing must include the spec_k ring slack its own
+    validation demands (it previously refused its own choice: auto
+    gave bucket(window)=128 while validation required window+spec_k).
+    The ring genuinely wraps here (prompt+new exceeds the cache) and
+    greedy output stays oracle-exact."""
+    cfg, model, params = _setup(max_len=1024, sliding_window=128,
+                                n_layers=1)
+    d_model, d_params = _draft_setup(cfg)
+    prompt = _prompts(cfg, [100])[0]
+    res = serve_loop(model, params, [prompt], slots=1,
+                     max_new_tokens=300, draft=d_model,
+                     draft_params=d_params, spec_k=4, steps_per_sync=8)
+    want = llama.generate(model, params, prompt[None, :], 300,
+                          cache_len=256)
+    assert res[0].tokens == [int(t) for t in np.asarray(want[0])]

@@ -103,6 +103,47 @@ def _serve_fns(model, temperature: float, top_k: int, top_p: float,
     return step, insert_row
 
 
+@functools.lru_cache(maxsize=8)
+def _spec_serve_fns(model, draft, k: int, temperature: float, top_k: int,
+                    top_p: float, params_transform=None,
+                    draft_transform=None):
+    """Jitted speculative decode block for serve_loop: n_rounds per-row
+    speculation rounds over the serve lanes, each at its own position.
+    The exactness-critical round math is speculative.make_spec_round —
+    ONE shared copy with the decode loop; this wrapper only adds lane
+    freezing and the per-round emission record the host reads.  Returns
+    per-round candidate tokens and accepted counts."""
+    from tf_operator_tpu.models.speculative import make_spec_round
+
+    t_xform = params_transform or (lambda p: p)
+    d_xform = draft_transform or (lambda p: p)
+    round_core = make_spec_round(model, draft, k, temperature, top_k,
+                                 top_p, t_xform, d_xform)
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3), static_argnums=(8,))
+    def spec_block(t_params, d_params, t_cache, d_cache, tok, pos, frozen,
+                   key, n_rounds: int):
+        def round_body(carry, rkey):
+            t_cache, d_cache, tok, pos = carry
+            t_cache, d_cache, cand, n_acc, slot = round_core(
+                t_params, d_params, t_cache, d_cache, tok, pos, rkey)
+            # frozen lanes emit nothing (n_acc marker -1) and stay put;
+            # their k+1 stale writes are wiped by the next admission's
+            # whole-row insert
+            n_acc = jnp.where(frozen, -1, n_acc)
+            tok = jnp.where(frozen, tok, slot)
+            pos = jnp.where(frozen, pos, pos + n_acc + 1)
+            return (t_cache, d_cache, tok, pos), (cand, n_acc)
+
+        (t_cache, d_cache, tok, pos), (cands, n_accs) = jax.lax.scan(
+            round_body, (t_cache, d_cache, tok, pos),
+            jax.random.split(key, n_rounds))
+        # cands [n_rounds, B, k+1]; n_accs [n_rounds, B] (-1 = frozen)
+        return t_cache, d_cache, tok, pos, cands, n_accs
+
+    return spec_block
+
+
 def serve_loop(model, params, requests: Sequence[Any], *,
                slots: int = 4, max_new_tokens: int = 64,
                eos_id: Optional[int] = None,
@@ -111,7 +152,9 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                top_p: float = 0.0, rng=None,
                params_transform=None, prefill_chunk: Optional[int] = None,
                kv_quant: bool = False,
-               steps_per_sync: int = 8) -> List[ServeResult]:
+               steps_per_sync: int = 8,
+               draft=None, draft_params=None, spec_k: int = 4,
+               draft_transform=None) -> List[ServeResult]:
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
     with continuous admission; returns a ServeResult per request, in
     request order.
@@ -129,6 +172,16 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     token (the dispatch+transfer amortization every serving loop needs;
     worst-case cost is steps_per_sync-1 discarded lane-steps after an
     EOS and the same bound on admission latency — tokens are unchanged).
+
+    draft / draft_params / spec_k / draft_transform: SPECULATIVE
+    continuous batching — every decode block becomes steps_per_sync
+    per-row speculation rounds (models/speculative.py's per-row
+    advance: spec_k draft tokens + one (spec_k+1)-wide target verify
+    per lane, each lane at its own position, up to spec_k+1 tokens
+    emitted per lane per round).  Greedy stays token-identical to
+    target-only serving; both models prefill at admission and the
+    verify write costs spec_k+1 extra cache slots of headroom (bounds
+    validated below).
 
     Greedy outputs are token-identical to per-request llama.generate
     calls; sampling draws its keys from the serve loop's own stream (the
@@ -159,32 +212,69 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    spec = draft is not None
+    if spec:
+        if draft_params is None:
+            raise ValueError("draft model given without draft_params")
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if draft.cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"target vocab {cfg.vocab_size} != draft vocab "
+                f"{draft.cfg.vocab_size} — speculation compares token ids")
+    # speculation headroom: a verify round may write spec_k+1 positions
+    # past a lane's current length (speculative_generate's own bound)
+    headroom = (spec_k + 1) if spec else 0
     longest = max(r.shape[0] for r in reqs)
+    model_cfgs = [("target", cfg)] + ([("draft", draft.cfg)] if spec else [])
     for i, r in enumerate(reqs):
         if r.shape[0] < 1:
             raise ValueError(f"request {i} is empty")
-        if r.shape[0] + max_new_tokens > cfg.max_len:
-            raise ValueError(
-                f"request {i}: prompt {r.shape[0]} + new "
-                f"{max_new_tokens} exceeds max_len {cfg.max_len}")
+        for name, c in model_cfgs:
+            if r.shape[0] + max_new_tokens + headroom > c.max_len:
+                raise ValueError(
+                    f"request {i}: prompt {r.shape[0]} + new "
+                    f"{max_new_tokens}"
+                    + (f" (+{headroom} speculation headroom)" if spec
+                       else "")
+                    + f" exceeds max_len {c.max_len} ({name})")
     if cache_len is None:
-        cache_len = _llama.auto_cache_len(
-            cfg, longest, longest + max_new_tokens, prefill_chunk)
-    # generate()'s visibility rules, per lane: a full-causal model must
-    # hold its longest request's whole sequence (the ring must never
-    # wrap); a windowed one needs at least the window resident
-    worst = longest + max_new_tokens
-    if cfg.sliding_window is None and worst > cache_len:
-        raise ValueError(
-            f"longest prompt {longest} + new {max_new_tokens} exceeds "
-            f"cache length {cache_len} — a full-causal model cannot "
-            f"stream past its cache")
-    if (cfg.sliding_window is not None
-            and cache_len < min(cfg.sliding_window, worst)):
-        raise ValueError(
-            f"cache_len {cache_len} < sliding window "
-            f"{min(cfg.sliding_window, worst)} — visible positions "
-            f"would be overwritten")
+        # size for EVERY model in play; under speculation a windowed
+        # ring needs spec_k extra slots (the validation below demands
+        # window + spec_k — sizing with a widened window keeps the
+        # default self-consistent, including chunk alignment, instead
+        # of refusing its own choice for 128-multiple windows)
+        cache_len = max(
+            _llama.auto_cache_len(
+                (dataclasses.replace(c, sliding_window=c.sliding_window
+                                     + spec_k)
+                 if spec and c.sliding_window is not None else c),
+                longest, longest + max_new_tokens + headroom,
+                prefill_chunk)
+            for _n, c in model_cfgs)
+    # generate()'s visibility rules, per lane and per model: a
+    # full-causal model must hold its longest request's whole sequence
+    # (the ring must never wrap); a windowed one whose ring wraps needs
+    # window (+ spec_k under speculation — the wrapped verify write's
+    # aliased slots must land outside every live query's band,
+    # speculative._spec_cache_len's bound) resident
+    worst = longest + max_new_tokens + headroom
+    for name, c in model_cfgs:
+        if c.sliding_window is None and worst > cache_len:
+            raise ValueError(
+                f"longest prompt {longest} + new {max_new_tokens} "
+                f"(+{headroom} headroom) exceeds cache length "
+                f"{cache_len} — a full-causal {name} model cannot "
+                f"stream past its cache")
+        if c.sliding_window is not None:
+            need = min(c.sliding_window + (spec_k if spec else 0), worst)
+            if cache_len < need:
+                raise ValueError(
+                    f"cache_len {cache_len} < {name} requirement {need} "
+                    f"(window {c.sliding_window}"
+                    + (f" + spec_k {spec_k}" if spec else "")
+                    + ", capped at the no-wrap total) — visible "
+                    "positions would be overwritten")
 
     def _effective_chunk(p_len: int) -> Optional[int]:
         # a chunk >= the prompt is a single-segment prefill (generate's
@@ -203,9 +293,10 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                 f"request {i}: prompt {r.shape[0]} exceeds cache_len "
                 f"{cache_len}; pass prefill_chunk to stream it")
         if chunk is not None:
-            _llama.check_prefill_chunk(
-                chunk, cache_len, cfg.sliding_window,
-                streams_past_cache=True)
+            for _name, c in model_cfgs:
+                _llama.check_prefill_chunk(
+                    chunk, cache_len, c.sliding_window,
+                    streams_past_cache=True)
 
     # jitted pieces: the batch step (compiled once), the row inserter,
     # and llama.generate's own chunk writers for off-batch prefill
@@ -213,6 +304,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                                   float(top_p), params_transform)
     _, chunk_fill, chunk_write = _llama._decode_fns(
         model, 0.0, 0, 0.0, -1, params_transform)
+    if spec:
+        spec_block = _spec_serve_fns(
+            model, draft, int(spec_k), float(temperature), int(top_k),
+            float(top_p), params_transform, draft_transform)
+        _, d_fill, d_write = _llama._decode_fns(
+            draft, 0.0, 0, 0.0, -1, draft_transform)
 
     def prefill_row(prompt):
         """Fill a fresh single-row cache with `prompt` (validated
@@ -222,10 +319,23 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             chunk_fill, chunk_write, params, row, prompt[None, :],
             _effective_chunk(prompt.shape[0]))
 
+    def prefill_draft_row(prompt):
+        """The draft's row cache for an admission (speculation only);
+        the final segment's logits are discarded — only the cache
+        matters (the first token always comes from the TARGET)."""
+        row = _llama.init_cache(draft.cfg, 1, cache_len,
+                                kv_quant=kv_quant)
+        _, row = _llama.stream_prefill(
+            d_fill, d_write, draft_params, row, prompt[None, :],
+            _effective_chunk(prompt.shape[0]))
+        return row
+
     # slot state: cache/tok/pos live on device; occupancy bookkeeping
     # (owner, frozen, emitted) lives on the host — the loop reads tokens
     # back once per step anyway (it must, to detect EOS)
     cache = _llama.init_cache(cfg, slots, cache_len, kv_quant=kv_quant)
+    d_cache = (_llama.init_cache(draft.cfg, slots, cache_len,
+                                 kv_quant=kv_quant) if spec else None)
     tok = jnp.zeros((slots,), jnp.int32)
     pos = jnp.zeros((slots,), jnp.int32)
     frozen_py = [True] * slots
@@ -252,6 +362,9 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             rng, k_first = jax.random.split(rng)
             last_logits, row = prefill_row(reqs[ridx])
             cache = insert_row(cache, row, jnp.int32(s))
+            if spec:
+                d_cache = insert_row(
+                    d_cache, prefill_draft_row(reqs[ridx]), jnp.int32(s))
             first = int(_llama._select_token(
                 last_logits, temperature, k_first, top_k, top_p)[0])
             owner[s] = ridx
@@ -266,17 +379,40 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             continue  # all lanes finished instantly; admit more
         # ---- one decode BLOCK for every lane, each at its own position
         rng, k_step = jax.random.split(rng)
-        cache, tok, pos, toks = step(params, cache, tok, pos,
-                                     jnp.asarray(frozen_py), k_step,
-                                     steps_per_sync)
-        block = jax.device_get(toks)  # [steps_per_sync, B]
-        for i in range(steps_per_sync):
-            n_step += 1
-            for s in range(slots):
-                if owner[s] is None or frozen_py[s]:
-                    continue
-                t = int(block[i, s])
-                emitted[s].append(t)
-                if t == eos or len(emitted[s]) >= max_new_tokens:
-                    finish(s)  # later in-block tokens are overshoot
+        if spec:
+            # steps_per_sync speculation ROUNDS: each emits up to
+            # spec_k+1 tokens per lane; a lane that hits EOS or budget
+            # mid-block keeps speculating to the block edge and the
+            # host discards the overshoot (same contract as the
+            # single-token block, scaled by the round width)
+            cache, d_cache, tok, pos, cands, n_accs = spec_block(
+                params, draft_params, cache, d_cache, tok, pos,
+                jnp.asarray(frozen_py), k_step, steps_per_sync)
+            cands = jax.device_get(cands)    # [rounds, B, spec_k+1]
+            n_accs = jax.device_get(n_accs)  # [rounds, B]; -1 = frozen
+            for i in range(steps_per_sync):
+                n_step += 1
+                for s in range(slots):
+                    if owner[s] is None or frozen_py[s]:
+                        continue
+                    for t in cands[i, s, :int(n_accs[i, s]) + 1]:
+                        emitted[s].append(int(t))
+                        if (int(t) == eos
+                                or len(emitted[s]) >= max_new_tokens):
+                            finish(s)
+                            break
+        else:
+            cache, tok, pos, toks = step(params, cache, tok, pos,
+                                         jnp.asarray(frozen_py), k_step,
+                                         steps_per_sync)
+            block = jax.device_get(toks)  # [steps_per_sync, B]
+            for i in range(steps_per_sync):
+                n_step += 1
+                for s in range(slots):
+                    if owner[s] is None or frozen_py[s]:
+                        continue
+                    t = int(block[i, s])
+                    emitted[s].append(t)
+                    if t == eos or len(emitted[s]) >= max_new_tokens:
+                        finish(s)  # later in-block tokens are overshoot
     return results  # type: ignore[return-value]

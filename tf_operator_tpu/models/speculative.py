@@ -75,6 +75,114 @@ def residual_sample(key, t_probs, d_probs):
     return jax.random.categorical(key, jnp.log(jnp.maximum(res, 1e-30)))
 
 
+def make_spec_round(target, draft, k: int, temperature: float,
+                    top_k: int, top_p: float, t_xform, d_xform,
+                    wrap_target: bool = False):
+    """THE speculation round — the one copy of the exactness-critical
+    math (truncate-then-sample draft proposals, the u*p_d < p_t
+    acceptance rule over identical truncated distributions, the padded
+    residual that doubles as the bonus draw).  Shared by
+    speculative_generate's decode loop and serving.serve_loop's
+    speculative decode blocks, which differ only in how they advance
+    state and emit tokens.
+
+    round_core(t_params, d_params, t_cache, d_cache, last, pos, rkey)
+      -> (t_cache, d_cache, cand [B, k+1], n_acc [B], slot [B])
+    where pos is a PER-ROW position vector, cand[:, :n_acc+1] are the
+    row's emitted tokens for the round, and slot == cand[:, n_acc] is
+    the round's final token (the caller's next `last`)."""
+    from tf_operator_tpu.models.llama import _truncate_logits
+
+    sampling = temperature > 0.0
+
+    def round_core(t_params, d_params, t_cache, d_cache, last, pos, rkey):
+        b = last.shape[0]
+        k_draft, k_accept, k_fix = jax.random.split(rkey, 3)
+
+        # ---- draft k tokens, single-token steps.  The scan runs
+        # k+1 steps: the extra step's OUTPUT is discarded, but its
+        # cache write records d_k's K/V at pos+k — without it, a
+        # fully-accepted round leaves a zero hole at that slot that
+        # every later draft query silently attends (the position
+        # mask treats any slot <= q_pos as written), eroding
+        # acceptance on exactly the high-agreement path.  When the
+        # round is rejected early the extra write is stale and
+        # invisible like every other rolled-back slot.
+        def dstep(carry, step_key):
+            d_cache, tok, dpos = carry
+            logits, d_cache = draft.apply(
+                {"params": d_xform(d_params)}, tok[:, None],
+                cache=d_cache, cache_pos=dpos)
+            lg = logits[:, 0]
+            if sampling:
+                # truncate FIRST, then sample and record softmax of
+                # the same masked logits: probs must be the exact
+                # distribution the proposal was drawn from or the
+                # acceptance ratio loses the exactness proof
+                ml = _truncate_logits(lg, temperature, top_k, top_p)
+                nxt = jax.random.categorical(
+                    step_key, ml, axis=-1).astype(jnp.int32)
+                probs = jax.nn.softmax(ml, axis=-1)
+            else:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                # greedy compares argmaxes and never reads probs;
+                # kept for a uniform scan carry shape
+                probs = jax.nn.softmax(lg, axis=-1)
+            return (d_cache, nxt, dpos + 1), (nxt, probs)
+
+        (d_cache, _, _), (drafts, dprobs) = jax.lax.scan(
+            dstep, (d_cache, last, pos),
+            jax.random.split(k_draft, k + 1))
+        drafts = drafts.T[:, :k]      # [B, k]; step k+1 wrote cache
+        dprobs = dprobs.transpose(1, 0, 2)[:, :k]  # [B, k, V]
+
+        # ---- one target forward over [last, d_1..d_k]
+        seq = jnp.concatenate([last[:, None], drafts], axis=1)
+        t_logits, t_cache = target.apply(
+            {"params": t_xform(t_params)}, seq, cache=t_cache,
+            cache_pos=pos, wrap_cache_write=wrap_target)
+
+        if sampling:
+            tprobs = jax.nn.softmax(
+                _truncate_logits(t_logits, temperature, top_k, top_p),
+                axis=-1)
+            # accept x_i with prob min(1, p_t(x_i)/p_d(x_i))
+            p_t = jnp.take_along_axis(
+                tprobs[:, :k], drafts[..., None], axis=2)[..., 0]
+            p_d = jnp.take_along_axis(
+                dprobs, drafts[..., None], axis=2)[..., 0]
+            u = jax.random.uniform(k_accept, (b, k))
+            accept = (u * jnp.maximum(p_d, 1e-30) < p_t).astype(
+                jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # [B]
+            # slot n_acc, per row: rejected there -> residual draw.
+            # The all-k-accepted bonus needs no special case: then the
+            # padded d_at row is all zeros, so residual_sample's
+            # norm(max(p_t - 0, 0)) IS an exact draw from the target
+            # distribution.
+            t_at = jnp.take_along_axis(
+                tprobs, n_acc[:, None, None], axis=1)[:, 0]   # [B, V]
+            d_at = jnp.take_along_axis(
+                jnp.pad(dprobs, ((0, 0), (0, 1), (0, 0))),
+                n_acc[:, None, None], axis=1)[:, 0]           # [B, V]
+            slot = residual_sample(k_fix, t_at, d_at).astype(jnp.int32)
+        else:
+            tpred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            match = (drafts == tpred[:, :k]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)   # [B]
+            # the target's own token at the first disagreement
+            slot = jnp.take_along_axis(tpred, n_acc[:, None],
+                                       axis=1)[:, 0]
+
+        idx = jnp.arange(k + 1, dtype=jnp.int32)
+        cand = jnp.where(idx[None, :] < n_acc[:, None],
+                         jnp.pad(drafts, ((0, 0), (0, 1))),
+                         slot[:, None])
+        return t_cache, d_cache, cand, n_acc, slot
+
+    return round_core
+
+
 @functools.lru_cache(maxsize=8)
 def _spec_fns(target, draft, k: int, temperature: float,
               target_transform=None, draft_transform=None,
@@ -93,11 +201,10 @@ def _spec_fns(target, draft, k: int, temperature: float,
     standard speculative-sampling proof applies unchanged to any
     modified target distribution as long as p_draft is the actual
     proposal distribution."""
-    from tf_operator_tpu.models.llama import _select_token, _truncate_logits
+    from tf_operator_tpu.models.llama import _select_token
 
     t_xform = target_transform or (lambda p: p)
     d_xform = draft_transform or (lambda p: p)
-    sampling = temperature > 0.0
 
     def _first_token(logits, key):
         # llama's own selection dispatch: keeps the greedy contract
@@ -128,96 +235,23 @@ def _spec_fns(target, draft, k: int, temperature: float,
         def cond(state):
             return jnp.any(state[3] < max_new)
 
+        round_core = make_spec_round(target, draft, k, temperature,
+                                     top_k, top_p, t_xform, d_xform,
+                                     wrap_target)
+
         def body(state):
             (t_cache, d_cache, out, n_out, pos, last, key, n_fwd,
              acc_total, prop_total) = state
-            key, k_draft, k_accept, k_fix = jax.random.split(key, 4)
+            key, rkey = jax.random.split(key)
             # PER-ROW advance: each row keeps its own accepted prefix
             # (no lockstep min — a batch is not diluted to its slowest
             # row).  Rows that reached max_new are done: they keep
             # computing (SPMD lanes can't exit) but their state freezes
             # and their writes land on the out buffer's scratch slot.
             done = n_out >= max_new                       # [B]
-
-            # ---- draft k tokens, single-token steps.  The scan runs
-            # k+1 steps: the extra step's OUTPUT is discarded, but its
-            # cache write records d_k's K/V at pos+k — without it, a
-            # fully-accepted round leaves a zero hole at that slot that
-            # every later draft query silently attends (the position
-            # mask treats any slot <= q_pos as written), eroding
-            # acceptance on exactly the high-agreement path.  When the
-            # round is rejected early the extra write is stale and
-            # invisible like every other rolled-back slot.
-            def dstep(carry, step_key):
-                d_cache, tok, dpos = carry
-                logits, d_cache = draft.apply(
-                    {"params": d_xform(d_params)}, tok[:, None],
-                    cache=d_cache, cache_pos=dpos)
-                lg = logits[:, 0]
-                if sampling:
-                    # truncate FIRST, then sample and record softmax of
-                    # the same masked logits: probs must be the exact
-                    # distribution the proposal was drawn from or the
-                    # acceptance ratio loses the exactness proof
-                    ml = _truncate_logits(lg, temperature, top_k, top_p)
-                    nxt = jax.random.categorical(
-                        step_key, ml, axis=-1).astype(jnp.int32)
-                    probs = jax.nn.softmax(ml, axis=-1)
-                else:
-                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                    # greedy compares argmaxes and never reads probs;
-                    # kept for a uniform scan carry shape
-                    probs = jax.nn.softmax(lg, axis=-1)
-                return (d_cache, nxt, dpos + 1), (nxt, probs)
-
-            (d_cache, _, _), (drafts, dprobs) = jax.lax.scan(
-                dstep, (d_cache, last, pos),
-                jax.random.split(k_draft, k + 1))
-            drafts = drafts.T[:, :k]      # [B, k]; step k+1 wrote cache
-            dprobs = dprobs.transpose(1, 0, 2)[:, :k]  # [B, k, V]
-
-            # ---- one target forward over [last, d_1..d_k]
-            seq = jnp.concatenate([last[:, None], drafts], axis=1)
-            t_logits, t_cache = target.apply(
-                {"params": t_xform(t_params)}, seq, cache=t_cache,
-                cache_pos=pos, wrap_cache_write=wrap_target)
-
-            if sampling:
-                tprobs = jax.nn.softmax(
-                    _truncate_logits(t_logits, temperature, top_k, top_p),
-                    axis=-1)
-                # accept x_i with prob min(1, p_t(x_i)/p_d(x_i))
-                p_t = jnp.take_along_axis(
-                    tprobs[:, :k], drafts[..., None], axis=2)[..., 0]
-                p_d = jnp.take_along_axis(
-                    dprobs, drafts[..., None], axis=2)[..., 0]
-                u = jax.random.uniform(k_accept, (b, k))
-                accept = (u * jnp.maximum(p_d, 1e-30) < p_t).astype(
-                    jnp.int32)
-                n_acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # [B]
-                # slot n_acc, per row: rejected there -> residual draw.
-                # The all-k-accepted bonus needs no special case: then
-                # the padded d_at row is all zeros, so residual_sample's
-                # norm(max(p_t - 0, 0)) IS an exact draw from the target
-                # distribution.
-                t_at = jnp.take_along_axis(
-                    tprobs, n_acc[:, None, None], axis=1)[:, 0]  # [B, V]
-                d_at = jnp.take_along_axis(
-                    jnp.pad(dprobs, ((0, 0), (0, 1), (0, 0))),
-                    n_acc[:, None, None], axis=1)[:, 0]          # [B, V]
-                slot = residual_sample(k_fix, t_at, d_at).astype(jnp.int32)
-            else:
-                tpred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-                match = (drafts == tpred[:, :k]).astype(jnp.int32)
-                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)   # [B]
-                # the target's own token at the first disagreement
-                slot = jnp.take_along_axis(tpred, n_acc[:, None],
-                                           axis=1)[:, 0]
-
+            t_cache, d_cache, cand, n_acc, slot = round_core(
+                t_params, d_params, t_cache, d_cache, last, pos, rkey)
             idx = jnp.arange(k + 1, dtype=jnp.int32)
-            cand = jnp.where(idx[None, :] < n_acc[:, None],
-                             jnp.pad(drafts, ((0, 0), (0, 1))),
-                             slot[:, None])
             # per-row scatter at each row's own offset; done rows write
             # the scratch slot (index max_new + k — the buffer's last
             # column, never part of the cropped result).  Active rows
