@@ -330,3 +330,24 @@ def test_spec_serve_default_cache_sizing_windowed():
     want = llama.generate(model, params, prompt[None, :], 300,
                           cache_len=256)
     assert res[0].tokens == [int(t) for t in np.asarray(want[0])]
+
+
+def test_spec_serve_draft_smaller_max_len():
+    """A draft whose max_len is smaller than the target's (and not a
+    128-multiple) gets its own ring capped at ITS max_len instead of
+    crashing in init_cache on the shared auto-sized value; outputs stay
+    oracle-exact."""
+    import dataclasses
+
+    cfg, model, params = _setup(max_len=512)
+    d_cfg = dataclasses.replace(cfg, n_layers=1, max_len=200)
+    d_model = llama.Llama(d_cfg)
+    d_params = d_model.init(jax.random.PRNGKey(9),
+                            jnp.zeros((1, 8), jnp.int32),
+                            train=False)["params"]
+    prompt = _prompts(cfg, [100])[0]
+    res = serve_loop(model, params, [prompt], slots=1,
+                     max_new_tokens=90, draft=d_model,
+                     draft_params=d_params, spec_k=4, steps_per_sync=4)
+    want = llama.generate(model, params, prompt[None, :], 90)
+    assert res[0].tokens == [int(t) for t in np.asarray(want[0])]

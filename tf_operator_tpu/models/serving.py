@@ -252,6 +252,11 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                 longest, longest + max_new_tokens + headroom,
                 prefill_chunk)
             for _n, c in model_cfgs)
+    # each model's ring is capped at ITS max_len (the RoPE-table bound
+    # init_cache enforces): a small draft beside a large target gets a
+    # smaller ring, and every check below runs against the model's own
+    # effective length
+    eff_len = {name: min(cache_len, c.max_len) for name, c in model_cfgs}
     # generate()'s visibility rules, per lane and per model: a
     # full-causal model must hold its longest request's whole sequence
     # (the ring must never wrap); a windowed one whose ring wraps needs
@@ -260,18 +265,18 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # speculative._spec_cache_len's bound) resident
     worst = longest + max_new_tokens + headroom
     for name, c in model_cfgs:
-        if c.sliding_window is None and worst > cache_len:
+        if c.sliding_window is None and worst > eff_len[name]:
             raise ValueError(
                 f"longest prompt {longest} + new {max_new_tokens} "
                 f"(+{headroom} headroom) exceeds cache length "
-                f"{cache_len} — a full-causal {name} model cannot "
+                f"{eff_len[name]} — a full-causal {name} model cannot "
                 f"stream past its cache")
         if c.sliding_window is not None:
             need = min(c.sliding_window + (spec_k if spec else 0), worst)
-            if cache_len < need:
+            if eff_len[name] < need:
                 raise ValueError(
-                    f"cache_len {cache_len} < {name} requirement {need} "
-                    f"(window {c.sliding_window}"
+                    f"cache_len {eff_len[name]} < {name} requirement "
+                    f"{need} (window {c.sliding_window}"
                     + (f" + spec_k {spec_k}" if spec else "")
                     + ", capped at the no-wrap total) — visible "
                     "positions would be overwritten")
@@ -288,14 +293,15 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # already decoded
     for i, r in enumerate(reqs):
         chunk = _effective_chunk(r.shape[0])
-        if chunk is None and r.shape[0] > cache_len:
+        if chunk is None and r.shape[0] > min(eff_len.values()):
             raise ValueError(
                 f"request {i}: prompt {r.shape[0]} exceeds cache_len "
-                f"{cache_len}; pass prefill_chunk to stream it")
+                f"{min(eff_len.values())}; pass prefill_chunk to "
+                f"stream it")
         if chunk is not None:
-            for _name, c in model_cfgs:
+            for name, c in model_cfgs:
                 _llama.check_prefill_chunk(
-                    chunk, cache_len, c.sliding_window,
+                    chunk, eff_len[name], c.sliding_window,
                     streams_past_cache=True)
 
     # jitted pieces: the batch step (compiled once), the row inserter,
@@ -314,7 +320,8 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     def prefill_row(prompt):
         """Fill a fresh single-row cache with `prompt` (validated
         above); returns (last logits, row cache)."""
-        row = _llama.init_cache(cfg, 1, cache_len, kv_quant=kv_quant)
+        row = _llama.init_cache(cfg, 1, eff_len["target"],
+                                kv_quant=kv_quant)
         return _llama.stream_prefill(
             chunk_fill, chunk_write, params, row, prompt[None, :],
             _effective_chunk(prompt.shape[0]))
@@ -323,7 +330,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         """The draft's row cache for an admission (speculation only);
         the final segment's logits are discarded — only the cache
         matters (the first token always comes from the TARGET)."""
-        row = _llama.init_cache(draft.cfg, 1, cache_len,
+        row = _llama.init_cache(draft.cfg, 1, eff_len["draft"],
                                 kv_quant=kv_quant)
         _, row = _llama.stream_prefill(
             d_fill, d_write, draft_params, row, prompt[None, :],
@@ -333,8 +340,9 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # slot state: cache/tok/pos live on device; occupancy bookkeeping
     # (owner, frozen, emitted) lives on the host — the loop reads tokens
     # back once per step anyway (it must, to detect EOS)
-    cache = _llama.init_cache(cfg, slots, cache_len, kv_quant=kv_quant)
-    d_cache = (_llama.init_cache(draft.cfg, slots, cache_len,
+    cache = _llama.init_cache(cfg, slots, eff_len["target"],
+                              kv_quant=kv_quant)
+    d_cache = (_llama.init_cache(draft.cfg, slots, eff_len["draft"],
                                  kv_quant=kv_quant) if spec else None)
     tok = jnp.zeros((slots,), jnp.int32)
     pos = jnp.zeros((slots,), jnp.int32)

@@ -344,6 +344,7 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
                          prefill_chunk: Optional[int] = None,
                          kv_quant: bool = False,
                          top_k: int = 0, top_p: float = 0.0,
+                         cache_sharding=None, draft_cache_sharding=None,
                          return_stats: bool = False):
     """Speculative decoding: [B, max_new_tokens] tokens produced in
     ~(accepted+1)-token chunks per target forward.  temperature 0 =
@@ -383,6 +384,11 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     kv_quant).  Greedy output stays token-identical to
     generate(..., kv_quant=True) — the exactness contract is relative
     to the target decoding over the same cache representation.
+
+    cache_sharding / draft_cache_sharding: generate()'s tensor-parallel
+    serving seam (parallel/tp.kv_cache_sharding), one per model — shard
+    params with transformer_param_sharding and both KV caches follow;
+    tokens stay exactly equal to the single-device run.
 
     return_stats: also return {"target_forwards": int,
     "accepted_drafts": int, "proposed_drafts": int} — forwards is the
@@ -438,6 +444,12 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     k_first, k_loop = jax.random.split(rng)
     t_cache = init_cache(target.cfg, b, c_t, kv_quant=kv_quant)
     d_cache = init_cache(draft.cfg, b, c_d, kv_quant=kv_quant)
+    # tensor-parallel serving seam, generate()'s cache_sharding contract:
+    # one NamedSharding broadcasts over every leaf of each model's cache
+    if cache_sharding is not None:
+        t_cache = jax.device_put(t_cache, cache_sharding)
+    if draft_cache_sharding is not None:
+        d_cache = jax.device_put(d_cache, draft_cache_sharding)
 
     prefill, spec_loop = _spec_fns(target, draft, int(k),
                                    float(temperature),
