@@ -606,7 +606,9 @@ def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4,
     out, stats = speculative_generate(
         model, params, model, params, prompt, max_new, k=k,
         return_stats=True)
-    exact = bool((jnp.asarray(out) == jnp.asarray(plain)).all())
+    self_match = float(jnp.mean(
+        (jnp.asarray(out) == jnp.asarray(plain)).astype(jnp.float32)))
+    exact = self_match == 1.0
     result = {
         "plain_decode_tokens_per_sec": round(b * max_new / t_plain, 1),
         "self_draft_witness": {
@@ -621,6 +623,9 @@ def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4,
             "best_case_forward_reduction": round(
                 (max_new - 1) / stats["target_forwards"], 2),
             "output_equals_plain_greedy": exact,
+            # separates bf16 near-tie argmax drift between the verify
+            # and single-token paths from a real divergence (see k_sweep)
+            "token_match_frac_vs_plain": round(self_match, 4),
         },
     }
 
@@ -645,6 +650,16 @@ def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4,
             # the rate is unbiased (emitted-token derivations understate
             # acceptance, worse at larger k)
             acc = st["accepted_drafts"] / max(1, st["proposed_drafts"])
+            # greedy exactness is an exact-arithmetic contract (pinned
+            # in f32 by tests/test_speculative.py); in bf16 on TPU the
+            # (k+1)-wide verify and the single-token decode can tile
+            # matmuls differently, so near-tie argmaxes may drift — a
+            # match FRACTION separates that float-level drift (~1 in
+            # 100 on random weights, rarer on trained ones) from a real
+            # divergence a bare bool would conflate
+            match = float(jnp.mean(
+                (jnp.asarray(o2) == jnp.asarray(plain)).astype(
+                    jnp.float32)))
             sweep[f"k{kk}"] = {
                 "acceptance_rate": round(acc, 3),
                 "target_forwards": n_fwd,
@@ -652,8 +667,8 @@ def bench_speculative(gen: str, cfg=None, max_new: int = 64, k: int = 4,
                     (max_new - 1) / n_fwd, 2),
                 "tokens_per_sec": round(b * max_new / t_spec, 1),
                 "speedup_vs_plain": round(t_plain / t_spec, 2),
-                "exact": bool(
-                    (jnp.asarray(o2) == jnp.asarray(plain)).all()),
+                "exact": match == 1.0,
+                "token_match_frac_vs_plain": round(match, 4),
             }
         return sweep
 
@@ -887,7 +902,12 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 16,
         t_spec = time.perf_counter() - t0
         n_spec = sum(len(r.tokens) for r in res)
         out["speculative"] = {
-            "draft": "int8 self-draft",
+            # the int8 self-draft accepts ~0.9 of proposals but costs
+            # nearly a full target forward per draft step, so this row
+            # witnesses the spec-serving PLUMBING at realistic
+            # acceptance — wall-clock gains need a genuinely cheaper
+            # (trained, shallower) draft
+            "draft": "int8 self-draft (acceptance/plumbing witness)",
             "spec_k": 3,
             "tokens_per_sec": round(n_spec / t_spec, 1),
             "speedup_vs_plain_serve": round(t_serve / t_spec, 2),
