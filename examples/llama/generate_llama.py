@@ -16,9 +16,10 @@ with any combination of:
                   greedy output is token-identical to plain decoding,
                   temperature sampling is distribution-exact
   --temperature/--top-k/--top-p
-                  plain sampling controls (top-k/top-p: plain decode
-                  only — the speculative acceptance ratio must match
-                  the sampled distributions)
+                  sampling controls; compose with speculation (both
+                  models' distributions truncate + renormalize before
+                  the acceptance ratio, keeping emitted tokens exact
+                  draws from the truncated target distribution)
 
 Smoke (no checkpoint, random tiny weights, CPU ok):
   python examples/llama/generate_llama.py --smoke --prompt "hello" \
@@ -73,6 +74,43 @@ def load_params(model, cfg, ckpt_dir: str, hf_dir: str,
     return params  # --smoke: random weights
 
 
+def resolve_config(args):
+    """Model config from the preset / --smoke / --hf-dir flags — shared
+    with the serving CLI (serve_llama.py)."""
+    presets = {"llama3": llama3_8b, "llama31": llama31_8b,
+               "mistral": mistral_7b, "mixtral": mixtral_8x7b}
+    if args.smoke:
+        cfg = tiny(tie_embeddings=True, dtype=jnp.float32, max_len=256)
+    else:
+        cfg = presets[args.model](tie_embeddings=True)
+    if args.hf_dir:
+        import transformers
+
+        from tf_operator_tpu.models.convert import config_from_hf
+
+        cfg = config_from_hf(
+            transformers.AutoConfig.from_pretrained(
+                args.hf_dir, local_files_only=True))
+    return cfg
+
+
+def build_draft(args, cfg):
+    """(draft model, draft params) from --draft-ckpt-dir/--draft-layers
+    (quantized when --int8) — shared with the serving CLI."""
+    import dataclasses
+
+    d_layers = args.draft_layers or max(1, cfg.n_layers // 4)
+    d_cfg = dataclasses.replace(cfg, n_layers=d_layers)
+    d_model = Llama(d_cfg)
+    d_params = load_params(d_model, d_cfg, args.draft_ckpt_dir, "",
+                           smoke=args.smoke)
+    if args.int8:
+        from tf_operator_tpu.models import quant
+
+        d_params = quant.quantize_params(d_params)
+    return d_model, d_params
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--prompt", required=True)
@@ -108,19 +146,7 @@ def main(argv=None) -> int:
                     help="tiny random model, CPU ok")
     args = ap.parse_args(argv)
 
-    presets = {"llama3": llama3_8b, "llama31": llama31_8b,
-               "mistral": mistral_7b, "mixtral": mixtral_8x7b}
-    if args.smoke:
-        cfg = tiny(tie_embeddings=True, dtype=jnp.float32, max_len=256)
-    else:
-        cfg = presets[args.model](tie_embeddings=True)
-    if args.hf_dir:
-        from tf_operator_tpu.models.convert import config_from_hf
-        import transformers
-
-        cfg = config_from_hf(
-            transformers.AutoConfig.from_pretrained(
-                args.hf_dir, local_files_only=True))
+    cfg = resolve_config(args)
     model = Llama(cfg)
     params = load_params(model, cfg, args.ckpt_dir, args.hf_dir,
                          smoke=args.smoke)
@@ -147,18 +173,11 @@ def main(argv=None) -> int:
     if speculative:
         from tf_operator_tpu.models.speculative import speculative_generate
 
-        import dataclasses
-
-        d_layers = args.draft_layers or max(1, cfg.n_layers // 4)
-        d_cfg = dataclasses.replace(cfg, n_layers=d_layers)
-        d_model = Llama(d_cfg)
-        d_params = load_params(d_model, d_cfg, args.draft_ckpt_dir, "",
-                               smoke=args.smoke)
+        d_model, d_params = build_draft(args, cfg)
         d_kw = {}
         if args.int8:
             from tf_operator_tpu.models import quant
 
-            d_params = quant.quantize_params(d_params)
             d_kw = {"draft_transform": quant.make_dequantizer(cfg.dtype)}
         if args.prefill_chunk:
             # long prompts stream into both rings segment by segment

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""[+] Serving CLI: continuous batching over a request list.
+
+Feeds a batch of prompts through `models/serving.serve_loop` — a fixed
+set of decode lanes with slot admission (a finished request frees its
+lane and the next queued prompt prefills into it while every other lane
+keeps decoding).  Every serving feature composes here:
+
+  --slots N          decode lanes (the static batch whose occupancy
+                     changes)
+  --int8 / --int8-kv weight-only int8 decode / int8 KV caches
+  --draft-layers K   SPECULATIVE serving: per-lane draft+verify rounds
+                     (spec_k tokens per round) through the same lanes
+  --temperature/--top-k/--top-p   sampling (composes with speculation)
+  --prefill-chunk    long prompts stream into each lane's cache in
+                     segments
+  --steps-per-sync   decode-block size between host syncs (scheduling
+                     only — tokens are invariant, test_block_size_...)
+
+Prompts come one per line from --prompts-file, or from repeated
+--prompt flags.  Outputs print in request order with scheduling
+metadata (slot, admitted/finished step) and aggregate tokens/sec.
+
+Smoke (no checkpoint, random tiny weights, CPU ok):
+  python examples/llama/serve_llama.py --smoke \
+      --prompt "hello" --prompt "the quick brown fox" --max-new 16
+
+Weight loading (checkpoint / local-HF / tokenizer flags) is shared with
+generate_llama.py.  No reference counterpart (the reference has no
+serving code, SURVEY.md §5.7).
+"""
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.data.tokenize import load_tokenizer
+from tf_operator_tpu.models.llama import Llama
+from tf_operator_tpu.models.serving import serve_loop
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prompt", action="append", default=[],
+                    help="repeatable; one request per flag")
+    ap.add_argument("--prompts-file", default="",
+                    help="one prompt per line")
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--steps-per-sync", type=int, default=8)
+    ap.add_argument("--model", default="llama3",
+                    choices=["llama3", "llama31", "mistral", "mixtral"])
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--hf-dir", default="")
+    ap.add_argument("--tokenizer", default="byte")
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--int8-kv", action="store_true")
+    ap.add_argument("--draft-ckpt-dir", default="",
+                    help="draft checkpoint -> speculative serving")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="smoke: random draft with this many layers")
+    ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--prefill-chunk", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args(argv)
+
+    prompts = list(args.prompt)
+    if args.prompts_file:
+        with open(args.prompts_file) as f:
+            prompts += [line.rstrip("\n") for line in f if line.strip()]
+    if not prompts:
+        raise SystemExit("no requests: pass --prompt or --prompts-file")
+
+    # model/weights/draft setup shared with the generation CLI (loaded
+    # by path — examples/ is scripts, not a package)
+    import importlib.util
+    import os
+
+    _spec = importlib.util.spec_from_file_location(
+        "generate_llama",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "generate_llama.py"))
+    _gen = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_gen)
+
+    cfg = _gen.resolve_config(args)
+    model = Llama(cfg)
+    params = _gen.load_params(model, cfg, args.ckpt_dir, args.hf_dir,
+                              smoke=args.smoke)
+
+    tok = load_tokenizer(args.tokenizer)
+    requests = []
+    for i, p in enumerate(prompts):
+        ids = tok.encode(p)
+        if not ids:
+            raise SystemExit(
+                f"request {i} ({p!r}): empty prompt after tokenization")
+        requests.append(jnp.asarray(ids, jnp.int32))
+
+    # top_k/top_p forward verbatim (including invalid values: the
+    # library's own validation message beats a silent mask here)
+    kw = {"top_k": args.top_k, "top_p": args.top_p}
+    if args.int8:
+        from tf_operator_tpu.models import quant
+
+        params = quant.quantize_params(params)
+        kw["params_transform"] = quant.make_dequantizer(cfg.dtype)
+        print("weights: int8 + per-channel scales")
+    if args.int8_kv:
+        kw["kv_quant"] = True
+        print("kv caches: int8 + per-head scales")
+    if args.prefill_chunk:
+        kw["prefill_chunk"] = args.prefill_chunk
+    if args.temperature > 0.0:
+        kw.update(temperature=args.temperature,
+                  rng=jax.random.PRNGKey(args.seed))
+
+    if args.draft_ckpt_dir or args.draft_layers:
+        d_model, d_params = _gen.build_draft(args, cfg)
+        if args.int8:
+            from tf_operator_tpu.models import quant
+
+            kw["draft_transform"] = quant.make_dequantizer(cfg.dtype)
+        kw.update(draft=d_model, draft_params=d_params,
+                  spec_k=args.spec_k)
+        print(f"speculative serving: {d_model.cfg.n_layers}-layer "
+              f"draft, k={args.spec_k}")
+
+    t0 = time.perf_counter()
+    results = serve_loop(model, params, requests, slots=args.slots,
+                         max_new_tokens=args.max_new,
+                         eos_id=tok.eos_id,
+                         steps_per_sync=args.steps_per_sync, **kw)
+    dt = time.perf_counter() - t0
+    n_tokens = sum(len(r.tokens) for r in results)
+    for i, (p, r) in enumerate(zip(prompts, results)):
+        print(f"--- request {i} (slot {r.slot}, steps "
+              f"{r.admitted_at_step}->{r.finished_at_step})")
+        print(f"    {p!r} -> {tok.decode(r.tokens)!r}")
+    print(f"{len(requests)} requests, {n_tokens} tokens through "
+          f"{args.slots} lanes in {dt:.2f}s "
+          f"({n_tokens / dt:.1f} tok/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

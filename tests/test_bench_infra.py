@@ -352,3 +352,24 @@ def test_bench_speculative_path_runs_on_tiny_config():
         assert row["exact"] is True, kk
         assert 0.5 < row["acceptance_rate"] <= 1.0, (kk, row)
         assert row["tokens_per_target_forward"] > 1.5, (kk, row)
+
+
+def test_bench_llama_decode_batch_sweep_tiny():
+    """The batch-sweep branch: result reuse for the headline batch,
+    fresh-prompt points for the others, mode markers on every entry."""
+    import jax.numpy as jnp
+
+    from bench import bench_llama_decode
+    from tf_operator_tpu.models import llama as llm
+
+    r = bench_llama_decode(
+        "cpu", cfg=llm.tiny(dtype=jnp.float32, max_len=256), max_new=8,
+        batch_sweep=(4, 2))
+    sweep = r["decode_batch_sweep_tokens_per_sec"]
+    assert set(sweep) == {"b4", "b2"}
+    # b4 is the headline batch: reused, not re-measured
+    assert sweep["b4"]["tokens_per_sec"] == r["decode_tokens_per_sec"]
+    assert sweep["b4"]["mode"] == r["decode_rate_mode"]
+    for v in sweep.values():
+        assert v["mode"] in ("whole_run", "decode_only")
+        assert 0 < v["tokens_per_sec"] < 1e6

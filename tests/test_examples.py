@@ -432,3 +432,20 @@ def test_train_then_generate_checkpoint_roundtrip(tmp_path):
     assert rc2.returncode == 0, rc2.stderr[-2000:]
     assert "restored step" in rc2.stdout
     assert "tokens: [" in rc2.stdout
+
+
+def test_serve_cli_smoke_modes(tmp_path):
+    """The serving CLI: continuous batching over a prompt list — plain,
+    speculative (draft+verify rounds through the lanes), and the full
+    int8+spec+sampling composition all serve on the tiny smoke model."""
+    for extra in ((), ("--draft-layers", "1", "--spec-k", "2"),
+                  ("--int8", "--int8-kv", "--draft-layers", "1",
+                   "--temperature", "0.8", "--top-p", "0.9")):
+        rc = _run("llama/serve_llama.py", "--smoke",
+                  "--prompt", "hello world", "--prompt", "again",
+                  "--prompt", "third request",
+                  "--max-new", "8", "--slots", "2",
+                  "--steps-per-sync", "2", *extra)
+        assert rc.returncode == 0, (extra, rc.stderr[-2000:])
+        assert "3 requests" in rc.stdout, (extra, rc.stdout)
+        assert "request 2 (slot" in rc.stdout, (extra, rc.stdout)
