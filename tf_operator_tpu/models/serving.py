@@ -27,11 +27,17 @@ TPU-first mechanics:
     their position pinned: the wasted lane work is the price of static
     shapes, bounded by slots, and their repeated same-slot write is
     harmless.
+  - SPECULATIVE serving (draft=/spec_k=): decode blocks become per-lane
+    draft+verify rounds (speculative.make_spec_round — the one shared
+    copy of the acceptance math), emitting up to spec_k+1 tokens per
+    lane per round; the draft's row cache prefills and inserts beside
+    the target's at admission.
 
 Exactness: greedy outputs per request are token-identical to an
-isolated llama.generate call (tests/test_serving.py) — batching and
-admission order change throughput only.  Composes with kv_quant (int8
-caches insert through the same tree scatter) and sliding-window rings.
+isolated llama.generate call (tests/test_serving.py) — batching,
+admission order, and speculation change throughput only.  Composes
+with kv_quant (int8 caches insert through the same tree scatter) and
+sliding-window rings.
 
 No reference counterpart (the reference has no serving code at all,
 SURVEY.md §5.7).
