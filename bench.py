@@ -80,6 +80,15 @@ def _micro() -> bool:
     return os.environ.get("BENCH_MICRO", "") == "1"
 
 
+def _heartbeat(msg: str) -> None:
+    """Timestamped stderr heartbeat: a multi-arm run on a tunnelled chip
+    takes tens of minutes per compile-heavy sub-step and is otherwise
+    indistinguishable from a wedged device claim to anyone tailing the
+    log.  ONE format for every arm and sub-step."""
+    print(f"# {time.strftime('%H:%M:%S')} {msg}",
+          file=sys.stderr, flush=True)
+
+
 def _timed_train_steps(step, params, opt_state, tokens, warmup, steps):
     """Shared LM timing harness: warm (and sync via value fetch — the only
     reliable barrier on relayed transports), then time `steps` iterations.
@@ -406,6 +415,10 @@ def bench_transformer(gen: str, n_chips: int):
     for arm, (attn_fn, loss_impl, batches) in variants.items():
         cfg = dataclasses.replace(base_cfg, attention_fn=attn_fn)
         for b in batches:
+            # sub-arm heartbeat: each BERT-large compile costs minutes
+            # on a tunnelled chip, and a wedge inside this sweep was
+            # previously indistinguishable from the whole arm hanging
+            _heartbeat(f"  transformer {arm} b{b * n_chips}")
             try:
                 tps = run_one(b * n_chips, cfg, loss_impl)
             except Exception as e:  # noqa: BLE001 — classify below
@@ -1026,6 +1039,7 @@ def bench_flash_attention(gen: str):
     results = {}
     for causal in (False, True):
         tag = "causal" if causal else "full"
+        _heartbeat(f"  flash {tag}")
         flash_vg, ref_vg = make_pair(causal)
         f_out, f_grads = flash_vg(q, k, v)
         r_out, r_grads = ref_vg(q, k, v)
@@ -1048,6 +1062,7 @@ def bench_flash_attention(gen: str):
     # path's O(S^2) score materialization starts to hurt (BASELINE.md)
     try:
         s_long = 8192
+        _heartbeat("  flash s8192")
         long_args = tuple(
             jax.random.normal(key, (1, s_long, h, d), jnp.bfloat16)
             for key in (kq, kk, kv)
@@ -1072,6 +1087,7 @@ def bench_flash_attention(gen: str):
             best = ("q512k1024", default_ms / 1e3)
         for blk_q, blk_k in ((256, 512), (512, 512), (1024, 1024)):
             tag = f"q{blk_q}k{blk_k}"
+            _heartbeat(f"  flash block sweep {tag}")
             try:
                 def loss_b(q, k, v, _bq=blk_q, _bk=blk_k):
                     return flash_attention(
@@ -1094,6 +1110,7 @@ def bench_flash_attention(gen: str):
     # one): validates the carry-kernel + SMEM-offset Mosaic lowering on
     # hardware even though multi-chip rings need a real slice
     try:
+        _heartbeat("  flash ring_flash 1dev")
         from tf_operator_tpu.ops.ring_flash import make_ring_flash_attention_fn
         from tf_operator_tpu.parallel.mesh import make_mesh
 
@@ -1538,11 +1555,7 @@ def main() -> int:
     extra = {"probe": probe_detail}
 
     def progress(arm: str) -> None:
-        # per-arm heartbeat on stderr: a multi-arm run on a tunnelled chip
-        # takes tens of minutes and is otherwise indistinguishable from a
-        # wedged device claim to anyone tailing the log
-        print(f"# {time.strftime('%H:%M:%S')} bench arm: {arm}",
-              file=sys.stderr, flush=True)
+        _heartbeat(f"bench arm: {arm}")
 
     on_tpu = tpu_ok and dev.platform != "cpu"
 
@@ -1565,33 +1578,13 @@ def main() -> int:
     extra["resnet"] = resnet
     checkpoint_cache(resnet)
 
-    if not (gen != "cpu" and _micro()):
-        # micro mode skips the BERT-large sweep (minutes of compile per
-        # variant on a tunnelled chip); the full bench still runs it
-        progress("transformer")
-        try:
-            extra["transformer"] = bench_transformer(gen, n_chips)
-        except Exception as e:  # noqa: BLE001 — must not kill headline
-            extra["transformer"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-        checkpoint_cache(resnet)
-
     if gen != "cpu":
-        progress("flash_attention")
-        try:
-            extra["flash_attention"] = bench_flash_attention(gen)
-        except Exception as e:  # noqa: BLE001 — surfaced, not fatal
-            extra["flash_attention"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-        checkpoint_cache(resnet)
-        # default-ON with a chip (VERDICT r2 item 1c): 5 steps + one big
-        # compile; opt out with BENCH_T5=0 (micro mode skips it — the
-        # 48-layer compile alone can outlast a short chip window)
-        if os.environ.get("BENCH_T5", "1") == "1" and not _micro():
-            progress("t5_3b")
-            try:
-                extra["t5_3b"] = bench_t5_3b(gen)
-            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
-                extra["t5_3b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
-            checkpoint_cache(resnet)
+        # ARM ORDER IS FAILURE-DOMAIN ORDER: every completed arm is
+        # checkpointed to the last-good cache, so cheap high-value arms
+        # (llama family: seconds of compile each) run BEFORE the
+        # multi-minute-compile sweeps (flash s8192, BERT-large variants,
+        # 48-layer T5) — a wedged claim or timeout late in the run then
+        # costs the expensive arms, never the model-family coverage
         if os.environ.get("BENCH_LLAMA", "1") == "1":
             progress("llama")
             try:
@@ -1667,7 +1660,43 @@ def main() -> int:
                 extra["serve_loop"] = {
                     "error": f"{type(e).__name__}: {e}"[:300]}
             checkpoint_cache(resnet)
+        progress("flash_attention")
+        try:
+            extra["flash_attention"] = bench_flash_attention(gen)
+        except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+            extra["flash_attention"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
+        checkpoint_cache(resnet)
+        if not _micro():
+            # micro mode skips the BERT-large sweep (minutes of compile
+            # per variant on a tunnelled chip); the full bench runs it
+            progress("transformer")
+            try:
+                extra["transformer"] = bench_transformer(gen, n_chips)
+            except Exception as e:  # noqa: BLE001 — must not kill headline
+                extra["transformer"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
+        # default-ON with a chip (VERDICT r2 item 1c): 5 steps + one big
+        # compile; opt out with BENCH_T5=0 (micro mode skips it — the
+        # 48-layer compile alone can outlast a short chip window)
+        if os.environ.get("BENCH_T5", "1") == "1" and not _micro():
+            progress("t5_3b")
+            try:
+                extra["t5_3b"] = bench_t5_3b(gen)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                extra["t5_3b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            checkpoint_cache(resnet)
     else:
+        # CPU: the tiny transformer smoke row keeps the arm's plumbing
+        # proven in every artifact
+        progress("transformer")
+        try:
+            extra["transformer"] = bench_transformer(gen, n_chips)
+        except Exception as e:  # noqa: BLE001 — must not kill headline
+            extra["transformer"] = {
+                "error": f"{type(e).__name__}: {e}"[:300]}
+        checkpoint_cache(resnet)
         # no chip: the pallas kernel still runs (interpret mode) so the
         # flash arm's correctness witness lands in the artifact
         progress("flash_parity_interpret")
