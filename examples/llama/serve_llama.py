@@ -67,6 +67,10 @@ def main(argv=None) -> int:
                     help="smoke: random draft with this many layers")
     ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--prefill-chunk", type=int, default=0)
+    ap.add_argument("--prefill-chunks-per-sync", type=int, default=0,
+                    help="admission-stall bound: stream at most this "
+                         "many prompt segments per decode block (long "
+                         "prompts no longer stall the other lanes)")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args(argv)
 
@@ -117,6 +121,8 @@ def main(argv=None) -> int:
         print("kv caches: int8 + per-head scales")
     if args.prefill_chunk:
         kw["prefill_chunk"] = args.prefill_chunk
+    if args.prefill_chunks_per_sync:
+        kw["prefill_chunks_per_sync"] = args.prefill_chunks_per_sync
     if args.temperature > 0.0:
         kw.update(temperature=args.temperature,
                   rng=jax.random.PRNGKey(args.seed))

@@ -351,3 +351,63 @@ def test_spec_serve_draft_smaller_max_len():
                      draft_params=d_params, spec_k=4, steps_per_sync=4)
     want = llama.generate(model, params, prompt[None, :], 90)
     assert res[0].tokens == [int(t) for t in np.asarray(want[0])]
+
+
+def test_prefill_budget_is_scheduling_not_semantics():
+    """prefill_chunks_per_sync bounds admission stall; per-request
+    tokens must be invariant to it (like steps_per_sync)."""
+    cfg, model, params = _setup(max_len=256)
+    prompts = _prompts(cfg, [40, 6, 33, 9])
+    base = serve_loop(model, params, prompts, slots=2,
+                      max_new_tokens=10, prefill_chunk=8)
+    for budget in (1, 2, 100):
+        got = serve_loop(model, params, prompts, slots=2,
+                         max_new_tokens=10, prefill_chunk=8,
+                         prefill_chunks_per_sync=budget)
+        assert [r.tokens for r in got] == [r.tokens for r in base], budget
+
+
+def test_prefill_budget_interleaves_with_decode():
+    """The liveness property the budget exists for: while one lane
+    streams a LONG prompt in 1-chunk installments, the other lane's
+    short requests keep decoding — short requests finish before the
+    long prefill even completes its admission."""
+    cfg, model, params = _setup(max_len=512)
+    long_p = _prompts(cfg, [200])[0]
+    shorts = _prompts(cfg, [5, 6, 7], seed=3)
+    prompts = [long_p] + shorts
+    res = serve_loop(model, params, prompts, slots=2,
+                     max_new_tokens=6, prefill_chunk=8,
+                     prefill_chunks_per_sync=1, steps_per_sync=2)
+    # outputs still oracle-exact
+    for r, p in zip(res, prompts):
+        assert r.tokens == _oracle(model, params, p, 6), r.slot
+    # the long request (25 one-chunk installments, one per loop
+    # iteration) was admitted LAST even though it was queued first —
+    # every short request got its lane and finished before the long
+    # prompt's streaming admission completed
+    long_r, short_rs = res[0], res[1:]
+    assert all(s.finished_at_step <= long_r.admitted_at_step
+               for s in short_rs), (
+        long_r, [s.finished_at_step for s in short_rs])
+
+
+def test_prefill_budget_composes_with_speculation():
+    cfg, model, params = _setup(max_len=512)
+    d_model, d_params = _draft_setup(cfg)
+    prompts = _prompts(cfg, [60, 7, 9])
+    base = [_oracle(model, params, p, 8) for p in prompts]
+    res = serve_loop(model, params, prompts, slots=2, max_new_tokens=8,
+                     prefill_chunk=8, prefill_chunks_per_sync=2,
+                     draft=d_model, draft_params=d_params, spec_k=2,
+                     steps_per_sync=2)
+    assert [r.tokens for r in res] == base
+
+
+def test_prefill_budget_validation():
+    cfg, model, params = _setup(max_len=128)
+    p = _prompts(cfg, [5])
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="prefill_chunks_per_sync"):
+            serve_loop(model, params, p, prefill_chunk=2,
+                       prefill_chunks_per_sync=bad)

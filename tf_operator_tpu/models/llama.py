@@ -908,11 +908,14 @@ def generate(model, params, prompt, max_new_tokens: int,
 
 def stream_prefill(chunk_fill, chunk_write, params, cache, prompt,
                    prefill_chunk: Optional[int]):
-    """The one streaming-prefill loop (generate + serving.serve_loop):
-    intermediate segments feed only the cache (chunk_write skips the
-    lm_head), the final segment returns its last-position logits.
-    prefill_chunk None = one-pass prefill.  Callers validate sizing
-    (check_prefill_chunk) before getting here."""
+    """generate()'s streaming-prefill loop: intermediate segments feed
+    only the cache (chunk_write skips the lm_head), the final segment
+    returns its last-position logits.  prefill_chunk None = one-pass
+    prefill.  Callers validate sizing (check_prefill_chunk) first.
+    serving.serve_loop's advance_prefill is the RESUMABLE variant of
+    this loop (it must stop after N segments and continue next block) —
+    a change to segment slicing or final-chunk handling here needs the
+    same change there."""
     if prefill_chunk is None:
         return chunk_fill(params, cache, prompt, jnp.int32(0))
     starts = list(range(0, prompt.shape[1], prefill_chunk))

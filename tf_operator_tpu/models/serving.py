@@ -159,6 +159,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                params_transform=None, prefill_chunk: Optional[int] = None,
                kv_quant: bool = False,
                steps_per_sync: int = 8,
+               prefill_chunks_per_sync: Optional[int] = None,
                draft=None, draft_params=None, spec_k: int = 4,
                draft_transform=None) -> List[ServeResult]:
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
@@ -178,6 +179,17 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     token (the dispatch+transfer amortization every serving loop needs;
     worst-case cost is steps_per_sync-1 discarded lane-steps after an
     EOS and the same bound on admission latency — tokens are unchanged).
+
+    prefill_chunks_per_sync: admission-stall bound — with prefill_chunk
+    set, an admitted prompt streams into its lane's cache at most this
+    many segments per loop iteration, with a decode block for the OTHER
+    lanes between advances; a 128k-token admission then delays everyone
+    else by O(budget x chunk) per block instead of its whole prefill.
+    None (default) finishes each admission's prefill immediately.
+    GREEDY tokens are invariant to the budget (scheduling, not
+    semantics); under sampling the budget shifts the loop's key-split
+    order, so draws differ per budget value — the same procedure-level
+    (not key-path) contract sampling already has here.
 
     draft / draft_params / spec_k / draft_transform: SPECULATIVE
     continuous batching — every decode block becomes steps_per_sync
@@ -204,6 +216,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     if steps_per_sync < 1:
         raise ValueError(
             f"steps_per_sync must be >= 1, got {steps_per_sync}")
+    if prefill_chunks_per_sync is not None and prefill_chunks_per_sync < 1:
+        # 0/negative would make advance_prefill a no-op and the serve
+        # loop spin forever on a pending admission
+        raise ValueError(
+            f"prefill_chunks_per_sync must be >= 1 (or None for "
+            f"unbounded), got {prefill_chunks_per_sync}")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
     # generate()'s own range checks — an out-of-range eos_id can never
@@ -323,26 +341,6 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         _, d_fill, d_write = _llama._decode_fns(
             draft, 0.0, 0, 0.0, -1, draft_transform)
 
-    def prefill_row(prompt):
-        """Fill a fresh single-row cache with `prompt` (validated
-        above); returns (last logits, row cache)."""
-        row = _llama.init_cache(cfg, 1, eff_len["target"],
-                                kv_quant=kv_quant)
-        return _llama.stream_prefill(
-            chunk_fill, chunk_write, params, row, prompt[None, :],
-            _effective_chunk(prompt.shape[0]))
-
-    def prefill_draft_row(prompt):
-        """The draft's row cache for an admission (speculation only);
-        the final segment's logits are discarded — only the cache
-        matters (the first token always comes from the TARGET)."""
-        row = _llama.init_cache(draft.cfg, 1, eff_len["draft"],
-                                kv_quant=kv_quant)
-        _, row = _llama.stream_prefill(
-            d_fill, d_write, draft_params, row, prompt[None, :],
-            _effective_chunk(prompt.shape[0]))
-        return row
-
     # slot state: cache/tok/pos live on device; occupancy bookkeeping
     # (owner, frozen, emitted) lives on the host — the loop reads tokens
     # back once per step anyway (it must, to detect EOS)
@@ -358,6 +356,13 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     results: List[Optional[ServeResult]] = [None] * len(reqs)
     admitted_step = [0] * slots
     queue = deque(range(len(reqs)))
+    # slot -> in-flight prefill {ridx, row, d_row, next}: a lane is
+    # RESERVED while its request's prompt streams into a single-row
+    # cache, at most prefill_chunks_per_sync segments per loop
+    # iteration — other lanes keep decoding between advances, so one
+    # long prompt bounds every other request's stall instead of
+    # stalling the whole loop for its full prefill
+    pending: dict = {}
     n_step = 0
 
     def finish(s):
@@ -367,30 +372,71 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             finished_at_step=n_step, slot=s)
         owner[s] = None
 
-    while queue or any(o is not None for o in owner):
-        # ---- admission: every free lane takes the next queued request
-        for s in range(slots):
-            if owner[s] is not None or not queue:
-                continue
-            ridx = queue.popleft()
-            rng, k_first = jax.random.split(rng)
-            last_logits, row = prefill_row(reqs[ridx])
-            cache = insert_row(cache, row, jnp.int32(s))
+    def advance_prefill(s):
+        """Stream up to prefill_chunks_per_sync segments of slot s's
+        pending prompt; on the final segment, sample the first token,
+        insert both row caches, and activate the lane.  This is the
+        RESUMABLE variant of llama.stream_prefill (same segment
+        slicing and final-chunk fill — keep them in lockstep)."""
+        nonlocal cache, d_cache, tok, pos, rng
+        st = pending[s]
+        prompt_r = reqs[st["ridx"]]
+        p_len = prompt_r.shape[0]
+        chunk = _effective_chunk(p_len)
+        seg = chunk if chunk is not None else p_len
+        budget = prefill_chunks_per_sync or (p_len // seg + 1)
+        for _ in range(budget):
+            start = st["next"]
+            piece = prompt_r[None, start:start + seg]
+            if start + seg >= p_len:  # final segment: logits + activate
+                last_logits, st["row"] = chunk_fill(
+                    params, st["row"], piece, jnp.int32(start))
+                if spec:
+                    st["d_row"] = d_write(draft_params, st["d_row"],
+                                          piece, jnp.int32(start))
+                cache = insert_row(cache, st["row"], jnp.int32(s))
+                if spec:
+                    d_cache = insert_row(d_cache, st["d_row"],
+                                         jnp.int32(s))
+                rng, k_first = jax.random.split(rng)
+                first = int(_llama._select_token(
+                    last_logits, temperature, k_first, top_k, top_p)[0])
+                ridx = st["ridx"]
+                del pending[s]
+                owner[s] = ridx
+                admitted_step[s] = n_step
+                emitted[s] = [first]
+                tok = tok.at[s].set(first)
+                pos = pos.at[s].set(p_len)
+                frozen_py[s] = False
+                if first == eos or max_new_tokens == 1:
+                    finish(s)
+                return
+            st["row"] = chunk_write(params, st["row"], piece,
+                                    jnp.int32(start))
             if spec:
-                d_cache = insert_row(
-                    d_cache, prefill_draft_row(reqs[ridx]), jnp.int32(s))
-            first = int(_llama._select_token(
-                last_logits, temperature, k_first, top_k, top_p)[0])
-            owner[s] = ridx
-            admitted_step[s] = n_step
-            emitted[s] = [first]
-            tok = tok.at[s].set(first)
-            pos = pos.at[s].set(reqs[ridx].shape[0])
-            frozen_py[s] = False
-            if first == eos or max_new_tokens == 1:
-                finish(s)
+                st["d_row"] = d_write(draft_params, st["d_row"], piece,
+                                      jnp.int32(start))
+            st["next"] = start + seg
+
+    while queue or pending or any(o is not None for o in owner):
+        # ---- admission: every free lane RESERVES the next queued
+        # request (cache allocation only; the prompt streams in below)
+        for s in range(slots):
+            if owner[s] is None and s not in pending and queue:
+                pending[s] = {
+                    "ridx": queue.popleft(),
+                    "row": _llama.init_cache(cfg, 1, eff_len["target"],
+                                             kv_quant=kv_quant),
+                    "d_row": (_llama.init_cache(
+                        draft.cfg, 1, eff_len["draft"],
+                        kv_quant=kv_quant) if spec else None),
+                    "next": 0,
+                }
+        for s in list(pending):
+            advance_prefill(s)
         if all(o is None for o in owner):
-            continue  # all lanes finished instantly; admit more
+            continue  # nothing decoding yet; keep prefilling/admitting
         # ---- one decode BLOCK for every lane, each at its own position
         rng, k_step = jax.random.split(rng)
         if spec:
