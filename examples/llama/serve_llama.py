@@ -146,8 +146,11 @@ def main(argv=None) -> int:
     dt = time.perf_counter() - t0
     n_tokens = sum(len(r.tokens) for r in results)
     for i, (p, r) in enumerate(zip(prompts, results)):
+        spec_note = (
+            f", acceptance {r.accepted_drafts}/{r.proposed_drafts}"
+            if r.proposed_drafts else "")
         print(f"--- request {i} (slot {r.slot}, steps "
-              f"{r.admitted_at_step}->{r.finished_at_step})")
+              f"{r.admitted_at_step}->{r.finished_at_step}{spec_note})")
         print(f"    {p!r} -> {tok.decode(r.tokens)!r}")
     print(f"{len(requests)} requests, {n_tokens} tokens through "
           f"{args.slots} lanes in {dt:.2f}s "

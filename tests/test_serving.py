@@ -411,3 +411,33 @@ def test_prefill_budget_validation():
         with pytest.raises(ValueError, match="prefill_chunks_per_sync"):
             serve_loop(model, params, p, prefill_chunk=2,
                        prefill_chunks_per_sync=bad)
+
+
+def test_spec_serve_reports_per_request_acceptance():
+    """ServeResult carries each request's own accepted/proposed draft
+    counts (overshoot rounds excluded); plain serving reports 0/0."""
+    cfg, model, params = _setup(max_len=256)
+    d_model, d_params = _draft_setup(cfg)
+    prompts = _prompts(cfg, [6, 9, 4])
+    res = serve_loop(model, params, prompts, slots=2, max_new_tokens=10,
+                     draft=d_model, draft_params=d_params, spec_k=3,
+                     steps_per_sync=2)
+    for r in res:
+        assert r.proposed_drafts > 0 and r.proposed_drafts % 3 == 0
+        assert 0 <= r.accepted_drafts <= r.proposed_drafts
+        # rounds made progress: each emits >= 1 token, so a request
+        # cannot have proposed more rounds than tokens it emitted
+        assert r.proposed_drafts // 3 <= len(r.tokens)
+    plain = serve_loop(model, params, prompts, slots=2,
+                       max_new_tokens=10)
+    assert all(r.proposed_drafts == 0 and r.accepted_drafts == 0
+               for r in plain)
+
+
+def test_prefill_budget_requires_chunking():
+    """A budget without prefill_chunk cannot bound anything (one-segment
+    prefill) — refused rather than silently no-opped."""
+    cfg, model, params = _setup(max_len=128)
+    with pytest.raises(ValueError, match="needs prefill_chunk"):
+        serve_loop(model, params, _prompts(cfg, [5]),
+                   prefill_chunks_per_sync=1)

@@ -906,25 +906,32 @@ def generate(model, params, prompt, max_new_tokens: int,
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 
+def prefill_segments(prompt_len: int, prefill_chunk: Optional[int]):
+    """THE segment schedule for streaming prefill: [(start, end,
+    is_last), ...].  One copy shared by generate()'s stream_prefill and
+    serving.serve_loop's resumable advance_prefill, so the slicing and
+    final-segment identification can never diverge between them.
+    prefill_chunk None = one whole-prompt segment."""
+    if prefill_chunk is None or prefill_chunk >= prompt_len:
+        return [(0, prompt_len, True)]
+    starts = list(range(0, prompt_len, prefill_chunk))
+    return [(i, min(i + prefill_chunk, prompt_len), i == starts[-1])
+            for i in starts]
+
+
 def stream_prefill(chunk_fill, chunk_write, params, cache, prompt,
                    prefill_chunk: Optional[int]):
-    """generate()'s streaming-prefill loop: intermediate segments feed
-    only the cache (chunk_write skips the lm_head), the final segment
-    returns its last-position logits.  prefill_chunk None = one-pass
-    prefill.  Callers validate sizing (check_prefill_chunk) first.
-    serving.serve_loop's advance_prefill is the RESUMABLE variant of
-    this loop (it must stop after N segments and continue next block) —
-    a change to segment slicing or final-chunk handling here needs the
-    same change there."""
-    if prefill_chunk is None:
-        return chunk_fill(params, cache, prompt, jnp.int32(0))
-    starts = list(range(0, prompt.shape[1], prefill_chunk))
-    for i in starts[:-1]:
-        cache = chunk_write(params, cache,
-                            prompt[:, i:i + prefill_chunk], jnp.int32(i))
-    last = starts[-1]
-    return chunk_fill(params, cache, prompt[:, last:last + prefill_chunk],
-                      jnp.int32(last))
+    """generate()'s streaming-prefill loop over prefill_segments:
+    intermediate segments feed only the cache (chunk_write skips the
+    lm_head), the final segment returns its last-position logits.
+    Callers validate sizing (check_prefill_chunk) first."""
+    for start, end, is_last in prefill_segments(prompt.shape[1],
+                                                prefill_chunk):
+        if is_last:
+            return chunk_fill(params, cache, prompt[:, start:end],
+                              jnp.int32(start))
+        cache = chunk_write(params, cache, prompt[:, start:end],
+                            jnp.int32(start))
 
 
 def _truncate_logits(logits, temperature: float, top_k: int = 0,

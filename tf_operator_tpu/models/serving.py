@@ -58,12 +58,19 @@ from tf_operator_tpu.models import llama as _llama
 @dataclasses.dataclass
 class ServeResult:
     """Per-request outcome: the emitted tokens (EOS included when hit)
-    and scheduling metadata for observability."""
+    and scheduling metadata for observability.  Under speculative
+    serving, accepted/proposed_drafts count this request's own rounds
+    (overshoot rounds after EOS excluded) — accepted/proposed is the
+    request's measured acceptance rate and `proposed == 0` means the
+    request never speculated (plain serving, or finished at its first
+    token)."""
 
     tokens: List[int]
     admitted_at_step: int
     finished_at_step: int
     slot: int
+    accepted_drafts: int = 0
+    proposed_drafts: int = 0
 
 
 @functools.lru_cache(maxsize=8)
@@ -216,12 +223,22 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     if steps_per_sync < 1:
         raise ValueError(
             f"steps_per_sync must be >= 1, got {steps_per_sync}")
-    if prefill_chunks_per_sync is not None and prefill_chunks_per_sync < 1:
-        # 0/negative would make advance_prefill a no-op and the serve
-        # loop spin forever on a pending admission
-        raise ValueError(
-            f"prefill_chunks_per_sync must be >= 1 (or None for "
-            f"unbounded), got {prefill_chunks_per_sync}")
+    if prefill_chunks_per_sync is not None:
+        if prefill_chunks_per_sync < 1:
+            # 0/negative would make advance_prefill a no-op and the
+            # serve loop spin forever on a pending admission
+            raise ValueError(
+                f"prefill_chunks_per_sync must be >= 1 (or None for "
+                f"unbounded), got {prefill_chunks_per_sync}")
+        if prefill_chunk is None:
+            # without chunking there is nothing to budget: the whole
+            # prompt prefills in one segment and the admission stall
+            # the caller asked to bound stays unbounded — refuse
+            # rather than silently no-op
+            raise ValueError(
+                "prefill_chunks_per_sync needs prefill_chunk: an "
+                "unchunked prompt prefills in one segment, so the "
+                "admission-stall bound cannot apply")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
     # generate()'s own range checks — an out-of-range eos_id can never
@@ -338,7 +355,10 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         spec_block = _spec_serve_fns(
             model, draft, int(spec_k), float(temperature), int(top_k),
             float(top_p), params_transform, draft_transform)
-        _, d_fill, d_write = _llama._decode_fns(
+        # only the chunk WRITER: every draft segment (final included)
+        # feeds the cache alone — the first token always comes from
+        # the target's logits
+        _, _, d_write = _llama._decode_fns(
             draft, 0.0, 0, 0.0, -1, draft_transform)
 
     # slot state: cache/tok/pos live on device; occupancy bookkeeping
@@ -363,32 +383,38 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # long prompt bounds every other request's stall instead of
     # stalling the whole loop for its full prefill
     pending: dict = {}
+    # per-lane speculation accounting for the CURRENT occupant
+    # (accepted, proposed) — reset at activation, reported in finish
+    spec_acc = [(0, 0)] * slots
     n_step = 0
 
     def finish(s):
         frozen_py[s] = True
         results[owner[s]] = ServeResult(
             tokens=emitted[s], admitted_at_step=admitted_step[s],
-            finished_at_step=n_step, slot=s)
+            finished_at_step=n_step, slot=s,
+            accepted_drafts=spec_acc[s][0],
+            proposed_drafts=spec_acc[s][1])
         owner[s] = None
 
     def advance_prefill(s):
         """Stream up to prefill_chunks_per_sync segments of slot s's
         pending prompt; on the final segment, sample the first token,
-        insert both row caches, and activate the lane.  This is the
-        RESUMABLE variant of llama.stream_prefill (same segment
-        slicing and final-chunk fill — keep them in lockstep)."""
+        insert both row caches, and activate the lane.  The resumable
+        counterpart of llama.stream_prefill — both iterate the SAME
+        llama.prefill_segments schedule, so slicing can't diverge."""
         nonlocal cache, d_cache, tok, pos, rng
         st = pending[s]
         prompt_r = reqs[st["ridx"]]
         p_len = prompt_r.shape[0]
-        chunk = _effective_chunk(p_len)
-        seg = chunk if chunk is not None else p_len
-        budget = prefill_chunks_per_sync or (p_len // seg + 1)
-        for _ in range(budget):
-            start = st["next"]
-            piece = prompt_r[None, start:start + seg]
-            if start + seg >= p_len:  # final segment: logits + activate
+        segments = _llama.prefill_segments(
+            p_len, _effective_chunk(p_len))
+        budget = prefill_chunks_per_sync or len(segments)
+        for start, end, is_last in segments[st["next"]:
+                                            st["next"] + budget]:
+            piece = prompt_r[None, start:end]
+            st["next"] += 1
+            if is_last:  # final segment: logits + activate the lane
                 last_logits, st["row"] = chunk_fill(
                     params, st["row"], piece, jnp.int32(start))
                 if spec:
@@ -404,6 +430,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                 ridx = st["ridx"]
                 del pending[s]
                 owner[s] = ridx
+                spec_acc[s] = (0, 0)
                 admitted_step[s] = n_step
                 emitted[s] = [first]
                 tok = tok.at[s].set(first)
@@ -417,7 +444,6 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             if spec:
                 st["d_row"] = d_write(draft_params, st["d_row"], piece,
                                       jnp.int32(start))
-            st["next"] = start + seg
 
     while queue or pending or any(o is not None for o in owner):
         # ---- admission: every free lane RESERVES the next queued
@@ -455,6 +481,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                 for s in range(slots):
                     if owner[s] is None or frozen_py[s]:
                         continue
+                    # this round genuinely belongs to the request
+                    # (overshoot rounds after finish are skipped by the
+                    # frozen check above): count its acceptance
+                    acc, prop = spec_acc[s]
+                    spec_acc[s] = (acc + int(n_accs[i, s]),
+                                   prop + spec_k)
                     for t in cands[i, s, :int(n_accs[i, s]) + 1]:
                         emitted[s].append(int(t))
                         if (int(t) == eos
