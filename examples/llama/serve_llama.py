@@ -66,6 +66,11 @@ def main(argv=None) -> int:
     ap.add_argument("--draft-layers", type=int, default=0,
                     help="smoke: random draft with this many layers")
     ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--system-prompt", default="",
+                    help="shared prefix prepended to every request but "
+                         "prefilled ONCE (prefix caching); with "
+                         "--prefill-chunk its token length must be a "
+                         "chunk multiple")
     ap.add_argument("--prefill-chunk", type=int, default=0)
     ap.add_argument("--prefill-chunks-per-sync", type=int, default=0,
                     help="admission-stall bound: stream at most this "
@@ -123,6 +128,10 @@ def main(argv=None) -> int:
         kw["prefill_chunk"] = args.prefill_chunk
     if args.prefill_chunks_per_sync:
         kw["prefill_chunks_per_sync"] = args.prefill_chunks_per_sync
+    if args.system_prompt:
+        pfx = tok.encode(args.system_prompt)
+        kw["shared_prefix"] = jnp.asarray(pfx, jnp.int32)
+        print(f"system prompt: {len(pfx)} tokens, prefilled once")
     if args.temperature > 0.0:
         kw.update(temperature=args.temperature,
                   rng=jax.random.PRNGKey(args.seed))

@@ -441,3 +441,70 @@ def test_prefill_budget_requires_chunking():
     with pytest.raises(ValueError, match="needs prefill_chunk"):
         serve_loop(model, params, _prompts(cfg, [5]),
                    prefill_chunks_per_sync=1)
+
+
+# ---------------------------------------------------------- prefix cache
+def test_shared_prefix_equals_concatenated_prompts():
+    """Prefix caching: serving suffixes with shared_prefix must emit
+    exactly what serving the concatenated prompts emits (which equals
+    isolated generate on prefix+suffix) — chunked and unchunked."""
+    cfg, model, params = _setup(max_len=256)
+    pfx = _prompts(cfg, [16])[0]
+    sufs = _prompts(cfg, [5, 9, 3, 7], seed=4)
+    full = [jnp.concatenate([pfx, s]) for s in sufs]
+    for kw in ({}, {"prefill_chunk": 8},
+               {"prefill_chunk": 8, "prefill_chunks_per_sync": 1}):
+        res = serve_loop(model, params, sufs, slots=2,
+                         max_new_tokens=10, shared_prefix=pfx, **kw)
+        for r, f in zip(res, full):
+            assert r.tokens == _oracle(model, params, f, 10), (kw, r.slot)
+
+
+def test_shared_prefix_composes_with_speculation_and_int8kv():
+    cfg, model, params = _setup(max_len=256)
+    d_model, d_params = _draft_setup(cfg)
+    pfx = _prompts(cfg, [8])[0]
+    sufs = _prompts(cfg, [6, 4], seed=5)
+    res = serve_loop(model, params, sufs, slots=2, max_new_tokens=8,
+                     shared_prefix=pfx, kv_quant=True,
+                     draft=d_model, draft_params=d_params, spec_k=2)
+    for r, s in zip(res, sufs):
+        f = jnp.concatenate([pfx, s])[None, :]
+        want = llama.generate(model, params, f, 8, kv_quant=True)
+        assert r.tokens == [int(t) for t in np.asarray(want[0])]
+
+
+def test_shared_prefix_validation():
+    cfg, model, params = _setup(max_len=256)
+    sufs = _prompts(cfg, [5])
+    pfx = _prompts(cfg, [10])[0]
+    # misaligned prefix with chunking is refused, not silently unshared
+    with pytest.raises(ValueError, match="multiple of"):
+        serve_loop(model, params, sufs, shared_prefix=pfx,
+                   prefill_chunk=8)
+    with pytest.raises(ValueError, match="non-empty"):
+        serve_loop(model, params, sufs,
+                   shared_prefix=jnp.zeros((0,), jnp.int32))
+    with pytest.raises(ValueError, match="suffix token"):
+        serve_loop(model, params, [jnp.zeros((0,), jnp.int32)],
+                   shared_prefix=pfx)
+
+
+def test_shared_prefix_windowed_ring():
+    """Prefix caching under a sliding-window model, BOTH prefill paths:
+    unchunked (the two-segment prefix-write + suffix-fill split) and
+    chunked through an O(window) ring."""
+    cfg, model, params = _setup(max_len=256, sliding_window=8,
+                                n_layers=2)
+    pfx = _prompts(cfg, [16])[0]
+    sufs = _prompts(cfg, [6, 9], seed=7)
+    for kw in ({"cache_len": 64},
+               {"prefill_chunk": 8, "cache_len": 16}):
+        res = serve_loop(model, params, sufs, slots=2,
+                         max_new_tokens=8, shared_prefix=pfx, **kw)
+        for r, s in zip(res, sufs):
+            f = jnp.concatenate([pfx, s])[None, :]
+            want = llama.generate(model, params, f, 8,
+                                  cache_len=kw.get("cache_len"),
+                                  prefill_chunk=kw.get("prefill_chunk"))
+            assert r.tokens == [int(t) for t in np.asarray(want[0])], kw

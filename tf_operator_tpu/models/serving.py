@@ -167,6 +167,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                kv_quant: bool = False,
                steps_per_sync: int = 8,
                prefill_chunks_per_sync: Optional[int] = None,
+               shared_prefix=None,
                draft=None, draft_params=None, spec_k: int = 4,
                draft_transform=None) -> List[ServeResult]:
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
@@ -208,6 +209,15 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     verify write costs spec_k+1 extra cache slots of headroom (bounds
     validated below).
 
+    shared_prefix: PREFIX CACHING — 1-D tokens (a system prompt)
+    logically prepended to EVERY request but prefilled ONCE: each
+    admission starts from a device copy of the prefix's row cache and
+    streams only its own suffix (a copy is O(cache bytes); re-prefill
+    is O(prefix x model FLOPs)).  Outputs equal serving the
+    concatenated prompts.  With prefill_chunk set, the prefix length
+    must be a chunk multiple so suffix segments stay aligned with the
+    ring's no-wrap guarantees (refused loudly otherwise).
+
     Greedy outputs are token-identical to per-request llama.generate
     calls; sampling draws its keys from the serve loop's own stream (the
     procedure, not the key path, matches)."""
@@ -215,6 +225,29 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     reqs = [jnp.asarray(r, jnp.int32).reshape(-1) for r in requests]
     if not reqs:
         return []
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(
+            f"prefill_chunk must be >= 1, got {prefill_chunk}")
+    prefix = (jnp.asarray(shared_prefix, jnp.int32).reshape(-1)
+              if shared_prefix is not None else None)
+    p_fix = 0 if prefix is None else int(prefix.shape[0])
+    if prefix is not None:
+        if p_fix < 1:
+            raise ValueError("shared_prefix must be non-empty when given")
+        if prefill_chunk is not None and p_fix % prefill_chunk != 0:
+            raise ValueError(
+                f"shared_prefix length {p_fix} must be a multiple of "
+                f"prefill_chunk {prefill_chunk} so suffix segments stay "
+                f"chunk-aligned (pad the prefix or adjust the chunk)")
+        for i, r in enumerate(reqs):
+            if r.shape[0] < 1:
+                raise ValueError(
+                    f"request {i} is empty — with a shared_prefix, at "
+                    f"least one suffix token is needed to produce the "
+                    f"first-token logits")
+        # from here on every request IS prefix + suffix; the sharing
+        # only changes WHERE the prefix tokens' cache writes come from
+        reqs = [jnp.concatenate([prefix, r]) for r in reqs]
     if slots < 1:
         raise ValueError(f"slots must be >= 1, got {slots}")
     if max_new_tokens < 1:
@@ -250,9 +283,6 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             f"{cfg.vocab_size}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     eos = -1 if eos_id is None else int(eos_id)
-    if prefill_chunk is not None and prefill_chunk < 1:
-        raise ValueError(
-            f"prefill_chunk must be >= 1, got {prefill_chunk}")
     spec = draft is not None
     if spec:
         if draft_params is None:
@@ -361,6 +391,56 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         _, _, d_write = _llama._decode_fns(
             draft, 0.0, 0, 0.0, -1, draft_transform)
 
+    def resume_index(full_len: int) -> int:
+        """How many leading segments of the request's schedule the
+        prefix row already holds (0 without a shared prefix)."""
+        if p_fix == 0:
+            return 0
+        return (1 if _effective_chunk(full_len) is None
+                else p_fix // prefill_chunk)
+
+    def request_segments(full_len: int):
+        """Segment schedule for the FULL prompt: with a shared prefix,
+        admissions resume at resume_index(full_len) — unchunked prompts
+        get a two-segment schedule (prefix write, suffix fill) so the
+        split point exists; alignment of p_fix to the chunk is
+        validated above."""
+        chunk = _effective_chunk(full_len)
+        if p_fix and chunk is None:
+            return [(0, p_fix, False), (p_fix, full_len, True)]
+        return _llama.prefill_segments(full_len, chunk)
+
+    def fresh_rows():
+        """(target row cache, draft row cache | None) for one admission:
+        a device COPY of the prefix rows when a shared prefix exists
+        (the chunk writers donate their cache argument, so the masters
+        must never be passed in directly), else empty caches."""
+        if p_fix:
+            return (jax.tree.map(jnp.copy, prefix_row),
+                    (jax.tree.map(jnp.copy, d_prefix_row)
+                     if spec else None))
+        return (_llama.init_cache(cfg, 1, eff_len["target"],
+                                  kv_quant=kv_quant),
+                (_llama.init_cache(draft.cfg, 1, eff_len["draft"],
+                                   kv_quant=kv_quant) if spec else None))
+
+    if p_fix:
+        # prefill the shared prefix ONCE (write-only: the logits of a
+        # mid-prompt position are never needed)
+        prefix_row = _llama.init_cache(cfg, 1, eff_len["target"],
+                                       kv_quant=kv_quant)
+        d_prefix_row = (_llama.init_cache(draft.cfg, 1, eff_len["draft"],
+                                          kv_quant=kv_quant)
+                        if spec else None)
+        segs = request_segments(p_fix + 1)  # +1: any suffix length
+        for start, end, _ in segs[:resume_index(p_fix + 1)]:
+            piece = prefix[None, start:end]
+            prefix_row = chunk_write(params, prefix_row, piece,
+                                     jnp.int32(start))
+            if spec:
+                d_prefix_row = d_write(draft_params, d_prefix_row,
+                                       piece, jnp.int32(start))
+
     # slot state: cache/tok/pos live on device; occupancy bookkeeping
     # (owner, frozen, emitted) lives on the host — the loop reads tokens
     # back once per step anyway (it must, to detect EOS)
@@ -407,8 +487,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         st = pending[s]
         prompt_r = reqs[st["ridx"]]
         p_len = prompt_r.shape[0]
-        segments = _llama.prefill_segments(
-            p_len, _effective_chunk(p_len))
+        segments = request_segments(p_len)
         budget = prefill_chunks_per_sync or len(segments)
         for start, end, is_last in segments[st["next"]:
                                             st["next"] + budget]:
@@ -450,14 +529,11 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         # request (cache allocation only; the prompt streams in below)
         for s in range(slots):
             if owner[s] is None and s not in pending and queue:
+                ridx = queue.popleft()
+                row, d_row = fresh_rows()
                 pending[s] = {
-                    "ridx": queue.popleft(),
-                    "row": _llama.init_cache(cfg, 1, eff_len["target"],
-                                             kv_quant=kv_quant),
-                    "d_row": (_llama.init_cache(
-                        draft.cfg, 1, eff_len["draft"],
-                        kv_quant=kv_quant) if spec else None),
-                    "next": 0,
+                    "ridx": ridx, "row": row, "d_row": d_row,
+                    "next": resume_index(reqs[ridx].shape[0]),
                 }
         for s in list(pending):
             advance_prefill(s)
