@@ -935,6 +935,41 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 16,
             n_requests * max_new / t_seq, 1),
         "speedup_vs_sequential": round(t_seq / t_serve, 2),
     }
+    # prefix caching: the same requests behind a shared system prompt,
+    # prefilled once vs once per admission — the saved work is
+    # n_requests-1 prefix prefills
+    try:
+        # keyed to the CONFIG the prompts must fit, not the backend: a
+        # small cfg on-chip must not overflow max_len into an error row
+        pfx_len = min(128, cfg.max_len // 4)
+        key, kp = jax.random.split(key)
+        pfx = jax.random.randint(kp, (pfx_len,), 0, cfg.vocab_size)
+        full = [jnp.concatenate([pfx, p]) for p in prompts]
+        serve_loop(model, params, full, slots=slots,
+                   max_new_tokens=max_new,
+                   steps_per_sync=steps_per_sync)  # warm compiles
+        t0 = time.perf_counter()
+        serve_loop(model, params, full, slots=slots,
+                   max_new_tokens=max_new, steps_per_sync=steps_per_sync)
+        t_unshared = time.perf_counter() - t0
+        serve_loop(model, params, prompts, shared_prefix=pfx,
+                   slots=slots, max_new_tokens=max_new,
+                   steps_per_sync=steps_per_sync)  # warm
+        t0 = time.perf_counter()
+        res_p = serve_loop(model, params, prompts, shared_prefix=pfx,
+                           slots=slots, max_new_tokens=max_new,
+                           steps_per_sync=steps_per_sync)
+        t_shared = time.perf_counter() - t0
+        n_p = sum(len(r.tokens) for r in res_p)
+        out["prefix_cache"] = {
+            "prefix_len": pfx_len,
+            "tokens_per_sec": round(n_p / t_shared, 1),
+            "unshared_tokens_per_sec": round(n_p / t_unshared, 1),
+            "speedup_vs_unshared": round(t_unshared / t_shared, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+        out["prefix_cache"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # speculative continuous batching: the int8 self-draft (cheap by HBM
     # bytes, high-acceptance by construction — bench_speculative's
     # realistic arm) through the same lanes

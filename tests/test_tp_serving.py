@@ -184,3 +184,55 @@ def test_speculative_under_tp_mesh():
                         transformer_param_sharding(dparams, mesh))
     got = speculative_generate(model, sp, draft, sd, prompt, 10, k=3)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_serve_loop_under_tp_mesh():
+    """SHARDED continuous batching: serve_loop with params placed by the
+    tp rule table and lane caches sharded over kv heads — per-request
+    tokens exactly equal the unsharded loop's, including speculation
+    (both models sharded) and admission churn."""
+    import dataclasses
+
+    from tf_operator_tpu.models.serving import serve_loop
+    from tf_operator_tpu.parallel.mesh import make_mesh
+    from tf_operator_tpu.parallel.tp import (
+        kv_cache_sharding, transformer_param_sharding,
+    )
+
+    cfg = llama.tiny(dtype=jnp.float32, max_len=128)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    d_cfg = dataclasses.replace(cfg, n_layers=1)
+    d_model = llama.Llama(d_cfg)
+    d_params = d_model.init(jax.random.PRNGKey(7),
+                            jnp.zeros((1, 8), jnp.int32),
+                            train=False)["params"]
+    key = jax.random.PRNGKey(3)
+    prompts = []
+    for n in (6, 11, 4, 9):
+        key, k = jax.random.split(key)
+        prompts.append(jax.random.randint(k, (n,), 0, cfg.vocab_size))
+
+    slots = 4
+    want = serve_loop(model, params, prompts, slots=slots,
+                      max_new_tokens=10, draft=d_model,
+                      draft_params=d_params, spec_k=2, steps_per_sync=2)
+
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    sp = jax.device_put(params, transformer_param_sharding(params, mesh))
+    sd = jax.device_put(d_params,
+                        transformer_param_sharding(d_params, mesh))
+    # slots=4 divides dp*fsdp=4, so kv_cache_sharding genuinely shards
+    # the SLOT axis too (insert_row's dynamic-slot scatter runs against
+    # a batch-sharded cache), alongside kv heads over tp
+    got = serve_loop(model, sp, prompts, slots=slots, max_new_tokens=10,
+                     draft=d_model, draft_params=sd, spec_k=2,
+                     steps_per_sync=2,
+                     cache_sharding=kv_cache_sharding(cfg, mesh, slots),
+                     draft_cache_sharding=kv_cache_sharding(
+                         d_cfg, mesh, slots))
+    assert [r.tokens for r in got] == [r.tokens for r in want]
+    assert ([(r.accepted_drafts, r.proposed_drafts) for r in got]
+            == [(r.accepted_drafts, r.proposed_drafts) for r in want])

@@ -168,6 +168,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                steps_per_sync: int = 8,
                prefill_chunks_per_sync: Optional[int] = None,
                shared_prefix=None,
+               cache_sharding=None, draft_cache_sharding=None,
                draft=None, draft_params=None, spec_k: int = 4,
                draft_transform=None) -> List[ServeResult]:
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
@@ -208,6 +209,13 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     target-only serving; both models prefill at admission and the
     verify write costs spec_k+1 extra cache slots of headroom (bounds
     validated below).
+
+    cache_sharding / draft_cache_sharding: generate()'s tensor-parallel
+    serving seam (parallel/tp.kv_cache_sharding over `slots`), one per
+    model — shard params with transformer_param_sharding and the lane
+    caches follow; single-row admission caches take the same spec with
+    the batch axis unpartitioned.  Tokens stay exactly equal to the
+    unsharded loop.
 
     shared_prefix: PREFIX CACHING — 1-D tokens (a system prompt)
     logically prepended to EVERY request but prefilled ONCE: each
@@ -410,28 +418,58 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             return [(0, p_fix, False), (p_fix, full_len, True)]
         return _llama.prefill_segments(full_len, chunk)
 
+    def _row_sharding(batch_sharding_):
+        """Single-row admission caches take the batch cache's spec with
+        the batch axis UNPARTITIONED (a size-1 dim can't shard)."""
+        if batch_sharding_ is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if not isinstance(batch_sharding_, NamedSharding):
+            # generate() accepts a pytree of shardings; the serve loop
+            # must derive the row spec from ONE broadcastable sharding —
+            # fail with the contract, not an AttributeError mid-loop
+            raise ValueError(
+                "serve_loop cache shardings must be a single "
+                "NamedSharding broadcast over every cache leaf "
+                f"(parallel/tp.kv_cache_sharding), got "
+                f"{type(batch_sharding_).__name__}")
+        return NamedSharding(
+            batch_sharding_.mesh,
+            PartitionSpec(None, *batch_sharding_.spec[1:]))
+
+    row_sh = _row_sharding(cache_sharding)
+    d_row_sh = _row_sharding(draft_cache_sharding)
+
+    def _place(tree, sharding):
+        return tree if sharding is None else jax.device_put(tree, sharding)
+
     def fresh_rows():
         """(target row cache, draft row cache | None) for one admission:
         a device COPY of the prefix rows when a shared prefix exists
         (the chunk writers donate their cache argument, so the masters
         must never be passed in directly), else empty caches."""
         if p_fix:
+            # jnp.copy preserves sharding, so prefix rows stay placed
             return (jax.tree.map(jnp.copy, prefix_row),
                     (jax.tree.map(jnp.copy, d_prefix_row)
                      if spec else None))
-        return (_llama.init_cache(cfg, 1, eff_len["target"],
-                                  kv_quant=kv_quant),
-                (_llama.init_cache(draft.cfg, 1, eff_len["draft"],
-                                   kv_quant=kv_quant) if spec else None))
+        return (_place(_llama.init_cache(cfg, 1, eff_len["target"],
+                                         kv_quant=kv_quant), row_sh),
+                (_place(_llama.init_cache(draft.cfg, 1, eff_len["draft"],
+                                          kv_quant=kv_quant), d_row_sh)
+                 if spec else None))
 
     if p_fix:
         # prefill the shared prefix ONCE (write-only: the logits of a
         # mid-prompt position are never needed)
-        prefix_row = _llama.init_cache(cfg, 1, eff_len["target"],
-                                       kv_quant=kv_quant)
-        d_prefix_row = (_llama.init_cache(draft.cfg, 1, eff_len["draft"],
-                                          kv_quant=kv_quant)
-                        if spec else None)
+        prefix_row = _place(
+            _llama.init_cache(cfg, 1, eff_len["target"],
+                              kv_quant=kv_quant), row_sh)
+        d_prefix_row = (_place(
+            _llama.init_cache(draft.cfg, 1, eff_len["draft"],
+                              kv_quant=kv_quant), d_row_sh)
+            if spec else None)
         segs = request_segments(p_fix + 1)  # +1: any suffix length
         for start, end, _ in segs[:resume_index(p_fix + 1)]:
             piece = prefix[None, start:end]
@@ -444,10 +482,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # slot state: cache/tok/pos live on device; occupancy bookkeeping
     # (owner, frozen, emitted) lives on the host — the loop reads tokens
     # back once per step anyway (it must, to detect EOS)
-    cache = _llama.init_cache(cfg, slots, eff_len["target"],
-                              kv_quant=kv_quant)
-    d_cache = (_llama.init_cache(draft.cfg, slots, eff_len["draft"],
-                                 kv_quant=kv_quant) if spec else None)
+    cache = _place(_llama.init_cache(cfg, slots, eff_len["target"],
+                                     kv_quant=kv_quant), cache_sharding)
+    d_cache = (_place(_llama.init_cache(draft.cfg, slots,
+                                        eff_len["draft"],
+                                        kv_quant=kv_quant),
+                      draft_cache_sharding) if spec else None)
     tok = jnp.zeros((slots,), jnp.int32)
     pos = jnp.zeros((slots,), jnp.int32)
     frozen_py = [True] * slots
