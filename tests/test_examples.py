@@ -449,3 +449,25 @@ def test_serve_cli_smoke_modes(tmp_path):
         assert rc.returncode == 0, (extra, rc.stderr[-2000:])
         assert "3 requests" in rc.stdout, (extra, rc.stdout)
         assert "request 2 (slot" in rc.stdout, (extra, rc.stdout)
+
+
+def test_serve_tpujob_through_run_local():
+    """The serving workload AS an operator job: the TPUJob serving spec
+    goes CR -> operator reconcile -> pod -> real serve_llama.py
+    subprocess (speculative continuous batching on smoke weights) ->
+    Succeeded — the operator half scheduling the inference half."""
+    from tf_operator_tpu.runtime.local import run_local
+
+    doc = yaml.safe_load(
+        open(os.path.join(EX, "llama", "serve_llama_tpujob.yaml")))
+    c = doc["spec"]["tpuReplicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0]
+    c["command"] = ["python",
+                    os.path.join(EX, "llama", "serve_llama.py")]
+    result = run_local(doc, timeout=600,
+                       extra_env={"PYTHONPATH": REPO,
+                                  "JAX_PLATFORMS": "cpu"})
+    combined = "\n".join(result["logs"].values())
+    assert result["state"] == "Succeeded", combined[-2000:]
+    assert "3 requests" in combined
+    assert "speculative serving" in combined

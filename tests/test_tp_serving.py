@@ -194,10 +194,6 @@ def test_serve_loop_under_tp_mesh():
     import dataclasses
 
     from tf_operator_tpu.models.serving import serve_loop
-    from tf_operator_tpu.parallel.mesh import make_mesh
-    from tf_operator_tpu.parallel.tp import (
-        kv_cache_sharding, transformer_param_sharding,
-    )
 
     cfg = llama.tiny(dtype=jnp.float32, max_len=128)
     model = llama.Llama(cfg)
