@@ -508,3 +508,67 @@ def test_shared_prefix_windowed_ring():
                                   cache_len=kw.get("cache_len"),
                                   prefill_chunk=kw.get("prefill_chunk"))
             assert r.tokens == [int(t) for t in np.asarray(want[0])], kw
+
+
+def test_randomized_feature_combinations_stay_oracle_exact():
+    """Seeded property sweep: random slots/chunking/budget/prefix/
+    speculation/window/int8 combinations, every one oracle-exact per
+    request.  The grid tests above pin each feature's contract; this
+    sweeps the CROSS-PRODUCT corners no hand-written case covers."""
+    import dataclasses
+    import random as pyrandom
+
+    from tf_operator_tpu.models import quant
+
+    rnd = pyrandom.Random(1234)
+    base = _f32(max_len=256)
+    w_cfg = _f32(max_len=256, sliding_window=8)
+    for trial in range(6):
+        windowed = rnd.random() < 0.5
+        cfg = w_cfg if windowed else base
+        model = llama.Llama(cfg)
+        params = model.init(jax.random.PRNGKey(trial),
+                            jnp.zeros((1, 8), jnp.int32),
+                            train=False)["params"]
+        int8 = rnd.random() < 0.4
+        xform = None
+        p_use = params
+        if int8:
+            p_use = quant.quantize_params(params)
+            xform = quant.make_dequantizer(cfg.dtype)
+        kv_q = rnd.random() < 0.4
+        chunk = rnd.choice([None, 4, 8])
+        kw = dict(slots=rnd.choice([1, 2, 3]),
+                  max_new_tokens=rnd.choice([5, 9]),
+                  steps_per_sync=rnd.choice([1, 3, 5]),
+                  kv_quant=kv_q, params_transform=xform)
+        if chunk is not None:
+            kw["prefill_chunk"] = chunk
+            if rnd.random() < 0.5:
+                kw["prefill_chunks_per_sync"] = rnd.choice([1, 2])
+        pfx = None
+        if chunk is not None and rnd.random() < 0.5:
+            pfx = _prompts(cfg, [chunk * rnd.choice([1, 2])],
+                           seed=100 + trial)[0]
+            kw["shared_prefix"] = pfx
+        if rnd.random() < 0.5:
+            d_cfg = dataclasses.replace(cfg, n_layers=1)
+            d_model = llama.Llama(d_cfg)
+            d_params = d_model.init(jax.random.PRNGKey(50 + trial),
+                                    jnp.zeros((1, 8), jnp.int32),
+                                    train=False)["params"]
+            if int8:
+                d_params = quant.quantize_params(d_params)
+                kw["draft_transform"] = xform
+            kw.update(draft=d_model, draft_params=d_params,
+                      spec_k=rnd.choice([1, 2, 3]))
+        lens = [rnd.randint(3, 14) for _ in range(rnd.randint(2, 4))]
+        sufs = _prompts(cfg, lens, seed=200 + trial)
+        res = serve_loop(model, p_use, sufs, **kw)
+        for r, s in zip(res, sufs):
+            f = (jnp.concatenate([pfx, s]) if pfx is not None else s)
+            want = llama.generate(
+                model, p_use, f[None, :], kw["max_new_tokens"],
+                kv_quant=kv_q, params_transform=xform)
+            assert r.tokens == [int(t) for t in np.asarray(want[0])], (
+                trial, kw.keys(), r.slot)
