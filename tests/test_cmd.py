@@ -199,8 +199,13 @@ def test_health_server_endpoints():
     def get(path):
         try:
             with urllib.request.urlopen(base + path) as r:
-                return r.status, r.read().decode()
+                body = r.read()
+                # every response is Content-Length-terminated: keep-alive
+                # scrape clients would otherwise hang on an open body
+                assert int(r.headers["Content-Length"]) == len(body)
+                return r.status, body.decode()
         except urllib.error.HTTPError as e:
+            assert int(e.headers["Content-Length"]) == len(e.read())
             return e.code, ""
 
     assert get("/healthz")[0] == 200

@@ -328,6 +328,82 @@ def test_trace_window_starts_on_resumed_step_counter(tmp_path):
     assert not prof2._tracing  # flushed; a later start_trace would work
 
 
+def test_goodput_split_with_checkpointing(tmp_path):
+    """Acceptance: a checkpointing session reports goodput < 1.0 and the
+    productive + checkpoint + replay (+ idle) fractions sum to ~1.0."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    res = run_training(
+        _make_state(), _train_step, _batches(), num_steps=6,
+        checkpointer=Checkpointer(ckpt_dir), save_interval_steps=2,
+    )
+    g = res.goodput
+    assert 0.0 < g["goodput"] < 1.0
+    assert g["checkpoint_fraction"] > 0.0  # saves took measurable time
+    assert g["replay_fraction"] == 0.0  # fresh run, nothing restored
+    total = (
+        g["productive_fraction"] + g["checkpoint_fraction"]
+        + g["replay_fraction"] + g["idle_fraction"]
+    )
+    assert abs(total - 1.0) < 1e-6
+    assert g["wall_time_s"] > 0
+
+    # resumed run: restore time lands in replay_fraction
+    res2 = run_training(
+        _make_state(), _train_step, _batches(), num_steps=9,
+        checkpointer=Checkpointer(ckpt_dir), save_interval_steps=100,
+    )
+    assert res2.resumed_from == 6
+    assert res2.goodput["replay_fraction"] > 0.0
+    assert res2.goodput["goodput"] < 1.0
+
+
+def test_goodput_in_metrics_line_and_summary():
+    from tf_operator_tpu.runtime.profiler import GoodputTracker
+
+    prof = Profiler(batch_size=2)
+    lines = []
+    run_training(
+        _make_state(), _train_step, _batches(), num_steps=4,
+        log_interval_steps=2, profiler=prof, metrics_sink=lines.append,
+    )
+    payload = json.loads(lines[-1])
+    assert 0.0 < payload["goodput"] <= 1.0
+    assert "idle_fraction" in payload
+    s = prof.summary()
+    assert "steps_per_sec" in s and "goodput" in s
+
+    # MFU needs flops_per_step + peak; charged against total wall-clock
+    t = GoodputTracker(flops_per_step=1e9, peak_flops_per_sec=1e12)
+    t.start()
+    t.note_productive(0.5, steps=10)
+    t._end = t._start + 1.0  # freeze: exactly 1s of wall
+    assert t.mfu() == pytest.approx((1e9 * 10 / 1.0) / 1e12)
+    assert t.summary()["mfu"] == pytest.approx(0.01)
+    assert GoodputTracker().mfu() is None
+
+
+def test_metrics_line_sanitizes_non_finite_floats():
+    prof = Profiler()
+    line = prof.metrics_line(
+        1, extra={"loss": float("nan"), "grad_norm": float("inf"), "ok": 2.0}
+    )
+    payload = json.loads(line)  # bare NaN would fail strict parsers
+    assert payload["loss"] is None
+    assert payload["grad_norm"] is None
+    assert payload["ok"] == 2.0
+    assert "NaN" not in line and "Infinity" not in line
+
+
+def test_step_profile_window_is_bounded_deque():
+    from collections import deque
+
+    p = StepProfile(window=8)
+    assert isinstance(p._times, deque) and p._times.maxlen == 8
+    for _ in range(20):
+        p.tick()
+    assert p.steps_recorded == 8  # oldest dropped in O(1)
+
+
 def test_maybe_trace_tolerates_externally_opened_window(tmp_path):
     """The documented external pattern — trace_window() around a run whose
     loop also calls maybe_trace(step) — must bound the window, not crash

@@ -1,0 +1,162 @@
+"""Prometheus exposition-format conformance for engine/metrics.py.
+
+A scraper parses expose_all() line by line; one malformed line (an
+unescaped quote in a label value, a bare NaN) silently drops the whole
+target. These tests parse the exposition with the text-format grammar and
+check the histogram invariants, plus a threads-vs-expose race.
+"""
+import math
+import re
+import threading
+
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.metrics import Counter, Gauge, Histogram
+
+# text-format sample line: name{labels} value  (labels optional)
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})? "
+    r"(?P<value>[^ ]+)$"
+)
+# one label pair: name="value" with \\, \", \n escapes only
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\["\\n])*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def _parse_labels(raw):
+    if not raw:
+        return {}
+    out = {}
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        assert m, f"malformed label pair at {raw[pos:]!r}"
+        out[m.group(1)] = _unescape(m.group(2))
+        pos = m.end()
+        if pos < len(raw):
+            assert raw[pos] == ",", f"expected ',' at {raw[pos:]!r}"
+            pos += 1
+    return out
+
+
+def parse_exposition(text: str):
+    """Parse the full exposition; returns {metric_name: [(labels, value)]}.
+    Raises AssertionError on any line the text-format grammar rejects."""
+    samples = {}
+    helped, typed = set(), set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split(" ", 3)[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4 and parts[3] in (
+                "counter", "gauge", "histogram"
+            ), line
+            typed.add(parts[2])
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        value = float(m.group("value"))  # raises on malformed value
+        samples.setdefault(m.group("name"), []).append(
+            (_parse_labels(m.group("labels")), value)
+        )
+    # every sample belongs to a HELP/TYPE'd family (base name for
+    # histogram _bucket/_sum/_count children)
+    for name in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in typed or base in typed, f"{name} has no TYPE"
+        assert name in helped or base in helped, f"{name} has no HELP"
+    return samples
+
+
+def test_label_values_escaped_round_trip():
+    c = Counter("test_escape_total", "labels with hostile values")
+    hostile = 'he said "hi"\\path\nnewline'
+    c.inc({"msg": hostile})
+    text = c.expose()
+    # no raw newline inside a sample line
+    sample_lines = [l for l in text.splitlines() if not l.startswith("#")]
+    assert len(sample_lines) == 1
+    assert '\\"hi\\"' in sample_lines[0]
+    assert "\\n" in sample_lines[0]
+    parsed = parse_exposition(text)
+    (labels, value), = parsed["test_escape_total"]
+    assert labels["msg"] == hostile  # escaping round-trips exactly
+    assert value == 1.0
+
+
+def test_expose_all_round_trips_under_grammar():
+    metrics.JOBS_CREATED.inc({"job_namespace": "ns-a"})
+    metrics.RECONCILE_DURATION.observe(0.02, {"kind": "TFJob"})
+    metrics.WORKQUEUE_LATENCY.observe(0.003, {"kind": "TFJob"})
+    metrics.IS_LEADER.set(1)
+    samples = parse_exposition(metrics.expose_all())
+    assert any(
+        l.get("job_namespace") == "ns-a"
+        for l, _ in samples["tpu_operator_jobs_created_total"]
+    )
+    assert "tpu_operator_sync_phase_duration_seconds_bucket" in samples or \
+        "tpu_operator_reconcile_duration_seconds_bucket" in samples
+
+
+def test_histogram_exposition_invariants():
+    h = Histogram("test_histo_inv_seconds", "t", buckets=(0.1, 1.0, 5.0))
+    for v in (0.05, 0.5, 0.5, 3.0, 30.0):
+        h.observe(v, {"kind": "X"})
+    samples = parse_exposition(h.expose())
+    buckets = samples["test_histo_inv_seconds_bucket"]
+    by_le = {l["le"]: v for l, v in buckets if l["kind"] == "X"}
+    # cumulative and non-decreasing, ending at +Inf == _count
+    assert by_le["0.1"] == 1
+    assert by_le["1"] == 3
+    assert by_le["5"] == 4
+    assert by_le["+Inf"] == 5
+    ordered = [by_le["0.1"], by_le["1"], by_le["5"], by_le["+Inf"]]
+    assert ordered == sorted(ordered)
+    (_, count), = samples["test_histo_inv_seconds_count"]
+    assert count == by_le["+Inf"]
+    (_, total), = samples["test_histo_inv_seconds_sum"]
+    assert math.isclose(total, 0.05 + 0.5 + 0.5 + 3.0 + 30.0)
+
+
+def test_concurrent_inc_observe_vs_expose():
+    """Writers hammer a counter + histogram while readers run expose_all();
+    every intermediate exposition must parse, and the final counts must be
+    exact (no lost updates)."""
+    c = Counter("test_race_total", "race")
+    h = Histogram("test_race_seconds", "race", buckets=(0.5, 1.0))
+    n_threads, n_iters = 8, 500
+    errors = []
+
+    def writer(i):
+        try:
+            for _ in range(n_iters):
+                c.inc({"t": str(i % 4)})
+                h.observe(0.25, {"t": str(i % 4)})
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(50):
+                parse_exposition(metrics.expose_all())
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(n_threads)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    total = sum(c.get({"t": str(i)}) for i in range(4))
+    assert total == n_threads * n_iters
+    assert sum(h.count({"t": str(i)}) for i in range(4)) == n_threads * n_iters
+    parse_exposition(metrics.expose_all())

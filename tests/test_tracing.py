@@ -1,0 +1,257 @@
+"""Span tracing + controller state gauges (engine/tracing.py, the
+reconcile instrumentation, and the /debug/traces endpoint).
+
+The acceptance path: a reconcile driven through the fake cluster yields a
+trace whose child spans break the sync into phases, the same durations
+land in the per-phase histogram, and the health server serves the whole
+thing as Chrome trace-event JSON.
+"""
+import json
+import threading
+import urllib.request
+
+from tf_operator_tpu.cmd.health import HealthServer
+from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.options import ServerOptions, parse_args
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.engine import metrics, tracing
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+from tests import testutil
+
+
+# ----------------------------------------------------------------- tracer
+
+
+def test_tracer_nests_spans_and_records_durations():
+    t = tracing.Tracer()
+    with t.span("root", attrs={"kind": "TFJob"}) as root:
+        with t.span("child-a") as a:
+            with t.span("grandchild"):
+                pass
+        with t.span("child-b"):
+            pass
+    assert root.duration is not None and root.duration >= 0
+    assert [c.name for c in root.children] == ["child-a", "child-b"]
+    assert [c.name for c in a.children] == ["grandchild"]
+    assert a.parent is root
+    traces = t.traces()
+    assert len(traces) == 1 and traces[0] is root
+    # only roots land in the ring buffer
+    assert all(sp.parent is None for sp in traces)
+
+
+def test_tracer_span_feeds_histogram():
+    t = tracing.Tracer()
+    h = metrics.Histogram("test_tracer_phase_seconds", "t")
+    with t.span("phase", histogram=h, labels={"phase": "p"}):
+        pass
+    assert h.count({"phase": "p"}) == 1
+
+
+def test_tracer_ring_buffer_bounded():
+    t = tracing.Tracer(max_traces=4)
+    for i in range(10):
+        with t.span(f"s{i}"):
+            pass
+    names = [sp.name for sp in t.traces()]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_tracer_thread_isolation():
+    """Spans opened on different threads must not adopt each other as
+    parents (the stack is thread-local)."""
+    t = tracing.Tracer()
+    done = threading.Event()
+
+    def other():
+        with t.span("other-root"):
+            done.wait(2)
+
+    th = threading.Thread(target=other)
+    with t.span("main-root"):
+        th.start()
+        done.set()
+    th.join()
+    roots = {sp.name for sp in t.traces()}
+    assert roots == {"main-root", "other-root"}
+    assert all(not sp.children or sp.name in roots for sp in t.traces())
+
+
+def test_chrome_trace_export_shape():
+    t = tracing.Tracer()
+    with t.span("root", attrs={"job": "ns/x"}):
+        with t.span("inner"):
+            pass
+    doc = json.loads(t.export_chrome_json())
+    events = doc["traceEvents"]
+    assert {e["name"] for e in events} == {"root", "inner"}
+    for e in events:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    root_ev = next(e for e in events if e["name"] == "root")
+    assert root_ev["args"] == {"job": "ns/x"}
+
+
+def test_tracer_dump_writes_valid_json(tmp_path):
+    t = tracing.Tracer()
+    with t.span("r"):
+        pass
+    path = str(tmp_path / "trace.json")
+    t.dump(path)
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["traceEvents"]
+
+
+def test_trace_dump_flag_parsed():
+    o = parse_args(["--trace-dump", "/tmp/traces.json"])
+    assert o.trace_dump == "/tmp/traces.json"
+    assert parse_args([]).trace_dump == ""
+
+
+# ------------------------------------------------- reconcile instrumentation
+
+
+def _drive_reconcile(kinds=("TFJob",), worker=2):
+    cluster = FakeCluster()
+    mgr = OperatorManager(
+        cluster,
+        ServerOptions(enabled_schemes=EnabledSchemes(list(kinds)), resync_period=0),
+    )
+    mgr.factory.start_all()
+    job = testutil.new_tfjob(worker=worker)
+    cluster.create(job.kind, job.to_dict())
+    mgr.process_until_idle()
+    return cluster, mgr, job
+
+
+def test_reconcile_produces_phase_trace_and_histograms():
+    """Acceptance: a fake-cluster reconcile yields >= 3 named child spans
+    whose durations also land in the per-phase histogram."""
+    tracer = tracing.get_tracer()
+    tracer.clear()
+    metrics.SYNC_PHASE_DURATION.reset()
+    _drive_reconcile()
+
+    roots = [sp for sp in tracer.traces() if sp.name == "reconcile"]
+    assert roots, "reconcile must open a root span"
+    root = roots[0]
+    assert root.attrs["kind"] == "TFJob"
+    assert root.attrs["job"] == "default/test-tfjob"
+    child_names = {c.name for c in root.children}
+    assert len(child_names & {
+        "expectation_check", "pod_reconcile", "service_reconcile",
+        "status_update", "status_write",
+    }) >= 3
+    for child in root.children:
+        assert child.duration is not None and child.duration >= 0
+    # per-kind controller span nested under the engine's status phase
+    status_spans = [c for c in root.children if c.name == "status_update"]
+    if status_spans:
+        assert any(
+            g.name == "TFJob.status_rules" for g in status_spans[0].children
+        )
+    # the same phases appear in the histogram (span-fed)
+    for phase in child_names:
+        assert metrics.SYNC_PHASE_DURATION.count(
+            {"kind": "TFJob", "phase": phase}
+        ) >= 1, f"phase {phase} missing from histogram"
+
+
+def test_debug_traces_endpoint_serves_chrome_json():
+    tracer = tracing.get_tracer()
+    tracer.clear()
+    _drive_reconcile()
+    srv = HealthServer()  # default tracer = process-global
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/traces"
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            body = r.read()
+            assert int(r.headers["Content-Length"]) == len(body)
+    finally:
+        srv.stop()
+    doc = json.loads(body)
+    events = doc["traceEvents"]
+    assert any(e["name"] == "reconcile" for e in events)
+    phase_names = {e["name"] for e in events}
+    assert {"pod_reconcile", "service_reconcile"} <= phase_names
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+# --------------------------------------------------- controller state gauges
+
+
+def test_workqueue_latency_and_depth_gauges():
+    metrics.WORKQUEUE_LATENCY.reset()
+    metrics.WORKQUEUE_DEPTH.reset()
+    _drive_reconcile()
+    assert metrics.WORKQUEUE_LATENCY.count({"kind": "TFJob"}) >= 1
+    # drained: depth gauge back to zero
+    assert metrics.WORKQUEUE_DEPTH.get({"kind": "TFJob"}) == 0
+    text = metrics.expose_all()
+    assert "tpu_operator_workqueue_latency_seconds_bucket" in text
+    assert "tpu_operator_workqueue_depth" in text
+
+
+def test_running_replicas_gauge_tracks_and_forgets():
+    metrics.RUNNING_REPLICAS_TRACKER.reset()
+    cluster, mgr, job = _drive_reconcile(worker=2)
+    labels = {"kind": "TFJob", "replica_type": "Worker"}
+    assert metrics.RUNNING_REPLICAS.get(labels) == 0  # pods still Pending
+    for p in cluster.list_pods():
+        p["status"]["phase"] = objects.POD_RUNNING
+        cluster.update_pod(p)
+    mgr.process_until_idle()
+    assert metrics.RUNNING_REPLICAS.get(labels) == 2
+    # deletion: the NotFound sync path forgets the job's contribution
+    cluster.delete(job.kind, "default", job.name)
+    mgr.process_until_idle()
+    assert metrics.RUNNING_REPLICAS.get(labels) == 0
+
+
+def test_sync_errors_counter_increments_on_error():
+    from unittest import mock
+
+    from tf_operator_tpu.engine.controller import ReconcileResult
+
+    metrics.SYNC_ERRORS.reset()
+    cluster = FakeCluster()
+    mgr = OperatorManager(
+        cluster, ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    )
+    cluster.create("TFJob", testutil.new_tfjob("err", worker=1).to_dict())
+    ctl = mgr.controllers["TFJob"]
+    with mock.patch.object(
+        ctl.engine, "reconcile", return_value=ReconcileResult(error="boom")
+    ), mock.patch.object(ctl.queue, "add_rate_limited"):
+        ctl._sync("default/err")
+    assert metrics.SYNC_ERRORS.get({"kind": "TFJob"}) == 1
+
+
+def test_control_ops_counters_count_creates():
+    metrics.CONTROL_OPS.reset()
+    _drive_reconcile(worker=2)
+    assert metrics.CONTROL_OPS.get({"kind": "Pod", "verb": "create"}) == 2
+    assert metrics.CONTROL_OPS.get({"kind": "Service", "verb": "create"}) == 2
+
+
+def test_replica_gauge_tracker_aggregates_across_jobs():
+    g = metrics.Gauge("test_running_replicas_agg", "t")
+    tr = metrics.ReplicaGaugeTracker(g)
+    tr.update("TFJob", "ns/a", {"Worker": 2, "PS": 1})
+    tr.update("TFJob", "ns/b", {"Worker": 3})
+    assert g.get({"kind": "TFJob", "replica_type": "Worker"}) == 5
+    assert g.get({"kind": "TFJob", "replica_type": "PS"}) == 1
+    tr.update("TFJob", "ns/a", {"Worker": 1})  # PS dropped -> 0 for job a
+    assert g.get({"kind": "TFJob", "replica_type": "Worker"}) == 4
+    assert g.get({"kind": "TFJob", "replica_type": "PS"}) == 0
+    tr.forget("TFJob", "ns/b")
+    assert g.get({"kind": "TFJob", "replica_type": "Worker"}) == 1

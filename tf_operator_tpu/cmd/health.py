@@ -1,9 +1,15 @@
-"""Health probes + metrics endpoints.
+"""Health probes + metrics + debug-trace endpoints.
 
 Reference parity: /healthz and /readyz on the probe address (reference
 cmd/training-operator.v1/main.go:110-117, probed by the Deployment at
 manifests/base/deployment.yaml:35-45) and the Prometheus exposition on the
 metrics address (main.go:63, legacy --monitoring-port options.go:75-77).
+Beyond the reference: /debug/traces serves the reconcile span tracer's
+Chrome trace-event JSON (engine/tracing.py) — load it in chrome://tracing
+or Perfetto to see where inside each sync the time went.
+
+Every response carries Content-Length: keep-alive scrape clients would
+otherwise wait on an unterminated body until the connection times out.
 """
 from __future__ import annotations
 
@@ -11,45 +17,54 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
-from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine import metrics, tracing
 
 Check = Callable[[], bool]
 
 
 class _Handler(BaseHTTPRequestHandler):
     checks: Dict[str, Check] = {}
+    tracer: Optional[tracing.Tracer] = None
 
     def log_message(self, fmt, *args):  # silence per-request stderr noise
         pass
 
+    def _respond(self, status: int, body: bytes, content_type: str = "text/plain") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 (stdlib API name)
         path = self.path.split("?")[0]
         if path == "/metrics":
-            body = metrics.expose_all().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.end_headers()
-            self.wfile.write(body)
+            self._respond(
+                200, metrics.expose_all().encode(), "text/plain; version=0.0.4"
+            )
+            return
+        if path == "/debug/traces":
+            tracer = self.tracer or tracing.get_tracer()
+            self._respond(
+                200, tracer.export_chrome_json().encode(), "application/json"
+            )
             return
         check = self.checks.get(path)
         if check is None:
-            self.send_response(404)
-            self.end_headers()
+            self._respond(404, b"not found")
             return
         ok = False
         try:
             ok = check()
         except Exception:
             ok = False
-        self.send_response(200 if ok else 500)
-        self.send_header("Content-Type", "text/plain")
-        self.end_headers()
-        self.wfile.write(b"ok" if ok else b"unhealthy")
+        self._respond(200 if ok else 500, b"ok" if ok else b"unhealthy")
 
 
 class HealthServer:
-    """Serves /healthz, /readyz, and /metrics on one listener. Bind with
-    port 0 to get an ephemeral port (tests read .port after start)."""
+    """Serves /healthz, /readyz, /metrics, and /debug/traces on one
+    listener. Bind with port 0 to get an ephemeral port (tests read .port
+    after start). `tracer` defaults to the process-global span tracer."""
 
     def __init__(
         self,
@@ -57,12 +72,14 @@ class HealthServer:
         port: int = 0,
         healthz: Optional[Check] = None,
         readyz: Optional[Check] = None,
+        tracer: Optional[tracing.Tracer] = None,
     ) -> None:
         handler = type("Handler", (_Handler,), {})
         handler.checks = {
             "/healthz": healthz or (lambda: True),
             "/readyz": readyz or (lambda: True),
         }
+        handler.tracer = tracer
         self._server = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 

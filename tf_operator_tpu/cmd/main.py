@@ -132,6 +132,17 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
 
     stop_event = threading.Event()
 
+    def dump_traces():
+        if not options.trace_dump:
+            return
+        from tf_operator_tpu.engine import tracing
+
+        try:
+            tracing.get_tracer().dump(options.trace_dump)
+            log.info("reconcile traces dumped to %s", options.trace_dump)
+        except OSError as e:
+            log.warning("trace dump failed: %s", e)
+
     def start_manager():
         manager.start()
         log.info("manager started: kinds=%s", list(manager.controllers))
@@ -158,11 +169,21 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         metrics_srv.stop()
         if webhook_srv is not None:
             webhook_srv.stop()
+        dump_traces()
     else:
-        # keep handles for the caller to stop
+        # keep handles for the caller to stop; manager.stop() must honor
+        # --trace-dump too — embedded callers never reach the block-mode
+        # shutdown path above
         manager._probe = probe
         manager._metrics_srv = metrics_srv
         manager._webhook_srv = webhook_srv
+        orig_stop = manager.stop
+
+        def stop_and_dump():
+            orig_stop()
+            dump_traces()
+
+        manager.stop = stop_and_dump
     return manager
 
 

@@ -70,6 +70,11 @@ class _KindController:
             )
         )
         self.workers: List[threading.Thread] = []
+        # enqueue timestamps for the queue-latency histogram: first add
+        # wins (client-go workqueue dedups, so the oldest pending event
+        # defines how long the key waited), popped when a worker syncs
+        self._enqueue_times: Dict[str, float] = {}
+        self._enqueue_lock = threading.Lock()
 
     # ------------------------------------------------------------- handlers
     def _in_scope(self, obj) -> bool:
@@ -93,17 +98,46 @@ class _KindController:
             self.enqueue(objects.key_of(obj))
 
     def enqueue(self, key: str) -> None:
+        with self._enqueue_lock:
+            self._enqueue_times.setdefault(key, time.monotonic())
         self.queue.add(key)
+        self._update_depth()
+
+    def _requeue_rate_limited(self, key: str) -> None:
+        """Instrumented twin of enqueue() for the retry paths: requeued keys
+        must be timed too — the latency histogram would otherwise go blind
+        exactly under the failure conditions it exists to surface."""
+        with self._enqueue_lock:
+            self._enqueue_times.setdefault(key, time.monotonic())
+        self.queue.add_rate_limited(key)
+        self._update_depth()
+
+    def _requeue_after(self, key: str, delay: float) -> None:
+        with self._enqueue_lock:
+            self._enqueue_times.setdefault(key, time.monotonic())
+        self.queue.add_after(key, delay)
+        self._update_depth()
+
+    def _update_depth(self) -> None:
+        metrics.WORKQUEUE_DEPTH.set(len(self.queue), {"kind": self.kind})
 
     # ------------------------------------------------------------- work loop
     def _sync(self, key: str) -> None:
         namespace, _, name = key.partition("/")
         log = logger_for_key(self.kind, key)
         t0 = time.monotonic()
+        with self._enqueue_lock:
+            enqueued_at = self._enqueue_times.pop(key, None)
+        if enqueued_at is not None:
+            metrics.WORKQUEUE_LATENCY.observe(
+                t0 - enqueued_at, {"kind": self.kind}
+            )
+        self._update_depth()
         try:
             raw = self.manager.cluster.get(self.kind, namespace, name)
         except NotFoundError:
             self.queue.forget(key)
+            metrics.RUNNING_REPLICAS_TRACKER.forget(self.kind, key)
             return  # deleted; nothing to reconcile
         job = self.engine.adapter.from_dict(raw)
         result = self.engine.reconcile(job)
@@ -111,9 +145,10 @@ class _KindController:
             time.monotonic() - t0, {"kind": self.kind}
         )
         if result.error:
+            metrics.SYNC_ERRORS.inc({"kind": self.kind})
             if self.queue.num_requeues(key) < MAX_RECONCILE_RETRIES:
                 log.warning("reconcile error, requeueing: %s", result.error)
-                self.queue.add_rate_limited(key)
+                self._requeue_rate_limited(key)
             else:
                 # client-go never abandons an erroring key — it caps the
                 # backoff.  Forgetting here would wedge the job until the
@@ -124,11 +159,11 @@ class _KindController:
                     "reconcile retries exhausted, holding at max backoff: %s",
                     result.error,
                 )
-                self.queue.add_after(key, EXHAUSTED_RETRY_PERIOD)
+                self._requeue_after(key, EXHAUSTED_RETRY_PERIOD)
             return
         self.queue.forget(key)
         if result.requeue_after is not None:
-            self.queue.add_after(key, result.requeue_after)
+            self._requeue_after(key, result.requeue_after)
 
     def run_worker(self) -> None:
         while True:
@@ -139,9 +174,11 @@ class _KindController:
                 self._sync(key)
             except Exception as e:  # noqa: BLE001 — workers must not die
                 logger_for_key(self.kind, key).error("sync panic: %s", e)
-                self.queue.add_rate_limited(key)
+                metrics.SYNC_ERRORS.inc({"kind": self.kind})
+                self._requeue_rate_limited(key)
             finally:
                 self.queue.done(key)
+                self._update_depth()
 
     def start_workers(self, n: int) -> None:
         for i in range(n):

@@ -36,6 +36,9 @@ class ServerOptions:
     webhook_bind_address: str = ""
     webhook_cert_file: str = ""
     webhook_key_file: str = ""
+    # write the reconcile span tracer's Chrome trace-event JSON here on
+    # shutdown (engine/tracing.py); empty = disabled
+    trace_dump: str = ""
 
     @property
     def all_kinds(self) -> List[str]:
@@ -86,6 +89,13 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
     )
     p.add_argument("--webhook-cert-file", default="")
     p.add_argument("--webhook-key-file", default="")
+    p.add_argument(
+        "--trace-dump",
+        default="",
+        metavar="PATH",
+        help="on shutdown, write recent reconcile traces here as Chrome "
+        "trace-event JSON (view in chrome://tracing); empty disables",
+    )
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -112,4 +122,5 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         webhook_bind_address=a.webhook_bind_address,
         webhook_cert_file=a.webhook_cert_file,
         webhook_key_file=a.webhook_key_file,
+        trace_dump=a.trace_dump,
     )

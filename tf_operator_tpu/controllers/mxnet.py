@@ -110,6 +110,10 @@ class MXNetAdapter(FrameworkAdapter):
         return mxapi.is_scheduler(rtype)
 
     def update_job_status(self, engine, job, ctx: StatusContext) -> None:
+        with engine.tracer.span("MXJob.status_rules"):
+            self._update_job_status(engine, job, ctx)
+
+    def _update_job_status(self, engine, job, ctx: StatusContext) -> None:
         """reference mxjob_controller.go:328-412: Running while any replica
         runs; Succeeded when any replica type fully completes; ExitCode
         failures restart, others fail."""

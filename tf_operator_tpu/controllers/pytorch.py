@@ -119,15 +119,16 @@ class PyTorchAdapter(FrameworkAdapter):
         return [rt for rt in (ptapi.REPLICA_MASTER, ptapi.REPLICA_WORKER) if rt in replicas]
 
     def update_job_status(self, engine, job, ctx: StatusContext) -> None:
-        if (
-            job.elastic_policy is not None
-            and ptapi.REPLICA_MASTER not in ctx.replicas
-        ):
-            self._elastic_update_job_status(job, ctx)
-            return
-        master_based_update_job_status(
-            self.KIND, job, ctx, master_type=ptapi.REPLICA_MASTER
-        )
+        with engine.tracer.span("PyTorchJob.status_rules"):
+            if (
+                job.elastic_policy is not None
+                and ptapi.REPLICA_MASTER not in ctx.replicas
+            ):
+                self._elastic_update_job_status(job, ctx)
+                return
+            master_based_update_job_status(
+                self.KIND, job, ctx, master_type=ptapi.REPLICA_MASTER
+            )
 
     def _elastic_update_job_status(self, job, ctx: StatusContext) -> None:
         """Worker-only elastic jobs (torchrun rendezvous, no Master): a

@@ -157,6 +157,12 @@ class TFAdapter(FrameworkAdapter):
         return False
 
     def update_job_status(self, engine: JobEngine, job: tfapi.TFJob, ctx: StatusContext) -> None:
+        with engine.tracer.span("TFJob.status_rules"):
+            self._update_job_status(engine, job, ctx)
+
+    def _update_job_status(
+        self, engine: JobEngine, job: tfapi.TFJob, ctx: StatusContext
+    ) -> None:
         """reference UpdateJobStatus (status.go:64-220): chief presence decides
         the success source; worker-0 completion is the chief-less fallback;
         Restarting precedence over Failed."""

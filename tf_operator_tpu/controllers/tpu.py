@@ -118,6 +118,12 @@ class TPUAdapter(FrameworkAdapter):
         return rtype == tpuapi.REPLICA_WORKER and index == 0  # coordinator host
 
     def update_job_status(self, engine: JobEngine, job, ctx: StatusContext) -> None:
+        with engine.tracer.span("TPUJob.status_rules"):
+            self._update_job_status(engine, job, ctx)
+
+    def _update_job_status(
+        self, engine: JobEngine, job, ctx: StatusContext
+    ) -> None:
         """All-hosts semantics: Running while any host runs; Succeeded only
         when every host completed; a non-retryable failure (engine didn't
         convert it to Restarting) fails the job."""

@@ -83,6 +83,7 @@ class XGBoostAdapter(FrameworkAdapter):
         return rtype == xgbapi.REPLICA_MASTER
 
     def update_job_status(self, engine, job, ctx: StatusContext) -> None:
-        master_based_update_job_status(
-            self.KIND, job, ctx, master_type=xgbapi.REPLICA_MASTER
-        )
+        with engine.tracer.span("XGBoostJob.status_rules"):
+            master_based_update_job_status(
+                self.KIND, job, ctx, master_type=xgbapi.REPLICA_MASTER
+            )

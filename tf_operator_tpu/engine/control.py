@@ -10,6 +10,7 @@ from __future__ import annotations
 import copy
 from typing import Any, Dict, List, Optional
 
+from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.k8s import objects
 
 
@@ -41,10 +42,13 @@ class PodControl:
             "spec": copy.deepcopy(pod_template.get("spec", {})),
             "status": {"phase": objects.POD_PENDING},
         }
-        return self.cluster.create_pod(pod)
+        created = self.cluster.create_pod(pod)
+        metrics.CONTROL_OPS.inc({"kind": "Pod", "verb": "create"})
+        return created
 
     def delete_pod(self, namespace: str, name: str, owner: Dict[str, Any]) -> None:
         self.cluster.delete_pod(namespace, name)
+        metrics.CONTROL_OPS.inc({"kind": "Pod", "verb": "delete"})
 
 
 class ServiceControl:
@@ -63,10 +67,13 @@ class ServiceControl:
             copy.deepcopy(controller_ref)
         ]
         service["metadata"].setdefault("namespace", namespace)
-        return self.cluster.create_service(service)
+        created = self.cluster.create_service(service)
+        metrics.CONTROL_OPS.inc({"kind": "Service", "verb": "create"})
+        return created
 
     def delete_service(self, namespace: str, name: str, owner: Dict[str, Any]) -> None:
         self.cluster.delete_service(namespace, name)
+        metrics.CONTROL_OPS.inc({"kind": "Service", "verb": "delete"})
 
 
 class FakePodControl(PodControl):

@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional
 
 from tf_operator_tpu.api import common
 from tf_operator_tpu.api.job import Job, ValidationError
-from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine import metrics, tracing
 from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
 from tf_operator_tpu.engine.control import PodControl, ServiceControl
 from tf_operator_tpu.engine.expectations import (
@@ -109,11 +109,13 @@ class JobEngine:
         clock=time.time,
         pod_control: Optional[PodControl] = None,
         service_control: Optional[ServiceControl] = None,
+        tracer: Optional[tracing.Tracer] = None,
     ) -> None:
         self.cluster = cluster
         self.adapter = adapter
         self.config = config or EngineConfig()
         self.clock = clock
+        self.tracer = tracer or tracing.get_tracer()
         if clock is time.time:
             # hot path: C++ expectations (native/expectations.cc) when built;
             # a test-injected clock forces the Python implementation since the
@@ -300,7 +302,26 @@ class JobEngine:
     # ------------------------------------------------------------ reconcile
     def reconcile(self, job: Job) -> ReconcileResult:
         """Full ReconcileJobs state machine. Mutates job.status and writes it
-        back to the cluster if changed."""
+        back to the cluster if changed. The whole sync runs under a root
+        span; each phase below opens a child span that also feeds the
+        per-phase histogram, so one instrumentation point serves both the
+        trace timeline and Prometheus."""
+        with self.tracer.span(
+            "reconcile", attrs={"kind": self.adapter.KIND, "job": job.key}
+        ):
+            return self._reconcile(job)
+
+    def _phase(self, name: str, **attrs):
+        """Child span for one sync phase, feeding
+        tpu_operator_sync_phase_duration_seconds{kind,phase}."""
+        return self.tracer.span(
+            name,
+            attrs={"kind": self.adapter.KIND, **attrs},
+            histogram=metrics.SYNC_PHASE_DURATION,
+            labels={"kind": self.adapter.KIND, "phase": name},
+        )
+
+    def _reconcile(self, job: Job) -> ReconcileResult:
         now_iso = iso_from_epoch(self.clock())
         status = job.status
         old_status = copy.deepcopy(status)
@@ -334,7 +355,9 @@ class JobEngine:
             return ReconcileResult(error=str(e))
 
         # expectation gate (reference tfjob_controller.go:139-146)
-        if not self.satisfied_expectations(job):
+        with self._phase("expectation_check"):
+            satisfied = self.satisfied_expectations(job)
+        if not satisfied:
             return ReconcileResult()
 
         pods = self.get_pods_for_job(job)
@@ -343,6 +366,7 @@ class JobEngine:
 
         # ----- terminal state: clean pods, TTL (reference ReconcileJobs head)
         if common.is_finished(status):
+            metrics.RUNNING_REPLICAS_TRACKER.forget(self.adapter.KIND, job.key)
             self._delete_pods_and_services(job, pods, services)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
@@ -357,6 +381,7 @@ class JobEngine:
         # ActiveDeadlineSeconds clock restarts on resume (batch/v1 Job
         # suspend behavior).
         if job.run_policy.suspend:
+            metrics.RUNNING_REPLICAS_TRACKER.forget(self.adapter.KIND, job.key)
             self._delete_pods_and_services(job, pods, services, force_all=True)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
@@ -401,6 +426,7 @@ class JobEngine:
                 f"active longer than specified deadline"
             )
         if failure_message is not None:
+            metrics.RUNNING_REPLICAS_TRACKER.forget(self.adapter.KIND, job.key)
             if status.completion_time is None:
                 status.completion_time = now_iso
             self._delete_pods_and_services(job, pods, services, force_all=True)
@@ -418,7 +444,8 @@ class JobEngine:
 
         # ----- gang PodGroup sync
         if self.config.enable_gang_scheduling:
-            self._sync_pod_group(job)
+            with self._phase("gang_sync"):
+                self._sync_pod_group(job)
 
         # ----- per replica type: pods + services. API errors (e.g. 409 on a
         # name held by a dying pod of an older incarnation) abort this sync
@@ -427,11 +454,13 @@ class JobEngine:
         restarted_types: set = set()
         try:
             for rtype, spec in replicas.items():
-                self.reconcile_pods(
-                    job, status, pods, rtype, spec, replicas, now_iso,
-                    restarted_types,
-                )
-                self.reconcile_services(job, services, rtype, spec)
+                with self._phase("pod_reconcile", replica_type=rtype):
+                    self.reconcile_pods(
+                        job, status, pods, rtype, spec, replicas, now_iso,
+                        restarted_types,
+                    )
+                with self._phase("service_reconcile", replica_type=rtype):
+                    self.reconcile_services(job, services, rtype, spec)
         except Exception as e:  # noqa: BLE001 — any API failure requeues
             self._write_status(job, old_status)
             return ReconcileResult(error=str(e), requeue_after=1.0)
@@ -439,18 +468,25 @@ class JobEngine:
         # ----- framework status rules
         if status.start_time is None:
             status.start_time = now_iso
-        ctx = StatusContext(
-            replicas, status,
-            self.get_pods_for_job(job), now_iso,
-            lambda etype, reason, msg: self.cluster.record_event(
-                job.to_dict(), etype, reason, msg
-            ),
-            restarted_types=restarted_types,
-        )
-        self.adapter.update_job_status(self, job, ctx)
+        with self._phase("status_update"):
+            ctx = StatusContext(
+                replicas, status,
+                self.get_pods_for_job(job), now_iso,
+                lambda etype, reason, msg: self.cluster.record_event(
+                    job.to_dict(), etype, reason, msg
+                ),
+                restarted_types=restarted_types,
+            )
+            self.adapter.update_job_status(self, job, ctx)
         status.last_reconcile_time = now_iso
+        metrics.RUNNING_REPLICAS_TRACKER.update(
+            self.adapter.KIND, job.key,
+            {rt: status.replica_statuses[rt].active
+             for rt in replicas if rt in status.replica_statuses},
+        )
 
-        self._write_status(job, old_status)
+        with self._phase("status_write"):
+            self._write_status(job, old_status)
 
         # requeue for ActiveDeadlineSeconds (RequeueAfter fix, SURVEY §7.4.6)
         requeue = None

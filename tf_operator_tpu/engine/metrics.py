@@ -32,10 +32,25 @@ class Metric:
     def get(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    @staticmethod
+    def _escape_label_value(v: str) -> str:
+        """Prometheus text-format label escaping: backslash, double quote,
+        and line feed must be escaped or one bad value (e.g. a job name
+        quoted inside an error-message label) corrupts the whole
+        exposition."""
+        return (
+            str(v)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
     def _render_labels(self, key) -> str:
         if not key:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in key)
+        inner = ",".join(
+            f'{k}="{self._escape_label_value(v)}"' for k, v in key
+        )
         return "{" + inner + "}"
 
     def expose(self) -> str:
@@ -200,3 +215,82 @@ RECONCILE_DURATION = Histogram(
     "Per-sync reconcile latency distribution "
     "(the reference only logs these durations — controller.go:303-307)",
 )
+SYNC_PHASE_DURATION = Histogram(
+    f"{PREFIX}_sync_phase_duration_seconds",
+    "Per-phase reconcile latency, fed by the span tracer "
+    "(engine/tracing.py): where inside a sync the time went",
+)
+WORKQUEUE_DEPTH = Gauge(
+    f"{PREFIX}_workqueue_depth",
+    "Keys currently waiting in the per-kind reconcile work queue",
+)
+WORKQUEUE_LATENCY = Histogram(
+    f"{PREFIX}_workqueue_latency_seconds",
+    "Enqueue-to-sync latency: how long a key waited in the work queue "
+    "before a worker picked it up",
+)
+SYNC_ERRORS = Counter(
+    f"{PREFIX}_sync_errors_total",
+    "Reconcile syncs that returned an error (requeued with backoff)",
+)
+RUNNING_REPLICAS = Gauge(
+    f"{PREFIX}_running_replicas",
+    "Pods currently Running, aggregated across jobs by kind and "
+    "replica type",
+)
+CONTROL_OPS = Counter(
+    f"{PREFIX}_control_operations_total",
+    "Pod/Service create/delete operations issued by the control layer",
+)
+
+
+class ReplicaGaugeTracker:
+    """Aggregates per-job active-replica counts into a {kind,replica_type}
+    gauge. A single job's reconcile only knows its own counts, so the
+    tracker keeps the per-job breakdown and re-sums on every update;
+    `forget()` (job finished/deleted) removes the job's contribution."""
+
+    def __init__(self, gauge: Gauge) -> None:
+        self._gauge = gauge
+        # (kind, replica_type) -> {job_key: active_count}
+        self._counts: Dict[Tuple[str, str], Dict[str, int]] = {}
+        self._tracker_lock = threading.Lock()
+
+    # gauge.set runs INSIDE _tracker_lock: setting outside would let a
+    # concurrent forget()/update() pair publish sums out of order and
+    # leave a stale value until the next reconcile touches the type
+    # (safe: nothing acquires _tracker_lock while holding the metrics
+    # lock, so the ordering is acyclic)
+    def update(self, kind: str, job_key: str, active: Dict[str, int]) -> None:
+        with self._tracker_lock:
+            touched = set()
+            for rtype, count in active.items():
+                self._counts.setdefault((kind, rtype), {})[job_key] = count
+                touched.add((kind, rtype))
+            # replica types this job no longer declares drop to zero
+            for (k, rtype), per_job in self._counts.items():
+                if k == kind and rtype not in active and job_key in per_job:
+                    del per_job[job_key]
+                    touched.add((k, rtype))
+            for (k, rtype) in touched:
+                self._gauge.set(
+                    sum(self._counts[(k, rtype)].values()),
+                    {"kind": k, "replica_type": rtype},
+                )
+
+    def forget(self, kind: str, job_key: str) -> None:
+        with self._tracker_lock:
+            for (k, rtype), per_job in self._counts.items():
+                if k == kind and per_job.pop(job_key, None) is not None:
+                    self._gauge.set(
+                        sum(per_job.values()),
+                        {"kind": k, "replica_type": rtype},
+                    )
+
+    def reset(self) -> None:
+        with self._tracker_lock:
+            self._counts.clear()
+        self._gauge.reset()
+
+
+RUNNING_REPLICAS_TRACKER = ReplicaGaugeTracker(RUNNING_REPLICAS)

@@ -1,0 +1,173 @@
+"""Span tracing for the reconcile pipeline.
+
+The reference logs reconcile durations as one opaque number
+(controller.go:303-307); the port's reconcile-latency histogram says HOW
+SLOW a sync was but not WHERE the time went. This module adds the missing
+dimension: a thread-safe `Tracer` producing nested spans (name, attrs,
+start, duration, parent), so each reconcile yields a phase breakdown —
+expectation check vs pod reconcile vs service reconcile vs status rules.
+
+Three consumers share one instrumentation point:
+  - per-phase `Histogram`s (engine/metrics.py): `span(histogram=...)`
+    observes the span duration on exit, so Prometheus gets
+    `tpu_operator_sync_phase_duration_seconds{kind,phase}` for free;
+  - Chrome trace-event JSON (`to_chrome_trace()`): load a dump in
+    chrome://tracing / Perfetto to see syncs nested on a timeline;
+  - the `/debug/traces` endpoint (cmd/health.py) and `--trace-dump`
+    (cmd/main.py) serve/persist the same export.
+
+Spans nest via a thread-local stack (each worker thread traces its own
+sync); finished ROOT spans land in a bounded ring buffer, so a long-lived
+operator keeps the most recent traces without unbounded growth.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@dataclass
+class Span:
+    """One timed region. `duration` stays None until the span finishes."""
+
+    name: str
+    start: float  # perf_counter seconds (duration arithmetic)
+    wall_start: float  # epoch seconds (trace-viewer timestamps)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    duration: Optional[float] = None
+    parent: Optional["Span"] = None
+    children: List["Span"] = field(default_factory=list)
+    thread_id: int = 0
+
+    def walk(self) -> Iterator["Span"]:
+        """Depth-first iteration over this span and all descendants."""
+        yield self
+        for child in self.children:
+            yield from child.walk()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "start": self.wall_start,
+            "duration": self.duration,
+            "attrs": dict(self.attrs),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+class Tracer:
+    """Thread-safe nested-span tracer.
+
+    `span()` is the single entry point: it pushes onto the calling
+    thread's stack (so spans opened inside an open span become children),
+    and on exit either attaches to the parent or — for roots — lands in
+    the shared ring buffer of finished traces. Passing `histogram=` (an
+    engine.metrics.Histogram) observes the duration with `labels=` on
+    exit, which is how per-phase histograms stay in lock-step with the
+    trace without double instrumentation."""
+
+    def __init__(self, max_traces: int = 256) -> None:
+        self.max_traces = max_traces
+        self._finished: "deque[Span]" = deque(maxlen=max_traces)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- spans
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        attrs: Optional[Dict[str, Any]] = None,
+        histogram=None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> Iterator[Span]:
+        stack = self._stack()
+        sp = Span(
+            name=name,
+            start=time.perf_counter(),
+            wall_start=time.time(),
+            attrs=dict(attrs or {}),
+            parent=stack[-1] if stack else None,
+            thread_id=threading.get_native_id(),
+        )
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.duration = time.perf_counter() - sp.start
+            stack.pop()
+            if sp.parent is not None:
+                sp.parent.children.append(sp)
+            else:
+                with self._lock:
+                    self._finished.append(sp)
+            if histogram is not None:
+                histogram.observe(sp.duration, labels)
+
+    # ------------------------------------------------------------ queries
+    def traces(self) -> List[Span]:
+        """Snapshot of finished root spans, oldest first."""
+        with self._lock:
+            return list(self._finished)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+
+    # ------------------------------------------------------------- export
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event format (`ph:"X"` complete events, micros) —
+        loadable in chrome://tracing and Perfetto."""
+        events: List[Dict[str, Any]] = []
+        pid = os.getpid()
+        for root in self.traces():
+            for sp in root.walk():
+                if sp.duration is None:
+                    continue
+                events.append(
+                    {
+                        "name": sp.name,
+                        "cat": "reconcile",
+                        "ph": "X",
+                        "ts": sp.wall_start * 1e6,
+                        "dur": sp.duration * 1e6,
+                        "pid": pid,
+                        "tid": sp.thread_id,
+                        "args": dict(sp.attrs),
+                    }
+                )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_json(self) -> str:
+        return json.dumps(self.to_chrome_trace())
+
+    def dump(self, path: str) -> None:
+        """Write the Chrome trace-event JSON to `path` (--trace-dump)."""
+        with open(path, "w") as fh:
+            fh.write(self.export_chrome_json())
+
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (the analogue of the metrics registry):
+    engines default to it, the health server serves it, --trace-dump
+    persists it."""
+    return _GLOBAL

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import signal
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Optional
 
 from tf_operator_tpu.runtime.profiler import Profiler
@@ -69,6 +69,10 @@ class LoopResult:
     preempted: bool
     resumed_from: Optional[int]
     last_metrics: Dict[str, float]
+    # goodput/MFU split for the session (GoodputTracker.summary()):
+    # productive/checkpoint/replay/idle fractions + goodput, mfu when the
+    # profiler was given flops_per_step/peak_flops_per_sec
+    goodput: Dict[str, float] = field(default_factory=dict)
 
 
 def run_training(
@@ -90,15 +94,17 @@ def run_training(
     restore and continue from there — the recreated pod converges to the
     same loop position (reference semantics: identical pod name/DNS, state
     from the framework's own checkpoint)."""
+    profiler = profiler or Profiler()
+    profiler.goodput.start()  # wall clock runs from here; restore is replay
     resumed_from = None
     if checkpointer is not None:
         latest = checkpointer.latest_step()
         if latest is not None:
-            state = checkpointer.restore(state)
+            with profiler.goodput.resume_replay():
+                state = checkpointer.restore(state)
             resumed_from = latest
             log.info("resumed from checkpoint step %d", latest)
 
-    profiler = profiler or Profiler()
     guard = guard or PreemptionGuard(install=False)
     step = int(state.step)
     steps_run = 0
@@ -107,46 +113,56 @@ def run_training(
     it = iter(batches)
 
     try:
-        while step < num_steps:
-            if guard.preempted:
-                break
-            profiler.maybe_trace(step)
-            try:
-                batch = next(it)
-            except StopIteration:
-                break
-            with profiler.step(step):
-                state, metrics = train_step(state, *batch)
-            step += 1
-            steps_run += 1
-            last_metrics = {k: float(v) for k, v in metrics.items()}
+        try:
+            while step < num_steps:
+                if guard.preempted:
+                    break
+                profiler.maybe_trace(step)
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                with profiler.step(step):
+                    state, metrics = train_step(state, *batch)
+                step += 1
+                steps_run += 1
+                last_metrics = {k: float(v) for k, v in metrics.items()}
 
-            if checkpointer is not None and step % save_interval_steps == 0:
-                checkpointer.save(step, state)
-                last_saved_step = step
-            if step % log_interval_steps == 0:
-                line = profiler.metrics_line(step, extra=last_metrics)
-                (metrics_sink or (lambda s: log.info("%s", s)))(line)
+                if checkpointer is not None and step % save_interval_steps == 0:
+                    with profiler.goodput.checkpoint_save():
+                        checkpointer.save(step, state)
+                    last_saved_step = step
+                if step % log_interval_steps == 0:
+                    line = profiler.metrics_line(step, extra=last_metrics)
+                    (metrics_sink or (lambda s: log.info("%s", s)))(line)
+        finally:
+            # flush an unfinished trace window even when a step raises mid-
+            # window: the jax profiler is process-global, and leaving it
+            # started loses the capture AND breaks any later start_trace()
+            profiler.stop_trace()
+        preempted = guard.preempted
+        if checkpointer is not None and steps_run > 0 and step != last_saved_step:
+            # final save unless this exact step is already on disk (interval
+            # save this iteration, or a recreated pod that restored an
+            # already-complete run) — orbax raises on duplicate steps.
+            # wait=True: the exit/preemption save must be durable before the
+            # process dies, even in async mode
+            with profiler.goodput.checkpoint_save():
+                checkpointer.save(step, state, wait=True)
+        elif checkpointer is not None:
+            # async interval saves may still be in flight; drain before return
+            with profiler.goodput.checkpoint_save():
+                checkpointer.wait_until_finished()
     finally:
-        # flush an unfinished trace window even when a step raises mid-
-        # window: the jax profiler is process-global, and leaving it
-        # started loses the capture AND breaks any later start_trace()
-        profiler.stop_trace()
-    preempted = guard.preempted
-    if checkpointer is not None and steps_run > 0 and step != last_saved_step:
-        # final save unless this exact step is already on disk (interval
-        # save this iteration, or a recreated pod that restored an
-        # already-complete run) — orbax raises on duplicate steps.
-        # wait=True: the exit/preemption save must be durable before the
-        # process dies, even in async mode
-        checkpointer.save(step, state, wait=True)
-    elif checkpointer is not None:
-        # async interval saves may still be in flight; drain before return
-        checkpointer.wait_until_finished()
+        # the goodput wall clock must freeze on every exit path — a caller
+        # reading summary() after a crashed step, or retrying with the same
+        # profiler, must not have the downtime charged as idle
+        profiler.goodput.stop()
     return LoopResult(
         state=state,
         steps_run=steps_run,
         preempted=preempted,
         resumed_from=resumed_from,
         last_metrics=last_metrics,
+        goodput=profiler.goodput.summary(),
     )

@@ -9,6 +9,11 @@ per-step wall-clock stream is the operator's throughput signal.
 Pieces:
   - `StepProfile`: ring-buffer of per-step wall times -> steps/sec, p50/p99.
   - `annotate_step(n)`: StepTraceAnnotation so device traces align to steps.
+  - `GoodputTracker`: splits wall-clock into productive step time vs
+    checkpoint-save, resume-replay, and idle time, plus an MFU estimate
+    from a caller-supplied FLOPs-per-step — the measured throughput signal
+    heterogeneity-aware schedulers assume the training system can report
+    (Gavel, arxiv 2008.09213; Tesserae, arxiv 2508.04953).
   - `Profiler`: programmatic trace capture (start/stop or N-step window),
     plus a metrics-line emitter the runner ships to stdout for scraping
     (the analogue of the reference's prometheus counters, SURVEY.md §5.5).
@@ -18,9 +23,10 @@ from __future__ import annotations
 import json
 import math
 import time
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Deque, Dict, Iterator, Optional
 
 import jax
 
@@ -33,18 +39,23 @@ def annotate_step(step: int):
 
 @dataclass
 class StepProfile:
-    """Per-step wall-time stats over a sliding window."""
+    """Per-step wall-time stats over a sliding window.
+
+    The window is a deque(maxlen=window): appending past capacity drops
+    the oldest in O(1), where a list + pop(0) shifted the whole window
+    every step in the hot loop."""
 
     window: int = 200
-    _times: List[float] = field(default_factory=list)
+    _times: Deque[float] = field(default_factory=deque)
     _last: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        self._times = deque(self._times, maxlen=self.window)
 
     def tick(self) -> None:
         now = time.perf_counter()
         if self._last is not None:
             self._times.append(now - self._last)
-            if len(self._times) > self.window:
-                self._times.pop(0)
         self._last = now
 
     def reset(self) -> None:
@@ -79,6 +90,132 @@ class StepProfile:
         return s
 
 
+class GoodputTracker:
+    """Wall-clock accounting: productive vs checkpoint vs replay vs idle.
+
+    "Goodput" is the fraction of elapsed wall-clock spent making forward
+    progress (running train steps). The rest is attributed to
+    checkpoint-save stalls, resume-replay (restoring state after a
+    recreation), or idle (input pipeline, host callbacks, anything
+    unaccounted). The training loop (runtime/loop.py) owns the exact
+    boundaries — it wraps restore and save calls in the context managers
+    below — so the split is measured, not inferred.
+
+    MFU: with a caller-supplied `flops_per_step` (model FLOPs, not
+    hardware FLOPs) and the accelerator's `peak_flops_per_sec`, `mfu()`
+    reports achieved-model-FLOPs / peak over total wall-clock — the
+    standard Model FLOPs Utilization definition, which charges every
+    non-step second against utilization."""
+
+    def __init__(
+        self,
+        flops_per_step: Optional[float] = None,
+        peak_flops_per_sec: Optional[float] = None,
+    ) -> None:
+        self.flops_per_step = flops_per_step
+        self.peak_flops_per_sec = peak_flops_per_sec
+        self.productive_time = 0.0
+        self.checkpoint_time = 0.0
+        self.replay_time = 0.0
+        self.steps = 0
+        self._start: Optional[float] = None
+        self._end: Optional[float] = None
+
+    # ------------------------------------------------------------ recording
+    def start(self) -> None:
+        """Start the wall clock (idempotent; note_* auto-start). Starting
+        again after stop() resumes the clock, excluding the paused gap —
+        a profiler reused across run_training sessions must not charge
+        the time between sessions as idle."""
+        now = time.perf_counter()
+        if self._start is None:
+            self._start = now
+        elif self._end is not None:
+            self._start += now - self._end
+        self._end = None
+
+    def stop(self) -> None:
+        """Freeze the wall clock (end of the training session)."""
+        if self._start is not None and self._end is None:
+            self._end = time.perf_counter()
+
+    def note_productive(self, duration: float, steps: int = 1) -> None:
+        self.start()
+        self.productive_time += duration
+        self.steps += steps
+
+    @contextmanager
+    def checkpoint_save(self) -> Iterator[None]:
+        """Wrap a (blocking portion of a) checkpoint save."""
+        self.start()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.checkpoint_time += time.perf_counter() - t0
+
+    @contextmanager
+    def resume_replay(self) -> Iterator[None]:
+        """Wrap checkpoint-restore / replay work done to resume a run."""
+        self.start()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.replay_time += time.perf_counter() - t0
+
+    # ------------------------------------------------------------- derived
+    def wall_time(self) -> float:
+        if self._start is None:
+            return 0.0
+        return (self._end or time.perf_counter()) - self._start
+
+    def goodput(self) -> float:
+        wall = self.wall_time()
+        return self.productive_time / wall if wall > 0 else 0.0
+
+    def mfu(self) -> Optional[float]:
+        """Model FLOPs Utilization over total wall-clock; None until both
+        flops_per_step and peak_flops_per_sec are known and a step ran."""
+        wall = self.wall_time()
+        if (
+            self.flops_per_step is None
+            or not self.peak_flops_per_sec
+            or self.steps == 0
+            or wall <= 0
+        ):
+            return None
+        return (self.flops_per_step * self.steps / wall) / self.peak_flops_per_sec
+
+    def summary(self) -> Dict[str, float]:
+        wall = self.wall_time()
+        if wall <= 0:
+            return {}
+        accounted = self.productive_time + self.checkpoint_time + self.replay_time
+        s = {
+            "wall_time_s": wall,
+            "goodput": self.productive_time / wall,
+            "productive_fraction": self.productive_time / wall,
+            "checkpoint_fraction": self.checkpoint_time / wall,
+            "replay_fraction": self.replay_time / wall,
+            "idle_fraction": max(0.0, (wall - accounted) / wall),
+        }
+        mfu = self.mfu()
+        if mfu is not None:
+            s["mfu"] = mfu
+        return s
+
+
+def _json_safe(v):
+    """JSON scalars only: device arrays -> float, non-finite floats -> None
+    (bare NaN/Inf is invalid JSON and breaks scrapers)."""
+    if hasattr(v, "item"):
+        v = float(v)
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
 class Profiler:
     """Programmatic jax.profiler capture + metrics emission.
 
@@ -93,10 +230,16 @@ class Profiler:
         window: int = 200,
         trace_start_step: int = 10,
         trace_num_steps: int = 20,
+        flops_per_step: Optional[float] = None,
+        peak_flops_per_sec: Optional[float] = None,
     ) -> None:
         self.trace_dir = trace_dir
         self.batch_size = batch_size
         self.steps = StepProfile(window=window)
+        self.goodput = GoodputTracker(
+            flops_per_step=flops_per_step,
+            peak_flops_per_sec=peak_flops_per_sec,
+        )
         self.trace_start_step = trace_start_step
         self.trace_num_steps = trace_num_steps
         self._tracing = False
@@ -149,24 +292,28 @@ class Profiler:
 
     @contextmanager
     def step(self, n: int) -> Iterator[None]:
-        """Wrap one train step: trace annotation + wall-time tick."""
+        """Wrap one train step: trace annotation + wall-time tick +
+        productive-time attribution for the goodput split."""
+        t0 = time.perf_counter()
         with annotate_step(n):
             yield
         self.steps.tick()
+        self.goodput.note_productive(time.perf_counter() - t0)
 
     # ------------------------------------------------------------- metrics
+    def summary(self) -> Dict[str, float]:
+        """Step-time stats + the goodput/MFU split, one flat dict."""
+        return {**self.steps.summary(self.batch_size), **self.goodput.summary()}
+
     def metrics_line(self, step: int, extra: Optional[Dict] = None) -> str:
         """One JSON line of progress metrics (shipped to stdout; the
-        in-container analogue of the operator's prometheus counters)."""
-        payload = {"step": step, **self.steps.summary(self.batch_size)}
+        in-container analogue of the operator's prometheus counters).
+        Non-finite floats (a NaN loss) serialize as null — bare NaN is
+        invalid JSON and breaks scrapers."""
+        payload = {"step": step, **self.summary()}
         if extra:
-            payload.update(
-                {
-                    k: (float(v) if hasattr(v, "item") else v)
-                    for k, v in extra.items()
-                }
-            )
-        return json.dumps(payload)
+            payload.update(extra)
+        return json.dumps({k: _json_safe(v) for k, v in payload.items()})
 
 
 def device_memory_stats() -> Dict[str, int]:
