@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test bench native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint bench native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -25,7 +25,12 @@ $(NATIVE_LIB): $(NATIVE_SRCS) $(wildcard $(NATIVE_DIR)/*.h)
 	mkdir -p $(dir $@)
 	$(CXX) $(CXXFLAGS) -shared -o $@ $(NATIVE_SRCS)
 
-test: native
+# Static metric-naming conventions (shared prefix, unit suffixes, no
+# duplicate family registration) over the whole registry.
+metrics-lint:
+	python hack/check_metric_names.py
+
+test: native metrics-lint
 	python -m pytest tests/ -x -q
 
 bench:

@@ -909,8 +909,9 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 16,
     serve_loop(model, params, prompts, slots=slots,
                max_new_tokens=max_new, steps_per_sync=steps_per_sync)
     t0 = time.perf_counter()
-    res = serve_loop(model, params, prompts, slots=slots,
-                     max_new_tokens=max_new, steps_per_sync=steps_per_sync)
+    res, serve_stats = serve_loop(
+        model, params, prompts, slots=slots, max_new_tokens=max_new,
+        steps_per_sync=steps_per_sync, return_stats=True)
     t_serve = time.perf_counter() - t0
     n_tokens = sum(len(r.tokens) for r in res)
     # sequential baseline: one request at a time, batch 1 (compiles per
@@ -934,6 +935,10 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 16,
         "sequential_tokens_per_sec": round(
             n_requests * max_new / t_seq, 1),
         "speedup_vs_sequential": round(t_seq / t_serve, 2),
+        # serving telemetry aggregate (models/telemetry.py): TTFT/TPOT/
+        # queue-wait/latency, occupancy, prefill-vs-decode split, HBM
+        # high watermark — the ServeStats the loop measured about itself
+        "serve_stats": serve_stats.summary(),
     }
     # prefix caching: the same requests behind a shared system prompt,
     # prefilled once vs once per admission — the saved work is
@@ -983,7 +988,8 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 16,
                     steps_per_sync=max(1, steps_per_sync // 4))
         serve_loop(model, params, prompts, **d_kw)  # warm compiles
         t0 = time.perf_counter()
-        res = serve_loop(model, params, prompts, **d_kw)
+        res, spec_stats = serve_loop(model, params, prompts,
+                                     return_stats=True, **d_kw)
         t_spec = time.perf_counter() - t0
         n_spec = sum(len(r.tokens) for r in res)
         out["speculative"] = {
@@ -996,6 +1002,7 @@ def bench_serve_loop(gen: str, cfg=None, n_requests: int = 16,
             "spec_k": 3,
             "tokens_per_sec": round(n_spec / t_spec, 1),
             "speedup_vs_plain_serve": round(t_serve / t_spec, 2),
+            "serve_stats": spec_stats.summary(),
         }
     except Exception as e:  # noqa: BLE001 — surfaced, not fatal
         out["speculative"] = {"error": f"{type(e).__name__}: {e}"[:200]}

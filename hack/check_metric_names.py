@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Metric naming lint — `make metrics-lint` (run inside `make test`).
+
+Imports the metric registry (engine/metrics.py — every family in the
+codebase registers itself there at import) and enforces the Prometheus
+naming conventions the docs and dashboards rely on:
+
+  - every family carries the shared `tpu_operator_` prefix, so one
+    scrape-config relabel and one Grafana variable cover the operator;
+  - unit suffixes: Counters end in `_total` (the value is a running
+    count); Histograms end in `_seconds` or `_bytes` (the only units we
+    record — a unitless histogram is a smell); Gauges never end in
+    `_total` (a gauge that counts should be a Counter) and, when they
+    measure a unit, name it (`_bytes`, `_seconds`);
+  - non-empty HELP text (an undocumented family is unusable at 3am);
+  - no duplicate family registration — two objects exposing the same
+    name produce a duplicate `# TYPE` block, which strict parsers
+    (promtool, OpenMetrics) reject for the whole target.
+
+Exit 0 clean, 1 with one line per violation.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check_registry() -> list:
+    from tf_operator_tpu.engine import metrics as em
+
+    with em._LOCK:
+        registry = list(em._REGISTRY)
+    errors = []
+    seen = {}
+    for m in registry:
+        where = f"{m.name} ({type(m).__name__})"
+        if not m.name.startswith(em.PREFIX + "_"):
+            errors.append(
+                f"{where}: missing shared prefix {em.PREFIX!r}_")
+        if not m.help.strip():
+            errors.append(f"{where}: empty HELP text")
+        if m.TYPE == "counter" and not m.name.endswith("_total"):
+            errors.append(f"{where}: counters must end in _total")
+        if m.TYPE == "histogram" and not m.name.endswith(
+                ("_seconds", "_bytes")):
+            errors.append(
+                f"{where}: histograms must end in _seconds or _bytes "
+                f"(the units this codebase records)")
+        if m.TYPE == "gauge":
+            if m.name.endswith("_total"):
+                errors.append(
+                    f"{where}: a gauge must not end in _total — a "
+                    f"monotonic count should be a Counter")
+            # gauges may be unitless (occupancy, leader flag) but a
+            # trailing pseudo-unit that is not a real unit is a typo
+            for bad in ("_second", "_byte", "_secs", "_ms"):
+                if m.name.endswith(bad):
+                    errors.append(
+                        f"{where}: suffix {bad!r} is not a canonical "
+                        f"unit (use _seconds / _bytes)")
+        if m.name in seen:
+            errors.append(
+                f"{where}: duplicate family registration (first "
+                f"registered as {seen[m.name]})")
+        else:
+            seen[m.name] = type(m).__name__
+    return errors
+
+
+def main() -> int:
+    errors = check_registry()
+    if errors:
+        for e in errors:
+            print(f"metrics-lint: {e}", file=sys.stderr)
+        print(f"metrics-lint: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    from tf_operator_tpu.engine import metrics as em
+
+    with em._LOCK:
+        n = len(em._REGISTRY)
+    print(f"metrics-lint: {n} families clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

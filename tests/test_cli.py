@@ -192,6 +192,65 @@ def test_describe_shows_conditions_replicas_events(tmp_path, capsys):
     assert "JobCreated" in out  # event vocabulary
 
 
+def test_describe_events_include_age(tmp_path, capsys):
+    """The Events section is a table with an AGE column computed from
+    each event's timestamp — not just type/reason/message."""
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    _invoke(cli, ["submit", str(path)])
+    engine = make_engine("TFJob", cli.cluster)
+    from tf_operator_tpu.api import tensorflow as tfapi
+
+    engine.reconcile(tfapi.TFJob.from_dict(
+        cli.cluster.get("TFJob", "default", "mnist")))
+    capsys.readouterr()
+    assert _invoke(cli, ["describe", "tfjob", "mnist"]) == 0
+    out = capsys.readouterr().out
+    assert "AGE" in out and "JobCreated" in out
+    # a just-recorded event is seconds old ("JobCreated" also names a
+    # condition reason — scope to the Events section)
+    lines = out.splitlines()
+    events_at = lines.index("Events:")
+    event_line = next(l for l in lines[events_at:] if "JobCreated" in l)
+    assert "<unknown>" not in event_line
+    import re
+
+    assert re.search(r"\b\d+s\b", event_line), event_line
+
+
+def test_events_verb_lists_job_events(tmp_path, capsys):
+    """`tpu-jobs events` — the kubectl-get-events analog over
+    cluster.events_for: header + one aged row per recorded event."""
+    cli = _cli_and_cluster()
+    path = tmp_path / "job.yaml"
+    path.write_text(yaml.safe_dump(TFJOB))
+    _invoke(cli, ["submit", str(path)])
+    engine = make_engine("TFJob", cli.cluster)
+    from tf_operator_tpu.api import tensorflow as tfapi
+
+    engine.reconcile(tfapi.TFJob.from_dict(
+        cli.cluster.get("TFJob", "default", "mnist")))
+    capsys.readouterr()
+    assert _invoke(cli, ["events", "tfjob", "mnist"]) == 0
+    out = capsys.readouterr().out
+    assert "LAST SEEN" in out and "TYPE" in out and "REASON" in out
+    assert "JobCreated" in out
+    import re
+
+    assert re.search(r"^\d+s\s+Normal", out.splitlines()[1]), out
+    # no events yet for a fresh job -> friendly empty message, exit 0
+    fresh = dict(TFJOB, metadata={"name": "quiet", "namespace": "default"})
+    path.write_text(yaml.safe_dump(fresh))
+    _invoke(cli, ["submit", str(path)])
+    capsys.readouterr()
+    assert _invoke(cli, ["events", "tfjob", "quiet"]) == 0
+    assert "No events found." in capsys.readouterr().out
+    # unknown job -> NotFound propagates (main() renders it cleanly)
+    with pytest.raises(NotFoundError):
+        cli.events("TFJob", "missing", "default")
+
+
 def test_scale_verb_drives_replica_count(tmp_path, capsys):
     cli = _cli_and_cluster()
     path = tmp_path / "job.yaml"

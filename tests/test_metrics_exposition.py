@@ -125,6 +125,35 @@ def test_histogram_exposition_invariants():
     assert math.isclose(total, 0.05 + 0.5 + 0.5 + 3.0 + 30.0)
 
 
+def test_percentiles_ceil_rank_edges():
+    """Quantile rank edge cases: q=0 must return the bucket holding the
+    SMALLEST observation (rank 1), not the first bucket whether or not
+    anything landed there; ranks are ceil(q*total) so a q that lands
+    exactly on a whole observation selects that observation."""
+    h = Histogram("test_pct_rank_seconds", "t", buckets=(0.1, 1.0, 5.0))
+    # all observations in the SECOND bucket: q=0 used to report 0.1
+    for _ in range(4):
+        h.observe(0.5, {"k": "a"})
+    ps = h.percentiles([0.0, 0.5, 1.0], {"k": "a"})
+    assert ps[0.0] == 1.0
+    assert ps[0.5] == 1.0
+    assert ps[1.0] == 1.0
+    # spread: 1 obs <=0.1, 2 more <=1.0, 1 more <=5.0
+    for v in (0.05, 0.5, 0.5, 3.0):
+        h.observe(v, {"k": "b"})
+    ps = h.percentiles([0.0, 0.25, 0.5, 0.75, 1.0], {"k": "b"})
+    assert ps[0.0] == 0.1   # rank 1: the smallest observation's bucket
+    assert ps[0.25] == 0.1  # ceil(0.25*4)=1 — exactly the 1st obs
+    assert ps[0.5] == 1.0   # ceil(2.0)=2 -> second obs lives in bucket 2
+    assert ps[0.75] == 1.0
+    assert ps[1.0] == 5.0
+    # beyond the last finite bucket stays None (prometheus semantics)
+    h.observe(100.0, {"k": "c"})
+    assert h.percentiles([0.0, 1.0], {"k": "c"}) == {0.0: None, 1.0: None}
+    # no observations at all: every quantile is None
+    assert h.percentiles([0.0, 0.5], {"k": "zzz"}) == {0.0: None, 0.5: None}
+
+
 def test_concurrent_inc_observe_vs_expose():
     """Writers hammer a counter + histogram while readers run expose_all();
     every intermediate exposition must parse, and the final counts must be

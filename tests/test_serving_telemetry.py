@@ -1,0 +1,238 @@
+"""Serving telemetry (models/telemetry.py + serve_loop wiring): the
+ServeStats aggregate must be internally consistent with the per-request
+ServeResults, the new metric families must round-trip the Prometheus
+text format, and the request lifecycle spans must export as valid,
+well-nested Chrome trace JSON."""
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.engine import metrics as em
+from tf_operator_tpu.engine.tracing import Tracer
+from tf_operator_tpu.models import llama
+from tf_operator_tpu.models.serving import serve_loop
+from tf_operator_tpu.models.telemetry import ServeStats, ServeTelemetry
+
+from tests.test_metrics_exposition import parse_exposition
+
+
+def _setup(seed=0, **cfg_kw):
+    cfg_kw.setdefault("dtype", jnp.float32)
+    cfg = llama.tiny(**cfg_kw)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    return cfg, model, params
+
+
+def _prompts(cfg, lengths, seed=1):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for n in lengths:
+        key, k = jax.random.split(key)
+        out.append(jax.random.randint(k, (n,), 0, cfg.vocab_size))
+    return out
+
+
+def _draft(cfg, seed=9):
+    d_cfg = dataclasses.replace(cfg, n_layers=1)
+    d_model = llama.Llama(d_cfg)
+    d_params = d_model.init(jax.random.PRNGKey(seed),
+                            jnp.zeros((1, 8), jnp.int32),
+                            train=False)["params"]
+    return d_model, d_params
+
+
+# ------------------------------------------------------------ ServeStats
+def test_serve_stats_plain_internally_consistent():
+    cfg, model, params = _setup(max_len=128)
+    prompts = _prompts(cfg, [6, 11, 3, 9, 7])
+    res, stats = serve_loop(model, params, prompts, slots=2,
+                            max_new_tokens=10, return_stats=True)
+    assert isinstance(stats, ServeStats)
+    assert stats.requests == len(prompts)
+    assert stats.slots == 2 and not stats.speculative
+    assert stats.total_tokens == sum(len(r.tokens) for r in res)
+    assert stats.wall_time_s > 0
+    assert stats.tokens_per_sec > 0
+    # per-request physics: queued <= admitted <= first token <= finished
+    assert len(stats.per_request) == len(prompts)
+    for pr, r in zip(stats.per_request, res):
+        assert pr["tokens"] == len(r.tokens)
+        assert pr["slot"] == r.slot
+        assert pr["queue_wait_s"] >= 0
+        assert 0 <= pr["ttft_s"] <= pr["e2e_latency_s"]
+        assert pr["queue_wait_s"] + pr["ttft_s"] <= pr["e2e_latency_s"]
+        assert pr["e2e_latency_s"] <= stats.wall_time_s
+        assert pr["accepted_drafts"] == 0 and pr["proposed_drafts"] == 0
+    # aggregates match the per-request rows
+    e2es = [pr["e2e_latency_s"] for pr in stats.per_request]
+    assert abs(stats.e2e_latency_mean_s - sum(e2es) / len(e2es)) < 1e-9
+    assert stats.e2e_latency_max_s == max(e2es)
+    assert stats.ttft_max_s == max(pr["ttft_s"] for pr in stats.per_request)
+    # occupancy bounded by the lane count and strictly positive (five
+    # 10-token requests through 2 lanes certainly decoded)
+    assert 0 < stats.occupancy_mean <= 2
+    assert 1 <= stats.occupancy_max <= 2
+    assert stats.decode_time_s > 0 and stats.prefill_time_s > 0
+    # plain serving never speculates
+    assert stats.accepted_drafts == 0 and stats.proposed_drafts == 0
+    assert stats.acceptance_rate is None
+    # CPU backend exposes no memory_stats — the profiler contract
+    assert stats.hbm_peak_bytes == {}
+
+
+def test_serve_stats_speculative_acceptance_matches_results():
+    cfg, model, params = _setup(max_len=256)
+    d_model, d_params = _draft(cfg)
+    prompts = _prompts(cfg, [6, 9, 4])
+    res, stats = serve_loop(model, params, prompts, slots=2,
+                            max_new_tokens=10, draft=d_model,
+                            draft_params=d_params, spec_k=3,
+                            steps_per_sync=2, return_stats=True)
+    assert stats.speculative
+    assert stats.accepted_drafts == sum(r.accepted_drafts for r in res)
+    assert stats.proposed_drafts == sum(r.proposed_drafts for r in res)
+    assert stats.proposed_drafts > 0
+    assert stats.acceptance_rate == (
+        stats.accepted_drafts / stats.proposed_drafts)
+    for pr, r in zip(stats.per_request, res):
+        assert pr["accepted_drafts"] == r.accepted_drafts
+        assert pr["proposed_drafts"] == r.proposed_drafts
+
+
+def test_stats_collection_does_not_change_tokens():
+    """Telemetry is measurement, not scheduling: tokens with and without
+    return_stats (and with a private telemetry object) are identical."""
+    cfg, model, params = _setup(max_len=128)
+    prompts = _prompts(cfg, [6, 8, 5], seed=3)
+    plain = serve_loop(model, params, prompts, slots=2, max_new_tokens=8)
+    with_stats, _ = serve_loop(model, params, prompts, slots=2,
+                               max_new_tokens=8, return_stats=True)
+    private = serve_loop(model, params, prompts, slots=2,
+                         max_new_tokens=8,
+                         telemetry=ServeTelemetry(tracer=Tracer()))
+    assert [r.tokens for r in plain] == [r.tokens for r in with_stats]
+    assert [r.tokens for r in plain] == [r.tokens for r in private]
+
+
+def test_empty_request_list_returns_empty_stats():
+    cfg, model, params = _setup(max_len=64)
+    res, stats = serve_loop(model, params, [], slots=3,
+                            return_stats=True)
+    assert res == []
+    assert stats.requests == 0 and stats.total_tokens == 0
+    # the CONFIGURED lane count is reported, not a phantom 0 — callers
+    # normalize occupancy by stats.slots
+    assert stats.slots == 3 and not stats.speculative
+    assert serve_loop(model, params, []) == []
+
+
+def test_summary_is_json_safe_and_drops_per_request():
+    cfg, model, params = _setup(max_len=128)
+    prompts = _prompts(cfg, [5, 7], seed=5)
+    _, stats = serve_loop(model, params, prompts, slots=2,
+                          max_new_tokens=6, return_stats=True)
+    s = stats.summary()
+    assert "per_request" not in s
+    json.dumps(s)  # round floats, ints, None, dicts only
+    assert s["requests"] == 2
+
+
+# ----------------------------------------------------------- exposition
+def test_new_families_round_trip_exposition():
+    cfg, model, params = _setup(max_len=128)
+    prompts = _prompts(cfg, [6, 9], seed=7)
+    before = em.SERVING_REQUESTS.get()
+    tokens_before = em.SERVING_TOKENS.get()
+    res = serve_loop(model, params, prompts, slots=2, max_new_tokens=8)
+    samples = parse_exposition(em.expose_all())
+    # counters advanced by exactly this run's contribution
+    (_, req_count), = samples["tpu_operator_serving_requests_total"]
+    assert req_count == before + len(prompts)
+    (_, tok_count), = samples["tpu_operator_serving_tokens_total"]
+    assert tok_count == tokens_before + sum(len(r.tokens) for r in res)
+    # histograms expose buckets/sum/count and saw >= one obs per request
+    for fam in ("tpu_operator_serving_ttft_seconds",
+                "tpu_operator_serving_queue_wait_seconds",
+                "tpu_operator_serving_request_latency_seconds"):
+        assert f"{fam}_bucket" in samples, fam
+        (_, count), = samples[f"{fam}_count"]
+        assert count >= len(prompts)
+    # the loop ended: the occupancy gauge idles at 0 (a scrape between
+    # runs must not read the final block's lane count)
+    (_, occ), = samples["tpu_operator_serving_batch_occupancy"]
+    assert occ == 0
+    assert em.SERVING_BATCH_OCCUPANCY.get() == 0
+
+
+def test_speculative_generate_feeds_acceptance_family():
+    from tf_operator_tpu.models.speculative import speculative_generate
+
+    cfg, model, params = _setup(max_len=128)
+    labels = {"path": "speculative_generate"}
+    before = em.SERVING_PROPOSED_DRAFTS.get(labels)
+    prompt = jnp.stack(_prompts(cfg, [8], seed=11))
+    _, stats = speculative_generate(model, params, model, params,
+                                    prompt, 12, k=3, return_stats=True)
+    assert em.SERVING_PROPOSED_DRAFTS.get(labels) == (
+        before + stats["proposed_drafts"])
+    assert em.SERVING_ACCEPTED_DRAFTS.get(
+        labels) >= stats["accepted_drafts"]
+
+
+# ----------------------------------------------------------- trace spans
+def test_chrome_trace_dump_valid_and_well_nested(tmp_path):
+    cfg, model, params = _setup(max_len=256)
+    tracer = Tracer()
+    prompts = _prompts(cfg, [40, 6, 9], seed=9)
+    res = serve_loop(model, params, prompts, slots=2, max_new_tokens=8,
+                     prefill_chunk=8, prefill_chunks_per_sync=1,
+                     telemetry=ServeTelemetry(tracer=tracer))
+    # the span TREE: one root per request with the lifecycle children
+    roots = tracer.traces()
+    assert len(roots) == len(prompts)
+    by_req = {sp.attrs["request"]: sp for sp in roots}
+    for i, r in enumerate(res):
+        root = by_req[i]
+        assert root.name == "serve_request"
+        assert root.category == "serving"
+        assert root.attrs["slot"] == r.slot
+        assert root.attrs["tokens"] == len(r.tokens)
+        names = [c.name for c in root.children]
+        assert names == ["queued", "prefill", "decode"]
+        prefill = root.children[1]
+        # the 40-token prompt streamed in 8-token segments
+        if i == 0:
+            assert len(prefill.children) == 5
+            seg = prefill.children[0]
+            assert seg.name == "prefill_segment"
+            assert seg.attrs["token_start"] == 0
+        # well-nested: every child interval inside its parent's
+        for parent in root.walk():
+            p_end = parent.wall_start + parent.duration
+            for c in parent.children:
+                assert c.wall_start >= parent.wall_start - 1e-6
+                assert c.wall_start + c.duration <= p_end + 1e-6
+    # the dump is valid trace-event JSON with the serving category
+    path = tmp_path / "serve_trace.json"
+    tracer.dump(str(path))
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    assert all(e["ph"] == "X" and e["cat"] == "serving" for e in events)
+    assert sum(1 for e in events if e["name"] == "serve_request") == 3
+    for e in events:
+        assert e["dur"] >= 0 and isinstance(e["ts"], float)
+
+
+def test_record_rejects_unfinished_root():
+    import pytest
+
+    from tf_operator_tpu.engine.tracing import Span
+
+    t = Tracer()
+    with pytest.raises(ValueError, match="unfinished"):
+        t.record(Span(name="x", start=0.0, wall_start=0.0))

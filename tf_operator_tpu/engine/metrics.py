@@ -8,6 +8,7 @@ text format, served by the CLI's metrics endpoint.
 """
 from __future__ import annotations
 
+import math
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -128,7 +129,11 @@ class Histogram(Metric):
         bound of the first bucket whose count reaches the target rank
         (None when the quantile falls beyond the last finite bucket —
         prometheus histogram_quantile semantics, conservative upper
-        bound)."""
+        bound).  The rank is ceil(q * total) clamped to >= 1 so it always
+        names a WHOLE observation: q=0 asks for the smallest observation
+        (rank 1), not "the first bucket whether or not anything landed in
+        it" — the raw-rank form returned buckets[0] for q=0 even when
+        that bucket was empty."""
         with _LOCK:
             obs = self._obs.get(_label_key(labels))
             counts = list(obs[0]) if obs else None
@@ -137,7 +142,7 @@ class Histogram(Metric):
         total = counts[-1]
         out: Dict[float, Optional[float]] = {}
         for q in qs:
-            rank = q * total
+            rank = max(1, math.ceil(q * total))
             out[q] = next(
                 (le for i, le in enumerate(self.buckets)
                  if counts[i] >= rank),
@@ -294,3 +299,84 @@ class ReplicaGaugeTracker:
 
 
 RUNNING_REPLICAS_TRACKER = ReplicaGaugeTracker(RUNNING_REPLICAS)
+
+
+# --------------------------------------------------------------- serving
+# Serving-path families (models/telemetry.py feeds them from serve_loop;
+# models/speculative.py feeds the draft counters from speculative_generate
+# with path="speculative_generate").  Same registry, same exposition
+# endpoint as the operator families — one scrape covers both halves.
+#
+# Sub-ms buckets: a CPU smoke lane emits tokens in tens of microseconds
+# and a TPU decode step lands around 5-20ms — the reconcile-tuned default
+# buckets would collapse TPOT into its first bucket.
+_SERVING_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+SERVING_TTFT = Histogram(
+    f"{PREFIX}_serving_ttft_seconds",
+    "Time to first token: lane admission to the request's first sampled "
+    "token (queue wait excluded — that is its own histogram)",
+    buckets=_SERVING_LATENCY_BUCKETS,
+)
+SERVING_TPOT = Histogram(
+    f"{PREFIX}_serving_tpot_seconds",
+    "Time per output token: a finished request's decode wall-clock over "
+    "its decoded tokens (first token excluded), one observation per "
+    "request with >= 2 tokens",
+    buckets=_SERVING_LATENCY_BUCKETS,
+)
+SERVING_QUEUE_WAIT = Histogram(
+    f"{PREFIX}_serving_queue_wait_seconds",
+    "How long a request sat queued before a decode lane was reserved "
+    "for it",
+    buckets=_SERVING_LATENCY_BUCKETS,
+)
+SERVING_REQUEST_LATENCY = Histogram(
+    f"{PREFIX}_serving_request_latency_seconds",
+    "End-to-end request latency: enqueue to final token (queue wait + "
+    "prefill + decode)",
+    buckets=_SERVING_LATENCY_BUCKETS,
+)
+SERVING_REQUESTS = Counter(
+    f"{PREFIX}_serving_requests_total",
+    "Requests finished by the serving loop",
+)
+SERVING_TOKENS = Counter(
+    f"{PREFIX}_serving_tokens_total",
+    "Tokens emitted to finished requests (EOS included when hit)",
+)
+SERVING_PREFILL_TIME = Counter(
+    f"{PREFIX}_serving_prefill_seconds_total",
+    "Wall-clock spent prefilling prompts into lane caches (the other "
+    "half of the prefill-vs-decode split)",
+)
+SERVING_DECODE_TIME = Counter(
+    f"{PREFIX}_serving_decode_seconds_total",
+    "Wall-clock spent in decode blocks (device step + token readback)",
+)
+SERVING_BATCH_OCCUPANCY = Gauge(
+    f"{PREFIX}_serving_batch_occupancy",
+    "Decode lanes occupied by live requests, sampled at each decode "
+    "block (bounded by the serve loop's slots)",
+)
+SERVING_ACCEPTED_DRAFTS = Counter(
+    f"{PREFIX}_serving_accepted_drafts_total",
+    "Speculative draft tokens accepted by target verification "
+    "(accepted/proposed is the acceptance rate); labeled by path: "
+    "serve_loop or speculative_generate",
+)
+SERVING_PROPOSED_DRAFTS = Counter(
+    f"{PREFIX}_serving_proposed_drafts_total",
+    "Speculative draft tokens proposed to target verification; labeled "
+    "by path: serve_loop or speculative_generate",
+)
+SERVING_HBM_PEAK = Gauge(
+    f"{PREFIX}_serving_hbm_peak_bytes",
+    "Per-device HBM high watermark sampled at the end of a serve_loop "
+    "run (runtime/profiler.device_memory_stats); on backends without "
+    "memory stats (CPU) no device-labeled sample is ever set and the "
+    "family exposes only the default unlabeled 0",
+)

@@ -34,7 +34,14 @@ from typing import Any, Dict, Iterator, List, Optional
 
 @dataclass
 class Span:
-    """One timed region. `duration` stays None until the span finishes."""
+    """One timed region. `duration` stays None until the span finishes.
+
+    `category` becomes the Chrome trace event's `cat` (the trace viewer's
+    filter axis): reconcile spans and serving-request spans share one
+    export but remain separable.  `thread_id` is the trace LANE, not
+    necessarily an OS thread — serving telemetry assigns one virtual lane
+    per request so overlapping in-flight requests render as parallel
+    tracks instead of a single overdrawn row."""
 
     name: str
     start: float  # perf_counter seconds (duration arithmetic)
@@ -44,6 +51,7 @@ class Span:
     parent: Optional["Span"] = None
     children: List["Span"] = field(default_factory=list)
     thread_id: int = 0
+    category: str = "reconcile"
 
     def walk(self) -> Iterator["Span"]:
         """Depth-first iteration over this span and all descendants."""
@@ -120,6 +128,20 @@ class Tracer:
             if histogram is not None:
                 histogram.observe(sp.duration, labels)
 
+    def record(self, span: Span) -> None:
+        """Land an externally assembled FINISHED root span in the ring
+        buffer.  `span()` is the right tool for code-shaped regions; this
+        is the seam for lifecycles that interleave — a serving request's
+        queued/prefill/decode phases overlap other requests' phases on
+        the same host thread, so a context-manager stack cannot express
+        them and the caller builds the span tree itself."""
+        if span.duration is None:
+            raise ValueError(
+                f"span {span.name!r} is unfinished (duration=None) — "
+                f"record() takes completed root spans only")
+        with self._lock:
+            self._finished.append(span)
+
     # ------------------------------------------------------------ queries
     def traces(self) -> List[Span]:
         """Snapshot of finished root spans, oldest first."""
@@ -143,7 +165,7 @@ class Tracer:
                 events.append(
                     {
                         "name": sp.name,
-                        "cat": "reconcile",
+                        "cat": sp.category,
                         "ph": "X",
                         "ts": sp.wall_start * 1e6,
                         "dur": sp.duration * 1e6,

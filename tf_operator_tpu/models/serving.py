@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from tf_operator_tpu.models import llama as _llama
+from tf_operator_tpu.models.telemetry import ServeTelemetry
 
 
 @dataclasses.dataclass
@@ -170,7 +171,9 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                shared_prefix=None,
                cache_sharding=None, draft_cache_sharding=None,
                draft=None, draft_params=None, spec_k: int = 4,
-               draft_transform=None) -> List[ServeResult]:
+               draft_transform=None,
+               telemetry: Optional[ServeTelemetry] = None,
+               return_stats: bool = False):
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
     with continuous admission; returns a ServeResult per request, in
     request order.
@@ -226,13 +229,33 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     must be a chunk multiple so suffix segments stay aligned with the
     ring's no-wrap guarantees (refused loudly otherwise).
 
+    telemetry / return_stats: SERVING TELEMETRY (models/telemetry.py).
+    Every call is instrumented — per-request lifecycle spans (queued ->
+    admitted -> prefill segments -> decode -> finished) land in the
+    process-global tracer (category "serving"; pass telemetry=
+    ServeTelemetry(tracer=...) to redirect), and the registry-level
+    TTFT/TPOT/queue-wait/latency histograms plus occupancy, prefill-vs-
+    decode split, token/request counters, and draft-acceptance families
+    are fed as requests finish.  return_stats=True returns
+    (results, ServeStats) — the aggregate the bench prints — instead of
+    the bare result list.  Instrumentation adds host clock reads only;
+    it never introduces a device sync the loop didn't already do, so
+    tokens and scheduling are byte-identical with or without it.
+
     Greedy outputs are token-identical to per-request llama.generate
     calls; sampling draws its keys from the serve loop's own stream (the
     procedure, not the key path, matches)."""
     cfg = model.cfg
     reqs = [jnp.asarray(r, jnp.int32).reshape(-1) for r in requests]
     if not reqs:
-        return []
+        # zero requests is still a (trivial) run: the telemetry reports
+        # the CONFIGURED slots/speculation so a caller dividing
+        # occupancy by stats.slots never sees a phantom 0, and a
+        # caller-supplied telemetry object completes its lifecycle
+        tel = telemetry if telemetry is not None else ServeTelemetry()
+        tel.loop_started(0, slots, draft is not None)
+        stats = tel.finalize()
+        return ([], stats) if return_stats else []
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}")
@@ -507,15 +530,21 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # (accepted, proposed) — reset at activation, reported in finish
     spec_acc = [(0, 0)] * slots
     n_step = 0
+    # serving telemetry: spans + histograms + ServeStats
+    # (models/telemetry.py); every request is queued from here on
+    tel = telemetry if telemetry is not None else ServeTelemetry()
+    tel.loop_started(len(reqs), slots, spec)
 
     def finish(s):
         frozen_py[s] = True
-        results[owner[s]] = ServeResult(
+        ridx = owner[s]
+        results[ridx] = ServeResult(
             tokens=emitted[s], admitted_at_step=admitted_step[s],
             finished_at_step=n_step, slot=s,
             accepted_drafts=spec_acc[s][0],
             proposed_drafts=spec_acc[s][1])
         owner[s] = None
+        tel.request_finished(ridx, results[ridx], n_step)
 
     def advance_prefill(s):
         """Stream up to prefill_chunks_per_sync segments of slot s's
@@ -534,18 +563,22 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             piece = prompt_r[None, start:end]
             st["next"] += 1
             if is_last:  # final segment: logits + activate the lane
-                last_logits, st["row"] = chunk_fill(
-                    params, st["row"], piece, jnp.int32(start))
-                if spec:
-                    st["d_row"] = d_write(draft_params, st["d_row"],
-                                          piece, jnp.int32(start))
-                cache = insert_row(cache, st["row"], jnp.int32(s))
-                if spec:
-                    d_cache = insert_row(d_cache, st["d_row"],
-                                         jnp.int32(s))
-                rng, k_first = jax.random.split(rng)
-                first = int(_llama._select_token(
-                    last_logits, temperature, k_first, top_k, top_p)[0])
+                with tel.prefill_segment(st["ridx"], start, end):
+                    last_logits, st["row"] = chunk_fill(
+                        params, st["row"], piece, jnp.int32(start))
+                    if spec:
+                        st["d_row"] = d_write(draft_params, st["d_row"],
+                                              piece, jnp.int32(start))
+                    cache = insert_row(cache, st["row"], jnp.int32(s))
+                    if spec:
+                        d_cache = insert_row(d_cache, st["d_row"],
+                                             jnp.int32(s))
+                    rng, k_first = jax.random.split(rng)
+                    # the int() forces the device sync, so the final
+                    # segment's span covers real prefill wall-clock
+                    first = int(_llama._select_token(
+                        last_logits, temperature, k_first, top_k,
+                        top_p)[0])
                 ridx = st["ridx"]
                 del pending[s]
                 owner[s] = ridx
@@ -555,14 +588,16 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                 tok = tok.at[s].set(first)
                 pos = pos.at[s].set(p_len)
                 frozen_py[s] = False
+                tel.request_activated(ridx, n_step)
                 if first == eos or max_new_tokens == 1:
                     finish(s)
                 return
-            st["row"] = chunk_write(params, st["row"], piece,
-                                    jnp.int32(start))
-            if spec:
-                st["d_row"] = d_write(draft_params, st["d_row"], piece,
-                                      jnp.int32(start))
+            with tel.prefill_segment(st["ridx"], start, end):
+                st["row"] = chunk_write(params, st["row"], piece,
+                                        jnp.int32(start))
+                if spec:
+                    st["d_row"] = d_write(draft_params, st["d_row"],
+                                          piece, jnp.int32(start))
 
     while queue or pending or any(o is not None for o in owner):
         # ---- admission: every free lane RESERVES the next queued
@@ -575,23 +610,28 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     "ridx": ridx, "row": row, "d_row": d_row,
                     "next": resume_index(reqs[ridx].shape[0]),
                 }
+                tel.request_admitted(ridx, s)
         for s in list(pending):
             advance_prefill(s)
         if all(o is None for o in owner):
             continue  # nothing decoding yet; keep prefilling/admitting
         # ---- one decode BLOCK for every lane, each at its own position
         rng, k_step = jax.random.split(rng)
+        # occupancy: lanes owned by a live request this block (finish
+        # clears owner, so owned == decoding)
+        busy = sum(1 for o in owner if o is not None)
         if spec:
             # steps_per_sync speculation ROUNDS: each emits up to
             # spec_k+1 tokens per lane; a lane that hits EOS or budget
             # mid-block keeps speculating to the block edge and the
             # host discards the overshoot (same contract as the
             # single-token block, scaled by the round width)
-            cache, d_cache, tok, pos, cands, n_accs = spec_block(
-                params, draft_params, cache, d_cache, tok, pos,
-                jnp.asarray(frozen_py), k_step, steps_per_sync)
-            cands = jax.device_get(cands)    # [rounds, B, spec_k+1]
-            n_accs = jax.device_get(n_accs)  # [rounds, B]; -1 = frozen
+            with tel.decode_block(busy):
+                cache, d_cache, tok, pos, cands, n_accs = spec_block(
+                    params, draft_params, cache, d_cache, tok, pos,
+                    jnp.asarray(frozen_py), k_step, steps_per_sync)
+                cands = jax.device_get(cands)   # [rounds, B, spec_k+1]
+                n_accs = jax.device_get(n_accs)  # [rounds, B]; -1=frozen
             for i in range(steps_per_sync):
                 n_step += 1
                 for s in range(slots):
@@ -610,10 +650,11 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                             finish(s)
                             break
         else:
-            cache, tok, pos, toks = step(params, cache, tok, pos,
-                                         jnp.asarray(frozen_py), k_step,
-                                         steps_per_sync)
-            block = jax.device_get(toks)  # [steps_per_sync, B]
+            with tel.decode_block(busy):
+                cache, tok, pos, toks = step(params, cache, tok, pos,
+                                             jnp.asarray(frozen_py),
+                                             k_step, steps_per_sync)
+                block = jax.device_get(toks)  # [steps_per_sync, B]
             for i in range(steps_per_sync):
                 n_step += 1
                 for s in range(slots):
@@ -623,4 +664,9 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     emitted[s].append(t)
                     if t == eos or len(emitted[s]) >= max_new_tokens:
                         finish(s)  # later in-block tokens are overshoot
+    # every exit idles the occupancy gauge and samples the HBM peak —
+    # a scrape between serve runs must not read the last block's state
+    tel.loop_finished()
+    if return_stats:
+        return results, tel.finalize()
     return results  # type: ignore[return-value]

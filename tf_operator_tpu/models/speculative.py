@@ -407,6 +407,13 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     check_truncation(target.cfg.vocab_size, top_k, top_p)
+    if eos_id is not None and not 0 <= int(eos_id) < target.cfg.vocab_size:
+        # validated BEFORE any compute (serve_loop's contract): an
+        # out-of-range eos must not run — and count — a full decode
+        # only to raise at the post-mask
+        raise ValueError(
+            f"eos_id {eos_id} out of range for vocab_size "
+            f"{target.cfg.vocab_size}")
     if temperature <= 0.0:
         # greedy ignores truncation (generate()'s contract) — normalize
         # so (T=0, top_k=50) and (T=0) share one _spec_fns cache entry
@@ -482,11 +489,17 @@ def speculative_generate(target, t_params, draft, d_params, prompt,
     out, n_fwd, acc_total, prop_total = spec_loop(
         t_params, d_params, t_cache, d_cache, first,
         jnp.int32(prompt_len), k_loop, int(max_new_tokens))
+    # registry-level acceptance family (engine/metrics.py): the same
+    # accepted/proposed the serve loop reports per request, labeled by
+    # path so scrapes separate batch generation from continuous
+    # batching.  The int() reads block on the decode loop — which every
+    # caller does on the very next line by consuming `out` anyway.
+    from tf_operator_tpu.engine import metrics as _em
+
+    _labels = {"path": "speculative_generate"}
+    _em.SERVING_ACCEPTED_DRAFTS.inc(_labels, int(acc_total))
+    _em.SERVING_PROPOSED_DRAFTS.inc(_labels, int(prop_total))
     if eos_id is not None:
-        if not 0 <= int(eos_id) < target.cfg.vocab_size:
-            raise ValueError(
-                f"eos_id {eos_id} out of range for vocab_size "
-                f"{target.cfg.vocab_size}")
         # generate()'s contract: once a row emits EOS it keeps emitting
         # it.  A post-mask gives the identical output (the masked tail's
         # compute is wasted, not wrong — greedy/sampling exactness up to

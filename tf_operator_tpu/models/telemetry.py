@@ -1,0 +1,391 @@
+"""Serving-path telemetry — request lifecycle spans, latency histograms,
+occupancy/acceptance accounting for models/serving.serve_loop.
+
+PR 1 made the OPERATOR observable (reconcile spans, workqueue gauges,
+goodput/MFU); the serving loop recorded only step indices.  This module
+is the serving half of that layer, built on the same primitives instead
+of new ones:
+
+  - per-request lifecycle SPANS (engine/tracing.Span): queued ->
+    admitted -> prefill (one child per streamed segment) -> decode ->
+    finished.  Requests interleave on one host thread, so the phases
+    cannot be expressed as a context-manager stack; the telemetry
+    assembles each request's span tree by hand and lands it in the
+    tracer via Tracer.record(), category "serving", one virtual trace
+    lane per request — the same Chrome-trace export (`/debug/traces`,
+    `--trace-dump`) that serves reconcile spans shows serving requests
+    beside them.
+  - latency HISTOGRAMS (engine/metrics.py serving families): TTFT
+    (lane admission -> first sampled token), TPOT (decode wall-clock
+    per decoded token), queue wait (enqueue -> lane reserved), and
+    end-to-end request latency — the externally-meaningful serving
+    SLO axes, each observed once per finished request.
+  - GAUGES/COUNTERS: batch occupancy (live lanes, sampled at every
+    decode block), the prefill-vs-decode wall-clock split, request and
+    token throughput counters, and speculative draft acceptance
+    (accepted/proposed, the same numbers ServeResult reports per
+    request) — the per-workload utilization signals scheduler work
+    (Gavel, Tesserae) assumes a serving system can report.
+  - an aggregate `ServeStats` (returned by serve_loop(return_stats=
+    True), printed by bench.py) with an HBM high-watermark sample via
+    runtime/profiler.device_memory_stats.
+
+Timing honesty: phases are measured at host boundaries.  Decode blocks
+END at the token readback (jax.device_get — a true device barrier), so
+decode time is real wall-clock; prefill segment durations cover the
+host dispatch of the chunk writers plus any sync the final segment's
+first-token fetch forces.  Nothing here adds a device sync the serve
+loop did not already perform — telemetry must not change the schedule
+it measures.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.engine import metrics as em
+from tf_operator_tpu.engine.tracing import Span, Tracer, get_tracer
+
+
+def _mean(xs: List[float]) -> float:
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+# Virtual trace-lane base for serving request spans: reconcile spans in
+# the same export use OS native thread ids as tid, and in a container
+# those are small integers — request index 3 must not land on worker
+# thread 3's track.  The offset keeps the two span streams on disjoint
+# Perfetto tracks (cat filtering separates colors, not tracks).
+_LANE_BASE = 1 << 20
+
+
+class _RequestTimeline:
+    """Host-side timestamps for one request's lifecycle.  Everything is
+    perf_counter: the telemetry anchors ONE (wall, perf) pair at loop
+    start and derives every span's wall_start from it, so phase
+    intervals nest exactly by construction — mixing per-event time.time()
+    samples with perf_counter durations would let clock skew break the
+    parent-contains-child invariant the trace viewer renders."""
+
+    __slots__ = (
+        "index", "queued_pc", "admitted_pc", "first_token_pc",
+        "finished_pc", "slot", "prefill_s", "segments", "tokens",
+        "accepted_drafts", "proposed_drafts", "admitted_at_step",
+        "finished_at_step",
+    )
+
+    def __init__(self, index: int, pc: float) -> None:
+        self.index = index
+        self.queued_pc = pc
+        self.admitted_pc: Optional[float] = None
+        self.first_token_pc: Optional[float] = None
+        self.finished_pc: Optional[float] = None
+        self.slot: Optional[int] = None
+        self.prefill_s = 0.0
+        # (pc_start, duration, token_start, token_end) per segment
+        self.segments: List[tuple] = []
+        self.tokens = 0
+        self.accepted_drafts = 0
+        self.proposed_drafts = 0
+        self.admitted_at_step = 0
+        self.finished_at_step = 0
+
+    # ------------------------------------------------------- derived
+    def queue_wait_s(self) -> float:
+        return self.admitted_pc - self.queued_pc
+
+    def ttft_s(self) -> float:
+        return self.first_token_pc - self.admitted_pc
+
+    def e2e_latency_s(self) -> float:
+        return self.finished_pc - self.queued_pc
+
+    def tpot_s(self) -> Optional[float]:
+        """Decode wall-clock per decoded token (first token excluded);
+        None for single-token requests — there was no decode phase."""
+        if self.tokens < 2:
+            return None
+        return (self.finished_pc - self.first_token_pc) / (self.tokens - 1)
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Aggregate serving telemetry for one serve_loop run.  Latency
+    aggregates summarize per-request numbers (the full per-request rows
+    ride in `per_request`); occupancy is time-weighted over decode
+    blocks; the prefill/decode split is loop-level wall-clock, so the
+    two need not sum to wall_time_s (admission bookkeeping and host
+    emission are neither)."""
+
+    requests: int = 0
+    slots: int = 0
+    speculative: bool = False
+    total_tokens: int = 0
+    wall_time_s: float = 0.0
+    tokens_per_sec: float = 0.0
+    queue_wait_mean_s: float = 0.0
+    queue_wait_max_s: float = 0.0
+    ttft_mean_s: float = 0.0
+    ttft_max_s: float = 0.0
+    tpot_mean_s: Optional[float] = None
+    e2e_latency_mean_s: float = 0.0
+    e2e_latency_max_s: float = 0.0
+    prefill_time_s: float = 0.0
+    decode_time_s: float = 0.0
+    occupancy_mean: float = 0.0
+    occupancy_max: int = 0
+    accepted_drafts: int = 0
+    proposed_drafts: int = 0
+    acceptance_rate: Optional[float] = None
+    hbm_peak_bytes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    per_request: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+    def summary(self, digits: int = 6) -> Dict[str, Any]:
+        """Compact dict for bench artifacts / JSON lines: the aggregate
+        fields rounded, per-request rows dropped."""
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            if f.name == "per_request":
+                continue
+            v = getattr(self, f.name)
+            out[f.name] = round(v, digits) if isinstance(v, float) else v
+        return out
+
+
+class ServeTelemetry:
+    """The instrumentation object serve_loop drives.  One instance per
+    serve_loop call; pass your own (e.g. with a private Tracer) via
+    serve_loop(telemetry=...) or let the loop build one against the
+    process-global tracer.  Metric families are registry-level and
+    shared — concurrent serve loops aggregate, as scrape targets do."""
+
+    def __init__(self, tracer: Optional[Tracer] = None) -> None:
+        self.tracer = tracer or get_tracer()
+        self._reqs: Dict[int, _RequestTimeline] = {}
+        self._done: List[_RequestTimeline] = []
+        self._slots = 0
+        self._spec = False
+        self._started_pc: Optional[float] = None
+        self._wall0 = 0.0  # epoch anchor for span placement
+        self._prefill_s = 0.0
+        self._decode_s = 0.0
+        self._occ: List[tuple] = []  # (busy_lanes, block_duration)
+        self._hbm: Optional[Dict[str, int]] = None  # set by loop_finished
+
+    def _wall(self, pc: float) -> float:
+        """Epoch seconds for a perf_counter reading, via the single
+        anchor pair sampled at loop start (see _RequestTimeline)."""
+        return self._wall0 + (pc - (self._started_pc or pc))
+
+    # --------------------------------------------------------- lifecycle
+    def loop_started(self, n_requests: int, slots: int,
+                     speculative: bool) -> None:
+        # fresh accumulators: an instance reused across serve_loop calls
+        # must report the CURRENT run, not a merge (spans and registry
+        # counters already landed; only the aggregate state resets)
+        self._reqs.clear()
+        self._done.clear()
+        self._occ.clear()
+        self._hbm = None
+        self._prefill_s = self._decode_s = 0.0
+        self._started_pc = time.perf_counter()
+        self._wall0 = time.time()
+        self._slots = slots
+        self._spec = speculative
+        for i in range(n_requests):
+            self._reqs[i] = _RequestTimeline(i, self._started_pc)
+
+    def request_admitted(self, index: int, slot: int) -> None:
+        """A decode lane was RESERVED for the request (its prompt may
+        still stream in over many loop iterations) — queue wait ends
+        here, the prefill phase begins."""
+        r = self._reqs[index]
+        r.admitted_pc = time.perf_counter()
+        r.slot = slot
+        em.SERVING_QUEUE_WAIT.observe(r.queue_wait_s())
+
+    @contextmanager
+    def prefill_segment(self, index: int, tok_start: int, tok_end: int):
+        """Time one streamed prompt segment (chunk write or final fill +
+        lane insert).  Non-final segments measure host dispatch; the
+        final segment includes the first-token fetch's device sync."""
+        r = self._reqs[index]
+        pc = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - pc
+            r.segments.append((pc, dt, tok_start, tok_end))
+            r.prefill_s += dt
+            self._prefill_s += dt
+            em.SERVING_PREFILL_TIME.inc(amount=dt)
+
+    def request_activated(self, index: int, step: int) -> None:
+        """First token sampled, lane live: TTFT is measurable."""
+        r = self._reqs[index]
+        r.first_token_pc = time.perf_counter()
+        r.admitted_at_step = step
+        em.SERVING_TTFT.observe(r.ttft_s())
+
+    @contextmanager
+    def decode_block(self, busy_lanes: int):
+        """Time one decode block (device scan + token readback — the
+        readback is a real barrier, so this is true decode wall-clock)
+        and sample batch occupancy, time-weighted by the block."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._decode_s += dt
+            self._occ.append((busy_lanes, dt))
+            em.SERVING_DECODE_TIME.inc(amount=dt)
+            em.SERVING_BATCH_OCCUPANCY.set(busy_lanes)
+
+    def request_finished(self, index: int, result: Any, step: int) -> None:
+        """Request complete (EOS or budget): close the lifecycle, feed
+        the histograms, and land the span tree in the tracer."""
+        r = self._reqs.pop(index)
+        r.finished_pc = time.perf_counter()
+        r.tokens = len(result.tokens)
+        r.accepted_drafts = result.accepted_drafts
+        r.proposed_drafts = result.proposed_drafts
+        r.finished_at_step = step
+        if r.first_token_pc is None:  # defensive: activation always ran
+            r.first_token_pc = r.finished_pc
+        em.SERVING_REQUEST_LATENCY.observe(r.e2e_latency_s())
+        em.SERVING_REQUESTS.inc()
+        em.SERVING_TOKENS.inc(amount=r.tokens)
+        tpot = r.tpot_s()
+        if tpot is not None:
+            em.SERVING_TPOT.observe(tpot)
+        if self._spec:
+            labels = {"path": "serve_loop"}
+            em.SERVING_ACCEPTED_DRAFTS.inc(labels, r.accepted_drafts)
+            em.SERVING_PROPOSED_DRAFTS.inc(labels, r.proposed_drafts)
+        self._done.append(r)
+        self.tracer.record(self._request_span(r))
+
+    # ------------------------------------------------------------- spans
+    def _request_span(self, r: _RequestTimeline) -> Span:
+        """Assemble the finished request's span tree: queued / prefill
+        (segment children) / decode under one root.  Every wall_start
+        derives from the same clock anchor and every phase boundary is
+        a shared perf_counter reading, so children nest inside their
+        parents exactly."""
+        def child(name: str, pc: float, dur: float, parent: Span,
+                  attrs: Optional[Dict[str, Any]] = None) -> Span:
+            sp = Span(name=name, start=pc, wall_start=self._wall(pc),
+                      attrs=dict(attrs or {}), duration=max(0.0, dur),
+                      parent=parent, thread_id=_LANE_BASE + r.index,
+                      category="serving")
+            parent.children.append(sp)
+            return sp
+
+        root = Span(
+            name="serve_request", start=r.queued_pc,
+            wall_start=self._wall(r.queued_pc),
+            attrs={
+                "request": r.index, "slot": r.slot, "tokens": r.tokens,
+                "admitted_at_step": r.admitted_at_step,
+                "finished_at_step": r.finished_at_step,
+                "accepted_drafts": r.accepted_drafts,
+                "proposed_drafts": r.proposed_drafts,
+            },
+            duration=r.e2e_latency_s(), thread_id=_LANE_BASE + r.index,
+            category="serving",
+        )
+        child("queued", r.queued_pc, r.queue_wait_s(), root)
+        prefill = child("prefill", r.admitted_pc, r.ttft_s(), root,
+                        {"segments": len(r.segments)})
+        for pc, dur, t0, t1 in r.segments:
+            child("prefill_segment", pc, dur, prefill,
+                  {"token_start": t0, "token_end": t1})
+        child("decode", r.first_token_pc,
+              r.finished_pc - r.first_token_pc, root,
+              {"tokens": r.tokens})
+        return root
+
+    # --------------------------------------------------------- aggregate
+    def loop_finished(self) -> None:
+        """The serve loop exited: idle the occupancy gauge (a scrape of
+        a quiescent process must read 0, not the last block's lane
+        count) and sample the HBM high watermark.  serve_loop calls
+        this on EVERY exit — with or without return_stats — so the
+        gauge families stay honest for plain callers; idempotent, and
+        finalize() reuses the sample."""
+        if self._hbm is not None:
+            return
+        em.SERVING_BATCH_OCCUPANCY.set(0)
+        self._hbm = _hbm_peaks()
+        for dev, peak in self._hbm.items():
+            em.SERVING_HBM_PEAK.set(peak, {"device": dev})
+
+    def finalize(self) -> ServeStats:
+        """Aggregate everything observed into a ServeStats (the HBM
+        high-watermark sample comes from loop_finished, taken here if
+        the loop didn't already)."""
+        self.loop_finished()
+        wall = (time.perf_counter() - self._started_pc
+                if self._started_pc is not None else 0.0)
+        done = sorted(self._done, key=lambda r: r.index)
+        total_tokens = sum(r.tokens for r in done)
+        tpots = [r.tpot_s() for r in done]
+        tpots = [t for t in tpots if t is not None]
+        occ_time = sum(dt for _, dt in self._occ)
+        accepted = sum(r.accepted_drafts for r in done)
+        proposed = sum(r.proposed_drafts for r in done)
+        hbm = dict(self._hbm or {})
+        return ServeStats(
+            requests=len(done),
+            slots=self._slots,
+            speculative=self._spec,
+            total_tokens=total_tokens,
+            wall_time_s=wall,
+            tokens_per_sec=total_tokens / wall if wall > 0 else 0.0,
+            queue_wait_mean_s=_mean([r.queue_wait_s() for r in done]),
+            queue_wait_max_s=max(
+                [r.queue_wait_s() for r in done], default=0.0),
+            ttft_mean_s=_mean([r.ttft_s() for r in done]),
+            ttft_max_s=max([r.ttft_s() for r in done], default=0.0),
+            tpot_mean_s=_mean(tpots) if tpots else None,
+            e2e_latency_mean_s=_mean([r.e2e_latency_s() for r in done]),
+            e2e_latency_max_s=max(
+                [r.e2e_latency_s() for r in done], default=0.0),
+            prefill_time_s=self._prefill_s,
+            decode_time_s=self._decode_s,
+            occupancy_mean=(
+                sum(b * dt for b, dt in self._occ) / occ_time
+                if occ_time > 0 else 0.0),
+            occupancy_max=max([b for b, _ in self._occ], default=0),
+            accepted_drafts=accepted,
+            proposed_drafts=proposed,
+            acceptance_rate=(accepted / proposed if proposed else None),
+            hbm_peak_bytes=hbm,
+            per_request=[{
+                "request": r.index,
+                "slot": r.slot,
+                "tokens": r.tokens,
+                "queue_wait_s": r.queue_wait_s(),
+                "ttft_s": r.ttft_s(),
+                "tpot_s": r.tpot_s(),
+                "e2e_latency_s": r.e2e_latency_s(),
+                "prefill_s": r.prefill_s,
+                "accepted_drafts": r.accepted_drafts,
+                "proposed_drafts": r.proposed_drafts,
+            } for r in done],
+        )
+
+
+def _hbm_peaks() -> Dict[str, int]:
+    """{device: peak_bytes_in_use} (falls back to bytes_in_use where the
+    backend has usage but no peak); {} on CPU — the profiler's contract."""
+    from tf_operator_tpu.runtime.profiler import device_memory_stats
+
+    out: Dict[str, int] = {}
+    for dev, stats in device_memory_stats().items():
+        out[dev] = stats.get("peak_bytes_in_use", stats["bytes_in_use"])
+    return out

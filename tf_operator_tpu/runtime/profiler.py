@@ -316,12 +316,24 @@ class Profiler:
         return json.dumps({k: _json_safe(v) for k, v in payload.items()})
 
 
-def device_memory_stats() -> Dict[str, int]:
-    """Per-device HBM usage {device: bytes_in_use} where the backend exposes
-    it (TPU/GPU; CPU returns {})."""
-    out: Dict[str, int] = {}
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device HBM usage where the backend exposes it (TPU/GPU; CPU
+    returns {} — backends without memory_stats never grow keys).
+
+    {device: {"bytes_in_use": N[, "peak_bytes_in_use": N,
+              "bytes_limit": N]}} — the peak is the allocation high
+    watermark since process start (the number a serving run's headroom
+    question actually needs: a transient prefill spike never shows in
+    an end-of-run bytes_in_use read), and bytes_limit is the device's
+    allocatable ceiling; both ride along only when the PJRT backend
+    reports them (TPU and GPU do today)."""
+    out: Dict[str, Dict[str, int]] = {}
     for d in jax.local_devices():
         stats = getattr(d, "memory_stats", lambda: None)()
         if stats and "bytes_in_use" in stats:
-            out[str(d)] = int(stats["bytes_in_use"])
+            entry = {"bytes_in_use": int(stats["bytes_in_use"])}
+            for key in ("peak_bytes_in_use", "bytes_limit"):
+                if key in stats:
+                    entry[key] = int(stats[key])
+            out[str(d)] = entry
     return out

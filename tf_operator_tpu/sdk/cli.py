@@ -9,6 +9,7 @@ common verbs into one command:
   tpu-jobs run-local job.yaml              # run replicas as LOCAL processes
   tpu-jobs get tfjob mnist [-n ns] [-o json|wide]
   tpu-jobs describe tfjob mnist            # conditions, replicas, events
+  tpu-jobs events tfjob mnist              # kubectl-get-events analog
   tpu-jobs list tpujob [-n ns]
   tpu-jobs wait tfjob mnist --timeout 600  # block until terminal
   tpu-jobs logs tfjob mnist [--replica-type Worker] [--index 0]
@@ -62,6 +63,35 @@ def resolve_kind(token: str) -> str:
 def _condition_summary(job: Dict[str, Any]) -> str:
     # single source of truth for "latest True condition" (sdk/watch.py)
     return job_state(job) or "Pending"
+
+
+def _event_time(e: Dict[str, Any]) -> str:
+    """An event's most recent timestamp: real apiserver events carry
+    lastTimestamp/firstTimestamp, the fake recorder a single timestamp."""
+    return (e.get("lastTimestamp") or e.get("timestamp")
+            or e.get("firstTimestamp") or "")
+
+
+def _age(ts: str) -> str:
+    """kubectl-style age for an ISO-8601 timestamp (now_iso's
+    %Y-%m-%dT%H:%M:%SZ shape): 5s / 3m / 2h / 4d; '<unknown>' for
+    anything unparseable so one odd event never breaks the listing."""
+    import datetime as _dt
+
+    try:
+        when = _dt.datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=_dt.timezone.utc)
+    except (TypeError, ValueError):
+        return "<unknown>"
+    secs = max(0, int((_dt.datetime.now(_dt.timezone.utc)
+                       - when).total_seconds()))
+    if secs < 120:
+        return f"{secs}s"
+    if secs < 2 * 3600:
+        return f"{secs // 60}m"
+    if secs < 2 * 86400:
+        return f"{secs // 3600}h"
+    return f"{secs // 86400}d"
 
 
 def _print_job_row(job: Dict[str, Any], header: bool = False) -> None:
@@ -225,9 +255,24 @@ class Cli:
         )
         if events:
             print("Events:")
+            print(f"  {'TYPE':<8}{'REASON':<28}{'AGE':<10}MESSAGE")
             for e in events:
                 print(f"  {e.get('type', ''):<8}{e.get('reason', ''):<28}"
-                      f"{e.get('message', '')}")
+                      f"{_age(_event_time(e)):<10}{e.get('message', '')}")
+        return 0
+
+    def events(self, kind: str, name: str, namespace: str) -> int:
+        """kubectl-get-events analog for one job: every recorded event,
+        oldest first, with its age."""
+        self.client(kind).get(name, namespace=namespace)  # NotFound early
+        events = self.cluster.events_for(name, namespace=namespace)
+        if not events:
+            print("No events found.")
+            return 0
+        print(f"{'LAST SEEN':<12}{'TYPE':<8}{'REASON':<28}MESSAGE")
+        for e in events:
+            print(f"{_age(_event_time(e)):<12}{e.get('type', ''):<8}"
+                  f"{e.get('reason', ''):<28}{e.get('message', '')}")
         return 0
 
     def scale(self, kind: str, name: str, namespace: str, replicas: int,
@@ -308,8 +353,8 @@ def make_parser() -> argparse.ArgumentParser:
     pr.add_argument("file", help="job YAML ('-' for stdin)")
     pr.add_argument("--timeout", type=float, default=300.0)
 
-    for verb in ("get", "describe", "wait", "pods", "logs", "delete",
-                 "suspend", "resume", "scale"):
+    for verb in ("get", "describe", "events", "wait", "pods", "logs",
+                 "delete", "suspend", "resume", "scale"):
         pv = sub.add_parser(verb, parents=[common])
         pv.add_argument("kind")
         pv.add_argument("name")
@@ -351,6 +396,8 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
         return cli.get(kind, args.name, ns, args.output)
     if args.verb == "describe":
         return cli.describe(kind, args.name, ns)
+    if args.verb == "events":
+        return cli.events(kind, args.name, ns)
     if args.verb == "list":
         return cli.list(kind, ns)
     if args.verb == "wait":
