@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint bench native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -30,8 +30,14 @@ $(NATIVE_LIB): $(NATIVE_SRCS) $(wildcard $(NATIVE_DIR)/*.h)
 metrics-lint:
 	python hack/check_metric_names.py
 
+# `make test` exercises the chaos harness on its default single seed (the
+# soak in tests/test_chaos.py); `make chaos` widens it to several fixed
+# seeds for the full fault-injection sweep (docs/robustness.md).
 test: native metrics-lint
 	python -m pytest tests/ -x -q
+
+chaos:
+	CHAOS_SEEDS="1337,4242,90210" python -m pytest tests/test_chaos.py -q
 
 bench:
 	python bench.py

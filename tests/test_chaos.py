@@ -1,0 +1,366 @@
+"""Chaos soak — the operator driven through seeded fault storms.
+
+The acceptance contract (ISSUE 3): a storm of 429/500s, conflicts, resets,
+stale reads, a watch outage, and two worker preemptions must end with the
+job Running, correct restart counters, zero orphaned pods/services, and only
+legal status-condition transitions — and the run must be deterministic per
+seed (two runs, byte-identical injector event logs).  The same scenarios run
+with the hardening switched off (`classify_retryable_errors=False`,
+`restart_backoff_base=0`) demonstrate the pre-hardening failure modes:
+retry-budget exhaustion and hot-loop pod churn.
+
+`make chaos` runs this module across several seeds (CHAOS_SEEDS env);
+the default single seed keeps tier-1 fast.
+"""
+import os
+
+import pytest
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.chaos import DeterministicQueue, FaultInjector, SimClock
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+from tests import testutil
+
+SOAK_SEEDS = [int(s) for s in os.environ.get("CHAOS_SEEDS", "1337").split(",")]
+
+TERMINAL = {"Succeeded", "Failed"}
+
+
+@pytest.fixture(autouse=True)
+def _quiet_logs():
+    """Thousands of injected failures would otherwise spend most of the
+    test's wall-clock formatting warning/error log records."""
+    import logging
+
+    logging.disable(logging.CRITICAL)
+    yield
+    logging.disable(logging.NOTSET)
+
+
+class ConditionAuditor:
+    """Watches every status write on the authoritative store and records
+    illegal condition transitions: terminal states are sticky and mutually
+    exclusive; Running and Restarting never hold simultaneously."""
+
+    def __init__(self, inner, kind: str) -> None:
+        self.violations = []
+        self._last = {}
+        inner.subscribe(kind, self._on_event)
+
+    def _on_event(self, event_type, obj) -> None:
+        if event_type not in ("ADDED", "MODIFIED"):
+            return
+        key = objects.key_of(obj)
+        conds = {
+            c["type"]
+            for c in (obj.get("status", {}) or {}).get("conditions", []) or []
+            if c.get("status") == "True"
+        }
+        prev = self._last.get(key, set())
+        if len(conds & TERMINAL) > 1:
+            self.violations.append(f"{key}: both terminal conditions true: {conds}")
+        for term in TERMINAL:
+            if term in prev:
+                if term not in conds:
+                    self.violations.append(f"{key}: terminal {term} revoked")
+                if conds & ({"Running", "Restarting"} | (TERMINAL - {term})):
+                    self.violations.append(
+                        f"{key}: post-{term} transition to {conds}"
+                    )
+        if "Running" in conds and "Restarting" in conds:
+            self.violations.append(f"{key}: Running and Restarting both true")
+        self._last[key] = conds
+
+
+def audit_orphans(inner, kind="TFJob"):
+    """No pod/service may outlive (or predate) its controlling job, and no
+    replica index may be doubly materialized."""
+    problems = []
+    jobs = {j["metadata"]["uid"]: j for j in inner.list(kind)}
+    for dep_kind in ("Pod", "Service"):
+        seen = set()
+        for obj in inner.list(dep_kind):
+            ref = objects.get_controller_of(obj)
+            if ref is None or ref.get("uid") not in jobs:
+                problems.append(f"orphan {dep_kind} {objects.key_of(obj)}")
+                continue
+            labels = objects.labels_of(obj)
+            slot = (
+                ref["uid"],
+                labels.get(objects.LABEL_REPLICA_TYPE),
+                labels.get(objects.LABEL_REPLICA_INDEX),
+            )
+            if slot in seen:
+                problems.append(
+                    f"duplicate index {dep_kind} {objects.key_of(obj)}"
+                )
+            seen.add(slot)
+    return problems
+
+
+def make_harness(seed, backoff_base=20.0, classify=True):
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=seed, clock=clock)
+    auditor = ConditionAuditor(inner, "TFJob")
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]),
+        restart_backoff_base=backoff_base,
+        restart_backoff_max=120.0,
+        classify_retryable_errors=classify,
+    )
+    mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
+    # all delays collapse to immediate adds: pop order (and therefore the
+    # whole run) becomes a pure function of the seed + schedule, and no
+    # real-time timer ever fires mid-soak
+    for ctl in mgr.controllers.values():
+        ctl.queue = DeterministicQueue()
+    mgr.factory.start_all()
+    return inner, clock, inj, mgr, auditor
+
+
+def drain(mgr, budget=80):
+    """Deterministic single-threaded dispatch: pop-and-sync until the queues
+    are empty or the per-round budget is burned (an active error storm
+    requeues every key immediately — the budget bounds the spin)."""
+    for _ in range(budget):
+        busy = False
+        for ctl in mgr.controllers.values():
+            key = ctl.queue.get(timeout=0)
+            if key is None:
+                continue
+            busy = True
+            try:
+                ctl._sync_guarded(key)
+            finally:
+                ctl.queue.done(key)
+        if not busy:
+            return
+
+
+def run_steps(inj, mgr, steps, dt=5.0):
+    for _ in range(steps):
+        inj.step(dt)
+        # periodic resync stands in for the real informers' resync loop: it
+        # re-enqueues every key (progress for keys parked behind real-time
+        # delays) and retries any pending watch-gap relist
+        for inf in mgr.factory._informers.values():
+            inf.resync_once()
+        drain(mgr)
+
+
+def _exitcode_tfjob(name, workers=3):
+    job = testutil.new_tfjob(name, worker=workers)
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_EXIT_CODE
+    return job
+
+
+# ---------------------------------------------------------------- the soak
+def run_soak(seed):
+    """The acceptance scenario: overlapping 429/500/conflict/reset/stale
+    storms, a Pod+Service watch outage, and two worker preemptions, then a
+    long quiet tail (expectation TTL + backoff windows) to converge."""
+    inner, clock, inj, mgr, auditor = make_harness(seed)
+    inj.schedule_storm(10, 15, fault="429", retry_after=3.0)
+    inj.schedule_storm(30, 10, fault="500")
+    inj.schedule_storm(42, 6, fault="conflict", ops=["update"])
+    inj.schedule_storm(50, 8, fault="reset")
+    inj.schedule_storm(60, 10, fault="stale", ops=["get", "list"])
+    inj.schedule_watch_outage(45, 12, kinds=("Pod", "Service"))
+    inj.at(
+        20, lambda: inj.kill_pod("default", "soak-worker-1", 137),
+        "preempt soak-worker-1",
+    )
+    # second preemption lands INSIDE the watch outage: its pod event is
+    # dropped, so the operator can only learn of it via the 410-forced
+    # relist — the hardest recovery path
+    inj.at(
+        50, lambda: inj.kill_pod("default", "soak-worker-0", 137),
+        "preempt soak-worker-0",
+    )
+    inj.create("TFJob", _exitcode_tfjob("soak").to_dict())
+    try:
+        run_steps(inj, mgr, steps=160, dt=5.0)  # 800s: chaos ends by t=80
+    finally:
+        mgr.factory.stop_all()
+
+    assert auditor.violations == [], auditor.violations
+    problems = audit_orphans(inner)
+    assert problems == [], problems
+
+    job = inner.get("TFJob", "default", "soak")
+    status = common.JobStatus.from_dict(job.get("status"))
+    assert common.is_running(status), [c.to_dict() for c in status.conditions]
+    rs = status.replica_statuses["Worker"]
+    assert rs.active == 3, job["status"]
+    # both preemptions landed on Running pods and each produced exactly one
+    # counted operator restart — no double counting through the storms
+    assert inj.stats.get("kill.hit") == 2, inj.stats
+    booked = inj.retryable_kills.get(("default/soak", "worker"), 0)
+    assert rs.restarts == booked == 2, (rs.restarts, dict(inj.retryable_kills))
+    pods = inner.list_pods()
+    assert len(pods) == 3
+    assert all(objects.pod_phase(p) == objects.POD_RUNNING for p in pods)
+    # the chaos actually bit: every fault class fired at least once
+    for fault in ("fault.429", "fault.500", "fault.conflict", "fault.reset"):
+        assert inj.stats.get(fault, 0) > 0, (fault, inj.stats)
+    assert inj.stats.get("watch.dropped.Pod", 0) > 0, inj.stats
+    return inj.log
+
+
+@pytest.mark.parametrize("seed", SOAK_SEEDS)
+def test_chaos_soak_converges_and_is_deterministic(seed):
+    log1 = run_soak(seed)
+    log2 = run_soak(seed)
+    assert log1 == log2, "same seed must replay an identical event log"
+    assert any("preempt" in line for line in log1)
+
+
+# ------------------------------------------- pre-hardening failure modes
+def _exhaustion_scenario(classify):
+    """A long 500 storm on pod creation: every reconcile errors at the
+    create step (a *classified-retryable* failure) while gets/lists still
+    work, so the error reaches the workqueue retry accounting."""
+    inner, clock, inj, mgr, _ = make_harness(1, classify=classify)
+    before = metrics.SYNC_RETRIES_EXHAUSTED.get({"kind": "TFJob"})
+    inj.schedule_storm(5, 150, fault="500", ops=["create"], kinds=["Pod"])
+    inj.create("TFJob", _exitcode_tfjob("burn", workers=1).to_dict())
+    try:
+        run_steps(inj, mgr, steps=36, dt=5.0)  # 180s: storm ends at 155
+    finally:
+        mgr.factory.stop_all()
+    exhausted = metrics.SYNC_RETRIES_EXHAUSTED.get({"kind": "TFJob"}) - before
+    job = inner.get("TFJob", "default", "burn")
+    return exhausted, common.JobStatus.from_dict(job.get("status"))
+
+
+def test_storm_exhausts_retry_budget_without_classification():
+    """Pre-hardening accounting: a transient apiserver storm burns
+    MAX_RECONCILE_RETRIES and drops the key to the flat exhausted cadence —
+    the invariant violation the classification exists to prevent."""
+    exhausted, _ = _exhaustion_scenario(classify=False)
+    assert exhausted > 0
+
+
+def test_storm_never_exhausts_classified_retries_and_converges():
+    exhausted, status = _exhaustion_scenario(classify=True)
+    assert exhausted == 0, "classified-transient errors must not burn the budget"
+    assert common.is_running(status)
+    assert status.replica_statuses["Worker"].active == 1
+
+
+def _flap_scenario(backoff_base):
+    """A worker that dies with SIGKILL seconds after every start — the
+    crash-loop.  Returns how many pods the operator churned through."""
+    inner, clock, inj, mgr, _ = make_harness(2, backoff_base=backoff_base)
+    for t in range(8, 88, 4):
+        inj.at(
+            t,
+            lambda: inj.kill_pod("default", "flap-worker-0", 137),
+            f"flap kill attempt",
+        )
+    inj.create("TFJob", _exitcode_tfjob("flap", workers=1).to_dict())
+    try:
+        run_steps(inj, mgr, steps=60, dt=2.0)  # 120s
+    finally:
+        mgr.factory.stop_all()
+    return inj.pod_creates.get("default/flap", 0)
+
+
+def test_crash_loop_backoff_stops_pod_churn():
+    """Pre-hardening, a flapping worker is deleted-and-recreated with zero
+    delay: pod churn tracks the kill rate.  With exponential crash-loop
+    backoff the churn collapses to a handful of increasingly spaced
+    recreations."""
+    churn_hot = _flap_scenario(backoff_base=0.0)
+    churn_backoff = _flap_scenario(backoff_base=20.0)
+    assert churn_hot >= 2 * churn_backoff, (churn_hot, churn_backoff)
+    assert churn_backoff <= 8, churn_backoff
+
+
+def test_restart_backoff_metric_observes_restarts():
+    metrics.RESTART_BACKOFF.reset()
+    _flap_scenario(backoff_base=20.0)
+    assert metrics.RESTART_BACKOFF.count({"kind": "TFJob"}) >= 2
+    text = metrics.RESTART_BACKOFF.expose()
+    assert "tpu_operator_restart_backoff_seconds_bucket" in text
+
+
+def test_partial_slice_teardown_in_storm_is_classified_transient():
+    """A whole-slice teardown interrupted purely by retryable apiserver
+    errors must surface as a RETRYABLE reconcile error — a storm hitting
+    pod deletion must not burn the bounded retry budget either."""
+    from tf_operator_tpu.controllers import make_engine
+    from tf_operator_tpu.engine.control import PodControl
+    from tf_operator_tpu.k8s.fake import ApiError
+
+    from tests.test_engine import reconcile, run_pods, set_phase
+
+    cluster = FakeCluster()
+
+    class StormyDeletes(PodControl):
+        def __init__(self, cluster):
+            super().__init__(cluster)
+            self.allowed = 1  # the failed pod's own delete goes through
+
+        def delete_pod(self, namespace, name, owner):
+            if self.allowed > 0:
+                self.allowed -= 1
+                return super().delete_pod(namespace, name, owner)
+            raise ApiError(503, "chaos: storm on delete")
+
+    engine = make_engine(
+        "TPUJob", cluster, pod_control=StormyDeletes(cluster)
+    )
+    job = testutil.new_tpujob("slice", accelerator_type="v4-16")  # 2 hosts
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    for p in run_pods(cluster):
+        set_phase(cluster, p, objects.POD_RUNNING, container="tpu")
+    victim = run_pods(cluster)[1]
+    set_phase(cluster, victim, objects.POD_FAILED, exit_code=137, container="tpu")
+    job, result = reconcile(cluster, engine, job)
+    assert result.error and "teardown is partial" in result.error
+    assert result.retryable, "storm-interrupted teardown must be transient"
+
+
+def test_backoff_window_survives_manager_restart():
+    """The backoff anchor is persisted status (lastRestartTime), so a brand
+    new manager over the same cluster stays in the window instead of
+    hot-recreating on its first sync."""
+    inner, clock, inj, mgr, _ = make_harness(3, backoff_base=50.0)
+    inj.at(8, lambda: inj.kill_pod("default", "anchor-worker-0", 137), "kill 1")
+    inj.at(16, lambda: inj.kill_pod("default", "anchor-worker-0", 137), "kill 2")
+    inj.create("TFJob", _exitcode_tfjob("anchor", workers=1).to_dict())
+    run_steps(inj, mgr, steps=10, dt=2.0)  # t=20: second restart just booked
+    mgr.factory.stop_all()
+    job = inner.get("TFJob", "default", "anchor")
+    rs = common.ReplicaStatus.from_dict(job["status"]["replicaStatuses"]["Worker"])
+    assert rs.restarts == 2 and rs.last_restart_time, job["status"]
+    assert inner.list_pods() == []  # mid-backoff: not recreated yet
+
+    # fresh manager, same cluster+clock: still respects the window...
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]),
+        restart_backoff_base=50.0, restart_backoff_max=120.0,
+    )
+    mgr2 = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
+    for ctl in mgr2.controllers.values():
+        ctl.queue = DeterministicQueue()
+    mgr2.factory.start_all()
+    inj.step(1.0)
+    mgr2.controllers["TFJob"].enqueue("default/anchor")
+    drain(mgr2)
+    assert inner.list_pods() == [], "restarted manager must honor the window"
+    # ...and recreates once it elapses
+    clock.advance(120.0)
+    mgr2.controllers["TFJob"].enqueue("default/anchor")
+    drain(mgr2)
+    mgr2.factory.stop_all()
+    assert len(inner.list_pods()) == 1

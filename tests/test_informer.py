@@ -139,3 +139,119 @@ def test_factory_shares_informers():
     assert f.for_kind("TFJob") is f.for_kind("TFJob")
     f.start_all()
     assert f.wait_for_cache_sync(timeout=1)
+
+
+# ------------------------------------------------- watch-drop recovery
+
+
+def _handler_log(inf):
+    seen = []
+    inf.add_event_handler(
+        ResourceEventHandler(
+            add_func=lambda o: seen.append(("add", o["metadata"]["name"])),
+            update_func=lambda old, new: seen.append(("upd", new["metadata"]["name"])),
+            delete_func=lambda o: seen.append(("del", o["metadata"]["name"])),
+        )
+    )
+    return seen
+
+
+def test_informer_relist_repairs_watch_gap_without_losing_deletes():
+    """Events lost during a watch outage (including DELETES — the ones a
+    naive cache reset silently eats) are recovered by the 410-driven
+    relist: adds as adds, changes as updates, vanished objects as deletes."""
+    from tf_operator_tpu.k8s.chaos import FaultInjector, SimClock
+
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=0, clock=clock, kubelet=False)
+    inf = SharedIndexInformer(inj, "TFJob")
+    seen = _handler_log(inf)
+    inner.create("TFJob", make_obj("stays"))
+    inner.create("TFJob", make_obj("doomed"))
+    inf.start()
+    seen.clear()
+
+    inj.schedule_watch_outage(5, 10, kinds=("TFJob",))
+    inj.step(6)  # t=6: outage active — everything below is dropped
+    inner.create("TFJob", make_obj("born-in-gap"))
+    changed = inner.get("TFJob", "default", "stays")
+    changed["spec"] = {"x": 1}
+    inner.update("TFJob", changed)
+    inner.delete("TFJob", "default", "doomed")
+    assert seen == [], "outage must drop events"
+    assert "default/doomed" in inf.cache_keys()  # cache is stale
+
+    inj.step(10)  # t=16: outage ended at 15 -> ERROR -> relist
+    assert ("add", "born-in-gap") in seen
+    assert ("upd", "stays") in seen
+    assert ("del", "doomed") in seen, "relist must NOT lose the delete"
+    assert sorted(inf.cache_keys()) == ["default/born-in-gap", "default/stays"]
+
+
+def test_informer_relist_failure_is_retried_by_resync():
+    """A relist attempted while the apiserver is still erroring stays
+    pending and the next resync retries it — recovery does not depend on a
+    second ERROR ever arriving."""
+    from tf_operator_tpu.k8s.chaos import FaultInjector, SimClock
+
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=0, clock=clock, kubelet=False)
+    inf = SharedIndexInformer(inj, "TFJob")
+    seen = _handler_log(inf)
+    inf.start()
+    inj.schedule_watch_outage(2, 4, kinds=("TFJob",))
+    inj.schedule_storm(2, 10, fault="500", ops=["list"])  # outlives the outage
+    inj.step(3)  # outage + storm active
+    inner.create("TFJob", make_obj("hidden"))
+    inj.step(4)  # t=7: outage ends -> ERROR -> relist FAILS (storm to 12)
+    assert seen == [] and inf._needs_relist
+    inf.resync_once()  # still storming: stays pending
+    assert inf._needs_relist
+    inj.step(6)  # t=13: storm over
+    inf.resync_once()  # retry succeeds
+    assert ("add", "hidden") in seen
+    assert not inf._needs_relist
+
+
+def test_relist_does_not_clobber_events_arriving_mid_list():
+    """Events landing while the relist's LIST is in flight must win over
+    the (already stale) snapshot: a concurrent create must not be
+    phantom-DELETED, and a concurrent delete must not be resurrected."""
+    from unittest import mock
+
+    cluster = FakeCluster()
+    cluster.create("TFJob", make_obj("doomed"))
+    inf = SharedIndexInformer(cluster, "TFJob")
+    seen = _handler_log(inf)
+    inf.start()
+    seen.clear()
+
+    real_list = cluster.list
+
+    def racing_list(kind, *a, **kw):
+        items = real_list(kind, *a, **kw)
+        # both races happen while the LIST is "in flight"
+        cluster.create("TFJob", make_obj("mid-race"))
+        cluster.delete("TFJob", "default", "doomed")
+        return items
+
+    with mock.patch.object(cluster, "list", side_effect=racing_list):
+        assert inf.relist()
+    assert ("del", "mid-race") not in seen, "live create phantom-deleted"
+    assert "default/mid-race" in inf.cache_keys()
+    assert "default/doomed" not in inf.cache_keys(), "delete resurrected"
+    # the live events themselves were delivered normally, exactly once
+    assert seen.count(("add", "mid-race")) == 1
+    assert seen.count(("del", "doomed")) == 1
+
+
+def test_rate_limiter_survives_thousands_of_failures():
+    """Regression for the overflow the chaos soak exposed: 2^n outgrows
+    float range after a long storm; the delay must pin at max, not raise."""
+    rl = ItemExponentialFailureRateLimiter(base_delay=0.005, max_delay=9.0)
+    for _ in range(4000):
+        delay = rl.when("stormy")
+    assert delay == 9.0
+    assert rl.num_requeues("stormy") == 4000

@@ -46,8 +46,11 @@ class StubTransport:
         self.handler = None
         self.streams = []  # scripted watch streams: list of list-of-events
 
-    def expect(self, status, body):
-        self.replies.append((status, body))
+    def expect(self, status, body, headers=None):
+        if headers is None:
+            self.replies.append((status, body))  # legacy 2-tuple shape
+        else:
+            self.replies.append((status, body, headers))
 
     def request(self, method, path, query=None, body=None):
         self.calls.append((method, path, query, body))
@@ -353,6 +356,148 @@ def test_unsubscribe_stops_loop_when_last_handler_removed():
     assert "Pod" in c._watches
     c.unsubscribe("Pod", h)
     assert "Pod" not in c._watches
+
+
+# ------------------------------------------------------------ retry layer
+class _Rng:
+    """Degenerate rng: uniform(a, b) -> b, so computed backoff is the cap
+    and assertions are exact."""
+
+    def uniform(self, a, b):
+        return b
+
+
+def retry_client(**policy_kw):
+    from tf_operator_tpu.k8s.client import RetryPolicy
+
+    t = StubTransport()
+    sleeps = []
+    c = ClusterClient(
+        t,
+        retry=RetryPolicy(**{"base_delay": 0.1, "max_delay": 5.0, **policy_kw}),
+        sleep=sleeps.append,
+        rng=_Rng(),
+    )
+    return c, t, sleeps
+
+
+def test_retry_on_500_then_success():
+    from tf_operator_tpu.engine import metrics
+
+    before = metrics.API_RETRIES.get({"reason": "500"})
+    c, t, sleeps = retry_client()
+    t.expect(500, {"message": "boom"})
+    t.expect(503, {"message": "still boom"})
+    t.expect(200, {"metadata": {"name": "p0"}})
+    assert c.get_pod("d", "p0")["metadata"]["name"] == "p0"
+    assert len(t.calls) == 3
+    # full jitter with the degenerate rng: cap = base * 2^attempt
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+    assert metrics.API_RETRIES.get({"reason": "500"}) == before + 1
+
+
+def test_retry_honors_retry_after_header():
+    c, t, sleeps = retry_client()
+    t.expect(429, {"message": "slow down"}, {"Retry-After": "3"})
+    t.expect(200, {"metadata": {"name": "p0"}})
+    c.get_pod("d", "p0")
+    assert sleeps == [3.0], "server-provided Retry-After overrides backoff"
+
+
+def test_terminal_errors_are_not_retried():
+    c, t, _ = retry_client()
+    t.expect(404, {"message": "nope"})
+    with pytest.raises(NotFoundError):
+        c.get_pod("d", "ghost")
+    assert len(t.calls) == 1
+    t.calls.clear()
+    t.expect(409, {"message": "stale"})
+    with pytest.raises(ConflictError):
+        c.update_pod(objects.make_pod("p0"))
+    assert len(t.calls) == 1, "a 409 must not be replayed verbatim"
+
+
+def test_connection_reset_is_retried():
+    c, t, sleeps = retry_client()
+    state = {"n": 0}
+
+    def flaky(method, path, query, body):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise ConnectionResetError("peer reset")
+        return 200, {"items": []}
+
+    t.handler = flaky
+    assert c.list_pods() == []
+    assert state["n"] == 2 and len(sleeps) == 1
+
+
+def test_delete_replay_after_reset_treats_404_as_success():
+    """A DELETE whose first attempt committed before the reply was lost
+    must not surface the replay's 404 as NotFoundError — the delete
+    succeeded (client-go convention).  A FIRST-attempt 404 still raises."""
+    c, t, _ = retry_client()
+    state = {"n": 0}
+
+    def committed_then_lost(method, path, query, body):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise ConnectionResetError("reply lost after commit")
+        return 404, {"message": "not found"}
+
+    t.handler = committed_then_lost
+    c.delete_pod("d", "p0")  # no raise: replayed 404 == success
+    assert state["n"] == 2
+    t.handler = lambda *a: (404, {"message": "never existed"})
+    with pytest.raises(NotFoundError):
+        c.delete_pod("d", "ghost")
+
+
+def test_retry_gives_up_after_attempt_budget():
+    c, t, sleeps = retry_client(max_attempts=3)
+    t.handler = lambda *a: (503, {"message": "down"})
+    with pytest.raises(ApiError) as e:
+        c.get_pod("d", "p0")
+    assert e.value.code == 503
+    assert len(t.calls) == 3  # initial + 2 replays
+    assert len(sleeps) == 2
+
+
+def test_retry_respects_request_deadline():
+    c, t, sleeps = retry_client(deadline=0.05, max_delay=40.0)
+    t.handler = lambda *a: (500, {"message": "down"})
+    with pytest.raises(ApiError):
+        c.get_pod("d", "p0")
+    # first computed delay (0.1) already exceeds the 50ms budget: no sleep
+    assert sleeps == [] and len(t.calls) == 1
+
+
+def test_classification_matrix():
+    from tf_operator_tpu.k8s.fake import (
+        is_retryable_api_error,
+        is_transient_api_error,
+    )
+
+    for code in (429, 500, 502, 503, 504, 408):
+        assert is_retryable_api_error(ApiError(code, "x")), code
+    for exc in (ApiError(400, "x"), NotFoundError(), ConflictError()):
+        assert not is_retryable_api_error(exc), exc
+    assert is_retryable_api_error(ConnectionResetError())
+    assert is_retryable_api_error(TimeoutError())
+    # permanent local misconfiguration must NOT look like an outage...
+    import ssl
+
+    assert not is_retryable_api_error(
+        ssl.SSLCertVerificationError("bad CA bundle")
+    )
+    assert not is_retryable_api_error(FileNotFoundError("client.key"))
+    # ...but a TLS stream dropped mid-read IS one (OSError, yet neither
+    # ConnectionError nor a cert problem)
+    assert is_retryable_api_error(ssl.SSLEOFError("EOF in violation"))
+    # conflicts ARE transient at workqueue level (fresh reconcile cures)
+    assert is_transient_api_error(ConflictError())
+    assert not is_transient_api_error(NotFoundError())
+    assert not is_transient_api_error(ValueError("not an api error"))
 
 
 # ------------------------------------------------------------- kubeconfig

@@ -210,6 +210,11 @@ class ReplicaStatus:
     # labelSelectorPath points here so the HPA can find the pods behind the
     # count (upstream training-operator does the same)
     selector: Optional[str] = None
+    # when the operator last deleted this type's pod(s) for an ExitCode
+    # restart — the crash-loop backoff anchor.  Persisted in status so a
+    # restarted controller does not forget it is mid-backoff and hot-loop
+    # a flapping replica (engine/controller.py restart backoff).
+    last_restart_time: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"active": self.active, "succeeded": self.succeeded, "failed": self.failed}
@@ -217,6 +222,8 @@ class ReplicaStatus:
             d["restarts"] = self.restarts
         if self.selector:
             d["selector"] = self.selector
+        if self.last_restart_time:
+            d["lastRestartTime"] = self.last_restart_time
         return d
 
     @classmethod
@@ -227,6 +234,7 @@ class ReplicaStatus:
             failed=d.get("failed", 0),
             restarts=d.get("restarts", 0),
             selector=d.get("selector"),
+            last_restart_time=d.get("lastRestartTime"),
         )
 
 

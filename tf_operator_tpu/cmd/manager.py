@@ -26,8 +26,13 @@ from tf_operator_tpu.controllers.registry import make_engine
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.engine.controller import EngineConfig
 from tf_operator_tpu.k8s import objects
-from tf_operator_tpu.k8s.fake import ApiError, NotFoundError
+from tf_operator_tpu.k8s.fake import (
+    ApiError,
+    NotFoundError,
+    is_transient_api_error,
+)
 from tf_operator_tpu.k8s.informer import (
+    ItemExponentialFailureRateLimiter,
     Lister,
     ResourceEventHandler,
     SharedIndexInformer,
@@ -40,6 +45,13 @@ MAX_RECONCILE_RETRIES = 15
 # client-go's capped-backoff semantics (workqueue maxDelay ~1000s), chosen
 # smaller so a recovered outage resumes within minutes
 EXHAUSTED_RETRY_PERIOD = 120.0
+# backoff ladder for TRANSIENT errors (client-classified 429/5xx/reset/
+# conflict).  Kept separate from the queue's rate limiter on purpose: its
+# failure counter is what num_requeues() reads for the bounded retry
+# budget, so routing storms through it would silently consume the budget
+# for later genuine errors.  Capped at apiserver-outage scale.
+TRANSIENT_RETRY_BASE = 0.05
+TRANSIENT_RETRY_MAX = 30.0
 
 
 class _KindController:
@@ -54,7 +66,10 @@ class _KindController:
             config=EngineConfig(
                 enable_gang_scheduling=manager.options.enable_gang_scheduling,
                 gang_scheduler_name=manager.options.gang_scheduler_name,
+                restart_backoff_base=manager.options.restart_backoff_base,
+                restart_backoff_max=manager.options.restart_backoff_max,
             ),
+            **manager.engine_kwargs,
         )
         # C++ work queue (native/workqueue.cc) when built, Python otherwise
         from tf_operator_tpu.native import make_queue
@@ -75,6 +90,16 @@ class _KindController:
         # defines how long the key waited), popped when a worker syncs
         self._enqueue_times: Dict[str, float] = {}
         self._enqueue_lock = threading.Lock()
+        # the transient backoff ladder: a rate limiter OF ITS OWN, distinct
+        # from the queue's (whose failure counter is the bounded retry
+        # budget num_requeues() guards); cleared on success or deletion
+        self._transient_limiter = ItemExponentialFailureRateLimiter(
+            base_delay=TRANSIENT_RETRY_BASE, max_delay=TRANSIENT_RETRY_MAX
+        )
+        # keys currently held at the exhausted cadence — the exhausted
+        # counter fires once per transition into the state, not per 120s
+        # hold cycle (a single stuck job must not read as dozens)
+        self._exhausted_keys: set = set()
 
     # ------------------------------------------------------------- handlers
     def _in_scope(self, obj) -> bool:
@@ -97,26 +122,66 @@ class _KindController:
             metrics.JOBS_DELETED.inc({"job_namespace": objects.namespace_of(obj)})
             self.enqueue(objects.key_of(obj))
 
-    def enqueue(self, key: str) -> None:
+    def _stamp(self, key: str, due: float) -> None:
+        """Record when the key became (or will become) DUE for work; the
+        earliest pending stamp wins, matching client-go's dedup where the
+        oldest pending event defines the wait.  Delayed requeues stamp
+        monotonic()+delay, NOT monotonic(): a deliberate hours-long
+        requeue_after (ActiveDeadlineSeconds) or the rate limiter's backoff
+        is scheduling, not queue latency — stamping at scheduling time made
+        tpu_operator_workqueue_latency_seconds read hours of phantom wait
+        on an idle operator (ROADMAP open item, now fixed)."""
         with self._enqueue_lock:
-            self._enqueue_times.setdefault(key, time.monotonic())
+            cur = self._enqueue_times.get(key)
+            if cur is None or due < cur:
+                self._enqueue_times[key] = due
+
+    def enqueue(self, key: str) -> None:
+        self._stamp(key, time.monotonic())
         self.queue.add(key)
         self._update_depth()
 
     def _requeue_rate_limited(self, key: str) -> None:
         """Instrumented twin of enqueue() for the retry paths: requeued keys
         must be timed too — the latency histogram would otherwise go blind
-        exactly under the failure conditions it exists to surface."""
+        exactly under the failure conditions it exists to surface.  The
+        rate limiter's delay is only known after the add, so a provisional
+        now-stamp lands first (a worker racing the short first backoffs can
+        at worst observe ~0 wait) and is upgraded to the due time only if
+        no worker consumed it — a late stamp must never outlive its queue
+        entry and poison a later observation."""
+        now = time.monotonic()
+        placed = False
         with self._enqueue_lock:
-            self._enqueue_times.setdefault(key, time.monotonic())
-        self.queue.add_rate_limited(key)
+            if key not in self._enqueue_times:
+                self._enqueue_times[key] = now
+                placed = True
+        delay = self.queue.add_rate_limited(key)
+        if not isinstance(delay, (int, float)):
+            delay = 0.0  # queue double that predates the return-delay contract
+        if placed and delay > 0.0:
+            with self._enqueue_lock:
+                if self._enqueue_times.get(key) == now:
+                    self._enqueue_times[key] = now + delay
         self._update_depth()
 
     def _requeue_after(self, key: str, delay: float) -> None:
-        with self._enqueue_lock:
-            self._enqueue_times.setdefault(key, time.monotonic())
+        self._stamp(key, time.monotonic() + max(0.0, delay))
         self.queue.add_after(key, delay)
         self._update_depth()
+
+    def _requeue_transient(self, key: str) -> None:
+        """Requeue after a client-classified transient error: capped
+        exponential backoff on the dedicated transient limiter, so storms
+        never touch the queue's failure counter (= the bounded retry
+        budget num_requeues() guards for genuine errors)."""
+        self._requeue_after(key, self._transient_limiter.when(key))
+
+    def _clear_failures(self, key: str) -> None:
+        self.queue.forget(key)
+        self._transient_limiter.forget(key)
+        with self._enqueue_lock:
+            self._exhausted_keys.discard(key)
 
     def _update_depth(self) -> None:
         metrics.WORKQUEUE_DEPTH.set(len(self.queue), {"kind": self.kind})
@@ -129,15 +194,18 @@ class _KindController:
         with self._enqueue_lock:
             enqueued_at = self._enqueue_times.pop(key, None)
         if enqueued_at is not None:
+            # clamp: a delayed requeue stamps its DUE time, and a fresh
+            # event can pull the key into work before that instant
             metrics.WORKQUEUE_LATENCY.observe(
-                t0 - enqueued_at, {"kind": self.kind}
+                max(0.0, t0 - enqueued_at), {"kind": self.kind}
             )
         self._update_depth()
         try:
             raw = self.manager.cluster.get(self.kind, namespace, name)
         except NotFoundError:
-            self.queue.forget(key)
+            self._clear_failures(key)
             metrics.RUNNING_REPLICAS_TRACKER.forget(self.kind, key)
+            self.engine.forget_job(key)
             return  # deleted; nothing to reconcile
         job = self.engine.adapter.from_dict(raw)
         result = self.engine.reconcile(job)
@@ -146,7 +214,17 @@ class _KindController:
         )
         if result.error:
             metrics.SYNC_ERRORS.inc({"kind": self.kind})
-            if self.queue.num_requeues(key) < MAX_RECONCILE_RETRIES:
+            if result.retryable and self.manager.options.classify_retryable_errors:
+                # the client layer already classified this transient
+                # (429/5xx/reset/conflict): requeue with backoff but do NOT
+                # spend the bounded retry budget — an apiserver error storm
+                # must never exhaust a job's reconcile retries
+                log.warning(
+                    "transient reconcile error, requeueing without "
+                    "consuming retry budget: %s", result.error,
+                )
+                self._requeue_transient(key)
+            elif self.queue.num_requeues(key) < MAX_RECONCILE_RETRIES:
                 log.warning("reconcile error, requeueing: %s", result.error)
                 self._requeue_rate_limited(key)
             else:
@@ -159,11 +237,38 @@ class _KindController:
                     "reconcile retries exhausted, holding at max backoff: %s",
                     result.error,
                 )
+                with self._enqueue_lock:
+                    first_time = key not in self._exhausted_keys
+                    self._exhausted_keys.add(key)
+                if first_time:
+                    metrics.SYNC_RETRIES_EXHAUSTED.inc({"kind": self.kind})
                 self._requeue_after(key, EXHAUSTED_RETRY_PERIOD)
             return
-        self.queue.forget(key)
+        self._clear_failures(key)
         if result.requeue_after is not None:
             self._requeue_after(key, result.requeue_after)
+
+    def _sync_guarded(self, key: str) -> None:
+        """_sync with the worker-loop crash barrier: an exception escaping a
+        sync (e.g. the initial cluster.get during an apiserver storm) is an
+        error to requeue, never a dead worker — shared by the threaded
+        workers and the deterministic test-mode dispatch so chaos scenarios
+        exercise the same recovery path either way."""
+        try:
+            self._sync(key)
+        except Exception as e:  # noqa: BLE001 — workers must not die
+            logger_for_key(self.kind, key).error("sync panic: %s", e)
+            metrics.SYNC_ERRORS.inc({"kind": self.kind})
+            if (
+                is_transient_api_error(e)
+                and self.manager.options.classify_retryable_errors
+            ):
+                # e.g. the initial job GET during an apiserver storm —
+                # transient failures here must not consume the retry
+                # budget either
+                self._requeue_transient(key)
+            else:
+                self._requeue_rate_limited(key)
 
     def run_worker(self) -> None:
         while True:
@@ -171,11 +276,7 @@ class _KindController:
             if key is None:
                 return
             try:
-                self._sync(key)
-            except Exception as e:  # noqa: BLE001 — workers must not die
-                logger_for_key(self.kind, key).error("sync panic: %s", e)
-                metrics.SYNC_ERRORS.inc({"kind": self.kind})
-                self._requeue_rate_limited(key)
+                self._sync_guarded(key)
             finally:
                 self.queue.done(key)
                 self._update_depth()
@@ -190,9 +291,18 @@ class _KindController:
 
 
 class OperatorManager:
-    def __init__(self, cluster, options: Optional[ServerOptions] = None) -> None:
+    def __init__(
+        self,
+        cluster,
+        options: Optional[ServerOptions] = None,
+        engine_kwargs: Optional[Dict] = None,
+    ) -> None:
+        """`engine_kwargs` is forwarded to every kind's JobEngine — the seam
+        tests use to inject a simulated clock (chaos soak) or alternate
+        control objects without patching."""
         self.cluster = cluster
         self.options = options or ServerOptions()
+        self.engine_kwargs = engine_kwargs or {}
         self.factory = SharedInformerFactory(
             cluster, resync_period=self.options.resync_period
         )
@@ -267,7 +377,7 @@ class OperatorManager:
                     continue
                 busy = True
                 try:
-                    ctl._sync(key)
+                    ctl._sync_guarded(key)
                 finally:
                     ctl.queue.done(key)
             if not busy:

@@ -39,6 +39,17 @@ class ServerOptions:
     # write the reconcile span tracer's Chrome trace-event JSON here on
     # shutdown (engine/tracing.py); empty = disabled
     trace_dump: str = ""
+    # crash-loop backoff tuning for ExitCode delete-for-recreate restarts
+    # (engine/controller.py EngineConfig.restart_backoff_*); base <= 0
+    # disables the backoff
+    restart_backoff_base: float = 5.0
+    restart_backoff_max: float = 300.0
+    # when True (default), reconcile errors the client layer classified as
+    # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
+    # consuming the bounded reconcile-retry budget; False restores the
+    # pre-hardening accounting (every error burns a retry) — kept as a
+    # switch so the chaos harness can demonstrate the failure mode
+    classify_retryable_errors: bool = True
 
     @property
     def all_kinds(self) -> List[str]:
@@ -96,6 +107,15 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         help="on shutdown, write recent reconcile traces here as Chrome "
         "trace-event JSON (view in chrome://tracing); empty disables",
     )
+    p.add_argument(
+        "--restart-backoff-base",
+        type=float,
+        default=5.0,
+        help="crash-loop backoff base seconds for ExitCode delete-for-"
+        "recreate restarts (doubles per restart past the first, capped "
+        "by --restart-backoff-max); <= 0 disables",
+    )
+    p.add_argument("--restart-backoff-max", type=float, default=300.0)
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -123,4 +143,6 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         webhook_cert_file=a.webhook_cert_file,
         webhook_key_file=a.webhook_key_file,
         trace_dump=a.trace_dump,
+        restart_backoff_base=a.restart_backoff_base,
+        restart_backoff_max=a.restart_backoff_max,
     )

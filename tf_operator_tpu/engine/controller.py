@@ -25,6 +25,7 @@ from __future__ import annotations
 import calendar
 import copy
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -39,7 +40,8 @@ from tf_operator_tpu.engine.expectations import (
     gen_expectation_services_key,
 )
 from tf_operator_tpu.k8s import objects
-from tf_operator_tpu.k8s.fake import NotFoundError
+from tf_operator_tpu.k8s.fake import NotFoundError, is_transient_api_error
+from tf_operator_tpu.k8s.informer import capped_exponential
 
 # Gang-scheduling annotations (reference pod.go:223-237 / tfjob_controller.go:799-813)
 GANG_GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
@@ -74,7 +76,15 @@ REASON_PARTIAL_SLICE_TEARDOWN = "PartialSliceTeardown"
 class PartialSliceTeardown(RuntimeError):
     """Whole-slice restart could not delete every pod of the slice; the
     sync-level catch turns this into requeue-with-error so teardown retries
-    instead of silently leaving a partially-restarted slice."""
+    instead of silently leaving a partially-restarted slice.  `transient`
+    is True when EVERY failed delete was a client-classified transient
+    error (429/5xx/reset/conflict) — an apiserver storm interrupting a
+    teardown must retry on the transient ladder, not burn the bounded
+    reconcile-retry budget."""
+
+    def __init__(self, message: str, transient: bool = False) -> None:
+        super().__init__(message)
+        self.transient = transient
 
 
 def iso_from_epoch(ts: float) -> str:
@@ -89,12 +99,29 @@ def epoch_from_iso(s: str) -> float:
 class EngineConfig:
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = DEFAULT_GANG_SCHEDULER
+    # Crash-loop backoff for ExitCode delete-for-recreate restarts: the
+    # recreation of a replica type's pods is delayed by
+    #   base * 2^(restarts - free - 1)   (capped at max, +/- jitter)
+    # once the persisted restart counter exceeds `free_restarts`.  The
+    # first restart(s) stay immediate — a one-off preemption recovers at
+    # full speed; only a *flapping* replica is slowed down.  base <= 0
+    # disables the backoff entirely (the pre-hardening hot-loop behavior,
+    # kept reachable for the chaos harness's regression demonstration).
+    restart_backoff_base: float = 5.0
+    restart_backoff_max: float = 300.0
+    restart_backoff_free_restarts: int = 1
+    restart_backoff_jitter: float = 0.1
 
 
 @dataclass
 class ReconcileResult:
     requeue_after: Optional[float] = None  # seconds
     error: Optional[str] = None
+    # True when the error was classified transient by the client layer
+    # (429/5xx/reset/conflict): the manager requeues with backoff but does
+    # NOT spend the bounded reconcile-retry budget on it — an apiserver
+    # outage must not exhaust a job's retries (cmd/manager.py).
+    retryable: bool = False
 
 
 class JobEngine:
@@ -127,6 +154,14 @@ class JobEngine:
             self.expectations = ControllerExpectations(clock=clock)
         self.pod_control = pod_control or PodControl(cluster)
         self.service_control = service_control or ServiceControl(cluster)
+        # stale-read fence: highest resourceVersion seen or written per job
+        # key.  A lagging read (apiserver watch cache, chaos-injected stale
+        # window) must not drive a reconcile — acting on it deletes pods
+        # and then loses the status write to a conflict, or worse, clobbers
+        # newer status with old.  Numeric comparison is best-effort (k8s
+        # RVs are formally opaque but etcd revisions compare in practice);
+        # unparsable RVs skip the fence.
+        self._rv_seen: Dict[str, str] = {}
         # informer-style hooks: observe creations/deletions for expectations
         # (reference pkg/common/util/reconciler.go:38-157)
         cluster.subscribe("Pod", self._on_pod_event)
@@ -153,6 +188,44 @@ class JobEngine:
         used at reference tensorflow.go:158; asserted by the reference e2e
         suite pod_names_validation_tests.py)."""
         return f"{job_name}-{rtype.lower()}-{index}"
+
+    # ----------------------------------------------------- crash-loop backoff
+    def _restart_backoff_delay(self, job: Job, rtype: str, restarts: int) -> float:
+        """Backoff imposed before recreating a type's pods after its Nth
+        ExitCode restart.  Jitter is deterministic (hash of job/type/count,
+        not an RNG) so reconciles are replayable: the same job history
+        always produces the same schedule — which the seeded chaos soak
+        depends on — while distinct jobs still decorrelate."""
+        cfg = self.config
+        if cfg.restart_backoff_base <= 0:
+            return 0.0
+        n = restarts - cfg.restart_backoff_free_restarts
+        if n <= 0:
+            return 0.0
+        delay = capped_exponential(
+            cfg.restart_backoff_base, n - 1, cfg.restart_backoff_max
+        )
+        frac = zlib.crc32(f"{job.key}/{rtype}/{restarts}".encode()) / 0xFFFFFFFF
+        # jitter inside the cap: --restart-backoff-max is a contract, so at
+        # the top of the ladder jitter only ever shortens the wait
+        return min(
+            cfg.restart_backoff_max,
+            delay * (1.0 + cfg.restart_backoff_jitter * (2.0 * frac - 1.0)),
+        )
+
+    def _restart_backoff_remaining(
+        self, job: Job, rtype: str, rs: Optional[common.ReplicaStatus]
+    ) -> float:
+        """Seconds left before this type may recreate pods (0 = not in
+        backoff), anchored on the persisted lastRestartTime so it survives
+        controller restarts."""
+        if rs is None or not rs.last_restart_time or rs.restarts <= 0:
+            return 0.0
+        delay = self._restart_backoff_delay(job, rtype, rs.restarts)
+        if delay <= 0.0:
+            return 0.0
+        elapsed = self.clock() - epoch_from_iso(rs.last_restart_time)
+        return max(0.0, delay - elapsed)
 
     # ------------------------------------------------------- informer hooks
     def _expectation_key_for(self, obj: Dict[str, Any], kind: str) -> Optional[str]:
@@ -321,7 +394,40 @@ class JobEngine:
             labels={"kind": self.adapter.KIND, "phase": name},
         )
 
+    @staticmethod
+    def _rv_int(rv: Optional[str]) -> Optional[int]:
+        try:
+            return int(rv)  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            return None
+
+    def _fence_stale_read(self, job: Job) -> bool:
+        """True when this job object is OLDER than state this engine has
+        already seen or written — the sync must be retried on a fresh read
+        instead of acting on (and then writing back) stale state."""
+        rv = self._rv_int((job.metadata or {}).get("resourceVersion"))
+        if rv is None:
+            return False
+        seen = self._rv_int(self._rv_seen.get(job.key))
+        if seen is not None and seen > rv:
+            return True
+        self._rv_seen[job.key] = str(rv)
+        return False
+
+    def forget_job(self, job_key: str) -> None:
+        """Drop per-job engine memory (fence watermark) once the job is
+        gone; a recreated job starts a fresh incarnation."""
+        self._rv_seen.pop(job_key, None)
+
     def _reconcile(self, job: Job) -> ReconcileResult:
+        if self._fence_stale_read(job):
+            return ReconcileResult(
+                error=f"stale read of {job.key} "
+                f"(rv {job.metadata.get('resourceVersion')!r} older than "
+                f"last seen); requeueing for a fresh read",
+                requeue_after=1.0,
+                retryable=True,
+            )
         now_iso = iso_from_epoch(self.clock())
         status = job.status
         old_status = copy.deepcopy(status)
@@ -393,6 +499,7 @@ class JobEngine:
                 status.replica_statuses[rtype] = common.ReplicaStatus(
                     restarts=prev.restarts if prev else 0,
                     selector=self._replica_selector(job, rtype),
+                    last_restart_time=prev.last_restart_time if prev else None,
                 )
             if not common.is_suspended(status):
                 msg = f"{self.adapter.KIND} {job.name} is suspended."
@@ -450,20 +557,30 @@ class JobEngine:
         # ----- per replica type: pods + services. API errors (e.g. 409 on a
         # name held by a dying pod of an older incarnation) abort this sync
         # with an error result — controller-runtime style requeue-on-error —
-        # rather than crashing the loop.
+        # rather than crashing the loop.  Transient errors (429/5xx/reset/
+        # conflict) are flagged retryable so the manager's bounded retry
+        # budget is not spent on them.
         restarted_types: set = set()
+        requeue_candidates: List[float] = []
         try:
             for rtype, spec in replicas.items():
                 with self._phase("pod_reconcile", replica_type=rtype):
-                    self.reconcile_pods(
+                    backoff_left = self.reconcile_pods(
                         job, status, pods, rtype, spec, replicas, now_iso,
                         restarted_types,
                     )
+                if backoff_left:
+                    requeue_candidates.append(backoff_left)
                 with self._phase("service_reconcile", replica_type=rtype):
                     self.reconcile_services(job, services, rtype, spec)
         except Exception as e:  # noqa: BLE001 — any API failure requeues
             self._write_status(job, old_status)
-            return ReconcileResult(error=str(e), requeue_after=1.0)
+            return ReconcileResult(
+                error=str(e), requeue_after=1.0,
+                retryable=(
+                    is_transient_api_error(e) or getattr(e, "transient", False)
+                ),
+            )
 
         # ----- framework status rules
         if status.start_time is None:
@@ -489,11 +606,14 @@ class JobEngine:
             self._write_status(job, old_status)
 
         # requeue for ActiveDeadlineSeconds (RequeueAfter fix, SURVEY §7.4.6)
-        requeue = None
+        # and for pending crash-loop backoff windows — the soonest wakeup
+        # wins so neither deadline nor delayed recreation relies on an
+        # unrelated event arriving.
         ads = job.run_policy.active_deadline_seconds
         if ads is not None and status.start_time is not None:
             remaining = epoch_from_iso(status.start_time) + ads - self.clock()
-            requeue = max(0.0, remaining)
+            requeue_candidates.append(max(0.0, remaining))
+        requeue = min(requeue_candidates) if requeue_candidates else None
         return ReconcileResult(requeue_after=requeue)
 
     # ------------------------------------------------------------- pods
@@ -507,30 +627,44 @@ class JobEngine:
         replicas: Dict[str, common.ReplicaSpec],
         now_iso: str,
         restarted_types: Optional[set] = None,
-    ) -> None:
+    ) -> Optional[float]:
         """Per-replica-type pod reconciliation: create missing indices, delete
         out-of-range (dynamic scale down), exit-code restart handling, replica
         status counting (reference tfjob_controller.go:644-740). Types whose
         pods were deleted-for-restart this sync are added to
-        `restarted_types` for the status rules."""
+        `restarted_types` for the status rules.
+
+        Returns the remaining crash-loop backoff when pod creation was
+        deferred by it (the caller requeues for that instant), else None."""
         typed = self.filter_for_replica_type(pods, rtype)
         num_replicas = spec.replicas or 0
         # initializeReplicaStatuses (reference status.go:244-249) — the
         # persisted ExitCode restart counter survives the per-sync reset so
         # BackoffLimit can count delete-for-recreate restarts; the selector
-        # feeds the /scale subresource's labelSelectorPath (HPA)
+        # feeds the /scale subresource's labelSelectorPath (HPA); the
+        # lastRestartTime anchor survives so the crash-loop backoff keeps
+        # its place across syncs and controller restarts
         prev = status.replica_statuses.get(rtype)
+        backoff_left = self._restart_backoff_remaining(job, rtype, prev)
         status.replica_statuses[rtype] = common.ReplicaStatus(
             restarts=prev.restarts if prev else 0,
             selector=self._replica_selector(job, rtype),
+            last_restart_time=prev.last_restart_time if prev else None,
         )
         restarted_this_pass = False
+        creation_deferred = False
 
         slices = self.get_slices(typed, num_replicas)
         for index, pod_slice in enumerate(slices):
             if len(pod_slice) > 1:
                 continue  # too many pods for index; wait for deletion to settle
             if len(pod_slice) == 0:
+                if backoff_left > 0.0:
+                    # mid-backoff after a delete-for-recreate: a flapping
+                    # replica must not hot-loop pod churn — recreation waits
+                    # out the window, surfaced to the caller as requeue_after
+                    creation_deferred = True
+                    continue
                 master_role = self.adapter.is_master_role(replicas, rtype, index)
                 self._create_new_pod(job, rtype, index, spec, master_role, replicas)
                 continue
@@ -580,7 +714,14 @@ class JobEngine:
                     status, common.JOB_RESTARTING, REASON_RESTARTING, msg, now_iso
                 )
                 metrics.JOBS_RESTARTED.inc({"job_namespace": job.namespace})
-                status.replica_statuses[rtype].restarts += 1
+                rs = status.replica_statuses[rtype]
+                rs.restarts += 1
+                # anchor the crash-loop backoff on this restart; the applied
+                # delay is observed by _write_status once the increment is
+                # DURABLY persisted — observing here would double-count the
+                # same restart whenever the delete or status write fails and
+                # the sync retries
+                rs.last_restart_time = now_iso
                 restarted_this_pass = True
                 if restarted_types is not None:
                     restarted_types.add(rtype)
@@ -602,6 +743,7 @@ class JobEngine:
         # reference restarts pods individually).
         if restarted_this_pass and getattr(self.adapter, "WHOLE_SLICE_RESTART", False):
             failed_deletes: List[str] = []
+            all_transient = True
             for pod_slice in self.get_slices(
                 self.filter_for_replica_type(self.get_pods_for_job(job), rtype),
                 num_replicas,
@@ -609,17 +751,20 @@ class JobEngine:
                 for pod in pod_slice:
                     try:
                         self._delete_pod_with_expectations(job, rtype, pod)
-                    except Exception:
+                    except Exception as de:  # noqa: BLE001
                         # keep deleting the rest of the slice — one stuck pod
                         # must not leave the others running — then surface the
                         # partial teardown loudly below
                         failed_deletes.append(objects.name_of(pod))
+                        all_transient &= is_transient_api_error(de)
             # counts no longer reflect reality; reset for this pass (the
             # restart counter is history, not a count of live pods — keep it;
-            # the selector feeds /scale's labelSelectorPath — keep it too)
+            # the selector feeds /scale's labelSelectorPath — keep it too;
+            # lastRestartTime anchors the backoff — keep it)
             status.replica_statuses[rtype] = common.ReplicaStatus(
                 restarts=status.replica_statuses[rtype].restarts,
                 selector=self._replica_selector(job, rtype),
+                last_restart_time=status.replica_statuses[rtype].last_restart_time,
             )
             if failed_deletes:
                 # A partially-torn-down slice is exactly the state whole-slice
@@ -633,7 +778,8 @@ class JobEngine:
                 self.cluster.record_event(
                     job.to_dict(), "Warning", REASON_PARTIAL_SLICE_TEARDOWN, msg
                 )
-                raise PartialSliceTeardown(msg)
+                raise PartialSliceTeardown(msg, transient=all_transient)
+        return backoff_left if creation_deferred else None
 
     def _delete_pod_with_expectations(self, job: Job, rtype: str, pod) -> None:
         """Expectation-guarded pod delete, shared by scale-down, exit-code
@@ -1020,7 +1166,9 @@ class JobEngine:
 
     # ------------------------------------------------------------ status io
     def _write_status(self, job: Job, old_status: common.JobStatus) -> None:
-        """Status().Update only on diff (reference tfjob_controller.go:510-537)."""
+        """Status().Update only on diff (reference tfjob_controller.go:510-537).
+        A successful write advances the stale-read fence so later syncs can
+        tell a lagging read from fresh state."""
         if job.status.to_dict() == old_status.to_dict():
             return
         try:
@@ -1030,4 +1178,19 @@ class JobEngine:
         current["status"] = job.status.to_dict()
         # also persist defaulted spec? The reference defaults in-memory only;
         # we match that: only status is written back.
-        self.cluster.update(self.adapter.KIND, current)
+        written = self.cluster.update(self.adapter.KIND, current)
+        rv = (written or {}).get("metadata", {}).get("resourceVersion")
+        if self._rv_int(rv) is not None:
+            self._rv_seen[job.key] = rv
+        # crash-loop backoff observations happen HERE, per durably persisted
+        # restart-counter increment, so _count tracks real restarts exactly
+        # even when a failed delete/write makes the sync replay (old_status
+        # is the fresh read, i.e. the previously persisted state)
+        for rtype, rs in job.status.replica_statuses.items():
+            prev = old_status.replica_statuses.get(rtype)
+            prev_n = prev.restarts if prev else 0
+            for n in range(prev_n + 1, rs.restarts + 1):
+                metrics.RESTART_BACKOFF.observe(
+                    self._restart_backoff_delay(job, rtype, n),
+                    {"kind": self.adapter.KIND},
+                )

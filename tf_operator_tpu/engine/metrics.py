@@ -247,6 +247,29 @@ CONTROL_OPS = Counter(
     f"{PREFIX}_control_operations_total",
     "Pod/Service create/delete operations issued by the control layer",
 )
+RESTART_BACKOFF = Histogram(
+    f"{PREFIX}_restart_backoff_seconds",
+    "Crash-loop backoff applied to ExitCode delete-for-recreate restarts "
+    "(0 = free restart within the grace budget); one observation per "
+    "restart, so _count tracks restarts and _sum the delay imposed",
+    buckets=(0.0, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0),
+)
+API_RETRIES = Counter(
+    f"{PREFIX}_api_request_retries_total",
+    "ClusterClient requests replayed after a retryable apiserver error "
+    "(429/5xx/connection reset), labeled by the error class",
+)
+WATCH_RESTARTS = Counter(
+    f"{PREFIX}_watch_restarts_total",
+    "Watch streams re-established after a drop, labeled by kind and "
+    "reason (gone = 410 resourceVersion expiry forcing a relist, "
+    "error = transport loss resuming from the last resourceVersion)",
+)
+SYNC_RETRIES_EXHAUSTED = Counter(
+    f"{PREFIX}_sync_retries_exhausted_total",
+    "Reconcile keys that burned the bounded retry budget on "
+    "non-transient errors and fell back to the flat max-backoff cadence",
+)
 
 
 class ReplicaGaugeTracker:

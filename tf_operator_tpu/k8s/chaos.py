@@ -1,0 +1,501 @@
+"""Chaos harness — deterministic, seeded fault injection for the operator.
+
+The operator's value proposition is surviving the failure modes that kill
+distributed TPU training: preempted slices, flaky apiservers, dropped watch
+streams.  Nothing can be trusted to survive what cannot be provoked, so this
+module provokes all of it, on demand and reproducibly:
+
+  - **API error storms**: scheduled windows during which cluster operations
+    fail with 429 (carrying Retry-After), 5xx, 409 conflicts, or connection
+    resets — exercising the retry/classification layer in k8s/client.py and
+    the manager's transient-error requeue policy.
+  - **Stale reads**: get/list return one-write-behind copies with outdated
+    resourceVersions, so optimistic-concurrency conflicts happen exactly the
+    way a lagging apiserver cache causes them.
+  - **Watch outages**: subscriber events are silently dropped for a window,
+    then a 410-style ``("ERROR", {...})`` delivery forces consumers
+    (SharedIndexInformer.relist) to repair by list+diff — the same contract a
+    real watch 410 Gone imposes.
+  - **Pod-level chaos**: preemptions (SIGKILL/137), OOM kills, node drains,
+    plus a minimal chaos kubelet that marks created pods Running, so whole
+    job lifecycles run against the fake cluster with no real containers.
+
+Everything fires from an explicit schedule keyed to a **simulated clock**
+advanced by :meth:`FaultInjector.step` — no real sleeps anywhere — and the
+injector's event log is a pure function of the seed and schedule: two runs of
+the same scenario produce byte-identical logs (asserted by tests/test_chaos.py).
+
+``FaultInjector`` presents the same surface it wraps (the FakeCluster /
+ClusterClient interface), so it composes transparently: the manager, engine,
+informers, and SDK run against it unmodified.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+from dataclasses import dataclass, field
+from random import Random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import ApiError, ConflictError, NotFoundError
+from tf_operator_tpu.k8s.informer import (
+    ItemExponentialFailureRateLimiter,
+    RateLimitingQueue,
+)
+
+
+class SimClock:
+    """Injectable simulated time: callable like time.time, advanced
+    explicitly.  Handed to the engine (JobEngine(clock=...)) and the
+    injector so expectation TTLs, ActiveDeadlineSeconds, and crash-loop
+    backoff all march to the same deterministic beat.  Starts at epoch 0
+    so scenario schedules read as plain elapsed seconds."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class DeterministicQueue(RateLimitingQueue):
+    """RateLimitingQueue whose delays all collapse to immediate adds: pop
+    order becomes a pure function of add order (no timer threads firing on
+    real wall-clock), which seeded chaos runs need to replay identically.
+    Failure counts still accrue so num_requeues-based cap logic behaves."""
+
+    def __init__(self) -> None:
+        super().__init__(ItemExponentialFailureRateLimiter(base_delay=0.0))
+
+    def add_after(self, item: Any, delay: float) -> None:  # noqa: ARG002
+        self.add(item)
+
+    def add_rate_limited(self, item: Any) -> float:
+        self._rate_limiter.when(item)  # count the failure
+        self.add(item)
+        return 0.0
+
+
+@dataclass
+class _Storm:
+    start: float
+    end: float
+    fault: str  # "429" | "500" | "502" | "503" | "504" | "conflict" | "reset" | "stale"
+    ops: Optional[frozenset] = None  # None = all of create/get/update/delete/list
+    kinds: Optional[frozenset] = None  # None = every kind
+    retry_after: Optional[float] = None  # attached to 429/503 errors
+
+
+@dataclass(order=True)
+class _Scheduled:
+    at: float
+    seq: int
+    label: str = field(compare=False)
+    fn: Callable[[], None] = field(compare=False)
+
+
+class FaultInjector:
+    """Wraps a FakeCluster (or anything with the same client surface) and
+    injects scheduled faults.  See module docstring for the fault classes.
+
+    The public bookkeeping consumed by soak assertions:
+      - ``log``: deterministic event log of every scheduled action fired
+      - ``stats``: counters of injected faults / dropped watch events
+      - ``retryable_kills`` / ``permanent_kills``: per (job_key, replica_type)
+        pod kills, for matching against persisted restart counters
+      - ``pod_creates``: per job_key count of pod creations that got through
+        (the hot-loop churn measurement)
+    """
+
+    _OPS = frozenset({"create", "get", "update", "delete", "list"})
+
+    def __init__(
+        self,
+        inner,
+        seed: int = 0,
+        clock: Optional[SimClock] = None,
+        kubelet: bool = True,
+        pod_start_delay: float = 1.0,
+        nodes: int = 4,
+    ) -> None:
+        self.inner = inner
+        self.clock = clock or SimClock()
+        self.rng = Random(seed)
+        self.kubelet = kubelet
+        self.pod_start_delay = pod_start_delay
+        self.nodes = nodes
+        self.log: List[str] = []
+        self.stats: Dict[str, int] = {}
+        self.retryable_kills: Dict[Tuple[str, str], int] = {}
+        self.permanent_kills: Dict[Tuple[str, str], int] = {}
+        self.pod_creates: Dict[str, int] = {}
+        self._storms: List[_Storm] = []
+        self._outages: List[Tuple[float, float, frozenset]] = []
+        self._schedule: List[_Scheduled] = []
+        self._seq = 0
+        self._node_rr = 0
+        # (kind, ns/name) -> the object version just superseded by an update
+        # (strictly older resourceVersion than stored) — the stale-read pool
+        self._prev: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # original handler -> gate-wrapped handler, per kind (unsubscribe
+        # must unregister the same callable that was registered)
+        self._subs: Dict[str, List[Tuple[Callable, Callable]]] = {}
+        self._lock = threading.RLock()
+        if kubelet:
+            self.inner.subscribe("Pod", self._kubelet_on_pod)
+
+    # ----------------------------------------------------------- bookkeeping
+    def _count(self, what: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[what] = self.stats.get(what, 0) + n
+
+    def _log(self, line: str) -> None:
+        self.log.append(line)
+
+    @staticmethod
+    def _job_of(pod: Dict[str, Any]) -> Optional[Tuple[str, str]]:
+        labels = objects.labels_of(pod)
+        job = labels.get(objects.LABEL_JOB_NAME)
+        rtype = labels.get(objects.LABEL_REPLICA_TYPE)
+        if not job or not rtype:
+            return None
+        return f"{objects.namespace_of(pod)}/{job}", rtype
+
+    # ------------------------------------------------------------- schedule
+    def at(self, t: float, fn: Callable[[], None], label: str) -> None:
+        """Schedule `fn` at simulated time `t` (absolute); fired by step()."""
+        self._seq += 1
+        heapq.heappush(self._schedule, _Scheduled(t, self._seq, label, fn))
+
+    def after(self, dt: float, fn: Callable[[], None], label: str) -> None:
+        self.at(self.clock() + dt, fn, label)
+
+    def step(self, dt: float = 1.0) -> None:
+        """Advance the simulated clock and fire everything that came due, in
+        (time, schedule-order) order — the single source of chaos, so the
+        event log replays identically for a given seed + schedule."""
+        self.clock.advance(dt)
+        now = self.clock()
+        while self._schedule and self._schedule[0].at <= now:
+            item = heapq.heappop(self._schedule)
+            self._log(f"t={item.at:g} {item.label}")
+            item.fn()
+
+    def run_until(self, t: float, dt: float = 1.0) -> None:
+        while self.clock() < t:
+            self.step(dt)
+
+    # ------------------------------------------------------------- storms
+    def schedule_storm(
+        self,
+        start: float,
+        duration: float,
+        fault: str = "500",
+        ops: Optional[List[str]] = None,
+        kinds: Optional[List[str]] = None,
+        retry_after: Optional[float] = None,
+    ) -> None:
+        """All matching API calls in [start, start+duration) fail with
+        `fault` (429/5xx/conflict/reset) or return stale data (fault="stale").
+        Times are absolute simulated seconds."""
+        storm = _Storm(
+            start=start,
+            end=start + duration,
+            fault=fault,
+            ops=frozenset(ops) if ops else None,
+            kinds=frozenset(kinds) if kinds else None,
+            retry_after=retry_after,
+        )
+        self._storms.append(storm)
+        scope = ",".join(sorted(storm.ops)) if storm.ops else "*"
+        self.at(start, lambda: None, f"storm_begin fault={fault} ops={scope}")
+        self.at(storm.end, lambda: None, f"storm_end fault={fault}")
+
+    def schedule_watch_outage(
+        self, start: float, duration: float, kinds: Tuple[str, ...] = ("Pod", "Service")
+    ) -> None:
+        """Watch events for `kinds` are silently dropped in [start,
+        start+duration); at the end every subscriber receives a 410-style
+        ERROR so it can repair by relist (informers) or ignore it (handlers
+        that only react to ADDED/DELETED, like expectation observers — their
+        losses are healed by expectation TTL expiry)."""
+        window = (start, start + duration, frozenset(kinds))
+        self._outages.append(window)
+        self.at(start, lambda: None, f"watch_outage_begin kinds={','.join(sorted(kinds))}")
+        self.at(
+            start + duration,
+            lambda: self._end_watch_outage(kinds),
+            f"watch_outage_end kinds={','.join(sorted(kinds))}",
+        )
+
+    def _watch_blocked(self, kind: str) -> bool:
+        now = self.clock()
+        return any(s <= now < e and kind in ks for (s, e, ks) in self._outages)
+
+    def _end_watch_outage(self, kinds: Tuple[str, ...]) -> None:
+        err = {"code": 410, "reason": "chaos watch outage"}
+        with self._lock:
+            targets = [
+                wrapped
+                for kind in kinds
+                for (_h, wrapped) in self._subs.get(kind, [])
+            ]
+        for wrapped in targets:
+            wrapped("ERROR", dict(err))
+
+    def _fault(self, op: str, kind: str) -> Optional[str]:
+        """Raise the active storm's error for this op, or return "stale" for
+        a stale-read window, or None when the path is clear."""
+        now = self.clock()
+        for s in self._storms:
+            if not (s.start <= now < s.end):
+                continue
+            if s.ops is not None and op not in s.ops:
+                continue
+            if s.kinds is not None and kind not in s.kinds:
+                continue
+            self._count(f"fault.{s.fault}")
+            if s.fault == "stale":
+                return "stale"
+            if s.fault == "conflict":
+                raise ConflictError(f"chaos: injected conflict on {op} {kind}")
+            if s.fault == "reset":
+                raise ConnectionResetError(f"chaos: connection reset on {op} {kind}")
+            raise ApiError(
+                int(s.fault),
+                f"chaos: injected {s.fault} on {op} {kind}",
+                retry_after=s.retry_after,
+            )
+        return None
+
+    # --------------------------------------------------------- pod chaos
+    def _kubelet_on_pod(self, event_type: str, pod: Dict[str, Any]) -> None:
+        if event_type != "ADDED":
+            return
+        ns, name = objects.namespace_of(pod), objects.name_of(pod)
+        self.after(
+            self.pod_start_delay,
+            lambda: self._mark_running(ns, name),
+            f"kubelet_start pod={ns}/{name}",
+        )
+
+    def _mark_running(self, namespace: str, name: str) -> None:
+        try:
+            pod = self.inner.get_pod(namespace, name)
+        except (NotFoundError, ApiError):
+            return
+        if objects.pod_phase(pod) not in ("", None, "Pending"):
+            return  # already progressed (or chaos got there first)
+        containers = pod.get("spec", {}).get("containers", []) or [{}]
+        cname = containers[0].get("name", "main")
+        self._node_rr += 1
+        pod.setdefault("status", {})
+        pod["status"]["phase"] = objects.POD_RUNNING
+        pod["status"]["containerStatuses"] = [
+            {"name": cname, "state": {"running": {}}, "restartCount": 0}
+        ]
+        pod["spec"]["nodeName"] = f"chaos-node-{self._node_rr % self.nodes}"
+        try:
+            self.inner.update_pod(pod)
+        except (ConflictError, NotFoundError, ApiError):
+            pass  # lost a race with a concurrent writer; next event retries
+
+    def kill_pod(
+        self, namespace: str, name: str, exit_code: int = 137,
+        reason: str = "Preempted",
+    ) -> bool:
+        """Terminate a running pod with `exit_code` (137 = SIGKILL class:
+        preemption/OOM; 1-127 = permanent user error).  Books the kill
+        against the owning job's replica type for the restart-counter
+        invariant.  Returns False when the pod is not currently Running."""
+        try:
+            pod = self.inner.get_pod(namespace, name)
+        except (NotFoundError, ApiError):
+            self._count("kill.miss")
+            return False
+        if objects.pod_phase(pod) != objects.POD_RUNNING:
+            self._count("kill.miss")
+            return False
+        containers = pod.get("spec", {}).get("containers", []) or [{}]
+        cname = containers[0].get("name", "main")
+        pod["status"]["phase"] = objects.POD_FAILED
+        pod["status"]["reason"] = reason
+        pod["status"]["containerStatuses"] = [{
+            "name": cname,
+            "state": {"terminated": {"exitCode": exit_code, "reason": reason}},
+            "restartCount": 0,
+        }]
+        try:
+            self.inner.update_pod(pod)
+        except (ConflictError, NotFoundError):
+            self._count("kill.miss")
+            return False
+        owner = self._job_of(pod)
+        if owner is not None:
+            book = (
+                self.retryable_kills if exit_code >= 128 else self.permanent_kills
+            )
+            with self._lock:
+                book[owner] = book.get(owner, 0) + 1
+        self._count("kill.hit")
+        self._log(
+            f"t={self.clock():g} kill pod={namespace}/{name} "
+            f"code={exit_code} reason={reason}"
+        )
+        return True
+
+    def running_pods(self) -> List[Dict[str, Any]]:
+        return sorted(
+            (
+                p
+                for p in self.inner.list_pods()
+                if objects.pod_phase(p) == objects.POD_RUNNING
+            ),
+            key=objects.key_of,
+        )
+
+    def kill_random_running_pod(
+        self, exit_code: int = 137, reason: str = "Preempted"
+    ) -> Optional[str]:
+        """Kill one seeded-random Running pod (sorted candidate list keeps
+        the choice a function of cluster state + seed, not dict order)."""
+        pods = self.running_pods()
+        if not pods:
+            self._count("kill.miss")
+            return None
+        pod = pods[self.rng.randrange(len(pods))]
+        ns, name = objects.namespace_of(pod), objects.name_of(pod)
+        self.kill_pod(ns, name, exit_code=exit_code, reason=reason)
+        return f"{ns}/{name}"
+
+    def drain_node(self, node: str) -> int:
+        """Node drain: every Running pod bound to `node` dies with 137
+        (preemption-class), like a TPU host reclaim."""
+        n = 0
+        for pod in self.running_pods():
+            if pod.get("spec", {}).get("nodeName") == node:
+                if self.kill_pod(
+                    objects.namespace_of(pod), objects.name_of(pod),
+                    exit_code=137, reason="NodeDrain",
+                ):
+                    n += 1
+        self._log(f"t={self.clock():g} drain node={node} killed={n}")
+        return n
+
+    # ------------------------------------------------- intercepted surface
+    def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._fault("create", kind)
+        out = self.inner.create(kind, obj)
+        if kind == "Pod":
+            owner = self._job_of(out)
+            if owner is not None:
+                with self._lock:
+                    self.pod_creates[owner[0]] = (
+                        self.pod_creates.get(owner[0], 0) + 1
+                    )
+        return out
+
+    def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        mode = self._fault("get", kind)
+        out = self.inner.get(kind, namespace, name)
+        if mode == "stale":
+            prev = self._prev.get((kind, f"{namespace}/{name}"))
+            if prev is not None:
+                self._count("stale.get")
+                return objects.fast_deepcopy(prev)
+        return out
+
+    def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._fault("update", kind)
+        key = objects.key_of(obj)
+        try:
+            superseded = self.inner.get(
+                kind, objects.namespace_of(obj), objects.name_of(obj)
+            )
+        except (NotFoundError, ApiError):
+            superseded = None
+        out = self.inner.update(kind, obj)
+        if superseded is not None:
+            self._prev[(kind, key)] = superseded
+        return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._fault("delete", kind)
+        self.inner.delete(kind, namespace, name)
+        self._prev.pop((kind, f"{namespace}/{name}"), None)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        mode = self._fault("list", kind)
+        items = self.inner.list(kind, namespace, selector)
+        if mode == "stale":
+            out = []
+            for item in items:
+                prev = self._prev.get((kind, objects.key_of(item)))
+                if prev is not None:
+                    self._count("stale.list")
+                    out.append(objects.fast_deepcopy(prev))
+                else:
+                    out.append(item)
+            return out
+        return items
+
+    # typed sugar routes through the generic ops so faults apply uniformly
+    def create_pod(self, pod):
+        return self.create("Pod", pod)
+
+    def get_pod(self, namespace, name):
+        return self.get("Pod", namespace, name)
+
+    def update_pod(self, pod):
+        return self.update("Pod", pod)
+
+    def delete_pod(self, namespace, name):
+        self.delete("Pod", namespace, name)
+
+    def list_pods(self, namespace=None, selector=None):
+        return self.list("Pod", namespace, selector)
+
+    def create_service(self, svc):
+        return self.create("Service", svc)
+
+    def delete_service(self, namespace, name):
+        self.delete("Service", namespace, name)
+
+    def list_services(self, namespace=None, selector=None):
+        return self.list("Service", namespace, selector)
+
+    # ------------------------------------------------------------- watches
+    def subscribe(self, kind: str, handler: Callable) -> None:
+        def gated(event_type: str, obj: Dict[str, Any]) -> None:
+            if event_type != "ERROR" and self._watch_blocked(kind):
+                self._count(f"watch.dropped.{kind}")
+                return
+            handler(event_type, obj)
+
+        with self._lock:
+            self._subs.setdefault(kind, []).append((handler, gated))
+        self.inner.subscribe(kind, gated)
+
+    def unsubscribe(self, kind: str, handler: Callable) -> None:
+        with self._lock:
+            pairs = self._subs.get(kind, [])
+            gated = next((w for (h, w) in pairs if h is handler), None)
+            if gated is not None:
+                pairs.remove((handler, gated))
+        if gated is not None:
+            self.inner.unsubscribe(kind, gated)
+
+    # ------------------------------------------------------------ passthrough
+    def __getattr__(self, name: str):
+        # everything not intercepted (record_event, events_for, pod logs,
+        # gc flag, ...) is the inner cluster's business
+        return getattr(self.inner, name)

@@ -24,18 +24,63 @@ from __future__ import annotations
 import base64
 import json
 import os
+import random
 import ssl
 import tempfile
 import threading
+import time
 from dataclasses import dataclass
 from http.client import HTTPConnection, HTTPSConnection
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
 
+from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.k8s import objects
-from tf_operator_tpu.k8s.fake import ApiError, ConflictError, NotFoundError
+from tf_operator_tpu.k8s.informer import capped_exponential
+from tf_operator_tpu.k8s.fake import (
+    ApiError,
+    ConflictError,
+    NotFoundError,
+    is_retryable_api_error,
+    is_transient_api_error,  # noqa: F401 — re-exported: the classification
+    # the manager consumes lives conceptually in this layer
+)
 
 EventHandler = Callable[[str, Dict[str, Any]], None]
+
+
+# -------------------------------------------------------------------- retry
+@dataclass
+class RetryPolicy:
+    """Transport retry tuning: exponential backoff with FULL jitter
+    (delay ~ U(0, min(max, base * 2^attempt)) — AWS-style, so a fleet of
+    operators hammered by the same outage does not reconverge in lockstep),
+    bounded by both an attempt budget and a per-request wall-clock deadline.
+    A server-provided Retry-After overrides the computed delay."""
+
+    base_delay: float = 0.2
+    max_delay: float = 10.0
+    max_attempts: int = 6
+    deadline: float = 30.0  # per-request budget incl. sleeps, seconds
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        return rng.uniform(
+            0.0, capped_exponential(self.base_delay, attempt, self.max_delay)
+        )
+
+
+def _retry_after_from(headers: Optional[Dict[str, str]]) -> Optional[float]:
+    """Parse a Retry-After header (delta-seconds form only; HTTP-date is
+    not worth a date parser here) into seconds."""
+    if not headers:
+        return None
+    for k, v in headers.items():
+        if k.lower() == "retry-after":
+            try:
+                return max(0.0, float(v))
+            except (TypeError, ValueError):
+                return None
+    return None
 
 
 # --------------------------------------------------------------------- kinds
@@ -268,8 +313,12 @@ class HttpTransport:
         path: str,
         query: Optional[Dict[str, str]] = None,
         body: Optional[Dict[str, Any]] = None,
-    ) -> Tuple[int, Any]:
-        """One apiserver round trip -> (status_code, decoded JSON | raw str)."""
+    ) -> Tuple[int, Any, Dict[str, str]]:
+        """One apiserver round trip -> (status, decoded JSON | raw str,
+        response headers).  The headers carry Retry-After on 429/503, which
+        the client's retry layer honors; transports that predate the
+        3-tuple (test stubs) may still return 2-tuples — consumers unpack
+        defensively."""
         if query:
             path = f"{path}?{urlencode(query)}"
         conn = self._connect(self.timeout)
@@ -278,10 +327,11 @@ class HttpTransport:
             conn.request(method, path, body=payload, headers=self._headers(body is not None))
             resp = conn.getresponse()
             raw = resp.read()
+            headers = dict(resp.headers.items())
             ctype = resp.headers.get("Content-Type", "")
             if "json" in ctype:
-                return resp.status, json.loads(raw) if raw else None
-            return resp.status, raw.decode(errors="replace")
+                return resp.status, json.loads(raw) if raw else None, headers
+            return resp.status, raw.decode(errors="replace"), headers
         finally:
             conn.close()
 
@@ -329,13 +379,26 @@ class HttpTransport:
 
 
 # --------------------------------------------------------------------- client
-def _raise_for(status: int, body: Any, context: str) -> None:
+def _error_for(
+    status: int, body: Any, context: str,
+    headers: Optional[Dict[str, str]] = None,
+) -> ApiError:
     message = body.get("message", str(body)) if isinstance(body, dict) else str(body)
     if status == 404:
-        raise NotFoundError(f"{context}: {message}")
+        return NotFoundError(f"{context}: {message}")
     if status == 409:
-        raise ConflictError(f"{context}: {message}")
-    raise ApiError(status, f"{context}: {message}")
+        return ConflictError(f"{context}: {message}")
+    return ApiError(
+        status, f"{context}: {message}", retry_after=_retry_after_from(headers)
+    )
+
+
+def _unpack(res) -> Tuple[int, Any, Optional[Dict[str, str]]]:
+    """Accept both transport reply shapes: (status, body) from legacy stubs
+    and (status, body, headers) from HttpTransport."""
+    status, body = res[0], res[1]
+    headers = res[2] if len(res) > 2 and isinstance(res[2], dict) else None
+    return status, body, headers
 
 
 class _WatchLoop:
@@ -421,11 +484,13 @@ class _WatchLoop:
             h(event_type, objects.fast_deepcopy(obj))
 
     def _list(self) -> Tuple[str, List[Dict[str, Any]]]:
-        status, body = self.client.transport.request(
-            "GET", resource_path(self.kind, self.client.namespace or None)
+        status, body, headers = _unpack(
+            self.client.transport.request(
+                "GET", resource_path(self.kind, self.client.namespace or None)
+            )
         )
         if status != 200:
-            _raise_for(status, body, f"watch-list {self.kind}")
+            raise _error_for(status, body, f"watch-list {self.kind}", headers)
         items = body.get("items", []) or []
         for item in items:
             item.setdefault("kind", self.kind)
@@ -468,9 +533,32 @@ class _WatchLoop:
             )
         return rv
 
+    def _reconnect_wait(self, failures: int) -> None:
+        """Exponential reconnect backoff with jitter, capped — a flat
+        cadence would turn an apiserver outage into a synchronized
+        thundering herd of relists the moment it heals."""
+        policy = self.client.retry
+        cap = capped_exponential(max(policy.base_delay, 0.2), failures, 30.0)
+        self._stop.wait(self.client._rng.uniform(cap / 2.0, cap))
+
     def _run(self) -> None:
         rv: Optional[str] = self._initial_rv
         seeded = rv is not None
+        failures = 0
+        last_failure = 0.0
+
+        def ratchet() -> None:
+            """Count a reconnect failure; isolated hiccups hours apart on a
+            QUIET kind (no events ever flow to reset the counter) must not
+            ratchet the backoff to its cap forever — the ladder restarts
+            when the previous failure is old news."""
+            nonlocal failures, last_failure
+            now = time.monotonic()
+            if now - last_failure > 300.0:
+                failures = 0
+            last_failure = now
+            failures += 1
+
         while not self._stop.is_set():
             try:
                 if rv is None:
@@ -497,23 +585,56 @@ class _WatchLoop:
                         rv = (obj.get("metadata") or {}).get("resourceVersion", rv)
                         continue
                     if etype == "ERROR":
-                        # typically 410 Gone: our resourceVersion expired
+                        # typically 410 Gone: our resourceVersion expired.
+                        # Backs off like the exception paths: churn can
+                        # expire the rv faster than we re-watch, and an
+                        # unthrottled ERROR->relist cycle is a LIST storm
+                        # against an already-struggling apiserver.
                         rv = None
+                        metrics.WATCH_RESTARTS.inc(
+                            {"kind": self.kind, "reason": "gone"}
+                        )
+                        ratchet()
+                        gone_backoff = True
                         break
                     new_rv = (obj.get("metadata") or {}).get("resourceVersion")
                     if new_rv:
                         rv = new_rv
+                    # NOTE: a delivered event does NOT reset the failure
+                    # ladder — under rv-churn every cycle delivers a few
+                    # events before its 410, and a per-event reset would
+                    # pin the backoff at its floor (ratchet()'s 300s rule
+                    # is what forgives old failures)
                     if etype in ("ADDED", "MODIFIED", "DELETED"):
                         self._dispatch(etype, obj)
+                else:
+                    gone_backoff = False
+                if gone_backoff:
+                    # drop the dead stream's connection BEFORE backing off —
+                    # sleeping inside the loop would pin the apiserver's
+                    # watch slot for the whole wait
+                    close = getattr(stream, "close", None)
+                    if close is not None:
+                        close()
+                    self._reconnect_wait(failures)
             except ApiError as e:
                 if e.code == 410:
                     rv = None  # expired: relist + diff
-                self._stop.wait(1.0)
+                metrics.WATCH_RESTARTS.inc({
+                    "kind": self.kind,
+                    "reason": "gone" if e.code == 410 else "error",
+                })
+                ratchet()
+                self._reconnect_wait(failures)
             except Exception:
                 # transport hiccough — reconnect from last good rv; if the
                 # stream constructor/protocol lost events, the next 410 (or
                 # explicit rv reset) repairs via _relist
-                self._stop.wait(1.0)
+                metrics.WATCH_RESTARTS.inc(
+                    {"kind": self.kind, "reason": "error"}
+                )
+                ratchet()
+                self._reconnect_wait(failures)
             finally:
                 with self._lock:
                     self._cancels.clear()
@@ -526,11 +647,78 @@ class ClusterClient:
     factory does (reference server.go:129, KUBEFLOW_NAMESPACE scoping);
     empty string = all namespaces."""
 
-    def __init__(self, transport, namespace: str = "") -> None:
+    def __init__(
+        self,
+        transport,
+        namespace: str = "",
+        retry: Optional[RetryPolicy] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ) -> None:
         self.transport = transport
         self.namespace = namespace
+        self.retry = retry or RetryPolicy()
+        self._sleep = sleep
+        self._rng = rng or random.Random()
         self._watches: Dict[str, _WatchLoop] = {}
         self._watch_lock = threading.Lock()
+
+    # ------------------------------------------------------------- transport
+    def _request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        body: Optional[Dict[str, Any]] = None,
+        *,
+        ok: Tuple[int, ...] = (200,),
+        context: str = "",
+        retries: bool = True,
+        replayed_404_ok: bool = False,
+    ) -> Any:
+        """One logical apiserver call with the retry layer applied: retryable
+        failures (429 honoring Retry-After, 5xx, connection resets) are
+        replayed with full-jitter exponential backoff until the policy's
+        attempt budget or per-request deadline runs out; terminal answers
+        (404/409/422...) surface immediately with FakeCluster-identical
+        exception types."""
+        policy = self.retry
+        give_up_at = time.monotonic() + policy.deadline
+        attempt = 0
+        while True:
+            err: BaseException
+            try:
+                status, rbody, headers = _unpack(
+                    self.transport.request(method, path, query=query, body=body)
+                )
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not retries or not is_retryable_api_error(e):
+                    raise
+                err = e
+            else:
+                if status in ok:
+                    return rbody
+                if status == 404 and attempt > 0 and replayed_404_ok:
+                    # a 404 on a REPLAY means the first attempt committed
+                    # before its reply was lost — for DELETE that is
+                    # success, not an error (client-go convention); a
+                    # first-attempt 404 still surfaces normally
+                    return rbody
+                err = _error_for(status, rbody, context, headers)
+                if not retries or not is_retryable_api_error(err):
+                    raise err
+            delay = getattr(err, "retry_after", None)
+            if delay is None:
+                delay = policy.backoff(attempt, self._rng)
+            attempt += 1
+            if attempt >= policy.max_attempts or (
+                time.monotonic() + delay > give_up_at
+            ):
+                raise err
+            metrics.API_RETRIES.inc(
+                {"reason": str(getattr(err, "code", "reset"))}
+            )
+            self._sleep(delay)
 
     @classmethod
     def from_kubeconfig(
@@ -568,21 +756,24 @@ class ClusterClient:
 
     # ------------------------------------------------------------- generic
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        # POST is NOT transport-retried (client-go does the same): the first
+        # attempt may have committed server-side before the reply was lost,
+        # and a blind replay turns success into 409 AlreadyExists.  The safe
+        # replay is the RECONCILE level — the manager requeues the
+        # transient error and the next sync re-lists and creates only what
+        # is actually missing.
         ns = objects.namespace_of(obj)
-        status, body = self.transport.request(
-            "POST", resource_path(kind, ns), body=obj
+        return self._request(
+            "POST", resource_path(kind, ns), body=obj,
+            ok=(200, 201), context=f"create {kind} {objects.key_of(obj)}",
+            retries=False,
         )
-        if status not in (200, 201):
-            _raise_for(status, body, f"create {kind} {objects.key_of(obj)}")
-        return body
 
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
-        status, body = self.transport.request(
-            "GET", resource_path(kind, namespace, name)
+        return self._request(
+            "GET", resource_path(kind, namespace, name),
+            context=f"get {kind} {namespace}/{name}",
         )
-        if status != 200:
-            _raise_for(status, body, f"get {kind} {namespace}/{name}")
-        return body
 
     def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         """PUT the main resource; for kinds with a status subresource also PUT
@@ -591,31 +782,27 @@ class ClusterClient:
         Stale resourceVersion surfaces as ConflictError, same as the fake."""
         ns, name = objects.namespace_of(obj), objects.name_of(obj)
         context = f"update {kind} {ns}/{name}"
-        status, body = self.transport.request(
-            "PUT", resource_path(kind, ns, name), body=obj
+        body = self._request(
+            "PUT", resource_path(kind, ns, name), body=obj, context=context
         )
-        if status != 200:
-            _raise_for(status, body, context)
         info = kind_info(kind)
         if info.has_status and "status" in obj:
             # carry the RV the main PUT returned so the status write is not
             # spuriously stale
             staged = dict(obj)
             staged["metadata"] = dict(body.get("metadata", obj.get("metadata", {})))
-            status, sbody = self.transport.request(
-                "PUT", resource_path(kind, ns, name, "status"), body=staged
+            return self._request(
+                "PUT", resource_path(kind, ns, name, "status"), body=staged,
+                context=context + " (status)",
             )
-            if status != 200:
-                _raise_for(status, sbody, context + " (status)")
-            return sbody
         return body
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        status, body = self.transport.request(
-            "DELETE", resource_path(kind, namespace, name)
+        self._request(
+            "DELETE", resource_path(kind, namespace, name),
+            ok=(200, 202), context=f"delete {kind} {namespace}/{name}",
+            replayed_404_ok=True,
         )
-        if status not in (200, 202):
-            _raise_for(status, body, f"delete {kind} {namespace}/{name}")
 
     def list(
         self,
@@ -628,11 +815,10 @@ class ClusterClient:
         sel = selector_to_query(selector)
         if sel:
             query["labelSelector"] = sel
-        status, body = self.transport.request(
-            "GET", resource_path(kind, ns), query=query or None
+        body = self._request(
+            "GET", resource_path(kind, ns), query=query or None,
+            context=f"list {kind}",
         )
-        if status != 200:
-            _raise_for(status, body, f"list {kind}")
         items = body.get("items", []) or []
         # list responses strip apiVersion/kind from items; restore kind so
         # downstream key/kind logic matches watch-delivered objects
@@ -667,11 +853,10 @@ class ClusterClient:
 
     # ------------------------------------------------------------- pod logs
     def read_pod_log(self, namespace: str, name: str) -> str:
-        status, body = self.transport.request(
-            "GET", resource_path("Pod", namespace, name, "log")
+        body = self._request(
+            "GET", resource_path("Pod", namespace, name, "log"),
+            context=f"logs {namespace}/{name}",
         )
-        if status != 200:
-            _raise_for(status, body, f"logs {namespace}/{name}")
         return body if isinstance(body, str) else json.dumps(body)
 
     # ------------------------------------------------------------- events
@@ -683,8 +868,10 @@ class ClusterClient:
         message: str,
     ) -> None:
         """POST a core/v1 Event (reference record.EventRecorder analogue —
-        SURVEY.md §5.5). Event failures are swallowed: observability must
-        never fail a reconcile."""
+        SURVEY.md §5.5). Event failures are swallowed — and NOT retried:
+        observability must never fail a reconcile, and during an apiserver
+        outage a retrying event post would stall the very teardown/restart
+        work the event describes."""
         ns = objects.namespace_of(obj)
         event = {
             "apiVersion": "v1",
@@ -708,8 +895,11 @@ class ClusterClient:
             "source": {"component": "tpu-operator"},
         }
         try:
-            self.create("Event", event)
-        except ApiError:
+            self._request(
+                "POST", resource_path("Event", ns), body=event,
+                ok=(200, 201), context="record event", retries=False,
+            )
+        except (ApiError, OSError):
             pass
 
     def events_for(

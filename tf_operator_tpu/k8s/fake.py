@@ -15,6 +15,7 @@ by expectation accounting (reference pkg/common/util/reconciler.go:38-157).
 from __future__ import annotations
 
 import fnmatch
+import ssl
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -22,10 +23,13 @@ from tf_operator_tpu.k8s import objects
 
 
 class ApiError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, retry_after: Optional[float] = None):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
+        # server-suggested backoff (Retry-After header on 429/503); honored
+        # by the retry layer in k8s/client.py over its computed backoff
+        self.retry_after = retry_after
 
 
 class NotFoundError(ApiError):
@@ -36,6 +40,41 @@ class NotFoundError(ApiError):
 class ConflictError(ApiError):
     def __init__(self, message: str = "conflict"):
         super().__init__(409, message)
+
+
+# HTTP statuses worth retrying at the transport level: throttling, server
+# faults, and timeouts.  Everything else 4xx is a terminal answer — the
+# request itself is wrong and replaying it cannot help.
+RETRYABLE_HTTP_CODES = frozenset({408, 429, 500, 502, 503, 504})
+
+
+def is_retryable_api_error(exc: BaseException) -> bool:
+    """Transport-level classification: True for errors a blind replay of the
+    same request may cure (throttling, apiserver 5xx, dropped connections).
+    404/409/422-class answers are terminal here — 409 in particular must
+    NOT be replayed verbatim (the write is stale; the caller needs a fresh
+    read first).  Deliberately NOT every OSError: a bad CA bundle or a
+    missing cert file (SSLCertVerificationError, FileNotFoundError) is a
+    permanent misconfiguration that retrying can only disguise as an
+    outage — but a TLS stream dropped mid-read (SSLEOFError and friends,
+    OSError yet not ConnectionError) is exactly an outage and must
+    retry."""
+    if isinstance(exc, ApiError):
+        return exc.code in RETRYABLE_HTTP_CODES
+    if isinstance(exc, ssl.SSLCertVerificationError):
+        return False
+    if isinstance(exc, ssl.SSLError):
+        return True
+    return isinstance(exc, (ConnectionError, TimeoutError))
+
+
+def is_transient_api_error(exc: BaseException) -> bool:
+    """Workqueue-level classification: everything retryable at the transport
+    PLUS optimistic-concurrency conflicts, which a *fresh reconcile* (re-read,
+    recompute, re-write) cures even though a verbatim replay would not.
+    Errors in this class should be requeued with backoff indefinitely rather
+    than spending the bounded reconcile-retry budget."""
+    return is_retryable_api_error(exc) or isinstance(exc, ConflictError)
 
 
 EventHandler = Callable[[str, Dict[str, Any]], None]  # (event_type, obj)

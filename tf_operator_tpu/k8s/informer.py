@@ -28,6 +28,20 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from tf_operator_tpu.k8s import objects
 
 
+def capped_exponential(base: float, n: int, cap: float) -> float:
+    """base * 2^n clamped to cap, overflow-safe for huge n — THE formula
+    behind every backoff ladder in this codebase (workqueue rate limiter,
+    watch reconnect, crash-loop restart).  The exponent clamp matters: past
+    ~2^60 the product overflows float conversion, and anything that has
+    been failing that long is pinned at the cap anyway — found by the
+    chaos soak."""
+    if base <= 0.0:
+        return 0.0
+    if n >= 60:
+        return cap
+    return min(cap, base * (2 ** n))
+
+
 class ItemExponentialFailureRateLimiter:
     """Per-item exponential backoff: base * 2^failures, capped.
     (client-go's DefaultControllerRateLimiter core, minus the token bucket —
@@ -43,7 +57,7 @@ class ItemExponentialFailureRateLimiter:
         with self._lock:
             n = self._failures.get(item, 0)
             self._failures[item] = n + 1
-        return min(self.base_delay * (2**n), self.max_delay)
+        return capped_exponential(self.base_delay, n, self.max_delay)
 
     def forget(self, item: Any) -> None:
         with self._lock:
@@ -152,8 +166,13 @@ class RateLimitingQueue:
                     continue
             self.add(ready)
 
-    def add_rate_limited(self, item: Any) -> None:
-        self.add_after(item, self._rate_limiter.when(item))
+    def add_rate_limited(self, item: Any) -> float:
+        """Returns the backoff delay applied, so callers timing queue
+        latency can stamp the key's *due* time rather than charging the
+        deliberate backoff to the latency histogram."""
+        delay = self._rate_limiter.when(item)
+        self.add_after(item, delay)
+        return delay
 
     def forget(self, item: Any) -> None:
         self._rate_limiter.forget(item)
@@ -220,6 +239,20 @@ class SharedIndexInformer:
         self._synced = False
         self._stop = threading.Event()
         self._resync_thread: Optional[threading.Thread] = None
+        self._needs_relist = False
+        # relist vs concurrent-event guard: while a relist's LIST is in
+        # flight, deletes AND upserts observed by _on_event are recorded so
+        # the stale list snapshot can neither resurrect an object deleted
+        # mid-relist nor clobber (and phantom-DELETE) one created/updated
+        # mid-relist
+        self._relisting = False
+        self._relist_deletes: set = set()
+        self._relist_upserts: Dict[str, Dict[str, Any]] = {}
+        # one relist at a time: the ERROR-dispatch thread and the resync
+        # thread's pending-repair retry would otherwise interleave and
+        # clobber the tombstone/upsert state above (plain Lock — never
+        # taken while holding self._lock, so no ordering cycle)
+        self._relist_mutex = threading.Lock()
         cluster.subscribe(kind, self._on_event)
 
     # ------------------------------------------------------------- lifecycle
@@ -247,15 +280,85 @@ class SharedIndexInformer:
         self._handlers.append(handler)
 
     def _on_event(self, event_type: str, obj: Dict[str, Any]) -> None:
+        if event_type == "ERROR":
+            # the watch layer lost events it cannot replay (410 Gone /
+            # stream gap): repair by relisting and diffing, like client-go's
+            # Reflector Replace — re-pinning without the diff would hide
+            # whatever happened during the gap forever
+            self.relist()
+            return
         key = objects.key_of(obj)
         old = None
         with self._lock:
             if event_type == "DELETED":
                 old = self._cache.pop(key, None)
+                if self._relisting:
+                    self._relist_deletes.add(key)
+                    self._relist_upserts.pop(key, None)
             else:
                 old = self._cache.get(key)
                 self._cache[key] = obj
+                if self._relisting:
+                    self._relist_upserts[key] = obj
+                    self._relist_deletes.discard(key)
         self._dispatch(event_type, obj, old)
+
+    def relist(self) -> bool:
+        """Resync the cache from an authoritative list and dispatch the
+        DIFF — new objects as adds, changed as updates, vanished as deletes
+        (delete events are exactly what a naive cache reset loses).  On a
+        failed list (the apiserver may still be erroring) the repair stays
+        pending and resync_once retries it.  Deletes and upserts observed
+        concurrently with the LIST win over the (already stale) snapshot.
+        Returns True on success."""
+        with self._relist_mutex:
+            return self._relist_locked()
+
+    def _relist_locked(self) -> bool:
+        with self._lock:
+            self._relisting = True
+            self._relist_deletes = set()
+            self._relist_upserts = {}
+        try:
+            current = self.cluster.list(self.kind)
+        except Exception:
+            with self._lock:
+                self._needs_relist = True
+                self._relisting = False
+            return False
+        with self._lock:
+            self._needs_relist = False
+            self._relisting = False
+            tombstones, self._relist_deletes = self._relist_deletes, set()
+            upserts, self._relist_upserts = self._relist_upserts, {}
+            new_cache = {
+                key: obj
+                for obj in current
+                if (key := objects.key_of(obj)) not in tombstones
+            }
+            new_cache.update(upserts)  # live events beat the snapshot
+            old_cache, self._cache = self._cache, new_cache
+            # diff computed under the lock: new_cache IS the live cache now,
+            # and concurrent events mutating it mid-iteration would raise.
+            # Dispatch itself happens outside (handlers may re-enter).
+            events = [
+                ("ADDED", obj, None)
+                for key, obj in new_cache.items()
+                if key not in old_cache
+            ]
+            events += [
+                ("MODIFIED", obj, old_cache[key])
+                for key, obj in new_cache.items()
+                if key in old_cache and old_cache[key] != obj
+            ]
+            events += [
+                ("DELETED", old, old)
+                for key, old in old_cache.items()
+                if key not in new_cache
+            ]
+        for event_type, obj, old in events:
+            self._dispatch(event_type, obj, old)
+        return True
 
     def _dispatch(
         self, event_type: str, obj: Dict[str, Any], old: Optional[Dict[str, Any]]
@@ -276,6 +379,13 @@ class SharedIndexInformer:
             self.resync_once()
 
     def resync_once(self) -> None:
+        # a watch-gap repair that failed (apiserver still erroring at
+        # relist time) is retried here, so recovery needs no further
+        # ERROR event — the periodic resync doubles as the retry loop
+        with self._lock:
+            needs = self._needs_relist
+        if needs:
+            self.relist()
         with self._lock:
             snapshot = list(self._cache.values())
         for obj in snapshot:

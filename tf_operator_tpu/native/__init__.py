@@ -187,11 +187,13 @@ class NativeRateLimitingQueue:
         finally:
             self._hd.exit()
 
-    def add_rate_limited(self, item: str) -> None:
+    def add_rate_limited(self, item: str) -> float:
+        """Returns the applied backoff delay in seconds (the C++ call
+        returns it in ms) — same contract as the Python queue."""
         if not self._hd.enter():
-            return
+            return 0.0
         try:
-            self._lib.wq_add_rate_limited(self._hd.h, item.encode())
+            return self._lib.wq_add_rate_limited(self._hd.h, item.encode()) / 1000.0
         finally:
             self._hd.exit()
 
