@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -41,6 +41,14 @@ chaos:
 
 bench:
 	python bench.py
+
+# Operator control-plane throughput on BOTH backends (in-memory store and
+# ClusterClient + REST façade), with the per-verb/kind API-request tally,
+# cached-lister hit/miss, and the rest-phase breakdown — the ISSUE 4
+# "zero steady-state LISTs" evidence, no TPU required.
+bench-scale:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_operator_scale; \
+	print(json.dumps({be: bench_operator_scale(backend=be) for be in ('fake', 'rest')}, indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

@@ -1281,6 +1281,11 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
 
     cluster, backing, close = _operator_cluster(backend)
     em.RECONCILE_DURATION.reset()
+    # per-verb/kind API tally + cached-lister hit/miss: the bench row
+    # carries the evidence that the sync hot path stopped LISTing (ISSUE 4)
+    em.API_REQUESTS.reset()
+    em.CACHED_LIST_HITS.reset()
+    em.CACHED_LIST_MISSES.reset()
     if backend == "rest":
         # measure WHERE the REST façade's time goes (parse / jsonschema
         # validate / store / watch fan-out) so the fake-vs-rest gap is a
@@ -1350,6 +1355,13 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
         kubelet_thread.join(timeout=10.0)
         manager.stop()
         close()
+    def _counter_rows(counter):
+        return {
+            " ".join(v for _, v in key): int(val)
+            for key, val in sorted(counter.samples().items())
+            if val
+        }
+
     out = {
         "backend": backend,
         "jobs": n_jobs,
@@ -1359,6 +1371,15 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
         "create_to_all_running_s": round(dt, 3),
         "jobs_per_sec": round(n_jobs / dt, 1) if dt > 0 else None,
         **_reconcile_percentiles(),
+        # {kind verb: count} — the steady-state claim made visible: pod/
+        # service "list" rows stay at the informers' startup seed instead
+        # of scaling with jobs x syncs (keys sort label-alphabetically:
+        # kind first, then verb)
+        "api_requests": _counter_rows(em.API_REQUESTS),
+        "cached_lists": {
+            "hits": _counter_rows(em.CACHED_LIST_HITS),
+            "misses": _counter_rows(em.CACHED_LIST_MISSES),
+        },
     }
     if backend == "rest":
         out["rest_breakdown"] = cluster.transport.profile_summary()

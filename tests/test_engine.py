@@ -989,3 +989,86 @@ def test_gang_backend_knob_warnings_are_symmetric():
     engine.reconcile(engine.adapter.from_dict(
         cluster.get("TFJob", "default", "test-tfjob")))
     assert len(warnings()) == 1
+
+
+# ---------------------------------------------------------------------------
+# status write-back: no GET-before-update, status subresource, conflict retry
+# ---------------------------------------------------------------------------
+
+
+def test_write_status_uses_status_verb_without_get():
+    """The read-modify-write satellite: a status change is persisted from
+    the in-hand object through the status subresource — no job GET, no
+    main-resource update — and the saved round trips are visible on
+    tpu_operator_api_requests_total."""
+    from tf_operator_tpu.engine import metrics
+
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, job.namespace, job.name)
+    )
+    before = {
+        verb: metrics.API_REQUESTS.get({"verb": verb, "kind": "TFJob"})
+        for verb in ("get", "update", "update_status")
+    }
+    engine.reconcile(fresh)  # Created condition -> status write
+    delta = {
+        verb: metrics.API_REQUESTS.get({"verb": verb, "kind": "TFJob"}) - n
+        for verb, n in before.items()
+    }
+    assert delta == {"get": 0, "update": 0, "update_status": 1}, delta
+    stored = cluster.get("TFJob", "default", "test-tfjob")
+    assert [c["type"] for c in stored["status"]["conditions"]] == ["Created"]
+
+
+def test_write_status_conflict_falls_back_to_fresh_read():
+    """A CR modified mid-sync makes the in-hand resourceVersion stale: the
+    write conflicts, and only then does the engine pay the GET it skipped —
+    re-read, overlay the computed status, retry once."""
+    from tf_operator_tpu.engine import metrics
+
+    cluster, engine = setup_engine()
+    job = submit(cluster, engine, testutil.new_tfjob(worker=1))
+    fresh = engine.adapter.from_dict(
+        cluster.get(job.kind, job.namespace, job.name)
+    )
+    # the CR changes under the sync (e.g. a user patch): in-hand rv stale
+    stored = cluster.get("TFJob", "default", "test-tfjob")
+    stored["metadata"]["labels"] = {"touched": "yes"}
+    cluster.update("TFJob", stored)
+    before_get = metrics.API_REQUESTS.get({"verb": "get", "kind": "TFJob"})
+    before_us = metrics.API_REQUESTS.get(
+        {"verb": "update_status", "kind": "TFJob"})
+    result = engine.reconcile(fresh)
+    assert result.error is None
+    # conflict path: 1 failed write + 1 fresh GET + 1 retried write
+    assert metrics.API_REQUESTS.get(
+        {"verb": "update_status", "kind": "TFJob"}) - before_us == 2
+    assert metrics.API_REQUESTS.get(
+        {"verb": "get", "kind": "TFJob"}) - before_get >= 1
+    stored = cluster.get("TFJob", "default", "test-tfjob")
+    assert any(c["type"] == "Created" for c in stored["status"]["conditions"])
+    assert stored["metadata"]["labels"] == {"touched": "yes"}, (
+        "the conflicting writer's change must survive the status retry"
+    )
+
+
+def test_write_status_never_writes_spec():
+    """Only status goes back: defaults applied in-memory during the sync
+    (e.g. replicas=1, injected ports) must not leak into the stored spec —
+    the status-subresource verb cannot touch spec by construction."""
+    cluster, engine = setup_engine()
+    job = testutil.new_tfjob(worker=1)
+    raw = job.to_dict()
+    # strip a field the defaulter would fill in-memory
+    del raw["spec"]["tfReplicaSpecs"]["Worker"]["replicas"]
+    cluster.create("TFJob", raw)
+    fresh = engine.adapter.from_dict(
+        cluster.get("TFJob", "default", "test-tfjob"))
+    engine.reconcile(fresh)
+    stored = cluster.get("TFJob", "default", "test-tfjob")
+    assert "replicas" not in stored["spec"]["tfReplicaSpecs"]["Worker"], (
+        "defaulted spec leaked into the store"
+    )
+    assert any(c["type"] == "Created" for c in stored["status"]["conditions"])

@@ -255,3 +255,351 @@ def test_rate_limiter_survives_thousands_of_failures():
         delay = rl.when("stormy")
     assert delay == 9.0
     assert rl.num_requeues("stormy") == 4000
+
+
+# --------------------------------------------------------------- indexes
+
+
+from tf_operator_tpu.k8s import objects  # noqa: E402
+
+
+def make_pod(name, job=None, ns="default", rtype="worker", index="0"):
+    labels = {}
+    if job is not None:
+        labels = {
+            objects.LABEL_GROUP_NAME: objects.GROUP_NAME,
+            objects.LABEL_JOB_NAME: job,
+            objects.LABEL_REPLICA_TYPE: rtype,
+            objects.LABEL_REPLICA_INDEX: index,
+        }
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels},
+    }
+
+
+def assert_indexes_match_rebuild(inf):
+    """The incremental indexes must be byte-identical to a from-scratch
+    rebuild of the same cache — the invariant every index bug breaks."""
+    with inf._lock:
+        ns_index, job_index = SharedIndexInformer.build_indexes(inf._cache)
+        assert inf._ns_index == ns_index
+        assert inf._job_index == job_index
+
+
+def test_indexes_track_adds_updates_deletes_and_label_moves():
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+    cluster.create("Pod", make_pod("a-0", job="a"))
+    cluster.create("Pod", make_pod("a-1", job="a", index="1"))
+    cluster.create("Pod", make_pod("b-0", job="b"))
+    cluster.create("Pod", make_pod("lonely"))  # no job label: ns index only
+    assert_indexes_match_rebuild(inf)
+
+    # label move: the pod leaves job a's bucket for job b's
+    moved = cluster.get("Pod", "default", "a-1")
+    moved["metadata"]["labels"][objects.LABEL_JOB_NAME] = "b"
+    cluster.update("Pod", moved)
+    assert_indexes_match_rebuild(inf)
+    with inf._lock:
+        assert "default/a-1" not in inf._job_index.get(("default", "a"), {})
+        assert "default/a-1" in inf._job_index[("default", "b")]
+
+    cluster.delete("Pod", "default", "a-0")
+    cluster.delete("Pod", "default", "lonely")
+    assert_indexes_match_rebuild(inf)
+    with inf._lock:
+        # empty buckets are pruned, not left as husks
+        assert ("default", "a") not in inf._job_index
+
+
+def test_lister_fast_paths_agree_with_full_scan():
+    """The index fast paths (namespace bucket, (namespace, job) bucket)
+    must return exactly what the old full-scan semantics did, for every
+    selector shape the engine uses."""
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+    for ns in ("default", "team-a"):
+        for job in ("j1", "j2"):
+            for i in range(3):
+                cluster.create(
+                    "Pod", make_pod(f"{job}-w-{i}", job=job, ns=ns, index=str(i))
+                )
+    cluster.create("Pod", make_pod("stray", ns="default"))
+    lister = Lister(inf)
+
+    def brute(namespace=None, selector=None):
+        with inf._lock:
+            items = list(inf._cache.values())
+        return sorted(
+            o["metadata"]["name"]
+            for o in items
+            if (namespace is None or objects.namespace_of(o) == namespace)
+            and (not selector or objects.selector_matches(
+                selector, objects.labels_of(o)))
+        )
+
+    gen_labels = {
+        objects.LABEL_GROUP_NAME: objects.GROUP_NAME,
+        objects.LABEL_JOB_NAME: "j1",
+    }
+    for ns, sel in (
+        ("default", gen_labels),                       # the hot-path shape
+        ("team-a", gen_labels),
+        ("default", {**gen_labels, objects.LABEL_REPLICA_TYPE: "worker"}),
+        ("default", {objects.LABEL_JOB_NAME: "nope"}),  # empty bucket
+        ("default", None),                              # namespace index
+        (None, gen_labels),                             # full scan w/ selector
+        (None, None),                                   # full scan
+    ):
+        got = sorted(o["metadata"]["name"] for o in lister.list(ns, sel))
+        assert got == brute(ns, sel), (ns, sel)
+
+
+def test_lister_copy_isolates_the_cache():
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+    cluster.create("Pod", make_pod("p", job="j"))
+    lister = Lister(inf)
+    copied = lister.list("default", {objects.LABEL_JOB_NAME: "j"}, copy=True)[0]
+    copied["metadata"]["labels"][objects.LABEL_JOB_NAME] = "mutated"
+    with inf._lock:
+        assert (
+            inf._cache["default/p"]["metadata"]["labels"][objects.LABEL_JOB_NAME]
+            == "j"
+        ), "copy=True must hand out an isolated object"
+
+
+def test_out_of_order_event_delivery_cannot_wedge_the_cache():
+    """FakeCluster notifies outside its store lock, so concurrent writers
+    can deliver events inverted.  The rv ordering guard must drop stale
+    deliveries: a late MODIFIED must not roll the cache back, and a late
+    ADDED must not resurrect a deleted object (which no later event would
+    ever correct — the wedge that flaked the suspend/resume stress test
+    when the engine started reading this cache)."""
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+
+    def pod_rv(rv, phase):
+        p = make_pod("p", job="j")
+        p["metadata"]["resourceVersion"] = str(rv)
+        p["status"] = {"phase": phase}
+        return p
+
+    inf._on_event("ADDED", pod_rv(5, "Pending"))
+    inf._on_event("MODIFIED", pod_rv(7, "Running"))
+    inf._on_event("MODIFIED", pod_rv(6, "Pending"))  # stale: delivered late
+    assert inf._cache["default/p"]["status"]["phase"] == "Running"
+
+    inf._on_event("DELETED", pod_rv(8, "Running"))
+    inf._on_event("MODIFIED", pod_rv(7, "Running"))  # late echo of rv7
+    assert "default/p" not in inf.cache_keys(), "stale upsert resurrected"
+
+    # a genuine recreate (newer rv than the tombstone) applies normally
+    inf._on_event("ADDED", pod_rv(9, "Pending"))
+    assert inf._cache["default/p"]["status"]["phase"] == "Pending"
+    assert_indexes_match_rebuild(inf)
+
+
+def test_indexes_survive_concurrent_churn_and_relists():
+    """Concurrent event delivery + relist (the watch-gap repair from PR 3)
+    must leave the indexes byte-identical to a from-scratch rebuild of the
+    final cache, and the cache equal to the authoritative store."""
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+    stop = threading.Event()
+    errors = []
+
+    def churner(worker_id):
+        try:
+            for round_no in range(40):
+                job = f"job-{worker_id}"
+                name = f"{job}-w-{round_no % 3}"
+                try:
+                    cluster.create("Pod", make_pod(name, job=job,
+                                                   index=str(round_no % 3)))
+                except Exception:
+                    pass  # already exists: update instead
+                try:
+                    pod = cluster.get("Pod", "default", name)
+                    pod["status"] = {"phase": "Running"}
+                    cluster.update("Pod", pod)
+                except Exception:
+                    pass
+                if round_no % 4 == 3:
+                    try:
+                        cluster.delete("Pod", "default", name)
+                    except Exception:
+                        pass
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    def relister():
+        while not stop.is_set():
+            inf.relist()
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=churner, args=(i,)) for i in range(4)]
+    rt = threading.Thread(target=relister)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join(timeout=5)
+    assert errors == []
+    # one final authoritative repair, then everything must line up
+    assert inf.relist()
+    assert_indexes_match_rebuild(inf)
+    with inf._lock:
+        cached = {k: v.get("metadata", {}).get("name") for k, v in inf._cache.items()}
+    stored = {objects.key_of(o): o["metadata"]["name"] for o in cluster.list("Pod")}
+    assert cached == stored
+
+
+def test_late_old_delete_cannot_regress_the_tombstone():
+    """delete(rv20) -> recreate(rv30) -> delete(rv40), with the FIRST delete
+    delivered last: the tombstone must stay at 40, so a late ADDED of the
+    middle incarnation (rv30) cannot resurrect a pod that no longer
+    exists."""
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+
+    def pod_rv(rv):
+        p = make_pod("p", job="j")
+        p["metadata"]["resourceVersion"] = str(rv)
+        return p
+
+    inf._on_event("DELETED", pod_rv(40))   # the final delete, on time
+    inf._on_event("DELETED", pod_rv(20))   # first delete, delivered late
+    assert inf._tombstones["default/p"] == 40, "older delete regressed tombstone"
+    inf._on_event("ADDED", pod_rv(30))     # middle incarnation, late
+    assert "default/p" not in inf.cache_keys(), "dead incarnation resurrected"
+
+
+def test_start_skips_dispatch_for_objects_the_guard_rejected():
+    """An object the initial-list guard refuses to cache (deleted while the
+    list was in flight) must not be announced as ADDED either — handlers
+    must never hear about state the cache refuses to hold."""
+    cluster = FakeCluster()
+    created = cluster.create("Pod", make_pod("ghost", job="j"))
+    inf = SharedIndexInformer(cluster, "Pod")
+    # delete observed between the informer's construction and start():
+    # rv newer than the stored object the list will return
+    tomb = dict(created)
+    tomb["metadata"] = dict(created["metadata"])
+    tomb["metadata"]["resourceVersion"] = str(
+        int(created["metadata"]["resourceVersion"]) + 1)
+    inf._on_event("DELETED", tomb)
+    seen = _handler_log(inf)
+    inf.start()
+    assert seen == [], "start() dispatched ADDED for a guarded-out object"
+    assert "default/ghost" not in inf.cache_keys()
+
+
+def test_relist_ignores_stale_snapshot_state():
+    """A relist fed a stale (one-write-behind) LIST must neither roll a
+    live object back below already-delivered state nor resurrect one whose
+    deletion was already delivered — the exact faults chaos.py's stale
+    storms inject into list()."""
+    from unittest import mock
+
+    cluster = FakeCluster()
+    cluster.create("Pod", make_pod("live", job="j"))
+    cluster.create("Pod", make_pod("dead", job="j"))
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+    stale_snapshot = cluster.list("Pod")  # both pods, pre-update rvs
+
+    live = cluster.get("Pod", "default", "live")
+    live["status"] = {"phase": "Running"}
+    cluster.update("Pod", live)            # cache now holds the newer rv
+    cluster.delete("Pod", "default", "dead")  # tombstone recorded
+
+    seen = _handler_log(inf)
+    with mock.patch.object(cluster, "list", return_value=stale_snapshot):
+        assert inf.relist()
+    assert seen == [], f"stale snapshot leaked through the relist: {seen}"
+    assert inf._cache["default/live"]["status"]["phase"] == "Running", (
+        "relist rolled a live object back to the stale snapshot"
+    )
+    assert "default/dead" not in inf.cache_keys(), (
+        "relist resurrected a delivered deletion"
+    )
+    assert_indexes_match_rebuild(inf)
+
+
+def test_relist_diff_deletions_tombstone_against_late_events():
+    """A deletion discovered BY the relist diff (the watch-gap case) must
+    tombstone like an event-delivered delete: a pre-gap upsert for the
+    vanished object still in flight must not resurrect it afterwards."""
+    from unittest import mock
+
+    cluster = FakeCluster()
+    created = cluster.create("Pod", make_pod("gone", job="j"))
+    inf = SharedIndexInformer(cluster, "Pod")
+    inf.start()
+    cluster.delete("Pod", "default", "gone")
+    # wedge the cache back to the pre-delete state to simulate the delete
+    # event having been DROPPED (watch outage), then repair via relist
+    inf.indexer_add(created)
+    assert "default/gone" in inf.cache_keys()
+    assert inf.relist()
+    assert "default/gone" not in inf.cache_keys()
+    # the in-flight pre-gap upsert arrives late: must stay dead
+    inf._on_event("MODIFIED", created)
+    assert "default/gone" not in inf.cache_keys(), (
+        "relist-diff deletion did not tombstone; late event resurrected"
+    )
+
+
+def test_pending_relist_degrades_lister_to_unsynced():
+    """A failed watch-gap repair leaves the cache knowingly incomplete:
+    Lister.synced() must go False for that window so the engine falls back
+    to live LISTs instead of serving the stale cache (the pre-PR read
+    path, restored exactly while degraded)."""
+    cluster = FakeCluster()
+    inf = SharedIndexInformer(cluster, "TFJob")
+    inf.start()
+    lister = Lister(inf)
+    assert lister.synced()
+    with inf._lock:
+        inf._needs_relist = True  # as a failed relist leaves it
+    assert not lister.synced()
+    inf.relist()  # repair lands (store is healthy here)
+    assert lister.synced()
+
+
+def test_gc_cascade_deletes_are_not_booked_as_client_requests():
+    """Owner-reference garbage collection is server-side work: deleting a
+    job with dependents must book exactly ONE client delete, not one per
+    reaped pod/service — otherwise the fake backend's api_requests tally
+    diverges from the REST façade's for identical workloads."""
+    from tf_operator_tpu.engine import metrics
+
+    cluster = FakeCluster()
+    job = cluster.create("TFJob", make_obj("owner"))
+    ref = {"apiVersion": "kubeflow.org/v1", "kind": "TFJob", "name": "owner",
+           "uid": job["metadata"]["uid"], "controller": True}
+    for i in range(3):
+        pod = make_pod(f"dep-{i}", job="owner")
+        pod["metadata"]["ownerReferences"] = [ref]
+        cluster.create("Pod", pod)
+    before_job = metrics.API_REQUESTS.get({"verb": "delete", "kind": "TFJob"})
+    before_pod = metrics.API_REQUESTS.get({"verb": "delete", "kind": "Pod"})
+    cluster.delete("TFJob", "default", "owner")
+    assert cluster.list("Pod") == []  # cascade really ran
+    assert metrics.API_REQUESTS.get(
+        {"verb": "delete", "kind": "TFJob"}) - before_job == 1
+    assert metrics.API_REQUESTS.get(
+        {"verb": "delete", "kind": "Pod"}) - before_pod == 0, (
+        "GC cascade booked as client deletes"
+    )

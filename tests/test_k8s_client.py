@@ -827,3 +827,80 @@ def test_facade_phase_profile():
     finally:
         cluster.close()
         transport.close()
+
+
+def test_facade_update_status_single_put_fast_path(rest_cluster):
+    """ClusterClient.update_status — the engine's hot-path status write —
+    is ONE /status PUT: spec stays untouched (even when the body carries
+    none), stale resourceVersion conflicts, invalid status 422s through
+    the status-only validator, and the write is visible to watchers."""
+    fake, c = rest_cluster
+    job = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {"name": "fast", "namespace": "default"},
+        "spec": {"tfReplicaSpecs": {"Worker": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "x"}]}},
+        }}},
+    }
+    created = c.create("TFJob", job)
+    # minimal engine-shaped body: identity + rv + status, NO spec
+    body = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "TFJob",
+        "metadata": {
+            "name": "fast", "namespace": "default",
+            "resourceVersion": created["metadata"]["resourceVersion"],
+        },
+        "status": {"conditions": [{"type": "Created", "status": "True"}]},
+    }
+    written = c.update_status("TFJob", body)
+    assert written["status"]["conditions"][0]["type"] == "Created"
+    stored = fake.get("TFJob", "default", "fast")
+    assert stored["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 1, (
+        "a spec-less status write must not touch the stored spec"
+    )
+    # stale rv -> conflict (the engine's conflict-retry path depends on it)
+    with pytest.raises(ConflictError):
+        c.update_status("TFJob", body)
+    # invalid status -> 422 from the status-only validator
+    from tf_operator_tpu.k8s.fake import ApiError
+
+    bad = dict(body)
+    bad["metadata"] = dict(body["metadata"])
+    bad["metadata"]["resourceVersion"] = written["metadata"]["resourceVersion"]
+    bad["status"] = {"conditions": [{"type": "Created"}]}  # missing 'status'
+    with pytest.raises(ApiError) as e:
+        c.update_status("TFJob", bad)
+    assert e.value.code == 422 and "status" in str(e.value)
+
+
+def test_fake_update_status_merges_and_conflicts():
+    """FakeCluster.update_status mirrors the façade: status merged onto the
+    stored object, spec kept, rv conflict on stale writes, MODIFIED
+    notified (informer caches see status changes)."""
+    fake = FakeCluster()
+    fake.create("TFJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "m", "namespace": "default"},
+        "spec": {"keep": True},
+    })
+    seen = []
+    fake.subscribe("TFJob", lambda et, obj: seen.append(et))
+    stored = fake.get("TFJob", "default", "m")
+    out = fake.update_status("TFJob", {
+        "metadata": {"name": "m", "namespace": "default",
+                     "resourceVersion": stored["metadata"]["resourceVersion"]},
+        "status": {"startTime": "2026-08-03T00:00:00Z"},
+    })
+    assert out["spec"] == {"keep": True}
+    assert out["status"]["startTime"] == "2026-08-03T00:00:00Z"
+    assert seen == ["MODIFIED"]
+    with pytest.raises(ConflictError):
+        fake.update_status("TFJob", {
+            "metadata": {"name": "m", "namespace": "default",
+                         "resourceVersion": stored["metadata"]["resourceVersion"]},
+            "status": {},
+        })

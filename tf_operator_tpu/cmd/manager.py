@@ -77,6 +77,12 @@ class _KindController:
         self.queue = make_queue()
         self.informer = manager.factory.for_kind(kind)
         self.lister = Lister(self.informer)
+        # sync hot path reads dependents from the shared Pod/Service
+        # informers' indexed caches (zero steady-state API LISTs per
+        # reconcile); the engine falls back to live LISTs until the
+        # informers sync, so startup correctness never depends on them
+        self.engine.pod_lister = Lister(manager.factory.for_kind("Pod"))
+        self.engine.service_lister = Lister(manager.factory.for_kind("Service"))
         self.informer.add_event_handler(
             ResourceEventHandler(
                 add_func=self._on_add,

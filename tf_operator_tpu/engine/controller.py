@@ -40,7 +40,11 @@ from tf_operator_tpu.engine.expectations import (
     gen_expectation_services_key,
 )
 from tf_operator_tpu.k8s import objects
-from tf_operator_tpu.k8s.fake import NotFoundError, is_transient_api_error
+from tf_operator_tpu.k8s.fake import (
+    ConflictError,
+    NotFoundError,
+    is_transient_api_error,
+)
 from tf_operator_tpu.k8s.informer import capped_exponential
 
 # Gang-scheduling annotations (reference pod.go:223-237 / tfjob_controller.go:799-813)
@@ -137,12 +141,23 @@ class JobEngine:
         pod_control: Optional[PodControl] = None,
         service_control: Optional[ServiceControl] = None,
         tracer: Optional[tracing.Tracer] = None,
+        pod_lister=None,
+        service_lister=None,
     ) -> None:
         self.cluster = cluster
         self.adapter = adapter
         self.config = config or EngineConfig()
         self.clock = clock
         self.tracer = tracer or tracing.get_tracer()
+        # indexed informer-cache listers for the dependent kinds (wired by
+        # the manager; None when the engine runs bare, e.g. unit tests).
+        # When present AND synced, get_pods_for_job/get_services_for_job
+        # read them instead of LISTing the apiserver — the reference's
+        # steady-state read model (client-go Lister over the shared
+        # informer's Indexer); absent/unsynced falls back to a live LIST
+        # so correctness never depends on the cache existing.
+        self.pod_lister = pod_lister
+        self.service_lister = service_lister
         if clock is time.time:
             # hot path: C++ expectations (native/expectations.cc) when built;
             # a test-injected clock forces the Python implementation since the
@@ -324,18 +339,52 @@ class JobEngine:
         meta = current.get("metadata", {})
         return meta.get("uid") == job.uid and not meta.get("deletionTimestamp")
 
+    def _cached_dependents(
+        self, kind: str, lister, job: Job
+    ) -> Optional[List[Dict[str, Any]]]:
+        """The job's dependents from the indexed informer cache, or None
+        when the cache cannot serve (no lister wired / not yet synced) and
+        the caller must fall back to a live LIST.  Copies are requested:
+        the adopt/claim path mutates orphans (writes the controllerRef
+        back), and a shared reference would corrupt the informer cache —
+        FakeCluster.list has always returned isolated copies, so the
+        cached path must too.  Hits and misses are counted so 'zero
+        steady-state LISTs' is an assertable, observable claim."""
+        if lister is None:
+            metrics.CACHED_LIST_MISSES.inc({"kind": kind, "reason": "no_lister"})
+            return None
+        if not lister.synced():
+            metrics.CACHED_LIST_MISSES.inc({"kind": kind, "reason": "not_synced"})
+            return None
+        items = lister.list(
+            namespace=job.namespace, selector=self.gen_labels(job.name),
+            copy=True,
+        )
+        metrics.CACHED_LIST_HITS.inc({"kind": kind})
+        return items
+
     def get_pods_for_job(self, job: Job) -> List[Dict[str, Any]]:
-        """List by GenLabels selector, then adopt/claim
-        (reference tfjob_controller.go:251-290)."""
-        selector = self.gen_labels(job.name)
-        pods = self.cluster.list_pods(namespace=job.namespace, selector=selector)
+        """List by GenLabels selector — from the indexed informer cache in
+        steady state, live LIST as the correctness fallback — then
+        adopt/claim (reference tfjob_controller.go:251-290).  Adoption
+        semantics are unchanged either way: the uncached UID recheck
+        (_can_adopt) still guards every orphan claim, and stale-cache
+        writes surface as conflicts that retry the sync on fresh state."""
+        pods = self._cached_dependents("Pod", self.pod_lister, job)
+        if pods is None:
+            pods = self.cluster.list_pods(
+                namespace=job.namespace, selector=self.gen_labels(job.name)
+            )
         return self._claim_controllees(job, "Pod", pods)
 
     def get_services_for_job(self, job: Job) -> List[Dict[str, Any]]:
         """Service twin of get_pods_for_job (reference
         ServiceControllerRefManager, tfjob_controller.go:295-331)."""
-        selector = self.gen_labels(job.name)
-        svcs = self.cluster.list_services(namespace=job.namespace, selector=selector)
+        svcs = self._cached_dependents("Service", self.service_lister, job)
+        if svcs is None:
+            svcs = self.cluster.list_services(
+                namespace=job.namespace, selector=self.gen_labels(job.name)
+            )
         return self._claim_controllees(job, "Service", svcs)
 
     @staticmethod
@@ -466,8 +515,14 @@ class JobEngine:
         if not satisfied:
             return ReconcileResult()
 
-        pods = self.get_pods_for_job(job)
-        services = self.get_services_for_job(job)
+        # ONE dependents read per sync: this snapshot is threaded through
+        # every consumer below (per-type reconcile, whole-slice teardown,
+        # the framework status rules) — re-listing inside the sync bought
+        # nothing but API round trips, and under cached listers a re-list
+        # could even be a LAGGING view of what this sync just did
+        with self._phase("dependents_list"):
+            pods = self.get_pods_for_job(job)
+            services = self.get_services_for_job(job)
         replicas = job.replica_specs
 
         # ----- terminal state: clean pods, TTL (reference ReconcileJobs head)
@@ -586,9 +641,12 @@ class JobEngine:
         if status.start_time is None:
             status.start_time = now_iso
         with self._phase("status_update"):
+            # the sync-start snapshot, NOT a fresh list: the replica counts
+            # the rules read were computed from this same snapshot, so a
+            # re-list could only disagree with them (and costs a LIST)
             ctx = StatusContext(
                 replicas, status,
-                self.get_pods_for_job(job), now_iso,
+                pods, now_iso,
                 lambda etype, reason, msg: self.cluster.record_event(
                     job.to_dict(), etype, reason, msg
                 ),
@@ -744,10 +802,13 @@ class JobEngine:
         if restarted_this_pass and getattr(self.adapter, "WHOLE_SLICE_RESTART", False):
             failed_deletes: List[str] = []
             all_transient = True
-            for pod_slice in self.get_slices(
-                self.filter_for_replica_type(self.get_pods_for_job(job), rtype),
-                num_replicas,
-            ):
+            # the sync's own snapshot (`typed`), not a re-list: pods already
+            # deleted above answer NotFound (counted as success by
+            # _delete_pod_with_expectations), and a pod CREATED earlier in
+            # this same pass carries the pre-restart generation label, so
+            # the stale-incarnation sweep deletes it on the next sync — the
+            # same repair path that finishes any interrupted teardown
+            for pod_slice in self.get_slices(typed, num_replicas):
                 for pod in pod_slice:
                     try:
                         self._delete_pod_with_expectations(job, rtype, pod)
@@ -1167,18 +1228,50 @@ class JobEngine:
     # ------------------------------------------------------------ status io
     def _write_status(self, job: Job, old_status: common.JobStatus) -> None:
         """Status().Update only on diff (reference tfjob_controller.go:510-537).
-        A successful write advances the stale-read fence so later syncs can
+
+        No GET-before-update: the sync already holds the job it read at
+        dispatch time, so the write body is built from the in-hand object
+        (name/namespace/uid + its resourceVersion) and sent through the
+        status-subresource verb — one round trip instead of three
+        (GET + spec PUT + status PUT on the REST backend).  Only status is
+        ever written: the reference defaults the spec in-memory only, and
+        the /status verb cannot touch spec by construction.  A conflict
+        (the CR changed under the sync) falls back to exactly the read the
+        fast path skipped — GET fresh, retry once; a second conflict
+        propagates and requeues the sync like any transient error.  A
+        successful write advances the stale-read fence so later syncs can
         tell a lagging read from fresh state."""
-        if job.status.to_dict() == old_status.to_dict():
+        new_status = job.status.to_dict()
+        if new_status == old_status.to_dict():
             return
+        meta = job.metadata or {}
+        body = {
+            "apiVersion": job.api_version,
+            "kind": job.kind,
+            "metadata": {
+                "name": job.name,
+                "namespace": job.namespace,
+                "uid": job.uid,
+                "resourceVersion": meta.get("resourceVersion"),
+            },
+            "status": new_status,
+        }
+        # legacy cluster doubles without the status verb keep the old
+        # read-modify-write shape (fetch, overlay status, full update)
+        update_status = getattr(self.cluster, "update_status", None)
         try:
-            current = self.cluster.get(self.adapter.KIND, job.namespace, job.name)
-        except Exception:
-            return
-        current["status"] = job.status.to_dict()
-        # also persist defaulted spec? The reference defaults in-memory only;
-        # we match that: only status is written back.
-        written = self.cluster.update(self.adapter.KIND, current)
+            if update_status is not None:
+                written = update_status(self.adapter.KIND, body)
+            else:
+                written = self._write_status_read_modify_write(job, new_status)
+        except NotFoundError:
+            return  # job deleted mid-sync; nothing to write status to
+        except ConflictError:
+            written = self._write_status_read_modify_write(
+                job, new_status, update_status
+            )
+            if written is None:
+                return
         rv = (written or {}).get("metadata", {}).get("resourceVersion")
         if self._rv_int(rv) is not None:
             self._rv_seen[job.key] = rv
@@ -1194,3 +1287,21 @@ class JobEngine:
                     self._restart_backoff_delay(job, rtype, n),
                     {"kind": self.adapter.KIND},
                 )
+
+    def _write_status_read_modify_write(
+        self, job: Job, new_status: Dict[str, Any], update_status=None
+    ) -> Optional[Dict[str, Any]]:
+        """The conflict-retry (and legacy-double) path: fetch the current
+        object — the one read the fast path saved — overlay the computed
+        status, write through whichever verb the cluster offers.  Returns
+        None when the job is gone or unreadable (matching the historical
+        swallow of GET failures); write errors propagate so the sync-level
+        handling requeues."""
+        try:
+            current = self.cluster.get(self.adapter.KIND, job.namespace, job.name)
+        except Exception:
+            return None
+        current["status"] = new_status
+        if update_status is not None:
+            return update_status(self.adapter.KIND, current)
+        return self.cluster.update(self.adapter.KIND, current)

@@ -33,6 +33,12 @@ class Metric:
     def get(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def samples(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Snapshot of every label set's value (bench rows and tests that
+        need the whole family, e.g. the per-verb/kind API-request tally)."""
+        with _LOCK:
+            return dict(self._values)
+
     @staticmethod
     def _escape_label_value(v: str) -> str:
         """Prometheus text-format label escaping: backslash, double quote,
@@ -269,6 +275,25 @@ SYNC_RETRIES_EXHAUSTED = Counter(
     f"{PREFIX}_sync_retries_exhausted_total",
     "Reconcile keys that burned the bounded retry budget on "
     "non-transient errors and fell back to the flat max-backoff cadence",
+)
+API_REQUESTS = Counter(
+    f"{PREFIX}_api_requests_total",
+    "Logical API-server requests issued through the operator's cluster "
+    "client (FakeCluster or ClusterClient), labeled by verb "
+    "(get/list/create/update/update_status/delete) and kind — the "
+    "'zero steady-state LISTs per reconcile' claim is asserted on the "
+    "{verb=list,kind=Pod|Service} series",
+)
+CACHED_LIST_HITS = Counter(
+    f"{PREFIX}_cached_list_hits_total",
+    "Dependent (pod/service) reads on the sync hot path served from the "
+    "indexed informer cache instead of an API LIST, labeled by kind",
+)
+CACHED_LIST_MISSES = Counter(
+    f"{PREFIX}_cached_list_misses_total",
+    "Dependent reads that fell back to a live API LIST, labeled by kind "
+    "and reason (no_lister = engine running without informer wiring, "
+    "not_synced = informer cache not yet listed)",
 )
 
 

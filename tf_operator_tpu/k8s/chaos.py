@@ -423,6 +423,25 @@ class FaultInjector:
             self._prev[(kind, key)] = superseded
         return out
 
+    def update_status(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Status-subresource writes are update-class faults: the engine's
+        hot-path status write-back moved to this verb, and letting it slip
+        past the injector via __getattr__ would exempt the single most
+        frequent write from conflict/5xx storms (ops=["update"] covers
+        both verbs)."""
+        self._fault("update", kind)
+        key = objects.key_of(obj)
+        try:
+            superseded = self.inner.get(
+                kind, objects.namespace_of(obj), objects.name_of(obj)
+            )
+        except (NotFoundError, ApiError):
+            superseded = None
+        out = self.inner.update_status(kind, obj)
+        if superseded is not None:
+            self._prev[(kind, key)] = superseded
+        return out
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._fault("delete", kind)
         self.inner.delete(kind, namespace, name)

@@ -484,6 +484,10 @@ class _WatchLoop:
             h(event_type, objects.fast_deepcopy(obj))
 
     def _list(self) -> Tuple[str, List[Dict[str, Any]]]:
+        # watch (re)seeds and gap-repair relists are real LIST round trips:
+        # they book under the same {verb=list} series, which is exactly why
+        # the steady-state zero-LIST assertion holds — no restarts, no lists
+        metrics.API_REQUESTS.inc({"verb": "list", "kind": self.kind})
         status, body, headers = _unpack(
             self.client.transport.request(
                 "GET", resource_path(self.kind, self.client.namespace or None)
@@ -755,7 +759,14 @@ class ClusterClient:
             self._watches.clear()
 
     # ------------------------------------------------------------- generic
+    @staticmethod
+    def _observe(verb: str, kind: str) -> None:
+        """One logical request = one tpu_operator_api_requests_total tick
+        (transport replays are counted separately by _api_request_retries)."""
+        metrics.API_REQUESTS.inc({"verb": verb, "kind": kind})
+
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._observe("create", kind)
         # POST is NOT transport-retried (client-go does the same): the first
         # attempt may have committed server-side before the reply was lost,
         # and a blind replay turns success into 409 AlreadyExists.  The safe
@@ -770,6 +781,7 @@ class ClusterClient:
         )
 
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        self._observe("get", kind)
         return self._request(
             "GET", resource_path(kind, namespace, name),
             context=f"get {kind} {namespace}/{name}",
@@ -780,6 +792,7 @@ class ClusterClient:
         /status (the apiserver drops status changes on main-resource writes
         and vice versa — one FakeCluster.update equals up to two REST calls).
         Stale resourceVersion surfaces as ConflictError, same as the fake."""
+        self._observe("update", kind)
         ns, name = objects.namespace_of(obj), objects.name_of(obj)
         context = f"update {kind} {ns}/{name}"
         body = self._request(
@@ -797,7 +810,25 @@ class ClusterClient:
             )
         return body
 
+    def update_status(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Status-subresource write in ONE PUT: the engine's hot-path status
+        write-back sends the object it already holds (rv rides along for the
+        conflict check) straight to /status — no GET-before-update, and none
+        of update()'s main-resource PUT whose spec bytes the apiserver would
+        discard anyway.  Kinds without a status subresource fall back to a
+        plain update."""
+        info = kind_info(kind)
+        if not info.has_status:
+            return self.update(kind, obj)
+        self._observe("update_status", kind)
+        ns, name = objects.namespace_of(obj), objects.name_of(obj)
+        return self._request(
+            "PUT", resource_path(kind, ns, name, "status"), body=obj,
+            context=f"update {kind} {ns}/{name} (status)",
+        )
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._observe("delete", kind)
         self._request(
             "DELETE", resource_path(kind, namespace, name),
             ok=(200, 202), context=f"delete {kind} {namespace}/{name}",
@@ -810,6 +841,7 @@ class ClusterClient:
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
+        self._observe("list", kind)
         ns = namespace if namespace is not None else (self.namespace or None)
         query: Dict[str, str] = {}
         sel = selector_to_query(selector)

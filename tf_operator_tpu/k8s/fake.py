@@ -80,6 +80,21 @@ def is_transient_api_error(exc: BaseException) -> bool:
 EventHandler = Callable[[str, Dict[str, Any]], None]  # (event_type, obj)
 
 
+_METRICS = None
+
+
+def _observe_api_request(verb: str, kind: str) -> None:
+    """tpu_operator_api_requests_total{verb,kind} — the per-call tally the
+    'zero steady-state LISTs' tests and the scale bench read.  The metrics
+    module is imported lazily: engine/__init__ imports the controller which
+    imports this module, so a top-level import here would be a cycle."""
+    global _METRICS
+    if _METRICS is None:
+        from tf_operator_tpu.engine import metrics as _m
+        _METRICS = _m
+    _METRICS.API_REQUESTS.inc({"verb": verb, "kind": kind})
+
+
 class FakeCluster:
     """In-memory object store: pods, services, podgroups, and job CRs
     (stored unstructured, keyed by kind).
@@ -92,6 +107,12 @@ class FakeCluster:
 
     def __init__(self, gc: bool = True) -> None:
         self.gc = gc
+        # tpu_operator_api_requests_total accounting: ON when this store IS
+        # the operator's client; the REST façade (e2e/apiserver.py) turns it
+        # OFF for its backing store so each logical request books exactly
+        # once — at the ClusterClient that issued it, not again at the store
+        # that served it
+        self.count_api_requests = True
         self._lock = threading.RLock()
         # kind -> {namespace/name -> obj}
         self._store: Dict[str, Dict[str, Dict[str, Any]]] = {}
@@ -128,8 +149,13 @@ class FakeCluster:
         for h in handlers:
             h(event_type, objects.fast_deepcopy(obj))
 
+    def _observe(self, verb: str, kind: str) -> None:
+        if self.count_api_requests:
+            _observe_api_request(verb, kind)
+
     # ------------------------------------------------------------- generic
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._observe("create", kind)
         with self._lock:
             key = objects.key_of(obj)
             store = self._kind_store(kind)
@@ -156,7 +182,7 @@ class FakeCluster:
         )
         if self.gc and owner_uid is not None and not self._uid_alive(owner_uid):
             try:
-                self.delete(
+                self._delete_internal(
                     kind,
                     obj["metadata"].get("namespace", "default"),
                     obj["metadata"]["name"],
@@ -174,6 +200,7 @@ class FakeCluster:
             )
 
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        self._observe("get", kind)
         with self._lock:
             store = self._kind_store(kind)
             key = f"{objects.normalize_namespace(kind, namespace)}/{name}"
@@ -182,6 +209,7 @@ class FakeCluster:
             return objects.fast_deepcopy(store[key])
 
     def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        self._observe("update", kind)
         with self._lock:
             key = objects.key_of(obj)
             store = self._kind_store(kind)
@@ -201,13 +229,53 @@ class FakeCluster:
         self._notify(kind, "MODIFIED", obj)
         return objects.fast_deepcopy(obj)
 
+    def update_status(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Status-subresource write: merges obj's .status onto the STORED
+        object (spec untouched — apiserver /status semantics), with the same
+        optimistic-concurrency check as update().  This is the verb the
+        engine's status write-back uses so a sync needs no GET-before-update:
+        the in-hand object's resourceVersion rides along and a stale one
+        surfaces as ConflictError for the caller's conflict-retry."""
+        self._observe("update_status", kind)
+        with self._lock:
+            key = objects.key_of(obj)
+            store = self._kind_store(kind)
+            if key not in store:
+                raise NotFoundError(f"{kind} {key}")
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            stored_rv = store[key].get("metadata", {}).get("resourceVersion")
+            if sent_rv is not None and stored_rv is not None and sent_rv != stored_rv:
+                raise ConflictError(
+                    f"{kind} {key}: resourceVersion {sent_rv} != {stored_rv}"
+                )
+            merged = objects.fast_deepcopy(store[key])
+            merged["status"] = objects.fast_deepcopy(obj.get("status", {}))
+            self._bump(merged)
+            store[key] = merged
+        self._notify(kind, "MODIFIED", merged)
+        return objects.fast_deepcopy(merged)
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._observe("delete", kind)
+        self._delete_internal(kind, namespace, name)
+
+    def _delete_internal(self, kind: str, namespace: str, name: str) -> None:
+        """delete() minus the api_requests tick — the GC cascade's path: a
+        server-side garbage collection is not a client request, and booking
+        it would skew the per-verb tally between the fake backend and the
+        REST façade (whose backing store never counts)."""
         with self._lock:
             store = self._kind_store(kind)
             key = f"{objects.normalize_namespace(kind, namespace)}/{name}"
             if key not in store:
                 raise NotFoundError(f"{kind} {key}")
             obj = store.pop(key)
+            # restamp the delete with a fresh rv (real apiserver semantics;
+            # the REST façade already does this): _notify runs outside the
+            # lock, so a DELETED carrying the last stored rv could tie with
+            # the update that wrote it and cache consumers ordering events
+            # by rv (SharedIndexInformer) could not tell which came last
+            self._bump(obj)
         self._notify(kind, "DELETED", obj)
         self._collect_garbage(namespace, obj.get("metadata", {}).get("uid"))
 
@@ -231,7 +299,7 @@ class FakeCluster:
             ]
         for dep_kind, dep_ns, dep_name in dependents:
             try:
-                self.delete(dep_kind, dep_ns, dep_name)
+                self._delete_internal(dep_kind, dep_ns, dep_name)
             except NotFoundError:
                 pass  # lost a race with another deleter — already gone
 
@@ -241,6 +309,7 @@ class FakeCluster:
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
+        self._observe("list", kind)
         with self._lock:
             namespace = objects.normalize_namespace(kind, namespace)
             out = []

@@ -227,7 +227,17 @@ class SharedIndexInformer:
 
     The cache (indexer) is what listers read; tests may also inject fixtures
     directly with `indexer_add` the way the reference's controller tests
-    inject into informer indexers (reference job_test.go:40-64)."""
+    inject into informer indexers (reference job_test.go:40-64).
+
+    "Index" is literal (client-go cache.Indexer): alongside the flat
+    key->object cache, two lookup tables are maintained incrementally on
+    every event and rebuilt atomically on relist —
+      - namespace -> {key: obj}
+      - (namespace, job-name label) -> {key: obj}
+    so the sync hot path's "pods of job X" read (`Lister.list` with the
+    GenLabels selector) is a dict lookup over the job's own O(replicas)
+    objects instead of a linear scan of the whole cluster's cache with
+    per-object label matching."""
 
     def __init__(self, cluster, kind: str, resync_period: float = 0.0) -> None:
         self.cluster = cluster
@@ -235,6 +245,23 @@ class SharedIndexInformer:
         self.resync_period = resync_period
         self._lock = threading.RLock()
         self._cache: Dict[str, Dict[str, Any]] = {}
+        # client-go-style indexes over _cache; every mutation of _cache
+        # updates them under the same lock (byte-identical to a from-scratch
+        # rebuild at all times — asserted by the churn tests)
+        self._ns_index: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._job_index: Dict[Tuple[str, str], Dict[str, Dict[str, Any]]] = {}
+        # rv-ordered application guard: FakeCluster (and any concurrent
+        # event source) notifies OUTSIDE its store lock, so two writes to
+        # the same object can deliver inverted.  Harmless while consumers
+        # re-read the store, fatal once this cache IS the read path: a
+        # late ADDED would resurrect a deleted pod forever (no further
+        # event ever corrects it).  Stale deliveries — rv older than the
+        # cached object, or not newer than the key's deletion tombstone —
+        # are dropped, cache and dispatch both (client-go's single
+        # rv-ordered watch stream makes them impossible by construction;
+        # here they must be filtered).  Tombstones are pruned FIFO: they
+        # only matter for deliveries inverted across milliseconds.
+        self._tombstones: Dict[str, int] = {}
         self._handlers: List[ResourceEventHandler] = []
         self._synced = False
         self._stop = threading.Event()
@@ -255,16 +282,119 @@ class SharedIndexInformer:
         self._relist_mutex = threading.Lock()
         cluster.subscribe(kind, self._on_event)
 
+    # bound on deletion tombstones kept for the rv ordering guard
+    MAX_TOMBSTONES = 4096
+
+    @staticmethod
+    def _rv_int(obj: Optional[Dict[str, Any]]) -> Optional[int]:
+        """Best-effort numeric resourceVersion (k8s rvs are formally opaque
+        but etcd revisions compare in practice — same stance as the
+        engine's stale-read fence); None disables the ordering guard for
+        that comparison."""
+        if obj is None:
+            return None
+        try:
+            return int((obj.get("metadata") or {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            return None
+
+    # ------------------------------------------------------------- indexes
+    def _index_insert(self, key: str, obj: Dict[str, Any]) -> None:
+        """Register `obj` under both indexes. Caller holds self._lock."""
+        ns = objects.namespace_of(obj)
+        self._ns_index.setdefault(ns, {})[key] = obj
+        job_name = objects.labels_of(obj).get(objects.LABEL_JOB_NAME)
+        if job_name:
+            self._job_index.setdefault((ns, job_name), {})[key] = obj
+
+    def _index_remove(self, key: str, obj: Dict[str, Any]) -> None:
+        """Drop `obj`'s index entries, using ITS namespace/labels (a MODIFIED
+        that moves labels must remove the old coordinates, not the new).
+        Empty buckets are pruned so the index never outgrows the cache.
+        Caller holds self._lock."""
+        ns = objects.namespace_of(obj)
+        bucket = self._ns_index.get(ns)
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del self._ns_index[ns]
+        job_name = objects.labels_of(obj).get(objects.LABEL_JOB_NAME)
+        if job_name:
+            jbucket = self._job_index.get((ns, job_name))
+            if jbucket is not None:
+                jbucket.pop(key, None)
+                if not jbucket:
+                    del self._job_index[(ns, job_name)]
+
+    def _cache_upsert(self, key: str, obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Insert/replace `key` in cache + indexes; returns the replaced
+        object (None for a fresh add). Caller holds self._lock."""
+        old = self._cache.get(key)
+        if old is not None:
+            self._index_remove(key, old)
+        self._cache[key] = obj
+        self._index_insert(key, obj)
+        return old
+
+    def _cache_delete(self, key: str) -> Optional[Dict[str, Any]]:
+        """Remove `key` from cache + indexes; returns the removed object.
+        Caller holds self._lock."""
+        old = self._cache.pop(key, None)
+        if old is not None:
+            self._index_remove(key, old)
+        return old
+
+    @staticmethod
+    def build_indexes(
+        cache: Dict[str, Dict[str, Any]]
+    ) -> Tuple[
+        Dict[str, Dict[str, Dict[str, Any]]],
+        Dict[Tuple[str, str], Dict[str, Dict[str, Any]]],
+    ]:
+        """From-scratch (namespace, job) indexes for `cache` — the atomic
+        relist rebuild, and the churn tests' ground truth the incremental
+        maintenance is compared against."""
+        ns_index: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        job_index: Dict[Tuple[str, str], Dict[str, Dict[str, Any]]] = {}
+        for key, obj in cache.items():
+            ns = objects.namespace_of(obj)
+            ns_index.setdefault(ns, {})[key] = obj
+            job_name = objects.labels_of(obj).get(objects.LABEL_JOB_NAME)
+            if job_name:
+                job_index.setdefault((ns, job_name), {})[key] = obj
+        return ns_index, job_index
+
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
         """List current state into the cache and deliver initial ADDs."""
         initial = self.cluster.list(self.kind)
+        skipped = set()
         with self._lock:
             for obj in initial:
-                self._cache[objects.key_of(obj)] = obj
+                # events race the initial list (subscription opened at
+                # construction): state a live event already delivered must
+                # not be rolled back by the (possibly older) list snapshot,
+                # and a deletion observed since the list must not be
+                # resurrected — same rv ordering rules as _on_event.
+                # Skipped objects are skipped from dispatch too: an ADDED
+                # for state the informer judged dead/stale would leak to
+                # handlers what the cache (rightly) refuses to hold.
+                key = objects.key_of(obj)
+                rv = self._rv_int(obj)
+                if rv is not None:
+                    tomb = self._tombstones.get(key)
+                    if tomb is not None and rv <= tomb:
+                        skipped.add(key)
+                        continue
+                    cur_rv = self._rv_int(self._cache.get(key))
+                    if cur_rv is not None and rv < cur_rv:
+                        skipped.add(key)
+                        continue
+                self._cache_upsert(key, obj)
             self._synced = True
         for obj in initial:
-            self._dispatch("ADDED", obj, None)
+            if objects.key_of(obj) not in skipped:
+                self._dispatch("ADDED", obj, None)
         if self.resync_period > 0 and self._resync_thread is None:
             self._resync_thread = threading.Thread(target=self._resync_loop, daemon=True)
             self._resync_thread.start()
@@ -288,16 +418,38 @@ class SharedIndexInformer:
             self.relist()
             return
         key = objects.key_of(obj)
+        rv = self._rv_int(obj)
         old = None
         with self._lock:
             if event_type == "DELETED":
-                old = self._cache.pop(key, None)
+                cur_rv = self._rv_int(self._cache.get(key))
+                if rv is not None and cur_rv is not None and cur_rv > rv:
+                    return  # late delete of an older incarnation
+                old = self._cache_delete(key)
+                if rv is not None:
+                    # max(): a LATE-delivered older delete (prior incarnation)
+                    # must not regress the tombstone and re-open the window
+                    # for that incarnation's stale upserts
+                    prev_tomb = self._tombstones.get(key)
+                    self._tombstones[key] = (
+                        rv if prev_tomb is None else max(rv, prev_tomb)
+                    )
+                    while len(self._tombstones) > self.MAX_TOMBSTONES:
+                        self._tombstones.pop(next(iter(self._tombstones)))
                 if self._relisting:
                     self._relist_deletes.add(key)
                     self._relist_upserts.pop(key, None)
             else:
-                old = self._cache.get(key)
-                self._cache[key] = obj
+                tomb = self._tombstones.get(key)
+                if rv is not None:
+                    if tomb is not None and rv <= tomb:
+                        return  # upsert older than the key's deletion
+                    cur_rv = self._rv_int(self._cache.get(key))
+                    if cur_rv is not None and rv < cur_rv:
+                        return  # stale delivery: cache already newer
+                    if tomb is not None:
+                        del self._tombstones[key]  # recreated, newer rv
+                old = self._cache_upsert(key, obj)
                 if self._relisting:
                     self._relist_upserts[key] = obj
                     self._relist_deletes.discard(key)
@@ -329,15 +481,37 @@ class SharedIndexInformer:
         with self._lock:
             self._needs_relist = False
             self._relisting = False
-            tombstones, self._relist_deletes = self._relist_deletes, set()
+            mid_deletes, self._relist_deletes = self._relist_deletes, set()
             upserts, self._relist_upserts = self._relist_upserts, {}
-            new_cache = {
-                key: obj
-                for obj in current
-                if (key := objects.key_of(obj)) not in tombstones
-            }
+            new_cache: Dict[str, Dict[str, Any]] = {}
+            for obj in current:
+                key = objects.key_of(obj)
+                if key in mid_deletes:
+                    continue  # deleted while the LIST was in flight
+                # the same rv ordering rules as _on_event apply to the
+                # snapshot itself: a stale LIST (one-write-behind chaos
+                # fault, lagging apiserver cache) must neither resurrect
+                # an object whose deletion was already delivered (rv <=
+                # its tombstone) nor roll a live object back below state
+                # already in the cache — the cache is the sync read path
+                # now, and nothing would ever correct either regression
+                rv = self._rv_int(obj)
+                if rv is not None:
+                    tomb = self._tombstones.get(key)
+                    if tomb is not None and rv <= tomb:
+                        continue
+                    cur = self._cache.get(key)
+                    cur_rv = self._rv_int(cur)
+                    if cur_rv is not None and rv < cur_rv:
+                        new_cache[key] = cur  # keep the newer known state
+                        continue
+                new_cache[key] = obj
             new_cache.update(upserts)  # live events beat the snapshot
             old_cache, self._cache = self._cache, new_cache
+            # indexes are rebuilt from scratch and swapped in atomically
+            # with the cache (both under self._lock): a reader never sees
+            # a cache/index pair from different generations
+            self._ns_index, self._job_index = self.build_indexes(new_cache)
             # diff computed under the lock: new_cache IS the live cache now,
             # and concurrent events mutating it mid-iteration would raise.
             # Dispatch itself happens outside (handlers may re-enter).
@@ -351,11 +525,26 @@ class SharedIndexInformer:
                 for key, obj in new_cache.items()
                 if key in old_cache and old_cache[key] != obj
             ]
-            events += [
-                ("DELETED", old, old)
+            vanished = [
+                (key, old)
                 for key, old in old_cache.items()
                 if key not in new_cache
             ]
+            for key, old in vanished:
+                # snapshot-diff deletions tombstone too (best-effort at the
+                # vanished object's last known rv): a pre-gap event for the
+                # object still in flight in another notifier thread must
+                # not resurrect it after the repair — the same wedge the
+                # _on_event DELETED branch guards against
+                rv = self._rv_int(old)
+                if rv is not None:
+                    prev_tomb = self._tombstones.get(key)
+                    self._tombstones[key] = (
+                        rv if prev_tomb is None else max(rv, prev_tomb)
+                    )
+                    while len(self._tombstones) > self.MAX_TOMBSTONES:
+                        self._tombstones.pop(next(iter(self._tombstones)))
+            events += [("DELETED", old, old) for _, old in vanished]
         for event_type, obj, old in events:
             self._dispatch(event_type, obj, old)
         return True
@@ -396,7 +585,7 @@ class SharedIndexInformer:
     # ------------------------------------------------------------- cache/test
     def indexer_add(self, obj: Dict[str, Any]) -> None:
         with self._lock:
-            self._cache[objects.key_of(obj)] = obj
+            self._cache_upsert(objects.key_of(obj), obj)
 
     def cache_keys(self) -> List[str]:
         with self._lock:
@@ -405,10 +594,28 @@ class SharedIndexInformer:
 
 class Lister:
     """Read-only view over an informer's cache (reference
-    pkg/client/listers/tensorflow/v1/tfjob.go)."""
+    pkg/client/listers/tensorflow/v1/tfjob.go).
+
+    `list` is index-accelerated: a namespace narrows the scan to that
+    namespace's bucket, and a selector carrying the job-name label
+    (GenLabels — the sync hot path's shape) narrows it to the job's own
+    O(replicas) objects.  Returned objects are the cache's own unless
+    `copy=True`; callers that mutate (the engine's adopt/claim path) must
+    ask for copies or they corrupt the cache."""
 
     def __init__(self, informer: SharedIndexInformer) -> None:
         self._informer = informer
+
+    def synced(self) -> bool:
+        """True only when the cache is safe to serve the hot path: it has
+        completed its initial list AND no watch-gap repair is pending.  A
+        failed relist (apiserver still erroring at repair time) leaves the
+        cache knowingly missing a gap until resync retries it — consumers
+        must fall back to live LISTs for that window instead of serving
+        stale state, which is exactly what the engine's _cached_dependents
+        does on False."""
+        inf = self._informer
+        return inf.has_synced() and not inf._needs_relist
 
     def get(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
         with self._informer._lock:
@@ -418,18 +625,29 @@ class Lister:
         self,
         namespace: Optional[str] = None,
         selector: Optional[Dict[str, str]] = None,
+        copy: bool = False,
     ) -> List[Dict[str, Any]]:
-        with self._informer._lock:
-            items = list(self._informer._cache.values())
+        inf = self._informer
+        job_name = (selector or {}).get(objects.LABEL_JOB_NAME)
+        with inf._lock:
+            if namespace is not None and job_name is not None:
+                items = list(inf._job_index.get((namespace, job_name), {}).values())
+            elif namespace is not None:
+                items = list(inf._ns_index.get(namespace, {}).values())
+            else:
+                items = list(inf._cache.values())
         out = []
         for obj in items:
+            # the index guarantees namespace and job-name already; the
+            # residual selector keys (group-name, replica-type, ...) still
+            # match here — selector_matches over 2-3 keys is cheap
             if namespace is not None and objects.namespace_of(obj) != namespace:
                 continue
             if selector and not objects.selector_matches(
                 selector, objects.labels_of(obj)
             ):
                 continue
-            out.append(obj)
+            out.append(objects.fast_deepcopy(obj) if copy else obj)
         return out
 
 

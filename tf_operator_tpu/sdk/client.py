@@ -15,7 +15,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Set
 
 from tf_operator_tpu.k8s import objects
-from tf_operator_tpu.k8s.fake import NotFoundError
+from tf_operator_tpu.k8s.fake import ConflictError, NotFoundError
 
 TERMINAL_CONDITIONS = ("Succeeded", "Failed")
 
@@ -72,8 +72,19 @@ class JobClient:
     def patch(
         self, name: str, patch: Dict[str, Any], namespace: str = "default"
     ) -> Dict[str, Any]:
-        current = self.cluster.get(self.kind, namespace, name)
-        return self.cluster.update(self.kind, _deep_merge(current, patch))
+        """Strategic-merge-patch emulated as read-merge-write.  A real
+        apiserver PATCH merges server-side and cannot rv-conflict; the
+        emulation can — whenever the operator's status write lands between
+        our read and write — so a conflict re-reads and re-merges instead
+        of surfacing an error a real PATCH caller would never see."""
+        for attempt in range(5):
+            current = self.cluster.get(self.kind, namespace, name)
+            try:
+                return self.cluster.update(self.kind, _deep_merge(current, patch))
+            except ConflictError:
+                if attempt == 4:
+                    raise
+                time.sleep(0.01 * (attempt + 1))
 
     def apply(
         self, doc, namespace: str = "default"
