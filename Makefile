@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -49,6 +49,14 @@ bench:
 bench-scale:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_operator_scale; \
 	print(json.dumps({be: bench_operator_scale(backend=be) for be in ('fake', 'rest')}, indent=1))"
+
+# N-replica gang startup latency (1/8/32 workers, fake + rest-over-real-
+# socket), --control-fanout 1 vs 8 side by side, with the pooled
+# transport's connections created/reused per run — the pooled keep-alive +
+# slow-start fan-out evidence, no TPU required.
+bench-startup:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_startup_replica_sweep; \
+	print(json.dumps(bench_startup_replica_sweep(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

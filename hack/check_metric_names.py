@@ -8,8 +8,8 @@ naming conventions the docs and dashboards rely on:
   - every family carries the shared `tpu_operator_` prefix, so one
     scrape-config relabel and one Grafana variable cover the operator;
   - unit suffixes: Counters end in `_total` (the value is a running
-    count); Histograms end in `_seconds` or `_bytes` (the only units we
-    record — a unitless histogram is a smell); Gauges never end in
+    count); Histograms end in `_seconds`, `_bytes`, or `_ops` (the only
+    units we record — a unitless histogram is a smell); Gauges never end in
     `_total` (a gauge that counts should be a Counter) and, when they
     measure a unit, name it (`_bytes`, `_seconds`);
   - non-empty HELP text (an undocumented family is unusable at 3am);
@@ -45,10 +45,11 @@ def check_registry() -> list:
         if m.TYPE == "counter" and not m.name.endswith("_total"):
             errors.append(f"{where}: counters must end in _total")
         if m.TYPE == "histogram" and not m.name.endswith(
-                ("_seconds", "_bytes")):
+                ("_seconds", "_bytes", "_ops")):
             errors.append(
-                f"{where}: histograms must end in _seconds or _bytes "
-                f"(the units this codebase records)")
+                f"{where}: histograms must end in _seconds, _bytes, or "
+                f"_ops (the units this codebase records; _ops covers "
+                f"count-valued distributions like fan-out batch sizes)")
         if m.TYPE == "gauge":
             if m.name.endswith("_total"):
                 errors.append(

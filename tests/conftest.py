@@ -21,3 +21,11 @@ import jax  # noqa: E402  (may already be in sys.modules via sitecustomize)
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; register the mark so heavy
+    # concurrency soaks can opt out without tripping PytestUnknownMarkWarning
+    config.addinivalue_line(
+        "markers", "slow: heavy soak/concurrency tests excluded from tier-1"
+    )

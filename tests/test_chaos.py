@@ -104,7 +104,7 @@ def audit_orphans(inner, kind="TFJob"):
     return problems
 
 
-def make_harness(seed, backoff_base=20.0, classify=True):
+def make_harness(seed, backoff_base=20.0, classify=True, fanout=1):
     inner = FakeCluster()
     clock = SimClock()
     inj = FaultInjector(inner, seed=seed, clock=clock)
@@ -114,6 +114,7 @@ def make_harness(seed, backoff_base=20.0, classify=True):
         restart_backoff_base=backoff_base,
         restart_backoff_max=120.0,
         classify_retryable_errors=classify,
+        control_fanout=fanout,
     )
     mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
     # all delays collapse to immediate adds: pop order (and therefore the
@@ -162,11 +163,11 @@ def _exitcode_tfjob(name, workers=3):
 
 
 # ---------------------------------------------------------------- the soak
-def run_soak(seed):
+def run_soak(seed, fanout=1):
     """The acceptance scenario: overlapping 429/500/conflict/reset/stale
     storms, a Pod+Service watch outage, and two worker preemptions, then a
     long quiet tail (expectation TTL + backoff windows) to converge."""
-    inner, clock, inj, mgr, auditor = make_harness(seed)
+    inner, clock, inj, mgr, auditor = make_harness(seed, fanout=fanout)
     inj.schedule_storm(10, 15, fault="429", retry_after=3.0)
     inj.schedule_storm(30, 10, fault="500")
     inj.schedule_storm(42, 6, fault="conflict", ops=["update"])
@@ -225,6 +226,74 @@ def test_chaos_soak_converges_and_is_deterministic(seed):
     log2 = run_soak(seed)
     assert log1 == log2, "same seed must replay an identical event log"
     assert any("preempt" in line for line in log1)
+
+
+def test_fanout1_soak_log_matches_pre_fanout_golden():
+    """--control-fanout 1 must reproduce the PRE-fan-out engine's serial
+    order exactly: the golden file was generated from the commit before
+    the fan-out existed (seed 1337, this exact scenario), so any change
+    that reorders serial-mode control ops — routing creates through the
+    batched path, reordering the teardown walk — breaks this byte-for-
+    byte.  Regenerate ONLY for deliberate scenario/schedule changes:
+      python -c "import logging; logging.disable(logging.CRITICAL); \\
+        from tests.test_chaos import run_soak; \\
+        open('tests/data/chaos_soak_log_1337.txt','w').write( \\
+          chr(10).join(run_soak(1337)) + chr(10))"
+    """
+    golden = os.path.join(
+        os.path.dirname(__file__), "data", "chaos_soak_log_1337.txt"
+    )
+    with open(golden) as f:
+        expected = f.read().splitlines()
+    assert run_soak(1337, fanout=1) == expected
+
+
+@pytest.mark.slow
+def test_chaos_soak_converges_with_fanout():
+    """Heavy concurrency soak: the full storm scenario with slow-start
+    fan-out enabled — concurrent creates/deletes interleave with 429/500/
+    conflict/reset storms and the watch outage, and every convergence
+    invariant run_soak asserts (Running end state, exact restart counters,
+    zero orphans, legal conditions) must still hold.  The event LOG is not
+    compared: batch threads race each other by design."""
+    run_soak(SOAK_SEEDS[0], fanout=4)
+
+
+def test_fanout_slow_start_aborts_under_create_storm():
+    """A 500 storm on Pod creates with fanout=4: the slow-start ramp sends
+    ONE probe create, sees it fail, and aborts the batch — the gang is not
+    sprayed at a down apiserver — while expectations stay exact, so the
+    next storm-free sync completes the gang."""
+    from tf_operator_tpu.controllers.registry import make_engine
+    from tf_operator_tpu.engine.controller import EngineConfig
+
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=11, clock=clock, kubelet=False)
+    inj.schedule_storm(0, 50, fault="500", ops=["create"], kinds=["Pod"])
+    inj.step(1.0)  # enter the storm window
+    engine = make_engine(
+        "TFJob", inj, config=EngineConfig(control_fanout=4),
+        clock=clock,
+    )
+    job = _exitcode_tfjob("probe", workers=16)
+    inj.create("TFJob", job.to_dict())
+    fresh = engine.adapter.from_dict(inner.get("TFJob", "default", "probe"))
+    result = engine.reconcile(fresh)
+    assert result.error and result.retryable
+    assert inner.list_pods() == [], "no create slips past the storm"
+    # exactly ONE probe hit the storm: slow-start's first batch
+    assert inj.stats.get("fault.500") == 1, inj.stats
+    assert engine.satisfied_expectations(fresh), (
+        "failed + never-attempted ops must leave no dangling expectations"
+    )
+    # storm over: the same job converges in one clean sync
+    inj.step(60.0)
+    fresh = engine.adapter.from_dict(inner.get("TFJob", "default", "probe"))
+    result = engine.reconcile(fresh)
+    assert result.error is None
+    assert len(inner.list_pods()) == 16
+    assert engine.satisfied_expectations(fresh)
 
 
 # ------------------------------------------- pre-hardening failure modes

@@ -1,0 +1,227 @@
+"""slow_start_batch (engine/fanout.py) + the engine's control fan-out.
+
+The contract under test, mirroring client-go's slowStartBatch:
+exponential batch growth capped by the fanout, first failing batch aborts
+the ramp (create path) or keeps going (teardown path), the serial
+fanout<=1 mode never spawns a thread and preserves strict list order, and
+the engine's expectations accounting stays exact under partial failure.
+"""
+import threading
+
+import pytest
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.controllers.registry import make_engine
+from tf_operator_tpu.engine.control import PodControl
+from tf_operator_tpu.engine.controller import EngineConfig
+from tf_operator_tpu.engine.fanout import FanoutResult, slow_start_batch
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import ApiError, FakeCluster
+
+from tests import testutil
+from tests.test_engine import reconcile, run_pods
+
+
+# ------------------------------------------------------------- unit tests
+def test_batch_growth_sequence_capped_by_fanout():
+    sizes = []
+    ran = []
+    ops = [lambda i=i: ran.append(i) for i in range(10)]
+    res = slow_start_batch(ops, fanout=4, observe=sizes.append)
+    # 1, 2, 4 (cap), then the 3 remaining
+    assert sizes == [1, 2, 4, 3]
+    assert res.successes == 10 and res.attempted == 10 and not res.failures
+    assert sorted(ran) == list(range(10))
+
+
+def test_first_failing_batch_aborts_the_ramp():
+    attempted = []
+
+    def op(i):
+        attempted.append(i)
+        if i >= 3:
+            raise ApiError(500, f"boom {i}")
+
+    ops = [lambda i=i: op(i) for i in range(20)]
+    res = slow_start_batch(ops, fanout=8)
+    # batches 1 (op 0), 2 (ops 1-2), 4 (ops 3-6: all fail) — then abort;
+    # ops 7..19 never start
+    assert res.attempted == 7 and len(attempted) == 7
+    assert res.successes == 3
+    assert [i for i, _ in res.failures] == [3, 4, 5, 6]
+    assert isinstance(res.first_error, ApiError)
+    with pytest.raises(ApiError, match="boom 3"):
+        res.raise_first()
+
+
+def test_abort_on_failure_false_attempts_every_op():
+    def op(i):
+        if i % 2 == 0:
+            raise ApiError(503, f"boom {i}")
+
+    ops = [lambda i=i: op(i) for i in range(9)]
+    res = slow_start_batch(ops, fanout=4, abort_on_failure=False)
+    assert res.attempted == 9
+    assert res.successes == 4
+    assert [i for i, _ in res.failures] == [0, 2, 4, 6, 8]
+
+
+def test_serial_mode_is_inline_ordered_and_threadless():
+    order = []
+    threads = set()
+
+    def op(i):
+        order.append(i)
+        threads.add(threading.get_ident())
+
+    res = slow_start_batch([lambda i=i: op(i) for i in range(6)], fanout=1)
+    assert order == list(range(6)), "serial mode must preserve list order"
+    assert threads == {threading.get_ident()}, "serial mode must not thread"
+    assert res.successes == 6
+
+    # serial abort: first failure stops immediately (op 3 never runs)
+    order.clear()
+
+    def flaky(i):
+        order.append(i)
+        if i == 2:
+            raise ApiError(500, "stop")
+
+    res = slow_start_batch([lambda i=i: flaky(i) for i in range(5)], fanout=1)
+    assert order == [0, 1, 2] and res.attempted == 3
+    assert [i for i, _ in res.failures] == [2]
+
+
+def test_empty_ops_is_a_noop():
+    assert slow_start_batch([], fanout=4) == FanoutResult()
+
+
+# -------------------------------------------------- engine integration
+class RecordingPodControl(PodControl):
+    """Books every create's pod name + calling thread; optionally fails
+    after `allowed` creates (the quota-denial / storm shape)."""
+
+    def __init__(self, cluster, allowed=None, fail_with=None):
+        super().__init__(cluster)
+        self.created = []
+        self.threads = set()
+        self.allowed = allowed
+        self.fail_with = fail_with or ApiError(429, "chaos: quota storm")
+        self._lock = threading.Lock()
+
+    def create_pod_with_controller_ref(self, namespace, template, owner, ref):
+        with self._lock:
+            if self.allowed is not None and len(self.created) >= self.allowed:
+                raise self.fail_with
+            self.created.append(template["metadata"]["name"])
+            self.threads.add(threading.get_ident())
+        return super().create_pod_with_controller_ref(
+            namespace, template, owner, ref
+        )
+
+
+def test_fanout_engine_creates_full_gang():
+    """control_fanout > 1: every pod and service of an 8-replica gang is
+    created, expectations settle, and creates actually fanned out."""
+    cluster = FakeCluster()
+    control = RecordingPodControl(cluster)
+    engine = make_engine(
+        "TFJob", cluster, config=EngineConfig(control_fanout=4),
+        pod_control=control,
+    )
+    job = testutil.new_tfjob("gang", worker=8)
+    cluster.create(job.kind, job.to_dict())
+    job, result = reconcile(cluster, engine, job)
+    assert result.error is None
+    assert sorted(control.created) == [f"gang-worker-{i}" for i in range(8)]
+    assert len(run_pods(cluster)) == 8
+    assert len(cluster.list_services()) == 8
+    assert engine.satisfied_expectations(job)
+
+
+def test_fanout1_default_keeps_serial_create_order():
+    """The regression the chaos seeds rely on: at the default fanout the
+    engine issues creates strictly in index order, one at a time, on the
+    calling thread — today's serial order, exactly."""
+    cluster = FakeCluster()
+    control = RecordingPodControl(cluster)
+    engine = make_engine("TFJob", cluster, pod_control=control)  # defaults
+    assert engine.config.control_fanout == 1
+    job = testutil.new_tfjob("serial", worker=6)
+    cluster.create(job.kind, job.to_dict())
+    reconcile(cluster, engine, job)
+    assert control.created == [f"serial-worker-{i}" for i in range(6)]
+    assert control.threads == {threading.get_ident()}
+
+
+def test_fanout_partial_failure_keeps_expectations_exact():
+    """A storm that kills creates after the 3rd: the slow-start ramp
+    (1, 2, then a failing 4) aborts, every failed op lowered its own
+    expectation, never-attempted ops never raised one — so the next sync
+    is NOT gated and completes the gang once the storm clears."""
+    cluster = FakeCluster()
+    control = RecordingPodControl(cluster, allowed=3)
+    engine = make_engine(
+        "TFJob", cluster, config=EngineConfig(control_fanout=4),
+        pod_control=control,
+    )
+    job = testutil.new_tfjob("storm", worker=12)
+    cluster.create(job.kind, job.to_dict())
+    job, result = reconcile(cluster, engine, job)
+    assert result.error and result.retryable, "429 storm must be transient"
+    assert len(run_pods(cluster)) == 3
+    # ramp: 1 + 2 succeeded, the 4-batch hit the storm; 12-7=5 never started
+    assert len(control.created) == 3
+    # the accounting invariant: nothing left dangling — the next sync runs
+    assert engine.satisfied_expectations(job)
+    control.allowed = None  # storm over
+    job, result = reconcile(cluster, engine, job)
+    assert result.error is None
+    assert len(run_pods(cluster)) == 12
+    assert engine.satisfied_expectations(job)
+
+
+def test_fanout_scale_down_deletes_out_of_range():
+    cluster = FakeCluster()
+    engine = make_engine(
+        "TFJob", cluster, config=EngineConfig(control_fanout=4)
+    )
+    job = testutil.new_tfjob("shrink", worker=8)
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    assert len(run_pods(cluster)) == 8
+    # scale 8 -> 2: six out-of-range pods + services deleted via fan-out
+    doc = cluster.get(job.kind, "default", "shrink")
+    doc["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] = 2
+    cluster.update(job.kind, doc)
+    job, result = reconcile(cluster, engine, job)
+    assert result.error is None
+    assert [objects.name_of(p) for p in run_pods(cluster)] == [
+        "shrink-worker-0", "shrink-worker-1",
+    ]
+    assert len(cluster.list_services()) == 2
+    assert engine.satisfied_expectations(job)
+
+
+def test_fanout_terminal_teardown_deletes_everything():
+    cluster = FakeCluster()
+    engine = make_engine(
+        "TFJob", cluster, config=EngineConfig(control_fanout=8)
+    )
+    job = testutil.new_tfjob(
+        "done", worker=6,
+        run_policy=common.RunPolicy(clean_pod_policy="All"),
+    )
+    cluster.create(job.kind, job.to_dict())
+    job, _ = reconcile(cluster, engine, job)
+    assert len(run_pods(cluster)) == 6
+    doc = cluster.get(job.kind, "default", "done")
+    doc["status"]["conditions"].append({
+        "type": "Succeeded", "status": "True", "reason": "JobSucceeded",
+        "message": "done",
+    })
+    cluster.update(job.kind, doc)
+    job, result = reconcile(cluster, engine, job)
+    assert result.error is None
+    assert run_pods(cluster) == []
+    assert cluster.list_services() == []

@@ -877,6 +877,228 @@ def test_facade_update_status_single_put_fast_path(rest_cluster):
     assert e.value.code == 422 and "status" in str(e.value)
 
 
+# --------------------------------------------------- pooled keep-alive pool
+@pytest.fixture()
+def socket_cluster():
+    """ClusterClient -> pooled HttpTransport -> real TCP socket ->
+    HTTP/1.1 HttpApiServer -> FakeCluster: the full wire path the pool
+    exists for."""
+    from tf_operator_tpu.e2e.http_apiserver import HttpApiServer
+    from tf_operator_tpu.k8s.client import HttpTransport, KubeConfig
+
+    server = HttpApiServer().start()
+    transport = HttpTransport(KubeConfig(server=server.url), pool_size=4)
+    client = ClusterClient(transport)
+    yield server, transport, client
+    client.close()
+    transport.close()
+    server.stop()
+
+
+def _conn_counters():
+    from tf_operator_tpu.engine import metrics
+
+    return (
+        metrics.TRANSPORT_CONNECTIONS_CREATED.get(),
+        metrics.TRANSPORT_CONNECTIONS_REUSED.get(),
+    )
+
+
+def test_pool_reuses_one_connection_for_serial_requests(socket_cluster):
+    _, transport, client = socket_cluster
+    created0, reused0 = _conn_counters()
+    client.create_pod(objects.make_pod("p0", namespace="d"))
+    for _ in range(9):
+        client.get_pod("d", "p0")
+    created, reused = _conn_counters()
+    assert created - created0 == 1, "10 serial requests must share 1 socket"
+    assert reused - reused0 == 9
+
+
+def test_pool_bounds_parallel_requests_to_pool_size(socket_cluster):
+    """Thread-safety + the bound: 8 threads x 6 requests each never hold
+    more than pool_size sockets, and the pool serves every request."""
+    _, transport, client = socket_cluster
+    client.create_pod(objects.make_pod("p0", namespace="d"))
+    created0, reused0 = _conn_counters()
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(6):
+                client.get_pod("d", "p0")
+        except Exception as e:  # noqa: BLE001 — surfaced via the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    created, reused = _conn_counters()
+    assert created - created0 <= transport.pool_size
+    assert reused - reused0 >= 48 - transport.pool_size
+    assert len(transport._idle) <= transport.pool_size
+
+
+def test_pool_retires_errored_connection_and_replays_stale(socket_cluster):
+    """A mid-request server failure must retire that socket — never hand it
+    to the next caller — and a request that died on a REUSED socket before
+    any response bytes is replayed once on a fresh connection, so pooling
+    never introduces failures the per-request transport didn't have."""
+    server, transport, client = socket_cluster
+    client.create_pod(objects.make_pod("p0", namespace="d"))
+    client.get_pod("d", "p0")  # socket now pooled + warm
+    created0, _ = _conn_counters()
+
+    real_request = server.transport.request
+    state = {"bombs": 1}
+
+    def sabotaged(method, path, query=None, body=None):
+        if state["bombs"] > 0:
+            state["bombs"] -= 1
+            # handler thread dies mid-exchange -> socket aborted under the
+            # client, exactly like a connection reset
+            raise RuntimeError("chaos: handler killed")
+        return real_request(method, path, query, body)
+
+    server.transport.request = sabotaged
+    try:
+        # rides the poisoned pooled socket, dies without response bytes,
+        # replays on a fresh connection, succeeds — caller sees nothing
+        assert client.get_pod("d", "p0")["metadata"]["name"] == "p0"
+    finally:
+        server.transport.request = real_request
+    created, _ = _conn_counters()
+    assert created - created0 == 1, "the retired socket was replaced by one fresh dial"
+    # the pool is not poisoned: follow-up requests reuse cleanly
+    for _ in range(3):
+        client.get_pod("d", "p0")
+    assert _conn_counters()[0] == created
+
+    # POST is NEVER transport-replayed, even on a reused socket: the
+    # first attempt may have committed server-side (PR 3 invariant; the
+    # reconcile level is the idempotent replay) — the stale-socket death
+    # surfaces as a retryable connection error instead
+    state["bombs"] = 1
+    server.transport.request = sabotaged
+    try:
+        with pytest.raises((ConnectionError, ApiError)):
+            client.create_pod(objects.make_pod("p1", namespace="d"))
+    finally:
+        server.transport.request = real_request
+    # and the failure still did not poison the pool
+    assert client.get_pod("d", "p0")["metadata"]["name"] == "p0"
+
+
+def test_watch_streams_never_enter_the_pool(socket_cluster):
+    """stream() owns a private connection for its whole life: it never
+    comes from — or returns to — the request pool, and its cancel hook
+    closes that private socket."""
+    server, transport, client = socket_cluster
+    client.create_pod(objects.make_pod("seed", namespace="d"))
+    idle_before = len(transport._idle)
+    _, reused0 = _conn_counters()
+
+    got = []
+    client.subscribe("Pod", lambda et, obj: got.append((et, objects.name_of(obj))))
+    client.create_pod(objects.make_pod("post", namespace="d"))
+    deadline = time.monotonic() + 5.0
+    while ("ADDED", "post") not in got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ("ADDED", "post") in got
+    # the live watch holds no pool slot and parked nothing in the pool
+    assert len(transport._idle) <= idle_before + 1  # +1: the create above
+    loop_thread = client._watches["Pod"]._thread
+    client.close()  # cancel hook must close the watch's private socket
+    loop_thread.join(timeout=3.0)
+    assert not loop_thread.is_alive()
+    # request path still healthy afterwards
+    assert client.get_pod("d", "seed")["metadata"]["name"] == "seed"
+
+
+def test_http11_watch_is_close_framed_and_survives_410(socket_cluster):
+    """The HTTP/1.1 server keeps per-request responses keep-alive framed
+    but still ends watch streams by closing the connection (410 semantics
+    byte-compatible with the old HTTP/1.0 behavior)."""
+    server, transport, client = socket_cluster
+    got = []
+    client.subscribe("Pod", lambda et, obj: got.append((et, objects.name_of(obj))))
+    client.create_pod(objects.make_pod("a", namespace="d"))
+    _wait_until(lambda: ("ADDED", "a") in got, what="first event")
+    server.transport.expire_watches()  # 410 Gone ends the stream
+    time.sleep(0.1)
+    client.create_pod(objects.make_pod("b", namespace="d"))
+    _wait_until(lambda: ("ADDED", "b") in got, what="event after relist")
+
+
+def test_sdk_patch_path_rides_the_pooled_transport(socket_cluster):
+    """The SDK's read-merge-write PATCH emulation (GET + PUT per attempt,
+    plus conflict retries) must reuse the one pooled transport — zero new
+    connections once the pool is warm, no per-call construction."""
+    from tf_operator_tpu.sdk.client import TFJobClient
+
+    _, transport, client = socket_cluster
+    sdk = TFJobClient(client)
+    sdk.create({
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "sdkjob", "namespace": "default"},
+        "spec": {"tfReplicaSpecs": {"Worker": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "x"}]}},
+        }}},
+    })
+    created0, reused0 = _conn_counters()
+    for n in (3, 2, 3):
+        sdk.scale("sdkjob", n)
+    created, reused = _conn_counters()
+    assert created == created0, "a warm pool needs no new connections"
+    assert reused - reused0 >= 6, "every GET/PUT attempt reused a socket"
+
+
+def test_reconcile_burst_creates_at_most_pool_size_connections(socket_cluster):
+    """The acceptance claim: one reconcile burst in steady state creates at
+    most pool-size request connections (plus one dedicated connection per
+    watch stream) while the reuse counter tracks request volume."""
+    from tf_operator_tpu.cmd.manager import OperatorManager
+    from tf_operator_tpu.cmd.options import ServerOptions
+    from tf_operator_tpu.controllers.registry import EnabledSchemes
+
+    server, transport, client = socket_cluster
+    created0, reused0 = _conn_counters()
+    manager = OperatorManager(
+        client,
+        ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"])),
+    )
+    manager.factory.start_all()
+    try:
+        assert manager.factory.wait_for_cache_sync()
+        for i in range(6):
+            client.create("TFJob", {
+                "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+                "metadata": {"name": f"burst-{i}", "namespace": "default"},
+                "spec": {"tfReplicaSpecs": {"Worker": {
+                    "replicas": 2,
+                    "template": {"spec": {"containers": [
+                        {"name": "tensorflow", "image": "x"}]}},
+                }}},
+            })
+        manager.process_until_idle(timeout=30.0)
+    finally:
+        manager.stop()
+    created, reused = _conn_counters()
+    # 3 watch streams (TFJob/Pod/Service) each own one dedicated conn
+    watches = 3
+    assert created - created0 <= transport.pool_size + watches, (
+        created - created0
+    )
+    assert reused - reused0 > 2 * (created - created0), (
+        "reuse must dominate creation across a reconcile burst"
+    )
+
+
 def test_fake_update_status_merges_and_conflicts():
     """FakeCluster.update_status mirrors the façade: status merged onto the
     stored object, spec kept, rv conflict on stale writes, MODIFIED

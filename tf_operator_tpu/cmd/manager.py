@@ -68,6 +68,7 @@ class _KindController:
                 gang_scheduler_name=manager.options.gang_scheduler_name,
                 restart_backoff_base=manager.options.restart_backoff_base,
                 restart_backoff_max=manager.options.restart_backoff_max,
+                control_fanout=manager.options.control_fanout,
             ),
             **manager.engine_kwargs,
         )

@@ -44,6 +44,11 @@ class ServerOptions:
     # disables the backoff
     restart_backoff_base: float = 5.0
     restart_backoff_max: float = 300.0
+    # slow-start control fan-out cap (engine/fanout.py): max concurrent
+    # replica pod/service creates and teardown deletes per sync, reached
+    # via exponential batch growth (1, 2, 4, ...).  1 (default) keeps the
+    # strictly serial, deterministic pre-fan-out order.
+    control_fanout: int = 1
     # when True (default), reconcile errors the client layer classified as
     # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
     # consuming the bounded reconcile-retry budget; False restores the
@@ -116,6 +121,14 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "by --restart-backoff-max); <= 0 disables",
     )
     p.add_argument("--restart-backoff-max", type=float, default=300.0)
+    p.add_argument(
+        "--control-fanout",
+        type=int,
+        default=1,
+        help="max concurrent pod/service creates (and teardown deletes) "
+        "per sync, reached by exponential slow-start batches (1, 2, 4, "
+        "...); 1 (default) keeps the serial, deterministic order",
+    )
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -145,4 +158,5 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         trace_dump=a.trace_dump,
         restart_backoff_base=a.restart_backoff_base,
         restart_backoff_max=a.restart_backoff_max,
+        control_fanout=a.control_fanout,
     )

@@ -12,10 +12,16 @@ test/workflows/components/workflows.libsonnet:216-291 runs its e2e against
 a provisioned cluster; suite_test.go:50-76 boots a real apiserver binary) —
 VERDICT r3 missing #1.
 
-Watch framing matches `HttpTransport.stream`'s reader: one JSON object per
-line, connection closed by the server on 410/close (HTTP/1.0 close framing
-— the client opens a fresh connection per request anyway, matching
-client-go's behavior of pinning one connection per watch).
+Framing: HTTP/1.1 keep-alive.  Regular responses carry an explicit
+Content-Length so the client's connection POOL can ride one socket across
+many requests — an HTTP/1.0 close-per-response server would silently
+defeat `HttpTransport`'s keep-alive pool and re-pay a TCP handshake per
+call.  Watch streams are the one exception: an unbounded stream has no
+Content-Length, so the stream response advertises `Connection: close` and
+is framed by connection close, byte-compatible with the old HTTP/1.0
+behavior (one JSON object per line; server closes on 410/close) — which is
+also exactly how the client treats watches: one dedicated, never-pooled
+connection per stream.
 """
 from __future__ import annotations
 
@@ -43,10 +49,15 @@ class HttpApiServer:
         transport = self.transport
 
         class Handler(BaseHTTPRequestHandler):
-            # HTTP/1.0: every response is framed by connection close, which
-            # is exactly what an unbounded watch stream needs and costs the
-            # per-request clients nothing (they reconnect per call)
-            protocol_version = "HTTP/1.0"
+            # HTTP/1.1 keep-alive: responses are Content-Length framed so
+            # the client's connection pool reuses the socket; watch streams
+            # alone opt into close framing (Connection: close) because
+            # their length is unknowable up front
+            protocol_version = "HTTP/1.1"
+            # idle keep-alive connections are reaped after this long so a
+            # client that vanished without closing (kill -9'd operator)
+            # cannot pin handler threads forever
+            timeout = 60
 
             def log_message(self, *_args) -> None:  # quiet test output
                 pass
@@ -96,6 +107,10 @@ class HttpApiServer:
                     return self._reply(e.code, _status_payload(e.code, str(e)))
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
+                # no Content-Length is knowable for an unbounded stream:
+                # close framing, explicitly advertised (send_header also
+                # flips close_connection so the handler loop ends here)
+                self.send_header("Connection", "close")
                 self.end_headers()
                 try:
                     for event in events:

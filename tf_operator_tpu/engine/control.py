@@ -8,10 +8,31 @@ calls instead of hitting an apiserver).
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Dict, List, Optional
 
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.k8s import objects
+
+
+class _OpTimer:
+    """Times one control op into
+    tpu_operator_control_op_duration_seconds{kind,verb} — the per-operation
+    round-trip cost the transport pool and control fan-out exist to hide.
+    Failed ops are observed too: a 429 that burned its retry budget is
+    latency the sync paid."""
+
+    def __init__(self, kind: str, verb: str) -> None:
+        self._labels = {"kind": kind, "verb": verb}
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        metrics.CONTROL_OP_DURATION.observe(
+            time.perf_counter() - self._t0, self._labels
+        )
 
 
 class PodControl:
@@ -42,12 +63,14 @@ class PodControl:
             "spec": copy.deepcopy(pod_template.get("spec", {})),
             "status": {"phase": objects.POD_PENDING},
         }
-        created = self.cluster.create_pod(pod)
+        with _OpTimer("Pod", "create"):
+            created = self.cluster.create_pod(pod)
         metrics.CONTROL_OPS.inc({"kind": "Pod", "verb": "create"})
         return created
 
     def delete_pod(self, namespace: str, name: str, owner: Dict[str, Any]) -> None:
-        self.cluster.delete_pod(namespace, name)
+        with _OpTimer("Pod", "delete"):
+            self.cluster.delete_pod(namespace, name)
         metrics.CONTROL_OPS.inc({"kind": "Pod", "verb": "delete"})
 
 
@@ -67,12 +90,14 @@ class ServiceControl:
             copy.deepcopy(controller_ref)
         ]
         service["metadata"].setdefault("namespace", namespace)
-        created = self.cluster.create_service(service)
+        with _OpTimer("Service", "create"):
+            created = self.cluster.create_service(service)
         metrics.CONTROL_OPS.inc({"kind": "Service", "verb": "create"})
         return created
 
     def delete_service(self, namespace: str, name: str, owner: Dict[str, Any]) -> None:
-        self.cluster.delete_service(namespace, name)
+        with _OpTimer("Service", "delete"):
+            self.cluster.delete_service(namespace, name)
         metrics.CONTROL_OPS.inc({"kind": "Service", "verb": "delete"})
 
 

@@ -34,6 +34,7 @@ from tf_operator_tpu.api.job import Job, ValidationError
 from tf_operator_tpu.engine import metrics, tracing
 from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
 from tf_operator_tpu.engine.control import PodControl, ServiceControl
+from tf_operator_tpu.engine.fanout import FanoutResult, slow_start_batch
 from tf_operator_tpu.engine.expectations import (
     ControllerExpectations,
     gen_expectation_pods_key,
@@ -115,6 +116,14 @@ class EngineConfig:
     restart_backoff_max: float = 300.0
     restart_backoff_free_restarts: int = 1
     restart_backoff_jitter: float = 0.1
+    # Slow-start control fan-out cap (--control-fanout): replica pod/
+    # service creates and whole-slice / scale-down deletes run in
+    # exponentially growing concurrent batches (1, 2, 4, ...) capped at
+    # this many in flight (engine/fanout.py).  1 (the default) is the
+    # strictly serial path — ops run inline at their historical call
+    # sites in the historical order, no threads — so seeded chaos runs
+    # and event logs replay exactly as before the fan-out existed.
+    control_fanout: int = 1
 
 
 @dataclass
@@ -711,6 +720,14 @@ class JobEngine:
         )
         restarted_this_pass = False
         creation_deferred = False
+        # control fan-out: at fanout > 1 creates and scale-down/stale-gen
+        # deletes are COLLECTED during the scan and dispatched afterwards in
+        # slow-start batches; at fanout <= 1 `pending_ops` stays None and
+        # every op runs inline at its historical call site — the exact
+        # pre-fan-out order the seeded chaos logs replay
+        pending_ops: Optional[List] = (
+            [] if self.config.control_fanout > 1 else None
+        )
 
         slices = self.get_slices(typed, num_replicas)
         for index, pod_slice in enumerate(slices):
@@ -724,12 +741,22 @@ class JobEngine:
                     creation_deferred = True
                     continue
                 master_role = self.adapter.is_master_role(replicas, rtype, index)
-                self._create_new_pod(job, rtype, index, spec, master_role, replicas)
+                self._run_or_defer(
+                    pending_ops,
+                    lambda i=index, m=master_role: self._create_new_pod(
+                        job, rtype, i, spec, m, replicas
+                    ),
+                )
                 continue
             pod = pod_slice[0]
             if index < 0 or index >= num_replicas:
                 # out-of-range: scale down (reference tfjob_controller.go:698-703)
-                self._delete_pod_with_expectations(job, rtype, pod)
+                self._run_or_defer(
+                    pending_ops,
+                    lambda p=pod: self._delete_pod_with_expectations(
+                        job, rtype, p
+                    ),
+                )
                 continue
 
             gen = objects.pod_restart_generation(pod)
@@ -741,7 +768,12 @@ class JobEngine:
                 # stale incarnation: an earlier whole-slice teardown was
                 # interrupted (PartialSliceTeardown) — finish it instead of
                 # absorbing a pre-restart pod into the recreated slice
-                self._delete_pod_with_expectations(job, rtype, pod)
+                self._run_or_defer(
+                    pending_ops,
+                    lambda p=pod: self._delete_pod_with_expectations(
+                        job, rtype, p
+                    ),
+                )
                 if restarted_types is not None:
                     restarted_types.add(rtype)
                 continue
@@ -759,7 +791,11 @@ class JobEngine:
                 and common.is_retryable_exit_code(exit_code)
             ):
                 # delete-for-recreate + Restarting condition
-                # (reference tfjob_controller.go:705-736)
+                # (reference tfjob_controller.go:705-736).  NEVER deferred
+                # to the fan-out: the restart-counter increment just below
+                # must only happen once this delete has succeeded — a
+                # deferred failure after the increment would persist a
+                # phantom restart through the sync-level status write
                 self._delete_pod_with_expectations(job, rtype, pod)
                 msg = (
                     f"{self.adapter.KIND} {job.name} is restarting because "
@@ -795,29 +831,46 @@ class JobEngine:
             elif phase == objects.POD_FAILED:
                 rs.failed += 1
 
+        # dispatch the deferred creates / scale-down deletes (fanout > 1
+        # only) in slow-start batches; the first failure aborts the ramp
+        # and surfaces exactly like the serial path's first exception —
+        # each op raised/lowered its own expectations, and never-attempted
+        # ops never touched them, so the accounting stays exact
+        if pending_ops:
+            self._dispatch_control_ops(pending_ops).raise_first()
+
         # Whole-slice gang restart: a TPU slice is unusable partially, so a
         # retryable failure tears down ALL replicas of the type for atomic
         # recreation (SURVEY.md §5.3/§7.4.1 — no reference counterpart; the
         # reference restarts pods individually).
         if restarted_this_pass and getattr(self.adapter, "WHOLE_SLICE_RESTART", False):
-            failed_deletes: List[str] = []
-            all_transient = True
             # the sync's own snapshot (`typed`), not a re-list: pods already
             # deleted above answer NotFound (counted as success by
             # _delete_pod_with_expectations), and a pod CREATED earlier in
             # this same pass carries the pre-restart generation label, so
             # the stale-incarnation sweep deletes it on the next sync — the
-            # same repair path that finishes any interrupted teardown
+            # same repair path that finishes any interrupted teardown.
+            # abort_on_failure=False: every delete is attempted even after
+            # failures — one stuck pod must not leave the others running —
+            # then the partial teardown surfaces loudly below
+            teardown_names: List[str] = []
+            teardown_ops: List = []
             for pod_slice in self.get_slices(typed, num_replicas):
                 for pod in pod_slice:
-                    try:
-                        self._delete_pod_with_expectations(job, rtype, pod)
-                    except Exception as de:  # noqa: BLE001
-                        # keep deleting the rest of the slice — one stuck pod
-                        # must not leave the others running — then surface the
-                        # partial teardown loudly below
-                        failed_deletes.append(objects.name_of(pod))
-                        all_transient &= is_transient_api_error(de)
+                    teardown_names.append(objects.name_of(pod))
+                    teardown_ops.append(
+                        lambda p=pod: self._delete_pod_with_expectations(
+                            job, rtype, p
+                        )
+                    )
+            res = slow_start_batch(
+                teardown_ops, self.config.control_fanout,
+                abort_on_failure=False,
+            )
+            failed_deletes = [teardown_names[i] for i, _ in res.failures]
+            all_transient = all(
+                is_transient_api_error(e) for _, e in res.failures
+            )
             # counts no longer reflect reality; reset for this pass (the
             # restart counter is history, not a count of live pods — keep it;
             # the selector feeds /scale's labelSelectorPath — keep it too;
@@ -944,6 +997,28 @@ class JobEngine:
             raise
 
     # ------------------------------------------------------------- services
+    @staticmethod
+    def _run_or_defer(pending_ops: Optional[List], op) -> None:
+        """The one place the fan-out dispatch decision lives: serial mode
+        (pending_ops is None) runs the thunk inline at its historical call
+        site; fan-out mode defers it for the slow-start batch.  Callers
+        must pass a thunk that owns its captures (default-arg lambda) —
+        late-binding a loop variable would make every deferred op act on
+        the last iteration's object."""
+        if pending_ops is None:
+            op()
+        else:
+            pending_ops.append(op)
+
+    def _dispatch_control_ops(
+        self, ops: List, abort_on_failure: bool = True
+    ) -> FanoutResult:
+        """Run deferred control ops through the slow-start fan-out (only the
+        fanout > 1 paths defer; the serial engine never builds an op list)."""
+        return slow_start_batch(
+            ops, self.config.control_fanout, abort_on_failure=abort_on_failure
+        )
+
     def reconcile_services(
         self,
         job: Job,
@@ -953,27 +1028,49 @@ class JobEngine:
     ) -> None:
         """One headless Service per replica index — the stable DNS identity
         peers dial ({job}-{rt}-{i}.{ns}.svc, reference tensorflow.go:153-166;
-        engine ReconcileServices)."""
+        engine ReconcileServices).  Creates and scale-down deletes ride the
+        same slow-start fan-out as pods (inline and strictly ordered at
+        fanout <= 1)."""
         typed = self.filter_for_replica_type(services, rtype)
         num_replicas = spec.replicas or 0
         slices = self.get_slices(typed, num_replicas)
+        pending_ops: Optional[List] = (
+            [] if self.config.control_fanout > 1 else None
+        )
         for index, svc_slice in enumerate(slices):
             if len(svc_slice) > 1:
                 continue
             if len(svc_slice) == 0:
-                self._create_new_service(job, rtype, index, spec)
+                self._run_or_defer(
+                    pending_ops,
+                    lambda i=index: self._create_new_service(
+                        job, rtype, i, spec
+                    ),
+                )
             else:
                 svc = svc_slice[0]
                 if index >= num_replicas:
-                    key = gen_expectation_services_key(job.key, rtype)
-                    self.expectations.raise_expectations(key, 0, 1)
-                    try:
-                        self.service_control.delete_service(
-                            job.namespace, objects.name_of(svc), job.to_dict()
-                        )
-                    except Exception:
-                        self.expectations.lower_expectations(key, 0, 1)
-                        raise
+                    self._run_or_defer(
+                        pending_ops,
+                        lambda s=svc:
+                        self._delete_service_with_expectations(job, rtype, s),
+                    )
+        if pending_ops:
+            self._dispatch_control_ops(pending_ops).raise_first()
+
+    def _delete_service_with_expectations(
+        self, job: Job, rtype: str, svc: Dict[str, Any]
+    ) -> None:
+        """Expectation-guarded service delete (scale-down path)."""
+        key = gen_expectation_services_key(job.key, rtype)
+        self.expectations.raise_expectations(key, 0, 1)
+        try:
+            self.service_control.delete_service(
+                job.namespace, objects.name_of(svc), job.to_dict()
+            )
+        except Exception:
+            self.expectations.lower_expectations(key, 0, 1)
+            raise
 
     def _create_new_service(
         self, job: Job, rtype: str, index: int, spec: common.ReplicaSpec
@@ -1031,6 +1128,11 @@ class JobEngine:
         policy = job.run_policy.clean_pod_policy or common.CLEAN_POD_POLICY_RUNNING
         if not force_all and policy == common.CLEAN_POD_POLICY_NONE:
             return
+        # whole-slice teardown rides the slow-start fan-out too: every op
+        # swallows its own errors (teardown is best-effort and re-driven by
+        # the next sync), so abort_on_failure=False and the serial path is
+        # byte-identical to the historical per-pod loop
+        ops: List = []
         for pod in pods:
             if (
                 not force_all
@@ -1038,15 +1140,10 @@ class JobEngine:
                 and objects.pod_phase(pod) != objects.POD_RUNNING
             ):
                 continue
-            name = objects.name_of(pod)
-            try:
-                self.pod_control.delete_pod(job.namespace, name, job.to_dict())
-            except Exception:
-                pass
-            try:
-                self.service_control.delete_service(job.namespace, name, job.to_dict())
-            except Exception:
-                pass
+            ops.append(
+                lambda n=objects.name_of(pod):
+                self._delete_pod_and_service_quietly(job, n)
+            )
         # orphan services: a pod-less service (earlier swallowed delete
         # error) is always cleaned; services whose pod exists were already
         # handled alongside the pod above (or deliberately kept by policy)
@@ -1055,12 +1152,27 @@ class JobEngine:
             name = objects.name_of(svc)
             if name in pod_names:
                 continue
-            try:
-                self.service_control.delete_service(
-                    job.namespace, name, job.to_dict()
-                )
-            except Exception:
-                pass
+            ops.append(
+                lambda n=name: self._delete_service_quietly(job, n)
+            )
+        slow_start_batch(
+            ops, self.config.control_fanout, abort_on_failure=False
+        )
+
+    def _delete_pod_and_service_quietly(self, job: Job, name: str) -> None:
+        try:
+            self.pod_control.delete_pod(job.namespace, name, job.to_dict())
+        except Exception:
+            pass
+        self._delete_service_quietly(job, name)
+
+    def _delete_service_quietly(self, job: Job, name: str) -> None:
+        try:
+            self.service_control.delete_service(
+                job.namespace, name, job.to_dict()
+            )
+        except Exception:
+            pass
 
     def _cleanup_job_ttl(self, job: Job) -> ReconcileResult:
         """TTLSecondsAfterFinished: delete the job CR once expired, else

@@ -1,0 +1,153 @@
+"""Slow-start control fan-out — client-go's slowStartBatch for this engine.
+
+The reference issues pod/service creates through kubeflow/common's
+CreatePodsWithControllerRef, which ultimately rides client-go's
+`slowStartBatch` (kubernetes pkg/controller/controller_utils.go): operations
+run in concurrent batches that grow exponentially — 1, 2, 4, ... — so a
+healthy apiserver quickly reaches full parallelism while a failing one is
+probed with a single cheap request instead of a thundering herd of N
+doomed creates.  This module is that algorithm, parameterized by the
+`--control-fanout` cap:
+
+  - ``fanout <= 1`` is the SERIAL path: every op runs inline on the calling
+    thread, in list order, exactly like the pre-fan-out engine — no threads
+    are ever created, so deterministic harnesses (the seeded chaos soak,
+    single-threaded test dispatch) replay byte-identically.
+  - ``fanout > 1`` dispatches each batch on short-lived worker threads,
+    batch size capped at ``fanout``.  With ``abort_on_failure`` (the create
+    path), a batch containing any failure stops the ramp: in-flight ops of
+    that batch complete, remaining ops are never attempted — client-go
+    semantics, so one quota denial costs O(batch) requests, not O(N).
+    Teardown paths pass ``abort_on_failure=False``: every delete is
+    attempted regardless of earlier failures (one stuck pod must not leave
+    the rest of a slice running), only the parallelism changes.
+
+Expectations accounting is the caller's contract: each op raises its own
+expectation immediately before its API call and lowers it on failure (the
+same raise/lower pairing the serial engine always had), so ops that are
+never attempted never touch expectations, and `satisfied_expectations`
+stays exact under partial failure.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from tf_operator_tpu.engine import metrics
+
+SLOW_START_INITIAL_BATCH_SIZE = 1  # client-go SlowStartInitialBatchSize
+
+# One shared worker pool for every fan-out dispatch in the process:
+# batches are joined inside each slow_start_batch call, so the per-call
+# concurrency bound is the batch size (<= fanout), not the pool size —
+# sharing only amortizes thread creation, which would otherwise be paid
+# per batch, per sync.  The pool bounds TOTAL fan-out concurrency across
+# concurrent syncs; a fanout above it still completes, just no wider.
+_MAX_FANOUT_WORKERS = 64
+_executor: Optional[ThreadPoolExecutor] = None
+_executor_lock = threading.Lock()
+
+
+def _shared_executor() -> ThreadPoolExecutor:
+    global _executor
+    with _executor_lock:
+        if _executor is None:
+            _executor = ThreadPoolExecutor(
+                max_workers=_MAX_FANOUT_WORKERS,
+                thread_name_prefix="control-fanout",
+            )
+        return _executor
+
+
+@dataclass
+class FanoutResult:
+    """Outcome of one slow_start_batch run.
+
+    ``failures`` carries (op index, exception) for every attempted op that
+    raised; ``attempted`` counts ops that ran (successes + failures) — ops
+    past an abort were never started and appear in neither."""
+
+    successes: int = 0
+    attempted: int = 0
+    failures: List[Tuple[int, BaseException]] = field(default_factory=list)
+
+    @property
+    def first_error(self) -> Optional[BaseException]:
+        if not self.failures:
+            return None
+        return min(self.failures, key=lambda f: f[0])[1]
+
+    def raise_first(self) -> None:
+        err = self.first_error
+        if err is not None:
+            raise err
+
+
+def slow_start_batch(
+    ops: Sequence[Callable[[], Any]],
+    fanout: int,
+    abort_on_failure: bool = True,
+    observe: Optional[Callable[[int], None]] = None,
+) -> FanoutResult:
+    """Run ``ops`` with exponential batch growth capped at ``fanout``.
+
+    ``observe`` (when given) receives each dispatched batch's size — the
+    hook the engine points at the fan-out batch-size histogram."""
+    result = FanoutResult()
+    if not ops:
+        return result
+    if observe is None:
+        observe = lambda n: metrics.CONTROL_FANOUT_BATCH.observe(n)  # noqa: E731
+
+    if fanout <= 1:
+        # serial fast path: no threads, strict list order, first failure
+        # aborts (or not) exactly like the batched path with batch size 1
+        for i, op in enumerate(ops):
+            observe(1)
+            result.attempted += 1
+            try:
+                op()
+                result.successes += 1
+            except Exception as e:  # noqa: BLE001 — collected for the caller
+                result.failures.append((i, e))
+                if abort_on_failure:
+                    break
+        return result
+
+    pos = 0
+    batch = SLOW_START_INITIAL_BATCH_SIZE
+    lock = threading.Lock()
+    while pos < len(ops):
+        size = min(batch, fanout, len(ops) - pos)
+        observe(size)
+        batch_failed = False
+
+        def run_one(index: int) -> None:
+            nonlocal batch_failed
+            try:
+                ops[index]()
+                with lock:
+                    result.successes += 1
+            except Exception as e:  # noqa: BLE001 — collected for the caller
+                with lock:
+                    result.failures.append((index, e))
+                    batch_failed = True
+
+        result.attempted += size
+        if size == 1:
+            run_one(pos)
+        else:
+            futures = [
+                _shared_executor().submit(run_one, pos + j)
+                for j in range(size)
+            ]
+            for f in futures:
+                f.result()  # run_one never raises; this is the join
+        pos += size
+        if batch_failed and abort_on_failure:
+            break
+        batch *= 2
+    result.failures.sort(key=lambda f: f[0])
+    return result

@@ -295,6 +295,32 @@ CACHED_LIST_MISSES = Counter(
     "and reason (no_lister = engine running without informer wiring, "
     "not_synced = informer cache not yet listed)",
 )
+TRANSPORT_CONNECTIONS_CREATED = Counter(
+    f"{PREFIX}_transport_connections_created_total",
+    "TCP/TLS connections dialed by the keep-alive HttpTransport (pool "
+    "misses plus one dedicated connection per watch stream); in steady "
+    "state this stays near the pool size while reuse tracks request "
+    "volume",
+)
+TRANSPORT_CONNECTIONS_REUSED = Counter(
+    f"{PREFIX}_transport_connections_reused_total",
+    "Requests served on a pooled keep-alive connection instead of a "
+    "fresh handshake — created vs reused is the pool's hit ratio",
+)
+CONTROL_FANOUT_BATCH = Histogram(
+    f"{PREFIX}_control_fanout_batch_ops",
+    "Operations dispatched per slow-start control fan-out batch "
+    "(client-go slowStartBatch: 1, 2, 4, ... capped by --control-fanout; "
+    "a distribution stuck at 1 means serial mode or constant early "
+    "failures)",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+CONTROL_OP_DURATION = Histogram(
+    f"{PREFIX}_control_op_duration_seconds",
+    "Latency of one pod/service create/delete issued by the control "
+    "layer, labeled by kind and verb — the per-operation cost the "
+    "transport pool and control fan-out exist to hide",
+)
 
 
 class ReplicaGaugeTracker:

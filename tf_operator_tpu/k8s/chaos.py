@@ -167,9 +167,13 @@ class FaultInjector:
 
     # ------------------------------------------------------------- schedule
     def at(self, t: float, fn: Callable[[], None], label: str) -> None:
-        """Schedule `fn` at simulated time `t` (absolute); fired by step()."""
-        self._seq += 1
-        heapq.heappush(self._schedule, _Scheduled(t, self._seq, label, fn))
+        """Schedule `fn` at simulated time `t` (absolute); fired by step().
+        Locked: with control fan-out > 1 the chaos kubelet's hooks fire
+        from concurrent create threads, and an unlocked seq++/heappush
+        pair would corrupt the schedule heap."""
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._schedule, _Scheduled(t, self._seq, label, fn))
 
     def after(self, dt: float, fn: Callable[[], None], label: str) -> None:
         self.at(self.clock() + dt, fn, label)
@@ -177,12 +181,19 @@ class FaultInjector:
     def step(self, dt: float = 1.0) -> None:
         """Advance the simulated clock and fire everything that came due, in
         (time, schedule-order) order — the single source of chaos, so the
-        event log replays identically for a given seed + schedule."""
+        event log replays identically for a given seed + schedule.  The
+        pop+log pair holds the schedule lock; the action itself runs
+        outside it (actions create/update objects, which may schedule
+        follow-ups through at() — RLock-safe, but holding the lock across
+        store calls would serialize against every concurrent fan-out op)."""
         self.clock.advance(dt)
         now = self.clock()
-        while self._schedule and self._schedule[0].at <= now:
-            item = heapq.heappop(self._schedule)
-            self._log(f"t={item.at:g} {item.label}")
+        while True:
+            with self._lock:
+                if not self._schedule or self._schedule[0].at > now:
+                    return
+                item = heapq.heappop(self._schedule)
+                self._log(f"t={item.at:g} {item.label}")
             item.fn()
 
     def run_until(self, t: float, dt: float = 1.0) -> None:

@@ -25,12 +25,18 @@ import base64
 import json
 import os
 import random
+import socket as _socket
 import ssl
 import tempfile
 import threading
 import time
 from dataclasses import dataclass
-from http.client import HTTPConnection, HTTPSConnection
+from http.client import (
+    BadStatusLine,
+    HTTPConnection,
+    HTTPException,
+    HTTPSConnection,
+)
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
 
@@ -268,14 +274,39 @@ def in_cluster_config() -> KubeConfig:
 
 # ------------------------------------------------------------------ transport
 class HttpTransport:
-    """Blocking HTTP(S) to the apiserver, one connection per request (plus a
-    dedicated connection per watch stream).  Deliberately boring: the
-    operator's QPS is single-digit (reference options.go:81-82 defaults
-    qps=5 burst=10); connection reuse is not the bottleneck."""
+    """Blocking HTTP(S) to the apiserver over a bounded KEEP-ALIVE pool:
+    requests check a connection out, ride it, and check it back in, so the
+    steady-state cost of an API call is one round trip — not a TCP (and
+    TLS) handshake plus a round trip.  Watch streams never touch the pool:
+    each `stream()` owns a private connection for its whole life (client-go
+    pins one connection per watch the same way) and its cancel hook closes
+    that socket.
 
-    def __init__(self, config: KubeConfig, timeout: float = 30.0) -> None:
+    Failure containment: any transport error — connection reset, a
+    mid-response drop, a `FaultInjector`-style storm — RETIRES the socket
+    it happened on.  A poisoned connection must never be handed to the
+    next request; the next checkout dials fresh.  An IDEMPOTENT request
+    (GET/PUT/DELETE) that dies on a REUSED socket before any response
+    bytes arrive is replayed once on a fresh connection: the
+    overwhelmingly likely cause is the server having closed the idle
+    keep-alive socket between requests (urllib3 replays exactly this
+    case), and without the replay pooling would *introduce* spurious
+    failures the one-connection-per-request transport never had.  POST is
+    never transport-replayed (the reconcile level is the idempotent
+    replay — PR 3 invariant), and nothing is replayed once the response
+    status line has arrived: the server processed that request.
+
+    `tpu_operator_transport_connections_created_total` /
+    `..._reused_total` make the reuse ratio observable: a reconcile burst
+    in steady state should create at most `pool_size` connections while
+    the reused counter tracks request volume."""
+
+    def __init__(
+        self, config: KubeConfig, timeout: float = 30.0, pool_size: int = 8
+    ) -> None:
         self.config = config
         self.timeout = timeout
+        self.pool_size = max(1, int(pool_size))
         u = urlsplit(config.server)
         self._https = u.scheme == "https"
         self._host = u.hostname or "localhost"
@@ -291,13 +322,63 @@ class HttpTransport:
                     config.client_cert_file, config.client_key_file
                 )
             self._ssl_ctx = ctx
+        self._pool_lock = threading.Lock()
+        self._idle: List[Any] = []  # LIFO: most-recently-used first
+        self._closed = False
+        # bounds CONCURRENT request connections (idle + checked out) at
+        # pool_size: parallel callers beyond the bound wait for a checkin
+        # rather than dialing an unbounded herd at the apiserver
+        self._slots = threading.BoundedSemaphore(self.pool_size)
 
     def _connect(self, timeout: Optional[float]):
+        metrics.TRANSPORT_CONNECTIONS_CREATED.inc()
         if self._https:
             return HTTPSConnection(
                 self._host, self._port, timeout=timeout, context=self._ssl_ctx
             )
         return HTTPConnection(self._host, self._port, timeout=timeout)
+
+    # ------------------------------------------------------------- pool
+    def _checkout(self) -> Tuple[Any, bool]:
+        """-> (connection, reused).  Blocks while pool_size connections are
+        already in flight; LIFO reuse keeps the warmest socket busiest so
+        idle ones age out server-side first."""
+        self._slots.acquire()
+        with self._pool_lock:
+            if self._idle:
+                metrics.TRANSPORT_CONNECTIONS_REUSED.inc()
+                return self._idle.pop(), True
+        return self._connect(self.timeout), False
+
+    def _checkin(self, conn) -> None:
+        with self._pool_lock:
+            if not self._closed:
+                self._idle.append(conn)
+                conn = None
+        if conn is not None:  # transport closed while this request flew
+            conn.close()
+        self._slots.release()
+
+    def _retire(self, conn) -> None:
+        """Errored (or server-closed) socket: close it and free the slot —
+        never back into the pool."""
+        try:
+            conn.close()
+        except Exception:
+            pass
+        self._slots.release()
+
+    def close(self) -> None:
+        """Drop all idle pooled connections; in-flight ones close on their
+        request's retire/checkin."""
+        with self._pool_lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except Exception:
+                pass
 
     def _headers(self, has_body: bool) -> Dict[str, str]:
         h = {"Accept": "application/json"}
@@ -321,19 +402,59 @@ class HttpTransport:
         defensively."""
         if query:
             path = f"{path}?{urlencode(query)}"
-        conn = self._connect(self.timeout)
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            conn.request(method, path, body=payload, headers=self._headers(body is not None))
-            resp = conn.getresponse()
-            raw = resp.read()
+        payload = json.dumps(body).encode() if body is not None else None
+        while True:
+            conn, reused = self._checkout()
+            try:
+                conn.request(
+                    method, path, body=payload,
+                    headers=self._headers(body is not None),
+                )
+                resp = conn.getresponse()
+            except (HTTPException, OSError) as e:
+                self._retire(conn)
+                # Stale keep-alive: the server closed this idle socket
+                # between requests, so nothing of the request was processed
+                # — replay once on a fresh connection (a fresh-connection
+                # failure raises: reused is False).  ONLY idempotent verbs:
+                # a POST that died here *probably* never reached the
+                # server, but "probably" is not the transport's call to
+                # make — PR 3's invariant stands (POST is never
+                # transport-replayed; the reconcile level is the
+                # idempotent replay), so a stale-socket POST surfaces as a
+                # retryable connection error instead.
+                if (
+                    reused
+                    and method in ("GET", "PUT", "DELETE")
+                    and isinstance(
+                        e, (BadStatusLine, ConnectionError, ssl.SSLEOFError)
+                    )
+                ):
+                    continue
+                raise
+            except Exception:
+                self._retire(conn)
+                raise
+            try:
+                raw = resp.read()
+            except Exception:
+                # the status line arrived, so the server processed the
+                # request: a mid-body drop retires the socket but must
+                # NEVER replay — the write may have committed
+                self._retire(conn)
+                raise
             headers = dict(resp.headers.items())
+            # reuse only when the response says the connection survives
+            # (HTTP/1.1 keep-alive with sound framing); a close-framed or
+            # errored response retires the socket
+            if resp.will_close or not resp.isclosed():
+                self._retire(conn)
+            else:
+                self._checkin(conn)
             ctype = resp.headers.get("Content-Type", "")
             if "json" in ctype:
                 return resp.status, json.loads(raw) if raw else None, headers
             return resp.status, raw.decode(errors="replace"), headers
-        finally:
-            conn.close()
 
     def stream(
         self,
@@ -350,10 +471,33 @@ class HttpTransport:
             path = f"{path}?{urlencode(query)}"
         # connect + register the cancel hook EAGERLY (not inside the
         # generator): the consumer snapshots `cancel` before first next(),
-        # and a lazily-registered hook would be invisible to it
+        # and a lazily-registered hook would be invisible to it.  The
+        # watch's connection is PRIVATE — it never comes from or returns
+        # to the request pool: an unbounded stream would otherwise pin a
+        # pool slot for its whole life and starve request traffic.
         conn = self._connect(None)  # watches are long-lived: no read timeout
+        # connect NOW and pin the raw socket: a close-framed (Connection:
+        # close) response makes http.client detach `conn.sock` when the
+        # response is created, so a late getattr would find None and the
+        # cancel hook would wake nobody
+        conn.connect()
+        sock = conn.sock
+
+        def _cancel() -> None:
+            # shutdown() BEFORE close(): close() only drops the fd refcount
+            # and does not wake a thread parked in recv() on a quiet watch
+            # — shutdown() does, and the reader then sees EOF and exits
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except Exception:
+                pass
+
         if cancel is not None:
-            cancel.append(conn.close)
+            cancel.append(_cancel)
 
         def _events() -> Iterator[Dict[str, Any]]:
             try:

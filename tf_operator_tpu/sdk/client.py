@@ -45,6 +45,28 @@ class JobClient:
         self.cluster = cluster
         self.kind = kind or self.KIND
 
+    @classmethod
+    def from_kubeconfig(
+        cls,
+        path: str = "",
+        namespace: str = "",
+        context: Optional[str] = None,
+        kind: Optional[str] = None,
+    ) -> "JobClient":
+        """SDK client over ONE long-lived `ClusterClient` (and therefore one
+        pooled keep-alive `HttpTransport`).  Every SDK call — including each
+        GET/PUT attempt of `patch`'s read-merge-write emulation and its
+        conflict retries — rides the same connection pool; nothing on the
+        SDK path ever constructs a per-call transport or connection, so a
+        retry loop costs round trips, not TCP/TLS handshakes."""
+        from tf_operator_tpu.k8s.client import ClusterClient
+
+        return cls(
+            ClusterClient.from_kubeconfig(path, namespace=namespace,
+                                          context=context),
+            kind=kind,
+        )
+
     # ------------------------------------------------------------- CRUD
     def create(
         self, job, namespace: str = "default", validate: bool = True
@@ -76,7 +98,10 @@ class JobClient:
         apiserver PATCH merges server-side and cannot rv-conflict; the
         emulation can — whenever the operator's status write lands between
         our read and write — so a conflict re-reads and re-merges instead
-        of surfacing an error a real PATCH caller would never see."""
+        of surfacing an error a real PATCH caller would never see.  All
+        attempts go through `self.cluster` (one shared transport): on the
+        pooled HttpTransport the whole retry ladder reuses keep-alive
+        sockets instead of re-dialing per attempt."""
         for attempt in range(5):
             current = self.cluster.get(self.kind, namespace, name)
             try:
