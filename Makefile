@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -31,7 +31,8 @@ metrics-lint:
 	python hack/check_metric_names.py
 
 # `make test` exercises the chaos harness on its default single seed (the
-# soak in tests/test_chaos.py); `make chaos` widens it to several fixed
+# soak in tests/test_chaos.py, which now includes the seeded
+# shard-crash-mid-storm soak); `make chaos` widens it to several fixed
 # seeds for the full fault-injection sweep (docs/robustness.md).
 test: native metrics-lint
 	python -m pytest tests/ -x -q
@@ -57,6 +58,14 @@ bench-scale:
 bench-startup:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_startup_replica_sweep; \
 	print(json.dumps(bench_startup_replica_sweep(), indent=1))"
+
+# Sharded control-plane throughput + failover: bench_operator_scale at
+# shards 1/4/8 on fake + rest backends — jobs/s, reconcile p99, and (on
+# sharded rows) crash-failover recovery time per row (ISSUE 6 evidence,
+# no TPU required).
+bench-shard:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_shard_sweep; \
+	print(json.dumps(bench_shard_sweep(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

@@ -1288,13 +1288,22 @@ def _reconcile_percentiles():
 
 
 def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
-                         backend: str = "fake"):
+                         backend: str = "fake", shards: int = 1,
+                         failover: bool = False, lease_duration: float = 5.0):
     """Operator throughput at the reference's design scale target of O(100)
     concurrent jobs per cluster with a single controller (reference design
     doc tf_job_design_doc.md:24; SURVEY.md §6).  Creates n_jobs TFJobs
     against the engine + a stub kubelet that marks pods Running, and times
-    until every job carries a Running condition."""
-    from tf_operator_tpu.cmd.manager import OperatorManager
+    until every job carries a Running condition.
+
+    `shards > 1` runs the sharded control plane (cmd/manager.py
+    ShardedOperator): jobs partitioned by rendezvous hash, per-slot
+    leases, each shard with its own workqueue/expectations/workers.
+    `failover=True` additionally crashes shard 0 once everything is
+    Running and measures crash -> (slots re-acquired + all moved jobs
+    re-adopted and re-synced) — the recovery-time row `make bench-shard`
+    reports; `lease_duration` bounds detection latency."""
+    from tf_operator_tpu.cmd.manager import OperatorManager, ShardedOperator
     from tf_operator_tpu.cmd.options import ServerOptions
     from tf_operator_tpu.engine import metrics as em
     from tf_operator_tpu.k8s.kubelet_util import write_pod_status
@@ -1342,13 +1351,40 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
                 lambda p: p.setdefault("status", {}).update(phase="Running"),
             )
 
+    # progress is tracked from the backing store's own job events instead
+    # of polling LISTs: a 10ms list-everything poll deep-copied all N jobs
+    # under the store lock — O(N) lock hold a hundred times a second was
+    # the dominant cost of the measurement itself at N=1k, starving the
+    # very control plane being measured
+    running_lock = threading.Lock()
+    running_jobs: set = set()
+
+    def track_running(etype, job):
+        name = name_of(job)
+        with running_lock:
+            if etype == "DELETED":
+                running_jobs.discard(name)
+            elif job_state(job) == "Running":
+                running_jobs.add(name)
+            else:
+                running_jobs.discard(name)
+
     # the kubelet lives on the backing store (like a real kubelet beside a
     # real apiserver); the operator runs over `cluster` (possibly REST)
     backing.subscribe("Pod", instant_kubelet)
+    backing.subscribe("TFJob", track_running)
     kubelet_thread = threading.Thread(target=kubelet_worker, daemon=True)
     kubelet_thread.start()
-    manager = OperatorManager(cluster, ServerOptions(threadiness=threadiness))
+    if shards > 1:
+        manager = ShardedOperator(
+            cluster, ServerOptions(threadiness=threadiness),
+            shard_count=shards, lease_duration=lease_duration,
+        )
+    else:
+        manager = OperatorManager(cluster, ServerOptions(threadiness=threadiness))
     manager.start()
+    failover_s = None
+    failed_over_still_running = None
     try:
         t0 = time.perf_counter()
         for i in range(n_jobs):
@@ -1364,14 +1400,49 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
         deadline = t0 + 120.0
         running = 0
         while time.perf_counter() < deadline:
-            running = sum(
-                1 for j in cluster.list("TFJob", namespace="default")
-                if job_state(j) == "Running"
-            )
+            with running_lock:
+                running = len(running_jobs)
             if running == n_jobs:
                 break
             time.sleep(0.01)
         dt = time.perf_counter() - t0
+        if failover and shards > 1 and running == n_jobs:
+            # crash shard 0 and measure until every one of its slots is
+            # re-owned by a survivor AND the re-adopt syncs have drained —
+            # detection (lease lapse) + takeover + re-list + re-sync
+            victim_slots = set(manager.shards[0].owned_slots)
+            t_crash = time.perf_counter()
+            manager.crash_shard(0)
+            fo_deadline = t_crash + 60.0
+            while time.perf_counter() < fo_deadline:
+                owners = {s: manager.slot_owner(s) for s in victim_slots}
+                # require the recorded adoption-complete events, not just
+                # slot_owner: _adopt marks the slot owned BEFORE it
+                # enqueues the re-adopt keys, so owned-slots + empty
+                # queues can be observed inside that window and stamp a
+                # recovery time that measured only lease lapse + takeover
+                adopted_slots = {
+                    e["slot"]
+                    for e in manager.failover_events
+                    if e["shard"] != 0
+                }
+                if (
+                    all(o is not None and o != 0 for o in owners.values())
+                    and victim_slots <= adopted_slots
+                ):
+                    live = [
+                        ctl
+                        for sh in manager.shards if not sh.crashed
+                        for ctl in sh.manager.controllers.values()
+                    ]
+                    if all(len(c.queue) == 0 and c.queue.empty() for c in live):
+                        failover_s = time.perf_counter() - t_crash
+                        break
+                time.sleep(0.002)
+            failed_over_still_running = sum(
+                1 for j in cluster.list("TFJob", namespace="default")
+                if job_state(j) == "Running"
+            ) == n_jobs
     finally:
         pod_q.put(None)
         kubelet_thread.join(timeout=10.0)
@@ -1389,6 +1460,7 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
         "jobs": n_jobs,
         "pods": 2 * n_jobs,
         "threadiness": threadiness,
+        "shards": shards,
         "all_running": running == n_jobs,
         "create_to_all_running_s": round(dt, 3),
         "jobs_per_sec": round(n_jobs / dt, 1) if dt > 0 else None,
@@ -1403,9 +1475,41 @@ def bench_operator_scale(n_jobs: int = 100, threadiness: int = 4,
             "misses": _counter_rows(em.CACHED_LIST_MISSES),
         },
     }
+    if failover and shards > 1:
+        out["failover_recovery_s"] = (
+            round(failover_s, 3) if failover_s is not None else None
+        )
+        out["all_running_after_failover"] = failed_over_still_running
     if backend == "rest":
         out["rest_breakdown"] = cluster.transport.profile_summary()
     return out
+
+
+def bench_shard_sweep(
+    n_jobs_fake: int = 1000,
+    n_jobs_rest: int = 300,
+    shard_counts=(1, 4, 8),
+    threadiness: int = 2,
+):
+    """`make bench-shard` — bench_operator_scale across shard counts on
+    both backends.  Each sharded row also crashes one shard after
+    convergence and reports failover recovery time (lease lapse + takeover
+    + re-adopt + re-sync).  The jobs/s ratio of shards=8 vs shards=1 on
+    the fake backend is the ISSUE 6 scaling evidence."""
+    rows = []
+    for backend in ("fake", "rest"):
+        n = n_jobs_fake if backend == "fake" else n_jobs_rest
+        for shards in shard_counts:
+            rows.append(
+                bench_operator_scale(
+                    n_jobs=n,
+                    threadiness=threadiness,
+                    backend=backend,
+                    shards=shards,
+                    failover=shards > 1,
+                )
+            )
+    return rows
 
 
 def bench_data_loader(n_records: int = 20000, batch: int = 256):

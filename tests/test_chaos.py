@@ -13,11 +13,12 @@ retry-budget exhaustion and hot-loop pod churn.
 the default single seed keeps tier-1 fast.
 """
 import os
+import threading
 
 import pytest
 
 from tf_operator_tpu.api import common
-from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.manager import OperatorManager, ShardedOperator
 from tf_operator_tpu.cmd.options import ServerOptions
 from tf_operator_tpu.controllers.registry import EnabledSchemes
 from tf_operator_tpu.engine import metrics
@@ -104,7 +105,25 @@ def audit_orphans(inner, kind="TFJob"):
     return problems
 
 
-def make_harness(seed, backoff_base=20.0, classify=True, fanout=1):
+def _controllers(mgr):
+    """Live controllers across both manager shapes (sharded mode skips
+    crashed shards — a crashed worker processes nothing)."""
+    if isinstance(mgr, ShardedOperator):
+        return [
+            ctl
+            for s in mgr.shards
+            if not s.crashed
+            for ctl in s.manager.controllers.values()
+        ]
+    return list(mgr.controllers.values())
+
+
+def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
+                 shards=None, lease_duration=24.0):
+    """`shards=None` is the historical single OperatorManager; an int
+    builds the ShardedOperator over the same injector (shards=1 disables
+    leases — single-owner mode must stay byte-identical to the pre-shard
+    engine, which the golden-log test asserts)."""
     inner = FakeCluster()
     clock = SimClock()
     inj = FaultInjector(inner, seed=seed, clock=clock)
@@ -116,13 +135,22 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1):
         classify_retryable_errors=classify,
         control_fanout=fanout,
     )
-    mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
+    if shards is None:
+        mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
+    else:
+        mgr = ShardedOperator(
+            inj, opts, shard_count=shards, engine_kwargs={"clock": clock},
+            clock=clock, lease_duration=lease_duration, note=inj.note,
+        )
     # all delays collapse to immediate adds: pop order (and therefore the
     # whole run) becomes a pure function of the seed + schedule, and no
     # real-time timer ever fires mid-soak
-    for ctl in mgr.controllers.values():
+    for ctl in _controllers(mgr):
         ctl.queue = DeterministicQueue()
-    mgr.factory.start_all()
+    if shards is None:
+        mgr.factory.start_all()
+    else:
+        mgr.start(workers=False)  # slot leases first, then informers
     return inner, clock, inj, mgr, auditor
 
 
@@ -132,7 +160,7 @@ def drain(mgr, budget=80):
     requeues every key immediately — the budget bounds the spin)."""
     for _ in range(budget):
         busy = False
-        for ctl in mgr.controllers.values():
+        for ctl in _controllers(mgr):
             key = ctl.queue.get(timeout=0)
             if key is None:
                 continue
@@ -148,6 +176,10 @@ def drain(mgr, budget=80):
 def run_steps(inj, mgr, steps, dt=5.0):
     for _ in range(steps):
         inj.step(dt)
+        if isinstance(mgr, ShardedOperator):
+            # deterministic lease maintenance: renewals, lapse detection,
+            # takeover — the SimClock beat replaces the background loop
+            mgr.tick()
         # periodic resync stands in for the real informers' resync loop: it
         # re-enqueues every key (progress for keys parked behind real-time
         # delays) and retries any pending watch-gap relist
@@ -163,11 +195,13 @@ def _exitcode_tfjob(name, workers=3):
 
 
 # ---------------------------------------------------------------- the soak
-def run_soak(seed, fanout=1):
+def run_soak(seed, fanout=1, shards=None):
     """The acceptance scenario: overlapping 429/500/conflict/reset/stale
     storms, a Pod+Service watch outage, and two worker preemptions, then a
     long quiet tail (expectation TTL + backoff windows) to converge."""
-    inner, clock, inj, mgr, auditor = make_harness(seed, fanout=fanout)
+    inner, clock, inj, mgr, auditor = make_harness(
+        seed, fanout=fanout, shards=shards
+    )
     inj.schedule_storm(10, 15, fault="429", retry_after=3.0)
     inj.schedule_storm(30, 10, fault="500")
     inj.schedule_storm(42, 6, fault="conflict", ops=["update"])
@@ -246,6 +280,198 @@ def test_fanout1_soak_log_matches_pre_fanout_golden():
     with open(golden) as f:
         expected = f.read().splitlines()
     assert run_soak(1337, fanout=1) == expected
+
+
+def test_sharded_single_shard_soak_log_matches_pre_shard_golden():
+    """ISSUE 6 acceptance: the shards=1 control plane (ShardedOperator
+    around one OperatorManager, leases off, static ownership) must replay
+    the PRE-shard engine's event log byte-for-byte — the shard library is
+    a pure superset at N=1."""
+    golden = os.path.join(
+        os.path.dirname(__file__), "data", "chaos_soak_log_1337.txt"
+    )
+    with open(golden) as f:
+        expected = f.read().splitlines()
+    assert run_soak(1337, shards=1) == expected
+
+
+# ------------------------------------------------- sharded chaos scenarios
+def _stamped_exitcode_tfjob(name, uid, workers=3):
+    """ExitCode job with a PINNED uid: rendezvous routing hashes the UID,
+    so deterministic soaks must not let uuid4 pick the slot."""
+    job = _exitcode_tfjob(name, workers=workers)
+    job.metadata["uid"] = uid
+    return job
+
+
+def run_shard_crash_soak(seed):
+    """The ISSUE 6 acceptance scenario: 4 shards, the full storm schedule,
+    and one shard CRASHED mid-500-storm.  Its slot's lease lapses, a
+    survivor takes it over (generation bump), re-lists and re-adopts the
+    slot's jobs — including one whose worker was preempted while nobody
+    owned it — and everything converges: all jobs Running, restart
+    counters exact, zero orphans, zero stale (fenced) writes applied."""
+    inner, clock, inj, mgr, auditor = make_harness(
+        seed, shards=4, lease_duration=24.0
+    )
+    failovers_before = sum(metrics.SHARD_FAILOVERS.samples().values())
+    fencing_before = sum(metrics.FENCING_REJECTIONS.samples().values())
+    # "job-uid-{0..5}" rendezvous to slots {2,0,1,1,2,3}: all four slots
+    # populated, the crash victim (slot 1) owns two jobs
+    jobs = {
+        f"soak{i}": _stamped_exitcode_tfjob(f"soak{i}", f"job-uid-{i}")
+        for i in range(6)
+    }
+    slot_of = {
+        name: mgr.router.slot_for(job.metadata["uid"])
+        for name, job in jobs.items()
+    }
+    # the crash victim is shard 1; the scenario requires it to own jobs
+    victim_jobs = sorted(n for n, s in slot_of.items() if s == 1)
+    assert victim_jobs, (
+        "fixture uids must place at least one job on slot 1; got "
+        f"{slot_of}"
+    )
+    vj = victim_jobs[0]
+
+    inj.schedule_storm(10, 15, fault="429", retry_after=3.0)
+    inj.schedule_storm(30, 10, fault="500")
+    inj.schedule_storm(42, 6, fault="conflict", ops=["update"])
+    inj.schedule_storm(50, 8, fault="reset")
+    inj.schedule_storm(60, 10, fault="stale", ops=["get", "list"])
+    inj.schedule_watch_outage(45, 12, kinds=("Pod", "Service"))
+    # one preemption while shard 1 still owns the job...
+    inj.at(
+        20, lambda: inj.kill_pod("default", f"{vj}-worker-1", 137),
+        f"preempt {vj}-worker-1",
+    )
+    # ...the crash itself, mid-500-storm...
+    inj.at(35, lambda: mgr.crash_shard(1), "crash shard-1")
+    # ...and a preemption while the slot is ORPHANED (crashed owner, lease
+    # not yet lapsed) AND its pod event is dropped by the watch outage —
+    # only the new owner's post-takeover re-adopt + relist can find it
+    inj.at(
+        50, lambda: inj.kill_pod("default", f"{vj}-worker-0", 137),
+        f"preempt {vj}-worker-0",
+    )
+    for job in jobs.values():
+        inj.create("TFJob", job.to_dict())
+    try:
+        run_steps(inj, mgr, steps=160, dt=5.0)
+    finally:
+        mgr.factory.stop_all()
+
+    assert auditor.violations == [], auditor.violations
+    problems = audit_orphans(inner)
+    assert problems == [], problems
+    for name in jobs:
+        stored = inner.get("TFJob", "default", name)
+        status = common.JobStatus.from_dict(stored.get("status"))
+        assert common.is_running(status), (name, stored.get("status"))
+        rs = status.replica_statuses["Worker"]
+        assert rs.active == 3, (name, stored["status"])
+        booked = inj.retryable_kills.get((f"default/{name}", "worker"), 0)
+        assert rs.restarts == booked, (name, rs.restarts, booked)
+    # both preemptions landed and were each counted exactly once
+    assert inj.stats.get("kill.hit") == 2, inj.stats
+    assert inj.retryable_kills.get((f"default/{vj}", "worker")) == 2
+    # the failover actually happened: slot 1 is owned by a survivor now
+    assert mgr.slot_owner(1) not in (None, 1)
+    assert sum(metrics.SHARD_FAILOVERS.samples().values()) > failovers_before
+    # a crashed (never-resumed) shard produces no zombie writes
+    assert sum(metrics.FENCING_REJECTIONS.samples().values()) == fencing_before
+    # the chaos bit
+    for fault in ("fault.429", "fault.500", "fault.conflict", "fault.reset"):
+        assert inj.stats.get(fault, 0) > 0, (fault, inj.stats)
+    assert inj.stats.get("watch.dropped.Pod", 0) > 0, inj.stats
+    return inj.log
+
+
+def test_shard_crash_mid_storm_soak_converges_and_is_deterministic():
+    log1 = run_shard_crash_soak(SOAK_SEEDS[0])
+    log2 = run_shard_crash_soak(SOAK_SEEDS[0])
+    assert log1 == log2, "same seed must replay an identical merged log"
+    assert any("crash shard-1" in line for line in log1)
+    assert any("shard_failover slot=1" in line for line in log1)
+
+
+def _threaded_sharded_log(seed):
+    """N REAL shard worker threads over one injector: each thread tags
+    itself (inj.set_shard) so its lines land in its own stream; the merged
+    log must be a pure function of the seed — the OS scheduler must not
+    leak into it (ISSUE 6 satellite: determinism under shard threads)."""
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=seed, clock=clock)
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]),
+        restart_backoff_base=0.0,  # immediate recreate: no real-time parks
+    )
+    mgr = ShardedOperator(
+        inj, opts, shard_count=4, engine_kwargs={"clock": clock},
+        clock=clock, enable_leases=False, note=inj.note,
+    )
+    # jobs exist BEFORE the informers start so every shard's initial
+    # enqueue order is the deterministic list order, then workers race
+    for i in range(8):
+        inj.create(
+            "TFJob",
+            _stamped_exitcode_tfjob(f"tj{i}", f"uid-tj-{i}", workers=2).to_dict(),
+        )
+    mgr.start(workers=False)
+    threads = []
+
+    def shard_worker(shard, ctl):
+        inj.set_shard(shard.id)
+        ctl.run_worker()
+
+    for shard in mgr.shards:
+        for ctl in shard.manager.controllers.values():
+            t = threading.Thread(
+                target=shard_worker, args=(shard, ctl), daemon=True
+            )
+            t.start()
+            threads.append(t)
+
+    import time as _time
+
+    def quiesce(predicate, timeout=10.0):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if predicate() and all(
+                len(c.queue) == 0 and c.queue.empty()
+                for s in mgr.shards
+                for c in s.manager.controllers.values()
+            ):
+                return
+            _time.sleep(0.005)
+        raise TimeoutError("threaded shards did not quiesce")
+
+    try:
+        # round 1: all pods created, kubelet hooks scheduled at t=1
+        quiesce(lambda: len(inner.list_pods()) == 16)
+        inj.step(1.0)  # fire kubelet starts (shard-stream log lines)
+        quiesce(lambda: len(inj.running_pods()) == 16)
+        # kill two pods owned by different shards, then converge
+        inj.kill_pod("default", "tj0-worker-0", 137)
+        inj.kill_pod("default", "tj5-worker-1", 137)
+        quiesce(lambda: len(inner.list_pods()) == 16)
+        inj.step(1.0)  # restart kubelet hooks
+        quiesce(lambda: len(inj.running_pods()) == 16)
+    finally:
+        mgr.stop()
+    for t in threads:
+        t.join(timeout=2)
+    return inj.log
+
+
+def test_threaded_shard_streams_merge_deterministically():
+    log1 = _threaded_sharded_log(77)
+    log2 = _threaded_sharded_log(77)
+    assert log1 == log2, "\n".join(
+        f"{a!r:>60} | {b!r}" for a, b in zip(log1, log2) if a != b
+    )
+    assert any("kubelet_start" in line for line in log1)
 
 
 @pytest.mark.slow

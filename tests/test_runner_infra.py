@@ -98,7 +98,12 @@ def test_leader_stop_forces_non_leader_when_run_wedged():
     elector.stop(join_timeout=0.3)
     assert not elector.is_leader, "stop() left stale leadership"
     lease = cluster.get("Lease", "default", "tpu-operator")
-    assert lease["spec"]["renewTime"] == 0, "lease not released"
+    # released = backdated past its own window, i.e. already expired for
+    # any acquirer on the current clock
+    assert (
+        lease["spec"]["renewTime"] + lease["spec"]["leaseDurationSeconds"]
+        < time.time()
+    ), "lease not released"
     wedge.set()
 
 

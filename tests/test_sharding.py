@@ -1,0 +1,689 @@
+"""Sharded control plane (ISSUE 6): rendezvous routing, per-slot leases
+with an injectable clock, crash failover with re-adopt, fencing-token
+rejection of zombie writes, and the APF-style admission layer.
+
+The full storm scenarios live in tests/test_chaos.py (shard-crash soak,
+threaded-stream determinism); this module covers the mechanisms one at a
+time.
+"""
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.cmd.leader import LeaseLock
+from tf_operator_tpu.cmd.manager import ShardedOperator
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import EnabledSchemes, make_engine
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.sharding import (
+    FENCE_ANNOTATION,
+    ShardRouter,
+    fence_token,
+    parse_fence_token,
+)
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.chaos import DeterministicQueue, FaultInjector, SimClock
+from tf_operator_tpu.k8s.fake import (
+    ApiError,
+    FakeCluster,
+    StaleFencingTokenError,
+)
+
+from tests import testutil
+
+
+# ------------------------------------------------------------- rendezvous
+def test_rendezvous_balance_and_minimal_movement():
+    """Satellite: growing N->N+1 reassigns ~1/(N+1) of jobs (and only ever
+    TO the new slot); shrinking by one slot moves exactly that slot's jobs
+    and nobody else's."""
+    uids = [f"uid-{i}" for i in range(4000)]
+    r8, r9, r7 = ShardRouter(8), ShardRouter(9), ShardRouter(7)
+    a8 = {u: r8.slot_for(u) for u in uids}
+
+    counts = Counter(a8.values())
+    fair = len(uids) / 8
+    assert set(counts) == set(range(8))
+    assert all(0.6 * fair < c < 1.4 * fair for c in counts.values()), counts
+
+    movers = [u for u in uids if r9.slot_for(u) != a8[u]]
+    assert 0 < len(movers) / len(uids) < 2 / 9
+    assert all(r9.slot_for(u) == 8 for u in movers), (
+        "growing may only move keys to the NEW slot"
+    )
+
+    for u in uids:
+        if a8[u] != 7:
+            assert r7.slot_for(u) == a8[u], (
+                "removing slot 7 must not move keys owned elsewhere"
+            )
+
+
+def test_rendezvous_is_stable_and_uidless_objects_land_on_slot_zero():
+    r = ShardRouter(4)
+    assert r.slot_for("abc") == ShardRouter(4).slot_for("abc")
+    assert r.slot_for(None) == 0
+    assert r.slot_for("") == 0
+
+
+def test_fence_token_round_trip():
+    tok = fence_token("default", "tpu-operator-shard-3", 7)
+    assert parse_fence_token(tok) == ("default", "tpu-operator-shard-3", 7)
+    assert parse_fence_token("garbage") is None
+    assert parse_fence_token("a/b:notanint") is None
+
+
+# ------------------------------------------------------------- lease lock
+def test_lease_lock_simclock_expiry_and_generation():
+    """Satellite: the elector core is clock-injectable — a SimClock expires
+    leases with zero real sleeps — and every NEW holding bumps the fencing
+    generation while in-lease renewals keep it."""
+    cluster = FakeCluster()
+    clock = SimClock()
+    a = LeaseLock(cluster, "a", "slot-0", lease_duration=10.0, clock=clock)
+    b = LeaseLock(cluster, "b", "slot-0", lease_duration=10.0, clock=clock)
+    assert a.try_acquire_or_renew()
+    assert a.generation == 1 and a.token == "default/slot-0:1"
+    assert not b.try_acquire_or_renew() and b.lost_to_other
+
+    clock.advance(5.0)
+    assert a.try_acquire_or_renew() and a.generation == 1  # renew keeps gen
+
+    clock.advance(11.0)  # a's lease lapses on the sim clock
+    assert b.try_acquire_or_renew()
+    assert b.generation == 2 and b.token == "default/slot-0:2"
+    # the zombie keeps its cached stale token — exactly what fencing rejects
+    assert a.token == "default/slot-0:1"
+    assert not a.try_acquire_or_renew() and a.lost_to_other
+
+
+def test_lease_lock_survives_transient_store_errors_inside_window():
+    """A 500 storm on the Lease kind must not shed ownership while the
+    lease window is still open — only an observed other holder or local
+    expiry does."""
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=0, clock=clock, kubelet=False)
+    lock = LeaseLock(inj, "a", "slot-0", lease_duration=20.0, clock=clock)
+    assert lock.try_acquire_or_renew()
+    inj.schedule_storm(1, 10, fault="500")
+    inj.step(5.0)  # inside the storm
+    assert not lock.try_acquire_or_renew()
+    assert not lock.lost_to_other and not lock.locally_expired()
+    inj.step(10.0)  # storm over, still inside the lease window
+    assert lock.try_acquire_or_renew() and lock.generation == 1
+
+
+def test_elector_sheds_leadership_at_renew_deadline_not_lease_duration():
+    """The threaded elector has NO fencing on its writes, so it must stop
+    leading once renews have failed for renew_deadline — holding on until
+    the full lease_duration would overlap it with the standby that legally
+    acquires the lapsed lease."""
+    from tf_operator_tpu.cmd.leader import LeaderElector
+
+    cluster = FakeCluster()
+    clock = SimClock()
+    elector = LeaderElector(
+        cluster, "a", lease_duration=15.0, renew_deadline=5.0, clock=clock,
+    )
+    assert elector._try_acquire_or_renew()
+    clock.advance(4.0)  # renews failing, but inside the renew deadline
+    assert not (
+        elector.lock.lost_to_other
+        or clock() - elector.lock.last_renew > elector.renew_deadline
+    ), "must keep trying inside the renew window"
+    clock.advance(2.0)  # 6s since last successful renew > renew_deadline=5
+    assert clock() - elector.lock.last_renew > elector.renew_deadline, (
+        "past the renew deadline the run loop's shed condition must fire "
+        "(well before lease_duration at 15s)"
+    )
+
+
+def test_forget_job_clears_tracked_expectation_keys():
+    """Deleted (not moved) jobs must not leak their _exp_keys entry — the
+    single-process default never calls disown_job, so forget_job is the
+    only reclaim point under job churn."""
+    cluster = FakeCluster()
+    engine = make_engine("TFJob", cluster)
+    job = testutil.new_tfjob("churn", worker=1)
+    cluster.create("TFJob", job.to_dict())
+    fresh = engine.adapter.from_dict(cluster.get("TFJob", "default", "churn"))
+    engine.reconcile(fresh)
+    assert fresh.key in engine._exp_keys
+    engine.forget_job(fresh.key)
+    assert engine._exp_keys == {}
+
+
+# ---------------------------------------------------------------- fencing
+def _lease_obj(name, generation, holder="shard-x"):
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "holderIdentity": holder,
+            "leaseDurationSeconds": 15.0,
+            "renewTime": 0,
+            "generation": generation,
+        },
+    }
+
+
+def _status_body(stored, token=None):
+    meta = {
+        "name": stored["metadata"]["name"],
+        "namespace": stored["metadata"]["namespace"],
+        "resourceVersion": stored["metadata"]["resourceVersion"],
+    }
+    if token:
+        meta["annotations"] = {FENCE_ANNOTATION: token}
+    return {
+        "apiVersion": stored["apiVersion"],
+        "kind": stored["kind"],
+        "metadata": meta,
+        "status": {"conditions": []},
+    }
+
+
+def test_fake_store_rejects_stale_fencing_token_and_counts_it():
+    cluster = FakeCluster()
+    cluster.create("Lease", _lease_obj("slot-0", generation=2))
+    job = testutil.new_tfjob("fenced", worker=1)
+    cluster.create(job.kind, job.to_dict())
+    stored = cluster.get("TFJob", "default", "fenced")
+
+    before = metrics.FENCING_REJECTIONS.get({"kind": "TFJob"})
+    with pytest.raises(StaleFencingTokenError):
+        cluster.update_status(
+            "TFJob", _status_body(stored, token="default/slot-0:1")
+        )
+    assert metrics.FENCING_REJECTIONS.get({"kind": "TFJob"}) == before + 1
+    # the stale write left no trace
+    assert cluster.get("TFJob", "default", "fenced")["status"] == stored.get(
+        "status", {}
+    )
+    # the CURRENT generation is accepted, and a token naming a Lease that
+    # does not exist passes (fencing only in force where a lock says who
+    # owns)
+    cluster.update_status(
+        "TFJob", _status_body(stored, token="default/slot-0:2")
+    )
+    stored = cluster.get("TFJob", "default", "fenced")
+    cluster.update_status(
+        "TFJob", _status_body(stored, token="default/no-such-lease:1")
+    )
+
+
+def test_rest_facade_propagates_fencing_rejection_as_403():
+    """The fencing check lives in the backing store, so the REST façade —
+    and therefore the live-cluster client path — inherits it."""
+    from tf_operator_tpu.e2e.apiserver import ApiServerTransport
+
+    backing = FakeCluster()
+    transport = ApiServerTransport(backing)
+    backing.create("Lease", _lease_obj("slot-1", generation=3))
+    job = testutil.new_tfjob("restfence", worker=1)
+    backing.create(job.kind, job.to_dict())
+    stored = backing.get("TFJob", "default", "restfence")
+
+    status, payload = transport.request(
+        "PUT",
+        "/apis/kubeflow.org/v1/namespaces/default/tfjobs/restfence/status",
+        body=_status_body(stored, token="default/slot-1:2"),
+    )
+    assert status == 403, payload
+    assert "stale" in payload["message"]
+    transport.close()
+
+
+# ------------------------------------------------------- sharded operator
+def _sharded_harness(shards, seed=0, lease_duration=20.0, kubelet=True):
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=seed, clock=clock, kubelet=kubelet)
+    opts = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    op = ShardedOperator(
+        inj, opts, shard_count=shards, engine_kwargs={"clock": clock},
+        clock=clock, lease_duration=lease_duration, note=inj.note,
+    )
+    for s in op.shards:
+        for ctl in s.manager.controllers.values():
+            ctl.queue = DeterministicQueue()
+    op.start(workers=False)
+    return inner, clock, inj, op
+
+
+def _drain(op, budget=200):
+    for _ in range(budget):
+        busy = False
+        for s in op.shards:
+            if s.crashed:
+                continue
+            for ctl in s.manager.controllers.values():
+                key = ctl.queue.get(timeout=0)
+                if key is None:
+                    continue
+                busy = True
+                try:
+                    ctl._sync_guarded(key)
+                finally:
+                    ctl.queue.done(key)
+        if not busy:
+            return
+
+
+def _settle(inj, op, rounds=6, dt=2.0):
+    for _ in range(rounds):
+        inj.step(dt)
+        op.tick()
+        _drain(op)
+
+
+def test_events_route_to_exactly_one_owning_shard():
+    """Each job is driven by its rendezvous owner and ONLY by it: every
+    other shard's queue and engine never see the job."""
+    inner, clock, inj, op = _sharded_harness(4)
+    names = {}
+    for i in range(8):
+        job = testutil.new_tfjob(f"route{i}", worker=1)
+        job.metadata["uid"] = f"uid-{i}"
+        names[f"route{i}"] = op.router.slot_for(f"uid-{i}")
+        inj.create("TFJob", job.to_dict())
+    _settle(inj, op)
+
+    for name, slot in names.items():
+        stored = inner.get("TFJob", "default", name)
+        status = common.JobStatus.from_dict(stored.get("status"))
+        assert common.is_running(status), (name, stored.get("status"))
+        key = f"default/{name}"
+        for s in op.shards:
+            engine = s.manager.controllers["TFJob"].engine
+            saw = key in engine._rv_seen
+            assert saw == (s.index == slot), (
+                f"{name} (slot {slot}) synced by shard {s.index}"
+            )
+    assert len(inner.list_pods()) == 8
+    # the ownership gauges add up
+    op.tick()
+    total = sum(
+        metrics.SHARD_JOBS_OWNED.get({"shard": s.id, "kind": "TFJob"})
+        for s in op.shards
+    )
+    assert total == 8
+    # queue depth is per-shard when sharded: a kind-only key would be
+    # last-writer-wins across N shards' controllers
+    for s in op.shards:
+        assert metrics.WORKQUEUE_DEPTH.get(
+            {"kind": "TFJob", "shard": s.id}
+        ) == 0
+
+
+def test_crash_failover_readopts_and_zombie_write_is_fenced():
+    """The zombie scenario end to end: shard A crashes mid-flight, its
+    slot's lease lapses, shard B takes over (generation bump), re-adopts
+    and keeps driving the job — including booking a preemption restart —
+    then A wakes up still believing and its status write is REJECTED with
+    the stale fencing token, leaving B's exact restart counter in place."""
+    inner, clock, inj, op = _sharded_harness(2, lease_duration=10.0)
+    uid = next(u for u in (f"u{i}" for i in range(50))
+               if op.router.slot_for(u) == 0)
+    job = testutil.new_tfjob("zomb", worker=1)
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_EXIT_CODE
+    job.metadata["uid"] = uid
+    inj.create("TFJob", job.to_dict())
+    _settle(inj, op)
+    stored = inner.get("TFJob", "default", "zomb")
+    assert common.is_running(common.JobStatus.from_dict(stored["status"]))
+
+    failovers_before = metrics.SHARD_FAILOVERS.get(
+        {"slot": "0", "shard": "shard-1"}
+    )
+    op.crash_shard(0)
+    clock.advance(11.0)  # slot-0 lease lapses on the sim clock
+    _settle(inj, op)
+    assert op.slot_owner(0) == 1
+    assert metrics.SHARD_FAILOVERS.get(
+        {"slot": "0", "shard": "shard-1"}
+    ) == failovers_before + 1
+
+    # B drives a real preemption restart after the takeover
+    assert inj.kill_pod("default", "zomb-worker-0", 137)
+    _settle(inj, op, rounds=10, dt=5.0)
+    stored = inner.get("TFJob", "default", "zomb")
+    rs = common.ReplicaStatus.from_dict(
+        stored["status"]["replicaStatuses"]["Worker"]
+    )
+    assert rs.restarts == 1 and rs.active == 1, stored["status"]
+
+    # the zombie wakes up still believing it owns slot 0 and tries to
+    # write status with its cached generation-1 token
+    op.resume_shard(0)
+    zombie_engine = op.shards[0].manager.controllers["TFJob"].engine
+    assert op.shards[0].handle.owns_uid(uid), "zombie must still believe"
+    # ...but belief is not proof: its lease window lapsed, so the
+    # side-effect gate already refuses before the store has to fence
+    assert not op.shards[0].handle.may_act(uid)
+    fresh = zombie_engine.adapter.from_dict(
+        inner.get("TFJob", "default", "zomb")
+    )
+    import copy
+
+    old_status = copy.deepcopy(fresh.status)
+    fresh.status.replica_statuses["Worker"].restarts = 99  # the clobber
+    rejections_before = metrics.FENCING_REJECTIONS.get({"kind": "TFJob"})
+    with pytest.raises(ApiError) as exc:
+        zombie_engine._write_status(fresh, old_status)
+    assert "stale" in str(exc.value)
+    assert metrics.FENCING_REJECTIONS.get(
+        {"kind": "TFJob"}
+    ) == rejections_before + 1
+    # the restart counter stayed exact — the zombie changed nothing
+    stored = inner.get("TFJob", "default", "zomb")
+    rs = common.ReplicaStatus.from_dict(
+        stored["status"]["replicaStatuses"]["Worker"]
+    )
+    assert rs.restarts == 1
+    # and the zombie's next lease tick discovers the loss and disowns
+    op.tick()
+    assert not op.shards[0].handle.owns_uid(uid)
+
+
+def test_zombie_dispatch_issues_no_pod_mutations():
+    """A resumed zombie with a parked workqueue key must not reconcile:
+    only the final status write is store-fenced, so a zombie sync that
+    reached the engine could create/delete pods unfenced against the job
+    the new owner is driving.  The may_act gate at dispatch refuses
+    (requeue, not disown — a recovered renew must resume), the next
+    lease tick disowns, and the dispatch after that drops cleanly."""
+    inner, clock, inj, op = _sharded_harness(2, lease_duration=10.0)
+    uid = next(u for u in (f"u{i}" for i in range(50))
+               if op.router.slot_for(u) == 0)
+    job = testutil.new_tfjob("zomb2", worker=1)
+    job.metadata["uid"] = uid
+    inj.create("TFJob", job.to_dict())
+    _settle(inj, op)
+    assert common.is_running(common.JobStatus.from_dict(
+        inner.get("TFJob", "default", "zomb2")["status"]
+    ))
+
+    op.crash_shard(0)
+    clock.advance(11.0)
+    _settle(inj, op)
+    assert op.slot_owner(0) == 1
+
+    # a worker pod vanishes: any shard that reconciles now WOULD create
+    # a replacement — exactly the unfenced mutation a zombie must not make
+    inner.delete("Pod", "default", "zomb2-worker-0")
+    pods_before = len(inner.list_pods())
+    creates_before = inj.pod_creates.get("default/zomb2", 0)
+
+    op.resume_shard(0)
+    zombie_ctl = op.shards[0].manager.controllers["TFJob"]
+    zombie_ctl.enqueue("default/zomb2")  # the parked key
+    key = zombie_ctl.queue.get(timeout=0)
+    assert key == "default/zomb2"
+    try:
+        zombie_ctl._sync_guarded(key)
+    finally:
+        zombie_ctl.queue.done(key)
+    assert len(inner.list_pods()) == pods_before, "zombie created a pod"
+    assert inj.pod_creates.get("default/zomb2", 0) == creates_before
+    # refused but NOT disowned: the key is requeued (transient ladder)
+    assert len(zombie_ctl.queue) == 1
+
+    # the zombie's next lease tick observes the new holder and disowns;
+    # the requeued key then drops cleanly at dispatch
+    op.tick()
+    assert not op.shards[0].handle.owns_uid(uid)
+    key = zombie_ctl.queue.get(timeout=0)
+    try:
+        zombie_ctl._sync_guarded(key)
+    finally:
+        zombie_ctl.queue.done(key)
+    assert len(zombie_ctl.queue) == 0
+
+    # the real owner replaces the missing pod and the job re-converges
+    _settle(inj, op, rounds=10, dt=5.0)
+    assert len(inner.list_pods()) == pods_before + 1
+    assert common.is_running(common.JobStatus.from_dict(
+        inner.get("TFJob", "default", "zomb2")["status"]
+    ))
+
+
+def test_second_operator_instance_cannot_steal_leases():
+    """Lease holder identities are instance-qualified: a second operator
+    process (rolling-update overlap, accidental replica, standby) whose
+    shard has the same index must NOT be mistaken for the current holder
+    — its acquire fails while the lease is live, and its eventual
+    takeover bumps the fencing generation so the old instance's writes
+    are rejected."""
+    inner = FakeCluster()
+    clock = SimClock()
+    opts = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    mk = lambda: ShardedOperator(  # noqa: E731
+        inner, opts, shard_count=1, enable_leases=True,
+        clock=clock, lease_duration=10.0,
+    )
+    a, b = mk(), mk()
+    assert a.instance_id != b.instance_id
+    a.start(workers=False)
+    assert 0 in a.shards[0].owned_slots
+    gen_a = a.shards[0].locks[0].generation
+    assert gen_a == 1
+
+    # B comes up while A's lease is live: same shard index, different
+    # instance — B must neither acquire at start nor via its sweep
+    b.start(workers=False)
+    assert 0 not in b.shards[0].owned_slots
+    b.tick()
+    assert 0 not in b.shards[0].owned_slots
+    lease = inner.get("Lease", "default", "tpu-operator-shard-0")
+    assert lease["spec"]["holderIdentity"] == f"{a.instance_id}/shard-0"
+
+    # A dies (stops renewing); after the lease lapses B takes over WITH
+    # a generation bump — A's cached token is now stale and fenced
+    clock.advance(11.0)
+    b.tick()
+    assert 0 in b.shards[0].owned_slots
+    assert b.shards[0].locks[0].generation == gen_a + 1
+    lease = inner.get("Lease", "default", "tpu-operator-shard-0")
+    assert lease["spec"]["holderIdentity"] == f"{b.instance_id}/shard-0"
+    a.factory.stop_all()
+    b.factory.stop_all()
+
+
+def test_clean_stop_releases_leases_for_immediate_takeover():
+    """Voluntary shutdown must release held slot leases: the replacement
+    instance is a DIFFERENT holder identity, so without the release every
+    clean rolling restart would leave all jobs undriven for a full lease
+    duration."""
+    inner = FakeCluster()
+    clock = SimClock()
+    opts = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    a = ShardedOperator(
+        inner, opts, shard_count=2, enable_leases=True,
+        clock=clock, lease_duration=30.0,
+    )
+    a.start(workers=False)
+    assert {0, 1} == a.shards[0].owned_slots | a.shards[1].owned_slots
+    a.stop()
+
+    # no clock advance: the replacement must acquire IMMEDIATELY
+    b = ShardedOperator(
+        inner, opts, shard_count=2, enable_leases=True,
+        clock=clock, lease_duration=30.0,
+    )
+    b.start(workers=False)
+    assert 0 in b.shards[0].owned_slots
+    assert 1 in b.shards[1].owned_slots
+    # each takeover bumped the generation: a's cached tokens are stale
+    for slot in (0, 1):
+        assert b.shards[slot].locks[slot].generation == 2
+    b.stop()
+
+
+def test_disowned_job_rebuilds_expectations_never_leaks():
+    """Satellite: a moved job's in-flight expectations are deleted on
+    disown — the slot's next holder starts from a clean ledger instead of
+    being gated by a dead shard's unobserved creates."""
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=0, clock=clock, kubelet=False)
+    # drop the pod ADDED events so the creates stay unobserved in-flight
+    inj.schedule_watch_outage(0, 100, kinds=("Pod", "Service"))
+    inj.step(0.5)  # enter the outage
+    engine = make_engine("TFJob", inj, clock=clock)
+    job = testutil.new_tfjob("mover", worker=2)
+    inj.create("TFJob", job.to_dict())
+    fresh = engine.adapter.from_dict(inner.get("TFJob", "default", "mover"))
+    engine.reconcile(fresh)
+    assert len(inner.list_pods()) == 2
+    assert not engine.satisfied_expectations(fresh), (
+        "outage must leave the creates unobserved"
+    )
+    engine.disown_job(fresh.key)
+    assert engine.satisfied_expectations(fresh)
+    assert engine._exp_keys == {}, "tracked keys must not leak"
+
+
+def test_sharded_single_shard_has_no_leases_and_no_fence():
+    """shards=1 is the pre-shard engine: static ownership, no Lease
+    objects, unfenced status writes."""
+    inner, clock, inj, op = _sharded_harness(1)
+    assert not op.enable_leases
+    job = testutil.new_tfjob("solo", worker=1)
+    inj.create("TFJob", job.to_dict())
+    _settle(inj, op)
+    assert inner.list("Lease") == []
+    stored = inner.get("TFJob", "default", "solo")
+    assert common.is_running(common.JobStatus.from_dict(stored["status"]))
+    assert (stored["metadata"].get("annotations") or {}).get(
+        FENCE_ANNOTATION
+    ) is None
+
+
+# -------------------------------------------------------------------- APF
+def test_apf_noisy_tenant_capped_while_quiet_tenant_stays_bounded():
+    """ISSUE 6 acceptance: a tenant flooding the admission layer gets 429s
+    (queue_full) while another tenant's queue waits stay bounded — the
+    fair-share dispatcher alternates flows, so the quiet tenant never
+    waits behind the noisy tenant's whole backlog."""
+    from tf_operator_tpu.e2e.http_apiserver import (
+        FairFlowController,
+        RejectedError,
+    )
+
+    metrics.APF_REJECTED.reset()
+    metrics.APF_QUEUE_WAIT.reset()
+    apf = FairFlowController(
+        seats=2, queue_limit=4, queue_timeout=10.0, retry_after=0.25
+    )
+    hold = 0.005
+    noisy_rejected = []
+
+    def noisy():
+        for _ in range(15):
+            try:
+                apf.acquire("noisy")
+            except RejectedError:
+                noisy_rejected.append(1)
+                continue
+            try:
+                time.sleep(hold)
+            finally:
+                apf.release()
+
+    threads = [threading.Thread(target=noisy) for _ in range(8)]
+    for t in threads:
+        t.start()
+    quiet_waits = []
+    for _ in range(10):
+        t0 = time.monotonic()
+        apf.acquire("quiet")
+        quiet_waits.append(time.monotonic() - t0)
+        try:
+            time.sleep(hold)
+        finally:
+            apf.release()
+    for t in threads:
+        t.join()
+
+    assert noisy_rejected, "the noisy tenant must hit its queue cap"
+    assert metrics.APF_REJECTED.get(
+        {"flow": "noisy", "reason": "queue_full"}
+    ) == len(noisy_rejected)
+    assert metrics.APF_REJECTED.get(
+        {"flow": "quiet", "reason": "queue_full"}
+    ) == 0
+    # every quiet request was admitted with a bounded wait: well under the
+    # noisy backlog's total service time
+    assert max(quiet_waits) < 1.0, quiet_waits
+    assert metrics.APF_QUEUE_WAIT.count({"flow": "quiet"}) >= 1
+
+
+def test_http_apiserver_apf_rejects_with_retry_after_header():
+    import http.client
+
+    from tf_operator_tpu.e2e.http_apiserver import (
+        FairFlowController,
+        HttpApiServer,
+        flow_of,
+    )
+
+    assert flow_of("/api/v1/namespaces/team-a/pods") == "team-a"
+    assert flow_of("/apis/kubeflow.org/v1/tfjobs") == "cluster"
+
+    apf = FairFlowController(seats=1, queue_limit=0, retry_after=0.75)
+    server = HttpApiServer(apf=apf).start()
+    try:
+        apf.acquire("hog")  # occupy the only seat out-of-band
+        conn = http.client.HTTPConnection(server.host, server.port, timeout=5)
+        conn.request("GET", "/api/v1/namespaces/default/pods")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 429, body
+        assert resp.getheader("Retry-After") == "0.75"
+        apf.release()
+        conn.request("GET", "/api/v1/namespaces/default/pods")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_apf_client_retry_ladder_rides_through_a_burst():
+    """End to end over a real socket: the operator's ClusterClient retries
+    the 429 (honoring Retry-After) and completes once the seat frees."""
+    from tf_operator_tpu.e2e.http_apiserver import (
+        FairFlowController,
+        HttpApiServer,
+    )
+    from tf_operator_tpu.k8s.client import (
+        ClusterClient,
+        HttpTransport,
+        KubeConfig,
+        RetryPolicy,
+    )
+
+    apf = FairFlowController(seats=1, queue_limit=0, retry_after=0.1)
+    server = HttpApiServer(apf=apf).start()
+    transport = HttpTransport(KubeConfig(server=server.url))
+    client = ClusterClient(
+        transport, retry=RetryPolicy(base_delay=0.05, deadline=10.0)
+    )
+    try:
+        apf.acquire("hog")
+        timer = threading.Timer(0.4, apf.release)
+        timer.start()
+        pods = client.list_pods()  # retried until the seat frees
+        assert pods == []
+        timer.cancel()
+    finally:
+        client.close()
+        transport.close()
+        server.stop()

@@ -1,4 +1,4 @@
-"""Leader election over a Lease record in the cluster store.
+"""Leader election over Lease records in the cluster store.
 
 The reference's legacy stack runs Endpoints-lock leader election with lease
 15s / renew 5s / retry 3s and flips a `tf_operator_is_leader` gauge
@@ -6,17 +6,181 @@ The reference's legacy stack runs Endpoints-lock leader election with lease
 the same state machine over a coordination.k8s.io/Lease-shaped object
 (Endpoints locks are deprecated upstream; Lease is the modern lock), with
 the timings configurable so tests run in milliseconds.
+
+Generalized for the sharded control plane (ISSUE 6): the acquire/renew CAS
+lives in :class:`LeaseLock`, a thread-free, clock-injectable single-lock
+state machine the ShardedOperator instantiates once per shard slot (N
+locks), driven from its deterministic tick — the chaos harness's SimClock
+expires leases without a single real sleep.  Each acquisition by a new
+holder bumps the lease's ``spec.generation``; the generation is the
+fencing token stamped into the owner's status writes and checked by the
+store (k8s/fake.py), so a zombie that wakes up after failover can never
+clobber the new owner.  :class:`LeaderElector` keeps its historical
+threaded API on top of one LeaseLock, now with a jittered retry loop so a
+herd of standbys doesn't hammer the apiserver in lockstep.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
 from tf_operator_tpu.engine.metrics import IS_LEADER
+from tf_operator_tpu.engine.sharding import fence_token
 from tf_operator_tpu.k8s.fake import ApiError
 
 LEASE_KIND = "Lease"
+
+
+class LeaseLock:
+    """One Lease lock: CAS-based acquire/renew/release with an injectable
+    clock and a monotonically increasing acquisition generation.
+
+    Thread-free by design — `try_acquire_or_renew()` is called from the
+    owner's loop (LeaderElector's renew thread, or the ShardedOperator's
+    lease tick), so a simulated clock drives expiry deterministically.
+
+    State the callers read:
+      - ``held``: this identity believes it holds the lock (kept True
+        across *transient* renew errors until the lease duration since the
+        last successful renew elapses — a 500 storm on the Lease kind must
+        not shed ownership the moment one renew fails);
+      - ``lost_to_other``: the last attempt observed a different,
+        unexpired holder (the definitive "you lost" signal);
+      - ``generation`` / ``token``: the fencing token of the CURRENT
+        holding.  Deliberately NOT cleared when renewal fails: a zombie
+        keeps writing with its cached token, which is exactly what the
+        store-side fencing check exists to reject.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        identity: str,
+        lock_name: str,
+        namespace: str = "default",
+        lease_duration: float = 15.0,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.cluster = cluster
+        self.identity = identity
+        self.lock_name = lock_name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.clock = clock
+        self.held = False
+        self.lost_to_other = False
+        self.generation = 0
+        self.last_renew = 0.0
+
+    # ------------------------------------------------------------- lock ops
+    def _get_lease(self) -> Optional[Dict[str, Any]]:
+        # OSError too: a chaos reset / dropped socket mid-renew is an
+        # attempt failure, not a reason to crash the lease maintainer
+        try:
+            return self.cluster.get(LEASE_KIND, self.namespace, self.lock_name)
+        except (ApiError, OSError):
+            return None
+
+    def try_acquire_or_renew(self) -> bool:
+        """One CAS attempt.  True = we hold the lock (fresh acquire or
+        renew); False = held by someone else, or the store errored (the
+        caller decides whether to keep believing via `locally_expired`)."""
+        now = self.clock()
+        self.lost_to_other = False
+        lease = self._get_lease()
+        if lease is None:
+            record = {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": self.lease_duration,
+                "renewTime": now,
+                "generation": 1,
+            }
+            try:
+                self.cluster.create(
+                    LEASE_KIND,
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": LEASE_KIND,
+                        "metadata": {
+                            "name": self.lock_name, "namespace": self.namespace
+                        },
+                        "spec": record,
+                    },
+                )
+            except (ApiError, OSError):
+                return False
+            self.held = True
+            self.generation = 1
+            self.last_renew = now
+            return True
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        expired = now > spec.get("renewTime", 0) + spec.get(
+            "leaseDurationSeconds", self.lease_duration
+        )
+        if holder != self.identity and not expired:
+            self.lost_to_other = True
+            self.held = False
+            return False
+        prev_gen = int(spec.get("generation", 0) or 0)
+        # a NEW holding (takeover, or re-acquire after our own expiry —
+        # someone may have held and released in between) bumps the fencing
+        # generation; an in-lease renew by the same holder keeps it
+        renewing = holder == self.identity and not expired
+        new_gen = prev_gen if renewing else prev_gen + 1
+        lease["spec"] = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": self.lease_duration,
+            "renewTime": now,
+            "generation": new_gen,
+        }
+        try:
+            self.cluster.update(LEASE_KIND, lease)
+        except (ApiError, OSError):
+            return False
+        self.held = True
+        self.generation = new_gen
+        self.last_renew = now
+        return True
+
+    def locally_expired(self) -> bool:
+        """True once the lease duration has elapsed since our last
+        successful renew: ownership can no longer be assumed even if no
+        other holder was observed (we may simply be partitioned)."""
+        return self.clock() - self.last_renew > self.lease_duration
+
+    @property
+    def token(self) -> Optional[str]:
+        """Fencing token of the current holding (stamped into status
+        writes); survives renew failures on purpose — see class doc."""
+        if self.generation <= 0:
+            return None
+        return fence_token(self.namespace, self.lock_name, self.generation)
+
+    def release(self) -> None:
+        """Voluntarily give up the lease so a standby can take over without
+        waiting out the lease duration."""
+        self.held = False
+        lease = self._get_lease()
+        if lease and lease.get("spec", {}).get("holderIdentity") == self.identity:
+            # backdate past the lease window relative to the CURRENT
+            # clock — a literal 0 reads as 1970 (expired) on wall clocks
+            # but as "renewed just now" on a SimClock still near t=0
+            lease["spec"]["renewTime"] = (
+                self.clock()
+                - float(
+                    lease["spec"].get(
+                        "leaseDurationSeconds", self.lease_duration
+                    )
+                )
+                - 1.0
+            )
+            try:
+                self.cluster.update(LEASE_KIND, lease)
+            except (ApiError, OSError):
+                pass
 
 
 class LeaderElector:
@@ -31,76 +195,49 @@ class LeaderElector:
         retry_period: float = 3.0,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.time,
+        retry_jitter: float = 0.2,
     ) -> None:
         if renew_deadline >= lease_duration:
             raise ValueError("renew_deadline must be < lease_duration")
-        self.cluster = cluster
-        self.identity = identity
-        self.lock_name = lock_name
-        self.namespace = namespace
+        self.lock = LeaseLock(
+            cluster, identity, lock_name,
+            namespace=namespace, lease_duration=lease_duration, clock=clock,
+        )
         self.lease_duration = lease_duration
         self.renew_deadline = renew_deadline
         self.retry_period = retry_period
+        self.retry_jitter = retry_jitter
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.is_leader = False
+        self._rng = random.Random()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._release_on_stop = True
 
-    # ------------------------------------------------------------- lock ops
-    def _get_lease(self) -> Optional[Dict[str, Any]]:
-        try:
-            return self.cluster.get(LEASE_KIND, self.namespace, self.lock_name)
-        except ApiError:
-            return None
+    # compatibility accessors (tests and callers address the elector)
+    @property
+    def cluster(self):
+        return self.lock.cluster
+
+    @property
+    def identity(self) -> str:
+        return self.lock.identity
 
     def _try_acquire_or_renew(self) -> bool:
-        now = time.time()
-        lease = self._get_lease()
-        record = {
-            "holderIdentity": self.identity,
-            "leaseDurationSeconds": self.lease_duration,
-            "renewTime": now,
-        }
-        if lease is None:
-            try:
-                self.cluster.create(
-                    LEASE_KIND,
-                    {
-                        "apiVersion": "coordination.k8s.io/v1",
-                        "kind": LEASE_KIND,
-                        "metadata": {"name": self.lock_name, "namespace": self.namespace},
-                        "spec": record,
-                    },
-                )
-                return True
-            except ApiError:
-                return False
-        spec = lease.get("spec", {})
-        holder = spec.get("holderIdentity")
-        expired = now > spec.get("renewTime", 0) + spec.get(
-            "leaseDurationSeconds", self.lease_duration
-        )
-        if holder != self.identity and not expired:
-            return False
-        lease["spec"] = record
-        try:
-            self.cluster.update(LEASE_KIND, lease)
-            return True
-        except ApiError:
-            return False
+        return self.lock.try_acquire_or_renew()
 
     def release(self) -> None:
-        """Voluntarily give up the lease so a standby can take over without
-        waiting out the lease duration."""
-        lease = self._get_lease()
-        if lease and lease.get("spec", {}).get("holderIdentity") == self.identity:
-            lease["spec"]["renewTime"] = 0
-            try:
-                self.cluster.update(LEASE_KIND, lease)
-            except ApiError:
-                pass
+        self.lock.release()
+
+    def _retry_wait(self) -> float:
+        """The acquire loop's wait, jittered ±retry_jitter so N standbys
+        watching the same lease don't retry in lockstep forever (they all
+        observed the same expiry instant; unjittered, every round is a
+        thundering herd and a CAS pile-up)."""
+        j = self.retry_jitter
+        return self.retry_period * (1.0 + j * (2.0 * self._rng.random() - 1.0))
 
     # ------------------------------------------------------------- run loop
     def run(self) -> None:
@@ -111,16 +248,43 @@ class LeaderElector:
         while not self._stop.is_set():
             if self._try_acquire_or_renew():
                 break
-            self._stop.wait(self.retry_period)
+            self._stop.wait(self._retry_wait())
         if self._stop.is_set():
             return
         self.is_leader = True
         IS_LEADER.set(1)
         if self.on_started_leading:
             self.on_started_leading()
-        # renew
-        while not self._stop.wait(self.renew_deadline):
-            if not self._try_acquire_or_renew():
+        # renew (period renew_deadline/2, the client-go cadence: at least
+        # two renew attempts must fit inside the give-up bound or a single
+        # transient failure already exhausts it).  The wait never sleeps
+        # PAST the shed deadline: a fixed renew_deadline/2 cadence would
+        # notice a lapsed deadline up to half a period late, and with
+        # renew_deadline close to lease_duration that lands after the
+        # lease itself expired — overlapping this (unfenced) leader with
+        # the standby that legally acquired it
+        while True:
+            deadline_in = (
+                self.lock.last_renew + self.renew_deadline
+                - self.lock.clock()
+            )
+            if self._stop.wait(
+                min(self.renew_deadline / 2.0, max(0.05, deadline_in))
+            ):
+                break  # stopped
+            if self._try_acquire_or_renew():
+                continue
+            # a transient store error is not a lost lease — but unlike the
+            # sharded slot locks (whose writes are fenced), NOTHING rejects
+            # a stale elector-guarded leader's writes, so leadership must
+            # be shed once renewing has failed for renew_deadline: holding
+            # on until the full lease_duration would overlap us with the
+            # standby that legally acquires the lapsed lease (client-go's
+            # RenewDeadline invariant, which the ctor check exists for)
+            if self.lock.lost_to_other or (
+                self.lock.clock() - self.lock.last_renew
+                >= self.renew_deadline
+            ):
                 break
         was_stopped = self._stop.is_set()
         self.is_leader = False

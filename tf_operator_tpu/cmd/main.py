@@ -21,7 +21,7 @@ from typing import List, Optional
 from tf_operator_tpu import version
 from tf_operator_tpu.cmd.health import HealthServer
 from tf_operator_tpu.cmd.leader import LeaderElector
-from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.manager import OperatorManager, ShardedOperator
 from tf_operator_tpu.cmd.options import ServerOptions, parse_args, split_bind_address
 from tf_operator_tpu.k8s.fake import FakeCluster
 from tf_operator_tpu.utils import logging as ulog
@@ -97,7 +97,19 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
                 "manifests/overlays/standalone (kubectl apply -k) first"
             )
 
-    manager = OperatorManager(cluster, options)
+    if options.shards > 1:
+        # sharded control plane: N shard workers in this process, jobs
+        # partitioned by rendezvous hash, per-slot Leases with failover
+        # and fenced status writes (cmd/manager.py ShardedOperator)
+        manager = ShardedOperator(
+            cluster,
+            options,
+            shard_count=options.shards,
+            lease_duration=options.shard_lease_duration,
+            lease_namespace=options.namespace or "default",
+        )
+    else:
+        manager = OperatorManager(cluster, options)
 
     health_host, health_port = split_bind_address(options.health_probe_bind_address)
     probe = HealthServer(
@@ -145,7 +157,11 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
 
     def start_manager():
         manager.start()
-        log.info("manager started: kinds=%s", list(manager.controllers))
+        log.info(
+            "manager started: kinds=%s shards=%d",
+            options.all_kinds,
+            getattr(manager, "shard_count", 1),
+        )
 
     if options.leader_elect:
         elector = LeaderElector(

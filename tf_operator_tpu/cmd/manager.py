@@ -14,21 +14,36 @@ observation itself happens inside the engine's cluster subscription.
 ReconcileResult.requeue_after lands on queue.add_after — the real
 ActiveDeadlineSeconds path the reference's new stack silently dropped
 (FakeWorkQueue, SURVEY.md §7.4.6).
+
+Sharded mode (ISSUE 6): OperatorManager is a per-shard *library* — N
+instances share one SharedInformerFactory (pass `factory=`) and each
+filters events through its `shard` handle (ownership by rendezvous hash
+of the job UID, engine/sharding.py), so every shard keeps its own
+workqueues, expectations ledger, and fan-out executor with no cross-shard
+locking.  `ShardedOperator` below is the coordinator: per-slot Leases
+(cmd/leader.py LeaseLock), crash failover with re-list/re-adopt, and
+fencing tokens on status writes.  With `shard=None` (the default) nothing
+changes — the single-process operator is byte-identical to before.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Dict, List, Optional
+import uuid
+from typing import Callable, Dict, List, Optional
 
+from tf_operator_tpu.cmd.leader import LeaseLock
 from tf_operator_tpu.cmd.options import ServerOptions
 from tf_operator_tpu.controllers.registry import make_engine
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.engine.controller import EngineConfig
+from tf_operator_tpu.engine.sharding import ShardRouter
 from tf_operator_tpu.k8s import objects
 from tf_operator_tpu.k8s.fake import (
     ApiError,
     NotFoundError,
+    StaleFencingTokenError,
     is_transient_api_error,
 )
 from tf_operator_tpu.k8s.informer import (
@@ -38,7 +53,7 @@ from tf_operator_tpu.k8s.informer import (
     SharedIndexInformer,
     SharedInformerFactory,
 )
-from tf_operator_tpu.utils.logging import logger_for_key
+from tf_operator_tpu.utils.logging import logger_for_key, logger_with
 
 MAX_RECONCILE_RETRIES = 15
 # past the rate-limiter's window the key is retried at a flat cadence —
@@ -60,6 +75,14 @@ class _KindController:
     def __init__(self, manager: "OperatorManager", kind: str) -> None:
         self.manager = manager
         self.kind = kind
+        # sharded: N shards each run a _KindController for the same kind,
+        # and a kind-only gauge key would be last-writer-wins — shard 3
+        # draining its last key must not zero out shard 0's 500-key
+        # backlog.  Single-process mode keeps the historical kind-only
+        # label set.
+        self._depth_labels = {"kind": kind}
+        if manager.shard is not None:
+            self._depth_labels["shard"] = manager.shard.shard_id
         self.engine = make_engine(
             kind,
             manager.cluster,
@@ -84,6 +107,11 @@ class _KindController:
         # informers sync, so startup correctness never depends on them
         self.engine.pod_lister = Lister(manager.factory.for_kind("Pod"))
         self.engine.service_lister = Lister(manager.factory.for_kind("Service"))
+        if manager.shard is not None:
+            # sharded mode: the owning slot's fencing token rides on every
+            # status write so the store rejects a zombie's post-failover
+            # writes (engine/sharding.py)
+            self.engine.fence = manager.shard.fence_token_for
         self.informer.add_event_handler(
             ResourceEventHandler(
                 add_func=self._on_add,
@@ -111,7 +139,10 @@ class _KindController:
     # ------------------------------------------------------------- handlers
     def _in_scope(self, obj) -> bool:
         ns = self.manager.options.namespace
-        return not ns or objects.namespace_of(obj) == ns
+        if ns and objects.namespace_of(obj) != ns:
+            return False
+        # sharded mode: only the owning shard's queue sees the event
+        return self.manager._owns_obj(obj)
 
     # job-created/-deleted counters are incremented by the engine (the
     # reference increments on the Created condition / DeleteJob path, not in
@@ -191,7 +222,7 @@ class _KindController:
             self._exhausted_keys.discard(key)
 
     def _update_depth(self) -> None:
-        metrics.WORKQUEUE_DEPTH.set(len(self.queue), {"kind": self.kind})
+        metrics.WORKQUEUE_DEPTH.set(len(self.queue), self._depth_labels)
 
     # ------------------------------------------------------------- work loop
     def _sync(self, key: str) -> None:
@@ -214,6 +245,26 @@ class _KindController:
             metrics.RUNNING_REPLICAS_TRACKER.forget(self.kind, key)
             self.engine.forget_job(key)
             return  # deleted; nothing to reconcile
+        if not self.manager._owns_obj(raw):
+            # the job moved to another shard between enqueue and dispatch
+            # (slot failover / topology change): drop it cleanly — clear
+            # retry state and per-job engine memory so the in-flight
+            # expectations ledger never leaks and never gates the slot's
+            # next holder
+            self._clear_failures(key)
+            self.engine.disown_job(key)
+            return
+        if not self.manager._may_act_obj(raw):
+            # we still believe we own the slot but the lease window lapsed
+            # without a successful renew (partition / renew-failure storm /
+            # resumed zombie): reconciling now could issue pod/service
+            # mutations we cannot prove the right to make.  Don't disown —
+            # a recovered renew must resume driving the job — requeue on
+            # the transient ladder until the lease resolves (renewed →
+            # sync proceeds; lost → the lease tick disowns and the next
+            # dispatch drops above)
+            self._requeue_transient(key)
+            return
         job = self.engine.adapter.from_dict(raw)
         result = self.engine.reconcile(job)
         metrics.RECONCILE_DURATION.observe(
@@ -263,19 +314,41 @@ class _KindController:
         exercise the same recovery path either way."""
         try:
             self._sync(key)
-        except Exception as e:  # noqa: BLE001 — workers must not die
-            logger_for_key(self.kind, key).error("sync panic: %s", e)
-            metrics.SYNC_ERRORS.inc({"kind": self.kind})
-            if (
-                is_transient_api_error(e)
-                and self.manager.options.classify_retryable_errors
+        except ApiError as e:
+            if not (
+                isinstance(e, StaleFencingTokenError)
+                # over the REST path the store's rejection arrives as a
+                # plain 403 ApiError; match its message, not just the code
+                # (403 alone could be RBAC)
+                or (e.code == 403 and "fencing token" in e.message)
             ):
-                # e.g. the initial job GET during an apiserver storm —
-                # transient failures here must not consume the retry
-                # budget either
-                self._requeue_transient(key)
-            else:
-                self._requeue_rate_limited(key)
+                self._sync_failed(key, e)
+                return
+            # this shard lost the job's slot mid-sync (lease takeover raced
+            # the in-flight status write): the store already refused the
+            # write, the NEW owner drives the job from here — drop cleanly
+            # instead of retrying a write that can never succeed with our
+            # token (requeue would only re-fence until the lease tick
+            # disowns the slot)
+            logger_for_key(self.kind, key).warning("fenced mid-sync: %s", e)
+            self._clear_failures(key)
+            self.engine.disown_job(key)
+        except Exception as e:  # noqa: BLE001 — workers must not die
+            self._sync_failed(key, e)
+
+    def _sync_failed(self, key: str, e: Exception) -> None:
+        logger_for_key(self.kind, key).error("sync panic: %s", e)
+        metrics.SYNC_ERRORS.inc({"kind": self.kind})
+        if (
+            is_transient_api_error(e)
+            and self.manager.options.classify_retryable_errors
+        ):
+            # e.g. the initial job GET during an apiserver storm —
+            # transient failures here must not consume the retry
+            # budget either
+            self._requeue_transient(key)
+        else:
+            self._requeue_rate_limited(key)
 
     def run_worker(self) -> None:
         while True:
@@ -303,14 +376,24 @@ class OperatorManager:
         cluster,
         options: Optional[ServerOptions] = None,
         engine_kwargs: Optional[Dict] = None,
+        factory: Optional[SharedInformerFactory] = None,
+        shard=None,
     ) -> None:
         """`engine_kwargs` is forwarded to every kind's JobEngine — the seam
         tests use to inject a simulated clock (chaos soak) or alternate
-        control objects without patching."""
+        control objects without patching.
+
+        `factory` lets N shard instances share one set of informers (one
+        watch per kind for the whole control plane, events fanned out to
+        every shard's filtering handlers).  `shard` is the ownership
+        handle (ShardedOperator wires it): `owns_uid(uid)` routes events,
+        `fence_token_for(uid)` fences status writes.  Both default to the
+        historical single-process behavior."""
         self.cluster = cluster
         self.options = options or ServerOptions()
         self.engine_kwargs = engine_kwargs or {}
-        self.factory = SharedInformerFactory(
+        self.shard = shard
+        self.factory = factory or SharedInformerFactory(
             cluster, resync_period=self.options.resync_period
         )
         self.controllers: Dict[str, _KindController] = {}
@@ -328,14 +411,30 @@ class OperatorManager:
             )
         self._started = False
 
+    # ------------------------------------------------------------- ownership
+    def _owns_uid(self, uid: Optional[str]) -> bool:
+        return self.shard is None or self.shard.owns_uid(uid)
+
+    def _owns_obj(self, obj: Dict) -> bool:
+        return self._owns_uid((obj.get("metadata") or {}).get("uid"))
+
+    def _may_act_obj(self, obj: Dict) -> bool:
+        if self.shard is None:
+            return True
+        return self.shard.may_act((obj.get("metadata") or {}).get("uid"))
+
     # ------------------------------------------------------------- dependents
     def _on_dependent(self, obj) -> None:
-        """Route a Pod/Service event to its controlling job's queue."""
+        """Route a Pod/Service event to its controlling job's queue —
+        sharded: only when this shard owns the controlling job (the
+        ownerReference carries the job UID the rendezvous hash keys on)."""
         ref = objects.get_controller_of(obj)
         if not ref:
             return
         ctl = self.controllers.get(ref.get("kind", ""))
         if ctl is None:
+            return
+        if not self._owns_uid(ref.get("uid")):
             return
         key = f"{objects.namespace_of(obj)}/{ref.get('name', '')}"
         ctl.enqueue(key)
@@ -375,20 +474,436 @@ class OperatorManager:
     def process_until_idle(self, timeout: float = 10.0) -> None:
         """Deterministically drain all queues without worker threads —
         the test-mode dispatch (timers from add_after still apply)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            busy = False
-            for ctl in self.controllers.values():
-                key = ctl.queue.get(timeout=0)
-                if key is None:
+        _drain_until_idle(
+            lambda: self.controllers.values(), timeout,
+            "queues did not drain",
+        )
+
+
+def _drain_until_idle(controllers, timeout: float, timeout_msg: str) -> None:
+    """The single test-mode dispatch loop (one key per live controller
+    per round, _sync_guarded, done) shared by OperatorManager and
+    ShardedOperator — `controllers` is a callable returning the live
+    controller set so a shard crashing mid-drain drops out."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        busy = False
+        for ctl in controllers():
+            key = ctl.queue.get(timeout=0)
+            if key is None:
+                continue
+            busy = True
+            try:
+                ctl._sync_guarded(key)
+            finally:
+                ctl.queue.done(key)
+        if not busy:
+            if all(len(c.queue) == 0 for c in controllers()):
+                return
+            time.sleep(0.002)
+    raise TimeoutError(timeout_msg)
+
+
+# --------------------------------------------------------------------- shards
+class _ShardHandle:
+    """The ownership/fencing view one shard's OperatorManager consults —
+    the whole seam between the shard library and the coordinator."""
+
+    def __init__(self, op: "ShardedOperator", index: int) -> None:
+        self._op = op
+        self.index = index
+        self.shard_id = f"shard-{index}"
+
+    def owns_uid(self, uid: Optional[str]) -> bool:
+        return (
+            self._op.router.slot_for(uid)
+            in self._op.shards[self.index].owned_slots
+        )
+
+    def may_act(self, uid: Optional[str]) -> bool:
+        """owns_uid AND the slot's lease can still be assumed valid —
+        the gate on SIDE EFFECTS.  `owns_uid` is raw belief (event
+        routing: a partitioned shard keeps collecting its events so a
+        recovered renew resumes seamlessly); `may_act` is proof: once
+        the lease window lapses without a successful renew (partition,
+        renew-failure storm, or a resumed zombie), the shard must not
+        issue pod/service mutations — only the status write is
+        store-fenced, a zombie's create/delete would land unfenced."""
+        shard = self._op.shards[self.index]
+        slot = self._op.router.slot_for(uid)
+        if slot not in shard.owned_slots:
+            return False
+        if not self._op.enable_leases:
+            return True
+        lock = shard.locks.get(slot)
+        return (
+            lock is not None and lock.held and not lock.locally_expired()
+        )
+
+    def fence_token_for(self, uid: Optional[str]) -> Optional[str]:
+        shard = self._op.shards[self.index]
+        lock = shard.locks.get(self._op.router.slot_for(uid))
+        return lock.token if lock is not None else None
+
+
+class _Shard:
+    """One control-plane worker: its manager (queues + engines +
+    expectations), the slots it believes it owns, and its per-slot lease
+    locks.  `crashed` simulates process death: the shard stops renewing
+    and stops processing; `owned_slots` is deliberately NOT cleared — a
+    resumed zombie still believes, which is what fencing must defeat."""
+
+    def __init__(self, op: "ShardedOperator", index: int) -> None:
+        self.index = index
+        self.id = f"shard-{index}"
+        self.handle = _ShardHandle(op, index)
+        self.crashed = False
+        self.owned_slots: set = set()
+        self.locks: Dict[int, LeaseLock] = {}
+        self.manager = OperatorManager(
+            op.cluster,
+            op.options,
+            engine_kwargs=op.engine_kwargs,
+            factory=op.factory,
+            shard=self.handle,
+        )
+
+
+class ShardedOperator:
+    """The sharded control plane: N OperatorManager shards over one
+    cluster and one shared informer set.
+
+    - **Partition**: job UID -> slot via rendezvous hashing
+      (engine/sharding.py); informer events route to the owning shard's
+      workqueue, so shards share no queues, no expectations, no fan-out
+      executors.
+    - **Ownership**: one coordination.k8s.io/Lease per slot
+      (`{lock_prefix}-{slot}`), held via cmd/leader.py LeaseLock with an
+      injectable clock — the chaos SimClock expires leases without real
+      sleeps.  Every new holding bumps the lease generation.
+    - **Failover**: `tick()` renews held slots and sweeps lapsed ones; the
+      survivor with the fewest slots (lowest id tiebreak) acquires the
+      lease, **re-lists and re-adopts** that slot's jobs (enqueue all,
+      rebuild expectations from scratch), and its generation fences out
+      the previous holder: a zombie's status writes are rejected by the
+      store (k8s/fake.py `_check_fence`) and surface as
+      `tpu_operator_fencing_rejections_total`.
+    - **shards=1**: leases default off, ownership is static, and the data
+      path is byte-identical to the single OperatorManager (asserted
+      against the pre-shard chaos golden log).
+
+    `note` is an optional callable(line) for the deterministic chaos log
+    (FaultInjector.note); `clock` drives lease expiry.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        options: Optional[ServerOptions] = None,
+        shard_count: int = 1,
+        engine_kwargs: Optional[Dict] = None,
+        lease_duration: float = 15.0,
+        lease_namespace: str = "default",
+        lock_prefix: str = "tpu-operator-shard",
+        clock: Callable[[], float] = time.time,
+        enable_leases: Optional[bool] = None,
+        note: Optional[Callable[[str], None]] = None,
+        instance_id: Optional[str] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.options = options or ServerOptions()
+        self.engine_kwargs = engine_kwargs or {}
+        self.shard_count = shard_count
+        self.router = ShardRouter(shard_count)
+        self.clock = clock
+        self.lease_duration = lease_duration
+        self.lease_namespace = lease_namespace
+        self.lock_prefix = lock_prefix
+        self.enable_leases = (
+            shard_count > 1 if enable_leases is None else enable_leases
+        )
+        self.note = note or (lambda line: None)
+        # lease holder identities must be unique per OPERATOR INSTANCE,
+        # not just per shard index: with a bare "shard-0" identity a
+        # second process (rolling-update overlap, accidental replica,
+        # standby) would silently "renew" the first process's lease as
+        # the same holder — no generation bump, fencing bypassed, both
+        # drive every job.  shard.id stays the short display name
+        # (metrics labels, chaos notes) so deterministic logs are
+        # unaffected; only the Lease holderIdentity is qualified.
+        self.instance_id = instance_id or (
+            f"{os.getpid():x}.{uuid.uuid4().hex[:6]}"
+        )
+        self.factory = SharedInformerFactory(
+            cluster, resync_period=self.options.resync_period
+        )
+        self.shards: List[_Shard] = [
+            _Shard(self, i) for i in range(shard_count)
+        ]
+        # appended AFTER a failover's re-adopt enqueues complete — the
+        # signal probes (bench failover_recovery_s) wait on, instead of
+        # racing the owned_slots.add → enqueue window where the slot
+        # already reads as owned but no re-adopt sync is queued yet
+        self.failover_events: List[Dict] = []
+        self._threaded = False
+        self._stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # ------------------------------------------------------------- leases
+    def _lock_for(self, shard: _Shard, slot: int) -> LeaseLock:
+        lock = shard.locks.get(slot)
+        if lock is None:
+            lock = LeaseLock(
+                self.cluster,
+                identity=f"{self.instance_id}/{shard.id}",
+                lock_name=f"{self.lock_prefix}-{slot}",
+                namespace=self.lease_namespace,
+                lease_duration=self.lease_duration,
+                clock=self.clock,
+            )
+            shard.locks[slot] = lock
+        return lock
+
+    def slot_owner(self, slot: int) -> Optional[int]:
+        """The live shard currently believing it owns `slot` (None while
+        the slot is orphaned, i.e. between a crash and the takeover)."""
+        for shard in self.shards:
+            if not shard.crashed and slot in shard.owned_slots:
+                return shard.index
+        return None
+
+    def tick(self) -> None:
+        """One deterministic lease-maintenance pass, shards in id order:
+        renew held slots, shed definitively lost ones (another holder
+        observed, or our lease window lapsed — a transient store error
+        inside the window keeps ownership and retries next tick), then
+        sweep lapsed slots for takeover.  Driven by the background loop in
+        threaded mode and explicitly (against SimClock) in chaos tests."""
+        if self.enable_leases:
+            for shard in self.shards:
+                if shard.crashed:
                     continue
-                busy = True
-                try:
-                    ctl._sync_guarded(key)
-                finally:
-                    ctl.queue.done(key)
-            if not busy:
-                if all(len(c.queue) == 0 for c in self.controllers.values()):
-                    return
-                time.sleep(0.002)
-        raise TimeoutError("queues did not drain")
+                for slot in sorted(shard.owned_slots):
+                    lock = self._lock_for(shard, slot)
+                    if lock.try_acquire_or_renew():
+                        continue
+                    if lock.lost_to_other or lock.locally_expired():
+                        self._disown(shard, slot)
+            for slot in range(self.shard_count):
+                if any(
+                    slot in s.owned_slots and not s.crashed
+                    for s in self.shards
+                ):
+                    continue
+                live = [s for s in self.shards if not s.crashed]
+                if not live:
+                    continue
+                # survivor with the fewest slots takes over (lowest id
+                # tiebreak); the lease CAS itself enforces expiry — the
+                # attempt fails until the old lease lapses
+                candidate = min(live, key=lambda s: (len(s.owned_slots), s.index))
+                if self._lock_for(candidate, slot).try_acquire_or_renew():
+                    self._adopt(candidate, slot, failover=True)
+        self._update_gauges()
+
+    # ------------------------------------------------------------- ownership
+    def _jobs_in_slot(self, manager: OperatorManager, slot: int) -> List:
+        """Sorted (kind, key) of every job hashing to `slot` — informer
+        cache when synced, live LIST as the fallback (failover is rare;
+        one LIST per kind is fine)."""
+        found = []
+        for kind, ctl in manager.controllers.items():
+            try:
+                jobs = (
+                    ctl.lister.list()
+                    if ctl.lister.synced()
+                    else self.cluster.list(kind)
+                )
+            except (ApiError, OSError):
+                jobs = []  # mid-storm re-adopt: the resync retry heals it
+            for job in jobs:
+                ns = self.options.namespace
+                if ns and objects.namespace_of(job) != ns:
+                    continue
+                uid = (job.get("metadata") or {}).get("uid")
+                if self.router.slot_for(uid) == slot:
+                    found.append((kind, objects.key_of(job)))
+        return sorted(found)
+
+    def _adopt(
+        self, shard: _Shard, slot: int, failover: bool = False,
+        initial: bool = False,
+    ) -> None:
+        shard.owned_slots.add(slot)
+        lock = shard.locks[slot]
+        adopted = 0
+        if not initial:
+            # re-list and re-adopt: every job of the slot is enqueued on
+            # the new owner, whose per-job engine state starts clean (a
+            # previous holding's expectations must not gate the re-sync)
+            for kind, key in self._jobs_in_slot(shard.manager, slot):
+                ctl = shard.manager.controllers[kind]
+                ctl.engine.disown_job(key)
+                ctl.enqueue(key)
+                adopted += 1
+        if failover:
+            metrics.SHARD_FAILOVERS.inc(
+                {"slot": str(slot), "shard": shard.id}
+            )
+            self.note(
+                f"shard_failover slot={slot} new_owner={shard.id} "
+                f"generation={lock.generation} jobs={adopted}"
+            )
+            self.failover_events.append(
+                {"slot": slot, "shard": shard.index, "jobs": adopted}
+            )
+
+    def _disown(self, shard: _Shard, slot: int) -> None:
+        shard.owned_slots.discard(slot)
+        dropped = 0
+        for kind, key in self._jobs_in_slot(shard.manager, slot):
+            shard.manager.controllers[kind].engine.disown_job(key)
+            dropped += 1
+        self.note(
+            f"shard_disown slot={slot} shard={shard.id} jobs={dropped}"
+        )
+
+    def _update_gauges(self) -> None:
+        for shard in self.shards:
+            metrics.SHARD_SLOTS_OWNED.set(
+                0 if shard.crashed else len(shard.owned_slots),
+                {"shard": shard.id},
+            )
+        # one O(jobs) pass per kind building slot -> count (the informers
+        # are shared, so any shard's lister sees the same cache), then
+        # each shard just sums its owned slots — scanning every kind's
+        # full lister once PER SHARD would put O(jobs x shards) work on
+        # the tick thread that also handles renew/failover latency
+        for kind, ctl in self.shards[0].manager.controllers.items():
+            if not ctl.lister.synced():
+                continue
+            slot_counts: Dict[int, int] = {}
+            for j in ctl.lister.list():
+                slot = self.router.slot_for(
+                    (j.get("metadata") or {}).get("uid")
+                )
+                slot_counts[slot] = slot_counts.get(slot, 0) + 1
+            for shard in self.shards:
+                owned = sum(
+                    slot_counts.get(s, 0) for s in shard.owned_slots
+                )
+                metrics.SHARD_JOBS_OWNED.set(
+                    0 if shard.crashed else owned,
+                    {"shard": shard.id, "kind": kind},
+                )
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, workers: bool = True) -> None:
+        """Acquire home slots FIRST (slot i -> shard i), then start the
+        shared informers — the initial ADDED dispatch routes through
+        already-settled ownership, so no job's first sync is dropped —
+        then worker threads (and the lease-maintenance loop) per shard."""
+        for shard in self.shards:
+            if not self.enable_leases:
+                shard.owned_slots.add(shard.index)
+            elif self._lock_for(shard, shard.index).try_acquire_or_renew():
+                self._adopt(shard, shard.index, initial=True)
+            # a home slot whose lease is held elsewhere (restart racing a
+            # standby) is picked up by the first tick's takeover sweep
+        self.factory.start_all()
+        if not self.factory.wait_for_cache_sync():
+            raise RuntimeError("informer caches failed to sync")
+        if workers:
+            self._threaded = True
+            for shard in self.shards:
+                for ctl in shard.manager.controllers.values():
+                    ctl.start_workers(self.options.threadiness)
+            if self.enable_leases:
+                self._tick_thread = threading.Thread(
+                    target=self._tick_loop, daemon=True
+                )
+                self._tick_thread.start()
+        self._started = True
+
+    def _tick_loop(self) -> None:
+        period = max(0.02, min(self.lease_duration / 3.0, 2.0))
+        log = logger_with({"component": "shard-leases"})
+        while not self._stop.wait(period):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — lease upkeep must not die
+                # but a persistently failing tick means renewals have
+                # silently stopped and every slot will lapse: say so
+                log.error("lease tick failed: %s", e)
+
+    def crash_shard(self, index: int) -> None:
+        """Simulate a shard worker crash: stops renewing, stops
+        processing.  Its lease(s) lapse after lease_duration and tick()'s
+        sweep fails the slots over to survivors.  The shard's ownership
+        memory is kept — resume_shard() brings it back as a zombie that
+        still believes, which fencing must (and does) stop."""
+        shard = self.shards[index]
+        shard.crashed = True
+        if self._threaded:
+            for ctl in shard.manager.controllers.values():
+                ctl.queue.shut_down()
+
+    def resume_shard(self, index: int) -> None:
+        """Un-crash a shard WITHOUT rediscovery: it still holds its old
+        owned_slots and cached fencing tokens — the zombie scenario.  Its
+        next tick renew observes the new holder and disowns."""
+        self.shards[index].crashed = False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=2)
+        if self.enable_leases:
+            # voluntary shutdown releases held leases (after the tick
+            # thread is down, so no renew races the release) — otherwise
+            # a clean rolling restart's replacement instance, being a
+            # DIFFERENT holder identity, would wait out the full lease
+            # duration on every slot before driving a single job.
+            # Crashed shards keep theirs: that's the zombie contract.
+            for shard in self.shards:
+                if shard.crashed:
+                    continue
+                for slot in sorted(shard.owned_slots):
+                    lock = shard.locks.get(slot)
+                    if lock is not None and lock.held:
+                        lock.release()
+        for shard in self.shards:
+            for ctl in shard.manager.controllers.values():
+                ctl.queue.shut_down()
+        self.factory.stop_all()
+        for shard in self.shards:
+            for ctl in shard.manager.controllers.values():
+                for t in ctl.workers:
+                    t.join(timeout=2)
+        self._started = False
+
+    @property
+    def healthy(self) -> bool:
+        return True
+
+    @property
+    def ready(self) -> bool:
+        return self._started and all(
+            i.has_synced() for i in self.factory._informers.values()
+        )
+
+    # ------------------------------------------------------------- test mode
+    def process_until_idle(self, timeout: float = 10.0) -> None:
+        """Deterministic single-threaded dispatch across every live shard
+        (shards in id order, one key per controller per round)."""
+        _drain_until_idle(
+            lambda: [
+                ctl
+                for s in self.shards
+                if not s.crashed
+                for ctl in s.manager.controllers.values()
+            ],
+            timeout,
+            "shard queues did not drain",
+        )

@@ -49,6 +49,13 @@ class ServerOptions:
     # via exponential batch growth (1, 2, 4, ...).  1 (default) keeps the
     # strictly serial, deterministic pre-fan-out order.
     control_fanout: int = 1
+    # sharded control plane (cmd/manager.py ShardedOperator): number of
+    # controller shards; jobs are partitioned across shards by rendezvous
+    # hashing on job UID, each slot owned via a coordination.k8s.io/Lease
+    # with crash failover and fencing.  1 (default) is the single-process
+    # operator, byte-identical to the pre-shard engine.
+    shards: int = 1
+    shard_lease_duration: float = 15.0
     # when True (default), reconcile errors the client layer classified as
     # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
     # consuming the bounded reconcile-retry budget; False restores the
@@ -129,6 +136,22 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "per sync, reached by exponential slow-start batches (1, 2, 4, "
         "...); 1 (default) keeps the serial, deterministic order",
     )
+    p.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        help="controller shards: jobs are partitioned across this many "
+        "shard slots by rendezvous hashing on job UID, each slot owned "
+        "via a Lease with crash failover and fenced status writes; "
+        "1 (default) is the single-process operator",
+    )
+    p.add_argument(
+        "--shard-lease-duration",
+        type=float,
+        default=15.0,
+        help="per-slot Lease duration in seconds (failover detection "
+        "latency is bounded by this)",
+    )
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -159,4 +182,6 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         restart_backoff_base=a.restart_backoff_base,
         restart_backoff_max=a.restart_backoff_max,
         control_fanout=a.control_fanout,
+        shards=a.shards,
+        shard_lease_duration=a.shard_lease_duration,
     )

@@ -179,8 +179,10 @@ def _status_payload(code: int, message: str) -> Dict[str, Any]:
         404: "NotFound",
         409: "Conflict",
         400: "BadRequest",
+        403: "Forbidden",
         410: "Gone",
         422: "Invalid",
+        429: "TooManyRequests",
     }
     return {
         "kind": "Status",

@@ -22,17 +22,163 @@ is framed by connection close, byte-compatible with the old HTTP/1.0
 behavior (one JSON object per line; server closes on 410/close) — which is
 also exactly how the client treats watches: one dedicated, never-pooled
 connection per stream.
+
+An APF-style **priority-and-fairness admission layer** (ISSUE 6) can be
+put in front of the transport: :class:`FairFlowController` keeps one FIFO
+queue per tenant flow (flow = the request path's namespace), dispatches
+queued requests round-robin across flows as execution seats free up, and
+answers queue overflow/timeout with 429 + Retry-After — which the
+operator's client retry ladder (k8s/client.py RetryPolicy) already
+honors.  One noisy tenant saturating its own queue gets throttled while
+other tenants' queue wait stays bounded; watch streams are exempt (k8s
+APF exempts long-running requests the same way).
 """
 from __future__ import annotations
 
 import json
+import re
 import threading
+import time
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 from urllib.parse import parse_qsl, urlsplit
 
 from tf_operator_tpu.e2e.apiserver import ApiServerTransport, _status_payload
+from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.k8s.fake import ApiError, FakeCluster
+
+_FLOW_NS_RE = re.compile(r"/namespaces/([^/]+)/")
+
+
+def flow_of(path: str) -> str:
+    """Tenant flow a request belongs to: its namespace (the natural tenant
+    boundary in this control plane), 'cluster' for cluster-scoped paths."""
+    m = _FLOW_NS_RE.search(path)
+    return m.group(1) if m else "cluster"
+
+
+class RejectedError(ApiError):
+    """Admission rejection: 429 carrying Retry-After, the contract the
+    client retry ladder consumes."""
+
+    def __init__(self, message: str, retry_after: float) -> None:
+        super().__init__(429, message, retry_after=retry_after)
+
+
+class FairFlowController:
+    """APF-style admission: bounded concurrent execution seats, one
+    bounded FIFO queue per flow, round-robin fair dispatch across flows.
+
+    `acquire(flow)` blocks until a seat is granted, raises
+    :class:`RejectedError` when the flow's queue is full or the queue wait
+    exceeds `queue_timeout`.  `release()` frees the seat and dispatches
+    the next waiter fairly.  No-barging: while any flow has waiters, new
+    arrivals queue behind them even if a seat is momentarily free —
+    otherwise a hot flow's back-to-back arrivals would starve queued
+    flows forever.
+    """
+
+    def __init__(
+        self,
+        seats: int = 8,
+        queue_limit: int = 16,
+        queue_timeout: float = 15.0,
+        retry_after: float = 1.0,
+    ) -> None:
+        self.seats = seats
+        self.queue_limit = queue_limit
+        self.queue_timeout = queue_timeout
+        self.retry_after = retry_after
+        self._cond = threading.Condition()
+        self._executing = 0
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._rr: deque = deque()  # flows with waiters, round-robin order
+
+    def _depth(self, flow: str) -> int:
+        q = self._queues.get(flow)
+        return len(q) if q else 0
+
+    def acquire(self, flow: str) -> None:
+        t0 = time.monotonic()
+        with self._cond:
+            if self._executing < self.seats and not self._rr:
+                self._executing += 1
+                metrics.APF_DISPATCHED.inc({"flow": flow})
+                return
+            if self._depth(flow) >= self.queue_limit:
+                metrics.APF_REJECTED.inc(
+                    {"flow": flow, "reason": "queue_full"}
+                )
+                raise RejectedError(
+                    f"flow {flow!r} admission queue full "
+                    f"({self.queue_limit} waiting)",
+                    retry_after=self.retry_after,
+                )
+            ticket = {"ready": False}
+            q = self._queues.get(flow)
+            if q is None:
+                q = self._queues[flow] = deque()
+            if not q:
+                self._rr.append(flow)
+            q.append(ticket)
+            metrics.APF_QUEUE_DEPTH.set(len(q), {"flow": flow})
+            deadline = t0 + self.queue_timeout
+            while not ticket["ready"]:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # timed out still queued: remove the ticket (it may
+                    # sit anywhere in the deque behind dispatched peers)
+                    try:
+                        q.remove(ticket)
+                    except ValueError:
+                        pass  # dispatched in the same instant: take it
+                    else:
+                        if not q:
+                            try:
+                                self._rr.remove(flow)
+                            except ValueError:
+                                pass
+                            self._queues.pop(flow, None)
+                        metrics.APF_QUEUE_DEPTH.set(
+                            self._depth(flow), {"flow": flow}
+                        )
+                        metrics.APF_REJECTED.inc(
+                            {"flow": flow, "reason": "timeout"}
+                        )
+                        raise RejectedError(
+                            f"flow {flow!r} queue wait exceeded "
+                            f"{self.queue_timeout}s",
+                            retry_after=self.retry_after,
+                        )
+                    break
+                self._cond.wait(remaining)
+        metrics.APF_QUEUE_WAIT.observe(
+            time.monotonic() - t0, {"flow": flow}
+        )
+
+    def release(self) -> None:
+        with self._cond:
+            self._executing -= 1
+            self._dispatch_locked()
+
+    def _dispatch_locked(self) -> None:
+        while self._executing < self.seats and self._rr:
+            flow = self._rr.popleft()
+            q = self._queues.get(flow)
+            if not q:
+                self._queues.pop(flow, None)
+                continue
+            ticket = q.popleft()
+            ticket["ready"] = True
+            self._executing += 1
+            metrics.APF_DISPATCHED.inc({"flow": flow})
+            metrics.APF_QUEUE_DEPTH.set(len(q), {"flow": flow})
+            if q:
+                self._rr.append(flow)  # fair: go to the back of the ring
+            else:
+                self._queues.pop(flow, None)
+            self._cond.notify_all()
 
 
 class HttpApiServer:
@@ -43,10 +189,13 @@ class HttpApiServer:
         fake: Optional[FakeCluster] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        apf: Optional[FairFlowController] = None,
     ) -> None:
         self.fake = fake if fake is not None else FakeCluster()
         self.transport = ApiServerTransport(self.fake)
+        self.apf = apf
         transport = self.transport
+        flow_controller = apf
 
         class Handler(BaseHTTPRequestHandler):
             # HTTP/1.1 keep-alive: responses are Content-Length framed so
@@ -72,17 +221,39 @@ class HttpApiServer:
                 parsed = urlsplit(self.path)
                 query = dict(parse_qsl(parsed.query))
                 if method == "GET" and query.get("watch") == "true":
+                    # long-running requests are APF-exempt (a watch would
+                    # pin its seat for the stream's whole lifetime)
                     return self._stream(parsed.path, query)
                 try:
                     body = self._body()
                 except (ValueError, OSError):
                     return self._reply(400, {"message": "bad request body"})
-                status, payload = transport.request(
-                    method, parsed.path, query or None, body
-                )
+                if flow_controller is not None:
+                    flow = flow_of(parsed.path)
+                    try:
+                        flow_controller.acquire(flow)
+                    except RejectedError as e:
+                        return self._reply(
+                            429,
+                            _status_payload(429, str(e)),
+                            headers={"Retry-After": f"{e.retry_after:g}"},
+                        )
+                    try:
+                        status, payload = transport.request(
+                            method, parsed.path, query or None, body
+                        )
+                    finally:
+                        flow_controller.release()
+                else:
+                    status, payload = transport.request(
+                        method, parsed.path, query or None, body
+                    )
                 self._reply(status, payload)
 
-            def _reply(self, status: int, payload) -> None:
+            def _reply(
+                self, status: int, payload,
+                headers: Optional[Dict[str, str]] = None,
+            ) -> None:
                 if isinstance(payload, str):
                     data, ctype = payload.encode(), "text/plain"
                 else:
@@ -90,6 +261,8 @@ class HttpApiServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 try:
                     self.wfile.write(data)

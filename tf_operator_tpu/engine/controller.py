@@ -178,6 +178,16 @@ class JobEngine:
             self.expectations = ControllerExpectations(clock=clock)
         self.pod_control = pod_control or PodControl(cluster)
         self.service_control = service_control or ServiceControl(cluster)
+        # sharded control plane (engine/sharding.py): when set by the
+        # manager, a callable(job_uid) -> fencing token (or None) whose
+        # result is stamped into status-write bodies; the store rejects
+        # stale tokens so a zombie shard can never clobber the new owner
+        self.fence: Optional[Any] = None
+        # expectation keys ever raised per job key — lets disown_job()
+        # clear a moved job's in-flight expectations exactly (works for
+        # both the Python and native ledgers, which have per-key delete
+        # but no prefix scan)
+        self._exp_keys: Dict[str, set] = {}
         # stale-read fence: highest resourceVersion seen or written per job
         # key.  A lagging read (apiserver watch cache, chaos-injected stale
         # window) must not drive a reconcile — acting on it deletes pods
@@ -473,8 +483,25 @@ class JobEngine:
         return False
 
     def forget_job(self, job_key: str) -> None:
-        """Drop per-job engine memory (fence watermark) once the job is
-        gone; a recreated job starts a fresh incarnation."""
+        """Drop per-job engine memory (fence watermark + tracked
+        expectation keys) once the job is gone; a recreated job starts a
+        fresh incarnation.  The expectation records themselves are already
+        settled by the deletion path — only the key-tracking set must not
+        outlive the job (it would grow with lifetime job count)."""
+        self._rv_seen.pop(job_key, None)
+        self._exp_keys.pop(job_key, None)
+
+    def _track_exp_key(self, job_key: str, key: str) -> None:
+        self._exp_keys.setdefault(job_key, set()).add(key)
+
+    def disown_job(self, job_key: str) -> None:
+        """The job moved to another shard (slot failover / resize): drop
+        every piece of per-job engine state so nothing leaks and nothing
+        stale gates the NEW owner's syncs if the slot ever comes back —
+        in-flight expectations are deleted (rebuilt from scratch by
+        whoever owns the job next), the rv watermark is cleared."""
+        for key in self._exp_keys.pop(job_key, ()):
+            self.expectations.delete_expectations(key)
         self._rv_seen.pop(job_key, None)
 
     def _reconcile(self, job: Job) -> ReconcileResult:
@@ -903,6 +930,7 @@ class JobEngine:
         deletion will never surface as an informer event, so the
         expectation is settled here."""
         key = gen_expectation_pods_key(job.key, rtype)
+        self._track_exp_key(job.key, key)
         self.expectations.raise_expectations(key, 0, 1)
         try:
             self.pod_control.delete_pod(
@@ -926,6 +954,7 @@ class JobEngine:
         """reference createNewPod (tfjob_controller.go:744-834)."""
         rt = rtype.lower()
         key = gen_expectation_pods_key(job.key, rtype)
+        self._track_exp_key(job.key, key)
         self.expectations.raise_expectations(key, 1, 0)
 
         labels = self.gen_labels(job.name)
@@ -1063,6 +1092,7 @@ class JobEngine:
     ) -> None:
         """Expectation-guarded service delete (scale-down path)."""
         key = gen_expectation_services_key(job.key, rtype)
+        self._track_exp_key(job.key, key)
         self.expectations.raise_expectations(key, 0, 1)
         try:
             self.service_control.delete_service(
@@ -1077,6 +1107,7 @@ class JobEngine:
     ) -> None:
         rt = rtype.lower()
         key = gen_expectation_services_key(job.key, rtype)
+        self._track_exp_key(job.key, key)
         self.expectations.raise_expectations(key, 1, 0)
 
         labels = self.gen_labels(job.name)
@@ -1368,6 +1399,14 @@ class JobEngine:
             },
             "status": new_status,
         }
+        # sharded mode: the owning slot's fencing token rides in the write
+        # body's annotations (never persisted — /status merges .status
+        # only) so the store can reject a zombie's post-failover writes
+        fence_token = self.fence(job.uid) if self.fence is not None else None
+        if fence_token:
+            from tf_operator_tpu.engine.sharding import FENCE_ANNOTATION
+
+            body["metadata"]["annotations"] = {FENCE_ANNOTATION: fence_token}
         # legacy cluster doubles without the status verb keep the old
         # read-modify-write shape (fetch, overlay status, full update)
         update_status = getattr(self.cluster, "update_status", None)
@@ -1415,5 +1454,15 @@ class JobEngine:
             return None
         current["status"] = new_status
         if update_status is not None:
+            # the retry must carry the fencing token too (only on the
+            # status verb, whose merge discards body annotations; the
+            # legacy full-update path below would PERSIST them)
+            fence_token = self.fence(job.uid) if self.fence is not None else None
+            if fence_token:
+                from tf_operator_tpu.engine.sharding import FENCE_ANNOTATION
+
+                current.setdefault("metadata", {}).setdefault(
+                    "annotations", {}
+                )[FENCE_ANNOTATION] = fence_token
             return update_status(self.adapter.KIND, current)
         return self.cluster.update(self.adapter.KIND, current)

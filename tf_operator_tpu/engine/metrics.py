@@ -322,6 +322,61 @@ CONTROL_OP_DURATION = Histogram(
     "transport pool and control fan-out exist to hide",
 )
 
+# ------------------------------------------------------- sharded control plane
+# Shard ownership, failover, and fencing (engine/sharding.py + the
+# ShardedOperator in cmd/manager.py), plus the APF-style admission layer in
+# e2e/http_apiserver.py — the ISSUE 6 families.
+SHARD_JOBS_OWNED = Gauge(
+    f"{PREFIX}_shard_jobs_owned",
+    "Jobs currently owned by each shard (rendezvous slot ownership held "
+    "via per-slot Leases), labeled by shard and kind; the sum across "
+    "shards tracks total jobs and a skewed distribution means a hot "
+    "shard",
+)
+SHARD_SLOTS_OWNED = Gauge(
+    f"{PREFIX}_shard_slots_owned",
+    "Shard slots whose Lease each shard currently holds; in steady state "
+    "1 per live shard, >1 on a survivor that absorbed a crashed peer's "
+    "slot",
+)
+SHARD_FAILOVERS = Counter(
+    f"{PREFIX}_shard_failovers_total",
+    "Slot ownership transfers after a lease lapse (crash failover or "
+    "shrink takeover), labeled by the slot and the new owning shard — "
+    "every increment is a re-list + re-adopt of that slot's jobs",
+)
+FENCING_REJECTIONS = Counter(
+    f"{PREFIX}_fencing_rejections_total",
+    "Status writes rejected by the store because their fencing token's "
+    "lease generation was stale — a zombie shard trying to write after "
+    "losing its slot; any nonzero rate means a failover raced a "
+    "still-running old owner (and the barrier held)",
+)
+APF_QUEUE_DEPTH = Gauge(
+    f"{PREFIX}_apf_queue_depth",
+    "Requests currently parked in each tenant flow's admission queue "
+    "(APF-style priority-and-fairness layer in the e2e http apiserver), "
+    "labeled by flow",
+)
+APF_DISPATCHED = Counter(
+    f"{PREFIX}_apf_dispatched_total",
+    "Requests admitted to execution by the fair-share dispatcher, "
+    "labeled by flow — compare across flows to see fairness in action",
+)
+APF_REJECTED = Counter(
+    f"{PREFIX}_apf_rejected_total",
+    "Requests rejected with 429+Retry-After because the flow's queue was "
+    "full or the queue wait timed out, labeled by flow and reason "
+    "(queue_full | timeout); a noisy tenant shows up here while other "
+    "flows stay clean",
+)
+APF_QUEUE_WAIT = Histogram(
+    f"{PREFIX}_apf_queue_wait_seconds",
+    "How long an admitted request waited in its flow queue before a seat "
+    "freed up, labeled by flow — the fairness SLO: a noisy tenant must "
+    "not drag other flows' p99",
+)
+
 
 class ReplicaGaugeTracker:
     """Aggregates per-job active-replica counts into a {kind,replica_type}

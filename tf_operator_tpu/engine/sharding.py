@@ -1,0 +1,110 @@
+"""Job-to-shard assignment — rendezvous hashing over shard slots.
+
+The control plane scales past one process by partitioning jobs across N
+shard *slots*; each slot is owned by exactly one controller worker at a
+time (a per-slot ``coordination.k8s.io/Lease``, cmd/leader.py LeaseLock)
+and every informer event is routed to the owning shard's workqueue.  The
+partition function lives here, separate from the lease machinery, because
+its only job is to be **stable**: every shard, standby, and zombie must
+compute the same owner for the same job UID or two workqueues drive the
+same job.
+
+Rendezvous (highest-random-weight) hashing is used instead of a modulo
+ring: changing the slot count from N to N±1 reassigns only ~1/N of the
+keys (the keys whose top-scoring slot is the added/removed one), and
+removing a slot moves *exactly* that slot's keys and no others — the
+property the resize test asserts.  Scores come from blake2b, which is
+stable across processes and Python versions (``hash()`` is salted per
+process and would split the brain by construction).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Optional
+
+# Fencing-token annotation stamped into status-subresource write bodies by
+# a sharded engine and checked by the stores (k8s/fake.py, and through it
+# the REST façade + http apiserver).  Token format:
+#   "<lease-namespace>/<lease-name>:<generation>"
+# The store compares the token's generation against the named Lease's
+# spec.generation and rejects older tokens with 403 — a zombie shard that
+# wakes up after failover can never clobber the new owner's writes.  The
+# annotation never persists: the status subresource merges .status only.
+FENCE_ANNOTATION = "kubeflow.org/fencing-token"
+
+
+def fence_token(namespace: str, name: str, generation: int) -> str:
+    return f"{namespace}/{name}:{generation}"
+
+
+def parse_fence_token(token: str) -> Optional[tuple]:
+    """(namespace, name, generation) or None for an unparsable token."""
+    ref, sep, gen = token.rpartition(":")
+    if not sep:
+        return None
+    ns, _, name = ref.partition("/")
+    try:
+        return ns, name, int(gen)
+    except ValueError:
+        return None
+
+
+def rendezvous_score(uid: str, slot: int) -> int:
+    """Stable 64-bit score of (uid, slot).  One digest per pair — the
+    route is recomputed per event, so the digest is kept cheap (blake2b
+    with an 8-byte digest is a single short hash call)."""
+    h = hashlib.blake2b(f"{slot}\x00{uid}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class ShardRouter:
+    """Maps a job UID to its owning shard slot via rendezvous hashing.
+
+    Slots are dense integers [0, n).  The router is pure and shared by
+    every shard (and by standbys, and by the bench's failover probe):
+    ownership *changes* are a lease concern; the slot a UID belongs to is
+    a function of (uid, slot count) alone.
+    """
+
+    _MEMO_CAP = 65536
+
+    def __init__(self, n_slots: int) -> None:
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        self.slots: List[int] = list(range(n_slots))
+        # uid -> slot memo: every shard checks ownership of every event,
+        # so one routing decision is consulted N times per event — the
+        # hashes are cheap but not N-shards-times-per-event cheap.  Plain
+        # dict ops are atomic under the GIL; the cap bounds a pathological
+        # churn of unique UIDs (cleared wholesale, recomputed on demand).
+        self._memo: dict = {}
+
+    @property
+    def n_slots(self) -> int:
+        return len(self.slots)
+
+    def slot_for(self, uid: Optional[str]) -> int:
+        """Owning slot for a job UID.  A missing UID (malformed object)
+        deterministically lands on slot 0 so it is still driven by exactly
+        one shard rather than dropped by all of them."""
+        if not uid:
+            return 0
+        if len(self.slots) == 1:
+            return self.slots[0]
+        slot = self._memo.get(uid)
+        if slot is None:
+            # max() tiebreak on (score, slot) keeps the choice total-ordered
+            slot = max(
+                self.slots, key=lambda s: (rendezvous_score(uid, s), s)
+            )
+            if len(self._memo) >= self._MEMO_CAP:
+                self._memo.clear()
+            self._memo[uid] = slot
+        return slot
+
+    def partition(self, uids: Iterable[str]) -> dict:
+        """slot -> [uids] (bench + re-adopt sweeps)."""
+        out: dict = {s: [] for s in self.slots}
+        for uid in uids:
+            out[self.slot_for(uid)].append(uid)
+        return out

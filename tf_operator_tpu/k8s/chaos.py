@@ -96,6 +96,10 @@ class _Scheduled:
     seq: int
     label: str = field(compare=False)
     fn: Callable[[], None] = field(compare=False)
+    # log stream the firing line lands in: captured at schedule time, so a
+    # kubelet hook scheduled from shard A's create thread logs into A's
+    # stream no matter which thread fires it (see FaultInjector log docs)
+    stream: str = field(compare=False, default="")
 
 
 class FaultInjector:
@@ -128,7 +132,17 @@ class FaultInjector:
         self.kubelet = kubelet
         self.pod_start_delay = pod_start_delay
         self.nodes = nodes
-        self.log: List[str] = []
+        # Event log, kept as PER-SHARD STREAMS merged on read.  With one
+        # control-plane process (the historical shape) everything lands in
+        # the default "" stream and `log` renders exactly the old append
+        # order.  With N shard threads, each thread tags itself via
+        # set_shard(); lines (and the firing lines of events it scheduled)
+        # land in its own stream, and `log` merges streams by
+        # (sim-time, shard-id, per-stream order) — a total order that does
+        # not depend on how the OS interleaved the threads, so the
+        # byte-identical-log-per-seed guarantee survives sharding.
+        self._streams: Dict[str, List[Tuple[float, str]]] = {}
+        self._tls = threading.local()
         self.stats: Dict[str, int] = {}
         self.retryable_kills: Dict[Tuple[str, str], int] = {}
         self.permanent_kills: Dict[Tuple[str, str], int] = {}
@@ -153,8 +167,50 @@ class FaultInjector:
         with self._lock:
             self.stats[what] = self.stats.get(what, 0) + n
 
-    def _log(self, line: str) -> None:
-        self.log.append(line)
+    def set_shard(self, shard: Optional[str]) -> None:
+        """Tag the calling thread as shard `shard`: its subsequent log
+        lines (and events it schedules) land in that shard's stream.
+        None restores the default stream."""
+        self._tls.shard = shard
+
+    def _current_stream(self) -> str:
+        return getattr(self._tls, "shard", None) or ""
+
+    def _log(
+        self, line: str, t: Optional[float] = None, stream: Optional[str] = None
+    ) -> None:
+        with self._lock:
+            sid = self._current_stream() if stream is None else stream
+            entries = self._streams.setdefault(sid, [])
+            ts = self.clock() if t is None else t
+            if entries and entries[-1][0] > ts:
+                # monotone clamp per stream: a direct log at clock() can
+                # follow a scheduled line whose `at` was earlier — the
+                # merge sort must never reorder a stream's append order
+                ts = entries[-1][0]
+            entries.append((ts, line))
+
+    @property
+    def log(self) -> List[str]:
+        """The merged deterministic event log: streams interleaved by
+        (sim-time, shard-id, within-stream order).  Single-stream runs
+        render their exact append order (the pre-shard byte-identity
+        contract, asserted against the golden file)."""
+        with self._lock:
+            merged = [
+                (ts, sid, idx, line)
+                for sid, entries in self._streams.items()
+                for idx, (ts, line) in enumerate(entries)
+            ]
+        merged.sort(key=lambda e: (e[0], e[1], e[2]))
+        return [line for _, _, _, line in merged]
+
+    def note(self, label: str) -> None:
+        """Record an external actor's event (shard failover, lease
+        takeover, re-adopt sweep) at the current simulated time, in the
+        calling thread's stream — the hook the sharded control plane uses
+        so its decisions appear in the deterministic log."""
+        self._log(f"t={self.clock():g} {label}")
 
     @staticmethod
     def _job_of(pod: Dict[str, Any]) -> Optional[Tuple[str, str]]:
@@ -173,7 +229,10 @@ class FaultInjector:
         pair would corrupt the schedule heap."""
         with self._lock:
             self._seq += 1
-            heapq.heappush(self._schedule, _Scheduled(t, self._seq, label, fn))
+            heapq.heappush(
+                self._schedule,
+                _Scheduled(t, self._seq, label, fn, self._current_stream()),
+            )
 
     def after(self, dt: float, fn: Callable[[], None], label: str) -> None:
         self.at(self.clock() + dt, fn, label)
@@ -193,7 +252,8 @@ class FaultInjector:
                 if not self._schedule or self._schedule[0].at > now:
                     return
                 item = heapq.heappop(self._schedule)
-                self._log(f"t={item.at:g} {item.label}")
+                self._log(f"t={item.at:g} {item.label}", t=item.at,
+                          stream=item.stream)
             item.fn()
 
     def run_until(self, t: float, dt: float = 1.0) -> None:

@@ -42,6 +42,17 @@ class ConflictError(ApiError):
         super().__init__(409, message)
 
 
+class StaleFencingTokenError(ApiError):
+    """A status write stamped with a fencing token older than the owning
+    shard slot's current lease generation — the zombie-shard write barrier
+    (engine/sharding.py).  403, not 409: a conflict invites re-read-and-
+    retry, but a stale token means the writer is no longer the owner and
+    replaying the write with the same token can never succeed."""
+
+    def __init__(self, message: str = "stale fencing token"):
+        super().__init__(403, message)
+
+
 # HTTP statuses worth retrying at the transport level: throttling, server
 # faults, and timeouts.  Everything else 4xx is a terminal answer — the
 # request itself is wrong and replaying it cannot help.
@@ -153,6 +164,48 @@ class FakeCluster:
         if self.count_api_requests:
             _observe_api_request(verb, kind)
 
+    def _check_fence(self, kind: str, obj: Dict[str, Any]) -> None:
+        """Reject writes whose fencing token (engine/sharding.py, stamped
+        into the body's annotations by a sharded engine's status write) is
+        older than the named Lease's current generation.  Enforced HERE —
+        the authoritative store — so the REST façade and http apiserver
+        inherit it: a zombie shard that wakes up after a failover cannot
+        clobber the new owner's writes through any backend.  Writes
+        without a token, or naming a Lease that does not exist, pass
+        (fencing is only in force where a lock object says who owns)."""
+        annotations = (obj.get("metadata") or {}).get("annotations") or {}
+        if not annotations:
+            return
+        # lazy import: engine <-> k8s would cycle at module scope
+        from tf_operator_tpu.engine.sharding import (
+            FENCE_ANNOTATION,
+            parse_fence_token,
+        )
+
+        token = annotations.get(FENCE_ANNOTATION)
+        if not token:
+            return
+        parsed = parse_fence_token(token)
+        if parsed is None:
+            return
+        ns, name, gen = parsed
+        with self._lock:
+            lease = self._kind_store("Lease").get(f"{ns}/{name}")
+            if lease is None:
+                return
+            current = int((lease.get("spec") or {}).get("generation", 0) or 0)
+        if gen < current:
+            global _METRICS
+            if _METRICS is None:
+                from tf_operator_tpu.engine import metrics as _m
+                _METRICS = _m
+            _METRICS.FENCING_REJECTIONS.inc({"kind": kind})
+            raise StaleFencingTokenError(
+                f"{kind} {objects.key_of(obj)}: fencing token generation "
+                f"{gen} is stale (lease {ns}/{name} is at generation "
+                f"{current}); the writer lost slot ownership"
+            )
+
     # ------------------------------------------------------------- generic
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         self._observe("create", kind)
@@ -211,6 +264,11 @@ class FakeCluster:
     def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         self._observe("update", kind)
         with self._lock:
+            # fence INSIDE the write's critical section (the lock is
+            # reentrant): checked-then-released would let a takeover's
+            # generation bump land between the check and the write,
+            # applying a stale-token write the fence already blessed
+            self._check_fence(kind, obj)
             key = objects.key_of(obj)
             store = self._kind_store(kind)
             if key not in store:
@@ -235,9 +293,16 @@ class FakeCluster:
         optimistic-concurrency check as update().  This is the verb the
         engine's status write-back uses so a sync needs no GET-before-update:
         the in-hand object's resourceVersion rides along and a stale one
-        surfaces as ConflictError for the caller's conflict-retry."""
+        surfaces as ConflictError for the caller's conflict-retry.
+
+        The fencing check runs BEFORE the optimistic-concurrency check: a
+        zombie's stale-token write must be rejected as a fencing event
+        (counted, terminal) even when its resourceVersion happens to be
+        current."""
         self._observe("update_status", kind)
         with self._lock:
+            # same-critical-section fencing as update(): see there
+            self._check_fence(kind, obj)
             key = objects.key_of(obj)
             store = self._kind_store(kind)
             if key not in store:
