@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -66,6 +66,14 @@ bench-startup:
 bench-shard:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_shard_sweep; \
 	print(json.dumps(bench_shard_sweep(), indent=1))"
+
+# Warm-pool cold-start sweep: create-to-first-running p50/p99 and
+# warm-hit ratio with 0/30/120s simulated image-pull+init latency, warm
+# pool off vs on, fake + rest backends (ISSUE 7 evidence, no TPU
+# required).  Rows land in BENCH_r06.json.
+bench-warmpool:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_cold_start; \
+	print(json.dumps(bench_cold_start(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

@@ -21,7 +21,7 @@ from tf_operator_tpu.api import common
 from tf_operator_tpu.cmd.manager import OperatorManager, ShardedOperator
 from tf_operator_tpu.cmd.options import ServerOptions
 from tf_operator_tpu.controllers.registry import EnabledSchemes
-from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine import metrics, warmpool
 from tf_operator_tpu.k8s import objects
 from tf_operator_tpu.k8s.chaos import DeterministicQueue, FaultInjector, SimClock
 from tf_operator_tpu.k8s.fake import FakeCluster
@@ -81,12 +81,16 @@ class ConditionAuditor:
 
 def audit_orphans(inner, kind="TFJob"):
     """No pod/service may outlive (or predate) its controlling job, and no
-    replica index may be doubly materialized."""
+    replica index may be doubly materialized.  Unclaimed warm-pool standby
+    pods are the one legitimate ownerless class: they belong to no job BY
+    DESIGN until a claim writes the controllerRef (engine/warmpool.py)."""
     problems = []
     jobs = {j["metadata"]["uid"]: j for j in inner.list(kind)}
     for dep_kind in ("Pod", "Service"):
         seen = set()
         for obj in inner.list(dep_kind):
+            if warmpool.is_unclaimed_pool_pod(obj):
+                continue
             ref = objects.get_controller_of(obj)
             if ref is None or ref.get("uid") not in jobs:
                 problems.append(f"orphan {dep_kind} {objects.key_of(obj)}")
@@ -119,14 +123,20 @@ def _controllers(mgr):
 
 
 def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
-                 shards=None, lease_duration=24.0):
+                 shards=None, lease_duration=24.0, warm_pool=0,
+                 latency=None):
     """`shards=None` is the historical single OperatorManager; an int
     builds the ShardedOperator over the same injector (shards=1 disables
     leases — single-owner mode must stay byte-identical to the pre-shard
-    engine, which the golden-log test asserts)."""
+    engine, which the golden-log test asserts).  `warm_pool` enables K
+    default-shape standby pods; `latency` is an optional (pull, init)
+    pair for the chaos kubelet's seeded cold-start injection."""
     inner = FakeCluster()
     clock = SimClock()
-    inj = FaultInjector(inner, seed=seed, clock=clock)
+    pull, init = latency if latency is not None else (None, None)
+    inj = FaultInjector(
+        inner, seed=seed, clock=clock, pull_latency=pull, init_latency=init,
+    )
     auditor = ConditionAuditor(inner, "TFJob")
     opts = ServerOptions(
         enabled_schemes=EnabledSchemes(["TFJob"]),
@@ -134,6 +144,7 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
         restart_backoff_max=120.0,
         classify_retryable_errors=classify,
         control_fanout=fanout,
+        warm_pool_size=warm_pool,
     )
     if shards is None:
         mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
@@ -174,12 +185,17 @@ def drain(mgr, budget=80):
 
 
 def run_steps(inj, mgr, steps, dt=5.0):
+    pool = getattr(mgr, "warm_pool", None)
     for _ in range(steps):
         inj.step(dt)
         if isinstance(mgr, ShardedOperator):
             # deterministic lease maintenance: renewals, lapse detection,
             # takeover — the SimClock beat replaces the background loop
             mgr.tick()
+        if pool is not None:
+            # the refill loop's deterministic stand-in (no real thread
+            # may race the sim clock)
+            pool.replenish()
         # periodic resync stands in for the real informers' resync loop: it
         # re-enqueues every key (progress for keys parked behind real-time
         # delays) and retries any pending watch-gap relist
@@ -393,6 +409,132 @@ def test_shard_crash_mid_storm_soak_converges_and_is_deterministic():
     assert log1 == log2, "same seed must replay an identical merged log"
     assert any("crash shard-1" in line for line in log1)
     assert any("shard_failover slot=1" in line for line in log1)
+
+
+# --------------------------------------------- kubelet cold-start latency
+def _latency_soak_log(seed):
+    """Pull/init latency enabled on the chaos kubelet: delays are sampled
+    from the injector's seeded per-shard stream at SCHEDULE time, so the
+    run (and its log, which now carries the sampled values in the
+    kubelet_start labels) is a pure function of the seed."""
+    inner, clock, inj, mgr, auditor = make_harness(
+        seed, latency=((10.0, 40.0), (2.0, 8.0))
+    )
+    cold0 = metrics.CREATE_TO_RUNNING.count({"path": "cold"})
+    for i in range(2):
+        inj.create("TFJob", _exitcode_tfjob(f"lat{i}", workers=2).to_dict())
+    try:
+        run_steps(inj, mgr, steps=30, dt=5.0)  # 150s: worst case is 48s+1
+    finally:
+        mgr.factory.stop_all()
+    pods = inner.list_pods()
+    assert len(pods) == 4
+    assert all(objects.pod_phase(p) == objects.POD_RUNNING for p in pods)
+    assert auditor.violations == []
+    # the injected latency is visible in the cold-start histogram: every
+    # pod paid >10s, which the old 1s-delay kubelet never produced
+    assert metrics.CREATE_TO_RUNNING.count({"path": "cold"}) - cold0 == 4
+    ps = metrics.CREATE_TO_RUNNING.percentiles([0.5], {"path": "cold"})
+    assert ps[0.5] is not None and ps[0.5] >= 5.0
+    return inj.log
+
+
+def test_kubelet_latency_injection_is_byte_deterministic():
+    log1 = _latency_soak_log(SOAK_SEEDS[0])
+    log2 = _latency_soak_log(SOAK_SEEDS[0])
+    assert log1 == log2, "\n".join(
+        f"{a!r} | {b!r}" for a, b in zip(log1, log2) if a != b
+    )
+    assert any("pull=" in line and "init=" in line for line in log1)
+
+
+# ----------------------------------------------------- warm-pool chaos soak
+def run_warmpool_shard_crash_soak(seed):
+    """ISSUE 7 acceptance: 4 shards, warm pool of 6 default-shape standby
+    pods, realistic pull/init latency, the full storm schedule, and one
+    shard crashed mid-storm while 50% of the job pods are pool-claimed
+    (6 jobs x 3 workers = 18 pods, 9 of them claims).
+    Afterwards: every job Running with exact restart counters, claimed
+    pods re-adopted exactly once (no duplicate indices), unclaimed pool
+    pods neither leaked nor double-claimed (pool back at K), zero stale
+    fenced writes applied, and the whole run byte-deterministic."""
+    inner, clock, inj, mgr, auditor = make_harness(
+        seed, shards=4, lease_duration=24.0, warm_pool=9,
+        latency=((20.0, 50.0), (5.0, 15.0)),
+    )
+    pool = mgr.warm_pool
+    fencing_before = sum(metrics.FENCING_REJECTIONS.samples().values())
+    claims_before = metrics.WARM_POOL_CLAIMS.get({"shape": "v5e-1"})
+    # pre-fill: standby pods pay the pull/init cold start while no job is
+    # waiting (the whole point) — by t=80 all 6 are Running
+    run_steps(inj, mgr, steps=16, dt=5.0)
+    assert pool.ready_count("v5e-1") == 9
+
+    jobs = {
+        f"warm{i}": _stamped_exitcode_tfjob(f"warm{i}", f"job-uid-{i}")
+        for i in range(6)
+    }
+    victim_jobs = sorted(
+        n for n, job in jobs.items()
+        if mgr.router.slot_for(job.metadata["uid"]) == 1
+    )
+    assert victim_jobs, "fixture uids must place jobs on slot 1"
+
+    inj.schedule_storm(90, 15, fault="429", retry_after=3.0)
+    inj.schedule_storm(110, 10, fault="500")
+    inj.schedule_storm(122, 6, fault="conflict", ops=["update"])
+    inj.schedule_watch_outage(125, 12, kinds=("Pod", "Service"))
+    # the crash lands mid-500-storm, while half the fleet is pool-claimed
+    inj.at(115, lambda: mgr.crash_shard(1), "crash shard-1")
+    for job in jobs.values():
+        inj.create("TFJob", job.to_dict())
+    try:
+        run_steps(inj, mgr, steps=100, dt=5.0)  # through t=580
+    finally:
+        mgr.factory.stop_all()
+
+    assert auditor.violations == [], auditor.violations
+    problems = audit_orphans(inner)
+    assert problems == [], problems
+    # 18 job pods wanted, 9 warm claims (the pool's entire ready stock —
+    # refills were still mid-pull when the cold creates won the rest)
+    claims = metrics.WARM_POOL_CLAIMS.get({"shape": "v5e-1"}) - claims_before
+    assert claims == 9, claims
+    for name in jobs:
+        stored = inner.get("TFJob", "default", name)
+        status = common.JobStatus.from_dict(stored.get("status"))
+        assert common.is_running(status), (name, stored.get("status"))
+        rs = status.replica_statuses["Worker"]
+        assert rs.active == 3, (name, stored["status"])
+        booked = inj.retryable_kills.get((f"default/{name}", "worker"), 0)
+        assert rs.restarts == booked, (name, rs.restarts, booked)
+    # the failover happened and the victim's jobs (claimed pods included)
+    # were re-adopted by a survivor — exactly one pod per index survives
+    # (audit_orphans would flag duplicates)
+    assert mgr.slot_owner(1) not in (None, 1)
+    # unclaimed pool pods neither leak nor double-claim: replenishment
+    # restored exactly K standby pods, all unowned
+    assert pool.size("v5e-1") == 9
+    unclaimed = [
+        p for p in inner.list_pods()
+        if warmpool.is_unclaimed_pool_pod(p)
+    ]
+    assert len(unclaimed) == 9, [objects.key_of(p) for p in unclaimed]
+    # a crashed (never-resumed) shard produces no zombie writes; every
+    # write that landed carried a live token — zero stale writes applied
+    assert sum(metrics.FENCING_REJECTIONS.samples().values()) == fencing_before
+    return inj.log
+
+
+def test_warmpool_shard_crash_soak_converges_and_is_deterministic():
+    log1 = run_warmpool_shard_crash_soak(SOAK_SEEDS[0])
+    log2 = run_warmpool_shard_crash_soak(SOAK_SEEDS[0])
+    assert log1 == log2, "\n".join(
+        f"{a!r} | {b!r}" for a, b in zip(log1, log2) if a != b
+    )
+    assert any("crash shard-1" in line for line in log1)
+    assert any("shard_failover slot=1" in line for line in log1)
+    assert any("pod=default/warm-v5e-1-" in line for line in log1)
 
 
 def _threaded_sharded_log(seed):

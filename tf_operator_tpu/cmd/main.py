@@ -157,10 +157,12 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
 
     def start_manager():
         manager.start()
+        pool = getattr(manager, "warm_pool", None)
         log.info(
-            "manager started: kinds=%s shards=%d",
+            "manager started: kinds=%s shards=%d warm_pool=%s",
             options.all_kinds,
             getattr(manager, "shard_count", 1),
+            dict(pool.config.sizes) if pool is not None else "off",
         )
 
     if options.leader_elect:

@@ -39,6 +39,11 @@ from tf_operator_tpu.controllers.registry import make_engine
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.engine.controller import EngineConfig
 from tf_operator_tpu.engine.sharding import ShardRouter
+from tf_operator_tpu.engine.warmpool import (
+    DEFAULT_SHAPE,
+    WarmPoolConfig,
+    WarmPoolManager,
+)
 from tf_operator_tpu.k8s import objects
 from tf_operator_tpu.k8s.fake import (
     ApiError,
@@ -67,6 +72,30 @@ EXHAUSTED_RETRY_PERIOD = 120.0
 # for later genuine errors.  Capped at apiserver-outage scale.
 TRANSIENT_RETRY_BASE = 0.05
 TRANSIENT_RETRY_MAX = 30.0
+
+
+def build_warm_pool(cluster, options: ServerOptions, engine_kwargs=None):
+    """One WarmPoolManager per operator process, or None when disabled.
+    Shared by every shard's engines: claims are CAS-safe, and a single
+    refill loop owns the deficit accounting."""
+    sizes = {
+        s: k for s, k in (options.warm_pool_shapes or {}).items() if k > 0
+    }
+    if options.warm_pool_size > 0:
+        sizes.setdefault(DEFAULT_SHAPE, options.warm_pool_size)
+    if not sizes:
+        return None
+    return WarmPoolManager(
+        cluster,
+        WarmPoolConfig(
+            sizes=sizes,
+            namespace=options.namespace or "default",
+            image=options.warm_pool_image,
+        ),
+        clock=(engine_kwargs or {}).get("clock", time.time),
+        fanout=options.control_fanout,
+        refill_interval=options.warm_pool_refill_interval,
+    )
 
 
 class _KindController:
@@ -112,6 +141,9 @@ class _KindController:
             # status write so the store rejects a zombie's post-failover
             # writes (engine/sharding.py)
             self.engine.fence = manager.shard.fence_token_for
+        # warm-pool claim-before-create seam: all kinds (and all shards)
+        # share the one process-wide pool; None keeps the cold-only path
+        self.engine.warm_pool = manager.warm_pool
         self.informer.add_event_handler(
             ResourceEventHandler(
                 add_func=self._on_add,
@@ -378,6 +410,7 @@ class OperatorManager:
         engine_kwargs: Optional[Dict] = None,
         factory: Optional[SharedInformerFactory] = None,
         shard=None,
+        warm_pool=None,
     ) -> None:
         """`engine_kwargs` is forwarded to every kind's JobEngine — the seam
         tests use to inject a simulated clock (chaos soak) or alternate
@@ -388,11 +421,20 @@ class OperatorManager:
         every shard's filtering handlers).  `shard` is the ownership
         handle (ShardedOperator wires it): `owns_uid(uid)` routes events,
         `fence_token_for(uid)` fences status writes.  Both default to the
-        historical single-process behavior."""
+        historical single-process behavior.
+
+        `warm_pool` hands a shard instance the coordinator's shared
+        WarmPoolManager; a standalone manager builds (and owns) its own
+        from the options when --warm-pool-size enables one."""
         self.cluster = cluster
         self.options = options or ServerOptions()
         self.engine_kwargs = engine_kwargs or {}
         self.shard = shard
+        self._owns_warm_pool = warm_pool is None and shard is None
+        if self._owns_warm_pool:
+            warm_pool = build_warm_pool(cluster, self.options, engine_kwargs)
+            self._owns_warm_pool = warm_pool is not None
+        self.warm_pool = warm_pool
         self.factory = factory or SharedInformerFactory(
             cluster, resync_period=self.options.resync_period
         )
@@ -449,9 +491,13 @@ class OperatorManager:
             raise RuntimeError("informer caches failed to sync")
         for ctl in self.controllers.values():
             ctl.start_workers(self.options.threadiness)
+        if self._owns_warm_pool:
+            self.warm_pool.start()
         self._started = True
 
     def stop(self) -> None:
+        if self._owns_warm_pool:
+            self.warm_pool.stop()
         for ctl in self.controllers.values():
             ctl.queue.shut_down()
         self.factory.stop_all()
@@ -566,6 +612,7 @@ class _Shard:
             engine_kwargs=op.engine_kwargs,
             factory=op.factory,
             shard=self.handle,
+            warm_pool=op.warm_pool,
         )
 
 
@@ -637,6 +684,10 @@ class ShardedOperator:
         self.factory = SharedInformerFactory(
             cluster, resync_period=self.options.resync_period
         )
+        # one pool for the whole control plane, shared by every shard's
+        # engines: pool pods are unowned (no slot hashes them), claims are
+        # CAS-protected, and a single refill loop owns the K accounting
+        self.warm_pool = build_warm_pool(cluster, self.options, engine_kwargs)
         self.shards: List[_Shard] = [
             _Shard(self, i) for i in range(shard_count)
         ]
@@ -824,6 +875,12 @@ class ShardedOperator:
                     target=self._tick_loop, daemon=True
                 )
                 self._tick_thread.start()
+            if self.warm_pool is not None:
+                self.warm_pool.start()
+        elif self.warm_pool is not None:
+            # deterministic (workerless) harnesses drive replenish()
+            # explicitly — no background thread may race the sim clock
+            self.warm_pool.resync()
         self._started = True
 
     def _tick_loop(self) -> None:
@@ -857,6 +914,8 @@ class ShardedOperator:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.warm_pool is not None:
+            self.warm_pool.stop()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=2)
         if self.enable_leases:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import argparse
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tf_operator_tpu.controllers.registry import SUPPORTED_ADAPTERS, EnabledSchemes
 
@@ -56,6 +56,20 @@ class ServerOptions:
     # operator, byte-identical to the pre-shard engine.
     shards: int = 1
     shard_lease_duration: float = 15.0
+    # warm-pool pod placement (engine/warmpool.py): keep K pre-pulled,
+    # pre-initialized standby pods per slice shape; job pod creation
+    # claims from the pool (CAS) and falls back to cold create.
+    # --warm-pool-size sets K for the default shape (v5e-1, the shape
+    # every unannotated job maps to); --warm-pool-shape SHAPE=K
+    # (repeatable) configures additional shapes.  0 (default) disables
+    # the pool entirely — byte-identical to the pre-pool engine.
+    warm_pool_size: int = 0
+    warm_pool_shapes: Dict[str, int] = field(default_factory=dict)
+    # image the standby pods are pre-pulled with (the generic pre-warmed
+    # runtime; workload identity is late-bound at claim time)
+    warm_pool_image: str = "warm-runtime"
+    # cadence of the asynchronous refill loop (claims also wake it)
+    warm_pool_refill_interval: float = 0.5
     # when True (default), reconcile errors the client layer classified as
     # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
     # consuming the bounded reconcile-retry budget; False restores the
@@ -152,12 +166,43 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         help="per-slot Lease duration in seconds (failover detection "
         "latency is bounded by this)",
     )
+    p.add_argument(
+        "--warm-pool-size",
+        type=int,
+        default=0,
+        help="keep this many pre-pulled, pre-initialized standby pods for "
+        "the default slice shape; job pod creation claims from the pool "
+        "and falls back to cold create; 0 (default) disables the pool",
+    )
+    p.add_argument(
+        "--warm-pool-shape",
+        action="append",
+        default=[],
+        metavar="SHAPE=K",
+        help="per-shape pool size, e.g. v5e-8=2 (repeatable)",
+    )
+    p.add_argument(
+        "--warm-pool-image",
+        default="warm-runtime",
+        help="image the standby pods are pre-pulled with (the generic "
+        "pre-warmed runtime; workload identity is late-bound at claim)",
+    )
+    p.add_argument("--warm-pool-refill-interval", type=float, default=0.5)
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
     schemes = EnabledSchemes()
     for kind in a.enable_scheme:
         schemes.set(kind)  # raises ValueError on unknown kind
+
+    warm_shapes: Dict[str, int] = {}
+    for spec in a.warm_pool_shape:
+        shape, sep, k = spec.partition("=")
+        if not sep or not shape:
+            raise ValueError(
+                f"--warm-pool-shape wants SHAPE=K, got {spec!r}"
+            )
+        warm_shapes[shape] = int(k)
 
     return ServerOptions(
         namespace=a.namespace,
@@ -184,4 +229,8 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         control_fanout=a.control_fanout,
         shards=a.shards,
         shard_lease_duration=a.shard_lease_duration,
+        warm_pool_size=a.warm_pool_size,
+        warm_pool_shapes=warm_shapes,
+        warm_pool_image=a.warm_pool_image,
+        warm_pool_refill_interval=a.warm_pool_refill_interval,
     )

@@ -15,6 +15,7 @@ import json
 import threading
 import time
 import urllib.request
+from random import Random
 from typing import Dict, Optional
 
 from tf_operator_tpu.e2e.test_server import TestServer
@@ -32,14 +33,49 @@ class _RunningPod:
 
 
 class FakeKubelet:
-    """Watches Pods; materializes each as a TestServer with the pod's env."""
+    """Watches Pods; materializes each as a TestServer with the pod's env.
 
-    def __init__(self, cluster: FakeCluster, startup_delay: float = 0.0) -> None:
+    ``pull_delay`` / ``init_delay`` model the image-pull and runtime-init
+    cold start a real kubelet pays before the container entrypoint runs:
+    each is 0 (disabled), constant seconds, or a (lo, hi) uniform range
+    drawn from a dedicated seeded RNG (``latency_seed``) so e2e scenarios
+    exercising the warm pool see a reproducible cold-start distribution.
+    Warm-pool standby pods pay it at pool-fill time like any other ADDED
+    pod; a claim is a MODIFIED and restarts nothing — the pre-warmed
+    server keeps running, which is the entire point of the pool."""
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        startup_delay: float = 0.0,
+        pull_delay=0.0,
+        init_delay=0.0,
+        latency_seed: int = 0,
+    ) -> None:
         self.cluster = cluster
         self.startup_delay = startup_delay
+        self.pull_delay = pull_delay
+        self.init_delay = init_delay
+        self._latency_rng = Random(f"{latency_seed}:e2e-kubelet-latency")
         self._lock = threading.Lock()
         self._running: Dict[str, _RunningPod] = {}
         cluster.subscribe("Pod", self._on_pod_event)
+
+    def _sample(self, spec) -> float:
+        if not spec:
+            return 0.0
+        if isinstance(spec, (int, float)):
+            return float(spec)
+        lo, hi = spec
+        with self._lock:
+            return self._latency_rng.uniform(lo, hi)
+
+    def _startup_latency(self) -> float:
+        return (
+            self.startup_delay
+            + self._sample(self.pull_delay)
+            + self._sample(self.init_delay)
+        )
 
     # ------------------------------------------------------------- events
     def _on_pod_event(self, event_type: str, pod) -> None:
@@ -53,8 +89,9 @@ class FakeKubelet:
 
     # ------------------------------------------------------------- lifecycle
     def _start_pod(self, key: str) -> None:
-        if self.startup_delay:
-            time.sleep(self.startup_delay)
+        delay = self._startup_latency()
+        if delay:
+            time.sleep(delay)
         namespace, _, name = key.partition("/")
         try:
             pod = self.cluster.get_pod(namespace, name)

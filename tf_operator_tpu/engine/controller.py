@@ -31,7 +31,7 @@ from typing import Any, Dict, List, Optional
 
 from tf_operator_tpu.api import common
 from tf_operator_tpu.api.job import Job, ValidationError
-from tf_operator_tpu.engine import metrics, tracing
+from tf_operator_tpu.engine import metrics, tracing, warmpool
 from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
 from tf_operator_tpu.engine.control import PodControl, ServiceControl
 from tf_operator_tpu.engine.fanout import FanoutResult, slow_start_batch
@@ -188,6 +188,17 @@ class JobEngine:
         # both the Python and native ledgers, which have per-key delete
         # but no prefix scan)
         self._exp_keys: Dict[str, set] = {}
+        # warm-pool pod placement (engine/warmpool.py): wired by the
+        # manager when --warm-pool-size enables the pool; None keeps the
+        # historical cold-create-only path byte-identical
+        self.warm_pool: Optional[Any] = None
+        # claim token -> (expectation key, job key): a warm claim raises
+        # the same ledger entry a create would, and is settled by the
+        # informer-delivered MODIFIED event carrying the token — exactly
+        # one observation per claim, no matter how many later updates
+        # touch the pod
+        self._pending_claims: Dict[str, tuple] = {}
+        self._claim_seq = 0
         # stale-read fence: highest resourceVersion seen or written per job
         # key.  A lagging read (apiserver watch cache, chaos-injected stale
         # window) must not drive a reconcile — acting on it deletes pods
@@ -274,11 +285,40 @@ class JobEngine:
         return gen_expectation_services_key(job_key, rtype)
 
     def _on_pod_event(self, event_type: str, pod: Dict[str, Any]) -> None:
+        if event_type == "MODIFIED":
+            # a warm-pool claim surfaces as MODIFIED, not ADDED: the pod
+            # already existed (unlabeled, unowned) and the claim wrote the
+            # job's identity onto it.  The claim token registered before
+            # the write is popped exactly once — later updates of the same
+            # pod (kubelet status writes) carry the annotation but no
+            # pending entry, so they never touch the ledger.
+            if self._pending_claims:
+                token = (
+                    (pod.get("metadata") or {}).get("annotations") or {}
+                ).get(warmpool.WARM_CLAIM_ANNOTATION)
+                if token:
+                    entry = self._pending_claims.pop(token, None)
+                    if entry is not None:
+                        self.expectations.creation_observed(entry[0])
+            return
         key = self._expectation_key_for(pod, "Pod")
         if key is None:
             return
         if event_type == "ADDED":
             self.expectations.creation_observed(key)
+            # a relist repair after a watch outage can deliver a CLAIMED
+            # pod as ADDED (the outage swallowed the claim's MODIFIED).
+            # The line above just settled its expectation via the job
+            # labels — retire the pending token too, or the pod's next
+            # MODIFIED (any kubelet status write; the claim annotation is
+            # persisted) would settle the same expectation a second time
+            # and drive the ledger's add-count negative.
+            if self._pending_claims:
+                token = (
+                    (pod.get("metadata") or {}).get("annotations") or {}
+                ).get(warmpool.WARM_CLAIM_ANNOTATION)
+                if token:
+                    self._pending_claims.pop(token, None)
         elif event_type == "DELETED":
             self.expectations.deletion_observed(key)
 
@@ -490,9 +530,17 @@ class JobEngine:
         outlive the job (it would grow with lifetime job count)."""
         self._rv_seen.pop(job_key, None)
         self._exp_keys.pop(job_key, None)
+        self._drop_pending_claims(job_key)
 
     def _track_exp_key(self, job_key: str, key: str) -> None:
         self._exp_keys.setdefault(job_key, set()).add(key)
+
+    def _drop_pending_claims(self, job_key: str) -> None:
+        for token in [
+            t for t, (_k, jk) in list(self._pending_claims.items())
+            if jk == job_key
+        ]:
+            self._pending_claims.pop(token, None)
 
     def disown_job(self, job_key: str) -> None:
         """The job moved to another shard (slot failover / resize): drop
@@ -503,6 +551,7 @@ class JobEngine:
         for key in self._exp_keys.pop(job_key, ()):
             self.expectations.delete_expectations(key)
         self._rv_seen.pop(job_key, None)
+        self._drop_pending_claims(job_key)
 
     def _reconcile(self, job: Job) -> ReconcileResult:
         if self._fence_stale_read(job):
@@ -1015,6 +1064,16 @@ class JobEngine:
         controller_ref = objects.owner_reference(
             {"apiVersion": job.api_version, "kind": job.kind, "metadata": job.metadata}
         )
+        # warm-pool fast path: claim a pre-provisioned standby pod of the
+        # template's slice shape before paying a cold create.  The claim
+        # reuses the expectation raised above (settled by the claim's own
+        # MODIFIED event); a miss falls straight through to the cold
+        # create with the ledger untouched in between.
+        if self.warm_pool is not None and self._claim_warm_pod(
+            job, rtype, index, template, dict(meta.get("labels", {})), key,
+            controller_ref,
+        ):
+            return
         try:
             self.pod_control.create_pod_with_controller_ref(
                 job.namespace, template, job.to_dict(), controller_ref
@@ -1024,6 +1083,77 @@ class JobEngine:
             # expectation (reference tfjob_controller.go:824-832)
             self.expectations.creation_observed(key)
             raise
+
+    def _claim_warm_pod(
+        self,
+        job: Job,
+        rtype: str,
+        index: int,
+        template: Dict[str, Any],
+        labels: Dict[str, str],
+        exp_key: str,
+        controller_ref: Dict[str, Any],
+    ) -> bool:
+        """Try to serve this replica from the warm pool.  Returns True when
+        a standby pod was claimed (the replica exists; no create needed).
+
+        Ledger contract: the caller already raised the creation
+        expectation.  The claim token is registered BEFORE the CAS write,
+        so the claim's MODIFIED event — delivered synchronously by the
+        fake store, or later by a real watch — observes it exactly once;
+        a miss pops the token and leaves the raised expectation for the
+        cold create's ADDED to settle; an error lowers it and propagates
+        (a fenced claim surfaces as the store's 403, which
+        _sync_guarded's fenced-mid-sync handling already owns)."""
+        import json as _json
+
+        self._claim_seq += 1
+        token = f"{job.uid}/{rtype}/{index}/{self._claim_seq}"
+        spec = template.get("spec", {}) or {}
+        container = (spec.get("containers") or [{}])[0]
+        annotations = {
+            warmpool.WARM_CLAIM_ANNOTATION: token,
+            # the identity + env the pod would have carried cold-created:
+            # the late-binding contract the pre-warmed runtime reads
+            warmpool.WARM_BOUND_NAME_ANNOTATION: template["metadata"]["name"],
+        }
+        env = container.get("env") or []
+        if env:
+            annotations[warmpool.WARM_BOUND_ENV_ANNOTATION] = _json.dumps(
+                env, separators=(",", ":"), sort_keys=True
+            )
+        fence_token = self.fence(job.uid) if self.fence is not None else None
+        self._pending_claims[token] = (exp_key, job.key)
+        try:
+            claimed = self.warm_pool.try_claim(
+                namespace=job.namespace,
+                shape=warmpool.slice_shape_of(template),
+                image=container.get("image", ""),
+                labels=labels,
+                annotations=annotations,
+                controller_ref=controller_ref,
+                fence_token=fence_token,
+                # the EFFECTIVE policy (_new_pod already rewrote ExitCode
+                # to Never): pod spec is immutable, so only a policy-equal
+                # standby may serve this replica
+                restart_policy=spec.get("restartPolicy"),
+            )
+        except Exception:
+            # the claim write failed terminally (e.g. fenced): no event
+            # will ever carry the token — settle the ledger here, exactly
+            # like a failed create
+            self._pending_claims.pop(token, None)
+            self.expectations.creation_observed(exp_key)
+            raise
+        if claimed is None:
+            self._pending_claims.pop(token, None)
+            return False
+        self.cluster.record_event(
+            job.to_dict(), "Normal", "WarmPodClaimed",
+            f"claimed warm pod {objects.namespace_of(claimed)}."
+            f"{objects.name_of(claimed)} for {rtype} replica {index}",
+        )
+        return True
 
     # ------------------------------------------------------------- services
     @staticmethod

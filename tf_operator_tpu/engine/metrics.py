@@ -377,6 +377,45 @@ APF_QUEUE_WAIT = Histogram(
     "not drag other flows' p99",
 )
 
+# ------------------------------------------------------------- warm pools
+# Warm-pool pod placement (engine/warmpool.py): pre-provisioned standby
+# pods per slice shape that job pod creation claims instead of paying the
+# image-pull + init cold start.
+WARM_POOL_SIZE = Gauge(
+    f"{PREFIX}_warm_pool_size",
+    "Unclaimed standby pods per slice shape, labeled by shape and state: "
+    "ready (Running, claimable) vs filling (created, still paying pull/"
+    "init latency); ready should sit at the configured K in steady state",
+)
+WARM_POOL_CLAIMS = Counter(
+    f"{PREFIX}_warm_pool_claims_total",
+    "Job replica creations served by claiming a ready warm pod (the CAS "
+    "relabel) instead of a cold create, labeled by shape — "
+    "claims / (claims + cold creates) is the warm-hit ratio",
+)
+WARM_POOL_CLAIM_MISSES = Counter(
+    f"{PREFIX}_warm_pool_claim_misses_total",
+    "Claim attempts that fell back toward a cold create, labeled by shape "
+    "and reason: empty (no ready standby), contested (lost the CAS to a "
+    "rival claimer), image_mismatch (strict image matching enabled and no "
+    "pre-pulled match), namespace (pool serves a different namespace)",
+)
+WARM_POOL_REPLENISH = Counter(
+    f"{PREFIX}_warm_pool_replenish_total",
+    "Standby pods created by the asynchronous pool refill (slow-start "
+    "fan-out, retry ladder under apiserver errors), labeled by shape; "
+    "rate tracks the claim rate in steady state",
+)
+CREATE_TO_RUNNING = Histogram(
+    f"{PREFIX}_create_to_running_seconds",
+    "Replica-needed to replica-Running latency, labeled by path: cold "
+    "(fresh create paying image pull + runtime init), warm (claimed from "
+    "the warm pool — the latency the pool exists to delete), pool_fill "
+    "(a standby pod paying the cold start off the job critical path)",
+    buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0,
+             180.0, 300.0, 600.0),
+)
+
 
 class ReplicaGaugeTracker:
     """Aggregates per-job active-replica counts into a {kind,replica_type}

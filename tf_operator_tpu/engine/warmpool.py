@@ -1,0 +1,530 @@
+"""Warm-pool pod placement — pre-provisioned standby slices.
+
+The simulated ``create_to_running`` path is milliseconds, but a real TPU
+pod cold-starts in minutes: image pull, runtime init, mesh bootstrap.
+Speculative Container Scheduling (PAPERS.md, arXiv 2010.11307) removes
+that latency from the critical path by placing containers *before* the
+scheduler commits; this module is that idea as an operator subsystem:
+
+  - The pool keeps **K pre-pulled, pre-initialized standby pods per slice
+    shape** (v5e-1 / v5e-8 / v5e-256).  Standby pods are created ahead of
+    demand, pay the image-pull + init latency while nobody is waiting,
+    and sit Running (pre-warmed generic runtime) until claimed.
+  - Job pod creation **claims** a warm pod instead of cold-creating when
+    one is ready: a single compare-and-swap ``update`` that writes the
+    job's controllerRef + labels in one shot, conditioned on the pod's
+    resourceVersion.  Under sharding (or two operator processes) exactly
+    one contender wins a contested pod — the loser's CAS conflicts, it
+    falls back to the next pool pod or a cold create, and its
+    expectations ledger is never touched.  A sharded engine additionally
+    stamps its slot's **fencing token** into the claim body, so a zombie
+    shard that lost its lease cannot claim pods for jobs it no longer
+    owns (the store rejects the write with 403 before it lands).
+  - Pool pods are **unowned until claimed** (no ownerReferences): they
+    belong to no job and no shard, so a shard crash neither strands nor
+    double-claims them — claimed pods become ordinary dependents that
+    failover re-adopts like any other.
+  - **Replenishment is asynchronous** and rides the existing slow-start
+    fan-out (engine/fanout.py): refills never queue behind reconciles on
+    a workqueue, a failing apiserver is probed with one create instead of
+    a herd (the ramp aborts on first failure), and a per-shape capped-
+    exponential retry ladder gates the next attempt so an error storm
+    never produces runaway creates past K.
+
+Workload identity is **late-bound**: a claimed pod keeps its (immutable)
+spec — the standby image is the generic pre-warmed runtime — and the
+job-specific cluster-spec env rides in annotations for the in-container
+bootstrap to pick up (the model of the speculative-scheduling paper;
+``runtime/bootstrap.py`` reads the same env contract).  Pods are indexed
+by labels, not names, throughout the engine, so a claimed pod named
+``warm-v5e-8-3`` serves replica index 2 exactly like a cold pod named
+``{job}-worker-2`` would.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.fanout import slow_start_batch
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.fake import ApiError, ConflictError, NotFoundError
+from tf_operator_tpu.k8s.informer import capped_exponential
+
+# Pool membership + provenance: present (value = slice shape) on every pod
+# born in the pool; kept after a claim so warm-claimed replicas remain
+# distinguishable (the cold-vs-warm histogram label and the soak audits
+# key on it).  An UNCLAIMED pool pod = this label AND no controllerRef.
+WARM_POOL_LABEL = "warm-pool-shape"
+# A job/pod template opts into a slice shape with this annotation (or
+# label); absent means DEFAULT_SHAPE — the single-host slice every plain
+# job maps to.
+SHAPE_ANNOTATION = "kubeflow.org/slice-shape"
+# Claim CAS bookkeeping, written by the claiming engine in the claim body:
+#   warm-claim: unique token the engine registered BEFORE issuing the
+#     write — the MODIFIED event carrying it is the claim's "creation
+#     observed" signal for the expectations ledger (a claim raises the
+#     same ledger entry a create would, and the informer-delivered claim
+#     event settles it the way an ADDED settles a create).
+#   warm-bound-name / warm-bound-env: the replica identity + cluster-spec
+#     env the pod would have carried had it been cold-created — the
+#     late-binding contract the pre-warmed runtime reads.
+WARM_CLAIM_ANNOTATION = "kubeflow.org/warm-claim"
+WARM_BOUND_NAME_ANNOTATION = "kubeflow.org/warm-bound-name"
+WARM_BOUND_ENV_ANNOTATION = "kubeflow.org/warm-bound-env"
+
+DEFAULT_SHAPE = "v5e-1"
+KNOWN_SHAPES = ("v5e-1", "v5e-8", "v5e-256")
+
+
+def slice_shape_of(template: Dict[str, Any]) -> str:
+    """The slice shape a pod template requests: the SHAPE_ANNOTATION from
+    its metadata (annotation first, label as a fallback), else
+    DEFAULT_SHAPE.  Pure so the engine and the pool always agree."""
+    meta = template.get("metadata", {}) or {}
+    for source in (meta.get("annotations"), meta.get("labels")):
+        shape = (source or {}).get(SHAPE_ANNOTATION)
+        if shape:
+            return shape
+    return DEFAULT_SHAPE
+
+
+def is_warm_pool_pod(obj: Dict[str, Any]) -> bool:
+    return WARM_POOL_LABEL in objects.labels_of(obj)
+
+
+def is_unclaimed_pool_pod(obj: Dict[str, Any]) -> bool:
+    return is_warm_pool_pod(obj) and objects.get_controller_of(obj) is None
+
+
+@dataclass
+class WarmPoolConfig:
+    # shape -> K standby pods to keep pre-provisioned
+    sizes: Dict[str, int] = field(default_factory=dict)
+    namespace: str = "default"
+    # image the standby pods are pre-pulled with (the generic pre-warmed
+    # runtime).  With match_any_image (the late-binding model) any job
+    # image claims any warm pod of the right shape; without it, a claim
+    # requires the job's image to equal the standby image — an image the
+    # node never pulled has no pre-pull win to offer.
+    image: str = "warm-runtime"
+    match_any_image: bool = True
+    # restartPolicy the standby pods are born with.  Pod spec is immutable
+    # at claim time, so a claim requires the job template's EFFECTIVE
+    # policy to equal this (controller.py forces ExitCode -> Never before
+    # claiming; the operator's default replica policy is Never too) — a
+    # mismatched standby would let the kubelet restart a failed container
+    # in place, hiding exits the operator's restart accounting must see.
+    restart_policy: str = "Never"
+    # replenish retry ladder (per shape): first retry after retry_base,
+    # doubling to retry_max — an apiserver error storm is probed, not
+    # hammered
+    retry_base: float = 1.0
+    retry_max: float = 60.0
+
+
+class WarmPoolManager:
+    """Keeps the per-shape standby pools full and serves CAS claims.
+
+    One instance per operator process, shared by every shard's engines
+    (claims are rv-CAS-safe across processes; the in-process lock merely
+    avoids self-contention).  ``replenish()`` is safe to call from a
+    deterministic driver (the chaos harness steps it explicitly); threaded
+    deployments call ``start()`` for the background refill loop, which
+    also wakes promptly on every claim."""
+
+    def __init__(
+        self,
+        cluster,
+        config: WarmPoolConfig,
+        clock=time.time,
+        fanout: int = 1,
+        refill_interval: float = 0.5,
+        ready_probe=None,
+    ) -> None:
+        self.cluster = cluster
+        self.config = config
+        self.clock = clock
+        self.fanout = max(1, fanout)
+        self.refill_interval = refill_interval
+        # optional extra readiness gate (runtime/bootstrap.py pre-warm
+        # probe): a Running standby pod is claimable only once the probe
+        # accepts it — e.g. the JAX runtime reports its persistent
+        # compilation cache is primed.  None = phase Running is enough.
+        self.ready_probe = ready_probe
+        self._lock = threading.RLock()
+        # shape -> {pod name -> last-known pod object} (unclaimed only;
+        # Pending entries are "filling", Running entries are claimable)
+        self._pool: Dict[str, Dict[str, Dict[str, Any]]] = {
+            shape: {} for shape in config.sizes
+        }
+        self._seq: Dict[str, int] = {shape: 0 for shape in config.sizes}
+        # replenish retry ladder state, per shape
+        self._fail_count: Dict[str, int] = {}
+        self._retry_at: Dict[str, float] = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # phase transitions / deletions / cross-process claims arrive as
+        # pod events; our own creates are inserted directly (a watch
+        # outage must not blind the deficit accounting into runaway
+        # creates past K)
+        cluster.subscribe("Pod", self._on_pod_event)
+
+    # ------------------------------------------------------------- tracking
+    def _on_pod_event(self, event_type: str, pod: Dict[str, Any]) -> None:
+        labels = objects.labels_of(pod)
+        shape = labels.get(WARM_POOL_LABEL)
+        if shape is None:
+            return
+        name = objects.name_of(pod)
+        with self._lock:
+            pool = self._pool.get(shape)
+            if pool is None:
+                return
+            if event_type == "DELETED" or objects.get_controller_of(pod):
+                # gone, or claimed (possibly by another process): not ours
+                # to hand out anymore
+                pool.pop(name, None)
+            else:
+                # upsert even for names we have not inserted yet: on the
+                # REST backend the watch can deliver the standby's Running
+                # MODIFIED before our create call returns — dropping it
+                # would store the stale Pending create-response and leave
+                # the pod "filling" forever.  Unknown unclaimed pool pods
+                # (another process's pool, resync gaps) are adopted here
+                # exactly as resync() would adopt them.
+                pool[name] = pod
+            self._update_gauges_locked(shape)
+
+    def _update_gauges_locked(self, shape: str) -> None:
+        pool = self._pool.get(shape, {})
+        ready = sum(1 for p in pool.values() if self._is_ready(p))
+        metrics.WARM_POOL_SIZE.set(ready, {"shape": shape, "state": "ready"})
+        metrics.WARM_POOL_SIZE.set(
+            len(pool) - ready, {"shape": shape, "state": "filling"}
+        )
+
+    def _is_ready(self, pod: Dict[str, Any]) -> bool:
+        # belt and braces: a tracked copy that already shows a
+        # controllerRef is claimed no matter how it got here — CAS'ing
+        # over it with a current rv would STEAL the rival's pod
+        if objects.get_controller_of(pod) is not None:
+            return False
+        if objects.pod_phase(pod) != objects.POD_RUNNING:
+            return False
+        return self.ready_probe is None or bool(self.ready_probe(pod))
+
+    def ready_count(self, shape: str) -> int:
+        with self._lock:
+            return sum(
+                1 for p in self._pool.get(shape, {}).values()
+                if self._is_ready(p)
+            )
+
+    def size(self, shape: str) -> int:
+        """Unclaimed pool pods of the shape, ready + filling."""
+        with self._lock:
+            return len(self._pool.get(shape, {}))
+
+    # ------------------------------------------------------------- lifecycle
+    def resync(self) -> None:
+        """Adopt pre-existing unclaimed pool pods (operator restart: the
+        pool, like any dependent state, is rebuilt from the cluster)."""
+        for shape in self.config.sizes:
+            try:
+                pods = self.cluster.list_pods(
+                    namespace=self.config.namespace,
+                    selector={WARM_POOL_LABEL: shape},
+                )
+            except (ApiError, OSError):
+                continue  # the refill loop retries; startup must not die
+            with self._lock:
+                pool = self._pool.setdefault(shape, {})
+                for pod in pods:
+                    if objects.get_controller_of(pod) is None:
+                        name = objects.name_of(pod)
+                        pool.setdefault(name, pod)
+                        # never reuse a discovered pod's sequence number
+                        tail = name.rsplit("-", 1)[-1]
+                        if tail.isdigit():
+                            self._seq[shape] = max(
+                                self._seq.get(shape, 0), int(tail) + 1
+                            )
+                self._update_gauges_locked(shape)
+
+    def start(self) -> None:
+        self.resync()
+        self.replenish()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._refill_loop, name="warm-pool-refill", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        try:
+            self.cluster.unsubscribe("Pod", self._on_pod_event)
+        except Exception:  # noqa: BLE001 — best-effort detach on shutdown
+            pass
+
+    def _refill_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.refill_interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.replenish()
+            except Exception:  # noqa: BLE001 — refill upkeep must not die
+                pass
+
+    # ------------------------------------------------------------- replenish
+    def _standby_pod(self, shape: str, name: str) -> Dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": self.config.namespace,
+                "labels": {WARM_POOL_LABEL: shape},
+                "annotations": {SHAPE_ANNOTATION: shape},
+            },
+            # deliberately NO ownerReferences: unowned until claimed
+            "spec": {
+                "restartPolicy": self.config.restart_policy,
+                "containers": [
+                    {"name": "warm-runtime", "image": self.config.image}
+                ],
+            },
+            "status": {"phase": objects.POD_PENDING},
+        }
+
+    def _reap_terminal(self) -> None:
+        """Delete unclaimed standbys stuck in a terminal phase (pre-warm
+        runtime exited, chaos OOM): the deficit math counts them, so left
+        alone they would depress the ready pool below K forever."""
+        with self._lock:
+            reap = [
+                (shape, name, objects.namespace_of(p))
+                for shape, pool in self._pool.items()
+                for name, p in sorted(pool.items())
+                if objects.pod_phase(p)
+                in (objects.POD_SUCCEEDED, objects.POD_FAILED)
+            ]
+        for shape, name, ns in reap:
+            try:
+                self.cluster.delete_pod(ns, name)
+            except NotFoundError:
+                pass
+            except (ApiError, OSError):
+                continue  # still tracked; retried next cycle
+            with self._lock:
+                self._pool.get(shape, {}).pop(name, None)
+                self._update_gauges_locked(shape)
+
+    def replenish(self) -> int:
+        """Top every shape's pool back up to K.  Deficit counts ready AND
+        filling pods, so creates never overshoot; shapes inside their
+        retry-ladder window are skipped.  Returns pods created."""
+        self._reap_terminal()
+        now = self.clock()
+        plan: List[tuple] = []
+        with self._lock:
+            for shape, k in self.config.sizes.items():
+                if now < self._retry_at.get(shape, 0.0):
+                    continue
+                deficit = k - len(self._pool.get(shape, {}))
+                for _ in range(max(0, deficit)):
+                    name = f"warm-{shape}-{self._seq[shape]}"
+                    self._seq[shape] += 1
+                    plan.append((shape, name))
+        if not plan:
+            return 0
+
+        failed_shapes: Dict[str, BaseException] = {}
+
+        def create_one(shape: str, name: str) -> None:
+            created = self.cluster.create_pod(self._standby_pod(shape, name))
+            with self._lock:
+                # insert directly: the pod event may be gated (chaos watch
+                # outage) and the deficit math must still see it.
+                # setdefault, not assignment: the watch may already have
+                # delivered a FRESHER copy (Running) than this create
+                # response, and overwriting it would regress the pod to
+                # Pending in our book.
+                self._pool.setdefault(shape, {}).setdefault(name, created)
+                self._update_gauges_locked(shape)
+            metrics.WARM_POOL_REPLENISH.inc({"shape": shape})
+
+        res = slow_start_batch(
+            [lambda s=s, n=n: create_one(s, n) for s, n in plan],
+            self.fanout,
+            abort_on_failure=True,  # probe a failing apiserver, don't herd
+        )
+        for idx, err in res.failures:
+            failed_shapes.setdefault(plan[idx][0], err)
+        with self._lock:
+            touched = {s for s, _ in plan}
+            for shape in touched:
+                if shape in failed_shapes:
+                    n = self._fail_count.get(shape, 0)
+                    self._fail_count[shape] = n + 1
+                    self._retry_at[shape] = self.clock() + capped_exponential(
+                        self.config.retry_base, n, self.config.retry_max
+                    )
+                else:
+                    self._fail_count.pop(shape, None)
+                    self._retry_at.pop(shape, None)
+        return res.successes
+
+    # ------------------------------------------------------------- claims
+    def try_claim(
+        self,
+        namespace: str,
+        shape: str,
+        image: str,
+        labels: Dict[str, str],
+        annotations: Dict[str, str],
+        controller_ref: Dict[str, Any],
+        fence_token: Optional[str] = None,
+        restart_policy: Optional[str] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Claim one ready warm pod of `shape` for a job replica, or None
+        (caller falls back to a cold create).  The claim is ONE update:
+        controllerRef + the replica's full label set + the late-binding
+        annotations, CAS'd on the pod's resourceVersion — under contention
+        exactly one claimer wins; a loser's conflict re-reads once (the
+        bump may have been a kubelet status write, not a rival claim) and
+        then moves to the next candidate.  A sharded caller passes its
+        fencing token; the store rejects a stale one with 403, which
+        propagates so the engine's fenced-mid-sync handling runs.
+
+        `restart_policy` is the template's EFFECTIVE pod restartPolicy
+        (after the ExitCode -> Never rewrite): the pod spec is immutable,
+        so a standby born with a different policy is never claimable — a
+        kubelet honoring the wrong policy would restart failed containers
+        in place and hide exits from the operator's restart accounting.
+
+        Misses are counted once per reason per call, and only when the
+        whole claim falls back cold (docs/monitoring.md: a miss == a
+        fallback, so warm_hit_ratio can be read off claims vs misses)."""
+        t0 = self.clock()
+        with self._lock:
+            pool = self._pool.get(shape, {})
+            # sorted: the claim order is a function of pool state, not
+            # dict insertion interleaving — seeded chaos runs replay it
+            candidates = sorted(
+                name for name, pod in pool.items() if self._is_ready(pod)
+            )
+        miss_reasons = set()
+        for name in candidates:
+            with self._lock:
+                pod = self._pool.get(shape, {}).get(name)
+            if pod is None:
+                # claimed/deleted since the snapshot: lost to a rival
+                miss_reasons.add("contested")
+                continue
+            if objects.namespace_of(pod) != namespace:
+                miss_reasons.add("namespace")
+                continue
+            spec = pod.get("spec", {}) or {}
+            pod_image = (spec.get("containers") or [{}])[0].get("image", "")
+            if not self.config.match_any_image and pod_image != image:
+                miss_reasons.add("image_mismatch")
+                continue
+            if (
+                restart_policy is not None
+                and spec.get("restartPolicy") != restart_policy
+            ):
+                miss_reasons.add("restart_policy")
+                continue
+            claimed = self._cas_claim(
+                shape, name, pod, labels, annotations, controller_ref,
+                fence_token,
+            )
+            if claimed is not None:
+                metrics.WARM_POOL_CLAIMS.inc({"shape": shape})
+                metrics.CREATE_TO_RUNNING.observe(
+                    max(0.0, self.clock() - t0), {"path": "warm"}
+                )
+                self._wake.set()  # refill the hole promptly
+                return claimed
+            miss_reasons.add("contested")
+        if not candidates:
+            miss_reasons.add("empty")
+        for reason in sorted(miss_reasons):
+            metrics.WARM_POOL_CLAIM_MISSES.inc(
+                {"shape": shape, "reason": reason}
+            )
+        return None
+
+    def _cas_claim(
+        self,
+        shape: str,
+        name: str,
+        pod: Dict[str, Any],
+        labels: Dict[str, str],
+        annotations: Dict[str, str],
+        controller_ref: Dict[str, Any],
+        fence_token: Optional[str],
+        retried: bool = False,
+    ) -> Optional[Dict[str, Any]]:
+        from tf_operator_tpu.engine.sharding import FENCE_ANNOTATION
+
+        if objects.get_controller_of(pod) is not None:
+            # already someone's dependent — never overwrite a rival claim
+            with self._lock:
+                self._pool.get(shape, {}).pop(name, None)
+                self._update_gauges_locked(shape)
+            return None
+        body = objects.fast_deepcopy(pod)
+        meta = body.setdefault("metadata", {})
+        meta["ownerReferences"] = [objects.fast_deepcopy(controller_ref)]
+        meta.setdefault("labels", {}).update(labels)
+        ann = meta.setdefault("annotations", {})
+        ann.update(annotations)
+        if fence_token:
+            ann[FENCE_ANNOTATION] = fence_token
+        try:
+            out = self.cluster.update_pod(body)
+        except ConflictError:
+            # rv moved under us: a rival claim, or just a kubelet status
+            # write.  One fresh read decides — still unclaimed retries the
+            # CAS once on the new rv; claimed/other means we lost the pod.
+            try:
+                fresh = self.cluster.get_pod(objects.namespace_of(pod), name)
+            except (NotFoundError, ApiError):
+                fresh = None
+            if (
+                fresh is not None
+                and not retried
+                and objects.get_controller_of(fresh) is None
+            ):
+                with self._lock:
+                    if name in self._pool.get(shape, {}):
+                        self._pool[shape][name] = fresh
+                return self._cas_claim(
+                    shape, name, fresh, labels, annotations, controller_ref,
+                    fence_token, retried=True,
+                )
+            with self._lock:
+                self._pool.get(shape, {}).pop(name, None)
+                self._update_gauges_locked(shape)
+            return None
+        except NotFoundError:
+            with self._lock:
+                self._pool.get(shape, {}).pop(name, None)
+                self._update_gauges_locked(shape)
+            return None
+        with self._lock:
+            self._pool.get(shape, {}).pop(name, None)
+            self._update_gauges_locked(shape)
+        return out

@@ -125,12 +125,28 @@ class FaultInjector:
         kubelet: bool = True,
         pod_start_delay: float = 1.0,
         nodes: int = 4,
+        pull_latency=None,
+        init_latency=None,
     ) -> None:
         self.inner = inner
         self.clock = clock or SimClock()
+        self.seed = seed
         self.rng = Random(seed)
         self.kubelet = kubelet
         self.pod_start_delay = pod_start_delay
+        # Image-pull / runtime-init latency the chaos kubelet charges every
+        # created pod before marking it Running — the dominant real-world
+        # cold-start term the simulated 8ms path hides.  Each spec is None
+        # (disabled, byte-identical to the historical kubelet), a float
+        # (constant seconds), or a (lo, hi) tuple sampled uniformly from a
+        # SEEDED PER-SHARD stream: samples are drawn at SCHEDULE time on
+        # the creating thread (whose set_shard tag names the stream), so
+        # with N shard threads each stream's draw order is a pure function
+        # of that shard's own create order — the byte-identical-log-per-
+        # seed contract survives latency injection.
+        self.pull_latency = pull_latency
+        self.init_latency = init_latency
+        self._latency_rngs: Dict[str, Random] = {}
         self.nodes = nodes
         # Event log, kept as PER-SHARD STREAMS merged on read.  With one
         # control-plane process (the historical shape) everything lands in
@@ -344,17 +360,52 @@ class FaultInjector:
         return None
 
     # --------------------------------------------------------- pod chaos
+    def _latency_rng(self, stream: str) -> Random:
+        """Seeded per-shard latency stream.  Random(str) seeds via a
+        stable digest of the string (not the per-process-salted hash()),
+        so the same (seed, shard) pair draws the same sequence in every
+        process and run."""
+        rng = self._latency_rngs.get(stream)
+        if rng is None:
+            rng = Random(f"{self.seed}:kubelet-latency:{stream}")
+            self._latency_rngs[stream] = rng
+        return rng
+
+    @staticmethod
+    def _sample_latency(spec, rng: Random) -> float:
+        if not spec:
+            return 0.0
+        if isinstance(spec, (int, float)):
+            return float(spec)
+        lo, hi = spec
+        return rng.uniform(lo, hi)
+
     def _kubelet_on_pod(self, event_type: str, pod: Dict[str, Any]) -> None:
         if event_type != "ADDED":
             return
         ns, name = objects.namespace_of(pod), objects.name_of(pod)
+        delay = self.pod_start_delay
+        label = f"kubelet_start pod={ns}/{name}"
+        if self.pull_latency or self.init_latency:
+            # schedule-time capture from the creating thread's stream:
+            # the draw order within a stream is the shard's own create
+            # order, immune to how the OS interleaves other shards
+            with self._lock:
+                rng = self._latency_rng(self._current_stream())
+                pull = self._sample_latency(self.pull_latency, rng)
+                init = self._sample_latency(self.init_latency, rng)
+            delay += pull + init
+            label += f" pull={pull:g} init={init:g}"
+        created_at = self.clock()
         self.after(
-            self.pod_start_delay,
-            lambda: self._mark_running(ns, name),
-            f"kubelet_start pod={ns}/{name}",
+            delay,
+            lambda: self._mark_running(ns, name, created_at=created_at),
+            label,
         )
 
-    def _mark_running(self, namespace: str, name: str) -> None:
+    def _mark_running(
+        self, namespace: str, name: str, created_at: Optional[float] = None
+    ) -> None:
         try:
             pod = self.inner.get_pod(namespace, name)
         except (NotFoundError, ApiError):
@@ -373,7 +424,20 @@ class FaultInjector:
         try:
             self.inner.update_pod(pod)
         except (ConflictError, NotFoundError, ApiError):
-            pass  # lost a race with a concurrent writer; next event retries
+            return  # lost a race with a concurrent writer; next event retries
+        if created_at is not None:
+            # cold-vs-warm cold-start evidence: a pool standby pays the
+            # pull/init latency as pool_fill (off any job's critical
+            # path); every other pod is a job replica's cold start.  Lazy
+            # import: engine/__init__ pulls the controller, which imports
+            # k8s modules — same cycle fake.py dodges.
+            from tf_operator_tpu.engine import metrics as _metrics
+            from tf_operator_tpu.engine import warmpool as _warmpool
+
+            path = "pool_fill" if _warmpool.is_warm_pool_pod(pod) else "cold"
+            _metrics.CREATE_TO_RUNNING.observe(
+                max(0.0, self.clock() - created_at), {"path": path}
+            )
 
     def kill_pod(
         self, namespace: str, name: str, exit_code: int = 137,

@@ -206,6 +206,18 @@ class FakeCluster:
                 f"{current}); the writer lost slot ownership"
             )
 
+    @staticmethod
+    def _strip_fence(obj: Dict[str, Any]) -> None:
+        """Drop the fencing-token annotation from an object about to be
+        stored (see update(); lazy import — engine <-> k8s would cycle at
+        module scope)."""
+        annotations = (obj.get("metadata") or {}).get("annotations")
+        if not annotations:
+            return
+        from tf_operator_tpu.engine.sharding import FENCE_ANNOTATION
+
+        annotations.pop(FENCE_ANNOTATION, None)
+
     # ------------------------------------------------------------- generic
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         self._observe("create", kind)
@@ -282,6 +294,13 @@ class FakeCluster:
                     f"{kind} {key}: resourceVersion {sent_rv} != {stored_rv}"
                 )
             obj = objects.fast_deepcopy(obj)
+            # the fencing token is a per-REQUEST assertion, never persisted
+            # state: a full-object write that stored it (warm-pool claims
+            # ride update, not update_status) would make every later
+            # read-modify-write of the object — a kubelet status write, a
+            # controllerRef adoption — replay the claimer's old token and
+            # get fenced after any failover bumped the generation
+            self._strip_fence(obj)
             self._bump(obj)
             store[key] = obj
         self._notify(kind, "MODIFIED", obj)
