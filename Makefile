@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool bench-sched native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -74,6 +74,14 @@ bench-shard:
 bench-warmpool:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_cold_start; \
 	print(json.dumps(bench_cold_start(), indent=1))"
+
+# Cluster-scheduler policy sweep: makespan + Jain fairness per
+# bin-packing policy (spread / packed / throughput_ratio) on a mixed
+# contended trace over a heterogeneous slice inventory, with preemption
+# counts (ISSUE 8 evidence, no TPU required).  Rows land in BENCH_r07.json.
+bench-sched:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_sched; \
+	print(json.dumps(bench_sched(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

@@ -124,13 +124,18 @@ def _controllers(mgr):
 
 def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
                  shards=None, lease_duration=24.0, warm_pool=0,
-                 latency=None):
+                 latency=None, scheduler_nodes=None,
+                 scheduler_policy="packed"):
     """`shards=None` is the historical single OperatorManager; an int
     builds the ShardedOperator over the same injector (shards=1 disables
     leases — single-owner mode must stay byte-identical to the pre-shard
     engine, which the golden-log test asserts).  `warm_pool` enables K
     default-shape standby pods; `latency` is an optional (pull, init)
-    pair for the chaos kubelet's seeded cold-start injection."""
+    pair for the chaos kubelet's seeded cold-start injection.
+    `scheduler_nodes` (a list of NAME=SHAPE[:GEN] specs) enables the
+    cluster scheduler over that Node inventory, attaches it to the
+    injector (drain_node evicts gangs through it), and routes its
+    admission/preemption decisions into the seeded event log."""
     inner = FakeCluster()
     clock = SimClock()
     pull, init = latency if latency is not None else (None, None)
@@ -145,6 +150,9 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
         classify_retryable_errors=classify,
         control_fanout=fanout,
         warm_pool_size=warm_pool,
+        scheduler_enabled=scheduler_nodes is not None,
+        scheduler_policy=scheduler_policy,
+        scheduler_nodes=list(scheduler_nodes or []),
     )
     if shards is None:
         mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
@@ -153,6 +161,9 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
             inj, opts, shard_count=shards, engine_kwargs={"clock": clock},
             clock=clock, lease_duration=lease_duration, note=inj.note,
         )
+    if getattr(mgr, "scheduler", None) is not None:
+        inj.scheduler = mgr.scheduler
+        mgr.scheduler.note = inj.note
     # all delays collapse to immediate adds: pop order (and therefore the
     # whole run) becomes a pure function of the seed + schedule, and no
     # real-time timer ever fires mid-soak
@@ -535,6 +546,136 @@ def test_warmpool_shard_crash_soak_converges_and_is_deterministic():
     assert any("crash shard-1" in line for line in log1)
     assert any("shard_failover slot=1" in line for line in log1)
     assert any("pod=default/warm-v5e-1-" in line for line in log1)
+
+
+# ------------------------------------------- scheduler gang-preemption soak
+def _sliced_exitcode_tfjob(name, uid, workers, priority=None):
+    """ExitCode job whose every worker asks for a whole v5e-8 slice, with
+    a pinned uid (determinism) and an optional scheduler priority."""
+    job = _stamped_exitcode_tfjob(name, uid, workers=workers)
+    job.replica_specs["Worker"].template.setdefault("metadata", {})[
+        "annotations"
+    ] = {"kubeflow.org/slice-shape": "v5e-8"}
+    if priority is not None:
+        job.metadata.setdefault("annotations", {})[
+            "kubeflow.org/priority"
+        ] = str(priority)
+    return job
+
+
+def run_scheduler_preemption_soak(seed):
+    """ISSUE 8 acceptance: the cluster scheduler under the full storm
+    schedule.  Two v5e-8 nodes (16 chips).  A low-priority 2-slice gang
+    fills the cluster; a high-priority 1-slice arrival preempts it
+    (SIGTERM/143, whole gang) mid-429-storm; a node drain then evicts
+    the high-priority gang through the scheduler (gang requeues as a
+    unit, node name in the seeded log).  Afterwards: the high-priority
+    job is Running again, the low-priority gang is parked with a
+    Scheduling condition and ZERO pods (requeued, not orphaned), every
+    restart counter equals the evictions booked against the job, no
+    gang is ever partially reserved, and the log replays byte-identical
+    per seed."""
+    inner, clock, inj, mgr, auditor = make_harness(
+        seed, scheduler_nodes=["sched-0=v5e-8", "sched-1=v5e-8"],
+    )
+    sched = mgr.scheduler
+    lo = _sliced_exitcode_tfjob("sched-lo", "sched-uid-lo", workers=2)
+    hi = _sliced_exitcode_tfjob(
+        "sched-hi", "sched-uid-hi", workers=1, priority=100
+    )
+    inj.schedule_storm(35, 15, fault="429", retry_after=3.0)
+    inj.schedule_storm(55, 8, fault="500")
+    inj.schedule_storm(66, 6, fault="conflict", ops=["update"])
+    # the high-priority arrival lands inside the 429 storm: admission is
+    # in-memory (never faulted) but the eviction writes and the new
+    # gang's creates both fight the storm.  The submission itself goes
+    # straight to the backing store — a user's kubectl apply is not an
+    # operator API call and must not be eaten by the operator's storm
+    inj.at(
+        40, lambda: inner.create("TFJob", hi.to_dict()),
+        "submit sched-hi priority=100",
+    )
+    # drain the node the hi gang landed on (packed + name tiebreak pins
+    # it to sched-0): the gang is evicted THROUGH the scheduler and the
+    # node name rides the seeded log
+    inj.at(90, lambda: inj.drain_node("sched-0"), "drain sched-0")
+    inj.create("TFJob", lo.to_dict())
+
+    partial = []
+
+    def audit_gangs():
+        # the tentpole invariant, checked continuously: a gang is fully
+        # reserved or not reserved at all — and no pod of a job exists
+        # without its gang's full reservation
+        for uid, total in (("sched-uid-lo", 2), ("sched-uid-hi", 1)):
+            n = sched.reserved_members(uid)
+            if n not in (0, total):
+                partial.append((clock(), uid, n))
+
+    try:
+        for _ in range(120):  # 600 sim-seconds; chaos ends by t=96
+            inj.step(5.0)
+            for inf in mgr.factory._informers.values():
+                inf.resync_once()
+            drain(mgr)
+            audit_gangs()
+    finally:
+        mgr.factory.stop_all()
+
+    assert partial == [], f"partially reserved gangs observed: {partial}"
+    assert auditor.violations == [], auditor.violations
+    problems = audit_orphans(inner)
+    assert problems == [], problems
+
+    hi_stored = inner.get("TFJob", "default", "sched-hi")
+    hi_status = common.JobStatus.from_dict(hi_stored.get("status"))
+    assert common.is_running(hi_status), hi_stored.get("status")
+    assert hi_status.replica_statuses["Worker"].active == 1
+
+    lo_stored = inner.get("TFJob", "default", "sched-lo")
+    lo_status = common.JobStatus.from_dict(lo_stored.get("status"))
+    # parked, visibly: Scheduling condition True, zero pods, not orphaned
+    assert common.has_condition(lo_status, common.JOB_SCHEDULING), (
+        lo_stored.get("status")
+    )
+    lo_pods = [
+        p for p in inner.list_pods()
+        if objects.labels_of(p).get(objects.LABEL_JOB_NAME) == "sched-lo"
+    ]
+    assert lo_pods == [], [objects.key_of(p) for p in lo_pods]
+    assert inner.events_for("sched-lo", "Warning"), "preemption event missing"
+
+    # restart counters exact: every counted restart is an eviction the
+    # scheduler booked (preemption) or a drain kill the injector booked
+    for name in ("sched-lo", "sched-hi"):
+        stored = inner.get("TFJob", "default", name)
+        rs = common.JobStatus.from_dict(
+            stored.get("status")
+        ).replica_statuses["Worker"]
+        booked = sched.evictions.get(f"default/{name}", 0) + (
+            inj.retryable_kills.get((f"default/{name}", "worker"), 0)
+        )
+        assert rs.restarts == booked, (name, rs.restarts, booked)
+    # the drain actually went through the scheduler: the hi gang was
+    # evicted as a unit and the node name is in the log
+    assert sched.evictions.get("default/sched-lo", 0) >= 2
+    assert inj.retryable_kills.get(("default/sched-hi", "worker"), 0) >= 1
+    assert any("drain node=sched-0" in line for line in inj.log)
+    assert any("drain_evict gang=default/sched-hi" in line
+               for line in inj.log)
+    assert any("preempt gang=default/sched-lo" in line for line in inj.log)
+    # the chaos bit
+    for fault in ("fault.429", "fault.500", "fault.conflict"):
+        assert inj.stats.get(fault, 0) > 0, (fault, inj.stats)
+    return inj.log
+
+
+def test_scheduler_preemption_soak_converges_and_is_deterministic():
+    log1 = run_scheduler_preemption_soak(SOAK_SEEDS[0])
+    log2 = run_scheduler_preemption_soak(SOAK_SEEDS[0])
+    assert log1 == log2, "\n".join(
+        f"{a!r} | {b!r}" for a, b in zip(log1, log2) if a != b
+    )
 
 
 def _threaded_sharded_log(seed):

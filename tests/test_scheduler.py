@@ -1,0 +1,922 @@
+"""Cluster scheduler (engine/scheduler.py) — gang admission atomicity,
+bin-packing properties, preemption accounting, and wiring.
+
+The acceptance contract (ISSUE 8): under a chaos bind-failure storm no
+job ever has a strict subset of its replicas bound; bin-packing never
+exceeds node capacity and `packed` beats `spread` on fragmentation;
+preemption keeps PR 3's restart counters exact and preempted gangs
+requeue rather than orphan; `--scheduler-policy` selects the plugin all
+the way from the flags to the engines (one scheduler per process, shared
+across shards); disabled (the default) bypasses every seam.
+"""
+import io
+from contextlib import redirect_stdout
+from random import Random
+
+import pytest
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.cmd.manager import (
+    DEFAULT_SCHEDULER_TOPOLOGY,
+    OperatorManager,
+    ShardedOperator,
+    build_scheduler,
+)
+from tf_operator_tpu.cmd.options import ServerOptions, parse_args
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.engine import metrics, scheduler as sched_mod
+from tf_operator_tpu.engine.scheduler import (
+    ASSIGNED_NODE_ANNOTATION,
+    ClusterScheduler,
+    chips_of_shape,
+    make_node,
+    parse_node_spec,
+    priority_of,
+    throughput_ratios_of,
+)
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.chaos import DeterministicQueue, FaultInjector, SimClock
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+from tests import testutil
+from tests.test_chaos import audit_orphans, drain, make_harness, run_steps
+
+
+# ------------------------------------------------------------------ helpers
+def make_sched(policy="packed", nodes=(("n0", "v5e-8", "v5e"),
+                                       ("n1", "v5e-8", "v5e"))):
+    cluster = FakeCluster()
+    for name, shape, gen in nodes:
+        cluster.add_node(name, shape, gen)
+    sched = ClusterScheduler(cluster, policy=policy, clock=SimClock())
+    sched.resync()
+    return cluster, sched
+
+
+def admit(sched, uid, members, priority=0, throughput=None, key=None):
+    return sched.admit(
+        job_key=key or f"default/{uid}", job_uid=uid, kind="TFJob",
+        namespace="default", members=members, priority=priority,
+        throughput=throughput,
+    )
+
+
+def sliced_job(name, workers, shape="v5e-8", priority=None, uid=None):
+    job = testutil.new_tfjob(name, worker=workers)
+    job.replica_specs["Worker"].restart_policy = common.RESTART_POLICY_EXIT_CODE
+    job.replica_specs["Worker"].template.setdefault("metadata", {})[
+        "annotations"
+    ] = {"kubeflow.org/slice-shape": shape}
+    if priority is not None:
+        job.metadata.setdefault("annotations", {})[
+            sched_mod.PRIORITY_ANNOTATION
+        ] = str(priority)
+    if uid is not None:
+        job.metadata["uid"] = uid
+    return job
+
+
+# ---------------------------------------------------------------- unit layer
+def test_chips_of_shape_and_node_spec_parsing():
+    assert chips_of_shape("v5e-1") == 1
+    assert chips_of_shape("v5e-8") == 8
+    assert chips_of_shape("v5e-256") == 256
+    assert chips_of_shape("weird") == 1  # malformed never unschedulable
+    assert parse_node_spec("a=v5e-8") == ("a", "v5e-8", "v5e")
+    assert parse_node_spec("fast=v5e-8:v5p") == ("fast", "v5e-8", "v5p")
+    with pytest.raises(ValueError):
+        parse_node_spec("nonsense")
+
+
+def test_priority_and_throughput_annotations():
+    job = sliced_job("p", 1, priority=42)
+    assert priority_of(job) == 42
+    named = testutil.new_tfjob("named", worker=1)
+    named.run_policy.scheduling_policy = common.SchedulingPolicy(
+        priority_class="high"
+    )
+    assert priority_of(named) == 100
+    assert priority_of(testutil.new_tfjob("plain", worker=1)) == 0
+    tj = testutil.new_tfjob("t", worker=1)
+    tj.metadata.setdefault("annotations", {})[
+        sched_mod.THROUGHPUT_ANNOTATION
+    ] = "v5e=1.0,v5p=2.5,junk"
+    assert throughput_ratios_of(tj) == {"v5e": 1.0, "v5p": 2.5}
+
+
+def test_spread_scatters_and_packed_fills():
+    _, spread = make_sched(policy="spread")
+    for i in range(2):
+        ok, _ = admit(spread, f"s{i}", {f"s{i}-w-0": 1})
+        assert ok
+    free = spread.free_chips()
+    assert sorted(free.values()) == [7, 7], free  # one member per node
+
+    _, packed = make_sched(policy="packed")
+    for i in range(2):
+        ok, _ = admit(packed, f"p{i}", {f"p{i}-w-0": 1})
+        assert ok
+    free = packed.free_chips()
+    assert sorted(free.values()) == [6, 8], free  # both on one node
+    # ...which is exactly what lets a whole-slice gang still land
+    ok, _ = admit(packed, "big", {"big-w-0": 8})
+    assert ok
+
+
+def test_throughput_ratio_prefers_fast_generation_for_jobs_that_benefit():
+    _, sched = make_sched(
+        policy="throughput_ratio",
+        nodes=(("slow-0", "v5e-8", "v5e"), ("fast-0", "v5e-8", "v5p")),
+    )
+    ok, _ = admit(
+        sched, "speedy", {"speedy-w-0": 8},
+        throughput={"v5e": 1.0, "v5p": 2.5},
+    )
+    assert ok
+    assert sched.planned_node("speedy", "speedy-w-0") == "fast-0"
+    # a generation-indifferent job packs onto what's left
+    ok, _ = admit(sched, "meh", {"meh-w-0": 8})
+    assert ok
+    assert sched.planned_node("meh", "meh-w-0") == "slow-0"
+
+
+def test_gang_admission_is_all_or_nothing():
+    _, sched = make_sched()  # 2 x 8 chips
+    # 3 whole-slice members cannot fit: NOTHING must be reserved
+    ok, msg = admit(sched, "big", {f"big-w-{i}": 8 for i in range(3)})
+    assert not ok and "waiting for capacity" in msg
+    assert sched.reserved_members("big") == 0
+    assert sorted(sched.free_chips().values()) == [8, 8]
+    assert sched.pending_count() == 1
+    # shrink to 2: admits atomically, pending clears
+    ok, _ = admit(sched, "big", {f"big-w-{i}": 8 for i in range(2)})
+    assert ok
+    assert sched.reserved_members("big") == 2
+    assert sched.pending_count() == 0
+
+
+def test_release_key_sweeps_reservation_and_pending():
+    _, sched = make_sched()
+    admit(sched, "gone", {"gone-w-0": 8}, key="default/gone")
+    admit(sched, "parked", {f"parked-w-{i}": 8 for i in range(3)},
+          key="default/parked")
+    assert sched.pending_count() == 1
+    sched.release_key("default/gone")
+    sched.release_key("default/parked")
+    assert sched.reserved_members("gone") == 0
+    assert sched.pending_count() == 0
+    assert sorted(sched.free_chips().values()) == [8, 8]
+
+
+def test_failed_resize_restores_the_old_full_shape():
+    """Review-found hole: a resize mixing a removal with an addition
+    that cannot fit must restore the PREVIOUS full shape — popping the
+    removed member and then failing the extension stranded a
+    neither-old-nor-new-shape subset (exactly the partial state gang
+    atomicity forbids)."""
+    _, sched = make_sched()  # 2 x 8 chips
+    ok, _ = admit(sched, "rz", {"rz-a": 8, "rz-b": 8})
+    assert ok
+    before = {m: sched.planned_node("rz", m) for m in ("rz-a", "rz-b")}
+    # replace member a with TWO new slices: cannot fit (cluster is full)
+    ok, _ = admit(sched, "rz", {"rz-b": 8, "rz-c": 8, "rz-d": 8})
+    assert not ok
+    assert sched.reserved_members("rz") == 2  # the old FULL shape
+    for m, node in before.items():
+        assert sched.planned_node("rz", m) == node
+    assert sorted(sched.free_chips().values()) == [0, 0]
+
+
+def test_preemption_never_double_counts_candidate_adopted_capacity():
+    """Review-found hole: the preemption planner built its hypothetical
+    free map without deducting the candidate gang's own already-adopted
+    (live-pod) members — offering their chips to the plan twice placed
+    the missing member over capacity and evicted a victim that
+    contributed nothing."""
+    cluster, sched = make_sched()  # n0, n1: 8 chips each
+    ok, _ = admit(sched, "victim", {"v-w-0": 8})  # fills its node
+    assert ok
+    victim_node = sched.planned_node("victim", "v-w-0")
+    other = "n1" if victim_node == "n0" else "n0"
+    # candidate: one member already LIVE on the other node (adopted),
+    # one missing whole-slice member — only the victim's node can host it
+    ok, _ = sched.admit(
+        job_key="default/cand", job_uid="cand", kind="TFJob",
+        namespace="default", members={"c-w-0": 8, "c-w-1": 8},
+        priority=100, existing={"c-w-0": other},
+    )
+    assert ok
+    assert sched.planned_node("cand", "c-w-0") == other
+    assert sched.planned_node("cand", "c-w-1") == victim_node
+    assert sched.evictions.get("default/victim") == 1
+    for node, free in sched.free_chips().items():
+        assert free >= 0, (node, free)  # never over capacity
+
+
+def test_preemption_prunes_non_contributing_victims():
+    """Review-found hole: the victim plan is built in priority/age
+    order, which can front-load a gang whose eviction frees nothing the
+    fit needs — it must be pruned, not needlessly restarted."""
+    cluster, sched = make_sched(
+        nodes=(("small", "v5e-4", "v5e"), ("big", "v5e-8", "v5e")),
+    )
+    clock = sched.clock
+    ok, _ = admit(sched, "old-big", {"ob-w-0": 8})  # fills `big`
+    assert ok
+    clock.advance(10.0)
+    ok, _ = admit(sched, "young-small", {"ys-w-0": 1})  # on `small`
+    assert ok
+    # the arrival needs a whole 8-chip slice: only `big` can ever host
+    # it, yet the youngest-first victim order tries `young-small` first
+    ok, _ = admit(sched, "arrival", {"ar-w-0": 8}, priority=100)
+    assert ok
+    assert sched.planned_node("arrival", "ar-w-0") == "big"
+    assert sched.evictions == {"default/old-big": 1}, sched.evictions
+    # the non-contributing gang kept its reservation untouched
+    assert sched.reserved_members("young-small") == 1
+    assert sched.planned_node("young-small", "ys-w-0") == "small"
+
+
+def test_pending_only_release_refreshes_the_gauge():
+    """Review-found hole: releasing a gang that was pending but never
+    admitted skipped the gauge update, leaving scheduler_pending_gangs
+    stale."""
+    _, sched = make_sched()
+    ok, _ = admit(sched, "park", {f"park-w-{i}": 8 for i in range(3)})
+    assert not ok
+    assert metrics.SCHEDULER_PENDING_GANGS.get() == 1
+    sched.release("park")
+    assert metrics.SCHEDULER_PENDING_GANGS.get() == 0
+
+
+def test_warm_claimed_pods_keep_member_identity_across_resync():
+    """Review-found hole: a warm-claimed pod keeps its standby NAME;
+    resync (and the engine's existing-placement extraction) must key the
+    rebuilt reservation by the member name in the warm-bound-name
+    annotation, or the live pod is orphaned from its own gang and its
+    capacity double-booked after an operator restart."""
+    cluster, clock, inj, mgr = scheduled_manager(warm_pool=1)
+    settle(inj, mgr, steps=4)
+    job = testutil.new_tfjob("wr", worker=1)
+    job.metadata["uid"] = "wr-uid"
+    cluster.create("TFJob", job.to_dict())
+    settle(inj, mgr)
+    claimed = [
+        p for p in cluster.list_pods()
+        if objects.labels_of(p).get(objects.LABEL_JOB_NAME) == "wr"
+    ]
+    assert len(claimed) == 1
+    assert objects.name_of(claimed[0]).startswith("warm-")  # a claim
+    actual_node = objects.pod_node(claimed[0])
+    mgr.factory.stop_all()
+
+    fresh = ClusterScheduler(cluster, policy="packed", clock=clock)
+    fresh.resync()
+    # the reservation is keyed by MEMBER name, placed where the pod is
+    assert fresh.planned_node("wr-uid", "wr-worker-0") == actual_node
+    assert fresh.planned_node("wr-uid", objects.name_of(claimed[0])) is None
+
+
+def test_eviction_kills_warm_claimed_pods_by_their_actual_name():
+    """Review-found hole: a warm-claimed member's pod keeps the
+    standby's name — eviction by member name would hit NotFound, count
+    'already gone', and hand the preemptor chips a live pod still
+    occupies."""
+    cluster, sched = make_sched(nodes=(("n0", "v5e-1", "v5e"),))
+    # the owner CR must exist or the fake store's GC reaps the dependent
+    cluster.create("TFJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "vic", "namespace": "default",
+                     "uid": "vic-uid"},
+        "spec": {},
+    })
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": "warm-v5e-1-0", "namespace": "default",
+            "annotations": {
+                "kubeflow.org/warm-bound-name": "vic-worker-0",
+                "kubeflow.org/slice-shape": "v5e-1",
+            },
+            "ownerReferences": [{
+                "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+                "name": "vic", "uid": "vic-uid", "controller": True,
+            }],
+        },
+        "spec": {"nodeName": "n0",
+                 "containers": [{"name": "tensorflow", "image": "t"}]},
+        "status": {"phase": "Running"},
+    }
+    cluster.create_pod(pod)
+    sched.resync()
+    assert sched.planned_node("vic-uid", "vic-worker-0") == "n0"
+    ok, _ = admit(sched, "hi", {"hi-w-0": 1}, priority=100)
+    assert ok
+    killed = cluster.get_pod("default", "warm-v5e-1-0")
+    assert objects.pod_phase(killed) == objects.POD_FAILED
+    term = killed["status"]["containerStatuses"][0]["state"]["terminated"]
+    assert term["exitCode"] == 143
+    assert sched.evictions.get("default/vic") == 1
+
+
+def test_resize_to_zero_releases_the_reservation():
+    """Review-found hole: an empty member set (replicas scaled to 0 —
+    'preemption = resize to 0') must release the held capacity, not
+    leak it against an empty cluster forever."""
+    _, sched = make_sched()
+    ok, _ = admit(sched, "z", {"z-a": 8, "z-b": 8})
+    assert ok and sorted(sched.free_chips().values()) == [0, 0]
+    ok, _ = admit(sched, "z", {})
+    assert ok
+    assert sched.reserved_members("z") == 0
+    assert sorted(sched.free_chips().values()) == [8, 8]
+
+
+def test_chip_demand_change_is_readmitted_not_rubber_stamped():
+    """Review-found hole: identical member NAMES with a changed chip
+    demand (slice-shape edit) must re-place under the new demand with a
+    fit check — name-set comparison rubber-stamped it and over-committed
+    the old nodes."""
+    _, sched = make_sched()  # 2 x 8 chips
+    ok, _ = admit(sched, "grow", {"g-a": 1, "g-b": 1})
+    assert ok  # packed: both on one node
+    # same names, 8 chips each: must spread over both nodes, fit-checked
+    ok, _ = admit(sched, "grow", {"g-a": 8, "g-b": 8})
+    assert ok
+    nodes = {sched.planned_node("grow", m) for m in ("g-a", "g-b")}
+    assert nodes == {"n0", "n1"}
+    assert sorted(sched.free_chips().values()) == [0, 0]
+    # growing past the cluster restores the previous (8-chip) shape
+    ok, _ = admit(sched, "grow", {"g-a": 8, "g-b": 8, "g-c": 8})
+    assert not ok
+    assert sched.reserved_members("grow") == 2
+    assert sorted(sched.free_chips().values()) == [0, 0]
+
+
+def test_release_key_is_kind_scoped():
+    """Review-found hole: every kind's engine shares one scheduler, and a
+    deleted TFJob ns/x must not release a live PyTorchJob ns/x."""
+    _, sched = make_sched()
+    sched.admit(job_key="default/x", job_uid="tf-x", kind="TFJob",
+                namespace="default", members={"x-tf-0": 8})
+    sched.admit(job_key="default/x", job_uid="pt-x", kind="PyTorchJob",
+                namespace="default", members={"x-pt-0": 8})
+    sched.release_key("default/x", kind="TFJob")
+    assert sched.reserved_members("tf-x") == 0
+    assert sched.reserved_members("pt-x") == 1
+    sched.release_key("default/x")  # kindless sweeps the rest
+    assert sched.reserved_members("pt-x") == 0
+
+
+def test_resync_preserves_owner_priority_against_inversion():
+    """Review-found hole: rebuilding reservations with priority=0 let any
+    positive-priority arrival preempt a high-priority gang right after an
+    operator restart — resync must read the owner CR's priority."""
+    cluster, sched = make_sched(nodes=(("n0", "v5e-8", "v5e"),))
+    cluster.create("TFJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "vip", "namespace": "default",
+                     "uid": "vip-uid",
+                     "annotations": {"kubeflow.org/priority": "100"}},
+        "spec": {},
+    })
+    cluster.create_pod({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": "vip-worker-0", "namespace": "default",
+            "annotations": {"kubeflow.org/assigned-node": "n0",
+                            "kubeflow.org/slice-shape": "v5e-8"},
+            "ownerReferences": [{
+                "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+                "name": "vip", "uid": "vip-uid", "controller": True,
+            }],
+        },
+        "spec": {"nodeName": "n0",
+                 "containers": [{"name": "tensorflow", "image": "t"}]},
+        "status": {"phase": "Running"},
+    })
+    fresh = ClusterScheduler(cluster, policy="packed", clock=SimClock())
+    fresh.resync()
+    # a mid-priority arrival must NOT preempt the rebuilt 100 gang
+    ok, msg = admit(fresh, "mid", {"mid-w-0": 8}, priority=50)
+    assert not ok and "waiting for capacity" in msg
+    assert fresh.reserved_members("vip-uid") == 1
+    assert fresh.evictions == {}
+    pod = cluster.get_pod("default", "vip-worker-0")
+    assert objects.pod_phase(pod) == objects.POD_RUNNING
+
+
+def test_reverted_resize_clears_stale_pending_entry():
+    """Review-found hole: a failed resize marks pending; reverting the
+    spec back to the admitted shape must clear the entry, not leave the
+    gauge over-reporting forever."""
+    _, sched = make_sched()
+    ok, _ = admit(sched, "rv", {"rv-a": 8})
+    assert ok
+    ok, _ = admit(sched, "rv", {"rv-a": 8, "rv-b": 8, "rv-c": 8})
+    assert not ok and sched.pending_count() == 1
+    ok, _ = admit(sched, "rv", {"rv-a": 8})  # revert
+    assert ok
+    assert sched.pending_count() == 0
+    assert metrics.SCHEDULER_PENDING_GANGS.get() == 0
+
+
+def test_drain_keeps_reservation_while_members_still_alive():
+    """Review-found hole: drain released the reservation even when a
+    member survived the kill (Pending under pull latency, conflicted
+    write) — freeing chips a live pod occupies.  The gang must keep its
+    reservation, like the preemption path's abort."""
+    cluster, sched = make_sched(
+        nodes=(("nx", "v5e-8", "v5e"), ("ny", "v5e-8", "v5e")),
+    )
+    cluster.create("TFJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "dg", "namespace": "default", "uid": "dg-uid"},
+        "spec": {},
+    })
+    for name, node, phase in (("dg-worker-0", "nx", "Running"),
+                              ("dg-worker-1", "ny", "Pending")):
+        cluster.create_pod({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": name, "namespace": "default",
+                "annotations": {"kubeflow.org/assigned-node": node,
+                                "kubeflow.org/slice-shape": "v5e-8"},
+                "ownerReferences": [{
+                    "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+                    "name": "dg", "uid": "dg-uid", "controller": True,
+                }],
+            },
+            "spec": {"nodeName": node,
+                     "containers": [{"name": "tensorflow", "image": "t"}]},
+            "status": {"phase": phase},
+        })
+    sched.resync()
+    inj = FaultInjector(cluster, seed=9, clock=SimClock(), kubelet=False)
+    killed = sched.drain_node(
+        "nx", kill=lambda ns, n: inj.kill_pod(ns, n, 137, "NodeDrain")
+    )
+    assert killed == 1  # the Running member on nx died
+    # the Pending member is still alive on ny: the reservation is KEPT
+    # (killed members restart into their held slots) and ny's chips are
+    # not offered to anyone else
+    assert sched.reserved_members("dg-uid") == 2
+    assert sched.free_chips()["ny"] == 0
+
+
+def test_resize_of_high_priority_gang_may_preempt():
+    """Review-found hole: preemption only ran on fresh admission — a
+    high-priority gang scaling up parked forever behind lower-priority
+    gangs it was entitled to evict."""
+    _, sched = make_sched()  # n0, n1: 8 chips each
+    ok, _ = admit(sched, "hi", {"hi-w-0": 8}, priority=100)
+    assert ok
+    ok, _ = admit(sched, "lo", {"lo-w-0": 8}, priority=0)
+    assert ok
+    ok, _ = admit(
+        sched, "hi", {"hi-w-0": 8, "hi-w-1": 8}, priority=100
+    )
+    assert ok, "scale-up must preempt the lower-priority gang"
+    assert sched.reserved_members("hi") == 2
+    assert sched.reserved_members("lo") == 0
+    assert sched.evictions.get("default/lo") == 1
+
+
+def test_scale_extension_is_atomic_and_keeps_survivors_in_place():
+    _, sched = make_sched()
+    ok, _ = admit(sched, "el", {"el-w-0": 8})
+    assert ok
+    before = sched.planned_node("el", "el-w-0")
+    # grow to 3: cannot fit — the old full reservation survives untouched
+    ok, _ = admit(sched, "el", {f"el-w-{i}": 8 for i in range(3)})
+    assert not ok
+    assert sched.reserved_members("el") == 1
+    assert sched.planned_node("el", "el-w-0") == before
+    # grow to 2: fits, survivor stays put
+    ok, _ = admit(sched, "el", {f"el-w-{i}": 8 for i in range(2)})
+    assert ok
+    assert sched.planned_node("el", "el-w-0") == before
+    assert sched.reserved_members("el") == 2
+
+
+# ----------------------------------------------------------- property layer
+@pytest.mark.parametrize("policy", ["spread", "packed", "throughput_ratio"])
+@pytest.mark.parametrize("seed", [7, 23])
+def test_binpack_never_exceeds_capacity_and_never_partially_reserves(
+    policy, seed
+):
+    """Seeded random admit/release streams: after EVERY operation, each
+    node's reserved chips stay within capacity (free never negative) and
+    every gang is fully reserved or not reserved at all."""
+    rng = Random(seed)
+    nodes = tuple(
+        (f"n{i}", rng.choice(["v5e-1", "v5e-8", "v5e-8", "v5e-256"]), "v5e")
+        for i in range(6)
+    )
+    _, sched = make_sched(policy=policy, nodes=nodes)
+    live = {}
+    for step in range(200):
+        if live and rng.random() < 0.4:
+            uid = rng.choice(sorted(live))
+            sched.release(uid)
+            del live[uid]
+        else:
+            uid = f"g{step}"
+            members = {
+                f"{uid}-w-{i}": chips_of_shape(
+                    rng.choice(["v5e-1", "v5e-8", "v5e-256"])
+                )
+                for i in range(rng.randrange(1, 5))
+            }
+            ok, _ = admit(sched, uid, members)
+            if ok:
+                live[uid] = len(members)
+        for node, free in sched.free_chips().items():
+            assert free >= 0, (step, node, free)
+        for uid, total in live.items():
+            assert sched.reserved_members(uid) == total, (step, uid)
+        for uid in set(sched._pending_since) - set(live):
+            assert sched.reserved_members(uid) == 0, (step, uid)
+
+
+def test_packed_beats_spread_on_fragmentation():
+    """The same contended trace of small gangs + whole-slice arrivals on
+    both policies: `packed` must strand strictly fewer whole-slice gangs
+    for lack of a contiguous slice while total free capacity was enough
+    (fragmentation-caused rejections — exactly what best-fit exists to
+    avoid)."""
+
+    def frag_rejections(policy, seed=11):
+        rng = Random(seed)
+        nodes = tuple((f"n{i}", "v5e-8", "v5e") for i in range(4))
+        _, sched = make_sched(policy=policy, nodes=nodes)
+        live, rejected = [], 0
+        for step in range(240):
+            roll = rng.random()
+            if live and roll < 0.35:
+                uid = live.pop(rng.randrange(len(live)))
+                sched.release(uid)
+            elif roll < 0.85:
+                uid = f"small{step}"
+                ok, _ = admit(sched, uid, {f"{uid}-w-0": 1})
+                if ok:
+                    live.append(uid)
+            else:
+                uid = f"slice{step}"
+                total_free = sum(
+                    max(0, f) for f in sched.free_chips().values()
+                )
+                ok, _ = admit(sched, uid, {f"{uid}-w-0": 8})
+                if ok:
+                    live.append(uid)
+                elif total_free >= 8:
+                    rejected += 1  # enough chips, no contiguous slice
+                if not ok:
+                    sched.release_key(f"default/{uid}")
+        return rejected
+
+    packed, spread = frag_rejections("packed"), frag_rejections("spread")
+    # seed 11: packed 5 vs spread 15 (seeds 13/29: 0/19 and 0/10) —
+    # best-fit cannot always dodge fragmentation (releases land where
+    # they land) but it must beat the scatter baseline decisively
+    assert packed * 2 <= spread, (packed, spread)
+
+
+# ---------------------------------------------------------- operator layer
+def scheduled_manager(nodes=("n0=v5e-8", "n1=v5e-8"), policy="packed",
+                      warm_pool=0):
+    cluster = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(cluster, seed=5, clock=clock)
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]),
+        scheduler_enabled=True,
+        scheduler_policy=policy,
+        scheduler_nodes=list(nodes),
+        warm_pool_size=warm_pool,
+    )
+    mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
+    inj.scheduler = mgr.scheduler
+    for ctl in mgr.controllers.values():
+        ctl.queue = DeterministicQueue()
+    mgr.factory.start_all()
+    return cluster, clock, inj, mgr
+
+
+def settle(inj, mgr, steps=6, dt=2.0):
+    pool = getattr(mgr, "warm_pool", None)
+    for _ in range(steps):
+        inj.step(dt)
+        if pool is not None:
+            pool.replenish()
+        for inf in mgr.factory._informers.values():
+            inf.resync_once()
+        drain(mgr)
+
+
+def test_admitted_gang_pods_bind_to_reserved_nodes():
+    cluster, clock, inj, mgr, = scheduled_manager()
+    cluster.create("TFJob", sliced_job("bind", 2, uid="bind-uid").to_dict())
+    settle(inj, mgr)
+    pods = sorted(cluster.list_pods(), key=objects.name_of)
+    assert [objects.pod_node(p) for p in pods] == ["n0", "n1"]
+    for p in pods:
+        ann = p["metadata"]["annotations"]
+        assert ann[ASSIGNED_NODE_ANNOTATION] == objects.pod_node(p)
+        assert objects.pod_phase(p) == objects.POD_RUNNING
+    assert mgr.scheduler.reserved_members("bind-uid") == 2
+    mgr.factory.stop_all()
+
+
+def test_preemption_restart_counters_exact_and_victim_requeues():
+    """The PR 3 contract under preemption: every evicted member is
+    exactly one counted ExitCode restart (code 143), the victim requeues
+    (Scheduling condition, zero pods, zero orphans), and it comes BACK
+    once the preemptor finishes — with no further restarts."""
+    cluster, clock, inj, mgr = scheduled_manager()
+    sched = mgr.scheduler
+    cluster.create("TFJob", sliced_job("lo", 2, uid="lo-uid").to_dict())
+    settle(inj, mgr)
+    assert sched.reserved_members("lo-uid") == 2
+
+    cluster.create(
+        "TFJob", sliced_job("hi", 1, priority=100, uid="hi-uid").to_dict()
+    )
+    settle(inj, mgr)
+    hi = cluster.get("TFJob", "default", "hi")
+    hi_status = common.JobStatus.from_dict(hi.get("status"))
+    assert common.is_running(hi_status)
+
+    lo = cluster.get("TFJob", "default", "lo")
+    lo_status = common.JobStatus.from_dict(lo.get("status"))
+    rs = lo_status.replica_statuses["Worker"]
+    assert rs.restarts == 2 == sched.evictions.get("default/lo", 0)
+    assert common.has_condition(lo_status, common.JOB_SCHEDULING)
+    assert [
+        p for p in cluster.list_pods()
+        if objects.labels_of(p).get(objects.LABEL_JOB_NAME) == "lo"
+    ] == []
+    assert audit_orphans(cluster) == []
+    # the eviction was SIGTERM-graceful: the kill event carries 143
+    exits = cluster.events_for("lo", "Normal")
+    assert any("exited with code 143" in e["message"] for e in exits), exits
+
+    # preemptor finishes -> capacity frees -> the victim gang comes back
+    cluster.delete("TFJob", "default", "hi")
+    settle(inj, mgr, steps=8)
+    lo = cluster.get("TFJob", "default", "lo")
+    lo_status = common.JobStatus.from_dict(lo.get("status"))
+    assert common.is_running(lo_status), lo.get("status")
+    assert not common.has_condition(lo_status, common.JOB_SCHEDULING)
+    assert lo_status.replica_statuses["Worker"].active == 2
+    assert lo_status.replica_statuses["Worker"].restarts == 2  # unchanged
+    mgr.factory.stop_all()
+
+
+def test_no_feasible_preemption_kills_nobody():
+    """A high-priority gang that cannot fit EVEN after evicting every
+    lower-priority gang must not evict anyone (the feasibility check
+    runs before any pod is touched)."""
+    cluster, clock, inj, mgr = scheduled_manager()
+    cluster.create("TFJob", sliced_job("lo", 1, uid="lo-uid").to_dict())
+    settle(inj, mgr)
+    # needs 3 slices; the cluster only has 2 even empty
+    cluster.create(
+        "TFJob", sliced_job("huge", 3, priority=100, uid="huge-uid").to_dict()
+    )
+    settle(inj, mgr)
+    lo = cluster.get("TFJob", "default", "lo")
+    lo_status = common.JobStatus.from_dict(lo.get("status"))
+    assert common.is_running(lo_status)
+    assert lo_status.replica_statuses["Worker"].restarts == 0
+    assert sched_mod is not None and mgr.scheduler.evictions == {}
+    huge = cluster.get("TFJob", "default", "huge")
+    huge_status = common.JobStatus.from_dict(huge.get("status"))
+    assert common.has_condition(huge_status, common.JOB_SCHEDULING)
+    mgr.factory.stop_all()
+
+
+def test_bind_failure_storm_never_partially_reserves(caplog):
+    """The tentpole invariant under mid-bind chaos: with a 500 storm on
+    Pod creates, admission reserves the WHOLE gang before any create, so
+    failed creates leave a full reservation (never a partial one) and
+    the gang finishes binding once the storm passes — zero partial
+    states observed at every step."""
+    inner, clock, inj, mgr, auditor = make_harness(
+        3, scheduler_nodes=["n0=v5e-8", "n1=v5e-8", "n2=v5e-8",
+                            "n3=v5e-8"],
+    )
+    sched = mgr.scheduler
+    inj.schedule_storm(4, 40, fault="500", ops=["create"], kinds=["Pod"])
+    job = testutil.new_tfjob("gang", worker=4)
+    job.metadata["uid"] = "gang-uid"
+    job.replica_specs["Worker"].restart_policy = (
+        common.RESTART_POLICY_EXIT_CODE
+    )
+    job.replica_specs["Worker"].template.setdefault("metadata", {})[
+        "annotations"
+    ] = {"kubeflow.org/slice-shape": "v5e-8"}
+    inj.create("TFJob", job.to_dict())
+    partial = []
+    try:
+        for _ in range(30):  # 150 sim-s; the storm ends at t=44
+            inj.step(5.0)
+            for inf in mgr.factory._informers.values():
+                inf.resync_once()
+            drain(mgr)
+            n = sched.reserved_members("gang-uid")
+            if n not in (0, 4):
+                partial.append((clock(), n))
+            # a bound pod without a full gang reservation is the bug the
+            # subsystem exists to prevent
+            job_pods = [
+                p for p in inner.list_pods()
+                if objects.labels_of(p).get(objects.LABEL_JOB_NAME)
+                == "gang"
+            ]
+            if job_pods and n != 4:
+                partial.append((clock(), "pods-without-reservation"))
+    finally:
+        mgr.factory.stop_all()
+    assert partial == [], partial
+    assert inj.stats.get("fault.500", 0) > 0
+    pods = inner.list_pods()
+    assert len(pods) == 4
+    assert sorted(objects.pod_node(p) for p in pods) == [
+        "n0", "n1", "n2", "n3"
+    ]
+    assert audit_orphans(inner) == []
+
+
+def test_warm_claim_consults_placement_hint_and_rebinds():
+    """Speculative placement: with the warm pool enabled, a claim prefers
+    a standby already on the member's reserved node; when the only ready
+    standby sits elsewhere, the claim still wins and the reservation
+    REBINDS to where the pod physically runs."""
+    cluster, clock, inj, mgr = scheduled_manager(warm_pool=2)
+    pool = mgr.warm_pool
+    settle(inj, mgr, steps=4)  # standbys fill and go Running
+    assert pool.ready_count("v5e-1") == 2
+    standby_nodes = {
+        objects.name_of(p): objects.pod_node(p)
+        for p in cluster.list_pods()
+    }
+    assert set(standby_nodes.values()) <= {f"chaos-node-{i}" for i in range(4)}
+
+    job = testutil.new_tfjob("wp", worker=1)  # default v5e-1 shape
+    job.metadata["uid"] = "wp-uid"
+    cluster.create("TFJob", job.to_dict())
+    settle(inj, mgr)
+    claimed = [
+        p for p in cluster.list_pods()
+        if objects.labels_of(p).get(objects.LABEL_JOB_NAME) == "wp"
+    ]
+    assert len(claimed) == 1
+    actual = objects.pod_node(claimed[0])
+    # the standby's chaos-node is off-inventory: the reservation follows
+    # the pod (reality wins), not the planned inventory slot
+    assert mgr.scheduler.planned_node("wp-uid", "wp-worker-0") == actual
+    assert metrics.WARM_POOL_CLAIMS.get({"shape": "v5e-1"}) >= 1
+    mgr.factory.stop_all()
+
+
+def test_resync_rebuilds_reservations_from_live_pods():
+    """Operator restart: a fresh scheduler adopts live pods' placements
+    (assigned-node annotation) instead of re-placing anything, and the
+    free-chip accounting matches what the old process had."""
+    cluster, clock, inj, mgr = scheduled_manager()
+    cluster.create("TFJob", sliced_job("keep", 2, uid="keep-uid").to_dict())
+    settle(inj, mgr)
+    before = mgr.scheduler.free_chips()
+    placements = {
+        objects.name_of(p): objects.pod_node(p) for p in cluster.list_pods()
+    }
+    mgr.factory.stop_all()
+
+    fresh = ClusterScheduler(cluster, policy="packed", clock=clock)
+    fresh.resync()
+    assert fresh.free_chips() == before
+    assert fresh.reserved_members("keep-uid") == 2
+    for member, node in placements.items():
+        assert fresh.planned_node("keep-uid", member) == node
+
+
+# ----------------------------------------------------------------- wiring
+def test_policy_selection_wired_from_flags_to_engines():
+    o = parse_args(
+        ["--scheduler-enabled", "--scheduler-policy", "throughput_ratio",
+         "--node", "a=v5e-8", "--node", "b=v5e-256:v5p"]
+    )
+    assert o.scheduler_enabled and o.scheduler_policy == "throughput_ratio"
+    assert o.scheduler_nodes == ["a=v5e-8", "b=v5e-256:v5p"]
+    cluster = FakeCluster()
+    o.enabled_schemes = EnabledSchemes(["TFJob"])
+    mgr = OperatorManager(cluster, o)
+    assert mgr.scheduler is not None
+    assert mgr.scheduler.policy_name == "throughput_ratio"
+    assert mgr.controllers["TFJob"].engine.scheduler is mgr.scheduler
+    assert set(mgr.scheduler.free_chips()) == {"a", "b"}
+    assert mgr.scheduler.free_chips()["b"] == 256
+    with pytest.raises(ValueError):
+        ClusterScheduler(cluster, policy="nonsense")
+
+
+def test_scheduler_disabled_by_default_and_default_topology():
+    mgr = OperatorManager(
+        FakeCluster(), ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    )
+    assert mgr.scheduler is None
+    assert mgr.controllers["TFJob"].engine.scheduler is None
+    cluster = FakeCluster()
+    sched = build_scheduler(
+        cluster,
+        ServerOptions(
+            enabled_schemes=EnabledSchemes(["TFJob"]), scheduler_enabled=True
+        ),
+    )
+    assert set(sched.free_chips()) == {
+        parse_node_spec(s)[0] for s in DEFAULT_SCHEDULER_TOPOLOGY
+    }
+
+
+def test_sharded_operator_shares_one_scheduler():
+    cluster = FakeCluster()
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]),
+        scheduler_enabled=True,
+        scheduler_nodes=["n0=v5e-8"],
+    )
+    op = ShardedOperator(cluster, opts, shard_count=3)
+    assert op.scheduler is not None
+    for shard in op.shards:
+        ctl = shard.manager.controllers["TFJob"]
+        assert ctl.engine.scheduler is op.scheduler
+
+
+def test_describe_shows_scheduling_condition_and_event():
+    """Satellite 1: `tpu-jobs describe` surfaces WHY a job is Pending —
+    the Scheduling condition row and the GangPending event."""
+    from tf_operator_tpu.sdk.cli import Cli
+
+    cluster, clock, inj, mgr = scheduled_manager(nodes=("tiny=v5e-1",))
+    cluster.create("TFJob", sliced_job("stuck", 1, uid="stuck-uid").to_dict())
+    settle(inj, mgr)
+    out = io.StringIO()
+    with redirect_stdout(out):
+        Cli(cluster).describe("TFJob", "stuck", "default")
+    text = out.getvalue()
+    assert "Scheduling" in text
+    assert "GangPending" in text
+    assert "waiting for capacity" in text
+    mgr.factory.stop_all()
+
+
+def test_bench_sched_policies_beat_spread_on_makespan():
+    """ISSUE 8 acceptance (BENCH_r07): on the contended mixed trace,
+    `packed` and `throughput_ratio` beat `spread` on makespan, with a
+    Jain fairness index reported per policy.  bench_sched is a pure
+    function of its seed (SimClock, no threads), so this is a regression
+    test, not a flaky perf assertion."""
+    from bench import bench_sched
+
+    r = bench_sched()
+    by = {row["policy"]: row for row in r["rows"]}
+    for row in r["rows"]:
+        assert row["completed"] == row["jobs"], row
+        assert row["jain_fairness"] is not None
+        assert 0.0 < row["jain_fairness"] <= 1.0
+    assert by["packed"]["makespan_s"] < by["spread"]["makespan_s"], by
+    assert (
+        by["throughput_ratio"]["makespan_s"] < by["spread"]["makespan_s"]
+    ), by
+    assert r["speedup"]["packed_vs_spread_makespan"] > 1.0
+    assert r["speedup"]["throughput_ratio_vs_spread_makespan"] > 1.0
+
+
+def test_scheduler_metrics_families_exposed():
+    cluster, clock, inj, mgr = scheduled_manager(nodes=("n0=v5e-8",))
+    binds0 = metrics.SCHEDULER_BINDS.get({"policy": "packed"})
+    cluster.create("TFJob", sliced_job("m1", 1, uid="m1-uid").to_dict())
+    cluster.create("TFJob", sliced_job("m2", 1, uid="m2-uid").to_dict())
+    settle(inj, mgr)
+    assert metrics.SCHEDULER_BINDS.get({"policy": "packed"}) - binds0 == 1
+    assert metrics.SCHEDULER_PENDING_GANGS.get() == 1
+    text = "\n".join(
+        m.expose()
+        for m in (
+            metrics.SCHEDULER_BINDS,
+            metrics.SCHEDULER_PENDING_GANGS,
+            metrics.SCHEDULER_PREEMPTIONS,
+            metrics.SCHEDULER_BIND_LATENCY,
+            metrics.SCHEDULER_FRAGMENTATION,
+        )
+    )
+    for family in (
+        "tpu_operator_scheduler_binds_total",
+        "tpu_operator_scheduler_pending_gangs",
+        "tpu_operator_scheduler_preemptions_total",
+        "tpu_operator_scheduler_bind_latency_seconds_bucket",
+        "tpu_operator_scheduler_fragmentation_ratio",
+    ):
+        assert family in text, family
+    mgr.factory.stop_all()

@@ -44,6 +44,11 @@ JOB_RESTARTING = "Restarting"
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
 JOB_SUSPENDED = "Suspended"
+# the gang is waiting for cluster capacity (engine/scheduler.py): set
+# while admission fails, cleared on bind — `tpu-jobs describe` shows WHY
+# a job is Pending instead of a blank state (no reference counterpart;
+# the reference delegates this visibility to volcano's PodGroup status)
+JOB_SCHEDULING = "Scheduling"
 
 
 def is_retryable_exit_code(exit_code: int) -> bool:
@@ -348,7 +353,8 @@ def update_job_conditions(
     # not stack the OTHER terminal on top (PS failed + worker-0 succeeded
     # is a Failed job, not both) — first terminal wins.
     if is_finished(status):
-        if cond_type in (JOB_RUNNING, JOB_RESTARTING, JOB_SUSPENDED):
+        if cond_type in (JOB_RUNNING, JOB_RESTARTING, JOB_SUSPENDED,
+                         JOB_SCHEDULING):
             return
         if cond_type == JOB_SUCCEEDED and is_failed(status):
             return
@@ -387,12 +393,16 @@ def update_job_conditions(
     if cond_type == JOB_RUNNING:
         _demote(JOB_RESTARTING)
         _demote(JOB_SUSPENDED)
+        # a Running gang is by definition no longer waiting for capacity
+        _demote(JOB_SCHEDULING)
     elif cond_type == JOB_RESTARTING:
         _demote(JOB_RUNNING)
     elif cond_type == JOB_SUSPENDED:
         _demote(JOB_RUNNING)
         _demote(JOB_RESTARTING)
+        _demote(JOB_SCHEDULING)
     elif cond_type in (JOB_SUCCEEDED, JOB_FAILED):
         _demote(JOB_RUNNING)
         _demote(JOB_RESTARTING)
         _demote(JOB_SUSPENDED)
+        _demote(JOB_SCHEDULING)

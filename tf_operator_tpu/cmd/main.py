@@ -158,11 +158,16 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
     def start_manager():
         manager.start()
         pool = getattr(manager, "warm_pool", None)
+        sched = getattr(manager, "scheduler", None)
         log.info(
-            "manager started: kinds=%s shards=%d warm_pool=%s",
+            "manager started: kinds=%s shards=%d warm_pool=%s scheduler=%s",
             options.all_kinds,
             getattr(manager, "shard_count", 1),
             dict(pool.config.sizes) if pool is not None else "off",
+            (
+                f"{sched.policy_name} over {len(sched.free_chips())} node(s)"
+                if sched is not None else "off"
+            ),
         )
 
     if options.leader_elect:

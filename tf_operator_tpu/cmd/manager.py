@@ -74,6 +74,41 @@ TRANSIENT_RETRY_BASE = 0.05
 TRANSIENT_RETRY_MAX = 30.0
 
 
+def build_scheduler(cluster, options: ServerOptions, engine_kwargs=None):
+    """One ClusterScheduler per operator process, or None when disabled.
+    Shared by every shard's engines (admission is lock-serialized and
+    reservations are keyed by job UID, so failover changes nothing).
+    The --node inventory is materialized as Node objects first — a
+    pre-seeded cluster (or a restart) keeps whatever topology it has."""
+    if not options.scheduler_enabled:
+        return None
+    from tf_operator_tpu.engine.scheduler import (
+        ClusterScheduler,
+        ensure_nodes,
+    )
+
+    specs = options.scheduler_nodes or list(DEFAULT_SCHEDULER_TOPOLOGY)
+    ensure_nodes(cluster, specs)
+    sched = ClusterScheduler(
+        cluster,
+        policy=options.scheduler_policy,
+        clock=(engine_kwargs or {}).get("clock", time.time),
+    )
+    sched.resync()
+    return sched
+
+
+# a usable out-of-the-box inventory for --scheduler-enabled without
+# --node flags: four single-host v5e slices — enough for the smoke path;
+# real topologies name their slices explicitly
+DEFAULT_SCHEDULER_TOPOLOGY = (
+    "tpu-node-0=v5e-8",
+    "tpu-node-1=v5e-8",
+    "tpu-node-2=v5e-8",
+    "tpu-node-3=v5e-8",
+)
+
+
 def build_warm_pool(cluster, options: ServerOptions, engine_kwargs=None):
     """One WarmPoolManager per operator process, or None when disabled.
     Shared by every shard's engines: claims are CAS-safe, and a single
@@ -144,6 +179,9 @@ class _KindController:
         # warm-pool claim-before-create seam: all kinds (and all shards)
         # share the one process-wide pool; None keeps the cold-only path
         self.engine.warm_pool = manager.warm_pool
+        # cluster scheduler (engine/scheduler.py): one per process, shared
+        # by every kind and shard; None bypasses gang admission entirely
+        self.engine.scheduler = manager.scheduler
         self.informer.add_event_handler(
             ResourceEventHandler(
                 add_func=self._on_add,
@@ -411,6 +449,7 @@ class OperatorManager:
         factory: Optional[SharedInformerFactory] = None,
         shard=None,
         warm_pool=None,
+        scheduler=None,
     ) -> None:
         """`engine_kwargs` is forwarded to every kind's JobEngine — the seam
         tests use to inject a simulated clock (chaos soak) or alternate
@@ -435,6 +474,14 @@ class OperatorManager:
             warm_pool = build_warm_pool(cluster, self.options, engine_kwargs)
             self._owns_warm_pool = warm_pool is not None
         self.warm_pool = warm_pool
+        # cluster scheduler: a shard instance is handed the coordinator's
+        # shared one; a standalone manager builds (and owns) its own when
+        # --scheduler-enabled asks for it
+        self._owns_scheduler = scheduler is None and shard is None
+        if self._owns_scheduler:
+            scheduler = build_scheduler(cluster, self.options, engine_kwargs)
+            self._owns_scheduler = scheduler is not None
+        self.scheduler = scheduler
         self.factory = factory or SharedInformerFactory(
             cluster, resync_period=self.options.resync_period
         )
@@ -498,6 +545,8 @@ class OperatorManager:
     def stop(self) -> None:
         if self._owns_warm_pool:
             self.warm_pool.stop()
+        if self._owns_scheduler:
+            self.scheduler.stop()
         for ctl in self.controllers.values():
             ctl.queue.shut_down()
         self.factory.stop_all()
@@ -613,6 +662,7 @@ class _Shard:
             factory=op.factory,
             shard=self.handle,
             warm_pool=op.warm_pool,
+            scheduler=op.scheduler,
         )
 
 
@@ -688,6 +738,10 @@ class ShardedOperator:
         # engines: pool pods are unowned (no slot hashes them), claims are
         # CAS-protected, and a single refill loop owns the K accounting
         self.warm_pool = build_warm_pool(cluster, self.options, engine_kwargs)
+        # one scheduler for the whole control plane too: gang reservations
+        # are keyed by job UID, so slot failover moves a job between
+        # shards without touching its placement
+        self.scheduler = build_scheduler(cluster, self.options, engine_kwargs)
         self.shards: List[_Shard] = [
             _Shard(self, i) for i in range(shard_count)
         ]
@@ -916,6 +970,8 @@ class ShardedOperator:
         self._stop.set()
         if self.warm_pool is not None:
             self.warm_pool.stop()
+        if self.scheduler is not None:
+            self.scheduler.stop()
         if self._tick_thread is not None:
             self._tick_thread.join(timeout=2)
         if self.enable_leases:

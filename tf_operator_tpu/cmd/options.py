@@ -70,6 +70,18 @@ class ServerOptions:
     warm_pool_image: str = "warm-runtime"
     # cadence of the asynchronous refill loop (claims also wake it)
     warm_pool_refill_interval: float = 0.5
+    # cluster scheduler (engine/scheduler.py): gang admission, topology-
+    # aware bin-packing, priority preemption over a simulated Node
+    # inventory.  Disabled (default): pod creation bypasses every
+    # scheduler seam — byte-identical to the pre-scheduler engine.
+    scheduler_enabled: bool = False
+    # bin-packing policy: packed (Tesserae best-fit, the default),
+    # spread (emptiest-node baseline), throughput_ratio (Gavel
+    # heterogeneity-aware)
+    scheduler_policy: str = "packed"
+    # Node inventory specs, NAME=SHAPE[:GEN] (repeatable --node); empty
+    # uses the built-in default topology (cmd/manager.py)
+    scheduler_nodes: List[str] = field(default_factory=list)
     # when True (default), reconcile errors the client layer classified as
     # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
     # consuming the bounded reconcile-retry budget; False restores the
@@ -188,6 +200,32 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "pre-warmed runtime; workload identity is late-bound at claim)",
     )
     p.add_argument("--warm-pool-refill-interval", type=float, default=0.5)
+    p.add_argument(
+        "--scheduler-enabled",
+        action="store_true",
+        help="run the cluster scheduler: pod creation is gated on gang "
+        "admission (a job's whole slice reserves node capacity "
+        "atomically or not at all), placed by --scheduler-policy, with "
+        "priority preemption; off (default) bypasses every scheduler "
+        "seam",
+    )
+    p.add_argument(
+        "--scheduler-policy",
+        default="packed",
+        choices=("spread", "packed", "throughput_ratio"),
+        help="gang bin-packing policy: packed (best-fit, keeps large "
+        "contiguous slices free), spread (emptiest-node baseline), "
+        "throughput_ratio (Gavel-style heterogeneity-aware placement)",
+    )
+    p.add_argument(
+        "--node",
+        action="append",
+        default=[],
+        metavar="NAME=SHAPE[:GEN]",
+        help="add a Node to the scheduler's slice inventory, e.g. "
+        "pool-a=v5e-8 or fast-0=v5e-8:v5p (repeatable); empty uses a "
+        "built-in 4x v5e-8 default topology",
+    )
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -233,4 +271,7 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         warm_pool_shapes=warm_shapes,
         warm_pool_image=a.warm_pool_image,
         warm_pool_refill_interval=a.warm_pool_refill_interval,
+        scheduler_enabled=a.scheduler_enabled,
+        scheduler_policy=a.scheduler_policy,
+        scheduler_nodes=list(a.node),
     )

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional
 from tf_operator_tpu.api import common
 from tf_operator_tpu.api.job import Job, ValidationError
 from tf_operator_tpu.engine import metrics, tracing, warmpool
+from tf_operator_tpu.engine import scheduler as cluster_scheduler
 from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
 from tf_operator_tpu.engine.control import PodControl, ServiceControl
 from tf_operator_tpu.engine.fanout import FanoutResult, slow_start_batch
@@ -76,6 +77,8 @@ REASON_FAILED_VALIDATION = "FailedValidation"
 REASON_SUSPENDED = "JobSuspended"
 REASON_RESUMED = "JobResumed"
 REASON_PARTIAL_SLICE_TEARDOWN = "PartialSliceTeardown"
+REASON_GANG_PENDING = "GangPending"
+REASON_GANG_SCHEDULED = "GangScheduled"
 
 
 class PartialSliceTeardown(RuntimeError):
@@ -192,6 +195,13 @@ class JobEngine:
         # manager when --warm-pool-size enables the pool; None keeps the
         # historical cold-create-only path byte-identical
         self.warm_pool: Optional[Any] = None
+        # cluster scheduler (engine/scheduler.py): wired by the manager
+        # when --scheduler-enabled builds one.  When set, pod creation is
+        # gated on gang admission (the job's whole member set reserves
+        # node capacity atomically or not at all) and every created pod
+        # is bound to its reserved node; None bypasses every seam — the
+        # pre-scheduler engine, byte-identical
+        self.scheduler: Optional[Any] = None
         # claim token -> (expectation key, job key): a warm claim raises
         # the same ledger entry a create would, and is settled by the
         # informer-delivered MODIFIED event carrying the token — exactly
@@ -531,6 +541,10 @@ class JobEngine:
         self._rv_seen.pop(job_key, None)
         self._exp_keys.pop(job_key, None)
         self._drop_pending_claims(job_key)
+        if self.scheduler is not None:
+            # a deleted job's reservation (or pending entry) must not hold
+            # capacity — release by key: the UID died with the object
+            self.scheduler.release_key(job_key)
 
     def _track_exp_key(self, job_key: str, key: str) -> None:
         self._exp_keys.setdefault(job_key, set()).add(key)
@@ -616,6 +630,8 @@ class JobEngine:
             self._delete_pods_and_services(job, pods, services)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
+            if self.scheduler is not None:
+                self.scheduler.release(job.uid)
             res = self._cleanup_job_ttl(job)
             self._write_status(job, old_status)
             return res
@@ -631,6 +647,9 @@ class JobEngine:
             self._delete_pods_and_services(job, pods, services, force_all=True)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
+            if self.scheduler is not None:
+                # a suspended gang holds no capacity; resume re-admits
+                self.scheduler.release(job.uid)
             # counts describe live pods only; the ExitCode restart counter is
             # history and survives suspension, and the selector must too —
             # /scale's labelSelectorPath reads it while suspended
@@ -679,6 +698,8 @@ class JobEngine:
             self._delete_pods_and_services(job, pods, services, force_all=True)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
+            if self.scheduler is not None:
+                self.scheduler.release(job.uid)
             self.cluster.record_event(
                 job.to_dict(), "Normal", REASON_FAILED, failure_message
             )
@@ -694,6 +715,19 @@ class JobEngine:
             with self._phase("gang_sync"):
                 self._sync_pod_group(job)
 
+        # ----- cluster-scheduler gang admission (engine/scheduler.py):
+        # the job's whole member set reserves node capacity atomically or
+        # not at all.  Admission gates CREATION only — deletes, exit-code
+        # restarts, and status counting below still run for an unadmitted
+        # job (a preempted gang must finish its delete-for-recreate and
+        # keep exact restart counters while it waits for capacity).
+        gang_admitted = True
+        if self.scheduler is not None:
+            with self._phase("gang_admission"):
+                gang_admitted = self._sync_gang_admission(
+                    job, status, pods, now_iso
+                )
+
         # ----- per replica type: pods + services. API errors (e.g. 409 on a
         # name held by a dying pod of an older incarnation) abort this sync
         # with an error result — controller-runtime style requeue-on-error —
@@ -707,7 +741,7 @@ class JobEngine:
                 with self._phase("pod_reconcile", replica_type=rtype):
                     backoff_left = self.reconcile_pods(
                         job, status, pods, rtype, spec, replicas, now_iso,
-                        restarted_types,
+                        restarted_types, may_create=gang_admitted,
                     )
                 if backoff_left:
                     requeue_candidates.append(backoff_left)
@@ -756,8 +790,96 @@ class JobEngine:
         if ads is not None and status.start_time is not None:
             remaining = epoch_from_iso(status.start_time) + ads - self.clock()
             requeue_candidates.append(max(0.0, remaining))
+        if not gang_admitted:
+            # pending gang: retry admission without waiting for the next
+            # object event (capacity frees when other gangs finish)
+            requeue_candidates.append(self.scheduler.retry_interval)
         requeue = min(requeue_candidates) if requeue_candidates else None
         return ReconcileResult(requeue_after=requeue)
+
+    # -------------------------------------------------------- gang admission
+    def _gang_members(self, job: Job) -> Dict[str, int]:
+        """The gang: every replica pod name the current spec implies,
+        mapped to its chip demand (slice shape of its type's template —
+        the same annotation the warm pool routes on)."""
+        members: Dict[str, int] = {}
+        for rtype, spec in (job.replica_specs or {}).items():
+            chips = cluster_scheduler.chips_of_shape(
+                warmpool.slice_shape_of(spec.template)
+            )
+            for index in range(spec.replicas or 0):
+                members[self.gen_general_name(job.name, rtype, index)] = chips
+        return members
+
+    def _sync_gang_admission(
+        self,
+        job: Job,
+        status: common.JobStatus,
+        pods: List[Dict[str, Any]],
+        now_iso: str,
+    ) -> bool:
+        """Admit (or re-assert) the job's gang with the cluster scheduler.
+        Live pods' placements are handed in as `existing` so admission
+        adopts physical reality (restart resync, warm-claimed pods on
+        standby nodes) instead of re-placing anything.  Not-admitted
+        stamps the Scheduling condition + a GangPending event (once per
+        message change); admission clears it with a GangScheduled event."""
+        members = self._gang_members(job)
+        existing: Dict[str, str] = {}
+        pod_names: Dict[str, str] = {}
+        for pod in pods:
+            ann = (pod.get("metadata") or {}).get("annotations") or {}
+            # a warm-claimed pod keeps its standby NAME — the member
+            # identity the gang knows it by rides the late-binding
+            # annotation (filtering on the pod name would orphan the
+            # member from its own reservation)
+            member = (
+                ann.get(warmpool.WARM_BOUND_NAME_ANNOTATION)
+                or objects.name_of(pod)
+            )
+            if member not in members or not objects.is_pod_active(pod):
+                continue
+            if member != objects.name_of(pod):
+                pod_names[member] = objects.name_of(pod)
+            node = ann.get(
+                cluster_scheduler.ASSIGNED_NODE_ANNOTATION
+            ) or objects.pod_node(pod)
+            if node:
+                existing[member] = node
+        admitted, msg = self.scheduler.admit(
+            job_key=job.key,
+            job_uid=job.uid,
+            kind=self.adapter.KIND,
+            namespace=job.namespace,
+            members=members,
+            priority=cluster_scheduler.priority_of(job),
+            existing=existing,
+            throughput=cluster_scheduler.throughput_ratios_of(job),
+            pod_names=pod_names,
+        )
+        prev = common.get_condition(status, common.JOB_SCHEDULING)
+        if admitted:
+            if prev is not None and prev.status == "True":
+                done = f"gang admitted: {len(members)} replica(s) bound"
+                common.demote_condition(
+                    status, common.JOB_SCHEDULING, now_iso,
+                    reason=REASON_GANG_SCHEDULED, message=done,
+                )
+                self.cluster.record_event(
+                    job.to_dict(), "Normal", REASON_GANG_SCHEDULED, done
+                )
+            return True
+        # the event fires once per pending transition or message change,
+        # not once per sync — a gang parked for an hour is one event, but
+        # a shortfall that changes shape is worth a fresh line
+        if prev is None or prev.status != "True" or prev.message != msg:
+            self.cluster.record_event(
+                job.to_dict(), "Normal", REASON_GANG_PENDING, msg
+            )
+        common.update_job_conditions(
+            status, common.JOB_SCHEDULING, REASON_GANG_PENDING, msg, now_iso
+        )
+        return False
 
     # ------------------------------------------------------------- pods
     def reconcile_pods(
@@ -770,12 +892,18 @@ class JobEngine:
         replicas: Dict[str, common.ReplicaSpec],
         now_iso: str,
         restarted_types: Optional[set] = None,
+        may_create: bool = True,
     ) -> Optional[float]:
         """Per-replica-type pod reconciliation: create missing indices, delete
         out-of-range (dynamic scale down), exit-code restart handling, replica
         status counting (reference tfjob_controller.go:644-740). Types whose
         pods were deleted-for-restart this sync are added to
         `restarted_types` for the status rules.
+
+        `may_create=False` (gang not admitted by the cluster scheduler)
+        skips ONLY the create-missing-pod branch: deletes, restarts, and
+        counting run regardless, so a capacity-starved job still converges
+        its teardown half and keeps exact restart accounting.
 
         Returns the remaining crash-loop backoff when pod creation was
         deferred by it (the caller requeues for that instant), else None."""
@@ -810,6 +938,11 @@ class JobEngine:
             if len(pod_slice) > 1:
                 continue  # too many pods for index; wait for deletion to settle
             if len(pod_slice) == 0:
+                if not may_create:
+                    # gang not admitted: the scheduler holds no capacity
+                    # for this member yet — creation waits (the sync-level
+                    # requeue retries admission), everything else proceeds
+                    continue
                 if backoff_left > 0.0:
                     # mid-backoff after a delete-for-recreate: a flapping
                     # replica must not hot-loop pod churn — recreation waits
@@ -1064,14 +1197,29 @@ class JobEngine:
         controller_ref = objects.owner_reference(
             {"apiVersion": job.api_version, "kind": job.kind, "metadata": job.metadata}
         )
+        # cluster scheduler: the member was reserved a node at gang
+        # admission — bind the pod to it at create (spec.nodeName) and
+        # stamp the reservation into an annotation so a restarted
+        # operator's resync rebuilds placements from the pods themselves
+        planned_node = None
+        if self.scheduler is not None:
+            planned_node = self.scheduler.planned_node(job.uid, meta["name"])
+            if planned_node is not None:
+                meta.setdefault("annotations", {})[
+                    cluster_scheduler.ASSIGNED_NODE_ANNOTATION
+                ] = planned_node
+                template.setdefault("spec", {})["nodeName"] = planned_node
         # warm-pool fast path: claim a pre-provisioned standby pod of the
         # template's slice shape before paying a cold create.  The claim
         # reuses the expectation raised above (settled by the claim's own
         # MODIFIED event); a miss falls straight through to the cold
-        # create with the ledger untouched in between.
+        # create with the ledger untouched in between.  The reserved node
+        # rides along as a speculative placement hint: a standby already
+        # sitting on the gang's node is preferred, and a claim that lands
+        # elsewhere rebinds the reservation to where the pod really is.
         if self.warm_pool is not None and self._claim_warm_pod(
             job, rtype, index, template, dict(meta.get("labels", {})), key,
-            controller_ref,
+            controller_ref, node_hint=planned_node,
         ):
             return
         try:
@@ -1093,6 +1241,7 @@ class JobEngine:
         labels: Dict[str, str],
         exp_key: str,
         controller_ref: Dict[str, Any],
+        node_hint: Optional[str] = None,
     ) -> bool:
         """Try to serve this replica from the warm pool.  Returns True when
         a standby pod was claimed (the replica exists; no create needed).
@@ -1137,6 +1286,10 @@ class JobEngine:
                 # to Never): pod spec is immutable, so only a policy-equal
                 # standby may serve this replica
                 restart_policy=spec.get("restartPolicy"),
+                # speculative placement: prefer a standby already sitting
+                # on the gang's reserved node (scheduler hint); any ready
+                # standby still beats a cold create
+                node_hint=node_hint,
             )
         except Exception:
             # the claim write failed terminally (e.g. fenced): no event
@@ -1148,6 +1301,16 @@ class JobEngine:
         if claimed is None:
             self._pending_claims.pop(token, None)
             return False
+        if self.scheduler is not None:
+            # the standby's immutable spec pinned its node (and its
+            # NAME): move the member's reservation to where the pod
+            # physically runs, and record the actual pod name so
+            # eviction/drain kill the pod that exists
+            self.scheduler.rebind(
+                job.uid, template["metadata"]["name"],
+                objects.pod_node(claimed) or "",
+                pod_name=objects.name_of(claimed),
+            )
         self.cluster.record_event(
             job.to_dict(), "Normal", "WarmPodClaimed",
             f"claimed warm pod {objects.namespace_of(claimed)}."

@@ -406,6 +406,42 @@ WARM_POOL_REPLENISH = Counter(
     "fan-out, retry ladder under apiserver errors), labeled by shape; "
     "rate tracks the claim rate in steady state",
 )
+# ------------------------------------------------------------- scheduler
+# Cluster scheduler (engine/scheduler.py): gang admission, bin-packing,
+# preemption over the simulated Node inventory — the ISSUE 8 families.
+SCHEDULER_PENDING_GANGS = Gauge(
+    f"{PREFIX}_scheduler_pending_gangs",
+    "Gangs currently waiting for capacity (admission failed, Scheduling "
+    "condition stamped on the job); a persistently nonzero value means "
+    "the cluster is oversubscribed or fragmented",
+)
+SCHEDULER_BINDS = Counter(
+    f"{PREFIX}_scheduler_binds_total",
+    "Gangs admitted: the whole member set atomically reserved node "
+    "capacity, labeled by the scoring policy that placed it",
+)
+SCHEDULER_PREEMPTIONS = Counter(
+    f"{PREFIX}_scheduler_preemptions_total",
+    "Lower-priority gangs evicted (SIGTERM/143, reservation released, "
+    "gang requeued) to admit a higher-priority arrival, labeled by "
+    "policy",
+)
+SCHEDULER_BIND_LATENCY = Histogram(
+    f"{PREFIX}_scheduler_bind_latency_seconds",
+    "Gang admission wait: first failed admission to successful bind "
+    "(0 for gangs admitted on first attempt), labeled by policy — the "
+    "queueing delay capacity pressure imposes",
+    buckets=(0.0, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+             1800.0),
+)
+SCHEDULER_FRAGMENTATION = Gauge(
+    f"{PREFIX}_scheduler_fragmentation_ratio",
+    "1 - (largest contiguous free block / total free chips) over the "
+    "Node inventory: 0 = all free capacity in one slice (a big gang can "
+    "land), toward 1 = free chips are crumbs no large slice fits in; "
+    "`packed` exists to keep this low",
+)
+
 CREATE_TO_RUNNING = Histogram(
     f"{PREFIX}_create_to_running_seconds",
     "Replica-needed to replica-Running latency, labeled by path: cold "

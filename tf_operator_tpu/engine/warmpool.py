@@ -396,6 +396,7 @@ class WarmPoolManager:
         controller_ref: Dict[str, Any],
         fence_token: Optional[str] = None,
         restart_policy: Optional[str] = None,
+        node_hint: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         """Claim one ready warm pod of `shape` for a job replica, or None
         (caller falls back to a cold create).  The claim is ONE update:
@@ -413,6 +414,13 @@ class WarmPoolManager:
         kubelet honoring the wrong policy would restart failed containers
         in place and hide exits from the operator's restart accounting.
 
+        `node_hint` is the cluster scheduler's speculative-placement seam
+        (the member's reserved node): standbys already sitting on the
+        hinted node are tried first — claiming one makes the speculative
+        placement exact — but any ready standby still beats a cold
+        create.  Ordering stays a pure function of pool state + hint, so
+        seeded chaos runs replay identically.
+
         Misses are counted once per reason per call, and only when the
         whole claim falls back cold (docs/monitoring.md: a miss == a
         fallback, so warm_hit_ratio can be read off claims vs misses)."""
@@ -424,6 +432,16 @@ class WarmPoolManager:
             candidates = sorted(
                 name for name, pod in pool.items() if self._is_ready(pod)
             )
+            if node_hint:
+                candidates.sort(
+                    key=lambda name: (
+                        0 if (
+                            (pool.get(name, {}).get("spec") or {})
+                            .get("nodeName") == node_hint
+                        ) else 1,
+                        name,
+                    )
+                )
         miss_reasons = set()
         for name in candidates:
             with self._lock:

@@ -175,6 +175,11 @@ class FaultInjector:
         # must unregister the same callable that was registered)
         self._subs: Dict[str, List[Tuple[Callable, Callable]]] = {}
         self._lock = threading.RLock()
+        # cluster scheduler attach point (engine/scheduler.py): when set,
+        # drain_node evicts gang-reserved pods THROUGH the scheduler (the
+        # whole gang requeues as a unit) before the generic per-node
+        # sweep; None keeps the historical drain byte-identical
+        self.scheduler = None
         if kubelet:
             self.inner.subscribe("Pod", self._kubelet_on_pod)
 
@@ -420,7 +425,12 @@ class FaultInjector:
         pod["status"]["containerStatuses"] = [
             {"name": cname, "state": {"running": {}}, "restartCount": 0}
         ]
-        pod["spec"]["nodeName"] = f"chaos-node-{self._node_rr % self.nodes}"
+        # a pod the scheduler already bound (spec.nodeName stamped at
+        # create) keeps its placement — the kubelet only picks a node for
+        # unscheduled pods, so the historical round-robin (and the seeded
+        # chaos goldens, whose pods are never pre-bound) is unchanged
+        if not pod["spec"].get("nodeName"):
+            pod["spec"]["nodeName"] = f"chaos-node-{self._node_rr % self.nodes}"
         try:
             self.inner.update_pod(pod)
         except (ConflictError, NotFoundError, ApiError):
@@ -509,8 +519,22 @@ class FaultInjector:
 
     def drain_node(self, node: str) -> int:
         """Node drain: every Running pod bound to `node` dies with 137
-        (preemption-class), like a TPU host reclaim."""
+        (preemption-class), like a TPU host reclaim.  With a scheduler
+        attached, gangs holding a reservation on the node are evicted
+        FIRST and as a unit — a TPU slice is unusable partially, so the
+        gang's members on other nodes die too, its reservation is
+        released, and the job re-enters gang admission wholesale; the
+        generic sweep then catches anything unscheduled (warm standbys,
+        legacy pods).  Each kill routes through kill_pod, so the seeded
+        event log carries the node name and every killed pod either way."""
         n = 0
+        if self.scheduler is not None:
+            n += self.scheduler.drain_node(
+                node,
+                kill=lambda ns, name: self.kill_pod(
+                    ns, name, exit_code=137, reason="NodeDrain"
+                ),
+            )
         for pod in self.running_pods():
             if pod.get("spec", {}).get("nodeName") == node:
                 if self.kill_pod(

@@ -124,6 +124,9 @@ KIND_REGISTRY: Dict[str, KindInfo] = {
         "scheduling.x-k8s.io", "v1alpha1", "podgroups"
     ),
     "Lease": KindInfo("coordination.k8s.io", "v1", "leases"),
+    # cluster scheduler's slice inventory (engine/scheduler.py): each Node
+    # models one TPU slice (chip capacity + accelerator generation)
+    "Node": KindInfo("", "v1", "nodes", cluster_scoped=True),
     # kinds the deploy tooling applies (tf_operator_tpu/deploy/cluster.py)
     "Namespace": KindInfo("", "v1", "namespaces", cluster_scoped=True),
     "ServiceAccount": KindInfo("", "v1", "serviceaccounts"),

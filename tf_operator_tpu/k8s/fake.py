@@ -432,6 +432,21 @@ class FakeCluster:
     def list_services(self, namespace=None, selector=None) -> List[Dict[str, Any]]:
         return self.list("Service", namespace, selector)
 
+    # ------------------------------------------------------------- nodes
+    # Node inventory for the cluster scheduler (engine/scheduler.py): each
+    # Node models one TPU slice — chip capacity from its slice shape,
+    # accelerator generation for the heterogeneity-aware policy.  Stored
+    # as ordinary cluster-scoped objects, so the REST façade serves them
+    # at /api/v1/nodes with no special casing.
+    def add_node(self, name: str, shape: str = "v5e-8",
+                 generation: str = "v5e") -> Dict[str, Any]:
+        from tf_operator_tpu.engine.scheduler import make_node  # lazy: cycle
+
+        return self.create("Node", make_node(name, shape, generation))
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        return self.list("Node")
+
     # ------------------------------------------------------------- pod logs
     def append_pod_log(self, namespace: str, name: str, line: str) -> None:
         """Container stdout capture (written by the kubelet simulator; read

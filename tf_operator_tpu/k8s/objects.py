@@ -110,7 +110,7 @@ CLUSTER_SCOPED_KINDS = {
     "Namespace", "CustomResourceDefinition", "ClusterRole",
     "ClusterRoleBinding", "PriorityClass", "StorageClass",
     "ValidatingWebhookConfiguration", "MutatingWebhookConfiguration",
-    "ClusterIssuer",
+    "ClusterIssuer", "Node",
 }
 
 
@@ -155,6 +155,13 @@ def pod_restart_generation(pod: Dict[str, Any]) -> "int | None":
         return int(val)
     except ValueError:
         return None
+
+
+def pod_node(pod: Dict[str, Any]) -> Optional[str]:
+    """The node a pod is bound to (spec.nodeName), or None while unbound.
+    Written by the scheduler at create time for gang-admitted pods, by
+    the chaos kubelet at Running for everything else."""
+    return (pod.get("spec") or {}).get("nodeName") or None
 
 
 def is_pod_active(pod: Dict[str, Any]) -> bool:
