@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool bench-sched native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool bench-sched bench-paged native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -74,6 +74,15 @@ bench-shard:
 bench-warmpool:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_cold_start; \
 	print(json.dumps(bench_cold_start(), indent=1))"
+
+# Paged-KV dense-vs-paged sweep on the tiny llama config: concurrent
+# lanes at a fixed simulated HBM budget (>= 2x is the regression bound
+# asserted in tests/test_bench_infra.py), token parity, shared-prefix
+# admission TTFT (copy vs refcount), CoW + blocks-per-token per row
+# (ISSUE 9 evidence, no TPU required).  Rows land in BENCH_r08.json.
+bench-paged:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_paged; \
+	print(json.dumps(bench_paged(), indent=1))"
 
 # Cluster-scheduler policy sweep: makespan + Jain fairness per
 # bin-packing policy (spread / packed / throughput_ratio) on a mixed

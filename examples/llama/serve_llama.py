@@ -71,6 +71,15 @@ def main(argv=None) -> int:
                          "prefilled ONCE (prefix caching); with "
                          "--prefill-chunk its token length must be a "
                          "chunk multiple")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV cache: block-pool attention with "
+                         "memory-gated admission and copy-free prefix "
+                         "sharing (models/paging.py)")
+    ap.add_argument("--block-size", type=int, default=64,
+                    help="KV block size in tokens (with --paged)")
+    ap.add_argument("--pool-blocks", type=int, default=0,
+                    help="block-pool capacity; 0 = dense-equivalent "
+                         "default (every lane can hold the worst case)")
     ap.add_argument("--prefill-chunk", type=int, default=0)
     ap.add_argument("--prefill-chunks-per-sync", type=int, default=0,
                     help="admission-stall bound: stream at most this "
@@ -146,6 +155,13 @@ def main(argv=None) -> int:
                   spec_k=args.spec_k)
         print(f"speculative serving: {d_model.cfg.n_layers}-layer "
               f"draft, k={args.spec_k}")
+
+    if args.paged:
+        kw.update(paged=True, block_size=args.block_size)
+        if args.pool_blocks:
+            kw["pool_blocks"] = args.pool_blocks
+        print(f"paged KV cache: block_size={args.block_size}, "
+              f"pool_blocks={args.pool_blocks or 'auto'}")
 
     t0 = time.perf_counter()
     results = serve_loop(model, params, requests, slots=args.slots,

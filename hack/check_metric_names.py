@@ -28,6 +28,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# Capacity gauges: `<unit>s_total` reading as "how many exist" (a level
+# set once per run, not a monotonic count) is allowed for exactly these
+# names — the paged-KV pool capacity, whose used/total ratio is the
+# dashboards' block-occupancy formula.  Anything else ending in _total
+# still fails: a gauge that COUNTS should be a Counter.
+_CAPACITY_GAUGES = {"tpu_operator_serving_kv_blocks_total"}
+
+
 def check_registry() -> list:
     from tf_operator_tpu.engine import metrics as em
 
@@ -51,10 +59,12 @@ def check_registry() -> list:
                 f"_ops (the units this codebase records; _ops covers "
                 f"count-valued distributions like fan-out batch sizes)")
         if m.TYPE == "gauge":
-            if m.name.endswith("_total"):
+            if (m.name.endswith("_total")
+                    and m.name not in _CAPACITY_GAUGES):
                 errors.append(
                     f"{where}: a gauge must not end in _total — a "
-                    f"monotonic count should be a Counter")
+                    f"monotonic count should be a Counter (capacity "
+                    f"levels may be allowlisted in _CAPACITY_GAUGES)")
             # gauges may be unitless (occupancy, leader flag) but a
             # trailing pseudo-unit that is not a real unit is a typo
             for bad in ("_second", "_byte", "_secs", "_ms"):

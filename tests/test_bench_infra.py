@@ -354,6 +354,53 @@ def test_bench_speculative_path_runs_on_tiny_config():
         assert row["tokens_per_target_forward"] > 1.5, (kk, row)
 
 
+def test_bench_paged_bounds_hold_on_tiny_config():
+    """BENCH_r08's regression bounds, pinned so the artifact can't
+    silently rot: at a fixed simulated HBM budget the paged arm must
+    sustain >= 2x dense's concurrent lanes (deterministic allocator
+    arithmetic, not timing), tokens must be dense==paged identical on
+    both arms, the gated pool must never exceed the budget, and the
+    per-row blocks/CoW accounting must be present and consistent."""
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama
+
+    r = bench.bench_paged(
+        "cpu", cfg=llama.tiny(dtype=jnp.float32, max_len=128),
+        n_requests=5, max_new=6, block_size=4, steps_per_sync=4,
+        prefix_len=18, warm=False)  # 18 % 4 != 0 -> CoW on the path
+    assert r["token_parity_dense_vs_paged"] is True
+    assert r["lanes_ratio"] >= 2.0
+    assert (r["paged"]["concurrent_lanes"]
+            >= 2 * r["dense"]["concurrent_lanes"])
+    # the whole device allocation (scratch included) fits the budget —
+    # not just the blocks in use
+    assert r["paged"]["pool_alloc_bytes"] <= r["hbm_budget_bytes"]
+    assert (r["paged"]["peak_pool_bytes"]
+            <= r["paged"]["pool_alloc_bytes"])
+    assert r["paged"]["admissions_blocked_on_memory"] >= 0
+    assert r["paged"]["blocks_per_token"] > 0
+    assert len(r["paged"]["per_request_kv_blocks"]) == 5
+    assert all(b > 0 for b in r["paged"]["per_request_kv_blocks"])
+    # the prefix arm: exact tokens, refcount reuse counted, CoW on the
+    # unaligned boundary (18 % 4 != 0 -> one copy per admission)
+    p = r["prefix"]
+    assert p["token_parity"] is True
+    assert p["prefix_block_hits"] > 0
+    assert p["cow_copies"] == 4  # one boundary copy per admission
+    assert p["dense_ttft_mean_s"] > 0 and p["paged_ttft_mean_s"] > 0
+    # the admission-cost decomposition must be REPORTED (that is the
+    # artifact's TTFT claim — dense copies+scatters the whole row
+    # cache, aligned paged admission is bookkeeping, measured ~4x on an
+    # idle box) but NOT ratio-asserted: both sides are wall-clock
+    # micro-timings and the ratio flakes under CI load.  The
+    # deterministic bounds above (lanes, parity, allocation, CoW
+    # counts) are the regression gate.
+    for k in ("admission_dense_copy_us", "admission_paged_refcount_us",
+              "admission_paged_cow_us", "admission_speedup_vs_dense"):
+        assert p[k] > 0, k
+
+
 def test_bench_llama_decode_batch_sweep_tiny():
     """The batch-sweep branch: result reuse for the headline batch,
     fresh-prompt points for the others, mode markers on every entry."""

@@ -1,0 +1,362 @@
+"""Paged KV cache (models/paging.py + serve_loop paged=True): allocator
+properties under churn, dense-vs-paged token parity across the serving
+feature matrix, copy-on-write byte preservation, and memory-gated
+admission (pool exhaustion queues instead of OOMing)."""
+import dataclasses
+import random as pyrandom
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import llama, paging, quant
+from tf_operator_tpu.models.serving import serve_loop
+
+
+def _f32(**kw):
+    kw.setdefault("dtype", jnp.float32)
+    return llama.tiny(**kw)
+
+
+def _setup(seed=0, **cfg_kw):
+    cfg = _f32(**cfg_kw)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    return cfg, model, params
+
+
+def _prompts(cfg, lengths, seed=1):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for n in lengths:
+        key, k = jax.random.split(key)
+        out.append(jax.random.randint(k, (n,), 0, cfg.vocab_size))
+    return out
+
+
+def _draft_setup(cfg, seed=9):
+    d_cfg = dataclasses.replace(cfg, n_layers=1)
+    d_model = llama.Llama(d_cfg)
+    d_params = d_model.init(jax.random.PRNGKey(seed),
+                            jnp.zeros((1, 8), jnp.int32),
+                            train=False)["params"]
+    return d_model, d_params
+
+
+# ------------------------------------------------------------- allocator
+def test_allocator_never_exceeds_capacity_and_free_list_exact():
+    """Seeded admit/finish churn: used <= capacity at every step, every
+    handed-out id is in [1, N] and never aliased between live owners,
+    and after all frees the free list is exactly the full pool again."""
+    rnd = pyrandom.Random(42)
+    pool = paging.BlockPool(num_blocks=24, block_size=8)
+    live = []  # lists of owned ids
+    for _ in range(500):
+        if live and (rnd.random() < 0.4 or not pool.can_alloc(1)):
+            ids = live.pop(rnd.randrange(len(live)))
+            pool.decref(ids)
+        else:
+            n = rnd.randint(1, 5)
+            if not pool.can_alloc(n):
+                continue
+            ids = pool.alloc(n)
+            assert all(1 <= b <= 24 for b in ids)
+            assert paging.SCRATCH_BLOCK not in ids
+            live.append(ids)
+        owned = [b for ids in live for b in ids]
+        assert len(owned) == len(set(owned))  # no aliasing
+        assert pool.used == len(owned) <= pool.num_blocks
+        assert pool.used + pool.free_blocks == pool.num_blocks
+    for ids in live:
+        pool.decref(ids)
+    assert pool.used == 0
+    assert sorted(pool._free) == list(range(1, 25))
+
+
+def test_allocator_refcounts_free_exactly_once():
+    pool = paging.BlockPool(num_blocks=4, block_size=8)
+    ids = pool.alloc(2)
+    pool.incref(ids)          # a second "lane" shares them
+    pool.decref(ids)          # first lane leaves: still held
+    assert pool.used == 2
+    pool.decref(ids)          # second lane leaves: freed NOW
+    assert pool.used == 0
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.decref(ids)      # a third decref must not resurrect
+    with pytest.raises(RuntimeError, match="incref"):
+        pool.incref(ids)      # nor may a free block be re-shared
+    assert pool.free_blocks == 4
+
+
+def test_allocator_exhaustion_and_validation():
+    pool = paging.BlockPool(num_blocks=2, block_size=4)
+    assert pool.can_alloc(2) and not pool.can_alloc(3)
+    pool.alloc(2)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc(1)
+    with pytest.raises(ValueError):
+        paging.BlockPool(num_blocks=0, block_size=4)
+    with pytest.raises(ValueError):
+        paging.BlockPool(num_blocks=2, block_size=0)
+    assert paging.blocks_for(1, 4) == 1
+    assert paging.blocks_for(4, 4) == 1
+    assert paging.blocks_for(5, 4) == 2
+
+
+def test_plan_request_block_math():
+    # no prefix: everything private, no CoW
+    assert paging.plan_request(10, 6, 0, 4) == (4, 0, 4, False)
+    # block-aligned prefix: shared blocks, no CoW
+    assert paging.plan_request(12, 4, 0, 4, prefix_len=8) == (4, 2, 2, False)
+    # partial boundary: the straddling block is private via CoW
+    assert paging.plan_request(12, 4, 0, 4, prefix_len=10) == (4, 2, 2, True)
+    # speculation headroom extends the worst case
+    assert paging.plan_request(10, 6, 3, 4) == (5, 0, 5, False)
+
+
+def test_cow_preserves_prefix_bytes():
+    """copy_block must copy the boundary block's K/V bytes exactly, and
+    the shared source block must be bit-unchanged after a full paged
+    serve with CoW admissions."""
+    cfg, model, params = _setup(max_len=128)
+    pool_dev = paging.init_block_pool(cfg, num_blocks=4, block_size=4)
+    # scribble a recognizable payload into block 1, then CoW it to 2
+    k0 = pool_dev[0][0].at[1].set(7.5)
+    pool_dev[0] = (k0, pool_dev[0][1])
+    copied = paging.copy_block(pool_dev, jnp.int32(1), jnp.int32(2))
+    np.testing.assert_array_equal(np.asarray(copied[0][0][2]),
+                                  np.full((4, cfg.n_kv_heads,
+                                           cfg.head_dim), 7.5))
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_cow_serve_keeps_shared_block_read_only(kv_quant):
+    """Unaligned shared prefix (CoW per admission): outputs oracle-exact
+    AND every admission observed the same prefix bytes — if a lane wrote
+    through into the shared boundary block, later admissions would
+    diverge from the dense oracle.  Runs the matrix over bf16 AND int8
+    pools: copy_block's tree_map must copy a QTensor boundary block's
+    payload and scales alike."""
+    cfg, model, params = _setup(max_len=256)
+    pfx = _prompts(cfg, [10], seed=3)[0]   # 10 % 4 != 0 -> CoW
+    sufs = _prompts(cfg, [5, 9, 3, 7, 6], seed=4)
+    dense = serve_loop(model, params, sufs, slots=2, max_new_tokens=8,
+                       shared_prefix=pfx, kv_quant=kv_quant)
+    paged, st = serve_loop(model, params, sufs, slots=2,
+                           max_new_tokens=8, shared_prefix=pfx,
+                           paged=True, block_size=4, kv_quant=kv_quant,
+                           return_stats=True)
+    assert [r.tokens for r in dense] == [r.tokens for r in paged]
+    assert st.cow_copies == len(sufs)       # one boundary copy per lane
+    assert st.prefix_block_hits == 2 * len(sufs)  # 10 // 4 shared blocks
+
+
+# ------------------------------------------------- dense-vs-paged parity
+def _spec_kw(cfg):
+    d_model, d_params = _draft_setup(cfg)
+    return dict(draft=d_model, draft_params=d_params, spec_k=3,
+                steps_per_sync=2)
+
+
+@pytest.mark.parametrize("config", [
+    "plain", "chunked_prefill", "chunked_prefill_throttled",
+    "shared_prefix", "int8_kv", "speculative",
+])
+def test_dense_vs_paged_token_parity(config):
+    """THE correctness bar: paged serve_loop output tokens are identical
+    to dense serve_loop for the same requests/seed, across the serving
+    configurations.  The throttled entry (prefill_chunks_per_sync) is
+    the one where a PENDING lane stays frozen across decode blocks
+    interleaved with its own streaming prefill — its table must stay
+    scratch until activation or those blocks stamp garbage through it
+    (the bug this entry was added to pin)."""
+    cfg, model, params = _setup(max_len=256)
+    lens = [6, 11, 3, 9, 7, 5]
+    kw = dict(slots=2, max_new_tokens=10)
+    p_use = params
+    if config == "chunked_prefill":
+        lens = [40, 22, 33, 9]
+        kw.update(prefill_chunk=8)
+    elif config == "chunked_prefill_throttled":
+        lens = [40, 6, 33, 9, 12]
+        kw.update(prefill_chunk=8, prefill_chunks_per_sync=1,
+                  steps_per_sync=2)
+    elif config == "shared_prefix":
+        kw.update(shared_prefix=_prompts(cfg, [8], seed=3)[0])
+    elif config == "int8_kv":
+        p_use = quant.quantize_params(params)
+        kw.update(params_transform=quant.make_dequantizer(cfg.dtype),
+                  kv_quant=True)
+    elif config == "speculative":
+        kw.update(_spec_kw(cfg))
+    prompts = _prompts(cfg, lens)
+    dense = serve_loop(model, p_use, prompts, **kw)
+    paged = serve_loop(model, p_use, prompts, paged=True, block_size=4,
+                       **kw)
+    assert [r.tokens for r in dense] == [r.tokens for r in paged], config
+    # paged rows report their block footprint; dense rows report 0
+    assert all(r.kv_blocks > 0 for r in paged)
+    assert all(r.kv_blocks == 0 for r in dense)
+
+
+def test_paged_full_stack_composition():
+    """Prefix sharing + chunked streaming + int8 weights/KV +
+    speculation, all through blocks at once — oracle-exact."""
+    cfg, model, params = _setup(max_len=256)
+    qp = quant.quantize_params(params)
+    dq = quant.make_dequantizer(cfg.dtype)
+    d_model, d_params = _draft_setup(cfg)
+    pfx = _prompts(cfg, [8], seed=5)[0]
+    sufs = _prompts(cfg, [6, 9, 4], seed=6)
+    kw = dict(slots=2, max_new_tokens=8, shared_prefix=pfx,
+              prefill_chunk=8, prefill_chunks_per_sync=1, kv_quant=True,
+              params_transform=dq,
+              draft=d_model, draft_params=quant.quantize_params(d_params),
+              draft_transform=dq, spec_k=2, steps_per_sync=2)
+    dense = serve_loop(model, qp, sufs, **kw)
+    paged = serve_loop(model, qp, sufs, paged=True, block_size=4, **kw)
+    assert [r.tokens for r in dense] == [r.tokens for r in paged]
+
+
+def test_paged_sampling_seed_deterministic():
+    cfg, model, params = _setup(max_len=128)
+    prompts = _prompts(cfg, [6, 8], seed=11)
+    kw = dict(slots=2, max_new_tokens=8, temperature=0.8, top_k=20,
+              paged=True, block_size=4)
+    a = serve_loop(model, params, prompts, rng=jax.random.PRNGKey(1), **kw)
+    b = serve_loop(model, params, prompts, rng=jax.random.PRNGKey(1), **kw)
+    assert [r.tokens for r in a] == [r.tokens for r in b]
+    assert all(0 <= t < cfg.vocab_size for r in a for t in r.tokens)
+
+
+def test_paged_block_size_is_scheduling_not_semantics():
+    """Like steps_per_sync: the block size changes memory layout only —
+    tokens identical across block sizes (and equal to dense)."""
+    cfg, model, params = _setup(max_len=128)
+    prompts = _prompts(cfg, [6, 9, 4, 7], seed=13)
+    base = serve_loop(model, params, prompts, slots=2, max_new_tokens=10)
+    for bs in (2, 4, 16):
+        got = serve_loop(model, params, prompts, slots=2,
+                         max_new_tokens=10, paged=True, block_size=bs)
+        assert [r.tokens for r in got] == [r.tokens for r in base], bs
+
+
+# ---------------------------------------------------- memory-gated admission
+def test_memory_gate_queues_instead_of_oom():
+    """A pool too small for all lanes at once: admissions wait at the
+    queue head (FIFO), every request still completes oracle-exactly,
+    the blocked counter ticks, and the queue-wait histogram moves."""
+    from tf_operator_tpu.engine import metrics as em
+
+    cfg, model, params = _setup(max_len=128)
+    prompts = _prompts(cfg, [8, 8, 8, 8], seed=5)
+    dense = serve_loop(model, params, prompts, slots=4, max_new_tokens=8)
+    qw_before = em.SERVING_QUEUE_WAIT.count()
+    # each request needs ceil((8+8)/4) = 4 blocks; 5 usable blocks
+    # => exactly one lane lives at a time
+    paged, st = serve_loop(model, params, prompts, slots=4,
+                           max_new_tokens=8, paged=True, block_size=4,
+                           pool_blocks=5, return_stats=True)
+    assert [r.tokens for r in dense] == [r.tokens for r in paged]
+    assert st.admissions_blocked_on_memory > 0
+    assert st.occupancy_max == 1          # gate held concurrency to 1
+    assert st.kv_blocks_peak_used <= 5    # never exceeded the pool
+    assert em.SERVING_QUEUE_WAIT.count() - qw_before == len(prompts)
+    # later admissions genuinely waited on memory, not just lane churn
+    waits = [r["queue_wait_s"] for r in st.per_request]
+    assert max(waits) > min(waits)
+
+
+def test_memory_gate_is_fifo():
+    """Head-of-line blocking is the policy: a big request at the head
+    is not overtaken by smaller ones behind it."""
+    cfg, model, params = _setup(max_len=256)
+    prompts = _prompts(cfg, [40, 4, 4], seed=7)
+    # 40+8 -> 12 blocks of 4; pool 14: while the big one runs, the
+    # small ones (3 blocks each) wait for it even though slot+blocks
+    # would fit one of them only after its finish
+    res, st = serve_loop(model, params, prompts, slots=2,
+                         max_new_tokens=8, paged=True, block_size=4,
+                         pool_blocks=14, return_stats=True)
+    for r, p in zip(res, prompts):
+        want = llama.generate(model, params, p[None, :], 8)
+        assert r.tokens == [int(t) for t in np.asarray(want[0])]
+    # admission order == request order (FIFO preserved under gating)
+    order = sorted(range(len(res)), key=lambda i: (
+        st.per_request[i]["queue_wait_s"]))
+    assert order == [0, 1, 2]
+
+
+def test_paged_gauges_and_counters_wired():
+    """Registry-level families move under a paged run: blocks gauges,
+    CoW/prefix counters, blocked-admission counter."""
+    from tf_operator_tpu.engine import metrics as em
+
+    cfg, model, params = _setup(max_len=256)
+    pfx = _prompts(cfg, [10], seed=3)[0]
+    sufs = _prompts(cfg, [5, 9, 3], seed=4)
+    cow0 = em.SERVING_KV_BLOCK_COW_COPIES.get()
+    hit0 = em.SERVING_PREFIX_BLOCK_HITS.get()
+    _, st = serve_loop(model, params, sufs, slots=2, max_new_tokens=6,
+                       shared_prefix=pfx, paged=True, block_size=4,
+                       return_stats=True)
+    assert em.SERVING_KV_BLOCK_COW_COPIES.get() - cow0 \
+        == st.cow_copies == len(sufs)
+    assert em.SERVING_PREFIX_BLOCK_HITS.get() - hit0 \
+        == st.prefix_block_hits
+    # capacity gauge was configured; used gauge idles to 0 after exit
+    assert em.SERVING_KV_BLOCKS_TOTAL.get() == st.kv_blocks_total > 0
+    assert em.SERVING_KV_BLOCKS_USED.get() == 0
+    # a subsequent DENSE run clears the capacity gauge — "0 means
+    # dense serving" must hold for the process's next scrape
+    serve_loop(model, params, sufs[:1], slots=1, max_new_tokens=4)
+    assert em.SERVING_KV_BLOCKS_TOTAL.get() == 0
+    assert st.kv_block_occupancy_mean > 0
+    assert st.paged and st.kv_block_size == 4
+
+
+# ------------------------------------------------------------- validation
+def test_paged_validation():
+    cfg, model, params = _setup(max_len=64)
+    p = _prompts(cfg, [6])
+    with pytest.raises(ValueError, match="block_size"):
+        serve_loop(model, params, p, paged=True, block_size=0,
+                   max_new_tokens=4)
+    with pytest.raises(ValueError, match="multiple of.*block_size"):
+        serve_loop(model, params, _prompts(cfg, [40]), paged=True,
+                   block_size=4, prefill_chunk=6, max_new_tokens=4)
+    with pytest.raises(ValueError, match="pool_blocks"):
+        serve_loop(model, params, p, paged=True, block_size=4,
+                   pool_blocks=0, max_new_tokens=4)
+    with pytest.raises(ValueError, match="dense-ring knob"):
+        # cache_len must be refused, not silently dropped: it was the
+        # caller's memory bound
+        serve_loop(model, params, p, paged=True, cache_len=32,
+                   max_new_tokens=4)
+    # infeasible request: the error names the request and the block math
+    with pytest.raises(ValueError,
+                       match=r"request 1: .*needs 12 private blocks"):
+        serve_loop(model, params, _prompts(cfg, [6, 40]), paged=True,
+                   block_size=4, pool_blocks=8, max_new_tokens=8)
+    wcfg, wmodel, wparams = _setup(max_len=256, sliding_window=32)
+    with pytest.raises(ValueError, match="sliding-window"):
+        serve_loop(wmodel, wparams, _prompts(wcfg, [6]), paged=True,
+                   max_new_tokens=4)
+    with pytest.raises(ValueError, match="cache_sharding"):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("tp",))
+        sh = NamedSharding(mesh, PartitionSpec(None))
+        serve_loop(model, params, p, paged=True, cache_sharding=sh,
+                   max_new_tokens=4)
+
+
+def test_dense_longest_prompt_error_names_request():
+    """The small-fix satellite: the full-causal cannot-stream error
+    names the offending request index, not just 'longest prompt'."""
+    cfg, model, params = _setup(max_len=64)
+    with pytest.raises(ValueError, match="request 1: prompt 40"):
+        serve_loop(model, params, _prompts(cfg, [10, 40]), cache_len=16,
+                   max_new_tokens=4)

@@ -584,3 +584,42 @@ SERVING_HBM_PEAK = Gauge(
     "memory stats (CPU) no device-labeled sample is ever set and the "
     "family exposes only the default unlabeled 0",
 )
+# Paged-KV families (serve_loop paged=True; models/paging.py).  The
+# *_kv_blocks_total gauge is a CAPACITY (how many blocks the pool was
+# built with — a level, not a running count; the metrics lint carves
+# out this one name from its gauges-must-not-end-_total rule), so
+# used/total is the block-occupancy ratio the router/autoscaler scales
+# on — the real memory signal, where lane occupancy saturates at
+# `slots` long before HBM does.
+SERVING_KV_BLOCKS_TOTAL = Gauge(
+    f"{PREFIX}_serving_kv_blocks_total",
+    "KV block-pool capacity (usable blocks; scratch excluded) of the "
+    "serving process's paged cache — a capacity level, set at serve "
+    "start; 0 means dense (unpaged) serving",
+)
+SERVING_KV_BLOCKS_USED = Gauge(
+    f"{PREFIX}_serving_kv_blocks_used",
+    "KV blocks currently allocated to live lanes and shared prefixes, "
+    "sampled at every decode block — used/total is the block-level "
+    "occupancy the autoscaler should scale on (lane occupancy "
+    "saturates at `slots` long before memory does)",
+)
+SERVING_KV_BLOCK_COW_COPIES = Counter(
+    f"{PREFIX}_serving_kv_block_cow_copies_total",
+    "Copy-on-write block copies at admission: a shared prefix whose "
+    "length is not a block multiple copies exactly its boundary block "
+    "per lane (one block, not the dense path's whole-cache copy)",
+)
+SERVING_PREFIX_BLOCK_HITS = Counter(
+    f"{PREFIX}_serving_prefix_block_hits_total",
+    "Shared-prefix blocks reused by reference at admission instead of "
+    "being re-prefilled or copied — each hit is one block of KV the "
+    "admission did not have to produce",
+)
+SERVING_ADMISSION_BLOCKED = Counter(
+    f"{PREFIX}_serving_admission_blocked_on_memory_total",
+    "Admissions deferred by the memory gate: a decode lane was free and "
+    "a request was queued, but the block pool could not cover the "
+    "request's worst case — the request waits instead of OOMing "
+    "(sampled once per serve-loop iteration while blocked)",
+)

@@ -365,7 +365,8 @@ class GqaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, angles, cache=None, pos=None, wrap_write=False):
+    def __call__(self, x, angles, cache=None, pos=None, wrap_write=False,
+                 block_table=None):
         cfg = self.cfg
         dense = functools.partial(
             nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
@@ -379,11 +380,38 @@ class GqaAttention(nn.Module):
         if cache is not None:
             k_cache, v_cache = cache
             l = x.shape[1]
-            k_cache = _cache_write(k_cache, k, pos, wrap_write)
-            v_cache = _cache_write(v_cache, v, pos, wrap_write)
             steps = jnp.arange(l, dtype=jnp.int32)
             q_pos = (pos[:, None] + steps
                      if getattr(pos, "ndim", 0) == 1 else pos + steps)
+            if block_table is not None:
+                # PAGED path (models/paging.py): the cache leaves are
+                # block pools [N, bs, KV, D]; writes scatter through the
+                # lane tables and attention runs on the table-gathered
+                # linear view — position masking is unchanged, which is
+                # the dense-parity argument (serving.serve_loop paged=)
+                if cfg.sliding_window is not None:
+                    # fail loudly at the mechanism's own depth (the
+                    # attention_fn convention below): a linear block
+                    # table has no modular seam, and silently attending
+                    # the full context would be wrong, not approximate
+                    raise ValueError(
+                        f"paged decode does not support sliding_window "
+                        f"{cfg.sliding_window} — use the dense ring")
+                from tf_operator_tpu.models import paging as _paging
+
+                k_cache = _paging.paged_cache_write(k_cache, k, pos,
+                                                    block_table)
+                v_cache = _paging.paged_cache_write(v_cache, v, pos,
+                                                    block_table)
+                k_lin = _paging.gather_blocks(k_cache, block_table)
+                v_lin = _paging.gather_blocks(v_cache, block_table)
+                out = _cached_attention(q, k_lin, v_lin, q_pos,
+                                        k_lin.shape[1], window=None)
+                proj = dense(features=cfg.d_model, axis=(-2, -1),
+                             name="out")
+                return proj(out), (k_cache, v_cache)
+            k_cache = _cache_write(k_cache, k, pos, wrap_write)
+            v_cache = _cache_write(v_cache, v, pos, wrap_write)
             out = _cached_attention(q, k_cache, v_cache, q_pos,
                                     k_cache.shape[1],
                                     window=cfg.sliding_window)
@@ -516,7 +544,8 @@ class LlamaBlock(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, angles, cache=None, pos=None, wrap_write=False):
+    def __call__(self, x, angles, cache=None, pos=None, wrap_write=False,
+                 block_table=None):
         cfg = self.cfg
         norm = functools.partial(
             nn.RMSNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype
@@ -526,7 +555,7 @@ class LlamaBlock(nn.Module):
                else SwiGlu(cfg, name="mlp"))
         if cache is not None:
             a, cache = attn(norm(name="ln1")(x), angles, cache, pos,
-                            wrap_write)
+                            wrap_write, block_table)
             x = x + a
             h = norm(name="ln2")(x)
             y = mlp(h, decode=True) if self.use_moe else mlp(h)
@@ -545,7 +574,7 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
                  positions=None, cache=None, cache_pos=None,
-                 wrap_cache_write: bool = False):
+                 wrap_cache_write: bool = False, block_table=None):
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
@@ -557,7 +586,10 @@ class Llama(nn.Module):
             # cache: per-layer (k, v) tuples (init_cache); cache_pos is the
             # global position of tokens[:, 0] — rotation follows it.  A
             # VECTOR cache_pos [B] gives each row its own position
-            # (continuous batching / per-row speculative verify)
+            # (continuous batching / per-row speculative verify).  With
+            # block_table set, the leaves are block POOLS
+            # (paging.init_block_pool) and the table routes each row's
+            # positions to its blocks — paged continuous batching
             if getattr(cache_pos, "ndim", 0) == 1:
                 steps = jnp.arange(tokens.shape[1], dtype=jnp.int32)
                 angles = table[cache_pos[:, None] + steps]  # [B, L, D/2]
@@ -577,7 +609,7 @@ class Llama(nn.Module):
             blk = block(cfg, use_moe=use_moe, name=f"block{i}")
             if decode:
                 x, layer_cache = blk(x, angles, cache[i], cache_pos,
-                                     wrap_cache_write)
+                                     wrap_cache_write, block_table)
                 new_cache.append(layer_cache)
             else:
                 x = blk(x, angles)

@@ -1,0 +1,270 @@
+"""Paged KV cache — block-pool attention storage for continuous batching.
+
+The dense serve path (models/serving.serve_loop over llama.init_cache)
+bills HBM for worst-case length x slots: every lane preallocates
+`cache_len` positions per layer whether or not its request ever uses
+them, and shared-prefix admission is an O(cache bytes) device copy per
+request.  Paging converts both costs into bookkeeping — the vLLM
+design, restated for TPU static shapes:
+
+  - the cache is a fixed pool of BLOCKS (`init_block_pool`): per-layer
+    (k, v) buffers of shape [num_blocks + 1, block_size, KV, D] with a
+    leading block axis.  Block ids are LOGICAL and shared across every
+    layer (and across the draft model under speculation): one host-side
+    allocator (`BlockPool`) hands out ids, and the same id indexes every
+    layer's buffers — allocation is bookkeeping done once, not per
+    layer.
+  - each lane holds a BLOCK TABLE [T] of ids mapping its logical
+    positions to pool blocks: position p lives in block table[p // bs]
+    at offset p % bs.  Tables are allocated in position order, so the
+    gather `pool[table]` reshaped over (block, offset) IS a linear cache
+    of length T*bs — llama's existing position-masked attention runs on
+    it unchanged, which is how paged decode stays token-identical to
+    dense by construction.
+  - block id 0 is a reserved SCRATCH block, never allocated: frozen
+    lanes (and table padding) point every entry at it, so their pinned
+    repeated writes can never land in a block that was freed and handed
+    to another lane — the paged analogue of the dense path's "harmless
+    same-slot write".
+  - shared prefixes are REFCOUNTED read-only blocks: every admission's
+    table starts with the prefix's block ids (an incref, not a copy),
+    and only a partial boundary block (prefix length not a block
+    multiple) is copied — copy-on-write of ONE block instead of the
+    dense path's whole-cache device copy per admission.
+
+Static shapes: the pool, every table, and every write/gather below are
+fixed-shape under jit; the allocator is host-only bookkeeping between
+device dispatches, exactly like the serve loop's slot occupancy.  int8
+KV (models/quant.QTensor pool leaves) composes: writes quantize
+per-(position, head) before the block scatter, reads gather q and scale
+and dequantize into the attention einsum — the same contract as the
+dense ring.  Sliding-window models keep the dense O(window) ring
+(serve_loop refuses paged+window loudly): a linear block table has no
+modular seam, and the ring is already the right memory shape there.
+
+No reference counterpart (the reference has no serving code at all,
+SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# block id 0: reserved scratch target for frozen lanes and table padding
+SCRATCH_BLOCK = 0
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold `tokens` positions (ceil division)."""
+    return -(-tokens // block_size)
+
+
+class BlockPool:
+    """Host-side allocator over `num_blocks` usable block ids (1-based;
+    id 0 is the scratch block and is never handed out).
+
+    Pure bookkeeping: allocation/refcounting happens between device
+    dispatches, and the device pools are indexed by the ids this hands
+    out.  Every id has a refcount — 1 for a lane-private block, +1 per
+    sharing lane for a prefix block — and returns to the free list
+    exactly when its count hits zero.  Double-free and foreign-id
+    misuse raise instead of corrupting the free list: an allocator bug
+    here would silently alias two lanes' KV."""
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # pop() hands out low ids first (1, 2, ...) — deterministic
+        # placement, and the bench's blocks-used telemetry reads as a
+        # compact prefix of the pool
+        self._free = list(range(num_blocks, 0, -1))
+        self._ref = [0] * (num_blocks + 1)
+
+    # ------------------------------------------------------------ state
+    @property
+    def used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    # ------------------------------------------------------- operations
+    def alloc(self, n: int) -> List[int]:
+        """Take n blocks (refcount 1 each); raises if the pool cannot
+        cover them — callers gate admission on can_alloc first."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise RuntimeError(
+                f"pool exhausted: {n} blocks requested, "
+                f"{len(self._free)} free of {self.num_blocks}")
+        ids = [self._free.pop() for _ in range(n)]
+        for b in ids:
+            self._ref[b] = 1
+        return ids
+
+    def incref(self, ids: Sequence[int]) -> None:
+        """Share live blocks (prefix reuse): each id must already be
+        allocated — increffing a free block would resurrect it."""
+        for b in ids:
+            if not 1 <= b <= self.num_blocks or self._ref[b] < 1:
+                raise RuntimeError(
+                    f"incref of unallocated block {b} (ref "
+                    f"{self._ref[b] if 0 <= b <= self.num_blocks else '?'})")
+            self._ref[b] += 1
+
+    def decref(self, ids: Sequence[int]) -> int:
+        """Drop one reference per id; ids whose count hits zero return
+        to the free list (exactly once — a second decref raises).
+        Returns how many blocks were actually freed."""
+        freed = 0
+        for b in ids:
+            if not 1 <= b <= self.num_blocks or self._ref[b] < 1:
+                raise RuntimeError(
+                    f"decref of unallocated block {b} — double free")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+                freed += 1
+        return freed
+
+
+def init_block_pool(cfg, num_blocks: int, block_size: int, dtype=None,
+                    kv_quant: bool = False):
+    """Per-layer (k, v) block pools [num_blocks + 1, block_size, KV, D]
+    (+1: the scratch block at id 0).  Same leaf layout rules as
+    llama.init_cache — bf16/f32 arrays, or QTensor(int8 payload,
+    per-(position, head) f32 scale) leaves under kv_quant — so every
+    cache consumer (scatter insert, tree_map copy, sharding specs)
+    treats pools and rings alike."""
+    shape = (num_blocks + 1, block_size, cfg.n_kv_heads, cfg.head_dim)
+    if kv_quant:
+        if dtype is not None:
+            raise ValueError(
+                "kv_quant and dtype are mutually exclusive: the int8 "
+                "pool's layout is fixed (int8 payload + f32 scales)")
+        from tf_operator_tpu.models.quant import QTensor
+
+        def leaf():
+            return QTensor(q=jnp.zeros(shape, jnp.int8),
+                           scale=jnp.ones(shape[:3] + (1,), jnp.float32))
+
+        return [(leaf(), leaf()) for _ in range(cfg.n_layers)]
+    dt = dtype or cfg.dtype
+    return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+            for _ in range(cfg.n_layers)]
+
+
+def _block_write(pool, val, pos, table):
+    """Scatter val [B, L, ...] into pool [N, bs, ...] at global
+    positions pos..pos+L-1 per row, routed through table [B, T]:
+    position p lands in block table[b, p // bs] at offset p % bs.
+
+    pos is a scalar (single-row prefill) or a vector [B] (per-lane
+    decode).  NOT unique_indices: every frozen lane's table is all
+    scratch, so multiple frozen rows may legally collide on the scratch
+    block — last-writer-wins garbage in a block no query is ever
+    allowed to see (the position mask hides slots past each lane's
+    length, and live lanes' blocks are allocator-disjoint)."""
+    bs = pool.shape[1]
+    b, l = val.shape[0], val.shape[1]
+    steps = jnp.arange(l, dtype=jnp.int32)
+    if getattr(pos, "ndim", 0) == 1:
+        p = pos[:, None] + steps[None, :]                     # [B, L]
+    else:
+        p = jnp.broadcast_to(pos + steps[None, :], (b, l))    # [B, L]
+    # out-of-table positions (a frozen lane pinned past its zeroed
+    # table) clamp to the last column, which for frozen lanes is
+    # scratch; live lanes' allocations cover their worst case by the
+    # serve loop's admission gate
+    bidx = jnp.take_along_axis(table, jnp.minimum(p // bs,
+                                                  table.shape[1] - 1),
+                               axis=1)                        # [B, L]
+    off = jnp.mod(p, bs)
+    return pool.at[bidx, off].set(val.astype(pool.dtype))
+
+
+def paged_cache_write(pool, val, pos, table):
+    """One K or V block-pool write; int8 pools (QTensor leaves) quantize
+    at the write with per-(position, head) scales — the same pipeline
+    as the dense ring's _cache_write, targeting blocks."""
+    from tf_operator_tpu.models.quant import QTensor, quantize_tensor
+
+    if isinstance(pool, QTensor):
+        qv = quantize_tensor(val, axes=(3,))  # [B,L,KV,D]: scale [B,L,KV,1]
+        return QTensor(q=_block_write(pool.q, qv.q, pos, table),
+                       scale=_block_write(pool.scale, qv.scale, pos, table))
+    return _block_write(pool, val, pos, table)
+
+
+def gather_blocks(pool, table):
+    """[B, T*bs, KV, D] linear view of each lane's blocks: gather
+    pool[table] and fold (block, offset) into one position axis.
+    Tables are position-ordered, so index p of the view IS global
+    position p — llama's position-masked attention consumes it with no
+    paging awareness (padding/scratch entries sit past every lane's
+    length and mask out).  int8 pools gather payload and scales and
+    stay QTensor (the attention read dequantizes as usual)."""
+    from tf_operator_tpu.models.quant import QTensor
+
+    if isinstance(pool, QTensor):
+        return QTensor(q=_gather(pool.q, table),
+                       scale=_gather(pool.scale, table))
+    return _gather(pool, table)
+
+
+def _gather(pool, table):
+    g = pool[table]  # [B, T, bs, ...]
+    b, t, bs = g.shape[:3]
+    return g.reshape(b, t * bs, *g.shape[3:])
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def copy_block(cache, src, dst):
+    """Copy one block's payload src -> dst across every layer's (k, v)
+    pools — the copy-on-write primitive for a partial prefix boundary
+    block.  src/dst are traced, so one compile serves every CoW; the
+    cache is donated (the caller rebinds, as with every cache op).
+    QTensor leaves flatten to (q, scale) arrays, so int8 pools copy
+    both payload and scales through the same tree_map."""
+    return jax.tree.map(lambda p: p.at[dst].set(p[src]), cache)
+
+
+def build_table(ids: Sequence[int], width: int,
+                pad: int = SCRATCH_BLOCK) -> jnp.ndarray:
+    """One lane's table row [width]: block ids in position order, padded
+    with the scratch id (padding slots sit past the lane's written
+    length and are masked by position; their garbage is never read)."""
+    if len(ids) > width:
+        raise ValueError(
+            f"table of {len(ids)} blocks exceeds width {width}")
+    return jnp.asarray(list(ids) + [pad] * (width - len(ids)), jnp.int32)
+
+
+def plan_request(prompt_len: int, max_new_tokens: int, headroom: int,
+                 block_size: int, prefix_len: int = 0):
+    """Admission block math for one request whose FULL prompt (prefix
+    included) is `prompt_len` tokens: (total blocks, fully-shared
+    prefix blocks, private blocks, needs boundary CoW).
+
+    The first prefix_len // block_size blocks are whole-prefix and
+    shareable by refcount; a partial boundary block (prefix_len not a
+    block multiple) must be copied per lane (its tail holds lane
+    positions) and counts private.  Private blocks cover everything
+    from the boundary through prompt + max_new + headroom — the worst
+    case the memory gate reserves."""
+    total = blocks_for(prompt_len + max_new_tokens + headroom, block_size)
+    shared = min(prefix_len // block_size, total)
+    cow = prefix_len % block_size != 0
+    return total, shared, total - shared, cow

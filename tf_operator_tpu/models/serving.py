@@ -33,11 +33,25 @@ TPU-first mechanics:
     lane per round; the draft's row cache prefills and inserts beside
     the target's at admission.
 
+  - PAGED KV cache (paged=True, models/paging.py): the dense per-lane
+    rings above bill HBM for cache_len x slots regardless of occupancy;
+    paged mode replaces them with one fixed block pool shared by all
+    layers (leading block axis) and per-lane block tables.  Admission
+    is MEMORY-GATED — a request is admitted only when the pool covers
+    its prompt + max_new worst case, else it waits in queue (the PR-2
+    queue-wait telemetry measures the tradeoff) — and shared prefixes
+    become refcounted read-only blocks: admission increfs instead of
+    copying, with copy-on-write of only a partial boundary block.
+    Token-identical to dense by construction: the table-gathered view
+    is a linear cache and the position mask is unchanged.
+
 Exactness: greedy outputs per request are token-identical to an
 isolated llama.generate call (tests/test_serving.py) — batching,
-admission order, and speculation change throughput only.  Composes
-with kv_quant (int8 caches insert through the same tree scatter) and
-sliding-window rings.
+admission order, speculation, and paging change throughput only.
+Composes with kv_quant (int8 caches insert through the same tree
+scatter; int8 block pools quantize at the block write) and
+sliding-window rings (dense mode; paged mode refuses windows — a
+linear block table has no modular seam).
 
 No reference counterpart (the reference has no serving code at all,
 SURVEY.md §5.7).
@@ -72,6 +86,10 @@ class ServeResult:
     slot: int
     accepted_drafts: int = 0
     proposed_drafts: int = 0
+    # paged serving only: KV blocks this request's table referenced
+    # (shared prefix blocks included) — blocks/tokens is the bench's
+    # per-request memory-efficiency row; 0 under dense serving
+    kv_blocks: int = 0
 
 
 @functools.lru_cache(maxsize=8)
@@ -158,6 +176,97 @@ def _spec_serve_fns(model, draft, k: int, temperature: float, top_k: int,
     return spec_block
 
 
+@functools.lru_cache(maxsize=8)
+def _paged_serve_fns(model, temperature: float, top_k: int, top_p: float,
+                     params_transform=None):
+    """Jitted (step, chunk_fill, chunk_write) for PAGED serving: the
+    same decode block / prefill writers as _serve_fns + llama's chunk
+    writers, with every cache op routed through a block table
+    (models/paging.py).  There is no insert_row — prefill writes land
+    directly in the admitted lane's blocks of the one shared pool, so
+    admission copies nothing."""
+    xform = params_transform or (lambda p: p)
+
+    @functools.partial(jax.jit, donate_argnums=(1,), static_argnums=(7,))
+    def step(params, cache, tok, pos, frozen, table, key, n_steps: int):
+        """The paged decode block: identical math to _serve_fns.step
+        (parity by construction), with writes/reads routed by `table`
+        [B, T].  Frozen lanes' tables are all-scratch, so their pinned
+        repeated writes can never touch a freed block."""
+        def body(carry, k):
+            cache, tok, pos = carry
+            logits, cache = model.apply(
+                {"params": xform(params)}, tok[:, None], cache=cache,
+                cache_pos=pos, block_table=table)
+            nxt = _llama._select_token(logits[:, 0], temperature, k,
+                                       top_k, top_p)
+            nxt = jnp.where(frozen, tok, nxt)
+            pos = jnp.where(frozen, pos, pos + 1)
+            return (cache, nxt, pos), nxt
+
+        (cache, tok, pos), toks = jax.lax.scan(
+            body, (cache, tok, pos), jax.random.split(key, n_steps))
+        return cache, tok, pos, toks  # toks [n_steps, B]
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def chunk_fill(params, cache, segment, pos, table):
+        """Final prefill segment into the lane's blocks ([1, T] table):
+        returns the last position's logits for first-token selection."""
+        logits, cache = model.apply(
+            {"params": xform(params)}, segment, cache=cache,
+            cache_pos=pos, block_table=table)
+        return logits[:, -1], cache
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def chunk_write(params, cache, segment, pos, table):
+        """Non-final segments feed the blocks only — lm_head skipped
+        (llama chunk_write's contract, block-targeted)."""
+        _, cache = model.apply(
+            {"params": xform(params)}, segment, cache=cache,
+            cache_pos=pos, block_table=table, return_hidden=True)
+        return cache
+
+    return step, chunk_fill, chunk_write
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_spec_serve_fns(model, draft, k: int, temperature: float,
+                          top_k: int, top_p: float, params_transform=None,
+                          draft_transform=None):
+    """_spec_serve_fns' paged twin: the same make_spec_round math with
+    both models' caches as block pools sharing ONE table (they cache
+    the same logical positions, so one allocation serves both)."""
+    from tf_operator_tpu.models.speculative import make_spec_round
+
+    t_xform = params_transform or (lambda p: p)
+    d_xform = draft_transform or (lambda p: p)
+    round_core = make_spec_round(model, draft, k, temperature, top_k,
+                                 top_p, t_xform, d_xform, paged=True)
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3), static_argnums=(9,))
+    def spec_block(t_params, d_params, t_cache, d_cache, tok, pos, frozen,
+                   table, key, n_rounds: int):
+        def round_body(carry, rkey):
+            t_cache, d_cache, tok, pos = carry
+            t_cache, d_cache, cand, n_acc, slot = round_core(
+                t_params, d_params, t_cache, d_cache, tok, pos, rkey,
+                table)
+            # frozen lanes: same contract as the dense spec block — they
+            # emit nothing (-1 marker) and stay put; their k+1 writes go
+            # to the scratch block via their zeroed table rows
+            n_acc = jnp.where(frozen, -1, n_acc)
+            tok = jnp.where(frozen, tok, slot)
+            pos = jnp.where(frozen, pos, pos + n_acc + 1)
+            return (t_cache, d_cache, tok, pos), (cand, n_acc)
+
+        (t_cache, d_cache, tok, pos), (cands, n_accs) = jax.lax.scan(
+            round_body, (t_cache, d_cache, tok, pos),
+            jax.random.split(key, n_rounds))
+        return t_cache, d_cache, tok, pos, cands, n_accs
+
+    return spec_block
+
+
 def serve_loop(model, params, requests: Sequence[Any], *,
                slots: int = 4, max_new_tokens: int = 64,
                eos_id: Optional[int] = None,
@@ -172,6 +281,8 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                cache_sharding=None, draft_cache_sharding=None,
                draft=None, draft_params=None, spec_k: int = 4,
                draft_transform=None,
+               paged: bool = False, block_size: int = 64,
+               pool_blocks: Optional[int] = None,
                telemetry: Optional[ServeTelemetry] = None,
                return_stats: bool = False):
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
@@ -228,6 +339,31 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     concatenated prompts.  With prefill_chunk set, the prefix length
     must be a chunk multiple so suffix segments stay aligned with the
     ring's no-wrap guarantees (refused loudly otherwise).
+
+    paged / block_size / pool_blocks: PAGED KV CACHE (models/paging.py).
+    paged=True replaces the dense per-lane caches with one fixed pool
+    of `block_size`-token blocks shared by every layer (and the draft,
+    under speculation) plus per-lane block tables; `pool_blocks`
+    defaults to the dense-equivalent capacity (every lane can hold the
+    worst case) — shrink it to engage the MEMORY GATE: a request is
+    admitted only when the pool covers its prompt + max_new_tokens
+    (+ speculation headroom) worst case, else it waits at the queue
+    head (FIFO — no small-request overtaking) and the
+    admission_blocked_on_memory counter ticks.  Shared prefixes become
+    refcounted read-only blocks: admission bumps refcounts instead of
+    copying the prefix cache, and only a partial boundary block
+    (prefix length not a block multiple) is copied per lane
+    (copy-on-write of ONE block).  Greedy tokens are IDENTICAL to
+    dense serving across every configuration (tests/test_paging.py's
+    parity matrix); throughput and memory change, semantics never.
+    With prefill_chunk set, the chunk must be a block_size multiple so
+    every streamed segment stays block-aligned (refused loudly, like
+    the prefix/chunk alignment rule).  Paged mode refuses
+    sliding-window models (the dense O(window) ring is already the
+    right shape there), cache_sharding (dense TP serving covers it),
+    and cache_len (a dense-ring knob — pool_blocks is the paged memory
+    bound; silently dropping the caller's bound would be worse than
+    refusing).
 
     telemetry / return_stats: SERVING TELEMETRY (models/telemetry.py).
     Every call is instrumented — per-request lifecycle spans (queued ->
@@ -328,7 +464,40 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # past a lane's current length (speculative_generate's own bound)
     headroom = (spec_k + 1) if spec else 0
     longest = max(r.shape[0] for r in reqs)
+    longest_i = max(range(len(reqs)), key=lambda i: int(reqs[i].shape[0]))
     model_cfgs = [("target", cfg)] + ([("draft", draft.cfg)] if spec else [])
+    if paged:
+        from tf_operator_tpu.models import paging
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        for name, c in model_cfgs:
+            if c.sliding_window is not None:
+                raise ValueError(
+                    f"paged serving does not support sliding-window "
+                    f"models ({name} window {c.sliding_window}): a block "
+                    f"table is linear and has no modular seam — use the "
+                    f"dense ring path (paged=False), which is already "
+                    f"O(window)")
+        if cache_sharding is not None or draft_cache_sharding is not None:
+            raise ValueError(
+                "paged serving does not compose with cache_sharding yet "
+                "— use dense serving for tensor-parallel lanes")
+        if cache_len is not None:
+            # refuse-loudly convention: silently dropping the caller's
+            # dense memory bound would un-bound their HBM expectation
+            raise ValueError(
+                "cache_len is a dense-ring knob; paged serving sizes "
+                "memory by pool_blocks x block_size — pass pool_blocks "
+                "instead")
+        if prefill_chunk is not None and prefill_chunk % block_size != 0:
+            # the same alignment rule as shared_prefix % prefill_chunk:
+            # a streamed segment must cover whole blocks so segment
+            # boundaries and block boundaries never shear
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be a multiple of "
+                f"block_size {block_size} so every streamed segment "
+                f"writes whole blocks (adjust the chunk or the block "
+                f"size)")
     for i, r in enumerate(reqs):
         if r.shape[0] < 1:
             raise ValueError(f"request {i} is empty")
@@ -340,48 +509,50 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     + (f" (+{headroom} speculation headroom)" if spec
                        else "")
                     + f" exceeds max_len {c.max_len} ({name})")
-    if cache_len is None:
-        # size for EVERY model in play; under speculation a windowed
-        # ring needs spec_k extra slots (the validation below demands
-        # window + spec_k — sizing with a widened window keeps the
-        # default self-consistent, including chunk alignment, instead
-        # of refusing its own choice for 128-multiple windows)
-        cache_len = max(
-            _llama.auto_cache_len(
-                (dataclasses.replace(c, sliding_window=c.sliding_window
-                                     + spec_k)
-                 if spec and c.sliding_window is not None else c),
-                longest, longest + max_new_tokens + headroom,
-                prefill_chunk)
-            for _n, c in model_cfgs)
-    # each model's ring is capped at ITS max_len (the RoPE-table bound
-    # init_cache enforces): a small draft beside a large target gets a
-    # smaller ring, and every check below runs against the model's own
-    # effective length
-    eff_len = {name: min(cache_len, c.max_len) for name, c in model_cfgs}
-    # generate()'s visibility rules, per lane and per model: a
-    # full-causal model must hold its longest request's whole sequence
-    # (the ring must never wrap); a windowed one whose ring wraps needs
-    # window (+ spec_k under speculation — the wrapped verify write's
-    # aliased slots must land outside every live query's band,
-    # speculative._spec_cache_len's bound) resident
-    worst = longest + max_new_tokens + headroom
-    for name, c in model_cfgs:
-        if c.sliding_window is None and worst > eff_len[name]:
-            raise ValueError(
-                f"longest prompt {longest} + new {max_new_tokens} "
-                f"(+{headroom} headroom) exceeds cache length "
-                f"{eff_len[name]} — a full-causal {name} model cannot "
-                f"stream past its cache")
-        if c.sliding_window is not None:
-            need = min(c.sliding_window + (spec_k if spec else 0), worst)
-            if eff_len[name] < need:
+    if not paged:
+        if cache_len is None:
+            # size for EVERY model in play; under speculation a windowed
+            # ring needs spec_k extra slots (the validation below demands
+            # window + spec_k — sizing with a widened window keeps the
+            # default self-consistent, including chunk alignment, instead
+            # of refusing its own choice for 128-multiple windows)
+            cache_len = max(
+                _llama.auto_cache_len(
+                    (dataclasses.replace(c, sliding_window=c.sliding_window
+                                         + spec_k)
+                     if spec and c.sliding_window is not None else c),
+                    longest, longest + max_new_tokens + headroom,
+                    prefill_chunk)
+                for _n, c in model_cfgs)
+        # each model's ring is capped at ITS max_len (the RoPE-table bound
+        # init_cache enforces): a small draft beside a large target gets a
+        # smaller ring, and every check below runs against the model's own
+        # effective length
+        eff_len = {name: min(cache_len, c.max_len) for name, c in model_cfgs}
+        # generate()'s visibility rules, per lane and per model: a
+        # full-causal model must hold its longest request's whole sequence
+        # (the ring must never wrap); a windowed one whose ring wraps needs
+        # window (+ spec_k under speculation — the wrapped verify write's
+        # aliased slots must land outside every live query's band,
+        # speculative._spec_cache_len's bound) resident
+        worst = longest + max_new_tokens + headroom
+        for name, c in model_cfgs:
+            if c.sliding_window is None and worst > eff_len[name]:
                 raise ValueError(
-                    f"cache_len {eff_len[name]} < {name} requirement "
-                    f"{need} (window {c.sliding_window}"
-                    + (f" + spec_k {spec_k}" if spec else "")
-                    + ", capped at the no-wrap total) — visible "
-                    "positions would be overwritten")
+                    f"request {longest_i}: prompt {longest} + new "
+                    f"{max_new_tokens} (+{headroom} headroom) exceeds "
+                    f"cache length {eff_len[name]} — a full-causal "
+                    f"{name} model cannot stream past its cache")
+            if c.sliding_window is not None:
+                need = min(c.sliding_window + (spec_k if spec else 0),
+                           worst)
+                if eff_len[name] < need:
+                    raise ValueError(
+                        f"cache_len {eff_len[name]} < {name} requirement "
+                        f"{need} (window {c.sliding_window}"
+                        + (f" + spec_k {spec_k}" if spec else "")
+                        + ", capped at the no-wrap total) — visible "
+                        "positions would be overwritten")
 
     def _effective_chunk(p_len: int) -> Optional[int]:
         # a chunk >= the prompt is a single-segment prefill (generate's
@@ -393,34 +564,90 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # per-request prefill feasibility, validated BEFORE any compute —
     # a bad request must not surface mid-serve after other requests
     # already decoded
-    for i, r in enumerate(reqs):
-        chunk = _effective_chunk(r.shape[0])
-        if chunk is None and r.shape[0] > min(eff_len.values()):
+    if paged:
+        # block math per request: total table width t_blocks covers the
+        # longest worst case; pool_blocks defaults to dense-equivalent
+        # capacity (every lane can hold the worst case simultaneously,
+        # prefix shared) — shrink it to engage the memory gate
+        t_blocks = paging.blocks_for(
+            longest + max_new_tokens + headroom, block_size)
+        n_prefix_blocks = paging.blocks_for(p_fix, block_size)
+        plans = [paging.plan_request(int(r.shape[0]), max_new_tokens,
+                                     headroom, block_size, p_fix)
+                 for r in reqs]
+        if pool_blocks is None:
+            pool_blocks = (slots * max(pl[2] for pl in plans)
+                           + n_prefix_blocks)
+        if pool_blocks < 1:
             raise ValueError(
-                f"request {i}: prompt {r.shape[0]} exceeds cache_len "
-                f"{min(eff_len.values())}; pass prefill_chunk to "
-                f"stream it")
-        if chunk is not None:
-            for name, c in model_cfgs:
-                _llama.check_prefill_chunk(
-                    chunk, eff_len[name], c.sliding_window,
-                    streams_past_cache=True)
+                f"pool_blocks must be >= 1, got {pool_blocks}")
+        pool = paging.BlockPool(pool_blocks, block_size)
+        for i, (r, (_tot, _sh, private_i, _cow)) in enumerate(
+                zip(reqs, plans)):
+            # the worst case must fit an EMPTY pool (prefix aside) or
+            # the memory gate would wait forever — refuse with the
+            # block math, naming the request
+            if private_i + n_prefix_blocks > pool_blocks:
+                raise ValueError(
+                    f"request {i}: prompt {r.shape[0]} + new "
+                    f"{max_new_tokens}"
+                    + (f" (+{headroom} speculation headroom)" if spec
+                       else "")
+                    + f" needs {private_i} private blocks of "
+                    f"{block_size} tokens"
+                    + (f" (+{n_prefix_blocks} shared prefix blocks)"
+                       if p_fix else "")
+                    + f", but the pool has {pool_blocks} — grow "
+                    f"pool_blocks or shrink the request")
+    else:
+        for i, r in enumerate(reqs):
+            chunk = _effective_chunk(r.shape[0])
+            if chunk is None and r.shape[0] > min(eff_len.values()):
+                raise ValueError(
+                    f"request {i}: prompt {r.shape[0]} exceeds cache_len "
+                    f"{min(eff_len.values())}; pass prefill_chunk to "
+                    f"stream it")
+            if chunk is not None:
+                for name, c in model_cfgs:
+                    _llama.check_prefill_chunk(
+                        chunk, eff_len[name], c.sliding_window,
+                        streams_past_cache=True)
 
     # jitted pieces: the batch step (compiled once), the row inserter,
-    # and llama.generate's own chunk writers for off-batch prefill
-    step, insert_row = _serve_fns(model, float(temperature), int(top_k),
-                                  float(top_p), params_transform)
-    _, chunk_fill, chunk_write = _llama._decode_fns(
-        model, 0.0, 0, 0.0, -1, params_transform)
-    if spec:
-        spec_block = _spec_serve_fns(
-            model, draft, int(spec_k), float(temperature), int(top_k),
-            float(top_p), params_transform, draft_transform)
-        # only the chunk WRITER: every draft segment (final included)
-        # feeds the cache alone — the first token always comes from
-        # the target's logits
-        _, _, d_write = _llama._decode_fns(
-            draft, 0.0, 0, 0.0, -1, draft_transform)
+    # and llama.generate's own chunk writers for off-batch prefill.
+    # Paged mode swaps all of them for table-routed twins (and drops
+    # insert_row entirely — prefill writes land in the lane's blocks)
+    if paged:
+        step, _, _ = _paged_serve_fns(model, float(temperature),
+                                      int(top_k), float(top_p),
+                                      params_transform)
+        # greedy-keyed writers (selection happens host-side with the
+        # real sampling params — the dense path's exact split)
+        _, chunk_fill, chunk_write = _paged_serve_fns(
+            model, 0.0, 0, 0.0, params_transform)
+        if spec:
+            spec_block = _paged_spec_serve_fns(
+                model, draft, int(spec_k), float(temperature),
+                int(top_k), float(top_p), params_transform,
+                draft_transform)
+            _, _, d_write = _paged_serve_fns(draft, 0.0, 0, 0.0,
+                                             draft_transform)
+    else:
+        step, insert_row = _serve_fns(model, float(temperature),
+                                      int(top_k), float(top_p),
+                                      params_transform)
+        _, chunk_fill, chunk_write = _llama._decode_fns(
+            model, 0.0, 0, 0.0, -1, params_transform)
+        if spec:
+            spec_block = _spec_serve_fns(
+                model, draft, int(spec_k), float(temperature),
+                int(top_k), float(top_p), params_transform,
+                draft_transform)
+            # only the chunk WRITER: every draft segment (final
+            # included) feeds the cache alone — the first token always
+            # comes from the target's logits
+            _, _, d_write = _llama._decode_fns(
+                draft, 0.0, 0, 0.0, -1, draft_transform)
 
     def resume_index(full_len: int) -> int:
         """How many leading segments of the request's schedule the
@@ -483,34 +710,70 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                                           kv_quant=kv_quant), d_row_sh)
                  if spec else None))
 
-    if p_fix:
-        # prefill the shared prefix ONCE (write-only: the logits of a
-        # mid-prompt position are never needed)
-        prefix_row = _place(
-            _llama.init_cache(cfg, 1, eff_len["target"],
-                              kv_quant=kv_quant), row_sh)
-        d_prefix_row = (_place(
-            _llama.init_cache(draft.cfg, 1, eff_len["draft"],
-                              kv_quant=kv_quant), d_row_sh)
-            if spec else None)
-        segs = request_segments(p_fix + 1)  # +1: any suffix length
-        for start, end, _ in segs[:resume_index(p_fix + 1)]:
-            piece = prefix[None, start:end]
-            prefix_row = chunk_write(params, prefix_row, piece,
-                                     jnp.int32(start))
-            if spec:
-                d_prefix_row = d_write(draft_params, d_prefix_row,
-                                       piece, jnp.int32(start))
+    if paged:
+        # ONE block pool per model (leading block axis shared by every
+        # layer; block ids shared across models), per-lane tables of
+        # t_blocks entries, id 0 = scratch.  The dense per-lane caches
+        # and row-cache machinery above are never allocated.
+        cache = paging.init_block_pool(cfg, pool_blocks, block_size,
+                                       kv_quant=kv_quant)
+        d_cache = (paging.init_block_pool(draft.cfg, pool_blocks,
+                                          block_size, kv_quant=kv_quant)
+                   if spec else None)
+        table = jnp.zeros((slots, t_blocks), jnp.int32)
+        prefix_ids: List[int] = []
+        if p_fix:
+            # prefill the shared prefix ONCE into refcounted blocks —
+            # the pool's base reference holds them for the whole run;
+            # admissions incref the whole-prefix blocks and CoW a
+            # partial boundary block
+            prefix_ids = pool.alloc(n_prefix_blocks)
+            pfx_table = paging.build_table(prefix_ids, t_blocks)[None, :]
+            segs = request_segments(p_fix + 1)  # +1: any suffix length
+            for start, end, _ in segs[:resume_index(p_fix + 1)]:
+                piece = prefix[None, start:end]
+                cache = chunk_write(params, cache, piece,
+                                    jnp.int32(start), pfx_table)
+                if spec:
+                    d_cache = d_write(draft_params, d_cache, piece,
+                                      jnp.int32(start), pfx_table)
+        # per-lane block ownership: shared (increffed prefix) vs own
+        # (private, freed at finish); table rows reset to scratch on
+        # finish so frozen-lane writes can never touch a freed block
+        lane_shared: List[List[int]] = [[] for _ in range(slots)]
+        lane_own: List[List[int]] = [[] for _ in range(slots)]
+        lane_nblocks = [0] * slots
+    else:
+        if p_fix:
+            # prefill the shared prefix ONCE (write-only: the logits of
+            # a mid-prompt position are never needed)
+            prefix_row = _place(
+                _llama.init_cache(cfg, 1, eff_len["target"],
+                                  kv_quant=kv_quant), row_sh)
+            d_prefix_row = (_place(
+                _llama.init_cache(draft.cfg, 1, eff_len["draft"],
+                                  kv_quant=kv_quant), d_row_sh)
+                if spec else None)
+            segs = request_segments(p_fix + 1)  # +1: any suffix length
+            for start, end, _ in segs[:resume_index(p_fix + 1)]:
+                piece = prefix[None, start:end]
+                prefix_row = chunk_write(params, prefix_row, piece,
+                                         jnp.int32(start))
+                if spec:
+                    d_prefix_row = d_write(draft_params, d_prefix_row,
+                                           piece, jnp.int32(start))
 
-    # slot state: cache/tok/pos live on device; occupancy bookkeeping
-    # (owner, frozen, emitted) lives on the host — the loop reads tokens
-    # back once per step anyway (it must, to detect EOS)
-    cache = _place(_llama.init_cache(cfg, slots, eff_len["target"],
-                                     kv_quant=kv_quant), cache_sharding)
-    d_cache = (_place(_llama.init_cache(draft.cfg, slots,
-                                        eff_len["draft"],
-                                        kv_quant=kv_quant),
-                      draft_cache_sharding) if spec else None)
+        # slot state: cache/tok/pos live on device; occupancy
+        # bookkeeping (owner, frozen, emitted) lives on the host — the
+        # loop reads tokens back once per step anyway (it must, to
+        # detect EOS)
+        cache = _place(_llama.init_cache(cfg, slots, eff_len["target"],
+                                         kv_quant=kv_quant),
+                       cache_sharding)
+        d_cache = (_place(_llama.init_cache(draft.cfg, slots,
+                                            eff_len["draft"],
+                                            kv_quant=kv_quant),
+                          draft_cache_sharding) if spec else None)
     tok = jnp.zeros((slots,), jnp.int32)
     pos = jnp.zeros((slots,), jnp.int32)
     frozen_py = [True] * slots
@@ -534,45 +797,77 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # (models/telemetry.py); every request is queued from here on
     tel = telemetry if telemetry is not None else ServeTelemetry()
     tel.loop_started(len(reqs), slots, spec)
+    if paged:
+        tel.pool_configured(pool_blocks, block_size)
+        tel.blocks_in_use(pool.used)  # prefix blocks, if any
 
     def finish(s):
+        nonlocal table
         frozen_py[s] = True
         ridx = owner[s]
         results[ridx] = ServeResult(
             tokens=emitted[s], admitted_at_step=admitted_step[s],
             finished_at_step=n_step, slot=s,
             accepted_drafts=spec_acc[s][0],
-            proposed_drafts=spec_acc[s][1])
+            proposed_drafts=spec_acc[s][1],
+            kv_blocks=lane_nblocks[s] if paged else 0)
         owner[s] = None
+        if paged:
+            # release the lane's blocks: shared prefix blocks drop one
+            # reference, private blocks free; the table row resets to
+            # all-scratch so the frozen lane's pinned writes can never
+            # land in a block the allocator hands to someone else
+            if lane_shared[s]:
+                pool.decref(lane_shared[s])
+            if lane_own[s]:
+                pool.decref(lane_own[s])
+            lane_shared[s], lane_own[s] = [], []
+            lane_nblocks[s] = 0
+            table = table.at[s].set(0)
+            tel.blocks_in_use(pool.used)
         tel.request_finished(ridx, results[ridx], n_step)
 
     def advance_prefill(s):
         """Stream up to prefill_chunks_per_sync segments of slot s's
         pending prompt; on the final segment, sample the first token,
-        insert both row caches, and activate the lane.  The resumable
-        counterpart of llama.stream_prefill — both iterate the SAME
+        insert both row caches (dense) — paged segments write STRAIGHT
+        into the lane's blocks, so there is nothing to insert — and
+        activate the lane.  The resumable counterpart of
+        llama.stream_prefill — both iterate the SAME
         llama.prefill_segments schedule, so slicing can't diverge."""
-        nonlocal cache, d_cache, tok, pos, rng
+        nonlocal cache, d_cache, tok, pos, rng, table
         st = pending[s]
         prompt_r = reqs[st["ridx"]]
         p_len = prompt_r.shape[0]
         segments = request_segments(p_len)
         budget = prefill_chunks_per_sync or len(segments)
+        row_tbl = st["row_tbl"] if paged else None
         for start, end, is_last in segments[st["next"]:
                                             st["next"] + budget]:
             piece = prompt_r[None, start:end]
             st["next"] += 1
             if is_last:  # final segment: logits + activate the lane
                 with tel.prefill_segment(st["ridx"], start, end):
-                    last_logits, st["row"] = chunk_fill(
-                        params, st["row"], piece, jnp.int32(start))
-                    if spec:
-                        st["d_row"] = d_write(draft_params, st["d_row"],
-                                              piece, jnp.int32(start))
-                    cache = insert_row(cache, st["row"], jnp.int32(s))
-                    if spec:
-                        d_cache = insert_row(d_cache, st["d_row"],
-                                             jnp.int32(s))
+                    if paged:
+                        last_logits, cache = chunk_fill(
+                            params, cache, piece, jnp.int32(start),
+                            row_tbl)
+                        if spec:
+                            d_cache = d_write(draft_params, d_cache,
+                                              piece, jnp.int32(start),
+                                              row_tbl)
+                    else:
+                        last_logits, st["row"] = chunk_fill(
+                            params, st["row"], piece, jnp.int32(start))
+                        if spec:
+                            st["d_row"] = d_write(draft_params,
+                                                  st["d_row"], piece,
+                                                  jnp.int32(start))
+                        cache = insert_row(cache, st["row"],
+                                           jnp.int32(s))
+                        if spec:
+                            d_cache = insert_row(d_cache, st["d_row"],
+                                                 jnp.int32(s))
                     rng, k_first = jax.random.split(rng)
                     # the int() forces the device sync, so the final
                     # segment's span covers real prefill wall-clock
@@ -580,6 +875,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         last_logits, temperature, k_first, top_k,
                         top_p)[0])
                 ridx = st["ridx"]
+                if paged:
+                    # the lane goes LIVE: its table row becomes real
+                    # exactly when it unfreezes (it was scratch while
+                    # pending, so interleaved decode blocks could not
+                    # write through it)
+                    table = table.at[s].set(st["row_tbl"][0])
                 del pending[s]
                 owner[s] = ridx
                 spec_acc[s] = (0, 0)
@@ -593,24 +894,82 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     finish(s)
                 return
             with tel.prefill_segment(st["ridx"], start, end):
-                st["row"] = chunk_write(params, st["row"], piece,
-                                        jnp.int32(start))
-                if spec:
-                    st["d_row"] = d_write(draft_params, st["d_row"],
-                                          piece, jnp.int32(start))
+                if paged:
+                    cache = chunk_write(params, cache, piece,
+                                        jnp.int32(start), row_tbl)
+                    if spec:
+                        d_cache = d_write(draft_params, d_cache, piece,
+                                          jnp.int32(start), row_tbl)
+                else:
+                    st["row"] = chunk_write(params, st["row"], piece,
+                                            jnp.int32(start))
+                    if spec:
+                        st["d_row"] = d_write(draft_params, st["d_row"],
+                                              piece, jnp.int32(start))
 
     while queue or pending or any(o is not None for o in owner):
         # ---- admission: every free lane RESERVES the next queued
-        # request (cache allocation only; the prompt streams in below)
+        # request (cache/block allocation only; the prompt streams in
+        # below).  Paged admission is MEMORY-GATED and FIFO: the queue
+        # head waits until the pool covers its worst case — no
+        # smaller-request overtaking, so a big request can't starve
         for s in range(slots):
             if owner[s] is None and s not in pending and queue:
-                ridx = queue.popleft()
-                row, d_row = fresh_rows()
-                pending[s] = {
-                    "ridx": ridx, "row": row, "d_row": d_row,
-                    "next": resume_index(reqs[ridx].shape[0]),
-                }
-                tel.request_admitted(ridx, s)
+                if paged:
+                    ridx = queue[0]
+                    _tot, shared_i, private_i, cow_i = plans[ridx]
+                    if not pool.can_alloc(private_i):
+                        # gate: wait for a finish to free blocks (the
+                        # upfront validation guarantees an empty pool
+                        # always fits the head, so this cannot hang)
+                        tel.admission_blocked_on_memory()
+                        break
+                    queue.popleft()
+                    own = pool.alloc(private_i)
+                    shared_ids = prefix_ids[:shared_i]
+                    if shared_ids:
+                        # prefix reuse IS a refcount bump — no copy
+                        pool.incref(shared_ids)
+                        tel.prefix_blocks_reused(len(shared_ids))
+                    if cow_i:
+                        # partial boundary block: the ONE copy prefix
+                        # sharing still pays — its tail holds this
+                        # lane's own positions
+                        src = jnp.int32(prefix_ids[shared_i])
+                        dst = jnp.int32(own[0])
+                        cache = paging.copy_block(cache, src, dst)
+                        if spec:
+                            d_cache = paging.copy_block(d_cache, src,
+                                                        dst)
+                        tel.cow_copy()
+                    lane_shared[s] = list(shared_ids)
+                    lane_own[s] = own
+                    lane_nblocks[s] = shared_i + private_i
+                    # the device table row stays ALL-SCRATCH until
+                    # activation: a pending lane is frozen across the
+                    # decode blocks interleaved with its streamed
+                    # prefill (prefill_chunks_per_sync), and a frozen
+                    # lane's pinned stale-pos write must keep landing
+                    # in scratch — a live row here would let it stamp
+                    # garbage into the lane's freshly prefilled blocks
+                    # (or worse, a shared prefix block).  Prefill
+                    # writes route through the host-built row below.
+                    pending[s] = {
+                        "ridx": ridx,
+                        "next": resume_index(reqs[ridx].shape[0]),
+                        "row_tbl": paging.build_table(
+                            list(shared_ids) + own, t_blocks)[None, :],
+                    }
+                    tel.request_admitted(ridx, s)
+                    tel.blocks_in_use(pool.used)
+                else:
+                    ridx = queue.popleft()
+                    row, d_row = fresh_rows()
+                    pending[s] = {
+                        "ridx": ridx, "row": row, "d_row": d_row,
+                        "next": resume_index(reqs[ridx].shape[0]),
+                    }
+                    tel.request_admitted(ridx, s)
         for s in list(pending):
             advance_prefill(s)
         if all(o is None for o in owner):
@@ -626,10 +985,17 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             # mid-block keeps speculating to the block edge and the
             # host discards the overshoot (same contract as the
             # single-token block, scaled by the round width)
-            with tel.decode_block(busy):
-                cache, d_cache, tok, pos, cands, n_accs = spec_block(
-                    params, draft_params, cache, d_cache, tok, pos,
-                    jnp.asarray(frozen_py), k_step, steps_per_sync)
+            with tel.decode_block(busy,
+                                  pool.used if paged else None):
+                if paged:
+                    cache, d_cache, tok, pos, cands, n_accs = spec_block(
+                        params, draft_params, cache, d_cache, tok, pos,
+                        jnp.asarray(frozen_py), table, k_step,
+                        steps_per_sync)
+                else:
+                    cache, d_cache, tok, pos, cands, n_accs = spec_block(
+                        params, draft_params, cache, d_cache, tok, pos,
+                        jnp.asarray(frozen_py), k_step, steps_per_sync)
                 cands = jax.device_get(cands)   # [rounds, B, spec_k+1]
                 n_accs = jax.device_get(n_accs)  # [rounds, B]; -1=frozen
             for i in range(steps_per_sync):
@@ -650,10 +1016,16 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                             finish(s)
                             break
         else:
-            with tel.decode_block(busy):
-                cache, tok, pos, toks = step(params, cache, tok, pos,
-                                             jnp.asarray(frozen_py),
-                                             k_step, steps_per_sync)
+            with tel.decode_block(busy,
+                                  pool.used if paged else None):
+                if paged:
+                    cache, tok, pos, toks = step(
+                        params, cache, tok, pos, jnp.asarray(frozen_py),
+                        table, k_step, steps_per_sync)
+                else:
+                    cache, tok, pos, toks = step(
+                        params, cache, tok, pos, jnp.asarray(frozen_py),
+                        k_step, steps_per_sync)
                 block = jax.device_get(toks)  # [steps_per_sync, B]
             for i in range(steps_per_sync):
                 n_step += 1

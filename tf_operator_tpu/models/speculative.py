@@ -77,7 +77,7 @@ def residual_sample(key, t_probs, d_probs):
 
 def make_spec_round(target, draft, k: int, temperature: float,
                     top_k: int, top_p: float, t_xform, d_xform,
-                    wrap_target: bool = False):
+                    wrap_target: bool = False, paged: bool = False):
     """THE speculation round — the one copy of the exactness-critical
     math (truncate-then-sample draft proposals, the u*p_d < p_t
     acceptance rule over identical truncated distributions, the padded
@@ -86,17 +86,29 @@ def make_spec_round(target, draft, k: int, temperature: float,
     speculative decode blocks, which differ only in how they advance
     state and emit tokens.
 
-    round_core(t_params, d_params, t_cache, d_cache, last, pos, rkey)
+    round_core(t_params, d_params, t_cache, d_cache, last, pos, rkey,
+               table=None)
       -> (t_cache, d_cache, cand [B, k+1], n_acc [B], slot [B])
     where pos is a PER-ROW position vector, cand[:, :n_acc+1] are the
     row's emitted tokens for the round, and slot == cand[:, n_acc] is
-    the round's final token (the caller's next `last`)."""
+    the round's final token (the caller's next `last`).
+
+    paged=True: both caches are block POOLS (models/paging.py) and
+    `table` is the per-lane block table routing every draft step's and
+    the k+1-wide verify's writes/reads — ONE table serves both models
+    because they cache the same logical positions (the allocator is
+    shared; only the device pools are per-model).  Rejected-round
+    rollback is the same position-mask argument as the dense ring:
+    stale writes past a lane's accepted length sit at masked slots and
+    are overwritten before they ever become visible."""
     from tf_operator_tpu.models.llama import _truncate_logits
 
     sampling = temperature > 0.0
 
-    def round_core(t_params, d_params, t_cache, d_cache, last, pos, rkey):
+    def round_core(t_params, d_params, t_cache, d_cache, last, pos, rkey,
+                   table=None):
         b = last.shape[0]
+        pg = {"block_table": table} if paged else {}
         k_draft, k_accept, k_fix = jax.random.split(rkey, 3)
 
         # ---- draft k tokens, single-token steps.  The scan runs
@@ -112,7 +124,7 @@ def make_spec_round(target, draft, k: int, temperature: float,
             d_cache, tok, dpos = carry
             logits, d_cache = draft.apply(
                 {"params": d_xform(d_params)}, tok[:, None],
-                cache=d_cache, cache_pos=dpos)
+                cache=d_cache, cache_pos=dpos, **pg)
             lg = logits[:, 0]
             if sampling:
                 # truncate FIRST, then sample and record softmax of
@@ -140,7 +152,7 @@ def make_spec_round(target, draft, k: int, temperature: float,
         seq = jnp.concatenate([last[:, None], drafts], axis=1)
         t_logits, t_cache = target.apply(
             {"params": t_xform(t_params)}, seq, cache=t_cache,
-            cache_pos=pos, wrap_cache_write=wrap_target)
+            cache_pos=pos, wrap_cache_write=wrap_target, **pg)
 
         if sampling:
             tprobs = jax.nn.softmax(

@@ -25,7 +25,12 @@ of new ones:
     token throughput counters, and speculative draft acceptance
     (accepted/proposed, the same numbers ServeResult reports per
     request) — the per-workload utilization signals scheduler work
-    (Gavel, Tesserae) assumes a serving system can report.
+    (Gavel, Tesserae) assumes a serving system can report.  Paged
+    serving (serve_loop paged=True) adds the block-pool families:
+    blocks total/used gauges (used/total is the memory-occupancy
+    ratio the autoscaler scales on), CoW-copy and prefix-block-hit
+    counters, and the blocked-admission counter that makes the memory
+    gate's queueing visible.
   - an aggregate `ServeStats` (returned by serve_loop(return_stats=
     True), printed by bench.py) with an HBM high-watermark sample via
     runtime/profiler.device_memory_stats.
@@ -122,6 +127,19 @@ class ServeStats:
     requests: int = 0
     slots: int = 0
     speculative: bool = False
+    # paged-KV accounting (serve_loop paged=True; zeros under dense
+    # serving): pool capacity/peak in blocks, the time-weighted mean
+    # block occupancy over decode blocks (the autoscaler's memory
+    # signal), CoW/prefix-reuse counts, and how many serve-loop
+    # iterations deferred an admission for pool capacity
+    paged: bool = False
+    kv_block_size: int = 0
+    kv_blocks_total: int = 0
+    kv_blocks_peak_used: int = 0
+    kv_block_occupancy_mean: float = 0.0
+    cow_copies: int = 0
+    prefix_block_hits: int = 0
+    admissions_blocked_on_memory: int = 0
     total_tokens: int = 0
     wall_time_s: float = 0.0
     tokens_per_sec: float = 0.0
@@ -174,6 +192,14 @@ class ServeTelemetry:
         self._decode_s = 0.0
         self._occ: List[tuple] = []  # (busy_lanes, block_duration)
         self._hbm: Optional[Dict[str, int]] = None  # set by loop_finished
+        # paged-KV accounting (pool_configured + per-event methods)
+        self._pool_total = 0
+        self._pool_block_size = 0
+        self._blocks_occ: List[tuple] = []  # (blocks_used, duration)
+        self._blocks_peak = 0
+        self._cow = 0
+        self._prefix_hits = 0
+        self._adm_blocked = 0
 
     def _wall(self, pc: float) -> float:
         """Epoch seconds for a perf_counter reading, via the single
@@ -191,12 +217,57 @@ class ServeTelemetry:
         self._occ.clear()
         self._hbm = None
         self._prefill_s = self._decode_s = 0.0
+        self._pool_total = self._pool_block_size = 0
+        self._blocks_occ.clear()
+        self._blocks_peak = self._cow = 0
+        self._prefix_hits = self._adm_blocked = 0
+        # a DENSE run must clear a prior paged run's capacity gauge or
+        # the process keeps exporting a pool it no longer has ("0 means
+        # dense serving" is the family's documented contract); a paged
+        # run re-sets it via pool_configured right after.  USED resets
+        # too: an ABORTED paged run (exception before loop_finished)
+        # would otherwise leave used > 0 beside total == 0 and the
+        # dashboards' used/total occupancy ratio would read +Inf
+        em.SERVING_KV_BLOCKS_TOTAL.set(0)
+        em.SERVING_KV_BLOCKS_USED.set(0)
         self._started_pc = time.perf_counter()
         self._wall0 = time.time()
         self._slots = slots
         self._spec = speculative
         for i in range(n_requests):
             self._reqs[i] = _RequestTimeline(i, self._started_pc)
+
+    # ------------------------------------------------------ paged cache
+    def pool_configured(self, total_blocks: int, block_size: int) -> None:
+        """serve_loop(paged=True) announces its block pool: capacity
+        gauge set once per run (used/total is the dashboards' block-
+        occupancy ratio)."""
+        self._pool_total = total_blocks
+        self._pool_block_size = block_size
+        em.SERVING_KV_BLOCKS_TOTAL.set(total_blocks)
+        em.SERVING_KV_BLOCKS_USED.set(0)
+
+    def blocks_in_use(self, used: int) -> None:
+        """Sample pool occupancy outside a decode block (admissions and
+        finishes change it between blocks); peak tracking only — the
+        time-weighted mean is carried by decode_block."""
+        self._blocks_peak = max(self._blocks_peak, used)
+        em.SERVING_KV_BLOCKS_USED.set(used)
+
+    def cow_copy(self) -> None:
+        self._cow += 1
+        em.SERVING_KV_BLOCK_COW_COPIES.inc()
+
+    def prefix_blocks_reused(self, n: int) -> None:
+        if n > 0:
+            self._prefix_hits += n
+            em.SERVING_PREFIX_BLOCK_HITS.inc(amount=n)
+
+    def admission_blocked_on_memory(self) -> None:
+        """One serve-loop iteration had a free lane and a queued request
+        but the pool could not cover the request's worst case."""
+        self._adm_blocked += 1
+        em.SERVING_ADMISSION_BLOCKED.inc()
 
     def request_admitted(self, index: int, slot: int) -> None:
         """A decode lane was RESERVED for the request (its prompt may
@@ -231,10 +302,13 @@ class ServeTelemetry:
         em.SERVING_TTFT.observe(r.ttft_s())
 
     @contextmanager
-    def decode_block(self, busy_lanes: int):
+    def decode_block(self, busy_lanes: int, blocks_used: Optional[int] = None):
         """Time one decode block (device scan + token readback — the
         readback is a real barrier, so this is true decode wall-clock)
-        and sample batch occupancy, time-weighted by the block."""
+        and sample batch occupancy, time-weighted by the block.  In
+        paged mode `blocks_used` rides along: the LANE gauge saturates
+        at `slots` long before memory does, so the block-level sample
+        is the occupancy signal the autoscaler actually needs."""
         t0 = time.perf_counter()
         try:
             yield
@@ -244,6 +318,10 @@ class ServeTelemetry:
             self._occ.append((busy_lanes, dt))
             em.SERVING_DECODE_TIME.inc(amount=dt)
             em.SERVING_BATCH_OCCUPANCY.set(busy_lanes)
+            if blocks_used is not None:
+                self._blocks_occ.append((blocks_used, dt))
+                self._blocks_peak = max(self._blocks_peak, blocks_used)
+                em.SERVING_KV_BLOCKS_USED.set(blocks_used)
 
     def request_finished(self, index: int, result: Any, step: int) -> None:
         """Request complete (EOS or budget): close the lifecycle, feed
@@ -320,6 +398,7 @@ class ServeTelemetry:
         if self._hbm is not None:
             return
         em.SERVING_BATCH_OCCUPANCY.set(0)
+        em.SERVING_KV_BLOCKS_USED.set(0)
         self._hbm = _hbm_peaks()
         for dev, peak in self._hbm.items():
             em.SERVING_HBM_PEAK.set(peak, {"device": dev})
@@ -336,6 +415,7 @@ class ServeTelemetry:
         tpots = [r.tpot_s() for r in done]
         tpots = [t for t in tpots if t is not None]
         occ_time = sum(dt for _, dt in self._occ)
+        blk_time = sum(dt for _, dt in self._blocks_occ)
         accepted = sum(r.accepted_drafts for r in done)
         proposed = sum(r.proposed_drafts for r in done)
         hbm = dict(self._hbm or {})
@@ -343,6 +423,16 @@ class ServeTelemetry:
             requests=len(done),
             slots=self._slots,
             speculative=self._spec,
+            paged=self._pool_total > 0,
+            kv_block_size=self._pool_block_size,
+            kv_blocks_total=self._pool_total,
+            kv_blocks_peak_used=self._blocks_peak,
+            kv_block_occupancy_mean=(
+                sum(b * dt for b, dt in self._blocks_occ) / blk_time
+                if blk_time > 0 else 0.0),
+            cow_copies=self._cow,
+            prefix_block_hits=self._prefix_hits,
+            admissions_blocked_on_memory=self._adm_blocked,
             total_tokens=total_tokens,
             wall_time_s=wall,
             tokens_per_sec=total_tokens / wall if wall > 0 else 0.0,
