@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool bench-sched bench-paged native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool bench-sched bench-paged bench-timeline native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -91,6 +91,14 @@ bench-paged:
 bench-sched:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_sched; \
 	print(json.dumps(bench_sched(), indent=1))"
+
+# Flight-recorder overhead pair: bench_operator_scale with the job
+# timeline recorder off vs on (alternated repeats, best-of comparison) —
+# the ISSUE 10 acceptance evidence that recording costs <= 5% reconcile
+# throughput.  Rows land in BENCH_r09.json.
+bench-timeline:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_timeline; \
+	print(json.dumps(bench_timeline(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

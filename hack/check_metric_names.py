@@ -35,6 +35,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # still fails: a gauge that COUNTS should be a Counter.
 _CAPACITY_GAUGES = {"tpu_operator_serving_kv_blocks_total"}
 
+# Families external consumers depend on BY NAME (docs/monitoring.md
+# PromQL, SLO dashboards): renaming or dropping one silently breaks
+# every recording rule built on it, so the lint pins name AND type.
+# The per-job SLO families are derived by the flight recorder
+# (engine/timeline.py) — the ISSUE 10 contract.
+_REQUIRED_FAMILIES = {
+    "tpu_operator_job_time_to_scheduled_seconds": "Histogram",
+    "tpu_operator_job_time_to_running_seconds": "Histogram",
+    "tpu_operator_job_restart_mttr_seconds": "Histogram",
+    "tpu_operator_job_timeline_events_total": "Counter",
+    "tpu_operator_job_timeline_evictions_total": "Counter",
+}
+
 
 def check_registry() -> list:
     from tf_operator_tpu.engine import metrics as em
@@ -78,6 +91,16 @@ def check_registry() -> list:
                 f"registered as {seen[m.name]})")
         else:
             seen[m.name] = type(m).__name__
+    for name, want_type in sorted(_REQUIRED_FAMILIES.items()):
+        got = seen.get(name)
+        if got is None:
+            errors.append(
+                f"{name}: required family missing from the registry "
+                f"(docs/monitoring.md PromQL depends on it by name)")
+        elif got != want_type:
+            errors.append(
+                f"{name}: required family must be a {want_type}, "
+                f"registered as {got}")
     return errors
 
 

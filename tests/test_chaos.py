@@ -125,7 +125,7 @@ def _controllers(mgr):
 def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
                  shards=None, lease_duration=24.0, warm_pool=0,
                  latency=None, scheduler_nodes=None,
-                 scheduler_policy="packed"):
+                 scheduler_policy="packed", timeline=None):
     """`shards=None` is the historical single OperatorManager; an int
     builds the ShardedOperator over the same injector (shards=1 disables
     leases — single-owner mode must stay byte-identical to the pre-shard
@@ -135,7 +135,9 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
     `scheduler_nodes` (a list of NAME=SHAPE[:GEN] specs) enables the
     cluster scheduler over that Node inventory, attaches it to the
     injector (drain_node evicts gangs through it), and routes its
-    admission/preemption decisions into the seeded event log."""
+    admission/preemption decisions into the seeded event log.
+    `timeline` overrides --timeline-events-per-job (None keeps the
+    default-on recorder; 0 disables it — the recorder-off goldens)."""
     inner = FakeCluster()
     clock = SimClock()
     pull, init = latency if latency is not None else (None, None)
@@ -154,6 +156,8 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
         scheduler_policy=scheduler_policy,
         scheduler_nodes=list(scheduler_nodes or []),
     )
+    if timeline is not None:
+        opts.timeline_events_per_job = timeline
     if shards is None:
         mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
     else:
@@ -164,6 +168,10 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
     if getattr(mgr, "scheduler", None) is not None:
         inj.scheduler = mgr.scheduler
         mgr.scheduler.note = inj.note
+    if getattr(mgr, "recorder", None) is not None:
+        # injected kills land in the owning job's timeline — root cause
+        # IN the story (recording never touches the seeded log)
+        inj.recorder = mgr.recorder
     # all delays collapse to immediate adds: pop order (and therefore the
     # whole run) becomes a pure function of the seed + schedule, and no
     # real-time timer ever fires mid-soak
@@ -222,12 +230,12 @@ def _exitcode_tfjob(name, workers=3):
 
 
 # ---------------------------------------------------------------- the soak
-def run_soak(seed, fanout=1, shards=None):
+def run_soak(seed, fanout=1, shards=None, timeline=None):
     """The acceptance scenario: overlapping 429/500/conflict/reset/stale
     storms, a Pod+Service watch outage, and two worker preemptions, then a
     long quiet tail (expectation TTL + backoff windows) to converge."""
     inner, clock, inj, mgr, auditor = make_harness(
-        seed, fanout=fanout, shards=shards
+        seed, fanout=fanout, shards=shards, timeline=timeline
     )
     inj.schedule_storm(10, 15, fault="429", retry_after=3.0)
     inj.schedule_storm(30, 10, fault="500")

@@ -1,0 +1,736 @@
+"""Job flight recorder (engine/timeline.py) — the ISSUE 10 acceptance
+surface.
+
+Bounded memory (rings cap, LRU evicts only finished jobs), cross-thread
+per-job sequence monotonicity, recorder-off chaos goldens byte-identical,
+per-job SLO histograms round-tripping through the /metrics exposition,
+the /debug/timeline + filtered /debug/traces endpoints, the `tpu-jobs
+timeline` verb, and the chaos-soak causality audit: every scheduler
+bind / preemption / drain eviction and every injected kill in the seeded
+chaos log appears exactly once in the owning job's timeline, in log
+order.
+"""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.cmd.health import HealthServer
+from tf_operator_tpu.cmd.manager import OperatorManager
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.engine import metrics, tracing
+from tf_operator_tpu.engine.timeline import FlightRecorder
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.chaos import DeterministicQueue, SimClock
+from tf_operator_tpu.k8s.fake import FakeCluster, StaleFencingTokenError
+from tf_operator_tpu.sdk.cli import Cli, make_parser
+from tf_operator_tpu.sdk.cli import run as cli_run
+
+from tests import testutil
+from tests.test_chaos import (
+    _sliced_exitcode_tfjob,
+    drain,
+    make_harness,
+    run_soak,
+)
+
+
+def _events(rec, key, source=None, event=None):
+    doc = rec.timeline(key)
+    if doc is None:
+        return []
+    out = doc["events"]
+    if source is not None:
+        out = [e for e in out if e["source"] == source]
+    if event is not None:
+        out = [e for e in out if e["event"] == event]
+    return out
+
+
+# ------------------------------------------------------------ bounded memory
+def test_ring_caps_hold_under_10k_events_and_lru_evicts_only_finished():
+    clock = SimClock()
+    rec = FlightRecorder(events_per_job=16, max_jobs=8, clock=clock)
+    metrics.JOB_TIMELINE_EVICTIONS.reset()
+    jobs = [f"default/j{i}" for i in range(20)]
+    # one early DECISION per job, then a 10k-event routine flood: the
+    # decision ring is separate, so the flood can never evict the one
+    # record that explains the job
+    for key in jobs:
+        rec.record(key, "scheduler", "gang_admitted", {"members": 1})
+    for n in range(10_000):
+        clock.advance(0.001)
+        rec.record(jobs[n % len(jobs)], "informer", "job_modified", {"n": n})
+    for key in jobs:
+        doc = rec.timeline(key)
+        if doc is None:
+            continue
+        routine = [e for e in doc["events"] if e["source"] == "informer"]
+        assert len(routine) == 16
+        assert [e["event"] for e in doc["events"]][0] == "gang_admitted"
+        # the ring keeps the NEWEST records, seq strictly increasing
+        seqs = [e["seq"] for e in doc["events"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # none of the 20 jobs is finished, so NOTHING was evicted even though
+    # the directory is over its cap of 8 — live jobs are never dropped
+    assert len(rec.jobs()) == 20
+    assert metrics.JOB_TIMELINE_EVICTIONS.get() == 0
+
+    # finish half; the next admissions evict only finished jobs, oldest
+    # last-touch first
+    for key in jobs[:10]:
+        rec.finish(key)
+    for i in range(5):
+        clock.advance(1.0)
+        rec.record(f"default/new{i}", "informer", "job_added", {})
+    tracked = set(rec.jobs())
+    assert metrics.JOB_TIMELINE_EVICTIONS.get() == 5
+    # the 5 oldest-touched finished jobs are gone (round-robin append
+    # order means j0..j4 were touched least recently among the finished)
+    for key in jobs[:5]:
+        assert key not in tracked
+    # every LIVE job survived
+    for key in jobs[10:]:
+        assert key in tracked
+
+
+def test_cross_thread_appends_keep_per_job_seq_monotonic():
+    rec = FlightRecorder(events_per_job=4096, max_jobs=8)
+    key = "default/threaded"
+    n_threads, per_thread = 8, 200
+
+    def writer(tid):
+        for i in range(per_thread):
+            rec.record(key, "informer", "job_modified",
+                       {"tid": tid, "i": i})
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = _events(rec, key)
+    assert len(events) == n_threads * per_thread
+    seqs = [e["seq"] for e in events]
+    assert seqs == list(range(1, n_threads * per_thread + 1))
+    # every thread's own records stayed in its program order
+    for tid in range(n_threads):
+        mine = [e["detail"]["i"] for e in events
+                if e["detail"]["tid"] == tid]
+        assert mine == list(range(per_thread))
+
+
+def test_append_hot_path_never_takes_the_directory_lock():
+    """The O(1)-append contract: after first contact the per-record path
+    synchronizes only on the JOB's ring lock — N workers recording N
+    different jobs must not serialize on the recorder-wide directory."""
+
+    class CountingLock:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.acquisitions = 0
+
+        def __enter__(self):
+            self.acquisitions += 1
+            return self._lock.__enter__()
+
+        def __exit__(self, *exc):
+            return self._lock.__exit__(*exc)
+
+    rec = FlightRecorder(events_per_job=32, max_jobs=8)
+    counter = CountingLock()
+    rec._dir_lock = counter
+    rec.record("default/hot", "sync", "reconcile", {"duration": 0.001})
+    after_admit = counter.acquisitions
+    assert after_admit >= 1  # first contact admits under the lock
+    for _ in range(500):
+        rec.record("default/hot", "sync", "reconcile", {"duration": 0.001})
+    assert counter.acquisitions == after_admit
+
+
+# ------------------------------------------------------- chaos determinism
+def test_recorder_off_soak_log_matches_golden():
+    """--timeline-events-per-job 0 bypasses every seam: the seeded soak
+    replays the pre-recorder golden byte-for-byte (the recorder-ON runs
+    are covered by the existing golden tests, since recording never
+    writes to the seeded log)."""
+    import os
+
+    golden = os.path.join(
+        os.path.dirname(__file__), "data", "chaos_soak_log_1337.txt"
+    )
+    with open(golden) as f:
+        expected = f.read().splitlines()
+    assert run_soak(1337, timeline=0) == expected
+
+
+# -------------------------------------------------------- causality audit
+def run_causality_soak(seed):
+    """The scheduler-preemption scenario (two v5e-8 nodes; a low-priority
+    2-slice gang preempted by a high-priority arrival mid-storm; a node
+    drain) with the recorder on — returns (inj, recorder, log)."""
+    inner, clock, inj, mgr, auditor = make_harness(
+        seed, scheduler_nodes=["sched-0=v5e-8", "sched-1=v5e-8"],
+    )
+    rec = mgr.recorder
+    assert rec is not None and inj.recorder is rec
+    lo = _sliced_exitcode_tfjob("caus-lo", "caus-uid-lo", workers=2)
+    hi = _sliced_exitcode_tfjob(
+        "caus-hi", "caus-uid-hi", workers=1, priority=100
+    )
+    inj.schedule_storm(35, 15, fault="429", retry_after=3.0)
+    inj.schedule_storm(55, 8, fault="500")
+    inj.at(
+        40, lambda: inner.create("TFJob", hi.to_dict()),
+        "submit caus-hi priority=100",
+    )
+    inj.at(
+        70, lambda: inj.kill_pod("default", "caus-hi-worker-0", 137),
+        "preempt caus-hi-worker-0",
+    )
+    inj.at(90, lambda: inj.drain_node("sched-0"), "drain sched-0")
+    inj.create("TFJob", lo.to_dict())
+    try:
+        for _ in range(120):
+            inj.step(5.0)
+            for inf in mgr.factory._informers.values():
+                inf.resync_once()
+            drain(mgr)
+    finally:
+        mgr.factory.stop_all()
+    assert auditor.violations == [], auditor.violations
+    return inner, inj, rec, inj.log
+
+
+def test_causality_audit_every_log_decision_lands_once_in_its_timeline():
+    """The acceptance audit: every scheduler bind / preemption / drain
+    eviction and every injected kill in the seeded chaos log appears
+    exactly once in the owning job's timeline, in log order."""
+    inner, inj, rec, log = run_causality_soak(1337)
+
+    # per-job ordered decision lines extracted from the seeded log,
+    # mapped to the timeline record type each must appear as
+    line_specs = (
+        ("gang_admit job=", "scheduler", "gang_admitted"),
+        ("preempt gang=", "scheduler", "preempted"),
+        ("drain_evict gang=", "scheduler", "drain_evicted"),
+    )
+    expected = {}  # job key -> [record event names, in log order]
+    for line in log:
+        for prefix, _source, event in line_specs:
+            at = line.find(prefix)
+            if at < 0:
+                continue
+            key = line[at + len(prefix):].split()[0]
+            expected.setdefault(key, []).append(event)
+    assert expected, "scenario produced no scheduler decisions"
+    assert any(v.count("preempted") for v in expected.values())
+    assert any(v.count("drain_evicted") for v in expected.values())
+
+    for key, want in expected.items():
+        got = [
+            e["event"] for e in _events(rec, key, source="scheduler")
+            if e["event"] in ("gang_admitted", "preempted", "drain_evicted")
+        ]
+        assert got == want, (key, got, want)
+
+    # every injected kill booked against a job appears exactly once in
+    # that job's timeline as a chaos record (and the pod named in each
+    # record is unique — no double stamping)
+    kill_lines = [ln for ln in log if " kill pod=" in ln]
+    assert kill_lines, "scenario injected no kills"
+    total_records = 0
+    for (key, rtype), n in {
+        **inj.retryable_kills, **inj.permanent_kills
+    }.items():
+        kills = _events(rec, key, source="chaos", event="kill")
+        mine = [e for e in kills if e["detail"]["replica_type"] == rtype]
+        assert len(mine) == n, (key, rtype, len(mine), n)
+        assert all(e["detail"]["pod"].startswith("default/") for e in mine)
+        total_records += len(mine)
+    booked = sum(inj.retryable_kills.values()) + sum(
+        inj.permanent_kills.values()
+    )
+    assert total_records == booked
+    # ... and in log order per job: timeline chaos records are
+    # timestamped by the same sim clock the log is
+    for key in {k for (k, _r) in inj.retryable_kills}:
+        ts = [e["t"] for e in _events(rec, key, source="chaos")]
+        assert ts == sorted(ts)
+
+    # the preemption pair: victim names beneficiary and vice versa
+    lo_preempted = _events(rec, "default/caus-lo", source="scheduler",
+                           event="preempted")
+    assert lo_preempted and all(
+        e["detail"]["by"] == "default/caus-hi" for e in lo_preempted
+    )
+    hi_won = _events(rec, "default/caus-hi", source="scheduler",
+                     event="preemption")
+    assert hi_won and all(
+        e["detail"]["victim"] == "default/caus-lo" for e in hi_won
+    )
+    # the parked gang's shortfall math is IN the timeline
+    pending = _events(rec, "default/caus-lo", source="scheduler",
+                      event="gang_pending")
+    assert pending and "waiting for capacity" in pending[0]["detail"]["message"]
+
+
+def test_causality_soak_is_deterministic_with_recorder_on():
+    _, _, _, log1 = run_causality_soak(1337)
+    _, _, _, log2 = run_causality_soak(1337)
+    assert log1 == log2
+
+
+# ------------------------------------------------------------- SLO metrics
+def _reset_slo_metrics():
+    metrics.JOB_TIME_TO_SCHEDULED.reset()
+    metrics.JOB_TIME_TO_RUNNING.reset()
+    metrics.JOB_RESTART_MTTR.reset()
+
+
+def test_slo_histograms_derive_from_milestones_and_round_trip_metrics():
+    _reset_slo_metrics()
+    clock = SimClock()
+    rec = FlightRecorder(events_per_job=64, max_jobs=16, clock=clock)
+    key = "default/slo"
+    rec.record(key, "informer", "job_added", {}, uid="u1")     # t=0: created
+    clock.advance(2.0)
+    rec.record(key, "scheduler", "gang_admitted", {"members": 1}, uid="u1")
+    clock.advance(3.0)
+    rec.record(key, "controller", "condition",
+               {"type": "Running", "reason": "JobRunning"}, uid="u1")
+    # failure at t=5 -> repaired at t=12: MTTR 7 (clock starts at the
+    # injected kill, not the later Restarting condition)
+    clock.advance(0.0)
+    rec.record(key, "chaos", "kill", {"pod": "default/slo-worker-0",
+                                      "exit_code": 137,
+                                      "replica_type": "worker"}, uid="u1")
+    clock.advance(1.0)
+    rec.record(key, "controller", "condition",
+               {"type": "Restarting", "reason": "JobRestarting"}, uid="u1")
+    clock.advance(6.0)
+    rec.record(key, "controller", "condition",
+               {"type": "Running", "reason": "JobRunning"}, uid="u1")
+
+    slo = rec.slo(key)
+    assert slo["time_to_scheduled_s"] == pytest.approx(2.0)
+    assert slo["time_to_running_s"] == pytest.approx(5.0)
+    assert slo["last_restart_mttr_s"] == pytest.approx(7.0)
+    assert metrics.JOB_TIME_TO_SCHEDULED.count() == 1
+    assert metrics.JOB_TIME_TO_RUNNING.count() == 1
+    assert metrics.JOB_RESTART_MTTR.count() == 1
+    # time-to-running observed ONCE per job, not per Running transition
+    assert metrics.JOB_TIME_TO_RUNNING.percentiles([0.5])[0.5] == 5.0
+
+    # round-trip through the Prometheus exposition on a real socket
+    srv = HealthServer(recorder=rec)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics"
+        ) as r:
+            text = r.read().decode()
+        for family in (
+            "tpu_operator_job_time_to_scheduled_seconds",
+            "tpu_operator_job_time_to_running_seconds",
+            "tpu_operator_job_restart_mttr_seconds",
+            "tpu_operator_job_timeline_events_total",
+            "tpu_operator_job_timeline_evictions_total",
+        ):
+            assert f"# TYPE {family}" in text, family
+        assert "tpu_operator_job_time_to_running_seconds_count 1" in text
+        assert "tpu_operator_job_restart_mttr_seconds_count 1" in text
+        # ...and the timeline endpoint serves the same story as JSON
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/timeline/default/slo"
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["job"] == key and doc["slo"]["time_to_running_s"] == 5.0
+        assert [e["event"] for e in doc["events"]][:2] == [
+            "job_added", "gang_admitted"
+        ]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/timeline"
+        ) as r:
+            assert json.loads(r.read())["jobs"] == [key]
+        # unknown job and disabled-recorder answers are clean 404s
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/timeline/default/nope"
+            )
+        assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_timeline_records_only_durably_persisted_conditions():
+    """The Running milestone must come from a SUCCESSFUL status write:
+    an end-to-end engine drive records condition transitions exactly
+    once each (Created, then Running)."""
+    from tests.test_engine import reconcile
+    from tests.test_warmpool import pool_engine, submit
+
+    cluster = FakeCluster()
+    engine = pool_engine(cluster, None)
+    rec = FlightRecorder(events_per_job=64, max_jobs=16)
+    engine.recorder = rec
+    engine.warm_pool = None
+    job = submit(cluster, testutil.new_tfjob("durable", worker=2))
+    reconcile(cluster, engine, job)
+    for pod in cluster.list_pods():
+        pod["status"]["phase"] = objects.POD_RUNNING
+        cluster.update_pod(pod)
+    reconcile(cluster, engine, job)
+    conds = _events(rec, "default/durable", source="controller",
+                    event="condition")
+    assert [c["detail"]["type"] for c in conds] == ["Created", "Running"]
+    # replaying the same state records no duplicate transitions
+    reconcile(cluster, engine, job)
+    conds = _events(rec, "default/durable", source="controller",
+                    event="condition")
+    assert [c["detail"]["type"] for c in conds] == ["Created", "Running"]
+    # the sync bridge carried the span phases
+    syncs = _events(rec, "default/durable", source="sync")
+    assert syncs and "pod_reconcile" in syncs[0]["detail"]["phases"]
+    # without a scheduler, the first pod create marks "scheduled"
+    assert rec.slo("default/durable")["time_to_scheduled_s"] >= 0
+
+
+# --------------------------------------------------------- warm pool seam
+def test_warm_claim_and_miss_land_in_the_claiming_jobs_timeline():
+    from tests.test_engine import reconcile
+    from tests.test_warmpool import (
+        make_pool, mark_pool_running, pool_engine, submit,
+    )
+
+    cluster = FakeCluster()
+    pool = make_pool(cluster, sizes={"v5e-1": 1})
+    pool.resync()
+    pool.replenish()
+    mark_pool_running(cluster)
+    rec = FlightRecorder(events_per_job=64, max_jobs=16)
+    pool.recorder = rec
+    engine = pool_engine(cluster, pool)
+    engine.recorder = rec
+    # 2 workers, 1 ready standby: one warm claim, one miss-then-cold
+    job = submit(cluster, testutil.new_tfjob("warmrec", worker=2))
+    reconcile(cluster, engine, job)
+    hits = _events(rec, "default/warmrec", source="warmpool",
+                   event="warm_claim")
+    misses = _events(rec, "default/warmrec", source="warmpool",
+                     event="warm_miss")
+    assert len(hits) == 1 and hits[0]["detail"]["shape"] == "v5e-1"
+    assert hits[0]["detail"]["pod"].startswith("warm-")
+    assert len(misses) == 1 and misses[0]["detail"]["reasons"] == ["empty"]
+    # exactly one WarmPodClaimed cluster event matches the one hit
+    claimed_events = [
+        e for e in cluster.events_for("warmrec")
+        if e.get("reason") == "WarmPodClaimed"
+    ]
+    assert len(claimed_events) == len(hits) == 1
+
+
+# ------------------------------------------------------------ fencing seam
+def test_fenced_mid_sync_is_stamped_into_the_timeline():
+    cluster = FakeCluster()
+    opts = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    mgr = OperatorManager(cluster, opts)
+    assert mgr.recorder is not None  # default-on
+    ctl = mgr.controllers["TFJob"]
+    cluster.create("TFJob", testutil.new_tfjob("fencedrec", worker=1).to_dict())
+
+    def fenced_reconcile(job, corr_id=None):
+        raise StaleFencingTokenError(
+            "stale fencing token: lease generation moved on"
+        )
+
+    ctl.engine.reconcile = fenced_reconcile
+    ctl._sync_guarded("default/fencedrec")
+    fenced = _events(mgr.recorder, "default/fencedrec", source="fencing")
+    assert len(fenced) == 1
+    assert fenced[0]["event"] == "fenced_mid_sync"
+    assert "stale" in fenced[0]["detail"]["error"]
+
+
+# ------------------------------------------- sharded ownership continuity
+def test_failover_moves_the_appender_not_the_timeline():
+    """One recorder per process: a slot failover changes which shard
+    appends, never which ring holds the story — the job's timeline spans
+    the crash with no loss, no duplicate milestones, and the move itself
+    recorded."""
+    from tf_operator_tpu.cmd.manager import ShardedOperator
+    from tf_operator_tpu.k8s.chaos import FaultInjector
+
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=3, clock=clock)
+    opts = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    op = ShardedOperator(
+        inner, opts, shard_count=2, engine_kwargs={"clock": clock},
+        clock=clock, lease_duration=10.0,
+    )
+    rec = op.recorder
+    assert rec is not None
+    for s in op.shards:
+        for ctl in s.manager.controllers.values():
+            ctl.queue = DeterministicQueue()
+    uid = next(u for u in (f"u{i}" for i in range(50))
+               if op.router.slot_for(u) == 0)
+    job = testutil.new_tfjob("moverec", worker=1)
+    job.metadata["uid"] = uid
+    op.start(workers=False)
+    inner.create("TFJob", job.to_dict())
+
+    def settle(rounds=6, dt=2.0):
+        for _ in range(rounds):
+            inj.step(dt)
+            op.tick()
+            for _i in range(100):
+                busy = False
+                for s in op.shards:
+                    if s.crashed:
+                        continue
+                    for ctl in s.manager.controllers.values():
+                        k = ctl.queue.get(timeout=0)
+                        if k is None:
+                            continue
+                        busy = True
+                        try:
+                            ctl._sync_guarded(k)
+                        finally:
+                            ctl.queue.done(k)
+                if not busy:
+                    break
+
+    try:
+        settle()
+        key = "default/moverec"
+        before = len(_events(rec, key))
+        conds_before = [
+            c["detail"]["type"]
+            for c in _events(rec, key, source="controller",
+                             event="condition")
+        ]
+        assert "Running" in conds_before
+        op.crash_shard(0)
+        clock.advance(11.0)
+        settle()
+        assert op.slot_owner(0) == 1
+        # the SAME ring kept growing across the move...
+        after = _events(rec, key)
+        assert len(after) > before
+        # ...the move is in the story...
+        moves = _events(rec, key, source="shard", event="failover_adopt")
+        assert len(moves) == 1 and moves[0]["detail"]["shard"] == "shard-1"
+        # ...and no milestone was duplicated by the re-adopt resync
+        conds = [c["detail"]["type"]
+                 for c in _events(rec, key, source="controller",
+                                  event="condition")]
+        assert conds == conds_before
+    finally:
+        op.stop()
+
+
+# ------------------------------------------------------- /debug/traces
+def test_debug_traces_category_and_limit_filters():
+    tracer = tracing.Tracer()
+    with tracer.span("reconcile_a"):
+        pass
+    with tracer.span("reconcile_b"):
+        pass
+    serving_root = None
+    with tracer.span("request") as sp:
+        sp.category = "serving"
+        serving_root = sp
+    assert serving_root.duration is not None
+    rec = FlightRecorder(events_per_job=8, max_jobs=4)
+    rec.record("default/lane", "informer", "job_added", {})
+    srv = HealthServer(tracer=tracer, recorder=rec)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}/debug/traces"
+    try:
+        def fetch(qs=""):
+            with urllib.request.urlopen(base + qs) as r:
+                return json.loads(r.read())["traceEvents"]
+
+        everything = fetch()
+        names = {e["name"] for e in everything}
+        assert {"reconcile_a", "reconcile_b", "request",
+                "job_added"} <= names
+        # ?category= separates reconcile / serving / timeline lanes
+        reconcile_only = {e["name"] for e in fetch("?category=reconcile")}
+        assert "request" not in reconcile_only
+        assert "job_added" not in reconcile_only
+        assert {"reconcile_a", "reconcile_b"} <= reconcile_only
+        serving_only = {e["name"] for e in fetch("?category=serving")}
+        assert serving_only == {"request"}
+        lane_only = fetch("?category=timeline")
+        assert {e["name"] for e in lane_only} == {"thread_name", "job_added"}
+        # ?limit= keeps only the newest N root traces; combined with
+        # ?category= it means "the newest N traces OF that category" —
+        # the serving root between them must not eat the budget
+        # newest root overall is the serving request (timeline lanes
+        # always ride an unfiltered export)
+        last_all = {e["name"] for e in fetch("?limit=1")}
+        assert last_all == {"request", "thread_name", "job_added"}
+        last_one = {e["name"] for e in fetch("?limit=1&category=reconcile")}
+        assert last_one == {"reconcile_b"}
+        last_two = {e["name"] for e in fetch("?limit=2&category=reconcile")}
+        assert last_two == {"reconcile_a", "reconcile_b"}
+        assert fetch("?limit=0&category=reconcile") == []
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "?limit=bogus")
+        assert err.value.code == 400
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ SIGUSR1 dump
+def test_sigusr1_dumps_traces_and_live_timelines(tmp_path):
+    import os
+    import signal
+    import time as _time
+
+    from tf_operator_tpu.cmd import main as cmd_main
+
+    dump = tmp_path / "wedge.json"
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]),
+        trace_dump=str(dump),
+        health_probe_bind_address=":0",
+        metrics_bind_address=":0",
+    )
+    prev = signal.getsignal(signal.SIGUSR1)
+    cluster = FakeCluster()
+    manager = cmd_main.run(opts, cluster=cluster, block=False)
+    try:
+        cluster.create(
+            "TFJob", testutil.new_tfjob("sigrec", worker=1).to_dict()
+        )
+        manager.process_until_idle()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline and not dump.exists():
+            _time.sleep(0.01)
+        assert dump.exists(), "SIGUSR1 did not dump traces"
+        doc = json.loads(dump.read_text())
+        assert any(e["name"] == "reconcile" for e in doc["traceEvents"])
+        # the live timelines rode along, without waiting for shutdown
+        side = tmp_path / "wedge.json.timeline.json"
+        assert side.exists()
+        timelines = json.loads(side.read_text())["jobs"]
+        assert "default/sigrec" in timelines
+        assert any(
+            e["source"] == "sync"
+            for e in timelines["default/sigrec"]["events"]
+        )
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+        manager.stop()
+
+
+# ------------------------------------------------------------------- CLI
+def _drive_cli_job(rec):
+    from tests.test_engine import reconcile
+    from tests.test_warmpool import pool_engine, submit
+
+    cluster = FakeCluster()
+    engine = pool_engine(cluster, None)
+    engine.warm_pool = None
+    engine.recorder = rec
+    job = submit(cluster, testutil.new_tfjob("mnist", worker=1))
+    reconcile(cluster, engine, job)
+    for pod in cluster.list_pods():
+        pod["status"]["phase"] = objects.POD_RUNNING
+        cluster.update_pod(pod)
+    reconcile(cluster, engine, job)
+    return cluster
+
+
+def test_cli_timeline_verb_renders_table_and_json(capsys):
+    rec = FlightRecorder(events_per_job=64, max_jobs=16)
+    cluster = _drive_cli_job(rec)
+    cli = Cli(cluster, recorder=rec)
+
+    args = make_parser().parse_args(["timeline", "default", "mnist"])
+    assert cli_run(args, cli) == 0
+    out = capsys.readouterr().out
+    assert "Job:       default/mnist" in out
+    assert "SLO:" in out and "time-to-running" in out
+    # aligned columns: relative time, source, event, one-line detail
+    assert "SOURCE" in out and "EVENT" in out and "DETAIL" in out
+    assert "controller" in out and "condition" in out
+    assert "type=Running" in out
+    lines = [ln for ln in out.splitlines() if ln.lstrip().startswith("+")]
+    assert lines and all("s  " in ln for ln in lines)
+
+    args = make_parser().parse_args(
+        ["timeline", "default", "mnist", "--json"]
+    )
+    assert cli_run(args, cli) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["job"] == "default/mnist"
+    assert doc["slo"]["time_to_running_s"] >= 0
+    assert any(e["event"] == "condition" for e in doc["events"])
+
+    # unknown job / disabled recorder: clean errors, nonzero exit
+    args = make_parser().parse_args(["timeline", "default", "nope"])
+    assert cli_run(args, cli) == 1
+    assert "no timeline" in capsys.readouterr().err
+    off = Cli(cluster, recorder=FlightRecorder(events_per_job=0))
+    args = make_parser().parse_args(["timeline", "default", "mnist"])
+    assert cli_run(args, off) == 1
+    assert "disabled" in capsys.readouterr().err
+
+
+def test_cli_describe_gains_slo_summary_when_recorder_on(capsys):
+    rec = FlightRecorder(events_per_job=64, max_jobs=16)
+    cluster = _drive_cli_job(rec)
+    cli = Cli(cluster, recorder=rec)
+    args = make_parser().parse_args(["describe", "tfjob", "mnist"])
+    assert cli_run(args, cli) == 0
+    out = capsys.readouterr().out
+    assert "SLO:       time-to-scheduled=" in out
+    assert "           time-to-running=" in out
+    # recorder off: describe is exactly as before — no SLO lines
+    off = Cli(cluster, recorder=FlightRecorder(events_per_job=0))
+    args = make_parser().parse_args(["describe", "tfjob", "mnist"])
+    assert cli_run(args, off) == 0
+    assert "SLO:" not in capsys.readouterr().out
+
+
+# ------------------------------------------------------------ lint + bench
+def test_metric_lint_counts_the_slo_families():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names",
+        os.path.join(os.path.dirname(__file__), "..", "hack",
+                     "check_metric_names.py"),
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    assert lint.check_registry() == []
+    # the pinned contract: all five ISSUE 10 families present, by name
+    from tf_operator_tpu.engine import metrics as em
+
+    with em._LOCK:
+        names = {m.name for m in em._REGISTRY}
+    assert set(lint._REQUIRED_FAMILIES) <= names
+    # the asserted lint count: 64 families after the five SLO additions
+    with em._LOCK:
+        assert len(em._REGISTRY) == 64
+
+
+@pytest.mark.slow
+def test_bench_timeline_pair_reports_overhead():
+    from bench import bench_timeline
+
+    row = bench_timeline(n_jobs=8, threadiness=2, repeats=1)
+    assert row["jobs_per_sec_off"] and row["jobs_per_sec_on"]
+    assert "overhead_pct" in row and "overhead_ok" in row

@@ -182,7 +182,12 @@ def test_debug_traces_endpoint_serves_chrome_json():
     assert any(e["name"] == "reconcile" for e in events)
     phase_names = {e["name"] for e in events}
     assert {"pod_reconcile", "service_reconcile"} <= phase_names
+    # tracer spans are complete events; the flight recorder's per-job
+    # lanes (cat "timeline", ISSUE 10) ride the same export as instants
+    # and lane-name metadata — filter to the tracer's own events here
     for e in events:
+        if e.get("cat") == "timeline" or e.get("ph") == "M":
+            continue
         assert e["ph"] == "X" and e["dur"] >= 0
 
 

@@ -1,23 +1,35 @@
-"""Health probes + metrics + debug-trace endpoints.
+"""Health probes + metrics + debug-trace/timeline endpoints.
 
 Reference parity: /healthz and /readyz on the probe address (reference
 cmd/training-operator.v1/main.go:110-117, probed by the Deployment at
 manifests/base/deployment.yaml:35-45) and the Prometheus exposition on the
 metrics address (main.go:63, legacy --monitoring-port options.go:75-77).
-Beyond the reference: /debug/traces serves the reconcile span tracer's
-Chrome trace-event JSON (engine/tracing.py) — load it in chrome://tracing
-or Perfetto to see where inside each sync the time went.
+Beyond the reference:
+
+  - ``/debug/traces`` serves the reconcile span tracer's Chrome
+    trace-event JSON (engine/tracing.py), with one extra lane per job
+    from the flight recorder (engine/timeline.py) merged in — load it in
+    chrome://tracing or Perfetto to see syncs AND per-job causal stories
+    on one timeline.  ``?category=`` keeps only spans of that category
+    (reconcile / serving / timeline) and ``?limit=N`` keeps only the
+    most recent N root traces.
+  - ``/debug/timeline`` lists the recorder's tracked jobs;
+    ``/debug/timeline/<ns>/<name>`` serves one job's full timeline
+    (records + derived SLOs) as JSON — the payload
+    ``tpu-jobs timeline`` renders.
 
 Every response carries Content-Length: keep-alive scrape clients would
 otherwise wait on an unterminated body until the connection times out.
 """
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, unquote
 
-from tf_operator_tpu.engine import metrics, tracing
+from tf_operator_tpu.engine import metrics, timeline, tracing
 
 Check = Callable[[], bool]
 
@@ -25,6 +37,7 @@ Check = Callable[[], bool]
 class _Handler(BaseHTTPRequestHandler):
     checks: Dict[str, Check] = {}
     tracer: Optional[tracing.Tracer] = None
+    recorder: Optional[timeline.FlightRecorder] = None
 
     def log_message(self, fmt, *args):  # silence per-request stderr noise
         pass
@@ -36,18 +49,68 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _json(self, doc, status: int = 200) -> None:
+        self._respond(status, json.dumps(doc).encode(), "application/json")
+
+    def _recorder(self) -> timeline.FlightRecorder:
+        return self.recorder or timeline.get_recorder()
+
+    def _serve_traces(self, params: Dict[str, list]) -> None:
+        tracer = self.tracer or tracing.get_tracer()
+        category = (params.get("category") or [None])[0]
+        raw_limit = (params.get("limit") or [None])[0]
+        limit = None
+        if raw_limit is not None:
+            try:
+                limit = max(0, int(raw_limit))
+            except ValueError:
+                self._respond(400, b"limit must be an integer")
+                return
+        doc = tracer.to_chrome_trace(category=category, limit=limit)
+        rec = self._recorder()
+        # the per-job flight-recorder lanes ride the same export (cat
+        # "timeline"), separable by the same ?category= axis; ?limit=
+        # bounds the lanes too (newest N records per job) — a filter
+        # meant to shrink the response must not ship every ring whole
+        if rec.enabled and category in (None, "timeline"):
+            doc["traceEvents"].extend(rec.chrome_events(per_job=limit))
+        self._json(doc)
+
+    def _serve_timeline(self, rest: str) -> None:
+        rec = self._recorder()
+        if not rec.enabled:
+            self._respond(404, b"timeline recorder disabled "
+                               b"(--timeline-events-per-job 0)")
+            return
+        if not rest:
+            self._json({"jobs": rec.jobs()})
+            return
+        namespace, _, name = rest.partition("/")
+        if not name or "/" in name:
+            self._respond(404, b"want /debug/timeline/<namespace>/<name>")
+            return
+        doc = rec.timeline(f"{unquote(namespace)}/{unquote(name)}")
+        if doc is None:
+            self._respond(
+                404,
+                f"no timeline for {unquote(namespace)}/{unquote(name)}".encode(),
+            )
+            return
+        self._json(doc)
+
     def do_GET(self):  # noqa: N802 (stdlib API name)
-        path = self.path.split("?")[0]
+        path, _, query = self.path.partition("?")
+        params = parse_qs(query)
         if path == "/metrics":
             self._respond(
                 200, metrics.expose_all().encode(), "text/plain; version=0.0.4"
             )
             return
         if path == "/debug/traces":
-            tracer = self.tracer or tracing.get_tracer()
-            self._respond(
-                200, tracer.export_chrome_json().encode(), "application/json"
-            )
+            self._serve_traces(params)
+            return
+        if path == "/debug/timeline" or path.startswith("/debug/timeline/"):
+            self._serve_timeline(path[len("/debug/timeline"):].strip("/"))
             return
         check = self.checks.get(path)
         if check is None:
@@ -62,9 +125,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class HealthServer:
-    """Serves /healthz, /readyz, /metrics, and /debug/traces on one
-    listener. Bind with port 0 to get an ephemeral port (tests read .port
-    after start). `tracer` defaults to the process-global span tracer."""
+    """Serves /healthz, /readyz, /metrics, /debug/traces, and
+    /debug/timeline on one listener. Bind with port 0 to get an ephemeral
+    port (tests read .port after start). `tracer` defaults to the
+    process-global span tracer, `recorder` to the process-global flight
+    recorder (disabled unless an operator configured one)."""
 
     def __init__(
         self,
@@ -73,6 +138,7 @@ class HealthServer:
         healthz: Optional[Check] = None,
         readyz: Optional[Check] = None,
         tracer: Optional[tracing.Tracer] = None,
+        recorder: Optional[timeline.FlightRecorder] = None,
     ) -> None:
         handler = type("Handler", (_Handler,), {})
         handler.checks = {
@@ -80,6 +146,7 @@ class HealthServer:
             "/readyz": readyz or (lambda: True),
         }
         handler.tracer = tracer
+        handler.recorder = recorder
         self._server = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 

@@ -111,12 +111,14 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
     else:
         manager = OperatorManager(cluster, options)
 
+    recorder = getattr(manager, "recorder", None)
     health_host, health_port = split_bind_address(options.health_probe_bind_address)
     probe = HealthServer(
         host=health_host,
         port=health_port,
         healthz=lambda: manager.healthy,
         readyz=lambda: manager.ready,
+        recorder=recorder,
     )
     probe.start()
     log.info("health probes on :%d", probe.port)
@@ -124,7 +126,9 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
     # separate metrics listener (reference --metrics-bind-address :8080,
     # main.go:63; the probe port also serves /metrics for convenience)
     metrics_host, metrics_port = split_bind_address(options.metrics_bind_address)
-    metrics_srv = HealthServer(host=metrics_host, port=metrics_port)
+    metrics_srv = HealthServer(
+        host=metrics_host, port=metrics_port, recorder=recorder
+    )
     metrics_srv.start()
     log.info("metrics on :%d", metrics_srv.port)
 
@@ -144,29 +148,67 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
 
     stop_event = threading.Event()
 
-    def dump_traces():
-        if not options.trace_dump:
-            return
+    def dump_debug_state(path=None):
+        """Write the Chrome trace export (reconcile/serving spans + one
+        flight-recorder lane per job) to `path`, and every live timeline
+        as JSON beside it.  The shutdown path uses --trace-dump; SIGUSR1
+        falls back to a pid-stamped /tmp path so a wedged operator is
+        inspectable even when the flag was never set."""
+        import json as _json
+
         from tf_operator_tpu.engine import tracing
 
+        path = path or options.trace_dump
+        if not path:
+            return
         try:
-            tracing.get_tracer().dump(options.trace_dump)
-            log.info("reconcile traces dumped to %s", options.trace_dump)
+            doc = tracing.get_tracer().to_chrome_trace()
+            if recorder is not None and recorder.enabled:
+                doc["traceEvents"].extend(recorder.chrome_events())
+            with open(path, "w") as fh:
+                _json.dump(doc, fh)
+            log.info("reconcile traces dumped to %s", path)
+            if recorder is not None and recorder.enabled:
+                recorder.dump(path + ".timeline.json")
+                log.info("job timelines dumped to %s.timeline.json", path)
         except OSError as e:
             log.warning("trace dump failed: %s", e)
+
+    def dump_traces():
+        dump_debug_state()
+
+    # SIGUSR1: dump traces + all live timelines NOW — --trace-dump only
+    # fires on clean shutdown, which a wedged operator never reaches.
+    # Registration needs the main thread (tests embed run() in worker
+    # threads; they call dump_debug_state directly).
+    if (
+        hasattr(signal, "SIGUSR1")
+        and threading.current_thread() is threading.main_thread()
+    ):
+        fallback = f"/tmp/tpu-operator-{os.getpid()}-traces.json"
+        signal.signal(
+            signal.SIGUSR1,
+            lambda *_: dump_debug_state(options.trace_dump or fallback),
+        )
 
     def start_manager():
         manager.start()
         pool = getattr(manager, "warm_pool", None)
         sched = getattr(manager, "scheduler", None)
         log.info(
-            "manager started: kinds=%s shards=%d warm_pool=%s scheduler=%s",
+            "manager started: kinds=%s shards=%d warm_pool=%s scheduler=%s "
+            "timeline=%s",
             options.all_kinds,
             getattr(manager, "shard_count", 1),
             dict(pool.config.sizes) if pool is not None else "off",
             (
                 f"{sched.policy_name} over {len(sched.free_chips())} node(s)"
                 if sched is not None else "off"
+            ),
+            (
+                f"{recorder.events_per_job} ev/job, "
+                f"{recorder.max_jobs} jobs"
+                if recorder is not None else "off"
             ),
         )
 

@@ -37,6 +37,7 @@ from tf_operator_tpu.cmd.leader import LeaseLock
 from tf_operator_tpu.cmd.options import ServerOptions
 from tf_operator_tpu.controllers.registry import make_engine
 from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine import timeline as timeline_mod
 from tf_operator_tpu.engine.controller import EngineConfig
 from tf_operator_tpu.engine.sharding import ShardRouter
 from tf_operator_tpu.engine.warmpool import (
@@ -107,6 +108,30 @@ DEFAULT_SCHEDULER_TOPOLOGY = (
     "tpu-node-2=v5e-8",
     "tpu-node-3=v5e-8",
 )
+
+
+def build_recorder(options: ServerOptions, engine_kwargs=None):
+    """One job flight recorder per operator process, or None when
+    --timeline-events-per-job is 0.  Shared by every shard's engines —
+    reservations of a job's story survive slot failover because there is
+    only one store to begin with.  Registered as the process default so
+    the /debug endpoints and an in-process CLI find it unwired."""
+    if options.timeline_events_per_job <= 0:
+        # reset the process default too: a recorder-off operator built
+        # after a recorder-on one (bench pairs, test sequences) must not
+        # leave the /debug endpoints and CLI serving the PREVIOUS
+        # manager's stale timelines through the global fallback
+        timeline_mod.set_recorder(
+            timeline_mod.FlightRecorder(events_per_job=0)
+        )
+        return None
+    recorder = timeline_mod.FlightRecorder(
+        events_per_job=options.timeline_events_per_job,
+        max_jobs=options.timeline_max_jobs,
+        clock=(engine_kwargs or {}).get("clock", time.time),
+    )
+    timeline_mod.set_recorder(recorder)
+    return recorder
 
 
 def build_warm_pool(cluster, options: ServerOptions, engine_kwargs=None):
@@ -182,6 +207,10 @@ class _KindController:
         # cluster scheduler (engine/scheduler.py): one per process, shared
         # by every kind and shard; None bypasses gang admission entirely
         self.engine.scheduler = manager.scheduler
+        # job flight recorder (engine/timeline.py): one per process,
+        # shared by every kind and shard; None bypasses every seam
+        self.recorder = manager.recorder
+        self.engine.recorder = manager.recorder
         self.informer.add_event_handler(
             ResourceEventHandler(
                 add_func=self._on_add,
@@ -194,6 +223,11 @@ class _KindController:
         # wins (client-go workqueue dedups, so the oldest pending event
         # defines how long the key waited), popped when a worker syncs
         self._enqueue_times: Dict[str, float] = {}
+        # correlation ids for the flight recorder: stamped once per
+        # pending key at enqueue (dedup'd exactly like the timestamp),
+        # popped at dispatch and threaded through the sync so the
+        # timeline ties "waited in queue" to "this sync's phases"
+        self._corr_ids: Dict[str, int] = {}
         self._enqueue_lock = threading.Lock()
         # the transient backoff ladder: a rate limiter OF ITS OWN, distinct
         # from the queue's (whose failure counter is the bounded retry
@@ -219,16 +253,31 @@ class _KindController:
     # the informer handlers: job.go:30-37, controller.go:70-77)
     def _on_add(self, obj) -> None:
         if self._in_scope(obj):
+            self._record_informer("job_added", obj)
             self.enqueue(objects.key_of(obj))
 
     def _on_update(self, old, new) -> None:
         if self._in_scope(new):
+            self._record_informer("job_modified", new)
             self.enqueue(objects.key_of(new))
 
     def _on_delete(self, obj) -> None:
         if self._in_scope(obj):
             metrics.JOBS_DELETED.inc({"job_namespace": objects.namespace_of(obj)})
+            self._record_informer("job_deleted", obj)
             self.enqueue(objects.key_of(obj))
+
+    def _record_informer(self, event: str, obj) -> None:
+        """Flight-recorder seam: the job's own informer deliveries, with
+        the resourceVersion so a timeline can be matched against the
+        store's history."""
+        if self.recorder is None:
+            return
+        md = obj.get("metadata") or {}
+        self.recorder.record(
+            objects.key_of(obj), "informer", event,
+            {"rv": md.get("resourceVersion")}, uid=md.get("uid"),
+        )
 
     def _stamp(self, key: str, due: float) -> None:
         """Record when the key became (or will become) DUE for work; the
@@ -244,8 +293,29 @@ class _KindController:
             if cur is None or due < cur:
                 self._enqueue_times[key] = due
 
+    def _record_enqueue(self, key: str, event: str = "enqueue",
+                        delay: Optional[float] = None) -> None:
+        """Stamp a correlation id (once per pending key — dedup'd like
+        the enqueue timestamp) and record the enqueue.  Requeues of a key
+        already pending record nothing: the workqueue dedups them, so one
+        queue wait gets one enqueue/dequeue pair."""
+        rec = self.recorder
+        if rec is None or not rec.enabled:
+            return
+        with self._enqueue_lock:
+            new = key not in self._corr_ids
+            if new:
+                self._corr_ids[key] = rec.next_corr()
+            corr = self._corr_ids[key]
+        if new:
+            detail: Dict[str, object] = {"corr": corr}
+            if delay is not None and delay > 0:
+                detail["delay"] = round(delay, 3)
+            rec.record(key, "workqueue", event, detail)
+
     def enqueue(self, key: str) -> None:
         self._stamp(key, time.monotonic())
+        self._record_enqueue(key)
         self.queue.add(key)
         self._update_depth()
 
@@ -264,6 +334,12 @@ class _KindController:
             if key not in self._enqueue_times:
                 self._enqueue_times[key] = now
                 placed = True
+        # corr stamped BEFORE the add (no delay detail on this path —
+        # the rate limiter only reveals it after the add, and a worker
+        # can dequeue the key the instant it lands; a corr allocated
+        # after that would orphan the dequeue and poison the NEXT
+        # cycle's pairing)
+        self._record_enqueue(key, event="requeue")
         delay = self.queue.add_rate_limited(key)
         if not isinstance(delay, (int, float)):
             delay = 0.0  # queue double that predates the return-delay contract
@@ -275,6 +351,7 @@ class _KindController:
 
     def _requeue_after(self, key: str, delay: float) -> None:
         self._stamp(key, time.monotonic() + max(0.0, delay))
+        self._record_enqueue(key, event="requeue", delay=delay)
         self.queue.add_after(key, delay)
         self._update_depth()
 
@@ -301,12 +378,17 @@ class _KindController:
         t0 = time.monotonic()
         with self._enqueue_lock:
             enqueued_at = self._enqueue_times.pop(key, None)
+            corr = self._corr_ids.pop(key, None)
         if enqueued_at is not None:
             # clamp: a delayed requeue stamps its DUE time, and a fresh
             # event can pull the key into work before that instant
-            metrics.WORKQUEUE_LATENCY.observe(
-                max(0.0, t0 - enqueued_at), {"kind": self.kind}
-            )
+            wait = max(0.0, t0 - enqueued_at)
+            metrics.WORKQUEUE_LATENCY.observe(wait, {"kind": self.kind})
+            if self.recorder is not None and corr is not None:
+                self.recorder.record(
+                    key, "workqueue", "dequeue",
+                    {"corr": corr, "wait": round(wait, 6)},
+                )
         self._update_depth()
         try:
             raw = self.manager.cluster.get(self.kind, namespace, name)
@@ -336,7 +418,7 @@ class _KindController:
             self._requeue_transient(key)
             return
         job = self.engine.adapter.from_dict(raw)
-        result = self.engine.reconcile(job)
+        result = self.engine.reconcile(job, corr_id=corr)
         metrics.RECONCILE_DURATION.observe(
             time.monotonic() - t0, {"kind": self.kind}
         )
@@ -401,6 +483,12 @@ class _KindController:
             # token (requeue would only re-fence until the lease tick
             # disowns the slot)
             logger_for_key(self.kind, key).warning("fenced mid-sync: %s", e)
+            if self.recorder is not None:
+                # the rejection is the moment this shard's story of the
+                # job ENDS (the new owner's syncs continue it) — stamp it
+                self.recorder.record(
+                    key, "fencing", "fenced_mid_sync", {"error": str(e)},
+                )
             self._clear_failures(key)
             self.engine.disown_job(key)
         except Exception as e:  # noqa: BLE001 — workers must not die
@@ -450,6 +538,7 @@ class OperatorManager:
         shard=None,
         warm_pool=None,
         scheduler=None,
+        recorder=None,
     ) -> None:
         """`engine_kwargs` is forwarded to every kind's JobEngine — the seam
         tests use to inject a simulated clock (chaos soak) or alternate
@@ -482,6 +571,18 @@ class OperatorManager:
             scheduler = build_scheduler(cluster, self.options, engine_kwargs)
             self._owns_scheduler = scheduler is not None
         self.scheduler = scheduler
+        # job flight recorder: a shard instance is handed the
+        # coordinator's shared one; a standalone manager builds its own
+        # when --timeline-events-per-job enables it (None = every
+        # recording seam bypassed)
+        if recorder is None and shard is None:
+            recorder = build_recorder(self.options, engine_kwargs)
+        self.recorder = recorder
+        if self.recorder is not None:
+            if self.warm_pool is not None:
+                self.warm_pool.recorder = self.recorder
+            if self.scheduler is not None:
+                self.scheduler.recorder = self.recorder
         self.factory = factory or SharedInformerFactory(
             cluster, resync_period=self.options.resync_period
         )
@@ -493,9 +594,12 @@ class OperatorManager:
             inf = self.factory.for_kind(dep_kind)
             inf.add_event_handler(
                 ResourceEventHandler(
-                    add_func=self._on_dependent,
-                    update_func=lambda old, new: self._on_dependent(new),
-                    delete_func=self._on_dependent,
+                    add_func=lambda obj, k=dep_kind: self._on_dependent(
+                        obj, k, "added"),
+                    update_func=lambda old, new, k=dep_kind:
+                    self._on_dependent(new, k, "modified"),
+                    delete_func=lambda obj, k=dep_kind: self._on_dependent(
+                        obj, k, "deleted"),
                 )
             )
         self._started = False
@@ -513,10 +617,14 @@ class OperatorManager:
         return self.shard.may_act((obj.get("metadata") or {}).get("uid"))
 
     # ------------------------------------------------------------- dependents
-    def _on_dependent(self, obj) -> None:
+    def _on_dependent(self, obj, dep_kind: str = "", etype: str = "") -> None:
         """Route a Pod/Service event to its controlling job's queue —
         sharded: only when this shard owns the controlling job (the
-        ownerReference carries the job UID the rendezvous hash keys on)."""
+        ownerReference carries the job UID the rendezvous hash keys on).
+        ADDED/DELETED deliveries are also stamped into the owning job's
+        timeline (MODIFIED — every kubelet status write — is deliberately
+        not: it is the chattiest delivery and says nothing causal the
+        pod's add/delete and the sync records don't already say)."""
         ref = objects.get_controller_of(obj)
         if not ref:
             return
@@ -526,6 +634,15 @@ class OperatorManager:
         if not self._owns_uid(ref.get("uid")):
             return
         key = f"{objects.namespace_of(obj)}/{ref.get('name', '')}"
+        if (
+            self.recorder is not None
+            and dep_kind
+            and etype in ("added", "deleted")
+        ):
+            self.recorder.record(
+                key, "informer", f"{dep_kind.lower()}_{etype}",
+                {"name": objects.name_of(obj)}, uid=ref.get("uid"),
+            )
         ctl.enqueue(key)
 
     # ------------------------------------------------------------- lifecycle
@@ -663,6 +780,7 @@ class _Shard:
             shard=self.handle,
             warm_pool=op.warm_pool,
             scheduler=op.scheduler,
+            recorder=op.recorder,
         )
 
 
@@ -742,6 +860,16 @@ class ShardedOperator:
         # are keyed by job UID, so slot failover moves a job between
         # shards without touching its placement
         self.scheduler = build_scheduler(cluster, self.options, engine_kwargs)
+        # one flight recorder for the whole control plane: ownership
+        # moves change which shard APPENDS, never which ring holds the
+        # job's story — a failover neither loses nor duplicates a
+        # timeline because there is exactly one per job to begin with
+        self.recorder = build_recorder(self.options, engine_kwargs)
+        if self.recorder is not None:
+            if self.warm_pool is not None:
+                self.warm_pool.recorder = self.recorder
+            if self.scheduler is not None:
+                self.scheduler.recorder = self.recorder
         self.shards: List[_Shard] = [
             _Shard(self, i) for i in range(shard_count)
         ]
@@ -850,6 +978,14 @@ class ShardedOperator:
             for kind, key in self._jobs_in_slot(shard.manager, slot):
                 ctl = shard.manager.controllers[kind]
                 ctl.engine.disown_job(key)
+                if failover and self.recorder is not None:
+                    # the ownership move, in the job's own story: the
+                    # shared recorder keeps the ring — only the appender
+                    # changes
+                    self.recorder.record(
+                        key, "shard", "failover_adopt",
+                        {"slot": slot, "shard": shard.id},
+                    )
                 ctl.enqueue(key)
                 adopted += 1
         if failover:

@@ -82,6 +82,14 @@ class ServerOptions:
     # Node inventory specs, NAME=SHAPE[:GEN] (repeatable --node); empty
     # uses the built-in default topology (cmd/manager.py)
     scheduler_nodes: List[str] = field(default_factory=list)
+    # job flight recorder (engine/timeline.py): per-job causal timeline
+    # every subsystem appends to, served at /debug/timeline/<ns>/<name>
+    # and by `tpu-jobs timeline`, with derived per-job SLO histograms.
+    # events-per-job bounds each job's ring; 0 disables the recorder
+    # entirely and bypasses every recording seam.  max-jobs caps tracked
+    # jobs (LRU-evicting finished ones).
+    timeline_events_per_job: int = 256
+    timeline_max_jobs: int = 1000
     # when True (default), reconcile errors the client layer classified as
     # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
     # consuming the bounded reconcile-retry budget; False restores the
@@ -226,6 +234,22 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "pool-a=v5e-8 or fast-0=v5e-8:v5p (repeatable); empty uses a "
         "built-in 4x v5e-8 default topology",
     )
+    p.add_argument(
+        "--timeline-events-per-job",
+        type=int,
+        default=256,
+        help="job flight recorder: keep this many records per job's "
+        "timeline ring (served at /debug/timeline/<ns>/<name> and by "
+        "`tpu-jobs timeline`, with derived per-job SLO histograms); "
+        "0 disables the recorder entirely",
+    )
+    p.add_argument(
+        "--timeline-max-jobs",
+        type=int,
+        default=1000,
+        help="job flight recorder: cap on tracked jobs; finished jobs "
+        "are LRU-evicted past the cap (live jobs never are)",
+    )
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -274,4 +298,6 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         scheduler_enabled=a.scheduler_enabled,
         scheduler_policy=a.scheduler_policy,
         scheduler_nodes=list(a.node),
+        timeline_events_per_job=a.timeline_events_per_job,
+        timeline_max_jobs=a.timeline_max_jobs,
     )

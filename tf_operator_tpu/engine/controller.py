@@ -202,6 +202,10 @@ class JobEngine:
         # is bound to its reserved node; None bypasses every seam — the
         # pre-scheduler engine, byte-identical
         self.scheduler: Optional[Any] = None
+        # job flight recorder (engine/timeline.py): wired by the manager
+        # when --timeline-events-per-job > 0; one per process, shared
+        # across shards.  None bypasses every recording seam.
+        self.recorder: Optional[Any] = None
         # claim token -> (expectation key, job key): a warm claim raises
         # the same ledger entry a create would, and is settled by the
         # informer-delivered MODIFIED event carrying the token — exactly
@@ -491,16 +495,34 @@ class JobEngine:
         return slices
 
     # ------------------------------------------------------------ reconcile
-    def reconcile(self, job: Job) -> ReconcileResult:
+    def reconcile(self, job: Job, corr_id: Optional[int] = None) -> ReconcileResult:
         """Full ReconcileJobs state machine. Mutates job.status and writes it
         back to the cluster if changed. The whole sync runs under a root
         span; each phase below opens a child span that also feeds the
         per-phase histogram, so one instrumentation point serves both the
-        trace timeline and Prometheus."""
-        with self.tracer.span(
-            "reconcile", attrs={"kind": self.adapter.KIND, "job": job.key}
-        ):
-            return self._reconcile(job)
+        trace timeline and Prometheus.
+
+        `corr_id` is the workqueue's correlation id (stamped at enqueue,
+        threaded through the manager's dispatch): it rides the root span
+        and the flight recorder's sync bridge, so a timeline reads
+        "enqueued (corr 17) → waited 1.2s → sync (corr 17) spent 40ms in
+        pod_reconcile" as one causal chain."""
+        attrs: Dict[str, Any] = {"kind": self.adapter.KIND, "job": job.key}
+        if corr_id is not None:
+            attrs["corr"] = corr_id
+        root: Optional[tracing.Span] = None
+        try:
+            with self.tracer.span("reconcile", attrs=attrs) as root:
+                return self._reconcile(job)
+        finally:
+            # bridge the finished span tree into the job's timeline (the
+            # finally runs after the span closed, so duration is set);
+            # a sync that RAISED still lands — the storm that aborted it
+            # belongs in the story
+            if self.recorder is not None and root is not None:
+                self.recorder.record_sync(
+                    job.key, root, corr=corr_id, uid=job.uid
+                )
 
     def _phase(self, name: str, **attrs):
         """Child span for one sync phase, feeding
@@ -541,6 +563,11 @@ class JobEngine:
         self._rv_seen.pop(job_key, None)
         self._exp_keys.pop(job_key, None)
         self._drop_pending_claims(job_key)
+        if self.recorder is not None:
+            # the job is GONE (not moved — disown_job handles moves and
+            # must NOT touch the shared recorder): its timeline keeps
+            # serving reads but becomes LRU-evictable
+            self.recorder.finish(job_key)
         if self.scheduler is not None:
             # a deleted job's reservation (or pending entry) must not hold
             # capacity — release by key: the UID died with the object
@@ -876,6 +903,14 @@ class JobEngine:
             self.cluster.record_event(
                 job.to_dict(), "Normal", REASON_GANG_PENDING, msg
             )
+            # once per pending transition or shortfall change, like the
+            # event — the timeline carries the chip-shortfall math, not
+            # one line per parked sync
+            if self.recorder is not None:
+                self.recorder.record(
+                    job.key, "scheduler", "gang_pending",
+                    {"message": msg}, uid=job.uid,
+                )
         common.update_job_conditions(
             status, common.JOB_SCHEDULING, REASON_GANG_PENDING, msg, now_iso
         )
@@ -924,6 +959,11 @@ class JobEngine:
         )
         restarted_this_pass = False
         creation_deferred = False
+        creations = 0
+        # indices of CREATE ops within pending_ops (fan-out mode): the
+        # dispatch result reports failures by op index, so the timeline
+        # can count exactly how many creates actually succeeded
+        create_indices: set = set()
         # control fan-out: at fanout > 1 creates and scale-down/stale-gen
         # deletes are COLLECTED during the scan and dispatched afterwards in
         # slow-start batches; at fanout <= 1 `pending_ops` stays None and
@@ -950,12 +990,15 @@ class JobEngine:
                     creation_deferred = True
                     continue
                 master_role = self.adapter.is_master_role(replicas, rtype, index)
+                if pending_ops is not None:
+                    create_indices.add(len(pending_ops))
                 self._run_or_defer(
                     pending_ops,
                     lambda i=index, m=master_role: self._create_new_pod(
                         job, rtype, i, spec, m, replicas
                     ),
                 )
+                creations += 1
                 continue
             pod = pod_slice[0]
             if index < 0 or index >= num_replicas:
@@ -1046,7 +1089,46 @@ class JobEngine:
         # each op raised/lowered its own expectations, and never-attempted
         # ops never touched them, so the accounting stays exact
         if pending_ops:
-            self._dispatch_control_ops(pending_ops).raise_first()
+            res = self._dispatch_control_ops(pending_ops)
+            self._record_fanout(job, "Pod", rtype, res)
+            # record BEFORE raise_first: pods created by the batch exist
+            # even when a sibling op failed, and a milestone skipped here
+            # would never be re-stamped (the next sync sees the pods and
+            # counts zero creations).  n counts creates that actually
+            # SUCCEEDED — ops dispatch in list order, so an op ran iff
+            # its index < attempted, and succeeded iff it is not among
+            # the failures; a batch whose every create died must not
+            # stamp the "scheduled" milestone for pods that don't exist.
+            if creations and self.recorder is not None:
+                failed_idx = {i for i, _e in res.failures}
+                created_ok = sum(
+                    1 for i in create_indices
+                    if i < res.attempted and i not in failed_idx
+                )
+                if created_ok:
+                    self.recorder.record(
+                        job.key, "controller", "pods_created",
+                        {"replica_type": rtype, "n": created_ok,
+                         "failed_ops": len(res.failures)},
+                        uid=job.uid,
+                    )
+            res.raise_first()
+        elif creations and self.recorder is not None:
+            # serial mode: a failing create raised out of the loop above,
+            # so reaching here means every counted create succeeded — the
+            # "scheduled" milestone without a cluster scheduler
+            # (placement and creation coincide; with one, gang_admitted
+            # lands first and wins)
+            self.recorder.record(
+                job.key, "controller", "pods_created",
+                {"replica_type": rtype, "n": creations}, uid=job.uid,
+            )
+        if creation_deferred and self.recorder is not None:
+            self.recorder.record(
+                job.key, "controller", "restart_backoff",
+                {"replica_type": rtype, "wait": round(backoff_left, 3)},
+                uid=job.uid,
+            )
 
         # Whole-slice gang restart: a TPU slice is unusable partially, so a
         # retryable failure tears down ALL replicas of the type for atomic
@@ -1341,6 +1423,19 @@ class JobEngine:
             ops, self.config.control_fanout, abort_on_failure=abort_on_failure
         )
 
+    def _record_fanout(self, job: Job, kind: str, rtype: str,
+                       res: FanoutResult) -> None:
+        """Timeline record for one slow-start batch dispatch — outcomes
+        included, so an aborted ramp mid-storm is visible per job."""
+        if self.recorder is None:
+            return
+        self.recorder.record(
+            job.key, "fanout", "batch",
+            {"kind": kind, "replica_type": rtype, "ops": res.attempted,
+             "failed": len(res.failures)},
+            uid=job.uid,
+        )
+
     def reconcile_services(
         self,
         job: Job,
@@ -1378,7 +1473,9 @@ class JobEngine:
                         self._delete_service_with_expectations(job, rtype, s),
                     )
         if pending_ops:
-            self._dispatch_control_ops(pending_ops).raise_first()
+            res = self._dispatch_control_ops(pending_ops)
+            self._record_fanout(job, "Service", rtype, res)
+            res.raise_first()
 
     def _delete_service_with_expectations(
         self, job: Job, rtype: str, svc: Dict[str, Any]
@@ -1727,10 +1824,55 @@ class JobEngine:
             prev = old_status.replica_statuses.get(rtype)
             prev_n = prev.restarts if prev else 0
             for n in range(prev_n + 1, rs.restarts + 1):
+                delay = self._restart_backoff_delay(job, rtype, n)
                 metrics.RESTART_BACKOFF.observe(
-                    self._restart_backoff_delay(job, rtype, n),
-                    {"kind": self.adapter.KIND},
+                    delay, {"kind": self.adapter.KIND},
                 )
+                if self.recorder is not None:
+                    # per DURABLE increment, like the histogram: a replayed
+                    # sync whose write failed never records a phantom
+                    self.recorder.record(
+                        job.key, "controller", "restart",
+                        {"replica_type": rtype, "n": n,
+                         "backoff": round(delay, 3)},
+                        uid=job.uid,
+                    )
+        if self.recorder is not None:
+            self._record_condition_transitions(job, old_status)
+
+    def _record_condition_transitions(
+        self, job: Job, old_status: common.JobStatus
+    ) -> None:
+        """Timeline records for conditions that just became True — only
+        after the status write SUCCEEDED, so the timeline's Running /
+        Restarting / terminal milestones (and the SLO histograms derived
+        from them) reflect durably persisted state."""
+        old = {c.type: c.status for c in old_status.conditions}
+        for c in job.status.conditions:
+            if c.status == "True" and old.get(c.type) != "True":
+                self.recorder.record(
+                    job.key, "controller", "condition",
+                    {"type": c.type, "reason": c.reason}, uid=job.uid,
+                )
+        # full-strength transition: every desired replica active after a
+        # persisted state in which some were not.  A partially-degraded
+        # job (one of N workers dead) can keep its Running condition
+        # through a whole restart incident, so this — not a condition
+        # flip — is the durable repair-complete signal the MTTR clock
+        # closes on (and at startup it marks "all replicas active").
+        desired = sum(
+            spec.replicas or 0
+            for spec in (job.replica_specs or {}).values()
+        )
+
+        def _active(st: common.JobStatus) -> int:
+            return sum(rs.active for rs in st.replica_statuses.values())
+
+        if desired > 0 and _active(job.status) == desired > _active(old_status):
+            self.recorder.record(
+                job.key, "controller", "replicas_active",
+                {"active": desired}, uid=job.uid,
+            )
 
     def _write_status_read_modify_write(
         self, job: Job, new_status: Dict[str, Any], update_status=None

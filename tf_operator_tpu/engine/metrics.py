@@ -442,6 +442,51 @@ SCHEDULER_FRAGMENTATION = Gauge(
     "`packed` exists to keep this low",
 )
 
+# ------------------------------------------------------------- flight recorder
+# Per-job SLO families derived by the job flight recorder
+# (engine/timeline.py) from milestone records — ground truth per job
+# (first bind, first Running condition, failure-to-Running repair), not
+# inference from aggregate counters.
+_SLO_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0,
+    600.0, 1800.0, 3600.0,
+)
+JOB_TIME_TO_SCHEDULED = Histogram(
+    f"{PREFIX}_job_time_to_scheduled_seconds",
+    "Per-job time from first timeline contact (creation) to placement: "
+    "the cluster scheduler's gang bind, or the first pod create / warm "
+    "claim when no scheduler runs — the queueing SLO policy schedulers "
+    "are judged on",
+    buckets=_SLO_BUCKETS,
+)
+JOB_TIME_TO_RUNNING = Histogram(
+    f"{PREFIX}_job_time_to_running_seconds",
+    "Per-job time from creation to the first Running condition — the "
+    "end-to-end startup SLO (admission + placement + image pull + "
+    "runtime init), observed once per job from its timeline",
+    buckets=_SLO_BUCKETS,
+)
+JOB_RESTART_MTTR = Histogram(
+    f"{PREFIX}_job_restart_mttr_seconds",
+    "Per-incident repair time: earliest failure evidence in the job's "
+    "timeline (injected kill, preemption, Restarting condition) to the "
+    "next Running condition — mean time to recovery from ground truth",
+    buckets=_SLO_BUCKETS,
+)
+JOB_TIMELINE_EVENTS = Counter(
+    f"{PREFIX}_job_timeline_events_total",
+    "Records appended to per-job flight-recorder timelines, labeled by "
+    "source subsystem (informer/workqueue/sync/controller/scheduler/"
+    "warmpool/fanout/fencing/chaos/shard) — the recorder's own write "
+    "volume",
+)
+JOB_TIMELINE_EVICTIONS = Counter(
+    f"{PREFIX}_job_timeline_evictions_total",
+    "Finished-job timelines evicted by the recorder's LRU when the "
+    "tracked-job cap was hit; live jobs are never evicted, so a high "
+    "rate just means --timeline-max-jobs is small relative to job churn",
+)
+
 CREATE_TO_RUNNING = Histogram(
     f"{PREFIX}_create_to_running_seconds",
     "Replica-needed to replica-Running latency, labeled by path: cold "

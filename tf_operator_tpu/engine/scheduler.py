@@ -308,6 +308,12 @@ class ClusterScheduler:
         # deterministic-log hook (FaultInjector.note in soaks): admission,
         # preemption, and drain decisions land in the seeded event log
         self.note = note or (lambda line: None)
+        # job flight recorder (engine/timeline.py): when wired by the
+        # manager, every bind / preemption / drain eviction also lands in
+        # the affected jobs' timelines (victim AND beneficiary), so
+        # "why is job X pending" is answerable per job, not just from
+        # the cluster-wide log.  None disables the seam.
+        self.recorder = None
         self._lock = threading.RLock()
         # node name -> (capacity chips, generation)
         self._nodes: Dict[str, Tuple[int, str]] = {}
@@ -639,6 +645,12 @@ class ClusterScheduler:
                 f"gang_admit job={job_key} members={len(members)} "
                 f"policy={self.policy_name}"
             )
+            self._record(
+                job_key, "gang_admitted",
+                {"members": len(members), "policy": self.policy_name,
+                 "nodes": sorted(set(res.assignments.values()))},
+                uid=job_uid,
+            )
             return True, ""
 
     def _free_for_candidate_locked(self, res: Reservation) -> Dict[str, int]:
@@ -873,7 +885,29 @@ class ClusterScheduler:
             f"preempt gang={victim.job_key} members={len(killed)} "
             f"by={preemptor.job_key}"
         )
+        # victim+beneficiary pair: the victim's timeline says who took
+        # its capacity, the preemptor's says whose it took
+        self._record(
+            victim.job_key, "preempted",
+            {"by": preemptor.job_key, "members": len(killed)},
+            uid=victim.job_uid,
+        )
+        self._record(
+            preemptor.job_key, "preemption",
+            {"victim": victim.job_key, "members": len(killed)},
+            uid=preemptor.job_uid,
+        )
         return True
+
+    def _record(self, job_key: str, event: str, detail: Dict[str, Any],
+                uid: Optional[str] = None) -> None:
+        """Flight-recorder seam: scheduler decisions stamped into the
+        affected job's timeline (no-op when no recorder is wired)."""
+        if self.recorder is not None:
+            self.recorder.record(
+                job_key, "scheduler", event, detail, uid=uid,
+                ts=self.clock(),
+            )
 
     def _kill_member(self, namespace: str, name: str) -> bool:
         """SIGTERM one member pod: phase Failed, exit 143.  A pod that
@@ -965,6 +999,11 @@ class ClusterScheduler:
                 self.note(
                     f"drain_evict gang={victim.job_key} node={node} "
                     f"members={len(victim.assignments)}"
+                )
+                self._record(
+                    victim.job_key, "drain_evicted",
+                    {"node": node, "members": len(victim.assignments)},
+                    uid=victim.job_uid,
                 )
             self._update_gauges_locked()
             return n

@@ -1,0 +1,485 @@
+"""Job flight recorder — one causal, bounded timeline per job.
+
+Six subsystems now make decisions about a job (sharded control plane,
+cluster scheduler, warm pool, control fan-out, chaos harness, fencing),
+and their evidence lands in six disconnected places: metrics are
+aggregates, the seeded chaos log is cluster-wide, Events are lossy
+prose.  Nobody can answer "why did job X take 90s to reach Running?"
+without grepping all of them.  This module is the missing join: every
+subsystem appends structured, monotonically-sequenced records to ONE
+per-job ring, so the whole causal chain — informer receipt, workqueue
+wait, sync phase breakdown, gang admission / preemption, warm-pool
+claim, fan-out batch, fencing rejection, crash-loop backoff, injected
+chaos fault — reads as a single ordered story per job.
+
+Design constraints, in order:
+
+  - **Bounded**: per job, one ring (``deque(maxlen=events_per_job)``)
+    for routine traffic (informer / workqueue / sync) and one for
+    DECISIONS (scheduler / warm pool / fencing / chaos / condition
+    transitions) — merged by sequence on read.  Routine chatter must
+    not evict the rare records that explain it: a job parked pending
+    for an hour churns hundreds of requeue/sync records, and a single
+    shared ring would forget the one gang_pending record that explains
+    the hour.  At most ``max_jobs`` jobs are tracked; past the cap the
+    least-recently-touched FINISHED job is evicted (live jobs never
+    are — their count is bounded by the cluster, and dropping a live
+    timeline would be answering "why is this job slow" with "we threw
+    that away").
+  - **Cheap on the hot path**: append is O(1) under the JOB's ring lock;
+    the recorder-wide directory lock is taken only on first contact with
+    a job (and on eviction), never per record — N worker threads
+    recording N different jobs do not serialize on each other.
+  - **Causal**: records carry a per-job monotonic ``seq`` assigned under
+    the ring lock, so cross-thread appends to one job have a total
+    order; the workqueue stamps a correlation id at enqueue that the
+    dequeue record and the sync's span bridge both carry, tying "waited
+    1.2s in the queue" to "then spent 40ms in pod_reconcile".
+  - **Derived SLOs**: milestones observed while recording feed the
+    ``tpu_operator_job_time_to_scheduled_seconds`` /
+    ``_time_to_running_seconds`` / ``_restart_mttr_seconds`` histograms
+    from per-job ground truth (first gang admission / first Running
+    condition / failure-to-Running repair), not inferred from aggregate
+    counters.
+
+One recorder per operator process, shared by every shard's engines (like
+the scheduler and warm pool): slot failover moves a job between shards
+without losing or duplicating its timeline.  ``events_per_job=0``
+disables recording entirely — every seam checks ``recorder is None`` or
+finds ``record()`` returning immediately, and the chaos goldens stay
+byte-identical either way (the recorder never writes to the seeded log).
+
+Served as JSON at ``/debug/timeline/<ns>/<name>`` (cmd/health.py),
+rendered by ``tpu-jobs timeline NS NAME``, and merged into the
+``/debug/traces`` Chrome-trace export as one lane per job.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from tf_operator_tpu.engine import metrics
+
+# (source, event) pairs that mark the "scheduled" milestone: the cluster
+# scheduler's bind when one is running, otherwise the first pod create /
+# warm claim (placement and creation coincide without a scheduler).
+_SCHEDULED_MARKS = frozenset({
+    ("scheduler", "gang_admitted"),
+    ("controller", "pods_created"),
+    ("warmpool", "warm_claim"),
+})
+# Sources whose records are DECISIONS (scheduler binds/preemptions, warm
+# claims, fencing rejections, chaos injections, condition transitions,
+# ownership moves) vs routine high-frequency traffic (informer
+# deliveries, queue stamps, sync bridges).  Each class gets its own ring:
+# a job parked pending for an hour churns hundreds of requeue/sync
+# records, and one shared ring would evict the single gang_pending
+# record that explains the hour — the flight recorder would forget
+# exactly what it exists to remember.
+_DECISION_SOURCES = frozenset({
+    "scheduler", "warmpool", "fencing", "chaos", "shard", "controller",
+})
+# controller events that are routine cadence, not decisions: a job
+# parked in a long crash-loop backoff window re-records its wait every
+# sync, and routing that into the decision ring would let the chatter
+# evict the restart/condition records that explain it.
+_ROUTINE_OVERRIDES = frozenset({("controller", "restart_backoff")})
+# Chrome-trace lane ids for job timelines start here — far above any
+# plausible native thread id, so merged exports never alias a real
+# worker thread's row to a job lane.
+_LANE_TID_BASE = 1 << 24
+# events that start the repair clock (MTTR) — the earliest failure
+# evidence wins: an injected kill precedes the Restarting condition the
+# controller stamps once it observes the dead pod.  The durable
+# `restart` record is in the set too: a partially-degraded job (one of
+# N workers dead) can keep its Running condition through the whole
+# incident, so neither a Restarting transition nor a chaos record may
+# exist — but every counted restart IS a failure, persisted.
+_FAILURE_MARKS = frozenset({"kill", "preempted", "drain_evicted", "restart"})
+
+
+class _JobTimeline:
+    """One job's ring + SLO bookkeeping, guarded by its own lock."""
+
+    __slots__ = (
+        "key", "uid", "lock", "events", "decisions", "seq", "last_ts",
+        "finished", "created_ts", "scheduled_ts", "running_ts",
+        "restart_since", "mttr_last",
+    )
+
+    def __init__(self, key: str, cap: int) -> None:
+        self.key = key
+        self.uid: Optional[str] = None
+        self.lock = threading.Lock()
+        # two rings, one sequence: routine traffic (informer/workqueue/
+        # sync) cannot evict the rare decision records that explain it
+        self.events: "deque[Dict[str, Any]]" = deque(maxlen=cap)
+        self.decisions: "deque[Dict[str, Any]]" = deque(maxlen=cap)
+        self.seq = 0
+        self.last_ts = 0.0
+        self.finished = False
+        self.created_ts: Optional[float] = None
+        self.scheduled_ts: Optional[float] = None
+        self.running_ts: Optional[float] = None
+        self.restart_since: Optional[float] = None
+        self.mttr_last: Optional[float] = None
+
+    def reset_locked(self, uid: Optional[str], ts: float) -> None:
+        """A new incarnation (same ns/name, new UID) starts a fresh ring;
+        seq keeps counting so ordering across the boundary stays total."""
+        self.uid = uid
+        self.events.clear()
+        self.decisions.clear()
+        self.finished = False
+        self.created_ts = ts
+        self.scheduled_ts = None
+        self.running_ts = None
+        self.restart_since = None
+        self.mttr_last = None
+
+
+class FlightRecorder:
+    """Thread-safe bounded per-job flight recorder.  See module docs."""
+
+    def __init__(
+        self,
+        events_per_job: int = 256,
+        max_jobs: int = 1000,
+        clock=time.time,
+    ) -> None:
+        self.events_per_job = int(events_per_job)
+        self.max_jobs = max(1, int(max_jobs))
+        self.clock = clock
+        self._jobs: Dict[str, _JobTimeline] = {}
+        # directory lock: first-contact admission + eviction ONLY — the
+        # per-record hot path reads the dict without it (GIL-atomic) and
+        # synchronizes on the job's own ring lock
+        self._dir_lock = threading.Lock()
+        self._corr = itertools.count(1)
+
+    @property
+    def enabled(self) -> bool:
+        return self.events_per_job > 0
+
+    def next_corr(self) -> int:
+        """A fresh correlation id (stamped at workqueue enqueue, carried
+        by the dequeue record and the sync's span bridge)."""
+        return next(self._corr)
+
+    # --------------------------------------------------------------- record
+    def record(
+        self,
+        job_key: str,
+        source: str,
+        event: str,
+        detail: Optional[Dict[str, Any]] = None,
+        uid: Optional[str] = None,
+        ts: Optional[float] = None,
+    ) -> None:
+        """Append one structured record to `job_key`'s ring.  O(1) under
+        the job's ring lock; a disabled recorder returns immediately so
+        every call site can stay unconditional behind a None check."""
+        if self.events_per_job <= 0 or not job_key:
+            return
+        if ts is None:
+            ts = self.clock()
+        while True:
+            tl = self._jobs.get(job_key)
+            if tl is None:
+                tl = self._admit(job_key)
+            with tl.lock:
+                if self._jobs.get(job_key) is not tl:
+                    # lost a race with _evict_locked between the lookup
+                    # and the lock: appending to the orphaned ring would
+                    # silently drop the record — re-admit and retry
+                    continue
+                if uid:
+                    if tl.uid is None:
+                        tl.uid = uid
+                    elif uid != tl.uid:
+                        tl.reset_locked(uid, ts)
+                tl.seq += 1
+                ring = (
+                    tl.decisions
+                    if source in _DECISION_SOURCES
+                    and (source, event) not in _ROUTINE_OVERRIDES
+                    else tl.events
+                )
+                ring.append({
+                    "seq": tl.seq,
+                    "t": ts,
+                    "source": source,
+                    "event": event,
+                    "detail": detail or {},
+                })
+                tl.last_ts = ts
+                self._derive_locked(tl, source, event, detail or {}, ts)
+            break
+        metrics.JOB_TIMELINE_EVENTS.inc({"source": source})
+
+    def record_sync(
+        self, job_key: str, root_span, corr: Optional[int] = None,
+        uid: Optional[str] = None,
+    ) -> None:
+        """Bridge one finished reconcile root span (engine/tracing.py)
+        into the timeline: total duration + per-phase breakdown, tied to
+        the workqueue's correlation id."""
+        if self.events_per_job <= 0 or root_span is None:
+            return
+        phases: Dict[str, float] = {}
+        for child in root_span.children:
+            if child.duration is not None:
+                phases[child.name] = (
+                    phases.get(child.name, 0.0) + child.duration
+                )
+        detail: Dict[str, Any] = {
+            "duration": round(root_span.duration or 0.0, 6),
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+        }
+        if corr is not None:
+            detail["corr"] = corr
+        self.record(job_key, "sync", "reconcile", detail, uid=uid)
+
+    def finish(self, job_key: str) -> None:
+        """Mark a job's timeline finished (deleted / terminal): it keeps
+        serving reads but becomes eligible for LRU eviction."""
+        for _ in range(2):
+            tl = self._jobs.get(job_key)
+            if tl is None:
+                return
+            with tl.lock:
+                if self._jobs.get(job_key) is tl:
+                    tl.finished = True
+                    return
+            # evicted-and-readmitted under us: mark the current entry
+            # (one retry suffices — a second race leaves at worst an
+            # unfinished ring the next finish() call closes)
+
+    # ------------------------------------------------------------ directory
+    def _admit(self, job_key: str) -> _JobTimeline:
+        with self._dir_lock:
+            tl = self._jobs.get(job_key)
+            if tl is not None:
+                return tl
+            if len(self._jobs) >= self.max_jobs:
+                self._evict_locked()
+            tl = _JobTimeline(job_key, self.events_per_job)
+            self._jobs[job_key] = tl
+            return tl
+
+    def _evict_locked(self) -> None:
+        """Evict the least-recently-touched FINISHED job.  Live jobs are
+        never evicted: if every tracked job is live the cap is allowed to
+        stretch — live-job count is bounded by the cluster itself, and a
+        silent hole in a live timeline is worse than the memory."""
+        victim_key = None
+        victim_ts = None
+        for key, tl in self._jobs.items():
+            if tl.finished and (victim_ts is None or tl.last_ts < victim_ts):
+                victim_key, victim_ts = key, tl.last_ts
+        if victim_key is not None:
+            # delete UNDER the victim's ring lock: record()'s identity
+            # re-check (is the dict entry still this object?) runs under
+            # the same lock, so an append either lands before the
+            # eviction (and is evicted with the finished job) or observes
+            # the removal and re-admits a fresh ring — never into an
+            # orphan.  Ordering is acyclic: dir_lock -> ring lock here,
+            # and record() never takes dir_lock while holding a ring
+            # lock (_admit runs before the ring lock is taken).
+            with self._jobs[victim_key].lock:
+                del self._jobs[victim_key]
+            metrics.JOB_TIMELINE_EVICTIONS.inc()
+
+    # -------------------------------------------------------------- derive
+    def _derive_locked(
+        self, tl: _JobTimeline, source: str, event: str,
+        detail: Dict[str, Any], ts: float,
+    ) -> None:
+        if tl.created_ts is None:
+            tl.created_ts = ts
+        if (source, event) in _SCHEDULED_MARKS and tl.scheduled_ts is None:
+            tl.scheduled_ts = ts
+            metrics.JOB_TIME_TO_SCHEDULED.observe(
+                max(0.0, ts - tl.created_ts)
+            )
+        if source == "controller" and event == "condition":
+            ctype = detail.get("type")
+            if ctype == "Running":
+                if tl.running_ts is None:
+                    tl.running_ts = ts
+                    if tl.scheduled_ts is None:
+                        # backstop: a storm can swallow the create-side
+                        # milestone record (the sync that created the
+                        # pods raised before recording) — a job that is
+                        # RUNNING was necessarily scheduled, so the
+                        # milestone is stamped no later than here
+                        tl.scheduled_ts = ts
+                        metrics.JOB_TIME_TO_SCHEDULED.observe(
+                            max(0.0, ts - tl.created_ts)
+                        )
+                    metrics.JOB_TIME_TO_RUNNING.observe(
+                        max(0.0, ts - tl.created_ts)
+                    )
+                if tl.restart_since is not None:
+                    tl.mttr_last = max(0.0, ts - tl.restart_since)
+                    tl.restart_since = None
+                    metrics.JOB_RESTART_MTTR.observe(tl.mttr_last)
+            elif ctype in ("Succeeded", "Failed"):
+                tl.finished = True
+            elif ctype == "Restarting" and tl.restart_since is None:
+                tl.restart_since = ts
+        elif source == "controller" and event == "replicas_active":
+            # repair complete: every desired replica active again — the
+            # close that works even when a partially-degraded job kept
+            # its Running condition through the whole incident
+            if tl.restart_since is not None:
+                tl.mttr_last = max(0.0, ts - tl.restart_since)
+                tl.restart_since = None
+                metrics.JOB_RESTART_MTTR.observe(tl.mttr_last)
+        elif event in _FAILURE_MARKS and tl.restart_since is None:
+            tl.restart_since = ts
+
+    @staticmethod
+    def _slo_locked(tl: _JobTimeline) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if tl.created_ts is not None:
+            if tl.scheduled_ts is not None:
+                out["time_to_scheduled_s"] = round(
+                    tl.scheduled_ts - tl.created_ts, 6
+                )
+            if tl.running_ts is not None:
+                out["time_to_running_s"] = round(
+                    tl.running_ts - tl.created_ts, 6
+                )
+        if tl.mttr_last is not None:
+            out["last_restart_mttr_s"] = round(tl.mttr_last, 6)
+        if tl.restart_since is not None:
+            out["repair_in_progress_since"] = tl.restart_since
+        return out
+
+    # --------------------------------------------------------------- reads
+    def jobs(self) -> List[str]:
+        with self._dir_lock:
+            return sorted(self._jobs)
+
+    @staticmethod
+    def _merged_locked(tl: _JobTimeline) -> List[Dict[str, Any]]:
+        """Both rings interleaved back into one sequence (caller holds
+        tl.lock) — the single merge every export shares."""
+        return sorted(
+            (dict(e) for e in (*tl.events, *tl.decisions)),
+            key=lambda e: e["seq"],
+        )
+
+    def timeline(self, job_key: str) -> Optional[Dict[str, Any]]:
+        """Snapshot of one job's timeline as a JSON-ready dict, or None
+        when the job was never recorded (or has been evicted)."""
+        tl = self._jobs.get(job_key)
+        if tl is None:
+            return None
+        with tl.lock:
+            return {
+                "job": tl.key,
+                "uid": tl.uid,
+                "finished": tl.finished,
+                "slo": self._slo_locked(tl),
+                "events": self._merged_locked(tl),
+            }
+
+    def slo(self, job_key: str) -> Optional[Dict[str, Any]]:
+        tl = self._jobs.get(job_key)
+        if tl is None:
+            return None
+        with tl.lock:
+            return self._slo_locked(tl)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Every live timeline (the SIGUSR1 / --trace-dump payload)."""
+        return {
+            "jobs": {
+                key: tl for key in self.jobs()
+                if (tl := self.timeline(key)) is not None
+            }
+        }
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_dict(), fh)
+
+    # -------------------------------------------------------------- export
+    def chrome_events(
+        self, per_job: Optional[int] = None
+    ) -> List[Dict[str, Any]]:
+        """One Chrome-trace lane per job, merged into /debug/traces
+        beside the reconcile/serving spans (cat "timeline"): records with
+        a duration (sync bridges) render as complete events, the rest as
+        instants, and each lane is named after its job.  `per_job` keeps
+        only each lane's newest N records — ?limit=N must bound the
+        recorder's contribution too, not just the tracer's roots."""
+        events: List[Dict[str, Any]] = []
+        pid = os.getpid()
+        with self._dir_lock:
+            items = sorted(self._jobs.items())
+        # job lanes live in their own tid block far above real native
+        # thread ids: a lane colliding with a worker thread's tid would
+        # render that thread's reconcile spans inside a row labeled as a
+        # job timeline in the merged export
+        for lane, (key, tl) in enumerate(items, start=_LANE_TID_BASE + 1):
+            with tl.lock:
+                snapshot = self._merged_locked(tl)
+            if per_job is not None and per_job >= 0:
+                snapshot = snapshot[-per_job:] if per_job > 0 else []
+            if not snapshot:
+                # no records survive the cap: no lane either — a limit
+                # meant to shrink the response must not still ship one
+                # metadata row per tracked job
+                continue
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": lane,
+                "args": {"name": f"job {key}"},
+            })
+            for e in snapshot:
+                args = {"source": e["source"], "seq": e["seq"],
+                        **(e["detail"] or {})}
+                dur = (e["detail"] or {}).get("duration")
+                base = {
+                    "name": e["event"], "cat": "timeline",
+                    "ts": e["t"] * 1e6, "pid": pid, "tid": lane,
+                    "args": args,
+                }
+                if isinstance(dur, (int, float)) and dur > 0:
+                    # records are stamped at the moment they happen —
+                    # for a sync bridge that is the sync's END — so the
+                    # complete event starts dur earlier, aligning the
+                    # job-lane bar with the tracer's span for the same
+                    # sync in the merged export
+                    events.append({
+                        **base, "ph": "X", "ts": (e["t"] - dur) * 1e6,
+                        "dur": dur * 1e6,
+                    })
+                else:
+                    events.append({**base, "ph": "i", "s": "t"})
+        return events
+
+
+# disabled until an operator configures one (cmd/manager.build_recorder):
+# the fallback the health endpoints and in-process CLI read when no
+# explicit recorder was injected — mirrors tracing.get_tracer()
+_GLOBAL = FlightRecorder(events_per_job=0)
+
+
+def get_recorder() -> FlightRecorder:
+    return _GLOBAL
+
+
+def set_recorder(recorder: FlightRecorder) -> None:
+    """Register the process's recorder (one per process, like the
+    scheduler and warm pool) so /debug endpoints and the in-process CLI
+    find it without explicit wiring."""
+    global _GLOBAL
+    _GLOBAL = recorder

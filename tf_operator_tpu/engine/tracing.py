@@ -162,14 +162,35 @@ class Tracer:
             self._finished.clear()
 
     # ------------------------------------------------------------- export
-    def to_chrome_trace(self) -> Dict[str, Any]:
+    def to_chrome_trace(
+        self, category: Optional[str] = None, limit: Optional[int] = None
+    ) -> Dict[str, Any]:
         """Chrome trace-event format (`ph:"X"` complete events, micros) —
-        loadable in chrome://tracing and Perfetto."""
+        loadable in chrome://tracing and Perfetto.
+
+        `category` keeps only spans whose `cat` matches (reconcile vs
+        serving traces share one ring but are separable); `limit` keeps
+        only the most recent N root traces — the /debug/traces query
+        filters, so a dashboard can pull \"last 5 serving traces\" without
+        downloading the whole ring.  With both given, the category
+        filter runs FIRST: ?category=serving&limit=5 means the newest 5
+        serving traces, not \"the newest 5 traces, serving spans only\"
+        (which could be empty while serving traces sit in the ring)."""
         events: List[Dict[str, Any]] = []
         pid = os.getpid()
-        for root in self.traces():
+        roots = self.traces()
+        if category is not None:
+            roots = [
+                r for r in roots
+                if any(sp.category == category for sp in r.walk())
+            ]
+        if limit is not None and limit >= 0:
+            roots = roots[-limit:] if limit > 0 else []
+        for root in roots:
             for sp in root.walk():
                 if sp.duration is None:
+                    continue
+                if category is not None and sp.category != category:
                     continue
                 events.append(
                     {
@@ -185,8 +206,10 @@ class Tracer:
                 )
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
-    def export_chrome_json(self) -> str:
-        return json.dumps(self.to_chrome_trace())
+    def export_chrome_json(
+        self, category: Optional[str] = None, limit: Optional[int] = None
+    ) -> str:
+        return json.dumps(self.to_chrome_trace(category=category, limit=limit))
 
     def dump(self, path: str) -> None:
         """Write the Chrome trace-event JSON to `path` (--trace-dump)."""

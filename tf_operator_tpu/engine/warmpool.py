@@ -154,6 +154,11 @@ class WarmPoolManager:
         # accepts it — e.g. the JAX runtime reports its persistent
         # compilation cache is primed.  None = phase Running is enough.
         self.ready_probe = ready_probe
+        # job flight recorder (engine/timeline.py): when wired by the
+        # manager, claim hits and misses land in the claiming job's
+        # timeline with the reason — "why did this replica cold-start"
+        # answered per job.  None disables the seam.
+        self.recorder = None
         self._lock = threading.RLock()
         # shape -> {pod name -> last-known pod object} (unclaimed only;
         # Pending entries are "filling", Running entries are claimable)
@@ -473,6 +478,11 @@ class WarmPoolManager:
                 metrics.CREATE_TO_RUNNING.observe(
                     max(0.0, self.clock() - t0), {"path": "warm"}
                 )
+                self._record_claim(
+                    namespace, labels, "warm_claim",
+                    {"shape": shape, "pod": name,
+                     "node": (claimed.get("spec") or {}).get("nodeName")},
+                )
                 self._wake.set()  # refill the hole promptly
                 return claimed
             miss_reasons.add("contested")
@@ -482,7 +492,30 @@ class WarmPoolManager:
             metrics.WARM_POOL_CLAIM_MISSES.inc(
                 {"shape": shape, "reason": reason}
             )
+        if miss_reasons:
+            # one timeline record per fallback, like the metric: the
+            # claiming job's story says why it paid a cold create
+            self._record_claim(
+                namespace, labels, "warm_miss",
+                {"shape": shape, "reasons": sorted(miss_reasons)},
+            )
         return None
+
+    def _record_claim(
+        self, namespace: str, labels: Dict[str, str], event: str,
+        detail: Dict[str, Any],
+    ) -> None:
+        """Flight-recorder seam: the claiming job's identity rides the
+        replica label set the claim writes, so the record lands in the
+        right job's timeline without new plumbing."""
+        if self.recorder is None:
+            return
+        job_name = labels.get(objects.LABEL_JOB_NAME)
+        if job_name:
+            self.recorder.record(
+                f"{namespace}/{job_name}", "warmpool", event, detail,
+                ts=self.clock(),
+            )
 
     def _cas_claim(
         self,

@@ -180,6 +180,12 @@ class FaultInjector:
         # whole gang requeues as a unit) before the generic per-node
         # sweep; None keeps the historical drain byte-identical
         self.scheduler = None
+        # job flight recorder attach point (engine/timeline.py): when
+        # set, every injected kill is stamped into the owning job's
+        # timeline — root cause IN the timeline, not beside it in the
+        # seeded log.  Recording never writes to the log, so the
+        # byte-identical-per-seed contract holds with or without it.
+        self.recorder = None
         if kubelet:
             self.inner.subscribe("Pod", self._kubelet_on_pod)
 
@@ -486,6 +492,13 @@ class FaultInjector:
             )
             with self._lock:
                 book[owner] = book.get(owner, 0) + 1
+            if self.recorder is not None:
+                self.recorder.record(
+                    owner[0], "chaos", "kill",
+                    {"pod": f"{namespace}/{name}", "exit_code": exit_code,
+                     "reason": reason, "replica_type": owner[1]},
+                    ts=self.clock(),
+                )
         self._count("kill.hit")
         self._log(
             f"t={self.clock():g} kill pod={namespace}/{name} "

@@ -10,6 +10,7 @@ common verbs into one command:
   tpu-jobs get tfjob mnist [-n ns] [-o json|wide]
   tpu-jobs describe tfjob mnist            # conditions, replicas, events
   tpu-jobs events tfjob mnist              # kubectl-get-events analog
+  tpu-jobs timeline default mnist [--json] # the job's flight-recorder story
   tpu-jobs list tpujob [-n ns]
   tpu-jobs wait tfjob mnist --timeout 600  # block until terminal
   tpu-jobs logs tfjob mnist [--replica-type Worker] [--index 0]
@@ -94,6 +95,18 @@ def _age(ts: str) -> str:
     return f"{secs // 86400}d"
 
 
+def _detail_line(detail: Dict[str, Any]) -> str:
+    """One-line k=v rendering of a record's structured detail (nested
+    values compact-JSON'd so phase maps stay greppable)."""
+    parts = []
+    for k in sorted(detail):
+        v = detail[k]
+        if isinstance(v, (dict, list)):
+            v = json.dumps(v, separators=(",", ":"), sort_keys=True)
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
 def _print_job_row(job: Dict[str, Any], header: bool = False) -> None:
     if header:
         print(f"{'NAME':<32}{'KIND':<14}{'STATE':<12}CREATED")
@@ -105,13 +118,27 @@ def _print_job_row(job: Dict[str, Any], header: bool = False) -> None:
 
 
 class Cli:
-    """Verb dispatcher bound to a cluster backend (injectable for tests)."""
+    """Verb dispatcher bound to a cluster backend (injectable for tests).
 
-    def __init__(self, cluster) -> None:
+    `recorder` is the job flight recorder (engine/timeline.py) the
+    `timeline` verb and describe's SLO summary read; None falls back to
+    the process-global recorder, which an in-process operator registers
+    and which is otherwise disabled (the verbs then say so instead of
+    guessing)."""
+
+    def __init__(self, cluster, recorder=None) -> None:
         self.cluster = cluster
+        self.recorder = recorder
 
     def client(self, kind: str) -> JobClient:
         return JobClient(self.cluster, kind=kind)
+
+    def _recorder(self):
+        if self.recorder is not None:
+            return self.recorder
+        from tf_operator_tpu.engine import timeline as timeline_mod
+
+        return timeline_mod.get_recorder()
 
     # ----------------------------------------------------------- verbs
     def submit(self, path: str, namespace: str, apply: bool = False) -> int:
@@ -226,6 +253,17 @@ class Cli:
         print(f"Kind:      {job.get('kind', '')}")
         print(f"Created:   {md.get('creationTimestamp', '')}")
         print(f"State:     {_condition_summary(job)}")
+        rec = self._recorder()
+        slo = rec.slo(f"{namespace}/{name}") if rec.enabled else None
+        if slo and (
+            "time_to_scheduled_s" in slo or "time_to_running_s" in slo
+        ):
+            tts = slo.get("time_to_scheduled_s")
+            ttr = slo.get("time_to_running_s")
+            print(f"SLO:       time-to-scheduled="
+                  f"{'-' if tts is None else f'{tts:g}s'}")
+            print(f"           time-to-running="
+                  f"{'-' if ttr is None else f'{ttr:g}s'}")
         rs = status.get("replicaStatuses", {}) or {}
         if rs:
             print("Replica Statuses:")
@@ -259,6 +297,48 @@ class Cli:
             for e in events:
                 print(f"  {e.get('type', ''):<8}{e.get('reason', ''):<28}"
                       f"{_age(_event_time(e)):<10}{e.get('message', '')}")
+        return 0
+
+    def timeline(self, namespace: str, name: str, as_json: bool = False) -> int:
+        """Render one job's flight-recorder timeline (engine/timeline.py)
+        as an aligned, time-ordered table — relative timestamps, source
+        column, one-line detail — or raw JSON with --json.  The payload
+        is the same document /debug/timeline/<ns>/<name> serves."""
+        rec = self._recorder()
+        if not rec.enabled:
+            print(
+                "error: timeline recorder is disabled "
+                "(--timeline-events-per-job 0, or not running in the "
+                "operator process)",
+                file=sys.stderr,
+            )
+            return 1
+        doc = rec.timeline(f"{namespace}/{name}")
+        if doc is None:
+            print(f"error: no timeline for {namespace}/{name}",
+                  file=sys.stderr)
+            return 1
+        if as_json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+            return 0
+        events = doc.get("events") or []
+        slo = doc.get("slo") or {}
+        print(f"Job:       {doc['job']}"
+              + (f" (uid {doc['uid']})" if doc.get("uid") else ""))
+        if slo:
+            print("SLO:       " + "  ".join(
+                f"{k.replace('_', '-')}={v:g}"
+                for k, v in sorted(slo.items())
+                if isinstance(v, (int, float))
+            ))
+        if not events:
+            print("No records.")
+            return 0
+        base = events[0]["t"]
+        print(f"{'TIME':>10}  {'SOURCE':<11}{'EVENT':<18}DETAIL")
+        for e in events:
+            print(f"{e['t'] - base:>+9.3f}s  {e['source']:<11}"
+                  f"{e['event']:<18}{_detail_line(e.get('detail') or {})}")
         return 0
 
     def events(self, kind: str, name: str, namespace: str) -> int:
@@ -374,6 +454,15 @@ def make_parser() -> argparse.ArgumentParser:
 
     pl = sub.add_parser("list", parents=[common])
     pl.add_argument("kind")
+
+    # timeline addresses the recorder by job KEY (ns/name) — kind-free,
+    # because the flight recorder joins every kind's story in one store
+    pt = sub.add_parser("timeline", parents=[common])
+    pt.add_argument("job_namespace", metavar="NAMESPACE")
+    pt.add_argument("name")
+    pt.add_argument("--json", action="store_true", dest="as_json",
+                    help="raw recorder JSON instead of the table")
+
     sub.add_parser("version", parents=[common])
     return p
 
@@ -391,6 +480,9 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
         return cli.submit(args.file, ns, apply=True)
     if args.verb == "run-local":
         return run_local_file(args.file, args.timeout)
+    if args.verb == "timeline":
+        return cli.timeline(args.job_namespace, args.name,
+                            as_json=args.as_json)
     kind = resolve_kind(args.kind)
     if args.verb == "get":
         return cli.get(kind, args.name, ns, args.output)
