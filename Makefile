@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-warmpool bench-sched bench-paged bench-timeline native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-timeline native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -66,6 +66,15 @@ bench-startup:
 bench-shard:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_shard_sweep; \
 	print(json.dumps(bench_shard_sweep(), indent=1))"
+
+# Multi-process control plane: shards 1/4 as in-process workers vs real
+# supervised worker PROCESSES over the same HTTP apiserver, with a
+# kill -9 failover probe (takeover + end-to-end recovery time) and the
+# watch-journal hit/cache ratios per multi-process row — the ISSUE 11
+# GIL-escape evidence.  Rows land in BENCH_r10.json.
+bench-multiproc:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_multiproc_sweep; \
+	print(json.dumps(bench_multiproc_sweep(), indent=1))"
 
 # Warm-pool cold-start sweep: create-to-first-running p50/p99 and
 # warm-hit ratio with 0/30/120s simulated image-pull+init latency, warm

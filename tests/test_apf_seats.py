@@ -1,0 +1,91 @@
+"""APF per-flow seat counts (ISSUE 11): one flow may not occupy every
+execution seat, so a crash-looping worker process's relist barrage cannot
+starve its sibling processes' flows.  Fast, tier-1: pure in-process
+FairFlowController mechanics (the cross-process storms live in the slow
+multi-process soak).
+"""
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.e2e.http_apiserver import FairFlowController, RejectedError
+from tf_operator_tpu.engine import metrics
+
+
+def test_flow_seat_cap_queues_even_with_global_seats_free():
+    """A flow at its per-flow cap queues new arrivals although global
+    seats are idle; releasing one of ITS seats dispatches the waiter."""
+    apf = FairFlowController(seats=4, seats_per_flow=2, queue_timeout=5.0)
+    apf.acquire("hog")
+    apf.acquire("hog")
+    assert metrics.APF_SEATS_IN_USE.get({"flow": "hog"}) == 2
+
+    got = threading.Event()
+
+    def third():
+        apf.acquire("hog")  # must park: hog is at its 2-seat cap
+        got.set()
+        apf.release("hog")
+
+    t = threading.Thread(target=third)
+    t.start()
+    assert not got.wait(0.15), "third hog acquire must queue at the cap"
+    # 2 of 4 global seats are free the whole time
+    apf.release("hog")
+    assert got.wait(2.0), "freed flow seat must dispatch the hog waiter"
+    t.join()
+    apf.release("hog")
+    assert metrics.APF_SEATS_IN_USE.get({"flow": "hog"}) == 0
+
+
+def test_other_flows_dispatch_past_a_seat_capped_flow():
+    """The round-robin dispatcher skips a flow parked at its seat cap —
+    other flows' requests are admitted immediately instead of waiting
+    behind it (the crash-looping-sibling isolation)."""
+    apf = FairFlowController(seats=4, seats_per_flow=1, queue_timeout=5.0)
+    apf.acquire("loop")  # the crash-looper occupies its one seat
+
+    parked = threading.Event()
+
+    def looper():
+        apf.acquire("loop")  # parks at the cap
+        parked.set()
+        apf.release("loop")
+
+    t = threading.Thread(target=looper)
+    t.start()
+    time.sleep(0.05)  # let the looper park so the ring is non-empty
+    for _ in range(6):  # quiet flow sails through, repeatedly
+        t0 = time.monotonic()
+        apf.acquire("quiet")
+        assert time.monotonic() - t0 < 0.5
+        apf.release("quiet")
+    assert not parked.is_set(), "capped flow must still be parked"
+    apf.release("loop")
+    assert parked.wait(2.0)
+    t.join()
+    apf.release("loop")
+
+
+def test_flow_seat_cap_timeout_still_rejects():
+    """A waiter parked solely by its flow's seat cap still honors the
+    queue timeout — 429 with Retry-After, not an eternal park."""
+    apf = FairFlowController(
+        seats=4, seats_per_flow=1, queue_timeout=0.1, retry_after=0.5
+    )
+    apf.acquire("hog")
+    with pytest.raises(RejectedError) as exc:
+        apf.acquire("hog")
+    assert exc.value.retry_after == 0.5
+    apf.release("hog")
+
+
+def test_no_cap_keeps_legacy_release_signature():
+    """seats_per_flow=None (the default) is the pre-ISSUE-11 controller:
+    release() without a flow stays valid and nothing is capped."""
+    apf = FairFlowController(seats=2)
+    apf.acquire("a")
+    apf.acquire("a")  # 2 seats, one flow — allowed without a cap
+    apf.release()
+    apf.release()

@@ -1,0 +1,145 @@
+"""Write-ahead watch journal (ISSUE 11): per-kind bounded event journals
+in the REST façade, resume-from-cursor hit/miss accounting, and the
+shared wire encoding that keeps N process watchers from re-serializing
+the world N times.  Fast, tier-1 — the real multi-process consumers live
+in the slow soak.
+"""
+import json
+
+from tf_operator_tpu.e2e.apiserver import ApiServerTransport, WatchJournal
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.k8s.fake import FakeCluster
+
+from tests import testutil
+
+PODS_PATH = "/api/v1/namespaces/default/pods"
+TFJOBS_PATH = "/apis/kubeflow.org/v1/namespaces/default/tfjobs"
+
+
+def _mk():
+    backing = FakeCluster()
+    return backing, ApiServerTransport(backing)
+
+
+def _drain(stream, n):
+    return [next(stream) for _ in range(n)]
+
+
+def test_watch_resumes_from_cursor_and_counts_hit():
+    """A watcher reconnecting with its last-seen rv receives exactly the
+    events it missed — no relist, journal resume counted as a hit."""
+    backing, transport = _mk()
+    metrics.WATCH_JOURNAL_RESUMES.reset()
+    backing.create("TFJob", testutil.new_tfjob("j0", worker=1).to_dict())
+    _, listing = transport.request("GET", TFJOBS_PATH)
+    rv = int(listing["metadata"]["resourceVersion"])
+
+    # events the disconnected watcher will have missed
+    backing.create("TFJob", testutil.new_tfjob("j1", worker=1).to_dict())
+    backing.create("TFJob", testutil.new_tfjob("j2", worker=1).to_dict())
+
+    stream = transport.stream(
+        TFJOBS_PATH, {"watch": "true", "resourceVersion": str(rv)}
+    )
+    got = _drain(stream, 2)
+    assert [e["object"]["metadata"]["name"] for e in got] == ["j1", "j2"]
+    assert all(e["type"] == "ADDED" for e in got)
+    assert metrics.WATCH_JOURNAL_RESUMES.get(
+        {"kind": "TFJob", "outcome": "hit"}
+    ) == 1
+    assert metrics.WATCH_JOURNAL_RESUMES.get(
+        {"kind": "TFJob", "outcome": "miss"}
+    ) == 0
+    transport.close()
+
+
+def test_pruned_cursor_gets_410_and_counts_miss():
+    """A cursor behind the journal's horizon has provably lost events:
+    410 Gone (the relist path), counted as a resume miss."""
+    backing, transport = _mk()
+    metrics.WATCH_JOURNAL_RESUMES.reset()
+    transport.MAX_LOG = 4  # tiny journal: force pruning
+    for i in range(8):
+        backing.create("TFJob", testutil.new_tfjob(f"p{i}", worker=1).to_dict())
+    stream = transport.stream(
+        TFJOBS_PATH, {"watch": "true", "resourceVersion": "1"}
+    )
+    event = next(stream)
+    assert event["type"] == "ERROR"
+    assert event["object"]["code"] == 410
+    assert metrics.WATCH_JOURNAL_RESUMES.get(
+        {"kind": "TFJob", "outcome": "miss"}
+    ) == 1
+    transport.close()
+
+
+def test_journal_horizon_is_per_kind():
+    """Pruning one chatty kind's journal must NOT 410 other kinds'
+    watchers — pre-journal, the horizon was global and one kind's churn
+    forced every watcher to relist."""
+    backing, transport = _mk()
+    transport.MAX_LOG = 4
+    backing.create("TFJob", testutil.new_tfjob("keep", worker=1).to_dict())
+    _, listing = transport.request("GET", TFJOBS_PATH)
+    rv = int(listing["metadata"]["resourceVersion"])
+    # churn PODS far past the cap; the TFJob journal is untouched
+    for i in range(12):
+        backing.create("Pod", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"chatty-{i}", "namespace": "default"},
+        })
+    backing.create("TFJob", testutil.new_tfjob("after", worker=1).to_dict())
+    stream = transport.stream(
+        TFJOBS_PATH, {"watch": "true", "resourceVersion": str(rv)}
+    )
+    event = next(stream)
+    assert event["type"] == "ADDED"
+    assert event["object"]["metadata"]["name"] == "after"
+    transport.close()
+
+
+def test_wire_encoding_is_shared_across_watchers():
+    """stream_lines watchers share one serialization per event: the
+    first to need an entry encodes it, every later watcher reuses the
+    journal's stored bytes (cache source counted)."""
+    backing, transport = _mk()
+    metrics.WATCH_JOURNAL_ENCODES.reset()
+    backing.create("Pod", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "shared", "namespace": "default"},
+    })
+    a = transport.stream_lines(PODS_PATH, {"watch": "true"})
+    b = transport.stream_lines(PODS_PATH, {"watch": "true"})
+    line_a, line_b = next(a), next(b)
+    assert line_a == line_b and line_a.endswith(b"\n")
+    decoded = json.loads(line_a)
+    assert decoded["type"] == "ADDED"
+    assert decoded["object"]["metadata"]["name"] == "shared"
+    assert metrics.WATCH_JOURNAL_ENCODES.get(
+        {"kind": "Pod", "source": "encode"}
+    ) == 1
+    assert metrics.WATCH_JOURNAL_ENCODES.get(
+        {"kind": "Pod", "source": "cache"}
+    ) == 1
+    # dict-protocol consumers (in-process informers) never pay encoding
+    c = transport.stream(PODS_PATH, {"watch": "true"})
+    assert next(c)["object"]["metadata"]["name"] == "shared"
+    assert metrics.WATCH_JOURNAL_ENCODES.get(
+        {"kind": "Pod", "source": "encode"}
+    ) == 1
+    transport.close()
+
+
+def test_journal_since_bisects_correctly():
+    j = WatchJournal("TFJob", cap=100)
+    for seq in (3, 5, 9, 12):
+        j.append(seq, "ADDED", {"metadata": {"name": f"s{seq}"}})
+    assert [e.seq for e in j.since(0)] == [3, 5, 9, 12]
+    assert [e.seq for e in j.since(5)] == [9, 12]
+    assert [e.seq for e in j.since(6)] == [9, 12]
+    assert j.since(12) == []
+    assert j.horizon == 0
+    j.cap = 2
+    j.append(15, "ADDED", {"metadata": {"name": "s15"}})
+    assert j.horizon == 9  # 3, 5, 9 pruned down to the cap of 2
+    assert [e.seq for e in j.since(0)] == [12, 15]

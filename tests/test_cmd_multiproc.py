@@ -1,0 +1,261 @@
+"""Multi-process control plane, the deterministic half (ISSUE 11).
+
+Two ShardedOperator instances with disjoint `local_shards` over ONE
+backing store reproduce the exact cross-process topology — separate
+informer factories, separate fencing identities, coordination only
+through the per-slot Leases — without forking, so SimClock drives lease
+expiry and every scenario is seed-stable and fast (tier-1).  The real
+`kill -9` / SIGSTOP / SIGTERM soaks over actual OS processes live in
+tests/test_multiproc_soak.py (slow tier).
+"""
+import time
+
+import pytest
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.cmd.manager import ShardedOperator
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.sharding import ShardRouter, shard_lock_name
+from tf_operator_tpu.k8s.chaos import DeterministicQueue, FaultInjector, SimClock
+from tf_operator_tpu.k8s.fake import ApiError, FakeCluster
+
+from tests import testutil
+
+
+@pytest.fixture(autouse=True)
+def _reset_shared_gauges():
+    """These scenarios deliberately leave 'dead' instances with parked
+    queues; the depth/ownership gauges are process-global and keyed by
+    the same shard-<i> labels later tests' operators use, so a stale
+    level from a corpse here must not leak into their assertions."""
+    yield
+    metrics.WORKQUEUE_DEPTH.reset()
+    metrics.SHARD_JOBS_OWNED.reset()
+    metrics.SHARD_SLOTS_OWNED.reset()
+
+
+def _worker(cluster, index, shards=2, clock=None, lease=10.0):
+    """One 'process': a ShardedOperator hosting a single home slot of an
+    `shards`-slot plane (exactly what `cmd/main.py --shard-index` runs)."""
+    opts = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+    op = ShardedOperator(
+        cluster, opts, shard_count=shards,
+        engine_kwargs={"clock": clock} if clock else None,
+        clock=clock or time.time, lease_duration=lease,
+        local_shards=[index],
+    )
+    for s in op.shards:
+        for ctl in s.manager.controllers.values():
+            ctl.queue = DeterministicQueue()
+    op.start(workers=False)
+    return op
+
+
+def _drain(ops, budget=200):
+    for _ in range(budget):
+        busy = False
+        for op in ops:
+            for s in op.shards:
+                if s.crashed:
+                    continue
+                for ctl in s.manager.controllers.values():
+                    key = ctl.queue.get(timeout=0)
+                    if key is None:
+                        continue
+                    busy = True
+                    try:
+                        ctl._sync_guarded(key)
+                    finally:
+                        ctl.queue.done(key)
+        if not busy:
+            return
+
+
+def _settle(inj, ops, rounds=6, dt=2.0):
+    for _ in range(rounds):
+        inj.step(dt)
+        for op in ops:
+            op.tick()
+        _drain(ops)
+
+
+def _uid_for_slot(slot, shards=2):
+    router = ShardRouter(shards)
+    return next(
+        u for u in (f"mp-{i}" for i in range(200))
+        if router.slot_for(u) == slot
+    )
+
+
+def test_two_instances_partition_the_plane_and_each_drives_its_slot():
+    """Each instance acquires its home slot's Lease under its own
+    identity and drives only the jobs hashing there — the coordination
+    is entirely in the store, never in shared memory."""
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=0, clock=clock, kubelet=True)
+    a = _worker(inj, 0, clock=clock)
+    b = _worker(inj, 1, clock=clock)
+    assert a.instance_id != b.instance_id
+
+    for slot in (0, 1):
+        job = testutil.new_tfjob(f"part{slot}", worker=1)
+        job.metadata["uid"] = _uid_for_slot(slot)
+        inj.create("TFJob", job.to_dict())
+    _settle(inj, [a, b])
+
+    for slot, op in ((0, a), (1, b)):
+        lease = inner.get("Lease", "default", shard_lock_name(slot))
+        assert lease["spec"]["holderIdentity"].startswith(op.instance_id)
+        stored = inner.get("TFJob", "default", f"part{slot}")
+        assert common.is_running(common.JobStatus.from_dict(stored["status"]))
+        # driven by the owner and ONLY the owner
+        key = f"default/part{slot}"
+        assert (key in op.shards[0].manager.controllers["TFJob"]
+                .engine._rv_seen)
+        peer = b if op is a else a
+        assert (key not in peer.shards[0].manager.controllers["TFJob"]
+                .engine._rv_seen)
+    a.stop()
+    b.stop()
+
+
+def test_dead_instance_slot_fails_over_and_zombie_write_is_fenced():
+    """Instance B 'dies' (stops ticking/renewing).  A's takeover sweep
+    absorbs slot 1 after the lease lapses and re-adopts its jobs; B's
+    post-mortem status write with the cached token is 403-fenced."""
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=1, clock=clock, kubelet=True)
+    a = _worker(inj, 0, clock=clock)
+    b = _worker(inj, 1, clock=clock)
+    job = testutil.new_tfjob("fo", worker=1)
+    job.metadata["uid"] = _uid_for_slot(1)
+    inj.create("TFJob", job.to_dict())
+    _settle(inj, [a, b])
+    assert common.is_running(common.JobStatus.from_dict(
+        inner.get("TFJob", "default", "fo")["status"]
+    ))
+
+    # B dies: only A is stepped from here on
+    clock.advance(11.0)
+    failovers = metrics.SHARD_FAILOVERS.get({"slot": "1", "shard": "shard-0"})
+    _settle(inj, [a])
+    assert 1 in a.shards[0].owned_slots
+    assert metrics.SHARD_FAILOVERS.get(
+        {"slot": "1", "shard": "shard-0"}
+    ) == failovers + 1
+    lease = inner.get("Lease", "default", shard_lock_name(1))
+    assert lease["spec"]["holderIdentity"] == f"{a.instance_id}/shard-0"
+
+    # the zombie writes status with its cached generation-1 token
+    zombie_engine = b.shards[0].manager.controllers["TFJob"].engine
+    fresh = zombie_engine.adapter.from_dict(
+        inner.get("TFJob", "default", "fo")
+    )
+    import copy
+
+    old_status = copy.deepcopy(fresh.status)
+    fresh.status.replica_statuses["Worker"].restarts = 99
+    before = metrics.FENCING_REJECTIONS.get({"kind": "TFJob"})
+    with pytest.raises(ApiError) as exc:
+        zombie_engine._write_status(fresh, old_status)
+    assert "stale" in str(exc.value)
+    assert metrics.FENCING_REJECTIONS.get({"kind": "TFJob"}) == before + 1
+    a.stop()
+    b.factory.stop_all()
+
+
+def test_restarted_instance_reclaims_home_slot_via_preference():
+    """The restart-with-new-identity protocol end to end: survivor A
+    holds dead B's slot; replacement B2 stamps preferredHolder, A hands
+    the slot back on its next renew (instead of B2 waiting out a lapse
+    that never comes), A's own sweep DEFERS to the preference, and B2's
+    acquire bumps the fencing generation."""
+    inner = FakeCluster()
+    clock = SimClock()
+    inj = FaultInjector(inner, seed=2, clock=clock, kubelet=True)
+    a = _worker(inj, 0, clock=clock)
+    b = _worker(inj, 1, clock=clock)
+    _settle(inj, [a, b], rounds=2)
+    # B dies; A absorbs slot 1
+    clock.advance(11.0)
+    _settle(inj, [a], rounds=2)
+    assert a.shards[0].owned_slots == {0, 1}
+    gen_survivor = a.shards[0].locks[1].generation
+
+    # the supervisor restarts slot 1's worker: a NEW identity
+    b2 = _worker(inj, 1, clock=clock)
+    assert 1 not in b2.shards[0].owned_slots, "must not steal a live lease"
+    b2.tick()  # records the standing preferredHolder request
+    lease = inner.get("Lease", "default", shard_lock_name(1))
+    assert lease["spec"]["preferredHolder"] == f"{b2.instance_id}/shard-1"
+
+    a.tick()  # A renews slot 1, sees the preference, hands the slot back
+    assert a.shards[0].owned_slots == {0}
+    # A's sweep must now DEFER to B2 instead of re-grabbing the free slot
+    a.tick()
+    assert 1 not in a.shards[0].owned_slots
+    b2.tick()  # B2's sweep takes its home slot back
+    assert 1 in b2.shards[0].owned_slots
+    assert b2.shards[0].locks[1].generation == gen_survivor + 1, (
+        "reclaim is a NEW holding: the generation must bump so the "
+        "survivor's cached token for the slot is fenced"
+    )
+    a.stop()
+    b2.stop()
+    b.factory.stop_all()
+
+
+def test_supervisor_worker_argv_derivation():
+    """The worker argv is the supervisor's own argv minus the
+    --shard-processes recursion, listeners moved to ephemeral ports,
+    per-worker trace-dump paths, and the slot index stamped last."""
+    from tf_operator_tpu.cmd.supervisor import build_worker_argv
+
+    base = [
+        "--kubeconfig", "/tmp/kc.yaml",
+        "--shards", "4",
+        "--shard-processes",
+        "--leader-elect",
+        "--trace-dump", "/tmp/traces.json",
+        "--metrics-bind-address", ":8080",
+    ]
+    argv = build_worker_argv(base, 2)
+    assert "--shard-processes" not in argv, "workers must not recurse"
+    assert "--leader-elect" not in argv, (
+        "leader election across workers would idle all but one — the "
+        "per-slot Leases are already the election"
+    )
+    assert argv[-2:] == ["--shard-index", "2"]
+    assert argv[argv.index("--trace-dump") + 1] == "/tmp/traces.json.shard2"
+    # last-wins override: the ephemeral listener addresses come AFTER the
+    # inherited ones
+    metrics_vals = [
+        argv[i + 1] for i, a in enumerate(argv)
+        if a == "--metrics-bind-address"
+    ]
+    assert metrics_vals[-1] == "127.0.0.1:0"
+    assert "--health-probe-bind-address" in argv
+    assert argv[argv.index("--kubeconfig") + 1] == "/tmp/kc.yaml"
+
+
+def test_clean_stop_hands_slot_over_in_real_time_not_lease_duration():
+    """Satellite (ISSUE 11): a worker's graceful shutdown releases its
+    leases, so the sibling acquires the slot in real seconds — never by
+    waiting out lease_duration.  Deliberately SimClock-free: the bound
+    is wall-clock."""
+    inner = FakeCluster()
+    a = _worker(inner, 0, lease=60.0)  # a lapse would take a minute
+    b = _worker(inner, 1, lease=60.0)
+    t0 = time.monotonic()
+    b.stop()  # the SIGTERM path: ShardedOperator.stop() releases leases
+    a.tick()  # the sibling's next maintenance pass
+    elapsed = time.monotonic() - t0
+    assert 1 in a.shards[0].owned_slots, (
+        "released slot must be adoptable immediately"
+    )
+    assert elapsed < 10.0, f"handover took {elapsed:.1f}s (lease is 60s)"
+    a.stop()

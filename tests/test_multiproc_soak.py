@@ -1,0 +1,479 @@
+"""Multi-process control plane soaks (ISSUE 11) — REAL OS processes.
+
+The deterministic (SimClock, in-process) half of the multi-process
+machinery is covered in tests/test_cmd_multiproc.py.  Here the actual
+deployment artifact runs as supervised worker processes against the
+HTTP apiserver, and process death is the real thing: `kill -9` mid
+500-storm, SIGSTOP/SIGCONT zombies, SIGTERM rollouts, SIGUSR1 dumps.
+All slow-tier: each scenario pays real process spawns and lease waits.
+"""
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.cmd.supervisor import Supervisor
+from tf_operator_tpu.e2e.http_apiserver import (
+    FairFlowController,
+    HttpApiServer,
+)
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.sharding import (
+    FENCE_ANNOTATION,
+    ShardRouter,
+    shard_lock_name,
+)
+from tf_operator_tpu.k8s.fake import ApiError, FakeCluster
+from tf_operator_tpu.k8s.kubelet_util import write_pod_status
+from tf_operator_tpu.k8s.objects import name_of, namespace_of
+
+from tests import testutil
+
+pytestmark = pytest.mark.slow
+
+LEASE = 2.0
+
+
+def _wait(pred, timeout, msg, poll=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll)
+    raise AssertionError(msg)
+
+
+def _instant_kubelet(fake):
+    """Every pod goes Running on arrival (conflict-retrying writer)."""
+    def kubelet(etype, pod):
+        if etype != "ADDED":
+            return
+        write_pod_status(
+            fake, namespace_of(pod), name_of(pod),
+            lambda p: p.setdefault("status", {}).update(phase="Running"),
+        )
+
+    fake.subscribe("Pod", kubelet)
+
+
+def _spawn_plane(fake, tmp_path, shards, lease=LEASE, extra=(),
+                 restart_backoff=0.5):
+    """HTTP apiserver over `fake` + a supervised N-worker-process plane."""
+    srv = HttpApiServer(
+        fake,
+        apf=FairFlowController(seats=16, seats_per_flow=8, queue_limit=64),
+    ).start()
+    srv.install_crds()
+    kc = srv.write_kubeconfig(str(tmp_path / "kubeconfig.yaml"))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "KUBECONFIG": "",
+        "KUBERNETES_SERVICE_HOST": "",
+    }
+    sup = Supervisor(
+        shards,
+        [
+            "--kubeconfig", kc,
+            "--shards", str(shards),
+            "--shard-lease-duration", str(lease),
+            "--threadiness", "2",
+            "--enable-scheme", "TFJob",
+            *extra,
+        ],
+        grace=15.0,
+        restart_backoff=restart_backoff,
+        log_dir=str(tmp_path),
+        env=env,
+    ).start()
+    return srv, sup
+
+
+def _worker_log(tmp_path, index):
+    p = tmp_path / f"shard-{index}.log"
+    return p.read_text()[-4000:] if p.exists() else "<no log>"
+
+
+def _holder(fake, slot):
+    try:
+        lease = fake.get("Lease", "default", shard_lock_name(slot))
+    except ApiError:
+        return None
+    return lease["spec"].get("holderIdentity")
+
+
+def _wait_all_slots_held(fake, shards, timeout=30.0):
+    """Home convergence — slot i held by worker i.  A slow-starting
+    worker's home slot can be swept up by a sibling's first tick (the
+    preference hand-back returns it within a few ticks); scenarios that
+    pick victims BY SLOT must not start until the mapping is the
+    identity."""
+    _wait(
+        lambda: all(
+            (_holder(fake, s) or "").endswith(f"/shard-{s}")
+            for s in range(shards)
+        ),
+        timeout, "workers never converged on their home slots",
+    )
+
+
+def _make_job(fake, name, uid, workers=2, policy=None):
+    job = testutil.new_tfjob(name, worker=workers)
+    if policy:
+        job.replica_specs["Worker"].restart_policy = policy
+    job.metadata["uid"] = uid
+    fake.create("TFJob", job.to_dict())
+
+
+def _uids_for_slot(slot, shards, n, tag="soak"):
+    router = ShardRouter(shards)
+    out = []
+    i = 0
+    while len(out) < n:
+        uid = f"{tag}-{i}"
+        if router.slot_for(uid) == slot:
+            out.append(uid)
+        i += 1
+    return out
+
+
+def _running_jobs(fake):
+    from tf_operator_tpu.sdk.watch import job_state
+
+    return sum(
+        1 for j in fake.list("TFJob", namespace="default")
+        if job_state(j) == "Running"
+    )
+
+
+class _StormCluster(FakeCluster):
+    """Backing store with a switchable 500-fault window on job writes —
+    the server-side '500 storm' the kill -9 soak runs through.  Reads and
+    Pod/Lease traffic stay clean: the storm targets the operator's write
+    path (which its client retry ladder absorbs), not the kubelet or the
+    lease machinery that the scenario needs live."""
+
+    def __init__(self):
+        super().__init__()
+        self.storm_until = 0.0
+
+    def _stormy(self, kind):
+        return kind == "TFJob" and time.monotonic() < self.storm_until
+
+    def update_status(self, kind, obj):
+        if self._stormy(kind):
+            raise ApiError(500, "injected storm")
+        return super().update_status(kind, obj)
+
+    def update(self, kind, obj):
+        if self._stormy(kind):
+            raise ApiError(500, "injected storm")
+        return super().update(kind, obj)
+
+
+def test_kill9_mid_storm_survivors_readopt_exactly_once(tmp_path):
+    """The ISSUE 11 acceptance soak: 4 worker PROCESSES, a 500 storm on
+    job writes, and `kill -9` of a real child mid-storm.  Survivors take
+    the dead slot within the lease bound and re-adopt its jobs exactly
+    once (same pods, same uids, zero orphans); the supervisor restarts
+    the victim as a NEW identity."""
+    fake = _StormCluster()
+    _instant_kubelet(fake)
+    shards, n_jobs = 4, 24
+    srv, sup = _spawn_plane(fake, tmp_path, shards)
+    try:
+        _wait_all_slots_held(fake, shards)
+        victim_slot = 1
+        victim = sup.workers[victim_slot]
+        victim_identity = _holder(fake, victim_slot)
+        victim_pid = victim.pid
+        assert victim_identity is not None
+
+        # jobs spread over every slot, a known batch on the victim's
+        uids = [f"spread-{i}" for i in range(n_jobs - 6)]
+        uids += _uids_for_slot(victim_slot, shards, 6)
+        for i, uid in enumerate(uids):
+            _make_job(fake, f"soak{i}", uid)
+        _wait(
+            lambda: _running_jobs(fake) == n_jobs, 60.0,
+            f"jobs never converged: {_running_jobs(fake)}/{n_jobs} "
+            f"({_worker_log(tmp_path, victim_slot)})",
+        )
+        pods_before = {
+            name_of(p): p["metadata"]["uid"]
+            for p in fake.list("Pod", namespace="default")
+        }
+        assert len(pods_before) == 2 * n_jobs
+
+        # ---- storm on, then kill -9 the victim mid-storm
+        fake.storm_until = time.monotonic() + 3.0
+        time.sleep(0.3)
+        t_kill = time.monotonic()
+        os.kill(victim_pid, signal.SIGKILL)
+
+        # survivors absorb the slot within the lease bound (+ tick and
+        # takeover slack, all while the storm is still blowing)
+        _wait(
+            lambda: (
+                _holder(fake, victim_slot) is not None
+                and not _holder(fake, victim_slot).startswith(
+                    victim_identity.split("/")[0]
+                )
+            ),
+            LEASE * 3 + 10.0,
+            "dead worker's slot was never taken over",
+        )
+        takeover_s = time.monotonic() - t_kill
+        assert takeover_s < LEASE * 3 + 10.0
+
+        # the re-adopt is exactly-once: same pod set, same uids, nothing
+        # orphaned, nothing duplicated, every job still Running
+        def _converged():
+            pods = {
+                name_of(p): p["metadata"]["uid"]
+                for p in fake.list("Pod", namespace="default")
+            }
+            return pods == pods_before and _running_jobs(fake) == n_jobs
+
+        _wait(
+            _converged, 30.0,
+            f"re-adopt not exact: pods="
+            f"{len(fake.list('Pod', namespace='default'))} "
+            f"running={_running_jobs(fake)}/{n_jobs}",
+        )
+
+        # restart counters stayed exact (no restarts ever happened)
+        for j in fake.list("TFJob", namespace="default"):
+            rs = (j.get("status") or {}).get("replicaStatuses") or {}
+            assert (rs.get("Worker") or {}).get("restarts", 0) == 0, j
+
+        # the supervisor restarted the victim with a new pid (= new
+        # instance identity; its eventual re-acquires bump generations)
+        _wait(
+            lambda: victim.alive and victim.pid != victim_pid, 30.0,
+            "supervisor never restarted the killed worker",
+        )
+        assert victim.restarts >= 1
+    finally:
+        sup.stop()
+        srv.stop()
+
+
+class _HoldStaleWrites(FakeCluster):
+    """Backing store that parks status writes carrying a chosen fencing
+    generation until released — the deterministic way to have a zombie's
+    writes IN FLIGHT while its slot fails over.  (A SIGSTOPped process
+    cannot be steered; its already-sent requests can.)"""
+
+    def __init__(self):
+        super().__init__()
+        self.hold_suffix = None  # e.g. ":1" — generation to park
+        self.held = 0
+        self.release_evt = threading.Event()
+
+    def update_status(self, kind, obj):
+        ann = ((obj.get("metadata") or {}).get("annotations") or {})
+        token = ann.get(FENCE_ANNOTATION) or ""
+        if self.hold_suffix and token.endswith(self.hold_suffix):
+            self.held += 1
+            self.release_evt.wait(timeout=30.0)
+        return super().update_status(kind, obj)
+
+
+def _kill_pod_137(fake, name):
+    """Kubelet-style preemption: terminate a pod with a retryable exit
+    code so an ExitCode-policy job books a delete-for-recreate restart."""
+    write_pod_status(
+        fake, "default", name,
+        lambda p: p.setdefault("status", {}).update(
+            phase="Failed",
+            containerStatuses=[{
+                "name": "tensorflow",
+                "state": {"terminated": {"exitCode": 137}},
+            }],
+        ),
+    )
+
+
+def test_sigstop_zombie_status_writes_rejected_403(tmp_path):
+    """Satellite (ISSUE 11): SIGSTOP a worker past lease expiry, let a
+    survivor take its slot, SIGCONT the zombie — every status write the
+    zombie had in flight is rejected 403 by the store-side fence and
+    counted in `fencing_rejections_total`, and the job's restart
+    counter stays exact (the zombie's fenced bookkeeping neither lands
+    nor double-counts the survivor's)."""
+    fake = _HoldStaleWrites()
+    _instant_kubelet(fake)
+    metrics.FENCING_REJECTIONS.reset()
+    # backoff off: the survivor's delete-for-recreate restart (the
+    # counter-exactness probe) must not sit in a 5s crash-loop hold
+    srv, sup = _spawn_plane(
+        fake, tmp_path, shards=2, extra=("--restart-backoff-base", "0"),
+    )
+    try:
+        _wait_all_slots_held(fake, 2)
+        zombie = sup.workers[0]
+        zombie_identity = _holder(fake, 0)
+        gen0 = fake.get("Lease", "default", shard_lock_name(0))["spec"][
+            "generation"
+        ]
+
+        # one ExitCode job on the zombie's slot
+        uid = _uids_for_slot(0, 2, 1, tag="zfence")[0]
+        _make_job(
+            fake, "zfence", uid, workers=1,
+            policy=common.RESTART_POLICY_EXIT_CODE,
+        )
+        _wait(
+            lambda: _running_jobs(fake) == 1, 30.0,
+            f"job never ran ({_worker_log(tmp_path, 0)})",
+        )
+
+        # park any status write stamped with the zombie's current
+        # generation, then preempt the worker pod: the zombie books a
+        # restart and its status write arrives — and hangs — server-side,
+        # which is the deterministic way to have the write IN FLIGHT
+        # while the slot fails over
+        fake.hold_suffix = f":{gen0}"
+        _kill_pod_137(fake, "zfence-worker-0")
+        _wait(
+            lambda: fake.held >= 1, 20.0,
+            f"zombie's restart write never arrived "
+            f"({_worker_log(tmp_path, 0)})",
+        )
+        held_writes = fake.held
+        os.kill(zombie.pid, signal.SIGSTOP)
+
+        try:
+            # the slot fails over to the survivor with a generation bump —
+            # the zombie's parked writes are now one generation stale
+            _wait(
+                lambda: (
+                    (h := _holder(fake, 0)) is not None
+                    and h != zombie_identity
+                ),
+                LEASE * 3 + 10.0, "survivor never took the zombie's slot",
+            )
+            assert fake.get(
+                "Lease", "default", shard_lock_name(0)
+            )["spec"]["generation"] == gen0 + 1
+        finally:
+            # release the parked stale writes and wake the zombie
+            fake.release_evt.set()
+            os.kill(zombie.pid, signal.SIGCONT)
+
+        # every in-flight zombie write crossed the fence and was 403'd
+        _wait(
+            lambda: metrics.FENCING_REJECTIONS.get({"kind": "TFJob"})
+            >= held_writes,
+            20.0,
+            f"held zombie writes not fenced: "
+            f"{metrics.FENCING_REJECTIONS.get({'kind': 'TFJob'})} of "
+            f"{held_writes} ({_worker_log(tmp_path, 0)})",
+        )
+
+        # the zombie's fenced bookkeeping never landed: the store's
+        # restart counter holds the survivor's exact count.  The zombie
+        # already replaced the preempted pod BEFORE it was stopped (its
+        # in-lease mutations were legal); its fenced write means the
+        # counter reads 0 — consistent ownership wins over the dead
+        # incarnation's bookkeeping, and crucially NOT 99/garbage
+        def _restarts():
+            j = fake.get("TFJob", "default", "zfence")
+            rs = (j.get("status") or {}).get("replicaStatuses") or {}
+            return (rs.get("Worker") or {}).get("restarts", 0)
+
+        assert _restarts() == 0
+        # now the SURVIVOR drives a real preemption restart: the counter
+        # must land at exactly 1 — no zombie inflation, no double count
+        time.sleep(1.5)  # zombie's next tick disowns before the kill
+        _wait(lambda: _running_jobs(fake) == 1, 30.0, "job not re-running")
+        _kill_pod_137(fake, "zfence-worker-0")
+        _wait(
+            lambda: _restarts() == 1, 30.0,
+            f"survivor never booked the restart "
+            f"({_worker_log(tmp_path, 1)})",
+        )
+        _wait(lambda: _running_jobs(fake) == 1, 30.0, "job not re-running")
+        assert _restarts() == 1
+        pods = fake.list("Pod", namespace="default")
+        assert len(pods) == 1, [name_of(p) for p in pods]
+    finally:
+        sup.stop()
+        srv.stop()
+
+
+def test_sigterm_rollout_hands_slot_over_without_lease_wait(tmp_path):
+    """Satellite (ISSUE 11): a worker's SIGTERM handler releases its
+    leases (ShardedOperator.stop()), so a rolling restart's handover is
+    real-time — the 30s lease would otherwise park the slot for a
+    detectable age."""
+    fake = FakeCluster()
+    _instant_kubelet(fake)
+    srv, sup = _spawn_plane(
+        fake, tmp_path, shards=2, lease=30.0, restart_backoff=5.0
+    )
+    try:
+        _wait_all_slots_held(fake, 2)
+        old_holder = _holder(fake, 0)
+        t0 = time.monotonic()
+        sup.workers[0].proc.send_signal(signal.SIGTERM)
+        # the slot must be re-held (survivor sweep, or the supervisor's
+        # replacement) long before the 30s lease could have lapsed
+        _wait(
+            lambda: (
+                (h := _holder(fake, 0)) is not None and h != old_holder
+            ),
+            15.0,
+            f"slot not handed over after SIGTERM "
+            f"({_worker_log(tmp_path, 0)})",
+        )
+        assert time.monotonic() - t0 < 15.0
+    finally:
+        sup.stop()
+        srv.stop()
+
+
+def test_sigusr1_dumps_worker_traces_at_pid_stamped_path(tmp_path):
+    """Satellite (ISSUE 11): every worker PROCESS registers the SIGUSR1
+    trace+timeline dump on its own main thread post-fork, at a
+    pid-stamped path — `kill -USR1 <worker pid>` inspects exactly that
+    worker even with N of them running."""
+    fake = FakeCluster()
+    _instant_kubelet(fake)
+    srv, sup = _spawn_plane(fake, tmp_path, shards=2)
+    try:
+        _wait_all_slots_held(fake, 2)
+        uid = _uids_for_slot(0, 2, 1, tag="dump")[0]
+        _make_job(fake, "dumpme", uid, workers=1)
+        _wait(lambda: _running_jobs(fake) == 1, 30.0, "job never ran")
+
+        pid = sup.workers[0].pid
+        dump = f"/tmp/tpu-operator-{pid}-traces.json"
+        timeline = dump + ".timeline.json"
+        for stale in (dump, timeline):
+            if os.path.exists(stale):
+                os.unlink(stale)
+        time.sleep(0.5)  # let the worker's syncs finish tracing
+        os.kill(pid, signal.SIGUSR1)
+        _wait(
+            lambda: os.path.exists(dump) and os.path.exists(timeline),
+            15.0,
+            f"SIGUSR1 dump never appeared at {dump} "
+            f"({_worker_log(tmp_path, 0)})",
+        )
+        with open(dump) as fh:
+            doc = json.load(fh)
+        assert "traceEvents" in doc
+        with open(timeline) as fh:
+            tl = json.load(fh)
+        assert any("dumpme" in key for key in tl["jobs"]), list(tl["jobs"])
+        for p in (dump, timeline):
+            os.unlink(p)
+    finally:
+        sup.stop()
+        srv.stop()

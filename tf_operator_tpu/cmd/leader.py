@@ -52,6 +52,11 @@ class LeaseLock:
         holding.  Deliberately NOT cleared when renewal fails: a zombie
         keeps writing with its cached token, which is exactly what the
         store-side fencing check exists to reject.
+      - ``preferred_by``: another identity has asked for this lock via
+        ``request_preference`` (the Lease's ``spec.preferredHolder``, the
+        coordinated-leader-election hand-back from the client-go lineage).
+        A holder that honors it calls ``release()``; the preference is
+        advisory — nothing ever *takes* a live lease.
     """
 
     def __init__(
@@ -73,6 +78,8 @@ class LeaseLock:
         self.lost_to_other = False
         self.generation = 0
         self.last_renew = 0.0
+        self.preferred_by: Optional[str] = None
+        self.deferred_to_preferred = False
 
     # ------------------------------------------------------------- lock ops
     def _get_lease(self) -> Optional[Dict[str, Any]]:
@@ -83,12 +90,20 @@ class LeaseLock:
         except (ApiError, OSError):
             return None
 
-    def try_acquire_or_renew(self) -> bool:
+    def try_acquire_or_renew(self, honor_preference: bool = False) -> bool:
         """One CAS attempt.  True = we hold the lock (fresh acquire or
         renew); False = held by someone else, or the store errored (the
-        caller decides whether to keep believing via `locally_expired`)."""
+        caller decides whether to keep believing via `locally_expired`).
+
+        `honor_preference`: when the lease is free for the taking but its
+        ``preferredHolder`` names a DIFFERENT identity, step aside this
+        attempt (`deferred_to_preferred` is set) so the preferred holder's
+        own loop wins the race instead of whoever ticks first.  The caller
+        bounds the courtesy — a dead preferred holder must not park the
+        slot forever."""
         now = self.clock()
         self.lost_to_other = False
+        self.deferred_to_preferred = False
         lease = self._get_lease()
         if lease is None:
             record = {
@@ -117,12 +132,21 @@ class LeaseLock:
             return True
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity")
+        preferred = spec.get("preferredHolder") or None
+        self.preferred_by = preferred if preferred != self.identity else None
         expired = now > spec.get("renewTime", 0) + spec.get(
             "leaseDurationSeconds", self.lease_duration
         )
         if holder != self.identity and not expired:
             self.lost_to_other = True
             self.held = False
+            return False
+        if (
+            honor_preference
+            and self.preferred_by
+            and not (holder == self.identity and not expired)
+        ):
+            self.deferred_to_preferred = True
             return False
         prev_gen = int(spec.get("generation", 0) or 0)
         # a NEW holding (takeover, or re-acquire after our own expiry —
@@ -136,6 +160,12 @@ class LeaseLock:
             "renewTime": now,
             "generation": new_gen,
         }
+        if renewing and self.preferred_by:
+            # a renew must not erase a standing hand-back request (the
+            # requester writes it once, not once per our renew); a NEW
+            # holding clears it — if we are the preferred holder the
+            # request is satisfied, and if not the old request is moot
+            lease["spec"]["preferredHolder"] = self.preferred_by
         try:
             self.cluster.update(LEASE_KIND, lease)
         except (ApiError, OSError):
@@ -158,6 +188,28 @@ class LeaseLock:
         if self.generation <= 0:
             return None
         return fence_token(self.namespace, self.lock_name, self.generation)
+
+    def request_preference(self) -> bool:
+        """Ask the current (different, unexpired) holder to hand this lock
+        back by stamping our identity into ``spec.preferredHolder`` — the
+        home-slot reclaim a restarted worker process uses instead of
+        waiting for the survivor's lease to lapse.  Advisory and
+        idempotent: one write per standing request, never a takeover.
+        Returns True once the request is recorded (or already was)."""
+        lease = self._get_lease()
+        if lease is None:
+            return False
+        spec = lease.get("spec", {})
+        if spec.get("holderIdentity") == self.identity:
+            return False  # we hold it; nothing to request
+        if spec.get("preferredHolder") == self.identity:
+            return True  # standing request, carried by the holder's renews
+        lease["spec"] = {**spec, "preferredHolder": self.identity}
+        try:
+            self.cluster.update(LEASE_KIND, lease)
+        except (ApiError, OSError):
+            return False  # lost an RMW race (e.g. with a renew): next tick
+        return True
 
     def release(self) -> None:
         """Voluntarily give up the lease so a standby can take over without

@@ -97,16 +97,26 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
                 "manifests/overlays/standalone (kubectl apply -k) first"
             )
 
-    if options.shards > 1:
-        # sharded control plane: N shard workers in this process, jobs
-        # partitioned by rendezvous hash, per-slot Leases with failover
-        # and fenced status writes (cmd/manager.py ShardedOperator)
+    if options.shards > 1 or options.shard_index >= 0:
+        # sharded control plane: jobs partitioned by rendezvous hash,
+        # per-slot Leases with failover and fenced status writes
+        # (cmd/manager.py ShardedOperator).  In `--shard-processes` mode
+        # this process is ONE worker of the plane: it hosts only its
+        # `--shard-index` home slot (the supervisor stamps the flag) and
+        # coordinates with its sibling processes purely through the
+        # Leases in the shared apiserver — even a 1-slot plane keeps its
+        # Lease there, so a supervisor restart is fenced like any other
+        # new identity.
+        local = (
+            [options.shard_index] if options.shard_index >= 0 else None
+        )
         manager = ShardedOperator(
             cluster,
             options,
             shard_count=options.shards,
             lease_duration=options.shard_lease_duration,
             lease_namespace=options.namespace or "default",
+            local_shards=local,
         )
     else:
         manager = OperatorManager(cluster, options)
@@ -180,7 +190,12 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
     # SIGUSR1: dump traces + all live timelines NOW — --trace-dump only
     # fires on clean shutdown, which a wedged operator never reaches.
     # Registration needs the main thread (tests embed run() in worker
-    # threads; they call dump_debug_state directly).
+    # threads; they call dump_debug_state directly).  Shard worker
+    # processes get this too: the supervisor re-execs this entrypoint,
+    # so each child registers on its OWN main thread post-fork and the
+    # pid-stamped fallback path keeps N workers' dumps from clobbering
+    # each other — `kill -USR1 <worker pid>` inspects exactly that
+    # worker.
     if (
         hasattr(signal, "SIGUSR1")
         and threading.current_thread() is threading.main_thread()
@@ -189,6 +204,10 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         signal.signal(
             signal.SIGUSR1,
             lambda *_: dump_debug_state(options.trace_dump or fallback),
+        )
+        log.info(
+            "SIGUSR1 debug dump registered (pid %d, fallback %s)",
+            os.getpid(), fallback,
         )
 
     def start_manager():
@@ -212,6 +231,15 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
             ),
         )
 
+    if block:
+        # shutdown signals are wired BEFORE the manager starts: a worker
+        # process SIGTERMed during startup (a rollout racing a slow cache
+        # sync) must still run the graceful path — ShardedOperator.stop()
+        # releases its held slot Leases, and dying by default disposition
+        # here would park every acquired slot for a full lease_duration
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop_event.set())
+
     if options.leader_elect:
         elector = LeaderElector(
             cluster,
@@ -226,8 +254,6 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         start_manager()
 
     if block:
-        for sig in (signal.SIGINT, signal.SIGTERM):
-            signal.signal(sig, lambda *_: stop_event.set())
         stop_event.wait()
         manager.stop()
         probe.stop()
@@ -257,6 +283,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if options.print_version:
         print(version.version_string())
         return 0
+    if options.shard_processes and options.shard_index < 0:
+        # multi-process control plane: this invocation is the parent
+        # supervisor — fork one worker process per shard slot (each a
+        # re-exec of this entrypoint with --shard-index i) and own only
+        # their lifecycle (cmd/supervisor.py)
+        from tf_operator_tpu.cmd.supervisor import run_supervisor
+
+        return run_supervisor(
+            options, list(argv) if argv is not None else sys.argv[1:]
+        )
     run(options)
     return 0
 

@@ -39,7 +39,11 @@ from tf_operator_tpu.controllers.registry import make_engine
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.engine import timeline as timeline_mod
 from tf_operator_tpu.engine.controller import EngineConfig
-from tf_operator_tpu.engine.sharding import ShardRouter
+from tf_operator_tpu.engine.sharding import (
+    DEFAULT_LOCK_PREFIX,
+    ShardRouter,
+    shard_lock_name,
+)
 from tf_operator_tpu.engine.warmpool import (
     DEFAULT_SHAPE,
     WarmPoolConfig,
@@ -729,7 +733,7 @@ class _ShardHandle:
     def owns_uid(self, uid: Optional[str]) -> bool:
         return (
             self._op.router.slot_for(uid)
-            in self._op.shards[self.index].owned_slots
+            in self._op._shard_by_index[self.index].owned_slots
         )
 
     def may_act(self, uid: Optional[str]) -> bool:
@@ -741,7 +745,7 @@ class _ShardHandle:
         renew-failure storm, or a resumed zombie), the shard must not
         issue pod/service mutations — only the status write is
         store-fenced, a zombie's create/delete would land unfenced."""
-        shard = self._op.shards[self.index]
+        shard = self._op._shard_by_index[self.index]
         slot = self._op.router.slot_for(uid)
         if slot not in shard.owned_slots:
             return False
@@ -753,7 +757,7 @@ class _ShardHandle:
         )
 
     def fence_token_for(self, uid: Optional[str]) -> Optional[str]:
-        shard = self._op.shards[self.index]
+        shard = self._op._shard_by_index[self.index]
         lock = shard.locks.get(self._op.router.slot_for(uid))
         return lock.token if lock is not None else None
 
@@ -806,10 +810,28 @@ class ShardedOperator:
     - **shards=1**: leases default off, ownership is static, and the data
       path is byte-identical to the single OperatorManager (asserted
       against the pre-shard chaos golden log).
+    - **Multi-process** (ISSUE 11): `local_shards` names the subset of
+      slot indices this PROCESS instantiates shards for — N worker
+      processes each run `ShardedOperator(local_shards=[i])` against the
+      same apiserver and coordinate ONLY through the slot Leases and
+      fenced status writes; there is deliberately no other cross-process
+      channel.  A local shard's takeover sweep absorbs any lapsed slot
+      (including a killed sibling process's), and a restarted process
+      reclaims its home slot by stamping the Lease's ``preferredHolder``
+      (cmd/leader.py) — the survivor hands the slot back on its next
+      renew instead of the restart waiting out a lapse that never comes.
+      Leases are forced on whenever the slot space is wider than this
+      process (a single local shard of a 4-slot plane still fences).
 
     `note` is an optional callable(line) for the deterministic chaos log
     (FaultInjector.note); `clock` drives lease expiry.
     """
+
+    # sweep courtesy toward a Lease's preferredHolder: a free slot whose
+    # preference names someone else is left alone for this many
+    # consecutive sweep attempts, then taken anyway (the preferred
+    # process may be dead — a hand-back must never park a slot forever)
+    _PREF_DEFER_TICKS = 3
 
     def __init__(
         self,
@@ -819,11 +841,12 @@ class ShardedOperator:
         engine_kwargs: Optional[Dict] = None,
         lease_duration: float = 15.0,
         lease_namespace: str = "default",
-        lock_prefix: str = "tpu-operator-shard",
+        lock_prefix: str = DEFAULT_LOCK_PREFIX,
         clock: Callable[[], float] = time.time,
         enable_leases: Optional[bool] = None,
         note: Optional[Callable[[str], None]] = None,
         instance_id: Optional[str] = None,
+        local_shards: Optional[List[int]] = None,
     ) -> None:
         self.cluster = cluster
         self.options = options or ServerOptions()
@@ -834,9 +857,28 @@ class ShardedOperator:
         self.lease_duration = lease_duration
         self.lease_namespace = lease_namespace
         self.lock_prefix = lock_prefix
-        self.enable_leases = (
-            shard_count > 1 if enable_leases is None else enable_leases
+        if local_shards is not None:
+            bad = [i for i in local_shards if not 0 <= i < shard_count]
+            if bad or not local_shards:
+                raise ValueError(
+                    f"local_shards must be non-empty indices in "
+                    f"[0, {shard_count}), got {local_shards!r}"
+                )
+        self.local_shards = (
+            sorted(set(local_shards)) if local_shards is not None else None
         )
+        # leases must be on whenever OTHER processes can own slots of this
+        # plane — even a single local shard of a multi-slot space fences
+        self.enable_leases = (
+            (shard_count > 1 if enable_leases is None else enable_leases)
+            or self.local_shards is not None
+        )
+        # home-slot reclaim (preferredHolder hand-back) is a multi-process
+        # behavior: a restarted worker process is a NEW identity wanting
+        # its home slot back.  In-process mode keeps the PR 6 zombie
+        # contract — a resumed shard stays disowned until slots lapse.
+        self._home_reclaim = self.local_shards is not None
+        self._pref_defer: Dict[int, int] = {}
         self.note = note or (lambda line: None)
         # lease holder identities must be unique per OPERATOR INSTANCE,
         # not just per shard index: with a bare "shard-0" identity a
@@ -871,8 +913,14 @@ class ShardedOperator:
             if self.scheduler is not None:
                 self.scheduler.recorder = self.recorder
         self.shards: List[_Shard] = [
-            _Shard(self, i) for i in range(shard_count)
+            _Shard(self, i)
+            for i in (self.local_shards
+                      if self.local_shards is not None
+                      else range(shard_count))
         ]
+        self._shard_by_index: Dict[int, _Shard] = {
+            s.index: s for s in self.shards
+        }
         # appended AFTER a failover's re-adopt enqueues complete — the
         # signal probes (bench failover_recovery_s) wait on, instead of
         # racing the owned_slots.add → enqueue window where the slot
@@ -890,7 +938,7 @@ class ShardedOperator:
             lock = LeaseLock(
                 self.cluster,
                 identity=f"{self.instance_id}/{shard.id}",
-                lock_name=f"{self.lock_prefix}-{slot}",
+                lock_name=shard_lock_name(slot, self.lock_prefix),
                 namespace=self.lease_namespace,
                 lease_duration=self.lease_duration,
                 clock=self.clock,
@@ -912,7 +960,15 @@ class ShardedOperator:
         observed, or our lease window lapsed — a transient store error
         inside the window keeps ownership and retries next tick), then
         sweep lapsed slots for takeover.  Driven by the background loop in
-        threaded mode and explicitly (against SimClock) in chaos tests."""
+        threaded mode and explicitly (against SimClock) in chaos tests.
+
+        Multi-process additions (both no-ops in-process): a renew that
+        observes ``preferredHolder`` on a NON-home slot hands it back
+        (release + disown) so a restarted sibling process reclaims its
+        home slot without waiting out our lease; a local shard missing its
+        home slot stamps that preference; and the takeover sweep briefly
+        defers to a free slot's preferred holder so the reclaim isn't
+        lost to whichever process happens to tick first."""
         if self.enable_leases:
             for shard in self.shards:
                 if shard.crashed:
@@ -920,9 +976,29 @@ class ShardedOperator:
                 for slot in sorted(shard.owned_slots):
                     lock = self._lock_for(shard, slot)
                     if lock.try_acquire_or_renew():
+                        if (
+                            self._home_reclaim
+                            and slot != shard.index
+                            and lock.preferred_by
+                        ):
+                            # an absorbed slot's home process is back and
+                            # asking: hand it back now — generation bumps
+                            # on its acquire, so our cached token fences
+                            self.note(
+                                f"shard_handback slot={slot} "
+                                f"shard={shard.id} to={lock.preferred_by}"
+                            )
+                            lock.release()
+                            self._disown(shard, slot)
                         continue
                     if lock.lost_to_other or lock.locally_expired():
                         self._disown(shard, slot)
+                if self._home_reclaim and shard.index not in shard.owned_slots:
+                    # our home slot is held elsewhere (we are a restarted
+                    # process; a survivor absorbed it): record the standing
+                    # hand-back request — advisory, idempotent, never a
+                    # takeover
+                    self._lock_for(shard, shard.index).request_preference()
             for slot in range(self.shard_count):
                 if any(
                     slot in s.owned_slots and not s.crashed
@@ -936,8 +1012,26 @@ class ShardedOperator:
                 # tiebreak); the lease CAS itself enforces expiry — the
                 # attempt fails until the old lease lapses
                 candidate = min(live, key=lambda s: (len(s.owned_slots), s.index))
-                if self._lock_for(candidate, slot).try_acquire_or_renew():
+                lock = self._lock_for(candidate, slot)
+                # defer to a different preferred holder for a bounded
+                # number of sweeps — never on our own home slot
+                honor = (
+                    slot != candidate.index
+                    and self._pref_defer.get(slot, 0) < self._PREF_DEFER_TICKS
+                )
+                if lock.try_acquire_or_renew(honor_preference=honor):
+                    self._pref_defer.pop(slot, None)
                     self._adopt(candidate, slot, failover=True)
+                elif lock.deferred_to_preferred:
+                    self._pref_defer[slot] = self._pref_defer.get(slot, 0) + 1
+                elif lock.lost_to_other:
+                    # the episode ended — whoever we were deferring to (or
+                    # any other holder) owns the slot now.  Reset the
+                    # courtesy budget so the NEXT failover of this slot
+                    # gets its full deference again; without this, one
+                    # consumed budget makes every later sweep seize the
+                    # slot from under a freshly restarted home process.
+                    self._pref_defer.pop(slot, None)
         self._update_gauges()
 
     # ------------------------------------------------------------- ownership
@@ -1090,7 +1184,7 @@ class ShardedOperator:
         sweep fails the slots over to survivors.  The shard's ownership
         memory is kept — resume_shard() brings it back as a zombie that
         still believes, which fencing must (and does) stop."""
-        shard = self.shards[index]
+        shard = self._shard_by_index[index]
         shard.crashed = True
         if self._threaded:
             for ctl in shard.manager.controllers.values():
@@ -1100,7 +1194,7 @@ class ShardedOperator:
         """Un-crash a shard WITHOUT rediscovery: it still holds its old
         owned_slots and cached fencing tokens — the zombie scenario.  Its
         next tick renew observes the new holder and disowns."""
-        self.shards[index].crashed = False
+        self._shard_by_index[index].crashed = False
 
     def stop(self) -> None:
         self._stop.set()

@@ -56,6 +56,23 @@ class ServerOptions:
     # operator, byte-identical to the pre-shard engine.
     shards: int = 1
     shard_lease_duration: float = 15.0
+    # multi-process control plane (cmd/supervisor.py): run each shard
+    # slot as its OWN OS process — a parent supervisor forks N workers
+    # (spawn, liveness, SIGTERM escalation, restart with a fresh fencing
+    # identity) that coordinate only through the per-slot Leases and
+    # fenced status writes against a shared apiserver.  Requires
+    # --kubeconfig (the workers must reach the apiserver over a real
+    # socket; an in-memory store cannot span processes).
+    shard_processes: bool = False
+    # internal (stamped by the supervisor onto each worker's argv): the
+    # single shard slot index THIS process hosts; -1 = not a worker
+    shard_index: int = -1
+    # supervisor shutdown escalation: SIGTERM each worker, then SIGKILL
+    # whatever is still alive after this many seconds
+    shard_process_grace: float = 10.0
+    # supervisor restart backoff for crash-looping workers (doubles per
+    # consecutive fast death, capped at 30s)
+    shard_restart_backoff: float = 1.0
     # warm-pool pod placement (engine/warmpool.py): keep K pre-pulled,
     # pre-initialized standby pods per slice shape; job pod creation
     # claims from the pool (CAS) and falls back to cold create.
@@ -187,6 +204,28 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "latency is bounded by this)",
     )
     p.add_argument(
+        "--shard-processes",
+        action="store_true",
+        help="run each shard slot as its own OS process under a parent "
+        "supervisor (liveness, SIGTERM escalation, restart with a fresh "
+        "fencing identity); workers coordinate only through per-slot "
+        "Leases and fenced status writes, so --kubeconfig is required",
+    )
+    p.add_argument(
+        "--shard-index",
+        type=int,
+        default=-1,
+        help=argparse.SUPPRESS,  # internal: stamped by the supervisor
+    )
+    p.add_argument(
+        "--shard-process-grace",
+        type=float,
+        default=10.0,
+        help="supervisor shutdown escalation: SIGKILL workers still "
+        "alive this many seconds after SIGTERM",
+    )
+    p.add_argument("--shard-restart-backoff", type=float, default=1.0)
+    p.add_argument(
         "--warm-pool-size",
         type=int,
         default=0,
@@ -291,6 +330,10 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         control_fanout=a.control_fanout,
         shards=a.shards,
         shard_lease_duration=a.shard_lease_duration,
+        shard_processes=a.shard_processes,
+        shard_index=a.shard_index,
+        shard_process_grace=a.shard_process_grace,
+        shard_restart_backoff=a.shard_restart_backoff,
         warm_pool_size=a.warm_pool_size,
         warm_pool_shapes=warm_shapes,
         warm_pool_image=a.warm_pool_image,

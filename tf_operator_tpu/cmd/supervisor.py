@@ -1,0 +1,326 @@
+"""Parent supervisor for the multi-process control plane (ISSUE 11).
+
+`--shards N --shard-processes` turns the operator entrypoint into a
+supervisor: it forks N worker OS processes — each one `cmd/main.py
+--shard-index i`, i.e. a ShardedOperator hosting exactly one home slot
+with its OWN informer factory, workqueues, and fencing identity — and
+owns nothing but their lifecycle.  The workers coordinate exclusively
+through the per-slot Leases and fenced status writes against the shared
+apiserver (the PR 6 machinery, now across real process boundaries), so
+the supervisor deliberately has no data-plane state to lose: kill -9 the
+supervisor and the workers keep reconciling; kill -9 a worker and its
+slot fails over to a sibling within the lease bound.
+
+Lifecycle rules:
+
+- **Spawn**: one subprocess per slot, stdout/stderr to a per-worker log
+  file (or inherited).  Workers bind their health/metrics listeners to
+  ephemeral ports — the supervisor's own listeners keep the advertised
+  addresses and report aggregate liveness.
+- **Liveness + restart-with-new-identity**: a worker that dies (any
+  cause — crash, OOM kill, `kill -9`) is restarted after a crash-loop
+  backoff.  The replacement is a NEW process, so its ShardedOperator
+  mints a fresh `instance_id`: when it eventually re-acquires a slot the
+  Lease generation bumps and every write the dead incarnation still had
+  in flight is 403-fenced server-side.  The replacement does not fight
+  the survivor that absorbed its home slot — it stamps the Lease's
+  ``preferredHolder`` and the survivor hands the slot back on its next
+  renew (cmd/leader.py).
+- **SIGTERM escalation**: shutdown sends SIGTERM to every worker (each
+  worker's signal handler runs ShardedOperator.stop(), which RELEASES
+  its held leases so a rolling restart never waits out lease_duration),
+  then SIGKILLs whatever is still alive after the grace window.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tf_operator_tpu.cmd.options import ServerOptions, split_bind_address
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.utils import logging as ulog
+
+# a worker that survived this long before dying gets a clean slate on
+# its crash-loop backoff ladder; faster deaths double the delay
+MIN_HEALTHY_UPTIME = 5.0
+RESTART_BACKOFF_MAX = 30.0
+
+
+def build_worker_argv(
+    base_argv: List[str], index: int, log_tag: str = ""
+) -> List[str]:
+    """One worker's flag list: the supervisor's own argv minus the
+    `--shard-processes` recursion, worker listeners moved to ephemeral
+    ports (N workers cannot share the parent's advertised ports), a
+    per-worker trace-dump path when one was configured, and the slot
+    index stamped last (argparse last-wins keeps overrides simple)."""
+    argv: List[str] = []
+    skip = False
+    trace_dump = ""
+    for arg in base_argv:
+        if skip:
+            skip = False
+            trace_dump = arg
+            continue
+        if arg == "--shard-processes":
+            continue
+        if arg == "--leader-elect":
+            # leader election across the workers would elect ONE of them
+            # and idle the rest — the exact single-process collapse this
+            # mode exists to escape.  The per-slot Leases already are the
+            # election; the flag must not reach a worker.
+            continue
+        if arg == "--trace-dump":
+            skip = True  # re-appended per worker below
+            continue
+        if arg.startswith("--trace-dump="):
+            trace_dump = arg.split("=", 1)[1]
+            continue
+        argv.append(arg)
+    argv += [
+        "--metrics-bind-address", "127.0.0.1:0",
+        "--health-probe-bind-address", "127.0.0.1:0",
+    ]
+    if trace_dump:
+        argv += ["--trace-dump", f"{trace_dump}.shard{index}{log_tag}"]
+    argv += ["--shard-index", str(index)]
+    return argv
+
+
+class _Worker:
+    """One supervised shard process and its restart bookkeeping."""
+
+    def __init__(self, index: int, argv: List[str]) -> None:
+        self.index = index
+        self.argv = argv
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.consecutive_fast_deaths = 0
+        self.spawned_at = 0.0
+        self.respawn_at: Optional[float] = None  # backoff hold
+        self.log_file = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+
+class Supervisor:
+    """Spawns and supervises one worker process per shard slot.
+
+    `base_argv` is the operator's own CLI argv (the worker argvs are
+    derived from it); `log_dir` writes each worker's stdout/stderr to
+    `shard-<i>.log` there (appended across restarts) instead of
+    inheriting the parent's streams.  `restart` disables the respawn
+    loop entirely (tests that only want spawn + escalation)."""
+
+    def __init__(
+        self,
+        shard_count: int,
+        base_argv: List[str],
+        grace: float = 10.0,
+        restart_backoff: float = 1.0,
+        log_dir: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        restart: bool = True,
+        poll_interval: float = 0.2,
+    ) -> None:
+        if shard_count < 1:
+            raise ValueError("shard_count must be >= 1")
+        self.grace = grace
+        self.restart_backoff = restart_backoff
+        self.log_dir = log_dir
+        self.env = env
+        self.restart = restart
+        self.poll_interval = poll_interval
+        self.log = ulog.logger_with({"component": "shard-supervisor"})
+        self.workers = [
+            _Worker(i, build_worker_argv(base_argv, i))
+            for i in range(shard_count)
+        ]
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- spawning
+    def _spawn(self, worker: _Worker) -> None:
+        if self.log_dir is not None and worker.log_file is None:
+            worker.log_file = open(
+                os.path.join(self.log_dir, f"shard-{worker.index}.log"), "ab"
+            )
+        worker.proc = subprocess.Popen(
+            [sys.executable, "-m", "tf_operator_tpu.cmd.main", *worker.argv],
+            stdout=worker.log_file,
+            stderr=worker.log_file,
+            env=self.env,
+        )
+        worker.spawned_at = time.monotonic()
+        worker.respawn_at = None
+        self.log.info(
+            "shard %d worker spawned: pid=%d", worker.index, worker.proc.pid
+        )
+
+    def start(self) -> "Supervisor":
+        for worker in self.workers:
+            self._spawn(worker)
+        self._update_gauge()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+        return self
+
+    # ------------------------------------------------------------- liveness
+    def _monitor_loop(self) -> None:
+        while not self._stopping.wait(self.poll_interval):
+            for worker in self.workers:
+                if worker.alive:
+                    continue
+                if worker.respawn_at is None:
+                    # freshly observed death: book it and set the backoff
+                    rc = worker.proc.returncode if worker.proc else None
+                    uptime = time.monotonic() - worker.spawned_at
+                    if uptime < MIN_HEALTHY_UPTIME:
+                        worker.consecutive_fast_deaths += 1
+                    else:
+                        worker.consecutive_fast_deaths = 0
+                    delay = min(
+                        self.restart_backoff
+                        * (2 ** max(0, worker.consecutive_fast_deaths - 1)),
+                        RESTART_BACKOFF_MAX,
+                    )
+                    self.log.warning(
+                        "shard %d worker died (rc=%s uptime=%.1fs); "
+                        "restart in %.1fs with a new identity",
+                        worker.index, rc, uptime, delay,
+                    )
+                    metrics.SUPERVISOR_RESTARTS.inc(
+                        {"shard": f"shard-{worker.index}"}
+                    )
+                    worker.respawn_at = time.monotonic() + delay
+                    self._update_gauge()
+                elif self.restart and time.monotonic() >= worker.respawn_at:
+                    worker.restarts += 1
+                    self._spawn(worker)
+                    self._update_gauge()
+
+    def _update_gauge(self) -> None:
+        alive = sum(1 for w in self.workers if w.alive)
+        metrics.SUPERVISOR_CHILDREN.set(alive, {"state": "running"})
+        metrics.SUPERVISOR_CHILDREN.set(
+            len(self.workers) - alive, {"state": "down"}
+        )
+
+    @property
+    def healthy(self) -> bool:
+        # the supervisor's own job is the monitor loop; worker health is
+        # readiness, not liveness (a crash-looping worker must not get
+        # the PARENT killed by its liveness probe)
+        return self._monitor is None or self._monitor.is_alive()
+
+    @property
+    def ready(self) -> bool:
+        return all(w.alive for w in self.workers)
+
+    # ------------------------------------------------------------- shutdown
+    def stop(self) -> int:
+        """SIGTERM every worker, escalate to SIGKILL after the grace
+        window, reap everything.  Returns the worst worker exit code (0
+        when every worker shut down cleanly on SIGTERM)."""
+        self._stopping.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        for worker in self.workers:
+            if worker.alive:
+                worker.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + self.grace
+        worst = 0
+        for worker in self.workers:
+            if worker.proc is None:
+                continue
+            try:
+                worker.proc.wait(
+                    timeout=max(0.0, deadline - time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                self.log.error(
+                    "shard %d worker ignored SIGTERM for %.1fs; escalating "
+                    "to SIGKILL", worker.index, self.grace,
+                )
+                worker.proc.kill()
+                worker.proc.wait(timeout=5.0)
+            rc = worker.proc.returncode
+            if rc not in (0, None):
+                worst = worst or int(rc)
+            if worker.log_file is not None:
+                worker.log_file.close()
+                worker.log_file = None
+        self._update_gauge()
+        return worst
+
+
+def run_supervisor(
+    options: ServerOptions, argv: List[str], block: bool = True
+) -> int:
+    """The `--shard-processes` entrypoint (called from cmd/main.py):
+    spawn the workers, serve aggregate health/metrics on the parent's
+    advertised addresses, and supervise until SIGTERM/SIGINT."""
+    from tf_operator_tpu.cmd.health import HealthServer
+
+    ulog.configure(json_format=options.json_log_format)
+    log = ulog.logger_with({"component": "shard-supervisor"})
+    if not (
+        options.kubeconfig
+        or os.environ.get("KUBECONFIG")
+        or os.environ.get("KUBERNETES_SERVICE_HOST")
+    ):
+        raise SystemExit(
+            "--shard-processes requires --kubeconfig (or in-cluster "
+            "config): worker processes coordinate through a shared "
+            "apiserver and an in-memory store cannot span processes"
+        )
+    supervisor = Supervisor(
+        max(1, options.shards),
+        argv,
+        grace=options.shard_process_grace,
+        restart_backoff=options.shard_restart_backoff,
+    ).start()
+    log.info(
+        "supervising %d shard worker processes (grace=%.1fs)",
+        len(supervisor.workers), options.shard_process_grace,
+    )
+    health_host, health_port = split_bind_address(
+        options.health_probe_bind_address
+    )
+    probe = HealthServer(
+        host=health_host,
+        port=health_port,
+        healthz=lambda: supervisor.healthy,
+        readyz=lambda: supervisor.ready,
+    )
+    probe.start()
+    metrics_host, metrics_port = split_bind_address(
+        options.metrics_bind_address
+    )
+    metrics_srv = HealthServer(host=metrics_host, port=metrics_port)
+    metrics_srv.start()
+
+    stop_event = threading.Event()
+    if not block:
+        # embedded callers (tests) drive shutdown themselves
+        supervisor._probe = probe
+        supervisor._metrics_srv = metrics_srv
+        return supervisor
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop_event.set())
+    stop_event.wait()
+    rc = supervisor.stop()
+    probe.stop()
+    metrics_srv.stop()
+    return rc

@@ -17,13 +17,16 @@ relative to a real apiserver.
 """
 from __future__ import annotations
 
+import bisect
 import functools
+import json
 import re
 import threading
 import time
 import uuid
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from tf_operator_tpu.engine import metrics as _metrics
 from tf_operator_tpu.k8s import objects
 from tf_operator_tpu.k8s.client import KIND_REGISTRY
 from tf_operator_tpu.k8s.fake import ApiError, ConflictError, FakeCluster, NotFoundError
@@ -174,6 +177,82 @@ def _validate_crd_status(kind: str, status: Dict[str, Any]) -> None:
         )
 
 
+class _JournalEntry:
+    """One journaled watch event.  `line` — the wire encoding (one JSON
+    object, newline-terminated) — is built lazily on first need and then
+    shared by every socket watcher: with N worker processes watching the
+    same kind, the world is serialized once, not N times."""
+
+    __slots__ = ("seq", "etype", "obj", "line")
+
+    def __init__(self, seq: int, etype: str, obj: Dict[str, Any]) -> None:
+        self.seq = seq
+        self.etype = etype
+        self.obj = obj
+        self.line: Optional[bytes] = None
+
+
+class WatchJournal:
+    """Bounded write-ahead journal of one kind's watch events (ISSUE 11).
+
+    The journal is what lets each watcher — in particular each shard
+    worker PROCESS, every one with its own informer factory and its own
+    resourceVersion cursor — resume exactly where it left off instead of
+    re-listing (and re-serializing) the world whenever any stream blips.
+
+    Entries are seq-ordered; `since(cursor)` bisects to the suffix a
+    watcher at rv=cursor still needs.  Appends past `cap` prune from the
+    front and advance `horizon`, the last discarded seq: a cursor at or
+    below the horizon has provably lost events and gets 410 Gone (the
+    relist path), everyone above resumes from the journal.  The horizon
+    is PER KIND — before the journal, one chatty kind's pruning forced
+    every other kind's watchers to relist too.
+
+    Mutation happens under the owning transport's condition lock; the
+    lazy wire encoding deliberately does not (a duplicate encode under a
+    race is benign, a serialization stall under the store lock is not).
+    """
+
+    def __init__(self, kind: str, cap: int = 4096) -> None:
+        self.kind = kind
+        self.cap = cap
+        self.entries: List[_JournalEntry] = []
+        self._seqs: List[int] = []  # parallel, for bisect
+        self.horizon = 0
+
+    def append(self, seq: int, etype: str, obj: Dict[str, Any]) -> None:
+        self.entries.append(_JournalEntry(seq, etype, obj))
+        self._seqs.append(seq)
+        _metrics.WATCH_JOURNAL_EVENTS.inc({"kind": self.kind})
+        if len(self.entries) > self.cap:
+            drop = len(self.entries) - self.cap
+            self.horizon = max(self.horizon, self._seqs[drop - 1])
+            del self.entries[:drop]
+            del self._seqs[:drop]
+
+    def since(self, cursor: int) -> List[_JournalEntry]:
+        """Entries with seq strictly greater than `cursor` (the caller
+        has already checked the cursor against the horizon)."""
+        return self.entries[bisect.bisect_right(self._seqs, cursor):]
+
+    def encoded(self, entry: _JournalEntry) -> bytes:
+        line = entry.line
+        if line is None:
+            line = (
+                json.dumps({"type": entry.etype, "object": entry.obj}).encode()
+                + b"\n"
+            )
+            entry.line = line
+            _metrics.WATCH_JOURNAL_ENCODES.inc(
+                {"kind": self.kind, "source": "encode"}
+            )
+        else:
+            _metrics.WATCH_JOURNAL_ENCODES.inc(
+                {"kind": self.kind, "source": "cache"}
+            )
+        return line
+
+
 def _status_payload(code: int, message: str) -> Dict[str, Any]:
     reasons = {
         404: "NotFound",
@@ -205,8 +284,9 @@ class ApiServerTransport:
         # kubelet-style direct writers muddy the operator's tally)
         fake.count_api_requests = False
         self._lock = threading.Condition()
-        # per-kind ordered event logs: List[(seq, etype, obj)]
-        self._logs: Dict[str, List[Tuple[int, str, Dict[str, Any]]]] = {}
+        # per-kind write-ahead watch journals (bounded, seq-ordered,
+        # wire-encoding shared across watchers) — see WatchJournal
+        self._journals: Dict[str, WatchJournal] = {}
         self._seq = 0
         self._min_rv = 0  # watches below this rv get 410 Gone (expiry sim)
         self._closed = False
@@ -274,9 +354,10 @@ class ApiServerTransport:
                 max(total - accounted, 0.0) / total * 100, 1)
         return out
 
-    # keep at most this many events per kind; older entries are pruned and the
-    # 410 horizon advances so a slow watcher relists (the client's relist
-    # diffs against its delivered state, so pruning never loses updates)
+    # keep at most this many events per kind's journal; older entries are
+    # pruned and that KIND's 410 horizon advances so a slow watcher relists
+    # (the client's relist diffs against its delivered state, so pruning
+    # never loses updates)
     MAX_LOG = 4096
 
     def _make_recorder(self, kind: str):
@@ -312,12 +393,12 @@ class ApiServerTransport:
                 # pops the object carrying its last stored rv — restamp so
                 # watch replay ordering stays monotone
                 obj.setdefault("metadata", {})["resourceVersion"] = str(seq)
-            log = self._logs.setdefault(kind, [])
-            log.append((seq, etype, obj))
-            if len(log) > self.MAX_LOG:
-                drop = len(log) - self.MAX_LOG
-                self._min_rv = max(self._min_rv, log[drop - 1][0])
-                del log[:drop]
+            journal = self._journals.get(kind)
+            if journal is None:
+                journal = self._journals[kind] = WatchJournal(
+                    kind, cap=self.MAX_LOG
+                )
+            journal.append(seq, etype, obj)
             self._lock.notify_all()
 
     def close(self) -> None:
@@ -488,6 +569,28 @@ class ApiServerTransport:
         query: Optional[Dict[str, str]] = None,
         cancel: Optional[list] = None,
     ) -> Iterator[Dict[str, Any]]:
+        """Watch events as dicts — the in-process consumer protocol."""
+        return self._stream(path, query, cancel, encode=False)
+
+    def stream_lines(
+        self,
+        path: str,
+        query: Optional[Dict[str, str]] = None,
+        cancel: Optional[list] = None,
+    ) -> Iterator[bytes]:
+        """Watch events wire-framed (one newline-terminated JSON object
+        per event) — the HTTP server's path.  Encodings come from the
+        journal's shared write-ahead cache, so N worker processes
+        watching the same kind pay one serialization per event, not N."""
+        return self._stream(path, query, cancel, encode=True)
+
+    def _stream(
+        self,
+        path: str,
+        query: Optional[Dict[str, str]],
+        cancel: Optional[list],
+        encode: bool,
+    ):
         if (query or {}).get("watch") != "true":
             raise ApiError(400, "stream requires watch=true")
         kind, _ns, _name, _sub = _parse_path(path)
@@ -506,31 +609,58 @@ class ApiServerTransport:
 
             cancel.append(_cancel)
 
-        def _events() -> Iterator[Dict[str, Any]]:
+        def _events():
             cursor = start
+            # a watch opened WITH a cursor is a resume: whether the
+            # journal still covers it (hit) or it must relist (miss) is
+            # the journal hit ratio the bench rows record
+            resuming = start > 0
             while True:
                 with self._lock:
                     if self._closed or cancelled.is_set():
                         return
-                    if cursor < self._min_rv:
-                        yield {
+                    journal = self._journals.get(kind)
+                    horizon = max(
+                        self._min_rv,
+                        journal.horizon if journal is not None else 0,
+                    )
+                    if cursor < horizon:
+                        if resuming:
+                            _metrics.WATCH_JOURNAL_RESUMES.inc(
+                                {"kind": kind, "outcome": "miss"}
+                            )
+                        gone = {
                             "type": "ERROR",
                             "object": _status_payload(
                                 410, "too old resource version"
                             ),
                         }
+                        yield (
+                            json.dumps(gone).encode() + b"\n"
+                            if encode else gone
+                        )
                         return
-                    pending = [
-                        (seq, etype, obj)
-                        for seq, etype, obj in self._logs.get(kind, [])
-                        if seq > cursor
-                    ]
+                    if resuming:
+                        _metrics.WATCH_JOURNAL_RESUMES.inc(
+                            {"kind": kind, "outcome": "hit"}
+                        )
+                        resuming = False
+                    pending = (
+                        journal.since(cursor)
+                        if journal is not None else []
+                    )
                     if not pending:
                         self._lock.wait(timeout=0.5)
                         continue
-                for seq, etype, obj in pending:
-                    yield {"type": etype, "object": obj}
-                    cursor = max(cursor, seq)
+                for entry in pending:
+                    # encoding happens OUTSIDE the lock: first watcher to
+                    # need an entry builds the line, the rest reuse it
+                    yield (
+                        journal.encoded(entry)
+                        if encode
+                        else {"type": entry.etype, "object": entry.obj}
+                    )
+                    cursor = max(cursor, entry.seq)
 
         return _events()
 

@@ -72,11 +72,22 @@ class FairFlowController:
 
     `acquire(flow)` blocks until a seat is granted, raises
     :class:`RejectedError` when the flow's queue is full or the queue wait
-    exceeds `queue_timeout`.  `release()` frees the seat and dispatches
-    the next waiter fairly.  No-barging: while any flow has waiters, new
-    arrivals queue behind them even if a seat is momentarily free —
-    otherwise a hot flow's back-to-back arrivals would starve queued
-    flows forever.
+    exceeds `queue_timeout`.  `release(flow)` frees the seat and
+    dispatches the next waiter fairly.  No-barging: while any flow has
+    waiters, new arrivals queue behind them even if a seat is momentarily
+    free — otherwise a hot flow's back-to-back arrivals would starve
+    queued flows forever.
+
+    `seats_per_flow` (ISSUE 11) additionally caps how many of the
+    execution seats any ONE flow may occupy concurrently.  Queue-level
+    fairness alone cannot protect siblings from a crash-looping worker
+    process: its relist barrages arrive one at a time, sail through an
+    idle dispatcher, and can occupy every seat just as the other workers'
+    failover re-adopt storms land.  With a per-flow seat count, a flow at
+    its cap queues even while global seats are free, and the round-robin
+    dispatcher skips it until one of ITS seats frees — other flows keep
+    dispatching.  Callers that enable the cap must pass the flow back to
+    `release`.
     """
 
     def __init__(
@@ -85,13 +96,16 @@ class FairFlowController:
         queue_limit: int = 16,
         queue_timeout: float = 15.0,
         retry_after: float = 1.0,
+        seats_per_flow: Optional[int] = None,
     ) -> None:
         self.seats = seats
         self.queue_limit = queue_limit
         self.queue_timeout = queue_timeout
         self.retry_after = retry_after
+        self.seats_per_flow = seats_per_flow
         self._cond = threading.Condition()
         self._executing = 0
+        self._flow_seats: Dict[str, int] = {}  # flow -> seats occupied
         self._queues: "OrderedDict[str, deque]" = OrderedDict()
         self._rr: deque = deque()  # flows with waiters, round-robin order
 
@@ -99,12 +113,28 @@ class FairFlowController:
         q = self._queues.get(flow)
         return len(q) if q else 0
 
+    def _flow_free(self, flow: str) -> bool:
+        return (
+            self.seats_per_flow is None
+            or self._flow_seats.get(flow, 0) < self.seats_per_flow
+        )
+
+    def _grant_locked(self, flow: str) -> None:
+        self._executing += 1
+        n = self._flow_seats.get(flow, 0) + 1
+        self._flow_seats[flow] = n
+        metrics.APF_SEATS_IN_USE.set(n, {"flow": flow})
+        metrics.APF_DISPATCHED.inc({"flow": flow})
+
     def acquire(self, flow: str) -> None:
         t0 = time.monotonic()
         with self._cond:
-            if self._executing < self.seats and not self._rr:
-                self._executing += 1
-                metrics.APF_DISPATCHED.inc({"flow": flow})
+            if (
+                self._executing < self.seats
+                and not self._rr
+                and self._flow_free(flow)
+            ):
+                self._grant_locked(flow)
                 return
             if self._depth(flow) >= self.queue_limit:
                 metrics.APF_REJECTED.inc(
@@ -123,6 +153,11 @@ class FairFlowController:
                 self._rr.append(flow)
             q.append(ticket)
             metrics.APF_QUEUE_DEPTH.set(len(q), {"flow": flow})
+            # with a per-flow seat cap the ring can hold parked flows
+            # while global seats sit free, so an arrival that queued must
+            # run a dispatch pass itself — pre-cap, ring-non-empty
+            # implied every seat busy and only release() dispatched
+            self._dispatch_locked()
             deadline = t0 + self.queue_timeout
             while not ticket["ready"]:
                 remaining = deadline - time.monotonic()
@@ -157,22 +192,41 @@ class FairFlowController:
             time.monotonic() - t0, {"flow": flow}
         )
 
-    def release(self) -> None:
+    def release(self, flow: Optional[str] = None) -> None:
+        """Free a seat.  `flow` must name the flow the seat was acquired
+        for whenever a per-flow cap is configured (the per-flow count is
+        what the cap enforces); without a cap it may be omitted."""
         with self._cond:
             self._executing -= 1
+            if flow is not None:
+                n = max(0, self._flow_seats.get(flow, 1) - 1)
+                if n:
+                    self._flow_seats[flow] = n
+                else:
+                    self._flow_seats.pop(flow, None)
+                metrics.APF_SEATS_IN_USE.set(n, {"flow": flow})
             self._dispatch_locked()
 
     def _dispatch_locked(self) -> None:
-        while self._executing < self.seats and self._rr:
+        # rotation guard: flows parked at their seat cap are skipped (put
+        # back at the ring's tail) but must not spin the dispatcher —
+        # after one full lap of nothing dispatchable, stop until the next
+        # release frees a seat somewhere
+        skipped = 0
+        while self._executing < self.seats and self._rr and skipped < len(self._rr):
             flow = self._rr.popleft()
             q = self._queues.get(flow)
             if not q:
                 self._queues.pop(flow, None)
                 continue
+            if not self._flow_free(flow):
+                self._rr.append(flow)
+                skipped += 1
+                continue
+            skipped = 0
             ticket = q.popleft()
             ticket["ready"] = True
-            self._executing += 1
-            metrics.APF_DISPATCHED.inc({"flow": flow})
+            self._grant_locked(flow)
             metrics.APF_QUEUE_DEPTH.set(len(q), {"flow": flow})
             if q:
                 self._rr.append(flow)  # fair: go to the back of the ring
@@ -243,7 +297,7 @@ class HttpApiServer:
                             method, parsed.path, query or None, body
                         )
                     finally:
-                        flow_controller.release()
+                        flow_controller.release(flow)
                 else:
                     status, payload = transport.request(
                         method, parsed.path, query or None, body
@@ -274,8 +328,11 @@ class HttpApiServer:
                 try:
                     # routing/validation errors raise HERE (before the
                     # generator body runs) — they must become a real error
-                    # status, not a 200 with an empty stream
-                    events = transport.stream(path, query, cancel)
+                    # status, not a 200 with an empty stream.  Events
+                    # arrive pre-framed from the write-ahead journal, so
+                    # N process watchers share one serialization per
+                    # event instead of re-encoding it per socket.
+                    events = transport.stream_lines(path, query, cancel)
                 except ApiError as e:
                     return self._reply(e.code, _status_payload(e.code, str(e)))
                 self.send_response(200)
@@ -286,8 +343,8 @@ class HttpApiServer:
                 self.send_header("Connection", "close")
                 self.end_headers()
                 try:
-                    for event in events:
-                        self.wfile.write(json.dumps(event).encode() + b"\n")
+                    for line in events:
+                        self.wfile.write(line)
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass  # watcher went away (e.g. operator killed)

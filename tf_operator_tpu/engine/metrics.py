@@ -376,6 +376,51 @@ APF_QUEUE_WAIT = Histogram(
     "freed up, labeled by flow — the fairness SLO: a noisy tenant must "
     "not drag other flows' p99",
 )
+APF_SEATS_IN_USE = Gauge(
+    f"{PREFIX}_apf_seats_in_use",
+    "Execution seats each flow currently occupies, labeled by flow; with "
+    "a per-flow seat cap configured this saturating at the cap while "
+    "other flows keep dispatching is the isolation working — one "
+    "crash-looping client cannot occupy every seat",
+)
+
+# -------------------------------------------------- multi-process plane
+# The multi-process control plane (cmd/supervisor.py + the write-ahead
+# watch journal in e2e/apiserver.py): worker-process lifecycle and the
+# apiserver-side cost of serving N independent process watchers.
+SUPERVISOR_RESTARTS = Counter(
+    f"{PREFIX}_supervisor_restarts_total",
+    "Worker processes the shard supervisor observed dead and scheduled "
+    "for restart, labeled by shard; every restart is a NEW fencing "
+    "identity, so the dead incarnation's in-flight writes stay fenced",
+)
+SUPERVISOR_CHILDREN = Gauge(
+    f"{PREFIX}_supervisor_children",
+    "Shard worker processes by state (running | down); down > 0 for "
+    "longer than the restart backoff means a crash loop",
+)
+WATCH_JOURNAL_EVENTS = Counter(
+    f"{PREFIX}_watch_journal_events_total",
+    "Events appended to the apiserver's bounded write-ahead watch "
+    "journal, labeled by kind; the journal is what lets each watcher "
+    "process resume from its own resourceVersion cursor instead of "
+    "re-listing the world",
+)
+WATCH_JOURNAL_RESUMES = Counter(
+    f"{PREFIX}_watch_journal_resumes_total",
+    "Watch streams opened with a resourceVersion cursor, labeled by kind "
+    "and outcome: hit = the journal still covered the cursor and the "
+    "stream resumed from it; miss = the cursor had fallen behind the "
+    "journal's horizon and the watcher was sent 410 Gone to relist — "
+    "hit/(hit+miss) is the journal hit ratio the bench rows record",
+)
+WATCH_JOURNAL_ENCODES = Counter(
+    f"{PREFIX}_watch_journal_encodes_total",
+    "Watch events serialized for the wire, labeled by kind and source: "
+    "encode = JSON built for the first watcher to need the entry, cache "
+    "= a later watcher reused the journal's stored bytes; with N worker "
+    "processes watching, cache/(cache+encode) approaches (N-1)/N",
+)
 
 # ------------------------------------------------------------- warm pools
 # Warm-pool pod placement (engine/warmpool.py): pre-provisioned standby

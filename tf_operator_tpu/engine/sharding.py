@@ -37,6 +37,20 @@ def fence_token(namespace: str, name: str, generation: int) -> str:
     return f"{namespace}/{name}:{generation}"
 
 
+# Default prefix of the per-slot Lease names.  Exposed here (not in
+# cmd/manager.py) because it is CROSS-PROCESS shared state: every worker
+# process, the supervisor's liveness view, and the bench's failover probe
+# must derive the same Lease name for the same slot or they coordinate
+# about different objects.
+DEFAULT_LOCK_PREFIX = "tpu-operator-shard"
+
+
+def shard_lock_name(slot: int, prefix: str = DEFAULT_LOCK_PREFIX) -> str:
+    """Name of the Lease object guarding shard slot `slot` — the single
+    naming rule shared by owners, standbys, zombies, and probes."""
+    return f"{prefix}-{slot}"
+
+
 def parse_fence_token(token: str) -> Optional[tuple]:
     """(namespace, name, generation) or None for an unparsable token."""
     ref, sep, gen = token.rpartition(":")
