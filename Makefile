@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-timeline native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-timeline bench-elastic native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -108,6 +108,15 @@ bench-sched:
 bench-timeline:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_timeline; \
 	print(json.dumps(bench_timeline(), indent=1))"
+
+# Elastic resize vs whole-gang eviction under capacity pressure: one
+# deterministic SimClock trace (low-priority elastic gang squeezed by a
+# high-priority arrival), scored on victim goodput fraction, wasted
+# replica-seconds, restarts, and time-to-recover (ISSUE 12 evidence, no
+# TPU required).  Rows land in BENCH_r11.json.
+bench-elastic:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_elastic; \
+	print(json.dumps(bench_elastic(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

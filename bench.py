@@ -1831,6 +1831,11 @@ def bench_operator_multiproc(n_jobs: int = 200, shards: int = 4,
         "KUBECONFIG": "",
         "KUBERNETES_SERVICE_HOST": "",
     }
+    # pinned per-worker metrics ports (--shard-metrics-port-base): the
+    # supervisor's historical ephemeral binds made multiproc rows blind
+    # to reconcile percentiles — nothing could find a worker's /metrics
+    # after the fact (ROADMAP open item 1)
+    metrics_base = _free_port_block(shards)
     supervisor = Supervisor(
         shards,
         [
@@ -1844,6 +1849,7 @@ def bench_operator_multiproc(n_jobs: int = 200, shards: int = 4,
         restart_backoff=0.5,
         log_dir=tmp,
         env=env,
+        metrics_port_base=metrics_base,
     ).start()
 
     def _holder(slot):
@@ -1901,6 +1907,11 @@ def bench_operator_multiproc(n_jobs: int = 200, shards: int = 4,
         out["all_running"] = converged
         out["create_to_all_running_s"] = round(dt, 3)
         out["jobs_per_sec"] = round(n_jobs / dt, 1) if dt > 0 else None
+        # per-worker reconcile percentiles, merged across the fleet —
+        # scraped BEFORE the kill probe while every worker is alive
+        ports = {i: metrics_base + i for i in range(shards)}
+        out["shard_metrics_ports"] = ports
+        out.update(_scrape_reconcile_percentiles(ports.values()))
 
         if kill_probe and converged and shards >= 1:
             victim = supervisor.workers[0]
@@ -2020,6 +2031,268 @@ def bench_multiproc_sweep(n_jobs: int = 200, shard_counts=(1, 4),
             "multiproc_at_least_inproc": (
                 bool(multi and inproc and multi >= inproc)
             ),
+        },
+    }
+
+
+def _scrape_reconcile_percentiles(ports, qs=(0.5, 0.9, 0.99)):
+    """Merge tpu_operator_reconcile_duration_seconds bucket counts from
+    each worker's /metrics exposition and read percentiles off the
+    merged cumulative histogram (ceil-rank over bucket upper bounds,
+    the same read engine/metrics.Histogram.percentiles does) — the
+    multi-process counterpart of _reconcile_percentiles(), which only
+    sees THIS process's registry."""
+    import re
+    import urllib.request
+
+    buckets: dict = {}
+    for port in ports:
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+        except OSError:
+            continue
+        for line in text.splitlines():
+            if not line.startswith(
+                "tpu_operator_reconcile_duration_seconds_bucket"
+            ):
+                continue
+            m = re.search(r'le="([^"]+)"[^}]*\}\s+(\S+)', line)
+            if m is None:
+                continue
+            buckets[m.group(1)] = buckets.get(m.group(1), 0.0) + float(
+                m.group(2)
+            )
+    return merge_bucket_percentiles(buckets, qs)
+
+
+def merge_bucket_percentiles(buckets, qs=(0.5, 0.9, 0.99)):
+    """{le-string: merged cumulative count} -> reconcile_pXX_ms dict."""
+    import math
+
+    def le_val(le):
+        return math.inf if le in ("+Inf", "inf") else float(le)
+
+    items = sorted(buckets.items(), key=lambda kv: le_val(kv[0]))
+    total = items[-1][1] if items else 0.0
+    out = {"reconcile_samples": int(total)}
+    for q in qs:
+        rank = q * total
+        val = None
+        for le, cum in items:
+            if total > 0 and cum >= rank:
+                val = le_val(le)
+                break
+        out[f"reconcile_p{int(q * 100)}_ms"] = (
+            round(val * 1000.0, 3)
+            if val is not None and val != math.inf else None
+        )
+    return out
+
+
+def _free_port_block(n, start=19400, stop=19900):
+    """A base port such that base..base+n-1 all bind on loopback right
+    now (the supervisor's workers claim them moments later)."""
+    import socket
+
+    for base in range(start, stop, max(1, n)):
+        ok = True
+        for p in range(base, base + n):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", p))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port block for worker metrics")
+
+
+def bench_elastic(
+    seed: int = 1337,
+    horizon_s: float = 420.0,
+    dt: float = 5.0,
+    hi_arrival_s: float = 60.0,
+):
+    """`make bench-elastic` — resize-vs-evict under capacity pressure
+    (ISSUE 12 evidence).  One scenario, two modes, fully deterministic
+    per seed on the SimClock:
+
+      a 2-slice cluster is filled by a low-priority 2-worker gang
+      (whole-slice workers, kubeflow.org/min-replicas=1); at t=60 a
+      high-priority 1-slice gang arrives.
+
+      evict  — elastic resize OFF: the planner's only move is whole-gang
+               eviction; the victim restarts from scratch and then PARKS
+               (2 slices wanted, 1 free) for the rest of the horizon.
+      shrink — elastic resize ON: the victim is resized to its floor
+               through drain -> checkpoint -> resume and keeps training
+               at 1 worker.
+
+    Scored per mode: the victim's goodput fraction (integral of running
+    replicas / the no-pressure ideal), wasted replica-seconds, eviction-
+    booked restarts, time-to-recover (hi arrival -> victim Running
+    again), and the preemptor's time-to-running.  Rows land in
+    BENCH_r11.json; tests/test_bench_infra.py asserts the shrink-beats-
+    evict regression bounds."""
+    from tf_operator_tpu.api import common as japi_common
+    from tf_operator_tpu.cmd.manager import OperatorManager
+    from tf_operator_tpu.cmd.options import ServerOptions
+    from tf_operator_tpu.controllers.registry import EnabledSchemes
+    from tf_operator_tpu.k8s import objects as kobjects
+    from tf_operator_tpu.k8s.chaos import (
+        DeterministicQueue,
+        FaultInjector,
+        SimClock,
+    )
+    from tf_operator_tpu.k8s.fake import FakeCluster
+    from tf_operator_tpu.sdk.watch import job_state
+
+    def job_doc(name, workers, priority=None, min_replicas=None, uid=None):
+        ann = {}
+        if priority is not None:
+            ann["kubeflow.org/priority"] = str(priority)
+        if min_replicas is not None:
+            ann["kubeflow.org/min-replicas"] = str(min_replicas)
+        return {
+            "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+            "metadata": {"name": name, "namespace": "default",
+                         "uid": uid or f"uid-{name}",
+                         "annotations": ann},
+            "spec": {"tfReplicaSpecs": {"Worker": {
+                "replicas": workers,
+                "restartPolicy": "ExitCode",
+                "template": {
+                    "metadata": {"annotations": {
+                        "kubeflow.org/slice-shape": "v5e-8"}},
+                    "spec": {"containers": [
+                        {"name": "tensorflow", "image": "bench"}]},
+                },
+            }}},
+        }
+
+    def run_mode(mode):
+        inner = FakeCluster()
+        clock = SimClock()
+        inj = FaultInjector(inner, seed=seed, clock=clock)
+        opts = ServerOptions(
+            enabled_schemes=EnabledSchemes(["TFJob"]),
+            scheduler_enabled=True,
+            scheduler_nodes=["el-0=v5e-8", "el-1=v5e-8"],
+            elastic_resize=(mode == "shrink"),
+            timeline_events_per_job=0,
+        )
+        mgr = OperatorManager(inj, opts, engine_kwargs={"clock": clock})
+        for ctl in mgr.controllers.values():
+            ctl.queue = DeterministicQueue()
+        mgr.factory.start_all()
+        inj.scheduler = mgr.scheduler
+        mgr.scheduler.note = inj.note
+        inj.at(
+            hi_arrival_s,
+            lambda: inner.create("TFJob", job_doc("hi", 1, priority=100)),
+            "submit hi priority=100",
+        )
+        inj.create("TFJob", job_doc("lo", 2, min_replicas=1))
+
+        def lo_running_pods():
+            return sum(
+                1 for p in inner.list_pods()
+                if kobjects.labels_of(p).get(
+                    kobjects.LABEL_JOB_NAME) == "lo"
+                and kobjects.pod_phase(p) == kobjects.POD_RUNNING
+            )
+
+        goodput_s = 0.0
+        wasted_s = 0.0
+        recover_at = None
+        hi_running_at = None
+        steps = int(horizon_s / dt)
+        for i in range(steps):
+            inj.step(dt)
+            for inf in mgr.factory._informers.values():
+                inf.resync_once()
+            for _ in range(80):
+                busy = False
+                for ctl in mgr.controllers.values():
+                    key = ctl.queue.get(timeout=0)
+                    if key is None:
+                        continue
+                    busy = True
+                    try:
+                        ctl._sync_guarded(key)
+                    finally:
+                        ctl.queue.done(key)
+                if not busy:
+                    break
+            t = (i + 1) * dt
+            active = lo_running_pods()
+            goodput_s += active * dt
+            if t > hi_arrival_s:
+                wasted_s += max(0, 2 - active) * dt
+                if hi_running_at is None:
+                    hi = inner.get("TFJob", "default", "hi")
+                    if job_state(hi) == "Running":
+                        hi_running_at = t
+                if recover_at is None and active > 0 and job_state(
+                    inner.get("TFJob", "default", "lo")
+                ) == "Running" and not japi_common.is_resizing(
+                    japi_common.JobStatus.from_dict(
+                        inner.get("TFJob", "default", "lo")["status"]
+                    )
+                ):
+                    # first post-pressure instant the victim is running
+                    # again with its transition settled
+                    if t > hi_arrival_s + dt:
+                        recover_at = t
+        mgr.factory.stop_all()
+        lo = inner.get("TFJob", "default", "lo")
+        rs = (lo["status"].get("replicaStatuses") or {}).get("Worker", {})
+        return {
+            "mode": mode,
+            "seed": seed,
+            "horizon_s": horizon_s,
+            "victim_goodput_fraction": round(
+                goodput_s / (2.0 * horizon_s), 4
+            ),
+            "victim_wasted_replica_seconds": round(wasted_s, 1),
+            "victim_final_replicas": lo["spec"]["tfReplicaSpecs"][
+                "Worker"]["replicas"],
+            "victim_running_pods_final": lo_running_pods(),
+            "victim_restarts": int(rs.get("restarts", 0) or 0),
+            "victim_evicted_members": int(
+                mgr.scheduler.evictions.get("default/lo", 0)
+            ),
+            "victim_time_to_recover_s": (
+                round(recover_at - hi_arrival_s, 1)
+                if recover_at is not None else None
+            ),
+            "preemptor_time_to_running_s": (
+                round(hi_running_at - hi_arrival_s, 1)
+                if hi_running_at is not None else None
+            ),
+        }
+
+    rows = [run_mode("evict"), run_mode("shrink")]
+    by = {r["mode"]: r for r in rows}
+    return {
+        "rows": rows,
+        "comparison": {
+            "goodput_ratio_shrink_over_evict": (
+                round(
+                    by["shrink"]["victim_goodput_fraction"]
+                    / by["evict"]["victim_goodput_fraction"], 2
+                )
+                if by["evict"]["victim_goodput_fraction"] else None
+            ),
+            "shrink_recovers": by["shrink"]["victim_time_to_recover_s"]
+            is not None,
+            "evict_recovers": by["evict"]["victim_time_to_recover_s"]
+            is not None,
         },
     }
 

@@ -46,6 +46,9 @@ _REQUIRED_FAMILIES = {
     "tpu_operator_job_restart_mttr_seconds": "Histogram",
     "tpu_operator_job_timeline_events_total": "Counter",
     "tpu_operator_job_timeline_evictions_total": "Counter",
+    # elastic resize (ISSUE 12): resize_requested -> resumed per
+    # transition, derived by the flight recorder like the families above
+    "tpu_operator_job_resize_duration_seconds": "Histogram",
 }
 
 

@@ -420,3 +420,65 @@ def test_bench_llama_decode_batch_sweep_tiny():
     for v in sweep.values():
         assert v["mode"] in ("whole_run", "decode_only")
         assert 0 < v["tokens_per_sec"] < 1e6
+
+
+def test_bench_elastic_shrink_beats_evict_deterministically():
+    """BENCH_r11's regression bounds (ISSUE 12), pinned so the artifact
+    can't silently rot.  The trace is SimClock-driven and seeded, so
+    every number is deterministic arithmetic, not timing: shrink mode
+    must keep the elastic victim alive at its floor (zero evictions,
+    zero restart-counter drift, >= its floor's share of goodput) while
+    evict mode kills the whole gang and parks it for the horizon."""
+    import logging
+
+    logging.disable(logging.CRITICAL)
+    try:
+        r = bench.bench_elastic(horizon_s=240.0)
+    finally:
+        logging.disable(logging.NOTSET)
+    by = {row["mode"]: row for row in r["rows"]}
+    ev, sh = by["evict"], by["shrink"]
+    # shrink degrades instead of dying: floor reached, nobody killed
+    assert sh["victim_final_replicas"] == 1
+    assert sh["victim_running_pods_final"] == 1
+    assert sh["victim_restarts"] == 0
+    assert sh["victim_evicted_members"] == 0
+    assert sh["victim_time_to_recover_s"] is not None
+    # evict kills the gang and the victim never fits again
+    assert ev["victim_evicted_members"] == 2
+    assert ev["victim_restarts"] >= 2
+    assert ev["victim_running_pods_final"] == 0
+    assert ev["victim_time_to_recover_s"] is None
+    # the headline: goodput under pressure strictly favors shrink
+    assert (sh["victim_goodput_fraction"]
+            > 1.5 * ev["victim_goodput_fraction"])
+    assert (sh["victim_wasted_replica_seconds"]
+            < ev["victim_wasted_replica_seconds"])
+    # both modes admit the preemptor promptly
+    assert ev["preemptor_time_to_running_s"] is not None
+    assert sh["preemptor_time_to_running_s"] is not None
+
+
+def test_merge_bucket_percentiles_reads_merged_histograms():
+    """The multiproc /metrics scrape math: per-worker cumulative bucket
+    counts merge by le and percentiles read off the merged histogram
+    (ceil-rank, bucket upper bound)."""
+    from bench import merge_bucket_percentiles
+
+    # two workers' cumulative buckets for the same family
+    merged = {}
+    for worker in (
+        {"0.005": 10, "0.05": 90, "0.5": 100, "+Inf": 100},
+        {"0.005": 0, "0.05": 20, "0.5": 100, "+Inf": 100},
+    ):
+        for le, v in worker.items():
+            merged[le] = merged.get(le, 0) + v
+    out = merge_bucket_percentiles(merged, qs=(0.5, 0.99))
+    assert out["reconcile_samples"] == 200
+    assert out["reconcile_p50_ms"] == 50.0   # rank 100 <= cum 110 @ 0.05
+    assert out["reconcile_p99_ms"] == 500.0  # rank 198 -> 0.5 bucket
+    # a sample set that never leaves +Inf reports None, not inf
+    assert merge_bucket_percentiles({"+Inf": 5}, qs=(0.5,))[
+        "reconcile_p50_ms"] is None
+    assert merge_bucket_percentiles({}, qs=(0.5,)) == {
+        "reconcile_samples": 0, "reconcile_p50_ms": None}

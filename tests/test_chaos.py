@@ -125,7 +125,7 @@ def _controllers(mgr):
 def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
                  shards=None, lease_duration=24.0, warm_pool=0,
                  latency=None, scheduler_nodes=None,
-                 scheduler_policy="packed", timeline=None):
+                 scheduler_policy="packed", timeline=None, elastic=False):
     """`shards=None` is the historical single OperatorManager; an int
     builds the ShardedOperator over the same injector (shards=1 disables
     leases — single-owner mode must stay byte-identical to the pre-shard
@@ -155,6 +155,7 @@ def make_harness(seed, backoff_base=20.0, classify=True, fanout=1,
         scheduler_enabled=scheduler_nodes is not None,
         scheduler_policy=scheduler_policy,
         scheduler_nodes=list(scheduler_nodes or []),
+        elastic_resize=elastic,
     )
     if timeline is not None:
         opts.timeline_events_per_job = timeline

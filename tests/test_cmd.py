@@ -305,21 +305,33 @@ def test_reconcile_duration_histogram_observed_and_exposed():
 
 
 def test_histogram_percentiles():
+    from tf_operator_tpu.engine import metrics as em
     from tf_operator_tpu.engine.metrics import Histogram
 
-    h = Histogram("test_pctl_seconds", "t", buckets=(0.01, 0.1, 1.0))
-    labels = {"kind": "TFJob"}
-    assert h.percentiles([0.5], labels) == {0.5: None}  # empty
-    for _ in range(90):
-        h.observe(0.005, labels)   # -> 0.01 bucket
-    for _ in range(9):
-        h.observe(0.05, labels)    # -> 0.1 bucket
-    h.observe(5.0, labels)         # beyond last finite bucket
-    ps = h.percentiles([0.5, 0.9, 0.99, 1.0], labels)
-    assert ps[0.5] == 0.01
-    assert ps[0.9] == 0.01
-    assert ps[0.99] == 0.1
-    assert ps[1.0] is None  # falls in +Inf: no finite upper bound
+    # prefixed, and deregistered on exit: every Histogram self-registers
+    # into the process-global registry, and a leaked unprefixed family
+    # fails hack/check_metric_names.py for any later test in the same
+    # process (the lint pin in test_timeline.py)
+    h = Histogram("tpu_operator_test_pctl_seconds", "test scaffolding",
+                  buckets=(0.01, 0.1, 1.0))
+    try:
+        labels = {"kind": "TFJob"}
+        assert h.percentiles([0.5], labels) == {0.5: None}  # empty
+        for _ in range(90):
+            h.observe(0.005, labels)   # -> 0.01 bucket
+        for _ in range(9):
+            h.observe(0.05, labels)    # -> 0.1 bucket
+        h.observe(5.0, labels)         # beyond last finite bucket
+        ps = h.percentiles([0.5, 0.9, 0.99, 1.0], labels)
+        assert ps[0.5] == 0.01
+        assert ps[0.9] == 0.01
+        assert ps[0.99] == 0.1
+        assert ps[1.0] is None  # falls in +Inf: no finite upper bound
+    finally:
+        # even a failing assertion must not leak the family into the
+        # process registry (it would cascade into the lint-count test)
+        with em._LOCK:
+            em._REGISTRY.remove(h)
 
 
 def test_exhausted_retries_hold_at_max_backoff_not_forgotten():

@@ -241,6 +241,17 @@ def test_supervisor_worker_argv_derivation():
     assert "--health-probe-bind-address" in argv
     assert argv[argv.index("--kubeconfig") + 1] == "/tmp/kc.yaml"
 
+    # --shard-metrics-port-base pins each worker's /metrics at
+    # base + index (ROADMAP open item 1: ephemeral binds left multiproc
+    # bench rows without reconcile percentiles); 0 keeps ephemeral
+    pinned = build_worker_argv(base, 2, metrics_port_base=19400)
+    pinned_vals = [
+        pinned[i + 1] for i, a in enumerate(pinned)
+        if a == "--metrics-bind-address"
+    ]
+    assert pinned_vals[-1] == "127.0.0.1:19402"
+    assert pinned[-2:] == ["--shard-index", "2"]
+
 
 def test_clean_stop_hands_slot_over_in_real_time_not_lease_duration():
     """Satellite (ISSUE 11): a worker's graceful shutdown releases its

@@ -1,0 +1,245 @@
+"""Checkpoint resharding across mesh shapes (models/reshard.py) and the
+elastic-resize loss-trajectory contract: train -> drain (final SIGTERM
+checkpoint) -> reshard to a different mesh -> resume must match a
+fixed-size golden run step for step — exact step count, loss within
+float-reassociation tolerance.
+
+Named late in the alphabet on purpose: jax compilation makes this file
+heavy relative to the tier-1 870s cap; it runs in full suites.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tf_operator_tpu.models.reshard import (
+    host_gather,
+    place_state,
+    reshard_checkpoint,
+    reshard_shapes,
+    state_shardings,
+)
+from tf_operator_tpu.parallel.mesh import make_mesh
+from tf_operator_tpu.runtime.loop import PreemptionGuard, run_training
+from tf_operator_tpu.runtime.train import (
+    Checkpointer,
+    create_train_state,
+    make_train_step,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >= 4 (forced-host) devices"
+)
+
+D_IN, D_HID, D_OUT = 256, 128, 8  # w1 is 256x128 = 32768 > min_size
+
+
+class _Mlp:
+    """Two-layer MLP big enough that w1/w2 cross the fsdp min_size."""
+
+    def init(self, rng, x, train=False):
+        k1, k2 = jax.random.split(rng)
+        scale = 0.05
+        return {"params": {
+            "w1": scale * jax.random.normal(k1, (D_IN, D_HID)),
+            "b1": jnp.zeros(D_HID),
+            "w2": scale * jax.random.normal(k2, (D_HID, D_OUT * 32)),
+            "b2": jnp.zeros(D_OUT * 32),
+        }}
+
+    def apply(self, variables, x, train=False):
+        p = variables["params"]
+        h = jax.nn.relu(x @ p["w1"] + p["b1"])
+        return (h @ p["w2"] + p["b2"])[:, :D_OUT]
+
+
+def _mesh(fsdp):
+    return make_mesh({"fsdp": fsdp}, jax.devices()[:fsdp])
+
+
+def _fresh_state(mesh):
+    state = create_train_state(
+        jax.random.PRNGKey(0), _Mlp(), jnp.ones((8, D_IN)),
+        optax.adam(1e-2),
+    )
+    return place_state(state, mesh)
+
+
+def _setup(mesh):
+    """(state, recording step fn, losses) with the pjit contract wired:
+    out_shardings come from state_shardings of the EXACT state instance
+    being trained (TrainState's tx rides the pytree aux, so shardings
+    built from a different instance would not match the traced tree)."""
+    state = _fresh_state(mesh)
+    losses = []
+    inner = make_train_step(
+        _Mlp(), has_batch_stats=False, mesh=mesh,
+        state_shardings=state_shardings(state, mesh),
+    )
+
+    def step(s, x, y):
+        s, m = inner(s, x, y)
+        losses.append(float(m["loss"]))
+        return s, m
+
+    return state, step, losses
+
+
+def _batches(start=0, n=64):
+    """Deterministic per-step batches so two runs (resized or not) feed
+    identical data at identical step numbers."""
+    for i in range(start, start + n):
+        k = jax.random.PRNGKey(1000 + i)
+        kx, ky = jax.random.split(k)
+        yield (
+            jax.random.normal(kx, (8, D_IN)),
+            jax.random.randint(ky, (8,), 0, D_OUT),
+        )
+
+
+# ----------------------------------------------------------- placement
+def test_state_shardings_shards_large_leaves_and_replicates_small():
+    mesh = _mesh(4)
+    state = _fresh_state(mesh)
+    sh = state_shardings(state, mesh)
+    w1 = sh.params["w1"].spec
+    assert "fsdp" in tuple(w1), w1          # large: sharded
+    assert tuple(sh.params["b1"].spec) in ((), (None,)), (
+        sh.params["b1"].spec)               # small: replicated
+    # adam moments shaped like w1 shard exactly like w1 — the optimizer
+    # state rides the same single placement rule
+    mu_w1 = jax.tree.leaves(
+        jax.tree.map(lambda s: s, sh.opt_state),
+    )
+    assert any("fsdp" in tuple(getattr(s, "spec", ())) for s in mu_w1)
+
+
+def test_reshard_checkpoint_grow_shrink_and_crash_rerun(tmp_path):
+    mesh2, mesh4 = _mesh(2), _mesh(4)
+    state = _fresh_state(mesh2)
+    state = state.replace(step=jnp.asarray(9, jnp.int32))
+    ck = Checkpointer(str(tmp_path / "src"))
+    ck.save(9, state)
+
+    dst = str(tmp_path / "dst")
+    step = reshard_checkpoint(str(tmp_path / "src"), dst, mesh4)
+    assert step == 9
+    # crash-rerun idempotency: the destination is scratch until the
+    # phase machine advances — a second run overwrites, same result
+    assert reshard_checkpoint(str(tmp_path / "src"), dst, mesh4) == 9
+
+    template = place_state(_fresh_state(mesh4), mesh4)
+    restored = Checkpointer(dst).restore(template)
+    assert int(restored.step) == 9
+    for k in ("w1", "b1", "w2", "b2"):
+        np.testing.assert_array_equal(
+            np.asarray(restored.params[k]), np.asarray(state.params[k])
+        )
+    # and back down: 4 -> 2 (the shrink-before-evict direction)
+    dst2 = str(tmp_path / "dst2")
+    assert reshard_checkpoint(dst, dst2, mesh2) == 9
+    back = Checkpointer(dst2).restore(place_state(_fresh_state(mesh2), mesh2))
+    np.testing.assert_array_equal(
+        np.asarray(back.params["w1"]), np.asarray(state.params["w1"])
+    )
+
+
+def test_reshard_refuses_in_place_destination(tmp_path):
+    with pytest.raises(ValueError, match="distinct"):
+        reshard_checkpoint(str(tmp_path), str(tmp_path), _mesh(2))
+
+
+def test_reshard_without_checkpoint_raises(tmp_path):
+    os.makedirs(tmp_path / "empty", exist_ok=True)
+    with pytest.raises(ValueError, match="no checkpoint"):
+        reshard_checkpoint(
+            str(tmp_path / "empty"), str(tmp_path / "out"), _mesh(2)
+        )
+
+
+def test_host_gather_materializes_numpy():
+    mesh = _mesh(2)
+    state = _fresh_state(mesh)
+    host = host_gather({"params": state.params})
+    assert all(
+        isinstance(x, np.ndarray) for x in jax.tree.leaves(host)
+    )
+
+
+def test_reshard_shapes_summary():
+    s = reshard_shapes({"Worker": 4}, {"Worker": 2})
+    assert s["direction"] == "shrink"
+    assert s["types"]["Worker"] == [4, 2]
+    assert reshard_shapes({"Worker": 2}, {"Worker": 4})["direction"] == "grow"
+
+
+# ------------------------------------------------- drain step exactness
+def test_drain_saves_the_exact_inflight_step(tmp_path):
+    """SIGTERM mid-run: the final checkpoint holds exactly the step the
+    loop reached — the resharded resume loses at most the in-flight
+    step, never a save interval (LoopResult.last_saved_step contract)."""
+    mesh = _mesh(2)
+    state, step, losses = _setup(mesh)
+    guard = PreemptionGuard(install=False)
+
+    def batches():
+        for i, b in enumerate(_batches()):
+            if i == 7:
+                guard.trigger()  # SIGTERM lands between steps 7 and 8
+            yield b
+
+    ck = Checkpointer(str(tmp_path / "ck"))
+    res = run_training(
+        state, step, batches(),
+        num_steps=50, checkpointer=ck, save_interval_steps=100,
+        guard=guard,
+    )
+    assert res.preempted
+    assert res.steps_run == 8
+    assert res.last_saved_step == 8
+    assert ck.latest_step() == 8
+
+
+# ------------------------------------------------------ loss trajectory
+def test_loss_trajectory_resize_matches_fixed_size_golden(tmp_path):
+    """train 6 steps @ fsdp=2 -> drain -> reshard -> resume @ fsdp=4 for
+    6 more; the resumed trajectory must match a never-resized fsdp=4 run
+    fed identical batches — same steps, same losses (float tolerance).
+    in/out axis_resources ride state_shardings on BOTH sides of the
+    boundary, so no hidden cross-boundary resharding can skew step one
+    after the resume (the SNIPPETS.md pjit contract)."""
+    mesh_small, mesh_big = _mesh(2), _mesh(4)
+
+    g_state, g_step, golden = _setup(mesh_big)
+    run_training(g_state, g_step, _batches(start=0), num_steps=12)
+    assert len(golden) == 12
+
+    # elastic leg 1: the old shape, drained at step 6 with a final save
+    s_state, s_step, leg1 = _setup(mesh_small)
+    src = str(tmp_path / "src")
+    res1 = run_training(
+        s_state, s_step, _batches(start=0), num_steps=6,
+        checkpointer=Checkpointer(src), save_interval_steps=3,
+    )
+    assert res1.last_saved_step == 6
+    np.testing.assert_allclose(leg1, golden[:6], rtol=2e-4, atol=1e-5)
+
+    # reshard: old sharding -> host gather -> new mesh's shardings
+    dst = str(tmp_path / "dst")
+    assert reshard_checkpoint(src, dst, mesh_big) == 6
+
+    # elastic leg 2: resume on the NEW mesh from the resharded step
+    r_state, r_step, leg2 = _setup(mesh_big)
+    res2 = run_training(
+        r_state, r_step, _batches(start=6), num_steps=12,
+        checkpointer=Checkpointer(dst), save_interval_steps=100,
+    )
+    assert res2.resumed_from == 6          # exact step count preserved
+    assert int(res2.state.step) == 12
+    assert len(leg2) == 6
+    # re-warmup: the resumed run re-traces/compiles, but numerically it
+    # must track the fixed-size golden from its very first step
+    np.testing.assert_allclose(leg2, golden[6:], rtol=2e-4, atol=1e-5)

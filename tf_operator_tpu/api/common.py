@@ -49,6 +49,14 @@ JOB_SUSPENDED = "Suspended"
 # a job is Pending instead of a blank state (no reference counterpart;
 # the reference delegates this visibility to volcano's PodGroup status)
 JOB_SCHEDULING = "Scheduling"
+# an elastic resize (replica-count delta) is in flight: the controller's
+# drain → reshard → resume transition (engine/controller.py).  The
+# condition's reason names the current phase (ResizeStarted /
+# ResizeAdmitted / ResizeReverted / ResizeCompleted once demoted), and
+# deliberately does NOT exclude Running: the gang keeps running at the
+# old shape until the drain actually begins, and a half-truthful
+# "not Running" would hide that from `tpu-jobs describe`.
+JOB_RESIZING = "Resizing"
 
 
 def is_retryable_exit_code(exit_code: int) -> bool:
@@ -317,6 +325,10 @@ def is_suspended(status: JobStatus) -> bool:
     return has_condition(status, JOB_SUSPENDED)
 
 
+def is_resizing(status: JobStatus) -> bool:
+    return has_condition(status, JOB_RESIZING)
+
+
 def demote_condition(
     status: JobStatus,
     cond_type: str,
@@ -354,7 +366,7 @@ def update_job_conditions(
     # is a Failed job, not both) — first terminal wins.
     if is_finished(status):
         if cond_type in (JOB_RUNNING, JOB_RESTARTING, JOB_SUSPENDED,
-                         JOB_SCHEDULING):
+                         JOB_SCHEDULING, JOB_RESIZING):
             return
         if cond_type == JOB_SUCCEEDED and is_failed(status):
             return
@@ -401,8 +413,12 @@ def update_job_conditions(
         _demote(JOB_RUNNING)
         _demote(JOB_RESTARTING)
         _demote(JOB_SCHEDULING)
+        # a suspended job holds no pods: whatever resize was in flight is
+        # moot — resume re-detects any spec delta from durable state
+        _demote(JOB_RESIZING)
     elif cond_type in (JOB_SUCCEEDED, JOB_FAILED):
         _demote(JOB_RUNNING)
         _demote(JOB_RESTARTING)
         _demote(JOB_SUSPENDED)
         _demote(JOB_SCHEDULING)
+        _demote(JOB_RESIZING)

@@ -216,7 +216,7 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         sched = getattr(manager, "scheduler", None)
         log.info(
             "manager started: kinds=%s shards=%d warm_pool=%s scheduler=%s "
-            "timeline=%s",
+            "timeline=%s elastic_resize=%s",
             options.all_kinds,
             getattr(manager, "shard_count", 1),
             dict(pool.config.sizes) if pool is not None else "off",
@@ -229,6 +229,7 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
                 f"{recorder.max_jobs} jobs"
                 if recorder is not None else "off"
             ),
+            "on" if options.elastic_resize else "off",
         )
 
     if block:

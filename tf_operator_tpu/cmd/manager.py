@@ -98,6 +98,9 @@ def build_scheduler(cluster, options: ServerOptions, engine_kwargs=None):
         cluster,
         policy=options.scheduler_policy,
         clock=(engine_kwargs or {}).get("clock", time.time),
+        # shrink-before-evict needs the controller's elastic-resize
+        # machinery to execute the shrink it requests
+        shrink_before_evict=options.elastic_resize,
     )
     sched.resync()
     return sched
@@ -185,6 +188,7 @@ class _KindController:
                 restart_backoff_base=manager.options.restart_backoff_base,
                 restart_backoff_max=manager.options.restart_backoff_max,
                 control_fanout=manager.options.control_fanout,
+                elastic_resize=manager.options.elastic_resize,
             ),
             **manager.engine_kwargs,
         )

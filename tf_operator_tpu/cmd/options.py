@@ -73,6 +73,12 @@ class ServerOptions:
     # supervisor restart backoff for crash-looping workers (doubles per
     # consecutive fast death, capped at 30s)
     shard_restart_backoff: float = 1.0
+    # multi-process metrics: workers bind their /metrics listener at
+    # base + shard_index (the supervisor logs the full map) so an
+    # external scraper — or `make bench-multiproc` — can read per-worker
+    # reconcile percentiles.  0 (default) keeps the historical ephemeral
+    # binds (port 0), which nothing can find after the fact.
+    shard_metrics_port_base: int = 0
     # warm-pool pod placement (engine/warmpool.py): keep K pre-pulled,
     # pre-initialized standby pods per slice shape; job pod creation
     # claims from the pool (CAS) and falls back to cold create.
@@ -99,6 +105,14 @@ class ServerOptions:
     # Node inventory specs, NAME=SHAPE[:GEN] (repeatable --node); empty
     # uses the built-in default topology (cmd/manager.py)
     scheduler_nodes: List[str] = field(default_factory=list)
+    # elastic resize (engine/controller.py): a replica-count delta on a
+    # live job becomes a failure-atomic drain -> reshard -> resume
+    # transition (with a Resizing condition and durable per-phase state),
+    # and the cluster scheduler's preemption planner may SHRINK elastic
+    # victims (kubeflow.org/min-replicas) to their floor instead of
+    # evicting them.  Off (default) keeps the historical scale-down
+    # semantics byte-identical.
+    elastic_resize: bool = False
     # job flight recorder (engine/timeline.py): per-job causal timeline
     # every subsystem appends to, served at /debug/timeline/<ns>/<name>
     # and by `tpu-jobs timeline`, with derived per-job SLO histograms.
@@ -226,6 +240,25 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
     )
     p.add_argument("--shard-restart-backoff", type=float, default=1.0)
     p.add_argument(
+        "--shard-metrics-port-base",
+        type=int,
+        default=0,
+        help="with --shard-processes, bind each worker's /metrics "
+        "listener at this port + its shard index (the supervisor logs "
+        "the map) so per-worker reconcile percentiles are scrapeable; "
+        "0 (default) uses ephemeral ports",
+    )
+    p.add_argument(
+        "--elastic-resize",
+        action="store_true",
+        help="treat replica-count edits on live jobs as failure-atomic "
+        "drain -> reshard -> resume transitions (Resizing condition, "
+        "durable per-phase state, final checkpoint before teardown), "
+        "and let the scheduler shrink kubeflow.org/min-replicas-"
+        "annotated victims to their floor instead of evicting them; "
+        "off (default) keeps plain scale-down semantics",
+    )
+    p.add_argument(
         "--warm-pool-size",
         type=int,
         default=0,
@@ -334,6 +367,8 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         shard_index=a.shard_index,
         shard_process_grace=a.shard_process_grace,
         shard_restart_backoff=a.shard_restart_backoff,
+        shard_metrics_port_base=a.shard_metrics_port_base,
+        elastic_resize=a.elastic_resize,
         warm_pool_size=a.warm_pool_size,
         warm_pool_shapes=warm_shapes,
         warm_pool_image=a.warm_pool_image,

@@ -52,13 +52,16 @@ RESTART_BACKOFF_MAX = 30.0
 
 
 def build_worker_argv(
-    base_argv: List[str], index: int, log_tag: str = ""
+    base_argv: List[str], index: int, log_tag: str = "",
+    metrics_port_base: int = 0,
 ) -> List[str]:
     """One worker's flag list: the supervisor's own argv minus the
     `--shard-processes` recursion, worker listeners moved to ephemeral
-    ports (N workers cannot share the parent's advertised ports), a
-    per-worker trace-dump path when one was configured, and the slot
-    index stamped last (argparse last-wins keeps overrides simple)."""
+    ports (N workers cannot share the parent's advertised ports) — or,
+    with `metrics_port_base`, the metrics listener pinned to
+    base + index so per-worker /metrics stays scrapeable — a per-worker
+    trace-dump path when one was configured, and the slot index stamped
+    last (argparse last-wins keeps overrides simple)."""
     argv: List[str] = []
     skip = False
     trace_dump = ""
@@ -82,8 +85,9 @@ def build_worker_argv(
             trace_dump = arg.split("=", 1)[1]
             continue
         argv.append(arg)
+    metrics_port = metrics_port_base + index if metrics_port_base > 0 else 0
     argv += [
-        "--metrics-bind-address", "127.0.0.1:0",
+        "--metrics-bind-address", f"127.0.0.1:{metrics_port}",
         "--health-probe-bind-address", "127.0.0.1:0",
     ]
     if trace_dump:
@@ -133,6 +137,7 @@ class Supervisor:
         env: Optional[Dict[str, str]] = None,
         restart: bool = True,
         poll_interval: float = 0.2,
+        metrics_port_base: int = 0,
     ) -> None:
         if shard_count < 1:
             raise ValueError("shard_count must be >= 1")
@@ -142,9 +147,15 @@ class Supervisor:
         self.env = env
         self.restart = restart
         self.poll_interval = poll_interval
+        self.metrics_port_base = metrics_port_base
         self.log = ulog.logger_with({"component": "shard-supervisor"})
         self.workers = [
-            _Worker(i, build_worker_argv(base_argv, i))
+            _Worker(
+                i,
+                build_worker_argv(
+                    base_argv, i, metrics_port_base=metrics_port_base
+                ),
+            )
             for i in range(shard_count)
         ]
         self._stopping = threading.Event()
@@ -171,6 +182,15 @@ class Supervisor:
     def start(self) -> "Supervisor":
         for worker in self.workers:
             self._spawn(worker)
+        if self.metrics_port_base > 0:
+            # the shard -> /metrics-port map, logged once so a scraper
+            # (or `make bench-multiproc`) can find every worker without
+            # guessing — the whole point of pinning the ports
+            self.log.info(
+                "worker metrics ports: %s",
+                {w.index: self.metrics_port_base + w.index
+                 for w in self.workers},
+            )
         self._update_gauge()
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
@@ -290,6 +310,7 @@ def run_supervisor(
         argv,
         grace=options.shard_process_grace,
         restart_backoff=options.shard_restart_backoff,
+        metrics_port_base=options.shard_metrics_port_base,
     ).start()
     log.info(
         "supervising %d shard worker processes (grace=%.1fs)",

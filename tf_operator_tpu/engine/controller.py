@@ -79,6 +79,23 @@ REASON_RESUMED = "JobResumed"
 REASON_PARTIAL_SLICE_TEARDOWN = "PartialSliceTeardown"
 REASON_GANG_PENDING = "GangPending"
 REASON_GANG_SCHEDULED = "GangScheduled"
+# elastic resize (drain -> reshard -> resume) event/condition vocabulary
+REASON_RESIZE_STARTED = "ResizeStarted"
+REASON_RESIZE_ADMITTED = "ResizeAdmitted"
+REASON_RESIZE_REVERTED = "ResizeReverted"
+REASON_RESIZE_DRAINING = "ResizeDraining"
+REASON_RESIZE_RESUMING = "ResizeResuming"
+REASON_RESIZE_COMPLETED = "ResizeCompleted"
+
+# Durable resize state: the whole drain -> reshard -> resume transition is
+# crash-recoverable because every phase boundary is persisted in this
+# annotation BEFORE the phase's effects begin — a mid-resize operator
+# kill -9 finds the phase to finish, never a half-drained mystery.  The
+# generation annotation is the cheap observable twin (monotonic int, one
+# bump per started resize) that `describe`/tests can read without parsing
+# the state JSON.
+RESIZE_STATE_ANNOTATION = "kubeflow.org/resize-state"
+RESIZE_GENERATION_ANNOTATION = "kubeflow.org/resize-generation"
 
 
 class PartialSliceTeardown(RuntimeError):
@@ -127,6 +144,12 @@ class EngineConfig:
     # sites in the historical order, no threads — so seeded chaos runs
     # and event logs replay exactly as before the fan-out existed.
     control_fanout: int = 1
+    # Elastic resize (--elastic-resize): a replica-count delta on a live
+    # job becomes a failure-atomic drain -> reshard -> resume transition
+    # instead of the historical scale-down-deletes + create-missing.
+    # False (the default) bypasses the resize machine entirely — the
+    # pre-elastic engine, byte-identical (chaos goldens untouched).
+    elastic_resize: bool = False
 
 
 @dataclass
@@ -138,6 +161,27 @@ class ReconcileResult:
     # NOT spend the bounded reconcile-retry budget on it — an apiserver
     # outage must not exhaust a job's retries (cmd/manager.py).
     retryable: bool = False
+
+
+@dataclass
+class ResizeDirective:
+    """What the resize state machine wants from the rest of THIS sync:
+    while a resize transition is in flight (`active`) it owns gang
+    admission (the normal Scheduling-condition seam is skipped) and gates
+    pod creation through `may_create` — drain and a pending admit must
+    not race new pods into the old shape.
+
+    `create_within` carves out the one exception: while a resize is
+    PARKED at admit (capacity shortfall, reverted to the previous
+    shape), the old gang must keep FULL strength — an ExitCode
+    replacement for a dying member of the still-running shape is
+    allowed up to the applied shape's per-type counts (its reservation
+    still exists; only target-shape growth stays blocked)."""
+
+    active: bool = False
+    may_create: bool = True
+    requeue_after: Optional[float] = None
+    create_within: Optional[Dict[str, int]] = None
 
 
 class JobEngine:
@@ -206,6 +250,17 @@ class JobEngine:
         # when --timeline-events-per-job > 0; one per process, shared
         # across shards.  None bypasses every recording seam.
         self.recorder: Optional[Any] = None
+        # elastic-resize reshard hook: callable(job, from_shape, to_shape)
+        # invoked during the resize transition's reshard phase, after the
+        # gang is fully drained (final checkpoints on disk) and before any
+        # pod of the new shape exists.  The operator side is deliberately
+        # a seam: models/reshard.py implements the checkpoint math (load
+        # at the old sharding -> host gather -> save at the new mesh's
+        # shardings) and deployments wire it here; None records the phase
+        # and moves on (resharding delegated to the runtime's own resume).
+        # Exceptions abort the sync and retry — the phase is durable, so
+        # a failed reshard re-runs instead of resuming on a stale shape.
+        self.resharder: Optional[Any] = None
         # claim token -> (expectation key, job key): a warm claim raises
         # the same ledger entry a create would, and is settled by the
         # informer-delivered MODIFIED event carrying the token — exactly
@@ -742,6 +797,28 @@ class JobEngine:
             with self._phase("gang_sync"):
                 self._sync_pod_group(job)
 
+        # ----- elastic resize (drain -> reshard -> resume): when enabled,
+        # a replica-count delta against the durably recorded applied shape
+        # enters (or continues) the failure-atomic resize transition.
+        # While a transition is in flight it OWNS gang admission and the
+        # may-create gate; any phase error requeues with the phase state
+        # untouched on the API server — the next sync finishes it.
+        resize = None
+        if self.config.elastic_resize:
+            try:
+                with self._phase("resize"):
+                    resize = self._sync_resize(job, status, pods, now_iso)
+            except Exception as e:  # noqa: BLE001 — requeue like pod errors
+                self._write_status(job, old_status)
+                return ReconcileResult(
+                    error=str(e), requeue_after=1.0,
+                    retryable=(
+                        is_transient_api_error(e)
+                        or getattr(e, "transient", False)
+                    ),
+                )
+        resize_owns = resize is not None and resize.active
+
         # ----- cluster-scheduler gang admission (engine/scheduler.py):
         # the job's whole member set reserves node capacity atomically or
         # not at all.  Admission gates CREATION only — deletes, exit-code
@@ -749,7 +826,9 @@ class JobEngine:
         # job (a preempted gang must finish its delete-for-recreate and
         # keep exact restart counters while it waits for capacity).
         gang_admitted = True
-        if self.scheduler is not None:
+        if resize_owns:
+            gang_admitted = resize.may_create
+        elif self.scheduler is not None:
             with self._phase("gang_admission"):
                 gang_admitted = self._sync_gang_admission(
                     job, status, pods, now_iso
@@ -763,12 +842,14 @@ class JobEngine:
         # budget is not spent on them.
         restarted_types: set = set()
         requeue_candidates: List[float] = []
+        create_within = resize.create_within if resize_owns else None
         try:
             for rtype, spec in replicas.items():
                 with self._phase("pod_reconcile", replica_type=rtype):
                     backoff_left = self.reconcile_pods(
                         job, status, pods, rtype, spec, replicas, now_iso,
                         restarted_types, may_create=gang_admitted,
+                        create_within=create_within,
                     )
                 if backoff_left:
                     requeue_candidates.append(backoff_left)
@@ -817,7 +898,10 @@ class JobEngine:
         if ads is not None and status.start_time is not None:
             remaining = epoch_from_iso(status.start_time) + ads - self.clock()
             requeue_candidates.append(max(0.0, remaining))
-        if not gang_admitted:
+        if resize is not None and resize.requeue_after is not None:
+            # mid-transition: the resize machine drives its own cadence
+            requeue_candidates.append(resize.requeue_after)
+        if not gang_admitted and not resize_owns:
             # pending gang: retry admission without waiting for the next
             # object event (capacity frees when other gangs finish)
             requeue_candidates.append(self.scheduler.retry_interval)
@@ -838,20 +922,12 @@ class JobEngine:
                 members[self.gen_general_name(job.name, rtype, index)] = chips
         return members
 
-    def _sync_gang_admission(
-        self,
-        job: Job,
-        status: common.JobStatus,
-        pods: List[Dict[str, Any]],
-        now_iso: str,
-    ) -> bool:
-        """Admit (or re-assert) the job's gang with the cluster scheduler.
-        Live pods' placements are handed in as `existing` so admission
-        adopts physical reality (restart resync, warm-claimed pods on
-        standby nodes) instead of re-placing anything.  Not-admitted
-        stamps the Scheduling condition + a GangPending event (once per
-        message change); admission clears it with a GangScheduled event."""
-        members = self._gang_members(job)
+    def _existing_placements(
+        self, members: Dict[str, int], pods: List[Dict[str, Any]]
+    ) -> tuple:
+        """(existing, pod_names) for admission: live pods' placements —
+        physical reality admission adopts verbatim — and the actual pod
+        name of members served by a warm claim (the standby's name)."""
         existing: Dict[str, str] = {}
         pod_names: Dict[str, str] = {}
         for pod in pods:
@@ -873,7 +949,22 @@ class JobEngine:
             ) or objects.pod_node(pod)
             if node:
                 existing[member] = node
-        admitted, msg = self.scheduler.admit(
+        return existing, pod_names
+
+    def _admit_gang(
+        self, job: Job, pods: List[Dict[str, Any]],
+        members: Optional[Dict[str, int]] = None,
+    ) -> tuple:
+        """One fit-checked admission attempt for the job's spec-derived
+        gang.  Shared by the normal Scheduling seam and the resize
+        machine's admit/resume phases; (True, "") without a scheduler.
+        `members` lets a caller that already computed the gang reuse it."""
+        if self.scheduler is None:
+            return True, ""
+        if members is None:
+            members = self._gang_members(job)
+        existing, pod_names = self._existing_placements(members, pods)
+        return self.scheduler.admit(
             job_key=job.key,
             job_uid=job.uid,
             kind=self.adapter.KIND,
@@ -883,7 +974,24 @@ class JobEngine:
             existing=existing,
             throughput=cluster_scheduler.throughput_ratios_of(job),
             pod_names=pod_names,
+            min_replicas=cluster_scheduler.min_replicas_of(job),
         )
+
+    def _sync_gang_admission(
+        self,
+        job: Job,
+        status: common.JobStatus,
+        pods: List[Dict[str, Any]],
+        now_iso: str,
+    ) -> bool:
+        """Admit (or re-assert) the job's gang with the cluster scheduler.
+        Live pods' placements are handed in as `existing` so admission
+        adopts physical reality (restart resync, warm-claimed pods on
+        standby nodes) instead of re-placing anything.  Not-admitted
+        stamps the Scheduling condition + a GangPending event (once per
+        message change); admission clears it with a GangScheduled event."""
+        members = self._gang_members(job)
+        admitted, msg = self._admit_gang(job, pods, members=members)
         prev = common.get_condition(status, common.JOB_SCHEDULING)
         if admitted:
             if prev is not None and prev.status == "True":
@@ -916,6 +1024,442 @@ class JobEngine:
         )
         return False
 
+    # --------------------------------------------------------- elastic resize
+    @staticmethod
+    def _spec_shape(job: Job) -> Dict[str, int]:
+        return {
+            rt: (spec.replicas or 0)
+            for rt, spec in (job.replica_specs or {}).items()
+        }
+
+    @staticmethod
+    def _shape_str(shape: Optional[Dict[str, int]]) -> str:
+        return ",".join(f"{rt}={n}" for rt, n in sorted((shape or {}).items()))
+
+    def _resize_state(self, job: Job) -> Optional[Dict[str, Any]]:
+        """The durable resize state (phase machine position) from the
+        job's annotation, or None when never stamped."""
+        import json as _json
+
+        ann = (job.metadata or {}).get("annotations") or {}
+        raw = ann.get(RESIZE_STATE_ANNOTATION)
+        if not raw:
+            return None
+        try:
+            state = _json.loads(raw)
+        except ValueError:
+            return None
+        return state if isinstance(state, dict) else None
+
+    def _write_resize_state(self, job: Job, state: Dict[str, Any]) -> bool:
+        """Persist the resize state annotation on the job CR — the ONE
+        durable record every phase transition goes through BEFORE its
+        effects begin, so a mid-resize operator crash (kill -9, chaos)
+        re-enters exactly the phase it left.  One conflict retry on fresh
+        state; other errors propagate (the sync requeues, the previous
+        phase stays durable).  The in-hand job's metadata is refreshed
+        from the write so the sync's own status write-back does not
+        conflict with it."""
+        import json as _json
+
+        payload = _json.dumps(state, separators=(",", ":"), sort_keys=True)
+        gen = str(state.get("gen", 0))
+        for attempt in (0, 1):
+            try:
+                current = self.cluster.get(
+                    self.adapter.KIND, job.namespace, job.name
+                )
+            except NotFoundError:
+                return False
+            ann = current.setdefault("metadata", {}).setdefault(
+                "annotations", {}
+            )
+            ann[RESIZE_STATE_ANNOTATION] = payload
+            ann[RESIZE_GENERATION_ANNOTATION] = gen
+            try:
+                written = self.cluster.update(self.adapter.KIND, current)
+            except ConflictError:
+                if attempt == 1:
+                    raise
+                continue
+            md = written.get("metadata", {}) or {}
+            job.metadata.setdefault("annotations", {}).update(
+                md.get("annotations") or {}
+            )
+            rv = md.get("resourceVersion")
+            if self._rv_int(rv) is not None:
+                job.metadata["resourceVersion"] = rv
+                self._rv_seen[job.key] = rv
+            return True
+        return False
+
+    def _record_resize(self, job: Job, event: str,
+                       detail: Dict[str, Any]) -> None:
+        """DECISIONS-ring record for a resize milestone (resize_requested
+        / drained / resharded / resumed / reverted)."""
+        if self.recorder is not None:
+            self.recorder.record(
+                job.key, "controller", event, detail, uid=job.uid
+            )
+
+    def _sync_resize(
+        self,
+        job: Job,
+        status: common.JobStatus,
+        pods: List[Dict[str, Any]],
+        now_iso: str,
+    ) -> Optional[ResizeDirective]:
+        """The failure-atomic resize phase machine.  Phases (durable in
+        the resize-state annotation, advanced strictly forward):
+
+          done   — no transition in flight; a spec shape differing from
+                   the recorded applied shape STARTS one (gen+1, admit)
+          admit  — fit-check the target shape through the scheduler's
+                   atomic resize path.  Failure reverts: the reservation
+                   is already restored to the old full shape, no pod has
+                   been touched, and the gang keeps running while the
+                   admit retries (ResizeReverted, once per message)
+          drain  — gracefully delete the gang's in-range active pods
+                   (kubelet SIGTERM -> runtime/loop.py's guard lands one
+                   final checkpoint); out-of-range pods ride the normal
+                   scale-down path in the same sync.  Advances only when
+                   NO dependent pod remains
+          reshard— run the wired resharder (checkpoint: old sharding ->
+                   host gather -> new mesh shardings; models/reshard.py)
+                   exactly between "fully drained" and "first new pod"
+          resume — re-assert admission (an operator restarted mid-resize
+                   rebuilds the reservation here), let creation proceed,
+                   and complete once every target replica is Running
+
+        Every phase is re-entrant: the sync that finds phase P finishes
+        P's remaining work and only then persists P+1."""
+        spec_shape = self._spec_shape(job)
+        state = self._resize_state(job)
+        if state is None:
+            # first contact under --elastic-resize: durably record the
+            # applied shape so later spec edits are a detectable delta
+            self._write_resize_state(
+                job, {"gen": 0, "phase": "done", "to": spec_shape}
+            )
+            return None
+        phase = state.get("phase", "done")
+        if phase == "done":
+            if state.get("to") == spec_shape:
+                # steady state — but finish a completion whose status
+                # write was lost after the annotation landed (crash
+                # between the two): the condition must not stay True.
+                # A CANCELLED transition repairs as a revert, not a
+                # completion — the resize-duration SLO must never
+                # observe a transition that disrupted nothing.
+                if common.is_resizing(status):
+                    if state.get("cancelled"):
+                        self._finish_cancel(job, status, state, now_iso)
+                    else:
+                        self._finish_resize(job, status, state, now_iso)
+                return None
+            state = {
+                "gen": int(state.get("gen", 0)) + 1,
+                "phase": "admit",
+                "from": dict(state.get("to") or {}),
+                "to": spec_shape,
+                "t0": round(self.clock(), 3),
+            }
+            self._write_resize_state(job, state)
+            msg = (
+                f"resize {self._shape_str(state['from'])} -> "
+                f"{self._shape_str(spec_shape)} requested"
+            )
+            self.cluster.record_event(
+                job.to_dict(), "Normal", REASON_RESIZE_STARTED, msg
+            )
+            self._record_resize(
+                job, "resize_requested",
+                {"gen": state["gen"], "from": state["from"],
+                 "to": spec_shape},
+            )
+            common.update_job_conditions(
+                status, common.JOB_RESIZING, REASON_RESIZE_STARTED, msg,
+                now_iso,
+            )
+            phase = "admit"
+        elif state.get("to") != spec_shape:
+            if (
+                state.get("phase") == "admit"
+                and spec_shape == (state.get("from") or {})
+            ):
+                # the spec moved BACK to the applied shape before any
+                # drain happened (a cancelled resize, or the scheduler's
+                # shrink request racing a user revert): nothing was
+                # disrupted — end the transition instead of pointlessly
+                # bouncing the whole gang through drain -> resume.  The
+                # durable `cancelled` marker is what lets a crash
+                # between this write and the status write repair as a
+                # REVERT (done-branch above), not a phantom completion.
+                state = {
+                    "gen": int(state.get("gen", 0)), "phase": "done",
+                    "to": spec_shape, "cancelled": True,
+                }
+                self._write_resize_state(job, state)
+                self._finish_cancel(job, status, state, now_iso)
+                return None
+            # the spec moved again mid-transition: restart at admit with
+            # the new target (drained pods stay drained; a completed
+            # reshard is re-run against the new shape)
+            state = {
+                "gen": int(state.get("gen", 0)) + 1,
+                "phase": "admit",
+                "from": dict(state.get("from") or {}),
+                "to": spec_shape,
+                "t0": state.get("t0", round(self.clock(), 3)),
+            }
+            self._write_resize_state(job, state)
+            msg = (
+                f"resize retargeted to {self._shape_str(spec_shape)} "
+                f"mid-transition"
+            )
+            self.cluster.record_event(
+                job.to_dict(), "Normal", REASON_RESIZE_STARTED, msg
+            )
+            self._record_resize(
+                job, "resize_requested",
+                {"gen": state["gen"], "from": state["from"],
+                 "to": spec_shape},
+            )
+            phase = "admit"
+
+        target = {rt: int(n) for rt, n in (state.get("to") or {}).items()}
+
+        if phase == "admit":
+            admitted, why = self._admit_gang(job, pods)
+            if not admitted:
+                # the scheduler's atomic restore already put the old full
+                # reservation back; nothing was drained — the gang keeps
+                # running at the previous shape while the admit retries
+                msg = (
+                    f"resize to {self._shape_str(target)} reverted to "
+                    f"previous shape: {why}"
+                )
+                prev = common.get_condition(status, common.JOB_RESIZING)
+                if (
+                    prev is None or prev.status != "True"
+                    or prev.reason != REASON_RESIZE_REVERTED
+                    or prev.message != msg
+                ):
+                    self.cluster.record_event(
+                        job.to_dict(), "Normal", REASON_RESIZE_REVERTED, msg
+                    )
+                    self._record_resize(
+                        job, "reverted",
+                        {"gen": state.get("gen"), "why": why},
+                    )
+                common.update_job_conditions(
+                    status, common.JOB_RESIZING, REASON_RESIZE_REVERTED,
+                    msg, now_iso,
+                )
+                retry = (
+                    self.scheduler.retry_interval
+                    if self.scheduler is not None else 5.0
+                )
+                return ResizeDirective(
+                    active=True, may_create=False, requeue_after=retry,
+                    # the gang keeps running at the previous shape — and
+                    # keeps REPAIRING at it: ExitCode replacements within
+                    # the applied shape stay allowed (their members'
+                    # reservations survived the atomic restore)
+                    create_within=dict(state.get("from") or {}),
+                )
+            msg = f"resize to {self._shape_str(target)} admitted; draining"
+            state = {**state, "phase": "drain"}
+            self._write_resize_state(job, state)
+            self.cluster.record_event(
+                job.to_dict(), "Normal", REASON_RESIZE_ADMITTED, msg
+            )
+            common.update_job_conditions(
+                status, common.JOB_RESIZING, REASON_RESIZE_ADMITTED, msg,
+                now_iso,
+            )
+            phase = "drain"
+
+        if phase == "drain":
+            if not pods:
+                state = {**state, "phase": "reshard"}
+                self._write_resize_state(job, state)
+                self._record_resize(job, "drained", {"gen": state.get("gen")})
+                phase = "reshard"
+            else:
+                drained = self._drain_for_resize(job, pods, target)
+                common.update_job_conditions(
+                    status, common.JOB_RESIZING, REASON_RESIZE_DRAINING,
+                    f"draining {drained} pod(s) for the final checkpoint",
+                    now_iso,
+                )
+                return ResizeDirective(
+                    active=True, may_create=False, requeue_after=1.0
+                )
+
+        if phase == "reshard":
+            if self.resharder is not None:
+                # raises propagate: the phase is durable, a failed
+                # reshard re-runs — resuming on a stale shape is the one
+                # outcome this phase exists to prevent
+                self.resharder(job, dict(state.get("from") or {}), target)
+            state = {**state, "phase": "resume"}
+            self._write_resize_state(job, state)
+            self._record_resize(job, "resharded", {"gen": state.get("gen")})
+            phase = "resume"
+
+        if phase == "resume":
+            admitted, why = self._admit_gang(job, pods)
+            if not admitted:
+                # capacity was stolen while the gang was down (operator
+                # restart mid-resize, a higher-priority arrival): park
+                # creation exactly like a pending gang, keep the phase
+                common.update_job_conditions(
+                    status, common.JOB_RESIZING, REASON_RESIZE_RESUMING,
+                    f"waiting to resume at {self._shape_str(target)}: "
+                    f"{why}",
+                    now_iso,
+                )
+                retry = (
+                    self.scheduler.retry_interval
+                    if self.scheduler is not None else 5.0
+                )
+                return ResizeDirective(
+                    active=True, may_create=False, requeue_after=retry
+                )
+            running: Dict[str, int] = {}
+            for pod in pods:
+                rt = objects.labels_of(pod).get(objects.LABEL_REPLICA_TYPE)
+                if rt and objects.pod_phase(pod) == objects.POD_RUNNING:
+                    running[rt] = running.get(rt, 0) + 1
+            complete = all(
+                running.get(rt.lower(), 0) == n for rt, n in target.items()
+            )
+            if not complete:
+                common.update_job_conditions(
+                    status, common.JOB_RESIZING, REASON_RESIZE_RESUMING,
+                    f"resuming at {self._shape_str(target)}", now_iso,
+                )
+                return ResizeDirective(
+                    active=True, may_create=True, requeue_after=1.0
+                )
+            state = {
+                "gen": state.get("gen"), "phase": "done", "to": target,
+                "t0": state.get("t0"),
+            }
+            self._write_resize_state(job, state)
+            self._finish_resize(job, status, state, now_iso)
+            return None
+
+        return None
+
+    def _finish_cancel(
+        self, job: Job, status: common.JobStatus, state: Dict[str, Any],
+        now_iso: str,
+    ) -> None:
+        """End a cancelled-before-drain transition: final `reverted`
+        record (the timeline closes its resize clock WITHOUT observing a
+        duration), ResizeReverted event, condition demoted.  Shared by
+        the cancel branch and the done-branch crash repair."""
+        msg = (
+            f"resize cancelled before drain; running at "
+            f"{self._shape_str(state.get('to'))}"
+        )
+        self.cluster.record_event(
+            job.to_dict(), "Normal", REASON_RESIZE_REVERTED, msg
+        )
+        self._record_resize(
+            job, "reverted", {"gen": state.get("gen"), "final": True}
+        )
+        common.demote_condition(
+            status, common.JOB_RESIZING, now_iso,
+            reason=REASON_RESIZE_REVERTED, message=msg,
+        )
+
+    def _finish_resize(
+        self, job: Job, status: common.JobStatus, state: Dict[str, Any],
+        now_iso: str,
+    ) -> None:
+        """Demote the Resizing condition and stamp the resumed milestone
+        (also the repair path for a completion whose status write was
+        lost after the annotation landed)."""
+        t0 = state.get("t0")
+        detail: Dict[str, Any] = {"gen": state.get("gen")}
+        if isinstance(t0, (int, float)):
+            detail["duration"] = round(max(0.0, self.clock() - t0), 3)
+        self._record_resize(job, "resumed", detail)
+        msg = (
+            f"resize to {self._shape_str(state.get('to'))} complete; "
+            f"resumed from the resharded checkpoint"
+        )
+        common.demote_condition(
+            status, common.JOB_RESIZING, now_iso,
+            reason=REASON_RESIZE_COMPLETED, message=msg,
+        )
+        self.cluster.record_event(
+            job.to_dict(), "Normal", REASON_RESIZE_COMPLETED, msg
+        )
+
+    def _drain_for_resize(
+        self, job: Job, pods: List[Dict[str, Any]], target: Dict[str, int]
+    ) -> int:
+        """Gracefully delete the gang's pods for the resize: the
+        kubelet's SIGTERM gives runtime/loop.py's signal guard its final
+        checkpoint.  Ownership split, so the drain-complete check
+        (`no dependent pods remain`) can always be reached:
+
+          - out-of-range pods of SPEC'd types ride the per-type loops'
+            historical scale-down delete in this same sync;
+          - Failed pods whose type is ExitCode with a retryable code
+            belong to the restart machinery (deleting them here would
+            swallow the restart-counter increment the chaos accounting
+            cross-checks);
+          - EVERYTHING else — active in-range pods, in-range Succeeded
+            pods, pods of types no longer in the spec, unparsable
+            indices — is drained here: no other path ever deletes them,
+            and one leftover would wedge the phase machine in drain
+            forever.
+
+        Returns deletes issued."""
+        lower_target = {rt.lower(): n for rt, n in target.items()}
+        specs_by_lower = {
+            rt.lower(): (rt, spec)
+            for rt, spec in (job.replica_specs or {}).items()
+        }
+        n = 0
+        for pod in pods:
+            labels = objects.labels_of(pod)
+            rt = labels.get(objects.LABEL_REPLICA_TYPE) or ""
+            try:
+                idx: Optional[int] = int(
+                    labels.get(objects.LABEL_REPLICA_INDEX, "")
+                )
+            except ValueError:
+                idx = None
+            if (
+                rt in specs_by_lower and idx is not None
+                and idx >= lower_target.get(rt, 0)
+            ):
+                continue  # out-of-range: the scale-down path owns it
+            rtype, spec = specs_by_lower.get(rt, (rt or "worker", None))
+            if objects.pod_phase(pod) == objects.POD_FAILED:
+                exit_code = objects.container_exit_code(
+                    pod, self.adapter.CONTAINER_NAME
+                )
+                if (
+                    spec is not None
+                    and spec.restart_policy == common.RESTART_POLICY_EXIT_CODE
+                    and common.is_retryable_exit_code(exit_code)
+                ):
+                    # the ExitCode machinery deletes AND counts this one
+                    continue
+                # permanent failures were already visible to this sync's
+                # status rules (same snapshot); non-ExitCode policies
+                # have no delete path of their own — drain it
+            self._delete_pod_with_expectations(job, rtype, pod)
+            n += 1
+        return n
+
     # ------------------------------------------------------------- pods
     def reconcile_pods(
         self,
@@ -928,6 +1472,7 @@ class JobEngine:
         now_iso: str,
         restarted_types: Optional[set] = None,
         may_create: bool = True,
+        create_within: Optional[Dict[str, int]] = None,
     ) -> Optional[float]:
         """Per-replica-type pod reconciliation: create missing indices, delete
         out-of-range (dynamic scale down), exit-code restart handling, replica
@@ -939,6 +1484,11 @@ class JobEngine:
         skips ONLY the create-missing-pod branch: deletes, restarts, and
         counting run regardless, so a capacity-starved job still converges
         its teardown half and keeps exact restart accounting.
+
+        `create_within` (a resize parked at admit) re-opens creation for
+        indices below the APPLIED shape's per-type count even while
+        may_create is False: the running gang keeps repairing itself at
+        the old shape; only target-shape growth stays blocked.
 
         Returns the remaining crash-loop backoff when pod creation was
         deferred by it (the caller requeues for that instant), else None."""
@@ -978,7 +1528,10 @@ class JobEngine:
             if len(pod_slice) > 1:
                 continue  # too many pods for index; wait for deletion to settle
             if len(pod_slice) == 0:
-                if not may_create:
+                if not may_create and (
+                    create_within is None
+                    or index >= create_within.get(rtype, 0)
+                ):
                     # gang not admitted: the scheduler holds no capacity
                     # for this member yet — creation waits (the sync-level
                     # requeue retries admission), everything else proceeds

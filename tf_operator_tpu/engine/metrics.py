@@ -479,6 +479,13 @@ SCHEDULER_BIND_LATENCY = Histogram(
     buckets=(0.0, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
              1800.0),
 )
+SCHEDULER_SHRINKS = Counter(
+    f"{PREFIX}_scheduler_shrinks_total",
+    "Elastic victims resized down to their kubeflow.org/min-replicas "
+    "floor (spec patched; the victim's own drain -> checkpoint -> "
+    "resume transition executes the shrink) to admit a higher-priority "
+    "arrival instead of evicting the whole gang, labeled by policy",
+)
 SCHEDULER_FRAGMENTATION = Gauge(
     f"{PREFIX}_scheduler_fragmentation_ratio",
     "1 - (largest contiguous free block / total free chips) over the "
@@ -516,6 +523,14 @@ JOB_RESTART_MTTR = Histogram(
     "Per-incident repair time: earliest failure evidence in the job's "
     "timeline (injected kill, preemption, Restarting condition) to the "
     "next Running condition — mean time to recovery from ground truth",
+    buckets=_SLO_BUCKETS,
+)
+JOB_RESIZE_DURATION = Histogram(
+    f"{PREFIX}_job_resize_duration_seconds",
+    "Per-resize elastic transition time: resize_requested to resumed in "
+    "the job's timeline (drain + checkpoint reshard + recreate + "
+    "re-warmup to all-replicas-Running) — the SLO a failure-atomic "
+    "resize is judged on; reverted transitions are not observed",
     buckets=_SLO_BUCKETS,
 )
 JOB_TIMELINE_EVENTS = Counter(

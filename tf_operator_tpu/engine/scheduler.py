@@ -76,6 +76,12 @@ DEFAULT_GENERATION = "v5e"
 PRIORITY_ANNOTATION = "kubeflow.org/priority"
 THROUGHPUT_ANNOTATION = "kubeflow.org/throughput-ratios"
 PRIORITY_CLASSES = {"system": 1000, "high": 100, "default": 0, "low": -100}
+# Elastic opt-in: a job carrying this annotation (an integer floor) may be
+# SHRUNK to that many replicas per type — through the controller's full
+# drain -> checkpoint -> resume path — when a higher-priority gang needs
+# its chips, instead of being evicted outright ("preemption = resize to
+# what fits").  Absent = rigid: the gang is all-or-nothing, as before.
+MIN_REPLICAS_ANNOTATION = "kubeflow.org/min-replicas"
 
 # Stamped into every scheduled pod's annotations at create time: the
 # member's reserved node.  resync() rebuilds reservations from it after
@@ -84,6 +90,7 @@ PRIORITY_CLASSES = {"system": 1000, "high": 100, "default": 0, "low": -100}
 ASSIGNED_NODE_ANNOTATION = "kubeflow.org/assigned-node"
 
 REASON_PREEMPTED = "GangPreempted"
+REASON_SHRUNK = "GangShrunk"
 
 
 def chips_of_shape(shape: str) -> int:
@@ -199,6 +206,28 @@ def priority_of(job) -> int:
     return 0
 
 
+def _parse_min_replicas(raw) -> Optional[int]:
+    if raw is None:
+        return None
+    try:
+        return max(0, int(raw))
+    except (TypeError, ValueError):
+        return None
+
+
+def min_replicas_of(job) -> Optional[int]:
+    """The job's elastic floor (MIN_REPLICAS_ANNOTATION), or None when the
+    job is rigid (no shrink-before-evict eligibility)."""
+    ann = (getattr(job, "metadata", None) or {}).get("annotations") or {}
+    return _parse_min_replicas(ann.get(MIN_REPLICAS_ANNOTATION))
+
+
+def min_replicas_of_cr(cr: Dict[str, Any]) -> Optional[int]:
+    """min_replicas_of over a raw CR dict (resync reads stored objects)."""
+    ann = (cr.get("metadata") or {}).get("annotations") or {}
+    return _parse_min_replicas(ann.get(MIN_REPLICAS_ANNOTATION))
+
+
 def throughput_ratios_of(job) -> Dict[str, float]:
     """Per-generation relative throughput ("v5e=1.0,v5p=2.4"); absent or
     malformed entries default to 1.0-everywhere (generation-indifferent)."""
@@ -272,6 +301,10 @@ class Reservation:
     # after them (warm claims keep the standby's name) — eviction and
     # drain must kill the pod that exists, not the name the gang uses
     pod_names: Dict[str, str] = field(default_factory=dict)
+    # elastic floor (MIN_REPLICAS_ANNOTATION): when set, the preemption
+    # planner may shrink this gang to `min_replicas` per replica type
+    # instead of evicting it; None = rigid
+    min_replicas: Optional[int] = None
 
     def pod_of(self, member: str) -> str:
         return self.pod_names.get(member, member)
@@ -293,6 +326,7 @@ class ClusterScheduler:
         retry_interval: float = 5.0,
         enable_preemption: bool = True,
         note: Optional[Callable[[str], None]] = None,
+        shrink_before_evict: bool = False,
     ) -> None:
         if policy not in POLICIES:
             raise ValueError(
@@ -305,6 +339,11 @@ class ClusterScheduler:
         self.clock = clock
         self.retry_interval = retry_interval
         self.enable_preemption = enable_preemption
+        # shrink-before-evict (requires the controller's --elastic-resize
+        # to actually execute the shrink): eligible elastic victims are
+        # resized down to their floor before anyone is fully evicted.
+        # Off (default) keeps the evict-only planner byte-identical.
+        self.shrink_before_evict = shrink_before_evict
         # deterministic-log hook (FaultInjector.note in soaks): admission,
         # preemption, and drain decisions land in the seeded event log
         self.note = note or (lambda line: None)
@@ -368,22 +407,26 @@ class ClusterScheduler:
             pods = self.cluster.list_pods()
         except (ApiError, OSError):
             pods = []
-        # one owner-CR read per job, for its PRIORITY: rebuilding with a
+        # one owner-CR read per job, for its PRIORITY (rebuilding with a
         # default 0 would let any positive-priority arrival preempt a
         # high-priority gang in the window before its first post-restart
-        # sync re-asserts itself — priority inversion at the worst time
-        owner_priority: Dict[Tuple[str, str, str], int] = {}
+        # sync re-asserts itself — priority inversion at the worst time)
+        # and its elastic floor (a restarted operator must not forget a
+        # victim's shrink eligibility mid-capacity-crunch)
+        owner_info: Dict[Tuple[str, str, str], Tuple[int, Optional[int]]] = {}
 
-        def priority_for(ref: Dict[str, Any], namespace: str) -> int:
+        def info_for(ref: Dict[str, Any], namespace: str
+                     ) -> Tuple[int, Optional[int]]:
             key = (ref.get("kind", ""), namespace, ref.get("name", ""))
-            if key not in owner_priority:
+            if key not in owner_info:
                 try:
-                    owner_priority[key] = priority_of_cr(
-                        self.cluster.get(*key)
+                    cr = self.cluster.get(*key)
+                    owner_info[key] = (
+                        priority_of_cr(cr), min_replicas_of_cr(cr)
                     )
                 except (ApiError, OSError):
-                    owner_priority[key] = 0
-            return owner_priority[key]
+                    owner_info[key] = (0, None)
+            return owner_info[key]
 
         for pod in pods:
             ref = objects.get_controller_of(pod)
@@ -411,6 +454,7 @@ class ClusterScheduler:
             with self._lock:
                 res = self._reservations.get(ref.get("uid", ""))
                 if res is None:
+                    prio, floor = info_for(ref, objects.namespace_of(pod))
                     res = Reservation(
                         job_uid=ref.get("uid", ""),
                         job_key=(
@@ -418,12 +462,11 @@ class ClusterScheduler:
                         ),
                         kind=ref.get("kind", ""),
                         namespace=objects.namespace_of(pod),
-                        priority=priority_for(
-                            ref, objects.namespace_of(pod)
-                        ),
+                        priority=prio,
                         members={},
                         assignments={},
                         admitted_at=self.clock(),
+                        min_replicas=floor,
                     )
                     self._reservations[res.job_uid] = res
                 res.members[member] = chips_of_shape(shape)
@@ -510,6 +553,7 @@ class ClusterScheduler:
         existing: Optional[Dict[str, str]] = None,
         throughput: Optional[Dict[str, float]] = None,
         pod_names: Optional[Dict[str, str]] = None,
+        min_replicas: Optional[int] = None,
     ) -> Tuple[bool, str]:
         """Admit (or re-assert) the gang atomically.  Returns
         (admitted, message).  Idempotent: an unchanged admitted gang is a
@@ -540,6 +584,7 @@ class ClusterScheduler:
             if res is not None:
                 res.priority = priority
                 res.throughput = dict(throughput or {})
+                res.min_replicas = min_replicas
                 if pod_names:
                     res.pod_names.update(
                         {m: n for m, n in pod_names.items() if m in members}
@@ -626,6 +671,7 @@ class ClusterScheduler:
                     m: n for m, n in (pod_names or {}).items()
                     if m in members
                 },
+                min_replicas=min_replicas,
             )
             adopted = self._adopt_locked(res, members, existing)
             missing = {m: c for m, c in members.items() if m not in adopted}
@@ -767,6 +813,97 @@ class ClusterScheduler:
             self._update_gauges_locked()
 
     # ------------------------------------------------------------- preemption
+    def _shrink_drop_locked(self, victim: Reservation) -> Dict[str, str]:
+        """member -> node for the members a shrink-to-floor would drop:
+        per replica type, every index at or above the victim's elastic
+        floor (the spec patch sets replicas = min(current, floor), so
+        indices 0..floor-1 survive).  Empty when the victim is rigid or
+        already at its floor — i.e. not shrinkable."""
+        floor = victim.min_replicas
+        if floor is None:
+            return {}
+        groups: Dict[str, List[Tuple[int, str]]] = {}
+        for member in victim.assignments:
+            parts = member.rsplit("-", 2)
+            if len(parts) != 3:
+                continue
+            try:
+                idx = int(parts[2])
+            except ValueError:
+                continue
+            groups.setdefault(parts[1], []).append((idx, member))
+        drop: Dict[str, str] = {}
+        for entries in groups.values():
+            entries.sort()
+            for _idx, member in entries[floor:]:
+                drop[member] = victim.assignments[member]
+        return drop
+
+    def _request_shrink_locked(
+        self, victim: Reservation, preemptor: Reservation
+    ) -> bool:
+        """Patch the victim job's SPEC down to its elastic floor
+        (replicas = min(current, floor) per type) so the victim's own
+        controller executes the shrink through the full elastic-resize
+        path: drain with a final checkpoint, reshard, resume at the
+        floor.  The reservation is NOT touched here — capacity frees
+        when the victim's resize admits the smaller shape, and the
+        preemptor stays pending until then.  Idempotent: a spec already
+        at the floor is a quiet no-op (retry syncs re-plan without
+        re-noting)."""
+        floor = victim.min_replicas or 0
+        name = victim.job_key.partition("/")[2]
+        try:
+            cr = self.cluster.get(victim.kind, victim.namespace, name)
+        except (ApiError, OSError):
+            return False
+        spec = cr.get("spec") or {}
+        rs_key = next(
+            (k for k in spec if k.endswith("ReplicaSpecs")), None
+        )
+        if rs_key is None:
+            return False
+        changed = False
+        for rspec in (spec.get(rs_key) or {}).values():
+            cur = int(rspec.get("replicas") or 0)
+            if cur > floor:
+                rspec["replicas"] = floor
+                changed = True
+        if not changed:
+            return True  # already at/below the floor: shrink in flight
+        try:
+            self.cluster.update(victim.kind, cr)
+        except (ApiError, OSError):
+            return False
+        metrics.SCHEDULER_SHRINKS.inc({"policy": self.policy_name})
+        try:
+            self.cluster.record_event(
+                {"kind": victim.kind,
+                 "metadata": {"name": name,
+                              "namespace": victim.namespace}},
+                "Normal", REASON_SHRUNK,
+                f"gang shrunk to min-replicas={floor} for higher-priority "
+                f"{preemptor.job_key} (priority {preemptor.priority} > "
+                f"{victim.priority}); degrading instead of evicting",
+            )
+        except Exception:  # noqa: BLE001 — eventing is best-effort
+            pass
+        self.note(
+            f"shrink gang={victim.job_key} floor={floor} "
+            f"by={preemptor.job_key}"
+        )
+        self._record(
+            victim.job_key, "shrink_requested",
+            {"by": preemptor.job_key, "floor": floor},
+            uid=victim.job_uid,
+        )
+        self._record(
+            preemptor.job_key, "shrink",
+            {"victim": victim.job_key, "floor": floor},
+            uid=preemptor.job_uid,
+        )
+        return True
+
     def _preempt_and_place_locked(
         self,
         new_res: Reservation,
@@ -775,12 +912,16 @@ class ClusterScheduler:
         registered: bool = False,
     ) -> Optional[Dict[str, str]]:
         """Find the cheapest set of strictly-lower-priority victims whose
-        eviction provably frees enough capacity, evict them (SIGTERM /
-        143), and place.  Victims are taken lowest priority first,
-        youngest first within a priority (the least work is lost).  The
+        eviction (or, with shrink_before_evict, shrink-to-floor) provably
+        frees enough capacity, apply the plan, and place.  Victims are
+        taken lowest priority first, youngest first within a priority
+        (the least work is lost).  Shrinks are planned BEFORE evictions:
+        an elastic victim degrades to its floor through its own drain ->
+        checkpoint -> resume path instead of dying; only when every
+        shrink still cannot fit the gang does full eviction start.  The
         whole plan is verified against a hypothetical free map BEFORE
-        any pod is touched: if even evicting every eligible victim
-        cannot fit the gang, nobody dies."""
+        any pod or spec is touched: if even the maximal plan cannot fit
+        the gang, nobody dies and nobody shrinks."""
         victims = sorted(
             (
                 r for r in self._reservations.values()
@@ -791,7 +932,10 @@ class ClusterScheduler:
         if not victims:
             return None
 
-        def free_with_evicted(plan: List[Reservation]) -> Dict[str, int]:
+        def free_with(
+            evicts: List[Reservation],
+            shrinks: List[Tuple[Reservation, Dict[str, str]]],
+        ) -> Dict[str, int]:
             # the candidate's own placed/adopted members stay deducted:
             # offering their chips to the plan would double-count them
             # and land the gang over capacity.  A REGISTERED candidate
@@ -801,35 +945,77 @@ class ClusterScheduler:
                 self._free_locked() if registered
                 else self._free_for_candidate_locked(new_res)
             )
-            for victim in plan:
+            for victim in evicts:
                 for member, node in victim.assignments.items():
+                    if node in hypo:
+                        hypo[node] += victim.members.get(member, 0)
+            for victim, drop in shrinks:
+                for member, node in drop.items():
                     if node in hypo:
                         hypo[node] += victim.members.get(member, 0)
             return hypo
 
-        plan: List[Reservation] = []
+        evicts: List[Reservation] = []
+        shrinks: List[Tuple[Reservation, Dict[str, str]]] = []
         placed = None
-        for victim in victims:
-            plan.append(victim)
-            placed = self._place_locked(
-                missing, free_with_evicted(plan), ctx
-            )
-            if placed is not None:
-                break
+        if self.shrink_before_evict:
+            for victim in victims:
+                drop = self._shrink_drop_locked(victim)
+                if not drop:
+                    continue
+                shrinks.append((victim, drop))
+                placed = self._place_locked(
+                    missing, free_with(evicts, shrinks), ctx
+                )
+                if placed is not None:
+                    break
+        if placed is None:
+            for victim in victims:
+                # a fully-evicted victim's shrink entry is superseded
+                shrinks = [(v, d) for v, d in shrinks if v is not victim]
+                evicts.append(victim)
+                placed = self._place_locked(
+                    missing, free_with(evicts, shrinks), ctx
+                )
+                if placed is not None:
+                    break
         if placed is None:
             return None
         # prune non-contributing victims: the eligibility order is by
-        # priority/age, not by where capacity is needed, so the prefix
-        # may include gangs whose eviction frees nothing the fit uses —
-        # drop every victim the plan still works without (each dropped
-        # victim is a whole gang NOT needlessly restarted)
-        for victim in list(plan):
-            trial = [v for v in plan if v is not victim]
+        # priority/age, not by where capacity is needed, so the plan may
+        # include gangs whose chips the fit never uses — drop every
+        # victim the plan still works without (shrinks first: a dropped
+        # shrink is a gang not even degraded; each dropped eviction is a
+        # whole gang NOT needlessly restarted)
+        for victim, _drop in list(shrinks):
+            trial = [(v, d) for v, d in shrinks if v is not victim]
             if self._place_locked(
-                missing, free_with_evicted(trial), ctx
+                missing, free_with(evicts, trial), ctx
             ) is not None:
-                plan = trial
-        for victim in plan:
+                shrinks = trial
+        for victim in list(evicts):
+            trial = [v for v in evicts if v is not victim]
+            if self._place_locked(
+                missing, free_with(trial, shrinks), ctx
+            ) is not None:
+                evicts = trial
+        if shrinks:
+            # shrink-ONLY this round, even when the proven plan mixes
+            # shrinks and evictions: shrunk capacity frees later (the
+            # victims' own drain -> resume transitions), so evicting now
+            # and returning pending would leave the freed slices
+            # UNRESERVED — the evicted gang's requeue could re-admit
+            # into its own freed slice and be evicted again on every
+            # retry.  Once the shrinks land, the retry re-plans: the
+            # floored victims have nothing left to shrink, so the
+            # remaining shortfall becomes a pure-eviction plan, which
+            # evicts and places atomically under this same lock.
+            for victim, _drop in shrinks:
+                # best-effort: a failed spec patch (storm) just leaves
+                # the gang pending; the retry re-plans on fresh state
+                self._request_shrink_locked(victim, preemptor=new_res)
+            return None
+        for victim in evicts:
             if not self._evict_locked(victim, preemptor=new_res):
                 # an eviction write failed (storm): abort with every
                 # remaining reservation intact — already-killed members
@@ -837,7 +1023,7 @@ class ClusterScheduler:
                 # new gang stays pending for the next sync's retry
                 return None
         # re-place against the REAL free map now that victims are gone
-        return self._place_locked(missing, free_with_evicted([]), ctx)
+        return self._place_locked(missing, free_with([], []), ctx)
 
     def _evict_locked(self, victim: Reservation, preemptor: Reservation
                       ) -> bool:

@@ -109,7 +109,7 @@ class _JobTimeline:
     __slots__ = (
         "key", "uid", "lock", "events", "decisions", "seq", "last_ts",
         "finished", "created_ts", "scheduled_ts", "running_ts",
-        "restart_since", "mttr_last",
+        "restart_since", "mttr_last", "resize_since", "resize_last",
     )
 
     def __init__(self, key: str, cap: int) -> None:
@@ -128,6 +128,8 @@ class _JobTimeline:
         self.running_ts: Optional[float] = None
         self.restart_since: Optional[float] = None
         self.mttr_last: Optional[float] = None
+        self.resize_since: Optional[float] = None
+        self.resize_last: Optional[float] = None
 
     def reset_locked(self, uid: Optional[str], ts: float) -> None:
         """A new incarnation (same ns/name, new UID) starts a fresh ring;
@@ -141,6 +143,8 @@ class _JobTimeline:
         self.running_ts = None
         self.restart_since = None
         self.mttr_last = None
+        self.resize_since = None
+        self.resize_last = None
 
 
 class FlightRecorder:
@@ -333,6 +337,23 @@ class FlightRecorder:
                 tl.finished = True
             elif ctype == "Restarting" and tl.restart_since is None:
                 tl.restart_since = ts
+        elif source == "controller" and event == "resize_requested":
+            # a retargeted resize (new generation mid-transition) keeps
+            # the ORIGINAL start: the user-visible disruption began then
+            if tl.resize_since is None:
+                tl.resize_since = ts
+        elif source == "controller" and event == "resumed":
+            if tl.resize_since is not None:
+                tl.resize_last = max(0.0, ts - tl.resize_since)
+                tl.resize_since = None
+                metrics.JOB_RESIZE_DURATION.observe(tl.resize_last)
+        elif source == "controller" and event == "reverted":
+            # only a FINAL revert (cancelled before drain) ends the
+            # transition: an admission revert is transient — the
+            # controller keeps retrying and the eventual `resumed` must
+            # still observe the full requested->resumed duration
+            if detail.get("final"):
+                tl.resize_since = None
         elif source == "controller" and event == "replicas_active":
             # repair complete: every desired replica active again — the
             # close that works even when a partially-degraded job kept
@@ -360,6 +381,10 @@ class FlightRecorder:
             out["last_restart_mttr_s"] = round(tl.mttr_last, 6)
         if tl.restart_since is not None:
             out["repair_in_progress_since"] = tl.restart_since
+        if tl.resize_last is not None:
+            out["last_resize_duration_s"] = round(tl.resize_last, 6)
+        if tl.resize_since is not None:
+            out["resize_in_progress_since"] = tl.resize_since
         return out
 
     # --------------------------------------------------------------- reads

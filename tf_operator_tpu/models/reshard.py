@@ -1,0 +1,142 @@
+"""Checkpoint resharding across mesh shapes — the elastic-resize middle.
+
+An elastic resize (engine/controller.py drain -> reshard -> resume)
+changes the gang's device count, which changes the `jax.sharding.Mesh`
+the training state lives on: a checkpoint written by 4 fsdp-sharded
+hosts cannot simply be `restore()`d by 2 — and letting XLA "fix it up"
+at restore time hides a full cross-host reshard inside the first train
+step (the SNIPPETS.md pjit contract: in/out axis_resources must match,
+or every step pays a hidden resharding collective).
+
+This module is the explicit, failure-atomic version of that move:
+
+  load at the OLD sharding -> gather to host -> save at the NEW mesh's
+  shardings
+
+with ONE placement rule (`state_shardings`, built on the same
+`pick_fsdp_dim` heuristic runtime/train.py and parallel/tp.py share) so
+the resumed train step's `in_shardings` (the restored state) and
+`out_shardings` (`make_train_step(state_shardings=...)`) are the same
+object by construction — no hidden cross-boundary resharding can sneak
+in between restore and step.
+
+Failure atomicity: `reshard_checkpoint` writes into a DESTINATION
+directory and never mutates the source.  The controller's reshard phase
+only advances (durably) after the destination save completes, so a
+crash mid-reshard finds the source checkpoint intact and re-runs the
+whole reshard — the destination is scratch until the phase machine says
+otherwise.  Re-runs overwrite a half-written destination step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tf_operator_tpu.parallel.mesh import pick_fsdp_dim
+
+
+def state_shardings(tree: Any, mesh: Mesh, min_size: int = 2**14) -> Any:
+    """Per-leaf NamedShardings for a whole train-state pytree on `mesh`:
+    every large leaf (params AND the optimizer moments shaped like them)
+    shards along its largest fsdp-divisible dim, small leaves and
+    scalars replicate.  The single placement rule the resharded save,
+    the resumed restore template, and the train step's out_shardings all
+    share — divergence here IS the hidden-reshard bug."""
+    fsdp = mesh.shape.get("fsdp", 1)
+
+    def place(x):
+        shape = tuple(getattr(x, "shape", ()) or ())
+        d = pick_fsdp_dim(shape, fsdp, min_size)
+        if d is not None:
+            spec = [None] * len(shape)
+            spec[d] = "fsdp"
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(place, tree)
+
+
+def host_gather(tree: Any) -> Any:
+    """Materialize every leaf as a host numpy array — the explicit
+    gather between "loaded at the old sharding" and "placed at the new":
+    a fully-addressable copy no mesh owns, so the new placement is a
+    plain device_put, not a cross-mesh transfer XLA must infer."""
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def place_state(tree: Any, mesh: Mesh, min_size: int = 2**14) -> Any:
+    """device_put a host pytree at `state_shardings(tree, mesh)` — the
+    second half of the reshard, shared by the checkpoint path below and
+    by in-memory resizes (tests, single-process elastic loops)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s),
+        tree,
+        state_shardings(tree, mesh, min_size=min_size),
+    )
+
+
+def reshard_checkpoint(
+    src_dir: str,
+    dst_dir: str,
+    new_mesh: Mesh,
+    step: Optional[int] = None,
+    min_size: int = 2**14,
+) -> int:
+    """Reshard the newest (or `step`'s) checkpoint under `src_dir` to
+    `new_mesh`'s shardings, written under `dst_dir`; returns the step.
+
+    The source is never touched: the resumed loop points its
+    Checkpointer at `dst_dir` and restores the exact step the drain
+    saved — step count preserved, params byte-equal modulo placement.
+    A destination that already holds the step (a crash re-run) is
+    overwritten: until the controller's phase annotation advances, the
+    destination is scratch."""
+    import orbax.checkpoint as ocp
+
+    if not dst_dir or str(dst_dir) == str(src_dir):
+        raise ValueError(
+            "reshard_checkpoint needs a destination distinct from the "
+            "source: resharding in place would destroy the only durable "
+            "copy mid-write — the opposite of failure-atomic"
+        )
+    src = ocp.CheckpointManager(src_dir)
+    try:
+        step = step if step is not None else src.latest_step()
+        if step is None:
+            raise ValueError(f"no checkpoint to reshard under {src_dir!r}")
+        payload = src.restore(step, args=ocp.args.StandardRestore())
+    finally:
+        src.close()
+    placed = place_state(host_gather(payload), new_mesh, min_size=min_size)
+    dst = ocp.CheckpointManager(dst_dir)
+    try:
+        if step in (dst.all_steps() or []):
+            dst.delete(step)
+        dst.save(step, args=ocp.args.StandardSave(placed))
+        dst.wait_until_finished()
+    finally:
+        dst.close()
+    return int(step)
+
+
+def reshard_shapes(
+    old_shape: Dict[str, int], new_shape: Dict[str, int]
+) -> Dict[str, Any]:
+    """Human/log-facing summary of a shape delta (the controller records
+    it with the `resharded` decision): per-type old -> new counts plus
+    the grow/shrink verdict."""
+    types = sorted(set(old_shape) | set(new_shape))
+    old_total = sum(old_shape.get(t, 0) for t in types)
+    new_total = sum(new_shape.get(t, 0) for t in types)
+    return {
+        "types": {
+            t: [old_shape.get(t, 0), new_shape.get(t, 0)] for t in types
+        },
+        "direction": (
+            "grow" if new_total > old_total
+            else "shrink" if new_total < old_total else "reshape"
+        ),
+    }

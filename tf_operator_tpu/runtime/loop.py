@@ -73,6 +73,12 @@ class LoopResult:
     # productive/checkpoint/replay/idle fractions + goodput, mfu when the
     # profiler was given flops_per_step/peak_flops_per_sec
     goodput: Dict[str, float] = field(default_factory=dict)
+    # the step the newest durable checkpoint holds on exit (None when no
+    # checkpointer / nothing saved).  The elastic-resize drain contract
+    # reads this: a SIGTERMed loop's final save must equal the step it
+    # actually reached, so the resharded resume loses at most the
+    # in-flight step — asserted by the resize soak/loss tests.
+    last_saved_step: Optional[int] = None
 
 
 def run_training(
@@ -149,6 +155,7 @@ def run_training(
             # process dies, even in async mode
             with profiler.goodput.checkpoint_save():
                 checkpointer.save(step, state, wait=True)
+            last_saved_step = step
         elif checkpointer is not None:
             # async interval saves may still be in flight; drain before return
             with profiler.goodput.checkpoint_save():
@@ -165,4 +172,8 @@ def run_training(
         resumed_from=resumed_from,
         last_metrics=last_metrics,
         goodput=profiler.goodput.summary(),
+        last_saved_step=(
+            last_saved_step if checkpointer is not None
+            and last_saved_step >= 0 else None
+        ),
     )

@@ -18,6 +18,9 @@ common verbs into one command:
   tpu-jobs suspend tfjob mnist             # tear pods down, keep the CR
   tpu-jobs resume tfjob mnist
   tpu-jobs scale pytorchjob elastic --replicas 6 [--replica-type Worker]
+  tpu-jobs resize tfjob mnist 4 [--replica-type Worker] [--timeout 60]
+                                           # elastic resize: patch spec,
+                                           # watch Resizing -> Running
   tpu-jobs delete tfjob mnist
   tpu-jobs version
 
@@ -368,6 +371,111 @@ class Cli:
               f"({replica_type}={replicas})")
         return 0
 
+    def resize(self, kind: str, name: str, namespace: str, replicas: int,
+               replica_type: str, timeout: float = 60.0,
+               poll_interval: float = 0.2) -> int:
+        """Elastic resize: patch the replica count (the same spec edit
+        `scale` makes) and then WATCH the operator's failure-atomic
+        transition, printing each Resizing-condition phase change
+        (ResizeStarted -> ResizeAdmitted -> ResizeDraining -> ... ->
+        Running, or ResizeReverted) as it lands.  Requires an operator
+        running with --elastic-resize for the transition to appear;
+        --timeout 0 just patches and returns (scale-and-forget)."""
+        import json as _json
+        import time as _time
+
+        from tf_operator_tpu.engine.controller import (
+            RESIZE_STATE_ANNOTATION,
+        )
+
+        client = self.client(kind)
+        before = client.get(name, namespace=namespace)
+        key = next(
+            (k for k in (before.get("spec") or {})
+             if k.endswith("ReplicaSpecs")), None,
+        )
+        current = (
+            ((before["spec"].get(key) or {}).get(replica_type) or {})
+            .get("replicas") if key else None
+        )
+        ann0 = (before.get("metadata") or {}).get("annotations") or {}
+        try:
+            state0 = _json.loads(ann0.get(RESIZE_STATE_ANNOTATION, ""))
+        except ValueError:
+            state0 = {}
+        if current == replicas:
+            if not state0 or (
+                state0.get("phase") == "done"
+                and (state0.get("to") or {}).get(replica_type) == replicas
+            ):
+                # settled at the requested shape (or never touched by an
+                # elastic operator, which would only baseline this exact
+                # shape): nothing to do or watch
+                print(f"{kind.lower()}.kubeflow.org/{name} already at "
+                      f"{replica_type}={replicas}")
+                return 0
+            # the spec already says N but the transition toward it is
+            # still in flight (an earlier request, possibly from a
+            # timed-out watch): don't re-patch, just watch it land
+            print(f"{kind.lower()}.kubeflow.org/{name} resize to "
+                  f"{replica_type}={replicas} already requested; watching")
+        else:
+            try:
+                client.scale(name, replicas, replica_type=replica_type,
+                             namespace=namespace)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            print(f"{kind.lower()}.kubeflow.org/{name} resize requested "
+                  f"({replica_type}={replicas})")
+        if timeout <= 0:
+            return 0
+        deadline = _time.monotonic() + timeout
+        last = None
+        while _time.monotonic() < deadline:
+            job = client.get(name, namespace=namespace)
+            conds = {
+                c.get("type"): c
+                for c in (job.get("status", {}) or {}).get(
+                    "conditions", []) or []
+            }
+            rc = conds.get("Resizing")
+            phase = (rc.get("reason"), rc.get("status")) if rc else None
+            if phase is not None and phase != last:
+                last = phase
+                print(f"  Resizing={phase[1]} {phase[0]}: "
+                      f"{rc.get('message', '')}")
+            # completion anchor: the DURABLE state machine reads done at
+            # the requested count.  Sound against stale state from a
+            # PREVIOUS transition: its `to` was the pre-patch shape,
+            # which the current-vs-requested pre-check above already
+            # ruled out — so done-at-the-requested-count can only be
+            # written by the operator processing THIS request (full
+            # transition or the cancel short-circuit).  A demoted
+            # Resizing condition beside a still-True Running never
+            # counts on its own.
+            ann = (job.get("metadata") or {}).get("annotations") or {}
+            try:
+                state = _json.loads(ann.get(RESIZE_STATE_ANNOTATION, ""))
+            except ValueError:
+                state = {}
+            if (
+                state.get("phase") == "done"
+                and (state.get("to") or {}).get(replica_type) == replicas
+                and conds.get("Running", {}).get("status") == "True"
+            ):
+                print(f"{name}: Running "
+                      f"({replica_type}={replicas})")
+                return 0
+            if _condition_summary(job) in ("Succeeded", "Failed"):
+                print(f"{name}: {_condition_summary(job)}")
+                return 2
+            _time.sleep(poll_interval)
+        print(f"error: timed out after {timeout:g}s waiting for the "
+              f"resize to complete (is the operator running with "
+              f"--elastic-resize?)", file=sys.stderr)
+        return 1
+
     def suspend(self, kind: str, name: str, namespace: str) -> int:
         self.client(kind).suspend(name, namespace=namespace)
         print(f"{kind.lower()}.kubeflow.org/{name} suspended")
@@ -455,6 +563,17 @@ def make_parser() -> argparse.ArgumentParser:
     pl = sub.add_parser("list", parents=[common])
     pl.add_argument("kind")
 
+    # elastic resize: scale's spec patch + a watch of the operator's
+    # drain -> reshard -> resume transition (Resizing condition phases)
+    pz = sub.add_parser("resize", parents=[common])
+    pz.add_argument("kind")
+    pz.add_argument("name")
+    pz.add_argument("replicas", type=int)
+    pz.add_argument("--replica-type", default="Worker")
+    pz.add_argument("--timeout", type=float, default=60.0,
+                    help="seconds to watch the Resizing -> Running "
+                    "transition; 0 patches the spec and returns")
+
     # timeline addresses the recorder by job KEY (ns/name) — kind-free,
     # because the flight recorder joins every kind's story in one store
     pt = sub.add_parser("timeline", parents=[common])
@@ -504,6 +623,9 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
     if args.verb == "scale":
         return cli.scale(kind, args.name, ns, args.replicas,
                          args.replica_type)
+    if args.verb == "resize":
+        return cli.resize(kind, args.name, ns, args.replicas,
+                          args.replica_type, timeout=args.timeout)
     if args.verb == "suspend":
         return cli.suspend(kind, args.name, ns)
     if args.verb == "resume":
