@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-timeline bench-elastic native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -92,6 +92,19 @@ bench-warmpool:
 bench-paged:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_paged; \
 	print(json.dumps(bench_paged(), indent=1))"
+
+# Paged decode-step sweep: pallas block-indexed kernel vs table gather
+# vs dense ring at 1/8/32 lanes x block_size 16/64 — per-step time,
+# blocks-touched accounting, token parity, and a cache_sharding row
+# asserting the paged decode block is a sharding fixpoint (zero
+# per-step resharding transfers) on a tp=2 mesh (ISSUE 13 evidence;
+# interpret-mode rows assert parity + blocks-touched, not wall-clock —
+# regression bounds in tests/test_zpagedkernel.py).  Rows land in
+# BENCH_r12.json.
+bench-paged-decode:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+	python -c "import json; from bench import bench_paged_decode; \
+	print(json.dumps(bench_paged_decode(), indent=1))"
 
 # Cluster-scheduler policy sweep: makespan + Jain fairness per
 # bin-packing policy (spread / packed / throughput_ratio) on a mixed

@@ -1229,6 +1229,215 @@ def bench_paged(gen: str = "cpu", cfg=None, n_requests: int = 12,
     return out
 
 
+def bench_paged_decode(gen: str = "cpu", cfg=None,
+                       lanes_sweep=(1, 8, 32), block_sizes=(16, 64),
+                       seq_fill: int = 48, n_steps: int = 4,
+                       repeats: int = 3):
+    """Paged decode-step cost: pallas kernel vs table gather vs dense
+    ring — ISSUE 13's perf evidence (BENCH_r12.json).
+
+    Per (lanes, block_size) point the three paths decode the SAME
+    steady state (every lane prefilled to seq_fill positions, no
+    admission churn): `step_us` is the per-token-step wall clock of
+    each path's jitted decode block, `token_parity` asserts all three
+    emit identical greedy tokens from identical state, and the
+    blocks-touched accounting is the deterministic headline — the
+    gather path materializes `positions_streamed_dense`-worth of
+    linear view per step while the kernel touches `blocks_touched`
+    blocks through the table.  On CPU the pallas rows run under
+    interpret=True: `mode` marks them, wall-clock is reported for
+    provenance but the regression bounds (tests/test_zpagedkernel.py)
+    assert parity + blocks-touched ONLY — interpret-mode timing is an
+    emulator artifact, not a kernel measurement; the TPU arm re-times
+    the same rows for real.
+
+    The cache_sharding row runs the paged decode block with the pool's
+    kv-head dim sharded over a 2-way tp mesh (block ids replicated)
+    and asserts the step is a sharding FIXPOINT: out↔in
+    axis_resources matched on every pool leaf, i.e. zero per-step
+    resharding transfers — SNIPPETS.md's pjit perf contract, the same
+    one the dense ring's TP serving keeps."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama as llm
+    from tf_operator_tpu.models import paged_attention as pk
+    from tf_operator_tpu.models import paging
+    from tf_operator_tpu.models.serving import _paged_serve_fns, _serve_fns
+
+    if cfg is None:
+        cfg = llm.tiny(dtype=jnp.float32, max_len=256)
+    model = llm.Llama(cfg)
+    key = jax.random.PRNGKey(0)
+    params = model.init(key, jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    interpret = pk._use_interpret()
+    # every timed block advances n_steps; parity + warm + repeats
+    # blocks must all stay inside the linear cache (no ring wrap on
+    # the dense arm, no table overflow on the paged arms)
+    cache_len = seq_fill + n_steps * (repeats + 3)
+    # dense decode needs a 1-row compile anyway; greedy everywhere
+    d_step, _ = _serve_fns(model, 0.0, 0, 0.0, None)
+    _, d_fill, _ = _llama_decode_fns(model)
+
+    def prefill_dense(lanes, prompts):
+        cache = llm.init_cache(cfg, lanes, cache_len)
+        _last, cache = d_fill(params, cache, prompts, jnp.int32(0))
+        return cache, _last
+
+    def prefill_paged(lanes, prompts, bs, t_blocks, fns):
+        _, p_fill, _ = fns
+        pool_n = lanes * t_blocks
+        cache = paging.init_block_pool(cfg, pool_n, bs)
+        table = jnp.stack([
+            paging.build_table(
+                list(range(1 + i * t_blocks, 1 + (i + 1) * t_blocks)),
+                t_blocks)
+            for i in range(lanes)])
+        last, cache = p_fill(params, cache, prompts, jnp.int32(0),
+                             table)
+        return cache, table, last
+
+    def time_steps(fn):
+        # fn() dispatches one decode block and returns the rebindable
+        # state; block_until_ready bounds it
+        fn()  # warm (compile)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            fn()
+        return (time.perf_counter() - t0) / (repeats * n_steps) * 1e6
+
+    rows = []
+    for lanes in lanes_sweep:
+        key, kp = jax.random.split(key)
+        prompts = jax.random.randint(kp, (lanes, seq_fill), 0,
+                                     cfg.vocab_size)
+        d_cache, last = prefill_dense(lanes, prompts)
+        tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        pos0 = jnp.full((lanes,), seq_fill, jnp.int32)
+        frozen = jnp.zeros((lanes,), bool)
+        k_fixed = jax.random.PRNGKey(7)
+
+        # dense reference tokens + timing
+        dc, _t, _p, d_toks = d_step(params, d_cache, tok0, pos0, frozen,
+                                    k_fixed, n_steps)
+        d_toks = jax.device_get(d_toks)
+        state = {"c": dc, "t": _t, "p": _p}
+
+        def d_one():
+            state["c"], state["t"], state["p"], toks = d_step(
+                params, state["c"], state["t"], state["p"], frozen,
+                k_fixed, n_steps)
+            jax.block_until_ready(toks)
+        dense_us = time_steps(d_one)
+
+        for bs in block_sizes:
+            t_blocks = paging.blocks_for(cache_len, bs)
+            row = {
+                "lanes": lanes,
+                "block_size": bs,
+                "table_slots_per_lane": t_blocks,
+                # what each path must move per decode step, per lane:
+                # gather materializes the whole table-width linear
+                # view; the kernel streams table_slots blocks through
+                # VMEM and computes on the blocks holding live
+                # positions
+                "blocks_touched_per_lane":
+                    paging.blocks_for(seq_fill + 1, bs),
+                "positions_streamed_dense_per_lane": cache_len,
+                "mode": "interpret" if interpret else "tpu",
+            }
+            kernel_us = {"dense": round(dense_us, 1)}
+            parity = {}
+            for kern in ("gather", "pallas"):
+                fns = _paged_serve_fns(model, 0.0, 0, 0.0, None, kern)
+                cache, table, last_p = prefill_paged(
+                    lanes, prompts, bs, t_blocks, fns)
+                tok_p = jnp.argmax(last_p, axis=-1).astype(jnp.int32)
+                cache, _t2, _p2, toks = fns[0](
+                    params, cache, tok_p, pos0, frozen, table, k_fixed,
+                    n_steps)
+                parity[kern] = bool(
+                    (jax.device_get(toks) == d_toks).all())
+                st = {"c": cache, "t": _t2, "p": _p2}
+
+                def p_one(fns=fns, st=st, table=table):
+                    st["c"], st["t"], st["p"], tk = fns[0](
+                        params, st["c"], st["t"], st["p"], frozen,
+                        table, k_fixed, n_steps)
+                    jax.block_until_ready(tk)
+                kernel_us[kern] = round(time_steps(p_one), 1)
+            row["step_us"] = kernel_us
+            row["token_parity_pallas_gather_dense"] = (
+                parity["pallas"] and parity["gather"])
+            rows.append(row)
+
+    out = {
+        "config": f"tiny {cfg.n_layers}L {cfg.n_heads}q:{cfg.n_kv_heads}kv",
+        "seq_fill": seq_fill,
+        "n_steps_per_block": n_steps,
+        "interpret_mode": interpret,
+        "rows": rows,
+        "note": ("interpret-mode pallas timing is an emulator "
+                 "artifact; regression bounds assert parity + "
+                 "blocks-touched (deterministic), TPU arm re-times"),
+    }
+
+    # ---- cache_sharding row: the paged decode block as a sharding
+    # fixpoint (zero per-step resharding transfers) on a 2-way tp mesh
+    if len(jax.devices()) >= 2:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        import numpy as np
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        pool_sh = NamedSharding(mesh,
+                                PartitionSpec(None, None, "tp", None))
+        bs = block_sizes[0]
+        t_blocks = paging.blocks_for(cache_len, bs)
+        lanes = lanes_sweep[min(1, len(lanes_sweep) - 1)]
+        key, kp = jax.random.split(key)
+        prompts = jax.random.randint(kp, (lanes, seq_fill), 0,
+                                     cfg.vocab_size)
+        fns = _paged_serve_fns(model, 0.0, 0, 0.0, None, "gather")
+        cache, table, last_p = prefill_paged(lanes, prompts, bs,
+                                             t_blocks, fns)
+        cache = jax.device_put(cache, pool_sh)
+        tok_p = jnp.argmax(last_p, axis=-1).astype(jnp.int32)
+        out_cache, *_rest = fns[0](
+            params, cache, tok_p,
+            jnp.full((lanes,), seq_fill, jnp.int32),
+            jnp.zeros((lanes,), bool), table, jax.random.PRNGKey(7),
+            n_steps)
+        fixpoint = all(
+            leaf.sharding.is_equivalent_to(pool_sh, leaf.ndim)
+            for layer in out_cache for leaf in layer)
+        out["cache_sharding"] = {
+            "mesh": "tp=2",
+            "lanes": lanes,
+            "block_size": bs,
+            "pool_spec": str(pool_sh.spec),
+            "step_is_sharding_fixpoint": bool(fixpoint),
+            # matched out<->in axis_resources on a donated buffer IS
+            # the zero-transfer witness: nothing to reshard between
+            # steps
+            "resharding_transfers_per_step": 0 if fixpoint else None,
+        }
+    else:
+        out["cache_sharding"] = {
+            "skipped": "needs >= 2 devices "
+                       "(XLA_FLAGS=--xla_force_host_platform_device_"
+                       "count=2 on CPU)"}
+    return out
+
+
+def _llama_decode_fns(model):
+    """Greedy-keyed llama chunk writers shared by the decode bench
+    arms (one compile-cache entry)."""
+    from tf_operator_tpu.models import llama as llm
+
+    return llm._decode_fns(model, 0.0, 0, 0.0, -1, None)
+
+
 def _parity(f_out, f_grads, r_out, r_grads):
     """(fwd_rel, grad_max_rel, ok) between two (loss, grads) pairs."""
     import jax

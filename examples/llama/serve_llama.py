@@ -80,6 +80,14 @@ def main(argv=None) -> int:
     ap.add_argument("--pool-blocks", type=int, default=0,
                     help="block-pool capacity; 0 = dense-equivalent "
                          "default (every lane can hold the worst case)")
+    ap.add_argument("--paged-kernel", default="auto",
+                    choices=["auto", "pallas", "gather"],
+                    help="paged read path: 'pallas' = block-indexed "
+                         "pallas decode kernel (interpret-mode on "
+                         "CPU), 'gather' = table-gathered linear view "
+                         "(the parity oracle), 'auto' = pallas on TPU "
+                         "/ gather on CPU and under tensor "
+                         "parallelism")
     ap.add_argument("--prefill-chunk", type=int, default=0)
     ap.add_argument("--prefill-chunks-per-sync", type=int, default=0,
                     help="admission-stall bound: stream at most this "
@@ -160,8 +168,11 @@ def main(argv=None) -> int:
         kw.update(paged=True, block_size=args.block_size)
         if args.pool_blocks:
             kw["pool_blocks"] = args.pool_blocks
+        if args.paged_kernel != "auto":
+            kw["paged_kernel"] = args.paged_kernel
         print(f"paged KV cache: block_size={args.block_size}, "
-              f"pool_blocks={args.pool_blocks or 'auto'}")
+              f"pool_blocks={args.pool_blocks or 'auto'}, "
+              f"kernel={args.paged_kernel}")
 
     t0 = time.perf_counter()
     results = serve_loop(model, params, requests, slots=args.slots,

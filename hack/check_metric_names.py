@@ -49,6 +49,12 @@ _REQUIRED_FAMILIES = {
     # elastic resize (ISSUE 12): resize_requested -> resumed per
     # transition, derived by the flight recorder like the families above
     "tpu_operator_job_resize_duration_seconds": "Histogram",
+    # paged-attention kernel rollout (ISSUE 13): the pallas/gather
+    # per-request split and the sliding-window eviction rate —
+    # docs/monitoring.md's kernel-path-ratio and window-eviction PromQL
+    # read these by name
+    "tpu_operator_serving_paged_kernel_requests_total": "Counter",
+    "tpu_operator_serving_kv_window_evicted_blocks_total": "Counter",
 }
 
 

@@ -401,6 +401,12 @@ def test_bench_paged_bounds_hold_on_tiny_config():
         assert p[k] > 0, k
 
 
+# BENCH_r12's (ISSUE 13) bench_paged_decode regression bounds live in
+# tests/test_zpagedkernel.py (test_bench_paged_decode_bounds...): the
+# arm compiles interpret-mode pallas kernels, and this file sorts into
+# tier-1's scarce early-alphabet budget.
+
+
 def test_bench_llama_decode_batch_sweep_tiny():
     """The batch-sweep branch: result reuse for the headline batch,
     fresh-prompt points for the others, mode markers on every entry."""

@@ -341,16 +341,29 @@ def test_paged_validation():
                        match=r"request 1: .*needs 12 private blocks"):
         serve_loop(model, params, _prompts(cfg, [6, 40]), paged=True,
                    block_size=4, pool_blocks=8, max_new_tokens=8)
-    wcfg, wmodel, wparams = _setup(max_len=256, sliding_window=32)
-    with pytest.raises(ValueError, match="sliding-window"):
-        serve_loop(wmodel, wparams, _prompts(wcfg, [6]), paged=True,
+    # paged_kernel is a paged knob; unknown values are refused too
+    with pytest.raises(ValueError, match="paged_kernel"):
+        serve_loop(model, params, p, paged_kernel="gather",
                    max_new_tokens=4)
-    with pytest.raises(ValueError, match="cache_sharding"):
+    with pytest.raises(ValueError, match="paged_kernel"):
+        serve_loop(model, params, p, paged=True,
+                   paged_kernel="vectorized", max_new_tokens=4)
+    # the two ISSUE 9 refusals are LIFTED (window and cache_sharding
+    # now compose — tests/test_zpagedkernel.py pins them); what remains
+    # refused, with the block math: window x speculation (one table,
+    # two moduli) and explicit pallas x cache_sharding
+    wcfg, wmodel, wparams = _setup(max_len=256, sliding_window=32)
+    d_model, d_params = _draft_setup(wcfg)
+    with pytest.raises(ValueError, match=r"speculation.*blocks"):
+        serve_loop(wmodel, wparams, _prompts(wcfg, [6]), paged=True,
+                   block_size=4, draft=d_model, draft_params=d_params,
+                   max_new_tokens=4)
+    with pytest.raises(ValueError, match="pallas.*cache_sharding"):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
         mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("tp",))
         sh = NamedSharding(mesh, PartitionSpec(None))
         serve_loop(model, params, p, paged=True, cache_sharding=sh,
-                   max_new_tokens=4)
+                   paged_kernel="pallas", max_new_tokens=4)
 
 
 def test_dense_longest_prompt_error_names_request():

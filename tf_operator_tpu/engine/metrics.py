@@ -728,3 +728,19 @@ SERVING_ADMISSION_BLOCKED = Counter(
     "request's worst case — the request waits instead of OOMing "
     "(sampled once per serve-loop iteration while blocked)",
 )
+SERVING_PAGED_KERNEL_REQUESTS = Counter(
+    f"{PREFIX}_serving_paged_kernel_requests_total",
+    "Paged requests finished, labeled by the read path that served "
+    "them (kernel=pallas: the block-indexed paged-attention kernel, "
+    "models/paged_attention.py; kernel=gather: the table-gathered "
+    "linear-view oracle) — the pallas/gather ratio is the "
+    "fast-path-adoption signal after a rollout",
+)
+SERVING_KV_WINDOW_EVICTED = Counter(
+    f"{PREFIX}_serving_kv_window_evicted_blocks_total",
+    "KV block epochs retired by sliding-window rotation: a windowed "
+    "lane's modular table wrapped past a block's positions — private "
+    "blocks are reused in place, shared prefix blocks are dereferenced "
+    "(and copied only while still partially visible); compare with "
+    "the CoW-copy rate to see window pressure vs prefix-boundary cost",
+)

@@ -366,7 +366,7 @@ class GqaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, angles, cache=None, pos=None, wrap_write=False,
-                 block_table=None):
+                 block_table=None, paged_kernel="pallas"):
         cfg = self.cfg
         dense = functools.partial(
             nn.DenseGeneral, dtype=cfg.dtype, use_bias=False
@@ -385,28 +385,39 @@ class GqaAttention(nn.Module):
                      if getattr(pos, "ndim", 0) == 1 else pos + steps)
             if block_table is not None:
                 # PAGED path (models/paging.py): the cache leaves are
-                # block pools [N, bs, KV, D]; writes scatter through the
-                # lane tables and attention runs on the table-gathered
-                # linear view — position masking is unchanged, which is
-                # the dense-parity argument (serving.serve_loop paged=)
-                if cfg.sliding_window is not None:
-                    # fail loudly at the mechanism's own depth (the
-                    # attention_fn convention below): a linear block
-                    # table has no modular seam, and silently attending
-                    # the full context would be wrong, not approximate
-                    raise ValueError(
-                        f"paged decode does not support sliding_window "
-                        f"{cfg.sliding_window} — use the dense ring")
+                # block pools [N, bs, KV, D]; writes scatter through
+                # the lane tables.  The read is paged_kernel's choice:
+                # "pallas" indexes blocks in place from the pool
+                # (models/paged_attention.py — no linear view, ever);
+                # "gather" materializes the table-gathered linear view
+                # and runs the unchanged dense attention (the oracle
+                # path).  Sliding-window models ride MODULAR tables:
+                # the folded view is a ring of table_width * bs slots
+                # and the dense ring formula (with window=) does the
+                # seam — dense parity by the same masking argument.
                 from tf_operator_tpu.models import paging as _paging
 
+                modular = cfg.sliding_window is not None
                 k_cache = _paging.paged_cache_write(k_cache, k, pos,
-                                                    block_table)
+                                                    block_table, modular)
                 v_cache = _paging.paged_cache_write(v_cache, v, pos,
-                                                    block_table)
-                k_lin = _paging.gather_blocks(k_cache, block_table)
-                v_lin = _paging.gather_blocks(v_cache, block_table)
-                out = _cached_attention(q, k_lin, v_lin, q_pos,
-                                        k_lin.shape[1], window=None)
+                                                    block_table, modular)
+                from tf_operator_tpu.models import paged_attention as _pk
+
+                if (paged_kernel == "pallas"
+                        and _pk.fits_kernel(l, cfg.n_heads,
+                                            cfg.n_kv_heads)):
+                    out = _pk.paged_attention(
+                        q, k_cache, v_cache, block_table, pos,
+                        window=cfg.sliding_window)
+                else:
+                    # gather oracle, and the fallback for contraction
+                    # widths past the kernel's VMEM budget
+                    k_lin = _paging.gather_blocks(k_cache, block_table)
+                    v_lin = _paging.gather_blocks(v_cache, block_table)
+                    out = _cached_attention(q, k_lin, v_lin, q_pos,
+                                            k_lin.shape[1],
+                                            window=cfg.sliding_window)
                 proj = dense(features=cfg.d_model, axis=(-2, -1),
                              name="out")
                 return proj(out), (k_cache, v_cache)
@@ -545,7 +556,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, angles, cache=None, pos=None, wrap_write=False,
-                 block_table=None):
+                 block_table=None, paged_kernel="pallas"):
         cfg = self.cfg
         norm = functools.partial(
             nn.RMSNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype
@@ -555,7 +566,7 @@ class LlamaBlock(nn.Module):
                else SwiGlu(cfg, name="mlp"))
         if cache is not None:
             a, cache = attn(norm(name="ln1")(x), angles, cache, pos,
-                            wrap_write, block_table)
+                            wrap_write, block_table, paged_kernel)
             x = x + a
             h = norm(name="ln2")(x)
             y = mlp(h, decode=True) if self.use_moe else mlp(h)
@@ -574,7 +585,8 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = True, return_hidden: bool = False,
                  positions=None, cache=None, cache_pos=None,
-                 wrap_cache_write: bool = False, block_table=None):
+                 wrap_cache_write: bool = False, block_table=None,
+                 paged_kernel: str = "pallas"):
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
@@ -609,7 +621,8 @@ class Llama(nn.Module):
             blk = block(cfg, use_moe=use_moe, name=f"block{i}")
             if decode:
                 x, layer_cache = blk(x, angles, cache[i], cache_pos,
-                                     wrap_cache_write, block_table)
+                                     wrap_cache_write, block_table,
+                                     paged_kernel)
                 new_cache.append(layer_cache)
             else:
                 x = blk(x, angles)

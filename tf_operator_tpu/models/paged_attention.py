@@ -1,0 +1,273 @@
+"""Pallas paged-attention decode kernel — block-indexed KV, no gather.
+
+PR 9's block pool made paged serving a MEMORY win; this kernel makes it
+a SPEED win.  The gather path (models/paging.gather_blocks) materializes
+a per-lane LINEAR view of the cache — `pool[table]` — before running the
+unchanged dense attention, which on a real TPU is a full cache-sized
+HBM gather per generated token.  This kernel consumes the block pool
+`[N+1, bs, KV, D]` and the per-lane block tables DIRECTLY:
+
+  - grid over (lane, kv_head, table slot): the table rides as a
+    SCALAR-PREFETCH operand (pltpu.PrefetchScalarGridSpec), so the K/V
+    BlockSpec index maps resolve `table[lane, slot]` BEFORE each grid
+    step and pallas's double-buffered pipeline DMAs exactly that one
+    block from HBM into VMEM — blocks stream through VMEM in table
+    order, and no linear K/V copy ever exists.
+  - ONLINE SOFTMAX across the streamed blocks (the flash-attention
+    recipe, one block at a time): running max / running sum / f32
+    accumulator live in VMEM scratch that persists across the table
+    dimension, finalized at the last slot.
+  - the SCRATCH block (id 0, models/paging.SCRATCH_BLOCK) contributes
+    masked -inf scores: frozen lanes (all-scratch tables) and table
+    padding need no special casing — an all-masked row finalizes
+    through the l==0 guard to a finite zero vector, which the serve
+    loop's frozen-lane token mask discards anyway.
+  - POSITION VISIBILITY is the dense ring formula verbatim
+    (llama._cached_attention): slot position `t*bs + off` resolves to
+    global position `q - mod(q - slot_pos, ring)` with `ring = T*bs`.
+    For linear tables (ring >= every position) that is exactly
+    "written and causal"; for MODULAR window tables (serve_loop paged
+    sliding-window) the same formula handles the wrap seam, and the
+    optional `window` mask hides out-of-band positions — one kernel,
+    both table disciplines, parity with dense by the same argument the
+    gather path makes.
+  - GQA is native: one grid program owns one kv head and contracts its
+    whole query group [L*G, D] against each [bs, D] block — the shared
+    kv head is read once, never repeated.
+  - int8 KV pools (models/quant.QTensor leaves) dequantize IN the
+    kernel, per block: payload and per-(position, head) scales ride
+    separate BlockSpecs through the same table index map, so int8 is
+    what streams from HBM — the same contract as the dense ring's
+    fused dequant.
+
+MULTI-TOKEN q (the chunked-prefill / speculative-verify contraction) is
+the same kernel at L > 1: query rows become [L*G, bs] score tiles with
+per-row positions `base + l` (positions are consecutive on every paged
+write path).  `_MAX_Q_ROWS` bounds the VMEM the q tile may take; above
+it callers fall back to the gather path (prefill is MXU-bound, not
+gather-bound, so nothing is lost).
+
+On CPU the kernel runs under `interpret=True` (the flash kernel's
+convention), which is how the tier-1 parity matrix pins
+token-identity to the dense ring without TPU hardware; the gather path
+remains selectable (`paged_kernel="gather"`) as the oracle.
+
+No reference counterpart (the reference has no serving code at all,
+SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# q tile rows (L * group) beyond which the caller should prefer the
+# gather path: the kernel holds q [rows, D], the accumulator [rows, D]
+# and a [rows, bs] score tile in VMEM — at 1024 rows x D=128 that is
+# ~1.5 MB f32, comfortably inside the ~16 MB budget; a 8k-token prefill
+# chunk would not be.
+_MAX_Q_ROWS = 1024
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params(interpret: bool):
+    """lane and kv-head grid dims are parallel (disjoint outputs); the
+    streamed table dim is sequential (scratch carries the softmax state
+    across it)."""
+    if interpret:
+        return None
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    except Exception:  # older pallas: run without the hint
+        return None
+
+
+def _kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+            m_scr, l_scr, acc_scr, *, bs: int, group: int, n_slots: int,
+            ring: int, window: Optional[int], scale: float,
+            k_scale_ref=None, v_scale_ref=None):
+    """One (lane, kv_head, table slot) step: score q's group rows
+    against the slot's block, mask by visibility + scratch, fold into
+    the online-softmax accumulators; finalize at the last slot."""
+    b, t = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    block_id = tbl_ref[b, t]
+    q = q_ref[0, 0]                                   # [LG, D]
+    k = k_ref[0, :, 0, :]                              # [bs, D]
+    v = v_ref[0, :, 0, :]
+    if k_scale_ref is not None:
+        # int8 pool: dequantize the block in VMEM, exactly the dense
+        # read's math (QTensor.dequantize: f32 payload * scale -> dtype)
+        k = (k.astype(jnp.float32)
+             * k_scale_ref[0, :, 0, :]).astype(q.dtype)
+        v = (v.astype(jnp.float32)
+             * v_scale_ref[0, :, 0, :]).astype(q.dtype)
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # [LG, bs]
+    # per-row query position: rows are (l, g) with position base + l —
+    # every paged write path produces consecutive positions, so the
+    # scalar base per lane is the whole story
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+    q_pos = pos_ref[b] + rows                          # [LG, bs]
+    slot_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + t * bs
+    # the dense ring visibility formula (llama._cached_attention): a
+    # slot's last-written global position; negative = unwritten, and
+    # for linear tables (ring >= every position) this reduces to
+    # slot_pos <= q_pos — written-and-causal
+    k_global = q_pos - jnp.mod(q_pos - slot_pos, ring)
+    mask = k_global >= 0
+    if window is not None:
+        mask &= k_global > q_pos - window
+    mask &= block_id != 0  # scratch: frozen lanes / table padding
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev = m_scr[:, 0]
+    l_prev = l_scr[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    # zero masked probabilities EXPLICITLY: while the running max is
+    # still NEG_INF (a fully-masked prefix of the table — frozen lane,
+    # or every block so far outside the window band), exp(s - m) would
+    # be exp(0) = 1 and the row would finalize to an average of
+    # garbage V instead of through the l == 0 guard below; once a real
+    # score has been seen, masked entries underflow to 0 anyway and
+    # this is a no-op
+    p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    l_scr[:, 0] = l_prev * corr + jnp.sum(p, axis=1)
+    m_scr[:, 0] = m_new
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_scr[:] = acc_scr[:] * corr[:, None] + pv
+
+    @pl.when(t == n_slots - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # all-masked (frozen) -> 0
+        o_ref[0, 0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, table, pos, *,
+                    window: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Block-indexed paged attention.
+
+    q:            [B, L, H, D] post-RoPE queries (L new positions).
+    k_pool/v_pool:[N+1, bs, KV, D] block pools (models/paging
+                  .init_block_pool; id 0 = scratch), or QTensor pools
+                  (int8 payload + per-(position, head) f32 scales).
+    table:        [B, T] int32 per-lane block tables (position p lives
+                  in table[p // bs] for linear tables, table[(p // bs)
+                  % T] for modular window tables — the kernel's ring
+                  formula covers both).
+    pos:          scalar or [B] int32 — global position of q[:, 0];
+                  row l attends positions visible to `pos + l`.
+    window:       sliding-window width (cfg.sliding_window); None =
+                  full causal.
+
+    Returns [B, L, H, D], numerically the gather path's
+    `_cached_attention(q, gather_blocks(k), gather_blocks(v), ...)`
+    computed without ever materializing the linear view.
+    """
+    from tf_operator_tpu.models.quant import QTensor
+
+    b, l, h, d = q.shape
+    quantized = isinstance(k_pool, QTensor)
+    kv_heads = (k_pool.q if quantized else k_pool).shape[2]
+    bs = (k_pool.q if quantized else k_pool).shape[1]
+    if h % kv_heads:
+        raise ValueError(
+            f"q heads {h} not divisible by kv heads {kv_heads}")
+    group = h // kv_heads
+    n_slots = table.shape[1]
+    ring = n_slots * bs
+    lg = l * group
+    if interpret is None:
+        interpret = _use_interpret()
+    scale = 1.0 / (d ** 0.5)
+    if getattr(pos, "ndim", 0) == 0:
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    pos = pos.astype(jnp.int32)
+
+    # [B, L, H, D] -> [B, KV, L*G, D]: one grid program owns one kv
+    # head's whole query group; rows are (l, g) pairs, row // G = l
+    q3 = (q.reshape(b, l, kv_heads, group, d)
+          .transpose(0, 2, 1, 3, 4)
+          .reshape(b, kv_heads, lg, d))
+
+    num_prefetch = 2  # table + positions resolve index maps pre-DMA
+    q_spec = pl.BlockSpec((1, 1, lg, d), lambda i, j, t, tbl, p: (i, j, 0, 0))
+    blk_spec = pl.BlockSpec(
+        (1, bs, 1, d), lambda i, j, t, tbl, p: (tbl[i, t], 0, j, 0))
+    in_specs = [q_spec, blk_spec, blk_spec]
+    args = [table, pos, q3]
+    if quantized:
+        scl_spec = pl.BlockSpec(
+            (1, bs, 1, 1), lambda i, j, t, tbl, p: (tbl[i, t], 0, j, 0))
+        in_specs += [scl_spec, scl_spec]
+        args += [k_pool.q, v_pool.q, k_pool.scale, v_pool.scale]
+        kern = functools.partial(
+            _int8_kernel_adapter, bs=bs, group=group, n_slots=n_slots,
+            ring=ring, window=window, scale=scale)
+    else:
+        args += [k_pool, v_pool]
+        kern = functools.partial(
+            _kernel, bs=bs, group=group, n_slots=n_slots, ring=ring,
+            window=window, scale=scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_prefetch,
+        grid=(b, kv_heads, n_slots),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, lg, d), lambda i, j, t, tbl, p: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((lg, 1), jnp.float32),   # running max
+            pltpu.VMEM((lg, 1), jnp.float32),   # running sum
+            pltpu.VMEM((lg, d), jnp.float32),   # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, lg, d), q.dtype),
+        interpret=interpret,
+        compiler_params=_compiler_params(interpret),
+    )(*args)
+    return (out.reshape(b, kv_heads, l, group, d)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(b, l, h, d))
+
+
+def _int8_kernel_adapter(tbl_ref, pos_ref, q_ref, k_ref, v_ref,
+                         k_scale_ref, v_scale_ref, o_ref,
+                         m_scr, l_scr, acc_scr, **kw):
+    """Ref-order shim: pallas passes scale refs after the payload refs
+    and before the output; the core kernel takes them by keyword."""
+    _kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+            m_scr, l_scr, acc_scr,
+            k_scale_ref=k_scale_ref, v_scale_ref=v_scale_ref, **kw)
+
+
+def fits_kernel(l: int, n_heads: int, n_kv_heads: int) -> bool:
+    """Whether an L-token contraction's q tile fits the kernel's VMEM
+    budget (the chunked-prefill variant is the same kernel at L > 1);
+    callers fall back to the gather path above the bound."""
+    return l * (n_heads // n_kv_heads) <= _MAX_Q_ROWS

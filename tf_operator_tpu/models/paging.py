@@ -38,9 +38,20 @@ device dispatches, exactly like the serve loop's slot occupancy.  int8
 KV (models/quant.QTensor pool leaves) composes: writes quantize
 per-(position, head) before the block scatter, reads gather q and scale
 and dequantize into the attention einsum — the same contract as the
-dense ring.  Sliding-window models keep the dense O(window) ring
-(serve_loop refuses paged+window loudly): a linear block table has no
-modular seam, and the ring is already the right memory shape there.
+dense ring.  Sliding-window models use MODULAR tables: a window lane's
+table is a ring of `ring_blocks` slots (position p lives in slot
+(p // bs) % ring_blocks — the paged twin of the dense ring's p % C),
+so window memory stays O(window) blocks and eviction is a refcount
+decrement of rotated-out shared blocks (plan_window_request /
+WindowRotation below; the read side is the same ring-visibility
+formula the dense path uses, in gather_blocks' consumer and in the
+pallas kernel alike).
+
+Reads have two disciplines: gather_blocks materializes the per-lane
+linear view (the ORACLE path — correct everywhere, a cache-sized HBM
+gather per step on real TPU), and models/paged_attention.py indexes
+blocks in place from the pool via the table (the fast path — see that
+module).  serve_loop(paged_kernel=...) picks.
 
 No reference counterpart (the reference has no serving code at all,
 SURVEY.md §5.7).
@@ -166,10 +177,23 @@ def init_block_pool(cfg, num_blocks: int, block_size: int, dtype=None,
             for _ in range(cfg.n_layers)]
 
 
-def _block_write(pool, val, pos, table):
+def _block_write(pool, val, pos, table, modular: bool = False):
     """Scatter val [B, L, ...] into pool [N, bs, ...] at global
     positions pos..pos+L-1 per row, routed through table [B, T]:
     position p lands in block table[b, p // bs] at offset p % bs.
+
+    modular=True (sliding-window tables): the table is a RING of T
+    blocks and the slot index wraps, (p // bs) % T — the paged twin of
+    the dense ring's `pos % C` slot rule; the serve loop's rotation
+    bookkeeping (WindowRotation) guarantees every wrapped-onto slot is
+    lane-private by the time a write reaches it.  LINEAR tables must
+    NOT wrap: a live lane's end-of-block overshoot (decode blocks run
+    to the block edge past EOS/budget) writes positions past its worst
+    case, which under a modulo would land in table slot 0 — a SHARED
+    prefix block when one exists.  They clamp to the last column
+    instead: the lane's own last block (garbage past its budget, which
+    the position mask never shows a query) or, for a frozen lane
+    pinned past its zeroed table, scratch.
 
     pos is a scalar (single-row prefill) or a vector [B] (per-lane
     decode).  NOT unique_indices: every frozen lane's table is all
@@ -184,28 +208,26 @@ def _block_write(pool, val, pos, table):
         p = pos[:, None] + steps[None, :]                     # [B, L]
     else:
         p = jnp.broadcast_to(pos + steps[None, :], (b, l))    # [B, L]
-    # out-of-table positions (a frozen lane pinned past its zeroed
-    # table) clamp to the last column, which for frozen lanes is
-    # scratch; live lanes' allocations cover their worst case by the
-    # serve loop's admission gate
-    bidx = jnp.take_along_axis(table, jnp.minimum(p // bs,
-                                                  table.shape[1] - 1),
-                               axis=1)                        # [B, L]
+    slot = (jnp.mod(p // bs, table.shape[1]) if modular
+            else jnp.minimum(p // bs, table.shape[1] - 1))
+    bidx = jnp.take_along_axis(table, slot, axis=1)           # [B, L]
     off = jnp.mod(p, bs)
     return pool.at[bidx, off].set(val.astype(pool.dtype))
 
 
-def paged_cache_write(pool, val, pos, table):
+def paged_cache_write(pool, val, pos, table, modular: bool = False):
     """One K or V block-pool write; int8 pools (QTensor leaves) quantize
     at the write with per-(position, head) scales — the same pipeline
-    as the dense ring's _cache_write, targeting blocks."""
+    as the dense ring's _cache_write, targeting blocks.  modular routes
+    sliding-window ring tables (see _block_write)."""
     from tf_operator_tpu.models.quant import QTensor, quantize_tensor
 
     if isinstance(pool, QTensor):
         qv = quantize_tensor(val, axes=(3,))  # [B,L,KV,D]: scale [B,L,KV,1]
-        return QTensor(q=_block_write(pool.q, qv.q, pos, table),
-                       scale=_block_write(pool.scale, qv.scale, pos, table))
-    return _block_write(pool, val, pos, table)
+        return QTensor(
+            q=_block_write(pool.q, qv.q, pos, table, modular),
+            scale=_block_write(pool.scale, qv.scale, pos, table, modular))
+    return _block_write(pool, val, pos, table, modular)
 
 
 def gather_blocks(pool, table):
@@ -250,6 +272,101 @@ def build_table(ids: Sequence[int], width: int,
         raise ValueError(
             f"table of {len(ids)} blocks exceeds width {width}")
     return jnp.asarray(list(ids) + [pad] * (width - len(ids)), jnp.int32)
+
+
+def plan_window_request(prompt_len: int, max_new_tokens: int,
+                        block_size: int, ring_blocks: int,
+                        prefix_len: int = 0, write_slack: int = 0):
+    """Admission block math for a SLIDING-WINDOW lane over a modular
+    table of `ring_blocks` slots: (needed slots, shared prefix blocks,
+    private blocks to reserve, needs boundary CoW, shared blocks the
+    ring will rotate out).
+
+    The lane touches at most ring_blocks slots regardless of sequence
+    length (the window bound — the whole point).  Shared prefix blocks
+    initially occupy their identity slots (the prefix fits the ring,
+    validated by the serve loop); when the ring wraps back onto a
+    shared slot the lane swaps in a PRIVATE shadow block (the shared
+    block is read-only — other lanes may still be attending it) and
+    drops its reference: eviction as a refcount decrement.  Those
+    shadow blocks are reserved HERE, at admission, so the memory gate's
+    worst case is exact and rotation can never fail an allocation
+    mid-decode.
+
+    write_slack: extra positions the device may write PAST the worst
+    case — decode blocks run to the block edge after EOS/budget
+    (serve_loop's steps_per_sync - 1 overshoot), and those writes wrap
+    the modular table too, so the shadows must cover them."""
+    seq = prompt_len + max_new_tokens + write_slack
+    last_block = (seq - 1) // block_size
+    needed = min(last_block + 1, ring_blocks)
+    shared = min(prefix_len // block_size, needed)
+    cow = prefix_len % block_size != 0
+    rotated = (max(0, min(shared, last_block - ring_blocks + 1))
+               if last_block >= ring_blocks else 0)
+    private = needed - shared + rotated
+    return needed, shared, private, cow, rotated
+
+
+class WindowRotation:
+    """Host-side modular-table bookkeeping for ONE sliding-window lane.
+
+    Owns the slot -> block-id map and the pre-reserved shadow blocks;
+    `advance(upto_pos, q_min)` walks every block index the lane is
+    about to write and returns the table edits the serve loop must
+    apply BEFORE dispatching that write:
+
+      - a PRIVATE slot whose old epoch retires is reused in place
+        (ring semantics — the dense path's slot overwrite, no edit);
+      - a SHARED (prefix) slot is swapped to a shadow private block and
+        the shared id is returned for decref — eviction by refcount.
+        When any of the old block's positions is still inside a live
+        query's window (q_min's band), the shadow must first COPY the
+        shared content (copy_block) so not-yet-overwritten offsets stay
+        readable — the window analogue of the boundary CoW; fully
+        out-of-window shared blocks decref WITHOUT a copy.
+
+    Everything here is allocator arithmetic between device dispatches;
+    the property tests in tests/test_zpagedkernel.py drive it directly.
+    """
+
+    def __init__(self, slot_ids: List[int], shared_count: int,
+                 shadows: List[int], block_size: int,
+                 window: int) -> None:
+        self.slots = list(slot_ids)        # slot -> block id (0 = scratch)
+        self.ring = len(slot_ids)
+        # which slots still hold a SHARED (read-only) block
+        self.shared_slots = set(range(shared_count))
+        self.shadows = list(shadows)       # pre-reserved private ids
+        self.bs = block_size
+        self.window = window
+        self.next_block = self.ring        # first block index that wraps
+
+    def advance(self, upto_pos: int, q_min: int):
+        """Handle every wrap up to (and including) the block holding
+        `upto_pos`; returns (edits, released, evicted) where edits is
+        [(slot, new_id, copy_src | None)], released the shared ids to
+        decref, evicted the count of retired block epochs."""
+        edits, released, evicted = [], [], 0
+        last = upto_pos // self.bs
+        while self.next_block <= last:
+            j = self.next_block
+            slot = j % self.ring
+            evicted += 1
+            if slot in self.shared_slots:
+                old = self.slots[slot]
+                new = self.shadows.pop()
+                # old epoch covers positions [(j - ring)*bs, ... +bs);
+                # copy iff any of them is still visible to a query at
+                # q_min or later (q - window < k_pos)
+                old_max = (j - self.ring) * self.bs + self.bs - 1
+                copy_src = old if old_max > q_min - self.window else None
+                self.slots[slot] = new
+                self.shared_slots.discard(slot)
+                released.append(old)
+                edits.append((slot, new, copy_src))
+            self.next_block += 1
+        return edits, released, evicted
 
 
 def plan_request(prompt_len: int, max_new_tokens: int, headroom: int,

@@ -50,8 +50,12 @@ isolated llama.generate call (tests/test_serving.py) — batching,
 admission order, speculation, and paging change throughput only.
 Composes with kv_quant (int8 caches insert through the same tree
 scatter; int8 block pools quantize at the block write) and
-sliding-window rings (dense mode; paged mode refuses windows — a
-linear block table has no modular seam).
+sliding-window rings (dense mode's O(window) ring, or paged mode's
+MODULAR tables — a ring of blocks with eviction as a refcount
+decrement, models/paging.WindowRotation).  The paged read path is
+selectable: the pallas block-indexed kernel
+(models/paged_attention.py, the raw-speed path) or the table-gather
+linear view (the parity oracle) — serve_loop(paged_kernel=...).
 
 No reference counterpart (the reference has no serving code at all,
 SURVEY.md §5.7).
@@ -178,13 +182,15 @@ def _spec_serve_fns(model, draft, k: int, temperature: float, top_k: int,
 
 @functools.lru_cache(maxsize=8)
 def _paged_serve_fns(model, temperature: float, top_k: int, top_p: float,
-                     params_transform=None):
+                     params_transform=None, paged_kernel: str = "pallas"):
     """Jitted (step, chunk_fill, chunk_write) for PAGED serving: the
     same decode block / prefill writers as _serve_fns + llama's chunk
     writers, with every cache op routed through a block table
     (models/paging.py).  There is no insert_row — prefill writes land
     directly in the admitted lane's blocks of the one shared pool, so
-    admission copies nothing."""
+    admission copies nothing.  paged_kernel picks the read path
+    ("pallas" block-indexed kernel / "gather" linear-view oracle —
+    llama.GqaAttention's knob; part of the compile-cache key)."""
     xform = params_transform or (lambda p: p)
 
     @functools.partial(jax.jit, donate_argnums=(1,), static_argnums=(7,))
@@ -197,7 +203,8 @@ def _paged_serve_fns(model, temperature: float, top_k: int, top_p: float,
             cache, tok, pos = carry
             logits, cache = model.apply(
                 {"params": xform(params)}, tok[:, None], cache=cache,
-                cache_pos=pos, block_table=table)
+                cache_pos=pos, block_table=table,
+                paged_kernel=paged_kernel)
             nxt = _llama._select_token(logits[:, 0], temperature, k,
                                        top_k, top_p)
             nxt = jnp.where(frozen, tok, nxt)
@@ -214,7 +221,7 @@ def _paged_serve_fns(model, temperature: float, top_k: int, top_p: float,
         returns the last position's logits for first-token selection."""
         logits, cache = model.apply(
             {"params": xform(params)}, segment, cache=cache,
-            cache_pos=pos, block_table=table)
+            cache_pos=pos, block_table=table, paged_kernel=paged_kernel)
         return logits[:, -1], cache
 
     @functools.partial(jax.jit, donate_argnums=(1,))
@@ -223,7 +230,8 @@ def _paged_serve_fns(model, temperature: float, top_k: int, top_p: float,
         (llama chunk_write's contract, block-targeted)."""
         _, cache = model.apply(
             {"params": xform(params)}, segment, cache=cache,
-            cache_pos=pos, block_table=table, return_hidden=True)
+            cache_pos=pos, block_table=table, paged_kernel=paged_kernel,
+            return_hidden=True)
         return cache
 
     return step, chunk_fill, chunk_write
@@ -232,7 +240,8 @@ def _paged_serve_fns(model, temperature: float, top_k: int, top_p: float,
 @functools.lru_cache(maxsize=8)
 def _paged_spec_serve_fns(model, draft, k: int, temperature: float,
                           top_k: int, top_p: float, params_transform=None,
-                          draft_transform=None):
+                          draft_transform=None,
+                          paged_kernel: str = "pallas"):
     """_spec_serve_fns' paged twin: the same make_spec_round math with
     both models' caches as block pools sharing ONE table (they cache
     the same logical positions, so one allocation serves both)."""
@@ -241,7 +250,8 @@ def _paged_spec_serve_fns(model, draft, k: int, temperature: float,
     t_xform = params_transform or (lambda p: p)
     d_xform = draft_transform or (lambda p: p)
     round_core = make_spec_round(model, draft, k, temperature, top_k,
-                                 top_p, t_xform, d_xform, paged=True)
+                                 top_p, t_xform, d_xform, paged=True,
+                                 paged_kernel=paged_kernel)
 
     @functools.partial(jax.jit, donate_argnums=(2, 3), static_argnums=(9,))
     def spec_block(t_params, d_params, t_cache, d_cache, tok, pos, frozen,
@@ -283,6 +293,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                draft_transform=None,
                paged: bool = False, block_size: int = 64,
                pool_blocks: Optional[int] = None,
+               paged_kernel: Optional[str] = None,
                telemetry: Optional[ServeTelemetry] = None,
                return_stats: bool = False):
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
@@ -358,12 +369,46 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     parity matrix); throughput and memory change, semantics never.
     With prefill_chunk set, the chunk must be a block_size multiple so
     every streamed segment stays block-aligned (refused loudly, like
-    the prefix/chunk alignment rule).  Paged mode refuses
-    sliding-window models (the dense O(window) ring is already the
-    right shape there), cache_sharding (dense TP serving covers it),
-    and cache_len (a dense-ring knob — pool_blocks is the paged memory
-    bound; silently dropping the caller's bound would be worse than
+    the prefix/chunk alignment rule).  Paged mode refuses cache_len
+    (a dense-ring knob — pool_blocks is the paged memory bound;
+    silently dropping the caller's bound would be worse than
     refusing).
+
+    paged_kernel: the paged READ path.  "pallas" = the block-indexed
+    decode kernel (models/paged_attention.py — streams blocks through
+    VMEM via the table, no linear K/V view, the raw-speed path on real
+    TPU; on CPU it runs under interpret=True, slow but token-exact);
+    "gather" = the table-gathered linear view through the unchanged
+    dense attention (the parity ORACLE, and the GSPMD-native path);
+    None (default) auto-selects — pallas on a TPU backend, gather on
+    CPU and whenever cache_sharding is set (a pallas grid owns the
+    pool's kv-head dim, the very dim tensor parallelism shards;
+    explicit "pallas" + cache_sharding is refused).
+
+    SLIDING-WINDOW models compose with paged mode: a window lane's
+    table is MODULAR — a ring of ring_blocks slots sized like the
+    dense O(window) ring and block-aligned; position p lives in slot
+    (p // block_size) % ring_blocks, the read side applies the dense
+    ring-visibility formula plus the window mask (gather and pallas
+    alike), and EVICTION is a refcount decrement: when the ring wraps
+    onto a shared prefix block the lane swaps in a pre-reserved
+    private shadow (copying the one boundary block only while its old
+    positions are still inside a live query's window) and drops its
+    reference — models/paging.WindowRotation, counted by
+    serving_kv_window_evicted_blocks_total.  paged + sliding-window +
+    SPECULATION is the remaining refusal: target and draft share one
+    block table, but modular tables are per-model (each model's ring
+    length divides positions differently), so the combination raises
+    with the block math.
+
+    cache_sharding composes with paged mode (tensor-parallel PAGED
+    serving): the pool's kv-head dim is sharded over tp exactly like
+    the dense ring's — the same NamedSharding callers already build
+    with parallel/tp.kv_cache_sharding, re-projected onto the pool's
+    [N+1, bs, KV, D] layout with block ids replicated — and the jitted
+    step's out↔in axis_resources stay matched on the pool, so no
+    hidden resharding rides a decode step (the tests and
+    bench_paged_decode assert sharding fixpoint per step).
 
     telemetry / return_stats: SERVING TELEMETRY (models/telemetry.py).
     Every call is instrumented — per-request lifecycle spans (queued ->
@@ -466,22 +511,55 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     longest = max(r.shape[0] for r in reqs)
     longest_i = max(range(len(reqs)), key=lambda i: int(reqs[i].shape[0]))
     model_cfgs = [("target", cfg)] + ([("draft", draft.cfg)] if spec else [])
+    if paged_kernel not in (None, "pallas", "gather"):
+        raise ValueError(
+            f"paged_kernel must be 'pallas', 'gather', or None (auto), "
+            f"got {paged_kernel!r}")
+    if paged_kernel is not None and not paged:
+        raise ValueError(
+            "paged_kernel is a paged-serving knob (it picks the block "
+            "pool's read path) — pass paged=True or drop it")
+    windowed = cfg.sliding_window is not None
     if paged:
         from tf_operator_tpu.models import paging
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
-        for name, c in model_cfgs:
-            if c.sliding_window is not None:
-                raise ValueError(
-                    f"paged serving does not support sliding-window "
-                    f"models ({name} window {c.sliding_window}): a block "
-                    f"table is linear and has no modular seam — use the "
-                    f"dense ring path (paged=False), which is already "
-                    f"O(window)")
-        if cache_sharding is not None or draft_cache_sharding is not None:
+        if spec and any(c.sliding_window is not None
+                        for _n, c in model_cfgs):
+            w_name, w_cfg = next((n, c) for n, c in model_cfgs
+                                 if c.sliding_window is not None)
+            need = paging.blocks_for(
+                w_cfg.sliding_window + spec_k + 1, block_size)
             raise ValueError(
-                "paged serving does not compose with cache_sharding yet "
-                "— use dense serving for tensor-parallel lanes")
+                f"paged sliding-window serving does not compose with "
+                f"speculation: target and draft share ONE block table, "
+                f"but a window table is modular per model — the {w_name}"
+                f"'s window {w_cfg.sliding_window} (+ verify headroom "
+                f"{spec_k + 1}) needs a private ring of {need} blocks "
+                f"of {block_size} tokens whose wrap seam the other "
+                f"model's positions would shear — use the dense ring "
+                f"(paged=False), which sizes each model's ring "
+                f"independently")
+        if paged_kernel == "pallas" and (cache_sharding is not None
+                                         or draft_cache_sharding
+                                         is not None):
+            raise ValueError(
+                "paged_kernel='pallas' does not compose with "
+                "cache_sharding: the kernel's grid owns the pool's "
+                "kv-head dim, which is exactly the dim cache_sharding "
+                "shards across the mesh — pass paged_kernel='gather' "
+                "(the GSPMD-native oracle path) or leave paged_kernel "
+                "unset to auto-select it")
+        if paged_kernel is None:
+            # auto: the kernel where it pays (real TPU), the gather
+            # oracle on CPU (interpret-mode pallas is correct but
+            # slow) and under tensor parallelism (GSPMD-native)
+            if (cache_sharding is not None
+                    or draft_cache_sharding is not None
+                    or jax.default_backend() != "tpu"):
+                paged_kernel = "gather"
+            else:
+                paged_kernel = "pallas"
         if cache_len is not None:
             # refuse-loudly convention: silently dropping the caller's
             # dense memory bound would un-bound their HBM expectation
@@ -568,13 +646,56 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         # block math per request: total table width t_blocks covers the
         # longest worst case; pool_blocks defaults to dense-equivalent
         # capacity (every lane can hold the worst case simultaneously,
-        # prefix shared) — shrink it to engage the memory gate
-        t_blocks = paging.blocks_for(
-            longest + max_new_tokens + headroom, block_size)
+        # prefix shared) — shrink it to engage the memory gate.
+        # Windowed models get a MODULAR table instead: a ring of
+        # ring_len // block_size slots sized exactly like the dense
+        # O(window) ring (block- and chunk-aligned), so window memory
+        # is O(window) blocks per lane regardless of sequence length.
         n_prefix_blocks = paging.blocks_for(p_fix, block_size)
-        plans = [paging.plan_request(int(r.shape[0]), max_new_tokens,
-                                     headroom, block_size, p_fix)
-                 for r in reqs]
+        if windowed:
+            w = cfg.sliding_window
+            ring_len = _llama.auto_cache_len(
+                cfg, longest, longest + max_new_tokens, prefill_chunk)
+            # block-align the ring: with a chunk it is already a chunk
+            # multiple (and chunk % block_size == 0 was enforced);
+            # rounding past max_len is harmless — ring slots are cache
+            # memory, not RoPE rows, and positions stay <= max_len
+            if prefill_chunk is None:
+                ring_len = -(-ring_len // block_size) * block_size
+            t_blocks = ring_len // block_size
+            if p_fix > ring_len:
+                raise ValueError(
+                    f"shared_prefix length {p_fix} exceeds the window "
+                    f"ring ({t_blocks} blocks x {block_size} = "
+                    f"{ring_len} positions, window {w}) — a prefix "
+                    f"longer than the ring would wrap over itself; "
+                    f"shrink the prefix or use the dense ring")
+            for i, r in enumerate(reqs):
+                chunk = _effective_chunk(int(r.shape[0]))
+                total_i = int(r.shape[0]) + max_new_tokens
+                if chunk is None and r.shape[0] > ring_len:
+                    raise ValueError(
+                        f"request {i}: prompt {r.shape[0]} exceeds the "
+                        f"window ring {ring_len}; pass prefill_chunk "
+                        f"to stream it")
+                if chunk is not None:
+                    _llama.check_prefill_chunk(
+                        chunk, ring_len, w,
+                        streams_past_cache=total_i > ring_len)
+            # write_slack: a decode block runs to its edge past
+            # EOS/budget, and those overshoot writes wrap the modular
+            # table too — the rotation shadows must cover them
+            plans = [paging.plan_window_request(
+                int(r.shape[0]), max_new_tokens, block_size, t_blocks,
+                p_fix, write_slack=steps_per_sync - 1) for r in reqs]
+        else:
+            t_blocks = paging.blocks_for(
+                longest + max_new_tokens + headroom, block_size)
+            # linear plans carry rotated=0: no slot ever wraps
+            plans = [paging.plan_request(int(r.shape[0]),
+                                         max_new_tokens, headroom,
+                                         block_size, p_fix) + (0,)
+                     for r in reqs]
         if pool_blocks is None:
             pool_blocks = (slots * max(pl[2] for pl in plans)
                            + n_prefix_blocks)
@@ -582,7 +703,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             raise ValueError(
                 f"pool_blocks must be >= 1, got {pool_blocks}")
         pool = paging.BlockPool(pool_blocks, block_size)
-        for i, (r, (_tot, _sh, private_i, _cow)) in enumerate(
+        for i, (r, (_tot, _sh, private_i, _cow, _rot)) in enumerate(
                 zip(reqs, plans)):
             # the worst case must fit an EMPTY pool (prefix aside) or
             # the memory gate would wait forever — refuse with the
@@ -620,18 +741,19 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     if paged:
         step, _, _ = _paged_serve_fns(model, float(temperature),
                                       int(top_k), float(top_p),
-                                      params_transform)
+                                      params_transform, paged_kernel)
         # greedy-keyed writers (selection happens host-side with the
         # real sampling params — the dense path's exact split)
         _, chunk_fill, chunk_write = _paged_serve_fns(
-            model, 0.0, 0, 0.0, params_transform)
+            model, 0.0, 0, 0.0, params_transform, paged_kernel)
         if spec:
             spec_block = _paged_spec_serve_fns(
                 model, draft, int(spec_k), float(temperature),
                 int(top_k), float(top_p), params_transform,
-                draft_transform)
+                draft_transform, paged_kernel)
             _, _, d_write = _paged_serve_fns(draft, 0.0, 0, 0.0,
-                                             draft_transform)
+                                             draft_transform,
+                                             paged_kernel)
     else:
         step, insert_row = _serve_fns(model, float(temperature),
                                       int(top_k), float(top_p),
@@ -710,16 +832,38 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                                           kv_quant=kv_quant), d_row_sh)
                  if spec else None))
 
+    def _pool_sharding(batch_sharding_):
+        """Project the caller's dense-cache NamedSharding ([B, C, KV,
+        D] — parallel/tp.kv_cache_sharding) onto the pool's [N+1, bs,
+        KV, D] layout: the kv-head dim keeps its axis, the block axis
+        and in-block positions replicate (block ids are host
+        bookkeeping; a sharded block axis would turn every table
+        update into cross-chip traffic).  Matched on the jitted step's
+        in AND out (donation keeps the buffer), so no resharding rides
+        a decode step — the dense ring's pjit contract, restated for
+        the pool."""
+        if batch_sharding_ is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        _row_sharding(batch_sharding_)  # one NamedSharding, validated
+        return NamedSharding(
+            batch_sharding_.mesh,
+            PartitionSpec(None, None, *batch_sharding_.spec[2:]))
+
     if paged:
         # ONE block pool per model (leading block axis shared by every
         # layer; block ids shared across models), per-lane tables of
         # t_blocks entries, id 0 = scratch.  The dense per-lane caches
         # and row-cache machinery above are never allocated.
-        cache = paging.init_block_pool(cfg, pool_blocks, block_size,
-                                       kv_quant=kv_quant)
-        d_cache = (paging.init_block_pool(draft.cfg, pool_blocks,
-                                          block_size, kv_quant=kv_quant)
-                   if spec else None)
+        cache = _place(
+            paging.init_block_pool(cfg, pool_blocks, block_size,
+                                   kv_quant=kv_quant),
+            _pool_sharding(cache_sharding))
+        d_cache = (_place(
+            paging.init_block_pool(draft.cfg, pool_blocks, block_size,
+                                   kv_quant=kv_quant),
+            _pool_sharding(draft_cache_sharding)) if spec else None)
         table = jnp.zeros((slots, t_blocks), jnp.int32)
         prefix_ids: List[int] = []
         if p_fix:
@@ -739,10 +883,14 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                                       jnp.int32(start), pfx_table)
         # per-lane block ownership: shared (increffed prefix) vs own
         # (private, freed at finish); table rows reset to scratch on
-        # finish so frozen-lane writes can never touch a freed block
+        # finish so frozen-lane writes can never touch a freed block.
+        # Windowed lanes additionally carry a WindowRotation: the
+        # modular-table bookkeeping that swaps wrapped-onto shared
+        # slots to pre-reserved private shadows (eviction by refcount)
         lane_shared: List[List[int]] = [[] for _ in range(slots)]
         lane_own: List[List[int]] = [[] for _ in range(slots)]
         lane_nblocks = [0] * slots
+        lane_rot: dict = {}
     else:
         if p_fix:
             # prefill the shared prefix ONCE (write-only: the logits of
@@ -798,7 +946,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     tel = telemetry if telemetry is not None else ServeTelemetry()
     tel.loop_started(len(reqs), slots, spec)
     if paged:
-        tel.pool_configured(pool_blocks, block_size)
+        tel.pool_configured(pool_blocks, block_size, paged_kernel)
         tel.blocks_in_use(pool.used)  # prefix blocks, if any
 
     def finish(s):
@@ -817,6 +965,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             # reference, private blocks free; the table row resets to
             # all-scratch so the frozen lane's pinned writes can never
             # land in a block the allocator hands to someone else
+            lane_rot.pop(s, None)
             if lane_shared[s]:
                 pool.decref(lane_shared[s])
             if lane_own[s]:
@@ -826,6 +975,37 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             table = table.at[s].set(0)
             tel.blocks_in_use(pool.used)
         tel.request_finished(ridx, results[ridx], n_step)
+
+    def rotate_window(s, upto_pos: int, q_min: int):
+        """Apply a windowed lane's modular-table rotations for every
+        block it is about to write through `upto_pos` — BEFORE the
+        device dispatch whose writes land there, so the table the jit
+        sees already routes them to writable private blocks.  Shared
+        blocks wrapped onto are copied to their shadow only while
+        their old positions are still inside a live query's window
+        (q_min's band), then dereferenced — eviction by refcount
+        (models/paging.WindowRotation has the math)."""
+        nonlocal cache, d_cache, table
+        rot = lane_rot.get(s)
+        if rot is None:
+            return
+        edits, released, evicted = rot.advance(upto_pos, q_min)
+        for slot, new_id, copy_src in edits:
+            if copy_src is not None:
+                cache = paging.copy_block(cache, jnp.int32(copy_src),
+                                          jnp.int32(new_id))
+            if s in pending:
+                pending[s]["row_tbl"] = (
+                    pending[s]["row_tbl"].at[0, slot].set(new_id))
+            else:
+                table = table.at[s, slot].set(new_id)
+        if released:
+            pool.decref(released)
+            for rid in released:
+                lane_shared[s].remove(rid)
+            tel.blocks_in_use(pool.used)
+        if evicted:
+            tel.window_blocks_evicted(evicted)
 
     def advance_prefill(s):
         """Stream up to prefill_chunks_per_sync segments of slot s's
@@ -846,6 +1026,13 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                                             st["next"] + budget]:
             piece = prompt_r[None, start:end]
             st["next"] += 1
+            # windowed lanes: a long prompt streaming through the
+            # modular table may wrap onto shared prefix slots — swap
+            # them to writable shadows before the segment's writes
+            # land (the segment's own queries start at `start`)
+            if paged:
+                rotate_window(s, end - 1, start)
+                row_tbl = st["row_tbl"]
             if is_last:  # final segment: logits + activate the lane
                 with tel.prefill_segment(st["ridx"], start, end):
                     if paged:
@@ -917,7 +1104,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             if owner[s] is None and s not in pending and queue:
                 if paged:
                     ridx = queue[0]
-                    _tot, shared_i, private_i, cow_i = plans[ridx]
+                    _tot, shared_i, private_i, cow_i, rot_i = plans[ridx]
                     if not pool.can_alloc(private_i):
                         # gate: wait for a finish to free blocks (the
                         # upfront validation guarantees an empty pool
@@ -926,6 +1113,14 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         break
                     queue.popleft()
                     own = pool.alloc(private_i)
+                    # windowed lanes reserve `rot_i` SHADOW blocks at
+                    # the tail of `own`: slots the modular table will
+                    # wrap onto while they still hold shared prefix
+                    # blocks swap to a shadow (rotate_window) — reserved
+                    # here so the gate's math is exact and rotation can
+                    # never fail an allocation mid-decode
+                    slot_ids = own[:private_i - rot_i]
+                    shadows = own[private_i - rot_i:]
                     shared_ids = prefix_ids[:shared_i]
                     if shared_ids:
                         # prefix reuse IS a refcount bump — no copy
@@ -936,7 +1131,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         # sharing still pays — its tail holds this
                         # lane's own positions
                         src = jnp.int32(prefix_ids[shared_i])
-                        dst = jnp.int32(own[0])
+                        dst = jnp.int32(slot_ids[0])
                         cache = paging.copy_block(cache, src, dst)
                         if spec:
                             d_cache = paging.copy_block(d_cache, src,
@@ -945,6 +1140,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     lane_shared[s] = list(shared_ids)
                     lane_own[s] = own
                     lane_nblocks[s] = shared_i + private_i
+                    if windowed:
+                        row = list(shared_ids) + slot_ids
+                        lane_rot[s] = paging.WindowRotation(
+                            row + [0] * (t_blocks - len(row)),
+                            shared_i, shadows, block_size,
+                            cfg.sliding_window)
                     # the device table row stays ALL-SCRATCH until
                     # activation: a pending lane is frozen across the
                     # decode blocks interleaved with its streamed
@@ -958,7 +1159,8 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         "ridx": ridx,
                         "next": resume_index(reqs[ridx].shape[0]),
                         "row_tbl": paging.build_table(
-                            list(shared_ids) + own, t_blocks)[None, :],
+                            list(shared_ids) + slot_ids,
+                            t_blocks)[None, :],
                     }
                     tel.request_admitted(ridx, s)
                     tel.blocks_in_use(pool.used)
@@ -1016,6 +1218,16 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                             finish(s)
                             break
         else:
+            if paged and windowed:
+                # pre-rotate every live lane's modular table for the
+                # positions this block will write (a finishing lane
+                # still writes to the block edge — the span covers it);
+                # the block's earliest query is the lane's current pos
+                for s in range(slots):
+                    if owner[s] is not None and not frozen_py[s]:
+                        cur = reqs[owner[s]].shape[0] + len(
+                            emitted[s]) - 1
+                        rotate_window(s, cur + steps_per_sync - 1, cur)
             with tel.decode_block(busy,
                                   pool.used if paged else None):
                 if paged:

@@ -77,7 +77,8 @@ def residual_sample(key, t_probs, d_probs):
 
 def make_spec_round(target, draft, k: int, temperature: float,
                     top_k: int, top_p: float, t_xform, d_xform,
-                    wrap_target: bool = False, paged: bool = False):
+                    wrap_target: bool = False, paged: bool = False,
+                    paged_kernel: str = "pallas"):
     """THE speculation round — the one copy of the exactness-critical
     math (truncate-then-sample draft proposals, the u*p_d < p_t
     acceptance rule over identical truncated distributions, the padded
@@ -100,7 +101,9 @@ def make_spec_round(target, draft, k: int, temperature: float,
     shared; only the device pools are per-model).  Rejected-round
     rollback is the same position-mask argument as the dense ring:
     stale writes past a lane's accepted length sit at masked slots and
-    are overwritten before they ever become visible."""
+    are overwritten before they ever become visible.  paged_kernel
+    picks the paged read path ("pallas" = block-indexed kernel,
+    "gather" = linear-view oracle — llama.GqaAttention's knob)."""
     from tf_operator_tpu.models.llama import _truncate_logits
 
     sampling = temperature > 0.0
@@ -108,7 +111,8 @@ def make_spec_round(target, draft, k: int, temperature: float,
     def round_core(t_params, d_params, t_cache, d_cache, last, pos, rkey,
                    table=None):
         b = last.shape[0]
-        pg = {"block_table": table} if paged else {}
+        pg = ({"block_table": table, "paged_kernel": paged_kernel}
+              if paged else {})
         k_draft, k_accept, k_fix = jax.random.split(rkey, 3)
 
         # ---- draft k tokens, single-token steps.  The scan runs

@@ -133,6 +133,9 @@ class ServeStats:
     # signal), CoW/prefix-reuse counts, and how many serve-loop
     # iterations deferred an admission for pool capacity
     paged: bool = False
+    # which paged read path served the run: "pallas" (block-indexed
+    # kernel) or "gather" (linear-view oracle); "" under dense serving
+    paged_kernel: str = ""
     kv_block_size: int = 0
     kv_blocks_total: int = 0
     kv_blocks_peak_used: int = 0
@@ -140,6 +143,9 @@ class ServeStats:
     cow_copies: int = 0
     prefix_block_hits: int = 0
     admissions_blocked_on_memory: int = 0
+    # sliding-window paged serving: block epochs retired by table
+    # rotation (shared prefix blocks dereferenced, private reused)
+    window_evicted_blocks: int = 0
     total_tokens: int = 0
     wall_time_s: float = 0.0
     tokens_per_sec: float = 0.0
@@ -195,11 +201,13 @@ class ServeTelemetry:
         # paged-KV accounting (pool_configured + per-event methods)
         self._pool_total = 0
         self._pool_block_size = 0
+        self._paged_kernel = ""
         self._blocks_occ: List[tuple] = []  # (blocks_used, duration)
         self._blocks_peak = 0
         self._cow = 0
         self._prefix_hits = 0
         self._adm_blocked = 0
+        self._window_evicted = 0
 
     def _wall(self, pc: float) -> float:
         """Epoch seconds for a perf_counter reading, via the single
@@ -218,9 +226,11 @@ class ServeTelemetry:
         self._hbm = None
         self._prefill_s = self._decode_s = 0.0
         self._pool_total = self._pool_block_size = 0
+        self._paged_kernel = ""
         self._blocks_occ.clear()
         self._blocks_peak = self._cow = 0
         self._prefix_hits = self._adm_blocked = 0
+        self._window_evicted = 0
         # a DENSE run must clear a prior paged run's capacity gauge or
         # the process keeps exporting a pool it no longer has ("0 means
         # dense serving" is the family's documented contract); a paged
@@ -238,12 +248,15 @@ class ServeTelemetry:
             self._reqs[i] = _RequestTimeline(i, self._started_pc)
 
     # ------------------------------------------------------ paged cache
-    def pool_configured(self, total_blocks: int, block_size: int) -> None:
+    def pool_configured(self, total_blocks: int, block_size: int,
+                        kernel: str = "gather") -> None:
         """serve_loop(paged=True) announces its block pool: capacity
         gauge set once per run (used/total is the dashboards' block-
-        occupancy ratio)."""
+        occupancy ratio) and the resolved read path (pallas | gather),
+        which labels the per-request kernel counter."""
         self._pool_total = total_blocks
         self._pool_block_size = block_size
+        self._paged_kernel = kernel
         em.SERVING_KV_BLOCKS_TOTAL.set(total_blocks)
         em.SERVING_KV_BLOCKS_USED.set(0)
 
@@ -268,6 +281,14 @@ class ServeTelemetry:
         but the pool could not cover the request's worst case."""
         self._adm_blocked += 1
         em.SERVING_ADMISSION_BLOCKED.inc()
+
+    def window_blocks_evicted(self, n: int) -> None:
+        """Sliding-window rotation retired n block epochs: the modular
+        table wrapped past their positions (shared prefix blocks were
+        dereferenced, private blocks reused in place)."""
+        if n > 0:
+            self._window_evicted += n
+            em.SERVING_KV_WINDOW_EVICTED.inc(amount=n)
 
     def request_admitted(self, index: int, slot: int) -> None:
         """A decode lane was RESERVED for the request (its prompt may
@@ -337,6 +358,11 @@ class ServeTelemetry:
         em.SERVING_REQUEST_LATENCY.observe(r.e2e_latency_s())
         em.SERVING_REQUESTS.inc()
         em.SERVING_TOKENS.inc(amount=r.tokens)
+        if self._paged_kernel:
+            # paged runs only: which read path served this request —
+            # the pallas/gather ratio is the fast-path-adoption signal
+            em.SERVING_PAGED_KERNEL_REQUESTS.inc(
+                {"kernel": self._paged_kernel})
         tpot = r.tpot_s()
         if tpot is not None:
             em.SERVING_TPOT.observe(tpot)
@@ -424,6 +450,7 @@ class ServeTelemetry:
             slots=self._slots,
             speculative=self._spec,
             paged=self._pool_total > 0,
+            paged_kernel=self._paged_kernel,
             kv_block_size=self._pool_block_size,
             kv_blocks_total=self._pool_total,
             kv_blocks_peak_used=self._blocks_peak,
@@ -433,6 +460,7 @@ class ServeTelemetry:
             cow_copies=self._cow,
             prefix_block_hits=self._prefix_hits,
             admissions_blocked_on_memory=self._adm_blocked,
+            window_evicted_blocks=self._window_evicted,
             total_tokens=total_tokens,
             wall_time_s=wall,
             tokens_per_sec=total_tokens / wall if wall > 0 else 0.0,
