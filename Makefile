@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -130,6 +130,18 @@ bench-timeline:
 bench-elastic:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_elastic; \
 	print(json.dumps(bench_elastic(), indent=1))"
+
+# Serving-fleet control plane: >= 1k simulated concurrent users on a
+# seeded diurnal/bursty trace with heavy-tailed prompts, served by one
+# big static replica vs round-robin-over-a-fixed-fleet vs the occupancy
+# router + telemetry autoscaler (ISSUE 14 evidence; deterministic
+# SimClock arithmetic, no TPU required).  Headline: occupancy+autoscale
+# beats round-robin on TTFT p99, matches it on tokens/s, and every
+# scale-out reacts within one warm-pool claim latency.  Rows land in
+# BENCH_r13.json; bounds asserted in tests/test_bench_infra.py.
+bench-fleet:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_fleet; \
+	print(json.dumps(bench_fleet(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

@@ -2321,6 +2321,99 @@ def _free_port_block(n, start=19400, stop=19900):
     raise RuntimeError("no free port block for worker metrics")
 
 
+def bench_fleet(
+    seed: int = 1337,
+    n_users: int = 1200,
+    horizon_s: float = 700.0,
+    fixed_fleet: int = 4,
+    min_replicas: int = 2,
+    max_replicas: int = 8,
+    warm_standbys: int = 6,
+    claim_latency_s: float = 0.5,
+):
+    """`make bench-fleet` — the serving control plane's headline (ISSUE
+    14 evidence, BENCH_r13.json).  One seeded trace of >= 1k simulated
+    concurrent users (diurnal session arrivals with two burst windows,
+    1-3 requests per session with think time, heavy-tailed prompt
+    lengths), served by three fleets on the deterministic SimClock
+    harness (models/fleetsim.py — SimReplica models serve_loop's
+    memory-gated FIFO admission + sequential prefill + per-lane decode):
+
+      static_big          — ONE replica with the fixed fleet's aggregate
+                            capacity (slots/pool/prefill x N): the
+                            single-admission-queue baseline, where one
+                            long prompt is head-of-line latency for
+                            everything behind it.
+      round_robin         — a fixed fleet of `fixed_fleet` replicas
+                            behind blind round-robin dispatch: heavy
+                            tails convoy individual replicas while
+                            siblings idle.
+      occupancy_autoscale — the occupancy router (models/router.py:
+                            most-free-KV-blocks + shortest-queue
+                            dispatch, bounded per-replica in-flight)
+                            plus the telemetry autoscaler
+                            (engine/servefleet.AutoscalePolicy), scaling
+                            min..max replicas with warm-pool claims
+                            (claim latency vs a 30s cold create).
+
+    Per row: tokens/s, TTFT p50/p99, queue-wait p99, peak in-flight,
+    replica-seconds (the cost axis), scale events, and per-scale-out
+    reaction time (trigger crossing -> replica ready).  Every number is
+    deterministic arithmetic per seed; tests/test_bench_infra.py pins
+    the regression bounds (occupancy+autoscale beats round-robin on
+    TTFT p99, matches-or-beats it on tokens/s, scale-out reacts within
+    one warm-pool claim latency, nothing dropped or duplicated)."""
+    from tf_operator_tpu.api.servingjob import AutoscaleSpec
+    from tf_operator_tpu.models.fleetsim import FleetHarness, make_trace
+
+    trace = make_trace(seed, n_users=n_users)
+    auto = AutoscaleSpec(
+        min_replicas=min_replicas, max_replicas=max_replicas,
+        scale_out_queue_wait_p99_s=1.5, scale_out_blocked_admissions=4,
+        scale_in_occupancy_floor=0.2,
+    )
+    arms = (
+        ("static_big", "static_big", dict(n_replicas=fixed_fleet)),
+        ("round_robin", "round_robin", dict(n_replicas=fixed_fleet)),
+        ("occupancy_autoscale", "occupancy", dict(
+            n_replicas=min_replicas, autoscale=auto,
+            warm_standbys=warm_standbys,
+        )),
+    )
+    rows = []
+    for label, mode, kw in arms:
+        harness = FleetHarness(
+            mode, claim_latency_s=claim_latency_s, **kw
+        )
+        row = harness.run(trace, horizon_s=horizon_s)
+        row["mode"] = label
+        row["redispatches"] = len(row["redispatches"])
+        rows.append(row)
+    by = {r["mode"]: r for r in rows}
+    occ, rr = by["occupancy_autoscale"], by["round_robin"]
+    reactions = occ["scale_out_reaction_s"]
+    return {
+        "seed": seed,
+        "users": n_users,
+        "requests": len(trace),
+        "claim_latency_s": claim_latency_s,
+        "rows": rows,
+        "summary": {
+            "ttft_p99_rr_over_occ": (
+                round(rr["ttft_p99_s"] / occ["ttft_p99_s"], 2)
+                if occ["ttft_p99_s"] else None
+            ),
+            "tokens_occ_over_rr": (
+                round(occ["tokens_per_sec"] / rr["tokens_per_sec"], 3)
+                if rr["tokens_per_sec"] else None
+            ),
+            "max_scale_out_reaction_s": (
+                max(reactions) if reactions else None
+            ),
+        },
+    }
+
+
 def bench_elastic(
     seed: int = 1337,
     horizon_s: float = 420.0,

@@ -55,6 +55,14 @@ _REQUIRED_FAMILIES = {
     # read these by name
     "tpu_operator_serving_paged_kernel_requests_total": "Counter",
     "tpu_operator_serving_kv_window_evicted_blocks_total": "Counter",
+    # serving-fleet control plane (ISSUE 14): the router's dispatch
+    # breakdown + queue depth and the autoscaler's fleet shape + scale
+    # activity — docs/monitoring.md's occupancy-spread, scale-reaction,
+    # and dispatch-reason PromQL read these by name
+    "tpu_operator_serving_fleet_replicas": "Gauge",
+    "tpu_operator_serving_router_dispatch_total": "Counter",
+    "tpu_operator_serving_router_queue_depth": "Gauge",
+    "tpu_operator_serving_fleet_scale_events_total": "Counter",
 }
 
 

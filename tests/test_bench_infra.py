@@ -465,6 +465,43 @@ def test_bench_elastic_shrink_beats_evict_deterministically():
     assert sh["preemptor_time_to_running_s"] is not None
 
 
+def test_bench_fleet_occupancy_beats_round_robin_deterministically():
+    """BENCH_r13's regression bounds (ISSUE 14), pinned so the artifact
+    can't silently rot.  The fleet harness is SimClock-driven and
+    seeded, so every number is deterministic arithmetic: at >= 1k
+    simulated concurrent users on the bursty trace, the occupancy
+    router + autoscaler must beat blind round-robin-over-a-fixed-fleet
+    on TTFT p99, match-or-beat it on tokens/s, react to every scale-out
+    trigger within one warm-pool claim latency, and neither drop nor
+    duplicate a single request."""
+    r = bench.bench_fleet()
+    assert r["users"] >= 1000
+    by = {row["mode"]: row for row in r["rows"]}
+    occ = by["occupancy_autoscale"]
+    rr = by["round_robin"]
+    static = by["static_big"]
+    # completeness: every arm serves the whole trace, exactly once
+    for row in r["rows"]:
+        assert row["completed"] == r["requests"]
+        assert row["dropped"] == 0
+        assert row["duplicates"] == 0
+    # the headline: occupancy routing + autoscaling beats blind dispatch
+    # on tail latency under the bursty trace...
+    assert occ["ttft_p99_s"] < rr["ttft_p99_s"]
+    assert occ["ttft_p99_s"] < static["ttft_p99_s"]
+    assert occ["queue_wait_p99_s"] < rr["queue_wait_p99_s"]
+    # ...while matching round-robin's throughput (>= within 2%)
+    assert occ["tokens_per_sec"] >= 0.98 * rr["tokens_per_sec"]
+    # autoscale reacted, and every scale-out became a ready replica
+    # within one warm-pool claim latency of the trigger decision
+    assert occ["scale_out_events"] > 0
+    assert occ["scale_out_reaction_s"], "no reaction samples recorded"
+    assert max(occ["scale_out_reaction_s"]) <= r["claim_latency_s"] + 1e-6
+    # scale-in happened and drained without dropping anything (the
+    # completeness assertions above already prove no loss)
+    assert occ["scale_in_events"] > 0
+
+
 def test_merge_bucket_percentiles_reads_merged_histograms():
     """The multiproc /metrics scrape math: per-worker cumulative bucket
     counts merge by le and percentiles read off the merged histogram

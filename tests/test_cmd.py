@@ -31,7 +31,8 @@ def test_parse_args_defaults_match_reference():
     assert o.metrics_bind_address == ":8080"
     assert o.health_probe_bind_address == ":8081"
     # empty --enable-scheme means all kinds
-    assert set(o.all_kinds) == {"TFJob", "PyTorchJob", "MXJob", "XGBoostJob", "TPUJob"}
+    assert set(o.all_kinds) == {"TFJob", "PyTorchJob", "MXJob", "XGBoostJob",
+                            "TPUJob", "TPUServingJob"}
 
 
 def test_parse_args_enable_scheme_case_insensitive_and_validating():
@@ -254,10 +255,11 @@ def test_crd_preflight_real_client_blocks_without_crds():
         run(opts, cluster=client, block=False)
 
     missing = crd_preflight(client, opts.all_kinds)
-    assert "tfjobs.kubeflow.org" in missing and len(missing) == 5
+    assert "tfjobs.kubeflow.org" in missing and len(missing) == 6
 
     # install the CRDs (as deploy/cluster.py would) -> preflight passes
-    for kind in ("tfjobs", "pytorchjobs", "mxjobs", "xgboostjobs", "tpujobs"):
+    for kind in ("tfjobs", "pytorchjobs", "mxjobs", "xgboostjobs",
+                 "tpujobs", "tpuservingjobs"):
         # natural cluster-scoped form (no namespace field): the store keys
         # it under "" via objects.CLUSTER_SCOPED_KINDS
         backing.create("CustomResourceDefinition", {

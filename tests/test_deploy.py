@@ -26,7 +26,7 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 def test_render_base_contains_all_resources():
     docs = render_kustomization(os.path.join(REPO, "manifests", "base"))
     kinds = sorted(d["kind"] for d in docs)
-    assert kinds.count("CustomResourceDefinition") == 5
+    assert kinds.count("CustomResourceDefinition") == 6
     for kind in ("Deployment", "Service", "ServiceAccount", "ClusterRole",
                  "ClusterRoleBinding"):
         assert kind in kinds, kinds

@@ -26,7 +26,9 @@ def test_crds_in_sync_with_api_types():
 
 
 def test_crds_cover_all_kinds_and_replica_types():
-    from tf_operator_tpu.api import mxnet, pytorch, tensorflow, tpujob, xgboost
+    from tf_operator_tpu.api import (
+        mxnet, pytorch, servingjob, tensorflow, tpujob, xgboost,
+    )
 
     expect = {
         "TFJob": ("tfReplicaSpecs", tensorflow.REPLICA_TYPES),
@@ -34,6 +36,7 @@ def test_crds_cover_all_kinds_and_replica_types():
         "MXJob": ("mxReplicaSpecs", mxnet.REPLICA_TYPES),
         "XGBoostJob": ("xgbReplicaSpecs", xgboost.REPLICA_TYPES),
         "TPUJob": ("tpuReplicaSpecs", tpujob.REPLICA_TYPES),
+        "TPUServingJob": ("servingReplicaSpecs", servingjob.REPLICA_TYPES),
     }
     seen = {}
     crd_dir = os.path.join(BASE, "crds")
@@ -71,6 +74,22 @@ def test_tpujob_crd_has_tpu_fields():
     assert {"acceleratorType", "topology", "numSlices"} <= set(spec["properties"])
 
 
+def test_servingjob_crd_has_fleet_fields():
+    (doc,) = _load(
+        os.path.join(BASE, "crds", "kubeflow.org_tpuservingjobs.yaml")
+    )
+    spec = doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+        "properties"
+    ]["spec"]
+    assert {"sliceShape", "autoscale"} <= set(spec["properties"])
+    auto = spec["properties"]["autoscale"]["properties"]
+    assert {
+        "minReplicas", "maxReplicas", "scaleOutQueueWaitP99S",
+        "scaleOutBlockedAdmissions", "scaleInOccupancyFloor",
+        "maxInflightPerReplica",
+    } <= set(auto)
+
+
 def test_kustomize_base_resources_exist():
     (kust,) = _load(os.path.join(BASE, "kustomization.yaml"))
     for res in kust["resources"]:
@@ -83,7 +102,8 @@ def test_rbac_covers_all_crds_and_podgroups():
     kubeflow_rule = next(
         r for r in role["rules"] if "kubeflow.org" in r["apiGroups"]
     )
-    for plural in ("tfjobs", "pytorchjobs", "mxjobs", "xgboostjobs", "tpujobs"):
+    for plural in ("tfjobs", "pytorchjobs", "mxjobs", "xgboostjobs",
+                   "tpujobs", "tpuservingjobs"):
         assert plural in kubeflow_rule["resources"]
         assert f"{plural}/status" in kubeflow_rule["resources"]
     volcano = next(

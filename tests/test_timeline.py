@@ -723,16 +723,18 @@ def test_metric_lint_counts_the_slo_families():
     with em._LOCK:
         names = {m.name for m in em._REGISTRY}
     assert set(lint._REQUIRED_FAMILIES) <= names
-    # the asserted lint count: 74 families — 64 after the five ISSUE 10
+    # the asserted lint count: 78 families — 64 after the five ISSUE 10
     # SLO additions, +6 from ISSUE 11 (supervisor children/restarts,
     # watch-journal events/resumes/encodes, APF seats), +2 from ISSUE 12
     # (job resize-duration SLO histogram, scheduler shrink counter),
     # +2 from ISSUE 13 (paged-kernel request counter, sliding-window
-    # evicted-blocks counter).
+    # evicted-blocks counter), +4 from ISSUE 14 (serving-fleet replicas
+    # gauge, router dispatch counter, router queue-depth gauge, fleet
+    # scale-events counter).
     # (The ISSUE 11 bump was never recorded here: this test sits past
     # the tier-1 timeout cutoff, so the stale 64 went unnoticed.)
     with em._LOCK:
-        assert len(em._REGISTRY) == 74
+        assert len(em._REGISTRY) == 78
 
 
 @pytest.mark.slow

@@ -1,0 +1,205 @@
+"""TPUServingJob — the serving-fleet kind (new; no reference counterpart).
+
+The training kinds model a gang: N replicas that live and die together
+(a TPU slice is unusable partially, so admission is atomic and restart
+is whole-slice).  A serving fleet is the opposite shape: N *independent*
+`serve_loop` replicas behind an occupancy-aware router
+(models/router.py), scaled by telemetry (engine/servefleet.py).  A
+replica dying affects only the requests routed to it; a replica being
+added needs no rendezvous, env rewrite, or reshard — the router simply
+starts dispatching to it.  The spec therefore carries no gang knobs:
+
+  spec:
+    sliceShape: "v5e-8"            # per-replica slice (warm-pool vocabulary)
+    servingReplicaSpecs:
+      Replica: {replicas: 2, template: {...}}
+    autoscale:                     # optional; absent = fixed fleet
+      minReplicas: 1
+      maxReplicas: 8
+      scaleOutQueueWaitP99S: 2.0   # queue-wait p99 trigger (seconds)
+      scaleOutBlockedAdmissions: 4 # admission_blocked_on_memory delta trigger
+      scaleInOccupancyFloor: 0.3   # KV-block occupancy floor (used/total)
+      maxInflightPerReplica: 8     # router's bounded per-replica admission
+
+Consequences wired through the stack (controllers/serving.py
+INDEPENDENT_REPLICAS): no cluster-scheduler gang admission (each replica
+is placed alone — warm-pool claims still apply per pod), no PodGroup,
+and a replicas edit is a plain FLEET RESIZE, never the elastic
+drain → reshard → resume phase machine (there is no cross-replica state
+to reshard; scale-in drains through the router instead,
+docs/serving.md "Serving fleet").
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from tf_operator_tpu.api import common, job as jobapi
+
+KIND = "TPUServingJob"
+PLURAL = "tpuservingjobs"
+
+REPLICA_REPLICA = "Replica"
+REPLICA_TYPES = [REPLICA_REPLICA]
+
+DEFAULT_CONTAINER_NAME = "serve"
+DEFAULT_PORT_NAME = "servingjob-port"
+DEFAULT_PORT = 8000  # the replica's inference HTTP port
+# replicas default to ExitCode: a preempted/killed replica (>=128) is
+# replaced, a crashing model server (1-127) is a permanent failure
+DEFAULT_RESTART_POLICY = common.RESTART_POLICY_EXIT_CODE
+
+DEFAULT_SLICE_SHAPE = "v5e-1"
+# same vocabulary the warm pool routes standbys on (engine/warmpool.py)
+_SHAPE_RE = re.compile(r"^v\d+(?:p|e|litepod)?-\d+$")
+
+# the annotation the warm pool and scheduler read the shape from; set_defaults
+# stamps it onto the replica template so fleet pods are warm-pool-claimable
+SHAPE_ANNOTATION = "kubeflow.org/slice-shape"
+
+
+@dataclass
+class AutoscaleSpec:
+    """Telemetry-driven fleet autoscaling bounds + triggers.  The trigger
+    metrics are exactly the serving families PR 2/PR 9 already export:
+    queue-wait p99 and admission_blocked_on_memory_total say "requests
+    are waiting on capacity" (scale out), KV-block occupancy says "the
+    fleet is paying for memory nobody uses" (scale in)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scale_out_queue_wait_p99_s: float = 2.0
+    scale_out_blocked_admissions: int = 4
+    scale_in_occupancy_floor: float = 0.3
+    max_inflight_per_replica: int = 8
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "minReplicas": self.min_replicas,
+            "maxReplicas": self.max_replicas,
+            "scaleOutQueueWaitP99S": self.scale_out_queue_wait_p99_s,
+            "scaleOutBlockedAdmissions": self.scale_out_blocked_admissions,
+            "scaleInOccupancyFloor": self.scale_in_occupancy_floor,
+            "maxInflightPerReplica": self.max_inflight_per_replica,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["AutoscaleSpec"]:
+        if d is None:
+            return None
+        out = cls()
+        if "minReplicas" in d:
+            out.min_replicas = d["minReplicas"]
+        if "maxReplicas" in d:
+            out.max_replicas = d["maxReplicas"]
+        if "scaleOutQueueWaitP99S" in d:
+            out.scale_out_queue_wait_p99_s = d["scaleOutQueueWaitP99S"]
+        if "scaleOutBlockedAdmissions" in d:
+            out.scale_out_blocked_admissions = d["scaleOutBlockedAdmissions"]
+        if "scaleInOccupancyFloor" in d:
+            out.scale_in_occupancy_floor = d["scaleInOccupancyFloor"]
+        if "maxInflightPerReplica" in d:
+            out.max_inflight_per_replica = d["maxInflightPerReplica"]
+        return out
+
+
+@dataclass
+class TPUServingJob(jobapi.Job):
+    kind: str = KIND
+    slice_shape: str = DEFAULT_SLICE_SHAPE
+    autoscale: Optional[AutoscaleSpec] = None
+
+    def replica_specs_key(self) -> str:
+        return "servingReplicaSpecs"
+
+    def extra_spec_to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"sliceShape": self.slice_shape}
+        if self.autoscale is not None:
+            d["autoscale"] = self.autoscale.to_dict()
+        return d
+
+    def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
+        self.slice_shape = spec.get("sliceShape", DEFAULT_SLICE_SHAPE)
+        self.autoscale = AutoscaleSpec.from_dict(spec.get("autoscale"))
+
+
+def set_defaults(job: TPUServingJob) -> None:
+    """replicas -> 1, restartPolicy -> ExitCode, inference port, and the
+    slice-shape annotation stamped onto the template so the warm pool
+    (engine/warmpool.py) and scheduler read the fleet's per-replica shape
+    from the same place they read every other kind's."""
+    jobapi.apply_common_defaults(
+        job, REPLICA_TYPES, DEFAULT_CONTAINER_NAME, DEFAULT_PORT_NAME,
+        DEFAULT_PORT, DEFAULT_RESTART_POLICY,
+    )
+    if not job.slice_shape:
+        job.slice_shape = DEFAULT_SLICE_SHAPE
+    spec = (job.replica_specs or {}).get(REPLICA_REPLICA)
+    if spec is not None and isinstance(spec.template, dict):
+        meta = spec.template.setdefault("metadata", {})
+        meta.setdefault("annotations", {}).setdefault(
+            SHAPE_ANNOTATION, job.slice_shape
+        )
+
+
+def validate(job: TPUServingJob) -> None:
+    jobapi.validate_replica_specs(
+        job, DEFAULT_CONTAINER_NAME, valid_types=REPLICA_TYPES, kind=KIND
+    )
+    if not _SHAPE_RE.match(job.slice_shape or ""):
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: bad sliceShape {job.slice_shape!r} "
+            f"(want e.g. 'v5e-8')"
+        )
+    a = job.autoscale
+    if a is None:
+        return
+    for name, value in (
+        ("autoscale.minReplicas", a.min_replicas),
+        ("autoscale.maxReplicas", a.max_replicas),
+        ("autoscale.scaleOutBlockedAdmissions", a.scale_out_blocked_admissions),
+        ("autoscale.maxInflightPerReplica", a.max_inflight_per_replica),
+    ):
+        if not jobapi.is_int(value):
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: {name} must be an integer, "
+                f"got {value!r}"
+            )
+    if a.min_replicas < 1:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: autoscale.minReplicas must be >= 1 "
+            f"(a serving fleet scaled to zero serves nobody; delete or "
+            f"suspend the job instead)"
+        )
+    if a.max_replicas < a.min_replicas:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: autoscale.maxReplicas "
+            f"({a.max_replicas}) must be >= minReplicas ({a.min_replicas})"
+        )
+    if a.max_inflight_per_replica < 1:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: autoscale.maxInflightPerReplica "
+            f"must be >= 1"
+        )
+    if not (
+        isinstance(a.scale_out_queue_wait_p99_s, (int, float))
+        and a.scale_out_queue_wait_p99_s > 0
+    ):
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: autoscale.scaleOutQueueWaitP99S "
+            f"must be > 0, got {a.scale_out_queue_wait_p99_s!r}"
+        )
+    if not (
+        isinstance(a.scale_in_occupancy_floor, (int, float))
+        and 0.0 <= a.scale_in_occupancy_floor < 1.0
+    ):
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: autoscale.scaleInOccupancyFloor "
+            f"must be in [0, 1), got {a.scale_in_occupancy_floor!r}"
+        )
+    if a.scale_out_blocked_admissions < 1:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: autoscale.scaleOutBlockedAdmissions "
+            f"must be >= 1"
+        )

@@ -214,9 +214,10 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         manager.start()
         pool = getattr(manager, "warm_pool", None)
         sched = getattr(manager, "scheduler", None)
+        autoscaler = getattr(manager, "fleet_autoscaler", None)
         log.info(
             "manager started: kinds=%s shards=%d warm_pool=%s scheduler=%s "
-            "timeline=%s elastic_resize=%s",
+            "timeline=%s elastic_resize=%s serving_autoscale=%s",
             options.all_kinds,
             getattr(manager, "shard_count", 1),
             dict(pool.config.sizes) if pool is not None else "off",
@@ -230,6 +231,10 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
                 if recorder is not None else "off"
             ),
             "on" if options.elastic_resize else "off",
+            (
+                f"every {autoscaler.interval:g}s"
+                if autoscaler is not None else "off"
+            ),
         )
 
     if block:

@@ -141,6 +141,24 @@ def build_recorder(options: ServerOptions, engine_kwargs=None):
     return recorder
 
 
+def build_fleet_autoscaler(cluster, options: ServerOptions, engine_kwargs=None,
+                           recorder=None):
+    """One serving-fleet autoscaler per operator process, or None when
+    --serving-autoscale is off.  Standalone managers only (a sharded
+    coordinator would run one on the parent; N shards each patching the
+    same CR would fight the cooldown)."""
+    if not options.serving_autoscale:
+        return None
+    from tf_operator_tpu.engine.servefleet import FleetAutoscaler
+
+    return FleetAutoscaler(
+        cluster,
+        interval=options.serving_autoscale_interval,
+        clock=(engine_kwargs or {}).get("clock", time.time),
+        recorder=recorder,
+    )
+
+
 def build_warm_pool(cluster, options: ServerOptions, engine_kwargs=None):
     """One WarmPoolManager per operator process, or None when disabled.
     Shared by every shard's engines: claims are CAS-safe, and a single
@@ -586,6 +604,16 @@ class OperatorManager:
         if recorder is None and shard is None:
             recorder = build_recorder(self.options, engine_kwargs)
         self.recorder = recorder
+        # serving-fleet autoscaler (engine/servefleet.py): standalone
+        # managers only; --serving-autoscale off (default) builds nothing
+        self._owns_autoscaler = shard is None
+        self.fleet_autoscaler = (
+            build_fleet_autoscaler(
+                cluster, self.options, engine_kwargs, recorder=recorder
+            )
+            if self._owns_autoscaler else None
+        )
+        self._owns_autoscaler = self.fleet_autoscaler is not None
         if self.recorder is not None:
             if self.warm_pool is not None:
                 self.warm_pool.recorder = self.recorder
@@ -665,9 +693,13 @@ class OperatorManager:
             ctl.start_workers(self.options.threadiness)
         if self._owns_warm_pool:
             self.warm_pool.start()
+        if self._owns_autoscaler:
+            self.fleet_autoscaler.start()
         self._started = True
 
     def stop(self) -> None:
+        if self._owns_autoscaler:
+            self.fleet_autoscaler.stop()
         if self._owns_warm_pool:
             self.warm_pool.stop()
         if self._owns_scheduler:

@@ -121,6 +121,13 @@ class ServerOptions:
     # jobs (LRU-evicting finished ones).
     timeline_events_per_job: int = 256
     timeline_max_jobs: int = 1000
+    # serving-fleet autoscaler (engine/servefleet.py): scales each
+    # TPUServingJob's replica count on its own telemetry (queue-wait
+    # p99 / blocked admissions out, KV-block occupancy floor in), with
+    # two-phase drain on scale-in.  Off (default) builds nothing — a
+    # TPUServingJob then stays at its declared replica count.
+    serving_autoscale: bool = False
+    serving_autoscale_interval: float = 1.0
     # when True (default), reconcile errors the client layer classified as
     # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
     # consuming the bounded reconcile-retry budget; False restores the
@@ -322,6 +329,17 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         help="job flight recorder: cap on tracked jobs; finished jobs "
         "are LRU-evicted past the cap (live jobs never are)",
     )
+    p.add_argument(
+        "--serving-autoscale",
+        action="store_true",
+        help="run the serving-fleet autoscaler: each TPUServingJob's "
+        "replica count scales out on queue-wait p99 / blocked-admission "
+        "triggers (claiming warm-pool standbys) and in on the KV-block "
+        "occupancy floor, draining the victim replica first so no "
+        "request is dropped; off (default) keeps fleets at their "
+        "declared size",
+    )
+    p.add_argument("--serving-autoscale-interval", type=float, default=1.0)
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -378,4 +396,6 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         scheduler_nodes=list(a.node),
         timeline_events_per_job=a.timeline_events_per_job,
         timeline_max_jobs=a.timeline_max_jobs,
+        serving_autoscale=a.serving_autoscale,
+        serving_autoscale_interval=a.serving_autoscale_interval,
     )

@@ -12,6 +12,7 @@ from tf_operator_tpu.controllers.pytorch import PyTorchAdapter
 from tf_operator_tpu.controllers.mxnet import MXNetAdapter
 from tf_operator_tpu.controllers.xgboost import XGBoostAdapter
 from tf_operator_tpu.controllers.tpu import TPUAdapter
+from tf_operator_tpu.controllers.serving import ServingAdapter
 
 SUPPORTED_ADAPTERS: Dict[str, Type[FrameworkAdapter]] = {
     TFAdapter.KIND: TFAdapter,
@@ -19,6 +20,7 @@ SUPPORTED_ADAPTERS: Dict[str, Type[FrameworkAdapter]] = {
     MXNetAdapter.KIND: MXNetAdapter,
     XGBoostAdapter.KIND: XGBoostAdapter,
     TPUAdapter.KIND: TPUAdapter,
+    ServingAdapter.KIND: ServingAdapter,
 }
 
 

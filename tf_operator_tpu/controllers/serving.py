@@ -1,0 +1,153 @@
+"""TPUServingJob controller adapter — an independent-replica serving fleet.
+
+The training adapters model gangs (atomic admission, whole-slice restart,
+all-hosts success).  A serving fleet inverts every one of those rules:
+
+  - **INDEPENDENT_REPLICAS**: replicas are admitted, placed, restarted,
+    and drained one at a time.  The engine skips cluster-scheduler gang
+    admission and the PodGroup seam entirely (a fleet never waits on
+    "all N or nothing" — a partially-provisioned fleet serves at reduced
+    capacity), and a replicas edit is a plain fleet resize, never the
+    elastic drain → reshard → resume machine (there is no cross-replica
+    training state; scale-in coordination is the ROUTER's job —
+    engine/servefleet.py drains dispatch before the pod is deleted).
+  - replicas stay warm-pool-claimable: the slice-shape annotation
+    api/servingjob.set_defaults stamps on the template routes each pod
+    through the same claim-before-create seam as every training pod,
+    which is what makes telemetry-driven scale-out fast enough to matter
+    (one claim latency instead of a cold image pull).
+  - status: Running while ANY replica serves (the fleet degrades, it
+    does not die); Failed only when every replica failed permanently
+    and nothing is restarting.
+
+Cluster env: each replica learns its own identity and the fleet shape —
+enough for a replica to register itself with the router and export
+per-replica occupancy telemetry under a stable id.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from tf_operator_tpu.api import common
+from tf_operator_tpu.api import servingjob as servingapi
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine.adapter import FrameworkAdapter, StatusContext
+from tf_operator_tpu.engine.controller import (
+    JobEngine,
+    REASON_FAILED,
+    REASON_RUNNING,
+    REASON_SUCCEEDED,
+)
+from tf_operator_tpu.k8s import objects
+
+
+class ServingAdapter(FrameworkAdapter):
+    KIND = servingapi.KIND
+    PLURAL = servingapi.PLURAL
+    REPLICA_TYPES = servingapi.REPLICA_TYPES
+    CONTAINER_NAME = servingapi.DEFAULT_CONTAINER_NAME
+    PORT_NAME = servingapi.DEFAULT_PORT_NAME
+    DEFAULT_PORT = servingapi.DEFAULT_PORT
+    # the one switch the engine reads: no gang admission, no PodGroup,
+    # no elastic-resize phase machine — replicas are independent
+    INDEPENDENT_REPLICAS = True
+
+    def from_dict(self, d: Dict[str, Any]) -> servingapi.TPUServingJob:
+        return servingapi.TPUServingJob.from_dict(d)
+
+    def set_defaults(self, job: servingapi.TPUServingJob) -> None:
+        servingapi.set_defaults(job)
+
+    def validate(self, job: servingapi.TPUServingJob) -> None:
+        servingapi.validate(job)
+
+    def set_cluster_spec(
+        self, job: servingapi.TPUServingJob, pod_template: Dict[str, Any],
+        rtype: str, index: int,
+    ) -> None:
+        spec = (job.replica_specs or {}).get(rtype)
+        port = objects.replica_port(
+            spec.template if spec else pod_template,
+            servingapi.DEFAULT_CONTAINER_NAME,
+            servingapi.DEFAULT_PORT_NAME,
+            servingapi.DEFAULT_PORT,
+        )
+        env = {
+            # stable replica identity: the router keys live occupancy
+            # telemetry and dispatch bookkeeping on this
+            "SERVING_REPLICA_ID": JobEngine.gen_general_name(
+                job.name, rtype, index
+            ),
+            "SERVING_REPLICA_INDEX": str(index),
+            "SERVING_FLEET_SIZE": str(
+                (spec.replicas if spec else None) or 1
+            ),
+            "SERVING_JOB": f"{job.namespace}/{job.name}",
+            "SERVING_PORT": str(port),
+            "TPU_SLICE_SHAPE": job.slice_shape,
+        }
+        c = objects.find_container(pod_template, self.CONTAINER_NAME)
+        targets = (
+            [c]
+            if c is not None
+            else pod_template.get("spec", {}).get("containers", []) or []
+        )
+        for container in targets:
+            for k, v in env.items():
+                objects.set_env(container, k, v)
+
+    def is_master_role(
+        self, replicas: Dict[str, common.ReplicaSpec], rtype: str, index: int
+    ) -> bool:
+        return False  # a fleet has no master; the router is outside it
+
+    def update_job_status(self, engine: JobEngine, job, ctx: StatusContext) -> None:
+        with engine.tracer.span("TPUServingJob.status_rules"):
+            self._update_job_status(engine, job, ctx)
+
+    def _update_job_status(
+        self, engine: JobEngine, job, ctx: StatusContext
+    ) -> None:
+        """Fleet semantics: Running while ANY replica is active (a
+        degraded fleet still serves); Failed only when every replica
+        failed permanently with nothing restarting; Succeeded when every
+        replica exited clean (batch-inference fleets)."""
+        status = ctx.status
+        rtype = servingapi.REPLICA_REPLICA
+        if rtype not in ctx.replicas:
+            return
+        expected, active, succeeded, failed = ctx.counts(rtype)
+        desired = ctx.replicas[rtype].replicas or 0
+        if active > 0:
+            common.update_job_conditions(
+                status, common.JOB_RUNNING, REASON_RUNNING,
+                f"TPUServingJob {job.namespace}/{job.name} is serving "
+                f"({active}/{desired} replica(s) ready).", ctx.now,
+            )
+        if desired > 0 and expected == 0 and succeeded > 0:
+            msg = (
+                f"TPUServingJob {job.namespace}/{job.name} completed: all "
+                f"replicas exited cleanly."
+            )
+            ctx.record_event("Normal", REASON_SUCCEEDED, msg)
+            if status.completion_time is None:
+                status.completion_time = ctx.now
+            common.update_job_conditions(
+                status, common.JOB_SUCCEEDED, REASON_SUCCEEDED, msg, ctx.now
+            )
+            metrics.JOBS_SUCCEEDED.inc({"job_namespace": job.namespace})
+        elif (
+            failed > 0 and active == 0 and rtype not in ctx.restarted_types
+        ):
+            msg = (
+                f"TPUServingJob {job.namespace}/{job.name} has failed: "
+                f"{failed} replica(s) failed permanently and none are "
+                f"serving."
+            )
+            ctx.record_event("Normal", REASON_FAILED, msg)
+            if status.completion_time is None:
+                status.completion_time = ctx.now
+            common.update_job_conditions(
+                status, common.JOB_FAILED, REASON_FAILED, msg, ctx.now
+            )
+            metrics.JOBS_FAILED.inc({"job_namespace": job.namespace})

@@ -204,6 +204,15 @@ class JobEngine:
         self.adapter = adapter
         self.config = config or EngineConfig()
         self.clock = clock
+        # independent-replica kinds (serving fleets): replicas are
+        # admitted/placed/restarted one at a time — no gang PodGroup, no
+        # cluster-scheduler gang admission, and a replicas edit is a
+        # plain fleet resize (the elastic drain->reshard->resume machine
+        # is a gang concept; scale-in draining is the router's job,
+        # engine/servefleet.py)
+        self._independent = bool(
+            getattr(adapter, "INDEPENDENT_REPLICAS", False)
+        )
         self.tracer = tracer or tracing.get_tracer()
         # indexed informer-cache listers for the dependent kinds (wired by
         # the manager; None when the engine runs bare, e.g. unit tests).
@@ -792,8 +801,8 @@ class JobEngine:
             self._write_status(job, old_status)
             return ReconcileResult()
 
-        # ----- gang PodGroup sync
-        if self.config.enable_gang_scheduling:
+        # ----- gang PodGroup sync (independent-replica kinds never gang)
+        if self.config.enable_gang_scheduling and not self._independent:
             with self._phase("gang_sync"):
                 self._sync_pod_group(job)
 
@@ -804,7 +813,7 @@ class JobEngine:
         # may-create gate; any phase error requeues with the phase state
         # untouched on the API server — the next sync finishes it.
         resize = None
-        if self.config.elastic_resize:
+        if self.config.elastic_resize and not self._independent:
             try:
                 with self._phase("resize"):
                     resize = self._sync_resize(job, status, pods, now_iso)
@@ -828,7 +837,7 @@ class JobEngine:
         gang_admitted = True
         if resize_owns:
             gang_admitted = resize.may_create
-        elif self.scheduler is not None:
+        elif self.scheduler is not None and not self._independent:
             with self._phase("gang_admission"):
                 gang_admitted = self._sync_gang_admission(
                     job, status, pods, now_iso
@@ -1810,7 +1819,7 @@ class JobEngine:
         else:
             template.setdefault("spec", {})["restartPolicy"] = spec.restart_policy
 
-        if self.config.enable_gang_scheduling:
+        if self.config.enable_gang_scheduling and not self._independent:
             user_scheduler = template.get("spec", {}).get("schedulerName")
             if not user_scheduler:
                 template["spec"]["schedulerName"] = self.config.gang_scheduler_name

@@ -736,6 +736,42 @@ SERVING_PAGED_KERNEL_REQUESTS = Counter(
     "linear-view oracle) — the pallas/gather ratio is the "
     "fast-path-adoption signal after a rollout",
 )
+# Serving-fleet control plane (ISSUE 14): the occupancy-aware router
+# (models/router.py) and the telemetry-driven fleet autoscaler
+# (engine/servefleet.py).  The dispatch-reason breakdown is the router's
+# health signal (occupancy vs queued vs redispatch), the replicas-by-
+# state gauge is the fleet's shape, and scale-events-by-direction is the
+# autoscaler's activity — docs/monitoring.md carries the PromQL.
+SERVING_FLEET_REPLICAS = Gauge(
+    f"{PREFIX}_serving_fleet_replicas",
+    "Serving-fleet replicas by state (starting: claimed/created but not "
+    "yet serving; ready: dispatchable; draining: finishing in-flight "
+    "requests before scale-in; unhealthy: heartbeat stale, dispatch "
+    "suspended) — set by the router/autoscaler from live telemetry",
+)
+SERVING_ROUTER_DISPATCH = Counter(
+    f"{PREFIX}_serving_router_dispatch_total",
+    "Router dispatch decisions by reason (occupancy: picked the replica "
+    "with the most free KV blocks and shortest queue; round_robin: "
+    "baseline policy; redispatch: re-routed exactly once off a dead "
+    "replica; queued: no replica had capacity, request parked in the "
+    "router queue; rejected: worst-case KV cost exceeds every known "
+    "replica's whole pool — refused upfront instead of wedging the "
+    "queue head)",
+)
+SERVING_ROUTER_QUEUE_DEPTH = Gauge(
+    f"{PREFIX}_serving_router_queue_depth",
+    "Requests parked in the router's queue because no healthy replica "
+    "had free capacity (bounded per-replica in-flight admission) — "
+    "sustained depth is the scale-out pressure signal",
+)
+SERVING_FLEET_SCALE_EVENTS = Counter(
+    f"{PREFIX}_serving_fleet_scale_events_total",
+    "Fleet autoscaler actions by direction (dir=out: replica added on a "
+    "queue-wait/blocked-admission trigger; dir=in: replica drained and "
+    "removed on the occupancy floor) — each event also lands as a "
+    "DECISIONS record on the owning TPUServingJob's timeline",
+)
 SERVING_KV_WINDOW_EVICTED = Counter(
     f"{PREFIX}_serving_kv_window_evicted_blocks_total",
     "KV block epochs retired by sliding-window rotation: a windowed "

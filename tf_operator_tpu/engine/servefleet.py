@@ -1,0 +1,585 @@
+"""Serving-fleet autoscaler — telemetry-driven scale-out/in for TPUServingJob.
+
+The data plane (serve_loop + paged KV pool) exports exactly the signals
+a fleet controller needs, and this module is the loop that acts on them:
+
+  - **Scale OUT** when requests are visibly waiting on capacity:
+    fleet queue-wait p99 over a sliding window crosses
+    `scaleOutQueueWaitP99S`, or `serving_admission_blocked_on_memory`
+    grew by >= `scaleOutBlockedAdmissions` since the last tick (the
+    memory gate is parking admissions — more replicas is the only fix
+    short of more HBM).  The action is a +1 replicas patch on the CR;
+    the engine's ordinary create path then claims a warm-pool standby
+    (PR 7), so reaction time is one claim latency, not an image pull.
+  - **Scale IN** when the fleet pays for memory nobody uses: KV-block
+    occupancy (used/total across replicas) stays under
+    `scaleInOccupancyFloor` with no queue pressure.  Scale-in is
+    TWO-PHASE so no request is ever dropped: the victim (always the
+    highest-indexed replica — the one the engine's scale-down delete
+    will take) is first marked draining (`kubeflow.org/fleet-drain`
+    annotation; the router stops dispatching to it), and only once its
+    in-flight count reads zero is the replicas count patched down —
+    `replica_drained` lands on the timeline between `scale_in` and the
+    pod delete.
+
+Every action is a DECISIONS record on the owning TPUServingJob's
+timeline (source `servefleet`, detail carrying the trigger metric and
+its observed value vs threshold), so `tpu-jobs timeline` explains every
+autoscale the way it already explains every preemption.
+
+`AutoscalePolicy` is the pure decision function — no cluster, no
+threads — shared verbatim by the operator loop here and the
+deterministic fleet simulation (models/fleetsim.py) that `make
+bench-fleet` and the seeded chaos tests drive, so the benched policy IS
+the shipped policy.
+
+Telemetry transport: replicas push via `FleetAutoscaler.report()` — the
+in-process stand-in for scraping each replica's /metrics (the families
+exist; the scrape loop is deployment plumbing).  A process-global
+fleet-status registry feeds `tpu-jobs describe`'s fleet section.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from tf_operator_tpu.api import servingjob as servingapi
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.k8s import objects
+
+# CR annotation naming replicas the router must stop dispatching to (a
+# JSON list of pod names): the coordination channel between the
+# operator-side autoscaler and the serving-side router — a front-end
+# router applies it via FleetRouter.sync_drains(drain_targets(job)) on
+# CR watch events (the fleet harness/in-process hook short-circuits it)
+DRAIN_ANNOTATION = "kubeflow.org/fleet-drain"
+
+_QUEUE_WAIT_WINDOW_S = 30.0
+
+
+def ceil_rank_percentile(samples: List[float], q: float) -> float:
+    """Ceil-rank percentile over raw samples (q in (0, 1]) — THE one
+    quantile convention shared by the autoscaler's queue-wait p99 and
+    the fleet simulation's scoring, so the benched policy and the
+    shipped policy cannot silently diverge on what 'p99' means.
+    Returns 0.0 for an empty sample set."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    rank = -(-int(q * 100) * len(ordered) // 100)
+    return ordered[min(max(rank, 1), len(ordered)) - 1]
+
+
+def drain_targets(job: Dict[str, Any]) -> List[str]:
+    """Parse a TPUServingJob's fleet-drain annotation into the replica
+    names the router must stop dispatching to (the read side of
+    DRAIN_ANNOTATION; malformed/absent reads as empty)."""
+    ann = (job.get("metadata") or {}).get("annotations") or {}
+    try:
+        targets = json.loads(ann.get(DRAIN_ANNOTATION, "[]"))
+    except ValueError:
+        return []
+    return [t for t in targets if isinstance(t, str)] if isinstance(
+        targets, list) else []
+
+
+@dataclasses.dataclass
+class ReplicaTelemetry:
+    """One replica's most recent report (its own serving families)."""
+
+    free_blocks: int = 0
+    total_blocks: int = 0
+    queue_depth: int = 0
+    inflight: int = 0
+    blocked_total: int = 0  # cumulative admission_blocked_on_memory_total
+    ts: float = 0.0
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    direction: Optional[str] = None  # "out" | "in" | None
+    trigger: str = ""                # metric family that fired
+    value: float = 0.0
+    threshold: float = 0.0
+
+    @property
+    def detail(self) -> Dict[str, Any]:
+        return {
+            "trigger": self.trigger,
+            "value": round(self.value, 4),
+            "threshold": self.threshold,
+        }
+
+
+class AutoscalePolicy:
+    """The pure scale decision: thresholds in, direction out.  Stateless
+    except for the cooldown clocks — shared by the operator loop and the
+    fleet simulation so both act identically on the same telemetry.
+
+    Cooldowns are DIRECTION-AWARE, the standard autoscaler asymmetry:
+    scale-out repeats quickly (a burst needs the whole ramp now; the cap
+    is maxReplicas, and overshoot costs idle replicas for seconds),
+    scale-in waits long (tearing a replica down re-queues nothing but
+    re-claiming one costs a warm standby — flapping is pure waste)."""
+
+    def __init__(
+        self,
+        spec: servingapi.AutoscaleSpec,
+        out_cooldown_s: float = 1.0,
+        in_cooldown_s: float = 10.0,
+        cooldown_s: Optional[float] = None,
+    ) -> None:
+        self.spec = spec
+        if cooldown_s is not None:  # symmetric override (tests)
+            out_cooldown_s = in_cooldown_s = cooldown_s
+        self.out_cooldown_s = float(out_cooldown_s)
+        self.in_cooldown_s = float(in_cooldown_s)
+        self._cooldown_until = 0.0
+
+    def decide(
+        self,
+        now: float,
+        replicas: int,
+        queue_wait_p99_s: float,
+        blocked_delta: int,
+        occupancy: Optional[float],
+    ) -> ScaleDecision:
+        """`occupancy` None means NO block telemetry exists (no replica
+        has reported) — unknown, not idle: scale-in is vetoed, because
+        draining a fleet whose scrape loop is down would shrink a
+        possibly-saturated fleet to minReplicas on zero evidence."""
+        s = self.spec
+        if now < self._cooldown_until:
+            return ScaleDecision()
+        if replicas < s.max_replicas:
+            if queue_wait_p99_s > s.scale_out_queue_wait_p99_s:
+                return ScaleDecision(
+                    "out", "serving_queue_wait_seconds_p99",
+                    queue_wait_p99_s, s.scale_out_queue_wait_p99_s,
+                )
+            if blocked_delta >= s.scale_out_blocked_admissions:
+                return ScaleDecision(
+                    "out", "serving_admission_blocked_on_memory_total",
+                    float(blocked_delta),
+                    float(s.scale_out_blocked_admissions),
+                )
+        if (
+            occupancy is not None
+            and replicas > s.min_replicas
+            and occupancy < s.scale_in_occupancy_floor
+            and blocked_delta == 0
+            and queue_wait_p99_s <= s.scale_out_queue_wait_p99_s / 2.0
+        ):
+            # under the floor AND no queue pressure: one replica's worth
+            # of capacity is idle memory
+            return ScaleDecision(
+                "in", "serving_kv_block_occupancy",
+                occupancy, s.scale_in_occupancy_floor,
+            )
+        return ScaleDecision()
+
+    def acted(self, now: float, direction: str = "in") -> None:
+        cool = (
+            self.out_cooldown_s if direction == "out" else self.in_cooldown_s
+        )
+        self._cooldown_until = now + cool
+
+
+# --------------------------------------------------------------------------
+# process-global fleet status (CLI describe's fleet section) — mirrors
+# timeline.get_recorder(): the operator process registers, readers fall
+# back to "nothing known" cleanly
+# --------------------------------------------------------------------------
+_STATUS_LOCK = threading.Lock()
+_FLEET_STATUS: Dict[str, Dict[str, Any]] = {}
+
+
+def fleet_status(job_key: str) -> Optional[Dict[str, Any]]:
+    with _STATUS_LOCK:
+        doc = _FLEET_STATUS.get(job_key)
+        return json.loads(json.dumps(doc)) if doc is not None else None
+
+
+def _set_fleet_status(job_key: str, doc: Dict[str, Any]) -> None:
+    with _STATUS_LOCK:
+        _FLEET_STATUS[job_key] = doc
+
+
+def _drop_fleet_status(job_key: str) -> None:
+    with _STATUS_LOCK:
+        _FLEET_STATUS.pop(job_key, None)
+
+
+def reset_fleet_status() -> None:
+    """Test isolation hook."""
+    with _STATUS_LOCK:
+        _FLEET_STATUS.clear()
+
+
+class FleetAutoscaler:
+    """The operator half: watches TPUServingJobs, aggregates per-replica
+    telemetry, and edits `spec.servingReplicaSpecs.Replica.replicas`.
+    One per process (the coordinator's loop; shards never run their own —
+    two autoscalers patching one CR would fight the cooldown)."""
+
+    KIND = servingapi.KIND
+
+    def __init__(
+        self,
+        cluster,
+        interval: float = 1.0,
+        clock: Callable[[], float] = time.time,
+        recorder=None,
+        cooldown_s: Optional[float] = None,
+        drain_timeout_s: float = 30.0,
+    ) -> None:
+        self.cluster = cluster
+        self.interval = float(interval)
+        self.clock = clock
+        self.recorder = recorder
+        self.cooldown_s = (
+            cooldown_s if cooldown_s is not None else max(5.0, 2 * interval)
+        )
+        # how long a drain may wait on a victim that stopped reporting:
+        # a victim that died permanently mid-drain (exit 1 — never
+        # replaced, never reports again) must not wedge autoscaling for
+        # the job forever; past the timeout the drain completes on the
+        # evidence available (a dead replica has nothing in flight to
+        # protect — pod-level recovery is the ExitCode machinery's job)
+        self.drain_timeout_s = float(drain_timeout_s)
+        # job key -> replica name -> latest report
+        self._telemetry: Dict[str, Dict[str, ReplicaTelemetry]] = {}
+        # job key -> sliding window of (ts, queue_wait_s) samples
+        self._queue_waits: Dict[str, "deque"] = {}
+        # job key -> replica -> blocked_total at the previous tick
+        self._blocked_prev: Dict[str, Dict[str, int]] = {}
+        self._policies: Dict[str, AutoscalePolicy] = {}
+        # job key -> replica currently draining toward a -1 patch
+        self._draining: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        # job keys seen on the previous tick: a key that disappears was
+        # deleted — its telemetry/policy/status state is garbage-collected
+        # (without this, state for deleted jobs persists for the
+        # operator's lifetime)
+        self._known: set = set()
+        # job key -> when the current drain began (the timeout anchor)
+        self._drain_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # optional coupling hooks for an in-process router (the fleet
+        # harness / a colocated front-end); the annotation remains the
+        # cross-process channel
+        self.on_drain: Optional[Callable[[str, str], None]] = None
+        self.inflight_of: Optional[Callable[[str, str], int]] = None
+
+    # ------------------------------------------------------------ telemetry
+    def report(
+        self,
+        job_key: str,
+        replica: str,
+        free_blocks: int = 0,
+        total_blocks: int = 0,
+        queue_depth: int = 0,
+        inflight: int = 0,
+        blocked_total: int = 0,
+        queue_waits: Optional[List[float]] = None,
+        ts: Optional[float] = None,
+    ) -> None:
+        """One replica's telemetry push (the scrape stand-in).
+        `queue_waits` carries the queue-wait seconds of requests admitted
+        since the replica's last report — the p99 source."""
+        now = self.clock() if ts is None else ts
+        with self._lock:
+            self._telemetry.setdefault(job_key, {})[replica] = (
+                ReplicaTelemetry(
+                    free_blocks=int(free_blocks),
+                    total_blocks=int(total_blocks),
+                    queue_depth=int(queue_depth),
+                    inflight=int(inflight),
+                    blocked_total=int(blocked_total),
+                    ts=now,
+                )
+            )
+            window = self._queue_waits.setdefault(
+                job_key, deque(maxlen=4096)
+            )
+            for w in queue_waits or ():
+                window.append((now, float(w)))
+
+    def forget(self, job_key: str) -> None:
+        with self._lock:
+            self._telemetry.pop(job_key, None)
+            self._queue_waits.pop(job_key, None)
+            self._blocked_prev.pop(job_key, None)
+            self._policies.pop(job_key, None)
+            self._draining.pop(job_key, None)
+            self._drain_since.pop(job_key, None)
+        _drop_fleet_status(job_key)
+
+    def _queue_wait_p99(self, job_key: str, now: float) -> float:
+        window = self._queue_waits.get(job_key)
+        if not window:
+            return 0.0
+        while window and now - window[0][0] > _QUEUE_WAIT_WINDOW_S:
+            window.popleft()
+        return ceil_rank_percentile([w for _, w in window], 0.99)
+
+    def _blocked_delta(self, job_key: str, tele: Dict[str, ReplicaTelemetry]) -> int:
+        prev = self._blocked_prev.setdefault(job_key, {})
+        delta = 0
+        for rid, t in tele.items():
+            delta += max(0, t.blocked_total - prev.get(rid, 0))
+            prev[rid] = t.blocked_total
+        for rid in list(prev):
+            if rid not in tele:
+                del prev[rid]
+        return delta
+
+    # -------------------------------------------------------------- control
+    def tick(self) -> None:
+        """One autoscale pass over every TPUServingJob in scope; state
+        for jobs that disappeared since the last pass is dropped."""
+        try:
+            jobs = self.cluster.list(self.KIND)
+        except Exception:  # noqa: BLE001 — storm; next tick retries
+            return
+        seen = set()
+        for job in jobs:
+            md = job.get("metadata") or {}
+            seen.add(f"{objects.namespace_of(job)}/{md.get('name', '')}")
+            try:
+                self._tick_job(job)
+            except Exception:  # noqa: BLE001 — conflict/storm on one job
+                continue       # must not starve the others; next tick retries
+        for gone in self._known - seen:
+            self.forget(gone)
+        self._known = seen
+
+    @staticmethod
+    def _replicas_of(job: Dict[str, Any]) -> Optional[int]:
+        spec = (job.get("spec") or {}).get("servingReplicaSpecs") or {}
+        replica = spec.get(servingapi.REPLICA_REPLICA) or {}
+        return replica.get("replicas")
+
+    def _patch_replicas(self, job: Dict[str, Any], count: int,
+                        drain: Optional[List[str]] = None) -> None:
+        spec = job.setdefault("spec", {}).setdefault(
+            "servingReplicaSpecs", {}
+        ).setdefault(servingapi.REPLICA_REPLICA, {})
+        spec["replicas"] = count
+        ann = job.setdefault("metadata", {}).setdefault("annotations", {})
+        if drain:
+            ann[DRAIN_ANNOTATION] = json.dumps(sorted(drain))
+        else:
+            ann.pop(DRAIN_ANNOTATION, None)
+        self.cluster.update(self.KIND, job)
+
+    def _clear_drain_annotation(self, job: Dict[str, Any]) -> None:
+        ann = (job.get("metadata") or {}).get("annotations") or {}
+        if DRAIN_ANNOTATION not in ann:
+            return
+        ann.pop(DRAIN_ANNOTATION, None)
+        job.setdefault("metadata", {})["annotations"] = ann
+        self.cluster.update(self.KIND, job)
+
+    def _record(self, job: Dict[str, Any], event: str,
+                detail: Dict[str, Any]) -> None:
+        if self.recorder is None:
+            return
+        md = job.get("metadata") or {}
+        self.recorder.record(
+            f"{objects.namespace_of(job)}/{md.get('name', '')}",
+            "servefleet", event, detail, uid=md.get("uid"),
+        )
+
+    def _tick_job(self, job: Dict[str, Any]) -> None:
+        md = job.get("metadata") or {}
+        job_key = f"{objects.namespace_of(job)}/{md.get('name', '')}"
+        auto = servingapi.AutoscaleSpec.from_dict(
+            (job.get("spec") or {}).get("autoscale")
+        )
+        replicas = self._replicas_of(job)
+        now = self.clock()
+        with self._lock:
+            tele = dict(self._telemetry.get(job_key, {}))
+            p99 = self._queue_wait_p99(job_key, now)
+            blocked = self._blocked_delta(job_key, tele)
+        used = sum(
+            t.total_blocks - t.free_blocks for t in tele.values()
+        )
+        total = sum(t.total_blocks for t in tele.values())
+        # total == 0 means NO replica has reported block telemetry:
+        # unknown, not idle — decide() vetoes scale-in on None
+        occupancy = (used / total) if total else None
+        self._publish_status(job_key, replicas, tele, occupancy or 0.0, p99)
+        if auto is None or replicas is None:
+            # autoscaling removed (or the spec lost its count): a drain
+            # left mid-flight must be RELEASED, not parked forever — the
+            # annotation would keep the victim fenced off dispatch while
+            # nothing ever finishes the scale-in
+            victim = self._draining.pop(job_key, None)
+            if victim is not None:
+                self._drain_since.pop(job_key, None)
+                if replicas is not None:
+                    self._patch_replicas(job, replicas, drain=None)
+                else:
+                    # no count to re-assert, but the annotation must
+                    # still come off — a fenced victim with nothing ever
+                    # finishing the scale-in serves nobody forever
+                    self._clear_drain_annotation(job)
+            return
+        # ----- phase 2 of a scale-in: the victim finished draining?
+        victim = self._draining.get(job_key)
+        if victim is not None:
+            timed_out = False
+            if self.inflight_of is not None:
+                # in-process router hook: live truth, wait it out
+                inflight = self.inflight_of(job_key, victim)
+            else:
+                # telemetry path: a victim that died permanently
+                # mid-drain never reports again — its last report's
+                # inflight would wedge this job's autoscaling forever.
+                # Stale/absent reports (or a drain older than the
+                # timeout) complete the drain on the evidence available:
+                # a dead replica has nothing in flight to protect, and a
+                # hung one is bounded disruption vs a permanent wedge.
+                t = tele.get(victim)
+                inflight = t.inflight if t is not None else 0
+                started = self._drain_since.setdefault(job_key, now)
+                timed_out = (
+                    t is None
+                    or now - t.ts > self.drain_timeout_s
+                    or now - started > self.drain_timeout_s
+                )
+            if inflight > 0 and not timed_out:
+                return  # keep waiting; dispatch to it is already stopped
+            target = max(replicas - 1, auto.min_replicas)
+            self._drain_since.pop(job_key, None)
+            del self._draining[job_key]
+            if target >= replicas:
+                # minReplicas was raised mid-drain at or past the
+                # current count: the drain is ABANDONED — the victim is
+                # released at the UNCHANGED count (growing the fleet is
+                # the decide() path's job, and recording a
+                # replica_drained / dir=in here would report a scale-in
+                # that never happened)
+                self._patch_replicas(job, replicas, drain=None)
+                self._policy_for(job_key, auto).acted(now, "in")
+                return
+            self._patch_replicas(job, target, drain=None)
+            # retire the deleted replica's telemetry: a ghost report must
+            # not keep deflating fleet occupancy (or show as draining in
+            # describe) after the pod is gone
+            with self._lock:
+                self._telemetry.get(job_key, {}).pop(victim, None)
+                self._blocked_prev.get(job_key, {}).pop(victim, None)
+                tele.pop(victim, None)
+            self._publish_status(job_key, target, tele, occupancy, p99)
+            metrics.SERVING_FLEET_SCALE_EVENTS.inc({"dir": "in"})
+            detail = {"replica": victim, "replicas": target}
+            if timed_out and inflight > 0:
+                detail["timed_out"] = True
+            self._record(job, "replica_drained", detail)
+            self._note_scale(job_key, "in", victim, now)
+            self._policy_for(job_key, auto).acted(now, "in")
+            return
+        decision = self._policy_for(job_key, auto).decide(
+            now, replicas, p99, blocked, occupancy
+        )
+        if decision.direction == "out":
+            target = min(replicas + 1, auto.max_replicas)
+            self._patch_replicas(job, target)
+            metrics.SERVING_FLEET_SCALE_EVENTS.inc({"dir": "out"})
+            self._record(job, "scale_out",
+                         {**decision.detail, "replicas": target})
+            self._note_scale(job_key, "out", decision.trigger, now)
+            self._policy_for(job_key, auto).acted(now, "out")
+        elif decision.direction == "in":
+            # phase 1: pick the victim the engine's scale-down delete
+            # will take (highest index) and stop dispatch to it
+            victim = self._victim_of(job, replicas)
+            if victim is None:
+                return
+            self._draining[job_key] = victim
+            self._drain_since[job_key] = now
+            self._patch_replicas(job, replicas, drain=[victim])
+            self._record(job, "scale_in",
+                         {**decision.detail, "replica": victim})
+            if self.on_drain is not None:
+                self.on_drain(job_key, victim)
+
+    def _policy_for(self, job_key: str,
+                    auto: servingapi.AutoscaleSpec) -> AutoscalePolicy:
+        policy = self._policies.get(job_key)
+        if policy is None or policy.spec != auto:
+            # a changed autoscale block gets fresh thresholds but keeps
+            # the running cooldown — a spec edit must not grant a free
+            # immediate scale action
+            fresh = AutoscalePolicy(
+                auto, out_cooldown_s=self.interval,
+                in_cooldown_s=self.cooldown_s,
+            )
+            if policy is not None:
+                fresh._cooldown_until = policy._cooldown_until
+            self._policies[job_key] = fresh
+            policy = fresh
+        return policy
+
+    def _victim_of(self, job: Dict[str, Any], replicas: int) -> Optional[str]:
+        if replicas < 1:
+            return None
+        name = (job.get("metadata") or {}).get("name", "")
+        rt = servingapi.REPLICA_REPLICA.lower()
+        return f"{name}-{rt}-{replicas - 1}"
+
+    def _note_scale(self, job_key: str, direction: str, what: str,
+                    now: float) -> None:
+        with _STATUS_LOCK:
+            doc = _FLEET_STATUS.setdefault(job_key, {})
+            doc["last_scale"] = {
+                "dir": direction, "detail": what, "t": round(now, 3),
+            }
+
+    def _publish_status(
+        self, job_key: str, replicas: Optional[int],
+        tele: Dict[str, ReplicaTelemetry], occupancy: float, p99: float,
+    ) -> None:
+        with _STATUS_LOCK:
+            doc = _FLEET_STATUS.setdefault(job_key, {})
+            doc["replicas"] = replicas
+            doc["occupancy"] = round(occupancy, 4)
+            doc["queue_wait_p99_s"] = round(p99, 4)
+            doc["per_replica"] = {
+                rid: {
+                    "free_blocks": t.free_blocks,
+                    "total_blocks": t.total_blocks,
+                    "queue_depth": t.queue_depth,
+                    "inflight": t.inflight,
+                }
+                for rid, t in sorted(tele.items())
+            }
+            doc["draining"] = self._draining.get(job_key)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.tick()

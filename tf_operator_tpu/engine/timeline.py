@@ -83,6 +83,10 @@ _SCHEDULED_MARKS = frozenset({
 # exactly what it exists to remember.
 _DECISION_SOURCES = frozenset({
     "scheduler", "warmpool", "fencing", "chaos", "shard", "controller",
+    # fleet autoscaler (engine/servefleet.py): scale_out / scale_in /
+    # replica_drained — the records that explain why a serving fleet
+    # changed shape, each carrying the trigger metric and its value
+    "servefleet",
 })
 # controller events that are routine cadence, not decisions: a job
 # parked in a long crash-loop backoff window re-records its wait every

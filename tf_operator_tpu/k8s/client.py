@@ -110,7 +110,9 @@ class KindInfo:
 # Job CRDs carry the status subresource (manifests/base/crds/*.yaml set
 # `subresources: {status: {}}`), so plain PUTs to the main resource drop
 # status changes — update() below routes status writes to /status.
-_JOB_KINDS = ("TFJob", "PyTorchJob", "MXJob", "XGBoostJob", "TPUJob")
+_JOB_KINDS = (
+    "TFJob", "PyTorchJob", "MXJob", "XGBoostJob", "TPUJob", "TPUServingJob"
+)
 
 KIND_REGISTRY: Dict[str, KindInfo] = {
     "Pod": KindInfo("", "v1", "pods"),

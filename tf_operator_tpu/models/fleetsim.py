@@ -1,0 +1,505 @@
+"""Deterministic serving-fleet simulation — the bench/chaos harness for
+the occupancy router (models/router.py) and the autoscale policy
+(engine/servefleet.AutoscalePolicy).
+
+Real replicas are `serve_loop` processes; driving N of them with 1k+
+concurrent users on a CI box is neither feasible nor deterministic.
+This module models exactly the serve_loop mechanics the router and
+autoscaler react to — and nothing else:
+
+  - `SimReplica`: a fixed set of decode lanes over a fixed KV block
+    pool.  Admission is memory-gated FIFO (a request needs
+    ceil((prompt+max_new)/block_size) blocks or it waits at the head,
+    counted into `blocked_total` like
+    serving_admission_blocked_on_memory_total); prefill is a single
+    sequential channel (serve_loop prefills off the batch, one row at a
+    time — a long prompt is head-of-line latency for every admission
+    behind it); decode emits tokens per lane at a fixed rate.  All
+    arithmetic, no threads, no wall clock.
+  - `FleetHarness`: couples SimReplicas to a FleetRouter and an
+    AutoscalePolicy on one SimClock: arrivals from a seeded trace,
+    heartbeats at a fixed cadence, router health sweeps, warm-pool
+    claim latency for scale-out (a standby becomes a ready replica one
+    claim latency after the decision — the PR 7 mechanism, simulated),
+    two-phase drain for scale-in, and seeded replica kills for the
+    chaos soak.  Every decision lands in one merged event log that is a
+    pure function of (seed, config): the byte-identity surface
+    tests/test_zfleet.py asserts.
+
+`make bench-fleet` (bench.bench_fleet) runs three fleets over the same
+trace — one big static replica, round-robin over a fixed fleet, and the
+occupancy router + autoscaler — and BENCH_r13.json carries the rows.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from random import Random
+from typing import Dict, List, Optional, Tuple
+
+from tf_operator_tpu.engine.servefleet import (
+    AutoscalePolicy, ceil_rank_percentile,
+)
+from tf_operator_tpu.k8s.chaos import SimClock
+from tf_operator_tpu.models.router import (
+    FleetRouter, READY, STARTING, ServeRequest,
+)
+
+
+@dataclasses.dataclass
+class ReplicaConfig:
+    """One replica's capacity model (scaled up for the static-big arm)."""
+
+    slots: int = 4                 # concurrent decode lanes
+    pool_blocks: int = 160         # KV block pool (scratch excluded)
+    block_size: int = 16
+    prefill_tps: float = 1500.0    # sequential prefill channel, tokens/s
+    decode_tps: float = 32.0       # per-lane decode, tokens/s
+
+    def scaled(self, n: int) -> "ReplicaConfig":
+        return ReplicaConfig(
+            slots=self.slots * n,
+            pool_blocks=self.pool_blocks * n,
+            block_size=self.block_size,
+            prefill_tps=self.prefill_tps * n,
+            decode_tps=self.decode_tps,
+        )
+
+
+class _Lane:
+    __slots__ = ("req", "arrival_t", "admit_t", "prefill_left",
+                 "tokens_out", "first_token_t", "blocks")
+
+    def __init__(self, req: ServeRequest, arrival_t: float, admit_t: float,
+                 blocks: int) -> None:
+        self.req = req
+        self.arrival_t = arrival_t
+        self.admit_t = admit_t
+        self.prefill_left = float(req.prompt_len)
+        self.tokens_out = 0.0
+        self.first_token_t: Optional[float] = None
+        self.blocks = blocks
+
+
+class SimReplica:
+    """Deterministic serve_loop stand-in.  See module docs."""
+
+    def __init__(self, rid: str, cfg: ReplicaConfig) -> None:
+        self.rid = rid
+        self.cfg = cfg
+        self.alive = True
+        self.free_blocks = cfg.pool_blocks
+        self.queue: "deque[Tuple[ServeRequest, float]]" = deque()
+        self.lanes: List[_Lane] = []
+        self.blocked_total = 0
+        # blocked-admission sampling cadence: the real loop samples once
+        # per serve iteration (~a decode block), not once per sim step
+        self._last_blocked_t = -1.0
+        # queue-wait seconds of requests admitted since the last
+        # heartbeat drain (the autoscaler's p99 source)
+        self.new_queue_waits: List[float] = []
+
+    # ------------------------------------------------------------- intake
+    def enqueue(self, req: ServeRequest, arrival_t: float) -> None:
+        self.queue.append((req, arrival_t))
+
+    def inflight(self) -> int:
+        return len(self.queue) + len(self.lanes)
+
+    # ------------------------------------------------------------- service
+    def _admit(self, now: float) -> None:
+        admitted_any = False
+        while self.queue and len(self.lanes) < self.cfg.slots:
+            req, arrival_t = self.queue[0]
+            blocks = req.blocks(self.cfg.block_size)
+            if blocks > self.free_blocks:
+                if not admitted_any and now - self._last_blocked_t >= 0.25:
+                    # memory gate holds the FIFO head: one blocked
+                    # sample per service iteration, like the serve loop
+                    self.blocked_total += 1
+                    self._last_blocked_t = now
+                break
+            self.queue.popleft()
+            self.free_blocks -= blocks
+            self.lanes.append(_Lane(req, arrival_t, now, blocks))
+            self.new_queue_waits.append(max(0.0, now - arrival_t))
+            admitted_any = True
+
+    def step(self, now: float, dt: float) -> List[dict]:
+        """Advance dt seconds; returns completion records."""
+        if not self.alive:
+            return []
+        self._admit(now)
+        done: List[dict] = []
+        # ONE sequential prefill channel: the earliest-admitted lane
+        # still prefilling gets the whole budget (serve_loop prefills
+        # off-batch, one row at a time)
+        budget = self.cfg.prefill_tps * dt
+        for lane in self.lanes:
+            if lane.prefill_left <= 0 or budget <= 0:
+                continue
+            used = min(lane.prefill_left, budget)
+            lane.prefill_left -= used
+            budget -= used
+        # decode: every prefilled lane emits tokens
+        for lane in list(self.lanes):
+            if lane.prefill_left > 0:
+                continue
+            lane.tokens_out += self.cfg.decode_tps * dt
+            if lane.first_token_t is None and lane.tokens_out >= 1.0:
+                lane.first_token_t = now + dt
+            if lane.tokens_out >= lane.req.max_new:
+                self.lanes.remove(lane)
+                self.free_blocks += lane.blocks
+                done.append({
+                    "rid": lane.req.rid,
+                    "arrival_t": lane.arrival_t,
+                    "admit_t": lane.admit_t,
+                    "first_token_t": lane.first_token_t or (now + dt),
+                    "finish_t": now + dt,
+                    "tokens": int(lane.req.max_new),
+                    "replica": self.rid,
+                })
+        if done:
+            self._admit(now)
+        return done
+
+    # ------------------------------------------------------------ telemetry
+    def heartbeat(self) -> dict:
+        waits, self.new_queue_waits = self.new_queue_waits, []
+        return {
+            "free_blocks": self.free_blocks,
+            "total_blocks": self.cfg.pool_blocks,
+            "queue_depth": len(self.queue),
+            "inflight": self.inflight(),
+            "blocked_total": self.blocked_total,
+            "queue_waits": waits,
+        }
+
+
+def make_trace(
+    seed: int,
+    n_users: int = 1200,
+    horizon_s: float = 240.0,
+    base_rate: float = 2.2,
+    burst_rate: float = 9.0,
+    bursts: Tuple[Tuple[float, float], ...] = ((60.0, 20.0), (150.0, 25.0)),
+) -> List[Tuple[float, ServeRequest]]:
+    """Seeded diurnal/bursty USER SESSIONS with heavy-tailed prompts.
+    Each of the `n_users` simulated users starts a session on a
+    diurnally-ramped arrival process (0.6x the base rate early, 1.4x
+    late) with burst windows at `bursts` ((start, duration)) where the
+    session rate jumps to `burst_rate` — the regime where blind dispatch
+    convoys and a fixed fleet drowns.  A session issues 1-3 requests
+    separated by think time, so users overlap across the horizon.  Every
+    timestamp/length is a pure function of the seed."""
+    rng = Random(seed)
+    arrivals: List[Tuple[float, ServeRequest]] = []
+    t = 0.0
+    for i in range(n_users):
+        # diurnal ramp on SESSION starts
+        frac = min(1.0, t / horizon_s)
+        rate = base_rate * (0.6 + 0.8 * frac)
+        for start, dur in bursts:
+            if start <= t < start + dur:
+                rate = burst_rate
+                break
+        t += rng.expovariate(rate)
+        if t >= horizon_s:
+            # wrap remaining users into the tail at the base rate so the
+            # trace always carries exactly n_users sessions
+            t = max(t, horizon_s) + rng.expovariate(base_rate)
+        n_req = 1 + (rng.random() < 0.6) + (rng.random() < 0.25)
+        rt = t
+        for k in range(n_req):
+            if k:
+                rt += rng.uniform(8.0, 30.0)  # think time
+            roll = rng.random()
+            if roll < 0.85:
+                prompt = rng.randrange(32, 128)
+            elif roll < 0.97:
+                prompt = rng.randrange(128, 384)
+            else:
+                prompt = rng.randrange(384, 768)  # the heavy tail
+            max_new = rng.randrange(32, 96)
+            arrivals.append((rt, ServeRequest(f"u{i}r{k}", prompt, max_new)))
+    arrivals.sort(key=lambda a: (a[0], a[1].rid))
+    return arrivals
+
+
+class FleetHarness:
+    """One fleet (router + replicas + optional autoscaler) driven over a
+    trace on a SimClock.  Deterministic per (seed, config)."""
+
+    def __init__(
+        self,
+        mode: str,                      # "occupancy" | "round_robin" | "static_big"
+        n_replicas: int = 4,
+        replica_cfg: Optional[ReplicaConfig] = None,
+        autoscale=None,                 # servingjob.AutoscaleSpec or None
+        warm_standbys: int = 4,
+        standby_replenish_s: float = 20.0,
+        claim_latency_s: float = 0.5,
+        cold_latency_s: float = 30.0,
+        heartbeat_s: float = 0.5,
+        autoscale_interval_s: float = 1.0,
+        health_interval_s: float = 2.0,
+        max_inflight_per_replica: int = 12,
+        dt: float = 0.05,
+    ) -> None:
+        self.mode = mode
+        self.cfg = replica_cfg or ReplicaConfig()
+        self.clock = SimClock()
+        self.dt = dt
+        self.heartbeat_s = heartbeat_s
+        self.autoscale_interval_s = autoscale_interval_s
+        self.claim_latency_s = claim_latency_s
+        self.cold_latency_s = cold_latency_s
+        self.warm_standbys = warm_standbys
+        # warm-pool async replenish (PR 7): a claimed standby is replaced
+        # `standby_replenish_s` later, so back-to-back bursts still claim
+        # warm as long as the pool was sized for the scale-out depth
+        self.standby_replenish_s = standby_replenish_s
+        self._replenish_at: List[float] = []
+        policy = "round_robin" if mode in ("round_robin", "static_big") else "occupancy"
+        self.router = FleetRouter(
+            policy=policy,
+            max_inflight_per_replica=max_inflight_per_replica,
+            health_interval=health_interval_s,
+            block_size=self.cfg.block_size,
+            clock=self.clock,
+        )
+        self.log = self.router.events  # one merged deterministic log
+        self.replicas: Dict[str, SimReplica] = {}
+        self._next_idx = 0
+        # rid -> sim time it becomes ready (warm claim / cold create)
+        self._starting: Dict[str, float] = {}
+        self.autoscale = autoscale
+        self.policy = (
+            AutoscalePolicy(
+                autoscale, out_cooldown_s=autoscale_interval_s,
+                in_cooldown_s=20 * autoscale_interval_s,
+            )
+            if autoscale is not None else None
+        )
+        self._blocked_prev: Dict[str, int] = {}
+        self._wait_window: "deque[Tuple[float, float]]" = deque()
+        self._draining: Optional[str] = None
+        self.arrival_t: Dict[str, float] = {}
+        self.results: Dict[str, dict] = {}
+        self.duplicates = 0
+        self.scale_events: List[dict] = []
+        self.kills: List[Tuple[float, str]] = []
+        self.replica_seconds = 0.0
+        self.peak_inflight = 0
+        self.router.on_dispatch = self._on_dispatch
+        if mode == "static_big":
+            self._add_replica(self.cfg.scaled(n_replicas), ready_now=True)
+        else:
+            for _ in range(n_replicas):
+                self._add_replica(self.cfg, ready_now=True)
+
+    # ------------------------------------------------------------- plumbing
+    def _log(self, line: str) -> None:
+        self.log.append(f"t={self.clock():g} {line}")
+
+    def _add_replica(self, cfg: ReplicaConfig, ready_now: bool,
+                     latency: float = 0.0) -> str:
+        rid = f"r{self._next_idx}"
+        self._next_idx += 1
+        self.replicas[rid] = SimReplica(rid, cfg)
+        self.router.add_replica(rid, state=STARTING)
+        if ready_now:
+            hb = self.replicas[rid].heartbeat()
+            self.router.observe(
+                rid, hb["free_blocks"], hb["total_blocks"],
+                hb["queue_depth"],
+            )
+        else:
+            self._starting[rid] = self.clock() + latency
+        return rid
+
+    def _on_dispatch(self, req: ServeRequest, rid: str, reason: str) -> None:
+        replica = self.replicas.get(rid)
+        if replica is not None:
+            replica.enqueue(req, self.arrival_t[req.rid])
+
+    def kill(self, at: float, rid: str) -> None:
+        """Schedule a replica kill (the seeded chaos injection)."""
+        self.kills.append((at, rid))
+        self.kills.sort()
+
+    # ------------------------------------------------------------ autoscale
+    def _p99(self, now: float, window_s: float = 12.0) -> float:
+        while self._wait_window and now - self._wait_window[0][0] > window_s:
+            self._wait_window.popleft()
+        return ceil_rank_percentile(
+            [w for _, w in self._wait_window], 0.99
+        )
+
+    def _autoscale_tick(self, now: float) -> None:
+        while self._replenish_at and self._replenish_at[0] <= now:
+            self._replenish_at.pop(0)
+            self.warm_standbys += 1
+        live = {
+            rid: r for rid, r in self.replicas.items()
+            if r.alive and rid not in self._starting
+        }
+        used = sum(r.cfg.pool_blocks - r.free_blocks for r in live.values())
+        total = sum(r.cfg.pool_blocks for r in live.values())
+        # no live telemetry reads as unknown (scale-in vetoed), not idle
+        occupancy = used / total if total else None
+        blocked_delta = 0
+        for rid, r in live.items():
+            blocked_delta += max(
+                0, r.blocked_total - self._blocked_prev.get(rid, 0)
+            )
+            self._blocked_prev[rid] = r.blocked_total
+        p99 = self._p99(now)
+        if self._draining is not None:
+            if self.router.inflight(self._draining) == 0:
+                victim = self._draining
+                self._draining = None
+                self.router.remove_replica(victim, requeue=False)
+                self.replicas.pop(victim, None)
+                self._blocked_prev.pop(victim, None)
+                self._log(f"scale_in_done replica={victim}")
+                self.scale_events.append({
+                    "dir": "in", "t": now, "replica": victim,
+                })
+                self.policy.acted(now, "in")
+            return
+        fleet = len(live) + len(self._starting)
+        decision = self.policy.decide(
+            now, fleet, p99, blocked_delta, occupancy
+        )
+        if decision.direction == "out":
+            warm = self.warm_standbys > 0
+            latency = self.claim_latency_s if warm else self.cold_latency_s
+            if warm:
+                self.warm_standbys -= 1
+                self._replenish_at.append(now + self.standby_replenish_s)
+                self._replenish_at.sort()
+            rid = self._add_replica(self.cfg, ready_now=False,
+                                    latency=latency)
+            self._log(
+                f"scale_out replica={rid} trigger={decision.trigger} "
+                f"value={decision.value:.3f} warm={int(warm)}"
+            )
+            self.scale_events.append({
+                "dir": "out", "t": now, "replica": rid,
+                "trigger": decision.trigger, "warm": warm,
+                "ready_t": self._starting[rid],
+            })
+            self.policy.acted(now, "out")
+        elif decision.direction == "in":
+            ready = self.router.replicas(state=READY)
+            if len(ready) <= 1:
+                return
+            # highest NUMERIC index: the scale-down delete's pick (rids
+            # are r0..rN — lexical order would pick r9 over r10)
+            victim = max(ready, key=lambda rid: int(rid[1:]))
+            self._draining = victim
+            self.router.drain(victim)
+            self._log(
+                f"scale_in replica={victim} occupancy={occupancy:.3f}"
+            )
+
+    # ---------------------------------------------------------------- run
+    def run(self, trace: List[Tuple[float, ServeRequest]],
+            horizon_s: float = 400.0) -> dict:
+        pending = deque(trace)
+        kills = deque(self.kills)
+        next_hb = 0.0
+        next_scale = 0.0
+        n_total = len(trace)
+        while (len(self.results) < n_total or pending) and self.clock() < horizon_s:
+            self.clock.advance(self.dt)
+            now = self.clock()
+            while pending and pending[0][0] <= now:
+                _, req = pending.popleft()
+                self.arrival_t[req.rid] = now
+                self.router.submit(req)
+            while kills and kills[0][0] <= now:
+                _, rid = kills.popleft()
+                replica = self.replicas.get(rid)
+                if replica is not None and replica.alive:
+                    replica.alive = False
+                    self._log(f"kill replica={rid}")
+            inflight = sum(
+                r.inflight() for r in self.replicas.values() if r.alive
+            ) + self.router.queue_depth()
+            self.peak_inflight = max(self.peak_inflight, inflight)
+            for rid in sorted(self.replicas):
+                replica = self.replicas[rid]
+                if not replica.alive or rid in self._starting:
+                    continue
+                self.replica_seconds += self.dt
+                for rec in replica.step(now - self.dt, self.dt):
+                    if self.router.finish(rid, rec["rid"]):
+                        self.results[rec["rid"]] = rec
+                    else:
+                        self.duplicates += 1
+            for rid, ready_at in sorted(self._starting.items()):
+                if now >= ready_at:
+                    del self._starting[rid]
+                    hb = self.replicas[rid].heartbeat()
+                    self.router.observe(
+                        rid, hb["free_blocks"], hb["total_blocks"],
+                        hb["queue_depth"],
+                    )
+            if now >= next_hb:
+                next_hb = now + self.heartbeat_s
+                for rid in sorted(self.replicas):
+                    replica = self.replicas[rid]
+                    if not replica.alive or rid in self._starting:
+                        continue
+                    hb = replica.heartbeat()
+                    for w in hb["queue_waits"]:
+                        self._wait_window.append((now, w))
+                    self.router.observe(
+                        rid, hb["free_blocks"], hb["total_blocks"],
+                        hb["queue_depth"],
+                    )
+            self.router.tick(now)
+            if self.policy is not None and now >= next_scale:
+                next_scale = now + self.autoscale_interval_s
+                self._autoscale_tick(now)
+        return self.summary(n_total)
+
+    # ------------------------------------------------------------- scoring
+    def summary(self, n_total: int) -> dict:
+        recs = list(self.results.values())
+        ttfts = sorted(r["first_token_t"] - r["arrival_t"] for r in recs)
+        waits = sorted(r["admit_t"] - r["arrival_t"] for r in recs)
+        tokens = sum(r["tokens"] for r in recs)
+        span = (
+            max(r["finish_t"] for r in recs) - min(self.arrival_t.values())
+            if recs else 0.0
+        )
+
+        def pct(xs: List[float], q: float) -> Optional[float]:
+            return round(ceil_rank_percentile(xs, q), 3) if xs else None
+
+        reactions = [
+            round(e["ready_t"] - e["t"], 3)
+            for e in self.scale_events if e["dir"] == "out"
+        ]
+        return {
+            "mode": self.mode,
+            "completed": len(recs),
+            "dropped": n_total - len(recs),
+            "duplicates": self.duplicates,
+            "tokens_per_sec": round(tokens / span, 1) if span else 0.0,
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p99_s": pct(ttfts, 0.99),
+            "queue_wait_p99_s": pct(waits, 0.99),
+            "peak_inflight": self.peak_inflight,
+            "replica_seconds": round(self.replica_seconds, 1),
+            "scale_out_events": sum(
+                1 for e in self.scale_events if e["dir"] == "out"),
+            "scale_in_events": sum(
+                1 for e in self.scale_events if e["dir"] == "in"),
+            "scale_out_reaction_s": reactions,
+            "redispatches": dict(self.router.redispatches),
+        }

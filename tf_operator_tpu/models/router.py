@@ -1,0 +1,506 @@
+"""Occupancy-aware serving-fleet router — dispatch by live KV pressure.
+
+One `serve_loop` replica is a fixed set of decode lanes over a fixed KV
+block pool; a FLEET of them serves real traffic only as well as requests
+are spread across those pools.  Round-robin (and anything else blind to
+occupancy) convoys: heavy-tailed prompt lengths mean one replica
+accumulates long prompts until its memory gate parks everything behind
+them, while a sibling idles — the p99 TTFT pays for the blindness.  This
+router dispatches each request to the replica with the **most free KV
+blocks and the shortest admission queue**, read from the replicas' own
+telemetry (the `serving_kv_blocks_used/total` and queue-depth families
+every replica already exports — PR 9 built the signal for exactly this),
+not from a guess:
+
+  - **Live occupancy**: replicas heartbeat `observe()` with their block
+    pool and queue state.  Between heartbeats the router debits its own
+    dispatches against the last snapshot (`effective free = reported
+    free − blocks committed since the report`), so a burst dispatched
+    inside one heartbeat interval cannot all land on the replica that
+    merely *looked* emptiest.
+  - **Bounded in-flight admission**: at most `max_inflight_per_replica`
+    dispatched-but-unfinished requests per replica.  One long-prompt
+    burst fills a replica's bound and overflows to siblings instead of
+    convoying a queue a sibling could absorb; when no replica has
+    capacity the request parks in the router's FIFO (the queue-depth
+    gauge is the autoscaler's pressure signal).
+  - **Health**: a replica whose heartbeat goes stale for
+    `health_interval` stops receiving dispatches and its unfinished
+    requests re-dispatch to siblings **exactly once** (tracked per
+    request).  Completion is deduplicated by request id, so even a
+    false-positive expiry (replica alive but slow) delivers one result —
+    at-least-once dispatch, at-most-once delivery.
+  - **Drain**: `drain()` stops new dispatch to a replica while its
+    in-flight requests finish — the scale-in half of the autoscaler
+    (engine/servefleet.py) deletes the pod only after `inflight() == 0`,
+    so scale-in never drops a request.
+
+Deterministic by construction: candidate order is a pure function of
+state (score, then replica id), the clock is injected, and every
+decision appends to `events` — the seeded chaos tests assert the log is
+byte-identical per seed (tests/test_zfleet.py).
+
+The round_robin policy is kept as the bench baseline (`make bench-fleet`
+measures exactly what the occupancy policy buys).  No reference
+counterpart (the reference has no serving code at all, SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from tf_operator_tpu.engine import metrics
+
+POLICIES = ("occupancy", "round_robin")
+
+# replica lifecycle states (the serving_fleet_replicas gauge's label set)
+STARTING = "starting"    # pod claimed/created, not yet heartbeating
+READY = "ready"          # dispatchable
+DRAINING = "draining"    # finishing in-flight before scale-in
+UNHEALTHY = "unhealthy"  # heartbeat stale; dispatch suspended
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One inference request as the router sees it: identity plus the
+    worst-case KV cost (prompt + full generation budget — the same math
+    the replica's own memory gate charges at admission)."""
+
+    rid: str
+    prompt_len: int
+    max_new: int
+
+    def blocks(self, block_size: int) -> int:
+        return -(-(self.prompt_len + self.max_new) // block_size)
+
+
+@dataclasses.dataclass
+class ReplicaSnapshot:
+    """One heartbeat's worth of a replica's own telemetry."""
+
+    free_blocks: int
+    total_blocks: int
+    queue_depth: int
+    ts: float
+
+
+class _Replica:
+    __slots__ = (
+        "rid", "state", "snapshot", "inflight", "debit_blocks",
+        "debit_count", "drain_pending", "last_seen",
+    )
+
+    def __init__(self, rid: str, state: str) -> None:
+        self.rid = rid
+        self.state = state
+        self.snapshot: Optional[ReplicaSnapshot] = None
+        # health anchor for a replica with no heartbeat yet: set at
+        # add/mark_ready so a READY replica that NEVER reports still
+        # expires after one health interval (snapshot-None must not
+        # read as healthy-forever)
+        self.last_seen: Optional[float] = None
+        # dispatched-but-unfinished requests, in dispatch order
+        self.inflight: Dict[str, ServeRequest] = {}
+        # blocks/requests committed since the last heartbeat (cleared by
+        # observe(): the fresh report already reflects them)
+        self.debit_blocks = 0
+        self.debit_count = 0
+        # sticky drain fence: survives an UNHEALTHY detour — a draining
+        # replica that misses heartbeats and then recovers must come
+        # back as DRAINING, never READY (the autoscaler is about to
+        # delete it; resuming dispatch would hand it doomed requests)
+        self.drain_pending = False
+
+    def effective_free(self) -> int:
+        if self.snapshot is None:
+            return 0
+        return max(0, self.snapshot.free_blocks - self.debit_blocks)
+
+    def effective_queue(self) -> int:
+        if self.snapshot is None:
+            return 0
+        return self.snapshot.queue_depth + self.debit_count
+
+
+class FleetRouter:
+    """Dispatch front-end over N serving replicas.  See module docs."""
+
+    def __init__(
+        self,
+        policy: str = "occupancy",
+        max_inflight_per_replica: int = 8,
+        health_interval: float = 5.0,
+        block_size: int = 16,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown router policy {policy!r} (choose from {POLICIES})"
+            )
+        self.policy = policy
+        self.max_inflight = int(max_inflight_per_replica)
+        self.health_interval = float(health_interval)
+        self.block_size = int(block_size)
+        self.clock = clock
+        self._replicas: Dict[str, _Replica] = {}
+        self._queue: "deque[ServeRequest]" = deque()
+        self._rr_last: Optional[str] = None
+        # request id -> times re-dispatched off a dead replica; the
+        # exactly-once ledger the chaos soak asserts against
+        self.redispatches: Dict[str, int] = {}
+        # request ids refused at submit because their KV cost exceeds
+        # every known replica's whole pool — the serve loop's own
+        # upfront validation restated at the fleet boundary: queueing
+        # one would park the FIFO head forever and starve everything
+        # behind it
+        self.rejected: List[str] = []
+        self._completed: set = set()
+        # both ledgers are BOUNDED: dedup only has to span the
+        # re-dispatch window, not the router's lifetime — at 100 req/s
+        # an unbounded completed-id set would grow ~8.6M entries/day
+        self._completed_order: "deque[str]" = deque()
+        self._redispatch_order: "deque[str]" = deque()
+        self.ledger_cap = 1 << 16
+        # dispatch callback: (request, replica_id, reason) — the harness
+        # hands the request to the chosen replica here
+        self.on_dispatch: Optional[Callable] = None
+        # deterministic decision log (the seeded chaos byte-identity
+        # surface): every dispatch/queue/health/drain decision, in order
+        self.events: List[str] = []
+
+    # ------------------------------------------------------------- helpers
+    def _log(self, line: str) -> None:
+        self.events.append(f"t={self.clock():g} {line}")
+
+    def _gauge_states(self) -> None:
+        counts: Dict[str, int] = {}
+        for r in self._replicas.values():
+            counts[r.state] = counts.get(r.state, 0) + 1
+        for state in (STARTING, READY, DRAINING, UNHEALTHY):
+            metrics.SERVING_FLEET_REPLICAS.set(
+                counts.get(state, 0), {"state": state}
+            )
+
+    def _queue_gauge(self) -> None:
+        metrics.SERVING_ROUTER_QUEUE_DEPTH.set(len(self._queue))
+
+    def _note_redispatch(self, request_id: str) -> None:
+        if request_id not in self.redispatches:
+            self._redispatch_order.append(request_id)
+            while len(self._redispatch_order) > self.ledger_cap:
+                self.redispatches.pop(self._redispatch_order.popleft(), None)
+        self.redispatches[request_id] = (
+            self.redispatches.get(request_id, 0) + 1
+        )
+
+    def _note_completed(self, request_id: str) -> None:
+        self._completed.add(request_id)
+        self._completed_order.append(request_id)
+        while len(self._completed_order) > self.ledger_cap:
+            self._completed.discard(self._completed_order.popleft())
+
+    # ------------------------------------------------------------ lifecycle
+    def add_replica(self, rid: str, state: str = STARTING) -> None:
+        if rid in self._replicas:
+            return
+        replica = _Replica(rid, state)
+        replica.last_seen = self.clock()
+        self._replicas[rid] = replica
+        self._log(f"replica_added replica={rid} state={state}")
+        self._gauge_states()
+
+    def replica_state(self, rid: str) -> Optional[str]:
+        r = self._replicas.get(rid)
+        return r.state if r is not None else None
+
+    def replicas(self, state: Optional[str] = None) -> List[str]:
+        return sorted(
+            rid for rid, r in self._replicas.items()
+            if state is None or r.state == state
+        )
+
+    def inflight(self, rid: str) -> int:
+        r = self._replicas.get(rid)
+        return len(r.inflight) if r is not None else 0
+
+    def drain(self, rid: str) -> int:
+        """Stop dispatching to `rid`; returns its in-flight count.  The
+        caller (autoscaler) deletes the replica only once this reads 0 —
+        scale-in never drops a request."""
+        r = self._replicas.get(rid)
+        if r is None:
+            return 0
+        r.drain_pending = True
+        if r.state != DRAINING:
+            r.state = DRAINING
+            self._log(f"drain_begin replica={rid} inflight={len(r.inflight)}")
+            self._gauge_states()
+        return len(r.inflight)
+
+    def remove_replica(self, rid: str, requeue: bool = False) -> int:
+        """Forget a replica.  `requeue=True` (replica died) re-dispatches
+        its unfinished requests to siblings, each exactly once; False
+        (clean scale-in after drain) expects an empty in-flight set."""
+        r = self._replicas.pop(rid, None)
+        if r is None:
+            return 0
+        orphans = [
+            req for req in r.inflight.values()
+            if req.rid not in self._completed
+        ]
+        self._log(
+            f"replica_removed replica={rid} requeue={len(orphans) if requeue else 0}"
+        )
+        n = 0
+        if requeue:
+            for req in orphans:
+                self._note_redispatch(req.rid)
+                metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "redispatch"})
+                self._log(f"redispatch req={req.rid} from={rid}")
+                self._place(req)
+                n += 1
+        self._gauge_states()
+        self._queue_gauge()
+        return n
+
+    def mark_ready(self, rid: str) -> None:
+        r = self._replicas.get(rid)
+        if r is not None and r.state in (STARTING, UNHEALTHY):
+            r.state = DRAINING if r.drain_pending else READY
+            r.last_seen = self.clock()
+            self._log(f"replica_ready replica={rid}")
+            self._gauge_states()
+            self.pump()
+
+    def mark_dead(self, rid: str) -> int:
+        """External death signal (operator saw the pod die): remove and
+        re-dispatch in one step."""
+        return self.remove_replica(rid, requeue=True)
+
+    # ------------------------------------------------------------ telemetry
+    def observe(
+        self, rid: str, free_blocks: int, total_blocks: int,
+        queue_depth: int, ts: Optional[float] = None,
+    ) -> None:
+        """A replica heartbeat: its own block-pool and queue telemetry.
+        Clears the router's since-last-heartbeat debits (the fresh report
+        reflects them) and revives an unhealthy replica."""
+        r = self._replicas.get(rid)
+        if r is None:
+            return
+        r.snapshot = ReplicaSnapshot(
+            free_blocks=int(free_blocks), total_blocks=int(total_blocks),
+            queue_depth=int(queue_depth),
+            ts=self.clock() if ts is None else ts,
+        )
+        r.debit_blocks = 0
+        r.debit_count = 0
+        if r.state == STARTING:
+            r.state = DRAINING if r.drain_pending else READY
+            self._log(f"replica_ready replica={rid}")
+            self._gauge_states()
+        elif r.state == UNHEALTHY:
+            # false alarm (or restart reusing the name): dispatchable
+            # again — unless a drain fence is pending, in which case it
+            # comes back DRAINING (the autoscaler is deleting it);
+            # completion dedup keeps delivery at-most-once either way
+            r.state = DRAINING if r.drain_pending else READY
+            self._log(f"replica_recovered replica={rid}")
+            self._gauge_states()
+        self.pump()
+
+    def tick(self, now: Optional[float] = None) -> List[str]:
+        """Health sweep: replicas whose heartbeat is older than
+        `health_interval` stop receiving dispatches and their unfinished
+        requests re-dispatch to siblings exactly once.  Returns the ids
+        newly declared unhealthy."""
+        now = self.clock() if now is None else now
+        expired = []
+        for rid in sorted(self._replicas):
+            r = self._replicas[rid]
+            if r.state not in (READY, DRAINING):
+                continue
+            # never-heartbeated READY (mark_ready without a report) uses
+            # its add/ready time as the anchor — silence still expires
+            last = r.snapshot.ts if r.snapshot is not None else r.last_seen
+            if last is None or now - last <= self.health_interval:
+                continue
+            r.state = UNHEALTHY
+            expired.append(rid)
+            self._log(
+                f"replica_unhealthy replica={rid} "
+                f"stale={now - last if last is not None else -1:g}"
+            )
+            orphans = [
+                req for req in r.inflight.values()
+                if req.rid not in self._completed
+            ]
+            r.inflight.clear()
+            r.debit_blocks = 0
+            r.debit_count = 0
+            for req in orphans:
+                self._note_redispatch(req.rid)
+                metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "redispatch"})
+                self._log(f"redispatch req={req.rid} from={rid}")
+                self._place(req)
+        if expired:
+            self._gauge_states()
+        return expired
+
+    # ------------------------------------------------------------- dispatch
+    def submit(self, request: ServeRequest) -> Optional[str]:
+        """Route one request: returns the chosen replica id, or None when
+        it parked in the router queue (dispatched later by pump())."""
+        return self._place(request)
+
+    def _reject_oversized(self, request: ServeRequest) -> bool:
+        """The serve loop's upfront validation at the fleet boundary: a
+        request whose worst case exceeds every known replica's WHOLE
+        pool can never dispatch — queueing it would park the FIFO head
+        forever and starve everything behind it.  Checked at submit AND
+        at pump (a request can slip past submit before any heartbeat
+        exists, or outlive the big replica that could have served it)."""
+        if self.policy != "occupancy":
+            return False
+        cap = max(
+            (r.snapshot.total_blocks for r in self._replicas.values()
+             if r.snapshot is not None),
+            default=None,
+        )
+        if cap is None or request.blocks(self.block_size) <= cap:
+            return False
+        self.rejected.append(request.rid)
+        metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "rejected"})
+        self._log(
+            f"reject req={request.rid} "
+            f"blocks={request.blocks(self.block_size)} cap={cap}"
+        )
+        return True
+
+    def _place(self, request: ServeRequest) -> Optional[str]:
+        if self._reject_oversized(request):
+            return None
+        rid = self._pick(request)
+        if rid is None:
+            self._queue.append(request)
+            metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "queued"})
+            self._log(f"queue req={request.rid} depth={len(self._queue)}")
+            self._queue_gauge()
+            return None
+        self._dispatch(request, rid)
+        return rid
+
+    def _dispatch(self, request: ServeRequest, rid: str) -> None:
+        r = self._replicas[rid]
+        r.inflight[request.rid] = request
+        r.debit_blocks += request.blocks(self.block_size)
+        r.debit_count += 1
+        metrics.SERVING_ROUTER_DISPATCH.inc({"reason": self.policy})
+        self._log(f"dispatch req={request.rid} replica={rid}")
+        if self.on_dispatch is not None:
+            self.on_dispatch(request, rid, self.policy)
+
+    def _candidates(self) -> List[_Replica]:
+        return [
+            self._replicas[rid]
+            for rid in sorted(self._replicas)
+            if self._replicas[rid].state == READY
+        ]
+
+    def _pick(self, request: ServeRequest) -> Optional[str]:
+        cands = self._candidates()
+        if not cands:
+            return None
+        if self.policy == "round_robin":
+            # blind baseline: cycle ready replicas, no occupancy or
+            # in-flight bound — exactly what bench-fleet measures against
+            order = sorted(c.rid for c in cands)
+            if self._rr_last is not None:
+                idx = 0
+                for i, rid in enumerate(order):
+                    if rid > self._rr_last:
+                        idx = i
+                        break
+                order = order[idx:] + order[:idx]
+            chosen = order[0]
+            self._rr_last = chosen
+            return chosen
+        cost = request.blocks(self.block_size)
+        best = None
+        best_key = None
+        for c in cands:
+            if len(c.inflight) >= self.max_inflight:
+                continue
+            if c.snapshot is None or c.effective_free() < cost:
+                continue
+            key = (-c.effective_free(), c.effective_queue(), c.rid)
+            if best_key is None or key < best_key:
+                best, best_key = c, key
+        return best.rid if best is not None else None
+
+    def pump(self) -> int:
+        """Drain the router queue into whatever capacity exists now
+        (called after heartbeats, completions, and replica adds)."""
+        n = 0
+        while self._queue:
+            request = self._queue[0]
+            if self._reject_oversized(request):
+                # permanently unfittable head (queued before heartbeats
+                # existed, or the big replica scaled away): evict it so
+                # it cannot starve everything behind it
+                self._queue.popleft()
+                n += 1
+                continue
+            rid = self._pick(request)
+            if rid is None:
+                break
+            self._queue.popleft()
+            self._dispatch(request, rid)
+            n += 1
+        if n:
+            self._queue_gauge()
+        return n
+
+    def finish(self, rid: str, request_id: str) -> bool:
+        """A replica reports a completed request.  Returns True when this
+        is the FIRST completion of the id (deliver it); a duplicate from
+        a recovered replica whose requests were re-dispatched returns
+        False (drop — at-most-once delivery)."""
+        r = self._replicas.get(rid)
+        if r is not None:
+            r.inflight.pop(request_id, None)
+        if request_id in self._completed:
+            self._log(f"duplicate_completion req={request_id} replica={rid}")
+            # the duplicate still freed a dispatch slot on `rid`: pump
+            # the queue into it instead of waiting for the next event
+            self.pump()
+            return False
+        self._note_completed(request_id)
+        self.pump()
+        return True
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def sync_drains(self, targets) -> None:
+        """Apply the owning TPUServingJob's drain-target set (the
+        `kubeflow.org/fleet-drain` annotation, parsed by
+        engine/servefleet.drain_targets) — the channel a front-end
+        router consumes on CR watch events.  Every named replica is
+        drained; a replica whose pending drain is no longer named is
+        released back to dispatch (the autoscaler completed or
+        abandoned the scale-in)."""
+        targets = set(targets or ())
+        for rid in sorted(self._replicas):
+            r = self._replicas[rid]
+            if rid in targets:
+                self.drain(rid)
+            elif r.drain_pending:
+                r.drain_pending = False
+                if r.state == DRAINING:
+                    r.state = READY
+                    self._log(f"drain_released replica={rid}")
+                    self._gauge_states()
+                    self.pump()

@@ -278,6 +278,8 @@ class Cli:
                 if counts.get("restarts"):
                     line += f" restarts={counts['restarts']}"
                 print(line)
+        if kind == "TPUServingJob":
+            self._describe_fleet(job, namespace, name)
         conds = status.get("conditions", []) or []
         if conds:
             print("Conditions:")
@@ -301,6 +303,41 @@ class Cli:
                 print(f"  {e.get('type', ''):<8}{e.get('reason', ''):<28}"
                       f"{_age(_event_time(e)):<10}{e.get('message', '')}")
         return 0
+
+    def _describe_fleet(self, job: Dict[str, Any], namespace: str,
+                        name: str) -> None:
+        """Serving-fleet section of describe: fleet size, per-replica
+        occupancy, and the last autoscale event — from the process-global
+        fleet status the autoscaler publishes (engine/servefleet.py);
+        absent (no autoscaler in-process) only the declared/active counts
+        print, from the CR itself."""
+        from tf_operator_tpu.engine import servefleet
+
+        spec = (job.get("spec") or {}).get("servingReplicaSpecs") or {}
+        desired = (spec.get("Replica") or {}).get("replicas", 0)
+        active = (
+            (job.get("status", {}).get("replicaStatuses") or {})
+            .get("Replica") or {}
+        ).get("active", 0)
+        print("Fleet:")
+        print(f"  size: {active}/{desired} replica(s) ready")
+        doc = servefleet.fleet_status(f"{namespace}/{name}")
+        if not doc:
+            return
+        if doc.get("occupancy") is not None:
+            print(f"  kv-occupancy: {doc['occupancy']:g}  "
+                  f"queue-wait-p99: {doc.get('queue_wait_p99_s', 0):g}s")
+        for rid, t in sorted((doc.get("per_replica") or {}).items()):
+            used = t["total_blocks"] - t["free_blocks"]
+            occ = used / t["total_blocks"] if t["total_blocks"] else 0.0
+            drain = " (draining)" if doc.get("draining") == rid else ""
+            print(f"  {rid}: blocks={used}/{t['total_blocks']} "
+                  f"({occ:.0%}) queue={t['queue_depth']} "
+                  f"inflight={t['inflight']}{drain}")
+        last = doc.get("last_scale")
+        if last:
+            print(f"  last-scale: dir={last['dir']} {last['detail']} "
+                  f"t={last['t']:g}")
 
     def timeline(self, namespace: str, name: str, as_json: bool = False) -> int:
         """Render one job's flight-recorder timeline (engine/timeline.py)
@@ -388,6 +425,16 @@ class Cli:
             RESIZE_STATE_ANNOTATION,
         )
 
+        if kind == "TPUServingJob":
+            # serving fleets resize WITHOUT the drain->reshard->resume
+            # phase machine: replicas are independent, so a replicas
+            # edit is a plain fleet resize the engine applies directly
+            # (scale-in request draining is the autoscaler/router's job,
+            # not a job-level phase — docs/serving.md "Serving fleet")
+            return self._resize_fleet(
+                kind, name, namespace, replicas, replica_type,
+                timeout, poll_interval,
+            )
         client = self.client(kind)
         before = client.get(name, namespace=namespace)
         key = next(
@@ -474,6 +521,56 @@ class Cli:
         print(f"error: timed out after {timeout:g}s waiting for the "
               f"resize to complete (is the operator running with "
               f"--elastic-resize?)", file=sys.stderr)
+        return 1
+
+    def _resize_fleet(self, kind: str, name: str, namespace: str,
+                      replicas: int, replica_type: str, timeout: float,
+                      poll_interval: float) -> int:
+        """Fleet resize: patch the count, then watch the ACTIVE replica
+        count converge (no Resizing condition exists for fleets — the
+        engine scales directly, warm-claiming new pods on grow and
+        deleting highest-index pods on shrink)."""
+        import time as _time
+
+        client = self.client(kind)
+        before = client.get(name, namespace=namespace)
+        current = (
+            ((before.get("spec", {}).get("servingReplicaSpecs") or {})
+             .get(replica_type) or {}).get("replicas")
+        )
+        if current == replicas:
+            print(f"{kind.lower()}.kubeflow.org/{name} already at "
+                  f"{replica_type}={replicas}")
+            return 0
+        try:
+            client.scale(name, replicas, replica_type=replica_type,
+                         namespace=namespace)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"{kind.lower()}.kubeflow.org/{name} fleet resize requested "
+              f"({replica_type}={current}->{replicas}; independent "
+              f"replicas, no drain phase machine)")
+        if timeout <= 0:
+            return 0
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            job = client.get(name, namespace=namespace)
+            status = job.get("status", {}) or {}
+            active = (
+                (status.get("replicaStatuses") or {})
+                .get(replica_type) or {}
+            ).get("active", 0)
+            state = _condition_summary(job)
+            if state in ("Succeeded", "Failed"):
+                print(f"{name}: {state}")
+                return 2
+            if active == replicas:
+                print(f"{name}: Running ({replica_type}={replicas})")
+                return 0
+            _time.sleep(poll_interval)
+        print(f"error: timed out after {timeout:g}s waiting for the fleet "
+              f"to reach {replicas} active replica(s)", file=sys.stderr)
         return 1
 
     def suspend(self, kind: str, name: str, namespace: str) -> int:
@@ -603,6 +700,13 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
         return cli.timeline(args.job_namespace, args.name,
                             as_json=args.as_json)
     kind = resolve_kind(args.kind)
+    if (
+        kind == "TPUServingJob"
+        and getattr(args, "replica_type", None) == "Worker"
+    ):
+        # the argparse default targets the training kinds' Worker; a
+        # serving fleet's one replica type is Replica
+        args.replica_type = "Replica"
     if args.verb == "get":
         return cli.get(kind, args.name, ns, args.output)
     if args.verb == "describe":
