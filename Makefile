@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -142,6 +142,18 @@ bench-elastic:
 bench-fleet:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_fleet; \
 	print(json.dumps(bench_fleet(), indent=1))"
+
+# Serving failure domain (ISSUE 15): the hardened router (ejection +
+# hedging + degraded fallback) vs the no-ejection/no-hedge baseline
+# under ONE seeded outage trace (fleet-wide scrape storm, single-replica
+# scrape storm, replica freeze, kill-mid-decode) composed by the
+# FaultInjector on the harness SimClock.  Headline: hardened serves the
+# whole trace (zero dropped) with a bounded all-requests TTFT p99; the
+# baseline's is unbounded (the frozen replica eats >1% of the trace).
+# Rows land in BENCH_r14.json; bounds asserted in tests/test_bench_infra.py.
+bench-fleet-chaos:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_fleet_chaos; \
+	print(json.dumps(bench_fleet_chaos(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

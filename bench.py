@@ -2414,6 +2414,102 @@ def bench_fleet(
     }
 
 
+def bench_fleet_chaos(
+    seed: int = 1337,
+    n_users: int = 400,
+    horizon_s: float = 600.0,
+    n_replicas: int = 3,
+):
+    """`make bench-fleet-chaos` — the serving failure domain's headline
+    (ISSUE 15 evidence, BENCH_r14.json).  One seeded outage trace —
+    composed by the FaultInjector on the harness's SimClock, so every
+    fault fires at the same simulated instant in both arms:
+
+      t=40..52   scrape storm, ALL replicas (the monitoring plane dies:
+                 the hardened router enters degraded round-robin instead
+                 of expiring the fleet)
+      t=80..88   scrape storm, r0 only (consecutive failures: ejection +
+                 half-open re-admission after backoff)
+      t=120      r1 FREEZES (accepts dispatch, keeps heartbeating,
+                 never completes — the SIGSTOP of serving; only hedged
+                 re-dispatch rescues its trapped requests)
+      t=180      r2 killed mid-decode (stops heartbeating AND computing;
+                 health expiry re-dispatches its orphans exactly once)
+
+    Two arms, identical trace + faults + autoscale policy:
+
+      baseline — PR 14's router plus this PR's degraded fallback (core
+                 tick() behavior, not a flag) but NO ejection and NO
+                 hedging.  The frozen replica heartbeats healthily
+                 forever, so its trapped requests are simply LOST —
+                 health expiry never fires on a live metrics thread.
+      hardened — ejection + hedging armed.
+
+    Scored per arm: completed/dropped, TTFT p50/p99 (served AND
+    censored-over-all-requests — a lost request's TTFT is +inf, and
+    excluding the lost tail would let the lossy arm "win" tail latency
+    by survivorship), ejections, hedges issued/won/lost, degraded
+    entries, re-dispatch ledger.  Every number is deterministic
+    arithmetic per seed; tests/test_bench_infra.py pins the bounds
+    (hardened drops NOTHING with a BOUNDED all-requests p99; the
+    baseline's is unbounded — it loses >1% of the trace to the frozen
+    replica)."""
+    from tf_operator_tpu.api.servingjob import AutoscaleSpec
+    from tf_operator_tpu.k8s.chaos import FaultInjector, SimClock
+    from tf_operator_tpu.k8s.fake import FakeCluster
+    from tf_operator_tpu.models.fleetsim import FleetHarness, make_trace
+
+    trace = make_trace(seed, n_users=n_users)
+    auto = AutoscaleSpec(
+        min_replicas=2, max_replicas=6,
+        scale_out_queue_wait_p99_s=1.5, scale_out_blocked_admissions=4,
+        scale_in_occupancy_floor=0.2,
+    )
+
+    def run(hardened: bool):
+        inj = FaultInjector(
+            FakeCluster(), seed=seed, clock=SimClock(), kubelet=False
+        )
+        inj.schedule_scrape_storm(40.0, 12.0, mode="timeout")
+        inj.schedule_scrape_storm(80.0, 8.0, mode="500", replicas=["r0"])
+        inj.schedule_replica_freeze(120.0, "r1")
+        inj.schedule_replica_kill(180.0, "r2")
+        harness = FleetHarness(
+            "occupancy", n_replicas=n_replicas, injector=inj,
+            hedging=hardened, ejection=hardened,
+            autoscale=auto, warm_standbys=6,
+        )
+        row = harness.run(trace, horizon_s=horizon_s)
+        row["mode"] = "hardened" if hardened else "baseline"
+        row["redispatches"] = len(row["redispatches"])
+        row["log_lines"] = len(harness.log)
+        return row
+
+    rows = [run(False), run(True)]
+    base, hard = rows
+    return {
+        "seed": seed,
+        "users": n_users,
+        "requests": len(trace),
+        "rows": rows,
+        "summary": {
+            "baseline_dropped": base["dropped"],
+            "hardened_dropped": hard["dropped"],
+            # censored (all-requests) TTFT p99: a lost request's TTFT is
+            # +inf — None means the p99 rank lands in the lost region.
+            # The headline is bounded-vs-unbounded, not a ratio: the
+            # baseline loses more than 1% of the trace to the frozen
+            # replica, so its real tail never terminates.
+            "ttft_p99_all_baseline_s": base["ttft_p99_all_s"],
+            "ttft_p99_all_hardened_s": hard["ttft_p99_all_s"],
+            "hedge_win_rate": (
+                round(hard["hedges_won"] / hard["hedges_issued"], 3)
+                if hard["hedges_issued"] else None
+            ),
+        },
+    }
+
+
 def bench_elastic(
     seed: int = 1337,
     horizon_s: float = 420.0,

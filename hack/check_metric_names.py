@@ -63,6 +63,16 @@ _REQUIRED_FAMILIES = {
     "tpu_operator_serving_router_dispatch_total": "Counter",
     "tpu_operator_serving_router_queue_depth": "Gauge",
     "tpu_operator_serving_fleet_scale_events_total": "Counter",
+    # serving-fleet failure domain (ISSUE 15): the scrape transport's
+    # success ratio + per-replica age, and the router's ejection /
+    # degraded-fallback / hedging activity — docs/monitoring.md's
+    # scrape-success, ejection-rate, and hedge-win-rate PromQL read
+    # these by name
+    "tpu_operator_serving_scrape_attempts_total": "Counter",
+    "tpu_operator_serving_scrape_age_seconds": "Gauge",
+    "tpu_operator_serving_replica_ejections_total": "Counter",
+    "tpu_operator_serving_router_degraded_total": "Counter",
+    "tpu_operator_serving_hedge_requests_total": "Counter",
 }
 
 

@@ -502,6 +502,41 @@ def test_bench_fleet_occupancy_beats_round_robin_deterministically():
     assert occ["scale_in_events"] > 0
 
 
+def test_bench_fleet_chaos_hardened_router_bounds():
+    """BENCH_r14's regression bounds (ISSUE 15).  One seeded outage
+    trace (fleet-wide scrape storm, single-replica scrape storm, replica
+    freeze, kill-mid-decode), two arms on the same SimClock schedule:
+    the hardened router (ejection + hedging) must serve the WHOLE trace
+    — zero dropped, every re-dispatch exactly once — with a bounded
+    all-requests TTFT p99, while the no-ejection/no-hedge baseline
+    demonstrably loses the frozen replica's trapped requests (its
+    censored p99 is unbounded).  Both arms enter degraded mode during
+    the fleet-wide storm; only the hardened arm ejects and hedges."""
+    r = bench.bench_fleet_chaos()
+    by = {row["mode"]: row for row in r["rows"]}
+    base, hard = by["baseline"], by["hardened"]
+    # zero-loss under the outage trace is the hardened arm's contract
+    assert hard["dropped"] == 0
+    assert hard["completed"] == r["requests"]
+    # ...and the baseline measurably cannot hold it: the frozen replica
+    # keeps heartbeating, so health expiry never rescues its requests
+    assert base["dropped"] > 0
+    # censored tail: bounded for hardened, unbounded for baseline
+    assert hard["ttft_p99_all_s"] is not None
+    assert base["ttft_p99_all_s"] is None
+    # the machinery demonstrably fired, in the right arm only
+    assert hard["ejections"] >= 1 and base["ejections"] == 0
+    assert hard["hedges_issued"] >= 1 and base["hedges_issued"] == 0
+    assert hard["hedges_won"] >= 1
+    assert (
+        hard["hedges_won"] + hard["hedges_lost"] <= hard["hedges_issued"]
+    )
+    # degraded mode is core tick() behavior — both arms entered it
+    # during the fleet-wide scrape storm
+    assert base["degraded_entries"] >= 1
+    assert hard["degraded_entries"] >= 1
+
+
 def test_merge_bucket_percentiles_reads_merged_histograms():
     """The multiproc /metrics scrape math: per-worker cumulative bucket
     counts merge by le and percentiles read off the merged histogram

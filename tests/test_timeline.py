@@ -730,11 +730,13 @@ def test_metric_lint_counts_the_slo_families():
     # +2 from ISSUE 13 (paged-kernel request counter, sliding-window
     # evicted-blocks counter), +4 from ISSUE 14 (serving-fleet replicas
     # gauge, router dispatch counter, router queue-depth gauge, fleet
-    # scale-events counter).
+    # scale-events counter), +5 from ISSUE 15 (scrape attempts counter,
+    # scrape-age gauge, replica-ejections counter, router-degraded
+    # counter, hedge-requests counter).
     # (The ISSUE 11 bump was never recorded here: this test sits past
     # the tier-1 timeout cutoff, so the stale 64 went unnoticed.)
     with em._LOCK:
-        assert len(em._REGISTRY) == 78
+        assert len(em._REGISTRY) == 83
 
 
 @pytest.mark.slow

@@ -20,7 +20,7 @@ from tf_operator_tpu.k8s.chaos import FaultInjector, SimClock
 from tf_operator_tpu.k8s.fake import FakeCluster
 from tf_operator_tpu.models.fleetsim import FleetHarness, make_trace
 from tf_operator_tpu.models.router import (
-    DRAINING, READY, UNHEALTHY, FleetRouter, ServeRequest,
+    DRAINING, EJECTED, READY, UNHEALTHY, FleetRouter, ServeRequest,
 )
 from tf_operator_tpu.sdk.cli import Cli, make_parser
 from tf_operator_tpu.sdk.cli import run as cli_run
@@ -859,6 +859,88 @@ def test_cli_resize_fleet_is_plain_and_watches_active(capsys):
     mgr.stop()
 
 
+def test_router_gap_recovery_requeues_stalled_books():
+    """Degraded mode never expires a lone replica — so when it dies
+    AND restarts (fresh process, fresh heartbeat), its pre-outage
+    in-flight books would otherwise consume dispatch slots forever.
+    A sample landing after a full missed-heartbeat gap requeues the
+    progress-stalled entries; a stream that kept progressing through
+    a mere telemetry outage stays put."""
+    router, clock = make_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    assert router.submit(req("a")) == "r0"
+    assert router.submit(req("b")) == "r0"
+    router.note_progress("r0", "b")  # b's stream is alive pre-gap
+    clock.advance(3.0)
+    assert router.tick() == []  # lone replica: degraded, not expired
+    assert router.degraded
+    # ...the pod restarted behind the gap and heartbeats fresh, but b
+    # kept streaming through what was only a TELEMETRY outage
+    router.note_progress("r0", "b")
+    router.observe("r0", 100, 100, 0)
+    assert not router.degraded
+    # a (no progress since dispatch) was re-dispatched; b stayed put
+    assert router.redispatches == {"a": 1}
+    assert set(router._replicas["r0"].inflight) == {"a", "b"}
+    # a's re-dispatch is fresh — it will not instantly re-hedge/expire
+    assert router._replicas["r0"].dispatched_at["a"] == clock()
+
+
+def test_router_lone_replica_dispatch_failure_queues_not_loops():
+    """A dispatch failure on the fleet's ONLY replica queues the
+    request — re-placing it onto the replica that just refused it
+    would turn a dead lone replica into an unbounded
+    dispatch→fail→re-place hot loop (degraded mode keeps it READY and
+    ejection has no witness).  pump() retries once a sibling exists."""
+    router, clock = make_router()
+    ready_replica(router, "r0")
+    assert router.submit(req("a")) == "r0"
+    router.dispatch_failed("r0", "a")
+    assert router.inflight("r0") == 0
+    assert router.queue_depth() == 1  # parked, not hot-looped
+    events_before = len(router.events)
+    router.tick()
+    assert router.queue_depth() == 1  # no churn while nothing changed
+    assert router.inflight("r0") == 0
+    # fresh capacity/evidence appears: the parked request dispatches
+    ready_replica(router, "r1")
+    assert router.queue_depth() == 0
+    assert router.inflight("r0") + router.inflight("r1") == 1
+    assert len(router.events) > events_before
+
+
+def test_fleet_frozen_drain_victim_times_out_and_requeues():
+    """A FROZEN scale-in victim (accepts dispatch, never completes,
+    keeps heartbeating) can never reach inflight==0: the harness's
+    drain wait must time out like the operator's — complete the
+    scale-in, requeue the trapped requests exactly once — instead of
+    silently disabling autoscaling for the rest of the run."""
+    harness = FleetHarness(
+        "occupancy", n_replicas=3,
+        autoscale=auto_spec(min_replicas=2, max_replicas=6,
+                            scale_in_occupancy_floor=0.2),
+    )
+    clock = harness.clock
+    victim = "r2"  # highest index: the scale-in pick
+    # plant a request directly on the victim (the occupancy tie-break
+    # would route a submit elsewhere)
+    harness.arrival_t["trapped"] = clock()
+    harness.router._dispatch(req("trapped"), victim)
+    assert harness.router.inflight(victim) == 1
+    harness.freeze(victim)
+    harness.router.drain(victim)
+    harness._draining = victim
+    harness._drain_started = clock()
+    clock.advance(harness.drain_timeout_s + 1.0)
+    harness._autoscale_tick(clock())
+    assert harness._draining is None  # wedge broken
+    assert victim not in harness.replicas
+    # the trapped request moved to a live sibling exactly once
+    assert harness.router.redispatches == {"trapped": 1}
+    assert any("scale_in_done replica=r2 timeout=1" in l
+               for l in harness.log)
+
+
 # ------------------------------------------------------------ chaos (sim)
 def chaos_fleet_run(seed, kill_at=65.0, victim="r1"):
     trace = make_trace(seed, n_users=300)
@@ -953,4 +1035,701 @@ def test_options_wire_serving_autoscale():
     inj = FaultInjector(FakeCluster(), seed=1, clock=clock)
     mgr = make_operator(inj, clock)
     assert mgr.fleet_autoscaler is None
+    mgr.stop()
+
+
+# ----------------------------------------- failure domain (ISSUE 15)
+def test_router_degraded_falls_back_to_round_robin_and_recovers():
+    """ALL replicas stale at once = the monitoring plane down, not the
+    fleet: nobody expires, dispatch degrades to round-robin over READY
+    (in-flight bounds still honored), and the first fresh sample
+    restores occupancy dispatch."""
+    router, clock = make_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    clock.advance(3.0)  # both snapshots stale
+    assert router.tick() == []  # degraded, NOT expired
+    assert router.degraded and router.degraded_entries == 1
+    assert router.replica_state("r0") == READY
+    # blind round-robin (occupancy says r0 has more free; rr ignores it)
+    picks = [router.submit(req(f"q{i}")) for i in range(4)]
+    assert picks == ["r0", "r1", "r0", "r1"]
+    assert any("router_degraded" in l for l in router.events)
+    # second tick while still blind: no duplicate entry records
+    router.tick()
+    assert router.degraded_entries == 1
+    # first fresh sample ends it
+    router.observe("r0", 100, 100, 0)
+    assert not router.degraded
+    assert any("router_recovered" in l for l in router.events)
+    # the still-stale sibling now expires NORMALLY (minority staleness)
+    assert router.tick() == ["r1"]
+    # its orphans moved exactly once each
+    assert router.redispatches == {"q1": 1, "q3": 1}
+
+
+def test_router_degraded_keyed_on_dispatchable_set_only():
+    """Degraded entry/exit must consider only DISPATCHABLE replicas —
+    the set _candidates() draws from.  A scrape storm covering exactly
+    the READY set while a fresh drain victim keeps reporting must still
+    degrade (round-robin keeps serving), the victim's heartbeats must
+    NOT clear degraded, and the READY replicas must never expire to
+    UNHEALTHY on its testimony — that would requeue their orphans with
+    no candidate and park the FIFO on blindness."""
+    router, clock = make_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    router.drain("r2")  # the autoscaler's scale-in victim
+    assert router.submit(req("a")) == "r0"
+    # storm on the READY set only: their scrape streams fail (no
+    # ejection — the only clean witness is the non-dispatchable drain
+    # victim) while r2's telemetry stays fresh
+    for _ in range(5):
+        router.scrape_failed("r0")
+        router.scrape_failed("r1")
+    assert router.ejections == 0
+    clock.advance(3.0)  # r0/r1 stale past health_interval
+    router.observe("r2", 100, 100, 0)  # drain victim still reporting
+    assert router.tick() == []  # degraded, nobody expired
+    assert router.degraded
+    assert router.replica_state("r0") == READY
+    # the drain victim's next heartbeat is not recovery evidence
+    router.observe("r2", 100, 100, 0)
+    assert router.degraded
+    assert router.tick() == []  # still degraded: READY set unharmed
+    assert router.replica_state("r0") == READY
+    assert "a" not in router.redispatches
+    # blind round-robin keeps serving over the READY set
+    assert router.submit(req("b")) in ("r0", "r1")
+    # a fresh sample from a DISPATCHABLE replica ends it
+    router.observe("r0", 100, 100, 0)
+    assert not router.degraded
+
+
+def test_router_degraded_not_vetoed_by_never_reported_newcomer():
+    """A replica mark_ready'd DURING a scrape outage (pod Ready fires;
+    telemetry never can) reads fresh off its add-time anchor.  It must
+    not veto degraded entry: letting it would expire the whole
+    established READY set on its testimony and requeue their orphans
+    toward a candidate whose snapshot=None occupancy _pick skips —
+    parking the FIFO.  It still serves in the round-robin fallback."""
+    router, clock = make_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    assert router.submit(req("a")) == "r0"
+    clock.advance(3.0)  # the scrape plane has been down a while
+    router.add_replica("r2")
+    router.mark_ready("r2")  # autoscaler's newcomer: no telemetry ever
+    assert router.tick() == []  # degraded, established set unharmed
+    assert router.degraded
+    assert router.replica_state("r0") == READY
+    assert "a" not in router.redispatches
+    # the newcomer is still a round-robin candidate (availability)
+    picks = {router.submit(req(f"q{i}")) for i in range(3)}
+    assert picks == {"r0", "r1", "r2"}
+
+
+def test_router_degraded_entry_requeues_orphans_round_robin():
+    """On the degraded ENTRY tick the flag must flip before any orphan
+    requeue: a dead drain victim's requests expired in the same sweep
+    place by round-robin, not by the fleet-wide-stale occupancy
+    fiction (and carry the `degraded` dispatch reason)."""
+    router, clock = make_router(health_interval=2.0)
+    reasons = []
+    router.on_dispatch = lambda request, rid, reason: reasons.append(
+        (request.rid, rid, reason))
+    # stale snapshots CLAIM r2 is emptiest — occupancy picks it
+    ready_replica(router, "r0", free=10)
+    ready_replica(router, "r1", free=20)
+    ready_replica(router, "r2", free=100)
+    assert router.submit(req("a")) == "r2"
+    router.drain("r2")
+    clock.advance(3.0)  # everything stale; the drain victim died too
+    assert router.tick() == ["r2"]
+    assert router.degraded
+    # the orphan was re-placed by the DEGRADED fallback, not occupancy
+    assert reasons[-1][0] == "a" and reasons[-1][2] == "degraded"
+    assert reasons[-1][1] in ("r0", "r1")
+
+
+def test_router_degraded_still_expires_dead_drain_victim():
+    """Degraded mode spares the READY set from expiry — but a DRAINING
+    replica that genuinely dies mid-outage must still expire: it is not
+    a dispatch candidate (expiring it cannot park the FIFO), and its
+    in-flight requests must requeue onto the round-robin READY set
+    instead of stranding behind the autoscaler's inflight==0 drain wait
+    for the whole storm."""
+    router, clock = make_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    assert router.submit(req("a")) == "r0"
+    router.drain("r0")  # scale-in victim, one request still in flight
+    clock.advance(3.0)  # EVERYTHING stale: degraded territory
+    assert router.tick() == ["r0"]  # degraded AND the victim expired
+    assert router.degraded
+    assert router.replica_state("r0") == UNHEALTHY
+    # the trapped request moved to a READY sibling exactly once
+    assert router.redispatches == {"a": 1}
+    assert router.inflight("r1") + router.inflight("r2") == 1
+    assert router.replica_state("r1") == READY
+
+
+def test_router_degraded_honors_inflight_bound():
+    router, clock = make_router(health_interval=2.0,
+                                max_inflight_per_replica=1)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    clock.advance(3.0)
+    router.tick()
+    assert router.degraded
+    assert router.submit(req("a")) == "r0"
+    assert router.submit(req("b")) == "r1"
+    # both bounds full: queue, never convoy — blindness does not lift
+    # the router's own books
+    assert router.submit(req("c")) is None
+    assert router.queue_depth() == 1
+
+
+def test_router_ejection_half_open_readmission_and_backoff_ladder():
+    router, clock = make_router()
+    router.eject_failure_threshold = 3
+    router.eject_backoff_s = 4.0
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    assert router.submit(req("a")) == "r0"
+    router.scrape_failed("r0")
+    router.scrape_failed("r0")
+    assert router.replica_state("r0") == READY  # under threshold
+    router.scrape_failed("r0")
+    assert router.replica_state("r0") == EJECTED
+    assert router.ejections == 1
+    # the orphan moved to the sibling exactly once
+    assert router.redispatches == {"a": 1}
+    assert router.inflight("r1") == 1
+    # telemetry BEFORE the backoff window is ignored (half-open gate)
+    clock.advance(1.0)
+    router.observe("r0", 100, 100, 0)
+    assert router.replica_state("r0") == EJECTED
+    # at/after the window: the sample IS the probe — readmitted
+    clock.advance(3.0)
+    router.observe("r0", 100, 100, 0)
+    assert router.replica_state("r0") == READY
+    assert any("replica_readmitted" in l for l in router.events)
+    # a second ejection doubles the backoff (capped exponential)
+    for _ in range(3):
+        router.scrape_failed("r0")
+    assert router.replica_state("r0") == EJECTED
+    assert router._replicas["r0"].eject_until - clock() == 8.0
+
+
+def test_router_fleetwide_failures_never_eject_everything():
+    """Ejection is a minority verdict: when EVERY replica's scrape
+    stream is failing the evidence points at the monitoring plane, and
+    nobody ejects (degraded mode owns that case)."""
+    router, clock = make_router()
+    router.eject_failure_threshold = 3
+    for rid in ("r0", "r1", "r2"):
+        ready_replica(router, rid)
+    for _ in range(5):
+        for rid in ("r0", "r1", "r2"):
+            router.scrape_failed(rid)
+    assert router.ejections == 0
+    assert router.replicas(state=READY) == ["r0", "r1", "r2"]
+    # one replica's stream healing makes the OTHERS ejectable again
+    router.observe("r2", 100, 100, 0)
+    for _ in range(3):
+        router.scrape_failed("r0")
+    assert router.replica_state("r0") == EJECTED
+
+
+def test_router_mark_ready_resets_boot_failures():
+    """Scrape failures racing a replica's boot (podIP up, /metrics
+    listener not yet) must not carry into READY: without the reset one
+    post-ready transient failure would instantly eject the newcomer —
+    "N CONSECUTIVE failures" starts counting at ready."""
+    router, clock = make_router()
+    router.eject_failure_threshold = 3
+    ready_replica(router, "r0")  # the clean witness
+    router.add_replica("r2")
+    for _ in range(5):
+        router.scrape_failed("r2")  # boot races, state still STARTING
+    router.mark_ready("r2")
+    router.scrape_failed("r2")  # one transient after ready
+    assert router.replica_state("r2") == READY
+    assert router.ejections == 0
+    router.scrape_failed("r2")
+    router.scrape_failed("r2")  # ...three consecutive POST-ready: eject
+    assert router.replica_state("r2") == EJECTED
+
+
+def test_router_rehedges_when_the_hedge_arm_also_stalls():
+    """Both copies frozen (the hedge arm froze too, both holders still
+    heartbeating healthy telemetry) must not strand the request behind
+    the one-live-hedge budget: the failed race settles lost, the budget
+    restores, and a THIRD sibling gets the re-hedge — won+lost still
+    converges to issued."""
+    router, clock = hedging_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)
+    router.tick()
+    assert router._hedged["a"] == "r1"
+    # the hedge copy ALSO goes silent past the threshold
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)  # everyone heartbeats fine
+    router.tick()
+    assert router._hedged["a"] == "r2"  # re-hedged to the third sibling
+    assert router.hedges_issued == 2
+    assert router.hedges_lost == 1  # the first race settled lost
+    assert router.finish("r2", "a") is True
+    assert router.hedges_won == 1  # ...and the second won at delivery
+    assert router.hedges_won + router.hedges_lost == router.hedges_issued
+
+
+def test_router_hedge_outcome_settles_when_a_holder_dies():
+    """A holder dying mid-race must settle the hedge outcome (won+lost
+    converges to issued): the ORIGINAL's death means the surviving
+    hedge copy carried the request (won); the HEDGE arm's death means
+    the hedge lost.  Without settlement the bench's win rate reads
+    artificially low exactly in the storms hedging exists for."""
+    router, clock = hedging_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)
+    router.tick()
+    assert router._hedged["a"] == "r1"
+    # the ORIGINAL holder dies: the hedge copy is the carrier — won
+    router.remove_replica("r0", requeue=True)
+    assert router.hedges_won == 1 and router.hedges_lost == 0
+    assert router.finish("r1", "a") is True
+    # no double count at delivery (the race already settled)
+    assert router.hedges_won == 1 and router.hedges_lost == 0
+
+
+def test_router_ejection_witness_must_have_reported():
+    """The minority-verdict witness must carry actual evidence: a
+    never-reported newcomer (mark_ready mid-outage) has a clean failure
+    count by vacuity, not by a working scrape stream — established
+    replicas must not eject on its testimony.  Its first real sample
+    makes it a qualified witness."""
+    router, clock = make_router()
+    router.eject_failure_threshold = 3
+    ready_replica(router, "r0")
+    router.add_replica("r2")
+    router.mark_ready("r2")  # READY, zero failures, snapshot=None
+    for _ in range(5):
+        router.scrape_failed("r0")
+    assert router.ejections == 0
+    assert router.replica_state("r0") == READY
+    # the newcomer's first sample is scrape-plane evidence: now a
+    # continuing failure streak on r0 is a minority verdict
+    router.observe("r2", 100, 100, 0)
+    for _ in range(3):
+        router.scrape_failed("r0")
+    assert router.replica_state("r0") == EJECTED
+
+
+def test_router_drain_fence_sticky_through_ejection():
+    router, clock = make_router()
+    router.eject_failure_threshold = 2
+    router.eject_backoff_s = 2.0
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    router.drain("r0")
+    router.scrape_failed("r0")
+    router.scrape_failed("r0")
+    assert router.replica_state("r0") == EJECTED
+    # a drain arriving WHILE ejected only pends the fence
+    router.drain("r0")
+    assert router.replica_state("r0") == EJECTED
+    clock.advance(2.5)
+    router.observe("r0", 100, 100, 0)
+    # readmitted INTO the fence, never into dispatch
+    assert router.replica_state("r0") == DRAINING
+    assert router.submit(req("b")) == "r1"
+
+
+def test_router_dispatch_failure_replaces_and_counts_toward_ejection():
+    router, clock = make_router()
+    router.eject_failure_threshold = 2
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    assert router.submit(req("a")) == "r0"
+    debited = router._replicas["r0"].debit_blocks
+    assert debited > 0
+    router.dispatch_failed("r0", "a")
+    # the request never landed: re-placed immediately (on r1 — r0 just
+    # failed a dispatch but is still READY below the threshold)
+    assert router.inflight("r0") == 0
+    assert router.inflight("r1") == 1
+    # ...and the never-landed dispatch's occupancy debit is reversed —
+    # a phantom debit would make r0 look full until its next heartbeat
+    assert router._replicas["r0"].debit_blocks == 0
+    assert router._replicas["r0"].debit_count == 0
+    assert router.submit(req("b")) in ("r0", "r1")
+    holder = [rid for rid in ("r0", "r1") if "b" in
+              router._replicas[rid].inflight][0]
+    if holder == "r0":
+        router.dispatch_failed("r0", "b")
+        assert router.replica_state("r0") == EJECTED
+
+
+def hedging_router(**kw):
+    kw.setdefault("health_interval", 100.0)  # expiry out of the way
+    router, clock = make_router(**kw)
+    router.enable_hedging = True
+    router.hedge_min_samples = 1
+    router.hedge_floor_s = 1.0
+    return router, clock
+
+
+def seed_ttft(router, clock, rid="r0", req_id="warm"):
+    assert router.submit(req(req_id)) == rid
+    clock.advance(0.2)
+    router.note_first_token(rid, req_id)
+    assert router.finish(rid, req_id) is True
+    # clear the warm-up dispatch's debits so later picks are fair
+    router.observe(rid, 100, 100, 0)
+
+
+def test_hedge_issues_on_stalled_first_token_and_winner_bookkeeping():
+    router, clock = hedging_router()
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    seed_ttft(router, clock)  # one TTFT sample (0.2s; floor clamps to 1)
+    assert router.hedge_threshold() == 1.0
+    assert router.submit(req("a")) == "r0"
+    clock.advance(0.5)
+    router.tick()
+    assert router.hedges_issued == 0  # not overdue yet
+    clock.advance(1.0)
+    router.observe("r0", 100, 100, 0)
+    router.observe("r1", 100, 100, 0)
+    router.tick()
+    assert router.hedges_issued == 1
+    assert router._hedged["a"] == "r1"
+    assert router.inflight("r0") == 1 and router.inflight("r1") == 1
+    # only one hedge per request, ever
+    clock.advance(2.0)
+    router.observe("r0", 100, 100, 0)
+    router.observe("r1", 100, 100, 0)
+    router.tick()
+    assert router.hedges_issued == 1
+    # the hedge copy wins: delivered, counted, loser copy still charged
+    # to ITS replica until it completes
+    assert router.finish("r1", "a") is True
+    assert router.hedges_won == 1 and router.hedges_lost == 0
+    assert router.inflight("r0") == 1
+    assert router.finish("r0", "a") is False  # duplicate, dropped
+    assert router.inflight("r0") == 0
+
+
+def test_hedge_progress_anchor_catches_mid_decode_freeze():
+    """A request whose FIRST token arrived but whose stream then went
+    silent is as overdue as one that never started: the hedge anchors
+    on last progress, not first token."""
+    router, clock = hedging_router()
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(0.3)
+    router.note_first_token("r0", "a")  # stream started...
+    for _ in range(3):  # ...and keeps making progress: never hedged
+        clock.advance(0.8)
+        router.note_progress("r0", "a")
+        router.observe("r0", 100, 100, 0)
+        router.observe("r1", 100, 100, 0)
+        router.tick()
+    assert router.hedges_issued == 0
+    # then the replica freezes mid-decode: silence past the threshold
+    clock.advance(1.5)
+    router.observe("r1", 100, 100, 0)
+    router.tick()
+    assert router.hedges_issued == 1
+    assert router._hedged["a"] == "r1"
+
+
+def test_hedge_loser_completion_decrements_own_replica_and_pumps():
+    """The PR 14 duplicate-completion pump test, extended to hedging: a
+    hedge loser completing AFTER the winner decrements in-flight on its
+    OWN replica (never the winner's) and its freed slot pumps the
+    queue."""
+    router, clock = hedging_router(max_inflight_per_replica=1)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(1.5)
+    router.observe("r0", 100, 100, 0)
+    router.observe("r1", 100, 100, 0)
+    router.tick()  # a hedged onto r1; both bounds now full
+    assert router._hedged["a"] == "r1"
+    # winner (the hedge copy) delivers: r1's slot frees, r0 still holds
+    # the loser copy
+    assert router.finish("r1", "a") is True
+    assert router.inflight("r1") == 0 and router.inflight("r0") == 1
+    # new traffic fills r1; the next request has nowhere to go
+    assert router.submit(req("b")) == "r1"
+    assert router.submit(req("c")) is None
+    assert router.queue_depth() == 1
+    # the loser completes late: dropped as a duplicate, but it must
+    # decrement r0's OWN in-flight (not r1's) and pump c onto r0
+    assert router.finish("r0", "a") is False
+    assert router.inflight("r0") == 1  # c, not a leak of a
+    assert "c" in router._replicas["r0"].inflight
+    assert router.inflight("r1") == 1  # b untouched
+    assert router.queue_depth() == 0
+
+
+def test_hedge_skips_covered_orphans_on_expiry():
+    """A hedged request whose original replica dies is NOT re-dispatched
+    a third time while the hedge copy is still live on a sibling — but
+    the dead original DOES restore the hedge budget: the survivor is the
+    only copy now, and if it is itself silent past the threshold the
+    same sweep re-hedges it (a frozen survivor must never strand the
+    request forever)."""
+    router, clock = hedging_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)
+    router.tick()
+    assert router._hedged["a"] == "r1"
+    # r0 (the original holder) goes silent past the health interval
+    clock.advance(2.5)
+    router.observe("r1", 100, 100, 0)
+    router.observe("r2", 100, 100, 0)
+    assert router.tick() == ["r0"]
+    # NOT re-dispatched: the live hedge copy on r1 covers delivery
+    assert "a" not in router.redispatches
+    assert any("redispatch_skipped req=a" in l for l in router.events)
+    # ...but the budget came back, and r1 (silent since the hedge went
+    # out) was itself re-hedged onto r2 by the same sweep
+    assert router._hedged["a"] == "r2"
+    assert router.hedges_issued == 2
+    assert router.finish("r1", "a") is True
+
+
+def test_hedge_arm_dispatch_failure_restores_hedge_budget():
+    """When the hedge COPY's dispatch never lands (connection refused),
+    the request is back to one copy: the hedge ledger entry must clear,
+    or a still-stalled original could never be rescued again — it would
+    strand forever on a frozen replica."""
+    router, clock = hedging_router()
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)
+    router.tick()
+    assert router._hedged["a"] == "r1"
+    router.dispatch_failed("r1", "a")
+    # not re-placed (the original still holds it) but re-hedgeable
+    assert "a" not in router._hedged
+    assert router.inflight("r0") == 1
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)
+    router.tick()
+    assert router.hedges_issued == 2
+    assert "a" in router._hedged
+    assert router.finish(router._hedged["a"], "a") is True
+
+
+def test_hedge_arm_dispatch_failure_after_delivery_never_replaces():
+    """A hedge arm's dispatch failure reported AFTER the other arm
+    already delivered must not re-place the request: the id is in the
+    completed ledger and a third dispatch would burn a whole inference
+    whose completion is dropped as a duplicate (the same guard
+    _requeue_orphans applies to orphan sweeps)."""
+    router, clock = hedging_router()
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)
+    router.tick()
+    assert router._hedged["a"] == "r1"
+    # the ORIGINAL delivers first; the hedge copy is still in flight
+    assert router.finish("r0", "a") is True
+    # ...and its dispatch failure comes back late (connection refused)
+    router.dispatch_failed("r1", "a")
+    # delivered request: nobody re-dispatches it, nothing is in flight
+    assert all(router.inflight(rid) == 0 for rid in ("r0", "r1", "r2"))
+    assert "a" not in router.redispatches
+    assert not any("dispatch req=a" in e for e in router.events[-2:])
+
+
+def test_hedge_arm_expiry_restores_hedge_budget():
+    """The hedge copy's REPLICA expiring (covered-orphan skip) must also
+    clear the ledger entry, so the same sweep can re-hedge the stalled
+    original onto a healthy sibling."""
+    router, clock = hedging_router(health_interval=2.0)
+    ready_replica(router, "r0")
+    ready_replica(router, "r1")
+    ready_replica(router, "r2")
+    seed_ttft(router, clock)
+    assert router.submit(req("a")) == "r0"
+    clock.advance(1.5)
+    for rid in ("r0", "r1", "r2"):
+        router.observe(rid, 100, 100, 0)
+    router.tick()
+    assert router._hedged["a"] == "r1"
+    # r1 (the hedge arm) goes silent past the health interval while the
+    # frozen original keeps heartbeating
+    clock.advance(2.5)
+    router.observe("r0", 100, 100, 0)
+    router.observe("r2", 100, 100, 0)
+    assert router.tick() == ["r1"]
+    # a was covered by r0 (no third dispatch of the orphan)...
+    assert "a" not in router.redispatches
+    # ...and the same sweep's hedge pass re-hedged it onto r2
+    assert router._hedged["a"] == "r2"
+    assert router.hedges_issued == 2
+    assert router.finish("r2", "a") is True
+
+
+def test_fleet_chaos_soak_timeline_and_causality():
+    """The kill + scrape-outage soak (ISSUE 15 acceptance): seeded
+    serving faults composed by the FaultInjector — fleet-wide scrape
+    storm (degraded mode entered AND exited on the timeline), a
+    single-replica storm (ejection + readmission), a freeze (hedge
+    rescue), a kill mid-decode (re-dispatch exactly once) — zero
+    dropped, duplicate deliveries structurally zero, both logs
+    byte-identical per seed, and every router DECISION in the log lands
+    exactly once on the owning job's timeline, in log order."""
+    from tf_operator_tpu.engine.timeline import FlightRecorder
+
+    def run(seed, with_recorder=True):
+        inj = FaultInjector(FakeCluster(), seed=seed, clock=SimClock(),
+                            kubelet=False)
+        inj.schedule_scrape_storm(40.0, 12.0, mode="timeout")
+        inj.schedule_scrape_storm(70.0, 8.0, mode="500", replicas=["r0"])
+        inj.schedule_replica_freeze(95.0, "r1")
+        # r0, not the highest index: the autoscaler's occupancy-floor
+        # scale-in may have drained r2 away by now — the kill must land
+        # on a replica that still exists mid-traffic
+        inj.schedule_replica_kill(110.0, "r0")
+        recorder = (
+            FlightRecorder(events_per_job=512, clock=inj.clock)
+            if with_recorder else None
+        )
+        harness = FleetHarness(
+            "occupancy", n_replicas=3, injector=inj,
+            hedging=True, ejection=True,
+            autoscale=auto_spec(min_replicas=2, max_replicas=6,
+                                scale_out_queue_wait_p99_s=1.5,
+                                scale_in_occupancy_floor=0.2),
+            warm_standbys=4, recorder=recorder, job_key="default/llm",
+        )
+        trace = make_trace(seed, n_users=250)
+        summary = harness.run(trace, horizon_s=500.0)
+        return harness, summary, list(inj.log), recorder
+
+    h1, s1, l1, rec = run(4242)
+    h2, s2, l2, _ = run(4242)
+    assert h1.log == h2.log and l1 == l2 and s1 == s2
+    # a different seed is a different story (the injector log carries
+    # only the fixed schedule labels, so only the harness log varies)
+    h3, _, _, _ = run(90210)
+    assert h3.log != h1.log
+    # recording never writes the seeded logs (the PR 10 contract)
+    h4, s4, l4, _ = run(4242, with_recorder=False)
+    assert h4.log == h1.log and l4 == l1
+    # zero loss; every orphan re-dispatched exactly once; duplicate
+    # DELIVERIES are structurally zero (results keyed by first finish)
+    assert s1["dropped"] == 0
+    assert s1["completed"] == len(make_trace(4242, n_users=250))
+    assert all(n == 1 for n in s1["redispatches"].values())
+    # the whole ladder fired: degraded, ejection, hedging, AND the
+    # kill's health-expiry re-dispatch (the kill landed mid-traffic)
+    assert s1["degraded_entries"] >= 1
+    assert s1["ejections"] >= 1
+    assert s1["hedges_issued"] >= 1 and s1["hedges_won"] >= 1
+    assert any("kill replica=r0" in l for l in h1.log)
+    assert any("replica_unhealthy replica=r0" in l for l in h1.log)
+    # timeline: degraded entered AND exited, ejection + readmission,
+    # hedges — and each log DECISION appears exactly once, in log order
+    tl = rec.timeline("default/llm")
+    records = [e for e in tl["events"] if e["source"] == "router"]
+    got = [e["event"] for e in records]
+    for needed in ("router_degraded", "router_recovered",
+                   "replica_ejected", "replica_readmitted",
+                   "hedge_issued"):
+        assert needed in got, f"timeline missing {needed}"
+    decision_lines = [
+        l for l in h1.log
+        if any(k in l for k in (
+            "router_degraded", "router_recovered", "replica_ejected",
+            "replica_readmitted", "hedge_issued",
+        ))
+    ]
+    assert len(decision_lines) == len(records)
+    for line, record in zip(decision_lines, records):
+        assert record["event"] in line
+        # trigger metric + value + threshold ride the DECISION records
+        if record["event"] in ("router_degraded", "hedge_issued",
+                               "replica_ejected"):
+            assert "trigger" in record["detail"]
+            assert "threshold" in record["detail"]
+
+
+def test_cli_describe_fleet_failure_columns(capsys):
+    """describe's Fleet section gains scrape-age / ejected / degraded
+    columns when the scrape loop and router publish them — and stays
+    byte-identical when they are absent (scrape loop off)."""
+    servefleet.reset_fleet_status()
+    clock, inj, mgr, asc = autoscaled_operator()
+    asc.report("default/llm", "llm-replica-0", free_blocks=40,
+               total_blocks=100, queue_depth=2, inflight=3)
+    asc.tick()
+    cli = Cli(inj, recorder=mgr.recorder)
+    assert cli.describe("TPUServingJob", "llm", "default") == 0
+    before = capsys.readouterr().out
+    assert "scrape-age" not in before
+    assert "ejected" not in before and "degraded" not in before
+    # the scrape loop + router publish their halves
+    servefleet.note_scrape("default/llm", "llm-replica-0", 0.4, 0)
+    servefleet.note_scrape("default/llm", "llm-replica-1", 7.5, 3)
+    servefleet.note_router_state("default/llm", degraded=True,
+                                 ejected=["llm-replica-1"])
+    assert cli.describe("TPUServingJob", "llm", "default") == 0
+    out = capsys.readouterr().out
+    assert "degraded: yes" in out
+    assert "llm-replica-0: blocks=60/100 (60%) queue=2 inflight=3 " \
+           "scrape-age=0.4s" in out
+    assert "llm-replica-1: no telemetry scrape-age=7.5s failures=3 " \
+           "(ejected)" in out
+    # publishing cleared -> byte-identical to the pre-scrape output
+    servefleet.reset_fleet_status()
+    asc.tick()
+    asc.report("default/llm", "llm-replica-0", free_blocks=40,
+               total_blocks=100, queue_depth=2, inflight=3)
+    asc.tick()
+    assert cli.describe("TPUServingJob", "llm", "default") == 0
+    assert capsys.readouterr().out == before
     mgr.stop()

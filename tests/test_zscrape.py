@@ -1,0 +1,628 @@
+"""Serving-fleet scrape transport (ISSUE 15): ScrapeLoop over real HTTP,
+push-vs-scrape equivalence, failure outcomes + backoff, ejection through
+the real transport, target discovery, manager wiring.
+
+Late-alphabet file per the tier-1 870s-cap discipline.  The HTTP tests
+bind ephemeral local listeners; everything else is SimClock-driven.
+"""
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tf_operator_tpu.cmd.health import HealthServer
+from tf_operator_tpu.cmd.manager import OperatorManager, build_scrape_loop
+from tf_operator_tpu.cmd.options import ServerOptions, parse_args
+from tf_operator_tpu.engine import metrics, servefleet
+from tf_operator_tpu.engine.scrape import (
+    METRICS_ENDPOINT_ANNOTATION,
+    ScrapeLoop,
+    ScrapeTarget,
+    discover_targets,
+    extract_sample,
+    parse_exposition,
+    queue_wait_samples,
+)
+from tf_operator_tpu.engine.servefleet import FleetAutoscaler
+from tf_operator_tpu.k8s.chaos import SimClock
+from tf_operator_tpu.k8s.fake import FakeCluster
+from tf_operator_tpu.models.router import EJECTED, READY, FleetRouter
+
+
+class _ListCluster:
+    """Minimal cluster stub for a FleetAutoscaler that never ticks."""
+
+    def list(self, kind):
+        return []
+
+
+# ------------------------------------------------------------ parsing
+def test_parse_exposition_families_and_labels():
+    text = (
+        "# HELP tpu_operator_serving_kv_blocks_total cap\n"
+        "# TYPE tpu_operator_serving_kv_blocks_total gauge\n"
+        "tpu_operator_serving_kv_blocks_total 160\n"
+        'tpu_operator_serving_queue_wait_seconds_bucket{le="0.5"} 3\n'
+        'tpu_operator_serving_queue_wait_seconds_bucket{le="+Inf"} 4\n'
+        "garbage line without value x\n"
+        # legal Prometheus trailing timestamps, labeled and not
+        "tpu_operator_serving_kv_blocks_used 40 1722800000000\n"
+        'tpu_operator_serving_batch_occupancy{shard="0"} 2 1722800000000\n'
+    )
+    fams = parse_exposition(text)
+    assert fams["tpu_operator_serving_kv_blocks_total"] == [({}, 160.0)]
+    assert fams["tpu_operator_serving_kv_blocks_used"] == [({}, 40.0)]
+    assert fams["tpu_operator_serving_batch_occupancy"] == [
+        ({"shard": "0"}, 2.0),
+    ]
+    assert (
+        {"le": "0.5"}, 3.0,
+    ) in fams["tpu_operator_serving_queue_wait_seconds_bucket"]
+
+
+def test_queue_wait_samples_resolve_bucket_deltas_at_upper_bounds():
+    prev = {0.5: 1.0, 2.5: 1.0, float("inf"): 1.0}
+    cur = {0.5: 3.0, 2.5: 4.0, float("inf"): 5.0}
+    # +2 in (0, 0.5], +1 in (0.5, 2.5], +1 past 2.5 (clamped to 2.5)
+    assert queue_wait_samples(cur, prev) == [0.5, 0.5, 2.5, 2.5]
+    # no history = the whole histogram is this scrape's delta
+    assert queue_wait_samples({0.5: 2.0, float("inf"): 2.0}, {}) == [
+        0.5, 0.5,
+    ]
+
+
+def test_extract_sample_raises_on_truncated_exposition():
+    from tf_operator_tpu.engine.scrape import TruncatedExposition
+
+    fams = parse_exposition(
+        "tpu_operator_serving_kv_blocks_used 40\n"  # total missing
+    )
+    with pytest.raises(TruncatedExposition):
+        extract_sample(fams, {})
+
+
+# ------------------------------------- push-vs-scrape equivalence (HTTP)
+def serving_exposition_server():
+    """A REAL /metrics endpoint: the process-global registry served by
+    the same HealthServer a replica runs, with the serving families set
+    to known values."""
+    metrics.SERVING_KV_BLOCKS_TOTAL.set(100)
+    metrics.SERVING_KV_BLOCKS_USED.set(40)
+    metrics.SERVING_BATCH_OCCUPANCY.set(2)
+    srv = HealthServer()
+    srv.start()
+    return srv
+
+
+def test_scrape_feeds_same_numbers_as_push_seam():
+    """THE equivalence contract: a scrape of a real replica /metrics
+    endpoint (HTTP, pooled transport) lands the same report() the push
+    seam would — telemetry fields AND queue-wait samples equal.  The
+    FIRST scrape only baselines the replica's lifetime histogram (an
+    operator restart must not replay old congestion into the scale-out
+    window); deltas flow from the second scrape on."""
+    servefleet.reset_fleet_status()
+    srv = serving_exposition_server()
+    # pre-history the replica accumulated before this operator started:
+    # the priming scrape must baseline it, never report it
+    metrics.SERVING_QUEUE_WAIT.observe(9.9)
+    clock = SimClock(100.0)
+    scraped = FleetAutoscaler(_ListCluster(), clock=clock)
+    pushed = FleetAutoscaler(_ListCluster(), clock=clock)
+    router = FleetRouter(clock=clock)
+    router.add_replica("llm-replica-0")
+    loop = ScrapeLoop(
+        lambda: [ScrapeTarget(
+            "default/llm", "llm-replica-0",
+            f"http://127.0.0.1:{srv.port}/metrics",
+        )],
+        autoscaler=scraped,
+        router_of=lambda job_key: router,
+        interval=1.0, timeout=5.0, clock=clock,
+    )
+    try:
+        assert loop.tick() == 1  # priming: levels land, history doesn't
+        assert not scraped._queue_waits.get("default/llm")
+        # this interval's real traffic — waits chosen ON bucket bounds
+        # so histogram-delta samples are exact
+        blocked0 = metrics.SERVING_ADMISSION_BLOCKED.get()
+        metrics.SERVING_ADMISSION_BLOCKED.inc(amount=3)
+        metrics.SERVING_QUEUE_WAIT.observe(0.5)
+        metrics.SERVING_QUEUE_WAIT.observe(2.5)
+        clock.advance(1.0)
+        assert loop.tick() == 1
+        # the push seam's view of the same replica state: the scrape
+        # reads used/total + the blocked counter + the batch-occupancy
+        # gauge as inflight + histogram-delta waits
+        pushed.report(
+            "default/llm", "llm-replica-0",
+            free_blocks=60, total_blocks=100, queue_depth=0, inflight=2,
+            blocked_total=int(blocked0) + 3, queue_waits=[0.5, 2.5],
+        )
+        a = scraped._telemetry["default/llm"]["llm-replica-0"]
+        b = pushed._telemetry["default/llm"]["llm-replica-0"]
+        assert (a.free_blocks, a.total_blocks, a.queue_depth,
+                a.inflight) == (
+            b.free_blocks, b.total_blocks, b.queue_depth, b.inflight)
+        # blocked totals match up to the pre-test counter baseline
+        assert a.blocked_total == b.blocked_total
+        assert (
+            [w for _, w in scraped._queue_waits["default/llm"]]
+            == [w for _, w in pushed._queue_waits["default/llm"]]
+            == [0.5, 2.5]
+        )
+        # the router heard the same observe() the push path carries
+        snap = router._replicas["llm-replica-0"].snapshot
+        assert (snap.free_blocks, snap.total_blocks) == (60, 100)
+        assert router.replica_state("llm-replica-0") == READY
+        # a further tick only feeds NEW histogram deltas (none)
+        clock.advance(1.0)
+        assert loop.tick() == 1
+        assert [
+            w for _, w in scraped._queue_waits["default/llm"]
+        ] == [0.5, 2.5]
+    finally:
+        loop.stop()
+        srv.stop()
+
+
+def test_scrape_age_exported_and_published():
+    servefleet.reset_fleet_status()
+    srv = serving_exposition_server()
+    clock = SimClock(0.0)
+    loop = ScrapeLoop(
+        lambda: [ScrapeTarget(
+            "default/llm", "llm-replica-0",
+            f"http://127.0.0.1:{srv.port}/metrics",
+        )],
+        autoscaler=None, interval=1.0, timeout=5.0, clock=clock,
+    )
+    try:
+        loop.tick()
+        clock.advance(3.5)
+        loop.tick()  # due again: fresh success resets age to 0
+        assert loop.scrape_age("default/llm", "llm-replica-0") == 0.0
+        clock.advance(0.25)
+        assert loop.scrape_age("default/llm", "llm-replica-0") == 0.25
+        doc = servefleet.fleet_status("default/llm")
+        assert doc["scrape"]["llm-replica-0"]["failures"] == 0
+        assert metrics.SERVING_SCRAPE_AGE.get(
+            {"serving_job": "default/llm", "replica": "llm-replica-0"}) >= 0.0
+    finally:
+        loop.stop()
+        srv.stop()
+
+
+# ----------------------------------------------- failure outcomes (HTTP)
+class _FaultyHandler(BaseHTTPRequestHandler):
+    mode = "ok"  # "500" | "truncated" | "hang" | "ok"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        if type(self).mode == "hang":
+            time.sleep(1.0)  # longer than the scrape timeout
+            return
+        if type(self).mode == "500":
+            body = b"boom"
+            self.send_response(500)
+        else:
+            text = metrics.expose_all()
+            if type(self).mode == "truncated":
+                # cut the exposition BEFORE the serving block families:
+                # half an exposition is no exposition
+                text = text[: max(0, text.find(
+                    "tpu_operator_serving_kv_blocks"))][:400]
+            body = text.encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def faulty_server():
+    handler = type("H", (_FaultyHandler,), {})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, handler
+
+
+def test_storm_tick_fetches_concurrently():
+    """A storm must not serialize timeouts: three hanging replicas and
+    one healthy one scrape in ~one timeout of wall clock, and the
+    healthy sibling's sample still lands in the same tick."""
+    servefleet.reset_fleet_status()
+    metrics.SERVING_KV_BLOCKS_TOTAL.set(100)
+    metrics.SERVING_KV_BLOCKS_USED.set(40)
+    hang_srv, hang_handler = faulty_server()
+    hang_handler.mode = "hang"
+    ok_srv, _ = faulty_server()
+    hang_port = hang_srv.server_address[1]
+    ok_port = ok_srv.server_address[1]
+    targets = [
+        ScrapeTarget("default/llm", f"hang-{i}",
+                     f"http://127.0.0.1:{hang_port}/metrics")
+        for i in range(3)
+    ] + [ScrapeTarget("default/llm", "healthy",
+                      f"http://127.0.0.1:{ok_port}/metrics")]
+    loop = ScrapeLoop(lambda: list(targets), interval=1.0, timeout=0.5,
+                      clock=SimClock(0.0))
+    base_to = metrics.SERVING_SCRAPE_ATTEMPTS.get({"outcome": "timeout"})
+    try:
+        t0 = time.monotonic()
+        assert loop.tick() == 1  # the healthy replica
+        elapsed = time.monotonic() - t0
+        # serial would be >= 3 * 0.5s before the healthy scrape even
+        # starts; concurrent is ~one timeout (slack for slow CI)
+        assert elapsed < 1.2, f"storm tick serialized: {elapsed:.2f}s"
+        assert metrics.SERVING_SCRAPE_ATTEMPTS.get(
+            {"outcome": "timeout"}) - base_to == 3
+    finally:
+        loop.stop()
+        for s in (hang_srv, ok_srv):
+            s.shutdown()
+            s.server_close()
+
+
+def test_slow_drip_response_cannot_stall_the_tick():
+    """The per-recv socket timeout does not bound a slow-DRIP response
+    (every recv succeeds; the body never ends).  The fetch phase's wall
+    deadline must: a tick facing a dripping replica returns within
+    ~timeout, counts it as a timeout outcome, and the healthy sibling's
+    sample still lands in the same tick."""
+    servefleet.reset_fleet_status()
+    metrics.SERVING_KV_BLOCKS_TOTAL.set(100)
+    metrics.SERVING_KV_BLOCKS_USED.set(40)
+
+    class _Drip(_FaultyHandler):
+        def do_GET(self):  # noqa: N802
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", "100000")
+            self.end_headers()
+            for _ in range(40):  # ~8s of trickle, bounded for teardown
+                try:
+                    self.wfile.write(b"#")
+                    self.wfile.flush()
+                except OSError:
+                    return
+                time.sleep(0.2)
+
+    drip_srv = ThreadingHTTPServer(("127.0.0.1", 0), _Drip)
+    drip_srv.daemon_threads = True
+    threading.Thread(target=drip_srv.serve_forever, daemon=True).start()
+    ok_srv, _ = faulty_server()
+    targets = [
+        ScrapeTarget("default/llm", "drip",
+                     f"http://127.0.0.1:{drip_srv.server_address[1]}/metrics"),
+        ScrapeTarget("default/llm", "healthy",
+                     f"http://127.0.0.1:{ok_srv.server_address[1]}/metrics"),
+    ]
+    loop = ScrapeLoop(lambda: list(targets), interval=1.0, timeout=0.5,
+                      clock=SimClock(0.0))
+    base_to = metrics.SERVING_SCRAPE_ATTEMPTS.get({"outcome": "timeout"})
+    try:
+        t0 = time.monotonic()
+        assert loop.tick() == 1  # the healthy replica
+        elapsed = time.monotonic() - t0
+        assert elapsed < 3.0, f"drip stalled the tick: {elapsed:.2f}s"
+        assert metrics.SERVING_SCRAPE_ATTEMPTS.get(
+            {"outcome": "timeout"}) - base_to == 1
+        # the wedged worker is remembered: the next due attempt does NOT
+        # stack a second worker on it (one parked worker per sick
+        # replica, ever) but still counts as a timeout so the backoff
+        # ladder keeps climbing
+        assert len(loop._stuck) == 1
+        loop.clock.advance(10.0)  # past the failure backoff
+        t0 = time.monotonic()
+        assert loop.tick() == 1
+        assert time.monotonic() - t0 < 3.0
+        assert len(loop._stuck) == 1  # still just the one
+        assert metrics.SERVING_SCRAPE_ATTEMPTS.get(
+            {"outcome": "timeout"}) - base_to == 2
+    finally:
+        loop.stop()
+        drip_srv.shutdown()
+        drip_srv.server_close()
+        ok_srv.shutdown()
+        ok_srv.server_close()
+
+
+def test_start_reaps_dead_thread_from_timed_out_stop():
+    """stop() deliberately keeps _thread set while a wedged tick lives;
+    once that thread exits on the stop event, start() must reap it and
+    spawn a fresh loop — not silently no-op forever (ages frozen,
+    autoscaler blind) on the non-None sentinel."""
+    loop = ScrapeLoop(lambda: [], interval=0.05, timeout=0.2)
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    loop._thread = dead  # the timed-out-stop residue
+    loop.start()
+    assert loop._thread is not dead and loop._thread.is_alive()
+    loop.stop()
+    assert loop._thread is None
+
+
+def test_scrape_urls_split_on_real_url_structure():
+    """Scrape URLs are split by a real URL parse, not a substring hunt:
+    a HOSTNAME containing "metrics" ("http://metrics-gw:9090/metrics")
+    must dial that host, and a path-bearing endpoint must GET its own
+    path — asserted over a real listener that records request paths."""
+    loop = ScrapeLoop(lambda: [], clock=SimClock(0.0))
+    assert loop._base_of("http://metrics-gw:9090/metrics") == (
+        "http://metrics-gw:9090", "/metrics")
+    assert loop._base_of("http://10.0.0.7:9000/custom/metrics") == (
+        "http://10.0.0.7:9000", "/custom/metrics")
+    loop.stop()
+    servefleet.reset_fleet_status()
+    metrics.SERVING_KV_BLOCKS_TOTAL.set(100)
+    metrics.SERVING_KV_BLOCKS_USED.set(40)
+    handler = type("H", (_FaultyHandler,), {"paths": []})
+
+    def do_get(self):
+        type(self).paths.append(self.path)
+        _FaultyHandler.do_GET(self)
+
+    handler.do_GET = do_get
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    loop = ScrapeLoop(
+        lambda: [ScrapeTarget(
+            "default/llm", "r0",
+            f"http://127.0.0.1:{port}/telemetry/metrics",
+        )],
+        interval=1.0, timeout=5.0, clock=SimClock(0.0),
+    )
+    try:
+        assert loop.tick() == 1
+        assert handler.paths == ["/telemetry/metrics"]
+    finally:
+        loop.stop()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_scrape_failure_outcomes_backoff_and_ejection():
+    """Each failure class lands its outcome label, failures back off on
+    the capped-exponential ladder, consecutive failures EJECT the
+    replica through the real transport, and recovery re-admits it after
+    the half-open backoff."""
+    servefleet.reset_fleet_status()
+    metrics.SERVING_KV_BLOCKS_TOTAL.set(100)
+    metrics.SERVING_KV_BLOCKS_USED.set(40)
+    srv, handler = faulty_server()
+    port = srv.server_address[1]
+    clock = SimClock(0.0)
+    router = FleetRouter(clock=clock, eject_failure_threshold=3,
+                         eject_backoff_s=4.0)
+    router.job_key = "default/llm"
+    router.add_replica("r0")
+    router.observe("r0", 60, 100, 0)
+    # a clean sibling: ejection is a minority verdict
+    router.add_replica("r1")
+    router.observe("r1", 90, 100, 0)
+    loop = ScrapeLoop(
+        lambda: [ScrapeTarget(
+            "default/llm", "r0", f"http://127.0.0.1:{port}/metrics",
+        )],
+        router_of=lambda job_key: router,
+        interval=1.0, timeout=0.3, clock=clock,
+    )
+    base = {
+        o: metrics.SERVING_SCRAPE_ATTEMPTS.get({"outcome": o})
+        for o in ("ok", "timeout", "http_error", "truncated")
+    }
+
+    def attempts(outcome):
+        return metrics.SERVING_SCRAPE_ATTEMPTS.get(
+            {"outcome": outcome}) - base[outcome]
+
+    try:
+        handler.mode = "500"
+        loop.tick()
+        assert attempts("http_error") == 1
+        st = loop._state[("default/llm", "r0")]
+        assert st.failures == 1
+        # first failure retries at the base rung (interval * 2^0)
+        assert st.next_due == clock() + 1.0
+        # not due yet: nothing scrapes
+        clock.advance(0.5)
+        loop.tick()
+        assert attempts("http_error") == 1
+        handler.mode = "truncated"
+        clock.advance(0.5)
+        loop.tick()
+        assert attempts("truncated") == 1
+        # second failure climbs the ladder (interval * 2^1)
+        assert st.next_due == clock() + 2.0
+        handler.mode = "hang"
+        clock.advance(2.0)
+        loop.tick()
+        assert attempts("timeout") == 1
+        # three consecutive scrape failures: ejected (r1 is clean)
+        assert router.replica_state("r0") == EJECTED
+        assert servefleet.fleet_status("default/llm")["ejected"] == ["r0"]
+        # recovery after the half-open backoff: scrape ok -> readmitted
+        handler.mode = "ok"
+        clock.advance(8.0)
+        loop.tick()
+        assert attempts("ok") == 1
+        assert router.replica_state("r0") == READY
+        assert loop._state[("default/llm", "r0")].failures == 0
+    finally:
+        loop.stop()
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------------------ discovery
+def serving_pod(name, annotations=None, pod_ip=None, port=None,
+                controller_kind="TPUServingJob", uid="u1"):
+    env = [{"name": "SERVING_PORT", "value": str(port)}] if port else []
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": "default",
+            "annotations": annotations or {},
+            "ownerReferences": [{
+                "kind": controller_kind, "name": "llm", "uid": uid,
+                "controller": True,
+            }],
+        },
+        "spec": {"containers": [{"name": "serve", "env": env}]},
+    }
+    if pod_ip:
+        pod["status"] = {"podIP": pod_ip}
+    return pod
+
+
+def serving_owner(cluster, uid="u1"):
+    cluster.create("TPUServingJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "TPUServingJob",
+        "metadata": {"name": "llm", "namespace": "default", "uid": uid},
+        "spec": {"servingReplicaSpecs": {"Replica": {"replicas": 1,
+                 "template": {"spec": {"containers": [
+                     {"name": "serve", "image": "srv:1"}]}}}}},
+    })
+
+
+def test_discover_targets_annotation_and_podip_fallback():
+    cluster = FakeCluster()
+    serving_owner(cluster)
+    cluster.create("TFJob", {
+        "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+        "metadata": {"name": "llm", "namespace": "default", "uid": "u2"},
+        "spec": {"tfReplicaSpecs": {}},
+    })
+    cluster.create_pod(serving_pod(
+        "llm-replica-0",
+        annotations={METRICS_ENDPOINT_ANNOTATION: "127.0.0.1:9100"},
+    ))
+    cluster.create_pod(serving_pod(
+        "llm-replica-1", pod_ip="10.0.0.7", port=8000,
+    ))
+    cluster.create_pod(serving_pod("llm-replica-2"))  # undiscoverable
+    # full-URL annotation already naming the metrics path: no doubling
+    cluster.create_pod(serving_pod(
+        "llm-replica-3",
+        annotations={
+            METRICS_ENDPOINT_ANNOTATION: "http://10.0.0.8:9400/metrics",
+        },
+    ))
+    cluster.create_pod(serving_pod(
+        "train-worker-0", controller_kind="TFJob", uid="u2",
+        pod_ip="10.0.0.9", port=8000,
+    ))
+    # terminated-but-lingering and deleting pods are NOT targets: their
+    # podIP outlives the listener, and scraping them forever would pin
+    # a rising age series for a replica that can never recover
+    dead = serving_pod("llm-replica-4", pod_ip="10.0.0.10", port=8000)
+    dead["status"]["phase"] = "Failed"
+    cluster.create_pod(dead)
+    going = serving_pod("llm-replica-5", pod_ip="10.0.0.11", port=8000)
+    going["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    cluster.create_pod(going)
+    targets = discover_targets(cluster)
+    assert [(t.replica, t.url) for t in targets] == [
+        ("llm-replica-0", "http://127.0.0.1:9100/metrics"),
+        ("llm-replica-1", "http://10.0.0.7:8000/metrics"),
+        ("llm-replica-3", "http://10.0.0.8:9400/metrics"),
+    ]
+    assert all(t.job_key == "default/llm" for t in targets)
+
+
+def test_gone_targets_state_dropped():
+    servefleet.reset_fleet_status()
+    srv = serving_exposition_server()
+    targets = [ScrapeTarget(
+        "default/llm", "r0", f"http://127.0.0.1:{srv.port}/metrics",
+    )]
+    clock = SimClock(0.0)
+    loop = ScrapeLoop(lambda: list(targets), interval=1.0, timeout=5.0,
+                      clock=clock)
+    try:
+        loop.tick()
+        assert ("default/llm", "r0") in loop._state
+        assert any(
+            ("replica", "r0") in k
+            for k in metrics.SERVING_SCRAPE_AGE.samples()
+        )
+        assert len(loop._transports) == 1
+        targets.clear()  # the replica scaled away
+        clock.advance(1.0)
+        loop.tick()
+        assert loop._state == {}
+        # the warm keep-alive transport closed with it (no fd leak
+        # across fleet churn in the long-lived operator process)
+        assert loop._transports == {}
+        assert "r0" not in (
+            servefleet.fleet_status("default/llm") or {}
+        ).get("scrape", {})
+        # the age SERIES is gone too — a departed replica must not
+        # export a frozen age (it would trip the staleness alert forever)
+        assert all(
+            ("replica", "r0") not in k
+            for k in metrics.SERVING_SCRAPE_AGE.samples()
+        )
+    finally:
+        loop.stop()
+        srv.stop()
+
+
+# --------------------------------------------------------------- wiring
+def test_options_wire_serving_scrape_flags():
+    opts = parse_args([
+        "--serving-scrape-interval", "2.0",
+        "--serving-scrape-timeout", "0.5",
+    ])
+    assert opts.serving_scrape_interval == 2.0
+    assert opts.serving_scrape_timeout == 0.5
+    # defaults: no scrape loop
+    assert parse_args([]).serving_scrape_interval == 0.0
+
+
+def test_manager_builds_scrape_loop_beside_autoscaler():
+    from tf_operator_tpu.controllers.registry import EnabledSchemes
+
+    cluster = FakeCluster()
+    # default OFF: no loop even with an autoscaler
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TPUServingJob"]),
+        serving_autoscale=True,
+    )
+    mgr = OperatorManager(cluster, opts)
+    assert mgr.scrape_loop is None
+    # scrape interval without an autoscaler: nothing to feed -> None
+    assert build_scrape_loop(
+        cluster,
+        ServerOptions(serving_scrape_interval=1.0),
+        autoscaler=None,
+    ) is None
+    # both on: the loop exists, wired to the manager's autoscaler with
+    # the flags' cadence/timeout
+    opts2 = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TPUServingJob"]),
+        serving_autoscale=True,
+        serving_scrape_interval=0.25,
+        serving_scrape_timeout=1.5,
+    )
+    mgr2 = OperatorManager(cluster, opts2)
+    assert mgr2.scrape_loop is not None
+    assert mgr2.scrape_loop.autoscaler is mgr2.fleet_autoscaler
+    assert mgr2.scrape_loop.interval == 0.25
+    assert mgr2.scrape_loop.timeout == 1.5
+    # discovery follows the cluster
+    serving_owner(cluster)
+    cluster.create_pod(serving_pod(
+        "llm-replica-0",
+        annotations={METRICS_ENDPOINT_ANNOTATION: "127.0.0.1:9100"},
+    ))
+    assert [t.replica for t in mgr2.scrape_loop.targets()] == [
+        "llm-replica-0"
+    ]

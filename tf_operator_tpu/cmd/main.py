@@ -215,9 +215,18 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         pool = getattr(manager, "warm_pool", None)
         sched = getattr(manager, "scheduler", None)
         autoscaler = getattr(manager, "fleet_autoscaler", None)
+        scrape = getattr(manager, "scrape_loop", None)
+        if options.serving_scrape_interval > 0 and scrape is None:
+            log.warning(
+                "--serving-scrape-interval %g was given but no scrape "
+                "loop runs: the loop feeds the serving autoscaler, "
+                "which requires --serving-autoscale",
+                options.serving_scrape_interval,
+            )
         log.info(
             "manager started: kinds=%s shards=%d warm_pool=%s scheduler=%s "
-            "timeline=%s elastic_resize=%s serving_autoscale=%s",
+            "timeline=%s elastic_resize=%s serving_autoscale=%s "
+            "serving_scrape=%s",
             options.all_kinds,
             getattr(manager, "shard_count", 1),
             dict(pool.config.sizes) if pool is not None else "off",
@@ -234,6 +243,10 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
             (
                 f"every {autoscaler.interval:g}s"
                 if autoscaler is not None else "off"
+            ),
+            (
+                f"every {scrape.interval:g}s timeout {scrape.timeout:g}s"
+                if scrape is not None else "off"
             ),
         )
 

@@ -159,6 +159,26 @@ def build_fleet_autoscaler(cluster, options: ServerOptions, engine_kwargs=None,
     )
 
 
+def build_scrape_loop(cluster, options: ServerOptions, autoscaler,
+                      engine_kwargs=None):
+    """One serving-fleet scrape loop per operator process, or None when
+    --serving-scrape-interval is 0 (the default) or no autoscaler runs
+    to consume the telemetry.  Targets are re-discovered from the
+    cluster every tick (TPUServingJob pods with a metrics endpoint), so
+    the scrape set follows the fleet through scale events."""
+    if options.serving_scrape_interval <= 0 or autoscaler is None:
+        return None
+    from tf_operator_tpu.engine.scrape import ScrapeLoop, discover_targets
+
+    return ScrapeLoop(
+        lambda: discover_targets(cluster),
+        autoscaler=autoscaler,
+        interval=options.serving_scrape_interval,
+        timeout=options.serving_scrape_timeout,
+        clock=(engine_kwargs or {}).get("clock", time.time),
+    )
+
+
 def build_warm_pool(cluster, options: ServerOptions, engine_kwargs=None):
     """One WarmPoolManager per operator process, or None when disabled.
     Shared by every shard's engines: claims are CAS-safe, and a single
@@ -614,6 +634,14 @@ class OperatorManager:
             if self._owns_autoscaler else None
         )
         self._owns_autoscaler = self.fleet_autoscaler is not None
+        # serving-fleet scrape loop (engine/scrape.py): the real
+        # telemetry transport — per-replica /metrics over the pooled
+        # keep-alive HttpTransport, feeding the autoscaler the numbers
+        # the push seam otherwise carries; --serving-scrape-interval 0
+        # (default) builds nothing
+        self.scrape_loop = build_scrape_loop(
+            cluster, self.options, self.fleet_autoscaler, engine_kwargs
+        )
         if self.recorder is not None:
             if self.warm_pool is not None:
                 self.warm_pool.recorder = self.recorder
@@ -695,9 +723,13 @@ class OperatorManager:
             self.warm_pool.start()
         if self._owns_autoscaler:
             self.fleet_autoscaler.start()
+        if self.scrape_loop is not None:
+            self.scrape_loop.start()
         self._started = True
 
     def stop(self) -> None:
+        if self.scrape_loop is not None:
+            self.scrape_loop.stop()
         if self._owns_autoscaler:
             self.fleet_autoscaler.stop()
         if self._owns_warm_pool:

@@ -128,6 +128,15 @@ class ServerOptions:
     # TPUServingJob then stays at its declared replica count.
     serving_autoscale: bool = False
     serving_autoscale_interval: float = 1.0
+    # serving-fleet scrape transport (engine/scrape.py): per-replica
+    # HTTP GET of each TPUServingJob replica's /metrics over the pooled
+    # keep-alive transport, feeding the autoscaler the same numbers the
+    # in-process push seam would, with per-replica timeout, capped-
+    # exponential backoff on failure, and exported scrape age.  0
+    # (default) builds no scrape loop — telemetry arrives only via the
+    # push seam, byte-identical to the pre-scrape operator.
+    serving_scrape_interval: float = 0.0
+    serving_scrape_timeout: float = 2.0
     # when True (default), reconcile errors the client layer classified as
     # transient (429/5xx/reset/conflict) are requeued with backoff WITHOUT
     # consuming the bounded reconcile-retry budget; False restores the
@@ -340,6 +349,24 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "declared size",
     )
     p.add_argument("--serving-autoscale-interval", type=float, default=1.0)
+    p.add_argument(
+        "--serving-scrape-interval",
+        type=float,
+        default=0.0,
+        help="scrape each TPUServingJob replica's /metrics at this "
+        "cadence (seconds) over the pooled keep-alive transport, "
+        "feeding the fleet autoscaler the numbers the push seam "
+        "otherwise carries; failed scrapes back off per replica "
+        "(capped exponential) and export per-replica scrape age; "
+        "0 (default) disables the scrape loop",
+    )
+    p.add_argument(
+        "--serving-scrape-timeout",
+        type=float,
+        default=2.0,
+        help="per-replica scrape timeout in seconds (a slower reply "
+        "counts as a failed scrape)",
+    )
     p.add_argument("--version", action="store_true", dest="print_version")
     a = p.parse_args(argv)
 
@@ -398,4 +425,6 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         timeline_max_jobs=a.timeline_max_jobs,
         serving_autoscale=a.serving_autoscale,
         serving_autoscale_interval=a.serving_autoscale_interval,
+        serving_scrape_interval=a.serving_scrape_interval,
+        serving_scrape_timeout=a.serving_scrape_timeout,
     )

@@ -92,6 +92,13 @@ class Gauge(Metric):
         with _LOCK:
             self._values[_label_key(labels)] = value
 
+    def remove(self, labels: Optional[Dict[str, str]] = None) -> None:
+        """Drop one label-set's series (e.g. a replica that left the
+        fleet) — without this the gauge exports its last value forever
+        and per-entity label cardinality only ever grows."""
+        with _LOCK:
+            self._values.pop(_label_key(labels), None)
+
 
 class Histogram(Metric):
     """Prometheus histogram: cumulative le-buckets + _sum + _count.
@@ -771,6 +778,55 @@ SERVING_FLEET_SCALE_EVENTS = Counter(
     "queue-wait/blocked-admission trigger; dir=in: replica drained and "
     "removed on the occupancy floor) — each event also lands as a "
     "DECISIONS record on the owning TPUServingJob's timeline",
+)
+# Serving-fleet failure domain (ISSUE 15): the scrape transport's
+# health (attempts by outcome, per-replica age), and the router's
+# degraded/ejection/hedging activity.  Scrape age is THE staleness
+# signal the router's health expiry and degraded fallback key on;
+# docs/monitoring.md carries the scrape-success-ratio, ejection-rate,
+# and hedge-win-rate PromQL.
+SERVING_SCRAPE_ATTEMPTS = Counter(
+    f"{PREFIX}_serving_scrape_attempts_total",
+    "Per-replica /metrics scrape attempts by outcome (ok; timeout: no "
+    "response within --serving-scrape-timeout; http_error: non-200 "
+    "status; truncated: a 200 whose exposition is missing the serving "
+    "block families — half an exposition is no exposition; error: "
+    "transport-level failure) — ok/total is the scrape success ratio",
+)
+SERVING_SCRAPE_AGE = Gauge(
+    f"{PREFIX}_serving_scrape_age_seconds",
+    "Seconds since each replica's last SUCCESSFUL scrape (labeled by "
+    "serving_job and replica; not `job`, which Prometheus reserves for "
+    "the scrape-target label and would rewrite to exported_job) — the staleness signal behind the router's health expiry "
+    "and fleet-wide degraded fallback; a rising age on every replica "
+    "at once means the scrape plane, not the fleet, is down",
+)
+SERVING_REPLICA_EJECTIONS = Counter(
+    f"{PREFIX}_serving_replica_ejections_total",
+    "Replicas ejected from dispatch after consecutive scrape or "
+    "dispatch failures (models/router.py) — re-admission is half-open: "
+    "a fresh telemetry sample after a capped-exponential backoff; each "
+    "ejection re-dispatches the replica's unfinished requests exactly "
+    "once and lands as a replica_ejected DECISION on the timeline",
+)
+SERVING_ROUTER_DEGRADED = Counter(
+    f"{PREFIX}_serving_router_degraded_total",
+    "Times the router entered DEGRADED mode: every replica's telemetry "
+    "stale at once (the monitoring plane down, not the fleet), dispatch "
+    "falls back to round-robin over READY replicas instead of parking "
+    "the FIFO on blindness; recovery is the first fresh sample",
+)
+SERVING_HEDGE_REQUESTS = Counter(
+    f"{PREFIX}_serving_hedge_requests_total",
+    "Hedged (speculatively re-dispatched) requests by outcome: issued "
+    "(first token overdue past the ceil-rank-p99 TTFT threshold, "
+    "floor-clamped — a copy went to a sibling), won (the hedge copy "
+    "carried the request: it delivered first, OR the original holder "
+    "died/failed and the hedge copy was left to deliver), lost (the "
+    "original carried it; the loser's completion is dropped by the "
+    "dedup ledger) — won/issued is the hedge win rate that justifies "
+    "the speculation budget; every race settles exactly once, at "
+    "delivery or at a holder's death",
 )
 SERVING_KV_WINDOW_EVICTED = Counter(
     f"{PREFIX}_serving_kv_window_evicted_blocks_total",

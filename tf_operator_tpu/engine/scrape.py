@@ -1,0 +1,654 @@
+"""Serving-fleet scrape transport — the REAL telemetry path.
+
+PR 14's router and autoscaler consume replica telemetry through an
+in-process push seam (`FleetAutoscaler.report()` / `FleetRouter.observe()`)
+— perfect for simulation and tests, useless for a deployed front-end,
+where telemetry arrives by scraping each replica's `/metrics` over HTTP
+and every failure mode of that transport (timeouts, 5xx, half an
+exposition, a dead listener) is a routine Tuesday.  This module is the
+transport:
+
+  - **`ScrapeLoop`**: per-replica HTTP GET of `/metrics` over the pooled
+    keep-alive `HttpTransport` (PR 5 — one warm socket per replica
+    endpoint, retired on any transport error), parsing the serving
+    families every replica already exports (PR 9's block-pool gauges,
+    the admission-blocked counter, the queue-wait histogram) and feeding
+    the SAME `report()`/`observe()` calls the push seam would — push
+    stays as the sim/test seam, asserted equivalent by
+    tests/test_zscrape.py's push-vs-scrape test.
+  - **Failure accounting**: every attempt lands in
+    `serving_scrape_attempts_total{outcome}` (ok / timeout / http_error
+    / truncated / error); failures back off per replica on PR 3's
+    `capped_exponential` ladder and count toward the router's ejection
+    threshold (`FleetRouter.scrape_failed` — a failing scrape IS a
+    missed heartbeat).  Per-replica scrape AGE (seconds since the last
+    success) is exported as `serving_scrape_age_seconds{replica}` and
+    published into the fleet status doc `tpu-jobs describe` renders —
+    age rising on every replica at once is the signature of the scrape
+    plane (not the fleet) being down, which the router answers with its
+    degraded round-robin fallback.
+  - **Exposition parsing**: the queue-wait p99 source is the replica's
+    `serving_queue_wait_seconds` histogram — per-scrape bucket-count
+    deltas are resolved into samples at their bucket upper bound (the
+    same ceil-rank read `bench.merge_bucket_percentiles` performs), so
+    the autoscaler's sliding window sees the scrape exactly as it sees
+    the push.  A 200 whose body is missing the block families is a
+    TRUNCATED exposition and counts as a failed scrape — half an
+    exposition must never feed half a decision.  Replica queue depth is
+    not separately exported by serve_loop; the scrape reports the batch
+    occupancy gauge as the in-flight level and 0 queue depth (the
+    occupancy score's dominant term is free blocks; depth is a
+    tie-break the push seam still carries exactly).
+
+Wired behind `--serving-scrape-interval` / `--serving-scrape-timeout`
+(cmd/options.py) and run by the manager beside `--serving-autoscale`
+(cmd/manager.build_scrape_loop).  Target discovery reads each
+TPUServingJob pod's `kubeflow.org/metrics-endpoint` annotation, falling
+back to `status.podIP` + the SERVING_PORT env the ServingAdapter stamps.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from concurrent.futures import TimeoutError as FuturesTimeout
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from tf_operator_tpu.engine import metrics, servefleet
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.client import HttpTransport, KubeConfig
+from tf_operator_tpu.k8s.informer import capped_exponential
+from tf_operator_tpu.utils.logging import get_logger
+
+log = get_logger("serving-scrape")
+
+SERVING_KIND = "TPUServingJob"
+# pod annotation naming the replica's metrics listener ("host:port" or a
+# full http URL) — the explicit override; absent, discovery falls back
+# to status.podIP + the SERVING_PORT env
+METRICS_ENDPOINT_ANNOTATION = "kubeflow.org/metrics-endpoint"
+
+# the serving families a replica scrape resolves (engine/metrics.py,
+# fed by models/telemetry.py + serve_loop's paged pool)
+F_BLOCKS_TOTAL = "tpu_operator_serving_kv_blocks_total"
+F_BLOCKS_USED = "tpu_operator_serving_kv_blocks_used"
+F_BLOCKED = "tpu_operator_serving_admission_blocked_on_memory_total"
+F_OCCUPANCY = "tpu_operator_serving_batch_occupancy"
+F_QUEUE_WAIT_BUCKET = "tpu_operator_serving_queue_wait_seconds_bucket"
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+class TruncatedExposition(Exception):
+    """A 200 response whose body is missing the serving block families:
+    the exposition was cut mid-flight (or the target is not a serving
+    replica) — treated as a failed scrape, never as zeros."""
+
+
+def parse_exposition(
+    text: str,
+) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Prometheus text exposition -> {family: [(labels, value), ...]}.
+    Comment/TYPE/HELP lines are skipped; unparseable sample lines are
+    ignored (a scraper must survive a family it does not know)."""
+    families: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, rest = line.partition("{")
+        labels: Dict[str, str] = {}
+        if rest:
+            raw, _, value_part = rest.rpartition("}")
+            labels = {k: v for k, v in _LABEL_RE.findall(raw)}
+        else:
+            # split on the FIRST space: a legal trailing timestamp
+            # ("name value ts") must not be taken as the value
+            name, _, value_part = line.partition(" ")
+            name = name.strip()
+        try:
+            value = float(value_part.strip().split()[0])
+        except (ValueError, IndexError):
+            continue
+        families.setdefault(name.strip(), []).append((labels, value))
+    return families
+
+
+def _value(
+    families: Dict[str, List[Tuple[Dict[str, str], float]]],
+    name: str,
+) -> Optional[float]:
+    samples = families.get(name)
+    if not samples:
+        return None
+    # prefer the unlabeled sample (the process-level level); fall back
+    # to the first labeled one
+    for labels, value in samples:
+        if not labels:
+            return value
+    return samples[0][1]
+
+
+def _bucket_counts(
+    families: Dict[str, List[Tuple[Dict[str, str], float]]],
+    name: str,
+) -> Dict[float, float]:
+    out: Dict[float, float] = {}
+    for labels, value in families.get(name, ()):
+        le = labels.get("le")
+        if le is None:
+            continue
+        out[float("inf") if le == "+Inf" else float(le)] = value
+    return out
+
+
+def queue_wait_samples(
+    buckets: Dict[float, float], prev: Dict[float, float]
+) -> List[float]:
+    """Resolve per-scrape cumulative-bucket deltas into wait samples at
+    their bucket's upper bound (the ceil-rank read: a sample that landed
+    in (le_{i-1}, le_i] is worth le_i — the same convention
+    bench.merge_bucket_percentiles uses).  +Inf overflow clamps to the
+    largest finite bound."""
+    finite = sorted(le for le in buckets if le != float("inf"))
+    samples: List[float] = []
+    below = 0.0
+    for le in finite:
+        cum_delta = buckets[le] - prev.get(le, 0.0)
+        n = int(round(cum_delta - below))
+        if n > 0:
+            samples.extend([le] * n)
+        below = max(below, cum_delta)
+    inf_delta = buckets.get(float("inf"), 0.0) - prev.get(
+        float("inf"), 0.0
+    )
+    overflow = int(round(inf_delta - below))
+    if finite and overflow > 0:
+        samples.extend([finite[-1]] * overflow)
+    return samples
+
+
+@dataclasses.dataclass(frozen=True)
+class ScrapeTarget:
+    """One replica's scrape address."""
+
+    job_key: str   # "<namespace>/<job name>"
+    replica: str   # pod name (the router/autoscaler replica id)
+    url: str       # full URL, e.g. "http://10.0.0.7:8000/metrics"
+
+
+@dataclasses.dataclass
+class ReplicaSample:
+    """One successful scrape, in the shape report()/observe() take."""
+
+    free_blocks: int = 0
+    total_blocks: int = 0
+    queue_depth: int = 0
+    inflight: int = 0
+    blocked_total: int = 0
+    queue_waits: List[float] = dataclasses.field(default_factory=list)
+
+
+def extract_sample(
+    families: Dict[str, List[Tuple[Dict[str, str], float]]],
+    prev_buckets: Dict[float, float],
+) -> Tuple[ReplicaSample, Dict[float, float]]:
+    """Families -> ReplicaSample (+ this scrape's bucket counts, the
+    next scrape's delta baseline).  Raises TruncatedExposition when the
+    block families are absent — the number the autoscaler scales on must
+    never be fabricated from a cut-off body."""
+    total = _value(families, F_BLOCKS_TOTAL)
+    used = _value(families, F_BLOCKS_USED)
+    if total is None or used is None:
+        raise TruncatedExposition(
+            f"exposition missing {F_BLOCKS_TOTAL}/{F_BLOCKS_USED}"
+        )
+    blocked = _value(families, F_BLOCKED) or 0.0
+    occupancy = _value(families, F_OCCUPANCY) or 0.0
+    buckets = _bucket_counts(families, F_QUEUE_WAIT_BUCKET)
+    waits = queue_wait_samples(buckets, prev_buckets)
+    return (
+        ReplicaSample(
+            free_blocks=max(0, int(total - used)),
+            total_blocks=int(total),
+            queue_depth=0,
+            inflight=int(occupancy),
+            blocked_total=int(blocked),
+            queue_waits=waits,
+        ),
+        buckets,
+    )
+
+
+def discover_targets(cluster) -> List[ScrapeTarget]:
+    """Scrape targets from the cluster: every pod controlled by a
+    TPUServingJob whose metrics listener is discoverable — the
+    `kubeflow.org/metrics-endpoint` annotation ("host:port" or full
+    URL), else `status.podIP` + the SERVING_PORT env the ServingAdapter
+    stamps on every replica."""
+    out: List[ScrapeTarget] = []
+    for pod in cluster.list("Pod"):
+        ref = objects.get_controller_of(pod)
+        if not ref or ref.get("kind") != SERVING_KIND:
+            continue
+        md = pod.get("metadata") or {}
+        status = pod.get("status") or {}
+        # a terminated-but-lingering pod (OOM kill, eviction) or one
+        # already being deleted is not a scrape target: its podIP may
+        # outlive its listener, and scraping it forever would pin a
+        # rising age series + endless scrape_failed() for a replica
+        # that can never recover
+        if md.get("deletionTimestamp") or status.get("phase") in (
+            "Succeeded", "Failed",
+        ):
+            continue
+        endpoint = (md.get("annotations") or {}).get(
+            METRICS_ENDPOINT_ANNOTATION
+        )
+        if not endpoint:
+            ip = status.get("podIP")
+            port = None
+            for c in (pod.get("spec") or {}).get("containers", []) or []:
+                for e in c.get("env", []) or []:
+                    if e.get("name") == "SERVING_PORT":
+                        port = e.get("value")
+                        break
+                if port:
+                    # FIRST container wins — the ServingAdapter stamps
+                    # the serving container first; a sidecar's copy of
+                    # the env must not steal the scrape target
+                    break
+            if ip and port:
+                endpoint = f"{ip}:{port}"
+        if not endpoint:
+            continue
+        base = (
+            endpoint if endpoint.startswith(("http://", "https://"))
+            else f"http://{endpoint}"
+        ).rstrip("/")
+        # a full-URL annotation may already name the metrics path
+        url = base if base.endswith("/metrics") else f"{base}/metrics"
+        out.append(ScrapeTarget(
+            job_key=f"{objects.namespace_of(pod)}/{ref.get('name', '')}",
+            replica=objects.name_of(pod),
+            url=url,
+        ))
+    return sorted(out, key=lambda t: (t.job_key, t.replica))
+
+
+class _TargetState:
+    __slots__ = (
+        "failures", "next_due", "last_success", "first_seen", "buckets",
+        "primed",
+    )
+
+    def __init__(self, now: float) -> None:
+        self.failures = 0
+        self.next_due = now
+        self.last_success: Optional[float] = None
+        self.first_seen = now
+        # previous scrape's cumulative queue-wait buckets (delta base)
+        self.buckets: Dict[float, float] = {}
+        # False until the first successful scrape: that scrape's
+        # cumulative histogram is the replica's lifetime history, not
+        # this interval's traffic — baseline only, never samples
+        self.primed = False
+
+
+class ScrapeLoop:
+    """The per-replica /metrics scrape driver.  See module docs.
+
+    `targets` is a callable returning the current List[ScrapeTarget]
+    (re-evaluated every tick, so replicas appear/disappear with the
+    fleet); `autoscaler` receives report() per successful scrape;
+    `router_of(job_key)` (optional — a colocated front-end) returns the
+    FleetRouter whose observe()/scrape_failed() mirror the telemetry.
+    FleetRouter is NOT thread-safe: a front-end wiring router_of while
+    serving requests on its own thread must serialize router calls
+    (one lock or one event loop) — the started loop calls the router
+    from its scrape thread."""
+
+    def __init__(
+        self,
+        targets: Callable[[], List[ScrapeTarget]],
+        autoscaler=None,
+        router_of: Optional[Callable[[str], Any]] = None,
+        interval: float = 1.0,
+        timeout: float = 2.0,
+        clock: Callable[[], float] = time.time,
+        backoff_max_s: float = 30.0,
+        transport_factory: Optional[Callable] = None,
+    ) -> None:
+        self.targets = targets
+        self.autoscaler = autoscaler
+        self.router_of = router_of
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self.clock = clock
+        self.backoff_max_s = float(backoff_max_s)
+        self.transport_factory = transport_factory
+        self._transports: Dict[str, HttpTransport] = {}
+        self._transport_lock = threading.Lock()
+        self._state: Dict[Tuple[str, str], _TargetState] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # persistent fetch pool (lazily built, regrown on fleet growth):
+        # a tick per second spawning-and-joining N fresh OS threads is
+        # pure churn in a long-lived operator process
+        self._fetch_pool: Optional[ThreadPoolExecutor] = None
+        self._fetch_pool_size = 0
+        # fetches abandoned at the wall deadline whose worker is still
+        # wedged mid-body (a slow-drip response): at most ONE per
+        # target — no new fetch is stacked on a wedged one, so a sick
+        # replica parks exactly one worker, never the whole pool
+        self._stuck: Dict[Tuple[str, str], Any] = {}
+
+    # ------------------------------------------------------------ transport
+    def _base_of(self, url: str) -> Tuple[str, str]:
+        """Scrape URL -> (scheme://netloc, request path).  A real URL
+        split, not a substring hunt: a hostname containing "metrics"
+        ("http://metrics-gw:9090/metrics") or a path-bearing endpoint
+        ("http://10.0.0.7:9000/custom/metrics") must dial the right
+        host and GET the right path."""
+        parts = urlsplit(url)
+        path = parts.path or "/metrics"
+        if parts.query:
+            path = f"{path}?{parts.query}"
+        return f"{parts.scheme}://{parts.netloc}", path
+
+    def _fetcher(self, n: int) -> ThreadPoolExecutor:
+        """The persistent fetch pool, regrown when the fleet outgrows it
+        (an executor's worker count is fixed at creation; a storm tick
+        must still run every timing-out fetch concurrently or one slow
+        replica serializes its siblings' cadence behind its timeout)."""
+        if self._fetch_pool is None or self._fetch_pool_size < n:
+            if self._fetch_pool is not None:
+                self._fetch_pool.shutdown(wait=False)
+            self._fetch_pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="serving-scrape-fetch"
+            )
+            self._fetch_pool_size = n
+        return self._fetch_pool
+
+    def _transport(self, base: str) -> HttpTransport:
+        with self._transport_lock:
+            t = self._transports.get(base)
+            if t is None:
+                cfg = KubeConfig(server=base)
+                if self.transport_factory is not None:
+                    t = self.transport_factory(cfg, self.timeout)
+                else:
+                    # small pool: one warm keep-alive socket per replica
+                    # is the steady state; 2 covers a retire mid-burst
+                    t = HttpTransport(
+                        cfg, timeout=self.timeout, pool_size=2
+                    )
+                self._transports[base] = t
+            return t
+
+    # -------------------------------------------------------------- scraping
+    def _fetch(
+        self, target: ScrapeTarget
+    ) -> Tuple[str, Optional[int], Optional[str]]:
+        """The HTTP half of one scrape: ("response", status, body) or a
+        terminal outcome.  Safe to run concurrently — it touches only
+        the locked transport pool."""
+        base, path = self._base_of(target.url)
+        try:
+            status, body, _headers = self._transport(base).request(
+                "GET", path
+            )
+        except TimeoutError:
+            return ("timeout", None, None)
+        except Exception:  # noqa: BLE001 — any transport death is a miss
+            return ("error", None, None)
+        return ("response", status, body if isinstance(body, str) else "")
+
+    def scrape_one(
+        self,
+        target: ScrapeTarget,
+        fetched: Optional[Tuple[str, Optional[int], Optional[str]]] = None,
+    ) -> str:
+        """One scrape attempt -> outcome label (ok / timeout /
+        http_error / truncated / error).  Feeds the autoscaler + router
+        on ok; failures only count.  `fetched` carries the concurrent
+        fetch phase's result; absent, the GET runs inline."""
+        state = self._state[(target.job_key, target.replica)]
+        kind, status, body = (
+            fetched if fetched is not None else self._fetch(target)
+        )
+        if kind != "response":
+            return kind
+        if status != 200:
+            return "http_error"
+        try:
+            sample, buckets = extract_sample(
+                parse_exposition(body or ""), state.buckets
+            )
+        except TruncatedExposition:
+            return "truncated"
+        state.buckets = buckets
+        if not state.primed:
+            # an operator (re)start against a long-running replica must
+            # not replay its whole histogram into the scale-out window
+            state.primed = True
+            sample.queue_waits = []
+        now = self.clock()
+        if self.autoscaler is not None:
+            self.autoscaler.report(
+                target.job_key, target.replica,
+                free_blocks=sample.free_blocks,
+                total_blocks=sample.total_blocks,
+                queue_depth=sample.queue_depth,
+                inflight=sample.inflight,
+                blocked_total=sample.blocked_total,
+                queue_waits=sample.queue_waits,
+                ts=now,
+            )
+        router = (
+            self.router_of(target.job_key)
+            if self.router_of is not None else None
+        )
+        if router is not None:
+            router.observe(
+                target.replica, sample.free_blocks, sample.total_blocks,
+                sample.queue_depth,
+            )
+        return "ok"
+
+    def _finish_scrape(
+        self,
+        target: ScrapeTarget,
+        fetched: Tuple[str, Optional[int], Optional[str]],
+    ) -> int:
+        """Parse/feed one fetched scrape and book its outcome (attempt
+        counter, backoff ladder, router failure signal).  Returns 1 on
+        an ok scrape, 0 otherwise."""
+        key = (target.job_key, target.replica)
+        state = self._state[key]
+        outcome = self.scrape_one(target, fetched)
+        metrics.SERVING_SCRAPE_ATTEMPTS.inc({"outcome": outcome})
+        now = self.clock()
+        if outcome == "ok":
+            state.failures = 0
+            state.last_success = now
+            state.next_due = now + self.interval
+            return 1
+        state.failures += 1
+        # first failure retries at the base interval; the ladder climbs
+        # from the second on (same 0-based exponent every other backoff
+        # in this codebase uses)
+        state.next_due = now + capped_exponential(
+            self.interval, state.failures - 1, self.backoff_max_s
+        )
+        router = (
+            self.router_of(target.job_key)
+            if self.router_of is not None else None
+        )
+        if router is not None:
+            router.scrape_failed(target.replica)
+        return 0
+
+    def tick(self) -> int:
+        """Scrape every due target once; returns the success count.
+        Exports per-replica scrape age and publishes it into the fleet
+        status doc afterward, success or not — age is the signal."""
+        now = self.clock()
+        targets = self.targets()
+        known = {(t.job_key, t.replica) for t in targets}
+        for key in [k for k in self._state if k not in known]:
+            del self._state[key]
+            self._stuck.pop(key, None)
+            servefleet.drop_scrape(*key)
+            # a replica that left the fleet must stop exporting: a
+            # frozen age series would trip the staleness alert forever
+            metrics.SERVING_SCRAPE_AGE.remove(
+                {"serving_job": key[0], "replica": key[1]}
+            )
+        # ...and its warm keep-alive transport must close: over fleet
+        # churn every departed pod IP would otherwise pin sockets in
+        # this long-lived process forever
+        live_bases = {self._base_of(t.url)[0] for t in targets}
+        with self._transport_lock:
+            for base in [
+                b for b in self._transports if b not in live_bases
+            ]:
+                self._transports.pop(base).close()
+        due = []
+        for target in targets:
+            key = (target.job_key, target.replica)
+            state = self._state.get(key)
+            if state is None:
+                state = self._state[key] = _TargetState(now)
+            if now >= state.next_due and not self._stop.is_set():
+                due.append(target)
+        # fetch phase runs CONCURRENTLY and results are processed in
+        # COMPLETION order: in a storm, one timing-out (or slow-DRIP)
+        # replica must not hold a healthy sibling's already-arrived
+        # sample hostage to the shared deadline — healthy telemetry
+        # feeds the instant its fetch lands.  Parsing + feeding still
+        # run on THIS thread; per-replica sample order is unchanged
+        # (the deterministic surface is the push seam, not wall-clock
+        # transport timing).
+        ok = 0
+        submit = []
+        for t in due:
+            key = (t.job_key, t.replica)
+            prev = self._stuck.get(key)
+            if prev is not None:
+                if prev.done():
+                    self._stuck.pop(key)  # late result discarded
+                else:
+                    # the previous attempt is still wedged mid-body: do
+                    # not stack another worker on it — the attempt still
+                    # counts (backoff climbs, scrape_failed fires) but
+                    # the sick replica holds exactly one worker
+                    ok += self._finish_scrape(t, ("timeout", None, None))
+                    continue
+            submit.append(t)
+        if submit:
+            # capacity covers the new fetches PLUS the parked workers,
+            # so healthy siblings never queue behind a wedged fetch
+            pool = self._fetcher(len(submit) + len(self._stuck))
+            by_future = {
+                pool.submit(self._fetch, t): t for t in submit
+            }
+            # shared wall deadline: the per-recv socket timeout does
+            # NOT bound a slow-DRIP response (every recv succeeds, the
+            # body never ends) — an unbounded wait would let one sick
+            # replica stall every healthy sibling's cadence and blow
+            # past stop()'s join bound.  An abandoned fetch's worker
+            # finishes (or trickles) on its own; its late result is
+            # discarded.
+            try:
+                for fut in as_completed(
+                    by_future, timeout=self.timeout + 1.0
+                ):
+                    ok += self._finish_scrape(
+                        by_future.pop(fut), fut.result()
+                    )
+            except FuturesTimeout:
+                pass
+            for fut, target in by_future.items():  # abandoned at deadline
+                key = (target.job_key, target.replica)
+                self._stuck[key] = fut
+                ok += self._finish_scrape(target, ("timeout", None, None))
+        now = self.clock()  # the fetch phase consumed wall time
+        for target in targets:
+            state = self._state[(target.job_key, target.replica)]
+            age = now - (
+                state.last_success
+                if state.last_success is not None else state.first_seen
+            )
+            metrics.SERVING_SCRAPE_AGE.set(
+                age,
+                {"serving_job": target.job_key,
+                 "replica": target.replica},
+            )
+            servefleet.note_scrape(
+                target.job_key, target.replica, age, state.failures
+            )
+        return ok
+
+    def scrape_age(self, job_key: str, replica: str) -> Optional[float]:
+        state = self._state.get((job_key, replica))
+        if state is None:
+            return None
+        anchor = (
+            state.last_success
+            if state.last_success is not None else state.first_seen
+        )
+        return self.clock() - anchor
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            if self._thread.is_alive():
+                return
+            # a previous stop() timed out its join and left the thread
+            # recorded; it has since drained and exited on the stop
+            # event — reap it, or the loop could never be restarted
+            # (silent no-op: ages frozen, autoscaler blind)
+            self._thread = None
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-scrape", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            # a storm tick is bounded by the HTTP timeout (fetches run
+            # concurrently): join past that bound rather than closing a
+            # live tick's sockets underneath it
+            t.join(timeout=self.timeout + self.interval + 1.0)
+            if t.is_alive():
+                # the daemon thread did not drain in time — leave its
+                # transports alone (it would only re-dial them) and
+                # keep _thread set so start() refuses while it lives
+                return
+            self._thread = None
+        with self._transport_lock:
+            for tr in self._transports.values():
+                tr.close()
+            self._transports.clear()
+        if self._fetch_pool is not None:
+            self._fetch_pool.shutdown(wait=False)
+            self._fetch_pool = None
+            self._fetch_pool_size = 0
+        self._stuck.clear()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — a storm must not kill the loop
+                # ...but a silently swallowed tick is an invisible
+                # outage: the autoscaler runs blind while the operator
+                # looks healthy.  Log it so the failure is diagnosable.
+                log.exception("scrape tick failed")

@@ -220,6 +220,37 @@ def reset_fleet_status() -> None:
         _FLEET_STATUS.clear()
 
 
+def note_scrape(job_key: str, replica: str, age_s: float,
+                failures: int) -> None:
+    """The scrape loop's contribution to the fleet status doc: each
+    replica's scrape age and consecutive-failure count, rendered by the
+    describe Fleet section.  Absent entirely when no scrape loop runs —
+    describe output stays byte-identical."""
+    with _STATUS_LOCK:
+        doc = _FLEET_STATUS.setdefault(job_key, {})
+        doc.setdefault("scrape", {})[replica] = {
+            "age_s": round(age_s, 3), "failures": int(failures),
+        }
+
+
+def drop_scrape(job_key: str, replica: str) -> None:
+    with _STATUS_LOCK:
+        (_FLEET_STATUS.get(job_key) or {}).get("scrape", {}).pop(
+            replica, None
+        )
+
+
+def note_router_state(job_key: str, degraded: bool,
+                      ejected: List[str]) -> None:
+    """The router's contribution: fleet-wide degraded flag and the
+    currently-ejected replica set.  Only a router with an owning job key
+    publishes (a front-end process / the fleet harness)."""
+    with _STATUS_LOCK:
+        doc = _FLEET_STATUS.setdefault(job_key, {})
+        doc["degraded"] = bool(degraded)
+        doc["ejected"] = sorted(ejected)
+
+
 class FleetAutoscaler:
     """The operator half: watches TPUServingJobs, aggregates per-replica
     telemetry, and edits `spec.servingReplicaSpecs.Replica.replicas`.

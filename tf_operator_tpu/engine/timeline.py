@@ -87,6 +87,11 @@ _DECISION_SOURCES = frozenset({
     # replica_drained — the records that explain why a serving fleet
     # changed shape, each carrying the trigger metric and its value
     "servefleet",
+    # fleet router (models/router.py): router_degraded / router_recovered
+    # / replica_ejected / replica_readmitted / hedge_issued — the
+    # records that explain why dispatch changed shape under failure,
+    # each carrying the trigger metric, observed value, and threshold
+    "router",
 })
 # controller events that are routine cadence, not decisions: a job
 # parked in a long crash-loop backoff window re-records its wait every

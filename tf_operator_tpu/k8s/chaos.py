@@ -186,6 +186,16 @@ class FaultInjector:
         # seeded log.  Recording never writes to the log, so the
         # byte-identical-per-seed contract holds with or without it.
         self.recorder = None
+        # serving-fleet attach point (models/fleetsim.FleetHarness):
+        # when set, request-plane faults (replica freeze, kill-mid-
+        # decode) fire INTO the harness off this injector's schedule —
+        # the harness shares the injector's SimClock, so the injector
+        # log and the router/harness log march to one beat.  None (the
+        # default, and every operator chaos scenario) leaves all
+        # historical behavior byte-identical.
+        self.fleet = None
+        # scrape-fault storm windows: (start, end, mode, replicas|None)
+        self._scrape_storms: List[Tuple[float, float, str, Optional[frozenset]]] = []
         if kubelet:
             self.inner.subscribe("Pod", self._kubelet_on_pod)
 
@@ -557,6 +567,77 @@ class FaultInjector:
                     n += 1
         self._log(f"t={self.clock():g} drain node={node} killed={n}")
         return n
+
+    # ------------------------------------------------- serving faults
+    # Chaos at the request plane (ISSUE 15): seeded, sim-clock-scheduled
+    # faults against a serving FLEET — the harness (models/fleetsim.py)
+    # consults scrape_fault() at every heartbeat and registers itself as
+    # `fleet` so freeze/kill events fire into it.  Everything lands in
+    # this injector's deterministic log; nothing here touches the
+    # cluster surface, so the operator chaos goldens are unaffected.
+
+    def schedule_scrape_storm(
+        self,
+        start: float,
+        duration: float,
+        mode: str = "timeout",
+        replicas: Optional[List[str]] = None,
+    ) -> None:
+        """Scrapes of `replicas` (None = every replica) fail with `mode`
+        (timeout / 500 / truncated) in [start, start+duration) — the
+        monitoring-plane outage the router's ejection ladder and
+        degraded fallback exist for."""
+        window = (
+            start, start + duration, mode,
+            # [] is an explicit empty scope (a dynamically-built list
+            # that matched nothing), NOT "every replica" — only None
+            # means fleet-wide
+            frozenset(replicas) if replicas is not None else None,
+        )
+        self._scrape_storms.append(window)
+        scope = (
+            ",".join(sorted(replicas)) if replicas is not None else "*"
+        )
+        self.at(
+            start, lambda: None,
+            f"scrape_storm_begin mode={mode} replicas={scope}",
+        )
+        self.at(
+            start + duration, lambda: None,
+            f"scrape_storm_end mode={mode}",
+        )
+
+    def scrape_fault(self, replica: str) -> Optional[str]:
+        """The active scrape-storm mode covering `replica` right now, or
+        None when the scrape path is clear.  Counted per consultation."""
+        now = self.clock()
+        for start, end, mode, scope in self._scrape_storms:
+            if start <= now < end and (scope is None or replica in scope):
+                self._count(f"scrape.{mode}")
+                return mode
+        return None
+
+    def schedule_replica_freeze(self, at: float, replica: str) -> None:
+        """Freeze a serving replica at simulated time `at`: it keeps
+        accepting dispatches and (unless a scrape storm also covers it)
+        keeps heartbeating healthy telemetry, but never makes progress —
+        the SIGSTOP'd decode thread whose metrics thread lives.  Only
+        hedged re-dispatch rescues its in-flight requests."""
+        self.at(
+            at,
+            lambda: self.fleet is not None and self.fleet.freeze(replica),
+            f"freeze replica={replica}",
+        )
+
+    def schedule_replica_kill(self, at: float, replica: str) -> None:
+        """Kill a serving replica mid-decode at simulated time `at`: it
+        stops heartbeating AND computing — health expiry re-dispatches
+        its orphans exactly once."""
+        self.at(
+            at,
+            lambda: self.fleet is not None and self.fleet.kill_now(replica),
+            f"kill_mid_decode replica={replica}",
+        )
 
     # ------------------------------------------------- intercepted surface
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:

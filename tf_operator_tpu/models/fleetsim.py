@@ -88,6 +88,11 @@ class SimReplica:
         self.rid = rid
         self.cfg = cfg
         self.alive = True
+        # frozen = the SIGSTOP of serving: accepts dispatch (enqueue
+        # still lands), keeps heartbeating its last-known telemetry,
+        # but admits/prefills/decodes NOTHING — the straggler regime
+        # only hedged re-dispatch rescues
+        self.frozen = False
         self.free_blocks = cfg.pool_blocks
         self.queue: "deque[Tuple[ServeRequest, float]]" = deque()
         self.lanes: List[_Lane] = []
@@ -127,7 +132,7 @@ class SimReplica:
 
     def step(self, now: float, dt: float) -> List[dict]:
         """Advance dt seconds; returns completion records."""
-        if not self.alive:
+        if not self.alive or self.frozen:
             return []
         self._admit(now)
         done: List[dict] = []
@@ -246,10 +251,32 @@ class FleetHarness:
         health_interval_s: float = 2.0,
         max_inflight_per_replica: int = 12,
         dt: float = 0.05,
+        injector=None,                  # k8s/chaos.FaultInjector or None
+        hedging: bool = False,
+        ejection: bool = False,
+        eject_failure_threshold: int = 3,
+        hedge_floor_s: float = 1.0,
+        recorder=None,
+        job_key: str = "",
     ) -> None:
+        """`injector` composes the request-plane chaos (scrape storms,
+        replica freeze, kill-mid-decode): the harness adopts the
+        injector's SimClock and registers itself as `injector.fleet`, so
+        the injector's seeded schedule and the router's decision log
+        march to one beat.  `hedging`/`ejection` arm the router's
+        failure machinery (both OFF by default so every pre-existing
+        trace — BENCH_r13, the PR 14 soaks — replays byte-identically);
+        `recorder`/`job_key` land the router's degraded/ejection/hedge
+        DECISIONs on the owning job's timeline."""
         self.mode = mode
         self.cfg = replica_cfg or ReplicaConfig()
-        self.clock = SimClock()
+        self.injector = injector
+        if injector is not None:
+            self.clock = injector.clock
+            injector.fleet = self
+        else:
+            self.clock = SimClock()
+        self.hedging = bool(hedging)
         self.dt = dt
         self.heartbeat_s = heartbeat_s
         self.autoscale_interval_s = autoscale_interval_s
@@ -268,7 +295,14 @@ class FleetHarness:
             health_interval=health_interval_s,
             block_size=self.cfg.block_size,
             clock=self.clock,
+            eject_failure_threshold=(
+                eject_failure_threshold if ejection else 0
+            ),
+            enable_hedging=self.hedging,
+            hedge_floor_s=hedge_floor_s,
         )
+        self.router.recorder = recorder
+        self.router.job_key = job_key
         self.log = self.router.events  # one merged deterministic log
         self.replicas: Dict[str, SimReplica] = {}
         self._next_idx = 0
@@ -285,6 +319,12 @@ class FleetHarness:
         self._blocked_prev: Dict[str, int] = {}
         self._wait_window: "deque[Tuple[float, float]]" = deque()
         self._draining: Optional[str] = None
+        # drain wait bound, mirroring FleetAutoscaler.drain_timeout_s: a
+        # FROZEN victim (accepts dispatch, never completes, keeps
+        # heartbeating) would otherwise hold inflight>0 forever and
+        # silently disable autoscaling for the rest of the run
+        self._drain_started: Optional[float] = None
+        self.drain_timeout_s = 30.0
         self.arrival_t: Dict[str, float] = {}
         self.results: Dict[str, dict] = {}
         self.duplicates = 0
@@ -329,6 +369,20 @@ class FleetHarness:
         self.kills.append((at, rid))
         self.kills.sort()
 
+    # injector-fired faults (FaultInjector.schedule_replica_freeze/_kill
+    # land here through the `fleet` attach point, on the shared clock)
+    def kill_now(self, rid: str) -> None:
+        replica = self.replicas.get(rid)
+        if replica is not None and replica.alive:
+            replica.alive = False
+            self._log(f"kill replica={rid}")
+
+    def freeze(self, rid: str) -> None:
+        replica = self.replicas.get(rid)
+        if replica is not None and replica.alive and not replica.frozen:
+            replica.frozen = True
+            self._log(f"freeze replica={rid}")
+
     # ------------------------------------------------------------ autoscale
     def _p99(self, now: float, window_s: float = 12.0) -> float:
         while self._wait_window and now - self._wait_window[0][0] > window_s:
@@ -357,13 +411,25 @@ class FleetHarness:
             self._blocked_prev[rid] = r.blocked_total
         p99 = self._p99(now)
         if self._draining is not None:
-            if self.router.inflight(self._draining) == 0:
+            timed_out = (
+                self._drain_started is not None
+                and now - self._drain_started > self.drain_timeout_s
+            )
+            if self.router.inflight(self._draining) == 0 or timed_out:
                 victim = self._draining
                 self._draining = None
-                self.router.remove_replica(victim, requeue=False)
+                self._drain_started = None
+                # a timed-out victim (frozen mid-drain) still holds
+                # requests: requeue them exactly once — the operator
+                # side completes a wedged drain the same way (bounded
+                # disruption vs a permanent autoscaling wedge)
+                self.router.remove_replica(victim, requeue=timed_out)
                 self.replicas.pop(victim, None)
                 self._blocked_prev.pop(victim, None)
-                self._log(f"scale_in_done replica={victim}")
+                self._log(
+                    f"scale_in_done replica={victim}"
+                    + (" timeout=1" if timed_out else "")
+                )
                 self.scale_events.append({
                     "dir": "in", "t": now, "replica": victim,
                 })
@@ -400,6 +466,7 @@ class FleetHarness:
             # are r0..rN — lexical order would pick r9 over r10)
             victim = max(ready, key=lambda rid: int(rid[1:]))
             self._draining = victim
+            self._drain_started = now
             self.router.drain(victim)
             self._log(
                 f"scale_in replica={victim} occupancy={occupancy:.3f}"
@@ -414,7 +481,12 @@ class FleetHarness:
         next_scale = 0.0
         n_total = len(trace)
         while (len(self.results) < n_total or pending) and self.clock() < horizon_s:
-            self.clock.advance(self.dt)
+            if self.injector is not None:
+                # one beat: advances the SHARED clock and fires due
+                # injector faults (freeze/kill land via the fleet hook)
+                self.injector.step(self.dt)
+            else:
+                self.clock.advance(self.dt)
             now = self.clock()
             while pending and pending[0][0] <= now:
                 _, req = pending.popleft()
@@ -422,10 +494,7 @@ class FleetHarness:
                 self.router.submit(req)
             while kills and kills[0][0] <= now:
                 _, rid = kills.popleft()
-                replica = self.replicas.get(rid)
-                if replica is not None and replica.alive:
-                    replica.alive = False
-                    self._log(f"kill replica={rid}")
+                self.kill_now(rid)
             inflight = sum(
                 r.inflight() for r in self.replicas.values() if r.alive
             ) + self.router.queue_depth()
@@ -440,6 +509,17 @@ class FleetHarness:
                         self.results[rec["rid"]] = rec
                     else:
                         self.duplicates += 1
+                if self.hedging and not replica.frozen:
+                    # first tokens feed the router's TTFT distribution
+                    # (the hedge threshold) and every scan refreshes the
+                    # per-request progress anchor; a FROZEN replica's
+                    # lanes emit nothing, so they get no refresh and age
+                    # into hedge eligibility — exactly the rescue path
+                    for lane in replica.lanes:
+                        if lane.first_token_t is not None:
+                            self.router.note_first_token(
+                                rid, lane.req.rid
+                            )
             for rid, ready_at in sorted(self._starting.items()):
                 if now >= ready_at:
                     del self._starting[rid]
@@ -454,6 +534,18 @@ class FleetHarness:
                     replica = self.replicas[rid]
                     if not replica.alive or rid in self._starting:
                         continue
+                    if self.injector is not None:
+                        fault = self.injector.scrape_fault(rid)
+                        if fault is not None:
+                            # the scrape (heartbeat) of this replica
+                            # failed: no telemetry lands — a missed
+                            # heartbeat the router's ejection ladder
+                            # counts and its health expiry ages
+                            self._log(
+                                f"scrape_fail replica={rid} mode={fault}"
+                            )
+                            self.router.scrape_failed(rid)
+                            continue
                     hb = replica.heartbeat()
                     for w in hb["queue_waits"]:
                         self._wait_window.append((now, w))
@@ -481,6 +573,17 @@ class FleetHarness:
         def pct(xs: List[float], q: float) -> Optional[float]:
             return round(ceil_rank_percentile(xs, q), 3) if xs else None
 
+        # censored all-requests p99: a dropped request's TTFT is +inf,
+        # not absent — excluding the lost tail lets a lossy arm "win"
+        # tail latency by survivorship.  None = the p99 rank lands in
+        # the lost region (unbounded).
+        all_ttfts = ttfts + [float("inf")] * (n_total - len(recs))
+        p99_all = (
+            ceil_rank_percentile(all_ttfts, 0.99) if all_ttfts else None
+        )
+        if p99_all == float("inf"):
+            p99_all = None
+
         reactions = [
             round(e["ready_t"] - e["t"], 3)
             for e in self.scale_events if e["dir"] == "out"
@@ -493,6 +596,9 @@ class FleetHarness:
             "tokens_per_sec": round(tokens / span, 1) if span else 0.0,
             "ttft_p50_s": pct(ttfts, 0.50),
             "ttft_p99_s": pct(ttfts, 0.99),
+            "ttft_p99_all_s": (
+                round(p99_all, 3) if p99_all is not None else None
+            ),
             "queue_wait_p99_s": pct(waits, 0.99),
             "peak_inflight": self.peak_inflight,
             "replica_seconds": round(self.replica_seconds, 1),
@@ -502,4 +608,9 @@ class FleetHarness:
                 1 for e in self.scale_events if e["dir"] == "in"),
             "scale_out_reaction_s": reactions,
             "redispatches": dict(self.router.redispatches),
+            "ejections": self.router.ejections,
+            "hedges_issued": self.router.hedges_issued,
+            "hedges_won": self.router.hedges_won,
+            "hedges_lost": self.router.hedges_lost,
+            "degraded_entries": self.router.degraded_entries,
         }

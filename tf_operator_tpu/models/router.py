@@ -30,6 +30,34 @@ not from a guess:
     request).  Completion is deduplicated by request id, so even a
     false-positive expiry (replica alive but slow) delivers one result —
     at-least-once dispatch, at-most-once delivery.
+  - **Degraded mode**: staleness on EVERY replica at once is a
+    monitoring-plane outage (the scrape loop died, not N independent
+    replicas) — expiring the whole fleet would park the FIFO on
+    blindness.  Instead the router degrades: round-robin over READY
+    replicas (in-flight bounds still honored — they are the router's own
+    books, not telemetry), a `router_degraded` DECISION on the owning
+    job's timeline plus `serving_router_degraded_total`, and recovery to
+    occupancy dispatch on the FIRST fresh sample.  Availability over
+    optimality.
+  - **Ejection**: `eject_failure_threshold` CONSECUTIVE scrape or
+    dispatch failures eject a replica — dispatch stops, its unfinished
+    requests re-dispatch exactly once, and re-admission is half-open: a
+    fresh telemetry sample is accepted as the probe only after a
+    capped-exponential backoff (`replica_ejected` / `replica_readmitted`
+    DECISIONs, `serving_replica_ejections_total`).  The drain fence is
+    sticky through an ejection exactly as through an UNHEALTHY detour.
+  - **Hedging**: a dispatched request whose token stream has been
+    SILENT past the hedge threshold — ceil-rank p99 of recent TTFTs,
+    clamped to `hedge_floor_s` — is speculatively re-dispatched ONCE to
+    a sibling (arXiv:2010.11307's speculative-execution arm).  The
+    silence anchor is the request's last progress (dispatch, first
+    token, or any token after), so a replica that freezes MID-decode
+    strands nothing: its requests age into eligibility exactly like a
+    prefill that never starts.  Both copies ride the completion-dedup
+    ledger, so delivery stays at-most-once; the loser's completion is
+    dropped and frees its own replica's slot.
+    `serving_hedge_requests_total{outcome=issued|won|lost}` counts the
+    arms (won = the hedge copy delivered first).
   - **Drain**: `drain()` stops new dispatch to a replica while its
     in-flight requests finish — the scale-in half of the autoscaler
     (engine/servefleet.py) deletes the pod only after `inflight() == 0`,
@@ -52,6 +80,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.k8s.informer import capped_exponential
 
 POLICIES = ("occupancy", "round_robin")
 
@@ -60,6 +89,7 @@ STARTING = "starting"    # pod claimed/created, not yet heartbeating
 READY = "ready"          # dispatchable
 DRAINING = "draining"    # finishing in-flight before scale-in
 UNHEALTHY = "unhealthy"  # heartbeat stale; dispatch suspended
+EJECTED = "ejected"      # consecutive failures; half-open re-admission
 
 
 @dataclasses.dataclass
@@ -89,7 +119,8 @@ class ReplicaSnapshot:
 class _Replica:
     __slots__ = (
         "rid", "state", "snapshot", "inflight", "debit_blocks",
-        "debit_count", "drain_pending", "last_seen",
+        "debit_count", "drain_pending", "last_seen", "dispatched_at",
+        "last_progress", "consec_failures", "eject_count", "eject_until",
     )
 
     def __init__(self, rid: str, state: str) -> None:
@@ -103,6 +134,13 @@ class _Replica:
         self.last_seen: Optional[float] = None
         # dispatched-but-unfinished requests, in dispatch order
         self.inflight: Dict[str, ServeRequest] = {}
+        # per-request dispatch time: the hedge pass measures time-to-
+        # first-token against it
+        self.dispatched_at: Dict[str, float] = {}
+        # per-request last-progress time (first token and every token
+        # after): the hedge pass's stall anchor — a decode that stops
+        # emitting is as overdue as one that never starts
+        self.last_progress: Dict[str, float] = {}
         # blocks/requests committed since the last heartbeat (cleared by
         # observe(): the fresh report already reflects them)
         self.debit_blocks = 0
@@ -112,6 +150,14 @@ class _Replica:
         # back as DRAINING, never READY (the autoscaler is about to
         # delete it; resuming dispatch would hand it doomed requests)
         self.drain_pending = False
+        # ejection bookkeeping: consecutive scrape/dispatch failures
+        # (any success resets), ejections so far (the backoff ladder's
+        # exponent), and the half-open gate — telemetry before
+        # eject_until is ignored, the first sample at/after it is the
+        # re-admission probe
+        self.consec_failures = 0
+        self.eject_count = 0
+        self.eject_until = 0.0
 
     def effective_free(self) -> int:
         if self.snapshot is None:
@@ -125,7 +171,14 @@ class _Replica:
 
 
 class FleetRouter:
-    """Dispatch front-end over N serving replicas.  See module docs."""
+    """Dispatch front-end over N serving replicas.  See module docs.
+
+    NOT thread-safe: the router is a deterministic single-threaded
+    state machine (its event log is the chaos byte-identity surface).
+    A caller wiring it to more than one thread — e.g. a front-end's
+    request loop plus a ScrapeLoop's router_of seam — must serialize
+    every call (submit/finish/observe/tick/...) through one lock or
+    one event loop."""
 
     def __init__(
         self,
@@ -134,6 +187,12 @@ class FleetRouter:
         health_interval: float = 5.0,
         block_size: int = 16,
         clock: Callable[[], float] = time.time,
+        eject_failure_threshold: int = 3,
+        eject_backoff_s: float = 4.0,
+        eject_backoff_max_s: float = 60.0,
+        enable_hedging: bool = True,
+        hedge_floor_s: float = 1.0,
+        hedge_min_samples: int = 8,
     ) -> None:
         if policy not in POLICIES:
             raise ValueError(
@@ -144,6 +203,17 @@ class FleetRouter:
         self.health_interval = float(health_interval)
         self.block_size = int(block_size)
         self.clock = clock
+        # ejection ladder: 0 disables ejection entirely (the bench's
+        # no-ejection baseline); backoff doubles per ejection, capped
+        self.eject_failure_threshold = int(eject_failure_threshold)
+        self.eject_backoff_s = float(eject_backoff_s)
+        self.eject_backoff_max_s = float(eject_backoff_max_s)
+        # hedging: threshold = max(floor, p99 of recent TTFTs); no
+        # hedge fires before hedge_min_samples TTFTs exist (a cold
+        # router has no distribution to rank against)
+        self.enable_hedging = bool(enable_hedging)
+        self.hedge_floor_s = float(hedge_floor_s)
+        self.hedge_min_samples = int(hedge_min_samples)
         self._replicas: Dict[str, _Replica] = {}
         self._queue: "deque[ServeRequest]" = deque()
         self._rr_last: Optional[str] = None
@@ -169,19 +239,63 @@ class FleetRouter:
         # deterministic decision log (the seeded chaos byte-identity
         # surface): every dispatch/queue/health/drain decision, in order
         self.events: List[str] = []
+        # degraded mode: every replica's telemetry stale at once — the
+        # monitoring plane is down, not the fleet; dispatch falls back
+        # to round-robin over READY replicas until the first fresh
+        # sample (availability over optimality)
+        self.degraded = False
+        self.degraded_entries = 0
+        # hedging ledgers: request id -> the sibling holding the hedge
+        # copy (one live hedge per request), TTFT samples for the p99
+        # threshold, and request ids whose first token arrived —
+        # _first_token only dedupes TTFT sampling; eligibility is the
+        # hedge pass's last-progress anchor, so a stream that goes
+        # silent MID-decode hedges exactly like one that never starts
+        self._hedged: Dict[str, str] = {}
+        self._hedged_order: "deque[str]" = deque()
+        self._ttfts: "deque[float]" = deque(maxlen=256)
+        self._first_token: set = set()
+        self._first_token_order: "deque[str]" = deque()
+        self.hedges_issued = 0
+        self.hedges_won = 0
+        self.hedges_lost = 0
+        self.ejections = 0
+        # flight-recorder seams: when an owning TPUServingJob is known
+        # (front-end process / fleet harness), degraded/ejection/hedge
+        # decisions land on its timeline as DECISION records
+        self.recorder = None
+        self.job_key = ""
 
     # ------------------------------------------------------------- helpers
     def _log(self, line: str) -> None:
         self.events.append(f"t={self.clock():g} {line}")
 
+    def _record(self, event: str, detail: Dict) -> None:
+        if self.recorder is not None and self.job_key:
+            self.recorder.record(
+                self.job_key, "router", event, detail, ts=self.clock()
+            )
+
     def _gauge_states(self) -> None:
         counts: Dict[str, int] = {}
         for r in self._replicas.values():
             counts[r.state] = counts.get(r.state, 0) + 1
-        for state in (STARTING, READY, DRAINING, UNHEALTHY):
+        for state in (STARTING, READY, DRAINING, UNHEALTHY, EJECTED):
             metrics.SERVING_FLEET_REPLICAS.set(
                 counts.get(state, 0), {"state": state}
             )
+        self._publish_router_state()
+
+    def _publish_router_state(self) -> None:
+        if not self.job_key:
+            return
+        from tf_operator_tpu.engine import servefleet
+
+        servefleet.note_router_state(
+            self.job_key,
+            degraded=self.degraded,
+            ejected=self.replicas(state=EJECTED),
+        )
 
     def _queue_gauge(self) -> None:
         metrics.SERVING_ROUTER_QUEUE_DEPTH.set(len(self._queue))
@@ -200,6 +314,121 @@ class FleetRouter:
         self._completed_order.append(request_id)
         while len(self._completed_order) > self.ledger_cap:
             self._completed.discard(self._completed_order.popleft())
+
+    def _note_first_token_id(self, request_id: str) -> None:
+        self._first_token.add(request_id)
+        self._first_token_order.append(request_id)
+        while len(self._first_token_order) > self.ledger_cap:
+            self._first_token.discard(self._first_token_order.popleft())
+
+    def _drop_hedge_entry(
+        self,
+        request_id: str,
+        dead_rid: Optional[str] = None,
+        delivered_by: Optional[str] = None,
+    ) -> None:
+        """Restore a request's hedge budget, keeping the order deque in
+        sync — a stale duplicate left behind would, at the cap, evict a
+        LIVE re-hedge's ledger entry and break the one-hedge budget.
+
+        The race's outcome settles HERE (the one place, so won+lost
+        converges to issued): `delivered_by` names the replica whose
+        completion won the race outright (the hedge won iff it
+        delivered); `dead_rid` names a holder whose death, dispatch
+        failure, or stall voided it (the hedge won iff the OTHER copy
+        failed).  Neither set = pure budget restore."""
+        hedge_rid = self._hedged.pop(request_id, None)
+        if hedge_rid is None:
+            return
+        try:
+            self._hedged_order.remove(request_id)
+        except ValueError:
+            pass
+        if dead_rid is None and delivered_by is None:
+            return
+        won = (
+            delivered_by == hedge_rid
+            if delivered_by is not None else dead_rid != hedge_rid
+        )
+        if won:
+            self.hedges_won += 1
+        else:
+            self.hedges_lost += 1
+        metrics.SERVING_HEDGE_REQUESTS.inc(
+            {"outcome": "won" if won else "lost"}
+        )
+        self._log(
+            f"hedge_{'won' if won else 'lost'} req={request_id} "
+            f"via={delivered_by if delivered_by is not None else dead_rid}"
+        )
+
+    def _holders(self, request_id: str) -> List[str]:
+        """Replicas currently holding `request_id` in flight (one, or
+        two while a hedge is outstanding)."""
+        return [
+            rid for rid in sorted(self._replicas)
+            if request_id in self._replicas[rid].inflight
+        ]
+
+    def _requeue_orphans(
+        self,
+        r: _Replica,
+        now: Optional[float] = None,
+        stalled_only: bool = False,
+    ) -> int:
+        """Re-dispatch a dead/ejected replica's unfinished requests to
+        siblings, each exactly once — EXCEPT requests whose hedge copy
+        is still alive on another replica (a third dispatch would break
+        the one-hedge budget; the live copy already covers delivery).
+
+        `stalled_only` takes just the requests with no progress for a
+        health interval (the gap-recovery sweep: a replica whose pod
+        restarted behind a telemetry gap carries books its fresh
+        process knows nothing about, while a stream the front-end kept
+        feeding progress notes for stays put)."""
+        if stalled_only:
+            taken = [
+                req for req_id, req in list(r.inflight.items())
+                if now - r.last_progress.get(
+                    req_id, r.dispatched_at.get(req_id, now)
+                ) > self.health_interval
+            ]
+            for req in taken:
+                r.inflight.pop(req.rid, None)
+                r.dispatched_at.pop(req.rid, None)
+                r.last_progress.pop(req.rid, None)
+        else:
+            taken = list(r.inflight.values())
+            r.inflight.clear()
+            r.dispatched_at.clear()
+            r.last_progress.clear()
+            r.debit_blocks = 0
+            r.debit_count = 0
+        orphans = [
+            req for req in taken if req.rid not in self._completed
+        ]
+        n = 0
+        for req in orphans:
+            # the dying replica held ONE of the request's copies
+            # (original or hedge arm): whichever survives is back to
+            # being the only copy — restore the request's hedge budget
+            # (or a later stall could never re-hedge and the request
+            # would strand forever on a frozen holder) and settle the
+            # race's outcome so won+lost tracks issued
+            self._drop_hedge_entry(req.rid, dead_rid=r.rid)
+            covered = self._holders(req.rid)
+            if covered:
+                self._log(
+                    f"redispatch_skipped req={req.rid} "
+                    f"covered_by={covered[0]}"
+                )
+                continue
+            self._note_redispatch(req.rid)
+            metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "redispatch"})
+            self._log(f"redispatch req={req.rid} from={r.rid}")
+            self._place(req)
+            n += 1
+        return n
 
     # ------------------------------------------------------------ lifecycle
     def add_replica(self, rid: str, state: str = STARTING) -> None:
@@ -233,7 +462,11 @@ class FleetRouter:
         if r is None:
             return 0
         r.drain_pending = True
-        if r.state != DRAINING:
+        # an EJECTED replica keeps its state: the fence is pending, and
+        # the half-open re-admission brings it back DRAINING (forcing
+        # DRAINING here would re-arm health expiry on a replica the
+        # ejection backoff already owns)
+        if r.state not in (DRAINING, EJECTED):
             r.state = DRAINING
             self._log(f"drain_begin replica={rid} inflight={len(r.inflight)}")
             self._gauge_states()
@@ -246,21 +479,8 @@ class FleetRouter:
         r = self._replicas.pop(rid, None)
         if r is None:
             return 0
-        orphans = [
-            req for req in r.inflight.values()
-            if req.rid not in self._completed
-        ]
-        self._log(
-            f"replica_removed replica={rid} requeue={len(orphans) if requeue else 0}"
-        )
-        n = 0
-        if requeue:
-            for req in orphans:
-                self._note_redispatch(req.rid)
-                metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "redispatch"})
-                self._log(f"redispatch req={req.rid} from={rid}")
-                self._place(req)
-                n += 1
+        n = self._requeue_orphans(r) if requeue else 0
+        self._log(f"replica_removed replica={rid} requeue={n}")
         self._gauge_states()
         self._queue_gauge()
         return n
@@ -270,6 +490,12 @@ class FleetRouter:
         if r is not None and r.state in (STARTING, UNHEALTHY):
             r.state = DRAINING if r.drain_pending else READY
             r.last_seen = self.clock()
+            # failures accumulated BEFORE ready (scrapes racing a boot
+            # whose /metrics listener was not up yet) are not evidence
+            # against the serving replica: without this reset, one
+            # post-ready transient would instantly eject it — "N
+            # CONSECUTIVE failures" starts counting now
+            r.consec_failures = 0
             self._log(f"replica_ready replica={rid}")
             self._gauge_states()
             self.pump()
@@ -290,13 +516,23 @@ class FleetRouter:
         r = self._replicas.get(rid)
         if r is None:
             return
+        now = self.clock()
+        if r.state == EJECTED and now < r.eject_until:
+            # still serving the ejection backoff: the half-open gate
+            # ignores telemetry until the probe window opens — a storm
+            # that intermittently succeeds must not flap the replica
+            # back into dispatch
+            return
+        prev_ts = r.snapshot.ts if r.snapshot is not None else r.last_seen
+        was_degraded = self.degraded
         r.snapshot = ReplicaSnapshot(
             free_blocks=int(free_blocks), total_blocks=int(total_blocks),
             queue_depth=int(queue_depth),
-            ts=self.clock() if ts is None else ts,
+            ts=now if ts is None else ts,
         )
         r.debit_blocks = 0
         r.debit_count = 0
+        r.consec_failures = 0
         if r.state == STARTING:
             r.state = DRAINING if r.drain_pending else READY
             self._log(f"replica_ready replica={rid}")
@@ -309,45 +545,378 @@ class FleetRouter:
             r.state = DRAINING if r.drain_pending else READY
             self._log(f"replica_recovered replica={rid}")
             self._gauge_states()
+        elif r.state == EJECTED:
+            # half-open probe success: the backoff elapsed and the
+            # replica produced fresh telemetry — re-admit (sticky drain
+            # fence honored, like the UNHEALTHY recovery path)
+            r.state = DRAINING if r.drain_pending else READY
+            self._log(f"replica_readmitted replica={rid}")
+            self._record(
+                "replica_readmitted",
+                {"replica": rid, "ejections": r.eject_count},
+            )
+            self._gauge_states()
+        if self.degraded and r.state == READY:
+            # a fresh sample from a DISPATCHABLE replica ends degraded
+            # mode: occupancy dispatch has evidence it can act on.  A
+            # drain victim's heartbeat is NOT such evidence —
+            # _candidates() will never pick it, and exiting on it would
+            # hand the next tick a fleet whose every READY replica is
+            # still stale, expiring them all and parking the FIFO (the
+            # exact outcome degraded mode exists to prevent).
+            self.degraded = False
+            self._log(f"router_recovered replica={rid}")
+            self._record("router_recovered", {"replica": rid})
+            self._publish_router_state()
+        if (
+            was_degraded
+            and prev_ts is not None
+            and now - prev_ts > self.health_interval
+            and r.inflight
+        ):
+            # DEGRADED-gap recovery: this fresh sample lands after a
+            # full missed-heartbeat window that degraded mode
+            # deliberately never expired — possibly a pod that died
+            # and restarted behind the outage.  Its progress-stalled
+            # in-flight books belong to a process that no longer
+            # exists: requeue them, or they consume dispatch slots
+            # forever on a replica that will never finish them.
+            # Streams the front-end kept feeding progress notes for
+            # stay put, completion dedup keeps a late survivor's
+            # delivery at-most-once, and outside a degraded episode
+            # staleness is the health sweep's business (expiry already
+            # requeues in full).
+            self._requeue_orphans(r, now=now, stalled_only=True)
         self.pump()
+
+    # ------------------------------------------------------------- failures
+    def scrape_failed(self, rid: str) -> None:
+        """One failed scrape of `rid` (timeout/5xx/truncated): a missed
+        heartbeat by another name.  Counts toward ejection; staleness
+        itself is the health sweep's business."""
+        r = self._replicas.get(rid)
+        if r is None:
+            return
+        r.consec_failures += 1
+        self._maybe_eject(r, "scrape_failures")
+
+    def dispatch_failed(self, rid: str, request_id: str) -> None:
+        """A dispatch handed to `rid` never landed (connection refused,
+        pod gone).  The request re-places immediately — it was never
+        accepted, so this is not a re-dispatch of an orphan — and the
+        failure counts toward ejection."""
+        r = self._replicas.get(rid)
+        if r is None:
+            return
+        req = r.inflight.pop(request_id, None)
+        r.dispatched_at.pop(request_id, None)
+        r.last_progress.pop(request_id, None)
+        if req is not None:
+            # one of the request's copies never landed: back to one
+            # copy — restore the hedge budget so a stalled survivor can
+            # still be rescued by a later hedge pass, settling the
+            # race's outcome against the failed holder
+            self._drop_hedge_entry(request_id, dead_rid=rid)
+            # ...and reverse the dispatch's occupancy debit: the request
+            # never landed, so until the next heartbeat cleared them the
+            # phantom blocks would make an empty replica look full
+            # (clamped — observe() may already have zeroed the debits)
+            r.debit_blocks = max(
+                0, r.debit_blocks - req.blocks(self.block_size)
+            )
+            r.debit_count = max(0, r.debit_count - 1)
+        r.consec_failures += 1
+        self._log(f"dispatch_failed req={request_id} replica={rid}")
+        self._maybe_eject(r, "dispatch_failures")
+        # re-place only a request that is neither delivered nor covered:
+        # a hedge copy whose dispatch failure is reported AFTER the
+        # other arm already completed must not burn a third execution
+        # (same guard _requeue_orphans applies).  Require a SIBLING —
+        # with the failed dispatch's debit reversed, the refusing
+        # replica may well score best again and the request would
+        # ping-pong into the replica that just refused it; with no
+        # sibling it queues until pump() has somewhere to put it
+        if (
+            req is not None
+            and request_id not in self._completed
+            and not self._holders(request_id)
+        ):
+            self._place(req, avoid=frozenset((rid,)))
+
+    def _maybe_eject(self, r: _Replica, trigger: str) -> None:
+        if (
+            self.eject_failure_threshold <= 0
+            or r.consec_failures < self.eject_failure_threshold
+            or r.state not in (READY, DRAINING, UNHEALTHY)
+        ):
+            return
+        # ejection is a MINORITY verdict: it needs at least one READY
+        # sibling whose scrape stream is clean.  When every dispatchable
+        # replica is failing at once the evidence points at the
+        # monitoring plane, not the fleet — that is degraded mode's case
+        # (tick()), and ejecting the whole fleet on it would park the
+        # FIFO the same way expiring it would.  The witness must be
+        # DISPATCHABLE: a clean drain victim proves the scrape plane
+        # works, but ejecting the last READY replicas on its testimony
+        # still parks the FIFO — the queue would wait on dispatch
+        # candidates that no longer exist.
+        # ...and carrying actual evidence: a never-reported newcomer
+        # (mark_ready mid-outage, telemetry still in flight) has a
+        # clean failure count by vacuity, not by a working scrape
+        # stream — the same snapshot-None exclusion degraded detection
+        # applies in tick().
+        if not any(
+            s.consec_failures == 0 and s.state == READY
+            and s.snapshot is not None
+            for s in self._replicas.values() if s.rid != r.rid
+        ):
+            return
+        now = self.clock()
+        r.eject_count += 1
+        backoff = capped_exponential(
+            self.eject_backoff_s, r.eject_count - 1, self.eject_backoff_max_s
+        )
+        r.eject_until = now + backoff
+        r.state = EJECTED
+        self.ejections += 1
+        metrics.SERVING_REPLICA_EJECTIONS.inc()
+        self._log(
+            f"replica_ejected replica={r.rid} failures={r.consec_failures} "
+            f"backoff={backoff:g}"
+        )
+        self._record("replica_ejected", {
+            "replica": r.rid, "trigger": trigger,
+            "value": r.consec_failures,
+            "threshold": self.eject_failure_threshold,
+            "backoff_s": backoff,
+        })
+        self._requeue_orphans(r)
+        r.consec_failures = 0
+        self._gauge_states()
+        self._queue_gauge()
+
+    def _stale_age(self, r: _Replica, now: float) -> Optional[float]:
+        """Seconds past the health interval, or None while fresh.  A
+        never-heartbeated READY (mark_ready without a report) anchors on
+        its add/ready time — silence still expires."""
+        last = r.snapshot.ts if r.snapshot is not None else r.last_seen
+        if last is None:
+            return float("inf")
+        age = now - last
+        return age if age > self.health_interval else None
 
     def tick(self, now: Optional[float] = None) -> List[str]:
         """Health sweep: replicas whose heartbeat is older than
         `health_interval` stop receiving dispatches and their unfinished
         requests re-dispatch to siblings exactly once.  Returns the ids
-        newly declared unhealthy."""
+        newly declared unhealthy.
+
+        EXCEPT when every dispatchable replica is stale at once: that is
+        the monitoring plane down (a dead scrape loop), not N replicas
+        dying in the same interval — expiring the whole fleet would park
+        the FIFO on blindness.  The router enters DEGRADED mode instead:
+        dispatch continues round-robin over READY replicas, nobody is
+        expired, and the first fresh sample (observe()) restores
+        occupancy dispatch.  The hedge pass runs on every sweep."""
         now = self.clock() if now is None else now
-        expired = []
+        live = [
+            self._replicas[rid] for rid in sorted(self._replicas)
+            if self._replicas[rid].state in (READY, DRAINING)
+        ]
+        stale = {r.rid: self._stale_age(r, now) for r in live}
+        expired: List[str] = []
+        # degraded detection considers only DISPATCHABLE replicas —
+        # the set _candidates() draws from.  A fresh drain victim must
+        # not veto degraded entry while every READY replica is blind:
+        # taking the expiry branch there would mark the whole READY set
+        # UNHEALTHY, requeue their orphans with no candidate, and park
+        # the FIFO behind a replica dispatch will never pick.
+        # ...and only replicas that have EVER reported: a replica
+        # mark_ready'd during the outage (pod Ready fires; telemetry
+        # never can) reads as "fresh" off its add-time anchor, and
+        # letting it veto entry would expire the whole established
+        # fleet on its testimony — snapshot-None replicas carry no
+        # staleness evidence either way.
+        ready_stale = [
+            stale[r.rid] for r in live
+            if r.state == READY and r.snapshot is not None
+        ]
+        if ready_stale and all(s is not None for s in ready_stale):
+            # total blindness on the dispatchable set: degrade.  The
+            # flag flips BEFORE any requeue below so orphans place by
+            # round-robin, not by the fleet-wide-stale occupancy
+            # fiction.  READY replicas are spared expiry (that is the
+            # point), but a stale DRAIN victim still expires — it is
+            # not a dispatch candidate, so expiring it cannot park the
+            # FIFO, and its orphans requeue onto the round-robin READY
+            # set instead of stranding behind the autoscaler's
+            # inflight==0 drain wait for the whole outage.
+            entering = not self.degraded
+            self.degraded = True
+            for r in live:
+                if r.state != DRAINING or stale[r.rid] is None:
+                    continue
+                age = stale[r.rid]
+                r.state = UNHEALTHY
+                expired.append(r.rid)
+                self._log(
+                    f"replica_unhealthy replica={r.rid} "
+                    f"stale={age if age != float('inf') else -1:g}"
+                )
+                self._requeue_orphans(r)
+            if expired:
+                self._gauge_states()
+            if entering:
+                self.degraded_entries += 1
+                worst = max(
+                    s for s in ready_stale if s != float("inf")
+                ) if any(
+                    s != float("inf") for s in ready_stale
+                ) else -1.0
+                metrics.SERVING_ROUTER_DEGRADED.inc()
+                self._log(
+                    f"router_degraded replicas={len(ready_stale)} "
+                    f"stale={worst:g}"
+                )
+                self._record("router_degraded", {
+                    "trigger": "serving_scrape_age_seconds",
+                    "value": round(worst, 4) if worst >= 0 else None,
+                    "threshold": self.health_interval,
+                    "replicas": len(ready_stale),
+                })
+                self._publish_router_state()
+        else:
+            for r in live:
+                age = stale[r.rid]
+                if age is None:
+                    continue
+                r.state = UNHEALTHY
+                expired.append(r.rid)
+                self._log(
+                    f"replica_unhealthy replica={r.rid} "
+                    f"stale={age if age != float('inf') else -1:g}"
+                )
+                self._requeue_orphans(r)
+            if expired:
+                self._gauge_states()
+        self._hedge_pass(now)
+        return expired
+
+    # -------------------------------------------------------------- hedging
+    def note_first_token(self, rid: str, request_id: str) -> None:
+        """A replica produced `request_id`'s first token: record the
+        TTFT sample (dispatch -> now on the replica that produced it)
+        and advance the hedge pass's progress anchor."""
+        self.note_progress(rid, request_id)
+        if request_id in self._first_token:
+            return
+        r = self._replicas.get(rid)
+        t0 = r.dispatched_at.get(request_id) if r is not None else None
+        self._note_first_token_id(request_id)
+        if t0 is not None:
+            self._ttfts.append(self.clock() - t0)
+
+    def note_progress(self, rid: str, request_id: str) -> None:
+        """A replica emitted tokens for `request_id`: refresh the hedge
+        pass's stall anchor.  A request is hedge-eligible only once its
+        stream has been silent past the threshold — measured from its
+        LAST progress, so a freeze mid-decode is caught exactly like a
+        prefill that never starts."""
+        r = self._replicas.get(rid)
+        if r is not None and request_id in r.inflight:
+            r.last_progress[request_id] = self.clock()
+
+    def hedge_threshold(self) -> Optional[float]:
+        """Ceil-rank p99 of recent TTFTs, floor-clamped; None while too
+        few samples exist to rank (no hedging on a cold router)."""
+        if len(self._ttfts) < self.hedge_min_samples:
+            return None
+        from tf_operator_tpu.engine.servefleet import ceil_rank_percentile
+
+        return max(
+            self.hedge_floor_s,
+            ceil_rank_percentile(list(self._ttfts), 0.99),
+        )
+
+    def _hedge_pass(self, now: float) -> None:
+        """Speculative re-dispatch of stragglers: any in-flight request
+        whose first token has not arrived within the hedge threshold is
+        dispatched ONCE more to a sibling.  Both copies share the
+        completion-dedup ledger (delivery stays at-most-once); the
+        loser's completion frees its own replica's slot and is dropped."""
+        if not self.enable_hedging or self.degraded:
+            return
+        thr = self.hedge_threshold()
+        if thr is None:
+            return
         for rid in sorted(self._replicas):
             r = self._replicas[rid]
+            # READY/DRAINING only: an UNHEALTHY replica's inflight map
+            # is always empty (expiry requeued its orphans in the same
+            # step that set the state), and EJECTED likewise
             if r.state not in (READY, DRAINING):
                 continue
-            # never-heartbeated READY (mark_ready without a report) uses
-            # its add/ready time as the anchor — silence still expires
-            last = r.snapshot.ts if r.snapshot is not None else r.last_seen
-            if last is None or now - last <= self.health_interval:
-                continue
-            r.state = UNHEALTHY
-            expired.append(rid)
-            self._log(
-                f"replica_unhealthy replica={rid} "
-                f"stale={now - last if last is not None else -1:g}"
-            )
-            orphans = [
-                req for req in r.inflight.values()
-                if req.rid not in self._completed
-            ]
-            r.inflight.clear()
-            r.debit_blocks = 0
-            r.debit_count = 0
-            for req in orphans:
-                self._note_redispatch(req.rid)
-                metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "redispatch"})
-                self._log(f"redispatch req={req.rid} from={rid}")
-                self._place(req)
-        if expired:
-            self._gauge_states()
-        return expired
+            for req_id in sorted(r.inflight):
+                if req_id in self._completed:
+                    continue
+                hedge_rid = self._hedged.get(req_id)
+                if hedge_rid == rid:
+                    # this row IS the live hedge copy; its original's
+                    # row drives any further action
+                    continue
+                anchor = r.last_progress.get(
+                    req_id, r.dispatched_at.get(req_id)
+                )
+                if anchor is None or now - anchor <= thr:
+                    continue
+                if hedge_rid is not None:
+                    h = self._replicas.get(hedge_rid)
+                    h_anchor = (
+                        h.last_progress.get(
+                            req_id, h.dispatched_at.get(req_id)
+                        )
+                        if h is not None and req_id in h.inflight
+                        else None
+                    )
+                    if h_anchor is not None and now - h_anchor <= thr:
+                        continue  # the hedge copy is progressing
+                req = r.inflight[req_id]
+                # exclude EVERY current holder, not just this row's
+                # replica: hedging onto the request's other (stalled)
+                # holder would ping-pong copies between two frozen
+                # replicas forever
+                sibling = self._pick(
+                    req, exclude=frozenset(self._holders(req_id))
+                )
+                if sibling is None:
+                    # nowhere to rescue to: leave any open race open —
+                    # either stalled copy may yet deliver and settle it
+                    # truthfully
+                    continue
+                if hedge_rid is not None:
+                    # BOTH copies stalled (the hedge arm froze too) and
+                    # a THIRD sibling exists: that race failed to
+                    # rescue — settle it lost, restore the budget, and
+                    # re-hedge, or the request strands forever behind
+                    # two healthy-heartbeating frozen holders
+                    self._drop_hedge_entry(req_id, dead_rid=hedge_rid)
+                self._hedged[req_id] = sibling
+                self._hedged_order.append(req_id)
+                while len(self._hedged_order) > self.ledger_cap:
+                    self._hedged.pop(self._hedged_order.popleft(), None)
+                self.hedges_issued += 1
+                metrics.SERVING_HEDGE_REQUESTS.inc({"outcome": "issued"})
+                self._log(
+                    f"hedge_issued req={req_id} from={rid} to={sibling} "
+                    f"waited={now - anchor:g} thr={thr:g}"
+                )
+                self._record("hedge_issued", {
+                    "request": req_id, "from": rid, "to": sibling,
+                    "trigger": "serving_ttft_seconds_p99",
+                    "value": round(now - anchor, 4),
+                    "threshold": round(thr, 4),
+                })
+                self._dispatch(req, sibling, reason="hedge")
 
     # ------------------------------------------------------------- dispatch
     def submit(self, request: ServeRequest) -> Optional[str]:
@@ -379,10 +948,18 @@ class FleetRouter:
         )
         return True
 
-    def _place(self, request: ServeRequest) -> Optional[str]:
+    def _place(
+        self, request: ServeRequest,
+        avoid: frozenset = frozenset(),
+    ) -> Optional[str]:
+        """Dispatch or queue.  `avoid` hard-excludes replicas: a
+        request whose dispatch just failed on the fleet's only replica
+        QUEUES (pump() retries on the next state change) — falling back
+        onto the refusing replica would turn a dead lone replica into
+        an unbounded dispatch→fail→re-place hot loop."""
         if self._reject_oversized(request):
             return None
-        rid = self._pick(request)
+        rid = self._pick(request, exclude=avoid)
         if rid is None:
             self._queue.append(request)
             metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "queued"})
@@ -392,15 +969,21 @@ class FleetRouter:
         self._dispatch(request, rid)
         return rid
 
-    def _dispatch(self, request: ServeRequest, rid: str) -> None:
+    def _dispatch(self, request: ServeRequest, rid: str,
+                  reason: Optional[str] = None) -> None:
         r = self._replicas[rid]
+        now = self.clock()
         r.inflight[request.rid] = request
+        r.dispatched_at[request.rid] = now
         r.debit_blocks += request.blocks(self.block_size)
         r.debit_count += 1
-        metrics.SERVING_ROUTER_DISPATCH.inc({"reason": self.policy})
+        reason = reason or (
+            "degraded" if self.degraded else self.policy
+        )
+        metrics.SERVING_ROUTER_DISPATCH.inc({"reason": reason})
         self._log(f"dispatch req={request.rid} replica={rid}")
         if self.on_dispatch is not None:
-            self.on_dispatch(request, rid, self.policy)
+            self.on_dispatch(request, rid, reason)
 
     def _candidates(self) -> List[_Replica]:
         return [
@@ -409,28 +992,46 @@ class FleetRouter:
             if self._replicas[rid].state == READY
         ]
 
-    def _pick(self, request: ServeRequest) -> Optional[str]:
+    def _rr_pick(self, cands: List[_Replica],
+                 exclude: frozenset) -> Optional[str]:
+        order = sorted(c.rid for c in cands if c.rid not in exclude)
+        if not order:
+            return None
+        if self._rr_last is not None:
+            idx = 0
+            for i, rid in enumerate(order):
+                if rid > self._rr_last:
+                    idx = i
+                    break
+            order = order[idx:] + order[:idx]
+        chosen = order[0]
+        self._rr_last = chosen
+        return chosen
+
+    def _pick(self, request: ServeRequest,
+              exclude: frozenset = frozenset()) -> Optional[str]:
         cands = self._candidates()
         if not cands:
             return None
         if self.policy == "round_robin":
             # blind baseline: cycle ready replicas, no occupancy or
             # in-flight bound — exactly what bench-fleet measures against
-            order = sorted(c.rid for c in cands)
-            if self._rr_last is not None:
-                idx = 0
-                for i, rid in enumerate(order):
-                    if rid > self._rr_last:
-                        idx = i
-                        break
-                order = order[idx:] + order[:idx]
-            chosen = order[0]
-            self._rr_last = chosen
-            return chosen
+            return self._rr_pick(cands, exclude)
+        if self.degraded:
+            # blindness fallback: telemetry is stale fleet-wide, so the
+            # occupancy score is fiction — round-robin over READY, but
+            # keep the in-flight bound (the router's OWN books, still
+            # true) so one replica cannot absorb the whole queue
+            return self._rr_pick(
+                [c for c in cands if len(c.inflight) < self.max_inflight],
+                exclude,
+            )
         cost = request.blocks(self.block_size)
         best = None
         best_key = None
         for c in cands:
+            if c.rid in exclude:
+                continue
             if len(c.inflight) >= self.max_inflight:
                 continue
             if c.snapshot is None or c.effective_free() < cost:
@@ -466,11 +1067,17 @@ class FleetRouter:
     def finish(self, rid: str, request_id: str) -> bool:
         """A replica reports a completed request.  Returns True when this
         is the FIRST completion of the id (deliver it); a duplicate from
-        a recovered replica whose requests were re-dispatched returns
-        False (drop — at-most-once delivery)."""
+        a recovered replica whose requests were re-dispatched — or the
+        losing arm of a hedge — returns False (drop — at-most-once
+        delivery).  The completion decrements in-flight on the replica
+        that REPORTED it, never on the other holder: a hedge loser
+        completing after the winner frees its own slot while the
+        winner's books stay untouched."""
         r = self._replicas.get(rid)
         if r is not None:
             r.inflight.pop(request_id, None)
+            r.dispatched_at.pop(request_id, None)
+            r.last_progress.pop(request_id, None)
         if request_id in self._completed:
             self._log(f"duplicate_completion req={request_id} replica={rid}")
             # the duplicate still freed a dispatch slot on `rid`: pump
@@ -478,6 +1085,7 @@ class FleetRouter:
             self.pump()
             return False
         self._note_completed(request_id)
+        self._drop_hedge_entry(request_id, delivered_by=rid)
         self.pump()
         return True
 

@@ -327,13 +327,34 @@ class Cli:
         if doc.get("occupancy") is not None:
             print(f"  kv-occupancy: {doc['occupancy']:g}  "
                   f"queue-wait-p99: {doc.get('queue_wait_p99_s', 0):g}s")
+        if doc.get("degraded"):
+            # the router's fleet-wide telemetry-blindness fallback —
+            # present only when a router publishes state in-process
+            print("  degraded: yes (telemetry stale fleet-wide; "
+                  "round-robin fallback active)")
+        scrape = doc.get("scrape") or {}
+        ejected = set(doc.get("ejected") or ())
         for rid, t in sorted((doc.get("per_replica") or {}).items()):
             used = t["total_blocks"] - t["free_blocks"]
             occ = used / t["total_blocks"] if t["total_blocks"] else 0.0
             drain = " (draining)" if doc.get("draining") == rid else ""
+            # scrape-age / ejected columns only exist when a scrape
+            # loop / router publishes them: with both off, this line is
+            # byte-identical to the pre-scrape describe
+            sc = scrape.get(rid)
+            age = f" scrape-age={sc['age_s']:g}s" if sc else ""
+            ej = " (ejected)" if rid in ejected else ""
             print(f"  {rid}: blocks={used}/{t['total_blocks']} "
                   f"({occ:.0%}) queue={t['queue_depth']} "
-                  f"inflight={t['inflight']}{drain}")
+                  f"inflight={t['inflight']}{drain}{age}{ej}")
+        # replicas the scrape loop knows but the autoscaler has no
+        # telemetry for yet (never scraped successfully) still show age
+        for rid in sorted(set(scrape) - set(doc.get("per_replica") or {})):
+            sc = scrape[rid]
+            ej = " (ejected)" if rid in ejected else ""
+            print(f"  {rid}: no telemetry "
+                  f"scrape-age={sc['age_s']:g}s "
+                  f"failures={sc['failures']}{ej}")
         last = doc.get("last_scale")
         if last:
             print(f"  last-scale: dir={last['dir']} {last['detail']} "
