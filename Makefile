@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos bench-reqtrace native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -154,6 +154,19 @@ bench-fleet:
 bench-fleet-chaos:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_fleet_chaos; \
 	print(json.dumps(bench_fleet_chaos(), indent=1))"
+
+# Request flight-recorder overhead (ISSUE 16): the fleet sim's seeded
+# outage trace replayed with the per-request recorder + SLO burn engine
+# off vs on, alternated best-of pairs; the seeded event log is asserted
+# byte-identical between the arms inside the bench.  Contract
+# (documented in bench_reqtrace's docstring): relative overhead <= 5%
+# OR absolute overhead <= 150 us per request — the sim's whole
+# per-request cost is ~300 us of arithmetic, so the absolute bound is
+# the honest one on this baseline.  Rows land in BENCH_r15.json;
+# bounds asserted in tests/test_bench_infra.py.
+bench-reqtrace:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_reqtrace; \
+	print(json.dumps(bench_reqtrace(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

@@ -73,6 +73,15 @@ _REQUIRED_FAMILIES = {
     "tpu_operator_serving_replica_ejections_total": "Counter",
     "tpu_operator_serving_router_degraded_total": "Counter",
     "tpu_operator_serving_hedge_requests_total": "Counter",
+    # request flight recorder + windowed SLO engine (ISSUE 16): the
+    # per-axis multi-window burn rates and the recorder's own volume /
+    # eviction counters — docs/monitoring.md's burn-rate alerting PromQL
+    # reads these by name
+    "tpu_operator_serving_slo_burn_rate": "Gauge",
+    "tpu_operator_serving_slo_window_p99_seconds": "Gauge",
+    "tpu_operator_serving_slo_burns_total": "Counter",
+    "tpu_operator_serving_request_timeline_events_total": "Counter",
+    "tpu_operator_serving_request_timeline_evictions_total": "Counter",
 }
 
 

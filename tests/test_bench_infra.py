@@ -537,6 +537,48 @@ def test_bench_fleet_chaos_hardened_router_bounds():
     assert hard["degraded_entries"] >= 1
 
 
+def test_bench_reqtrace_pair_reports_overhead_and_identity():
+    """bench_reqtrace (ISSUE 16) on a reduced trace: the off/on pair
+    must complete, track every request, and report both overhead axes.
+    The byte-identity contract (recorder on must not steer the seeded
+    log) is asserted INSIDE the bench — this run would raise if the
+    recorder changed a single event.  No wall-clock bound on the live
+    run (shared-box noise); the committed artifact's contract is
+    checked separately."""
+    r = bench.bench_reqtrace(n_users=60, horizon_s=120.0, repeats=1)
+    assert r["tracked_requests"] == r["requests"] > 0
+    assert len(r["requests_per_sec_off"]) == 1
+    assert len(r["requests_per_sec_on"]) == 1
+    assert isinstance(r["overhead_pct"], float)
+    assert isinstance(r["per_request_overhead_us"], float)
+    assert isinstance(r["overhead_ok"], bool)
+
+
+def test_bench_reqtrace_committed_artifact_holds_contract():
+    """BENCH_r15.json is the committed evidence for the ISSUE 16
+    overhead contract (documented in bench_reqtrace's docstring:
+    relative <= 5% OR <= 150 us per request).  Pin its structure and
+    verdict so a regenerated artifact that fails the bound cannot land
+    silently."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_r15.json"
+    )
+    with open(path) as fh:
+        r = json.load(fh)
+    assert r["overhead_ok"] is True
+    assert r["tracked_requests"] == r["requests"] > 0
+    # the documented contract, re-derived from the recorded numbers so
+    # the boolean cannot drift from the data it summarizes
+    rel_ok = (
+        r["best_requests_per_sec_on"]
+        >= 0.95 * r["best_requests_per_sec_off"]
+    )
+    abs_ok = r["per_request_overhead_us"] <= 150.0
+    assert rel_ok or abs_ok
+
+
 def test_merge_bucket_percentiles_reads_merged_histograms():
     """The multiproc /metrics scrape math: per-worker cumulative bucket
     counts merge by le and percentiles read off the merged histogram

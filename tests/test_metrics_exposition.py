@@ -9,8 +9,22 @@ import math
 import re
 import threading
 
+import pytest
+
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.engine.metrics import Counter, Gauge, Histogram
+
+
+@pytest.fixture(autouse=True)
+def _scratch_registry():
+    # test_* families here don't carry the tpu_operator_ prefix; drop them
+    # from the process-global registry so the name lint stays clean for
+    # whatever test file runs after this one.
+    with metrics._LOCK:
+        n = len(metrics._REGISTRY)
+    yield
+    with metrics._LOCK:
+        del metrics._REGISTRY[n:]
 
 # text-format sample line: name{labels} value  (labels optional)
 _SAMPLE_RE = re.compile(

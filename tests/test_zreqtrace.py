@@ -1,0 +1,603 @@
+"""Request flight recorder (engine/reqtrace.py) — the ISSUE 16
+acceptance surface.
+
+Bounded memory (per-request rings cap, LRU evicts only FINISHED
+requests), cross-thread per-request sequence monotonicity, the O(1)
+append contract, hedge arms as sibling attempts on ONE timeline,
+recorder-off byte-identity on the seeded fleet-chaos closure, the
+BENCH_r14 causality audit (every req=-carrying router decision in the
+seeded log lands exactly once on the owning request's timeline, in log
+order), the windowed SLO burn-rate engine (multi-window fire, censored
++inf drops, cooldown, decay), the /debug/requests + filtered
+/debug/traces endpoints, the `tpu-jobs requests` verb, describe's SLO
+two-liner, and the SIGUSR1 `.requests.json` dump.
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api import servingjob
+from tf_operator_tpu.api.servingjob import AutoscaleSpec, SLOSpec
+from tf_operator_tpu.cmd.health import HealthServer
+from tf_operator_tpu.cmd.manager import build_request_recorder
+from tf_operator_tpu.cmd.options import ServerOptions
+from tf_operator_tpu.controllers.registry import EnabledSchemes
+from tf_operator_tpu.engine import metrics, reqtrace, servefleet
+from tf_operator_tpu.engine.reqtrace import RequestRecorder
+from tf_operator_tpu.engine.timeline import FlightRecorder
+from tf_operator_tpu.k8s.chaos import FaultInjector, SimClock
+from tf_operator_tpu.k8s.fake import FakeCluster
+from tf_operator_tpu.models.fleetsim import FleetHarness, make_trace
+from tf_operator_tpu.sdk.cli import Cli, make_parser
+from tf_operator_tpu.sdk.cli import run as cli_run
+
+from tests.test_zfleet import auto_spec, autoscaled_operator
+
+JOB = "default/llm"
+
+
+def _disabled():
+    return RequestRecorder(events_per_request=0)
+
+
+# ------------------------------------------------------------ bounded memory
+def test_request_ring_caps_hold_under_10k_events_and_lru_evicts_only_finished():
+    clock = SimClock()
+    rec = RequestRecorder(events_per_request=16, max_requests=8, clock=clock)
+    metrics.SERVING_REQUEST_TIMELINE_EVICTIONS.reset()
+    rids = [f"u{i}" for i in range(20)]
+    # one early DECISION per request, then a 10k-event routine flood:
+    # the decision ring is separate, so the flood can never evict the
+    # one hedge record that explains the request
+    for rid in rids:
+        rec.record(JOB, rid, "router", "hedge_issued",
+                   {"from": "r0", "to": "r1"}, ts=clock())
+    for n in range(10_000):
+        clock.advance(0.001)
+        rec.record(JOB, rids[n % len(rids)], "replica", "prefill_chunk",
+                   {"n": n}, ts=clock())
+    for rid in rids:
+        doc = rec.request_timeline(JOB, rid)
+        assert doc is not None
+        routine = [e for e in doc["events"] if e["event"] == "prefill_chunk"]
+        assert len(routine) == 16
+        # the merged view leads with the surviving decision (seq 1)
+        assert doc["events"][0]["event"] == "hedge_issued"
+        seqs = [e["seq"] for e in doc["events"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # none of the 20 requests is finished, so NOTHING was evicted even
+    # though the directory is over its cap of 8 — in-flight requests
+    # are never dropped
+    assert len(rec.request_ids(JOB)) == 20
+    assert metrics.SERVING_REQUEST_TIMELINE_EVICTIONS.get() == 0
+
+    # finish half; the next admissions evict only finished requests,
+    # oldest last-touch first
+    for rid in rids[:10]:
+        clock.advance(1.0)
+        rec.record(JOB, rid, "router", "finished", {"tokens": 4},
+                   ts=clock())
+    for i in range(5):
+        clock.advance(1.0)
+        rec.record(JOB, f"new{i}", "router", "submitted", {}, ts=clock())
+    tracked = set(rec.request_ids(JOB))
+    rec.jobs()  # read entry point settles the staged counters
+    assert metrics.SERVING_REQUEST_TIMELINE_EVICTIONS.get() == 5
+    for rid in rids[:5]:
+        assert rid not in tracked
+    for rid in rids[10:]:
+        assert rid in tracked
+
+
+def test_cross_thread_appends_keep_per_request_seq_monotonic():
+    rec = RequestRecorder(events_per_request=4096, max_requests=8)
+    n_threads, per_thread = 8, 200
+
+    def writer(tid):
+        for i in range(per_thread):
+            rec.record(JOB, "threaded", "replica", "prefill_chunk",
+                       {"tid": tid, "i": i})
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    doc = rec.request_timeline(JOB, "threaded")
+    events = doc["events"]
+    assert len(events) == n_threads * per_thread
+    seqs = [e["seq"] for e in events]
+    assert seqs == list(range(1, n_threads * per_thread + 1))
+    # every thread's own records stayed in its program order
+    for tid in range(n_threads):
+        mine = [e["detail"]["i"] for e in events
+                if e["detail"]["tid"] == tid]
+        assert mine == list(range(per_thread))
+
+
+def test_record_hot_path_never_takes_the_directory_lock():
+    """Same O(1)-append contract as the job recorder: after first
+    contact the per-record path synchronizes only on the REQUEST's ring
+    lock."""
+
+    class CountingLock:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.acquisitions = 0
+
+        def __enter__(self):
+            self.acquisitions += 1
+            return self._lock.__enter__()
+
+        def __exit__(self, *exc):
+            return self._lock.__exit__(*exc)
+
+    rec = RequestRecorder(events_per_request=32, max_requests=8)
+    counter = CountingLock()
+    rec._dir_lock = counter
+    rec.record(JOB, "hot", "replica", "prefill_chunk", {"n": 0})
+    after_admit = counter.acquisitions
+    assert after_admit >= 1  # first contact admits under the lock
+    for n in range(500):
+        rec.record(JOB, "hot", "replica", "prefill_chunk", {"n": n})
+    assert counter.acquisitions == after_admit
+
+
+def test_event_counters_stage_and_flush_on_read():
+    """The per-record path never touches the global-locked exporter
+    families; counts stage under the small stats lock and settle on any
+    read entry point."""
+    metrics.SERVING_REQUEST_TIMELINE_EVENTS.reset()
+    rec = RequestRecorder(events_per_request=8, max_requests=8)
+    rec.record(JOB, "u1", "router", "submitted", {})
+    rec.record(JOB, "u1", "router", "dispatched", {"replica": "r0"})
+    rec.record(JOB, "u1", "replica", "admitted", {"replica": "r0"})
+    assert metrics.SERVING_REQUEST_TIMELINE_EVENTS.get(
+        {"source": "router"}) == 0  # still staged
+    assert rec.jobs() == [JOB]  # reads flush
+    assert metrics.SERVING_REQUEST_TIMELINE_EVENTS.get(
+        {"source": "router"}) == 2
+    assert metrics.SERVING_REQUEST_TIMELINE_EVENTS.get(
+        {"source": "replica"}) == 1
+
+
+def test_disabled_recorder_records_nothing():
+    rec = _disabled()
+    assert not rec.enabled
+    rec.record(JOB, "u1", "router", "submitted", {})
+    assert rec.jobs() == []
+    assert rec.request_timeline(JOB, "u1") is None
+    rec.slo_tick(0.0)  # no-op, must not throw
+
+
+def test_build_request_recorder_default_on_and_off_resets_global():
+    try:
+        opts = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]))
+        rec = build_request_recorder(opts)
+        assert rec is not None and rec.enabled
+        assert rec.events_per_request == 128 and rec.max_requests == 2048
+        assert reqtrace.get_recorder() is rec
+        # recorder-off must also reset the process default, so a later
+        # CLI/debug read cannot serve the previous manager's timelines
+        off = ServerOptions(enabled_schemes=EnabledSchemes(["TFJob"]),
+                            reqtrace_events_per_request=0)
+        assert build_request_recorder(off) is None
+        assert not reqtrace.get_recorder().enabled
+    finally:
+        reqtrace.set_recorder(_disabled())
+
+
+# --------------------------------------------------------------- SLO engine
+def _finish_one(rec, rid, clock, ttft_s=2.0, tokens=8):
+    t0 = clock()
+    rec.record(JOB, rid, "router", "submitted", {}, ts=t0)
+    rec.record(JOB, rid, "router", "dispatched", {"replica": "r0"}, ts=t0)
+    clock.advance(ttft_s)
+    rec.record(JOB, rid, "replica", "first_token", {"replica": "r0"},
+               ts=clock())
+    clock.advance(0.5)
+    rec.record(JOB, rid, "router", "finished",
+               {"replica": "r0", "tokens": tokens}, ts=clock())
+
+
+def test_slo_burn_fires_on_both_windows_with_cooldown_and_decay():
+    metrics.SERVING_SLO_BURNS.reset()
+    clock = SimClock()
+    jr = FlightRecorder(events_per_job=64, max_jobs=8, clock=clock)
+    rec = RequestRecorder(events_per_request=64, max_requests=64,
+                          clock=clock, job_recorder=jr)
+    rec.set_slo(JOB, SLOSpec(ttft_p99_s=1.0, e2e_p99_s=60.0,
+                             objective=0.9, fast_window_s=60.0,
+                             slow_window_s=300.0, burn_threshold=1.0))
+    for i in range(8):
+        _finish_one(rec, f"u{i}", clock)  # every ttft 2.0 > 1.0 target
+
+    # sample-driven evals are spaced fast_window/2 apart; the scrape
+    # cadence (slo_tick) always evaluates
+    rec.slo_tick(clock())
+    burns = lambda: metrics.SERVING_SLO_BURNS.get(  # noqa: E731
+        {"serving_job": JOB, "axis": "ttft"})
+    assert burns() == 1
+    # the DECISION landed on the owning JOB's timeline...
+    jdoc = jr.timeline(JOB)
+    slo_events = [e for e in jdoc["events"] if e["source"] == "slo"]
+    assert [e["event"] for e in slo_events] == ["slo_burn"]
+    d = slo_events[0]["detail"]
+    assert d["axis"] == "ttft" and d["target_s"] == 1.0
+    # every sample violated: burn = (8/8) / (1 - 0.9) = 10x
+    assert d["burn_fast"] == 10.0 and d["burn_slow"] == 10.0
+    assert d["samples_fast"] == 8 and d["window_p99_s"] == 2.0
+    # ...and on each offending request's own timeline
+    for i in range(8):
+        doc = rec.request_timeline(JOB, f"u{i}")
+        assert any(e["event"] == "slo_burn" and e["source"] == "slo"
+                   for e in doc["events"]), f"u{i}"
+    # the e2e axis is within target: no burn
+    assert metrics.SERVING_SLO_BURNS.get(
+        {"serving_job": JOB, "axis": "e2e"}) == 0
+    st = rec.slo_status(JOB)
+    assert st["axes"]["ttft"]["burning"] is True
+    assert st["axes"]["ttft"]["burn_fast"] == 10.0
+    assert st["axes"]["ttft"]["p99_s"] == 2.0
+    assert st["axes"]["e2e"]["burning"] is False
+
+    # cooldown: an immediate re-evaluation cannot re-fire...
+    rec.slo_tick(clock())
+    assert burns() == 1
+    # ...but past half a fast window (samples still in-window) it can
+    clock.advance(31.0)
+    rec.slo_tick(clock())
+    assert burns() == 2
+    # decay: with the windows drained, burn rates return to 0 without
+    # new traffic (the scrape cadence keeps evaluating)
+    clock.advance(400.0)
+    rec.slo_tick(clock())
+    assert burns() == 2
+    assert metrics.SERVING_SLO_BURN_RATE.get(
+        {"serving_job": JOB, "axis": "ttft", "window": "fast"}) == 0.0
+    assert rec.slo_status(JOB)["axes"]["ttft"]["burning"] is False
+
+
+def test_slo_censors_drops_as_infinite_latency():
+    """A dropped request IS the worst latency, not a missing sample:
+    every axis it never completed contributes +inf, the window p99 goes
+    censored (None, no exported series), and the burn still fires."""
+    clock = SimClock()
+    jr = FlightRecorder(events_per_job=64, max_jobs=8, clock=clock)
+    rec = RequestRecorder(events_per_request=64, max_requests=64,
+                          clock=clock, job_recorder=jr)
+    rec.set_slo(JOB, SLOSpec(e2e_p99_s=5.0, objective=0.9,
+                             fast_window_s=60.0, slow_window_s=300.0))
+    for i in range(6):
+        rid = f"d{i}"
+        rec.record(JOB, rid, "router", "submitted", {}, ts=clock())
+        clock.advance(1.0)
+        rec.record(JOB, rid, "router", "drop", {"reason": "horizon"},
+                   ts=clock())
+    rec.slo_tick(clock())
+    st = rec.slo_status(JOB)
+    axis = st["axes"]["e2e"]
+    assert axis["samples"] == 6 and axis["burning"] is True
+    assert axis["p99_s"] is None  # censored: the p99 rank is +inf
+    jdoc = jr.timeline(JOB)
+    burn = next(e for e in jdoc["events"] if e["event"] == "slo_burn")
+    assert burn["detail"]["window_p99_s"] is None
+    # the drop is terminal: the request is evictable and summarized so
+    summary = rec.requests(JOB)[0]
+    assert summary["finished"] is True and summary["dropped"] is True
+
+
+def test_slo_spec_validation_and_round_trip():
+    assert SLOSpec.from_dict(None) is None
+    spec = SLOSpec.from_dict({"ttftP99S": 4.0, "objective": 0.95})
+    assert spec.ttft_p99_s == 4.0 and spec.objective == 0.95
+    assert SLOSpec.from_dict(spec.to_dict()).to_dict() == spec.to_dict()
+    servingjob._validate_slo(None)
+    servingjob._validate_slo(SLOSpec(ttft_p99_s=1.0))
+    for bad in (
+        SLOSpec(ttft_p99_s=-1.0),
+        SLOSpec(e2e_p99_s=10.0, objective=1.5),
+        SLOSpec(e2e_p99_s=10.0, fast_window_s=300.0, slow_window_s=60.0),
+    ):
+        with pytest.raises(servingjob.jobapi.ValidationError):
+            servingjob._validate_slo(bad)
+
+
+# ------------------------------------------------- fleet chaos determinism
+def _chaos_run(seed, rt=None, slo=None):
+    """The ISSUE 15 seeded outage closure (test_zfleet's soak), with the
+    request recorder on the harness seams."""
+    inj = FaultInjector(FakeCluster(), seed=seed, clock=SimClock(),
+                        kubelet=False)
+    inj.schedule_scrape_storm(40.0, 12.0, mode="timeout")
+    inj.schedule_scrape_storm(70.0, 8.0, mode="500", replicas=["r0"])
+    inj.schedule_replica_freeze(95.0, "r1")
+    inj.schedule_replica_kill(110.0, "r0")
+    if rt is not None:
+        rt.clock = inj.clock
+    harness = FleetHarness(
+        "occupancy", n_replicas=3, injector=inj,
+        hedging=True, ejection=True,
+        autoscale=auto_spec(min_replicas=2, max_replicas=6,
+                            scale_out_queue_wait_p99_s=1.5,
+                            scale_in_occupancy_floor=0.2),
+        warm_standbys=4, job_key=JOB, reqtrace=rt, slo=slo,
+    )
+    trace = make_trace(seed, n_users=250)
+    summary = harness.run(trace, horizon_s=500.0)
+    return harness, summary, list(inj.log), trace
+
+
+def test_fleet_chaos_byte_identity_and_hedge_arms_share_one_timeline():
+    """Recorder-off byte-identity on the seeded fleet closure (with the
+    SLO engine armed, the strictest arm), and the hedge acceptance: a
+    hedged request's two arms are sibling attempts under ONE timeline,
+    the losing arm attributed to its own attempt."""
+    rt = RequestRecorder(events_per_request=128, max_requests=4096)
+    slo = SLOSpec(ttft_p99_s=2.0, e2e_p99_s=120.0, objective=0.95)
+    h_on, s_on, il_on, trace = _chaos_run(4242, rt=rt, slo=slo)
+    h_off, s_off, il_off, _ = _chaos_run(4242)
+    # recording (rings + burn engine) never writes the seeded logs
+    assert h_on.log == h_off.log and il_on == il_off and s_on == s_off
+    assert s_on["hedges_issued"] >= 1
+    # every request of the trace is tracked (zero drops, cap not hit)
+    assert len(rt.request_ids(JOB)) == len(trace)
+
+    # pick a hedged request that finished the race either way
+    hedged = None
+    for summary in rt.requests(JOB):
+        doc = rt.request_timeline(JOB, summary["request"])
+        names = [e["event"] for e in doc["events"]]
+        if "hedge_issued" in names and (
+                "hedge_won" in names or "hedge_lost" in names):
+            hedged = doc
+            break
+    assert hedged is not None, "seeded closure produced no hedge race"
+    events = hedged["events"]
+    dispatched = [e for e in events if e["event"] == "dispatched"]
+    # each dispatch opened the next attempt, in order
+    assert [e["attempt"] for e in dispatched] == list(
+        range(hedged["attempts"]))
+    assert hedged["attempts"] >= 2
+    by_replica = {e["detail"]["replica"]: e["attempt"] for e in dispatched}
+    hi = next(e for e in events if e["event"] == "hedge_issued")
+    # the hedge decision is attributed to the arm it raced AGAINST, and
+    # the new arm's dispatch carries reason=hedge on its own attempt
+    assert hi["attempt"] == by_replica[hi["detail"]["from"]]
+    arm = next(e for e in dispatched
+               if e["detail"]["replica"] == hi["detail"]["to"]
+               and e["seq"] > hi["seq"])
+    assert arm["detail"]["reason"] == "hedge"
+    verdict = next(e for e in events
+                   if e["event"] in ("hedge_won", "hedge_lost"))
+    assert verdict["attempt"] == by_replica[verdict["detail"]["via"]]
+    # exactly one terminal record per timeline
+    assert sum(1 for e in events
+               if e["event"] in ("finished", "rejected", "drop")) == 1
+    # milestones are causally ordered for every finished request
+    for summary in rt.requests(JOB):
+        ms = summary["milestones"]
+        rels = [ms[k] for k in ("dispatched_rel_s", "admitted_rel_s",
+                                "first_token_rel_s", "finished_rel_s")
+                if k in ms]
+        assert rels == sorted(rels), summary["request"]
+        assert all(r >= 0 for r in rels)
+
+    # acceptance surface: the same story over HTTP, and the Chrome
+    # export contributes request lanes filterable by ?category=
+    srv = HealthServer(reqrecorder=rt)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/debug/requests") as r:
+            assert json.loads(r.read())["jobs"] == [JOB]
+        rid = hedged["request"]
+        with urllib.request.urlopen(
+            f"{base}/debug/requests/default/llm/{rid}"
+        ) as r:
+            assert json.loads(r.read()) == hedged
+        with urllib.request.urlopen(
+            f"{base}/debug/requests/default/llm"
+        ) as r:
+            doc = json.loads(r.read())
+        assert rid in [s["request"] for s in doc["requests"]]
+        assert doc["slo"] is not None and "ttft" in doc["slo"]["axes"]
+        with urllib.request.urlopen(
+            f"{base}/debug/traces?category=request&limit=4"
+        ) as r:
+            tdoc = json.loads(r.read())
+        cats = {e["cat"] for e in tdoc["traceEvents"] if e["ph"] != "M"}
+        assert cats == {"request"}
+        lanes = {e["args"]["name"] for e in tdoc["traceEvents"]
+                 if e["ph"] == "M"}
+        assert f"req {JOB} {rid}" in lanes
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"{base}/debug/requests/default/llm/nope"
+            )
+        assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_bench_r14_trace_causality_audit():
+    """Every req=-carrying router DECISION line in the BENCH_r14
+    hardened-arm log (hedge issue/win/loss, re-dispatch + skip, dispatch
+    failure, duplicate completion, rejection) appears exactly once on
+    the owning request's timeline, in log order."""
+    rt = RequestRecorder(events_per_request=256, max_requests=8192)
+    inj = FaultInjector(FakeCluster(), seed=1337, clock=SimClock(),
+                        kubelet=False)
+    inj.schedule_scrape_storm(40.0, 12.0, mode="timeout")
+    inj.schedule_scrape_storm(80.0, 8.0, mode="500", replicas=["r0"])
+    inj.schedule_replica_freeze(120.0, "r1")
+    inj.schedule_replica_kill(180.0, "r2")
+    rt.clock = inj.clock
+    harness = FleetHarness(
+        "occupancy", n_replicas=3, injector=inj,
+        hedging=True, ejection=True,
+        autoscale=AutoscaleSpec(
+            min_replicas=2, max_replicas=6,
+            scale_out_queue_wait_p99_s=1.5,
+            scale_out_blocked_admissions=4,
+            scale_in_occupancy_floor=0.2,
+        ),
+        warm_standbys=6, job_key=JOB, reqtrace=rt,
+    )
+    summary = harness.run(make_trace(1337, n_users=400), horizon_s=600.0)
+    assert summary["dropped"] == 0  # the BENCH_r14 hardened bound
+
+    audited = {"hedge_issued", "hedge_won", "hedge_lost", "redispatch",
+               "redispatch_skipped", "dispatch_failed",
+               "duplicate_completion", "reject"}
+    log_event = {"reject": "rejected"}  # log verb -> timeline event
+    want = {}
+    for line in harness.log:
+        parts = line.split()
+        if parts[1] not in audited:
+            continue
+        rid = next(p[len("req="):] for p in parts if p.startswith("req="))
+        want.setdefault(rid, []).append(log_event.get(parts[1], parts[1]))
+    assert want, "seeded trace fired no audited decisions"
+    timeline_events = {log_event.get(e, e) for e in audited}
+    assert any("hedge_issued" in seq for seq in want.values())
+    for rid, expect in want.items():
+        doc = rt.request_timeline(JOB, rid)
+        assert doc is not None, rid
+        got = [e["event"] for e in doc["events"]
+               if e["source"] == "router" and e["event"] in timeline_events]
+        assert got == expect, rid
+
+    # the ISSUE 16 acceptance shape: a hedged request from THIS trace
+    # shows submit -> dispatch -> hedge_issued -> won/lost -> finished
+    # on ONE /debug/requests timeline
+    hedged_rid = next(
+        rid for rid, seq in want.items()
+        if "hedge_issued" in seq
+        and ("hedge_won" in seq or "hedge_lost" in seq)
+    )
+    srv = HealthServer(reqrecorder=rt)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/requests/default/llm/"
+            f"{hedged_rid}"
+        ) as r:
+            doc = json.loads(r.read())
+    finally:
+        srv.stop()
+    names = [e["event"] for e in doc["events"]]
+    verdict = "hedge_won" if "hedge_won" in names else "hedge_lost"
+    chain = [names.index(n) for n in
+             ("submitted", "dispatched", "hedge_issued", verdict,
+              "finished")]
+    assert chain == sorted(chain)
+    assert doc["attempts"] >= 2 and doc["finished"] and not doc["dropped"]
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_requests_verb_renders_table_and_json(capsys):
+    clock = SimClock()
+    rt = RequestRecorder(events_per_request=64, max_requests=8, clock=clock)
+    _finish_one(rt, "u1", clock, ttft_s=1.5, tokens=16)
+    cli = Cli(FakeCluster(), reqrecorder=rt)
+    assert cli.requests("default", "llm") == 0
+    out = capsys.readouterr().out
+    assert "Request u1" in out and "[finished, 1 attempt(s)]" in out
+    assert "EVENT" in out and "first_token" in out and "dispatched" in out
+    assert cli.requests("default", "llm", as_json=True) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["job"] == JOB
+    assert [r["request"] for r in doc["requests"]] == ["u1"]
+    assert doc["requests"][0]["milestones"]["first_token_rel_s"] == 1.5
+    # the argparse plumbing routes the verb
+    args = make_parser().parse_args(["requests", "default", "llm", "--json"])
+    assert cli_run(args, cli) == 0
+    json.loads(capsys.readouterr().out)
+    # unknown job: clean failure
+    assert cli.requests("default", "nope") == 1
+    assert "no request timelines" in capsys.readouterr().err
+    # disabled recorder: the error points at the flag
+    off = Cli(FakeCluster(), reqrecorder=_disabled())
+    assert off.requests("default", "llm") == 1
+    assert "--reqtrace-events-per-request" in capsys.readouterr().err
+
+
+def test_cli_describe_serving_slo_two_liner_and_byte_identity(capsys):
+    servefleet.reset_fleet_status()
+    clock, inj, mgr, asc = autoscaled_operator()
+    cli_off = Cli(inj, recorder=mgr.recorder, reqrecorder=_disabled())
+    assert cli_off.describe("TPUServingJob", "llm", "default") == 0
+    before = capsys.readouterr().out
+    assert "slo (" not in before and "burn (" not in before
+    # recorder on but no spec.slo declared -> byte-identical describe
+    rt = RequestRecorder(events_per_request=64, max_requests=64,
+                         clock=clock)
+    cli = Cli(inj, recorder=mgr.recorder, reqrecorder=rt)
+    assert cli.describe("TPUServingJob", "llm", "default") == 0
+    assert capsys.readouterr().out == before
+    # armed + violating traffic -> exactly the two SLO lines appear
+    rt.set_slo(JOB, SLOSpec(ttft_p99_s=1.0, objective=0.9,
+                            fast_window_s=30.0, slow_window_s=120.0))
+    for i in range(6):
+        _finish_one(rt, f"u{i}", clock)  # ttft 2.0 > 1.0 target
+    rt.slo_tick(clock())
+    assert cli.describe("TPUServingJob", "llm", "default") == 0
+    out = capsys.readouterr().out
+    assert "  slo (p99 targets, objective 0.9): ttft=1s (now 2s)" in out
+    assert "  burn (30s/120s windows): ttft=10x/10x BURNING" in out
+    stripped = [l for l in out.splitlines()
+                if not l.startswith("  slo (")
+                and not l.startswith("  burn (")]
+    assert stripped == before.splitlines()
+
+
+# ------------------------------------------------------------ SIGUSR1 dump
+def test_sigusr1_dump_writes_request_timelines_side_file(tmp_path):
+    import os
+    import signal
+    import time as _time
+
+    from tf_operator_tpu.cmd import main as cmd_main
+
+    dump = tmp_path / "wedge.json"
+    opts = ServerOptions(
+        enabled_schemes=EnabledSchemes(["TFJob"]),
+        trace_dump=str(dump),
+        health_probe_bind_address=":0",
+        metrics_bind_address=":0",
+    )
+    prev = signal.getsignal(signal.SIGUSR1)
+    manager = cmd_main.run(opts, cluster=FakeCluster(), block=False)
+    try:
+        # the request recorder is ON by default in the operator process
+        assert manager.reqrecorder is not None and manager.reqrecorder.enabled
+        manager.reqrecorder.record(JOB, "u1", "router", "submitted", {})
+        manager.reqrecorder.record(JOB, "u1", "router", "finished",
+                                   {"tokens": 2})
+        os.kill(os.getpid(), signal.SIGUSR1)
+        side = tmp_path / "wedge.json.requests.json"
+        doc = None
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if side.exists():
+                try:
+                    doc = json.loads(side.read_text())
+                    break
+                except ValueError:
+                    pass  # mid-write
+            _time.sleep(0.01)
+        assert doc is not None, "SIGUSR1 did not dump request timelines"
+        tl = doc["jobs"][JOB]["requests"]["u1"]
+        assert [e["event"] for e in tl["events"]] == ["submitted",
+                                                      "finished"]
+        assert tl["finished"] is True
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+        manager.stop()
+        reqtrace.set_recorder(_disabled())

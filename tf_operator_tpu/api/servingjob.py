@@ -105,10 +105,85 @@ class AutoscaleSpec:
 
 
 @dataclass
+class SLOSpec:
+    """Per-job serving SLO targets for the request flight recorder's
+    burn-rate engine (engine/reqtrace.py).  Each latency axis carries a
+    p99 target in seconds (absent = that axis is not tracked); the
+    engine evaluates bad-sample fractions over TWO sliding windows
+    (fast + slow, the classic multi-window burn-rate alerting shape:
+    the fast window catches a fresh regression, the slow window keeps a
+    single slow request from paging) against the error budget
+    `1 - objective`, and fires an `slo_burn` DECISION when BOTH exceed
+    `burn_threshold`.
+
+      spec:
+        slo:
+          ttftP99S: 4.0          # time-to-first-token p99 target
+          tpotP99S: 0.08         # time-per-output-token p99 target
+          queueWaitP99S: 2.0     # submit -> admission p99 target
+          e2eP99S: 20.0          # submit -> finish p99 target
+          objective: 0.99        # SLO objective (error budget = 1%)
+          fastWindowS: 60.0
+          slowWindowS: 300.0
+          burnThreshold: 1.0     # burn rate that pages (both windows)
+    """
+
+    ttft_p99_s: Optional[float] = None
+    tpot_p99_s: Optional[float] = None
+    queue_wait_p99_s: Optional[float] = None
+    e2e_p99_s: Optional[float] = None
+    objective: float = 0.99
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_threshold: float = 1.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "objective": self.objective,
+            "fastWindowS": self.fast_window_s,
+            "slowWindowS": self.slow_window_s,
+            "burnThreshold": self.burn_threshold,
+        }
+        if self.ttft_p99_s is not None:
+            d["ttftP99S"] = self.ttft_p99_s
+        if self.tpot_p99_s is not None:
+            d["tpotP99S"] = self.tpot_p99_s
+        if self.queue_wait_p99_s is not None:
+            d["queueWaitP99S"] = self.queue_wait_p99_s
+        if self.e2e_p99_s is not None:
+            d["e2eP99S"] = self.e2e_p99_s
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["SLOSpec"]:
+        if d is None:
+            return None
+        out = cls()
+        if "ttftP99S" in d:
+            out.ttft_p99_s = d["ttftP99S"]
+        if "tpotP99S" in d:
+            out.tpot_p99_s = d["tpotP99S"]
+        if "queueWaitP99S" in d:
+            out.queue_wait_p99_s = d["queueWaitP99S"]
+        if "e2eP99S" in d:
+            out.e2e_p99_s = d["e2eP99S"]
+        if "objective" in d:
+            out.objective = d["objective"]
+        if "fastWindowS" in d:
+            out.fast_window_s = d["fastWindowS"]
+        if "slowWindowS" in d:
+            out.slow_window_s = d["slowWindowS"]
+        if "burnThreshold" in d:
+            out.burn_threshold = d["burnThreshold"]
+        return out
+
+
+@dataclass
 class TPUServingJob(jobapi.Job):
     kind: str = KIND
     slice_shape: str = DEFAULT_SLICE_SHAPE
     autoscale: Optional[AutoscaleSpec] = None
+    slo: Optional[SLOSpec] = None
 
     def replica_specs_key(self) -> str:
         return "servingReplicaSpecs"
@@ -117,11 +192,14 @@ class TPUServingJob(jobapi.Job):
         d: Dict[str, Any] = {"sliceShape": self.slice_shape}
         if self.autoscale is not None:
             d["autoscale"] = self.autoscale.to_dict()
+        if self.slo is not None:
+            d["slo"] = self.slo.to_dict()
         return d
 
     def extra_spec_from_dict(self, spec: Dict[str, Any]) -> None:
         self.slice_shape = spec.get("sliceShape", DEFAULT_SLICE_SHAPE)
         self.autoscale = AutoscaleSpec.from_dict(spec.get("autoscale"))
+        self.slo = SLOSpec.from_dict(spec.get("slo"))
 
 
 def set_defaults(job: TPUServingJob) -> None:
@@ -152,6 +230,7 @@ def validate(job: TPUServingJob) -> None:
             f"{KIND}Spec is not valid: bad sliceShape {job.slice_shape!r} "
             f"(want e.g. 'v5e-8')"
         )
+    _validate_slo(job.slo)
     a = job.autoscale
     if a is None:
         return
@@ -202,4 +281,46 @@ def validate(job: TPUServingJob) -> None:
         raise jobapi.ValidationError(
             f"{KIND}Spec is not valid: autoscale.scaleOutBlockedAdmissions "
             f"must be >= 1"
+        )
+
+
+def _validate_slo(s: Optional[SLOSpec]) -> None:
+    if s is None:
+        return
+    for name, value in (
+        ("slo.ttftP99S", s.ttft_p99_s),
+        ("slo.tpotP99S", s.tpot_p99_s),
+        ("slo.queueWaitP99S", s.queue_wait_p99_s),
+        ("slo.e2eP99S", s.e2e_p99_s),
+    ):
+        if value is None:
+            continue
+        if not (isinstance(value, (int, float)) and value > 0):
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: {name} must be > 0, "
+                f"got {value!r}"
+            )
+    if not (
+        isinstance(s.objective, (int, float)) and 0.0 < s.objective < 1.0
+    ):
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: slo.objective must be in (0, 1), "
+            f"got {s.objective!r} (1.0 leaves no error budget to burn)"
+        )
+    for name, value in (
+        ("slo.fastWindowS", s.fast_window_s),
+        ("slo.slowWindowS", s.slow_window_s),
+        ("slo.burnThreshold", s.burn_threshold),
+    ):
+        if not (isinstance(value, (int, float)) and value > 0):
+            raise jobapi.ValidationError(
+                f"{KIND}Spec is not valid: {name} must be > 0, "
+                f"got {value!r}"
+            )
+    if s.fast_window_s >= s.slow_window_s:
+        raise jobapi.ValidationError(
+            f"{KIND}Spec is not valid: slo.fastWindowS "
+            f"({s.fast_window_s}) must be < slowWindowS "
+            f"({s.slow_window_s}) — multi-window burn alerting needs a "
+            f"short window inside a long one"
         )

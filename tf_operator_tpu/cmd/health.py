@@ -8,15 +8,21 @@ Beyond the reference:
 
   - ``/debug/traces`` serves the reconcile span tracer's Chrome
     trace-event JSON (engine/tracing.py), with one extra lane per job
-    from the flight recorder (engine/timeline.py) merged in — load it in
-    chrome://tracing or Perfetto to see syncs AND per-job causal stories
-    on one timeline.  ``?category=`` keeps only spans of that category
-    (reconcile / serving / timeline) and ``?limit=N`` keeps only the
-    most recent N root traces.
+    from the flight recorder (engine/timeline.py) and one per request
+    from the request recorder (engine/reqtrace.py) merged in — load it
+    in chrome://tracing or Perfetto to see syncs AND per-job causal
+    stories on one timeline.  ``?category=`` keeps only spans of that
+    category (reconcile / serving / timeline / request) and
+    ``?limit=N`` keeps only the most recent N root traces.
   - ``/debug/timeline`` lists the recorder's tracked jobs;
     ``/debug/timeline/<ns>/<name>`` serves one job's full timeline
     (records + derived SLOs) as JSON — the payload
     ``tpu-jobs timeline`` renders.
+  - ``/debug/requests`` lists the request recorder's tracked jobs;
+    ``/debug/requests/<ns>/<name>`` serves one serving job's request
+    summaries + SLO burn status; ``/debug/requests/<ns>/<name>/<rid>``
+    serves one request's full merged timeline — the payload
+    ``tpu-jobs requests`` renders.
 
 Every response carries Content-Length: keep-alive scrape clients would
 otherwise wait on an unterminated body until the connection times out.
@@ -29,7 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, unquote
 
-from tf_operator_tpu.engine import metrics, timeline, tracing
+from tf_operator_tpu.engine import metrics, reqtrace, timeline, tracing
 
 Check = Callable[[], bool]
 
@@ -38,6 +44,7 @@ class _Handler(BaseHTTPRequestHandler):
     checks: Dict[str, Check] = {}
     tracer: Optional[tracing.Tracer] = None
     recorder: Optional[timeline.FlightRecorder] = None
+    reqrecorder: Optional[reqtrace.RequestRecorder] = None
 
     def log_message(self, fmt, *args):  # silence per-request stderr noise
         pass
@@ -54,6 +61,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _recorder(self) -> timeline.FlightRecorder:
         return self.recorder or timeline.get_recorder()
+
+    def _reqrecorder(self) -> reqtrace.RequestRecorder:
+        return self.reqrecorder or reqtrace.get_recorder()
 
     def _serve_traces(self, params: Dict[str, list]) -> None:
         tracer = self.tracer or tracing.get_tracer()
@@ -74,6 +84,12 @@ class _Handler(BaseHTTPRequestHandler):
         # meant to shrink the response must not ship every ring whole
         if rec.enabled and category in (None, "timeline"):
             doc["traceEvents"].extend(rec.chrome_events(per_job=limit))
+        # ...and one lane per request (cat "request"), same axes
+        reqrec = self._reqrecorder()
+        if reqrec.enabled and category in (None, "request"):
+            doc["traceEvents"].extend(
+                reqrec.chrome_events(per_request=limit)
+            )
         self._json(doc)
 
     def _serve_timeline(self, rest: str) -> None:
@@ -98,6 +114,42 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json(doc)
 
+    def _serve_requests(self, rest: str) -> None:
+        rec = self._reqrecorder()
+        if not rec.enabled:
+            self._respond(404, b"request recorder disabled "
+                               b"(--reqtrace-events-per-request 0)")
+            return
+        if not rest:
+            self._json({"jobs": rec.jobs()})
+            return
+        parts = rest.split("/")
+        if len(parts) == 2:
+            namespace, name = parts
+            job_key = f"{unquote(namespace)}/{unquote(name)}"
+            self._json({
+                "job": job_key,
+                "requests": rec.requests(job_key),
+                "slo": rec.slo_status(job_key),
+            })
+            return
+        if len(parts) == 3:
+            namespace, name, rid = parts
+            job_key = f"{unquote(namespace)}/{unquote(name)}"
+            doc = rec.request_timeline(job_key, unquote(rid))
+            if doc is None:
+                self._respond(
+                    404,
+                    f"no timeline for request {unquote(rid)!r} "
+                    f"of {job_key}".encode(),
+                )
+                return
+            self._json(doc)
+            return
+        self._respond(
+            404, b"want /debug/requests/<namespace>/<name>[/<request>]"
+        )
+
     def do_GET(self):  # noqa: N802 (stdlib API name)
         path, _, query = self.path.partition("?")
         params = parse_qs(query)
@@ -112,6 +164,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/debug/timeline" or path.startswith("/debug/timeline/"):
             self._serve_timeline(path[len("/debug/timeline"):].strip("/"))
             return
+        if path == "/debug/requests" or path.startswith("/debug/requests/"):
+            self._serve_requests(path[len("/debug/requests"):].strip("/"))
+            return
         check = self.checks.get(path)
         if check is None:
             self._respond(404, b"not found")
@@ -125,11 +180,13 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class HealthServer:
-    """Serves /healthz, /readyz, /metrics, /debug/traces, and
-    /debug/timeline on one listener. Bind with port 0 to get an ephemeral
-    port (tests read .port after start). `tracer` defaults to the
-    process-global span tracer, `recorder` to the process-global flight
-    recorder (disabled unless an operator configured one)."""
+    """Serves /healthz, /readyz, /metrics, /debug/traces,
+    /debug/timeline, and /debug/requests on one listener. Bind with
+    port 0 to get an ephemeral port (tests read .port after start).
+    `tracer` defaults to the process-global span tracer, `recorder` to
+    the process-global flight recorder, `reqrecorder` to the
+    process-global request recorder (each disabled unless an operator
+    configured one)."""
 
     def __init__(
         self,
@@ -139,6 +196,7 @@ class HealthServer:
         readyz: Optional[Check] = None,
         tracer: Optional[tracing.Tracer] = None,
         recorder: Optional[timeline.FlightRecorder] = None,
+        reqrecorder: Optional[reqtrace.RequestRecorder] = None,
     ) -> None:
         handler = type("Handler", (_Handler,), {})
         handler.checks = {
@@ -147,6 +205,7 @@ class HealthServer:
         }
         handler.tracer = tracer
         handler.recorder = recorder
+        handler.reqrecorder = reqrecorder
         self._server = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 

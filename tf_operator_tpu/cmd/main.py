@@ -122,6 +122,7 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         manager = OperatorManager(cluster, options)
 
     recorder = getattr(manager, "recorder", None)
+    reqrecorder = getattr(manager, "reqrecorder", None)
     health_host, health_port = split_bind_address(options.health_probe_bind_address)
     probe = HealthServer(
         host=health_host,
@@ -129,6 +130,7 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
         healthz=lambda: manager.healthy,
         readyz=lambda: manager.ready,
         recorder=recorder,
+        reqrecorder=reqrecorder,
     )
     probe.start()
     log.info("health probes on :%d", probe.port)
@@ -137,7 +139,8 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
     # main.go:63; the probe port also serves /metrics for convenience)
     metrics_host, metrics_port = split_bind_address(options.metrics_bind_address)
     metrics_srv = HealthServer(
-        host=metrics_host, port=metrics_port, recorder=recorder
+        host=metrics_host, port=metrics_port, recorder=recorder,
+        reqrecorder=reqrecorder,
     )
     metrics_srv.start()
     log.info("metrics on :%d", metrics_srv.port)
@@ -160,8 +163,10 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
 
     def dump_debug_state(path=None):
         """Write the Chrome trace export (reconcile/serving spans + one
-        flight-recorder lane per job) to `path`, and every live timeline
-        as JSON beside it.  The shutdown path uses --trace-dump; SIGUSR1
+        flight-recorder lane per job + one lane per request) to `path`,
+        and every live job timeline (`PATH.timeline.json`) and request
+        timeline (`PATH.requests.json`) as JSON beside it.  The
+        shutdown path uses --trace-dump; SIGUSR1
         falls back to a pid-stamped /tmp path so a wedged operator is
         inspectable even when the flag was never set."""
         import json as _json
@@ -175,12 +180,19 @@ def run(options: ServerOptions, cluster=None, block: bool = True) -> OperatorMan
             doc = tracing.get_tracer().to_chrome_trace()
             if recorder is not None and recorder.enabled:
                 doc["traceEvents"].extend(recorder.chrome_events())
+            if reqrecorder is not None and reqrecorder.enabled:
+                doc["traceEvents"].extend(reqrecorder.chrome_events())
             with open(path, "w") as fh:
                 _json.dump(doc, fh)
             log.info("reconcile traces dumped to %s", path)
             if recorder is not None and recorder.enabled:
                 recorder.dump(path + ".timeline.json")
                 log.info("job timelines dumped to %s.timeline.json", path)
+            if reqrecorder is not None and reqrecorder.enabled:
+                reqrecorder.dump(path + ".requests.json")
+                log.info(
+                    "request timelines dumped to %s.requests.json", path
+                )
         except OSError as e:
             log.warning("trace dump failed: %s", e)
 

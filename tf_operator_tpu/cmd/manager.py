@@ -37,6 +37,7 @@ from tf_operator_tpu.cmd.leader import LeaseLock
 from tf_operator_tpu.cmd.options import ServerOptions
 from tf_operator_tpu.controllers.registry import make_engine
 from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine import reqtrace as reqtrace_mod
 from tf_operator_tpu.engine import timeline as timeline_mod
 from tf_operator_tpu.engine.controller import EngineConfig
 from tf_operator_tpu.engine.sharding import (
@@ -141,8 +142,35 @@ def build_recorder(options: ServerOptions, engine_kwargs=None):
     return recorder
 
 
+def build_request_recorder(options: ServerOptions, engine_kwargs=None,
+                           job_recorder=None):
+    """One request flight recorder per operator process, or None when
+    --reqtrace-events-per-request is 0.  ON by default (128 events per
+    request).  `job_recorder` is the job FlightRecorder that receives
+    mirrored `slo_burn` DECISIONs on the owning job's timeline.
+    Registered as the process default so /debug/requests and an
+    in-process CLI find it unwired."""
+    if options.reqtrace_events_per_request <= 0:
+        # reset the process default too: a recorder-off operator built
+        # after a recorder-on one (bench pairs, test sequences) must not
+        # leave /debug/requests and the CLI serving the PREVIOUS
+        # manager's stale timelines through the global fallback
+        reqtrace_mod.set_recorder(
+            reqtrace_mod.RequestRecorder(events_per_request=0)
+        )
+        return None
+    reqrecorder = reqtrace_mod.RequestRecorder(
+        events_per_request=options.reqtrace_events_per_request,
+        max_requests=options.reqtrace_max_requests,
+        clock=(engine_kwargs or {}).get("clock", time.time),
+        job_recorder=job_recorder,
+    )
+    reqtrace_mod.set_recorder(reqrecorder)
+    return reqrecorder
+
+
 def build_fleet_autoscaler(cluster, options: ServerOptions, engine_kwargs=None,
-                           recorder=None):
+                           recorder=None, reqrecorder=None):
     """One serving-fleet autoscaler per operator process, or None when
     --serving-autoscale is off.  Standalone managers only (a sharded
     coordinator would run one on the parent; N shards each patching the
@@ -156,11 +184,12 @@ def build_fleet_autoscaler(cluster, options: ServerOptions, engine_kwargs=None,
         interval=options.serving_autoscale_interval,
         clock=(engine_kwargs or {}).get("clock", time.time),
         recorder=recorder,
+        reqrecorder=reqrecorder,
     )
 
 
 def build_scrape_loop(cluster, options: ServerOptions, autoscaler,
-                      engine_kwargs=None):
+                      engine_kwargs=None, reqrecorder=None):
     """One serving-fleet scrape loop per operator process, or None when
     --serving-scrape-interval is 0 (the default) or no autoscaler runs
     to consume the telemetry.  Targets are re-discovered from the
@@ -176,6 +205,7 @@ def build_scrape_loop(cluster, options: ServerOptions, autoscaler,
         interval=options.serving_scrape_interval,
         timeout=options.serving_scrape_timeout,
         clock=(engine_kwargs or {}).get("clock", time.time),
+        reqrecorder=reqrecorder,
     )
 
 
@@ -585,6 +615,7 @@ class OperatorManager:
         warm_pool=None,
         scheduler=None,
         recorder=None,
+        reqrecorder=None,
     ) -> None:
         """`engine_kwargs` is forwarded to every kind's JobEngine — the seam
         tests use to inject a simulated clock (chaos soak) or alternate
@@ -624,12 +655,21 @@ class OperatorManager:
         if recorder is None and shard is None:
             recorder = build_recorder(self.options, engine_kwargs)
         self.recorder = recorder
+        # request flight recorder (engine/reqtrace.py): per-request
+        # causal timelines + the SLO burn-rate engine, ON by default;
+        # a shard instance is handed the coordinator's shared one
+        if reqrecorder is None and shard is None:
+            reqrecorder = build_request_recorder(
+                self.options, engine_kwargs, job_recorder=recorder
+            )
+        self.reqrecorder = reqrecorder
         # serving-fleet autoscaler (engine/servefleet.py): standalone
         # managers only; --serving-autoscale off (default) builds nothing
         self._owns_autoscaler = shard is None
         self.fleet_autoscaler = (
             build_fleet_autoscaler(
-                cluster, self.options, engine_kwargs, recorder=recorder
+                cluster, self.options, engine_kwargs, recorder=recorder,
+                reqrecorder=reqrecorder,
             )
             if self._owns_autoscaler else None
         )
@@ -640,7 +680,8 @@ class OperatorManager:
         # the push seam otherwise carries; --serving-scrape-interval 0
         # (default) builds nothing
         self.scrape_loop = build_scrape_loop(
-            cluster, self.options, self.fleet_autoscaler, engine_kwargs
+            cluster, self.options, self.fleet_autoscaler, engine_kwargs,
+            reqrecorder=reqrecorder,
         )
         if self.recorder is not None:
             if self.warm_pool is not None:
@@ -853,6 +894,7 @@ class _Shard:
             warm_pool=op.warm_pool,
             scheduler=op.scheduler,
             recorder=op.recorder,
+            reqrecorder=op.reqrecorder,
         )
 
 
@@ -975,6 +1017,11 @@ class ShardedOperator:
         # job's story — a failover neither loses nor duplicates a
         # timeline because there is exactly one per job to begin with
         self.recorder = build_recorder(self.options, engine_kwargs)
+        # ...and one request recorder, for the same reason: a request's
+        # timeline must survive the slot moving, so there is one store
+        self.reqrecorder = build_request_recorder(
+            self.options, engine_kwargs, job_recorder=self.recorder
+        )
         if self.recorder is not None:
             if self.warm_pool is not None:
                 self.warm_pool.recorder = self.recorder

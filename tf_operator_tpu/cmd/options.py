@@ -121,6 +121,16 @@ class ServerOptions:
     # jobs (LRU-evicting finished ones).
     timeline_events_per_job: int = 256
     timeline_max_jobs: int = 1000
+    # request flight recorder (engine/reqtrace.py): per-request causal
+    # timeline across router/replica/serving/SLO planes, served at
+    # /debug/requests and by `tpu-jobs requests`, with the windowed SLO
+    # burn-rate engine judging each TPUServingJob's `spec.slo`.  ON by
+    # default — the off path (0) bypasses every recording seam and is
+    # asserted byte-identical to the pre-recorder operator.
+    # events-per-request bounds each request's ring; max-requests caps
+    # tracked requests (LRU-evicting finished ones).
+    reqtrace_events_per_request: int = 128
+    reqtrace_max_requests: int = 2048
     # serving-fleet autoscaler (engine/servefleet.py): scales each
     # TPUServingJob's replica count on its own telemetry (queue-wait
     # p99 / blocked admissions out, KV-block occupancy floor in), with
@@ -339,6 +349,23 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         "are LRU-evicted past the cap (live jobs never are)",
     )
     p.add_argument(
+        "--reqtrace-events-per-request",
+        type=int,
+        default=128,
+        help="request flight recorder: keep this many records per "
+        "request's timeline ring (served at /debug/requests and by "
+        "`tpu-jobs requests`; the windowed SLO burn-rate engine rides "
+        "on the same samples); 0 disables the recorder entirely",
+    )
+    p.add_argument(
+        "--reqtrace-max-requests",
+        type=int,
+        default=2048,
+        help="request flight recorder: cap on tracked requests; "
+        "finished requests are LRU-evicted past the cap (in-flight "
+        "ones never are)",
+    )
+    p.add_argument(
         "--serving-autoscale",
         action="store_true",
         help="run the serving-fleet autoscaler: each TPUServingJob's "
@@ -423,6 +450,8 @@ def parse_args(argv: Optional[List[str]] = None) -> ServerOptions:
         scheduler_nodes=list(a.node),
         timeline_events_per_job=a.timeline_events_per_job,
         timeline_max_jobs=a.timeline_max_jobs,
+        reqtrace_events_per_request=a.reqtrace_events_per_request,
+        reqtrace_max_requests=a.reqtrace_max_requests,
         serving_autoscale=a.serving_autoscale,
         serving_autoscale_interval=a.serving_autoscale_interval,
         serving_scrape_interval=a.serving_scrape_interval,

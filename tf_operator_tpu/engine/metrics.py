@@ -836,3 +836,45 @@ SERVING_KV_WINDOW_EVICTED = Counter(
     "(and copied only while still partially visible); compare with "
     "the CoW-copy rate to see window pressure vs prefix-boundary cost",
 )
+# Request flight recorder + windowed SLO engine (ISSUE 16,
+# engine/reqtrace.py): per-request causal timelines on the serving
+# plane, and multi-window burn rates of the latency axes (TTFT / TPOT /
+# queue-wait / e2e) against each TPUServingJob's spec.slo targets.
+# docs/monitoring.md carries the burn-rate PromQL.
+SERVING_SLO_BURN_RATE = Gauge(
+    f"{PREFIX}_serving_slo_burn_rate",
+    "Current SLO burn rate per latency axis (ttft/tpot/queue_wait/e2e) "
+    "and evaluation window (fast/slow): bad-sample fraction divided by "
+    "the error budget (1 - objective) — 1.0 burns the budget exactly at "
+    "the allowed rate; a page fires when BOTH windows exceed the "
+    "configured threshold (multi-window, so a single slow request "
+    "cannot page and a sustained regression cannot hide)",
+)
+SERVING_SLO_WINDOW_P99 = Gauge(
+    f"{PREFIX}_serving_slo_window_p99_seconds",
+    "Sliding-window ceil-rank p99 of each latency axis (censored: a "
+    "dropped request contributes +inf, so the gauge is only exported "
+    "while the p99 is finite — an absent series under drops IS the "
+    "signal, not a healthy zero)",
+)
+SERVING_SLO_BURNS = Counter(
+    f"{PREFIX}_serving_slo_burns_total",
+    "slo_burn DECISIONs emitted per latency axis: both burn-rate "
+    "windows crossed the threshold, a record landed on the owning "
+    "TPUServingJob's timeline and on the offending requests' — the "
+    "page-worthy event count, rate-limited per axis by half the fast "
+    "window",
+)
+SERVING_REQUEST_TIMELINE_EVENTS = Counter(
+    f"{PREFIX}_serving_request_timeline_events_total",
+    "Records appended to per-request flight-recorder timelines, labeled "
+    "by source plane (router/replica/serving/slo) — the request "
+    "recorder's own write volume",
+)
+SERVING_REQUEST_TIMELINE_EVICTIONS = Counter(
+    f"{PREFIX}_serving_request_timeline_evictions_total",
+    "Finished-request timelines evicted by the request recorder's LRU "
+    "when the tracked-request cap was hit; in-flight requests are never "
+    "evicted, so a high rate just means --reqtrace-max-requests is "
+    "small relative to request churn",
+)

@@ -1,0 +1,785 @@
+"""Request flight recorder — one causal, bounded timeline per request.
+
+PR 10 gave every *job* a flight recorder; the serving plane has since
+grown into a distributed system (occupancy router, fleet autoscaler,
+scrape transport, ejection, hedged re-dispatch) whose individual
+*requests* have no story: when TTFT p99 blows up, nothing explains
+whether the request queued at the router, lost a hedge race, rode a
+degraded round-robin, or parked behind a paged-pool memory gate.  This
+module is the per-request join: every plane appends structured,
+monotonically-sequenced records keyed by (job, request id), so one
+request's whole life — submit, router queue, dispatch, hedge race,
+replica admission, prefill chunks, first token, finish (or ejection
+re-dispatch, rejection, drop) — reads as a single ordered story, with
+a hedged request's two arms as sibling ATTEMPTS under one timeline.
+
+The design mirrors the job recorder (engine/timeline.py) exactly,
+because its constraints are the same and proven:
+
+  - **Bounded**: per request, one ring (``deque(maxlen=...)``) for
+    routine progress (queued / dispatched / admitted / prefill_chunk /
+    first_token / progress) and one for DECISIONS (hedge_issued / won /
+    lost, redispatch, dispatch_failed, degraded entry/exit, memory-gate
+    block, rejection, drop, slo_burn) — merged by sequence on read.  A
+    long decode churns hundreds of progress records, and a single
+    shared ring would evict the one hedge_lost record that explains the
+    tail latency.  At most ``max_requests`` requests are tracked; past
+    the cap the least-recently-touched FINISHED request is evicted
+    (in-flight requests never are).
+  - **Cheap on the hot path**: append is O(1) under the REQUEST's ring
+    lock; the directory lock is taken only on first contact and on
+    eviction.  ``progress`` records are additionally rate-limited per
+    (request, replica) — the fleet simulator's per-step token scan must
+    not flood the routine ring into amnesia.
+  - **Causal**: records carry a per-request monotonic ``seq`` assigned
+    under the ring lock; each ``dispatched`` record opens a new
+    ATTEMPT, and later records are attributed to the attempt that owns
+    their replica — the losing arm of a hedge race stays readable as
+    "attempt 1 was dispatched, raced, and lost".
+  - **Derived SLOs**: finish-time milestones feed a windowed SLO
+    engine: sliding-window TTFT / TPOT / queue-wait / e2e samples
+    (ceil-rank p99, censored +inf for drops) evaluated as multi-window
+    burn rates against per-TPUServingJob ``spec.slo`` targets,
+    emitting ``slo_burn`` DECISIONs onto BOTH the owning job's timeline
+    and the offending requests' own, plus ``serving_slo_*`` families.
+
+``events_per_request=0`` disables recording entirely; every seam checks
+``recorder is None`` or finds ``record()`` returning immediately, and
+the seeded chaos/fleet goldens stay byte-identical either way (the
+recorder never writes to the seeded log).
+
+Served as JSON at ``/debug/requests/<ns>/<name>[/<rid>]``
+(cmd/health.py), rendered by ``tpu-jobs requests NS NAME``, and merged
+into the ``/debug/traces`` Chrome-trace export as one lane per request
+(category ``request``).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.engine import metrics
+from tf_operator_tpu.engine import timeline as _timeline
+
+# Events that are DECISIONS (routing/failure-handling verdicts about the
+# request) vs routine progress traffic.  Classification is by EVENT here
+# — unlike the job recorder's by-source split — because one source (the
+# router) emits both classes: `dispatched` is cadence, `hedge_lost` is
+# the record the timeline exists to remember.
+_DECISION_EVENTS = frozenset({
+    "hedge_issued", "hedge_won", "hedge_lost",
+    "redispatch", "redispatch_skipped", "dispatch_failed",
+    "degraded_entry", "degraded_exit",
+    "memory_gate_block", "rejected", "drop",
+    "duplicate_completion", "slo_burn",
+})
+# Events that close a timeline: the request became eligible for LRU
+# eviction, and its milestones feed the SLO windows (censored +inf when
+# it never delivered).
+_TERMINAL_EVENTS = frozenset({"finished", "rejected", "drop"})
+# Chrome-trace lane ids for request timelines start here — above the
+# serving-telemetry block (1 << 20) and the job-timeline block
+# (1 << 24), so the three lane families never alias in a merged export.
+_LANE_TID_BASE = 1 << 25
+# Minimum spacing between `progress` records per (request, replica):
+# the fleet simulator reports token progress every step, and unbounded
+# progress chatter would evict the admission/first-token records that
+# give the timeline its shape.
+_PROGRESS_MIN_GAP_S = 1.0
+# Multi-window burn evaluation: both windows need this many samples
+# before they can page (a single slow request must not), and a given
+# (job, axis) re-fires at most once per half fast-window.
+_SLO_MIN_SAMPLES = 5
+_SLO_MAX_SAMPLES = 4096
+_SLO_OFFENDERS_CAP = 10
+_SLO_AXES = ("ttft", "tpot", "queue_wait", "e2e")
+
+
+class _ReqTimeline:
+    """One request's rings + milestone bookkeeping, guarded by its own
+    lock."""
+
+    __slots__ = (
+        "job_key", "rid", "lock", "events", "decisions", "seq", "last_ts",
+        "finished", "dropped", "attempts", "attempt_of", "last_progress",
+        "submitted_ts", "dispatched_ts", "admitted_ts", "first_token_ts",
+        "finished_ts", "tokens",
+    )
+
+    def __init__(self, job_key: str, rid: str, cap: int) -> None:
+        self.job_key = job_key
+        self.rid = rid
+        self.lock = threading.Lock()
+        # two rings, one sequence: progress chatter cannot evict the
+        # rare decision records that explain it
+        self.events: "deque[Dict[str, Any]]" = deque(maxlen=cap)
+        self.decisions: "deque[Dict[str, Any]]" = deque(maxlen=cap)
+        self.seq = 0
+        self.last_ts = 0.0
+        self.finished = False
+        self.dropped = False
+        # each `dispatched` record opens attempt N (0-based); replica ->
+        # attempt lets later records (first_token via r2, hedge_won via
+        # r2) attribute themselves to the arm that owns that replica
+        self.attempts = 0
+        self.attempt_of: Dict[str, int] = {}
+        self.last_progress: Dict[str, float] = {}
+        self.submitted_ts: Optional[float] = None
+        self.dispatched_ts: Optional[float] = None
+        self.admitted_ts: Optional[float] = None
+        self.first_token_ts: Optional[float] = None
+        self.finished_ts: Optional[float] = None
+        self.tokens: Optional[int] = None
+
+
+class _SloState:
+    """One job's SLO targets + per-axis sliding sample windows, guarded
+    by the recorder's slo lock."""
+
+    __slots__ = ("spec", "samples", "last_burn", "last_eval")
+
+    def __init__(self, spec: Any) -> None:
+        self.spec = spec
+        # axis -> deque[(ts, value, rid)]; pruned to the slow window on
+        # every observe/evaluate, hard-capped so a burst cannot grow it
+        self.samples: Dict[str, "deque[Tuple[float, float, str]]"] = {
+            axis: deque(maxlen=_SLO_MAX_SAMPLES) for axis in _SLO_AXES
+        }
+        self.last_burn: Dict[str, float] = {}
+        # last sample-driven window evaluation: scanning + ranking both
+        # windows on EVERY finish is the recorder's one O(window) cost,
+        # so finish-driven evals are spaced at least fast_window/2 apart
+        # (slo_tick — the scrape cadence — always evaluates)
+        self.last_eval = -math.inf
+
+
+def _spec_targets(spec: Any) -> List[Tuple[str, float]]:
+    """(axis, target_seconds) pairs for the targets the spec sets."""
+    pairs = (
+        ("ttft", getattr(spec, "ttft_p99_s", None)),
+        ("tpot", getattr(spec, "tpot_p99_s", None)),
+        ("queue_wait", getattr(spec, "queue_wait_p99_s", None)),
+        ("e2e", getattr(spec, "e2e_p99_s", None)),
+    )
+    return [(axis, float(t)) for axis, t in pairs if t is not None]
+
+
+def _p99(values: List[float]) -> Optional[float]:
+    """Ceil-rank p99 (PR 14/15 convention); None on empty input."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, math.ceil(0.99 * len(ordered)) - 1)]
+
+
+class RequestRecorder:
+    """Thread-safe bounded per-request flight recorder + windowed SLO
+    burn-rate engine.  See module docs."""
+
+    def __init__(
+        self,
+        events_per_request: int = 128,
+        max_requests: int = 2048,
+        clock=time.time,
+        job_recorder: Optional[_timeline.FlightRecorder] = None,
+    ) -> None:
+        self.events_per_request = int(events_per_request)
+        self.max_requests = max(1, int(max_requests))
+        self.clock = clock
+        # where slo_burn DECISIONs about the JOB land; None falls back
+        # to the process-global job recorder at emission time
+        self.job_recorder = job_recorder
+        self._requests: Dict[Tuple[str, str], _ReqTimeline] = {}
+        # directory lock: first-contact admission + eviction ONLY — the
+        # per-record hot path reads the dict without it (GIL-atomic) and
+        # synchronizes on the request's own ring lock
+        self._dir_lock = threading.Lock()
+        self._slo: Dict[str, _SloState] = {}
+        self._slo_lock = threading.Lock()
+        # metric staging: the exporter families are global-locked and
+        # label-keyed, too heavy for the per-record path — counts stage
+        # here and flush on the scrape cadence (slo_tick) and on every
+        # read entry point, so anything that LOOKS at the recorder sees
+        # settled counters
+        self._stats_lock = threading.Lock()
+        self._pending_events: Dict[str, int] = {}
+        self._pending_evictions = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.events_per_request > 0
+
+    # --------------------------------------------------------------- record
+    def record(
+        self,
+        job_key: str,
+        request_id: str,
+        source: str,
+        event: str,
+        detail: Optional[Dict[str, Any]] = None,
+        ts: Optional[float] = None,
+    ) -> None:
+        """Append one structured record to the request's ring.  O(1)
+        under the request's ring lock; a disabled recorder returns
+        immediately so every seam can stay unconditional behind a None
+        check."""
+        if self.events_per_request <= 0 or not job_key or not request_id:
+            return
+        if ts is None:
+            ts = self.clock()
+        if detail is None:
+            detail = {}
+        key = (job_key, str(request_id))
+        samples: Optional[Dict[str, float]] = None
+        while True:
+            tl = self._requests.get(key)
+            if tl is None:
+                tl = self._admit(key)
+            with tl.lock:
+                if self._requests.get(key) is not tl:
+                    # lost a race with _evict_locked between the lookup
+                    # and the lock: appending to the orphaned ring would
+                    # silently drop the record — re-admit and retry
+                    continue
+                if event == "progress":
+                    rep = str(detail.get("replica", ""))
+                    last = tl.last_progress.get(rep)
+                    if last is not None and ts - last < _PROGRESS_MIN_GAP_S:
+                        return
+                    tl.last_progress[rep] = ts
+                tl.seq += 1
+                attempt, samples = self._apply_locked(tl, event, detail, ts)
+                rec: Dict[str, Any] = {
+                    "seq": tl.seq,
+                    "t": ts,
+                    "source": source,
+                    "event": event,
+                    "detail": detail,
+                }
+                if attempt is not None:
+                    rec["attempt"] = attempt
+                ring = (
+                    tl.decisions if event in _DECISION_EVENTS else tl.events
+                )
+                ring.append(rec)
+                tl.last_ts = ts
+            break
+        with self._stats_lock:
+            self._pending_events[source] = (
+                self._pending_events.get(source, 0) + 1
+            )
+        if samples:
+            # SLO windows are fed OUTSIDE the ring lock: the evaluator
+            # records slo_burn back onto request rings, and feeding it
+            # under a ring lock would order ring -> slo -> ring
+            self._slo_observe(job_key, key[1], samples, ts)
+
+    def _apply_locked(
+        self, tl: _ReqTimeline, event: str, detail: Dict[str, Any],
+        ts: float,
+    ) -> Tuple[Optional[int], Optional[Dict[str, float]]]:
+        """Attempt attribution + milestone bookkeeping, one frame for
+        both (the per-record path runs at fleet rates).  `dispatched`
+        opens a new attempt owned by its replica; every other record
+        joins the attempt that owns the replica it names (via
+        `replica`, `via`, or `from`).  Returns (attempt, slo_samples) —
+        samples only on the FIRST terminal record, censored +inf where
+        the request never got that far."""
+        if event == "dispatched":
+            attempt: Optional[int] = tl.attempts
+            tl.attempts += 1
+            rep = detail.get("replica")
+            if rep is not None:
+                tl.attempt_of[str(rep)] = attempt
+            if tl.dispatched_ts is None:
+                tl.dispatched_ts = ts
+            return attempt, None
+        attempt = None
+        for field in ("replica", "via", "from"):
+            rep = detail.get(field)
+            if rep is not None:
+                attempt = tl.attempt_of.get(str(rep))
+                if attempt is not None:
+                    break
+        return attempt, self._derive_locked(tl, event, detail, ts)
+
+    def _derive_locked(
+        self, tl: _ReqTimeline, event: str, detail: Dict[str, Any],
+        ts: float,
+    ) -> Optional[Dict[str, float]]:
+        """Milestone bookkeeping; returns the SLO samples a terminal
+        record yields (censored +inf where the request never got that
+        far), None otherwise."""
+        if event == "submitted" and tl.submitted_ts is None:
+            tl.submitted_ts = ts
+        elif event == "admitted" and tl.admitted_ts is None:
+            tl.admitted_ts = ts
+        elif event == "first_token" and tl.first_token_ts is None:
+            tl.first_token_ts = ts
+        if event not in _TERMINAL_EVENTS or tl.finished:
+            return None
+        tl.finished = True
+        tl.finished_ts = ts
+        if event != "finished":
+            tl.dropped = True
+        tokens = detail.get("tokens")
+        if isinstance(tokens, (int, float)):
+            tl.tokens = int(tokens)
+        return self._samples_locked(tl, ts)
+
+    @staticmethod
+    def _samples_locked(tl: _ReqTimeline, ts: float) -> Dict[str, float]:
+        """Latency samples at finish.  Censoring (PR 15 convention): a
+        dropped/rejected request contributes +inf on every axis it never
+        completed — a drop IS the worst latency, not a missing sample."""
+        base = tl.submitted_ts
+        out: Dict[str, float] = {}
+        if base is None:
+            return out
+        if tl.dropped:
+            out["e2e"] = math.inf
+        else:
+            out["e2e"] = max(0.0, ts - base)
+        admit = tl.admitted_ts or tl.dispatched_ts
+        if admit is not None:
+            out["queue_wait"] = max(0.0, admit - base)
+        elif tl.dropped:
+            out["queue_wait"] = math.inf
+        if tl.first_token_ts is not None:
+            out["ttft"] = max(0.0, tl.first_token_ts - base)
+            if not tl.dropped and tl.tokens and tl.tokens > 1:
+                out["tpot"] = max(
+                    0.0, (ts - tl.first_token_ts) / (tl.tokens - 1)
+                )
+        elif tl.dropped:
+            out["ttft"] = math.inf
+        return out
+
+    # ------------------------------------------------------------ directory
+    def _admit(self, key: Tuple[str, str]) -> _ReqTimeline:
+        with self._dir_lock:
+            tl = self._requests.get(key)
+            if tl is not None:
+                return tl
+            if len(self._requests) >= self.max_requests:
+                self._evict_locked()
+            tl = _ReqTimeline(key[0], key[1], self.events_per_request)
+            self._requests[key] = tl
+            return tl
+
+    def _evict_locked(self) -> None:
+        """Evict the least-recently-touched FINISHED request.  In-flight
+        requests are never evicted: their count is bounded by the fleet's
+        admission caps, and a silent hole in a live timeline is worse
+        than the memory."""
+        victim_key = None
+        victim_ts = None
+        for key, tl in self._requests.items():
+            if tl.finished and (victim_ts is None or tl.last_ts < victim_ts):
+                victim_key, victim_ts = key, tl.last_ts
+        if victim_key is not None:
+            # delete UNDER the victim's ring lock — same identity-recheck
+            # contract as the job recorder: an append either lands before
+            # the eviction or observes the removal and re-admits
+            with self._requests[victim_key].lock:
+                del self._requests[victim_key]
+            with self._stats_lock:
+                self._pending_evictions += 1
+
+    def _flush_stats(self) -> None:
+        """Drain the staged per-source event counts into the exporter
+        families.  Called on the scrape cadence (slo_tick) and from
+        every read entry point — the counters are settled whenever
+        anything observes the recorder, while the per-record hot path
+        pays one small-lock dict bump instead of a global-locked
+        label-keyed inc."""
+        with self._stats_lock:
+            if not self._pending_events and not self._pending_evictions:
+                return
+            pending, self._pending_events = self._pending_events, {}
+            evictions, self._pending_evictions = self._pending_evictions, 0
+        for source, n in pending.items():
+            metrics.SERVING_REQUEST_TIMELINE_EVENTS.inc(
+                {"source": source}, amount=n
+            )
+        if evictions:
+            metrics.SERVING_REQUEST_TIMELINE_EVICTIONS.inc(
+                amount=evictions
+            )
+
+    # ----------------------------------------------------------- SLO engine
+    def set_slo(self, job_key: str, spec: Any) -> None:
+        """Install (or clear, spec=None) a job's SLO targets.  `spec` is
+        duck-typed to api/servingjob.SLOSpec: per-axis p99 targets plus
+        objective / fast_window_s / slow_window_s / burn_threshold."""
+        with self._slo_lock:
+            if spec is None:
+                self._slo.pop(job_key, None)
+                return
+            state = self._slo.get(job_key)
+            if state is None:
+                self._slo[job_key] = _SloState(spec)
+            else:
+                # retargeting keeps the accumulated windows: the samples
+                # are ground truth regardless of where the bar sits
+                state.spec = spec
+
+    def _slo_observe(
+        self, job_key: str, rid: str, samples: Dict[str, float], ts: float,
+    ) -> None:
+        with self._slo_lock:
+            state = self._slo.get(job_key)
+            if state is None:
+                return
+            targeted = {axis for axis, _ in _spec_targets(state.spec)}
+            for axis, value in samples.items():
+                if axis in targeted:
+                    state.samples[axis].append((ts, value, rid))
+            # space finish-driven evaluations out: a burst of finishes
+            # must not rank the full windows per sample.  The gap equals
+            # the burn cooldown (fast_window/2), so it cannot lower the
+            # fire rate; worst added detection latency is one gap, and
+            # only when no scrape loop is ticking slo_tick.
+            gap = max(1.0, float(
+                getattr(state.spec, "fast_window_s", 60.0)) / 2.0)
+            if ts - state.last_eval < gap:
+                return
+            state.last_eval = ts
+        self._slo_eval(job_key, ts)
+
+    def slo_tick(self, now: Optional[float] = None) -> None:
+        """Re-evaluate every job's windows (scrape-loop cadence): burn
+        rates must decay when traffic stops, not freeze at their last
+        finish-driven value."""
+        if self.events_per_request <= 0:
+            return
+        if now is None:
+            now = self.clock()
+        self._flush_stats()
+        with self._slo_lock:
+            keys = list(self._slo)
+            for state in self._slo.values():
+                state.last_eval = now
+        for job_key in keys:
+            self._slo_eval(job_key, now)
+
+    def _slo_eval(self, job_key: str, now: float) -> None:
+        """Evaluate one job's multi-window burn rates; emissions happen
+        after the slo lock drops (they take ring locks)."""
+        emit: List[Tuple[str, Dict[str, Any], List[str]]] = []
+        with self._slo_lock:
+            state = self._slo.get(job_key)
+            if state is None:
+                return
+            spec = state.spec
+            fast_w = float(getattr(spec, "fast_window_s", 60.0))
+            slow_w = float(getattr(spec, "slow_window_s", 300.0))
+            objective = float(getattr(spec, "objective", 0.99))
+            threshold = float(getattr(spec, "burn_threshold", 1.0))
+            budget = max(1e-9, 1.0 - objective)
+            for axis, target in _spec_targets(spec):
+                dq = state.samples[axis]
+                while dq and dq[0][0] < now - slow_w:
+                    dq.popleft()
+                slow = [(v, rid) for _, v, rid in dq]
+                fast = [
+                    (v, rid) for t, v, rid in dq if t >= now - fast_w
+                ]
+                burns: Dict[str, float] = {}
+                for window, vals in (("fast", fast), ("slow", slow)):
+                    if vals:
+                        bad = sum(1 for v, _ in vals if v > target)
+                        burns[window] = (bad / len(vals)) / budget
+                    else:
+                        burns[window] = 0.0
+                    metrics.SERVING_SLO_BURN_RATE.set(
+                        burns[window],
+                        {"serving_job": job_key, "axis": axis,
+                         "window": window},
+                    )
+                    p99 = _p99([v for v, _ in vals])
+                    labels = {"serving_job": job_key, "axis": axis,
+                              "window": window}
+                    if p99 is not None and math.isfinite(p99):
+                        metrics.SERVING_SLO_WINDOW_P99.set(p99, labels)
+                    else:
+                        # censored +inf (or no samples): an absent
+                        # series IS the signal — never export inf/NaN
+                        metrics.SERVING_SLO_WINDOW_P99.remove(labels)
+                burning = (
+                    len(fast) >= _SLO_MIN_SAMPLES
+                    and len(slow) >= _SLO_MIN_SAMPLES
+                    and burns["fast"] >= threshold
+                    and burns["slow"] >= threshold
+                )
+                if not burning:
+                    continue
+                last = state.last_burn.get(axis)
+                if last is not None and now - last < fast_w / 2.0:
+                    continue  # cooldown: re-fire at most 2x per fast window
+                state.last_burn[axis] = now
+                slow_p99 = _p99([v for v, _ in slow])
+                detail = {
+                    "axis": axis,
+                    "target_s": target,
+                    "burn_fast": round(burns["fast"], 4),
+                    "burn_slow": round(burns["slow"], 4),
+                    "threshold": threshold,
+                    "window_p99_s": (
+                        round(slow_p99, 6)
+                        if slow_p99 is not None and math.isfinite(slow_p99)
+                        else None
+                    ),
+                    "samples_fast": len(fast),
+                    "samples_slow": len(slow),
+                }
+                # offenders: the fast window's violators, newest first —
+                # the requests whose timelines explain THIS burn
+                offenders: List[str] = []
+                for v, rid in reversed(fast):
+                    if v > target and rid not in offenders:
+                        offenders.append(rid)
+                    if len(offenders) >= _SLO_OFFENDERS_CAP:
+                        break
+                emit.append((axis, detail, offenders))
+        for axis, detail, offenders in emit:
+            metrics.SERVING_SLO_BURNS.inc(
+                {"serving_job": job_key, "axis": axis}
+            )
+            jr = self.job_recorder or _timeline.get_recorder()
+            jr.record(job_key, "slo", "slo_burn", dict(detail), ts=now)
+            for rid in offenders:
+                self.record(
+                    job_key, rid, "slo", "slo_burn", dict(detail), ts=now
+                )
+
+    def slo_status(self, job_key: str) -> Optional[Dict[str, Any]]:
+        """Per-axis snapshot for `describe` / debug endpoints: target,
+        both burn rates, slow-window p99 (None while censored), sample
+        counts, and whether the multi-window condition holds right now."""
+        self._flush_stats()
+        now = self.clock()
+        with self._slo_lock:
+            state = self._slo.get(job_key)
+            if state is None:
+                return None
+            spec = state.spec
+            fast_w = float(getattr(spec, "fast_window_s", 60.0))
+            slow_w = float(getattr(spec, "slow_window_s", 300.0))
+            objective = float(getattr(spec, "objective", 0.99))
+            threshold = float(getattr(spec, "burn_threshold", 1.0))
+            budget = max(1e-9, 1.0 - objective)
+            axes: Dict[str, Any] = {}
+            for axis, target in _spec_targets(spec):
+                dq = state.samples[axis]
+                slow = [v for t, v, _ in dq if t >= now - slow_w]
+                fast = [v for t, v, _ in dq if t >= now - fast_w]
+                burns = {}
+                for window, vals in (("fast", fast), ("slow", slow)):
+                    bad = sum(1 for v in vals if v > target)
+                    burns[window] = (bad / len(vals)) / budget if vals else 0.0
+                p99 = _p99(slow)
+                axes[axis] = {
+                    "target_s": target,
+                    "burn_fast": round(burns["fast"], 4),
+                    "burn_slow": round(burns["slow"], 4),
+                    "p99_s": (
+                        round(p99, 6)
+                        if p99 is not None and math.isfinite(p99)
+                        else None
+                    ),
+                    "samples": len(slow),
+                    "burning": (
+                        len(fast) >= _SLO_MIN_SAMPLES
+                        and len(slow) >= _SLO_MIN_SAMPLES
+                        and burns["fast"] >= threshold
+                        and burns["slow"] >= threshold
+                    ),
+                }
+            return {
+                "objective": objective,
+                "fast_window_s": fast_w,
+                "slow_window_s": slow_w,
+                "burn_threshold": threshold,
+                "axes": axes,
+            }
+
+    # --------------------------------------------------------------- reads
+    def jobs(self) -> List[str]:
+        self._flush_stats()
+        with self._dir_lock:
+            return sorted({job for job, _ in self._requests})
+
+    def request_ids(self, job_key: str) -> List[str]:
+        with self._dir_lock:
+            return sorted(
+                rid for job, rid in self._requests if job == job_key
+            )
+
+    @staticmethod
+    def _merged_locked(tl: _ReqTimeline) -> List[Dict[str, Any]]:
+        """Both rings interleaved back into one sequence (caller holds
+        tl.lock) — the single merge every export shares."""
+        return sorted(
+            (dict(e) for e in (*tl.events, *tl.decisions)),
+            key=lambda e: e["seq"],
+        )
+
+    @staticmethod
+    def _milestones_locked(tl: _ReqTimeline) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        base = tl.submitted_ts
+        for name, ts in (
+            ("submitted", tl.submitted_ts),
+            ("dispatched", tl.dispatched_ts),
+            ("admitted", tl.admitted_ts),
+            ("first_token", tl.first_token_ts),
+            ("finished", tl.finished_ts),
+        ):
+            if ts is not None:
+                out[f"{name}_t"] = ts
+                if base is not None and name != "submitted":
+                    out[f"{name}_rel_s"] = round(ts - base, 6)
+        if tl.tokens is not None:
+            out["tokens"] = tl.tokens
+        return out
+
+    def _summary_locked(self, tl: _ReqTimeline) -> Dict[str, Any]:
+        return {
+            "request": tl.rid,
+            "finished": tl.finished,
+            "dropped": tl.dropped,
+            "attempts": tl.attempts,
+            "records": len(tl.events) + len(tl.decisions),
+            "milestones": self._milestones_locked(tl),
+        }
+
+    def requests(self, job_key: str) -> List[Dict[str, Any]]:
+        """Summaries of every tracked request of one job, ordered by
+        submit time (the /debug/requests/<ns>/<name> payload)."""
+        self._flush_stats()
+        with self._dir_lock:
+            keys = sorted(k for k in self._requests if k[0] == job_key)
+        out = []
+        for key in keys:
+            tl = self._requests.get(key)
+            if tl is None:
+                continue
+            with tl.lock:
+                out.append(self._summary_locked(tl))
+        out.sort(
+            key=lambda s: (
+                s["milestones"].get("submitted_t", 0.0), s["request"],
+            )
+        )
+        return out
+
+    def request_timeline(
+        self, job_key: str, request_id: str
+    ) -> Optional[Dict[str, Any]]:
+        """One request's full merged timeline as a JSON-ready dict, or
+        None when it was never recorded (or has been evicted)."""
+        self._flush_stats()
+        tl = self._requests.get((job_key, str(request_id)))
+        if tl is None:
+            return None
+        with tl.lock:
+            return {
+                "job": tl.job_key,
+                "request": tl.rid,
+                "finished": tl.finished,
+                "dropped": tl.dropped,
+                "attempts": tl.attempts,
+                "milestones": self._milestones_locked(tl),
+                "events": self._merged_locked(tl),
+            }
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Every tracked timeline (the SIGUSR1 / --trace-dump payload)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for job_key in self.jobs():
+            reqs = {
+                rid: tl
+                for rid in self.request_ids(job_key)
+                if (tl := self.request_timeline(job_key, rid)) is not None
+            }
+            out[job_key] = {
+                "requests": reqs,
+                "slo": self.slo_status(job_key),
+            }
+        return {"jobs": out}
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_dict(), fh)
+
+    # -------------------------------------------------------------- export
+    def chrome_events(
+        self, per_request: Optional[int] = None
+    ) -> List[Dict[str, Any]]:
+        """One Chrome-trace lane per request, merged into /debug/traces
+        beside the reconcile spans, serving lanes, and job timelines
+        (cat "request"): records carrying a duration (prefill chunks)
+        render as complete events, the rest as instants, and each lane
+        is named after its job + request id.  `per_request` keeps only
+        each lane's newest N records — ?limit=N must bound the request
+        recorder's contribution too."""
+        self._flush_stats()
+        events: List[Dict[str, Any]] = []
+        pid = os.getpid()
+        with self._dir_lock:
+            items = sorted(self._requests.items())
+        for lane, (key, tl) in enumerate(items, start=_LANE_TID_BASE + 1):
+            with tl.lock:
+                snapshot = self._merged_locked(tl)
+            if per_request is not None and per_request >= 0:
+                snapshot = snapshot[-per_request:] if per_request > 0 else []
+            if not snapshot:
+                continue
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": lane,
+                "args": {"name": f"req {key[0]} {key[1]}"},
+            })
+            for e in snapshot:
+                args = {"source": e["source"], "seq": e["seq"],
+                        **(e["detail"] or {})}
+                if "attempt" in e:
+                    args["attempt"] = e["attempt"]
+                dur = (e["detail"] or {}).get("duration")
+                base = {
+                    "name": e["event"], "cat": "request",
+                    "ts": e["t"] * 1e6, "pid": pid, "tid": lane,
+                    "args": args,
+                }
+                if isinstance(dur, (int, float)) and dur > 0:
+                    events.append({
+                        **base, "ph": "X", "ts": (e["t"] - dur) * 1e6,
+                        "dur": dur * 1e6,
+                    })
+                else:
+                    events.append({**base, "ph": "i", "s": "t"})
+        return events
+
+
+# disabled until an operator configures one (cmd/manager.
+# build_request_recorder): the fallback the health endpoints and the
+# in-process CLI read when no explicit recorder was injected — mirrors
+# timeline.get_recorder()
+_GLOBAL = RequestRecorder(events_per_request=0)
+
+
+def get_recorder() -> RequestRecorder:
+    return _GLOBAL
+
+
+def set_recorder(recorder: RequestRecorder) -> None:
+    """Register the process's request recorder (one per process, like
+    the job recorder) so /debug endpoints and the in-process CLI find it
+    without explicit wiring."""
+    global _GLOBAL
+    _GLOBAL = recorder

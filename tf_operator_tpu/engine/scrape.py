@@ -321,10 +321,15 @@ class ScrapeLoop:
         clock: Callable[[], float] = time.time,
         backoff_max_s: float = 30.0,
         transport_factory: Optional[Callable] = None,
+        reqrecorder=None,
     ) -> None:
         self.targets = targets
         self.autoscaler = autoscaler
         self.router_of = router_of
+        # request recorder (engine/reqtrace.py) whose SLO windows tick
+        # with the scrape cadence — burn rates must decay when traffic
+        # stops, not freeze at their last fed value
+        self.reqrecorder = reqrecorder
         self.interval = float(interval)
         self.timeout = float(timeout)
         self.clock = clock
@@ -591,6 +596,8 @@ class ScrapeLoop:
             servefleet.note_scrape(
                 target.job_key, target.replica, age, state.failures
             )
+        if self.reqrecorder is not None and self.reqrecorder.enabled:
+            self.reqrecorder.slo_tick(now)
         return ok
 
     def scrape_age(self, job_key: str, replica: str) -> Optional[float]:

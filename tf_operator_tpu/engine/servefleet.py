@@ -267,11 +267,16 @@ class FleetAutoscaler:
         recorder=None,
         cooldown_s: Optional[float] = None,
         drain_timeout_s: float = 30.0,
+        reqrecorder=None,
     ) -> None:
         self.cluster = cluster
         self.interval = float(interval)
         self.clock = clock
         self.recorder = recorder
+        # request recorder (engine/reqtrace.py): each tick pushes every
+        # job's `spec.slo` into it so the burn-rate engine always judges
+        # against the CURRENT spec, and clears it when the spec drops it
+        self.reqrecorder = reqrecorder
         self.cooldown_s = (
             cooldown_s if cooldown_s is not None else max(5.0, 2 * interval)
         )
@@ -349,6 +354,8 @@ class FleetAutoscaler:
             self._policies.pop(job_key, None)
             self._draining.pop(job_key, None)
             self._drain_since.pop(job_key, None)
+        if self.reqrecorder is not None:
+            self.reqrecorder.set_slo(job_key, None)
         _drop_fleet_status(job_key)
 
     def _queue_wait_p99(self, job_key: str, now: float) -> float:
@@ -433,6 +440,13 @@ class FleetAutoscaler:
         auto = servingapi.AutoscaleSpec.from_dict(
             (job.get("spec") or {}).get("autoscale")
         )
+        if self.reqrecorder is not None and self.reqrecorder.enabled:
+            self.reqrecorder.set_slo(
+                job_key,
+                servingapi.SLOSpec.from_dict(
+                    (job.get("spec") or {}).get("slo")
+                ),
+            )
         replicas = self._replicas_of(job)
         now = self.clock()
         with self._lock:

@@ -92,6 +92,10 @@ _DECISION_SOURCES = frozenset({
     # records that explain why dispatch changed shape under failure,
     # each carrying the trigger metric, observed value, and threshold
     "router",
+    # SLO burn-rate engine (engine/reqtrace.py): slo_burn — both burn
+    # windows of a latency axis crossed the configured threshold, the
+    # record carrying the axis, window burn rates, and observed p99
+    "slo",
 })
 # controller events that are routine cadence, not decisions: a job
 # parked in a long crash-loop backoff window re-records its wait every

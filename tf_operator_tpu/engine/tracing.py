@@ -169,7 +169,9 @@ class Tracer:
         loadable in chrome://tracing and Perfetto.
 
         `category` keeps only spans whose `cat` matches (reconcile vs
-        serving traces share one ring but are separable); `limit` keeps
+        serving traces share one ring but are separable; /debug/traces
+        additionally merges per-job "timeline" lanes and per-request
+        "request" lanes under the same axis); `limit` keeps
         only the most recent N root traces — the /debug/traces query
         filters, so a dashboard can pull \"last 5 serving traces\" without
         downloading the whole ring.  With both given, the category

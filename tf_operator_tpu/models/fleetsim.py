@@ -88,6 +88,12 @@ class SimReplica:
         self.rid = rid
         self.cfg = cfg
         self.alive = True
+        # request flight-recorder seam (engine/reqtrace.py), attached by
+        # FleetHarness._add_replica: admission / memory-gate / prefill /
+        # first-token records land on the owning request's timeline.
+        # Never writes the harness log — byte-identity holds either way.
+        self.reqtrace = None
+        self.job_key = ""
         # frozen = the SIGSTOP of serving: accepts dispatch (enqueue
         # still lands), keeps heartbeating its last-known telemetry,
         # but admits/prefills/decodes NOTHING — the straggler regime
@@ -105,6 +111,14 @@ class SimReplica:
         self.new_queue_waits: List[float] = []
 
     # ------------------------------------------------------------- intake
+    def _rrecord(
+        self, request_id: str, event: str, detail: dict, ts: float,
+    ) -> None:
+        if self.reqtrace is not None and self.job_key:
+            self.reqtrace.record(
+                self.job_key, request_id, "replica", event, detail, ts=ts,
+            )
+
     def enqueue(self, req: ServeRequest, arrival_t: float) -> None:
         self.queue.append((req, arrival_t))
 
@@ -112,7 +126,7 @@ class SimReplica:
         return len(self.queue) + len(self.lanes)
 
     # ------------------------------------------------------------- service
-    def _admit(self, now: float) -> None:
+    def _admit(self, now: float, record_t: float) -> None:
         admitted_any = False
         while self.queue and len(self.lanes) < self.cfg.slots:
             req, arrival_t = self.queue[0]
@@ -123,18 +137,30 @@ class SimReplica:
                     # sample per service iteration, like the serve loop
                     self.blocked_total += 1
                     self._last_blocked_t = now
+                    self._rrecord(req.rid, "memory_gate_block", {
+                        "replica": self.rid, "blocks": blocks,
+                        "free_blocks": self.free_blocks,
+                    }, record_t)
                 break
             self.queue.popleft()
             self.free_blocks -= blocks
             self.lanes.append(_Lane(req, arrival_t, now, blocks))
             self.new_queue_waits.append(max(0.0, now - arrival_t))
+            self._rrecord(req.rid, "admitted", {
+                "replica": self.rid,
+                "queue_wait_s": round(max(0.0, now - arrival_t), 6),
+            }, record_t)
             admitted_any = True
 
     def step(self, now: float, dt: float) -> List[dict]:
         """Advance dt seconds; returns completion records."""
         if not self.alive or self.frozen:
             return []
-        self._admit(now)
+        # request-timeline stamps use the step's END (now + dt): the
+        # harness steps replicas over [clock - dt, clock), so the end is
+        # the same instant the router stamps its own records with — a
+        # same-tick dispatch -> admit pair must not read time-reversed
+        self._admit(now, now + dt)
         done: List[dict] = []
         # ONE sequential prefill channel: the earliest-admitted lane
         # still prefilling gets the whole budget (serve_loop prefills
@@ -146,6 +172,17 @@ class SimReplica:
             used = min(lane.prefill_left, budget)
             lane.prefill_left -= used
             budget -= used
+            if lane.prefill_left <= 0:
+                # one record at prefill completion (not per chunk — a
+                # long prompt would flood the routine ring), carrying
+                # the whole prefill as a duration for the trace lane
+                self._rrecord(lane.req.rid, "prefill_chunk", {
+                    "replica": self.rid,
+                    "tokens": int(lane.req.prompt_len),
+                    "duration": round(
+                        lane.req.prompt_len / self.cfg.prefill_tps, 6
+                    ),
+                }, now + dt)
         # decode: every prefilled lane emits tokens
         for lane in list(self.lanes):
             if lane.prefill_left > 0:
@@ -153,6 +190,9 @@ class SimReplica:
             lane.tokens_out += self.cfg.decode_tps * dt
             if lane.first_token_t is None and lane.tokens_out >= 1.0:
                 lane.first_token_t = now + dt
+                self._rrecord(lane.req.rid, "first_token", {
+                    "replica": self.rid,
+                }, now + dt)
             if lane.tokens_out >= lane.req.max_new:
                 self.lanes.remove(lane)
                 self.free_blocks += lane.blocks
@@ -166,7 +206,7 @@ class SimReplica:
                     "replica": self.rid,
                 })
         if done:
-            self._admit(now)
+            self._admit(now, now + dt)
         return done
 
     # ------------------------------------------------------------ telemetry
@@ -258,6 +298,8 @@ class FleetHarness:
         hedge_floor_s: float = 1.0,
         recorder=None,
         job_key: str = "",
+        reqtrace=None,
+        slo=None,
     ) -> None:
         """`injector` composes the request-plane chaos (scrape storms,
         replica freeze, kill-mid-decode): the harness adopts the
@@ -267,7 +309,13 @@ class FleetHarness:
         failure machinery (both OFF by default so every pre-existing
         trace — BENCH_r13, the PR 14 soaks — replays byte-identically);
         `recorder`/`job_key` land the router's degraded/ejection/hedge
-        DECISIONs on the owning job's timeline."""
+        DECISIONs on the owning job's timeline; `reqtrace` (an
+        engine/reqtrace.RequestRecorder) additionally gives every
+        request its own causal timeline — router verdicts plus the
+        replicas' admission/prefill/first-token records — and `slo`
+        (api/servingjob.SLOSpec) arms the recorder's burn-rate engine
+        for `job_key`.  All recording is off the log path: the seeded
+        event log is byte-identical with or without them."""
         self.mode = mode
         self.cfg = replica_cfg or ReplicaConfig()
         self.injector = injector
@@ -303,6 +351,11 @@ class FleetHarness:
         )
         self.router.recorder = recorder
         self.router.job_key = job_key
+        self.reqtrace = reqtrace
+        self.job_key = job_key
+        self.router.reqtrace = reqtrace
+        if reqtrace is not None and slo is not None and job_key:
+            reqtrace.set_slo(job_key, slo)
         self.log = self.router.events  # one merged deterministic log
         self.replicas: Dict[str, SimReplica] = {}
         self._next_idx = 0
@@ -348,6 +401,8 @@ class FleetHarness:
         rid = f"r{self._next_idx}"
         self._next_idx += 1
         self.replicas[rid] = SimReplica(rid, cfg)
+        self.replicas[rid].reqtrace = self.reqtrace
+        self.replicas[rid].job_key = self.job_key
         self.router.add_replica(rid, state=STARTING)
         if ready_now:
             hb = self.replicas[rid].heartbeat()
@@ -505,7 +560,9 @@ class FleetHarness:
                     continue
                 self.replica_seconds += self.dt
                 for rec in replica.step(now - self.dt, self.dt):
-                    if self.router.finish(rid, rec["rid"]):
+                    if self.router.finish(
+                        rid, rec["rid"], tokens=rec["tokens"]
+                    ):
                         self.results[rec["rid"]] = rec
                     else:
                         self.duplicates += 1
@@ -557,6 +614,18 @@ class FleetHarness:
             if self.policy is not None and now >= next_scale:
                 next_scale = now + self.autoscale_interval_s
                 self._autoscale_tick(now)
+        if self.reqtrace is not None and self.job_key:
+            # the horizon expired on every unfinished request: a `drop`
+            # DECISION closes its timeline (and feeds the SLO windows a
+            # censored +inf — a drop IS the worst latency, not a
+            # missing sample)
+            now = self.clock()
+            for req_id in sorted(self.arrival_t):
+                if req_id not in self.results:
+                    self.reqtrace.record(
+                        self.job_key, req_id, "router", "drop",
+                        {"reason": "horizon"}, ts=now,
+                    )
         return self.summary(n_total)
 
     # ------------------------------------------------------------- scoring

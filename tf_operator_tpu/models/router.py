@@ -265,6 +265,17 @@ class FleetRouter:
         # decisions land on its timeline as DECISION records
         self.recorder = None
         self.job_key = ""
+        # request flight-recorder seam (engine/reqtrace.py): every
+        # routing verdict about an individual request lands on THAT
+        # request's timeline — submit/queue/dispatch/hedge/redispatch/
+        # finish.  Never writes self.events (the byte-identity surface).
+        self.reqtrace = None
+        # progress pre-filter: request id -> last ts FORWARDED to the
+        # recorder.  The fleet sim reports progress every step per lane,
+        # and even a rate-limited-out record() pays a ring lock — gate
+        # the chatter here with one dict probe.  The recorder's own
+        # per-(request, replica) limit stays authoritative.
+        self._progress_noted: Dict[str, float] = {}
 
     # ------------------------------------------------------------- helpers
     def _log(self, line: str) -> None:
@@ -274,6 +285,18 @@ class FleetRouter:
         if self.recorder is not None and self.job_key:
             self.recorder.record(
                 self.job_key, "router", event, detail, ts=self.clock()
+            )
+
+    def _rrecord(
+        self, request_id: str, event: str, detail: Optional[Dict] = None,
+    ) -> None:
+        """Request flight-recorder seam: one record on `request_id`'s
+        own timeline.  Never touches self.events — the seeded chaos log
+        stays byte-identical with the recorder on or off."""
+        if self.reqtrace is not None and self.job_key:
+            self.reqtrace.record(
+                self.job_key, request_id, "router", event, detail,
+                ts=self.clock(),
             )
 
     def _gauge_states(self) -> None:
@@ -361,6 +384,10 @@ class FleetRouter:
             f"hedge_{'won' if won else 'lost'} req={request_id} "
             f"via={delivered_by if delivered_by is not None else dead_rid}"
         )
+        self._rrecord(
+            request_id, "hedge_won" if won else "hedge_lost",
+            {"via": delivered_by if delivered_by is not None else dead_rid},
+        )
 
     def _holders(self, request_id: str) -> List[str]:
         """Replicas currently holding `request_id` in flight (one, or
@@ -422,10 +449,15 @@ class FleetRouter:
                     f"redispatch_skipped req={req.rid} "
                     f"covered_by={covered[0]}"
                 )
+                self._rrecord(
+                    req.rid, "redispatch_skipped",
+                    {"from": r.rid, "covered_by": covered[0]},
+                )
                 continue
             self._note_redispatch(req.rid)
             metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "redispatch"})
             self._log(f"redispatch req={req.rid} from={r.rid}")
+            self._rrecord(req.rid, "redispatch", {"from": r.rid})
             self._place(req)
             n += 1
         return n
@@ -567,6 +599,10 @@ class FleetRouter:
             self.degraded = False
             self._log(f"router_recovered replica={rid}")
             self._record("router_recovered", {"replica": rid})
+            for req in self._queue:
+                self._rrecord(
+                    req.rid, "degraded_exit", {"recovered_by": rid}
+                )
             self._publish_router_state()
         if (
             was_degraded
@@ -627,6 +663,7 @@ class FleetRouter:
             r.debit_count = max(0, r.debit_count - 1)
         r.consec_failures += 1
         self._log(f"dispatch_failed req={request_id} replica={rid}")
+        self._rrecord(request_id, "dispatch_failed", {"replica": rid})
         self._maybe_eject(r, "dispatch_failures")
         # re-place only a request that is neither delivered nor covered:
         # a hedge copy whose dispatch failure is reported AFTER the
@@ -784,6 +821,13 @@ class FleetRouter:
                     "threshold": self.health_interval,
                     "replicas": len(ready_stale),
                 })
+                # the queued requests are the ones whose dispatch shape
+                # just changed (round-robin until recovery): each gets
+                # the DECISION on its own timeline
+                for req in self._queue:
+                    self._rrecord(req.rid, "degraded_entry", {
+                        "replicas_stale": len(ready_stale),
+                    })
                 self._publish_router_state()
         else:
             for r in live:
@@ -813,6 +857,10 @@ class FleetRouter:
         r = self._replicas.get(rid)
         t0 = r.dispatched_at.get(request_id) if r is not None else None
         self._note_first_token_id(request_id)
+        # no request-timeline record here: the replica seam already
+        # stamps `first_token` at the step it was produced (earlier and
+        # with the same replica attribution) — a second copy per
+        # request buys nothing and costs a ring write on the hot path
         if t0 is not None:
             self._ttfts.append(self.clock() - t0)
 
@@ -824,7 +872,20 @@ class FleetRouter:
         prefill that never starts."""
         r = self._replicas.get(rid)
         if r is not None and request_id in r.inflight:
-            r.last_progress[request_id] = self.clock()
+            now = self.clock()
+            r.last_progress[request_id] = now
+            if self.reqtrace is not None and self.job_key:
+                # forward at most ~1/s per request: the recorder
+                # rate-limits too (per request AND replica), but the
+                # pre-filter keeps the per-step chatter from even
+                # reaching its ring locks
+                last = self._progress_noted.get(request_id)
+                if last is None or now - last >= 1.0:
+                    self._progress_noted[request_id] = now
+                    self.reqtrace.record(
+                        self.job_key, request_id, "router", "progress",
+                        {"replica": rid}, ts=now,
+                    )
 
     def hedge_threshold(self) -> Optional[float]:
         """Ceil-rank p99 of recent TTFTs, floor-clamped; None while too
@@ -916,12 +977,24 @@ class FleetRouter:
                     "value": round(now - anchor, 4),
                     "threshold": round(thr, 4),
                 })
+                self._rrecord(req_id, "hedge_issued", {
+                    "from": rid, "to": sibling,
+                    "waited_s": round(now - anchor, 4),
+                    "threshold_s": round(thr, 4),
+                })
                 self._dispatch(req, sibling, reason="hedge")
 
     # ------------------------------------------------------------- dispatch
     def submit(self, request: ServeRequest) -> Optional[str]:
         """Route one request: returns the chosen replica id, or None when
-        it parked in the router queue (dispatched later by pump())."""
+        it parked in the router queue (dispatched later by pump()).  The
+        request id is minted here as far as the flight recorder is
+        concerned: `submitted` opens the timeline every later plane's
+        records join."""
+        self._rrecord(request.rid, "submitted", {
+            "prompt_len": request.prompt_len, "max_new": request.max_new,
+            "blocks": request.blocks(self.block_size),
+        })
         return self._place(request)
 
     def _reject_oversized(self, request: ServeRequest) -> bool:
@@ -946,6 +1019,9 @@ class FleetRouter:
             f"reject req={request.rid} "
             f"blocks={request.blocks(self.block_size)} cap={cap}"
         )
+        self._rrecord(request.rid, "rejected", {
+            "blocks": request.blocks(self.block_size), "cap": cap,
+        })
         return True
 
     def _place(
@@ -964,6 +1040,9 @@ class FleetRouter:
             self._queue.append(request)
             metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "queued"})
             self._log(f"queue req={request.rid} depth={len(self._queue)}")
+            self._rrecord(
+                request.rid, "queued", {"depth": len(self._queue)}
+            )
             self._queue_gauge()
             return None
         self._dispatch(request, rid)
@@ -982,6 +1061,9 @@ class FleetRouter:
         )
         metrics.SERVING_ROUTER_DISPATCH.inc({"reason": reason})
         self._log(f"dispatch req={request.rid} replica={rid}")
+        self._rrecord(
+            request.rid, "dispatched", {"replica": rid, "reason": reason}
+        )
         if self.on_dispatch is not None:
             self.on_dispatch(request, rid, reason)
 
@@ -1064,7 +1146,9 @@ class FleetRouter:
             self._queue_gauge()
         return n
 
-    def finish(self, rid: str, request_id: str) -> bool:
+    def finish(
+        self, rid: str, request_id: str, tokens: Optional[int] = None,
+    ) -> bool:
         """A replica reports a completed request.  Returns True when this
         is the FIRST completion of the id (deliver it); a duplicate from
         a recovered replica whose requests were re-dispatched — or the
@@ -1072,20 +1156,39 @@ class FleetRouter:
         delivery).  The completion decrements in-flight on the replica
         that REPORTED it, never on the other holder: a hedge loser
         completing after the winner frees its own slot while the
-        winner's books stay untouched."""
+        winner's books stay untouched.  `tokens` (generated count, when
+        the caller knows it) rides the request timeline's `finished`
+        record so the SLO engine can derive TPOT."""
         r = self._replicas.get(rid)
         if r is not None:
             r.inflight.pop(request_id, None)
             r.dispatched_at.pop(request_id, None)
             r.last_progress.pop(request_id, None)
+        self._progress_noted.pop(request_id, None)
+        if len(self._progress_noted) > 4 * self.ledger_cap:
+            # insertion-ordered dict: the oldest half belongs to
+            # requests that terminated without a completion (horizon
+            # drops) — shed them so the pre-filter stays bounded
+            for stale in list(self._progress_noted)[: 2 * self.ledger_cap]:
+                del self._progress_noted[stale]
         if request_id in self._completed:
             self._log(f"duplicate_completion req={request_id} replica={rid}")
+            self._rrecord(
+                request_id, "duplicate_completion", {"replica": rid}
+            )
             # the duplicate still freed a dispatch slot on `rid`: pump
             # the queue into it instead of waiting for the next event
             self.pump()
             return False
         self._note_completed(request_id)
+        # settle any open hedge race BEFORE stamping `finished`: the
+        # timeline reads submit -> dispatch -> hedge_issued -> won/lost
+        # -> finished, the order the decisions actually resolved in
         self._drop_hedge_entry(request_id, delivered_by=rid)
+        detail: Dict = {"replica": rid}
+        if tokens is not None:
+            detail["tokens"] = int(tokens)
+        self._rrecord(request_id, "finished", detail)
         self.pump()
         return True
 

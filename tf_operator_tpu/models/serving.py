@@ -1108,8 +1108,10 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     if not pool.can_alloc(private_i):
                         # gate: wait for a finish to free blocks (the
                         # upfront validation guarantees an empty pool
-                        # always fits the head, so this cannot hang)
-                        tel.admission_blocked_on_memory()
+                        # always fits the head, so this cannot hang) —
+                        # the held FIFO head's index rides along so the
+                        # request recorder can pin the block on it
+                        tel.admission_blocked_on_memory(ridx)
                         break
                     queue.popleft()
                     own = pool.alloc(private_i)

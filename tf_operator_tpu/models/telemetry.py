@@ -51,6 +51,7 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 from tf_operator_tpu.engine import metrics as em
+from tf_operator_tpu.engine import reqtrace as rt
 from tf_operator_tpu.engine.tracing import Span, Tracer, get_tracer
 
 
@@ -186,8 +187,27 @@ class ServeTelemetry:
     process-global tracer.  Metric families are registry-level and
     shared — concurrent serve loops aggregate, as scrape targets do."""
 
-    def __init__(self, tracer: Optional[Tracer] = None) -> None:
+    def __init__(
+        self,
+        tracer: Optional[Tracer] = None,
+        reqtrace: Optional["rt.RequestRecorder"] = None,
+        job_key: str = "local/serve",
+        request_ids: Optional[List[str]] = None,
+    ) -> None:
         self.tracer = tracer or get_tracer()
+        # request flight-recorder seam (engine/reqtrace.py): the serving
+        # plane's records (admitted / prefill_chunk / first_token /
+        # finished / memory_gate_block) land on per-request timelines.
+        # Defaults to the process-global recorder (disabled unless the
+        # operator enabled it), under the well-known `local/serve` key a
+        # standalone serve_loop has no TPUServingJob to replace with;
+        # front-ends pass their own recorder + the owning job's key.
+        # `request_ids` maps the loop's request INDEX to the fleet-wide
+        # request id, so a dispatched request's serving records join the
+        # timeline the router opened at submit.
+        self.reqtrace = reqtrace if reqtrace is not None else rt.get_recorder()
+        self.job_key = job_key
+        self.request_ids = list(request_ids) if request_ids else None
         self._reqs: Dict[int, _RequestTimeline] = {}
         self._done: List[_RequestTimeline] = []
         self._slots = 0
@@ -213,6 +233,20 @@ class ServeTelemetry:
         """Epoch seconds for a perf_counter reading, via the single
         anchor pair sampled at loop start (see _RequestTimeline)."""
         return self._wall0 + (pc - (self._started_pc or pc))
+
+    def _rid(self, index: int) -> str:
+        if self.request_ids is not None and index < len(self.request_ids):
+            return self.request_ids[index]
+        return f"req{index}"
+
+    def _rrecord(
+        self, index: int, event: str, detail: Dict[str, Any], pc: float,
+    ) -> None:
+        if self.reqtrace is not None and self.reqtrace.enabled:
+            self.reqtrace.record(
+                self.job_key, self._rid(index), "serving", event, detail,
+                ts=self._wall(pc),
+            )
 
     # --------------------------------------------------------- lifecycle
     def loop_started(self, n_requests: int, slots: int,
@@ -246,6 +280,7 @@ class ServeTelemetry:
         self._spec = speculative
         for i in range(n_requests):
             self._reqs[i] = _RequestTimeline(i, self._started_pc)
+            self._rrecord(i, "queued", {"slots": slots}, self._started_pc)
 
     # ------------------------------------------------------ paged cache
     def pool_configured(self, total_blocks: int, block_size: int,
@@ -276,11 +311,18 @@ class ServeTelemetry:
             self._prefix_hits += n
             em.SERVING_PREFIX_BLOCK_HITS.inc(amount=n)
 
-    def admission_blocked_on_memory(self) -> None:
+    def admission_blocked_on_memory(self, index: Optional[int] = None) -> None:
         """One serve-loop iteration had a free lane and a queued request
-        but the pool could not cover the request's worst case."""
+        but the pool could not cover the request's worst case.  `index`
+        (when the caller knows which request held the FIFO head) lands a
+        memory_gate_block DECISION on that request's timeline."""
         self._adm_blocked += 1
         em.SERVING_ADMISSION_BLOCKED.inc()
+        if index is not None:
+            self._rrecord(
+                index, "memory_gate_block",
+                {"pool_blocks": self._pool_total}, time.perf_counter(),
+            )
 
     def window_blocks_evicted(self, n: int) -> None:
         """Sliding-window rotation retired n block epochs: the modular
@@ -298,6 +340,9 @@ class ServeTelemetry:
         r.admitted_pc = time.perf_counter()
         r.slot = slot
         em.SERVING_QUEUE_WAIT.observe(r.queue_wait_s())
+        self._rrecord(index, "admitted", {
+            "slot": slot, "queue_wait_s": round(r.queue_wait_s(), 6),
+        }, r.admitted_pc)
 
     @contextmanager
     def prefill_segment(self, index: int, tok_start: int, tok_end: int):
@@ -314,6 +359,10 @@ class ServeTelemetry:
             r.prefill_s += dt
             self._prefill_s += dt
             em.SERVING_PREFILL_TIME.inc(amount=dt)
+            self._rrecord(index, "prefill_chunk", {
+                "token_start": tok_start, "token_end": tok_end,
+                "duration": round(dt, 6),
+            }, pc + dt)
 
     def request_activated(self, index: int, step: int) -> None:
         """First token sampled, lane live: TTFT is measurable."""
@@ -321,6 +370,9 @@ class ServeTelemetry:
         r.first_token_pc = time.perf_counter()
         r.admitted_at_step = step
         em.SERVING_TTFT.observe(r.ttft_s())
+        self._rrecord(index, "first_token", {
+            "step": step, "ttft_s": round(r.ttft_s(), 6),
+        }, r.first_token_pc)
 
     @contextmanager
     def decode_block(self, busy_lanes: int, blocks_used: Optional[int] = None):
@@ -371,6 +423,10 @@ class ServeTelemetry:
             em.SERVING_ACCEPTED_DRAFTS.inc(labels, r.accepted_drafts)
             em.SERVING_PROPOSED_DRAFTS.inc(labels, r.proposed_drafts)
         self._done.append(r)
+        self._rrecord(index, "finished", {
+            "tokens": r.tokens, "slot": r.slot,
+            "e2e_s": round(r.e2e_latency_s(), 6),
+        }, r.finished_pc)
         self.tracer.record(self._request_span(r))
 
     # ------------------------------------------------------------- spans

@@ -11,6 +11,7 @@ common verbs into one command:
   tpu-jobs describe tfjob mnist            # conditions, replicas, events
   tpu-jobs events tfjob mnist              # kubectl-get-events analog
   tpu-jobs timeline default mnist [--json] # the job's flight-recorder story
+  tpu-jobs requests default llm [--json]   # per-request serving timelines
   tpu-jobs list tpujob [-n ns]
   tpu-jobs wait tfjob mnist --timeout 600  # block until terminal
   tpu-jobs logs tfjob mnist [--replica-type Worker] [--index 0]
@@ -124,14 +125,17 @@ class Cli:
     """Verb dispatcher bound to a cluster backend (injectable for tests).
 
     `recorder` is the job flight recorder (engine/timeline.py) the
-    `timeline` verb and describe's SLO summary read; None falls back to
-    the process-global recorder, which an in-process operator registers
-    and which is otherwise disabled (the verbs then say so instead of
-    guessing)."""
+    `timeline` verb and describe's SLO summary read; `reqrecorder` is
+    the request flight recorder (engine/reqtrace.py) the `requests`
+    verb and describe's serving-SLO burn summary read.  None falls back
+    to the process-global recorders, which an in-process operator
+    registers and which are otherwise disabled (the verbs then say so
+    instead of guessing)."""
 
-    def __init__(self, cluster, recorder=None) -> None:
+    def __init__(self, cluster, recorder=None, reqrecorder=None) -> None:
         self.cluster = cluster
         self.recorder = recorder
+        self.reqrecorder = reqrecorder
 
     def client(self, kind: str) -> JobClient:
         return JobClient(self.cluster, kind=kind)
@@ -142,6 +146,13 @@ class Cli:
         from tf_operator_tpu.engine import timeline as timeline_mod
 
         return timeline_mod.get_recorder()
+
+    def _reqrecorder(self):
+        if self.reqrecorder is not None:
+            return self.reqrecorder
+        from tf_operator_tpu.engine import reqtrace as reqtrace_mod
+
+        return reqtrace_mod.get_recorder()
 
     # ----------------------------------------------------------- verbs
     def submit(self, path: str, namespace: str, apply: bool = False) -> int:
@@ -280,6 +291,7 @@ class Cli:
                 print(line)
         if kind == "TPUServingJob":
             self._describe_fleet(job, namespace, name)
+            self._describe_serving_slo(namespace, name)
         conds = status.get("conditions", []) or []
         if conds:
             print("Conditions:")
@@ -359,6 +371,90 @@ class Cli:
         if last:
             print(f"  last-scale: dir={last['dir']} {last['detail']} "
                   f"t={last['t']:g}")
+
+    def _describe_serving_slo(self, namespace: str, name: str) -> None:
+        """Two-line serving-SLO summary for describe, from the request
+        recorder's windowed burn-rate engine (engine/reqtrace.py).
+        Prints nothing — byte-identical to the pre-recorder describe —
+        when the recorder is off or the job declares no spec.slo."""
+        rec = self._reqrecorder()
+        if not rec.enabled:
+            return
+        st = rec.slo_status(f"{namespace}/{name}")
+        if not st or not st.get("axes"):
+            return
+        axes = st["axes"]
+        print("  slo (p99 targets, objective "
+              f"{st['objective']:g}): " + "  ".join(
+                  f"{axis}={axes[axis]['target_s']:g}s"
+                  + (f" (now {axes[axis]['p99_s']:g}s)"
+                     if axes[axis]["p99_s"] is not None else "")
+                  for axis in sorted(axes)
+              ))
+        print(f"  burn ({st['fast_window_s']:g}s/"
+              f"{st['slow_window_s']:g}s windows): " + "  ".join(
+                  f"{axis}={axes[axis]['burn_fast']:g}x/"
+                  f"{axes[axis]['burn_slow']:g}x"
+                  + (" BURNING" if axes[axis]["burning"] else "")
+                  for axis in sorted(axes)
+              ))
+
+    def requests(self, namespace: str, name: str,
+                 as_json: bool = False) -> int:
+        """Render one serving job's request timelines
+        (engine/reqtrace.py) — every tracked request as an aligned,
+        time-ordered table (timestamps relative to the request's own
+        submit, attempt column, event, one-line detail), or the raw
+        recorder JSON with --json.  The payloads are the ones
+        /debug/requests/<ns>/<name>[/<rid>] serves."""
+        rec = self._reqrecorder()
+        if not rec.enabled:
+            print(
+                "error: request recorder is disabled "
+                "(--reqtrace-events-per-request 0, or not running in "
+                "the operator process)",
+                file=sys.stderr,
+            )
+            return 1
+        job_key = f"{namespace}/{name}"
+        summaries = rec.requests(job_key)
+        docs = [
+            d for s in summaries
+            if (d := rec.request_timeline(job_key, s["request"]))
+            is not None
+        ]
+        if not docs:
+            print(f"error: no request timelines for {job_key}",
+                  file=sys.stderr)
+            return 1
+        if as_json:
+            print(json.dumps(
+                {"job": job_key, "requests": docs,
+                 "slo": rec.slo_status(job_key)},
+                indent=2, sort_keys=True,
+            ))
+            return 0
+        print(f"Job:       {job_key}  ({len(docs)} request(s))")
+        for doc in docs:
+            events = doc.get("events") or []
+            state = (
+                "dropped" if doc["dropped"]
+                else "finished" if doc["finished"] else "in-flight"
+            )
+            print(f"\nRequest {doc['request']}  [{state}, "
+                  f"{doc['attempts']} attempt(s)]")
+            if not events:
+                print("  No records.")
+                continue
+            base = events[0]["t"]
+            print(f"{'TIME':>10}  {'ATT':<5}{'EVENT':<18}DETAIL")
+            for e in events:
+                att = e.get("attempt")
+                print(f"{e['t'] - base:>+9.3f}s  "
+                      f"{'-' if att is None else str(att):<5}"
+                      f"{e['event']:<18}"
+                      f"{_detail_line(e.get('detail') or {})}")
+        return 0
 
     def timeline(self, namespace: str, name: str, as_json: bool = False) -> int:
         """Render one job's flight-recorder timeline (engine/timeline.py)
@@ -700,6 +796,14 @@ def make_parser() -> argparse.ArgumentParser:
     pt.add_argument("--json", action="store_true", dest="as_json",
                     help="raw recorder JSON instead of the table")
 
+    # requests addresses the request recorder by job KEY too — the
+    # per-request timelines live outside any kind's store
+    pq = sub.add_parser("requests", parents=[common])
+    pq.add_argument("job_namespace", metavar="NAMESPACE")
+    pq.add_argument("name")
+    pq.add_argument("--json", action="store_true", dest="as_json",
+                    help="raw recorder JSON instead of the tables")
+
     sub.add_parser("version", parents=[common])
     return p
 
@@ -719,6 +823,9 @@ def run(args: argparse.Namespace, cli: Cli) -> int:
         return run_local_file(args.file, args.timeout)
     if args.verb == "timeline":
         return cli.timeline(args.job_namespace, args.name,
+                            as_json=args.as_json)
+    if args.verb == "requests":
+        return cli.requests(args.job_namespace, args.name,
                             as_json=args.as_json)
     kind = resolve_kind(args.kind)
     if (
