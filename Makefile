@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos bench-reqtrace native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos bench-reqtrace bench-cluster native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -167,6 +167,19 @@ bench-fleet-chaos:
 bench-reqtrace:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_reqtrace; \
 	print(json.dumps(bench_reqtrace(), indent=1))"
+
+# One cluster, one day (ISSUE 18): training gangs + the serving fleet
+# on ONE shared node inventory through a seeded chaos day (scrape
+# storm, replica freeze, kill-mid-decode, scheduler kill -9 + resync,
+# node drain through the scheduler).  Headline: the hardened stack
+# (shrink-before-evict + hedging + ejection) serves the whole trace
+# with zero drops and returns every gang to Running with exact restart
+# counters; the baseline drops requests and pays whole-gang evictions.
+# Both arms run twice inside the bench and must hash identically.
+# Rows land in BENCH_r16.json; bounds asserted in tests/test_bench_infra.py.
+bench-cluster:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_cluster; \
+	print(json.dumps(bench_cluster(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

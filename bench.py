@@ -2615,6 +2615,92 @@ def bench_reqtrace(
     }
 
 
+def bench_cluster(seed: int = 0):
+    """`make bench-cluster` — one cluster, one day (ISSUE 18 evidence,
+    BENCH_r16.json).  ONE shared Node inventory carries a high- and a
+    low-priority training gang plus a TPUServingJob fleet for a full
+    simulated day: a diurnal serving curve with two bursts and heavy-
+    tailed prompts, serving autoscaling into idle training capacity
+    (yielding while a same-or-higher-priority gang is pending), and a
+    demand spike that shrinks the low-priority gang to its floor via
+    the failure-atomic resize verb instead of evicting it.
+
+    The headline is the seeded mid-day chaos window:
+
+      t=100..115  fleet-wide scrape storm (degraded routing)
+      t=125       serving replica r0 FREEZES (SIGSTOP: heartbeats,
+                  never completes — only hedged re-dispatch rescues it)
+      t=140       newest serving replica killed mid-decode
+      t=160..180  kill -9 of the scheduler control-plane worker; the
+                  respawn rebuilds every reservation from pod
+                  annotations + owner CRs (resync), then re-keys the
+                  serving fleet's per-replica claims
+      t=200       node n1 drained THROUGH the scheduler (cordon first,
+                  gang-evict as a unit) — lands on the high gang
+      t=240       n1 uncordoned
+
+    Two arms, identical trace + chaos schedule + autoscale policy:
+    hardened (shrink-before-evict + hedging + ejection) must serve the
+    ENTIRE trace (zero dropped) and put every gang back to Running with
+    restart counters matching the chaos ledger exactly; the baseline
+    (all three off) measurably loses requests to the frozen replica and
+    pays whole-gang evictions (restarts + tens of seconds of MTTR)
+    where the hardened arm shrank.  Scoring rides the two flight
+    recorders: per-gang time-to-running / restart MTTR / resize
+    duration, serving TTFT p99 + SLO burn windows.  Each arm is run
+    TWICE and the merged event logs must hash identically — the whole
+    day is deterministic arithmetic per seed.  Rows land in
+    BENCH_r16.json; bounds asserted in tests/test_bench_infra.py."""
+    from tf_operator_tpu.engine.clustersim import run_cluster_day
+
+    def arm(hardened: bool):
+        row = run_cluster_day(seed=seed, hardened=hardened)
+        rerun = run_cluster_day(seed=seed, hardened=hardened)
+        assert rerun["log_sha256"] == row["log_sha256"], (
+            "cluster day is not deterministic per seed"
+        )
+        row["mode"] = "hardened" if hardened else "baseline"
+        row["serving"]["redispatches"] = len(
+            row["serving"]["redispatches"]
+        )
+        return row
+
+    rows = [arm(False), arm(True)]
+    base, hard = rows
+    hard_gangs = {g["name"]: g for g in hard["gangs"]}
+    base_gangs = {g["name"]: g for g in base["gangs"]}
+    return {
+        "seed": seed,
+        "requests": hard["requests"],
+        "rows": rows,
+        "summary": {
+            "baseline_dropped": base["serving"]["dropped"],
+            "hardened_dropped": hard["serving"]["dropped"],
+            # censored all-requests TTFT p99: None = the p99 rank lands
+            # in the lost region (the baseline's tail never terminates)
+            "ttft_p99_all_baseline_s":
+                base["serving"]["ttft_p99_all_s"],
+            "ttft_p99_all_hardened_s":
+                hard["serving"]["ttft_p99_all_s"],
+            "baseline_slo_burns": base["serving"]["slo_burns"],
+            "hardened_slo_burns": hard["serving"]["slo_burns"],
+            # the spike's victim: shrunk to floor (hardened) vs evicted
+            # whole (baseline) — restarts and MTTR tell the story
+            "low_gang_restarts_baseline":
+                base_gangs["train-low"]["restarts_observed"],
+            "low_gang_restarts_hardened":
+                hard_gangs["train-low"]["restarts_observed"],
+            "low_gang_mttr_baseline_s":
+                base_gangs["train-low"]["last_restart_mttr_s"],
+            "hardened_resize_duration_s":
+                hard_gangs["train-low"]["last_resize_duration_s"],
+            "gangs_running_hardened": sum(
+                1 for g in hard["gangs"] if g["state"] == "running"
+            ),
+        },
+    }
+
+
 def bench_elastic(
     seed: int = 1337,
     horizon_s: float = 420.0,

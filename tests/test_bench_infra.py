@@ -602,3 +602,77 @@ def test_merge_bucket_percentiles_reads_merged_histograms():
         "reconcile_p50_ms"] is None
     assert merge_bucket_percentiles({}, qs=(0.5,)) == {
         "reconcile_samples": 0, "reconcile_p50_ms": None}
+
+
+def test_bench_cluster_mixed_tenancy_bounds():
+    """BENCH_r16's regression bounds (ISSUE 18).  One shared-inventory
+    simulated day — training gangs + the serving fleet + the seeded
+    chaos window — two arms on the same trace and schedule.  The
+    hardened arm (shrink-before-evict + hedging + ejection) serves the
+    WHOLE day and puts every gang back to Running with restart counters
+    matching the chaos ledger exactly; the baseline measurably loses
+    requests and pays whole-gang evictions where the hardened arm
+    shrank.  Determinism (two runs per arm, identical transcript hash)
+    is asserted INSIDE the bench."""
+    r = bench.bench_cluster()
+    by = {row["mode"]: row for row in r["rows"]}
+    base, hard = by["baseline"], by["hardened"]
+    # zero-loss through the chaos day is the hardened arm's contract
+    assert hard["serving"]["dropped"] == 0
+    assert hard["serving"]["completed"] == r["requests"]
+    assert base["serving"]["dropped"] > 0
+    # censored tail: bounded for hardened, unbounded for baseline
+    assert hard["serving"]["ttft_p99_all_s"] is not None
+    assert base["serving"]["ttft_p99_all_s"] is None
+    # every hardened gang recovered, restarts exactly accounted
+    for g in hard["gangs"]:
+        assert g["state"] == "running", g
+        assert g["restarts_observed"] == g["restarts_booked"], g
+    hard_low = next(g for g in hard["gangs"] if g["name"] == "train-low")
+    base_low = next(g for g in base["gangs"] if g["name"] == "train-low")
+    # the spike SHRANK the elastic tenant (no restarts, a measured
+    # resize) instead of evicting it whole (restarts + a long MTTR)
+    assert hard_low["restarts_observed"] == 0
+    assert hard_low["last_resize_duration_s"] is not None
+    assert hard_low["width"] == hard_low["min_replicas"]
+    assert base_low["restarts_observed"] > 0
+    assert base_low["last_restart_mttr_s"] is not None
+    # the day contained its chaos, and APF yielded at least once
+    assert hard["chaos"]["blackouts"] == 1
+    assert hard["serving"]["scale_out_denied"] >= 1
+    # the lost tail fires the burn engine in the baseline arm only
+    assert base["serving"]["slo_burns"] >= 1
+    assert hard["serving"]["slo_burns"] == 0
+
+
+def test_bench_cluster_committed_artifact_holds_contract():
+    """BENCH_r16.json is the committed evidence for the ISSUE 18
+    chaos-day contract.  Pin its structure and re-derive the verdict
+    from the recorded numbers, so a regenerated artifact that fails the
+    survival bound cannot land silently."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_r16.json"
+    )
+    with open(path) as fh:
+        r = json.load(fh)
+    assert {row["mode"] for row in r["rows"]} == {"baseline", "hardened"}
+    by = {row["mode"]: row for row in r["rows"]}
+    hard, base = by["hardened"], by["baseline"]
+    s = r["summary"]
+    # the summary is re-derived from the rows it summarizes
+    assert s["hardened_dropped"] == hard["serving"]["dropped"] == 0
+    assert s["baseline_dropped"] == base["serving"]["dropped"] > 0
+    assert hard["serving"]["completed"] == r["requests"]
+    assert s["low_gang_restarts_hardened"] == 0
+    assert s["low_gang_restarts_baseline"] > 0
+    assert s["hardened_resize_duration_s"] is not None
+    assert s["gangs_running_hardened"] == len(hard["gangs"])
+    # per-seed determinism: both arms carry their transcript hash
+    for row in r["rows"]:
+        assert len(row["log_sha256"]) == 64
+    assert hard["log_sha256"] != base["log_sha256"]
+    # the three scored SLO axes surface in the serving row
+    for axis in ("ttft", "queue_wait"):
+        assert axis in hard["serving"]["slo_axes"], axis

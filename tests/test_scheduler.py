@@ -920,3 +920,61 @@ def test_scheduler_metrics_families_exposed():
     ):
         assert family in text, family
     mgr.factory.stop_all()
+
+
+def test_cordon_excludes_node_from_placement_until_uncordon():
+    """Cordon semantics (ISSUE 18 satellite): a cordoned node keeps its
+    existing reservations but placement never offers it — a gang that
+    only fits there parks pending instead of landing on a node being
+    drained — and uncordon restores it.  The state is mirrored onto
+    spec.unschedulable so a resync'd (restarted) scheduler inherits the
+    cordon rather than silently re-opening the node."""
+    cluster, sched = make_sched()
+    sched.cordon("n0")
+    assert sched.cordoned_nodes() == frozenset({"n0"})
+    # idempotent, and mirrored to the Node object
+    sched.cordon("n0")
+    node = next(o for o in cluster.list("Node")
+                if o["metadata"]["name"] == "n0")
+    assert node["spec"]["unschedulable"] is True
+    # packed placement would pick n0 (first sorted) — the cordon forces
+    # the gang onto n1, and a second gang that now only fits on n0 parks
+    ok, _ = admit(sched, "g1", {"g1-worker-0": 8})
+    assert ok
+    assert sched.planned_node("g1", "g1-worker-0") == "n1"
+    ok, msg = admit(sched, "g2", {"g2-worker-0": 8})
+    assert not ok and "free" in msg
+    # a restarted scheduler derives the cordon from spec.unschedulable
+    fresh = ClusterScheduler(cluster, policy="packed", clock=SimClock())
+    fresh.resync()
+    assert fresh.cordoned_nodes() == frozenset({"n0"})
+    # uncordon re-opens the node: the parked gang's shape now admits
+    sched.uncordon("n0")
+    node = next(o for o in cluster.list("Node")
+                if o["metadata"]["name"] == "n0")
+    assert node["spec"]["unschedulable"] is False
+    ok, _ = admit(sched, "g2", {"g2-worker-0": 8})
+    assert ok
+    assert sched.planned_node("g2", "g2-worker-0") == "n0"
+
+
+def test_drain_cordons_and_requeued_gang_avoids_the_drained_node():
+    """The drain-requeue race the cordon closes: without it, the gang
+    evicted off a draining node re-enters admission the same tick and
+    lands straight back on that node (it has the most free chips by
+    construction).  drain_node must cordon first, so the requeued gang
+    places elsewhere or parks until uncordon."""
+    cluster, sched = make_sched(
+        nodes=(("n0", "v5e-8", "v5e"), ("n1", "v5e-8", "v5e")),
+    )
+    ok, _ = admit(sched, "dg", {"dg-worker-0": 8})
+    assert ok and sched.planned_node("dg", "dg-worker-0") == "n0"
+    killed = sched.drain_node("n0", kill=lambda ns, n: True)
+    assert killed == 1
+    assert sched.reserved_members("dg") == 0
+    assert "n0" in sched.cordoned_nodes()
+    # immediate re-admission (the evicted controller requeues at once):
+    # the gang must NOT come back to the node being drained
+    ok, _ = admit(sched, "dg", {"dg-worker-0": 8})
+    assert ok
+    assert sched.planned_node("dg", "dg-worker-0") == "n1"

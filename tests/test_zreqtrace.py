@@ -601,3 +601,54 @@ def test_sigusr1_dump_writes_request_timelines_side_file(tmp_path):
         signal.signal(signal.SIGUSR1, prev)
         manager.stop()
         reqtrace.set_recorder(_disabled())
+
+
+def test_total_outage_burns_below_the_min_sample_gate():
+    """ISSUE 18 satellite: the min-sample gate exists to suppress
+    noise-burns off a thin window — but a non-empty window whose EVERY
+    sample is censored (+inf) is a total outage, where few samples is
+    itself the signal.  Two stranded requests (fewer than the 5-sample
+    gate) must page; two merely-slow finite requests must not; and the
+    slo_status snapshot must agree with the pager in both regimes."""
+    metrics.SERVING_SLO_BURNS.reset()
+    clock = SimClock()
+    jr = FlightRecorder(events_per_job=64, max_jobs=8, clock=clock)
+    rec = RequestRecorder(events_per_request=64, max_requests=64,
+                          clock=clock, job_recorder=jr)
+    rec.set_slo(JOB, SLOSpec(e2e_p99_s=5.0, objective=0.9,
+                             fast_window_s=60.0, slow_window_s=300.0))
+    # regime 1: two finite violations — thin window, NOT all censored:
+    # the noise gate holds and nothing fires
+    for i in range(2):
+        rid = f"slow{i}"
+        rec.record(JOB, rid, "router", "submitted", {}, ts=clock())
+        clock.advance(8.0)  # e2e 8.0 > 5.0 target, but finite
+        rec.record(JOB, rid, "router", "finished",
+                   {"replica": "r0", "tokens": 4}, ts=clock())
+    rec.slo_tick(clock())
+    assert metrics.SERVING_SLO_BURNS.get(
+        {"serving_job": JOB, "axis": "e2e"}) == 0
+    assert rec.slo_status(JOB)["axes"]["e2e"]["burning"] is False
+
+    # regime 2 (fresh windows): two DROPPED requests and nothing else —
+    # every sample +inf, still under the gate — the burn fires
+    clock.advance(400.0)  # drain the finite samples out of both windows
+    for i in range(2):
+        rid = f"lost{i}"
+        rec.record(JOB, rid, "router", "submitted", {}, ts=clock())
+        clock.advance(1.0)
+        rec.record(JOB, rid, "router", "drop", {"reason": "outage"},
+                   ts=clock())
+    rec.slo_tick(clock())
+    assert metrics.SERVING_SLO_BURNS.get(
+        {"serving_job": JOB, "axis": "e2e"}) == 1
+    st = rec.slo_status(JOB)["axes"]["e2e"]
+    assert st["burning"] is True
+    assert st["samples"] == 2
+    assert st["p99_s"] is None  # censored: the whole window is +inf
+    burn = next(e for e in jr.timeline(JOB)["events"]
+                if e["event"] == "slo_burn")
+    # the very first drop's sample-driven eval already paged (one
+    # censored sample IS a total outage under the gate)
+    assert 1 <= burn["detail"]["samples_fast"] <= 2
+    assert burn["detail"]["window_p99_s"] is None

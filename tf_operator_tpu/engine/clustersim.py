@@ -1,0 +1,806 @@
+"""One cluster, one day: the trace-driven mixed train+serve tenancy
+harness (PR 16).
+
+Every subsystem below has its own bench — the gang scheduler
+(BENCH_r07), warm pools (r06), elastic resize (r11), the serving fleet
+and its failure domain (r13/r14), the request SLO engine (r15) — but
+none of them ever shared a Node.  This module composes ALL of them on
+one FakeCluster inventory and replays one simulated day:
+
+  * a diurnal serving curve (models/fleetsim.make_trace: late-heavy
+    session arrivals, burst windows, heavy-tailed prompts) served by a
+    TPUServingJob fleet whose autoscaler must ACQUIRE chips from the
+    shared ClusterScheduler before every scale-out (the
+    ``FleetHarness.capacity`` gate) — serving grows into capacity that
+    training is not using, and not one chip further;
+  * a tenant mix of training gangs (high-priority rigid, low-priority
+    elastic with a min-replicas floor) driven by a deliberately small
+    gang controller: submit -> gang admission -> pods -> Running,
+    observing evictions/kills through the pods exactly like the real
+    engine, executing scheduler-requested shrinks through the
+    resize-drain-resume path, and re-queueing after preemption;
+  * a seeded mid-day CHAOS window riding the r14 FaultInjector: a
+    fleet-wide scrape storm, a replica freeze (SIGSTOP'd decode), a
+    kill-mid-decode, a ``kill -9`` of the scheduler control-plane
+    worker (state rebuilt from pods via resync, the r10 contract), and
+    a node drain THROUGH the scheduler (which cordons the node until
+    the chaos script uncordons it).
+
+Scoring is the two flight recorders: engine/timeline.FlightRecorder
+per-job SLOs (time-to-running, restart MTTR, resize duration) and
+engine/reqtrace.RequestRecorder burn windows + the fleet summary
+(TTFT/drops).  Everything is a pure function of the seed: the injector
+log, the router log, and the scheduler notes merge into one
+deterministic transcript whose sha256 the bench asserts across runs.
+
+The HARDENED arm runs the full stack (shrink-before-evict, hedged
+re-dispatch, scrape-failure ejection); the BASELINE arm switches all
+three off.  Same trace, same chaos, same seed — the delta is the PR 16
+headline: the hardened day serves every request and recovers every
+gang; the baseline day drops requests on the frozen replica and
+strands the evicted low-priority gang.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tf_operator_tpu.api.servingjob import AutoscaleSpec, SLOSpec
+from tf_operator_tpu.engine.reqtrace import RequestRecorder
+from tf_operator_tpu.engine.scheduler import (
+    ASSIGNED_NODE_ANNOTATION,
+    MIN_REPLICAS_ANNOTATION,
+    PRIORITY_ANNOTATION,
+    SLICE_SHAPE_LABEL,
+    ClusterScheduler,
+)
+from tf_operator_tpu.engine.timeline import FlightRecorder
+from tf_operator_tpu.k8s import objects
+from tf_operator_tpu.k8s.chaos import FaultInjector, SimClock
+from tf_operator_tpu.k8s.fake import FakeCluster
+from tf_operator_tpu.models.fleetsim import FleetHarness, make_trace
+
+NS = "default"
+TRAIN_KIND = "TFJob"
+SERVE_KIND = "TPUServingJob"
+SERVE_NAME = "serve"
+SERVE_KEY = f"{NS}/{SERVE_NAME}"
+SERVE_UID = "uid-serve"
+
+
+@dataclass
+class GangSpec:
+    """One training tenant.  ``min_replicas`` set => elastic (the
+    scheduler may shrink it to the floor instead of evicting);
+    ``work_s`` set => the gang finishes after that much full-width
+    progress and releases its slice (None = trains past the horizon)."""
+
+    name: str
+    replicas: int
+    priority: int
+    chips: int = 8
+    min_replicas: Optional[int] = None
+    submit_at: float = 0.0
+    work_s: Optional[float] = None
+
+
+@dataclass
+class ChaosDay:
+    """The seeded mid-day failure storm (absolute sim seconds).  Any
+    field set to None skips that injection, so tests can run partial
+    storms without re-deriving the whole timeline."""
+
+    scrape_storm_at: Optional[float] = 100.0
+    scrape_storm_s: float = 15.0
+    freeze_at: Optional[float] = 125.0          # SIGSTOP replica r0
+    kill_decode_at: Optional[float] = 140.0     # newest live replica
+    blackout_at: Optional[float] = 160.0        # kill -9 the scheduler
+    blackout_s: float = 20.0
+    drain_at: Optional[float] = 200.0
+    drain_node: str = "n1"   # first training node under packed placement
+    uncordon_at: Optional[float] = 240.0
+
+
+class _Gang:
+    """Runtime state of one training tenant: the minimal gang
+    controller.  States: unsubmitted -> pending -> starting -> running
+    (-> repairing -> running | -> resizing -> starting | -> pending on
+    eviction) -> done."""
+
+    def __init__(self, spec: GangSpec) -> None:
+        self.spec = spec
+        self.uid = f"uid-{spec.name}"
+        self.key = f"{NS}/{spec.name}"
+        self.state = "unsubmitted"
+        self.width = spec.replicas      # current target gang width
+        self.restarts = 0               # member deaths observed via pods
+        self.requeue_at = 0.0
+        self.resize_done_at = 0.0
+        self.progress = 0.0
+        self.last_run_ts: Optional[float] = None
+
+    def member(self, i: int) -> str:
+        # name-type-index: the format the scheduler's elastic shrink
+        # planner parses to find droppable high indices
+        return f"{self.spec.name}-worker-{i}"
+
+    def members(self) -> Dict[str, int]:
+        return {self.member(i): self.spec.chips for i in range(self.width)}
+
+
+class _ServingCapacity:
+    """The ``FleetHarness.capacity`` gate: every serving scale-out must
+    win a one-member gang admission from the shared scheduler first.
+    Admission CAN preempt (a traffic spike shrinks the elastic
+    low-priority tenant through the same verb a training arrival would
+    use), but the gate yields outright while a training gang of equal
+    or higher priority is pending — APF semantics: the serving fleet
+    must not starve a parked high-priority gang by grabbing freed chips
+    one replica at a time.  Denials ride the autoscaler's own cooldown,
+    so a yielded scale-out is re-attempted, not flapped."""
+
+    def __init__(self, sim: "ClusterDaySim") -> None:
+        self.sim = sim
+        self.uids: Dict[str, str] = {}          # live rid -> reservation uid
+        self._granted: Optional[Tuple[str, str]] = None
+
+    def acquire(self, now: float) -> bool:
+        sim = self.sim
+        if sim.sched is None:
+            return False                        # control plane is dead
+        for gang in sim.gangs:
+            if (
+                gang.state == "pending"
+                and gang.spec.priority >= sim.serve_priority
+            ):
+                sim.inj.note(
+                    f"serve_yield gang={gang.key} "
+                    f"priority={gang.spec.priority}"
+                )
+                return False
+        rid = f"r{sim.fleet._next_idx}"         # the next _add_replica id
+        member = f"serve-{rid}"
+        uid = f"{SERVE_UID}-{rid}"
+        ok, _msg = sim.sched.admit(
+            job_key=SERVE_KEY, job_uid=uid, kind=SERVE_KIND, namespace=NS,
+            members={member: sim.serve_chips}, priority=sim.serve_priority,
+        )
+        if not ok:
+            # the autoscaler polls; a parked pending entry would just
+            # hold the gauge up between its cooldown-spaced attempts
+            sim.sched.release(uid)
+            return False
+        self._granted = (uid, member)
+        return True
+
+    def bind(self, rid: str) -> None:
+        assert self._granted is not None
+        uid, member = self._granted
+        self._granted = None
+        self.uids[rid] = uid
+        node = self.sim.sched.planned_node(uid, member)
+        self.sim._create_serving_pod(member, node)
+
+    def release(self, rid: str) -> None:
+        uid = self.uids.pop(rid, None)
+        if uid is None:
+            return
+        if self.sim.sched is not None:
+            self.sim.sched.release(uid)
+        self.sim._delete_pod(f"serve-{rid}")
+
+
+class ClusterDaySim:
+    """One shared-inventory simulated day.  ``hardened`` arms
+    shrink-before-evict + hedging + ejection; the baseline switches all
+    three off.  Everything else — trace, chaos, inventory — is
+    identical, so the scored delta is exactly the hardening."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        hardened: bool = True,
+        nodes: int = 6,
+        node_shape: str = "v5e-8",
+        gangs: Optional[List[GangSpec]] = None,
+        serve_chips: int = 8,
+        serve_priority: int = 100,
+        serve_max_replicas: int = 3,
+        n_users: int = 260,
+        trace_horizon_s: float = 300.0,
+        horizon_s: float = 420.0,
+        base_rate: float = 1.0,
+        burst_rate: float = 7.0,
+        bursts: Tuple[Tuple[float, float], ...] = ((60.0, 25.0), (240.0, 18.0)),
+        chaos: Optional[ChaosDay] = None,
+        dt: float = 0.05,
+        train_sync_s: float = 0.25,
+        resize_drain_s: float = 2.0,
+        requeue_backoff_s: float = 1.0,
+        slo_tick_s: float = 5.0,
+        pod_start_delay: float = 1.0,
+    ) -> None:
+        self.seed = seed
+        self.hardened = hardened
+        self.horizon_s = horizon_s
+        self.dt = dt
+        self.train_sync_s = train_sync_s
+        self.resize_drain_s = resize_drain_s
+        self.requeue_backoff_s = requeue_backoff_s
+        self.slo_tick_s = slo_tick_s
+        self.serve_chips = serve_chips
+        self.serve_priority = serve_priority
+        self.node_shape = node_shape
+        self.chaos = chaos
+
+        self.cluster = FakeCluster()
+        self.clock = SimClock()
+        self.inj = FaultInjector(
+            self.cluster, seed=seed, clock=self.clock, kubelet=True,
+            pod_start_delay=pod_start_delay, nodes=nodes,
+        )
+        self.node_names = [f"n{i}" for i in range(nodes)]
+        for name in self.node_names:
+            self.cluster.add_node(name, shape=node_shape)
+
+        self.frec = FlightRecorder(clock=self.clock)
+        self.rrec = RequestRecorder(clock=self.clock, job_recorder=self.frec)
+        self.sched: Optional[ClusterScheduler] = self._make_scheduler()
+        self.sched.resync()   # nodes predate the scheduler's watch
+        self.inj.scheduler = self.sched
+        self.inj.recorder = self.frec
+        # evictions booked by a scheduler incarnation that later died
+        # (the blackout): carried forward so the restart cross-check
+        # spans the whole day, not just the surviving process
+        self._evictions_carry: Dict[str, int] = {}
+
+        self.gangs = [
+            _Gang(s) for s in (gangs or [
+                GangSpec("train-high", replicas=2, priority=100,
+                         submit_at=0.5),
+                GangSpec("train-low", replicas=3, priority=10,
+                         min_replicas=1, submit_at=1.0),
+            ])
+        ]
+
+        # the serving job CR: resync reads priority (and the absent
+        # elastic floor) from here when rebuilding replica reservations
+        self.cluster.create(SERVE_KIND, {
+            "apiVersion": "kubeflow.org/v1", "kind": SERVE_KIND,
+            "metadata": {
+                "name": SERVE_NAME, "namespace": NS, "uid": SERVE_UID,
+                "annotations": {PRIORITY_ANNOTATION: str(serve_priority)},
+            },
+            "spec": {},
+        })
+        self.fleet = FleetHarness(
+            mode="occupancy",
+            n_replicas=1,
+            # floor of TWO: hedged re-dispatch needs a sibling, so the
+            # autoscaler must never drain the fleet down to one replica
+            # that might be the frozen one (the scale-in victim picker
+            # cannot see a SIGSTOP'd decode behind healthy heartbeats)
+            autoscale=AutoscaleSpec(
+                min_replicas=2, max_replicas=serve_max_replicas,
+                scale_out_queue_wait_p99_s=2.0,
+                scale_out_blocked_admissions=4,
+                scale_in_occupancy_floor=0.2,
+            ),
+            warm_standbys=2,
+            injector=self.inj,
+            hedging=hardened,
+            ejection=hardened,
+            recorder=self.frec,
+            job_key=SERVE_KEY,
+            reqtrace=self.rrec,
+            slo=SLOSpec(ttft_p99_s=6.0, queue_wait_p99_s=5.0,
+                        fast_window_s=30.0, slow_window_s=120.0),
+            dt=dt,
+        )
+        self.capacity = _ServingCapacity(self)
+        self.fleet.capacity = self.capacity
+        # the constructor's initial replica (r0) predates the gate:
+        # adopt its reservation so day-zero serving capacity is booked
+        # against the shared inventory like everything after it
+        ok, msg = self.sched.admit(
+            job_key=SERVE_KEY, job_uid=f"{SERVE_UID}-r0", kind=SERVE_KIND,
+            namespace=NS, members={"serve-r0": serve_chips},
+            priority=serve_priority,
+        )
+        if not ok:
+            raise RuntimeError(f"initial serving replica unplaceable: {msg}")
+        self.capacity.uids["r0"] = f"{SERVE_UID}-r0"
+        self._create_serving_pod(
+            "serve-r0",
+            self.sched.planned_node(f"{SERVE_UID}-r0", "serve-r0"),
+        )
+        self.frec.record(SERVE_KEY, "controller", "created",
+                         {"kind": SERVE_KIND}, uid=SERVE_UID, ts=0.0)
+
+        self.trace = make_trace(
+            seed, n_users=n_users, horizon_s=trace_horizon_s,
+            base_rate=base_rate, burst_rate=burst_rate, bursts=bursts,
+        )
+        self.blackout_events = 0
+        if chaos is not None:
+            self._schedule_chaos(chaos)
+
+    # ------------------------------------------------------------ plumbing
+    def _make_scheduler(self) -> ClusterScheduler:
+        sched = ClusterScheduler(
+            self.inj, policy="packed", clock=self.clock,
+            note=self.inj.note, shrink_before_evict=self.hardened,
+        )
+        sched.recorder = self.frec
+        return sched
+
+    def _delete_pod(self, name: str) -> None:
+        try:
+            self.inj.delete_pod(NS, name)
+        except Exception:  # noqa: BLE001 — already gone / storm: fine
+            pass
+
+    def _pod(self, name: str, node: Optional[str], job_name: str,
+             kind: str, uid: str, chips: int, replica_type: str) -> Dict[str, Any]:
+        shape = f"v5e-{chips}"
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": name, "namespace": NS,
+                "labels": {
+                    objects.LABEL_JOB_NAME: job_name,
+                    objects.LABEL_REPLICA_TYPE: replica_type,
+                },
+                "annotations": {
+                    ASSIGNED_NODE_ANNOTATION: node or "",
+                    SLICE_SHAPE_LABEL: shape,
+                },
+                "ownerReferences": [{
+                    "apiVersion": "kubeflow.org/v1", "kind": kind,
+                    "name": job_name, "uid": uid, "controller": True,
+                }],
+            },
+            "spec": {
+                "nodeName": node or "",
+                "containers": [{"name": "main"}],
+            },
+            "status": {"phase": objects.POD_PENDING},
+        }
+
+    def _create_serving_pod(self, member: str,
+                            node: Optional[str]) -> None:
+        # owned by the CR itself (its uid must be live or the fake's GC
+        # reaps the pod at birth); the per-replica reservation uid is
+        # scheduler-side bookkeeping only
+        self.inj.create_pod(self._pod(
+            member, node, SERVE_NAME, SERVE_KIND, SERVE_UID,
+            self.serve_chips, "replica",
+        ))
+
+    def _gang_pod(self, gang: _Gang, member: str) -> None:
+        node = (
+            self.sched.planned_node(gang.uid, member)
+            if self.sched is not None else None
+        )
+        self.inj.create_pod(self._pod(
+            member, node, gang.spec.name, TRAIN_KIND, gang.uid,
+            gang.spec.chips, "worker",
+        ))
+
+    def _gang_pods(self, gang: _Gang) -> List[Dict[str, Any]]:
+        out = []
+        for i in range(gang.width):
+            try:
+                out.append(self.inj.get_pod(NS, gang.member(i)))
+            except Exception:  # noqa: BLE001 — missing/storm reads as gone
+                out.append(None)
+        return out
+
+    # --------------------------------------------------------------- chaos
+    def _schedule_chaos(self, c: ChaosDay) -> None:
+        if c.scrape_storm_at is not None:
+            self.inj.schedule_scrape_storm(
+                c.scrape_storm_at, c.scrape_storm_s, mode="timeout",
+            )
+        if c.freeze_at is not None:
+            self.inj.schedule_replica_freeze(c.freeze_at, "r0")
+        if c.kill_decode_at is not None:
+            self.inj.at(
+                c.kill_decode_at, self._kill_newest_replica,
+                "kill_mid_decode replica=newest",
+            )
+        if c.blackout_at is not None:
+            self.inj.at(
+                c.blackout_at, self._blackout_begin,
+                "control_plane_kill proc=scheduler signal=9",
+            )
+            self.inj.at(
+                c.blackout_at + c.blackout_s, self._blackout_end,
+                "control_plane_respawn proc=scheduler",
+            )
+        if c.drain_at is not None:
+            self.inj.at(
+                c.drain_at,
+                lambda: self.inj.drain_node(c.drain_node),
+                f"drain_begin node={c.drain_node}",
+            )
+        if c.uncordon_at is not None:
+            self.inj.at(
+                c.uncordon_at,
+                lambda: self.sched is not None
+                and self.sched.uncordon(c.drain_node),
+                f"uncordon node={c.drain_node}",
+            )
+
+    def _kill_newest_replica(self) -> None:
+        # pick at fire time: the newest live autoscaled replica (never
+        # r0 — that one is the freeze target).  Deterministic: fleet
+        # state at the firing tick is a pure function of the seed.
+        live = [
+            rid for rid, r in self.fleet.replicas.items()
+            if r.alive and rid != "r0" and rid not in self.fleet._starting
+        ]
+        if live:
+            self.fleet.kill_now(max(live, key=lambda rid: int(rid[1:])))
+
+    def _blackout_begin(self) -> None:
+        # kill -9: the scheduler's in-memory reservations die with it.
+        # Admission, resize completion, and eviction detection all stall
+        # until the respawn resyncs from pods (the r10 contract: derived
+        # state is rebuilt, not replicated).
+        if self.sched is not None:
+            for key, n in self.sched.evictions.items():
+                self._evictions_carry[key] = (
+                    self._evictions_carry.get(key, 0) + n
+                )
+        self.sched = None
+        self.inj.scheduler = None
+        self.blackout_events += 1
+
+    def _blackout_end(self) -> None:
+        sched = self._make_scheduler()
+        sched.resync()
+        # resync rebuilt the serving fleet as ONE reservation under the
+        # CR uid (every replica pod shares the CR's ownerRef): the
+        # serving side now re-asserts its per-replica reservations,
+        # adopting each pod's live placement — the same first-sync
+        # re-admission the training controllers do after a respawn
+        sched.release(SERVE_UID)
+        for rid in sorted(self.capacity.uids, key=lambda r: int(r[1:])):
+            member = f"serve-{rid}"
+            try:
+                pod = self.inj.get_pod(NS, member)
+            except Exception:  # noqa: BLE001 — died mid-blackout
+                self.capacity.uids.pop(rid, None)
+                continue
+            node = (pod.get("spec") or {}).get("nodeName") or None
+            sched.admit(
+                job_key=SERVE_KEY, job_uid=self.capacity.uids[rid],
+                kind=SERVE_KIND, namespace=NS,
+                members={member: self.serve_chips},
+                priority=self.serve_priority,
+                existing={member: node} if node else None,
+            )
+        self.sched = sched
+        self.inj.scheduler = sched
+        self.inj.note("scheduler_resync complete")
+
+    # ------------------------------------------------------ gang controller
+    def _train_tick(self, now: float) -> None:
+        for gang in self.gangs:
+            if gang.state == "done" or now < gang.spec.submit_at:
+                continue
+            if gang.state == "unsubmitted":
+                self._submit_gang(gang, now)
+                continue
+            if self.sched is None:
+                # control-plane blackout: pods still run (kubelet is
+                # alive) but nothing can be admitted, shrunk, or
+                # detected as evicted — observation-only below
+                if gang.state == "starting":
+                    self._check_all_running(gang, now)
+                elif gang.state == "running":
+                    self._account_progress(gang, now)
+                continue
+            if gang.state in ("starting", "running", "repairing", "resizing"):
+                if self.sched.reserved_members(gang.uid) == 0:
+                    self._on_evicted(gang, now)
+                    continue
+            if gang.state in ("running", "repairing"):
+                if self._maybe_start_shrink(gang, now):
+                    continue
+            if gang.state == "pending":
+                if now >= gang.requeue_at:
+                    self._try_admit(gang, now)
+            elif gang.state == "starting":
+                self._check_all_running(gang, now)
+            elif gang.state == "repairing":
+                self._check_repaired(gang, now)
+            elif gang.state == "resizing":
+                if now >= gang.resize_done_at:
+                    self._finish_shrink(gang, now)
+            elif gang.state == "running":
+                self._observe_member_failures(gang, now)
+                if gang.state == "running":
+                    self._account_progress(gang, now)
+                    self._maybe_complete(gang, now)
+
+    def _submit_gang(self, gang: _Gang, now: float) -> None:
+        ann = {PRIORITY_ANNOTATION: str(gang.spec.priority)}
+        if gang.spec.min_replicas is not None:
+            ann[MIN_REPLICAS_ANNOTATION] = str(gang.spec.min_replicas)
+        self.inj.create(TRAIN_KIND, {
+            "apiVersion": "kubeflow.org/v1", "kind": TRAIN_KIND,
+            "metadata": {
+                "name": gang.spec.name, "namespace": NS,
+                "uid": gang.uid, "annotations": ann,
+            },
+            "spec": {"tfReplicaSpecs": {
+                "Worker": {"replicas": gang.spec.replicas},
+            }},
+        })
+        self.frec.record(gang.key, "controller", "created",
+                         {"kind": TRAIN_KIND}, uid=gang.uid, ts=now)
+        gang.state = "pending"
+        gang.requeue_at = now
+
+    def _spec_replicas(self, gang: _Gang) -> int:
+        try:
+            cr = self.inj.get(TRAIN_KIND, NS, gang.spec.name)
+        except Exception:  # noqa: BLE001 — storm: keep last known width
+            return gang.width
+        return int(
+            ((cr.get("spec") or {}).get("tfReplicaSpecs") or {})
+            .get("Worker", {}).get("replicas") or gang.width
+        )
+
+    def _maybe_start_shrink(self, gang: _Gang, now: float) -> bool:
+        """The scheduler's shrink-before-evict patched our spec down: run
+        the elastic resize path — drain, then re-admit at the floor.
+        Capacity frees when the smaller shape admits, exactly the
+        failure-atomic verb (PR 11)."""
+        target = self._spec_replicas(gang)
+        if target >= gang.width:
+            return False
+        self._account_progress(gang, now)
+        gang.state = "resizing"
+        gang.resize_done_at = now + self.resize_drain_s
+        self.frec.record(
+            gang.key, "controller", "resize_requested",
+            {"from": gang.width, "to": target}, uid=gang.uid, ts=now,
+        )
+        return True
+
+    def _finish_shrink(self, gang: _Gang, now: float) -> None:
+        target = self._spec_replicas(gang)
+        dropped = list(range(target, gang.width))
+        gang.width = max(target, gang.spec.min_replicas or 0)
+        ok, _msg = self.sched.admit(
+            job_key=gang.key, job_uid=gang.uid, kind=TRAIN_KIND,
+            namespace=NS, members=gang.members(),
+            priority=gang.spec.priority,
+            min_replicas=gang.spec.min_replicas,
+        )
+        for i in dropped:
+            # graceful scale-down, not a restart: the drained members'
+            # pods exit clean and nobody books a kill
+            self._delete_pod(gang.member(i))
+        self.frec.record(
+            gang.key, "controller", "resumed",
+            {"replicas": gang.width, "admitted": bool(ok)},
+            uid=gang.uid, ts=now,
+        )
+        gang.state = "starting" if ok else "pending"
+        gang.requeue_at = now + self.requeue_backoff_s
+
+    def _try_admit(self, gang: _Gang, now: float) -> None:
+        ok, _msg = self.sched.admit(
+            job_key=gang.key, job_uid=gang.uid, kind=TRAIN_KIND,
+            namespace=NS, members=gang.members(),
+            priority=gang.spec.priority,
+            min_replicas=gang.spec.min_replicas,
+        )
+        if not ok:
+            gang.requeue_at = now + self.requeue_backoff_s
+            return
+        for i in range(gang.width):
+            self._delete_pod(gang.member(i))
+            self._gang_pod(gang, gang.member(i))
+        gang.state = "starting"
+
+    def _check_all_running(self, gang: _Gang, now: float) -> None:
+        pods = self._gang_pods(gang)
+        if any(p is None for p in pods):
+            return
+        if all(objects.pod_phase(p) == objects.POD_RUNNING for p in pods):
+            self.frec.record(
+                gang.key, "controller", "condition",
+                {"type": "Running", "reason": "AllReplicasRunning"},
+                uid=gang.uid, ts=now,
+            )
+            self.frec.record(
+                gang.key, "controller", "replicas_active",
+                {"active": gang.width}, uid=gang.uid, ts=now,
+            )
+            gang.state = "running"
+            gang.last_run_ts = now
+
+    def _check_repaired(self, gang: _Gang, now: float) -> None:
+        pods = self._gang_pods(gang)
+        if any(p is None for p in pods):
+            return
+        if all(objects.pod_phase(p) == objects.POD_RUNNING for p in pods):
+            # full strength again: replicas_active closes the MTTR clock
+            # (the Running condition never flipped — partial degradation)
+            self.frec.record(
+                gang.key, "controller", "replicas_active",
+                {"active": gang.width}, uid=gang.uid, ts=now,
+            )
+            gang.state = "running"
+            gang.last_run_ts = now
+
+    def _observe_member_failures(self, gang: _Gang, now: float) -> None:
+        """A member died but the reservation survived (drain_keep, a
+        stray chaos kill): ExitCode restart semantics — recreate the pod
+        into its still-held slot."""
+        failed = []
+        for i, pod in enumerate(self._gang_pods(gang)):
+            if pod is not None and objects.pod_phase(pod) == objects.POD_FAILED:
+                failed.append(i)
+        if not failed:
+            return
+        self._account_progress(gang, now)
+        gang.restarts += len(failed)
+        for i in failed:
+            self._delete_pod(gang.member(i))
+            self._gang_pod(gang, gang.member(i))
+        gang.state = "repairing"
+
+    def _on_evicted(self, gang: _Gang, now: float) -> None:
+        """The whole reservation is gone (preemption or drain): every
+        member died — count them, sweep the corpses, requeue the gang
+        wholesale.  The failure marks (scheduler ``preempted`` / chaos
+        ``kill`` / ``drain_evicted``) already opened the MTTR clock."""
+        if gang.state != "resizing":
+            self._account_progress(gang, now)
+        gang.restarts += gang.width
+        for i in range(gang.width):
+            self._delete_pod(gang.member(i))
+        gang.state = "pending"
+        gang.requeue_at = now + self.requeue_backoff_s
+
+    def _account_progress(self, gang: _Gang, now: float) -> None:
+        if gang.last_run_ts is not None:
+            gang.progress += now - gang.last_run_ts
+        gang.last_run_ts = now
+
+    def _maybe_complete(self, gang: _Gang, now: float) -> None:
+        if gang.spec.work_s is None or gang.progress < gang.spec.work_s:
+            return
+        for i in range(gang.width):
+            self._delete_pod(gang.member(i))
+        if self.sched is not None:
+            self.sched.release(gang.uid)
+        self.frec.record(
+            gang.key, "controller", "condition",
+            {"type": "Succeeded", "reason": "Completed"},
+            uid=gang.uid, ts=now,
+        )
+        gang.state = "done"
+
+    # ---------------------------------------------------- serving reconcile
+    def _serve_reconcile(self) -> None:
+        """Kill fleet replicas whose cluster half died externally (node
+        drain through the scheduler, a chaos pod kill): the router stops
+        dispatching to them and the autoscaler re-acquires capacity
+        through the gate."""
+        if self.sched is None:
+            return
+        for rid in sorted(self.capacity.uids, key=lambda r: int(r[1:])):
+            replica = self.fleet.replicas.get(rid)
+            if replica is None or not replica.alive:
+                continue
+            if self.sched.reserved_members(self.capacity.uids[rid]) == 0:
+                self.fleet.kill_now(rid)
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> Dict[str, Any]:
+        self.fleet.begin(self.trace, horizon_s=self.horizon_s)
+        next_train = 0.0
+        next_slo = 0.0
+        while self.clock() < self.horizon_s:
+            self.inj.step(self.dt)
+            now = self.clock()
+            if now >= next_train:
+                next_train = now + self.train_sync_s
+                self._train_tick(now)
+                self._serve_reconcile()
+            self.fleet.service_tick()
+            if now >= next_slo:
+                next_slo = now + self.slo_tick_s
+                self.rrec.slo_tick(now)
+        serving = self.fleet.finish()
+        # finish() just recorded every unserved request as a censored
+        # +inf drop: one last evaluation so a lost tail fires its burn
+        # (the total-outage window rule) instead of expiring unseen
+        self.rrec.slo_tick(self.clock())
+        return self._score(serving)
+
+    # --------------------------------------------------------------- score
+    def _booked_restarts(self, gang: _Gang) -> int:
+        booked = self._evictions_carry.get(gang.key, 0)
+        if self.sched is not None:
+            booked += self.sched.evictions.get(gang.key, 0)
+        booked += self.inj.retryable_kills.get((gang.key, "worker"), 0)
+        return booked
+
+    def _slo_burns(self, job_key: str) -> int:
+        tl = self.frec.timeline(job_key) or {}
+        return sum(
+            1 for e in tl.get("events", [])
+            if e.get("source") == "slo" and e.get("event") == "slo_burn"
+        )
+
+    def transcript(self) -> str:
+        """The full deterministic day: injector log (chaos + scheduler
+        notes) and the fleet's merged router log, in one byte-stable
+        document — what the bench hashes for the per-seed contract."""
+        return (
+            "\n".join(self.inj.log)
+            + "\n-- fleet --\n"
+            + "\n".join(self.fleet.log)
+        )
+
+    def _score(self, serving: Dict[str, Any]) -> Dict[str, Any]:
+        gangs_out = []
+        for gang in self.gangs:
+            slo = self.frec.slo(gang.key) or {}
+            gangs_out.append({
+                "name": gang.spec.name,
+                "priority": gang.spec.priority,
+                "replicas": gang.spec.replicas,
+                "min_replicas": gang.spec.min_replicas,
+                "state": gang.state,
+                "width": gang.width,
+                "restarts_observed": gang.restarts,
+                "restarts_booked": self._booked_restarts(gang),
+                "time_to_running_s": slo.get("time_to_running_s"),
+                "last_restart_mttr_s": slo.get("last_restart_mttr_s"),
+                "last_resize_duration_s": slo.get("last_resize_duration_s"),
+            })
+        slo_axes = self.rrec.slo_status(SERVE_KEY) or {}
+        digest = hashlib.sha256(self.transcript().encode()).hexdigest()
+        return {
+            "seed": self.seed,
+            "hardened": self.hardened,
+            "nodes": len(self.node_names),
+            "requests": len(self.trace),
+            "horizon_s": self.horizon_s,
+            "serving": dict(
+                serving,
+                slo_burns=self._slo_burns(SERVE_KEY),
+                slo_axes=slo_axes.get("axes", {}),
+                scale_out_denied=sum(
+                    1 for e in self.fleet.scale_events
+                    if e["dir"] == "out_denied"
+                ),
+            ),
+            "gangs": gangs_out,
+            "chaos": {
+                "blackouts": self.blackout_events,
+                "kills": dict(self.inj.stats),
+            },
+            "log_sha256": digest,
+        }
+
+
+def run_cluster_day(seed: int = 0, hardened: bool = True,
+                    **kwargs: Any) -> Dict[str, Any]:
+    """One chaos day, scored.  The bench's entry point."""
+    sim = ClusterDaySim(
+        seed=seed, hardened=hardened,
+        chaos=kwargs.pop("chaos", ChaosDay()), **kwargs,
+    )
+    return sim.run()

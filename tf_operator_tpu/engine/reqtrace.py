@@ -61,7 +61,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.engine import timeline as _timeline
@@ -98,6 +98,23 @@ _SLO_MIN_SAMPLES = 5
 _SLO_MAX_SAMPLES = 4096
 _SLO_OFFENDERS_CAP = 10
 _SLO_AXES = ("ttft", "tpot", "queue_wait", "e2e")
+
+
+def _window_gated(vals: Sequence[Any]) -> bool:
+    """Whether a burn window has enough evidence to page.  The
+    min-sample gate suppresses noise-burns off a thin window — but a
+    NON-EMPTY window whose every sample is censored (+inf: drops,
+    scrape-storm casualties) is a total outage, the one regime where
+    few samples is itself the signal.  Gate on (enough samples) OR
+    (all of them censored), so a storm that strands two requests still
+    pages instead of silently skipping the evaluation.  Accepts the
+    pager's (value, rid) windows and the status snapshot's bare
+    value windows."""
+    if len(vals) >= _SLO_MIN_SAMPLES:
+        return True
+    return bool(vals) and all(
+        math.isinf(v[0] if isinstance(v, tuple) else v) for v in vals
+    )
 
 
 class _ReqTimeline:
@@ -512,8 +529,8 @@ class RequestRecorder:
                         # series IS the signal — never export inf/NaN
                         metrics.SERVING_SLO_WINDOW_P99.remove(labels)
                 burning = (
-                    len(fast) >= _SLO_MIN_SAMPLES
-                    and len(slow) >= _SLO_MIN_SAMPLES
+                    _window_gated(fast)
+                    and _window_gated(slow)
                     and burns["fast"] >= threshold
                     and burns["slow"] >= threshold
                 )
@@ -594,9 +611,12 @@ class RequestRecorder:
                         else None
                     ),
                     "samples": len(slow),
+                    # same gate as the pager (_slo_eval): a snapshot
+                    # that says "not burning" during a total outage
+                    # would contradict the burn the pager just fired
                     "burning": (
-                        len(fast) >= _SLO_MIN_SAMPLES
-                        and len(slow) >= _SLO_MIN_SAMPLES
+                        _window_gated(fast)
+                        and _window_gated(slow)
                         and burns["fast"] >= threshold
                         and burns["slow"] >= threshold
                     ),

@@ -356,6 +356,12 @@ class ClusterScheduler:
         self._lock = threading.RLock()
         # node name -> (capacity chips, generation)
         self._nodes: Dict[str, Tuple[int, str]] = {}
+        # cordoned node names: placement never offers them (existing
+        # reservations stay — cordon is "no NEW work", not eviction).
+        # Mirrors spec.unschedulable on the Node object, so the state
+        # survives resync and is visible to other actors (the chaos
+        # kubelet's warm-standby placement consults it)
+        self._cordoned: set = set()
         self._reservations: Dict[str, Reservation] = {}
         # pending gangs: job_uid -> (first time admission failed,
         # job_key, kind) — feeds the bind-latency histogram and the
@@ -374,6 +380,7 @@ class ClusterScheduler:
         with self._lock:
             if event_type == "DELETED":
                 self._nodes.pop(name, None)
+                self._cordoned.discard(name)
             else:
                 self._nodes[name] = (
                     node_chips(node),
@@ -381,6 +388,10 @@ class ClusterScheduler:
                         GENERATION_LABEL, DEFAULT_GENERATION
                     ),
                 )
+                if (node.get("spec") or {}).get("unschedulable"):
+                    self._cordoned.add(name)
+                else:
+                    self._cordoned.discard(name)
             self._update_gauges_locked()
 
     def resync(self) -> None:
@@ -397,12 +408,20 @@ class ClusterScheduler:
             nodes = []
         with self._lock:
             for node in nodes:
-                self._nodes[objects.name_of(node)] = (
+                name = objects.name_of(node)
+                self._nodes[name] = (
                     node_chips(node),
                     objects.labels_of(node).get(
                         GENERATION_LABEL, DEFAULT_GENERATION
                     ),
                 )
+                # cordon state is derived state too: a restarted
+                # scheduler must not re-place onto a node someone
+                # cordoned before the crash
+                if (node.get("spec") or {}).get("unschedulable"):
+                    self._cordoned.add(name)
+                else:
+                    self._cordoned.discard(name)
         try:
             pods = self.cluster.list_pods()
         except (ApiError, OSError):
@@ -488,6 +507,49 @@ class ClusterScheduler:
         with self._lock:
             return self._free_locked()
 
+    # ----------------------------------------------------------------- cordon
+    def cordoned_nodes(self) -> frozenset:
+        with self._lock:
+            return frozenset(self._cordoned)
+
+    def _write_unschedulable(self, node: str, value: bool) -> None:
+        """Mirror cordon state onto the Node object's spec.unschedulable
+        (best-effort: the in-memory set is authoritative for THIS
+        scheduler; the write makes the state survive resync and shows
+        it to other actors — kubectl semantics)."""
+        try:
+            for obj in self.cluster.list("Node"):
+                if objects.name_of(obj) != node:
+                    continue
+                spec = obj.setdefault("spec", {})
+                if bool(spec.get("unschedulable")) == value:
+                    return
+                spec["unschedulable"] = value
+                self.cluster.update("Node", obj)
+                return
+        except (ApiError, OSError):
+            return
+
+    def cordon(self, node: str) -> None:
+        """Mark `node` unschedulable: existing reservations stay (cordon
+        is not eviction), but placement never offers it until
+        uncordon().  Idempotent."""
+        with self._lock:
+            if node in self._cordoned:
+                return
+            self._cordoned.add(node)
+        self._write_unschedulable(node, True)
+        self.note(f"cordon node={node}")
+
+    def uncordon(self, node: str) -> None:
+        """Restore `node` to the schedulable pool.  Idempotent."""
+        with self._lock:
+            if node not in self._cordoned:
+                return
+            self._cordoned.discard(node)
+        self._write_unschedulable(node, False)
+        self.note(f"uncordon node={node}")
+
     def reserved_members(self, job_uid: str) -> int:
         with self._lock:
             res = self._reservations.get(job_uid)
@@ -526,6 +588,11 @@ class ClusterScheduler:
             chips = members[member]
             best_node, best_score = None, None
             for node in sorted(tentative):
+                if node in self._cordoned:
+                    # a draining/cordoned node takes no NEW placements
+                    # — evicted gangs and replenishment must not land
+                    # back on the node mid-drain
+                    continue
                 cap_free = tentative[node]
                 if cap_free < chips:
                     continue
@@ -1145,7 +1212,15 @@ class ClusterScheduler:
         reservation released, so the gang re-enters admission wholesale.
         `kill` is the caller's pod-killer (the chaos injector's
         kill_pod, which books the kill and logs it into the seeded event
-        stream); returns members killed."""
+        stream); returns members killed.
+
+        The node is CORDONED first: the evicted gangs requeue
+        immediately, and without the cordon the very next admission
+        could re-place them onto the node being drained (it has the
+        most free chips by construction).  The cordon persists until an
+        explicit uncordon() — the drain caller decides when the node is
+        healthy again."""
+        self.cordon(node)
         with self._lock:
             victims = sorted(
                 (

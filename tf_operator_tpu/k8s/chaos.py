@@ -446,7 +446,21 @@ class FaultInjector:
         # unscheduled pods, so the historical round-robin (and the seeded
         # chaos goldens, whose pods are never pre-bound) is unchanged
         if not pod["spec"].get("nodeName"):
-            pod["spec"]["nodeName"] = f"chaos-node-{self._node_rr % self.nodes}"
+            # honor cordons: an unscheduled pod (warm-pool standby
+            # replenishment, mostly) must not land on a node mid-drain.
+            # With no scheduler attached or nothing cordoned the pick is
+            # the historical round-robin, byte-identical
+            cordoned = (
+                self.scheduler.cordoned_nodes()
+                if self.scheduler is not None else frozenset()
+            )
+            cand = f"chaos-node-{self._node_rr % self.nodes}"
+            for _ in range(self.nodes):
+                if cand not in cordoned:
+                    break
+                self._node_rr += 1
+                cand = f"chaos-node-{self._node_rr % self.nodes}"
+            pod["spec"]["nodeName"] = cand
         try:
             self.inner.update_pod(pod)
         except (ConflictError, NotFoundError, ApiError):

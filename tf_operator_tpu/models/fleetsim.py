@@ -386,6 +386,21 @@ class FleetHarness:
         self.replica_seconds = 0.0
         self.peak_inflight = 0
         self.router.on_dispatch = self._on_dispatch
+        # cluster-capacity gate (engine/clustersim.py): when set, every
+        # scale-out must acquire chips from the shared Node inventory
+        # first (acquire() -> bool, then bind(rid)), and a removed or
+        # killed replica releases them (release(rid)).  None — every
+        # standalone fleet bench/soak — keeps behavior byte-identical.
+        self.capacity = None
+        # stepped-trace state (begin()/service_tick()/finish()): run()
+        # drives these in a loop; an external harness owning the clock
+        # (clustersim) interleaves its own work between ticks
+        self._pending: "deque[Tuple[float, ServeRequest]]" = deque()
+        self._kills_due: "deque[Tuple[float, str]]" = deque()
+        self._next_hb = 0.0
+        self._next_scale = 0.0
+        self._n_total = 0
+        self._horizon_s = 0.0
         if mode == "static_big":
             self._add_replica(self.cfg.scaled(n_replicas), ready_now=True)
         else:
@@ -431,6 +446,11 @@ class FleetHarness:
         if replica is not None and replica.alive:
             replica.alive = False
             self._log(f"kill replica={rid}")
+            if self.capacity is not None:
+                # a dead replica computes nothing: its chips go back to
+                # the shared inventory (the autoscaler's next scale-out
+                # re-acquires through the same gate)
+                self.capacity.release(rid)
 
     def freeze(self, rid: str) -> None:
         replica = self.replicas.get(rid)
@@ -481,6 +501,8 @@ class FleetHarness:
                 self.router.remove_replica(victim, requeue=timed_out)
                 self.replicas.pop(victim, None)
                 self._blocked_prev.pop(victim, None)
+                if self.capacity is not None:
+                    self.capacity.release(victim)
                 self._log(
                     f"scale_in_done replica={victim}"
                     + (" timeout=1" if timed_out else "")
@@ -495,6 +517,21 @@ class FleetHarness:
             now, fleet, p99, blocked_delta, occupancy
         )
         if decision.direction == "out":
+            if self.capacity is not None and not self.capacity.acquire(now):
+                # the shared inventory said no (a pending higher-
+                # priority training gang owns the chips): lose ONCE and
+                # take the full out-cooldown — retrying every tick
+                # would flap against the scheduler's decision
+                self._log(
+                    f"scale_out_denied trigger={decision.trigger} "
+                    f"value={decision.value:.3f}"
+                )
+                self.scale_events.append({
+                    "dir": "out_denied", "t": now,
+                    "trigger": decision.trigger,
+                })
+                self.policy.acted(now, "out")
+                return
             warm = self.warm_standbys > 0
             latency = self.claim_latency_s if warm else self.cold_latency_s
             if warm:
@@ -503,6 +540,8 @@ class FleetHarness:
                 self._replenish_at.sort()
             rid = self._add_replica(self.cfg, ready_now=False,
                                     latency=latency)
+            if self.capacity is not None:
+                self.capacity.bind(rid)
             self._log(
                 f"scale_out replica={rid} trigger={decision.trigger} "
                 f"value={decision.value:.3f} warm={int(warm)}"
@@ -528,92 +567,103 @@ class FleetHarness:
             )
 
     # ---------------------------------------------------------------- run
-    def run(self, trace: List[Tuple[float, ServeRequest]],
-            horizon_s: float = 400.0) -> dict:
-        pending = deque(trace)
-        kills = deque(self.kills)
-        next_hb = 0.0
-        next_scale = 0.0
-        n_total = len(trace)
-        while (len(self.results) < n_total or pending) and self.clock() < horizon_s:
-            if self.injector is not None:
-                # one beat: advances the SHARED clock and fires due
-                # injector faults (freeze/kill land via the fleet hook)
-                self.injector.step(self.dt)
-            else:
-                self.clock.advance(self.dt)
-            now = self.clock()
-            while pending and pending[0][0] <= now:
-                _, req = pending.popleft()
-                self.arrival_t[req.rid] = now
-                self.router.submit(req)
-            while kills and kills[0][0] <= now:
-                _, rid = kills.popleft()
-                self.kill_now(rid)
-            inflight = sum(
-                r.inflight() for r in self.replicas.values() if r.alive
-            ) + self.router.queue_depth()
-            self.peak_inflight = max(self.peak_inflight, inflight)
+    def begin(self, trace: List[Tuple[float, ServeRequest]],
+              horizon_s: float = 400.0) -> None:
+        """Arm the stepped-trace state.  run() is begin() + a
+        step-until-done loop + finish(); an external harness that owns
+        the clock (engine/clustersim.py) calls begin() once, advances
+        the shared clock itself, and calls service_tick() per beat."""
+        self._pending = deque(trace)
+        self._kills_due = deque(self.kills)
+        self._next_hb = 0.0
+        self._next_scale = 0.0
+        self._n_total = len(trace)
+        self._horizon_s = horizon_s
+
+    def trace_done(self) -> bool:
+        return not (
+            (len(self.results) < self._n_total or self._pending)
+            and self.clock() < self._horizon_s
+        )
+
+    def service_tick(self) -> None:
+        """One service beat at the CURRENT clock (the caller already
+        advanced it by dt): arrivals, scheduled kills, replica service,
+        readiness transitions, heartbeats, router tick, autoscale."""
+        now = self.clock()
+        while self._pending and self._pending[0][0] <= now:
+            _, req = self._pending.popleft()
+            self.arrival_t[req.rid] = now
+            self.router.submit(req)
+        while self._kills_due and self._kills_due[0][0] <= now:
+            _, rid = self._kills_due.popleft()
+            self.kill_now(rid)
+        inflight = sum(
+            r.inflight() for r in self.replicas.values() if r.alive
+        ) + self.router.queue_depth()
+        self.peak_inflight = max(self.peak_inflight, inflight)
+        for rid in sorted(self.replicas):
+            replica = self.replicas[rid]
+            if not replica.alive or rid in self._starting:
+                continue
+            self.replica_seconds += self.dt
+            for rec in replica.step(now - self.dt, self.dt):
+                if self.router.finish(
+                    rid, rec["rid"], tokens=rec["tokens"]
+                ):
+                    self.results[rec["rid"]] = rec
+                else:
+                    self.duplicates += 1
+            if self.hedging and not replica.frozen:
+                # first tokens feed the router's TTFT distribution
+                # (the hedge threshold) and every scan refreshes the
+                # per-request progress anchor; a FROZEN replica's
+                # lanes emit nothing, so they get no refresh and age
+                # into hedge eligibility — exactly the rescue path
+                for lane in replica.lanes:
+                    if lane.first_token_t is not None:
+                        self.router.note_first_token(
+                            rid, lane.req.rid
+                        )
+        for rid, ready_at in sorted(self._starting.items()):
+            if now >= ready_at:
+                del self._starting[rid]
+                hb = self.replicas[rid].heartbeat()
+                self.router.observe(
+                    rid, hb["free_blocks"], hb["total_blocks"],
+                    hb["queue_depth"],
+                )
+        if now >= self._next_hb:
+            self._next_hb = now + self.heartbeat_s
             for rid in sorted(self.replicas):
                 replica = self.replicas[rid]
                 if not replica.alive or rid in self._starting:
                     continue
-                self.replica_seconds += self.dt
-                for rec in replica.step(now - self.dt, self.dt):
-                    if self.router.finish(
-                        rid, rec["rid"], tokens=rec["tokens"]
-                    ):
-                        self.results[rec["rid"]] = rec
-                    else:
-                        self.duplicates += 1
-                if self.hedging and not replica.frozen:
-                    # first tokens feed the router's TTFT distribution
-                    # (the hedge threshold) and every scan refreshes the
-                    # per-request progress anchor; a FROZEN replica's
-                    # lanes emit nothing, so they get no refresh and age
-                    # into hedge eligibility — exactly the rescue path
-                    for lane in replica.lanes:
-                        if lane.first_token_t is not None:
-                            self.router.note_first_token(
-                                rid, lane.req.rid
-                            )
-            for rid, ready_at in sorted(self._starting.items()):
-                if now >= ready_at:
-                    del self._starting[rid]
-                    hb = self.replicas[rid].heartbeat()
-                    self.router.observe(
-                        rid, hb["free_blocks"], hb["total_blocks"],
-                        hb["queue_depth"],
-                    )
-            if now >= next_hb:
-                next_hb = now + self.heartbeat_s
-                for rid in sorted(self.replicas):
-                    replica = self.replicas[rid]
-                    if not replica.alive or rid in self._starting:
+                if self.injector is not None:
+                    fault = self.injector.scrape_fault(rid)
+                    if fault is not None:
+                        # the scrape (heartbeat) of this replica
+                        # failed: no telemetry lands — a missed
+                        # heartbeat the router's ejection ladder
+                        # counts and its health expiry ages
+                        self._log(
+                            f"scrape_fail replica={rid} mode={fault}"
+                        )
+                        self.router.scrape_failed(rid)
                         continue
-                    if self.injector is not None:
-                        fault = self.injector.scrape_fault(rid)
-                        if fault is not None:
-                            # the scrape (heartbeat) of this replica
-                            # failed: no telemetry lands — a missed
-                            # heartbeat the router's ejection ladder
-                            # counts and its health expiry ages
-                            self._log(
-                                f"scrape_fail replica={rid} mode={fault}"
-                            )
-                            self.router.scrape_failed(rid)
-                            continue
-                    hb = replica.heartbeat()
-                    for w in hb["queue_waits"]:
-                        self._wait_window.append((now, w))
-                    self.router.observe(
-                        rid, hb["free_blocks"], hb["total_blocks"],
-                        hb["queue_depth"],
-                    )
-            self.router.tick(now)
-            if self.policy is not None and now >= next_scale:
-                next_scale = now + self.autoscale_interval_s
-                self._autoscale_tick(now)
+                hb = replica.heartbeat()
+                for w in hb["queue_waits"]:
+                    self._wait_window.append((now, w))
+                self.router.observe(
+                    rid, hb["free_blocks"], hb["total_blocks"],
+                    hb["queue_depth"],
+                )
+        self.router.tick(now)
+        if self.policy is not None and now >= self._next_scale:
+            self._next_scale = now + self.autoscale_interval_s
+            self._autoscale_tick(now)
+
+    def finish(self) -> dict:
         if self.reqtrace is not None and self.job_key:
             # the horizon expired on every unfinished request: a `drop`
             # DECISION closes its timeline (and feeds the SLO windows a
@@ -626,7 +676,20 @@ class FleetHarness:
                         self.job_key, req_id, "router", "drop",
                         {"reason": "horizon"}, ts=now,
                     )
-        return self.summary(n_total)
+        return self.summary(self._n_total)
+
+    def run(self, trace: List[Tuple[float, ServeRequest]],
+            horizon_s: float = 400.0) -> dict:
+        self.begin(trace, horizon_s)
+        while not self.trace_done():
+            if self.injector is not None:
+                # one beat: advances the SHARED clock and fires due
+                # injector faults (freeze/kill land via the fleet hook)
+                self.injector.step(self.dt)
+            else:
+                self.clock.advance(self.dt)
+            self.service_tick()
+        return self.finish()
 
     # ------------------------------------------------------------- scoring
     def summary(self, n_total: int) -> dict:
