@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos bench-reqtrace bench-cluster native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-serve-cb bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos bench-reqtrace bench-cluster native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -92,6 +92,16 @@ bench-warmpool:
 bench-paged:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_paged; \
 	print(json.dumps(bench_paged(), indent=1))"
+
+# Slot loop vs token-level continuous batching at a FIXED block pool
+# (ISSUE 19): same prefill-heavy heterogeneous-budget trace, same
+# slots, same pool_blocks — only scheduler= differs.  Headlines:
+# tokens/s ratio (>= 1.5x) and TTFT p99 (strictly better), with greedy
+# token parity asserted in-bench.  Rows land in BENCH_r17.json;
+# bounds pinned by tests/test_bench_infra.py.
+bench-serve-cb:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_serve_cb; \
+	print(json.dumps(bench_serve_cb(), indent=1))"
 
 # Paged decode-step sweep: pallas block-indexed kernel vs table gather
 # vs dense ring at 1/8/32 lanes x block_size 16/64 — per-step time,

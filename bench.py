@@ -1229,6 +1229,171 @@ def bench_paged(gen: str = "cpu", cfg=None, n_requests: int = 12,
     return out
 
 
+def bench_serve_cb(gen: str = "cpu", cfg=None, n_requests: int = 24,
+                   slots: int = 8, block_size: int = 8,
+                   steps_per_sync: int = 8, pool_blocks: int = 32,
+                   prefill_chunk=None, warm: bool = True):
+    """Slot loop vs token-level continuous batching at a FIXED block
+    pool — ISSUE 19's perf evidence (`make bench-serve-cb`,
+    BENCH_r17.json).
+
+    Both arms run serve_loop over the SAME prefill-heavy trace
+    (moderate prompts; generous heterogeneous budgets that act as CAPS
+    because most streams stop at a deterministically chosen eos first —
+    real traffic's shape), the SAME slots, and the SAME pool_blocks;
+    only `scheduler` differs.  The slot loop reserves
+    every request's whole prompt+budget worst case at admission and
+    runs every lane to the steps_per_sync block edge, so the pool's
+    RESERVED blocks cap concurrency well below what its ACTUAL
+    occupancy allows, and post-EOS lane-steps burn dispatches.  The
+    continuous scheduler admits on the blocks-per-step gate
+    (paging.step_gate: next step's demand + a one-block reservation
+    ladder), grows coverage lazily, freezes finished lanes ON DEVICE
+    mid-block, shortens blocks to the longest remaining budget, and
+    fuses admission prefill segments into the decode dispatch
+    (_cb_paged_serve_fns) — so more lanes decode per dispatch and
+    fewer dispatches are spent on frozen rows.  tokens/s is the
+    wall-clock headline; TTFT percentiles (queue wait + prefill, from
+    ServeStats.per_request) are the latency headline; greedy token
+    parity slot==continuous is asserted in-bench.  The occupancy /
+    wasted-step / fused-token columns explain WHERE the ratio comes
+    from — they are allocator/scheduler arithmetic, deterministic on
+    any backend.
+
+    tests/test_bench_infra.py pins the committed artifact's bounds:
+    >= 1.5x tokens/s and strictly better TTFT p99 at equal pool."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models import llama as llm
+    from tf_operator_tpu.models.serving import serve_loop
+
+    if cfg is None:
+        cfg = llm.tiny(dtype=jnp.float32, max_len=256)
+    model = llm.Llama(cfg)
+    key = jax.random.PRNGKey(0)
+    params = jax.tree.map(
+        lambda x: x.astype(cfg.dtype),
+        model.init(key, jnp.zeros((1, 8), jnp.int32),
+                   train=False)["params"])
+    # prefill-heavy trace: moderate prompts, generous budgets with a
+    # long tail — the CAP each request reserves.  Most streams stop at
+    # eos far below it (selected below), so the slot loop's worst-case
+    # reservations are dominated by blocks nobody writes
+    lengths = [[24, 32, 28, 40][i % 4] for i in range(n_requests)]
+    budgets = [(96 if i % 4 == 2 else 48 + (4 * i) % 9)
+               for i in range(n_requests)]
+    prompts = []
+    for n in lengths:
+        key, k = jax.random.split(key)
+        prompts.append(jax.random.randint(k, (n,), 0, cfg.vocab_size))
+
+    bytes_per_token = (cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim
+                       * jnp.dtype(cfg.dtype).itemsize)
+    kw = dict(slots=slots, max_new_tokens=budgets, paged=True,
+              block_size=block_size, pool_blocks=pool_blocks,
+              prefill_chunk=prefill_chunk,
+              steps_per_sync=steps_per_sync)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        if not xs:
+            return None
+        i = max(0, min(len(xs) - 1, int(round(q * (len(xs) - 1)))))
+        return xs[i]
+
+    # real traffic's defining property: max_tokens is a CAP, not a
+    # length — most streams stop at EOS long before it, so the slot
+    # gate's prompt+max_new reservation is mostly blocks nobody will
+    # ever write.  Reproduce that deterministically: run the trace once
+    # eos-free (greedy streams are prefix-stable, so an eos only
+    # truncates them), then pick the token that first appears in the
+    # 3..24 window of the most streams as the eos — a median stop near
+    # ~1/4 of the budget with a genuine long tail (streams missing the
+    # token run their full budget)
+    ref = serve_loop(model, params, prompts, scheduler="slot", **kw)
+    eos, eos_score = 0, -1
+    for t in range(cfg.vocab_size):
+        early = sum(1 for r in ref
+                    if t in r.tokens and 3 <= r.tokens.index(t) <= 24)
+        if early > eos_score:
+            eos, eos_score = t, early
+    kw["eos_id"] = eos
+
+    def run(scheduler):
+        t0 = time.perf_counter()
+        res, stats = serve_loop(model, params, prompts,
+                                scheduler=scheduler, return_stats=True,
+                                **kw)
+        dt = time.perf_counter() - t0
+        return res, stats, dt
+
+    if warm:
+        # warm both arms: jit compiles for every (segment_len, n)
+        # shape the trace produces — the measured pass replays the
+        # identical shapes, so compile time stays out of the ratio
+        run("slot")
+        run("continuous")
+    s_res, s_stats, t_slot = run("slot")
+    c_res, c_stats, t_cont = run("continuous")
+    parity = [r.tokens for r in s_res] == [r.tokens for r in c_res]
+    n_tok = sum(len(r.tokens) for r in c_res)
+
+    def arm(stats, res, dt):
+        # TTFT from arrival: queue wait + admission-to-first-token
+        # (every request is queued at loop start, so this is the
+        # latency a caller actually saw)
+        ttfts = [r["queue_wait_s"] + r["ttft_s"]
+                 for r in stats.per_request]
+        return {
+            "scheduler": stats.scheduler,
+            "tokens": sum(len(r.tokens) for r in res),
+            "wall_time_s": round(dt, 4),
+            "tokens_per_sec": round(
+                sum(len(r.tokens) for r in res) / dt, 1),
+            "ttft_p50_s": round(pct(ttfts, 0.50), 6),
+            "ttft_p99_s": round(pct(ttfts, 0.99), 6),
+            "occupancy_mean": round(stats.occupancy_mean, 2),
+            "occupancy_max": stats.occupancy_max,
+            "kv_blocks_peak_used": stats.kv_blocks_peak_used,
+            "wasted_lane_steps": stats.wasted_lane_steps,
+            "fused_prefill_tokens": stats.fused_prefill_tokens,
+            "preemptions": stats.preemptions,
+            "admissions_blocked_on_memory":
+                stats.admissions_blocked_on_memory,
+        }
+
+    slot_row = arm(s_stats, s_res, t_slot)
+    cont_row = arm(c_stats, c_res, t_cont)
+    return {
+        "requests": n_requests,
+        "slots": slots,
+        "block_size": block_size,
+        "pool_blocks": pool_blocks,
+        "prefill_chunk": prefill_chunk,
+        "steps_per_sync": steps_per_sync,
+        "prompt_lens": f"{min(lengths)}..{max(lengths)}",
+        "budgets": f"{min(budgets)}..{max(budgets)}",
+        "eos_id": eos,
+        "requests_stopped_early": sum(
+            1 for r, b in zip(c_res, budgets) if len(r.tokens) < b),
+        "total_tokens": n_tok,
+        "pool_alloc_bytes": int((pool_blocks + 1) * block_size
+                                * bytes_per_token),
+        "token_parity_slot_vs_continuous": parity,
+        "slot": slot_row,
+        "continuous": cont_row,
+        "tokens_per_sec_cb_over_slot": round(
+            cont_row["tokens_per_sec"] / slot_row["tokens_per_sec"], 2),
+        "ttft_p99_slot_over_cb": round(
+            slot_row["ttft_p99_s"] / cont_row["ttft_p99_s"], 2),
+        "wasted_steps_slot_over_cb": (
+            round(slot_row["wasted_lane_steps"]
+                  / cont_row["wasted_lane_steps"], 2)
+            if cont_row["wasted_lane_steps"] else None),
+    }
+
+
 def bench_paged_decode(gen: str = "cpu", cfg=None,
                        lanes_sweep=(1, 8, 32), block_sizes=(16, 64),
                        seq_fill: int = 48, n_steps: int = 4,
@@ -2372,12 +2537,26 @@ def bench_fleet(
         scale_out_queue_wait_p99_s=1.5, scale_out_blocked_admissions=4,
         scale_in_occupancy_floor=0.2,
     )
+    from tf_operator_tpu.models.fleetsim import ReplicaConfig
+
     arms = (
         ("static_big", "static_big", dict(n_replicas=fixed_fleet)),
         ("round_robin", "round_robin", dict(n_replicas=fixed_fleet)),
         ("occupancy_autoscale", "occupancy", dict(
             n_replicas=min_replicas, autoscale=auto,
             warm_standbys=warm_standbys,
+        )),
+        # ISSUE 19: the same occupancy+autoscale fleet with replicas
+        # modeling serve_loop(scheduler="continuous") — per-step
+        # admission (prompt coverage + reservation ladder instead of
+        # the whole prompt+max_new worst case) and fair-share prefill
+        # instead of the sequential head-of-line channel.  The delta
+        # vs occupancy_autoscale is how much the slot-loop replica
+        # model OVERSTATED queue wait
+        ("occupancy_autoscale_cb", "occupancy", dict(
+            n_replicas=min_replicas, autoscale=auto,
+            warm_standbys=warm_standbys,
+            replica_cfg=ReplicaConfig(continuous=True),
         )),
     )
     rows = []
@@ -2409,6 +2588,16 @@ def bench_fleet(
             ),
             "max_scale_out_reaction_s": (
                 max(reactions) if reactions else None
+            ),
+            # slot-model queue wait over continuous-model queue wait on
+            # the identical fleet: how much the sequential-prefill +
+            # worst-case-admission replica model overstated waiting
+            "queue_wait_p99_slot_over_cb": (
+                round(occ["queue_wait_p99_s"]
+                      / by["occupancy_autoscale_cb"]["queue_wait_p99_s"],
+                      2)
+                if by["occupancy_autoscale_cb"]["queue_wait_p99_s"]
+                else None
             ),
         },
     }

@@ -82,6 +82,13 @@ _REQUIRED_FAMILIES = {
     "tpu_operator_serving_slo_burns_total": "Counter",
     "tpu_operator_serving_request_timeline_events_total": "Counter",
     "tpu_operator_serving_request_timeline_evictions_total": "Counter",
+    # iteration-level scheduling (ISSUE 19): the continuous scheduler's
+    # step-mix gauges and the wasted-lane-step counter —
+    # docs/monitoring.md's fused-prefill-ratio and wasted-step-rate
+    # PromQL read these by name
+    "tpu_operator_serving_step_decode_rows": "Gauge",
+    "tpu_operator_serving_step_prefill_tokens": "Gauge",
+    "tpu_operator_serving_lane_wasted_steps_total": "Counter",
 }
 
 

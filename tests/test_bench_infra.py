@@ -500,6 +500,13 @@ def test_bench_fleet_occupancy_beats_round_robin_deterministically():
     # scale-in happened and drained without dropping anything (the
     # completeness assertions above already prove no loss)
     assert occ["scale_in_events"] > 0
+    # ISSUE 19's rider arm: the same fleet with continuous-batching
+    # replicas (per-step admission + fair-share prefill) waits no
+    # longer than the slot-model fleet — SimClock-deterministic
+    cb = by["occupancy_autoscale_cb"]
+    assert cb["completed"] == r["requests"]
+    assert cb["queue_wait_p99_s"] <= occ["queue_wait_p99_s"]
+    assert cb["ttft_p99_s"] <= occ["ttft_p99_s"]
 
 
 def test_bench_fleet_chaos_hardened_router_bounds():
@@ -577,6 +584,54 @@ def test_bench_reqtrace_committed_artifact_holds_contract():
     )
     abs_ok = r["per_request_overhead_us"] <= 150.0
     assert rel_ok or abs_ok
+
+
+def test_bench_serve_cb_live_runs_and_holds_parity():
+    """bench_serve_cb (ISSUE 19) on a reduced trace: both scheduler
+    arms complete the same requests with identical greedy tokens, the
+    continuous arm demonstrably used its machinery (fused prefill
+    segments, early eos stops), and the report carries both headline
+    ratios.  No wall-clock bound on the live run (shared-box noise);
+    the committed artifact's bounds are checked separately."""
+    r = bench.bench_serve_cb(n_requests=6, warm=False)
+    assert r["token_parity_slot_vs_continuous"] is True
+    assert r["slot"]["tokens"] == r["continuous"]["tokens"] > 0
+    assert r["continuous"]["fused_prefill_tokens"] > 0
+    assert r["requests_stopped_early"] > 0
+    assert isinstance(r["tokens_per_sec_cb_over_slot"], float)
+    assert isinstance(r["ttft_p99_slot_over_cb"], float)
+
+
+def test_bench_serve_cb_committed_artifact_holds_bounds():
+    """BENCH_r17.json is the committed evidence for ISSUE 19's tentpole
+    claim: at an EQUAL block pool over the same eos-capped trace, the
+    continuous scheduler delivers >= 1.5x tokens/s AND a strictly
+    better TTFT p99 than the slot loop, with greedy token parity.
+    Bounds re-derived from the recorded per-arm rows so the summary
+    ratios cannot drift from the data they summarize."""
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_r17.json"
+    )
+    with open(path) as fh:
+        r = json.load(fh)
+    slot, cont = r["slot"], r["continuous"]
+    # same work, same memory: the comparison is honest by construction
+    assert r["token_parity_slot_vs_continuous"] is True
+    assert slot["tokens"] == cont["tokens"] > 0
+    assert slot["kv_blocks_peak_used"] <= r["pool_blocks"]
+    assert cont["kv_blocks_peak_used"] <= r["pool_blocks"]
+    # the tentpole bounds, from the recorded arm numbers
+    assert cont["tokens_per_sec"] >= 1.5 * slot["tokens_per_sec"]
+    assert cont["ttft_p99_s"] < slot["ttft_p99_s"]
+    # WHERE the ratio comes from: more lanes actually decoding per
+    # dispatch, prefill fused into decode steps, and the eos-capped
+    # trace that leaves the slot loop's reservations unused
+    assert cont["occupancy_mean"] > slot["occupancy_mean"]
+    assert cont["fused_prefill_tokens"] > 0
+    assert slot["fused_prefill_tokens"] == 0
+    assert r["requests_stopped_early"] > 0
 
 
 def test_merge_bucket_percentiles_reads_merged_histograms():

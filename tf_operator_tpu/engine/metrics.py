@@ -836,6 +836,33 @@ SERVING_KV_WINDOW_EVICTED = Counter(
     "(and copied only while still partially visible); compare with "
     "the CoW-copy rate to see window pressure vs prefix-boundary cost",
 )
+# Iteration-level scheduling (ISSUE 19): the continuous scheduler's
+# step-mix families — what one device dispatch actually carried, and
+# the post-finish lane-steps both schedulers discard
+SERVING_STEP_DECODE_ROWS = Gauge(
+    f"{PREFIX}_serving_step_decode_rows",
+    "Decode lanes advanced by the most recent serving dispatch (the "
+    "ragged step's decode side; 0 between runs) — under the continuous "
+    "scheduler this is the iteration batch the admission gate filled, "
+    "under the slot loop it equals the block's busy-lane count",
+)
+SERVING_STEP_PREFILL_TOKENS = Gauge(
+    f"{PREFIX}_serving_step_prefill_tokens",
+    "Prefill tokens fused into the most recent serving dispatch beside "
+    "its decode rows (continuous scheduler, paged mode: one admitted "
+    "prompt's segment rides the same device step; 0 for slot-loop and "
+    "unfused dispatches) — the fused-prefill ratio vs "
+    "serving_step_decode_rows shows how much prefill the fleet hides "
+    "inside decode steps",
+)
+SERVING_LANE_WASTED_STEPS = Counter(
+    f"{PREFIX}_serving_lane_wasted_steps_total",
+    "Lane-steps computed for already-finished lanes: the slot loop "
+    "runs every lane to the steps_per_sync block edge and discards the "
+    "post-EOS tail; the continuous scheduler freezes lanes on-device "
+    "mid-block, leaving only the freeze-to-edge residue — a shrinking "
+    "rate here is the iteration scheduler paying off",
+)
 # Request flight recorder + windowed SLO engine (ISSUE 16,
 # engine/reqtrace.py): per-request causal timelines on the serving
 # plane, and multi-window burn rates of the latency axes (TTFT / TPOT /

@@ -55,6 +55,14 @@ class ReplicaConfig:
     block_size: int = 16
     prefill_tps: float = 1500.0    # sequential prefill channel, tokens/s
     decode_tps: float = 32.0       # per-lane decode, tokens/s
+    # iteration-level scheduling (ISSUE 19): admission charges only the
+    # PROMPT's block coverage plus a one-block-per-lane reservation
+    # ladder (serving.paging.step_gate), decode-time block demand grows
+    # lazily (grow-or-stall under pressure), and prefill is per-step
+    # fair-share across admitted lanes instead of a sequential
+    # head-of-line channel — the serve_loop(scheduler="continuous")
+    # stand-in.  Default False keeps every existing golden byte-stable
+    continuous: bool = False
 
     def scaled(self, n: int) -> "ReplicaConfig":
         return ReplicaConfig(
@@ -63,6 +71,7 @@ class ReplicaConfig:
             block_size=self.block_size,
             prefill_tps=self.prefill_tps * n,
             decode_tps=self.decode_tps,
+            continuous=self.continuous,
         )
 
 
@@ -130,8 +139,17 @@ class SimReplica:
         admitted_any = False
         while self.queue and len(self.lanes) < self.cfg.slots:
             req, arrival_t = self.queue[0]
-            blocks = req.blocks(self.cfg.block_size)
-            if blocks > self.free_blocks:
+            if self.cfg.continuous:
+                # blocks-per-step gate: the prompt's own coverage now
+                # plus a one-block reservation per in-flight lane
+                # (their next decode block's growth) — decode blocks
+                # accrue lazily in step()
+                blocks = -(-req.prompt_len // self.cfg.block_size)
+                gate = blocks + len(self.lanes)
+            else:
+                blocks = req.blocks(self.cfg.block_size)
+                gate = blocks
+            if gate > self.free_blocks:
                 if not admitted_any and now - self._last_blocked_t >= 0.25:
                     # memory gate holds the FIFO head: one blocked
                     # sample per service iteration, like the serve loop
@@ -162,10 +180,29 @@ class SimReplica:
         # same-tick dispatch -> admit pair must not read time-reversed
         self._admit(now, now + dt)
         done: List[dict] = []
-        # ONE sequential prefill channel: the earliest-admitted lane
-        # still prefilling gets the whole budget (serve_loop prefills
-        # off-batch, one row at a time)
+        # Prefill channel.  Slot loop: ONE sequential channel — the
+        # earliest-admitted lane still prefilling gets the whole budget
+        # (serve_loop prefills off-batch, one row at a time, so a long
+        # prompt is head-of-line latency for everyone behind it).
+        # Continuous: per-step FAIR SHARE — every admitted lane's
+        # segments interleave through the fused dispatches, so the
+        # channel splits evenly across prefilling lanes.
         budget = self.cfg.prefill_tps * dt
+        if self.cfg.continuous:
+            filling = [ln for ln in self.lanes if ln.prefill_left > 0]
+            share = budget / len(filling) if filling else 0.0
+            for lane in filling:
+                used = min(lane.prefill_left, share)
+                lane.prefill_left -= used
+                if lane.prefill_left <= 0:
+                    self._rrecord(lane.req.rid, "prefill_chunk", {
+                        "replica": self.rid,
+                        "tokens": int(lane.req.prompt_len),
+                        "duration": round(
+                            lane.req.prompt_len / self.cfg.prefill_tps,
+                            6),
+                    }, now + dt)
+            budget = 0.0
         for lane in self.lanes:
             if lane.prefill_left <= 0 or budget <= 0:
                 continue
@@ -183,10 +220,26 @@ class SimReplica:
                         lane.req.prompt_len / self.cfg.prefill_tps, 6
                     ),
                 }, now + dt)
-        # decode: every prefilled lane emits tokens
+        # decode: every prefilled lane emits tokens.  Continuous lanes
+        # were admitted with prompt-only coverage, so their block
+        # demand GROWS as tokens accrue — grow-or-stall: a lane the
+        # pool can't grow skips this step's emission (the real
+        # scheduler preempts-to-queue; stalling is the deterministic
+        # fluid-model equivalent and frees nothing retroactively)
         for lane in list(self.lanes):
             if lane.prefill_left > 0:
                 continue
+            if self.cfg.continuous:
+                emit = min(self.cfg.decode_tps * dt,
+                           lane.req.max_new - lane.tokens_out)
+                need = -(-int(lane.req.prompt_len + lane.tokens_out
+                              + emit) // self.cfg.block_size)
+                grow = need - lane.blocks
+                if grow > 0:
+                    if grow > self.free_blocks:
+                        continue  # stall this step; retry next tick
+                    self.free_blocks -= grow
+                    lane.blocks = need
             lane.tokens_out += self.cfg.decode_tps * dt
             if lane.first_token_t is None and lane.tokens_out >= 1.0:
                 lane.first_token_t = now + dt

@@ -271,3 +271,20 @@ def fits_kernel(l: int, n_heads: int, n_kv_heads: int) -> bool:
     budget (the chunked-prefill variant is the same kernel at L > 1);
     callers fall back to the gather path above the bound."""
     return l * (n_heads // n_kv_heads) <= _MAX_Q_ROWS
+
+
+def ragged_step_on_kernel(seg_len: int, n_heads: int,
+                          n_kv_heads: int) -> bool:
+    """Ragged step entry (ISSUE 19): the continuous scheduler's fused
+    dispatch carries B decode rows of one token each PLUS one prefill
+    row of `seg_len` tokens over the same block pool
+    (models/serving._cb_paged_serve_fns).  Each row class reaches this
+    module as its own contraction — decode rows at L=1, the segment at
+    L=seg_len — and llama's attention falls back to the gather oracle
+    PER CALL when a tile overflows, so fusion is always correct; this
+    predicate says whether the WHOLE ragged step stays on the pallas
+    path (the perf planning question: a fused step whose prefill side
+    drops to gather still saves the dispatch, not the kernel).  Use it
+    to pick a prefill_chunk that keeps fused steps kernel-resident."""
+    return (fits_kernel(1, n_heads, n_kv_heads)
+            and fits_kernel(seg_len, n_heads, n_kv_heads))

@@ -369,6 +369,34 @@ class WindowRotation:
         return edits, released, evicted
 
 
+def blocks_to_cover(upto_tokens: int, covered_blocks: int,
+                    block_size: int) -> int:
+    """Marginal blocks a lane's LINEAR table needs to cover positions
+    [0, upto_tokens), given `covered_blocks` entries already allocated
+    (shared prefix + CoW + private alike — coverage is table entries,
+    whatever their ownership).  The unit of the blocks-per-step gate:
+    the continuous scheduler allocates coverage lazily, per prefill
+    segment and per decode block, instead of reserving the whole
+    prompt + max_new worst case at admission."""
+    return max(0, blocks_for(upto_tokens, block_size) - covered_blocks)
+
+
+def step_gate(free_blocks: int, need_now: int, in_flight_lanes: int,
+              ladder_per_lane: int = 1) -> bool:
+    """The blocks-per-step admission gate: admit a newcomer when the
+    pool covers its NEXT step's block demand (`need_now` — the first
+    prefill segment's coverage beyond shared-prefix increfs, which cost
+    zero new blocks) plus a reservation ladder of `ladder_per_lane`
+    blocks per in-flight request.  The ladder keeps one decode-step's
+    growth headroom for every lane already admitted, so a newcomer
+    cannot take the block an in-flight lane needs to cross its next
+    block boundary; deeper shortfalls (every lane growing at once into
+    a full pool) are handled by preempt-to-queue, not refused admission
+    — the whole-request worst-case charge plan_request makes is exactly
+    what this gate replaces."""
+    return free_blocks >= need_now + ladder_per_lane * in_flight_lanes
+
+
 def plan_request(prompt_len: int, max_new_tokens: int, headroom: int,
                  block_size: int, prefix_len: int = 0):
     """Admission block math for one request whose FULL prompt (prefix

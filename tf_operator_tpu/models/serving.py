@@ -69,6 +69,7 @@ from typing import Any, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tf_operator_tpu.models import llama as _llama
 from tf_operator_tpu.models.telemetry import ServeTelemetry
@@ -277,8 +278,138 @@ def _paged_spec_serve_fns(model, draft, k: int, temperature: float,
     return spec_block
 
 
+@functools.lru_cache(maxsize=8)
+def _cb_serve_fns(model, temperature: float, top_k: int, top_p: float,
+                  params_transform=None):
+    """Jitted decode block for the CONTINUOUS (iteration-level)
+    scheduler: _serve_fns.step plus ON-DEVICE finish detection.  The
+    scan carry grows a frozen mask and a per-lane remaining-budget
+    vector; a lane that emits EOS (eos rides as a traced int32, -1 =
+    never matches) or spends its budget freezes INSIDE the block — its
+    position pins and later scan steps neither advance nor emit for it
+    (the per-step live mask tells the host exactly which tokens are
+    real, so there is no overshoot to discard).  The slot loop instead
+    runs every lane to the block edge and discards host-side; both
+    schedulers emit the same token stream — freezing changes what a
+    dead lane costs, never what a live lane computes."""
+    xform = params_transform or (lambda p: p)
+
+    @functools.partial(jax.jit, donate_argnums=(1,), static_argnums=(8,))
+    def step(params, cache, tok, pos, frozen, left, eos_t, key,
+             n_steps: int):
+        def body(carry, k):
+            cache, tok, pos, frozen, left = carry
+            logits, cache = model.apply(
+                {"params": xform(params)}, tok[:, None], cache=cache,
+                cache_pos=pos)
+            nxt = _llama._select_token(logits[:, 0], temperature, k,
+                                       top_k, top_p)
+            nxt = jnp.where(frozen, tok, nxt)
+            live = ~frozen
+            done = live & ((nxt == eos_t) | (left <= 1))
+            pos = jnp.where(frozen, pos, pos + 1)
+            left = jnp.where(frozen, left, left - 1)
+            frozen = frozen | done
+            return (cache, nxt, pos, frozen, left), (nxt, live)
+
+        (cache, tok, pos, frozen, left), (toks, lives) = jax.lax.scan(
+            body, (cache, tok, pos, frozen, left),
+            jax.random.split(key, n_steps))
+        return cache, tok, pos, toks, lives  # [n_steps, B] each
+
+    return step
+
+
+@functools.lru_cache(maxsize=8)
+def _cb_paged_serve_fns(model, temperature: float, top_k: int,
+                        top_p: float, params_transform=None,
+                        paged_kernel: str = "pallas"):
+    """_cb_serve_fns' paged twin plus the FUSED prefill+decode steps:
+    ONE jitted dispatch that writes a newcomer's prefill segment into
+    its blocks (routed by its own single-row table — the paged kernel's
+    multi-token-q path handles the segment's row length) AND runs the
+    decode block for every live lane (routed by the batch table).  This
+    is the iteration scheduler's ragged step: decode rows at one token
+    each beside a prefill row of segment-many tokens, over one shared
+    block pool, one device round-trip instead of two.  The two writes
+    are block-disjoint by the allocator (a pending lane's batch-table
+    row is still all scratch until activation), so fusion changes
+    dispatch count, never math.  fused_fill selects the segment's
+    first token INSIDE the jit (greedy-identical to the host-side
+    chunk_fill selection; it rides the same device_get the decode
+    tokens already pay, instead of an extra eager select + sync per
+    activation); fused_write is the lm_head-skipping twin for
+    non-final segments."""
+    xform = params_transform or (lambda p: p)
+
+    def _decode_scan(params, cache, tok, pos, frozen, left, eos_t,
+                     table, key, n_steps):
+        def body(carry, k):
+            cache, tok, pos, frozen, left = carry
+            logits, cache = model.apply(
+                {"params": xform(params)}, tok[:, None], cache=cache,
+                cache_pos=pos, block_table=table,
+                paged_kernel=paged_kernel)
+            nxt = _llama._select_token(logits[:, 0], temperature, k,
+                                       top_k, top_p)
+            nxt = jnp.where(frozen, tok, nxt)
+            live = ~frozen
+            done = live & ((nxt == eos_t) | (left <= 1))
+            pos = jnp.where(frozen, pos, pos + 1)
+            left = jnp.where(frozen, left, left - 1)
+            frozen = frozen | done
+            return (cache, nxt, pos, frozen, left), (nxt, live)
+
+        (cache, tok, pos, frozen, left), (toks, lives) = jax.lax.scan(
+            body, (cache, tok, pos, frozen, left),
+            jax.random.split(key, n_steps))
+        return cache, tok, pos, toks, lives
+
+    @functools.partial(jax.jit, donate_argnums=(1,), static_argnums=(9,))
+    def step(params, cache, tok, pos, frozen, left, eos_t, table, key,
+             n_steps: int):
+        return _decode_scan(params, cache, tok, pos, frozen, left,
+                            eos_t, table, key, n_steps)
+
+    @functools.partial(jax.jit, donate_argnums=(1,),
+                       static_argnums=(13,))
+    def fused_fill(params, cache, tok, pos, frozen, left, eos_t, table,
+                   segment, seg_pos, seg_table, lane, key,
+                   n_steps: int):
+        seg_logits, cache = model.apply(
+            {"params": xform(params)}, segment, cache=cache,
+            cache_pos=seg_pos, block_table=seg_table,
+            paged_kernel=paged_kernel)
+        k_scan, k_first = jax.random.split(key)
+        cache, tok, pos, toks, lives = _decode_scan(
+            params, cache, tok, pos, frozen, left, eos_t, table,
+            k_scan, n_steps)
+        first = _llama._select_token(seg_logits[:, -1], temperature,
+                                     k_first, top_k, top_p)[0]
+        # activate the newcomer in-jit: its first sampled token and
+        # prompt-end position land in the lane's decode rows for the
+        # NEXT block (the lane was frozen through this one), saving the
+        # host two eager scatter dispatches per admission
+        tok = tok.at[lane].set(first)
+        pos = pos.at[lane].set(seg_pos + segment.shape[1])
+        return cache, tok, pos, toks, lives, first
+
+    @functools.partial(jax.jit, donate_argnums=(1,),
+                       static_argnums=(12,))
+    def fused_write(params, cache, tok, pos, frozen, left, eos_t, table,
+                    segment, seg_pos, seg_table, key, n_steps: int):
+        _, cache = model.apply(
+            {"params": xform(params)}, segment, cache=cache,
+            cache_pos=seg_pos, block_table=seg_table,
+            paged_kernel=paged_kernel, return_hidden=True)
+        return _decode_scan(params, cache, tok, pos, frozen, left,
+                            eos_t, table, key, n_steps)
+
+    return step, fused_fill, fused_write
+
+
 def serve_loop(model, params, requests: Sequence[Any], *,
-               slots: int = 4, max_new_tokens: int = 64,
+               slots: int = 4, max_new_tokens=64,
                eos_id: Optional[int] = None,
                cache_len: Optional[int] = None,
                temperature: float = 0.0, top_k: int = 0,
@@ -294,11 +425,50 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                paged: bool = False, block_size: int = 64,
                pool_blocks: Optional[int] = None,
                paged_kernel: Optional[str] = None,
+               scheduler: str = "slot",
                telemetry: Optional[ServeTelemetry] = None,
                return_stats: bool = False):
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
     with continuous admission; returns a ServeResult per request, in
     request order.
+
+    max_new_tokens: one int for every request, or a sequence of
+    per-request budgets (len == len(requests)) — real traffic carries
+    heterogeneous max_tokens, and the schedulers below exploit the
+    variance (a short-budget lane frees early).  Every budget bound
+    below (cache sizing, admission block math) uses the request's OWN
+    budget; greedy parity vs per-request llama.generate holds either
+    way.
+
+    scheduler: "slot" (default) or "continuous".  The SLOT loop is the
+    block-synchronous oracle: lanes admit/evict only at steps_per_sync
+    boundaries, a finishing lane computes to the block edge and the
+    host discards the overshoot, and paged admission reserves the
+    request's whole prompt+max_new worst case.  "continuous" is
+    token-level ITERATION SCHEDULING (the Orca recipe): finish
+    detection moves ON DEVICE (a lane freezes the step it emits EOS or
+    spends its budget — zero token overshoot), blocks shorten to the
+    longest remaining budget, freed lanes refill at every sync, paged
+    prefill segments FUSE into the same device dispatch as ongoing
+    decodes (one round-trip carries decode rows + a prefill row —
+    _cb_paged_serve_fns), and the paged memory gate reasons in
+    blocks-per-step (paging.step_gate): admission charges the first
+    prefill segment's coverage plus a one-block reservation ladder per
+    in-flight request, coverage grows lazily per segment/per block, and
+    pool pressure preempts the YOUNGEST lane back to the queue head
+    (blocks freed, prefill recomputed on re-admission) instead of
+    refusing newcomers — shared-prefix increfs cost zero new blocks in
+    the gate, exactly as they cost the pool.  Greedy tokens are
+    IDENTICAL between the two schedulers across every cache mode
+    (tests/test_zcontbatch.py's matrix): greedy continuations depend
+    only on the prompt, so scheduling — including a preemption's
+    re-prefill — can never change them.  Sampling keeps its
+    procedure-level contract (draws differ between schedulers, as they
+    already do across steps_per_sync values).  Windowed and speculative
+    lanes keep their worst-case reservations under "continuous" (a
+    window ring IS its per-step bound; a verify round writes spec_k+1
+    positions at once) — they gain iteration-level admission/eviction
+    and shortened blocks, not lazy growth.
 
     cache_len: per-slot KV slots (default: a 128-bucket of the worst
     case, prompt+new, via llama.auto_cache_len on the longest prompt;
@@ -427,6 +597,11 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     calls; sampling draws its keys from the serve loop's own stream (the
     procedure, not the key path, matches)."""
     cfg = model.cfg
+    if scheduler not in ("slot", "continuous"):
+        raise ValueError(
+            f"scheduler must be 'slot' or 'continuous', got "
+            f"{scheduler!r}")
+    continuous = scheduler == "continuous"
     reqs = [jnp.asarray(r, jnp.int32).reshape(-1) for r in requests]
     if not reqs:
         # zero requests is still a (trivial) run: the telemetry reports
@@ -434,9 +609,23 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         # occupancy by stats.slots never sees a phantom 0, and a
         # caller-supplied telemetry object completes its lifecycle
         tel = telemetry if telemetry is not None else ServeTelemetry()
-        tel.loop_started(0, slots, draft is not None)
+        tel.loop_started(0, slots, draft is not None,
+                         scheduler=scheduler)
         stats = tel.finalize()
         return ([], stats) if return_stats else []
+    if isinstance(max_new_tokens, (int, jnp.integer)):
+        budgets = [int(max_new_tokens)] * len(reqs)
+    else:
+        budgets = [int(b) for b in max_new_tokens]
+        if len(budgets) != len(reqs):
+            raise ValueError(
+                f"max_new_tokens sequence has {len(budgets)} entries "
+                f"for {len(reqs)} requests — one budget per request")
+    for i, b in enumerate(budgets):
+        if b < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {b} (request {i})")
+    max_new = max(budgets)
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}")
@@ -462,9 +651,6 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         reqs = [jnp.concatenate([prefix, r]) for r in reqs]
     if slots < 1:
         raise ValueError(f"slots must be >= 1, got {slots}")
-    if max_new_tokens < 1:
-        raise ValueError(
-            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if steps_per_sync < 1:
         raise ValueError(
             f"steps_per_sync must be >= 1, got {steps_per_sync}")
@@ -580,13 +766,18 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         if r.shape[0] < 1:
             raise ValueError(f"request {i} is empty")
         for name, c in model_cfgs:
-            if r.shape[0] + max_new_tokens + headroom > c.max_len:
+            if r.shape[0] + budgets[i] + headroom > c.max_len:
                 raise ValueError(
                     f"request {i}: prompt {r.shape[0]} + new "
-                    f"{max_new_tokens}"
+                    f"{budgets[i]}"
                     + (f" (+{headroom} speculation headroom)" if spec
                        else "")
                     + f" exceeds max_len {c.max_len} ({name})")
+    # the binding worst case over PER-REQUEST budgets (with one shared
+    # budget this is exactly the old longest + max_new)
+    worst_i = max(range(len(reqs)),
+                  key=lambda i: int(reqs[i].shape[0]) + budgets[i])
+    worst_total = int(reqs[worst_i].shape[0]) + budgets[worst_i]
     if not paged:
         if cache_len is None:
             # size for EVERY model in play; under speculation a windowed
@@ -599,7 +790,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     (dataclasses.replace(c, sliding_window=c.sliding_window
                                          + spec_k)
                      if spec and c.sliding_window is not None else c),
-                    longest, longest + max_new_tokens + headroom,
+                    longest, worst_total + headroom,
                     prefill_chunk)
                 for _n, c in model_cfgs)
         # each model's ring is capped at ITS max_len (the RoPE-table bound
@@ -613,14 +804,15 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         # window (+ spec_k under speculation — the wrapped verify write's
         # aliased slots must land outside every live query's band,
         # speculative._spec_cache_len's bound) resident
-        worst = longest + max_new_tokens + headroom
+        worst = worst_total + headroom
         for name, c in model_cfgs:
             if c.sliding_window is None and worst > eff_len[name]:
                 raise ValueError(
-                    f"request {longest_i}: prompt {longest} + new "
-                    f"{max_new_tokens} (+{headroom} headroom) exceeds "
-                    f"cache length {eff_len[name]} — a full-causal "
-                    f"{name} model cannot stream past its cache")
+                    f"request {worst_i}: prompt {reqs[worst_i].shape[0]}"
+                    f" + new {budgets[worst_i]} (+{headroom} headroom) "
+                    f"exceeds cache length {eff_len[name]} — a "
+                    f"full-causal {name} model cannot stream past its "
+                    f"cache")
             if c.sliding_window is not None:
                 need = min(c.sliding_window + (spec_k if spec else 0),
                            worst)
@@ -655,7 +847,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         if windowed:
             w = cfg.sliding_window
             ring_len = _llama.auto_cache_len(
-                cfg, longest, longest + max_new_tokens, prefill_chunk)
+                cfg, longest, worst_total, prefill_chunk)
             # block-align the ring: with a chunk it is already a chunk
             # multiple (and chunk % block_size == 0 was enforced);
             # rounding past max_len is harmless — ring slots are cache
@@ -672,7 +864,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     f"shrink the prefix or use the dense ring")
             for i, r in enumerate(reqs):
                 chunk = _effective_chunk(int(r.shape[0]))
-                total_i = int(r.shape[0]) + max_new_tokens
+                total_i = int(r.shape[0]) + budgets[i]
                 if chunk is None and r.shape[0] > ring_len:
                     raise ValueError(
                         f"request {i}: prompt {r.shape[0]} exceeds the "
@@ -686,16 +878,17 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             # EOS/budget, and those overshoot writes wrap the modular
             # table too — the rotation shadows must cover them
             plans = [paging.plan_window_request(
-                int(r.shape[0]), max_new_tokens, block_size, t_blocks,
-                p_fix, write_slack=steps_per_sync - 1) for r in reqs]
+                int(r.shape[0]), budgets[i], block_size, t_blocks,
+                p_fix, write_slack=steps_per_sync - 1)
+                for i, r in enumerate(reqs)]
         else:
             t_blocks = paging.blocks_for(
-                longest + max_new_tokens + headroom, block_size)
+                worst_total + headroom, block_size)
             # linear plans carry rotated=0: no slot ever wraps
             plans = [paging.plan_request(int(r.shape[0]),
-                                         max_new_tokens, headroom,
+                                         budgets[i], headroom,
                                          block_size, p_fix) + (0,)
-                     for r in reqs]
+                     for i, r in enumerate(reqs)]
         if pool_blocks is None:
             pool_blocks = (slots * max(pl[2] for pl in plans)
                            + n_prefix_blocks)
@@ -711,7 +904,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             if private_i + n_prefix_blocks > pool_blocks:
                 raise ValueError(
                     f"request {i}: prompt {r.shape[0]} + new "
-                    f"{max_new_tokens}"
+                    f"{budgets[i]}"
                     + (f" (+{headroom} speculation headroom)" if spec
                        else "")
                     + f" needs {private_i} private blocks of "
@@ -754,6 +947,14 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             _, _, d_write = _paged_serve_fns(draft, 0.0, 0, 0.0,
                                              draft_transform,
                                              paged_kernel)
+        if continuous and not spec:
+            # the iteration scheduler's step twins: an EOS/budget-aware
+            # decode scan plus fused prefill+decode dispatches (one XLA
+            # program writes an admission's segment AND advances every
+            # live decode lane)
+            cb_step, cb_fused_fill, cb_fused_write = _cb_paged_serve_fns(
+                model, float(temperature), int(top_k), float(top_p),
+                params_transform, paged_kernel)
     else:
         step, insert_row = _serve_fns(model, float(temperature),
                                       int(top_k), float(top_p),
@@ -770,6 +971,13 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             # comes from the target's logits
             _, _, d_write = _llama._decode_fns(
                 draft, 0.0, 0, 0.0, -1, draft_transform)
+        if continuous and not spec:
+            # dense continuous: iteration-level admission/eviction only
+            # (prefill still lands via insert_row — there is no block
+            # table to fuse through)
+            cb_step = _cb_serve_fns(model, float(temperature),
+                                    int(top_k), float(top_p),
+                                    params_transform)
 
     def resume_index(full_len: int) -> int:
         """How many leading segments of the request's schedule the
@@ -944,13 +1152,33 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # serving telemetry: spans + histograms + ServeStats
     # (models/telemetry.py); every request is queued from here on
     tel = telemetry if telemetry is not None else ServeTelemetry()
-    tel.loop_started(len(reqs), slots, spec)
+    tel.loop_started(len(reqs), slots, spec, scheduler=scheduler)
     if paged:
         tel.pool_configured(pool_blocks, block_size, paged_kernel)
         tel.blocks_in_use(pool.used)  # prefix blocks, if any
+    # continuous + paged (non-spec, non-windowed) admits LAZILY: a lane
+    # allocates only the blocks its next step writes (paging.step_gate),
+    # growing coverage per segment / per decode block.  Windowed lanes
+    # keep their ring reservation (the ring IS the per-step bound) and
+    # speculation keeps worst-case admission (verify writes race ahead)
+    cb_lazy = continuous and paged and not spec and not windowed
+    # the iteration scheduler edits the block table every loop turn
+    # (coverage growth, preempt, finish, activation) — as a device
+    # array each edit is an eager scatter dispatch costing more than
+    # the decode step it bookkeeps for.  Keep the table (and pending
+    # row tables) host-side; the jitted steps take them as arguments,
+    # so they ride the dispatch as a one-shot 4*t_blocks-byte transfer
+    host_tbl = continuous and paged
+    if host_tbl:
+        table = np.zeros((slots, t_blocks), np.int32)
+    # admission damping after a preempt-to-queue: re-admitting the
+    # victim immediately would re-create the pressure that evicted it —
+    # hold until some lane finishes (or the pool drains empty)
+    hold_admissions = False
 
     def finish(s):
-        nonlocal table
+        nonlocal table, hold_admissions
+        hold_admissions = False
         frozen_py[s] = True
         ridx = owner[s]
         results[ridx] = ServeResult(
@@ -972,7 +1200,10 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                 pool.decref(lane_own[s])
             lane_shared[s], lane_own[s] = [], []
             lane_nblocks[s] = 0
-            table = table.at[s].set(0)
+            if host_tbl:
+                table[s] = 0
+            else:
+                table = table.at[s].set(0)
             tel.blocks_in_use(pool.used)
         tel.request_finished(ridx, results[ridx], n_step)
 
@@ -995,8 +1226,13 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                 cache = paging.copy_block(cache, jnp.int32(copy_src),
                                           jnp.int32(new_id))
             if s in pending:
-                pending[s]["row_tbl"] = (
-                    pending[s]["row_tbl"].at[0, slot].set(new_id))
+                if host_tbl:
+                    pending[s]["row_tbl"][0, slot] = new_id
+                else:
+                    pending[s]["row_tbl"] = (
+                        pending[s]["row_tbl"].at[0, slot].set(new_id))
+            elif host_tbl:
+                table[s, slot] = new_id
             else:
                 table = table.at[s, slot].set(new_id)
         if released:
@@ -1006,6 +1242,36 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             tel.blocks_in_use(pool.used)
         if evicted:
             tel.window_blocks_evicted(evicted)
+
+    def activate_lane(s, first: int, dev_done: bool = False):
+        """The lane goes LIVE with its sampled first token — shared by
+        advance_prefill's final segment and the continuous scheduler's
+        fused prefill dispatch (which already wrote tok/pos in-jit:
+        dev_done skips the host-side scatters)."""
+        nonlocal tok, pos, table
+        st = pending[s]
+        ridx = st["ridx"]
+        p_len = reqs[ridx].shape[0]
+        if paged:
+            # the lane's table row becomes real exactly when it
+            # unfreezes (it was scratch while pending, so interleaved
+            # decode blocks could not write through it)
+            if host_tbl:
+                table[s] = st["row_tbl"][0]
+            else:
+                table = table.at[s].set(st["row_tbl"][0])
+        del pending[s]
+        owner[s] = ridx
+        spec_acc[s] = (0, 0)
+        admitted_step[s] = n_step
+        emitted[s] = [first]
+        if not dev_done:
+            tok = tok.at[s].set(first)
+            pos = pos.at[s].set(p_len)
+        frozen_py[s] = False
+        tel.request_activated(ridx, n_step)
+        if first == eos or budgets[ridx] == 1:
+            finish(s)
 
     def advance_prefill(s):
         """Stream up to prefill_chunks_per_sync segments of slot s's
@@ -1024,6 +1290,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         row_tbl = st["row_tbl"] if paged else None
         for start, end, is_last in segments[st["next"]:
                                             st["next"] + budget]:
+            # lazy coverage: this segment's writes need blocks the
+            # step-granular admission did not reserve — grow (or
+            # preempt someone; if the victim is THIS lane, stop)
+            if cb_lazy and not grow_or_preempt(s, end):
+                return
+            row_tbl = st["row_tbl"] if paged else None
             piece = prompt_r[None, start:end]
             st["next"] += 1
             # windowed lanes: a long prompt streaming through the
@@ -1061,24 +1333,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     first = int(_llama._select_token(
                         last_logits, temperature, k_first, top_k,
                         top_p)[0])
-                ridx = st["ridx"]
-                if paged:
-                    # the lane goes LIVE: its table row becomes real
-                    # exactly when it unfreezes (it was scratch while
-                    # pending, so interleaved decode blocks could not
-                    # write through it)
-                    table = table.at[s].set(st["row_tbl"][0])
-                del pending[s]
-                owner[s] = ridx
-                spec_acc[s] = (0, 0)
-                admitted_step[s] = n_step
-                emitted[s] = [first]
-                tok = tok.at[s].set(first)
-                pos = pos.at[s].set(p_len)
-                frozen_py[s] = False
-                tel.request_activated(ridx, n_step)
-                if first == eos or max_new_tokens == 1:
-                    finish(s)
+                activate_lane(s, first)
                 return
             with tel.prefill_segment(st["ridx"], start, end):
                 if paged:
@@ -1093,6 +1348,354 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     if spec:
                         st["d_row"] = d_write(draft_params, st["d_row"],
                                               piece, jnp.int32(start))
+
+    if continuous:
+        # ================================================================
+        # iteration-level scheduler (Orca-style continuous batching).
+        # Control flow per iteration: lift the post-preemption admission
+        # hold if nothing is in flight, admit newcomers into freed lanes
+        # under the blocks-per-step gate, grow every live lane's block
+        # coverage for the next shortened decode block (preempt-to-queue
+        # on pressure), then ONE device dispatch that advances every
+        # live decode lane and — paged, non-spec — fuses the oldest
+        # pending admission's next prefill segment into the same step.
+        # Finish detection is on-device (_cb_serve_fns); freed lanes and
+        # blocks recycle at the next sync.
+        # ================================================================
+        eos_t = jnp.int32(eos)
+        # prompts are host data to the scheduler (lengths, segment
+        # slices fed to the next dispatch) — keep them as numpy so the
+        # per-iteration slicing never becomes an eager device gather
+        reqs = [np.asarray(r) for r in reqs]
+
+        def in_flight():
+            return [s for s in range(slots)
+                    if owner[s] is not None or s in pending]
+
+        def lane_ridx(s):
+            return pending[s]["ridx"] if s in pending else owner[s]
+
+        def ensure_cover(s, upto: int) -> bool:
+            """Grow lane s's linear block coverage to hold positions
+            [0, upto); False (state unchanged) when the pool can't
+            supply the marginal blocks."""
+            nonlocal table
+            covered = len(lane_shared[s]) + len(lane_own[s])
+            need = paging.blocks_to_cover(upto, covered, block_size)
+            if need == 0:
+                return True
+            if not pool.can_alloc(need):
+                return False
+            new_ids = pool.alloc(need)
+            if s in pending:
+                pending[s]["row_tbl"][0, covered:covered + need] = new_ids
+            else:
+                table[s, covered:covered + need] = new_ids
+            lane_own[s].extend(new_ids)
+            lane_nblocks[s] += need
+            tel.blocks_in_use(pool.used)
+            return True
+
+        def preempt(s):
+            """Preempt-to-queue: swap-out is a table edit — drop lane
+            s's blocks (decref; KV is recomputed at re-admission, the
+            recompute flavor of swap), re-queue its request at the
+            HEAD (FIFO order preserved), and hold further admissions
+            until a finish frees real capacity."""
+            nonlocal table, hold_admissions
+            ridx = lane_ridx(s)
+            if s in pending:
+                del pending[s]
+            else:
+                owner[s] = None
+            frozen_py[s] = True
+            lane_rot.pop(s, None)
+            if lane_shared[s]:
+                pool.decref(lane_shared[s])
+            if lane_own[s]:
+                pool.decref(lane_own[s])
+            lane_shared[s], lane_own[s] = [], []
+            lane_nblocks[s] = 0
+            table[s] = 0
+            emitted[s] = []
+            queue.appendleft(ridx)
+            hold_admissions = True
+            tel.preempted_to_queue(ridx)
+            tel.blocks_in_use(pool.used)
+
+        def grow_or_preempt(s, upto: int) -> bool:
+            """ensure_cover with pressure relief: evict the YOUNGEST
+            in-flight lane (highest request index — least sunk work,
+            FIFO fairness) until s's coverage fits.  False iff s itself
+            was the youngest — the caller must stop driving s."""
+            while not ensure_cover(s, upto):
+                victim = max(in_flight(), key=lane_ridx)
+                preempt(victim)
+                if victim == s:
+                    return False
+            return True
+
+        def admit_free_lanes():
+            nonlocal cache, d_cache
+            for s in range(slots):
+                if not queue:
+                    return
+                if owner[s] is not None or s in pending:
+                    continue
+                ridx = queue[0]
+                if paged:
+                    _tot, shared_i, private_i, cow_i, rot_i = plans[ridx]
+                    shared_ids = prefix_ids[:shared_i]
+                    if cb_lazy:
+                        if hold_admissions:
+                            return
+                        # blocks-per-step gate: only the FIRST prefill
+                        # segment's marginal blocks beyond the shared
+                        # prefix (increfs are free), plus one reserved
+                        # block per in-flight lane (their next decode
+                        # block's worst-case growth)
+                        p_len = int(reqs[ridx].shape[0])
+                        segs = request_segments(p_len)
+                        first_end = segs[resume_index(p_len)][1]
+                        need_now = paging.blocks_to_cover(
+                            first_end, shared_i, block_size)
+                        if not paging.step_gate(pool.free_blocks,
+                                                need_now,
+                                                len(in_flight())):
+                            tel.admission_blocked_on_memory(ridx)
+                            return
+                        alloc_n = need_now
+                    else:
+                        # windowed keeps the ring reservation (the ring
+                        # IS the per-step bound); speculation keeps the
+                        # worst case (verify writes race ahead)
+                        if not pool.can_alloc(private_i):
+                            tel.admission_blocked_on_memory(ridx)
+                            return
+                        alloc_n = private_i
+                    queue.popleft()
+                    own = pool.alloc(alloc_n)
+                    slot_ids = own[:alloc_n - rot_i]
+                    shadows = own[alloc_n - rot_i:]
+                    if shared_ids:
+                        pool.incref(shared_ids)
+                        tel.prefix_blocks_reused(len(shared_ids))
+                    if cow_i:
+                        src = jnp.int32(prefix_ids[shared_i])
+                        dst = jnp.int32(slot_ids[0])
+                        cache = paging.copy_block(cache, src, dst)
+                        if spec:
+                            d_cache = paging.copy_block(d_cache, src,
+                                                        dst)
+                        tel.cow_copy()
+                    lane_shared[s] = list(shared_ids)
+                    lane_own[s] = own
+                    lane_nblocks[s] = shared_i + alloc_n
+                    if windowed:
+                        row = list(shared_ids) + slot_ids
+                        lane_rot[s] = paging.WindowRotation(
+                            row + [0] * (t_blocks - len(row)),
+                            shared_i, shadows, block_size,
+                            cfg.sliding_window)
+                    row_np = np.zeros((1, t_blocks), np.int32)
+                    ids = list(shared_ids) + slot_ids
+                    row_np[0, :len(ids)] = ids
+                    pending[s] = {
+                        "ridx": ridx,
+                        "next": resume_index(reqs[ridx].shape[0]),
+                        "row_tbl": row_np,
+                    }
+                    tel.request_admitted(ridx, s)
+                    tel.blocks_in_use(pool.used)
+                else:
+                    queue.popleft()
+                    row, d_row = fresh_rows()
+                    pending[s] = {
+                        "ridx": ridx, "row": row, "d_row": d_row,
+                        "next": resume_index(reqs[ridx].shape[0]),
+                    }
+                    tel.request_admitted(ridx, s)
+
+        def live_lanes():
+            return [s for s in range(slots)
+                    if owner[s] is not None and not frozen_py[s]]
+
+        fused = paged and not spec
+        while queue or pending or any(o is not None for o in owner):
+            if hold_admissions and not in_flight():
+                hold_admissions = False  # pool drained; retry
+            admit_free_lanes()
+            live = live_lanes()
+            if not fused or not live:
+                # dense/spec prefill (insert_row / worst-case blocks),
+                # or nothing to fuse WITH — stream pending prompts the
+                # slot way, oldest request first
+                for s in sorted(pending,
+                                key=lambda s: pending[s]["ridx"]):
+                    if s in pending:  # a peer's growth may evict it
+                        advance_prefill(s)
+                live = live_lanes()
+                if not live:
+                    continue
+            rng, k_step = jax.random.split(rng)
+            if spec:
+                # iteration-scheduled speculation: admission/eviction at
+                # every sync and rounds shortened to the longest
+                # remaining budget; freezing stays host-side (the spec
+                # block's -1 marker already skips frozen lanes)
+                max_rem = max(budgets[owner[s]] - len(emitted[s])
+                              for s in live)
+                n_rounds = min(steps_per_sync,
+                               -(-max_rem // (spec_k + 1)))
+                busy = len(live)
+                with tel.decode_block(busy,
+                                      pool.used if paged else None):
+                    if paged:
+                        (cache, d_cache, tok, pos, cands,
+                         n_accs) = spec_block(
+                            params, draft_params, cache, d_cache, tok,
+                            pos, np.asarray(frozen_py), table, k_step,
+                            n_rounds)
+                    else:
+                        (cache, d_cache, tok, pos, cands,
+                         n_accs) = spec_block(
+                            params, draft_params, cache, d_cache, tok,
+                            pos, np.asarray(frozen_py), k_step,
+                            n_rounds)
+                    cands = jax.device_get(cands)
+                    n_accs = jax.device_get(n_accs)
+                tel.step_mix(busy, 0)
+                waste = 0
+                for i in range(n_rounds):
+                    n_step += 1
+                    for s in range(slots):
+                        if owner[s] is None or frozen_py[s]:
+                            continue
+                        acc, prop = spec_acc[s]
+                        spec_acc[s] = (acc + int(n_accs[i, s]),
+                                       prop + spec_k)
+                        bud = budgets[owner[s]]
+                        for t in cands[i, s, :int(n_accs[i, s]) + 1]:
+                            emitted[s].append(int(t))
+                            if int(t) == eos or len(emitted[s]) >= bud:
+                                finish(s)
+                                waste += n_rounds - 1 - i
+                                break
+                if waste:
+                    tel.lane_wasted_steps(waste)
+                continue
+            # ---- non-spec: one (optionally fused) dispatch.  Shorten
+            # the block to the longest remaining budget — no lane can
+            # emit past it, so the tail steps would be all-frozen
+            n = min(steps_per_sync,
+                    max(budgets[owner[s]] - len(emitted[s])
+                        for s in live))
+            seg_plan = None
+            if fused and pending:
+                # fuse the OLDEST pending admission's next segment into
+                # this dispatch (one prefill row beside the decode rows)
+                s_pre = min(pending, key=lambda s: pending[s]["ridx"])
+                st = pending[s_pre]
+                segments = request_segments(reqs[st["ridx"]].shape[0])
+                start, end, is_last = segments[st["next"]]
+                ok = (grow_or_preempt(s_pre, end) if cb_lazy else True)
+                if ok:
+                    if windowed:
+                        rotate_window(s_pre, end - 1, start)
+                    seg_plan = (s_pre, start, end, is_last)
+            if cb_lazy:
+                # grow every live lane's coverage for this block's
+                # writes, oldest request first (a young lane under
+                # pressure preempts itself, never a senior)
+                for s in sorted(live, key=lambda s: owner[s]
+                                if owner[s] is not None else slots):
+                    if owner[s] is None or frozen_py[s]:
+                        continue  # preempted by a senior's growth
+                    r = owner[s]
+                    p_len_s = reqs[r].shape[0]
+                    upto = min(p_len_s + len(emitted[s]) - 1 + n,
+                               p_len_s + budgets[r])
+                    grow_or_preempt(s, upto)
+                live = live_lanes()
+                if seg_plan is not None and seg_plan[0] not in pending:
+                    seg_plan = None  # the pending lane lost its blocks
+                if not live:
+                    continue  # decode lanes all preempted; re-plan
+                n = min(n, max(budgets[owner[s]] - len(emitted[s])
+                               for s in live))
+            if windowed:
+                # pre-rotate every live lane's modular table for this
+                # block's writes (frozen lanes pin their final pos —
+                # already rotated)
+                for s in live:
+                    cur = reqs[owner[s]].shape[0] + len(emitted[s]) - 1
+                    rotate_window(s, cur + n - 1, cur)
+            live_set = set(live)
+            left_v = np.asarray(
+                [budgets[owner[s]] - len(emitted[s])
+                 if s in live_set else 0 for s in range(slots)],
+                np.int32)
+            frz = np.asarray(frozen_py)
+            busy = len(live)
+            seg_tok = 0
+            first_dev = None
+            with tel.decode_block(busy, pool.used if paged else None):
+                if seg_plan is not None:
+                    s_pre, start, end, is_last = seg_plan
+                    st = pending[s_pre]
+                    piece = reqs[st["ridx"]][None, start:end]
+                    if is_last:
+                        (cache, tok, pos, toks, lives,
+                         first_dev) = cb_fused_fill(
+                            params, cache, tok, pos, frz, left_v,
+                            eos_t, table, piece, np.int32(start),
+                            st["row_tbl"], np.int32(s_pre), k_step, n)
+                    else:
+                        cache, tok, pos, toks, lives = cb_fused_write(
+                            params, cache, tok, pos, frz, left_v,
+                            eos_t, table, piece, np.int32(start),
+                            st["row_tbl"], k_step, n)
+                    st["next"] += 1
+                    seg_tok = end - start
+                elif paged:
+                    cache, tok, pos, toks, lives = cb_step(
+                        params, cache, tok, pos, frz, left_v, eos_t,
+                        table, k_step, n)
+                else:
+                    cache, tok, pos, toks, lives = cb_step(
+                        params, cache, tok, pos, frz, left_v, eos_t,
+                        k_step, n)
+                toks_h = jax.device_get(toks)   # [n, B]
+                lives_h = jax.device_get(lives)  # [n, B] bool
+            tel.step_mix(busy, seg_tok)
+            waste = 0
+            for i in range(n):
+                n_step += 1
+                for s in range(slots):
+                    if (owner[s] is None or frozen_py[s]
+                            or not lives_h[i, s]):
+                        continue
+                    t = int(toks_h[i, s])
+                    emitted[s].append(t)
+                    if t == eos or len(emitted[s]) >= budgets[owner[s]]:
+                        finish(s)
+                        # the device froze the lane mid-block; the
+                        # remaining scan steps still computed its
+                        # (masked) rows — the residual waste the
+                        # shortened block didn't already remove
+                        waste += n - 1 - i
+            if waste:
+                tel.lane_wasted_steps(waste)
+            if seg_plan is not None and seg_plan[3]:
+                # final segment rode the fused dispatch, which also
+                # selected its first token AND wrote the lane's tok/pos
+                # rows — activate into the NEXT block's decode rows
+                activate_lane(seg_plan[0], int(first_dev),
+                              dev_done=True)
+        tel.loop_finished()
+        if return_stats:
+            return results, tel.finalize()
+        return results  # type: ignore[return-value]
 
     while queue or pending or any(o is not None for o in owner):
         # ---- admission: every free lane RESERVES the next queued
@@ -1202,6 +1805,8 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         jnp.asarray(frozen_py), k_step, steps_per_sync)
                 cands = jax.device_get(cands)   # [rounds, B, spec_k+1]
                 n_accs = jax.device_get(n_accs)  # [rounds, B]; -1=frozen
+            tel.step_mix(busy, 0)
+            waste = 0
             for i in range(steps_per_sync):
                 n_step += 1
                 for s in range(slots):
@@ -1213,12 +1818,18 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     acc, prop = spec_acc[s]
                     spec_acc[s] = (acc + int(n_accs[i, s]),
                                    prop + spec_k)
+                    bud = budgets[owner[s]]
                     for t in cands[i, s, :int(n_accs[i, s]) + 1]:
                         emitted[s].append(int(t))
-                        if (int(t) == eos
-                                or len(emitted[s]) >= max_new_tokens):
+                        if int(t) == eos or len(emitted[s]) >= bud:
                             finish(s)
+                            # the lane speculates to the block edge and
+                            # those rounds are discarded — the measured
+                            # cost the iteration scheduler shrinks
+                            waste += steps_per_sync - 1 - i
                             break
+            if waste:
+                tel.lane_wasted_steps(waste)
         else:
             if paged and windowed:
                 # pre-rotate every live lane's modular table for the
@@ -1241,6 +1852,8 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         params, cache, tok, pos, jnp.asarray(frozen_py),
                         k_step, steps_per_sync)
                 block = jax.device_get(toks)  # [steps_per_sync, B]
+            tel.step_mix(busy, 0)
+            waste = 0
             for i in range(steps_per_sync):
                 n_step += 1
                 for s in range(slots):
@@ -1248,8 +1861,11 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         continue
                     t = int(block[i, s])
                     emitted[s].append(t)
-                    if t == eos or len(emitted[s]) >= max_new_tokens:
+                    if t == eos or len(emitted[s]) >= budgets[owner[s]]:
                         finish(s)  # later in-block tokens are overshoot
+                        waste += steps_per_sync - 1 - i
+            if waste:
+                tel.lane_wasted_steps(waste)
     # every exit idles the occupancy gauge and samples the HBM peak —
     # a scrape between serve runs must not read the last block's state
     tel.loop_finished()

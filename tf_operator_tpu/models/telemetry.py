@@ -128,6 +128,9 @@ class ServeStats:
     requests: int = 0
     slots: int = 0
     speculative: bool = False
+    # which inner loop served the run: "slot" (block-synchronous
+    # oracle) or "continuous" (token-level iteration scheduler)
+    scheduler: str = "slot"
     # paged-KV accounting (serve_loop paged=True; zeros under dense
     # serving): pool capacity/peak in blocks, the time-weighted mean
     # block occupancy over decode blocks (the autoscaler's memory
@@ -147,6 +150,14 @@ class ServeStats:
     # sliding-window paged serving: block epochs retired by table
     # rotation (shared prefix blocks dereferenced, private reused)
     window_evicted_blocks: int = 0
+    # step-mix accounting: lane-steps computed for already-finished
+    # lanes (the slot loop's post-EOS overshoot; the continuous
+    # scheduler's in-block freeze residue), prefill tokens that rode a
+    # fused prefill+decode dispatch, and preempt-to-queue evictions
+    # (continuous scheduler's pressure valve; 0 under the slot loop)
+    wasted_lane_steps: int = 0
+    fused_prefill_tokens: int = 0
+    preemptions: int = 0
     total_tokens: int = 0
     wall_time_s: float = 0.0
     tokens_per_sec: float = 0.0
@@ -228,6 +239,10 @@ class ServeTelemetry:
         self._prefix_hits = 0
         self._adm_blocked = 0
         self._window_evicted = 0
+        self._scheduler = "slot"
+        self._wasted_lane_steps = 0
+        self._fused_prefill_tokens = 0
+        self._preemptions = 0
 
     def _wall(self, pc: float) -> float:
         """Epoch seconds for a perf_counter reading, via the single
@@ -250,7 +265,8 @@ class ServeTelemetry:
 
     # --------------------------------------------------------- lifecycle
     def loop_started(self, n_requests: int, slots: int,
-                     speculative: bool) -> None:
+                     speculative: bool,
+                     scheduler: str = "slot") -> None:
         # fresh accumulators: an instance reused across serve_loop calls
         # must report the CURRENT run, not a merge (spans and registry
         # counters already landed; only the aggregate state resets)
@@ -265,6 +281,14 @@ class ServeTelemetry:
         self._blocks_peak = self._cow = 0
         self._prefix_hits = self._adm_blocked = 0
         self._window_evicted = 0
+        self._scheduler = scheduler
+        self._wasted_lane_steps = 0
+        self._fused_prefill_tokens = 0
+        self._preemptions = 0
+        # step-mix gauges sample the last dispatch; a fresh run must
+        # not inherit the previous run's final step
+        em.SERVING_STEP_DECODE_ROWS.set(0)
+        em.SERVING_STEP_PREFILL_TOKENS.set(0)
         # a DENSE run must clear a prior paged run's capacity gauge or
         # the process keeps exporting a pool it no longer has ("0 means
         # dense serving" is the family's documented contract); a paged
@@ -331,6 +355,38 @@ class ServeTelemetry:
         if n > 0:
             self._window_evicted += n
             em.SERVING_KV_WINDOW_EVICTED.inc(amount=n)
+
+    def step_mix(self, decode_rows: int, prefill_tokens: int) -> None:
+        """One dispatched decode block's ragged composition: how many
+        lanes decoded and how many prefill tokens rode the SAME device
+        dispatch (0 everywhere except the continuous scheduler's fused
+        prefill+decode steps).  Host-side bookkeeping only — no device
+        sync rides on telemetry.  The gauges sample the latest
+        dispatch (the scrape-time mix); the fused-token count also
+        accumulates into ServeStats.fused_prefill_tokens."""
+        em.SERVING_STEP_DECODE_ROWS.set(decode_rows)
+        em.SERVING_STEP_PREFILL_TOKENS.set(prefill_tokens)
+        if prefill_tokens > 0:
+            self._fused_prefill_tokens += prefill_tokens
+
+    def lane_wasted_steps(self, n: int) -> None:
+        """n lane-steps were computed for already-finished lanes: the
+        slot loop's run-to-the-block-edge overshoot, or the continuous
+        scheduler's residue between an in-block device freeze and the
+        block edge.  The shrinking quantity ISSUE 19's scheduler is
+        scored on."""
+        if n > 0:
+            self._wasted_lane_steps += n
+            em.SERVING_LANE_WASTED_STEPS.inc(amount=n)
+
+    def preempted_to_queue(self, index: int) -> None:
+        """The continuous scheduler evicted a lane under block-pool
+        pressure and re-queued its request (it will re-admit and
+        recompute; no tokens were lost, the emitted list reset)."""
+        self._preemptions += 1
+        self._rrecord(index, "preempted_to_queue",
+                      {"pool_blocks": self._pool_total},
+                      time.perf_counter())
 
     def request_admitted(self, index: int, slot: int) -> None:
         """A decode lane was RESERVED for the request (its prompt may
@@ -481,6 +537,8 @@ class ServeTelemetry:
             return
         em.SERVING_BATCH_OCCUPANCY.set(0)
         em.SERVING_KV_BLOCKS_USED.set(0)
+        em.SERVING_STEP_DECODE_ROWS.set(0)
+        em.SERVING_STEP_PREFILL_TOKENS.set(0)
         self._hbm = _hbm_peaks()
         for dev, peak in self._hbm.items():
             em.SERVING_HBM_PEAK.set(peak, {"device": dev})
@@ -505,6 +563,7 @@ class ServeTelemetry:
             requests=len(done),
             slots=self._slots,
             speculative=self._spec,
+            scheduler=self._scheduler,
             paged=self._pool_total > 0,
             paged_kernel=self._paged_kernel,
             kv_block_size=self._pool_block_size,
@@ -517,6 +576,9 @@ class ServeTelemetry:
             prefix_block_hits=self._prefix_hits,
             admissions_blocked_on_memory=self._adm_blocked,
             window_evicted_blocks=self._window_evicted,
+            wasted_lane_steps=self._wasted_lane_steps,
+            fused_prefill_tokens=self._fused_prefill_tokens,
+            preemptions=self._preemptions,
             total_tokens=total_tokens,
             wall_time_s=wall,
             tokens_per_sec=total_tokens / wall if wall > 0 else 0.0,
