@@ -7,7 +7,7 @@ NATIVE_DIR := native
 NATIVE_LIB := tf_operator_tpu/native/libtpuoperator.so
 NATIVE_SRCS := $(wildcard $(NATIVE_DIR)/*.cc)
 
-.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-serve-cb bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos bench-reqtrace bench-cluster native clean docker-build deploy undeploy
+.PHONY: all manifests verify-manifests test metrics-lint chaos bench bench-scale bench-startup bench-shard bench-multiproc bench-warmpool bench-sched bench-paged bench-serve-cb bench-paged-decode bench-timeline bench-elastic bench-fleet bench-fleet-chaos bench-reqtrace bench-cluster bench-disagg native clean docker-build deploy undeploy
 
 all: native manifests
 
@@ -190,6 +190,19 @@ bench-reqtrace:
 bench-cluster:
 	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_cluster; \
 	print(json.dumps(bench_cluster(), indent=1))"
+
+# Disaggregated prefill/decode serving (ISSUE 20): a prefill fleet
+# (queue-depth dispatch, prompt-only admission) handing finished
+# prompts to a decode fleet (free-KV-block dispatch, block-table
+# adoption) vs the unified fleet, at equal total KV blocks on the same
+# accelerators, over a seeded prefill-burst trace (long-prompt bursts
+# on a steady decode-heavy floor) and its steady no-burst twin.
+# Headline: disaggregated TTFT p99 >= 1.5x better under the burst;
+# steady tokens/s within 10% of unified.  Rows land in BENCH_r18.json;
+# bounds asserted in tests/test_bench_infra.py.
+bench-disagg:
+	JAX_PLATFORMS=cpu python -c "import json; from bench import bench_disagg; \
+	print(json.dumps(bench_disagg(), indent=1))"
 
 docker-build:
 	docker build -f build/images/tpu-training-operator/Dockerfile -t $(IMG) .

@@ -2890,6 +2890,121 @@ def bench_cluster(seed: int = 0):
     }
 
 
+def bench_disagg(
+    seed: int = 7,
+    horizon_s: float = 400.0,
+    floor_rate: float = 3.4,
+    burst_rate: float = 14.0,
+    n_unified: int = 4,
+    unified_pool: int = 160,
+    n_prefill: int = 2,
+    prefill_pool: int = 64,
+    n_decode: int = 2,
+    decode_pool: int = 256,
+    decode_slots: int = 10,
+):
+    """`make bench-disagg` — disaggregated prefill/decode vs the
+    unified fleet (ISSUE 20 evidence, BENCH_r18.json).  Two seeded
+    traces (models/fleetsim.make_prefill_burst_trace), two arms each,
+    at EQUAL TOTAL KV BLOCKS (4x160 unified = 2x64 prefill + 2x256
+    decode = 640) on the same four accelerators:
+
+      unified — FleetHarness, occupancy router, shared-compute
+                replicas (a prefill dispatch stalls every decode lane
+                for its duration — slot-loop mechanics).  A burst's
+                long prompt is (a) head-of-line prefill latency,
+                (b) a worst-case prompt+budget pool reservation
+                contending with camped decode lanes, and (c) stolen
+                decode time, on whatever replica it lands on.
+      disagg  — DisaggHarness: a prefill fleet routed on queue depth
+                (prompt-only admission, the pool turns over per
+                prompt) handing finished prompts to a decode fleet
+                routed on free KV blocks (block-table handoff; decode
+                replicas never prefill, so their batch is KV-bound —
+                `decode_slots` lanes over the bigger pool).
+
+    Headline (asserted in tests/test_bench_infra.py): under the
+    prefill-burst trace the disaggregated split's TTFT p99 is >= 1.5x
+    better than unified; under the steady decode-heavy floor (same
+    seed, no bursts) its tokens/s is within 10% of unified — the split
+    costs nothing when there is nothing to split.  Every number is
+    deterministic arithmetic per seed."""
+    from tf_operator_tpu.models.fleetsim import (
+        DisaggHarness, FleetHarness, ReplicaConfig,
+        make_prefill_burst_trace,
+    )
+
+    burst = make_prefill_burst_trace(
+        seed, floor_rate=floor_rate, burst_rate=burst_rate,
+    )
+    steady = make_prefill_burst_trace(
+        seed, floor_rate=floor_rate, bursts=(),
+    )
+
+    def run_unified(trace):
+        cfg = ReplicaConfig(
+            pool_blocks=unified_pool, shared_compute=True,
+        )
+        harness = FleetHarness(
+            "occupancy", n_replicas=n_unified, replica_cfg=cfg,
+            autoscale=None,
+        )
+        row = harness.run(trace, horizon_s=horizon_s)
+        row["mode"] = "unified"
+        row["redispatches"] = len(row["redispatches"])
+        return row
+
+    def run_disagg(trace):
+        harness = DisaggHarness(
+            n_prefill=n_prefill,
+            n_decode=n_decode,
+            prefill_cfg=ReplicaConfig(
+                role="prefill", shared_compute=True,
+                pool_blocks=prefill_pool,
+            ),
+            decode_cfg=ReplicaConfig(
+                role="decode", shared_compute=True,
+                pool_blocks=decode_pool, slots=decode_slots,
+            ),
+        )
+        return harness.run(trace, horizon_s=horizon_s)
+
+    rows = []
+    for trace_name, trace in (("burst", burst), ("steady", steady)):
+        for row in (run_unified(trace), run_disagg(trace)):
+            row["trace"] = trace_name
+            rows.append(row)
+    by = {(r["trace"], r["mode"]): r for r in rows}
+    ub, db = by[("burst", "unified")], by[("burst", "disagg")]
+    us, ds = by[("steady", "unified")], by[("steady", "disagg")]
+    return {
+        "seed": seed,
+        "requests_burst": len(burst),
+        "requests_steady": len(steady),
+        "total_kv_blocks_unified": n_unified * unified_pool,
+        "total_kv_blocks_disagg": (
+            n_prefill * prefill_pool + n_decode * decode_pool
+        ),
+        "rows": rows,
+        "summary": {
+            "ttft_p99_unified_over_disagg": (
+                round(ub["ttft_p99_s"] / db["ttft_p99_s"], 2)
+                if db["ttft_p99_s"] else None
+            ),
+            "ttft_p50_unified_over_disagg": (
+                round(ub["ttft_p50_s"] / db["ttft_p50_s"], 2)
+                if db["ttft_p50_s"] else None
+            ),
+            "steady_tokens_disagg_over_unified": (
+                round(ds["tokens_per_sec"] / us["tokens_per_sec"], 3)
+                if us["tokens_per_sec"] else None
+            ),
+            "handoffs_burst": db["handoffs"],
+            "handoff_retries_burst": db["handoff_retries"],
+        },
+    }
+
+
 def bench_elastic(
     seed: int = 1337,
     horizon_s: float = 420.0,

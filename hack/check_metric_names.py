@@ -89,6 +89,14 @@ _REQUIRED_FAMILIES = {
     "tpu_operator_serving_step_decode_rows": "Gauge",
     "tpu_operator_serving_step_prefill_tokens": "Gauge",
     "tpu_operator_serving_lane_wasted_steps_total": "Counter",
+    # disaggregated prefill/decode serving (ISSUE 20): the KV-block
+    # handoff's volume (phase=exported/elided/adopted/deduped), wire
+    # latency (side=export/adopt), and decode-side admission retries —
+    # docs/monitoring.md's handoff-dedup-ratio and retry-rate PromQL
+    # read these by name
+    "tpu_operator_serving_handoff_blocks_total": "Counter",
+    "tpu_operator_serving_handoff_duration_seconds": "Histogram",
+    "tpu_operator_serving_handoff_retries_total": "Counter",
 }
 
 

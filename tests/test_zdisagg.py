@@ -1,0 +1,530 @@
+"""Disaggregated prefill/decode serving — ISSUE 20.
+
+The tentpole invariant: splitting serving into a prefill fleet and a
+decode fleet joined by KV-block handoff changes WHERE work happens,
+never WHAT comes out.  The unified slot loop is the parity oracle —
+every matrix case runs the unified loop and the prefill_only→adopt
+split over the same trace and diffs greedy tokens byte-for-byte.
+
+The ownership protocol rides the existing BlockPool refcounts: an
+export carries block bytes + content hashes, adoption allocates fresh
+ids (or increfs a deduped shared block through the HandoffRegistry),
+and a finished lane's release must restore the receiver pool's free
+list EXACTLY — the property test walks adopt/finish sequences and
+checks the free list against the untouched-pool baseline.
+
+Late-alphabet ON PURPOSE (same reasoning as test_zcontbatch.py):
+tier-1's time cap cuts the suite alphabetically and the parity matrix
+compiles fresh jits per case; they must not crowd out the early half.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models import llama, paging, quant
+from tf_operator_tpu.models.serving import serve_loop
+
+
+def _setup(seed=0, **cfg_kw):
+    cfg_kw.setdefault("dtype", jnp.float32)
+    cfg = llama.tiny(**cfg_kw)
+    model = llama.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    return cfg, model, params
+
+
+def _prompts(cfg, lengths, seed=1):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for n in lengths:
+        key, k = jax.random.split(key)
+        out.append(jax.random.randint(k, (n,), 0, cfg.vocab_size))
+    return out
+
+
+_KW = dict(slots=2, max_new_tokens=10, paged=True, block_size=4)
+
+
+def _split(model, params, prompts, adopt_kw=None, **kw):
+    """Unified tokens vs the prefill_only -> adopt= split's tokens
+    over the same trace (plus the handoff list for inspection)."""
+    unified = serve_loop(model, params, prompts, **kw)
+    hand = serve_loop(model, params, prompts, prefill_only=True, **kw)
+    out = serve_loop(model, params, prompts, adopt=hand,
+                     **{**kw, **(adopt_kw or {})})
+    return ([r.tokens for r in unified], [r.tokens for r in out], hand)
+
+
+# ----------------------------------------------------- parity matrix
+def test_handoff_parity_plain_paged():
+    """Plain paged ring: greedy tokens byte-identical across the
+    handoff, every non-completed handoff carries an export, and the
+    telemetry counts one export per handed-off lane."""
+    cfg, model, params = _setup(max_len=256)
+    ps = _prompts(cfg, [6, 11, 3, 9])
+    hand, stats = serve_loop(model, params, ps, prefill_only=True,
+                             return_stats=True, **_KW)
+    assert stats.handoff_exports == sum(
+        1 for h in hand if not h.completed)
+    uni, split, hand2 = _split(model, params, ps, **_KW)
+    assert uni == split
+    for h in hand2:
+        assert h.completed or h.export is not None
+        # the first token was sampled on the prefill side: the decode
+        # side must START from it, not recompute it
+        assert isinstance(h.first_token, int)
+
+
+def test_handoff_parity_int8_kv():
+    """Quantized KV pool: QTensor leaves (q, scale) ride the export
+    payload and the adopted pool decodes identically."""
+    cfg, model, params = _setup(max_len=256)
+    qp = quant.quantize_params(params)
+    kw = dict(_KW, params_transform=quant.make_dequantizer(cfg.dtype),
+              kv_quant=True)
+    ps = _prompts(cfg, [6, 11, 3, 9])
+    uni, split, _ = _split(model, params, ps, **kw)
+    assert uni == split
+
+
+def test_handoff_parity_shared_prefix_dedups_wire():
+    """Shared prefix: the prefill side serves suffixes over a CoW
+    prefix; the decode side receives FULL prompts (prompt_len covers
+    the prefix) and adopts.  The hot prefix crosses the wire ONCE —
+    later exports elide prefix payload by content hash and the
+    receiver's registry resolves them to the already-adopted block."""
+    cfg, model, params = _setup(max_len=256)
+    pfx = _prompts(cfg, [8], seed=3)[0]
+    sufs = _prompts(cfg, [5, 9, 3], seed=4)
+    full = [jnp.concatenate([pfx, s]) for s in sufs]
+    uni = [r.tokens for r in serve_loop(
+        model, params, sufs, shared_prefix=pfx, **_KW)]
+    hand = serve_loop(model, params, sufs, shared_prefix=pfx,
+                      prefill_only=True, **_KW)
+    out, stats = serve_loop(model, params, full, adopt=hand,
+                            return_stats=True, **_KW)
+    assert uni == [r.tokens for r in out]
+    # wire-format dedup is observable: with slots=2 the two lanes of
+    # the first admission wave each ship the prefix once at most, and
+    # every LATER export elides it entirely
+    payloads = [h.export.payload_blocks() for h in hand
+                if h.export is not None]
+    blocks = [len(h.export) for h in hand if h.export is not None]
+    assert any(p < b for p, b in zip(payloads, blocks))
+    # receiver-side dedup resolved the elided blocks by hash
+    assert stats.prefix_block_hits > 0
+    assert stats.handoff_adoptions == len(
+        [h for h in hand if not h.completed])
+
+
+def test_handoff_parity_sliding_window():
+    """Sliding-window ring: the export carries the rotation state
+    (ring slots, shared-slot set, next_block cursor) and the adopted
+    lane keeps rotating identically."""
+    cfg, model, params = _setup(max_len=256, sliding_window=16)
+    ps = _prompts(cfg, [24, 9, 30], seed=7)
+    uni, split, hand = _split(model, params, ps, **_KW)
+    assert uni == split
+    assert any(h.export is not None and h.export.window is not None
+               for h in hand)
+
+
+def test_handoff_parity_continuous_decode_side():
+    """The decode fleet runs the token-level continuous scheduler over
+    adopted lanes: same tokens, same order, scheduler unchanged."""
+    cfg, model, params = _setup(max_len=256)
+    ps = _prompts(cfg, [6, 11, 3, 9])
+    uni, split, _ = _split(model, params, ps,
+                           adopt_kw=dict(scheduler="continuous"),
+                           **_KW)
+    assert uni == split
+
+
+def test_prefill_only_and_adopt_validation():
+    """The seams refuse loudly: dense serving has no block table to
+    ship, prefill_only and adopt are mutually exclusive, and an
+    adopt list must match the decode trace row-for-row."""
+    cfg, model, params = _setup(max_len=256)
+    ps = _prompts(cfg, [6, 4])
+    with pytest.raises(ValueError, match="paged"):
+        serve_loop(model, params, ps, slots=2, max_new_tokens=4,
+                   prefill_only=True)
+    with pytest.raises(ValueError, match="paged"):
+        serve_loop(model, params, ps, slots=2, max_new_tokens=4,
+                   adopt=[None, None])
+    hand = serve_loop(model, params, ps, prefill_only=True,
+                      **dict(_KW, max_new_tokens=4))
+    with pytest.raises(ValueError):
+        serve_loop(model, params, ps, prefill_only=True, adopt=hand,
+                   **dict(_KW, max_new_tokens=4))
+    # adopt rows must line up with the decode-side requests
+    with pytest.raises(ValueError):
+        serve_loop(model, params, ps[:1], adopt=hand,
+                   **dict(_KW, max_new_tokens=4))
+    with pytest.raises(ValueError):
+        serve_loop(model, params, ps, adopt=hand,
+                   **dict(_KW, max_new_tokens=9))
+
+
+# ------------------------------------------------ ownership protocol
+def _mini_cache(n_blocks, block_size, seed=0):
+    """A tiny synthetic paged pool (pytree of [N+1, bs, kv, d] leaves)
+    — adopt/export are pure tree ops, no model needed."""
+    rng = np.random.default_rng(seed)
+    return {
+        "k": jnp.asarray(rng.normal(size=(n_blocks + 1, block_size,
+                                          2, 4)), jnp.float32),
+        "v": jnp.asarray(rng.normal(size=(n_blocks + 1, block_size,
+                                          2, 4)), jnp.float32),
+    }
+
+
+def test_refcount_free_list_exactly_restored():
+    """The refcount property: a sequence of adoptions (mixed dedup
+    hits and fresh allocations) followed by every lane's finish
+    restores the receiver pool's free list EXACTLY — no leak, no
+    double-free, and the registry's hash maps empty out with it."""
+    src_pool = paging.BlockPool(num_blocks=8, block_size=4)
+    cache = _mini_cache(8, 4)
+    ids = src_pool.alloc(4)
+    shared = [True, True, False, False]
+    sent: set = set()
+    exports = [
+        paging.export_blocks(cache, ids, shared, 4, sent_hashes=sent)
+        for _ in range(3)
+    ]
+    # the second and third exports elided the shared prefix's payload
+    assert exports[0].payload_blocks() == 4
+    assert exports[1].payload_blocks() == 2
+    dst_pool = paging.BlockPool(num_blocks=16, block_size=4)
+    dst_cache = _mini_cache(16, 4, seed=1)
+    registry = paging.HandoffRegistry(dst_pool)
+    lanes = []
+    for i, exp in enumerate(exports):
+        cost = paging.adoption_cost(exp, registry)
+        # first adoption writes all 4; later ones dedup the 2 shared
+        assert cost == (4 if i == 0 else 2)
+        assert dst_pool.can_alloc(cost)
+        dst_cache, adopted, sh_ids, own_ids, stats = (
+            paging.adopt_blocks(dst_cache, dst_pool, exp, registry))
+        assert stats["fresh"] == cost
+        assert stats["deduped"] == (0 if i == 0 else 2)
+        assert len(adopted) == 4
+        assert dst_pool.used <= dst_pool.num_blocks
+        lanes.append((sh_ids, own_ids))
+    # shared blocks are genuinely shared: all three lanes point at the
+    # same adopted prefix ids
+    assert lanes[0][0] == lanes[1][0] == lanes[2][0]
+    # adopted bytes match the exported bytes exactly
+    row = np.asarray(dst_cache["k"][lanes[1][1][0]])
+    src_row = np.asarray(cache["k"][ids[2]])
+    np.testing.assert_array_equal(row, src_row)
+    for sh_ids, own_ids in lanes:
+        registry.release(sh_ids)
+        dst_pool.decref(own_ids)
+    assert dst_pool.used == 0
+    assert sorted(dst_pool._free) == list(range(1, 17))
+    assert registry._id_of == {} and registry._hash_of == {}
+    assert registry.dedup_hits == 4
+
+
+def test_adoption_refuses_elided_payload_for_unknown_hash():
+    """A sender that elides bytes the receiver never saw is a LOUD
+    HandoffError (the router retries with full payload), never a
+    silent garbage adoption."""
+    pool = paging.BlockPool(num_blocks=8, block_size=4)
+    cache = _mini_cache(8, 4)
+    ids = pool.alloc(2)
+    sent: set = set()
+    paging.export_blocks(cache, ids, [True, False], 4,
+                         sent_hashes=sent)
+    elided = paging.export_blocks(cache, ids, [True, False], 4,
+                                  sent_hashes=sent)
+    fresh_pool = paging.BlockPool(num_blocks=8, block_size=4)
+    registry = paging.HandoffRegistry(fresh_pool)
+    with pytest.raises(paging.HandoffError, match="resend"):
+        paging.adopt_blocks(_mini_cache(8, 4, seed=2), fresh_pool,
+                            elided, registry)
+    with pytest.raises(paging.HandoffError, match="block size"):
+        paging.adopt_blocks(
+            _mini_cache(8, 4, seed=2),
+            paging.BlockPool(num_blocks=8, block_size=8),
+            elided, None)
+
+
+# ------------------------------------------------------- router tier
+def _disagg_router(clock, decode_ledger=None):
+    from tf_operator_tpu.models import router as rt
+
+    r = rt.DisaggRouter(block_size=4, clock=clock,
+                        decode_ledger=decode_ledger)
+    for rid in ("p0", "p1"):
+        r.prefill.add_replica(rid, state=rt.READY)
+        r.prefill.observe(rid, free_blocks=64, total_blocks=64,
+                          queue_depth=0)
+    for rid in ("d0", "d1"):
+        r.decode.add_replica(rid, state=rt.READY)
+        r.decode.observe(rid, free_blocks=64, total_blocks=64,
+                         queue_depth=0)
+    return r
+
+
+def test_disagg_router_handoff_and_retry():
+    """The two-tier dispatch seam: submit lands on the prefill tier
+    (queue-depth policy), handoff retires the prompt there and places
+    on the decode tier, a decode-side refusal re-places on a SIBLING,
+    and a duplicated handoff (re-dispatched prompt finishing twice)
+    is swallowed by the prefill tier's ledger."""
+    from tf_operator_tpu.models import router as rt
+    from tf_operator_tpu.engine import metrics
+
+    t = [0.0]
+    r = _disagg_router(lambda: t[0])
+    dispatches = []
+    r.decode.on_dispatch = (
+        lambda req, rid, reason: dispatches.append((req.rid, rid)))
+    req = rt.ServeRequest("a", prompt_len=12, max_new=8)
+    prid = r.submit(req)
+    assert prid in ("p0", "p1")
+    before = metrics.SERVING_HANDOFF_RETRIES.get()
+    drid = r.handoff(prid, req)
+    assert drid in ("d0", "d1")
+    assert r.handoffs == 1
+    # duplicate handoff of the same rid: the prefill ledger already
+    # holds it — counted, NOT re-placed on decode
+    assert r.handoff(prid, req) is None
+    assert r.duplicate_handoffs == 1
+    assert len(dispatches) == 1
+    # decode-side admission refusal: retry counted, re-placed on the
+    # sibling (never straight back onto the refuser)
+    r.handoff_rejected(drid, req)
+    assert r.handoff_retries == 1
+    assert metrics.SERVING_HANDOFF_RETRIES.get() == before + 1
+    assert len(dispatches) == 2
+    assert dispatches[1][1] != drid
+    assert r.finish(dispatches[1][1], "a") is True
+
+
+def test_two_routers_shared_ledger_dedup_exactly_once():
+    """Two routers behind ONE decode fleet share a CompletionLedger:
+    a handoff duplicated across routers (each prefill tier has its own
+    ledger, so both forward it) adopts twice but COMPLETES exactly
+    once — the second finish is rejected fleet-wide, exactly once."""
+    from tf_operator_tpu.models import router as rt
+
+    t = [0.0]
+    shared = rt.CompletionLedger()
+    ra = _disagg_router(lambda: t[0], decode_ledger=shared)
+    rb = _disagg_router(lambda: t[0], decode_ledger=shared)
+    req = rt.ServeRequest("dup", prompt_len=8, max_new=4)
+    pa = ra.submit(req)
+    pb = rb.submit(req)
+    da = ra.handoff(pa, req)
+    db = rb.handoff(pb, req)
+    assert da is not None and db is not None
+    verdicts = [ra.finish(da, "dup"), rb.finish(db, "dup")]
+    assert verdicts.count(True) == 1
+    assert verdicts.count(False) == 1
+    assert "dup" in shared
+    # a third delivery attempt through EITHER router stays rejected
+    assert ra.finish(da, "dup") is False
+
+
+def test_queue_depth_policy_dispatch_and_cost():
+    """The prefill tier's dispatch axis: shallowest effective queue
+    wins, and the in-flight debit charges PROMPT-only blocks (the
+    prefill pool never holds a decode reservation)."""
+    from tf_operator_tpu.models import router as rt
+
+    r = rt.FleetRouter(policy="queue_depth", block_size=4,
+                       clock=lambda: 0.0)
+    for rid, q in (("p0", 3), ("p1", 0)):
+        r.add_replica(rid, state=rt.READY)
+        r.observe(rid, free_blocks=64, total_blocks=64, queue_depth=q)
+    req = rt.ServeRequest("q", prompt_len=12, max_new=100)
+    assert r.submit(req) == "p1"
+    # the debit was prompt-only: 3 blocks, not ceil(112/4)
+    snap = r._replicas["p1"]
+    assert snap.effective_free() == 64 - req.prefill_blocks(4)
+
+
+def test_disagg_autoscale_policy_per_fleet():
+    """engine/servefleet.DisaggAutoscalePolicy: prefill scales on
+    queue-wait p99, decode on occupancy/blocked admissions, cooldowns
+    tracked PER FLEET, and unknown decode occupancy vetoes scale-in."""
+    from tf_operator_tpu.api.servingjob import AutoscaleSpec
+    from tf_operator_tpu.engine.servefleet import DisaggAutoscalePolicy
+
+    spec = AutoscaleSpec(
+        min_replicas=1, max_replicas=4,
+        scale_out_queue_wait_p99_s=2.0,
+        scale_out_blocked_admissions=3,
+        scale_in_occupancy_floor=0.2,
+    )
+    pol = DisaggAutoscalePolicy(spec, out_cooldown_s=1.0,
+                                in_cooldown_s=10.0)
+    d = pol.decide_prefill(0.0, 2, queue_wait_p99_s=5.0)
+    assert d.direction == "out"
+    pol.acted(0.0, "prefill", "out")
+    # prefill is cooling down; decode is NOT (per-fleet cooldowns)
+    assert pol.decide_prefill(0.5, 2, 5.0).direction is None
+    d = pol.decide_decode(0.5, 2, occupancy=0.95, blocked_delta=0)
+    assert d.direction == "out"
+    # near-full threshold sits halfway between the floor and 1.0
+    assert pol.decide_decode(
+        10.0, 2, occupancy=0.5, blocked_delta=0).direction is None
+    d = pol.decide_decode(10.0, 2, occupancy=0.1, blocked_delta=0)
+    assert d.direction == "in"
+    # blocked admissions trump occupancy; unknown occupancy vetoes in
+    assert pol.decide_decode(
+        20.0, 2, occupancy=0.1, blocked_delta=5).direction == "out"
+    assert pol.decide_decode(
+        30.0, 2, occupancy=None, blocked_delta=0).direction is None
+    assert pol.decide_prefill(
+        30.0, 2, queue_wait_p99_s=0.1).direction == "in"
+
+
+# --------------------------------------------------------- fleet sim
+def test_prefill_burst_trace_seeded_and_shaped():
+    """make_prefill_burst_trace: deterministic per seed, sorted by
+    (t, rid), decode-heavy floor (short prompt / long budget) under
+    long-prompt bursts (384-768 / short budget) confined to their
+    windows."""
+    from tf_operator_tpu.models.fleetsim import make_prefill_burst_trace
+
+    a = make_prefill_burst_trace(11)
+    b = make_prefill_burst_trace(11)
+    assert [(t, r.rid, r.prompt_len, r.max_new) for t, r in a] == \
+           [(t, r.rid, r.prompt_len, r.max_new) for t, r in b]
+    assert a != make_prefill_burst_trace(12)
+    assert [t for t, _ in a] == sorted(t for t, _ in a)
+    floor = [r for _, r in a if r.rid.startswith("f")]
+    burst = [(t, r) for t, r in a if r.rid.startswith("b")]
+    assert floor and burst
+    assert all(16 <= r.prompt_len < 64 and 96 <= r.max_new < 192
+               for r in floor)
+    assert all(384 <= r.prompt_len < 768 and 8 <= r.max_new < 32
+               for _, r in burst)
+    windows = ((60.0, 75.0), (150.0, 168.0))
+    assert all(any(lo <= t < hi for lo, hi in windows)
+               for t, _ in burst)
+    assert make_prefill_burst_trace(11, bursts=()) == [
+        (t, r) for t, r in a if r.rid.startswith("f")]
+
+
+def test_shared_compute_interference_steals_decode_time():
+    """The opt-in interference model: a prefill segment's tokens come
+    off the same accelerator-seconds the decode lanes run on — with a
+    long prompt prefilling, shared_compute decode output drops; the
+    default keeps the prefill channel free (byte-stable goldens)."""
+    from tf_operator_tpu.models.fleetsim import ReplicaConfig, SimReplica
+    from tf_operator_tpu.models.router import ServeRequest
+
+    outs = {}
+    for shared in (False, True):
+        rep = SimReplica("r0", ReplicaConfig(
+            shared_compute=shared, prefill_tps=100.0))
+        rep.enqueue(ServeRequest("decode", 4, 1000), 0.0)
+        rep.step(0.0, 1.0)                      # prefill the short one
+        rep.enqueue(ServeRequest("long", 400, 8), 1.0)
+        for i in range(4):                      # long prompt hogs 100%
+            rep.step(1.0 + i, 1.0)
+        lane = next(ln for ln in rep.lanes if ln.req.rid == "decode")
+        outs[shared] = lane.tokens_out
+    assert outs[True] < outs[False]
+
+
+def test_disagg_harness_beats_unified_on_reduced_burst():
+    """The scheduling win end-to-end on a reduced trace: at equal
+    total KV blocks the disaggregated split's TTFT p99 beats unified,
+    every request is served exactly once, and every one crossed the
+    handoff seam."""
+    from tf_operator_tpu.models.fleetsim import (
+        DisaggHarness, FleetHarness, ReplicaConfig,
+        make_prefill_burst_trace,
+    )
+
+    trace = make_prefill_burst_trace(
+        5, horizon_s=100.0, floor_rate=3.4,
+        bursts=((30.0, 10.0),), burst_rate=14.0,
+    )
+    uni = FleetHarness(
+        "occupancy", n_replicas=4,
+        replica_cfg=ReplicaConfig(pool_blocks=160, shared_compute=True),
+        autoscale=None,
+    ).run(trace, horizon_s=250.0)
+    dis = DisaggHarness(
+        n_prefill=2, n_decode=2,
+        prefill_cfg=ReplicaConfig(role="prefill", shared_compute=True,
+                                  pool_blocks=64),
+        decode_cfg=ReplicaConfig(role="decode", shared_compute=True,
+                                 pool_blocks=256, slots=10),
+    ).run(trace, horizon_s=250.0)
+    assert uni["dropped"] == dis["dropped"] == 0
+    assert uni["duplicates"] == dis["duplicates"] == 0
+    assert dis["handoffs"] == len(trace)
+    assert dis["duplicate_handoffs"] == 0
+    assert dis["ttft_p99_s"] < uni["ttft_p99_s"]
+
+
+def test_disagg_harness_bounces_feed_retry_path():
+    """Decode-side admission failure is the handoff-retry path: with
+    decode pools squeezed to one lane's worth, adoptions bounce
+    through DisaggRouter.handoff_rejected (retries counted, re-placed)
+    and the trace still completes exactly once."""
+    from tf_operator_tpu.models.fleetsim import (
+        DisaggHarness, ReplicaConfig, make_prefill_burst_trace,
+    )
+
+    trace = make_prefill_burst_trace(
+        3, horizon_s=40.0, floor_rate=2.5,
+        bursts=((10.0, 8.0),), burst_rate=12.0,
+    )
+    h = DisaggHarness(
+        n_prefill=1, n_decode=2,
+        prefill_cfg=ReplicaConfig(role="prefill", shared_compute=True,
+                                  pool_blocks=64),
+        decode_cfg=ReplicaConfig(role="decode", shared_compute=True,
+                                 pool_blocks=96, slots=4),
+    )
+    r = h.run(trace, horizon_s=400.0)
+    assert r["dropped"] == 0 and r["duplicates"] == 0
+    assert r["handoff_retries"] > 0
+    assert r["completed"] == len(trace)
+    # the bounces must not have ejected the healthy-but-full refusers
+    assert h.router.decode.ejections == 0
+
+
+def test_disagg_harness_autoscales_both_fleets():
+    """Per-fleet autoscaling end-to-end: a prefill burst trips the
+    queue-wait p99 trigger on the PREFILL fleet; squeezed decode pools
+    trip the occupancy/blocked trigger on the DECODE fleet."""
+    from tf_operator_tpu.api.servingjob import AutoscaleSpec
+    from tf_operator_tpu.models.fleetsim import (
+        DisaggHarness, ReplicaConfig, make_prefill_burst_trace,
+    )
+
+    trace = make_prefill_burst_trace(
+        5, horizon_s=80.0, floor_rate=3.0,
+        bursts=((20.0, 12.0),), burst_rate=14.0,
+    )
+    h = DisaggHarness(
+        n_prefill=1, n_decode=1,
+        prefill_cfg=ReplicaConfig(role="prefill", shared_compute=True,
+                                  pool_blocks=64),
+        decode_cfg=ReplicaConfig(role="decode", shared_compute=True,
+                                 pool_blocks=128, slots=8),
+        autoscale=AutoscaleSpec(
+            min_replicas=1, max_replicas=4,
+            scale_out_queue_wait_p99_s=1.5,
+            scale_out_blocked_admissions=4,
+            scale_in_occupancy_floor=0.2,
+        ),
+    )
+    r = h.run(trace, horizon_s=300.0)
+    assert r["dropped"] == 0
+    fleets = {e["fleet"] for e in h.scale_events if e["dir"] == "out"}
+    assert "prefill" in fleets and "decode" in fleets

@@ -303,13 +303,13 @@ def test_router_mark_ready_without_heartbeat_still_expires():
 def test_router_ledgers_are_bounded():
     router, _ = make_router()
     ready_replica(router, "r0")
-    router.ledger_cap = 8
+    router._completed.cap = 8
     for i in range(32):
         rid = f"q{i}"
         router.submit(ServeRequest(rid, 8, 8))
         router.finish("r0", rid)
     assert len(router._completed) <= 8
-    assert len(router._completed_order) <= 8
+    assert len(router._completed._order) <= 8
 
 
 def test_router_duplicate_completion_still_pumps_queue():

@@ -863,6 +863,43 @@ SERVING_LANE_WASTED_STEPS = Counter(
     "mid-block, leaving only the freeze-to-edge residue — a shrinking "
     "rate here is the iteration scheduler paying off",
 )
+# Disaggregated prefill/decode serving (ISSUE 20): the KV-block
+# handoff between the prefill fleet and the decode fleet — the block
+# table is the wire format.  phase= labels count blocks by what the
+# wire carried: exported (payload bytes shipped) / elided (referenced
+# by hash, bytes already at the receiver) on the send side, adopted
+# (freshly allocated+written) / deduped (content-hash hit, incref
+# only) on the receive side.  elided+deduped rates are the shared-
+# prefix dedup actually saving wire and pool.
+SERVING_HANDOFF_BLOCKS = Counter(
+    f"{PREFIX}_serving_handoff_blocks_total",
+    "KV blocks crossing the prefill→decode handoff by phase: "
+    "exported/elided count the sender's wire composition (elided = "
+    "shared-prefix blocks referenced by content hash, shipped "
+    "earlier), adopted/deduped count the receiver's pool composition "
+    "(deduped = hash hit, an incref instead of an alloc+write) — "
+    "elided/exported and deduped/adopted are the hot-prefix transfer "
+    "savings",
+)
+SERVING_HANDOFF_DURATION = Histogram(
+    f"{PREFIX}_serving_handoff_duration_seconds",
+    "Wall-clock of one lane's KV handoff half, by side: export "
+    "(device_get + hashing + wire form on the prefill replica) and "
+    "adopt (alloc + one jitted scatter on the decode replica) — the "
+    "handoff's latency contribution to disaggregated TTFT; compare "
+    "p99 against serving_ttft_seconds to see whether the wire or the "
+    "compute dominates the split's overhead",
+    buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+             1.0, 2.5),
+)
+SERVING_HANDOFF_RETRIES = Counter(
+    f"{PREFIX}_serving_handoff_retries_total",
+    "Handoffs bounced by decode-side admission (pool could not cover "
+    "the export's fresh blocks plus decode growth) and re-placed on "
+    "another decode replica by the router — a sustained rate is the "
+    "decode fleet's KV capacity signal saturating; pair with "
+    "serving_kv_blocks_used over the decode fleet before scaling",
+)
 # Request flight recorder + windowed SLO engine (ISSUE 16,
 # engine/reqtrace.py): per-request causal timelines on the serving
 # plane, and multi-window burn rates of the latency axes (TTFT / TPOT /

@@ -189,6 +189,118 @@ class AutoscalePolicy:
         self._cooldown_until = now + cool
 
 
+class DisaggAutoscalePolicy:
+    """Per-fleet scale decisions for disaggregated serving: the two
+    tiers saturate on DIFFERENT axes, which is the whole reason to
+    split them (ISSUE 20) — a unified fleet's autoscaler conflates
+    prefill pressure (requests waiting for a prompt slot) with decode
+    pressure (lanes camping on KV blocks) and scales the wrong
+    dimension.  Here:
+
+      * PREFILL scales on queue-wait p99 — a prefill replica's pool
+        turns over per prompt, so memory is never the binding
+        constraint; waiting requests are.  Scale-in when the queue is
+        quiet (p99 under half the out threshold).
+      * DECODE scales on KV-block occupancy and blocked admissions —
+        decode lanes hold blocks for the whole generation, so the
+        fleet saturates in memory long before compute.  Scale-in under
+        the occupancy floor with no blocked admissions.
+
+    Same cooldown asymmetry as AutoscalePolicy, tracked PER FLEET (a
+    prefill burst must not put the decode tier on cooldown).  Both
+    deciders are pure: thresholds in, direction out — shared verbatim
+    by the fleet simulation (models/fleetsim.DisaggHarness) and the
+    operator loop, the same no-divergence contract as
+    ceil_rank_percentile."""
+
+    def __init__(
+        self,
+        spec: servingapi.AutoscaleSpec,
+        out_cooldown_s: float = 1.0,
+        in_cooldown_s: float = 10.0,
+    ) -> None:
+        self.spec = spec
+        self.out_cooldown_s = float(out_cooldown_s)
+        self.in_cooldown_s = float(in_cooldown_s)
+        self._cooldown_until = {"prefill": 0.0, "decode": 0.0}
+
+    def decide_prefill(
+        self,
+        now: float,
+        replicas: int,
+        queue_wait_p99_s: float,
+    ) -> ScaleDecision:
+        s = self.spec
+        if now < self._cooldown_until["prefill"]:
+            return ScaleDecision()
+        if (replicas < s.max_replicas
+                and queue_wait_p99_s > s.scale_out_queue_wait_p99_s):
+            return ScaleDecision(
+                "out", "serving_queue_wait_seconds_p99",
+                queue_wait_p99_s, s.scale_out_queue_wait_p99_s,
+            )
+        if (replicas > s.min_replicas
+                and queue_wait_p99_s
+                <= s.scale_out_queue_wait_p99_s / 2.0):
+            return ScaleDecision(
+                "in", "serving_queue_wait_seconds_p99",
+                queue_wait_p99_s, s.scale_out_queue_wait_p99_s / 2.0,
+            )
+        return ScaleDecision()
+
+    def decide_decode(
+        self,
+        now: float,
+        replicas: int,
+        occupancy: Optional[float],
+        blocked_delta: int,
+    ) -> ScaleDecision:
+        """`occupancy` None = no decode replica has reported — unknown,
+        not idle: scale-in vetoed (same evidence rule as
+        AutoscalePolicy.decide)."""
+        s = self.spec
+        if now < self._cooldown_until["decode"]:
+            return ScaleDecision()
+        if replicas < s.max_replicas:
+            if blocked_delta >= s.scale_out_blocked_admissions:
+                return ScaleDecision(
+                    "out", "serving_admission_blocked_on_memory_total",
+                    float(blocked_delta),
+                    float(s.scale_out_blocked_admissions),
+                )
+            if (occupancy is not None
+                    and occupancy > 1.0 - (1.0 -
+                                           s.scale_in_occupancy_floor)
+                    / 2.0):
+                # nearly full: handoffs are about to start bouncing
+                # (serving_handoff_retries_total) — scale before the
+                # retry storm, not after
+                return ScaleDecision(
+                    "out", "serving_kv_block_occupancy",
+                    occupancy,
+                    1.0 - (1.0 - s.scale_in_occupancy_floor) / 2.0,
+                )
+        if (
+            occupancy is not None
+            and replicas > s.min_replicas
+            and occupancy < s.scale_in_occupancy_floor
+            and blocked_delta == 0
+        ):
+            return ScaleDecision(
+                "in", "serving_kv_block_occupancy",
+                occupancy, s.scale_in_occupancy_floor,
+            )
+        return ScaleDecision()
+
+    def acted(self, now: float, fleet: str,
+              direction: str = "in") -> None:
+        cool = (
+            self.out_cooldown_s if direction == "out"
+            else self.in_cooldown_s
+        )
+        self._cooldown_until[fleet] = now + cool
+
+
 # --------------------------------------------------------------------------
 # process-global fleet status (CLI describe's fleet section) — mirrors
 # timeline.get_recorder(): the operator process registers, readers fall

@@ -38,11 +38,11 @@ from random import Random
 from typing import Dict, List, Optional, Tuple
 
 from tf_operator_tpu.engine.servefleet import (
-    AutoscalePolicy, ceil_rank_percentile,
+    AutoscalePolicy, DisaggAutoscalePolicy, ceil_rank_percentile,
 )
 from tf_operator_tpu.k8s.chaos import SimClock
 from tf_operator_tpu.models.router import (
-    FleetRouter, READY, STARTING, ServeRequest,
+    DisaggRouter, FleetRouter, READY, STARTING, ServeRequest,
 )
 
 
@@ -63,6 +63,22 @@ class ReplicaConfig:
     # head-of-line channel — the serve_loop(scheduler="continuous")
     # stand-in.  Default False keeps every existing golden byte-stable
     continuous: bool = False
+    # disaggregated serving (ISSUE 20).  role: "unified" replicas
+    # prefill AND decode (every pre-existing fleet); "prefill" replicas
+    # admit on PROMPT-only blocks and retire a request the moment its
+    # prefill produces the first token — the handoff point; "decode"
+    # replicas receive prefilled requests (prefill_left = 0, first
+    # token already emitted upstream) and only decode, bouncing an
+    # admission the pool can't cover back to the router (the
+    # handoff-retry path) instead of parking it.
+    role: str = "unified"
+    # shared-compute interference: prefill segments run on the SAME
+    # accelerator as the decode lanes (slot-loop mechanics — a prefill
+    # dispatch stalls every decode lane for its duration), so a
+    # second of prefill is a second of decode lost.  Opt-in: the
+    # pre-existing fleets model prefill as a free channel and their
+    # goldens must stay byte-stable.
+    shared_compute: bool = False
 
     def scaled(self, n: int) -> "ReplicaConfig":
         return ReplicaConfig(
@@ -72,6 +88,8 @@ class ReplicaConfig:
             prefill_tps=self.prefill_tps * n,
             decode_tps=self.decode_tps,
             continuous=self.continuous,
+            role=self.role,
+            shared_compute=self.shared_compute,
         )
 
 
@@ -118,6 +136,10 @@ class SimReplica:
         # queue-wait seconds of requests admitted since the last
         # heartbeat drain (the autoscaler's p99 source)
         self.new_queue_waits: List[float] = []
+        # decode-role only: adoptions the pool refused outright this
+        # step — the harness bounces them to the router's handoff-retry
+        # path (dispatch_failed + re-place) instead of parking them
+        self.bounced: List[ServeRequest] = []
 
     # ------------------------------------------------------------- intake
     def _rrecord(
@@ -128,7 +150,30 @@ class SimReplica:
                 self.job_key, request_id, "replica", event, detail, ts=ts,
             )
 
+    def _decode_gate(self, req: ServeRequest, lanes: int) -> int:
+        bs = self.cfg.block_size
+        if self.cfg.continuous:
+            return -(-req.prompt_len // bs) + lanes
+        return req.blocks(bs)
+
     def enqueue(self, req: ServeRequest, arrival_t: float) -> None:
+        if self.cfg.role == "decode":
+            # the adoption check happens on ARRIVAL, not at the queue
+            # head: paging.adopt_blocks either covers the export NOW or
+            # raises HandoffError.  The router dispatched off its last
+            # heartbeat, which can't see demand already queued here —
+            # if current free minus queued-ahead demand can't cover
+            # this export, refuse it loudly and let the router retry a
+            # sibling rather than park a request whose blocks the
+            # queue ahead of it will eat
+            ahead = sum(
+                self._decode_gate(q, 0) for q, _ in self.queue
+            )
+            if (self._decode_gate(req, len(self.lanes))
+                    > self.free_blocks - ahead):
+                self.blocked_total += 1
+                self.bounced.append(req)
+                return
         self.queue.append((req, arrival_t))
 
     def inflight(self) -> int:
@@ -139,7 +184,13 @@ class SimReplica:
         admitted_any = False
         while self.queue and len(self.lanes) < self.cfg.slots:
             req, arrival_t = self.queue[0]
-            if self.cfg.continuous:
+            if self.cfg.role == "prefill":
+                # prefill fleet: the pool only ever holds PROMPTS —
+                # no decode reservation, so turnover is one prefill
+                # duration and long-prompt bursts admit immediately
+                blocks = req.prefill_blocks(self.cfg.block_size)
+                gate = blocks
+            elif self.cfg.continuous:
                 # blocks-per-step gate: the prompt's own coverage now
                 # plus a one-block reservation per in-flight lane
                 # (their next decode block's growth) — decode blocks
@@ -150,6 +201,20 @@ class SimReplica:
                 blocks = req.blocks(self.cfg.block_size)
                 gate = blocks
             if gate > self.free_blocks:
+                if self.cfg.role == "decode":
+                    # adoption failure is LOUD (paging raises
+                    # HandoffError when the pool can't cover the
+                    # export): bounce to the router rather than wait —
+                    # a sibling decode replica may have room now
+                    self.queue.popleft()
+                    self.blocked_total += 1
+                    self.bounced.append(req)
+                    self._rrecord(req.rid, "memory_gate_block", {
+                        "replica": self.rid, "blocks": blocks,
+                        "free_blocks": self.free_blocks,
+                        "bounced": True,
+                    }, record_t)
+                    continue
                 if not admitted_any and now - self._last_blocked_t >= 0.25:
                     # memory gate holds the FIFO head: one blocked
                     # sample per service iteration, like the serve loop
@@ -162,7 +227,13 @@ class SimReplica:
                 break
             self.queue.popleft()
             self.free_blocks -= blocks
-            self.lanes.append(_Lane(req, arrival_t, now, blocks))
+            lane = _Lane(req, arrival_t, now, blocks)
+            if self.cfg.role == "decode":
+                # the export arrives prefilled and the first token was
+                # sampled by the prefill replica: adopt-and-decode
+                lane.prefill_left = 0.0
+                lane.tokens_out = 1.0
+            self.lanes.append(lane)
             self.new_queue_waits.append(max(0.0, now - arrival_t))
             self._rrecord(req.rid, "admitted", {
                 "replica": self.rid,
@@ -188,12 +259,14 @@ class SimReplica:
         # segments interleave through the fused dispatches, so the
         # channel splits evenly across prefilling lanes.
         budget = self.cfg.prefill_tps * dt
+        spent_tokens = 0.0
         if self.cfg.continuous:
             filling = [ln for ln in self.lanes if ln.prefill_left > 0]
             share = budget / len(filling) if filling else 0.0
             for lane in filling:
                 used = min(lane.prefill_left, share)
                 lane.prefill_left -= used
+                spent_tokens += used
                 if lane.prefill_left <= 0:
                     self._rrecord(lane.req.rid, "prefill_chunk", {
                         "replica": self.rid,
@@ -209,6 +282,7 @@ class SimReplica:
             used = min(lane.prefill_left, budget)
             lane.prefill_left -= used
             budget -= used
+            spent_tokens += used
             if lane.prefill_left <= 0:
                 # one record at prefill completion (not per chunk — a
                 # long prompt would flood the routine ring), carrying
@@ -220,6 +294,14 @@ class SimReplica:
                         lane.req.prompt_len / self.cfg.prefill_tps, 6
                     ),
                 }, now + dt)
+        # shared-compute interference: the seconds the prefill channel
+        # just burned came off the same accelerator the decode lanes
+        # run on (every prefill dispatch stalls every decode lane for
+        # its duration in the slot loop) — decode only advances through
+        # whatever the prefill segments left of this step
+        ddt = dt
+        if self.cfg.shared_compute and spent_tokens > 0:
+            ddt = max(0.0, dt - spent_tokens / self.cfg.prefill_tps)
         # decode: every prefilled lane emits tokens.  Continuous lanes
         # were admitted with prompt-only coverage, so their block
         # demand GROWS as tokens accrue — grow-or-stall: a lane the
@@ -229,8 +311,31 @@ class SimReplica:
         for lane in list(self.lanes):
             if lane.prefill_left > 0:
                 continue
+            if self.cfg.role == "prefill":
+                # the handoff point: the prompt's final fill sampled
+                # the first token, the lane retires, and its prompt
+                # blocks free as soon as the export ships — the record
+                # ("handoff": True) hands the request to the decode
+                # fleet instead of counting as a completion
+                lane.first_token_t = now + dt
+                self.lanes.remove(lane)
+                self.free_blocks += lane.blocks
+                self._rrecord(lane.req.rid, "first_token", {
+                    "replica": self.rid,
+                }, now + dt)
+                done.append({
+                    "rid": lane.req.rid,
+                    "arrival_t": lane.arrival_t,
+                    "admit_t": lane.admit_t,
+                    "first_token_t": now + dt,
+                    "finish_t": now + dt,
+                    "tokens": 1,
+                    "replica": self.rid,
+                    "handoff": True,
+                })
+                continue
             if self.cfg.continuous:
-                emit = min(self.cfg.decode_tps * dt,
+                emit = min(self.cfg.decode_tps * ddt,
                            lane.req.max_new - lane.tokens_out)
                 need = -(-int(lane.req.prompt_len + lane.tokens_out
                               + emit) // self.cfg.block_size)
@@ -240,7 +345,7 @@ class SimReplica:
                         continue  # stall this step; retry next tick
                     self.free_blocks -= grow
                     lane.blocks = need
-            lane.tokens_out += self.cfg.decode_tps * dt
+            lane.tokens_out += self.cfg.decode_tps * ddt
             if lane.first_token_t is None and lane.tokens_out >= 1.0:
                 lane.first_token_t = now + dt
                 self._rrecord(lane.req.rid, "first_token", {
@@ -321,6 +426,50 @@ def make_trace(
                 prompt = rng.randrange(384, 768)  # the heavy tail
             max_new = rng.randrange(32, 96)
             arrivals.append((rt, ServeRequest(f"u{i}r{k}", prompt, max_new)))
+    arrivals.sort(key=lambda a: (a[0], a[1].rid))
+    return arrivals
+
+
+def make_prefill_burst_trace(
+    seed: int,
+    horizon_s: float = 240.0,
+    floor_rate: float = 3.0,
+    bursts: Tuple[Tuple[float, float], ...] = ((60.0, 15.0), (150.0, 18.0)),
+    burst_rate: float = 14.0,
+) -> List[Tuple[float, ServeRequest]]:
+    """Bursty LONG-PROMPT arrivals over a steady decode-heavy floor —
+    the regime disaggregation exists for (ISSUE 20).  The floor is
+    chat-like traffic: short prompts (16-64) with long generations
+    (96-192), so the fleet's steady state is decode-bound — lanes camp
+    on KV blocks and the prefill channel idles.  The bursts are
+    retrieval-stuffed prompts: 384-768 tokens of prefill with 8-32 of
+    generation.  In a unified fleet every burst prompt is head-of-line
+    prefill latency for the replica it lands on (stalling its decode
+    lanes for the whole fill under shared compute) AND a worst-case
+    prompt+budget pool reservation contending with the camped floor
+    lanes — TTFT collapses fleet-wide.  A prefill fleet admits the same
+    burst on prompt-only blocks and ships it to decode replicas that
+    never prefill.  Every timestamp/length is a pure function of the
+    seed."""
+    rng = Random(seed)
+    arrivals: List[Tuple[float, ServeRequest]] = []
+    t = rng.expovariate(floor_rate)
+    i = 0
+    while t < horizon_s:
+        prompt = rng.randrange(16, 64)
+        max_new = rng.randrange(96, 192)
+        arrivals.append((t, ServeRequest(f"f{i}", prompt, max_new)))
+        i += 1
+        t += rng.expovariate(floor_rate)
+    j = 0
+    for start, dur in bursts:
+        bt = start + rng.expovariate(burst_rate)
+        while bt < start + dur:
+            prompt = rng.randrange(384, 768)
+            max_new = rng.randrange(8, 32)
+            arrivals.append((bt, ServeRequest(f"b{j}", prompt, max_new)))
+            j += 1
+            bt += rng.expovariate(burst_rate)
     arrivals.sort(key=lambda a: (a[0], a[1].rid))
     return arrivals
 
@@ -798,4 +947,374 @@ class FleetHarness:
             "hedges_won": self.router.hedges_won,
             "hedges_lost": self.router.hedges_lost,
             "degraded_entries": self.router.degraded_entries,
+        }
+
+
+class DisaggHarness:
+    """Prefill fleet + decode fleet joined by DisaggRouter handoff —
+    the scheduling-win proof for disaggregated serving (ISSUE 20).
+
+    Mechanics mirrored from the real stack: requests enter the PREFILL
+    tier (routed on queue depth), where replicas admit on PROMPT-only
+    blocks, fill the prompt, sample the first token, and retire the
+    lane — the handoff point.  The router's `handoff()` retires the
+    request from the prefill tier (its completion ledger dedupes a
+    re-dispatched prompt finishing twice) and places it onto the
+    DECODE tier (routed on free KV blocks), where replicas adopt the
+    export — prefill_left = 0, first token already emitted — and only
+    decode.  A decode replica whose pool can't cover the adoption
+    bounces it (`handoff_rejected` → retry on a sibling), the sim
+    stand-in for models/paging.HandoffError.
+
+    Scored with the same keys as FleetHarness.summary so the two arms
+    compare directly at equal total KV blocks; TTFT is the PREFILL
+    side's first token (the handoff moves time-to-second-token, not
+    TTFT).  Optional per-fleet autoscaling drives
+    engine/servefleet.DisaggAutoscalePolicy: prefill on queue-wait
+    p99, decode on occupancy + blocked admissions.  Deterministic per
+    (seed, config)."""
+
+    def __init__(
+        self,
+        n_prefill: int = 2,
+        n_decode: int = 2,
+        prefill_cfg: Optional[ReplicaConfig] = None,
+        decode_cfg: Optional[ReplicaConfig] = None,
+        autoscale=None,                 # servingjob.AutoscaleSpec or None
+        autoscale_interval_s: float = 1.0,
+        claim_latency_s: float = 0.5,
+        heartbeat_s: float = 0.5,
+        health_interval_s: float = 2.0,
+        max_inflight_prefill: int = 64,
+        max_inflight_decode: int = 12,
+        dt: float = 0.05,
+    ) -> None:
+        self.prefill_cfg = prefill_cfg or ReplicaConfig(
+            role="prefill", shared_compute=True, pool_blocks=64,
+        )
+        self.decode_cfg = decode_cfg or ReplicaConfig(
+            role="decode", shared_compute=True, pool_blocks=256,
+        )
+        if (self.prefill_cfg.role != "prefill"
+                or self.decode_cfg.role != "decode"):
+            raise ValueError(
+                "DisaggHarness needs role='prefill' / role='decode' "
+                "replica configs — a unified config belongs in "
+                "FleetHarness"
+            )
+        self.clock = SimClock()
+        self.dt = dt
+        self.heartbeat_s = heartbeat_s
+        self.autoscale_interval_s = autoscale_interval_s
+        self.claim_latency_s = claim_latency_s
+        self.router = DisaggRouter(
+            block_size=self.prefill_cfg.block_size,
+            clock=self.clock,
+            prefill_kw=dict(
+                max_inflight_per_replica=max_inflight_prefill,
+                health_interval=health_interval_s,
+            ),
+            decode_kw=dict(
+                max_inflight_per_replica=max_inflight_decode,
+                health_interval=health_interval_s,
+            ),
+        )
+        self.log = self.router.prefill.events
+        self.prefill_replicas: Dict[str, SimReplica] = {}
+        self.decode_replicas: Dict[str, SimReplica] = {}
+        self._next_p = 0
+        self._next_d = 0
+        # rid -> sim time the replica becomes ready (scale-out claims)
+        self._starting: Dict[str, float] = {}
+        self.policy = (
+            DisaggAutoscalePolicy(
+                autoscale, out_cooldown_s=autoscale_interval_s,
+                in_cooldown_s=20 * autoscale_interval_s,
+            )
+            if autoscale is not None else None
+        )
+        self._wait_window: "deque[Tuple[float, float]]" = deque()
+        self._blocked_prev: Dict[str, int] = {}
+        self.scale_events: List[dict] = []
+        self.arrival_t: Dict[str, float] = {}
+        self.first_token_t: Dict[str, float] = {}
+        self.prefill_waits: Dict[str, float] = {}
+        self.requests: Dict[str, ServeRequest] = {}
+        self.results: Dict[str, dict] = {}
+        self.duplicates = 0
+        self.handoff_blocks = 0
+        self.peak_inflight = 0
+        self.replica_seconds = 0.0
+        self.router.prefill.on_dispatch = self._on_prefill_dispatch
+        self.router.decode.on_dispatch = self._on_decode_dispatch
+        for _ in range(n_prefill):
+            self._add_replica("prefill", ready_now=True)
+        for _ in range(n_decode):
+            self._add_replica("decode", ready_now=True)
+
+    # ------------------------------------------------------------- plumbing
+    def _add_replica(self, fleet: str, ready_now: bool,
+                     latency: float = 0.0) -> str:
+        if fleet == "prefill":
+            rid = f"p{self._next_p}"
+            self._next_p += 1
+            cfg, pool, tier = (
+                self.prefill_cfg, self.prefill_replicas,
+                self.router.prefill,
+            )
+        else:
+            rid = f"d{self._next_d}"
+            self._next_d += 1
+            cfg, pool, tier = (
+                self.decode_cfg, self.decode_replicas,
+                self.router.decode,
+            )
+        pool[rid] = SimReplica(rid, cfg)
+        tier.add_replica(rid, state=STARTING)
+        if ready_now:
+            hb = pool[rid].heartbeat()
+            tier.observe(
+                rid, hb["free_blocks"], hb["total_blocks"],
+                hb["queue_depth"],
+            )
+        else:
+            self._starting[rid] = self.clock() + latency
+        return rid
+
+    def _on_prefill_dispatch(
+        self, req: ServeRequest, rid: str, reason: str,
+    ) -> None:
+        replica = self.prefill_replicas.get(rid)
+        if replica is not None:
+            replica.enqueue(req, self.arrival_t[req.rid])
+
+    def _on_decode_dispatch(
+        self, req: ServeRequest, rid: str, reason: str,
+    ) -> None:
+        replica = self.decode_replicas.get(rid)
+        if replica is not None:
+            replica.enqueue(req, self.arrival_t[req.rid])
+
+    # ------------------------------------------------------------ autoscale
+    def _autoscale_tick(self, now: float) -> None:
+        while (self._wait_window
+               and now - self._wait_window[0][0] > 12.0):
+            self._wait_window.popleft()
+        p99 = ceil_rank_percentile(
+            [w for _, w in self._wait_window], 0.99
+        )
+        live_p = sorted(
+            rid for rid in self.prefill_replicas
+            if rid not in self._starting
+        )
+        d = self.policy.decide_prefill(
+            now, len(self.prefill_replicas), p99
+        )
+        if d.direction == "out":
+            rid = self._add_replica(
+                "prefill", ready_now=False, latency=self.claim_latency_s
+            )
+            self.scale_events.append({
+                "fleet": "prefill", "dir": "out", "t": now,
+                "replica": rid, "trigger": d.trigger,
+            })
+            self.policy.acted(now, "prefill", "out")
+        elif d.direction == "in" and len(live_p) > 1:
+            # drain-free scale-in: only an IDLE victim goes (highest
+            # numeric index, the scale-down delete's pick) — a busy
+            # fleet just skips the shrink this tick
+            victim = max(live_p, key=lambda rid: int(rid[1:]))
+            if self.prefill_replicas[victim].inflight() == 0 \
+                    and self.router.prefill.inflight(victim) == 0:
+                self.router.prefill.remove_replica(victim)
+                self.prefill_replicas.pop(victim)
+                self.scale_events.append({
+                    "fleet": "prefill", "dir": "in", "t": now,
+                    "replica": victim,
+                })
+                self.policy.acted(now, "prefill", "in")
+        live_d = sorted(
+            rid for rid in self.decode_replicas
+            if rid not in self._starting
+        )
+        used = total = 0
+        blocked_delta = 0
+        for rid in live_d:
+            r = self.decode_replicas[rid]
+            used += r.cfg.pool_blocks - r.free_blocks
+            total += r.cfg.pool_blocks
+            blocked_delta += max(
+                0, r.blocked_total - self._blocked_prev.get(rid, 0)
+            )
+            self._blocked_prev[rid] = r.blocked_total
+        occupancy = used / total if total else None
+        d = self.policy.decide_decode(
+            now, len(self.decode_replicas), occupancy, blocked_delta
+        )
+        if d.direction == "out":
+            rid = self._add_replica(
+                "decode", ready_now=False, latency=self.claim_latency_s
+            )
+            self.scale_events.append({
+                "fleet": "decode", "dir": "out", "t": now,
+                "replica": rid, "trigger": d.trigger,
+            })
+            self.policy.acted(now, "decode", "out")
+        elif d.direction == "in" and len(live_d) > 1:
+            victim = max(live_d, key=lambda rid: int(rid[1:]))
+            if self.decode_replicas[victim].inflight() == 0 \
+                    and self.router.decode.inflight(victim) == 0:
+                self.router.decode.remove_replica(victim)
+                self.decode_replicas.pop(victim)
+                self.scale_events.append({
+                    "fleet": "decode", "dir": "in", "t": now,
+                    "replica": victim,
+                })
+                self.policy.acted(now, "decode", "in")
+
+    # ---------------------------------------------------------------- run
+    def run(self, trace: List[Tuple[float, ServeRequest]],
+            horizon_s: float = 400.0) -> dict:
+        pending = deque(trace)
+        n_total = len(trace)
+        next_hb = 0.0
+        next_scale = 0.0
+        while ((len(self.results) < n_total or pending)
+               and self.clock() < horizon_s):
+            self.clock.advance(self.dt)
+            now = self.clock()
+            while pending and pending[0][0] <= now:
+                _, req = pending.popleft()
+                self.arrival_t[req.rid] = now
+                self.requests[req.rid] = req
+                self.router.submit(req)
+            inflight = (
+                sum(r.inflight()
+                    for r in self.prefill_replicas.values())
+                + sum(r.inflight()
+                      for r in self.decode_replicas.values())
+                + self.router.prefill.queue_depth()
+                + self.router.decode.queue_depth()
+            )
+            self.peak_inflight = max(self.peak_inflight, inflight)
+            for rid in sorted(self.prefill_replicas):
+                if rid in self._starting:
+                    continue
+                replica = self.prefill_replicas[rid]
+                self.replica_seconds += self.dt
+                for rec in replica.step(now - self.dt, self.dt):
+                    req = self.requests[rec["rid"]]
+                    # TTFT is decided HERE: the prefill's last fill
+                    # sampled the token; the handoff moves the rest
+                    self.first_token_t[rec["rid"]] = (
+                        rec["first_token_t"]
+                    )
+                    self.prefill_waits[rec["rid"]] = max(
+                        0.0, rec["admit_t"] - rec["arrival_t"]
+                    )
+                    self.handoff_blocks += req.prefill_blocks(
+                        self.prefill_cfg.block_size
+                    )
+                    self.router.handoff(rid, req)
+            for rid in sorted(self.decode_replicas):
+                if rid in self._starting:
+                    continue
+                replica = self.decode_replicas[rid]
+                self.replica_seconds += self.dt
+                for rec in replica.step(now - self.dt, self.dt):
+                    if self.router.finish(
+                        rid, rec["rid"], tokens=rec["tokens"]
+                    ):
+                        self.results[rec["rid"]] = rec
+                    else:
+                        self.duplicates += 1
+                for req in replica.bounced:
+                    self.router.handoff_rejected(rid, req)
+                replica.bounced.clear()
+            for rid, ready_at in sorted(self._starting.items()):
+                if now >= ready_at:
+                    del self._starting[rid]
+                    pool, tier = (
+                        (self.prefill_replicas, self.router.prefill)
+                        if rid.startswith("p")
+                        else (self.decode_replicas, self.router.decode)
+                    )
+                    hb = pool[rid].heartbeat()
+                    tier.observe(
+                        rid, hb["free_blocks"], hb["total_blocks"],
+                        hb["queue_depth"],
+                    )
+            if now >= next_hb:
+                next_hb = now + self.heartbeat_s
+                for pool, tier in (
+                    (self.prefill_replicas, self.router.prefill),
+                    (self.decode_replicas, self.router.decode),
+                ):
+                    for rid in sorted(pool):
+                        if rid in self._starting:
+                            continue
+                        hb = pool[rid].heartbeat()
+                        for w in hb["queue_waits"]:
+                            self._wait_window.append((now, w))
+                        tier.observe(
+                            rid, hb["free_blocks"],
+                            hb["total_blocks"], hb["queue_depth"],
+                        )
+                self.router.publish_occupancy()
+            self.router.tick(now)
+            if self.policy is not None and now >= next_scale:
+                next_scale = now + self.autoscale_interval_s
+                self._autoscale_tick(now)
+        return self.summary(n_total)
+
+    # ------------------------------------------------------------- scoring
+    def summary(self, n_total: int) -> dict:
+        recs = list(self.results.values())
+        ttfts = sorted(
+            self.first_token_t[r["rid"]]
+            - self.arrival_t[r["rid"]]
+            for r in recs
+        )
+        waits = sorted(
+            self.prefill_waits[r["rid"]] for r in recs
+            if r["rid"] in self.prefill_waits
+        )
+        tokens = sum(r["tokens"] for r in recs)
+        span = (
+            max(r["finish_t"] for r in recs)
+            - min(self.arrival_t.values())
+            if recs else 0.0
+        )
+
+        def pct(xs: List[float], q: float) -> Optional[float]:
+            return round(ceil_rank_percentile(xs, q), 3) if xs else None
+
+        all_ttfts = ttfts + [float("inf")] * (n_total - len(recs))
+        p99_all = (
+            ceil_rank_percentile(all_ttfts, 0.99) if all_ttfts else None
+        )
+        if p99_all == float("inf"):
+            p99_all = None
+        return {
+            "mode": "disagg",
+            "completed": len(recs),
+            "dropped": n_total - len(recs),
+            "duplicates": self.duplicates,
+            "tokens_per_sec": round(tokens / span, 1) if span else 0.0,
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p99_s": pct(ttfts, 0.99),
+            "ttft_p99_all_s": (
+                round(p99_all, 3) if p99_all is not None else None
+            ),
+            "queue_wait_p99_s": pct(waits, 0.99),
+            "peak_inflight": self.peak_inflight,
+            "replica_seconds": round(self.replica_seconds, 1),
+            "handoffs": self.router.handoffs,
+            "handoff_retries": self.router.handoff_retries,
+            "duplicate_handoffs": self.router.duplicate_handoffs,
+            "handoff_blocks": self.handoff_blocks,
+            "scale_out_events": sum(
+                1 for e in self.scale_events if e["dir"] == "out"),
+            "scale_in_events": sum(
+                1 for e in self.scale_events if e["dir"] == "in"),
         }

@@ -59,7 +59,10 @@ SURVEY.md §5.7).
 from __future__ import annotations
 
 import functools
+import hashlib
 from typing import List, Sequence
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -413,3 +416,237 @@ def plan_request(prompt_len: int, max_new_tokens: int, headroom: int,
     shared = min(prefix_len // block_size, total)
     cow = prefix_len % block_size != 0
     return total, shared, total - shared, cow
+
+
+# ------------------------------------------------------------------ handoff
+# Disaggregated prefill/decode: THE BLOCK TABLE IS THE WIRE FORMAT.  A
+# prefill replica finishes a prompt into its own pool, exports the
+# lane's table as (content hashes in table order, payload for each
+# referenced block), and frees its blocks — ownership transfers with
+# the bytes.  The decode replica adopts the export into ITS pool: fresh
+# ids (block ids are pool-local, never wire-meaningful), refcounts as
+# the ownership protocol, and shared-prefix blocks deduped by content
+# hash so a hot prefix's bytes cross the wire and land in the pool
+# exactly once per decode replica (HandoffRegistry).  Adoption is
+# CoW-safe by construction: only WHOLE shared-prefix blocks are marked
+# dedupe-eligible, so a partial boundary block (whose tail holds lane
+# positions) always ships and adopts as a private block.
+
+
+class HandoffError(RuntimeError):
+    """A KV-block handoff cannot be adopted as shipped — wrong block
+    size, or a block's payload is absent and its hash unknown to the
+    receiver.  The router's retry surface: resend with full payload
+    (or re-prefill) on a replica that can take it."""
+
+
+class BlockExport:
+    """One lane's KV blocks in wire form: content hashes in table
+    order, a dedupe-eligibility flag per block, and payload bytes
+    (host arrays, same tree structure as one pool block) keyed by
+    hash.  `window` carries sliding-window ring metadata (slot map,
+    surviving shared slots, rotation cursor) when the lane's table is
+    modular; linear lanes leave it None."""
+
+    __slots__ = ("block_size", "hashes", "shared", "payload", "window")
+
+    def __init__(self, block_size, hashes, shared, payload, window=None):
+        self.block_size = int(block_size)
+        self.hashes = list(hashes)
+        self.shared = list(shared)
+        self.payload = dict(payload)
+        self.window = window
+
+    def __len__(self) -> int:
+        return len(self.hashes)
+
+    def payload_blocks(self) -> int:
+        """Blocks whose bytes actually ride this export (dedup may have
+        elided shared ones already shipped)."""
+        return len(self.payload)
+
+    def nbytes(self) -> int:
+        """Wire payload size (block bytes only; the table rides as
+        hashes and is noise next to the KV)."""
+        total = 0
+        for row in self.payload.values():
+            for leaf in jax.tree.leaves(row):
+                total += leaf.nbytes
+        return total
+
+
+def _hash_block(leaves, i: int) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for leaf in leaves:
+        h.update(np.ascontiguousarray(leaf[i]).tobytes())
+    return h.hexdigest()
+
+
+def export_blocks(cache, ids: Sequence[int], shared: Sequence[bool],
+                  block_size: int, *, sent_hashes=None,
+                  window=None) -> BlockExport:
+    """Export the blocks `ids` (in table order) from `cache` into wire
+    form.  `shared[i]` marks block i dedupe-eligible — WHOLE
+    shared-prefix blocks only; a CoW boundary block's tail is
+    lane-private and must never dedupe.  `sent_hashes` (caller-owned
+    set) elides payload for shared blocks already shipped to the same
+    receiver: the hot prefix crosses the wire once, later handoffs
+    reference it by hash and the receiver's HandoffRegistry resolves
+    the id.  One device_get covers every exported block; QTensor
+    (int8 KV) leaves ride the same tree."""
+    if len(ids) != len(shared):
+        raise ValueError(
+            f"ids/shared length mismatch: {len(ids)} vs {len(shared)}")
+    idx = jnp.asarray(list(ids), jnp.int32)
+    host = jax.device_get(jax.tree.map(lambda p: p[idx], cache))
+    leaves = jax.tree.leaves(host)
+    hashes = [_hash_block(leaves, i) for i in range(len(ids))]
+    payload = {}
+    for i, (h, sh) in enumerate(zip(hashes, shared)):
+        if sh and sent_hashes is not None and h in sent_hashes:
+            continue  # receiver already holds these bytes
+        if h in payload:
+            continue
+        payload[h] = jax.tree.map(lambda leaf: leaf[i], host)
+        if sh and sent_hashes is not None:
+            sent_hashes.add(h)
+    return BlockExport(block_size, hashes, shared, payload, window)
+
+
+class HandoffRegistry:
+    """Receiver-side dedup: content hash -> adopted block id, tied to
+    one BlockPool's refcounts.  The registry holds NO reference of its
+    own — a mapping lives exactly as long as some lane holds the block,
+    so the pool's free list is exactly restored once every adopting
+    lane finishes (the refcount property the handoff tests pin).  The
+    price of refcount-tied lifetime: every decref of a possibly-
+    registered id must route through release(), or the map would go
+    stale and a later adoption would incref a freed block."""
+
+    def __init__(self, pool: BlockPool) -> None:
+        self.pool = pool
+        self._id_of = {}
+        self._hash_of = {}
+        self.dedup_hits = 0
+
+    def lookup(self, h: str):
+        return self._id_of.get(h)
+
+    def register(self, h: str, block_id: int) -> None:
+        self._id_of[h] = block_id
+        self._hash_of[block_id] = h
+
+    def adopt_shared(self, h: str):
+        """Dedup hit: take one more reference on the block already
+        holding these bytes, or None when the hash is unknown."""
+        bid = self._id_of.get(h)
+        if bid is None:
+            return None
+        self.pool.incref([bid])
+        self.dedup_hits += 1
+        return bid
+
+    def release(self, ids: Sequence[int]) -> int:
+        """decref that keeps the hash map honest: ids freed by this
+        decref drop their registration (the next adoption of that
+        content re-ships and re-registers)."""
+        freed = 0
+        for b in list(ids):
+            f = self.pool.decref([b])
+            freed += f
+            if f:
+                h = self._hash_of.pop(b, None)
+                if h is not None:
+                    self._id_of.pop(h, None)
+        return freed
+
+
+def adoption_cost(export: BlockExport, registry=None) -> int:
+    """Fresh blocks an adoption of `export` will allocate RIGHT NOW
+    given the registry's current contents — the admission gate's unit
+    (dedup hits cost an incref, not a block)."""
+    fresh = 0
+    seen = set()
+    for h, sh in zip(export.hashes, export.shared):
+        if sh and h in seen:
+            continue
+        if sh and registry is not None and registry.lookup(h) is not None:
+            continue
+        fresh += 1
+        if sh:
+            seen.add(h)
+    return fresh
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def write_blocks(cache, ids, rows):
+    """Adoption's device half: scatter `rows` (stacked block payloads,
+    leading axis aligned with `ids`) into the pool at `ids`.  ids/rows
+    are traced, so one compile per (count, shape) serves every adoption
+    — callers pad the count to the table width with scratch-id rows
+    (writes to block 0 are the same harmless scratch writes frozen
+    lanes make).  QTensor leaves flatten to (q, scale) pairs on both
+    sides and stay aligned through the tree_map."""
+    return jax.tree.map(lambda p, v: p.at[ids].set(v), cache, rows)
+
+
+def adopt_blocks(cache, pool: BlockPool, export: BlockExport,
+                 registry=None, *, pad_to=None):
+    """Adopt an exported lane into (cache, pool): fresh ids in table
+    order, shared blocks deduped through `registry` (incref instead of
+    alloc+write), everything else allocated and written in ONE jitted
+    scatter.  Returns (cache, adopted_ids, shared_ids, own_ids, stats):
+    adopted_ids is the table row; shared_ids (registry-tracked — free
+    them via registry.release) and own_ids (plain decref) split
+    ownership for the lane's finish path.  stats = {"fresh", "deduped",
+    "payload_blocks"}.
+
+    Raises HandoffError on block-size mismatch or when a block's
+    payload is missing and its hash unknown (the sender elided bytes
+    the receiver never saw — the router retries with full payload).
+    Raises RuntimeError on pool exhaustion: callers gate admission on
+    adoption_cost() first, exactly like every other admission path."""
+    if export.block_size != pool.block_size:
+        raise HandoffError(
+            f"block size mismatch: export {export.block_size} vs "
+            f"pool {pool.block_size}")
+    adopted, shared_ids, own_ids = [], [], []
+    write_ids, write_rows = [], []
+    deduped = 0
+    for i, (h, sh) in enumerate(zip(export.hashes, export.shared)):
+        if sh and registry is not None:
+            bid = registry.adopt_shared(h)
+            if bid is not None:
+                adopted.append(bid)
+                shared_ids.append(bid)
+                deduped += 1
+                continue
+        row = export.payload.get(h)
+        if row is None:
+            raise HandoffError(
+                f"block {i}: payload for hash {h} not shipped and not "
+                f"resident — resend with full payload")
+        [bid] = pool.alloc(1)
+        adopted.append(bid)
+        if sh:
+            shared_ids.append(bid)
+            if registry is not None:
+                registry.register(h, bid)
+        else:
+            own_ids.append(bid)
+        write_ids.append(bid)
+        write_rows.append(row)
+    if write_rows:
+        ids_out = list(write_ids)
+        rows_out = list(write_rows)
+        if pad_to is not None and len(ids_out) < pad_to:
+            zero = jax.tree.map(np.zeros_like, rows_out[0])
+            while len(ids_out) < pad_to:
+                ids_out.append(SCRATCH_BLOCK)
+                rows_out.append(zero)
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *rows_out)
+        cache = write_blocks(cache, jnp.asarray(ids_out, jnp.int32),
+                             stacked)
+    stats = {"fresh": len(write_ids), "deduped": deduped,
+             "payload_blocks": len(write_ids)}
+    return cache, adopted, shared_ids, own_ids, stats

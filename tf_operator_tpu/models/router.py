@@ -82,7 +82,7 @@ from typing import Callable, Dict, List, Optional
 from tf_operator_tpu.engine import metrics
 from tf_operator_tpu.k8s.informer import capped_exponential
 
-POLICIES = ("occupancy", "round_robin")
+POLICIES = ("occupancy", "round_robin", "queue_depth")
 
 # replica lifecycle states (the serving_fleet_replicas gauge's label set)
 STARTING = "starting"    # pod claimed/created, not yet heartbeating
@@ -104,6 +104,39 @@ class ServeRequest:
 
     def blocks(self, block_size: int) -> int:
         return -(-(self.prompt_len + self.max_new) // block_size)
+
+    def prefill_blocks(self, block_size: int) -> int:
+        """KV cost on a PREFILL-fleet replica: the prompt's blocks
+        only — a prefill lane never decodes, so its pool charge stops
+        at the prompt (models/serving.py prefill_only plans)."""
+        return -(-self.prompt_len // block_size)
+
+
+class CompletionLedger:
+    """Bounded at-most-once completion set, SHAREABLE between routers:
+    two front-end routers over one decode fleet must agree on which
+    request ids already delivered — during a prefill→decode handoff a
+    re-dispatched adoption can complete through either router, and
+    exactly one completion may reach the client.  Same bound rationale
+    as the per-router ledgers: dedup only has to span the re-dispatch
+    window, not the fleet's lifetime."""
+
+    def __init__(self, cap: int = 1 << 16) -> None:
+        self.cap = int(cap)
+        self._ids: set = set()
+        self._order: "deque[str]" = deque()
+
+    def add(self, request_id: str) -> None:
+        self._ids.add(request_id)
+        self._order.append(request_id)
+        while len(self._order) > self.cap:
+            self._ids.discard(self._order.popleft())
+
+    def __contains__(self, request_id: str) -> bool:
+        return request_id in self._ids
+
+    def __len__(self) -> int:
+        return len(self._ids)
 
 
 @dataclasses.dataclass
@@ -193,6 +226,8 @@ class FleetRouter:
         enable_hedging: bool = True,
         hedge_floor_s: float = 1.0,
         hedge_min_samples: int = 8,
+        ledger: Optional[CompletionLedger] = None,
+        fleet: Optional[str] = None,
     ) -> None:
         if policy not in POLICIES:
             raise ValueError(
@@ -226,13 +261,19 @@ class FleetRouter:
         # one would park the FIFO head forever and starve everything
         # behind it
         self.rejected: List[str] = []
-        self._completed: set = set()
-        # both ledgers are BOUNDED: dedup only has to span the
-        # re-dispatch window, not the router's lifetime — at 100 req/s
-        # an unbounded completed-id set would grow ~8.6M entries/day
-        self._completed_order: "deque[str]" = deque()
-        self._redispatch_order: "deque[str]" = deque()
+        # ledgers are BOUNDED: dedup only has to span the re-dispatch
+        # window, not the router's lifetime — at 100 req/s an unbounded
+        # completed-id set would grow ~8.6M entries/day.  The completion
+        # ledger is injectable so routers sharing one fleet (e.g. two
+        # front-ends over the decode tier of a disaggregated pair)
+        # agree on delivered ids — at-most-once holds fleet-wide
         self.ledger_cap = 1 << 16
+        self._completed = (ledger if ledger is not None
+                           else CompletionLedger(self.ledger_cap))
+        self._redispatch_order: "deque[str]" = deque()
+        # fleet name: labels this router's queue gauge so a prefill
+        # and a decode router in one process export distinct series
+        self.fleet = fleet
         # dispatch callback: (request, replica_id, reason) — the harness
         # hands the request to the chosen replica here
         self.on_dispatch: Optional[Callable] = None
@@ -321,7 +362,19 @@ class FleetRouter:
         )
 
     def _queue_gauge(self) -> None:
-        metrics.SERVING_ROUTER_QUEUE_DEPTH.set(len(self._queue))
+        if self.fleet is not None:
+            metrics.SERVING_ROUTER_QUEUE_DEPTH.set(
+                len(self._queue), {"fleet": self.fleet})
+        else:
+            metrics.SERVING_ROUTER_QUEUE_DEPTH.set(len(self._queue))
+
+    def _cost(self, request: ServeRequest) -> int:
+        """Blocks this router's fleet charges for the request: the
+        prefill tier (queue_depth policy) stops at the prompt, every
+        other tier carries the full prompt+generation worst case."""
+        if self.policy == "queue_depth":
+            return request.prefill_blocks(self.block_size)
+        return request.blocks(self.block_size)
 
     def _note_redispatch(self, request_id: str) -> None:
         if request_id not in self.redispatches:
@@ -334,9 +387,6 @@ class FleetRouter:
 
     def _note_completed(self, request_id: str) -> None:
         self._completed.add(request_id)
-        self._completed_order.append(request_id)
-        while len(self._completed_order) > self.ledger_cap:
-            self._completed.discard(self._completed_order.popleft())
 
     def _note_first_token_id(self, request_id: str) -> None:
         self._first_token.add(request_id)
@@ -636,11 +686,17 @@ class FleetRouter:
         r.consec_failures += 1
         self._maybe_eject(r, "scrape_failures")
 
-    def dispatch_failed(self, rid: str, request_id: str) -> None:
+    def dispatch_failed(self, rid: str, request_id: str,
+                        count_failure: bool = True) -> None:
         """A dispatch handed to `rid` never landed (connection refused,
         pod gone).  The request re-places immediately — it was never
         accepted, so this is not a re-dispatch of an orphan — and the
-        failure counts toward ejection."""
+        failure counts toward ejection.  `count_failure=False` skips
+        the ejection pressure: an ADMISSION refusal (decode pool can't
+        cover a handoff's blocks — backpressure from a healthy replica)
+        must not eject the refuser, because ejection orphan-requeues
+        its genuinely-running lanes onto siblings and every request
+        then completes twice."""
         r = self._replicas.get(rid)
         if r is None:
             return
@@ -658,13 +714,15 @@ class FleetRouter:
             # phantom blocks would make an empty replica look full
             # (clamped — observe() may already have zeroed the debits)
             r.debit_blocks = max(
-                0, r.debit_blocks - req.blocks(self.block_size)
+                0, r.debit_blocks - self._cost(req)
             )
             r.debit_count = max(0, r.debit_count - 1)
-        r.consec_failures += 1
+        if count_failure:
+            r.consec_failures += 1
         self._log(f"dispatch_failed req={request_id} replica={rid}")
         self._rrecord(request_id, "dispatch_failed", {"replica": rid})
-        self._maybe_eject(r, "dispatch_failures")
+        if count_failure:
+            self._maybe_eject(r, "dispatch_failures")
         # re-place only a request that is neither delivered nor covered:
         # a hedge copy whose dispatch failure is reported AFTER the
         # other arm already completed must not burn a third execution
@@ -1004,23 +1062,23 @@ class FleetRouter:
         forever and starve everything behind it.  Checked at submit AND
         at pump (a request can slip past submit before any heartbeat
         exists, or outlive the big replica that could have served it)."""
-        if self.policy != "occupancy":
+        if self.policy == "round_robin":
             return False
         cap = max(
             (r.snapshot.total_blocks for r in self._replicas.values()
              if r.snapshot is not None),
             default=None,
         )
-        if cap is None or request.blocks(self.block_size) <= cap:
+        if cap is None or self._cost(request) <= cap:
             return False
         self.rejected.append(request.rid)
         metrics.SERVING_ROUTER_DISPATCH.inc({"reason": "rejected"})
         self._log(
             f"reject req={request.rid} "
-            f"blocks={request.blocks(self.block_size)} cap={cap}"
+            f"blocks={self._cost(request)} cap={cap}"
         )
         self._rrecord(request.rid, "rejected", {
-            "blocks": request.blocks(self.block_size), "cap": cap,
+            "blocks": self._cost(request), "cap": cap,
         })
         return True
 
@@ -1054,7 +1112,7 @@ class FleetRouter:
         now = self.clock()
         r.inflight[request.rid] = request
         r.dispatched_at[request.rid] = now
-        r.debit_blocks += request.blocks(self.block_size)
+        r.debit_blocks += self._cost(request)
         r.debit_count += 1
         reason = reason or (
             "degraded" if self.degraded else self.policy
@@ -1099,6 +1157,32 @@ class FleetRouter:
             # blind baseline: cycle ready replicas, no occupancy or
             # in-flight bound — exactly what bench-fleet measures against
             return self._rr_pick(cands, exclude)
+        if self.policy == "queue_depth":
+            # prefill tier: TTFT is queue wait + one prompt's compute,
+            # so dispatch to the shortest queue — free blocks only
+            # break ties (a prefill pool holds prompts briefly; depth,
+            # not occupancy, is what a burst piles up).  The cost gate
+            # still holds: the replica must fit the PROMPT's blocks
+            if self.degraded:
+                return self._rr_pick(
+                    [c for c in cands
+                     if len(c.inflight) < self.max_inflight],
+                    exclude,
+                )
+            cost = self._cost(request)
+            best = None
+            best_key = None
+            for c in cands:
+                if c.rid in exclude:
+                    continue
+                if len(c.inflight) >= self.max_inflight:
+                    continue
+                if c.snapshot is None or c.effective_free() < cost:
+                    continue
+                key = (c.effective_queue(), -c.effective_free(), c.rid)
+                if best_key is None or key < best_key:
+                    best, best_key = c, key
+            return best.rid if best is not None else None
         if self.degraded:
             # blindness fallback: telemetry is stale fleet-wide, so the
             # occupancy score is fiction — round-robin over READY, but
@@ -1215,3 +1299,111 @@ class FleetRouter:
                     self._log(f"drain_released replica={rid}")
                     self._gauge_states()
                     self.pump()
+
+
+class DisaggRouter:
+    """Two-tier dispatch for disaggregated serving: a PREFILL fleet
+    routed on queue depth (TTFT = queue wait + one prompt's compute;
+    the pool holds prompts briefly, so depth is the scarce axis) and a
+    DECODE fleet routed on free KV blocks (a decode lane camps on its
+    blocks for the whole generation; occupancy is the scarce axis).
+    The seam between them is `handoff()`: the prefill replica finished
+    a prompt and exported its block table (models/serving.py
+    prefill_only → models/paging.BlockExport) — the request now places
+    onto a decode replica, which ADOPTS the blocks instead of
+    re-prefilling.
+
+    Failure surface: a decode replica can refuse an adoption (pool
+    cannot cover the export's fresh blocks plus decode growth —
+    models/paging.HandoffError or an admission gate).  The caller
+    reports it via `handoff_rejected()`, which counts
+    serving_handoff_retries_total and re-places the request on a
+    sibling through the decode router's dispatch_failed path — the
+    refusing replica is avoided, a lone-replica fleet queues.
+
+    Each tier is a full FleetRouter (health, ejection, drain, hedging,
+    chaos-deterministic event logs).  The decode tier's completion
+    ledger is injectable and shareable: multiple DisaggRouters over
+    one decode fleet agree on delivered ids, so a duplicate adoption
+    of a re-dispatched handoff completes at most once fleet-wide."""
+
+    def __init__(
+        self,
+        block_size: int = 16,
+        clock: Callable[[], float] = time.time,
+        decode_ledger: Optional[CompletionLedger] = None,
+        prefill_kw: Optional[Dict] = None,
+        decode_kw: Optional[Dict] = None,
+    ) -> None:
+        self.prefill = FleetRouter(
+            policy="queue_depth", block_size=block_size, clock=clock,
+            fleet="prefill", **(prefill_kw or {}),
+        )
+        self.decode = FleetRouter(
+            policy="occupancy", block_size=block_size, clock=clock,
+            fleet="decode", ledger=decode_ledger, **(decode_kw or {}),
+        )
+        self.handoffs = 0
+        self.handoff_retries = 0
+        self.duplicate_handoffs = 0
+
+    # ------------------------------------------------------- lifecycle
+    def submit(self, request: ServeRequest) -> Optional[str]:
+        """Route a new request into the prefill tier."""
+        return self.prefill.submit(request)
+
+    def handoff(self, prefill_rid: str, request: ServeRequest,
+                ) -> Optional[str]:
+        """The prefill replica finished `request`'s prompt: retire it
+        from the prefill tier (its ledger dedupes a re-dispatched
+        prompt finishing twice — the duplicate must NOT adopt twice)
+        and place it onto the decode tier.  Returns the decode replica
+        id, or None when the handoff queued (decode.pump() delivers it
+        when blocks free up)."""
+        if not self.prefill.finish(prefill_rid, request.rid):
+            self.duplicate_handoffs += 1
+            return None
+        self.handoffs += 1
+        return self.decode.submit(request)
+
+    def handoff_rejected(self, decode_rid: str,
+                         request: ServeRequest) -> None:
+        """Decode-side admission refused the adoption: count the retry
+        and re-place on a sibling (never straight back onto the
+        refusing replica).  The refusal is BACKPRESSURE, not a broken
+        replica — `count_failure=False` keeps it out of the ejection
+        ledger (ejecting a full-but-healthy replica would orphan-
+        requeue its running lanes and double-deliver them)."""
+        self.handoff_retries += 1
+        metrics.SERVING_HANDOFF_RETRIES.inc()
+        self.decode.dispatch_failed(decode_rid, request.rid,
+                                    count_failure=False)
+
+    def finish(self, decode_rid: str, request_id: str,
+               tokens: Optional[int] = None) -> bool:
+        """Decode replica delivered the request — at-most-once via the
+        decode tier's (shareable) completion ledger."""
+        return self.decode.finish(decode_rid, request_id, tokens=tokens)
+
+    def tick(self, now: Optional[float] = None) -> List[str]:
+        return self.prefill.tick(now) + self.decode.tick(now)
+
+    def pump(self) -> int:
+        return self.prefill.pump() + self.decode.pump()
+
+    def publish_occupancy(self) -> None:
+        """Per-fleet labels on the existing occupancy families: each
+        tier's aggregate used/total KV blocks from the latest
+        heartbeats (the unlabeled series stays the single-replica
+        serve loop's own)."""
+        for name, tier in (("prefill", self.prefill),
+                           ("decode", self.decode)):
+            used = total = 0
+            for r in tier._replicas.values():
+                if r.snapshot is None:
+                    continue
+                total += r.snapshot.total_blocks
+                used += (r.snapshot.total_blocks
+                         - r.effective_free())
+            metrics.SERVING_KV_BLOCKS_USED.set(used, {"fleet": name})
+            metrics.SERVING_KV_BLOCKS_TOTAL.set(total, {"fleet": name})

@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time as _time
 from collections import deque
 from typing import Any, List, Optional, Sequence
 
@@ -95,6 +96,31 @@ class ServeResult:
     # (shared prefix blocks included) — blocks/tokens is the bench's
     # per-request memory-efficiency row; 0 under dense serving
     kv_blocks: int = 0
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One request's prefill -> decode handoff: the first sampled token
+    plus the lane's exported KV blocks (models/paging.BlockExport — the
+    block table IS the wire format).  Produced by
+    serve_loop(prefill_only=True) on the prefill fleet, consumed by
+    serve_loop(adopt=[...]) on the decode fleet.
+
+    `completed` marks a request that FINISHED at its first token (EOS,
+    or a budget of 1) — its export is None because there is nothing
+    left to decode; the decode side emits the result without touching
+    a lane.  prompt_len is the FULL prompt (shared prefix included):
+    the decode call is handed the full prompts and validates the
+    pairing, so a shuffled handoff list refuses instead of decoding
+    someone else's KV."""
+
+    rid: int
+    prompt_len: int
+    budget: int
+    first_token: int
+    export: Optional[Any] = None
+    completed: bool = False
+    prefix_len: int = 0
 
 
 @functools.lru_cache(maxsize=8)
@@ -426,6 +452,8 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                pool_blocks: Optional[int] = None,
                paged_kernel: Optional[str] = None,
                scheduler: str = "slot",
+               prefill_only: bool = False,
+               adopt: Optional[Sequence[KVHandoff]] = None,
                telemetry: Optional[ServeTelemetry] = None,
                return_stats: bool = False):
     """Serve `requests` (1-D int32 prompts) through `slots` decode lanes
@@ -593,6 +621,28 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     it never introduces a device sync the loop didn't already do, so
     tokens and scheduling are byte-identical with or without it.
 
+    prefill_only / adopt: DISAGGREGATED prefill/decode serving (paged
+    only — the handoff's wire format IS the block table,
+    models/paging.BlockExport).  prefill_only=True runs the slot
+    scheduler's admission + chunked-prefill path, but a lane that
+    samples its first token EXPORTS its blocks (content hashes in table
+    order + payload; whole shared-prefix blocks ship once per call) and
+    frees them instead of decoding — the call returns a KVHandoff per
+    request.  adopt=[KVHandoff, ...] is the decode fleet's half: each
+    admission ADOPTS its handoff into this call's pool (fresh ids,
+    refcounts as the ownership protocol, shared blocks deduped by
+    content hash through a per-call HandoffRegistry) and the lane goes
+    live at the handoff's first token — under the slot OR continuous
+    scheduler, unchanged.  Greedy tokens across the handoff are
+    byte-identical to the unified slot loop (the KV bytes are exact
+    copies and greedy continuations depend only on the prompt),
+    including int8 KV, shared-prefix, and sliding-window tables
+    (tests/test_zdisagg.py's parity matrix).  Refusals: dense mode
+    (nothing to export/adopt), speculation (two pools would ship),
+    prefill_only + continuous (no decode lanes to fuse with), and
+    adopt + shared_prefix (the prefix rides the handoff — pass the
+    full prompts).
+
     Greedy outputs are token-identical to per-request llama.generate
     calls; sampling draws its keys from the serve loop's own stream (the
     procedure, not the key path, matches)."""
@@ -602,6 +652,33 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             f"scheduler must be 'slot' or 'continuous', got "
             f"{scheduler!r}")
     continuous = scheduler == "continuous"
+    if prefill_only and adopt is not None:
+        raise ValueError(
+            "prefill_only and adopt are the two ENDS of a handoff — a "
+            "call is either the prefill fleet's half or the decode "
+            "fleet's half, never both")
+    if (prefill_only or adopt is not None) and not paged:
+        raise ValueError(
+            "disaggregated serving is paged-only: the handoff's wire "
+            "format IS the block table (models/paging.BlockExport) — "
+            "a dense lane has no blocks to export or adopt; pass "
+            "paged=True")
+    if (prefill_only or adopt is not None) and draft is not None:
+        raise ValueError(
+            "speculative serving does not hand off: target and draft "
+            "share the block table but ship as TWO pools — drop the "
+            "draft or serve unified")
+    if prefill_only and continuous:
+        raise ValueError(
+            "prefill_only rides the slot scheduler's admission/prefill "
+            "path (there are no decode lanes to fuse with) — use "
+            "scheduler='slot' on the prefill fleet; the DECODE side "
+            "takes adopt= under either scheduler")
+    if adopt is not None and shared_prefix is not None:
+        raise ValueError(
+            "adopt= refuses shared_prefix: the prefix's blocks ride "
+            "the handoff (content-hash dedup adopts them once) — pass "
+            "the FULL prompts the prefill side served")
     reqs = [jnp.asarray(r, jnp.int32).reshape(-1) for r in requests]
     if not reqs:
         # zero requests is still a (trivial) run: the telemetry reports
@@ -626,6 +703,29 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {b} (request {i})")
     max_new = max(budgets)
+    if adopt is not None:
+        adopt = list(adopt)
+        if len(adopt) != len(reqs):
+            raise ValueError(
+                f"adopt has {len(adopt)} handoffs for {len(reqs)} "
+                f"requests — adopt[i] pairs with requests[i]")
+        for i, h in enumerate(adopt):
+            if int(h.prompt_len) != int(reqs[i].shape[0]):
+                raise ValueError(
+                    f"handoff {i}: prompt_len {h.prompt_len} != "
+                    f"request length {int(reqs[i].shape[0])} — the "
+                    f"decode side takes the FULL prompt the prefill "
+                    f"side served (prefix included), in the same order")
+            if int(h.budget) != budgets[i]:
+                raise ValueError(
+                    f"handoff {i}: prefill planned budget {h.budget} "
+                    f"but this call asked {budgets[i]} — budgets must "
+                    f"match across the handoff or completed-at-prefill "
+                    f"decisions diverge")
+            if not h.completed and h.export is None:
+                raise ValueError(
+                    f"handoff {i}: no export and not completed — "
+                    f"nothing to adopt")
     if prefill_chunk is not None and prefill_chunk < 1:
         raise ValueError(
             f"prefill_chunk must be >= 1, got {prefill_chunk}")
@@ -877,16 +977,28 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             # write_slack: a decode block runs to its edge past
             # EOS/budget, and those overshoot writes wrap the modular
             # table too — the rotation shadows must cover them
+            # prefill_only plans PROMPT-ONLY lanes: no decode position
+            # ever writes, so neither the budget nor the overshoot
+            # slack rotates the ring — the prefill fleet's pool is
+            # sized for prompts, which is the point of the split
             plans = [paging.plan_window_request(
-                int(r.shape[0]), budgets[i], block_size, t_blocks,
-                p_fix, write_slack=steps_per_sync - 1)
+                int(r.shape[0]), 0 if prefill_only else budgets[i],
+                block_size, t_blocks, p_fix,
+                write_slack=0 if prefill_only else steps_per_sync - 1)
                 for i, r in enumerate(reqs)]
         else:
             t_blocks = paging.blocks_for(
                 worst_total + headroom, block_size)
-            # linear plans carry rotated=0: no slot ever wraps
+            # linear plans carry rotated=0: no slot ever wraps.  A
+            # prefill_only lane reserves only its PROMPT's blocks —
+            # the first token samples off the final fill's logits
+            # without a decode write, and growth belongs to the
+            # decode fleet's pool
             plans = [paging.plan_request(int(r.shape[0]),
-                                         budgets[i], headroom,
+                                         0 if prefill_only
+                                         else budgets[i],
+                                         0 if prefill_only
+                                         else headroom,
                                          block_size, p_fix) + (0,)
                      for i, r in enumerate(reqs)]
         if pool_blocks is None:
@@ -1099,6 +1211,45 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         lane_own: List[List[int]] = [[] for _ in range(slots)]
         lane_nblocks = [0] * slots
         lane_rot: dict = {}
+        # --- disaggregated handoff state (prefill_only / adopt) ---
+        # sender side: hashes already shipped this call (a hot shared
+        # prefix transfers once; later exports elide it by hash)
+        sent_hashes: set = set()
+        handoffs: List[Optional[KVHandoff]] = (
+            [None] * len(reqs) if prefill_only else [])
+        # receiver side: the registry maps content hash <-> adopted
+        # block id so N adoptions of one prefix hold N refs on ONE
+        # block — release must flow through it (not raw pool.decref)
+        # or the hash map leaks ids whose blocks were freed
+        adopt_registry = (paging.HandoffRegistry(pool)
+                          if adopt is not None else None)
+        if adopt is not None:
+            # every export adopts against the UNION of the batch's
+            # payloads: a sender elides bytes it already shipped under
+            # an earlier request's hash, but a preempt on this side
+            # can free that block before a later re-admission needs it
+            _union: dict = {}
+            for h in adopt:
+                if h.export is not None:
+                    _union.update(h.export.payload)
+            adopt_exports: List[Optional[Any]] = []
+            for h in adopt:
+                if h.export is None:
+                    adopt_exports.append(None)
+                    continue
+                e = h.export
+                full = paging.BlockExport(
+                    e.block_size, e.hashes, e.shared,
+                    {hh: _union[hh] for hh in e.hashes
+                     if hh in _union},
+                    e.window)
+                adopt_exports.append(full)
+
+        def _release_shared(ids):
+            if adopt_registry is not None:
+                adopt_registry.release(ids)
+            else:
+                pool.decref(ids)
     else:
         if p_fix:
             # prefill the shared prefix ONCE (write-only: the logits of
@@ -1156,6 +1307,20 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     if paged:
         tel.pool_configured(pool_blocks, block_size, paged_kernel)
         tel.blocks_in_use(pool.used)  # prefix blocks, if any
+    if adopt is not None:
+        # completed-at-prefill handoffs (EOS / budget 1 on the first
+        # token) carry no export: surface the prefill fleet's answer
+        # directly — the decode side never owns a lane for them
+        for i, h in enumerate(adopt):
+            if not h.completed:
+                continue
+            tel.request_admitted(i, -1)
+            tel.request_activated(i, 0)
+            results[i] = ServeResult(
+                tokens=[int(h.first_token)], admitted_at_step=0,
+                finished_at_step=0, slot=-1)
+            tel.request_finished(i, results[i], 0)
+        queue = deque(i for i in queue if not adopt[i].completed)
     # continuous + paged (non-spec, non-windowed) admits LAZILY: a lane
     # allocates only the blocks its next step writes (paging.step_gate),
     # growing coverage per segment / per decode block.  Windowed lanes
@@ -1195,7 +1360,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             # land in a block the allocator hands to someone else
             lane_rot.pop(s, None)
             if lane_shared[s]:
-                pool.decref(lane_shared[s])
+                _release_shared(lane_shared[s])
             if lane_own[s]:
                 pool.decref(lane_own[s])
             lane_shared[s], lane_own[s] = [], []
@@ -1236,12 +1401,48 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             else:
                 table = table.at[s, slot].set(new_id)
         if released:
-            pool.decref(released)
+            _release_shared(released)
             for rid in released:
                 lane_shared[s].remove(rid)
             tel.blocks_in_use(pool.used)
         if evicted:
             tel.window_blocks_evicted(evicted)
+
+    def _export_lane(s, ridx):
+        """Ship lane s's KV blocks in wire form: the block-id table IS
+        the wire format.  Windowed lanes carry the ring's slot map and
+        rotation cursor so the decode side resumes the SAME modular
+        table mid-rotation; linear lanes ship prompt blocks in
+        position order.  Only whole shared-prefix blocks are marked
+        dedupe-eligible — a CoW boundary block's tail is lane-private
+        and must transfer every time."""
+        p_len = reqs[ridx].shape[0]
+        rot = lane_rot.get(s)
+        if rot is not None:
+            ids, shared_f, slots_map = [], [], []
+            for slot_i, bid in enumerate(rot.slots):
+                if bid == paging.SCRATCH_BLOCK:
+                    slots_map.append(-1)
+                    continue
+                slots_map.append(len(ids))
+                ids.append(bid)
+                shared_f.append(slot_i in rot.shared_slots)
+            window_meta = {"ring": len(rot.slots), "slots": slots_map,
+                           "shared_slots": sorted(rot.shared_slots),
+                           "next_block": rot.next_block}
+        else:
+            n_blk = paging.blocks_for(p_len, block_size)
+            ids = (lane_shared[s] + lane_own[s])[:n_blk]
+            shared_f = [i < len(lane_shared[s])
+                        for i in range(len(ids))]
+            window_meta = None
+        t0 = _time.perf_counter()
+        exp = paging.export_blocks(cache, ids, shared_f, block_size,
+                                   sent_hashes=sent_hashes,
+                                   window=window_meta)
+        tel.handoff_exported(len(exp), exp.payload_blocks(),
+                             _time.perf_counter() - t0)
+        return exp
 
     def activate_lane(s, first: int, dev_done: bool = False):
         """The lane goes LIVE with its sampled first token — shared by
@@ -1270,6 +1471,19 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             pos = pos.at[s].set(p_len)
         frozen_py[s] = False
         tel.request_activated(ridx, n_step)
+        if prefill_only:
+            # the prefill fleet's job ends at the first token: ship
+            # the lane's block table (unless the request finished
+            # outright — EOS or a single-token budget needs no decode
+            # fleet at all) and free the lane for the next prompt
+            done = first == eos or budgets[ridx] == 1
+            handoffs[ridx] = KVHandoff(
+                rid=ridx, prompt_len=int(p_len),
+                budget=budgets[ridx], first_token=first,
+                prefix_len=p_fix, completed=done,
+                export=None if done else _export_lane(s, ridx))
+            finish(s)
+            return
         if first == eos or budgets[ridx] == 1:
             finish(s)
 
@@ -1349,6 +1563,115 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                         st["d_row"] = d_write(draft_params, st["d_row"],
                                               piece, jnp.int32(start))
 
+    def _admit_adopt(s) -> bool:
+        """Admit the queue head into lane s by ADOPTING its handoff:
+        no prefill — the blocks arrive written.  The memory gate
+        covers the export's fresh blocks (dedup hits are increfs)
+        PLUS this side's decode growth (linear tail / window shadows;
+        lazily-grown under the continuous blocks-per-step gate).  The
+        lane activates immediately with the prefill fleet's first
+        token.  False = gate failed (FIFO: stop admitting)."""
+        nonlocal cache, table, tok, pos
+        ridx = queue[0]
+        h = adopt[ridx]
+        exp = adopt_exports[ridx]
+        p_len = int(reqs[ridx].shape[0])
+        fresh = paging.adoption_cost(exp, adopt_registry)
+        win = exp.window
+        if windowed:
+            if win is None or win["ring"] != t_blocks:
+                raise paging.HandoffError(
+                    f"windowed adoption needs a matching ring: sender "
+                    f"shipped {None if win is None else win['ring']}, "
+                    f"this pool's tables are {t_blocks} wide")
+            # decode growth, two kinds: TAIL slots (still scratch in
+            # the export — the sender's prompt-only plan never
+            # reserved them; decode writes land there before the ring
+            # ever wraps) and SHADOWS for the remaining wraps onto
+            # surviving shared slots (occupied non-shared slots
+            # rotate in place, costing nothing)
+            shs = set(win["shared_slots"])
+            smap = win["slots"]
+            last = (p_len + budgets[ridx] + steps_per_sync - 2
+                    ) // block_size
+            tail_slots: List[int] = []
+            shadow_n = 0
+            seen_sl: set = set()
+            for j in range(p_len // block_size, last + 1):
+                sl = j % win["ring"]
+                if sl in seen_sl:
+                    continue
+                seen_sl.add(sl)
+                if smap[sl] < 0:
+                    tail_slots.append(sl)
+                elif sl in shs and j >= win["next_block"]:
+                    shs.discard(sl)
+                    shadow_n += 1
+            growth = len(tail_slots) + shadow_n
+            if not pool.can_alloc(fresh + growth):
+                tel.admission_blocked_on_memory(ridx)
+                return False
+        elif cb_lazy:
+            growth = 0  # decode blocks grow lazily per step
+            if hold_admissions or not paging.step_gate(
+                    pool.free_blocks, fresh, len(in_flight())):
+                tel.admission_blocked_on_memory(ridx)
+                return False
+        else:
+            growth = plans[ridx][0] - paging.blocks_for(p_len,
+                                                        block_size)
+            if not pool.can_alloc(fresh + growth):
+                tel.admission_blocked_on_memory(ridx)
+                return False
+        queue.popleft()
+        t0 = _time.perf_counter()
+        cache, adopted, sh_ids, own_ids, stats = paging.adopt_blocks(
+            cache, pool, exp, adopt_registry, pad_to=t_blocks)
+        grow = pool.alloc(growth) if growth else []
+        lane_shared[s] = sh_ids
+        lane_own[s] = own_ids + grow
+        lane_nblocks[s] = len(adopted) + len(grow)
+        tel.handoff_adopted(stats["fresh"], stats["deduped"],
+                            _time.perf_counter() - t0)
+        if stats["deduped"]:
+            tel.prefix_blocks_reused(stats["deduped"])
+        if windowed:
+            slots_ids = [paging.SCRATCH_BLOCK] * win["ring"]
+            for slot_i, idx in enumerate(win["slots"]):
+                if idx >= 0:
+                    slots_ids[slot_i] = adopted[idx]
+            for sl, bid in zip(tail_slots, grow):
+                slots_ids[sl] = bid
+            rot = paging.WindowRotation(slots_ids, 0,
+                                        grow[len(tail_slots):],
+                                        block_size,
+                                        cfg.sliding_window)
+            # resume the sender's rotation MID-RING: same surviving
+            # shared slots, same cursor — the modular table picks up
+            # exactly where the prefill fleet's writes stopped
+            rot.shared_slots = set(win["shared_slots"])
+            rot.next_block = win["next_block"]
+            lane_rot[s] = rot
+            row = slots_ids
+        else:
+            row = adopted + grow
+        if host_tbl:
+            table[s] = 0
+            table[s, :len(row)] = row
+        else:
+            table = table.at[s].set(paging.build_table(row, t_blocks))
+        owner[s] = ridx
+        spec_acc[s] = (0, 0)
+        admitted_step[s] = n_step
+        emitted[s] = [int(h.first_token)]
+        tok = tok.at[s].set(int(h.first_token))
+        pos = pos.at[s].set(p_len)
+        frozen_py[s] = False
+        tel.request_admitted(ridx, s)
+        tel.blocks_in_use(pool.used)
+        tel.request_activated(ridx, n_step)
+        return True
+
     if continuous:
         # ================================================================
         # iteration-level scheduler (Orca-style continuous batching).
@@ -1411,7 +1734,7 @@ def serve_loop(model, params, requests: Sequence[Any], *,
             frozen_py[s] = True
             lane_rot.pop(s, None)
             if lane_shared[s]:
-                pool.decref(lane_shared[s])
+                _release_shared(lane_shared[s])
             if lane_own[s]:
                 pool.decref(lane_own[s])
             lane_shared[s], lane_own[s] = [], []
@@ -1444,6 +1767,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
                     continue
                 ridx = queue[0]
                 if paged:
+                    if adopt is not None:
+                        # disaggregated decode side: admission adopts
+                        # the prefill fleet's blocks — no prefill here
+                        if not _admit_adopt(s):
+                            return
+                        continue
                     _tot, shared_i, private_i, cow_i, rot_i = plans[ridx]
                     shared_ids = prefix_ids[:shared_i]
                     if cb_lazy:
@@ -1706,6 +2035,12 @@ def serve_loop(model, params, requests: Sequence[Any], *,
         for s in range(slots):
             if owner[s] is None and s not in pending and queue:
                 if paged:
+                    if adopt is not None:
+                        # disaggregated decode side: admission adopts
+                        # the prefill fleet's blocks — no prefill here
+                        if not _admit_adopt(s):
+                            break
+                        continue
                     ridx = queue[0]
                     _tot, shared_i, private_i, cow_i, rot_i = plans[ridx]
                     if not pool.can_alloc(private_i):
@@ -1869,6 +2204,13 @@ def serve_loop(model, params, requests: Sequence[Any], *,
     # every exit idles the occupancy gauge and samples the HBM peak —
     # a scrape between serve runs must not read the last block's state
     tel.loop_finished()
+    if prefill_only:
+        # the prefill fleet's product is handoffs, not token streams:
+        # one KVHandoff per request (completed ones carry the lone
+        # first token; the rest carry the exported block table)
+        if return_stats:
+            return handoffs, tel.finalize()
+        return handoffs  # type: ignore[return-value]
     if return_stats:
         return results, tel.finalize()
     return results  # type: ignore[return-value]

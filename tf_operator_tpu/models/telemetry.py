@@ -158,6 +158,11 @@ class ServeStats:
     wasted_lane_steps: int = 0
     fused_prefill_tokens: int = 0
     preemptions: int = 0
+    # disaggregated serving: lanes exported at the handoff point
+    # (prefill_only runs) and exports adopted into this pool
+    # (adopt= runs) — 0 for a unified loop
+    handoff_exports: int = 0
+    handoff_adoptions: int = 0
     total_tokens: int = 0
     wall_time_s: float = 0.0
     tokens_per_sec: float = 0.0
@@ -243,6 +248,8 @@ class ServeTelemetry:
         self._wasted_lane_steps = 0
         self._fused_prefill_tokens = 0
         self._preemptions = 0
+        self._handoff_exports = 0
+        self._handoff_adoptions = 0
 
     def _wall(self, pc: float) -> float:
         """Epoch seconds for a perf_counter reading, via the single
@@ -285,6 +292,8 @@ class ServeTelemetry:
         self._wasted_lane_steps = 0
         self._fused_prefill_tokens = 0
         self._preemptions = 0
+        self._handoff_exports = 0
+        self._handoff_adoptions = 0
         # step-mix gauges sample the last dispatch; a fresh run must
         # not inherit the previous run's final step
         em.SERVING_STEP_DECODE_ROWS.set(0)
@@ -378,6 +387,36 @@ class ServeTelemetry:
         if n > 0:
             self._wasted_lane_steps += n
             em.SERVING_LANE_WASTED_STEPS.inc(amount=n)
+
+    def handoff_exported(self, blocks: int, payload_blocks: int,
+                         duration_s: float) -> None:
+        """One lane's KV blocks left on the prefill→decode wire:
+        `payload_blocks` carried bytes, the rest were elided by
+        content hash (shared prefix already shipped to this
+        receiver)."""
+        self._handoff_exports += 1
+        if payload_blocks > 0:
+            em.SERVING_HANDOFF_BLOCKS.inc({"phase": "exported"},
+                                          payload_blocks)
+        if blocks - payload_blocks > 0:
+            em.SERVING_HANDOFF_BLOCKS.inc({"phase": "elided"},
+                                          blocks - payload_blocks)
+        em.SERVING_HANDOFF_DURATION.observe(duration_s,
+                                            {"side": "export"})
+
+    def handoff_adopted(self, fresh: int, deduped: int,
+                        duration_s: float) -> None:
+        """One handoff landed in this decode replica's pool: `fresh`
+        blocks allocated+written, `deduped` resolved to already-
+        adopted blocks by content hash (incref, no bytes moved)."""
+        self._handoff_adoptions += 1
+        if fresh > 0:
+            em.SERVING_HANDOFF_BLOCKS.inc({"phase": "adopted"}, fresh)
+        if deduped > 0:
+            em.SERVING_HANDOFF_BLOCKS.inc({"phase": "deduped"},
+                                          deduped)
+        em.SERVING_HANDOFF_DURATION.observe(duration_s,
+                                            {"side": "adopt"})
 
     def preempted_to_queue(self, index: int) -> None:
         """The continuous scheduler evicted a lane under block-pool
@@ -579,6 +618,8 @@ class ServeTelemetry:
             wasted_lane_steps=self._wasted_lane_steps,
             fused_prefill_tokens=self._fused_prefill_tokens,
             preemptions=self._preemptions,
+            handoff_exports=self._handoff_exports,
+            handoff_adoptions=self._handoff_adoptions,
             total_tokens=total_tokens,
             wall_time_s=wall,
             tokens_per_sec=total_tokens / wall if wall > 0 else 0.0,
